@@ -1,0 +1,15 @@
+from .numerics import cast_to_format, cast_oracle, max_finite
+from .quant_function import float_quantize, quantizer, quant_gemm
+from .quant_module import Quantizer, QuantLinear, QuantConv
+
+__all__ = [
+    "cast_to_format",
+    "cast_oracle",
+    "max_finite",
+    "float_quantize",
+    "quantizer",
+    "quant_gemm",
+    "Quantizer",
+    "QuantLinear",
+    "QuantConv",
+]

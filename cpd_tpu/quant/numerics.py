@@ -1,0 +1,207 @@
+"""Core eXmY custom-precision cast — the semantic heart of the framework.
+
+This module re-implements, TPU-natively (pure jnp bit-twiddling, fully
+vectorized, jit/vmap/grad-safe), the semantics of the reference CUDA device
+function ``cast_precision`` (reference: CPDtorch/quant/quant_cuda/
+float_kernel.cu:10-92).  Everything else in the framework — elementwise
+quantization, the quantized-accumulator GEMM, the APS low-precision gradient
+all-reduce — composes this one function.
+
+Semantics (matching the reference exactly, with deviations documented):
+
+* Input is IEEE FP32.  Target format has ``exp_bits`` exponent bits
+  (1..8) and ``man_bits`` mantissa bits (0..23), bias ``2^(exp_bits-1)-1``.
+* Inf / NaN / ±0 pass through unchanged (float_kernel.cu:17-19).
+* FP32 subnormal inputs flush to +0.0 — unsigned, as the reference returns
+  literal ``0`` (float_kernel.cu:87-91).
+* Exponent overflow is checked *before* mantissa rounding and saturates to
+  ±FP32-infinity (float_kernel.cu:24-30).  Consequently a value whose
+  mantissa *rounds up* past the target max does NOT become Inf — the carry
+  propagates into the exponent and the (out-of-format) value ``2^(e+1)`` is
+  returned, exactly as the reference does (the TODO at float_kernel.cu:71
+  acknowledges this).  We replicate it bit-for-bit: emulation fidelity
+  trumps IEEE correctness.
+* Normal targets: round-to-nearest-even on the 24-bit significand at bit
+  position ``23 - man_bits`` (float_kernel.cu:33-49).
+* Subnormal targets: the significand is right-shifted by ``1 - e_new``
+  first (truncating the shifted-out bits — a deliberate double-rounding
+  quirk of the reference, float_kernel.cu:52) and *then* RTNE-rounded at the
+  same bit position (float_kernel.cu:56-69).  We replicate the truncating
+  shift exactly.
+* Deviation 1: for ``man_bits == 23`` the reference's subnormal rounding
+  computes ``1 << -1`` (undefined behaviour in C).  We define it as "no
+  rounding" (pure truncating shift), consistent with the normal-path
+  short-circuit at float_kernel.cu:33.
+* Deviation 2: shifts ≥ 32 are UB in C; we define them to produce 0 (which
+  is what NVIDIA hardware funnel-shifts produce in practice).
+
+The JAX implementation is pure: it returns a new array and never aliases its
+input.  The reference kernel mutates its (contiguous) input in place
+(float_kernel.cu:98, quant.cu:22-23); callers that relied on that aliasing
+are rewritten functionally at the API layer (quant_function.py here).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["cast_to_format", "cast_oracle", "max_finite", "FP32_EXP_BITS", "FP32_MAN_BITS"]
+
+FP32_EXP_BITS = 8
+FP32_MAN_BITS = 23
+
+
+def _validate(exp_bits: int, man_bits: int) -> None:
+    if not (1 <= exp_bits <= 8):
+        raise ValueError(f"exp_bits must be in [1, 8], got {exp_bits}")
+    if not (0 <= man_bits <= 23):
+        raise ValueError(f"man_bits must be in [0, 23], got {man_bits}")
+
+
+def max_finite(exp_bits: int, man_bits: int) -> float:
+    """Largest value the (exp_bits, man_bits) format can represent *normally*.
+
+    Note the reference saturates on pre-rounding exponent overflow, so the
+    max *exponent field* is ``2^exp_bits - 2`` (all-ones is treated as
+    reserved, float_kernel.cu:24).
+    """
+    _validate(exp_bits, man_bits)
+    bias = (1 << (exp_bits - 1)) - 1
+    e_max = ((1 << exp_bits) - 2) - bias
+    sig = 2.0 - 2.0 ** (-man_bits)
+    return sig * (2.0 ** e_max)
+
+
+def _rtne(man: jnp.ndarray, shift: int) -> jnp.ndarray:
+    """Round-to-nearest-even of an integer significand at bit `shift`.
+
+    Mirrors the three-way branch of float_kernel.cu:33-49 / :56-69:
+    round-down when the round bit is 0; round-up when the round bit is 1 and
+    sticky != 0; ties resolved to even (the kept LSB).
+    """
+    if shift <= 0:
+        return man
+    half = 1 << (shift - 1)
+    sticky_mask = half - 1
+    keep_mask = ~((1 << shift) - 1)
+    round_bit = (man & half) != 0
+    sticky = (man & sticky_mask) != 0
+    lsb = (man & (1 << shift)) != 0
+    inc = round_bit & (sticky | lsb)
+    man = jnp.where(inc, man + half, man)
+    return man & keep_mask
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def cast_to_format(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Cast FP32 array values into the eXmY format, vectorized.
+
+    Pure-functional, any shape/rank; `exp_bits`/`man_bits` are static so each
+    format compiles once (reference: one CUDA kernel specialization per call,
+    float_kernel.cu:94-101).
+    """
+    _validate(exp_bits, man_bits)
+    x = jnp.asarray(x, jnp.float32)
+
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    exp_f = ((bits >> 23) & 0xFF).astype(jnp.int32)
+    man_f = (bits & 0x007FFFFF).astype(jnp.int32)
+    negative = (bits >> 31) != 0
+
+    # Case split (float_kernel.cu:17-20, :87-91).
+    passthrough = (exp_f == 0xFF) | ((exp_f == 0) & (man_f == 0))
+    flush_to_zero = (exp_f == 0) & (man_f != 0)
+
+    bias = (1 << (exp_bits - 1)) - 1
+    man24 = man_f | (1 << 23)
+    new_e = exp_f - 127 + bias
+
+    # Pre-rounding saturation to +/-FP32-Inf (float_kernel.cu:24-30).
+    overflow = new_e >= ((1 << exp_bits) - 1)
+
+    shift = 23 - man_bits
+
+    # Normal-target path (float_kernel.cu:31-50): RTNE on the 24-bit
+    # significand; exponent carry from rounding flows into the value via the
+    # shared reconstruction below.
+    man_norm = _rtne(man24, shift)
+    e_norm = exp_f - 127  # new_e - bias
+
+    # Subnormal-target path (float_kernel.cu:51-70): truncating right shift
+    # by (1 - new_e), THEN RTNE.  Shift >= 24 wipes the significand.
+    sub_shift = jnp.clip(1 - new_e, 0, 24)  # man24 < 2^24, so >>24 == 0
+    man_sub = _rtne(man24 >> sub_shift, shift)
+    e_sub = 1 - bias
+
+    is_sub = new_e <= 0
+    man_out = jnp.where(is_sub, man_sub, man_norm)
+    e_out = jnp.where(is_sub, e_sub, e_norm)
+
+    # Value reconstruction (float_kernel.cu:72-86): man/2^23 * 2^e.  The
+    # significand fits exactly in fp32 (< 2^25) so this is exact.
+    mag = jnp.ldexp(man_out.astype(jnp.float32), e_out - 23)
+    val = jnp.where(negative, -mag, mag)
+
+    inf = jnp.where(negative, -jnp.inf, jnp.inf).astype(jnp.float32)
+    val = jnp.where(overflow, inf, val)
+    val = jnp.where(flush_to_zero, jnp.float32(0.0), val)
+    return jnp.where(passthrough, x, val)
+
+
+def cast_oracle(x: float, exp_bits: int, man_bits: int) -> float:
+    """Scalar NumPy transliteration of float_kernel.cu:10-92, used as the
+    correctness oracle in tests.  Follows the CUDA control flow literally."""
+    _validate(exp_bits, man_bits)
+    f = np.float32(x)
+    old_num = int(np.array(f, np.float32).view(np.uint32))
+    exp = (old_num & 0x7F800000) >> 23
+    man = old_num & 0x007FFFFF
+    true_exp = exp - 127
+    if exp == 0xFF or (exp == 0x00 and man == 0):
+        return float(f)
+    if exp > 0:
+        man = man | (1 << 23)
+        diy_bias = (1 << (exp_bits - 1)) - 1
+        new_e = true_exp + diy_bias
+        if new_e >= (1 << exp_bits) - 1:
+            return float(np.inf if f > 0 else -np.inf)
+        s = 23 - man_bits
+        if new_e > 0:
+            if man_bits == 23 or (man & (1 << (s - 1))) == 0:
+                man = man & ~((1 << s) - 1)
+            elif (man & ((1 << (s - 1)) - 1)) != 0:
+                man = (man + (1 << (s - 1))) & ~((1 << s) - 1)
+            else:
+                if (man & (1 << s)) != 0:
+                    man = man + (1 << (s - 1))
+                man = man & ~((1 << s) - 1)
+            new_e -= diy_bias
+        else:
+            shift_amt = 1 - new_e
+            man = man >> shift_amt if shift_amt < 32 else 0
+            new_e = 1 - diy_bias
+            if man_bits == 23:  # deviation 1: defined as no rounding
+                pass
+            elif (man & (1 << (s - 1))) == 0:
+                man = man & ~((1 << s) - 1)
+            elif (man & ((1 << (s - 1)) - 1)) != 0:
+                man = (man + (1 << (s - 1))) & ~((1 << s) - 1)
+            else:
+                if (man & (1 << s)) != 0:
+                    man = man + (1 << (s - 1))
+                man = man & ~((1 << s) - 1)
+        res = np.float32(man) / np.float32(1 << 23)
+        if new_e >= 0:
+            for _ in range(new_e):
+                res = np.float32(res * np.float32(2.0))
+        else:
+            for _ in range(-new_e):
+                res = np.float32(res / np.float32(2.0))
+        if old_num & (1 << 31):
+            res = -res
+        return float(res)
+    return 0.0

@@ -1,0 +1,129 @@
+"""Custom-precision quantization API (L1 of the layer map).
+
+TPU-native re-implementation of the reference Python quant API
+(reference: CPDtorch/quant/quant_function.py).  Differences by design:
+
+* Pure functional — `float_quantize` returns a new array; the reference
+  mutates contiguous CUDA inputs in place (quant.cu:22-23).  Numerics are
+  identical.
+* `quantizer` is a `jax.custom_vjp` identity instead of a torch autograd
+  Function (quant_function.py:33-57), with the same (8,23) shortcut.
+* `quant_gemm` (quant_function.py:78-98) supports two modes:
+  - ``faithful`` (default, matching the CUDA `tvm_gemm` kernel,
+    float_kernel.cu:103-220): sequential K-loop where every multiply and
+    every Kahan-compensated accumulation step is re-cast to eXmY.  On TPU
+    this runs as a `lax.scan` of rank-1 updates on the VPU — the MXU cannot
+    requantize mid-dot, the same fidelity/throughput trade the reference
+    made by not using tensor cores.
+  - ``fast``: fp32 MXU dot followed by a single output cast — the
+    "deployment" path for when emulation of the accumulator is not needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .numerics import cast_to_format
+
+__all__ = ["float_quantize", "quantizer", "quant_gemm"]
+
+
+def float_quantize(x: jnp.ndarray, exp: int, man: int) -> jnp.ndarray:
+    """Quantize an FP32 array into the eXmY format (round-to-nearest-even).
+
+    Mirrors reference `float_quantize` (quant_function.py:60-75); argument
+    order (exp, man) preserved.  Works on any shape, any backend (the
+    reference raises NotImplementedError on CPU, quant_function.py:28-29 —
+    here XLA compiles the same code for CPU/TPU).
+    """
+    return cast_to_format(x, exp, man)
+
+
+def quantizer(forward_exp: int = 8, forward_man: int = 23,
+              backward_exp: int = 8, backward_man: int = 23):
+    """Factory returning a function that quantizes activations on the forward
+    pass and cotangents on the backward pass, with identity shortcuts when
+    the format is (8, 23) — reference quant_function.py:33-57."""
+
+    @jax.custom_vjp
+    def _round(x):
+        if forward_exp == 8 and forward_man == 23:
+            return x
+        return cast_to_format(x, forward_exp, forward_man)
+
+    def _round_fwd(x):
+        return _round(x), None
+
+    def _round_bwd(_, g):
+        if backward_exp == 8 and backward_man == 23:
+            return (g,)
+        return (cast_to_format(g, backward_exp, backward_man),)
+
+    _round.defvjp(_round_fwd, _round_bwd)
+    return _round
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
+               mode: str = "faithful") -> jnp.ndarray:
+    """GEMM ``a @ b`` with an eXmY accumulator.
+
+    a: (M, K), b: (K, N) — reference quant_function.py:78-98.  The faithful
+    mode reproduces the CUDA kernel's numerics exactly (float_kernel.cu:
+    174-205): for k = 0..K-1 in order, with Kahan compensation, every
+    intermediate re-cast to eXmY:
+
+        tmp = cast(a[:, k] * b[k, :])
+        y   = cast(tmp - c)
+        t   = cast(s + y)
+        c   = cast(cast(t - s) - y)
+        s   = t
+
+    The CUDA kernel's K-tiling (rx_outer/rx_inner) visits k strictly in
+    ascending order, so a flat ordered scan is bit-identical.  Note the
+    reference edge-path bug (uninitialized Kahan residual for the last row
+    block when M % 16 != 0, float_kernel.cu:113,298) is UB, not semantics —
+    we use a zero-initialized residual everywhere, which is what the main
+    path does (float_kernel.cu:120).
+    """
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+        raise ValueError(f"quant_gemm expects (M,K)x(K,N); got {a.shape} x {b.shape}")
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    if mode == "fast":
+        # True fp32 MXU dot (HIGHEST forces fp32 multiply passes on TPU,
+        # where the default would be bf16) followed by one output cast.
+        out = jnp.dot(a, b, precision=lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+        if exp == 8 and man == 23:
+            return out
+        return cast_to_format(out, exp, man)
+    if mode != "faithful":
+        raise ValueError(f"unknown quant_gemm mode: {mode!r}")
+    # NOTE: no (8,23) shortcut here — the reference CUDA kernel runs the
+    # Kahan-compensated sequential loop for every format including fp32
+    # (quant_function.py:78-98 has no shortcut), and cast_to_format(8,23)
+    # still flushes fp32-subnormal intermediates, so bit-parity requires
+    # the full scan.  Use mode="fast" when emulation is not needed.
+
+    q = lambda t: cast_to_format(t, exp, man)
+    M, _ = a.shape
+    N = b.shape[1]
+
+    def step(carry, ab_k):
+        s, c = carry
+        a_k, b_k = ab_k  # (M,), (N,)
+        tmp = q(a_k[:, None] * b_k[None, :])
+        y = q(tmp - c)
+        t = q(s + y)
+        c = q(q(t - s) - y)
+        return (t, c), None
+
+    init = (jnp.zeros((M, N), jnp.float32), jnp.zeros((M, N), jnp.float32))
+    (s, _), _ = lax.scan(step, init, (a.T, b))
+    return s

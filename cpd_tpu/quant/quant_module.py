@@ -1,0 +1,176 @@
+"""Quantized NN layers (L1): QuantLinear / QuantConv as Flax modules.
+
+TPU-native re-implementation of reference CPDtorch/quant/quant_module.py.
+The reference wires a torch autograd Function whose backward recomputes both
+gradient GEMMs with the quantized accumulator and quantizes the bias-grad
+sum (quant_module.py:36-52); here that recipe is a `jax.custom_vjp` around
+the forward GEMM, so it composes with arbitrary surrounding autodiff (e.g.
+the im2col patch extraction in QuantConv).
+
+Weight layout parity: QuantLinear stores weight as (out_features,
+in_features) like torch.nn.Linear (quant_module.py:63); QuantConv stores
+(out_channels, in_channels, kh, kw) (quant_module.py:92-93).  Like the
+reference, QuantConv supports square kernels and ignores dilation/groups
+(documented quirk, quant_module.py:89-90 — args accepted, unused).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .quant_function import float_quantize, quant_gemm, quantizer
+
+__all__ = ["Quantizer", "QuantLinear", "QuantConv", "quant_linear_fn"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def quant_linear_fn(x: jnp.ndarray, weight: jnp.ndarray,
+                    bias: Optional[jnp.ndarray], exp: int, man: int,
+                    mode: str = "faithful") -> jnp.ndarray:
+    """y = x @ W^T + b with eXmY-accumulator GEMMs, reference backward recipe.
+
+    x: (M, in), weight: (out, in), bias: (out,) or None.
+    Forward: quant_gemm(x, W^T) + b      (quant_module.py:30-33)
+    Backward: grad_x = quant_gemm(g, W); grad_W = quant_gemm(g^T, x);
+              grad_b = float_quantize(g.sum(0))   (quant_module.py:36-52)
+    """
+    out = quant_gemm(x, weight.T, man=man, exp=exp, mode=mode)
+    if bias is not None:
+        out = out + bias[None, :]
+    return out
+
+
+def _qlin_fwd(x, weight, bias, exp, man, mode):
+    return quant_linear_fn(x, weight, bias, exp, man, mode), (x, weight, bias)
+
+
+def _qlin_bwd(exp, man, mode, res, g):
+    x, weight, bias = res
+    grad_x = quant_gemm(g, weight, man=man, exp=exp, mode=mode)
+    grad_w = quant_gemm(g.T, x, man=man, exp=exp, mode=mode)
+    grad_b = None if bias is None else float_quantize(g.sum(0), exp, man)
+    return grad_x, grad_w, grad_b
+
+
+quant_linear_fn.defvjp(_qlin_fwd, _qlin_bwd)
+
+
+def _kaiming_uniform(key, shape, fan_in, dtype=jnp.float32):
+    # torch kaiming_uniform_(a=sqrt(5)) => bound = sqrt(6/((1+5)*fan_in))
+    #                                            = 1/sqrt(fan_in)
+    # (quant_module.py:71,109)
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+class Quantizer(nn.Module):
+    """Activation quantizer module (quant_module.py:13-20)."""
+    forward_exp: int = 8
+    forward_man: int = 23
+    backward_exp: int = 8
+    backward_man: int = 23
+
+    @nn.compact
+    def __call__(self, x):
+        return quantizer(self.forward_exp, self.forward_man,
+                         self.backward_exp, self.backward_man)(x)
+
+
+class QuantLinear(nn.Module):
+    """Linear layer with eXmY-accumulator GEMM (quant_module.py:55-85)."""
+    in_features: int
+    out_features: int
+    use_bias: bool = True
+    exp: int = 8
+    man: int = 23
+    mode: str = "faithful"
+
+    @nn.compact
+    def __call__(self, x):
+        weight = self.param(
+            "weight",
+            lambda k, s: _kaiming_uniform(k, s, self.in_features),
+            (self.out_features, self.in_features))
+        bias = None
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                lambda k, s: _kaiming_uniform(k, s, self.in_features),
+                (self.out_features,))
+        squeeze = x.ndim == 1
+        x2 = x[None, :] if squeeze else x.reshape(-1, x.shape[-1])
+        y = quant_linear_fn(x2, weight, bias, self.exp, self.man, self.mode)
+        y = y.reshape(*x.shape[:-1], self.out_features) if not squeeze else y[0]
+        return y
+
+
+class QuantConv(nn.Module):
+    """2-D convolution via im2col + quantized GEMM (quant_module.py:88-139).
+
+    NCHW layout for API parity with the reference.  Square kernels only.
+    The reference accepts-and-ignores `dilation`/`groups` and silently
+    computes a dense dilation-1 conv (quant_module.py:89-90); we deviate by
+    raising instead — silent wrong numerics in a fresh API helps no one.
+    """
+    in_channels: int
+    out_channels: int
+    kernel_size: int
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    groups: int = 1
+    use_bias: bool = True
+    exp: int = 8
+    man: int = 23
+    mode: str = "faithful"
+
+    @nn.compact
+    def __call__(self, x):
+        if self.dilation != 1 or self.groups != 1:
+            raise ValueError(
+                "QuantConv supports dilation=1, groups=1 only (the reference "
+                f"silently ignores them); got dilation={self.dilation}, "
+                f"groups={self.groups}")
+        k = self.kernel_size
+        fan_in = self.in_channels * k * k
+        weight = self.param(
+            "weight",
+            lambda kk, s: _kaiming_uniform(kk, s, fan_in),
+            (self.out_channels, self.in_channels, k, k))
+        bias = None
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                lambda kk, s: _kaiming_uniform(kk, s, fan_in),
+                (self.out_channels,))
+
+        b, c, h, w = x.shape
+        out_h = (h - k + 2 * self.padding) // self.stride + 1
+        out_w = (w - k + 2 * self.padding) // self.stride + 1
+
+        # im2col matching torch.nn.functional.unfold's (C, kh, kw)-major
+        # patch layout (quant_module.py:135-136).
+        # conv_general_dilated_patches returns feature dim ordered as
+        # (C, kh, kw) flattened — same as unfold.
+        patches = lax.conv_general_dilated_patches(
+            x,
+            filter_shape=(k, k),
+            window_strides=(self.stride, self.stride),
+            padding=[(self.padding, self.padding)] * 2,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # (B, C*k*k, out_h, out_w)
+        patches = patches.reshape(b, c * k * k, out_h * out_w)
+        patches = jnp.transpose(patches, (0, 2, 1)).reshape(b * out_h * out_w,
+                                                            c * k * k)
+        w2 = weight.reshape(self.out_channels, c * k * k)
+        y = quant_linear_fn(patches, w2, bias, self.exp, self.man, self.mode)
+        y = y.reshape(b, out_h * out_w, self.out_channels)
+        y = jnp.transpose(y, (0, 2, 1))
+        return y.reshape(b, self.out_channels, out_h, out_w)

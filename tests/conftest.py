@@ -1,0 +1,24 @@
+"""Test config: force an 8-device virtual CPU platform before any test runs.
+
+This is the JAX analog of the reference's `--emulate_node` testing trick
+(reference: README.md:76-79) — multi-device semantics without hardware.
+Note the axon TPU plugin overrides the JAX_PLATFORMS env var, so we must
+also force the platform through jax.config after import.
+"""
+
+import os
+import re
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu"
+assert len(jax.devices()) == 8

@@ -1,0 +1,113 @@
+"""Tests for float_quantize / quantizer / quant_gemm vs. scalar oracles."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cpd_tpu.quant.numerics import cast_oracle
+from cpd_tpu.quant.quant_function import float_quantize, quant_gemm, quantizer
+
+
+def _gemm_oracle(a, b, exp, man):
+    """Literal transliteration of the CUDA tvm_gemm inner loop
+    (float_kernel.cu:174-205): ordered K, Kahan, every step cast."""
+    M, K = a.shape
+    N = b.shape[1]
+    co = lambda v: np.float32(cast_oracle(float(np.float32(v)), exp, man))
+    out = np.zeros((M, N), np.float32)
+    for i in range(M):
+        for j in range(N):
+            s = np.float32(0.0)
+            c = np.float32(0.0)
+            for k in range(K):
+                tmp = co(np.float32(a[i, k]) * np.float32(b[k, j]))
+                y = co(tmp - c)
+                t = co(s + y)
+                c = co(co(t - s) - y)
+                s = t
+            out[i, j] = s
+    return out
+
+
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3), (5, 10)])
+@pytest.mark.parametrize("shape", [(4, 9, 5), (3, 16, 3), (1, 1, 1), (7, 33, 2)])
+def test_quant_gemm_matches_oracle(exp, man, shape):
+    M, K, N = shape
+    rng = np.random.default_rng(M * 100 + K + exp)
+    a = rng.standard_normal((M, K)).astype(np.float32)
+    b = rng.standard_normal((K, N)).astype(np.float32)
+    got = np.asarray(quant_gemm(jnp.asarray(a), jnp.asarray(b), man=man, exp=exp))
+    want = _gemm_oracle(a, b, exp, man)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_quant_gemm_fp32_faithful_runs_kahan():
+    # (8,23) faithful mode must run the full Kahan scan (no shortcut):
+    # bit-compare against the oracle, which differs from a plain dot.
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 16)).astype(np.float32)
+    b = rng.standard_normal((16, 3)).astype(np.float32)
+    got = np.asarray(quant_gemm(jnp.asarray(a), jnp.asarray(b)))
+    want = _gemm_oracle(a, b, 8, 23)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_allclose(got, a @ b, rtol=1e-5)  # sanity vs plain dot
+
+
+def test_quant_gemm_fast_mode():
+    from jax import lax
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((8, 16)).astype(np.float32)
+    b = rng.standard_normal((16, 4)).astype(np.float32)
+    got = np.asarray(quant_gemm(jnp.asarray(a), jnp.asarray(b), man=2, exp=5,
+                                mode="fast"))
+    # bitwise: cast of the *same* fp32 dot (same precision setting)
+    dot = np.asarray(jnp.dot(jnp.asarray(a), jnp.asarray(b),
+                             precision=lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32))
+    want = np.array([[cast_oracle(float(v), 5, 2) for v in row]
+                     for row in dot], np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_quant_gemm_fast_mode_fp32_is_plain_dot():
+    from jax import lax
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((8, 16)).astype(np.float32)
+    b = rng.standard_normal((16, 4)).astype(np.float32)
+    got = np.asarray(quant_gemm(jnp.asarray(a), jnp.asarray(b), mode="fast"))
+    want = np.asarray(jnp.dot(jnp.asarray(a), jnp.asarray(b),
+                              precision=lax.Precision.HIGHEST,
+                              preferred_element_type=jnp.float32))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_float_quantize_shapes_and_purity():
+    x = jnp.ones((2, 3, 4)) * 1.1
+    y = float_quantize(x, 5, 2)
+    assert y.shape == x.shape
+    assert float(x[0, 0, 0]) == np.float32(1.1)  # input not mutated (pure)
+    assert float(y[0, 0, 0]) == 1.0  # 1.1 -> e5m2 -> 1.0
+
+
+def test_quantizer_forward_and_backward():
+    qf = quantizer(5, 2, 4, 3)
+    x = jnp.asarray(np.array([1.1, -2.3, 0.07], np.float32))
+    y = qf(x)
+    want_f = [cast_oracle(v, 5, 2) for v in [1.1, -2.3, 0.07]]
+    np.testing.assert_array_equal(np.asarray(y), np.float32(want_f))
+
+    # backward quantizes the cotangent with the backward format
+    _, vjp = jax.vjp(qf, x)
+    g = jnp.asarray(np.array([1.1, -2.3, 0.07], np.float32))
+    (gx,) = vjp(g)
+    want_b = [cast_oracle(v, 4, 3) for v in [1.1, -2.3, 0.07]]
+    np.testing.assert_array_equal(np.asarray(gx), np.float32(want_b))
+
+
+def test_quantizer_identity_shortcut():
+    qf = quantizer(8, 23, 8, 23)
+    x = jnp.asarray(np.array([1e-45, 1.1], np.float32))  # subnormal survives
+    y = qf(x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))

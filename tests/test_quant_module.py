@@ -1,0 +1,92 @@
+"""Tests for QuantLinear / QuantConv: forward vs torch-unfold oracle,
+backward vs the reference gradient recipe (quant_module.py:36-52)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cpd_tpu.quant.quant_function import float_quantize, quant_gemm
+from cpd_tpu.quant.quant_module import QuantConv, QuantLinear, quant_linear_fn
+
+
+def test_quant_linear_forward():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((6, 5)).astype(np.float32)
+    w = rng.standard_normal((3, 5)).astype(np.float32)
+    b = rng.standard_normal((3,)).astype(np.float32)
+    got = quant_linear_fn(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), 5, 2)
+    want = np.asarray(quant_gemm(jnp.asarray(x), jnp.asarray(w).T, man=2, exp=5)) + b
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_quant_linear_backward_recipe():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((6, 5)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((3, 5)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((3,)).astype(np.float32))
+    g = jnp.asarray(rng.standard_normal((6, 3)).astype(np.float32))
+
+    _, vjp = jax.vjp(lambda x_, w_, b_: quant_linear_fn(x_, w_, b_, 5, 2), x, w, b)
+    gx, gw, gb = vjp(g)
+    np.testing.assert_array_equal(
+        np.asarray(gx), np.asarray(quant_gemm(g, w, man=2, exp=5)))
+    np.testing.assert_array_equal(
+        np.asarray(gw), np.asarray(quant_gemm(g.T, x, man=2, exp=5)))
+    np.testing.assert_array_equal(
+        np.asarray(gb), np.asarray(float_quantize(g.sum(0), 5, 2)))
+
+
+def test_quant_linear_module():
+    m = QuantLinear(in_features=5, out_features=3, exp=5, man=2)
+    x = jnp.ones((2, 5))
+    params = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(params, x)
+    assert y.shape == (2, 3)
+    w = params["params"]["weight"]
+    assert w.shape == (3, 5)
+    bound = 1.0 / np.sqrt(5)
+    assert np.all(np.abs(np.asarray(w)) <= bound)
+
+
+@pytest.mark.parametrize("stride,padding", [(1, 0), (1, 1), (2, 1)])
+def test_quant_conv_vs_torch_unfold_oracle(stride, padding):
+    """The conv must equal: torch unfold -> (our) quantized GEMM -> fold.
+    torch (CPU) provides the im2col layout oracle; the GEMM numerics are
+    already oracle-tested."""
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(2)
+    B, C, H, W, O, k = 2, 3, 8, 8, 4, 3
+    x = rng.standard_normal((B, C, H, W)).astype(np.float32)
+    wgt = rng.standard_normal((O, C, k, k)).astype(np.float32)
+    bias = rng.standard_normal((O,)).astype(np.float32)
+
+    m = QuantConv(in_channels=C, out_channels=O, kernel_size=k, stride=stride,
+                  padding=padding, exp=5, man=2)
+    variables = {"params": {"weight": jnp.asarray(wgt), "bias": jnp.asarray(bias)}}
+    got = np.asarray(m.apply(variables, jnp.asarray(x)))
+
+    out_h = (H - k + 2 * padding) // stride + 1
+    out_w = (W - k + 2 * padding) // stride + 1
+    inp_unf = F.unfold(torch.from_numpy(x), (k, k), stride=stride,
+                       padding=padding).transpose(1, 2)  # (B, L, C*k*k)
+    a = inp_unf.reshape(B * out_h * out_w, C * k * k).numpy()
+    w2 = wgt.reshape(O, C * k * k)
+    y = np.asarray(quant_gemm(jnp.asarray(a), jnp.asarray(w2).T, man=2, exp=5)) + bias
+    want = y.reshape(B, out_h * out_w, O).transpose(0, 2, 1).reshape(
+        B, O, out_h, out_w)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_quant_conv_grad_flows():
+    m = QuantConv(in_channels=2, out_channels=3, kernel_size=3, padding=1,
+                  exp=5, man=2)
+    x = jnp.ones((1, 2, 6, 6))
+    params = m.init(jax.random.PRNGKey(0), x)
+    loss = lambda p, x_: jnp.sum(m.apply(p, x_) ** 2)
+    grads = jax.grad(loss)(params, x)
+    assert grads["params"]["weight"].shape == (3, 2, 3, 3)
+    assert np.isfinite(np.asarray(grads["params"]["weight"])).all()
