@@ -1,0 +1,23 @@
+"""Distributed layer: mesh, low-precision collectives, APS, emulation.
+
+TPU-native replacement for reference CPDtorch/utils/dist_util.py (NCCL /
+torch.distributed) built on XLA collectives under shard_map/pjit."""
+
+from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
+                  aps_unscale)
+from .dist import (all_reduce_mean, broadcast_from, dist_init,
+                   make_sum_gradients_fn, replicate, sum_gradients)
+from .emulate import emulate_node_reduce
+from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR,
+                   data_parallel_mesh, make_mesh)
+from .reduction import (kahan_quantized_sum, ordered_quantized_sum,
+                        quantized_sum)
+
+__all__ = [
+    "aps_max_exponents", "aps_scale", "aps_shift_factors", "aps_unscale",
+    "all_reduce_mean", "broadcast_from", "dist_init", "make_sum_gradients_fn",
+    "replicate", "sum_gradients", "emulate_node_reduce",
+    "AXIS_DATA", "AXIS_EXPERT", "AXIS_PIPE", "AXIS_SEQ", "AXIS_TENSOR",
+    "data_parallel_mesh", "make_mesh",
+    "kahan_quantized_sum", "ordered_quantized_sum", "quantized_sum",
+]

@@ -1,0 +1,66 @@
+"""Cluster-size emulation ("emulate node") — N virtual nodes per real device.
+
+TPU-native re-implementation of the reference's `--emulate_node` mechanism
+(reference: example/ResNet18/tools/mix.py:224-285, example/ResNet50/
+main.py:156-202): each real process runs N micro-batches, buffers per-param
+gradients, then performs a *local* APS shift + quantize + ordered quantized
+accumulation — "as we use a single node to emulate multi-node, we should
+first accumulate gradients within a single node and then communicate them"
+(mix.py:275-277) — before the cross-process `sum_gradients`.
+
+Here the micro-batch loop is vectorized: the trainer computes per-micro-batch
+grads with `jax.vmap`/`lax.scan` (leaf shape ``(N, *shape)``) and this module
+reduces the leading axis with the same ordered primitives as the collectives,
+so emulated-node numerics are bit-identical to the reference's recipe.
+
+Faithful quirks preserved (mix.py:251-282):
+* N == 1 shortcut: the single grad is used as-is, NO quantization
+  (mix.py:254-256).
+* The quantize step runs even when APS is off (shift is just 0)
+  (mix.py:267-271: `shift_factor = 0 if not use_APS`, quantize regardless).
+* All-zero guard: max_exp == -100 sentinel → shift 0 (mix.py:267-268).
+* The local shift uses only the *local* micro-batch max — the global pmax
+  happens later inside `sum_gradients`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..quant.numerics import cast_to_format
+from .aps import aps_max_exponents, aps_shift_factors
+from .reduction import ordered_quantized_sum
+
+__all__ = ["emulate_node_reduce"]
+
+
+def _reduce_leaf(g: jnp.ndarray, n: int, use_aps: bool,
+                 grad_exp: int, grad_man: int) -> jnp.ndarray:
+    """Reduce one stacked leaf (N, *shape) -> (*shape,)."""
+    if n == 1:
+        return g[0]  # mix.py:254-256 — no quantization for a single grad
+    if use_aps:
+        max_exp = aps_max_exponents([g], n)
+        shift = aps_shift_factors(max_exp, grad_exp)[0]
+    else:
+        shift = jnp.float32(0.0)  # quantize still runs (mix.py:267-271)
+    scale = jnp.exp2(shift)
+    g = cast_to_format(g * scale, grad_exp, grad_man)
+    res = ordered_quantized_sum(g, grad_exp, grad_man)
+    return res / jnp.exp2(shift)  # true divide, as mix.py:280 does
+
+
+def emulate_node_reduce(stacked_grads: Any, emulate_node: int,
+                        use_aps: bool = False, grad_exp: int = 5,
+                        grad_man: int = 2) -> Any:
+    """Locally reduce N stacked micro-batch gradients per leaf.
+
+    stacked_grads: pytree with leaves shaped (emulate_node, *param_shape).
+    Returns the locally-accumulated gradient pytree (leaf shape
+    (*param_shape,)), ready for the cross-device `sum_gradients`."""
+    return jax.tree.map(
+        lambda g: _reduce_leaf(g, emulate_node, use_aps, grad_exp, grad_man),
+        stacked_grads)

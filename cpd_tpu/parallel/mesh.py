@@ -1,0 +1,68 @@
+"""Device-mesh construction for all parallelism axes.
+
+The reference's only parallelism is data parallelism over NCCL ranks
+(SURVEY.md §2); its "mesh" is implicit in the process group
+(dist_util.py:128).  TPU-natively the mesh is explicit and multi-axis:
+data (dp), tensor (tp), sequence/context (sp), pipeline (pp) and expert (ep)
+axes all live on one `jax.sharding.Mesh`, and shardings — not process ranks —
+decide which collectives XLA emits and whether they ride ICI or DCN.
+
+Axis order convention: ("dp", "pp", "sp", "tp", "ep")-major with dp
+outermost, so dp collectives (the gradient all-reduce) cross the slowest
+axis and tp collectives (per-layer all-gathers) stay on the innermost,
+fastest ICI ring.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "data_parallel_mesh", "AXIS_DATA", "AXIS_TENSOR",
+           "AXIS_SEQ", "AXIS_PIPE", "AXIS_EXPERT"]
+
+AXIS_DATA = "dp"
+AXIS_TENSOR = "tp"
+AXIS_SEQ = "sp"
+AXIS_PIPE = "pp"
+AXIS_EXPERT = "ep"
+
+_CANONICAL_ORDER = (AXIS_DATA, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT, AXIS_TENSOR)
+
+
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
+              ep: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh with the requested axis sizes (size-1 axes kept, so
+    PartitionSpecs can always name every axis).
+
+    If `dp` is 0, it absorbs all remaining devices (the common "shard batch
+    over whatever is left" case)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    fixed = tp * sp * pp * ep
+    if dp == 0:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by tp*sp*pp*ep={fixed}")
+        dp = n // fixed
+    total = dp * fixed
+    if total != n:
+        raise ValueError(
+            f"mesh axes dp={dp} pp={pp} sp={sp} ep={ep} tp={tp} need {total} "
+            f"devices, have {n}")
+    sizes = {AXIS_DATA: dp, AXIS_PIPE: pp, AXIS_SEQ: sp, AXIS_EXPERT: ep,
+             AXIS_TENSOR: tp}
+    shape = tuple(sizes[a] for a in _CANONICAL_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, _CANONICAL_ORDER)
+
+
+def data_parallel_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """Pure-DP mesh over all devices — the reference's implicit topology
+    (one NCCL rank per GPU, dist_util.py:126-128)."""
+    if devices is None:
+        devices = jax.devices()
+    return make_mesh(dp=len(devices), devices=devices)

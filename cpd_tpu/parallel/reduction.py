@@ -1,0 +1,82 @@
+"""Ordered low-precision reduction primitives (the emulation heart of L2).
+
+The reference's key trick (CPDtorch/utils/dist_util.py:54-89) is to emulate a
+low-precision all-reduce *deterministically*: gather full-precision values from
+every rank, then accumulate them **in rank order**, re-quantizing to eXmY after
+every addition (optionally Kahan-compensated, every intermediate quantized).
+That makes the reduction's numerics independent of the network's reduction
+tree — a property `psum` cannot give, since XLA's reduction order is opaque.
+
+Here the primitive operates on a *stacked* array ``(W, ...)`` so that exactly
+the same code runs in three contexts, bit-identically:
+
+1. real collectives: ``lax.all_gather`` inside ``shard_map`` → (W, ...);
+2. cluster emulation ("emulate node", reference mix.py:251-282): micro-batch
+   gradients stacked on a leading axis;
+3. unit tests on a single device.
+
+Everything is a `lax.scan` over the leading axis — sequential by construction,
+which is the point: order *is* the semantics being emulated.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..quant.numerics import cast_to_format
+
+__all__ = ["ordered_quantized_sum", "kahan_quantized_sum", "quantized_sum"]
+
+
+def ordered_quantized_sum(stacked: jnp.ndarray, exp: int, man: int) -> jnp.ndarray:
+    """res = 0; for g in stacked: res = quantize(res + g)   — in order.
+
+    Mirrors reference normal_sum_gradients' gather path
+    (dist_util.py:60-69): accumulation starts from zeros, and every partial
+    sum is re-cast to eXmY.  `stacked` has shape (W, *leaf_shape).
+    """
+    q = functools.partial(cast_to_format, exp_bits=exp, man_bits=man)
+
+    def step(res, g):
+        return q(res + g), None
+
+    res, _ = lax.scan(step, jnp.zeros_like(stacked[0]), stacked)
+    return res
+
+
+def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int) -> jnp.ndarray:
+    """Rank-ordered Kahan-compensated sum with every intermediate quantized.
+
+    Mirrors reference kahan_sum_gradients (dist_util.py:72-89):
+
+        y = q(g - c); t = q(res + y); c = q(q(t - res) - y); res = t
+    """
+    q = functools.partial(cast_to_format, exp_bits=exp, man_bits=man)
+
+    def step(carry, g):
+        res, c = carry
+        y = q(g - c)
+        t = q(res + y)
+        c = q(q(t - res) - y)
+        return (t, c), None
+
+    zero = jnp.zeros_like(stacked[0])
+    (res, _), _ = lax.scan(step, (zero, zero), stacked)
+    return res
+
+
+def quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
+                  use_kahan: bool = False) -> jnp.ndarray:
+    """Dispatch between the plain and Kahan ordered quantized sums.
+
+    The fp32 shortcut (exp==8, man==23 → plain sum) applies only to the
+    non-Kahan path, exactly as the reference does (dist_util.py:55-59 has the
+    shortcut; kahan_sum_gradients:72-89 does not)."""
+    if use_kahan:
+        return kahan_quantized_sum(stacked, exp, man)
+    if exp == 8 and man == 23:
+        return jnp.sum(stacked, axis=0)
+    return ordered_quantized_sum(stacked, exp, man)

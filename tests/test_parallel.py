@@ -1,0 +1,231 @@
+"""Tests for the distributed layer (cpd_tpu.parallel).
+
+Oracle strategy (SURVEY.md §4): NumPy transliterations of the reference's
+Python loops (dist_util.py:54-89, mix.py:251-282) checked bit-for-bit against
+the JAX implementations, on an 8-device virtual CPU platform (conftest.py) —
+the JAX analog of the reference's `--emulate_node` testing trick.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cpd_tpu.parallel import (aps_max_exponents, aps_shift_factors,
+                              data_parallel_mesh, emulate_node_reduce,
+                              kahan_quantized_sum, make_mesh,
+                              make_sum_gradients_fn, ordered_quantized_sum,
+                              replicate, sum_gradients)
+from cpd_tpu.quant import float_quantize
+
+W = 8  # conftest forces 8 virtual devices
+
+
+def np_quant(x, exp, man):
+    """Host-side quantize via the JAX cast (itself oracle-tested in
+    test_numerics.py against the CUDA transliteration)."""
+    return np.asarray(float_quantize(jnp.asarray(x, jnp.float32), exp, man))
+
+
+def oracle_normal_sum(grads, exp, man):
+    # dist_util.py:60-69
+    res = np.zeros_like(grads[0])
+    for g in grads:
+        res = np_quant(res + g, exp, man)
+    return res
+
+
+def oracle_kahan_sum(grads, exp, man):
+    # dist_util.py:72-89
+    res = np.zeros_like(grads[0])
+    c = np.zeros_like(grads[0])
+    for g in grads:
+        y = np_quant(g - c, exp, man)
+        t = np_quant(res + y, exp, man)
+        c = np_quant(np_quant(t - res, exp, man) - y, exp, man)
+        res = t
+    return res
+
+
+def rand_stack(shape, seed=0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(W, *shape) * scale).astype(np.float32)
+
+
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3), (5, 10), (8, 23)])
+def test_ordered_sum_matches_oracle(exp, man):
+    stacked = rand_stack((17, 5), seed=1)
+    got = np.asarray(ordered_quantized_sum(jnp.asarray(stacked), exp, man))
+    want = oracle_normal_sum(list(stacked), exp, man)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3), (8, 23)])
+def test_kahan_sum_matches_oracle(exp, man):
+    stacked = rand_stack((33,), seed=2)
+    got = np.asarray(kahan_quantized_sum(jnp.asarray(stacked), exp, man))
+    want = oracle_kahan_sum(list(stacked), exp, man)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_kahan_beats_plain_at_low_precision():
+    # The reason Kahan exists (README.md:10-11): compensated accumulation
+    # tracks the true sum better at e5m2.
+    stacked = rand_stack((1000,), seed=3, scale=0.1)
+    true = stacked.astype(np.float64).sum(0)
+    plain = np.asarray(ordered_quantized_sum(jnp.asarray(stacked), 5, 2))
+    kahan = np.asarray(kahan_quantized_sum(jnp.asarray(stacked), 5, 2))
+    assert (np.abs(kahan - true).mean() <= np.abs(plain - true).mean())
+
+
+def _shard_stacked(mesh, stacked_tree):
+    """Place leaves (W, ...) with leading axis on the dp mesh axis."""
+    return jax.tree.map(
+        lambda g: jax.device_put(
+            jnp.asarray(g), NamedSharding(mesh, P("dp"))), stacked_tree)
+
+
+@pytest.mark.parametrize("use_kahan", [False, True])
+@pytest.mark.parametrize("use_aps", [False, True])
+def test_sum_gradients_collective_matches_oracle(use_aps, use_kahan):
+    exp, man = 5, 2
+    mesh = data_parallel_mesh()
+    tree = {"w": rand_stack((9, 4), seed=4), "b": rand_stack((7,), seed=5)}
+
+    reduce_fn = make_sum_gradients_fn(mesh, axis_name="dp", use_aps=use_aps,
+                                      grad_exp=exp, grad_man=man,
+                                      use_kahan=use_kahan)
+    got = jax.tree.map(np.asarray, reduce_fn(_shard_stacked(mesh, tree)))
+
+    # Oracle: dist_util.py:22-51 literally.
+    def oracle(stacked):
+        grads = {k: list(v) for k, v in stacked.items()}
+        shifts = {}
+        if use_aps:
+            for k, gs in grads.items():
+                max_exp = max(
+                    np.ceil(np.log2(np.abs(g * np.float32(W)).max()))
+                    for g in gs)
+                shifts[k] = (2 ** (exp - 1) - 1) - max_exp
+                grads[k] = [np_quant(g * 2.0 ** shifts[k], exp, man)
+                            for g in gs]
+        fn = oracle_kahan_sum if use_kahan else oracle_normal_sum
+        out = {k: fn(gs, exp, man) for k, gs in grads.items()}
+        if use_aps:
+            out = {k: (v / np.float32(2.0 ** shifts[k])).astype(np.float32)
+                   for k, v in out.items()}
+        return out
+
+    want = oracle(tree)
+    for k in tree:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+
+def test_sum_gradients_fp32_is_plain_sum():
+    mesh = data_parallel_mesh()
+    tree = {"w": rand_stack((6, 3), seed=6)}
+    reduce_fn = make_sum_gradients_fn(mesh, axis_name="dp",
+                                      grad_exp=8, grad_man=23)
+    got = np.asarray(reduce_fn(_shard_stacked(mesh, tree))["w"])
+    np.testing.assert_allclose(got, tree["w"].sum(0), rtol=1e-6)
+
+
+def test_sum_gradients_fast_mode_precision():
+    # fast mode: quantize -> psum -> quantize.  Oracle: quantize each rank's
+    # grad, fp32 sum (psum's order variation is sub-ulp here), final cast.
+    mesh = data_parallel_mesh()
+    tree = {"w": rand_stack((32,), seed=7)}
+    fast = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=5, grad_man=2,
+                                 mode="fast")
+    a = np.asarray(fast(_shard_stacked(mesh, tree))["w"])
+    q_each = np.stack([np_quant(g, 5, 2) for g in tree["w"]])
+    want = np_quant(q_each.sum(0), 5, 2)
+    assert np.isfinite(a).all()
+    np.testing.assert_allclose(a, want, rtol=0.3, atol=1e-6)
+
+
+def test_aps_zero_grad_guard():
+    # All-zero leaf: reference sum_gradients would NaN (log2(0) = -inf,
+    # dist_util.py:27); we guard (shift=0) like the emulate path
+    # (mix.py:267-268).  Result must be zeros, not NaN.
+    mesh = data_parallel_mesh()
+    tree = {"z": np.zeros((W, 5), np.float32)}
+    reduce_fn = make_sum_gradients_fn(mesh, axis_name="dp", use_aps=True,
+                                      grad_exp=5, grad_man=2)
+    got = np.asarray(reduce_fn(_shard_stacked(mesh, tree))["z"])
+    np.testing.assert_array_equal(got, np.zeros(5, np.float32))
+
+
+def test_aps_improves_low_precision_sum():
+    # The paper's point: APS rescues *dynamic range*.  Gradients below
+    # e5m2's subnormal floor (2^-16) vanish in an unshifted quantized sum;
+    # the exponent shift moves them to the top of the representable range.
+    stacked = rand_stack((256,), seed=8, scale=1e-6)
+    true = stacked.astype(np.float64).sum(0)
+
+    plain = np.asarray(ordered_quantized_sum(jnp.asarray(stacked), 5, 2))
+
+    mesh = data_parallel_mesh()
+    aps = make_sum_gradients_fn(mesh, axis_name="dp", use_aps=True,
+                                grad_exp=5, grad_man=2)
+    got = np.asarray(aps(_shard_stacked(mesh, {"g": stacked}))["g"])
+    assert np.abs(got - true).mean() < np.abs(plain - true).mean()
+
+
+@pytest.mark.parametrize("use_aps", [False, True])
+def test_emulate_node_matches_oracle(use_aps):
+    # mix.py:251-282 literally.
+    exp, man, n = 5, 2, 4
+    rng = np.random.RandomState(9)
+    stacked = (rng.randn(n, 13) * 0.01).astype(np.float32)
+
+    got = np.asarray(emulate_node_reduce(
+        {"g": jnp.asarray(stacked)}, n, use_aps=use_aps,
+        grad_exp=exp, grad_man=man)["g"])
+
+    max_exp = np.ceil(np.log2(np.abs(stacked * np.float32(n)).max()))
+    shift = (2 ** (exp - 1) - 1) - max_exp if use_aps else 0.0
+    q = [np_quant(g * 2.0 ** shift, exp, man) for g in stacked]
+    res = np.zeros_like(q[0])
+    for g in q:
+        res = np_quant(res + g, exp, man)
+    want = (res / np.float32(2.0 ** shift)).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_emulate_node_one_is_identity():
+    g = rand_stack((5,), seed=10)[:1]
+    got = np.asarray(emulate_node_reduce({"g": jnp.asarray(g)}, 1,
+                                         use_aps=True, grad_exp=5,
+                                         grad_man=2)["g"])
+    np.testing.assert_array_equal(got, g[0])  # mix.py:254-256: no quantize
+
+
+def test_replicate_and_mesh_axes():
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    assert mesh.shape == {"dp": 2, "pp": 1, "sp": 2, "ep": 1, "tp": 2}
+    tree = {"w": np.ones((4, 4), np.float32)}
+    rep = replicate(tree, mesh)
+    assert rep["w"].sharding.is_fully_replicated
+
+    mesh0 = make_mesh(dp=0, tp=4)
+    assert mesh0.shape["dp"] == 2 and mesh0.shape["tp"] == 4
+
+
+def test_collective_matches_emulation_bit_exact():
+    # The design invariant: real collectives and emulate-node use the same
+    # ordered primitive, so an 8-rank collective reduction == an
+    # emulate_node=8 local reduction (sans APS-shift differences when both
+    # disabled).
+    exp, man = 4, 3
+    stacked = rand_stack((21,), seed=11)
+    mesh = data_parallel_mesh()
+    coll = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=exp,
+                                 grad_man=man)
+    a = np.asarray(coll(_shard_stacked(mesh, {"g": stacked}))["g"])
+    b = np.asarray(ordered_quantized_sum(jnp.asarray(stacked), exp, man))
+    np.testing.assert_array_equal(a, b)
