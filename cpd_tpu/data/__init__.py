@@ -1,0 +1,16 @@
+"""Data layer: datasets, vectorized augmentation, deterministic samplers."""
+
+from .augment import (CIFAR10_MEAN, CIFAR10_STD, Crop, Cutout, FlipLR,
+                      TransformPipeline, normalise, pad_reflect)
+from .cifar import CIFAR10Pipeline, load_cifar10, synthetic_cifar10
+from .samplers import (DistributedEpochSampler,
+                       DistributedGivenIterationSampler,
+                       GivenIterationSampler)
+
+__all__ = [
+    "CIFAR10_MEAN", "CIFAR10_STD", "Crop", "Cutout", "FlipLR",
+    "TransformPipeline", "normalise", "pad_reflect",
+    "CIFAR10Pipeline", "load_cifar10", "synthetic_cifar10",
+    "DistributedEpochSampler", "DistributedGivenIterationSampler",
+    "GivenIterationSampler",
+]

@@ -1,0 +1,120 @@
+"""Deterministic, iteration-based, resumable samplers.
+
+Parity with reference `example/ResNet18/utils/train_util.py`:
+  * DistributedGivenIterationSampler (train_util.py:159-222): generate
+    total_iter * batch_size indices by seed-0 shuffling the dataset repeated
+    ceil-many times, slice the whole schedule per rank, resume by skipping
+    `last_iter * batch_size`;
+  * DistributedSampler (train_util.py:225-265): epoch-seeded randperm,
+    padded to a multiple of world, strided per rank;
+  * GivenIterationSampler (train_util.py:110-156): the single-rank variant.
+
+These are numpy index generators (no torch dependency); the trainer feeds
+the indices to whatever array-backed dataset it holds.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+__all__ = ["GivenIterationSampler", "DistributedGivenIterationSampler",
+           "DistributedEpochSampler"]
+
+
+class GivenIterationSampler:
+    """Fixed-length schedule of total_iter*batch_size indices, seed-shuffled
+    (train_util.py:110-156).  Iterating yields single indices; `resume(it)`
+    skips the first `it` batches."""
+
+    def __init__(self, dataset_len: int, total_iter: int, batch_size: int,
+                 seed: int = 0, last_iter: int = -1):
+        self.dataset_len = dataset_len
+        self.total_iter = total_iter
+        self.batch_size = batch_size
+        self.seed = seed
+        self.last_iter = last_iter
+        self.indices = self._gen_indices()
+
+    def _gen_indices(self) -> np.ndarray:
+        total = self.total_iter * self.batch_size
+        repeats = -(-total // self.dataset_len)  # ceil
+        rng = np.random.RandomState(self.seed)
+        base = np.arange(self.dataset_len)
+        tiled = np.concatenate(
+            [base[rng.permutation(self.dataset_len)] for _ in range(repeats)])
+        return tiled[:total]
+
+    def __iter__(self) -> Iterator[int]:
+        start = (self.last_iter + 1) * self.batch_size
+        return iter(self.indices[start:])
+
+    def __len__(self) -> int:
+        return self.total_iter * self.batch_size
+
+    def batches(self) -> Iterator[np.ndarray]:
+        start = self.last_iter + 1
+        for it in range(start, self.total_iter):
+            lo = it * self.batch_size
+            yield self.indices[lo:lo + self.batch_size]
+
+
+class DistributedGivenIterationSampler(GivenIterationSampler):
+    """Per-rank slice of the global schedule (train_util.py:159-222).
+
+    The reference builds world*total*batch indices by seed-0 shuffling and
+    tiling, then takes the rank-th contiguous block (`beg = total_size//world
+    * rank`, train_util.py:212-215) — contiguous block, NOT strided."""
+
+    def __init__(self, dataset_len: int, total_iter: int, batch_size: int,
+                 world_size: int = 1, rank: int = 0, seed: int = 0,
+                 last_iter: int = -1):
+        self.world_size = world_size
+        self.rank = rank
+        super().__init__(dataset_len, total_iter, batch_size, seed, last_iter)
+
+    def _gen_indices(self) -> np.ndarray:
+        total = self.total_iter * self.batch_size * self.world_size
+        repeats = -(-total // self.dataset_len)
+        rng = np.random.RandomState(self.seed)  # seed 0 default, :200
+        base = np.arange(self.dataset_len)
+        tiled = np.concatenate(
+            [base[rng.permutation(self.dataset_len)] for _ in range(repeats)])
+        tiled = tiled[:total]
+        per_rank = self.total_iter * self.batch_size
+        return tiled[self.rank * per_rank:(self.rank + 1) * per_rank]
+
+
+class DistributedEpochSampler:
+    """Epoch-seeded shuffling sampler (train_util.py:225-265): randperm with
+    `seed = epoch`, padded to a multiple of world_size, strided per rank —
+    the torch DistributedSampler contract ResNet50 relies on
+    (main.py:111-120 + set_epoch at :222)."""
+
+    def __init__(self, dataset_len: int, world_size: int = 1, rank: int = 0,
+                 shuffle: bool = True):
+        self.dataset_len = dataset_len
+        self.world_size = world_size
+        self.rank = rank
+        self.shuffle = shuffle
+        self.epoch = 0
+        self.num_samples = -(-dataset_len // world_size)  # ceil
+        self.total_size = self.num_samples * world_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(self.dataset_len)
+        else:
+            indices = np.arange(self.dataset_len)
+        pad = self.total_size - len(indices)
+        if pad:
+            indices = np.concatenate([indices, indices[:pad]])
+        return iter(indices[self.rank:self.total_size:self.world_size])
+
+    def __len__(self) -> int:
+        return self.num_samples

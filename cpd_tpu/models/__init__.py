@@ -1,0 +1,33 @@
+"""Model zoo — parity with the reference's example models plus a registry.
+
+The reference selects models by dict key (`models['res_cifar']`,
+reference: example/ResNet18/tools/mix.py:82); `get_model(name)` is the same
+idea for all families.
+"""
+
+from .resnet_cifar import ResNetCIFAR, resnet18_cifar
+from .davidnet import DavidNet, davidnet
+from .resnet import ResNet, resnet18, resnet50, resnet101
+from .fcn import FCN, FCNHead, fcn_r50_d8
+
+_REGISTRY = {
+    "res_cifar": resnet18_cifar,      # reference name (mix.py:82)
+    "resnet18_cifar": resnet18_cifar,
+    "davidnet": davidnet,
+    "resnet18": resnet18,
+    "resnet50": resnet50,
+    "resnet101": resnet101,
+    "fcn_r50_d8": fcn_r50_d8,
+}
+
+
+def get_model(name: str, **kwargs):
+    """Instantiate a model by registry name."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+__all__ = ["ResNetCIFAR", "resnet18_cifar", "DavidNet", "davidnet",
+           "ResNet", "resnet18", "resnet50", "resnet101",
+           "FCN", "FCNHead", "fcn_r50_d8", "get_model"]

@@ -1,0 +1,96 @@
+"""DavidNet — the DAWNBench fast-CIFAR10 network.
+
+Capability parity with reference `example/DavidNet/davidnet.py`: prep
+conv-bn-relu at 64ch, three stages at 128/256/512 each = conv-bn-relu +
+2x2 max-pool, residual (two conv-bn-relu) on layers 1 and 3, classifier =
+4x4 max-pool -> flatten -> 512->10 linear (no bias) -> x0.125 logit scale
+(davidnet.py:19-62).
+
+The reference expresses this as a nested-dict dataflow graph executed
+topologically by `TorchGraph` (utils.py:258-292); SURVEY.md §7.6 notes the
+dict-graph executor is incidental, not a capability — here it is a plain
+Flax module, which XLA fuses better anyway.  BatchNorm weight-init and the
+fixed 0.125 logit multiplier are preserved (davidnet.py:20,33).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Mapping
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["DavidNet", "davidnet"]
+
+
+class ConvBN(nn.Module):
+    """conv3x3(no bias) + BN(+optional weight init) + ReLU (davidnet.py:19-24)."""
+    channels: int
+    bn_weight_init: float = 1.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    kernel_init=nn.initializers.kaiming_normal())(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype,
+                         param_dtype=self.param_dtype,
+                         scale_init=nn.initializers.constant(
+                             self.bn_weight_init))(x)
+        return nn.relu(x)
+
+
+class Residual(nn.Module):
+    """x + conv_bn(conv_bn(x)) (davidnet.py:27-33)."""
+    channels: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cb = partial(ConvBN, self.channels, dtype=self.dtype,
+                     param_dtype=self.param_dtype)
+        y = cb(name="res1")(x, train=train)
+        y = cb(name="res2")(y, train=train)
+        return x + y
+
+
+class DavidNet(nn.Module):
+    """Input NHWC (B, 32, 32, 3); returns scaled logits (B, 10)."""
+    num_classes: int = 10
+    channels: Mapping[str, int] = None
+    logit_weight: float = 0.125
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        ch = self.channels or {"prep": 64, "layer1": 128, "layer2": 256,
+                               "layer3": 512}
+        cb = partial(ConvBN, dtype=self.dtype, param_dtype=self.param_dtype)
+        pool = partial(nn.max_pool, window_shape=(2, 2), strides=(2, 2))
+
+        x = cb(ch["prep"], name="prep")(x, train=train)
+        x = pool(cb(ch["layer1"], name="layer1")(x, train=train))
+        x = Residual(ch["layer1"], dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     name="layer1_residual")(x, train=train)
+        x = pool(cb(ch["layer2"], name="layer2")(x, train=train))
+        x = pool(cb(ch["layer3"], name="layer3")(x, train=train))
+        x = Residual(ch["layer3"], dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     name="layer3_residual")(x, train=train)
+
+        x = nn.max_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(self.num_classes, use_bias=False, dtype=jnp.float32,
+                     param_dtype=self.param_dtype, name="linear")(x)
+        return (x * self.logit_weight).astype(jnp.float32)
+
+
+def davidnet(dtype=jnp.float32) -> DavidNet:
+    return DavidNet(dtype=dtype)

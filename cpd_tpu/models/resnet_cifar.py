@@ -1,0 +1,96 @@
+"""ResNet18 for 32x32 CIFAR — the reference's flagship model.
+
+Capability parity with reference `example/ResNet18/models/resnet18_cifar.py`
+(architecture: 3x3 stem without max-pool, 4 stages of 2 BasicBlocks at
+64/128/256/512 channels, strides 1/2/2/2, 4x4 avg-pool, 512->num_classes fc
+head — resnet18_cifar.py:48-87), re-designed TPU-first:
+
+* NHWC layout (TPU-native; the reference is NCHW because cuDNN prefers it).
+* Separate `param_dtype` (fp32 master weights) and `dtype` (bf16 compute) so
+  the MXU runs bf16 matmuls/convs while the optimizer sees fp32 — subsuming
+  the reference's manual master-weight copies (mix.py:53-63).
+* BatchNorm carries running stats in the `batch_stats` collection; scale
+  init 1, bias 0, momentum 0.9, eps 1e-5 (torch defaults the reference
+  inherits via nn.BatchNorm2d).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNetCIFAR", "resnet18_cifar"]
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity/projection shortcut (resnet18_cifar.py:7-45)."""
+    channels: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype,
+                       kernel_init=nn.initializers.kaiming_normal())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+
+        y = conv(self.channels, (3, 3), strides=(self.stride, self.stride),
+                 padding=1, name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.channels, (3, 3), padding=1, name="conv2")(y)
+        y = norm(name="bn2")(y)
+
+        if self.stride != 1 or x.shape[-1] != self.channels:
+            x = conv(self.channels, (1, 1),
+                     strides=(self.stride, self.stride),
+                     name="shortcut_conv")(x)
+            x = norm(name="shortcut_bn")(x)
+        return nn.relu(y + x)
+
+
+class ResNetCIFAR(nn.Module):
+    """CIFAR-sized ResNet (resnet18_cifar.py:48-87). Input NHWC (B,32,32,3)."""
+    num_classes: int = 10
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    stage_channels: Sequence[int] = (64, 128, 256, 512)
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    kernel_init=nn.initializers.kaiming_normal(),
+                    name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+
+        for stage, (blocks, channels) in enumerate(
+                zip(self.stage_sizes, self.stage_channels)):
+            for block in range(blocks):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(channels, stride, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               name=f"layer{stage + 1}_block{block}")(
+                                   x, train=train)
+
+        # 4x4 avg-pool on the 4x4 feature map == global mean (mix ref :81).
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=self.param_dtype, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18_cifar(num_classes: int = 10, dtype=jnp.float32) -> ResNetCIFAR:
+    """Factory matching reference `models['res_cifar']` (mix.py:82-84)."""
+    return ResNetCIFAR(num_classes=num_classes, dtype=dtype)

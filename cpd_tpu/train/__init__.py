@@ -1,0 +1,27 @@
+"""Training harness: state, steps, optimizers, schedules, metrics, ckpt."""
+
+from .state import TrainState, create_train_state
+from .step import cross_entropy_loss, make_eval_step, make_train_step
+from .optim import lars, make_optimizer, sgd
+from .schedules import iter_table, piecewise_linear, warmup_step_decay
+from .metrics import AverageMeter, Timer, accuracy
+
+__all__ = [
+    "TrainState", "create_train_state",
+    "cross_entropy_loss", "make_eval_step", "make_train_step",
+    "lars", "make_optimizer", "sgd",
+    "iter_table", "piecewise_linear", "warmup_step_decay",
+    "AverageMeter", "Timer", "accuracy",
+    "CheckpointManager", "save_checkpoint", "restore_latest",
+]
+
+_CHECKPOINT_NAMES = {"CheckpointManager", "save_checkpoint", "restore_latest"}
+
+
+def __getattr__(name):
+    # Checkpoint exports resolve lazily so importing cpd_tpu.train does not
+    # pay the orbax import cost unless checkpointing is actually used.
+    if name in _CHECKPOINT_NAMES:
+        from . import checkpoint
+        return getattr(checkpoint, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

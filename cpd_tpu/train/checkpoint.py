@@ -1,0 +1,97 @@
+"""Checkpoint / auto-resume — orbax over the whole TrainState pytree.
+
+Parity with the reference's three ad-hoc schemes (SURVEY.md §5):
+  * ResNet18: rank-0 `save_checkpoint` of state_dict + optimizer + step +
+    best_prec1, with a `_best` copy (mix.py:345-356, train_util.py:268-271);
+  * ResNet50: per-epoch `checkpoint-{E}.pth.tar` + auto-resume by scanning
+    for the latest file (main.py:70-75,134-138,261-269);
+  * `load_state`'s `module.`-prefix surgery (train_util.py:274-318)
+    disappears — a pytree has no wrapper prefixes.
+
+Here: one CheckpointManager per run directory, step-indexed, keep-N,
+`best_fn`-tracked best, and `restore_latest` as the auto-resume.  Works for
+any TrainState (params/batch_stats/opt_state/step) because it's all one
+pytree.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+__all__ = ["CheckpointManager", "save_checkpoint", "restore_latest"]
+
+
+class CheckpointManager:
+    """Thin orbax wrapper with the reference's retention semantics."""
+
+    def __init__(self, directory: str, max_to_keep: int = 5,
+                 track_best: bool = True):
+        directory = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            best_fn=(lambda m: m.get("best_metric", 0.0)) if track_best
+            else None,
+            best_mode="max" if track_best else None,
+        )
+        self._mgr = ocp.CheckpointManager(directory, options=options)
+
+    def save(self, step: int, state: TrainState,
+             best_metric: Optional[float] = None, force: bool = False):
+        """Save at `step`; only the process-0 host writes (orbax handles
+        multi-host coordination — the reference gates on rank==0 manually,
+        mix.py:345)."""
+        metrics = ({"best_metric": float(best_metric)}
+                   if best_metric is not None else None)
+        self._mgr.save(step, args=ocp.args.StandardSave(state),
+                       metrics=metrics, force=force)
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: TrainState,
+                step: Optional[int] = None) -> Optional[TrainState]:
+        """Restore `step` (default latest) shaped like `state_template`;
+        None if no checkpoint exists — the auto-resume scan of
+        main.py:70-75."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                                state_template)
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(abstract))
+
+    def close(self):
+        self._mgr.close()
+
+
+def save_checkpoint(directory: str, step: int, state: TrainState,
+                    best_metric: Optional[float] = None):
+    """One-shot save (train_util.py:268-271 equivalent)."""
+    mgr = CheckpointManager(directory, track_best=best_metric is not None)
+    mgr.save(step, state, best_metric=best_metric, force=True)
+    mgr.wait()
+    mgr.close()
+
+
+def restore_latest(directory: str,
+                   state_template: TrainState) -> Optional[TrainState]:
+    """Auto-resume from the newest checkpoint in `directory`, or None."""
+    if not os.path.isdir(directory):
+        return None
+    mgr = CheckpointManager(directory, track_best=False)
+    try:
+        return mgr.restore(state_template)
+    finally:
+        mgr.close()

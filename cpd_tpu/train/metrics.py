@@ -1,0 +1,80 @@
+"""Host-side metric plumbing: meters, top-k accuracy, timers.
+
+Parity with the reference's harness utilities (duplicated there across
+example/ResNet18/utils/train_util.py and example/DavidNet/utils.py;
+SURVEY.md C21 — one copy here):
+  * AverageMeter with a sliding window (train_util.py:21-48)
+  * accuracy(output, target, topk) (train_util.py:51-65)
+  * Timer (DavidNet/utils.py:28-38)
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["AverageMeter", "accuracy", "Timer"]
+
+
+class AverageMeter:
+    """Tracks current value, windowed average and global average.
+
+    length > 0 → sliding window of that many updates (the reference stores a
+    history list and averages the tail, train_util.py:27-41); length == 0 →
+    running sum/count average (train_util.py:33,43-48)."""
+
+    def __init__(self, length: int = 0):
+        self.length = length
+        self.reset()
+
+    def reset(self):
+        self.history = deque(maxlen=self.length or None)
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.avg = 0.0
+
+    def update(self, val: float, n: int = 1):
+        val = float(val)
+        self.val = val
+        if self.length > 0:
+            self.history.append(val)
+            self.avg = sum(self.history) / len(self.history)
+        else:
+            self.sum += val * n
+            self.count += n
+            self.avg = self.sum / max(self.count, 1)
+
+
+def accuracy(output, target, topk: Sequence[int] = (1,)):
+    """Top-k precision over a batch, as percentages (train_util.py:51-65).
+
+    output: (B, C) logits/scores; target: (B,) int labels.  Returns one
+    float per k."""
+    output = np.asarray(output)
+    target = np.asarray(target)
+    maxk = max(topk)
+    pred = np.argsort(-output, axis=1)[:, :maxk]          # (B, maxk)
+    correct = pred == target[:, None]
+    batch = target.shape[0]
+    return [100.0 * correct[:, :k].any(axis=1).sum() / batch for k in topk]
+
+
+class Timer:
+    """Incremental wall-clock timer (DavidNet/utils.py:28-38): each call
+
+    returns the time since the previous call and accumulates total time."""
+
+    def __init__(self):
+        self.times = [time.perf_counter()]
+        self.total_time = 0.0
+
+    def __call__(self, include_in_total: bool = True) -> float:
+        self.times.append(time.perf_counter())
+        delta = self.times[-1] - self.times[-2]
+        if include_in_total:
+            self.total_time += delta
+        return delta

@@ -1,0 +1,131 @@
+"""Optimizers with torch-faithful semantics: SGD(+Nesterov) and LARS.
+
+The reference uses torch.optim.SGD over fp32 master params
+(example/ResNet18/tools/mix.py:94-96, example/DavidNet/dawn.py:73-79,
+example/ResNet50/main.py:123-131) and a hand-written LARS update
+(mix.py:297-310).  optax's built-in `sgd` scales the momentum buffer
+differently from torch (torch accumulates raw grads in the buffer and
+multiplies by lr at apply time; optax's trace folds lr in), which changes
+trajectories when lr varies per step — so `sgd` here reproduces torch's
+update rule exactly:
+
+    buf   = momentum * buf + (g + wd * w)                 # torch sgd
+    step  = g + momentum * buf  (nesterov)  |  buf
+    w    -= lr * step
+
+and `lars` reproduces mix.py:297-310 exactly:
+
+    local_lr = ||w|| / (||g|| + wd * ||w||) * 0.001
+    buf      = momentum * buf + lr * local_lr * (g + wd * w)
+    w       -= buf
+
+Both take a `Schedule` (step -> lr) so the whole update stays inside jit.
+Master-weight handling (mix.py:53-63,292-294,313-314) is structural here:
+params are always fp32; bf16 is a compute dtype inside the model, so the
+"master copy" is just the params pytree itself.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["sgd", "lars", "make_optimizer"]
+
+
+class TorchSGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buf: optax.Updates
+
+
+def sgd(schedule: Callable, momentum: float = 0.9, weight_decay: float = 0.0,
+        nesterov: bool = False,
+        wd_mask: Optional[Callable] = None) -> optax.GradientTransformation:
+    """torch.optim.SGD-semantics transformation.
+
+    `wd_mask(params)` -> pytree of bools selecting which leaves get weight
+    decay — the BN-params-without-wd grouping of main.py:123-131.
+    Returned updates are the *negative* delta (optax convention:
+    new_p = p + update)."""
+
+    def init(params):
+        return TorchSGDState(jnp.zeros([], jnp.int32),
+                             jax.tree.map(jnp.zeros_like, params))
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("sgd requires params")
+        lr = schedule(state.step)
+        mask = (wd_mask(params) if wd_mask is not None
+                else jax.tree.map(lambda _: True, params))
+
+        def one(g, w, buf, use_wd):
+            d = g + (weight_decay * w if (weight_decay and use_wd) else 0.0)
+            new_buf = momentum * buf + d
+            step_dir = d + momentum * new_buf if nesterov else new_buf
+            return -lr * step_dir, new_buf
+
+        flat = jax.tree.map(one, grads, params, state.momentum_buf, mask)
+        updates = jax.tree.map(lambda t: t[0], flat,
+                               is_leaf=lambda t: isinstance(t, tuple))
+        bufs = jax.tree.map(lambda t: t[1], flat,
+                            is_leaf=lambda t: isinstance(t, tuple))
+        return updates, TorchSGDState(state.step + 1, bufs)
+
+    return optax.GradientTransformation(init, update)
+
+
+def lars(schedule: Callable, momentum: float = 0.9,
+         weight_decay: float = 0.0, coefficient: float = 0.001,
+         ) -> optax.GradientTransformation:
+    """The reference's manual LARS (mix.py:297-310), exactly — including its
+    quirks: trust ratio computed on the *un-decayed* gradient norm, the fixed
+    0.001 coefficient, and lr folded into the momentum buffer (unlike torch
+    SGD).  Zero-norm params fall back to local_lr = coefficient·0 = 0 guard
+    via the epsilon-free reference formula (||g||+wd·||w|| in the
+    denominator; all-zero grads give local_lr = 1/wd... matching reference
+    float math)."""
+
+    def init(params):
+        return TorchSGDState(jnp.zeros([], jnp.int32),
+                             jax.tree.map(jnp.zeros_like, params))
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("lars requires params")
+        lr = schedule(state.step)
+
+        def one(g, w, buf):
+            w_norm = jnp.linalg.norm(w.reshape(-1))
+            g_norm = jnp.linalg.norm(g.reshape(-1))
+            local_lr = w_norm / (g_norm + weight_decay * w_norm) * coefficient
+            new_buf = momentum * buf + lr * local_lr * (g + weight_decay * w)
+            return -new_buf, new_buf
+
+        flat = jax.tree.map(one, grads, params, state.momentum_buf)
+        updates = jax.tree.map(lambda t: t[0], flat,
+                               is_leaf=lambda t: isinstance(t, tuple))
+        bufs = jax.tree.map(lambda t: t[1], flat,
+                            is_leaf=lambda t: isinstance(t, tuple))
+        return updates, TorchSGDState(state.step + 1, bufs)
+
+    return optax.GradientTransformation(init, update)
+
+
+def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,
+                   weight_decay: float = 0.0, nesterov: bool = False,
+                   wd_mask: Optional[Callable] = None,
+                   ) -> optax.GradientTransformation:
+    """Registry used by trainer configs: 'sgd' | 'nesterov' | 'lars'."""
+    if name == "sgd":
+        return sgd(schedule, momentum, weight_decay, nesterov=nesterov,
+                   wd_mask=wd_mask)
+    if name == "nesterov":
+        return sgd(schedule, momentum, weight_decay, nesterov=True,
+                   wd_mask=wd_mask)
+    if name == "lars":
+        return lars(schedule, momentum, weight_decay)
+    raise ValueError(f"unknown optimizer {name!r}")

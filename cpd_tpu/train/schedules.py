@@ -1,0 +1,88 @@
+"""LR schedules — the reference's three schedules as pure step->lr functions.
+
+All schedules are `Callable[[step], float32]`, jit-traceable, usable both
+with optax (inject_hyperparams) and with the manual LARS update.
+
+Parity map (reference → here):
+  * `adjust_learning_rate` warmup→1.6, /10 at epochs 40/80
+    (example/ResNet18/tools/mix.py:181-198)        → `warmup_step_decay`
+  * `PiecewiseLinear([0,5,24],[0,0.4,0])`
+    (example/DavidNet/dawn.py:65)                  → `piecewise_linear`
+  * ResNet50 5-epoch warmup to 3.2, /10 at 30/60/80
+    (example/ResNet50/main.py:237-252)             → `warmup_step_decay`
+  * `IterLRScheduler` (explicit iteration->lr table,
+    ResNet18/utils/train_util.py:68-107)           → `iter_table`
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["warmup_step_decay", "piecewise_linear", "iter_table",
+           "Schedule"]
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def warmup_step_decay(base_lr: float, warmup_iters: int,
+                      decay_iters: Sequence[int], warmup_from: float = 0.1,
+                      decay_factor: float = 0.1) -> Schedule:
+    """Linear warmup from `warmup_from` to `base_lr` over `warmup_iters`,
+    then multiply by `decay_factor` after each boundary in `decay_iters`.
+
+    With base_lr=1.6, warmup=5 epochs, boundaries at 40/80 epochs this is
+    exactly mix.py:181-198 (which starts warmup at 0.1, not 0); ResNet50's
+    schedule (main.py:237-252) is the same shape with base 3.2, warmup_from
+    equal to base/warmup_epochs increments, boundaries 30/60/80.
+    """
+    boundaries = jnp.asarray(list(decay_iters), jnp.float32)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = warmup_from + (base_lr - warmup_from) * (step / max(warmup_iters, 1))
+        decays = jnp.sum(step > boundaries)
+        decayed = base_lr * decay_factor ** decays
+        return jnp.where(step <= warmup_iters, warm, decayed)
+
+    return schedule
+
+
+def piecewise_linear(knot_steps: Sequence[float],
+                     knot_values: Sequence[float]) -> Schedule:
+    """Linear interpolation through (step, value) knots, clamped at the ends
+    — reference PiecewiseLinear (DavidNet/utils.py: np.interp over epochs,
+    dawn.py:65 uses knots [0, 5, 24] -> [0, 0.4, 0])."""
+    xs = jnp.asarray(list(knot_steps), jnp.float32)
+    ys = jnp.asarray(list(knot_values), jnp.float32)
+
+    def schedule(step):
+        return jnp.interp(jnp.asarray(step, jnp.float32), xs, ys)
+
+    return schedule
+
+
+def iter_table(lr_steps: Sequence[int], lr_mults: Sequence[float],
+               base_lr: float, warmup_steps: int = 0,
+               warmup_lr: float = 0.0) -> Schedule:
+    """Explicit iteration->multiplier table with optional linear warmup —
+    reference IterLRScheduler (train_util.py:68-107): at each step in
+    `lr_steps` the lr is multiplied by the matching entry of `lr_mults`;
+    warmup interpolates warmup_lr -> base_lr over `warmup_steps`."""
+    if len(lr_steps) != len(lr_mults):
+        raise ValueError("lr_steps and lr_mults must have equal length")
+    steps = jnp.asarray(list(lr_steps), jnp.float32)
+    cum = jnp.cumprod(jnp.asarray(list(lr_mults), jnp.float32))
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        idx = jnp.sum(step >= steps).astype(jnp.int32)
+        mult = jnp.where(idx == 0, 1.0, cum[jnp.maximum(idx - 1, 0)])
+        lr = base_lr * mult
+        if warmup_steps > 0:
+            warm = warmup_lr + (base_lr - warmup_lr) * (step / warmup_steps)
+            lr = jnp.where(step < warmup_steps, warm, lr)
+        return lr
+
+    return schedule

@@ -1,0 +1,44 @@
+"""TrainState — one pytree holding everything a training run mutates.
+
+Replaces the reference's scattered mutable state: model params + BN running
+stats (torch module buffers), fp32 master copies (mix.py:53-63 — structural
+here: params ARE fp32, bf16 is a compute dtype), optimizer state
+(torch SGD momentum buffers / mix.py's manual `momentum_buffer` list), and
+the step counter (mix.py's `curr_step`).  Being a pytree, the whole thing
+shards/checkpoints/donates as a unit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["TrainState", "create_train_state"]
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray               # scalar int32
+    params: Any                     # fp32 master weights
+    batch_stats: Any                # BN running stats ({} for stat-less models)
+    opt_state: Any
+
+
+def create_train_state(model, tx: optax.GradientTransformation,
+                       sample_input: jnp.ndarray, rng: jax.Array,
+                       train: bool = True) -> TrainState:
+    """Initialize params/stats with a sample batch and build optimizer state.
+
+    Equivalent of the reference's model construction + broadcast + master
+    prep + optimizer construction block (mix.py:82-103); the rank-0
+    broadcast (mix.py:86-88) happens when the caller `replicate()`s the
+    returned state onto a mesh."""
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(step=jnp.zeros([], jnp.int32), params=params,
+                      batch_stats=batch_stats, opt_state=tx.init(params))

@@ -1,0 +1,172 @@
+"""The shared train/eval step — one traced graph per configuration.
+
+This replaces the reference's three copy-pasted training loops
+(example/ResNet18/tools/mix.py:224-356, example/DavidNet/utils.py:328-344,
+example/ResNet50/main.py:141-212).  Where the reference's step is a Python
+loop issuing one CUDA kernel / NCCL op per parameter per micro-batch
+(SURVEY.md §3.1 "kernel-launch storm"), here the WHOLE step — micro-batch
+scan, local emulated-node reduction, APS, the quantized cross-device
+all-reduce, and the optimizer — is a single jitted shard_map program, so XLA
+fuses the quantize math into the surrounding elementwise work and schedules
+the ICI collectives back-to-back.
+
+Semantics preserved from the reference step (mix.py:224-314):
+  * loss divided by world*emulate_node so the distributed SUM equals the
+    mean (mix.py:239);
+  * optional loss scaling, multiplied into the loss before grad and NOT
+    unscaled before the step — faithful to DavidNet/utils.py:332-334, which
+    never unscales (default scale 1.0 makes it a no-op);
+  * micro-batches run sequentially (lax.scan), so BN running stats update
+    in the same order as the reference's sequential sub-batch loop;
+  * the reported loss is the cross-rank all-reduced copy (mix.py:240-242).
+
+Deviation (documented): BN running stats are cross-replica pmean'd at the
+end of the step.  The reference keeps per-rank stats and checkpoints
+rank-0's (train_util.py:268-271); with jit+shard_map, replicated outputs
+must be bitwise-replicated, and averaging is strictly more principled than
+"whatever rank 0 saw".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.dist import sum_gradients
+from ..parallel.emulate import emulate_node_reduce
+from .state import TrainState
+
+__all__ = ["cross_entropy_loss", "make_train_step", "make_eval_step"]
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy with integer labels (the criterion of all
+    three reference trainers, e.g. mix.py:104)."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
+                    *, axis_name: str = "dp", emulate_node: int = 1,
+                    use_aps: bool = False, grad_exp: int = 8,
+                    grad_man: int = 23, use_kahan: bool = False,
+                    mode: str = "faithful", loss_scale: float = 1.0,
+                    loss_fn: Callable = cross_entropy_loss,
+                    donate: bool = True):
+    """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
+
+    images: (global_batch * emulate_node, H, W, C) sharded over `axis_name`;
+    each device's local slice is split into `emulate_node` sequential
+    micro-batches (the reference's virtual-node emulation, mix.py:224-285).
+    Returned metrics: {'loss': all-reduced mean loss, 'accuracy': top-1 over
+    the global batch, 'lr'-free — schedule owns lr}.
+    """
+    has_stats_cache: dict = {}
+
+    def local_micro_grads(params, batch_stats, images, labels, world):
+        """Sequential scan over micro-batches -> stacked grads (N, ...)."""
+        n = emulate_node
+        mb = images.shape[0] // n
+        images = images.reshape(n, mb, *images.shape[1:])
+        labels = labels.reshape(n, mb, *labels.shape[1:])
+
+        def loss_of(p, stats, x, y):
+            variables = {"params": p}
+            has_stats = bool(jax.tree.leaves(stats))
+            if has_stats:
+                variables["batch_stats"] = stats
+                logits, mut = model.apply(variables, x, train=True,
+                                          mutable=["batch_stats"])
+                new_stats = mut["batch_stats"]
+            else:
+                logits = model.apply(variables, x, train=True)
+                new_stats = stats
+            loss = loss_fn(logits, y) / (world * n)          # mix.py:239
+            return loss * loss_scale, (logits, new_stats, loss)
+
+        def micro(carry, xy):
+            stats = carry
+            x, y = xy
+            (_, (logits, new_stats, loss)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, stats, x, y)
+            correct = jnp.sum(jnp.argmax(logits, -1) == y)
+            return new_stats, (grads, loss, correct)
+
+        final_stats, (stacked_grads, losses, corrects) = lax.scan(
+            micro, batch_stats, (images, labels))
+        return stacked_grads, final_stats, losses.sum(), corrects.sum()
+
+    def step_fn(state: TrainState, images, labels):
+        world = lax.psum(jnp.float32(1.0), axis_name)
+        stacked, new_stats, loss, correct = local_micro_grads(
+            state.params, state.batch_stats, images, labels, world)
+
+        # Local emulated-node reduction (mix.py:251-282), then the
+        # cross-device low-precision all-reduce (mix.py:286-291).
+        local = emulate_node_reduce(stacked, emulate_node, use_aps,
+                                    grad_exp, grad_man)
+        reduced = sum_gradients(local, axis_name, use_aps=use_aps,
+                                grad_exp=grad_exp, grad_man=grad_man,
+                                use_kahan=use_kahan, mode=mode)
+
+        updates, new_opt = tx.update(reduced, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_stats = jax.tree.map(lambda s: lax.pmean(s, axis_name), new_stats)
+
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               batch_stats=new_stats, opt_state=new_opt)
+        metrics = {
+            # loss is the per-rank sum of micro losses (already /world/n);
+            # psum across ranks gives the global mean (mix.py:240-242).
+            "loss": lax.psum(loss, axis_name) / loss_scale,
+            "accuracy": lax.psum(correct.astype(jnp.float32), axis_name)
+                        / lax.psum(jnp.float32(labels.shape[0]), axis_name),
+        }
+        return new_state, metrics
+
+    state_spec = P()            # replicated
+    data_spec = P(axis_name)    # batch-sharded
+    shard_fn = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(state_spec, data_spec, data_spec),
+        out_specs=(state_spec, state_spec),
+        check_vma=False)
+    return jax.jit(shard_fn, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(model, mesh: Mesh, *, axis_name: str = "dp",
+                   loss_fn: Callable = cross_entropy_loss):
+    """Jitted ``(state, images, labels) -> metrics`` (validate() parity,
+    mix.py:359-425: all-reduced loss sum + top-1/top-5 counts)."""
+
+    def step_fn(state: TrainState, images, labels):
+        variables = {"params": state.params}
+        if jax.tree.leaves(state.batch_stats):
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, images, train=False)
+        loss = loss_fn(logits, labels)
+        top1 = jnp.sum(jnp.argmax(logits, -1) == labels)
+        k = min(5, logits.shape[-1])
+        topk = jnp.sum(jnp.any(
+            lax.top_k(logits, k)[1] == labels[:, None], axis=-1))
+        n = jnp.float32(labels.shape[0])
+        return {
+            "loss": lax.psum(loss * n, axis_name) / lax.psum(n, axis_name),
+            "top1": lax.psum(top1.astype(jnp.float32), axis_name)
+                    / lax.psum(n, axis_name),
+            "top5": lax.psum(topk.astype(jnp.float32), axis_name)
+                    / lax.psum(n, axis_name),
+        }
+
+    shard_fn = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(shard_fn)
