@@ -1,0 +1,72 @@
+"""Headline benchmark: ResNet-50/ImageNet-shape training throughput per chip.
+
+The reference's only quantitative scale claim is ResNet-50/ImageNet, 90
+epochs in ">30 hours" on 8x V100 — an implied upper bound of ~133 img/s/chip
+(BASELINE.md; reference README.md:118).  This bench measures the same
+workload shape on one TPU chip: full training step (fwd+bwd+optimizer) of
+ResNet-50 at 224x224, batch 32/chip (main.py:32-33), bf16 compute / fp32
+master params, with the e5m2 APS gradient pipeline engaged exactly as the
+reference's flagship config runs it (--use_APS --grad_exp 5 --grad_man 2).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 133.0  # derived in BASELINE.md / SURVEY.md §6
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.models import resnet50
+    from cpd_tpu.parallel.mesh import make_mesh
+    from cpd_tpu.train import (create_train_state, make_optimizer,
+                               make_train_step, warmup_step_decay)
+
+    batch = 32
+    n_dev = len(jax.devices())
+    mesh = make_mesh(dp=n_dev)
+
+    model = resnet50(dtype=jnp.bfloat16)
+    schedule = warmup_step_decay(3.2, 500, [3000, 6000])  # main.py:237-252 shape
+    tx = make_optimizer("sgd", schedule, momentum=0.9, weight_decay=1e-4)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch * n_dev, 224, 224, 3).astype(np.float32),
+                    jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, batch * n_dev).astype(np.int32))
+
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, use_aps=True, grad_exp=5,
+                           grad_man=2, mode="faithful", donate=True)
+
+    # warmup/compile
+    state, metrics = step(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec_per_chip = batch * n_dev * iters / dt / n_dev
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_per_chip",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_per_sec_per_chip
+                             / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Native kernel layer (Pallas/Mosaic) — the TPU analog of the reference's
+CUDA extension (CPDtorch/quant/quant_cuda/).  See also quant/ for the XLA
+implementations these are bit-identical to."""
+
+from .quantize import quantize_pallas
+from .qgemm import qgemm_pallas
+
+__all__ = ["quantize_pallas", "qgemm_pallas"]

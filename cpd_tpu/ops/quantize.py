@@ -1,0 +1,72 @@
+"""Pallas elementwise eXmY quantize kernel — the native analog of the
+reference's CUDA quantize kernel.
+
+Reference: `float_kernel_nearest` launches one CUDA thread per element
+(CPDtorch/quant/quant_cuda/float_kernel.cu:94-101, quant.cu:14-25).  The
+TPU-native shape of the same op is a VPU kernel over (8,128)-tiled VMEM
+blocks: each grid step streams one block HBM->VMEM, applies the bit-exact
+cast body (quant/numerics.py `cast_body` — shared with the XLA path, so the
+kernel *is* the oracle) and streams it back.  Unlike the CUDA kernel this is
+pure: no in-place mutation (quant.cu:22-23's aliasing trap disappears).
+
+XLA already fuses `cast_to_format` into surrounding elementwise work, so the
+kernel's value is (a) demonstrating the native path end-to-end, (b) avoiding
+fusion-boundary materialization for very large standalone quantize calls,
+and (c) being the template the quantized-GEMM kernel builds on.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..quant.numerics import _validate, cast_body
+
+__all__ = ["quantize_pallas"]
+
+_LANES = 128
+_BLOCK_ROWS = 512  # (512, 128) fp32 block = 256 KiB of VMEM in + out
+
+
+def _quantize_kernel(x_ref, o_ref, *, exp_bits: int, man_bits: int):
+    o_ref[:] = cast_body(x_ref[:], exp_bits, man_bits)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def quantize_pallas(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                    interpret: bool = False) -> jnp.ndarray:
+    """eXmY cast of an arbitrary-shape fp32 array via a Pallas TPU kernel.
+
+    Bit-identical to `cast_to_format` (same body).  `interpret=True` runs
+    the kernel in the Pallas interpreter for CPU testing."""
+    _validate(exp_bits, man_bits)
+    x = jnp.asarray(x, jnp.float32)
+    shape = x.shape
+    n = x.size
+    if n == 0:
+        return x
+
+    rows = -(-n // _LANES)
+    pad = rows * _LANES - n
+    flat = jnp.pad(x.reshape(-1), (0, pad))
+    grid = -(-rows // _BLOCK_ROWS)
+    padded_rows = grid * _BLOCK_ROWS
+    flat = jnp.pad(flat.reshape(rows, _LANES),
+                   ((0, padded_rows - rows), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_quantize_kernel, exp_bits=exp_bits,
+                          man_bits=man_bits),
+        out_shape=jax.ShapeDtypeStruct((padded_rows, _LANES), jnp.float32),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(flat)
+    return out.reshape(-1)[:n].reshape(shape)

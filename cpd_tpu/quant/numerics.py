@@ -49,7 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["cast_to_format", "cast_oracle", "max_finite", "FP32_EXP_BITS", "FP32_MAN_BITS"]
+__all__ = ["cast_to_format", "cast_body", "cast_oracle", "max_finite",
+           "FP32_EXP_BITS", "FP32_MAN_BITS"]
 
 FP32_EXP_BITS = 8
 FP32_MAN_BITS = 23
@@ -96,14 +97,17 @@ def _rtne(man: jnp.ndarray, shift: int) -> jnp.ndarray:
     return man & keep_mask
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def cast_to_format(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
-    """Cast FP32 array values into the eXmY format, vectorized.
+def _pow2(e: jnp.ndarray) -> jnp.ndarray:
+    """Exact fp32 power of two for integer e in [-126, 127], built by bit
+    assembly (no transcendental, Mosaic/Pallas-safe)."""
+    return jax.lax.bitcast_convert_type(
+        ((e + 127) << 23).astype(jnp.uint32), jnp.float32)
 
-    Pure-functional, any shape/rank; `exp_bits`/`man_bits` are static so each
-    format compiles once (reference: one CUDA kernel specialization per call,
-    float_kernel.cu:94-101).
-    """
+
+def cast_body(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Un-jitted cast body using only ops Mosaic supports, so the SAME code
+    is the XLA implementation (via `cast_to_format`) and the Pallas kernel
+    body (ops/quantize.py).  See module docstring for semantics."""
     _validate(exp_bits, man_bits)
     x = jnp.asarray(x, jnp.float32)
 
@@ -141,15 +145,34 @@ def cast_to_format(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
     man_out = jnp.where(is_sub, man_sub, man_norm)
     e_out = jnp.where(is_sub, e_sub, e_norm)
 
-    # Value reconstruction (float_kernel.cu:72-86): man/2^23 * 2^e.  The
-    # significand fits exactly in fp32 (< 2^25) so this is exact.
-    mag = jnp.ldexp(man_out.astype(jnp.float32), e_out - 23)
+    # Value reconstruction (float_kernel.cu:72-86): man * 2^(e-23), split
+    # into two exact power-of-two factors so the subnormal tail (2^(e-23)
+    # down to 2^-149) never rounds: a in [-126, 127] carries most of the
+    # scale, b in [-23, 0] finishes it.  man_out < 2^25 is exact in fp32,
+    # and each multiply is exact (results are k*2^-149 with k < 2^24, all
+    # representable), so this equals the reference's iterative x2 / /2 loops
+    # bit-for-bit.
+    e = e_out - 23
+    a = jnp.clip(e, -126, 127)
+    b = e - a  # 0 in the normal range; [-23, 0) deep in the subnormal range
+    mag = man_out.astype(jnp.float32) * _pow2(a) * _pow2(b)
     val = jnp.where(negative, -mag, mag)
 
     inf = jnp.where(negative, -jnp.inf, jnp.inf).astype(jnp.float32)
     val = jnp.where(overflow, inf, val)
     val = jnp.where(flush_to_zero, jnp.float32(0.0), val)
     return jnp.where(passthrough, x, val)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def cast_to_format(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Cast FP32 array values into the eXmY format, vectorized.
+
+    Pure-functional, any shape/rank; `exp_bits`/`man_bits` are static so each
+    format compiles once (reference: one CUDA kernel specialization per call,
+    float_kernel.cu:94-101).
+    """
+    return cast_body(x, exp_bits, man_bits)
 
 
 def cast_oracle(x: float, exp_bits: int, man_bits: int) -> float:

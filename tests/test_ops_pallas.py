@@ -1,0 +1,73 @@
+"""Pallas kernels vs the XLA/jnp implementations (interpret mode on CPU).
+
+The kernels share `cast_body` with the XLA path, so equality must be exact
+(bitwise), not approximate — these tests assert that.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cpd_tpu.ops import qgemm_pallas, quantize_pallas
+from cpd_tpu.quant import float_quantize, quant_gemm
+from cpd_tpu.quant.numerics import cast_to_format
+
+FORMATS = [(5, 2), (4, 3), (8, 23), (2, 0), (8, 0), (1, 10)]
+
+
+def _rand(shape, seed=0, scale=4.0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(*shape) * scale).astype(np.float32)
+
+
+@pytest.mark.parametrize("exp,man", FORMATS)
+def test_quantize_pallas_bitwise_matches_xla(exp, man):
+    x = _rand((300, 77), seed=exp * 10 + man)
+    got = quantize_pallas(x, exp, man, True)
+    want = cast_to_format(x, exp, man)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_quantize_pallas_special_values():
+    x = np.array([np.inf, -np.inf, np.nan, 0.0, -0.0, 1e-45, -1e-45,
+                  65536.0, 61440.0], np.float32)
+    got = np.asarray(quantize_pallas(x, 5, 2, True))
+    want = np.asarray(cast_to_format(x, 5, 2))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_quantize_pallas_odd_sizes_and_ranks():
+    for shape in [(1,), (129,), (7, 3, 5), (1000,)]:
+        x = _rand(shape, seed=sum(shape))
+        got = quantize_pallas(x, 4, 3, True)
+        want = cast_to_format(x, 4, 3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3), (8, 23)])
+def test_qgemm_pallas_bitwise_matches_scan(exp, man):
+    a = _rand((24, 17), seed=1, scale=1.0)
+    b = _rand((17, 9), seed=2, scale=1.0)
+    got = qgemm_pallas(a, b, exp, man, True)
+    want = quant_gemm(a, b, man=man, exp=exp, mode="faithful")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_qgemm_pallas_tile_boundary():
+    # M, N exactly at and above the 128 tile edge
+    a = _rand((128, 5), seed=3, scale=1.0)
+    b = _rand((5, 130), seed=4, scale=1.0)
+    got = qgemm_pallas(a, b, 5, 2, True)
+    want = quant_gemm(a, b, man=2, exp=5, mode="faithful")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_qgemm_pallas_order_sensitivity_preserved():
+    """The ordered low-precision accumulation is order-sensitive; the kernel
+    must reproduce the forward-order result, not a tree reduction."""
+    a = np.array([[1.0, 1e4, -1e4]], np.float32)
+    b = np.ones((3, 1), np.float32)
+    got = float(qgemm_pallas(a, b, 5, 2, True)[0, 0])
+    want = float(quant_gemm(a, b, man=2, exp=5, mode="faithful")[0, 0])
+    assert got == want
