@@ -6,6 +6,9 @@ from .cifar import CIFAR10Pipeline, load_cifar10, synthetic_cifar10
 from .samplers import (DistributedEpochSampler,
                        DistributedGivenIterationSampler,
                        GivenIterationSampler)
+from .imagenet import (IMAGENET_MEAN, IMAGENET_STD, ImageFolderDataset,
+                       SyntheticImageNet, load_imagenet)
+from .segmentation import SyntheticSegmentation
 
 __all__ = [
     "CIFAR10_MEAN", "CIFAR10_STD", "Crop", "Cutout", "FlipLR",
@@ -13,4 +16,6 @@ __all__ = [
     "CIFAR10Pipeline", "load_cifar10", "synthetic_cifar10",
     "DistributedEpochSampler", "DistributedGivenIterationSampler",
     "GivenIterationSampler",
+    "IMAGENET_MEAN", "IMAGENET_STD", "ImageFolderDataset",
+    "SyntheticImageNet", "load_imagenet", "SyntheticSegmentation",
 ]

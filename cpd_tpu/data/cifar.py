@@ -113,6 +113,7 @@ class CIFAR10Pipeline:
         self.augment = augment
         self.drop_last = drop_last
         base = normalise(images.astype(np.float32))
+        self._choice_seed: Optional[int] = None
         if augment:
             self.data = pad_reflect(base, 4)
             transforms = [Crop(32, 32), FlipLR()]
@@ -122,6 +123,21 @@ class CIFAR10Pipeline:
         else:
             self.data = base
             self.pipeline = None
+
+    def batch(self, indices: np.ndarray, seed: int = 0
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """One batch for explicit `indices` (iteration-based samplers).
+        Choices are re-drawn only when `seed` changes, matching the
+        reference's once-per-epoch set_random_choices (utils.py:138-145)."""
+        indices = np.asarray(indices)
+        if self.pipeline is not None:
+            if self._choice_seed != seed:
+                self.pipeline.resample(seed)
+                self._choice_seed = seed
+            x = self.pipeline.apply(self.data, indices)
+        else:
+            x = self.data[indices]
+        return x, self.labels[indices]
 
     def __len__(self) -> int:
         n = len(self.labels)
@@ -133,6 +149,7 @@ class CIFAR10Pipeline:
         sampler in data/samplers.py)."""
         if self.pipeline is not None:
             self.pipeline.resample(seed)
+            self._choice_seed = seed   # keep batch()'s cache coherent
         bs = self.batch_size
         limit = len(indices) - (len(indices) % bs if self.drop_last else 0)
         for lo in range(0, limit, bs):

@@ -1,0 +1,163 @@
+"""ImageNet-shaped data: PIL ImageFolder loader + synthetic fallback.
+
+The reference trains ResNet-50 from `torchvision.datasets.ImageFolder`
+with RandomResizedCrop/flip for train and Resize/CenterCrop for val
+(example/ResNet50/main.py:90-110).  torchvision is not a dependency here;
+`ImageFolderDataset` re-implements that contract directly on PIL, emitting
+NHWC fp32 numpy batches (the TPU conv layout).  `SyntheticImageNet` is the
+zero-egress stand-in: deterministic, class-dependent images generated on
+demand so nothing of ImageNet's 150 GB needs to exist on disk.
+
+Both expose the same surface: `.labels`, `len()`, and
+`batch(indices, seed) -> (x, y)` — the contract CIFAR10Pipeline.batch set.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["IMAGENET_MEAN", "IMAGENET_STD", "SyntheticImageNet",
+           "ImageFolderDataset", "load_imagenet"]
+
+IMAGENET_MEAN = np.asarray((0.485, 0.456, 0.406), np.float32)  # main.py:101
+IMAGENET_STD = np.asarray((0.229, 0.224, 0.225), np.float32)
+
+
+def _normalise(x: np.ndarray) -> np.ndarray:
+    """x in [0,1] NHWC -> channel-standardised."""
+    return (x - IMAGENET_MEAN) / IMAGENET_STD
+
+
+class SyntheticImageNet:
+    """Deterministic on-demand ImageNet-shaped data with learnable
+    class-dependent structure (cf. synthetic_cifar10 in cifar.py)."""
+
+    def __init__(self, n: int = 12800, num_classes: int = 1000,
+                 size: int = 224, seed: int = 0):
+        self.size = size
+        self.num_classes = num_classes
+        rng = np.random.RandomState(seed)
+        self.labels = rng.randint(0, num_classes, size=n).astype(np.int32)
+        self._seed = seed
+        yy, xx = np.mgrid[0:size, 0:size] / max(size - 1, 1)
+        self._yy, self._xx = yy.astype(np.float32), xx.astype(np.float32)
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def batch(self, indices: Sequence[int], seed: int = 0
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        indices = np.asarray(indices)
+        y = self.labels[indices]
+        n, s = len(indices), self.size
+        out = np.empty((n, s, s, 3), np.float32)
+        for i, (idx, c) in enumerate(zip(indices, y)):
+            rng = np.random.RandomState((self._seed * 1_000_003 + idx)
+                                        % (2 ** 31))
+            freq = 1 + (c % 16)
+            phase = (c // 16) / 64.0
+            pattern = (np.cos(2 * np.pi * (freq * self._yy + phase))
+                       + np.sin(2 * np.pi * (freq * self._xx)))
+            base = 0.5 + 0.2 * pattern + (c / self.num_classes - 0.5) * 0.3
+            noise = rng.randn(s, s, 3).astype(np.float32) * 0.2
+            out[i] = base[:, :, None] + noise
+        return _normalise(np.clip(out, 0.0, 1.0)), y
+
+
+class ImageFolderDataset:
+    """`root/<class_name>/*.{jpg,png,...}` loader (ImageFolder contract).
+
+    train=True: RandomResizedCrop(size) + horizontal flip;
+    train=False: Resize(size*256/224) + CenterCrop(size) — the val
+    transform of main.py:105-110.  Decoding is PIL, per batch, on host.
+    """
+
+    _EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+    def __init__(self, root: str, size: int = 224, train: bool = True):
+        from PIL import Image  # noqa: F401 — fail early if PIL missing
+        self.root = root
+        self.size = size
+        self.train = train
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {root}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        labels = []
+        for c in classes:
+            folder = os.path.join(root, c)
+            for fname in sorted(os.listdir(folder)):
+                if fname.lower().endswith(self._EXTS):
+                    self.samples.append(os.path.join(folder, fname))
+                    labels.append(self.class_to_idx[c])
+        self.labels = np.asarray(labels, np.int32)
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def _load_train(self, path: str, rng: np.random.RandomState) -> np.ndarray:
+        from PIL import Image
+        img = Image.open(path).convert("RGB")
+        w, h = img.size
+        # RandomResizedCrop: area in [0.08, 1.0], ratio in [3/4, 4/3]
+        for _ in range(10):
+            area = w * h * rng.uniform(0.08, 1.0)
+            ratio = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+            cw = int(round(np.sqrt(area * ratio)))
+            ch = int(round(np.sqrt(area / ratio)))
+            if cw <= w and ch <= h:
+                x0 = rng.randint(0, w - cw + 1)
+                y0 = rng.randint(0, h - ch + 1)
+                img = img.crop((x0, y0, x0 + cw, y0 + ch))
+                break
+        img = img.resize((self.size, self.size))
+        if rng.rand() < 0.5:
+            img = img.transpose(0)  # FLIP_LEFT_RIGHT
+        return np.asarray(img, np.float32) / 255.0
+
+    def _load_eval(self, path: str) -> np.ndarray:
+        from PIL import Image
+        img = Image.open(path).convert("RGB")
+        short = int(self.size * 256 / 224)
+        w, h = img.size
+        scale = short / min(w, h)
+        img = img.resize((max(1, round(w * scale)), max(1, round(h * scale))))
+        w, h = img.size
+        x0 = (w - self.size) // 2
+        y0 = (h - self.size) // 2
+        img = img.crop((x0, y0, x0 + self.size, y0 + self.size))
+        return np.asarray(img, np.float32) / 255.0
+
+    def batch(self, indices: Sequence[int], seed: int = 0
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        indices = np.asarray(indices)
+        n = len(indices)
+        out = np.empty((n, self.size, self.size, 3), np.float32)
+        for i, idx in enumerate(indices):
+            if self.train:
+                rng = np.random.RandomState((seed * 1_000_003 + int(idx))
+                                            % (2 ** 31))
+                out[i] = self._load_train(self.samples[idx], rng)
+            else:
+                out[i] = self._load_eval(self.samples[idx])
+        return _normalise(out), self.labels[indices]
+
+
+def load_imagenet(root: Optional[str], size: int = 224,
+                  synthetic_train: int = 12800, synthetic_val: int = 1280,
+                  num_classes: int = 1000):
+    """Return (train_ds, val_ds): real ImageFolder pair if `root` has
+    train/ and val/ subdirs, else the synthetic stand-in."""
+    if root:
+        train_dir = os.path.join(root, "train")
+        val_dir = os.path.join(root, "val")
+        if os.path.isdir(train_dir) and os.path.isdir(val_dir):
+            return (ImageFolderDataset(train_dir, size, train=True),
+                    ImageFolderDataset(val_dir, size, train=False))
+    return (SyntheticImageNet(synthetic_train, num_classes, size, seed=0),
+            SyntheticImageNet(synthetic_val, num_classes, size, seed=1))

@@ -1,0 +1,67 @@
+"""Segmentation data: synthetic Cityscapes-shaped crops.
+
+The reference's FCN/Cityscapes workload lives out-of-repo (mmcv fork,
+README.md:132-150): 769x769 random crops of 19-class street scenes.  The
+synthetic stand-in emits (image NHWC fp32, label map HxW int32) pairs whose
+label regions are geometric shapes correlated with the image content, so
+short runs show the loss decreasing; real Cityscapes can be wired in by
+implementing this same `batch()` contract over the leftImg8bit/gtFine pair
+tree.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SyntheticSegmentation"]
+
+
+class SyntheticSegmentation:
+    """Deterministic synthetic scenes: `num_classes` horizontal bands with
+    per-class texture, plus a random rectangle of another class per image."""
+
+    def __init__(self, n: int = 256, num_classes: int = 19,
+                 crop_size: int = 128, seed: int = 0):
+        self.num_classes = num_classes
+        self.crop_size = crop_size
+        self._seed = seed
+        self.labels = np.zeros(n, np.int32)  # unused; keeps dataset contract
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def batch(self, indices: Sequence[int], seed: int = 0
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        indices = np.asarray(indices)
+        s, c = self.crop_size, self.num_classes
+        x = np.empty((len(indices), s, s, 3), np.float32)
+        y = np.empty((len(indices), s, s), np.int32)
+        for i, idx in enumerate(indices):
+            rng = np.random.RandomState((self._seed * 1_000_003 + int(idx))
+                                        % (2 ** 31))
+            n_bands = rng.randint(2, 5)
+            classes = rng.choice(c, size=n_bands, replace=False)
+            bounds = np.sort(rng.choice(np.arange(1, s), n_bands - 1,
+                                        replace=False)) if n_bands > 1 else []
+            label = np.empty((s, s), np.int32)
+            img = np.empty((s, s, 3), np.float32)
+            lo = 0
+            for b, cls in enumerate(classes):
+                hi = bounds[b] if b < n_bands - 1 else s
+                label[lo:hi] = cls
+                img[lo:hi] = (cls + 1) / c + 0.1 * rng.randn(hi - lo, s, 3)
+                lo = hi
+            # one foreground rectangle
+            cls = rng.randint(0, c)
+            h0, w0 = rng.randint(0, s // 2, size=2)
+            h1 = h0 + rng.randint(s // 8, s // 2)
+            w1 = w0 + rng.randint(s // 8, s // 2)
+            label[h0:h1, w0:w1] = cls
+            img[h0:h1, w0:w1] = (cls + 1) / c + 0.1 * rng.randn(
+                min(h1, s) - h0, min(w1, s) - w0, 3)
+            x[i] = img
+            y[i] = label
+        return x, y

@@ -9,6 +9,7 @@ from .resnet_cifar import ResNetCIFAR, resnet18_cifar
 from .davidnet import DavidNet, davidnet
 from .resnet import ResNet, resnet18, resnet50, resnet101
 from .fcn import FCN, FCNHead, fcn_r50_d8
+from .tiny import TinyCNN, tiny_cnn
 
 _REGISTRY = {
     "res_cifar": resnet18_cifar,      # reference name (mix.py:82)
@@ -18,6 +19,7 @@ _REGISTRY = {
     "resnet50": resnet50,
     "resnet101": resnet101,
     "fcn_r50_d8": fcn_r50_d8,
+    "tiny": tiny_cnn,                 # smoke-test model (models/tiny.py)
 }
 
 
@@ -30,4 +32,5 @@ def get_model(name: str, **kwargs):
 
 __all__ = ["ResNetCIFAR", "resnet18_cifar", "DavidNet", "davidnet",
            "ResNet", "resnet18", "resnet50", "resnet101",
-           "FCN", "FCNHead", "fcn_r50_d8", "get_model"]
+           "FCN", "FCNHead", "fcn_r50_d8", "TinyCNN", "tiny_cnn",
+           "get_model"]

@@ -58,13 +58,15 @@ class FCN(nn.Module):
     """Backbone + FCN decode head; logits upsampled to input size (NHWC)."""
     num_classes: int = 19  # Cityscapes
     aux_head: bool = False
+    stage_sizes: tuple = (3, 4, 6, 3)   # R50; smaller for smoke tests
+    head_channels: int = 512
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         h, w = x.shape[1], x.shape[2]
-        backbone = ResNet(stage_sizes=(3, 4, 6, 3), block=Bottleneck,
+        backbone = ResNet(stage_sizes=self.stage_sizes, block=Bottleneck,
                           output_stride=8, features_only=True,
                           dtype=self.dtype, param_dtype=self.param_dtype,
                           name="backbone")
@@ -75,8 +77,8 @@ class FCN(nn.Module):
         # through a second head on the same features when aux is off-path.
         feats = backbone(x, train=train)  # (B, h/8, w/8, 2048)
 
-        logits = FCNHead(self.num_classes, dtype=self.dtype,
-                         param_dtype=self.param_dtype,
+        logits = FCNHead(self.num_classes, channels=self.head_channels,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
                          name="decode_head")(feats, train=train)
         logits = jax.image.resize(
             logits.astype(jnp.float32), (logits.shape[0], h, w,

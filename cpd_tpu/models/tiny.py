@@ -1,0 +1,44 @@
+"""Tiny CNN — a smoke-test model, not a reference-parity one.
+
+The full zoo models (ResNet-18/50, DavidNet, FCN) cost minutes of XLA
+compile time on the 8-virtual-device CPU mesh; CI-style smoke tests of the
+trainer entry points need the identical harness path (BN stats, scan,
+quantized collectives, optimizer) at a fraction of the graph size.  That is
+this model's only job.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["TinyCNN", "tiny_cnn"]
+
+
+class TinyCNN(nn.Module):
+    """conv-BN-relu -> conv-BN-relu -> pool -> dense."""
+    num_classes: int = 10
+    width: int = 16
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for i, stride in enumerate(((2, 2), (2, 2))):
+            x = nn.Conv(self.width * (i + 1), (3, 3), strides=stride,
+                        use_bias=False, dtype=self.dtype,
+                        param_dtype=self.param_dtype, name=f"conv{i}")(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype,
+                             param_dtype=self.param_dtype, name=f"bn{i}")(x)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=self.param_dtype, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+def tiny_cnn(num_classes: int = 10, dtype=jnp.float32, **kw) -> TinyCNN:
+    return TinyCNN(num_classes=num_classes, dtype=dtype, **kw)

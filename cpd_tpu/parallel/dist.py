@@ -50,7 +50,7 @@ from .reduction import quantized_sum
 
 __all__ = [
     "dist_init", "sum_gradients", "broadcast_from", "replicate",
-    "all_reduce_mean",
+    "all_reduce_mean", "host_batch_to_global",
 ]
 
 
@@ -99,6 +99,23 @@ def broadcast_from(x: jnp.ndarray, axis_name: str, src: int = 0) -> jnp.ndarray:
     For use inside shard_map when parity with an explicit
     `dist.broadcast(p, 0)` (dist_util.py:94) is wanted mid-computation."""
     return lax.all_gather(x, axis_name, axis=0, tiled=False)[src]
+
+
+def host_batch_to_global(x, mesh: Mesh, axis_name: str = "dp"):
+    """Assemble each host's local batch slice into one global jax.Array
+    sharded over `axis_name`.
+
+    Multi-controller JAX feeds data per process (the analog of the
+    reference's per-rank DataLoader, main.py:111-120): each host loads
+    global_batch / process_count consecutive samples and this stitches them
+    into the global batch.  Single-process: a plain device_put.  The
+    host-order convention matches the contiguous per-rank blocks of
+    DistributedGivenIterationSampler (train_util.py:212-215)."""
+    x = np.asarray(x)
+    sharding = NamedSharding(mesh, P(axis_name))
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, x)
 
 
 def all_reduce_mean(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:

@@ -1,14 +1,16 @@
 """Training harness: state, steps, optimizers, schedules, metrics, ckpt."""
 
 from .state import TrainState, create_train_state
-from .step import cross_entropy_loss, make_eval_step, make_train_step
+from .step import (cross_entropy_loss, make_eval_step, make_train_step,
+                   seg_cross_entropy_loss)
 from .optim import lars, make_optimizer, sgd
 from .schedules import iter_table, piecewise_linear, warmup_step_decay
 from .metrics import AverageMeter, Timer, accuracy
 
 __all__ = [
     "TrainState", "create_train_state",
-    "cross_entropy_loss", "make_eval_step", "make_train_step",
+    "cross_entropy_loss", "seg_cross_entropy_loss", "make_eval_step",
+    "make_train_step",
     "lars", "make_optimizer", "sgd",
     "iter_table", "piecewise_linear", "warmup_step_decay",
     "AverageMeter", "Timer", "accuracy",

@@ -34,12 +34,12 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 5,
                  track_best: bool = True):
         directory = os.path.abspath(directory)
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
-            best_fn=(lambda m: m.get("best_metric", 0.0)) if track_best
-            else None,
-            best_mode="max" if track_best else None,
-        )
+        kwargs = {}
+        if track_best:   # orbax requires best_mode in {'min','max'} if set
+            kwargs = {"best_fn": lambda m: m.get("best_metric", 0.0),
+                      "best_mode": "max"}
+        options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                               **kwargs)
         self._mgr = ocp.CheckpointManager(directory, options=options)
 
     def save(self, step: int, state: TrainState,

@@ -42,7 +42,8 @@ from ..parallel.dist import sum_gradients
 from ..parallel.emulate import emulate_node_reduce
 from .state import TrainState
 
-__all__ = ["cross_entropy_loss", "make_train_step", "make_eval_step"]
+__all__ = ["cross_entropy_loss", "seg_cross_entropy_loss",
+           "make_train_step", "make_eval_step"]
 
 
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -52,12 +53,28 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
         logits, labels).mean()
 
 
+def seg_cross_entropy_loss(ignore_label: int = 255) -> Callable:
+    """Per-pixel CE averaged over non-ignored pixels — the segmentation
+    criterion of the FCN/Cityscapes config (reference README.md:132-150;
+    mmseg's CrossEntropyLoss with ignore_index=255)."""
+
+    def loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+        valid = labels != ignore_label
+        safe = jnp.where(valid, labels, 0)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+        return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    return loss
+
+
 def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     *, axis_name: str = "dp", emulate_node: int = 1,
                     use_aps: bool = False, grad_exp: int = 8,
                     grad_man: int = 23, use_kahan: bool = False,
                     mode: str = "faithful", loss_scale: float = 1.0,
                     loss_fn: Callable = cross_entropy_loss,
+                    rng_keys: tuple = (), rng_seed: int = 0,
+                    ignore_label: Optional[int] = None,
                     donate: bool = True):
     """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
 
@@ -69,43 +86,65 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     """
     has_stats_cache: dict = {}
 
-    def local_micro_grads(params, batch_stats, images, labels, world):
+    def local_micro_grads(params, batch_stats, images, labels, world, step):
         """Sequential scan over micro-batches -> stacked grads (N, ...)."""
         n = emulate_node
         mb = images.shape[0] // n
         images = images.reshape(n, mb, *images.shape[1:])
         labels = labels.reshape(n, mb, *labels.shape[1:])
 
-        def loss_of(p, stats, x, y):
+        def loss_of(p, stats, x, y, rngs):
             variables = {"params": p}
+            kwargs = {"rngs": rngs} if rngs else {}
             has_stats = bool(jax.tree.leaves(stats))
             if has_stats:
                 variables["batch_stats"] = stats
                 logits, mut = model.apply(variables, x, train=True,
-                                          mutable=["batch_stats"])
+                                          mutable=["batch_stats"], **kwargs)
                 new_stats = mut["batch_stats"]
             else:
-                logits = model.apply(variables, x, train=True)
+                logits = model.apply(variables, x, train=True, **kwargs)
                 new_stats = stats
             loss = loss_fn(logits, y) / (world * n)          # mix.py:239
             return loss * loss_scale, (logits, new_stats, loss)
 
         def micro(carry, xy):
-            stats = carry
+            stats, micro_idx = carry
             x, y = xy
+            # Per-micro-step stream rngs (dropout etc.), deterministic in
+            # (rng_seed, replica, global step, micro index) — the replica
+            # fold keeps dropout masks decorrelated across data-parallel
+            # shards (one rng stream per rank, as torch DDP gives).
+            rngs = {}
+            if rng_keys:
+                base = jax.random.fold_in(jax.random.PRNGKey(rng_seed),
+                                          step * n + micro_idx)
+                base = jax.random.fold_in(
+                    base, lax.axis_index(axis_name).astype(jnp.int32))
+                rngs = {k: jax.random.fold_in(base, i)
+                        for i, k in enumerate(rng_keys)}
             (_, (logits, new_stats, loss)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params, stats, x, y)
-            correct = jnp.sum(jnp.argmax(logits, -1) == y)
-            return new_stats, (grads, loss, correct)
+                loss_of, has_aux=True)(params, stats, x, y, rngs)
+            hit = jnp.argmax(logits, -1) == y
+            if ignore_label is not None:
+                valid = y != ignore_label
+                correct = jnp.sum(hit & valid)
+                counted = jnp.sum(valid)
+            else:
+                correct = jnp.sum(hit)
+                counted = jnp.asarray(y.size)
+            return (new_stats, micro_idx + 1), (grads, loss, correct, counted)
 
-        final_stats, (stacked_grads, losses, corrects) = lax.scan(
-            micro, batch_stats, (images, labels))
-        return stacked_grads, final_stats, losses.sum(), corrects.sum()
+        (final_stats, _), (stacked_grads, losses, corrects, counts) = lax.scan(
+            micro, (batch_stats, jnp.zeros([], jnp.int32)), (images, labels))
+        return (stacked_grads, final_stats, losses.sum(), corrects.sum(),
+                counts.sum())
 
     def step_fn(state: TrainState, images, labels):
         world = lax.psum(jnp.float32(1.0), axis_name)
-        stacked, new_stats, loss, correct = local_micro_grads(
-            state.params, state.batch_stats, images, labels, world)
+        stacked, new_stats, loss, correct, counted = local_micro_grads(
+            state.params, state.batch_stats, images, labels, world,
+            state.step)
 
         # Local emulated-node reduction (mix.py:251-282), then the
         # cross-device low-precision all-reduce (mix.py:286-291).
@@ -125,8 +164,13 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # loss is the per-rank sum of micro losses (already /world/n);
             # psum across ranks gives the global mean (mix.py:240-242).
             "loss": lax.psum(loss, axis_name) / loss_scale,
+            # element counts (not shape[0]) so dense label maps (FCN pixel
+            # accuracy, minus ignore_label pixels) and flat class labels
+            # share one metric definition.
             "accuracy": lax.psum(correct.astype(jnp.float32), axis_name)
-                        / lax.psum(jnp.float32(labels.shape[0]), axis_name),
+                        / jnp.maximum(
+                            lax.psum(counted.astype(jnp.float32), axis_name),
+                            1.0),
         }
         return new_state, metrics
 

@@ -1,1 +1,9 @@
-"""Shared utilities (config loading, logging) — populated as they land."""
+"""Shared utilities: config loading, loggers, profiling."""
+
+from .config import load_yaml_config, merge_config_into_args
+from .logging import (ProgressPrinter, ScalarWriter, TableLogger, TSVLogger,
+                      format_validation_line)
+
+__all__ = ["load_yaml_config", "merge_config_into_args", "TableLogger",
+           "TSVLogger", "ScalarWriter", "ProgressPrinter",
+           "format_validation_line"]

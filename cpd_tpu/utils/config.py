@@ -1,0 +1,39 @@
+"""YAML-into-argparse config merge (ResNet18 trainer parity).
+
+The reference loads a YAML file and injects the ``common:`` block's keys
+directly onto the argparse namespace (mix.py:69-72), so CLI flags and YAML
+keys share one flat namespace.  Same contract here, plus explicit
+precedence: a key given on the command line wins over the YAML value.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Dict
+
+import yaml
+
+__all__ = ["load_yaml_config", "merge_config_into_args"]
+
+
+def load_yaml_config(path: str, section: str = "common") -> Dict[str, Any]:
+    """Read `path` and return its `section` mapping (mix.py:69-72 reads the
+    ``common`` block of configs/res18_cifar.yaml)."""
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    cfg = doc.get(section, doc)
+    if not isinstance(cfg, dict):
+        raise ValueError(f"config section {section!r} in {path} is not a map")
+    return cfg
+
+
+def merge_config_into_args(args: argparse.Namespace, cfg: Dict[str, Any],
+                           cli_overrides: Dict[str, Any] | None = None
+                           ) -> argparse.Namespace:
+    """Set each cfg key as an attribute on `args` unless the user passed it
+    explicitly on the command line (keys in `cli_overrides`)."""
+    explicit = cli_overrides or {}
+    for key, value in cfg.items():
+        if key not in explicit:
+            setattr(args, key, value)
+    return args

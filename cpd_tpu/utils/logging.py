@@ -1,0 +1,119 @@
+"""Loggers — the reference's three observability surfaces, unified.
+
+Parity targets (SURVEY.md §5 "Metrics / logging"):
+  * the stdout line protocol that doubles as the plotting data source —
+    ``Iter: [i/N] ... Loss ... Prec@1 ...`` progress lines and the
+    ``* All Loss {l} Prec@1 {p} ...`` validation summary lines that
+    example/ResNet18/draw_curve.py:11-29 greps out of `tee`'d logs
+    (printed at mix.py:326-335,422-425);
+  * DavidNet's rank-gated column printer ``TableLogger`` (utils.py:44-56)
+    and DAWNBench ``TSVLogger`` (dawn.py:37-47);
+  * tensorboardX rank-0 scalars (mix.py:16,168-171,323-325,340-343) —
+    re-imagined as a dependency-free JSONL scalar stream that tensorboard,
+    pandas, or draw_curve can all ingest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, IO, Optional
+
+__all__ = ["TableLogger", "TSVLogger", "ScalarWriter", "ProgressPrinter",
+           "format_validation_line"]
+
+
+class TableLogger:
+    """Aligned-column stdout table (DavidNet utils.py:44-56 parity): prints
+    the header once, then one row per call; only `rank` 0 prints."""
+
+    def __init__(self, rank: int = 0):
+        self.rank = rank
+        self.keys: Optional[list] = None
+
+    def append(self, output: Dict[str, Any]):
+        if self.rank != 0:
+            return
+        if self.keys is None:
+            self.keys = list(output)
+            print(*(f"{k:>12s}" for k in self.keys))
+        filtered = [output[k] for k in self.keys]
+        print(*(f"{v:12.4f}" if isinstance(v, float) else f"{str(v):>12s}"
+                for v in filtered), flush=True)
+
+
+class TSVLogger:
+    """DAWNBench submission format: ``epoch\\thours\\ttop1Accuracy``
+    (dawn.py:37-47 parity, with the accuracy column actually populated —
+    the reference hardcodes it to 0, dawn.py:42-43)."""
+
+    def __init__(self):
+        self.log = ["epoch\thours\ttop1Accuracy"]
+
+    def append(self, output: Dict[str, Any]):
+        epoch = output["epoch"]
+        hours = output["total time"] / 3600
+        acc = 100.0 * float(output.get("test acc", 0.0))
+        self.log.append(f"{epoch}\t{hours:.8f}\t{acc:.2f}")
+
+    def __str__(self):
+        return "\n".join(self.log)
+
+
+class ScalarWriter:
+    """Append-only JSONL scalar stream: one ``{"tag","step","value","ts"}``
+    object per line.  Replaces the reference's tensorboardX SummaryWriter
+    (mix.py:168-171) without the dependency; `rank`-gated like the
+    reference's ``if rank == 0`` guards."""
+
+    def __init__(self, log_dir: str, rank: int = 0,
+                 filename: str = "scalars.jsonl"):
+        self.rank = rank
+        self._fh: Optional[IO] = None
+        if rank == 0:
+            os.makedirs(log_dir, exist_ok=True)
+            self._fh = open(os.path.join(log_dir, filename), "a")
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps({"tag": tag, "step": int(step),
+                                   "value": float(value),
+                                   "ts": time.time()}) + "\n")
+        self._fh.flush()
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ProgressPrinter:
+    """The per-iteration stdout protocol of mix.py:326-335: emitted every
+    `print_freq` steps, rank-0 only."""
+
+    def __init__(self, total_iters: int, print_freq: int = 50, rank: int = 0):
+        self.total = total_iters
+        self.freq = print_freq
+        self.rank = rank
+
+    def maybe_print(self, step: int, **meters: float):
+        if self.rank != 0 or step % self.freq != 0:
+            return
+        body = "\t".join(f"{k} {v:.4f}" for k, v in meters.items())
+        print(f"Iter: [{step}/{self.total}]\t{body}", flush=True)
+
+
+def format_validation_line(loss: float, prec1: float, prec5: float) -> str:
+    """The exact summary-line shape draw_curve greps for: token index -3
+    must be Prec@1's value (draw_curve.py:16-18 splits on whitespace and
+    takes ``split()[-3]``; mix.py:422-425 prints
+    ``* All Loss {l} Prec@1 {p1} Prec@5 {p5}``)."""
+    return f" * All Loss {loss:.4f} Prec@1 {prec1:.3f} Prec@5 {prec5:.3f}"

@@ -1,0 +1,67 @@
+"""Plot validation-accuracy curves from training logs.
+
+Parity with `example/ResNet18/draw_curve.py:11-29`: greps `tee`'d stdout
+logs for the ``* All Loss … Prec@1 …`` summary lines (token index -3 is
+Prec@1 — the contract of cpd_tpu.utils.format_validation_line) and plots
+one curve per log.  Also understands the ScalarWriter JSONL stream
+(`--jsonl`, tag val/top1) — the richer source the reference lacked.
+
+Usage:
+    python examples/draw_curve.py aps.log no_aps.log -o curves.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import List
+
+
+def parse_stdout_log(path: str) -> List[float]:
+    """Prec@1 values from '* All Loss … Prec@1 …' lines
+    (draw_curve.py:14-18: split() and take [-3])."""
+    vals = []
+    with open(path) as f:
+        for line in f:
+            if "* All Loss" in line:
+                vals.append(float(line.split()[-3]))
+    return vals
+
+
+def parse_jsonl(path: str, tag: str = "val/top1") -> List[float]:
+    vals = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("tag") == tag:
+                vals.append(100.0 * rec["value"])
+    return vals
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("logs", nargs="+", help="stdout logs (or .jsonl scalars)")
+    p.add_argument("-o", "--output", default="curves.png")
+    p.add_argument("--tag", default="val/top1", help="tag for JSONL inputs")
+    args = p.parse_args(argv)
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(1, 1)
+    for path in args.logs:
+        vals = (parse_jsonl(path, args.tag) if path.endswith(".jsonl")
+                else parse_stdout_log(path))
+        label = os.path.splitext(os.path.basename(path))[0]
+        ax.plot(range(len(vals)), vals, label=label)
+    ax.set_xlabel("validation round", fontsize=16)
+    ax.set_ylabel("testing accuracy", fontsize=16)
+    ax.legend(loc="lower right", fontsize=12)
+    fig.savefig(args.output, dpi=120, bbox_inches="tight")
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

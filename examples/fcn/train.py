@@ -1,0 +1,124 @@
+"""FCN-R50-d8 segmentation trainer — the reference's fourth workload,
+in-repo instead of the mmcv-fork hack (README.md:132-150: forks of mmcv
+branch APS_support + mmsegmentation, precision toggled by editing
+optimizer.py line 27).  Here precision is just flags on the shared trainer,
+proving the framework integration point the reference's fork demonstrates:
+the quantized all-reduce wraps any model's gradients.
+
+Iteration-based like mmseg (40K iters at crop 769; README.md:133).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+# Make the repo importable when run as a script (the reference required a
+# manual PYTHONPATH export, README.md:39; here the entry bootstraps itself).
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="cpd_tpu FCN/Cityscapes")
+    p.add_argument("--crop-size", default=769, type=int)
+    p.add_argument("--num-classes", default=19, type=int)
+    p.add_argument("--batch-size", default=2, type=int,
+                   help="per chip (mmseg default: 2 imgs/GPU)")
+    p.add_argument("--max-iter", default=40000, type=int)
+    p.add_argument("--base-lr", default=0.01, type=float)
+    p.add_argument("--momentum", default=0.9, type=float)
+    p.add_argument("--wd", default=0.0005, type=float)
+    p.add_argument("--print-freq", default=50, type=int)
+    p.add_argument("--save-path", default="fcn_ckpt")
+    p.add_argument("--val-freq", default=4000, type=int)
+    # precision flags — the reference's edit-a-source-line, as real flags
+    p.add_argument("--grad_exp", default=8, type=int)
+    p.add_argument("--grad_man", default=23, type=int)
+    p.add_argument("--use_APS", action="store_true")
+    p.add_argument("--use_kahan", action="store_true")
+    p.add_argument("--emulate_node", default=1, type=int)
+    p.add_argument("--mode", default="faithful", choices=["faithful", "fast"])
+    p.add_argument("--dist", action="store_true")
+    p.add_argument("--synthetic-size", default=256, type=int)
+    p.add_argument("--tiny-backbone", action="store_true",
+                   help="1-block-per-stage backbone (smoke tests)")
+    return p
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.data.segmentation import SyntheticSegmentation
+    from cpd_tpu.models import fcn_r50_d8
+    from cpd_tpu.parallel.dist import dist_init, host_batch_to_global
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    from cpd_tpu.train import (create_train_state, make_optimizer,
+                               make_train_step)
+    from cpd_tpu.train.step import seg_cross_entropy_loss
+    from cpd_tpu.train.schedules import piecewise_linear
+    from cpd_tpu.utils import ProgressPrinter, ScalarWriter
+
+    rank, world = dist_init() if args.dist else (0, 1)
+    mesh = data_parallel_mesh()
+    n_dev = mesh.devices.size
+
+    ds = SyntheticSegmentation(args.synthetic_size, args.num_classes,
+                               args.crop_size)
+    global_batch = args.batch_size * n_dev * args.emulate_node
+
+    # mmseg's poly schedule ~ piecewise-linear decay to lr*0.01 at max_iter
+    schedule = piecewise_linear([0, args.max_iter],
+                                [args.base_lr, args.base_lr * 0.01])
+    tiny = ({"stage_sizes": (1, 1, 1, 1), "head_channels": 64}
+            if args.tiny_backbone else {})
+    model = fcn_r50_d8(num_classes=args.num_classes, dtype=jnp.bfloat16,
+                       **tiny)
+    tx = make_optimizer("sgd", schedule, momentum=args.momentum,
+                        weight_decay=args.wd)
+    state = create_train_state(
+        model, tx, jnp.zeros((1, args.crop_size, args.crop_size, 3)),
+        jax.random.PRNGKey(0))
+
+    step = make_train_step(
+        model, tx, mesh, emulate_node=args.emulate_node,
+        use_aps=args.use_APS, grad_exp=args.grad_exp,
+        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
+        loss_fn=seg_cross_entropy_loss(ignore_label=255),
+        ignore_label=255, rng_keys=("dropout",))
+
+    writer = ScalarWriter(os.path.join(args.save_path, "logs"), rank=rank)
+    progress = ProgressPrinter(args.max_iter, args.print_freq, rank=rank)
+    # per-host RNG stream: hosts draw disjoint random crops
+    rng = np.random.RandomState(rank)
+    host_batch = global_batch // world
+    last = {}
+    t0 = time.time()
+    for it in range(1, args.max_iter + 1):
+        idx = rng.randint(0, len(ds), size=host_batch)
+        x, y = ds.batch(idx, seed=it)
+        state, m = step(state, host_batch_to_global(x, mesh),
+                        host_batch_to_global(y, mesh))
+        last = {k: float(v) for k, v in m.items()}
+        progress.maybe_print(it, Loss=last["loss"],
+                             PixAcc=100 * last["accuracy"])
+        writer.add_scalar("train/loss", last["loss"], it)
+    jax.block_until_ready(state.params)
+    if rank == 0:
+        print(f"done: {args.max_iter} iters in {time.time()-t0:.1f}s "
+              f"final loss {last.get('loss', float('nan')):.4f}")
+    writer.close()
+    return {"step": args.max_iter, **last}
+
+
+if __name__ == "__main__":
+    main()

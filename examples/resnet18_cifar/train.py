@@ -1,0 +1,223 @@
+"""ResNet18/CIFAR-10 trainer — parity with the reference flagship entry
+`example/ResNet18/tools/mix.py` (flags mix.py:29-44, YAML merge :69-72,
+schedule :181-198, loop :224-356), rebuilt on the shared cpd_tpu harness.
+
+Where the reference runs one Python loop per parameter per micro-batch
+(SURVEY.md §3.1), here the whole quantized step — emulate-node scan, APS,
+low-precision ordered all-reduce, LARS/SGD — is ONE jitted shard_map
+program per step (cpd_tpu/train/step.py).
+
+Usage (mirrors README.md:76-79's single-host quick start):
+    python examples/resnet18_cifar/train.py --use_APS --grad_exp 5 \
+        --grad_man 2 --emulate_node 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+# Make the repo importable when run as a script (the reference required a
+# manual PYTHONPATH export, README.md:39; here the entry bootstraps itself).
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    here = os.path.dirname(os.path.abspath(__file__))
+    p = argparse.ArgumentParser(description="cpd_tpu ResNet18/CIFAR10")
+    # the reference's surface (mix.py:29-44)
+    p.add_argument("--config", default=os.path.join(here, "configs",
+                                                    "res18_cifar.yaml"))
+    p.add_argument("--dist", action="store_true",
+                   help="multi-host: call jax.distributed.initialize()")
+    p.add_argument("--load-path", default="", type=str)
+    p.add_argument("--grad_exp", default=5, type=int)
+    p.add_argument("--grad_man", default=2, type=int)
+    p.add_argument("--resume-opt", action="store_true")
+    p.add_argument("--use_lars", action="store_true")
+    p.add_argument("--use_APS", action="store_true")
+    p.add_argument("--use_kahan", action="store_true")
+    p.add_argument("-e", "--evaluate", action="store_true")
+    p.add_argument("--emulate_node", default=1, type=int)
+    # YAML-backed keys (mix.py:69-72 merges the YAML onto args); a CLI
+    # value beats the YAML one, so default=None means "take the YAML's".
+    p.add_argument("--arch", default=None, type=str)
+    p.add_argument("--batch_size", default=None, type=int)
+    p.add_argument("--max_epoch", default=None, type=int)
+    p.add_argument("--save_path", default=None, type=str)
+    p.add_argument("--val_freq", default=None, type=int)
+    p.add_argument("--print_freq", default=None, type=int)
+    # new surface (no reference equivalent)
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--max-iter", default=None, type=int,
+                   help="override total iterations (smoke tests)")
+    p.add_argument("--mode", default="faithful",
+                   choices=["faithful", "fast"],
+                   help="faithful: bit-ordered quantized reduction; "
+                        "fast: quantize->psum->dequantize")
+    return p
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.data import CIFAR10Pipeline, load_cifar10
+    from cpd_tpu.data.samplers import DistributedGivenIterationSampler
+    from cpd_tpu.models import get_model
+    from cpd_tpu.parallel.dist import dist_init, host_batch_to_global
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    from cpd_tpu.train import (CheckpointManager, create_train_state,
+                               make_eval_step, make_optimizer,
+                               make_train_step, warmup_step_decay)
+    from cpd_tpu.utils import (ProgressPrinter, ScalarWriter,
+                               format_validation_line, load_yaml_config,
+                               merge_config_into_args)
+
+    rank, world = dist_init() if args.dist else (0, 1)
+    explicit = {k: v for k, v in vars(args).items() if v is not None}
+    merge_config_into_args(args, load_yaml_config(args.config),
+                           cli_overrides=explicit)
+
+    mesh = data_parallel_mesh()
+    n_dev = mesh.devices.size
+    seed = 24                                   # mix.py:23
+
+    train_x, train_y, test_x, test_y = load_cifar10(args.data_root)
+    dataset_len = len(train_y)
+
+    # Schedule shape of mix.py:181-198: warmup 0.1 -> 1.6 over 5 epochs,
+    # x0.1 after epochs 40 and 80; iters/epoch counts the emulated cluster.
+    iter_per_epoch = math.ceil(
+        dataset_len / (n_dev * args.batch_size * args.emulate_node))
+    total_iter = args.max_epoch * iter_per_epoch
+    if args.max_iter is not None:
+        total_iter = args.max_iter
+    schedule = warmup_step_decay(
+        1.6, 5 * iter_per_epoch,
+        [40 * iter_per_epoch, 80 * iter_per_epoch], warmup_from=0.1)
+
+    model = get_model(args.arch)
+    tx = make_optimizer("lars" if args.use_lars else "sgd", schedule,
+                        momentum=args.momentum,
+                        weight_decay=args.weight_decay)
+
+    state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),
+                               jax.random.PRNGKey(seed))
+    ckpt_dir = os.path.abspath(args.save_path)
+    manager = CheckpointManager(ckpt_dir, track_best=True)
+    start_iter = 0
+    if args.load_path:
+        # Warm-start from an explicit checkpoint dir (mix.py --load-path /
+        # train_util.load_state:274-318); --resume-opt additionally restores
+        # the optimizer state and step counter, else params only.
+        from cpd_tpu.train import restore_latest
+        loaded = restore_latest(os.path.abspath(args.load_path), state)
+        if loaded is None:
+            raise FileNotFoundError(
+                f"--load-path {args.load_path}: no checkpoint found")
+        if args.resume_opt:
+            state = loaded
+            start_iter = int(loaded.step)
+        else:
+            state = state.replace(params=loaded.params,
+                                  batch_stats=loaded.batch_stats)
+        if rank == 0:
+            print(f"=> loaded {args.load_path} "
+                  f"(opt {'restored' if args.resume_opt else 'fresh'})")
+    elif manager.latest_step() is not None:
+        restored = manager.restore(state)
+        if restored is not None:
+            state = restored
+            start_iter = int(restored.step)
+            if rank == 0:
+                print(f"=> resumed from iter {start_iter}")
+
+    train_step = make_train_step(
+        model, tx, mesh, emulate_node=args.emulate_node,
+        use_aps=args.use_APS, grad_exp=args.grad_exp,
+        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode)
+    eval_step = make_eval_step(model, mesh)
+
+    # Global per-step batch = per-chip batch x chips x emulated nodes
+    # (mix.py:123-132 scales max_iter by emulate_node instead; same
+    # cluster).  Each host loads its 1/world contiguous slice; the sampler
+    # hands out per-host index blocks (train_util.py:212-215) and
+    # host_batch_to_global stitches them into the sharded global array.
+    global_batch = args.batch_size * n_dev * args.emulate_node
+    host_batch = global_batch // world
+    pipeline = CIFAR10Pipeline(train_x, train_y, host_batch, augment=True,
+                               cutout=0)
+    eval_bs = max(n_dev, (min(1000, len(test_y)) // n_dev) * n_dev)
+    eval_host = eval_bs // world
+    eval_pipe = CIFAR10Pipeline(test_x, test_y, eval_bs, augment=False)
+
+    def validate(step_no: int) -> dict:
+        tot = {"loss": 0.0, "top1": 0.0, "top5": 0.0}
+        n_batches = 0
+        limit = (len(test_y) // eval_bs) * eval_bs
+        for lo in range(0, limit, eval_bs):
+            sel = np.arange(lo + rank * eval_host,
+                            lo + (rank + 1) * eval_host)
+            x, y = eval_pipe.batch(sel)
+            m = eval_step(state, host_batch_to_global(x, mesh),
+                          host_batch_to_global(y, mesh))
+            for k in tot:
+                tot[k] += float(m[k])
+            n_batches += 1
+        avg = {k: v / max(n_batches, 1) for k, v in tot.items()}
+        if rank == 0:
+            print(format_validation_line(avg["loss"], 100 * avg["top1"],
+                                         100 * avg["top5"]), flush=True)
+        return avg
+
+    if args.evaluate:                            # mix.py:-e
+        return validate(start_iter)
+
+    sampler = DistributedGivenIterationSampler(
+        dataset_len, total_iter, host_batch, world_size=world, rank=rank,
+        seed=0, last_iter=start_iter - 1)
+    writer = ScalarWriter(os.path.join(ckpt_dir, "logs"), rank=rank)
+    progress = ProgressPrinter(total_iter, args.print_freq, rank=rank)
+    best_prec1 = 0.0
+    last = {"loss": float("nan"), "accuracy": 0.0}
+    step_no = start_iter
+    t0 = time.time()
+    for batch_idx in sampler.batches():
+        x, y = pipeline.batch(batch_idx, seed=step_no // iter_per_epoch)
+        state, metrics = train_step(state, host_batch_to_global(x, mesh),
+                                    host_batch_to_global(y, mesh))
+        step_no += 1
+        last = {k: float(v) for k, v in metrics.items()}
+        progress.maybe_print(step_no, Loss=last["loss"],
+                             Prec=100 * last["accuracy"],
+                             LR=float(schedule(step_no)))
+        writer.add_scalar("train/loss", last["loss"], step_no)
+        writer.add_scalar("train/acc", last["accuracy"], step_no)
+        if step_no % args.val_freq == 0 or step_no == total_iter:
+            val = validate(step_no)
+            writer.add_scalar("val/top1", val["top1"], step_no)
+            prec1 = 100 * val["top1"]
+            best_prec1 = max(best_prec1, prec1)
+            manager.save(step_no, state, best_metric=prec1)
+    manager.wait()
+    writer.close()
+    if rank == 0:
+        print(f"done: {step_no - start_iter} iters in {time.time()-t0:.1f}s "
+              f"best Prec@1 {best_prec1:.2f}")
+    manager.close()
+    return {"step": step_no, "best_prec1": best_prec1, **last}
+
+
+if __name__ == "__main__":
+    main()

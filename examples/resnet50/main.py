@@ -1,0 +1,211 @@
+"""ResNet-50 / ImageNet trainer — parity with `example/ResNet50/main.py`
+(flags :21-55, warmup schedule :237-252, BN-without-wd param groups
+:123-131, per-epoch checkpoint + auto-resume :70-75,134-138,261-269,
+emulate-node sub-batch accumulation :160-202) on the shared cpd_tpu
+harness.
+
+The headline workload (SURVEY.md §6): ResNet-50, batch 32/chip, e5m2 APS
+gradient all-reduce.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+# Make the repo importable when run as a script (the reference required a
+# manual PYTHONPATH export, README.md:39; here the entry bootstraps itself).
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="cpd_tpu ImageNet Example",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    # reference surface (main.py:21-55)
+    p.add_argument("--train-dir", default=None,
+                   help="ImageNet root with train/ and val/ (synthetic "
+                        "stand-in when absent)")
+    p.add_argument("--log-dir", default="./logs")
+    p.add_argument("--checkpoint-dir", default="./checkpoints",
+                   help="per-epoch checkpoints + auto-resume (the "
+                        "checkpoint-{epoch}.pth.tar scan of main.py:70-75)")
+    p.add_argument("--emulate-node", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--val-batch-size", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=90)
+    p.add_argument("--base-lr", type=float, default=0.0125,
+                   help="learning rate for a single chip")
+    p.add_argument("--warmup-epochs", type=float, default=5)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=0.0001)
+    p.add_argument("--use-APS", action="store_true")
+    p.add_argument("--use-kahan", action="store_true")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--grad_exp", type=int, default=8)
+    p.add_argument("--grad_man", type=int, default=23)
+    # new surface
+    p.add_argument("--arch", default="resnet50")
+    p.add_argument("--num-classes", default=1000, type=int)
+    p.add_argument("--dist", action="store_true")
+    p.add_argument("--max-batches-per-epoch", default=None, type=int)
+    p.add_argument("--image-size", default=224, type=int)
+    p.add_argument("--mode", default="faithful",
+                   choices=["faithful", "fast"])
+    return p
+
+
+def bn_and_bias_no_wd(params):
+    """wd_mask: True = apply weight decay.  BN scale/bias and all biases
+    are excluded — the param-group split of main.py:123-131."""
+    import jax
+
+    def decide(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+        is_bn = any("BatchNorm" in str(n) or str(n) == "batch_stats"
+                    for n in names)
+        is_bias = names and str(names[-1]) in ("bias", "scale")
+        return not (is_bn or is_bias)
+
+    return jax.tree_util.tree_map_with_path(decide, params)
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.data.imagenet import load_imagenet
+    from cpd_tpu.data.samplers import DistributedEpochSampler
+    from cpd_tpu.models import get_model
+    from cpd_tpu.parallel.dist import dist_init, host_batch_to_global
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    from cpd_tpu.train import (CheckpointManager, create_train_state,
+                               make_eval_step, make_optimizer,
+                               make_train_step, warmup_step_decay)
+    from cpd_tpu.utils import ScalarWriter, format_validation_line
+
+    rank, world = dist_init() if args.dist else (0, 1)
+    mesh = data_parallel_mesh()
+    n_dev = mesh.devices.size
+
+    train_ds, val_ds = load_imagenet(args.train_dir, size=args.image_size,
+                                     num_classes=args.num_classes)
+    global_batch = args.batch_size * n_dev * args.emulate_node
+    iters_per_epoch = len(train_ds) // global_batch
+    if args.max_batches_per_epoch:
+        iters_per_epoch = min(iters_per_epoch, args.max_batches_per_epoch)
+    if iters_per_epoch == 0:
+        raise ValueError(f"dataset of {len(train_ds)} too small for global "
+                         f"batch {global_batch}")
+
+    # main.py:237-252: lr 3.2-style linear-scaled base with 5-epoch warmup
+    # from 0.1x, /10 after epochs 30/60/80.  base-lr is per-chip
+    # (main.py:38-39 scales by world size x emulate_node).
+    scaled_lr = args.base_lr * n_dev * args.emulate_node
+    schedule = warmup_step_decay(
+        scaled_lr, int(args.warmup_epochs * iters_per_epoch),
+        [30 * iters_per_epoch, 60 * iters_per_epoch, 80 * iters_per_epoch],
+        warmup_from=scaled_lr / 10.0)
+
+    model = get_model(args.arch, num_classes=args.num_classes,
+                      dtype=jnp.bfloat16)
+    tx = make_optimizer("sgd", schedule, momentum=args.momentum,
+                        weight_decay=args.wd, wd_mask=bn_and_bias_no_wd)
+    state = create_train_state(
+        model, tx, jnp.zeros((2, args.image_size, args.image_size, 3)),
+        jax.random.PRNGKey(args.seed))
+
+    manager = CheckpointManager(os.path.abspath(args.checkpoint_dir),
+                                track_best=True)
+    start_epoch = 0
+    restored = manager.restore(state)
+    if restored is not None:                 # auto-resume (main.py:70-75)
+        state = restored
+        start_epoch = int(restored.step) // iters_per_epoch
+        if rank == 0:
+            print(f"=> auto-resumed from epoch {start_epoch}")
+
+    train_step = make_train_step(
+        model, tx, mesh, emulate_node=args.emulate_node,
+        use_aps=args.use_APS, grad_exp=args.grad_exp,
+        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode)
+    eval_step = make_eval_step(model, mesh)
+
+    writer = ScalarWriter(args.log_dir, rank=rank)
+    # Per-host epoch-seeded shuffle: each host draws its strided 1/world of
+    # the epoch permutation (main.py:111-120's DistributedSampler contract).
+    sampler = DistributedEpochSampler(len(train_ds), world_size=world,
+                                      rank=rank)
+    host_batch = global_batch // world
+    val_bs = args.val_batch_size * n_dev
+    val_host = val_bs // world
+    result = {}
+    for epoch in range(start_epoch, args.epochs):
+        sampler.set_epoch(epoch)
+        order = np.fromiter(iter(sampler), np.int64)
+        t0 = time.time()
+        train_loss = train_acc = 0.0
+        for it in range(iters_per_epoch):
+            idx = order[it * host_batch:(it + 1) * host_batch]
+            x, y = train_ds.batch(idx, seed=epoch)
+            state, m = train_step(
+                state,
+                host_batch_to_global(x.astype(np.float32), mesh),
+                host_batch_to_global(y, mesh))
+            train_loss += float(m["loss"])
+            train_acc += float(m["accuracy"])
+        jax.block_until_ready(state.params)
+        dt = time.time() - t0
+        imgs_per_sec = iters_per_epoch * global_batch / dt
+
+        # validate (main.py:215-235)
+        val_loss = val_top1 = val_top5 = 0.0
+        k = 0
+        n_val = (len(val_ds) // val_bs) * val_bs
+        for lo in range(0, n_val, val_bs):
+            sel = np.arange(lo + rank * val_host, lo + (rank + 1) * val_host)
+            x, y = val_ds.batch(sel)
+            m = eval_step(state,
+                          host_batch_to_global(x.astype(np.float32), mesh),
+                          host_batch_to_global(y, mesh))
+            val_loss += float(m["loss"])
+            val_top1 += float(m["top1"])
+            val_top5 += float(m["top5"])
+            k += 1
+        k = max(k, 1)
+        result = {
+            "epoch": epoch, "train_loss": train_loss / iters_per_epoch,
+            "train_acc": train_acc / iters_per_epoch,
+            "val_loss": val_loss / k, "val_top1": val_top1 / k,
+            "val_top5": val_top5 / k, "img_per_sec": imgs_per_sec,
+        }
+        if rank == 0:
+            print(f"Epoch {epoch}: loss {result['train_loss']:.4f} "
+                  f"acc {100*result['train_acc']:.2f} "
+                  f"({imgs_per_sec:.1f} img/s)")
+            print(format_validation_line(result["val_loss"],
+                                         100 * result["val_top1"],
+                                         100 * result["val_top5"]))
+        writer.add_scalar("train/loss", result["train_loss"], epoch)
+        writer.add_scalar("val/top1", result["val_top1"], epoch)
+        # per-epoch checkpoint, step-indexed by iteration (main.py:261-269)
+        manager.save((epoch + 1) * iters_per_epoch, state,
+                     best_metric=100 * result["val_top1"])
+    manager.wait()
+    manager.close()
+    writer.close()
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Cross-oracle tests: the native C++ library vs the jnp implementation vs
+the NumPy transliteration oracle.
+
+Three independent implementations of the eXmY semantics (C++ bit-twiddle,
+jnp bit-twiddle, NumPy CUDA-transliteration) agreeing bitwise on random +
+adversarial inputs is the strongest correctness evidence available without
+the reference's GPU (SURVEY.md §4's test-pyramid plan)."""
+
+import numpy as np
+import pytest
+
+from cpd_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain")
+
+FORMATS = [(5, 2), (4, 3), (8, 23), (1, 0), (8, 0), (2, 7), (6, 9)]
+
+
+def _adversarial(exp, man):
+    """Edge-case values for a format: around max, min-normal, subnormal
+    steps, ties."""
+    bias = (1 << (exp - 1)) - 1
+    vals = [0.0, -0.0, np.inf, -np.inf, np.nan,
+            1.0, -1.0, 1.5, 2.0 ** (-bias), 2.0 ** (-bias - man),
+            2.0 ** (-bias - man - 1), 2.0 ** (1 - bias) * 0.75,
+            float(np.finfo(np.float32).tiny),        # min normal fp32
+            float(np.finfo(np.float32).tiny) / 2,    # fp32 subnormal
+            float(np.finfo(np.float32).max),
+            (2 - 2.0 ** (-man)) * 2.0 ** (bias if bias else 1),
+            ]
+    # RTNE tie patterns at the rounding boundary
+    for frac in (1 + 2.0 ** (-man - 1), 1 + 3 * 2.0 ** (-man - 1),
+                 1 + 2.0 ** (-man - 1) + 2.0 ** -23):
+        vals.append(frac)
+        vals.append(-frac)
+    return np.asarray(vals, np.float32)
+
+
+@pytest.mark.parametrize("exp,man", FORMATS)
+def test_native_cast_matches_jnp(exp, man):
+    from cpd_tpu.quant import float_quantize
+
+    rng = np.random.RandomState(42)
+    x = np.concatenate([
+        rng.randn(512).astype(np.float32) * 10.0 ** rng.randint(-8, 8, 512),
+        _adversarial(exp, man),
+    ]).astype(np.float32)
+    got = native.float_quantize_np(x, exp, man)
+    want = np.asarray(float_quantize(x, exp, man))
+    # full bitwise equality (NaN passthrough preserves payloads in both)
+    np.testing.assert_array_equal(got.view(np.uint32), want.view(np.uint32))
+
+
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3), (8, 23)])
+def test_native_cast_matches_scalar_oracle(exp, man):
+    from cpd_tpu.quant.numerics import cast_oracle
+
+    rng = np.random.RandomState(7)
+    xs = rng.randn(200).astype(np.float32) * 10.0 ** rng.randint(-6, 6, 200)
+    for x in xs:
+        got = native.float_quantize_np(np.float32([x]), exp, man)[0]
+        want = np.float32(cast_oracle(float(x), exp, man))
+        assert np.float32(got).tobytes() == want.tobytes(), (x, got, want)
+
+
+@pytest.mark.parametrize("exp,man", [(5, 2), (8, 23)])
+def test_native_qgemm_matches_jnp(exp, man):
+    from cpd_tpu.quant import quant_gemm
+
+    rng = np.random.RandomState(3)
+    a = rng.randn(7, 13).astype(np.float32)
+    b = rng.randn(13, 5).astype(np.float32)
+    got = native.quant_gemm_np(a, b, exp, man)
+    want = np.asarray(quant_gemm(a, b, man=man, exp=exp, mode="faithful"))
+    np.testing.assert_array_equal(got.view(np.uint32), want.view(np.uint32))
+
+
+@pytest.mark.parametrize("kahan", [False, True])
+def test_native_ordered_sum_matches_jnp(kahan):
+    from cpd_tpu.parallel.reduction import quantized_sum
+
+    rng = np.random.RandomState(11)
+    stacked = rng.randn(8, 33).astype(np.float32)
+    got = native.ordered_sum_np(stacked, 5, 2, kahan=kahan)
+    want = np.asarray(quantized_sum(stacked, 5, 2, use_kahan=kahan))
+    np.testing.assert_array_equal(got.view(np.uint32), want.view(np.uint32))
+
+
+def test_native_quantize_is_pure():
+    x = np.linspace(-3, 3, 17, dtype=np.float32)
+    x0 = x.copy()
+    native.float_quantize_np(x, 5, 2)
+    np.testing.assert_array_equal(x, x0)
+
+
+def test_unavailable_paths_raise(monkeypatch):
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", True)
+    with pytest.raises(NotImplementedError):
+        native.float_quantize_np(np.zeros(3, np.float32), 5, 2)
