@@ -10,6 +10,7 @@ from .davidnet import DavidNet, davidnet
 from .resnet import ResNet, resnet18, resnet50, resnet101
 from .fcn import FCN, FCNHead, fcn_r50_d8
 from .tiny import TinyCNN, tiny_cnn
+from .transformer import TransformerLM, lm_param_specs, transformer_lm
 
 _REGISTRY = {
     "res_cifar": resnet18_cifar,      # reference name (mix.py:82)
@@ -20,6 +21,7 @@ _REGISTRY = {
     "resnet101": resnet101,
     "fcn_r50_d8": fcn_r50_d8,
     "tiny": tiny_cnn,                 # smoke-test model (models/tiny.py)
+    "transformer_lm": transformer_lm,
 }
 
 
@@ -33,4 +35,5 @@ def get_model(name: str, **kwargs):
 __all__ = ["ResNetCIFAR", "resnet18_cifar", "DavidNet", "davidnet",
            "ResNet", "resnet18", "resnet50", "resnet101",
            "FCN", "FCNHead", "fcn_r50_d8", "TinyCNN", "tiny_cnn",
+           "TransformerLM", "transformer_lm", "lm_param_specs",
            "get_model"]

@@ -1,0 +1,163 @@
+"""Transformer LM with tensor- and sequence-parallelism built in.
+
+New capability beyond the reference (whose workloads are CNNs only,
+SURVEY.md §5): a decoder-only LM whose forward pass is written to run
+unchanged in two regimes —
+
+* single device (``tp_axis=None, sp_axis=None``): plain local attention;
+* inside ``shard_map`` over a ("dp","sp","tp") mesh: Megatron-style tensor
+  parallelism (qkv/wi column-sharded, wo row-sharded, one `psum` over tp
+  per projection pair) and Ring-Attention sequence parallelism (K/V rotate
+  over the sp axis, ops/attention.py).
+
+TPU-first choices: RoPE positions are computed from the sp rank's global
+offset (no position-embedding table to shard); all Dense layers are
+bias-free so the tp `psum` needs no bias correction; head count and ff
+width are derived from the *runtime kernel shapes*, so the same module
+code handles full (init-time) and per-rank (apply-time, shard_map-sliced)
+parameter shapes.
+
+`lm_param_specs` maps a param pytree to PartitionSpecs (the tp sharding
+rules); train/lm.py consumes it for the whole-step shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import local_attention, ring_attention
+
+__all__ = ["TransformerLM", "transformer_lm", "lm_param_specs"]
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    """Rotary embedding on (B, T, H, D) with (T,) global positions."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32)
+                    * (jnp.log(10000.0) / half))
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+class Block(nn.Module):
+    head_dim: int
+    d_ff: int           # GLOBAL ff width; local = d_ff // tp_size
+    d_model: int
+    tp_axis: Optional[str]
+    sp_axis: Optional[str]
+    tp_size: int        # 1 at init (global shapes); the mesh's tp size when
+                        # applied inside shard_map (flax validates declared
+                        # vs stored shapes, so features must be local)
+    dtype: Any
+
+    def _psum_tp(self, x):
+        return lax.psum(x, self.tp_axis) if self.tp_axis else x
+
+    @nn.compact
+    def __call__(self, x, positions):
+        # ---- attention ----
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        qkv = nn.Dense(3 * self.d_model // self.tp_size, use_bias=False,
+                       dtype=self.dtype, name="wqkv")(h)
+        # local head count from the runtime shape (tp slices the out dim).
+        # Layout is HEAD-major — (n_heads, 3, head_dim) in the feature dim —
+        # so a tp column-slice keeps whole heads with their q,k,v together.
+        n_local = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = qkv.reshape(*qkv.shape[:-1], n_local, 3, self.head_dim)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+        if self.sp_axis:
+            attn = ring_attention(q, k, v, self.sp_axis, causal=True)
+        else:
+            attn = local_attention(q, k, v, causal=True)
+        attn = attn.reshape(*attn.shape[:-2], n_local * self.head_dim)
+        proj = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                        name="wo")(attn)
+        x = x + self._psum_tp(proj)
+
+        # ---- mlp ----
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.d_ff // self.tp_size, use_bias=False,
+                     dtype=self.dtype, name="wi")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                     name="wo_mlp")(h)
+        return x + self._psum_tp(h)
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM.  Input: (B, T_local) int32 tokens; output:
+    (B, T_local, vocab) fp32 logits."""
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 2048
+    tp_axis: Optional[str] = None
+    sp_axis: Optional[str] = None
+    tp_size: int = 1
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        t_local = tokens.shape[1]
+        if self.sp_axis:
+            offset = lax.axis_index(self.sp_axis) * t_local
+        else:
+            offset = 0
+        positions = offset + jnp.arange(t_local)
+
+        emb = nn.Embed(self.vocab_size, self.d_model,
+                       dtype=self.dtype, param_dtype=self.param_dtype,
+                       name="embed")
+        x = emb(tokens)
+        head_dim = self.d_model // self.n_heads
+        for i in range(self.n_layers):
+            x = Block(head_dim=head_dim, d_ff=self.d_ff,
+                      d_model=self.d_model, tp_axis=self.tp_axis,
+                      sp_axis=self.sp_axis, tp_size=self.tp_size,
+                      dtype=self.dtype,
+                      name=f"block{i}")(x, positions)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = emb.attend(x.astype(self.param_dtype))  # tied head
+        return logits.astype(jnp.float32)
+
+
+def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
+                   n_layers: int = 4, n_heads: int = 8,
+                   d_ff: Optional[int] = None, dtype=jnp.float32,
+                   **kw) -> TransformerLM:
+    return TransformerLM(vocab_size=vocab_size, d_model=d_model,
+                         n_layers=n_layers, n_heads=n_heads,
+                         d_ff=d_ff or 4 * d_model, dtype=dtype, **kw)
+
+
+def lm_param_specs(params, tp_axis: str = "tp"):
+    """PartitionSpec pytree for the Megatron sharding rules: qkv and wi
+    kernels column-sharded (out dim on tp), wo kernels row-sharded (in dim
+    on tp), everything else replicated."""
+
+    def spec(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        joined = "/".join(names)
+        if names and names[-1] == "kernel":
+            if "wqkv" in joined or joined.endswith("wi/kernel"):
+                return P(None, tp_axis)
+            if "wo" in joined or "wo_mlp" in joined:
+                return P(tp_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

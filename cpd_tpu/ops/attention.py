@@ -1,0 +1,115 @@
+"""Attention ops: fused local attention + ring attention for sequence/context
+parallelism.
+
+The reference has no attention at all (SURVEY.md §5 "long-context: absent" —
+its workloads are CNNs), so this is new capability, built TPU-first:
+
+* `local_attention` — plain blockwise softmax attention on one device;
+  fp32 logits/softmax (MXU matmuls in the input dtype, accumulation fp32).
+* `ring_attention` — sequence-parallel attention inside `shard_map`: Q
+  stays resident, K/V blocks rotate around the `sp` axis ring via
+  `lax.ppermute` while an online-softmax accumulator (running max m,
+  normalizer l, output o) folds in one block per step.  Communication is
+  W-1 ppermutes of the local K/V — the ICI-friendly pattern of Ring
+  Attention (Liu et al.; see PAPERS.md) — and peak memory is O(T_local^2)
+  per device instead of O(T^2).
+
+Causality with a sharded sequence: rank r holds tokens
+[r*T_local, (r+1)*T_local); at ring step s it receives the K/V block of
+rank (r-s) mod W.  Blocks from lower-ranked sources attend fully, the own
+block (s=0) uses the triangular mask, and blocks from higher-ranked
+sources are skipped (masked to -inf; their compute overlaps the permute).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["local_attention", "ring_attention"]
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
+                  # when a full row is masked (the all-masked ring step)
+
+
+def _causal_mask(tq: int, tk: int, q_off, k_off) -> jnp.ndarray:
+    """(tq, tk) bool mask: query global position >= key global position."""
+    qi = q_off + jnp.arange(tq)[:, None]
+    ki = k_off + jnp.arange(tk)[None, :]
+    return qi >= ki
+
+
+def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True,
+                    q_offset=0, k_offset=0) -> jnp.ndarray:
+    """Softmax attention for (B, T, H, D) tensors on one device.
+
+    fp32 softmax; returns q.dtype.  Offsets give the tokens' global
+    positions (used by ring steps and by tests comparing shard vs full)."""
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = _causal_mask(q.shape[1], k.shape[1], q_offset, k_offset)
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = True) -> jnp.ndarray:
+    """Sequence-parallel attention; call inside shard_map with the sequence
+    dim sharded over `axis_name`.
+
+    q, k, v: (B, T_local, H, D) local shards.  Returns (B, T_local, H, D).
+    Differentiable (ppermute transposes to the reverse permute, so the
+    backward pass is itself a ring).
+    """
+    axis_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    q_off = my * t_local
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, s):
+        o, m, l, k_cur, v_cur = carry
+        src = (my - s) % axis_size           # whose K/V block we hold
+        k_off = src * t_local
+
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = _causal_mask(t_local, t_local, q_off, k_off)
+            logits = jnp.where(mask[None, None], logits, _NEG_INF)
+
+        # online softmax update (flash-attention recurrence)
+        m_new = jnp.maximum(m, logits.max(axis=-1))          # (B,H,Tq)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])               # (B,H,Tq,Tk)
+        l_new = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur,
+                        preferred_element_type=jnp.float32)
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+
+        # rotate K/V to the next rank (skip after the last fold: the scan
+        # body is uniform, so we permute every step; the final permute
+        # restores the original placement, which XLA can DCE if unused)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros(q.shape[:2] + (q.shape[2], v.shape[-1]), jnp.float32)
+    m0 = jnp.full((q.shape[0], q.shape[2], t_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], q.shape[2], t_local), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k.astype(q.dtype), v.astype(q.dtype)),
+        jnp.arange(axis_size))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

@@ -6,6 +6,7 @@ from .step import (cross_entropy_loss, make_eval_step, make_train_step,
 from .optim import lars, make_optimizer, sgd
 from .schedules import iter_table, piecewise_linear, warmup_step_decay
 from .metrics import AverageMeter, Timer, accuracy
+from .lm import lm_state_specs, make_lm_train_step
 
 __all__ = [
     "TrainState", "create_train_state",
@@ -14,6 +15,7 @@ __all__ = [
     "lars", "make_optimizer", "sgd",
     "iter_table", "piecewise_linear", "warmup_step_decay",
     "AverageMeter", "Timer", "accuracy",
+    "make_lm_train_step", "lm_state_specs",
     "CheckpointManager", "save_checkpoint", "restore_latest",
 ]
 

@@ -1,0 +1,191 @@
+"""LM train step over a ("dp","sp","tp") mesh — the long-context /
+multi-axis companion of train/step.py.
+
+One jitted shard_map program per config, composing every parallel axis the
+framework supports:
+
+* dp — data parallelism with the reference's quantized gradient all-reduce
+  (APS / ordered / Kahan, parallel/dist.py) — the low-precision collective
+  is the framework's core capability (reference dist_util.py:22-89);
+* sp — sequence parallelism: tokens sharded on T, Ring Attention inside
+  the model (ops/attention.py), plus an fp32 `psum` of gradients over sp
+  (each sp rank sees different tokens);
+* tp — Megatron tensor parallelism: params sharded per
+  `lm_param_specs`, activations replicated between the per-block psums;
+  replicated-param gradients are `psum`'d over tp, sharded-param gradients
+  are already complete on their shard.
+
+Gradient flow: local grads → psum over sp (all) → psum over tp
+(replicated params only) → quantized sum_gradients over dp → optimizer.
+The optimizer update runs shard-local, which is exact for the elementwise
+SGD family (train/optim.py); LARS trust ratios would need global norms —
+use sgd/nesterov here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.transformer import lm_param_specs
+from ..parallel.dist import sum_gradients
+from ..parallel.emulate import emulate_node_reduce
+from .state import TrainState
+
+__all__ = ["make_lm_train_step", "make_lm_eval_step", "lm_state_specs"]
+
+
+def lm_state_specs(state: TrainState, tp_axis: str = "tp") -> TrainState:
+    """PartitionSpec pytree shaped like `state`: params (and their optimizer
+    momentum mirror) follow the Megatron rules, scalars replicated."""
+    p_specs = lm_param_specs(state.params, tp_axis)
+    params_td = jax.tree.structure(state.params)
+
+    def mirror(obj):
+        # Structural matching: any optimizer-state subtree whose pytree
+        # structure equals the params' (momentum/mu/nu mirrors) takes the
+        # param specs wholesale; containers recurse; everything else
+        # (counters, scalars) is replicated.  No shape-based matching —
+        # same-shaped-but-differently-sharded leaves must not collide.
+        if jax.tree.structure(obj) == params_td:
+            return p_specs
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # NamedTuple
+            return type(obj)(*(mirror(x) for x in obj))
+        if isinstance(obj, (tuple, list)):
+            return type(obj)(mirror(x) for x in obj)
+        if isinstance(obj, dict):
+            return {k: mirror(v) for k, v in obj.items()}
+        return P()
+
+    return TrainState(step=P(), params=p_specs, batch_stats=P(),
+                      opt_state=mirror(state.opt_state))
+
+
+def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
+                       *, axis_dp: str = "dp", axis_sp: str = "sp",
+                       axis_tp: str = "tp", emulate_node: int = 1,
+                       use_aps: bool = False, grad_exp: int = 8,
+                       grad_man: int = 23, use_kahan: bool = False,
+                       mode: str = "faithful", donate: bool = True):
+    """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
+
+    tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
+    (dp, sp).  Loss is next-token CE averaged over all target positions.
+    """
+    p_spec_cache: dict = {}
+
+    def step_fn(state: TrainState, tokens, targets):
+        def loss_of(params, toks, tgts):
+            logits = model.apply({"params": params}, toks, train=True)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgts)                       # (B_local, T_local)
+            local_sum = ce.sum()
+            local_n = jnp.float32(ce.size)
+            # Normalizer includes the tp axis: the loss is computed
+            # redundantly on every tp rank and shard_map's transpose of the
+            # forward tp-psums sums those redundant cotangents, so without
+            # the /tp every gradient comes out exactly tp-times too large
+            # (verified against single-device grads).
+            global_n = lax.psum(local_n, (axis_dp, axis_sp, axis_tp))
+            # normalize by the emulated-cluster size too (mix.py:239's
+            # divide-so-the-sum-is-the-mean, per micro-batch)
+            loss = local_sum / global_n / emulate_node
+            hits = jnp.sum(jnp.argmax(logits, -1) == tgts)
+            return loss, (local_sum, local_n, hits)
+
+        n = emulate_node
+        mb = tokens.shape[0] // n
+        toks = tokens.reshape(n, mb, tokens.shape[1])
+        tgts = targets.reshape(n, mb, targets.shape[1])
+
+        def micro(_, xy):
+            tk, tg = xy
+            (_, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(state.params, tk, tg)
+            return None, (grads, *aux)
+
+        _, (stacked, sums, ns, hits) = lax.scan(micro, None, (toks, tgts))
+
+        # --- cross-axis gradient reduction (see module docstring) ---
+        specs = lm_param_specs(state.params, axis_tp)
+
+        def sp_tp_reduce(stacked_g, spec):
+            g = lax.psum(stacked_g, axis_sp)
+            if spec == P():                 # replicated param: finish tp sum
+                g = lax.psum(g, axis_tp)
+            return g
+
+        stacked = jax.tree.map(sp_tp_reduce, stacked, specs)
+        local = emulate_node_reduce(stacked, n, use_aps, grad_exp, grad_man)
+        reduced = sum_gradients(local, axis_dp, use_aps=use_aps,
+                                grad_exp=grad_exp, grad_man=grad_man,
+                                use_kahan=use_kahan, mode=mode)
+
+        updates, new_opt = tx.update(reduced, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               batch_stats=state.batch_stats,
+                               opt_state=new_opt)
+        # metrics use the dp/sp token count only (tp ranks duplicate the
+        # same tokens, and these psums exclude tp)
+        total_n = lax.psum(ns.sum(), (axis_dp, axis_sp))
+        metrics = {
+            "loss": lax.psum(sums.sum(), (axis_dp, axis_sp)) / total_n,
+            "accuracy": lax.psum(hits.sum().astype(jnp.float32),
+                                 (axis_dp, axis_sp)) / total_n,
+        }
+        return new_state, metrics
+
+    def build(state_template: TrainState):
+        specs = lm_state_specs(state_template, axis_tp)
+        data_spec = P(axis_dp, axis_sp)
+        shard_fn = jax.shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(specs, data_spec, data_spec),
+            out_specs=(specs, P()),
+            check_vma=False)
+        return jax.jit(shard_fn, donate_argnums=(0,) if donate else ())
+
+    def stepper(state, tokens, targets):
+        key = jax.tree.structure(state)
+        if key not in p_spec_cache:
+            p_spec_cache[key] = build(state)
+        return p_spec_cache[key](state, tokens, targets)
+
+    return stepper
+
+
+def make_lm_eval_step(model, mesh: Mesh, *, axis_dp: str = "dp",
+                      axis_sp: str = "sp", axis_tp: str = "tp"):
+    """Jitted ``(state, tokens, targets) -> {'loss','accuracy'}`` over the
+    same dp x sp x tp sharding as the train step (no grads, no update)."""
+    cache: dict = {}
+
+    def eval_fn(state: TrainState, tokens, targets):
+        logits = model.apply({"params": state.params}, tokens, train=False)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        hits = jnp.sum(jnp.argmax(logits, -1) == targets)
+        total_n = lax.psum(jnp.float32(ce.size), (axis_dp, axis_sp))
+        return {
+            "loss": lax.psum(ce.sum(), (axis_dp, axis_sp)) / total_n,
+            "accuracy": lax.psum(hits.astype(jnp.float32),
+                                 (axis_dp, axis_sp)) / total_n,
+        }
+
+    def runner(state, tokens, targets):
+        key = jax.tree.structure(state)
+        if key not in cache:
+            specs = lm_state_specs(state, axis_tp)
+            data_spec = P(axis_dp, axis_sp)
+            cache[key] = jax.jit(jax.shard_map(
+                eval_fn, mesh=mesh,
+                in_specs=(specs, data_spec, data_spec),
+                out_specs=P(), check_vma=False))
+        return cache[key](state, tokens, targets)
+
+    return runner

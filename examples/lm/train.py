@@ -1,0 +1,152 @@
+"""Transformer-LM trainer over a dp x sp x tp mesh — the long-context /
+multi-axis entry point.
+
+No reference counterpart (the reference is CNN-only, SURVEY.md §5); this
+CLI demonstrates the framework's full parallelism surface in one command:
+ring-attention sequence parallelism, Megatron tensor parallelism, and the
+reference's quantized APS gradient all-reduce on the data axis
+(--use_APS/--grad_exp/--grad_man/--use_kahan/--emulate_node, same flags as
+every other trainer).
+
+    python examples/lm/train.py --dp 2 --sp 2 --tp 2 --seq-len 2048 \
+        --use_APS --grad_exp 5 --grad_man 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="cpd_tpu transformer LM")
+    p.add_argument("--dp", default=0, type=int,
+                   help="data-parallel size (0 = all remaining devices)")
+    p.add_argument("--sp", default=1, type=int, help="sequence-parallel")
+    p.add_argument("--tp", default=1, type=int, help="tensor-parallel")
+    p.add_argument("--vocab-size", default=256, type=int)
+    p.add_argument("--d-model", default=256, type=int)
+    p.add_argument("--n-layers", default=4, type=int)
+    p.add_argument("--n-heads", default=8, type=int)
+    p.add_argument("--seq-len", default=256, type=int)
+    p.add_argument("--batch-size", default=8, type=int,
+                   help="sequences per dp rank per micro-step")
+    p.add_argument("--max-iter", default=200, type=int)
+    p.add_argument("--base-lr", default=0.01, type=float)
+    p.add_argument("--warmup-iters", default=20, type=int)
+    p.add_argument("--print-freq", default=10, type=int)
+    p.add_argument("--save-path", default="lm_ckpt")
+    p.add_argument("--val-freq", default=100, type=int)
+    # the reference-parity precision flags
+    p.add_argument("--grad_exp", default=8, type=int)
+    p.add_argument("--grad_man", default=23, type=int)
+    p.add_argument("--use_APS", action="store_true")
+    p.add_argument("--use_kahan", action="store_true")
+    p.add_argument("--emulate_node", default=1, type=int)
+    p.add_argument("--mode", default="faithful", choices=["faithful", "fast"])
+    p.add_argument("--dist", action="store_true")
+    return p
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.data.lm_data import SyntheticText
+    from cpd_tpu.models import transformer_lm
+    from cpd_tpu.parallel.dist import dist_init
+    from cpd_tpu.parallel.mesh import make_mesh
+    from cpd_tpu.train import (create_train_state, make_lm_train_step,
+                               make_optimizer, warmup_step_decay)
+    from cpd_tpu.train.lm import make_lm_eval_step
+    from cpd_tpu.utils import ProgressPrinter, ScalarWriter
+
+    rank, world = dist_init() if args.dist else (0, 1)
+    mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
+    dp = mesh.shape["dp"]
+
+    if args.seq_len % args.sp:
+        raise ValueError(f"seq-len {args.seq_len} not divisible by sp={args.sp}")
+    if args.n_heads % args.tp:
+        raise ValueError(f"n-heads {args.n_heads} not divisible by tp={args.tp}")
+    if args.d_model % args.n_heads:
+        raise ValueError(f"d-model {args.d_model} not divisible by "
+                         f"n-heads {args.n_heads}")
+    if (args.d_model // args.n_heads) % 2:
+        raise ValueError(f"head dim {args.d_model // args.n_heads} must be "
+                         "even (RoPE splits it in half)")
+
+    model_kw = dict(vocab_size=args.vocab_size, d_model=args.d_model,
+                    n_layers=args.n_layers, n_heads=args.n_heads)
+    model = transformer_lm(tp_axis="tp" if args.tp > 1 else None,
+                           sp_axis="sp" if args.sp > 1 else None,
+                           tp_size=args.tp, **model_kw)
+    init_model = transformer_lm(**model_kw)
+
+    schedule = warmup_step_decay(args.base_lr, args.warmup_iters,
+                                 [args.max_iter * 2], warmup_from=0.0)
+    tx = make_optimizer("sgd", schedule, momentum=0.9)
+
+    ds = SyntheticText(n=4096, seq_len=args.seq_len,
+                       vocab_size=args.vocab_size)
+    global_batch = args.batch_size * dp * args.emulate_node
+
+    sample = jnp.zeros((1, args.seq_len), jnp.int32)
+    state = create_train_state(init_model, tx, sample, jax.random.PRNGKey(0))
+    step = make_lm_train_step(
+        model, tx, mesh, emulate_node=args.emulate_node,
+        use_aps=args.use_APS, grad_exp=args.grad_exp,
+        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode)
+    eval_step = make_lm_eval_step(model, mesh)
+    # held-out tail of the synthetic corpus for validation
+    val_idx = np.arange(len(ds) - args.batch_size * dp, len(ds))
+    val_toks, val_tgts = ds.batch(val_idx, seed=-1)
+
+    def validate(it):
+        m = eval_step(state, jnp.asarray(val_toks), jnp.asarray(val_tgts))
+        if rank == 0:
+            print(f"Val [{it}]: loss {float(m['loss']):.4f} "
+                  f"acc {100 * float(m['accuracy']):.2f}", flush=True)
+        writer.add_scalar("val/loss", float(m["loss"]), it)
+        return m
+
+    writer = ScalarWriter(os.path.join(args.save_path, "logs"), rank=rank)
+    progress = ProgressPrinter(args.max_iter, args.print_freq, rank=rank)
+    rng = np.random.RandomState(0)
+    last = {}
+    t0 = time.time()
+    for it in range(1, args.max_iter + 1):
+        idx = rng.randint(0, len(ds), size=global_batch)
+        toks, tgts = ds.batch(idx, seed=it)
+        state, m = step(state, jnp.asarray(toks), jnp.asarray(tgts))
+        last = {k: float(v) for k, v in m.items()}
+        progress.maybe_print(it, Loss=last["loss"],
+                             Acc=100 * last["accuracy"],
+                             TokPerSec=global_batch * args.seq_len * it
+                             / max(time.time() - t0, 1e-9))
+        writer.add_scalar("train/loss", last["loss"], it)
+        if it % args.val_freq == 0 or it == args.max_iter:
+            validate(it)
+    jax.block_until_ready(state.params)
+    dt = time.time() - t0
+    if rank == 0:
+        print(f"done: {args.max_iter} iters in {dt:.1f}s "
+              f"({args.max_iter * global_batch * args.seq_len / dt:.0f} "
+              f"tok/s) final loss {last.get('loss', float('nan')):.4f}")
+    writer.close()
+    return {"step": args.max_iter, **last}
+
+
+if __name__ == "__main__":
+    main()

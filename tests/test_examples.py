@@ -194,3 +194,15 @@ def test_seg_loss_ignores_ignore_label():
     labels = jnp.array([[[0, 255], [255, 255]]])
     # only one valid pixel, uniform logits -> CE = log(3)
     assert np.isclose(float(loss_fn(logits, labels)), np.log(3), atol=1e-6)
+
+
+def test_lm_trainer_smoke(tmp_path):
+    from lm.train import main
+
+    res = main(["--dp", "2", "--sp", "2", "--tp", "2", "--seq-len", "32",
+                "--d-model", "32", "--n-layers", "2", "--n-heads", "4",
+                "--vocab-size", "64", "--batch-size", "2", "--max-iter", "3",
+                "--use_APS", "--grad_exp", "5", "--grad_man", "2",
+                "--save-path", str(tmp_path / "lm"), "--mode", "fast"])
+    assert res["step"] == 3
+    assert math.isfinite(res["loss"])
