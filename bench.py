@@ -8,21 +8,79 @@ ResNet-50 at 224x224, batch 32/chip (main.py:32-33), bf16 compute / fp32
 master params, with the e5m2 APS gradient pipeline engaged exactly as the
 reference's flagship config runs it (--use_APS --grad_exp 5 --grad_man 2).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — plus an
+"error" field (value null) if the TPU cannot be brought up, instead of a
+traceback (round-1 lesson: BENCH_r01.json died with rc=1 on a flaky
+`UNAVAILABLE: TPU backend setup/compile error`, VERDICT.md weak-item 1).
+
+Hardening — the parent/child watchdog design:
+  * the measurement runs in a CHILD process; the parent enforces the budget
+    with SIGKILL.  This is the only reliable guard: axon backend init has
+    been observed to hang inside native code, where SIGALRM handlers never
+    run because the C call never returns to the interpreter;
+  * the parent retries a failed/hung child (fresh process = fresh backend
+    registry, no cached-failure state);
+  * whatever happens, the parent's last act is printing a JSON line;
+  * persistent XLA compilation cache so driver re-runs skip compile;
+  * both reduction modes measured when time permits (faithful is the
+    flagship metric; fast reported alongside).
+
+Env knobs: BENCH_BUDGET_SECS (default 540), BENCH_PROFILE_DIR (write a
+jax.profiler trace of a few steps), BENCH_ITERS (default 20).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 133.0  # derived in BASELINE.md / SURVEY.md §6
+_CHILD_ENV = "_CPD_BENCH_CHILD"
 
 
-def main():
+def emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+class Deadline(Exception):
+    pass
+
+
+def _alarm_handler(signum, frame):
+    raise Deadline("bench deadline expired")
+
+
+def _measure(jax, step, state, x, y, iters: int):
+    """Compile (first call) then time `iters` steps, returning img/s."""
+    state, metrics = step(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return x.shape[0] * iters / dt, state
+
+
+def run_bench(budget_end: float, profile_dir: str | None = None):
     import jax
+
+    # the axon plugin ignores JAX_PLATFORMS, so offer an explicit override
+    # (smoke-testing the bench on CPU: BENCH_FORCE_PLATFORM=cpu)
+    force = os.environ.get("BENCH_FORCE_PLATFORM")
+    if force:
+        jax.config.update("jax_platforms", force)
+
+    from cpd_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+    devices = jax.devices()
     import jax.numpy as jnp
 
     from cpd_tpu.models import resnet50
@@ -30,42 +88,144 @@ def main():
     from cpd_tpu.train import (create_train_state, make_optimizer,
                                make_train_step, warmup_step_decay)
 
-    batch = 32
-    n_dev = len(jax.devices())
+    # BENCH_ARCH/BENCH_BATCH/BENCH_IMAGE_SIZE exist ONLY to smoke-test the
+    # bench plumbing on slow backends (CPU); the recorded metric is always
+    # the default resnet50 @ 224, batch 32/chip.
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    size = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    n_dev = len(devices)
     mesh = make_mesh(dp=n_dev)
 
-    model = resnet50(dtype=jnp.bfloat16)
+    if os.environ.get("BENCH_ARCH", "resnet50") == "resnet50":
+        model = resnet50(dtype=jnp.bfloat16)
+    else:
+        from cpd_tpu.models import get_model
+        model = get_model(os.environ["BENCH_ARCH"], num_classes=1000,
+                          dtype=jnp.bfloat16)
     schedule = warmup_step_decay(3.2, 500, [3000, 6000])  # main.py:237-252 shape
     tx = make_optimizer("sgd", schedule, momentum=0.9, weight_decay=1e-4)
 
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(batch * n_dev, 224, 224, 3).astype(np.float32),
+    x = jnp.asarray(rng.randn(batch * n_dev, size, size, 3).astype(np.float32),
                     jnp.bfloat16)
     y = jnp.asarray(rng.randint(0, 1000, batch * n_dev).astype(np.int32))
 
-    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
-    step = make_train_step(model, tx, mesh, use_aps=True, grad_exp=5,
-                           grad_man=2, mode="faithful", donate=True)
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    results = {}
+    # Flagship metric first (faithful mode — the reference's bit-exact
+    # ordered reduction); fast mode measured only if budget remains.
+    for mode in ("faithful", "fast"):
+        if mode != "faithful" and time.monotonic() > budget_end - 60:
+            break
+        # fresh state per mode: the step donates its state argument, so the
+        # buffers from the previous mode's run are deleted
+        state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+        step = make_train_step(model, tx, mesh, use_aps=True, grad_exp=5,
+                               grad_man=2, mode=mode, donate=True)
+        ips, _ = _measure(jax, step, state, x, y, iters)
+        results[mode] = ips / n_dev
+        if mode == "faithful" and profile_dir:
+            with jax.profiler.trace(profile_dir):
+                s2 = create_train_state(model, tx, x[:2],
+                                        jax.random.PRNGKey(0))
+                _measure(jax, step, s2, x, y, 3)
 
-    # warmup/compile
-    state, metrics = step(state, x, y)
-    jax.block_until_ready(metrics["loss"])
-
-    iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, x, y)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    img_per_sec_per_chip = batch * n_dev * iters / dt / n_dev
-    print(json.dumps({
+    per_chip = results["faithful"]
+    out = {
         "metric": "resnet50_train_img_per_sec_per_chip",
-        "value": round(img_per_sec_per_chip, 2),
+        "value": round(per_chip, 2),
         "unit": "img/s/chip",
-        "vs_baseline": round(img_per_sec_per_chip
-                             / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-    }))
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+        "n_devices": n_dev,
+        "platform": devices[0].platform,
+        "mode": "faithful",
+    }
+    if "fast" in results:
+        out["fast_mode_img_per_sec_per_chip"] = round(results["fast"], 2)
+    return out
+
+
+def child_main():
+    """Runs in the watchdog-supervised child: do the bench, print the JSON.
+    SIGALRM is a secondary guard for hangs that stay in Python; the parent's
+    SIGKILL covers hangs in native code."""
+    budget = float(os.environ.get("BENCH_BUDGET_SECS", "540"))
+    budget_end = time.monotonic() + budget
+    signal.signal(signal.SIGALRM, _alarm_handler)
+    signal.alarm(int(budget))
+    try:
+        out = run_bench(budget_end,
+                        profile_dir=os.environ.get("BENCH_PROFILE_DIR"))
+        emit(out)
+    except BaseException as e:  # noqa: BLE001 — a JSON line beats a traceback
+        emit({
+            "metric": "resnet50_train_img_per_sec_per_chip",
+            "value": None,
+            "unit": "img/s/chip",
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}",
+        })
+    finally:
+        signal.alarm(0)
+
+
+def _last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    if os.environ.get(_CHILD_ENV):
+        child_main()
+        return
+
+    budget = float(os.environ.get("BENCH_BUDGET_SECS", "540"))
+    deadline = time.monotonic() + budget
+    last_err = "no attempt ran"
+    for attempt in range(3):
+        remaining = deadline - time.monotonic()
+        if remaining < 60:
+            break
+        env = dict(os.environ)
+        env[_CHILD_ENV] = "1"
+        env["BENCH_BUDGET_SECS"] = str(int(remaining - 15))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+                capture_output=True, text=True, timeout=remaining - 5)
+        except subprocess.TimeoutExpired:
+            last_err = (f"attempt {attempt + 1}: child killed after "
+                        f"{int(remaining - 5)}s (backend init or compile "
+                        f"hang)")
+            print(f"# {last_err}", file=sys.stderr)
+            continue
+        out = _last_json_line(proc.stdout)
+        if out is not None and out.get("value") is not None:
+            emit(out)
+            return
+        if out is not None:
+            last_err = f"attempt {attempt + 1}: {out.get('error', 'null')}"
+        else:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            last_err = (f"attempt {attempt + 1}: child rc={proc.returncode} "
+                        f"{' | '.join(tail[-3:])}")
+        print(f"# {last_err}", file=sys.stderr)
+        time.sleep(5)
+
+    emit({
+        "metric": "resnet50_train_img_per_sec_per_chip",
+        "value": None,
+        "unit": "img/s/chip",
+        "vs_baseline": None,
+        "error": last_err,
+    })
 
 
 if __name__ == "__main__":

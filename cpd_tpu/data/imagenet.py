@@ -115,6 +115,13 @@ class ImageFolderDataset:
                 y0 = rng.randint(0, h - ch + 1)
                 img = img.crop((x0, y0, x0 + cw, y0 + ch))
                 break
+        else:
+            # torchvision fallback: center crop of the short side, so the
+            # final resize never distorts aspect ratio
+            side = min(w, h)
+            x0 = (w - side) // 2
+            y0 = (h - side) // 2
+            img = img.crop((x0, y0, x0 + side, y0 + side))
         img = img.resize((self.size, self.size))
         if rng.rand() < 0.5:
             img = img.transpose(0)  # FLIP_LEFT_RIGHT

@@ -1,13 +1,21 @@
 """Deterministic, iteration-based, resumable samplers.
 
 Parity with reference `example/ResNet18/utils/train_util.py`:
-  * DistributedGivenIterationSampler (train_util.py:159-222): generate
-    total_iter * batch_size indices by seed-0 shuffling the dataset repeated
-    ceil-many times, slice the whole schedule per rank, resume by skipping
-    `last_iter * batch_size`;
+  * DistributedGivenIterationSampler (train_util.py:159-222): bit-exact
+    `gen_new_list` — seed-0 RandomState, dataset indices capped at all_size
+    BEFORE tiling (the reference's `indices[:all_size]` quirk at :204,
+    which silently truncates datasets larger than the schedule), tiled to
+    all_size, ONE whole-schedule shuffle (:208), contiguous per-rank slice
+    (:209-210); resume by skipping `last_iter * batch_size`;
   * DistributedSampler (train_util.py:225-265): epoch-seeded randperm,
     padded to a multiple of world, strided per rank;
-  * GivenIterationSampler (train_util.py:110-156): the single-rank variant.
+  * GivenIterationSampler (train_util.py:110-156): the single-rank variant
+    (same gen_new_list with world_size=1).
+
+`np.random.RandomState(0).shuffle` is bit-identical to the reference's
+legacy `np.random.seed(0); np.random.shuffle` — the global generator IS a
+RandomState.  Index sequences are checked against a vendored transcript of
+the reference's output in tests/test_train.py.
 
 These are numpy index generators (no torch dependency); the trainer feeds
 the indices to whatever array-backed dataset it holds.
@@ -23,10 +31,27 @@ __all__ = ["GivenIterationSampler", "DistributedGivenIterationSampler",
            "DistributedEpochSampler"]
 
 
+def _gen_new_list(dataset_len: int, total_size: int, world_size: int,
+                  rank: int, seed: int) -> np.ndarray:
+    """Bit-exact transcription of the reference schedule recipe
+    (train_util.py:196-215): cap-at-all_size, tile, one shuffle, contiguous
+    rank slice."""
+    all_size = total_size * world_size
+    indices = np.arange(dataset_len)
+    indices = indices[:all_size]                    # the :204 cap quirk
+    num_repeat = (all_size - 1) // indices.shape[0] + 1
+    indices = np.tile(indices, num_repeat)
+    indices = indices[:all_size]
+    rng = np.random.RandomState(seed)               # == np.random.seed(0)
+    rng.shuffle(indices)                            # ONE global shuffle :208
+    beg = total_size * rank
+    return indices[beg:beg + total_size]
+
+
 class GivenIterationSampler:
     """Fixed-length schedule of total_iter*batch_size indices, seed-shuffled
-    (train_util.py:110-156).  Iterating yields single indices; `resume(it)`
-    skips the first `it` batches."""
+    (train_util.py:110-156).  Iterating yields single indices; `last_iter`
+    skips the first `last_iter + 1` batches on resume."""
 
     def __init__(self, dataset_len: int, total_iter: int, batch_size: int,
                  seed: int = 0, last_iter: int = -1):
@@ -38,13 +63,9 @@ class GivenIterationSampler:
         self.indices = self._gen_indices()
 
     def _gen_indices(self) -> np.ndarray:
-        total = self.total_iter * self.batch_size
-        repeats = -(-total // self.dataset_len)  # ceil
-        rng = np.random.RandomState(self.seed)
-        base = np.arange(self.dataset_len)
-        tiled = np.concatenate(
-            [base[rng.permutation(self.dataset_len)] for _ in range(repeats)])
-        return tiled[:total]
+        return _gen_new_list(self.dataset_len,
+                             self.total_iter * self.batch_size,
+                             world_size=1, rank=0, seed=self.seed)
 
     def __iter__(self) -> Iterator[int]:
         start = (self.last_iter + 1) * self.batch_size
@@ -75,15 +96,10 @@ class DistributedGivenIterationSampler(GivenIterationSampler):
         super().__init__(dataset_len, total_iter, batch_size, seed, last_iter)
 
     def _gen_indices(self) -> np.ndarray:
-        total = self.total_iter * self.batch_size * self.world_size
-        repeats = -(-total // self.dataset_len)
-        rng = np.random.RandomState(self.seed)  # seed 0 default, :200
-        base = np.arange(self.dataset_len)
-        tiled = np.concatenate(
-            [base[rng.permutation(self.dataset_len)] for _ in range(repeats)])
-        tiled = tiled[:total]
-        per_rank = self.total_iter * self.batch_size
-        return tiled[self.rank * per_rank:(self.rank + 1) * per_rank]
+        return _gen_new_list(self.dataset_len,
+                             self.total_iter * self.batch_size,
+                             world_size=self.world_size, rank=self.rank,
+                             seed=self.seed)
 
 
 class DistributedEpochSampler:

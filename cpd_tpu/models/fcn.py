@@ -67,19 +67,16 @@ class FCN(nn.Module):
     def __call__(self, x, train: bool = True):
         h, w = x.shape[1], x.shape[2]
         backbone = ResNet(stage_sizes=self.stage_sizes, block=Bottleneck,
-                          output_stride=8, features_only=True,
+                          output_stride=8, feature_stages=(3, 4),
                           dtype=self.dtype, param_dtype=self.param_dtype,
                           name="backbone")
-
-        # Capture both stage-3 (aux) and stage-4 (main) features by running
-        # the backbone module tree manually via its sow-free interface: the
-        # dilated ResNet returns stage-4; for the aux head we tap stage 3
-        # through a second head on the same features when aux is off-path.
-        feats = backbone(x, train=train)  # (B, h/8, w/8, 2048)
+        # stage-3 (1024ch) feeds the auxiliary head, stage-4 (2048ch) the
+        # decode head — mmseg's fcn_r50-d8 attaches aux to layer3.
+        feats3, feats4 = backbone(x, train=train)
 
         logits = FCNHead(self.num_classes, channels=self.head_channels,
                          dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="decode_head")(feats, train=train)
+                         name="decode_head")(feats4, train=train)
         logits = jax.image.resize(
             logits.astype(jnp.float32), (logits.shape[0], h, w,
                                          self.num_classes), "bilinear")
@@ -87,7 +84,7 @@ class FCN(nn.Module):
             return logits
         aux = FCNHead(self.num_classes, channels=256, num_convs=1,
                       dtype=self.dtype, param_dtype=self.param_dtype,
-                      name="aux_head")(feats, train=train)
+                      name="aux_head")(feats3, train=train)
         aux = jax.image.resize(
             aux.astype(jnp.float32), (aux.shape[0], h, w, self.num_classes),
             "bilinear")

@@ -94,13 +94,17 @@ class ResNet(nn.Module):
 
     `output_stride` < 32 switches trailing stages to dilated convs (stride 1,
     growing dilation) — the "-d8" trick FCN needs (see models/fcn.py).
-    `features_only` returns the stage-4 feature map instead of logits.
+    `features_only` returns the stage-4 feature map instead of logits;
+    `feature_stages` (1-indexed, e.g. (3, 4)) returns a tuple of those
+    stages' feature maps instead — the multi-stage mode FCN's auxiliary
+    head needs (mmseg's fcn_r50-d8 taps layer3).
     """
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     block: Any = Bottleneck
     num_classes: int = 1000
     output_stride: int = 32
     features_only: bool = False
+    feature_stages: Sequence[int] = ()
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -119,6 +123,7 @@ class ResNet(nn.Module):
         stride_so_far = 4
         dilation = 1
         widths = (64, 128, 256, 512)
+        stage_feats = {}
         for stage, blocks in enumerate(self.stage_sizes):
             want_stride = 1 if stage == 0 else 2
             if want_stride == 2 and stride_so_far >= self.output_stride:
@@ -133,7 +138,10 @@ class ResNet(nn.Module):
                                param_dtype=self.param_dtype,
                                name=f"layer{stage + 1}_block{block}")(
                                    x, train=train)
+            stage_feats[stage + 1] = x
 
+        if self.feature_stages:
+            return tuple(stage_feats[s] for s in self.feature_stages)
         if self.features_only:
             return x
         x = jnp.mean(x, axis=(1, 2))

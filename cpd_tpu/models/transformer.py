@@ -152,11 +152,12 @@ def lm_param_specs(params, tp_axis: str = "tp"):
 
     def spec(path, leaf):
         names = [str(getattr(k, "key", k)) for k in path]
-        joined = "/".join(names)
-        if names and names[-1] == "kernel":
-            if "wqkv" in joined or joined.endswith("wi/kernel"):
+        # Exact layer-name matching (not substring): a future param whose
+        # path merely *contains* "wo" must not silently get row-sharded.
+        if len(names) >= 2 and names[-1] == "kernel":
+            if names[-2] in ("wqkv", "wi"):
                 return P(None, tp_axis)
-            if "wo" in joined or "wo_mlp" in joined:
+            if names[-2] in ("wo", "wo_mlp"):
                 return P(tp_axis, None)
         return P()
 

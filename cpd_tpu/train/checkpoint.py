@@ -16,6 +16,7 @@ pytree.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional
 
@@ -40,17 +41,62 @@ class CheckpointManager:
                       "best_mode": "max"}
         options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                **kwargs)
+        self._dir = directory
         self._mgr = ocp.CheckpointManager(directory, options=options)
 
     def save(self, step: int, state: TrainState,
-             best_metric: Optional[float] = None, force: bool = False):
+             best_metric: Optional[float] = None, force: bool = False,
+             metadata: Optional[dict] = None):
         """Save at `step`; only the process-0 host writes (orbax handles
         multi-host coordination — the reference gates on rank==0 manually,
-        mix.py:345)."""
+        mix.py:345).
+
+        `metadata`: small JSON-able dict stored in a sidecar file next to
+        the checkpoint — e.g. the epoch number, so resume doesn't have to
+        re-derive it from step // iters_per_epoch (which breaks when batch
+        size / device count / --max-batches-per-epoch change between runs).
+        """
         metrics = ({"best_metric": float(best_metric)}
                    if best_metric is not None else None)
         self._mgr.save(step, args=ocp.args.StandardSave(state),
                        metrics=metrics, force=force)
+        if metadata is not None and jax.process_index() == 0:
+            tmp = os.path.join(self._dir, f".meta-{step}.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(metadata, f)
+            os.replace(tmp, os.path.join(self._dir, f"meta-{step}.json"))
+            self._gc_metadata(keep=step)
+
+    def _gc_metadata(self, keep: Optional[int] = None) -> None:
+        """Drop meta-*.json sidecars whose checkpoint was purged by orbax's
+        max_to_keep retention (best-effort; `keep` is the step being written
+        right now, whose orbax save may still be in flight)."""
+        live = set(self._mgr.all_steps())
+        if keep is not None:
+            live.add(keep)
+        for fname in os.listdir(self._dir):
+            if fname.startswith("meta-") and fname.endswith(".json"):
+                try:
+                    step = int(fname[len("meta-"):-len(".json")])
+                except ValueError:
+                    continue
+                if step not in live:
+                    try:
+                        os.remove(os.path.join(self._dir, fname))
+                    except OSError:
+                        pass
+
+    def metadata(self, step: Optional[int] = None) -> Optional[dict]:
+        """Sidecar metadata saved with `step` (default: latest), or None."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        path = os.path.join(self._dir, f"meta-{step}.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
 
     def wait(self):
         self._mgr.wait_until_finished()

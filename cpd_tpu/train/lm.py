@@ -77,6 +77,18 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
     (dp, sp).  Loss is next-token CE averaged over all target positions.
     """
+    # Guard: the optimizer update runs shard-local, which is only exact for
+    # elementwise transforms.  LARS trust ratios need *global* param/grad
+    # norms; over tp-sharded params the per-shard norms are wrong, so refuse
+    # rather than silently train with broken trust ratios.  (With tp=1 all
+    # params are replicated and grads fully reduced before the update, so
+    # per-shard norms ARE global norms — LARS is fine there.)
+    if getattr(tx, "norm_based", False) and mesh.shape.get(axis_tp, 1) > 1:
+        raise ValueError(
+            "norm-based optimizers (LARS) are not supported by the "
+            "tp-sharded LM step: trust ratios need global norms but the "
+            "update is shard-local (cpd_tpu/train/lm.py docstring). "
+            "Use sgd/nesterov here, or set tp=1.")
     p_spec_cache: dict = {}
 
     def step_fn(state: TrainState, tokens, targets):

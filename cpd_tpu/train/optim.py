@@ -78,6 +78,13 @@ def sgd(schedule: Callable, momentum: float = 0.9, weight_decay: float = 0.0,
     return optax.GradientTransformation(init, update)
 
 
+class NormBasedTransformation(optax.GradientTransformation):
+    """GradientTransformation whose update needs *global* parameter/gradient
+    norms (LARS trust ratios).  Shard-local steppers (train/lm.py) check this
+    flag and refuse, instead of silently computing per-shard norms."""
+    norm_based = True
+
+
 def lars(schedule: Callable, momentum: float = 0.9,
          weight_decay: float = 0.0, coefficient: float = 0.001,
          ) -> optax.GradientTransformation:
@@ -112,7 +119,7 @@ def lars(schedule: Callable, momentum: float = 0.9,
                             is_leaf=lambda t: isinstance(t, tuple))
         return updates, TorchSGDState(state.step + 1, bufs)
 
-    return optax.GradientTransformation(init, update)
+    return NormBasedTransformation(init, update)
 
 
 def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,

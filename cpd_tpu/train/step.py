@@ -43,7 +43,13 @@ from ..parallel.emulate import emulate_node_reduce
 from .state import TrainState
 
 __all__ = ["cross_entropy_loss", "seg_cross_entropy_loss",
-           "make_train_step", "make_eval_step"]
+           "seg_loss_with_aux", "make_train_step", "make_eval_step"]
+
+
+def _main_logits(out):
+    """Models with an auxiliary head return (main, aux); metrics and eval
+    use the main logits only (mmseg semantics: aux is train-time loss)."""
+    return out[0] if isinstance(out, tuple) else out
 
 
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -63,6 +69,20 @@ def seg_cross_entropy_loss(ignore_label: int = 255) -> Callable:
         safe = jnp.where(valid, labels, 0)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
         return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    return loss
+
+
+def seg_loss_with_aux(ignore_label: int = 255,
+                      aux_weight: float = 0.4) -> Callable:
+    """Main + aux_weight * auxiliary segmentation loss for models returning
+    (main_logits, aux_logits) — mmseg's fcn_r50-d8 trains the aux FCN head
+    on layer3 features at loss weight 0.4 (reference README.md:132-150)."""
+    base = seg_cross_entropy_loss(ignore_label)
+
+    def loss(out, labels: jnp.ndarray) -> jnp.ndarray:
+        main, aux = out
+        return base(main, labels) + aux_weight * base(aux, labels)
 
     return loss
 
@@ -125,7 +145,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                         for i, k in enumerate(rng_keys)}
             (_, (logits, new_stats, loss)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params, stats, x, y, rngs)
-            hit = jnp.argmax(logits, -1) == y
+            hit = jnp.argmax(_main_logits(logits), -1) == y
             if ignore_label is not None:
                 valid = y != ignore_label
                 correct = jnp.sum(hit & valid)
@@ -193,7 +213,7 @@ def make_eval_step(model, mesh: Mesh, *, axis_name: str = "dp",
         variables = {"params": state.params}
         if jax.tree.leaves(state.batch_stats):
             variables["batch_stats"] = state.batch_stats
-        logits = model.apply(variables, images, train=False)
+        logits = _main_logits(model.apply(variables, images, train=False))
         loss = loss_fn(logits, labels)
         top1 = jnp.sum(jnp.argmax(logits, -1) == labels)
         k = min(5, logits.shape[-1])

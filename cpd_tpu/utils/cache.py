@@ -1,0 +1,34 @@
+"""Persistent XLA compilation cache — one shared switch.
+
+Full-model train steps cost tens of seconds of XLA compile; caching them
+makes driver re-runs of the bench / dryrun / test suite near-free.  Used by
+bench.py, __graft_entry__.py and tests/conftest.py so the cache-dir logic
+lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_compile_cache", "default_cache_dir"]
+
+
+def default_cache_dir() -> str:
+    """<repo root>/.jax_cache (repo root = parent of the cpd_tpu package)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), ".jax_cache")
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Point jax's persistent compilation cache at `cache_dir` (default:
+    repo-root .jax_cache).  Best-effort: a jax without these flags just
+    skips the optimization."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          cache_dir or default_cache_dir())
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass

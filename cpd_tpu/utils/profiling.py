@@ -1,0 +1,63 @@
+"""jax.profiler trace hooks — SURVEY.md §5's TPU-native equivalent of the
+reference's wall-clock meters (AverageMeter windows, train_util.py:21-48;
+DavidNet Timer, utils.py:28-38).
+
+The reference only ever *times* steps; on TPU the profiler trace is
+strictly more informative (per-op HLO timeline, HBM traffic, ICI
+collectives) and costs nothing when off.  Every trainer exposes it as
+`--profile-dir DIR`: steps [start, start+num) are wrapped in a trace whose
+artifacts land under DIR (viewable in TensorBoard / Perfetto).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["StepProfiler"]
+
+
+class StepProfiler:
+    """Trace a window of training steps.
+
+    Call `step(it)` at the top of every iteration (1-based or 0-based —
+    only equality with the configured window matters) and `close()` after
+    the loop.  With `trace_dir=None` every call is a no-op.
+    """
+
+    def __init__(self, trace_dir: Optional[str], start: int = 2,
+                 num_steps: int = 3):
+        self.trace_dir = trace_dir
+        self.start = start
+        self.num_steps = num_steps
+        self._running = False
+        self._started = False
+
+    def step(self, it: int) -> None:
+        if not self.trace_dir:
+            return
+        import jax
+
+        if it == self.start:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._running = True
+            self._started = True
+        elif self._running and it >= self.start + self.num_steps:
+            jax.profiler.stop_trace()
+            self._running = False
+
+    def close(self) -> None:
+        """Stop a still-open trace (loop ended inside the window); warn if
+        the window never opened (run shorter than `start` steps)."""
+        if self._running:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._running = False
+        elif self.trace_dir and not self._started:
+            import sys
+
+            print(f"# profile window never opened: run ended before step "
+                  f"{self.start}; no trace written to {self.trace_dir}",
+                  file=sys.stderr)

@@ -49,6 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batches-per-epoch", default=None, type=int,
                    help="truncate epochs (smoke tests)")
     p.add_argument("--emulate_node", default=1, type=int)
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of a few steps here")
     p.add_argument("--mode", default="faithful",
                    choices=["faithful", "fast"])
     return p
@@ -67,7 +69,7 @@ def main(argv=None) -> dict:
     from cpd_tpu.train import (Timer, create_train_state, make_eval_step,
                                make_optimizer, make_train_step,
                                piecewise_linear)
-    from cpd_tpu.utils import TableLogger, TSVLogger
+    from cpd_tpu.utils import StepProfiler, TableLogger, TSVLogger
 
     rank, world = dist_init() if args.dist else (0, 1)
     mesh = data_parallel_mesh()
@@ -111,6 +113,8 @@ def main(argv=None) -> dict:
     table = TableLogger(rank=rank)
     tsv = TSVLogger()
     timer = Timer()
+    profiler = StepProfiler(args.profile_dir, start=3)
+    global_step = 0
     result = {}
     for epoch in range(1, args.epoch + 1):
         rng = np.random.RandomState(args.seed + epoch)
@@ -120,6 +124,8 @@ def main(argv=None) -> dict:
         train_loss = train_acc = 0.0
         n = 0
         for lo in range(0, len(order), global_batch):
+            global_step += 1
+            profiler.step(global_step)
             sel = order[lo + rank * host_batch:lo + (rank + 1) * host_batch]
             x, y = pipeline.batch(sel, seed=epoch)
             state, m = train_step(state, host_batch_to_global(x, mesh),
@@ -158,6 +164,7 @@ def main(argv=None) -> dict:
         }
         table.append(result)
         tsv.append(result)
+    profiler.close()
     if rank == 0:
         print(tsv)
     return result

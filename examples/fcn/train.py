@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-size", default=256, type=int)
     p.add_argument("--tiny-backbone", action="store_true",
                    help="1-block-per-stage backbone (smoke tests)")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of a few steps here")
+    p.add_argument("--aux-head", action="store_true",
+                   help="auxiliary FCN head on stage-3 features at loss "
+                        "weight 0.4 (mmseg fcn_r50-d8 default)")
+    p.add_argument("--aux-weight", default=0.4, type=float)
     return p
 
 
@@ -64,9 +70,9 @@ def main(argv=None) -> dict:
     from cpd_tpu.parallel.mesh import data_parallel_mesh
     from cpd_tpu.train import (create_train_state, make_optimizer,
                                make_train_step)
-    from cpd_tpu.train.step import seg_cross_entropy_loss
+    from cpd_tpu.train.step import seg_cross_entropy_loss, seg_loss_with_aux
     from cpd_tpu.train.schedules import piecewise_linear
-    from cpd_tpu.utils import ProgressPrinter, ScalarWriter
+    from cpd_tpu.utils import ProgressPrinter, ScalarWriter, StepProfiler
 
     rank, world = dist_init() if args.dist else (0, 1)
     mesh = data_parallel_mesh()
@@ -82,7 +88,7 @@ def main(argv=None) -> dict:
     tiny = ({"stage_sizes": (1, 1, 1, 1), "head_channels": 64}
             if args.tiny_backbone else {})
     model = fcn_r50_d8(num_classes=args.num_classes, dtype=jnp.bfloat16,
-                       **tiny)
+                       aux_head=args.aux_head, **tiny)
     tx = make_optimizer("sgd", schedule, momentum=args.momentum,
                         weight_decay=args.wd)
     state = create_train_state(
@@ -93,7 +99,8 @@ def main(argv=None) -> dict:
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
         grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
-        loss_fn=seg_cross_entropy_loss(ignore_label=255),
+        loss_fn=(seg_loss_with_aux(255, args.aux_weight) if args.aux_head
+                 else seg_cross_entropy_loss(ignore_label=255)),
         ignore_label=255, rng_keys=("dropout",))
 
     writer = ScalarWriter(os.path.join(args.save_path, "logs"), rank=rank)
@@ -102,8 +109,10 @@ def main(argv=None) -> dict:
     rng = np.random.RandomState(rank)
     host_batch = global_batch // world
     last = {}
+    profiler = StepProfiler(args.profile_dir, start=3)
     t0 = time.time()
     for it in range(1, args.max_iter + 1):
+        profiler.step(it)
         idx = rng.randint(0, len(ds), size=host_batch)
         x, y = ds.batch(idx, seed=it)
         state, m = step(state, host_batch_to_global(x, mesh),
@@ -113,6 +122,7 @@ def main(argv=None) -> dict:
                              PixAcc=100 * last["accuracy"])
         writer.add_scalar("train/loss", last["loss"], it)
     jax.block_until_ready(state.params)
+    profiler.close()
     if rank == 0:
         print(f"done: {args.max_iter} iters in {time.time()-t0:.1f}s "
               f"final loss {last.get('loss', float('nan')):.4f}")

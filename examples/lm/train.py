@@ -54,6 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--emulate_node", default=1, type=int)
     p.add_argument("--mode", default="faithful", choices=["faithful", "fast"])
     p.add_argument("--dist", action="store_true")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of a few steps here")
     return p
 
 
@@ -70,7 +72,7 @@ def main(argv=None) -> dict:
     from cpd_tpu.train import (create_train_state, make_lm_train_step,
                                make_optimizer, warmup_step_decay)
     from cpd_tpu.train.lm import make_lm_eval_step
-    from cpd_tpu.utils import ProgressPrinter, ScalarWriter
+    from cpd_tpu.utils import ProgressPrinter, ScalarWriter, StepProfiler
 
     rank, world = dist_init() if args.dist else (0, 1)
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
@@ -126,8 +128,12 @@ def main(argv=None) -> dict:
     rng = np.random.RandomState(0)
     last = {}
     t0 = time.time()
+    # training indices exclude the held-out validation tail
+    train_n = len(ds) - len(val_idx)
+    profiler = StepProfiler(args.profile_dir, start=3)
     for it in range(1, args.max_iter + 1):
-        idx = rng.randint(0, len(ds), size=global_batch)
+        profiler.step(it)
+        idx = rng.randint(0, train_n, size=global_batch)
         toks, tgts = ds.batch(idx, seed=it)
         state, m = step(state, jnp.asarray(toks), jnp.asarray(tgts))
         last = {k: float(v) for k, v in m.items()}
@@ -139,6 +145,7 @@ def main(argv=None) -> dict:
         if it % args.val_freq == 0 or it == args.max_iter:
             validate(it)
     jax.block_until_ready(state.params)
+    profiler.close()
     dt = time.time() - t0
     if rank == 0:
         print(f"done: {args.max_iter} iters in {dt:.1f}s "

@@ -59,6 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-root", default=None)
     p.add_argument("--max-iter", default=None, type=int,
                    help="override total iterations (smoke tests)")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of a few steps here")
     p.add_argument("--mode", default="faithful",
                    choices=["faithful", "fast"],
                    help="faithful: bit-ordered quantized reduction; "
@@ -80,7 +82,7 @@ def main(argv=None) -> dict:
     from cpd_tpu.train import (CheckpointManager, create_train_state,
                                make_eval_step, make_optimizer,
                                make_train_step, warmup_step_decay)
-    from cpd_tpu.utils import (ProgressPrinter, ScalarWriter,
+    from cpd_tpu.utils import (ProgressPrinter, ScalarWriter, StepProfiler,
                                format_validation_line, load_yaml_config,
                                merge_config_into_args)
 
@@ -192,8 +194,10 @@ def main(argv=None) -> dict:
     best_prec1 = 0.0
     last = {"loss": float("nan"), "accuracy": 0.0}
     step_no = start_iter
+    profiler = StepProfiler(args.profile_dir, start=start_iter + 2)
     t0 = time.time()
     for batch_idx in sampler.batches():
+        profiler.step(step_no)
         x, y = pipeline.batch(batch_idx, seed=step_no // iter_per_epoch)
         state, metrics = train_step(state, host_batch_to_global(x, mesh),
                                     host_batch_to_global(y, mesh))
@@ -210,6 +214,7 @@ def main(argv=None) -> dict:
             prec1 = 100 * val["top1"]
             best_prec1 = max(best_prec1, prec1)
             manager.save(step_no, state, best_metric=prec1)
+    profiler.close()
     manager.wait()
     writer.close()
     if rank == 0:

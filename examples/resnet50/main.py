@@ -60,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", default=224, type=int)
     p.add_argument("--mode", default="faithful",
                    choices=["faithful", "fast"])
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of a few steps here")
     return p
 
 
@@ -92,7 +94,8 @@ def main(argv=None) -> dict:
     from cpd_tpu.train import (CheckpointManager, create_train_state,
                                make_eval_step, make_optimizer,
                                make_train_step, warmup_step_decay)
-    from cpd_tpu.utils import ScalarWriter, format_validation_line
+    from cpd_tpu.utils import (ScalarWriter, StepProfiler,
+                               format_validation_line)
 
     rank, world = dist_init() if args.dist else (0, 1)
     mesh = data_parallel_mesh()
@@ -131,7 +134,19 @@ def main(argv=None) -> dict:
     restored = manager.restore(state)
     if restored is not None:                 # auto-resume (main.py:70-75)
         state = restored
-        start_epoch = int(restored.step) // iters_per_epoch
+        meta = manager.metadata()
+        latest = int(manager.latest_step() or 0)
+        if meta is not None and "epoch" in meta:
+            # exact epoch from checkpoint metadata — robust to batch size /
+            # device count / --max-batches-per-epoch changing between runs
+            start_epoch = int(meta["epoch"]) + 1
+        elif latest > args.epochs:
+            # legacy dir: indices were (epoch+1)*iters_per_epoch, no sidecar
+            start_epoch = latest // iters_per_epoch
+        else:
+            # checkpoints are epoch-indexed (reference's
+            # checkpoint-{epoch}.pth.tar, main.py:261-269)
+            start_epoch = latest
         if rank == 0:
             print(f"=> auto-resumed from epoch {start_epoch}")
 
@@ -150,12 +165,16 @@ def main(argv=None) -> dict:
     val_bs = args.val_batch_size * n_dev
     val_host = val_bs // world
     result = {}
+    profiler = StepProfiler(args.profile_dir, start=3)
+    global_it = 0
     for epoch in range(start_epoch, args.epochs):
         sampler.set_epoch(epoch)
         order = np.fromiter(iter(sampler), np.int64)
         t0 = time.time()
         train_loss = train_acc = 0.0
         for it in range(iters_per_epoch):
+            global_it += 1
+            profiler.step(global_it)
             idx = order[it * host_batch:(it + 1) * host_batch]
             x, y = train_ds.batch(idx, seed=epoch)
             state, m = train_step(
@@ -198,9 +217,15 @@ def main(argv=None) -> dict:
                                          100 * result["val_top5"]))
         writer.add_scalar("train/loss", result["train_loss"], epoch)
         writer.add_scalar("val/top1", result["val_top1"], epoch)
-        # per-epoch checkpoint, step-indexed by iteration (main.py:261-269)
-        manager.save((epoch + 1) * iters_per_epoch, state,
-                     best_metric=100 * result["val_top1"])
+        # per-epoch checkpoint, EPOCH-indexed like the reference's
+        # checkpoint-{epoch}.pth.tar (main.py:261-269) — a monotonic index
+        # even when iters_per_epoch changes between resumed runs (the
+        # training-step count lives inside state.step regardless)
+        manager.save(epoch + 1, state,
+                     best_metric=100 * result["val_top1"],
+                     metadata={"epoch": epoch,
+                               "iters_per_epoch": iters_per_epoch})
+    profiler.close()
     manager.wait()
     manager.close()
     writer.close()

@@ -20,5 +20,21 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: full-model train steps cost tens of seconds
+# of XLA compile each; caching them cuts suite wall time on re-runs from
+# ~10 min to ~1 min (VERDICT.md round-1 weak-item 3).
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from cpd_tpu.utils import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 assert jax.default_backend() == "cpu"
 assert len(jax.devices()) == 8
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: full-model tests (XLA compile heavy); deselect "
+        "with -m 'not slow' for the fast core suite")

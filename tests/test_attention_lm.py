@@ -152,6 +152,17 @@ def test_lm_train_step_dp_sp_tp():
     assert float(m["loss"]) < float(m1["loss"])
 
 
+def test_lm_step_rejects_norm_based_optimizer():
+    """LARS trust ratios need global norms; the shard-local LM update must
+    refuse it rather than silently compute per-shard norms."""
+    from cpd_tpu.train import make_lm_train_step, make_optimizer
+
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    tx = make_optimizer("lars", lambda s: 0.1)
+    with pytest.raises(ValueError, match="norm-based"):
+        make_lm_train_step(_tiny_lm(), tx, mesh)
+
+
 def test_lm_train_step_emulate_node():
     from cpd_tpu.train import (create_train_state, make_lm_train_step,
                                make_optimizer)

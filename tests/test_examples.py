@@ -19,6 +19,9 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "examples"))
 
+# every test here compiles a full trainer graph — the compile-heavy tier
+pytestmark = pytest.mark.slow
+
 
 def _write_tiny_cifar(tmp_path, n_train=512, n_test=64):
     """Drop a small real-format CIFAR-10 pickle tree under tmp_path."""
@@ -45,15 +48,22 @@ def tiny_cifar(tmp_path_factory):
     return _write_tiny_cifar(tmp_path_factory.mktemp("cifar"))
 
 
-def test_resnet18_trainer_aps_smoke(tiny_cifar, tmp_path, capsys):
+@pytest.mark.parametrize("mode", ["fast", "faithful"])
+def test_resnet18_trainer_aps_smoke(tiny_cifar, tmp_path, capsys, mode):
     from resnet18_cifar.train import main
 
     save = str(tmp_path / "ckpt")
+    prof = str(tmp_path / "trace")
+    extra = ["--profile-dir", prof] if mode == "fast" else []
     res = main(["--use_APS", "--grad_exp", "5", "--grad_man", "2",
                 "--emulate_node", "2", "--use_lars", "--arch", "tiny",
                 "--data-root", tiny_cifar, "--max-iter", "4",
                 "--batch_size", "2", "--val_freq", "4",
-                "--save_path", save, "--mode", "fast"])
+                "--save_path", save, "--mode", mode] + extra)
+    if mode == "fast":
+        # jax.profiler must have written trace artifacts for steps 3..4
+        found = [os.path.join(r, f) for r, _, fs in os.walk(prof) for f in fs]
+        assert found, "no profiler trace artifacts written"
     assert res["step"] == 4
     assert math.isfinite(res["loss"])
     out = capsys.readouterr().out
@@ -81,11 +91,12 @@ def test_resnet18_trainer_evaluate_flag(tiny_cifar):
 def test_davidnet_trainer_smoke(tiny_cifar, capsys):
     from davidnet.dawn import main
 
+    # faithful mode: the gather+ordered-scan collective end-to-end
     res = main(["--epoch", "2", "--batch_size", "16", "--arch", "tiny",
                 "--max-batches-per-epoch", "2", "--half", "1",
                 "--use_APS", "--grad_exp", "5", "--grad_man", "2",
                 "--loss_scale", "128", "--data-root", tiny_cifar,
-                "--mode", "fast"])
+                "--mode", "faithful"])
     assert res["epoch"] == 2
     assert math.isfinite(res["train loss"])
     out = capsys.readouterr().out
@@ -102,7 +113,7 @@ def test_resnet50_trainer_smoke_and_resume(tmp_path, capsys):
             "--max-batches-per-epoch", "2", "--image-size", "32",
             "--use-APS", "--grad_exp", "5", "--grad_man", "2",
             "--emulate-node", "2", "--checkpoint-dir", ckpt,
-            "--log-dir", logs, "--mode", "fast"]
+            "--log-dir", logs, "--mode", "faithful"]
     res = main(argv)
     assert res["epoch"] == 0
     assert math.isfinite(res["train_loss"])
@@ -116,11 +127,13 @@ def test_resnet50_trainer_smoke_and_resume(tmp_path, capsys):
 def test_fcn_trainer_smoke(tmp_path):
     from fcn.train import main
 
+    # faithful mode + aux head: stage-3 auxiliary loss through the full
+    # quantized pipeline
     res = main(["--crop-size", "32", "--batch-size", "1", "--max-iter", "2",
                 "--num-classes", "5", "--synthetic-size", "16",
-                "--tiny-backbone",
+                "--tiny-backbone", "--aux-head",
                 "--use_APS", "--grad_exp", "5", "--grad_man", "2",
-                "--save-path", str(tmp_path / "fcn"), "--mode", "fast"])
+                "--save-path", str(tmp_path / "fcn"), "--mode", "faithful"])
     assert res["step"] == 2
     assert math.isfinite(res["loss"])
     assert 0.0 <= res["accuracy"] <= 1.0
@@ -203,6 +216,6 @@ def test_lm_trainer_smoke(tmp_path):
                 "--d-model", "32", "--n-layers", "2", "--n-heads", "4",
                 "--vocab-size", "64", "--batch-size", "2", "--max-iter", "3",
                 "--use_APS", "--grad_exp", "5", "--grad_man", "2",
-                "--save-path", str(tmp_path / "lm"), "--mode", "fast"])
+                "--save-path", str(tmp_path / "lm"), "--mode", "faithful"])
     assert res["step"] == 3
     assert math.isfinite(res["loss"])

@@ -78,6 +78,36 @@ def test_fcn_r50_d8_output_stride_and_head():
     assert out.shape == (1, 65, 65, 19)  # upsampled back to input size
 
 
+def test_fcn_aux_head_taps_stage3():
+    """Aux head: distinct logits from the main head, gradients reaching
+    stage-3 (and NOT stage-4) backbone params — mmseg fcn_r50-d8 attaches
+    aux to layer3 (VERDICT.md round-1 weak-item 4)."""
+    model = fcn_r50_d8(num_classes=5, aux_head=True,
+                       stage_sizes=(1, 1, 1, 1), head_channels=16)
+    x = jnp.linspace(0, 1, 1 * 33 * 33 * 3).reshape(1, 33, 33, 3)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    main, aux = model.apply(variables, x, train=False)
+    assert main.shape == aux.shape == (1, 33, 33, 5)
+    assert not jnp.allclose(main, aux)
+
+    # gradient of the aux loss alone w.r.t. backbone params: nonzero at
+    # stage-3 (aux taps layer3), zero at stage-4 (aux must not see layer4)
+    def aux_loss(params):
+        _, a = model.apply({"params": params,
+                            "batch_stats": variables["batch_stats"]},
+                           x, train=False)
+        return (a ** 2).mean()
+
+    grads = jax.grad(aux_loss)(variables["params"])
+    bb = grads["backbone"]
+    g3 = sum(float(jnp.abs(g).sum())
+             for g in jax.tree.leaves(bb["layer3_block0"]))
+    g4 = sum(float(jnp.abs(g).sum())
+             for g in jax.tree.leaves(bb["layer4_block0"]))
+    assert g3 > 0.0
+    assert g4 == 0.0
+
+
 def test_registry():
     assert get_model("res_cifar").__class__.__name__ == "ResNetCIFAR"
     with pytest.raises(KeyError):

@@ -131,6 +131,30 @@ def test_distributed_sampler_blocks_disjoint_schedules():
     assert len(all_idx) == 5 * 8 * world
 
 
+def test_sampler_bit_exact_vs_reference_transcript():
+    """Vendored transcript of the reference's gen_new_list output
+    (train_util.py:196-215 run verbatim with np.random.seed(0)): tiles the
+    capped dataset, one whole-schedule shuffle, contiguous rank slice.
+    Covers the `indices[:all_size]` cap-before-tile quirk (dataset larger
+    than the schedule, case B)."""
+    # case A: dataset 10, 4 iters x batch 3, world 2
+    expect_a = {
+        0: [1, 0, 2, 4, 0, 1, 3, 3, 6, 8, 6, 7],
+        1: [4, 2, 5, 8, 9, 7, 9, 3, 0, 1, 5, 2],
+    }
+    for rank, expected in expect_a.items():
+        s = DistributedGivenIterationSampler(
+            10, total_iter=4, batch_size=3, world_size=2, rank=rank, seed=0)
+        np.testing.assert_array_equal(s.indices, expected)
+    # case B: dataset (50) larger than the schedule (8) — the reference caps
+    # indices at all_size BEFORE tiling, so only the first 8 images appear
+    s = GivenIterationSampler(50, total_iter=2, batch_size=4, seed=0)
+    np.testing.assert_array_equal(s.indices, [6, 2, 1, 7, 3, 0, 5, 4])
+    # case C: single-rank, 7 elements, 3 iters x batch 2
+    s = GivenIterationSampler(7, total_iter=3, batch_size=2, seed=0)
+    np.testing.assert_array_equal(s.indices, [5, 2, 1, 3, 0, 4])
+
+
 # ----------------------------------------------------- end-to-end train step
 
 @pytest.fixture(scope="module")
@@ -145,6 +169,7 @@ def _data(batch, seed=0):
     return jnp.asarray(x), jnp.asarray(y)
 
 
+@pytest.mark.slow
 def test_train_step_runs_and_learns(mesh):
     model = resnet18_cifar()
     tx = make_optimizer("sgd", lambda s: jnp.float32(0.05), momentum=0.9)
@@ -159,6 +184,7 @@ def test_train_step_runs_and_learns(mesh):
     assert losses[-1] < losses[0], losses  # same batch -> loss must drop
 
 
+@pytest.mark.slow
 def test_train_step_emulate_node_equivalence(mesh):
     """emulate_node=2 with fp32 formats must equal one big batch in grad
     direction: with (8,23) the quantized accumulation is near-identity, so
@@ -183,6 +209,7 @@ def test_train_step_emulate_node_equivalence(mesh):
     assert np.allclose(p1, p2, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_train_step_quantized_path(mesh):
     model = davidnet()
     tx = make_optimizer("sgd", lambda s: jnp.float32(0.01))
