@@ -62,9 +62,8 @@ def test_davidnet_logit_scale():
 def test_resnet50_shapes_and_params():
     model = resnet50()
     x = jnp.zeros((1, 64, 64, 3))  # small spatial for CPU test speed
-    _, out = _init_and_apply(model, x)
+    variables, out = _init_and_apply(model, x)
     assert out.shape == (1, 1000)
-    variables = model.init(jax.random.PRNGKey(0), x, train=False)
     n = sum(p.size for p in jax.tree.leaves(variables["params"]))
     # torchvision resnet50: 25,557,032 params
     assert 25_400_000 < n < 25_700_000, n
