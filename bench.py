@@ -69,7 +69,10 @@ def _measure(jax, step, state, x, y, iters: int):
     return x.shape[0] * iters / dt, state
 
 
-def run_bench(budget_end: float, profile_dir: str | None = None):
+def run_bench(budget_end: float, profile_dir: str | None = None,
+              partial: dict | None = None):
+    if partial is None:
+        partial = {}
     import jax
 
     # the axon plugin ignores JAX_PLATFORMS, so offer an explicit override
@@ -113,36 +116,44 @@ def run_bench(budget_end: float, profile_dir: str | None = None):
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     results = {}
     # Flagship metric first (faithful mode — the reference's bit-exact
-    # ordered reduction); fast mode measured only if budget remains.
+    # ordered reduction); fast mode and the optional profile trace are
+    # budget-gated EXTRAS.  As soon as the flagship number exists it is
+    # recorded into `partial`, so a deadline/crash during an extra degrades
+    # to a valid result instead of discarding the measurement (round-2
+    # review finding).
+    faithful_step = None
+    # fresh state per mode: the step donates its state argument, so the
+    # buffers from the previous mode's run are deleted
     for mode in ("faithful", "fast"):
         if mode != "faithful" and time.monotonic() > budget_end - 60:
             break
-        # fresh state per mode: the step donates its state argument, so the
-        # buffers from the previous mode's run are deleted
         state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
         step = make_train_step(model, tx, mesh, use_aps=True, grad_exp=5,
                                grad_man=2, mode=mode, donate=True)
         ips, _ = _measure(jax, step, state, x, y, iters)
         results[mode] = ips / n_dev
-        if mode == "faithful" and profile_dir:
-            with jax.profiler.trace(profile_dir):
-                s2 = create_train_state(model, tx, x[:2],
-                                        jax.random.PRNGKey(0))
-                _measure(jax, step, s2, x, y, 3)
+        if mode == "faithful":
+            faithful_step = step
+            per_chip = results["faithful"]
+            partial.update({
+                "metric": "resnet50_train_img_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "img/s/chip",
+                "vs_baseline": round(
+                    per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+                "n_devices": n_dev,
+                "platform": devices[0].platform,
+                "mode": "faithful",
+            })
+        else:
+            partial["fast_mode_img_per_sec_per_chip"] = round(
+                results["fast"], 2)
 
-    per_chip = results["faithful"]
-    out = {
-        "metric": "resnet50_train_img_per_sec_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "img/s/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-        "n_devices": n_dev,
-        "platform": devices[0].platform,
-        "mode": "faithful",
-    }
-    if "fast" in results:
-        out["fast_mode_img_per_sec_per_chip"] = round(results["fast"], 2)
-    return out
+    if profile_dir and time.monotonic() < budget_end - 30:
+        state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+        with jax.profiler.trace(profile_dir):
+            _measure(jax, faithful_step, state, x, y, 3)
+    return partial
 
 
 def child_main():
@@ -153,18 +164,26 @@ def child_main():
     budget_end = time.monotonic() + budget
     signal.signal(signal.SIGALRM, _alarm_handler)
     signal.alarm(int(budget))
+    partial: dict = {}
     try:
         out = run_bench(budget_end,
-                        profile_dir=os.environ.get("BENCH_PROFILE_DIR"))
+                        profile_dir=os.environ.get("BENCH_PROFILE_DIR"),
+                        partial=partial)
         emit(out)
     except BaseException as e:  # noqa: BLE001 — a JSON line beats a traceback
-        emit({
-            "metric": "resnet50_train_img_per_sec_per_chip",
-            "value": None,
-            "unit": "img/s/chip",
-            "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}",
-        })
+        if partial.get("value") is not None:
+            # flagship faithful number was already measured; a failure in
+            # the budget-gated extras must not discard it
+            partial["note"] = (f"extras aborted: {type(e).__name__}: {e}")
+            emit(partial)
+        else:
+            emit({
+                "metric": "resnet50_train_img_per_sec_per_chip",
+                "value": None,
+                "unit": "img/s/chip",
+                "vs_baseline": None,
+                "error": f"{type(e).__name__}: {e}",
+            })
     finally:
         signal.alarm(0)
 
@@ -190,11 +209,17 @@ def main():
     last_err = "no attempt ran"
     for attempt in range(3):
         remaining = deadline - time.monotonic()
-        if remaining < 60:
+        # always run at least one attempt (tiny BENCH_BUDGET_SECS is the
+        # documented CPU smoke-test config); retries need a real margin
+        if remaining < (10 if attempt == 0 else 60):
+            last_err += (f"; budget exhausted before attempt {attempt + 1} "
+                         f"({remaining:.0f}s left; retries need 60s)")
             break
         env = dict(os.environ)
         env[_CHILD_ENV] = "1"
-        env["BENCH_BUDGET_SECS"] = str(int(remaining - 15))
+        # clamp: with a tiny overall budget (smoke tests) the reserve could
+        # drive the child's budget negative, wrapping signal.alarm()
+        env["BENCH_BUDGET_SECS"] = str(max(int(remaining - 15), 5))
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,

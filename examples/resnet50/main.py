@@ -135,18 +135,16 @@ def main(argv=None) -> dict:
     if restored is not None:                 # auto-resume (main.py:70-75)
         state = restored
         meta = manager.metadata()
-        latest = int(manager.latest_step() or 0)
         if meta is not None and "epoch" in meta:
             # exact epoch from checkpoint metadata — robust to batch size /
             # device count / --max-batches-per-epoch changing between runs
             start_epoch = int(meta["epoch"]) + 1
-        elif latest > args.epochs:
-            # legacy dir: indices were (epoch+1)*iters_per_epoch, no sidecar
-            start_epoch = latest // iters_per_epoch
         else:
-            # checkpoints are epoch-indexed (reference's
-            # checkpoint-{epoch}.pth.tar, main.py:261-269)
-            start_epoch = latest
+            # no sidecar: derive from the iteration counter inside the
+            # restored state itself — never from how the checkpoint file
+            # happened to be numbered (mis-guessing the numbering scheme
+            # resumed at the wrong epoch; round-2 review finding)
+            start_epoch = int(restored.step) // max(iters_per_epoch, 1)
         if rank == 0:
             print(f"=> auto-resumed from epoch {start_epoch}")
 
@@ -217,11 +215,14 @@ def main(argv=None) -> dict:
                                          100 * result["val_top5"]))
         writer.add_scalar("train/loss", result["train_loss"], epoch)
         writer.add_scalar("val/top1", result["val_top1"], epoch)
-        # per-epoch checkpoint, EPOCH-indexed like the reference's
-        # checkpoint-{epoch}.pth.tar (main.py:261-269) — a monotonic index
-        # even when iters_per_epoch changes between resumed runs (the
-        # training-step count lives inside state.step regardless)
-        manager.save(epoch + 1, state,
+        # per-epoch checkpoint keyed by the TRUE global step: monotonic no
+        # matter how earlier checkpoints in the directory were numbered, so
+        # a resumed run can never be shadowed by a stale higher-numbered
+        # file.  The reference's epoch-named files (checkpoint-{epoch}
+        # .pth.tar, main.py:261-269) are matched in behavior — one
+        # checkpoint per epoch, auto-resume — with the epoch recorded in
+        # sidecar metadata instead of the filename.
+        manager.save(int(state.step), state,
                      best_metric=100 * result["val_top1"],
                      metadata={"epoch": epoch,
                                "iters_per_epoch": iters_per_epoch})
