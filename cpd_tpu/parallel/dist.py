@@ -128,9 +128,64 @@ def _gather_leaf(g: jnp.ndarray, axis_name) -> jnp.ndarray:
     return lax.all_gather(g, axis_name, axis=0, tiled=False)
 
 
+# Per-bucket element cap for the faithful path.  W x 4M x 4B = 128 MiB of
+# gathered fp32 at W=8 — large enough to amortize collective launch
+# overhead, small enough that the gathered stack never rivals model memory.
+_BUCKET_ELEMS = 4 * 1024 * 1024
+
+
+def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
+                            grad_man: int, use_kahan: bool,
+                            bucket_elems: int = _BUCKET_ELEMS) -> Any:
+    """Faithful ordered reduction over few large buckets instead of one
+    collective per parameter (SURVEY.md §7 hard-part 4).
+
+    Leaves are flattened and concatenated per dtype into buckets of at most
+    `bucket_elems` elements; each bucket is all_gathered ONCE and reduced
+    with ONE rank-ordered requantizing scan, then split back.  The quantized
+    accumulation is elementwise, so concatenation changes nothing about any
+    element's value — results are bit-identical to the per-leaf path (the
+    reference's per-parameter loop, dist_util.py:60-89), with W x leaf_count
+    collective launches collapsed to W x bucket_count.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    out = [None] * len(leaves)
+    # group by dtype, preserving leaf order within a group
+    by_dtype: dict = {}
+    for i, g in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(g.dtype), []).append(i)
+    for idxs in by_dtype.values():
+        # split the group into buckets of <= bucket_elems (a leaf larger
+        # than the cap forms its own bucket)
+        buckets, cur, cur_n = [], [], 0
+        for i in idxs:
+            n = leaves[i].size
+            if cur and cur_n + n > bucket_elems:
+                buckets.append(cur)
+                cur, cur_n = [], 0
+            cur.append(i)
+            cur_n += n
+        if cur:
+            buckets.append(cur)
+        for bucket in buckets:
+            flat = (leaves[bucket[0]].reshape(-1) if len(bucket) == 1 else
+                    jnp.concatenate([leaves[i].reshape(-1)
+                                     for i in bucket]))
+            gathered = lax.all_gather(flat, axis_name, axis=0, tiled=False)
+            red = quantized_sum(gathered, grad_exp, grad_man, use_kahan)
+            off = 0
+            for i in bucket:
+                n = leaves[i].size
+                out[i] = lax.dynamic_slice_in_dim(red, off, n).reshape(
+                    leaves[i].shape)
+                off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   use_aps: bool = False, grad_exp: int = 5, grad_man: int = 2,
-                  use_kahan: bool = False, mode: str = "faithful") -> Any:
+                  use_kahan: bool = False, mode: str = "faithful",
+                  bucket: bool = True) -> Any:
     """Low-precision gradient all-reduce (SUM) over `axis_name`.
 
     Pure pytree-in/pytree-out version of reference `sum_gradients`
@@ -142,6 +197,8 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
     use_aps     → APS exponent shifting around the reduction (aps.py).
     use_kahan   → Kahan-compensated ordered accumulation (dist_util.py:72-89).
     mode        → "faithful" (gather + ordered scan) | "fast" (quantize+psum).
+    bucket      → faithful mode only: fuse per-leaf gathers into few large
+                  per-dtype buckets (bit-identical; default on).
     """
     if mode not in ("faithful", "fast"):
         raise ValueError(f"unknown mode {mode!r}")
@@ -168,6 +225,9 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         if grad_exp == 8 and grad_man == 23 and not use_kahan:
             # fp32 fast path == plain all-reduce (dist_util.py:55-59).
             reduced = jax.tree.map(lambda g: lax.psum(g, axis_name), grads)
+        elif bucket:
+            reduced = _bucketed_quantized_sum(grads, axis_name, grad_exp,
+                                              grad_man, use_kahan)
         else:
             reduced = jax.tree.map(
                 lambda g: quantized_sum(_gather_leaf(g, axis_name),

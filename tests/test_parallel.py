@@ -125,6 +125,42 @@ def test_sum_gradients_collective_matches_oracle(use_aps, use_kahan):
         np.testing.assert_array_equal(got[k], want[k], err_msg=k)
 
 
+@pytest.mark.parametrize("use_kahan", [False, True])
+def test_bucketed_faithful_reduce_bit_identical(use_kahan):
+    """Fusing leaves into buckets (one gather + one ordered scan per bucket,
+    SURVEY.md §7 hard-part 4) must not change a single bit vs the per-leaf
+    path — the quantized accumulation is elementwise.  A tiny bucket cap
+    forces multiple buckets, including a leaf larger than the cap."""
+    from cpd_tpu.parallel.dist import _bucketed_quantized_sum
+
+    mesh = data_parallel_mesh()
+    exp, man = 4, 3
+    tree = {"a": rand_stack((37,), seed=10), "b": rand_stack((100,), seed=11),
+            "c": rand_stack((5, 9), seed=12), "d": rand_stack((3,), seed=13)}
+
+    def body(stacked, bucketed):
+        local = jax.tree.map(lambda g: g[0], stacked)
+        if bucketed:
+            return _bucketed_quantized_sum(local, "dp", exp, man, use_kahan,
+                                           bucket_elems=64)
+        return sum_gradients(local, "dp", grad_exp=exp, grad_man=man,
+                             use_kahan=use_kahan, bucket=False)
+
+    in_spec = jax.tree.map(lambda _: P("dp"), tree)
+    out_spec = jax.tree.map(lambda _: P(), tree)
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, P("dp")))
+               for k, v in tree.items()}
+    got = {}
+    for bucketed in (False, True):
+        fn = jax.jit(shard_map(
+            functools.partial(body, bucketed=bucketed), mesh=mesh,
+            in_specs=(in_spec,), out_specs=out_spec, check_vma=False))
+        got[bucketed] = jax.tree.map(np.asarray, fn(sharded))
+    for k in tree:
+        np.testing.assert_array_equal(got[True][k], got[False][k],
+                                      err_msg=k)
+
+
 def test_sum_gradients_fp32_is_plain_sum():
     mesh = data_parallel_mesh()
     tree = {"w": rand_stack((6, 3), seed=6)}
