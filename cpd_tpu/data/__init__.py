@@ -8,7 +8,8 @@ from .samplers import (DistributedEpochSampler,
                        GivenIterationSampler)
 from .imagenet import (IMAGENET_MEAN, IMAGENET_STD, ImageFolderDataset,
                        SyntheticImageNet, load_imagenet)
-from .segmentation import SyntheticSegmentation
+from .segmentation import (CityscapesDataset, SyntheticSegmentation,
+                           load_segmentation)
 
 __all__ = [
     "CIFAR10_MEAN", "CIFAR10_STD", "Crop", "Cutout", "FlipLR",
@@ -18,4 +19,5 @@ __all__ = [
     "GivenIterationSampler",
     "IMAGENET_MEAN", "IMAGENET_STD", "ImageFolderDataset",
     "SyntheticImageNet", "load_imagenet", "SyntheticSegmentation",
+    "CityscapesDataset", "load_segmentation",
 ]

@@ -1,21 +1,49 @@
-"""Segmentation data: synthetic Cityscapes-shaped crops.
+"""Segmentation data: Cityscapes leftImg8bit/gtFine loader + synthetic fallback.
 
 The reference's FCN/Cityscapes workload lives out-of-repo (mmcv fork,
-README.md:132-150): 769x769 random crops of 19-class street scenes.  The
-synthetic stand-in emits (image NHWC fp32, label map HxW int32) pairs whose
-label regions are geometric shapes correlated with the image content, so
-short runs show the loss decreasing; real Cityscapes can be wired in by
-implementing this same `batch()` contract over the leftImg8bit/gtFine pair
-tree.
+README.md:132-150): 769x769 random crops of 19-class street scenes.
+`CityscapesDataset` walks the standard tree
+
+    <root>/leftImg8bit/<split>/<city>/<name>_leftImg8bit.png
+    <root>/gtFine/<split>/<city>/<name>_gtFine_labelIds.png
+
+maps the 34 raw labelIds to the 19 train classes (everything else
+ignore_label=255), and emits random crops with the mmseg train pipeline's
+geometry (random crop after optional padding, random horizontal flip,
+mean/std normalization).  `SyntheticSegmentation` is the structure-matched
+stand-in; `load_segmentation` picks whichever exists on disk.  Both expose
+the same `batch(indices, seed) -> (NHWC fp32, HxW int32)` contract.
 """
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+import os
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["SyntheticSegmentation"]
+__all__ = ["SyntheticSegmentation", "CityscapesDataset", "load_segmentation",
+           "CITYSCAPES_IGNORE", "cityscapes_train_ids"]
+
+CITYSCAPES_IGNORE = 255
+
+# raw labelId -> trainId for the 19 evaluated classes (the standard
+# cityscapesScripts assignment mmseg's CityscapesDataset uses)
+_LABEL_TO_TRAIN = {7: 0, 8: 1, 11: 2, 12: 3, 13: 4, 17: 5, 19: 6, 20: 7,
+                   21: 8, 22: 9, 23: 10, 24: 11, 25: 12, 26: 13, 27: 14,
+                   28: 15, 31: 16, 32: 17, 33: 18}
+
+# mmseg's img_norm_cfg for the fcn_r50-d8 cityscapes configs (RGB, 0-255)
+_SEG_MEAN = np.array([123.675, 116.28, 103.53], np.float32)
+_SEG_STD = np.array([58.395, 57.12, 57.375], np.float32)
+
+
+def cityscapes_train_ids() -> np.ndarray:
+    """(256,) uint8 lookup: raw labelId -> trainId (255 = ignore)."""
+    lut = np.full(256, CITYSCAPES_IGNORE, np.uint8)
+    for raw, train in _LABEL_TO_TRAIN.items():
+        lut[raw] = train
+    return lut
 
 
 class SyntheticSegmentation:
@@ -65,3 +93,91 @@ class SyntheticSegmentation:
             x[i] = img
             y[i] = label
         return x, y
+
+
+class CityscapesDataset:
+    """Random-crop training view of a Cityscapes tree.
+
+    Replaces the reference's out-of-repo mmsegmentation data pipeline
+    (README.md:132-150) for the FCN trainer: 769x769 random crops (the
+    fcn_r50-d8 config's crop), random horizontal flip, labelId->trainId
+    mapping with ignore 255, and the mmseg mean/std normalization.  Images
+    shorter than the crop on either side are zero-padded (labels padded
+    with ignore), as mmseg's Pad transform does.
+    """
+
+    def __init__(self, root: str, split: str = "train",
+                 crop_size: int = 769, num_classes: int = 19,
+                 flip: bool = True):
+        self.crop_size = crop_size
+        self.num_classes = num_classes
+        self.flip = flip
+        self._lut = cityscapes_train_ids()
+        img_dir = os.path.join(root, "leftImg8bit", split)
+        lab_dir = os.path.join(root, "gtFine", split)
+        pairs = []
+        for city in sorted(os.listdir(img_dir)):
+            cdir = os.path.join(img_dir, city)
+            if not os.path.isdir(cdir):
+                continue
+            for name in sorted(os.listdir(cdir)):
+                if not name.endswith("_leftImg8bit.png"):
+                    continue
+                stem = name[:-len("_leftImg8bit.png")]
+                lab = os.path.join(lab_dir, city,
+                                   stem + "_gtFine_labelIds.png")
+                if os.path.isfile(lab):
+                    pairs.append((os.path.join(cdir, name), lab))
+        if not pairs:
+            raise FileNotFoundError(
+                f"no leftImg8bit/gtFine pairs under {root} split={split}")
+        self._pairs = pairs
+        self.labels = np.zeros(len(pairs), np.int32)  # dataset contract
+
+    def __len__(self) -> int:
+        return len(self._pairs)
+
+    def _load_pair(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        from PIL import Image
+
+        img_path, lab_path = self._pairs[idx]
+        img = np.asarray(Image.open(img_path).convert("RGB"), np.uint8)
+        lab = np.asarray(Image.open(lab_path), np.uint8)
+        return img, self._lut[lab]
+
+    def batch(self, indices: Sequence[int], seed: int = 0
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        s = self.crop_size
+        n = len(indices)
+        x = np.zeros((n, s, s, 3), np.float32)
+        y = np.full((n, s, s), CITYSCAPES_IGNORE, np.int32)
+        for i, idx in enumerate(np.asarray(indices)):
+            rng = np.random.RandomState((seed * 1_000_003 + int(idx))
+                                        % (2 ** 31))
+            img, lab = self._load_pair(int(idx))
+            h, w = lab.shape
+            # pad-to-crop (ignore-filled labels, zero-pixel images), then a
+            # uniform random crop — mmseg's Pad + RandomCrop
+            top = rng.randint(0, max(h - s, 0) + 1)
+            left = rng.randint(0, max(w - s, 0) + 1)
+            ch, cw = min(s, h), min(s, w)
+            img_c = img[top:top + ch, left:left + cw].astype(np.float32)
+            lab_c = lab[top:top + ch, left:left + cw]
+            if self.flip and rng.rand() < 0.5:
+                img_c = img_c[:, ::-1]
+                lab_c = lab_c[:, ::-1]
+            x[i, :ch, :cw] = (img_c - _SEG_MEAN) / _SEG_STD
+            y[i, :ch, :cw] = lab_c
+        return x, y
+
+
+def load_segmentation(root: Optional[str] = None, split: str = "train",
+                      crop_size: int = 128, num_classes: int = 19,
+                      synthetic_size: int = 256, seed: int = 0):
+    """Real Cityscapes if `root` holds a leftImg8bit/gtFine tree, else the
+    synthetic stand-in (same batch() contract)."""
+    if root and os.path.isdir(os.path.join(root, "leftImg8bit", split)):
+        return CityscapesDataset(root, split=split, crop_size=crop_size,
+                                 num_classes=num_classes)
+    return SyntheticSegmentation(n=synthetic_size, num_classes=num_classes,
+                                 crop_size=crop_size, seed=seed)

@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--emulate_node", default=1, type=int)
     p.add_argument("--mode", default="faithful", choices=["faithful", "fast"])
     p.add_argument("--dist", action="store_true")
+    p.add_argument("--data-root", default=None,
+                   help="Cityscapes root (leftImg8bit/gtFine); synthetic "
+                        "fallback when absent")
     p.add_argument("--synthetic-size", default=256, type=int)
     p.add_argument("--tiny-backbone", action="store_true",
                    help="1-block-per-stage backbone (smoke tests)")
@@ -64,7 +67,7 @@ def main(argv=None) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from cpd_tpu.data.segmentation import SyntheticSegmentation
+    from cpd_tpu.data.segmentation import load_segmentation
     from cpd_tpu.models import fcn_r50_d8
     from cpd_tpu.parallel.dist import dist_init, host_batch_to_global
     from cpd_tpu.parallel.mesh import data_parallel_mesh
@@ -78,8 +81,12 @@ def main(argv=None) -> dict:
     mesh = data_parallel_mesh()
     n_dev = mesh.devices.size
 
-    ds = SyntheticSegmentation(args.synthetic_size, args.num_classes,
-                               args.crop_size)
+    # real Cityscapes (leftImg8bit/gtFine tree, 769x769 crops — the mmseg
+    # fcn_r50-d8 pipeline the reference trains on, README.md:132-150) when
+    # --data-root points at one; synthetic stand-in otherwise
+    ds = load_segmentation(args.data_root, crop_size=args.crop_size,
+                           num_classes=args.num_classes,
+                           synthetic_size=args.synthetic_size)
     global_batch = args.batch_size * n_dev * args.emulate_node
 
     # mmseg's poly schedule ~ piecewise-linear decay to lr*0.01 at max_iter

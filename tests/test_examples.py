@@ -124,14 +124,32 @@ def test_resnet50_trainer_smoke_and_resume(tmp_path, capsys):
     assert res2 == {}                      # all epochs already done
 
 
+def test_resnet18_trainer_resume_continues_training(tiny_cifar, tmp_path):
+    """Auto-resume must REPLICATE the orbax-restored state back onto the
+    mesh and keep training — restore committed the arrays to one device,
+    which crashed the sharded step (round-2 regression)."""
+    from resnet18_cifar.train import main
+
+    save = str(tmp_path / "ckpt")
+    common = ["--arch", "tiny", "--data-root", tiny_cifar,
+              "--batch_size", "2", "--val_freq", "100",
+              "--save_path", save, "--mode", "fast"]
+    res1 = main(common + ["--max-iter", "2"])
+    assert res1["step"] == 2
+    res2 = main(common + ["--max-iter", "4"])   # resumes at 2, trains 2 more
+    assert res2["step"] == 4
+    assert math.isfinite(res2["loss"])
+
+
 def test_fcn_trainer_smoke(tmp_path):
     from fcn.train import main
 
-    # faithful mode + aux head: stage-3 auxiliary loss through the full
-    # quantized pipeline
+    # faithful mode + aux head + REAL-format Cityscapes tree: stage-3
+    # auxiliary loss through the full quantized pipeline, fed by the
+    # leftImg8bit/gtFine walker (19 trainId classes)
+    root = _write_tiny_cityscapes(str(tmp_path / "cs"))
     res = main(["--crop-size", "32", "--batch-size", "1", "--max-iter", "2",
-                "--num-classes", "5", "--synthetic-size", "16",
-                "--tiny-backbone", "--aux-head",
+                "--data-root", root, "--tiny-backbone", "--aux-head",
                 "--use_APS", "--grad_exp", "5", "--grad_man", "2",
                 "--save-path", str(tmp_path / "fcn"), "--mode", "faithful"])
     assert res["step"] == 2
@@ -195,6 +213,81 @@ def test_image_folder_dataset(tmp_path):
     x1, _ = ev.batch([1])
     x2, _ = ev.batch([1])
     np.testing.assert_array_equal(x1, x2)
+
+
+def _write_tiny_cityscapes(root, n_imgs=3, h=64, w=96):
+    """Real-format leftImg8bit/gtFine fixture tree (two cities)."""
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for city_i, city in enumerate(("aaa", "bbb")):
+        for k in range(n_imgs):
+            stem = f"{city}_{k:06d}_000019"
+            img_dir = os.path.join(root, "leftImg8bit", "train", city)
+            lab_dir = os.path.join(root, "gtFine", "train", city)
+            os.makedirs(img_dir, exist_ok=True)
+            os.makedirs(lab_dir, exist_ok=True)
+            img = rng.randint(0, 256, (h, w, 3), dtype=np.uint8)
+            # raw labelIds: road(7), car(26), sky(23) bands + void(0) strip
+            lab = np.zeros((h, w), np.uint8)
+            lab[: h // 3] = 23
+            lab[h // 3: 2 * h // 3] = 7
+            lab[2 * h // 3:] = 26
+            lab[:, : w // 3] = 0                # void -> ignore
+            Image.fromarray(img).save(
+                os.path.join(img_dir, stem + "_leftImg8bit.png"))
+            Image.fromarray(lab).save(
+                os.path.join(lab_dir, stem + "_gtFine_labelIds.png"))
+    return root
+
+
+def test_cityscapes_loader_real_tree(tmp_path):
+    from cpd_tpu.data.segmentation import (CITYSCAPES_IGNORE,
+                                           CityscapesDataset,
+                                           load_segmentation)
+
+    root = _write_tiny_cityscapes(str(tmp_path))
+    ds = load_segmentation(root, crop_size=48)
+    assert isinstance(ds, CityscapesDataset)
+    assert len(ds) == 6
+    x, y = ds.batch([0, 3, 5], seed=1)
+    assert x.shape == (3, 48, 48, 3) and x.dtype == np.float32
+    assert y.shape == (3, 48, 48) and y.dtype == np.int32
+    # labelId -> trainId: only {sky=10, road=0, car=13, ignore} can appear
+    assert set(np.unique(y)) <= {0, 10, 13, CITYSCAPES_IGNORE}
+    assert CITYSCAPES_IGNORE in np.unique(y)    # the void strip
+    # normalized pixels are z-scores, not raw bytes
+    assert np.abs(x).max() < 5.0
+    # determinism under the (seed, index) contract
+    x2, y2 = ds.batch([0, 3, 5], seed=1)
+    np.testing.assert_array_equal(x, x2)
+    np.testing.assert_array_equal(y, y2)
+    # different seed -> different crops somewhere
+    x3, _ = ds.batch([0, 3, 5], seed=2)
+    assert not np.array_equal(x, x3)
+
+
+def test_cityscapes_loader_pads_small_images(tmp_path):
+    from cpd_tpu.data.segmentation import (CITYSCAPES_IGNORE,
+                                           CityscapesDataset)
+
+    root = _write_tiny_cityscapes(str(tmp_path), h=32, w=40)
+    ds = CityscapesDataset(root, crop_size=64, flip=False)
+    x, y = ds.batch([0], seed=0)
+    # padded region: ignore labels, zero pixels
+    assert np.all(y[0, 32:, :] == CITYSCAPES_IGNORE)
+    assert np.all(x[0, 32:, :, :] == 0.0)
+    assert np.any(y[0, :32, :40] != CITYSCAPES_IGNORE)
+
+
+def test_load_segmentation_synthetic_fallback(tmp_path):
+    from cpd_tpu.data.segmentation import (SyntheticSegmentation,
+                                           load_segmentation)
+
+    ds = load_segmentation(str(tmp_path / "nope"), crop_size=32,
+                           synthetic_size=8)
+    assert isinstance(ds, SyntheticSegmentation)
+    assert len(ds) == 8
 
 
 def test_seg_loss_ignores_ignore_label():
