@@ -109,6 +109,14 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     def local_micro_grads(params, batch_stats, images, labels, world, step):
         """Sequential scan over micro-batches -> stacked grads (N, ...)."""
         n = emulate_node
+        if images.shape[0] < n or images.shape[0] % n:
+            # a 0-sample micro-batch silently yields NaN losses (mean over
+            # an empty batch); fail at trace time with the actual geometry
+            raise ValueError(
+                f"per-device batch {images.shape[0]} must be a positive "
+                f"multiple of emulate_node={n} (global batch = "
+                f"devices * per-device batch; each device slice is split "
+                f"into emulate_node sequential micro-batches)")
         mb = images.shape[0] // n
         images = images.reshape(n, mb, *images.shape[1:])
         labels = labels.reshape(n, mb, *labels.shape[1:])

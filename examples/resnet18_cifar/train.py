@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--print_freq", default=None, type=int)
     # new surface (no reference equivalent)
     p.add_argument("--data-root", default=None)
+    p.add_argument("--peak-lr", default=None, type=float,
+                   help="override the hardcoded 1.6 post-warmup peak LR "
+                        "(mix.py:181-198) — small archs/batches need less")
     p.add_argument("--max-iter", default=None, type=int,
                    help="override total iterations (smoke tests)")
     p.add_argument("--profile-dir", default=None,
@@ -77,7 +80,8 @@ def main(argv=None) -> dict:
     from cpd_tpu.data import CIFAR10Pipeline, load_cifar10
     from cpd_tpu.data.samplers import DistributedGivenIterationSampler
     from cpd_tpu.models import get_model
-    from cpd_tpu.parallel.dist import dist_init, host_batch_to_global
+    from cpd_tpu.parallel.dist import (dist_init, host_batch_to_global,
+                                       replicate)
     from cpd_tpu.parallel.mesh import data_parallel_mesh
     from cpd_tpu.train import (CheckpointManager, create_train_state,
                                make_eval_step, make_optimizer,
@@ -105,9 +109,11 @@ def main(argv=None) -> dict:
     total_iter = args.max_epoch * iter_per_epoch
     if args.max_iter is not None:
         total_iter = args.max_iter
+    peak_lr = args.peak_lr if args.peak_lr is not None else 1.6
     schedule = warmup_step_decay(
-        1.6, 5 * iter_per_epoch,
-        [40 * iter_per_epoch, 80 * iter_per_epoch], warmup_from=0.1)
+        peak_lr, 5 * iter_per_epoch,
+        [40 * iter_per_epoch, 80 * iter_per_epoch],
+        warmup_from=peak_lr / 16.0)
 
     model = get_model(args.arch)
     tx = make_optimizer("lars" if args.use_lars else "sgd", schedule,
@@ -144,6 +150,10 @@ def main(argv=None) -> dict:
             start_iter = int(restored.step)
             if rank == 0:
                 print(f"=> resumed from iter {start_iter}")
+    # orbax restores arrays committed to a single device; the train step's
+    # shard_map needs the state replicated over the mesh (fresh states are
+    # uncommitted, so only the restore paths hit the mismatch)
+    state = replicate(state, mesh)
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,

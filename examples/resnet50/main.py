@@ -89,7 +89,8 @@ def main(argv=None) -> dict:
     from cpd_tpu.data.imagenet import load_imagenet
     from cpd_tpu.data.samplers import DistributedEpochSampler
     from cpd_tpu.models import get_model
-    from cpd_tpu.parallel.dist import dist_init, host_batch_to_global
+    from cpd_tpu.parallel.dist import (dist_init, host_batch_to_global,
+                                       replicate)
     from cpd_tpu.parallel.mesh import data_parallel_mesh
     from cpd_tpu.train import (CheckpointManager, create_train_state,
                                make_eval_step, make_optimizer,
@@ -147,6 +148,9 @@ def main(argv=None) -> dict:
             start_epoch = int(restored.step) // max(iters_per_epoch, 1)
         if rank == 0:
             print(f"=> auto-resumed from epoch {start_epoch}")
+    # orbax restores arrays committed to a single device; the train step's
+    # shard_map needs the state replicated over the mesh
+    state = replicate(state, mesh)
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
