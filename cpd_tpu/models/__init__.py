@@ -11,6 +11,7 @@ from .resnet import ResNet, resnet18, resnet50, resnet101
 from .fcn import FCN, FCNHead, fcn_r50_d8
 from .tiny import TinyCNN, tiny_cnn
 from .transformer import TransformerLM, lm_param_specs, transformer_lm
+from .pipeline_lm import PipelinedLM, pipelined_lm, pp_param_specs
 
 _REGISTRY = {
     "res_cifar": resnet18_cifar,      # reference name (mix.py:82)
@@ -22,6 +23,7 @@ _REGISTRY = {
     "fcn_r50_d8": fcn_r50_d8,
     "tiny": tiny_cnn,                 # smoke-test model (models/tiny.py)
     "transformer_lm": transformer_lm,
+    "pipelined_lm": pipelined_lm,
 }
 
 
@@ -36,4 +38,5 @@ __all__ = ["ResNetCIFAR", "resnet18_cifar", "DavidNet", "davidnet",
            "ResNet", "resnet18", "resnet50", "resnet101",
            "FCN", "FCNHead", "fcn_r50_d8", "TinyCNN", "tiny_cnn",
            "TransformerLM", "transformer_lm", "lm_param_specs",
+           "PipelinedLM", "pipelined_lm", "pp_param_specs",
            "get_model"]

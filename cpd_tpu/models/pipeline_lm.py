@@ -1,0 +1,178 @@
+"""Pipeline-parallel decoder LM — layer-stacked params over the `pp` axis.
+
+New capability beyond the reference (SURVEY.md §2: PP "Absent"; round-1
+review: pp axis was a placeholder).  The design is the TPU-native "stack
+of identical layers" form:
+
+* block parameters live as ONE pytree whose leaves have a leading layer
+  axis (L, ...) — built by vmapping `Block.init` over per-layer rngs;
+* on a mesh, that leading axis is sharded `P("pp", ...)`: each pipeline
+  stage holds its contiguous L/pp slice, exactly as tensor parallelism
+  shards feature axes;
+* the forward pass is `lax.scan` over the local layer slice; across
+  stages, activations stream via `parallel.pipeline.pipeline_spmd`
+  (rotating ppermute, GPipe schedule);
+* embedding, final LayerNorm and the tied head are replicated — their
+  gradients need a `psum` over pp (stage-local block grads are already
+  complete, each stage being the only owner of its layers).
+
+`PipelinedLM` is intentionally NOT an nn.Module: flax modules cannot be
+re-applied inside `lax.scan` pipeline ticks, but a pure `Block.apply`
+over stacked params can.  The class mirrors the `init/apply` surface the
+trainers use, and composes with tensor parallelism (Block's tp psums)
+unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import flax.linen as nn
+
+from ..parallel.pipeline import pipeline_spmd
+from .transformer import Block
+
+__all__ = ["PipelinedLM", "pipelined_lm", "pp_param_specs"]
+
+
+@dataclass(frozen=True)
+class PipelinedLM:
+    """Decoder-only LM with layer-stacked block params.
+
+    pp_axis/pp_size and tp_axis/tp_size describe the APPLY-time mesh
+    context (shard_map slices the params); init always builds the full
+    global stack with pp_size=1-style shapes.
+    """
+    vocab_size: int = 32000
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    d_ff: int = 1024
+    pp_axis: Optional[str] = None
+    pp_size: int = 1
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    def _block(self) -> Block:
+        return Block(head_dim=self.d_model // self.n_heads, d_ff=self.d_ff,
+                     d_model=self.d_model, tp_axis=self.tp_axis,
+                     sp_axis=None, tp_size=self.tp_size, dtype=self.dtype)
+
+    def _embed(self) -> nn.Embed:
+        return nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                        param_dtype=self.param_dtype)
+
+    def _lnf(self) -> nn.LayerNorm:
+        return nn.LayerNorm(dtype=self.dtype)
+
+    def init(self, rng, tokens, train: bool = True) -> dict:
+        """Full (global) parameter pytree: embed / ln_f replicated shapes,
+        blocks stacked on a leading (n_layers, ...) axis."""
+        del train
+        t = tokens.shape[1]
+        k_embed, k_blocks, k_ln = jax.random.split(rng, 3)
+        embed_vars = self._embed().init(k_embed, tokens)
+        x0 = jnp.zeros((tokens.shape[0], t, self.d_model), self.dtype)
+        positions = jnp.arange(t)
+        block = self._block()
+        keys = jax.random.split(k_blocks, self.n_layers)
+        stacked = jax.vmap(
+            lambda k: block.init(k, x0, positions)["params"])(keys)
+        ln_vars = self._lnf().init(k_ln, x0)
+        return {"params": {"embed": embed_vars["params"],
+                           "blocks": stacked,
+                           "ln_f": ln_vars["params"]}}
+
+    def _apply_stack(self, stacked_params, x, positions):
+        block = self._block()
+
+        def body(h, p):
+            return block.apply({"params": p}, h, positions), None
+
+        h, _ = lax.scan(body, x, stacked_params)
+        return h
+
+    def apply(self, variables: dict, tokens: jnp.ndarray,
+              train: bool = True) -> jnp.ndarray:
+        """(B, T) int32 -> (B, T, vocab) fp32 logits.
+
+        Without a pp context this is an ordinary sequential LM (the
+        single-device oracle the tests compare against).  Inside shard_map
+        with pp_size > 1, `tokens` must already be the per-rank batch and
+        the caller uses `apply_pipelined` (microbatch streaming).
+        """
+        del train
+        params = variables["params"]
+        positions = jnp.arange(tokens.shape[1])
+        x = self._embed().apply({"params": params["embed"]}, tokens)
+        h = self._apply_stack(params["blocks"], x, positions)
+        return self._head(params, h)
+
+    def _head(self, params, h):
+        h = self._lnf().apply({"params": params["ln_f"]}, h)
+        logits = self._embed().apply(
+            {"params": params["embed"]}, h.astype(self.param_dtype),
+            method="attend")
+        return logits.astype(jnp.float32)
+
+    def apply_pipelined(self, variables: dict, tokens: jnp.ndarray,
+                        n_microbatches: int) -> jnp.ndarray:
+        """Pipelined forward inside shard_map over (pp_axis).
+
+        tokens: (B_local, T); returns (B_local, T, vocab) logits VALID ON
+        THE LAST pp STAGE ONLY (mask downstream with axis_index == last).
+        """
+        params = variables["params"]
+        m = n_microbatches
+        b, t = tokens.shape
+        if b < m or b % m:
+            raise ValueError(
+                f"per-rank batch {b} must be a positive multiple of "
+                f"n_microbatches={m} (each dp rank's batch is split into "
+                f"pipeline microbatches)")
+        positions = jnp.arange(t)
+        toks = tokens.reshape(m, b // m, t)
+        x = self._embed().apply({"params": params["embed"]}, toks)
+
+        def stage_fn(act):
+            return self._apply_stack(params["blocks"], act, positions)
+
+        outs = pipeline_spmd(stage_fn, x, self.pp_axis, self.pp_size)
+        logits = self._head(params, outs.reshape(b, t, -1).astype(self.dtype))
+        return logits
+
+
+def pipelined_lm(vocab_size: int = 32000, d_model: int = 256,
+                 n_layers: int = 4, n_heads: int = 4,
+                 d_ff: Optional[int] = None, **kw) -> PipelinedLM:
+    return PipelinedLM(vocab_size=vocab_size, d_model=d_model,
+                       n_layers=n_layers, n_heads=n_heads,
+                       d_ff=d_ff or 4 * d_model, **kw)
+
+
+def pp_param_specs(params, pp_axis: str = "pp", tp_axis: str = "tp"):
+    """PartitionSpecs: block leaves pp-sharded on their leading layer axis
+    (composed with the Megatron tp rules on the trailing axes), embed and
+    ln_f replicated."""
+    def spec(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        if names and names[0] == "blocks":
+            # Megatron rule on the per-layer (trailing) axes, then prepend
+            # the layer axis sharded over pp
+            if len(names) >= 2 and names[-1] == "kernel":
+                if names[-2] in ("wqkv", "wi"):
+                    return P(pp_axis, None, tp_axis)
+                if names[-2] in ("wo", "wo_mlp"):
+                    return P(pp_axis, tp_axis, None)
+            return P(pp_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -35,7 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.transformer import lm_param_specs
 from ..parallel.dist import sum_gradients
 from ..parallel.emulate import emulate_node_reduce
-from .state import TrainState
+from .state import TrainState, state_specs_like
 
 __all__ = ["make_lm_train_step", "make_lm_eval_step", "lm_state_specs"]
 
@@ -43,27 +43,7 @@ __all__ = ["make_lm_train_step", "make_lm_eval_step", "lm_state_specs"]
 def lm_state_specs(state: TrainState, tp_axis: str = "tp") -> TrainState:
     """PartitionSpec pytree shaped like `state`: params (and their optimizer
     momentum mirror) follow the Megatron rules, scalars replicated."""
-    p_specs = lm_param_specs(state.params, tp_axis)
-    params_td = jax.tree.structure(state.params)
-
-    def mirror(obj):
-        # Structural matching: any optimizer-state subtree whose pytree
-        # structure equals the params' (momentum/mu/nu mirrors) takes the
-        # param specs wholesale; containers recurse; everything else
-        # (counters, scalars) is replicated.  No shape-based matching —
-        # same-shaped-but-differently-sharded leaves must not collide.
-        if jax.tree.structure(obj) == params_td:
-            return p_specs
-        if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # NamedTuple
-            return type(obj)(*(mirror(x) for x in obj))
-        if isinstance(obj, (tuple, list)):
-            return type(obj)(mirror(x) for x in obj)
-        if isinstance(obj, dict):
-            return {k: mirror(v) for k, v in obj.items()}
-        return P()
-
-    return TrainState(step=P(), params=p_specs, batch_stats=P(),
-                      opt_state=mirror(state.opt_state))
+    return state_specs_like(state, lm_param_specs(state.params, tp_axis))
 
 
 def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-__all__ = ["TrainState", "create_train_state"]
+__all__ = ["TrainState", "create_train_state", "state_specs_like"]
 
 
 @flax.struct.dataclass
@@ -42,3 +42,30 @@ def create_train_state(model, tx: optax.GradientTransformation,
     batch_stats = variables.get("batch_stats", {})
     return TrainState(step=jnp.zeros([], jnp.int32), params=params,
                       batch_stats=batch_stats, opt_state=tx.init(params))
+
+
+def state_specs_like(state: TrainState, p_specs: Any) -> TrainState:
+    """PartitionSpec pytree shaped like `state`, given the params' specs.
+
+    Optimizer-state subtrees that structurally mirror the params
+    (momentum/mu/nu) take the param specs wholesale; containers recurse;
+    scalars/counters are replicated.  Structural (not shape-based)
+    matching: same-shaped-but-differently-sharded leaves must not collide.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    params_td = jax.tree.structure(state.params)
+
+    def mirror(obj):
+        if jax.tree.structure(obj) == params_td:
+            return p_specs
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # NamedTuple
+            return type(obj)(*(mirror(x) for x in obj))
+        if isinstance(obj, (tuple, list)):
+            return type(obj)(mirror(x) for x in obj)
+        if isinstance(obj, dict):
+            return {k: mirror(v) for k, v in obj.items()}
+        return P()
+
+    return TrainState(step=P(), params=p_specs, batch_stats=P(),
+                      opt_state=mirror(state.opt_state))
