@@ -12,6 +12,7 @@ from .fcn import FCN, FCNHead, fcn_r50_d8
 from .tiny import TinyCNN, tiny_cnn
 from .transformer import TransformerLM, lm_param_specs, transformer_lm
 from .pipeline_lm import PipelinedLM, pipelined_lm, pp_param_specs
+from .moe import MoETransformerLM, moe_lm, moe_param_specs
 
 _REGISTRY = {
     "res_cifar": resnet18_cifar,      # reference name (mix.py:82)
@@ -24,6 +25,7 @@ _REGISTRY = {
     "tiny": tiny_cnn,                 # smoke-test model (models/tiny.py)
     "transformer_lm": transformer_lm,
     "pipelined_lm": pipelined_lm,
+    "moe_lm": moe_lm,
 }
 
 
@@ -39,4 +41,5 @@ __all__ = ["ResNetCIFAR", "resnet18_cifar", "DavidNet", "davidnet",
            "FCN", "FCNHead", "fcn_r50_d8", "TinyCNN", "tiny_cnn",
            "TransformerLM", "transformer_lm", "lm_param_specs",
            "PipelinedLM", "pipelined_lm", "pp_param_specs",
+           "MoETransformerLM", "moe_lm", "moe_param_specs",
            "get_model"]

@@ -1,0 +1,176 @@
+"""Mixture-of-Experts LM with expert parallelism (the `ep` mesh axis).
+
+New capability beyond the reference (SURVEY.md §2: EP "Absent"; round-1
+review: the ep axis was a placeholder).  Switch-Transformer-style top-1
+routing with static shapes throughout — the TPU constraint that shapes be
+known at compile time is met with the classic capacity trick:
+
+    capacity C = ceil(capacity_factor * local_tokens / n_experts)
+    each expert accepts at most C tokens per rank; overflow tokens pass
+    through the residual unchanged (their gate contribution is dropped).
+
+Parallel layout (mesh dp x ep):
+
+* tokens are sharded over BOTH dp and ep for every layer — ep doubles as
+  a data axis outside the expert computation;
+* expert weights are stacked (n_experts, ...) and sharded P("ep", ...):
+  each ep rank owns n_experts/ep consecutive experts;
+* dispatch: tokens are binned into per-expert capacity buffers on every
+  rank, then ONE `lax.all_to_all` over ep ships each expert's buffers to
+  its owner; the owner applies its local experts (a vmapped batched
+  matmul — one big MXU-friendly einsum, not a loop); a reverse
+  all_to_all brings results home for the gated combine.
+
+Router/attention/norm params are replicated over ep; their gradients need
+a `psum` over ep (train/moe.py), while expert-weight gradients are already
+complete on the owning rank.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .transformer import Block
+
+__all__ = ["MoEFeedForward", "MoETransformerLM", "moe_lm", "moe_param_specs"]
+
+
+class MoEFeedForward(nn.Module):
+    """Top-1 routed expert MLP.  Input/output: (B, T, d_model)."""
+    d_model: int
+    d_ff: int
+    n_experts: int          # GLOBAL expert count
+    ep_axis: Optional[str]
+    ep_size: int            # 1 at init; the mesh's ep size inside shard_map
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        s = b * t                      # local tokens
+        e_local = self.n_experts // self.ep_size
+        # stacked expert weights; ep slices the leading axis
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (e_local, d, self.d_ff), self.param_dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (e_local, self.d_ff, d), self.param_dtype)
+
+        tokens = x.reshape(s, d)
+        # router is replicated; computed over the GLOBAL expert range
+        logits = nn.Dense(self.n_experts, use_bias=False, dtype=self.dtype,
+                          name="router")(tokens)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        expert = jnp.argmax(probs, axis=-1)               # (S,)
+        gate = jnp.max(probs, axis=-1)                    # (S,)
+
+        capacity = max(1, math.ceil(self.capacity_factor * s
+                                    / self.n_experts))
+        onehot = jax.nn.one_hot(expert, self.n_experts,
+                                dtype=jnp.float32)        # (S, E)
+        # position of each token within its expert's buffer (0-based)
+        pos = jnp.einsum("se->s", jnp.cumsum(onehot, axis=0) * onehot) - 1.0
+        keep = pos < capacity
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)          # (S, C)
+        # (S, E, C) dispatch tensor: token s -> (expert e, slot c)
+        dispatch = onehot[:, :, None] * slot[:, None, :] \
+            * keep[:, None, None]
+        buffers = jnp.einsum("sec,sd->ecd", dispatch,
+                             tokens.astype(jnp.float32)).astype(self.dtype)
+
+        if self.ep_axis and self.ep_size > 1:
+            # (E, C, D) -> (E/P, P*C, D): every rank ends up with ITS
+            # experts' buffers from all ep ranks
+            buffers = lax.all_to_all(buffers, self.ep_axis, split_axis=0,
+                                     concat_axis=1, tiled=True)
+
+        h = jnp.einsum("ecd,edf->ecf", buffers, wi.astype(self.dtype))
+        h = nn.gelu(h)
+        out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+
+        if self.ep_axis and self.ep_size > 1:
+            # reverse: (E/P, P*C, D) -> (E, C, D)
+            out = lax.all_to_all(out, self.ep_axis, split_axis=1,
+                                 concat_axis=0, tiled=True)
+
+        combine = dispatch * gate[:, None, None]          # (S, E, C)
+        y = jnp.einsum("sec,ecd->sd", combine, out.astype(jnp.float32))
+        # auxiliary load-balancing loss (Switch eq. 4): mean gate mass per
+        # expert x fraction of tokens routed there, scaled by E
+        density = onehot.mean(axis=0)
+        density_proxy = probs.mean(axis=0)
+        self.sow("intermediates", "aux_loss",
+                 jnp.sum(density * density_proxy) * self.n_experts)
+        return y.reshape(b, t, d).astype(self.dtype)
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only MoE LM.  (B, T_local) int32 -> (B, T_local, vocab)."""
+    vocab_size: int = 32000
+    d_model: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    n_experts: int = 4
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        del train
+        positions = jnp.arange(tokens.shape[1])
+        emb = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                       param_dtype=self.param_dtype, name="embed")
+        x = emb(tokens)
+        # reuse transformer.Block's attention half wholesale; only the MLP
+        # is swapped for the routed experts (Block.mlp factory)
+        moe_factory = functools.partial(
+            MoEFeedForward, d_model=self.d_model, d_ff=self.d_ff,
+            n_experts=self.n_experts, ep_axis=self.ep_axis,
+            ep_size=self.ep_size, capacity_factor=self.capacity_factor,
+            dtype=self.dtype, name="moe")
+        for i in range(self.n_layers):
+            x = Block(head_dim=self.d_model // self.n_heads,
+                      d_ff=self.d_ff, d_model=self.d_model,
+                      tp_axis=None, sp_axis=None, tp_size=1,
+                      dtype=self.dtype, mlp=moe_factory,
+                      name=f"block{i}")(x, positions)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = emb.attend(x.astype(self.param_dtype))
+        return logits.astype(jnp.float32)
+
+
+def moe_lm(vocab_size: int = 32000, d_model: int = 256, n_layers: int = 2,
+           n_heads: int = 4, d_ff: Optional[int] = None, n_experts: int = 4,
+           **kw) -> MoETransformerLM:
+    return MoETransformerLM(vocab_size=vocab_size, d_model=d_model,
+                            n_layers=n_layers, n_heads=n_heads,
+                            d_ff=d_ff or 2 * d_model, n_experts=n_experts,
+                            **kw)
+
+
+def moe_param_specs(params, ep_axis: str = "ep"):
+    """PartitionSpecs: expert weight stacks ('wi'/'wo' under an 'moe'
+    scope) ep-sharded on the leading expert axis, everything else
+    replicated."""
+
+    def spec(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        if "moe" in names and names[-1] in ("wi", "wo"):
+            return P(ep_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

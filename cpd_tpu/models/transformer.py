@@ -60,6 +60,10 @@ class Block(nn.Module):
                         # applied inside shard_map (flax validates declared
                         # vs stored shapes, so features must be local)
     dtype: Any
+    mlp: Optional[Any] = None   # factory () -> nn.Module replacing the
+                                # dense pair (e.g. MoE experts); a custom
+                                # mlp owns its own collectives — Block's tp
+                                # psum applies only to the built-in pair
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
@@ -89,6 +93,8 @@ class Block(nn.Module):
 
         # ---- mlp ----
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        if self.mlp is not None:
+            return x + self.mlp()(h)
         h = nn.Dense(self.d_ff // self.tp_size, use_bias=False,
                      dtype=self.dtype, name="wi")(h)
         h = nn.gelu(h)

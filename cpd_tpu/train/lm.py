@@ -35,7 +35,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.transformer import lm_param_specs
 from ..parallel.dist import sum_gradients
 from ..parallel.emulate import emulate_node_reduce
-from .state import TrainState, state_specs_like
+from .state import (TrainState, make_sharded_stepper, reject_norm_based,
+                    state_specs_like)
 
 __all__ = ["make_lm_train_step", "make_lm_eval_step", "lm_state_specs"]
 
@@ -58,18 +59,11 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     (dp, sp).  Loss is next-token CE averaged over all target positions.
     """
     # Guard: the optimizer update runs shard-local, which is only exact for
-    # elementwise transforms.  LARS trust ratios need *global* param/grad
-    # norms; over tp-sharded params the per-shard norms are wrong, so refuse
-    # rather than silently train with broken trust ratios.  (With tp=1 all
-    # params are replicated and grads fully reduced before the update, so
-    # per-shard norms ARE global norms — LARS is fine there.)
-    if getattr(tx, "norm_based", False) and mesh.shape.get(axis_tp, 1) > 1:
-        raise ValueError(
-            "norm-based optimizers (LARS) are not supported by the "
-            "tp-sharded LM step: trust ratios need global norms but the "
-            "update is shard-local (cpd_tpu/train/lm.py docstring). "
-            "Use sgd/nesterov here, or set tp=1.")
-    p_spec_cache: dict = {}
+    # elementwise transforms (see reject_norm_based).  With tp=1 all params
+    # are replicated and grads fully reduced before the update, so
+    # per-shard norms ARE global norms — LARS is fine there.
+    if mesh.shape.get(axis_tp, 1) > 1:
+        reject_norm_based(tx, "tp-sharded LM step")
 
     def step_fn(state: TrainState, tokens, targets):
         def loss_of(params, toks, tgts):
@@ -133,23 +127,9 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         }
         return new_state, metrics
 
-    def build(state_template: TrainState):
-        specs = lm_state_specs(state_template, axis_tp)
-        data_spec = P(axis_dp, axis_sp)
-        shard_fn = jax.shard_map(
-            step_fn, mesh=mesh,
-            in_specs=(specs, data_spec, data_spec),
-            out_specs=(specs, P()),
-            check_vma=False)
-        return jax.jit(shard_fn, donate_argnums=(0,) if donate else ())
-
-    def stepper(state, tokens, targets):
-        key = jax.tree.structure(state)
-        if key not in p_spec_cache:
-            p_spec_cache[key] = build(state)
-        return p_spec_cache[key](state, tokens, targets)
-
-    return stepper
+    return make_sharded_stepper(
+        step_fn, lambda s: lm_state_specs(s, axis_tp), mesh,
+        P(axis_dp, axis_sp), donate=donate)
 
 
 def make_lm_eval_step(model, mesh: Mesh, *, axis_dp: str = "dp",

@@ -27,7 +27,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.pipeline_lm import PipelinedLM, pp_param_specs
 from ..parallel.dist import sum_gradients
-from .state import TrainState, state_specs_like
+from .state import (TrainState, make_sharded_stepper, reject_norm_based,
+                    state_specs_like)
 
 __all__ = ["make_pp_train_step", "pp_state_specs"]
 
@@ -52,14 +53,9 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
     pipeline microbatches.  Keep n_microbatches >= pp for a small bubble
     (fraction (pp-1)/(n_microbatches+pp-1)).
     """
-    if getattr(tx, "norm_based", False):
-        raise ValueError(
-            "norm-based optimizers (LARS) are not supported by the "
-            "pp-sharded step: trust ratios need global norms but the "
-            "update is shard-local. Use sgd/nesterov here.")
+    reject_norm_based(tx, "pp-sharded step")
     pp_size = mesh.shape.get(axis_pp, 1)
     all_axes = (axis_dp, axis_pp, axis_tp)  # size-1 axes psum as no-ops
-    cache: dict = {}
 
     def step_fn(state: TrainState, tokens, targets):
         is_last = (lax.axis_index(axis_pp) == pp_size - 1
@@ -121,20 +117,6 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
         }
         return new_state, metrics
 
-    def build(state_template):
-        specs = pp_state_specs(state_template, axis_pp, axis_tp)
-        data_spec = P(axis_dp)
-        shard_fn = jax.shard_map(
-            step_fn, mesh=mesh,
-            in_specs=(specs, data_spec, data_spec),
-            out_specs=(specs, P()),
-            check_vma=False)
-        return jax.jit(shard_fn, donate_argnums=(0,) if donate else ())
-
-    def stepper(state, tokens, targets):
-        key = jax.tree.structure(state)
-        if key not in cache:
-            cache[key] = build(state)
-        return cache[key](state, tokens, targets)
-
-    return stepper
+    return make_sharded_stepper(
+        step_fn, lambda s: pp_state_specs(s, axis_pp, axis_tp), mesh,
+        P(axis_dp), donate=donate)

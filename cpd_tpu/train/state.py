@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
-__all__ = ["TrainState", "create_train_state", "state_specs_like"]
+__all__ = ["TrainState", "create_train_state", "state_specs_like",
+           "reject_norm_based", "make_sharded_stepper"]
 
 
 @flax.struct.dataclass
@@ -69,3 +70,43 @@ def state_specs_like(state: TrainState, p_specs: Any) -> TrainState:
 
     return TrainState(step=P(), params=p_specs, batch_stats=P(),
                       opt_state=mirror(state.opt_state))
+
+
+def reject_norm_based(tx, where: str) -> None:
+    """Shared guard: shard-local optimizer updates are only exact for
+    elementwise transforms; LARS trust ratios need global norms."""
+    if getattr(tx, "norm_based", False):
+        raise ValueError(
+            f"norm-based optimizers (LARS) are not supported by the "
+            f"{where}: trust ratios need global norms but the update is "
+            f"shard-local. Use sgd/nesterov here.")
+
+
+def make_sharded_stepper(step_fn: Callable, specs_fn: Callable, mesh,
+                         data_spec, donate: bool = True) -> Callable:
+    """Structure-keyed cache of jitted shard_map steps — the shared tail of
+    every multi-axis train-step factory (lm/pp/moe).
+
+    step_fn(state, a, b) -> (state, metrics); specs_fn(state_template) ->
+    PartitionSpec TrainState; data batches get `data_spec`, metrics P().
+    """
+    from jax.sharding import PartitionSpec as P
+
+    cache: dict = {}
+
+    def build(state_template):
+        specs = specs_fn(state_template)
+        shard_fn = jax.shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(specs, data_spec, data_spec),
+            out_specs=(specs, P()),
+            check_vma=False)
+        return jax.jit(shard_fn, donate_argnums=(0,) if donate else ())
+
+    def stepper(state, a, b):
+        key = jax.tree.structure(state)
+        if key not in cache:
+            cache[key] = build(state)
+        return cache[key](state, a, b)
+
+    return stepper

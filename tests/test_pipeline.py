@@ -17,7 +17,7 @@ from cpd_tpu.models.pipeline_lm import pipelined_lm, pp_param_specs
 from cpd_tpu.parallel.mesh import make_mesh
 from cpd_tpu.parallel.pipeline import pipeline_spmd
 from cpd_tpu.train import create_train_state, make_optimizer
-from cpd_tpu.train.pp import make_pp_train_step
+from cpd_tpu.train.pp import make_pp_train_step, pp_state_specs
 from cpd_tpu.train.state import TrainState
 
 
@@ -133,10 +133,8 @@ def test_pp_train_step_matches_single_device():
                        opt_state=tx.init(variables["params"]))
     specs = pp_param_specs(variables["params"])
     sharded_state = jax.device_put(
-        state, jax.tree.map(
-            lambda s: NamedSharding(mesh, s),
-            __import__("cpd_tpu.train.pp", fromlist=["pp_state_specs"])
-            .pp_state_specs(state)))
+        state, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            pp_state_specs(state)))
 
     step = make_pp_train_step(pp_model, tx, mesh, n_microbatches=4,
                               donate=False)
