@@ -95,7 +95,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     loss_fn: Callable = cross_entropy_loss,
                     rng_keys: tuple = (), rng_seed: int = 0,
                     ignore_label: Optional[int] = None,
-                    donate: bool = True):
+                    donate: bool = True,
+                    update_fn: Optional[Callable] = None,
+                    opt_state_spec: Optional[Any] = None):
     """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
 
     images: (global_batch * emulate_node, H, W, C) sharded over `axis_name`;
@@ -182,8 +184,15 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                                 grad_exp=grad_exp, grad_man=grad_man,
                                 use_kahan=use_kahan, mode=mode)
 
-        updates, new_opt = tx.update(reduced, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if update_fn is not None:
+            # custom update (e.g. parallel/zero.py ZeRO-1: shard-local
+            # optimizer math + param all_gather); must return the full
+            # replicated params and the (possibly sharded) new opt state
+            new_params, new_opt = update_fn(reduced, state, axis_name)
+        else:
+            updates, new_opt = tx.update(reduced, state.opt_state,
+                                         state.params)
+            new_params = optax.apply_updates(state.params, updates)
         new_stats = jax.tree.map(lambda s: lax.pmean(s, axis_name), new_stats)
 
         new_state = TrainState(step=state.step + 1, params=new_params,
@@ -202,12 +211,16 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         }
         return new_state, metrics
 
-    state_spec = P()            # replicated
+    if opt_state_spec is None:
+        state_spec: Any = P()   # fully replicated state
+    else:
+        state_spec = TrainState(step=P(), params=P(), batch_stats=P(),
+                                opt_state=opt_state_spec)
     data_spec = P(axis_name)    # batch-sharded
     shard_fn = jax.shard_map(
         step_fn, mesh=mesh,
         in_specs=(state_spec, data_spec, data_spec),
-        out_specs=(state_spec, state_spec),
+        out_specs=(state_spec, P()),
         check_vma=False)
     return jax.jit(shard_fn, donate_argnums=(0,) if donate else ())
 
