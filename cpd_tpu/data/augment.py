@@ -128,7 +128,37 @@ class TransformPipeline:
     def apply(self, x: np.ndarray, indices: np.ndarray) -> np.ndarray:
         if self.choices is None:
             raise RuntimeError("call resample(seed) before apply()")
+        fused = self._apply_fused(x, indices)
+        if fused is not None:
+            return fused
         out = x[indices]
         for t, ch in zip(self.transforms, self.choices):
             out = t(out, {k: v[indices] for k, v in ch.items()})
         return out
+
+    def _apply_fused(self, x: np.ndarray, indices: np.ndarray
+                     ) -> Optional[np.ndarray]:
+        """Native fused Crop -> FlipLR [-> Cutout] executor (one threaded
+        C++ pass, cpd_tpu/native/augment_native.cpp) for the canonical
+        chain; bitwise identical to the numpy path (pure copies/zeros).
+        Returns None when the chain doesn't match or the native lib is
+        unavailable — callers fall back transparently."""
+        kinds = [type(t).__name__ for t in self.transforms]
+        if (kinds not in (["Crop", "FlipLR"], ["Crop", "FlipLR", "Cutout"])
+                or x.dtype != np.float32):
+            return None
+        from .. import native
+        if not native.available():
+            return None
+        crop = self.transforms[0]
+        crop_ch, flip_ch = self.choices[0], self.choices[1]
+        cut_kwargs = {}
+        if len(self.transforms) == 3:
+            cut = self.transforms[2]
+            cut_kwargs = dict(cut_y=self.choices[2]["y0"],
+                              cut_x=self.choices[2]["x0"],
+                              cut_h=cut.h, cut_w=cut.w)
+        return native.fused_augment_np(
+            x, np.asarray(indices), crop_ch["y0"], crop_ch["x0"],
+            crop.h, crop.w, flip_ch["choice"].astype(np.uint8),
+            **cut_kwargs)

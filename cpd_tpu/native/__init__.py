@@ -29,16 +29,17 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["available", "float_quantize_np", "quant_gemm_np",
-           "ordered_sum_np", "build", "load"]
+           "ordered_sum_np", "fused_augment_np", "build", "load"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "quant_native.cpp")
+_SRCS = (os.path.join(_HERE, "quant_native.cpp"),
+         os.path.join(_HERE, "augment_native.cpp"))
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
 def _so_path() -> str:
-    return os.path.join(_HERE, "_quant_native.so")
+    return os.path.join(_HERE, "_cpd_native.so")
 
 
 def build(force: bool = False) -> Optional[str]:
@@ -46,7 +47,8 @@ def build(force: bool = False) -> Optional[str]:
     when no toolchain is available."""
     so = _so_path()
     if (not force and os.path.exists(so)
-            and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+            and os.path.getmtime(so) >= max(os.path.getmtime(s)
+                                            for s in _SRCS)):
         return so
     for cxx in (os.environ.get("CXX"), "g++", "c++", "clang++"):
         if not cxx:
@@ -55,7 +57,8 @@ def build(force: bool = False) -> Optional[str]:
         # imports (e.g. pytest-xdist workers racing).
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
         os.close(fd)
-        cmd = [cxx, "-O2", "-shared", "-fPIC", "-o", tmp, _SRC]
+        cmd = [cxx, "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp,
+               *_SRCS]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             os.replace(tmp, so)
@@ -87,6 +90,12 @@ def load() -> Optional[ctypes.CDLL]:
     lib.cpd_qgemm.argtypes = [fptr, fptr, fptr, i64, i64, i64, i32, i32]
     lib.cpd_ordered_sum.restype = None
     lib.cpd_ordered_sum.argtypes = [fptr, fptr, i64, i64, i32, i32, i32]
+    iptr = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    bptr = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.cpd_fused_augment.restype = None
+    lib.cpd_fused_augment.argtypes = [
+        fptr, iptr, i64, i64, i64, i64, iptr, iptr, i64, i64, bptr,
+        iptr, iptr, i64, i64, fptr, i64]
     _LIB = lib
     return _LIB
 
@@ -138,4 +147,35 @@ def ordered_sum_np(stacked: np.ndarray, exp: int, man: int,
     out = np.empty(stacked.shape[1:], np.float32)
     lib.cpd_ordered_sum(stacked.reshape(W, -1), out.reshape(-1), W, n,
                         exp, man, int(kahan))
+    return out
+
+
+def fused_augment_np(data: np.ndarray, indices: np.ndarray,
+                     crop_y: np.ndarray, crop_x: np.ndarray,
+                     oh: int, ow: int, flip: np.ndarray,
+                     cut_y: Optional[np.ndarray] = None,
+                     cut_x: Optional[np.ndarray] = None,
+                     cut_h: int = 0, cut_w: int = 0,
+                     n_threads: int = 0) -> np.ndarray:
+    """Fused crop -> flip -> cutout over a padded fp32 NHWC dataset.
+
+    `crop_*`/`flip`/`cut_*` are per-DATASET-sample pre-drawn choices
+    (TransformPipeline.resample's layout); `indices` selects the batch.
+    Bitwise identical to the numpy transform chain (pure copies/zeros).
+    n_threads=0 -> hardware concurrency."""
+    lib = _require()
+    data = np.ascontiguousarray(data, np.float32)
+    n_total, ih, iw, ch = data.shape
+    indices = np.ascontiguousarray(indices, np.int64)
+    b = indices.size
+    zero = np.zeros(n_total, np.int64)
+    out = np.empty((b, oh, ow, ch), np.float32)
+    lib.cpd_fused_augment(
+        data.reshape(-1), indices, b, ih, iw, ch,
+        np.ascontiguousarray(crop_y, np.int64),
+        np.ascontiguousarray(crop_x, np.int64), oh, ow,
+        np.ascontiguousarray(flip, np.uint8),
+        np.ascontiguousarray(cut_y, np.int64) if cut_h else zero,
+        np.ascontiguousarray(cut_x, np.int64) if cut_h else zero,
+        cut_h, cut_w, out.reshape(-1), n_threads)
     return out

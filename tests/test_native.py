@@ -99,3 +99,45 @@ def test_unavailable_paths_raise(monkeypatch):
     monkeypatch.setattr(native, "_TRIED", True)
     with pytest.raises(NotImplementedError):
         native.float_quantize_np(np.zeros(3, np.float32), 5, 2)
+
+
+def test_fused_augment_matches_numpy_chain():
+    """The native fused Crop->FlipLR->Cutout executor must be bitwise
+    identical to the numpy transform chain it replaces."""
+    import numpy as np
+    import pytest
+
+    from cpd_tpu import native
+    from cpd_tpu.data.augment import (Crop, Cutout, FlipLR,
+                                      TransformPipeline)
+
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(24, 40, 40, 3).astype(np.float32)
+    pipe = TransformPipeline([Crop(32, 32), FlipLR(), Cutout(8, 8)],
+                             data.shape)
+    pipe.resample(seed=5)
+    idx = rng.permutation(24)[:10]
+
+    got = pipe.apply(data, idx)                  # fused path (native up)
+    # force the numpy fallback for the oracle
+    fused = TransformPipeline._apply_fused
+    try:
+        TransformPipeline._apply_fused = lambda self, x, i: None
+        want = pipe.apply(data, idx)
+    finally:
+        TransformPipeline._apply_fused = fused
+    np.testing.assert_array_equal(got, want)
+
+    # no-cutout variant
+    pipe2 = TransformPipeline([Crop(32, 32), FlipLR()], data.shape)
+    pipe2.resample(seed=7)
+    got2 = pipe2.apply(data, idx)
+    try:
+        TransformPipeline._apply_fused = lambda self, x, i: None
+        want2 = pipe2.apply(data, idx)
+    finally:
+        TransformPipeline._apply_fused = fused
+    np.testing.assert_array_equal(got2, want2)
