@@ -13,10 +13,30 @@ import os
 __all__ = ["enable_compile_cache", "default_cache_dir"]
 
 
+def _machine_tag() -> str:
+    """Short hash of the host CPU's feature flags.
+
+    XLA:CPU AOT cache entries bake in the compile machine's features;
+    loading them on a different host warns 'could lead to SIGILL'
+    (observed when this repo's cache dir was shared across machines).
+    Keying the cache dir by machine identity makes that impossible."""
+    import hashlib
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha1(line.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+    return hashlib.sha1(platform.processor().encode()).hexdigest()[:10]
+
+
 def default_cache_dir() -> str:
-    """<repo root>/.jax_cache (repo root = parent of the cpd_tpu package)."""
+    """<repo root>/.jax_cache/<machine tag> (repo root = parent of the
+    cpd_tpu package)."""
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return os.path.join(os.path.dirname(pkg), ".jax_cache")
+    return os.path.join(os.path.dirname(pkg), ".jax_cache", _machine_tag())
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
