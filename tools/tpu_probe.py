@@ -1,0 +1,156 @@
+"""TPU perf probe — decompose ResNet-50 step time on the real chip.
+
+Run under an external watchdog (the tunnel can hang in native code):
+
+    timeout 560 python tools/tpu_probe.py [--profile-dir DIR]
+
+Phases, each timed in windows with a forced scalar device->host pull
+(block_until_ready alone has been observed not to block through the
+axon tunnel):
+
+  1. chained 4096^3 bf16 matmul  — raw MXU ceiling through the tunnel
+  2. ResNet-50 fwd (bs 32, 224)  — model forward cost
+  3. full train step, fp32 grads — +backward +SGD
+  4. train step, APS e5m2 fast   — +quantize/psum pipeline
+  5. train step, APS e5m2 faithful — +gather+ordered-scan collective
+
+Prints one line per phase; the deltas localize any slowdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def sync_scalar(x) -> float:
+    """Force completion + transfer (tunnel-proof sync)."""
+    import jax.numpy as jnp
+    return float(jnp.ravel(x)[0])
+
+
+def windows(fn, sync, n_windows=4, per=5):
+    rates = []
+    for _ in range(n_windows):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(per):
+            out = fn()
+        sync(out)
+        rates.append((time.perf_counter() - t0) / per)
+    rates.sort()
+    return rates[0], rates[len(rates) // 2]   # best, median seconds/iter
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--profile-dir", default=None)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--windows", type=int, default=4)
+    p.add_argument("--per", type=int, default=5)
+    args = p.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.utils import enable_compile_cache
+    enable_compile_cache()
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", flush=True)
+
+    import functools
+    win = functools.partial(windows, n_windows=args.windows, per=args.per)
+
+    # --- 1. raw matmul (CPU smoke runs shrink it) ---
+    k = 4096 if dev.platform == "tpu" else 512
+    a = jnp.asarray(np.random.RandomState(0).randn(k, k), jnp.bfloat16)
+    b = jnp.asarray(np.random.RandomState(1).randn(k, k), jnp.bfloat16)
+
+    @jax.jit
+    def mm(x):
+        return (x @ b) * jnp.bfloat16(0.125)
+
+    state_holder = {"x": a}
+
+    def mm_step():
+        state_holder["x"] = mm(state_holder["x"])
+        return state_holder["x"]
+
+    sync_scalar(mm(a))
+    best, med = win(mm_step, sync_scalar)
+    print(f"matmul {k}^3 bf16: best {2*k**3/best/1e12:.1f} TFLOP/s "
+          f"({best*1e3:.2f} ms), median {2*k**3/med/1e12:.1f}", flush=True)
+
+    # --- model phases ---
+    from cpd_tpu.models import resnet50
+    from cpd_tpu.parallel.mesh import make_mesh
+    from cpd_tpu.train import (create_train_state, make_optimizer,
+                               make_train_step, warmup_step_decay)
+
+    batch = args.batch
+    model = resnet50(dtype=jnp.bfloat16)
+    mesh = make_mesh(dp=1, devices=jax.devices()[:1])
+    schedule = warmup_step_decay(3.2, 500, [3000, 6000])
+    tx = make_optimizer("sgd", schedule, momentum=0.9, weight_decay=1e-4)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 224, 224, 3).astype(np.float32),
+                    jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
+    t0 = time.perf_counter()
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    sync_scalar(jax.tree.leaves(state.params)[0])
+    print(f"init: {time.perf_counter()-t0:.1f}s", flush=True)
+
+    # 2. forward only
+    fwd = jax.jit(lambda p, s, xx: model.apply(
+        {"params": p, "batch_stats": s}, xx, train=False))
+    t0 = time.perf_counter()
+    sync_scalar(fwd(state.params, state.batch_stats, x))
+    print(f"fwd compile+run: {time.perf_counter()-t0:.1f}s", flush=True)
+    best, med = win(lambda: fwd(state.params, state.batch_stats, x),
+                    sync_scalar)
+    print(f"fwd-only: best {batch/best:.1f} img/s ({best*1e3:.1f} ms), "
+          f"median {batch/med:.1f}", flush=True)
+
+    # 3-5. train-step variants
+    variants = [
+        ("step fp32-grads", dict(use_aps=False, grad_exp=8, grad_man=23,
+                                 mode="fast")),
+        ("step APS e5m2 fast", dict(use_aps=True, grad_exp=5, grad_man=2,
+                                    mode="fast")),
+        ("step APS e5m2 faithful", dict(use_aps=True, grad_exp=5,
+                                        grad_man=2, mode="faithful")),
+    ]
+    for name, kw in variants:
+        step = make_train_step(model, tx, mesh, donate=False, **kw)
+        holder = {"s": state}
+
+        def one_step():
+            holder["s"], m = step(holder["s"], x, y)
+            return m["loss"]
+
+        t0 = time.perf_counter()
+        sync_scalar(one_step())
+        print(f"{name} compile+run: {time.perf_counter()-t0:.1f}s",
+              flush=True)
+        best, med = win(one_step, sync_scalar)
+        print(f"{name}: best {batch/best:.1f} img/s ({best*1e3:.1f} ms), "
+              f"median {batch/med:.1f}", flush=True)
+        if args.profile_dir and name == "step APS e5m2 faithful":
+            import jax.profiler
+            with jax.profiler.trace(args.profile_dir):
+                for _ in range(3):
+                    sync_scalar(one_step())
+            print(f"trace -> {args.profile_dir}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
