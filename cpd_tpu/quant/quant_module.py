@@ -9,9 +9,10 @@ the im2col patch extraction in QuantConv).
 
 Weight layout parity: QuantLinear stores weight as (out_features,
 in_features) like torch.nn.Linear (quant_module.py:63); QuantConv stores
-(out_channels, in_channels, kh, kw) (quant_module.py:92-93).  Like the
-reference, QuantConv supports square kernels and ignores dilation/groups
-(documented quirk, quant_module.py:89-90 — args accepted, unused).
+(out_channels, in_channels/groups, kh, kw) (quant_module.py:92-93).
+Square kernels only, like the reference; unlike the reference — which
+accepts dilation/groups but silently ignores them (quant_module.py:89-90)
+— both are implemented with torch semantics.
 """
 
 from __future__ import annotations
@@ -115,9 +116,12 @@ class QuantConv(nn.Module):
     """2-D convolution via im2col + quantized GEMM (quant_module.py:88-139).
 
     NCHW layout for API parity with the reference.  Square kernels only.
-    The reference accepts-and-ignores `dilation`/`groups` and silently
-    computes a dense dilation-1 conv (quant_module.py:89-90); we deviate by
-    raising instead — silent wrong numerics in a fresh API helps no one.
+    Deviation (documented, strictly better): the reference ACCEPTS
+    `dilation`/`groups` but silently computes a dense dilation-1 conv
+    (quant_module.py:89-90); here both are implemented — dilated patch
+    extraction, and grouped conv as one quantized GEMM per group over the
+    group's contiguous im2col columns (torch semantics, incl. the
+    in_channels/groups fan-in for kaiming init).
     """
     in_channels: int
     out_channels: int
@@ -133,17 +137,19 @@ class QuantConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        if self.dilation != 1 or self.groups != 1:
+        g = self.groups
+        if self.in_channels % g or self.out_channels % g:
             raise ValueError(
-                "QuantConv supports dilation=1, groups=1 only (the reference "
-                f"silently ignores them); got dilation={self.dilation}, "
-                f"groups={self.groups}")
+                f"groups={g} must divide in_channels={self.in_channels} "
+                f"and out_channels={self.out_channels}")
         k = self.kernel_size
-        fan_in = self.in_channels * k * k
+        c_g = self.in_channels // g
+        o_g = self.out_channels // g
+        fan_in = c_g * k * k                 # torch fan-in under groups
         weight = self.param(
             "weight",
             lambda kk, s: _kaiming_uniform(kk, s, fan_in),
-            (self.out_channels, self.in_channels, k, k))
+            (self.out_channels, c_g, k, k))
         bias = None
         if self.use_bias:
             bias = self.param(
@@ -152,25 +158,35 @@ class QuantConv(nn.Module):
                 (self.out_channels,))
 
         b, c, h, w = x.shape
-        out_h = (h - k + 2 * self.padding) // self.stride + 1
-        out_w = (w - k + 2 * self.padding) // self.stride + 1
+        d = self.dilation
+        span = d * (k - 1) + 1               # dilated receptive field
+        out_h = (h + 2 * self.padding - span) // self.stride + 1
+        out_w = (w + 2 * self.padding - span) // self.stride + 1
 
         # im2col matching torch.nn.functional.unfold's (C, kh, kw)-major
-        # patch layout (quant_module.py:135-136).
-        # conv_general_dilated_patches returns feature dim ordered as
-        # (C, kh, kw) flattened — same as unfold.
+        # patch layout (quant_module.py:135-136); rhs_dilation dilates the
+        # sampling grid exactly as unfold's `dilation`.
         patches = lax.conv_general_dilated_patches(
             x,
             filter_shape=(k, k),
             window_strides=(self.stride, self.stride),
             padding=[(self.padding, self.padding)] * 2,
+            rhs_dilation=(d, d),
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
         )  # (B, C*k*k, out_h, out_w)
         patches = patches.reshape(b, c * k * k, out_h * out_w)
         patches = jnp.transpose(patches, (0, 2, 1)).reshape(b * out_h * out_w,
                                                             c * k * k)
-        w2 = weight.reshape(self.out_channels, c * k * k)
-        y = quant_linear_fn(patches, w2, bias, self.exp, self.man, self.mode)
+        # per-group GEMM over the group's contiguous im2col columns (the
+        # feature dim is channel-major, so group channels are adjacent)
+        outs = []
+        for gi in range(g):
+            cols = patches[:, gi * c_g * k * k:(gi + 1) * c_g * k * k]
+            w2 = weight[gi * o_g:(gi + 1) * o_g].reshape(o_g, c_g * k * k)
+            b2 = None if bias is None else bias[gi * o_g:(gi + 1) * o_g]
+            outs.append(quant_linear_fn(cols, w2, b2, self.exp, self.man,
+                                        self.mode))
+        y = outs[0] if g == 1 else jnp.concatenate(outs, axis=-1)
         y = y.reshape(b, out_h * out_w, self.out_channels)
         y = jnp.transpose(y, (0, 2, 1))
         return y.reshape(b, self.out_channels, out_h, out_w)

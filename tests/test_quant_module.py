@@ -90,3 +90,38 @@ def test_quant_conv_grad_flows():
     grads = jax.grad(loss)(params, x)
     assert grads["params"]["weight"].shape == (3, 2, 3, 3)
     assert np.isfinite(np.asarray(grads["params"]["weight"])).all()
+
+
+@pytest.mark.parametrize("dilation,groups", [(2, 1), (1, 2), (2, 2)])
+def test_quant_conv_dilation_groups_vs_torch(dilation, groups):
+    """Dilated/grouped QuantConv at fp32 precision must equal
+    torch.nn.functional.conv2d (the quantized-GEMM numerics are separately
+    oracle-tested; (8,23) makes the GEMM exact up to fp32 summation order,
+    so compare with a small tolerance)."""
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(7)
+    B, C, H, W, O, k = 2, 4, 9, 9, 6, 3
+    x = rng.standard_normal((B, C, H, W)).astype(np.float32)
+    wgt = rng.standard_normal((O, C // groups, k, k)).astype(np.float32)
+    bias = rng.standard_normal((O,)).astype(np.float32)
+
+    m = QuantConv(in_channels=C, out_channels=O, kernel_size=k, stride=1,
+                  padding=dilation, dilation=dilation, groups=groups,
+                  exp=8, man=23)
+    variables = {"params": {"weight": jnp.asarray(wgt),
+                            "bias": jnp.asarray(bias)}}
+    got = np.asarray(m.apply(variables, jnp.asarray(x)))
+
+    want = F.conv2d(torch.from_numpy(x), torch.from_numpy(wgt),
+                    torch.from_numpy(bias), stride=1, padding=dilation,
+                    dilation=dilation, groups=groups).numpy()
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_quant_conv_groups_must_divide():
+    m = QuantConv(in_channels=3, out_channels=4, kernel_size=3, groups=2)
+    with pytest.raises(ValueError):
+        m.init(jax.random.PRNGKey(0), jnp.ones((1, 3, 6, 6)))
