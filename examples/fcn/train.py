@@ -38,6 +38,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--print-freq", default=50, type=int)
     p.add_argument("--save-path", default="fcn_ckpt")
     p.add_argument("--val-freq", default=4000, type=int)
+    p.add_argument("--ckpt-freq", default=4000, type=int,
+                   help="checkpoint interval (mmcv CheckpointHook parity)")
     # precision flags — the reference's edit-a-source-line, as real flags
     p.add_argument("--grad_exp", default=8, type=int)
     p.add_argument("--grad_man", default=23, type=int)
@@ -102,6 +104,22 @@ def main(argv=None) -> dict:
         model, tx, jnp.zeros((1, args.crop_size, args.crop_size, 3)),
         jax.random.PRNGKey(0))
 
+    # interval checkpoints + auto-resume — the mmcv runner's
+    # CheckpointHook/resume behavior the reference relies on
+    # (README.md:132-150); restored arrays are re-replicated over the mesh
+    from cpd_tpu.parallel.dist import replicate
+    from cpd_tpu.train import CheckpointManager
+    manager = CheckpointManager(os.path.abspath(
+        os.path.join(args.save_path, "ckpt")), track_best=False)
+    start_iter = 0
+    restored = manager.restore(state)
+    if restored is not None:
+        state = restored
+        start_iter = int(restored.step)
+        if rank == 0:
+            print(f"=> resumed from iter {start_iter}")
+    state = replicate(state, mesh)
+
     step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
@@ -118,7 +136,7 @@ def main(argv=None) -> dict:
     last = {}
     profiler = StepProfiler(args.profile_dir, start=3)
     t0 = time.time()
-    for it in range(1, args.max_iter + 1):
+    for it in range(start_iter + 1, args.max_iter + 1):
         profiler.step(it)
         idx = rng.randint(0, len(ds), size=host_batch)
         x, y = ds.batch(idx, seed=it)
@@ -128,7 +146,11 @@ def main(argv=None) -> dict:
         progress.maybe_print(it, Loss=last["loss"],
                              PixAcc=100 * last["accuracy"])
         writer.add_scalar("train/loss", last["loss"], it)
+        if it % args.ckpt_freq == 0 or it == args.max_iter:
+            manager.save(it, state)
     jax.block_until_ready(state.params)
+    manager.wait()
+    manager.close()
     profiler.close()
     if rank == 0:
         print(f"done: {args.max_iter} iters in {time.time()-t0:.1f}s "

@@ -148,13 +148,19 @@ def test_fcn_trainer_smoke(tmp_path):
     # auxiliary loss through the full quantized pipeline, fed by the
     # leftImg8bit/gtFine walker (19 trainId classes)
     root = _write_tiny_cityscapes(str(tmp_path / "cs"))
-    res = main(["--crop-size", "32", "--batch-size", "1", "--max-iter", "2",
-                "--data-root", root, "--tiny-backbone", "--aux-head",
-                "--use_APS", "--grad_exp", "5", "--grad_man", "2",
-                "--save-path", str(tmp_path / "fcn"), "--mode", "faithful"])
+    common = ["--crop-size", "32", "--batch-size", "1", "--data-root", root,
+              "--tiny-backbone", "--aux-head", "--use_APS",
+              "--grad_exp", "5", "--grad_man", "2", "--ckpt-freq", "2",
+              "--save-path", str(tmp_path / "fcn"), "--mode", "faithful"]
+    res = main(common + ["--max-iter", "2"])
     assert res["step"] == 2
     assert math.isfinite(res["loss"])
     assert 0.0 <= res["accuracy"] <= 1.0
+    # interval checkpoint written; auto-resume picks it up (0 iters left —
+    # the continue-training path is covered by the resnet18 resume test,
+    # which exercises the same CheckpointManager + replicate machinery)
+    res2 = main(common + ["--max-iter", "2"])
+    assert res2["step"] == 2 and "loss" not in res2
 
 
 def test_draw_curve_parses_both_formats(tmp_path):
