@@ -46,6 +46,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--print-freq", default=10, type=int)
     p.add_argument("--save-path", default="lm_ckpt")
     p.add_argument("--val-freq", default=100, type=int)
+    p.add_argument("--ckpt-freq", default=500, type=int)
     # the reference-parity precision flags
     p.add_argument("--grad_exp", default=8, type=int)
     p.add_argument("--grad_man", default=23, type=int)
@@ -106,6 +107,28 @@ def main(argv=None) -> dict:
 
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
     state = create_train_state(init_model, tx, sample, jax.random.PRNGKey(0))
+
+    # checkpoints of the tp/sp-SHARDED state: orbax saves the global
+    # arrays; on restore the state is re-laid-out with the Megatron
+    # PartitionSpecs (lm_state_specs) before training continues
+    from jax.sharding import NamedSharding
+    from cpd_tpu.train import CheckpointManager
+    from cpd_tpu.train.lm import lm_state_specs
+    manager = CheckpointManager(os.path.abspath(
+        os.path.join(args.save_path, "ckpt")), track_best=False)
+    start_iter = 0
+    restored = manager.restore(state)
+    if restored is not None:
+        state = restored
+        start_iter = int(restored.step)
+        if rank == 0:
+            print(f"=> resumed from iter {start_iter}")
+    from jax.sharding import PartitionSpec
+    state = jax.device_put(
+        state, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            lm_state_specs(state),
+                            is_leaf=lambda s: isinstance(s, PartitionSpec)))
+
     step = make_lm_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
@@ -131,7 +154,7 @@ def main(argv=None) -> dict:
     # training indices exclude the held-out validation tail
     train_n = len(ds) - len(val_idx)
     profiler = StepProfiler(args.profile_dir, start=3)
-    for it in range(1, args.max_iter + 1):
+    for it in range(start_iter + 1, args.max_iter + 1):
         profiler.step(it)
         idx = rng.randint(0, train_n, size=global_batch)
         toks, tgts = ds.batch(idx, seed=it)
@@ -144,7 +167,11 @@ def main(argv=None) -> dict:
         writer.add_scalar("train/loss", last["loss"], it)
         if it % args.val_freq == 0 or it == args.max_iter:
             validate(it)
+        if it % args.ckpt_freq == 0 or it == args.max_iter:
+            manager.save(it, state)
     jax.block_until_ready(state.params)
+    manager.wait()
+    manager.close()
     profiler.close()
     dt = time.time() - t0
     if rank == 0:

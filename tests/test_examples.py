@@ -311,10 +311,16 @@ def test_seg_loss_ignores_ignore_label():
 def test_lm_trainer_smoke(tmp_path):
     from lm.train import main
 
-    res = main(["--dp", "2", "--sp", "2", "--tp", "2", "--seq-len", "32",
-                "--d-model", "32", "--n-layers", "2", "--n-heads", "4",
-                "--vocab-size", "64", "--batch-size", "2", "--max-iter", "3",
-                "--use_APS", "--grad_exp", "5", "--grad_man", "2",
-                "--save-path", str(tmp_path / "lm"), "--mode", "faithful"])
+    argv = ["--dp", "2", "--sp", "2", "--tp", "2", "--seq-len", "32",
+            "--d-model", "32", "--n-layers", "2", "--n-heads", "4",
+            "--vocab-size", "64", "--batch-size", "2", "--max-iter", "3",
+            "--use_APS", "--grad_exp", "5", "--grad_man", "2",
+            "--ckpt-freq", "3",
+            "--save-path", str(tmp_path / "lm"), "--mode", "faithful"]
+    res = main(argv)
     assert res["step"] == 3
     assert math.isfinite(res["loss"])
+    # sharded-state checkpoint written; auto-resume restores and re-lays
+    # it out over the dp x sp x tp mesh (0 iters left)
+    res2 = main(argv)
+    assert res2["step"] == 3 and "loss" not in res2
