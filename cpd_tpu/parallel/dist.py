@@ -185,7 +185,7 @@ def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
 def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   use_aps: bool = False, grad_exp: int = 5, grad_man: int = 2,
                   use_kahan: bool = False, mode: str = "faithful",
-                  bucket: bool = True) -> Any:
+                  bucket: Optional[bool] = None) -> Any:
     """Low-precision gradient all-reduce (SUM) over `axis_name`.
 
     Pure pytree-in/pytree-out version of reference `sum_gradients`
@@ -198,10 +198,16 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
     use_kahan   → Kahan-compensated ordered accumulation (dist_util.py:72-89).
     mode        → "faithful" (gather + ordered scan) | "fast" (quantize+psum).
     bucket      → faithful mode only: fuse per-leaf gathers into few large
-                  per-dtype buckets (bit-identical; default on).
+                  per-dtype buckets (bit-identical).  Default (None) =
+                  auto: on for TPU — fewer collective launches riding ICI
+                  — off elsewhere (on the CPU mesh the gather is a plain
+                  memcpy and the bucket concat/split copies measured ~17%
+                  slower on a ResNet-18-sized pytree).
     """
     if mode not in ("faithful", "fast"):
         raise ValueError(f"unknown mode {mode!r}")
+    if bucket is None:
+        bucket = jax.default_backend() == "tpu"
     world = lax.psum(jnp.float32(1.0), axis_name)
 
     shifts = None
