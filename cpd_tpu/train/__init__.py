@@ -7,12 +7,12 @@ from .optim import lars, make_optimizer, sgd
 from .schedules import iter_table, piecewise_linear, warmup_step_decay
 from .metrics import AverageMeter, Timer, accuracy
 from .lm import lm_state_specs, make_lm_train_step
-from .pp import make_pp_train_step, pp_state_specs
-from .moe import make_moe_train_step, moe_state_specs
+from .pp import make_pp_eval_step, make_pp_train_step, pp_state_specs
+from .moe import make_moe_eval_step, make_moe_train_step, moe_state_specs
 
 __all__ = [
-    "make_pp_train_step", "pp_state_specs",
-    "make_moe_train_step", "moe_state_specs",
+    "make_pp_train_step", "make_pp_eval_step", "pp_state_specs",
+    "make_moe_train_step", "make_moe_eval_step", "moe_state_specs",
     "TrainState", "create_train_state",
     "cross_entropy_loss", "seg_cross_entropy_loss", "make_eval_step",
     "make_train_step",

@@ -29,7 +29,7 @@ from ..parallel.dist import sum_gradients
 from .state import (TrainState, make_sharded_stepper, reject_norm_based,
                     state_specs_like)
 
-__all__ = ["make_moe_train_step", "moe_state_specs"]
+__all__ = ["make_moe_train_step", "make_moe_eval_step", "moe_state_specs"]
 
 
 def moe_state_specs(state: TrainState, ep_axis: str = "ep") -> TrainState:
@@ -99,3 +99,34 @@ def make_moe_train_step(model: MoETransformerLM,
     return make_sharded_stepper(
         step_fn, lambda s: moe_state_specs(s, axis_ep), mesh,
         P(data_axes), donate=donate)
+
+
+def make_moe_eval_step(model: MoETransformerLM, mesh: Mesh, *,
+                       axis_dp: str = "dp", axis_ep: str = "ep"):
+    """Jitted ``(state, tokens, targets) -> {'loss','accuracy'}`` over the
+    same (dp, ep) token sharding as the train step."""
+    data_axes = (axis_dp, axis_ep)
+    cache: dict = {}
+
+    def eval_fn(state: TrainState, tokens, targets):
+        logits = model.apply({"params": state.params}, tokens, train=False)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        hits = jnp.sum(jnp.argmax(logits, -1) == targets)
+        total = lax.psum(jnp.float32(ce.size), data_axes)
+        return {
+            "loss": lax.psum(ce.sum(), data_axes) / total,
+            "accuracy": lax.psum(hits.astype(jnp.float32),
+                                 data_axes) / total,
+        }
+
+    def runner(state, tokens, targets):
+        key = jax.tree.structure(state)
+        if key not in cache:
+            specs = moe_state_specs(state, axis_ep)
+            cache[key] = jax.jit(jax.shard_map(
+                eval_fn, mesh=mesh,
+                in_specs=(specs, P(data_axes), P(data_axes)),
+                out_specs=P(), check_vma=False))
+        return cache[key](state, tokens, targets)
+
+    return runner

@@ -30,7 +30,7 @@ from ..parallel.dist import sum_gradients
 from .state import (TrainState, make_sharded_stepper, reject_norm_based,
                     state_specs_like)
 
-__all__ = ["make_pp_train_step", "pp_state_specs"]
+__all__ = ["make_pp_train_step", "make_pp_eval_step", "pp_state_specs"]
 
 
 def pp_state_specs(state: TrainState, pp_axis: str = "pp",
@@ -120,3 +120,40 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
     return make_sharded_stepper(
         step_fn, lambda s: pp_state_specs(s, axis_pp, axis_tp), mesh,
         P(axis_dp), donate=donate)
+
+
+def make_pp_eval_step(model: PipelinedLM, mesh: Mesh, *,
+                      n_microbatches: int = 4, axis_dp: str = "dp",
+                      axis_pp: str = "pp", axis_tp: str = "tp"):
+    """Jitted ``(state, tokens, targets) -> {'loss','accuracy'}`` over the
+    same dp x pp sharding as the train step (no grads, no update)."""
+    pp_size = mesh.shape.get(axis_pp, 1)
+    all_axes = (axis_dp, axis_pp, axis_tp)
+    cache: dict = {}
+
+    def eval_fn(state: TrainState, tokens, targets):
+        is_last = (lax.axis_index(axis_pp) == pp_size - 1
+                   ).astype(jnp.float32)
+        logits = model.apply_pipelined({"params": state.params}, tokens,
+                                       n_microbatches)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        hits = jnp.sum(jnp.argmax(logits, -1) == targets) * is_last
+        n = jnp.float32(ce.size) * is_last
+        total = lax.psum(n, all_axes)
+        return {
+            "loss": lax.psum(ce.sum() * is_last, all_axes) / total,
+            "accuracy": lax.psum(hits.astype(jnp.float32),
+                                 all_axes) / total,
+        }
+
+    def runner(state, tokens, targets):
+        key = jax.tree.structure(state)
+        if key not in cache:
+            specs = pp_state_specs(state, axis_pp, axis_tp)
+            cache[key] = jax.jit(jax.shard_map(
+                eval_fn, mesh=mesh,
+                in_specs=(specs, P(axis_dp), P(axis_dp)),
+                out_specs=P(), check_vma=False))
+        return cache[key](state, tokens, targets)
+
+    return runner

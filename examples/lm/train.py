@@ -33,6 +33,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel size (0 = all remaining devices)")
     p.add_argument("--sp", default=1, type=int, help="sequence-parallel")
     p.add_argument("--tp", default=1, type=int, help="tensor-parallel")
+    p.add_argument("--pp", default=1, type=int,
+                   help="pipeline-parallel (GPipe; excludes sp/tp/moe)")
+    p.add_argument("--n-microbatches", default=4, type=int,
+                   help="pipeline microbatches per step (with --pp)")
+    p.add_argument("--moe", action="store_true",
+                   help="Switch-style MoE feed-forward (excludes sp/tp/pp)")
+    p.add_argument("--ep", default=1, type=int,
+                   help="expert-parallel size (with --moe)")
+    p.add_argument("--n-experts", default=4, type=int)
     p.add_argument("--vocab-size", default=256, type=int)
     p.add_argument("--d-model", default=256, type=int)
     p.add_argument("--n-layers", default=4, type=int)
@@ -76,7 +85,15 @@ def main(argv=None) -> dict:
     from cpd_tpu.utils import ProgressPrinter, ScalarWriter, StepProfiler
 
     rank, world = dist_init() if args.dist else (0, 1)
-    mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
+    if (args.pp > 1 or args.moe) and (args.sp > 1 or args.tp > 1):
+        raise ValueError("--pp/--moe do not compose with sp/tp here")
+    if args.pp > 1 and args.moe:
+        raise ValueError("--pp and --moe are mutually exclusive")
+    if (args.pp > 1 or args.moe) and args.emulate_node != 1:
+        raise ValueError("--emulate_node is only supported on the "
+                         "default dp/sp/tp path")
+    mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp,
+                     ep=args.ep if args.moe else 1)
     dp = mesh.shape["dp"]
 
     if args.seq_len % args.sp:
@@ -92,28 +109,73 @@ def main(argv=None) -> dict:
 
     model_kw = dict(vocab_size=args.vocab_size, d_model=args.d_model,
                     n_layers=args.n_layers, n_heads=args.n_heads)
-    model = transformer_lm(tp_axis="tp" if args.tp > 1 else None,
-                           sp_axis="sp" if args.sp > 1 else None,
-                           tp_size=args.tp, **model_kw)
-    init_model = transformer_lm(**model_kw)
-
     schedule = warmup_step_decay(args.base_lr, args.warmup_iters,
                                  [args.max_iter * 2], warmup_from=0.0)
     tx = make_optimizer("sgd", schedule, momentum=0.9)
 
     ds = SyntheticText(n=4096, seq_len=args.seq_len,
                        vocab_size=args.vocab_size)
-    global_batch = args.batch_size * dp * args.emulate_node
-
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
-    state = create_train_state(init_model, tx, sample, jax.random.PRNGKey(0))
+    quant_kw = dict(use_aps=args.use_APS, grad_exp=args.grad_exp,
+                    grad_man=args.grad_man, use_kahan=args.use_kahan,
+                    mode=args.mode)
 
-    # checkpoints of the tp/sp-SHARDED state: orbax saves the global
-    # arrays; on restore the state is re-laid-out with the Megatron
-    # PartitionSpecs (lm_state_specs) before training continues
-    from jax.sharding import NamedSharding
+    if args.pp > 1:
+        # GPipe pipeline path (parallel/pipeline.py, train/pp.py)
+        from cpd_tpu.models import pipelined_lm
+        from cpd_tpu.train import make_pp_eval_step, make_pp_train_step
+        from cpd_tpu.train.pp import pp_state_specs
+        from cpd_tpu.train.state import TrainState
+        pp_model = pipelined_lm(**model_kw, pp_axis="pp", pp_size=args.pp)
+        variables = pipelined_lm(**model_kw).init(jax.random.PRNGKey(0),
+                                                  sample)
+        state = TrainState(step=jnp.zeros([], jnp.int32),
+                           params=variables["params"], batch_stats={},
+                           opt_state=tx.init(variables["params"]))
+        step = make_pp_train_step(pp_model, tx, mesh,
+                                  n_microbatches=args.n_microbatches,
+                                  **quant_kw)
+        eval_step = make_pp_eval_step(pp_model, mesh,
+                                      n_microbatches=args.n_microbatches)
+        specs_fn = pp_state_specs
+        global_batch = args.batch_size * dp
+    elif args.moe:
+        # expert-parallel path (models/moe.py, train/moe.py)
+        from cpd_tpu.models import moe_lm
+        from cpd_tpu.train import make_moe_eval_step, make_moe_train_step
+        from cpd_tpu.train.moe import moe_state_specs
+        from cpd_tpu.train.state import TrainState
+        ep = mesh.shape["ep"]
+        moe_kw = dict(**model_kw, n_experts=args.n_experts)
+        moe_model = moe_lm(**moe_kw, ep_axis="ep" if ep > 1 else None,
+                           ep_size=ep)
+        variables = moe_lm(**moe_kw).init(jax.random.PRNGKey(0), sample)
+        state = TrainState(step=jnp.zeros([], jnp.int32),
+                           params=variables["params"], batch_stats={},
+                           opt_state=tx.init(variables["params"]))
+        step = make_moe_train_step(moe_model, tx, mesh, **quant_kw)
+        eval_step = make_moe_eval_step(moe_model, mesh)
+        specs_fn = moe_state_specs
+        global_batch = args.batch_size * dp * ep
+    else:
+        from cpd_tpu.train.lm import lm_state_specs
+        model = transformer_lm(tp_axis="tp" if args.tp > 1 else None,
+                               sp_axis="sp" if args.sp > 1 else None,
+                               tp_size=args.tp, **model_kw)
+        init_model = transformer_lm(**model_kw)
+        state = create_train_state(init_model, tx, sample,
+                                   jax.random.PRNGKey(0))
+        step = make_lm_train_step(model, tx, mesh,
+                                  emulate_node=args.emulate_node,
+                                  **quant_kw)
+        eval_step = make_lm_eval_step(model, mesh)
+        specs_fn = lm_state_specs
+        global_batch = args.batch_size * dp * args.emulate_node
+
+    # checkpoints of the SHARDED state: orbax saves the global arrays; on
+    # restore the state is re-laid-out with the path's PartitionSpecs
+    from jax.sharding import NamedSharding, PartitionSpec
     from cpd_tpu.train import CheckpointManager
-    from cpd_tpu.train.lm import lm_state_specs
     manager = CheckpointManager(os.path.abspath(
         os.path.join(args.save_path, "ckpt")), track_best=False)
     start_iter = 0
@@ -123,19 +185,14 @@ def main(argv=None) -> dict:
         start_iter = int(restored.step)
         if rank == 0:
             print(f"=> resumed from iter {start_iter}")
-    from jax.sharding import PartitionSpec
     state = jax.device_put(
         state, jax.tree.map(lambda s: NamedSharding(mesh, s),
-                            lm_state_specs(state),
+                            specs_fn(state),
                             is_leaf=lambda s: isinstance(s, PartitionSpec)))
-
-    step = make_lm_train_step(
-        model, tx, mesh, emulate_node=args.emulate_node,
-        use_aps=args.use_APS, grad_exp=args.grad_exp,
-        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode)
-    eval_step = make_lm_eval_step(model, mesh)
-    # held-out tail of the synthetic corpus for validation
-    val_idx = np.arange(len(ds) - args.batch_size * dp, len(ds))
+    # held-out tail of the synthetic corpus for validation (sized to the
+    # eval step's data sharding: dp, dp x ep, ... depending on path)
+    val_bs = global_batch // args.emulate_node
+    val_idx = np.arange(len(ds) - val_bs, len(ds))
     val_toks, val_tgts = ds.batch(val_idx, seed=-1)
 
     def validate(it):

@@ -324,3 +324,21 @@ def test_lm_trainer_smoke(tmp_path):
     # it out over the dp x sp x tp mesh (0 iters left)
     res2 = main(argv)
     assert res2["step"] == 3 and "loss" not in res2
+
+
+def test_lm_trainer_pp_and_moe_paths(tmp_path):
+    """--pp and --moe switch the trainer onto the pipeline / expert
+    parallel step builders (GPipe streaming, all_to_all dispatch)."""
+    from lm.train import main
+
+    common = ["--seq-len", "32", "--d-model", "32", "--n-layers", "4",
+              "--n-heads", "4", "--vocab-size", "64", "--batch-size", "4",
+              "--max-iter", "2", "--val-freq", "2", "--ckpt-freq", "99",
+              "--use_APS", "--grad_exp", "5", "--grad_man", "2"]
+    r = main(common + ["--dp", "4", "--pp", "2",
+                       "--save-path", str(tmp_path / "pp")])
+    assert r["step"] == 2 and math.isfinite(r["loss"])
+    r = main(common + ["--dp", "4", "--moe", "--ep", "2",
+                       "--n-experts", "4",
+                       "--save-path", str(tmp_path / "moe")])
+    assert r["step"] == 2 and math.isfinite(r["loss"])

@@ -129,3 +129,27 @@ def test_moe_capacity_drops_tokens():
     out_small = np.asarray(small.apply(variables, tokens))
     assert np.all(np.isfinite(out_small))
     assert not np.allclose(out_big, out_small)
+
+
+def test_moe_eval_step_matches_sequential():
+    import optax
+    from cpd_tpu.train.moe import make_moe_eval_step
+
+    ep, dp = 4, 2
+    mesh = make_mesh(dp=dp, ep=ep)
+    tokens = _tokens(b=16, t=8, seed=9)
+    targets = _tokens(b=16, t=8, seed=10)
+    ref = _model(ep_size=1)
+    variables = ref.init(jax.random.PRNGKey(2), tokens[:2])
+    want = optax.softmax_cross_entropy_with_integer_labels(
+        ref.apply(variables, tokens), targets).mean()
+
+    moe_model = _model(ep_size=ep)
+    tx = make_optimizer("sgd", lambda s: jnp.float32(0.1))
+    state = TrainState(step=jnp.zeros([], jnp.int32),
+                       params=variables["params"], batch_stats={},
+                       opt_state=tx.init(variables["params"]))
+    ev = make_moe_eval_step(moe_model, mesh)
+    m = ev(state, tokens, targets)
+    np.testing.assert_allclose(float(m["loss"]), float(want), rtol=2e-4,
+                               atol=2e-4)

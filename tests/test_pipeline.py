@@ -151,3 +151,27 @@ def test_pp_train_step_matches_single_device():
             jax.tree_util.tree_flatten_with_path(want_params)[0]):
         np.testing.assert_allclose(got, np.asarray(want), rtol=2e-3,
                                    atol=2e-4, err_msg=str(path))
+
+
+def test_pp_eval_step_matches_sequential():
+    import optax
+    from cpd_tpu.train.pp import make_pp_eval_step
+
+    pp, dp = 4, 2
+    mesh = make_mesh(pp=pp, dp=dp)
+    model = _lm()
+    tokens = _tokens(b=8, t=16, seed=9)
+    targets = _tokens(b=8, t=16, seed=10)
+    variables = model.init(jax.random.PRNGKey(2), tokens[:2])
+    want = optax.softmax_cross_entropy_with_integer_labels(
+        model.apply(variables, tokens), targets).mean()
+
+    pp_model = _lm(pp_axis="pp", pp_size=pp)
+    tx = make_optimizer("sgd", lambda s: jnp.float32(0.1))
+    state = TrainState(step=jnp.zeros([], jnp.int32),
+                       params=variables["params"], batch_stats={},
+                       opt_state=tx.init(variables["params"]))
+    ev = make_pp_eval_step(pp_model, mesh, n_microbatches=4)
+    m = ev(state, tokens, targets)
+    np.testing.assert_allclose(float(m["loss"]), float(want), rtol=2e-4,
+                               atol=2e-4)
