@@ -331,7 +331,7 @@ def test_lm_trainer_pp_and_moe_paths(tmp_path):
     parallel step builders (GPipe streaming, all_to_all dispatch)."""
     from lm.train import main
 
-    common = ["--seq-len", "32", "--d-model", "32", "--n-layers", "4",
+    common = ["--seq-len", "32", "--d-model", "32", "--n-layers", "2",
               "--n-heads", "4", "--vocab-size", "64", "--batch-size", "4",
               "--max-iter", "2", "--val-freq", "2", "--ckpt-freq", "99",
               "--use_APS", "--grad_exp", "5", "--grad_man", "2"]

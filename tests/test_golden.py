@@ -24,7 +24,7 @@ def test_aps_recovers_low_precision_accuracy(tmp_path):
 
     configs = [("e3m4_noaps", 3, 4, False), ("e3m4_aps", 3, 4, True)]
     results = aps_golden.run_experiment(
-        iters=150, save_root=str(tmp_path), batch_size=8,
+        iters=100, save_root=str(tmp_path), batch_size=8,
         configs=configs)
     noaps = results["e3m4_noaps"]["prec1"]
     aps = results["e3m4_aps"]["prec1"]

@@ -61,7 +61,7 @@ def test_davidnet_logit_scale():
 
 def test_resnet50_shapes_and_params():
     model = resnet50()
-    x = jnp.zeros((1, 64, 64, 3))  # small spatial for CPU test speed
+    x = jnp.zeros((1, 32, 32, 3))  # small spatial for CPU test speed
     variables, out = _init_and_apply(model, x)
     assert out.shape == (1, 1000)
     n = sum(p.size for p in jax.tree.leaves(variables["params"]))
@@ -83,10 +83,10 @@ def test_fcn_aux_head_taps_stage3():
     aux to layer3 (VERDICT.md round-1 weak-item 4)."""
     model = fcn_r50_d8(num_classes=5, aux_head=True,
                        stage_sizes=(1, 1, 1, 1), head_channels=16)
-    x = jnp.linspace(0, 1, 1 * 33 * 33 * 3).reshape(1, 33, 33, 3)
+    x = jnp.linspace(0, 1, 1 * 17 * 17 * 3).reshape(1, 17, 17, 3)
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     main, aux = model.apply(variables, x, train=False)
-    assert main.shape == aux.shape == (1, 33, 33, 5)
+    assert main.shape == aux.shape == (1, 17, 17, 5)
     assert not jnp.allclose(main, aux)
 
     # gradient of the aux loss alone w.r.t. backbone params: nonzero at
