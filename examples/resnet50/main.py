@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", default=224, type=int)
     p.add_argument("--mode", default="faithful",
                    choices=["faithful", "fast"])
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard the SGD momentum buffer 1/N over "
+                        "the dp axis (parallel/zero.py)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
     return p
@@ -128,6 +131,12 @@ def main(argv=None) -> dict:
     state = create_train_state(
         model, tx, jnp.zeros((2, args.image_size, args.image_size, 3)),
         jax.random.PRNGKey(args.seed))
+    zero = None
+    if args.zero1:
+        from cpd_tpu.parallel.zero import zero1_sgd
+        zero = zero1_sgd(schedule, world=n_dev, momentum=args.momentum,
+                         weight_decay=args.wd, wd_mask=bn_and_bias_no_wd)
+        state = state.replace(opt_state=zero.init(state.params))
 
     manager = CheckpointManager(os.path.abspath(args.checkpoint_dir),
                                 track_best=True)
@@ -149,13 +158,29 @@ def main(argv=None) -> dict:
         if rank == 0:
             print(f"=> auto-resumed from epoch {start_epoch}")
     # orbax restores arrays committed to a single device; the train step's
-    # shard_map needs the state replicated over the mesh
-    state = replicate(state, mesh)
+    # shard_map needs the state laid out over the mesh (replicated, except
+    # the ZeRO-1 momentum which is dp-sharded)
+    if zero is None:
+        state = replicate(state, mesh)
+        extra = {}
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from cpd_tpu.train.state import TrainState as TS
+        spec_tree = TS(step=PartitionSpec(), params=PartitionSpec(),
+                       batch_stats=PartitionSpec(),
+                       opt_state=zero.state_spec())
+        state = jax.device_put(
+            state, jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                                is_leaf=lambda s: isinstance(
+                                    s, PartitionSpec)))
+        extra = {"update_fn": zero.update_fn,
+                 "opt_state_spec": zero.state_spec()}
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
-        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode)
+        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
+        **extra)
     eval_step = make_eval_step(model, mesh)
 
     writer = ScalarWriter(args.log_dir, rank=rank)

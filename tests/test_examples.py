@@ -124,6 +124,20 @@ def test_resnet50_trainer_smoke_and_resume(tmp_path, capsys):
     assert res2 == {}                      # all epochs already done
 
 
+def test_resnet50_trainer_zero1_smoke(tmp_path):
+    """--zero1 shards the momentum 1/N over dp through the flagship CLI."""
+    from resnet50.main import main
+
+    res = main(["--batch-size", "1", "--epochs", "1", "--arch", "tiny",
+                "--num-classes", "10", "--max-batches-per-epoch", "2",
+                "--image-size", "32", "--use-APS", "--grad_exp", "5",
+                "--grad_man", "2", "--zero1",
+                "--checkpoint-dir", str(tmp_path / "ck"),
+                "--log-dir", str(tmp_path / "logs"), "--mode", "fast"])
+    assert res["epoch"] == 0
+    assert math.isfinite(res["train_loss"])
+
+
 def test_resnet18_trainer_resume_continues_training(tiny_cifar, tmp_path):
     """Auto-resume must REPLICATE the orbax-restored state back onto the
     mesh and keep training — restore committed the arrays to one device,
