@@ -225,6 +225,34 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     return jax.jit(shard_fn, donate_argnums=(0,) if donate else ())
 
 
+def make_multi_train_step(model, tx: optax.GradientTransformation,
+                          mesh: Mesh, k: int, *, axis_name: str = "dp",
+                          donate: bool = True, **kw):
+    """K train steps fused into ONE executable via `lax.scan`.
+
+    ``(state, images (k, B, ...), labels (k, B, ...)) -> (state, metrics)``
+    where metrics are the LAST step's.  Semantically identical to calling
+    the single step k times; operationally it amortizes per-dispatch
+    overhead (host->device launch, and on the tunneled dev TPU the
+    transport round-trip) over k steps — the idiomatic TPU training loop
+    shape.  Batches for all k steps must be resident up front.
+    """
+    # the inner jit inlines when traced inside the scan body
+    single = make_train_step(model, tx, mesh, axis_name=axis_name,
+                             donate=False, **kw)
+
+    def multi(state, xs, ys):
+        def body(s, xy):
+            s, m = single(s, xy[0], xy[1])
+            return s, m
+
+        state, ms = jax.lax.scan(body, state, (xs, ys))
+        last = jax.tree.map(lambda a: a[-1], ms)
+        return state, last
+
+    return jax.jit(multi, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(model, mesh: Mesh, *, axis_name: str = "dp",
                    loss_fn: Callable = cross_entropy_loss):
     """Jitted ``(state, images, labels) -> metrics`` (validate() parity,

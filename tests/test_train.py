@@ -259,3 +259,31 @@ def test_cifar_eval_pipeline_no_augment():
     assert x.shape == (32, 32, 32, 3)
     # normalised: roughly zero-mean-ish, well within (-3, 3)
     assert -3 < x.mean() < 3
+
+
+def test_multi_step_fusion_bitwise(mesh):
+    """k scan-fused steps == k single-step calls, bitwise (bench.py's
+    measurement unit must be semantically identical training)."""
+    from cpd_tpu.train.step import make_multi_train_step
+
+    model = tiny_cnn()
+    tx = make_optimizer("sgd", lambda s: jnp.float32(0.05), momentum=0.9)
+    rng = np.random.RandomState(0)
+    k, B = 3, 16
+    xs = jnp.asarray(rng.randn(k, B, 32, 32, 3).astype(np.float32))
+    ys = jnp.asarray(rng.randint(0, 10, (k, B)).astype(np.int32))
+    state = create_train_state(model, tx, xs[0, :2], jax.random.PRNGKey(0))
+
+    single = make_train_step(model, tx, mesh, use_aps=True, grad_exp=5,
+                             grad_man=2, donate=False)
+    s1 = state
+    for i in range(k):
+        s1, m1 = single(s1, xs[i], ys[i])
+
+    multi = make_multi_train_step(model, tx, mesh, k, use_aps=True,
+                                  grad_exp=5, grad_man=2, donate=False)
+    s2, m2 = multi(state, xs, ys)
+    assert int(s2.step) == k
+    assert float(m1["loss"]) == float(m2["loss"])
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
