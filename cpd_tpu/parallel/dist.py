@@ -124,8 +124,25 @@ def all_reduce_mean(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     return lax.pmean(x, axis_name)
 
 
-def _gather_leaf(g: jnp.ndarray, axis_name) -> jnp.ndarray:
-    return lax.all_gather(g, axis_name, axis=0, tiled=False)
+def _wire_dtype(grad_exp: int, grad_man: int):
+    """Hardware dtype that exactly represents the (exp, man) value set —
+    including its infinities — or None.
+
+    When the gathered values are ALREADY quantized to the format (the APS
+    path quantizes before the reduction, dist_util.py:35-37), casting to
+    this dtype for the W x all_gather is lossless, and the wire carries
+    1-2 bytes/element instead of 4.  float8_e4m3fn is finite-only, so
+    (4,3) — whose reference cast saturates to +-inf — is NOT mapped."""
+    return {(5, 2): jnp.float8_e5m2,
+            (5, 10): jnp.float16,
+            (8, 7): jnp.bfloat16}.get((grad_exp, grad_man))
+
+
+def _gather_leaf(g: jnp.ndarray, axis_name, wire=None) -> jnp.ndarray:
+    if wire is not None:
+        g = g.astype(wire)
+    out = lax.all_gather(g, axis_name, axis=0, tiled=False)
+    return out.astype(jnp.float32) if wire is not None else out
 
 
 # Per-bucket element cap for the faithful path.  W x 4M x 4B = 128 MiB of
@@ -136,7 +153,8 @@ _BUCKET_ELEMS = 4 * 1024 * 1024
 
 def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
                             grad_man: int, use_kahan: bool,
-                            bucket_elems: int = _BUCKET_ELEMS) -> Any:
+                            bucket_elems: int = _BUCKET_ELEMS,
+                            wire=None) -> Any:
     """Faithful ordered reduction over few large buckets instead of one
     collective per parameter (SURVEY.md §7 hard-part 4).
 
@@ -171,7 +189,7 @@ def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
             flat = (leaves[bucket[0]].reshape(-1) if len(bucket) == 1 else
                     jnp.concatenate([leaves[i].reshape(-1)
                                      for i in bucket]))
-            gathered = lax.all_gather(flat, axis_name, axis=0, tiled=False)
+            gathered = _gather_leaf(flat, axis_name, wire=wire)
             red = quantized_sum(gathered, grad_exp, grad_man, use_kahan)
             off = 0
             for i in bucket:
@@ -228,16 +246,25 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
             reduced = jax.tree.map(
                 lambda g: cast_to_format(g, grad_exp, grad_man), reduced)
     else:
+        # Wire compression: with APS the gathered values were quantized to
+        # the (exp, man) value set just above, so when a hardware dtype
+        # represents that set exactly the W x gather ships 1-2 bytes per
+        # element losslessly (bit-identical results; tested).  Without APS
+        # the reference gathers RAW fp32 grads (dist_util.py:62-64), so no
+        # compression is possible without changing semantics.
+        wire = _wire_dtype(grad_exp, grad_man) if use_aps else None
         if grad_exp == 8 and grad_man == 23 and not use_kahan:
             # fp32 fast path == plain all-reduce (dist_util.py:55-59).
             reduced = jax.tree.map(lambda g: lax.psum(g, axis_name), grads)
         elif bucket:
             reduced = _bucketed_quantized_sum(grads, axis_name, grad_exp,
-                                              grad_man, use_kahan)
+                                              grad_man, use_kahan,
+                                              wire=wire)
         else:
             reduced = jax.tree.map(
-                lambda g: quantized_sum(_gather_leaf(g, axis_name),
-                                        grad_exp, grad_man, use_kahan),
+                lambda g: quantized_sum(
+                    _gather_leaf(g, axis_name, wire=wire),
+                    grad_exp, grad_man, use_kahan),
                 grads)
 
     if use_aps:

@@ -161,6 +161,42 @@ def test_bucketed_faithful_reduce_bit_identical(use_kahan):
                                       err_msg=k)
 
 
+@pytest.mark.parametrize("exp,man", [(5, 2), (8, 7), (5, 10)])
+def test_wire_compressed_gather_bit_identical(exp, man):
+    """With APS the gathered values live in the (exp, man) value set, so
+    shipping them as float8_e5m2 / bf16 / f16 on the wire must not change
+    a single bit of the reduction result."""
+    from cpd_tpu.parallel.dist import _wire_dtype
+
+    from cpd_tpu.parallel.dist import _gather_leaf
+    from cpd_tpu.parallel.reduction import quantized_sum
+    from cpd_tpu.quant.numerics import cast_to_format
+
+    wire = _wire_dtype(exp, man)
+    assert wire is not None
+    assert _wire_dtype(4, 3) is None         # e4m3fn has no inf
+    mesh = data_parallel_mesh()
+    # mixed magnitudes incl. values that quantize to subnormals and (via
+    # a huge outlier) to inf in the target format
+    g = rand_stack((257,), seed=20, scale=1e-3)
+    g[0, 0] = 1e30
+    g[1, 1] = -1e30
+
+    def body(stacked, use_wire):
+        local = cast_to_format(stacked[0], exp, man)   # pre-quantized
+        gathered = _gather_leaf(local, "dp", wire=wire if use_wire else None)
+        return quantized_sum(gathered, exp, man)
+
+    sharded = jax.device_put(jnp.asarray(g), NamedSharding(mesh, P("dp")))
+    got = {}
+    for use_wire in (False, True):
+        fn = jax.jit(shard_map(
+            functools.partial(body, use_wire=use_wire), mesh=mesh,
+            in_specs=(P("dp"),), out_specs=P(), check_vma=False))
+        got[use_wire] = np.asarray(fn(sharded))
+    np.testing.assert_array_equal(got[True], got[False])
+
+
 def test_sum_gradients_fp32_is_plain_sum():
     mesh = data_parallel_mesh()
     tree = {"w": rand_stack((6, 3), seed=6)}
