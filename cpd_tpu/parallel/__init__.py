@@ -8,7 +8,7 @@ from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
 from .dist import (all_reduce_mean, broadcast_from, dist_init,
                    make_sum_gradients_fn, replicate, sum_gradients)
 from .emulate import emulate_node_reduce
-from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR,
+from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, group_split,
                    data_parallel_mesh, make_mesh)
 from .pipeline import pipeline_spmd
 from .zero import Zero1State, zero1_sgd

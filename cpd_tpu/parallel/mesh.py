@@ -21,7 +21,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "data_parallel_mesh", "AXIS_DATA", "AXIS_TENSOR",
+__all__ = ["make_mesh", "data_parallel_mesh", "group_split",
+           "AXIS_DATA", "AXIS_TENSOR",
            "AXIS_SEQ", "AXIS_PIPE", "AXIS_EXPERT"]
 
 AXIS_DATA = "dp"
@@ -66,3 +67,20 @@ def data_parallel_mesh(devices: Optional[Sequence] = None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return make_mesh(dp=len(devices), devices=devices)
+
+
+def group_split(world_size: int, num_groups: int):
+    """Sub-communicator groups — reference `simple_group_split`
+    (train_util.py:11-18), which carves the world into `num_groups` NCCL
+    groups of consecutive ranks.
+
+    The XLA analog is `axis_index_groups` for collectives: pass the
+    returned list to `lax.psum(..., axis_name, axis_index_groups=...)`
+    (or pmax/all_gather) to reduce within each group only — no process
+    groups to manage.
+    """
+    if world_size % num_groups:
+        raise ValueError(f"world {world_size} not divisible into "
+                         f"{num_groups} groups")
+    per = world_size // num_groups
+    return [list(range(g * per, (g + 1) * per)) for g in range(num_groups)]

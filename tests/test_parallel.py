@@ -301,3 +301,26 @@ def test_collective_matches_emulation_bit_exact():
     a = np.asarray(coll(_shard_stacked(mesh, {"g": stacked}))["g"])
     b = np.asarray(ordered_quantized_sum(jnp.asarray(stacked), exp, man))
     np.testing.assert_array_equal(a, b)
+
+
+def test_group_split_subcommunicators():
+    """group_split == reference simple_group_split (train_util.py:11-18):
+    consecutive-rank groups, usable as axis_index_groups in collectives."""
+    from cpd_tpu.parallel import group_split
+
+    groups = group_split(8, 2)
+    assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    with pytest.raises(ValueError):
+        group_split(8, 3)
+
+    mesh = data_parallel_mesh()
+
+    def body(x):
+        return jax.lax.psum(x, "dp", axis_index_groups=groups)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P("dp"), check_vma=False))
+    x = jnp.arange(8.0)
+    out = np.asarray(fn(x))
+    # group sums: 0+1+2+3=6 for ranks 0-3, 4+5+6+7=22 for ranks 4-7
+    np.testing.assert_array_equal(out, [6, 6, 6, 6, 22, 22, 22, 22])
