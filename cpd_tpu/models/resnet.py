@@ -30,6 +30,7 @@ class Bottleneck(nn.Module):
     channels: int  # bottleneck width; output is channels * 4
     stride: int = 1
     dilation: int = 1
+    bn_axis: Any = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -40,7 +41,8 @@ class Bottleneck(nn.Module):
                        kernel_init=nn.initializers.kaiming_normal())
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=self.param_dtype)
+                       param_dtype=self.param_dtype,
+                       axis_name=self.bn_axis if train else None)
         out_ch = self.channels * 4
 
         y = conv(self.channels, (1, 1), name="conv1")(x)
@@ -65,6 +67,7 @@ class BasicBlockV1(nn.Module):
     channels: int
     stride: int = 1
     dilation: int = 1
+    bn_axis: Any = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -75,7 +78,8 @@ class BasicBlockV1(nn.Module):
                        kernel_init=nn.initializers.kaiming_normal())
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=self.param_dtype)
+                       param_dtype=self.param_dtype,
+                       axis_name=self.bn_axis if train else None)
         y = conv(self.channels, (3, 3), strides=(self.stride, self.stride),
                  padding=1, name="conv1")(x)
         y = nn.relu(norm(name="bn1")(y))
@@ -98,6 +102,9 @@ class ResNet(nn.Module):
     `feature_stages` (1-indexed, e.g. (3, 4)) returns a tuple of those
     stages' feature maps instead — the multi-stage mode FCN's auxiliary
     head needs (mmseg's fcn_r50-d8 taps layer3).
+    `bn_axis` names a mesh axis to compute batch statistics over
+    (sync-BN): only usable when training runs inside shard_map with that
+    axis bound; None (default) keeps the reference's per-replica stats.
     """
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     block: Any = Bottleneck
@@ -105,6 +112,7 @@ class ResNet(nn.Module):
     output_stride: int = 32
     features_only: bool = False
     feature_stages: Sequence[int] = ()
+    bn_axis: Any = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -116,7 +124,9 @@ class ResNet(nn.Module):
                     name="stem_conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype,
-                         param_dtype=self.param_dtype, name="stem_bn")(x)
+                         param_dtype=self.param_dtype,
+                         axis_name=self.bn_axis if train else None,
+                         name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
@@ -134,7 +144,8 @@ class ResNet(nn.Module):
             for block in range(blocks):
                 x = self.block(widths[stage],
                                stride=want_stride if block == 0 else 1,
-                               dilation=dilation, dtype=self.dtype,
+                               dilation=dilation, bn_axis=self.bn_axis,
+                               dtype=self.dtype,
                                param_dtype=self.param_dtype,
                                name=f"layer{stage + 1}_block{block}")(
                                    x, train=train)

@@ -21,6 +21,7 @@ class TinyCNN(nn.Module):
     """conv-BN-relu -> conv-BN-relu -> pool -> dense."""
     num_classes: int = 10
     width: int = 16
+    bn_axis: Any = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -32,7 +33,9 @@ class TinyCNN(nn.Module):
                         param_dtype=self.param_dtype, name=f"conv{i}")(x)
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                              epsilon=1e-5, dtype=self.dtype,
-                             param_dtype=self.param_dtype, name=f"bn{i}")(x)
+                             param_dtype=self.param_dtype,
+                             axis_name=self.bn_axis if train else None,
+                             name=f"bn{i}")(x)
             x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,

@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", default=224, type=int)
     p.add_argument("--mode", default="faithful",
                    choices=["faithful", "fast"])
+    p.add_argument("--sync-bn", action="store_true",
+                   help="compute BN batch statistics across the dp axis "
+                        "(per-replica stats, the reference behavior, when "
+                        "off)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard the SGD momentum buffer 1/N over "
                         "the dp axis (parallel/zero.py)")
@@ -125,7 +129,8 @@ def main(argv=None) -> dict:
         warmup_from=scaled_lr / 10.0)
 
     model = get_model(args.arch, num_classes=args.num_classes,
-                      dtype=jnp.bfloat16)
+                      dtype=jnp.bfloat16,
+                      **({"bn_axis": "dp"} if args.sync_bn else {}))
     tx = make_optimizer("sgd", schedule, momentum=args.momentum,
                         weight_decay=args.wd, wd_mask=bn_and_bias_no_wd)
     state = create_train_state(

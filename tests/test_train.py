@@ -287,3 +287,32 @@ def test_multi_step_fusion_bitwise(mesh):
     assert float(m1["loss"]) == float(m2["loss"])
     for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sync_bn_statistics_are_cross_replica(mesh):
+    """bn_axis='dp': one train step's NEW running stats must reflect the
+    GLOBAL batch mean, not the per-shard means (which differ when shards
+    see different data)."""
+    model_sync = tiny_cnn(bn_axis="dp")
+    model_local = tiny_cnn()
+    tx = make_optimizer("sgd", lambda s: jnp.float32(0.0))
+    rng = np.random.RandomState(0)
+    # make shard 0's data wildly offset so local vs global stats differ
+    x = rng.randn(16, 32, 32, 3).astype(np.float32)
+    x[:2] += 50.0
+    x = jnp.asarray(x)
+    y = jnp.asarray(rng.randint(0, 10, 16).astype(np.int32))
+
+    stats = {}
+    for name, model in (("sync", model_sync), ("local", model_local)):
+        state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+        step = make_train_step(model, tx, mesh, donate=False)
+        new_state, _ = step(state, x, y)
+        stats[name] = float(np.asarray(
+            new_state.batch_stats["bn0"]["mean"]).mean())
+    # sync stats see the global batch; the local path pmean-averages
+    # per-shard stats computed from different normalizations -> different
+    assert stats["sync"] != stats["local"]
+    # sync running mean after one step = 0.9*0 + 0.1*global_batch_mean of
+    # the stem conv output; just sanity-check it moved off zero
+    assert abs(stats["sync"]) > 0.0
