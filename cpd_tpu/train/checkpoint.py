@@ -29,6 +29,11 @@ from .state import TrainState
 __all__ = ["CheckpointManager", "save_checkpoint", "restore_latest"]
 
 
+def jnp_dtype(x):
+    """dtype of an array-like leaf (scalars included)."""
+    return getattr(x, "dtype", None) or np.asarray(x).dtype
+
+
 class CheckpointManager:
     """Thin orbax wrapper with the reference's retention semantics."""
 
@@ -105,16 +110,38 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def restore(self, state_template: TrainState,
-                step: Optional[int] = None) -> Optional[TrainState]:
+                step: Optional[int] = None,
+                shardings: Optional[Any] = None) -> Optional[TrainState]:
         """Restore `step` (default latest) shaped like `state_template`;
         None if no checkpoint exists — the auto-resume scan of
-        main.py:70-75."""
+        main.py:70-75.
+
+        `shardings`: optional pytree of jax.sharding.Sharding matching the
+        state — orbax then materializes each array DIRECTLY in its target
+        layout (sharded/replicated on the mesh), skipping the
+        single-device restore + device_put relayout (2x host memory on
+        big states)."""
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
             return None
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
-                                state_template)
+        if shardings is None:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                                    state_template)
+        else:
+            # `shardings` may be a PREFIX tree (e.g. one sharding for the
+            # whole params subtree); broadcast it over the state leaves
+            try:   # not yet in the public tree_util namespace
+                from jax._src.tree_util import broadcast_prefix
+            except ImportError:  # pragma: no cover - newer jax
+                from jax.tree_util import broadcast_prefix  # type: ignore
+            flat_shard = broadcast_prefix(
+                shardings, state_template,
+                is_leaf=lambda s: isinstance(s, jax.sharding.Sharding))
+            leaves, treedef = jax.tree_util.tree_flatten(state_template)
+            abstract = jax.tree_util.tree_unflatten(treedef, [
+                jax.ShapeDtypeStruct(np.shape(x), jnp_dtype(x), sharding=s)
+                for x, s in zip(leaves, flat_shard)])
         return self._mgr.restore(step,
                                  args=ocp.args.StandardRestore(abstract))
 
