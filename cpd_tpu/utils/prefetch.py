@@ -1,0 +1,74 @@
+"""Background-thread batch prefetcher — overlap input prep with device steps.
+
+The reference leans on torch DataLoader worker processes (main.py:111-120);
+the analog here is a small bounded-queue thread that runs the numpy side
+(augmentation, host_batch_to_global) while the device executes the previous
+step.  One thread suffices: the heavy per-pixel work is already native
+(cpd_tpu/native/augment_native.cpp releases the GIL in C++), so the Python
+thread mostly coordinates.
+
+    for x, y in Prefetcher(pipe.epoch(indices, seed), depth=2):
+        state, m = step(state, x, y)
+
+Exceptions from the producer are re-raised at the consuming site; the
+thread is a daemon and also shuts down cleanly on `close()` / GC / break.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator
+
+__all__ = ["Prefetcher"]
+
+_SENTINEL = object()
+
+
+class Prefetcher:
+    """Iterate `source` on a background thread, `depth` items ahead."""
+
+    def __init__(self, source: Iterable, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, args=(source,),
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self, source):
+        try:
+            for item in source:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+            self._q.put(_SENTINEL)
+        except BaseException as e:  # noqa: BLE001 — deliver to consumer
+            self._q.put(e)
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is _SENTINEL:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self):
+        self._stop.set()
+        # drain so the producer's blocked put() can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __del__(self):
+        self.close()

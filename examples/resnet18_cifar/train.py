@@ -206,11 +206,20 @@ def main(argv=None) -> dict:
     step_no = start_iter
     profiler = StepProfiler(args.profile_dir, start=start_iter + 2)
     t0 = time.time()
-    for batch_idx in sampler.batches():
+    def produced():
+        # host-side batch prep (augmentation runs in the native threaded
+        # executor) on a background thread, 2 steps ahead of the device
+        s = step_no
+        for batch_idx in sampler.batches():
+            x, y = pipeline.batch(batch_idx, seed=s // iter_per_epoch)
+            yield (host_batch_to_global(x, mesh),
+                   host_batch_to_global(y, mesh))
+            s += 1
+
+    from cpd_tpu.utils.prefetch import Prefetcher
+    for gx, gy in Prefetcher(produced(), depth=2):
         profiler.step(step_no)
-        x, y = pipeline.batch(batch_idx, seed=step_no // iter_per_epoch)
-        state, metrics = train_step(state, host_batch_to_global(x, mesh),
-                                    host_batch_to_global(y, mesh))
+        state, metrics = train_step(state, gx, gy)
         step_no += 1
         last = {k: float(v) for k, v in metrics.items()}
         progress.maybe_print(step_no, Loss=last["loss"],

@@ -316,3 +316,23 @@ def test_sync_bn_statistics_are_cross_replica(mesh):
     # sync running mean after one step = 0.9*0 + 0.1*global_batch_mean of
     # the stem conv output; just sanity-check it moved off zero
     assert abs(stats["sync"]) > 0.0
+
+
+def test_prefetcher_order_exceptions_and_close():
+    from cpd_tpu.utils.prefetch import Prefetcher
+
+    assert list(Prefetcher(iter(range(20)), depth=2)) == list(range(20))
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer died")
+
+    it = Prefetcher(boom(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="producer died"):
+        next(it)
+
+    # early close unblocks a full queue
+    p = Prefetcher(iter(range(1000)), depth=1)
+    assert next(p) == 0
+    p.close()
