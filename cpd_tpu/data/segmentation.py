@@ -109,6 +109,13 @@ class CityscapesDataset:
     def __init__(self, root: str, split: str = "train",
                  crop_size: int = 769, num_classes: int = 19,
                  flip: bool = True):
+        if num_classes != 19:
+            # the labelId->trainId LUT emits exactly the 19 evaluated
+            # classes; training a smaller head on it would silently clip
+            # out-of-range labels inside the CE gather
+            raise ValueError(
+                f"Cityscapes trainId labels have 19 classes, got "
+                f"num_classes={num_classes}")
         self.crop_size = crop_size
         self.num_classes = num_classes
         self.flip = flip

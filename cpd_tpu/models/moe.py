@@ -78,7 +78,7 @@ class MoEFeedForward(nn.Module):
         onehot = jax.nn.one_hot(expert, self.n_experts,
                                 dtype=jnp.float32)        # (S, E)
         # position of each token within its expert's buffer (0-based)
-        pos = jnp.einsum("se->s", jnp.cumsum(onehot, axis=0) * onehot) - 1.0
+        pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(axis=1) - 1.0
         keep = pos < capacity
         slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
                               dtype=jnp.float32)          # (S, C)

@@ -162,16 +162,18 @@ def pp_param_specs(params, pp_axis: str = "pp", tp_axis: str = "tp"):
     """PartitionSpecs: block leaves pp-sharded on their leading layer axis
     (composed with the Megatron tp rules on the trailing axes), embed and
     ln_f replicated."""
+    from .transformer import megatron_shard_kind
+
     def spec(path, leaf):
         names = [str(getattr(k, "key", k)) for k in path]
         if names and names[0] == "blocks":
             # Megatron rule on the per-layer (trailing) axes, then prepend
             # the layer axis sharded over pp
-            if len(names) >= 2 and names[-1] == "kernel":
-                if names[-2] in ("wqkv", "wi"):
-                    return P(pp_axis, None, tp_axis)
-                if names[-2] in ("wo", "wo_mlp"):
-                    return P(pp_axis, tp_axis, None)
+            kind = megatron_shard_kind(names)
+            if kind == "col":
+                return P(pp_axis, None, tp_axis)
+            if kind == "row":
+                return P(pp_axis, tp_axis, None)
             return P(pp_axis)
         return P()
 

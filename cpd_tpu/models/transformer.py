@@ -151,20 +151,33 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                          d_ff=d_ff or 4 * d_model, dtype=dtype, **kw)
 
 
+def megatron_shard_kind(names) -> Optional[str]:
+    """The Megatron rule for a param path (list of name strings):
+    'col' = output dim tp-sharded (wqkv/wi kernels), 'row' = input dim
+    tp-sharded (wo/wo_mlp kernels), None = replicated.  Exact layer-name
+    matching (not substring): a future param whose path merely *contains*
+    "wo" must not silently get row-sharded.  Shared by lm_param_specs and
+    models/pipeline_lm.pp_param_specs."""
+    if len(names) >= 2 and names[-1] == "kernel":
+        if names[-2] in ("wqkv", "wi"):
+            return "col"
+        if names[-2] in ("wo", "wo_mlp"):
+            return "row"
+    return None
+
+
 def lm_param_specs(params, tp_axis: str = "tp"):
     """PartitionSpec pytree for the Megatron sharding rules: qkv and wi
     kernels column-sharded (out dim on tp), wo kernels row-sharded (in dim
     on tp), everything else replicated."""
 
     def spec(path, leaf):
-        names = [str(getattr(k, "key", k)) for k in path]
-        # Exact layer-name matching (not substring): a future param whose
-        # path merely *contains* "wo" must not silently get row-sharded.
-        if len(names) >= 2 and names[-1] == "kernel":
-            if names[-2] in ("wqkv", "wi"):
-                return P(None, tp_axis)
-            if names[-2] in ("wo", "wo_mlp"):
-                return P(tp_axis, None)
+        kind = megatron_shard_kind([str(getattr(k, "key", k))
+                                    for k in path])
+        if kind == "col":
+            return P(None, tp_axis)
+        if kind == "row":
+            return P(tp_axis, None)
         return P()
 
     return jax.tree_util.tree_map_with_path(spec, params)

@@ -168,14 +168,17 @@ def fused_augment_np(data: np.ndarray, indices: np.ndarray,
     n_total, ih, iw, ch = data.shape
     indices = np.ascontiguousarray(indices, np.int64)
     b = indices.size
-    zero = np.zeros(n_total, np.int64)
+    if not cut_h:
+        # unused by the kernel when cut_h == 0; a 1-element placeholder
+        # satisfies the ctypes signature without an n_total-sized alloc
+        cut_y = cut_x = np.zeros(1, np.int64)
     out = np.empty((b, oh, ow, ch), np.float32)
     lib.cpd_fused_augment(
         data.reshape(-1), indices, b, ih, iw, ch,
         np.ascontiguousarray(crop_y, np.int64),
         np.ascontiguousarray(crop_x, np.int64), oh, ow,
         np.ascontiguousarray(flip, np.uint8),
-        np.ascontiguousarray(cut_y, np.int64) if cut_h else zero,
-        np.ascontiguousarray(cut_x, np.int64) if cut_h else zero,
+        np.ascontiguousarray(cut_y, np.int64),
+        np.ascontiguousarray(cut_x, np.int64),
         cut_h, cut_w, out.reshape(-1), n_threads)
     return out

@@ -32,7 +32,6 @@ from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -61,16 +60,19 @@ class _Zero1:
         total = sum(l.size for l in jax.tree.leaves(params))
         return math.ceil(total / self.world)
 
-    def _flat_mask(self, params) -> np.ndarray:
-        """Static flat wd mask (host-side; wd_mask returns python bools)."""
+    def _flat_mask(self, params) -> jnp.ndarray:
+        """Flat wd mask as broadcast ops (jnp.full), NOT a materialized
+        numpy literal: a 25M-param model would otherwise embed a 100MB
+        constant into the compiled executable."""
         mask = (self.wd_mask(params) if self.wd_mask is not None
                 else jax.tree.map(lambda _: True, params))
-        parts = [np.full(l.size, bool(m), np.float32)
+        parts = [jnp.full((l.size,), float(bool(m)), jnp.float32)
                  for l, m in zip(jax.tree.leaves(params),
                                  jax.tree.leaves(mask))]
-        flat = np.concatenate(parts) if parts else np.zeros(0, np.float32)
+        flat = (jnp.concatenate(parts) if parts
+                else jnp.zeros((0,), jnp.float32))
         s = self._shard_size(params)
-        return np.pad(flat, (0, self.world * s - flat.size))
+        return jnp.pad(flat, (0, self.world * s - flat.shape[0]))
 
     @staticmethod
     def _flatten(tree) -> jnp.ndarray:
@@ -117,7 +119,7 @@ class _Zero1:
         g_sh = lax.dynamic_slice(flat_g, (rank * s,), (s,))
         p_sh = lax.dynamic_slice(flat_p, (rank * s,), (s,))
         m_sh = lax.dynamic_slice(
-            jnp.asarray(self._flat_mask(params)), (rank * s,), (s,))
+            self._flat_mask(params), (rank * s,), (s,))
 
         # torch-SGD rule on the shard (train/optim.py:65-69, bit-equal)
         d = g_sh + (self.weight_decay * p_sh * m_sh
