@@ -44,11 +44,21 @@ def _causal_mask(tq: int, tk: int, q_off, k_off) -> jnp.ndarray:
 
 def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
-                    q_offset=0, k_offset=0) -> jnp.ndarray:
+                    q_offset=0, k_offset=0,
+                    impl: str = "xla") -> jnp.ndarray:
     """Softmax attention for (B, T, H, D) tensors on one device.
 
     fp32 softmax; returns q.dtype.  Offsets give the tokens' global
-    positions (used by ring steps and by tests comparing shard vs full)."""
+    positions (used by ring steps and by tests comparing shard vs full).
+
+    impl="flash" opts into the Pallas TPU flash-attention kernel
+    (jax.experimental.pallas.ops.tpu) — O(T) memory instead of the
+    materialized (T, T) score matrix.  Explicit opt-in, not autodetected:
+    the kernel has TPU-generation/shape constraints (sequence multiples
+    of the block size, supported head dims) that should fail loudly at
+    the call site, not silently downgrade mid-training."""
+    if impl == "flash":
+        return _flash_attention(q, k, v, causal, q_offset, k_offset)
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -59,6 +69,24 @@ def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
+
+
+def _flash_attention(q, k, v, causal, q_offset, k_offset):
+    """Pallas TPU flash kernel on (B, T, H, D) inputs (kernel layout is
+    (B, H, T, D)); nonzero offsets are not supported — the ring wrapper
+    handles global positions itself."""
+    if q_offset != 0 or k_offset != 0:
+        raise ValueError("impl='flash' does not support q/k offsets; "
+                         "use the default impl inside ring steps")
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention)
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_attention(qt, kt, vt, causal=causal,
+                          sm_scale=1.0 / float(q.shape[-1]) ** 0.5)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -239,3 +239,20 @@ def test_lm_sharded_grads_match_single_device():
         np.testing.assert_allclose(
             np.asarray(flat_sh[path]), np.asarray(leaf),
             rtol=2e-5, atol=1e-6, err_msg=str(path))
+
+
+def test_flash_attention_impl_gating():
+    """impl='flash' rejects offsets; on a TPU it must match the XLA path
+    (skipped elsewhere — the Pallas TPU kernel doesn't run on CPU)."""
+    from cpd_tpu.ops.attention import local_attention
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 128, 4, 128).astype(np.float32))
+    with pytest.raises(ValueError, match="offsets"):
+        local_attention(q, q, q, impl="flash", q_offset=4)
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("Pallas TPU flash kernel needs a TPU")
+    want = np.asarray(local_attention(q, q, q, causal=True))
+    got = np.asarray(local_attention(q, q, q, causal=True, impl="flash"))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
