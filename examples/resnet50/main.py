@@ -38,7 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default="./checkpoints",
                    help="per-epoch checkpoints + auto-resume (the "
                         "checkpoint-{epoch}.pth.tar scan of main.py:70-75)")
-    p.add_argument("--emulate-node", type=int, default=1)
+    # underscore aliases keep the reference's flag spellings working
+    # (mix.py/main.py use --emulate_node/--use_APS/--use_kahan)
+    p.add_argument("--emulate-node", "--emulate_node", type=int, default=1)
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--val-batch-size", type=int, default=32)
     p.add_argument("--epochs", type=int, default=90)
@@ -47,8 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-epochs", type=float, default=5)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--wd", type=float, default=0.0001)
-    p.add_argument("--use-APS", action="store_true")
-    p.add_argument("--use-kahan", action="store_true")
+    p.add_argument("--use-APS", "--use_APS", action="store_true")
+    p.add_argument("--use-kahan", "--use_kahan", action="store_true")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--grad_exp", type=int, default=8)
     p.add_argument("--grad_man", type=int, default=23)
