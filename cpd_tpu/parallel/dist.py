@@ -3,8 +3,11 @@
 TPU-native re-implementation of reference CPDtorch/utils/dist_util.py on top
 of XLA collectives.  The reference runs one NCCL op per parameter from a
 Python loop; here everything is traced once under `shard_map`/`pjit` so XLA
-schedules the collectives on ICI back-to-back (and can overlap them), and
-gradients can optionally be bucketed into one gather.
+schedules the collectives on ICI back-to-back (and can overlap them).  On
+TPU the faithful gathers are fused into few large per-dtype buckets
+(`_bucketed_quantized_sum`), and when APS has pre-quantized the values to
+a hardware-representable format the wire carries 1-2 bytes per element
+(`_wire_dtype`) — both bit-identical to the per-leaf fp32 path.
 
 Semantics map (reference → here):
 
