@@ -271,6 +271,15 @@ def main():
             tail = (proc.stderr or proc.stdout or "").strip().splitlines()
             last_err = (f"attempt {attempt + 1}: child rc={proc.returncode} "
                         f"{' | '.join(tail[-3:])}")
+            if proc.returncode < 0:
+                # killed by a signal (native abort) — e.g. a compile-cache
+                # entry gone bad.  Wipe the cache so the retry recompiles
+                # clean (the CPUID-keyed cache dir makes this rare,
+                # cpd_tpu/utils/cache.py).  Clean nonzero exits keep the
+                # cache: they are Python-level failures, and the wipe would
+                # cost the retry its warm TPU executables.
+                from cpd_tpu.utils import clear_cache
+                clear_cache()
         print(f"# {last_err}", file=sys.stderr)
         time.sleep(5)
 

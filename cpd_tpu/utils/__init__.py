@@ -1,6 +1,6 @@
 """Shared utilities: config loading, loggers, profiling."""
 
-from .cache import default_cache_dir, enable_compile_cache
+from .cache import clear_cache, default_cache_dir, enable_compile_cache
 from .config import load_yaml_config, merge_config_into_args
 from .logging import (ProgressPrinter, ScalarWriter, TableLogger, TSVLogger,
                       format_validation_line)
@@ -9,4 +9,4 @@ from .profiling import StepProfiler
 __all__ = ["load_yaml_config", "merge_config_into_args", "TableLogger",
            "TSVLogger", "ScalarWriter", "ProgressPrinter",
            "format_validation_line", "enable_compile_cache",
-           "default_cache_dir", "StepProfiler"]
+           "default_cache_dir", "clear_cache", "StepProfiler"]

@@ -10,17 +10,78 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["enable_compile_cache", "default_cache_dir"]
+__all__ = ["enable_compile_cache", "default_cache_dir", "clear_cache"]
+
+
+def _cpuid(leaf: int, subleaf: int = 0) -> tuple[int, int, int, int]:
+    """Raw x86 CPUID from userspace (eax, ebx, ecx, edx).
+
+    Why not /proc/cpuinfo: this sandbox is a VM that can be snapshot-
+    restored onto a different physical host mid-lifetime.  The kernel
+    caches CPU capabilities at boot, so /proc/cpuinfo keeps showing the
+    *boot* host's (virtualized, generic) identity — while the CPUID
+    instruction reports the *current* host, which is exactly what LLVM's
+    getHostCPUFeatures bakes into XLA:CPU AOT cache entries.  Observed
+    failure: entries compiled with +amx/+prefer-no-scatter on host A
+    crashed AllReduceThunk after a migration to host B, with identical
+    /proc/cpuinfo on both."""
+    import ctypes
+    import mmap
+
+    code = bytes([
+        0x53,                          # push rbx (callee-saved, cpuid clobbers)
+        0x89, 0xF8,                    # mov eax, edi   (leaf)
+        0x89, 0xF1,                    # mov ecx, esi   (subleaf)
+        0x49, 0x89, 0xD1,              # mov r9, rdx    (out ptr)
+        0x0F, 0xA2,                    # cpuid
+        0x41, 0x89, 0x01,              # mov [r9],    eax
+        0x41, 0x89, 0x59, 0x04,        # mov [r9+4],  ebx
+        0x41, 0x89, 0x49, 0x08,        # mov [r9+8],  ecx
+        0x41, 0x89, 0x51, 0x0C,        # mov [r9+12], edx
+        0x5B,                          # pop rbx
+        0xC3,                          # ret
+    ])
+    buf = mmap.mmap(-1, mmap.PAGESIZE,
+                    prot=mmap.PROT_READ | mmap.PROT_WRITE | mmap.PROT_EXEC)
+    try:
+        buf.write(code)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        fn = ctypes.CFUNCTYPE(None, ctypes.c_uint32, ctypes.c_uint32,
+                              ctypes.c_void_p)(addr)
+        out = (ctypes.c_uint32 * 4)()
+        fn(leaf, subleaf, ctypes.addressof(out))
+        del fn
+        return tuple(out)
+    finally:
+        buf.close()
 
 
 def _machine_tag() -> str:
-    """Short hash of the host CPU's feature flags.
+    """Short hash of the *current* host CPU's identity and feature leaves.
 
     XLA:CPU AOT cache entries bake in the compile machine's features;
-    loading them on a different host warns 'could lead to SIGILL'
-    (observed when this repo's cache dir was shared across machines).
-    Keying the cache dir by machine identity makes that impossible."""
+    loading them on a different host crashes or SIGILLs.  Keying the
+    cache dir by what CPUID reports right now (vendor, family/model/
+    stepping, feature leaves 1 / 7.0 / 7.1 / 0xD.1 / ext 0x80000001 —
+    the ones LLVM reads) makes a cross-host hit impossible."""
     import hashlib
+    import platform
+
+    if platform.machine() == "x86_64":
+        try:
+            words = []
+            for leaf, sub in ((0, 0), (1, 0), (7, 0), (7, 1), (0xD, 1),
+                              (0x80000001, 0)):
+                a, b, c, d = _cpuid(leaf, sub)
+                if leaf == 1:
+                    # EBX[31:24] is the *executing core's* initial APIC ID —
+                    # scheduler-dependent, so it must not enter the hash.
+                    b &= 0x00FFFFFF
+                words.extend((a, b, c, d))
+            blob = ",".join(f"{w:08x}" for w in words)
+            return hashlib.sha1(blob.encode()).hexdigest()[:10]
+        except Exception:
+            pass  # W^X kernels etc. — fall through to cpuinfo
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
@@ -28,24 +89,62 @@ def _machine_tag() -> str:
                     return hashlib.sha1(line.encode()).hexdigest()[:10]
     except OSError:
         pass
-    import platform
     return hashlib.sha1(platform.processor().encode()).hexdigest()[:10]
 
 
-def default_cache_dir() -> str:
-    """<repo root>/.jax_cache/<machine tag> (repo root = parent of the
-    cpd_tpu package)."""
+def _cache_root() -> str:
+    """<repo root>/.jax_cache (repo root = parent of the cpd_tpu package)."""
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return os.path.join(os.path.dirname(pkg), ".jax_cache", _machine_tag())
+    return os.path.join(os.path.dirname(pkg), ".jax_cache")
+
+
+def default_cache_dir() -> str:
+    return os.path.join(_cache_root(), _machine_tag())
+
+
+def clear_cache() -> None:
+    """Delete the current machine's compile-cache dir.
+
+    Last-resort recovery for a supervisor whose child crashed in native
+    code: if a cache entry somehow went bad, the retry recompiles clean.
+    Only the *current* machine's tag dir is wiped — other tags' entries
+    can never have been read by the crashed child, and keeping them
+    bounds the collateral cost.  Lives here so the drivers (bench.py)
+    stay in sync with the cache layout."""
+    import shutil
+
+    shutil.rmtree(default_cache_dir(), ignore_errors=True)
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     """Point jax's persistent compilation cache at `cache_dir` (default:
     repo-root .jax_cache).  Best-effort: a jax without these flags just
-    skips the optimization."""
+    skips the optimization.
+
+    TPU-only: on the CPU backend this is a no-op, because reloading
+    XLA:CPU AOT executables that contain collectives is broken in this
+    jaxlib — deserialized modules get conflicting rendezvous op_ids
+    (observed: a collective-permute and an all-reduce joining the same
+    RendezvousKey op_id=1), the rendezvous times out, and the runtime
+    F-aborts the process (rc=-6).  Reproduced deterministically on warm
+    re-runs of the 8-virtual-device dryrun.  A cold compile of the tiny
+    dryrun models is ~70s, so CPU runs simply recompile.
+
+    When the platform is explicitly configured as cpu (tests, dryrun) the
+    check is free — no backend init.  Otherwise the *resolved* backend is
+    consulted: a platform list like "axon,cpu" falls back to CPU when the
+    TPU plugin fails init, and enabling the cache on that silent-fallback
+    path is exactly the crash above (callers configure their platform
+    before calling this, so initializing the backend here is safe)."""
     import jax
 
     try:
+        configured = (jax.config.jax_platforms or
+                      os.environ.get("JAX_PLATFORMS") or "")
+        if configured.split(",")[0] == "cpu":
+            return
+        if jax.default_backend() == "cpu":
+            return
         jax.config.update("jax_compilation_cache_dir",
                           cache_dir or default_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
