@@ -1,0 +1,57 @@
+"""cpd_tpu — TPU-native customized-precision distributed training.
+
+A JAX/XLA/Pallas re-design of the CPD emulator (reference:
+CPDtorch/quant/__init__.py:4-5, CPDtorch/utils/dist_util.py): train with
+arbitrary eXmY floating-point formats — casts, quantized-accumulator
+GEMM, low-precision gradient all-reduce with APS and Kahan compensation —
+over jax.sharding meshes instead of NCCL process groups.
+
+The reference's ``import CPDtorch`` surface (float_quantize, quantizer,
+Quantizer, quant_gemm, Quant_Linear → QuantLinear, Quant_Conv →
+QuantConv, plus dist_util's dist_init / sum_gradients / broadcast) is
+re-exported here at the package root.  Attribute access is lazy (PEP
+562) so ``import cpd_tpu`` stays cheap — jax/flax load only when the
+API is first touched.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.2.0"
+
+# name -> submodule providing it
+_EXPORTS = {
+    # L1 quant API (reference CPDtorch/quant/__init__.py:4-5)
+    "float_quantize": "quant",
+    "quantizer": "quant",
+    "quant_gemm": "quant",
+    "Quantizer": "quant",
+    "QuantLinear": "quant",
+    "QuantConv": "quant",
+    "cast_to_format": "quant",
+    # L2 distributed layer (reference CPDtorch/utils/dist_util.py)
+    "dist_init": "parallel",
+    "sum_gradients": "parallel",
+    "broadcast_from": "parallel",
+    "replicate": "parallel",
+    "make_mesh": "parallel",
+    "make_sum_gradients_fn": "parallel",
+    "emulate_node_reduce": "parallel",
+}
+
+__all__ = ["__version__", *_EXPORTS]
+
+
+def __getattr__(name: str):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f".{submodule}", __name__)
+    value = getattr(mod, name)
+    globals()[name] = value  # cache for subsequent lookups
+    return value
+
+
+def __dir__():
+    return sorted(__all__)
