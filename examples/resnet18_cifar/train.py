@@ -216,27 +216,48 @@ def main(argv=None) -> dict:
                    host_batch_to_global(y, mesh))
             s += 1
 
+    # SIGTERM (spot-VM preemption) → save at the next step boundary and
+    # exit; the iteration-based sampler resumes at exactly this step via
+    # last_iter (train_util.py:159-222 semantics), so nothing re-trains.
+    from cpd_tpu.train import PreemptionGuard
+    guard = PreemptionGuard()
+    preempted = False
     from cpd_tpu.utils.prefetch import Prefetcher
-    for gx, gy in Prefetcher(produced(), depth=2):
-        profiler.step(step_no)
-        state, metrics = train_step(state, gx, gy)
-        step_no += 1
-        last = {k: float(v) for k, v in metrics.items()}
-        progress.maybe_print(step_no, Loss=last["loss"],
-                             Prec=100 * last["accuracy"],
-                             LR=float(schedule(step_no)))
-        writer.add_scalar("train/loss", last["loss"], step_no)
-        writer.add_scalar("train/acc", last["accuracy"], step_no)
-        if step_no % args.val_freq == 0 or step_no == total_iter:
-            val = validate(step_no)
-            writer.add_scalar("val/top1", val["top1"], step_no)
-            prec1 = 100 * val["top1"]
-            best_prec1 = max(best_prec1, prec1)
-            manager.save(step_no, state, best_metric=prec1)
+    try:
+        for gx, gy in Prefetcher(produced(), depth=2):
+            if guard.should_stop():      # collective when multi-host
+                jax.block_until_ready(state.params)
+                # an existing checkpoint at this exact step (val_freq
+                # save, or a resume that never stepped) already holds this
+                # state — saving again would raise StepAlreadyExistsError
+                if manager.latest_step() != step_no:
+                    manager.save(step_no, state, force=True)
+                    manager.wait()
+                if rank == 0:
+                    print(f"=> preempted: saved iter {step_no}; exiting")
+                preempted = True
+                break
+            profiler.step(step_no)
+            state, metrics = train_step(state, gx, gy)
+            step_no += 1
+            last = {k: float(v) for k, v in metrics.items()}
+            progress.maybe_print(step_no, Loss=last["loss"],
+                                 Prec=100 * last["accuracy"],
+                                 LR=float(schedule(step_no)))
+            writer.add_scalar("train/loss", last["loss"], step_no)
+            writer.add_scalar("train/acc", last["accuracy"], step_no)
+            if step_no % args.val_freq == 0 or step_no == total_iter:
+                val = validate(step_no)
+                writer.add_scalar("val/top1", val["top1"], step_no)
+                prec1 = 100 * val["top1"]
+                best_prec1 = max(best_prec1, prec1)
+                manager.save(step_no, state, best_metric=prec1)
+    finally:
+        guard.uninstall()
     profiler.close()
     manager.wait()
     writer.close()
-    if rank == 0:
+    if rank == 0 and not preempted:   # an interrupted run is NOT "done"
         print(f"done: {step_no - start_iter} iters in {time.time()-t0:.1f}s "
               f"best Prec@1 {best_prec1:.2f}")
     manager.close()

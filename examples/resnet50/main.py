@@ -101,9 +101,10 @@ def main(argv=None) -> dict:
     from cpd_tpu.parallel.dist import (dist_init, host_batch_to_global,
                                        replicate)
     from cpd_tpu.parallel.mesh import data_parallel_mesh
-    from cpd_tpu.train import (CheckpointManager, create_train_state,
-                               make_eval_step, make_optimizer,
-                               make_train_step, warmup_step_decay)
+    from cpd_tpu.train import (CheckpointManager, PreemptionGuard,
+                               create_train_state, make_eval_step,
+                               make_optimizer, make_train_step,
+                               warmup_step_decay)
     from cpd_tpu.utils import (ScalarWriter, StepProfiler,
                                format_validation_line)
 
@@ -148,11 +149,31 @@ def main(argv=None) -> dict:
     manager = CheckpointManager(os.path.abspath(args.checkpoint_dir),
                                 track_best=True)
     start_epoch = 0
+    start_it = 0
     restored = manager.restore(state)
     if restored is not None:                 # auto-resume (main.py:70-75)
         state = restored
         meta = manager.metadata()
-        if meta is not None and "epoch" in meta:
+        if meta is not None and "resume_it" in meta:
+            # preemption checkpoint: continue the interrupted epoch at the
+            # exact iteration (the epoch-seeded sampler order is
+            # deterministic, so no batch is trained twice or skipped).
+            # Exactness requires the SAME iteration geometry — if batch
+            # size / device count / --max-batches-per-epoch changed, the
+            # saved iteration indexes different samples, so restart the
+            # interrupted epoch from 0 instead (re-training part of it,
+            # like the reference's per-epoch resume, main.py:70-75).
+            start_epoch = int(meta["epoch"])
+            same_geometry = (
+                int(meta.get("iters_per_epoch", -1)) == iters_per_epoch
+                and int(meta.get("global_batch", -1)) == global_batch
+                and int(meta.get("world", -1)) == world)
+            if same_geometry:
+                start_it = int(meta["resume_it"])
+            elif rank == 0:
+                print("=> iteration geometry changed since preemption; "
+                      "restarting the interrupted epoch from iter 0")
+        elif meta is not None and "epoch" in meta:
             # exact epoch from checkpoint metadata — robust to batch size /
             # device count / --max-batches-per-epoch changing between runs
             start_epoch = int(meta["epoch"]) + 1
@@ -163,7 +184,8 @@ def main(argv=None) -> dict:
             # resumed at the wrong epoch; round-2 review finding)
             start_epoch = int(restored.step) // max(iters_per_epoch, 1)
         if rank == 0:
-            print(f"=> auto-resumed from epoch {start_epoch}")
+            at = f" iter {start_it}" if start_it else ""
+            print(f"=> auto-resumed from epoch {start_epoch}{at}")
     # orbax restores arrays committed to a single device; the train step's
     # shard_map needs the state laid out over the mesh (replicated, except
     # the ZeRO-1 momentum which is dp-sharded)
@@ -200,68 +222,100 @@ def main(argv=None) -> dict:
     val_host = val_bs // world
     result = {}
     profiler = StepProfiler(args.profile_dir, start=3)
+    # SIGTERM (spot-VM preemption / maintenance) → checkpoint at the next
+    # step boundary with the exact (epoch, iteration) and exit cleanly;
+    # auto-resume above continues mid-epoch without re-training a batch.
+    guard = PreemptionGuard()
+    preempted = False
     global_it = 0
-    for epoch in range(start_epoch, args.epochs):
-        sampler.set_epoch(epoch)
-        order = np.fromiter(iter(sampler), np.int64)
-        t0 = time.time()
-        train_loss = train_acc = 0.0
-        for it in range(iters_per_epoch):
-            global_it += 1
-            profiler.step(global_it)
-            idx = order[it * host_batch:(it + 1) * host_batch]
-            x, y = train_ds.batch(idx, seed=epoch)
-            state, m = train_step(
-                state,
-                host_batch_to_global(x.astype(np.float32), mesh),
-                host_batch_to_global(y, mesh))
-            train_loss += float(m["loss"])
-            train_acc += float(m["accuracy"])
-        jax.block_until_ready(state.params)
-        dt = time.time() - t0
-        imgs_per_sec = iters_per_epoch * global_batch / dt
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            sampler.set_epoch(epoch)
+            order = np.fromiter(iter(sampler), np.int64)
+            t0 = time.time()
+            train_loss = train_acc = 0.0
+            epoch_start = start_it if epoch == start_epoch else 0
+            n_done = 0
+            for it in range(epoch_start, iters_per_epoch):
+                if guard.should_stop():      # collective when multi-host
+                    jax.block_until_ready(state.params)
+                    # an existing checkpoint at this exact step (epoch-end
+                    # save, or a resume that never stepped) already holds this
+                    # state — saving again would raise StepAlreadyExistsError
+                    if manager.latest_step() != int(state.step):
+                        manager.save(int(state.step), state, force=True,
+                                     metadata={"epoch": epoch, "resume_it": it,
+                                               "iters_per_epoch":
+                                                   iters_per_epoch,
+                                               "global_batch": global_batch,
+                                               "world": world})
+                        manager.wait()
+                    if rank == 0:
+                        print(f"=> preempted: saved step {int(state.step)} "
+                              f"(epoch {epoch} iter {it}); exiting")
+                    preempted = True
+                    break
+                global_it += 1
+                profiler.step(global_it)
+                idx = order[it * host_batch:(it + 1) * host_batch]
+                x, y = train_ds.batch(idx, seed=epoch)
+                state, m = train_step(
+                    state,
+                    host_batch_to_global(x.astype(np.float32), mesh),
+                    host_batch_to_global(y, mesh))
+                train_loss += float(m["loss"])
+                train_acc += float(m["accuracy"])
+                n_done += 1
+            if preempted:
+                break
+            jax.block_until_ready(state.params)
+            dt = time.time() - t0
+            n_done = max(n_done, 1)
+            imgs_per_sec = n_done * global_batch / dt
 
-        # validate (main.py:215-235)
-        val_loss = val_top1 = val_top5 = 0.0
-        k = 0
-        n_val = (len(val_ds) // val_bs) * val_bs
-        for lo in range(0, n_val, val_bs):
-            sel = np.arange(lo + rank * val_host, lo + (rank + 1) * val_host)
-            x, y = val_ds.batch(sel)
-            m = eval_step(state,
-                          host_batch_to_global(x.astype(np.float32), mesh),
-                          host_batch_to_global(y, mesh))
-            val_loss += float(m["loss"])
-            val_top1 += float(m["top1"])
-            val_top5 += float(m["top5"])
-            k += 1
-        k = max(k, 1)
-        result = {
-            "epoch": epoch, "train_loss": train_loss / iters_per_epoch,
-            "train_acc": train_acc / iters_per_epoch,
-            "val_loss": val_loss / k, "val_top1": val_top1 / k,
-            "val_top5": val_top5 / k, "img_per_sec": imgs_per_sec,
-        }
-        if rank == 0:
-            print(f"Epoch {epoch}: loss {result['train_loss']:.4f} "
-                  f"acc {100*result['train_acc']:.2f} "
-                  f"({imgs_per_sec:.1f} img/s)")
-            print(format_validation_line(result["val_loss"],
-                                         100 * result["val_top1"],
-                                         100 * result["val_top5"]))
-        writer.add_scalar("train/loss", result["train_loss"], epoch)
-        writer.add_scalar("val/top1", result["val_top1"], epoch)
-        # per-epoch checkpoint keyed by the TRUE global step: monotonic no
-        # matter how earlier checkpoints in the directory were numbered, so
-        # a resumed run can never be shadowed by a stale higher-numbered
-        # file.  The reference's epoch-named files (checkpoint-{epoch}
-        # .pth.tar, main.py:261-269) are matched in behavior — one
-        # checkpoint per epoch, auto-resume — with the epoch recorded in
-        # sidecar metadata instead of the filename.
-        manager.save(int(state.step), state,
-                     best_metric=100 * result["val_top1"],
-                     metadata={"epoch": epoch,
-                               "iters_per_epoch": iters_per_epoch})
+            # validate (main.py:215-235)
+            val_loss = val_top1 = val_top5 = 0.0
+            k = 0
+            n_val = (len(val_ds) // val_bs) * val_bs
+            for lo in range(0, n_val, val_bs):
+                sel = np.arange(lo + rank * val_host, lo + (rank + 1) * val_host)
+                x, y = val_ds.batch(sel)
+                m = eval_step(state,
+                              host_batch_to_global(x.astype(np.float32), mesh),
+                              host_batch_to_global(y, mesh))
+                val_loss += float(m["loss"])
+                val_top1 += float(m["top1"])
+                val_top5 += float(m["top5"])
+                k += 1
+            k = max(k, 1)
+            result = {
+                "epoch": epoch, "train_loss": train_loss / n_done,
+                "train_acc": train_acc / n_done,
+                "val_loss": val_loss / k, "val_top1": val_top1 / k,
+                "val_top5": val_top5 / k, "img_per_sec": imgs_per_sec,
+            }
+            if rank == 0:
+                print(f"Epoch {epoch}: loss {result['train_loss']:.4f} "
+                      f"acc {100*result['train_acc']:.2f} "
+                      f"({imgs_per_sec:.1f} img/s)")
+                print(format_validation_line(result["val_loss"],
+                                             100 * result["val_top1"],
+                                             100 * result["val_top5"]))
+            writer.add_scalar("train/loss", result["train_loss"], epoch)
+            writer.add_scalar("val/top1", result["val_top1"], epoch)
+            # per-epoch checkpoint keyed by the TRUE global step: monotonic no
+            # matter how earlier checkpoints in the directory were numbered, so
+            # a resumed run can never be shadowed by a stale higher-numbered
+            # file.  The reference's epoch-named files (checkpoint-{epoch}
+            # .pth.tar, main.py:261-269) are matched in behavior — one
+            # checkpoint per epoch, auto-resume — with the epoch recorded in
+            # sidecar metadata instead of the filename.
+            manager.save(int(state.step), state,
+                         best_metric=100 * result["val_top1"],
+                         metadata={"epoch": epoch,
+                                   "iters_per_epoch": iters_per_epoch})
+    finally:
+        guard.uninstall()
     profiler.close()
     manager.wait()
     manager.close()

@@ -124,6 +124,135 @@ def test_resnet50_trainer_smoke_and_resume(tmp_path, capsys):
     assert res2 == {}                      # all epochs already done
 
 
+def _make_fake_guard(trigger_after_polls):
+    """Deterministic PreemptionGuard stand-in: should_stop() turns True
+    after N polls, so trainer save/resume logic is exercised without real
+    signal timing (the signal mechanics have their own unit test)."""
+
+    class FakeGuard:
+        def __init__(self, *a, **k):
+            self.polls = 0
+
+        @property
+        def triggered(self):
+            return self.polls > trigger_after_polls
+
+        def should_stop(self):
+            self.polls += 1
+            return self.triggered
+
+        def uninstall(self):
+            pass
+
+    return FakeGuard
+
+
+def test_preemption_guard_signal_mechanics():
+    import signal
+
+    from cpd_tpu.train import PreemptionGuard
+
+    guard = PreemptionGuard()
+    try:
+        assert not guard.triggered
+        os.kill(os.getpid(), signal.SIGTERM)   # delivered synchronously
+        assert guard.triggered
+    finally:
+        guard.uninstall()
+    # uninstall restored the previous disposition
+    assert signal.getsignal(signal.SIGTERM) != guard._handle
+
+
+def test_resnet50_preempt_saves_and_resumes_mid_epoch(tmp_path, capsys,
+                                                      monkeypatch):
+    """SIGTERM mid-epoch → checkpoint with (epoch, iter) → exact resume.
+
+    The guard's signal mechanics are unit-tested above; here a fake guard
+    triggers deterministically after one step so the trainer's
+    save/resume logic is exercised without real signal timing."""
+    from cpd_tpu.train import CheckpointManager, checkpoint
+    from resnet50.main import main
+
+    FakeGuard = _make_fake_guard(1)
+
+    ckpt = str(tmp_path / "ck")
+    argv = ["--batch-size", "1", "--epochs", "1", "--arch", "tiny",
+            "--num-classes", "10", "--max-batches-per-epoch", "3",
+            "--image-size", "32", "--use-APS", "--grad_exp", "5",
+            "--grad_man", "2", "--checkpoint-dir", ckpt,
+            "--log-dir", str(tmp_path / "logs"), "--mode", "fast"]
+
+    monkeypatch.setattr(checkpoint, "PreemptionGuard", FakeGuard)
+    res = main(argv)
+    out = capsys.readouterr().out
+    assert "preempted: saved step 1 (epoch 0 iter 1)" in out
+    assert res == {}                       # epoch never completed
+
+    mgr = CheckpointManager(ckpt, track_best=False)
+    meta = mgr.metadata()
+    mgr.close()
+    assert meta == {"epoch": 0, "resume_it": 1, "iters_per_epoch": 3,
+                    "global_batch": 8, "world": 1}   # batch 1 x 8 devices
+
+    monkeypatch.undo()                     # real (never-fired) guard
+    res2 = main(argv)
+    out = capsys.readouterr().out
+    assert "auto-resumed from epoch 0 iter 1" in out
+    assert res2["epoch"] == 0
+    assert math.isfinite(res2["train_loss"])
+
+
+def test_resnet50_preempt_geometry_change_restarts_epoch(tmp_path, capsys,
+                                                         monkeypatch):
+    """resume_it is only exact for identical iteration geometry; when
+    --max-batches-per-epoch changes after a preemption, the interrupted
+    epoch restarts from iter 0 instead of mis-indexing the sampler."""
+    from cpd_tpu.train import checkpoint
+    from resnet50.main import main
+
+    FakeGuard = _make_fake_guard(1)
+
+    ckpt = str(tmp_path / "ck")
+    base = ["--batch-size", "1", "--epochs", "1", "--arch", "tiny",
+            "--num-classes", "10", "--image-size", "32", "--grad_exp", "5",
+            "--grad_man", "2", "--checkpoint-dir", ckpt,
+            "--log-dir", str(tmp_path / "logs"), "--mode", "fast"]
+    monkeypatch.setattr(checkpoint, "PreemptionGuard", FakeGuard)
+    main(base + ["--max-batches-per-epoch", "3"])
+    capsys.readouterr()
+
+    monkeypatch.undo()
+    res = main(base + ["--max-batches-per-epoch", "2"])
+    out = capsys.readouterr().out
+    assert "iteration geometry changed" in out
+    assert "auto-resumed from epoch 0" in out
+    assert res["epoch"] == 0
+
+
+def test_resnet18_preempt_saves_and_resumes(tmp_path, tiny_cifar, capsys,
+                                            monkeypatch):
+    """Iteration-based trainer: preempt at iter 2, resume at exactly 2."""
+    from cpd_tpu.train import checkpoint
+    from resnet18_cifar.train import main
+
+    FakeGuard = _make_fake_guard(2)
+
+    argv = ["--arch", "tiny", "--max-iter", "4", "--batch_size", "2",
+            "--val_freq", "4", "--data-root", tiny_cifar,
+            "--save_path", str(tmp_path / "ck"), "--mode", "fast"]
+    monkeypatch.setattr(checkpoint, "PreemptionGuard", FakeGuard)
+    res = main(argv)
+    out = capsys.readouterr().out
+    assert "preempted: saved iter 2" in out
+    assert res["step"] == 2
+
+    monkeypatch.undo()
+    res2 = main(argv)
+    out = capsys.readouterr().out
+    assert "resumed from iter 2" in out
+    assert res2["step"] == 4
+
+
 def test_resnet50_trainer_zero1_smoke(tmp_path):
     """--zero1 shards the momentum 1/N over dp through the flagship CLI."""
     from resnet50.main import main
