@@ -129,7 +129,14 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
     # TPU training-loop shape; it also amortizes the dev tunnel's
     # per-dispatch transport overhead).  Semantically identical to calling
     # the single step k times — verified bitwise in tests/test_train.py.
-    fuse = int(os.environ.get("BENCH_FUSE_STEPS", "4"))
+    # Default 16: microbenchmarks showed EVERY single dispatch through the
+    # tunnel costs ~25 ms regardless of payload, so at fuse=4 dispatch was
+    # still ~6 ms/step of the measurement and captures swung with tunnel
+    # conditions (1068-1508 img/s faithful across runs); 16 brings
+    # dispatch under 2 ms/step and stabilizes the capture (~2177
+    # faithful).  16 x 32 bf16 inputs ≈ 150 MB, comfortably inside a v5e
+    # chip's HBM.
+    fuse = int(os.environ.get("BENCH_FUSE_STEPS", "16"))
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(fuse, batch * n_dev, size, size,
                               3).astype(np.float32), jnp.bfloat16)
