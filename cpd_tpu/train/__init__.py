@@ -3,7 +3,7 @@
 from .state import TrainState, create_train_state
 from .step import (cross_entropy_loss, make_eval_step, make_train_step,
                    seg_cross_entropy_loss)
-from .optim import lars, make_optimizer, sgd
+from .optim import lars, make_optimizer, quant_sgd, sgd
 from .schedules import iter_table, piecewise_linear, warmup_step_decay
 from .metrics import AverageMeter, Timer, accuracy
 from .lm import lm_state_specs, make_lm_train_step
@@ -16,7 +16,7 @@ __all__ = [
     "TrainState", "create_train_state",
     "cross_entropy_loss", "seg_cross_entropy_loss", "make_eval_step",
     "make_train_step",
-    "lars", "make_optimizer", "sgd",
+    "lars", "make_optimizer", "quant_sgd", "sgd",
     "iter_table", "piecewise_linear", "warmup_step_decay",
     "AverageMeter", "Timer", "accuracy",
     "make_lm_train_step", "lm_state_specs",

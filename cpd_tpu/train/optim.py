@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-__all__ = ["sgd", "lars", "make_optimizer"]
+__all__ = ["sgd", "lars", "quant_sgd", "make_optimizer"]
 
 
 class TorchSGDState(NamedTuple):
@@ -69,10 +69,7 @@ def sgd(schedule: Callable, momentum: float = 0.9, weight_decay: float = 0.0,
             return -lr * step_dir, new_buf
 
         flat = jax.tree.map(one, grads, params, state.momentum_buf, mask)
-        updates = jax.tree.map(lambda t: t[0], flat,
-                               is_leaf=lambda t: isinstance(t, tuple))
-        bufs = jax.tree.map(lambda t: t[1], flat,
-                            is_leaf=lambda t: isinstance(t, tuple))
+        updates, bufs = _unzip(flat, 2)
         return updates, TorchSGDState(state.step + 1, bufs)
 
     return optax.GradientTransformation(init, update)
@@ -113,20 +110,126 @@ def lars(schedule: Callable, momentum: float = 0.9,
             return -new_buf, new_buf
 
         flat = jax.tree.map(one, grads, params, state.momentum_buf)
-        updates = jax.tree.map(lambda t: t[0], flat,
-                               is_leaf=lambda t: isinstance(t, tuple))
-        bufs = jax.tree.map(lambda t: t[1], flat,
-                            is_leaf=lambda t: isinstance(t, tuple))
+        updates, bufs = _unzip(flat, 2)
         return updates, TorchSGDState(state.step + 1, bufs)
 
     return NormBasedTransformation(init, update)
 
 
+class QuantSGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buf: optax.Updates
+    comp: optax.Updates    # Kahan residuals; () (leafless) w/o use_kahan
+
+
+def _unzip(flat, n):
+    """Split a pytree of n-tuples into n pytrees (shared by the
+    optimizers here)."""
+    is_t = lambda t: isinstance(t, tuple)  # noqa: E731
+    return tuple(jax.tree.map(lambda t: t[i], flat, is_leaf=is_t)
+                 for i in range(n))
+
+
+def quant_sgd(schedule: Callable, momentum: float = 0.9,
+              weight_decay: float = 0.0, exp: int = 8, man: int = 23,
+              use_kahan: bool = False, nesterov: bool = False,
+              wd_mask: Optional[Callable] = None,
+              ) -> optax.GradientTransformation:
+    """torch-SGD semantics with the momentum buffer held in eXmY.
+
+    New capability beyond the reference, built from its own numerics
+    doctrine: the reference quantizes gradients around the all-reduce
+    (dist_util.py:35-37) and keeps every Kahan intermediate quantized
+    (dist_util.py:82-88); this applies the same discipline to the
+    *optimizer state* — the momentum buffer lives in the (exp, man)
+    value set, every intermediate of its update is re-quantized, and an
+    optional quantized Kahan residual recovers the small gradients that
+    a naive low-precision accumulation would flush (the classic 8-bit-
+    optimizer memory/accuracy trade, emulated exactly like the rest of
+    CPD).  Params stay fp32 masters.
+
+    With (8,23) the cast is the identity; use_kahan=False then walks
+    `sgd`'s trajectory bitwise.  use_kahan=True still runs the Kahan
+    arithmetic (fp32 compensation changes rounding, so only ~ulp-close
+    to `sgd`) — the same shortcut asymmetry the reference's fp32 Kahan
+    all-reduce has (dist_util.py:55-59 vs :72-89, preserved in
+    parallel/reduction.py).
+
+        d    = g + wd*w
+        s    = Q(momentum * buf)
+        naive:  buf' = Q(s + d)
+        kahan:  y = Q(d - Q(momentum*c));  buf' = Q(s + y)
+                c' = Q(Q(buf' - s) - y)
+        step = d + momentum*buf' (nesterov) | buf'
+        w   -= lr * step
+    """
+    if (exp, man) == (8, 23):
+        def q(x):
+            return x
+    else:
+        from ..quant.numerics import cast_to_format
+
+        def q(x):
+            return cast_to_format(x, exp, man)
+
+    def init(params):
+        # no dead residual tree without Kahan: () has no leaves, so the
+        # quantized-optimizer state stays one buffer per param
+        comp = (jax.tree.map(jnp.zeros_like, params) if use_kahan else ())
+        return QuantSGDState(jnp.zeros([], jnp.int32),
+                             jax.tree.map(jnp.zeros_like, params), comp)
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("quant_sgd requires params")
+        lr = schedule(state.step)
+        mask = (wd_mask(params) if wd_mask is not None
+                else jax.tree.map(lambda _: True, params))
+
+        def decayed(g, w, use_wd):
+            return g + (weight_decay * w
+                        if (weight_decay and use_wd) else 0.0)
+
+        def step_dir(d, new_buf):
+            return d + momentum * new_buf if nesterov else new_buf
+
+        if use_kahan:
+            def one(g, w, buf, c, use_wd):
+                d = decayed(g, w, use_wd)
+                s = q(momentum * buf)
+                y = q(d - q(momentum * c))
+                new_buf = q(s + y)
+                new_c = q(q(new_buf - s) - y)
+                return -lr * step_dir(d, new_buf), new_buf, new_c
+
+            flat = jax.tree.map(one, grads, params, state.momentum_buf,
+                                state.comp, mask)
+            updates, bufs, comp = _unzip(flat, 3)
+        else:
+            def one(g, w, buf, use_wd):
+                d = decayed(g, w, use_wd)
+                new_buf = q(q(momentum * buf) + d)
+                return -lr * step_dir(d, new_buf), new_buf
+
+            flat = jax.tree.map(one, grads, params, state.momentum_buf,
+                                mask)
+            updates, bufs = _unzip(flat, 2)
+            comp = ()
+        return updates, QuantSGDState(state.step + 1, bufs, comp)
+
+    return optax.GradientTransformation(init, update)
+
+
 def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,
                    weight_decay: float = 0.0, nesterov: bool = False,
-                   wd_mask: Optional[Callable] = None,
+                   wd_mask: Optional[Callable] = None, opt_exp: int = 8,
+                   opt_man: int = 23, opt_kahan: bool = False,
                    ) -> optax.GradientTransformation:
-    """Registry used by trainer configs: 'sgd' | 'nesterov' | 'lars'."""
+    """Registry used by trainer configs:
+    'sgd' | 'nesterov' | 'lars' | 'quant_sgd'.
+
+    opt_exp/opt_man/opt_kahan apply to 'quant_sgd' (eXmY momentum
+    buffer; the optimizer-state analog of --grad_exp/--grad_man)."""
     if name == "sgd":
         return sgd(schedule, momentum, weight_decay, nesterov=nesterov,
                    wd_mask=wd_mask)
@@ -135,4 +238,8 @@ def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,
                    wd_mask=wd_mask)
     if name == "lars":
         return lars(schedule, momentum, weight_decay)
+    if name == "quant_sgd":
+        return quant_sgd(schedule, momentum, weight_decay, exp=opt_exp,
+                         man=opt_man, use_kahan=opt_kahan,
+                         nesterov=nesterov, wd_mask=wd_mask)
     raise ValueError(f"unknown optimizer {name!r}")

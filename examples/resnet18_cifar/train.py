@@ -45,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_lars", action="store_true")
     p.add_argument("--use_APS", action="store_true")
     p.add_argument("--use_kahan", action="store_true")
+    # optimizer-state precision (beyond the reference): hold the SGD
+    # momentum buffer in eXmY, the state analog of --grad_exp/--grad_man
+    p.add_argument("--opt_exp", default=8, type=int)
+    p.add_argument("--opt_man", default=23, type=int)
+    p.add_argument("--opt_kahan", action="store_true",
+                   help="Kahan-compensate the quantized momentum buffer")
     p.add_argument("-e", "--evaluate", action="store_true")
     p.add_argument("--emulate_node", default=1, type=int)
     # YAML-backed keys (mix.py:69-72 merges the YAML onto args); a CLI
@@ -116,9 +122,16 @@ def main(argv=None) -> dict:
         warmup_from=peak_lr / 16.0)
 
     model = get_model(args.arch)
-    tx = make_optimizer("lars" if args.use_lars else "sgd", schedule,
-                        momentum=args.momentum,
-                        weight_decay=args.weight_decay)
+    quant_opt = (args.opt_exp, args.opt_man) != (8, 23) or args.opt_kahan
+    if quant_opt and args.use_lars:
+        raise SystemExit("--use_lars and --opt_exp/--opt_man/--opt_kahan "
+                         "are exclusive")
+    opt_name = ("lars" if args.use_lars else
+                "quant_sgd" if quant_opt else "sgd")
+    tx = make_optimizer(opt_name, schedule, momentum=args.momentum,
+                        weight_decay=args.weight_decay,
+                        opt_exp=args.opt_exp, opt_man=args.opt_man,
+                        opt_kahan=args.opt_kahan)
 
     state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),
                                jax.random.PRNGKey(seed))

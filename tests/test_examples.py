@@ -81,6 +81,18 @@ def test_resnet18_trainer_aps_smoke(tiny_cifar, tmp_path, capsys, mode):
     mgr.close()
 
 
+def test_resnet18_trainer_quant_optimizer_smoke(tiny_cifar, tmp_path):
+    """--opt_exp/--opt_man: e5m2 Kahan momentum buffer through the CLI."""
+    from resnet18_cifar.train import main
+
+    res = main(["--arch", "tiny", "--data-root", tiny_cifar,
+                "--max-iter", "3", "--batch_size", "2", "--val_freq", "3",
+                "--opt_exp", "5", "--opt_man", "2", "--opt_kahan",
+                "--save_path", str(tmp_path / "ck"), "--mode", "fast"])
+    assert res["step"] == 3
+    assert math.isfinite(res["loss"])
+
+
 def test_resnet18_trainer_evaluate_flag(tiny_cifar):
     from resnet18_cifar.train import main
 

@@ -13,7 +13,7 @@ from cpd_tpu.parallel.mesh import data_parallel_mesh
 from cpd_tpu.train import (create_train_state, make_eval_step,
                            make_optimizer, make_train_step, piecewise_linear,
                            warmup_step_decay)
-from cpd_tpu.train.optim import lars, sgd
+from cpd_tpu.train.optim import lars, quant_sgd, sgd
 from cpd_tpu.train.schedules import iter_table
 
 
@@ -97,6 +97,90 @@ def test_lars_matches_reference_formula():
         updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
     np.testing.assert_allclose(np.asarray(params["w"]), w_ref, rtol=1e-5)
+
+
+def _run_opt(tx, w0, grads):
+    params = {"w": jnp.asarray(w0)}
+    state = tx.init(params)
+    for g in grads:
+        updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return np.asarray(params["w"]), state
+
+
+def test_quant_sgd_fp32_is_exact_sgd():
+    """(8,23) momentum buffer without Kahan: quant_sgd must walk sgd's
+    trajectory bitwise (the identity-cast shortcut, like
+    float_quantize's).  WITH Kahan the compensation arithmetic itself
+    changes fp32 rounding, so only ulp-closeness holds — the same
+    shortcut asymmetry as the reference's fp32 Kahan all-reduce
+    (dist_util.py:55-59 vs :72-89); mixed-magnitude grads make that
+    divergence real, not hypothetical."""
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(6, 5).astype(np.float32)
+    grads = [(rng.randn(6, 5) * 10.0 ** rng.uniform(-3, 2, (6, 5))
+              ).astype(np.float32) for _ in range(12)]
+    sched = lambda s: jnp.where(s < 3, 0.2, 0.02)  # noqa: E731
+    ref, _ = _run_opt(sgd(sched, momentum=0.9, weight_decay=1e-2,
+                          nesterov=True), w0, grads)
+    got, _ = _run_opt(quant_sgd(sched, momentum=0.9, weight_decay=1e-2,
+                                exp=8, man=23, nesterov=True), w0, grads)
+    assert np.array_equal(ref, got)
+    got_k, _ = _run_opt(quant_sgd(sched, momentum=0.9, weight_decay=1e-2,
+                                  exp=8, man=23, use_kahan=True,
+                                  nesterov=True), w0, grads)
+    np.testing.assert_allclose(got_k, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_quant_sgd_buffer_in_value_set():
+    """The momentum buffer must hold only e4m3-representable values."""
+    from cpd_tpu.quant.numerics import cast_to_format
+
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(8).astype(np.float32)
+    grads = [rng.randn(8).astype(np.float32) for _ in range(5)]
+    _, state = _run_opt(quant_sgd(lambda s: jnp.float32(0.1), momentum=0.9,
+                                  exp=4, man=3), w0, grads)
+    buf = state.momentum_buf["w"]
+    assert np.array_equal(np.asarray(buf),
+                          np.asarray(cast_to_format(buf, 4, 3)))
+
+
+def test_quant_sgd_kahan_recovers_flushed_gradients():
+    """Sub-ulp gradients against a large low-precision buffer: naive
+    accumulation flushes every one of them (0.04 < half-ulp(1.0) = 0.0625
+    at m3), the quantized Kahan residual carries them across the rounding
+    boundary — the same mechanism the reference's Kahan all-reduce exists
+    for (dist_util.py:72-89), applied to the optimizer state.
+
+    The increment must exceed half-ulp of the *residual's* binade or the
+    quantized c itself pins at a round-to-nearest-even tie and stalls
+    (e.g. 2e-3 increments pin c at -0.0625 exactly) — compensated
+    accumulation in quantized arithmetic is better, not magic."""
+    w0 = np.zeros(4, np.float32)
+    # one big gradient builds the buffer to 1.0, then 200 sub-ulp ones
+    grads = [np.full(4, 1.0, np.float32)] + \
+            [np.full(4, 0.04, np.float32)] * 200
+    sched = lambda s: jnp.float32(0.0)  # noqa: E731 — isolate the buffer
+    kw = dict(momentum=1.0, weight_decay=0.0)
+    _, st_naive = _run_opt(quant_sgd(sched, exp=4, man=3, **kw), w0, grads)
+    _, st_kahan = _run_opt(quant_sgd(sched, exp=4, man=3, use_kahan=True,
+                                     **kw), w0, grads)
+    _, st_exact = _run_opt(sgd(sched, **kw), w0, grads)
+    exact = np.asarray(st_exact.momentum_buf["w"])   # 1 + 200*0.04 = 9.0
+    naive_err = np.abs(np.asarray(st_naive.momentum_buf["w"]) - exact).max()
+    kahan_err = np.abs(np.asarray(st_kahan.momentum_buf["w"]) - exact).max()
+    assert naive_err > 7.5, (naive_err, kahan_err)   # buffer stuck at 1.0
+    assert kahan_err < 0.5, (naive_err, kahan_err)   # tracks 9.0
+
+
+def test_make_optimizer_quant_sgd():
+    tx = make_optimizer("quant_sgd", lambda s: jnp.float32(0.1),
+                        opt_exp=5, opt_man=2, opt_kahan=True)
+    params = {"w": jnp.ones(3)}
+    state = tx.init(params)
+    updates, state = tx.update({"w": jnp.ones(3)}, state, params)
+    assert np.isfinite(np.asarray(updates["w"])).all()
 
 
 def test_wd_mask_excludes_leaves():
