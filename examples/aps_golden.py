@@ -41,30 +41,34 @@ CONFIGS = [
     ("e3m4_aps", 3, 4, True),
 ]
 
+# Second arm (capability beyond the reference): momentum buffer held in
+# eXmY (train/optim.py quant_sgd).  Same claim shape as APS: naive
+# low-precision state loses accuracy, the quantized Kahan residual
+# recovers it.  Gradients stay fp32 so the effect isolates the optimizer.
+OPT_CONFIGS = [
+    # tag, extra CLI flags
+    ("opt_fp32", []),
+    ("opt_e4m3_naive", ["--opt_exp", "4", "--opt_man", "3"]),
+    ("opt_e4m3_kahan", ["--opt_exp", "4", "--opt_man", "3",
+                        "--opt_kahan"]),
+]
 
-def run_experiment(iters: int, save_root: str, batch_size: int = 16,
-                   emulate_node: int = 2, peak_lr: float = 0.4,
-                   configs=CONFIGS, data_root=None, arch: str = "tiny",
-                   mode: str = "fast", quiet: bool = True) -> dict:
-    """Train every config; returns {tag: {"prec1": float, "loss": [...]}}.
 
-    `mode="fast"` uses quantize->psum->requantize; the ordered faithful
-    path is bit-covered by tests/test_parallel.py — for the accuracy-
-    ordering claim both modes carry the same precision at the wire, and
-    fast keeps the experiment CPU-affordable."""
+def _run_tagged(tagged_flags, iters: int, save_root: str, batch_size: int,
+                emulate_node: int, peak_lr: float, data_root, arch: str,
+                mode: str, quiet: bool) -> dict:
+    """Shared runner: train each (tag, extra_flags) config through the
+    ResNet-18 CLI; returns {tag: {"prec1": float, "loss": [(step, v)]}}."""
     from resnet18_cifar.train import main
 
     out = {}
-    for tag, ge, gm, aps in configs:
+    for tag, extra in tagged_flags:
         save = os.path.join(save_root, tag)
         argv = ["--arch", arch, "--batch_size", str(batch_size),
                 "--max-iter", str(iters), "--val_freq", str(iters),
                 "--print_freq", "100000" if quiet else "50",
                 "--peak-lr", str(peak_lr), "--save_path", save,
-                "--emulate_node", str(emulate_node), "--mode", mode,
-                "--grad_exp", str(ge), "--grad_man", str(gm)]
-        if aps:
-            argv.append("--use_APS")
+                "--emulate_node", str(emulate_node), "--mode", mode] + extra
         if data_root:
             argv += ["--data-root", data_root]
         res = main(argv)
@@ -76,9 +80,54 @@ def run_experiment(iters: int, save_root: str, batch_size: int = 16,
                     rec = json.loads(line)
                     if rec.get("tag") == "train/loss":
                         losses.append((rec["step"], rec["value"]))
-        out[tag] = {"prec1": res["best_prec1"], "loss": losses}
-        print(f"== {tag}: Prec@1 {res['best_prec1']:.2f}", flush=True)
+        out[tag] = {"prec1": res["best_prec1"], "loss": losses,
+                    "diverged": bool(res.get("diverged"))}
+        note = "  [DIVERGED]" if res.get("diverged") else ""
+        print(f"== {tag}: Prec@1 {res['best_prec1']:.2f}{note}", flush=True)
     return out
+
+
+def run_experiment(iters: int, save_root: str, batch_size: int = 16,
+                   emulate_node: int = 2, peak_lr: float = 0.4,
+                   configs=CONFIGS, data_root=None, arch: str = "tiny",
+                   mode: str = "fast", quiet: bool = True) -> dict:
+    """Train every gradient-precision config.
+
+    `mode="fast"` uses quantize->psum->requantize; the ordered faithful
+    path is bit-covered by tests/test_parallel.py — for the accuracy-
+    ordering claim both modes carry the same precision at the wire, and
+    fast keeps the experiment CPU-affordable."""
+    tagged = [(tag, ["--grad_exp", str(ge), "--grad_man", str(gm)]
+               + (["--use_APS"] if aps else []))
+              for tag, ge, gm, aps in configs]
+    return _run_tagged(tagged, iters, save_root, batch_size, emulate_node,
+                       peak_lr, data_root, arch, mode, quiet)
+
+
+def run_opt_experiment(iters: int, save_root: str, batch_size: int = 16,
+                       emulate_node: int = 2, peak_lr: float = 0.4,
+                       configs=OPT_CONFIGS, data_root=None,
+                       arch: str = "tiny", mode: str = "fast",
+                       quiet: bool = True) -> dict:
+    """Train every optimizer-precision config; {tag: {"prec1": ...}}."""
+    return _run_tagged(list(configs), iters, save_root, batch_size,
+                       emulate_node, peak_lr, data_root, arch, mode, quiet)
+
+
+def check_opt_ordering(results: dict, margin: float = 1.0,
+                       recover: float = 2.0) -> list[str]:
+    """Kahan-compensated eXmY momentum recovers what naive loses."""
+    fp32 = results["opt_fp32"]["prec1"]
+    naive = results["opt_e4m3_naive"]["prec1"]
+    kahan = results["opt_e4m3_kahan"]["prec1"]
+    ok_gain = kahan >= naive + margin
+    ok_recover = kahan >= fp32 - recover
+    return [
+        f"opt e4m3: kahan {kahan:.2f} >= naive {naive:.2f} + {margin} -> "
+        f"{'OK' if ok_gain else 'VIOLATED'}",
+        f"opt e4m3: kahan {kahan:.2f} >= fp32 {fp32:.2f} - {recover} -> "
+        f"{'OK' if ok_recover else 'VIOLATED'}",
+    ]
 
 
 def check_ordering(results: dict, margin: float = 2.0) -> list[str]:
@@ -130,18 +179,28 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=os.path.join(_REPO, "docs", "golden"))
     p.add_argument("--save-root", default="/tmp/cpd_tpu_golden")
     p.add_argument("--data-root", default=None)
-    p.add_argument("--margin", type=float, default=2.0)
+    p.add_argument("--margin", type=float, default=2.0,
+                   help="APS-arm min accuracy gain (aps vs noaps)")
+    p.add_argument("--opt-margin", type=float, default=1.0,
+                   help="optimizer-arm min gain (kahan vs naive)")
     args = p.parse_args(argv)
 
     results = run_experiment(args.iters, args.save_root,
                              data_root=args.data_root)
     checks = check_ordering(results, args.margin)
+    opt_results = run_opt_experiment(args.iters,
+                                     os.path.join(args.save_root, "opt"),
+                                     data_root=args.data_root)
+    opt_checks = check_opt_ordering(opt_results,
+                                    margin=args.opt_margin)
+    checks += opt_checks
     os.makedirs(args.out, exist_ok=True)
     payload = {
         "iters": args.iters,
         "workload": "CIFAR-10-shaped, tiny CNN, dp=8 x emulate_node=2 "
                     "(16-rank emulated cluster), faithful-precision wire",
         "prec1": {t: r["prec1"] for t, r in results.items()},
+        "opt_prec1": {t: r["prec1"] for t, r in opt_results.items()},
         "checks": checks,
     }
     with open(os.path.join(args.out, "results.json"), "w") as f:
@@ -153,5 +212,20 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
+    # The documented workload is the 8-device VIRTUAL CPU mesh (the JAX
+    # emulate-node analog, SURVEY.md §4c) — force it before jax imports.
+    # Without this, the axon TPU plugin grabs the backend and the
+    # experiment crawls through the tunnel on 1 real chip (~25 ms per
+    # device round-trip x 400 iters x 8 configs).
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                    os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     raise SystemExit(main())

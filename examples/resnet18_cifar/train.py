@@ -235,6 +235,7 @@ def main(argv=None) -> dict:
     from cpd_tpu.train import PreemptionGuard
     guard = PreemptionGuard()
     preempted = False
+    diverged = False
     from cpd_tpu.utils.prefetch import Prefetcher
     try:
         for gx, gy in Prefetcher(produced(), depth=2):
@@ -254,6 +255,19 @@ def main(argv=None) -> dict:
             state, metrics = train_step(state, gx, gy)
             step_no += 1
             last = {k: float(v) for k, v in metrics.items()}
+            if not math.isfinite(last["loss"]):
+                # low-precision training can diverge; every further step
+                # would train on garbage, so stop with a clear verdict
+                # instead of burning the rest of the run.  A controlled
+                # stop (not an exception): teardown runs, in-process
+                # harnesses (aps_golden, tests) get the partial result
+                # with diverged=True, and the CLI exits non-zero.
+                diverged = True
+                if rank == 0:
+                    print(f"=> non-finite loss {last['loss']} at iter "
+                          f"{step_no} — diverged (try --use_APS / more "
+                          f"mantissa bits)", file=sys.stderr)
+                break
             progress.maybe_print(step_no, Loss=last["loss"],
                                  Prec=100 * last["accuracy"],
                                  LR=float(schedule(step_no)))
@@ -270,12 +284,14 @@ def main(argv=None) -> dict:
     profiler.close()
     manager.wait()
     writer.close()
-    if rank == 0 and not preempted:   # an interrupted run is NOT "done"
+    if rank == 0 and not (preempted or diverged):  # interrupted != "done"
         print(f"done: {step_no - start_iter} iters in {time.time()-t0:.1f}s "
               f"best Prec@1 {best_prec1:.2f}")
     manager.close()
-    return {"step": step_no, "best_prec1": best_prec1, **last}
+    return {"step": step_no, "best_prec1": best_prec1,
+            "diverged": diverged, **last}
 
 
 if __name__ == "__main__":
-    main()
+    res = main()
+    sys.exit(3 if res.get("diverged") else 0)

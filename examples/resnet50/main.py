@@ -227,6 +227,7 @@ def main(argv=None) -> dict:
     # auto-resume above continues mid-epoch without re-training a batch.
     guard = PreemptionGuard()
     preempted = False
+    diverged = False
     global_it = 0
     try:
         for epoch in range(start_epoch, args.epochs):
@@ -263,10 +264,23 @@ def main(argv=None) -> dict:
                     state,
                     host_batch_to_global(x.astype(np.float32), mesh),
                     host_batch_to_global(y, mesh))
-                train_loss += float(m["loss"])
+                step_loss = float(m["loss"])
+                if not math.isfinite(step_loss):
+                    # low-precision training can diverge; controlled stop
+                    # (teardown runs, harnesses get diverged=True, CLI
+                    # exits non-zero) instead of burning the rest of the
+                    # run
+                    diverged = True
+                    if rank == 0:
+                        print(f"=> non-finite loss {step_loss} at epoch "
+                              f"{epoch} iter {it} — diverged (try "
+                              f"--use-APS / more mantissa bits)",
+                              file=sys.stderr)
+                    break
+                train_loss += step_loss
                 train_acc += float(m["accuracy"])
                 n_done += 1
-            if preempted:
+            if preempted or diverged:
                 break
             jax.block_until_ready(state.params)
             dt = time.time() - t0
@@ -320,8 +334,10 @@ def main(argv=None) -> dict:
     manager.wait()
     manager.close()
     writer.close()
+    result["diverged"] = diverged
     return result
 
 
 if __name__ == "__main__":
-    main()
+    res = main()
+    sys.exit(3 if res.get("diverged") else 0)

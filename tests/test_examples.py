@@ -81,6 +81,22 @@ def test_resnet18_trainer_aps_smoke(tiny_cifar, tmp_path, capsys, mode):
     mgr.close()
 
 
+def test_resnet18_halts_on_nonfinite_loss(tiny_cifar, tmp_path, capsys):
+    """A diverged run (NaN/inf loss) must stop with a clear verdict — a
+    controlled stop (diverged=True in the result, teardown runs), not an
+    exception that would kill in-process harnesses like aps_golden."""
+    from resnet18_cifar.train import main
+
+    res = main(["--arch", "tiny", "--data-root", tiny_cifar,
+                "--max-iter", "8", "--batch_size", "2", "--val_freq", "8",
+                "--peak-lr", "1e8",
+                "--save_path", str(tmp_path / "ck"), "--mode", "fast"])
+    assert res["diverged"] is True
+    assert res["step"] < 8                     # stopped early
+    err = capsys.readouterr().err
+    assert "non-finite loss" in err and "diverged" in err
+
+
 def test_resnet18_trainer_quant_optimizer_smoke(tiny_cifar, tmp_path):
     """--opt_exp/--opt_man: e5m2 Kahan momentum buffer through the CLI."""
     from resnet18_cifar.train import main
@@ -133,7 +149,7 @@ def test_resnet50_trainer_smoke_and_resume(tmp_path, capsys):
     res2 = main(argv)
     out = capsys.readouterr().out
     assert "auto-resumed" in out
-    assert res2 == {}                      # all epochs already done
+    assert "epoch" not in res2             # all epochs already done
 
 
 def _make_fake_guard(trigger_after_polls):
@@ -198,7 +214,7 @@ def test_resnet50_preempt_saves_and_resumes_mid_epoch(tmp_path, capsys,
     res = main(argv)
     out = capsys.readouterr().out
     assert "preempted: saved step 1 (epoch 0 iter 1)" in out
-    assert res == {}                       # epoch never completed
+    assert "epoch" not in res              # epoch never completed
 
     mgr = CheckpointManager(ckpt, track_best=False)
     meta = mgr.metadata()
