@@ -20,11 +20,12 @@ __all__ = [
     "iter_table", "piecewise_linear", "warmup_step_decay",
     "AverageMeter", "Timer", "accuracy",
     "make_lm_train_step", "lm_state_specs",
-    "CheckpointManager", "PreemptionGuard", "save_checkpoint",
-    "restore_latest",
+    "CheckpointManager", "PreemptionGuard", "preempt_save",
+    "loss_diverged", "save_checkpoint", "restore_latest",
 ]
 
 _CHECKPOINT_NAMES = {"CheckpointManager", "PreemptionGuard",
+                     "preempt_save", "loss_diverged",
                      "save_checkpoint", "restore_latest"}
 
 

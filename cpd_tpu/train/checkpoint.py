@@ -27,8 +27,46 @@ import orbax.checkpoint as ocp
 
 from .state import TrainState
 
-__all__ = ["CheckpointManager", "PreemptionGuard", "save_checkpoint",
-           "restore_latest"]
+__all__ = ["CheckpointManager", "PreemptionGuard", "preempt_save",
+           "loss_diverged", "save_checkpoint", "restore_latest"]
+
+
+def preempt_save(manager: "CheckpointManager", step_no, state, rank: int,
+                 metadata: Optional[dict] = None,
+                 what: str = "iter") -> None:
+    """The shared preemption-boundary save used by every trainer loop.
+
+    Skips the save when a checkpoint at this exact step already exists
+    (a periodic save just before the signal, or a resume that never
+    stepped) — saving again would raise orbax's StepAlreadyExistsError
+    mid-grace-period.  Blocks for in-flight device work first and waits
+    for the write, so the process can exit immediately after."""
+    jax.block_until_ready(state.params)
+    if manager.latest_step() != int(step_no):
+        manager.save(int(step_no), state, force=True, metadata=metadata)
+        manager.wait()
+    if rank == 0:
+        print(f"=> preempted: saved {what} {int(step_no)}; exiting")
+
+
+def loss_diverged(loss: float, where: str, rank: int,
+                  hint: str = "try --use_APS / more mantissa bits") -> bool:
+    """True (with a rank-0 verdict line on stderr) when `loss` is
+    non-finite.  Trainers break their loop on it and report
+    diverged=True — a controlled stop, not an exception, so in-process
+    harnesses (aps_golden, tests) record the divergence instead of
+    dying.  The loss metric is replicated across hosts, so every host
+    takes the same branch."""
+    import math
+
+    if math.isfinite(loss):
+        return False
+    if rank == 0:
+        import sys
+
+        print(f"=> non-finite loss {loss} at {where} — diverged "
+              f"({hint})", file=sys.stderr)
+    return True
 
 
 class PreemptionGuard:

@@ -66,7 +66,8 @@ def main(argv=None) -> dict:
     from cpd_tpu.models import get_model
     from cpd_tpu.parallel.dist import dist_init, host_batch_to_global
     from cpd_tpu.parallel.mesh import data_parallel_mesh
-    from cpd_tpu.train import (Timer, create_train_state, make_eval_step,
+    from cpd_tpu.train import (Timer, create_train_state,
+                               loss_diverged, make_eval_step,
                                make_optimizer, make_train_step,
                                piecewise_linear)
     from cpd_tpu.utils import StepProfiler, TableLogger, TSVLogger
@@ -116,6 +117,7 @@ def main(argv=None) -> dict:
     profiler = StepProfiler(args.profile_dir, start=3)
     global_step = 0
     result = {}
+    diverged = False
     for epoch in range(1, args.epoch + 1):
         rng = np.random.RandomState(args.seed + epoch)
         # same epoch permutation on every host; each takes its contiguous
@@ -130,9 +132,16 @@ def main(argv=None) -> dict:
             x, y = pipeline.batch(sel, seed=epoch)
             state, m = train_step(state, host_batch_to_global(x, mesh),
                                   host_batch_to_global(y, mesh))
-            train_loss += float(m["loss"])
+            step_loss = float(m["loss"])
+            if loss_diverged(step_loss, f"step {global_step}", rank,
+                             hint="lower --loss_scale / try --use_APS"):
+                diverged = True
+                break
+            train_loss += step_loss
             train_acc += float(m["accuracy"])
             n += 1
+        if diverged:
+            break
         jax.block_until_ready(state.params)
         train_time = timer()                 # counts toward total
 
@@ -167,8 +176,10 @@ def main(argv=None) -> dict:
     profiler.close()
     if rank == 0:
         print(tsv)
+    result["diverged"] = diverged
     return result
 
 
 if __name__ == "__main__":
-    main()
+    res = main()
+    sys.exit(3 if res.get("diverged") else 0)

@@ -135,29 +135,47 @@ def main(argv=None) -> dict:
     host_batch = global_batch // world
     last = {}
     profiler = StepProfiler(args.profile_dir, start=3)
+    # SIGTERM → save at the next step boundary and exit cleanly; resume
+    # continues at the saved iteration (same scheme as the other trainers)
+    from cpd_tpu.train import PreemptionGuard, loss_diverged, preempt_save
+    guard = PreemptionGuard()
+    preempted = diverged = False
+    step_no = start_iter
     t0 = time.time()
-    for it in range(start_iter + 1, args.max_iter + 1):
-        profiler.step(it)
-        idx = rng.randint(0, len(ds), size=host_batch)
-        x, y = ds.batch(idx, seed=it)
-        state, m = step(state, host_batch_to_global(x, mesh),
-                        host_batch_to_global(y, mesh))
-        last = {k: float(v) for k, v in m.items()}
-        progress.maybe_print(it, Loss=last["loss"],
-                             PixAcc=100 * last["accuracy"])
-        writer.add_scalar("train/loss", last["loss"], it)
-        if it % args.ckpt_freq == 0 or it == args.max_iter:
-            manager.save(it, state)
+    try:
+        for it in range(start_iter + 1, args.max_iter + 1):
+            if guard.should_stop():      # collective when multi-host
+                preempt_save(manager, step_no, state, rank)
+                preempted = True
+                break
+            profiler.step(it)
+            idx = rng.randint(0, len(ds), size=host_batch)
+            x, y = ds.batch(idx, seed=it)
+            state, m = step(state, host_batch_to_global(x, mesh),
+                            host_batch_to_global(y, mesh))
+            step_no = it
+            last = {k: float(v) for k, v in m.items()}
+            if loss_diverged(last["loss"], f"iter {it}", rank):
+                diverged = True
+                break
+            progress.maybe_print(it, Loss=last["loss"],
+                                 PixAcc=100 * last["accuracy"])
+            writer.add_scalar("train/loss", last["loss"], it)
+            if it % args.ckpt_freq == 0 or it == args.max_iter:
+                manager.save(it, state)
+    finally:
+        guard.uninstall()
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()
     profiler.close()
-    if rank == 0:
+    if rank == 0 and not (preempted or diverged):
         print(f"done: {args.max_iter} iters in {time.time()-t0:.1f}s "
               f"final loss {last.get('loss', float('nan')):.4f}")
     writer.close()
-    return {"step": args.max_iter, **last}
+    return {"step": step_no, "diverged": diverged, **last}
 
 
 if __name__ == "__main__":
-    main()
+    res = main()
+    sys.exit(3 if res.get("diverged") else 0)

@@ -232,7 +232,7 @@ def main(argv=None) -> dict:
     # SIGTERM (spot-VM preemption) → save at the next step boundary and
     # exit; the iteration-based sampler resumes at exactly this step via
     # last_iter (train_util.py:159-222 semantics), so nothing re-trains.
-    from cpd_tpu.train import PreemptionGuard
+    from cpd_tpu.train import PreemptionGuard, loss_diverged, preempt_save
     guard = PreemptionGuard()
     preempted = False
     diverged = False
@@ -240,33 +240,15 @@ def main(argv=None) -> dict:
     try:
         for gx, gy in Prefetcher(produced(), depth=2):
             if guard.should_stop():      # collective when multi-host
-                jax.block_until_ready(state.params)
-                # an existing checkpoint at this exact step (val_freq
-                # save, or a resume that never stepped) already holds this
-                # state — saving again would raise StepAlreadyExistsError
-                if manager.latest_step() != step_no:
-                    manager.save(step_no, state, force=True)
-                    manager.wait()
-                if rank == 0:
-                    print(f"=> preempted: saved iter {step_no}; exiting")
+                preempt_save(manager, step_no, state, rank)
                 preempted = True
                 break
             profiler.step(step_no)
             state, metrics = train_step(state, gx, gy)
             step_no += 1
             last = {k: float(v) for k, v in metrics.items()}
-            if not math.isfinite(last["loss"]):
-                # low-precision training can diverge; every further step
-                # would train on garbage, so stop with a clear verdict
-                # instead of burning the rest of the run.  A controlled
-                # stop (not an exception): teardown runs, in-process
-                # harnesses (aps_golden, tests) get the partial result
-                # with diverged=True, and the CLI exits non-zero.
+            if loss_diverged(last["loss"], f"iter {step_no}", rank):
                 diverged = True
-                if rank == 0:
-                    print(f"=> non-finite loss {last['loss']} at iter "
-                          f"{step_no} — diverged (try --use_APS / more "
-                          f"mantissa bits)", file=sys.stderr)
                 break
             progress.maybe_print(step_no, Loss=last["loss"],
                                  Prec=100 * last["accuracy"],

@@ -102,8 +102,9 @@ def main(argv=None) -> dict:
                                        replicate)
     from cpd_tpu.parallel.mesh import data_parallel_mesh
     from cpd_tpu.train import (CheckpointManager, PreemptionGuard,
-                               create_train_state, make_eval_step,
-                               make_optimizer, make_train_step,
+                               create_train_state, loss_diverged,
+                               make_eval_step, make_optimizer,
+                               make_train_step, preempt_save,
                                warmup_step_decay)
     from cpd_tpu.utils import (ScalarWriter, StepProfiler,
                                format_validation_line)
@@ -239,21 +240,14 @@ def main(argv=None) -> dict:
             n_done = 0
             for it in range(epoch_start, iters_per_epoch):
                 if guard.should_stop():      # collective when multi-host
-                    jax.block_until_ready(state.params)
-                    # an existing checkpoint at this exact step (epoch-end
-                    # save, or a resume that never stepped) already holds this
-                    # state — saving again would raise StepAlreadyExistsError
-                    if manager.latest_step() != int(state.step):
-                        manager.save(int(state.step), state, force=True,
-                                     metadata={"epoch": epoch, "resume_it": it,
-                                               "iters_per_epoch":
-                                                   iters_per_epoch,
-                                               "global_batch": global_batch,
-                                               "world": world})
-                        manager.wait()
+                    preempt_save(
+                        manager, state.step, state, rank, what="step",
+                        metadata={"epoch": epoch, "resume_it": it,
+                                  "iters_per_epoch": iters_per_epoch,
+                                  "global_batch": global_batch,
+                                  "world": world})
                     if rank == 0:
-                        print(f"=> preempted: saved step {int(state.step)} "
-                              f"(epoch {epoch} iter {it}); exiting")
+                        print(f"   (epoch {epoch} iter {it})")
                     preempted = True
                     break
                 global_it += 1
@@ -265,17 +259,10 @@ def main(argv=None) -> dict:
                     host_batch_to_global(x.astype(np.float32), mesh),
                     host_batch_to_global(y, mesh))
                 step_loss = float(m["loss"])
-                if not math.isfinite(step_loss):
-                    # low-precision training can diverge; controlled stop
-                    # (teardown runs, harnesses get diverged=True, CLI
-                    # exits non-zero) instead of burning the rest of the
-                    # run
+                if loss_diverged(step_loss, f"epoch {epoch} iter {it}",
+                                 rank, hint="try --use-APS / more "
+                                            "mantissa bits"):
                     diverged = True
-                    if rank == 0:
-                        print(f"=> non-finite loss {step_loss} at epoch "
-                              f"{epoch} iter {it} — diverged (try "
-                              f"--use-APS / more mantissa bits)",
-                              file=sys.stderr)
                     break
                 train_loss += step_loss
                 train_acc += float(m["accuracy"])
