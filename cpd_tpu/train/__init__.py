@@ -5,7 +5,7 @@ from .step import (cross_entropy_loss, make_eval_step, make_train_step,
                    seg_cross_entropy_loss)
 from .optim import lars, make_optimizer, quant_sgd, sgd
 from .schedules import iter_table, piecewise_linear, warmup_step_decay
-from .metrics import AverageMeter, Timer, accuracy
+from .metrics import AverageMeter, Timer, accuracy, loss_diverged
 from .lm import lm_state_specs, make_lm_train_step
 from .pp import make_pp_eval_step, make_pp_train_step, pp_state_specs
 from .moe import make_moe_eval_step, make_moe_train_step, moe_state_specs
@@ -25,8 +25,7 @@ __all__ = [
 ]
 
 _CHECKPOINT_NAMES = {"CheckpointManager", "PreemptionGuard",
-                     "preempt_save", "loss_diverged",
-                     "save_checkpoint", "restore_latest"}
+                     "preempt_save", "save_checkpoint", "restore_latest"}
 
 
 def __getattr__(name):

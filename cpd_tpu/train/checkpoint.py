@@ -28,7 +28,7 @@ import orbax.checkpoint as ocp
 from .state import TrainState
 
 __all__ = ["CheckpointManager", "PreemptionGuard", "preempt_save",
-           "loss_diverged", "save_checkpoint", "restore_latest"]
+           "save_checkpoint", "restore_latest"]
 
 
 def preempt_save(manager: "CheckpointManager", step_no, state, rank: int,
@@ -47,26 +47,6 @@ def preempt_save(manager: "CheckpointManager", step_no, state, rank: int,
         manager.wait()
     if rank == 0:
         print(f"=> preempted: saved {what} {int(step_no)}; exiting")
-
-
-def loss_diverged(loss: float, where: str, rank: int,
-                  hint: str = "try --use_APS / more mantissa bits") -> bool:
-    """True (with a rank-0 verdict line on stderr) when `loss` is
-    non-finite.  Trainers break their loop on it and report
-    diverged=True — a controlled stop, not an exception, so in-process
-    harnesses (aps_golden, tests) record the divergence instead of
-    dying.  The loss metric is replicated across hosts, so every host
-    takes the same branch."""
-    import math
-
-    if math.isfinite(loss):
-        return False
-    if rank == 0:
-        import sys
-
-        print(f"=> non-finite loss {loss} at {where} — diverged "
-              f"({hint})", file=sys.stderr)
-    return True
 
 
 class PreemptionGuard:

@@ -10,13 +10,34 @@ SURVEY.md C21 — one copy here):
 
 from __future__ import annotations
 
+import math
+import sys
 import time
 from collections import deque
 from typing import Sequence
 
 import numpy as np
 
-__all__ = ["AverageMeter", "accuracy", "Timer"]
+__all__ = ["AverageMeter", "accuracy", "Timer", "loss_diverged"]
+
+
+def loss_diverged(loss: float, where: str, rank: int,
+                  hint: str = "try --use_APS / more mantissa bits") -> bool:
+    """True (with a rank-0 verdict line on stderr) when `loss` is
+    non-finite.  Trainers break their loop on it and report
+    diverged=True — a controlled stop, not an exception, so in-process
+    harnesses (aps_golden, tests) record the divergence instead of
+    dying.  The loss metric is replicated across hosts, so every host
+    takes the same branch.
+
+    Lives here (not checkpoint.py) so trainers without checkpointing —
+    DavidNet, whose reference has none — don't pay the orbax import."""
+    if math.isfinite(loss):
+        return False
+    if rank == 0:
+        print(f"=> non-finite loss {loss} at {where} — diverged "
+              f"({hint})", file=sys.stderr)
+    return True
 
 
 class AverageMeter:

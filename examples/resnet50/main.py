@@ -11,7 +11,6 @@ gradient all-reduce.
 from __future__ import annotations
 
 import argparse
-import math
 import os
 import sys
 import time

@@ -1,0 +1,162 @@
+"""Unit tests for cpd_tpu.utils — config merge, loggers, prefetcher,
+compile cache.  These are the harness-plumbing pieces every trainer rides
+(SURVEY.md §5 config/logging parity); previously only covered indirectly
+through the trainer smokes."""
+
+import json
+import os
+import time
+
+import pytest
+
+
+# ------------------------------------------------------------- config
+
+def test_yaml_merge_cli_precedence(tmp_path):
+    import argparse
+
+    from cpd_tpu.utils import load_yaml_config, merge_config_into_args
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("common:\n  batch_size: 512\n  arch: res_cifar\n"
+                   "  momentum: 0.9\n")
+    loaded = load_yaml_config(str(cfg))
+    assert loaded["batch_size"] == 512
+
+    args = argparse.Namespace(batch_size=64, arch=None, momentum=None)
+    # explicit CLI value (batch_size) beats YAML; None takes the YAML's
+    merge_config_into_args(args, loaded,
+                           cli_overrides={"batch_size": 64})
+    assert args.batch_size == 64
+    assert args.arch == "res_cifar"
+    assert args.momentum == 0.9
+
+
+# ------------------------------------------------------------ loggers
+
+def test_table_logger_rank_gate_and_columns(capsys):
+    from cpd_tpu.utils import TableLogger
+
+    t = TableLogger(rank=1)
+    t.append({"epoch": 1, "loss": 0.5})
+    assert capsys.readouterr().out == ""     # non-zero rank is silent
+
+    t0 = TableLogger(rank=0)
+    t0.append({"epoch": 1, "loss": 0.5})
+    t0.append({"epoch": 2, "loss": 0.25})
+    out = capsys.readouterr().out.splitlines()
+    assert "epoch" in out[0] and "loss" in out[0]   # header once
+    assert len(out) == 3
+
+
+def test_tsv_logger_dawnbench_format():
+    from cpd_tpu.utils import TSVLogger
+
+    tsv = TSVLogger()
+    tsv.append({"epoch": 1, "total time": 3600.0, "test acc": 0.9})
+    lines = str(tsv).splitlines()
+    assert lines[0] == "epoch\thours\ttop1Accuracy"
+    epoch, hours, acc = lines[1].split("\t")
+    assert epoch == "1" and float(hours) == 1.0 and acc == "90.00"
+
+
+def test_scalar_writer_jsonl_roundtrip(tmp_path):
+    from cpd_tpu.utils import ScalarWriter
+
+    with ScalarWriter(str(tmp_path), rank=0) as w:
+        w.add_scalar("train/loss", 1.5, 1)
+        w.add_scalar("train/loss", 1.25, 2)
+    with ScalarWriter(str(tmp_path / "nope"), rank=1) as w:
+        w.add_scalar("train/loss", 9.9, 1)   # rank-gated: no file
+    recs = [json.loads(line)
+            for line in open(tmp_path / "scalars.jsonl")]
+    assert [r["value"] for r in recs] == [1.5, 1.25]
+    assert not (tmp_path / "nope").exists()
+
+
+def test_validation_line_matches_draw_curve_grep():
+    from cpd_tpu.utils import format_validation_line
+
+    line = format_validation_line(0.5, 91.25, 99.5)
+    # the grep contract of draw_curve.py / reference mix.py:422-425
+    assert line.startswith(" * All Loss ")
+    assert "Prec@1 91.250" in line and "Prec@5 99.500" in line
+
+
+# ---------------------------------------------------------- prefetcher
+
+def test_prefetcher_preserves_order_and_exhausts():
+    from cpd_tpu.utils.prefetch import Prefetcher
+
+    assert list(Prefetcher(iter(range(20)), depth=3)) == list(range(20))
+
+
+def test_prefetcher_propagates_source_exception():
+    from cpd_tpu.utils.prefetch import Prefetcher
+
+    def bad():
+        yield 1
+        raise RuntimeError("source broke")
+
+    it = iter(Prefetcher(bad(), depth=2))
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="source broke"):
+        for _ in it:
+            pass
+
+
+def test_prefetcher_runs_ahead_of_consumer():
+    from cpd_tpu.utils.prefetch import Prefetcher
+
+    produced = []
+
+    def slow_consumer_source():
+        for i in range(4):
+            produced.append(i)
+            yield i
+
+    pf = Prefetcher(slow_consumer_source(), depth=2)
+    it = iter(pf)
+    first = next(it)
+    time.sleep(0.2)                  # give the thread time to run ahead
+    assert first == 0
+    assert len(produced) >= 2        # producer is ahead of the consumer
+    assert list(it) == [1, 2, 3]
+
+
+# ------------------------------------------------------------- cache
+
+def test_machine_tag_stable_and_hex():
+    from cpd_tpu.utils.cache import _machine_tag
+
+    a, b = _machine_tag(), _machine_tag()
+    assert a == b                    # deterministic (APIC-ID byte masked)
+    int(a, 16)
+    assert len(a) == 10
+
+
+def test_enable_compile_cache_noop_on_cpu():
+    import jax
+
+    from cpd_tpu.utils import enable_compile_cache
+
+    # conftest forces the cpu platform, so this must be a no-op: the
+    # XLA:CPU AOT reload of collective executables crashes this jaxlib
+    before = jax.config.jax_compilation_cache_dir
+    enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_clear_cache_removes_only_current_tag(tmp_path, monkeypatch):
+    from cpd_tpu.utils import cache
+
+    root = tmp_path / ".jax_cache"
+    mine = root / cache._machine_tag()
+    other = root / "otherhosttag"
+    mine.mkdir(parents=True)
+    other.mkdir(parents=True)
+    (mine / "entry").write_text("x")
+    monkeypatch.setattr(cache, "_cache_root", lambda: str(root))
+    cache.clear_cache()
+    assert not mine.exists()
+    assert other.exists()            # other machines' entries survive
