@@ -23,7 +23,6 @@ sources are skipped (masked to -inf; their compute overlaps the permute).
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -24,7 +24,6 @@ use sgd/nesterov here.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

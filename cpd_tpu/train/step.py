@@ -29,7 +29,6 @@ must be bitwise-replicated, and averaging is strictly more principled than
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import jax
