@@ -3,11 +3,13 @@
 Parity with `example/ResNet18/draw_curve.py:11-29`: greps `tee`'d stdout
 logs for the ``* All Loss … Prec@1 …`` summary lines (token index -3 is
 Prec@1 — the contract of cpd_tpu.utils.format_validation_line) and plots
-one curve per log.  Also understands the ScalarWriter JSONL stream
-(`--jsonl`, tag val/top1) — the richer source the reference lacked.
+one curve per log.  Also understands the ScalarWriter JSONL stream — any input path ending
+in ``.jsonl`` is parsed as scalars (``--tag``, default val/top1) — the
+richer source the reference lacked.
 
 Usage:
     python examples/draw_curve.py aps.log no_aps.log -o curves.png
+    python examples/draw_curve.py ckpt/logs/scalars.jsonl -o curves.png
 """
 
 from __future__ import annotations
