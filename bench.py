@@ -210,6 +210,41 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
             partial["fast_mode_img_per_sec_per_chip"] = round(
                 results["fast"], 2)
 
+    # Budget-gated EXTRA: transformer-LM throughput (tokens/s/chip) with
+    # the same e5m2 APS pipeline — evidence for the beyond-reference
+    # long-context stack.  The reference has no LM baseline, so this is
+    # reported alongside, never as the headline metric.
+    if devices[0].platform == "tpu" and time.monotonic() < budget_end - 120:
+        try:
+            from cpd_tpu.models import transformer_lm
+            from cpd_tpu.train import make_lm_train_step
+            from cpd_tpu.train.state import TrainState
+
+            seq, lm_bs = 1024, 8
+            lm_kw = dict(vocab_size=32000, d_model=512, n_layers=8,
+                         n_heads=8, d_ff=2048)
+            lm = transformer_lm(**lm_kw, dtype=jnp.bfloat16)
+            arr = rng.randint(0, 32000,
+                              (lm_bs * n_dev, seq)).astype(np.int32)
+            toks = jnp.asarray(arr)
+            tgts = jnp.asarray(np.roll(arr, -1, axis=1))
+            variables = lm.init(jax.random.PRNGKey(2), toks[:1])
+            lm_tx = make_optimizer("sgd", schedule, momentum=0.9)
+            lm_state = TrainState(step=jnp.asarray(0, jnp.int32),
+                                  params=variables["params"],
+                                  batch_stats={},
+                                  opt_state=lm_tx.init(variables["params"]))
+            lm_step = make_lm_train_step(lm, lm_tx, mesh, use_aps=True,
+                                         grad_exp=5, grad_man=2,
+                                         donate=False)
+            tok_rate, _, _ = _measure(
+                jax, lm_step, lm_state, toks, tgts, 12, windows=3,
+                imgs_per_call=lm_bs * n_dev * seq)
+            partial["lm_train_tok_per_sec_per_chip"] = round(
+                tok_rate / n_dev, 1)
+        except Exception as e:  # noqa: BLE001 — extras must not kill the run
+            partial["lm_note"] = f"lm extra skipped: {type(e).__name__}: {e}"
+
     if profile_dir and time.monotonic() < budget_end - 30:
         state = create_train_state(model, tx, x[0, :2],
                                    jax.random.PRNGKey(0))
