@@ -4,6 +4,12 @@ This is the JAX analog of the reference's `--emulate_node` testing trick
 (reference: README.md:76-79) — multi-device semantics without hardware.
 Note the axon TPU plugin overrides the JAX_PLATFORMS env var, so we must
 also force the platform through jax.config after import.
+
+Wall time: ~200 tests in ~4 min fast tier (`-m "not slow"`) + ~6 min of
+full-model integration smokes, measured on a single vCPU (this sandbox
+exposes 1 core; XLA compile of the 8-device shard_map programs is the
+cost).  Nothing is skipped by default; CI splits the tiers
+(.github/workflows/ci.yml).
 """
 
 import os
