@@ -180,11 +180,13 @@ class CityscapesDataset:
 
 def load_segmentation(root: Optional[str] = None, split: str = "train",
                       crop_size: int = 128, num_classes: int = 19,
-                      synthetic_size: int = 256, seed: int = 0):
+                      synthetic_size: int = 256, seed: int = 0,
+                      flip: bool = True):
     """Real Cityscapes if `root` holds a leftImg8bit/gtFine tree, else the
-    synthetic stand-in (same batch() contract)."""
+    synthetic stand-in (same batch() contract).  Pass ``flip=False`` for
+    evaluation splits — mmseg's eval pipeline has no random flip."""
     if root and os.path.isdir(os.path.join(root, "leftImg8bit", split)):
         return CityscapesDataset(root, split=split, crop_size=crop_size,
-                                 num_classes=num_classes)
+                                 num_classes=num_classes, flip=flip)
     return SyntheticSegmentation(n=synthetic_size, num_classes=num_classes,
                                  crop_size=crop_size, seed=seed)

@@ -1,7 +1,8 @@
 """Training harness: state, steps, optimizers, schedules, metrics, ckpt."""
 
 from .state import TrainState, create_train_state
-from .step import (cross_entropy_loss, make_eval_step, make_train_step,
+from .step import (cross_entropy_loss, make_eval_step,
+                   make_seg_eval_step, make_train_step,
                    seg_cross_entropy_loss)
 from .optim import lars, make_optimizer, quant_sgd, sgd
 from .schedules import iter_table, piecewise_linear, warmup_step_decay
@@ -15,7 +16,7 @@ __all__ = [
     "make_moe_train_step", "make_moe_eval_step", "moe_state_specs",
     "TrainState", "create_train_state",
     "cross_entropy_loss", "seg_cross_entropy_loss", "make_eval_step",
-    "make_train_step",
+    "make_seg_eval_step", "make_train_step",
     "lars", "make_optimizer", "quant_sgd", "sgd",
     "iter_table", "piecewise_linear", "warmup_step_decay",
     "AverageMeter", "Timer", "accuracy",

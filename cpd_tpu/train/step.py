@@ -42,7 +42,8 @@ from ..parallel.emulate import emulate_node_reduce
 from .state import TrainState
 
 __all__ = ["cross_entropy_loss", "seg_cross_entropy_loss",
-           "seg_loss_with_aux", "make_train_step", "make_eval_step"]
+           "seg_loss_with_aux", "make_train_step", "make_eval_step",
+           "make_seg_eval_step"]
 
 
 def _main_logits(out):
@@ -274,6 +275,55 @@ def make_eval_step(model, mesh: Mesh, *, axis_name: str = "dp",
                     / lax.psum(n, axis_name),
             "top5": lax.psum(topk.astype(jnp.float32), axis_name)
                     / lax.psum(n, axis_name),
+        }
+
+    shard_fn = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(shard_fn)
+
+
+def make_seg_eval_step(model, mesh: Mesh, num_classes: int, *,
+                       axis_name: str = "dp", ignore_label: int = 255):
+    """Jitted segmentation eval: ``(state, images, labels) -> metrics``.
+
+    The mmseg-style periodic evaluation the reference's FCN workload
+    relies on (its mmcv runner's EvalHook; README.md:132-150).  Returns
+    per-batch sums so the caller can stream over a whole split:
+      loss_sum / n_pix  — ignored pixels excluded;
+      correct           — pixel-accuracy numerator;
+      inter / union     — per-class (num_classes,) intersection and union
+                          counts; mIoU = mean over classes with union>0
+                          after accumulating all batches (the standard
+                          Cityscapes metric over the 19 train classes).
+    """
+
+    def step_fn(state: TrainState, images, labels):
+        variables = {"params": state.params}
+        if jax.tree.leaves(state.batch_stats):
+            variables["batch_stats"] = state.batch_stats
+        logits = _main_logits(model.apply(variables, images, train=False))
+        valid = labels != ignore_label
+        safe = jnp.where(valid, labels, 0)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), safe)   # same op as the train loss
+        loss_sum = jnp.sum(ce * valid)
+        pred = jnp.argmax(logits, -1)
+        correct = jnp.sum((pred == labels) & valid)
+        cls = jnp.arange(num_classes)
+        pred_m = (pred[..., None] == cls) & valid[..., None]
+        lab_m = (safe[..., None] == cls) & valid[..., None]
+        inter = jnp.sum(pred_m & lab_m, axis=tuple(range(labels.ndim)))
+        union = jnp.sum(pred_m | lab_m, axis=tuple(range(labels.ndim)))
+        f = jnp.float32
+        return {
+            "loss_sum": lax.psum(f(loss_sum), axis_name),
+            "n_pix": lax.psum(f(jnp.sum(valid)), axis_name),
+            "correct": lax.psum(f(correct), axis_name),
+            "inter": lax.psum(inter.astype(jnp.float32), axis_name),
+            "union": lax.psum(union.astype(jnp.float32), axis_name),
         }
 
     shard_fn = jax.shard_map(

@@ -34,7 +34,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warm_up_epoch", default=5, type=int)
     p.add_argument("-b", "--batch_size", default=512, type=int)
     p.add_argument("--momentum", default=0.9, type=float)
-    p.add_argument("--workers", default=4)
+    p.add_argument("--workers", default=4,
+                   help="accepted for reference CLI parity (dawn.py:15, "
+                        "DataLoader workers); unused here — batches are "
+                        "built by the vectorized pipeline + native "
+                        "executor, no worker pool")
     p.add_argument("--half", default=0, type=int)
     p.add_argument("--lr_scale", default=1.0, type=float)
     p.add_argument("--seed", default=0, type=int)

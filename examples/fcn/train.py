@@ -89,6 +89,20 @@ def main(argv=None) -> dict:
     ds = load_segmentation(args.data_root, crop_size=args.crop_size,
                            num_classes=args.num_classes,
                            synthetic_size=args.synthetic_size)
+    # validation split: real Cityscapes val/ when present; otherwise (no
+    # val/ tree, or fully synthetic data) evaluate on the training
+    # distribution at deterministic crops — never mix real train with
+    # synthetic val
+    try:
+        val_ds = load_segmentation(args.data_root, split="val",
+                                   crop_size=args.crop_size,
+                                   num_classes=args.num_classes,
+                                   synthetic_size=args.synthetic_size,
+                                   flip=False)
+        if type(val_ds) is not type(ds):
+            val_ds = ds
+    except FileNotFoundError:
+        val_ds = ds
     global_batch = args.batch_size * n_dev * args.emulate_node
 
     # mmseg's poly schedule ~ piecewise-linear decay to lr*0.01 at max_iter
@@ -133,6 +147,39 @@ def main(argv=None) -> dict:
     # per-host RNG stream: hosts draw disjoint random crops
     rng = np.random.RandomState(rank)
     host_batch = global_batch // world
+
+    # periodic evaluation — pixel accuracy + mIoU over the val split, the
+    # mmseg EvalHook the reference's FCN workload relies on
+    from cpd_tpu.train import make_seg_eval_step
+    seg_eval = make_seg_eval_step(model, mesh,
+                                  num_classes=args.num_classes)
+
+    def validate(it: int) -> dict:
+        vrng = np.random.RandomState(1234 + rank)  # fixed eval crops
+        n_batches = max(1, min(8, len(val_ds) // max(global_batch, 1)))
+        tot = None
+        for _ in range(n_batches):
+            idx = vrng.randint(0, len(val_ds), size=host_batch)
+            x, y = val_ds.batch(idx, seed=-1)
+            m = seg_eval(state, host_batch_to_global(x, mesh),
+                         host_batch_to_global(y, mesh))
+            m = {k: np.asarray(v) for k, v in m.items()}
+            tot = m if tot is None else {k: tot[k] + m[k] for k in tot}
+        union = tot["union"]
+        present = union > 0
+        miou = float(np.mean(tot["inter"][present] / union[present])) \
+            if present.any() else 0.0
+        out = {"loss": float(tot["loss_sum"] / max(tot["n_pix"], 1)),
+               "pix_acc": float(tot["correct"] / max(tot["n_pix"], 1)),
+               "miou": miou}
+        if rank == 0:
+            print(f"Val [{it}]: loss {out['loss']:.4f} "
+                  f"pixAcc {100 * out['pix_acc']:.2f} "
+                  f"mIoU {100 * out['miou']:.2f}", flush=True)
+        writer.add_scalar("val/loss", out["loss"], it)
+        writer.add_scalar("val/pix_acc", out["pix_acc"], it)
+        writer.add_scalar("val/miou", out["miou"], it)
+        return out
     last = {}
     profiler = StepProfiler(args.profile_dir, start=3)
     # SIGTERM → save at the next step boundary and exit cleanly; resume
@@ -161,6 +208,9 @@ def main(argv=None) -> dict:
             progress.maybe_print(it, Loss=last["loss"],
                                  PixAcc=100 * last["accuracy"])
             writer.add_scalar("train/loss", last["loss"], it)
+            if it % args.val_freq == 0 or it == args.max_iter:
+                last_val = validate(it)
+                last.update({f"val_{k}": v for k, v in last_val.items()})
             if it % args.ckpt_freq == 0 or it == args.max_iter:
                 manager.save(it, state)
     finally:

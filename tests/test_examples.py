@@ -324,10 +324,13 @@ def test_fcn_trainer_smoke(tmp_path):
               "--tiny-backbone", "--aux-head", "--use_APS",
               "--grad_exp", "5", "--grad_man", "2", "--ckpt-freq", "2",
               "--save-path", str(tmp_path / "fcn"), "--mode", "faithful"]
-    res = main(common + ["--max-iter", "2"])
+    res = main(common + ["--max-iter", "2", "--val-freq", "2"])
     assert res["step"] == 2
     assert math.isfinite(res["loss"])
     assert 0.0 <= res["accuracy"] <= 1.0
+    # periodic seg evaluation ran (mmseg EvalHook parity): pixAcc + mIoU
+    assert 0.0 <= res["val_pix_acc"] <= 1.0
+    assert 0.0 <= res["val_miou"] <= 1.0
     # interval checkpoint written; auto-resume picks it up (0 iters left —
     # the continue-training path is covered by the resnet18 resume test,
     # which exercises the same CheckpointManager + replicate machinery)

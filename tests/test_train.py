@@ -183,6 +183,52 @@ def test_make_optimizer_quant_sgd():
     assert np.isfinite(np.asarray(updates["w"])).all()
 
 
+def test_seg_eval_step_matches_numpy_oracle():
+    """make_seg_eval_step's streamed sums (loss, pixel acc, per-class
+    inter/union for mIoU) vs a direct numpy computation, with ignored
+    pixels excluded — the Cityscapes metric definition."""
+    import flax.linen as nn
+
+    from cpd_tpu.train import create_train_state, make_seg_eval_step
+
+    C = 4
+
+    class TinySeg(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Conv(C, (1, 1))(x)
+
+    model = TinySeg()
+    mesh = data_parallel_mesh()
+    tx = sgd(lambda s: jnp.float32(0.1))
+    state = create_train_state(model, tx, jnp.zeros((1, 8, 8, 3)),
+                               jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, C, (8, 8, 8)).astype(np.int32)
+    y[0, :2, :] = 255                        # ignored region
+
+    ev = make_seg_eval_step(model, mesh, num_classes=C)
+    m = {k: np.asarray(v) for k, v in ev(state, jnp.asarray(x),
+                                         jnp.asarray(y)).items()}
+
+    logits = np.asarray(model.apply({"params": state.params},
+                                    jnp.asarray(x), train=False))
+    pred = logits.argmax(-1)
+    valid = y != 255
+    assert m["n_pix"] == valid.sum()
+    assert m["correct"] == ((pred == y) & valid).sum()
+    logp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                           .sum(-1, keepdims=True)) - logits.max(
+                               -1, keepdims=True)
+    want_loss = -logp[valid, y[valid]].sum()
+    np.testing.assert_allclose(m["loss_sum"], want_loss, rtol=1e-4)
+    for c in range(C):
+        pi, li = (pred == c) & valid, (y == c) & valid
+        assert m["inter"][c] == (pi & li).sum()
+        assert m["union"][c] == (pi | li).sum()
+
+
 def test_wd_mask_excludes_leaves():
     tx = sgd(lambda s: jnp.float32(1.0), momentum=0.0, weight_decay=0.1,
              wd_mask=lambda p: {"w": True, "bn": False})
