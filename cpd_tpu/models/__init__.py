@@ -13,6 +13,7 @@ from .tiny import TinyCNN, tiny_cnn
 from .transformer import TransformerLM, lm_param_specs, transformer_lm
 from .pipeline_lm import PipelinedLM, pipelined_lm, pp_param_specs
 from .moe import MoETransformerLM, moe_lm, moe_param_specs
+from .davidnet_graph import graph_davidnet
 
 _REGISTRY = {
     "res_cifar": resnet18_cifar,      # reference name (mix.py:82)
@@ -26,6 +27,7 @@ _REGISTRY = {
     "transformer_lm": transformer_lm,
     "pipelined_lm": pipelined_lm,
     "moe_lm": moe_lm,
+    "davidnet_graph": graph_davidnet,  # dict-graph definition (TorchGraph)
 }
 
 
@@ -42,4 +44,4 @@ __all__ = ["ResNetCIFAR", "resnet18_cifar", "DavidNet", "davidnet",
            "TransformerLM", "transformer_lm", "lm_param_specs",
            "PipelinedLM", "pipelined_lm", "pp_param_specs",
            "MoETransformerLM", "moe_lm", "moe_param_specs",
-           "get_model"]
+           "graph_davidnet", "get_model"]

@@ -21,7 +21,15 @@ from typing import Any, Mapping
 import flax.linen as nn
 import jax.numpy as jnp
 
-__all__ = ["DavidNet", "davidnet"]
+__all__ = ["DavidNet", "davidnet", "DEFAULT_CHANNELS", "BN_MOMENTUM",
+           "BN_EPSILON", "LOGIT_WEIGHT"]
+
+# Shared with the dict-graph definition (models/davidnet_graph.py) so the
+# two forms of the same network cannot drift apart.
+DEFAULT_CHANNELS = {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}
+BN_MOMENTUM = 0.9
+BN_EPSILON = 1e-5
+LOGIT_WEIGHT = 0.125  # davidnet.py:52 (weight=0.125)
 
 
 class ConvBN(nn.Module):
@@ -36,8 +44,8 @@ class ConvBN(nn.Module):
         x = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False,
                     dtype=self.dtype, param_dtype=self.param_dtype,
                     kernel_init=nn.initializers.kaiming_normal())(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype,
+        x = nn.BatchNorm(use_running_average=not train, momentum=BN_MOMENTUM,
+                         epsilon=BN_EPSILON, dtype=self.dtype,
                          param_dtype=self.param_dtype,
                          scale_init=nn.initializers.constant(
                              self.bn_weight_init))(x)
@@ -63,14 +71,13 @@ class DavidNet(nn.Module):
     """Input NHWC (B, 32, 32, 3); returns scaled logits (B, 10)."""
     num_classes: int = 10
     channels: Mapping[str, int] = None
-    logit_weight: float = 0.125
+    logit_weight: float = LOGIT_WEIGHT
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        ch = self.channels or {"prep": 64, "layer1": 128, "layer2": 256,
-                               "layer3": 512}
+        ch = self.channels or DEFAULT_CHANNELS
         cb = partial(ConvBN, dtype=self.dtype, param_dtype=self.param_dtype)
         pool = partial(nn.max_pool, window_shape=(2, 2), strides=(2, 2))
 

@@ -1,0 +1,138 @@
+"""DavidNet defined through the dict-graph API — reference definition parity.
+
+The reference builds DavidNet as a nested dict of nodes (reference:
+example/DavidNet/davidnet.py:19-63 — ``conv_bn`` / ``residual`` /
+``basic_net`` / ``net``) plus a losses dict (davidnet.py:66-69), executed
+by TorchGraph.  `cpd_tpu.models.davidnet.DavidNet` is the idiomatic-Flax
+form of the same network; this module reproduces the *definition style*
+itself on top of :mod:`cpd_tpu.utils.graph`, so users porting reference
+code that composes nets as dicts (extra_layers, res_layers, custom heads)
+keep that workflow.
+
+Architecture identity with ``DavidNet`` is asserted in
+tests/test_graph.py (same param count, same logit shape, trains under the
+standard harness via ``GraphClassifier``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Mapping
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..utils.graph import (Add, Correct, CrossEntropySum, Flatten,
+                           GraphClassifier, GraphModule, Identity, Mul,
+                           rel_path, union)
+from .davidnet import (BN_EPSILON, BN_MOMENTUM, DEFAULT_CHANNELS,
+                       LOGIT_WEIGHT)
+
+__all__ = ["conv_bn", "residual", "basic_net", "davidnet_net",
+           "davidnet_losses", "graph_davidnet"]
+
+
+class _GraphBatchNorm(nn.Module):
+    """BN node taking the executor's ``train`` flag (batch_norm,
+    reference utils.py:214-226: weight init + momentum/eps defaults)."""
+
+    bn_weight_init: float = 1.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return nn.BatchNorm(
+            use_running_average=not train, momentum=BN_MOMENTUM,
+            epsilon=BN_EPSILON, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            scale_init=nn.initializers.constant(self.bn_weight_init))(x)
+
+
+def conv_bn(c_out: int, bn_weight_init: float = 1.0,
+            dtype=jnp.float32, param_dtype=jnp.float32) -> dict:
+    """conv3x3(no bias) -> bn -> relu as three graph nodes
+    (davidnet.py:19-24)."""
+    return {
+        "conv": nn.Conv(c_out, (3, 3), padding=1, use_bias=False,
+                        dtype=dtype, param_dtype=param_dtype,
+                        kernel_init=nn.initializers.kaiming_normal()),
+        "bn": _GraphBatchNorm(bn_weight_init=bn_weight_init, dtype=dtype,
+                              param_dtype=param_dtype),
+        "relu": nn.relu,
+    }
+
+
+def residual(c: int, **kw) -> dict:
+    """identity + two conv_bn blocks + add (davidnet.py:27-33)."""
+    return {
+        "in": Identity(),
+        "res1": conv_bn(c, **kw),
+        "res2": conv_bn(c, **kw),
+        "add": (Add(), [rel_path("in"), rel_path("res2", "relu")]),
+    }
+
+
+def basic_net(channels: Mapping[str, int], weight: float, pool,
+              **kw) -> dict:
+    """Prep + three pooled stages + classifier head (davidnet.py:36-48)."""
+    return {
+        "prep": conv_bn(channels["prep"], **kw),
+        "layer1": dict(conv_bn(channels["layer1"], **kw), pool=pool),
+        "layer2": dict(conv_bn(channels["layer2"], **kw), pool=pool),
+        "layer3": dict(conv_bn(channels["layer3"], **kw), pool=pool),
+        "classifier": {
+            "pool": partial(nn.max_pool, window_shape=(4, 4),
+                            strides=(4, 4)),
+            "flatten": Flatten(),
+            # fp32 head regardless of compute dtype — DavidNet parity
+            # (davidnet.py: Dense dtype=fp32 + final fp32 cast), so bf16
+            # graph models still emit fp32 logits for the loss.
+            "linear": nn.Dense(10, use_bias=False, dtype=jnp.float32,
+                               param_dtype=kw.get("param_dtype",
+                                                  jnp.float32)),
+            "logits": Mul(weight),
+        },
+    }
+
+
+def davidnet_net(channels: Mapping[str, int] | None = None,
+                 weight: float = LOGIT_WEIGHT, pool=None, extra_layers=(),
+                 res_layers=("layer1", "layer3"), **kw) -> dict:
+    """The full DavidNet nested dict (davidnet.py:51-63): residual blocks
+    on layer1/layer3, optional extra conv_bn blocks per stage."""
+    channels = channels or DEFAULT_CHANNELS
+    pool = pool or partial(nn.max_pool, window_shape=(2, 2), strides=(2, 2))
+    n = basic_net(channels, weight, pool, **kw)
+    for layer in res_layers:
+        n[layer]["residual"] = residual(channels[layer], **kw)
+    for layer in extra_layers:
+        n[layer]["extra"] = conv_bn(channels[layer], **kw)
+    return n
+
+
+def davidnet_losses() -> dict:
+    """Loss/metric nodes living in the graph (davidnet.py:66-69)."""
+    return {
+        "loss": (CrossEntropySum(),
+                 [("classifier", "logits"), ("target",)]),
+        "correct": (Correct(), [("classifier", "logits"), ("target",)]),
+    }
+
+
+def graph_davidnet(with_losses: bool = False, dtype=jnp.float32,
+                   **net_kw) -> nn.Module:
+    """DavidNet built from the dict-graph definition.
+
+    with_losses=False returns a ``GraphClassifier`` (logits out — drops
+    into ``make_train_step`` like any zoo model); with_losses=True returns
+    the raw ``GraphModule`` whose call yields the full cache including
+    ``loss``/``correct`` nodes, the reference's TorchGraph usage shape.
+    """
+    def build():
+        net = davidnet_net(dtype=dtype, **net_kw)
+        return union(net, davidnet_losses()) if with_losses else net
+
+    if with_losses:
+        return GraphModule(build)
+    return GraphClassifier(build, output="classifier_logits")

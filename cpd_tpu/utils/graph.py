@@ -1,0 +1,230 @@
+"""Dict-dataflow graph model definition — the reference's TorchGraph API.
+
+The reference's DavidNet is *defined* as a nested-dict dataflow graph and
+executed topologically by ``TorchGraph`` (reference:
+example/DavidNet/utils.py:231-292 — ``union`` / ``path_iter`` /
+``build_graph`` / ``TorchGraph``; example/DavidNet/davidnet.py:19-69 builds
+the net that way).  This module provides the same model-definition surface
+on the TPU stack:
+
+* leaves of the nested dict are **Flax modules or plain callables**;
+* :func:`build_graph` flattens paths with ``'_'`` and resolves default /
+  relative / absolute input references exactly as the reference does
+  (utils.py:251-257);
+* :class:`GraphModule` executes the flattened graph inside one linen scope,
+  so parameters and BatchNorm state are handled normally and XLA fuses
+  across node boundaries — the graph is a *definition* convenience, not a
+  runtime interpreter (everything still traces into a single jitted
+  program, which is why this costs nothing on TPU).
+
+Reference-semantics notes:
+* a leaf is either ``node`` or ``(node, [input_refs])``; a node without
+  explicit inputs consumes the previous node's output in flattened order,
+  and the first node consumes ``'input'`` (utils.py:252).
+* an input ref is a str (top-level name), a tuple path, or
+  :func:`rel_path` parts resolved against the node's enclosing prefix
+  (utils.py:255-256).
+* execution returns the full activation cache — ``TorchGraph.forward``
+  returns ``self.cache`` (utils.py:287-292) — so loss/metric nodes can
+  live in the graph (davidnet.py:66-69).
+* nodes whose call signature has a ``train`` parameter receive the
+  executor's ``train`` flag (the linen analog of torch's module mode).
+"""
+
+from __future__ import annotations
+
+import inspect
+from collections.abc import Mapping
+from typing import Any, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SEP", "RelPath", "rel_path", "union", "path_iter",
+           "build_graph", "GraphModule", "GraphClassifier", "Identity",
+           "Mul", "Flatten", "Add", "Concat", "Correct",
+           "CrossEntropySum"]
+
+SEP = "_"
+
+
+class RelPath(NamedTuple):
+    """Input reference relative to the referencing node's dict prefix."""
+    parts: tuple
+
+
+def rel_path(*parts: str) -> RelPath:
+    return RelPath(tuple(parts))
+
+
+def union(*dicts: dict) -> dict:
+    """Merge dicts left-to-right (utils.py:235)."""
+    return {k: v for d in dicts for (k, v) in d.items()}
+
+
+def path_iter(nested: Mapping, pfx: tuple = ()):
+    """Yield ((path parts), leaf) for every non-mapping leaf, depth-first.
+
+    Mapping, not dict: linen freezes dict fields into FrozenDict, and a
+    net stored on a GraphModule must still flatten correctly.
+    """
+    for name, val in nested.items():
+        if isinstance(val, Mapping):
+            yield from path_iter(val, (*pfx, name))
+        else:
+            yield (*pfx, name), val
+
+
+def _resolve(ref, pfx: tuple) -> str:
+    if isinstance(ref, RelPath):
+        return SEP.join((*pfx, *ref.parts))
+    if isinstance(ref, str):
+        return ref
+    return SEP.join(ref)
+
+
+def build_graph(net: dict) -> dict:
+    """Flatten a nested net dict into ``{name: (node, [input names])}``.
+
+    Default-input chaining and reference resolution follow
+    utils.py:251-257: node *i* defaults to node *i-1*'s name ("input" for
+    the first), explicit refs resolve via :func:`_resolve`.
+    """
+    graph = {}
+    prev = "input"
+    for path, leaf in path_iter(net):
+        name, pfx = SEP.join(path), path[:-1]
+        if isinstance(leaf, tuple):
+            node, refs = leaf
+            inputs = [_resolve(r, pfx) for r in refs]
+        else:
+            node, inputs = leaf, [prev]
+        if name in graph:
+            # '_'-flattening can alias distinct paths (e.g. {"a":{"b":...}}
+            # vs {"a_b":...}); last-write-wins would silently train a
+            # different architecture, so fail loudly instead.
+            raise ValueError(f"duplicate flattened node name {name!r}")
+        graph[name] = (node, inputs)
+        prev = name
+    return graph
+
+
+def _accepts_train(node) -> bool:
+    fn = node.__call__ if isinstance(node, nn.Module) else node
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return "train" in sig.parameters
+
+
+# ---------------------------------------------------------------------------
+# Stateless node helpers (utils.py:184-207 equivalents; plain callables, so
+# the executor stores no parameters for them).
+# ---------------------------------------------------------------------------
+
+class Identity:
+    def __call__(self, x):
+        return x
+
+
+class Mul:
+    def __init__(self, weight: float):
+        self.weight = weight
+
+    def __call__(self, x):
+        return x * self.weight
+
+
+class Flatten:
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+class Add:
+    def __call__(self, x, y):
+        return x + y
+
+
+class Concat:
+    """Channel concat — NHWC axis -1 (the reference cats NCHW dim 1)."""
+
+    def __call__(self, *xs):
+        return jnp.concatenate(xs, axis=-1)
+
+
+class Correct:
+    def __call__(self, classifier, target):
+        return jnp.argmax(classifier, axis=-1) == target
+
+
+class CrossEntropySum:
+    """CE summed over the batch — ``CrossEntropyLoss(size_average=False)``
+    of the reference losses dict (davidnet.py:66-69)."""
+
+    def __call__(self, logits, target):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        picked = jnp.take_along_axis(logp, target[:, None], axis=-1)
+        return -jnp.sum(picked)
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+class GraphModule(nn.Module):
+    """Execute a dict-defined dataflow graph (TorchGraph parity).
+
+    ``net`` is either the nested dict itself or a zero-arg builder
+    returning it.  Prefer the builder form: module leaves are then
+    constructed inside this module's ``setup`` and adopted exactly once,
+    which keeps linen's submodule-ownership rules trivially satisfied and
+    makes the instance reusable.
+
+    ``__call__`` takes the input cache (``{"input": images, "target":
+    labels, ...}`` or a bare array, which becomes ``"input"``) and returns
+    the full cache of every node's output, keyed by flattened node name.
+    """
+
+    net: Any
+
+    def setup(self):
+        net = self.net if isinstance(self.net, Mapping) else self.net()
+        graph = build_graph(net)
+        # Assigning the dict registers each Module leaf as a named child
+        # ("nodes_<flatname>"); plain-callable leaves are stored untouched.
+        self.nodes = {name: node for name, (node, _) in graph.items()}
+        self.wiring = tuple((name, tuple(ins), _accepts_train(node))
+                            for name, (node, ins) in graph.items())
+
+    def __call__(self, inputs, train: bool = True) -> dict:
+        cache = dict(inputs) if isinstance(inputs, Mapping) else {
+            "input": inputs}
+        for name, input_names, wants_train in self.wiring:
+            node = self.nodes[name]
+            args = [cache[x] for x in input_names]
+            if wants_train:
+                cache[name] = node(*args, train=train)
+            else:
+                cache[name] = node(*args)
+        return cache
+
+
+class GraphClassifier(nn.Module):
+    """Adapter: run a graph, return one node's output.
+
+    Lets a graph-defined model plug into the standard train-step builders
+    (``make_train_step`` expects ``model(x, train) -> logits``) — the graph
+    definition style composes with the whole harness, the way the
+    reference's TorchGraph feeds its generic train loop (utils.py:328-344).
+    """
+
+    net: Any
+    output: str = "classifier_logits"
+
+    def setup(self):
+        self.graph = GraphModule(self.net)
+
+    def __call__(self, x, train: bool = True):
+        return self.graph({"input": x}, train=train)[self.output]

@@ -1,0 +1,169 @@
+"""Dict-graph executor: build_graph resolution + DavidNet-as-graph parity.
+
+Covers the TorchGraph API surface (reference example/DavidNet/utils.py:
+231-292, davidnet.py:19-69): flattening, default-input chaining, relative/
+absolute refs, cache-returning execution, loss nodes in the graph, and the
+GraphClassifier adapter feeding the standard train step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpd_tpu.models.davidnet import DavidNet
+from cpd_tpu.models.davidnet_graph import (davidnet_losses, davidnet_net,
+                                           graph_davidnet)
+from cpd_tpu.utils.graph import (Add, GraphModule, Identity, Mul,
+                                 build_graph, path_iter, rel_path, union)
+
+
+def _n_params(tree):
+    return sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_build_graph_resolution():
+    # default chaining, str ref, tuple path, rel_path — all four ref kinds
+    net = {
+        "a": {"x": Identity(), "y": Identity()},     # a_x <- input, a_y <- a_x
+        "b": (Add(), ["a_y", ("a", "x")]),           # str + tuple path
+        "c": {"in": Identity(),                      # c_in <- b (default)
+              "out": (Add(), [rel_path("in"), "b"])},
+    }
+    g = build_graph(net)
+    assert list(g) == ["a_x", "a_y", "b", "c_in", "c_out"]
+    assert g["a_x"][1] == ["input"]
+    assert g["a_y"][1] == ["a_x"]
+    assert g["b"][1] == ["a_y", "a_x"]
+    assert g["c_in"][1] == ["b"]
+    assert g["c_out"][1] == ["c_in", "b"]
+
+
+def test_graph_module_executes_and_caches():
+    net = {
+        "double": Mul(2.0),
+        "res": {"in": Identity(),
+                "add": (Add(), [rel_path("in"), "double"])},
+    }
+    m = GraphModule(net)
+    x = jnp.arange(4.0)
+    cache = m.apply({}, {"input": x})
+    # full activation cache, TorchGraph.forward parity
+    assert set(cache) == {"input", "double", "res_in", "res_add"}
+    np.testing.assert_allclose(cache["res_add"], 4.0 * x)
+    # bare-array input becomes "input"
+    cache2 = m.apply({}, x)
+    np.testing.assert_allclose(cache2["res_add"], cache["res_add"])
+
+
+def test_union_path_iter():
+    merged = union({"a": 1}, {"b": 2}, {"a": 3})
+    assert merged == {"a": 3, "b": 2}
+    flat = dict(path_iter({"p": {"q": 1}, "r": 2}))
+    assert flat == {("p", "q"): 1, ("r",): 2}
+
+
+@pytest.fixture(scope="module")
+def graph_model_and_vars():
+    model = graph_davidnet()
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    return model, variables
+
+
+def test_graph_davidnet_matches_flax_architecture(graph_model_and_vars):
+    """Forward parity with copied params: the two definition styles are the
+    SAME network, not merely equally-sized ones (guards hyperparameter
+    drift between davidnet.py and davidnet_graph.py)."""
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    model, variables = graph_model_and_vars
+    ref = DavidNet()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                    jnp.float32)
+    ref_vars = ref.init(jax.random.PRNGKey(0), x, train=False)
+
+    # Both trees flatten depth-first in definition order and correspond
+    # 1:1 (prep conv/bn, layer1 conv/bn, layer1 residual, ..., linear).
+    copied = {}
+    for col in ("params", "batch_stats"):
+        g_flat = flatten_dict(variables[col])
+        r_flat = flatten_dict(ref_vars[col])
+        assert len(g_flat) == len(r_flat)
+        out = {}
+        for (g_key, g_val), (r_key, r_val) in zip(g_flat.items(),
+                                                  r_flat.items()):
+            assert g_val.shape == r_val.shape, (g_key, r_key)
+            out[g_key] = r_val
+        copied[col] = unflatten_dict(out)
+
+    logits = model.apply(copied, x, train=False)
+    ref_logits = ref.apply(ref_vars, x, train=False)
+    assert logits.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_graph_davidnet_bf16_head_stays_fp32():
+    """bf16 compute must still emit fp32 logits (DavidNet head parity)."""
+    model = graph_davidnet(channels={"prep": 4, "layer1": 8, "layer2": 8,
+                                     "layer3": 8}, dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 32, 32, 3), jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.dtype == jnp.float32
+
+
+def test_graph_losses_in_cache():
+    model = graph_davidnet(with_losses=True)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    y = jnp.array([1, 3], jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           {"input": x, "target": y}, train=False)
+    cache = model.apply(variables, {"input": x, "target": y}, train=False)
+    assert cache["loss"].shape == ()
+    assert cache["correct"].shape == (2,)
+    # CE-sum parity: -sum log_softmax picked
+    logits = cache["classifier_logits"]
+    logp = jax.nn.log_softmax(logits)
+    expect = -(logp[0, 1] + logp[1, 3])
+    np.testing.assert_allclose(cache["loss"], expect, rtol=1e-6)
+
+
+def test_extra_layers_and_res_layers_compose():
+    # the definition-composition workflow the dict API exists for
+    # (davidnet.py:51-63: extra_layers / res_layers knobs)
+    net = davidnet_net(channels={"prep": 4, "layer1": 8, "layer2": 8,
+                                 "layer3": 8},
+                       extra_layers=("layer2",), res_layers=("layer1",))
+    g = build_graph(union(net, davidnet_losses()))
+    assert "layer2_extra_conv" in g and "layer1_residual_add" in g
+    assert "layer3_residual_add" not in g
+    m = GraphModule(net)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    cache = m.apply(variables, x, train=False)
+    assert cache["classifier_logits"].shape == (2, 10)
+
+
+@pytest.mark.slow
+def test_graph_classifier_trains_under_harness():
+    """GraphClassifier drops into the standard quantized train step."""
+    from cpd_tpu.parallel.mesh import make_mesh
+    from cpd_tpu.train import (create_train_state, make_optimizer,
+                               make_train_step, warmup_step_decay)
+
+    model = graph_davidnet(channels={"prep": 4, "layer1": 8, "layer2": 8,
+                                     "layer3": 8})
+    mesh = make_mesh(dp=len(jax.devices()))
+    tx = make_optimizer("sgd", warmup_step_decay(0.05, 5, [100]),
+                        momentum=0.9)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 32, 32, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, 16).astype(np.int32))
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, use_aps=True, grad_exp=5,
+                           grad_man=2, donate=False)
+    state, metrics = step(state, x, y)
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
