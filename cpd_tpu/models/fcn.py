@@ -59,7 +59,9 @@ class FCN(nn.Module):
     num_classes: int = 19  # Cityscapes
     aux_head: bool = False
     stage_sizes: tuple = (3, 4, 6, 3)   # R50; smaller for smoke tests
+    widths: tuple = (64, 128, 256, 512)  # backbone widths; ditto
     head_channels: int = 512
+    aux_channels: int = 256  # mmseg fcn_r50-d8 aux head width
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -67,6 +69,7 @@ class FCN(nn.Module):
     def __call__(self, x, train: bool = True):
         h, w = x.shape[1], x.shape[2]
         backbone = ResNet(stage_sizes=self.stage_sizes, block=Bottleneck,
+                          widths=self.widths,
                           output_stride=8, feature_stages=(3, 4),
                           dtype=self.dtype, param_dtype=self.param_dtype,
                           name="backbone")
@@ -82,8 +85,9 @@ class FCN(nn.Module):
                                          self.num_classes), "bilinear")
         if not self.aux_head:
             return logits
-        aux = FCNHead(self.num_classes, channels=256, num_convs=1,
-                      dtype=self.dtype, param_dtype=self.param_dtype,
+        aux = FCNHead(self.num_classes, channels=self.aux_channels,
+                      num_convs=1, dtype=self.dtype,
+                      param_dtype=self.param_dtype,
                       name="aux_head")(feats3, train=train)
         aux = jax.image.resize(
             aux.astype(jnp.float32), (aux.shape[0], h, w, self.num_classes),

@@ -108,6 +108,7 @@ class ResNet(nn.Module):
     """
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     block: Any = Bottleneck
+    widths: Sequence[int] = (64, 128, 256, 512)  # per-stage block width
     num_classes: int = 1000
     output_stride: int = 32
     features_only: bool = False
@@ -132,7 +133,7 @@ class ResNet(nn.Module):
 
         stride_so_far = 4
         dilation = 1
-        widths = (64, 128, 256, 512)
+        widths = self.widths
         stage_feats = {}
         for stage, blocks in enumerate(self.stage_sizes):
             want_stride = 1 if stage == 0 else 2

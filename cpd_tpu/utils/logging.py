@@ -65,15 +65,41 @@ class ScalarWriter:
     """Append-only JSONL scalar stream: one ``{"tag","step","value","ts"}``
     object per line.  Replaces the reference's tensorboardX SummaryWriter
     (mix.py:168-171) without the dependency; `rank`-gated like the
-    reference's ``if rank == 0`` guards."""
+    reference's ``if rank == 0`` guards.
+
+    ``tensorboard=True`` additionally mirrors every scalar into TensorBoard
+    event files in the same directory (the reference's actual logging
+    backend, mix.py:16,168-171), using ``torch.utils.tensorboard`` or
+    ``tensorboardX`` — whichever imports.  If neither does, the writer
+    degrades to JSONL-only with a one-line warning, mirroring the
+    reference's graceful CPU-only contract (quant_function.py:18-19)."""
 
     def __init__(self, log_dir: str, rank: int = 0,
-                 filename: str = "scalars.jsonl"):
+                 filename: str = "scalars.jsonl",
+                 tensorboard: bool = False):
         self.rank = rank
         self._fh: Optional[IO] = None
+        self._tb = None
         if rank == 0:
             os.makedirs(log_dir, exist_ok=True)
             self._fh = open(os.path.join(log_dir, filename), "a")
+            if tensorboard:
+                self._tb = self._open_tb(log_dir)
+
+    @staticmethod
+    def _open_tb(log_dir: str):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError:
+            try:
+                from tensorboardX import SummaryWriter
+            except ImportError:
+                import sys
+
+                print("ScalarWriter: tensorboard not importable; "
+                      "JSONL-only", file=sys.stderr)
+                return None
+        return SummaryWriter(log_dir)
 
     def add_scalar(self, tag: str, value: float, step: int):
         if self._fh is None:
@@ -82,11 +108,16 @@ class ScalarWriter:
                                    "value": float(value),
                                    "ts": time.time()}) + "\n")
         self._fh.flush()
+        if self._tb is not None:
+            self._tb.add_scalar(tag, float(value), int(step))
 
     def close(self):
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
     def __enter__(self):
         return self

@@ -8,6 +8,11 @@ Reference semantics kept: PiecewiseLinear LR 0 -> 0.4*lr_scale at epoch 5
 (dawn.py:66), `--half` as bf16 compute (TPU's half precision — the MXU
 dtype), `--loss_scale` multiplied into the loss and never unscaled
 (utils.py:332-334), TSV/Table loggers (dawn.py:37-47, utils.py:44-56).
+
+`--arch davidnet_graph` trains the dict-graph-defined form of the network
+(models/davidnet_graph.py — the reference's TorchGraph definition style,
+utils.py:258-292); forward-parity with `--arch davidnet` is pinned by
+tests/test_graph.py.
 """
 
 from __future__ import annotations

@@ -70,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override total iterations (smoke tests)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also write TensorBoard event files next to the "
+                        "JSONL scalars (reference mix.py:16,168-171)")
     p.add_argument("--mode", default="faithful",
                    choices=["faithful", "fast"],
                    help="faithful: bit-ordered quantized reduction; "
@@ -212,7 +215,8 @@ def main(argv=None) -> dict:
     sampler = DistributedGivenIterationSampler(
         dataset_len, total_iter, host_batch, world_size=world, rank=rank,
         seed=0, last_iter=start_iter - 1)
-    writer = ScalarWriter(os.path.join(ckpt_dir, "logs"), rank=rank)
+    writer = ScalarWriter(os.path.join(ckpt_dir, "logs"), rank=rank,
+                          tensorboard=args.tensorboard)
     progress = ProgressPrinter(total_iter, args.print_freq, rank=rank)
     best_prec1 = 0.0
     last = {"loss": float("nan"), "accuracy": 0.0}

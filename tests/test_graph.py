@@ -63,9 +63,14 @@ def test_union_path_iter():
     assert flat == {("p", "q"): 1, ("r",): 2}
 
 
+# Narrow channels: the copied-params parity property is width-independent,
+# and full-width DavidNet costs ~13s of XLA compile on the CPU mesh.
+_PARITY_CH = {"prep": 8, "layer1": 16, "layer2": 16, "layer3": 16}
+
+
 @pytest.fixture(scope="module")
 def graph_model_and_vars():
-    model = graph_davidnet()
+    model = graph_davidnet(channels=_PARITY_CH)
     x = jnp.zeros((2, 32, 32, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     return model, variables
@@ -78,7 +83,7 @@ def test_graph_davidnet_matches_flax_architecture(graph_model_and_vars):
     from flax.traverse_util import flatten_dict, unflatten_dict
 
     model, variables = graph_model_and_vars
-    ref = DavidNet()
+    ref = DavidNet(channels=_PARITY_CH)
     x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
                     jnp.float32)
     ref_vars = ref.init(jax.random.PRNGKey(0), x, train=False)

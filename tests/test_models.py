@@ -69,8 +69,29 @@ def test_resnet50_shapes_and_params():
     assert 25_400_000 < n < 25_700_000, n
 
 
+def test_fcn_r50_d8_default_config_shapes():
+    """mmseg fcn_r50-d8 parity of the DEFAULT config via eval_shape (no
+    compile): R50 stage sizes, 2048-ch stage-4 into a 512-ch decode head,
+    1024-ch stage-3 into a 256-ch aux head."""
+    model = fcn_r50_d8(num_classes=19, aux_head=True)
+    x = jax.ShapeDtypeStruct((1, 65, 65, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda inp: model.init(jax.random.PRNGKey(0), inp, train=False), x)
+    p = variables["params"]
+    assert p["decode_head"]["conv0"]["kernel"].shape == (3, 3, 2048, 512)
+    assert p["aux_head"]["conv0"]["kernel"].shape == (3, 3, 1024, 256)
+    assert p["backbone"]["layer4_block2"]["conv3"]["kernel"].shape[-1] \
+        == 2048
+    assert "layer3_block5" in p["backbone"]   # (3, 4, 6, 3) stage sizes
+    assert "layer4_block2" in p["backbone"]
+
+
 def test_fcn_r50_d8_output_stride_and_head():
-    model = fcn_r50_d8(num_classes=19)
+    # narrow widths: the stride-8 dilation layout and head plumbing are
+    # width-independent; full widths cost ~7s of CPU compile (the default
+    # config's shapes are pinned by the eval_shape test above)
+    model = fcn_r50_d8(num_classes=19, stage_sizes=(1, 1, 1, 1),
+                       widths=(8, 8, 8, 8), head_channels=16)
     x = jnp.zeros((1, 65, 65, 3))
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     out = model.apply(variables, x, train=False)
@@ -82,7 +103,8 @@ def test_fcn_aux_head_taps_stage3():
     stage-3 (and NOT stage-4) backbone params — mmseg fcn_r50-d8 attaches
     aux to layer3 (VERDICT.md round-1 weak-item 4)."""
     model = fcn_r50_d8(num_classes=5, aux_head=True,
-                       stage_sizes=(1, 1, 1, 1), head_channels=16)
+                       stage_sizes=(1, 1, 1, 1), widths=(8, 8, 8, 8),
+                       head_channels=16, aux_channels=8)
     x = jnp.linspace(0, 1, 1 * 17 * 17 * 3).reshape(1, 17, 17, 3)
     variables = model.init(jax.random.PRNGKey(0), x, train=False)
     main, aux = model.apply(variables, x, train=False)

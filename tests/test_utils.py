@@ -74,6 +74,27 @@ def test_scalar_writer_jsonl_roundtrip(tmp_path):
     assert not (tmp_path / "nope").exists()
 
 
+def test_scalar_writer_tensorboard_events(tmp_path):
+    """tensorboard=True mirrors scalars into event files (mix.py:168-171).
+
+    Skips only if no tensorboard backend is importable — this image ships
+    one with torch."""
+    from cpd_tpu.utils import ScalarWriter
+
+    import pytest
+    probe = ScalarWriter._open_tb(str(tmp_path / "probe"))
+    if probe is None:
+        pytest.skip("no tensorboard backend")
+    probe.close()
+
+    with ScalarWriter(str(tmp_path), rank=0, tensorboard=True) as w:
+        w.add_scalar("train/loss", 1.5, 1)
+    events = [p for p in tmp_path.iterdir()
+              if p.name.startswith("events.out.tfevents")]
+    assert events, "no TensorBoard event file written"
+    assert (tmp_path / "scalars.jsonl").exists()  # JSONL still primary
+
+
 def test_validation_line_matches_draw_curve_grep():
     from cpd_tpu.utils import format_validation_line
 
