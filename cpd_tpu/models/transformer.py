@@ -7,8 +7,9 @@ unchanged in two regimes —
 * single device (``tp_axis=None, sp_axis=None``): plain local attention;
 * inside ``shard_map`` over a ("dp","sp","tp") mesh: Megatron-style tensor
   parallelism (qkv/wi column-sharded, wo row-sharded, one `psum` over tp
-  per projection pair) and Ring-Attention sequence parallelism (K/V rotate
-  over the sp axis, ops/attention.py).
+  per projection pair) and sequence parallelism over the sp axis —
+  ``sp_mode="ring"`` (K/V rotate via ppermute) or ``"ulysses"``
+  (all_to_all heads<->sequence); both in ops/attention.py.
 
 TPU-first choices: RoPE positions are computed from the sp rank's global
 offset (no position-embedding table to shard); all Dense layers are
@@ -31,7 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import local_attention, ring_attention
+from ..ops.attention import (local_attention, ring_attention,
+                             ulysses_attention)
 
 __all__ = ["TransformerLM", "transformer_lm", "lm_param_specs"]
 
@@ -60,6 +62,8 @@ class Block(nn.Module):
                         # applied inside shard_map (flax validates declared
                         # vs stored shapes, so features must be local)
     dtype: Any
+    sp_mode: str = "ring"   # "ring" (ppermute K/V) | "ulysses" (all_to_all
+                            # heads<->sequence; local heads % sp size == 0)
     mlp: Optional[Any] = None   # factory () -> nn.Module replacing the
                                 # dense pair (e.g. MoE experts); a custom
                                 # mlp owns its own collectives — Block's tp
@@ -82,7 +86,12 @@ class Block(nn.Module):
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         q = _rope(q, positions)
         k = _rope(k, positions)
-        if self.sp_axis:
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sp_mode {self.sp_mode!r}; "
+                             "expected 'ring' or 'ulysses'")
+        if self.sp_axis and self.sp_mode == "ulysses":
+            attn = ulysses_attention(q, k, v, self.sp_axis, causal=True)
+        elif self.sp_axis:
             attn = ring_attention(q, k, v, self.sp_axis, causal=True)
         else:
             attn = local_attention(q, k, v, causal=True)
@@ -114,6 +123,7 @@ class TransformerLM(nn.Module):
     tp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
     tp_size: int = 1
+    sp_mode: str = "ring"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -135,7 +145,7 @@ class TransformerLM(nn.Module):
             x = Block(head_dim=head_dim, d_ff=self.d_ff,
                       d_model=self.d_model, tp_axis=self.tp_axis,
                       sp_axis=self.sp_axis, tp_size=self.tp_size,
-                      dtype=self.dtype,
+                      dtype=self.dtype, sp_mode=self.sp_mode,
                       name=f"block{i}")(x, positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = emb.attend(x.astype(self.param_dtype))  # tied head

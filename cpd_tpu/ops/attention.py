@@ -13,6 +13,14 @@ its workloads are CNNs), so this is new capability, built TPU-first:
   W-1 ppermutes of the local K/V — the ICI-friendly pattern of Ring
   Attention (Liu et al.; see PAPERS.md) — and peak memory is O(T_local^2)
   per device instead of O(T^2).
+* `ulysses_attention` — the all-to-all alternative (DeepSpeed-Ulysses
+  pattern; see PAPERS.md): one all_to_all turns sequence sharding into
+  head sharding, each device runs *full-sequence* attention on H/W heads,
+  a second all_to_all restores sequence sharding.  Two collectives total
+  (vs W-1 permute rounds), at the price of requiring heads % W == 0 and
+  O((T_global)^2) score memory per device — the right trade when W is
+  modest and heads are plentiful; composable with `impl="flash"` to drop
+  the score-matrix memory.
 
 Causality with a sharded sequence: rank r holds tokens
 [r*T_local, (r+1)*T_local); at ring step s it receives the K/V block of
@@ -28,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["local_attention", "ring_attention"]
+__all__ = ["local_attention", "ring_attention", "ulysses_attention"]
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
                   # when a full row is masked (the all-masked ring step)
@@ -140,3 +148,32 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         jnp.arange(axis_size))
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = True,
+                      impl: str = "xla") -> jnp.ndarray:
+    """All-to-all sequence-parallel attention; call inside shard_map with
+    the sequence dim sharded over `axis_name`.
+
+    q, k, v: (B, T_local, H, D) local shards with H the device-local head
+    count (after any tensor-parallel split); H must be divisible by the
+    `axis_name` mesh size (all_to_all enforces this).  Returns
+    (B, T_local, H, D).  Differentiable: all_to_all transposes to the
+    reverse all_to_all.
+
+    ``impl`` is forwarded to `local_attention` for the full-sequence
+    middle step ("flash" = Pallas kernel on the gathered sequence).
+    """
+    def seq_to_heads(x):
+        # (B, T_local, H, D) -> (B, T_global, H/W, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = local_attention(seq_to_heads(q), seq_to_heads(k),
+                          seq_to_heads(v), causal=causal, impl=impl)
+    return heads_to_seq(out)

@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-size", default=256, type=int)
     p.add_argument("--tiny-backbone", action="store_true",
                    help="1-block-per-stage backbone (smoke tests)")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also write TensorBoard event files next to the "
+                        "JSONL scalars (reference mix.py:16,168-171)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
     p.add_argument("--aux-head", action="store_true",
@@ -142,7 +145,8 @@ def main(argv=None) -> dict:
                  else seg_cross_entropy_loss(ignore_label=255)),
         ignore_label=255, rng_keys=("dropout",))
 
-    writer = ScalarWriter(os.path.join(args.save_path, "logs"), rank=rank)
+    writer = ScalarWriter(os.path.join(args.save_path, "logs"), rank=rank,
+                          tensorboard=args.tensorboard)
     progress = ProgressPrinter(args.max_iter, args.print_freq, rank=rank)
     # per-host RNG stream: hosts draw disjoint random crops
     rng = np.random.RandomState(rank)

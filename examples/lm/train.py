@@ -32,6 +32,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dp", default=0, type=int,
                    help="data-parallel size (0 = all remaining devices)")
     p.add_argument("--sp", default=1, type=int, help="sequence-parallel")
+    p.add_argument("--sp-mode", default="ring",
+                   choices=["ring", "ulysses"],
+                   help="sequence-parallel attention: ring (ppermute K/V) "
+                        "or ulysses (all_to_all heads<->sequence; needs "
+                        "local heads divisible by --sp)")
     p.add_argument("--tp", default=1, type=int, help="tensor-parallel")
     p.add_argument("--pp", default=1, type=int,
                    help="pipeline-parallel (GPipe; excludes sp/tp/moe)")
@@ -64,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--emulate_node", default=1, type=int)
     p.add_argument("--mode", default="faithful", choices=["faithful", "fast"])
     p.add_argument("--dist", action="store_true")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also write TensorBoard event files next to the "
+                        "JSONL scalars (reference mix.py:16,168-171)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
     return p
@@ -161,7 +169,8 @@ def main(argv=None) -> dict:
         from cpd_tpu.train.lm import lm_state_specs
         model = transformer_lm(tp_axis="tp" if args.tp > 1 else None,
                                sp_axis="sp" if args.sp > 1 else None,
-                               tp_size=args.tp, **model_kw)
+                               tp_size=args.tp, sp_mode=args.sp_mode,
+                               **model_kw)
         init_model = transformer_lm(**model_kw)
         state = create_train_state(init_model, tx, sample,
                                    jax.random.PRNGKey(0))
@@ -203,7 +212,8 @@ def main(argv=None) -> dict:
         writer.add_scalar("val/loss", float(m["loss"]), it)
         return m
 
-    writer = ScalarWriter(os.path.join(args.save_path, "logs"), rank=rank)
+    writer = ScalarWriter(os.path.join(args.save_path, "logs"), rank=rank,
+                          tensorboard=args.tensorboard)
     progress = ProgressPrinter(args.max_iter, args.print_freq, rank=rank)
     rng = np.random.RandomState(0)
     last = {}

@@ -68,6 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard the SGD momentum buffer 1/N over "
                         "the dp axis (parallel/zero.py)")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="also write TensorBoard event files next to the "
+                        "JSONL scalars (reference mix.py:16,168-171)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
     return p
@@ -212,7 +215,8 @@ def main(argv=None) -> dict:
         **extra)
     eval_step = make_eval_step(model, mesh)
 
-    writer = ScalarWriter(args.log_dir, rank=rank)
+    writer = ScalarWriter(args.log_dir, rank=rank,
+                          tensorboard=args.tensorboard)
     # Per-host epoch-seeded shuffle: each host draws its strided 1/world of
     # the epoch permutation (main.py:111-120's DistributedSampler contract).
     sampler = DistributedEpochSampler(len(train_ds), world_size=world,

@@ -88,6 +88,89 @@ def test_ring_attention_grads_match():
                                    rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_local(causal):
+    """All-to-all sequence parallelism over sp=8 equals single-device
+    attention (heads 8 % sp 8 == 0)."""
+    from cpd_tpu.ops.attention import ulysses_attention
+
+    rng = np.random.RandomState(11)
+    q, k, v = _rand_qkv(rng, b=2, t=64, h=8, d=8)
+    full = local_attention(q, k, v, causal=causal)
+
+    mesh = make_mesh(sp=8, dp=1)
+
+    def body(ql, kl, vl):
+        return ulysses_attention(ql, kl, vl, "sp", causal=causal)
+
+    sharded = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(full),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_attention_grads_match():
+    from cpd_tpu.ops.attention import ulysses_attention
+
+    rng = np.random.RandomState(12)
+    q, k, v = _rand_qkv(rng, b=1, t=32, h=8, d=8)
+    mesh = make_mesh(sp=8, dp=1)
+
+    def loss_full(q, k, v):
+        return jnp.sum(local_attention(q, k, v, causal=True) ** 2)
+
+    def loss_uly(q, k, v):
+        def body(ql, kl, vl):
+            o = ulysses_attention(ql, kl, vl, "sp", causal=True)
+            return lax.psum(jnp.sum(o ** 2), "sp")
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3, out_specs=P(),
+            check_vma=False)(q, k, v)
+
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_uly = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_full, g_uly):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_lm_unknown_sp_mode_raises():
+    model = _tiny_lm(sp_axis="sp", sp_mode="ulysess")  # typo must not
+    toks = jnp.zeros((1, 8), jnp.int32)                # silently ring
+    mesh = make_mesh(sp=8, dp=1)
+    with pytest.raises(ValueError, match="sp_mode"):
+        jax.shard_map(
+            lambda t: model.init(jax.random.PRNGKey(0), t),
+            mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+            check_vma=False)(toks)
+
+
+def test_lm_ulysses_forward_matches_single():
+    """dp2 x sp2 x tp2 with sp_mode='ulysses' == single-device forward
+    (local heads after tp split: 4/2=2, divisible by sp=2)."""
+    rng = np.random.RandomState(13)
+    toks = jnp.asarray(rng.randint(0, 64, (4, 32)).astype(np.int32))
+
+    ref_model = _tiny_lm()
+    params = ref_model.init(jax.random.PRNGKey(1), toks[:1])["params"]
+    want = ref_model.apply({"params": params}, toks)
+
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    sh_model = _tiny_lm(tp_axis="tp", sp_axis="sp", tp_size=2,
+                        sp_mode="ulysses")
+    specs = lm_param_specs(params, "tp")
+
+    out = jax.jit(jax.shard_map(
+        lambda p, t: sh_model.apply({"params": p}, t),
+        mesh=mesh, in_specs=(specs, P("dp", "sp")),
+        out_specs=P("dp", "sp"), check_vma=False))(params, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
 def _tiny_lm(**kw):
     return transformer_lm(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
                           d_ff=64, **kw)
