@@ -1,4 +1,4 @@
-"""ZeRO-1: optimizer-state sharding over the data axis.
+"""ZeRO-1/2: optimizer-state (and reduction) sharding over the data axis.
 
 New capability beyond the reference (SURVEY.md §2 strategy inventory:
 "ZeRO/FSDP sharding — Absent").  Stage-1 ZeRO: params stay replicated,
@@ -23,6 +23,27 @@ all_gather per step, riding ICI.  Usage:
     state = TrainState(..., opt_state=z.init(params))
     step = make_train_step(model, tx=None, mesh, update_fn=z.update_fn,
                            opt_state_spec=z.state_spec())
+
+Stage-2 ZeRO (`zero2_sgd`) additionally shards the *reduction*: instead of
+every rank gathering the full (W, P) gradient stack and each computing the
+whole ordered quantized sum (parallel/dist.py faithful mode), one
+`all_to_all` hands rank r the (W, P/W) stack of every rank's r-th slice,
+and the rank-ordered requantized scan runs only on that shard.  The scan
+is elementwise over ranks in rank order, so the shard-local sum is
+bit-identical to the corresponding slice of the replicated faithful
+reduction — APS scaling (global pmax), Kahan compensation, and the
+e5m2/fp16/bf16 wire compression all compose unchanged.  Peak reduction
+memory drops from W x P to P per chip (the gathered stack equals one
+model's gradients), wire bytes are identical.  Usage is the same as
+zero1_sgd, with the train step told to skip its own reduction (the step
+forwards its use_aps/grad_exp/grad_man/use_kahan/mode to the updater, so
+precision has one source of truth):
+
+    z = zero2_sgd(schedule, world)
+    step = make_train_step(model, None, mesh, use_aps=True, grad_exp=5,
+                           grad_man=2, update_fn=z.update_fn,
+                           opt_state_spec=z.state_spec(),
+                           reduce_in_update=True)
 """
 
 from __future__ import annotations
@@ -35,7 +56,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["Zero1State", "zero1_sgd"]
+from ..quant.numerics import cast_to_format
+from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
+                  pmax_scalar_vector)
+from .dist import _wire_dtype
+from .reduction import quantized_sum
+
+__all__ = ["Zero1State", "zero1_sgd", "zero2_sgd"]
 
 
 class Zero1State(NamedTuple):
@@ -102,21 +129,37 @@ class _Zero1:
     def state_spec(self) -> Zero1State:
         return Zero1State(P(), P(self.axis_name))
 
-    def update_fn(self, grads, state, axis_name: str):
-        """Inside shard_map: full replicated `grads`/params, LOCAL (S,)
-        momentum shard.  Returns (new full params, new opt state)."""
+    def _grad_shard(self, grads, state, axis_name: str,
+                    **quant_kw) -> jnp.ndarray:
+        """This rank's (S,) gradient slice.  ZeRO-1: slice the replicated
+        reduced grads; ZeRO-2 overrides with the sharded reduce-scatter."""
+        if quant_kw:
+            raise ValueError(
+                "ZeRO-1 expects pre-reduced gradients; "
+                "reduce_in_update=True is a ZeRO-2 (zero2_sgd) contract")
+        s = self._shard_size(state.params)
+        rank = lax.axis_index(axis_name)
+        flat_g = jnp.pad(self._flatten(grads),
+                         (0, self.world * s - sum(
+                             l.size for l in jax.tree.leaves(grads))))
+        return lax.dynamic_slice(flat_g, (rank * s,), (s,))
+
+    def update_fn(self, grads, state, axis_name: str, **quant_kw):
+        """Inside shard_map: `grads` per the subclass's _grad_shard
+        contract, LOCAL (S,) momentum shard.  Returns (new full params,
+        new opt state).  `quant_kw` is forwarded by the train step when it
+        delegates the reduction (reduce_in_update) so precision settings
+        have one source of truth."""
         params = state.params
         opt: Zero1State = state.opt_state
         s = self._shard_size(params)
         rank = lax.axis_index(axis_name)
         lr = self.schedule(opt.step)
 
-        flat_g = self._flatten(grads)
-        flat_p = self._flatten(params)
-        pad = self.world * s - flat_g.size
-        flat_g = jnp.pad(flat_g, (0, pad))
-        flat_p = jnp.pad(flat_p, (0, pad))
-        g_sh = lax.dynamic_slice(flat_g, (rank * s,), (s,))
+        g_sh = self._grad_shard(grads, state, axis_name, **quant_kw)
+        flat_p = jnp.pad(self._flatten(params),
+                         (0, self.world * s - sum(
+                             l.size for l in jax.tree.leaves(params))))
         p_sh = lax.dynamic_slice(flat_p, (rank * s,), (s,))
         m_sh = lax.dynamic_slice(
             self._flat_mask(params), (rank * s,), (s,))
@@ -139,4 +182,83 @@ def zero1_sgd(schedule: Callable, world: int, momentum: float = 0.9,
               axis_name: str = "dp") -> _Zero1:
     """ZeRO-1 torch-SGD: momentum sharded 1/`world` over `axis_name`."""
     return _Zero1(schedule, world, momentum, weight_decay, nesterov,
+                  wd_mask, axis_name)
+
+
+class _Zero2(_Zero1):
+    """ZeRO-2: sharded faithful quantized reduction + sharded update.
+
+    `update_fn` receives the rank's LOCAL (unreduced, post-emulate-node)
+    gradients — build the train step with ``reduce_in_update=True`` so it
+    skips `sum_gradients`.  Precision settings (use_aps/grad_exp/grad_man/
+    use_kahan/mode) are NOT stored here: the step forwards its own, so the
+    emulate-node quantization and the cross-device reduction cannot drift
+    apart."""
+
+    def _flat_shifts(self, grads, shifts) -> jnp.ndarray:
+        """Per-element shift vector matching the flat layout (broadcast
+        ops, not a materialized constant — see _flat_mask)."""
+        parts = [jnp.full((l.size,), 1.0, jnp.float32) * jnp.exp2(shifts[i])
+                 for i, l in enumerate(jax.tree.leaves(grads))]
+        flat = jnp.concatenate(parts)
+        s = self._shard_size(grads)
+        return jnp.pad(flat, (0, self.world * s - flat.shape[0]),
+                       constant_values=1.0)
+
+    def _grad_shard(self, local_grads, state, axis_name: str,
+                    use_aps: bool = False, grad_exp: int = 8,
+                    grad_man: int = 23, use_kahan: bool = False,
+                    mode: str = "faithful") -> jnp.ndarray:
+        """This rank's (S,) slice of the faithful quantized gradient sum.
+
+        Replicates parallel/dist.py `sum_gradients` faithful-mode semantics
+        exactly (APS pre-scale+quantize, rank-ordered requantized scan,
+        divide-unscale), but on 1/W of the elements: the scan is
+        elementwise over ranks, so slicing before summing is bit-identical
+        to summing then slicing.  The precision arguments come from the
+        train step (reduce_in_update forwards them)."""
+        if mode != "faithful":
+            raise ValueError(
+                f"ZeRO-2 shards the faithful ordered reduction; mode="
+                f"{mode!r} has no reduce-scatter equivalent (the fast "
+                f"psum path keeps the full gradient resident anyway)")
+        s = self._shard_size(local_grads)
+        g = local_grads
+        shifts = None
+        if use_aps:
+            max_exp = aps_max_exponents(g, float(self.world))
+            max_exp = pmax_scalar_vector(max_exp, axis_name)
+            shifts = aps_shift_factors(max_exp, grad_exp)
+            g = aps_scale(g, shifts)
+            g = jax.tree.map(
+                lambda l: cast_to_format(l, grad_exp, grad_man), g)
+
+        flat = self._flatten(g)
+        flat = jnp.pad(flat, (0, self.world * s - flat.size))
+        wire = _wire_dtype(grad_exp, grad_man) if use_aps else None
+        if wire is not None:
+            flat = flat.astype(wire)
+        # (W, S): row j after all_to_all = rank j's slice of OUR shard,
+        # rank-ordered — the gather side of a reduce_scatter
+        stacked = lax.all_to_all(flat.reshape(self.world, s), axis_name,
+                                 split_axis=0, concat_axis=0)
+        if wire is not None:
+            stacked = stacked.astype(jnp.float32)
+        red = quantized_sum(stacked, grad_exp, grad_man, use_kahan)
+        if use_aps:
+            rank = lax.axis_index(axis_name)
+            shift_sh = lax.dynamic_slice(
+                self._flat_shifts(local_grads, shifts), (rank * s,), (s,))
+            red = red / shift_sh   # true divide, aps_unscale semantics
+        return red
+
+
+def zero2_sgd(schedule: Callable, world: int, momentum: float = 0.9,
+              weight_decay: float = 0.0, nesterov: bool = False,
+              wd_mask: Optional[Callable] = None,
+              axis_name: str = "dp") -> _Zero2:
+    """ZeRO-2 torch-SGD: momentum AND the faithful quantized reduction
+    sharded 1/`world`; pair with ``make_train_step(...,
+    reduce_in_update=True)``, which forwards its precision settings."""
+    return _Zero2(schedule, world, momentum, weight_decay, nesterov,
                   wd_mask, axis_name)

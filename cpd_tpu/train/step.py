@@ -97,7 +97,8 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     ignore_label: Optional[int] = None,
                     donate: bool = True,
                     update_fn: Optional[Callable] = None,
-                    opt_state_spec: Optional[Any] = None):
+                    opt_state_spec: Optional[Any] = None,
+                    reduce_in_update: bool = False):
     """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
 
     images: (global_batch * emulate_node, H, W, C) sharded over `axis_name`;
@@ -105,7 +106,14 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     micro-batches (the reference's virtual-node emulation, mix.py:224-285).
     Returned metrics: {'loss': all-reduced mean loss, 'accuracy': top-1 over
     the global batch, 'lr'-free — schedule owns lr}.
+
+    reduce_in_update=True (requires update_fn) skips the step's own
+    `sum_gradients` and hands update_fn the rank-LOCAL post-emulate
+    gradients — for updaters that fold the collective into the update,
+    e.g. ZeRO-2's sharded faithful reduce-scatter (parallel/zero.py).
     """
+    if reduce_in_update and update_fn is None:
+        raise ValueError("reduce_in_update=True requires update_fn")
     has_stats_cache: dict = {}
 
     def local_micro_grads(params, batch_stats, images, labels, world, step):
@@ -180,15 +188,25 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         # cross-device low-precision all-reduce (mix.py:286-291).
         local = emulate_node_reduce(stacked, emulate_node, use_aps,
                                     grad_exp, grad_man)
-        reduced = sum_gradients(local, axis_name, use_aps=use_aps,
-                                grad_exp=grad_exp, grad_man=grad_man,
-                                use_kahan=use_kahan, mode=mode)
+        if reduce_in_update:
+            reduced = local       # update_fn owns the collective
+        else:
+            reduced = sum_gradients(local, axis_name, use_aps=use_aps,
+                                    grad_exp=grad_exp, grad_man=grad_man,
+                                    use_kahan=use_kahan, mode=mode)
 
         if update_fn is not None:
-            # custom update (e.g. parallel/zero.py ZeRO-1: shard-local
+            # custom update (e.g. parallel/zero.py ZeRO: shard-local
             # optimizer math + param all_gather); must return the full
-            # replicated params and the (possibly sharded) new opt state
-            new_params, new_opt = update_fn(reduced, state, axis_name)
+            # replicated params and the (possibly sharded) new opt state.
+            # With reduce_in_update the step's precision settings ride
+            # along so the updater's collective cannot drift from the
+            # emulate-node quantization above.
+            quant_kw = dict(use_aps=use_aps, grad_exp=grad_exp,
+                            grad_man=grad_man, use_kahan=use_kahan,
+                            mode=mode) if reduce_in_update else {}
+            new_params, new_opt = update_fn(reduced, state, axis_name,
+                                            **quant_kw)
         else:
             updates, new_opt = tx.update(reduced, state.opt_state,
                                          state.params)

@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="compute BN batch statistics across the dp axis "
                         "(per-replica stats, the reference behavior, when "
                         "off)")
+    p.add_argument("--zero2", action="store_true",
+                   help="ZeRO-2: momentum AND the faithful quantized "
+                        "reduction sharded over dp (parallel/zero.py)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard the SGD momentum buffer 1/N over "
                         "the dp axis (parallel/zero.py)")
@@ -143,9 +146,19 @@ def main(argv=None) -> dict:
         model, tx, jnp.zeros((2, args.image_size, args.image_size, 3)),
         jax.random.PRNGKey(args.seed))
     zero = None
+    if args.zero1 and args.zero2:
+        raise ValueError("--zero1 and --zero2 are mutually exclusive")
     if args.zero1:
         from cpd_tpu.parallel.zero import zero1_sgd
         zero = zero1_sgd(schedule, world=n_dev, momentum=args.momentum,
+                         weight_decay=args.wd, wd_mask=bn_and_bias_no_wd)
+        state = state.replace(opt_state=zero.init(state.params))
+    elif args.zero2:
+        if args.mode != "faithful":
+            raise ValueError("--zero2 shards the faithful reduction; "
+                             "--mode fast is not supported with it")
+        from cpd_tpu.parallel.zero import zero2_sgd
+        zero = zero2_sgd(schedule, world=n_dev, momentum=args.momentum,
                          weight_decay=args.wd, wd_mask=bn_and_bias_no_wd)
         state = state.replace(opt_state=zero.init(state.params))
 
@@ -207,6 +220,8 @@ def main(argv=None) -> dict:
                                     s, PartitionSpec)))
         extra = {"update_fn": zero.update_fn,
                  "opt_state_spec": zero.state_spec()}
+        if args.zero2:
+            extra["reduce_in_update"] = True
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
