@@ -14,6 +14,7 @@ from .transformer import TransformerLM, lm_param_specs, transformer_lm
 from .pipeline_lm import PipelinedLM, pipelined_lm, pp_param_specs
 from .moe import MoETransformerLM, moe_lm, moe_param_specs
 from .davidnet_graph import graph_davidnet
+from .generate import generate
 
 _REGISTRY = {
     "res_cifar": resnet18_cifar,      # reference name (mix.py:82)
@@ -44,4 +45,4 @@ __all__ = ["ResNetCIFAR", "resnet18_cifar", "DavidNet", "davidnet",
            "TransformerLM", "transformer_lm", "lm_param_specs",
            "PipelinedLM", "pipelined_lm", "pp_param_specs",
            "MoETransformerLM", "moe_lm", "moe_param_specs",
-           "graph_davidnet", "get_model"]
+           "graph_davidnet", "generate", "get_model"]

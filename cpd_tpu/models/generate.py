@@ -1,0 +1,108 @@
+"""Autoregressive generation for the transformer LM (KV-cache decode).
+
+Inference capability beyond the reference (CNN-only, SURVEY.md §5).
+TPU-first shape discipline: the KV cache is allocated once at the full
+``prompt + max_new_tokens`` length, prefill is ONE forward over the whole
+prompt (one MXU-friendly batch matmul, not a Python loop), and the decode
+loop is a single ``lax.scan`` of one-token steps — the whole thing traces
+into one jitted program with static shapes.
+
+Usage:
+
+    model = transformer_lm(vocab_size=..., ...)          # trained as usual
+    params = state.params
+    out = generate(model, params, prompt_tokens, max_new_tokens=32,
+                   temperature=0.0, rng=jax.random.PRNGKey(0))
+    # out: (B, T_prompt + max_new_tokens) int32
+
+``temperature=0`` is greedy argmax; ``temperature>0`` samples from
+``softmax(logits / temperature)`` (requires ``rng``).  Decode is
+single-device (the training-time sp/tp shardings do not apply; pass the
+plain unsharded module).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["generate"]
+
+
+@functools.lru_cache(maxsize=32)
+def _make_run(decoder, max_new_tokens: int, temperature: float):
+    """Build the jitted prefill+scan program once per (module, length,
+    temperature) — flax modules hash by their field values, so repeat
+    generate() calls hit jit's trace cache instead of recompiling."""
+
+    def sample(logits_last, key):
+        if temperature == 0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_last / jnp.float32(temperature), axis=-1
+        ).astype(jnp.int32)
+
+    @jax.jit
+    def run(params, cache, prompt, rng):
+        # one-pass prefill over the whole prompt
+        logits, mut = decoder.apply({"params": params, "cache": cache},
+                                    prompt, train=False, mutable=["cache"])
+        key0, rng = jax.random.split(rng)
+        first = sample(logits[:, -1], key0)
+
+        def step(carry, _):
+            cache, tok, rng = carry
+            key, rng = jax.random.split(rng)
+            logits, mut = decoder.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"])
+            nxt = sample(logits[:, -1], key)
+            return (mut["cache"], nxt, rng), tok
+
+        # each step emits its input token and computes the next; the final
+        # carry token is the max_new-th generated token
+        (_, last, _), toks = lax.scan(
+            step, (mut["cache"], first, rng), None,
+            length=max_new_tokens - 1)
+        new = jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
+        return jnp.concatenate([prompt, new], axis=1)
+
+    return run
+
+
+def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` (B, T_p).
+
+    Returns (B, T_p + max_new_tokens) int32 — prompt included.
+    """
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t_p = prompt.shape
+    t_max = t_p + max_new_tokens
+
+    decoder = model.clone(decode=True, sp_axis=None, tp_axis=None,
+                          tp_size=1)
+    # allocate the cache at full length (Block._cached_attention takes its
+    # cache shape from the init call) WITHOUT running the forward:
+    # eval_shape gives the cache pytree's shapes/dtypes for free, and the
+    # initial cache contents are defined zeros (position included)
+    shapes = jax.eval_shape(
+        lambda t: decoder.init(jax.random.PRNGKey(0), t, train=False),
+        jax.ShapeDtypeStruct((b, t_max), jnp.int32))["cache"]
+    cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    # carry needs an array either way; greedy sampling ignores it
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    run = _make_run(decoder, max_new_tokens, float(temperature))
+    return run(params, cache0, prompt, rng)

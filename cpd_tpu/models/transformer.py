@@ -64,6 +64,7 @@ class Block(nn.Module):
     dtype: Any
     sp_mode: str = "ring"   # "ring" (ppermute K/V) | "ulysses" (all_to_all
                             # heads<->sequence; local heads % sp size == 0)
+    decode: bool = False    # KV-cache autoregressive mode (single device)
     mlp: Optional[Any] = None   # factory () -> nn.Module replacing the
                                 # dense pair (e.g. MoE experts); a custom
                                 # mlp owns its own collectives — Block's tp
@@ -71,6 +72,41 @@ class Block(nn.Module):
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
+
+    def _cached_attention(self, q, k, v, positions):
+        """KV-cache attention (decode=True).
+
+        The cache is created on the FIRST call (flax init) with this
+        call's (B, T, H, D) shapes — so initialize with a dummy input of
+        the MAXIMUM sequence length.  Every later call writes its k/v
+        block at ``positions[0]`` and attends q over the whole cache with
+        the mask ``key_pos <= query_pos`` — one code path serves both
+        one-pass prefill (T = prompt length) and single-token decode
+        (T = 1)."""
+        is_init = self.has_variable("cache", "cached_k")
+        cache_k = self.variable("cache", "cached_k", jnp.zeros, k.shape,
+                                k.dtype)
+        cache_v = self.variable("cache", "cached_v", jnp.zeros, v.shape,
+                                v.dtype)
+        if not is_init:
+            # init trace: caches get their (B, T_max, H, D) zero shapes;
+            # run plain causal attention so init outputs are well-formed
+            return local_attention(q, k, v, causal=True)
+        start = positions[0]
+        cache_k.value = lax.dynamic_update_slice(
+            cache_k.value, k.astype(cache_k.value.dtype), (0, start, 0, 0))
+        cache_v.value = lax.dynamic_update_slice(
+            cache_v.value, v.astype(cache_v.value.dtype), (0, start, 0, 0))
+        # keys sit at global positions 0..T_max-1, queries at `positions`;
+        # local_attention's q_offset mask (q_off+i >= ki) is exactly
+        # key_pos <= query_pos, and also hides the unwritten cache tail
+        out = local_attention(q, cache_k.value, cache_v.value, causal=True,
+                              q_offset=start)
+        # capacity guard: past the allocated length dynamic_update_slice
+        # silently clamps the write (corrupting the last slot), so poison
+        # the output with NaN to fail loudly instead
+        t_max = cache_k.value.shape[1]
+        return jnp.where(positions[-1] < t_max, out, jnp.nan)
 
     @nn.compact
     def __call__(self, x, positions):
@@ -89,7 +125,9 @@ class Block(nn.Module):
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown sp_mode {self.sp_mode!r}; "
                              "expected 'ring' or 'ulysses'")
-        if self.sp_axis and self.sp_mode == "ulysses":
+        if self.decode:
+            attn = self._cached_attention(q, k, v, positions)
+        elif self.sp_axis and self.sp_mode == "ulysses":
             attn = ulysses_attention(q, k, v, self.sp_axis, causal=True)
         elif self.sp_axis:
             attn = ring_attention(q, k, v, self.sp_axis, causal=True)
@@ -124,13 +162,26 @@ class TransformerLM(nn.Module):
     sp_axis: Optional[str] = None
     tp_size: int = 1
     sp_mode: str = "ring"
+    decode: bool = False
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
         t_local = tokens.shape[1]
-        if self.sp_axis:
+        if self.decode:
+            if self.sp_axis or self.tp_axis:
+                raise ValueError("decode=True (KV cache) is single-device; "
+                                 "unset sp_axis/tp_axis")
+            # running position: init at 0 when the cache is created, then
+            # advance by this call's token count (prefill or one token)
+            is_init = self.has_variable("cache", "position")
+            pos_var = self.variable("cache", "position",
+                                    lambda: jnp.zeros((), jnp.int32))
+            offset = pos_var.value if is_init else 0
+            if is_init:
+                pos_var.value = pos_var.value + t_local
+        elif self.sp_axis:
             offset = lax.axis_index(self.sp_axis) * t_local
         else:
             offset = 0
@@ -146,6 +197,7 @@ class TransformerLM(nn.Module):
                       d_model=self.d_model, tp_axis=self.tp_axis,
                       sp_axis=self.sp_axis, tp_size=self.tp_size,
                       dtype=self.dtype, sp_mode=self.sp_mode,
+                      decode=self.decode,
                       name=f"block{i}")(x, positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = emb.attend(x.astype(self.param_dtype))  # tied head
