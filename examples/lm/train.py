@@ -72,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensorboard", action="store_true",
                    help="also write TensorBoard event files next to the "
                         "JSONL scalars (reference mix.py:16,168-171)")
+    p.add_argument("--sample", default=0, type=int,
+                   help="after training, greedy-decode this many tokens "
+                        "from a data prompt (KV-cache generate; default "
+                        "dp/sp/tp path only — pp/moe modules have no "
+                        "decode mode)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
     return p
@@ -100,6 +105,9 @@ def main(argv=None) -> dict:
     if (args.pp > 1 or args.moe) and args.emulate_node != 1:
         raise ValueError("--emulate_node is only supported on the "
                          "default dp/sp/tp path")
+    if (args.pp > 1 or args.moe) and args.sample > 0:
+        raise ValueError("--sample needs the default dp/sp/tp path "
+                         "(pp/moe modules have no decode mode)")
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp,
                      ep=args.ep if args.moe else 1)
     dp = mesh.shape["dp"]
@@ -262,8 +270,26 @@ def main(argv=None) -> dict:
         print(f"done: {args.max_iter} iters in {dt:.1f}s "
               f"({args.max_iter * global_batch * args.seq_len / dt:.0f} "
               f"tok/s) final loss {last.get('loss', float('nan')):.4f}")
+    sampled = None
+    if args.sample > 0 and not (preempted or diverged):
+        from jax.sharding import NamedSharding, PartitionSpec
+        from cpd_tpu.models import generate
+        toks, _ = ds.batch(np.arange(1), seed=0)
+        prompt = jnp.asarray(toks[:, :min(8, args.seq_len)], jnp.int32)
+        # params were laid out per lm_state_specs (tp-sharded leaves when
+        # tp>1); re-lay them out fully replicated — a compiled all-gather
+        # that is multi-host safe, unlike device_get on a sharded Array —
+        # then decode single-device
+        gather = jax.jit(lambda p: p,
+                         out_shardings=NamedSharding(mesh, PartitionSpec()))
+        out = generate(init_model, jax.device_get(gather(state.params)),
+                       prompt, max_new_tokens=args.sample)
+        sampled = np.asarray(out)[0].tolist()
+        if rank == 0:
+            print(f"sample (greedy, {args.sample} new tokens): {sampled}")
     writer.close()
-    return {"step": step_no, "diverged": diverged, **last}
+    return {"step": step_no, "diverged": diverged,
+            **({"sample": sampled} if sampled is not None else {}), **last}
 
 
 if __name__ == "__main__":

@@ -490,11 +490,14 @@ def test_lm_trainer_smoke(tmp_path):
             "--d-model", "32", "--n-layers", "2", "--n-heads", "4",
             "--vocab-size", "64", "--batch-size", "2", "--max-iter", "3",
             "--use_APS", "--grad_exp", "5", "--grad_man", "2",
-            "--ckpt-freq", "3",
+            "--ckpt-freq", "3", "--sample", "4",
             "--save-path", str(tmp_path / "lm"), "--mode", "faithful"]
     res = main(argv)
     assert res["step"] == 3
     assert math.isfinite(res["loss"])
+    # --sample decoded 4 new tokens from an 8-token prompt
+    assert len(res["sample"]) == 12
+    assert all(0 <= t < 64 for t in res["sample"])
     # sharded-state checkpoint written; auto-resume restores and re-lays
     # it out over the dp x sp x tp mesh (0 iters left)
     res2 = main(argv)
