@@ -11,12 +11,12 @@ from .emulate import emulate_node_reduce
 from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, group_split,
                    data_parallel_mesh, make_mesh)
 from .pipeline import pipeline_spmd
-from .zero import Zero1State, zero1_sgd, zero2_sgd
+from .zero import Zero1State, zero1_sgd, zero2_sgd, zero3_sgd
 from .reduction import (kahan_quantized_sum, ordered_quantized_sum,
                         quantized_sum)
 
 __all__ = [
-    "pipeline_spmd", "Zero1State", "zero1_sgd", "zero2_sgd",
+    "pipeline_spmd", "Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd",
     "aps_max_exponents", "aps_scale", "aps_shift_factors", "aps_unscale",
     "all_reduce_mean", "broadcast_from", "dist_init", "make_sum_gradients_fn",
     "replicate", "sum_gradients", "emulate_node_reduce",

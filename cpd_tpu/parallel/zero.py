@@ -62,7 +62,7 @@ from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
 from .dist import _wire_dtype
 from .reduction import quantized_sum
 
-__all__ = ["Zero1State", "zero1_sgd", "zero2_sgd"]
+__all__ = ["Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd"]
 
 
 class Zero1State(NamedTuple):
@@ -163,17 +163,21 @@ class _Zero1:
         p_sh = lax.dynamic_slice(flat_p, (rank * s,), (s,))
         m_sh = lax.dynamic_slice(
             self._flat_mask(params), (rank * s,), (s,))
-
-        # torch-SGD rule on the shard (train/optim.py:65-69, bit-equal)
-        d = g_sh + (self.weight_decay * p_sh * m_sh
-                    if self.weight_decay else 0.0)
-        new_buf = self.momentum * opt.momentum + d
-        step_dir = d + self.momentum * new_buf if self.nesterov else new_buf
-        new_p_sh = p_sh - lr * step_dir
+        new_p_sh, new_buf = self._shard_sgd(g_sh, p_sh, m_sh,
+                                            opt.momentum, lr)
 
         full = lax.all_gather(new_p_sh, axis_name, axis=0, tiled=True)
         new_params = self._unflatten(full, params)
         return new_params, Zero1State(opt.step + 1, new_buf)
+
+    def _shard_sgd(self, g_sh, p_sh, m_sh, buf, lr):
+        """The torch-SGD rule on a flat shard (train/optim.py:65-69,
+        bit-equal) — the ONE copy every ZeRO stage's update uses."""
+        d = g_sh + (self.weight_decay * p_sh * m_sh
+                    if self.weight_decay else 0.0)
+        new_buf = self.momentum * buf + d
+        step_dir = d + self.momentum * new_buf if self.nesterov else new_buf
+        return p_sh - lr * step_dir, new_buf
 
 
 def zero1_sgd(schedule: Callable, world: int, momentum: float = 0.9,
@@ -262,3 +266,99 @@ def zero2_sgd(schedule: Callable, world: int, momentum: float = 0.9,
     reduce_in_update=True)``, which forwards its precision settings."""
     return _Zero2(schedule, world, momentum, weight_decay, nesterov,
                   wd_mask, axis_name)
+
+
+class _Zero3(_Zero2):
+    """ZeRO-3 (FSDP-style): parameters themselves sharded at rest.
+
+    TrainState.params holds this rank's flat fp32 (S,) shard — the full
+    model exists only transiently inside the step: one tiled `all_gather`
+    + unflatten materializes the pytree for forward/backward, the ZeRO-2
+    reduce-scatter shards the gradients, the update runs on the shard,
+    and the step returns the shard.  Per-chip param memory drops from P
+    to P/W (plus the transient gather, which XLA frees after the last
+    use); the extra wire cost over ZeRO-2 is one P all_gather per step.
+
+    Built for the train step's ``params_spec``/``unpack_params`` hooks:
+
+        z = zero3_sgd(schedule, world, template=params_pytree)
+        state = TrainState(..., params=z.pack(params), opt_state=z.init())
+        step = make_train_step(model, None, mesh, update_fn=z.update_fn,
+                               opt_state_spec=z.state_spec(),
+                               params_spec=z.param_spec(),
+                               unpack_params=z.unpack,
+                               reduce_in_update=True, ...)
+
+    ``template`` fixes the pytree structure/shapes (arrays or
+    ShapeDtypeStructs); `to_pytree` recovers the pytree from the global
+    flat array for eval/checkpoint interop.
+    """
+
+    def __init__(self, schedule, world, momentum, weight_decay, nesterov,
+                 wd_mask, axis_name, template):
+        super().__init__(schedule, world, momentum, weight_decay, nesterov,
+                         wd_mask, axis_name)
+        self.template = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(jnp.shape(l), l.dtype), template)
+        if wd_mask is not None:
+            # ZeRO-3 evaluates the mask on the shape-only template (real
+            # params exist only transiently inside the step) — fail fast
+            # with a clear contract error for value-inspecting masks
+            try:
+                wd_mask(self.template)
+            except TypeError as e:
+                raise TypeError(
+                    "zero3_sgd wd_mask must be shape/path-based: it is "
+                    "evaluated on a ShapeDtypeStruct pytree, not real "
+                    f"arrays (got: {e})") from e
+
+    # ---- host-side layout converters ----
+    def pack(self, params) -> jnp.ndarray:
+        """Pytree -> global flat (W*S,) fp32 (device_put with
+        `param_spec()`'s NamedSharding, or the step's out sharding,
+        splits it 1/W)."""
+        s = self._shard_size(self.template)
+        flat = self._flatten(params)
+        return jnp.pad(flat, (0, self.world * s - flat.size))
+
+    def to_pytree(self, flat_global: jnp.ndarray):
+        """Global flat array -> param pytree (for eval / checkpoints)."""
+        return self._unflatten(flat_global, self.template)
+
+    # ---- step hooks ----
+    def param_spec(self) -> P:
+        return P(self.axis_name)
+
+    def unpack(self, flat_shard: jnp.ndarray, axis_name: str):
+        """Inside shard_map: rank's (S,) shard -> full param pytree."""
+        full = lax.all_gather(flat_shard, axis_name, axis=0, tiled=True)
+        return self._unflatten(full, self.template)
+
+    def init(self) -> Zero1State:
+        return super().init(self.template)
+
+    def update_fn(self, local_grads, state, axis_name: str, **quant_kw):
+        """`state.params` is the (S,) flat shard; `local_grads` the local
+        post-emulate grad pytree.  Returns (new shard, new opt state)."""
+        opt: Zero1State = state.opt_state
+        s = self._shard_size(self.template)
+        rank = lax.axis_index(axis_name)
+        lr = self.schedule(opt.step)
+
+        g_sh = self._grad_shard(local_grads, state, axis_name, **quant_kw)
+        p_sh = state.params
+        m_sh = lax.dynamic_slice(
+            self._flat_mask(self.template), (rank * s,), (s,))
+        new_p_sh, new_buf = self._shard_sgd(g_sh, p_sh, m_sh,
+                                            opt.momentum, lr)
+        return new_p_sh, Zero1State(opt.step + 1, new_buf)
+
+
+def zero3_sgd(schedule: Callable, world: int, template,
+              momentum: float = 0.9, weight_decay: float = 0.0,
+              nesterov: bool = False, wd_mask: Optional[Callable] = None,
+              axis_name: str = "dp") -> _Zero3:
+    """ZeRO-3 torch-SGD: params, momentum AND the faithful quantized
+    reduction all sharded 1/`world` (see _Zero3 for the wiring)."""
+    return _Zero3(schedule, world, momentum, weight_decay, nesterov,
+                  wd_mask, axis_name, template)

@@ -98,7 +98,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     donate: bool = True,
                     update_fn: Optional[Callable] = None,
                     opt_state_spec: Optional[Any] = None,
-                    reduce_in_update: bool = False):
+                    reduce_in_update: bool = False,
+                    params_spec: Optional[Any] = None,
+                    unpack_params: Optional[Callable] = None):
     """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
 
     images: (global_batch * emulate_node, H, W, C) sharded over `axis_name`;
@@ -111,9 +113,24 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     `sum_gradients` and hands update_fn the rank-LOCAL post-emulate
     gradients — for updaters that fold the collective into the update,
     e.g. ZeRO-2's sharded faithful reduce-scatter (parallel/zero.py).
+
+    params_spec / unpack_params support ZeRO-3 parameter sharding:
+    `params_spec` is the PartitionSpec of TrainState.params (default
+    replicated), and `unpack_params(stored_params, axis_name)` maps the
+    stored layout to the model's param pytree inside shard_map (e.g. the
+    flat-shard all_gather + unflatten of parallel/zero.py `_Zero3`);
+    update_fn then returns params back in the STORED layout.
     """
     if reduce_in_update and update_fn is None:
         raise ValueError("reduce_in_update=True requires update_fn")
+    if unpack_params is not None and update_fn is None:
+        raise ValueError("unpack_params requires update_fn (the default "
+                         "optax update assumes stored params == model "
+                         "params)")
+    if params_spec is not None and unpack_params is None:
+        raise ValueError("params_spec (sharded stored params) requires "
+                         "unpack_params to rebuild the model pytree "
+                         "inside the step")
     has_stats_cache: dict = {}
 
     def local_micro_grads(params, batch_stats, images, labels, world, step):
@@ -180,8 +197,10 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
     def step_fn(state: TrainState, images, labels):
         world = lax.psum(jnp.float32(1.0), axis_name)
+        model_params = (unpack_params(state.params, axis_name)
+                        if unpack_params is not None else state.params)
         stacked, new_stats, loss, correct, counted = local_micro_grads(
-            state.params, state.batch_stats, images, labels, world,
+            model_params, state.batch_stats, images, labels, world,
             state.step)
 
         # Local emulated-node reduction (mix.py:251-282), then the
@@ -197,8 +216,10 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         if update_fn is not None:
             # custom update (e.g. parallel/zero.py ZeRO: shard-local
-            # optimizer math + param all_gather); must return the full
-            # replicated params and the (possibly sharded) new opt state.
+            # optimizer math); must return params in the STORED layout
+            # (full replicated by default; the rank's shard when
+            # params_spec/unpack_params are in play) and the (possibly
+            # sharded) new opt state.
             # With reduce_in_update the step's precision settings ride
             # along so the updater's collective cannot drift from the
             # emulate-node quantization above.
@@ -229,11 +250,13 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         }
         return new_state, metrics
 
-    if opt_state_spec is None:
+    if opt_state_spec is None and params_spec is None:
         state_spec: Any = P()   # fully replicated state
     else:
-        state_spec = TrainState(step=P(), params=P(), batch_stats=P(),
-                                opt_state=opt_state_spec)
+        state_spec = TrainState(step=P(), params=params_spec or P(),
+                                batch_stats=P(),
+                                opt_state=opt_state_spec
+                                if opt_state_spec is not None else P())
     data_spec = P(axis_name)    # batch-sharded
     shard_fn = jax.shard_map(
         step_fn, mesh=mesh,

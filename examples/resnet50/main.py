@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero2", action="store_true",
                    help="ZeRO-2: momentum AND the faithful quantized "
                         "reduction sharded over dp (parallel/zero.py)")
+    p.add_argument("--zero3", action="store_true",
+                   help="ZeRO-3: params, momentum AND the reduction all "
+                        "sharded over dp; params gathered transiently "
+                        "per step (parallel/zero.py)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard the SGD momentum buffer 1/N over "
                         "the dp axis (parallel/zero.py)")
@@ -146,21 +150,28 @@ def main(argv=None) -> dict:
         model, tx, jnp.zeros((2, args.image_size, args.image_size, 3)),
         jax.random.PRNGKey(args.seed))
     zero = None
-    if args.zero1 and args.zero2:
-        raise ValueError("--zero1 and --zero2 are mutually exclusive")
+    if sum((args.zero1, args.zero2, args.zero3)) > 1:
+        raise ValueError("--zero1/--zero2/--zero3 are mutually exclusive")
+    if (args.zero2 or args.zero3) and args.mode != "faithful":
+        raise ValueError("--zero2/--zero3 shard the faithful reduction; "
+                         "--mode fast is not supported with them")
     if args.zero1:
         from cpd_tpu.parallel.zero import zero1_sgd
         zero = zero1_sgd(schedule, world=n_dev, momentum=args.momentum,
                          weight_decay=args.wd, wd_mask=bn_and_bias_no_wd)
         state = state.replace(opt_state=zero.init(state.params))
     elif args.zero2:
-        if args.mode != "faithful":
-            raise ValueError("--zero2 shards the faithful reduction; "
-                             "--mode fast is not supported with it")
         from cpd_tpu.parallel.zero import zero2_sgd
         zero = zero2_sgd(schedule, world=n_dev, momentum=args.momentum,
                          weight_decay=args.wd, wd_mask=bn_and_bias_no_wd)
         state = state.replace(opt_state=zero.init(state.params))
+    elif args.zero3:
+        from cpd_tpu.parallel.zero import zero3_sgd
+        zero = zero3_sgd(schedule, world=n_dev, template=state.params,
+                         momentum=args.momentum, weight_decay=args.wd,
+                         wd_mask=bn_and_bias_no_wd)
+        state = state.replace(params=zero.pack(state.params),
+                              opt_state=zero.init())
 
     manager = CheckpointManager(os.path.abspath(args.checkpoint_dir),
                                 track_best=True)
@@ -211,7 +222,8 @@ def main(argv=None) -> dict:
     else:
         from jax.sharding import NamedSharding, PartitionSpec
         from cpd_tpu.train.state import TrainState as TS
-        spec_tree = TS(step=PartitionSpec(), params=PartitionSpec(),
+        p_spec = (zero.param_spec() if args.zero3 else PartitionSpec())
+        spec_tree = TS(step=PartitionSpec(), params=p_spec,
                        batch_stats=PartitionSpec(),
                        opt_state=zero.state_spec())
         state = jax.device_put(
@@ -220,8 +232,11 @@ def main(argv=None) -> dict:
                                     s, PartitionSpec)))
         extra = {"update_fn": zero.update_fn,
                  "opt_state_spec": zero.state_spec()}
-        if args.zero2:
+        if args.zero2 or args.zero3:
             extra["reduce_in_update"] = True
+        if args.zero3:
+            extra["params_spec"] = zero.param_spec()
+            extra["unpack_params"] = zero.unpack
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
@@ -229,6 +244,13 @@ def main(argv=None) -> dict:
         grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
         **extra)
     eval_step = make_eval_step(model, mesh)
+    if args.zero3:
+        # eval consumes the pytree layout; one jitted unflatten per
+        # validation pass rebuilds it from the flat shards
+        _unpack_eval = jax.jit(zero.to_pytree)
+        eval_view = lambda s: s.replace(params=_unpack_eval(s.params))  # noqa: E731
+    else:
+        eval_view = lambda s: s                                         # noqa: E731
 
     writer = ScalarWriter(args.log_dir, rank=rank,
                           tensorboard=args.tensorboard)
@@ -296,10 +318,11 @@ def main(argv=None) -> dict:
             val_loss = val_top1 = val_top5 = 0.0
             k = 0
             n_val = (len(val_ds) // val_bs) * val_bs
+            eval_state = eval_view(state)
             for lo in range(0, n_val, val_bs):
                 sel = np.arange(lo + rank * val_host, lo + (rank + 1) * val_host)
                 x, y = val_ds.batch(sel)
-                m = eval_step(state,
+                m = eval_step(eval_state,
                               host_batch_to_global(x.astype(np.float32), mesh),
                               host_batch_to_global(y, mesh))
                 val_loss += float(m["loss"])

@@ -296,6 +296,22 @@ def test_resnet50_trainer_zero1_smoke(tmp_path):
     assert math.isfinite(res["train_loss"])
 
 
+def test_resnet50_trainer_zero3_smoke(tmp_path):
+    """--zero3 shards params+momentum+reduction 1/N over dp through the
+    flagship CLI, including the unpacked-eval validation path."""
+    from resnet50.main import main
+
+    res = main(["--batch-size", "1", "--epochs", "1", "--arch", "tiny",
+                "--num-classes", "10", "--max-batches-per-epoch", "2",
+                "--image-size", "32", "--use-APS", "--grad_exp", "5",
+                "--grad_man", "2", "--zero3",
+                "--checkpoint-dir", str(tmp_path / "ck"),
+                "--log-dir", str(tmp_path / "logs"), "--mode", "faithful"])
+    assert res["epoch"] == 0
+    assert math.isfinite(res["train_loss"])
+    assert math.isfinite(res["val_loss"])
+
+
 def test_resnet18_trainer_resume_continues_training(tiny_cifar, tmp_path):
     """Auto-resume must REPLICATE the orbax-restored state back onto the
     mesh and keep training — restore committed the arrays to one device,

@@ -190,6 +190,74 @@ def test_zero2_reduce_scatter_bitwise(exp, man, kahan):
                                   np.asarray(full)[:flat_ref.size])
 
 
+def test_zero3_matches_replicated_faithful():
+    """ZeRO-3 (params sharded at rest, gathered transiently per step)
+    trains identically to the replicated faithful path."""
+    from cpd_tpu.parallel.zero import zero3_sgd
+
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = tiny_cnn()
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    x, y = _data(16, seed=5)
+    quant = dict(use_aps=True, grad_exp=5, grad_man=2, use_kahan=True)
+
+    tx = make_optimizer("sgd", schedule, momentum=0.9, weight_decay=1e-2)
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, donate=False, mode="faithful",
+                           **quant)
+    s_ref = state
+    for _ in range(3):
+        s_ref, m_ref = step(s_ref, x, y)
+
+    z = zero3_sgd(schedule, world=w, template=state.params, momentum=0.9,
+                  weight_decay=1e-2)
+    z_state = TrainState(step=jnp.zeros([], jnp.int32),
+                         params=z.pack(state.params),
+                         batch_stats=state.batch_stats,
+                         opt_state=z.init())
+    spec_tree = TrainState(step=P(), params=z.param_spec(),
+                           batch_stats=P(), opt_state=z.state_spec())
+    z_state = jax.device_put(
+        z_state, jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                              is_leaf=lambda s: isinstance(s, P)))
+    z_step = make_train_step(model, None, mesh, donate=False,
+                             update_fn=z.update_fn,
+                             opt_state_spec=z.state_spec(),
+                             params_spec=z.param_spec(),
+                             unpack_params=z.unpack,
+                             reduce_in_update=True, **quant)
+    s_z = z_state
+    for _ in range(3):
+        s_z, m_z = z_step(s_z, x, y)
+
+    np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
+                               rtol=1e-6)
+    got = z.to_pytree(jnp.asarray(np.asarray(s_z.params)))
+    for (path, g), (_, want) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.tree.map(np.asarray, got))[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.tree.map(np.asarray, s_ref.params))[0]):
+        np.testing.assert_allclose(np.asarray(g), want, rtol=1e-6,
+                                   atol=1e-7, err_msg=str(path))
+
+    # params and momentum genuinely sharded 1/W per device
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
+    s_per_rank = -(-n_params // w)
+    for arr in (s_z.params, s_z.opt_state.momentum):
+        shard_shapes = {tuple(sh.data.shape)
+                        for sh in arr.addressable_shards}
+        assert shard_shapes == {(s_per_rank,)}
+
+
+def test_unpack_params_requires_update_fn():
+    mesh = data_parallel_mesh()
+    with pytest.raises(ValueError, match="unpack_params"):
+        make_train_step(tiny_cnn(), None, mesh,
+                        unpack_params=lambda p, a: p)
+
+
 def test_reduce_in_update_requires_update_fn():
     mesh = data_parallel_mesh()
     with pytest.raises(ValueError, match="reduce_in_update"):
