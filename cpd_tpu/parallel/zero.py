@@ -144,12 +144,20 @@ class _Zero1:
                              l.size for l in jax.tree.leaves(grads))))
         return lax.dynamic_slice(flat_g, (rank * s,), (s,))
 
+    requires_reduce_in_update = False
+
     def update_fn(self, grads, state, axis_name: str, **quant_kw):
         """Inside shard_map: `grads` per the subclass's _grad_shard
         contract, LOCAL (S,) momentum shard.  Returns (new full params,
         new opt state).  `quant_kw` is forwarded by the train step when it
         delegates the reduction (reduce_in_update) so precision settings
         have one source of truth."""
+        if self.requires_reduce_in_update and not quant_kw:
+            raise ValueError(
+                "this ZeRO stage folds the collective into the update: "
+                "build the step with make_train_step(..., "
+                "reduce_in_update=True) — without it the step pre-reduces "
+                "and the sharded reduce-scatter would double-count by W")
         params = state.params
         opt: Zero1State = state.opt_state
         s = self._shard_size(params)
@@ -198,6 +206,12 @@ class _Zero2(_Zero1):
     use_kahan/mode) are NOT stored here: the step forwards its own, so the
     emulate-node quantization and the cross-device reduction cannot drift
     apart."""
+
+    # update_fn must see LOCAL grads; _Zero1.update_fn enforces this by
+    # refusing to run when the step did not forward its precision settings
+    # (i.e. reduce_in_update was off and grads are already reduced —
+    # reduce-scattering those would double-count by W)
+    requires_reduce_in_update = True
 
     def _flat_shifts(self, grads, shifts) -> jnp.ndarray:
         """Per-element shift vector matching the flat layout (broadcast
@@ -337,9 +351,63 @@ class _Zero3(_Zero2):
     def init(self) -> Zero1State:
         return super().init(self.template)
 
+    def _total(self) -> int:
+        return sum(l.size for l in jax.tree.leaves(self.template))
+
+    def make_state(self, state, mesh):
+        """Pytree-params TrainState -> packed ZeRO-3 TrainState laid out
+        on `mesh` (params + momentum dp-sharded) — the ONE copy of the
+        spec-tree/device_put wiring.
+
+        `state.opt_state` may be any fresh optimizer state (replaced by
+        zeroed flat momentum) or a PORTABLE `Zero1State` from
+        `export_state` (trimmed momentum, re-padded for THIS world size —
+        checkpoints stay readable across device counts)."""
+        from jax.sharding import NamedSharding
+
+        opt = state.opt_state
+        if isinstance(opt, Zero1State):
+            s = self._shard_size(self.template)
+            mom = jnp.pad(jnp.asarray(opt.momentum),
+                          (0, self.world * s - opt.momentum.size))
+            new_opt = Zero1State(opt.step, mom)
+        else:
+            new_opt = self.init()
+        packed = state.replace(params=self.pack(state.params),
+                               opt_state=new_opt)
+        spec = state.replace(step=P(), params=self.param_spec(),
+                             batch_stats=P(), opt_state=self.state_spec())
+        return jax.device_put(packed, jax.tree.map(
+            lambda sp: NamedSharding(mesh, sp), spec,
+            is_leaf=lambda sp: isinstance(sp, P)))
+
+    def export_state(self, state):
+        """Packed layout -> PORTABLE checkpoint layout: pytree params and
+        the flat momentum trimmed of the world-size pad, so the
+        checkpoint is readable at any device count (and its params by any
+        non-ZeRO-3 consumer)."""
+        opt: Zero1State = state.opt_state
+        return state.replace(
+            params=self.to_pytree(jnp.asarray(state.params)),
+            opt_state=Zero1State(opt.step,
+                                 jnp.asarray(opt.momentum)[:self._total()]))
+
+    def portable_template(self, state):
+        """Restore template in the portable layout (for
+        `CheckpointManager.restore` before `make_state`)."""
+        return state.replace(
+            params=jax.tree.map(lambda l: jnp.zeros(l.shape, l.dtype),
+                                self.template),
+            opt_state=Zero1State(jnp.zeros([], jnp.int32),
+                                 jnp.zeros((self._total(),), jnp.float32)))
+
     def update_fn(self, local_grads, state, axis_name: str, **quant_kw):
         """`state.params` is the (S,) flat shard; `local_grads` the local
         post-emulate grad pytree.  Returns (new shard, new opt state)."""
+        if not quant_kw:
+            raise ValueError(
+                "ZeRO-3 folds the collective into the update: build the "
+                "step with make_train_step(..., reduce_in_update=True)")
         opt: Zero1State = state.opt_state
         s = self._shard_size(self.template)
         rank = lax.axis_index(axis_name)

@@ -170,14 +170,16 @@ def main(argv=None) -> dict:
         zero = zero3_sgd(schedule, world=n_dev, template=state.params,
                          momentum=args.momentum, weight_decay=args.wd,
                          wd_mask=bn_and_bias_no_wd)
-        state = state.replace(params=zero.pack(state.params),
-                              opt_state=zero.init())
+        # state stays in the pytree layout until after restore; checkpoints
+        # are saved/restored in zero.export_state's PORTABLE layout so they
+        # survive world-size changes and stay readable without --zero3
 
     manager = CheckpointManager(os.path.abspath(args.checkpoint_dir),
                                 track_best=True)
     start_epoch = 0
     start_it = 0
-    restored = manager.restore(state)
+    restored = manager.restore(zero.portable_template(state)
+                               if args.zero3 else state)
     if restored is not None:                 # auto-resume (main.py:70-75)
         state = restored
         meta = manager.metadata()
@@ -219,11 +221,19 @@ def main(argv=None) -> dict:
     if zero is None:
         state = replicate(state, mesh)
         extra = {}
+    elif args.zero3:
+        # packs params, re-pads a restored portable momentum (or zeros a
+        # fresh one), and lays the whole state out dp-sharded
+        state = zero.make_state(state, mesh)
+        extra = {"update_fn": zero.update_fn,
+                 "opt_state_spec": zero.state_spec(),
+                 "params_spec": zero.param_spec(),
+                 "unpack_params": zero.unpack,
+                 "reduce_in_update": True}
     else:
         from jax.sharding import NamedSharding, PartitionSpec
         from cpd_tpu.train.state import TrainState as TS
-        p_spec = (zero.param_spec() if args.zero3 else PartitionSpec())
-        spec_tree = TS(step=PartitionSpec(), params=p_spec,
+        spec_tree = TS(step=PartitionSpec(), params=PartitionSpec(),
                        batch_stats=PartitionSpec(),
                        opt_state=zero.state_spec())
         state = jax.device_put(
@@ -232,17 +242,16 @@ def main(argv=None) -> dict:
                                     s, PartitionSpec)))
         extra = {"update_fn": zero.update_fn,
                  "opt_state_spec": zero.state_spec()}
-        if args.zero2 or args.zero3:
+        if args.zero2:
             extra["reduce_in_update"] = True
-        if args.zero3:
-            extra["params_spec"] = zero.param_spec()
-            extra["unpack_params"] = zero.unpack
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
         grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
         **extra)
+    # checkpoints always persist the portable layout under --zero3
+    to_ckpt = zero.export_state if args.zero3 else (lambda s: s)
     eval_step = make_eval_step(model, mesh)
     if args.zero3:
         # eval consumes the pytree layout; one jitted unflatten per
@@ -281,7 +290,8 @@ def main(argv=None) -> dict:
             for it in range(epoch_start, iters_per_epoch):
                 if guard.should_stop():      # collective when multi-host
                     preempt_save(
-                        manager, state.step, state, rank, what="step",
+                        manager, state.step, to_ckpt(state), rank,
+                        what="step",
                         metadata={"epoch": epoch, "resume_it": it,
                                   "iters_per_epoch": iters_per_epoch,
                                   "global_batch": global_batch,
@@ -352,7 +362,7 @@ def main(argv=None) -> dict:
             # .pth.tar, main.py:261-269) are matched in behavior — one
             # checkpoint per epoch, auto-resume — with the epoch recorded in
             # sidecar metadata instead of the filename.
-            manager.save(int(state.step), state,
+            manager.save(int(state.step), to_ckpt(state),
                          best_metric=100 * result["val_top1"],
                          metadata={"epoch": epoch,
                                    "iters_per_epoch": iters_per_epoch})

@@ -212,15 +212,7 @@ def test_zero3_matches_replicated_faithful():
 
     z = zero3_sgd(schedule, world=w, template=state.params, momentum=0.9,
                   weight_decay=1e-2)
-    z_state = TrainState(step=jnp.zeros([], jnp.int32),
-                         params=z.pack(state.params),
-                         batch_stats=state.batch_stats,
-                         opt_state=z.init())
-    spec_tree = TrainState(step=P(), params=z.param_spec(),
-                           batch_stats=P(), opt_state=z.state_spec())
-    z_state = jax.device_put(
-        z_state, jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
-                              is_leaf=lambda s: isinstance(s, P)))
+    z_state = z.make_state(state, mesh)
     z_step = make_train_step(model, None, mesh, donate=False,
                              update_fn=z.update_fn,
                              opt_state_spec=z.state_spec(),
@@ -249,6 +241,93 @@ def test_zero3_matches_replicated_faithful():
         shard_shapes = {tuple(sh.data.shape)
                         for sh in arr.addressable_shards}
         assert shard_shapes == {(s_per_rank,)}
+
+
+def test_zero3_checkpoint_portable_across_world(tmp_path):
+    """export_state's portable layout (pytree params, pad-trimmed
+    momentum) restores at a DIFFERENT world size and keeps training."""
+    from cpd_tpu.parallel.mesh import make_mesh
+    from cpd_tpu.parallel.zero import zero3_sgd
+    from cpd_tpu.train import CheckpointManager
+
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    model = tiny_cnn()
+    x, y = _data(16, seed=7)
+    tx = make_optimizer("sgd", schedule, momentum=0.9)
+    state0 = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+
+    def build(world, mesh):
+        z = zero3_sgd(schedule, world=world, template=state0.params,
+                      momentum=0.9)
+        step = make_train_step(model, None, mesh, donate=False,
+                               update_fn=z.update_fn,
+                               opt_state_spec=z.state_spec(),
+                               params_spec=z.param_spec(),
+                               unpack_params=z.unpack,
+                               reduce_in_update=True)
+        return z, step
+
+    mesh8 = data_parallel_mesh()
+    z8, step8 = build(8, mesh8)
+    s8 = z8.make_state(state0, mesh8)
+    s8, _ = step8(s8, x, y)
+
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    mgr.save(1, z8.export_state(s8), force=True)
+    mgr.wait()
+
+    mesh4 = make_mesh(dp=4, devices=jax.devices()[:4])
+    z4, step4 = build(4, mesh4)
+    restored = mgr.restore(z4.portable_template(state0))
+    mgr.close()
+    assert restored is not None
+    s4 = z4.make_state(restored, mesh4)
+    # params survive the world change exactly
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(l).ravel()
+                        for l in jax.tree.leaves(z4.to_pytree(
+                            jnp.asarray(np.asarray(s4.params))))]),
+        np.concatenate([np.asarray(l).ravel()
+                        for l in jax.tree.leaves(
+                            z8.to_pytree(jnp.asarray(np.asarray(
+                                s8.params))))]))
+    s4, m4 = step4(s4, x[:8], y[:8])
+    assert np.isfinite(float(m4["loss"]))
+
+
+def test_zero23_update_requires_reduce_in_update():
+    """Building zero2/3 updates without reduce_in_update must fail at
+    trace time, not silently double-count gradients by W."""
+    from cpd_tpu.parallel.zero import zero3_sgd
+
+    mesh = data_parallel_mesh()
+    model = tiny_cnn()
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    tx = make_optimizer("sgd", schedule)
+    x, y = _data(16)
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+
+    z2 = zero2_sgd(schedule, world=mesh.devices.size)
+    z2_state = TrainState(step=jnp.zeros([], jnp.int32),
+                          params=state.params,
+                          batch_stats=state.batch_stats,
+                          opt_state=z2.init(state.params))
+    bad2 = make_train_step(model, None, mesh, donate=False,
+                           update_fn=z2.update_fn,
+                           opt_state_spec=z2.state_spec())  # no flag
+    with pytest.raises(ValueError, match="reduce_in_update"):
+        bad2(z2_state, x, y)
+
+    z3 = zero3_sgd(schedule, world=mesh.devices.size,
+                   template=state.params)
+    z3_state = z3.make_state(state, mesh)
+    bad3 = make_train_step(model, None, mesh, donate=False,
+                           update_fn=z3.update_fn,
+                           opt_state_spec=z3.state_spec(),
+                           params_spec=z3.param_spec(),
+                           unpack_params=z3.unpack)        # no flag
+    with pytest.raises(ValueError, match="reduce_in_update"):
+        bad3(z3_state, x, y)
 
 
 def test_unpack_params_requires_update_fn():
