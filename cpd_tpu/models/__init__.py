@@ -7,7 +7,7 @@ idea for all families.
 
 from .resnet_cifar import ResNetCIFAR, resnet18_cifar
 from .davidnet import DavidNet, davidnet
-from .resnet import ResNet, resnet18, resnet50, resnet101
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101
 from .fcn import FCN, FCNHead, fcn_r50_d8
 from .tiny import TinyCNN, tiny_cnn
 from .transformer import TransformerLM, lm_param_specs, transformer_lm
@@ -21,6 +21,7 @@ _REGISTRY = {
     "resnet18_cifar": resnet18_cifar,
     "davidnet": davidnet,
     "resnet18": resnet18,
+    "resnet34": resnet34,
     "resnet50": resnet50,
     "resnet101": resnet101,
     "fcn_r50_d8": fcn_r50_d8,
@@ -40,7 +41,7 @@ def get_model(name: str, **kwargs):
 
 
 __all__ = ["ResNetCIFAR", "resnet18_cifar", "DavidNet", "davidnet",
-           "ResNet", "resnet18", "resnet50", "resnet101",
+           "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "FCN", "FCNHead", "fcn_r50_d8", "TinyCNN", "tiny_cnn",
            "TransformerLM", "transformer_lm", "lm_param_specs",
            "PipelinedLM", "pipelined_lm", "pp_param_specs",

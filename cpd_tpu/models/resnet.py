@@ -176,3 +176,9 @@ def resnet101(num_classes: int = 1000, dtype=jnp.float32, **kw) -> ResNet:
 def resnet18(num_classes: int = 1000, dtype=jnp.float32, **kw) -> ResNet:
     return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlockV1,
                   num_classes=num_classes, dtype=dtype, **kw)
+
+
+def resnet34(num_classes: int = 1000, dtype=jnp.float32, **kw) -> ResNet:
+    """torchvision.models.resnet34 equivalent (BasicBlock, 3-4-6-3)."""
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlockV1,
+                  num_classes=num_classes, dtype=dtype, **kw)

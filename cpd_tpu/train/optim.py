@@ -226,10 +226,16 @@ def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,
                    opt_man: int = 23, opt_kahan: bool = False,
                    ) -> optax.GradientTransformation:
     """Registry used by trainer configs:
-    'sgd' | 'nesterov' | 'lars' | 'quant_sgd'.
+    'sgd' | 'nesterov' | 'lars' | 'quant_sgd' | 'adamw'.
 
     opt_exp/opt_man/opt_kahan apply to 'quant_sgd' (eXmY momentum
-    buffer; the optimizer-state analog of --grad_exp/--grad_man)."""
+    buffer; the optimizer-state analog of --grad_exp/--grad_man).
+    'adamw' (no reference counterpart — the transformer-era default,
+    elementwise so shard-local-safe under tp) reuses `momentum` as b1 and
+    applies `wd_mask` to its decoupled decay."""
+    if name == "adamw":
+        return optax.adamw(schedule, b1=momentum, weight_decay=weight_decay,
+                           mask=wd_mask)
     if name == "sgd":
         return sgd(schedule, momentum, weight_decay, nesterov=nesterov,
                    wd_mask=wd_mask)

@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequences per dp rank per micro-step")
     p.add_argument("--max-iter", default=200, type=int)
     p.add_argument("--base-lr", default=0.01, type=float)
+    p.add_argument("--optimizer", default="sgd",
+                   choices=["sgd", "nesterov", "adamw"],
+                   help="elementwise optimizers only (shard-local update "
+                        "under tp; LARS is guarded off in train/lm.py)")
     p.add_argument("--warmup-iters", default=20, type=int)
     p.add_argument("--print-freq", default=10, type=int)
     p.add_argument("--save-path", default="lm_ckpt")
@@ -127,7 +131,7 @@ def main(argv=None) -> dict:
                     n_layers=args.n_layers, n_heads=args.n_heads)
     schedule = warmup_step_decay(args.base_lr, args.warmup_iters,
                                  [args.max_iter * 2], warmup_from=0.0)
-    tx = make_optimizer("sgd", schedule, momentum=0.9)
+    tx = make_optimizer(args.optimizer, schedule, momentum=0.9)
 
     ds = SyntheticText(n=4096, seq_len=args.seq_len,
                        vocab_size=args.vocab_size)

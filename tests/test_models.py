@@ -69,6 +69,19 @@ def test_resnet50_shapes_and_params():
     assert 25_400_000 < n < 25_700_000, n
 
 
+def test_resnet34_param_count():
+    """torchvision resnet34 parity: 21,797,672 params (eval_shape, no
+    compile)."""
+    from cpd_tpu.models import resnet34
+
+    model = resnet34()
+    x = jax.ShapeDtypeStruct((1, 32, 32, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda inp: model.init(jax.random.PRNGKey(0), inp, train=False), x)
+    n = sum(p.size for p in jax.tree.leaves(variables["params"]))
+    assert 21_700_000 < n < 21_900_000, n
+
+
 def test_fcn_r50_d8_default_config_shapes():
     """mmseg fcn_r50-d8 parity of the DEFAULT config via eval_shape (no
     compile): R50 stage sizes, 2048-ch stage-4 into a 512-ch decode head,

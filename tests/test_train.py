@@ -183,6 +183,22 @@ def test_make_optimizer_quant_sgd():
     assert np.isfinite(np.asarray(updates["w"])).all()
 
 
+def test_make_optimizer_adamw():
+    """adamw registry entry: optax.adamw with momentum as b1 and the
+    wd_mask routed to the decoupled decay."""
+    mask = lambda p: {"w": True, "b": False}                   # noqa: E731
+    tx = make_optimizer("adamw", lambda s: jnp.float32(0.1),
+                        momentum=0.9, weight_decay=0.5, wd_mask=mask)
+    params = {"w": jnp.ones(3), "b": jnp.ones(3)}
+    state = tx.init(params)
+    # zero grads: any update comes solely from weight decay — masked off
+    # for "b", nonzero for "w"
+    updates, state = tx.update({"w": jnp.zeros(3), "b": jnp.zeros(3)},
+                               state, params)
+    assert np.all(np.asarray(updates["w"]) != 0.0)
+    assert np.all(np.asarray(updates["b"]) == 0.0)
+
+
 def test_seg_eval_step_matches_numpy_oracle():
     """make_seg_eval_step's streamed sums (loss, pixel acc, per-class
     inter/union for mIoU) vs a direct numpy computation, with ignored
