@@ -34,6 +34,17 @@ __all__ = ["generate"]
 
 
 @functools.lru_cache(maxsize=32)
+def _cache_shapes(decoder, b: int, t_max: int):
+    """Shapes/dtypes of the decoder's cache collection, via eval_shape —
+    memoized so repeat generate() calls skip the host-side init retrace
+    (the arrays themselves are rebuilt per call; their contents are the
+    defined zero state)."""
+    return jax.eval_shape(
+        lambda t: decoder.init(jax.random.PRNGKey(0), t, train=False),
+        jax.ShapeDtypeStruct((b, t_max), jnp.int32))["cache"]
+
+
+@functools.lru_cache(maxsize=32)
 def _make_run(decoder, max_new_tokens: int, temperature: float):
     """Build the jitted prefill+scan program once per (module, length,
     temperature) — flax modules hash by their field values, so repeat
@@ -95,11 +106,9 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
                           tp_size=1)
     # allocate the cache at full length (Block._cached_attention takes its
     # cache shape from the init call) WITHOUT running the forward:
-    # eval_shape gives the cache pytree's shapes/dtypes for free, and the
-    # initial cache contents are defined zeros (position included)
-    shapes = jax.eval_shape(
-        lambda t: decoder.init(jax.random.PRNGKey(0), t, train=False),
-        jax.ShapeDtypeStruct((b, t_max), jnp.int32))["cache"]
+    # eval_shape (memoized) gives the cache pytree's shapes/dtypes for
+    # free, and the initial cache contents are defined zeros
+    shapes = _cache_shapes(decoder, b, t_max)
     cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
     # carry needs an array either way; greedy sampling ignores it
     rng = jax.random.PRNGKey(0) if rng is None else rng

@@ -163,6 +163,10 @@ class TransformerLM(nn.Module):
     tp_size: int = 1
     sp_mode: str = "ring"
     decode: bool = False
+    remat: bool = False     # jax.checkpoint each block: activations are
+                            # recomputed in backward instead of stored —
+                            # O(sqrt) activation memory for deep stacks,
+                            # the standard TPU HBM<->FLOPs trade
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -192,13 +196,18 @@ class TransformerLM(nn.Module):
                        name="embed")
         x = emb(tokens)
         head_dim = self.d_model // self.n_heads
+        # nn.remat wraps the module class so flax keeps param/cache
+        # bookkeeping intact under jax.checkpoint; decode is cache-mutating
+        # (no backward pass), so remat is train-path only
+        block_cls = (nn.remat(Block) if self.remat and not self.decode
+                     else Block)
         for i in range(self.n_layers):
-            x = Block(head_dim=head_dim, d_ff=self.d_ff,
-                      d_model=self.d_model, tp_axis=self.tp_axis,
-                      sp_axis=self.sp_axis, tp_size=self.tp_size,
-                      dtype=self.dtype, sp_mode=self.sp_mode,
-                      decode=self.decode,
-                      name=f"block{i}")(x, positions)
+            x = block_cls(head_dim=head_dim, d_ff=self.d_ff,
+                          d_model=self.d_model, tp_axis=self.tp_axis,
+                          sp_axis=self.sp_axis, tp_size=self.tp_size,
+                          dtype=self.dtype, sp_mode=self.sp_mode,
+                          decode=self.decode,
+                          name=f"block{i}")(x, positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = emb.attend(x.astype(self.param_dtype))  # tied head
         return logits.astype(jnp.float32)

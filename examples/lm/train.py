@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["sgd", "nesterov", "adamw"],
                    help="elementwise optimizers only (shard-local update "
                         "under tp; LARS is guarded off in train/lm.py)")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each transformer block: recompute "
+                        "activations in backward instead of storing them "
+                        "(the HBM<->FLOPs trade for deep/long-context "
+                        "runs)")
     p.add_argument("--warmup-iters", default=20, type=int)
     p.add_argument("--print-freq", default=10, type=int)
     p.add_argument("--save-path", default="lm_ckpt")
@@ -112,6 +117,9 @@ def main(argv=None) -> dict:
     if (args.pp > 1 or args.moe) and args.sample > 0:
         raise ValueError("--sample needs the default dp/sp/tp path "
                          "(pp/moe modules have no decode mode)")
+    if (args.pp > 1 or args.moe) and args.remat:
+        raise ValueError("--remat is wired to the default dp/sp/tp path "
+                         "only (pipelined/MoE modules do not take it)")
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp,
                      ep=args.ep if args.moe else 1)
     dp = mesh.shape["dp"]
@@ -182,7 +190,7 @@ def main(argv=None) -> dict:
         model = transformer_lm(tp_axis="tp" if args.tp > 1 else None,
                                sp_axis="sp" if args.sp > 1 else None,
                                tp_size=args.tp, sp_mode=args.sp_mode,
-                               **model_kw)
+                               remat=args.remat, **model_kw)
         init_model = transformer_lm(**model_kw)
         state = create_train_state(init_model, tx, sample,
                                    jax.random.PRNGKey(0))

@@ -13,6 +13,7 @@ axon tunnel):
   3. full train step, fp32 grads — +backward +SGD
   4. train step, APS e5m2 fast   — +quantize/psum pipeline
   5. train step, APS e5m2 faithful — +gather+ordered-scan collective
+  6. LM KV-cache decode (--no-decode to skip) — generation tok/s
 
 Prints one line per phase; the deltas localize any slowdown.
 """
@@ -54,6 +55,8 @@ def main() -> int:
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--windows", type=int, default=4)
     p.add_argument("--per", type=int, default=5)
+    p.add_argument("--no-decode", action="store_true",
+                   help="skip the LM decode phase")
     args = p.parse_args()
 
     import numpy as np
@@ -149,6 +152,35 @@ def main() -> int:
                 for _ in range(3):
                     sync_scalar(one_step())
             print(f"trace -> {args.profile_dir}", flush=True)
+
+    # --- 6. LM KV-cache decode throughput ---
+    if not args.no_decode:
+        from cpd_tpu.models import generate, transformer_lm
+
+        small = dev.platform != "tpu"
+        lm_kw = (dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                      d_ff=128) if small else
+                 dict(vocab_size=32000, d_model=512, n_layers=8,
+                      n_heads=8, d_ff=2048))
+        b_dec, t_p, t_new = (2, 16, 16) if small else (8, 64, 64)
+        lm = transformer_lm(**lm_kw, dtype=jnp.bfloat16)
+        prompt = jnp.asarray(rng.randint(
+            0, lm_kw["vocab_size"], (b_dec, t_p)).astype(np.int32))
+        lm_params = lm.init(jax.random.PRNGKey(3), prompt)["params"]
+
+        def dec():
+            return generate(lm, lm_params, prompt, max_new_tokens=t_new)
+
+        t0 = time.perf_counter()
+        sync_scalar(dec())
+        print(f"decode compile+run: {time.perf_counter()-t0:.1f}s",
+              flush=True)
+        best, med = win(dec, sync_scalar)
+        n_tok = b_dec * t_new
+        print(f"decode {lm_kw['d_model']}d x {lm_kw['n_layers']}L "
+              f"bs{b_dec} prefill{t_p}+gen{t_new}: best "
+              f"{n_tok/best:.0f} tok/s ({best*1e3:.1f} ms), median "
+              f"{n_tok/med:.0f}", flush=True)
     return 0
 
 
