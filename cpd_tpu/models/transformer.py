@@ -69,6 +69,8 @@ class Block(nn.Module):
                                 # dense pair (e.g. MoE experts); a custom
                                 # mlp owns its own collectives — Block's tp
                                 # psum applies only to the built-in pair
+    scan_pair: bool = False     # return (x, None) — the (carry, out)
+                                # shape nn.scan's body contract requires
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
@@ -141,13 +143,15 @@ class Block(nn.Module):
         # ---- mlp ----
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         if self.mlp is not None:
-            return x + self.mlp()(h)
-        h = nn.Dense(self.d_ff // self.tp_size, use_bias=False,
-                     dtype=self.dtype, name="wi")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
-                     name="wo_mlp")(h)
-        return x + self._psum_tp(h)
+            out = x + self.mlp()(h)
+        else:
+            h = nn.Dense(self.d_ff // self.tp_size, use_bias=False,
+                         dtype=self.dtype, name="wi")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="wo_mlp")(h)
+            out = x + self._psum_tp(h)
+        return (out, None) if self.scan_pair else out
 
 
 class TransformerLM(nn.Module):
@@ -167,6 +171,12 @@ class TransformerLM(nn.Module):
                             # recomputed in backward instead of stored —
                             # O(sqrt) activation memory for deep stacks,
                             # the standard TPU HBM<->FLOPs trade
+    scan_layers: bool = False   # ONE nn.scan'd block instead of a Python
+                                # loop: layer body traced/compiled once
+                                # regardless of depth; params gain a
+                                # leading (n_layers,) axis (a different
+                                # checkpoint layout — lm_param_specs is
+                                # rank-aware for it)
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -198,16 +208,33 @@ class TransformerLM(nn.Module):
         head_dim = self.d_model // self.n_heads
         # nn.remat wraps the module class so flax keeps param/cache
         # bookkeeping intact under jax.checkpoint; decode is cache-mutating
-        # (no backward pass), so remat is train-path only
-        block_cls = (nn.remat(Block) if self.remat and not self.decode
-                     else Block)
-        for i in range(self.n_layers):
-            x = block_cls(head_dim=head_dim, d_ff=self.d_ff,
-                          d_model=self.d_model, tp_axis=self.tp_axis,
-                          sp_axis=self.sp_axis, tp_size=self.tp_size,
-                          dtype=self.dtype, sp_mode=self.sp_mode,
-                          decode=self.decode,
-                          name=f"block{i}")(x, positions)
+        # (no backward pass), so remat is train-path only.  Under nn.scan
+        # the scan itself provides the staging checkpoint needs, so CSE
+        # barriers are unnecessary (jax.checkpoint docs: prevent_cse=False
+        # inside scan) — keeping them would wedge optimization-barrier ops
+        # into the one scanned layer body.
+        if self.remat and not self.decode:
+            block_cls = nn.remat(Block, prevent_cse=not self.scan_layers)
+        else:
+            block_cls = Block
+        block_kw = dict(head_dim=head_dim, d_ff=self.d_ff,
+                        d_model=self.d_model, tp_axis=self.tp_axis,
+                        sp_axis=self.sp_axis, tp_size=self.tp_size,
+                        dtype=self.dtype, sp_mode=self.sp_mode,
+                        decode=self.decode)
+        if self.scan_layers:
+            if self.decode:
+                raise ValueError("scan_layers does not compose with "
+                                 "decode (per-layer caches need the "
+                                 "unrolled blocks)")
+            scan = nn.scan(block_cls, variable_axes={"params": 0},
+                           split_rngs={"params": True},
+                           in_axes=nn.broadcast, length=self.n_layers)
+            x, _ = scan(**block_kw, scan_pair=True, name="blocks")(
+                x, positions)
+        else:
+            for i in range(self.n_layers):
+                x = block_cls(**block_kw, name=f"block{i}")(x, positions)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = emb.attend(x.astype(self.param_dtype))  # tied head
         return logits.astype(jnp.float32)
@@ -239,16 +266,20 @@ def megatron_shard_kind(names) -> Optional[str]:
 
 def lm_param_specs(params, tp_axis: str = "tp"):
     """PartitionSpec pytree for the Megatron sharding rules: qkv and wi
-    kernels column-sharded (out dim on tp), wo kernels row-sharded (in dim
-    on tp), everything else replicated."""
+    kernels column-sharded (out dim on tp), wo kernels row-sharded (in
+    dim on tp), everything else replicated.  Rank-aware so the rules
+    apply to both layouts — per-layer (in, out) kernels and the
+    scan_layers stacked (n_layers, in, out) kernels (leading layer axis
+    stays unsharded)."""
 
     def spec(path, leaf):
         kind = megatron_shard_kind([str(getattr(k, "key", k))
                                     for k in path])
+        nd = jnp.ndim(leaf)
         if kind == "col":
-            return P(None, tp_axis)
+            return P(*([None] * (nd - 1)), tp_axis)
         if kind == "row":
-            return P(tp_axis, None)
+            return P(*([None] * (nd - 2)), tp_axis, None)
         return P()
 
     return jax.tree_util.tree_map_with_path(spec, params)

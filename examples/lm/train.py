@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "activations in backward instead of storing them "
                         "(the HBM<->FLOPs trade for deep/long-context "
                         "runs)")
+    p.add_argument("--scan-layers", action="store_true",
+                   help="nn.scan the block stack: compile the layer body "
+                        "once regardless of depth (params gain a leading "
+                        "layer axis; checkpoint layout differs from the "
+                        "unrolled form)")
     p.add_argument("--warmup-iters", default=20, type=int)
     p.add_argument("--print-freq", default=10, type=int)
     p.add_argument("--save-path", default="lm_ckpt")
@@ -117,9 +122,13 @@ def main(argv=None) -> dict:
     if (args.pp > 1 or args.moe) and args.sample > 0:
         raise ValueError("--sample needs the default dp/sp/tp path "
                          "(pp/moe modules have no decode mode)")
-    if (args.pp > 1 or args.moe) and args.remat:
-        raise ValueError("--remat is wired to the default dp/sp/tp path "
-                         "only (pipelined/MoE modules do not take it)")
+    if (args.pp > 1 or args.moe) and (args.remat or args.scan_layers):
+        raise ValueError("--remat/--scan-layers are wired to the default "
+                         "dp/sp/tp path only (pipelined/MoE modules do "
+                         "not take them)")
+    if args.scan_layers and args.sample > 0:
+        raise ValueError("--sample (KV-cache decode) does not compose "
+                         "with --scan-layers")
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp, pp=args.pp,
                      ep=args.ep if args.moe else 1)
     dp = mesh.shape["dp"]
@@ -190,8 +199,11 @@ def main(argv=None) -> dict:
         model = transformer_lm(tp_axis="tp" if args.tp > 1 else None,
                                sp_axis="sp" if args.sp > 1 else None,
                                tp_size=args.tp, sp_mode=args.sp_mode,
-                               remat=args.remat, **model_kw)
-        init_model = transformer_lm(**model_kw)
+                               remat=args.remat,
+                               scan_layers=args.scan_layers, **model_kw)
+        # init model: global shapes, but the SAME param-tree layout
+        init_model = transformer_lm(scan_layers=args.scan_layers,
+                                    **model_kw)
         state = create_train_state(init_model, tx, sample,
                                    jax.random.PRNGKey(0))
         step = make_lm_train_step(model, tx, mesh,
