@@ -24,7 +24,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
+import shutil
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -64,6 +66,9 @@ def _run_tagged(tagged_flags, iters: int, save_root: str, batch_size: int,
     out = {}
     for tag, extra in tagged_flags:
         save = os.path.join(save_root, tag)
+        # from-scratch experiment: a stale checkpoint from a previous run
+        # would auto-resume at max_iter and train nothing
+        shutil.rmtree(save, ignore_errors=True)
         argv = ["--arch", arch, "--batch_size", str(batch_size),
                 "--max-iter", str(iters), "--val_freq", str(iters),
                 "--print_freq", "100000" if quiet else "50",
@@ -112,6 +117,72 @@ def run_opt_experiment(iters: int, save_root: str, batch_size: int = 16,
     """Train every optimizer-precision config; {tag: {"prec1": ...}}."""
     return _run_tagged(list(configs), iters, save_root, batch_size,
                        emulate_node, peak_lr, data_root, arch, mode, quiet)
+
+
+# Third arm (capability beyond the reference): the transformer LM under
+# the same APS claim — at an aggressive gradient format the un-scaled
+# quantized all-reduce stalls training, APS recovers it.  Loss (lower
+# better) replaces Prec@1 as the metric.
+LM_CONFIGS = [
+    ("lm_fp32", 8, 23, False),
+    ("lm_e3m4_noaps", 3, 4, False),
+    ("lm_e3m4_aps", 3, 4, True),
+]
+
+
+def run_lm_experiment(iters: int, save_root: str, configs=LM_CONFIGS,
+                      quiet: bool = True) -> dict:
+    """Train each gradient-precision config through the LM CLI on the
+    8-device mesh; returns {tag: {"loss": float, "accuracy": float}}."""
+    from lm.train import main
+
+    out = {}
+    for tag, ge, gm, aps in configs:
+        save = os.path.join(save_root, tag)
+        shutil.rmtree(save, ignore_errors=True)   # see _run_tagged
+        argv = ["--seq-len", "32", "--d-model", "32", "--n-layers", "2",
+                "--n-heads", "4", "--vocab-size", "64", "--batch-size",
+                "2", "--max-iter", str(iters), "--base-lr", "0.05",
+                "--print-freq", "100000" if quiet else "50",
+                "--val-freq", str(iters), "--mode", "fast",
+                "--grad_exp", str(ge), "--grad_man", str(gm),
+                "--save-path", save]
+        if aps:
+            argv.append("--use_APS")
+        res = main(argv)
+        out[tag] = {"loss": res["loss"], "accuracy": res["accuracy"],
+                    "diverged": bool(res.get("diverged"))}
+        print(f"== {tag}: loss {res['loss']:.4f} "
+              f"acc {100 * res['accuracy']:.1f}", flush=True)
+    return out
+
+
+def check_lm_ordering(results: dict, margin: float = 0.5,
+                      recover: float = 0.3) -> list[str]:
+    """APS recovers the LM loss the naive low-precision reduce loses.
+
+    A diverged (or NaN) no-APS arm counts as infinitely bad — divergence
+    at the aggressive format is the strongest form of the claim's
+    premise, not a harness failure.  A diverged APS or fp32 arm IS a
+    failure."""
+    def loss_of(tag, bad_is_inf):
+        rec = results[tag]
+        v = rec["loss"]
+        if rec.get("diverged") or not math.isfinite(v):
+            return float("inf") if bad_is_inf else float("nan")
+        return v
+
+    fp32 = loss_of("lm_fp32", bad_is_inf=False)
+    noaps = loss_of("lm_e3m4_noaps", bad_is_inf=True)
+    aps = loss_of("lm_e3m4_aps", bad_is_inf=False)
+    ok_gain = aps <= noaps - margin
+    ok_recover = aps <= fp32 + recover
+    return [
+        f"lm e3m4: aps loss {aps:.3f} <= noaps {noaps:.3f} - {margin} -> "
+        f"{'OK' if ok_gain else 'VIOLATED'}",
+        f"lm e3m4: aps loss {aps:.3f} <= fp32 {fp32:.3f} + {recover} -> "
+        f"{'OK' if ok_recover else 'VIOLATED'}",
+    ]
 
 
 def check_opt_ordering(results: dict, margin: float = 1.0,
@@ -183,6 +254,12 @@ def main(argv=None) -> int:
                    help="APS-arm min accuracy gain (aps vs noaps)")
     p.add_argument("--opt-margin", type=float, default=1.0,
                    help="optimizer-arm min gain (kahan vs naive)")
+    p.add_argument("--lm-iters", type=int, default=150,
+                   help="LM-arm iterations (separation shows by ~150)")
+    p.add_argument("--lm-margin", type=float, default=0.5,
+                   help="LM-arm min loss gain (aps vs noaps)")
+    p.add_argument("--lm-recover", type=float, default=0.3,
+                   help="LM-arm max loss gap to fp32")
     args = p.parse_args(argv)
 
     results = run_experiment(args.iters, args.save_root,
@@ -194,13 +271,20 @@ def main(argv=None) -> int:
     opt_checks = check_opt_ordering(opt_results,
                                     margin=args.opt_margin)
     checks += opt_checks
+    lm_results = run_lm_experiment(args.lm_iters,
+                                   os.path.join(args.save_root, "lm"))
+    checks += check_lm_ordering(lm_results, margin=args.lm_margin,
+                                recover=args.lm_recover)
     os.makedirs(args.out, exist_ok=True)
     payload = {
         "iters": args.iters,
+        "lm_iters": args.lm_iters,
         "workload": "CIFAR-10-shaped, tiny CNN, dp=8 x emulate_node=2 "
-                    "(16-rank emulated cluster), faithful-precision wire",
+                    "(16-rank emulated cluster), faithful-precision wire; "
+                    "LM arm: 2L transformer, dp=8, Markov token stream",
         "prec1": {t: r["prec1"] for t, r in results.items()},
         "opt_prec1": {t: r["prec1"] for t, r in opt_results.items()},
+        "lm_loss": {t: r["loss"] for t, r in lm_results.items()},
         "checks": checks,
     }
     with open(os.path.join(args.out, "results.json"), "w") as f:

@@ -31,3 +31,18 @@ def test_aps_recovers_low_precision_accuracy(tmp_path):
     # the ordering the whole reference artifact exists to demonstrate
     assert aps >= noaps + 10.0, (noaps, aps)
     assert aps >= 60.0, aps        # APS actually trains, not just "less bad"
+
+
+def test_aps_recovers_lm_loss(tmp_path):
+    """The LM arm of the same claim: at e3m4 gradients the un-scaled
+    reduce stalls the transformer; APS restores training (loss)."""
+    import aps_golden
+
+    configs = [("lm_e3m4_noaps", 3, 4, False), ("lm_e3m4_aps", 3, 4, True)]
+    results = aps_golden.run_lm_experiment(iters=120,
+                                           save_root=str(tmp_path),
+                                           configs=configs)
+    noaps = results["lm_e3m4_noaps"]["loss"]
+    aps = results["lm_e3m4_aps"]["loss"]
+    assert aps <= noaps - 0.5, (noaps, aps)
+    assert aps <= 3.5, aps         # actually learning the Markov chain
