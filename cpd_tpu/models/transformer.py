@@ -32,8 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import (local_attention, ring_attention,
-                             ulysses_attention)
+from ..ops.attention import (grouped_query_attention, local_attention,
+                             ring_attention, ulysses_attention)
 
 __all__ = ["TransformerLM", "transformer_lm", "lm_param_specs"]
 
@@ -71,9 +71,21 @@ class Block(nn.Module):
                                 # psum applies only to the built-in pair
     scan_pair: bool = False     # return (x, None) — the (carry, out)
                                 # shape nn.scan's body contract requires
+    n_kv_heads: Optional[int] = None    # GQA: fewer K/V heads than query
+                                        # heads (None = MHA, wqkv layout)
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
+
+    @staticmethod
+    def _expand_kv(k, v, n_q_heads: int):
+        """Grouped-query expansion: repeat each K/V head over its query
+        group (kv head j serves q heads [j*rep, (j+1)*rep) — consistent
+        under tp column slicing since both head counts divide by tp)."""
+        rep = n_q_heads // k.shape[-2]
+        if rep == 1:
+            return k, v
+        return (jnp.repeat(k, rep, axis=-2), jnp.repeat(v, rep, axis=-2))
 
     def _cached_attention(self, q, k, v, positions):
         """KV-cache attention (decode=True).
@@ -91,19 +103,23 @@ class Block(nn.Module):
         cache_v = self.variable("cache", "cached_v", jnp.zeros, v.shape,
                                 v.dtype)
         if not is_init:
-            # init trace: caches get their (B, T_max, H, D) zero shapes;
-            # run plain causal attention so init outputs are well-formed
-            return local_attention(q, k, v, causal=True)
+            # init trace: caches get their (B, T_max, H_kv, D) zero
+            # shapes; run plain causal attention so init outputs are
+            # well-formed (grouped handles GQA head counts)
+            return grouped_query_attention(q, k, v, causal=True)
         start = positions[0]
         cache_k.value = lax.dynamic_update_slice(
             cache_k.value, k.astype(cache_k.value.dtype), (0, start, 0, 0))
         cache_v.value = lax.dynamic_update_slice(
             cache_v.value, v.astype(cache_v.value.dtype), (0, start, 0, 0))
         # keys sit at global positions 0..T_max-1, queries at `positions`;
-        # local_attention's q_offset mask (q_off+i >= ki) is exactly
-        # key_pos <= query_pos, and also hides the unwritten cache tail
-        out = local_attention(q, cache_k.value, cache_v.value, causal=True,
-                              q_offset=start)
+        # the q_offset mask (q_off+i >= ki) is exactly key_pos <=
+        # query_pos, and also hides the unwritten cache tail.  GQA caches
+        # the UNEXPANDED kv heads and the grouped kernel contracts
+        # against them directly — no rep× expansion is ever materialized
+        # (that would negate the cache-memory win; see ops/attention.py).
+        out = grouped_query_attention(q, cache_k.value, cache_v.value,
+                                      causal=True, q_offset=start)
         # capacity guard: past the allocated length dynamic_update_slice
         # silently clamps the write (corrupting the last slot), so poison
         # the output with NaN to fail loudly instead
@@ -114,14 +130,34 @@ class Block(nn.Module):
     def __call__(self, x, positions):
         # ---- attention ----
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        qkv = nn.Dense(3 * self.d_model // self.tp_size, use_bias=False,
-                       dtype=self.dtype, name="wqkv")(h)
-        # local head count from the runtime shape (tp slices the out dim).
-        # Layout is HEAD-major — (n_heads, 3, head_dim) in the feature dim —
-        # so a tp column-slice keeps whole heads with their q,k,v together.
-        n_local = qkv.shape[-1] // (3 * self.head_dim)
-        qkv = qkv.reshape(*qkv.shape[:-1], n_local, 3, self.head_dim)
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        if self.n_kv_heads is None:
+            # MHA: fused projection.  Layout is HEAD-major — (n_heads, 3,
+            # head_dim) in the feature dim — so a tp column-slice keeps
+            # whole heads with their q,k,v together; local head count
+            # comes from the runtime kernel shape.
+            qkv = nn.Dense(3 * self.d_model // self.tp_size,
+                           use_bias=False, dtype=self.dtype,
+                           name="wqkv")(h)
+            n_local = qkv.shape[-1] // (3 * self.head_dim)
+            qkv = qkv.reshape(*qkv.shape[:-1], n_local, 3, self.head_dim)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        else:
+            # GQA: separate q and kv projections (fewer kv heads), both
+            # head-major so tp column slices keep whole heads
+            qp = nn.Dense(self.d_model // self.tp_size, use_bias=False,
+                          dtype=self.dtype, name="wq")(h)
+            kvp = nn.Dense(
+                2 * self.n_kv_heads * self.head_dim // self.tp_size,
+                use_bias=False, dtype=self.dtype, name="wkv")(h)
+            n_local = qp.shape[-1] // self.head_dim
+            nkv_local = kvp.shape[-1] // (2 * self.head_dim)
+            if n_local % nkv_local:
+                raise ValueError(
+                    f"n_heads ({n_local} local) must be a multiple of "
+                    f"n_kv_heads ({nkv_local} local)")
+            q = qp.reshape(*qp.shape[:-1], n_local, self.head_dim)
+            kvp = kvp.reshape(*kvp.shape[:-1], nkv_local, 2, self.head_dim)
+            k, v = kvp[..., 0, :], kvp[..., 1, :]
         q = _rope(q, positions)
         k = _rope(k, positions)
         if self.sp_mode not in ("ring", "ulysses"):
@@ -129,12 +165,19 @@ class Block(nn.Module):
                              "expected 'ring' or 'ulysses'")
         if self.decode:
             attn = self._cached_attention(q, k, v, positions)
-        elif self.sp_axis and self.sp_mode == "ulysses":
-            attn = ulysses_attention(q, k, v, self.sp_axis, causal=True)
         elif self.sp_axis:
-            attn = ring_attention(q, k, v, self.sp_axis, causal=True)
+            # sequence-parallel paths take head-count-uniform kv: GQA
+            # expands over query groups BEFORE the collective, shipping
+            # rep x copies over ICI — the simplicity trade documented in
+            # ops/attention.py's module docstring
+            k, v = self._expand_kv(k, v, q.shape[-2])
+            if self.sp_mode == "ulysses":
+                attn = ulysses_attention(q, k, v, self.sp_axis,
+                                         causal=True)
+            else:
+                attn = ring_attention(q, k, v, self.sp_axis, causal=True)
         else:
-            attn = local_attention(q, k, v, causal=True)
+            attn = grouped_query_attention(q, k, v, causal=True)
         attn = attn.reshape(*attn.shape[:-2], n_local * self.head_dim)
         proj = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                         name="wo")(attn)
@@ -161,6 +204,7 @@ class TransformerLM(nn.Module):
     d_model: int = 512
     n_layers: int = 4
     n_heads: int = 8
+    n_kv_heads: Optional[int] = None   # GQA; None = MHA
     d_ff: int = 2048
     tp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
@@ -221,7 +265,7 @@ class TransformerLM(nn.Module):
                         d_model=self.d_model, tp_axis=self.tp_axis,
                         sp_axis=self.sp_axis, tp_size=self.tp_size,
                         dtype=self.dtype, sp_mode=self.sp_mode,
-                        decode=self.decode)
+                        decode=self.decode, n_kv_heads=self.n_kv_heads)
         if self.scan_layers:
             if self.decode:
                 raise ValueError("scan_layers does not compose with "
@@ -257,7 +301,7 @@ def megatron_shard_kind(names) -> Optional[str]:
     "wo" must not silently get row-sharded.  Shared by lm_param_specs and
     models/pipeline_lm.pp_param_specs."""
     if len(names) >= 2 and names[-1] == "kernel":
-        if names[-2] in ("wqkv", "wi"):
+        if names[-2] in ("wqkv", "wq", "wkv", "wi"):
             return "col"
         if names[-2] in ("wo", "wo_mlp"):
             return "row"

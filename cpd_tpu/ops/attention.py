@@ -21,6 +21,16 @@ its workloads are CNNs), so this is new capability, built TPU-first:
   O((T_global)^2) score memory per device — the right trade when W is
   modest and heads are plentiful; composable with `impl="flash"` to drop
   the score-matrix memory.
+* `grouped_query_attention` — GQA on UNEXPANDED K/V (H_kv heads serving
+  H = rep*H_kv query heads, kv head j ↔ q heads [j*rep, (j+1)*rep)):
+  the query head axis is reshaped to (H_kv, rep) and contracted against
+  the small K/V directly, so neither HBM nor the score computation ever
+  materializes the repeated copies — this is what makes the GQA KV-cache
+  memory win real at decode time.  The sequence-parallel paths (ring /
+  ulysses) instead receive kv expanded *before* the collective: shipping
+  rep× copies over ICI is a deliberate simplicity trade (the collectives
+  stay head-count-uniform); push the grouping inside them if GQA at
+  large sp ever becomes the bottleneck.
 
 Causality with a sharded sequence: rank r holds tokens
 [r*T_local, (r+1)*T_local); at ring step s it receives the K/V block of
@@ -36,7 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["local_attention", "ring_attention", "ulysses_attention"]
+__all__ = ["local_attention", "ring_attention", "ulysses_attention",
+           "grouped_query_attention"]
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
                   # when a full row is masked (the all-masked ring step)
@@ -148,6 +159,37 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         jnp.arange(axis_size))
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
+                            v: jnp.ndarray, causal: bool = True,
+                            q_offset=0) -> jnp.ndarray:
+    """GQA softmax attention without materializing the K/V expansion.
+
+    q: (B, Tq, H, D) with H = rep * H_kv; k, v: (B, Tk, H_kv, D).
+    Numerically identical to expanding K/V over each query group and
+    calling `local_attention` (fp32 logits/softmax, same mask), tested
+    bitwise-close against that oracle.  rep == 1 falls through to
+    `local_attention` itself.
+    """
+    b, tq, h, d = q.shape
+    hkv = k.shape[2]
+    if h == hkv:
+        return local_attention(q, k, v, causal=causal, q_offset=q_offset)
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    rep = h // hkv
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    qg = q.reshape(b, tq, hkv, rep, d)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = _causal_mask(tq, k.shape[1], q_offset, 0)
+        logits = jnp.where(mask[None, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, tq, h, d).astype(q.dtype)
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

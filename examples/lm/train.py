@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-model", default=256, type=int)
     p.add_argument("--n-layers", default=4, type=int)
     p.add_argument("--n-heads", default=8, type=int)
+    p.add_argument("--n-kv-heads", default=None, type=int,
+                   help="GQA: fewer K/V heads than query heads (must "
+                        "divide --n-heads; default = MHA)")
     p.add_argument("--seq-len", default=256, type=int)
     p.add_argument("--batch-size", default=8, type=int,
                    help="sequences per dp rank per micro-step")
@@ -122,10 +125,21 @@ def main(argv=None) -> dict:
     if (args.pp > 1 or args.moe) and args.sample > 0:
         raise ValueError("--sample needs the default dp/sp/tp path "
                          "(pp/moe modules have no decode mode)")
-    if (args.pp > 1 or args.moe) and (args.remat or args.scan_layers):
-        raise ValueError("--remat/--scan-layers are wired to the default "
-                         "dp/sp/tp path only (pipelined/MoE modules do "
-                         "not take them)")
+    if (args.pp > 1 or args.moe) and (args.remat or args.scan_layers
+                                      or args.n_kv_heads is not None):
+        raise ValueError("--remat/--scan-layers/--n-kv-heads are wired to "
+                         "the default dp/sp/tp path only (pipelined/MoE "
+                         "modules do not take them)")
+    if args.n_kv_heads is not None:
+        if args.n_kv_heads < 1:
+            raise ValueError(f"n-kv-heads must be >= 1, got "
+                             f"{args.n_kv_heads}")
+        if args.n_heads % args.n_kv_heads:
+            raise ValueError(f"n-heads {args.n_heads} not divisible by "
+                             f"n-kv-heads {args.n_kv_heads}")
+        if args.n_kv_heads % args.tp:
+            raise ValueError(f"n-kv-heads {args.n_kv_heads} not divisible "
+                             f"by tp={args.tp}")
     if args.scan_layers and args.sample > 0:
         raise ValueError("--sample (KV-cache decode) does not compose "
                          "with --scan-layers")
@@ -200,10 +214,11 @@ def main(argv=None) -> dict:
                                sp_axis="sp" if args.sp > 1 else None,
                                tp_size=args.tp, sp_mode=args.sp_mode,
                                remat=args.remat,
-                               scan_layers=args.scan_layers, **model_kw)
+                               scan_layers=args.scan_layers,
+                               n_kv_heads=args.n_kv_heads, **model_kw)
         # init model: global shapes, but the SAME param-tree layout
         init_model = transformer_lm(scan_layers=args.scan_layers,
-                                    **model_kw)
+                                    n_kv_heads=args.n_kv_heads, **model_kw)
         state = create_train_state(init_model, tx, sample,
                                    jax.random.PRNGKey(0))
         step = make_lm_train_step(model, tx, mesh,
