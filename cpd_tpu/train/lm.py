@@ -51,12 +51,18 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        axis_tp: str = "tp", emulate_node: int = 1,
                        use_aps: bool = False, grad_exp: int = 8,
                        grad_man: int = 23, use_kahan: bool = False,
-                       mode: str = "faithful", donate: bool = True):
+                       mode: str = "faithful", donate: bool = True,
+                       label_smoothing: float = 0.0):
     """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
 
     tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
-    (dp, sp).  Loss is next-token CE averaged over all target positions.
+    (dp, sp).  Loss is next-token CE averaged over all target positions;
+    ``label_smoothing`` in [0, 1) mixes the one-hot targets with uniform
+    mass (training loss only — eval stays plain CE).
     """
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(f"label_smoothing must be in [0, 1), got "
+                         f"{label_smoothing}")
     # Guard: the optimizer update runs shard-local, which is only exact for
     # elementwise transforms (see reject_norm_based).  With tp=1 all params
     # are replicated and grads fully reduced before the update, so
@@ -69,6 +75,16 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             logits = model.apply({"params": params}, toks, train=True)
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits, tgts)                       # (B_local, T_local)
+            if label_smoothing:
+                # closed form of CE against one_hot*(1-a) + a/V targets:
+                # (1-a)*CE_int + a*(logsumexp - mean(logits)) — no dense
+                # (B, T, V) target tensor, which at long-context shapes
+                # (V=32k) would cost GBs per microbatch
+                lf32 = logits.astype(jnp.float32)
+                uniform = (jax.scipy.special.logsumexp(lf32, axis=-1)
+                           - lf32.mean(axis=-1))
+                ce = ((1.0 - label_smoothing) * ce
+                      + label_smoothing * uniform)
             local_sum = ce.sum()
             local_n = jnp.float32(ce.size)
             # Normalizer includes the tp axis: the loss is computed

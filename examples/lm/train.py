@@ -89,6 +89,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensorboard", action="store_true",
                    help="also write TensorBoard event files next to the "
                         "JSONL scalars (reference mix.py:16,168-171)")
+    p.add_argument("--bf16", action="store_true",
+                   help="bf16 compute dtype (fp32 master params; the "
+                        "MXU-native precision — --half analog of the "
+                        "DavidNet trainer)")
+    p.add_argument("--label-smoothing", default=0.0, type=float,
+                   help="mix one-hot targets with uniform mass in the "
+                        "training loss (default dp/sp/tp path)")
     p.add_argument("--sample", default=0, type=int,
                    help="after training, greedy-decode this many tokens "
                         "from a data prompt (KV-cache generate; default "
@@ -126,10 +133,11 @@ def main(argv=None) -> dict:
         raise ValueError("--sample needs the default dp/sp/tp path "
                          "(pp/moe modules have no decode mode)")
     if (args.pp > 1 or args.moe) and (args.remat or args.scan_layers
-                                      or args.n_kv_heads is not None):
-        raise ValueError("--remat/--scan-layers/--n-kv-heads are wired to "
-                         "the default dp/sp/tp path only (pipelined/MoE "
-                         "modules do not take them)")
+                                      or args.n_kv_heads is not None
+                                      or args.label_smoothing):
+        raise ValueError("--remat/--scan-layers/--n-kv-heads/"
+                         "--label-smoothing are wired to the default "
+                         "dp/sp/tp path only")
     if args.n_kv_heads is not None:
         if args.n_kv_heads < 1:
             raise ValueError(f"n-kv-heads must be >= 1, got "
@@ -159,7 +167,8 @@ def main(argv=None) -> dict:
                          "even (RoPE splits it in half)")
 
     model_kw = dict(vocab_size=args.vocab_size, d_model=args.d_model,
-                    n_layers=args.n_layers, n_heads=args.n_heads)
+                    n_layers=args.n_layers, n_heads=args.n_heads,
+                    dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     schedule = warmup_step_decay(args.base_lr, args.warmup_iters,
                                  [args.max_iter * 2], warmup_from=0.0)
     tx = make_optimizer(args.optimizer, schedule, momentum=0.9)
@@ -223,6 +232,7 @@ def main(argv=None) -> dict:
                                    jax.random.PRNGKey(0))
         step = make_lm_train_step(model, tx, mesh,
                                   emulate_node=args.emulate_node,
+                                  label_smoothing=args.label_smoothing,
                                   **quant_kw)
         eval_step = make_lm_eval_step(model, mesh)
         specs_fn = lm_state_specs

@@ -137,6 +137,43 @@ def test_ulysses_attention_grads_match():
                                    rtol=5e-5, atol=5e-5)
 
 
+def test_lm_label_smoothing():
+    """Smoothed loss matches the closed form at step level: ls=0 equals
+    plain CE; ls>0 loss is finite and differs; invalid ls raises."""
+    from cpd_tpu.train import (create_train_state, make_lm_train_step,
+                               make_optimizer)
+
+    mesh = make_mesh(dp=len(jax.devices()))
+    tx = make_optimizer("sgd", lambda s: 0.0)   # lr 0: loss is pure fwd
+    model = _tiny_lm()
+    rng = np.random.RandomState(51)
+    toks = jnp.asarray(rng.randint(0, 64, (8, 16)).astype(np.int32))
+    tgts = jnp.roll(toks, -1, axis=1)
+    state = create_train_state(model, tx, toks[:1], jax.random.PRNGKey(0))
+
+    def loss_at(ls):
+        step = make_lm_train_step(model, tx, mesh, donate=False,
+                                  label_smoothing=ls)
+        _, m = step(state, toks, tgts)
+        return float(m["loss"])
+
+    plain = loss_at(0.0)
+    import optax
+    logits = model.apply({"params": jax.device_get(state.params)}, toks)
+    want = float(optax.softmax_cross_entropy_with_integer_labels(
+        logits, tgts).mean())
+    np.testing.assert_allclose(plain, want, rtol=1e-5)
+
+    ls = 0.1
+    smoothed = loss_at(ls)
+    soft = (jax.nn.one_hot(tgts, 64) * (1 - ls) + ls / 64)
+    want_s = float(optax.softmax_cross_entropy(logits, soft).mean())
+    np.testing.assert_allclose(smoothed, want_s, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="label_smoothing"):
+        make_lm_train_step(model, tx, mesh, label_smoothing=1.5)
+
+
 def test_lm_remat_grads_match():
     """jax.checkpoint per block changes memory, not math: params and
     gradients identical with and without remat (single device AND the
