@@ -5,7 +5,8 @@ from .step import (cross_entropy_loss, make_eval_step,
                    make_seg_eval_step, make_train_step,
                    seg_cross_entropy_loss)
 from .optim import lars, make_optimizer, quant_sgd, sgd
-from .schedules import iter_table, piecewise_linear, warmup_step_decay
+from .schedules import (iter_table, piecewise_linear, warmup_cosine,
+                        warmup_step_decay)
 from .metrics import AverageMeter, Timer, accuracy, loss_diverged
 from .lm import lm_state_specs, make_lm_train_step
 from .pp import make_pp_eval_step, make_pp_train_step, pp_state_specs
@@ -18,7 +19,7 @@ __all__ = [
     "cross_entropy_loss", "seg_cross_entropy_loss", "make_eval_step",
     "make_seg_eval_step", "make_train_step",
     "lars", "make_optimizer", "quant_sgd", "sgd",
-    "iter_table", "piecewise_linear", "warmup_step_decay",
+    "iter_table", "piecewise_linear", "warmup_cosine", "warmup_step_decay",
     "AverageMeter", "Timer", "accuracy",
     "make_lm_train_step", "lm_state_specs",
     "CheckpointManager", "PreemptionGuard", "preempt_save",

@@ -224,6 +224,7 @@ def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,
                    weight_decay: float = 0.0, nesterov: bool = False,
                    wd_mask: Optional[Callable] = None, opt_exp: int = 8,
                    opt_man: int = 23, opt_kahan: bool = False,
+                   clip_norm: Optional[float] = None,
                    ) -> optax.GradientTransformation:
     """Registry used by trainer configs:
     'sgd' | 'nesterov' | 'lars' | 'quant_sgd' | 'adamw'.
@@ -232,20 +233,33 @@ def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,
     buffer; the optimizer-state analog of --grad_exp/--grad_man).
     'adamw' (no reference counterpart — the transformer-era default,
     elementwise so shard-local-safe under tp) reuses `momentum` as b1 and
-    applies `wd_mask` to its decoupled decay."""
+    applies `wd_mask` to its decoupled decay.
+
+    clip_norm prepends global-norm gradient clipping.  The result is
+    marked norm-based: the clip needs the GLOBAL gradient norm, so the
+    shard-local LM stepper refuses it under tp (same contract as LARS);
+    the CNN steppers clip the fully-reduced replicated gradients, where
+    local norms ARE global."""
     if name == "adamw":
-        return optax.adamw(schedule, b1=momentum, weight_decay=weight_decay,
-                           mask=wd_mask)
-    if name == "sgd":
-        return sgd(schedule, momentum, weight_decay, nesterov=nesterov,
-                   wd_mask=wd_mask)
-    if name == "nesterov":
-        return sgd(schedule, momentum, weight_decay, nesterov=True,
-                   wd_mask=wd_mask)
-    if name == "lars":
-        return lars(schedule, momentum, weight_decay)
-    if name == "quant_sgd":
-        return quant_sgd(schedule, momentum, weight_decay, exp=opt_exp,
-                         man=opt_man, use_kahan=opt_kahan,
-                         nesterov=nesterov, wd_mask=wd_mask)
-    raise ValueError(f"unknown optimizer {name!r}")
+        tx = optax.adamw(schedule, b1=momentum, weight_decay=weight_decay,
+                         mask=wd_mask)
+    elif name == "sgd":
+        tx = sgd(schedule, momentum, weight_decay, nesterov=nesterov,
+                 wd_mask=wd_mask)
+    elif name == "nesterov":
+        tx = sgd(schedule, momentum, weight_decay, nesterov=True,
+                 wd_mask=wd_mask)
+    elif name == "lars":
+        tx = lars(schedule, momentum, weight_decay)
+    elif name == "quant_sgd":
+        tx = quant_sgd(schedule, momentum, weight_decay, exp=opt_exp,
+                       man=opt_man, use_kahan=opt_kahan,
+                       nesterov=nesterov, wd_mask=wd_mask)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if clip_norm is not None:
+        if clip_norm <= 0:
+            raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
+        chained = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
+        return NormBasedTransformation(chained.init, chained.update)
+    return tx

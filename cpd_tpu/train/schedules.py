@@ -21,7 +21,7 @@ from typing import Callable, Sequence
 import jax.numpy as jnp
 
 __all__ = ["warmup_step_decay", "piecewise_linear", "iter_table",
-           "Schedule"]
+           "warmup_cosine", "Schedule"]
 
 Schedule = Callable[[jnp.ndarray], jnp.ndarray]
 
@@ -45,6 +45,32 @@ def warmup_step_decay(base_lr: float, warmup_iters: int,
         decays = jnp.sum(step > boundaries)
         decayed = base_lr * decay_factor ** decays
         return jnp.where(step <= warmup_iters, warm, decayed)
+
+    return schedule
+
+
+def warmup_cosine(base_lr: float, warmup_iters: int, total_iters: int,
+                  final_lr: float = 0.0,
+                  warmup_from: float = 0.0) -> Schedule:
+    """Linear warmup then cosine decay to `final_lr` at `total_iters`.
+
+    No reference counterpart (its trainers use step/piecewise schedules);
+    the transformer-era default, here for the LM workloads."""
+    if total_iters <= warmup_iters:
+        raise ValueError(f"total_iters {total_iters} must exceed "
+                         f"warmup_iters {warmup_iters}")
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = warmup_from + (base_lr - warmup_from) * (
+            step / max(warmup_iters, 1))
+        frac = jnp.clip((step - warmup_iters)
+                        / (total_iters - warmup_iters), 0.0, 1.0)
+        cos = final_lr + 0.5 * (base_lr - final_lr) * (
+            1.0 + jnp.cos(jnp.pi * frac))
+        # strict <: both branches agree at the boundary, and warmup 0
+        # must start at base_lr, not warmup_from
+        return jnp.where(step < warmup_iters, warm, cos)
 
     return schedule
 

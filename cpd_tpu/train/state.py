@@ -77,9 +77,11 @@ def reject_norm_based(tx, where: str) -> None:
     elementwise transforms; LARS trust ratios need global norms."""
     if getattr(tx, "norm_based", False):
         raise ValueError(
-            f"norm-based optimizers (LARS) are not supported by the "
-            f"{where}: trust ratios need global norms but the update is "
-            f"shard-local. Use sgd/nesterov here.")
+            f"norm-based gradient transforms (LARS trust ratios, "
+            f"clip_norm global-norm clipping) are not supported by the "
+            f"{where}: they need GLOBAL norms but the update is "
+            f"shard-local. Use an elementwise optimizer (sgd/nesterov/"
+            f"adamw) without clip_norm here.")
 
 
 def make_sharded_stepper(step_fn: Callable, specs_fn: Callable, mesh,

@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequences per dp rank per micro-step")
     p.add_argument("--max-iter", default=200, type=int)
     p.add_argument("--base-lr", default=0.01, type=float)
+    p.add_argument("--lr-schedule", default="constant",
+                   choices=["constant", "cosine"],
+                   help="after warmup: constant (default) or cosine decay "
+                        "to 0 at --max-iter")
     p.add_argument("--optimizer", default="sgd",
                    choices=["sgd", "nesterov", "adamw"],
                    help="elementwise optimizers only (shard-local update "
@@ -169,8 +173,13 @@ def main(argv=None) -> dict:
     model_kw = dict(vocab_size=args.vocab_size, d_model=args.d_model,
                     n_layers=args.n_layers, n_heads=args.n_heads,
                     dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
-    schedule = warmup_step_decay(args.base_lr, args.warmup_iters,
-                                 [args.max_iter * 2], warmup_from=0.0)
+    if args.lr_schedule == "cosine":
+        from cpd_tpu.train import warmup_cosine
+        schedule = warmup_cosine(args.base_lr, args.warmup_iters,
+                                 args.max_iter)
+    else:
+        schedule = warmup_step_decay(args.base_lr, args.warmup_iters,
+                                     [args.max_iter * 2], warmup_from=0.0)
     tx = make_optimizer(args.optimizer, schedule, momentum=0.9)
 
     ds = SyntheticText(n=4096, seq_len=args.seq_len,

@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(mix.py:181-198) — small archs/batches need less")
     p.add_argument("--max-iter", default=None, type=int,
                    help="override total iterations (smoke tests)")
+    p.add_argument("--clip-grad", default=None, type=float,
+                   help="global-norm gradient clipping (applied to the "
+                        "fully reduced replicated gradients, so local "
+                        "norms are exact)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
     p.add_argument("--tensorboard", action="store_true",
@@ -134,7 +138,8 @@ def main(argv=None) -> dict:
     tx = make_optimizer(opt_name, schedule, momentum=args.momentum,
                         weight_decay=args.weight_decay,
                         opt_exp=args.opt_exp, opt_man=args.opt_man,
-                        opt_kahan=args.opt_kahan)
+                        opt_kahan=args.opt_kahan,
+                        clip_norm=args.clip_grad)
 
     state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),
                                jax.random.PRNGKey(seed))

@@ -78,6 +78,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensorboard", action="store_true",
                    help="also write TensorBoard event files next to the "
                         "JSONL scalars (reference mix.py:16,168-171)")
+    p.add_argument("--clip-grad", default=None, type=float,
+                   help="global-norm gradient clipping (applied to the "
+                        "fully reduced replicated gradients, so local "
+                        "norms are exact)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
     return p
@@ -145,7 +149,8 @@ def main(argv=None) -> dict:
                       dtype=jnp.bfloat16,
                       **({"bn_axis": "dp"} if args.sync_bn else {}))
     tx = make_optimizer("sgd", schedule, momentum=args.momentum,
-                        weight_decay=args.wd, wd_mask=bn_and_bias_no_wd)
+                        weight_decay=args.wd, wd_mask=bn_and_bias_no_wd,
+                        clip_norm=args.clip_grad)
     state = create_train_state(
         model, tx, jnp.zeros((2, args.image_size, args.image_size, 3)),
         jax.random.PRNGKey(args.seed))
@@ -155,6 +160,10 @@ def main(argv=None) -> dict:
     if (args.zero2 or args.zero3) and args.mode != "faithful":
         raise ValueError("--zero2/--zero3 shard the faithful reduction; "
                          "--mode fast is not supported with them")
+    if args.clip_grad is not None and (args.zero1 or args.zero2
+                                       or args.zero3):
+        raise ValueError("--clip-grad runs inside the optax chain, which "
+                         "the ZeRO updaters bypass — unsupported together")
     if args.zero1:
         from cpd_tpu.parallel.zero import zero1_sgd
         zero = zero1_sgd(schedule, world=n_dev, momentum=args.momentum,

@@ -183,6 +183,52 @@ def test_make_optimizer_quant_sgd():
     assert np.isfinite(np.asarray(updates["w"])).all()
 
 
+def test_warmup_cosine_schedule():
+    from cpd_tpu.train import warmup_cosine
+
+    s = warmup_cosine(1.0, warmup_iters=10, total_iters=110, final_lr=0.1)
+    np.testing.assert_allclose(float(s(0)), 0.0, atol=1e-7)
+    np.testing.assert_allclose(float(s(10)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(s(60)), 0.55, rtol=1e-5)  # midpoint
+    np.testing.assert_allclose(float(s(110)), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(s(500)), 0.1, rtol=1e-5)  # clamped
+    # warmup 0: first step trains at base_lr, not warmup_from=0
+    s0 = warmup_cosine(1.0, warmup_iters=0, total_iters=100)
+    np.testing.assert_allclose(float(s0(0)), 1.0, rtol=1e-6)
+    import pytest
+    with pytest.raises(ValueError, match="total_iters"):
+        warmup_cosine(1.0, warmup_iters=10, total_iters=5)
+
+
+def test_make_optimizer_clip_norm():
+    """clip_norm prepends global-norm clipping and marks the transform
+    norm-based so the shard-local LM stepper refuses it under tp."""
+    import pytest
+
+    tx = make_optimizer("sgd", lambda s: jnp.float32(1.0), momentum=0.0,
+                        clip_norm=1.0)
+    assert getattr(tx, "norm_based", False)
+    params = {"w": jnp.zeros(4)}
+    state = tx.init(params)
+    g = {"w": jnp.full(4, 10.0)}        # norm 20 -> scaled to norm 1
+    updates, _ = tx.update(g, state, params)
+    np.testing.assert_allclose(
+        float(jnp.linalg.norm(updates["w"])), 1.0, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="clip_norm"):
+        make_optimizer("sgd", lambda s: 0.1, clip_norm=-1.0)
+
+    # the LM guard rejects it under tp
+    from cpd_tpu.models import transformer_lm
+    from cpd_tpu.parallel.mesh import make_mesh
+    from cpd_tpu.train import make_lm_train_step
+    mesh = make_mesh(dp=4, tp=2)
+    model = transformer_lm(vocab_size=32, d_model=16, n_layers=1,
+                           n_heads=2, d_ff=32, tp_axis="tp", tp_size=2)
+    with pytest.raises(ValueError, match="norm"):
+        make_lm_train_step(model, tx, mesh)
+
+
 def test_make_optimizer_adamw():
     """adamw registry entry: optax.adamw with momentum as b1 and the
     wd_mask routed to the decoupled decay."""
