@@ -73,6 +73,14 @@ class Block(nn.Module):
                                 # shape nn.scan's body contract requires
     n_kv_heads: Optional[int] = None    # GQA: fewer K/V heads than query
                                         # heads (None = MHA, wqkv layout)
+    dropout_rate: float = 0.0   # residual-branch dropout (after the attn
+                                # and mlp projections, post-tp-psum so the
+                                # mask applies to the full summed value —
+                                # every tp rank must draw the SAME mask,
+                                # which the stepper ensures by NOT folding
+                                # the tp index into the rng)
+    deterministic: bool = True  # False during training (LM threads its
+                                # train flag here)
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
@@ -181,7 +189,7 @@ class Block(nn.Module):
         attn = attn.reshape(*attn.shape[:-2], n_local * self.head_dim)
         proj = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                         name="wo")(attn)
-        x = x + self._psum_tp(proj)
+        x = x + self._dropout(self._psum_tp(proj))
 
         # ---- mlp ----
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
@@ -193,8 +201,19 @@ class Block(nn.Module):
             h = nn.gelu(h)
             h = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                          name="wo_mlp")(h)
-            out = x + self._psum_tp(h)
+            out = x + self._dropout(self._psum_tp(h))
         return (out, None) if self.scan_pair else out
+
+    def _dropout(self, x):
+        if not self.dropout_rate:
+            return x
+        if not 0.0 < self.dropout_rate < 1.0:
+            # 1.0 would silently zero every residual branch (flax returns
+            # zeros_like at rate==1); out-of-range rates mis-scale
+            raise ValueError(f"dropout_rate must be in [0, 1), got "
+                             f"{self.dropout_rate}")
+        return nn.Dropout(self.dropout_rate,
+                          deterministic=self.deterministic)(x)
 
 
 class TransformerLM(nn.Module):
@@ -205,6 +224,7 @@ class TransformerLM(nn.Module):
     n_layers: int = 4
     n_heads: int = 8
     n_kv_heads: Optional[int] = None   # GQA; None = MHA
+    dropout_rate: float = 0.0
     d_ff: int = 2048
     tp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
@@ -265,14 +285,21 @@ class TransformerLM(nn.Module):
                         d_model=self.d_model, tp_axis=self.tp_axis,
                         sp_axis=self.sp_axis, tp_size=self.tp_size,
                         dtype=self.dtype, sp_mode=self.sp_mode,
-                        decode=self.decode, n_kv_heads=self.n_kv_heads)
+                        decode=self.decode, n_kv_heads=self.n_kv_heads,
+                        dropout_rate=self.dropout_rate,
+                        deterministic=not train)
         if self.scan_layers:
             if self.decode:
                 raise ValueError("scan_layers does not compose with "
                                  "decode (per-layer caches need the "
                                  "unrolled blocks)")
             scan = nn.scan(block_cls, variable_axes={"params": 0},
-                           split_rngs={"params": True},
+                           # dropout must be listed or lift.pack filters
+                           # the rng out of the scanned scope entirely
+                           # (InvalidRngError at the first train step);
+                           # True = a distinct mask per layer, matching
+                           # the unrolled stack's per-block make_rng
+                           split_rngs={"params": True, "dropout": True},
                            in_axes=nn.broadcast, length=self.n_layers)
             x, _ = scan(**block_kw, scan_pair=True, name="blocks")(
                 x, positions)

@@ -52,7 +52,7 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        use_aps: bool = False, grad_exp: int = 8,
                        grad_man: int = 23, use_kahan: bool = False,
                        mode: str = "faithful", donate: bool = True,
-                       label_smoothing: float = 0.0):
+                       label_smoothing: float = 0.0, rng_seed: int = 0):
     """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
 
     tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
@@ -70,9 +70,27 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     if mesh.shape.get(axis_tp, 1) > 1:
         reject_norm_based(tx, "tp-sharded LM step")
 
+    has_dropout = getattr(model, "dropout_rate", 0.0) > 0.0
+
     def step_fn(state: TrainState, tokens, targets):
-        def loss_of(params, toks, tgts):
-            logits = model.apply({"params": params}, toks, train=True)
+        def loss_of(params, toks, tgts, micro_idx):
+            rngs = {}
+            if has_dropout:
+                # deterministic in (seed, global step, micro index) and
+                # decorrelated across dp/sp ranks — but NOT tp: the tp
+                # ranks compute the same activations redundantly, so
+                # their masks must be identical (Block applies dropout
+                # post-psum)
+                key = jax.random.fold_in(jax.random.PRNGKey(rng_seed),
+                                         state.step * emulate_node
+                                         + micro_idx)
+                key = jax.random.fold_in(
+                    key, lax.axis_index(axis_dp).astype(jnp.int32))
+                key = jax.random.fold_in(
+                    key, lax.axis_index(axis_sp).astype(jnp.int32))
+                rngs = {"dropout": key}
+            logits = model.apply({"params": params}, toks, train=True,
+                                 rngs=rngs)
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits, tgts)                       # (B_local, T_local)
             if label_smoothing:
@@ -104,13 +122,14 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         toks = tokens.reshape(n, mb, tokens.shape[1])
         tgts = targets.reshape(n, mb, targets.shape[1])
 
-        def micro(_, xy):
+        def micro(micro_idx, xy):
             tk, tg = xy
             (_, aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(state.params, tk, tg)
-            return None, (grads, *aux)
+                loss_of, has_aux=True)(state.params, tk, tg, micro_idx)
+            return micro_idx + 1, (grads, *aux)
 
-        _, (stacked, sums, ns, hits) = lax.scan(micro, None, (toks, tgts))
+        _, (stacked, sums, ns, hits) = lax.scan(
+            micro, jnp.zeros([], jnp.int32), (toks, tgts))
 
         # --- cross-axis gradient reduction (see module docstring) ---
         specs = lm_param_specs(state.params, axis_tp)

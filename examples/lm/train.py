@@ -100,6 +100,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--label-smoothing", default=0.0, type=float,
                    help="mix one-hot targets with uniform mass in the "
                         "training loss (default dp/sp/tp path)")
+    p.add_argument("--dropout", default=0.0, type=float,
+                   help="residual-branch dropout rate (train only; "
+                        "default dp/sp/tp path)")
     p.add_argument("--sample", default=0, type=int,
                    help="after training, greedy-decode this many tokens "
                         "from a data prompt (KV-cache generate; default "
@@ -138,10 +141,11 @@ def main(argv=None) -> dict:
                          "(pp/moe modules have no decode mode)")
     if (args.pp > 1 or args.moe) and (args.remat or args.scan_layers
                                       or args.n_kv_heads is not None
-                                      or args.label_smoothing):
+                                      or args.label_smoothing
+                                      or args.dropout):
         raise ValueError("--remat/--scan-layers/--n-kv-heads/"
-                         "--label-smoothing are wired to the default "
-                         "dp/sp/tp path only")
+                         "--label-smoothing/--dropout are wired to the "
+                         "default dp/sp/tp path only")
     if args.n_kv_heads is not None:
         if args.n_kv_heads < 1:
             raise ValueError(f"n-kv-heads must be >= 1, got "
@@ -233,10 +237,12 @@ def main(argv=None) -> dict:
                                tp_size=args.tp, sp_mode=args.sp_mode,
                                remat=args.remat,
                                scan_layers=args.scan_layers,
-                               n_kv_heads=args.n_kv_heads, **model_kw)
+                               n_kv_heads=args.n_kv_heads,
+                               dropout_rate=args.dropout, **model_kw)
         # init model: global shapes, but the SAME param-tree layout
         init_model = transformer_lm(scan_layers=args.scan_layers,
-                                    n_kv_heads=args.n_kv_heads, **model_kw)
+                                    n_kv_heads=args.n_kv_heads,
+                                    dropout_rate=args.dropout, **model_kw)
         state = create_train_state(init_model, tx, sample,
                                    jax.random.PRNGKey(0))
         step = make_lm_train_step(model, tx, mesh,
