@@ -5,11 +5,11 @@ This is the JAX analog of the reference's `--emulate_node` testing trick
 Note the axon TPU plugin overrides the JAX_PLATFORMS env var, so we must
 also force the platform through jax.config after import.
 
-Wall time: ~200 tests in ~4 min fast tier (`-m "not slow"`) + ~6 min of
-full-model integration smokes, measured on a single vCPU (this sandbox
-exposes 1 core; XLA compile of the 8-device shard_map programs is the
-cost).  Nothing is skipped by default; CI splits the tiers
-(.github/workflows/ci.yml).
+Wall time (end of round 2): 253 tests in ~14-17 min total on a single
+vCPU — fast tier (`-m "not slow"`) ~5.5 min, the rest full-model
+integration smokes (XLA compile of the 8-device shard_map programs is
+the cost; this sandbox exposes 1 core).  Nothing is skipped by default;
+CI splits the tiers (.github/workflows/ci.yml).
 """
 
 import os
