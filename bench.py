@@ -274,10 +274,11 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 "platform": devices[0].platform,
                 "mode": "faithful",
             })
-            # MFU only for the real workload shape — the FLOPs constant is
-            # resnet50@224-specific, so CPU smoke configs would report a
-            # fiction
-            if (os.environ.get("BENCH_ARCH", "resnet50") == "resnet50"
+            # MFU only for the real workload shape on the real chip — the
+            # FLOPs constant is resnet50@224-specific and the peak is the
+            # v5e's, so CPU smoke configs would report a fiction
+            if (devices[0].platform == "tpu"
+                    and os.environ.get("BENCH_ARCH", "resnet50") == "resnet50"
                     and size == 224):
                 peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
                                             str(PEAK_TFLOPS_DEFAULT)))
@@ -291,8 +292,12 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
     # Budget-gated EXTRA: a larger-batch scaling point.  bs 32 is the
     # reference-parity headline (main.py:32) but underfills a TPU's MXU
     # (VERDICT r2 weak #3); bs 128 shows what the chip does when fed.
-    # fuse drops to 4 so the fused input block stays ~300 MB.
-    if devices[0].platform == "tpu" and time.monotonic() < budget_end - 150:
+    # fuse drops to 4 so the fused input block stays ~300 MB.  Same
+    # arch/size gate as the headline MFU: the bs-128 point and its MFU are
+    # resnet50@224-specific.
+    if (devices[0].platform == "tpu"
+            and os.environ.get("BENCH_ARCH", "resnet50") == "resnet50"
+            and size == 224 and time.monotonic() < budget_end - 150):
         try:
             big_bs, big_fuse = 128, 4
             xb = jnp.asarray(rng.randn(big_fuse, big_bs * n_dev, size, size,
@@ -418,21 +423,27 @@ def main():
     # that cannot even init (round-2 failure mode — one hung attempt ate
     # 534 of 540s).  Worst case here is ~2 x BENCH_PROBE_SECS, then an
     # early, informative exit that still carries last_known_good.
-    probe = _run_probe(deadline)
-    if probe is None:
-        failure = {
-            "metric": "resnet50_train_img_per_sec_per_chip",
-            "value": None,
-            "unit": "img/s/chip",
-            "vs_baseline": None,
-            "error": ("tunnel probe failed twice (backend init hang or "
-                      "crash); measurement budget not committed"),
-        }
-        last_good = _load_last_good()
-        if last_good is not None:
-            failure["last_known_good"] = last_good
-        emit(failure)
-        return
+    # BENCH_FORCE_PLATFORM runs (CPU smoke tests, often with tiny budgets)
+    # skip the probe: there is no tunnel to screen, and the loop below
+    # still guarantees them their one measurement attempt.
+    probe = {"secs": None}
+    if not os.environ.get("BENCH_FORCE_PLATFORM"):
+        probe = _run_probe(deadline)
+        if probe is None:
+            failure = {
+                "metric": "resnet50_train_img_per_sec_per_chip",
+                "value": None,
+                "unit": "img/s/chip",
+                "vs_baseline": None,
+                "error": ("tunnel probe did not succeed (backend init "
+                          "hang/crash, or probe budget exhausted); "
+                          "measurement budget not committed"),
+            }
+            last_good = _load_last_good()
+            if last_good is not None:
+                failure["last_known_good"] = last_good
+            emit(failure)
+            return
 
     last_err = "no attempt ran"
     for attempt in range(3):
