@@ -210,12 +210,15 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
     n_dev = len(devices)
     mesh = make_mesh(dp=n_dev)
 
-    if os.environ.get("BENCH_ARCH", "resnet50") == "resnet50":
+    arch = os.environ.get("BENCH_ARCH", "resnet50")
+    # MFU and the bs-128 point only make sense at the real workload shape on
+    # the real chip: FLOPS_PER_IMG and the peak are resnet50@224/v5e-specific
+    headline_shape = arch == "resnet50" and size == 224
+    if arch == "resnet50":
         model = resnet50(dtype=jnp.bfloat16)
     else:
         from cpd_tpu.models import get_model
-        model = get_model(os.environ["BENCH_ARCH"], num_classes=1000,
-                          dtype=jnp.bfloat16)
+        model = get_model(arch, num_classes=1000, dtype=jnp.bfloat16)
     schedule = warmup_step_decay(3.2, 500, [3000, 6000])  # main.py:237-252 shape
     tx = make_optimizer("sgd", schedule, momentum=0.9, weight_decay=1e-4)
 
@@ -274,12 +277,7 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 "platform": devices[0].platform,
                 "mode": "faithful",
             })
-            # MFU only for the real workload shape on the real chip — the
-            # FLOPs constant is resnet50@224-specific and the peak is the
-            # v5e's, so CPU smoke configs would report a fiction
-            if (devices[0].platform == "tpu"
-                    and os.environ.get("BENCH_ARCH", "resnet50") == "resnet50"
-                    and size == 224):
+            if devices[0].platform == "tpu" and headline_shape:
                 peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
                                             str(PEAK_TFLOPS_DEFAULT)))
                 tflops = per_chip * FLOPS_PER_IMG / 1e12
@@ -292,12 +290,9 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
     # Budget-gated EXTRA: a larger-batch scaling point.  bs 32 is the
     # reference-parity headline (main.py:32) but underfills a TPU's MXU
     # (VERDICT r2 weak #3); bs 128 shows what the chip does when fed.
-    # fuse drops to 4 so the fused input block stays ~300 MB.  Same
-    # arch/size gate as the headline MFU: the bs-128 point and its MFU are
-    # resnet50@224-specific.
-    if (devices[0].platform == "tpu"
-            and os.environ.get("BENCH_ARCH", "resnet50") == "resnet50"
-            and size == 224 and time.monotonic() < budget_end - 150):
+    # fuse drops to 4 so the fused input block stays ~300 MB.
+    if (devices[0].platform == "tpu" and headline_shape
+            and time.monotonic() < budget_end - 150):
         try:
             big_bs, big_fuse = 128, 4
             xb = jnp.asarray(rng.randn(big_fuse, big_bs * n_dev, size, size,
@@ -423,11 +418,13 @@ def main():
     # that cannot even init (round-2 failure mode — one hung attempt ate
     # 534 of 540s).  Worst case here is ~2 x BENCH_PROBE_SECS, then an
     # early, informative exit that still carries last_known_good.
-    # BENCH_FORCE_PLATFORM runs (CPU smoke tests, often with tiny budgets)
-    # skip the probe: there is no tunnel to screen, and the loop below
-    # still guarantees them their one measurement attempt.
+    # Runs forced onto a non-TPU platform (CPU smoke tests, often with tiny
+    # budgets) skip the probe: there is no tunnel to screen, and the loop
+    # below still guarantees them their one measurement attempt.  A forced
+    # TPU platform still probes — the tunnel is exactly what can hang.
+    force = os.environ.get("BENCH_FORCE_PLATFORM")
     probe = {"secs": None}
-    if not os.environ.get("BENCH_FORCE_PLATFORM"):
+    if not force or force in ("tpu", "axon"):
         probe = _run_probe(deadline)
         if probe is None:
             failure = {

@@ -74,8 +74,7 @@ def dist_init(coordinator_address: Optional[str] = None,
                      ("SLURM_PROCID", "OMPI_COMM_WORLD_RANK",
                       "COORDINATOR_ADDRESS", "TPU_WORKER_ID"))
     if explicit or in_cluster:
-        already = getattr(jax.distributed.global_state, "client", None)
-        if already is None:
+        if not jax.distributed.is_initialized():
             # No blanket except here: a coordinator failure must surface,
             # not silently degrade an N-host job to N independent trainings.
             jax.distributed.initialize(

