@@ -424,7 +424,9 @@ def main():
     # TPU platform still probes — the tunnel is exactly what can hang.
     force = os.environ.get("BENCH_FORCE_PLATFORM")
     probe = {"secs": None}
-    if not force or force in ("tpu", "axon"):
+    # `force` may be a jax platform priority LIST ("axon,cpu")
+    if not force or any(p.strip() in ("tpu", "axon")
+                        for p in force.split(",")):
         probe = _run_probe(deadline)
         if probe is None:
             failure = {

@@ -104,7 +104,17 @@ class Block(nn.Module):
         block at ``positions[0]`` and attends q over the whole cache with
         the mask ``key_pos <= query_pos`` — one code path serves both
         one-pass prefill (T = prompt length) and single-token decode
-        (T = 1)."""
+        (T = 1).
+
+        OVERFLOW CONTRACT: writing past the allocated cache length cannot
+        raise from inside jit (positions are traced values), so the layer
+        poisons the ENTIRE output block with NaN instead — argmax/sampling
+        over NaN logits would otherwise silently emit token 0.  `generate()`
+        sizes the cache so this never triggers there; callers driving
+        ``decode=True`` with their own cache management must either respect
+        ``prompt_len + steps <= cache length`` or check outputs for NaN
+        (``jnp.isnan(logits).any()``) after a step that might overflow
+        (ADVICE r2)."""
         is_init = self.has_variable("cache", "cached_k")
         cache_k = self.variable("cache", "cached_k", jnp.zeros, k.shape,
                                 k.dtype)

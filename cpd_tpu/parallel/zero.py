@@ -53,6 +53,7 @@ from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -213,15 +214,21 @@ class _Zero2(_Zero1):
     # reduce-scattering those would double-count by W)
     requires_reduce_in_update = True
 
-    def _flat_shifts(self, grads, shifts) -> jnp.ndarray:
-        """Per-element shift vector matching the flat layout (broadcast
-        ops, not a materialized constant — see _flat_mask)."""
-        parts = [jnp.full((l.size,), 1.0, jnp.float32) * jnp.exp2(shifts[i])
-                 for i, l in enumerate(jax.tree.leaves(grads))]
-        flat = jnp.concatenate(parts)
-        s = self._shard_size(grads)
-        return jnp.pad(flat, (0, self.world * s - flat.shape[0]),
-                       constant_values=1.0)
+    def _shard_shifts(self, grads, shifts, rank, s: int) -> jnp.ndarray:
+        """This rank's (S,) slice of the per-element APS shift factors.
+
+        Built directly from the static leaf-offset table: each of the
+        shard's global element indices is mapped to its leaf via
+        searchsorted, then to that leaf's shift.  O(S) per rank — the
+        round-2 version materialized the full (W*S,) vector on every rank
+        before slicing (ADVICE r2).  Pad elements past the last leaf land
+        on the appended shift of 0 → factor exp2(0)=1."""
+        leaves = jax.tree.leaves(grads)
+        ends = np.cumsum([l.size for l in leaves])  # static end offsets
+        idx = rank * s + jnp.arange(s)
+        leaf_idx = jnp.searchsorted(jnp.asarray(ends), idx, side="right")
+        padded = jnp.concatenate([shifts, jnp.zeros((1,), jnp.float32)])
+        return jnp.exp2(jnp.take(padded, leaf_idx))
 
     def _grad_shard(self, local_grads, state, axis_name: str,
                     use_aps: bool = False, grad_exp: int = 8,
@@ -265,8 +272,7 @@ class _Zero2(_Zero1):
         red = quantized_sum(stacked, grad_exp, grad_man, use_kahan)
         if use_aps:
             rank = lax.axis_index(axis_name)
-            shift_sh = lax.dynamic_slice(
-                self._flat_shifts(local_grads, shifts), (rank * s,), (s,))
+            shift_sh = self._shard_shifts(local_grads, shifts, rank, s)
             red = red / shift_sh   # true divide, aps_unscale semantics
         return red
 

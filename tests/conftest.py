@@ -5,11 +5,13 @@ This is the JAX analog of the reference's `--emulate_node` testing trick
 Note the axon TPU plugin overrides the JAX_PLATFORMS env var, so we must
 also force the platform through jax.config after import.
 
-Wall time (end of round 2): 253 tests in ~14-17 min total on a single
-vCPU — fast tier (`-m "not slow"`) ~5.5 min, the rest full-model
-integration smokes (XLA compile of the 8-device shard_map programs is
-the cost; this sandbox exposes 1 core).  Nothing is skipped by default;
-CI splits the tiers (.github/workflows/ci.yml).
+Wall time (round 3, re-measured after the suite trim): see the numbers
+in this docstring's history for previous rounds; current counts/timings
+are recorded in docs/ROUND3.md as they land.  The 1-vCPU sandbox is the
+cost driver (XLA compile of the 8-device shard_map programs), plus the
+two-process distributed test which spawns two fresh jax processes.
+Nothing is skipped by default; CI splits the tiers
+(.github/workflows/ci.yml).
 """
 
 import os

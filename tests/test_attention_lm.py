@@ -602,3 +602,35 @@ def test_flash_attention_impl_gating():
     want = np.asarray(local_attention(q, q, q, causal=True))
     got = np.asarray(local_attention(q, q, q, causal=True, impl="flash"))
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_lm_decode_cache_overflow_poisons_with_nan():
+    """The documented overflow contract (transformer.py decode docstring,
+    ADVICE r2): a write past the allocated cache length cannot raise from
+    inside jit, so the step's outputs must be all-NaN — never a silently
+    clamped write that argmax would turn into plausible tokens."""
+    model = _tiny_lm(decode=True)
+    toks = jnp.asarray(np.random.RandomState(5).randint(
+        0, 64, (1, 6)).astype(np.int32))
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32), train=False)
+    params, cache = variables["params"], variables["cache"]
+
+    # prefill 6 of 8 slots — well-formed
+    logits, vs = model.apply({"params": params, "cache": cache}, toks,
+                             train=False, mutable=["cache"])
+    assert not np.isnan(np.asarray(logits)).any()
+    cache = vs["cache"]
+
+    # two more single-token steps fill slots 6 and 7; the third writes
+    # position 8 == t_max and must poison
+    tok = jnp.zeros((1, 1), jnp.int32)
+    for step in range(3):
+        logits, vs = model.apply({"params": params, "cache": cache}, tok,
+                                 train=False, mutable=["cache"])
+        cache = vs["cache"]
+        nans = np.isnan(np.asarray(logits))
+        if step == 2:
+            assert nans.all(), "overflow step must poison every logit"
+        else:
+            assert not nans.any(), f"in-bounds step {step} produced NaN"
