@@ -58,6 +58,10 @@ class PipelinedLM:
     pp_size: int = 1
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    remat_stages: bool = True   # checkpoint each pipeline stage: backward
+                                # memory flat in n_microbatches (see
+                                # parallel/pipeline.py docstring);
+                                # value-neutral
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -145,7 +149,8 @@ class PipelinedLM:
         def stage_fn(act):
             return self._apply_stack(params["blocks"], act, positions)
 
-        outs = pipeline_spmd(stage_fn, x, self.pp_axis, self.pp_size)
+        outs = pipeline_spmd(stage_fn, x, self.pp_axis, self.pp_size,
+                             remat_stages=self.remat_stages)
         logits = self._head(params, outs.reshape(b, t, -1).astype(self.dtype))
         return logits
 

@@ -25,20 +25,64 @@ overlaps the permute with stage compute.
 
 The activation shape must be preserved by the stage function (true of
 transformer blocks), because every stage's buffer is the same array shape.
+
+Memory profile of the scan backward (and where this design stops scaling):
+
+* ``jax.grad`` through the tick scan stores each tick's residuals until
+  the reverse sweep.  WITHOUT stage remat that is (M+P-1) ticks x the
+  full internal residuals of stage_fn (every matmul input inside L/P
+  layers, per microbatch) per rank — linear in M, the classic GPipe
+  memory wall.
+* WITH ``remat_stages=True`` (``jax.checkpoint`` around stage_fn) each
+  tick stores only its boundary carry — the (B/M, T, d) activation —
+  and the stage recomputes its internals in the backward tick.  Total
+  boundary memory per rank is (M+P-1) x (B/M)·T·d ≈ (1 + (P-1)/M) x
+  B·T·d, i.e. roughly ONE full-batch boundary activation regardless of
+  M; the transient recompute peak adds one microbatch's stage residuals.
+  Memory is then flat in M, so the bubble (P-1)/(M+P-1) can be driven
+  down with more microbatches without hitting HBM — the remat forward
+  replay (~1/3 extra stage FLOPs) is the price.
+* What scan-GPipe cannot express is 1F1B/interleaved scheduling: AD
+  generates the backward as the transpose of the WHOLE forward scan, so
+  every forward tick completes before the first backward tick — fwd and
+  bwd of different microbatches never interleave.  1F1B's win over
+  remat-GPipe is holding ≤P (not M) boundary activations while skipping
+  the replay; expressing it in JAX requires a hand-scheduled
+  custom_vjp pipeline (both directions inside one scan with explicit
+  stashes).  Measured against that: remat-GPipe already removes the
+  M-scaling, so 1F1B here would buy only the replay FLOPs back — a
+  deliberate non-goal until a profile shows the replay on the critical
+  path (docs/PERF.md).
 """
 
 from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_spmd"]
+__all__ = ["pipeline_spmd", "pipeline_ticks", "bubble_fraction"]
+
+
+def pipeline_ticks(n_microbatches: int, pp_size: int) -> int:
+    """Scan length of the GPipe schedule: M + P - 1."""
+    return n_microbatches + pp_size - 1
+
+
+def bubble_fraction(n_microbatches: int, pp_size: int) -> float:
+    """Idle fraction of the schedule: (P-1)/(M+P-1).  Every rank executes
+    stage_fn once per tick; only M of the M+P-1 executions act on real
+    data, so compute overhead vs the unpipelined model is exactly
+    1/(1-bubble) — the tick count is asserted on the traced program's
+    scan length in tests/test_pipeline.py."""
+    return (pp_size - 1) / pipeline_ticks(n_microbatches, pp_size)
 
 
 def pipeline_spmd(stage_fn: Callable, microbatches: jnp.ndarray,
-                  pp_axis: str, pp_size: int) -> jnp.ndarray:
+                  pp_axis: str, pp_size: int,
+                  remat_stages: bool = False) -> jnp.ndarray:
     """Stream `microbatches` (M, ...) through the pp pipeline.
 
     stage_fn: activation (...) -> activation (...), closing over THIS
@@ -47,9 +91,15 @@ def pipeline_spmd(stage_fn: Callable, microbatches: jnp.ndarray,
     valid ON THE LAST STAGE ONLY (other ranks hold garbage; mask with
     `lax.axis_index(pp_axis) == pp_size - 1`).
 
+    remat_stages: checkpoint each stage application — backward memory
+    drops from (M+P-1) x stage residuals to (M+P-1) x boundary
+    activations (see module docstring).  Bitwise-neutral on values.
+
     Must be called inside shard_map with `pp_axis` bound.  pp_size == 1
     degenerates to a plain scan of stage_fn over microbatches.
     """
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
     m_count = microbatches.shape[0]
     if pp_size == 1:
         def plain(_, x):

@@ -88,19 +88,33 @@ class _Zero1:
         total = sum(l.size for l in jax.tree.leaves(params))
         return math.ceil(total / self.world)
 
-    def _flat_mask(self, params) -> jnp.ndarray:
-        """Flat wd mask as broadcast ops (jnp.full), NOT a materialized
-        numpy literal: a 25M-param model would otherwise embed a 100MB
-        constant into the compiled executable."""
+    def _shard_leaf_values(self, template, values, rank,
+                           s: int, pad: float = 0.0) -> jnp.ndarray:
+        """Expand a per-LEAF value vector to this rank's (S,) per-element
+        shard of the flat layout.
+
+        Built from the static leaf-offset table: each shard element index
+        maps to its leaf via searchsorted, then to that leaf's value.
+        O(S) per rank — never the full (W*S,) flat vector, which the
+        round-2 code materialized on every rank before slicing (ADVICE
+        r2).  Elements past the last leaf (flat padding) get `pad`."""
+        leaves = jax.tree.leaves(template)
+        ends = np.cumsum([l.size for l in leaves])  # static end offsets
+        idx = rank * s + jnp.arange(s)
+        leaf_idx = jnp.searchsorted(jnp.asarray(ends), idx, side="right")
+        padded = jnp.concatenate([jnp.asarray(values, jnp.float32),
+                                  jnp.full((1,), pad, jnp.float32)])
+        return jnp.take(padded, leaf_idx)
+
+    def _shard_mask(self, params, rank, s: int) -> jnp.ndarray:
+        """This rank's (S,) slice of the per-element weight-decay mask
+        (per-leaf bools are static, so the value vector is a host-side
+        constant of n_leaves floats, not a 100MB per-element literal)."""
         mask = (self.wd_mask(params) if self.wd_mask is not None
                 else jax.tree.map(lambda _: True, params))
-        parts = [jnp.full((l.size,), float(bool(m)), jnp.float32)
-                 for l, m in zip(jax.tree.leaves(params),
-                                 jax.tree.leaves(mask))]
-        flat = (jnp.concatenate(parts) if parts
-                else jnp.zeros((0,), jnp.float32))
-        s = self._shard_size(params)
-        return jnp.pad(flat, (0, self.world * s - flat.shape[0]))
+        vals = np.array([float(bool(m)) for m in jax.tree.leaves(mask)],
+                        np.float32)
+        return self._shard_leaf_values(params, vals, rank, s)
 
     @staticmethod
     def _flatten(tree) -> jnp.ndarray:
@@ -170,8 +184,7 @@ class _Zero1:
                          (0, self.world * s - sum(
                              l.size for l in jax.tree.leaves(params))))
         p_sh = lax.dynamic_slice(flat_p, (rank * s,), (s,))
-        m_sh = lax.dynamic_slice(
-            self._flat_mask(params), (rank * s,), (s,))
+        m_sh = self._shard_mask(params, rank, s)
         new_p_sh, new_buf = self._shard_sgd(g_sh, p_sh, m_sh,
                                             opt.momentum, lr)
 
@@ -215,20 +228,9 @@ class _Zero2(_Zero1):
     requires_reduce_in_update = True
 
     def _shard_shifts(self, grads, shifts, rank, s: int) -> jnp.ndarray:
-        """This rank's (S,) slice of the per-element APS shift factors.
-
-        Built directly from the static leaf-offset table: each of the
-        shard's global element indices is mapped to its leaf via
-        searchsorted, then to that leaf's shift.  O(S) per rank — the
-        round-2 version materialized the full (W*S,) vector on every rank
-        before slicing (ADVICE r2).  Pad elements past the last leaf land
-        on the appended shift of 0 → factor exp2(0)=1."""
-        leaves = jax.tree.leaves(grads)
-        ends = np.cumsum([l.size for l in leaves])  # static end offsets
-        idx = rank * s + jnp.arange(s)
-        leaf_idx = jnp.searchsorted(jnp.asarray(ends), idx, side="right")
-        padded = jnp.concatenate([shifts, jnp.zeros((1,), jnp.float32)])
-        return jnp.exp2(jnp.take(padded, leaf_idx))
+        """This rank's (S,) slice of the per-element APS shift factors
+        (pad elements get shift 0 → factor exp2(0)=1)."""
+        return jnp.exp2(self._shard_leaf_values(grads, shifts, rank, s))
 
     def _grad_shard(self, local_grads, state, axis_name: str,
                     use_aps: bool = False, grad_exp: int = 8,
@@ -421,8 +423,7 @@ class _Zero3(_Zero2):
 
         g_sh = self._grad_shard(local_grads, state, axis_name, **quant_kw)
         p_sh = state.params
-        m_sh = lax.dynamic_slice(
-            self._flat_mask(self.template), (rank * s,), (s,))
+        m_sh = self._shard_mask(self.template, rank, s)
         new_p_sh, new_buf = self._shard_sgd(g_sh, p_sh, m_sh,
                                             opt.momentum, lr)
         return new_p_sh, Zero1State(opt.step + 1, new_buf)

@@ -214,3 +214,69 @@ def test_pp_tp_composed_train_step_matches_single_device():
             jax.tree_util.tree_flatten_with_path(want_params)[0]):
         np.testing.assert_allclose(got, np.asarray(want), rtol=2e-3,
                                    atol=2e-4, err_msg=str(path))
+
+
+# ------------------------------------------------- schedule / bubble math
+
+def _scan_lengths(jaxpr, acc):
+    """Collect the `length` param of every scan in a (nested) jaxpr."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            acc.append(eqn.params["length"])
+        for v in eqn.params.values():
+            if hasattr(v, "eqns"):
+                _scan_lengths(v, acc)
+            elif hasattr(v, "jaxpr"):
+                _scan_lengths(v.jaxpr, acc)
+    return acc
+
+
+@pytest.mark.parametrize("pp,m", [(2, 4), (4, 6), (8, 3)])
+def test_pipeline_schedule_length_is_m_plus_p_minus_1(pp, m):
+    """The GPipe schedule must be exactly M+P-1 ticks — every rank runs
+    stage_fn once per tick, so the compute overhead vs unpipelined is
+    (M+P-1)/M = 1/(1-bubble) with bubble (P-1)/(M+P-1).  Asserted on the
+    traced program itself: the tick scan's static length."""
+    from cpd_tpu.parallel.pipeline import bubble_fraction, pipeline_ticks
+
+    mesh = make_mesh(pp=pp, devices=jax.devices()[:pp])
+    mb, d = 2, 8
+    w = jnp.eye(d, dtype=jnp.float32)
+
+    def body(xs):
+        return pipeline_spmd(lambda a: a @ w, xs, "pp", pp)
+
+    xs = jnp.zeros((m, mb, d), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                  check_vma=False))(xs)
+    lengths = _scan_lengths(jaxpr.jaxpr, [])
+    want = pipeline_ticks(m, pp)
+    assert lengths == [want], (lengths, want)
+    assert bubble_fraction(m, pp) == (pp - 1) / want
+
+
+def test_pipeline_remat_stages_is_value_neutral():
+    """remat_stages recomputes stage internals in the backward; values and
+    gradients must be bitwise unchanged."""
+    pp, m, mb, d = 4, 6, 2, 16
+    mesh = make_mesh(pp=pp, devices=jax.devices()[:pp])
+    rng = np.random.RandomState(2)
+    xs = jnp.asarray(rng.randn(m, mb, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, d) * 0.1, jnp.float32)
+
+    def loss(w, remat):
+        def body(xs):
+            outs = pipeline_spmd(lambda a: jnp.tanh(a @ w), xs, "pp", pp,
+                                 remat_stages=remat)
+            is_last = (lax.axis_index("pp") == pp - 1).astype(outs.dtype)
+            return lax.psum(outs * is_last, "pp")
+
+        out = shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                        check_vma=False)(xs)
+        return (out ** 2).sum()
+
+    v0, g0 = jax.value_and_grad(functools.partial(loss, remat=False))(w)
+    v1, g1 = jax.value_and_grad(functools.partial(loss, remat=True))(w)
+    np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+    np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
