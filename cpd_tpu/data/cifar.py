@@ -77,7 +77,14 @@ def synthetic_cifar10(n_train: int = 50000, n_test: int = 10000,
 
 def load_cifar10(root: Optional[str] = None, allow_synthetic: bool = True):
     """Return (train_x u8 NHWC, train_y, test_x, test_y); real data if found
-    under `root` (or common roots), else synthetic (see module docstring)."""
+    under `root` (or common roots), else synthetic (see module docstring).
+
+    An EXPLICIT `root` is strict: if no CIFAR-10 tree is found there, this
+    raises instead of silently training on synthetic data (a typo'd
+    --data-root must not fabricate a run that looks real).  The synthetic
+    fallback applies only to the no-root default search."""
+    if root:
+        allow_synthetic = False
     roots = [root] if root else list(_DEFAULT_ROOTS)
     for r in roots:
         if not r:

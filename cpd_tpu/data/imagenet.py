@@ -159,12 +159,17 @@ def load_imagenet(root: Optional[str], size: int = 224,
                   synthetic_train: int = 12800, synthetic_val: int = 1280,
                   num_classes: int = 1000):
     """Return (train_ds, val_ds): real ImageFolder pair if `root` has
-    train/ and val/ subdirs, else the synthetic stand-in."""
+    train/ and val/ subdirs, synthetic stand-in when no root is given.
+
+    An explicit `root` without the expected layout raises — a typo'd
+    --train-dir must not silently fabricate a synthetic run."""
     if root:
         train_dir = os.path.join(root, "train")
         val_dir = os.path.join(root, "val")
-        if os.path.isdir(train_dir) and os.path.isdir(val_dir):
-            return (ImageFolderDataset(train_dir, size, train=True),
-                    ImageFolderDataset(val_dir, size, train=False))
+        if not (os.path.isdir(train_dir) and os.path.isdir(val_dir)):
+            raise FileNotFoundError(
+                f"no train/ + val/ ImageFolder layout under {root}")
+        return (ImageFolderDataset(train_dir, size, train=True),
+                ImageFolderDataset(val_dir, size, train=False))
     return (SyntheticImageNet(synthetic_train, num_classes, size, seed=0),
             SyntheticImageNet(synthetic_val, num_classes, size, seed=1))

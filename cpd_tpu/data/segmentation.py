@@ -182,10 +182,17 @@ def load_segmentation(root: Optional[str] = None, split: str = "train",
                       crop_size: int = 128, num_classes: int = 19,
                       synthetic_size: int = 256, seed: int = 0,
                       flip: bool = True):
-    """Real Cityscapes if `root` holds a leftImg8bit/gtFine tree, else the
-    synthetic stand-in (same batch() contract).  Pass ``flip=False`` for
-    evaluation splits — mmseg's eval pipeline has no random flip."""
-    if root and os.path.isdir(os.path.join(root, "leftImg8bit", split)):
+    """Real Cityscapes if `root` holds a leftImg8bit/gtFine tree, synthetic
+    stand-in when no root is given (same batch() contract).  Pass
+    ``flip=False`` for evaluation splits — mmseg's eval pipeline has no
+    random flip.
+
+    An explicit `root` without the expected tree raises — a typo'd
+    --data-root must not silently fabricate a synthetic run."""
+    if root:
+        if not os.path.isdir(os.path.join(root, "leftImg8bit", split)):
+            raise FileNotFoundError(
+                f"no leftImg8bit/{split} tree under {root}")
         return CityscapesDataset(root, split=split, crop_size=crop_size,
                                  num_classes=num_classes, flip=flip)
     return SyntheticSegmentation(n=synthetic_size, num_classes=num_classes,

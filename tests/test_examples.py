@@ -477,14 +477,18 @@ def test_cityscapes_loader_pads_small_images(tmp_path):
     assert np.any(y[0, :32, :40] != CITYSCAPES_IGNORE)
 
 
-def test_load_segmentation_synthetic_fallback(tmp_path):
+def test_load_segmentation_explicit_root_is_strict(tmp_path):
+    """No root -> synthetic stand-in; an EXPLICIT root with no Cityscapes
+    tree raises (a typo'd --data-root must not silently train on
+    synthetic data — QUICKSTART.md contract)."""
     from cpd_tpu.data.segmentation import (SyntheticSegmentation,
                                            load_segmentation)
 
-    ds = load_segmentation(str(tmp_path / "nope"), crop_size=32,
-                           synthetic_size=8)
+    ds = load_segmentation(None, crop_size=32, synthetic_size=8)
     assert isinstance(ds, SyntheticSegmentation)
     assert len(ds) == 8
+    with pytest.raises(FileNotFoundError):
+        load_segmentation(str(tmp_path / "nope"), crop_size=32)
 
 
 def test_seg_loss_ignores_ignore_label():
@@ -536,3 +540,22 @@ def test_lm_trainer_pp_and_moe_paths(tmp_path):
                        "--n-experts", "4",
                        "--save-path", str(tmp_path / "moe")])
     assert r["step"] == 2 and math.isfinite(r["loss"])
+
+
+def test_load_cifar10_explicit_root_is_strict(tiny_cifar, tmp_path):
+    """Explicit root: real tree loads, missing tree raises (never a silent
+    synthetic fallback — QUICKSTART.md contract)."""
+    from cpd_tpu.data.cifar import load_cifar10
+
+    tx, ty, vx, vy = load_cifar10(tiny_cifar)
+    assert tx.shape == (510, 32, 32, 3) and tx.dtype == np.uint8
+    assert len(vy) == 64
+    with pytest.raises(FileNotFoundError):
+        load_cifar10(str(tmp_path / "nope"))
+
+
+def test_load_imagenet_explicit_root_is_strict(tmp_path):
+    from cpd_tpu.data.imagenet import load_imagenet
+
+    with pytest.raises(FileNotFoundError):
+        load_imagenet(str(tmp_path / "nope"))
