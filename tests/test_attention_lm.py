@@ -137,6 +137,7 @@ def test_ulysses_attention_grads_match():
                                    rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_lm_dropout():
     """Dropout: eval is identity (same logits as the rate-0 model on the
     same params), the train step is rng-deterministic, and dropping
@@ -170,11 +171,16 @@ def test_lm_dropout():
     assert np.isfinite(float(m1["loss"]))
     # rng deterministic in (seed, step): identical repeat
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]))
-    # and different from the undropped loss
-    sh0 = _tiny_lm(tp_axis="tp", sp_axis="sp", tp_size=2)
-    _, m0 = make_lm_train_step(sh0, tx, mesh, donate=False)(
-        state, toks, tgts)
-    assert abs(float(m1["loss"]) - float(m0["loss"])) > 1e-4
+    # and different from the undropped loss (single-device reference —
+    # compiling a third dp x sp x tp step just for this comparison cost
+    # ~10s of suite budget; the sharded==single-device loss parity is
+    # test_lm_train_step_dp_sp_tp's job)
+    import optax
+
+    logits0 = plain.apply({"params": state.params}, toks)
+    loss0 = optax.softmax_cross_entropy_with_integer_labels(
+        logits0, tgts).mean()
+    assert abs(float(m1["loss"]) - float(loss0)) > 1e-4
 
     # composes with scan_layers (the dropout rng must be lifted through
     # nn.scan's split_rngs or apply raises InvalidRngError)

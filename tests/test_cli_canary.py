@@ -1,0 +1,28 @@
+"""Fast-tier CLI canary: ONE full trainer runs end-to-end by default.
+
+The heavy trainer smokes live in the `slow` tier (test_examples.py); a
+default `pytest tests/` run still must prove the whole stack — flag
+parsing, config merge, data pipeline, sharded faithful quantized step,
+checkpointing, log protocol — hangs together, so this single smoke stays
+in the fast tier.  Kept to one compile (~15 s): reference-parity flags,
+faithful mode, APS e5m2, real-format CIFAR tree.
+"""
+
+import math
+
+import numpy as np
+
+
+def test_resnet18_cli_canary(tmp_path, tiny_cifar_factory):
+    from resnet18_cifar.train import main
+
+    root = tiny_cifar_factory(tmp_path / "cifar", n_train=160, n_test=32)
+    res = main(["--use_APS", "--grad_exp", "5", "--grad_man", "2",
+                "--emulate_node", "2", "--arch", "tiny",
+                "--data-root", root, "--max-iter", "2",
+                "--batch_size", "2", "--val_freq", "2",
+                "--save_path", str(tmp_path / "ck"), "--mode", "faithful"])
+    assert res["step"] == 2
+    assert math.isfinite(res["loss"])
+    assert np.isfinite(res["best_prec1"])
+    assert not res["diverged"]

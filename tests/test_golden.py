@@ -8,13 +8,7 @@ visibly underflows without APS) on the learnable synthetic CIFAR set,
 fixed seeds throughout, so the run is deterministic on the CPU mesh.
 """
 
-import os
-import sys
-
 import pytest
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(REPO, "examples"))
 
 pytestmark = pytest.mark.slow
 
@@ -48,16 +42,15 @@ def test_aps_recovers_lm_loss(tmp_path):
     assert aps <= 3.5, aps         # actually learning the Markov chain
 
 
-def test_golden_arm_on_real_format_cifar(tmp_path):
+def test_golden_arm_on_real_format_cifar(tmp_path, tiny_cifar_factory):
     """QUICKSTART.md contract: `aps_golden --data-root <real tree>` works
     end-to-end with zero edits.  A real-format CIFAR-10 pickle tree (tiny,
     random pixels) flows through the golden arm's full CLI path; strict
     explicit-root loading means this cannot silently fall back to
     synthetic data."""
     import aps_golden
-    from test_examples import _write_tiny_cifar
 
-    root = _write_tiny_cifar(tmp_path / "cifar")
+    root = tiny_cifar_factory(tmp_path / "cifar")
     res = aps_golden.run_experiment(
         iters=6, save_root=str(tmp_path / "runs"), batch_size=8,
         configs=[("fp32", 8, 23, False)], data_root=root)

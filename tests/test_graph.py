@@ -109,6 +109,7 @@ def test_graph_davidnet_matches_flax_architecture(graph_model_and_vars):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_graph_davidnet_bf16_head_stays_fp32():
     """bf16 compute must still emit fp32 logits (DavidNet head parity)."""
     model = graph_davidnet(channels={"prep": 4, "layer1": 8, "layer2": 8,
