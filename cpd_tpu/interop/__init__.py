@@ -1,0 +1,13 @@
+"""Interop: import reference (CPDtorch/torchvision) checkpoints into
+cpd_tpu models."""
+
+from .torch_import import (assert_compatible, convert_bn, convert_conv,
+                           convert_linear, import_reference_resnet18_cifar,
+                           import_torchvision_resnet,
+                           load_reference_checkpoint, strip_module_prefix)
+
+__all__ = [
+    "assert_compatible", "convert_bn", "convert_conv", "convert_linear",
+    "import_reference_resnet18_cifar", "import_torchvision_resnet",
+    "load_reference_checkpoint", "strip_module_prefix",
+]

@@ -1,0 +1,218 @@
+"""Import torch checkpoints (CPDtorch reference / torchvision ResNets) into
+cpd_tpu's flax models.
+
+A reference user's trained artifacts are `.pth` files: torchvision-style
+ImageNet ResNets (example/ResNet50/main.py:67 instantiates
+`torchvision.models.resnet50()`) and the reference's own CIFAR ResNet-18
+(example/ResNet18/models/resnet18_cifar.py), saved by
+`save_checkpoint` as `{"state_dict": ..., ...}` with optional DDP
+`module.` prefixes (utils/train_util.py:268-299).  These converters map
+those state_dicts onto our NHWC flax pytrees so migration does not forfeit
+trained models.
+
+Layout rules (torch -> flax):
+  * Conv2d weight  (O, I, kH, kW) -> nn.Conv kernel (kH, kW, I, O)
+  * Linear weight  (O, I)         -> nn.Dense kernel (I, O); bias as-is
+  * BatchNorm2d    weight/bias    -> scale/bias (params);
+                   running_mean/var -> mean/var (batch_stats);
+                   num_batches_tracked has no flax equivalent (dropped)
+
+Everything takes/returns numpy — torch is only needed (lazily) to
+`torch.load` a pickle; converted trees feed `model.apply` directly and are
+verified by forward-parity tests against live torch modules
+(tests/test_interop.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = [
+    "convert_conv", "convert_linear", "convert_bn", "strip_module_prefix",
+    "import_reference_resnet18_cifar", "import_torchvision_resnet",
+    "load_reference_checkpoint",
+]
+
+
+def _np(t) -> np.ndarray:
+    """torch.Tensor | array-like -> float32/int numpy (host)."""
+    if hasattr(t, "detach"):          # torch.Tensor without importing torch
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def convert_conv(weight) -> np.ndarray:
+    """Conv2d (O, I, kH, kW) -> flax (kH, kW, I, O)."""
+    w = _np(weight)
+    if w.ndim != 4:
+        raise ValueError(f"conv weight must be 4-D, got {w.shape}")
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def convert_linear(weight) -> np.ndarray:
+    """Linear (O, I) -> flax Dense kernel (I, O)."""
+    w = _np(weight)
+    if w.ndim != 2:
+        raise ValueError(f"linear weight must be 2-D, got {w.shape}")
+    return w.T
+
+
+def convert_bn(sd: Mapping[str, Any], prefix: str) -> tuple[dict, dict]:
+    """BatchNorm2d at `prefix` -> ({scale, bias}, {mean, var})."""
+    params = {"scale": _np(sd[f"{prefix}.weight"]),
+              "bias": _np(sd[f"{prefix}.bias"])}
+    stats = {"mean": _np(sd[f"{prefix}.running_mean"]),
+             "var": _np(sd[f"{prefix}.running_var"])}
+    return params, stats
+
+
+def strip_module_prefix(sd: Mapping[str, Any]) -> dict:
+    """Drop DDP's `module.` key prefix (train_util.py:286-299 does the same
+    dance in both directions; import always wants it gone)."""
+    if any(k.startswith("module.") for k in sd):
+        return {k[len("module."):] if k.startswith("module.") else k: v
+                for k, v in sd.items()}
+    return dict(sd)
+
+
+def load_reference_checkpoint(path: str) -> dict:
+    """torch.load a reference `.pth` and return its bare state_dict
+    (module-prefix stripped).  Accepts the reference's two wrapper
+    flavors — `{"state_dict": ...}` (ResNet-18 trainer,
+    train_util.py:269) and `{"model": ...}` (ResNet-50 trainer,
+    example/ResNet50/main.py:258-264) — and a raw state_dict."""
+    import torch  # lazy: converters themselves are torch-free
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    sd = ckpt
+    if isinstance(ckpt, dict):
+        for key in ("state_dict", "model"):
+            if key in ckpt and isinstance(ckpt[key], dict):
+                sd = ckpt[key]
+                break
+    return strip_module_prefix(sd)
+
+
+def assert_compatible(converted: dict, init_vars: Mapping[str, Any]) -> None:
+    """Raise a named error if a converted tree does not match the target
+    model's freshly initialized variables (params + batch_stats) in
+    structure and leaf shapes — an arch/num-classes mismatch must fail at
+    import time, not deep inside the first sharded step."""
+    import jax
+
+    def _shape(leaf):
+        # works for arrays AND jax.eval_shape's ShapeDtypeStructs
+        return tuple(getattr(leaf, "shape", None) or np.shape(leaf))
+
+    for col in ("params", "batch_stats"):
+        want = jax.tree_util.tree_flatten_with_path(init_vars[col])[0]
+        got = jax.tree_util.tree_flatten_with_path(converted[col])[0]
+        want_map = {jax.tree_util.keystr(p): _shape(l) for p, l in want}
+        got_map = {jax.tree_util.keystr(p): _shape(l) for p, l in got}
+        if set(want_map) != set(got_map):
+            missing = sorted(set(want_map) - set(got_map))
+            extra = sorted(set(got_map) - set(want_map))
+            raise ValueError(
+                f"imported checkpoint does not match the model's {col} "
+                f"tree (wrong --arch?): missing={missing[:5]} "
+                f"extra={extra[:5]}")
+        for key, shape in want_map.items():
+            if got_map[key] != shape:
+                raise ValueError(
+                    f"imported {col}{key} has shape {got_map[key]}, model "
+                    f"expects {shape} (wrong --arch/--num-classes?)")
+
+
+def _bn_into(tree_params, tree_stats, name, sd, prefix):
+    p, s = convert_bn(sd, prefix)
+    tree_params[name] = p
+    tree_stats[name] = s
+
+
+def import_reference_resnet18_cifar(sd: Mapping[str, Any]) -> dict:
+    """Reference CIFAR ResNet-18 state_dict -> variables for
+    `models.resnet18_cifar()`.
+
+    Key map (reference resnet18_cifar.py:48-87 builds everything from
+    nn.Sequential, so children are numeric):
+        conv1.0 / conv1.1                -> stem_conv / stem_bn
+        layer{s}.{b}.left.0/.1/.3/.4     -> layer{s}_block{b}.conv1/bn1/conv2/bn2
+        layer{s}.{b}.shortcut.0/.1       -> layer{s}_block{b}.shortcut_conv/_bn
+        fc                               -> fc
+    """
+    sd = strip_module_prefix(sd)
+    params: dict = {"stem_conv": {"kernel": convert_conv(sd["conv1.0.weight"])}}
+    stats: dict = {}
+    _bn_into(params, stats, "stem_bn", sd, "conv1.1")
+
+    for stage in range(1, 5):
+        block = 0
+        while f"layer{stage}.{block}.left.0.weight" in sd:
+            src = f"layer{stage}.{block}"
+            dst = f"layer{stage}_block{block}"
+            bp: dict = {
+                "conv1": {"kernel": convert_conv(sd[f"{src}.left.0.weight"])},
+                "conv2": {"kernel": convert_conv(sd[f"{src}.left.3.weight"])},
+            }
+            bs: dict = {}
+            _bn_into(bp, bs, "bn1", sd, f"{src}.left.1")
+            _bn_into(bp, bs, "bn2", sd, f"{src}.left.4")
+            if f"{src}.shortcut.0.weight" in sd:
+                bp["shortcut_conv"] = {
+                    "kernel": convert_conv(sd[f"{src}.shortcut.0.weight"])}
+                _bn_into(bp, bs, "shortcut_bn", sd, f"{src}.shortcut.1")
+            params[dst] = bp
+            stats[dst] = bs
+            block += 1
+        if block == 0:
+            raise KeyError(f"layer{stage} missing from state_dict")
+
+    params["fc"] = {"kernel": convert_linear(sd["fc.weight"]),
+                    "bias": _np(sd["fc.bias"])}
+    return {"params": params, "batch_stats": stats}
+
+
+def import_torchvision_resnet(sd: Mapping[str, Any]) -> dict:
+    """torchvision-style ResNet state_dict (resnet18/34/50/101 — the
+    flagship `torchvision.models.resnet50()`, main.py:67) -> variables for
+    the matching `models.resnet{18,34,50,101}()`.
+
+    Key map:
+        conv1 / bn1                       -> stem_conv / stem_bn
+        layer{s}.{b}.conv{i}/bn{i}        -> layer{s}_block{b}.conv{i}/bn{i}
+        layer{s}.{b}.downsample.0/.1      -> layer{s}_block{b}.downsample_conv/_bn
+        fc                                -> fc
+    """
+    sd = strip_module_prefix(sd)
+    params: dict = {"stem_conv": {"kernel": convert_conv(sd["conv1.weight"])}}
+    stats: dict = {}
+    _bn_into(params, stats, "stem_bn", sd, "bn1")
+
+    for stage in range(1, 5):
+        block = 0
+        while f"layer{stage}.{block}.conv1.weight" in sd:
+            src = f"layer{stage}.{block}"
+            dst = f"layer{stage}_block{block}"
+            bp: dict = {}
+            bs: dict = {}
+            conv = 1
+            while f"{src}.conv{conv}.weight" in sd:
+                bp[f"conv{conv}"] = {
+                    "kernel": convert_conv(sd[f"{src}.conv{conv}.weight"])}
+                _bn_into(bp, bs, f"bn{conv}", sd, f"{src}.bn{conv}")
+                conv += 1
+            if f"{src}.downsample.0.weight" in sd:
+                bp["downsample_conv"] = {
+                    "kernel": convert_conv(sd[f"{src}.downsample.0.weight"])}
+                _bn_into(bp, bs, "downsample_bn", sd, f"{src}.downsample.1")
+            params[dst] = bp
+            stats[dst] = bs
+            block += 1
+        if block == 0:
+            raise KeyError(f"layer{stage} missing from state_dict")
+
+    params["fc"] = {"kernel": convert_linear(sd["fc.weight"]),
+                    "bias": _np(sd["fc.bias"])}
+    return {"params": params, "batch_stats": stats}

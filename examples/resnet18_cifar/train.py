@@ -39,6 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dist", action="store_true",
                    help="multi-host: call jax.distributed.initialize()")
     p.add_argument("--load-path", default="", type=str)
+    p.add_argument("--init-from-torch", default="", type=str,
+                   help="warm-start params+BN stats from a reference "
+                        "CPDtorch .pth checkpoint (res_cifar arch; "
+                        "cpd_tpu.interop converts the layout)")
     p.add_argument("--grad_exp", default=5, type=int)
     p.add_argument("--grad_man", default=2, type=int)
     p.add_argument("--resume-opt", action="store_true")
@@ -146,7 +150,26 @@ def main(argv=None) -> dict:
     ckpt_dir = os.path.abspath(args.save_path)
     manager = CheckpointManager(ckpt_dir, track_best=True)
     start_iter = 0
-    if args.load_path:
+    if args.init_from_torch and args.load_path:
+        raise SystemExit("--init-from-torch and --load-path are exclusive")
+    if args.init_from_torch:
+        # Migration path: continue training / evaluate a model trained by
+        # the torch reference (docs/MIGRATING.md).  Params + BN running
+        # stats come from the .pth; optimizer state starts fresh.  Takes
+        # the same precedence --load-path has: auto-resume from save_path
+        # must NOT silently overwrite an explicitly requested import.
+        from cpd_tpu.interop import (assert_compatible,
+                                     import_reference_resnet18_cifar,
+                                     load_reference_checkpoint)
+        sd = load_reference_checkpoint(args.init_from_torch)
+        converted = import_reference_resnet18_cifar(sd)
+        assert_compatible(converted, {"params": state.params,
+                                      "batch_stats": state.batch_stats})
+        state = state.replace(params=converted["params"],
+                              batch_stats=converted["batch_stats"])
+        if rank == 0:
+            print(f"=> imported torch checkpoint {args.init_from_torch}")
+    elif args.load_path:
         # Warm-start from an explicit checkpoint dir (mix.py --load-path /
         # train_util.load_state:274-318); --resume-opt additionally restores
         # the optimizer state and step counter, else params only.

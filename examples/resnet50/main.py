@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad_man", type=int, default=23)
     # new surface
     p.add_argument("--arch", default="resnet50")
+    p.add_argument("--init-from-torch", default="", type=str,
+                   help="warm-start params+BN stats from a torchvision-"
+                        "style .pth checkpoint (cpd_tpu.interop converts "
+                        "the layout)")
     p.add_argument("--num-classes", default=1000, type=int)
     p.add_argument("--dist", action="store_true")
     p.add_argument("--max-batches-per-epoch", default=None, type=int)
@@ -154,6 +158,21 @@ def main(argv=None) -> dict:
     state = create_train_state(
         model, tx, jnp.zeros((2, args.image_size, args.image_size, 3)),
         jax.random.PRNGKey(args.seed))
+    if args.init_from_torch:
+        # Migration path: params + BN stats from a torchvision-style .pth
+        # (the reference trains torchvision.models.resnet50(), main.py:67;
+        # layout conversion in cpd_tpu.interop, docs/MIGRATING.md)
+        from cpd_tpu.interop import (assert_compatible,
+                                     import_torchvision_resnet,
+                                     load_reference_checkpoint)
+        converted = import_torchvision_resnet(
+            load_reference_checkpoint(args.init_from_torch))
+        assert_compatible(converted, {"params": state.params,
+                                      "batch_stats": state.batch_stats})
+        state = state.replace(params=converted["params"],
+                              batch_stats=converted["batch_stats"])
+        if rank == 0:
+            print(f"=> imported torch checkpoint {args.init_from_torch}")
     zero = None
     if sum((args.zero1, args.zero2, args.zero3)) > 1:
         raise ValueError("--zero1/--zero2/--zero3 are mutually exclusive")
@@ -187,8 +206,10 @@ def main(argv=None) -> dict:
                                 track_best=True)
     start_epoch = 0
     start_it = 0
-    restored = manager.restore(zero.portable_template(state)
-                               if args.zero3 else state)
+    # Auto-resume must not silently overwrite an explicitly requested torch
+    # import — an explicit --init-from-torch run starts from the .pth
+    restored = None if args.init_from_torch else manager.restore(
+        zero.portable_template(state) if args.zero3 else state)
     if restored is not None:                 # auto-resume (main.py:70-75)
         state = restored
         meta = manager.metadata()
