@@ -16,7 +16,12 @@ Usage:
     # out: (B, T_prompt + max_new_tokens) int32
 
 ``temperature=0`` is greedy argmax; ``temperature>0`` samples from
-``softmax(logits / temperature)`` (requires ``rng``).  Decode is
+``softmax(logits / temperature)`` (requires ``rng``), optionally
+restricted by ``top_k`` (k highest-logit tokens) and/or ``top_p``
+(smallest nucleus whose probability mass reaches p) — both applied as
+static masks inside the jitted program.  ``eos_id`` freezes a sequence
+once it emits that token (subsequent positions repeat ``eos_id``; the
+scan still runs to static length, as TPU shapes demand).  Decode is
 single-device (the training-time sp/tp shardings do not apply; pass the
 plain unsharded module).
 """
@@ -30,7 +35,46 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["generate"]
+__all__ = ["generate", "filter_logits"]
+
+_NEG_INF = jnp.float32(-1e30)
+
+
+def filter_logits(logits: jnp.ndarray, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jnp.ndarray:
+    """Mask logits (..., V) to the top-k set and/or the top-p nucleus.
+
+    top-k: keep the k highest logits.  top-p: keep the SMALLEST prefix of
+    the probability-sorted vocabulary whose cumulative mass reaches p
+    (the standard nucleus rule — the token that crosses the threshold is
+    kept).  Masked entries become -1e30, so a later softmax/categorical
+    assigns them zero probability.  Pure and jit-safe; k and p are
+    trace-time constants."""
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_k < logits.shape[-1]:
+            kth = lax.top_k(logits, top_k)[0][..., -1, None]
+            logits = jnp.where(logits < kth, _NEG_INF, logits)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_p < 1.0:
+            # one full descending sort (top_k(V)); when top_k also ran,
+            # its mask is already folded into `logits`, so the nucleus is
+            # taken within the top-k set (the standard composition)
+            sorted_desc = lax.top_k(logits, logits.shape[-1])[0]
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            # exclusive cumulative mass BEFORE each sorted token; a token
+            # is kept while that mass is still < p (so the crossing token
+            # stays in)
+            before = jnp.cumsum(probs, axis=-1) - probs
+            keep = before < top_p
+            # cutoff = smallest kept logit; everything below is masked
+            cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                             axis=-1, keepdims=True)
+            logits = jnp.where(logits < cutoff, _NEG_INF, logits)
+    return logits
 
 
 @functools.lru_cache(maxsize=32)
@@ -45,17 +89,30 @@ def _cache_shapes(decoder, b: int, t_max: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _make_run(decoder, max_new_tokens: int, temperature: float):
+def _make_run(decoder, max_new_tokens: int, temperature: float,
+              top_k: Optional[int], top_p: Optional[float],
+              eos_id: Optional[int]):
     """Build the jitted prefill+scan program once per (module, length,
-    temperature) — flax modules hash by their field values, so repeat
+    sampling config) — flax modules hash by their field values, so repeat
     generate() calls hit jit's trace cache instead of recompiling."""
 
     def sample(logits_last, key):
         if temperature == 0:
+            if top_k is not None or top_p is not None:
+                raise ValueError(
+                    "top_k/top_p require temperature > 0 (greedy argmax "
+                    "is unaffected by the filtered tail)")
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits_last / jnp.float32(temperature), axis=-1
-        ).astype(jnp.int32)
+        logits = filter_logits(logits_last / jnp.float32(temperature),
+                               top_k, top_p)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def freeze(tok, done):
+        """Once a sequence emitted eos, it keeps emitting eos."""
+        if eos_id is None:
+            return tok, jnp.zeros(tok.shape, bool) if done is None else done
+        done = (tok == eos_id) if done is None else done | (tok == eos_id)
+        return jnp.where(done, jnp.int32(eos_id), tok), done
 
     @jax.jit
     def run(params, cache, prompt, rng):
@@ -63,21 +120,21 @@ def _make_run(decoder, max_new_tokens: int, temperature: float):
         logits, mut = decoder.apply({"params": params, "cache": cache},
                                     prompt, train=False, mutable=["cache"])
         key0, rng = jax.random.split(rng)
-        first = sample(logits[:, -1], key0)
+        first, done = freeze(sample(logits[:, -1], key0), None)
 
         def step(carry, _):
-            cache, tok, rng = carry
+            cache, tok, done, rng = carry
             key, rng = jax.random.split(rng)
             logits, mut = decoder.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 train=False, mutable=["cache"])
-            nxt = sample(logits[:, -1], key)
-            return (mut["cache"], nxt, rng), tok
+            nxt, done = freeze(sample(logits[:, -1], key), done)
+            return (mut["cache"], nxt, done, rng), tok
 
         # each step emits its input token and computes the next; the final
         # carry token is the max_new-th generated token
-        (_, last, _), toks = lax.scan(
-            step, (mut["cache"], first, rng), None,
+        (_, last, _, _), toks = lax.scan(
+            step, (mut["cache"], first, done, rng), None,
             length=max_new_tokens - 1)
         new = jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
         return jnp.concatenate([prompt, new], axis=1)
@@ -86,11 +143,13 @@ def _make_run(decoder, max_new_tokens: int, temperature: float):
 
 
 def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
-             temperature: float = 0.0,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None, eos_id: Optional[int] = None,
              rng: Optional[jax.Array] = None) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, T_p).
 
-    Returns (B, T_p + max_new_tokens) int32 — prompt included.
+    Returns (B, T_p + max_new_tokens) int32 — prompt included.  With
+    ``eos_id``, positions after a sequence's first eos all hold eos_id.
     """
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
@@ -98,6 +157,13 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
         raise ValueError("temperature > 0 requires an rng key")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    # validate eagerly (filter_logits re-checks at trace time)
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature == 0 and (top_k is not None or top_p is not None):
+        raise ValueError("top_k/top_p require temperature > 0")
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t_p = prompt.shape
     t_max = t_p + max_new_tokens
@@ -113,5 +179,6 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
     # carry needs an array either way; greedy sampling ignores it
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
-    run = _make_run(decoder, max_new_tokens, float(temperature))
+    run = _make_run(decoder, max_new_tokens, float(temperature),
+                    top_k, top_p, eos_id)
     return run(params, cache0, prompt, rng)

@@ -104,10 +104,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="residual-branch dropout rate (train only; "
                         "default dp/sp/tp path)")
     p.add_argument("--sample", default=0, type=int,
-                   help="after training, greedy-decode this many tokens "
-                        "from a data prompt (KV-cache generate; default "
-                        "dp/sp/tp path only — pp/moe modules have no "
-                        "decode mode)")
+                   help="after training, decode this many tokens from a "
+                        "data prompt (KV-cache generate; default dp/sp/tp "
+                        "path only — pp/moe modules have no decode mode)")
+    p.add_argument("--sample-temperature", default=0.0, type=float,
+                   help="0 = greedy argmax; >0 samples softmax(l/T)")
+    p.add_argument("--sample-top-k", default=None, type=int,
+                   help="restrict sampling to the k best tokens "
+                        "(needs --sample-temperature > 0)")
+    p.add_argument("--sample-top-p", default=None, type=float,
+                   help="nucleus sampling mass in (0,1] "
+                        "(needs --sample-temperature > 0)")
+    p.add_argument("--sample-seed", default=0, type=int,
+                   help="rng seed for temperature sampling")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
     return p
@@ -129,6 +138,16 @@ def main(argv=None) -> dict:
     from cpd_tpu.utils import ProgressPrinter, ScalarWriter, StepProfiler
 
     rank, world = dist_init() if args.dist else (0, 1)
+    # sampling-flag validation BEFORE training: a bad combination must not
+    # surface as a crash after the whole run completed
+    if args.sample_temperature == 0 and (args.sample_top_k is not None
+                                         or args.sample_top_p is not None):
+        raise ValueError("--sample-top-k/--sample-top-p require "
+                         "--sample-temperature > 0")
+    if args.sample_top_k is not None and args.sample_top_k < 1:
+        raise ValueError("--sample-top-k must be >= 1")
+    if args.sample_top_p is not None and not 0.0 < args.sample_top_p <= 1.0:
+        raise ValueError("--sample-top-p must be in (0, 1]")
     if (args.pp > 1 or args.moe) and (args.sp > 1 or args.tp > 1):
         raise ValueError("--pp/--moe do not compose with sp/tp here")
     if args.pp > 1 and args.moe:
@@ -330,10 +349,19 @@ def main(argv=None) -> dict:
     manager.close()
     profiler.close()
     dt = time.time() - t0
+    ran = step_no - start_iter
     if rank == 0 and not (preempted or diverged):
-        print(f"done: {args.max_iter} iters in {dt:.1f}s "
-              f"({args.max_iter * global_batch * args.seq_len / dt:.0f} "
-              f"tok/s) final loss {last.get('loss', float('nan')):.4f}")
+        if last:
+            # count only the iters THIS run executed — a partial resume
+            # must not overstate the throughput
+            print(f"done: {ran} iters in {dt:.1f}s "
+                  f"({ran * global_batch * args.seq_len / dt:.0f}"
+                  f" tok/s) final loss {last['loss']:.4f}")
+        else:
+            # resumed at/past max_iter: no step ran — say so instead of
+            # printing a placeholder nan that reads like divergence
+            print(f"done: resumed at iter {start_iter}, nothing left to "
+                  f"train (max_iter {args.max_iter})")
     sampled = None
     if args.sample > 0 and not (preempted or diverged):
         from jax.sharding import NamedSharding, PartitionSpec
@@ -347,10 +375,17 @@ def main(argv=None) -> dict:
         gather = jax.jit(lambda p: p,
                          out_shardings=NamedSharding(mesh, PartitionSpec()))
         out = generate(init_model, jax.device_get(gather(state.params)),
-                       prompt, max_new_tokens=args.sample)
+                       prompt, max_new_tokens=args.sample,
+                       temperature=args.sample_temperature,
+                       top_k=args.sample_top_k, top_p=args.sample_top_p,
+                       rng=(jax.random.PRNGKey(args.sample_seed)
+                            if args.sample_temperature > 0 else None))
         sampled = np.asarray(out)[0].tolist()
         if rank == 0:
-            print(f"sample (greedy, {args.sample} new tokens): {sampled}")
+            how = ("greedy" if args.sample_temperature == 0 else
+                   f"T={args.sample_temperature} k={args.sample_top_k} "
+                   f"p={args.sample_top_p}")
+            print(f"sample ({how}, {args.sample} new tokens): {sampled}")
     writer.close()
     return {"step": step_no, "diverged": diverged,
             **({"sample": sampled} if sampled is not None else {}), **last}

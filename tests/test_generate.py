@@ -130,3 +130,80 @@ def test_decode_rejects_sharded_axes():
                            decode=True)
     with pytest.raises(ValueError, match="single-device"):
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+# --------------------------------------------------- sampling strategies
+
+def test_filter_logits_top_k():
+    from cpd_tpu.models.generate import filter_logits
+
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    out = np.asarray(filter_logits(logits, top_k=2))
+    # only the two largest (5.0 at idx 1, 4.0 at idx 4) survive
+    assert (out[0, [1, 4]] == [5.0, 4.0]).all()
+    assert (out[0, [0, 2, 3]] < -1e29).all()
+    # k >= V is a no-op
+    np.testing.assert_array_equal(
+        np.asarray(filter_logits(logits, top_k=5)), np.asarray(logits))
+
+
+def test_filter_logits_top_p_nucleus_rule():
+    from cpd_tpu.models.generate import filter_logits
+
+    # softmax of [2, 1, 0, -1] ≈ [0.644, 0.237, 0.087, 0.032]
+    logits = jnp.asarray([2.0, 1.0, 0.0, -1.0])
+    probs = np.asarray(jax.nn.softmax(logits))
+    # p just under the top prob: nucleus is exactly the argmax (the
+    # crossing token is kept)
+    out = np.asarray(filter_logits(logits, top_p=probs[0] - 1e-4))
+    assert out[0] == 2.0 and (out[1:] < -1e29).all()
+    # p between first and first-two mass: nucleus = two tokens
+    out = np.asarray(filter_logits(logits, top_p=float(probs[0] + 1e-4)))
+    assert (out[:2] == [2.0, 1.0]).all() and (out[2:] < -1e29).all()
+    # p=1 keeps everything
+    np.testing.assert_array_equal(
+        np.asarray(filter_logits(logits, top_p=1.0)), np.asarray(logits))
+
+
+def test_generate_top_k1_equals_greedy():
+    """top_k=1 sampling must reproduce argmax regardless of temperature."""
+    model, params = _model_and_params()
+    rng = np.random.RandomState(3)
+    prompt = jnp.asarray(rng.randint(0, 32, (2, 4)).astype(np.int32))
+    greedy = generate(model, params, prompt, max_new_tokens=6)
+    topk1 = generate(model, params, prompt, max_new_tokens=6,
+                     temperature=0.7, top_k=1, rng=jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+
+def test_generate_eos_freezes_sequence():
+    """After the first eos, every later position repeats eos_id."""
+    model, params = _model_and_params()
+    rng = np.random.RandomState(4)
+    prompt = jnp.asarray(rng.randint(0, 32, (2, 4)).astype(np.int32))
+    free = np.asarray(generate(model, params, prompt, max_new_tokens=8))
+    # pick the token sequence 0 actually generates second, force it as eos
+    eos = int(free[0, 5])
+    out = np.asarray(generate(model, params, prompt, max_new_tokens=8,
+                              eos_id=eos))
+    # greedy path identical up to the first eos, frozen after it
+    gen = out[0, 4:]
+    first = int(np.argmax(gen == eos))
+    assert gen[first] == eos
+    assert (gen[first:] == eos).all()
+    # sequences that never emit eos are untouched
+    if eos not in free[1, 4:]:
+        np.testing.assert_array_equal(out[1], free[1])
+
+
+def test_generate_sampling_validation():
+    model, params = _model_and_params()
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        generate(model, params, prompt, 2, top_k=3)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=1.0, top_p=1.5,
+                 rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, temperature=1.0, top_k=0,
+                 rng=jax.random.PRNGKey(0))
