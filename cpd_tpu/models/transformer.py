@@ -24,6 +24,7 @@ rules); train/lm.py consumes it for the whole-step shard_map.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -81,6 +82,14 @@ class Block(nn.Module):
                                 # the tp index into the rng)
     deterministic: bool = True  # False during training (LM threads its
                                 # train flag here)
+    ffn_exp: int = 8            # eXmY-accumulator GEMMs for the MLP pair
+    ffn_man: int = 23           # (wi/wo_mlp) when != (8, 23): the
+                                # reference's quantized forward/backward
+                                # recipe (quant_module.py:30-52) composed
+                                # into the LM.  Param layout stays Dense-
+                                # compatible (QuantDense), so checkpoints
+                                # and tp specs are unchanged.
+    ffn_mode: str = "faithful"
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
@@ -206,12 +215,19 @@ class Block(nn.Module):
         if self.mlp is not None:
             out = x + self.mlp()(h)
         else:
-            h = nn.Dense(self.d_ff // self.tp_size, use_bias=False,
-                         dtype=self.dtype, name="wi")(h)
+            if (self.ffn_exp, self.ffn_man) != (8, 23):
+                from ..quant.quant_module import QuantDense
+                dense = partial(QuantDense, exp=self.ffn_exp,
+                                man=self.ffn_man, mode=self.ffn_mode)
+            else:
+                dense = partial(nn.Dense, use_bias=False, dtype=self.dtype)
+            h = dense(self.d_ff // self.tp_size, name="wi")(h)
             h = nn.gelu(h)
-            h = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
-                         name="wo_mlp")(h)
-            out = x + self._dropout(self._psum_tp(h))
+            h = dense(self.d_model, name="wo_mlp")(h)
+            # psum BEFORE any downcast: the quant path's per-shard fp32
+            # accumulator results must reduce in fp32 (QuantDense's
+            # documented contract); the plain path's h is already dtype
+            out = x + self._dropout(self._psum_tp(h).astype(x.dtype))
         return (out, None) if self.scan_pair else out
 
     def _dropout(self, x):
@@ -251,6 +267,9 @@ class TransformerLM(nn.Module):
                                 # leading (n_layers,) axis (a different
                                 # checkpoint layout — lm_param_specs is
                                 # rank-aware for it)
+    ffn_exp: int = 8        # quantized-accumulator MLP GEMMs when !=
+    ffn_man: int = 23       # (8, 23) — see Block.ffn_exp
+    ffn_mode: str = "faithful"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -297,7 +316,8 @@ class TransformerLM(nn.Module):
                         dtype=self.dtype, sp_mode=self.sp_mode,
                         decode=self.decode, n_kv_heads=self.n_kv_heads,
                         dropout_rate=self.dropout_rate,
-                        deterministic=not train)
+                        deterministic=not train, ffn_exp=self.ffn_exp,
+                        ffn_man=self.ffn_man, ffn_mode=self.ffn_mode)
         if self.scan_layers:
             if self.decode:
                 raise ValueError("scan_layers does not compose with "

@@ -1,6 +1,6 @@
 from .numerics import cast_to_format, cast_oracle, max_finite
 from .quant_function import float_quantize, quantizer, quant_gemm
-from .quant_module import Quantizer, QuantLinear, QuantConv
+from .quant_module import Quantizer, QuantDense, QuantLinear, QuantConv
 
 __all__ = [
     "cast_to_format",
@@ -10,6 +10,7 @@ __all__ = [
     "quantizer",
     "quant_gemm",
     "Quantizer",
+    "QuantDense",
     "QuantLinear",
     "QuantConv",
 ]

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -28,7 +28,8 @@ from jax import lax
 
 from .quant_function import float_quantize, quant_gemm, quantizer
 
-__all__ = ["Quantizer", "QuantLinear", "QuantConv", "quant_linear_fn"]
+__all__ = ["Quantizer", "QuantLinear", "QuantConv", "QuantDense",
+           "quant_linear_fn"]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -110,6 +111,40 @@ class QuantLinear(nn.Module):
         y = quant_linear_fn(x2, weight, bias, self.exp, self.man, self.mode)
         y = y.reshape(*x.shape[:-1], self.out_features) if not squeeze else y[0]
         return y
+
+
+class QuantDense(nn.Module):
+    """Drop-in nn.Dense with the eXmY-accumulator GEMM.
+
+    Unlike `QuantLinear` (torch API parity: (out, in) "weight",
+    kaiming-uniform), this keeps flax's Dense contract — param named
+    "kernel", shape (in, out), lecun-normal init — so it substitutes for
+    nn.Dense inside existing models WITHOUT changing checkpoint layout or
+    the tp PartitionSpec rules keyed on Dense kernels (e.g. the
+    transformer's wi/wo_mlp, models/transformer.py).  Forward/backward
+    run the same reference custom_vjp recipe as QuantLinear
+    (quant_module.py:30-52); under tensor parallelism the quantized
+    accumulation is per-shard with an fp32 psum on top, which changes
+    rounding exactly the way the reference's per-rank dp reduction does.
+    """
+    features: int
+    use_bias: bool = False
+    exp: int = 8
+    man: int = 23
+    mode: str = "faithful"
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features), self.param_dtype)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), self.param_dtype)
+                if self.use_bias else None)
+        x2 = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+        y = quant_linear_fn(x2, kernel.astype(jnp.float32).T, bias,
+                            self.exp, self.man, self.mode)
+        return y.reshape(*x.shape[:-1], self.features)
 
 
 class QuantConv(nn.Module):

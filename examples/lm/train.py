@@ -93,6 +93,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensorboard", action="store_true",
                    help="also write TensorBoard event files next to the "
                         "JSONL scalars (reference mix.py:16,168-171)")
+    p.add_argument("--ffn-exp", default=8, type=int,
+                   help="MLP GEMM accumulator exponent bits; when "
+                        "(--ffn-exp, --ffn-man) != (8, 23) the blocks' "
+                        "wi/wo_mlp run the reference quantized GEMM "
+                        "recipe")
+    p.add_argument("--ffn-man", default=23, type=int)
+    p.add_argument("--ffn-mode", default="faithful",
+                   choices=["faithful", "fast"],
+                   help="faithful = ordered Kahan accumulation (bit-exact "
+                        "reference emulation, the API default); fast = "
+                        "cast-and-dot")
     p.add_argument("--bf16", action="store_true",
                    help="bf16 compute dtype (fp32 master params; the "
                         "MXU-native precision — --half analog of the "
@@ -196,6 +207,12 @@ def main(argv=None) -> dict:
     model_kw = dict(vocab_size=args.vocab_size, d_model=args.d_model,
                     n_layers=args.n_layers, n_heads=args.n_heads,
                     dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    if (args.ffn_exp, args.ffn_man) != (8, 23):
+        if args.pp > 1 or args.moe:
+            raise ValueError("--ffn-exp/--ffn-man apply to the default "
+                             "dp/sp/tp TransformerLM path only")
+        model_kw.update(ffn_exp=args.ffn_exp, ffn_man=args.ffn_man,
+                        ffn_mode=args.ffn_mode)
     if args.lr_schedule == "cosine":
         from cpd_tpu.train import warmup_cosine
         schedule = warmup_cosine(args.base_lr, args.warmup_iters,

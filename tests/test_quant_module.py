@@ -125,3 +125,41 @@ def test_quant_conv_groups_must_divide():
     m = QuantConv(in_channels=3, out_channels=4, kernel_size=3, groups=2)
     with pytest.raises(ValueError):
         m.init(jax.random.PRNGKey(0), jnp.ones((1, 3, 6, 6)))
+
+
+# ------------------------------------------------------------- QuantDense
+
+def test_quant_dense_matches_quant_linear_fn():
+    """QuantDense is quant_linear_fn under flax Dense param layout."""
+    from cpd_tpu.quant.quant_module import QuantDense, quant_linear_fn
+
+    rng = np.random.RandomState(40)
+    x = jnp.asarray(rng.randn(3, 5, 6).astype(np.float32))
+    m = QuantDense(4, exp=4, man=3)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    kernel = variables["params"]["kernel"]
+    assert kernel.shape == (6, 4)           # flax (in, out) layout
+
+    got = m.apply(variables, x)
+    want = quant_linear_fn(np.asarray(x).reshape(-1, 6), kernel.T, None,
+                           4, 3, "faithful").reshape(3, 5, 4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_quant_dense_grads_follow_reference_recipe():
+    """Gradients through QuantDense run the reference backward
+    (quant_gemm on g and g^T — quant_module.py:36-52), so they differ
+    from fp32 Dense grads at aggressive formats but stay finite."""
+    from cpd_tpu.quant.quant_module import QuantDense
+
+    rng = np.random.RandomState(41)
+    x = jnp.asarray(rng.randn(8, 6).astype(np.float32))
+    m = QuantDense(4, exp=4, man=3)
+    variables = m.init(jax.random.PRNGKey(1), x)
+
+    def loss(v):
+        return (m.apply(v, x) ** 2).sum()
+
+    g = jax.grad(loss)(variables)["params"]["kernel"]
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
