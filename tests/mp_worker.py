@@ -58,11 +58,53 @@ def main() -> None:
                                       grad_exp=5, grad_man=2, use_kahan=True)
     got = jax.tree.map(np.asarray, reduce_fn(global_tree))
 
+    # ---- full train step across the process boundary: BN batch stats,
+    # APS pmax, the quantized Kahan collective, and the SGD update all
+    # run over the 2-device cross-process mesh (the per-rank shape of
+    # the reference's DDP step, main.py:111-169) ----
+    step_result = _train_step_phase(mesh, rank * 2, (rank + 1) * 2)
+
     if rank == 0:
         tmp = os.path.join(outdir, "tmp_result.npz")  # savez appends .npz
-        np.savez(tmp, **got)
+        np.savez(tmp, **got, **step_result)
         os.replace(tmp, os.path.join(outdir, "result.npz"))
     print(f"mp_worker rank={rank} ok", flush=True)
+
+
+def _train_step_phase(mesh, lo: int, hi: int) -> dict:
+    """One quantized train step; this process feeds batch rows [lo, hi)
+    (the whole batch single-process, a half per rank two-process).
+    Returns flattened post-step params, BN batch_stats, and loss — all
+    replicated outputs, so every rank can read them.  Shared by the
+    worker and the parent test's single-process arm so the two
+    configurations cannot drift."""
+    import jax
+    import numpy as np
+
+    from cpd_tpu.parallel.dist import host_batch_to_global
+    from cpd_tpu.train import (create_train_state, make_optimizer,
+                               make_train_step)
+    from cpd_tpu.models import tiny_cnn
+
+    rng = np.random.RandomState(11)
+    x = rng.randn(4, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 10, 4).astype(np.int32)
+
+    model = tiny_cnn(width=4)
+    tx = make_optimizer("sgd", lambda s: 0.1, momentum=0.9)
+    state = create_train_state(model, tx, x[:1], jax.random.PRNGKey(3))
+    step = make_train_step(model, tx, mesh, use_aps=True, grad_exp=5,
+                           grad_man=2, use_kahan=True, donate=False)
+    xg = host_batch_to_global(x[lo:hi], mesh, "dp")
+    yg = host_batch_to_global(y[lo:hi], mesh, "dp")
+    state, metrics = step(state, xg, yg)
+
+    out = {"step_loss": np.asarray(metrics["loss"])}
+    for col, tree in (("param", state.params),
+                      ("bnstat", state.batch_stats)):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            out[col + jax.tree_util.keystr(path)] = np.asarray(leaf)
+    return out
 
 
 if __name__ == "__main__":

@@ -30,8 +30,9 @@ def _free_port() -> int:
 
 
 def _single_process_want():
-    """The same reduction on 2 virtual devices in THIS process (the
-    already-oracle-tested path, test_parallel.py)."""
+    """The same reduction AND full train step on 2 virtual devices in
+    THIS process (the already-oracle-tested path, test_parallel.py /
+    test_train.py)."""
     import jax
 
     from cpd_tpu.parallel import make_mesh, make_sum_gradients_fn
@@ -45,7 +46,12 @@ def _single_process_want():
         lambda a: host_batch_to_global(a, mesh, "dp"), full)
     reduce_fn = make_sum_gradients_fn(mesh, axis_name="dp", use_aps=True,
                                       grad_exp=5, grad_man=2, use_kahan=True)
-    return jax.tree.map(np.asarray, reduce_fn(global_tree))
+    want = jax.tree.map(np.asarray, reduce_fn(global_tree))
+    # single-process arm of the SAME step harness (full batch, one host) —
+    # shared code so the two configurations cannot drift
+    from mp_worker import _train_step_phase
+
+    return {**want, **_train_step_phase(mesh, 0, 4)}
 
 
 def test_two_process_faithful_reduce_bit_identical(tmp_path):
