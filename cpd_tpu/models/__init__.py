@@ -15,6 +15,7 @@ from .pipeline_lm import PipelinedLM, pipelined_lm, pp_param_specs
 from .moe import MoETransformerLM, moe_lm, moe_param_specs
 from .davidnet_graph import graph_davidnet
 from .generate import generate
+from .vit import ViT, vit
 
 _REGISTRY = {
     "res_cifar": resnet18_cifar,      # reference name (mix.py:82)
@@ -30,6 +31,7 @@ _REGISTRY = {
     "pipelined_lm": pipelined_lm,
     "moe_lm": moe_lm,
     "davidnet_graph": graph_davidnet,  # dict-graph definition (TorchGraph)
+    "vit": vit,                       # RoPE-ViT encoder (models/vit.py)
 }
 
 

@@ -90,6 +90,9 @@ class Block(nn.Module):
                                 # compatible (QuantDense), so checkpoints
                                 # and tp specs are unchanged.
     ffn_mode: str = "faithful"
+    causal: bool = True         # False = bidirectional attention (ViT
+                                # encoder use, models/vit.py); decode and
+                                # sp paths require causal
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
@@ -190,6 +193,9 @@ class Block(nn.Module):
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown sp_mode {self.sp_mode!r}; "
                              "expected 'ring' or 'ulysses'")
+        if not self.causal and (self.decode or self.sp_axis):
+            raise ValueError("causal=False (bidirectional encoder) does "
+                             "not compose with decode or sp paths")
         if self.decode:
             attn = self._cached_attention(q, k, v, positions)
         elif self.sp_axis:
@@ -204,7 +210,7 @@ class Block(nn.Module):
             else:
                 attn = ring_attention(q, k, v, self.sp_axis, causal=True)
         else:
-            attn = grouped_query_attention(q, k, v, causal=True)
+            attn = grouped_query_attention(q, k, v, causal=self.causal)
         attn = attn.reshape(*attn.shape[:-2], n_local * self.head_dim)
         proj = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                         name="wo")(attn)

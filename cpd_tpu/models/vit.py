@@ -1,0 +1,82 @@
+"""Vision Transformer classifier — the transformer stack applied to the
+reference's vision workloads.
+
+New capability beyond the reference (its models are CNNs only, SURVEY.md
+§2); exists so the quantized training harness covers both major vision
+architecture families with ONE block implementation: the encoder layers
+ARE `transformer.Block` (non-causal), so everything Block supports —
+Megatron tp sharding, remat, dropout, the quantized-accumulator FFN
+(ffn_exp/ffn_man) — applies to image classification unchanged.
+
+TPU-first choices:
+* patchify = one Conv with stride=patch (a single strided matmul on the
+  MXU), NHWC in, (B, N_patches, d) out;
+* rotary position encoding over the flattened patch index (the Block's
+  built-in RoPE — no separate learned position table) + mean-pool head
+  (no CLS token: pooling keeps the sequence length a clean power of two
+  for the MXU and drops a special-cased row);
+* pre-LN blocks, bf16-friendly (dtype/param_dtype split as everywhere).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import Block
+
+__all__ = ["ViT", "vit"]
+
+
+class ViT(nn.Module):
+    """(B, H, W, C) images -> (B, num_classes) fp32 logits."""
+    num_classes: int = 1000
+    patch: int = 16
+    d_model: int = 384
+    n_layers: int = 6
+    n_heads: int = 6
+    d_ff: Optional[int] = None
+    dropout_rate: float = 0.0
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
+    remat: bool = False
+    ffn_exp: int = 8
+    ffn_man: int = 23
+    ffn_mode: str = "faithful"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if x.shape[1] % self.patch or x.shape[2] % self.patch:
+            raise ValueError(f"image {x.shape[1]}x{x.shape[2]} not divisible "
+                             f"by patch {self.patch}")
+        x = nn.Conv(self.d_model, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding=0,
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="patch_embed")(x)
+        b, gh, gw, _ = x.shape
+        x = x.reshape(b, gh * gw, self.d_model)
+
+        d_ff = self.d_ff or 4 * self.d_model
+        block_cls = nn.remat(Block) if self.remat else Block
+        for i in range(self.n_layers):
+            x = block_cls(head_dim=self.d_model // self.n_heads,
+                          d_ff=d_ff, d_model=self.d_model,
+                          tp_axis=self.tp_axis, sp_axis=None,
+                          tp_size=self.tp_size, dtype=self.dtype,
+                          causal=False, dropout_rate=self.dropout_rate,
+                          deterministic=not train, ffn_exp=self.ffn_exp,
+                          ffn_man=self.ffn_man, ffn_mode=self.ffn_mode,
+                          name=f"block{i}")(x, jnp.arange(gh * gw))
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = x.mean(axis=1)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=self.param_dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def vit(num_classes: int = 1000, dtype=jnp.float32, **kw) -> ViT:
+    return ViT(num_classes=num_classes, dtype=dtype, **kw)
