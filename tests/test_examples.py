@@ -537,3 +537,19 @@ def test_load_imagenet_explicit_root_is_strict(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         load_imagenet(str(tmp_path / "nope"))
+
+
+def test_resnet50_trainer_vit_arch(tmp_path):
+    """--arch vit: the registry's uniform model contract lets the ImageNet
+    trainer drive the ViT family through the same quantized APS step."""
+    from resnet50.main import main
+
+    res = main(["--batch-size", "1", "--epochs", "1", "--arch", "vit",
+                "--num-classes", "10", "--max-batches-per-epoch", "2",
+                "--image-size", "32", "--use-APS", "--grad_exp", "5",
+                "--grad_man", "2", "--checkpoint-dir",
+                str(tmp_path / "ck"), "--log-dir", str(tmp_path / "logs"),
+                "--mode", "faithful"])
+    assert res["epoch"] == 0
+    assert math.isfinite(res["train_loss"])
+    assert not res["diverged"]
