@@ -93,6 +93,11 @@ class Block(nn.Module):
     causal: bool = True         # False = bidirectional attention (ViT
                                 # encoder use, models/vit.py); decode and
                                 # sp paths require causal
+    attn_impl: str = "xla"      # "flash" = Pallas TPU flash-attention
+                                # kernel for the non-decode single-
+                                # sequence path (O(T) memory; MHA only);
+                                # hardware-validated by
+                                # tools/pallas_check.py
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
@@ -196,6 +201,15 @@ class Block(nn.Module):
         if not self.causal and (self.decode or self.sp_axis):
             raise ValueError("causal=False (bidirectional encoder) does "
                              "not compose with decode or sp paths")
+        if self.attn_impl not in ("xla", "flash"):
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}; "
+                             "expected 'xla' or 'flash'")
+        if (self.attn_impl == "flash" and self.sp_axis
+                and self.sp_mode == "ring"):
+            raise ValueError("attn_impl='flash' does not compose with "
+                             "ring sequence parallelism (the ring's "
+                             "online-softmax accumulation is its own "
+                             "schedule); use sp_mode='ulysses'")
         if self.decode:
             attn = self._cached_attention(q, k, v, positions)
         elif self.sp_axis:
@@ -206,11 +220,12 @@ class Block(nn.Module):
             k, v = self._expand_kv(k, v, q.shape[-2])
             if self.sp_mode == "ulysses":
                 attn = ulysses_attention(q, k, v, self.sp_axis,
-                                         causal=True)
+                                         causal=True, impl=self.attn_impl)
             else:
                 attn = ring_attention(q, k, v, self.sp_axis, causal=True)
         else:
-            attn = grouped_query_attention(q, k, v, causal=self.causal)
+            attn = grouped_query_attention(q, k, v, causal=self.causal,
+                                           impl=self.attn_impl)
         attn = attn.reshape(*attn.shape[:-2], n_local * self.head_dim)
         proj = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                         name="wo")(attn)
@@ -276,6 +291,7 @@ class TransformerLM(nn.Module):
     ffn_exp: int = 8        # quantized-accumulator MLP GEMMs when !=
     ffn_man: int = 23       # (8, 23) — see Block.ffn_exp
     ffn_mode: str = "faithful"
+    attn_impl: str = "xla"  # "flash" = Pallas TPU kernel (see Block)
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -323,7 +339,8 @@ class TransformerLM(nn.Module):
                         decode=self.decode, n_kv_heads=self.n_kv_heads,
                         dropout_rate=self.dropout_rate,
                         deterministic=not train, ffn_exp=self.ffn_exp,
-                        ffn_man=self.ffn_man, ffn_mode=self.ffn_mode)
+                        ffn_man=self.ffn_man, ffn_mode=self.ffn_mode,
+                        attn_impl=self.attn_impl)
         if self.scan_layers:
             if self.decode:
                 raise ValueError("scan_layers does not compose with "

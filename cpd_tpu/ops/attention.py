@@ -77,6 +77,9 @@ def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     the call site, not silently downgrade mid-training."""
     if impl == "flash":
         return _flash_attention(q, k, v, causal, q_offset, k_offset)
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}; "
+                         "expected 'xla' or 'flash'")
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -163,7 +166,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
                             v: jnp.ndarray, causal: bool = True,
-                            q_offset=0) -> jnp.ndarray:
+                            q_offset=0, impl: str = "xla") -> jnp.ndarray:
     """GQA softmax attention without materializing the K/V expansion.
 
     q: (B, Tq, H, D) with H = rep * H_kv; k, v: (B, Tk, H_kv, D).
@@ -171,11 +174,19 @@ def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
     calling `local_attention` (fp32 logits/softmax, same mask), tested
     bitwise-close against that oracle.  rep == 1 falls through to
     `local_attention` itself.
+
+    impl="flash" (MHA only — the Pallas kernel takes uniform heads)
+    routes to the TPU flash-attention kernel; hardware-validated by
+    tools/pallas_check.py.
     """
     b, tq, h, d = q.shape
     hkv = k.shape[2]
+    if impl == "flash" and h != hkv:
+        raise ValueError("impl='flash' supports MHA only (uniform heads); "
+                         "unset n_kv_heads or use impl='xla'")
     if h == hkv:
-        return local_attention(q, k, v, causal=causal, q_offset=q_offset)
+        return local_attention(q, k, v, causal=causal, q_offset=q_offset,
+                               impl=impl)
     if h % hkv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
     rep = h // hkv

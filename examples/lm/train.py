@@ -104,6 +104,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="faithful = ordered Kahan accumulation (bit-exact "
                         "reference emulation, the API default); fast = "
                         "cast-and-dot")
+    p.add_argument("--attn-impl", default="xla",
+                   choices=["xla", "flash"],
+                   help="flash = Pallas TPU flash-attention kernel "
+                        "(MHA, non-decode; O(T) memory)")
     p.add_argument("--bf16", action="store_true",
                    help="bf16 compute dtype (fp32 master params; the "
                         "MXU-native precision — --half analog of the "
@@ -207,6 +211,14 @@ def main(argv=None) -> dict:
     model_kw = dict(vocab_size=args.vocab_size, d_model=args.d_model,
                     n_layers=args.n_layers, n_heads=args.n_heads,
                     dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    if args.attn_impl != "xla":
+        if args.pp > 1 or args.moe:
+            raise ValueError("--attn-impl applies to the default "
+                             "dp/sp/tp TransformerLM path only")
+        if args.n_kv_heads is not None:
+            raise ValueError("--attn-impl flash is MHA-only; unset "
+                             "--n-kv-heads")
+        model_kw.update(attn_impl=args.attn_impl)
     if (args.ffn_exp, args.ffn_man) != (8, 23):
         if args.pp > 1 or args.moe:
             raise ValueError("--ffn-exp/--ffn-man apply to the default "

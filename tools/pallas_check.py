@@ -7,12 +7,14 @@ on a fresh TPU runtime:
 
     timeout 300 python tools/pallas_check.py
 
-Checks (each vs the XLA reference implementation, bitwise):
+Checks (1-2 bitwise vs the XLA reference; 3-4 allclose — flash's
+different reduction order is expected, it is not a bit-parity kernel):
   1. quantize_pallas — elementwise eXmY cast, several shapes/formats
   2. qgemm_pallas    — quantized-Kahan-accumulator GEMM
   3. local_attention(impl="flash") — the jax.experimental Pallas TPU
-     flash kernel vs the reference implementation (allclose: different
-     reduction order is expected, it is not a bit-parity kernel)
+     flash kernel vs the reference implementation
+  4. a full transformer Block with attn_impl="flash" vs attn_impl="xla"
+     on the same params (the LM CLI's --attn-impl path end-to-end)
 
 Exit 0 = all pass; nonzero with a named failure otherwise.  On CPU the
 kernels run in interpret mode so the tool still smoke-tests end-to-end
@@ -95,6 +97,27 @@ def main() -> int:
         print("flash attention:",
               "OK" if not any("flash" in f for f in failures) else
               [f for f in failures if "flash" in f], flush=True)
+
+        # 4. the LM's attn_impl="flash" path end-to-end: one Block forward
+        # must match the XLA implementation on the same params
+        from cpd_tpu.models.transformer import Block
+
+        def blk(impl):
+            return Block(head_dim=64, d_ff=512, d_model=256, tp_axis=None,
+                         sp_axis=None, tp_size=1, dtype=jnp.float32,
+                         attn_impl=impl)
+
+        h = jnp.asarray(rng.randn(2, 128, 256).astype(np.float32))
+        pos = jnp.arange(128)
+        vb = blk("xla").init(jax.random.PRNGKey(5), h, pos)
+        out_x = np.asarray(blk("xla").apply(vb, h, pos))
+        out_f = np.asarray(blk("flash").apply(vb, h, pos))
+        if not np.allclose(out_x, out_f, atol=2e-2, rtol=2e-2):
+            failures.append(
+                f"LM flash block maxdiff={np.max(np.abs(out_x - out_f))}")
+        print("LM attn_impl=flash block:",
+              "OK" if not any("LM flash" in f for f in failures) else
+              [f for f in failures if "LM flash" in f], flush=True)
     else:
         print("flash attention: SKIPPED (needs TPU)", flush=True)
 
