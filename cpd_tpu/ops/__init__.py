@@ -2,7 +2,7 @@
 CUDA extension (CPDtorch/quant/quant_cuda/).  See also quant/ for the XLA
 implementations these are bit-identical to."""
 
-from .quantize import quantize_pallas
+from .quantize import quantize_pallas, quantize_pallas_sr
 from .qgemm import qgemm_pallas
 
-__all__ = ["quantize_pallas", "qgemm_pallas"]
+__all__ = ["quantize_pallas", "quantize_pallas_sr", "qgemm_pallas"]

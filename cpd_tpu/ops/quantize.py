@@ -24,9 +24,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..quant.numerics import _validate, cast_body
+from ..quant.numerics import _validate, cast_body, cast_body_sr
 
-__all__ = ["quantize_pallas"]
+__all__ = ["quantize_pallas", "quantize_pallas_sr"]
 
 _LANES = 128
 _BLOCK_ROWS = 512  # (512, 128) fp32 block = 256 KiB of VMEM in + out
@@ -34,6 +34,28 @@ _BLOCK_ROWS = 512  # (512, 128) fp32 block = 256 KiB of VMEM in + out
 
 def _quantize_kernel(x_ref, o_ref, *, exp_bits: int, man_bits: int):
     o_ref[:] = cast_body(x_ref[:], exp_bits, man_bits)
+
+
+def _quantize_sr_kernel(x_ref, r_ref, o_ref, *, exp_bits: int, man_bits: int):
+    o_ref[:] = cast_body_sr(x_ref[:], exp_bits, man_bits, r_ref[:])
+
+
+def _to_blocks(x: jnp.ndarray):
+    """Flatten + zero-pad an array to (grid*_BLOCK_ROWS, _LANES) tiles."""
+    n = x.size
+    rows = -(-n // _LANES)
+    pad = rows * _LANES - n
+    flat = jnp.pad(x.reshape(-1), (0, pad))
+    grid = -(-rows // _BLOCK_ROWS)
+    padded_rows = grid * _BLOCK_ROWS
+    flat = jnp.pad(flat.reshape(rows, _LANES),
+                   ((0, padded_rows - rows), (0, 0)))
+    return flat, grid, padded_rows
+
+
+def _block_spec():
+    return pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
@@ -49,24 +71,47 @@ def quantize_pallas(x: jnp.ndarray, exp_bits: int, man_bits: int,
     n = x.size
     if n == 0:
         return x
-
-    rows = -(-n // _LANES)
-    pad = rows * _LANES - n
-    flat = jnp.pad(x.reshape(-1), (0, pad))
-    grid = -(-rows // _BLOCK_ROWS)
-    padded_rows = grid * _BLOCK_ROWS
-    flat = jnp.pad(flat.reshape(rows, _LANES),
-                   ((0, padded_rows - rows), (0, 0)))
+    flat, grid, padded_rows = _to_blocks(x)
 
     out = pl.pallas_call(
         functools.partial(_quantize_kernel, exp_bits=exp_bits,
                           man_bits=man_bits),
         out_shape=jax.ShapeDtypeStruct((padded_rows, _LANES), jnp.float32),
         grid=(grid,),
-        in_specs=[pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
+        in_specs=[_block_spec()],
+        out_specs=_block_spec(),
         interpret=interpret,
     )(flat)
+    return out.reshape(-1)[:n].reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 4))
+def quantize_pallas_sr(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                       key: jax.Array, interpret: bool = False) -> jnp.ndarray:
+    """Stochastically-rounded eXmY cast via a Pallas TPU kernel.
+
+    Random bits are generated with the host-side JAX PRNG and streamed into
+    the kernel as a second operand (rather than seeding an on-chip PRNG), so
+    this is bit-identical to `cast_to_format_sr(x, exp, man, key)` — the
+    kernel and the XLA path consume the SAME bitstream and tests can assert
+    exact equality between them."""
+    _validate(exp_bits, man_bits)
+    x = jnp.asarray(x, jnp.float32)
+    shape = x.shape
+    n = x.size
+    if n == 0:
+        return x
+    rbits = jax.random.bits(key, shape, jnp.uint32)
+    flat, grid, padded_rows = _to_blocks(x)
+    rflat, _, _ = _to_blocks(rbits)
+
+    out = pl.pallas_call(
+        functools.partial(_quantize_sr_kernel, exp_bits=exp_bits,
+                          man_bits=man_bits),
+        out_shape=jax.ShapeDtypeStruct((padded_rows, _LANES), jnp.float32),
+        grid=(grid,),
+        in_specs=[_block_spec(), _block_spec()],
+        out_specs=_block_spec(),
+        interpret=interpret,
+    )(flat, rflat)
     return out.reshape(-1)[:n].reshape(shape)

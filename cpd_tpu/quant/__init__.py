@@ -1,10 +1,13 @@
-from .numerics import cast_to_format, cast_oracle, max_finite
+from .numerics import (cast_to_format, cast_to_format_sr, cast_oracle,
+                       cast_oracle_sr, max_finite)
 from .quant_function import float_quantize, quantizer, quant_gemm
 from .quant_module import Quantizer, QuantDense, QuantLinear, QuantConv
 
 __all__ = [
     "cast_to_format",
+    "cast_to_format_sr",
     "cast_oracle",
+    "cast_oracle_sr",
     "max_finite",
     "float_quantize",
     "quantizer",

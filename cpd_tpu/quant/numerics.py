@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["cast_to_format", "cast_body", "cast_oracle", "max_finite",
+           "cast_body_sr", "cast_to_format_sr", "cast_oracle_sr",
            "FP32_EXP_BITS", "FP32_MAN_BITS"]
 
 FP32_EXP_BITS = 8
@@ -104,10 +105,15 @@ def _pow2(e: jnp.ndarray) -> jnp.ndarray:
         ((e + 127) << 23).astype(jnp.uint32), jnp.float32)
 
 
-def cast_body(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
-    """Un-jitted cast body using only ops Mosaic supports, so the SAME code
-    is the XLA implementation (via `cast_to_format`) and the Pallas kernel
-    body (ops/quantize.py).  See module docstring for semantics."""
+def _cast_core(x: jnp.ndarray, exp_bits: int, man_bits: int,
+               round_fn) -> jnp.ndarray:
+    """Shared cast skeleton: everything except the significand rounding step.
+
+    `round_fn(man)` maps an integer significand to its rounded value at bit
+    position ``23 - man_bits`` (already masked).  `cast_body` instantiates it
+    with RTNE (`_rtne`) for reference bit-parity; `cast_body_sr` with
+    stochastic add-then-truncate.  The case split, saturation, subnormal
+    pre-shift and value reconstruction are identical in both."""
     _validate(exp_bits, man_bits)
     x = jnp.asarray(x, jnp.float32)
 
@@ -127,18 +133,16 @@ def cast_body(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
     # Pre-rounding saturation to +/-FP32-Inf (float_kernel.cu:24-30).
     overflow = new_e >= ((1 << exp_bits) - 1)
 
-    shift = 23 - man_bits
-
-    # Normal-target path (float_kernel.cu:31-50): RTNE on the 24-bit
+    # Normal-target path (float_kernel.cu:31-50): round the 24-bit
     # significand; exponent carry from rounding flows into the value via the
     # shared reconstruction below.
-    man_norm = _rtne(man24, shift)
+    man_norm = round_fn(man24)
     e_norm = exp_f - 127  # new_e - bias
 
     # Subnormal-target path (float_kernel.cu:51-70): truncating right shift
-    # by (1 - new_e), THEN RTNE.  Shift >= 24 wipes the significand.
+    # by (1 - new_e), THEN round.  Shift >= 24 wipes the significand.
     sub_shift = jnp.clip(1 - new_e, 0, 24)  # man24 < 2^24, so >>24 == 0
-    man_sub = _rtne(man24 >> sub_shift, shift)
+    man_sub = round_fn(man24 >> sub_shift)
     e_sub = 1 - bias
 
     is_sub = new_e <= 0
@@ -164,6 +168,45 @@ def cast_body(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
     return jnp.where(passthrough, x, val)
 
 
+def cast_body(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Un-jitted cast body using only ops Mosaic supports, so the SAME code
+    is the XLA implementation (via `cast_to_format`) and the Pallas kernel
+    body (ops/quantize.py).  See module docstring for semantics."""
+    shift = 23 - man_bits
+    return _cast_core(x, exp_bits, man_bits, lambda m: _rtne(m, shift))
+
+
+def _sr(man: jnp.ndarray, shift: int, rbits: jnp.ndarray) -> jnp.ndarray:
+    """Stochastic rounding of an integer significand at bit `shift`.
+
+    Adds the low `shift` random bits to the significand and truncates: the
+    result rounds up with probability exactly equal to the discarded
+    fraction (unbiased over uniform `rbits`).  `shift <= 0` (man_bits == 23)
+    is the identity, consistent with `_rtne` and deviation 1."""
+    if shift <= 0:
+        return man
+    keep_mask = ~((1 << shift) - 1)
+    r = (rbits & jnp.uint32((1 << shift) - 1)).astype(jnp.int32)
+    return (man + r) & keep_mask
+
+
+def cast_body_sr(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                 rbits: jnp.ndarray) -> jnp.ndarray:
+    """Stochastic-rounding variant of `cast_body` (beyond-reference: the
+    reference CUDA kernel is nearest-only, float_kernel.cu:33-49).
+
+    `rbits` is a uint32 array broadcastable to `x.shape`; its low
+    ``23 - man_bits`` bits decide the round direction per element.  All
+    non-rounding semantics (Inf/NaN/±0 passthrough, FP32-subnormal flush,
+    pre-rounding saturation, the subnormal truncating pre-shift, carry past
+    the format max) are IDENTICAL to the RTNE cast — same format, different
+    rounding.  Passing explicit bits (instead of a PRNG key) keeps the body
+    Mosaic-safe so the XLA path and the Pallas kernel are bit-comparable."""
+    shift = 23 - man_bits
+    rbits = jnp.broadcast_to(jnp.asarray(rbits, jnp.uint32), jnp.shape(x))
+    return _cast_core(x, exp_bits, man_bits, lambda m: _sr(m, shift, rbits))
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def cast_to_format(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
     """Cast FP32 array values into the eXmY format, vectorized.
@@ -173,6 +216,62 @@ def cast_to_format(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
     float_kernel.cu:94-101).
     """
     return cast_body(x, exp_bits, man_bits)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def cast_to_format_sr(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                      key: jax.Array) -> jnp.ndarray:
+    """Stochastically-rounded eXmY cast driven by a JAX PRNG key.
+
+    Unbiased: E[cast_to_format_sr(x)] == x for x in the format's normal
+    range (each element rounds up with probability equal to its discarded
+    significand fraction).  Deterministic given (x, key)."""
+    rbits = jax.random.bits(key, jnp.shape(x), jnp.uint32)
+    return cast_body_sr(x, exp_bits, man_bits, rbits)
+
+
+def cast_oracle_sr(x: float, exp_bits: int, man_bits: int, r: int) -> float:
+    """Scalar oracle for the stochastic cast: follows `cast_oracle`'s control
+    flow with RTNE replaced by add-`r`-then-truncate (r in [0, 2^shift)).
+    Used by tests to pin the SR semantics independently of the jnp path."""
+    _validate(exp_bits, man_bits)
+    s = 23 - man_bits
+    if not (0 <= r < (1 << s if s > 0 else 1)):
+        raise ValueError(f"r must be in [0, 2^{max(s, 0)}), got {r}")
+    f = np.float32(x)
+    old_num = int(np.array(f, np.float32).view(np.uint32))
+    exp = (old_num & 0x7F800000) >> 23
+    man = old_num & 0x007FFFFF
+    true_exp = exp - 127
+    if exp == 0xFF or (exp == 0x00 and man == 0):
+        return float(f)
+    if exp == 0:
+        return 0.0
+    man = man | (1 << 23)
+    diy_bias = (1 << (exp_bits - 1)) - 1
+    new_e = true_exp + diy_bias
+    if new_e >= (1 << exp_bits) - 1:
+        return float(np.inf if f > 0 else -np.inf)
+    if new_e > 0:
+        if man_bits != 23:
+            man = (man + r) & ~((1 << s) - 1)
+        new_e -= diy_bias
+    else:
+        shift_amt = 1 - new_e
+        man = man >> shift_amt if shift_amt < 32 else 0
+        new_e = 1 - diy_bias
+        if man_bits != 23:
+            man = (man + r) & ~((1 << s) - 1)
+    res = np.float32(man) / np.float32(1 << 23)
+    if new_e >= 0:
+        for _ in range(new_e):
+            res = np.float32(res * np.float32(2.0))
+    else:
+        for _ in range(-new_e):
+            res = np.float32(res / np.float32(2.0))
+    if old_num & (1 << 31):
+        res = -res
+    return float(res)
 
 
 def cast_oracle(x: float, exp_bits: int, man_bits: int) -> float:

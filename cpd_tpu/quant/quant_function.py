@@ -27,20 +27,39 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .numerics import cast_to_format
+from .numerics import cast_to_format, cast_to_format_sr
 
 __all__ = ["float_quantize", "quantizer", "quant_gemm"]
 
 
-def float_quantize(x: jnp.ndarray, exp: int, man: int) -> jnp.ndarray:
-    """Quantize an FP32 array into the eXmY format (round-to-nearest-even).
+def float_quantize(x: jnp.ndarray, exp: int, man: int,
+                   rounding: str = "nearest", key=None) -> jnp.ndarray:
+    """Quantize an FP32 array into the eXmY format.
 
     Mirrors reference `float_quantize` (quant_function.py:60-75); argument
     order (exp, man) preserved.  Works on any shape, any backend (the
     reference raises NotImplementedError on CPU, quant_function.py:28-29 —
     here XLA compiles the same code for CPU/TPU).
+
+    `rounding` selects the significand rounding:
+    - ``"nearest"`` (default): round-to-nearest-even, bit-exact to the
+      reference CUDA kernel.
+    - ``"stochastic"`` (beyond-reference): unbiased stochastic rounding
+      driven by the required PRNG `key` — the standard companion to RTNE
+      for low-precision weight updates (avoids update stagnation when
+      |update| < ulp/2).  All non-rounding semantics are identical.
     """
-    return cast_to_format(x, exp, man)
+    if rounding == "nearest":
+        if key is not None:
+            raise ValueError("a PRNG key was passed but rounding='nearest' "
+                             "would ignore it; did you mean "
+                             "rounding='stochastic'?")
+        return cast_to_format(x, exp, man)
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("rounding='stochastic' requires a PRNG key")
+        return cast_to_format_sr(x, exp, man, key)
+    raise ValueError(f"unknown rounding mode: {rounding!r}")
 
 
 def quantizer(forward_exp: int = 8, forward_man: int = 23,

@@ -120,6 +120,7 @@ class QuantSGDState(NamedTuple):
     step: jnp.ndarray
     momentum_buf: optax.Updates
     comp: optax.Updates    # Kahan residuals; () (leafless) w/o use_kahan
+    key: optax.Updates = ()  # PRNG key iff rounding='stochastic', else ()
 
 
 def _unzip(flat, n):
@@ -134,6 +135,7 @@ def quant_sgd(schedule: Callable, momentum: float = 0.9,
               weight_decay: float = 0.0, exp: int = 8, man: int = 23,
               use_kahan: bool = False, nesterov: bool = False,
               wd_mask: Optional[Callable] = None,
+              rounding: str = "nearest", seed: int = 0,
               ) -> optax.GradientTransformation:
     """torch-SGD semantics with the momentum buffer held in eXmY.
 
@@ -162,22 +164,42 @@ def quant_sgd(schedule: Callable, momentum: float = 0.9,
                 c' = Q(Q(buf' - s) - y)
         step = d + momentum*buf' (nesterov) | buf'
         w   -= lr * step
+
+    rounding='stochastic' (beyond-reference, Gupta et al. 2015's recipe)
+    replaces every eXmY cast in the buffer update with the unbiased
+    stochastic cast: small contributions smaller than ulp/2 then survive
+    *in expectation* instead of being flushed by RTNE — the standard cure
+    for low-precision update stagnation.  Bits are drawn per (step, leaf,
+    cast-site) from a PRNG key carried in the optimizer state, so the
+    trajectory is deterministic given `seed`.  With rounding='nearest'
+    (default) the state tree is unchanged from before (key=() has no
+    leaves) and the trajectory is bit-identical to the documented RTNE
+    semantics above.
     """
+    if rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"unknown rounding mode: {rounding!r}")
+    stochastic = rounding == "stochastic" and (exp, man) != (8, 23)
     if (exp, man) == (8, 23):
-        def q(x):
+        def q(x, _k=None):
             return x
+    elif stochastic:
+        from ..quant.numerics import cast_to_format_sr
+
+        def q(x, k):
+            return cast_to_format_sr(x, exp, man, k)
     else:
         from ..quant.numerics import cast_to_format
 
-        def q(x):
+        def q(x, _k=None):
             return cast_to_format(x, exp, man)
 
     def init(params):
         # no dead residual tree without Kahan: () has no leaves, so the
         # quantized-optimizer state stays one buffer per param
         comp = (jax.tree.map(jnp.zeros_like, params) if use_kahan else ())
+        key = jax.random.PRNGKey(seed) if stochastic else ()
         return QuantSGDState(jnp.zeros([], jnp.int32),
-                             jax.tree.map(jnp.zeros_like, params), comp)
+                             jax.tree.map(jnp.zeros_like, params), comp, key)
 
     def update(grads, state, params):
         if params is None:
@@ -185,6 +207,21 @@ def quant_sgd(schedule: Callable, momentum: float = 0.9,
         lr = schedule(state.step)
         mask = (wd_mask(params) if wd_mask is not None
                 else jax.tree.map(lambda _: True, params))
+
+        if stochastic:
+            # one independent subkey per leaf for this step; each cast
+            # site inside the leaf update folds in its own site index
+            step_key = jax.random.fold_in(state.key, state.step)
+            treedef = jax.tree.structure(params)
+            leaf_keys = jax.tree.unflatten(
+                treedef, list(jax.random.split(step_key,
+                                               treedef.num_leaves)))
+        else:
+            # dummy leaves (ignored by q) so all mapped trees share the
+            # params structure; None would be an empty pytree node
+            leaf_keys = jax.tree.map(lambda _: 0, params)
+        site = (lambda k, i: jax.random.fold_in(k, i)) if stochastic \
+            else (lambda k, i: None)
 
         def decayed(g, w, use_wd):
             return g + (weight_decay * w
@@ -194,28 +231,28 @@ def quant_sgd(schedule: Callable, momentum: float = 0.9,
             return d + momentum * new_buf if nesterov else new_buf
 
         if use_kahan:
-            def one(g, w, buf, c, use_wd):
+            def one(g, w, buf, c, k, use_wd):
                 d = decayed(g, w, use_wd)
-                s = q(momentum * buf)
-                y = q(d - q(momentum * c))
-                new_buf = q(s + y)
-                new_c = q(q(new_buf - s) - y)
+                s = q(momentum * buf, site(k, 0))
+                y = q(d - q(momentum * c, site(k, 1)), site(k, 2))
+                new_buf = q(s + y, site(k, 3))
+                new_c = q(q(new_buf - s, site(k, 4)) - y, site(k, 5))
                 return -lr * step_dir(d, new_buf), new_buf, new_c
 
             flat = jax.tree.map(one, grads, params, state.momentum_buf,
-                                state.comp, mask)
+                                state.comp, leaf_keys, mask)
             updates, bufs, comp = _unzip(flat, 3)
         else:
-            def one(g, w, buf, use_wd):
+            def one(g, w, buf, k, use_wd):
                 d = decayed(g, w, use_wd)
-                new_buf = q(q(momentum * buf) + d)
+                new_buf = q(q(momentum * buf, site(k, 0)) + d, site(k, 1))
                 return -lr * step_dir(d, new_buf), new_buf
 
             flat = jax.tree.map(one, grads, params, state.momentum_buf,
-                                mask)
+                                leaf_keys, mask)
             updates, bufs = _unzip(flat, 2)
             comp = ()
-        return updates, QuantSGDState(state.step + 1, bufs, comp)
+        return updates, QuantSGDState(state.step + 1, bufs, comp, state.key)
 
     return optax.GradientTransformation(init, update)
 
@@ -225,6 +262,7 @@ def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,
                    wd_mask: Optional[Callable] = None, opt_exp: int = 8,
                    opt_man: int = 23, opt_kahan: bool = False,
                    clip_norm: Optional[float] = None,
+                   opt_rounding: str = "nearest", opt_seed: int = 0,
                    ) -> optax.GradientTransformation:
     """Registry used by trainer configs:
     'sgd' | 'nesterov' | 'lars' | 'quant_sgd' | 'adamw'.
@@ -254,7 +292,8 @@ def make_optimizer(name: str, schedule: Callable, momentum: float = 0.9,
     elif name == "quant_sgd":
         tx = quant_sgd(schedule, momentum, weight_decay, exp=opt_exp,
                        man=opt_man, use_kahan=opt_kahan,
-                       nesterov=nesterov, wd_mask=wd_mask)
+                       nesterov=nesterov, wd_mask=wd_mask,
+                       rounding=opt_rounding, seed=opt_seed)
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     if clip_norm is not None:

@@ -1,0 +1,203 @@
+"""Stochastic-rounding cast tests (beyond-reference capability).
+
+The SR cast shares `_cast_core` with the RTNE cast, so everything except
+the rounding decision is already pinned by test_numerics.py.  Here we pin:
+(a) the SR semantics against the scalar oracle with explicit round bits,
+(b) the two-neighbor property (SR lands on the truncation or the round-up,
+never anywhere else), (c) unbiasedness E[SR(x)] == x statistically,
+(d) special-value behavior identical to RTNE, (e) bit-parity of the Pallas
+kernel with the XLA path, and (f) the quant_sgd stagnation cure.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cpd_tpu.quant.numerics import (cast_body_sr, cast_oracle_sr,
+                                    cast_to_format, cast_to_format_sr)
+from cpd_tpu.quant.quant_function import float_quantize
+
+FORMATS = [(5, 2), (4, 3), (3, 4), (8, 7), (2, 1)]
+
+
+def _rand_vals(n, seed):
+    rng = np.random.default_rng(seed)
+    bits = rng.integers(0, 2**32, size=n, dtype=np.uint64).astype(np.uint32)
+    return bits.view(np.float32)
+
+
+@pytest.mark.parametrize("exp_bits,man_bits", FORMATS)
+def test_sr_matches_oracle_explicit_bits(exp_bits, man_bits):
+    """cast_body_sr with explicit bits == the scalar SR oracle, elementwise,
+    over random fp32 bit patterns and random round bits."""
+    shift = 23 - man_bits
+    x = _rand_vals(4000, seed=exp_bits * 13 + man_bits)
+    rng = np.random.default_rng(7)
+    r = rng.integers(0, 1 << shift, size=x.size).astype(np.uint32)
+    # the kernel only reads the low `shift` bits; set high garbage to prove it
+    rbits = r | (rng.integers(0, 2**16, size=x.size).astype(np.uint32)
+                 << max(shift, 16))
+    got = np.asarray(cast_body_sr(jnp.asarray(x), exp_bits, man_bits,
+                                  jnp.asarray(rbits)))
+    want = np.array([cast_oracle_sr(float(v), exp_bits, man_bits, int(ri))
+                     for v, ri in zip(x, r)], np.float32)
+    eq = (got.view(np.uint32) == want.view(np.uint32)) | (
+        np.isnan(got) & np.isnan(want))
+    np.testing.assert_array_equal(eq, True)
+
+
+@pytest.mark.parametrize("exp_bits,man_bits", [(5, 2), (4, 3)])
+def test_sr_two_neighbor_property(exp_bits, man_bits):
+    """For every input and key, SR(x) is either the truncation (r=0) or the
+    full round-up (r=2^shift-1) — never a third value."""
+    shift = 23 - man_bits
+    x = jnp.asarray(_rand_vals(2000, seed=3))
+    finite = jnp.isfinite(x)
+    down = cast_body_sr(x, exp_bits, man_bits, jnp.uint32(0))
+    up = cast_body_sr(x, exp_bits, man_bits,
+                      jnp.uint32((1 << shift) - 1))
+    for seed in range(5):
+        got = cast_to_format_sr(x, exp_bits, man_bits,
+                                jax.random.PRNGKey(seed))
+        ok = (got == down) | (got == up) | ~finite
+        assert bool(jnp.all(ok))
+
+
+def test_sr_exact_values_are_fixed_points():
+    """Values already representable in the format are returned unchanged for
+    every key (their discarded fraction is zero)."""
+    exp_bits, man_bits = 4, 3
+    grid = np.array([m * 2.0**e for e in range(-6, 8)
+                     for m in (1.0, 1.125, 1.25, 1.5, 1.875)], np.float32)
+    grid = np.concatenate([grid, -grid])
+    x = jnp.asarray(grid)
+    for seed in range(4):
+        got = cast_to_format_sr(x, exp_bits, man_bits,
+                                jax.random.PRNGKey(seed))
+        np.testing.assert_array_equal(np.asarray(got), grid)
+
+
+def test_sr_unbiased_statistically():
+    """x sits 1/4 of the way between neighbors -> rounds up with p=0.25.
+    Over N independent draws the up-fraction must be within 5 sigma."""
+    exp_bits, man_bits = 4, 3
+    # ulp at 1.0 for m3 is 2^-3; x = 1 + ulp/4
+    x = np.float32(1.0 + 2.0**-5)
+    n = 8192
+    xs = jnp.full((n,), x, jnp.float32)
+    got = np.asarray(cast_to_format_sr(xs, exp_bits, man_bits,
+                                       jax.random.PRNGKey(42)))
+    up = np.float32(1.0 + 2.0**-3)
+    down = np.float32(1.0)
+    assert set(np.unique(got)) <= {down, up}
+    p_hat = float(np.mean(got == up))
+    sigma = (0.25 * 0.75 / n) ** 0.5
+    assert abs(p_hat - 0.25) < 5 * sigma, (p_hat, sigma)
+    # and the mean reconstructs x (unbiasedness in value space)
+    assert abs(float(np.mean(got)) - float(x)) < 5 * sigma * (up - down)
+
+
+def test_sr_special_values_match_rtne_semantics():
+    """Inf/NaN/±0 passthrough, fp32-subnormal flush to +0, pre-rounding
+    saturation — identical to the RTNE cast for every key."""
+    x = jnp.asarray(np.array([np.inf, -np.inf, np.nan, 0.0, -0.0,
+                              1e-45, -1e-45, 3.4e38, -3.4e38], np.float32))
+    got = np.asarray(cast_to_format_sr(x, 5, 2, jax.random.PRNGKey(0)))
+    want = np.asarray(cast_to_format(x, 5, 2))
+    eq = (got.view(np.uint32) == want.view(np.uint32)) | (
+        np.isnan(got) & np.isnan(want))
+    np.testing.assert_array_equal(eq, True)
+
+
+def test_sr_deterministic_and_key_sensitive():
+    x = jnp.asarray(_rand_vals(512, seed=11))
+    a = cast_to_format_sr(x, 4, 3, jax.random.PRNGKey(1))
+    b = cast_to_format_sr(x, 4, 3, jax.random.PRNGKey(1))
+    c = cast_to_format_sr(x, 4, 3, jax.random.PRNGKey(2))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.any(np.asarray(a) != np.asarray(c))
+
+
+def test_sr_man23_identity_on_normals():
+    """man_bits == 23 -> shift 0 -> SR is the identity (deviation-1
+    consistency with the RTNE cast)."""
+    x = jnp.asarray(np.array([1.5, -2.25, 3e20, -7e-20], np.float32))
+    got = cast_to_format_sr(x, 8, 23, jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x))
+
+
+def test_float_quantize_rounding_api():
+    x = jnp.asarray(_rand_vals(128, seed=5))
+    np.testing.assert_array_equal(
+        np.asarray(float_quantize(x, 5, 2)),
+        np.asarray(cast_to_format(x, 5, 2)))
+    key = jax.random.PRNGKey(9)
+    np.testing.assert_array_equal(
+        np.asarray(float_quantize(x, 5, 2, rounding="stochastic", key=key)),
+        np.asarray(cast_to_format_sr(x, 5, 2, key)))
+    with pytest.raises(ValueError):
+        float_quantize(x, 5, 2, rounding="stochastic")
+    with pytest.raises(ValueError):
+        float_quantize(x, 5, 2, rounding="floor")
+    with pytest.raises(ValueError):  # key with nearest = caller mistake
+        float_quantize(x, 5, 2, key=key)
+
+
+def test_pallas_sr_bit_identical_to_xla():
+    from cpd_tpu.ops.quantize import quantize_pallas_sr
+    x = jnp.asarray(_rand_vals(1000, seed=21).reshape(10, 100))
+    key = jax.random.PRNGKey(17)
+    got = quantize_pallas_sr(x, 4, 3, key, interpret=True)
+    want = cast_to_format_sr(x, 4, 3, key)
+    g = np.asarray(got).view(np.uint32)
+    w = np.asarray(want).view(np.uint32)
+    nan = np.isnan(np.asarray(got)) & np.isnan(np.asarray(want))
+    np.testing.assert_array_equal((g == w) | nan, True)
+
+
+class TestQuantSGDStochastic:
+    def _run(self, rounding, steps=100, seed=0):
+        from cpd_tpu.train.optim import quant_sgd
+        params = {"w": jnp.ones((64,), jnp.float32)}
+        # momentum=1.0 makes the buffer a pure accumulator; e4m3's ulp at
+        # 1.0 is 0.125, so grads of 0.01 are RTNE-flushed forever
+        tx = quant_sgd(lambda _: 0.0, momentum=1.0, exp=4, man=3,
+                       rounding=rounding, seed=seed)
+        state = tx.init(params)
+        grads = {"w": jnp.full((64,), 0.01, jnp.float32)}
+        big = {"w": jnp.ones((64,), jnp.float32)}
+        _, state = tx.update(big, state, params)  # buffer -> 1.0
+        for _ in range(steps):
+            _, state = tx.update(grads, state, params)
+        return np.asarray(state.momentum_buf["w"])
+
+    def test_rtne_stagnates_sr_progresses(self):
+        """The Gupta et al. motivation, demonstrated: sub-ulp/2 gradient
+        contributions are flushed by RTNE but survive in expectation under
+        stochastic rounding."""
+        rtne_buf = self._run("nearest")
+        np.testing.assert_array_equal(rtne_buf, 1.0)  # stagnated
+        sr_buf = self._run("stochastic")
+        # E[buf] = 1 + 100*0.01 = 2.0; P[element still at 1.0] = .92^100
+        assert float(np.mean(sr_buf)) > 1.3
+        assert float(np.mean(sr_buf)) < 2.7
+
+    def test_sr_trajectory_deterministic(self):
+        a = self._run("stochastic", steps=10, seed=4)
+        b = self._run("stochastic", steps=10, seed=4)
+        np.testing.assert_array_equal(a, b)
+
+    def test_nearest_state_tree_unchanged(self):
+        """rounding='nearest' keeps key=() (leafless) so existing
+        checkpoints and shardings of QuantSGDState are unaffected."""
+        from cpd_tpu.train.optim import quant_sgd
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        s_near = quant_sgd(lambda _: 0.1, exp=4, man=3).init(params)
+        assert isinstance(s_near.key, tuple) and s_near.key == ()
+        leaves = jax.tree.leaves(s_near)
+        assert len(leaves) == 2  # step + one momentum buffer
+        s_sr = quant_sgd(lambda _: 0.1, exp=4, man=3,
+                         rounding="stochastic").init(params)
+        assert not isinstance(s_sr.key, tuple)

@@ -64,6 +64,26 @@ def main() -> int:
                 failures.append(f"quantize {shape} e{exp_bits}m{man_bits}")
     print("quantize_pallas:", "OK" if not failures else failures, flush=True)
 
+    # 1b. stochastic-rounding quantize: same bitstream as the XLA path so
+    # the comparison is bitwise even though the rounding is random
+    from cpd_tpu.ops import quantize_pallas_sr
+    from cpd_tpu.quant.numerics import cast_to_format_sr
+
+    sr_fail_before = len(failures)
+    for shape in [(513, 3), (256, 128)]:
+        for exp_bits, man_bits in [(5, 2), (4, 3)]:
+            x = jnp.asarray(rng.randn(*shape).astype(np.float32) * 100)
+            key = jax.random.PRNGKey(shape[0] + man_bits)
+            got = np.asarray(quantize_pallas_sr(x, exp_bits, man_bits, key,
+                                                interpret))
+            want = np.asarray(cast_to_format_sr(x, exp_bits, man_bits, key))
+            if not np.array_equal(got, want):
+                failures.append(
+                    f"quantize_sr {shape} e{exp_bits}m{man_bits}")
+    print("quantize_pallas_sr:",
+          "OK" if len(failures) == sr_fail_before else
+          failures[sr_fail_before:], flush=True)
+
     # 2. quantized-Kahan GEMM vs the XLA faithful path (bitwise)
     for m, k, n in [(16, 32, 8), (130, 7, 129), (128, 128, 128)]:
         a = jnp.asarray(rng.randn(m, k).astype(np.float32))
