@@ -8,6 +8,7 @@ from .optim import lars, make_optimizer, quant_sgd, sgd
 from .schedules import (iter_table, piecewise_linear, warmup_cosine,
                         warmup_step_decay)
 from .metrics import AverageMeter, Timer, accuracy, loss_diverged
+from .scaling import with_dynamic_loss_scale, DynamicScaleState
 from .lm import lm_state_specs, make_lm_train_step
 from .pp import make_pp_eval_step, make_pp_train_step, pp_state_specs
 from .moe import make_moe_eval_step, make_moe_train_step, moe_state_specs
@@ -21,6 +22,7 @@ __all__ = [
     "lars", "make_optimizer", "quant_sgd", "sgd",
     "iter_table", "piecewise_linear", "warmup_cosine", "warmup_step_decay",
     "AverageMeter", "Timer", "accuracy",
+    "with_dynamic_loss_scale", "DynamicScaleState",
     "make_lm_train_step", "lm_state_specs",
     "CheckpointManager", "PreemptionGuard", "preempt_save",
     "loss_diverged", "save_checkpoint", "restore_latest",

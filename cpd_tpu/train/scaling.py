@@ -1,0 +1,116 @@
+"""Dynamic loss scaling — the torch.cuda.amp.GradScaler counterpart.
+
+The reference's loss scaling is a static multiplier that is never unscaled
+(C17: DavidNet utils.py:332-334, `--loss_scale`); `train/step.py` keeps that
+faithful path.  This module adds the modern dynamic variant as an optax
+wrapper: the loss is multiplied by a *state-carried* scale, the wrapper
+unscales the incoming (scaled) gradients, skips the update when any gradient
+is non-finite, halves the scale on overflow and doubles it after
+`growth_interval` consecutive finite steps — exactly GradScaler's policy
+(growth 2.0, backoff 0.5, interval 2000 by default).
+
+Composition notes:
+
+* Scale values are powers of two, so unscaling (multiply by ``1/scale``) is
+  exact in fp32 — with a finite trajectory the wrapped optimizer walks
+  bit-identically to the unwrapped one fed raw gradients (tested).
+* Under `--use_APS` dynamic scaling is redundant by construction: APS
+  already shifts every gradient tensor's exponent range to the top of the
+  eXmY format (parallel/aps.py), which is *per-tensor* loss scaling with a
+  provably optimal factor.  The wrapper exists for the non-APS configs
+  (plain bf16/quantized training) where a global scale is the standard
+  remedy.
+* Like GradScaler, a skipped step does not roll back BatchNorm running
+  stats — the forward pass already updated them.  The step counter and the
+  inner optimizer state are untouched on skip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["DynamicScaleState", "with_dynamic_loss_scale", "all_finite",
+           "current_scale"]
+
+
+class DynamicScaleState(NamedTuple):
+    scale: jnp.ndarray       # f32 scalar — multiply the loss by this
+    good_steps: jnp.ndarray  # i32 consecutive finite steps since last change
+    inner: Any               # wrapped transformation's state
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """Scalar bool: every element of every leaf is finite."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.bool_(True)
+    return jnp.stack([jnp.all(jnp.isfinite(l)) for l in leaves]).all()
+
+
+def current_scale(opt_state: Any) -> jnp.ndarray:
+    """The live scale scalar from a `with_dynamic_loss_scale` opt state.
+    Raises if the optimizer is not wrapped (trainers pass this to the loss)."""
+    if not isinstance(opt_state, DynamicScaleState):
+        raise TypeError(
+            "dynamic loss scaling needs the optimizer wrapped with "
+            "with_dynamic_loss_scale(tx); got opt state "
+            f"{type(opt_state).__name__}")
+    return opt_state.scale
+
+
+def with_dynamic_loss_scale(tx: optax.GradientTransformation,
+                            init_scale: float = 2.0 ** 15,
+                            growth_factor: float = 2.0,
+                            backoff_factor: float = 0.5,
+                            growth_interval: int = 2000,
+                            max_scale: float = 2.0 ** 24,
+                            min_scale: float = 1.0,
+                            ) -> optax.GradientTransformation:
+    """Wrap `tx` so it consumes gradients of a `scale`-multiplied loss.
+
+    update() expects grads that were computed from ``loss * state.scale``;
+    it unscales them, runs the inner update, and zeroes the whole update
+    (keeping the inner state) when any incoming gradient is non-finite.
+    """
+    if not (growth_factor > 1.0 and 0.0 < backoff_factor < 1.0):
+        raise ValueError("need growth_factor > 1 and 0 < backoff_factor < 1")
+
+    def init(params):
+        return DynamicScaleState(jnp.float32(init_scale),
+                                 jnp.zeros([], jnp.int32), tx.init(params))
+
+    def update(grads, state, params=None):
+        finite = all_finite(grads)
+        inv = jnp.float32(1.0) / state.scale
+        # zero the grads BEFORE multiplying: inf * 0 would manufacture NaN
+        safe = jax.tree.map(
+            lambda g: jnp.where(finite, g, jnp.zeros_like(g)) * inv, grads)
+        updates, new_inner = tx.update(safe, state.inner, params)
+        updates = jax.tree.map(
+            lambda u: jnp.where(finite, u, jnp.zeros_like(u)), updates)
+        new_inner = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
+                                 new_inner, state.inner)
+
+        good = jnp.where(finite, state.good_steps + 1,
+                         jnp.zeros([], jnp.int32))
+        grow = good >= growth_interval
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow,
+                      jnp.minimum(state.scale * growth_factor,
+                                  jnp.float32(max_scale)),
+                      state.scale),
+            jnp.maximum(state.scale * backoff_factor,
+                        jnp.float32(min_scale)))
+        good = jnp.where(grow, jnp.zeros([], jnp.int32), good)
+        return updates, DynamicScaleState(new_scale, good, new_inner)
+
+    wrapped = optax.GradientTransformation(init, update)
+    if getattr(tx, "norm_based", False):
+        from .optim import NormBasedTransformation
+        wrapped = NormBasedTransformation(init, update)
+    return wrapped

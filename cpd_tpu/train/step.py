@@ -15,7 +15,10 @@ Semantics preserved from the reference step (mix.py:224-314):
     mean (mix.py:239);
   * optional loss scaling, multiplied into the loss before grad and NOT
     unscaled before the step — faithful to DavidNet/utils.py:332-334, which
-    never unscales (default scale 1.0 makes it a no-op);
+    never unscales (default scale 1.0 makes it a no-op); beyond-reference,
+    ``loss_scale="dynamic"`` reads the scale from a
+    `with_dynamic_loss_scale` optimizer state instead (train/scaling.py:
+    GradScaler policy — unscale, skip non-finite steps, halve/double);
   * micro-batches run sequentially (lax.scan), so BN running stats update
     in the same order as the reference's sequential sub-batch loop;
   * the reported loss is the cross-rank all-reduced copy (mix.py:240-242).
@@ -121,6 +124,14 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     flat-shard all_gather + unflatten of parallel/zero.py `_Zero3`);
     update_fn then returns params back in the STORED layout.
     """
+    dynamic_scale = loss_scale == "dynamic"
+    if dynamic_scale and update_fn is not None:
+        raise ValueError("loss_scale='dynamic' requires the default optax "
+                         "update path (the wrapper owns unscale+skip); "
+                         "custom update_fn steppers must manage scaling "
+                         "themselves")
+    if not dynamic_scale:
+        loss_scale = float(loss_scale)
     if reduce_in_update and update_fn is None:
         raise ValueError("reduce_in_update=True requires update_fn")
     if unpack_params is not None and update_fn is None:
@@ -133,7 +144,8 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                          "inside the step")
     has_stats_cache: dict = {}
 
-    def local_micro_grads(params, batch_stats, images, labels, world, step):
+    def local_micro_grads(params, batch_stats, images, labels, world, step,
+                          scale):
         """Sequential scan over micro-batches -> stacked grads (N, ...)."""
         n = emulate_node
         if images.shape[0] < n or images.shape[0] % n:
@@ -161,7 +173,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 logits = model.apply(variables, x, train=True, **kwargs)
                 new_stats = stats
             loss = loss_fn(logits, y) / (world * n)          # mix.py:239
-            return loss * loss_scale, (logits, new_stats, loss)
+            return loss * scale, (logits, new_stats, loss)
 
         def micro(carry, xy):
             stats, micro_idx = carry
@@ -199,9 +211,22 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         world = lax.psum(jnp.float32(1.0), axis_name)
         model_params = (unpack_params(state.params, axis_name)
                         if unpack_params is not None else state.params)
+        from .scaling import DynamicScaleState, current_scale
+        if dynamic_scale:
+            scale = current_scale(state.opt_state)
+        else:
+            if isinstance(state.opt_state, DynamicScaleState):
+                # symmetric to current_scale's TypeError: a wrapped
+                # optimizer with a static loss_scale would silently divide
+                # every update by the (growing) scale
+                raise ValueError(
+                    "optimizer is wrapped with with_dynamic_loss_scale but "
+                    "loss_scale is static; pass loss_scale='dynamic' to "
+                    "make_train_step")
+            scale = jnp.float32(loss_scale)
         stacked, new_stats, loss, correct, counted = local_micro_grads(
             model_params, state.batch_stats, images, labels, world,
-            state.step)
+            state.step, scale)
 
         # Local emulated-node reduction (mix.py:251-282), then the
         # cross-device low-precision all-reduce (mix.py:286-291).
@@ -239,7 +264,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         metrics = {
             # loss is the per-rank sum of micro losses (already /world/n);
             # psum across ranks gives the global mean (mix.py:240-242).
-            "loss": lax.psum(loss, axis_name) / loss_scale,
+            # (`loss` aux output is the UNSCALED per-micro loss, so no
+            # scale division is needed for either static or dynamic.)
+            "loss": lax.psum(loss, axis_name),
             # element counts (not shape[0]) so dense label maps (FCN pixel
             # accuracy, minus ignore_label pixels) and flat class labels
             # share one metric definition.

@@ -51,7 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad_man", default=23, type=int)
     p.add_argument("--use_APS", action="store_true")
     p.add_argument("--use_kahan", action="store_true")
-    p.add_argument("--loss_scale", default=1, type=int)
+    p.add_argument("--loss_scale", default="1",
+                   help="static scale int (reference dawn.py:24, never "
+                        "unscaled) or 'dynamic' for GradScaler-style "
+                        "scaling (train/scaling.py; beyond-reference)")
     # new surface
     p.add_argument("--arch", default="davidnet")
     p.add_argument("--data-root", default=None)
@@ -100,6 +103,11 @@ def main(argv=None) -> dict:
     # dawn.py:73-79: nesterov SGD, wd = 5e-4 * batch_size
     tx = make_optimizer("nesterov", schedule, momentum=args.momentum,
                         weight_decay=5e-4 * args.batch_size)
+    dynamic_scale = str(args.loss_scale).strip().lower() == "dynamic"
+    if dynamic_scale:
+        from cpd_tpu.train.scaling import with_dynamic_loss_scale
+        tx = with_dynamic_loss_scale(tx)
+    loss_scale = "dynamic" if dynamic_scale else float(args.loss_scale)
 
     dtype = jnp.bfloat16 if args.half else jnp.float32
     model = get_model(args.arch, dtype=dtype)
@@ -110,7 +118,7 @@ def main(argv=None) -> dict:
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
         grad_man=args.grad_man, use_kahan=args.use_kahan,
-        loss_scale=float(args.loss_scale), mode=args.mode)
+        loss_scale=loss_scale, mode=args.mode)
     eval_step = make_eval_step(model, mesh)
 
     host_batch = global_batch // world

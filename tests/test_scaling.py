@@ -1,0 +1,188 @@
+"""Dynamic loss scaling (train/scaling.py) — GradScaler-policy tests.
+
+Wrapper level: exact equivalence with the unwrapped optimizer under
+power-of-two scales, skip-on-nonfinite with inner state preserved,
+backoff/growth/caps.  Step level: make_train_step(loss_scale="dynamic")
+trains, a poisoned batch leaves params untouched and halves the scale.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cpd_tpu.train.optim import sgd
+from cpd_tpu.train.scaling import (DynamicScaleState, all_finite,
+                                   current_scale, with_dynamic_loss_scale)
+
+
+def _params():
+    return {"w": jnp.asarray(np.linspace(-1, 1, 8), jnp.float32),
+            "b": jnp.asarray(np.linspace(3, 4, 4), jnp.float32)}
+
+
+def _grads(scale=1.0):
+    return {"w": jnp.asarray(np.linspace(0.5, -0.5, 8) * scale, jnp.float32),
+            "b": jnp.asarray(np.linspace(-2, 2, 4) * scale, jnp.float32)}
+
+
+def test_all_finite():
+    assert bool(all_finite(_grads()))
+    bad = {"w": jnp.asarray([1.0, jnp.inf]), "b": jnp.asarray([0.0])}
+    assert not bool(all_finite(bad))
+    nan = {"w": jnp.asarray([1.0, jnp.nan]), "b": jnp.asarray([0.0])}
+    assert not bool(all_finite(nan))
+    assert bool(all_finite({}))
+
+
+def test_exact_equivalence_with_pow2_scale():
+    """Scaled-loss grads through the wrapper == raw grads through the inner
+    optimizer, bitwise, because /2^k is exact in fp32."""
+    inner = sgd(lambda _: 0.1, momentum=0.9)
+    wrapped = with_dynamic_loss_scale(inner, init_scale=2.0 ** 10,
+                                      growth_interval=10 ** 9)
+    p = _params()
+    s_raw, s_wrap = inner.init(p), wrapped.init(p)
+    for step in range(5):
+        g = _grads(1.0 + step)
+        u_raw, s_raw = inner.update(g, s_raw, p)
+        g_scaled = jax.tree.map(lambda x: x * jnp.float32(2.0 ** 10), g)
+        u_wrap, s_wrap = wrapped.update(g_scaled, s_wrap, p)
+        for a, b in zip(jax.tree.leaves(u_raw), jax.tree.leaves(u_wrap)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_skip_on_nonfinite_preserves_inner_and_backs_off():
+    inner = sgd(lambda _: 0.1, momentum=0.9)
+    wrapped = with_dynamic_loss_scale(inner, init_scale=1024.0)
+    p = _params()
+    state = wrapped.init(p)
+    u, state = wrapped.update(
+        jax.tree.map(lambda g: g * 1024.0, _grads()), state, p)
+    inner_before = jax.tree.map(lambda x: np.asarray(x).copy(), state.inner)
+    bad = jax.tree.map(lambda g: g.at[0].set(jnp.inf), _grads())
+    u, state = wrapped.update(bad, state, p)
+    # update zeroed, inner untouched, scale halved, streak reset
+    assert all(float(np.abs(np.asarray(x)).max()) == 0.0
+               for x in jax.tree.leaves(u))
+    for a, b in zip(jax.tree.leaves(inner_before),
+                    jax.tree.leaves(state.inner)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(state.scale) == 512.0
+    assert int(state.good_steps) == 0
+    # floor: repeated overflow cannot push the scale below min_scale
+    for _ in range(15):
+        _, state = wrapped.update(bad, state, p)
+    assert float(state.scale) == 1.0
+
+
+def test_growth_after_interval_and_cap():
+    inner = sgd(lambda _: 0.1)
+    wrapped = with_dynamic_loss_scale(inner, init_scale=2.0 ** 23,
+                                      growth_interval=3)
+    p = _params()
+    state = wrapped.init(p)
+    scales = []
+    for _ in range(7):
+        g = jax.tree.map(lambda x: x * state.scale, _grads())
+        _, state = wrapped.update(g, state, p)
+        scales.append(float(state.scale))
+    # grows on the 3rd finite step, capped at max_scale=2^24 thereafter
+    assert scales == [2.0 ** 23] * 2 + [2.0 ** 24] * 5
+    assert int(state.good_steps) == 7 - 3 - 3  # reset on growth steps
+
+
+def test_current_scale_type_guard():
+    with pytest.raises(TypeError):
+        current_scale({"not": "wrapped"})
+    st = with_dynamic_loss_scale(sgd(lambda _: 0.1)).init(_params())
+    assert float(current_scale(st)) == 2.0 ** 15
+
+
+def test_bad_factors_rejected():
+    with pytest.raises(ValueError):
+        with_dynamic_loss_scale(sgd(lambda _: 0.1), growth_factor=1.0)
+    with pytest.raises(ValueError):
+        with_dynamic_loss_scale(sgd(lambda _: 0.1), backoff_factor=1.5)
+
+
+class TestDynamicScaleTrainStep:
+    def _setup(self):
+        from cpd_tpu.models.tiny import tiny_cnn
+        from cpd_tpu.parallel.mesh import data_parallel_mesh
+        from cpd_tpu.parallel.dist import replicate
+        from cpd_tpu.train.state import create_train_state
+        from cpd_tpu.train.step import make_train_step
+
+        mesh = data_parallel_mesh()
+        model = tiny_cnn(num_classes=4, width=4)
+        tx = with_dynamic_loss_scale(sgd(lambda _: 0.05, momentum=0.9),
+                                     init_scale=256.0, growth_interval=2)
+        state = create_train_state(model, tx, jnp.zeros((2, 8, 8, 3)),
+                                   jax.random.PRNGKey(0))
+        state = replicate(state, mesh)
+        step = make_train_step(model, tx, mesh, loss_scale="dynamic",
+                               donate=False)
+        n = mesh.devices.size
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2 * n, 8, 8, 3)), jnp.float32)
+        y = jnp.asarray(np.arange(2 * n) % 4, jnp.int32)
+        return state, step, x, y
+
+    def test_trains_and_grows(self):
+        state, step, x, y = self._setup()
+        s1, m1 = step(state, x, y)
+        assert np.isfinite(float(m1["loss"]))
+        # loss metric is the true unscaled loss: ~ln(4) for 4 random classes
+        assert 0.1 < float(m1["loss"]) < 10.0
+        s2, _ = step(s1, x, y)
+        # growth_interval=2: two finite steps -> scale doubled
+        assert float(current_scale(s2.opt_state)) == 512.0
+        p0 = jax.tree.leaves(state.params)[0]
+        p2 = jax.tree.leaves(s2.params)[0]
+        assert np.any(np.asarray(p0) != np.asarray(p2))
+
+    def test_poisoned_batch_skips_update_and_backs_off(self):
+        state, step, x, y = self._setup()
+        s1, _ = step(state, x, y)
+        bad = x.at[0, 0, 0, 0].set(jnp.nan)
+        s2, m2 = step(s1, bad, y)
+        for a, b in zip(jax.tree.leaves(s1.params),
+                        jax.tree.leaves(s2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert float(current_scale(s2.opt_state)) == 128.0
+        # step counter still advances (GradScaler parity)
+        assert int(s2.step) == int(s1.step) + 1
+
+    def test_dynamic_requires_default_update_path(self):
+        from cpd_tpu.models.tiny import tiny_cnn
+        from cpd_tpu.parallel.mesh import data_parallel_mesh
+        from cpd_tpu.train.step import make_train_step
+        with pytest.raises(ValueError):
+            make_train_step(tiny_cnn(), sgd(lambda _: 0.1),
+                            data_parallel_mesh(), loss_scale="dynamic",
+                            update_fn=lambda *a, **k: None)
+
+
+def test_wrapped_tx_with_static_scale_rejected():
+    """The inverse misconfiguration of current_scale's TypeError: a
+    wrapped optimizer + static loss_scale would silently divide every
+    update by the (growing) scale.  Must fail at trace time."""
+    from cpd_tpu.models.tiny import tiny_cnn
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    from cpd_tpu.parallel.dist import replicate
+    from cpd_tpu.train.state import create_train_state
+    from cpd_tpu.train.step import make_train_step
+
+    mesh = data_parallel_mesh()
+    model = tiny_cnn(num_classes=4, width=4)
+    tx = with_dynamic_loss_scale(sgd(lambda _: 0.05))
+    state = replicate(create_train_state(model, tx, jnp.zeros((2, 8, 8, 3)),
+                                         jax.random.PRNGKey(0)), mesh)
+    step = make_train_step(model, tx, mesh, donate=False)  # static scale
+    n = mesh.devices.size
+    x = jnp.zeros((2 * n, 8, 8, 3), jnp.float32)
+    y = jnp.zeros((2 * n,), jnp.int32)
+    with pytest.raises(ValueError, match="with_dynamic_loss_scale"):
+        step(state, x, y)
