@@ -1,13 +1,19 @@
 """Interop: import reference (CPDtorch/torchvision) checkpoints into
-cpd_tpu models."""
+cpd_tpu models, and export trained cpd_tpu models back to torch."""
 
 from .torch_import import (assert_compatible, convert_bn, convert_conv,
                            convert_linear, import_reference_resnet18_cifar,
                            import_torchvision_resnet,
                            load_reference_checkpoint, strip_module_prefix)
+from .torch_export import (export_bn, export_conv, export_linear,
+                           export_reference_resnet18_cifar,
+                           export_torchvision_resnet, save_torch_checkpoint)
 
 __all__ = [
     "assert_compatible", "convert_bn", "convert_conv", "convert_linear",
     "import_reference_resnet18_cifar", "import_torchvision_resnet",
     "load_reference_checkpoint", "strip_module_prefix",
+    "export_bn", "export_conv", "export_linear",
+    "export_reference_resnet18_cifar", "export_torchvision_resnet",
+    "save_torch_checkpoint",
 ]

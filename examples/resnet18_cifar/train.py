@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="warm-start params+BN stats from a reference "
                         "CPDtorch .pth checkpoint (res_cifar arch; "
                         "cpd_tpu.interop converts the layout)")
+    p.add_argument("--export-torch", default="", type=str,
+                   help="after the run (train or -e), write params+BN "
+                        "stats as a reference-format .pth (state_dict "
+                        "wrapper, res_cifar key layout) loadable by the "
+                        "torch reference — the reverse migration path")
     p.add_argument("--grad_exp", default=5, type=int)
     p.add_argument("--grad_man", default=2, type=int)
     p.add_argument("--resume-opt", action="store_true")
@@ -152,6 +157,11 @@ def main(argv=None) -> dict:
     start_iter = 0
     if args.init_from_torch and args.load_path:
         raise SystemExit("--init-from-torch and --load-path are exclusive")
+    if args.export_torch and args.arch != "res_cifar":
+        # fail in milliseconds, not after the training run: only the
+        # reference CIFAR ResNet-18 has a torch key map
+        raise SystemExit(f"--export-torch supports --arch res_cifar only "
+                         f"(got --arch {args.arch})")
     if args.init_from_torch:
         # Migration path: continue training / evaluate a model trained by
         # the torch reference (docs/MIGRATING.md).  Params + BN running
@@ -237,8 +247,27 @@ def main(argv=None) -> dict:
                                          100 * avg["top5"]), flush=True)
         return avg
 
+    def export_torch(state) -> None:
+        if not args.export_torch:
+            return
+        from cpd_tpu.interop import (export_reference_resnet18_cifar,
+                                     save_torch_checkpoint)
+        host = jax.device_get({"params": state.params,
+                               "batch_stats": state.batch_stats})
+        try:
+            sd = export_reference_resnet18_cifar(host)
+        except KeyError as e:
+            raise SystemExit(
+                f"--export-torch supports the res_cifar layout only "
+                f"(--arch {args.arch} has no reference key map): {e}")
+        if rank == 0:
+            save_torch_checkpoint(sd, args.export_torch)
+            print(f"=> exported torch checkpoint {args.export_torch}")
+
     if args.evaluate:                            # mix.py:-e
-        return validate(start_iter)
+        res = validate(start_iter)
+        export_torch(state)
+        return res
 
     sampler = DistributedGivenIterationSampler(
         dataset_len, total_iter, host_batch, world_size=world, rank=rank,
@@ -302,6 +331,8 @@ def main(argv=None) -> dict:
         print(f"done: {step_no - start_iter} iters in {time.time()-t0:.1f}s "
               f"best Prec@1 {best_prec1:.2f}")
     manager.close()
+    if not (preempted or diverged):
+        export_torch(state)
     return {"step": step_no, "best_prec1": best_prec1,
             "diverged": diverged, **last}
 

@@ -252,11 +252,21 @@ def test_trainer_init_from_torch_end_to_end(tmp_path, tiny_cifar_factory):
     torch.save({"state_dict": sd, "step": 1234}, path)
 
     root = tiny_cifar_factory(tmp_path / "cifar", n_train=160, n_test=32)
+    out_pth = str(tmp_path / "exported.pth")
     res = main(["-e", "--arch", "res_cifar", "--data-root", root,
-                "--init-from-torch", path,
+                "--init-from-torch", path, "--export-torch", out_pth,
                 "--save_path", str(tmp_path / "ck")])
     assert set(res) == {"loss", "top1", "top5"}
     assert np.isfinite(res["loss"])
+
+    # the CLI round trip import -> (-e, no training) -> export must hand
+    # back exactly the weights that went in (torch -> jax -> torch)
+    back = torch.load(out_pth, map_location="cpu",
+                      weights_only=True)["state_dict"]
+    for k, v in tm.state_dict().items():
+        if k.endswith("num_batches_tracked"):
+            continue  # flax has no counterpart; exported as 0
+        np.testing.assert_array_equal(back[k].numpy(), v.numpy(), err_msg=k)
 
 
 def test_load_reference_checkpoint_both_wrapper_keys(tmp_path):
@@ -301,3 +311,76 @@ def test_assert_compatible_rejects_wrong_arch():
             lambda: tiny_cnn().init(jax.random.PRNGKey(0),
                                     jnp.zeros((1, 32, 32, 3))))
         assert_compatible(converted, other)
+
+
+# ---------------------------------------------------------------- export
+
+
+def _randomized_stats(variables, seed=0):
+    """Push batch_stats off their 0/1 init so the export mapping is
+    actually exercised (mirrors _warm_bn on the torch side)."""
+    rng = np.random.RandomState(seed)
+    stats = jax.tree.map(
+        lambda s: jnp.asarray(rng.uniform(0.5, 2.0, s.shape), s.dtype),
+        variables["batch_stats"])
+    return {"params": variables["params"], "batch_stats": stats}
+
+
+@pytest.mark.slow
+def test_export_reference_cifar_strict_load_and_roundtrip(tmp_path):
+    from cpd_tpu.interop import (export_reference_resnet18_cifar,
+                                 load_reference_checkpoint,
+                                 save_torch_checkpoint)
+    from cpd_tpu.models import resnet18_cifar
+
+    jm = resnet18_cifar()
+    variables = _randomized_stats(jm.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False))
+    sd = export_reference_resnet18_cifar(variables)
+
+    # strict load into a live torch module with the reference's naming,
+    # then forward parity torch-vs-flax on the same weights
+    tm = _RefResNet18Cifar()
+    tm.load_state_dict({k: torch.as_tensor(np.ascontiguousarray(v))
+                        for k, v in sd.items()}, strict=True)
+    x = np.random.RandomState(7).randn(2, 3, 32, 32).astype(np.float32)
+    _parity(tm, jm, variables, x)
+
+    # disk round-trip: save with the reference wrapper, load+import back,
+    # bitwise-identical trees
+    path = str(tmp_path / "exported.pth")
+    save_torch_checkpoint(sd, path)
+    back = import_reference_resnet18_cifar(load_reference_checkpoint(path))
+    for col in ("params", "batch_stats"):
+        assert (jax.tree.structure(back[col]) ==
+                jax.tree.structure(jax.tree.map(np.asarray,
+                                                variables[col])))
+        for a, b in zip(jax.tree.leaves(variables[col]),
+                        jax.tree.leaves(back[col])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_export_torchvision_bottleneck_strict_load_parity():
+    from cpd_tpu.interop import (export_torchvision_resnet,
+                                 import_torchvision_resnet)
+    from cpd_tpu.models.resnet import Bottleneck, ResNet
+
+    jm = ResNet(stage_sizes=(1, 1, 1, 1), block=Bottleneck,
+                widths=(4, 8, 8, 8), num_classes=13)
+    variables = _randomized_stats(jm.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 64, 64, 3)), train=False),
+        seed=1)
+    sd = export_torchvision_resnet(variables)
+
+    tm = _TvResNet(_TvBottleneck, (1, 1, 1, 1), (4, 8, 8, 8),
+                   num_classes=13, expansion=4)
+    tm.load_state_dict({k: torch.as_tensor(np.ascontiguousarray(v))
+                        for k, v in sd.items()}, strict=True)
+    x = np.random.RandomState(9).randn(2, 3, 64, 64).astype(np.float32)
+    _parity(tm, jm, variables, x)
+
+    back = import_torchvision_resnet(sd)
+    for a, b in zip(jax.tree.leaves(variables["params"]),
+                    jax.tree.leaves(back["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
