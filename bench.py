@@ -283,6 +283,10 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 tflops = per_chip * FLOPS_PER_IMG / 1e12
                 partial["tflops_per_sec"] = round(tflops, 1)
                 partial["mfu_pct"] = round(100.0 * tflops / peak, 1)
+            # stream the flagship result NOW: if the tunnel wedges during
+            # an extra and the parent SIGKILLs this child, the partial
+            # line is already in the pipe for the parent to salvage
+            emit({**partial, "partial": True})
         else:
             partial["fast_mode_img_per_sec_per_chip"] = round(
                 results["fast"], 2)
@@ -471,14 +475,43 @@ def main():
                 [sys.executable, os.path.abspath(__file__)], env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
                 capture_output=True, text=True, timeout=attempt_secs)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as te:
+            # salvage: the child streams the flagship result as soon as it
+            # is measured, so a hang during the budget-gated extras must
+            # not discard a completed measurement
+            t_out = te.stdout
+            if isinstance(t_out, bytes):
+                t_out = t_out.decode(errors="replace")
+            out = _last_json_line(t_out or "")
+            if out is not None and out.get("value") is not None:
+                out.pop("partial", None)
+                out["salvaged_after_hang"] = True
+                out["probe_secs"] = probe.get("secs")
+                if out.get("platform") == "tpu":
+                    _record_last_good(out)
+                emit(out)
+                return
             last_err = (f"attempt {attempt + 1}: child killed after "
                         f"{int(attempt_secs)}s (backend init or compile "
                         f"hang)")
             print(f"# {last_err}", file=sys.stderr)
+            # a hang is native-level badness just like a signal death: a
+            # truncated/poisoned compile-cache entry can wedge every
+            # retry, so recompile clean (same rationale as the rc<0 wipe)
+            from cpd_tpu.utils import clear_cache
+            clear_cache()
             continue
         out = _last_json_line(proc.stdout)
         if out is not None and out.get("value") is not None:
+            if out.pop("partial", False):
+                # the child died AFTER streaming the flagship line (its
+                # final emit never ran) — keep the measurement, note the
+                # death, and treat a native death like the rc<0 path
+                # below: recompile clean next time
+                out["salvaged_after_child_death"] = f"rc={proc.returncode}"
+                if proc.returncode < 0:
+                    from cpd_tpu.utils import clear_cache
+                    clear_cache()
             out["probe_secs"] = probe.get("secs")
             # only a TPU measurement is worth remembering (CPU smoke runs
             # set BENCH_FORCE_PLATFORM / tiny shapes)
