@@ -543,7 +543,6 @@ def test_lm_sharded_grads_match_single_device():
     parameter, sharded and replicated alike."""
     import optax
     from cpd_tpu.models.transformer import lm_param_specs
-    from cpd_tpu.parallel.dist import sum_gradients
 
     rng = np.random.RandomState(7)
     toks = jnp.asarray(rng.randint(0, 64, (4, 32)).astype(np.int32))

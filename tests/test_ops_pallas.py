@@ -5,12 +5,11 @@ The kernels share `cast_body` with the XLA path, so equality must be exact
 """
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 
 from cpd_tpu.ops import qgemm_pallas, quantize_pallas
-from cpd_tpu.quant import float_quantize, quant_gemm
+from cpd_tpu.quant import quant_gemm
 from cpd_tpu.quant.numerics import cast_to_format
 
 FORMATS = [(5, 2), (4, 3), (8, 23), (2, 0), (8, 0), (1, 10)]

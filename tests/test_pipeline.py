@@ -16,7 +16,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from cpd_tpu.models.pipeline_lm import pipelined_lm, pp_param_specs
 from cpd_tpu.parallel.mesh import make_mesh
 from cpd_tpu.parallel.pipeline import pipeline_spmd
-from cpd_tpu.train import create_train_state, make_optimizer
+from cpd_tpu.train import make_optimizer
 from cpd_tpu.train.pp import make_pp_train_step, pp_state_specs
 from cpd_tpu.train.state import TrainState
 

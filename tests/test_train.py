@@ -8,7 +8,7 @@ import pytest
 
 from cpd_tpu.data import (CIFAR10Pipeline, DistributedGivenIterationSampler,
                           GivenIterationSampler, synthetic_cifar10)
-from cpd_tpu.models import resnet18_cifar, tiny_cnn
+from cpd_tpu.models import tiny_cnn
 from cpd_tpu.parallel.mesh import data_parallel_mesh
 from cpd_tpu.train import (create_train_state, make_eval_step,
                            make_optimizer, make_train_step, piecewise_linear,
