@@ -53,6 +53,12 @@ OPT_CONFIGS = [
     ("opt_e4m3_naive", ["--opt_exp", "4", "--opt_man", "3"]),
     ("opt_e4m3_kahan", ["--opt_exp", "4", "--opt_man", "3",
                         "--opt_kahan"]),
+    # stochastic rounding: the OTHER cure for low-precision update
+    # stagnation — unbiased random round direction instead of a
+    # deterministic residual.  Exploration (seeds 0 and 7: 95.20 / 94.80
+    # vs naive 92.97) sits between naive and Kahan, as theory predicts.
+    ("opt_e4m3_sr", ["--opt_exp", "4", "--opt_man", "3",
+                     "--opt-rounding", "stochastic"]),
 ]
 
 
@@ -187,18 +193,26 @@ def check_lm_ordering(results: dict, margin: float = 0.5,
 
 def check_opt_ordering(results: dict, margin: float = 1.0,
                        recover: float = 2.0) -> list[str]:
-    """Kahan-compensated eXmY momentum recovers what naive loses."""
+    """Kahan-compensated eXmY momentum recovers what naive loses; so does
+    unbiased stochastic rounding (by a smaller, noisier margin)."""
     fp32 = results["opt_fp32"]["prec1"]
     naive = results["opt_e4m3_naive"]["prec1"]
     kahan = results["opt_e4m3_kahan"]["prec1"]
     ok_gain = kahan >= naive + margin
     ok_recover = kahan >= fp32 - recover
-    return [
+    checks = [
         f"opt e4m3: kahan {kahan:.2f} >= naive {naive:.2f} + {margin} -> "
         f"{'OK' if ok_gain else 'VIOLATED'}",
         f"opt e4m3: kahan {kahan:.2f} >= fp32 {fp32:.2f} - {recover} -> "
         f"{'OK' if ok_recover else 'VIOLATED'}",
     ]
+    if "opt_e4m3_sr" in results:
+        sr = results["opt_e4m3_sr"]["prec1"]
+        ok_sr = sr >= naive + margin
+        checks.append(
+            f"opt e4m3: sr {sr:.2f} >= naive {naive:.2f} + {margin} -> "
+            f"{'OK' if ok_sr else 'VIOLATED'}")
+    return checks
 
 
 def check_ordering(results: dict, margin: float = 2.0) -> list[str]:

@@ -60,6 +60,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--opt_man", default=23, type=int)
     p.add_argument("--opt_kahan", action="store_true",
                    help="Kahan-compensate the quantized momentum buffer")
+    p.add_argument("--opt-rounding", default="nearest",
+                   choices=["nearest", "stochastic"],
+                   help="rounding of the eXmY momentum-buffer casts: "
+                        "stochastic = unbiased SR (cures sub-ulp/2 update "
+                        "stagnation; train/optim.py quant_sgd)")
+    p.add_argument("--opt-seed", default=0, type=int,
+                   help="PRNG seed for --opt-rounding stochastic")
     p.add_argument("-e", "--evaluate", action="store_true")
     p.add_argument("--emulate_node", default=1, type=int)
     # YAML-backed keys (mix.py:69-72 merges the YAML onto args); a CLI
@@ -142,12 +149,20 @@ def main(argv=None) -> dict:
     if quant_opt and args.use_lars:
         raise SystemExit("--use_lars and --opt_exp/--opt_man/--opt_kahan "
                          "are exclusive")
+    if (args.opt_rounding != "nearest"
+            and (args.opt_exp, args.opt_man) == (8, 23)):
+        # quant_opt alone is not enough: --opt_kahan with an fp32 buffer
+        # would silently drop SR (quant_sgd's (8,23) identity shortcut)
+        raise SystemExit("--opt-rounding stochastic needs a quantized "
+                         "buffer (--opt_exp/--opt_man below fp32)")
     opt_name = ("lars" if args.use_lars else
                 "quant_sgd" if quant_opt else "sgd")
     tx = make_optimizer(opt_name, schedule, momentum=args.momentum,
                         weight_decay=args.weight_decay,
                         opt_exp=args.opt_exp, opt_man=args.opt_man,
                         opt_kahan=args.opt_kahan,
+                        opt_rounding=args.opt_rounding,
+                        opt_seed=args.opt_seed,
                         clip_norm=args.clip_grad)
 
     state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),

@@ -58,3 +58,4 @@ def test_golden_arm_on_real_format_cifar(tmp_path, tiny_cifar_factory):
 
     assert np.isfinite(res["fp32"]["prec1"])
     assert not res["fp32"]["diverged"]
+

@@ -53,3 +53,25 @@ def test_pyproject_consistent():
         meta = tomllib.load(f)
     assert meta["project"]["version"] == cpd_tpu.__version__
     assert meta["project"]["name"] == "cpd-tpu"
+
+
+def test_committed_golden_results_consistent():
+    """The committed evidence (docs/golden/results.json) must contain every
+    arm the harness currently defines, and every recorded ordering check
+    must have passed — catches a results.json left stale after an arm is
+    added, and a committed run with violations."""
+    import json
+    import os
+
+    import aps_golden
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "golden", "results.json")
+    with open(path) as f:
+        rec = json.load(f)
+    assert {t for t, *_ in aps_golden.CONFIGS} <= set(rec["prec1"])
+    assert {t for t, _ in aps_golden.OPT_CONFIGS} <= set(rec["opt_prec1"])
+    assert {t for t, *_ in aps_golden.LM_CONFIGS} <= set(rec["lm_loss"])
+    assert rec["checks"], "no ordering checks recorded"
+    bad = [c for c in rec["checks"] if "VIOLATED" in c]
+    assert not bad, bad
