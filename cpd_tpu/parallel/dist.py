@@ -46,7 +46,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..quant.numerics import cast_to_format
+from ..quant.numerics import cast_to_format, cast_to_format_sr
 from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
                   aps_unscale, pmax_scalar_vector)
 from .reduction import quantized_sum
@@ -126,6 +126,17 @@ def all_reduce_mean(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     return lax.pmean(x, axis_name)
 
 
+def _flat_axis_index(axis_name) -> jnp.ndarray:
+    """This rank's flat index along one axis name or a sequence of them
+    (row-major over the sequence), for per-rank SR key decorrelation."""
+    if isinstance(axis_name, str):
+        return lax.axis_index(axis_name)
+    idx = jnp.zeros([], jnp.int32)
+    for a in axis_name:
+        idx = idx * lax.psum(jnp.int32(1), a) + lax.axis_index(a)
+    return idx
+
+
 def _wire_dtype(grad_exp: int, grad_man: int):
     """Hardware dtype that exactly represents the (exp, man) value set —
     including its infinities — or None.
@@ -156,7 +167,7 @@ _BUCKET_ELEMS = 4 * 1024 * 1024
 def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
                             grad_man: int, use_kahan: bool,
                             bucket_elems: int = _BUCKET_ELEMS,
-                            wire=None) -> Any:
+                            wire=None, key=None) -> Any:
     """Faithful ordered reduction over few large buckets instead of one
     collective per parameter (SURVEY.md §7 hard-part 4).
 
@@ -167,6 +178,11 @@ def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
     element's value — results are bit-identical to the per-leaf path (the
     reference's per-parameter loop, dist_util.py:60-89), with W x leaf_count
     collective launches collapsed to W x bucket_count.
+
+    With stochastic rounding (`key` given) the per-element bitstream is
+    drawn per bucket (folded on the bucket's first leaf index), so bucketed
+    and per-leaf results are two different — equally valid — SR draws, NOT
+    bit-identical; each is deterministic given (key, bucket layout).
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     out = [None] * len(leaves)
@@ -192,7 +208,10 @@ def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
                     jnp.concatenate([leaves[i].reshape(-1)
                                      for i in bucket]))
             gathered = _gather_leaf(flat, axis_name, wire=wire)
-            red = quantized_sum(gathered, grad_exp, grad_man, use_kahan)
+            bkey = (None if key is None else
+                    jax.random.fold_in(key, bucket[0]))
+            red = quantized_sum(gathered, grad_exp, grad_man, use_kahan,
+                                key=bkey)
             off = 0
             for i in bucket:
                 n = leaves[i].size
@@ -205,7 +224,8 @@ def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
 def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   use_aps: bool = False, grad_exp: int = 5, grad_man: int = 2,
                   use_kahan: bool = False, mode: str = "faithful",
-                  bucket: Optional[bool] = None) -> Any:
+                  bucket: Optional[bool] = None,
+                  rounding: str = "nearest", key=None) -> Any:
     """Low-precision gradient all-reduce (SUM) over `axis_name`.
 
     Pure pytree-in/pytree-out version of reference `sum_gradients`
@@ -223,12 +243,52 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   — off elsewhere (on the CPU mesh the gather is a plain
                   memcpy and the bucket concat/split copies measured ~17%
                   slower on a ResNet-18-sized pytree).
+    rounding    → "nearest" (reference semantics) | "stochastic": every
+                  eXmY cast in the pipeline (the APS/fast pre-quantize,
+                  each ordered-accumulation step, the fast post-quantize)
+                  uses the unbiased SR cast driven by `key` (required) —
+                  sub-ulp/2 gradient mass then survives the reduction in
+                  expectation, the unbiased alternative to APS's exponent
+                  shifting (beyond-reference; composes with it too).
+                  Deterministic given (key, bucket layout); every rank
+                  derives identical bits, so replicated outputs agree.
     """
     if mode not in ("faithful", "fast"):
         raise ValueError(f"unknown mode {mode!r}")
+    if rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"unknown rounding {rounding!r}")
+    if rounding == "stochastic" and key is None:
+        raise ValueError("rounding='stochastic' requires a PRNG key "
+                         "(fold in the step counter for fresh per-step "
+                         "bits)")
+    if rounding == "nearest":
+        key = None
     if bucket is None:
         bucket = jax.default_backend() == "tpu"
     world = lax.psum(jnp.float32(1.0), axis_name)
+
+    # Independent SR bitstreams for the three cast stages.  The pre-
+    # quantize acts on each rank's OWN gradients, so its key folds in the
+    # rank index — identical bits across ranks would round similar
+    # gradients the same way and the summed rounding error would grow
+    # coherently (~W*ulp) instead of averaging out (~sqrt(W)*ulp).  The
+    # ordered-sum and post-psum casts act on data that is identical on
+    # every rank (gathered / reduced), so THEIR keys must stay shared or
+    # the replicated outputs would disagree.
+    k_pre = k_sum = k_post = None
+    if key is not None:
+        k_pre, k_sum, k_post = jax.random.split(key, 3)
+        k_pre = jax.random.fold_in(k_pre, _flat_axis_index(axis_name))
+
+    def q_tree(t, k):
+        if k is None:
+            return jax.tree.map(
+                lambda g: cast_to_format(g, grad_exp, grad_man), t)
+        leaves, treedef = jax.tree_util.tree_flatten(t)
+        out = [cast_to_format_sr(g, grad_exp, grad_man,
+                                 jax.random.fold_in(k, i))
+               for i, g in enumerate(leaves)]
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     shifts = None
     if use_aps:
@@ -236,17 +296,14 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         max_exp = pmax_scalar_vector(max_exp, axis_name)
         shifts = aps_shift_factors(max_exp, grad_exp)
         grads = aps_scale(grads, shifts)
-        grads = jax.tree.map(
-            lambda g: cast_to_format(g, grad_exp, grad_man), grads)
+        grads = q_tree(grads, k_pre)
 
     if mode == "fast":
         if not use_aps and not (grad_exp == 8 and grad_man == 23):
-            grads = jax.tree.map(
-                lambda g: cast_to_format(g, grad_exp, grad_man), grads)
+            grads = q_tree(grads, k_pre)
         reduced = jax.tree.map(lambda g: lax.psum(g, axis_name), grads)
         if not (grad_exp == 8 and grad_man == 23):
-            reduced = jax.tree.map(
-                lambda g: cast_to_format(g, grad_exp, grad_man), reduced)
+            reduced = q_tree(reduced, k_post)
     else:
         # Wire compression: with APS the gathered values were quantized to
         # the (exp, man) value set just above, so when a hardware dtype
@@ -261,13 +318,16 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         elif bucket:
             reduced = _bucketed_quantized_sum(grads, axis_name, grad_exp,
                                               grad_man, use_kahan,
-                                              wire=wire)
+                                              wire=wire, key=k_sum)
         else:
-            reduced = jax.tree.map(
-                lambda g: quantized_sum(
-                    _gather_leaf(g, axis_name, wire=wire),
-                    grad_exp, grad_man, use_kahan),
-                grads)
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            out = [quantized_sum(
+                       _gather_leaf(g, axis_name, wire=wire),
+                       grad_exp, grad_man, use_kahan,
+                       key=(None if k_sum is None
+                            else jax.random.fold_in(k_sum, i)))
+                   for i, g in enumerate(leaves)]
+            reduced = jax.tree_util.tree_unflatten(treedef, out)
 
     if use_aps:
         reduced = aps_unscale(reduced, shifts)

@@ -30,7 +30,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ..quant.numerics import cast_to_format
+from ..quant.numerics import cast_to_format, cast_to_format_sr
 from .aps import aps_max_exponents, aps_shift_factors
 from .reduction import ordered_quantized_sum
 
@@ -38,7 +38,7 @@ __all__ = ["emulate_node_reduce"]
 
 
 def _reduce_leaf(g: jnp.ndarray, n: int, use_aps: bool,
-                 grad_exp: int, grad_man: int) -> jnp.ndarray:
+                 grad_exp: int, grad_man: int, key=None) -> jnp.ndarray:
     """Reduce one stacked leaf (N, *shape) -> (*shape,)."""
     if n == 1:
         return g[0]  # mix.py:254-256 — no quantization for a single grad
@@ -48,19 +48,35 @@ def _reduce_leaf(g: jnp.ndarray, n: int, use_aps: bool,
     else:
         shift = jnp.float32(0.0)  # quantize still runs (mix.py:267-271)
     scale = jnp.exp2(shift)
-    g = cast_to_format(g * scale, grad_exp, grad_man)
-    res = ordered_quantized_sum(g, grad_exp, grad_man)
+    if key is None:
+        g = cast_to_format(g * scale, grad_exp, grad_man)
+        res = ordered_quantized_sum(g, grad_exp, grad_man)
+    else:
+        k_pre, k_sum = jax.random.split(key)
+        g = cast_to_format_sr(g * scale, grad_exp, grad_man, k_pre)
+        res = ordered_quantized_sum(g, grad_exp, grad_man, key=k_sum)
     return res / jnp.exp2(shift)  # true divide, as mix.py:280 does
 
 
 def emulate_node_reduce(stacked_grads: Any, emulate_node: int,
                         use_aps: bool = False, grad_exp: int = 5,
-                        grad_man: int = 2) -> Any:
+                        grad_man: int = 2, key=None) -> Any:
     """Locally reduce N stacked micro-batch gradients per leaf.
 
     stacked_grads: pytree with leaves shaped (emulate_node, *param_shape).
     Returns the locally-accumulated gradient pytree (leaf shape
-    (*param_shape,)), ready for the cross-device `sum_gradients`."""
-    return jax.tree.map(
-        lambda g: _reduce_leaf(g, emulate_node, use_aps, grad_exp, grad_man),
-        stacked_grads)
+    (*param_shape,)), ready for the cross-device `sum_gradients`.
+
+    `key` (beyond-reference) switches every cast — the local pre-quantize
+    and each ordered-accumulation step — to unbiased stochastic rounding,
+    one independent bitstream per leaf."""
+    if key is None:
+        return jax.tree.map(
+            lambda g: _reduce_leaf(g, emulate_node, use_aps, grad_exp,
+                                   grad_man),
+            stacked_grads)
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_grads)
+    out = [_reduce_leaf(g, emulate_node, use_aps, grad_exp, grad_man,
+                        key=jax.random.fold_in(key, i))
+           for i, g in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -23,60 +23,91 @@ from __future__ import annotations
 
 import functools
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..quant.numerics import cast_to_format
+from ..quant.numerics import cast_to_format, cast_to_format_sr
 
 __all__ = ["ordered_quantized_sum", "kahan_quantized_sum", "quantized_sum"]
 
 
-def ordered_quantized_sum(stacked: jnp.ndarray, exp: int, man: int) -> jnp.ndarray:
+def _make_q(exp: int, man: int, key):
+    """Per-step quantizer factory.  key=None -> RTNE (reference semantics,
+    ignores the step/site arguments).  With a PRNG key -> unbiased
+    stochastic rounding with an independent bitstream per (step, site):
+    the sequential accumulation stays ordered and deterministic-given-key,
+    but each partial sum rounds up with probability equal to its discarded
+    fraction — so sub-ulp/2 contributions survive in expectation instead
+    of being flushed (the failure mode of an un-APS'd low-precision sum)."""
+    if key is None:
+        rtne = functools.partial(cast_to_format, exp_bits=exp, man_bits=man)
+        return lambda x, step, site: rtne(x)
+
+    def q(x, step, site):
+        k = jax.random.fold_in(jax.random.fold_in(key, step), site)
+        return cast_to_format_sr(x, exp, man, k)
+
+    return q
+
+
+def ordered_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
+                          key=None) -> jnp.ndarray:
     """res = 0; for g in stacked: res = quantize(res + g)   — in order.
 
     Mirrors reference normal_sum_gradients' gather path
     (dist_util.py:60-69): accumulation starts from zeros, and every partial
     sum is re-cast to eXmY.  `stacked` has shape (W, *leaf_shape).
+    `key` switches the per-step cast to stochastic rounding (see _make_q).
     """
-    q = functools.partial(cast_to_format, exp_bits=exp, man_bits=man)
+    q = _make_q(exp, man, key)
 
-    def step(res, g):
-        return q(res + g), None
+    def step(carry, xs):
+        res, i = carry
+        return (q(res + xs, i, 0), i + 1), None
 
-    res, _ = lax.scan(step, jnp.zeros_like(stacked[0]), stacked)
+    (res, _), _ = lax.scan(
+        step, (jnp.zeros_like(stacked[0]), jnp.zeros([], jnp.int32)),
+        stacked)
     return res
 
 
-def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int) -> jnp.ndarray:
+def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
+                        key=None) -> jnp.ndarray:
     """Rank-ordered Kahan-compensated sum with every intermediate quantized.
 
     Mirrors reference kahan_sum_gradients (dist_util.py:72-89):
 
         y = q(g - c); t = q(res + y); c = q(q(t - res) - y); res = t
+
+    With `key`, each of the four casts draws its own SR bitstream per rank
+    step (sites 0-3).
     """
-    q = functools.partial(cast_to_format, exp_bits=exp, man_bits=man)
+    q = _make_q(exp, man, key)
 
     def step(carry, g):
-        res, c = carry
-        y = q(g - c)
-        t = q(res + y)
-        c = q(q(t - res) - y)
-        return (t, c), None
+        res, c, i = carry
+        y = q(g - c, i, 0)
+        t = q(res + y, i, 1)
+        c = q(q(t - res, i, 2) - y, i, 3)
+        return (t, c, i + 1), None
 
     zero = jnp.zeros_like(stacked[0])
-    (res, _), _ = lax.scan(step, (zero, zero), stacked)
+    (res, _, _), _ = lax.scan(
+        step, (zero, zero, jnp.zeros([], jnp.int32)), stacked)
     return res
 
 
 def quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
-                  use_kahan: bool = False) -> jnp.ndarray:
+                  use_kahan: bool = False, key=None) -> jnp.ndarray:
     """Dispatch between the plain and Kahan ordered quantized sums.
 
     The fp32 shortcut (exp==8, man==23 → plain sum) applies only to the
     non-Kahan path, exactly as the reference does (dist_util.py:55-59 has the
-    shortcut; kahan_sum_gradients:72-89 does not)."""
+    shortcut; kahan_sum_gradients:72-89 does not).  The shortcut also makes
+    `key` irrelevant there (SR at (8,23) is the identity)."""
     if use_kahan:
-        return kahan_quantized_sum(stacked, exp, man)
+        return kahan_quantized_sum(stacked, exp, man, key=key)
     if exp == 8 and man == 23:
         return jnp.sum(stacked, axis=0)
-    return ordered_quantized_sum(stacked, exp, man)
+    return ordered_quantized_sum(stacked, exp, man, key=key)

@@ -95,6 +95,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     use_aps: bool = False, grad_exp: int = 8,
                     grad_man: int = 23, use_kahan: bool = False,
                     mode: str = "faithful", loss_scale: float = 1.0,
+                    grad_rounding: str = "nearest", grad_seed: int = 0,
                     loss_fn: Callable = cross_entropy_loss,
                     rng_keys: tuple = (), rng_seed: int = 0,
                     ignore_label: Optional[int] = None,
@@ -124,6 +125,12 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     flat-shard all_gather + unflatten of parallel/zero.py `_Zero3`);
     update_fn then returns params back in the STORED layout.
     """
+    if grad_rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
+    if grad_rounding == "stochastic" and reduce_in_update:
+        raise ValueError("grad_rounding='stochastic' is not supported with "
+                         "reduce_in_update (ZeRO updaters own their "
+                         "collective and do not thread SR keys)")
     dynamic_scale = loss_scale == "dynamic"
     if dynamic_scale and update_fn is not None:
         raise ValueError("loss_scale='dynamic' requires the default optax "
@@ -230,14 +237,29 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         # Local emulated-node reduction (mix.py:251-282), then the
         # cross-device low-precision all-reduce (mix.py:286-291).
-        local = emulate_node_reduce(stacked, emulate_node, use_aps,
-                                    grad_exp, grad_man)
+        # grad_rounding='stochastic': fresh unbiased SR bits per step,
+        # identical on every rank (the key depends only on seed + step),
+        # so the replicated reduction outputs stay consistent.
+        gkey = None
+        if grad_rounding == "stochastic":
+            gkey = jax.random.fold_in(jax.random.PRNGKey(grad_seed),
+                                      state.step)
+        # the emulate-node reduce is rank-LOCAL, so its key also folds in
+        # the rank index (same decorrelation the dropout rngs get above;
+        # sum_gradients folds the rank into its own pre-quantize key)
+        local = emulate_node_reduce(
+            stacked, emulate_node, use_aps, grad_exp, grad_man,
+            key=None if gkey is None else jax.random.fold_in(
+                jax.random.fold_in(gkey, 0),
+                lax.axis_index(axis_name).astype(jnp.int32)))
         if reduce_in_update:
             reduced = local       # update_fn owns the collective
         else:
-            reduced = sum_gradients(local, axis_name, use_aps=use_aps,
-                                    grad_exp=grad_exp, grad_man=grad_man,
-                                    use_kahan=use_kahan, mode=mode)
+            reduced = sum_gradients(
+                local, axis_name, use_aps=use_aps,
+                grad_exp=grad_exp, grad_man=grad_man,
+                use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
+                key=None if gkey is None else jax.random.fold_in(gkey, 1))
 
         if update_fn is not None:
             # custom update (e.g. parallel/zero.py ZeRO: shard-local

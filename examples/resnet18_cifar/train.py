@@ -50,6 +50,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "torch reference — the reverse migration path")
     p.add_argument("--grad_exp", default=5, type=int)
     p.add_argument("--grad_man", default=2, type=int)
+    p.add_argument("--grad-rounding", default="nearest",
+                   choices=["nearest", "stochastic"],
+                   help="rounding of every cast in the gradient pipeline "
+                        "(emulate-node + all-reduce): stochastic = "
+                        "unbiased SR, the alternative to APS's exponent "
+                        "shifting for sub-ulp gradient survival")
+    p.add_argument("--grad-seed", default=0, type=int,
+                   help="PRNG seed for --grad-rounding stochastic")
     p.add_argument("--resume-opt", action="store_true")
     p.add_argument("--use_lars", action="store_true")
     p.add_argument("--use_APS", action="store_true")
@@ -227,7 +235,8 @@ def main(argv=None) -> dict:
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
-        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode)
+        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
+        grad_rounding=args.grad_rounding, grad_seed=args.grad_seed)
     eval_step = make_eval_step(model, mesh)
 
     # Global per-step batch = per-chip batch x chips x emulated nodes

@@ -1,0 +1,164 @@
+"""Stochastic rounding through the GRADIENT pipeline (beyond-reference).
+
+Mechanism level: SR reduction properties (determinism, two-neighbor
+validity, unbiased survival of sub-ulp mass that RTNE flushes).
+Collective level: sum_gradients(rounding="stochastic") on the 8-device
+mesh — deterministic given key, consistent replicated outputs, key
+required.  Step level: make_train_step(grad_rounding=...) trains, and at
+an aggressive format SR visibly de-stagnates what RTNE flushes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cpd_tpu.parallel import (data_parallel_mesh, emulate_node_reduce,
+                              ordered_quantized_sum, sum_gradients)
+from cpd_tpu.quant.numerics import cast_to_format
+
+
+def test_ordered_sum_sr_deterministic_and_valid():
+    """Given a key the SR reduction is reproducible; each partial is in the
+    format's value set, so the final result re-casts to itself."""
+    rng = np.random.default_rng(0)
+    stacked = jnp.asarray(rng.normal(size=(8, 64)).astype(np.float32))
+    k = jax.random.PRNGKey(3)
+    a = ordered_quantized_sum(stacked, 5, 2, key=k)
+    b = ordered_quantized_sum(stacked, 5, 2, key=k)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = ordered_quantized_sum(stacked, 5, 2, key=jax.random.PRNGKey(4))
+    assert np.any(np.asarray(a) != np.asarray(c))
+    recast = cast_to_format(a, 5, 2)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(recast))
+
+
+def test_sr_reduction_recovers_flushed_mass():
+    """16 contributions of ulp/8 each: RTNE accumulates exactly 0 (every
+    partial flushes), SR accumulates ~2 ulp in expectation."""
+    exp, man = 4, 3
+    ulp = 2.0 ** -3  # at 1.0; use values near 1 so ulp is fixed
+    base = jnp.ones((1, 512), jnp.float32)
+    tiny = jnp.full((16, 512), ulp / 8, jnp.float32)
+    stacked = jnp.concatenate([base, tiny])  # start at 1.0, then drip
+    rtne = np.asarray(ordered_quantized_sum(stacked, exp, man))
+    np.testing.assert_array_equal(rtne, 1.0)  # fully stagnated
+    sr = np.asarray(ordered_quantized_sum(stacked, exp, man,
+                                          key=jax.random.PRNGKey(0)))
+    # E[sum] = 1 + 16 * ulp/8 = 1.25; mean over 512 elements is tight
+    assert 1.1 < float(sr.mean()) < 1.4, sr.mean()
+
+
+def test_sum_gradients_sr_collective():
+    mesh = data_parallel_mesh()
+    W = mesh.devices.size
+    rng = np.random.default_rng(1)
+    tree = {"w": jnp.asarray(rng.normal(size=(W, 33)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(W, 5)).astype(np.float32))}
+    sharded = jax.tree.map(
+        lambda g: jax.device_put(g, NamedSharding(mesh, P("dp"))), tree)
+
+    def run(key, mode):
+        def body(stacked):
+            local = jax.tree.map(lambda g: g[0], stacked)
+            return sum_gradients(local, "dp", use_aps=True, grad_exp=5,
+                                 grad_man=2, mode=mode,
+                                 rounding="stochastic", key=key)
+        in_spec = jax.tree.map(lambda _: P("dp"), tree)
+        out_spec = jax.tree.map(lambda _: P(), tree)
+        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(in_spec,),
+                               out_specs=out_spec, check_vma=False))
+        return jax.tree.map(np.asarray, fn(sharded))
+
+    k = jax.random.PRNGKey(9)
+    for mode in ("faithful", "fast"):
+        a, b = run(k, mode), run(k, mode)
+        for leaf_a, leaf_b in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(leaf_a, leaf_b)
+        c = run(jax.random.PRNGKey(10), mode)
+        assert any(np.any(x != y) for x, y in
+                   zip(jax.tree.leaves(a), jax.tree.leaves(c)))
+
+
+def test_sum_gradients_sr_requires_key():
+    mesh = data_parallel_mesh()
+    x = jax.device_put(jnp.ones((mesh.devices.size, 4)),
+                       NamedSharding(mesh, P("dp")))
+
+    def body(stacked):
+        return sum_gradients({"w": stacked[0]}, "dp", grad_exp=5,
+                             grad_man=2, rounding="stochastic")
+
+    with pytest.raises(ValueError, match="requires a PRNG key"):
+        jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                          out_specs=jax.tree.map(lambda _: P(), {"w": 0}),
+                          check_vma=False))(x)
+
+
+def test_emulate_node_sr_deterministic():
+    rng = np.random.default_rng(2)
+    tree = {"w": jnp.asarray(rng.normal(size=(4, 17)).astype(np.float32))}
+    k = jax.random.PRNGKey(5)
+    a = emulate_node_reduce(tree, 4, use_aps=True, grad_exp=4, grad_man=3,
+                            key=k)
+    b = emulate_node_reduce(tree, 4, use_aps=True, grad_exp=4, grad_man=3,
+                            key=k)
+    np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
+    # n == 1 shortcut unaffected by the key (no quantization at all)
+    one = emulate_node_reduce({"w": tree["w"][:1]}, 1, key=k)
+    np.testing.assert_array_equal(np.asarray(one["w"]),
+                                  np.asarray(tree["w"][0]))
+
+
+class TestTrainStepGradRounding:
+    def _step(self, grad_rounding, grad_man=3, seed=0):
+        from cpd_tpu.models.tiny import tiny_cnn
+        from cpd_tpu.parallel.dist import replicate
+        from cpd_tpu.train.optim import sgd
+        from cpd_tpu.train.state import create_train_state
+        from cpd_tpu.train.step import make_train_step
+
+        mesh = data_parallel_mesh()
+        model = tiny_cnn(num_classes=4, width=4)
+        tx = sgd(lambda _: 0.05, momentum=0.9)
+        state = replicate(create_train_state(
+            model, tx, jnp.zeros((2, 8, 8, 3)), jax.random.PRNGKey(0)),
+            mesh)
+        step = make_train_step(model, tx, mesh, grad_exp=4,
+                               grad_man=grad_man, emulate_node=2,
+                               grad_rounding=grad_rounding, grad_seed=seed,
+                               donate=False)
+        n = mesh.devices.size
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(4 * n, 8, 8, 3)), jnp.float32)
+        y = jnp.asarray(np.arange(4 * n) % 4, jnp.int32)
+        return state, step, x, y
+
+    def test_trains_and_is_seed_deterministic(self):
+        state, step, x, y = self._step("stochastic")
+        s1, m1 = step(state, x, y)
+        assert np.isfinite(float(m1["loss"]))
+        s1b, _ = step(state, x, y)
+        for a, b in zip(jax.tree.leaves(s1.params),
+                        jax.tree.leaves(s1b.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # a different seed takes a different trajectory
+        _, step2, _, _ = self._step("stochastic", seed=1)
+        s2, _ = step2(state, x, y)
+        assert any(np.any(np.asarray(a) != np.asarray(b)) for a, b in
+                   zip(jax.tree.leaves(s1.params),
+                       jax.tree.leaves(s2.params)))
+
+    def test_sr_rejected_with_reduce_in_update(self):
+        from cpd_tpu.models.tiny import tiny_cnn
+        from cpd_tpu.train.optim import sgd
+        from cpd_tpu.train.step import make_train_step
+        with pytest.raises(ValueError, match="reduce_in_update"):
+            make_train_step(tiny_cnn(), sgd(lambda _: 0.1),
+                            data_parallel_mesh(),
+                            grad_rounding="stochastic",
+                            reduce_in_update=True,
+                            update_fn=lambda *a, **k: None)
