@@ -52,7 +52,8 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        use_aps: bool = False, grad_exp: int = 8,
                        grad_man: int = 23, use_kahan: bool = False,
                        mode: str = "faithful", donate: bool = True,
-                       label_smoothing: float = 0.0, rng_seed: int = 0):
+                       label_smoothing: float = 0.0, rng_seed: int = 0,
+                       grad_rounding: str = "nearest", grad_seed: int = 0):
     """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
 
     tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
@@ -63,6 +64,8 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     if not 0.0 <= label_smoothing < 1.0:
         raise ValueError(f"label_smoothing must be in [0, 1), got "
                          f"{label_smoothing}")
+    if grad_rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
     # Guard: the optimizer update runs shard-local, which is only exact for
     # elementwise transforms (see reject_norm_based).  With tp=1 all params
     # are replicated and grads fully reduced before the update, so
@@ -141,10 +144,26 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             return g
 
         stacked = jax.tree.map(sp_tp_reduce, stacked, specs)
-        local = emulate_node_reduce(stacked, n, use_aps, grad_exp, grad_man)
-        reduced = sum_gradients(local, axis_dp, use_aps=use_aps,
-                                grad_exp=grad_exp, grad_man=grad_man,
-                                use_kahan=use_kahan, mode=mode)
+        # SR keys (grad_rounding='stochastic'): the rank-local emulate key
+        # folds ONLY the dp index — post-psum grads are identical across
+        # sp (and across tp for replicated params), so sp/tp copies must
+        # draw identical bits or their optimizer states would diverge;
+        # dp ranks hold different grads and decorrelate (see
+        # parallel/dist.py on coherent rounding error).
+        gkey = None
+        if grad_rounding == "stochastic":
+            gkey = jax.random.fold_in(jax.random.PRNGKey(grad_seed),
+                                      state.step)
+        local = emulate_node_reduce(
+            stacked, n, use_aps, grad_exp, grad_man,
+            key=None if gkey is None else jax.random.fold_in(
+                jax.random.fold_in(gkey, 0),
+                lax.axis_index(axis_dp).astype(jnp.int32)))
+        reduced = sum_gradients(
+            local, axis_dp, use_aps=use_aps,
+            grad_exp=grad_exp, grad_man=grad_man,
+            use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
+            key=None if gkey is None else jax.random.fold_in(gkey, 1))
 
         updates, new_opt = tx.update(reduced, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
     # the reference-parity precision flags
     p.add_argument("--grad_exp", default=8, type=int)
     p.add_argument("--grad_man", default=23, type=int)
+    p.add_argument("--grad-rounding", default="nearest",
+                   choices=["nearest", "stochastic"],
+                   help="rounding of the gradient-pipeline casts; "
+                        "stochastic = unbiased SR (dp path only)")
+    p.add_argument("--grad-seed", default=0, type=int)
     p.add_argument("--use_APS", action="store_true")
     p.add_argument("--use_kahan", action="store_true")
     p.add_argument("--emulate_node", default=1, type=int)
@@ -173,6 +178,10 @@ def main(argv=None) -> dict:
     if (args.pp > 1 or args.moe) and args.sample > 0:
         raise ValueError("--sample needs the default dp/sp/tp path "
                          "(pp/moe modules have no decode mode)")
+    if (args.pp > 1 or args.moe) and args.grad_rounding != "nearest":
+        raise ValueError("--grad-rounding stochastic is only supported on "
+                         "the default dp/sp/tp path (pp/moe steppers do "
+                         "not thread SR keys)")
     if (args.pp > 1 or args.moe) and (args.remat or args.scan_layers
                                       or args.n_kv_heads is not None
                                       or args.label_smoothing
@@ -296,6 +305,8 @@ def main(argv=None) -> dict:
         step = make_lm_train_step(model, tx, mesh,
                                   emulate_node=args.emulate_node,
                                   label_smoothing=args.label_smoothing,
+                                  grad_rounding=args.grad_rounding,
+                                  grad_seed=args.grad_seed,
                                   **quant_kw)
         eval_step = make_lm_eval_step(model, mesh)
         specs_fn = lm_state_specs
