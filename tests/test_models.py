@@ -59,6 +59,7 @@ def test_davidnet_logit_scale():
     assert jnp.allclose(out2, out1 * 2, rtol=1e-5)
 
 
+@pytest.mark.slow  # full ResNet-50 compile (~24s); CLI smoke also covers it
 def test_resnet50_shapes_and_params():
     model = resnet50()
     x = jnp.zeros((1, 32, 32, 3))  # small spatial for CPU test speed
@@ -111,6 +112,7 @@ def test_fcn_r50_d8_output_stride_and_head():
     assert out.shape == (1, 65, 65, 19)  # upsampled back to input size
 
 
+@pytest.mark.slow  # second full-FCN compile; stride test keeps fast coverage
 def test_fcn_aux_head_taps_stage3():
     """Aux head: distinct logits from the main head, gradients reaching
     stage-3 (and NOT stage-4) backbone params — mmseg fcn_r50-d8 attaches

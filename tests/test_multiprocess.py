@@ -14,6 +14,7 @@ virtual devices — process boundaries must be semantically invisible.
 """
 
 import os
+import pytest
 import socket
 import subprocess
 import sys
@@ -54,6 +55,7 @@ def _single_process_want():
     return {**want, **_train_step_phase(mesh, 0, 4)}
 
 
+@pytest.mark.slow  # two cold-start worker processes, ~50s
 def test_two_process_faithful_reduce_bit_identical(tmp_path):
     want = _single_process_want()
 

@@ -52,6 +52,7 @@ def test_sr_reduction_recovers_flushed_mass():
     assert 1.1 < float(sr.mean()) < 1.4, sr.mean()
 
 
+@pytest.mark.slow  # four shard_map compiles (2 modes x 2 keys)
 def test_sum_gradients_sr_collective():
     mesh = data_parallel_mesh()
     W = mesh.devices.size
@@ -113,6 +114,7 @@ def test_emulate_node_sr_deterministic():
                                   np.asarray(tree["w"][0]))
 
 
+@pytest.mark.slow  # two full train-step compiles on the 8-device mesh
 class TestTrainStepGradRounding:
     def _step(self, grad_rounding, grad_man=3, seed=0):
         from cpd_tpu.models.tiny import tiny_cnn
