@@ -13,7 +13,8 @@ axon tunnel):
   3. full train step, fp32 grads — +backward +SGD
   4. train step, APS e5m2 fast   — +quantize/psum pipeline
   5. train step, APS e5m2 faithful — +gather+ordered-scan collective
-  6. LM KV-cache decode (--no-decode to skip) — generation tok/s
+  6. train step, faithful + SR   — +per-element threefry bits per cast
+  7. LM KV-cache decode (--no-decode to skip) — generation tok/s
 
 Prints one line per phase; the deltas localize any slowdown.
 """
@@ -122,7 +123,7 @@ def main() -> int:
     print(f"fwd-only: best {batch/best:.1f} img/s ({best*1e3:.1f} ms), "
           f"median {batch/med:.1f}", flush=True)
 
-    # 3-5. train-step variants
+    # 3-6. train-step variants
     variants = [
         ("step fp32-grads", dict(use_aps=False, grad_exp=8, grad_man=23,
                                  mode="fast")),
@@ -130,6 +131,11 @@ def main() -> int:
                                     mode="fast")),
         ("step APS e5m2 faithful", dict(use_aps=True, grad_exp=5,
                                         grad_man=2, mode="faithful")),
+        # SR overhead: per-element threefry bits for every pipeline cast —
+        # the delta vs the faithful RTNE row prices grad_rounding on-chip
+        ("step APS e5m2 faithful SR", dict(use_aps=True, grad_exp=5,
+                                           grad_man=2, mode="faithful",
+                                           grad_rounding="stochastic")),
     ]
     for name, kw in variants:
         step = make_train_step(model, tx, mesh, donate=False, **kw)
@@ -153,7 +159,7 @@ def main() -> int:
                     sync_scalar(one_step())
             print(f"trace -> {args.profile_dir}", flush=True)
 
-    # --- 6. LM KV-cache decode throughput ---
+    # --- 7. LM KV-cache decode throughput ---
     if not args.no_decode:
         from cpd_tpu.models import generate, transformer_lm
 
