@@ -86,9 +86,10 @@ def quantizer(forward_exp: int = 8, forward_man: int = 23,
     return _round
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
 def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
-               mode: str = "faithful") -> jnp.ndarray:
+               mode: str = "faithful", rounding: str = "nearest",
+               key=None) -> jnp.ndarray:
     """GEMM ``a @ b`` with an eXmY accumulator.
 
     a: (M, K), b: (K, N) — reference quant_function.py:78-98.  The faithful
@@ -108,9 +109,24 @@ def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
     block when M % 16 != 0, float_kernel.cu:113,298) is UB, not semantics —
     we use a zero-initialized residual everywhere, which is what the main
     path does (float_kernel.cu:120).
+
+    rounding="stochastic" (beyond-reference, requires `key`) replaces
+    every cast — the five per-K-step faithful intermediates, or the fast
+    mode's output cast — with the unbiased SR cast (one independent
+    bitstream per (k, site)): the accumulator analog of the SR gradient
+    pipeline, for emulating stochastic-rounding accumulators.
     """
     if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
         raise ValueError(f"quant_gemm expects (M,K)x(K,N); got {a.shape} x {b.shape}")
+    if rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"unknown rounding mode: {rounding!r}")
+    if rounding == "stochastic" and key is None:
+        raise ValueError("rounding='stochastic' requires a PRNG key")
+    if rounding == "nearest" and key is not None:
+        raise ValueError("a PRNG key was passed but rounding='nearest' "
+                         "would ignore it; did you mean "
+                         "rounding='stochastic'?")
+    sr = rounding == "stochastic"
     a = jnp.asarray(a, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
 
@@ -121,6 +137,8 @@ def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
                       preferred_element_type=jnp.float32)
         if exp == 8 and man == 23:
             return out
+        if sr:
+            return cast_to_format_sr(out, exp, man, key)
         return cast_to_format(out, exp, man)
     if mode != "faithful":
         raise ValueError(f"unknown quant_gemm mode: {mode!r}")
@@ -130,19 +148,27 @@ def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
     # still flushes fp32-subnormal intermediates, so bit-parity requires
     # the full scan.  Use mode="fast" when emulation is not needed.
 
-    q = lambda t: cast_to_format(t, exp, man)
-    M, _ = a.shape
+    M, K = a.shape
     N = b.shape[1]
 
     def step(carry, ab_k):
         s, c = carry
-        a_k, b_k = ab_k  # (M,), (N,)
-        tmp = q(a_k[:, None] * b_k[None, :])
-        y = q(tmp - c)
-        t = q(s + y)
-        c = q(q(t - s) - y)
+        a_k, b_k, i = ab_k  # (M,), (N,), scalar k index
+        if sr:
+            kk = jax.random.fold_in(key, i)  # one hash per K step
+
+            def q(t, site):
+                return cast_to_format_sr(t, exp, man,
+                                         jax.random.fold_in(kk, site))
+        else:
+            def q(t, site):
+                return cast_to_format(t, exp, man)
+        tmp = q(a_k[:, None] * b_k[None, :], 0)
+        y = q(tmp - c, 1)
+        t = q(s + y, 2)
+        c = q(q(t - s, 3) - y, 4)
         return (t, c), None
 
     init = (jnp.zeros((M, N), jnp.float32), jnp.zeros((M, N), jnp.float32))
-    (s, _), _ = lax.scan(step, init, (a.T, b))
+    (s, _), _ = lax.scan(step, init, (a.T, b, jnp.arange(K)))
     return s

@@ -201,3 +201,59 @@ class TestQuantSGDStochastic:
         s_sr = quant_sgd(lambda _: 0.1, exp=4, man=3,
                          rounding="stochastic").init(params)
         assert not isinstance(s_sr.key, tuple)
+
+
+class TestQuantGemmStochastic:
+    def test_sr_gemm_deterministic_and_key_sensitive(self):
+        from cpd_tpu.quant.quant_function import quant_gemm
+        rng = np.random.default_rng(4)
+        a = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+        k = jax.random.PRNGKey(2)
+        x = quant_gemm(a, b, man=3, exp=4, rounding="stochastic", key=k)
+        y = quant_gemm(a, b, man=3, exp=4, rounding="stochastic", key=k)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        z = quant_gemm(a, b, man=3, exp=4, rounding="stochastic",
+                       key=jax.random.PRNGKey(3))
+        assert np.any(np.asarray(x) != np.asarray(z))
+        # fast mode takes the same knobs
+        f = quant_gemm(a, b, man=3, exp=4, mode="fast",
+                       rounding="stochastic", key=k)
+        assert np.isfinite(np.asarray(f)).all()
+
+    def test_sr_gemm_unbiased_around_exact(self):
+        """The faithful loop is Kahan-compensated, so RTNE does NOT
+        stagnate on sub-ulp contributions (that is the Kahan recipe's
+        whole point — float_kernel.cu:181-195); the SR variant's claim is
+        different: each column's accumulation is a random walk whose mean
+        over many independent columns sits near the exact fp32 dot."""
+        from cpd_tpu.quant.quant_function import quant_gemm
+        ulp = 2.0 ** -3  # e4m3 at 1.0
+        # exact = 1 + 10*(ulp/8) = 1.15625, strictly between the e4m3
+        # neighbors 1.125 and 1.25
+        col = np.concatenate([[1.0], np.full(10, ulp / 8)]).astype(np.float32)
+        a = jnp.asarray(col[None, :])          # (1, 11)
+        b = jnp.ones((11, 512), jnp.float32)   # 512 independent columns
+        exact = 1.15625
+        sr = np.asarray(quant_gemm(a, b, man=3, exp=4,
+                                   rounding="stochastic",
+                                   key=jax.random.PRNGKey(0)))
+        assert sr.shape == (1, 512)
+        assert abs(float(sr.mean()) - exact) < 0.05, sr.mean()
+        # every output is a representable e4m3 value (fixed point of RTNE)
+        np.testing.assert_array_equal(
+            np.asarray(cast_to_format(jnp.asarray(sr), 4, 3)), sr)
+
+    def test_sr_gemm_requires_key(self):
+        from cpd_tpu.quant.quant_function import quant_gemm
+        a = jnp.ones((2, 3)); b = jnp.ones((3, 2))
+        with pytest.raises(ValueError):
+            quant_gemm(a, b, man=3, exp=4, rounding="stochastic")
+        with pytest.raises(ValueError):
+            quant_gemm(a, b, man=3, exp=4, rounding="floor")
+
+    def test_gemm_key_with_nearest_rejected(self):
+        from cpd_tpu.quant.quant_function import quant_gemm
+        a = jnp.ones((2, 3)); b = jnp.ones((3, 2))
+        with pytest.raises(ValueError, match="ignore"):
+            quant_gemm(a, b, man=3, exp=4, key=jax.random.PRNGKey(0))
