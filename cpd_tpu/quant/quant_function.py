@@ -32,6 +32,21 @@ from .numerics import cast_to_format, cast_to_format_sr
 __all__ = ["float_quantize", "quantizer", "quant_gemm"]
 
 
+def _validate_rounding(rounding: str, key) -> bool:
+    """Shared rounding/key argument contract; returns True for SR."""
+    if rounding == "nearest":
+        if key is not None:
+            raise ValueError("a PRNG key was passed but rounding='nearest' "
+                             "would ignore it; did you mean "
+                             "rounding='stochastic'?")
+        return False
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("rounding='stochastic' requires a PRNG key")
+        return True
+    raise ValueError(f"unknown rounding mode: {rounding!r}")
+
+
 def float_quantize(x: jnp.ndarray, exp: int, man: int,
                    rounding: str = "nearest", key=None) -> jnp.ndarray:
     """Quantize an FP32 array into the eXmY format.
@@ -49,17 +64,9 @@ def float_quantize(x: jnp.ndarray, exp: int, man: int,
       for low-precision weight updates (avoids update stagnation when
       |update| < ulp/2).  All non-rounding semantics are identical.
     """
-    if rounding == "nearest":
-        if key is not None:
-            raise ValueError("a PRNG key was passed but rounding='nearest' "
-                             "would ignore it; did you mean "
-                             "rounding='stochastic'?")
-        return cast_to_format(x, exp, man)
-    if rounding == "stochastic":
-        if key is None:
-            raise ValueError("rounding='stochastic' requires a PRNG key")
+    if _validate_rounding(rounding, key):
         return cast_to_format_sr(x, exp, man, key)
-    raise ValueError(f"unknown rounding mode: {rounding!r}")
+    return cast_to_format(x, exp, man)
 
 
 def quantizer(forward_exp: int = 8, forward_man: int = 23,
@@ -118,15 +125,7 @@ def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
     """
     if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
         raise ValueError(f"quant_gemm expects (M,K)x(K,N); got {a.shape} x {b.shape}")
-    if rounding not in ("nearest", "stochastic"):
-        raise ValueError(f"unknown rounding mode: {rounding!r}")
-    if rounding == "stochastic" and key is None:
-        raise ValueError("rounding='stochastic' requires a PRNG key")
-    if rounding == "nearest" and key is not None:
-        raise ValueError("a PRNG key was passed but rounding='nearest' "
-                         "would ignore it; did you mean "
-                         "rounding='stochastic'?")
-    sr = rounding == "stochastic"
+    sr = _validate_rounding(rounding, key)
     a = jnp.asarray(a, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
 

@@ -32,33 +32,60 @@ __all__ = ["Quantizer", "QuantLinear", "QuantConv", "QuantDense",
            "quant_linear_fn"]
 
 
+def _site_key(key_data, site: int):
+    """Rebuild a PRNG key from raw uint32 key data and fold in a cast-site
+    index (0=fwd gemm, 1=grad_x gemm, 2=grad_w gemm, 3=grad_b cast)."""
+    return jax.random.fold_in(jax.random.wrap_key_data(key_data), site)
+
+
+def _gemm(a, b, exp, man, mode, key_data, site):
+    if key_data is None:
+        return quant_gemm(a, b, man=man, exp=exp, mode=mode)
+    return quant_gemm(a, b, man=man, exp=exp, mode=mode,
+                      rounding="stochastic", key=_site_key(key_data, site))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def quant_linear_fn(x: jnp.ndarray, weight: jnp.ndarray,
                     bias: Optional[jnp.ndarray], exp: int, man: int,
-                    mode: str = "faithful") -> jnp.ndarray:
+                    mode: str = "faithful", key_data=None) -> jnp.ndarray:
     """y = x @ W^T + b with eXmY-accumulator GEMMs, reference backward recipe.
 
     x: (M, in), weight: (out, in), bias: (out,) or None.
     Forward: quant_gemm(x, W^T) + b      (quant_module.py:30-33)
     Backward: grad_x = quant_gemm(g, W); grad_W = quant_gemm(g^T, x);
               grad_b = float_quantize(g.sum(0))   (quant_module.py:36-52)
+
+    `key_data` (beyond-reference): raw uint32 PRNG key data
+    (`jax.random.key_data`); when given, every GEMM accumulator cast and
+    the bias-grad cast use stochastic rounding, one independent subkey per
+    site.  Passed as key DATA (a traced non-float array, cotangent None)
+    rather than a typed key so it can ride the custom_vjp as a regular
+    argument.
     """
-    out = quant_gemm(x, weight.T, man=man, exp=exp, mode=mode)
+    out = _gemm(x, weight.T, exp, man, mode, key_data, 0)
     if bias is not None:
         out = out + bias[None, :]
     return out
 
 
-def _qlin_fwd(x, weight, bias, exp, man, mode):
-    return quant_linear_fn(x, weight, bias, exp, man, mode), (x, weight, bias)
+def _qlin_fwd(x, weight, bias, exp, man, mode, key_data=None):
+    return (quant_linear_fn(x, weight, bias, exp, man, mode, key_data),
+            (x, weight, bias, key_data))
 
 
 def _qlin_bwd(exp, man, mode, res, g):
-    x, weight, bias = res
-    grad_x = quant_gemm(g, weight, man=man, exp=exp, mode=mode)
-    grad_w = quant_gemm(g.T, x, man=man, exp=exp, mode=mode)
-    grad_b = None if bias is None else float_quantize(g.sum(0), exp, man)
-    return grad_x, grad_w, grad_b
+    x, weight, bias, key_data = res
+    grad_x = _gemm(g, weight, exp, man, mode, key_data, 1)
+    grad_w = _gemm(g.T, x, exp, man, mode, key_data, 2)
+    if bias is None:
+        grad_b = None
+    elif key_data is None:
+        grad_b = float_quantize(g.sum(0), exp, man)
+    else:
+        grad_b = float_quantize(g.sum(0), exp, man, rounding="stochastic",
+                                key=_site_key(key_data, 3))
+    return grad_x, grad_w, grad_b, None  # no cotangent for the key data
 
 
 quant_linear_fn.defvjp(_qlin_fwd, _qlin_bwd)
@@ -70,6 +97,18 @@ def _kaiming_uniform(key, shape, fan_in, dtype=jnp.float32):
     # (quant_module.py:71,109)
     bound = 1.0 / math.sqrt(fan_in)
     return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+
+def _rng_key_data(module: nn.Module, rounding: str):
+    """None for RTNE; raw key data from the module's 'sr' rng stream for
+    stochastic rounding (callers supply rngs={'sr': key} to init/apply —
+    flax raises a loud InvalidRngError otherwise)."""
+    if rounding == "nearest":
+        return None
+    if rounding != "stochastic":
+        raise ValueError(f"unknown rounding mode: {rounding!r}")
+    return jax.random.key_data(module.make_rng("sr"))
 
 
 class Quantizer(nn.Module):
@@ -93,6 +132,7 @@ class QuantLinear(nn.Module):
     exp: int = 8
     man: int = 23
     mode: str = "faithful"
+    rounding: str = "nearest"
 
     @nn.compact
     def __call__(self, x):
@@ -108,7 +148,8 @@ class QuantLinear(nn.Module):
                 (self.out_features,))
         squeeze = x.ndim == 1
         x2 = x[None, :] if squeeze else x.reshape(-1, x.shape[-1])
-        y = quant_linear_fn(x2, weight, bias, self.exp, self.man, self.mode)
+        y = quant_linear_fn(x2, weight, bias, self.exp, self.man, self.mode,
+                            _rng_key_data(self, self.rounding))
         y = y.reshape(*x.shape[:-1], self.out_features) if not squeeze else y[0]
         return y
 
@@ -132,6 +173,7 @@ class QuantDense(nn.Module):
     exp: int = 8
     man: int = 23
     mode: str = "faithful"
+    rounding: str = "nearest"
     param_dtype: Any = jnp.float32
 
     @nn.compact
@@ -143,7 +185,8 @@ class QuantDense(nn.Module):
                 if self.use_bias else None)
         x2 = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
         y = quant_linear_fn(x2, kernel.astype(jnp.float32).T, bias,
-                            self.exp, self.man, self.mode)
+                            self.exp, self.man, self.mode,
+                            _rng_key_data(self, self.rounding))
         return y.reshape(*x.shape[:-1], self.features)
 
 
@@ -169,6 +212,7 @@ class QuantConv(nn.Module):
     exp: int = 8
     man: int = 23
     mode: str = "faithful"
+    rounding: str = "nearest"
 
     @nn.compact
     def __call__(self, x):
@@ -214,13 +258,16 @@ class QuantConv(nn.Module):
                                                             c * k * k)
         # per-group GEMM over the group's contiguous im2col columns (the
         # feature dim is channel-major, so group channels are adjacent)
+        kd = _rng_key_data(self, self.rounding)
         outs = []
         for gi in range(g):
             cols = patches[:, gi * c_g * k * k:(gi + 1) * c_g * k * k]
             w2 = weight[gi * o_g:(gi + 1) * o_g].reshape(o_g, c_g * k * k)
             b2 = None if bias is None else bias[gi * o_g:(gi + 1) * o_g]
+            kd_g = (None if kd is None
+                    else jax.random.key_data(_site_key(kd, gi)))
             outs.append(quant_linear_fn(cols, w2, b2, self.exp, self.man,
-                                        self.mode))
+                                        self.mode, kd_g))
         y = outs[0] if g == 1 else jnp.concatenate(outs, axis=-1)
         y = y.reshape(b, out_h * out_w, self.out_channels)
         y = jnp.transpose(y, (0, 2, 1))

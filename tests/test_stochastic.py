@@ -257,3 +257,60 @@ class TestQuantGemmStochastic:
         a = jnp.ones((2, 3)); b = jnp.ones((3, 2))
         with pytest.raises(ValueError, match="ignore"):
             quant_gemm(a, b, man=3, exp=4, key=jax.random.PRNGKey(0))
+
+
+class TestQuantModulesStochastic:
+    def test_quant_dense_sr_forward_and_grads(self):
+        from cpd_tpu.quant.quant_module import QuantDense
+        m = QuantDense(features=5, exp=4, man=3, rounding="stochastic")
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 7)),
+                        jnp.float32)
+        init_rngs = {"params": jax.random.PRNGKey(0),
+                     "sr": jax.random.PRNGKey(1)}
+        variables = m.init(init_rngs, x)
+        apply = lambda v, xx, k: m.apply(v, xx, rngs={"sr": k})
+        y1 = apply(variables, x, jax.random.PRNGKey(2))
+        y2 = apply(variables, x, jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        y3 = apply(variables, x, jax.random.PRNGKey(3))
+        assert np.any(np.asarray(y1) != np.asarray(y3))
+
+        def loss(v):
+            return apply(v, x, jax.random.PRNGKey(2)).sum()
+        g = jax.grad(loss)(variables)
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(g))
+
+    def test_quant_conv_sr_groups(self):
+        from cpd_tpu.quant.quant_module import QuantConv
+        m = QuantConv(in_channels=4, out_channels=4, kernel_size=3,
+                      padding=1, groups=2, exp=4, man=3,
+                      rounding="stochastic")
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 4, 8, 8)),
+                        jnp.float32)
+        v = m.init({"params": jax.random.PRNGKey(0),
+                    "sr": jax.random.PRNGKey(1)}, x)
+        y1 = m.apply(v, x, rngs={"sr": jax.random.PRNGKey(2)})
+        y2 = m.apply(v, x, rngs={"sr": jax.random.PRNGKey(2)})
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        assert y1.shape == (2, 4, 8, 8)
+
+    def test_missing_sr_rng_raises(self):
+        import flax.errors
+        from cpd_tpu.quant.quant_module import QuantDense
+        m = QuantDense(features=2, exp=4, man=3, rounding="stochastic")
+        x = jnp.ones((1, 3), jnp.float32)
+        v = m.init({"params": jax.random.PRNGKey(0),
+                    "sr": jax.random.PRNGKey(1)}, x)
+        with pytest.raises(flax.errors.InvalidRngError):
+            m.apply(v, x)  # no 'sr' stream supplied
+
+    def test_nearest_default_bitwise_unchanged(self):
+        from cpd_tpu.quant.quant_module import QuantDense
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(3, 7)),
+                        jnp.float32)
+        a = QuantDense(features=5, exp=4, man=3)
+        b = QuantDense(features=5, exp=4, man=3, rounding="nearest")
+        va = a.init(jax.random.PRNGKey(0), x)
+        np.testing.assert_array_equal(np.asarray(a.apply(va, x)),
+                                      np.asarray(b.apply(va, x)))
