@@ -1,6 +1,6 @@
 from .numerics import (cast_to_format, cast_to_format_sr, cast_oracle,
                        cast_oracle_sr, max_finite)
-from .quant_function import float_quantize, quantizer, quant_gemm
+from .quant_function import float_quantize, quantizer, quantizer_sr, quant_gemm
 from .quant_module import Quantizer, QuantDense, QuantLinear, QuantConv
 
 __all__ = [
@@ -11,6 +11,7 @@ __all__ = [
     "max_finite",
     "float_quantize",
     "quantizer",
+    "quantizer_sr",
     "quant_gemm",
     "Quantizer",
     "QuantDense",

@@ -29,7 +29,14 @@ from jax import lax
 
 from .numerics import cast_to_format, cast_to_format_sr
 
-__all__ = ["float_quantize", "quantizer", "quant_gemm"]
+__all__ = ["float_quantize", "quantizer", "quantizer_sr", "quant_gemm"]
+
+
+def _site_key(key_data, site: int):
+    """Rebuild a PRNG key from raw uint32 key data and fold in a cast-site
+    index — the one shared key-derivation recipe for every custom_vjp
+    SR consumer (quantizer_sr here; quant_linear_fn in quant_module)."""
+    return jax.random.fold_in(jax.random.wrap_key_data(key_data), site)
 
 
 def _validate_rounding(rounding: str, key) -> bool:
@@ -88,6 +95,34 @@ def quantizer(forward_exp: int = 8, forward_man: int = 23,
         if backward_exp == 8 and backward_man == 23:
             return (g,)
         return (cast_to_format(g, backward_exp, backward_man),)
+
+    _round.defvjp(_round_fwd, _round_bwd)
+    return _round
+
+
+def quantizer_sr(forward_exp: int = 8, forward_man: int = 23,
+                 backward_exp: int = 8, backward_man: int = 23):
+    """Stochastic-rounding `quantizer` (beyond-reference): returns
+    ``fn(x, key_data)`` where `key_data` is raw uint32 PRNG key data
+    (`jax.random.key_data`) — activations SR-cast on forward (site 0),
+    cotangents on backward (site 1), independent subkeys.  The (8, 23)
+    shortcuts match `quantizer` (SR at fp32 is the identity anyway)."""
+
+    @jax.custom_vjp
+    def _round(x, key_data):
+        if forward_exp == 8 and forward_man == 23:
+            return x
+        return cast_to_format_sr(x, forward_exp, forward_man,
+                                 _site_key(key_data, 0))
+
+    def _round_fwd(x, key_data):
+        return _round(x, key_data), key_data
+
+    def _round_bwd(key_data, g):
+        if backward_exp == 8 and backward_man == 23:
+            return (g, None)
+        return (cast_to_format_sr(g, backward_exp, backward_man,
+                                  _site_key(key_data, 1)), None)
 
     _round.defvjp(_round_fwd, _round_bwd)
     return _round

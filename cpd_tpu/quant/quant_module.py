@@ -26,16 +26,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .quant_function import float_quantize, quant_gemm, quantizer
+from .quant_function import (_site_key, float_quantize,
+                             quant_gemm, quantizer, quantizer_sr)
 
 __all__ = ["Quantizer", "QuantLinear", "QuantConv", "QuantDense",
            "quant_linear_fn"]
-
-
-def _site_key(key_data, site: int):
-    """Rebuild a PRNG key from raw uint32 key data and fold in a cast-site
-    index (0=fwd gemm, 1=grad_x gemm, 2=grad_w gemm, 3=grad_b cast)."""
-    return jax.random.fold_in(jax.random.wrap_key_data(key_data), site)
 
 
 def _gemm(a, b, exp, man, mode, key_data, site):
@@ -112,16 +107,24 @@ def _rng_key_data(module: nn.Module, rounding: str):
 
 
 class Quantizer(nn.Module):
-    """Activation quantizer module (quant_module.py:13-20)."""
+    """Activation quantizer module (quant_module.py:13-20).
+
+    rounding='stochastic' uses `quantizer_sr` with a key from the 'sr'
+    rng stream: activations SR-cast forward, cotangents backward."""
     forward_exp: int = 8
     forward_man: int = 23
     backward_exp: int = 8
     backward_man: int = 23
+    rounding: str = "nearest"
 
     @nn.compact
     def __call__(self, x):
-        return quantizer(self.forward_exp, self.forward_man,
-                         self.backward_exp, self.backward_man)(x)
+        key_data = _rng_key_data(self, self.rounding)
+        if key_data is None:
+            return quantizer(self.forward_exp, self.forward_man,
+                             self.backward_exp, self.backward_man)(x)
+        return quantizer_sr(self.forward_exp, self.forward_man,
+                            self.backward_exp, self.backward_man)(x, key_data)
 
 
 class QuantLinear(nn.Module):
