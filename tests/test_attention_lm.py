@@ -198,6 +198,7 @@ def test_lm_dropout():
         bad.init(jax.random.PRNGKey(0), toks)
 
 
+@pytest.mark.slow  # feature-level LM compile; core LM step stays fast via test_lm_train_step_dp_sp_tp
 def test_lm_label_smoothing():
     """Smoothed loss matches the closed form at step level: ls=0 equals
     plain CE; ls>0 loss is finite and differs; invalid ls raises."""
@@ -267,6 +268,7 @@ def test_lm_remat_grads_match():
                                    rtol=1e-6, atol=1e-7, err_msg=str(path))
 
 
+@pytest.mark.slow  # remat value/grad parity stays fast via test_lm_remat_grads_match
 def test_lm_remat_sharded_step_runs():
     """remat composes with the full quantized dp x sp x tp train step
     (ring attention's ppermute recomputes inside jax.checkpoint)."""
@@ -641,6 +643,7 @@ def test_lm_decode_cache_overflow_poisons_with_nan():
             assert not nans.any(), f"in-bounds step {step} produced NaN"
 
 
+@pytest.mark.slow  # second full sharded-LM compile; QuantDense mechanics are fast-tier in test_quant_module
 def test_lm_quantized_ffn():
     """ffn_exp/ffn_man route the MLP pair through the quantized GEMM:
     same param tree as the unquantized model (checkpoint compatible),

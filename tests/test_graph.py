@@ -120,6 +120,7 @@ def test_graph_davidnet_bf16_head_stays_fp32():
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow  # graph-executor semantics covered by the other fast graph tests
 def test_graph_losses_in_cache():
     model = graph_davidnet(with_losses=True)
     x = jnp.zeros((2, 32, 32, 3), jnp.float32)

@@ -256,6 +256,7 @@ def test_pipeline_schedule_length_is_m_plus_p_minus_1(pp, m):
     assert bubble_fraction(m, pp) == (pp - 1) / want
 
 
+@pytest.mark.slow  # pipeline fwd/step parity covered by the remaining fast tests
 def test_pipeline_remat_stages_is_value_neutral():
     """remat_stages recomputes stage internals in the backward; values and
     gradients must be bitwise unchanged."""

@@ -330,11 +330,20 @@ class TestQuantizerSR:
         fin = np.isfinite(np.asarray(y1))
         np.testing.assert_array_equal(
             np.asarray(cast_to_format(y1, 4, 3))[fin], np.asarray(y1)[fin])
-        # backward: cotangents SR-cast with an independent subkey
+        # backward: the cotangent (= x for this loss) is SR-cast with an
+        # independent subkey — representable, genuinely stochastic (not a
+        # silent RTNE fallback), key-dependent, and decorrelated from the
+        # forward cast of the same values (site 1 vs site 0)
         g = jax.grad(lambda xx: (q(xx, kd) * x).sum())(x)
         gf = np.asarray(g)[np.isfinite(np.asarray(g))]
         np.testing.assert_array_equal(
             np.asarray(cast_to_format(jnp.asarray(gf), 4, 3)), gf)
+        rtne = np.asarray(cast_to_format(x, 4, 3))
+        fin = np.isfinite(np.asarray(g))
+        assert np.any(np.asarray(g)[fin] != rtne[fin])
+        g2 = jax.grad(lambda xx: (q(xx, kd2) * x).sum())(x)
+        assert np.any(np.asarray(g)[fin] != np.asarray(g2)[fin])
+        assert np.any(np.asarray(g)[fin] != np.asarray(y1)[fin])
 
     def test_fp32_shortcuts_identity(self):
         from cpd_tpu.quant.quant_function import quantizer_sr

@@ -74,6 +74,7 @@ def test_scalar_writer_jsonl_roundtrip(tmp_path):
     assert not (tmp_path / "nope").exists()
 
 
+@pytest.mark.slow  # tensorboard IO; the JSONL logging contract is fast-tier
 def test_scalar_writer_tensorboard_events(tmp_path):
     """tensorboard=True mirrors scalars into event files (mix.py:168-171).
 
