@@ -3,33 +3,47 @@
 #
 # The dev tunnel flaps on multi-hour scales (docs/PERF.md); when it
 # answers, this captures everything the round needs in one pass, each
-# stage under its own watchdog so a mid-run drop cannot wedge the shell.
-# Stages (each skipped-with-note if its budget is hit):
-#   1. liveness probe        (90 s)  — jax.devices() through the tunnel
+# stage under a SIGKILL-backed watchdog (`timeout -k`: the axon runtime
+# can wedge in native code where SIGTERM is never honored — same finding
+# bench.py documents).  All output is tee'd to a timestamped log so a
+# dropped terminal cannot lose captured evidence.  Stages:
+#   1. liveness probe        (90 s)  — device must actually BE a TPU
+#                                      (axon init failure silently falls
+#                                      back to CPU; that is "down")
 #   2. Pallas hardware check (300 s) — quantize/qgemm bitwise, SR kernel,
 #                                      flash attention (tools/pallas_check.py)
 #   3. headline bench        (900 s) — bench.py with salvage + last-good
 #                                      persistence (BENCH_BUDGET_SECS=840)
 #   4. perf probe            (560 s) — tools/tpu_probe.py incl. the SR
 #                                      phase (skip with NO_PROBE=1)
-# Results land in .bench_last_good.json (committed provenance) and
-# stdout; commit refreshed artifacts + update docs/ROUND3.md after.
+# Results land in .bench_last_good.json (committed provenance) and the
+# log; commit refreshed artifacts + update docs/ROUND3.md after.
 set -u
 cd "$(dirname "$0")/.."
 
+LOG="tools/recapture_$(date +%Y%m%d_%H%M%S).log"
+exec > >(tee "$LOG") 2>&1
+echo "== logging to $LOG"
+
 echo "== 1/4 tunnel probe"
-if ! timeout 90 python -c "import jax; print(jax.devices())"; then
-    echo "tunnel down (probe hung/failed) — nothing captured"; exit 1
+if ! timeout -k 10 90 python -c "
+import jax
+d = jax.devices()
+print(d)
+assert d[0].platform == 'tpu', f'backend fell back to {d[0].platform}'
+"; then
+    echo "tunnel down (probe hung, failed, or fell back to CPU) — nothing captured"
+    exit 1
 fi
 
 echo "== 2/4 pallas_check"
-timeout 300 python tools/pallas_check.py || echo "pallas_check FAILED/timeout (rc=$?)"
+timeout -k 10 300 python tools/pallas_check.py || echo "pallas_check FAILED/timeout (rc=$?)"
 
 echo "== 3/4 bench"
-BENCH_BUDGET_SECS=840 timeout 900 python bench.py || echo "bench rc=$?"
+BENCH_BUDGET_SECS=840 timeout -k 10 900 python bench.py || echo "bench rc=$?"
 
 if [ "${NO_PROBE:-0}" != "1" ]; then
     echo "== 4/4 tpu_probe"
-    timeout 560 python tools/tpu_probe.py || echo "tpu_probe rc=$?"
+    timeout -k 10 560 python tools/tpu_probe.py || echo "tpu_probe rc=$?"
 fi
-echo "== done; review .bench_last_good.json and commit artifacts"
+echo "== done; review .bench_last_good.json + $LOG and commit artifacts"
