@@ -489,6 +489,12 @@ def main():
                 out["probe_secs"] = probe.get("secs")
                 if out.get("platform") == "tpu":
                     _record_last_good(out)
+                # the child still HUNG (after the flagship measurement) —
+                # the poisoned-cache rationale below applies regardless of
+                # whether we salvaged a value, so the NEXT bench run must
+                # not inherit the wedged entry
+                from cpd_tpu.utils import clear_cache
+                clear_cache()
                 emit(out)
                 return
             last_err = (f"attempt {attempt + 1}: child killed after "

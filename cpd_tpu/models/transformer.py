@@ -102,16 +102,6 @@ class Block(nn.Module):
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
 
-    @staticmethod
-    def _expand_kv(k, v, n_q_heads: int):
-        """Grouped-query expansion: repeat each K/V head over its query
-        group (kv head j serves q heads [j*rep, (j+1)*rep) — consistent
-        under tp column slicing since both head counts divide by tp)."""
-        rep = n_q_heads // k.shape[-2]
-        if rep == 1:
-            return k, v
-        return (jnp.repeat(k, rep, axis=-2), jnp.repeat(v, rep, axis=-2))
-
     def _cached_attention(self, q, k, v, positions):
         """KV-cache attention (decode=True).
 
@@ -213,11 +203,11 @@ class Block(nn.Module):
         if self.decode:
             attn = self._cached_attention(q, k, v, positions)
         elif self.sp_axis:
-            # sequence-parallel paths take head-count-uniform kv: GQA
-            # expands over query groups BEFORE the collective, shipping
-            # rep x copies over ICI — the simplicity trade documented in
-            # ops/attention.py's module docstring
-            k, v = self._expand_kv(k, v, q.shape[-2])
+            # sequence-parallel paths take UNEXPANDED GQA kv: the ring
+            # rotates H_kv-headed blocks and ulysses all_to_alls them
+            # (expanding internally only when H_kv doesn't divide the sp
+            # size) — rep x fewer ICI bytes than expanding first
+            # (ops/attention.py)
             if self.sp_mode == "ulysses":
                 attn = ulysses_attention(q, k, v, self.sp_axis,
                                          causal=True, impl=self.attn_impl)

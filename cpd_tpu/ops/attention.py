@@ -26,11 +26,12 @@ its workloads are CNNs), so this is new capability, built TPU-first:
   the query head axis is reshaped to (H_kv, rep) and contracted against
   the small K/V directly, so neither HBM nor the score computation ever
   materializes the repeated copies — this is what makes the GQA KV-cache
-  memory win real at decode time.  The sequence-parallel paths (ring /
-  ulysses) instead receive kv expanded *before* the collective: shipping
-  rep× copies over ICI is a deliberate simplicity trade (the collectives
-  stay head-count-uniform); push the grouping inside them if GQA at
-  large sp ever becomes the bottleneck.
+  memory win real at decode time.  The sequence-parallel paths carry the
+  SAME unexpanded K/V through their collectives (round 4): the ring
+  rotates (B, T_local, H_kv, D) blocks — rep× fewer ICI bytes than the
+  expanded path, the point of GQA under sp — and ulysses all_to_alls
+  H_kv-headed K/V whenever H_kv divides the axis size, expanding by the
+  minimal factor (worst case to H) only when it does not.
 
 Causality with a sharded sequence: rank r holds tokens
 [r*T_local, (r+1)*T_local); at ring step s it receives the K/V block of
@@ -110,20 +111,38 @@ def _flash_attention(q, k, v, causal, q_offset, k_offset):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def _gqa_rep(q: jnp.ndarray, k: jnp.ndarray) -> int:
+    """Query-heads-per-kv-head factor, validated (1 = MHA)."""
+    h, hkv = q.shape[2], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    return h // hkv
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, causal: bool = True) -> jnp.ndarray:
     """Sequence-parallel attention; call inside shard_map with the sequence
     dim sharded over `axis_name`.
 
-    q, k, v: (B, T_local, H, D) local shards.  Returns (B, T_local, H, D).
-    Differentiable (ppermute transposes to the reverse permute, so the
-    backward pass is itself a ring).
+    q: (B, T_local, H, D); k, v: (B, T_local, H_kv, D) with H_kv | H — GQA
+    K/V ride the ring UNEXPANDED (rep× fewer ppermute bytes; the per-step
+    contraction groups the query heads instead — the same dot products as
+    the expanded ring, agreeing to the last ulp of the fp32 softmax chain;
+    XLA's batched-matmul layout for the grouped einsum differs, so not
+    bitwise).  Returns (B, T_local, H, D).  Differentiable (ppermute
+    transposes to the reverse permute, so the backward pass is itself a
+    ring).
     """
     axis_size = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
-    t_local = q.shape[1]
-    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    b, t_local, h, d = q.shape
+    hkv = k.shape[2]
+    rep = _gqa_rep(q, k)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
     q_off = my * t_local
+    # grouped layout: head index h == g*rep + r, so reshaping (H,) to
+    # (H_kv, rep) keeps kv head g serving q heads [g*rep, (g+1)*rep)
+    qg = q.reshape(b, t_local, hkv, rep, d)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
@@ -132,8 +151,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         src = (my - s) % axis_size           # whose K/V block we hold
         k_off = src * t_local
 
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur,
-                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k_cur,
+            preferred_element_type=jnp.float32).reshape(
+                b, h, t_local, t_local) * scale
         if causal:
             mask = _causal_mask(t_local, t_local, q_off, k_off)
             logits = jnp.where(mask[None, None], logits, _NEG_INF)
@@ -143,8 +164,11 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(logits - m_new[..., None])               # (B,H,Tq,Tk)
         l_new = l * alpha + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur,
-                        preferred_element_type=jnp.float32)
+        pv = jnp.einsum(
+            "bgrqk,bkgd->bqgrd",
+            p.astype(v_cur.dtype).reshape(b, hkv, rep, t_local, t_local),
+            v_cur, preferred_element_type=jnp.float32).reshape(
+                b, t_local, h, v_cur.shape[-1])
         o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
 
         # rotate K/V to the next rank (skip after the last fold: the scan
@@ -215,9 +239,32 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (B, T_local, H, D).  Differentiable: all_to_all transposes to the
     reverse all_to_all.
 
-    ``impl`` is forwarded to `local_attention` for the full-sequence
-    middle step ("flash" = Pallas kernel on the gathered sequence).
+    GQA K/V (H_kv < H heads) go through the all_to_all UNEXPANDED whenever
+    H_kv is divisible by the axis size — rep× fewer ICI bytes — and the
+    full-sequence middle step runs the grouped kernel on each device's
+    contiguous head chunk (chunk w's q heads [w·H/W, (w+1)·H/W) are served
+    exactly by its kv heads [w·H_kv/W, (w+1)·H_kv/W), since H/W =
+    rep·H_kv/W).  When H_kv % W != 0 the K/V are expanded by the MINIMAL
+    factor e (the smallest divisor of rep making H_kv·e % W == 0; worst
+    case e = rep, the fully-expanded legacy behavior).
+
+    ``impl`` is forwarded to the full-sequence middle step ("flash" =
+    Pallas kernel on the gathered sequence; MHA-shaped chunks only).
     """
+    axis_size = lax.psum(1, axis_name)
+    rep = _gqa_rep(q, k)
+    if q.shape[2] % axis_size:
+        raise ValueError(f"ulysses needs q heads {q.shape[2]} divisible "
+                         f"by the {axis_name} axis size {axis_size}")
+    if k.shape[2] % axis_size:
+        # minimal grouping-preserving expansion: kv head j repeated e×
+        # keeps q head h served by expanded head h // (rep/e), which the
+        # contiguous all_to_all chunking preserves iff e | rep
+        e = next(f for f in range(1, rep + 1)
+                 if rep % f == 0 and (k.shape[2] * f) % axis_size == 0)
+        k = jnp.repeat(k, e, axis=2)
+        v = jnp.repeat(v, e, axis=2)
+
     def seq_to_heads(x):
         # (B, T_local, H, D) -> (B, T_global, H/W, D)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -227,6 +274,6 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    out = local_attention(seq_to_heads(q), seq_to_heads(k),
-                          seq_to_heads(v), causal=causal, impl=impl)
+    out = grouped_query_attention(seq_to_heads(q), seq_to_heads(k),
+                                  seq_to_heads(v), causal=causal, impl=impl)
     return heads_to_seq(out)

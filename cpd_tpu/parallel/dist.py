@@ -46,14 +46,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..quant.numerics import cast_to_format, cast_to_format_sr
+from ..quant.numerics import cast_to_format, cast_to_format_sr_at
 from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
                   aps_unscale, pmax_scalar_vector)
 from .reduction import quantized_sum
 
 __all__ = [
     "dist_init", "sum_gradients", "broadcast_from", "replicate",
-    "all_reduce_mean", "host_batch_to_global",
+    "all_reduce_mean", "host_batch_to_global", "quantize_tree_sr",
 ]
 
 
@@ -137,6 +137,37 @@ def _flat_axis_index(axis_name) -> jnp.ndarray:
     return idx
 
 
+def _leaf_starts(tree) -> list[int]:
+    """Static global flat offset of each leaf (tree_flatten order) — the
+    index space the SR bitstream is defined on.  parallel/zero.py flattens
+    the same tree in the same order, so its shard offsets index the same
+    space and reproduce the same bits."""
+    sizes = [l.size for l in jax.tree_util.tree_leaves(tree)]
+    return [0] + list(np.cumsum(sizes[:-1]).astype(np.int64)) if sizes else []
+
+
+def _leaf_offsets(start: int, leaf) -> jnp.ndarray:
+    """Global flat offsets for one leaf, shaped like the leaf."""
+    return (jnp.uint32(start)
+            + jnp.arange(leaf.size, dtype=jnp.uint32)).reshape(leaf.shape)
+
+
+def quantize_tree_sr(tree, grad_exp: int, grad_man: int, key) -> Any:
+    """Per-leaf eXmY cast of a pytree: RTNE when `key` is None, otherwise
+    stochastic rounding with GLOBAL-offset-indexed bits (one bitstream over
+    the concatenated flat layout, so the draw is identical however the
+    tree is later flattened, bucketed, or sharded)."""
+    if key is None:
+        return jax.tree.map(
+            lambda g: cast_to_format(g, grad_exp, grad_man), tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    starts = _leaf_starts(tree)
+    out = [cast_to_format_sr_at(g, grad_exp, grad_man, key,
+                                _leaf_offsets(st, g))
+           for st, g in zip(starts, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def _wire_dtype(grad_exp: int, grad_man: int):
     """Hardware dtype that exactly represents the (exp, man) value set —
     including its infinities — or None.
@@ -179,12 +210,13 @@ def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
     reference's per-parameter loop, dist_util.py:60-89), with W x leaf_count
     collective launches collapsed to W x bucket_count.
 
-    With stochastic rounding (`key` given) the per-element bitstream is
-    drawn per bucket (folded on the bucket's first leaf index), so bucketed
-    and per-leaf results are two different — equally valid — SR draws, NOT
-    bit-identical; each is deterministic given (key, bucket layout).
+    With stochastic rounding (`key` given) the per-element bits are indexed
+    by GLOBAL flat offset (numerics.sr_bits_at), so bucketed and per-leaf
+    reductions draw the SAME bits — bit-identical results, invariant to the
+    bucket layout (and to ZeRO sharding, parallel/zero.py).
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads)
+    starts = _leaf_starts(grads)
     out = [None] * len(leaves)
     # group by dtype, preserving leaf order within a group
     by_dtype: dict = {}
@@ -208,10 +240,11 @@ def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
                     jnp.concatenate([leaves[i].reshape(-1)
                                      for i in bucket]))
             gathered = _gather_leaf(flat, axis_name, wire=wire)
-            bkey = (None if key is None else
-                    jax.random.fold_in(key, bucket[0]))
+            offs = (None if key is None else jnp.concatenate(
+                [_leaf_offsets(starts[i], leaves[i]).ravel()
+                 for i in bucket]))
             red = quantized_sum(gathered, grad_exp, grad_man, use_kahan,
-                                key=bkey)
+                                key=key, offsets=offs)
             off = 0
             for i in bucket:
                 n = leaves[i].size
@@ -250,8 +283,12 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   sub-ulp/2 gradient mass then survives the reduction in
                   expectation, the unbiased alternative to APS's exponent
                   shifting (beyond-reference; composes with it too).
-                  Deterministic given (key, bucket layout); every rank
-                  derives identical bits, so replicated outputs agree.
+                  Per-element bits are indexed by (key, scan step, cast
+                  site, GLOBAL flat offset) — deterministic given key and
+                  invariant to bucketing and to ZeRO reduce-scatter
+                  sharding (parallel/zero.py reproduces these exact bits
+                  on each shard); every rank derives identical bits, so
+                  replicated outputs agree.
     """
     if mode not in ("faithful", "fast"):
         raise ValueError(f"unknown mode {mode!r}")
@@ -261,8 +298,10 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         raise ValueError("rounding='stochastic' requires a PRNG key "
                          "(fold in the step counter for fresh per-step "
                          "bits)")
-    if rounding == "nearest":
-        key = None
+    if rounding == "nearest" and key is not None:
+        raise ValueError("a PRNG key was passed but rounding='nearest' "
+                         "would ignore it; pass rounding='stochastic' "
+                         "(matching float_quantize/quant_gemm's contract)")
     if bucket is None:
         bucket = jax.default_backend() == "tpu"
     world = lax.psum(jnp.float32(1.0), axis_name)
@@ -281,14 +320,7 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         k_pre = jax.random.fold_in(k_pre, _flat_axis_index(axis_name))
 
     def q_tree(t, k):
-        if k is None:
-            return jax.tree.map(
-                lambda g: cast_to_format(g, grad_exp, grad_man), t)
-        leaves, treedef = jax.tree_util.tree_flatten(t)
-        out = [cast_to_format_sr(g, grad_exp, grad_man,
-                                 jax.random.fold_in(k, i))
-               for i, g in enumerate(leaves)]
-        return jax.tree_util.tree_unflatten(treedef, out)
+        return quantize_tree_sr(t, grad_exp, grad_man, k)
 
     shifts = None
     if use_aps:
@@ -321,12 +353,13 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                                               wire=wire, key=k_sum)
         else:
             leaves, treedef = jax.tree_util.tree_flatten(grads)
+            starts = _leaf_starts(grads)
             out = [quantized_sum(
                        _gather_leaf(g, axis_name, wire=wire),
-                       grad_exp, grad_man, use_kahan,
-                       key=(None if k_sum is None
-                            else jax.random.fold_in(k_sum, i)))
-                   for i, g in enumerate(leaves)]
+                       grad_exp, grad_man, use_kahan, key=k_sum,
+                       offsets=(None if k_sum is None
+                                else _leaf_offsets(st, g)))
+                   for st, g in zip(starts, leaves)]
             reduced = jax.tree_util.tree_unflatten(treedef, out)
 
     if use_aps:

@@ -60,16 +60,28 @@ def _reduce_leaf(g: jnp.ndarray, n: int, use_aps: bool,
 
 def emulate_node_reduce(stacked_grads: Any, emulate_node: int,
                         use_aps: bool = False, grad_exp: int = 5,
-                        grad_man: int = 2, key=None) -> Any:
+                        grad_man: int = 2, key=None,
+                        rounding: str = "nearest") -> Any:
     """Locally reduce N stacked micro-batch gradients per leaf.
 
     stacked_grads: pytree with leaves shaped (emulate_node, *param_shape).
     Returns the locally-accumulated gradient pytree (leaf shape
     (*param_shape,)), ready for the cross-device `sum_gradients`.
 
-    `key` (beyond-reference) switches every cast — the local pre-quantize
-    and each ordered-accumulation step — to unbiased stochastic rounding,
-    one independent bitstream per leaf."""
+    rounding='stochastic' with `key` (beyond-reference) switches every
+    cast — the local pre-quantize and each ordered-accumulation step — to
+    unbiased stochastic rounding, one independent bitstream per leaf.
+    The key/rounding contract matches `sum_gradients`: a key with
+    'nearest' raises (it would be silently ignored), 'stochastic' without
+    a key raises."""
+    if rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"unknown rounding {rounding!r}")
+    if rounding == "stochastic" and key is None:
+        raise ValueError("rounding='stochastic' requires a PRNG key")
+    if rounding == "nearest" and key is not None:
+        raise ValueError("a PRNG key was passed but rounding='nearest' "
+                         "would ignore it; pass rounding='stochastic' "
+                         "(matching sum_gradients' contract)")
     if key is None:
         return jax.tree.map(
             lambda g: _reduce_leaf(g, emulate_node, use_aps, grad_exp,

@@ -27,40 +27,51 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..quant.numerics import cast_to_format, cast_to_format_sr
+from ..quant.numerics import cast_to_format, cast_to_format_sr_at
 
 __all__ = ["ordered_quantized_sum", "kahan_quantized_sum", "quantized_sum"]
 
 
-def _make_q(exp: int, man: int, key):
+def _make_q(exp: int, man: int, key, offsets=None):
     """Per-step quantizer factory.  key=None -> RTNE (reference semantics,
     ignores the step/site arguments).  With a PRNG key -> unbiased
-    stochastic rounding with an independent bitstream per (step, site):
-    the sequential accumulation stays ordered and deterministic-given-key,
-    but each partial sum rounds up with probability equal to its discarded
-    fraction — so sub-ulp/2 contributions survive in expectation instead
-    of being flushed (the failure mode of an un-APS'd low-precision sum)."""
+    stochastic rounding with an independent bitstream per (step, site,
+    element offset): the sequential accumulation stays ordered and
+    deterministic-given-key, but each partial sum rounds up with
+    probability equal to its discarded fraction — so sub-ulp/2
+    contributions survive in expectation instead of being flushed (the
+    failure mode of an un-APS'd low-precision sum).
+
+    Per-element bits are OFFSET-indexed (numerics.sr_bits_at): `offsets`
+    gives each element's global flat offset (default: leaf-local
+    ``arange(size)``).  Bits therefore depend only on (key, step, site,
+    offset), never on the array layout — callers that pass GLOBAL offsets
+    (parallel/dist.py buckets, parallel/zero.py shards) get bitwise
+    agreement with the per-leaf / replicated computation."""
     if key is None:
         rtne = functools.partial(cast_to_format, exp_bits=exp, man_bits=man)
         return lambda x, step, site: rtne(x)
 
     def q(x, step, site):
         k = jax.random.fold_in(jax.random.fold_in(key, step), site)
-        return cast_to_format_sr(x, exp, man, k)
+        offs = (jnp.arange(x.size, dtype=jnp.uint32).reshape(x.shape)
+                if offsets is None else offsets)
+        return cast_to_format_sr_at(x, exp, man, k, offs)
 
     return q
 
 
 def ordered_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
-                          key=None) -> jnp.ndarray:
+                          key=None, offsets=None) -> jnp.ndarray:
     """res = 0; for g in stacked: res = quantize(res + g)   — in order.
 
     Mirrors reference normal_sum_gradients' gather path
     (dist_util.py:60-69): accumulation starts from zeros, and every partial
     sum is re-cast to eXmY.  `stacked` has shape (W, *leaf_shape).
-    `key` switches the per-step cast to stochastic rounding (see _make_q).
+    `key` switches the per-step cast to stochastic rounding; `offsets`
+    overrides the per-element bit indices (see _make_q).
     """
-    q = _make_q(exp, man, key)
+    q = _make_q(exp, man, key, offsets)
 
     def step(carry, xs):
         res, i = carry
@@ -73,7 +84,7 @@ def ordered_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
 
 
 def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
-                        key=None) -> jnp.ndarray:
+                        key=None, offsets=None) -> jnp.ndarray:
     """Rank-ordered Kahan-compensated sum with every intermediate quantized.
 
     Mirrors reference kahan_sum_gradients (dist_util.py:72-89):
@@ -81,9 +92,9 @@ def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
         y = q(g - c); t = q(res + y); c = q(q(t - res) - y); res = t
 
     With `key`, each of the four casts draws its own SR bitstream per rank
-    step (sites 0-3).
+    step (sites 0-3); `offsets` overrides the per-element bit indices.
     """
-    q = _make_q(exp, man, key)
+    q = _make_q(exp, man, key, offsets)
 
     def step(carry, g):
         res, c, i = carry
@@ -99,7 +110,8 @@ def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
 
 
 def quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
-                  use_kahan: bool = False, key=None) -> jnp.ndarray:
+                  use_kahan: bool = False, key=None,
+                  offsets=None) -> jnp.ndarray:
     """Dispatch between the plain and Kahan ordered quantized sums.
 
     The fp32 shortcut (exp==8, man==23 → plain sum) applies only to the
@@ -107,7 +119,8 @@ def quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
     shortcut; kahan_sum_gradients:72-89 does not).  The shortcut also makes
     `key` irrelevant there (SR at (8,23) is the identity)."""
     if use_kahan:
-        return kahan_quantized_sum(stacked, exp, man, key=key)
+        return kahan_quantized_sum(stacked, exp, man, key=key,
+                                   offsets=offsets)
     if exp == 8 and man == 23:
         return jnp.sum(stacked, axis=0)
-    return ordered_quantized_sum(stacked, exp, man, key=key)
+    return ordered_quantized_sum(stacked, exp, man, key=key, offsets=offsets)

@@ -57,10 +57,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..quant.numerics import cast_to_format
 from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
                   pmax_scalar_vector)
-from .dist import _wire_dtype
+from .dist import _flat_axis_index, _wire_dtype, quantize_tree_sr
 from .reduction import quantized_sum
 
 __all__ = ["Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd"]
@@ -235,7 +234,8 @@ class _Zero2(_Zero1):
     def _grad_shard(self, local_grads, state, axis_name: str,
                     use_aps: bool = False, grad_exp: int = 8,
                     grad_man: int = 23, use_kahan: bool = False,
-                    mode: str = "faithful") -> jnp.ndarray:
+                    mode: str = "faithful", rounding: str = "nearest",
+                    key=None) -> jnp.ndarray:
         """This rank's (S,) slice of the faithful quantized gradient sum.
 
         Replicates parallel/dist.py `sum_gradients` faithful-mode semantics
@@ -243,12 +243,34 @@ class _Zero2(_Zero1):
         divide-unscale), but on 1/W of the elements: the scan is
         elementwise over ranks, so slicing before summing is bit-identical
         to summing then slicing.  The precision arguments come from the
-        train step (reduce_in_update forwards them)."""
+        train step (reduce_in_update forwards them).
+
+        rounding='stochastic' composes bitwise too: the SR bitstream is
+        indexed by GLOBAL flat offset (numerics.sr_bits_at) and the key
+        schedule mirrors sum_gradients' split exactly (k_pre rank-folded
+        for the local pre-quantize, k_sum shared for the ordered scan), so
+        each rank's shard reproduces the very bits the replicated faithful
+        path would give that slice — the semantics target is the
+        reference's ordered requantized sum (dist_util.py:60-69) with SR
+        in place of RTNE.  Elements in the world-size pad hold exact
+        zeros, whose cast is rounding-independent."""
         if mode != "faithful":
             raise ValueError(
                 f"ZeRO-2 shards the faithful ordered reduction; mode="
                 f"{mode!r} has no reduce-scatter equivalent (the fast "
                 f"psum path keeps the full gradient resident anyway)")
+        if rounding == "stochastic" and key is None:
+            raise ValueError("rounding='stochastic' requires a PRNG key")
+        if rounding == "nearest" and key is not None:
+            raise ValueError("a PRNG key was passed but rounding='nearest' "
+                             "would ignore it (sum_gradients' contract)")
+        k_pre = k_sum = None
+        if key is not None:
+            # same derivation as sum_gradients: shared scan key, rank-
+            # decorrelated pre-quantize key (coherent-rounding argument in
+            # parallel/dist.py)
+            k_pre, k_sum, _ = jax.random.split(key, 3)
+            k_pre = jax.random.fold_in(k_pre, _flat_axis_index(axis_name))
         s = self._shard_size(local_grads)
         g = local_grads
         shifts = None
@@ -257,8 +279,7 @@ class _Zero2(_Zero1):
             max_exp = pmax_scalar_vector(max_exp, axis_name)
             shifts = aps_shift_factors(max_exp, grad_exp)
             g = aps_scale(g, shifts)
-            g = jax.tree.map(
-                lambda l: cast_to_format(l, grad_exp, grad_man), g)
+            g = quantize_tree_sr(g, grad_exp, grad_man, k_pre)
 
         flat = self._flatten(g)
         flat = jnp.pad(flat, (0, self.world * s - flat.size))
@@ -271,9 +292,12 @@ class _Zero2(_Zero1):
                                  split_axis=0, concat_axis=0)
         if wire is not None:
             stacked = stacked.astype(jnp.float32)
-        red = quantized_sum(stacked, grad_exp, grad_man, use_kahan)
+        rank = lax.axis_index(axis_name)
+        offs = (None if k_sum is None
+                else (rank * s + jnp.arange(s)).astype(jnp.uint32))
+        red = quantized_sum(stacked, grad_exp, grad_man, use_kahan,
+                            key=k_sum, offsets=offs)
         if use_aps:
-            rank = lax.axis_index(axis_name)
             shift_sh = self._shard_shifts(local_grads, shifts, rank, s)
             red = red / shift_sh   # true divide, aps_unscale semantics
         return red

@@ -51,6 +51,7 @@ import numpy as np
 
 __all__ = ["cast_to_format", "cast_body", "cast_oracle", "max_finite",
            "cast_body_sr", "cast_to_format_sr", "cast_oracle_sr",
+           "sr_bits_at", "cast_to_format_sr_at",
            "FP32_EXP_BITS", "FP32_MAN_BITS"]
 
 FP32_EXP_BITS = 8
@@ -227,6 +228,42 @@ def cast_to_format_sr(x: jnp.ndarray, exp_bits: int, man_bits: int,
     range (each element rounds up with probability equal to its discarded
     significand fraction).  Deterministic given (x, key)."""
     rbits = jax.random.bits(key, jnp.shape(x), jnp.uint32)
+    return cast_body_sr(x, exp_bits, man_bits, rbits)
+
+
+def sr_bits_at(key: jax.Array, offsets: jnp.ndarray) -> jnp.ndarray:
+    """Offset-indexed SR bitstream: uint32 bits per element as a pure
+    function of (key, offset) — each element's bits come from its own
+    threefry stream (`fold_in(key, offset)` then one draw), NOT from its
+    position inside whatever array happens to hold it.
+
+    This is what makes the gradient pipeline's stochastic rounding
+    *layout-invariant*: the same (key, offset) pair yields the same bits
+    whether the element is cast per-leaf, inside a fused bucket, or on a
+    ZeRO reduce-scatter shard — so a sharded reduction reproduces the
+    replicated reduction's bits exactly (parallel/zero.py), and bucketed
+    vs per-leaf faithful reductions are bitwise identical
+    (parallel/dist.py).  Costs ~2 threefry evaluations per element vs ~0.5
+    for a shape-based `jax.random.bits` — negligible against the gather +
+    ordered-scan the faithful emulation path already pays.
+
+    `offsets` may be any shape; values must fit uint32 (documented limit:
+    reductions over > 2^32 elements would need a wider fold)."""
+    flat = jnp.reshape(jnp.asarray(offsets, jnp.uint32), (-1,))
+    keys = jax.vmap(lambda o: jax.random.fold_in(key, o))(flat)
+    bits = jax.vmap(lambda k: jax.random.bits(k, (), jnp.uint32))(keys)
+    return bits.reshape(jnp.shape(offsets))
+
+
+def cast_to_format_sr_at(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                         key: jax.Array, offsets: jnp.ndarray) -> jnp.ndarray:
+    """Stochastically-rounded eXmY cast with offset-indexed bits.
+
+    Like `cast_to_format_sr` but the per-element round bits are drawn by
+    global element offset (`sr_bits_at`) instead of by position in
+    `x.shape` — the layout-invariant variant the reduction pipeline uses.
+    `offsets` must have x's shape (or broadcast to it)."""
+    rbits = jnp.broadcast_to(sr_bits_at(key, offsets), jnp.shape(x))
     return cast_body_sr(x, exp_bits, man_bits, rbits)
 
 

@@ -156,6 +156,7 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                                       state.step)
         local = emulate_node_reduce(
             stacked, n, use_aps, grad_exp, grad_man,
+            rounding=grad_rounding,
             key=None if gkey is None else jax.random.fold_in(
                 jax.random.fold_in(gkey, 0),
                 lax.axis_index(axis_dp).astype(jnp.int32)))

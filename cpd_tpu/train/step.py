@@ -127,10 +127,6 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     """
     if grad_rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
-    if grad_rounding == "stochastic" and reduce_in_update:
-        raise ValueError("grad_rounding='stochastic' is not supported with "
-                         "reduce_in_update (ZeRO updaters own their "
-                         "collective and do not thread SR keys)")
     dynamic_scale = loss_scale == "dynamic"
     if dynamic_scale and update_fn is not None:
         raise ValueError("loss_scale='dynamic' requires the default optax "
@@ -222,10 +218,16 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         if dynamic_scale:
             scale = current_scale(state.opt_state)
         else:
-            if isinstance(state.opt_state, DynamicScaleState):
-                # symmetric to current_scale's TypeError: a wrapped
-                # optimizer with a static loss_scale would silently divide
-                # every update by the (growing) scale
+            # symmetric to current_scale's TypeError: a wrapped optimizer
+            # with a static loss_scale would silently divide every update
+            # by the (growing) scale.  The search covers the WHOLE
+            # opt_state pytree, not just the outermost node — e.g.
+            # optax.chain(clip, with_dynamic_loss_scale(tx)) nests the
+            # wrapper's state one level down.
+            def _is_dyn(n):
+                return isinstance(n, DynamicScaleState)
+            if any(map(_is_dyn, jax.tree.leaves(
+                    state.opt_state, is_leaf=_is_dyn))):
                 raise ValueError(
                     "optimizer is wrapped with with_dynamic_loss_scale but "
                     "loss_scale is static; pass loss_scale='dynamic' to "
@@ -249,6 +251,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         # sum_gradients folds the rank into its own pre-quantize key)
         local = emulate_node_reduce(
             stacked, emulate_node, use_aps, grad_exp, grad_man,
+            rounding=grad_rounding,
             key=None if gkey is None else jax.random.fold_in(
                 jax.random.fold_in(gkey, 0),
                 lax.axis_index(axis_name).astype(jnp.int32)))
@@ -269,10 +272,16 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # sharded) new opt state.
             # With reduce_in_update the step's precision settings ride
             # along so the updater's collective cannot drift from the
-            # emulate-node quantization above.
+            # emulate-node quantization above.  The SR key is the SAME
+            # fold the replicated path hands sum_gradients, so a ZeRO
+            # reduce-scatter draws exactly the bits the replicated
+            # faithful reduction would (parallel/zero.py).
             quant_kw = dict(use_aps=use_aps, grad_exp=grad_exp,
                             grad_man=grad_man, use_kahan=use_kahan,
-                            mode=mode) if reduce_in_update else {}
+                            mode=mode, rounding=grad_rounding,
+                            key=None if gkey is None
+                            else jax.random.fold_in(gkey, 1)
+                            ) if reduce_in_update else {}
             new_params, new_opt = update_fn(reduced, state, axis_name,
                                             **quant_kw)
         else:

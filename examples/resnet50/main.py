@@ -79,6 +79,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard the SGD momentum buffer 1/N over "
                         "the dp axis (parallel/zero.py)")
+    p.add_argument("--grad-rounding", default="nearest",
+                   choices=["nearest", "stochastic"],
+                   help="rounding for every gradient-pipeline cast "
+                        "(emulate-node + all-reduce — incl. the ZeRO-2/3 "
+                        "sharded reduce-scatter, whose offset-indexed SR "
+                        "bits match the replicated draw): stochastic = "
+                        "unbiased SR (beyond-reference)")
+    p.add_argument("--grad-seed", type=int, default=0,
+                   help="PRNG seed for --grad-rounding stochastic")
     p.add_argument("--tensorboard", action="store_true",
                    help="also write TensorBoard event files next to the "
                         "JSONL scalars (reference mix.py:16,168-171)")
@@ -279,6 +288,7 @@ def main(argv=None) -> dict:
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
         grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
+        grad_rounding=args.grad_rounding, grad_seed=args.grad_seed,
         **extra)
     # checkpoints always persist the portable layout under --zero3
     to_ckpt = zero.export_state if args.zero3 else (lambda s: s)

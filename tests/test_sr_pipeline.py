@@ -104,12 +104,19 @@ def test_emulate_node_sr_deterministic():
     tree = {"w": jnp.asarray(rng.normal(size=(4, 17)).astype(np.float32))}
     k = jax.random.PRNGKey(5)
     a = emulate_node_reduce(tree, 4, use_aps=True, grad_exp=4, grad_man=3,
-                            key=k)
+                            key=k, rounding="stochastic")
     b = emulate_node_reduce(tree, 4, use_aps=True, grad_exp=4, grad_man=3,
-                            key=k)
+                            key=k, rounding="stochastic")
     np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
     # n == 1 shortcut unaffected by the key (no quantization at all)
-    one = emulate_node_reduce({"w": tree["w"][:1]}, 1, key=k)
+    one = emulate_node_reduce({"w": tree["w"][:1]}, 1, key=k,
+                              rounding="stochastic")
+    # key/rounding contract matches sum_gradients (a key with 'nearest'
+    # would be silently ignored -> loud error instead)
+    with pytest.raises(ValueError, match="nearest"):
+        emulate_node_reduce(tree, 4, key=k)
+    with pytest.raises(ValueError, match="requires"):
+        emulate_node_reduce(tree, 4, rounding="stochastic")
     np.testing.assert_array_equal(np.asarray(one["w"]),
                                   np.asarray(tree["w"][0]))
 
@@ -154,16 +161,34 @@ class TestTrainStepGradRounding:
                    zip(jax.tree.leaves(s1.params),
                        jax.tree.leaves(s2.params)))
 
-    def test_sr_rejected_with_reduce_in_update(self):
-        from cpd_tpu.models.tiny import tiny_cnn
-        from cpd_tpu.train.optim import sgd
-        from cpd_tpu.train.step import make_train_step
-        with pytest.raises(ValueError, match="reduce_in_update"):
-            make_train_step(tiny_cnn(), sgd(lambda _: 0.1),
-                            data_parallel_mesh(),
-                            grad_rounding="stochastic",
-                            reduce_in_update=True,
-                            update_fn=lambda *a, **k: None)
+    def test_sr_bucket_layout_invariant(self):
+        """Offset-indexed bits: bucketed and per-leaf faithful SR
+        reductions are bitwise IDENTICAL (until round 3 they were two
+        different draws keyed by bucket layout)."""
+        mesh = data_parallel_mesh()
+        W = mesh.devices.size
+        rng = np.random.default_rng(5)
+        tree = {"a": jnp.asarray(rng.normal(size=(W, 65)).astype(np.float32)),
+                "b": jnp.asarray(rng.normal(size=(W, 9)).astype(np.float32)),
+                "c": jnp.asarray(rng.normal(size=(W, 4, 3)).astype(np.float32))}
+        key = jax.random.PRNGKey(2)
+
+        def run(bucket):
+            def body(stacked):
+                local = jax.tree.map(lambda g: g[0], stacked)
+                return sum_gradients(local, "dp", use_aps=True, grad_exp=4,
+                                     grad_man=3, mode="faithful",
+                                     rounding="stochastic", key=key,
+                                     bucket=bucket)
+            in_spec = jax.tree.map(lambda _: P("dp"), tree)
+            out_spec = jax.tree.map(lambda _: P(), tree)
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(in_spec,),
+                                   out_specs=out_spec, check_vma=False))
+            return jax.tree.map(np.asarray, fn(tree))
+
+        a, b = run(True), run(False)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(la, lb)
 
 
 @pytest.mark.slow  # three dp2 x sp2 x tp2 LM step compiles

@@ -190,6 +190,109 @@ def test_zero2_reduce_scatter_bitwise(exp, man, kahan):
                                   np.asarray(full)[:flat_ref.size])
 
 
+@pytest.mark.parametrize("use_aps,kahan", [(True, False), (False, False),
+                                           (True, True)])
+def test_zero2_reduce_scatter_bitwise_sr(use_aps, kahan):
+    """Stochastic rounding composes with the sharded reduce-scatter: the
+    SR bitstream is indexed by GLOBAL flat offset, so each rank's shard
+    reproduces the replicated faithful SR reduction's slice bit for bit
+    (round-4 item: SR + ZeRO-2/3).  Covers APS-prequantized, raw-fp32
+    gather, and Kahan (4 SR sites per rank step) variants."""
+    from jax import lax
+    from cpd_tpu.parallel.dist import sum_gradients
+
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    rng = np.random.RandomState(13)
+    tree = {"a": jnp.asarray(rng.randn(w, 33).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(w, 7, 5).astype(np.float32))}
+    z = zero2_sgd(lambda s: 0.1, world=w)
+    key = jax.random.PRNGKey(11)
+
+    def body(t):
+        local = jax.tree.map(lambda g: g[0], t)
+        ref = sum_gradients(local, "dp", use_aps=use_aps, grad_exp=4,
+                            grad_man=3, use_kahan=kahan, mode="faithful",
+                            rounding="stochastic", key=key)
+        sh = z._grad_shard(local, None, "dp", use_aps=use_aps, grad_exp=4,
+                           grad_man=3, use_kahan=kahan,
+                           rounding="stochastic", key=key)
+        return ref, lax.all_gather(sh, "dp", axis=0, tiled=True)
+
+    in_spec = jax.tree.map(lambda _: P("dp"), tree)
+    ref, full = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(in_spec,),
+        out_specs=(jax.tree.map(lambda _: P(), tree), P()),
+        check_vma=False))(tree)
+    flat_ref = np.concatenate([np.asarray(l).ravel()
+                               for l in jax.tree.leaves(ref)])
+    np.testing.assert_array_equal(flat_ref,
+                                  np.asarray(full)[:flat_ref.size])
+    # SR actually engaged: the draw differs from the RTNE reduction
+    def body_rtne(t):
+        local = jax.tree.map(lambda g: g[0], t)
+        return sum_gradients(local, "dp", use_aps=use_aps, grad_exp=4,
+                             grad_man=3, use_kahan=kahan, mode="faithful")
+    rtne = jax.jit(jax.shard_map(
+        body_rtne, mesh=mesh, in_specs=(in_spec,),
+        out_specs=jax.tree.map(lambda _: P(), tree),
+        check_vma=False))(tree)
+    flat_rtne = np.concatenate([np.asarray(l).ravel()
+                                for l in jax.tree.leaves(rtne)])
+    assert np.any(flat_ref != flat_rtne)
+
+
+def test_zero2_sr_train_step_end_to_end():
+    """make_train_step(grad_rounding='stochastic', reduce_in_update=True)
+    — rejected until round 3 — now trains, matches the replicated SR step
+    (grads bitwise; update arithmetic differs by last-ulp flat-vs-leaf
+    order), and stays seed-deterministic."""
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = tiny_cnn()
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    x, y = _data(16, seed=21)
+    quant = dict(use_aps=True, grad_exp=4, grad_man=3,
+                 grad_rounding="stochastic", grad_seed=7)
+
+    tx = make_optimizer("sgd", schedule, momentum=0.9, weight_decay=1e-2)
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, donate=False, mode="faithful",
+                           **quant)
+    s_ref = state
+    for _ in range(3):
+        s_ref, m_ref = step(s_ref, x, y)
+
+    z = zero2_sgd(schedule, world=w, momentum=0.9, weight_decay=1e-2)
+    z_state = TrainState(step=jnp.zeros([], jnp.int32), params=state.params,
+                         batch_stats=state.batch_stats,
+                         opt_state=z.init(state.params))
+    z_step = make_train_step(model, None, mesh, donate=False,
+                             update_fn=z.update_fn,
+                             opt_state_spec=z.state_spec(),
+                             reduce_in_update=True, **quant)
+    s_z = z_state
+    for _ in range(3):
+        s_z, m_z = z_step(s_z, x, y)
+
+    np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
+                               rtol=1e-6)
+    for (path, got), (_, want) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.tree.map(np.asarray, s_z.params))[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.tree.map(np.asarray, s_ref.params))[0]):
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                                   err_msg=str(path))
+    # deterministic given seed
+    s_z2 = z_state
+    for _ in range(3):
+        s_z2, _ = z_step(s_z2, x, y)
+    for a, b in zip(jax.tree.leaves(s_z.params),
+                    jax.tree.leaves(s_z2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_zero3_matches_replicated_faithful():
     """ZeRO-3 (params sharded at rest, gathered transiently per step)
     trains identically to the replicated faithful path."""

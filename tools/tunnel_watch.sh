@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Background tunnel watcher for a build round: retry recapture_tpu.sh
+# every WATCH_INTERVAL seconds (default 900) until the stage-1 probe
+# passes, then run the full capture once and exit 0 so the caller is
+# notified.  Exits 2 after WATCH_MAX_TRIES attempts (default 40, ~10 h)
+# so the process does not outlive the round.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${WATCH_INTERVAL:-900}"
+MAX="${WATCH_MAX_TRIES:-40}"
+for i in $(seq 1 "$MAX"); do
+    echo "== tunnel_watch attempt $i/$MAX $(date -u +%FT%TZ)"
+    if bash tools/recapture_tpu.sh; then
+        echo "== tunnel_watch: capture SUCCEEDED on attempt $i"
+        exit 0
+    fi
+    sleep "$INTERVAL"
+done
+echo "== tunnel_watch: exhausted $MAX attempts without a live tunnel"
+exit 2
