@@ -5,18 +5,25 @@ default `pytest tests/` run still must prove the whole stack — flag
 parsing, config merge, data pipeline, sharded faithful quantized step,
 checkpointing, log protocol — hangs together, so this single smoke stays
 in the fast tier.  Kept to one compile (~15 s): reference-parity flags,
-faithful mode, APS e5m2, real-format CIFAR tree.
+faithful mode, APS e5m2, and the COMMITTED real-format CIFAR tree
+(tests/fixtures/cifar10_real_format — the strict --data-root path reads
+bytes the test run did not fabricate; see
+tests/test_real_format_fixture.py).
 """
 
 import math
+import os
 
 import numpy as np
 
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "cifar10_real_format")
 
-def test_resnet18_cli_canary(tmp_path, tiny_cifar_factory):
+
+def test_resnet18_cli_canary(tmp_path):
     from resnet18_cifar.train import main
 
-    root = tiny_cifar_factory(tmp_path / "cifar", n_train=160, n_test=32)
+    root = FIXTURE
     res = main(["--use_APS", "--grad_exp", "5", "--grad_man", "2",
                 "--emulate_node", "2", "--arch", "tiny",
                 "--data-root", root, "--max-iter", "2",

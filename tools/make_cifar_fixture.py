@@ -1,0 +1,58 @@
+"""Generate the COMMITTED real-format CIFAR-10 fixture (VERDICT r3 #4).
+
+No network/dataset access exists in this environment, so the repo carries
+a small tree in the genuine CIFAR-10 on-disk layout (pickle batches with
+b"data" (N, 3072) uint8 row-major CHW and b"labels") holding the
+LEARNABLE class-structured synthetic images (data/cifar.py
+`synthetic_cifar10` — class-dependent low-frequency patterns), making the
+"zero-edit real-data command" claim executable evidence: the strict
+`--data-root` loader path reads bytes it did not fabricate in-process.
+
+Deterministic: re-running this script reproduces the committed bytes
+exactly (tests/test_real_format_fixture.py pins their sha256).
+
+    python tools/make_cifar_fixture.py   # writes tests/fixtures/...
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+N_TRAIN, N_TEST = 100, 20  # 20 per data_batch_i; ~370 KB committed total
+
+
+def main() -> int:
+    from cpd_tpu.data.cifar import synthetic_cifar10
+
+    train_x, train_y, test_x, test_y = synthetic_cifar10(
+        n_train=N_TRAIN, n_test=N_TEST, seed=1234)
+    root = os.path.join(_REPO, "tests", "fixtures", "cifar10_real_format")
+    folder = os.path.join(root, "cifar-10-batches-py")
+    os.makedirs(folder, exist_ok=True)
+
+    def rows(x):  # NHWC uint8 -> the on-disk (N, 3072) CHW row layout
+        return np.ascontiguousarray(
+            x.transpose(0, 3, 1, 2).reshape(len(x), -1))
+
+    per = N_TRAIN // 5
+    for i in range(1, 6):
+        sl = slice((i - 1) * per, i * per)
+        with open(os.path.join(folder, f"data_batch_{i}"), "wb") as f:
+            pickle.dump({b"data": rows(train_x[sl]),
+                         b"labels": train_y[sl].tolist()}, f, protocol=2)
+    with open(os.path.join(folder, "test_batch"), "wb") as f:
+        pickle.dump({b"data": rows(test_x),
+                     b"labels": test_y.tolist()}, f, protocol=2)
+    print(f"wrote {folder}: {N_TRAIN} train + {N_TEST} test samples")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
