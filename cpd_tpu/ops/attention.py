@@ -249,7 +249,10 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     case e = rep, the fully-expanded legacy behavior).
 
     ``impl`` is forwarded to the full-sequence middle step ("flash" =
-    Pallas kernel on the gathered sequence; MHA-shaped chunks only).
+    Pallas kernel on the gathered sequence).  The flash kernel takes
+    uniform heads, so with GQA the K/V chunk is expanded AFTER the
+    all_to_all — device-local HBM, not ICI, pays the rep×, keeping the
+    wire win while staying flash-compatible.
     """
     axis_size = lax.psum(1, axis_name)
     rep = _gqa_rep(q, k)
@@ -274,6 +277,13 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    out = grouped_query_attention(seq_to_heads(q), seq_to_heads(k),
-                                  seq_to_heads(v), causal=causal, impl=impl)
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if impl == "flash" and kh.shape[2] != qh.shape[2]:
+        # post-collective local expansion: the Pallas kernel wants
+        # uniform heads; the chunk alignment note above guarantees
+        # qh head i is served by kh head i // (local rep)
+        local_rep = qh.shape[2] // kh.shape[2]
+        kh = jnp.repeat(kh, local_rep, axis=2)
+        vh = jnp.repeat(vh, local_rep, axis=2)
+    out = grouped_query_attention(qh, kh, vh, causal=causal, impl=impl)
     return heads_to_seq(out)

@@ -232,6 +232,40 @@ def test_ulysses_attention_gqa(hkv, sp):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_ulysses_flash_gqa_expands_post_collective(monkeypatch):
+    """With impl='flash' and GQA, ulysses expands the K/V chunk AFTER the
+    all_to_all (HBM pays the rep x, ICI does not) so the uniform-heads
+    flash kernel applies.  The kernel itself needs a TPU, so it is
+    stubbed with the XLA path here — this pins the ROUTING: no MHA-only
+    rejection, chunk-aligned expansion, oracle agreement."""
+    import cpd_tpu.ops.attention as attn_mod
+    from cpd_tpu.ops.attention import (grouped_query_attention,
+                                       ulysses_attention)
+
+    calls = {}
+
+    def fake_flash(q, k, v, causal, q_offset, k_offset):
+        calls["heads"] = (q.shape[2], k.shape[2])
+        return attn_mod.local_attention(q, k, v, causal=causal)
+
+    monkeypatch.setattr(attn_mod, "_flash_attention", fake_flash)
+    rng = np.random.RandomState(24)
+    q, k, v = _rand_gqa(rng, h=8, hkv=4, t=32)
+    full = grouped_query_attention(q, k, v, causal=True)
+    mesh = make_mesh(sp=4, dp=1, devices=jax.devices()[:4])
+
+    def body(ql, kl, vl):
+        return ulysses_attention(ql, kl, vl, "sp", causal=True,
+                                 impl="flash")
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                               rtol=2e-5, atol=2e-5)
+    assert calls["heads"] == (2, 2)  # uniform heads reached the kernel
+
+
 @pytest.mark.slow
 def test_lm_dropout():
     """Dropout: eval is identity (same logits as the rate-0 model on the
