@@ -54,6 +54,7 @@ from .reduction import quantized_sum
 __all__ = [
     "dist_init", "sum_gradients", "broadcast_from", "replicate",
     "all_reduce_mean", "host_batch_to_global", "quantize_tree_sr",
+    "grad_sr_key",
 ]
 
 
@@ -166,6 +167,22 @@ def quantize_tree_sr(tree, grad_exp: int, grad_man: int, key) -> Any:
                                 _leaf_offsets(st, g))
            for st, g in zip(starts, leaves)]
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def grad_sr_key(grad_seed: int, step, site: int) -> jax.Array:
+    """The ONE derivation of gradient-pipeline SR keys, shared by every
+    train-step builder (train/step.py, lm.py, pp.py, moe.py).
+
+    Depends only on (grad_seed, step, site) — NEVER a rank index: the
+    same key must reach every sp/tp/pp/ep copy so replicated leaves
+    round identically (desynchronized bits would silently diverge
+    optimizer state across copies).  `sum_gradients` itself folds the
+    dp rank into its pre-quantize subkey where decorrelation is wanted.
+    Site convention: 0 = the rank-local pre-reduce cast (emulate-node;
+    callers fold their dp rank in AFTER this), 1 = the cross-device
+    `sum_gradients` reduction."""
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(grad_seed), step), site)
 
 
 def _wire_dtype(grad_exp: int, grad_man: int):

@@ -32,7 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer import lm_param_specs
-from ..parallel.dist import sum_gradients
+from ..parallel.dist import grad_sr_key, sum_gradients
 from ..parallel.emulate import emulate_node_reduce
 from .state import (TrainState, make_sharded_stepper, reject_norm_based,
                     state_specs_like)
@@ -150,21 +150,19 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         # draw identical bits or their optimizer states would diverge;
         # dp ranks hold different grads and decorrelate (see
         # parallel/dist.py on coherent rounding error).
-        gkey = None
-        if grad_rounding == "stochastic":
-            gkey = jax.random.fold_in(jax.random.PRNGKey(grad_seed),
-                                      state.step)
+        sr = grad_rounding == "stochastic"
         local = emulate_node_reduce(
             stacked, n, use_aps, grad_exp, grad_man,
             rounding=grad_rounding,
-            key=None if gkey is None else jax.random.fold_in(
-                jax.random.fold_in(gkey, 0),
-                lax.axis_index(axis_dp).astype(jnp.int32)))
+            key=jax.random.fold_in(
+                grad_sr_key(grad_seed, state.step, 0),
+                lax.axis_index(axis_dp).astype(jnp.int32)) if sr
+            else None)
         reduced = sum_gradients(
             local, axis_dp, use_aps=use_aps,
             grad_exp=grad_exp, grad_man=grad_man,
             use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
-            key=None if gkey is None else jax.random.fold_in(gkey, 1))
+            key=grad_sr_key(grad_seed, state.step, 1) if sr else None)
 
         updates, new_opt = tx.update(reduced, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -26,7 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.pipeline_lm import PipelinedLM, pp_param_specs
-from ..parallel.dist import sum_gradients
+from ..parallel.dist import grad_sr_key, sum_gradients
 from .state import (TrainState, make_sharded_stepper, reject_norm_based,
                     state_specs_like)
 
@@ -45,6 +45,7 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
                        axis_tp: str = "tp", use_aps: bool = False,
                        grad_exp: int = 8, grad_man: int = 23,
                        use_kahan: bool = False, mode: str = "faithful",
+                       grad_rounding: str = "nearest", grad_seed: int = 0,
                        donate: bool = True):
     """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
 
@@ -52,7 +53,17 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
     over pp); the per-dp-rank batch is split into `n_microbatches`
     pipeline microbatches.  Keep n_microbatches >= pp for a small bubble
     (fraction (pp-1)/(n_microbatches+pp-1)).
+
+    grad_rounding='stochastic': unbiased SR through the dp all-reduce
+    (same contract as train/step.py).  The key depends only on
+    (grad_seed, step) — identical across pp/tp ranks, which is required
+    (replicated leaves like the embedding must reduce to identical bits
+    on every pp copy) and harmless for stage-sharded leaves (pp ranks
+    hold different parameters, nothing sums across pp);
+    `sum_gradients` itself folds the dp rank into its pre-quantize key.
     """
+    if grad_rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
     reject_norm_based(tx, "pp-sharded step")
     pp_size = mesh.shape.get(axis_pp, 1)
     all_axes = (axis_dp, axis_pp, axis_tp)  # size-1 axes psum as no-ops
@@ -101,9 +112,12 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
 
         grads = jax.tree.map(reduce_leaf, grads, specs,
                              is_leaf=lambda x: isinstance(x, P))
+        gkey = (grad_sr_key(grad_seed, state.step, 1)
+                if grad_rounding == "stochastic" else None)
         grads = sum_gradients(grads, axis_dp, use_aps=use_aps,
                               grad_exp=grad_exp, grad_man=grad_man,
-                              use_kahan=use_kahan, mode=mode)
+                              use_kahan=use_kahan, mode=mode,
+                              rounding=grad_rounding, key=gkey)
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

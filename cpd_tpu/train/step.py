@@ -40,7 +40,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.dist import sum_gradients
+from ..parallel.dist import grad_sr_key, sum_gradients
 from ..parallel.emulate import emulate_node_reduce
 from .state import TrainState
 
@@ -239,22 +239,21 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         # Local emulated-node reduction (mix.py:251-282), then the
         # cross-device low-precision all-reduce (mix.py:286-291).
-        # grad_rounding='stochastic': fresh unbiased SR bits per step,
-        # identical on every rank (the key depends only on seed + step),
-        # so the replicated reduction outputs stay consistent.
-        gkey = None
-        if grad_rounding == "stochastic":
-            gkey = jax.random.fold_in(jax.random.PRNGKey(grad_seed),
-                                      state.step)
+        # grad_rounding='stochastic': fresh unbiased SR bits per step via
+        # the shared derivation (parallel/dist.py grad_sr_key — rank-free
+        # by contract, so replicated reduction outputs stay consistent).
+        sr = grad_rounding == "stochastic"
         # the emulate-node reduce is rank-LOCAL, so its key also folds in
         # the rank index (same decorrelation the dropout rngs get above;
         # sum_gradients folds the rank into its own pre-quantize key)
         local = emulate_node_reduce(
             stacked, emulate_node, use_aps, grad_exp, grad_man,
             rounding=grad_rounding,
-            key=None if gkey is None else jax.random.fold_in(
-                jax.random.fold_in(gkey, 0),
-                lax.axis_index(axis_name).astype(jnp.int32)))
+            key=jax.random.fold_in(
+                grad_sr_key(grad_seed, state.step, 0),
+                lax.axis_index(axis_name).astype(jnp.int32)) if sr
+            else None)
+        sum_key = grad_sr_key(grad_seed, state.step, 1) if sr else None
         if reduce_in_update:
             reduced = local       # update_fn owns the collective
         else:
@@ -262,7 +261,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 local, axis_name, use_aps=use_aps,
                 grad_exp=grad_exp, grad_man=grad_man,
                 use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
-                key=None if gkey is None else jax.random.fold_in(gkey, 1))
+                key=sum_key)
 
         if update_fn is not None:
             # custom update (e.g. parallel/zero.py ZeRO: shard-local
@@ -279,9 +278,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             quant_kw = dict(use_aps=use_aps, grad_exp=grad_exp,
                             grad_man=grad_man, use_kahan=use_kahan,
                             mode=mode, rounding=grad_rounding,
-                            key=None if gkey is None
-                            else jax.random.fold_in(gkey, 1)
-                            ) if reduce_in_update else {}
+                            key=sum_key) if reduce_in_update else {}
             new_params, new_opt = update_fn(reduced, state, axis_name,
                                             **quant_kw)
         else:

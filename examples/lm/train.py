@@ -178,10 +178,6 @@ def main(argv=None) -> dict:
     if (args.pp > 1 or args.moe) and args.sample > 0:
         raise ValueError("--sample needs the default dp/sp/tp path "
                          "(pp/moe modules have no decode mode)")
-    if (args.pp > 1 or args.moe) and args.grad_rounding != "nearest":
-        raise ValueError("--grad-rounding stochastic is only supported on "
-                         "the default dp/sp/tp path (pp/moe steppers do "
-                         "not thread SR keys)")
     if (args.pp > 1 or args.moe) and (args.remat or args.scan_layers
                                       or args.n_kv_heads is not None
                                       or args.label_smoothing
@@ -248,7 +244,8 @@ def main(argv=None) -> dict:
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
     quant_kw = dict(use_aps=args.use_APS, grad_exp=args.grad_exp,
                     grad_man=args.grad_man, use_kahan=args.use_kahan,
-                    mode=args.mode)
+                    mode=args.mode, grad_rounding=args.grad_rounding,
+                    grad_seed=args.grad_seed)
 
     if args.pp > 1:
         # GPipe pipeline path (parallel/pipeline.py, train/pp.py)
@@ -305,8 +302,6 @@ def main(argv=None) -> dict:
         step = make_lm_train_step(model, tx, mesh,
                                   emulate_node=args.emulate_node,
                                   label_smoothing=args.label_smoothing,
-                                  grad_rounding=args.grad_rounding,
-                                  grad_seed=args.grad_seed,
                                   **quant_kw)
         eval_step = make_lm_eval_step(model, mesh)
         specs_fn = lm_state_specs

@@ -115,6 +115,46 @@ def test_moe_train_step_matches_single_device():
                                    atol=2e-4, err_msg=str(path))
 
 
+@pytest.mark.slow
+def test_moe_train_step_grad_rounding_sr():
+    """SR through the MoE stepper (round 4): deterministic given seed,
+    seed-sensitive, finite — and ep-replicated leaves (router/attention)
+    stay bitwise consistent across ep copies after the SR dp-reduce."""
+    ep, dp = 2, 4
+    mesh = make_mesh(dp=dp, ep=ep)
+    tokens = _tokens(b=16, t=8, seed=11)
+    targets = _tokens(b=16, t=8, seed=12)
+    ref = _model(ep_size=1)
+    variables = ref.init(jax.random.PRNGKey(1), tokens[:2])
+    moe_model = _model(ep_size=ep)
+    tx = make_optimizer("sgd", lambda s: jnp.float32(0.1))
+    state = TrainState(step=jnp.zeros([], jnp.int32),
+                       params=variables["params"], batch_stats={},
+                       opt_state=tx.init(variables["params"]))
+    sharded_state = jax.device_put(
+        state, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            moe_state_specs(state)))
+
+    def run(seed):
+        step = make_moe_train_step(moe_model, tx, mesh, use_aps=True,
+                                   grad_exp=4, grad_man=3,
+                                   grad_rounding="stochastic",
+                                   grad_seed=seed, donate=False)
+        s, m = step(sharded_state, tokens, targets)
+        s, m = step(s, tokens, targets)   # step 2 surfaces divergence
+        return s, float(m["loss"])
+
+    s1, l1 = run(0)
+    s1b, l1b = run(0)
+    assert np.isfinite(l1)
+    assert l1 == l1b
+    for a, b in zip(jax.tree.leaves(s1.params),
+                    jax.tree.leaves(s1b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _, l2 = run(1)
+    assert l1 != l2
+
+
 def test_moe_capacity_drops_tokens():
     """With capacity_factor tiny, overflow tokens contribute nothing (the
     residual passes through) — outputs still finite, not equal to the

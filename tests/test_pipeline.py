@@ -153,6 +153,47 @@ def test_pp_train_step_matches_single_device():
                                    atol=2e-4, err_msg=str(path))
 
 
+@pytest.mark.slow
+def test_pp_train_step_grad_rounding_sr():
+    """SR through the pp stepper (round 4): deterministic given seed,
+    seed-sensitive, finite — and the pp-replicated leaves (embedding)
+    stay bitwise consistent across pp copies after the SR dp-reduce
+    (a divergence would poison step 2)."""
+    pp, dp = 2, 4
+    mesh = make_mesh(pp=pp, dp=dp)
+    model = _lm()
+    tokens = _tokens(b=16, t=16, seed=5)
+    targets = _tokens(b=16, t=16, seed=6)
+    variables = model.init(jax.random.PRNGKey(1), tokens[:2])
+    pp_model = _lm(pp_axis="pp", pp_size=pp)
+    tx = make_optimizer("sgd", lambda s: jnp.float32(0.1))
+    state = TrainState(step=jnp.zeros([], jnp.int32),
+                       params=variables["params"], batch_stats={},
+                       opt_state=tx.init(variables["params"]))
+    sharded_state = jax.device_put(
+        state, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            pp_state_specs(state)))
+
+    def run(seed):
+        step = make_pp_train_step(pp_model, tx, mesh, n_microbatches=4,
+                                  use_aps=True, grad_exp=4, grad_man=3,
+                                  grad_rounding="stochastic",
+                                  grad_seed=seed, donate=False)
+        s, m = step(sharded_state, tokens, targets)
+        s, m = step(s, tokens, targets)   # step 2 surfaces divergence
+        return s, float(m["loss"])
+
+    s1, l1 = run(0)
+    s1b, l1b = run(0)
+    assert np.isfinite(l1)
+    assert l1 == l1b
+    for a, b in zip(jax.tree.leaves(s1.params),
+                    jax.tree.leaves(s1b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _, l2 = run(1)
+    assert l1 != l2
+
+
 def test_pp_eval_step_matches_sequential():
     import optax
     from cpd_tpu.train.pp import make_pp_eval_step
