@@ -97,7 +97,10 @@ class Block(nn.Module):
                                 # kernel for the non-decode single-
                                 # sequence path (O(T) memory; MHA only);
                                 # hardware-validated by
-                                # tools/pallas_check.py
+                                # tools/pallas_check.py.  "chunked" =
+                                # pure-XLA online-softmax K/V-block scan
+                                # (flash's memory shape, any backend,
+                                # GQA-native; ops/attention.py)
 
     def _psum_tp(self, x):
         return lax.psum(x, self.tp_axis) if self.tp_axis else x
@@ -191,9 +194,9 @@ class Block(nn.Module):
         if not self.causal and (self.decode or self.sp_axis):
             raise ValueError("causal=False (bidirectional encoder) does "
                              "not compose with decode or sp paths")
-        if self.attn_impl not in ("xla", "flash"):
+        if self.attn_impl not in ("xla", "flash", "chunked"):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}; "
-                             "expected 'xla' or 'flash'")
+                             "expected 'xla', 'flash' or 'chunked'")
         if (self.attn_impl == "flash" and self.sp_axis
                 and self.sp_mode == "ring"):
             raise ValueError("attn_impl='flash' does not compose with "

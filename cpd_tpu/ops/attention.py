@@ -75,12 +75,20 @@ def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     materialized (T, T) score matrix.  Explicit opt-in, not autodetected:
     the kernel has TPU-generation/shape constraints (sequence multiples
     of the block size, supported head dims) that should fail loudly at
-    the call site, not silently downgrade mid-training."""
+    the call site, not silently downgrade mid-training.
+
+    impl="chunked" is the pure-XLA flash-style fallback: an online-
+    softmax `lax.scan` over K/V blocks — same O(T·block) memory shape as
+    flash without the Pallas constraints, any backend, offsets
+    supported.  Use when the Pallas kernel's shape rules bite (or off
+    TPU); ~the same FLOPs as "xla", traded against score-matrix HBM."""
     if impl == "flash":
         return _flash_attention(q, k, v, causal, q_offset, k_offset)
+    if impl == "chunked":
+        return _chunked_attention(q, k, v, causal, q_offset, k_offset)
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}; "
-                         "expected 'xla' or 'flash'")
+                         "expected 'xla', 'flash' or 'chunked'")
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -109,6 +117,81 @@ def _flash_attention(q, k, v, causal, q_offset, k_offset):
     out = flash_attention(qt, kt, vt, causal=causal,
                           sm_scale=1.0 / float(q.shape[-1]) ** 0.5)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+_CHUNK = 512  # K/V block length of the chunked scan (MXU-friendly, and
+              # small enough that (B,H,Tq,_CHUNK) fp32 logits stay modest)
+
+
+def _chunked_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                       causal, q_offset, k_offset,
+                       block: int = _CHUNK) -> jnp.ndarray:
+    """Flash-style attention in pure XLA: online softmax over K/V blocks.
+
+    Supports GQA natively — q (B, Tq, H, D) against k/v (B, Tk, H_kv, D)
+    with H_kv | H — via the same grouped contraction as
+    `grouped_query_attention`, so no expansion is materialized either.
+    Peak score memory is (B, H, Tq, block) instead of (B, H, Tq, Tk) —
+    in the BACKWARD pass too: the scan body is `jax.checkpoint`ed, so AD
+    stores only the per-block (o, m, l) carries (O(Tq·D) each, smaller
+    than a block of scores whenever D < block) and recomputes the block
+    softmax in the reverse sweep, the flash-backward recipe.  Tk is
+    padded to a block multiple (block itself is clamped to ~Tk rounded
+    up to the 128-lane width, so short sequences don't pay for a full
+    default block of masked pad); pad keys are masked out by their
+    global position, so results match the one-shot softmax to fp32
+    round-off (same recurrence as `ring_attention`'s fold).
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    hkv = k.shape[2]
+    rep = _gqa_rep(q, k)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    qg = q.reshape(b, tq, hkv, rep, d)
+
+    block = min(block, max(128, -(-tk // 128) * 128))
+    n_blocks = -(-tk // block)
+    pad = n_blocks * block - tk
+    kp = jnp.pad(k.astype(q.dtype), ((0, 0), (0, pad), (0, 0), (0, 0)))
+    vp = jnp.pad(v.astype(q.dtype), ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # (N, B, block, H_kv, D) — scan carries one block at a time
+    kb = kp.reshape(b, n_blocks, block, hkv, d).transpose(1, 0, 2, 3, 4)
+    vb = vp.reshape(b, n_blocks, block, hkv, d).transpose(1, 0, 2, 3, 4)
+    qi = q_offset + jnp.arange(tq)[:, None]            # (tq, 1)
+
+    def step(carry, xs):
+        o, m, l, i = carry
+        k_cur, v_cur = xs
+        logits = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k_cur,
+            preferred_element_type=jnp.float32).reshape(
+                b, h, tq, block) * scale
+        ki = k_offset + i * block + jnp.arange(block)[None, :]
+        valid = (ki - k_offset) < tk                   # pad keys out
+        if causal:
+            valid = valid & (qi >= ki)
+        logits = jnp.where(valid[None, None], logits, _NEG_INF)
+
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum(
+            "bgrqk,bkgd->bqgrd",
+            p.astype(v_cur.dtype).reshape(b, hkv, rep, tq, block),
+            v_cur, preferred_element_type=jnp.float32).reshape(
+                b, tq, h, v_cur.shape[-1])
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+        return (o_new, m_new, l_new, i + 1), None
+
+    o0 = jnp.zeros((b, tq, h, v.shape[-1]), jnp.float32)
+    m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    (o, m, l, _), _ = lax.scan(
+        jax.checkpoint(step), (o0, m0, l0, jnp.zeros([], jnp.int32)),
+        (kb, vb))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
 
 
 def _gqa_rep(q: jnp.ndarray, k: jnp.ndarray) -> int:
@@ -201,13 +284,17 @@ def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
 
     impl="flash" (MHA only — the Pallas kernel takes uniform heads)
     routes to the TPU flash-attention kernel; hardware-validated by
-    tools/pallas_check.py.
+    tools/pallas_check.py.  impl="chunked" runs the grouped contraction
+    through the online-softmax K/V-block scan (`_chunked_attention`) —
+    GQA-native, O(Tq·block) score memory, any backend.
     """
     b, tq, h, d = q.shape
     hkv = k.shape[2]
     if impl == "flash" and h != hkv:
         raise ValueError("impl='flash' supports MHA only (uniform heads); "
                          "unset n_kv_heads or use impl='xla'")
+    if impl == "chunked":
+        return _chunked_attention(q, k, v, causal, q_offset, 0)
     if h == hkv:
         return local_attention(q, k, v, causal=causal, q_offset=q_offset,
                                impl=impl)

@@ -110,9 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference emulation, the API default); fast = "
                         "cast-and-dot")
     p.add_argument("--attn-impl", default="xla",
-                   choices=["xla", "flash"],
+                   choices=["xla", "flash", "chunked"],
                    help="flash = Pallas TPU flash-attention kernel "
-                        "(MHA, non-decode; O(T) memory)")
+                        "(MHA, non-decode; O(T) memory); chunked = "
+                        "pure-XLA online-softmax K/V-block scan (flash's "
+                        "memory shape on any backend, GQA-native)")
     p.add_argument("--bf16", action="store_true",
                    help="bf16 compute dtype (fp32 master params; the "
                         "MXU-native precision — --half analog of the "
@@ -220,9 +222,13 @@ def main(argv=None) -> dict:
         if args.pp > 1 or args.moe:
             raise ValueError("--attn-impl applies to the default "
                              "dp/sp/tp TransformerLM path only")
-        if args.n_kv_heads is not None:
+        if args.n_kv_heads is not None and args.attn_impl == "flash":
+            # GQA: ops/attention routes flash via post-collective
+            # expansion only under ulysses; the plain single-sequence
+            # path keeps the loud MHA-only contract.  chunked is
+            # GQA-native.
             raise ValueError("--attn-impl flash is MHA-only; unset "
-                             "--n-kv-heads")
+                             "--n-kv-heads or use --attn-impl chunked")
         model_kw.update(attn_impl=args.attn_impl)
     if (args.ffn_exp, args.ffn_man) != (8, 23):
         if args.pp > 1 or args.moe:
