@@ -215,7 +215,12 @@ class Block(nn.Module):
                 attn = ulysses_attention(q, k, v, self.sp_axis,
                                          causal=True, impl=self.attn_impl)
             else:
-                attn = ring_attention(q, k, v, self.sp_axis, causal=True)
+                # ring accepts impl='chunked' (inner sub-block fold, for
+                # T_local >> block); 'flash' was rejected above
+                attn = ring_attention(q, k, v, self.sp_axis, causal=True,
+                                      impl=("chunked"
+                                            if self.attn_impl == "chunked"
+                                            else "xla"))
         else:
             attn = grouped_query_attention(q, k, v, causal=self.causal,
                                            impl=self.attn_impl)

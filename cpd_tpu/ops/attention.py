@@ -123,6 +123,33 @@ _CHUNK = 512  # K/V block length of the chunked scan (MXU-friendly, and
               # small enough that (B,H,Tq,_CHUNK) fp32 logits stay modest)
 
 
+def _fold_segment(o, m, l, qg, k_cur, v_cur, valid, scale):
+    """One online-softmax fold: merge a K/V segment into the (o, m, l)
+    accumulator — the flash recurrence, shared verbatim by the chunked
+    scan, the ring per-step fold, and the ring's chunked inner loop.
+
+    qg: (B, Tq, H_kv, rep, D) grouped queries (GQA-native contraction);
+    k_cur/v_cur: (B, S, H_kv, D); valid: (Tq, S) bool mask or None."""
+    b, tq, hkv, rep, d = qg.shape
+    h = hkv * rep
+    s = k_cur.shape[1]
+    logits = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, k_cur,
+        preferred_element_type=jnp.float32).reshape(b, h, tq, s) * scale
+    if valid is not None:
+        logits = jnp.where(valid[None, None], logits, _NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))          # (B,H,Tq)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None])               # (B,H,Tq,S)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum(
+        "bgrqk,bkgd->bqgrd",
+        p.astype(v_cur.dtype).reshape(b, hkv, rep, tq, s),
+        v_cur, preferred_element_type=jnp.float32).reshape(
+            b, tq, h, v_cur.shape[-1])
+    return o * alpha.transpose(0, 2, 1)[..., None] + pv, m_new, l_new
+
+
 def _chunked_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                        causal, q_offset, k_offset,
                        block: int = _CHUNK) -> jnp.ndarray:
@@ -162,27 +189,12 @@ def _chunked_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def step(carry, xs):
         o, m, l, i = carry
         k_cur, v_cur = xs
-        logits = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", qg, k_cur,
-            preferred_element_type=jnp.float32).reshape(
-                b, h, tq, block) * scale
         ki = k_offset + i * block + jnp.arange(block)[None, :]
         valid = (ki - k_offset) < tk                   # pad keys out
         if causal:
             valid = valid & (qi >= ki)
-        logits = jnp.where(valid[None, None], logits, _NEG_INF)
-
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new[..., None])
-        l_new = l * alpha + p.sum(axis=-1)
-        pv = jnp.einsum(
-            "bgrqk,bkgd->bqgrd",
-            p.astype(v_cur.dtype).reshape(b, hkv, rep, tq, block),
-            v_cur, preferred_element_type=jnp.float32).reshape(
-                b, tq, h, v_cur.shape[-1])
-        o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
-        return (o_new, m_new, l_new, i + 1), None
+        o, m, l = _fold_segment(o, m, l, qg, k_cur, v_cur, valid, scale)
+        return (o, m, l, i + 1), None
 
     o0 = jnp.zeros((b, tq, h, v.shape[-1]), jnp.float32)
     m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
@@ -203,7 +215,8 @@ def _gqa_rep(q: jnp.ndarray, k: jnp.ndarray) -> int:
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str, causal: bool = True) -> jnp.ndarray:
+                   axis_name: str, causal: bool = True,
+                   impl: str = "xla", block: int = _CHUNK) -> jnp.ndarray:
     """Sequence-parallel attention; call inside shard_map with the sequence
     dim sharded over `axis_name`.
 
@@ -215,7 +228,18 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     bitwise).  Returns (B, T_local, H, D).  Differentiable (ppermute
     transposes to the reverse permute, so the backward pass is itself a
     ring).
+
+    impl="chunked" folds each received K/V block through an inner
+    checkpointed sub-block scan (the same `_fold_segment` recurrence):
+    per-step score memory drops from (B, H, T_local, T_local) to
+    (B, H, T_local, block) — forward and backward — which is what keeps
+    very long per-device shards (T_local ≫ block) inside HBM.  Requires
+    block | T_local (else the inner loop degrades to one whole-block
+    fold, identical to "xla").
     """
+    if impl not in ("xla", "chunked"):
+        raise ValueError(f"unknown ring impl {impl!r}; "
+                         "expected 'xla' or 'chunked'")
     axis_size = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
@@ -226,33 +250,41 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # grouped layout: head index h == g*rep + r, so reshaping (H,) to
     # (H_kv, rep) keeps kv head g serving q heads [g*rep, (g+1)*rep)
     qg = q.reshape(b, t_local, hkv, rep, d)
+    if impl == "chunked" and t_local % block == 0 and t_local > block:
+        n_inner = t_local // block
+    else:
+        n_inner, block = 1, t_local
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    qi = q_off + jnp.arange(t_local)[:, None]
 
     def step(carry, s):
         o, m, l, k_cur, v_cur = carry
         src = (my - s) % axis_size           # whose K/V block we hold
         k_off = src * t_local
 
-        logits = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", qg, k_cur,
-            preferred_element_type=jnp.float32).reshape(
-                b, h, t_local, t_local) * scale
-        if causal:
-            mask = _causal_mask(t_local, t_local, q_off, k_off)
-            logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        def fold(inner_carry, xs):
+            o_i, m_i, l_i, j = inner_carry
+            k_seg, v_seg = xs
+            valid = None
+            if causal:
+                ki = k_off + j * block + jnp.arange(block)[None, :]
+                valid = qi >= ki
+            o_i, m_i, l_i = _fold_segment(o_i, m_i, l_i, qg, k_seg,
+                                          v_seg, valid, scale)
+            return (o_i, m_i, l_i, j + 1), None
 
-        # online softmax update (flash-attention recurrence)
-        m_new = jnp.maximum(m, logits.max(axis=-1))          # (B,H,Tq)
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new[..., None])               # (B,H,Tq,Tk)
-        l_new = l * alpha + p.sum(axis=-1)
-        pv = jnp.einsum(
-            "bgrqk,bkgd->bqgrd",
-            p.astype(v_cur.dtype).reshape(b, hkv, rep, t_local, t_local),
-            v_cur, preferred_element_type=jnp.float32).reshape(
-                b, t_local, h, v_cur.shape[-1])
-        o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+        if n_inner == 1:
+            (o_new, m_new, l_new, _), _ = fold(
+                (o, m, l, jnp.zeros([], jnp.int32)), (k_cur, v_cur))
+        else:
+            ks = k_cur.reshape(b, n_inner, block, hkv, d).transpose(
+                1, 0, 2, 3, 4)
+            vs = v_cur.reshape(b, n_inner, block, hkv, d).transpose(
+                1, 0, 2, 3, 4)
+            (o_new, m_new, l_new, _), _ = lax.scan(
+                jax.checkpoint(fold),
+                (o, m, l, jnp.zeros([], jnp.int32)), (ks, vs))
 
         # rotate K/V to the next rank (skip after the last fold: the scan
         # body is uniform, so we permute every step; the final permute
