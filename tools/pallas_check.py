@@ -141,6 +141,34 @@ def main() -> int:
     else:
         print("flash attention: SKIPPED (needs TPU)", flush=True)
 
+    # 5. chunked attention (any backend; on TPU this cross-checks the
+    # pure-XLA online-softmax scan against BOTH references on silicon —
+    # uniform and GQA heads)
+    from cpd_tpu.ops.attention import (_chunked_attention,
+                                       grouped_query_attention)
+
+    ch_before = len(failures)
+    for hkv in (4, 2):
+        q = jnp.asarray(rng.randn(2, 256, 4, 64).astype(np.float32))
+        kk = jnp.asarray(rng.randn(2, 256, hkv, 64).astype(np.float32))
+        v = jnp.asarray(rng.randn(2, 256, hkv, 64).astype(np.float32))
+        ref = np.asarray(grouped_query_attention(q, kk, v, causal=True))
+        chk = np.asarray(_chunked_attention(q, kk, v, True, 0, 0,
+                                            block=128))
+        if not np.allclose(ref, chk, atol=2e-4, rtol=2e-4):
+            failures.append(
+                f"chunked hkv={hkv} maxdiff={np.max(np.abs(ref - chk))}")
+        if on_tpu and hkv == 4:
+            from cpd_tpu.ops.attention import local_attention
+            fla = np.asarray(local_attention(q, kk, v, causal=True,
+                                             impl="flash"))
+            if not np.allclose(fla, chk, atol=2e-2, rtol=2e-2):
+                failures.append(
+                    f"chunked-vs-flash maxdiff={np.max(np.abs(fla - chk))}")
+    print("chunked attention:",
+          "OK" if len(failures) == ch_before else failures[ch_before:],
+          flush=True)
+
     if failures:
         print("FAIL:", failures)
         return 1
