@@ -353,6 +353,20 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 imgs_per_call=lm_bs * n_dev * seq)
             partial["lm_train_tok_per_sec_per_chip"] = round(
                 tok_rate / n_dev, 1)
+            # chunked attention (round 4): same model/step with the
+            # online-softmax K/V-block scan — the silicon cost of the
+            # O(T·block) score-memory path vs the one-shot softmax
+            if time.monotonic() < budget_end - 90:
+                lm_c = transformer_lm(**lm_kw, dtype=jnp.bfloat16,
+                                      attn_impl="chunked")
+                step_c = make_lm_train_step(lm_c, lm_tx, mesh,
+                                            use_aps=True, grad_exp=5,
+                                            grad_man=2, donate=False)
+                rate_c, _, _ = _measure(
+                    jax, step_c, lm_state, toks, tgts, 12, windows=3,
+                    imgs_per_call=lm_bs * n_dev * seq)
+                partial["lm_chunked_tok_per_sec_per_chip"] = round(
+                    rate_c / n_dev, 1)
         except Exception as e:  # noqa: BLE001 — extras must not kill the run
             partial["lm_note"] = f"lm extra skipped: {type(e).__name__}: {e}"
 

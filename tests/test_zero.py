@@ -242,18 +242,22 @@ def test_zero2_reduce_scatter_bitwise_sr(use_aps, kahan):
     assert np.any(flat_ref != flat_rtne)
 
 
-def test_zero2_sr_train_step_end_to_end():
+@pytest.mark.parametrize("emulate", [1, 2])
+def test_zero2_sr_train_step_end_to_end(emulate):
     """make_train_step(grad_rounding='stochastic', reduce_in_update=True)
     — rejected until round 3 — now trains, matches the replicated SR step
     (grads bitwise; update arithmetic differs by last-ulp flat-vs-leaf
-    order), and stays seed-deterministic."""
+    order), and stays seed-deterministic.  emulate=2 additionally runs
+    the rank-local SR emulate-node reduce ahead of the sharded
+    reduce-scatter (identical in both paths by construction)."""
     mesh = data_parallel_mesh()
     w = mesh.devices.size
     model = tiny_cnn()
     schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
-    x, y = _data(16, seed=21)
+    x, y = _data(16 * emulate, seed=21)
     quant = dict(use_aps=True, grad_exp=4, grad_man=3,
-                 grad_rounding="stochastic", grad_seed=7)
+                 grad_rounding="stochastic", grad_seed=7,
+                 emulate_node=emulate)
 
     tx = make_optimizer("sgd", schedule, momentum=0.9, weight_decay=1e-2)
     state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
