@@ -352,6 +352,64 @@ def test_zero3_matches_replicated_faithful():
         assert shard_shapes == {(s_per_rank,)}
 
 
+@pytest.mark.slow
+def test_zero3_sr_lm_fsdp():
+    """FSDP-style LM training: a transformer LM through the generic
+    make_train_step with ZeRO-3 params-at-rest sharding AND stochastic
+    rounding on the pure-dp mesh — the large-LM data-parallel recipe —
+    matches the replicated SR step (grads bitwise; update arithmetic
+    last-ulp) and keeps params/momentum sharded 1/W."""
+    from cpd_tpu.models import transformer_lm
+    from cpd_tpu.parallel.zero import zero3_sgd
+
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = transformer_lm(vocab_size=64, d_model=32, n_layers=2,
+                           n_heads=4, d_ff=64)
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    rng = np.random.RandomState(31)
+    toks = jnp.asarray(rng.randint(0, 64, (16, 16)).astype(np.int32))
+    tgts = jnp.asarray(np.roll(np.asarray(toks), -1, axis=1))
+    quant = dict(use_aps=True, grad_exp=4, grad_man=3,
+                 grad_rounding="stochastic", grad_seed=3)
+
+    tx = make_optimizer("sgd", schedule, momentum=0.9)
+    state = create_train_state(model, tx, toks[:2], jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, donate=False, mode="faithful",
+                           **quant)
+    s_ref = state
+    for _ in range(2):
+        s_ref, m_ref = step(s_ref, toks, tgts)
+
+    z = zero3_sgd(schedule, world=w, template=state.params, momentum=0.9)
+    z_state = z.make_state(state, mesh)
+    z_step = make_train_step(model, None, mesh, donate=False,
+                             update_fn=z.update_fn,
+                             opt_state_spec=z.state_spec(),
+                             params_spec=z.param_spec(),
+                             unpack_params=z.unpack,
+                             reduce_in_update=True, **quant)
+    s_z = z_state
+    for _ in range(2):
+        s_z, m_z = z_step(s_z, toks, tgts)
+
+    np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
+                               rtol=1e-6)
+    got = z.to_pytree(jnp.asarray(np.asarray(s_z.params)))
+    for (path, g), (_, want) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.tree.map(np.asarray, got))[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.tree.map(np.asarray, s_ref.params))[0]):
+        np.testing.assert_allclose(np.asarray(g), want, rtol=1e-6,
+                                   atol=1e-7, err_msg=str(path))
+    for arr in (s_z.params, s_z.opt_state.momentum):
+        shard_shapes = {tuple(sh.data.shape)
+                        for sh in arr.addressable_shards}
+        assert len(shard_shapes) == 1 and all(
+            s[0] * w == arr.shape[0] for s in shard_shapes)
+
+
 def test_zero3_checkpoint_portable_across_world(tmp_path):
     """export_state's portable layout (pytree params, pad-trimmed
     momentum) restores at a DIFFERENT world size and keeps training."""
