@@ -25,6 +25,30 @@ def _data(batch, seed=0):
     return jnp.asarray(x), jnp.asarray(y)
 
 
+def _assert_params_close(got_tree, want_tree, rtol=1e-6, atol=1e-7):
+    """Leaf-by-leaf comparison with path-labelled failures — the shared
+    replicated-vs-ZeRO oracle check (update arithmetic differs by
+    last-ulp flat-vs-leaf op order, hence the tolerance)."""
+    got = jax.tree_util.tree_flatten_with_path(
+        jax.tree.map(np.asarray, got_tree))[0]
+    want = jax.tree_util.tree_flatten_with_path(
+        jax.tree.map(np.asarray, want_tree))[0]
+    assert len(got) == len(want)
+    for (path, g), (_, w) in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=rtol, atol=atol,
+                                   err_msg=str(path))
+
+
+def _assert_sharded_1w(arr, n_params: int, w: int):
+    """Every device holds exactly the ceil(n_params/W)-sized shard of a
+    flat (W*S,) array — S derived from the true param count, so a
+    self-consistently inflated _shard_size would fail here."""
+    s_per_rank = -(-n_params // w)
+    assert arr.shape == (w * s_per_rank,)
+    shard_shapes = {tuple(sh.data.shape) for sh in arr.addressable_shards}
+    assert shard_shapes == {(s_per_rank,)}
+
+
 def test_zero1_matches_replicated_sgd():
     mesh = data_parallel_mesh()
     w = mesh.devices.size
@@ -60,21 +84,11 @@ def test_zero1_matches_replicated_sgd():
 
     np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
                                rtol=1e-6)
-    for (path, got), (_, want) in zip(
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, s_z.params))[0],
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, s_ref.params))[0]):
-        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
-                                   err_msg=str(path))
+    _assert_params_close(s_z.params, s_ref.params)
 
     # the momentum buffer is genuinely sharded: one (S,) shard per device
     n_params = sum(l.size for l in jax.tree.leaves(state.params))
-    s_per_rank = -(-n_params // w)
-    assert s_z.opt_state.momentum.shape == (w * s_per_rank,)
-    shard_shapes = {tuple(sh.data.shape)
-                    for sh in s_z.opt_state.momentum.addressable_shards}
-    assert shard_shapes == {(s_per_rank,)}
+    _assert_sharded_1w(s_z.opt_state.momentum, n_params, w)
 
 
 def test_zero1_quantized_path():
@@ -140,20 +154,11 @@ def test_zero2_matches_replicated_faithful(exp, man, kahan):
 
     np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
                                rtol=1e-6)
-    for (path, got), (_, want) in zip(
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, s_z.params))[0],
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, s_ref.params))[0]):
-        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
-                                   err_msg=str(path))
+    _assert_params_close(s_z.params, s_ref.params)
 
     # momentum genuinely sharded
     n_params = sum(l.size for l in jax.tree.leaves(state.params))
-    s_per_rank = -(-n_params // w)
-    shard_shapes = {tuple(sh.data.shape)
-                    for sh in s_z.opt_state.momentum.addressable_shards}
-    assert shard_shapes == {(s_per_rank,)}
+    _assert_sharded_1w(s_z.opt_state.momentum, n_params, w)
 
 
 @pytest.mark.parametrize("exp,man,kahan", [(5, 2, False), (4, 3, True)])
@@ -283,13 +288,7 @@ def test_zero2_sr_train_step_end_to_end(emulate):
 
     np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
                                rtol=1e-6)
-    for (path, got), (_, want) in zip(
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, s_z.params))[0],
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, s_ref.params))[0]):
-        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
-                                   err_msg=str(path))
+    _assert_params_close(s_z.params, s_ref.params)
     # deterministic given seed
     s_z2 = z_state
     for _ in range(3):
@@ -334,22 +333,13 @@ def test_zero3_matches_replicated_faithful():
 
     np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
                                rtol=1e-6)
-    got = z.to_pytree(jnp.asarray(np.asarray(s_z.params)))
-    for (path, g), (_, want) in zip(
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, got))[0],
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, s_ref.params))[0]):
-        np.testing.assert_allclose(np.asarray(g), want, rtol=1e-6,
-                                   atol=1e-7, err_msg=str(path))
+    _assert_params_close(z.to_pytree(jnp.asarray(np.asarray(s_z.params))),
+                         s_ref.params)
 
     # params and momentum genuinely sharded 1/W per device
     n_params = sum(l.size for l in jax.tree.leaves(state.params))
-    s_per_rank = -(-n_params // w)
     for arr in (s_z.params, s_z.opt_state.momentum):
-        shard_shapes = {tuple(sh.data.shape)
-                        for sh in arr.addressable_shards}
-        assert shard_shapes == {(s_per_rank,)}
+        _assert_sharded_1w(arr, n_params, w)
 
 
 @pytest.mark.slow
@@ -357,8 +347,10 @@ def test_zero3_sr_lm_fsdp():
     """FSDP-style LM training: a transformer LM through the generic
     make_train_step with ZeRO-3 params-at-rest sharding AND stochastic
     rounding on the pure-dp mesh — the large-LM data-parallel recipe —
-    matches the replicated SR step (grads bitwise; update arithmetic
-    last-ulp) and keeps params/momentum sharded 1/W."""
+    matches the replicated SR step end-to-end (loss + params to
+    last-ulp; the reduction's shard==replicated-slice BITWISE property
+    itself is pinned by test_zero2_reduce_scatter_bitwise_sr) and keeps
+    params/momentum sharded 1/W."""
     from cpd_tpu.models import transformer_lm
     from cpd_tpu.parallel.zero import zero3_sgd
 
@@ -395,19 +387,11 @@ def test_zero3_sr_lm_fsdp():
 
     np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
                                rtol=1e-6)
-    got = z.to_pytree(jnp.asarray(np.asarray(s_z.params)))
-    for (path, g), (_, want) in zip(
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, got))[0],
-            jax.tree_util.tree_flatten_with_path(
-                jax.tree.map(np.asarray, s_ref.params))[0]):
-        np.testing.assert_allclose(np.asarray(g), want, rtol=1e-6,
-                                   atol=1e-7, err_msg=str(path))
+    _assert_params_close(z.to_pytree(jnp.asarray(np.asarray(s_z.params))),
+                         s_ref.params)
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
     for arr in (s_z.params, s_z.opt_state.momentum):
-        shard_shapes = {tuple(sh.data.shape)
-                        for sh in arr.addressable_shards}
-        assert len(shard_shapes) == 1 and all(
-            s[0] * w == arr.shape[0] for s in shard_shapes)
+        _assert_sharded_1w(arr, n_params, w)
 
 
 def test_zero3_checkpoint_portable_across_world(tmp_path):
