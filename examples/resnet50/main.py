@@ -327,7 +327,22 @@ def main(argv=None) -> dict:
             train_loss = train_acc = 0.0
             epoch_start = start_it if epoch == start_epoch else 0
             n_done = 0
-            for it in range(epoch_start, iters_per_epoch):
+            def produced(epoch=epoch, epoch_start=epoch_start, order=order):
+                # host-side batch prep (the augmentation runs in the
+                # native threaded executor) on a background thread, two
+                # steps ahead of the device — the torch-DataLoader-worker
+                # analog (main.py:111-120), same recipe as the CIFAR
+                # trainer
+                for i in range(epoch_start, iters_per_epoch):
+                    idx = order[i * host_batch:(i + 1) * host_batch]
+                    bx, by = train_ds.batch(idx, seed=epoch)
+                    yield (host_batch_to_global(bx.astype(np.float32),
+                                                mesh),
+                           host_batch_to_global(by, mesh))
+
+            from cpd_tpu.utils.prefetch import Prefetcher
+            batches = Prefetcher(produced(), depth=2)
+            for it, (gx, gy) in enumerate(batches, start=epoch_start):
                 if guard.should_stop():      # collective when multi-host
                     preempt_save(
                         manager, state.step, to_ckpt(state), rank,
@@ -339,20 +354,17 @@ def main(argv=None) -> dict:
                     if rank == 0:
                         print(f"   (epoch {epoch} iter {it})")
                     preempted = True
+                    batches.close()
                     break
                 global_it += 1
                 profiler.step(global_it)
-                idx = order[it * host_batch:(it + 1) * host_batch]
-                x, y = train_ds.batch(idx, seed=epoch)
-                state, m = train_step(
-                    state,
-                    host_batch_to_global(x.astype(np.float32), mesh),
-                    host_batch_to_global(y, mesh))
+                state, m = train_step(state, gx, gy)
                 step_loss = float(m["loss"])
                 if loss_diverged(step_loss, f"epoch {epoch} iter {it}",
                                  rank, hint="try --use-APS / more "
                                             "mantissa bits"):
                     diverged = True
+                    batches.close()
                     break
                 train_loss += step_loss
                 train_acc += float(m["accuracy"])
