@@ -142,17 +142,28 @@ def main(argv=None) -> dict:
         order = rng.permutation(dataset_len)[:iters_per_epoch * global_batch]
         train_loss = train_acc = 0.0
         n = 0
-        for lo in range(0, len(order), global_batch):
+        def produced(order=order, epoch=epoch):
+            # batch prep (native threaded augmentation + device transfer)
+            # two steps ahead of the device (utils/prefetch.py) — matters
+            # most here: DAWNBench is a wall-clock speed run
+            for lo in range(0, len(order), global_batch):
+                sel = order[lo + rank * host_batch:
+                            lo + (rank + 1) * host_batch]
+                bx, by = pipeline.batch(sel, seed=epoch)
+                yield (host_batch_to_global(bx, mesh),
+                       host_batch_to_global(by, mesh))
+
+        from cpd_tpu.utils.prefetch import Prefetcher
+        batches = Prefetcher(produced(), depth=2)
+        for gx, gy in batches:
             global_step += 1
             profiler.step(global_step)
-            sel = order[lo + rank * host_batch:lo + (rank + 1) * host_batch]
-            x, y = pipeline.batch(sel, seed=epoch)
-            state, m = train_step(state, host_batch_to_global(x, mesh),
-                                  host_batch_to_global(y, mesh))
+            state, m = train_step(state, gx, gy)
             step_loss = float(m["loss"])
             if loss_diverged(step_loss, f"step {global_step}", rank,
                              hint="lower --loss_scale / try --use_APS"):
                 diverged = True
+                batches.close()
                 break
             train_loss += step_loss
             train_acc += float(m["accuracy"])

@@ -193,21 +193,32 @@ def main(argv=None) -> dict:
     preempted = diverged = False
     step_no = start_iter
     t0 = time.time()
+    def produced():
+        # random-crop batch prep two steps ahead of the device
+        # (utils/prefetch.py); the rng draws stay on this single
+        # producer thread, so the index sequence is unchanged
+        for i in range(start_iter + 1, args.max_iter + 1):
+            idx = rng.randint(0, len(ds), size=host_batch)
+            bx, by = ds.batch(idx, seed=i)
+            yield (host_batch_to_global(bx, mesh),
+                   host_batch_to_global(by, mesh))
+
+    from cpd_tpu.utils.prefetch import Prefetcher
+    batches = Prefetcher(produced(), depth=2)
     try:
-        for it in range(start_iter + 1, args.max_iter + 1):
+        for it, (gx, gy) in enumerate(batches, start=start_iter + 1):
             if guard.should_stop():      # collective when multi-host
                 preempt_save(manager, step_no, state, rank)
                 preempted = True
+                batches.close()
                 break
             profiler.step(it)
-            idx = rng.randint(0, len(ds), size=host_batch)
-            x, y = ds.batch(idx, seed=it)
-            state, m = step(state, host_batch_to_global(x, mesh),
-                            host_batch_to_global(y, mesh))
+            state, m = step(state, gx, gy)
             step_no = it
             last = {k: float(v) for k, v in m.items()}
             if loss_diverged(last["loss"], f"iter {it}", rank):
                 diverged = True
+                batches.close()
                 break
             progress.maybe_print(it, Loss=last["loss"],
                                  PixAcc=100 * last["accuracy"])
