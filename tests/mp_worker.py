@@ -104,6 +104,20 @@ def _train_step_phase(mesh, lo: int, hi: int) -> dict:
                       ("bnstat", state.batch_stats)):
         for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
             out[col + jax.tree_util.keystr(path)] = np.asarray(leaf)
+
+    # ---- stochastic-rounding step across the same boundary: the SR key
+    # schedule (grad_sr_key + in-program rank folds, never host identity)
+    # must make process boundaries invisible too — MULTIHOST.md's
+    # "multi-host-safe by construction" claim, executed ----
+    sr_state = create_train_state(model, tx, x[:1], jax.random.PRNGKey(3))
+    sr_step = make_train_step(model, tx, mesh, use_aps=True, grad_exp=4,
+                              grad_man=3, grad_rounding="stochastic",
+                              grad_seed=5, donate=False)
+    sr_state, sr_metrics = sr_step(sr_state, xg, yg)
+    out["sr_step_loss"] = np.asarray(sr_metrics["loss"])
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            sr_state.params)[0]:
+        out["srparam" + jax.tree_util.keystr(path)] = np.asarray(leaf)
     return out
 
 
