@@ -233,9 +233,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     checkpointed sub-block scan (the same `_fold_segment` recurrence):
     per-step score memory drops from (B, H, T_local, T_local) to
     (B, H, T_local, block) — forward and backward — which is what keeps
-    very long per-device shards (T_local ≫ block) inside HBM.  Requires
-    block | T_local (else the inner loop degrades to one whole-block
-    fold, identical to "xla").
+    very long per-device shards (T_local ≫ block) inside HBM.  When
+    block does not divide T_local, the largest divisor of T_local that
+    is ≤ block is used instead (the memory bound is preserved or
+    bettered, never silently dropped); a DEGENERATE split (divisor
+    < min(block, 128), e.g. prime T_local) raises rather than scanning
+    element-by-element or materializing the full block.
     """
     if impl not in ("xla", "chunked"):
         raise ValueError(f"unknown ring impl {impl!r}; "
@@ -250,7 +253,21 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # grouped layout: head index h == g*rep + r, so reshaping (H,) to
     # (H_kv, rep) keeps kv head g serving q heads [g*rep, (g+1)*rep)
     qg = q.reshape(b, t_local, hkv, rep, d)
-    if impl == "chunked" and t_local % block == 0 and t_local > block:
+    if impl == "chunked" and t_local > block:
+        # largest divisor of T_local <= block: the opted-into memory
+        # bound must hold, so never fall back to one whole-block fold
+        div = max(f for f in range(1, block + 1) if t_local % f == 0)
+        # refuse only when the REQUESTED block couldn't be honored and
+        # the best divisor is tiny (e.g. prime T_local -> div == 1); an
+        # explicit small block that divides exactly is always accepted
+        if div != block and div < max(8, block // 16):
+            raise ValueError(
+                f"ring impl='chunked' cannot split T_local={t_local} "
+                f"into sub-blocks <= {block}: largest divisor is {div} "
+                f"(degenerate).  Pick a per-device sequence length "
+                f"divisible by the block (multiples of 128 recommended) "
+                f"or pass an explicit block= that divides it")
+        block = div
         n_inner = t_local // block
     else:
         n_inner, block = 1, t_local

@@ -155,19 +155,22 @@ def main(argv=None) -> dict:
 
         from cpd_tpu.utils.prefetch import Prefetcher
         batches = Prefetcher(produced(), depth=2)
-        for gx, gy in batches:
-            global_step += 1
-            profiler.step(global_step)
-            state, m = train_step(state, gx, gy)
-            step_loss = float(m["loss"])
-            if loss_diverged(step_loss, f"step {global_step}", rank,
-                             hint="lower --loss_scale / try --use_APS"):
-                diverged = True
-                batches.close()
-                break
-            train_loss += step_loss
-            train_acc += float(m["accuracy"])
-            n += 1
+        try:
+            for gx, gy in batches:
+                global_step += 1
+                profiler.step(global_step)
+                state, m = train_step(state, gx, gy)
+                step_loss = float(m["loss"])
+                if loss_diverged(step_loss, f"step {global_step}", rank,
+                                 hint="lower --loss_scale / try "
+                                      "--use_APS"):
+                    diverged = True
+                    break
+                train_loss += step_loss
+                train_acc += float(m["accuracy"])
+                n += 1
+        finally:
+            batches.close()   # stop the producer on any exit path
         if diverged:
             break
         jax.block_until_ready(state.params)

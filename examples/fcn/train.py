@@ -230,6 +230,7 @@ def main(argv=None) -> dict:
                 manager.save(it, state)
     finally:
         guard.uninstall()
+        batches.close()   # stop the producer even on an exception path
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()

@@ -222,13 +222,17 @@ def main(argv=None) -> dict:
         if args.pp > 1 or args.moe:
             raise ValueError("--attn-impl applies to the default "
                              "dp/sp/tp TransformerLM path only")
-        if args.n_kv_heads is not None and args.attn_impl == "flash":
-            # GQA: ops/attention routes flash via post-collective
-            # expansion only under ulysses; the plain single-sequence
-            # path keeps the loud MHA-only contract.  chunked is
-            # GQA-native.
-            raise ValueError("--attn-impl flash is MHA-only; unset "
-                             "--n-kv-heads or use --attn-impl chunked")
+        if (args.n_kv_heads is not None and args.attn_impl == "flash"
+                and not (args.sp > 1 and args.sp_mode == "ulysses")):
+            # GQA+flash IS supported under ulysses (the K/V chunk is
+            # expanded post-collective, ops/attention.py); the plain
+            # single-sequence path keeps the loud MHA-only contract.
+            # chunked is GQA-native everywhere.
+            raise ValueError(
+                "--attn-impl flash with --n-kv-heads needs ulysses "
+                "sequence parallelism (--sp N --sp-mode ulysses, "
+                "post-collective expansion); elsewhere unset "
+                "--n-kv-heads or use --attn-impl chunked")
         model_kw.update(attn_impl=args.attn_impl)
     if (args.ffn_exp, args.ffn_man) != (8, 23):
         if args.pp > 1 or args.moe:

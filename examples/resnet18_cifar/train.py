@@ -322,8 +322,9 @@ def main(argv=None) -> dict:
     preempted = False
     diverged = False
     from cpd_tpu.utils.prefetch import Prefetcher
+    batches = Prefetcher(produced(), depth=2)
     try:
-        for gx, gy in Prefetcher(produced(), depth=2):
+        for gx, gy in batches:
             if guard.should_stop():      # collective when multi-host
                 preempt_save(manager, step_no, state, rank)
                 preempted = True
@@ -348,6 +349,7 @@ def main(argv=None) -> dict:
                 manager.save(step_no, state, best_metric=prec1)
     finally:
         guard.uninstall()
+        batches.close()   # stop the producer even on an exception path
     profiler.close()
     manager.wait()
     writer.close()

@@ -420,6 +420,8 @@ def main(argv=None) -> dict:
                                    "iters_per_epoch": iters_per_epoch})
     finally:
         guard.uninstall()
+        if "batches" in locals():
+            batches.close()   # stop the producer on any exception path
     profiler.close()
     manager.wait()
     manager.close()

@@ -323,6 +323,35 @@ class TestChunkedAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=5e-5, atol=5e-5)
 
+    def test_ring_chunked_divisor_and_degenerate(self):
+        """block ∤ T_local picks the largest divisor (memory bound kept,
+        never a silent whole-block fold); a degenerate split (prime
+        T_local) raises."""
+        from cpd_tpu.ops.attention import local_attention, ring_attention
+
+        rng = np.random.RandomState(36)
+        # T=96 over sp=2 -> T_local=48; block=32 ∤ 48 -> divisor 24
+        q, k, v = _rand_qkv(rng, b=1, t=96, h=2, d=8)
+        full = local_attention(q, k, v, causal=True)
+        mesh = make_mesh(sp=2, dp=1, devices=jax.devices()[:2])
+
+        def run(block, t_slice=96):
+            def body(ql, kl, vl):
+                return ring_attention(ql, kl, vl, "sp", causal=True,
+                                      impl="chunked", block=block)
+            return jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp"), check_vma=False))(
+                    q[:, :t_slice], k[:, :t_slice], v[:, :t_slice])
+
+        got = run(32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+        # T=94 -> T_local=47 (prime): degenerate, loud
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="degenerate"):
+            run(32, t_slice=94)
+
     def test_ulysses_chunked_gqa(self):
         from cpd_tpu.ops.attention import (grouped_query_attention,
                                            ulysses_attention)
