@@ -6,8 +6,8 @@
 # so the process does not outlive the round.
 set -u
 cd "$(dirname "$0")/.."
-INTERVAL="${WATCH_INTERVAL:-900}"
-MAX="${WATCH_MAX_TRIES:-40}"
+INTERVAL="${WATCH_INTERVAL:-420}"
+MAX="${WATCH_MAX_TRIES:-96}"
 for i in $(seq 1 "$MAX"); do
     echo "== tunnel_watch attempt $i/$MAX $(date -u +%FT%TZ)"
     if bash tools/recapture_tpu.sh; then
