@@ -4,5 +4,7 @@ implementations these are bit-identical to."""
 
 from .quantize import quantize_pallas, quantize_pallas_sr
 from .qgemm import qgemm_pallas
+from .flash_gqa import flash_gqa
 
-__all__ = ["quantize_pallas", "quantize_pallas_sr", "qgemm_pallas"]
+__all__ = ["quantize_pallas", "quantize_pallas_sr", "qgemm_pallas",
+           "flash_gqa"]

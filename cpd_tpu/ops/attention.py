@@ -53,6 +53,14 @@ __all__ = ["local_attention", "ring_attention", "ulysses_attention",
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
                   # when a full row is masked (the all-masked ring step)
 
+# Fully-masked query rows (a causal shard whose every key is in the
+# future, e.g. q_offset + Tq <= k_offset) return ZERO in every impl —
+# the flash-attention convention (round 5, ADVICE r4): the one-shot
+# softmax's uniform-average fallback and the online-softmax paths'
+# pad-key pollution both produced arbitrary, impl-dependent values for
+# rows with no attendable key; zero is the one answer all schedules
+# (one-shot, chunked, ring, Pallas flash_gqa) can agree on exactly.
+
 
 def _causal_mask(tq: int, tk: int, q_off, k_off) -> jnp.ndarray:
     """(tq, tk) bool mask: query global position >= key global position."""
@@ -92,12 +100,16 @@ def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    mask = None
     if causal:
         mask = _causal_mask(q.shape[1], k.shape[1], q_offset, k_offset)
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
+    if mask is not None:
+        # zero fully-masked rows (softmax fell back to a uniform average)
+        out = jnp.where(mask.any(-1)[None, :, None, None], out, 0.0)
     return out.astype(q.dtype)
 
 
@@ -141,6 +153,13 @@ def _fold_segment(o, m, l, qg, k_cur, v_cur, valid, scale):
     m_new = jnp.maximum(m, logits.max(axis=-1))          # (B,H,Tq)
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(logits - m_new[..., None])               # (B,H,Tq,S)
+    if valid is not None:
+        # explicit zero, not exp(_NEG_INF - m): when the whole row is
+        # still masked m_new == _NEG_INF and exp(0) == 1 would count
+        # every masked/pad key into l (ADVICE r4 — degenerate rows now
+        # yield l == 0 -> output 0, matching the one-shot path's zeroed
+        # fully-masked rows)
+        p = jnp.where(valid[None, None], p, 0.0)
     l_new = l * alpha + p.sum(axis=-1)
     pv = jnp.einsum(
         "bgrqk,bkgd->bqgrd",
@@ -331,17 +350,24 @@ def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
     bitwise-close against that oracle.  rep == 1 falls through to
     `local_attention` itself.
 
-    impl="flash" (MHA only — the Pallas kernel takes uniform heads)
-    routes to the TPU flash-attention kernel; hardware-validated by
-    tools/pallas_check.py.  impl="chunked" runs the grouped contraction
-    through the online-softmax K/V-block scan (`_chunked_attention`) —
-    GQA-native, O(Tq·block) score memory, any backend.
+    impl="flash" routes MHA (H == H_kv) to the stock TPU flash-attention
+    kernel and GQA to the in-repo GQA-native Pallas kernel
+    (`ops/flash_gqa.py`, round 5) which consumes the unexpanded K/V
+    directly; both hardware-validated by tools/pallas_check.py.
+    impl="chunked" runs the grouped contraction through the
+    online-softmax K/V-block scan (`_chunked_attention`) — GQA-native,
+    O(Tq·block) score memory, any backend.
     """
     b, tq, h, d = q.shape
     hkv = k.shape[2]
     if impl == "flash" and h != hkv:
-        raise ValueError("impl='flash' supports MHA only (uniform heads); "
-                         "unset n_kv_heads or use impl='xla'")
+        # GQA-native Pallas kernel (round 5): grouped queries against the
+        # UNEXPANDED K/V — nothing rep-sized is materialized in HBM
+        if q_offset != 0:
+            raise ValueError("impl='flash' does not support q offsets; "
+                             "use the default impl inside ring steps")
+        from .flash_gqa import flash_gqa
+        return flash_gqa(q, k, v, causal)
     if impl == "chunked":
         return _chunked_attention(q, k, v, causal, q_offset, 0)
     if h == hkv:
@@ -354,12 +380,15 @@ def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
     qg = q.reshape(b, tq, hkv, rep, d)
     logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k,
                         preferred_element_type=jnp.float32) * scale
+    mask = None
     if causal:
         mask = _causal_mask(tq, k.shape[1], q_offset, 0)
         logits = jnp.where(mask[None, None, None], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
+    if mask is not None:
+        out = jnp.where(mask.any(-1)[None, :, None, None, None], out, 0.0)
     return out.reshape(b, tq, h, d).astype(q.dtype)
 
 
@@ -385,10 +414,11 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     case e = rep, the fully-expanded legacy behavior).
 
     ``impl`` is forwarded to the full-sequence middle step ("flash" =
-    Pallas kernel on the gathered sequence).  The flash kernel takes
-    uniform heads, so with GQA the K/V chunk is expanded AFTER the
-    all_to_all — device-local HBM, not ICI, pays the rep×, keeping the
-    wire win while staying flash-compatible.
+    Pallas kernel on the gathered sequence).  With GQA the middle step
+    runs the GQA-native flash kernel (`ops/flash_gqa.py`) directly on the
+    unexpanded K/V chunk — since round 5 neither the wire NOR device-local
+    HBM pays the rep× (the pre-round-5 path re-materialized the expansion
+    after the all_to_all).
     """
     axis_size = lax.psum(1, axis_name)
     rep = _gqa_rep(q, k)
@@ -414,12 +444,5 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    if impl == "flash" and kh.shape[2] != qh.shape[2]:
-        # post-collective local expansion: the Pallas kernel wants
-        # uniform heads; the chunk alignment note above guarantees
-        # qh head i is served by kh head i // (local rep)
-        local_rep = qh.shape[2] // kh.shape[2]
-        kh = jnp.repeat(kh, local_rep, axis=2)
-        vh = jnp.repeat(vh, local_rep, axis=2)
     out = grouped_query_attention(qh, kh, vh, causal=causal, impl=impl)
     return heads_to_seq(out)

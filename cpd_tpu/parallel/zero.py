@@ -99,8 +99,13 @@ class _Zero1:
         r2).  Elements past the last leaf (flat padding) get `pad`."""
         leaves = jax.tree.leaves(template)
         ends = np.cumsum([l.size for l in leaves])  # static end offsets
-        idx = rank * s + jnp.arange(s)
-        leaf_idx = jnp.searchsorted(jnp.asarray(ends), idx, side="right")
+        # uint32 index space, same rationale as the SR offsets below:
+        # int32 would wrap negative past 2^31 elements and searchsorted
+        # would map those shard elements to leaf 0 (ADVICE r4 follow-up)
+        idx = rank.astype(jnp.uint32) * jnp.uint32(s) + jnp.arange(
+            s, dtype=jnp.uint32)
+        leaf_idx = jnp.searchsorted(jnp.asarray(ends, np.uint32), idx,
+                                    side="right")
         padded = jnp.concatenate([jnp.asarray(values, jnp.float32),
                                   jnp.full((1,), pad, jnp.float32)])
         return jnp.take(padded, leaf_idx)
@@ -293,8 +298,12 @@ class _Zero2(_Zero1):
         if wire is not None:
             stacked = stacked.astype(jnp.float32)
         rank = lax.axis_index(axis_name)
+        # uint32 throughout: int32 intermediates would rely on signed
+        # overflow wrapping to agree with _leaf_offsets for element
+        # counts in (2^31, 2^32] (ADVICE r4)
         offs = (None if k_sum is None
-                else (rank * s + jnp.arange(s)).astype(jnp.uint32))
+                else (rank.astype(jnp.uint32) * jnp.uint32(s)
+                      + jnp.arange(s, dtype=jnp.uint32)))
         red = quantized_sum(stacked, grad_exp, grad_man, use_kahan,
                             key=k_sum, offsets=offs)
         if use_aps:

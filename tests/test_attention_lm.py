@@ -267,6 +267,29 @@ class TestChunkedAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_fully_masked_rows_zero_in_every_impl(self):
+        """A causal shard whose keys are ALL in the future (q_offset +
+        Tq <= k_offset) has no attendable key; every impl returns 0 —
+        the flash convention, pinned impl-interchangeable since round 5
+        (ADVICE r4: chunked previously averaged PAD keys into such
+        rows, the one-shot softmax fell back to a uniform average)."""
+        from cpd_tpu.ops.attention import _chunked_attention, local_attention
+
+        rng = np.random.RandomState(35)
+        q, k, v = _rand_qkv(rng, b=1, t=24, h=2, d=8)
+        # all 24 query rows sit before key offset 64: fully masked
+        one_shot = local_attention(q, k, v, causal=True, q_offset=0,
+                                   k_offset=64)
+        chunked = _chunked_attention(q, k, v, True, 0, 64, block=16)
+        assert np.all(np.asarray(one_shot) == 0.0)
+        assert np.all(np.asarray(chunked) == 0.0)
+        # sanity: a PARTIALLY masked call still matches the oracle
+        part = _chunked_attention(q, k, v, True, 12, 8, block=16)
+        want = local_attention(q, k, v, causal=True, q_offset=12,
+                               k_offset=8)
+        np.testing.assert_allclose(np.asarray(part), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_grads_match(self):
         from cpd_tpu.ops.attention import (_chunked_attention,
                                            local_attention)
@@ -372,23 +395,29 @@ class TestChunkedAttention:
                                    rtol=2e-5, atol=2e-5)
 
 
-def test_ulysses_flash_gqa_expands_post_collective(monkeypatch):
-    """With impl='flash' and GQA, ulysses expands the K/V chunk AFTER the
-    all_to_all (HBM pays the rep x, ICI does not) so the uniform-heads
-    flash kernel applies.  The kernel itself needs a TPU, so it is
-    stubbed with the XLA path here — this pins the ROUTING: no MHA-only
-    rejection, chunk-aligned expansion, oracle agreement."""
-    import cpd_tpu.ops.attention as attn_mod
+def test_ulysses_flash_gqa_native_unexpanded(monkeypatch):
+    """With impl='flash' and GQA, ulysses hands the UNEXPANDED K/V chunk
+    to the GQA-native Pallas kernel (ops/flash_gqa.py, round 5) — no
+    rep× re-materialization on either side of the all_to_all.  The
+    kernel runs for real here (interpret mode off-TPU); the spy pins the
+    ROUTING: grouped heads reach the kernel unexpanded."""
+    import sys
+
+    import cpd_tpu.ops.flash_gqa  # noqa: F401 — ensure module is loaded
+    # the package re-exports the function under the submodule's name, so
+    # reach the MODULE through sys.modules for patching
+    fg_mod = sys.modules["cpd_tpu.ops.flash_gqa"]
     from cpd_tpu.ops.attention import (grouped_query_attention,
                                        ulysses_attention)
 
     calls = {}
+    real = fg_mod.flash_gqa
 
-    def fake_flash(q, k, v, causal, q_offset, k_offset):
+    def spy(q, k, v, causal=True):
         calls["heads"] = (q.shape[2], k.shape[2])
-        return attn_mod.local_attention(q, k, v, causal=causal)
+        return real(q, k, v, causal)
 
-    monkeypatch.setattr(attn_mod, "_flash_attention", fake_flash)
+    monkeypatch.setattr(fg_mod, "flash_gqa", spy)
     rng = np.random.RandomState(24)
     q, k, v = _rand_gqa(rng, h=8, hkv=4, t=32)
     full = grouped_query_attention(q, k, v, causal=True)
@@ -403,7 +432,7 @@ def test_ulysses_flash_gqa_expands_post_collective(monkeypatch):
         out_specs=P(None, "sp"), check_vma=False))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(full),
                                rtol=2e-5, atol=2e-5)
-    assert calls["heads"] == (2, 2)  # uniform heads reached the kernel
+    assert calls["heads"] == (2, 1)  # grouped heads, K/V unexpanded
 
 
 @pytest.mark.slow

@@ -70,3 +70,88 @@ def test_qgemm_pallas_order_sensitivity_preserved():
     got = float(qgemm_pallas(a, b, 5, 2, True)[0, 0])
     want = float(quant_gemm(a, b, man=2, exp=5, mode="faithful")[0, 0])
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# GQA-native flash attention (ops/flash_gqa.py) — interpret mode on CPU;
+# tools/pallas_check.py proves the same comparisons on real Mosaic.
+
+import jax  # noqa: E402
+
+
+@pytest.mark.parametrize("b,tq,tk,h,hkv,d,causal", [
+    (2, 256, 256, 4, 2, 64, True),     # GQA, square, causal
+    (1, 130, 100, 8, 2, 64, False),    # ragged Tq/Tk (padding paths)
+    (2, 128, 128, 4, 4, 128, True),    # rep == 1 (plain MHA)
+    (1, 64, 192, 6, 3, 32, True),      # Tq < Tk, D below the lane width
+])
+def test_flash_gqa_matches_oracle(b, tq, tk, h, hkv, d, causal):
+    from cpd_tpu.ops.attention import grouped_query_attention
+    from cpd_tpu.ops.flash_gqa import flash_gqa
+
+    rng = np.random.RandomState(tq + h + d)
+    q = jnp.asarray(rng.randn(b, tq, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, tk, hkv, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, tk, hkv, d).astype(np.float32))
+    got = np.asarray(flash_gqa(q, k, v, causal))
+    want = np.asarray(grouped_query_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+
+
+def test_flash_gqa_matches_chunked():
+    """The verdict's bar: agreement with the pure-XLA online-softmax scan
+    (same recurrence, different engine)."""
+    from cpd_tpu.ops.attention import _chunked_attention
+    from cpd_tpu.ops.flash_gqa import flash_gqa
+
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(2, 256, 4, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 256, 2, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 256, 2, 64).astype(np.float32))
+    got = np.asarray(flash_gqa(q, k, v, True))
+    want = np.asarray(_chunked_attention(q, k, v, True, 0, 0, block=128))
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+
+
+def test_flash_gqa_grad_matches_oracle():
+    """custom_vjp backward (chunked-recompute) vs the XLA path's AD."""
+    from cpd_tpu.ops.attention import grouped_query_attention
+    from cpd_tpu.ops.flash_gqa import flash_gqa
+
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 128, 4, 32).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 128, 2, 32).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 128, 2, 32).astype(np.float32))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v)))
+
+    gf = jax.grad(loss(lambda q, k, v: flash_gqa(q, k, v, True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss(lambda q, k, v: grouped_query_attention(
+        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gqa_routing_and_validation():
+    """grouped_query_attention(impl='flash') routes GQA to the native
+    kernel (no expansion error), rejects offsets and bad head ratios."""
+    from cpd_tpu.ops.attention import grouped_query_attention
+    from cpd_tpu.ops.flash_gqa import flash_gqa
+
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(1, 64, 4, 32).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 64, 2, 32).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 64, 2, 32).astype(np.float32))
+    got = np.asarray(grouped_query_attention(q, k, v, causal=True,
+                                             impl="flash"))
+    want = np.asarray(grouped_query_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+    with pytest.raises(ValueError, match="offset"):
+        grouped_query_attention(q, k, v, causal=True, q_offset=4,
+                                impl="flash")
+    with pytest.raises(ValueError, match="multiple"):
+        flash_gqa(q, k[:, :, :1].repeat(3, axis=2), v[:, :, :1].repeat(
+            3, axis=2), True)
