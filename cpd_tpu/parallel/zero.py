@@ -62,7 +62,8 @@ from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
 from .dist import _flat_axis_index, _wire_dtype, quantize_tree_sr
 from .reduction import quantized_sum
 
-__all__ = ["Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd"]
+__all__ = ["Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd",
+           "zero1_lars", "zero2_lars", "zero3_lars"]
 
 
 class Zero1State(NamedTuple):
@@ -97,6 +98,15 @@ class _Zero1:
         O(S) per rank — never the full (W*S,) flat vector, which the
         round-2 code materialized on every rank before slicing (ADVICE
         r2).  Elements past the last leaf (flat padding) get `pad`."""
+        leaf_idx = self._shard_leaf_index(template, rank, s)
+        padded = jnp.concatenate([jnp.asarray(values, jnp.float32),
+                                  jnp.full((1,), pad, jnp.float32)])
+        return jnp.take(padded, leaf_idx)
+
+    def _shard_leaf_index(self, template, rank, s: int) -> jnp.ndarray:
+        """(S,) map from shard element to its leaf index in tree-leaves
+        order; elements in the world-size pad map to n_leaves (one past
+        the last real leaf)."""
         leaves = jax.tree.leaves(template)
         ends = np.cumsum([l.size for l in leaves])  # static end offsets
         # uint32 index space, same rationale as the SR offsets below:
@@ -104,11 +114,8 @@ class _Zero1:
         # would map those shard elements to leaf 0 (ADVICE r4 follow-up)
         idx = rank.astype(jnp.uint32) * jnp.uint32(s) + jnp.arange(
             s, dtype=jnp.uint32)
-        leaf_idx = jnp.searchsorted(jnp.asarray(ends, np.uint32), idx,
-                                    side="right")
-        padded = jnp.concatenate([jnp.asarray(values, jnp.float32),
-                                  jnp.full((1,), pad, jnp.float32)])
-        return jnp.take(padded, leaf_idx)
+        return jnp.searchsorted(jnp.asarray(ends, np.uint32), idx,
+                                side="right")
 
     def _shard_mask(self, params, rank, s: int) -> jnp.ndarray:
         """This rank's (S,) slice of the per-element weight-decay mask
@@ -188,13 +195,19 @@ class _Zero1:
                          (0, self.world * s - sum(
                              l.size for l in jax.tree.leaves(params))))
         p_sh = lax.dynamic_slice(flat_p, (rank * s,), (s,))
-        m_sh = self._shard_mask(params, rank, s)
-        new_p_sh, new_buf = self._shard_sgd(g_sh, p_sh, m_sh,
-                                            opt.momentum, lr)
+        new_p_sh, new_buf = self._shard_update(g_sh, p_sh, params, rank, s,
+                                               opt.momentum, lr, axis_name)
 
         full = lax.all_gather(new_p_sh, axis_name, axis=0, tiled=True)
         new_params = self._unflatten(full, params)
         return new_params, Zero1State(opt.step + 1, new_buf)
+
+    def _shard_update(self, g_sh, p_sh, template, rank, s, buf, lr,
+                      axis_name):
+        """Optimizer rule on the flat shard — overridden by the LARS
+        variants (`_LarsRule`); the default is the torch-SGD rule."""
+        m_sh = self._shard_mask(template, rank, s)
+        return self._shard_sgd(g_sh, p_sh, m_sh, buf, lr)
 
     def _shard_sgd(self, g_sh, p_sh, m_sh, buf, lr):
         """The torch-SGD rule on a flat shard (train/optim.py:65-69,
@@ -456,9 +469,9 @@ class _Zero3(_Zero2):
 
         g_sh = self._grad_shard(local_grads, state, axis_name, **quant_kw)
         p_sh = state.params
-        m_sh = self._shard_mask(self.template, rank, s)
-        new_p_sh, new_buf = self._shard_sgd(g_sh, p_sh, m_sh,
-                                            opt.momentum, lr)
+        new_p_sh, new_buf = self._shard_update(g_sh, p_sh, self.template,
+                                               rank, s, opt.momentum, lr,
+                                               axis_name)
         return new_p_sh, Zero1State(opt.step + 1, new_buf)
 
 
@@ -470,3 +483,106 @@ def zero3_sgd(schedule: Callable, world: int, template,
     reduction all sharded 1/`world` (see _Zero3 for the wiring)."""
     return _Zero3(schedule, world, momentum, weight_decay, nesterov,
                   wd_mask, axis_name, template)
+
+
+class _LarsRule:
+    """LARS update on the flat shard (round 5, VERDICT r4 ask #5).
+
+    LARS needs PER-LAYER norms (train/optim.py:85-117, the reference's
+    mix.py:297-310), which the flat shard layout does not expose per
+    rank: a shard spans pieces of many leaves and no rank sees a whole
+    leaf.  The rule here recovers exact per-leaf norms with one
+    segment-sum + one tiny psum:
+
+      1. `_shard_leaf_index` maps each shard element to its leaf (static
+         cumsum table + searchsorted — the `_shard_leaf_values`
+         machinery);
+      2. segment-sum of p² and g² over that map gives this rank's
+         per-leaf partial sums of squares (n_leaves+1 floats, the +1
+         catching the world-size pad);
+      3. `lax.psum` over the dp axis completes them globally — the only
+         collective, 2·(n_leaves+1) floats;
+      4. the reference trust-ratio formula runs per leaf and is gathered
+         back per element (constant within a leaf).
+
+    Semantics match `lars` exactly — epsilon-free formula, trust ratio
+    on the UN-decayed gradient norm, lr folded into the momentum buffer,
+    no nesterov, no wd mask; zero-norm quirks (0/0 → nan) are preserved
+    for REAL leaves, only the pad bucket is forced to 0.  Numerics: the
+    replicated `lars` sums each leaf's squares in one XLA reduction; the
+    sharded rule sums per-shard segments then across ranks — a different
+    (still deterministic) association, so norms agree to fp32 round-off,
+    not bitwise; the ZeRO×LARS parity test pins the resulting params at
+    ulp-scale tolerance (tests/test_zero.py).
+    """
+
+    coefficient = 0.001
+
+    def _shard_update(self, g_sh, p_sh, template, rank, s, buf, lr,
+                      axis_name):
+        leaves = jax.tree.leaves(template)
+        n = len(leaves)
+        leaf_idx = self._shard_leaf_index(template, rank, s).astype(
+            jnp.int32)
+        w_sq = jax.ops.segment_sum(p_sh * p_sh, leaf_idx,
+                                   num_segments=n + 1)
+        g_sq = jax.ops.segment_sum(g_sh * g_sh, leaf_idx,
+                                   num_segments=n + 1)
+        w_norm = jnp.sqrt(lax.psum(w_sq, axis_name))      # (n+1,)
+        g_norm = jnp.sqrt(lax.psum(g_sq, axis_name))
+        local_lr = (w_norm / (g_norm + self.weight_decay * w_norm)
+                    * self.coefficient)
+        local_lr = local_lr.at[n].set(0.0)   # pad bucket (0/0 guard)
+        lr_e = jnp.take(local_lr, leaf_idx)               # (S,)
+        new_buf = (self.momentum * buf
+                   + lr * lr_e * (g_sh + self.weight_decay * p_sh))
+        return p_sh - new_buf, new_buf
+
+
+class _Zero1Lars(_LarsRule, _Zero1):
+    pass
+
+
+class _Zero2Lars(_LarsRule, _Zero2):
+    pass
+
+
+class _Zero3Lars(_LarsRule, _Zero3):
+    pass
+
+
+def _lars_factory(cls, schedule, world, momentum, weight_decay,
+                  coefficient, axis_name, template=None):
+    args = (schedule, world, momentum, weight_decay, False, None,
+            axis_name)
+    z = cls(*args, template) if template is not None else cls(*args)
+    z.coefficient = coefficient
+    return z
+
+
+def zero1_lars(schedule: Callable, world: int, momentum: float = 0.9,
+               weight_decay: float = 0.0, coefficient: float = 0.001,
+               axis_name: str = "dp") -> _Zero1Lars:
+    """ZeRO-1 LARS: momentum sharded 1/`world`, per-layer trust ratios
+    recovered via segment-sum + psum (`_LarsRule`)."""
+    return _lars_factory(_Zero1Lars, schedule, world, momentum,
+                         weight_decay, coefficient, axis_name)
+
+
+def zero2_lars(schedule: Callable, world: int, momentum: float = 0.9,
+               weight_decay: float = 0.0, coefficient: float = 0.001,
+               axis_name: str = "dp") -> _Zero2Lars:
+    """ZeRO-2 LARS: momentum + faithful reduction sharded; pair with
+    ``make_train_step(..., reduce_in_update=True)``."""
+    return _lars_factory(_Zero2Lars, schedule, world, momentum,
+                         weight_decay, coefficient, axis_name)
+
+
+def zero3_lars(schedule: Callable, world: int, template,
+               momentum: float = 0.9, weight_decay: float = 0.0,
+               coefficient: float = 0.001,
+               axis_name: str = "dp") -> _Zero3Lars:
+    """ZeRO-3 LARS: params, momentum AND reduction sharded, LARS trust
+    ratios from the sharded per-leaf norms."""
+    return _lars_factory(_Zero3Lars, schedule, world, momentum,
+                         weight_decay, coefficient, axis_name, template)

@@ -15,6 +15,12 @@ get a `psum` over pp (embedding gradients arrive on stage 0 via the input
 path and on the last stage via the tied head).  Then the dp quantized
 `sum_gradients`, then a shard-local elementwise optimizer update (the same
 exactness argument as train/lm.py — LARS refused).
+
+With ``model.vocab_pp`` (round 5) the tied table is vocab-sharded over pp
+(models/pipeline_lm.py docstring): its grads are shard-complete (no pp
+psum — the spec-driven `reduce_leaf` already skips sharded leaves) and
+the loss runs through `vocab_parallel_ce` on the (B, T, V/pp) logits
+slices.
 """
 
 from __future__ import annotations
@@ -25,7 +31,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.pipeline_lm import PipelinedLM, pp_param_specs
+from ..models.pipeline_lm import (PipelinedLM, pp_param_specs,
+                                  vocab_parallel_ce)
 from ..parallel.dist import grad_sr_key, sum_gradients
 from .state import (TrainState, make_sharded_stepper, reject_norm_based,
                     state_specs_like)
@@ -34,9 +41,10 @@ __all__ = ["make_pp_train_step", "make_pp_eval_step", "pp_state_specs"]
 
 
 def pp_state_specs(state: TrainState, pp_axis: str = "pp",
-                   tp_axis: str = "tp") -> TrainState:
+                   tp_axis: str = "tp",
+                   vocab_pp: bool = False) -> TrainState:
     return state_specs_like(
-        state, pp_param_specs(state.params, pp_axis, tp_axis))
+        state, pp_param_specs(state.params, pp_axis, tp_axis, vocab_pp))
 
 
 def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
@@ -75,8 +83,18 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
         def loss_of(params, toks, tgts):
             logits = model.apply_pipelined({"params": params}, toks,
                                            n_microbatches)
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits, tgts)
+            if model.vocab_pp:
+                # vocab-sharded logits (B, T, V/pp), valid on EVERY pp
+                # rank (the head broadcast already ran inside
+                # apply_pipelined); the CE is a pp collective.  is_last
+                # masking still applies — it de-duplicates the count and
+                # routes exactly one rank's cotangent into the psum
+                # transposes (which re-broadcast it to every slice).
+                ce, pred = vocab_parallel_ce(logits, tgts, axis_pp)
+            else:
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, tgts)
+                pred = jnp.argmax(logits, -1)
             # valid on the last stage only; masking zeroes both the loss
             # and (through autodiff) every non-last-stage head cotangent
             local_sum = ce.sum() * is_last
@@ -84,7 +102,7 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
             # tp ranks compute the loss redundantly; /tp via the global
             # count (same correction as train/lm.py:101-108)
             global_n = lax.psum(local_n, all_axes)
-            hits = jnp.sum((jnp.argmax(logits, -1) == tgts)) * is_last
+            hits = jnp.sum(pred == tgts) * is_last
             return local_sum / global_n, (local_sum, local_n, hits)
 
         (_, (lsum, ln, hits)), grads = jax.value_and_grad(
@@ -94,7 +112,8 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
         # whose spec names an axis is SHARDED over it (sole owner per
         # shard, grads already complete); a leaf whose spec doesn't is
         # replicated over it and its per-rank grads are partial sums.
-        specs = pp_param_specs(state.params, axis_pp, axis_tp)
+        specs = pp_param_specs(state.params, axis_pp, axis_tp,
+                               model.vocab_pp)
 
         def named_axes(spec):
             out = []
@@ -132,8 +151,9 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
         return new_state, metrics
 
     return make_sharded_stepper(
-        step_fn, lambda s: pp_state_specs(s, axis_pp, axis_tp), mesh,
-        P(axis_dp), donate=donate)
+        step_fn,
+        lambda s: pp_state_specs(s, axis_pp, axis_tp, model.vocab_pp),
+        mesh, P(axis_dp), donate=donate)
 
 
 def make_pp_eval_step(model: PipelinedLM, mesh: Mesh, *,
@@ -150,8 +170,13 @@ def make_pp_eval_step(model: PipelinedLM, mesh: Mesh, *,
                    ).astype(jnp.float32)
         logits = model.apply_pipelined({"params": state.params}, tokens,
                                        n_microbatches)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-        hits = jnp.sum(jnp.argmax(logits, -1) == targets) * is_last
+        if model.vocab_pp:
+            ce, pred = vocab_parallel_ce(logits, targets, axis_pp)
+        else:
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets)
+            pred = jnp.argmax(logits, -1)
+        hits = jnp.sum(pred == targets) * is_last
         n = jnp.float32(ce.size) * is_last
         total = lax.psum(n, all_axes)
         return {
@@ -163,7 +188,8 @@ def make_pp_eval_step(model: PipelinedLM, mesh: Mesh, *,
     def runner(state, tokens, targets):
         key = jax.tree.structure(state)
         if key not in cache:
-            specs = pp_state_specs(state, axis_pp, axis_tp)
+            specs = pp_state_specs(state, axis_pp, axis_tp,
+                                    model.vocab_pp)
             cache[key] = jax.jit(jax.shard_map(
                 eval_fn, mesh=mesh,
                 in_specs=(specs, P(axis_dp), P(axis_dp)),

@@ -39,9 +39,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "local heads divisible by --sp)")
     p.add_argument("--tp", default=1, type=int, help="tensor-parallel")
     p.add_argument("--pp", default=1, type=int,
-                   help="pipeline-parallel (GPipe; excludes sp/tp/moe)")
+                   help="pipeline-parallel (GPipe; composes with --tp, "
+                   "excludes sp/moe)")
     p.add_argument("--n-microbatches", default=4, type=int,
                    help="pipeline microbatches per step (with --pp)")
+    p.add_argument("--vocab-pp", action="store_true",
+                   help="shard the tied embed/head table over pp "
+                   "(vocab-parallel lookup/logits/CE; with --pp)")
     p.add_argument("--moe", action="store_true",
                    help="Switch-style MoE feed-forward (excludes sp/tp/pp)")
     p.add_argument("--ep", default=1, type=int,
@@ -170,8 +174,14 @@ def main(argv=None) -> dict:
         raise ValueError("--sample-top-k must be >= 1")
     if args.sample_top_p is not None and not 0.0 < args.sample_top_p <= 1.0:
         raise ValueError("--sample-top-p must be in (0, 1]")
-    if (args.pp > 1 or args.moe) and (args.sp > 1 or args.tp > 1):
-        raise ValueError("--pp/--moe do not compose with sp/tp here")
+    if args.moe and (args.sp > 1 or args.tp > 1):
+        raise ValueError("--moe does not compose with sp/tp here")
+    if args.pp > 1 and args.sp > 1:
+        raise ValueError("--pp does not compose with sp here (ring/"
+                         "ulysses need the sequence axis the pipeline "
+                         "streams microbatches over)")
+    if args.vocab_pp and args.pp <= 1:
+        raise ValueError("--vocab-pp needs --pp > 1")
     if args.pp > 1 and args.moe:
         raise ValueError("--pp and --moe are mutually exclusive")
     if (args.pp > 1 or args.moe) and args.emulate_node != 1:
@@ -263,7 +273,9 @@ def main(argv=None) -> dict:
         from cpd_tpu.train import make_pp_eval_step, make_pp_train_step
         from cpd_tpu.train.pp import pp_state_specs
         from cpd_tpu.train.state import TrainState
-        pp_model = pipelined_lm(**model_kw, pp_axis="pp", pp_size=args.pp)
+        pp_model = pipelined_lm(**model_kw, pp_axis="pp", pp_size=args.pp,
+                                tp_axis="tp" if args.tp > 1 else None,
+                                tp_size=args.tp, vocab_pp=args.vocab_pp)
         variables = pipelined_lm(**model_kw).init(jax.random.PRNGKey(0),
                                                   sample)
         state = TrainState(step=jnp.zeros([], jnp.int32),
@@ -274,7 +286,8 @@ def main(argv=None) -> dict:
                                   **quant_kw)
         eval_step = make_pp_eval_step(pp_model, mesh,
                                       n_microbatches=args.n_microbatches)
-        specs_fn = pp_state_specs
+        specs_fn = (lambda st: pp_state_specs(st, vocab_pp=True)
+                    ) if args.vocab_pp else pp_state_specs
         global_batch = args.batch_size * dp
     elif args.moe:
         # expert-parallel path (models/moe.py, train/moe.py)

@@ -219,6 +219,95 @@ def test_pp_eval_step_matches_sequential():
 
 
 @pytest.mark.slow
+def test_pp_vocab_sharded_embed_head_matches_single_device():
+    """vocab_pp=True: the tied embed/head table sharded P('pp', None) —
+    one dp2 x pp4 step must still match the sequential model (loss AND
+    post-step params), proving the vocab-parallel lookup, head, CE, and
+    the shard-complete (un-psum'd) table gradients.  Also pins the
+    memory claim: per-device param bytes ~ total/pp + ln_f."""
+    pp, dp = 4, 2
+    mesh = make_mesh(pp=pp, dp=dp)
+    model = _lm()
+    tokens = _tokens(b=8, t=16, seed=11)
+    targets = _tokens(b=8, t=16, seed=12)
+    variables = model.init(jax.random.PRNGKey(7), tokens[:2])
+    want_loss, want_grads = _seq_loss_and_grads(model, variables, tokens,
+                                                targets)
+
+    pp_model = _lm(pp_axis="pp", pp_size=pp, vocab_pp=True)
+    tx = make_optimizer("sgd", lambda s: jnp.float32(0.1))
+    state = TrainState(step=jnp.zeros([], jnp.int32),
+                       params=variables["params"], batch_stats={},
+                       opt_state=tx.init(variables["params"]))
+    sharded_state = jax.device_put(
+        state, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            pp_state_specs(state, vocab_pp=True)))
+
+    # the memory claim: every leaf except ln_f is 1/pp per device
+    total = sum(l.size * 4 for l in jax.tree.leaves(state.params))
+    lnf = sum(l.size * 4
+              for l in jax.tree.leaves(state.params["ln_f"]))
+    dev0 = mesh.devices.flat[0]
+    per_dev = sum(
+        sh.data.size * 4
+        for l in jax.tree.leaves(sharded_state.params)
+        for sh in l.addressable_shards if sh.device == dev0)
+    assert per_dev == (total - lnf) // pp + lnf, (per_dev, total, lnf)
+
+    step = make_pp_train_step(pp_model, tx, mesh, n_microbatches=4,
+                              donate=False)
+    new_state, metrics = step(sharded_state, tokens, targets)
+    np.testing.assert_allclose(float(metrics["loss"]), float(want_loss),
+                               rtol=2e-4, atol=2e-4)
+    want_params = jax.tree.map(lambda p, g: p - 0.1 * g,
+                               variables["params"], want_grads)
+    got_params = jax.tree.map(np.asarray, new_state.params)
+    for (path, got), (_, want) in zip(
+            jax.tree_util.tree_flatten_with_path(got_params)[0],
+            jax.tree_util.tree_flatten_with_path(want_params)[0]):
+        np.testing.assert_allclose(got, np.asarray(want), rtol=2e-3,
+                                   atol=2e-4, err_msg=str(path))
+
+
+def test_vocab_parallel_ce_matches_optax():
+    """vocab_parallel_ce over a 4-way vocab shard == optax CE + argmax on
+    the gathered logits (fast tier: one tiny shard_map, no pipeline)."""
+    import optax
+    from cpd_tpu.models.pipeline_lm import vocab_parallel_ce
+
+    mesh = make_mesh(pp=4, dp=2)
+    rng = np.random.RandomState(13)
+    logits = jnp.asarray(rng.randn(8, 6, 64).astype(np.float32))
+    targets = jnp.asarray(rng.randint(0, 64, (8, 6)).astype(np.int32))
+
+    def body(lg, tg):
+        ce, pred = vocab_parallel_ce(lg, tg, "pp")
+        return ce, pred
+
+    sharded = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(None, None, "pp"), P()),
+        out_specs=(P(), P()), check_vma=False))
+    ce, pred = sharded(logits, targets)
+    want_ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets)
+    np.testing.assert_allclose(np.asarray(ce), np.asarray(want_ce),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(pred),
+                                  np.argmax(np.asarray(logits), -1))
+
+    # gradient: softmax - onehot, assembled across shards
+    def loss_sharded(lg):
+        ce, _ = sharded(lg, targets)
+        return ce.sum()
+
+    g = jax.grad(loss_sharded)(logits)
+    g_want = jax.grad(lambda lg: optax.softmax_cross_entropy_with_integer_labels(
+        lg, targets).sum())(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
 def test_pp_tp_composed_train_step_matches_single_device():
     """dp2 x pp2 x tp2: pipeline stages whose blocks are ALSO Megatron
     tensor-parallel. One step must match the sequential model (loss and

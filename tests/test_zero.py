@@ -342,6 +342,139 @@ def test_zero3_matches_replicated_faithful():
         _assert_sharded_1w(arr, n_params, w)
 
 
+def test_zero1_lars_matches_replicated():
+    """ZeRO-1 x LARS (round 5, VERDICT r4 ask #5): the flagship LARS
+    recipe with its momentum sharded 1/W — per-layer trust ratios
+    recovered via segment-sum + psum — must train like the replicated
+    `lars` to fp32 round-off (the segmented norm sums associate
+    differently; see _LarsRule docstring)."""
+    from cpd_tpu.parallel.zero import zero1_lars
+
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = tiny_cnn()
+    schedule = lambda s: jnp.float32(0.8)                      # noqa: E731
+    x, y = _data(16, seed=7)
+
+    tx = make_optimizer("lars", schedule, momentum=0.9,
+                        weight_decay=5e-4)
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, donate=False)
+    s_ref = state
+    for _ in range(3):
+        s_ref, m_ref = step(s_ref, x, y)
+
+    z = zero1_lars(schedule, world=w, momentum=0.9, weight_decay=5e-4)
+    z_state = TrainState(step=jnp.zeros([], jnp.int32),
+                         params=state.params,
+                         batch_stats=state.batch_stats,
+                         opt_state=z.init(state.params))
+    spec_tree = TrainState(step=P(), params=P(), batch_stats=P(),
+                           opt_state=z.state_spec())
+    z_state = jax.device_put(
+        z_state, jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                              is_leaf=lambda s: isinstance(s, P)))
+    z_step = make_train_step(model, None, mesh, donate=False,
+                             update_fn=z.update_fn,
+                             opt_state_spec=z.state_spec())
+    s_z = z_state
+    for _ in range(3):
+        s_z, m_z = z_step(s_z, x, y)
+
+    np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
+                               rtol=1e-6)
+    _assert_params_close(s_z.params, s_ref.params, rtol=2e-6, atol=2e-7)
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
+    _assert_sharded_1w(s_z.opt_state.momentum, n_params, w)
+
+
+def test_zero3_lars_matches_replicated_quantized():
+    """ZeRO-3 x LARS with the faithful APS-quantized sharded reduction:
+    params, momentum, reduction AND the LARS trust-ratio norms all
+    sharded — vs the replicated lars step on identically-quantized
+    gradients."""
+    from cpd_tpu.parallel.zero import zero3_lars
+
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = tiny_cnn()
+    schedule = lambda s: jnp.float32(0.8)                      # noqa: E731
+    x, y = _data(16, seed=8)
+    quant = dict(use_aps=True, grad_exp=5, grad_man=2)
+
+    tx = make_optimizer("lars", schedule, momentum=0.9,
+                        weight_decay=5e-4)
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, donate=False, mode="faithful",
+                           **quant)
+    s_ref = state
+    for _ in range(2):
+        s_ref, m_ref = step(s_ref, x, y)
+
+    z = zero3_lars(schedule, world=w, template=state.params,
+                   momentum=0.9, weight_decay=5e-4)
+    z_state = z.make_state(state, mesh)
+    z_step = make_train_step(model, None, mesh, donate=False,
+                             update_fn=z.update_fn,
+                             opt_state_spec=z.state_spec(),
+                             params_spec=z.param_spec(),
+                             unpack_params=z.unpack,
+                             reduce_in_update=True, **quant)
+    s_z = z_state
+    for _ in range(2):
+        s_z, m_z = z_step(s_z, x, y)
+
+    np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
+                               rtol=1e-6)
+    _assert_params_close(z.to_pytree(jnp.asarray(np.asarray(s_z.params))),
+                         s_ref.params, rtol=2e-6, atol=2e-7)
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
+    for arr in (s_z.params, s_z.opt_state.momentum):
+        _assert_sharded_1w(arr, n_params, w)
+
+
+@pytest.mark.slow
+def test_zero2_lars_res_cifar_recipe():
+    """The actual ResNet18/CIFAR LARS recipe (reference mix.py:297-310
+    semantics: momentum 0.9, wd 5e-4, coefficient 0.001) with ZeRO-2:
+    momentum + faithful reduction sharded, trust ratios from sharded
+    norms — vs the replicated lars step on the real res_cifar model."""
+    from cpd_tpu.models import get_model
+    from cpd_tpu.parallel.zero import zero2_lars
+
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = get_model("res_cifar")
+    schedule = lambda s: jnp.float32(0.8)                      # noqa: E731
+    x, y = _data(16, seed=9)
+
+    tx = make_optimizer("lars", schedule, momentum=0.9,
+                        weight_decay=5e-4)
+    state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh, donate=False, mode="faithful")
+    s_ref, m_ref = step(state, x, y)
+
+    z = zero2_lars(schedule, world=w, momentum=0.9, weight_decay=5e-4)
+    z_state = TrainState(step=jnp.zeros([], jnp.int32),
+                         params=state.params,
+                         batch_stats=state.batch_stats,
+                         opt_state=z.init(state.params))
+    spec_tree = TrainState(step=P(), params=P(), batch_stats=P(),
+                           opt_state=z.state_spec())
+    z_state = jax.device_put(
+        z_state, jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                              is_leaf=lambda s: isinstance(s, P)))
+    z_step = make_train_step(model, None, mesh, donate=False,
+                             update_fn=z.update_fn,
+                             opt_state_spec=z.state_spec(),
+                             reduce_in_update=True, mode="faithful")
+    s_z, m_z = z_step(z_state, x, y)
+
+    np.testing.assert_allclose(float(m_z["loss"]), float(m_ref["loss"]),
+                               rtol=1e-6)
+    _assert_params_close(s_z.params, s_ref.params, rtol=2e-6, atol=2e-7)
+
+
 @pytest.mark.slow
 def test_zero3_sr_lm_fsdp():
     """FSDP-style LM training: a transformer LM through the generic
