@@ -243,9 +243,16 @@ def sr_bits_at(key: jax.Array, offsets: jnp.ndarray) -> jnp.ndarray:
     ZeRO reduce-scatter shard — so a sharded reduction reproduces the
     replicated reduction's bits exactly (parallel/zero.py), and bucketed
     vs per-leaf faithful reductions are bitwise identical
-    (parallel/dist.py).  Costs ~2 threefry evaluations per element vs ~0.5
-    for a shape-based `jax.random.bits` — negligible against the gather +
-    ordered-scan the faithful emulation path already pays.
+    (parallel/dist.py).  Costs ~2 threefry evaluations per element per
+    cast site vs ~0.5 for a shape-based `jax.random.bits` — and the
+    faithful ordered scan has W+1 cast sites, so this is NOT negligible:
+    `tools/sr_overhead.py` measures the SR faithful reduction at
+    7.8–12.3x the RTNE faithful reduction on the world=8 CPU mesh
+    (0.2M–3.2M params; docs/PERF.md "SR faithful-path overhead").  The
+    TPU ratio is expected lower (vectorized threefry vs the scan's ICI
+    gather) but has not been measured — staged in the recapture
+    pipeline.  Deployments that need cheap SR should use mode="fast"
+    (one pre-/post-cast pair) or the Pallas SR kernel's hardware PRNG.
 
     `offsets` may be any shape; values must fit uint32 (documented limit:
     reductions over > 2^32 elements would need a wider fold)."""

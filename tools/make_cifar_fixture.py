@@ -9,7 +9,10 @@ LEARNABLE class-structured synthetic images (data/cifar.py
 `--data-root` loader path reads bytes it did not fabricate in-process.
 
 Deterministic: re-running this script reproduces the committed bytes
-exactly (tests/test_real_format_fixture.py pins their sha256).
+exactly (tests/test_real_format_fixture.py pins the decoded content by
+sha256).  Protocol 4: protocol 2 stores uint8 buffers ~1.9x inflated
+(py2-era string escaping); the on-disk DICT layout (b"data"/b"labels",
+CHW row-major rows) — what the strict loader consumes — is identical.
 
     python tools/make_cifar_fixture.py   # writes tests/fixtures/...
 """
@@ -25,7 +28,9 @@ import numpy as np
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-N_TRAIN, N_TEST = 100, 20  # 20 per data_batch_i; ~370 KB committed total
+N_TRAIN, N_TEST = 1800, 200  # 360 per data_batch_i; ~6.1 MB committed
+# (round 5, VERDICT r4 ask #6: grown from 100+20 so the slow-tier
+# APS-ordering arm can train on committed real-format bytes)
 
 
 def main() -> int:
@@ -46,10 +51,10 @@ def main() -> int:
         sl = slice((i - 1) * per, i * per)
         with open(os.path.join(folder, f"data_batch_{i}"), "wb") as f:
             pickle.dump({b"data": rows(train_x[sl]),
-                         b"labels": train_y[sl].tolist()}, f, protocol=2)
+                         b"labels": train_y[sl].tolist()}, f, protocol=4)
     with open(os.path.join(folder, "test_batch"), "wb") as f:
         pickle.dump({b"data": rows(test_x),
-                     b"labels": test_y.tolist()}, f, protocol=2)
+                     b"labels": test_y.tolist()}, f, protocol=4)
     print(f"wrote {folder}: {N_TRAIN} train + {N_TEST} test samples")
     return 0
 

@@ -64,6 +64,13 @@ if [ "${NO_PROBE:-0}" != "1" ]; then
     echo "== 4/5 tpu_probe"
     timeout -k 10 560 python tools/tpu_probe.py || echo "tpu_probe rc=$?"
     bank "tpu perf probe"
+    echo "== 4b/5 sr_overhead on-chip (ratio vs CPU-proxy 7.8-12.3x)"
+    ON_TPU=1 timeout -k 10 300 python tools/sr_overhead.py 3200000 \
+        || echo "sr_overhead rc=$?"
+    echo "== 4c/5 mfu_model on-chip (TPU cost_analysis bytes)"
+    ON_TPU=1 timeout -k 10 400 python tools/mfu_model.py \
+        || echo "mfu_model rc=$?"
+    bank "sr_overhead + mfu_model on-chip"
 fi
 
 if [ "${NO_RERUN:-0}" != "1" ]; then
