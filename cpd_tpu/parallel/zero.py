@@ -209,6 +209,29 @@ class _Zero1:
         m_sh = self._shard_mask(template, rank, s)
         return self._shard_sgd(g_sh, p_sh, m_sh, buf, lr)
 
+    def mesh_layout(self, state, mesh):
+        """Lay a pytree-params TrainState (whose opt_state is this
+        updater's `init(...)`) out on `mesh` — everything replicated
+        except the dp-sharded flat momentum — and return
+        ``(state, step_kwargs)`` with the `make_train_step` hooks wired
+        (`update_fn`, `opt_state_spec`, plus `reduce_in_update` for the
+        stages that shard the reduction).  The ONE copy of the ZeRO-1/2
+        CLI wiring (the ZeRO-3 analog is `make_state`, whose packed
+        params need the extra `params_spec`/`unpack_params` hooks)."""
+        from jax.sharding import NamedSharding
+
+        spec_tree = state.replace(step=P(), params=P(), batch_stats=P(),
+                                  opt_state=self.state_spec())
+        laid = jax.device_put(
+            state, jax.tree.map(lambda sp: NamedSharding(mesh, sp),
+                                spec_tree,
+                                is_leaf=lambda sp: isinstance(sp, P)))
+        kw = {"update_fn": self.update_fn,
+              "opt_state_spec": self.state_spec()}
+        if self.requires_reduce_in_update:
+            kw["reduce_in_update"] = True
+        return laid, kw
+
     def _shard_sgd(self, g_sh, p_sh, m_sh, buf, lr):
         """The torch-SGD rule on a flat shard (train/optim.py:65-69,
         bit-equal) — the ONE copy every ZeRO stage's update uses."""

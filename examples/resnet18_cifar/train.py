@@ -105,6 +105,15 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["faithful", "fast"],
                    help="faithful: bit-ordered quantized reduction; "
                         "fast: quantize->psum->dequantize")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard the optimizer state 1/W over dp "
+                        "(composes with --use_lars via zero1_lars, "
+                        "round 5; parallel/zero.py)")
+    p.add_argument("--zero2", action="store_true",
+                   help="ZeRO-2: momentum AND the faithful reduction "
+                        "sharded (all_to_all reduce-scatter; composes "
+                        "with --use_lars).  --zero3 lives on the "
+                        "ResNet-50 CLI (portable checkpoint layout)")
     return p
 
 
@@ -175,6 +184,29 @@ def main(argv=None) -> dict:
 
     state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),
                                jax.random.PRNGKey(seed))
+    zero = None
+    if args.zero1 and args.zero2:
+        raise SystemExit("--zero1/--zero2 are mutually exclusive")
+    if args.zero1 or args.zero2:
+        if quant_opt:
+            raise SystemExit("--zero1/--zero2 do not compose with the "
+                             "quantized optimizer state (the ZeRO "
+                             "updaters carry fp32 flat momentum)")
+        if args.clip_grad is not None:
+            raise SystemExit("--clip-grad runs inside the optax chain, "
+                             "which the ZeRO updaters bypass")
+        if args.zero2 and args.mode != "faithful":
+            raise SystemExit("--zero2 shards the faithful reduction; "
+                             "--mode fast is not supported with it")
+        from cpd_tpu.parallel import zero as zero_mod
+        maker = getattr(zero_mod,
+                        ("zero1" if args.zero1 else "zero2")
+                        + ("_lars" if args.use_lars else "_sgd"))
+        # world = the dp axis size (emulate_node replicas live INSIDE a
+        # rank's micro-batch scan, same as the resnet50 CLI's wiring)
+        zero = maker(schedule, world=n_dev, momentum=args.momentum,
+                     weight_decay=args.weight_decay)
+        state = state.replace(opt_state=zero.init(state.params))
     ckpt_dir = os.path.abspath(args.save_path)
     manager = CheckpointManager(ckpt_dir, track_best=True)
     start_iter = 0
@@ -228,15 +260,20 @@ def main(argv=None) -> dict:
             if rank == 0:
                 print(f"=> resumed from iter {start_iter}")
     # orbax restores arrays committed to a single device; the train step's
-    # shard_map needs the state replicated over the mesh (fresh states are
-    # uncommitted, so only the restore paths hit the mismatch)
-    state = replicate(state, mesh)
+    # shard_map needs the state laid out over the mesh (replicated, except
+    # the ZeRO momentum which is dp-sharded)
+    if zero is None:
+        state = replicate(state, mesh)
+        extra = {}
+    else:
+        state, extra = zero.mesh_layout(state, mesh)
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
         use_aps=args.use_APS, grad_exp=args.grad_exp,
         grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
-        grad_rounding=args.grad_rounding, grad_seed=args.grad_seed)
+        grad_rounding=args.grad_rounding, grad_seed=args.grad_seed,
+        **extra)
     eval_step = make_eval_step(model, mesh)
 
     # Global per-step batch = per-chip batch x chips x emulated nodes

@@ -270,19 +270,7 @@ def main(argv=None) -> dict:
                  "unpack_params": zero.unpack,
                  "reduce_in_update": True}
     else:
-        from jax.sharding import NamedSharding, PartitionSpec
-        from cpd_tpu.train.state import TrainState as TS
-        spec_tree = TS(step=PartitionSpec(), params=PartitionSpec(),
-                       batch_stats=PartitionSpec(),
-                       opt_state=zero.state_spec())
-        state = jax.device_put(
-            state, jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
-                                is_leaf=lambda s: isinstance(
-                                    s, PartitionSpec)))
-        extra = {"update_fn": zero.update_fn,
-                 "opt_state_spec": zero.state_spec()}
-        if args.zero2:
-            extra["reduce_in_update"] = True
+        state, extra = zero.mesh_layout(state, mesh)
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,

@@ -288,6 +288,23 @@ def test_resnet50_trainer_zero3_smoke(tmp_path):
     assert math.isfinite(res["val_loss"])
 
 
+def test_resnet18_trainer_zero2_lars_smoke(tiny_cifar, tmp_path, capsys):
+    """--zero2 + --use_lars through the LARS-recipe CLI (round 5): the
+    sharded faithful reduction AND sharded per-layer trust ratios, end
+    to end with APS."""
+    from resnet18_cifar.train import main
+
+    res = main(["--use_APS", "--grad_exp", "5", "--grad_man", "2",
+                "--use_lars", "--zero2", "--arch", "tiny",
+                "--data-root", tiny_cifar, "--max-iter", "4",
+                "--batch_size", "2", "--val_freq", "4",
+                "--save_path", str(tmp_path / "ck"), "--mode",
+                "faithful"])
+    assert math.isfinite(res["best_prec1"])
+    out = capsys.readouterr().out
+    assert "All Loss" in out
+
+
 def test_resnet18_trainer_resume_continues_training(tiny_cifar, tmp_path):
     """Auto-resume must REPLICATE the orbax-restored state back onto the
     mesh and keep training — restore committed the arrays to one device,
