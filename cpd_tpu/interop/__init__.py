@@ -8,6 +8,8 @@ from .torch_import import (assert_compatible, convert_bn, convert_conv,
 from .torch_export import (export_bn, export_conv, export_linear,
                            export_reference_resnet18_cifar,
                            export_torchvision_resnet, save_torch_checkpoint)
+from .torch_lm import (build_torch_lm, export_transformer_lm,
+                       import_transformer_lm)
 
 __all__ = [
     "assert_compatible", "convert_bn", "convert_conv", "convert_linear",
@@ -16,4 +18,5 @@ __all__ = [
     "export_bn", "export_conv", "export_linear",
     "export_reference_resnet18_cifar", "export_torchvision_resnet",
     "save_torch_checkpoint",
+    "build_torch_lm", "export_transformer_lm", "import_transformer_lm",
 ]

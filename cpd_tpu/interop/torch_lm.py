@@ -1,0 +1,223 @@
+"""Torch interop for the transformer-LM family (round 5).
+
+The resnet importers/exporters cover the reference's CNN workloads
+(torch_import/torch_export); this module completes the migration story
+for the beyond-reference LM: a user who trains a `TransformerLM` here
+and must hand it to a torch consumer (serving stack, ONNX-via-torch,
+torch-side evaluation) gets
+
+  * `export_transformer_lm(variables, ...)` — flax params -> a torch
+    state_dict (plain `weight`/`bias` keys);
+  * `TorchTransformerLM` — the "modeling file": a faithful torch
+    `nn.Module` mirror of `models/transformer.py` (RoPE, pre-LN blocks
+    with eps=1e-6, head-major fused qkv / GQA split projections, fp32
+    softmax with the same -1e30 mask value, tanh-approx GELU, tied
+    embedding head) that `load_state_dict(strict=True)`s the exported
+    dict and reproduces the flax logits to fp32 tolerance
+    (tests/test_interop.py);
+  * `import_transformer_lm(sd, ...)` — the inverse, for bringing a
+    torch-trained checkpoint of the same architecture in;
+    `import(export(v))` round-trips bitwise (tested).
+
+Layout rules follow torch_import/torch_export: flax Dense kernel (I, O)
+<-> Linear weight (O, I); LayerNorm scale/bias <-> weight/bias;
+Embedding rows as-is.  Both the unrolled (`block{i}`) and
+`scan_layers` (stacked leading-axis) flax layouts are handled on
+export/import; the state_dict is always per-layer (`blocks.{i}.*`).
+
+Reference: the reference has no LM (SURVEY.md §5); this extends its
+C18-C20 torch-interop contract (docs/MIGRATING.md) to the LM family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+from .torch_export import export_linear
+from .torch_import import convert_linear
+
+__all__ = ["export_transformer_lm", "import_transformer_lm",
+           "build_torch_lm"]
+
+_BLOCK_LINEARS_MHA = ("wqkv", "wo", "wi", "wo_mlp")
+_BLOCK_LINEARS_GQA = ("wq", "wkv", "wo", "wi", "wo_mlp")
+
+
+def _np32(x) -> np.ndarray:
+    return np.asarray(x, np.float32)
+
+
+def _layer_params(params: Mapping[str, Any], i: int) -> Mapping[str, Any]:
+    """Layer i's param subtree in either flax layout (block{i} unrolled
+    or 'blocks' stacked-by-nn.scan)."""
+    if f"block{i}" in params:
+        return params[f"block{i}"]
+    if "blocks" in params:
+        import jax
+
+        return jax.tree.map(lambda l: l[i], params["blocks"])
+    raise KeyError(f"no block{i} / blocks entry in params "
+                   f"(keys: {sorted(params)})")
+
+
+def _n_layers(params: Mapping[str, Any]) -> int:
+    if "blocks" in params:
+        import jax
+
+        return int(jax.tree.leaves(params["blocks"])[0].shape[0])
+    return sum(1 for k in params if k.startswith("block")
+               and k[5:].isdigit())
+
+
+def export_transformer_lm(variables: Mapping[str, Any]) -> dict:
+    """TransformerLM flax variables -> torch state_dict (numpy fp32
+    values; wrap with `save_torch_checkpoint` to write a .pth)."""
+    params = variables.get("params", variables)
+    out: dict = {"embed.weight": _np32(params["embed"]["embedding"])}
+    n = _n_layers(params)
+    for i in range(n):
+        blk = _layer_params(params, i)
+        p = f"blocks.{i}."
+        gqa = "wq" in blk
+        for ln in ("ln1", "ln2"):
+            out[p + ln + ".weight"] = _np32(blk[ln]["scale"])
+            out[p + ln + ".bias"] = _np32(blk[ln]["bias"])
+        names = _BLOCK_LINEARS_GQA if gqa else _BLOCK_LINEARS_MHA
+        for w in names:
+            out[p + w + ".weight"] = export_linear(blk[w]["kernel"])
+    out["ln_f.weight"] = _np32(params["ln_f"]["scale"])
+    out["ln_f.bias"] = _np32(params["ln_f"]["bias"])
+    return out
+
+
+def import_transformer_lm(sd: Mapping[str, Any]) -> dict:
+    """torch state_dict (this module's layout) -> {"params": ...} in the
+    unrolled flax layout; exact inverse of `export_transformer_lm`."""
+    sd = {k: np.asarray(v) for k, v in sd.items()}
+    params: dict = {"embed": {"embedding": _np32(sd["embed.weight"])},
+                    "ln_f": {"scale": _np32(sd["ln_f.weight"]),
+                             "bias": _np32(sd["ln_f.bias"])}}
+    n = 1 + max((int(k.split(".")[1]) for k in sd
+                 if k.startswith("blocks.")), default=-1)
+    for i in range(n):
+        p = f"blocks.{i}."
+        gqa = p + "wq.weight" in sd
+        blk: dict = {}
+        for ln in ("ln1", "ln2"):
+            blk[ln] = {"scale": _np32(sd[p + ln + ".weight"]),
+                       "bias": _np32(sd[p + ln + ".bias"])}
+        names = _BLOCK_LINEARS_GQA if gqa else _BLOCK_LINEARS_MHA
+        for w in names:
+            blk[w] = {"kernel": convert_linear(sd[p + w + ".weight"])}
+        params[f"block{i}"] = blk
+    return {"params": params}
+
+
+def build_torch_lm(vocab_size: int, d_model: int, n_layers: int,
+                   n_heads: int, d_ff: Optional[int] = None,
+                   n_kv_heads: Optional[int] = None):
+    """The torch mirror of `models/transformer.py` TransformerLM
+    (non-decode forward path; eval semantics — no dropout).
+
+    Defined inside a builder so importing cpd_tpu never requires torch;
+    returns an un-initialized module — `load_state_dict` it from
+    `export_transformer_lm`'s output.
+    """
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    d_ff = d_ff or 4 * d_model
+    head_dim = d_model // n_heads
+
+    half = head_dim // 2
+
+    def rope_tables(t: int, device) -> tuple:
+        # _rope (transformer.py:42-53) — computed ONCE per forward on
+        # the input's device and shared by every block's q and k
+        freqs = torch.exp(
+            -torch.arange(half, dtype=torch.float32, device=device)
+            * (np.log(10000.0) / half))
+        angles = (torch.arange(t, dtype=torch.float32,
+                               device=device)[:, None] * freqs[None, :])
+        return (torch.cos(angles)[None, :, None, :],
+                torch.sin(angles)[None, :, None, :])
+
+    def rope(x: torch.Tensor, cos: torch.Tensor,
+             sin: torch.Tensor) -> torch.Tensor:
+        # (B, T, H, D), half-split layout
+        x1, x2 = x[..., :half], x[..., half:]
+        return torch.cat([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+    class TorchBlock(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ln1 = nn.LayerNorm(d_model, eps=1e-6)
+            self.ln2 = nn.LayerNorm(d_model, eps=1e-6)
+            if n_kv_heads is None:
+                self.wqkv = nn.Linear(d_model, 3 * d_model, bias=False)
+            else:
+                self.wq = nn.Linear(d_model, d_model, bias=False)
+                self.wkv = nn.Linear(d_model,
+                                     2 * n_kv_heads * head_dim,
+                                     bias=False)
+            self.wo = nn.Linear(d_model, d_model, bias=False)
+            self.wi = nn.Linear(d_model, d_ff, bias=False)
+            self.wo_mlp = nn.Linear(d_ff, d_model, bias=False)
+
+        def forward(self, x, cos, sin, mask):
+            h = self.ln1(x)
+            if n_kv_heads is None:
+                # head-major fused layout (transformer.py Block): (...,
+                # n_heads, 3, head_dim) in the feature dim
+                qkv = self.wqkv(h)
+                qkv = qkv.reshape(*qkv.shape[:-1], n_heads, 3, head_dim)
+                q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            else:
+                q = self.wq(h).reshape(*h.shape[:-1], n_heads, head_dim)
+                kv = self.wkv(h).reshape(*h.shape[:-1], n_kv_heads, 2,
+                                         head_dim)
+                k, v = kv[..., 0, :], kv[..., 1, :]
+            q = rope(q, cos, sin)
+            k = rope(k, cos, sin)
+            # grouped fp32 softmax attention, same mask constant as
+            # ops/attention.py (_NEG_INF = -1e30)
+            hkv = k.shape[2]
+            rep = q.shape[2] // hkv
+            b, t = q.shape[0], q.shape[1]
+            qg = q.reshape(b, t, hkv, rep, head_dim)
+            logits = torch.einsum("bqgrd,bkgd->bgrqk", qg.float(),
+                                  k.float()) / float(head_dim) ** 0.5
+            logits = torch.where(mask, logits,
+                                 logits.new_tensor(-1e30))
+            probs = torch.softmax(logits, dim=-1)
+            attn = torch.einsum("bgrqk,bkgd->bqgrd", probs, v.float())
+            attn = attn.reshape(b, t, n_heads * head_dim)
+            x = x + self.wo(attn)
+            h = self.ln2(x)
+            return x + self.wo_mlp(F.gelu(self.wi(h),
+                                          approximate="tanh"))
+
+    class TorchTransformerLM(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(vocab_size, d_model)
+            self.blocks = nn.ModuleList(TorchBlock()
+                                        for _ in range(n_layers))
+            self.ln_f = nn.LayerNorm(d_model, eps=1e-6)
+
+        def forward(self, tokens):
+            t = tokens.shape[1]
+            dev = tokens.device
+            cos, sin = rope_tables(t, dev)
+            pos = torch.arange(t, device=dev)
+            mask = (pos[:, None] >= pos[None, :])[None, None, None]
+            x = self.embed(tokens)
+            for blk in self.blocks:
+                x = blk(x, cos, sin, mask)
+            x = self.ln_f(x)
+            return x @ self.embed.weight.T        # tied head
+
+    return TorchTransformerLM()

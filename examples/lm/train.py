@@ -145,6 +145,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rng seed for temperature sampling")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of a few steps here")
+    p.add_argument("--export-torch", default=None, metavar="PATH",
+                   help="after training, write a torch state_dict .pth "
+                        "of the LM (cpd_tpu.interop.torch_lm; default "
+                        "dp/sp/tp path only — pp/moe layouts differ)")
     return p
 
 
@@ -182,6 +186,9 @@ def main(argv=None) -> dict:
                          "streams microbatches over)")
     if args.vocab_pp and args.pp <= 1:
         raise ValueError("--vocab-pp needs --pp > 1")
+    if args.export_torch and (args.pp > 1 or args.moe):
+        raise ValueError("--export-torch supports the default dp/sp/tp "
+                         "path only (pp/moe param layouts differ)")
     if args.pp > 1 and args.moe:
         raise ValueError("--pp and --moe are mutually exclusive")
     if (args.pp > 1 or args.moe) and args.emulate_node != 1:
@@ -444,6 +451,21 @@ def main(argv=None) -> dict:
                    f"T={args.sample_temperature} k={args.sample_top_k} "
                    f"p={args.sample_top_p}")
             print(f"sample ({how}, {args.sample} new tokens): {sampled}")
+    if args.export_torch and not (preempted or diverged):
+        from jax.sharding import NamedSharding, PartitionSpec
+        from cpd_tpu.interop import (export_transformer_lm,
+                                     save_torch_checkpoint)
+        # same multi-host-safe re-layout as the sample path above:
+        # compiled all-gather to replicated, then host copies; only rank
+        # 0 writes (every host holds the same gathered values)
+        gather = jax.jit(lambda p: p,
+                         out_shardings=NamedSharding(mesh, PartitionSpec()))
+        params_host = jax.device_get(gather(state.params))
+        if rank == 0:
+            sd = export_transformer_lm({"params": params_host})
+            save_torch_checkpoint(sd, args.export_torch,
+                                  wrapper="state_dict")
+            print(f"=> exported torch state_dict {args.export_torch}")
     writer.close()
     return {"step": step_no, "diverged": diverged,
             **({"sample": sampled} if sampled is not None else {}), **last}

@@ -388,6 +388,8 @@ def test_zero1_lars_matches_replicated():
     _assert_sharded_1w(s_z.opt_state.momentum, n_params, w)
 
 
+@pytest.mark.slow  # ~12 s; the fast tier keeps zero1_lars (7 s) as the
+                   # LARS-rule gate, this adds the zero3+quantized arm
 def test_zero3_lars_matches_replicated_quantized():
     """ZeRO-3 x LARS with the faithful APS-quantized sharded reduction:
     params, momentum, reduction AND the LARS trust-ratio norms all
