@@ -31,12 +31,17 @@ Design notes:
   * fp32 logits/softmax; p is cast to the V dtype for the PV matmul —
     the same precision recipe as `_fold_segment` (attention.py).
 
-Backward: `jax.custom_vjp` — the forward runs this kernel; the backward
-recomputes through `_chunked_attention`'s checkpointed scan (same
-recurrence, same O(Tq·block) score memory in reverse) and takes ITS
-gradient.  That keeps the hot forward on the MXU kernel while the
-backward stays pure-XLA — a valid gradient of softmax attention to fp32
-round-off, bit-independent of which forward produced the output.
+Backward: `jax.custom_vjp` with two selectable paths (``bwd=``).  The
+default "chunked" recomputes through `_chunked_attention`'s
+checkpointed scan (same recurrence, O(Tq·block) score memory in
+reverse) and takes ITS gradient — pure XLA, the conservative choice
+while the Mosaic lowering has only interpret-mode evidence.  "pallas"
+(round 5) runs the flash-backward recipe on the MXU: the forward also
+emits the per-row LSE, and two kernels — dq (K innermost) and fused
+dk/dv (Q innermost, the GQA group-sums folded into (rep, bq)
+contractions) — re-exponentiate p = exp(s − lse) per block.  Both are
+valid gradients of softmax attention to fp32 round-off, tested against
+each other and the XLA AD oracle.
 """
 
 from __future__ import annotations
@@ -57,7 +62,8 @@ _BQ = 128   # query rows per program (pre-rep); MXU/sublane aligned
 _BK = 128   # K/V block; == the lane width so (.., bk) masks are one tile
 
 
-def _flash_gqa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _flash_gqa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                      m_ref, l_ref, *,
                       causal: bool, scale: float, tq: int, tk: int,
                       bq: int, bk: int, n_k: int):
     i = pl.program_id(2)          # q block index
@@ -109,35 +115,59 @@ def _flash_gqa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[..., :1]                                 # (rep, bq, 1)
         o_ref[0, 0] = (acc_ref[...] /
                        jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # log-sum-exp per row, consumed by the Pallas backward (a
+        # fully-masked row keeps lse ~ -1e30; its p re-exponentiates
+        # to 0 there via the same validity mask)
+        lse_ref[0, 0] = (m_ref[..., :1]
+                         + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
-def _flash_gqa_fwd_call(q, k, v, causal: bool, interpret: bool):
+def _dims(q, k):
     b, tq, h, d = q.shape
     tk, hkv = k.shape[1], k.shape[2]
     rep = h // hkv
-    scale = 1.0 / float(d) ** 0.5
-
     bq, bk = min(_BQ, max(8, -(-tq // 8) * 8)), _BK
     tq_p = -(-tq // bq) * bq
     tk_p = -(-tk // bk) * bk
     d_p = max(128, -(-d // 128) * 128)
+    return b, tq, h, d, tk, hkv, rep, bq, bk, tq_p, tk_p, d_p
 
+
+def _q_layout(x, hkv, rep, tq_p, d_p):
+    """(B, Tq, H, D) -> padded (B, H_kv, rep, Tq_p, D_p)."""
+    b, tq, _, d = x.shape
+    return jnp.pad(x.reshape(b, tq, hkv, rep, d).transpose(0, 2, 3, 1, 4),
+                   ((0, 0), (0, 0), (0, 0), (0, tq_p - tq),
+                    (0, d_p - d)))
+
+
+def _kv_layout(x, tk_p, d_p):
+    """(B, Tk, H_kv, D) -> padded (B, H_kv, Tk_p, D_p)."""
+    return jnp.pad(x.transpose(0, 2, 1, 3),
+                   ((0, 0), (0, 0), (0, tk_p - x.shape[1]),
+                    (0, d_p - x.shape[-1])))
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _flash_gqa_fwd_call(q, k, v, causal: bool, interpret: bool):
+    """Returns ((B, Tq, H, D) out, (B, H_kv, rep, Tq_p) lse)."""
+    (b, tq, h, d, tk, hkv, rep, bq, bk, tq_p, tk_p, d_p) = _dims(q, k)
+    scale = 1.0 / float(d) ** 0.5
     # layouts: q -> (B, H_kv, rep, Tq, D); k/v -> (B, H_kv, Tk, D).
     # D zero-pad changes no logit (q·k unaffected) and only adds zero
     # output columns, sliced off below; pad keys are masked by position.
-    qt = jnp.pad(q.reshape(b, tq, hkv, rep, d).transpose(0, 2, 3, 1, 4),
-                 ((0, 0), (0, 0), (0, 0), (0, tq_p - tq), (0, d_p - d)))
-    kt = jnp.pad(k.transpose(0, 2, 1, 3),
-                 ((0, 0), (0, 0), (0, tk_p - tk), (0, d_p - d)))
-    vt = jnp.pad(v.transpose(0, 2, 1, 3),
-                 ((0, 0), (0, 0), (0, tk_p - tk), (0, d_p - d)))
+    qt = _q_layout(q, hkv, rep, tq_p, d_p)
+    kt = _kv_layout(k, tk_p, d_p)
+    vt = _kv_layout(v, tk_p, d_p)
 
     n_q, n_k = tq_p // bq, tk_p // bk
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_gqa_kernel, causal=causal, scale=scale,
                           tq=tq, tk=tk, bq=bq, bk=bk, n_k=n_k),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, rep, tq_p, d_p), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, rep, tq_p, d_p), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv, rep, tq_p), jnp.float32),
+        ),
         grid=(b, hkv, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, 1, rep, bq, d_p),
@@ -150,9 +180,14 @@ def _flash_gqa_fwd_call(q, k, v, causal: bool, interpret: bool):
                          lambda bi, g, i, j: (bi, g, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, rep, bq, d_p),
-                               lambda bi, g, i, j: (bi, g, 0, i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=(
+            pl.BlockSpec((1, 1, rep, bq, d_p),
+                         lambda bi, g, i, j: (bi, g, 0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, rep, bq),
+                         lambda bi, g, i, j: (bi, g, 0, i),
+                         memory_space=pltpu.VMEM),
+        ),
         scratch_shapes=[
             pltpu.VMEM((rep, bq, d_p), jnp.float32),
             pltpu.VMEM((rep, bq, 128), jnp.float32),
@@ -161,13 +196,177 @@ def _flash_gqa_fwd_call(q, k, v, causal: bool, interpret: bool):
         interpret=interpret,
     )(qt, kt, vt)
     # (B, H_kv, rep, Tq_p, D_p) -> (B, Tq, H, D)
-    return out[:, :, :, :tq, :d].transpose(0, 3, 1, 2, 4).reshape(
+    out = out[:, :, :, :tq, :d].transpose(0, 3, 1, 2, 4).reshape(
         b, tq, h, d)
+    return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i, j, *,
+              causal, scale, tk, bq, bk):
+    """Shared flash-backward block recompute: (p, ds) for q block i vs
+    k block j — the numerically delicate mask/re-exponentiation recipe,
+    ONE copy consumed by both backward kernels (only their final
+    contractions differ)."""
+    q = q_ref[0, 0]                                   # (rep, bq, D)
+    k = k_ref[0, 0]                                   # (bk, D)
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]                                 # (rep, bq, D)
+    lse = lse_ref[0, 0][..., None]                    # (rep, bq, 1)
+    delta = delta_ref[0, 0][..., None]                # (rep, bq, 1)
+    s = lax.dot_general(
+        q, k, (((2,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # (rep, bq, bk)
+    qpos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = kpos < tk
+    if causal:
+        valid = valid & (qpos >= kpos)
+    p = jnp.where(valid[None], jnp.exp(s - lse), 0.0)
+    dp = lax.dot_general(
+        do, v, (((2,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (rep, bq, bk)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _flash_gqa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                             delta_ref, dq_ref, acc_ref, *,
+                             causal: bool, scale: float, tk: int,
+                             bq: int, bk: int, n_k: int):
+    i = pl.program_id(2)          # q block index
+    j = pl.program_id(3)          # k block index (innermost)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    compute = (j * bk <= i * bq + (bq - 1)) if causal else True
+
+    @pl.when(compute)
+    def _():
+        _, ds = _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, i, j, causal=causal, scale=scale,
+                          tk=tk, bq=bq, bk=bk)
+        k = k_ref[0, 0]
+        acc_ref[...] += lax.dot_general(
+            ds.astype(k.dtype), k, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (rep, bq, D)
+
+    @pl.when(j == n_k - 1)
+    def _():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_gqa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                              delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                              *, causal: bool, scale: float, tk: int,
+                              bq: int, bk: int, n_q: int):
+    j = pl.program_id(2)          # k block index
+    i = pl.program_id(3)          # q block index (innermost)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: a q block strictly above the k block contributes nothing
+    compute = (i * bq + (bq - 1) >= j * bk) if causal else True
+
+    @pl.when(compute)
+    def _():
+        p, ds = _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, i, j, causal=causal, scale=scale,
+                          tk=tk, bq=bq, bk=bk)
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        # dv += Σ_rep p^T do ; dk += Σ_rep ds^T q  (one contraction each
+        # over the (rep, bq) axes — the GQA group sums fall out of the
+        # dot_general, nothing rep-sized is materialized)
+        dv_acc[...] += lax.dot_general(
+            p.astype(do.dtype), do, (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, D)
+        dk_acc[...] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, D)
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7))
+def _flash_gqa_bwd_call(q, k, v, out, lse, do, causal: bool,
+                        interpret: bool):
+    """Pallas flash backward: (dq, dk, dv) in the input shapes/dtypes."""
+    (b, tq, h, d, tk, hkv, rep, bq, bk, tq_p, tk_p, d_p) = _dims(q, k)
+    scale = 1.0 / float(d) ** 0.5
+    qt = _q_layout(q, hkv, rep, tq_p, d_p)
+    kt = _kv_layout(k, tk_p, d_p)
+    vt = _kv_layout(v, tk_p, d_p)
+    dot = _q_layout(do, hkv, rep, tq_p, d_p)
+    ot = _q_layout(out, hkv, rep, tq_p, d_p)
+    # delta_i = Σ_d dO_id · O_id (the flash-backward row constant); pad
+    # rows are all-zero -> delta 0
+    delta = (dot.astype(jnp.float32) * ot.astype(jnp.float32)).sum(-1)
+
+    n_q, n_k = tq_p // bq, tk_p // bk
+    qspec = pl.BlockSpec((1, 1, rep, bq, d_p),
+                         lambda bi, g, i, j: (bi, g, 0, i, 0),
+                         memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((1, 1, rep, bq),
+                           lambda bi, g, i, j: (bi, g, 0, i),
+                           memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((1, 1, bk, d_p),
+                          lambda bi, g, i, j: (bi, g, j, 0),
+                          memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_flash_gqa_bwd_dq_kernel, causal=causal,
+                          scale=scale, tk=tk, bq=bq, bk=bk, n_k=n_k),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rep, tq_p, d_p), q.dtype),
+        grid=(b, hkv, n_q, n_k),
+        in_specs=[qspec, kvspec, kvspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((rep, bq, d_p), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # k-major grid: the q-block index is innermost for the accumulators
+    qspec_kmaj = pl.BlockSpec((1, 1, rep, bq, d_p),
+                              lambda bi, g, j, i: (bi, g, 0, i, 0),
+                              memory_space=pltpu.VMEM)
+    rowspec_kmaj = pl.BlockSpec((1, 1, rep, bq),
+                                lambda bi, g, j, i: (bi, g, 0, i),
+                                memory_space=pltpu.VMEM)
+    kvspec_kmaj = pl.BlockSpec((1, 1, bk, d_p),
+                               lambda bi, g, j, i: (bi, g, j, 0),
+                               memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_gqa_bwd_dkv_kernel, causal=causal,
+                          scale=scale, tk=tk, bq=bq, bk=bk, n_q=n_q),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, tk_p, d_p), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, tk_p, d_p), v.dtype),
+        ),
+        grid=(b, hkv, n_k, n_q),
+        in_specs=[qspec_kmaj, kvspec_kmaj, kvspec_kmaj, qspec_kmaj,
+                  rowspec_kmaj, rowspec_kmaj],
+        out_specs=(kvspec_kmaj, kvspec_kmaj),
+        scratch_shapes=[pltpu.VMEM((bk, d_p), jnp.float32),
+                        pltpu.VMEM((bk, d_p), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dq = dq[:, :, :, :tq, :d].transpose(0, 3, 1, 2, 4).reshape(
+        b, tq, h, d)
+    dk = dk[:, :, :tk, :d].transpose(0, 2, 1, 3)
+    dv = dv[:, :, :tk, :d].transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_gqa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-              causal: bool = True) -> jnp.ndarray:
+              causal: bool = True, bwd: str = "chunked") -> jnp.ndarray:
     """Flash attention with GQA-native unexpanded K/V, on the MXU.
 
     q: (B, Tq, H, D); k, v: (B, Tk, H_kv, D) with H_kv | H (kv head g
@@ -181,17 +380,48 @@ def flash_gqa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     interpret mode automatically off-TPU so tests and CPU smoke runs
     exercise the same code path; `tools/pallas_check.py` proves the real
     Mosaic lowering on hardware.
+
+    ``bwd`` selects the gradient path: "chunked" (default) recomputes
+    through `_chunked_attention`'s checkpointed scan — pure XLA, the
+    conservative choice while the Pallas kernels' Mosaic lowering has
+    only interpret-mode evidence; "pallas" runs the flash-backward
+    recipe as two Pallas kernels (dq with K innermost; fused dk/dv with
+    Q innermost, the GQA group-sums folded into the (rep, bq)
+    contractions) against the forward's saved LSE — O(1) extra memory,
+    the full fwd+bwd on the MXU.  Both are valid gradients of softmax
+    attention to fp32 round-off and are tested against each other and
+    the XLA AD oracle; pallas_check stages the "pallas" path for
+    hardware validation.
     """
-    _gqa_rep(q, k)  # validate H_kv | H (shared contract, attention.py)
+    _validate_call(q, k, bwd)
     interpret = jax.devices()[0].platform != "tpu"
-    return _flash_gqa_fwd_call(q, k, v, causal, interpret)
+    out, _ = _flash_gqa_fwd_call(q, k, v, causal, interpret)
+    return out
 
 
-def _fwd(q, k, v, causal):
-    return flash_gqa(q, k, v, causal), (q, k, v)
+def _validate_call(q, k, bwd):
+    # shared by the primal AND _fwd: custom_vjp bypasses the primal
+    # under jax.grad, so validation only there would silently accept a
+    # bad bwd string / head ratio in exactly the differentiated case
+    _gqa_rep(q, k)  # H_kv | H (shared contract, attention.py)
+    if bwd not in ("chunked", "pallas"):
+        raise ValueError(f"unknown bwd {bwd!r}; 'chunked' or 'pallas'")
 
 
-def _bwd(causal, res, g):
+def _fwd(q, k, v, causal, bwd):
+    _validate_call(q, k, bwd)
+    interpret = jax.devices()[0].platform != "tpu"
+    out, lse = _flash_gqa_fwd_call(q, k, v, causal, interpret)
+    res = (q, k, v, out, lse) if bwd == "pallas" else (q, k, v)
+    return out, res
+
+
+def _bwd(causal, bwd, res, g):
+    if bwd == "pallas":
+        q, k, v, out, lse = res
+        interpret = jax.devices()[0].platform != "tpu"
+        return _flash_gqa_bwd_call(q, k, v, out, lse, g, causal,
+                                   interpret)
     q, k, v = res
     from .attention import _chunked_attention
 

@@ -135,6 +135,39 @@ def test_flash_gqa_grad_matches_oracle():
                                    rtol=2e-5, atol=2e-5)
 
 
+def test_flash_gqa_pallas_backward_matches_oracle():
+    """bwd='pallas' (round 5): the two flash-backward kernels (dq with K
+    innermost; fused dk/dv with Q innermost, GQA group-sums inside the
+    (rep, bq) contractions) against the forward's saved LSE — grads must
+    match the XLA AD oracle AND the default chunked-recompute bwd."""
+    from cpd_tpu.ops.attention import grouped_query_attention
+    from cpd_tpu.ops.flash_gqa import flash_gqa
+
+    rng = np.random.RandomState(9)
+    for (tq, tk, hkv, causal) in [(128, 128, 2, True),
+                                  (130, 100, 2, False)]:
+        q = jnp.asarray(rng.randn(1, tq, 4, 32).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, tk, hkv, 32).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, tk, hkv, 32).astype(np.float32))
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v)))
+
+        gp = jax.grad(loss(lambda q, k, v: flash_gqa(
+            q, k, v, causal, "pallas")), argnums=(0, 1, 2))(q, k, v)
+        gc = jax.grad(loss(lambda q, k, v: flash_gqa(
+            q, k, v, causal)), argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss(lambda q, k, v: grouped_query_attention(
+            q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+        for a, b_, c in zip(gp, gc, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=2e-5, atol=2e-5)
+            np.testing.assert_allclose(np.asarray(b_), np.asarray(c),
+                                       rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="bwd"):
+        flash_gqa(q, k, v, True, "nope")
+
+
 def test_flash_gqa_routing_and_validation():
     """grouped_query_attention(impl='flash') routes GQA to the native
     kernel (no expansion error), rejects offsets and bad head ratios."""

@@ -191,16 +191,17 @@ def main() -> int:
     kk = jnp.asarray(rng.randn(1, 128, 2, 32).astype(np.float32))
     v = jnp.asarray(rng.randn(1, 128, 2, 32).astype(np.float32))
     loss = lambda fn: (lambda a, b, c: jnp.sum(jnp.sin(fn(a, b, c))))
-    gf = jax.grad(loss(lambda a, b, c: flash_gqa(a, b, c, True)),
-                  argnums=(0, 1, 2))(q, kk, v)
     gx = jax.grad(loss(lambda a, b, c: grouped_query_attention(
         a, b, c, causal=True)), argnums=(0, 1, 2))(q, kk, v)
-    for name, a, b in zip("qkv", gf, gx):
-        if not np.allclose(np.asarray(a), np.asarray(b), atol=2e-4,
-                           rtol=2e-4):
-            failures.append(
-                f"flash_gqa grad d{name} "
-                f"maxdiff={np.max(np.abs(np.asarray(a) - np.asarray(b)))}")
+    for bwd in ("chunked", "pallas"):
+        gf = jax.grad(loss(lambda a, b, c: flash_gqa(a, b, c, True, bwd)),
+                      argnums=(0, 1, 2))(q, kk, v)
+        for name, a, b in zip("qkv", gf, gx):
+            if not np.allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                               rtol=2e-4):
+                failures.append(
+                    f"flash_gqa grad({bwd}) d{name} maxdiff="
+                    f"{np.max(np.abs(np.asarray(a) - np.asarray(b)))}")
     print("flash_gqa:",
           "OK" if len(failures) == fg_before else failures[fg_before:],
           flush=True)
