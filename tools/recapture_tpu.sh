@@ -34,9 +34,15 @@ exec > >(tee "$LOG") 2>&1
 echo "== logging to $LOG"
 
 bank() {
-    # commit whatever evidence exists right now; never fail the capture
+    # commit the capture's own artifacts ONLY (the log + the last-good
+    # record) — never `add -A` whole directories: the watcher can fire
+    # while the working tree holds unrelated WIP, which must not ride
+    # along in a capture commit.  Never fail the capture.
     [ "${NO_COMMIT:-0}" = "1" ] && return 0
-    git add -A .bench_last_good.json "$LOG" tools/ docs/ 2>/dev/null
+    # -f: tools/recapture_*.log is gitignored (routine failed-probe logs
+    # stay untracked); a SUCCESSFUL capture's log is evidence and must
+    # be banked even though it matches the ignore pattern
+    git add -f .bench_last_good.json "$LOG" 2>/dev/null
     git diff --cached --quiet 2>/dev/null || \
         git commit -q -m "TPU capture: $1" || true
 }
