@@ -341,7 +341,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
                             v: jnp.ndarray, causal: bool = True,
-                            q_offset=0, impl: str = "xla") -> jnp.ndarray:
+                            q_offset=0, impl: str = "xla",
+                            flash_bwd: str = "chunked") -> jnp.ndarray:
     """GQA softmax attention without materializing the K/V expansion.
 
     q: (B, Tq, H, D) with H = rep * H_kv; k, v: (B, Tk, H_kv, D).
@@ -367,7 +368,7 @@ def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
             raise ValueError("impl='flash' does not support q offsets; "
                              "use the default impl inside ring steps")
         from .flash_gqa import flash_gqa
-        return flash_gqa(q, k, v, causal)
+        return flash_gqa(q, k, v, causal, flash_bwd)
     if impl == "chunked":
         return _chunked_attention(q, k, v, causal, q_offset, 0)
     if h == hkv:
@@ -394,7 +395,8 @@ def grouped_query_attention(q: jnp.ndarray, k: jnp.ndarray,
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = True,
-                      impl: str = "xla") -> jnp.ndarray:
+                      impl: str = "xla",
+                      flash_bwd: str = "chunked") -> jnp.ndarray:
     """All-to-all sequence-parallel attention; call inside shard_map with
     the sequence dim sharded over `axis_name`.
 
@@ -444,5 +446,6 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = grouped_query_attention(qh, kh, vh, causal=causal, impl=impl)
+    out = grouped_query_attention(qh, kh, vh, causal=causal, impl=impl,
+                                  flash_bwd=flash_bwd)
     return heads_to_seq(out)

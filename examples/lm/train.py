@@ -113,12 +113,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="faithful = ordered Kahan accumulation (bit-exact "
                         "reference emulation, the API default); fast = "
                         "cast-and-dot")
+    p.add_argument("--flash-bwd", default="chunked",
+                   choices=["chunked", "pallas"],
+                   help="GQA flash-attention backward: chunked XLA "
+                        "recompute (default) or the Pallas flash-"
+                        "backward kernels (with --attn-impl flash)")
     p.add_argument("--attn-impl", default="xla",
                    choices=["xla", "flash", "chunked"],
-                   help="flash = Pallas TPU flash-attention kernel "
-                        "(MHA, non-decode; O(T) memory); chunked = "
-                        "pure-XLA online-softmax K/V-block scan (flash's "
-                        "memory shape on any backend, GQA-native)")
+                   help="flash = Pallas flash-attention kernels, O(T) "
+                        "memory, non-decode (MHA via the stock TPU "
+                        "kernel, GQA via the in-repo GQA-native kernel); "
+                        "chunked = pure-XLA online-softmax K/V-block "
+                        "scan (flash's memory shape on any backend, "
+                        "GQA-native)")
     p.add_argument("--bf16", action="store_true",
                    help="bf16 compute dtype (fp32 master params; the "
                         "MXU-native precision — --half analog of the "
@@ -239,17 +246,17 @@ def main(argv=None) -> dict:
         if args.pp > 1 or args.moe:
             raise ValueError("--attn-impl applies to the default "
                              "dp/sp/tp TransformerLM path only")
-        if (args.n_kv_heads is not None and args.attn_impl == "flash"
-                and not (args.sp > 1 and args.sp_mode == "ulysses")):
-            # GQA+flash IS supported under ulysses (the K/V chunk is
-            # expanded post-collective, ops/attention.py); the plain
-            # single-sequence path keeps the loud MHA-only contract.
-            # chunked is GQA-native everywhere.
-            raise ValueError(
-                "--attn-impl flash with --n-kv-heads needs ulysses "
-                "sequence parallelism (--sp N --sp-mode ulysses, "
-                "post-collective expansion); elsewhere unset "
-                "--n-kv-heads or use --attn-impl chunked")
+        # GQA (--n-kv-heads) + flash is supported EVERYWHERE since the
+        # round-5 GQA-native Pallas kernel (ops/flash_gqa.py): plain,
+        # ulysses (unexpanded through the all_to_all), decode excluded
+        # by the decode path's own gating.  chunked is GQA-native too.
+    if args.flash_bwd != "chunked" and not (
+            args.attn_impl == "flash" and args.n_kv_heads is not None):
+        raise ValueError(
+            "--flash-bwd pallas selects the GQA flash-backward kernels, "
+            "which only run with --attn-impl flash AND --n-kv-heads "
+            "(the MHA flash path uses the stock kernel's own backward) "
+            "— without them the flag would be a silent no-op")
         model_kw.update(attn_impl=args.attn_impl)
     if (args.ffn_exp, args.ffn_man) != (8, 23):
         if args.pp > 1 or args.moe:
@@ -322,6 +329,7 @@ def main(argv=None) -> dict:
                                remat=args.remat,
                                scan_layers=args.scan_layers,
                                n_kv_heads=args.n_kv_heads,
+                               flash_bwd=args.flash_bwd,
                                dropout_rate=args.dropout, **model_kw)
         # init model: global shapes, but the SAME param-tree layout
         init_model = transformer_lm(scan_layers=args.scan_layers,
