@@ -46,7 +46,7 @@ sys.path.insert(0, _REPO)
 
 def measure(dp: int, pp: int, m: int, remat: bool, *, d_model=192,
             n_layers=8, t_seq=128, batch=32, vocab=256, steps=5,
-            warmup=2) -> float:
+            warmup=2, vocab_pp=False) -> float:
     """Median step seconds for one (dp, pp, M, remat) config."""
     import jax
     import jax.numpy as jnp
@@ -61,7 +61,7 @@ def measure(dp: int, pp: int, m: int, remat: bool, *, d_model=192,
     kw = dict(vocab_size=vocab, d_model=d_model, n_layers=n_layers,
               n_heads=4, d_ff=4 * d_model)
     model = pipelined_lm(**kw, pp_axis="pp", pp_size=pp,
-                         remat_stages=remat)
+                         remat_stages=remat, vocab_pp=vocab_pp)
     init_model = pipelined_lm(**kw)
     rng = np.random.RandomState(0)
     toks = jnp.asarray(rng.randint(0, vocab, (batch, t_seq)).astype(np.int32))
@@ -111,8 +111,25 @@ def main() -> int:
               f"({sec / base:.2f}x base; tick model {ticks:.2f}x)",
               flush=True)
 
+    # vocab_pp arms (round 5): the vocab-sharded embed/head against the
+    # replicated head at a vocab where the head MATTERS (8192 ≈ 10x the
+    # block params here) — the step-time delta prices the lookup psum +
+    # head broadcast + vocab-parallel CE against the replicated head's
+    # full (B, T, V) logits work per rank
+    vp_rows = []
+    for dp, pp in [(4, 2), (2, 4)]:
+        t_rep = measure(dp, pp, 4, True, vocab=8192)
+        t_vp = measure(dp, pp, 4, True, vocab=8192, vocab_pp=True)
+        vp_rows.append({"dp": dp, "pp": pp, "vocab": 8192,
+                        "replicated_s": round(t_rep, 3),
+                        "vocab_pp_s": round(t_vp, 3),
+                        "ratio": round(t_vp / t_rep, 3)})
+        print(f"dp{dp} pp{pp} vocab8192: replicated {t_rep:.3f}s, "
+              f"vocab_pp {t_vp:.3f}s ({t_vp / t_rep:.2f}x)", flush=True)
+
     out = {"host_cpu": True, "note": "8-device virtual CPU mesh; step-time"
-           " ratios proxy FLOP ratios (no real ICI)", "rows": rows}
+           " ratios proxy FLOP ratios (no real ICI)", "rows": rows,
+           "vocab_pp_rows": vp_rows}
     path = os.path.join(_REPO, "docs", "pp_tax.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
@@ -123,6 +140,11 @@ def main() -> int:
         print(f"| {r['dp']} | {r['pp']} | {r['M']} | "
               f"{'on' if r['remat'] else 'off'} | {r['step_s']} | "
               f"{r['vs_base']} | {r['tick_model']} |")
+    print("\n| dp | pp | vocab | replicated s | vocab_pp s | ratio |")
+    print("|---|---|---|---|---|---|")
+    for r in vp_rows:
+        print(f"| {r['dp']} | {r['pp']} | {r['vocab']} | "
+              f"{r['replicated_s']} | {r['vocab_pp_s']} | {r['ratio']} |")
     return 0
 
 
