@@ -250,6 +250,8 @@ def main(argv=None) -> dict:
         # round-5 GQA-native Pallas kernel (ops/flash_gqa.py): plain,
         # ulysses (unexpanded through the all_to_all), decode excluded
         # by the decode path's own gating.  chunked is GQA-native too.
+        model_kw.update(attn_impl=args.attn_impl,
+                        flash_bwd=args.flash_bwd)
     if args.flash_bwd != "chunked" and not (
             args.attn_impl == "flash" and args.n_kv_heads is not None):
         raise ValueError(
@@ -257,7 +259,6 @@ def main(argv=None) -> dict:
             "which only run with --attn-impl flash AND --n-kv-heads "
             "(the MHA flash path uses the stock kernel's own backward) "
             "— without them the flag would be a silent no-op")
-        model_kw.update(attn_impl=args.attn_impl)
     if (args.ffn_exp, args.ffn_man) != (8, 23):
         if args.pp > 1 or args.moe:
             raise ValueError("--ffn-exp/--ffn-man apply to the default "
@@ -329,7 +330,6 @@ def main(argv=None) -> dict:
                                remat=args.remat,
                                scan_layers=args.scan_layers,
                                n_kv_heads=args.n_kv_heads,
-                               flash_bwd=args.flash_bwd,
                                dropout_rate=args.dropout, **model_kw)
         # init model: global shapes, but the SAME param-tree layout
         init_model = transformer_lm(scan_layers=args.scan_layers,

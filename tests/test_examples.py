@@ -519,6 +519,36 @@ def test_lm_trainer_smoke(tmp_path):
     assert res2["step"] == 3 and "loss" not in res2
 
 
+def test_lm_trainer_flash_gqa_pallas_bwd_reaches_kernel(tmp_path,
+                                                       monkeypatch):
+    """--attn-impl flash --n-kv-heads --flash-bwd pallas must actually
+    route through the GQA flash kernel WITH the requested backward —
+    regression for the round-5 indentation slip that left
+    `model_kw.update(attn_impl=...)` stranded after a raise, silently
+    training with xla attention while the flags validated clean."""
+    import sys
+
+    import cpd_tpu.ops.flash_gqa  # noqa: F401
+    fg_mod = sys.modules["cpd_tpu.ops.flash_gqa"]
+    from lm.train import main
+
+    calls = []
+    real = fg_mod.flash_gqa
+
+    def spy(q, k, v, causal=True, bwd="chunked"):
+        calls.append((q.shape[2], k.shape[2], bwd))
+        return real(q, k, v, causal, bwd)
+
+    monkeypatch.setattr(fg_mod, "flash_gqa", spy)
+    res = main(["--dp", "8", "--seq-len", "16", "--d-model", "32",
+                "--n-layers", "1", "--n-heads", "4", "--n-kv-heads", "2",
+                "--attn-impl", "flash", "--flash-bwd", "pallas",
+                "--vocab-size", "32", "--batch-size", "2",
+                "--max-iter", "2", "--save-path", str(tmp_path / "lm")])
+    assert math.isfinite(res["loss"])
+    assert calls and all(c == (4, 2, "pallas") for c in calls), calls
+
+
 def test_lm_trainer_pp_and_moe_paths(tmp_path):
     """--pp and --moe switch the trainer onto the pipeline / expert
     parallel step builders (GPipe streaming, all_to_all dispatch)."""

@@ -154,25 +154,29 @@ def test_pp_train_step_matches_single_device():
 
 
 @pytest.mark.slow
-def test_pp_train_step_grad_rounding_sr():
+@pytest.mark.parametrize("vocab_pp", [False, True])
+def test_pp_train_step_grad_rounding_sr(vocab_pp):
     """SR through the pp stepper (round 4): deterministic given seed,
     seed-sensitive, finite — and the pp-replicated leaves (embedding)
     stay bitwise consistent across pp copies after the SR dp-reduce
-    (a divergence would poison step 2)."""
+    (a divergence would poison step 2).  vocab_pp=True (round 5)
+    additionally composes SR with the vocab-sharded table: each pp
+    rank's shard dp-reduces under the same key schedule (shard-local
+    leaf offsets), nothing sums across pp."""
     pp, dp = 2, 4
     mesh = make_mesh(pp=pp, dp=dp)
     model = _lm()
     tokens = _tokens(b=16, t=16, seed=5)
     targets = _tokens(b=16, t=16, seed=6)
     variables = model.init(jax.random.PRNGKey(1), tokens[:2])
-    pp_model = _lm(pp_axis="pp", pp_size=pp)
+    pp_model = _lm(pp_axis="pp", pp_size=pp, vocab_pp=vocab_pp)
     tx = make_optimizer("sgd", lambda s: jnp.float32(0.1))
     state = TrainState(step=jnp.zeros([], jnp.int32),
                        params=variables["params"], batch_stats={},
                        opt_state=tx.init(variables["params"]))
     sharded_state = jax.device_put(
         state, jax.tree.map(lambda s: NamedSharding(mesh, s),
-                            pp_state_specs(state)))
+                            pp_state_specs(state, vocab_pp=vocab_pp)))
 
     def run(seed):
         step = make_pp_train_step(pp_model, tx, mesh, n_microbatches=4,

@@ -112,10 +112,14 @@ def main() -> int:
               flush=True)
 
     # vocab_pp arms (round 5): the vocab-sharded embed/head against the
-    # replicated head at a vocab where the head MATTERS (8192 ≈ 10x the
-    # block params here) — the step-time delta prices the lookup psum +
-    # head broadcast + vocab-parallel CE against the replicated head's
-    # full (B, T, V) logits work per rank
+    # replicated head at a vocab where the head MATTERS (8192 x 192 =
+    # 1.57M table params ~ 3.5x ONE block's params here, and the (B, T,
+    # 8192) logits dwarf any single block's activations) — the step-time
+    # delta prices the lookup psum + head broadcast + vocab-parallel CE
+    # against the replicated head's full logits+CE work per rank.
+    # NOTE: regenerating docs/pp_tax.json overwrites it; the round-4
+    # capture this tool cannot reproduce (it had pp=8 + repeat arms) is
+    # preserved at docs/pp_tax_r4.json
     vp_rows = []
     for dp, pp in [(4, 2), (2, 4)]:
         t_rep = measure(dp, pp, 4, True, vocab=8192)
