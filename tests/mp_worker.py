@@ -64,9 +64,16 @@ def main() -> None:
     # the reference's DDP step, main.py:111-169) ----
     step_result = _train_step_phase(mesh, rank * 2, (rank + 1) * 2)
 
+    # ---- pipeline across the process boundary (round 5): each process
+    # IS one pipeline stage — microbatch activations ppermute over the
+    # process link, and the vocab-sharded embed/head's lookup psum,
+    # head broadcast, and vocab-parallel CE all cross it too ----
+    pp_mesh = make_mesh(dp=1, pp=2)
+    pp_result = _pp_phase(pp_mesh)
+
     if rank == 0:
         tmp = os.path.join(outdir, "tmp_result.npz")  # savez appends .npz
-        np.savez(tmp, **got, **step_result)
+        np.savez(tmp, **got, **step_result, **pp_result)
         os.replace(tmp, os.path.join(outdir, "result.npz"))
     print(f"mp_worker rank={rank} ok", flush=True)
 
@@ -118,6 +125,65 @@ def _train_step_phase(mesh, lo: int, hi: int) -> dict:
     for path, leaf in jax.tree_util.tree_flatten_with_path(
             sr_state.params)[0]:
         out["srparam" + jax.tree_util.keystr(path)] = np.asarray(leaf)
+    return out
+
+
+def _pp_phase(mesh) -> dict:
+    """One vocab-sharded (vocab_pp) pipelined-LM train step on a pp=2
+    mesh — shared by the worker (stages in different PROCESSES) and the
+    parent's single-process arm, so the two configurations cannot
+    drift.  Returns the replicated loss and a replicated all-gather of
+    the post-step params."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cpd_tpu.models import pipelined_lm
+    from cpd_tpu.train import make_optimizer
+    from cpd_tpu.train.pp import make_pp_train_step, pp_state_specs
+    from cpd_tpu.train.state import TrainState
+
+    kw = dict(vocab_size=32, d_model=16, n_layers=2, n_heads=2, d_ff=32)
+    model = pipelined_lm(**kw, pp_axis="pp", pp_size=2, vocab_pp=True)
+    rng = np.random.RandomState(13)
+    toks = rng.randint(0, 32, (4, 8)).astype(np.int32)
+    tgts = np.roll(toks, -1, axis=1)
+    # init is mesh-independent (full global stack regardless of pp/vocab
+    # settings, pipeline_lm.init)
+    variables = model.init(jax.random.PRNGKey(5), jnp.asarray(toks[:1]))
+    tx = make_optimizer("sgd", lambda s: jnp.float32(0.1), momentum=0.9)
+    state = TrainState(step=jnp.zeros([], jnp.int32),
+                       params=variables["params"], batch_stats={},
+                       opt_state=tx.init(variables["params"]))
+    specs = pp_state_specs(state, vocab_pp=True)
+
+    def put(spec, leaf):
+        # every process holds the full host value; each contributes its
+        # addressable shards — works one- AND two-process
+        if not isinstance(leaf, jnp.ndarray) and not np.isscalar(
+                leaf) and not isinstance(leaf, np.ndarray):
+            return leaf                      # e.g. the empty batch_stats
+        sh = NamedSharding(mesh, spec)
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(arr.shape, sh,
+                                            lambda idx: arr[idx])
+
+    # specs as the PRIMARY tree: PartitionSpec leaves pair with the
+    # state's arrays (and with the empty batch_stats dict, passed back)
+    sharded = jax.tree.map(put, specs, state,
+                           is_leaf=lambda x: isinstance(x, P))
+    step = make_pp_train_step(model, tx, mesh, n_microbatches=2,
+                              use_aps=True, grad_exp=5, grad_man=2,
+                              donate=False)
+    new_state, metrics = step(sharded, jnp.asarray(toks),
+                              jnp.asarray(tgts))
+    gather = jax.jit(lambda p: p,
+                     out_shardings=NamedSharding(mesh, P()))
+    full = jax.tree.map(np.asarray, gather(new_state.params))
+    out = {"pp_loss": np.asarray(metrics["loss"])}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(full)[0]:
+        out["ppparam" + jax.tree_util.keystr(path)] = leaf
     return out
 
 

@@ -48,14 +48,17 @@ def _single_process_want():
     reduce_fn = make_sum_gradients_fn(mesh, axis_name="dp", use_aps=True,
                                       grad_exp=5, grad_man=2, use_kahan=True)
     want = jax.tree.map(np.asarray, reduce_fn(global_tree))
-    # single-process arm of the SAME step harness (full batch, one host) —
+    # single-process arms of the SAME harnesses (full batch, one host) —
     # shared code so the two configurations cannot drift
-    from mp_worker import _train_step_phase
+    from mp_worker import _pp_phase, _train_step_phase
 
-    return {**want, **_train_step_phase(mesh, 0, 4)}
+    pp_mesh = make_mesh(dp=1, pp=2, devices=jax.devices()[:2])
+    return {**want, **_train_step_phase(mesh, 0, 4),
+            **_pp_phase(pp_mesh)}
 
 
-@pytest.mark.slow  # two cold-start worker processes, ~50s
+@pytest.mark.slow  # two cold-start workers, ~2 min solo (reduce + CNN
+                   # steps + the round-5 pipelined vocab-pp phase)
 def test_two_process_faithful_reduce_bit_identical(tmp_path):
     want = _single_process_want()
 
@@ -78,7 +81,7 @@ def test_two_process_faithful_reduce_bit_identical(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=420)
             outs.append(out)
     finally:
         for p in procs:
