@@ -209,6 +209,33 @@ class _Zero1:
         m_sh = self._shard_mask(template, rank, s)
         return self._shard_sgd(g_sh, p_sh, m_sh, buf, lr)
 
+    # ---- portable checkpoints (round 5; the ZeRO-3 analogs are its
+    # own export_state/portable_template, which also convert params) ----
+    def export_state(self, state):
+        """Padded (W*S,) momentum -> PORTABLE (total,) layout: the
+        world-size pad is trimmed so the checkpoint restores at ANY
+        device count (and its momentum reads as the plain flat vector
+        by any non-ZeRO consumer)."""
+        opt: Zero1State = state.opt_state
+        total = sum(l.size for l in jax.tree.leaves(state.params))
+        return state.replace(opt_state=Zero1State(
+            opt.step, jnp.asarray(opt.momentum)[:total]))
+
+    def portable_template(self, state):
+        """Restore template in the portable layout (pass to
+        `CheckpointManager.restore` before `import_state`)."""
+        total = sum(l.size for l in jax.tree.leaves(state.params))
+        return state.replace(opt_state=Zero1State(
+            jnp.zeros([], jnp.int32), jnp.zeros((total,), jnp.float32)))
+
+    def import_state(self, state):
+        """Portable layout -> THIS updater's padded (W*S,) layout."""
+        opt: Zero1State = state.opt_state
+        s = self._shard_size(state.params)
+        mom = jnp.pad(jnp.asarray(opt.momentum),
+                      (0, self.world * s - opt.momentum.size))
+        return state.replace(opt_state=Zero1State(opt.step, mom))
+
     def mesh_layout(self, state, mesh):
         """Lay a pytree-params TrainState (whose opt_state is this
         updater's `init(...)`) out on `mesh` — everything replicated
@@ -444,10 +471,9 @@ class _Zero3(_Zero2):
 
         opt = state.opt_state
         if isinstance(opt, Zero1State):
-            s = self._shard_size(self.template)
-            mom = jnp.pad(jnp.asarray(opt.momentum),
-                          (0, self.world * s - opt.momentum.size))
-            new_opt = Zero1State(opt.step, mom)
+            # the shared portable->padded re-pad (idempotent: a state
+            # already padded for THIS world size pads by zero bytes)
+            new_opt = self.import_state(state).opt_state
         else:
             new_opt = self.init()
         packed = state.replace(params=self.pack(state.params),

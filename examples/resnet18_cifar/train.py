@@ -239,12 +239,13 @@ def main(argv=None) -> dict:
         # train_util.load_state:274-318); --resume-opt additionally restores
         # the optimizer state and step counter, else params only.
         from cpd_tpu.train import restore_latest
-        loaded = restore_latest(os.path.abspath(args.load_path), state)
+        tmpl = zero.portable_template(state) if zero else state
+        loaded = restore_latest(os.path.abspath(args.load_path), tmpl)
         if loaded is None:
             raise FileNotFoundError(
                 f"--load-path {args.load_path}: no checkpoint found")
         if args.resume_opt:
-            state = loaded
+            state = zero.import_state(loaded) if zero else loaded
             start_iter = int(loaded.step)
         else:
             state = state.replace(params=loaded.params,
@@ -253,9 +254,12 @@ def main(argv=None) -> dict:
             print(f"=> loaded {args.load_path} "
                   f"(opt {'restored' if args.resume_opt else 'fresh'})")
     elif manager.latest_step() is not None:
-        restored = manager.restore(state)
+        # ZeRO checkpoints are saved in the PORTABLE layout (pad-trimmed
+        # momentum), so they restore at any device count
+        restored = manager.restore(
+            zero.portable_template(state) if zero else state)
         if restored is not None:
-            state = restored
+            state = zero.import_state(restored) if zero else restored
             start_iter = int(restored.step)
             if rank == 0:
                 print(f"=> resumed from iter {start_iter}")
@@ -265,8 +269,10 @@ def main(argv=None) -> dict:
     if zero is None:
         state = replicate(state, mesh)
         extra = {}
+        to_ckpt = lambda st: st                               # noqa: E731
     else:
         state, extra = zero.mesh_layout(state, mesh)
+        to_ckpt = zero.export_state
 
     train_step = make_train_step(
         model, tx, mesh, emulate_node=args.emulate_node,
@@ -363,7 +369,7 @@ def main(argv=None) -> dict:
     try:
         for gx, gy in batches:
             if guard.should_stop():      # collective when multi-host
-                preempt_save(manager, step_no, state, rank)
+                preempt_save(manager, step_no, to_ckpt(state), rank)
                 preempted = True
                 break
             profiler.step(step_no)
@@ -383,7 +389,7 @@ def main(argv=None) -> dict:
                 writer.add_scalar("val/top1", val["top1"], step_no)
                 prec1 = 100 * val["top1"]
                 best_prec1 = max(best_prec1, prec1)
-                manager.save(step_no, state, best_metric=prec1)
+                manager.save(step_no, to_ckpt(state), best_metric=prec1)
     finally:
         guard.uninstall()
         batches.close()   # stop the producer even on an exception path

@@ -217,10 +217,14 @@ def main(argv=None) -> dict:
     start_it = 0
     # Auto-resume must not silently overwrite an explicitly requested torch
     # import — an explicit --init-from-torch run starts from the .pth
+    # ALL ZeRO stages checkpoint in the portable layout (round 5 for
+    # zero1/2: pad-trimmed momentum restores at any device count)
     restored = None if args.init_from_torch else manager.restore(
-        zero.portable_template(state) if args.zero3 else state)
+        zero.portable_template(state) if zero else state)
     if restored is not None:                 # auto-resume (main.py:70-75)
-        state = restored
+        # import_state is idempotent-safe for every stage (for --zero3
+        # the params are still the pytree here; make_state repacks)
+        state = zero.import_state(restored) if zero else restored
         meta = manager.metadata()
         if meta is not None and "resume_it" in meta:
             # preemption checkpoint: continue the interrupted epoch at the
@@ -278,8 +282,8 @@ def main(argv=None) -> dict:
         grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
         grad_rounding=args.grad_rounding, grad_seed=args.grad_seed,
         **extra)
-    # checkpoints always persist the portable layout under --zero3
-    to_ckpt = zero.export_state if args.zero3 else (lambda s: s)
+    # checkpoints always persist the portable layout under any ZeRO stage
+    to_ckpt = zero.export_state if zero else (lambda s: s)
     eval_step = make_eval_step(model, mesh)
     if args.zero3:
         # eval consumes the pytree layout; one jitted unflatten per
