@@ -375,6 +375,41 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                     rate_c / n_dev, 1)
         except Exception as e:  # noqa: BLE001 — extras must not kill the run
             partial["lm_note"] = f"lm extra skipped: {type(e).__name__}: {e}"
+        # GQA + the in-repo flash kernel with its Pallas backward
+        # (round 5): the silicon number for ops/flash_gqa.py —
+        # n_kv_heads=2 so the GQA route (not the stock MHA kernel) is
+        # what's measured.  Fresh init: the kv projection shapes differ
+        # from the MHA model's.  OWN try/except + a partial stream
+        # first: this arm compiles brand-new Mosaic kernels (fwd + the
+        # two backward kernels) — exactly the hang class the watchdog
+        # SIGKILLs — and must neither discard the LM numbers above nor
+        # mislabel its own failure as theirs.
+        if ("lm_train_tok_per_sec_per_chip" in partial
+                and time.monotonic() < budget_end - 90):
+            emit({**partial, "partial": True})
+            try:
+                from cpd_tpu.models import transformer_lm
+                from cpd_tpu.train import make_lm_train_step
+                from cpd_tpu.train.state import TrainState
+
+                lm_g = transformer_lm(**lm_kw, dtype=jnp.bfloat16,
+                                      n_kv_heads=2, attn_impl="flash",
+                                      flash_bwd="pallas")
+                vg = lm_g.init(jax.random.PRNGKey(2), toks[:1])
+                gstate = TrainState(step=jnp.asarray(0, jnp.int32),
+                                    params=vg["params"], batch_stats={},
+                                    opt_state=lm_tx.init(vg["params"]))
+                step_g = make_lm_train_step(lm_g, lm_tx, mesh,
+                                            use_aps=True, grad_exp=5,
+                                            grad_man=2, donate=False)
+                rate_g, _, _ = _measure(
+                    jax, step_g, gstate, toks, tgts, 12, windows=3,
+                    imgs_per_call=lm_bs * n_dev * seq)
+                partial["lm_gqa_flash_tok_per_sec_per_chip"] = round(
+                    rate_g / n_dev, 1)
+            except Exception as e:  # noqa: BLE001
+                partial["lm_gqa_note"] = (f"gqa-flash arm skipped: "
+                                          f"{type(e).__name__}: {e}")
 
     if profile_dir and time.monotonic() < budget_end - 30:
         state = create_train_state(model, tx, x[0, :2],
