@@ -310,6 +310,16 @@ def test_vocab_parallel_ce_matches_optax():
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_want),
                                rtol=2e-5, atol=2e-5)
 
+    # exact cross-shard ties: pred must pick the FIRST global index
+    # attaining the max (sequential-argmax semantics), even when the
+    # winner lives on a higher-valued later shard position
+    tied = np.zeros((8, 6, 64), np.float32)
+    tied[:, :, 5] = 3.0    # shard 0
+    tied[:, :, 37] = 3.0   # shard 2 — same value, later index
+    ce_t, pred_t = sharded(jnp.asarray(tied), targets)
+    np.testing.assert_array_equal(np.asarray(pred_t),
+                                  np.full((8, 6), 5, np.int32))
+
 
 @pytest.mark.slow
 def test_pp_tp_composed_train_step_matches_single_device():
