@@ -258,6 +258,27 @@ def test_resnet18_preempt_saves_and_resumes(tmp_path, tiny_cifar, capsys,
     assert res2["step"] == 4
 
 
+def test_resnet50_trainer_on_committed_imagefolder(tmp_path):
+    """The FLAGSHIP trainer's real-data path on COMMITTED bytes (round
+    5): --train-dir points at the in-repo ImageFolder fixture, so the
+    PIL decode + RandomResizedCrop + center-crop val pipeline runs on
+    files the process did not fabricate — the ImageNet analog of the
+    CIFAR canary."""
+    from resnet50.main import main
+
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures", "imagenet_folder")
+    res = main(["--train-dir", fixture, "--batch-size", "1",
+                "--epochs", "1", "--arch", "tiny", "--num-classes", "10",
+                "--max-batches-per-epoch", "2", "--image-size", "32",
+                "--use-APS", "--grad_exp", "5", "--grad_man", "2",
+                "--checkpoint-dir", str(tmp_path / "ck"),
+                "--log-dir", str(tmp_path / "logs"), "--mode", "fast"])
+    assert res["epoch"] == 0
+    assert math.isfinite(res["train_loss"])
+    assert math.isfinite(res["val_loss"])
+
+
 def test_resnet50_trainer_zero1_smoke(tmp_path):
     """--zero1 shards the momentum 1/N over dp through the flagship CLI."""
     from resnet50.main import main

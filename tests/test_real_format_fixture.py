@@ -59,3 +59,58 @@ def test_strict_root_rejects_missing_tree(tmp_path):
 # canary itself (tests/test_cli_canary.py points --data-root here), so
 # the zero-edit command shape runs on committed bytes in EVERY default
 # run at no extra compile cost.
+
+
+# ---------------------------------------------------------------------
+# ImageFolder fixture (round 5): the FLAGSHIP loader's committed tree —
+# train/<class>/*.png + val/<class>/*.png in the genuine ImageNet
+# ImageFolder layout (tools/make_imagenet_fixture.py; PNG = lossless,
+# so the decoded pin is codec-stable).
+
+IMAGENET_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "fixtures", "imagenet_folder")
+# sha256 over (relative path, decoded RGB pixels) per file — paths carry
+# the labels (class dirs), decoded arrays are codec-stable where encoded
+# PNG bytes are not (optimize=True output varies across Pillow/zlib)
+IMAGENET_CONTENT_SHA = ("1705294fb921362e8be63cb15604bf8fdb8"
+                        "21dd2fe03b9e592f2171c15f53555")
+
+
+def test_imagenet_fixture_pinned_and_loads():
+    """The committed tree's decoded content AND layout are pinned, and
+    `load_imagenet`'s REAL branch (not the synthetic stand-in) walks
+    them: 10 classes, deterministic eval crops."""
+    import glob
+    import numpy as np
+    from PIL import Image
+
+    from cpd_tpu.data.imagenet import load_imagenet
+
+    files = sorted(glob.glob(os.path.join(IMAGENET_FIXTURE, "**", "*.png"),
+                             recursive=True))
+    assert len(files) == 140
+    h = hashlib.sha256()
+    for f in files:
+        h.update(os.path.relpath(f, IMAGENET_FIXTURE).encode())
+        h.update(np.asarray(Image.open(f).convert("RGB")).tobytes())
+    assert h.hexdigest() == IMAGENET_CONTENT_SHA, (
+        "committed ImageFolder fixture drifted (pixels or layout) — "
+        "regenerate via tools/make_imagenet_fixture.py and re-pin only "
+        "if intended")
+
+    train_ds, val_ds = load_imagenet(IMAGENET_FIXTURE, size=32)
+    assert len(train_ds) == 120 and len(val_ds) == 20
+    xa, ya = val_ds.batch([0, 19])
+    xb, yb = val_ds.batch([0, 19])
+    np.testing.assert_array_equal(xa, xb)      # eval crop deterministic
+    assert xa.shape == (2, 32, 32, 3)
+    assert ya[0] != ya[1]                      # spans classes
+
+
+def test_imagenet_strict_root_rejects_missing_layout(tmp_path):
+    import pytest
+
+    from cpd_tpu.data.imagenet import load_imagenet
+
+    with pytest.raises(FileNotFoundError):
+        load_imagenet(str(tmp_path / "nope"), size=32)

@@ -1,0 +1,62 @@
+"""Generate the COMMITTED ImageFolder fixture for the flagship loader
+(round 5 — the ImageNet analog of `make_cifar_fixture.py`).
+
+No network/dataset access exists in this environment, so the repo
+carries a small `train/<class>/*.png` + `val/<class>/*.png` tree in the
+genuine ImageFolder layout `load_imagenet` consumes (data/imagenet.py),
+holding learnable class-structured patterns (a per-class low-frequency
+template + noise, the `synthetic_cifar10` recipe at 48x48).  PNG, not
+JPEG: lossless, so the DECODED pixels are stable whatever
+Pillow/zlib re-encodes the files (encoded bytes may differ across
+versions; the pin in tests/test_real_format_fixture.py is therefore
+over decoded arrays + labels, like the CIFAR fixture's).
+
+Deterministic pixels: re-running reproduces the same decoded content.
+
+    python tools/make_imagenet_fixture.py  # writes tests/fixtures/...
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_CLASSES, PER_TRAIN, PER_VAL, SIZE = 10, 12, 2, 48
+
+
+def _images(n: int, cls: int, rng: np.random.RandomState) -> np.ndarray:
+    """Class-dependent low-frequency template + per-image noise (the
+    learnable structure of data/cifar.py synthetic_cifar10, sized up)."""
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE].astype(np.float32) / SIZE
+    base = np.stack([
+        np.sin(2 * np.pi * ((cls % 5 + 1) * xx + cls * 0.13)),
+        np.cos(2 * np.pi * ((cls // 5 + 1) * yy - cls * 0.07)),
+        np.sin(2 * np.pi * (xx + yy) * (cls % 3 + 1)),
+    ], -1)
+    imgs = base[None] * 80 + 128 + rng.randn(n, SIZE, SIZE, 3) * 20
+    return np.clip(imgs, 0, 255).astype(np.uint8)
+
+
+def main() -> int:
+    from PIL import Image
+
+    root = os.path.join(_REPO, "tests", "fixtures", "imagenet_folder")
+    rng = np.random.RandomState(4321)
+    for split, per in (("train", PER_TRAIN), ("val", PER_VAL)):
+        for cls in range(N_CLASSES):
+            d = os.path.join(root, split, f"class_{cls:02d}")
+            os.makedirs(d, exist_ok=True)
+            for i, arr in enumerate(_images(per, cls, rng)):
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"{i:03d}.png"), optimize=True)
+    n = N_CLASSES * (PER_TRAIN + PER_VAL)
+    print(f"wrote {root}: {n} images, {N_CLASSES} classes, "
+          f"{SIZE}x{SIZE} png")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
