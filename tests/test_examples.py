@@ -343,6 +343,25 @@ def test_resnet18_trainer_resume_continues_training(tiny_cifar, tmp_path):
     assert math.isfinite(res2["loss"])
 
 
+def test_fcn_trainer_on_committed_cityscapes_tree(tmp_path):
+    """The FCN trainer's real-data path on COMMITTED bytes (round 5):
+    --data-root points at the in-repo leftImg8bit/gtFine fixture —
+    completing the committed-real-format trio (CIFAR, ImageNet
+    ImageFolder, Cityscapes)."""
+    from fcn.train import main
+
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures", "cityscapes_tree")
+    res = main(["--crop-size", "32", "--batch-size", "1", "--data-root",
+                fixture, "--tiny-backbone", "--use_APS", "--grad_exp",
+                "5", "--grad_man", "2", "--max-iter", "2", "--val-freq",
+                "2", "--save-path", str(tmp_path / "fcn"),
+                "--mode", "fast"])
+    assert res["step"] == 2
+    assert math.isfinite(res["loss"])
+    assert 0.0 <= res["val_pix_acc"] <= 1.0
+
+
 def test_fcn_trainer_smoke(tmp_path):
     from fcn.train import main
 

@@ -107,6 +107,46 @@ def test_imagenet_fixture_pinned_and_loads():
     assert ya[0] != ya[1]                      # spans classes
 
 
+CITYSCAPES_FIXTURE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures",
+    "cityscapes_tree")
+CITYSCAPES_CONTENT_SHA = ("f4e89f8c1b51af8abf9e20a4939117c7df7"
+                          "b586c59b314b0a2aacc77f0ac2678")
+
+
+def test_cityscapes_fixture_pinned_and_loads():
+    """Committed leftImg8bit/gtFine tree (round 5): decoded content +
+    layout pinned; the real walker finds the pairs and the 34->19
+    labelId remap runs on committed bytes (road/sky/car + void)."""
+    import glob
+    import numpy as np
+    from PIL import Image
+
+    from cpd_tpu.data.segmentation import (CITYSCAPES_IGNORE,
+                                           load_segmentation)
+
+    files = sorted(glob.glob(os.path.join(CITYSCAPES_FIXTURE, "**",
+                                          "*.png"), recursive=True))
+    assert len(files) == 16                     # 8 image/label pairs
+    h = hashlib.sha256()
+    for f in files:
+        h.update(os.path.relpath(f, CITYSCAPES_FIXTURE).encode())
+        h.update(np.asarray(Image.open(f)).tobytes())
+    assert h.hexdigest() == CITYSCAPES_CONTENT_SHA, (
+        "committed Cityscapes fixture drifted (pixels or layout) — "
+        "regenerate via tools/make_cityscapes_fixture.py and re-pin "
+        "only if intended")
+
+    ds = load_segmentation(CITYSCAPES_FIXTURE, crop_size=48)
+    assert len(ds) == 6
+    x, y = ds.batch([0, 5], seed=1)
+    assert x.shape == (2, 48, 48, 3) and y.shape == (2, 48, 48)
+    # remapped trainIds only: road=0, sky=10, car=13, ignore
+    assert set(np.unique(y)) <= {0, 10, 13, CITYSCAPES_IGNORE}
+    val = load_segmentation(CITYSCAPES_FIXTURE, split="val", crop_size=48)
+    assert len(val) == 2
+
+
 def test_imagenet_strict_root_rejects_missing_layout(tmp_path):
     import pytest
 

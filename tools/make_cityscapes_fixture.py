@@ -1,0 +1,79 @@
+"""Generate the COMMITTED Cityscapes-layout fixture for the FCN loader
+(round 5 — completing the committed-real-format trio: CIFAR pickle tree,
+ImageNet ImageFolder, and this leftImg8bit/gtFine walker's tree).
+
+The genuine on-disk contract (data/segmentation.py):
+
+    <root>/leftImg8bit/<split>/<city>/<name>_leftImg8bit.png
+    <root>/gtFine/<split>/<city>/<name>_gtFine_labelIds.png
+
+Images hold class-structured regions whose raw labelIds span mapped
+(road=7, sky=23, car=26), unmapped-void, and license-plate(-1-style)
+ids so the 34->19 trainId remap is exercised on committed bytes.  PNG
+throughout (the real dataset's format): decoded pixels are codec-stable
+and the pin in tests/test_real_format_fixture.py is over decoded
+arrays + relative paths.
+
+    python tools/make_cityscapes_fixture.py  # writes tests/fixtures/...
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+H, W = 64, 96
+CITIES = {"train": ("aachen", "bochum"), "val": ("frankfurt",)}
+PER_CITY = {"train": 3, "val": 2}
+
+
+def _scene(idx: int, rng: np.random.RandomState):
+    """(image, labelIds): sky band / road band / a car box + void strip,
+    with class-correlated colors so an FCN can actually learn it."""
+    lab = np.full((H, W), 4, np.uint8)          # 4 = static (unmapped)
+    lab[: H // 3] = 23                          # sky
+    lab[2 * H // 3:] = 7                        # road
+    x0 = 8 + (idx * 17) % (W - 40)
+    lab[H // 3: 2 * H // 3, x0:x0 + 24] = 26    # car
+    lab[:, :4] = 0                              # unlabeled void strip
+    img = np.zeros((H, W, 3), np.float32)
+    img[lab == 23] = (90, 140, 235)
+    img[lab == 7] = (120, 110, 120)
+    img[lab == 26] = (200, 40, 40)
+    img[lab == 4] = (60, 160, 60)
+    img[lab == 0] = (10, 10, 10)
+    img += rng.randn(H, W, 3) * 12
+    return np.clip(img, 0, 255).astype(np.uint8), lab
+
+
+def main() -> int:
+    from PIL import Image
+
+    root = os.path.join(_REPO, "tests", "fixtures", "cityscapes_tree")
+    rng = np.random.RandomState(97)
+    n = 0
+    for split, cities in CITIES.items():
+        for city in cities:
+            img_d = os.path.join(root, "leftImg8bit", split, city)
+            lab_d = os.path.join(root, "gtFine", split, city)
+            os.makedirs(img_d, exist_ok=True)
+            os.makedirs(lab_d, exist_ok=True)
+            for i in range(PER_CITY[split]):
+                img, lab = _scene(n, rng)
+                stem = f"{city}_{i:06d}_000019"
+                Image.fromarray(img).save(
+                    os.path.join(img_d, stem + "_leftImg8bit.png"),
+                    optimize=True)
+                Image.fromarray(lab).save(
+                    os.path.join(lab_d, stem + "_gtFine_labelIds.png"),
+                    optimize=True)
+                n += 1
+    print(f"wrote {root}: {n} image/label pairs, {H}x{W}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
