@@ -1,0 +1,35 @@
+"""cpd_tpu.analysis — JAX/precision-aware static lint for this repo.
+
+A stdlib-only (``ast``, no jax import) lint pass encoding the invariants
+the Python type system cannot see but CPD's bit-faithful emulation
+depends on: eXmY format bounds, collective axis-name bindings, jit and
+Pallas purity/tiling rules, ordered-reduction semantics over quantized
+values, and buffer-donation aliasing.  See docs/ANALYSIS.md for the rule
+catalog and rationale.
+
+Usage:
+
+    python -m cpd_tpu.analysis cpd_tpu tests tools examples
+    python -m cpd_tpu.analysis --format=json --select=format-bounds src/
+
+Exit-code contract (stable for tooling): 0 = clean, 1 = findings,
+2 = internal error (bad arguments, unreadable input, rule crash).
+
+Suppression: append ``# cpd: disable=<rule>[,<rule>...]`` to the flagged
+line (with a justification), or ``# cpd: disable-file=<rule>`` anywhere
+in the file for a file-wide waiver.  ``# cpd: skip-file`` excludes a
+file entirely (reserved for generated code).
+
+The module deliberately avoids importing jax/flax/numpy so the lint
+gate costs milliseconds and runs anywhere — including the minimal CI
+image before heavyweight deps install.
+"""
+
+from .core import (Finding, Rule, all_rules, lint_file, lint_source,
+                   lint_tree, register, render_json, render_text)
+
+__all__ = ["Finding", "Rule", "all_rules", "lint_file", "lint_source",
+           "lint_tree", "register", "render_json", "render_text"]
+
+# importing the rules package registers every built-in rule
+from . import rules as _rules  # noqa: E402,F401  (registration side effect)

@@ -1,0 +1,88 @@
+"""CLI entry: ``python -m cpd_tpu.analysis <paths> [--format=...]``.
+
+Exit-code contract (stable for tooling; pinned by tests/test_analysis.py
+and [project.scripts] cpd-lint):
+
+    0  clean — every checked file passed every selected rule
+    1  findings — at least one unsuppressed finding was reported
+    2  internal error — bad arguments, unreadable/ unparsable input, or
+       a rule crash (details on stderr)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import (LintError, all_rules, lint_tree, render_json,
+                   render_text)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m cpd_tpu.analysis",
+        description="JAX/precision-aware static lint for the cpd_tpu "
+                    "tree (stdlib-only; see docs/ANALYSIS.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format (default: text)")
+    p.add_argument("--select", default=None, metavar="RULE[,RULE...]",
+                   help="run only these rule ids")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit 0")
+    return p
+
+
+def main(argv=None) -> int:
+    try:
+        args = _build_parser().parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on bad usage and 0 on --help; map both into
+        # the documented contract (0 stays 0, anything else is 2)
+        return 0 if e.code in (0, None) else 2
+
+    rules = all_rules()
+    if args.list_rules:
+        for rule_id, rule in sorted(rules.items()):
+            print(f"{rule_id:16s} {rule.summary}")
+        return 0
+
+    if not args.paths:
+        print("error: no paths given (try --help)", file=sys.stderr)
+        return 2
+
+    select = None
+    if args.select is not None:
+        select = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = select - rules.keys()
+        if unknown:
+            print(f"error: unknown rule id(s): {sorted(unknown)}; "
+                  f"known: {sorted(rules)}", file=sys.stderr)
+            return 2
+
+    files = []
+    try:
+        findings = lint_tree(args.paths, select=select,
+                             on_file=files.append)
+    except LintError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if not files:
+        print(f"error: no Python files under {args.paths}",
+              file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(render_json(findings, files_checked=len(files)))
+    else:
+        print(render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,411 @@
+"""Lint engine: rule registry, suppression handling, file walking, output.
+
+Stdlib-only by contract (see package docstring).  A rule is a class with
+an ``id``, a one-line ``summary``, and a ``check(ctx)`` generator that
+yields ``Finding``s; it registers itself with the ``@register``
+decorator.  The engine parses each file once, hands every rule the same
+``ModuleContext`` (AST + source + small shared analyses), then filters
+findings through the suppression comments.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Callable, Iterable, Iterator, Optional
+
+__all__ = ["Finding", "ModuleContext", "Rule", "register", "all_rules",
+           "lint_source", "lint_file", "lint_tree", "render_text",
+           "render_json"]
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint hit, anchored to a source location (1-based line)."""
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_DISABLE_LINE = re.compile(r"#\s*cpd:\s*disable=([A-Za-z0-9_,\- ]+)")
+_DISABLE_FILE = re.compile(r"#\s*cpd:\s*disable-file=([A-Za-z0-9_,\- ]+)")
+_SKIP_FILE = re.compile(r"#\s*cpd:\s*skip-file\b")
+
+
+def _parse_rule_list(blob: str) -> set[str]:
+    """Rule ids from a directive's payload: comma-separated, with
+    anything after whitespace inside a segment treated as justification
+    text (`disable=format-bounds -- fast path intended` names one
+    rule)."""
+    out: set[str] = set()
+    for segment in blob.split(","):
+        tokens = segment.split()
+        if tokens:
+            out.add(tokens[0])
+    return out
+
+
+class Suppressions:
+    """Per-file view of ``# cpd:`` directives.
+
+    Directives are read from actual COMMENT tokens (via ``tokenize``),
+    never from string literals — a docstring that *documents* the
+    suppression syntax must not silently disable the linter for its
+    file.  If tokenization fails the file gets no suppressions (the
+    conservative direction: findings stay visible)."""
+
+    def __init__(self, src: str):
+        self.skip_file = False
+        self.file_rules: set[str] = set()
+        self.line_rules: dict[int, set[str]] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(src).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT or "cpd:" not in tok.string:
+                continue
+            line = tok.start[0]
+            if _SKIP_FILE.search(tok.string):
+                self.skip_file = True
+            m = _DISABLE_FILE.search(tok.string)
+            if m:
+                self.file_rules |= _parse_rule_list(m.group(1))
+            m = _DISABLE_LINE.search(tok.string)
+            if m:
+                self.line_rules.setdefault(line, set()).update(
+                    _parse_rule_list(m.group(1)))
+
+    def allows(self, f: Finding, stmt_line: Optional[int] = None) -> bool:
+        """True when the finding survives suppression.  ``stmt_line`` is
+        the first line of the enclosing statement — a directive there
+        also covers findings anchored to argument nodes on later lines
+        of a multi-line call."""
+        if "all" in self.file_rules or f.rule in self.file_rules:
+            return False
+        for line in {f.line, stmt_line or f.line}:
+            at_line = self.line_rules.get(line, ())
+            if "all" in at_line or f.rule in at_line:
+                return False
+        return True
+
+
+class ModuleContext:
+    """Everything a rule needs about one parsed file, computed once."""
+
+    def __init__(self, path: str, src: str, tree: ast.Module):
+        self.path = path
+        self.src = src
+        self.tree = tree
+        self.lines = src.splitlines()
+        # top-level NAME = <int> bindings, for resolving tile-size
+        # constants like _LANES = 128 in shape literals
+        self.int_constants: dict[str, int] = {}
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                val = literal_int(node.value)
+                if val is not None:
+                    self.int_constants[node.targets[0].id] = val
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(path=self.path, line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0), rule=rule,
+                       message=message)
+
+
+class Rule:
+    """Base class; subclasses set ``id``/``summary`` and implement
+    ``check``."""
+
+    id: str = ""
+    summary: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator adding a rule instance to the global registry."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by the rule modules)
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.experimental.pallas.BlockSpec' for nested Attribute/Name
+    chains; '' when the expression is not a plain dotted name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def base_name(node: ast.AST) -> str:
+    """Last segment of a dotted callee ('psum' for lax.psum)."""
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def literal_int(node: ast.AST) -> Optional[int]:
+    """Int value of a literal (including unary minus); None otherwise.
+    bools are NOT ints here (True is not a valid exp_bits)."""
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)):
+        inner = literal_int(node.operand)
+        return None if inner is None else -inner
+    if (isinstance(node, ast.Constant) and type(node.value) is int):
+        return node.value
+    return None
+
+
+def literal_float(node: ast.AST) -> Optional[float]:
+    """Float value of a numeric literal (int or float, +/-)."""
+    if (isinstance(node, ast.UnaryOp)
+            and isinstance(node.op, (ast.USub, ast.UAdd))):
+        inner = literal_float(node.operand)
+        if inner is None:
+            return None
+        return -inner if isinstance(node.op, ast.USub) else inner
+    if (isinstance(node, ast.Constant)
+            and type(node.value) in (int, float)):
+        return float(node.value)
+    return None
+
+
+def string_literals(node: ast.AST) -> Iterator[ast.Constant]:
+    """Every string-constant node inside ``node`` (inclusive)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub
+
+
+def call_arg(call: ast.Call, pos: Optional[int],
+             kw: Optional[str]) -> Optional[ast.AST]:
+    """Argument at positional index ``pos`` or keyword ``kw`` (keyword
+    wins); None when absent or hidden behind *args/**kwargs."""
+    if kw is not None:
+        for k in call.keywords:
+            if k.arg == kw:
+                return k.value
+    if pos is not None and pos < len(call.args):
+        arg = call.args[pos]
+        if not isinstance(arg, ast.Starred):
+            return arg
+    return None
+
+
+def iter_functions(tree: ast.Module) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Like ast.walk but does not descend into nested function/class
+    scopes (the nested def/lambda node itself IS yielded).  Scope-local
+    dataflow rules use this so a statement is analyzed in exactly one
+    scope."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                yield child
+                continue
+            stack.append(child)
+
+
+def unwrap_partial(node: ast.AST) -> Optional[ast.Call]:
+    """For ``functools.partial(f, ...)`` / ``partial(f, ...)`` return the
+    partial Call; None otherwise."""
+    if (isinstance(node, ast.Call)
+            and base_name(node.func) == "partial"):
+        return node
+    return None
+
+
+def jit_decoration(fn: ast.FunctionDef) -> Optional[ast.Call]:
+    """If ``fn`` is decorated with jax.jit (bare, called, or via
+    functools.partial), return a Call carrying the jit kwargs (synthetic
+    empty Call for the bare form); else None."""
+    for dec in fn.decorator_list:
+        name = dotted_name(dec)
+        if name in ("jax.jit", "jit"):
+            return ast.Call(func=dec, args=[], keywords=[])
+        if isinstance(dec, ast.Call):
+            if dotted_name(dec.func) in ("jax.jit", "jit"):
+                return dec
+            part = unwrap_partial(dec)
+            if (part is not None and part.args
+                    and dotted_name(part.args[0]) in ("jax.jit", "jit")):
+                return ast.Call(func=part.args[0], args=[],
+                                keywords=part.keywords)
+    return None
+
+
+def int_tuple_literal(node: ast.AST,
+                      consts: dict[str, int]) -> Optional[list[Optional[int]]]:
+    """Resolve a tuple/list literal of dimension sizes; each element is an
+    int (literal or module-level constant) or None when unresolvable.
+    Returns None when ``node`` is not a tuple/list literal at all."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: list[Optional[int]] = []
+    for el in node.elts:
+        v = literal_int(el)
+        if v is None and isinstance(el, ast.Name):
+            v = consts.get(el.id)
+        out.append(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# running
+# ---------------------------------------------------------------------------
+
+class LintError(Exception):
+    """Internal failure (unreadable file, rule crash) — exit code 2."""
+
+
+def lint_source(src: str, path: str = "<string>",
+                select: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Lint one source blob; returns suppression-filtered findings."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        raise LintError(f"{path}: syntax error at line {e.lineno}: "
+                        f"{e.msg}") from e
+    supp = Suppressions(src)
+    if supp.skip_file:
+        return []
+    ctx = ModuleContext(path, src, tree)
+    # line -> first line of the innermost statement covering it, so a
+    # suppression on a multi-line call's first line covers findings
+    # anchored to argument nodes on its later lines (nested statements
+    # start later, so max() picks the innermost)
+    stmt_start: dict[int, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt) and node.end_lineno is not None:
+            for line in range(node.lineno, node.end_lineno + 1):
+                stmt_start[line] = max(stmt_start.get(line, 1),
+                                       node.lineno)
+    wanted = set(select) if select is not None else None
+    out: list[Finding] = []
+    for rule_id, rule in sorted(_REGISTRY.items()):
+        if wanted is not None and rule_id not in wanted:
+            continue
+        try:
+            for f in rule.check(ctx):
+                if supp.allows(f, stmt_start.get(f.line)):
+                    out.append(f)
+        except LintError:
+            raise
+        except Exception as e:  # a rule crash is an engine bug: code 2
+            raise LintError(
+                f"{path}: rule {rule_id!r} crashed: {type(e).__name__}: "
+                f"{e}") from e
+    return sorted(out)
+
+
+def lint_file(path: str,
+              select: Optional[Iterable[str]] = None) -> list[Finding]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+    except OSError as e:
+        raise LintError(f"cannot read {path}: {e}") from e
+    return lint_source(src, path=path, select=select)
+
+
+# Directories never worth descending into.  ``fixtures`` holds test DATA
+# (including the analysis rules' deliberately-bad snippets); the lint
+# tests exercise those files explicitly via lint_file.
+_SKIP_DIRS = {"__pycache__", ".git", ".hypothesis", ".pytest_cache",
+              "node_modules", "fixtures", ".jax_cache"}
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for root in paths:
+        if os.path.isfile(root):
+            yield root
+            continue
+        if not os.path.isdir(root):
+            # a vanished root must fail loudly (exit 2), not shrink the
+            # gate's coverage to whatever paths still exist
+            raise LintError(f"path does not exist: {root}")
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_tree(paths: Iterable[str],
+              select: Optional[Iterable[str]] = None,
+              on_file: Optional[Callable[[str], None]] = None
+              ) -> list[Finding]:
+    """Lint every .py under ``paths`` (files or directories)."""
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        if on_file is not None:
+            on_file(path)
+        findings.extend(lint_file(path, select=select))
+    return sorted(findings)
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def render_text(findings: list[Finding]) -> str:
+    lines = [f"{f.path}:{f.line}:{f.col + 1}: {f.rule}: {f.message}"
+             for f in findings]
+    n = len(findings)
+    lines.append(f"{n} finding{'s' if n != 1 else ''}")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding], files_checked: int) -> str:
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return json.dumps({
+        "version": 1,
+        "files_checked": files_checked,
+        "findings": [f.to_dict() for f in findings],
+        "counts": by_rule,
+    }, indent=2, sort_keys=True)

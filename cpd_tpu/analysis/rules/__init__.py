@@ -1,0 +1,22 @@
+"""Built-in rules.  Importing this package registers every rule with the
+core registry (each module applies ``@core.register`` at import time).
+
+Rule IDs (stable — they are the suppression-comment vocabulary):
+
+  format-bounds    eXmY literals outside exp[1,8]/man[0,23]; constants
+                   that overflow a literal-declared format
+  axis-name        collective axis names with no mesh binding in module
+  jit-hazards      traced-value control flow / host calls / unhashable
+                   static defaults inside @jax.jit bodies
+  pallas-hygiene   fresh allocations in kernels; off-tile BlockSpec
+                   shapes; BlockSpecs without a memory space
+  kahan-ordering   unordered jnp.sum/lax.psum over quantized values
+                   where the ordered primitives exist
+  donation         reuse of a buffer after donating it to a jitted call
+"""
+
+from . import (axis_name, donation, format_bounds, jit_hazards,  # noqa: F401
+               kahan_ordering, pallas_hygiene)
+
+__all__ = ["format_bounds", "axis_name", "jit_hazards", "pallas_hygiene",
+           "kahan_ordering", "donation"]

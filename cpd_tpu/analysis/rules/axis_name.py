@@ -1,0 +1,120 @@
+"""axis-name: collective axis literals must be bound somewhere in the
+module.
+
+``lax.psum(x, "pd")`` inside a mesh whose axes are ``("dp", "tp")``
+raises only when the shard_map actually traces — and in test/example
+code the bad spelling frequently hides behind a rarely-run config
+branch.  EQuARX-class bugs (PAPERS.md) are silent because quantized
+collectives don't crash on semantic mistakes; spelling is the one part
+we can gate statically.
+
+Scope: module-local.  Axis *bindings* are collected from every mesh
+constructor / PartitionSpec in the file; ``lax`` collective calls whose
+axis argument is a string (or tuple-of-string) literal must use bound
+names.  Modules that bind NO axes (pure library code that takes
+``axis_name`` as a parameter) are exempt — the rule only fires where a
+mesh is actually declared, so helpers like parallel/dist.py stay quiet.
+
+Repo-specific bindings understood: ``make_mesh(...)`` /
+``data_parallel_mesh(...)`` (parallel/mesh.py) always create all five
+canonical axes dp/tp/sp/pp/ep (size-1 axes are kept, see make_mesh's
+docstring).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, Rule, base_name, call_arg,
+                    dotted_name, register, string_literals)
+
+_MESH_CANONICAL = {"dp", "tp", "sp", "pp", "ep"}
+
+# collective -> (positional index of axis arg, keyword name)
+_COLLECTIVES = {
+    "psum": (1, "axis_name"),
+    "pmean": (1, "axis_name"),
+    "pmax": (1, "axis_name"),
+    "pmin": (1, "axis_name"),
+    "ppermute": (1, "axis_name"),
+    "pshuffle": (1, "axis_name"),
+    "psum_scatter": (1, "axis_name"),
+    "all_gather": (1, "axis_name"),
+    "all_to_all": (1, "axis_name"),
+    "axis_index": (0, "axis_name"),
+    "axis_size": (0, "axis_name"),
+    # repo collectives with the same contract (parallel/dist.py)
+    "broadcast_from": (1, "axis_name"),
+    "all_reduce_mean": (1, "axis_name"),
+    "pmax_scalar_vector": (1, "axis_name"),
+}
+
+
+def _axis_strings(node: ast.AST) -> list[ast.Constant]:
+    """String constants naming axes in an axis argument: a bare literal,
+    or a tuple/list of literals.  Anything else (a variable, an
+    f-string) is unresolvable -> []."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [el for el in node.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, str)]
+    return []
+
+
+def _declared_axes(ctx: ModuleContext) -> set[str]:
+    declared: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = base_name(node.func)
+        if name in ("make_mesh", "data_parallel_mesh"):
+            declared |= _MESH_CANONICAL
+        elif name == "Mesh":
+            # jax.sharding.Mesh(devices, axis_names)
+            axes = call_arg(node, 1, "axis_names")
+            if axes is not None:
+                declared |= {c.value for c in string_literals(axes)}
+        elif dotted_name(node.func) in ("jax.make_mesh", "make_mesh2"):
+            axes = call_arg(node, 1, "axis_names")
+            if axes is not None:
+                declared |= {c.value for c in string_literals(axes)}
+        elif name in ("PartitionSpec", "P"):
+            declared |= {c.value for c in string_literals(node)}
+        elif name in ("shard_map", "pjit"):
+            for kw in node.keywords:
+                if kw.arg in ("in_specs", "out_specs", "axis_names"):
+                    declared |= {c.value
+                                 for c in string_literals(kw.value)}
+    return declared
+
+
+@register
+class AxisName(Rule):
+    id = "axis-name"
+    summary = ("collective axis-name literals must match an axis bound "
+               "by a mesh/PartitionSpec in the same module")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        declared = _declared_axes(ctx)
+        if not declared:
+            return  # library module: axes flow in as parameters
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = base_name(node.func)
+            spec = _COLLECTIVES.get(name)
+            if spec is None:
+                continue
+            axis_arg = call_arg(node, spec[0], spec[1])
+            if axis_arg is None:
+                continue
+            for const in _axis_strings(axis_arg):
+                if const.value not in declared:
+                    yield ctx.finding(
+                        self.id, const,
+                        f"{name}: axis {const.value!r} is not bound by "
+                        f"any mesh/PartitionSpec in this module "
+                        f"(bound here: {sorted(declared)})")

@@ -1,0 +1,140 @@
+"""donation: a donated buffer must not be read after the donating call.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's buffer to XLA
+for reuse; touching the Python handle afterwards raises (on strict
+backends) or silently reads garbage (on others — the worse outcome).
+The one legitimate idiom is rebinding the result over the donated name
+(``state = step(state, batch)``), which this rule recognizes and allows
+— including tuple unpacking (``state, metrics = step(state, ...)``) and
+the same pattern inside loops.
+
+Tracked donors (module-local, literal donate_argnums only):
+
+* ``@functools.partial(jax.jit, donate_argnums=(0,))`` decorated defs;
+* names bound to ``jax.jit(fn, donate_argnums=...)`` assignments.
+
+A use is flagged when the donated argument is a plain name read later in
+the same scope with no intervening rebind.  Ordering is by line number —
+an approximation of control flow that is cheap, predictable, and right
+for the straight-line train-loop code this repo writes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, Rule, dotted_name,
+                    iter_functions, jit_decoration, literal_int, register,
+                    walk_scope)
+
+
+def _donated_indices(jit_call: ast.Call) -> set[int]:
+    out: set[int] = set()
+    for kw in jit_call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = literal_int(kw.value)
+        if v is not None:
+            out.add(v)
+        elif isinstance(kw.value, (ast.Tuple, ast.List)):
+            for el in kw.value.elts:
+                v = literal_int(el)
+                if v is not None:
+                    out.add(v)
+    return out
+
+
+def _donors(ctx: ModuleContext) -> dict[str, set[int]]:
+    """callable name -> donated positional indices."""
+    donors: dict[str, set[int]] = {}
+    for fn in iter_functions(ctx.tree):
+        jit_call = jit_decoration(fn)
+        if jit_call is not None:
+            idx = _donated_indices(jit_call)
+            if idx:
+                donors[fn.name] = idx
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in ("jax.jit", "jit")):
+            idx = _donated_indices(node.value)
+            if idx:
+                donors[node.targets[0].id] = idx
+    return donors
+
+
+def _rebinds_same_name(parents: dict, call: ast.Call, name: str) -> bool:
+    """True when the donating call's own assignment rebinds ``name``
+    (the ``state = step(state, ...)`` idiom, tuple targets included)."""
+    node = call
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                els = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                if any(isinstance(e, ast.Name) and e.id == name
+                       for e in els):
+                    return True
+            return False
+        if isinstance(node, (ast.stmt,)):
+            return False
+    return False
+
+
+@register
+class Donation(Rule):
+    id = "donation"
+    summary = ("a buffer passed at a donate_argnums position is dead "
+               "after the call unless rebound from its result")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        donors = _donors(ctx)
+        if not donors:
+            return
+        for scope in [ctx.tree, *iter_functions(ctx.tree)]:
+            nodes = list(walk_scope(scope))
+            parents: dict = {}
+            for n in nodes:
+                for child in ast.iter_child_nodes(n):
+                    parents.setdefault(child, n)
+            stores = [(n.lineno, n.id) for n in nodes
+                      if isinstance(n, ast.Name)
+                      and isinstance(n.ctx, (ast.Store, ast.Del))]
+            loads = [n for n in nodes
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)]
+
+            events: list[tuple[str, int]] = []
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = (node.func.id
+                         if isinstance(node.func, ast.Name) else None)
+                idxs = donors.get(fname or "")
+                if not idxs:
+                    continue
+                for i in sorted(idxs):
+                    if i < len(node.args) and isinstance(
+                            node.args[i], ast.Name):
+                        name = node.args[i].id
+                        if not _rebinds_same_name(parents, node, name):
+                            events.append((name, node.lineno))
+
+            for name, line in events:
+                for load in sorted(loads, key=lambda n: n.lineno):
+                    if load.lineno <= line or load.id != name:
+                        continue
+                    if any(s_name == name and line < s_line <= load.lineno
+                           for s_line, s_name in stores):
+                        break  # rebound first: later reads are fine
+                    yield ctx.finding(
+                        self.id, load,
+                        f"{name!r} was donated at line {line} "
+                        f"(donate_argnums) — its buffer may already be "
+                        f"reused; read the call's result instead, or "
+                        f"drop donation")
+                    break

@@ -1,0 +1,142 @@
+"""format-bounds: eXmY format literals must be representable.
+
+The whole stack funnels through ``quant/numerics.py:_validate`` —
+``exp_bits in [1, 8]``, ``man_bits in [0, 23]`` — but that check fires at
+TRACE time, which for a 90-epoch run config can be hours into a job (or
+never, when the bad call sits on a rarely-taken branch).  This rule moves
+the check to lint time for every call site that passes literal ints.
+
+Second check: a numeric constant passed as the DATA argument of a cast
+whose literal format cannot represent it (|x| > max finite) silently
+saturates to ±Inf under the reference semantics (pre-rounding exponent
+overflow, numerics.py docstring) — almost always a wrong-format bug, not
+an intended Inf.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import (Finding, ModuleContext, Rule, base_name, call_arg,
+                    literal_float, literal_int, register)
+
+# API name -> ((exp pos, exp kw), (man pos, man kw), data positions)
+# Positions mirror the real signatures; note quant_gemm's (man, exp)
+# order and quantizer's two format pairs.
+_APIS: dict[str, list[tuple[tuple[Optional[int], Optional[str]],
+                            tuple[Optional[int], Optional[str]],
+                            tuple[int, ...]]]] = {
+    "cast_to_format":      [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "cast_to_format_sr":   [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "cast_to_format_sr_at": [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "cast_body":           [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "cast_body_sr":        [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "cast_oracle":         [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "cast_oracle_sr":      [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "quantize_pallas":     [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "quantize_pallas_sr":  [((1, "exp_bits"), (2, "man_bits"), (0,))],
+    "qgemm_pallas":        [((2, "exp_bits"), (3, "man_bits"), (0, 1))],
+    "max_finite":          [((0, "exp_bits"), (1, "man_bits"), ())],
+    "float_quantize":      [((1, "exp"), (2, "man"), (0,))],
+    "quant_gemm":          [((3, "exp"), (2, "man"), (0, 1))],
+    "ordered_quantized_sum": [((1, "exp"), (2, "man"), (0,))],
+    "kahan_quantized_sum": [((1, "exp"), (2, "man"), (0,))],
+    "quantized_sum":       [((1, "exp"), (2, "man"), (0,))],
+    "sum_gradients":       [((3, "grad_exp"), (4, "grad_man"), ())],
+    "emulate_node_reduce": [((3, "grad_exp"), (4, "grad_man"), ())],
+    "make_sum_gradients_fn": [((None, "grad_exp"), (None, "grad_man"), ())],
+    "quantizer":           [((0, "forward_exp"), (1, "forward_man"), ()),
+                            ((2, "backward_exp"), (3, "backward_man"), ())],
+    "quantizer_sr":        [((0, "forward_exp"), (1, "forward_man"), ()),
+                            ((2, "backward_exp"), (3, "backward_man"), ())],
+}
+
+# Keyword names that carry an eXmY component on ANY call (quant modules,
+# train-step builders, configs all reuse this vocabulary).
+_GENERIC_KW = {
+    "exp_bits": "exp", "grad_exp": "exp", "forward_exp": "exp",
+    "backward_exp": "exp", "act_exp": "exp", "weight_exp": "exp",
+    "man_bits": "man", "grad_man": "man", "forward_man": "man",
+    "backward_man": "man", "act_man": "man", "weight_man": "man",
+}
+
+_EXP_RANGE = (1, 8)
+_MAN_RANGE = (0, 23)
+
+
+def _max_finite(exp_bits: int, man_bits: int) -> float:
+    """Largest normal value of the format (same formula as
+    quant/numerics.py max_finite, restated here so the linter never
+    imports jax)."""
+    bias = (1 << (exp_bits - 1)) - 1
+    e_max = ((1 << exp_bits) - 2) - bias
+    return (2.0 - 2.0 ** (-man_bits)) * (2.0 ** e_max)
+
+
+def _check_component(value: Optional[int], kind: str):
+    """Return an error string for an out-of-range literal, else None."""
+    if value is None:
+        return None
+    lo, hi = _EXP_RANGE if kind == "exp" else _MAN_RANGE
+    if not (lo <= value <= hi):
+        what = "exp_bits" if kind == "exp" else "man_bits"
+        return (f"{what}={value} outside the legal eXmY range "
+                f"[{lo}, {hi}] (quant/numerics.py _validate would raise "
+                f"at trace time)")
+    return None
+
+
+@register
+class FormatBounds(Rule):
+    id = "format-bounds"
+    summary = ("literal eXmY components must satisfy exp in [1,8] / man "
+               "in [0,23]; literal operands must fit the declared format")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = base_name(node.func)
+            specs = _APIS.get(name)
+            if specs is not None:
+                for (epos, ekw), (mpos, mkw), data_pos in specs:
+                    e_arg = call_arg(node, epos, ekw)
+                    m_arg = call_arg(node, mpos, mkw)
+                    exp = literal_int(e_arg) if e_arg is not None else None
+                    man = literal_int(m_arg) if m_arg is not None else None
+                    for val, kind, anchor in ((exp, "exp", e_arg),
+                                              (man, "man", m_arg)):
+                        msg = _check_component(val, kind)
+                        if msg:
+                            yield ctx.finding(self.id, anchor or node,
+                                              f"{name}: {msg}")
+                    # representability of literal data in a fully-literal,
+                    # in-range format
+                    if (exp is not None and man is not None
+                            and _check_component(exp, "exp") is None
+                            and _check_component(man, "man") is None):
+                        limit = _max_finite(exp, man)
+                        for dp in data_pos:
+                            d_arg = call_arg(node, dp, None)
+                            if d_arg is None:
+                                continue
+                            v = literal_float(d_arg)
+                            if v is not None and abs(v) > limit:
+                                yield ctx.finding(
+                                    self.id, d_arg,
+                                    f"{name}: constant {v!r} exceeds "
+                                    f"e{exp}m{man}'s max finite value "
+                                    f"{limit!r} — the cast saturates to "
+                                    f"±Inf (pre-rounding overflow, "
+                                    f"quant/numerics.py)")
+            else:
+                # unknown callee: still police the shared kwarg vocabulary
+                for kw in node.keywords:
+                    kind = _GENERIC_KW.get(kw.arg or "")
+                    if kind is None:
+                        continue
+                    msg = _check_component(literal_int(kw.value), kind)
+                    if msg:
+                        yield ctx.finding(self.id, kw.value,
+                                          f"{name or 'call'}: {msg}")

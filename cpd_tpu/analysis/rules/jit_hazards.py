@@ -1,0 +1,191 @@
+"""jit-hazards: tracing-unsafe Python inside ``@jax.jit`` bodies.
+
+Three classes of bug that crash (or silently retrace) only when the
+function is first traced:
+
+1. Python ``if``/``while`` on a traced argument — raises
+   TracerBoolConversionError at trace time; the fix is ``lax.cond`` /
+   ``jnp.where`` or marking the argument static.  Shape/dtype
+   inspection (``x.ndim``, ``x.shape[0]``, ``x.size``…) is static and
+   allowed, including through simple local aliases (``n = x.size``).
+2. Host escapes on traced values: ``np.*`` calls taking a traced arg,
+   ``.item()`` / ``.tolist()``, and ``float()/int()/bool()`` coercions —
+   ConcretizationTypeError at trace time.
+3. ``static_argnums`` pointing at a parameter whose default is an
+   unhashable literal (list/dict/set) — TypeError at the first cache
+   lookup, i.e. the first CALL, possibly much later than import.
+
+The rule analyzes functions decorated ``@jax.jit`` / ``@jit`` /
+``@functools.partial(jax.jit, ...)`` — the only forms whose static
+arguments are statically knowable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, Rule, base_name, dotted_name,
+                    iter_functions, jit_decoration, literal_int, register)
+
+# attributes of a traced array that are static metadata, safe in
+# Python control flow
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "sharding",
+                 "weak_type", "itemsize"}
+
+# builtins whose truthiness/branching over a traced value is fine
+_SAFE_CALLS = {"isinstance", "len", "callable", "hasattr", "getattr",
+               "type", "jnp.shape", "jnp.ndim", "jnp.size",
+               "jnp.result_type"}
+
+_HOST_COERCIONS = {"float", "int", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist", "numpy"}
+_NUMPY_PREFIXES = ("np.", "numpy.")
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+def _static_params(fn: ast.FunctionDef, jit_call: ast.Call) -> set[str]:
+    """Parameter names pinned static by static_argnums/static_argnames."""
+    positional = [p.arg for p in fn.args.posonlyargs] + \
+                 [p.arg for p in fn.args.args]
+    static: set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            nums = ([literal_int(kw.value)]
+                    if literal_int(kw.value) is not None else
+                    [literal_int(el) for el in kw.value.elts]
+                    if isinstance(kw.value, (ast.Tuple, ast.List)) else [])
+            for n in nums:
+                if n is not None and 0 <= n < len(positional):
+                    static.add(positional[n])
+        elif kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    static.add(c.value)
+    return static
+
+
+def _static_derived(fn: ast.FunctionDef) -> set[str]:
+    """Local names assigned from static metadata of traced values:
+    ``n = x.size``, ``m, k = a.shape``, ``d = x.shape[1]``,
+    ``r = len(x)``."""
+
+    def is_static_expr(node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute):
+            return node.attr in _STATIC_ATTRS
+        if isinstance(node, ast.Subscript):
+            return is_static_expr(node.value)
+        if (isinstance(node, ast.Call) and base_name(node.func) == "len"):
+            return True
+        return False
+
+    derived: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or not is_static_expr(node.value):
+            continue
+        for tgt in node.targets:
+            names = (tgt.elts if isinstance(tgt, ast.Tuple) else [tgt])
+            for n in names:
+                if isinstance(n, ast.Name):
+                    derived.add(n.id)
+    return derived
+
+
+def _traced_names_in_test(node: ast.AST, traced: set[str]) -> list[ast.Name]:
+    """Occurrences of traced params in a branch test, skipping static
+    attribute accesses and shape-inspection calls."""
+    hits: list[ast.Name] = []
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return  # x.ndim etc: static, don't descend into x
+        if isinstance(n, ast.Call):
+            cal = dotted_name(n.func)
+            if cal in _SAFE_CALLS or base_name(n.func) in _SAFE_CALLS:
+                return
+        if isinstance(n, ast.Name) and n.id in traced:
+            hits.append(n)
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return hits
+
+
+@register
+class JitHazards(Rule):
+    id = "jit-hazards"
+    summary = ("no Python branching, numpy/host calls, or unhashable "
+               "static defaults on traced values inside @jax.jit bodies")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in iter_functions(ctx.tree):
+            jit_call = jit_decoration(fn)
+            if jit_call is None:
+                continue
+            static = _static_params(fn, jit_call)
+            traced = {p for p in _param_names(fn) if p not in static}
+            traced -= {"self", "cls"}
+            traced -= _static_derived(fn)
+
+            # (3) unhashable static defaults
+            positional = [p.arg for p in fn.args.posonlyargs] + \
+                         [p.arg for p in fn.args.args]
+            defaults = fn.args.defaults
+            defaulted = positional[len(positional) - len(defaults):]
+            for pname, dflt in zip(defaulted, defaults):
+                if pname in static and isinstance(
+                        dflt, (ast.List, ast.Dict, ast.Set)):
+                    yield ctx.finding(
+                        self.id, dflt,
+                        f"static argument {pname!r} has an unhashable "
+                        f"{type(dflt).__name__.lower()} default — jit's "
+                        f"cache lookup raises TypeError at first call")
+
+            for node in ast.walk(fn):
+                # (1) control flow on traced values
+                if isinstance(node, (ast.If, ast.While)):
+                    for hit in _traced_names_in_test(node.test, traced):
+                        kind = ("while" if isinstance(node, ast.While)
+                                else "if")
+                        yield ctx.finding(
+                            self.id, hit,
+                            f"Python `{kind}` on traced argument "
+                            f"{hit.id!r} raises at trace time — use "
+                            f"lax.cond/jnp.where, or mark it static")
+                # (2) host escapes
+                elif isinstance(node, ast.Call):
+                    cal = dotted_name(node.func)
+                    if cal.startswith(_NUMPY_PREFIXES):
+                        if any(isinstance(sub, ast.Name) and sub.id in traced
+                               for arg in node.args
+                               for sub in ast.walk(arg)):
+                            yield ctx.finding(
+                                self.id, node,
+                                f"host numpy call `{cal}` consumes a "
+                                f"traced value inside jit — use jnp")
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in _HOST_METHODS
+                          and isinstance(node.func.value, ast.Name)
+                          and node.func.value.id in traced):
+                        yield ctx.finding(
+                            self.id, node,
+                            f".{node.func.attr}() on traced argument "
+                            f"{node.func.value.id!r} forces a host "
+                            f"transfer — ConcretizationTypeError under "
+                            f"jit")
+                    elif (isinstance(node.func, ast.Name)
+                          and node.func.id in _HOST_COERCIONS
+                          and len(node.args) == 1
+                          and isinstance(node.args[0], ast.Name)
+                          and node.args[0].id in traced):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"{node.func.id}() coercion of traced "
+                            f"argument {node.args[0].id!r} — "
+                            f"ConcretizationTypeError under jit")

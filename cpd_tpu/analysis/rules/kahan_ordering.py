@@ -1,0 +1,157 @@
+"""kahan-ordering: unordered reductions over quantized values.
+
+The reduction order of ``jnp.sum`` / ``lax.psum`` is XLA's to choose —
+the exact property the faithful pipeline exists to remove
+(parallel/reduction.py's docstring: order *is* the semantics being
+emulated; qgemm.py: a property psum cannot give).  Summing values that
+just went through an eXmY cast with an unordered reduction therefore
+silently reintroduces tree-order nondeterminism: results change across
+backends, topologies, and XLA versions, which is an accuracy bug in an
+emulator whose claim is bit-faithfulness.
+
+Detected shapes (function-scope dataflow, one level deep):
+
+    q = cast_to_format(x, 5, 2);  jnp.sum(q)          # direct
+    jnp.sum(float_quantize(x, 5, 2))                   # nested
+    g = quantize_tree_sr(g, e, m, k)
+    jax.tree.map(lambda v: lax.psum(v, ax), g)         # tree.map'd
+
+Fix: route through ``parallel.reduction.quantized_sum`` (ordered scan,
+optionally Kahan) or ``ops.qgemm_pallas`` for dots — or suppress with a
+justification where XLA-order reduction is the documented intent (the
+``mode="fast"`` deployment path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import (Finding, ModuleContext, Rule, base_name, dotted_name,
+                    iter_functions, register, walk_scope)
+
+_PRODUCERS = {"cast_to_format", "cast_to_format_sr", "cast_to_format_sr_at",
+              "cast_body", "cast_body_sr", "float_quantize",
+              "quantize_pallas", "quantize_pallas_sr", "quantize_tree_sr"}
+
+_UNORDERED = {"jnp.sum", "jnp.mean", "jnp.nansum", "np.sum",
+              "jax.numpy.sum", "jax.numpy.mean",
+              "lax.psum", "lax.pmean", "jax.lax.psum", "jax.lax.pmean",
+              "psum", "pmean"}
+
+_TREE_MAPS = {"jax.tree.map", "jax.tree_util.tree_map", "tree_map",
+              "jax.tree_map"}
+
+
+def _is_producer_call(node: ast.AST, local_producers: set[str]) -> bool:
+    return (isinstance(node, ast.Call)
+            and (base_name(node.func) in _PRODUCERS
+                 or base_name(node.func) in local_producers))
+
+
+def _local_producer_names(scope: ast.AST) -> set[str]:
+    """Functions/lambdas defined in this scope whose body calls a quant
+    producer — one level of wrapper, enough for the `q = partial(cast…)`
+    / `def q_tree(...)` idioms."""
+    out: set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and base_name(sub.func) in _PRODUCERS):
+                    out.add(node.name)
+                    break
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+              and isinstance(node.targets[0], ast.Name)):
+            val = node.value
+            if isinstance(val, ast.Lambda):
+                for sub in ast.walk(val):
+                    if (isinstance(sub, ast.Call)
+                            and base_name(sub.func) in _PRODUCERS):
+                        out.add(node.targets[0].id)
+                        break
+    return out
+
+
+def _unordered_name(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name in _UNORDERED or base_name(call.func) in ("psum", "pmean"):
+        return name or base_name(call.func)
+    return None
+
+
+def _contains_unordered(node: ast.AST) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            n = _unordered_name(sub)
+            if n:
+                return n
+    return None
+
+
+@register
+class KahanOrdering(Rule):
+    id = "kahan-ordering"
+    summary = ("quantized values must be reduced with the ordered "
+               "primitives (parallel.reduction), not jnp.sum/lax.psum")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        scopes: list[ast.AST] = [ctx.tree, *iter_functions(ctx.tree)]
+        scopes += [n for n in ast.walk(ctx.tree)
+                   if isinstance(n, ast.Lambda)]
+        for scope in scopes:
+            local_prod = _local_producer_names(scope)
+            quant_names: set[str] = set()
+            body = getattr(scope, "body", [])
+            if isinstance(scope, ast.Lambda):
+                body = [scope.body]  # single expression scope
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # separate scope, analyzed on its own
+                # track assignments binding quantized values
+                for node in walk_scope(stmt):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    is_q = _is_producer_call(node.value, local_prod)
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            if is_q:
+                                quant_names.add(tgt.id)
+                            else:
+                                quant_names.discard(tgt.id)
+                # flag unordered reductions of quantized operands
+                for node in walk_scope(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    red = _unordered_name(node)
+                    if red is not None and node.args:
+                        arg = node.args[0]
+                        quant = (_is_producer_call(arg, local_prod)
+                                 or (isinstance(arg, ast.Name)
+                                     and arg.id in quant_names))
+                        if quant:
+                            yield ctx.finding(
+                                self.id, node,
+                                f"{red} over a quantized value: XLA's "
+                                f"reduction order is opaque, so this "
+                                f"drops the ordered-accumulation "
+                                f"semantics — use parallel.reduction."
+                                f"quantized_sum (or suppress if the "
+                                f"fast/deployment path is intended)")
+                        continue
+                    # jax.tree.map(f_with_psum, quantized_tree)
+                    if (dotted_name(node.func) in _TREE_MAPS
+                            and len(node.args) >= 2):
+                        tree_arg = node.args[1]
+                        if (isinstance(tree_arg, ast.Name)
+                                and tree_arg.id in quant_names):
+                            red = _contains_unordered(node.args[0])
+                            if red:
+                                yield ctx.finding(
+                                    self.id, node,
+                                    f"tree.map applies {red} over the "
+                                    f"quantized tree "
+                                    f"{tree_arg.id!r} — unordered "
+                                    f"reduction of quantized values "
+                                    f"(see parallel.reduction)")

@@ -1,0 +1,119 @@
+"""pallas-hygiene: TPU kernel bodies and BlockSpecs, checked statically.
+
+Three checks, all derived from /opt/skills-style Pallas TPU guidance and
+the conventions ops/quantize.py + ops/qgemm.py establish:
+
+1. **No fresh allocations in kernels.**  ``jnp.zeros((1024, 1024))``
+   inside a kernel body materializes outside the BlockSpec-managed VMEM
+   tiles; persistent accumulators belong in ``scratch_shapes`` and
+   initialization should go through the refs (``jnp.zeros_like(ref)``
+   and ``ref[...] =`` are fine and excluded).
+2. **Tile-aligned block shapes.**  BlockSpec block-shape literals whose
+   last dimension is not a multiple of 128 (lanes) or whose
+   second-to-last is not a multiple of 8 (fp32 sublanes) force Mosaic to
+   pad every block — legal but silently wasteful; leading dims of 1 are
+   the standard grid-mapped form and allowed.  Module-level integer
+   constants (``_LANES = 128``) are resolved before judging.
+3. **Explicit memory spaces.**  A BlockSpec that declares a block shape
+   but no ``memory_space`` leaves placement to defaults; this repo pins
+   every spec (``pltpu.VMEM`` et al.) so kernels read as their VMEM
+   budget (ops/quantize.py's 256 KiB note).
+
+Kernel bodies are found two ways: functions passed (possibly through
+``functools.partial``) as the first argument of a ``pallas_call`` in the
+same module, plus the ``*_kernel`` naming convention.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, Rule, base_name, call_arg,
+                    int_tuple_literal, iter_functions, register,
+                    unwrap_partial)
+
+_ALLOC_FNS = {"zeros", "ones", "full", "empty", "eye", "identity"}
+_LANES = 128
+_SUBLANES = 8
+
+
+def _kernel_names(ctx: ModuleContext) -> set[str]:
+    names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and base_name(node.func) == "pallas_call" and node.args):
+            first = node.args[0]
+            part = unwrap_partial(first)
+            if part is not None and part.args:
+                first = part.args[0]
+            if isinstance(first, ast.Name):
+                names.add(first.id)
+    for fn in iter_functions(ctx.tree):
+        if fn.name.endswith("_kernel"):
+            names.add(fn.name)
+    return names
+
+
+@register
+class PallasHygiene(Rule):
+    id = "pallas-hygiene"
+    summary = ("kernels must not allocate fresh arrays; BlockSpec shapes "
+               "should be (8,128)-tile aligned with explicit memory_space")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        kernels = _kernel_names(ctx)
+
+        # (1) allocations inside kernel bodies
+        for fn in iter_functions(ctx.tree):
+            if fn.name not in kernels:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = base_name(node.func)
+                if name in _ALLOC_FNS and node.args:
+                    # zeros_like(ref) etc. have their own names and are
+                    # excluded by construction; zeros(()) scalars are fine
+                    shape = int_tuple_literal(node.args[0],
+                                              ctx.int_constants)
+                    if shape is not None and len(shape) == 0:
+                        continue
+                    yield ctx.finding(
+                        self.id, node,
+                        f"jnp.{name}(...) inside kernel {fn.name!r} "
+                        f"allocates outside the BlockSpec tiles — use "
+                        f"scratch_shapes (pltpu.VMEM) and initialize "
+                        f"through the ref")
+
+        # (2)+(3) BlockSpec shape/memory-space checks, module-wide
+        for node in ast.walk(ctx.tree):
+            if (not isinstance(node, ast.Call)
+                    or base_name(node.func) != "BlockSpec"):
+                continue
+            shape_arg = call_arg(node, 0, "block_shape")
+            if shape_arg is None:
+                continue  # full-array spec: nothing to judge
+            dims = int_tuple_literal(shape_arg, ctx.int_constants)
+            if dims:
+                last = dims[-1]
+                if last is not None and last != 1 and last % _LANES:
+                    yield ctx.finding(
+                        self.id, shape_arg,
+                        f"BlockSpec last dim {last} is not a multiple of "
+                        f"{_LANES} (TPU lane count) — Mosaic pads every "
+                        f"block; pick a {_LANES}-multiple")
+                if len(dims) >= 2:
+                    sub = dims[-2]
+                    if sub is not None and sub != 1 and sub % _SUBLANES:
+                        yield ctx.finding(
+                            self.id, shape_arg,
+                            f"BlockSpec second-to-last dim {sub} is not "
+                            f"a multiple of {_SUBLANES} (fp32 sublanes) "
+                            f"— pick an {_SUBLANES}-multiple")
+            if call_arg(node, None, "memory_space") is None:
+                yield ctx.finding(
+                    self.id, node,
+                    "BlockSpec declares a block shape but no "
+                    "memory_space — pin it (pltpu.VMEM/SMEM/ANY) so the "
+                    "kernel's VMEM budget is explicit")

@@ -1,0 +1,51 @@
+"""Version-bridging shims over the installed JAX.
+
+The codebase targets the modern public surface (``jax.shard_map`` with
+``check_vma=``, promoted in jax 0.6); older jaxlibs (>= 0.4.30) ship the
+same primitive as ``jax.experimental.shard_map.shard_map`` with the flag
+spelled ``check_rep=``.  Everything in cpd_tpu (and its tests/tools)
+imports ``shard_map`` from here so the whole tree tracks one shim instead
+of sprinkling try/except at every call site.
+
+Stdlib-cheap rule: this module DOES import jax, so it must never be
+imported from ``cpd_tpu/__init__.py`` eagerly (see the lazy-export note
+there) — only from the L1/L2 modules that already depend on jax.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+try:  # jax >= 0.6: public
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x/0.5.x: experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _check_kw() -> str:
+    """The replication-check flag's spelling in the installed JAX.
+
+    Probed from the function's signature, not from which import
+    succeeded — the public promotion of shard_map and the
+    check_rep -> check_vma rename landed in different jax releases."""
+    import inspect
+    try:
+        params = inspect.signature(_shard_map).parameters
+    except (TypeError, ValueError):
+        return "check_rep"  # unsignaturable wrapper: assume the old name
+    return "check_vma" if "check_vma" in params else "check_rep"
+
+
+_CHECK_KW = _check_kw()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` with the replication-check flag translated.
+
+    Accepts the modern ``check_vma=`` spelling and forwards it under
+    whatever name the installed JAX uses.  All other keywords pass
+    through untouched."""
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -350,7 +350,11 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
     if mode == "fast":
         if not use_aps and not (grad_exp == 8 and grad_man == 23):
             grads = q_tree(grads, k_pre)
-        reduced = jax.tree.map(lambda g: lax.psum(g, axis_name), grads)
+        # fast mode IS the XLA-order psum by definition: same wire
+        # precision, no order emulation (module docstring) — the one
+        # place the unordered reduction is the documented intent.
+        reduced = jax.tree.map(  # cpd: disable=kahan-ordering
+            lambda g: lax.psum(g, axis_name), grads)
         if not (grad_exp == 8 and grad_man == 23):
             reduced = q_tree(reduced, k_post)
     else:
@@ -362,8 +366,11 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         # compression is possible without changing semantics.
         wire = _wire_dtype(grad_exp, grad_man) if use_aps else None
         if grad_exp == 8 and grad_man == 23 and not use_kahan:
-            # fp32 fast path == plain all-reduce (dist_util.py:55-59).
-            reduced = jax.tree.map(lambda g: lax.psum(g, axis_name), grads)
+            # fp32 fast path == plain all-reduce: the reference takes the
+            # same shortcut at the identity format (dist_util.py:55-59),
+            # so XLA-order psum here is reference parity, not a loss.
+            reduced = jax.tree.map(  # cpd: disable=kahan-ordering
+                lambda g: lax.psum(g, axis_name), grads)
         elif bucket:
             reduced = _bucketed_quantized_sum(grads, axis_name, grad_exp,
                                               grad_man, use_kahan,
@@ -395,7 +402,7 @@ def make_sum_gradients_fn(mesh: Mesh, axis_name: str = "data", **kwargs):
     `sum_gradients(model)` call (mix.py:286-291).  Trainers that jit a whole
     train step should instead call `sum_gradients` inline inside their
     shard_map — one trace, no extra dispatch."""
-    from jax import shard_map
+    from ..compat import shard_map
 
     fn = functools.partial(sum_gradients, axis_name=axis_name, **kwargs)
 

@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer import lm_param_specs
+from ..compat import shard_map
 from ..parallel.dist import grad_sr_key, sum_gradients
 from ..parallel.emulate import emulate_node_reduce
 from .state import (TrainState, make_sharded_stepper, reject_norm_based,
@@ -206,7 +207,7 @@ def make_lm_eval_step(model, mesh: Mesh, *, axis_dp: str = "dp",
         if key not in cache:
             specs = lm_state_specs(state, axis_tp)
             data_spec = P(axis_dp, axis_sp)
-            cache[key] = jax.jit(jax.shard_map(
+            cache[key] = jax.jit(shard_map(
                 eval_fn, mesh=mesh,
                 in_specs=(specs, data_spec, data_spec),
                 out_specs=P(), check_vma=False))

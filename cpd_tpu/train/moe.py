@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.moe import MoETransformerLM, moe_param_specs
+from ..compat import shard_map
 from ..parallel.dist import grad_sr_key, sum_gradients
 from .state import (TrainState, make_sharded_stepper, reject_norm_based,
                     state_specs_like)
@@ -137,7 +138,7 @@ def make_moe_eval_step(model: MoETransformerLM, mesh: Mesh, *,
         key = jax.tree.structure(state)
         if key not in cache:
             specs = moe_state_specs(state, axis_ep)
-            cache[key] = jax.jit(jax.shard_map(
+            cache[key] = jax.jit(shard_map(
                 eval_fn, mesh=mesh,
                 in_specs=(specs, P(data_axes), P(data_axes)),
                 out_specs=P(), check_vma=False))

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.pipeline_lm import (PipelinedLM, pp_param_specs,
                                   vocab_parallel_ce)
+from ..compat import shard_map
 from ..parallel.dist import grad_sr_key, sum_gradients
 from .state import (TrainState, make_sharded_stepper, reject_norm_based,
                     state_specs_like)
@@ -190,7 +191,7 @@ def make_pp_eval_step(model: PipelinedLM, mesh: Mesh, *,
         if key not in cache:
             specs = pp_state_specs(state, axis_pp, axis_tp,
                                     model.vocab_pp)
-            cache[key] = jax.jit(jax.shard_map(
+            cache[key] = jax.jit(shard_map(
                 eval_fn, mesh=mesh,
                 in_specs=(specs, P(axis_dp), P(axis_dp)),
                 out_specs=P(), check_vma=False))

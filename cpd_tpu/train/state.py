@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..compat import shard_map
+
 __all__ = ["TrainState", "create_train_state", "state_specs_like",
            "reject_norm_based", "make_sharded_stepper"]
 
@@ -98,7 +100,7 @@ def make_sharded_stepper(step_fn: Callable, specs_fn: Callable, mesh,
 
     def build(state_template):
         specs = specs_fn(state_template)
-        shard_fn = jax.shard_map(
+        shard_fn = shard_map(
             step_fn, mesh=mesh,
             in_specs=(specs, data_spec, data_spec),
             out_specs=(specs, P()),

@@ -40,6 +40,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
 from ..parallel.dist import grad_sr_key, sum_gradients
 from ..parallel.emulate import emulate_node_reduce
 from .state import TrainState
@@ -313,7 +314,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                                 opt_state=opt_state_spec
                                 if opt_state_spec is not None else P())
     data_spec = P(axis_name)    # batch-sharded
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map(
         step_fn, mesh=mesh,
         in_specs=(state_spec, data_spec, data_spec),
         out_specs=(state_spec, P()),
@@ -373,7 +374,7 @@ def make_eval_step(model, mesh: Mesh, *, axis_name: str = "dp",
                     / lax.psum(n, axis_name),
         }
 
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map(
         step_fn, mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name)),
         out_specs=P(),
@@ -422,7 +423,7 @@ def make_seg_eval_step(model, mesh: Mesh, num_classes: int, *,
             "union": lax.psum(union.astype(jnp.float32), axis_name),
         }
 
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map(
         step_fn, mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name)),
         out_specs=P(),

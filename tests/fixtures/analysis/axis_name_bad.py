@@ -1,0 +1,17 @@
+"""Lint fixture: `axis-name` — collectives naming axes the module never
+binds.  The mesh declares ("dp", "tp"); "pd" is the classic typo."""
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+spec = P("dp")
+
+
+def grads_mean(x):
+    return lax.pmean(x, "pd")              # typo: no such axis
+
+
+def gathered(x):
+    return lax.all_gather(x, "model", axis=0)   # unbound axis name

@@ -1,0 +1,22 @@
+"""Lint fixture: clean twin of axis_name_bad — axis literals all bound,
+and symbolic axis parameters are out of scope by design."""
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+spec = P("dp", "tp")
+
+
+def grads_mean(x):
+    return lax.pmean(x, "dp")
+
+
+def library_style(x, axis_name):
+    # a variable axis is the library idiom; unresolvable statically
+    return lax.psum(x, axis_name)
+
+
+def multi(x):
+    return lax.psum(x, ("dp", "tp"))

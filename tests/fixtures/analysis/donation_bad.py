@@ -1,0 +1,24 @@
+"""Lint fixture: `donation` — reading a buffer after donating it."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def train(state, batch):
+    out = step(state, batch)
+    return out + state          # state's buffer was donated above
+
+
+fast = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+
+def train2(state, batch):
+    out = fast(state, batch)
+    print(state)                # same bug via the jit-assignment form
+    return out

@@ -1,0 +1,23 @@
+"""Lint fixture: clean twin of donation_bad — the rebind idiom, and
+donation-free calls."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def train(state, batches):
+    for batch in batches:
+        state = step(state, batch)   # rebinding over the donated name
+    return state
+
+
+def train_tuple(state, batch, metrics_fn):
+    state, metrics = metrics_fn(state), None  # not a donor: untracked
+    out = step(state, batch)
+    return out, metrics

@@ -1,0 +1,15 @@
+"""Lint fixture: every statement below must trip `format-bounds`.
+Test data only — the tree walker skips fixtures/ directories."""
+
+from cpd_tpu.quant.numerics import cast_to_format, max_finite
+from cpd_tpu.quant.quant_function import float_quantize, quant_gemm
+
+
+def bad(x, a, b, step):
+    y = cast_to_format(x, 9, 2)            # exp_bits > 8
+    z = float_quantize(x, 5, 24)           # man > 23
+    g = quant_gemm(a, b, 2, 0)             # positional (man, exp): exp=0
+    m = max_finite(0, 10)                  # exp_bits < 1
+    w = cast_to_format(70000.0, 5, 2)      # e5m2 max finite is 57344
+    s = step(grad_exp=12, grad_man=2)      # shared kwarg vocabulary
+    return y, z, g, m, w, s

@@ -1,0 +1,15 @@
+"""Lint fixture: clean twin of format_bounds_bad — every call is legal."""
+
+from cpd_tpu.quant.numerics import cast_to_format, max_finite
+from cpd_tpu.quant.quant_function import float_quantize, quant_gemm
+
+
+def good(x, a, b, step, exp, man):
+    y = cast_to_format(x, 8, 23)           # fp32 identity format
+    z = float_quantize(x, 5, 2)            # e5m2
+    g = quant_gemm(a, b, 10, 5)            # fp16-ish accumulator
+    m = max_finite(4, 3)
+    w = cast_to_format(57344.0, 5, 2)      # exactly e5m2's max finite
+    v = cast_to_format(x, exp, man)        # non-literal: out of scope
+    s = step(grad_exp=5, grad_man=2)
+    return y, z, g, m, w, v, s

@@ -1,0 +1,31 @@
+"""Lint fixture: `jit-hazards` — tracing-unsafe Python under @jax.jit."""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def branch_on_traced(x):
+    if x > 0:                      # TracerBoolConversionError at trace
+        return -x
+    return x
+
+
+@jax.jit
+def loop_on_traced(x):
+    while x < 10:                  # same, while form
+        x = x + 1
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def unhashable_static(x, cfg=[1, 2]):   # TypeError at first cache lookup
+    return np.log(x)                     # host numpy on a traced value
+
+
+@jax.jit
+def host_escapes(x):
+    v = x.item()                   # forces host transfer
+    return float(x) + v            # concretization of a tracer

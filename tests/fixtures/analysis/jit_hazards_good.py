@@ -1,0 +1,31 @@
+"""Lint fixture: clean twin of jit_hazards_bad — static metadata
+branching, static args, and jnp-only bodies are all allowed."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shape_branching(x):
+    n = x.size                     # static metadata alias
+    if n == 0:
+        return x
+    if x.ndim != 2 or x.shape[0] > 8:
+        return x.reshape(-1)
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def static_branching(x, mode, depth=3):
+    if mode == "fast":             # static: fine
+        return x * depth
+    return jnp.where(x > 0, x, -x)  # traced branch, done the right way
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def static_by_name(x, cfg="a"):
+    if cfg == "a":
+        return x + 1
+    return x

@@ -1,0 +1,23 @@
+"""Lint fixture: `kahan-ordering` — unordered reductions over values
+that just went through an eXmY cast."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cpd_tpu.quant.numerics import cast_to_format
+from cpd_tpu.parallel.dist import quantize_tree_sr
+
+
+def direct(x):
+    q = cast_to_format(x, 5, 2)
+    return jnp.sum(q)                       # XLA picks the order
+
+
+def nested(g, axis_name):
+    return lax.psum(cast_to_format(g, 4, 3), axis_name)
+
+
+def tree_mapped(grads, axis_name, key):
+    grads = quantize_tree_sr(grads, 5, 2, key)
+    return jax.tree.map(lambda g: lax.psum(g, axis_name), grads)

@@ -1,0 +1,24 @@
+"""Lint fixture: clean twin of kahan_ordering_bad — ordered primitives
+for quantized data; unordered reductions only over full-precision
+values; rebinding to an unquantized value clears the taint."""
+
+import jax.numpy as jnp
+from jax import lax
+
+from cpd_tpu.parallel.reduction import quantized_sum
+from cpd_tpu.quant.numerics import cast_to_format
+
+
+def ordered(stacked):
+    return quantized_sum(stacked, 5, 2, use_kahan=True)
+
+
+def full_precision(x, axis_name):
+    s = jnp.sum(x)                      # nothing quantized here
+    return lax.psum(s, axis_name)
+
+
+def rebound(x):
+    q = cast_to_format(x, 5, 2)
+    q = q * 0.0 + x                     # rebound to full precision
+    return jnp.sum(q)

@@ -1,0 +1,15 @@
+"""Lint fixture: `pallas-hygiene` — kernel allocation, off-tile block
+shape, missing memory space."""
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _bad_kernel(x_ref, o_ref):
+    acc = jnp.zeros((8, 128), jnp.float32)     # fresh alloc in kernel
+    o_ref[:] = x_ref[:] + acc
+
+
+ragged = pl.BlockSpec((16, 100), lambda i: (i, 0))   # 100 % 128 != 0,
+                                                     # and no memory_space
+odd_sublanes = pl.BlockSpec((12, 128), lambda i: (i, 0))  # 12 % 8 != 0

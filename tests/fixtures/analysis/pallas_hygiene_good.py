@@ -1,0 +1,21 @@
+"""Lint fixture: clean twin of pallas_hygiene_bad — scratch refs, tile
+multiples (including via module constants), explicit memory spaces."""
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_ROWS = 512
+
+
+def _good_kernel(x_ref, o_ref, acc_ref):
+    acc_ref[...] = jnp.zeros_like(acc_ref)     # init through the ref
+    o_ref[:] = x_ref[:] + acc_ref[...]
+
+
+aligned = pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0),
+                       memory_space=pltpu.VMEM)
+leading_ones = pl.BlockSpec((1, 1, 8, 128), lambda i, j: (i, 0, j, 0),
+                            memory_space=pltpu.VMEM)
+full_array = pl.BlockSpec(memory_space=pltpu.ANY)

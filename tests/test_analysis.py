@@ -1,0 +1,176 @@
+"""The lint gate (cpd_tpu.analysis) — fixture-proven rules + a clean
+live tree.
+
+Three layers:
+
+1. every rule has a deliberately-bad fixture that MUST fire (true
+   positive) and a clean twin that MUST stay silent under the whole
+   catalog (true negative);
+2. the suppression grammar (line / file / skip-file) is honored;
+3. the real tree — cpd_tpu, tests, tools, examples — lints clean, so
+   any regression fails pytest without a separate CI system, and the
+   CLI's exit-code contract (0 clean / 1 findings / 2 internal error)
+   stays pinned for tooling.
+
+The analysis package is stdlib-only, so this file runs in milliseconds
+and never touches jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cpd_tpu.analysis import all_rules, lint_file, lint_source, lint_tree
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+LINTED_PATHS = [os.path.join(REPO, d)
+                for d in ("cpd_tpu", "tests", "tools", "examples")]
+RULE_IDS = sorted(all_rules())
+
+
+def _fixture(rule_id: str, kind: str) -> str:
+    return os.path.join(FIXTURES, f"{rule_id.replace('-', '_')}_{kind}.py")
+
+
+def test_catalog_is_complete():
+    assert RULE_IDS == ["axis-name", "donation", "format-bounds",
+                        "jit-hazards", "kahan-ordering", "pallas-hygiene"]
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_bad_fixture_is_a_true_positive(rule_id):
+    findings = lint_file(_fixture(rule_id, "bad"), select=[rule_id])
+    assert findings, f"{rule_id}: bad fixture produced no findings"
+    assert all(f.rule == rule_id for f in findings)
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_good_fixture_is_a_true_negative(rule_id):
+    # clean under the WHOLE catalog, not just its own rule
+    findings = lint_file(_fixture(rule_id, "good"))
+    assert findings == [], (
+        f"{rule_id}: good fixture tripped "
+        f"{[(f.rule, f.line, f.message) for f in findings]}")
+
+
+def test_bad_fixture_finding_counts():
+    """Each bad fixture encodes a known number of defects; pin them so a
+    rule silently losing a check fails loudly."""
+    expected = {"format-bounds": 6, "axis-name": 2, "jit-hazards": 6,
+                "pallas-hygiene": 5, "kahan-ordering": 3, "donation": 2}
+    assert set(expected) == set(RULE_IDS), "new rule missing a count pin"
+    for rule_id, n in expected.items():
+        findings = lint_file(_fixture(rule_id, "bad"), select=[rule_id])
+        assert len(findings) == n, (
+            f"{rule_id}: expected {n} findings, got "
+            f"{[(f.line, f.message) for f in findings]}")
+
+
+# ---------------------------------------------------------------------------
+# suppression grammar
+# ---------------------------------------------------------------------------
+
+_BAD_LINE = "from cpd_tpu.quant.numerics import cast_to_format\n" \
+            "y = cast_to_format(x, 9, 2)"
+
+
+def test_line_suppression():
+    src = _BAD_LINE + "  # cpd: disable=format-bounds — testing\n"
+    assert lint_source(src) == []
+
+
+def test_line_suppression_ascii_justification():
+    # ASCII separators must work too, not just the em-dash
+    for sep in ("-- known-bad fixture", "because reasons"):
+        src = _BAD_LINE + f"  # cpd: disable=format-bounds {sep}\n"
+        assert lint_source(src) == [], sep
+
+
+def test_line_suppression_is_rule_scoped():
+    src = _BAD_LINE + "  # cpd: disable=axis-name\n"
+    assert [f.rule for f in lint_source(src)] == ["format-bounds"]
+
+
+def test_file_suppression():
+    src = "# cpd: disable-file=format-bounds\n" + _BAD_LINE + "\n"
+    assert lint_source(src) == []
+
+
+def test_skip_file():
+    src = "# cpd: skip-file\n" + _BAD_LINE + "\n"
+    assert lint_source(src) == []
+
+
+def test_unsuppressed_fires():
+    assert [f.rule for f in lint_source(_BAD_LINE + "\n")] \
+        == ["format-bounds"]
+
+
+def test_directives_in_docstrings_are_inert():
+    # the docstring MENTIONS skip-file/disable; only real comments count
+    src = ('"""Docs: use `# cpd: skip-file` or `# cpd: '
+           'disable-file=format-bounds`."""\n') + _BAD_LINE + "\n"
+    assert [f.rule for f in lint_source(src)] == ["format-bounds"]
+
+
+def test_statement_first_line_suppression_covers_multiline_call():
+    src = ("from cpd_tpu.quant.numerics import cast_to_format\n"
+           "y = cast_to_format(  # cpd: disable=format-bounds — testing\n"
+           "    x, 9, 2)\n")
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# the live tree is clean — THE gate
+# ---------------------------------------------------------------------------
+
+def test_live_tree_is_clean():
+    findings = lint_tree(LINTED_PATHS)
+    assert findings == [], (
+        "lint regressions in the live tree:\n" + "\n".join(
+            f"{f.path}:{f.line}: {f.rule}: {f.message}"
+            for f in findings))
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract (0/1/2) + JSON shape
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "cpd_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+def test_cli_exit_0_on_clean():
+    proc = _run_cli(_fixture("format-bounds", "good"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exit_1_on_findings_and_json_shape():
+    proc = _run_cli("--format=json", _fixture("format-bounds", "bad"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == 1
+    assert payload["files_checked"] == 1
+    assert payload["counts"]["format-bounds"] == len(payload["findings"])
+    f = payload["findings"][0]
+    assert set(f) == {"path", "line", "col", "rule", "message"}
+
+
+def test_cli_exit_2_on_internal_error():
+    assert _run_cli("/nonexistent/path_for_lint").returncode == 2
+    assert _run_cli("--select=not-a-rule", "cpd_tpu").returncode == 2
+    # one good root must not mask a vanished one (coverage shrink)
+    assert _run_cli("cpd_tpu", "/nonexistent/path_for_lint").returncode == 2
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in RULE_IDS:
+        assert rule_id in proc.stdout

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from cpd_tpu.compat import shard_map
 from cpd_tpu.models.transformer import (TransformerLM, lm_param_specs,
                                         transformer_lm)
 from cpd_tpu.ops.attention import local_attention, ring_attention
@@ -56,7 +57,7 @@ def test_ring_attention_matches_local(causal):
     def body(ql, kl, vl):
         return ring_attention(ql, kl, vl, "sp", causal=causal)
 
-    sharded = jax.jit(jax.shard_map(
+    sharded = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"), check_vma=False))(q, k, v)
@@ -76,7 +77,7 @@ def test_ring_attention_grads_match():
         def body(ql, kl, vl):
             o = ring_attention(ql, kl, vl, "sp", causal=True)
             return lax.psum(jnp.sum(o ** 2), "sp")
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(P(None, "sp"),) * 3, out_specs=P(),
             check_vma=False)(q, k, v)
@@ -103,7 +104,7 @@ def test_ulysses_attention_matches_local(causal):
     def body(ql, kl, vl):
         return ulysses_attention(ql, kl, vl, "sp", causal=causal)
 
-    sharded = jax.jit(jax.shard_map(
+    sharded = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(None, "sp"),) * 3,
         out_specs=P(None, "sp"), check_vma=False))(q, k, v)
@@ -125,7 +126,7 @@ def test_ulysses_attention_grads_match():
         def body(ql, kl, vl):
             o = ulysses_attention(ql, kl, vl, "sp", causal=True)
             return lax.psum(jnp.sum(o ** 2), "sp")
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(P(None, "sp"),) * 3, out_specs=P(),
             check_vma=False)(q, k, v)
@@ -162,7 +163,7 @@ def test_ring_attention_gqa_unexpanded_parity():
     def run(kk, vv):
         def body(ql, kl, vl):
             return ring_attention(ql, kl, vl, "sp", causal=True)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"), check_vma=False))(q, kk, vv)
 
@@ -190,7 +191,7 @@ def test_ring_attention_gqa_grads_match():
         def body(ql, kl, vl):
             o = ring_attention(ql, kl, vl, "sp", causal=True)
             return lax.psum(jnp.sum(o ** 2), "sp")
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(),
             check_vma=False)(q, k, v)
 
@@ -220,7 +221,7 @@ def test_ulysses_attention_gqa(hkv, sp):
     def run(kk, vv):
         def body(ql, kl, vl):
             return ulysses_attention(ql, kl, vl, "sp", causal=True)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"), check_vma=False))(q, kk, vv)
 
@@ -324,7 +325,7 @@ class TestChunkedAttention:
         def body(ql, kl, vl):
             return ring_attention(ql, kl, vl, "sp", causal=True,
                                   impl="chunked", block=4)
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"), check_vma=False))(q, k, v)
         np.testing.assert_allclose(np.asarray(got), np.asarray(full),
@@ -335,7 +336,7 @@ class TestChunkedAttention:
                 o = ring_attention(ql, kl, vl, "sp", causal=True,
                                    impl=impl, block=block)
                 return lax.psum(jnp.sum(o ** 2), "sp")
-            return jax.shard_map(
+            return shard_map(
                 body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
                 out_specs=P(), check_vma=False)
         g_ref = jax.grad(lambda a, b_, c: loss("xla", 512)(a, b_, c),
@@ -362,7 +363,7 @@ class TestChunkedAttention:
             def body(ql, kl, vl):
                 return ring_attention(ql, kl, vl, "sp", causal=True,
                                       impl="chunked", block=block)
-            return jax.jit(jax.shard_map(
+            return jax.jit(shard_map(
                 body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
                 out_specs=P(None, "sp"), check_vma=False))(
                     q[:, :t_slice], k[:, :t_slice], v[:, :t_slice])
@@ -388,7 +389,7 @@ class TestChunkedAttention:
             return ulysses_attention(ql, kl, vl, "sp", causal=True,
                                      impl="chunked")
 
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"), check_vma=False))(q, k, v)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -427,7 +428,7 @@ def test_ulysses_flash_gqa_native_unexpanded(monkeypatch):
         return ulysses_attention(ql, kl, vl, "sp", causal=True,
                                  impl="flash")
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
         out_specs=P(None, "sp"), check_vma=False))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(full),
@@ -456,7 +457,7 @@ def test_long_context_ring_chunked_smoke():
         def body(ql, kl, vl):
             return ring_attention(ql, kl, vl, "sp", causal=True,
                                   impl=impl, block=block)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"), check_vma=False))(q, k, v)
 
@@ -671,7 +672,7 @@ def test_lm_gqa_sharded_forward_matches_single():
     sh_model = _tiny_lm(n_kv_heads=2, tp_axis="tp", sp_axis="sp",
                         tp_size=2)
     specs = lm_param_specs(params, "tp")
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda p, t: sh_model.apply({"params": p}, t),
         mesh=mesh, in_specs=(specs, P("dp", "sp")),
         out_specs=P("dp", "sp"), check_vma=False))(params, toks)
@@ -759,7 +760,7 @@ def test_lm_unknown_sp_mode_raises():
     toks = jnp.zeros((1, 8), jnp.int32)                # silently ring
     mesh = make_mesh(sp=8, dp=1)
     with pytest.raises(ValueError, match="sp_mode"):
-        jax.shard_map(
+        shard_map(
             lambda t: model.init(jax.random.PRNGKey(0), t),
             mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
             check_vma=False)(toks)
@@ -780,7 +781,7 @@ def test_lm_ulysses_forward_matches_single():
                         sp_mode="ulysses")
     specs = lm_param_specs(params, "tp")
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda p, t: sh_model.apply({"params": p}, t),
         mesh=mesh, in_specs=(specs, P("dp", "sp")),
         out_specs=P("dp", "sp"), check_vma=False))(params, toks)
@@ -818,7 +819,7 @@ def test_lm_sharded_forward_matches_single():
     def fwd(p, t):
         return sh_model.apply({"params": p}, t)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         fwd, mesh=mesh, in_specs=(specs, P("dp", "sp")),
         out_specs=P("dp", "sp"), check_vma=False))(params, toks)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
@@ -963,13 +964,13 @@ def test_lm_sharded_grads_match_single_device():
 
         return jax.tree.map(reduce, grads, specs)
 
-    g_sh = jax.jit(jax.shard_map(
+    g_sh = jax.jit(shard_map(
         sharded_grads, mesh=mesh,
         in_specs=(specs, P("dp", "sp"), P("dp", "sp")),
         out_specs=specs, check_vma=False))(params, toks, tgts)
 
-    flat_ref = jax.tree.leaves_with_path(g_ref)
-    flat_sh = dict(jax.tree.leaves_with_path(g_sh))
+    flat_ref = jax.tree_util.tree_leaves_with_path(g_ref)
+    flat_sh = dict(jax.tree_util.tree_leaves_with_path(g_sh))
     assert len(flat_ref) == len(flat_sh)
     for path, leaf in flat_ref:
         np.testing.assert_allclose(

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from cpd_tpu.compat import shard_map
 from cpd_tpu.models import (davidnet, fcn_r50_d8, get_model, resnet18_cifar,
                             resnet50)
 
@@ -231,7 +232,7 @@ def test_vit_tp_sharded_matches_single_device():
     sharded = jax.device_put(variables["params"],
                              jax.tree.map(lambda s: NamedSharding(mesh, s),
                                           specs))
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda p, xx: sh.apply({"params": p}, xx, train=False),
         mesh=mesh, in_specs=(specs, P("dp")), out_specs=P("dp"),
         check_vma=False))(sharded, x)

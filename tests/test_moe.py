@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from cpd_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from cpd_tpu.models.moe import moe_lm, moe_param_specs

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from cpd_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from cpd_tpu.parallel import (aps_max_exponents, aps_shift_factors,

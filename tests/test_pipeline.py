@@ -10,7 +10,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import lax, shard_map
+from jax import lax
+from cpd_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from cpd_tpu.models.pipeline_lm import pipelined_lm, pp_param_specs

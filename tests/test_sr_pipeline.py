@@ -13,7 +13,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from cpd_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from cpd_tpu.parallel import (data_parallel_mesh, emulate_node_reduce,

@@ -487,8 +487,14 @@ def test_multi_step_fusion_bitwise(mesh):
 
 def test_sync_bn_statistics_are_cross_replica(mesh):
     """bn_axis='dp': one train step's NEW running stats must reflect the
-    GLOBAL batch mean, not the per-shard means (which differ when shards
-    see different data)."""
+    GLOBAL batch variance, not the per-shard ones (which differ when
+    shards see different data).
+
+    The discriminating statistic is `var`, not `mean`: the step pmean's
+    the local path's stats across ranks (train/step.py), and the pmean of
+    per-shard means IS the global mean — but the pmean of per-shard
+    variances is not the global variance (it misses the between-shard
+    spread), so only `var` distinguishes sync from local BN."""
     model_sync = tiny_cnn(bn_axis="dp")
     model_local = tiny_cnn()
     tx = make_optimizer("sgd", lambda s: jnp.float32(0.0))
@@ -505,13 +511,13 @@ def test_sync_bn_statistics_are_cross_replica(mesh):
         step = make_train_step(model, tx, mesh, donate=False)
         new_state, _ = step(state, x, y)
         stats[name] = float(np.asarray(
-            new_state.batch_stats["bn0"]["mean"]).mean())
-    # sync stats see the global batch; the local path pmean-averages
-    # per-shard stats computed from different normalizations -> different
-    assert stats["sync"] != stats["local"]
-    # sync running mean after one step = 0.9*0 + 0.1*global_batch_mean of
-    # the stem conv output; just sanity-check it moved off zero
-    assert abs(stats["sync"]) > 0.0
+            new_state.batch_stats["bn0"]["var"]).mean())
+    # sync stats see the global batch (between-shard spread included);
+    # the local path averages per-shard variances -> strictly smaller
+    assert stats["sync"] > stats["local"]
+    # sync running var after one step = 0.9*1 + 0.1*global_batch_var of
+    # the stem input; sanity-check it moved off the init value
+    assert stats["sync"] != 1.0
 
 
 def test_prefetcher_order_exceptions_and_close():

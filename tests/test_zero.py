@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from cpd_tpu.compat import shard_map
 from cpd_tpu.models import tiny_cnn
 from cpd_tpu.parallel.mesh import data_parallel_mesh
 from cpd_tpu.parallel.zero import zero1_sgd, zero2_sgd
@@ -185,7 +186,7 @@ def test_zero2_reduce_scatter_bitwise(exp, man, kahan):
         return ref, lax.all_gather(sh, "dp", axis=0, tiled=True)
 
     in_spec = jax.tree.map(lambda _: P("dp"), tree)
-    ref, full = jax.jit(jax.shard_map(
+    ref, full = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(in_spec,),
         out_specs=(jax.tree.map(lambda _: P(), tree), P()),
         check_vma=False))(tree)
@@ -225,7 +226,7 @@ def test_zero2_reduce_scatter_bitwise_sr(use_aps, kahan):
         return ref, lax.all_gather(sh, "dp", axis=0, tiled=True)
 
     in_spec = jax.tree.map(lambda _: P("dp"), tree)
-    ref, full = jax.jit(jax.shard_map(
+    ref, full = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(in_spec,),
         out_specs=(jax.tree.map(lambda _: P(), tree), P()),
         check_vma=False))(tree)
@@ -238,7 +239,7 @@ def test_zero2_reduce_scatter_bitwise_sr(use_aps, kahan):
         local = jax.tree.map(lambda g: g[0], t)
         return sum_gradients(local, "dp", use_aps=use_aps, grad_exp=4,
                              grad_man=3, use_kahan=kahan, mode="faithful")
-    rtne = jax.jit(jax.shard_map(
+    rtne = jax.jit(shard_map(
         body_rtne, mesh=mesh, in_specs=(in_spec,),
         out_specs=jax.tree.map(lambda _: P(), tree),
         check_vma=False))(tree)

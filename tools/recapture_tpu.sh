@@ -8,7 +8,9 @@
 # Every stage runs under a SIGKILL-backed watchdog (`timeout -k`: the
 # axon runtime can wedge in native code where SIGTERM is never honored —
 # same finding bench.py documents).  All output is tee'd to a
-# timestamped log so a dropped terminal cannot lose captured evidence.
+# timestamped log under tools/recapture_logs/ (untracked) so a dropped
+# terminal cannot lose captured evidence; each banked stage appends one
+# summary line to tools/recapture_index.jsonl, the tracked ledger.
 # Stages:
 #   1. liveness probe   (90 s)  — device must actually BE a TPU (axon
 #                                 init failure silently falls back to
@@ -29,20 +31,36 @@
 set -u
 cd "$(dirname "$0")/.."
 
-LOG="tools/recapture_$(date +%Y%m%d_%H%M%S).log"
+# Raw logs live OUTSIDE git (tools/recapture_logs/, gitignored); what
+# gets banked is one appending JSONL *index* line per stage, so the
+# repo carries a compact evidence ledger instead of a pile of
+# recapture_*.log files (VERDICT item 7: evidence hygiene).
+RUN_ID="$(date +%Y%m%d_%H%M%S)"
+LOGDIR="tools/recapture_logs"
+INDEX="tools/recapture_index.jsonl"
+mkdir -p "$LOGDIR"
+LOG="$LOGDIR/recapture_$RUN_ID.log"
 exec > >(tee "$LOG") 2>&1
-echo "== logging to $LOG"
+echo "== logging to $LOG (raw log untracked; summary -> $INDEX)"
 
 bank() {
-    # commit the capture's own artifacts ONLY (the log + the last-good
+    # commit the capture's own artifacts ONLY (the index + the last-good
     # record) — never `add -A` whole directories: the watcher can fire
     # while the working tree holds unrelated WIP, which must not ride
     # along in a capture commit.  Never fail the capture.
+    headline=$(python - <<'PY' 2>/dev/null || echo null
+import json
+try:
+    print(json.dumps(json.load(open(".bench_last_good.json"))))
+except Exception:
+    print("null")
+PY
+)
+    printf '{"run":"%s","stage":"%s","ts":"%s","log":"%s","last_good":%s}\n' \
+        "$RUN_ID" "$1" "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$LOG" \
+        "$headline" >> "$INDEX"
     [ "${NO_COMMIT:-0}" = "1" ] && return 0
-    # -f: tools/recapture_*.log is gitignored (routine failed-probe logs
-    # stay untracked); a SUCCESSFUL capture's log is evidence and must
-    # be banked even though it matches the ignore pattern
-    git add -f .bench_last_good.json "$LOG" 2>/dev/null
+    git add .bench_last_good.json "$INDEX" 2>/dev/null
     git diff --cached --quiet 2>/dev/null || \
         git commit -q -m "TPU capture: $1" || true
 }
@@ -84,4 +102,5 @@ if [ "${NO_RERUN:-0}" != "1" ]; then
     timeout -k 10 600 python bench.py || echo "bench rerun rc=$?"
     bank "bench extras rerun"
 fi
-echo "== done; review .bench_last_good.json + $LOG and update docs/ROUND5.md"
+bank "capture complete"
+echo "== done; review .bench_last_good.json + $INDEX and update docs/ROUND5.md"

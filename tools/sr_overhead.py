@@ -48,6 +48,7 @@ def main() -> int:
     import numpy as np
     from jax.sharding import PartitionSpec as P
 
+    from cpd_tpu.compat import shard_map
     from cpd_tpu.parallel.dist import grad_sr_key, sum_gradients
     from cpd_tpu.parallel.mesh import make_mesh
 
@@ -73,7 +74,7 @@ def main() -> int:
             return sum_gradients(g, "dp", use_aps=True, grad_exp=5,
                                  grad_man=2, mode="faithful",
                                  rounding=rounding, key=key)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P(),), out_specs=P(),
             check_vma=False))
         out = fn(grads)                      # compile + warm
