@@ -411,6 +411,44 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 partial["lm_gqa_note"] = (f"gqa-flash arm skipped: "
                                           f"{type(e).__name__}: {e}")
 
+    # Cheap EXTRA (seconds, platform-agnostic): a guarded micro-run with
+    # one injected NaN step, so every BENCH_* capture carries the
+    # resilience counters — skip-rate over PRs is a tracked number, and a
+    # regression in the guard (skip stops firing, or fires on healthy
+    # steps) shows up in the bench ledger, not just in tests.
+    if time.monotonic() < budget_end - 20:
+        try:
+            from cpd_tpu.models.tiny import tiny_cnn
+            from cpd_tpu.resilience import (FaultPlan, with_fault_injection,
+                                            with_grad_guard)
+            from cpd_tpu.train.optim import sgd as sgd_opt
+            from cpd_tpu.parallel.dist import replicate
+
+            r_steps = 8
+            r_tx = with_fault_injection(
+                with_grad_guard(sgd_opt(lambda _: 0.05), axis_name="dp"),
+                FaultPlan.parse("grad_nan@3"), r_steps, axis_name="dp")
+            r_model = tiny_cnn(num_classes=4, width=4)
+            r_state = replicate(create_train_state(
+                r_model, r_tx, jnp.zeros((2, 8, 8, 3)),
+                jax.random.PRNGKey(0)), mesh)
+            r_step = make_train_step(r_model, r_tx, mesh, donate=False)
+            rx = jnp.asarray(rng.randn(2 * n_dev, 8, 8, 3), jnp.float32)
+            ry = jnp.asarray(np.arange(2 * n_dev) % 4, jnp.int32)
+            for _ in range(r_steps):
+                r_state, r_m = r_step(r_state, rx, ry)
+            partial["resilience"] = {
+                "steps": r_steps,
+                "faults_injected": int(r_m["faults_injected"]),
+                "steps_skipped": int(r_m["guard_skipped"]),
+                "skip_rate": round(
+                    float(r_m["guard_skipped"]) / r_steps, 4),
+                "final_loss_finite": bool(np.isfinite(float(r_m["loss"]))),
+            }
+        except Exception as e:  # noqa: BLE001 — extras must not kill the run
+            partial["resilience_note"] = (f"resilience extra skipped: "
+                                          f"{type(e).__name__}: {e}")
+
     if profile_dir and time.monotonic() < budget_end - 30:
         state = create_train_state(model, tx, x[0, :2],
                                    jax.random.PRNGKey(0))

@@ -13,10 +13,12 @@ Rule IDs (stable — they are the suppression-comment vocabulary):
   kahan-ordering   unordered jnp.sum/lax.psum over quantized values
                    where the ordered primitives exist
   donation         reuse of a buffer after donating it to a jitted call
+  swallow          bare except / pass-only broad except outside
+                   resilience/ (failure handling must be explicit)
 """
 
 from . import (axis_name, donation, format_bounds, jit_hazards,  # noqa: F401
-               kahan_ordering, pallas_hygiene)
+               kahan_ordering, pallas_hygiene, swallow)
 
 __all__ = ["format_bounds", "axis_name", "jit_hazards", "pallas_hygiene",
-           "kahan_ordering", "donation"]
+           "kahan_ordering", "donation", "swallow"]

@@ -1,0 +1,85 @@
+"""swallow: silently-swallowed exceptions outside the resilience package.
+
+A resilient system's retries are EXPLICIT — counted, logged, bounded
+(cpd_tpu/resilience/loop.py).  A ``bare except`` or an
+``except Exception: pass`` is the opposite: it converts every failure,
+including the injected ones the chaos tests rely on, into silence.  The
+classic incident shape: a swallowed checkpoint-write error turns a
+recoverable preemption into a run that resumes from a stale step.
+
+Flagged shapes:
+
+    try: ...
+    except: ...                      # bare: catches SystemExit too
+
+    except Exception: pass           # (or BaseException, or a tuple
+    except Exception: ...            #  containing either) with a body
+                                     #  that only passes/continues
+
+A broad handler whose body DOES something (logs, re-raises, returns a
+fallback, counts the failure) is fine — breadth is sometimes right at
+top-level entry points; silence never is.  Files under ``resilience/``
+are exempt: that package is the sanctioned home of failure handling,
+and its handlers are themselves exercised by fault injection.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from ..core import Finding, ModuleContext, Rule, dotted_name, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.AST) -> bool:
+    """True for Exception/BaseException, bare or inside a tuple."""
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(el) for el in type_node.elts)
+    name = dotted_name(type_node)
+    return name.rsplit(".", 1)[-1] in _BROAD
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """Body does nothing with the failure: only pass/.../continue."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Continue):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+@register
+class Swallow(Rule):
+    id = "swallow"
+    summary = ("bare except / silently-passed broad except outside "
+               "resilience/ — failure handling must be explicit")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        parts = os.path.normpath(ctx.path).split(os.sep)
+        if "resilience" in parts:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield ctx.finding(
+                    self.id, node,
+                    "bare `except:` catches everything (SystemExit, "
+                    "KeyboardInterrupt, injected preemptions) — name "
+                    "the exception, or route recovery through "
+                    "cpd_tpu.resilience")
+            elif _is_broad(node.type) and _swallows(node):
+                yield ctx.finding(
+                    self.id, node,
+                    "broad except with a pass-only body swallows the "
+                    "failure — count it, log it, or re-raise (retries "
+                    "must be explicit; see resilience/loop.py)")

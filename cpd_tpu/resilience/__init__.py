@@ -1,0 +1,46 @@
+"""Failure injection + guarded training — the two halves test each other.
+
+Low-precision distributed training fails in characteristic ways long
+before it hits accuracy limits: eXmY overflow/underflow turns a gradient
+non-finite, a corrupted quantized all-reduce leaves replicas holding
+*different* sums, pod-scale runs eat preemptions and stragglers, and
+storage flakes truncate checkpoints (PAPERS.md: EQuARX, MLPerf on
+TPU-v3 pods).  The seed had the happy-path pieces (orbax checkpointing,
+GradScaler-style skip); this package adds
+
+* **inject** — a deterministic, seed-driven :class:`FaultPlan` plus the
+  host-side :class:`Injector` and the jit-level
+  :func:`with_fault_injection` optax wrapper, so every defense can be
+  exercised on purpose, in tests and via ``--fault-plan`` on trainers;
+* **guard** — :func:`with_grad_guard`: jit-compatible non-finite + spike
+  detection with per-tensor culprit reporting and a cross-replica
+  agreement check, composing with the dynamic loss scale;
+* **watchdog** — :class:`StepWatchdog`: a hung/straggling step turns
+  into a diagnostic dump and a clean checkpoint-and-exit, not a silent
+  wedge;
+* **sentinel** — :class:`DivergenceSentinel`: rolling-window loss
+  blow-up detection;
+* **loop** — :func:`run_guarded`: the defenses composed around any
+  ``(state, x, y) -> (state, metrics)`` step, with integrity-checked
+  checkpoint rollback and bounded, re-seeded retries.
+
+The defense matrix (fault -> detector -> recovery) is documented in
+docs/RESILIENCE.md.
+"""
+
+from .inject import (FaultPlan, FaultSpec, InjectedPreemption, Injector,
+                     with_fault_injection)
+from .guard import (GradGuardState, describe_culprit, find_guard,
+                    guard_metrics, with_grad_guard)
+from .sentinel import DivergenceSentinel
+from .watchdog import StepWatchdog
+from .loop import GuardedReport, run_guarded
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "Injector", "InjectedPreemption",
+    "with_fault_injection",
+    "GradGuardState", "with_grad_guard", "guard_metrics", "find_guard",
+    "describe_culprit",
+    "DivergenceSentinel", "StepWatchdog",
+    "run_guarded", "GuardedReport",
+]

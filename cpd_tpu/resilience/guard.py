@@ -1,0 +1,241 @@
+"""GradGuard — jit-compatible gradient anomaly detection + skip.
+
+An optax wrapper (the composition pattern of train/scaling.py) that
+inspects every incoming gradient tree for
+
+* **non-finite values** — per-leaf counts, so the *culprit tensor* is
+  reported, not just "something was NaN";
+* **spikes** — a finite global grad-norm far above its running EMA (the
+  blow-up precursor a NaN check misses);
+* **cross-replica disagreement** — with ``axis_name`` (inside
+  shard_map), verdict bits are ``psum``'d: if some replicas see a bad
+  gradient and others don't, the *reduce itself* is corrupt (the EQuARX
+  failure mode) and every replica skips in lockstep, keeping params
+  bitwise replicated.
+
+On an anomalous step the update is zeroed and the inner optimizer state
+is preserved — with one deliberate exception: when a
+``with_dynamic_loss_scale`` wrapper sits inside, non-finite gradients
+are passed THROUGH to it so its backoff policy (halve scale, reset
+streak) still executes; the guard then only adds its own accounting and
+the spike/agreement checks the scaler cannot do.  Composition order:
+
+    with_fault_injection(with_grad_guard(with_dynamic_loss_scale(tx)))
+
+Under ``--use_APS`` dynamic scaling is redundant (scaling.py docstring)
+but the guard is not: APS shifts exponents, it does not detect a
+corrupted reduce or a loss blow-up.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..train.scaling import DynamicScaleState
+
+__all__ = ["GradGuardState", "with_grad_guard", "guard_metrics",
+           "find_guard", "describe_culprit", "leaf_names"]
+
+
+class GradGuardState(NamedTuple):
+    ema_norm: Any        # f32 EMA of the (unscaled) global grad norm
+    seen: Any            # i32 finite steps observed (spike warmup)
+    skipped: Any         # i32 total anomalous (skipped) steps
+    overflows: Any       # i32 non-finite anomalies
+    spikes: Any          # i32 finite-but-spiking anomalies
+    disagreements: Any   # i32 cross-replica verdict mismatches
+    last_ok: Any         # i32 1/0 — previous step's verdict
+    culprit: Any         # i32 leaf index of last non-finite (-1 = none)
+    inner: Any
+
+
+def _find(opt_state, klass):
+    def is_node(n):
+        return isinstance(n, klass)
+    for node in jax.tree.leaves(opt_state, is_leaf=is_node):
+        if is_node(node):
+            return node
+    return None
+
+
+def find_guard(opt_state) -> Optional[GradGuardState]:
+    """The GradGuardState nested anywhere in ``opt_state``, or None."""
+    return _find(opt_state, GradGuardState)
+
+
+def guard_metrics(opt_state) -> dict:
+    """Step-metric view of the guard (and fault-injection) counters.
+
+    Safe to call from inside a jitted step on any opt state — returns {}
+    when no wrapper is present, so the steppers can merge it
+    unconditionally.  All values are replicated scalars (the guard's
+    verdicts are ``psum``-agreed when it has an ``axis_name``)."""
+    out: dict = {}
+    g = find_guard(opt_state)
+    if g is not None:
+        f32 = jnp.float32
+        out.update(guard_ok=g.last_ok.astype(f32),
+                   guard_skipped=g.skipped.astype(f32),
+                   guard_overflows=g.overflows.astype(f32),
+                   guard_spikes=g.spikes.astype(f32),
+                   guard_disagreements=g.disagreements.astype(f32),
+                   guard_culprit=g.culprit.astype(f32))
+    from .inject import FaultInjectState
+    fi = _find(opt_state, FaultInjectState)
+    if fi is not None:
+        out["faults_injected"] = fi.injected.astype(jnp.float32)
+    return out
+
+
+def leaf_names(tree) -> list:
+    """Stable human-readable leaf labels, index-aligned with the guard's
+    ``culprit`` (both use jax.tree flattening order)."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+    flat, _ = tree_flatten_with_path(tree)
+    return [keystr(path) for path, _ in flat]
+
+
+def describe_culprit(opt_state, params) -> Optional[str]:
+    """Leaf label of the last non-finite gradient, or None."""
+    g = find_guard(opt_state)
+    if g is None:
+        return None
+    idx = int(g.culprit)
+    if idx < 0:
+        return None
+    names = leaf_names(params)
+    return names[idx] if idx < len(names) else f"<leaf {idx}>"
+
+
+def with_grad_guard(tx, *, spike_factor: float = 10.0,
+                    ema_decay: float = 0.99, warmup_steps: int = 10,
+                    axis_name: Optional[str] = None):
+    """Wrap ``tx`` with anomaly detection + skip (module docstring).
+
+    ``spike_factor``: a finite step whose unscaled global grad norm
+    exceeds ``spike_factor * EMA`` (after ``warmup_steps`` finite steps)
+    is skipped.  ``axis_name``: REQUIRED when the update runs inside a
+    sharded step and faults/corruption can differ per shard — the psum'd
+    verdict is what keeps every replica taking the same branch.  Pass
+    EVERY mesh axis the update runs under (a name or a tuple — e.g.
+    ``("dp","sp","tp")`` for the LM step): model-sharded leaves (tp/pp/
+    ep) legitimately hold different gradient values per shard, so a
+    verdict agreed over dp alone would let tp-rank-0 freeze its layer
+    shard while tp-rank-1 applies its half of the update.
+    """
+    if spike_factor <= 1.0:
+        raise ValueError(f"spike_factor must be > 1, got {spike_factor}")
+    if not 0.0 < ema_decay < 1.0:
+        raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+    axes = ((axis_name,) if isinstance(axis_name, str)
+            else tuple(axis_name) if axis_name is not None else None)
+
+    def init(params):
+        # one fresh buffer per field: sharing a single zeros array across
+        # fields makes the state pytree alias itself, which a donating
+        # jitted step rejects ("donate the same buffer twice")
+        return GradGuardState(
+            ema_norm=jnp.zeros([], jnp.float32),
+            seen=jnp.zeros([], jnp.int32),
+            skipped=jnp.zeros([], jnp.int32),
+            overflows=jnp.zeros([], jnp.int32),
+            spikes=jnp.zeros([], jnp.int32),
+            disagreements=jnp.zeros([], jnp.int32),
+            last_ok=jnp.ones([], jnp.int32),
+            culprit=jnp.full([], -1, jnp.int32),
+            inner=tx.init(params))
+
+    def update(grads, state, params=None):
+        leaves = jax.tree.leaves(grads)
+        if not leaves:
+            updates, new_inner = tx.update(grads, state.inner, params)
+            return updates, state._replace(inner=new_inner)
+        # per-leaf non-finite counts -> culprit index + global verdict
+        bad_vec = jnp.stack([jnp.sum(~jnp.isfinite(l)).astype(jnp.int32)
+                             for l in leaves])
+        # norm in f64-free fp32; non-finite leaves poison it, but the
+        # spike branch is only consulted when everything is finite
+        sq = jnp.stack([jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves])
+        norm = jnp.sqrt(jnp.sum(sq))
+        local_bad = jnp.sum(bad_vec) > 0
+        if axes is not None:
+            world = lax.psum(jnp.float32(1.0), axes)
+            bad_replicas = lax.psum(local_bad.astype(jnp.float32), axes)
+            finite = bad_replicas == 0.0
+            agree = (bad_replicas == 0.0) | (bad_replicas == world)
+            bad_vec = lax.psum(bad_vec, axes)
+            # pmean so every replica computes the identical spike verdict
+            # even when one replica's copy of the grads is corrupt
+            norm = lax.pmean(jnp.where(jnp.isfinite(norm), norm, 0.0),
+                             axes)
+        else:
+            finite = ~local_bad
+            agree = jnp.bool_(True)
+
+        # unscale the norm when a dynamic loss scale sits inside, so the
+        # EMA tracks the TRUE gradient magnitude across scale changes
+        dyn = _find(state.inner, DynamicScaleState)
+        if dyn is not None:
+            norm = norm / dyn.scale
+        warmed = state.seen >= warmup_steps
+        ref = jnp.maximum(state.ema_norm, jnp.float32(1e-30))
+        spike = finite & warmed & (norm > spike_factor * ref)
+        ok = finite & ~spike
+
+        # non-finite grads pass through to a nested dynamic scaler (its
+        # backoff must run); without one they are zeroed before the inner
+        # update so Inf/NaN never reaches optimizer arithmetic.  The
+        # scaler's own all_finite check is replica-LOCAL, so on a
+        # single-shard corruption the grads handed to it must be made
+        # bad on EVERY replica — the psum'd verdict decides, and all
+        # scalers take the identical skip+backoff branch (params and
+        # scale stay bitwise replicated).
+        handled = dyn is not None
+        if handled:
+            safe = jax.tree.map(
+                lambda g: jnp.where(finite, g,
+                                    jnp.full_like(g, jnp.nan)), grads)
+        else:
+            safe = jax.tree.map(
+                lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+        updates, new_inner = tx.update(safe, state.inner, params)
+        # zero the update / freeze inner state on every skip the inner
+        # chain did not already handle itself
+        suppress = (~finite & jnp.bool_(not handled)) | spike
+        updates = jax.tree.map(
+            lambda u: jnp.where(suppress, jnp.zeros_like(u), u), updates)
+        new_inner = jax.tree.map(
+            lambda n, o: jnp.where(suppress, o, n), new_inner, state.inner)
+
+        ema = jnp.where(
+            ok,
+            jnp.where(state.seen == 0, norm,
+                      ema_decay * state.ema_norm + (1 - ema_decay) * norm),
+            state.ema_norm)
+        i32 = lambda b: b.astype(jnp.int32)    # noqa: E731
+        culprit = jnp.where(jnp.sum(bad_vec) > 0,
+                            jnp.argmax(bad_vec).astype(jnp.int32),
+                            state.culprit)
+        new_state = GradGuardState(
+            ema_norm=ema,
+            seen=state.seen + i32(ok),
+            skipped=state.skipped + i32(~ok),
+            overflows=state.overflows + i32(~finite),
+            spikes=state.spikes + i32(spike),
+            disagreements=state.disagreements + i32(~agree),
+            last_ok=i32(ok),
+            culprit=culprit,
+            inner=new_inner)
+        return updates, new_state
+
+    import optax
+    wrapped = optax.GradientTransformation(init, update)
+    if getattr(tx, "norm_based", False):
+        from ..train.optim import NormBasedTransformation
+        wrapped = NormBasedTransformation(init, update)
+    return wrapped

@@ -1,0 +1,416 @@
+"""Deterministic fault injection — the chaos half of the resilience story.
+
+A :class:`FaultPlan` is an immutable, seed-reproducible schedule of
+:class:`FaultSpec` entries.  Two consumers execute it:
+
+* :func:`with_fault_injection` — an optax wrapper that corrupts the
+  gradients *inside* the jitted step (NaN / Inf / exponent blow-up,
+  optionally on a single data-parallel shard via ``lax.axis_index`` to
+  model one rank's corrupted quantized-reduce output).  The schedule is
+  baked into the compiled program as a constant table indexed by the
+  wrapper's own update counter, so injection is jit-compatible and
+  bit-reproducible.  Note the counter lives in the optimizer state: a
+  rollback that restores an old state REPLAYS the same faults — by
+  design (same plan, same timeline).
+* :class:`Injector` — the host-side driver for everything that is not a
+  gradient: poisoning a float batch, dropping/duplicating a batch,
+  stalling the host thread (straggler), truncating / bit-flipping a
+  checkpoint file, raising mid-step (preemption), and inflating the
+  observed loss (divergence-sentinel drill).  Host faults are
+  **one-shot**: each spec fires once and is consumed, so a
+  rollback-and-replay recovers instead of re-tripping forever.
+
+Grammar for ``--fault-plan`` (also accepts a path to a JSON file written
+by :meth:`FaultPlan.to_json`):
+
+    kind@step[:arg][;kind@step[:arg]...]
+
+e.g. ``grad_nan@3;stall@5:1.5;ckpt_truncate@6;loss_spike@8:1e6``.
+``arg`` means: shard index for ``grad_*`` (-1 = every shard, the
+default), seconds for ``stall``, multiplier for ``loss_spike`` /
+``batch_scale``; ignored elsewhere.
+
+``step`` convention: the 0-based optimizer-UPDATE index — one clock for
+both executors, so ``grad_nan@3`` and ``stall@3`` hit the same physical
+step in every entry point (run_guarded and both trainer CLIs).  The
+``ckpt_*`` kinds are the exception: their step is the saved
+checkpoint's own step number (what ``restore_latest_valid`` sees),
+because that is the name the corruption must land on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import time
+from typing import Any, Iterable, NamedTuple, Optional
+
+import numpy as np
+
+__all__ = ["FaultSpec", "FaultPlan", "Injector", "InjectedPreemption",
+           "with_fault_injection", "GRAD_KINDS", "HOST_KINDS"]
+
+# jit-level kinds -> corruption opcode in the compiled fault table
+GRAD_KINDS = {"grad_nan": 1, "grad_inf": 2, "grad_blowup": 3}
+# host-level kinds, executed by the Injector around the step call
+HOST_KINDS = frozenset({
+    "batch_nan",       # poison one element of the first float batch leaf
+    "batch_scale",     # multiply the float batch by `arg` (loss blow-up)
+    "data_drop",       # this step's batch never arrives; use the next one
+    "data_dup",        # the previous batch is delivered again
+    "stall",           # sleep `arg` seconds mid-step (straggler)
+    "preempt",         # raise InjectedPreemption before the step
+    "ckpt_truncate",   # truncate the newest checkpoint's largest file
+    "ckpt_bitflip",    # flip one byte in the newest checkpoint
+    "loss_spike",      # multiply the observed loss metric by `arg`
+})
+_ALL_KINDS = frozenset(GRAD_KINDS) | HOST_KINDS
+
+
+class InjectedPreemption(BaseException):
+    """Simulated SIGTERM-mid-step.  Derives from BaseException so generic
+    ``except Exception`` recovery code cannot accidentally swallow the
+    preemption it is being tested against."""
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class FaultSpec:
+    """One scheduled fault.  ``arg`` is kind-dependent (module docstring)."""
+    step: int
+    kind: str
+    arg: float = -1.0
+
+    def __post_init__(self):
+        if self.kind not in _ALL_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; know "
+                             f"{sorted(_ALL_KINDS)}")
+        if self.step < 0:
+            raise ValueError(f"fault step must be >= 0, got {self.step}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An immutable schedule of faults; equality/ordering is structural,
+    so 'same seed + config => identical plan' is testable directly."""
+    faults: tuple = ()
+    seed: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "faults",
+                           tuple(sorted(self.faults)))
+
+    def __len__(self) -> int:
+        return len(self.faults)
+
+    # -- constructors -----------------------------------------------------
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        """Parse the compact ``kind@step[:arg]`` grammar, or load a JSON
+        file if ``text`` names one (the ``--fault-plan`` flag accepts
+        both)."""
+        text = text.strip()
+        if not text:
+            return cls((), seed)
+        if os.path.exists(text):
+            with open(text) as f:
+                return cls.from_json(f.read())
+        faults = []
+        for part in text.replace(",", ";").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                kind, rest = part.split("@", 1)
+                if ":" in rest:
+                    step_s, arg_s = rest.split(":", 1)
+                    arg = float(arg_s)
+                else:
+                    step_s, arg = rest, -1.0
+                faults.append(FaultSpec(int(step_s), kind.strip(), arg))
+            except ValueError as e:
+                raise ValueError(
+                    f"bad fault spec {part!r} (want kind@step[:arg]): {e}"
+                ) from e
+        return cls(tuple(faults), seed)
+
+    @classmethod
+    def random(cls, seed: int, n_steps: int,
+               rates: Optional[dict] = None) -> "FaultPlan":
+        """Seed-deterministic random plan: each kind fires independently
+        per step with probability ``rates[kind]`` (default: a light mix
+        of gradient corruption and stalls)."""
+        rates = rates or {"grad_nan": 0.02, "grad_blowup": 0.02,
+                          "stall": 0.01}
+        rng = random.Random(seed)
+        faults = []
+        for step in range(n_steps):
+            for kind in sorted(rates):
+                if rng.random() < rates[kind]:
+                    arg = (rng.uniform(0.2, 1.0) if kind == "stall"
+                           else -1.0)
+                    faults.append(FaultSpec(step, kind, arg))
+        return cls(tuple(faults), seed)
+
+    @classmethod
+    def from_json(cls, blob: str) -> "FaultPlan":
+        doc = json.loads(blob)
+        return cls(tuple(FaultSpec(f["step"], f["kind"],
+                                   float(f.get("arg", -1.0)))
+                         for f in doc["faults"]),
+                   int(doc.get("seed", 0)))
+
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "faults": [dataclasses.asdict(f)
+                                      for f in self.faults]}, indent=2)
+
+    # -- consumers --------------------------------------------------------
+
+    def counts(self) -> dict:
+        out: dict = {}
+        for f in self.faults:
+            out[f.kind] = out.get(f.kind, 0) + 1
+        return out
+
+    def grad_faults(self) -> tuple:
+        return tuple(f for f in self.faults if f.kind in GRAD_KINDS)
+
+    def host_faults(self) -> dict:
+        """step -> [FaultSpec] for the host-level kinds."""
+        out: dict = {}
+        for f in self.faults:
+            if f.kind in HOST_KINDS:
+                out.setdefault(f.step, []).append(f)
+        return out
+
+    def grad_schedule(self, n_steps: int):
+        """Dense (codes, shards) int32 tables for the jit wrapper; entry
+        ``i`` drives optimizer update ``i``.  At most one gradient fault
+        per step (the last spec wins)."""
+        codes = np.zeros((max(n_steps, 1),), np.int32)
+        shards = np.full((max(n_steps, 1),), -1, np.int32)
+        for f in self.grad_faults():
+            if f.step < n_steps:
+                codes[f.step] = GRAD_KINDS[f.kind]
+                shards[f.step] = int(f.arg)
+        return codes, shards
+
+
+# ---------------------------------------------------------------------------
+# jit-level gradient corruption (optax wrapper)
+# ---------------------------------------------------------------------------
+
+class FaultInjectState(NamedTuple):
+    step: Any       # i32 update counter (drives the schedule table)
+    injected: Any   # i32 faults fired so far
+    inner: Any
+
+
+def with_fault_injection(tx, plan: FaultPlan, n_steps: int, *,
+                         axis_name: Optional[str] = None):
+    """Wrap ``tx`` so incoming gradients are corrupted per ``plan``.
+
+    Wrap OUTSIDE every defense under test
+    (``with_fault_injection(with_grad_guard(...))``) so the corruption
+    enters the pipeline exactly where a bad quantized reduce would.  With
+    ``axis_name`` (inside shard_map) and a fault ``arg`` >= 0, only that
+    shard's copy is corrupted — replicas now *disagree*, which is the
+    failure mode the guard's cross-replica agreement check exists for.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # a tuple (the guard's multi-axis agreement form) gates the shard
+    # index on its FIRST axis — by convention the data axis, the one a
+    # corrupted quantized reduce is per-replica over
+    if isinstance(axis_name, (tuple, list)):
+        axis_name = axis_name[0] if axis_name else None
+
+    codes_np, shards_np = plan.grad_schedule(n_steps)
+
+    def init(params):
+        return FaultInjectState(jnp.zeros([], jnp.int32),
+                                jnp.zeros([], jnp.int32), tx.init(params))
+
+    def update(grads, state, params=None):
+        codes = jnp.asarray(codes_np)
+        shards = jnp.asarray(shards_np)
+        idx = jnp.clip(state.step, 0, codes.shape[0] - 1)
+        in_range = state.step < codes.shape[0]
+        code = jnp.where(in_range, codes[idx], 0)
+        shard = shards[idx]
+        on = code > 0
+        if axis_name is not None:
+            me = lax.axis_index(axis_name).astype(jnp.int32)
+            on = on & ((shard < 0) | (me == shard))
+
+        def corrupt(g):
+            flat = jnp.ravel(g).astype(g.dtype)
+            nan_p = flat.at[0].set(jnp.nan)
+            inf_p = flat.at[0].set(jnp.inf)
+            blown = flat * jnp.asarray(2.0 ** 60, g.dtype)
+            out = jnp.where(code == 1, nan_p,
+                            jnp.where(code == 2, inf_p,
+                                      jnp.where(code == 3, blown, flat)))
+            return jnp.where(on, out, flat).reshape(g.shape)
+
+        bad = jax.tree.map(corrupt, grads)
+        updates, new_inner = tx.update(bad, state.inner, params)
+        return updates, FaultInjectState(
+            state.step + 1,
+            state.injected + (code > 0).astype(jnp.int32),
+            new_inner)
+
+    import optax
+    wrapped = optax.GradientTransformation(init, update)
+    if getattr(tx, "norm_based", False):
+        from ..train.optim import NormBasedTransformation
+        wrapped = NormBasedTransformation(init, update)
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# host-level faults
+# ---------------------------------------------------------------------------
+
+def _poison_first_float_leaf(batch, value: float):
+    """Return ``batch`` with element [0...] of its first float leaf set to
+    ``value`` (NaN-poisoning a data batch — reference for how real bad
+    records reach the loss).  Integer leaves (LM tokens, labels) are left
+    alone."""
+    import jax
+    import numpy as np_  # local alias: keep module numpy pristine
+
+    done = False
+
+    def poke(leaf):
+        nonlocal done
+        arr = np_.asarray(leaf)
+        if not done and np_.issubdtype(arr.dtype, np_.floating):
+            arr = arr.copy()
+            arr.reshape(-1)[0] = value
+            done = True
+            return arr
+        return leaf
+
+    out = jax.tree.map(poke, batch)
+    if not done:
+        raise ValueError("batch_nan fault: batch has no float leaf to "
+                         "poison (LM token batches need a grad_* fault "
+                         "instead)")
+    return out
+
+
+def _scale_float_leaves(batch, factor: float):
+    import jax
+    import numpy as np_
+
+    def scale(leaf):
+        arr = np_.asarray(leaf)
+        if np_.issubdtype(arr.dtype, np_.floating):
+            return arr * arr.dtype.type(factor)
+        return leaf
+
+    return jax.tree.map(scale, batch)
+
+
+class Injector:
+    """Executes a plan's host-level faults around a training loop.
+
+    Each spec fires exactly once (consumed on fire) and is counted in
+    ``fired``; ``log`` records the deterministic event sequence for the
+    reproducibility assertion.  All decisions are pure functions of the
+    plan — no wall clock, no RNG — so the same plan replays identically.
+    """
+
+    def __init__(self, plan: FaultPlan, rank: int = 0):
+        self.plan = plan
+        self.rank = rank
+        self._pending = {step: list(specs)
+                         for step, specs in plan.host_faults().items()}
+        self.fired: dict = {}
+        self.log: list = []
+
+    def unfired(self) -> list:
+        """Specs that never fired (scheduled past the end of the run, or
+        on a hook the loop doesn't wire).  Loops report these at exit —
+        a chaos run that silently skipped a fault proves nothing."""
+        return sorted(f for specs in self._pending.values() for f in specs)
+
+    def _take(self, step: int, kinds: Iterable[str]) -> Optional[FaultSpec]:
+        specs = self._pending.get(step, [])
+        for i, f in enumerate(specs):
+            if f.kind in kinds:
+                del specs[i]
+                self.fired[f.kind] = self.fired.get(f.kind, 0) + 1
+                self.log.append((f.kind, step))
+                return f
+        return None
+
+    # -- hooks, in loop order --------------------------------------------
+
+    def maybe_preempt(self, step: int) -> None:
+        if self._take(step, ("preempt",)) is not None:
+            raise InjectedPreemption(f"injected preemption at step {step}")
+
+    def batch_action(self, step: int) -> Optional[str]:
+        """'drop' / 'dup' / None — the loop owns the actual data motion."""
+        f = self._take(step, ("data_drop", "data_dup"))
+        if f is None:
+            return None
+        return "drop" if f.kind == "data_drop" else "dup"
+
+    def corrupt_batch(self, step: int, batch):
+        f = self._take(step, ("batch_nan", "batch_scale"))
+        if f is None:
+            return batch
+        if f.kind == "batch_nan":
+            return _poison_first_float_leaf(batch, float("nan"))
+        return _scale_float_leaves(batch, f.arg if f.arg > 0 else 1e6)
+
+    def maybe_stall(self, step: int) -> float:
+        f = self._take(step, ("stall",))
+        if f is None:
+            return 0.0
+        secs = f.arg if f.arg > 0 else 1.0
+        time.sleep(secs)
+        return secs
+
+    def fault_loss(self, step: int, loss: float) -> float:
+        f = self._take(step, ("loss_spike",))
+        if f is None:
+            return loss
+        return loss * (f.arg if f.arg > 0 else 1e6)
+
+    def corrupt_checkpoint(self, step: int, directory: str) -> bool:
+        """Truncate or bit-flip the just-saved step's largest data file.
+        Called by the loop right after a (finished) save at ``step``."""
+        f = self._take(step, ("ckpt_truncate", "ckpt_bitflip"))
+        if f is None:
+            return False
+        step_dir = os.path.join(directory, str(step))
+        victim, size = None, -1
+        for root, _, files in os.walk(step_dir):
+            for name in sorted(files):
+                p = os.path.join(root, name)
+                s = os.path.getsize(p)
+                if s > size:
+                    victim, size = p, s
+        if victim is None:
+            raise FileNotFoundError(
+                f"{f.kind} fault at step {step}: no checkpoint files "
+                f"under {step_dir}")
+        if f.kind == "ckpt_truncate":
+            with open(victim, "r+b") as fh:
+                fh.truncate(max(size // 2, 1))
+        else:
+            with open(victim, "r+b") as fh:
+                fh.seek(size // 2)
+                byte = fh.read(1)
+                fh.seek(size // 2)
+                fh.write(bytes([byte[0] ^ 0xFF]))
+        return True

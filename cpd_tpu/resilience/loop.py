@@ -1,0 +1,205 @@
+"""run_guarded — the defenses composed around any jitted train step.
+
+The generic guarded loop used by the chaos tests and available to
+harness code (notebooks, sweeps).  The example trainers wire the same
+defenses natively around their own validation/checkpoint cadence
+(examples/lm/train.py carries the full stack including rollback) — keep
+the recovery semantics here and there in lockstep.  One iteration:
+
+    preempt? -> batch (drop/dup/poison) -> [watchdog armed: stall? ->
+    step -> metric device-sync] -> counters -> loss fault -> sentinel
+    -> (rollback | advance) -> periodic integrity-checked save
+    -> post-save checkpoint corruption
+
+Recovery policies, in the order they can fire:
+
+* **watchdog trip** — the timer thread dumped diagnostics and
+  interrupted the main thread; the loop checkpoints the last GOOD state
+  and exits cleanly (``aborted='watchdog'``).
+* **injected preemption** — same checkpoint-and-exit contract as the
+  SIGTERM PreemptionGuard path (``aborted='preempted'``).
+* **divergence** — the sentinel tripped: restore the newest *valid*
+  checkpoint (integrity digests consulted; corrupt steps are skipped
+  and counted), re-seed the data order so the replay does not march
+  into the identical batch sequence, back off, and retry — at most
+  ``max_rollbacks`` times, then ``aborted='diverged'``.
+
+Anomalous gradient steps (non-finite / spike / replica disagreement)
+never reach this file: the GradGuard optax wrapper already skipped them
+inside the step; the loop just mirrors its counters into the meter.
+
+Every decision is a pure function of (plan, seeds, step outputs), so a
+run under a FaultPlan is reproducible event-for-event — asserted in
+tests/test_resilience.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import Callable, Optional
+
+from .inject import InjectedPreemption
+
+__all__ = ["run_guarded", "GuardedReport"]
+
+
+@dataclasses.dataclass
+class GuardedReport:
+    completed: bool
+    final_step: int
+    aborted: Optional[str]          # None | watchdog | preempted | diverged
+    counters: dict                  # ResilienceMeter.as_dict()
+    events: list                    # deterministic (what, step, ...) log
+
+
+def run_guarded(step_fn: Callable, state, next_batch: Callable,
+                n_steps: int, *, manager=None, injector=None,
+                sentinel=None, watchdog=None, meter=None,
+                ckpt_every: int = 0, max_rollbacks: int = 2,
+                backoff_secs: float = 0.0, rank: int = 0,
+                on_step: Optional[Callable] = None):
+    """Drive ``step_fn`` to ``n_steps`` under the defense stack.
+
+    step_fn: jitted ``(state, *batch) -> (state, metrics)`` with a
+        ``loss`` metric.  Build it with ``donate=False`` — a rollback
+        needs the pre-step state alive, and the restore template must
+        outlive the step call.
+    next_batch: ``(step, reseed) -> tuple`` — ``reseed`` increments on
+        every rollback so the replayed data order differs (same step on
+        retry k yields a different batch, the re-seeded recovery the
+        sentinel docstring promises).
+    manager: CheckpointManager (integrity on) — required for
+        ``ckpt_every`` and for rollback; without it a divergence aborts.
+    on_step: optional ``(step, metrics) -> None`` observer (logging).
+
+    Returns ``(state, GuardedReport)``; the report's ``events`` list is
+    the determinism witness.
+    """
+    from ..train.metrics import ResilienceMeter
+    meter = meter if meter is not None else ResilienceMeter()
+    events: list = []
+    rollbacks = 0
+    reseed = 0
+    prev_batch = None
+    it = int(state.step)
+
+    def save(step, tag):
+        if manager is None:
+            return
+        manager.save(step, state, force=True)
+        manager.wait()
+        events.append((tag, step))
+        if injector is not None and injector.corrupt_checkpoint(
+                step, manager.directory):
+            events.append(("ckpt_corrupted", step))
+
+    def finish(aborted):
+        if injector is not None and rank == 0:
+            leftover = injector.unfired()
+            if leftover:
+                # a chaos run that silently skipped a fault proves
+                # nothing — make the gap visible (expected when the run
+                # aborted early, suspicious otherwise)
+                print(f"=> fault plan: {len(leftover)} spec(s) never "
+                      f"fired: {leftover}", file=sys.stderr)
+        return state, GuardedReport(
+            completed=aborted is None and it >= n_steps,
+            final_step=it, aborted=aborted, counters=meter.as_dict(),
+            events=events)
+
+    while it < n_steps:
+        try:
+            if injector is not None:
+                injector.maybe_preempt(it)
+
+            # --- data motion, with drop/dup faults -------------------
+            action = (injector.batch_action(it)
+                      if injector is not None else None)
+            if action == "dup" and prev_batch is not None:
+                batch = prev_batch
+                meter.bump("batches_duplicated")
+                events.append(("dup", it))
+            elif action == "drop":
+                # this batch never arrives; train on the next one
+                meter.bump("batches_dropped")
+                events.append(("drop", it))
+                batch = next_batch(it + n_steps, reseed)
+            else:
+                batch = next_batch(it, reseed)
+            if injector is not None:
+                batch = injector.corrupt_batch(it, batch)
+            prev_batch = batch
+
+            # --- the blocking region, under the watchdog --------------
+            if watchdog is not None:
+                watchdog.arm(it, counters=meter.as_dict())
+            if injector is not None:
+                injector.maybe_stall(it)
+            new_state, metrics = step_fn(state, *batch)
+            loss = float(metrics["loss"])      # device sync
+            if watchdog is not None:
+                watchdog.disarm()
+                if watchdog.tripped:
+                    # the interrupt landed between bytecodes that
+                    # swallowed it (e.g. inside a sleeping stall that
+                    # resumed); honor the trip at the boundary
+                    raise KeyboardInterrupt
+
+        except KeyboardInterrupt:
+            if watchdog is not None and watchdog.tripped:
+                watchdog.disarm()     # acknowledges: cancels hard-exit
+                meter.bump("watchdog_trips")
+                events.append(("watchdog", it))
+                save(it, "ckpt_on_watchdog")
+                return finish("watchdog")
+            raise
+        except InjectedPreemption:
+            meter.bump("preemptions")
+            events.append(("preempted", it))
+            save(it, "ckpt_on_preempt")
+            return finish("preempted")
+
+        meter.observe_metrics(metrics)
+        if injector is not None:
+            loss = injector.fault_loss(it, loss)
+        if on_step is not None:
+            on_step(it, {**metrics, "loss": loss})
+
+        # A guard-skipped step's loss metric is naturally poisoned (the
+        # forward pass saw the bad batch); the anomaly was already
+        # handled in-step, so it must not ALSO count as divergence.
+        guard_ok = float(metrics.get("guard_ok", 1.0)) != 0.0
+
+        # --- divergence -> integrity-checked rollback -----------------
+        if sentinel is not None and guard_ok and sentinel.update(loss):
+            events.append(("diverged", it, round(loss, 6)))
+            if manager is None or rollbacks >= max_rollbacks:
+                return finish("diverged")
+            res = manager.restore_latest_valid(new_state, rank=rank)
+            if res is None:
+                return finish("diverged")
+            for bad in res.skipped:
+                meter.bump("ckpts_invalid")
+                events.append(("ckpt_invalid", bad))
+            state = res.state
+            it = int(res.step)
+            rollbacks += 1
+            reseed = rollbacks
+            meter.bump("rollbacks")
+            meter.bump("restores")
+            sentinel.reset()
+            events.append(("rollback", it))
+            if backoff_secs > 0:
+                time.sleep(backoff_secs * (2 ** (rollbacks - 1)))
+            continue
+
+        state = new_state
+        it += 1
+        if ckpt_every and it % ckpt_every == 0 and it < n_steps:
+            save(it, "ckpt")
+
+    if manager is not None and ckpt_every:
+        save(it, "ckpt_final")
+    return finish(None)

@@ -1,0 +1,57 @@
+"""Divergence sentinel — rolling-window loss blow-up detection.
+
+`loss_diverged` (train/metrics.py) only catches the terminal symptom: a
+loss that is already NaN/Inf.  Low-precision runs usually *announce* the
+divergence first — the loss jumps orders of magnitude above its recent
+history while still finite, at which point the parameters are often
+already damaged and the only honest recovery is a rollback.  The
+sentinel keeps a window of recent finite losses and trips when the new
+loss exceeds ``factor`` x the window median (median, not mean: one
+earlier spike must not inflate the baseline and mask the next one).
+
+The verdict is host-side and replicated-input (the loss metric is
+all-reduced), so every host trips at the same step.  The loop owns the
+recovery: restore the newest *valid* checkpoint, re-seed the data order,
+bounded retries with backoff (resilience/loop.py).
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from collections import deque
+
+__all__ = ["DivergenceSentinel"]
+
+
+class DivergenceSentinel:
+    def __init__(self, window: int = 20, factor: float = 10.0,
+                 min_history: int = 5):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        self.window = window
+        self.factor = factor
+        # a min_history the window can never reach would silently disarm
+        # the sentinel (len(deque(maxlen=w)) <= w)
+        self.min_history = min(min_history, window)
+        self.losses: deque = deque(maxlen=window)
+
+    def update(self, loss: float) -> bool:
+        """Record ``loss``; True when it signals divergence.  A diverged
+        loss is NOT added to the history — the baseline stays honest for
+        the post-rollback replay."""
+        loss = float(loss)
+        if not math.isfinite(loss):
+            return True
+        if (len(self.losses) >= self.min_history
+                and loss > self.factor * statistics.median(self.losses)):
+            return True
+        self.losses.append(loss)
+        return False
+
+    def reset(self) -> None:
+        """Forget the history (after a rollback: the restored model's
+        losses are the new baseline)."""
+        self.losses.clear()

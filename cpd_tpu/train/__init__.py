@@ -7,8 +7,10 @@ from .step import (cross_entropy_loss, make_eval_step,
 from .optim import lars, make_optimizer, quant_sgd, sgd
 from .schedules import (iter_table, piecewise_linear, warmup_cosine,
                         warmup_step_decay)
-from .metrics import AverageMeter, Timer, accuracy, loss_diverged
-from .scaling import with_dynamic_loss_scale, DynamicScaleState
+from .metrics import (AverageMeter, ResilienceMeter, Timer, accuracy,
+                      loss_diverged)
+from .scaling import (with_dynamic_loss_scale, DynamicScaleState,
+                      find_dynamic_scale)
 from .lm import lm_state_specs, make_lm_train_step
 from .pp import make_pp_eval_step, make_pp_train_step, pp_state_specs
 from .moe import make_moe_eval_step, make_moe_train_step, moe_state_specs
@@ -21,15 +23,17 @@ __all__ = [
     "make_seg_eval_step", "make_train_step",
     "lars", "make_optimizer", "quant_sgd", "sgd",
     "iter_table", "piecewise_linear", "warmup_cosine", "warmup_step_decay",
-    "AverageMeter", "Timer", "accuracy",
-    "with_dynamic_loss_scale", "DynamicScaleState",
+    "AverageMeter", "ResilienceMeter", "Timer", "accuracy",
+    "with_dynamic_loss_scale", "DynamicScaleState", "find_dynamic_scale",
     "make_lm_train_step", "lm_state_specs",
     "CheckpointManager", "PreemptionGuard", "preempt_save",
     "loss_diverged", "save_checkpoint", "restore_latest",
+    "RestoreResult", "checkpoint_digest",
 ]
 
 _CHECKPOINT_NAMES = {"CheckpointManager", "PreemptionGuard",
-                     "preempt_save", "save_checkpoint", "restore_latest"}
+                     "preempt_save", "save_checkpoint", "restore_latest",
+                     "RestoreResult", "checkpoint_digest"}
 
 
 def __getattr__(name):

@@ -16,10 +16,12 @@ pytree.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import signal as _signal
-from typing import Any, Optional
+import sys
+from typing import Any, NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -28,7 +30,41 @@ import orbax.checkpoint as ocp
 from .state import TrainState
 
 __all__ = ["CheckpointManager", "PreemptionGuard", "preempt_save",
-           "save_checkpoint", "restore_latest"]
+           "save_checkpoint", "restore_latest", "RestoreResult",
+           "checkpoint_digest"]
+
+
+def checkpoint_digest(step_dir: str) -> dict:
+    """Content checksum of one step's checkpoint directory.
+
+    sha256 over (relative path, size, bytes) of every file, in sorted
+    order — any truncation, bit-flip, or missing file changes the
+    digest.  Orbax finalizes a step atomically (write to a tmp dir, then
+    rename), so by the time a step is listed its files are stable."""
+    h = hashlib.sha256()
+    n_files = 0
+    n_bytes = 0
+    for root, dirs, files in os.walk(step_dir):
+        dirs.sort()
+        for name in sorted(files):
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, step_dir)
+            size = os.path.getsize(path)
+            h.update(rel.encode())
+            h.update(str(size).encode())
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            n_files += 1
+            n_bytes += size
+    return {"algo": "sha256", "digest": h.hexdigest(),
+            "files": n_files, "bytes": n_bytes}
+
+
+class RestoreResult(NamedTuple):
+    state: TrainState
+    step: int
+    skipped: tuple      # steps rejected (bad digest / unrestorable)
 
 
 def preempt_save(manager: "CheckpointManager", step_no, state, rank: int,
@@ -65,13 +101,22 @@ class PreemptionGuard:
     entry, not in library code, and ``uninstall()`` in tests.
     """
 
-    def __init__(self, signals=(_signal.SIGTERM,)):
+    def __init__(self, signals=(_signal.SIGTERM, _signal.SIGINT)):
+        # SIGINT is trapped too (it IS in the default set): a Ctrl-C on a
+        # long run should save-at-the-boundary exactly like a spot-VM
+        # SIGTERM, not lose the epoch to a KeyboardInterrupt traceback.
         self._triggered = False
         self._prev = {}
         for s in signals:
             self._prev[s] = _signal.signal(s, self._handle)
 
     def _handle(self, signum, frame):
+        if self._triggered and signum == _signal.SIGINT:
+            # second Ctrl-C: the user means it.  A wedged step never
+            # reaches the boundary where `triggered` is consulted, so
+            # the save-at-boundary protocol must not absorb Ctrl-C
+            # forever — escalate to the ordinary KeyboardInterrupt.
+            raise KeyboardInterrupt
         self._triggered = True
 
     @property
@@ -79,9 +124,21 @@ class PreemptionGuard:
         return self._triggered
 
     def uninstall(self) -> None:
+        """Restore the pre-install handlers (idempotent).  Signal
+        handlers are process-global: a trainer that returns without this
+        leaves the NEXT run (or the test harness) with a stale handler,
+        which is why `close()`/context-exit route here."""
         for s, prev in self._prev.items():
             _signal.signal(s, prev)
         self._prev = {}
+
+    close = uninstall
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.uninstall()
 
     def should_stop(self) -> bool:
         """Cluster-wide preemption decision — EVERY host must call this at
@@ -109,10 +166,16 @@ def jnp_dtype(x):
 
 
 class CheckpointManager:
-    """Thin orbax wrapper with the reference's retention semantics."""
+    """Thin orbax wrapper with the reference's retention semantics, plus
+    content-integrity checking (``integrity=True``, the default): every
+    save records a sha256 digest of the step's files in the metadata
+    sidecar, and ``restore_latest_valid`` walks steps newest-first,
+    skipping any whose bytes no longer match — a truncated or bit-flipped
+    checkpoint degrades the run by one save interval instead of killing
+    the resume (or worse, silently restoring garbage arrays)."""
 
     def __init__(self, directory: str, max_to_keep: int = 5,
-                 track_best: bool = True):
+                 track_best: bool = True, integrity: bool = True):
         directory = os.path.abspath(directory)
         kwargs = {}
         if track_best:   # orbax requires best_mode in {'min','max'} if set
@@ -121,7 +184,15 @@ class CheckpointManager:
         options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                **kwargs)
         self._dir = directory
+        self._integrity = integrity
         self._mgr = ocp.CheckpointManager(directory, options=options)
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, str(step))
 
     def save(self, step: int, state: TrainState,
              best_metric: Optional[float] = None, force: bool = False,
@@ -134,17 +205,54 @@ class CheckpointManager:
         the checkpoint — e.g. the epoch number, so resume doesn't have to
         re-derive it from step // iters_per_epoch (which breaks when batch
         size / device count / --max-batches-per-epoch change between runs).
+
+        With ``integrity`` on, the save is waited for and the sidecar
+        additionally records the step's content digest.  The sidecar
+        itself is written atomically (tmp + rename), so a crash mid-write
+        leaves either the old sidecar or the new one, never a torn file.
         """
         metrics = ({"best_metric": float(best_metric)}
                    if best_metric is not None else None)
+        if force and step in self._mgr.all_steps():
+            # a rollback replay re-reaches an already-saved step (often
+            # the corrupted one that caused the rollback): the fresh
+            # save must REPLACE it — orbax's force only bypasses
+            # should_save, it still refuses an existing step
+            self._mgr.delete(step)
         self._mgr.save(step, args=ocp.args.StandardSave(state),
                        metrics=metrics, force=force)
+        if self._integrity:
+            # the digest must cover the FINAL bytes: wait for orbax's
+            # async write + atomic rename before hashing.  Hash on
+            # process 0 only — it is the sole sidecar writer, and (N-1)
+            # redundant full reads of the checkpoint would be pure waste
+            # on a pod.  (Cost note: integrity makes save() synchronous;
+            # pass integrity=False to keep the async-save overlap.)
+            self._mgr.wait_until_finished()
+            if jax.process_index() == 0:
+                metadata = dict(metadata or {})
+                metadata["integrity"] = checkpoint_digest(
+                    self._step_dir(step))
         if metadata is not None and jax.process_index() == 0:
             tmp = os.path.join(self._dir, f".meta-{step}.json.tmp")
             with open(tmp, "w") as f:
                 json.dump(metadata, f)
             os.replace(tmp, os.path.join(self._dir, f"meta-{step}.json"))
             self._gc_metadata(keep=step)
+
+    def verify_step(self, step: int) -> Optional[bool]:
+        """Re-hash `step`'s files against the recorded digest.  True =
+        match, False = mismatch (or unreadable), None = no digest was
+        recorded (pre-integrity checkpoint: unknown, not invalid)."""
+        meta = self.metadata(step)
+        recorded = (meta or {}).get("integrity")
+        if not recorded:
+            return None
+        try:
+            actual = checkpoint_digest(self._step_dir(step))
+        except OSError:
+            return False
+        return actual["digest"] == recorded["digest"]
 
     def _gc_metadata(self, keep: Optional[int] = None) -> None:
         """Drop meta-*.json sidecars whose checkpoint was purged by orbax's
@@ -218,6 +326,39 @@ class CheckpointManager:
                 for x, s in zip(leaves, flat_shard)])
         return self._mgr.restore(step,
                                  args=ocp.args.StandardRestore(abstract))
+
+    def restore_latest_valid(self, state_template: TrainState,
+                             shardings: Optional[Any] = None,
+                             rank: int = 0) -> Optional[RestoreResult]:
+        """Restore the newest step that (a) passes the integrity check
+        and (b) actually restores.  Steps failing either are skipped
+        with a rank-0 warning and reported in ``RestoreResult.skipped``
+        (the resilience counters' `restores`/`skipped` feed).  Returns
+        None when no step survives."""
+        skipped = []
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            verdict = self.verify_step(step)
+            if verdict is False:
+                if rank == 0:
+                    print(f"=> checkpoint {step}: integrity digest "
+                          f"mismatch — skipping", file=sys.stderr)
+                skipped.append(step)
+                continue
+            try:
+                state = self.restore(state_template, step=step,
+                                     shardings=shardings)
+            except Exception as e:
+                # a checkpoint that fails integrity-unknown restore is
+                # exactly what this scan exists to survive: report and
+                # fall back to the next-newest step
+                if rank == 0:
+                    print(f"=> checkpoint {step}: restore failed "
+                          f"({type(e).__name__}: {e}) — skipping",
+                          file=sys.stderr)
+                skipped.append(step)
+                continue
+            return RestoreResult(state, step, tuple(skipped))
+        return None
 
     def close(self):
         self._mgr.close()

@@ -172,8 +172,10 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                                opt_state=new_opt)
         # metrics use the dp/sp token count only (tp ranks duplicate the
         # same tokens, and these psums exclude tp)
+        from ..resilience.guard import guard_metrics
         total_n = lax.psum(ns.sum(), (axis_dp, axis_sp))
         metrics = {
+            **guard_metrics(new_opt),
             "loss": lax.psum(sums.sum(), (axis_dp, axis_sp)) / total_n,
             "accuracy": lax.psum(hits.sum().astype(jnp.float32),
                                  (axis_dp, axis_sp)) / total_n,

@@ -18,7 +18,64 @@ from typing import Sequence
 
 import numpy as np
 
-__all__ = ["AverageMeter", "accuracy", "Timer", "loss_diverged"]
+__all__ = ["AverageMeter", "accuracy", "Timer", "loss_diverged",
+           "ResilienceMeter"]
+
+
+class ResilienceMeter:
+    """Run-level resilience counters, one place, one spelling.
+
+    Two kinds of field: *absolute* counters mirrored from the jitted
+    guard/injection state (``observe_metrics`` overwrites them from the
+    step's metric dict — the device holds the truth), and *host* counters
+    the loop bumps itself (``bump``).  ``suffix()`` renders the non-zero
+    ones for the per-step log line; ``as_dict`` feeds bench.py /
+    trainer return values so BENCH_* can track skip-rate across PRs.
+    """
+
+    # device-mirrored (metric key -> field)
+    MIRRORED = {"guard_skipped": "steps_skipped",
+                "guard_overflows": "overflows",
+                "guard_spikes": "spikes",
+                "guard_disagreements": "disagreements",
+                "faults_injected": "faults_injected"}
+    HOST = ("rollbacks", "restores", "watchdog_trips", "preemptions",
+            "batches_dropped", "batches_duplicated", "ckpts_invalid")
+    FIELDS = tuple(MIRRORED.values()) + HOST
+
+    def __init__(self):
+        self.counts = {f: 0 for f in self.FIELDS}
+
+    def observe_metrics(self, metrics: dict) -> None:
+        """Mirror the cumulative device-side counters from one step's
+        metrics (keys absent when no guard/injector is wired — no-op)."""
+        for key, field in self.MIRRORED.items():
+            if key in metrics:
+                self.counts[field] = int(metrics[key])
+
+    def bump(self, field: str, n: int = 1) -> None:
+        if field not in self.counts:
+            raise KeyError(f"unknown resilience counter {field!r}; know "
+                           f"{sorted(self.counts)}")
+        self.counts[field] += n
+
+    def __getitem__(self, field: str) -> int:
+        return self.counts[field]
+
+    def as_dict(self) -> dict:
+        return dict(self.counts)
+
+    def suffix(self) -> str:
+        """' skip 2 ovf 1 rollback 1' — only the non-zero counters, so
+        a healthy run's log lines stay exactly as they were."""
+        short = {"steps_skipped": "skip", "overflows": "ovf",
+                 "spikes": "spike", "disagreements": "disagree",
+                 "faults_injected": "inj", "rollbacks": "rollback",
+                 "restores": "restore", "watchdog_trips": "wdog",
+                 "preemptions": "preempt", "batches_dropped": "drop",
+                 "batches_duplicated": "dup", "ckpts_invalid": "badckpt"}
+        parts = [f"{short[f]} {v}" for f, v in self.counts.items() if v]
+        return (" " + " ".join(parts)) if parts else ""
 
 
 def loss_diverged(loss: float, where: str, rank: int,

@@ -103,8 +103,10 @@ def make_moe_train_step(model: MoETransformerLM,
         new_state = TrainState(step=state.step + 1, params=new_params,
                                batch_stats=state.batch_stats,
                                opt_state=new_opt)
+        from ..resilience.guard import guard_metrics
         total = lax.psum(ln, data_axes)
         metrics = {
+            **guard_metrics(new_opt),
             "loss": lax.psum(lsum, data_axes) / total,
             "accuracy": lax.psum(hits.astype(jnp.float32),
                                  data_axes) / total,

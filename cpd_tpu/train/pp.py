@@ -144,8 +144,10 @@ def make_pp_train_step(model: PipelinedLM, tx: optax.GradientTransformation,
         new_state = TrainState(step=state.step + 1, params=new_params,
                                batch_stats=state.batch_stats,
                                opt_state=new_opt)
+        from ..resilience.guard import guard_metrics
         total = lax.psum(ln, all_axes)
         metrics = {
+            **guard_metrics(new_opt),
             "loss": lax.psum(lsum, all_axes) / total,
             "accuracy": lax.psum(hits.astype(jnp.float32), all_axes) / total,
         }

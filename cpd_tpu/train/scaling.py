@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import optax
 
 __all__ = ["DynamicScaleState", "with_dynamic_loss_scale", "all_finite",
-           "current_scale"]
+           "current_scale", "find_dynamic_scale"]
 
 
 class DynamicScaleState(NamedTuple):
@@ -51,15 +51,29 @@ def all_finite(tree: Any) -> jnp.ndarray:
     return jnp.stack([jnp.all(jnp.isfinite(l)) for l in leaves]).all()
 
 
+def find_dynamic_scale(opt_state: Any) -> Any:
+    """The DynamicScaleState node nested anywhere in ``opt_state``, or
+    None.  A structural (pytree) search, so it sees through wrappers
+    like optax.chain or resilience.with_grad_guard that nest the scale
+    state one level down."""
+    def is_dyn(n):
+        return isinstance(n, DynamicScaleState)
+    for node in jax.tree.leaves(opt_state, is_leaf=is_dyn):
+        if is_dyn(node):
+            return node
+    return None
+
+
 def current_scale(opt_state: Any) -> jnp.ndarray:
     """The live scale scalar from a `with_dynamic_loss_scale` opt state.
     Raises if the optimizer is not wrapped (trainers pass this to the loss)."""
-    if not isinstance(opt_state, DynamicScaleState):
+    node = find_dynamic_scale(opt_state)
+    if node is None:
         raise TypeError(
             "dynamic loss scaling needs the optimizer wrapped with "
             "with_dynamic_loss_scale(tx); got opt state "
             f"{type(opt_state).__name__}")
-    return opt_state.scale
+    return node.scale
 
 
 def with_dynamic_loss_scale(tx: optax.GradientTransformation,

@@ -290,7 +290,14 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         new_state = TrainState(step=state.step + 1, params=new_params,
                                batch_stats=new_stats, opt_state=new_opt)
+        # resilience counters (guard skip/overflow/spike totals, injected
+        # fault count) ride along as replicated scalars whenever the
+        # optimizer is wrapped with resilience.with_grad_guard /
+        # with_fault_injection; {} otherwise, so the metric dict shape is
+        # unchanged for unguarded runs.
+        from ..resilience.guard import guard_metrics
         metrics = {
+            **guard_metrics(new_opt),
             # loss is the per-rank sum of micro losses (already /world/n);
             # psum across ranks gives the global mean (mix.py:240-242).
             # (`loss` aux output is the UNSCALED per-micro loss, so no

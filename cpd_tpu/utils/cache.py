@@ -80,7 +80,7 @@ def _machine_tag() -> str:
                 words.extend((a, b, c, d))
             blob = ",".join(f"{w:08x}" for w in words)
             return hashlib.sha1(blob.encode()).hexdigest()[:10]
-        except Exception:
+        except Exception:  # cpd: disable=swallow — fallback IS the handling
             pass  # W^X kernels etc. — fall through to cpuinfo
     try:
         with open("/proc/cpuinfo") as f:
@@ -149,5 +149,5 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
                           cache_dir or default_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:
+    except Exception:  # cpd: disable=swallow — cache is best-effort opt-in
         pass

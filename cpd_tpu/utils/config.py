@@ -1,9 +1,16 @@
-"""YAML-into-argparse config merge (ResNet18 trainer parity).
+"""YAML-into-argparse config merge (ResNet18 trainer parity) + the
+shared resilience-flag surface.
 
 The reference loads a YAML file and injects the ``common:`` block's keys
 directly onto the argparse namespace (mix.py:69-72), so CLI flags and YAML
 keys share one flat namespace.  Same contract here, plus explicit
 precedence: a key given on the command line wins over the YAML value.
+
+``add_resilience_flags`` / ``build_resilience`` give every trainer the
+same ``--fault-plan`` / guard / watchdog / rollback vocabulary (the YAML
+merge covers these keys too, since they are plain argparse dests).
+Imports of the resilience package are lazy: a trainer that never passes
+a resilience flag pays nothing.
 """
 
 from __future__ import annotations
@@ -13,7 +20,8 @@ from typing import Any, Dict
 
 import yaml
 
-__all__ = ["load_yaml_config", "merge_config_into_args"]
+__all__ = ["load_yaml_config", "merge_config_into_args",
+           "add_resilience_flags", "build_resilience"]
 
 
 def load_yaml_config(path: str, section: str = "common") -> Dict[str, Any]:
@@ -37,3 +45,101 @@ def merge_config_into_args(args: argparse.Namespace, cfg: Dict[str, Any],
         if key not in explicit:
             setattr(args, key, value)
     return args
+
+
+def add_resilience_flags(parser: argparse.ArgumentParser) -> None:
+    """The shared ``--fault-plan`` + defense knobs (docs/RESILIENCE.md)."""
+    g = parser.add_argument_group(
+        "resilience", "fault injection + guarded-loop defenses")
+    g.add_argument("--fault-plan", default=None, metavar="SPEC|FILE",
+                   help="inject faults: 'kind@step[:arg];...' (e.g. "
+                        "'grad_nan@3;stall@5:1.5;ckpt_truncate@6'), a "
+                        "JSON plan file, or 'random:<seed>' for a "
+                        "seed-deterministic random plan over the run")
+    g.add_argument("--guard-grads", action="store_true",
+                   help="wrap the optimizer with resilience."
+                        "with_grad_guard: skip non-finite / spiking / "
+                        "replica-disagreeing gradient steps (implied by "
+                        "--fault-plan with grad_* faults)")
+    g.add_argument("--spike-factor", default=10.0, type=float,
+                   help="guard: skip a finite step whose grad norm "
+                        "exceeds this multiple of its running EMA")
+    g.add_argument("--watchdog-timeout", default=0.0, type=float,
+                   help="seconds a step may block before the watchdog "
+                        "dumps diagnostics and forces a clean "
+                        "checkpoint-and-exit (0 = off)")
+    g.add_argument("--divergence-window", default=0, type=int,
+                   help="divergence sentinel window of recent losses "
+                        "(0 = off); trips when loss > factor x median")
+    g.add_argument("--divergence-factor", default=10.0, type=float)
+    g.add_argument("--max-rollbacks", default=2, type=int,
+                   help="bounded retries: rollbacks to the newest valid "
+                        "checkpoint before declaring the run diverged")
+    g.add_argument("--rollback-backoff", default=0.0, type=float,
+                   help="seconds to sleep after rollback k (doubled "
+                        "each retry)")
+    g.add_argument("--no-ckpt-integrity", dest="ckpt_integrity",
+                   action="store_false", default=True,
+                   help="skip the per-save content digest (saves regain "
+                        "their async overlap with compute, at the cost "
+                        "of restore falling back only on restore "
+                        "FAILURES, not on silent corruption)")
+
+
+def build_resilience(args: argparse.Namespace, *, n_steps: int,
+                     rank: int = 0) -> Dict[str, Any]:
+    """Materialize the resilience stack from parsed flags.
+
+    Returns a dict with ``injector`` / ``watchdog`` / ``sentinel`` /
+    ``meter`` (each possibly None) and ``wrap_tx``, a callable that
+    layers ``with_fault_injection`` (when the plan has gradient faults)
+    and ``with_grad_guard`` (when requested or implied) around an
+    optimizer — outermost-first, the order guard.py documents.
+    """
+    from cpd_tpu.resilience import (DivergenceSentinel, FaultPlan,
+                                    Injector, StepWatchdog,
+                                    with_fault_injection, with_grad_guard)
+    from cpd_tpu.train.metrics import ResilienceMeter
+
+    plan = None
+    spec = getattr(args, "fault_plan", None)
+    if spec:
+        if spec.startswith("random:"):
+            plan = FaultPlan.random(int(spec.split(":", 1)[1]), n_steps)
+        else:
+            plan = FaultPlan.parse(spec)
+    guard = bool(getattr(args, "guard_grads", False)
+                 or (plan is not None and plan.grad_faults()))
+
+    def wrap_tx(tx, axis_name=None):
+        if guard:
+            tx = with_grad_guard(tx, spike_factor=args.spike_factor,
+                                 axis_name=axis_name)
+        if plan is not None and plan.grad_faults():
+            tx = with_fault_injection(tx, plan, n_steps,
+                                      axis_name=axis_name)
+        return tx
+
+    timeout = float(getattr(args, "watchdog_timeout", 0.0) or 0.0)
+    window = int(getattr(args, "divergence_window", 0) or 0)
+    return {
+        "plan": plan,
+        # True only when wrap_tx is not the identity — what actually
+        # composes (or not) with custom-update paths like ZeRO
+        "wraps_optimizer": bool(guard
+                                or (plan is not None and plan.grad_faults())),
+        "injector": Injector(plan, rank=rank) if plan is not None else None,
+        # hard_exit_after: a trip nobody acknowledges (step wedged in
+        # native code, or the interrupt absorbed with no boundary in
+        # sight) kills the process with diagnostics after one more
+        # timeout, instead of hanging forever (watchdog.py docstring)
+        "watchdog": (StepWatchdog(timeout, rank=rank,
+                                  hard_exit_after=timeout)
+                     if timeout > 0 else None),
+        "sentinel": (DivergenceSentinel(window,
+                                        factor=args.divergence_factor)
+                     if window > 0 else None),
+        "meter": ResilienceMeter(),
+        "wrap_tx": wrap_tx,
+        "active": bool(plan or guard or timeout > 0 or window > 0),
+    }

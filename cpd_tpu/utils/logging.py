@@ -135,11 +135,14 @@ class ProgressPrinter:
         self.freq = print_freq
         self.rank = rank
 
-    def maybe_print(self, step: int, **meters: float):
+    def maybe_print(self, step: int, _suffix: str = "", **meters: float):
+        """``_suffix``: pre-rendered tail (the resilience counters'
+        ``ResilienceMeter.suffix()`` — integers, so they don't go
+        through the float meter formatting); empty for healthy runs."""
         if self.rank != 0 or step % self.freq != 0:
             return
         body = "\t".join(f"{k} {v:.4f}" for k, v in meters.items())
-        print(f"Iter: [{step}/{self.total}]\t{body}", flush=True)
+        print(f"Iter: [{step}/{self.total}]\t{body}{_suffix}", flush=True)
 
 
 def format_validation_line(loss: float, prec1: float, prec5: float) -> str:

@@ -156,6 +156,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="after training, write a torch state_dict .pth "
                         "of the LM (cpd_tpu.interop.torch_lm; default "
                         "dp/sp/tp path only — pp/moe layouts differ)")
+    from cpd_tpu.utils.config import add_resilience_flags
+    add_resilience_flags(p)       # --fault-plan / guard / watchdog / rollback
     return p
 
 
@@ -273,6 +275,19 @@ def main(argv=None) -> dict:
         schedule = warmup_step_decay(args.base_lr, args.warmup_iters,
                                      [args.max_iter * 2], warmup_from=0.0)
     tx = make_optimizer(args.optimizer, schedule, momentum=0.9)
+    # resilience stack (docs/RESILIENCE.md): gradient faults + guard are
+    # optax wrappers, so they ride inside the jitted step on every path
+    # (dp/sp/tp, pp, moe); host faults/watchdog/sentinel wrap the loop.
+    from cpd_tpu.utils.config import build_resilience
+    res = build_resilience(args, n_steps=args.max_iter, rank=rank)
+    if res["active"]:
+        # the guard's verdict must be agreed over EVERY mesh axis the
+        # update runs under — tp/pp/ep-sharded leaves legitimately hold
+        # different gradients per shard, so a dp-only psum would let
+        # model shards take different skip branches (guard.py docstring)
+        tx = res["wrap_tx"](tx, axis_name=tuple(mesh.axis_names))
+    injector, watchdog = res["injector"], res["watchdog"]
+    sentinel, meter = res["sentinel"], res["meter"]
 
     ds = SyntheticText(n=4096, seq_len=args.seq_len,
                        vocab_size=args.vocab_size)
@@ -350,7 +365,8 @@ def main(argv=None) -> dict:
     from jax.sharding import NamedSharding, PartitionSpec
     from cpd_tpu.train import CheckpointManager
     manager = CheckpointManager(os.path.abspath(
-        os.path.join(args.save_path, "ckpt")), track_best=False)
+        os.path.join(args.save_path, "ckpt")), track_best=False,
+        integrity=getattr(args, "ckpt_integrity", True))
     start_iter = 0
     restored = manager.restore(state)
     if restored is not None:
@@ -358,10 +374,16 @@ def main(argv=None) -> dict:
         start_iter = int(restored.step)
         if rank == 0:
             print(f"=> resumed from iter {start_iter}")
-    state = jax.device_put(
-        state, jax.tree.map(lambda s: NamedSharding(mesh, s),
-                            specs_fn(state),
-                            is_leaf=lambda s: isinstance(s, PartitionSpec)))
+    def relayout(st):
+        # orbax restores arrays committed to a single device; the step's
+        # shard_map needs the path's PartitionSpec layout (also re-run
+        # after every rollback restore)
+        return jax.device_put(
+            st, jax.tree.map(lambda s: NamedSharding(mesh, s),
+                             specs_fn(st),
+                             is_leaf=lambda s: isinstance(s, PartitionSpec)))
+
+    state = relayout(state)
     # held-out tail of the synthetic corpus for validation (sized to the
     # eval step's data sharding: dp, dp x ep, ... depending on path)
     val_bs = global_batch // args.emulate_node
@@ -388,25 +410,136 @@ def main(argv=None) -> dict:
     # SIGTERM → save at the next step boundary and exit cleanly; resume
     # continues at the saved iteration (same scheme as the other trainers)
     from cpd_tpu.train import PreemptionGuard, loss_diverged, preempt_save
+    from cpd_tpu.resilience.inject import InjectedPreemption
     guard = PreemptionGuard()
     preempted = diverged = False
     step_no = start_iter
+    rollbacks = reseed = 0
+    prev_batch = None
+
+    def batch_for(i):
+        # default path: the run-sequential RNG stream (unchanged
+        # behavior — watchdog/guard-only runs keep the baseline's exact
+        # batch order); rollback path: per-(retry, iter) seeding so a
+        # replay draws a DIFFERENT batch order (the re-seeded recovery
+        # of docs/RESILIENCE.md), identically on every host
+        if sentinel is not None:
+            r = np.random.RandomState((reseed * 1000003 + i) % (2 ** 31))
+            idx = r.randint(0, train_n, size=global_batch)
+        else:
+            idx = rng.randint(0, train_n, size=global_batch)
+        return ds.batch(idx, seed=i)
+
+    def watchdog_stop():
+        watchdog.disarm()     # acknowledge the trip: cancels hard-exit
+        meter.bump("watchdog_trips")
+        preempt_save(manager, step_no, state, rank, what="watchdog stop at")
+
     try:
-        for it in range(start_iter + 1, args.max_iter + 1):
+        it = start_iter + 1
+        while it <= args.max_iter:
+            if watchdog is not None and watchdog.tripped:
+                # the trip's interrupt was absorbed by the SIGINT-trapping
+                # PreemptionGuard; honor it at the step boundary
+                watchdog_stop()
+                preempted = True
+                break
             if guard.should_stop():      # collective when multi-host
                 preempt_save(manager, step_no, state, rank)
                 preempted = True
                 break
             profiler.step(it)
-            idx = rng.randint(0, train_n, size=global_batch)
-            toks, tgts = ds.batch(idx, seed=it)
-            state, m = step(state, jnp.asarray(toks), jnp.asarray(tgts))
+            # host faults key on the 0-based optimizer-UPDATE index, the
+            # same clock with_fault_injection's grad schedule runs on, so
+            # one plan stays in sync across its two executors (and across
+            # run_guarded, whose `it` is that index already).  Checkpoint
+            # faults are the exception: they key on the saved step's name.
+            upd = it - 1
+            try:
+                if injector is not None:
+                    injector.maybe_preempt(upd)
+                    action = injector.batch_action(upd)
+                else:
+                    action = None
+                if action == "dup" and prev_batch is not None:
+                    meter.bump("batches_duplicated")
+                    toks, tgts = prev_batch
+                elif action == "drop":
+                    meter.bump("batches_dropped")
+                    toks, tgts = batch_for(it + args.max_iter)
+                else:
+                    toks, tgts = batch_for(it)
+                if injector is not None:
+                    # batch_scale touches float leaves only (a no-op on
+                    # int token batches); batch_nan raises loudly there —
+                    # LM gradient faults belong to the grad_* kinds
+                    toks, tgts = injector.corrupt_batch(upd, (toks, tgts))
+                prev_batch = (toks, tgts)
+                if watchdog is not None:
+                    watchdog.arm(it, loss=last.get("loss"))
+                if injector is not None:
+                    injector.maybe_stall(upd)
+                state, m = step(state, jnp.asarray(toks), jnp.asarray(tgts))
+                last = {k: float(v) for k, v in m.items()}  # device sync
+                if watchdog is not None:
+                    watchdog.disarm()
+            except KeyboardInterrupt:
+                if watchdog is not None and watchdog.tripped:
+                    watchdog_stop()
+                    preempted = True
+                    break
+                raise
+            except InjectedPreemption:
+                preempt_save(manager, step_no, state, rank,
+                             what="injected preemption at")
+                meter.bump("preemptions")
+                preempted = True
+                break
             step_no = it
-            last = {k: float(v) for k, v in m.items()}
-            if loss_diverged(last["loss"], f"iter {it}", rank):
+            if meter is not None:
+                meter.observe_metrics(last)
+            if injector is not None:
+                last["loss"] = injector.fault_loss(upd, last["loss"])
+            # a guard-skipped step's loss metric may be poisoned by the
+            # bad batch/grads; the anomaly was already handled in-step
+            guard_ok = float(last.get("guard_ok", 1.0)) != 0.0
+            if sentinel is not None:
+                if guard_ok and sentinel.update(last["loss"]):
+                    if rank == 0:
+                        print(f"=> divergence sentinel tripped at iter "
+                              f"{it} (loss {last['loss']:.4g})",
+                              file=sys.stderr)
+                    rolled = None
+                    if rollbacks < args.max_rollbacks:
+                        rolled = manager.restore_latest_valid(state,
+                                                              rank=rank)
+                    if rolled is None:
+                        diverged = True
+                        break
+                    for _bad in rolled.skipped:
+                        meter.bump("ckpts_invalid")
+                    state = relayout(rolled.state)
+                    step_no = int(rolled.step)
+                    it = step_no + 1
+                    rollbacks += 1
+                    reseed = rollbacks
+                    meter.bump("rollbacks")
+                    meter.bump("restores")
+                    sentinel.reset()
+                    if rank == 0:
+                        print(f"=> rolled back to iter {step_no} "
+                              f"(retry {rollbacks}/{args.max_rollbacks}, "
+                              f"re-seeded data order)", file=sys.stderr)
+                    if args.rollback_backoff > 0:
+                        time.sleep(args.rollback_backoff
+                                   * (2 ** (rollbacks - 1)))
+                    continue
+            elif guard_ok and loss_diverged(last["loss"], f"iter {it}",
+                                            rank):
                 diverged = True
                 break
-            progress.maybe_print(it, Loss=last["loss"],
+            progress.maybe_print(it, _suffix=meter.suffix(),
+                                 Loss=last["loss"],
                                  Acc=100 * last["accuracy"],
                                  TokPerSec=global_batch * args.seq_len * it
                                  / max(time.time() - t0, 1e-9))
@@ -414,9 +547,26 @@ def main(argv=None) -> dict:
             if it % args.val_freq == 0 or it == args.max_iter:
                 validate(it)
             if it % args.ckpt_freq == 0 or it == args.max_iter:
-                manager.save(it, state)
+                # force under resilience: a rollback replay must be able
+                # to overwrite the stale/corrupt copy of this step
+                manager.save(it, state, force=res["active"])
+                if injector is not None:
+                    # the fault must land on the FINAL bytes — without
+                    # integrity the save is still async at this point
+                    manager.wait()
+                if injector is not None and injector.corrupt_checkpoint(
+                        it, manager.directory):
+                    if rank == 0:
+                        print(f"=> injected checkpoint corruption at "
+                              f"step {it}", file=sys.stderr)
+            it += 1
     finally:
         guard.uninstall()
+        if watchdog is not None:
+            watchdog.close()
+    if injector is not None and rank == 0 and injector.unfired():
+        print(f"=> fault plan: spec(s) never fired: "
+              f"{injector.unfired()}", file=sys.stderr)
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()
@@ -476,6 +626,7 @@ def main(argv=None) -> dict:
             print(f"=> exported torch state_dict {args.export_torch}")
     writer.close()
     return {"step": step_no, "diverged": diverged,
+            **({"resilience": meter.as_dict()} if res["active"] else {}),
             **({"sample": sampled} if sampled is not None else {}), **last}
 
 

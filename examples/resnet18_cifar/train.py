@@ -114,6 +114,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "sharded (all_to_all reduce-scatter; composes "
                         "with --use_lars).  --zero3 lives on the "
                         "ResNet-50 CLI (portable checkpoint layout)")
+    from cpd_tpu.utils.config import add_resilience_flags
+    add_resilience_flags(p)       # --fault-plan / guard / watchdog
     return p
 
 
@@ -181,6 +183,23 @@ def main(argv=None) -> dict:
                         opt_rounding=args.opt_rounding,
                         opt_seed=args.opt_seed,
                         clip_norm=args.clip_grad)
+    # Resilience stack (docs/RESILIENCE.md).  This trainer wires the
+    # in-step defenses (guard + injected gradient faults), the host
+    # faults, the watchdog, and the divergence STOP; checkpoint-rollback
+    # recovery lives on the LM trainer, whose synchronous batch fetch
+    # can rewind (the Prefetcher pipeline here cannot).
+    from cpd_tpu.utils.config import build_resilience
+    res = build_resilience(args, n_steps=total_iter, rank=rank)
+    if res["wraps_optimizer"] and (args.zero1 or args.zero2):
+        # watchdog / sentinel / host-level faults compose fine with ZeRO;
+        # only the optimizer WRAPPERS (guard, grad-fault injection) don't
+        raise SystemExit("--guard-grads / grad_* faults do not compose "
+                         "with the ZeRO updaters (custom update_fn owns "
+                         "the optimizer math the guard would wrap)")
+    if res["active"]:
+        tx = res["wrap_tx"](tx, axis_name="dp")
+    injector, watchdog = res["injector"], res["watchdog"]
+    sentinel, meter = res["sentinel"], res["meter"]
 
     state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),
                                jax.random.PRNGKey(seed))
@@ -208,7 +227,9 @@ def main(argv=None) -> dict:
                      weight_decay=args.weight_decay)
         state = state.replace(opt_state=zero.init(state.params))
     ckpt_dir = os.path.abspath(args.save_path)
-    manager = CheckpointManager(ckpt_dir, track_best=True)
+    manager = CheckpointManager(ckpt_dir, track_best=True,
+                                integrity=getattr(args, "ckpt_integrity",
+                                                  True))
     start_iter = 0
     if args.init_from_torch and args.load_path:
         raise SystemExit("--init-from-torch and --load-path are exclusive")
@@ -361,25 +382,97 @@ def main(argv=None) -> dict:
     # exit; the iteration-based sampler resumes at exactly this step via
     # last_iter (train_util.py:159-222 semantics), so nothing re-trains.
     from cpd_tpu.train import PreemptionGuard, loss_diverged, preempt_save
+    from cpd_tpu.resilience.inject import InjectedPreemption
     guard = PreemptionGuard()
     preempted = False
     diverged = False
+    prev_batch = None
     from cpd_tpu.utils.prefetch import Prefetcher
     batches = Prefetcher(produced(), depth=2)
+    batch_iter = iter(batches)
     try:
-        for gx, gy in batches:
+        for gx, gy in batch_iter:
+            if watchdog is not None and watchdog.tripped:
+                # trip interrupt absorbed by the SIGINT-trapping guard;
+                # honor it at the boundary (docs/RESILIENCE.md)
+                watchdog.disarm()     # acknowledge: cancels hard-exit
+                meter.bump("watchdog_trips")
+                preempt_save(manager, step_no, to_ckpt(state), rank,
+                             what="watchdog stop at")
+                preempted = True
+                break
             if guard.should_stop():      # collective when multi-host
                 preempt_save(manager, step_no, to_ckpt(state), rank)
                 preempted = True
                 break
             profiler.step(step_no)
-            state, metrics = train_step(state, gx, gy)
+            try:
+                if injector is not None:
+                    injector.maybe_preempt(step_no)
+                    action = injector.batch_action(step_no)
+                    if action == "drop":
+                        # this batch never arrives; train on the next
+                        # one (same semantics as run_guarded / lm)
+                        meter.bump("batches_dropped")
+                        try:
+                            gx, gy = next(batch_iter)
+                        except StopIteration:
+                            break
+                    if action == "dup" and prev_batch is not None:
+                        meter.bump("batches_duplicated")
+                        gx, gy = prev_batch
+                    gx, gy = injector.corrupt_batch(step_no, (gx, gy))
+                prev_batch = (gx, gy)
+                if watchdog is not None:
+                    watchdog.arm(step_no, loss=last.get("loss"))
+                if injector is not None:
+                    injector.maybe_stall(step_no)
+                state, metrics = train_step(state, gx, gy)
+                last = {k: float(v) for k, v in metrics.items()}  # sync
+                if watchdog is not None:
+                    watchdog.disarm()
+            except KeyboardInterrupt:
+                if watchdog is not None and watchdog.tripped:
+                    watchdog.disarm()     # acknowledge: cancels hard-exit
+                    meter.bump("watchdog_trips")
+                    preempt_save(manager, step_no, to_ckpt(state), rank,
+                                 what="watchdog stop at")
+                    preempted = True
+                    break
+                raise
+            except InjectedPreemption:
+                meter.bump("preemptions")
+                preempt_save(manager, step_no, to_ckpt(state), rank,
+                             what="injected preemption at")
+                preempted = True
+                break
             step_no += 1
-            last = {k: float(v) for k, v in metrics.items()}
-            if loss_diverged(last["loss"], f"iter {step_no}", rank):
+            meter.observe_metrics(last)
+            if injector is not None:
+                # step_no - 1 == the 0-based update index this loss came
+                # from — the same clock the pre-step hooks above use
+                last["loss"] = injector.fault_loss(step_no - 1,
+                                                   last["loss"])
+            # a guard-skipped step's loss metric may be poisoned by the
+            # bad batch/grads; the anomaly was already handled in-step
+            guard_ok = float(last.get("guard_ok", 1.0)) != 0.0
+            if (sentinel is not None and guard_ok
+                    and sentinel.update(last["loss"])):
+                # divergence STOP (rollback recovery: LM trainer / the
+                # resilience.run_guarded loop)
+                if rank == 0:
+                    print(f"=> divergence sentinel tripped at iter "
+                          f"{step_no} (loss {last['loss']:.4g})",
+                          file=sys.stderr)
                 diverged = True
                 break
-            progress.maybe_print(step_no, Loss=last["loss"],
+            if (sentinel is None and guard_ok
+                    and loss_diverged(last["loss"],
+                                      f"iter {step_no}", rank)):
+                diverged = True
+                break
+            progress.maybe_print(step_no, _suffix=meter.suffix(),
+                                 Loss=last["loss"],
                                  Prec=100 * last["accuracy"],
                                  LR=float(schedule(step_no)))
             writer.add_scalar("train/loss", last["loss"], step_no)
@@ -390,9 +483,22 @@ def main(argv=None) -> dict:
                 prec1 = 100 * val["top1"]
                 best_prec1 = max(best_prec1, prec1)
                 manager.save(step_no, to_ckpt(state), best_metric=prec1)
+                if injector is not None:
+                    # the fault must land on the FINAL bytes — without
+                    # integrity the save is still async at this point
+                    manager.wait()
+                if injector is not None and injector.corrupt_checkpoint(
+                        step_no, manager.directory) and rank == 0:
+                    print(f"=> injected checkpoint corruption at step "
+                          f"{step_no}", file=sys.stderr)
     finally:
         guard.uninstall()
+        if watchdog is not None:
+            watchdog.close()
         batches.close()   # stop the producer even on an exception path
+    if injector is not None and rank == 0 and injector.unfired():
+        print(f"=> fault plan: spec(s) never fired: "
+              f"{injector.unfired()}", file=sys.stderr)
     profiler.close()
     manager.wait()
     writer.close()
@@ -403,7 +509,9 @@ def main(argv=None) -> dict:
     if not (preempted or diverged):
         export_torch(state)
     return {"step": step_no, "best_prec1": best_prec1,
-            "diverged": diverged, **last}
+            "diverged": diverged,
+            **({"resilience": meter.as_dict()} if res["active"] else {}),
+            **last}
 
 
 if __name__ == "__main__":

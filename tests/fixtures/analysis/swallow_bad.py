@@ -1,0 +1,31 @@
+"""Deliberately-bad fixture for the `swallow` rule: 4 findings."""
+
+
+def bare_handler(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except:                       # noqa: E722 — finding 1: bare except
+        return None
+
+
+def broad_pass(fn):
+    try:
+        fn()
+    except Exception:             # finding 2: swallowed
+        pass
+
+
+def broad_ellipsis(fn):
+    try:
+        fn()
+    except BaseException:         # finding 3: swallowed (even broader)
+        ...
+
+
+def tuple_with_broad(fn):
+    for _ in range(3):
+        try:
+            return fn()
+        except (ValueError, Exception):   # finding 4: tuple hides Exception
+            continue

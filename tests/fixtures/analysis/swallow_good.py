@@ -1,0 +1,38 @@
+"""Clean twin for the `swallow` rule: narrow handlers, and broad ones
+that do something with the failure."""
+
+import sys
+
+
+def narrow_pass(path):
+    # a named, narrow exception may be passed — the decision is visible
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        pass
+    return None
+
+
+def broad_but_logged(fn):
+    try:
+        return fn()
+    except Exception as e:
+        print(f"fallback after {type(e).__name__}: {e}", file=sys.stderr)
+        return None
+
+
+def broad_but_reraised(fn):
+    try:
+        return fn()
+    except Exception:
+        fn.cleanup()
+        raise
+
+
+def narrow_tuple(fn):
+    try:
+        return fn()
+    except (ValueError, KeyError):
+        pass
+    return 0

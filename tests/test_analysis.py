@@ -38,7 +38,8 @@ def _fixture(rule_id: str, kind: str) -> str:
 
 def test_catalog_is_complete():
     assert RULE_IDS == ["axis-name", "donation", "format-bounds",
-                        "jit-hazards", "kahan-ordering", "pallas-hygiene"]
+                        "jit-hazards", "kahan-ordering", "pallas-hygiene",
+                        "swallow"]
 
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)
@@ -61,7 +62,8 @@ def test_bad_fixture_finding_counts():
     """Each bad fixture encodes a known number of defects; pin them so a
     rule silently losing a check fails loudly."""
     expected = {"format-bounds": 6, "axis-name": 2, "jit-hazards": 6,
-                "pallas-hygiene": 5, "kahan-ordering": 3, "donation": 2}
+                "pallas-hygiene": 5, "kahan-ordering": 3, "donation": 2,
+                "swallow": 4}
     assert set(expected) == set(RULE_IDS), "new rule missing a count pin"
     for rule_id, n in expected.items():
         findings = lint_file(_fixture(rule_id, "bad"), select=[rule_id])
@@ -108,6 +110,16 @@ def test_skip_file():
 def test_unsuppressed_fires():
     assert [f.rule for f in lint_source(_BAD_LINE + "\n")] \
         == ["format-bounds"]
+
+
+def test_swallow_rule_exempts_resilience_package():
+    """resilience/ is the sanctioned home of failure handling: the same
+    source flags everywhere else but is silent there."""
+    src = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    assert [f.rule for f in lint_source(
+        src, path="cpd_tpu/utils/helper.py")] == ["swallow"]
+    assert lint_source(
+        src, path="cpd_tpu/resilience/loop.py") == []
 
 
 def test_directives_in_docstrings_are_inert():

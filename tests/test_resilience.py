@@ -1,0 +1,694 @@
+"""cpd_tpu.resilience — fault injection proving the defenses (ISSUE 2).
+
+Layers:
+
+* plan: the FaultPlan grammar / JSON / seeded-random determinism;
+* wrappers: with_fault_injection schedules, with_grad_guard skip
+  semantics (non-finite, spike, culprit, dynamic-scale composition) and
+  the cross-replica agreement check inside a real shard_map;
+* integrity: checkpoint digests, truncation/bit-flip detection,
+  restore-latest-valid fallback;
+* host machinery: PreemptionGuard handler restoration (regression),
+  StepWatchdog trip + interrupt conversion, DivergenceSentinel;
+* end-to-end: the chaos run of the acceptance criteria — NaN gradient +
+  truncated checkpoint + loss blow-up in ONE guarded run that finishes
+  within budget with exact counter accounting, twice, identically.
+"""
+
+import os
+import signal
+import sys
+import time
+from typing import Any, NamedTuple
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from cpd_tpu.resilience import (DivergenceSentinel, FaultPlan, FaultSpec,
+                                GradGuardState, Injector,
+                                InjectedPreemption, StepWatchdog,
+                                describe_culprit, guard_metrics,
+                                run_guarded, with_fault_injection,
+                                with_grad_guard)
+from cpd_tpu.train.optim import sgd
+from cpd_tpu.train.scaling import current_scale, with_dynamic_loss_scale
+
+
+def _params():
+    return {"w": jnp.asarray(np.linspace(-1, 1, 8), jnp.float32),
+            "b": jnp.asarray(np.linspace(3, 4, 4), jnp.float32)}
+
+
+def _grads(scale=1.0):
+    return {"w": jnp.asarray(np.linspace(0.5, -0.5, 8) * scale, jnp.float32),
+            "b": jnp.asarray(np.linspace(-2, 2, 4) * scale, jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+def test_plan_parse_grammar():
+    plan = FaultPlan.parse("grad_nan@3;stall@5:1.5, ckpt_truncate@8")
+    assert plan.counts() == {"grad_nan": 1, "stall": 1, "ckpt_truncate": 1}
+    stall = [f for f in plan.faults if f.kind == "stall"][0]
+    assert stall.step == 5 and stall.arg == 1.5
+    assert FaultPlan.parse("") == FaultPlan()
+
+
+def test_plan_rejects_unknown_kind_and_bad_spec():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("gremlins@3")
+    with pytest.raises(ValueError, match="bad fault spec"):
+        FaultPlan.parse("grad_nan3")
+    with pytest.raises(ValueError, match="step must be"):
+        FaultSpec(-1, "grad_nan")
+
+
+def test_plan_json_roundtrip_and_file(tmp_path):
+    plan = FaultPlan.parse("grad_inf@2:1;loss_spike@7:1e6", seed=9)
+    assert FaultPlan.from_json(plan.to_json()) == plan
+    path = tmp_path / "plan.json"
+    path.write_text(plan.to_json())
+    assert FaultPlan.parse(str(path)) == plan
+
+
+def test_plan_random_is_seed_deterministic():
+    a = FaultPlan.random(123, 200)
+    b = FaultPlan.random(123, 200)
+    c = FaultPlan.random(124, 200)
+    assert a == b
+    assert a != c
+    assert len(a) > 0
+
+
+def test_plan_grad_schedule_tables():
+    plan = FaultPlan.parse("grad_nan@1;grad_blowup@3:2;stall@2")
+    codes, shards = plan.grad_schedule(5)
+    assert codes.tolist() == [0, 1, 0, 3, 0]     # stall is host-level
+    assert shards.tolist() == [-1, -1, -1, 2, -1]
+
+
+# ---------------------------------------------------------------------------
+# wrappers (host-level, no shard_map)
+# ---------------------------------------------------------------------------
+
+def test_guard_skips_nonfinite_and_reports_culprit():
+    tx = with_grad_guard(sgd(lambda _: 0.1, momentum=0.9))
+    p = _params()
+    state = tx.init(p)
+    _, state = tx.update(_grads(), state, p)
+    inner_before = jax.tree.map(lambda x: np.asarray(x).copy(), state.inner)
+    bad = {"w": _grads()["w"].at[2].set(jnp.nan), "b": _grads()["b"]}
+    u, state = tx.update(bad, state, p)
+    assert all(float(np.abs(np.asarray(x)).max()) == 0.0
+               for x in jax.tree.leaves(u))
+    for a, b in zip(jax.tree.leaves(inner_before),
+                    jax.tree.leaves(state.inner)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(state.skipped) == 1 and int(state.overflows) == 1
+    assert int(state.last_ok) == 0
+    # leaves sort b before w: culprit index 1 == 'w'
+    assert describe_culprit(state, p) == "['w']"
+
+
+def test_guard_spike_detection_after_warmup():
+    tx = with_grad_guard(sgd(lambda _: 0.1), spike_factor=5.0,
+                         warmup_steps=3)
+    p = _params()
+    state = tx.init(p)
+    for _ in range(4):
+        _, state = tx.update(_grads(), state, p)
+    assert int(state.skipped) == 0
+    u, state = tx.update(_grads(1000.0), state, p)     # 1000x the EMA
+    assert int(state.spikes) == 1 and int(state.skipped) == 1
+    assert all(float(np.abs(np.asarray(x)).max()) == 0.0
+               for x in jax.tree.leaves(u))
+    # finite -> not an overflow; and a normal step resumes cleanly
+    assert int(state.overflows) == 0
+    _, state = tx.update(_grads(), state, p)
+    assert int(state.last_ok) == 1
+
+
+def test_guard_composes_with_dynamic_scale_backoff():
+    """Non-finite grads pass THROUGH to the scaler (its backoff policy
+    must run) while the guard counts the overflow."""
+    tx = with_grad_guard(with_dynamic_loss_scale(sgd(lambda _: 0.1),
+                                                 init_scale=1024.0))
+    p = _params()
+    state = tx.init(p)
+    assert float(current_scale(state)) == 1024.0       # nested search
+    scaled = jax.tree.map(lambda g: g * 1024.0, _grads())
+    _, state = tx.update(scaled, state, p)
+    bad = jax.tree.map(lambda g: g.at[0].set(jnp.inf), scaled)
+    u, state = tx.update(bad, state, p)
+    assert float(current_scale(state)) == 512.0        # backoff happened
+    assert int(state.overflows) == 1 and int(state.skipped) == 1
+    assert all(float(np.abs(np.asarray(x)).max()) == 0.0
+               for x in jax.tree.leaves(u))
+
+
+def test_fault_injection_fires_on_schedule_only():
+    plan = FaultPlan.parse("grad_nan@1;grad_inf@4")
+    tx = with_fault_injection(with_grad_guard(sgd(lambda _: 0.1)), plan, 6)
+    p = _params()
+    state = tx.init(p)
+    params = p
+    for step in range(6):
+        u, state = tx.update(_grads(), state, p)
+        params = optax.apply_updates(params, u)
+    m = guard_metrics(state)
+    assert int(m["faults_injected"]) == 2
+    assert int(m["guard_overflows"]) == 2
+    assert int(m["guard_skipped"]) == 2
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree.leaves(params))
+    # beyond the table: no further injection
+    _, state = tx.update(_grads(), state, p)
+    assert int(guard_metrics(state)["faults_injected"]) == 2
+
+
+def test_guard_metrics_empty_without_wrappers():
+    assert guard_metrics(sgd(lambda _: 0.1).init(_params())) == {}
+
+
+# ---------------------------------------------------------------------------
+# cross-replica agreement (real shard_map; single-shard corruption)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh():
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    return data_parallel_mesh()
+
+
+def _sharded_update(tx, mesh):
+    from cpd_tpu.compat import shard_map
+
+    def f(opt_state, params, grads):
+        updates, new_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=(P(), P(), P()),
+                             out_specs=(P(), P()), check_vma=False))
+
+
+def test_single_shard_corruption_detected_and_agreed(mesh):
+    """A grad fault on ONE shard (a corrupted quantized-reduce output):
+    every replica must skip in lockstep (psum'd verdict), params stay
+    replicated and untouched, and the disagreement is counted."""
+    plan = FaultPlan.parse("grad_nan@1:2")         # shard 2 only, step 1
+    tx = with_fault_injection(
+        with_grad_guard(sgd(lambda _: 0.1), axis_name="dp"),
+        plan, 4, axis_name="dp")
+    p = _params()
+    state = tx.init(p)
+    step = _sharded_update(tx, mesh)
+    params = p
+    for i in range(3):
+        before = jax.tree.map(lambda x: np.asarray(x).copy(), params)
+        params, state = step(state, params, _grads())
+        if i == 1:
+            for a, b in zip(jax.tree.leaves(before),
+                            jax.tree.leaves(params)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(state.injected) == 1
+    g = state.inner
+    assert isinstance(g, GradGuardState)
+    assert int(g.skipped) == 1
+    assert int(g.overflows) == 1
+    assert int(g.disagreements) == 1     # 1 bad replica of 8: mismatch
+    assert int(g.culprit) >= 0
+    # params remained bitwise replicated through the skip
+    arr = params["w"]
+    assert all(np.array_equal(np.asarray(s.data), np.asarray(
+        arr.addressable_shards[0].data)) for s in arr.addressable_shards)
+
+
+def test_single_shard_corruption_with_nested_scaler_stays_lockstep(mesh):
+    """Review finding (PR 2): with a dynamic loss scale nested under the
+    guard, the scaler's OWN finite-check is replica-local — the guard
+    must hand every replica identically-poisoned grads so all scalers
+    take the same skip+backoff branch and params/scale stay replicated."""
+    plan = FaultPlan.parse("grad_nan@1:3")         # shard 3 only, step 1
+    tx = with_fault_injection(
+        with_grad_guard(with_dynamic_loss_scale(sgd(lambda _: 0.1),
+                                                init_scale=1024.0),
+                        axis_name="dp"),
+        plan, 4, axis_name="dp")
+    p = _params()
+    state = tx.init(p)
+    step = _sharded_update(tx, mesh)
+    params = p
+    for _ in range(3):
+        params, state = step(state, params,
+                             jax.tree.map(lambda g: g * 1024.0, _grads()))
+    g = state.inner
+    assert int(g.skipped) == 1 and int(g.disagreements) == 1
+    # the scaler backed off exactly once, identically on every replica
+    scale = current_scale(state)
+    assert float(scale) == 512.0
+    for s in scale.addressable_shards:
+        assert float(np.asarray(s.data)) == 512.0
+    arr = params["w"]
+    assert all(np.array_equal(np.asarray(s.data), np.asarray(
+        arr.addressable_shards[0].data)) for s in arr.addressable_shards)
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree.leaves(params))
+
+
+def test_guard_agreement_spans_all_mesh_axes():
+    """Review finding (PR 2): a tp-sharded leaf legitimately differs per
+    tp rank, so the verdict must be psum'd over EVERY axis — with a
+    tuple axis_name, a NaN confined to one (dp, tp) shard still skips
+    the update on all 8 shards in lockstep."""
+    from jax.sharding import Mesh
+    from cpd_tpu.compat import shard_map
+
+    devs = np.asarray(jax.devices()).reshape(2, 4)
+    mesh2 = Mesh(devs, ("dp", "tp"))
+    plan = FaultPlan.parse("grad_nan@1:1")         # dp shard 1, step 1
+    tx = with_fault_injection(
+        with_grad_guard(sgd(lambda _: 0.1), axis_name=("dp", "tp")),
+        plan, 3, axis_name=("dp", "tp"))
+    p = _params()
+    state = tx.init(p)
+
+    def f(opt_state, params, grads):
+        updates, new_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    step = jax.jit(shard_map(f, mesh=mesh2, in_specs=(P(), P(), P()),
+                             out_specs=(P(), P()), check_vma=False))
+    params = p
+    for i in range(3):
+        before = jax.tree.map(lambda x: np.asarray(x).copy(), params)
+        params, state = step(state, params, _grads())
+        if i == 1:
+            for a, b in zip(jax.tree.leaves(before),
+                            jax.tree.leaves(params)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    g = state.inner
+    assert int(g.skipped) == 1 and int(g.overflows) == 1
+    assert int(g.disagreements) == 1   # 4 of 8 shards saw the bad copy
+    arr = params["w"]
+    assert all(np.array_equal(np.asarray(s.data), np.asarray(
+        arr.addressable_shards[0].data)) for s in arr.addressable_shards)
+
+
+def test_all_shard_corruption_agrees(mesh):
+    """The same fault on EVERY shard is an agreed overflow — skipped, but
+    not a disagreement."""
+    plan = FaultPlan.parse("grad_inf@0")           # shard -1 = all
+    tx = with_fault_injection(
+        with_grad_guard(sgd(lambda _: 0.1), axis_name="dp"),
+        plan, 2, axis_name="dp")
+    p = _params()
+    state = tx.init(p)
+    step = _sharded_update(tx, mesh)
+    params, state = step(state, p, _grads())
+    g = state.inner
+    assert int(g.overflows) == 1 and int(g.disagreements) == 0
+
+
+# ---------------------------------------------------------------------------
+# PreemptionGuard (satellite: SIGINT + handler restoration)
+# ---------------------------------------------------------------------------
+
+def test_preemption_guard_traps_sigint_and_restores_handlers():
+    from cpd_tpu.train.checkpoint import PreemptionGuard
+    orig_term = signal.getsignal(signal.SIGTERM)
+    orig_int = signal.getsignal(signal.SIGINT)
+    guard = PreemptionGuard()
+    try:
+        assert signal.getsignal(signal.SIGTERM) is not orig_term
+        assert signal.getsignal(signal.SIGINT) is not orig_int
+        signal.raise_signal(signal.SIGINT)     # Ctrl-C: no traceback,
+        assert guard.triggered                 # just a boundary-save flag
+    finally:
+        guard.close()
+    assert signal.getsignal(signal.SIGTERM) is orig_term
+    assert signal.getsignal(signal.SIGINT) is orig_int
+
+
+def test_preemption_guard_second_sigint_escalates():
+    """First Ctrl-C: boundary-save protocol.  Second Ctrl-C: the user
+    means it (a wedged step never reaches the boundary) — escalate to a
+    real KeyboardInterrupt instead of absorbing Ctrl-C forever."""
+    from cpd_tpu.train.checkpoint import PreemptionGuard
+    with PreemptionGuard() as guard:
+        signal.raise_signal(signal.SIGINT)
+        assert guard.triggered
+        with pytest.raises(KeyboardInterrupt):
+            signal.raise_signal(signal.SIGINT)
+        # SIGTERM after trigger stays on the save path (no escalation)
+        signal.raise_signal(signal.SIGTERM)
+        assert guard.triggered
+
+
+def test_preemption_guard_context_manager_restores_on_exit():
+    from cpd_tpu.train.checkpoint import PreemptionGuard
+    orig_int = signal.getsignal(signal.SIGINT)
+    with PreemptionGuard() as guard:
+        assert not guard.triggered
+        assert signal.getsignal(signal.SIGINT) is not orig_int
+    assert signal.getsignal(signal.SIGINT) is orig_int
+    # uninstall is idempotent
+    guard.uninstall()
+    assert signal.getsignal(signal.SIGINT) is orig_int
+
+
+# ---------------------------------------------------------------------------
+# watchdog + sentinel
+# ---------------------------------------------------------------------------
+
+def test_watchdog_trips_and_interrupts_blocking_main():
+    wd = StepWatchdog(0.2)
+    try:
+        wd.arm(7, loss=1.0)
+        with pytest.raises(KeyboardInterrupt):
+            time.sleep(5.0)
+        assert wd.tripped and wd.trips == 1
+    finally:
+        wd.close()
+
+
+def test_watchdog_hard_exit_when_interrupt_absorbed():
+    """The trainers' worst case: a PreemptionGuard traps SIGINT, so the
+    watchdog's interrupt sets the guard's flag instead of raising, and
+    the 'step' never reaches a boundary.  hard_exit_after must kill the
+    process (124) with the diagnostic on stderr instead of hanging."""
+    import subprocess
+    script = (
+        "import sys, time; sys.path.insert(0, %r)\n"
+        "from cpd_tpu.train.checkpoint import PreemptionGuard\n"
+        "from cpd_tpu.resilience import StepWatchdog\n"
+        "guard = PreemptionGuard()          # traps SIGINT\n"
+        "wd = StepWatchdog(0.3, hard_exit_after=0.3)\n"
+        "wd.arm(1)\n"
+        "t0 = time.monotonic()\n"
+        "while time.monotonic() - t0 < 30:  # the 'wedged step'\n"
+        "    time.sleep(0.05)\n"
+        "print('UNREACHABLE')\n" % os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=60,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 124
+    assert "hard exit" in proc.stderr
+    assert "UNREACHABLE" not in proc.stdout
+
+
+def test_watchdog_disarm_cancels_hard_exit():
+    wd = StepWatchdog(0.1, hard_exit_after=0.2)
+    wd.arm(1)
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            time.sleep(2.0)
+    finally:
+        wd.disarm()           # acknowledge: cancels the exit timer
+    time.sleep(0.4)           # would have _exit(124)'d by now
+    assert wd.tripped
+
+
+def test_watchdog_disarm_prevents_trip():
+    wd = StepWatchdog(0.1)
+    wd.arm(1)
+    wd.disarm()
+    time.sleep(0.25)
+    assert not wd.tripped
+
+
+def test_sentinel_min_history_clamped_to_window():
+    """window < min_history must not silently disarm the spike check
+    (regression: found driving the resnet18 CLI with --divergence-window
+    4 — the default min_history of 5 could never be reached)."""
+    s = DivergenceSentinel(window=3, factor=10.0)    # default min_history 5
+    for _ in range(3):
+        assert not s.update(1.0)
+    assert s.update(1000.0)
+
+
+def test_sentinel_trips_on_nonfinite_and_spike_not_noise():
+    s = DivergenceSentinel(window=8, factor=10.0, min_history=3)
+    for i in range(6):
+        assert not s.update(1.0 + 0.1 * i)     # noisy but sane
+    assert s.update(float("nan"))
+    assert s.update(float("inf"))
+    assert s.update(50.0)                      # 50 > 10 x median(~1.2)
+    assert not s.update(2.0)
+    s.reset()
+    assert not s.update(1000.0)                # fresh baseline after reset
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+def _ck_state(v: float):
+    from cpd_tpu.train.state import TrainState
+    return TrainState(step=jnp.asarray(int(v), jnp.int32),
+                      params={"w": jnp.full((16,), float(v))},
+                      batch_stats={},
+                      opt_state={"m": jnp.zeros((16,))})
+
+
+def _largest_file(step_dir: str):
+    victim, size = None, -1
+    for root, _, files in os.walk(step_dir):
+        for name in sorted(files):
+            p = os.path.join(root, name)
+            s = os.path.getsize(p)
+            if s > size:
+                victim, size = p, s
+    return victim, size
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "bitflip"])
+def test_corrupt_checkpoint_is_skipped_for_newest_valid(tmp_path,
+                                                        corruption):
+    from cpd_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        mgr.save(3, _ck_state(3))
+        mgr.save(6, _ck_state(6))
+        mgr.wait()
+        assert mgr.verify_step(3) is True and mgr.verify_step(6) is True
+        victim, size = _largest_file(str(tmp_path / "6"))
+        with open(victim, "r+b") as f:
+            if corruption == "truncate":
+                f.truncate(max(size // 2, 1))
+            else:
+                f.seek(size // 2)
+                byte = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([byte[0] ^ 0xFF]))
+        assert mgr.verify_step(6) is False
+        res = mgr.restore_latest_valid(_ck_state(0))
+        assert res is not None
+        assert res.step == 3 and res.skipped == (6,)
+        np.testing.assert_allclose(np.asarray(res.state.params["w"]), 3.0)
+    finally:
+        mgr.close()
+
+
+def test_restore_latest_valid_none_when_all_corrupt(tmp_path):
+    from cpd_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        mgr.save(1, _ck_state(1))
+        mgr.wait()
+        victim, size = _largest_file(str(tmp_path / "1"))
+        with open(victim, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        assert mgr.restore_latest_valid(_ck_state(0)) is None
+    finally:
+        mgr.close()
+
+
+def test_integrity_digest_lives_in_metadata_sidecar(tmp_path):
+    from cpd_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        mgr.save(2, _ck_state(2), metadata={"epoch": 7})
+        mgr.wait()
+        meta = mgr.metadata(2)
+        assert meta["epoch"] == 7                    # user keys preserved
+        assert meta["integrity"]["algo"] == "sha256"
+        assert meta["integrity"]["files"] > 0
+    finally:
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# guarded loop: unit paths with a fake step (no compiles)
+# ---------------------------------------------------------------------------
+
+class _FakeState(NamedTuple):
+    step: Any
+
+
+def _fake_step(state, x):
+    return _FakeState(state.step + 1), {"loss": 1.0}
+
+
+def _fake_batch(step, reseed):
+    return (np.zeros((2,), np.float32),)
+
+
+def test_run_guarded_watchdog_trip_exits_cleanly():
+    inj = Injector(FaultPlan.parse("stall@2:1.5"))
+    wd = StepWatchdog(0.3)
+    state, report = run_guarded(_fake_step, _FakeState(0), _fake_batch, 6,
+                                injector=inj, watchdog=wd)
+    assert report.aborted == "watchdog"
+    assert report.counters["watchdog_trips"] == 1
+    assert ("watchdog", 2) in report.events
+    assert report.final_step == 2                  # steps 0,1 completed
+
+
+def test_run_guarded_injected_preemption_and_drop_dup():
+    inj = Injector(FaultPlan.parse("data_drop@1;data_dup@2;preempt@4"))
+    state, report = run_guarded(_fake_step, _FakeState(0), _fake_batch, 8,
+                                injector=inj)
+    assert report.aborted == "preempted"
+    assert report.final_step == 4
+    assert report.counters["batches_dropped"] == 1
+    assert report.counters["batches_duplicated"] == 1
+    assert report.counters["preemptions"] == 1
+    assert inj.fired == {"data_drop": 1, "data_dup": 1, "preempt": 1}
+
+
+def test_run_guarded_divergence_without_manager_aborts():
+    inj = Injector(FaultPlan.parse("loss_spike@3:1e8"))
+    sent = DivergenceSentinel(window=4, factor=10.0, min_history=2)
+    state, report = run_guarded(_fake_step, _FakeState(0), _fake_batch, 8,
+                                injector=inj, sentinel=sent)
+    assert report.aborted == "diverged"
+    assert ("diverged", 3, pytest.approx(1e8)) in report.events
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end chaos run (acceptance criteria), twice, identically
+# ---------------------------------------------------------------------------
+
+CHAOS_PLAN = "batch_nan@2;ckpt_truncate@6;loss_spike@8:1e6"
+CHAOS_STEPS = 10
+STEP_BUDGET = 2 * CHAOS_STEPS           # replay after one rollback fits
+
+
+def _chaos_run(step, model_state, ckpt_dir):
+    from cpd_tpu.train.checkpoint import CheckpointManager
+
+    calls = {"n": 0}
+    rng_cache = {}
+
+    def next_batch(i, reseed):
+        calls["n"] += 1
+        assert calls["n"] <= STEP_BUDGET, "chaos run exceeded step budget"
+        r = rng_cache.setdefault((i, reseed),
+                                 np.random.default_rng(1000 * reseed + i))
+        x = jnp.asarray(r.normal(size=(16, 8, 8, 3)), jnp.float32)
+        y = jnp.asarray(np.arange(16) % 4, jnp.int32)
+        return (x, y)
+
+    injector = Injector(FaultPlan.parse(CHAOS_PLAN))
+    sentinel = DivergenceSentinel(window=6, factor=50.0, min_history=3)
+    watchdog = StepWatchdog(120.0)       # generous: must NOT trip
+    manager = CheckpointManager(ckpt_dir, track_best=False)
+    try:
+        state, report = run_guarded(
+            step, model_state, next_batch, CHAOS_STEPS, manager=manager,
+            injector=injector, sentinel=sentinel, watchdog=watchdog,
+            ckpt_every=3, max_rollbacks=2)
+    finally:
+        watchdog.close()
+        manager.close()
+    return state, report, injector
+
+
+@pytest.fixture(scope="module")
+def chaos_step_and_state(mesh):
+    from cpd_tpu.models.tiny import tiny_cnn
+    from cpd_tpu.parallel.dist import replicate
+    from cpd_tpu.train.state import create_train_state
+    from cpd_tpu.train.step import make_train_step
+
+    model = tiny_cnn(num_classes=4, width=4)
+    tx = with_grad_guard(sgd(lambda _: 0.05, momentum=0.9),
+                         axis_name="dp")
+    state = create_train_state(model, tx, jnp.zeros((2, 8, 8, 3)),
+                               jax.random.PRNGKey(0))
+    state = replicate(state, mesh)
+    # donate=False: a rollback needs the pre-step buffers alive
+    step = make_train_step(model, tx, mesh, donate=False)
+    return step, state
+
+
+def test_chaos_run_end_to_end(tmp_path, chaos_step_and_state):
+    """NaN-gradient step + truncated checkpoint + loss blow-up, one run:
+    finishes in budget, final state finite, the truncated checkpoint is
+    skipped for the newest valid one, counters match the plan exactly."""
+    step, state0 = chaos_step_and_state
+    state, report, injector = _chaos_run(step, state0, str(tmp_path / "a"))
+
+    assert report.completed and report.aborted is None
+    assert report.final_step == CHAOS_STEPS
+    # every injected fault fired exactly once
+    assert injector.fired == {"batch_nan": 1, "ckpt_truncate": 1,
+                              "loss_spike": 1}
+    c = report.counters
+    assert c["steps_skipped"] == 1        # the NaN-batch step
+    assert c["overflows"] == 1
+    assert c["spikes"] == 0
+    assert c["rollbacks"] == 1            # the loss spike
+    assert c["restores"] == 1
+    assert c["ckpts_invalid"] == 1        # the truncated step-6 ckpt
+    assert c["watchdog_trips"] == 0
+    # rollback went to step 3 (6 was corrupt), then replayed to the end
+    assert ("ckpt_invalid", 6) in report.events
+    assert ("rollback", 3) in report.events
+    # final state is finite everywhere
+    for leaf in jax.tree.leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_chaos_run_is_deterministic(tmp_path, chaos_step_and_state):
+    """Same FaultPlan + seeds => identical fault/recovery event sequence
+    AND bitwise-identical final parameters."""
+    step, state0 = chaos_step_and_state
+    s1, r1, i1 = _chaos_run(step, state0, str(tmp_path / "run1"))
+    s2, r2, i2 = _chaos_run(step, state0, str(tmp_path / "run2"))
+    assert r1.events == r2.events
+    assert i1.log == i2.log
+    assert r1.counters == r2.counters
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# trainer CLI under a fault plan (full stack; slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_lm_trainer_chaos_cli(tmp_path):
+    from lm.train import main
+    res = main(["--max-iter", "12", "--d-model", "32", "--n-layers", "1",
+                "--n-heads", "2", "--vocab-size", "64", "--seq-len", "32",
+                "--batch-size", "2", "--val-freq", "100",
+                "--ckpt-freq", "4", "--save-path", str(tmp_path),
+                "--fault-plan",
+                "grad_nan@3;ckpt_truncate@8;loss_spike@10:1e6",
+                "--divergence-window", "6", "--divergence-factor", "50",
+                "--watchdog-timeout", "60"])
+    assert res["step"] == 12 and not res["diverged"]
+    assert np.isfinite(res["loss"])
+    r = res["resilience"]
+    assert r["steps_skipped"] == 1 and r["faults_injected"] == 1
+    assert r["rollbacks"] == 1 and r["restores"] == 1
+    assert r["ckpts_invalid"] == 1 and r["watchdog_trips"] == 0

@@ -165,6 +165,51 @@ class TestDynamicScaleTrainStep:
                             update_fn=lambda *a, **k: None)
 
 
+def test_injector_driven_skip_preserves_state_and_schedules_scale():
+    """Satellite (ISSUE 2): the skip path driven by the fault INJECTOR
+    rather than hand-built NaNs — inner optimizer state and params are
+    untouched on the injected-NaN step, the scale halves there and
+    regrows on schedule."""
+    from cpd_tpu.resilience import FaultPlan, with_fault_injection
+
+    inner = sgd(lambda _: 0.1, momentum=0.9)
+    tx = with_fault_injection(
+        with_dynamic_loss_scale(inner, init_scale=1024.0,
+                                growth_interval=3),
+        FaultPlan.parse("grad_nan@2"), 8)
+    p = _params()
+    state = tx.init(p)
+    assert float(current_scale(state)) == 1024.0       # nested search
+    params = p
+    scales = []
+    for step in range(8):
+        scale = float(current_scale(state))
+        g = jax.tree.map(lambda x: x * scale, _grads())
+        if step == 2:
+            params_before = jax.tree.map(
+                lambda x: np.asarray(x).copy(), params)
+            mom_before = jax.tree.map(
+                lambda x: np.asarray(x).copy(), state.inner.inner)
+        u, state = tx.update(g, state, params)
+        params = jax.tree.map(lambda a, b: a + b, params, u)
+        if step == 2:
+            # injected NaN: params and the momentum buffer are untouched
+            for a, b in zip(jax.tree.leaves(params_before),
+                            jax.tree.leaves(params)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(mom_before),
+                            jax.tree.leaves(state.inner.inner)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        scales.append(float(current_scale(state)))
+    # halves at the injected step 2, then regrows after growth_interval=3
+    # consecutive finite steps (steps 3,4,5), capped by nothing here
+    assert scales == [1024.0, 1024.0, 512.0, 512.0, 512.0, 1024.0,
+                      1024.0, 1024.0]
+    assert int(state.injected) == 1
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree.leaves(params))
+
+
 def test_wrapped_tx_with_static_scale_rejected():
     """The inverse misconfiguration of current_scale's TypeError: a
     wrapped optimizer + static loss_scale would silently divide every
