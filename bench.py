@@ -39,8 +39,14 @@ chip, override with BENCH_PEAK_TFLOPS), plus a budget-gated
 larger-batch scaling point (bs 128).
 
 Env knobs: BENCH_BUDGET_SECS (default 540), BENCH_PROBE_SECS (default 60),
+BENCH_PROBE_RETRIES (default 2, bounded with exponential backoff; each
+failed attempt is classified into a distinct error string — hang vs
+native-signal death vs broken environment vs backend-unavailable),
 BENCH_PROFILE_DIR (write a jax.profiler trace of a few steps), BENCH_ITERS
-(default 20).
+(default 20).  Output always carries a `reduction` block: the transport
+mode of the headline number plus analytic bytes-on-wire for every
+reduction transport (gather / packed gather / ring / psum) at the
+measured world size and the W=8 reference (tools/bench_reduce.py).
 """
 
 from __future__ import annotations
@@ -157,32 +163,75 @@ def probe_main() -> None:
           "secs": round(time.monotonic() - t0, 1)})
 
 
+def _classify_probe_failure(proc) -> str:
+    """One DISTINCT error string per probe failure mode, so a burned
+    capture budget says WHY (BENCH_r04/r05 both died with the same
+    undifferentiated 'probe attempt hung' line).  The classes:
+    native-signal death, broken Python environment, backend-reported
+    unavailability, and plain nonzero exit — hangs are classified by the
+    caller (TimeoutExpired never produces a proc)."""
+    tail = " | ".join((proc.stderr or proc.stdout or "")
+                      .strip().splitlines()[-3:])[-200:]
+    if proc.returncode < 0:
+        return (f"probe killed by signal {-proc.returncode} — native "
+                f"crash during backend init (plugin/runtime bug, not a "
+                f"dead tunnel): {tail}")
+    if "ModuleNotFoundError" in tail or "ImportError" in tail:
+        return (f"probe import failure — broken Python environment, NOT "
+                f"a tunnel problem: {tail}")
+    if ("UNAVAILABLE" in tail or "DEADLINE_EXCEEDED" in tail
+            or "connection refused" in tail.lower()
+            or "failed to connect" in tail.lower()):
+        return (f"probe backend unavailable — process ran but the TPU "
+                f"endpoint refused/failed (tunnel up, device side down?): "
+                f"{tail}")
+    return f"probe exited rc={proc.returncode} (unclassified): {tail}"
+
+
 def _run_probe(deadline: float):
-    """Run the probe child (one retry); returns its JSON dict or None."""
+    """Run the probe child with bounded retries + exponential backoff.
+
+    Returns ``(probe_json_or_None, [per-attempt error strings])`` — every
+    attempt's failure is classified distinctly (_classify_probe_failure /
+    the hang and budget-exhausted cases here) so the final JSON error
+    names the actual failure mode instead of a catch-all."""
     cap = float(os.environ.get("BENCH_PROBE_SECS", "60"))
-    for attempt in range(2):
+    attempts = max(1, int(os.environ.get("BENCH_PROBE_RETRIES", "2")))
+    errors: list = []
+    for attempt in range(attempts):
         remaining = deadline - time.monotonic()
         if remaining < 10:
-            return None
+            errors.append(f"probe budget exhausted before attempt "
+                          f"{attempt + 1} ({remaining:.0f}s left)")
+            break
         env = dict(os.environ)
         env[_PROBE_ENV] = "1"
+        attempt_cap = min(cap, remaining - 5)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
-                capture_output=True, text=True,
-                timeout=min(cap, remaining - 5))
+                capture_output=True, text=True, timeout=attempt_cap)
         except subprocess.TimeoutExpired:
-            print(f"# probe attempt {attempt + 1}: hung (tunnel down?)",
+            errors.append(f"probe hung >{attempt_cap:.0f}s — backend init "
+                          f"stuck in native code (tunnel down, or TPU "
+                          f"runtime wedged)")
+            print(f"# probe attempt {attempt + 1}: {errors[-1]}",
                   file=sys.stderr)
-            continue
-        out = _last_json_line(proc.stdout)
-        if out is not None and out.get("probe") == "ok":
-            return out
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        print(f"# probe attempt {attempt + 1}: rc={proc.returncode} "
-              f"{' | '.join(tail[-2:])}", file=sys.stderr)
-    return None
+        else:
+            out = _last_json_line(proc.stdout)
+            if out is not None and out.get("probe") == "ok":
+                return out, errors
+            errors.append(_classify_probe_failure(proc))
+            print(f"# probe attempt {attempt + 1}: {errors[-1]}",
+                  file=sys.stderr)
+        if attempt + 1 < attempts:
+            # short exponential backoff: transient tunnel blips recover in
+            # seconds; anything longer is for the bounded retry to give up
+            # on, not to wait out
+            time.sleep(min(2.0 * (2 ** attempt),
+                           max(0.0, deadline - time.monotonic() - 10)))
+    return None, errors
 
 
 def run_bench(budget_end: float, profile_dir: str | None = None,
@@ -255,6 +304,7 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
     # to a valid result instead of discarding the measurement (round-2
     # review finding).
     faithful_step = None
+    n_params = 0
     # fresh state per mode: the step donates its state argument, so the
     # buffers from the previous mode's run are deleted
     for mode in ("faithful", "fast"):
@@ -262,6 +312,7 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
             break
         state = create_train_state(model, tx, x[0, :2],
                                    jax.random.PRNGKey(0))
+        n_params = sum(l.size for l in jax.tree.leaves(state.params))
         step = make_multi_train_step(model, tx, mesh, fuse, use_aps=True,
                                      grad_exp=5, grad_man=2, mode=mode,
                                      donate=True)
@@ -296,6 +347,31 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
         else:
             partial["fast_mode_img_per_sec_per_chip"] = round(
                 results["fast"], 2)
+
+    # Cheap EXTRA (analytic, platform-agnostic, cannot fail the run): the
+    # gradient-reduction transport ledger.  Records which transport the
+    # headline number used and the per-device bytes-on-wire each transport
+    # would move for this model's gradients — at the measured world size
+    # AND at the W=8 pod-slice reference — so the ring transport's wire
+    # win (ISSUE 3; EQuARX) is a tracked number in every BENCH_* capture.
+    # parallel/ring.py owns the formulas (same table as
+    # tools/bench_reduce.py).  Only emitted when the faithful measurement
+    # actually ran: a ledger row must never claim a transport that the
+    # budget cut before it executed.
+    if "faithful" in results and n_params:
+        try:
+            from cpd_tpu.parallel.ring import transport_table
+            partial["reduction"] = {
+                "transport_mode": "faithful",  # the headline measurement's
+                "grad_elements": n_params,
+                "format": [5, 2],
+                "bytes_on_wire_per_device": transport_table(
+                    n_params, n_dev, 5, 2),
+                "w8_reference": transport_table(n_params, 8, 5, 2),
+            }
+        except Exception as e:  # noqa: BLE001 — extras must not kill it
+            partial["reduction_note"] = (f"reduction ledger skipped: "
+                                         f"{type(e).__name__}: {e}")
 
     # Budget-gated EXTRA: a larger-batch scaling point.  bs 32 is the
     # reference-parity headline (main.py:32) but underfills a TPU's MXU
@@ -524,16 +600,18 @@ def main():
     # `force` may be a jax platform priority LIST ("axon,cpu")
     if not force or any(p.strip() in ("tpu", "axon")
                         for p in force.split(",")):
-        probe = _run_probe(deadline)
+        probe, probe_errors = _run_probe(deadline)
         if probe is None:
             failure = {
                 "metric": "resnet50_train_img_per_sec_per_chip",
                 "value": None,
                 "unit": "img/s/chip",
                 "vs_baseline": None,
-                "error": ("tunnel probe did not succeed (backend init "
-                          "hang/crash, or probe budget exhausted); "
-                          "measurement budget not committed"),
+                "error": ("tunnel probe did not succeed after "
+                          f"{len(probe_errors)} attempt(s); measurement "
+                          "budget not committed. "
+                          + " || ".join(probe_errors)),
+                "probe_attempts": probe_errors,
             }
             last_good = _load_last_good()
             if last_good is not None:

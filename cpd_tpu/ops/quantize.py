@@ -26,7 +26,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..quant.numerics import _validate, cast_body, cast_body_sr
 
-__all__ = ["quantize_pallas", "quantize_pallas_sr"]
+__all__ = ["quantize_pallas", "quantize_pallas_sr", "quantize_add_pallas",
+           "quantize_add_pallas_bits"]
 
 _LANES = 128
 _BLOCK_ROWS = 512  # (512, 128) fp32 block = 256 KiB of VMEM in + out
@@ -82,6 +83,80 @@ def quantize_pallas(x: jnp.ndarray, exp_bits: int, man_bits: int,
         out_specs=_block_spec(),
         interpret=interpret,
     )(flat)
+    return out.reshape(-1)[:n].reshape(shape)
+
+
+def _quantize_add_kernel(x_ref, y_ref, o_ref, *, exp_bits: int,
+                         man_bits: int):
+    o_ref[:] = cast_body(x_ref[:] + y_ref[:], exp_bits, man_bits)
+
+
+def _quantize_add_sr_kernel(x_ref, y_ref, r_ref, o_ref, *, exp_bits: int,
+                            man_bits: int):
+    o_ref[:] = cast_body_sr(x_ref[:] + y_ref[:], exp_bits, man_bits,
+                            r_ref[:])
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def quantize_add_pallas(x: jnp.ndarray, y: jnp.ndarray, exp_bits: int,
+                        man_bits: int,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Fused quantize-accumulate: ``cast(x + y)`` in ONE VPU kernel — the
+    per-hop body of the ring reduce-scatter (parallel/ring.py), where the
+    add and the cast would otherwise be separate HBM round-trips per hop.
+    Bit-identical to ``cast_to_format(x + y)`` (same `cast_body`)."""
+    _validate(exp_bits, man_bits)
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    if x.shape != y.shape:
+        raise ValueError(f"shape mismatch {x.shape} vs {y.shape}")
+    shape, n = x.shape, x.size
+    if n == 0:
+        return x
+    xf, grid, padded_rows = _to_blocks(x)
+    yf, _, _ = _to_blocks(y)
+    out = pl.pallas_call(
+        functools.partial(_quantize_add_kernel, exp_bits=exp_bits,
+                          man_bits=man_bits),
+        out_shape=jax.ShapeDtypeStruct((padded_rows, _LANES), jnp.float32),
+        grid=(grid,),
+        in_specs=[_block_spec(), _block_spec()],
+        out_specs=_block_spec(),
+        interpret=interpret,
+    )(xf, yf)
+    return out.reshape(-1)[:n].reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 5))
+def quantize_add_pallas_bits(x: jnp.ndarray, y: jnp.ndarray, exp_bits: int,
+                             man_bits: int, rbits: jnp.ndarray,
+                             interpret: bool = False) -> jnp.ndarray:
+    """Stochastic-rounding fused quantize-accumulate: ``cast_sr(x + y)``
+    with EXPLICIT uint32 round bits streamed in as an operand (the ring
+    hop passes offset-indexed `sr_bits_at` bits, so the kernel stays
+    bit-identical to the XLA path and transport-invariant).  Bit-identical
+    to ``cast_body_sr(x + y, ..., rbits)``."""
+    _validate(exp_bits, man_bits)
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    if x.shape != y.shape:
+        raise ValueError(f"shape mismatch {x.shape} vs {y.shape}")
+    shape, n = x.shape, x.size
+    if n == 0:
+        return x
+    rbits = jnp.broadcast_to(jnp.asarray(rbits, jnp.uint32), shape)
+    xf, grid, padded_rows = _to_blocks(x)
+    yf, _, _ = _to_blocks(y)
+    rf, _, _ = _to_blocks(rbits)
+    out = pl.pallas_call(
+        functools.partial(_quantize_add_sr_kernel, exp_bits=exp_bits,
+                          man_bits=man_bits),
+        out_shape=jax.ShapeDtypeStruct((padded_rows, _LANES), jnp.float32),
+        grid=(grid,),
+        in_specs=[_block_spec(), _block_spec(), _block_spec()],
+        out_specs=_block_spec(),
+        interpret=interpret,
+    )(xf, yf, rf)
     return out.reshape(-1)[:n].reshape(shape)
 
 

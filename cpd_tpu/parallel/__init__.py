@@ -11,6 +11,8 @@ from .emulate import emulate_node_reduce
 from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, group_split,
                    data_parallel_mesh, make_mesh)
 from .pipeline import pipeline_spmd
+from .ring import (gather_transport_bytes, ring_oracle_sum,
+                   ring_quantized_sum, ring_transport_bytes)
 from .zero import Zero1State, zero1_sgd, zero2_sgd, zero3_sgd
 from .reduction import (kahan_quantized_sum, ordered_quantized_sum,
                         quantized_sum)
@@ -23,4 +25,6 @@ __all__ = [
     "AXIS_DATA", "AXIS_EXPERT", "AXIS_PIPE", "AXIS_SEQ", "AXIS_TENSOR",
     "data_parallel_mesh", "make_mesh",
     "kahan_quantized_sum", "ordered_quantized_sum", "quantized_sum",
+    "ring_quantized_sum", "ring_oracle_sum", "ring_transport_bytes",
+    "gather_transport_bytes",
 ]

@@ -5,9 +5,10 @@ of XLA collectives.  The reference runs one NCCL op per parameter from a
 Python loop; here everything is traced once under `shard_map`/`pjit` so XLA
 schedules the collectives on ICI back-to-back (and can overlap them).  On
 TPU the faithful gathers are fused into few large per-dtype buckets
-(`_bucketed_quantized_sum`), and when APS has pre-quantized the values to
-a hardware-representable format the wire carries 1-2 bytes per element
-(`_wire_dtype`) — both bit-identical to the per-leaf fp32 path.
+(`_bucketed_quantized_sum`), and when APS has pre-quantized the values the
+wire carries the bit-packed eXmY code words (`quant.numerics.pack_exmy`,
+1-3 bytes per element for any sub-fp32 format) — both bit-identical to
+the per-leaf fp32 path.
 
 Semantics map (reference → here):
 
@@ -33,6 +34,11 @@ Reduction modes:
   deployment path (EQuARX-style): same precision at the wire, but XLA's
   reduction tree order, so not bit-identical to the reference.  New
   capability beyond the reference.
+* ``ring``: chunked ppermute reduce-scatter + all-gather moving bit-packed
+  eXmY partials (parallel/ring.py) — the ordered requantized reduction at
+  ~2/W of the gather path's wire elements and O(n/W) peak transient
+  memory, in the documented per-chunk rank-rotation order (bitwise-gated
+  by `ring.ring_oracle_sum`).  New capability beyond the reference.
 """
 
 from __future__ import annotations
@@ -46,10 +52,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..quant.numerics import cast_to_format, cast_to_format_sr_at
+from ..quant.numerics import (cast_to_format, cast_to_format_sr_at,
+                              pack_exmy, unpack_exmy, wire_bytes)
 from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
                   aps_unscale, pmax_scalar_vector)
 from .reduction import quantized_sum
+from .ring import ring_quantized_sum
 
 __all__ = [
     "dist_init", "sum_gradients", "broadcast_from", "replicate",
@@ -185,25 +193,31 @@ def grad_sr_key(grad_seed: int, step, site: int) -> jax.Array:
         jax.random.fold_in(jax.random.PRNGKey(grad_seed), step), site)
 
 
-def _wire_dtype(grad_exp: int, grad_man: int):
-    """Hardware dtype that exactly represents the (exp, man) value set —
-    including its infinities — or None.
+def _wire_format(grad_exp: int, grad_man: int):
+    """(exp, man) when shipping the bit-packed eXmY code words pays, else
+    None.
 
     When the gathered values are ALREADY quantized to the format (the APS
-    path quantizes before the reduction, dist_util.py:35-37), casting to
-    this dtype for the W x all_gather is lossless, and the wire carries
-    1-2 bytes/element instead of 4.  float8_e4m3fn is finite-only, so
-    (4,3) — whose reference cast saturates to +-inf — is NOT mapped."""
-    return {(5, 2): jnp.float8_e5m2,
-            (5, 10): jnp.float16,
-            (8, 7): jnp.bfloat16}.get((grad_exp, grad_man))
+    path quantizes before the reduction, dist_util.py:35-37),
+    `pack_exmy`'s re-encoding is lossless and the wire carries
+    ``wire_bytes(exp, man)`` (1-3) bytes/element instead of 4.  This
+    replaces the old 3-entry hardware-dtype table: ANY sub-fp32 format
+    with man >= 2 compresses now — including (4,3), which float8_e4m3fn
+    (finite-only) could never carry because the reference cast saturates
+    to ±inf."""
+    if grad_man >= 2 and wire_bytes(grad_exp, grad_man) < 4:
+        return (grad_exp, grad_man)
+    return None
 
 
 def _gather_leaf(g: jnp.ndarray, axis_name, wire=None) -> jnp.ndarray:
+    """all_gather one leaf; `wire` is an (exp, man) tuple to bit-pack the
+    payload (values must already be in that format's value set)."""
     if wire is not None:
-        g = g.astype(wire)
-    out = lax.all_gather(g, axis_name, axis=0, tiled=False)
-    return out.astype(jnp.float32) if wire is not None else out
+        packed = pack_exmy(g, *wire)
+        out = lax.all_gather(packed, axis_name, axis=0, tiled=False)
+        return unpack_exmy(out, *wire)
+    return lax.all_gather(g, axis_name, axis=0, tiled=False)
 
 
 # Per-bucket element cap for the faithful path.  W x 4M x 4B = 128 MiB of
@@ -286,7 +300,12 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
 
     use_aps     → APS exponent shifting around the reduction (aps.py).
     use_kahan   → Kahan-compensated ordered accumulation (dist_util.py:72-89).
-    mode        → "faithful" (gather + ordered scan) | "fast" (quantize+psum).
+    mode        → "faithful" (gather + ordered scan) | "fast" (quantize+psum)
+                  | "ring" (chunked ppermute reduce-scatter + all-gather
+                  with bit-packed eXmY partials on the wire — the ordered
+                  requantized reduction at ~2/W of the gather wire bytes
+                  and O(n/W) peak memory, in parallel/ring.py's documented
+                  per-chunk rank-rotation order; single mesh axis only).
     bucket      → faithful mode only: fuse per-leaf gathers into few large
                   per-dtype buckets (bit-identical).  Default (None) =
                   auto: on for TPU — fewer collective launches riding ICI
@@ -307,7 +326,7 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   on each shard); every rank derives identical bits, so
                   replicated outputs agree.
     """
-    if mode not in ("faithful", "fast"):
+    if mode not in ("faithful", "fast", "ring"):
         raise ValueError(f"unknown mode {mode!r}")
     if rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown rounding {rounding!r}")
@@ -357,14 +376,36 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
             lambda g: lax.psum(g, axis_name), grads)
         if not (grad_exp == 8 and grad_man == 23):
             reduced = q_tree(reduced, k_post)
+    elif mode == "ring":
+        # One ring over the WHOLE flat gradient (leaves concatenated in
+        # tree_flatten order, so SR offsets live in the same global space
+        # as _leaf_starts).  Partial sums are post-quantize — always in
+        # the format value set — so the wire is bit-packed whether or not
+        # APS pre-quantized the inputs.
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if leaves:
+            flat = (leaves[0].astype(jnp.float32).reshape(-1)
+                    if len(leaves) == 1 else
+                    jnp.concatenate([l.astype(jnp.float32).reshape(-1)
+                                     for l in leaves]))
+            red = ring_quantized_sum(flat, axis_name, grad_exp, grad_man,
+                                     use_kahan=use_kahan, key=k_sum)
+            out, off = [], 0
+            for l in leaves:
+                out.append(lax.dynamic_slice_in_dim(red, off, l.size)
+                           .reshape(l.shape).astype(l.dtype))
+                off += l.size
+            reduced = jax.tree_util.tree_unflatten(treedef, out)
+        else:
+            reduced = grads
     else:
         # Wire compression: with APS the gathered values were quantized to
-        # the (exp, man) value set just above, so when a hardware dtype
-        # represents that set exactly the W x gather ships 1-2 bytes per
-        # element losslessly (bit-identical results; tested).  Without APS
-        # the reference gathers RAW fp32 grads (dist_util.py:62-64), so no
+        # the (exp, man) value set just above, so the W x gather ships the
+        # bit-packed code words — wire_bytes(exp, man) bytes per element —
+        # losslessly (bit-identical results; tested).  Without APS the
+        # reference gathers RAW fp32 grads (dist_util.py:62-64), so no
         # compression is possible without changing semantics.
-        wire = _wire_dtype(grad_exp, grad_man) if use_aps else None
+        wire = _wire_format(grad_exp, grad_man) if use_aps else None
         if grad_exp == 8 and grad_man == 23 and not use_kahan:
             # fp32 fast path == plain all-reduce: the reference takes the
             # same shortcut at the identity format (dist_util.py:55-59),
@@ -410,16 +451,24 @@ def make_sum_gradients_fn(mesh: Mesh, axis_name: str = "data", **kwargs):
         local = jax.tree.map(lambda g: g[0], stacked)  # this rank's grad
         return fn(local)
 
-    jitted = {}  # keyed by treedef so jit's trace cache is actually hit
+    # Keyed by treedef so jit's trace cache is actually hit — and BOUNDED:
+    # a long-lived reducer fed many distinct pytree structures (sweeps,
+    # notebooks) must not grow a callable per structure forever.  Eviction
+    # only costs a re-trace on the next call with that structure.
+    from ..utils.cache import LRUCache
+    jitted = LRUCache(maxsize=16)
 
     def reduced(stacked_grads):
         treedef = jax.tree.structure(stacked_grads)
-        if treedef not in jitted:
+
+        def build():
             in_spec = jax.tree.map(lambda _: P(axis_name), stacked_grads)
             out_spec = jax.tree.map(lambda _: P(), stacked_grads)
-            jitted[treedef] = jax.jit(
+            return jax.jit(
                 shard_map(body, mesh=mesh, in_specs=(in_spec,),
                           out_specs=out_spec, check_vma=False))
-        return jitted[treedef](stacked_grads)
 
+        return jitted.get_or_create(treedef, build)(stacked_grads)
+
+    reduced._cache = jitted   # introspectable bound (tests assert on it)
     return reduced

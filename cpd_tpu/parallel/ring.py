@@ -1,0 +1,351 @@
+"""Ring-transport quantized all-reduce: reduce-scatter + all-gather rings
+moving bit-packed eXmY payloads (EQuARX-style, PAPERS.md).
+
+The faithful gather path (parallel/dist.py) ships every rank's FULL
+gradient to every rank — (W-1)·n raw fp32 elements per device on the wire
+and a (W, n) gathered stack resident — before the ordered requantizing
+scan even starts.  The ring transport does the same class of ordered
+quantized reduction while moving ~2·n·(W-1)/W elements per device (2/W of
+the gather path's element count) at ``wire_bytes(exp, man)`` bytes each
+(quant/numerics.pack_exmy), with O(n/W) peak transient memory: partial
+sums — which are post-quantize and therefore always in the format's value
+set, APS or not — are what rides the wire, never raw fp32.
+
+Transport semantics (the documented per-chunk rank rotation)
+-----------------------------------------------------------
+
+The flat buffer is zero-padded to W·chunk and split into W chunks; device
+d finishes owning chunk d.  Chunk c's partial starts on device (c+1) mod W
+as ``q(0 + g_{c+1}[c])`` and hops rightward, each hop folding in the host
+device's local contribution:
+
+    hop t (t = 0..W-1): device (c+1+t) mod W applies
+        res = q(res + g_{(c+1+t) mod W}[c])            (plain; sites 0)
+        y = q(g - comp); tmp = q(res + y);              (Kahan; sites 0-3)
+        comp = q(q(tmp - res) - y); res = tmp
+
+so chunk c accumulates ranks in the ROTATED order (c+1, c+2, ..., c) mod
+W — each chunk's order is a rotation of rank order, not rank order
+itself.  A single unidirectional ring cannot give every chunk the
+identical start rank while keeping all devices busy, so the rotation IS
+the transport's semantics: deterministic, topology-independent, and
+emulated bit-for-bit by the single-device `ring_oracle_sum` (the
+correctness gate — tests assert bitwise equality distributed-vs-oracle
+across formats, world sizes and rounding modes).  Versus the gather
+path's single global rank order the result differs only by that
+per-chunk rotation of the same ordered requantized sum; both are equally
+faithful "some fixed documented order" reductions (the property psum
+cannot give), and tests pin their statistical agreement.
+
+Stochastic rounding composes transport-invariantly: per-element bits are
+indexed by (key, hop step t, cast site, GLOBAL flat offset) — the same
+(key, step, site, offset) scheme as reduction.py — so the oracle, the
+distributed ring, and any resharding of the ring draw identical bits.
+
+Kahan on a ring: the compensation term must ride along with the partial
+(the next hop's casts need it), so the reduce-scatter phase ships 2
+values per element; the all-gather phase ships only the result.  Still
+~(W-1)·3/W elements per device vs the gather path's (W-1)·n.
+
+The per-hop body is one fused quantize-accumulate kernel on TPU
+(ops/quantize.quantize_add_pallas, sharing `cast_body` with everything
+else); elsewhere the XLA composition of the same ops (bit-identical —
+same body).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..quant.numerics import (cast_to_format, cast_to_format_sr_at,
+                              pack_exmy, sr_bits_at, unpack_exmy,
+                              wire_bytes)
+
+__all__ = ["ring_quantized_sum", "ring_oracle_sum", "ring_transport_bytes",
+           "gather_transport_bytes", "transport_table", "pad_to_world",
+           "ring_chunk_size"]
+
+
+def ring_chunk_size(n: int, world: int) -> int:
+    """Elements per ring chunk: ceil(n / world) — one chunk per device.
+    The same quantum parallel/zero.py shards its flat layouts by."""
+    return math.ceil(n / world) if n else 0
+
+
+def pad_to_world(flat: jnp.ndarray, world: int) -> jnp.ndarray:
+    """Zero-pad a flat (n,) vector to world * ring_chunk_size(n, world).
+    Exact zeros are rounding-invariant, so pad elements never perturb a
+    quantized reduction (and are sliced off before returning)."""
+    n = flat.shape[0]
+    return jnp.pad(flat, (0, world * ring_chunk_size(n, world) - n))
+
+
+def _make_hop_q(exp: int, man: int, key):
+    """Per-hop quantizer ``q(x, step, site, offs)`` with reduction.py's
+    exact bit-indexing contract: RTNE when key is None, else SR bits from
+    (key, step, site, global offset).  Unlike reduction._make_q the
+    offsets are a call argument — on the ring the chunk (hence its global
+    offsets) a device is casting changes every hop."""
+    if key is None:
+        return lambda x, step, site, offs: cast_to_format(x, exp, man)
+
+    def q(x, step, site, offs):
+        k = jax.random.fold_in(jax.random.fold_in(key, step), site)
+        return cast_to_format_sr_at(x, exp, man, k, offs)
+
+    return q
+
+
+def _hop_plain(q, res, g, t, offs, fp32_shortcut):
+    """res = q(res + g) — one reduce-scatter hop.  At (8,23) non-Kahan the
+    cast is skipped entirely (plain fp32 add), mirroring quantized_sum's
+    reference-parity shortcut (dist_util.py:55-59): cast_to_format(8,23)
+    would flush fp32-subnormal partials, which the reference's fp32 path
+    never does."""
+    if fp32_shortcut:
+        return res + g
+    return q(res + g, t, 0, offs)
+
+
+def _hop_kahan(q, res, comp, g, t, offs):
+    """One Kahan-compensated hop, sites 0-3 exactly as
+    reduction.kahan_quantized_sum's scan body."""
+    y = q(g - comp, t, 0, offs)
+    tmp = q(res + y, t, 1, offs)
+    comp = q(q(tmp - res, t, 2, offs) - y, t, 3, offs)
+    return tmp, comp
+
+
+def _static_world(axis_name, world: Optional[int]) -> int:
+    if world is not None:
+        return int(world)
+    w = lax.psum(1, axis_name)  # concrete int inside shard_map on jax 0.4
+    try:
+        return int(w)
+    except (TypeError, jax.errors.TracerArrayConversionError) as e:
+        raise ValueError(
+            "ring transport needs the axis size as a static int at trace "
+            "time; this JAX returned a traced psum — pass world= "
+            "explicitly (e.g. mesh.shape[axis_name])") from e
+
+
+def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
+                       *, use_kahan: bool = False, key=None,
+                       offset_start: int = 0, packed: bool = True,
+                       world: Optional[int] = None,
+                       fused: Optional[bool] = None,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Ordered quantized SUM of per-rank flat fp32 vectors over `axis_name`
+    via a ppermute ring — call inside shard_map.
+
+    Every rank passes its LOCAL (n,) fp32 contribution; every rank returns
+    the full (n,) reduced vector (replicated).  Accumulation follows the
+    per-chunk rank rotation documented in the module docstring, with every
+    partial re-quantized to (exp, man) — `ring_oracle_sum` reproduces the
+    result bit-for-bit on one device.
+
+    packed       → ship hop payloads (and the final all-gather) as
+                   bit-packed eXmY code words (pack_exmy) instead of fp32.
+                   Lossless by construction — partials are post-cast, so
+                   they live in the format's value set.  Auto-disabled for
+                   formats the codec rejects (man < 2) and a no-op gain at
+                   (8, 23) (4-byte code words).
+    offset_start → global flat offset of flat[0] in the SR bit-index space
+                   (parallel/dist.py's `_leaf_starts` space).
+    world        → static axis size; default reads it from the axis.
+    fused        → use the fused Pallas quantize-accumulate hop kernel
+                   (ops/quantize.quantize_add_pallas; plain path only —
+                   Kahan's 4-cast body stays XLA).  Default: TPU backend
+                   only.  `interpret` runs that kernel in interpret mode
+                   (CPU tests).
+    """
+    if isinstance(axis_name, (tuple, list)):
+        raise ValueError("ring transport runs over exactly one mesh axis; "
+                         f"got {axis_name!r}")
+    w = _static_world(axis_name, world)
+    n = flat.shape[0]
+    flat = jnp.asarray(flat, jnp.float32)
+    fp32_shortcut = exp == 8 and man == 23 and not use_kahan
+    if man < 2 or (exp == 8 and man == 23):
+        packed = packed and not (man < 2)
+        packed = packed and not fp32_shortcut  # 4-byte words: skip the work
+    if fused is None:
+        fused = jax.default_backend() == "tpu"
+    if fused and (use_kahan or fp32_shortcut):
+        fused = False
+
+    padded = pad_to_world(flat, w)
+    chunk = padded.shape[0] // w if w else 0
+    if n == 0:
+        return flat
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % w) for i in range(w)]
+    q = _make_hop_q(exp, man, key)
+
+    def chunk_at(t):
+        """Chunk index this device's partial holds after hop t."""
+        return jnp.mod(rank.astype(jnp.int32) - 1 - t, w)
+
+    def local_chunk(c):
+        return lax.dynamic_slice_in_dim(padded, c * chunk, chunk)
+
+    def offs_of(c):
+        return (jnp.uint32(offset_start)
+                + c.astype(jnp.uint32) * jnp.uint32(chunk)
+                + jnp.arange(chunk, dtype=jnp.uint32))
+
+    def accum(res, comp, t, c):
+        g = local_chunk(c)
+        offs = offs_of(c)
+        if use_kahan:
+            return _hop_kahan(q, res, comp, g, t, offs)
+        if fused and key is None:
+            from ..ops.quantize import quantize_add_pallas
+            return quantize_add_pallas(res, g, exp, man,
+                                       interpret=interpret), comp
+        if fused:
+            from ..ops.quantize import quantize_add_pallas_bits
+            k = jax.random.fold_in(jax.random.fold_in(key, t), 0)
+            return quantize_add_pallas_bits(res, g, exp, man,
+                                            sr_bits_at(k, offs),
+                                            interpret=interpret), comp
+        return _hop_plain(q, res, g, t, offs, fp32_shortcut), comp
+
+    def to_wire(res, comp):
+        payload = jnp.stack([res, comp]) if use_kahan else res
+        return pack_exmy(payload, exp, man) if packed else payload
+
+    def from_wire(p):
+        payload = unpack_exmy(p, exp, man) if packed else p
+        if use_kahan:
+            return payload[0], payload[1]
+        return payload, jnp.zeros_like(payload)
+
+    # hop 0: quantize the local chunk in place (res = q(0 + g)); no wire
+    zero = jnp.zeros((chunk,), jnp.float32)
+    res, comp = accum(zero, zero, jnp.int32(0), chunk_at(0))
+
+    def body(carry, t):
+        res, comp = from_wire(lax.ppermute(carry, axis_name, perm))
+        res, comp = accum(res, comp, t, chunk_at(t))
+        return to_wire(res, comp), None
+
+    carry, _ = lax.scan(body, to_wire(res, comp),
+                        jnp.arange(1, w, dtype=jnp.int32))
+    res, _ = from_wire(carry)
+    # res is now the reduced chunk `rank`; ring all-gather of the packed
+    # chunks rebuilds the full vector (XLA lowers all_gather as a ring on
+    # the TPU torus, so the wire cost is the (W-1) chunk hops accounted in
+    # ring_transport_bytes — with the payload still bit-packed).
+    wire = pack_exmy(res, exp, man) if packed else res
+    gathered = lax.all_gather(wire, axis_name, axis=0, tiled=False)
+    full = unpack_exmy(gathered, exp, man) if packed else gathered
+    return full.reshape(-1)[:n]
+
+
+def ring_oracle_sum(stacked: jnp.ndarray, exp: int, man: int, *,
+                    use_kahan: bool = False, key=None,
+                    offset_start: int = 0) -> jnp.ndarray:
+    """Single-device oracle for the ring transport: given the stacked
+    per-rank contributions (W, *shape), reproduce `ring_quantized_sum`'s
+    result bit-for-bit — the per-chunk rank rotation, the per-hop casts
+    with their (step, site, global-offset) SR bit indexing, the (8,23)
+    fp32 shortcut, everything except the wire.
+
+    The distributed path and this oracle share the hop-body functions
+    (`_hop_plain` / `_hop_kahan` / `_make_hop_q`), so a divergence can
+    only come from the transport itself — which is exactly what the
+    oracle-parity tests gate."""
+    w = stacked.shape[0]
+    n = int(stacked[0].size)
+    shape = stacked.shape[1:]
+    if n == 0:
+        return jnp.zeros(shape, jnp.float32)
+    flat = jnp.reshape(jnp.asarray(stacked, jnp.float32), (w, n))
+    chunk = ring_chunk_size(n, w)
+    padded = jnp.pad(flat, ((0, 0), (0, w * chunk - n)))
+    per_chunk = padded.reshape(w, w, chunk)        # [rank, chunk, elem]
+    # contribution visiting chunk c at hop t comes from rank (c+1+t) mod w
+    t_idx = jnp.arange(w)[:, None]
+    c_idx = jnp.arange(w)[None, :]
+    order = jnp.mod(c_idx + 1 + t_idx, w)          # [hop, chunk]
+    hops = per_chunk[order, c_idx, :]              # [hop, chunk, elem]
+    offs = (jnp.uint32(offset_start)
+            + (c_idx.astype(jnp.uint32) * jnp.uint32(chunk))[..., None]
+            + jnp.arange(chunk, dtype=jnp.uint32)[None, None, :])[0]
+    q = _make_hop_q(exp, man, key)
+    fp32_shortcut = exp == 8 and man == 23 and not use_kahan
+
+    def body(carry, xs):
+        res, comp = carry
+        t, g = xs
+        if use_kahan:
+            res, comp = _hop_kahan(q, res, comp, g, t, offs)
+        else:
+            res = _hop_plain(q, res, g, t, offs, fp32_shortcut)
+        return (res, comp), None
+
+    zero = jnp.zeros((w, chunk), jnp.float32)
+    (res, _), _ = lax.scan(
+        body, (zero, zero), (jnp.arange(w, dtype=jnp.int32), hops))
+    return res.reshape(-1)[:n].reshape(shape)
+
+
+def ring_transport_bytes(n: int, world: int, exp: int, man: int, *,
+                         use_kahan: bool = False,
+                         packed: bool = True) -> int:
+    """Analytic per-device wire bytes for one ring all-reduce of n
+    elements: (W-1) reduce-scatter hops of one chunk (×2 with Kahan — the
+    compensation rides) plus (W-1) all-gather hops of one chunk."""
+    if n == 0 or world <= 0:
+        return 0
+    chunk = ring_chunk_size(n, world)
+    per_elem = wire_bytes(exp, man) if packed else 4
+    reduce_phase = (world - 1) * chunk * per_elem * (2 if use_kahan else 1)
+    gather_phase = (world - 1) * chunk * per_elem
+    return reduce_phase + gather_phase
+
+
+def gather_transport_bytes(n: int, world: int, exp: int, man: int, *,
+                           compressed: bool = False) -> int:
+    """Analytic per-device wire bytes for the faithful all_gather path:
+    (W-1)·n elements, raw fp32 unless the APS-prequantized wire packing
+    applies (`compressed`)."""
+    if n == 0 or world <= 0:
+        return 0
+    per_elem = wire_bytes(exp, man) if compressed else 4
+    return (world - 1) * n * per_elem
+
+
+def transport_table(n: int, world: int, exp: int, man: int,
+                    use_kahan: bool = False) -> dict:
+    """Analytic per-device bytes-on-wire for every transport of one
+    all-reduce of n elements — the payload of bench.py's `reduction`
+    block and tools/bench_reduce.py.  One home for the comparison so the
+    ledger, the tool and docs/PERF.md's table cannot drift."""
+    compressible = man >= 2 and wire_bytes(exp, man) < 4
+    gather = gather_transport_bytes(n, world, exp, man, compressed=False)
+    table = {
+        "faithful_gather_fp32": gather,
+        "faithful_gather_packed": (
+            gather_transport_bytes(n, world, exp, man, compressed=True)
+            if compressible else None),  # needs APS pre-quantized values
+        "ring_packed": ring_transport_bytes(n, world, exp, man,
+                                            use_kahan=use_kahan,
+                                            packed=compressible),
+        # XLA lowers psum as a ring reduce-scatter + all-gather on the
+        # TPU torus, but the payload stays fp32 (psum cannot know the
+        # values fit a narrower format — EQuARX's whole point), so fast
+        # mode's wire is exactly the UNPACKED ring: 2·(W-1)·(n/W)·4
+        "fast_psum_fp32": ring_transport_bytes(n, world, 8, 23,
+                                               packed=False),
+    }
+    table["ring_vs_gather_ratio"] = (
+        round(gather / table["ring_packed"], 2) if table["ring_packed"]
+        else None)
+    return table

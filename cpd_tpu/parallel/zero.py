@@ -48,7 +48,6 @@ precision has one source of truth):
 
 from __future__ import annotations
 
-import math
 from typing import Callable, NamedTuple, Optional
 
 import jax
@@ -57,10 +56,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..quant.numerics import pack_exmy, unpack_exmy
 from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
                   pmax_scalar_vector)
-from .dist import _flat_axis_index, _wire_dtype, quantize_tree_sr
+from .dist import _flat_axis_index, _wire_format, quantize_tree_sr
 from .reduction import quantized_sum
+from .ring import pad_to_world, ring_chunk_size
 
 __all__ = ["Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd",
            "zero1_lars", "zero2_lars", "zero3_lars"]
@@ -85,8 +86,10 @@ class _Zero1:
 
     # ---- flat layout ----
     def _shard_size(self, params) -> int:
+        # the ring transport's chunk quantum (parallel/ring.py) — ZeRO
+        # shards and ring chunks slice the same padded flat layout
         total = sum(l.size for l in jax.tree.leaves(params))
-        return math.ceil(total / self.world)
+        return ring_chunk_size(total, self.world)
 
     def _shard_leaf_values(self, template, values, rank,
                            s: int, pad: float = 0.0) -> jnp.ndarray:
@@ -165,9 +168,7 @@ class _Zero1:
                 "reduce_in_update=True is a ZeRO-2 (zero2_sgd) contract")
         s = self._shard_size(state.params)
         rank = lax.axis_index(axis_name)
-        flat_g = jnp.pad(self._flatten(grads),
-                         (0, self.world * s - sum(
-                             l.size for l in jax.tree.leaves(grads))))
+        flat_g = pad_to_world(self._flatten(grads), self.world)
         return lax.dynamic_slice(flat_g, (rank * s,), (s,))
 
     requires_reduce_in_update = False
@@ -191,9 +192,7 @@ class _Zero1:
         lr = self.schedule(opt.step)
 
         g_sh = self._grad_shard(grads, state, axis_name, **quant_kw)
-        flat_p = jnp.pad(self._flatten(params),
-                         (0, self.world * s - sum(
-                             l.size for l in jax.tree.leaves(params))))
+        flat_p = pad_to_world(self._flatten(params), self.world)
         p_sh = lax.dynamic_slice(flat_p, (rank * s,), (s,))
         new_p_sh, new_buf = self._shard_update(g_sh, p_sh, params, rank, s,
                                                opt.momentum, lr, axis_name)
@@ -231,9 +230,7 @@ class _Zero1:
     def import_state(self, state):
         """Portable layout -> THIS updater's padded (W*S,) layout."""
         opt: Zero1State = state.opt_state
-        s = self._shard_size(state.params)
-        mom = jnp.pad(jnp.asarray(opt.momentum),
-                      (0, self.world * s - opt.momentum.size))
+        mom = pad_to_world(jnp.asarray(opt.momentum), self.world)
         return state.replace(opt_state=Zero1State(opt.step, mom))
 
     def mesh_layout(self, state, mesh):
@@ -325,8 +322,11 @@ class _Zero2(_Zero1):
         if mode != "faithful":
             raise ValueError(
                 f"ZeRO-2 shards the faithful ordered reduction; mode="
-                f"{mode!r} has no reduce-scatter equivalent (the fast "
-                f"psum path keeps the full gradient resident anyway)")
+                f"{mode!r} is not supported here (the fast psum path "
+                f"keeps the full gradient resident anyway, and the ring "
+                f"transport's per-chunk rotation order is a different "
+                f"reduction semantics from the rank-order slices ZeRO-2 "
+                f"reproduces)")
         if rounding == "stochastic" and key is None:
             raise ValueError("rounding='stochastic' requires a PRNG key")
         if rounding == "nearest" and key is not None:
@@ -349,17 +349,20 @@ class _Zero2(_Zero1):
             g = aps_scale(g, shifts)
             g = quantize_tree_sr(g, grad_exp, grad_man, k_pre)
 
-        flat = self._flatten(g)
-        flat = jnp.pad(flat, (0, self.world * s - flat.size))
-        wire = _wire_dtype(grad_exp, grad_man) if use_aps else None
+        flat = pad_to_world(self._flatten(g), self.world)
+        wire = _wire_format(grad_exp, grad_man) if use_aps else None
+        payload = flat.reshape(self.world, s)
         if wire is not None:
-            flat = flat.astype(wire)
+            # bit-packed eXmY wire (quant.numerics.pack_exmy): the APS
+            # pre-quantize above put the values in the format set, so the
+            # all_to_all ships wire_bytes(exp, man) bytes/element lossless
+            payload = pack_exmy(payload, *wire)
         # (W, S): row j after all_to_all = rank j's slice of OUR shard,
         # rank-ordered — the gather side of a reduce_scatter
-        stacked = lax.all_to_all(flat.reshape(self.world, s), axis_name,
+        stacked = lax.all_to_all(payload, axis_name,
                                  split_axis=0, concat_axis=0)
         if wire is not None:
-            stacked = stacked.astype(jnp.float32)
+            stacked = unpack_exmy(stacked, *wire)
         rank = lax.axis_index(axis_name)
         # uint32 throughout: int32 intermediates would rely on signed
         # overflow wrapping to agree with _leaf_offsets for element
@@ -435,9 +438,7 @@ class _Zero3(_Zero2):
         """Pytree -> global flat (W*S,) fp32 (device_put with
         `param_spec()`'s NamedSharding, or the step's out sharding,
         splits it 1/W)."""
-        s = self._shard_size(self.template)
-        flat = self._flatten(params)
-        return jnp.pad(flat, (0, self.world * s - flat.size))
+        return pad_to_world(self._flatten(params), self.world)
 
     def to_pytree(self, flat_global: jnp.ndarray):
         """Global flat array -> param pytree (for eval / checkpoints)."""

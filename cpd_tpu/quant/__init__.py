@@ -1,5 +1,6 @@
 from .numerics import (cast_to_format, cast_to_format_sr, cast_oracle,
-                       cast_oracle_sr, max_finite)
+                       cast_oracle_sr, max_finite, pack_exmy, unpack_exmy,
+                       wire_bytes)
 from .quant_function import float_quantize, quantizer, quantizer_sr, quant_gemm
 from .quant_module import Quantizer, QuantDense, QuantLinear, QuantConv
 
@@ -9,6 +10,9 @@ __all__ = [
     "cast_oracle",
     "cast_oracle_sr",
     "max_finite",
+    "pack_exmy",
+    "unpack_exmy",
+    "wire_bytes",
     "float_quantize",
     "quantizer",
     "quantizer_sr",

@@ -52,6 +52,7 @@ import numpy as np
 __all__ = ["cast_to_format", "cast_body", "cast_oracle", "max_finite",
            "cast_body_sr", "cast_to_format_sr", "cast_oracle_sr",
            "sr_bits_at", "cast_to_format_sr_at",
+           "pack_exmy", "unpack_exmy", "wire_bytes",
            "FP32_EXP_BITS", "FP32_MAN_BITS"]
 
 FP32_EXP_BITS = 8
@@ -272,6 +273,161 @@ def cast_to_format_sr_at(x: jnp.ndarray, exp_bits: int, man_bits: int,
     `offsets` must have x's shape (or broadcast to it)."""
     rbits = jnp.broadcast_to(sr_bits_at(key, offsets), jnp.shape(x))
     return cast_body_sr(x, exp_bits, man_bits, rbits)
+
+
+# --------------------------------------------------------------------------
+# Bit-packed eXmY wire format (the transport codec of parallel/ring.py and
+# the compressed all_gather / all_to_all wires in parallel/dist.py,
+# parallel/zero.py).
+#
+# An fp32 value that came out of `cast_to_format(·, e, m)` carries only
+# 1 + e + m bits of information: sign, the format's e-bit exponent field,
+# and the m-bit mantissa field.  `pack_exmy` re-encodes each element into
+# that code word, stored little-endian in ceil((1+e+m)/8) bytes, and
+# `unpack_exmy` reconstructs the exact fp32 bit pattern.  This replaces the
+# old 3-entry hardware-dtype table (e5m2/f16/bf16 only): ANY format with
+# man_bits >= 2 now ships compressed — including (4,3), whose saturating
+# cast produces ±Inf that float8_e4m3fn cannot represent.
+#
+# Code-word layout (bit 0 = LSB):   [ man (m) | exp (e) | sign (1) ]
+#   exp field 0            → format subnormal: value = man · 2^(1-bias-m)
+#   exp field 1..2^e-2     → normal: value = (2^m + man) · 2^(F-bias-m)
+#   exp field all-ones     → specials, discriminated by the mantissa code:
+#       man 0 → ±Inf (the cast's pre-round saturation output)
+#       man 1 → ±2^(e_max+1), the carry-past-max value the reference cast
+#               deliberately emits (module docstring; float_kernel.cu:71)
+#       man 2 → NaN (canonicalized — payload bits are not format data)
+# The three specials are why man_bits >= 2 is required: with m < 2 the
+# all-ones block has too few codes.  (8,23) bypasses the codec entirely —
+# the code word IS the fp32 bit pattern, so packing is a byte split and
+# every NaN payload survives.
+#
+# Losslessness contract: for x in the (e, m) cast's OUTPUT set (any array
+# that went through cast_to_format / cast_body_sr at the same format),
+# unpack_exmy(pack_exmy(x)) == x bit-for-bit, including -0.0, format
+# subnormals (which for e == 8 are fp32 subnormals), ±Inf and the carry
+# value.  Values outside that set are a caller error (the low mantissa
+# bits are truncated, out-of-range exponents best-effort to carry/Inf).
+# --------------------------------------------------------------------------
+
+
+def wire_bytes(exp_bits: int, man_bits: int) -> int:
+    """Bytes per element of the packed eXmY wire format."""
+    _validate(exp_bits, man_bits)
+    return (1 + exp_bits + man_bits + 7) // 8
+
+
+def _validate_wire(exp_bits: int, man_bits: int) -> None:
+    _validate(exp_bits, man_bits)
+    if man_bits < 2 and not (exp_bits == 8 and man_bits == 23):
+        raise ValueError(
+            f"pack_exmy needs man_bits >= 2 (got ({exp_bits}, {man_bits})): "
+            "the all-ones exponent block must hold the Inf/carry/NaN "
+            "special codes; ship such formats as raw fp32 instead")
+
+
+def _split_bytes(code: jnp.ndarray, n_bytes: int) -> jnp.ndarray:
+    """uint32 code words -> little-endian uint8 array, one trailing axis."""
+    return jnp.stack(
+        [((code >> (8 * k)) & jnp.uint32(0xFF)).astype(jnp.uint8)
+         for k in range(n_bytes)], axis=-1)
+
+
+def _join_bytes(packed: jnp.ndarray) -> jnp.ndarray:
+    """Little-endian uint8 (..., B) -> uint32 code words (...)."""
+    code = jnp.zeros(packed.shape[:-1], jnp.uint32)
+    for k in range(packed.shape[-1]):
+        code = code | (packed[..., k].astype(jnp.uint32) << (8 * k))
+    return code
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def pack_exmy(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Pack fp32 values already in the (exp_bits, man_bits) value set into
+    little-endian uint8 code words of shape ``x.shape + (wire_bytes(),)``."""
+    _validate_wire(exp_bits, man_bits)
+    x = jnp.asarray(x, jnp.float32)
+    n_bytes = wire_bytes(exp_bits, man_bits)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    if exp_bits == 8 and man_bits == 23:
+        return _split_bytes(bits, n_bytes)
+
+    sign = (bits >> 31) & jnp.uint32(1)
+    exp_f = ((bits >> 23) & jnp.uint32(0xFF)).astype(jnp.int32)
+    man_f = (bits & jnp.uint32(0x007FFFFF)).astype(jnp.int32)
+    bias = (1 << (exp_bits - 1)) - 1
+    ones = (1 << exp_bits) - 1
+
+    is_nan = (exp_f == 0xFF) & (man_f != 0)
+    is_inf = (exp_f == 0xFF) & (man_f == 0)
+    # fp32 subnormal inputs have no implicit bit and a fixed 2^-126 scale
+    man24 = jnp.where(exp_f > 0, man_f | (1 << 23), man_f)
+    f = jnp.where(exp_f > 0, exp_f - 127, -126) + bias
+
+    # format-subnormal when the value sits below the format's normal range
+    # OR the fp32 pattern itself is subnormal (e == 8 formats)
+    is_sub = (f <= 0) | (exp_f == 0)
+    # finite exponent at/above the all-ones field: the carry value
+    is_carry = (~is_sub) & (exp_f != 0xFF) & (f >= ones)
+
+    shift = jnp.clip(jnp.maximum(1 - f, 0) + (23 - man_bits), 0, 31)
+    man_sub = man24 >> shift
+    man_norm = man_f >> (23 - man_bits)
+
+    exp_field = jnp.where(is_sub, 0, jnp.clip(f, 0, ones)).astype(jnp.uint32)
+    man_field = jnp.where(is_sub, man_sub, man_norm).astype(jnp.uint32)
+    code = (sign << (exp_bits + man_bits)) | (exp_field << man_bits) \
+        | man_field
+    # specials: all-ones exponent + discriminant code
+    top = jnp.uint32(ones << man_bits)
+    code = jnp.where(is_carry, (sign << (exp_bits + man_bits)) | top
+                     | jnp.uint32(1), code)
+    code = jnp.where(is_inf, (sign << (exp_bits + man_bits)) | top, code)
+    code = jnp.where(is_nan, top | jnp.uint32(2), code)
+    return _split_bytes(code, n_bytes)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def unpack_exmy(packed: jnp.ndarray, exp_bits: int,
+                man_bits: int) -> jnp.ndarray:
+    """Inverse of `pack_exmy`: uint8 ``(..., wire_bytes())`` -> fp32 ``(...)``
+    with the exact bit patterns the cast produced."""
+    _validate_wire(exp_bits, man_bits)
+    n_bytes = wire_bytes(exp_bits, man_bits)
+    packed = jnp.asarray(packed, jnp.uint8)
+    if packed.shape[-1] != n_bytes:
+        raise ValueError(f"trailing axis {packed.shape[-1]} != "
+                         f"wire_bytes({exp_bits}, {man_bits}) = {n_bytes}")
+    code = _join_bytes(packed)
+    if exp_bits == 8 and man_bits == 23:
+        return jax.lax.bitcast_convert_type(code, jnp.float32)
+
+    bias = (1 << (exp_bits - 1)) - 1
+    ones = (1 << exp_bits) - 1
+    sign = ((code >> (exp_bits + man_bits)) & jnp.uint32(1)) != 0
+    exp_field = ((code >> man_bits) & jnp.uint32(ones)).astype(jnp.int32)
+    man_field = (code & jnp.uint32((1 << man_bits) - 1)).astype(jnp.int32)
+
+    is_special = exp_field == ones
+    is_sub = exp_field == 0
+    # normals: (2^m + man) * 2^(F - bias - m); subnormals: man * 2^(1-bias-m)
+    mantissa = jnp.where(is_sub, man_field, man_field | (1 << man_bits))
+    e = jnp.where(is_sub, 1, exp_field) - bias - man_bits
+    # carry special: 1 * 2^(e_max + 1); e_max + 1 = ones - bias.  For e == 8
+    # that is 2^128, which the exact pow2 product below overflows to +Inf —
+    # the same value the e == 8 cast itself produces in place of a carry.
+    is_carry = is_special & (man_field == 1)
+    mantissa = jnp.where(is_carry, 1, mantissa)
+    e = jnp.where(is_carry, ones - bias, e)
+    # exact two-factor power-of-two product (see _cast_core's reconstruction)
+    a = jnp.clip(e, -126, 127)
+    b = jnp.clip(e - a, -126, 127)
+    mag = mantissa.astype(jnp.float32) * _pow2(a) * _pow2(b)
+    inf = jnp.float32(jnp.inf)
+    mag = jnp.where(is_special & (man_field == 0), inf, mag)
+    val = jnp.where(sign, -mag, mag)
+    return jnp.where(is_special & (man_field >= 2), jnp.float32(jnp.nan),
+                     val)
 
 
 def cast_oracle_sr(x: float, exp_bits: int, man_bits: int, r: int) -> float:

@@ -1,4 +1,5 @@
-"""Persistent XLA compilation cache — one shared switch.
+"""Caching utilities: the persistent XLA compilation cache switch, and a
+small bounded LRU mapping for host-side jit-callable caches.
 
 Full-model train steps cost tens of seconds of XLA compile; caching them
 makes driver re-runs of the bench / dryrun / test suite near-free.  Used by
@@ -9,8 +10,44 @@ lives in exactly one place.
 from __future__ import annotations
 
 import os
+from collections import OrderedDict
+from typing import Any, Callable, Hashable
 
-__all__ = ["enable_compile_cache", "default_cache_dir", "clear_cache"]
+__all__ = ["enable_compile_cache", "default_cache_dir", "clear_cache",
+           "LRUCache"]
+
+
+class LRUCache:
+    """Bounded insertion/recency-ordered mapping for host-side caches of
+    jitted callables (e.g. parallel/dist.py `make_sum_gradients_fn`, keyed
+    by treedef).  A plain dict there grows without bound when callers keep
+    presenting new pytree structures; evicting the least-recently-used
+    entry just drops a compiled callable — the next call with that
+    structure re-traces, which is a cost, never an error."""
+
+    def __init__(self, maxsize: int):
+        if maxsize < 1:
+            raise ValueError(f"maxsize must be >= 1, got {maxsize}")
+        self.maxsize = maxsize
+        self._d: OrderedDict = OrderedDict()
+
+    def get_or_create(self, key: Hashable, create: Callable[[], Any]) -> Any:
+        """Return the cached value for `key`, creating (and inserting) it
+        via `create()` on a miss; either way `key` becomes most-recent."""
+        if key in self._d:
+            self._d.move_to_end(key)
+            return self._d[key]
+        value = create()
+        self._d[key] = value
+        while len(self._d) > self.maxsize:
+            self._d.popitem(last=False)
+        return value
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
 
 
 def _cpuid(leaf: int, subleaf: int = 0) -> tuple[int, int, int, int]:

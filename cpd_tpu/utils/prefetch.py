@@ -54,7 +54,20 @@ class Prefetcher:
         return self
 
     def __next__(self):
-        item = self._q.get()
+        # Never block indefinitely: after close() the queue may stay empty
+        # forever (the drain discards even the end-of-stream sentinel), so
+        # a bare get() would hang the consumer.  A closed prefetcher is
+        # exhausted — close() already discards in-flight items — and an
+        # open one polls with a short timeout so a concurrent close()
+        # still unblocks it.
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                continue
         if item is _SENTINEL:
             raise StopIteration
         if isinstance(item, BaseException):

@@ -46,7 +46,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_APS", action="store_true")
     p.add_argument("--use_kahan", action="store_true")
     p.add_argument("--emulate_node", default=1, type=int)
-    p.add_argument("--mode", default="faithful", choices=["faithful", "fast"])
+    p.add_argument("--mode", default="faithful",
+                   choices=["faithful", "fast", "ring"])
     p.add_argument("--dist", action="store_true")
     p.add_argument("--data-root", default=None,
                    help="Cityscapes root (leftImg8bit/gtFine); synthetic "

@@ -97,7 +97,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_APS", action="store_true")
     p.add_argument("--use_kahan", action="store_true")
     p.add_argument("--emulate_node", default=1, type=int)
-    p.add_argument("--mode", default="faithful", choices=["faithful", "fast"])
+    p.add_argument("--mode", default="faithful",
+                   choices=["faithful", "fast", "ring"])
     p.add_argument("--dist", action="store_true")
     p.add_argument("--tensorboard", action="store_true",
                    help="also write TensorBoard event files next to the "

@@ -102,9 +102,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also write TensorBoard event files next to the "
                         "JSONL scalars (reference mix.py:16,168-171)")
     p.add_argument("--mode", default="faithful",
-                   choices=["faithful", "fast"],
+                   choices=["faithful", "fast", "ring"],
                    help="faithful: bit-ordered quantized reduction; "
-                        "fast: quantize->psum->dequantize")
+                        "fast: quantize->psum->dequantize; ring: ordered "
+                        "quantized reduce-scatter/all-gather ring with "
+                        "bit-packed eXmY wire (parallel/ring.py)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard the optimizer state 1/W over dp "
                         "(composes with --use_lars via zero1_lars, "

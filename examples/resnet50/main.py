@@ -64,7 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batches-per-epoch", default=None, type=int)
     p.add_argument("--image-size", default=224, type=int)
     p.add_argument("--mode", default="faithful",
-                   choices=["faithful", "fast"])
+                   choices=["faithful", "fast", "ring"],
+                   help="faithful: bit-ordered quantized reduction; "
+                        "fast: quantize->psum->dequantize; ring: ordered "
+                        "quantized reduce-scatter/all-gather ring with "
+                        "bit-packed eXmY wire (parallel/ring.py)")
     p.add_argument("--sync-bn", action="store_true",
                    help="compute BN batch statistics across the dp axis "
                         "(per-replica stats, the reference behavior, when "

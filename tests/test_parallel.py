@@ -161,20 +161,22 @@ def test_bucketed_faithful_reduce_bit_identical(use_kahan):
                                       err_msg=k)
 
 
-@pytest.mark.parametrize("exp,man", [(5, 2), (8, 7), (5, 10)])
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3), (8, 7), (5, 10)])
 def test_wire_compressed_gather_bit_identical(exp, man):
     """With APS the gathered values live in the (exp, man) value set, so
-    shipping them as float8_e5m2 / bf16 / f16 on the wire must not change
-    a single bit of the reduction result."""
-    from cpd_tpu.parallel.dist import _wire_dtype
+    shipping them as bit-packed eXmY code words (pack_exmy) on the wire
+    must not change a single bit of the reduction result.  (4,3) — which
+    the old hardware-dtype table could not map, e4m3fn having no inf —
+    now compresses too."""
+    from cpd_tpu.parallel.dist import _wire_format
 
     from cpd_tpu.parallel.dist import _gather_leaf
     from cpd_tpu.parallel.reduction import quantized_sum
     from cpd_tpu.quant.numerics import cast_to_format
 
-    wire = _wire_dtype(exp, man)
-    assert wire is not None
-    assert _wire_dtype(4, 3) is None         # e4m3fn has no inf
+    wire = _wire_format(exp, man)
+    assert wire == (exp, man)
+    assert _wire_format(8, 23) is None       # 4-byte words: nothing to gain
     mesh = data_parallel_mesh()
     # mixed magnitudes incl. values that quantize to subnormals and (via
     # a huge outlier) to inf in the target format

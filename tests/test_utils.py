@@ -146,7 +146,125 @@ def test_prefetcher_runs_ahead_of_consumer():
     assert list(it) == [1, 2, 3]
 
 
+def test_prefetcher_next_after_close_raises_stopiteration():
+    """Regression: close() drains the queue (discarding the end-of-stream
+    sentinel), so a subsequent __next__ used to block forever on the empty
+    queue.  A closed prefetcher must read as exhausted, promptly."""
+    from cpd_tpu.utils.prefetch import Prefetcher
+
+    pf = Prefetcher(iter(range(100)), depth=2)
+    it = iter(pf)
+    assert next(it) == 0
+    pf.close()
+    t0 = time.monotonic()
+    with pytest.raises(StopIteration):
+        next(it)
+    assert time.monotonic() - t0 < 2.0   # prompt, not a hang/timeout pile
+    with pytest.raises(StopIteration):   # and stays exhausted
+        next(it)
+
+
+def test_prefetcher_close_unblocks_waiting_consumer():
+    """A consumer already blocked in __next__ (empty queue, stalled
+    producer) must be released by a concurrent close()."""
+    import threading
+
+    from cpd_tpu.utils.prefetch import Prefetcher
+
+    gate = threading.Event()
+
+    def stalled():
+        yield 0
+        gate.wait(10.0)            # producer wedged until the test ends
+        yield 1
+
+    pf = Prefetcher(stalled(), depth=1)
+    it = iter(pf)
+    assert next(it) == 0
+    result = {}
+
+    def consume():
+        try:
+            next(it)
+            result["got"] = "item"
+        except StopIteration:
+            result["got"] = "stop"
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.2)                # let the consumer block in __next__
+    pf.close()
+    t.join(5.0)
+    gate.set()
+    assert not t.is_alive()
+    assert result["got"] == "stop"
+
+
 # ------------------------------------------------------------- cache
+
+def test_lru_cache_bounds_and_recency():
+    from cpd_tpu.utils import LRUCache
+
+    calls = []
+
+    def make(k):
+        def create():
+            calls.append(k)
+            return k * 10
+        return create
+
+    c = LRUCache(maxsize=2)
+    assert c.get_or_create("a", make("a")) == "a" * 10
+    c.get_or_create("b", make("b"))
+    c.get_or_create("a", make("a"))      # hit: refreshes recency, no call
+    c.get_or_create("c", make("c"))      # evicts b (least recent)
+    assert len(c) == 2
+    assert "a" in c and "c" in c and "b" not in c
+    assert calls == ["a", "b", "c"]
+    c.get_or_create("b", make("b"))      # re-creating b is a re-call
+    assert calls == ["a", "b", "c", "b"]
+    with pytest.raises(ValueError):
+        LRUCache(0)
+
+
+def test_sum_gradients_fn_jit_cache_bounded():
+    """make_sum_gradients_fn's per-treedef jit cache must not grow without
+    bound when fed many distinct pytree structures — and evicted
+    structures must still compute correctly on re-presentation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cpd_tpu.parallel import make_sum_gradients_fn
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+
+    mesh = data_parallel_mesh()
+    fn = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=8,
+                               grad_man=23)
+    lru = fn._cache
+    w = len(jax.devices())
+
+    def tree(i):
+        # i+1 distinct structures: dict with i+1 keys, values a pure
+        # function of (i, j) so re-presenting a structure reuses its data
+        return {f"k{j}": jnp.asarray(
+            np.random.RandomState(i * 100 + j).randn(w, 3)
+            .astype(np.float32)) for j in range(i + 1)}
+
+    def place(t):
+        return jax.tree.map(lambda g: jax.device_put(
+            g, NamedSharding(mesh, P("dp"))), t)
+
+    results = {}
+    for i in range(lru.maxsize + 4):     # overflow the bound
+        results[i] = fn(place(tree(i)))
+    assert len(lru) == lru.maxsize
+    # structure 0 was evicted; re-presenting it re-traces and still sums
+    again = fn(place(tree(0)))
+    np.testing.assert_array_equal(np.asarray(again["k0"]),
+                                  np.asarray(results[0]["k0"]))
+
 
 def test_machine_tag_stable_and_hex():
     from cpd_tpu.utils.cache import _machine_tag

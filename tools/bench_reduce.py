@@ -1,0 +1,184 @@
+"""Gradient-reduction transport microbenchmark: gather vs ring vs psum.
+
+The hot path at scale is the gradient all-reduce (MLPerf TPU-pod scaling;
+ISSUE 3), and the interesting axis is the TRANSPORT: the faithful gather
+path ships (W-1)·n fp32 elements per device, the ring transport
+(parallel/ring.py) ships ~2·(W-1)·n/W bit-packed eXmY code words.  This
+tool times `sum_gradients` in each mode on the current backend and reports
+the ANALYTIC per-device bytes-on-wire alongside (on the CPU mesh there is
+no real wire — the byte counters are the load-bearing numbers there; on
+TPU the timing is real too).
+
+    python tools/bench_reduce.py                  # measure, JSON line out
+    python tools/bench_reduce.py --smoke          # CI gate: tiny sizes,
+        asserts ring==oracle bitwise parity and the byte-counter
+        invariants (ring >= 2x fewer wire bytes than the faithful gather
+        at W=8 for e5m2), no timing claims; exit 1 on any violation
+
+Prints ONE JSON line; `bench.py` embeds the same analytic byte accounting
+as its `reduction` block.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _ensure_multidevice():
+    """Standalone runs on CPU get the 8-virtual-device platform (the same
+    trick as tests/conftest.py) — must happen before jax imports."""
+    if "--help" in sys.argv or "-h" in sys.argv:
+        return
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat in ("", "cpu") and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_"
+                                     "count=8").strip()
+
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
+            rounding: str) -> dict:
+    """Time sum_gradients in each transport mode on the current backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cpd_tpu.parallel import make_sum_gradients_fn
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    from cpd_tpu.parallel.ring import transport_table
+
+    mesh = data_parallel_mesh()
+    world = len(jax.devices())
+    rng = np.random.RandomState(0)
+    stacked = {"g": (rng.randn(world, n) * 0.1).astype(np.float32)}
+    sharded = jax.tree.map(
+        lambda g: jax.device_put(jnp.asarray(g),
+                                 NamedSharding(mesh, P("dp"))), stacked)
+    key = jax.random.PRNGKey(0) if rounding == "stochastic" else None
+
+    out = {"world": world, "elements": n, "format": [exp, man],
+           "use_kahan": use_kahan, "rounding": rounding,
+           "platform": jax.devices()[0].platform,
+           "bytes_on_wire_per_device": transport_table(
+               n, world, exp, man, use_kahan=use_kahan),
+           "modes": {}}
+    for mode in ("faithful", "ring", "fast"):
+        fn = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=exp,
+                                   grad_man=man, use_kahan=use_kahan,
+                                   mode=mode, rounding=rounding, key=key)
+        r = fn(sharded)
+        np.asarray(r["g"])  # compile + sync
+        best = float("inf")
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            r = fn(sharded)
+            np.asarray(r["g"])
+            best = min(best, time.perf_counter() - t0)
+        out["modes"][mode] = {"best_ms": round(best * 1e3, 3),
+                              "elems_per_sec": round(n / best, 1)}
+    return out
+
+
+def smoke() -> dict:
+    """CI gate (`reduce-smoke`): parity + byte-counter assertions on tiny
+    sizes.  Asserts, never times — a loaded CI box must not flake it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cpd_tpu.compat import shard_map
+    from cpd_tpu.parallel.mesh import make_mesh
+    from cpd_tpu.parallel.ring import (gather_transport_bytes,
+                                       ring_oracle_sum, ring_quantized_sum,
+                                       ring_transport_bytes)
+
+    checks = []
+    rng = np.random.RandomState(7)
+    key = jax.random.PRNGKey(11)
+    n = 257
+    for world in (2, 8):
+        devices = jax.devices()[:world]
+        mesh = make_mesh(dp=world, devices=devices)
+        for exp, man in ((5, 2), (4, 3)):
+            for kahan in (False, True):
+                for k in (None, key):
+                    stacked = (rng.randn(world, n) * 0.3).astype(np.float32)
+
+                    def body(st, kahan=kahan, k=k, exp=exp, man=man):
+                        return ring_quantized_sum(st[0], "dp", exp, man,
+                                                  use_kahan=kahan, key=k)
+
+                    fn = jax.jit(shard_map(body, mesh=mesh,
+                                           in_specs=(P("dp"),),
+                                           out_specs=P(), check_vma=False))
+                    got = np.asarray(fn(jax.device_put(
+                        jnp.asarray(stacked),
+                        NamedSharding(mesh, P("dp")))))
+                    want = np.asarray(ring_oracle_sum(
+                        jnp.asarray(stacked), exp, man, use_kahan=kahan,
+                        key=k))
+                    label = (f"W={world} ({exp},{man}) kahan={kahan} "
+                             f"sr={k is not None}")
+                    if (got.view(np.uint32) != want.view(np.uint32)).any():
+                        raise AssertionError(
+                            f"ring != oracle (bitwise) at {label}")
+                    checks.append(label)
+
+    # byte-counter invariants — the acceptance gate: >= 2x fewer wire
+    # bytes at W=8 for e5m2 vs the faithful gather path (both flavors)
+    n_big = 1_000_000
+    ring_b = ring_transport_bytes(n_big, 8, 5, 2)
+    gather_fp32 = gather_transport_bytes(n_big, 8, 5, 2, compressed=False)
+    gather_packed = gather_transport_bytes(n_big, 8, 5, 2, compressed=True)
+    assert ring_b * 2 <= gather_packed <= gather_fp32, \
+        (ring_b, gather_packed, gather_fp32)
+    # exact analytic forms: gather (W-1)*n*4 raw; ring 2*(W-1)*(n/W)*1
+    assert gather_fp32 == 7 * n_big * 4
+    assert ring_b == 2 * 7 * 125_000 * 1
+    return {"parity_checks": len(checks), "ring_bytes_w8_e5m2": ring_b,
+            "gather_bytes_w8_e5m2_fp32": gather_fp32,
+            "gather_bytes_w8_e5m2_packed": gather_packed,
+            "ring_vs_gather_fp32_ratio": round(gather_fp32 / ring_b, 2),
+            "ring_vs_gather_packed_ratio": round(gather_packed / ring_b, 2)}
+
+
+def main():
+    # env mutation ONLY on CLI entry: bench.py imports this module from an
+    # already-initialized (possibly TPU) process, which must see no
+    # platform side effects
+    _ensure_multidevice()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny-size parity + byte-counter assertions "
+                         "(CI `reduce-smoke`); no timing")
+    ap.add_argument("--elements", type=int, default=1_000_000)
+    ap.add_argument("--exp", type=int, default=5)
+    ap.add_argument("--man", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--kahan", action="store_true")
+    ap.add_argument("--rounding", default="nearest",
+                    choices=["nearest", "stochastic"])
+    args = ap.parse_args()
+
+    if args.smoke:
+        out = {"reduce_smoke": smoke(), "status": "ok"}
+    else:
+        out = {"reduction": measure(args.elements, args.exp, args.man,
+                                    args.iters, args.kahan, args.rounding)}
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
