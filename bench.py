@@ -368,6 +368,11 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 "bytes_on_wire_per_device": transport_table(
                     n_params, n_dev, 5, 2),
                 "w8_reference": transport_table(n_params, 8, 5, 2),
+                # the self-verifying transport (ISSUE 4): checksum wire
+                # bytes per device = one uint32 tag per hop + per
+                # gather row — noise next to the payload
+                "verify_tag_bytes_per_device": 4 * (2 * (n_dev - 1)
+                                                    + n_dev),
             }
         except Exception as e:  # noqa: BLE001 — extras must not kill it
             partial["reduction_note"] = (f"reduction ledger skipped: "
@@ -520,6 +525,36 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 "skip_rate": round(
                     float(r_m["guard_skipped"]) / r_steps, 4),
                 "final_loss_finite": bool(np.isfinite(float(r_m["loss"]))),
+            }
+            # verified-reduce drill (ISSUE 4): one clean verified ring
+            # step + one with an injected wire flip, so every BENCH_*
+            # capture records that the checksum layer still (a) passes
+            # clean wires and (b) catches corrupted ones
+            from cpd_tpu.compat import shard_map
+            from cpd_tpu.parallel.ring import ring_quantized_sum
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            varr = jax.device_put(
+                jnp.asarray(rng.randn(n_dev, 4096).astype(np.float32)),
+                NamedSharding(mesh, P("dp")))
+
+            def _verify_drill(fault):
+                def body(st):
+                    _, rep = ring_quantized_sum(st[0], "dp", 5, 2,
+                                                verify=True, fault=fault)
+                    return rep
+                fn = jax.jit(shard_map(body, mesh=mesh,
+                                       in_specs=(P("dp"),), out_specs=P(),
+                                       check_vma=False))
+                return {k: int(v) for k, v in fn(varr).items()}
+
+            clean = _verify_drill(None)
+            flip = _verify_drill((jnp.int32(1), jnp.int32(1 % n_dev)))
+            partial["resilience"]["verified_ring"] = {
+                "clean_ok": clean["ok"] == 1,
+                "flip_detected": flip["ok"] == 0,
+                "flip_hop_bad": flip["hop_bad"],
+                "flip_gather_bad": flip["gather_bad"],
             }
         except Exception as e:  # noqa: BLE001 — extras must not kill the run
             partial["resilience_note"] = (f"resilience extra skipped: "

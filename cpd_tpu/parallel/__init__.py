@@ -8,6 +8,8 @@ from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
 from .dist import (all_reduce_mean, broadcast_from, dist_init,
                    make_sum_gradients_fn, replicate, sum_gradients)
 from .emulate import emulate_node_reduce
+from .integrity import (digest_agree, hop_tag, make_consensus_fns,
+                        tree_digest, wire_digest)
 from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, group_split,
                    data_parallel_mesh, make_mesh)
 from .pipeline import pipeline_spmd
@@ -27,4 +29,6 @@ __all__ = [
     "kahan_quantized_sum", "ordered_quantized_sum", "quantized_sum",
     "ring_quantized_sum", "ring_oracle_sum", "ring_transport_bytes",
     "gather_transport_bytes",
+    "wire_digest", "tree_digest", "hop_tag", "digest_agree",
+    "make_consensus_fns",
 ]

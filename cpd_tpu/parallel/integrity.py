@@ -1,0 +1,186 @@
+"""In-jit wire/replica integrity: checksums for the quantized collectives.
+
+PR 3's ring transport moves bit-packed eXmY code words over
+``lax.ppermute`` — and until now nothing verified that what arrives is
+what was sent.  A single corrupted hop silently leaves replicas holding
+*different* gradient sums (the EQuARX failure mode, PAPERS.md; row 3 of
+docs/RESILIENCE.md), and because the ring's partials keep hopping, a
+corrupted partial can also land the SAME wrong sum on every replica —
+which no cross-replica comparison can see.  Two complementary checks,
+both pure jnp (they run *inside* the jitted step):
+
+* **per-wire checksums** — :func:`wire_digest`, a Fletcher-style
+  position-weighted double sum mod 65521 over the payload's words
+  (uint8 code words for packed eXmY, the raw fp32 bit patterns
+  otherwise).  The ring tags every hop payload with
+  :func:`hop_tag`(digest ^ hop-index ^ sender-rank), so a flipped bit,
+  a dropped payload, AND a stale self-echo (whose embedded digest still
+  matches its bytes!) all fail verification at the receiving hop —
+  catching exactly the corruption class cross-replica agreement cannot.
+* **cross-replica agreement** — :func:`digest_agree`: pmin == pmax of
+  the per-replica :func:`tree_digest`/:func:`wire_digest` of the
+  reduced result, so every replica learns whether *any* replica
+  disagrees (one tiny collective, two int32 scalars on the wire).
+
+On top of those, the **parameter-consensus check**
+(:func:`make_consensus_fns`) is the after-the-fact repair: a cheap
+jitted digest comparison run every N steps, and — only when it
+disagrees — a rank-0 broadcast re-sync that restores bitwise
+replication (`parallel/dist.py` broadcast_from semantics).
+
+`parallel/ring.py` consumes the checksums inside its scan body;
+`parallel/dist.py` threads the verdict out of ``sum_gradients(...,
+verify=True)``; `resilience/transport.py` turns repeated failures into
+transport downgrades.  This module imports nothing from its siblings so
+all of them can import it freely.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["wire_digest", "tree_digest", "hop_tag", "digest_agree",
+           "make_consensus_fns", "DIGEST_MOD"]
+
+# Largest prime below 2^16 (Adler-32's modulus): keeps both running sums
+# in uint16 range so the pair packs into one uint32 digest, and keeps
+# every intermediate product/sum below 2^32 (proof at each site below).
+# Plain Python ints here (NOT jnp constants): this module is imported
+# lazily from inside jitted code, and a module-level jnp array created
+# mid-trace would be a leaked tracer.
+DIGEST_MOD = 65521
+# Knuth/Murmur odd constants for the hop/sender tag mixing — any odd
+# multiplier is a bijection mod 2^32, so distinct (hop, sender) pairs
+# perturb the tag distinctly.
+_GOLD_HOP = 0x9E3779B9
+_GOLD_SRC = 0x85EBCA6B
+
+
+def _mod_sum(v: jnp.ndarray) -> jnp.ndarray:
+    """Sum of uint32 values (< DIGEST_MOD each) mod DIGEST_MOD, chunked
+    so no intermediate overflows: 4096 summands < 65521 stay under
+    4096 * 65520 < 2^28 < 2^32.  Static shapes only — jit-safe."""
+    m = jnp.uint32(DIGEST_MOD)
+    while v.size > 1:
+        pad = (-v.size) % 4096
+        if pad:
+            v = jnp.concatenate([v, jnp.zeros((pad,), jnp.uint32)])
+        v = jnp.sum(v.reshape(-1, 4096), axis=1) % m
+    return v[0] if v.size else jnp.uint32(0)
+
+
+def _digest_words(flat: jnp.ndarray) -> jnp.ndarray:
+    """Uint32 hash words for a flat payload — always the BIT PATTERN,
+    never a value cast: a value cast would truncate every |x| < 1 of a
+    bf16/f16 leaf to the same word (drift-blind digest), and
+    negative-float/signed->unsigned value conversion is
+    implementation-defined in XLA.  Sub-32-bit types bitcast to their
+    same-width unsigned then zero-extend (well-defined); 64-bit floats
+    (rare here — x64 is off repo-wide) hash their float32 narrowing,
+    deterministic though blind to sub-f32 drift."""
+    dt = flat.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        if dt.itemsize == 4:
+            return lax.bitcast_convert_type(flat, jnp.uint32)
+        if dt.itemsize == 2:
+            return lax.bitcast_convert_type(flat, jnp.uint16).astype(
+                jnp.uint32)
+        return lax.bitcast_convert_type(flat.astype(jnp.float32),
+                                        jnp.uint32)
+    if jnp.issubdtype(dt, jnp.signedinteger) and dt.itemsize <= 4:
+        unsigned = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}[dt.itemsize]
+        return lax.bitcast_convert_type(flat, unsigned).astype(jnp.uint32)
+    return flat.astype(jnp.uint32)    # unsigned/bool: zero-extend
+
+
+def wire_digest(x: jnp.ndarray) -> jnp.ndarray:
+    """Fletcher-style uint32 digest of any payload array (jit-pure).
+
+    Words are the payload's own transport units: uint8 code words for a
+    bit-packed eXmY wire, the fp32 *bit patterns* (bitcast, so -0.0/NaN
+    payloads are first-class) for an unpacked wire, the raw integer
+    values otherwise.  digest = (sum2 << 16) | sum1 with
+    sum1 = Σ wᵢ and sum2 = Σ (i+1)·wᵢ, both mod 65521 — sum1 catches
+    any changed word, the position weight in sum2 catches reorderings
+    and moved corruption that a plain sum cannot."""
+    words = _digest_words(jnp.ravel(x))
+    m = jnp.uint32(DIGEST_MOD)
+    w = words % m
+    # weights cycle 1..DIGEST_MOD; each product < 65521^2 < 2^32
+    pos = (jnp.arange(w.size, dtype=jnp.uint32) % m) + jnp.uint32(1)
+    s1 = _mod_sum(w)
+    s2 = _mod_sum((w * pos) % m)
+    return (s2 << 16) | s1
+
+
+def tree_digest(tree: Any) -> jnp.ndarray:
+    """One uint32 digest over a whole pytree (FNV-style fold of the
+    per-leaf :func:`wire_digest`s in tree-flatten order) — the replica
+    fingerprint the parameter-consensus check compares."""
+    d = jnp.uint32(0x811C9DC5)
+    for leaf in jax.tree.leaves(tree):
+        d = (d * jnp.uint32(0x01000193)) ^ wire_digest(leaf)
+    return d
+
+
+def hop_tag(payload: jnp.ndarray, hop: jnp.ndarray,
+            src_rank: jnp.ndarray) -> jnp.ndarray:
+    """The tagged checksum a ring hop rides alongside its payload:
+    digest ^ mix(hop index) ^ mix(sender rank).  The hop/sender folds
+    are what catch a STALE wire — a replayed buffer carries a digest
+    that still matches its own bytes, but its (hop, sender) provenance
+    cannot match what the receiver expects."""
+    return (wire_digest(payload)
+            ^ (jnp.asarray(hop).astype(jnp.uint32)
+               * jnp.uint32(_GOLD_HOP))
+            ^ (jnp.asarray(src_rank).astype(jnp.uint32)
+               * jnp.uint32(_GOLD_SRC)))
+
+
+def digest_agree(digest: jnp.ndarray, axis_name) -> jnp.ndarray:
+    """int32 1/0: do all replicas along `axis_name` (a name or a tuple
+    of names) hold this same digest?  pmin == pmax — every replica
+    learns whether ANY replica disagrees, for two scalars on the wire."""
+    d = lax.bitcast_convert_type(digest, jnp.int32)
+    return (lax.pmin(d, axis_name) == lax.pmax(d, axis_name)).astype(
+        jnp.int32)
+
+
+def _bcast(x: jnp.ndarray, axis_name: str, src: int = 0) -> jnp.ndarray:
+    # dist.broadcast_from, inlined so this module stays import-leaf
+    return lax.all_gather(x, axis_name, axis=0, tiled=False)[src]
+
+
+def make_consensus_fns(mesh, axis_name: str = "dp") -> Tuple:
+    """Build the periodic parameter-consensus pair ``(check_fn,
+    resync_fn)`` over a replicated pytree (a TrainState, a param tree).
+
+    ``check_fn(tree) -> int32 1/0``: every device digests ITS local
+    copy of the nominally-replicated tree; agreement is the pmin==pmax
+    of those digests.  Cheap: O(bytes) local hashing, two scalars on
+    the wire.
+
+    ``resync_fn(tree) -> tree``: rank 0's bytes broadcast to every
+    replica (one all_gather per leaf) — after it, the replicas are
+    bitwise identical regardless of how far they had drifted.  Call it
+    only when ``check_fn`` disagreed (or after a detected wire fault);
+    the split into two jitted programs is what keeps the healthy-path
+    cost at the digest alone."""
+    from ..compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def check(tree):
+        return digest_agree(tree_digest(tree), axis_name)
+
+    def resync(tree):
+        return jax.tree.map(lambda x: _bcast(x, axis_name, 0), tree)
+
+    check_fn = jax.jit(shard_map(check, mesh=mesh, in_specs=(P(),),
+                                 out_specs=P(), check_vma=False))
+    resync_fn = jax.jit(shard_map(resync, mesh=mesh, in_specs=(P(),),
+                                  out_specs=P(), check_vma=False))
+    return check_fn, resync_fn

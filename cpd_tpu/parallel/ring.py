@@ -51,6 +51,30 @@ The per-hop body is one fused quantize-accumulate kernel on TPU
 (ops/quantize.quantize_add_pallas, sharing `cast_body` with everything
 else); elsewhere the XLA composition of the same ops (bit-identical —
 same body).
+
+Wire integrity (ISSUE 4)
+------------------------
+
+``verify=True`` turns on the self-verifying transport: every hop
+payload rides a tagged Fletcher checksum (parallel/integrity.hop_tag —
+digest ^ hop-index ^ sender-rank, so flipped bits, dropped payloads AND
+coherent stale self-echoes all fail at the receiving hop), the final
+all-gather rows are tag-checked the same way, and the full reduced
+vector's digest is pmin/pmax-agreed across replicas.  The function then
+returns ``(vec, report)`` with replicated int32 scalars ``hop_bad`` /
+``gather_bad`` (psum'd mismatch counts), ``agree`` and ``ok``.  The
+scan-site checksums matter because a corrupted partial keeps hopping
+and lands the SAME wrong sum on every replica — invisible to any
+cross-replica comparison; the agreement digest matters because a
+gather-site corruption diverges one replica — invisible to the hops it
+never rode.
+
+``fault=(code, rank)`` injects the matching deterministic wire faults
+(resilience/inject.WIRE_KINDS: 1=flip one bit, 2=stale self-echo,
+3=drop) into the first reduce-scatter hop AND the all-gather wire on
+that rank — the attack exists independently of the defense, so a run
+with ``verify=False`` silently computes a wrong (or divergent) sum,
+which is exactly the EQuARX failure mode the checksums exist to catch.
 """
 
 from __future__ import annotations
@@ -121,6 +145,33 @@ def _hop_kahan(q, res, comp, g, t, offs):
     return tmp, comp
 
 
+def _flip_first_bit(x: jnp.ndarray) -> jnp.ndarray:
+    """The minimal wire corruption: the lowest bit of the first word of
+    a payload (uint8 code word or fp32 bit pattern) flipped."""
+    flat = jnp.ravel(x)
+    if flat.dtype == jnp.uint8:
+        flat = flat.at[0].set(flat[0] ^ jnp.uint8(1))
+    else:
+        b = lax.bitcast_convert_type(flat, jnp.uint32)
+        b = b.at[0].set(b[0] ^ jnp.uint32(1))
+        flat = lax.bitcast_convert_type(b, x.dtype)
+    return flat.reshape(x.shape)
+
+
+def _apply_hop_fault(recv, rtag, sent, stag, code, active):
+    """Corrupt a received (payload, tag) per the wire-fault code when
+    `active` (resilience/inject.WIRE_KINDS).  ``stale`` replays this
+    rank's own just-sent payload WITH its coherent tag — the corruption
+    a bare payload checksum cannot catch (the tag's sender-rank fold
+    does); ``flip``/``drop`` corrupt the payload under the ridden tag."""
+    stale = active & (code == 2)
+    recv = jnp.where(stale, sent, recv)
+    rtag = jnp.where(stale, stag, rtag)
+    recv = jnp.where(active & (code == 1), _flip_first_bit(recv), recv)
+    recv = jnp.where(active & (code == 3), jnp.zeros_like(recv), recv)
+    return recv, rtag
+
+
 def _static_world(axis_name, world: Optional[int]) -> int:
     if world is not None:
         return int(world)
@@ -139,7 +190,9 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
                        offset_start: int = 0, packed: bool = True,
                        world: Optional[int] = None,
                        fused: Optional[bool] = None,
-                       interpret: bool = False) -> jnp.ndarray:
+                       interpret: bool = False,
+                       verify: bool = False,
+                       fault: Optional[tuple] = None):
     """Ordered quantized SUM of per-rank flat fp32 vectors over `axis_name`
     via a ppermute ring — call inside shard_map.
 
@@ -163,6 +216,17 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
                    Kahan's 4-cast body stays XLA).  Default: TPU backend
                    only.  `interpret` runs that kernel in interpret mode
                    (CPU tests).
+    verify       → self-verifying transport (module docstring): returns
+                   ``(vec, report)`` with replicated int32 scalars
+                   {hop_bad, gather_bad, agree, ok}.  The clean-path
+                   result is BITWISE identical to verify=False — the
+                   checksums observe the wire, they never touch it.
+    fault        → ``(code, rank)`` int32 scalars injecting a
+                   deterministic wire fault (inject.WIRE_KINDS; 0 = no
+                   fault) into the first reduce-scatter hop and the
+                   all-gather wire on that rank.  Applied whether or
+                   not `verify` is on — the attack does not need the
+                   defense's permission.
     """
     if isinstance(axis_name, (tuple, list)):
         raise ValueError("ring transport runs over exactly one mesh axis; "
@@ -182,6 +246,10 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
     padded = pad_to_world(flat, w)
     chunk = padded.shape[0] // w if w else 0
     if n == 0:
+        if verify:
+            i0, i1 = jnp.zeros([], jnp.int32), jnp.ones([], jnp.int32)
+            return flat, {"hop_bad": i0, "gather_bad": i0,
+                          "agree": i1, "ok": i1}
         return flat
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % w) for i in range(w)]
@@ -230,22 +298,90 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
     zero = jnp.zeros((chunk,), jnp.float32)
     res, comp = accum(zero, zero, jnp.int32(0), chunk_at(0))
 
-    def body(carry, t):
-        res, comp = from_wire(lax.ppermute(carry, axis_name, perm))
-        res, comp = accum(res, comp, t, chunk_at(t))
-        return to_wire(res, comp), None
+    if not verify and fault is None:
+        # the plain transport, untouched: zero checksum work, and the
+        # oracle-parity tests gate this exact path bitwise
+        def body(carry, t):
+            res, comp = from_wire(lax.ppermute(carry, axis_name, perm))
+            res, comp = accum(res, comp, t, chunk_at(t))
+            return to_wire(res, comp), None
 
-    carry, _ = lax.scan(body, to_wire(res, comp),
-                        jnp.arange(1, w, dtype=jnp.int32))
-    res, _ = from_wire(carry)
-    # res is now the reduced chunk `rank`; ring all-gather of the packed
-    # chunks rebuilds the full vector (XLA lowers all_gather as a ring on
-    # the TPU torus, so the wire cost is the (W-1) chunk hops accounted in
-    # ring_transport_bytes — with the payload still bit-packed).
-    wire = pack_exmy(res, exp, man) if packed else res
-    gathered = lax.all_gather(wire, axis_name, axis=0, tiled=False)
-    full = unpack_exmy(gathered, exp, man) if packed else gathered
-    return full.reshape(-1)[:n]
+        carry, _ = lax.scan(body, to_wire(res, comp),
+                            jnp.arange(1, w, dtype=jnp.int32))
+        res, _ = from_wire(carry)
+        # res is now the reduced chunk `rank`; ring all-gather of the
+        # packed chunks rebuilds the full vector (XLA lowers all_gather
+        # as a ring on the TPU torus, so the wire cost is the (W-1)
+        # chunk hops accounted in ring_transport_bytes — with the
+        # payload still bit-packed).
+        wire = pack_exmy(res, exp, man) if packed else res
+        gathered = lax.all_gather(wire, axis_name, axis=0, tiled=False)
+        full = unpack_exmy(gathered, exp, man) if packed else gathered
+        return full.reshape(-1)[:n]
+
+    # --- verified / fault-injected transport (module docstring) ------
+    from .integrity import digest_agree, hop_tag, wire_digest
+    rank_i = rank.astype(jnp.int32)
+    f_code = (jnp.asarray(fault[0], jnp.int32) if fault is not None
+              else jnp.zeros([], jnp.int32))
+    f_rank = (jnp.asarray(fault[1], jnp.int32) if fault is not None
+              else jnp.zeros([], jnp.int32))
+    on_me = (f_code > 0) & (rank_i == f_rank)
+
+    def vbody(carry, t):
+        wire, tag, bad = carry
+        recv = lax.ppermute(wire, axis_name, perm)
+        rtag = lax.ppermute(tag, axis_name, perm)
+        recv, rtag = _apply_hop_fault(recv, rtag, wire, tag, f_code,
+                                      on_me & (t == jnp.int32(1)))
+        # the left neighbor built its tag for exactly this (hop, sender)
+        bad = bad + (hop_tag(recv, t, jnp.mod(rank_i - 1, w))
+                     != rtag).astype(jnp.int32)
+        res, comp = from_wire(recv)
+        res, comp = accum(res, comp, t, chunk_at(t))
+        new_wire = to_wire(res, comp)
+        return (new_wire, hop_tag(new_wire, t + 1, rank_i), bad), None
+
+    wire0 = to_wire(res, comp)
+    (wire_f, _, hop_bad), _ = lax.scan(
+        vbody, (wire0, hop_tag(wire0, jnp.int32(1), rank_i),
+                jnp.zeros([], jnp.int32)),
+        jnp.arange(1, w, dtype=jnp.int32))
+    res, _ = from_wire(wire_f)
+
+    # all-gather wire, row-tagged: row i's tag is built by rank i with
+    # hop index 0 (scan hops use t >= 1, so no aliasing)
+    gwire = pack_exmy(res, exp, man) if packed else res
+    gtag = hop_tag(gwire, jnp.int32(0), rank_i)
+    gathered = lax.all_gather(gwire, axis_name, axis=0, tiled=False)
+    gtags = lax.all_gather(gtag, axis_name, axis=0, tiled=False)
+    # gather-site fault: rank k's RECEIVED copy of row (k+1) mod W is
+    # corrupted — only that replica's rebuilt vector diverges, which is
+    # the case the cross-replica agreement digest exists for
+    j = jnp.mod(rank_i + 1, w)
+    row = jnp.take(gathered, j, axis=0)
+    new_row = jnp.where(f_code == 2, gwire, row)       # stale: own row
+    new_row = jnp.where(f_code == 1, _flip_first_bit(row), new_row)
+    new_row = jnp.where(f_code == 3, jnp.zeros_like(row), new_row)
+    gathered = jnp.where(on_me, gathered.at[j].set(new_row), gathered)
+    gtags = jnp.where(on_me & (f_code == 2), gtags.at[j].set(gtag),
+                      gtags)
+    row_tags = jax.vmap(
+        lambda r, i: hop_tag(r, jnp.int32(0), i))(
+            gathered, jnp.arange(w, dtype=jnp.int32))
+    gather_bad = jnp.sum((row_tags != gtags).astype(jnp.int32))
+    full = (unpack_exmy(gathered, exp, man) if packed
+            else gathered).reshape(-1)[:n]
+    if not verify:
+        return full
+    report = {
+        "hop_bad": lax.psum(hop_bad, axis_name),
+        "gather_bad": lax.psum(gather_bad, axis_name),
+        "agree": digest_agree(wire_digest(full), axis_name),
+    }
+    report["ok"] = ((report["hop_bad"] == 0) & (report["gather_bad"] == 0)
+                    & (report["agree"] == 1)).astype(jnp.int32)
+    return full, report
 
 
 def ring_oracle_sum(stacked: jnp.ndarray, exp: int, man: int, *,

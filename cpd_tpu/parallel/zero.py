@@ -68,6 +68,17 @@ __all__ = ["Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd",
 
 
 class Zero1State(NamedTuple):
+    """Flat ZeRO optimizer state.
+
+    Elastic-restart invariant (ISSUE 4): elements of ``momentum`` in the
+    world-size pad (past the total parameter count) hold EXACT zeros,
+    forever — `pad_to_world` zero-fills them and the update rule keeps
+    them there (pad gradients are exact zeros, so ``m*0 + 0 == 0``).
+    `train/checkpoint.py::restore_latest_valid(world=W')` relies on it:
+    trimming the pad and re-padding through `pad_to_world` at a NEW
+    world size is then bitwise-faithful, so a checkpoint written at
+    world W resumes at W' (`export_state`'s portable trim is the same
+    contract, applied eagerly)."""
     step: jnp.ndarray          # replicated scalar int32
     momentum: jnp.ndarray      # flat fp32, global (W*S,), per-rank (S,)
 
@@ -214,7 +225,13 @@ class _Zero1:
         """Padded (W*S,) momentum -> PORTABLE (total,) layout: the
         world-size pad is trimmed so the checkpoint restores at ANY
         device count (and its momentum reads as the plain flat vector
-        by any non-ZeRO consumer)."""
+        by any non-ZeRO consumer).
+
+        A PADDED snapshot (e.g. a preemption save that skipped this
+        conversion) is equally world-portable now: `CheckpointManager`
+        records the padded length in the sidecar and
+        `restore_latest_valid(world=W')` performs this same trim +
+        re-pad lazily at restore (the Zero1State elastic invariant)."""
         opt: Zero1State = state.opt_state
         total = sum(l.size for l in jax.tree.leaves(state.params))
         return state.replace(opt_state=Zero1State(

@@ -20,27 +20,34 @@ GradScaler-style skip); this package adds
   wedge;
 * **sentinel** — :class:`DivergenceSentinel`: rolling-window loss
   blow-up detection;
+* **transport** — :class:`TransportSupervisor`: the degraded-transport
+  ladder (ring -> faithful -> fp32) driven by the self-verifying
+  reduce's checksums (parallel/integrity.py), with bounded same-step
+  retries and probation back up;
 * **loop** — :func:`run_guarded`: the defenses composed around any
   ``(state, x, y) -> (state, metrics)`` step, with integrity-checked
-  checkpoint rollback and bounded, re-seeded retries.
+  checkpoint rollback, bounded re-seeded retries, verified-reduce
+  supervision and periodic replica-consensus repair.
 
 The defense matrix (fault -> detector -> recovery) is documented in
 docs/RESILIENCE.md.
 """
 
 from .inject import (FaultPlan, FaultSpec, InjectedPreemption, Injector,
-                     with_fault_injection)
+                     report_unfired, with_fault_injection)
 from .guard import (GradGuardState, describe_culprit, find_guard,
                     guard_metrics, with_grad_guard)
 from .sentinel import DivergenceSentinel
+from .transport import StepTable, TransportSupervisor, level_reduce_kwargs
 from .watchdog import StepWatchdog
 from .loop import GuardedReport, run_guarded
 
 __all__ = [
     "FaultPlan", "FaultSpec", "Injector", "InjectedPreemption",
-    "with_fault_injection",
+    "with_fault_injection", "report_unfired",
     "GradGuardState", "with_grad_guard", "guard_metrics", "find_guard",
     "describe_culprit",
     "DivergenceSentinel", "StepWatchdog",
+    "TransportSupervisor", "StepTable", "level_reduce_kwargs",
     "run_guarded", "GuardedReport",
 ]

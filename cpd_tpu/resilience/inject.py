@@ -27,8 +27,17 @@ by :meth:`FaultPlan.to_json`):
 
 e.g. ``grad_nan@3;stall@5:1.5;ckpt_truncate@6;loss_spike@8:1e6``.
 ``arg`` means: shard index for ``grad_*`` (-1 = every shard, the
-default), seconds for ``stall``, multiplier for ``loss_spike`` /
-``batch_scale``; ignored elsewhere.
+default), RANK for ``wire_*`` (-1 = rank 0), seconds for ``stall``,
+multiplier for ``loss_spike`` / ``batch_scale``; ignored elsewhere.
+
+A third executor consumes the ``wire_*`` kinds (``wire_flip@s:k``,
+``wire_stale@s:k``, ``wire_drop@s:k``): the ring transport itself
+(parallel/ring.py), which corrupts the bit-packed hop payload inside
+its scan body and the all-gather wire on rank ``k`` at step ``s`` —
+deterministic (same seed/plan ⇒ same corruption), detected by the
+integrity checksums (parallel/integrity.py) when the reduce runs with
+``verify=True``.  :meth:`FaultPlan.wire_schedule` compiles them into
+the dense (codes, ranks) table the step builders bake in.
 
 ``step`` convention: the 0-based optimizer-UPDATE index — one clock for
 both executors, so ``grad_nan@3`` and ``stall@3`` hit the same physical
@@ -50,10 +59,14 @@ from typing import Any, Iterable, NamedTuple, Optional
 import numpy as np
 
 __all__ = ["FaultSpec", "FaultPlan", "Injector", "InjectedPreemption",
-           "with_fault_injection", "GRAD_KINDS", "HOST_KINDS"]
+           "with_fault_injection", "report_unfired", "GRAD_KINDS",
+           "HOST_KINDS", "WIRE_KINDS"]
 
 # jit-level kinds -> corruption opcode in the compiled fault table
 GRAD_KINDS = {"grad_nan": 1, "grad_inf": 2, "grad_blowup": 3}
+# wire-level kinds -> corruption opcode inside ring_quantized_sum
+# (parallel/ring.py _apply_hop_fault / the gather-wire fault)
+WIRE_KINDS = {"wire_flip": 1, "wire_stale": 2, "wire_drop": 3}
 # host-level kinds, executed by the Injector around the step call
 HOST_KINDS = frozenset({
     "batch_nan",       # poison one element of the first float batch leaf
@@ -66,7 +79,7 @@ HOST_KINDS = frozenset({
     "ckpt_bitflip",    # flip one byte in the newest checkpoint
     "loss_spike",      # multiply the observed loss metric by `arg`
 })
-_ALL_KINDS = frozenset(GRAD_KINDS) | HOST_KINDS
+_ALL_KINDS = frozenset(GRAD_KINDS) | HOST_KINDS | frozenset(WIRE_KINDS)
 
 
 class InjectedPreemption(BaseException):
@@ -178,6 +191,9 @@ class FaultPlan:
     def grad_faults(self) -> tuple:
         return tuple(f for f in self.faults if f.kind in GRAD_KINDS)
 
+    def wire_faults(self) -> tuple:
+        return tuple(f for f in self.faults if f.kind in WIRE_KINDS)
+
     def host_faults(self) -> dict:
         """step -> [FaultSpec] for the host-level kinds."""
         out: dict = {}
@@ -197,6 +213,20 @@ class FaultPlan:
                 codes[f.step] = GRAD_KINDS[f.kind]
                 shards[f.step] = int(f.arg)
         return codes, shards
+
+    def wire_schedule(self, n_steps: int):
+        """Dense (codes, ranks) int32 tables for the ring transport's
+        in-jit wire faults; entry ``i`` drives optimizer update ``i``
+        (the same clock as `grad_schedule`).  ``arg`` is the target
+        rank (-1 -> rank 0); at most one wire fault per step (the last
+        spec wins)."""
+        codes = np.zeros((max(n_steps, 1),), np.int32)
+        ranks = np.zeros((max(n_steps, 1),), np.int32)
+        for f in self.wire_faults():
+            if f.step < n_steps:
+                codes[f.step] = WIRE_KINDS[f.kind]
+                ranks[f.step] = max(int(f.arg), 0)
+        return codes, ranks
 
 
 # ---------------------------------------------------------------------------
@@ -414,3 +444,43 @@ class Injector:
                 fh.seek(size // 2)
                 fh.write(bytes([byte[0] ^ 0xFF]))
         return True
+
+
+def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
+                   = None, meter=None, rank: int = 0,
+                   wire_armed: bool = True) -> list:
+    """The ONE end-of-run check every loop calls: which planned faults
+    never fired?  A chaos run that silently skipped a fault proves
+    nothing — the usual causes are a plan step beyond the run's
+    ``n_steps`` and a fault kind on a hook the run never wired, both
+    silent user errors until this surfaces them.
+
+    Covers the host-level one-shots (``Injector.unfired()``), the
+    jit-level grad/wire specs scheduled past the end of the compiled
+    fault table (when ``n_steps`` is given — the schedule builders drop
+    those without a sound), and — when the caller passes
+    ``wire_armed=False`` — EVERY wire spec, because the run's reduction
+    never baked in the wire table (e.g. ``wire_flip`` planned for a
+    faithful-mode run; the trainers compute this from their transport
+    config).  Bumps the meter's ``faults_unfired`` counter and warns on
+    rank 0; returns the sorted leftover list (empty = every planned
+    fault fired)."""
+    if injector is None:
+        return []
+    leftover = list(injector.unfired())
+    for f in injector.plan.grad_faults() + injector.plan.wire_faults():
+        past = n_steps is not None and f.step >= n_steps
+        unwired = not wire_armed and f.kind in WIRE_KINDS
+        if past or unwired:
+            leftover.append(f)
+    leftover = sorted(set(leftover))
+    if leftover:
+        if meter is not None:
+            meter.bump("faults_unfired", len(leftover))
+        if rank == 0:
+            import sys
+            print(f"=> fault plan: {len(leftover)} spec(s) never fired "
+                  f"(scheduled past the end of the run, or on a hook "
+                  f"this loop does not wire): {leftover}",
+                  file=sys.stderr)
+    return leftover

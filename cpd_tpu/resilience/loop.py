@@ -7,9 +7,10 @@ defenses natively around their own validation/checkpoint cadence
 the recovery semantics here and there in lockstep.  One iteration:
 
     preempt? -> batch (drop/dup/poison) -> [watchdog armed: stall? ->
-    step -> metric device-sync] -> counters -> loss fault -> sentinel
-    -> (rollback | advance) -> periodic integrity-checked save
-    -> post-save checkpoint corruption
+    step -> metric device-sync] -> verified-reduce supervision
+    (retry/downgrade/re-sync) -> counters -> loss fault -> sentinel
+    -> (rollback | advance) -> periodic parameter consensus
+    -> periodic integrity-checked save -> post-save ckpt corruption
 
 Recovery policies, in the order they can fire:
 
@@ -18,11 +19,24 @@ Recovery policies, in the order they can fire:
   and exits cleanly (``aborted='watchdog'``).
 * **injected preemption** — same checkpoint-and-exit contract as the
   SIGTERM PreemptionGuard path (``aborted='preempted'``).
+* **wire fault** — the step's verified reduce reported ``reduce_ok ==
+  0`` (hop checksum / gather-row / replica-agreement failure,
+  parallel/integrity.py): the corrupted update is DISCARDED (the
+  pre-step state is still good — build steps with ``donate=False``)
+  and the `TransportSupervisor` decides: bounded retry on the same
+  batch, or a transport downgrade (ring -> faithful -> fp32) with a
+  rank-0 replica re-sync before the retry, or — failing at the bottom
+  rung — ``aborted='transport'``.  Probation upgrades ride the same
+  hook on clean steps.
 * **divergence** — the sentinel tripped: restore the newest *valid*
   checkpoint (integrity digests consulted; corrupt steps are skipped
-  and counted), re-seed the data order so the replay does not march
-  into the identical batch sequence, back off, and retry — at most
-  ``max_rollbacks`` times, then ``aborted='diverged'``.
+  and counted; a restore with NO recorded digest is counted as
+  ``ckpts_unverified``), re-seed the data order so the replay does not
+  march into the identical batch sequence, back off, and retry — at
+  most ``max_rollbacks`` times, then ``aborted='diverged'``.
+* **replica drift** — every ``consensus_every`` accepted steps the
+  cheap parameter-consensus digest runs; a mismatch re-syncs the state
+  from rank 0 (bitwise) and counts ``resyncs``.
 
 Anomalous gradient steps (non-finite / spike / replica disagreement)
 never reach this file: the GradGuard optax wrapper already skipped them
@@ -36,7 +50,6 @@ tests/test_resilience.py.
 from __future__ import annotations
 
 import dataclasses
-import sys
 import time
 from typing import Callable, Optional
 
@@ -49,7 +62,8 @@ __all__ = ["run_guarded", "GuardedReport"]
 class GuardedReport:
     completed: bool
     final_step: int
-    aborted: Optional[str]          # None | watchdog | preempted | diverged
+    aborted: Optional[str]   # None | watchdog | preempted | diverged
+                             # | transport
     counters: dict                  # ResilienceMeter.as_dict()
     events: list                    # deterministic (what, step, ...) log
 
@@ -59,7 +73,11 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 sentinel=None, watchdog=None, meter=None,
                 ckpt_every: int = 0, max_rollbacks: int = 2,
                 backoff_secs: float = 0.0, rank: int = 0,
-                on_step: Optional[Callable] = None):
+                on_step: Optional[Callable] = None,
+                supervisor=None, step_for_level=None,
+                resync_fn: Optional[Callable] = None,
+                consensus_fn: Optional[Callable] = None,
+                consensus_every: int = 0):
     """Drive ``step_fn`` to ``n_steps`` under the defense stack.
 
     step_fn: jitted ``(state, *batch) -> (state, metrics)`` with a
@@ -73,16 +91,35 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
     manager: CheckpointManager (integrity on) — required for
         ``ckpt_every`` and for rollback; without it a divergence aborts.
     on_step: optional ``(step, metrics) -> None`` observer (logging).
+    supervisor: resilience.transport.TransportSupervisor — enables the
+        degraded-transport ladder; requires ``step_for_level``, a
+        ``level -> step_fn`` mapping (transport.StepTable) whose steps
+        were built with ``verify_reduce=True`` and ``donate=False``
+        (the discard-and-retry needs the pre-step buffers alive).
+    resync_fn: jitted ``state -> state`` rank-0 broadcast
+        (parallel.integrity.make_consensus_fns) — run after every
+        transport downgrade and on consensus mismatch, so replicas are
+        bitwise identical before the retry.
+    consensus_fn / consensus_every: the periodic parameter-consensus
+        digest check (``state -> int32 agree``) and its cadence in
+        accepted steps (0 = off; requires resync_fn).
 
     Returns ``(state, GuardedReport)``; the report's ``events`` list is
     the determinism witness.
     """
     from ..train.metrics import ResilienceMeter
     meter = meter if meter is not None else ResilienceMeter()
+    if supervisor is not None and step_for_level is None:
+        raise ValueError("supervisor requires step_for_level (a level -> "
+                         "step mapping, e.g. transport.StepTable)")
+    if consensus_every and (consensus_fn is None or resync_fn is None):
+        raise ValueError("consensus_every needs both consensus_fn and "
+                         "resync_fn")
     events: list = []
     rollbacks = 0
     reseed = 0
     prev_batch = None
+    retry_batch = None       # set when a verify failure replays a step
     it = int(state.step)
 
     def save(step, tag):
@@ -96,14 +133,12 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
             events.append(("ckpt_corrupted", step))
 
     def finish(aborted):
-        if injector is not None and rank == 0:
-            leftover = injector.unfired()
-            if leftover:
-                # a chaos run that silently skipped a fault proves
-                # nothing — make the gap visible (expected when the run
-                # aborted early, suspicious otherwise)
-                print(f"=> fault plan: {len(leftover)} spec(s) never "
-                      f"fired: {leftover}", file=sys.stderr)
+        # a chaos run that silently skipped a fault proves nothing —
+        # count + warn (expected when the run aborted early, a silent
+        # user error otherwise); the jit-level specs past n_steps are
+        # covered too (inject.report_unfired)
+        from .inject import report_unfired
+        report_unfired(injector, n_steps=n_steps, meter=meter, rank=rank)
         return state, GuardedReport(
             completed=aborted is None and it >= n_steps,
             final_step=it, aborted=aborted, counters=meter.as_dict(),
@@ -111,33 +146,41 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
 
     while it < n_steps:
         try:
-            if injector is not None:
-                injector.maybe_preempt(it)
-
-            # --- data motion, with drop/dup faults -------------------
-            action = (injector.batch_action(it)
-                      if injector is not None else None)
-            if action == "dup" and prev_batch is not None:
-                batch = prev_batch
-                meter.bump("batches_duplicated")
-                events.append(("dup", it))
-            elif action == "drop":
-                # this batch never arrives; train on the next one
-                meter.bump("batches_dropped")
-                events.append(("drop", it))
-                batch = next_batch(it + n_steps, reseed)
+            if retry_batch is not None:
+                # a verify-failed step replays on the SAME batch; the
+                # host injector hooks already fired for it (one-shot)
+                batch = retry_batch
+                retry_batch = None
             else:
-                batch = next_batch(it, reseed)
-            if injector is not None:
-                batch = injector.corrupt_batch(it, batch)
-            prev_batch = batch
+                if injector is not None:
+                    injector.maybe_preempt(it)
+
+                # --- data motion, with drop/dup faults ---------------
+                action = (injector.batch_action(it)
+                          if injector is not None else None)
+                if action == "dup" and prev_batch is not None:
+                    batch = prev_batch
+                    meter.bump("batches_duplicated")
+                    events.append(("dup", it))
+                elif action == "drop":
+                    # this batch never arrives; train on the next one
+                    meter.bump("batches_dropped")
+                    events.append(("drop", it))
+                    batch = next_batch(it + n_steps, reseed)
+                else:
+                    batch = next_batch(it, reseed)
+                if injector is not None:
+                    batch = injector.corrupt_batch(it, batch)
+                prev_batch = batch
 
             # --- the blocking region, under the watchdog --------------
             if watchdog is not None:
                 watchdog.arm(it, counters=meter.as_dict())
             if injector is not None:
                 injector.maybe_stall(it)
-            new_state, metrics = step_fn(state, *batch)
+            fn = (step_for_level[supervisor.mode]
+                  if supervisor is not None else step_fn)
+            new_state, metrics = fn(state, *batch)
             loss = float(metrics["loss"])      # device sync
             if watchdog is not None:
                 watchdog.disarm()
@@ -161,6 +204,45 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
             save(it, "ckpt_on_preempt")
             return finish("preempted")
 
+        # --- verified-reduce supervision (ISSUE 4) --------------------
+        # reduce_ok is the step's replicated integrity verdict (hop
+        # checksums + gather rows + replica agreement).  On failure the
+        # update in new_state came from a corrupted reduce: DISCARD it
+        # (state is the untouched pre-step pytree) and let the
+        # supervisor pick retry / downgrade / give-up.  Detection is by
+        # checksum at the faulted step itself — never by watching the
+        # loss diverge later.
+        if supervisor is not None:
+            if float(metrics.get("reduce_ok", 1.0)) == 0.0:
+                meter.bump("wire_faults_detected")
+                events.append(("wire_fault", it, supervisor.mode,
+                               int(float(metrics.get("reduce_hop_bad",
+                                                     0.0))),
+                               int(float(metrics.get("reduce_gather_bad",
+                                                     0.0)))))
+                action = supervisor.on_failure(it)
+                if action == "give_up":
+                    # fp32 psum disagreeing is not a transport problem
+                    return finish("transport")
+                if action == "downgrade":
+                    meter.bump("transport_downgrades")
+                    events.append(("transport_down", it, supervisor.mode))
+                    if resync_fn is not None:
+                        # a divergent replica may have leaked (gather-
+                        # site corruption); make replication bitwise
+                        # again before the retry
+                        state = resync_fn(state)
+                        meter.bump("resyncs")
+                        events.append(("resync", it))
+                else:
+                    meter.bump("reduce_retries")
+                    events.append(("reduce_retry", it))
+                retry_batch = batch
+                continue
+            if supervisor.on_success(it) == "upgrade":
+                meter.bump("transport_upgrades")
+                events.append(("transport_up", it, supervisor.mode))
+
         meter.observe_metrics(metrics)
         if injector is not None:
             loss = injector.fault_loss(it, loss)
@@ -183,6 +265,11 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
             for bad in res.skipped:
                 meter.bump("ckpts_invalid")
                 events.append(("ckpt_invalid", bad))
+            if res.verified is None:
+                # restored, but nothing could vouch for the bytes —
+                # the silent-integrity gap, made loud
+                meter.bump("ckpts_unverified")
+                events.append(("ckpt_unverified", res.step))
             state = res.state
             it = int(res.step)
             rollbacks += 1
@@ -197,6 +284,13 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
 
         state = new_state
         it += 1
+        if consensus_every and it % consensus_every == 0 and it < n_steps:
+            # cheap periodic drift repair: one digest collective; the
+            # broadcast only runs when replicas actually disagree
+            if int(consensus_fn(state)) == 0:
+                state = resync_fn(state)
+                meter.bump("resyncs")
+                events.append(("consensus_resync", it))
         if ckpt_every and it % ckpt_every == 0 and it < n_steps:
             save(it, "ckpt")
 

@@ -1,0 +1,146 @@
+"""Degraded-transport supervision for the verified quantized reduce.
+
+When ``sum_gradients(..., verify=True)`` reports a failed step (hop
+checksum mismatch, gather-row mismatch, or cross-replica disagreement —
+parallel/integrity.py), something between the replicas is lying.  The
+response ladder, encoded here as a host-side state machine:
+
+    ring ──(retries exhausted)──> faithful ──(again)──> fp32
+      ^                               |                   |
+      └──── N clean steps ────────────┴──── N clean ──────┘
+
+* **retry** — the step is re-run on the SAME batch and state (a
+  transient wire fault clears; a deterministic injected one does not,
+  which is what forces the next rung).  Bounded by ``max_retries``.
+* **downgrade** — one rung down the transport ladder: the ring's custom
+  wire is abandoned for the faithful gather (XLA's own all_gather, no
+  eXmY hop payloads), and the faithful gather for a plain fp32 psum —
+  each rung trades wire efficiency for a simpler, harder-to-corrupt
+  transport while keeping the run ALIVE.
+* **probation** — after ``probation`` consecutive clean verified steps
+  at a degraded level, move one rung back up; a healthy wire earns its
+  fast transport back.
+* **give_up** — a failure at the bottom rung (fp32 psum disagreeing
+  across replicas) is not a transport problem; the loop aborts.
+
+The supervisor is pure host state — no RNG, no wall clock — so a run
+under a deterministic ``FaultPlan`` replays its exact transition
+sequence (asserted in tests/test_resilience.py).  `run_guarded`
+(resilience/loop.py) drives it; the example trainers wire the same
+ladder around their own loops; every transition is counted in
+``ResilienceMeter`` and printed as a trainer log line.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+__all__ = ["TransportSupervisor", "StepTable", "level_reduce_kwargs"]
+
+
+class TransportSupervisor:
+    """The ring -> faithful -> fp32 degradation ladder (module docstring).
+
+    ``on_failure(step)`` -> "retry" | "downgrade" | "give_up";
+    ``on_success(step)`` -> "upgrade" | None.  ``mode`` names the level
+    whose step function the loop should run next; ``transitions`` is the
+    deterministic (step, from, to) log the chaos tests assert on.
+    """
+
+    LEVELS = ("ring", "faithful", "fp32")
+
+    def __init__(self, start: str = "ring", max_retries: int = 1,
+                 probation: int = 8):
+        if start not in self.LEVELS:
+            raise ValueError(f"unknown transport level {start!r}; know "
+                             f"{self.LEVELS}")
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        if probation < 1:
+            raise ValueError(f"probation must be >= 1, got {probation}")
+        self._home = self.LEVELS.index(start)   # the configured level:
+        self._level = self._home                # probation returns HERE,
+        self.max_retries = max_retries          # never above it
+        self.probation = probation
+        self.retries = 0          # consecutive failures at this step
+        self.clean = 0            # consecutive clean steps at this level
+        self.transitions: list = []   # (step, from_level, to_level)
+
+    @property
+    def mode(self) -> str:
+        return self.LEVELS[self._level]
+
+    @property
+    def home(self) -> str:
+        """The level the run was configured to use — the probation
+        ceiling (a faithful-mode run must never be 'upgraded' onto the
+        ring transport the user did not ask for)."""
+        return self.LEVELS[self._home]
+
+    @property
+    def degraded(self) -> bool:
+        return self._level > self._home
+
+    def on_failure(self, step: int) -> str:
+        """A verified reduce failed at `step`: decide retry / downgrade /
+        give_up.  Resets the probation streak either way."""
+        self.clean = 0
+        if self.retries < self.max_retries:
+            self.retries += 1
+            return "retry"
+        self.retries = 0
+        if self._level + 1 < len(self.LEVELS):
+            old = self.mode
+            self._level += 1
+            self.transitions.append((step, old, self.mode))
+            return "downgrade"
+        return "give_up"
+
+    def on_success(self, step: int) -> Optional[str]:
+        """A verified reduce passed at `step`: advance probation, and
+        return "upgrade" when the streak earns a rung back."""
+        self.retries = 0
+        self.clean += 1
+        if self._level > self._home and self.clean >= self.probation:
+            old = self.mode
+            self._level -= 1
+            self.clean = 0
+            self.transitions.append((step, old, self.mode))
+            return "upgrade"
+        return None
+
+
+def level_reduce_kwargs(level: str, grad_exp: int, grad_man: int) -> dict:
+    """The `sum_gradients` precision/mode kwargs for one ladder rung —
+    the ONE mapping from supervisor level to reduction config, shared by
+    run_guarded harness code, the trainers, and the tests."""
+    if level == "ring":
+        return dict(mode="ring", grad_exp=grad_exp, grad_man=grad_man)
+    if level == "faithful":
+        return dict(mode="faithful", grad_exp=grad_exp, grad_man=grad_man)
+    if level == "fp32":
+        # plain psum at the identity format — the reference's own fp32
+        # shortcut; no custom wire left to corrupt
+        return dict(mode="fast", grad_exp=8, grad_man=23)
+    raise ValueError(f"unknown transport level {level!r}; know "
+                     f"{TransportSupervisor.LEVELS}")
+
+
+class StepTable:
+    """Lazily-built ``level -> jitted step`` mapping.
+
+    Building a step means an XLA trace+compile, so the degraded rungs
+    are only paid for when a downgrade actually reaches them; entries
+    are cached, so flapping between levels compiles each rung once."""
+
+    def __init__(self, build: Callable[[str], Callable]):
+        self._build = build
+        self._cache: dict = {}
+
+    def __getitem__(self, level: str) -> Callable:
+        if level not in self._cache:
+            self._cache[level] = self._build(level)
+        return self._cache[level]
+
+    def __contains__(self, level: str) -> bool:
+        return True      # any level is buildable; cache fills on demand

@@ -24,6 +24,7 @@ import sys
 from typing import Any, NamedTuple, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -65,6 +66,13 @@ class RestoreResult(NamedTuple):
     state: TrainState
     step: int
     skipped: tuple      # steps rejected (bad digest / unrestorable)
+    # integrity verdict of the RESTORED step: True = digest matched,
+    # None = no digest was ever recorded (pre-integrity checkpoint, or
+    # integrity=False saves).  A None restore is a silent-integrity gap
+    # — counted (`ckpts_unverified`) and logged separately so it cannot
+    # masquerade as a verified one.  False never appears here: digest
+    # mismatches are skipped, not restored.
+    verified: Any = True
 
 
 def preempt_save(manager: "CheckpointManager", step_no, state, rank: int,
@@ -165,6 +173,26 @@ def jnp_dtype(x):
     return getattr(x, "dtype", None) or np.asarray(x).dtype
 
 
+def _find_zero_state(opt_state):
+    """The ZeRO flat-momentum state (parallel.zero.Zero1State) nested
+    anywhere in ``opt_state``, or None.  Lazy import: checkpointing a
+    plain optax state must not pay for the parallel stack."""
+    if opt_state is None:
+        return None
+    try:
+        from ..parallel.zero import Zero1State
+    except ImportError:      # pragma: no cover - parallel always ships
+        return None
+
+    def is_z(n):
+        return isinstance(n, Zero1State)
+
+    for node in jax.tree_util.tree_leaves(opt_state, is_leaf=is_z):
+        if is_z(node):
+            return node
+    return None
+
+
 class CheckpointManager:
     """Thin orbax wrapper with the reference's retention semantics, plus
     content-integrity checking (``integrity=True``, the default): every
@@ -221,6 +249,15 @@ class CheckpointManager:
             self._mgr.delete(step)
         self._mgr.save(step, args=ocp.args.StandardSave(state),
                        metrics=metrics, force=force)
+        z = _find_zero_state(getattr(state, "opt_state", None))
+        if z is not None:
+            # elastic-restart layout record (ISSUE 4): the flat-momentum
+            # length at THIS world size, so `restore(world=W')` can
+            # re-flatten a W-padded shard layout through pad_to_world at
+            # the new world instead of failing on a shape mismatch
+            metadata = dict(metadata or {})
+            metadata["zero_layout"] = {
+                "momentum_padded": int(np.shape(z.momentum)[0])}
         if self._integrity:
             # the digest must cover the FINAL bytes: wait for orbax's
             # async write + atomic rename before hashing.  Hash on
@@ -293,7 +330,8 @@ class CheckpointManager:
 
     def restore(self, state_template: TrainState,
                 step: Optional[int] = None,
-                shardings: Optional[Any] = None) -> Optional[TrainState]:
+                shardings: Optional[Any] = None,
+                world: Optional[int] = None) -> Optional[TrainState]:
         """Restore `step` (default latest) shaped like `state_template`;
         None if no checkpoint exists — the auto-resume scan of
         main.py:70-75.
@@ -302,11 +340,29 @@ class CheckpointManager:
         state — orbax then materializes each array DIRECTLY in its target
         layout (sharded/replicated on the mesh), skipping the
         single-device restore + device_put relayout (2x host memory on
-        big states)."""
+        big states).
+
+        `world`: elastic ZeRO-1/2 restart (ISSUE 4).  When the template
+        carries a `parallel.zero.Zero1State` whose flat momentum was
+        PADDED for a different world size than the checkpoint's (a
+        preemption replay that resumes on a shrunken/grown mesh), the
+        momentum is restored at its saved length, trimmed of the old
+        world-size pad, and re-flattened through `pad_to_world` at the
+        new world — bitwise-faithful, because the pad region holds exact
+        zeros by construction (zero gradients keep zero momentum)."""
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
             return None
+        if world is not None:
+            ztmpl = _find_zero_state(getattr(state_template, "opt_state",
+                                             None))
+            zl = (self.metadata(step) or {}).get("zero_layout")
+            if ztmpl is not None and zl is not None:
+                saved_len = int(zl["momentum_padded"])
+                if saved_len != int(np.shape(ztmpl.momentum)[0]):
+                    return self._restore_elastic(state_template, step,
+                                                 world, saved_len)
         if shardings is None:
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
                                     state_template)
@@ -327,14 +383,70 @@ class CheckpointManager:
         return self._mgr.restore(step,
                                  args=ocp.args.StandardRestore(abstract))
 
+    def _restore_elastic(self, state_template: TrainState, step: int,
+                         world: int, saved_len: int) -> TrainState:
+        """The ZeRO-1/2 re-flatten: restore the flat momentum at the
+        length it was SAVED with, trim the old world-size pad (the real
+        data is the first `total` elements — parallel/zero.py
+        export_state's portable contract), and re-pad for `world`.
+
+        Restores UNSHARDED (the caller's `shardings` describe the
+        target shapes, not the saved momentum length) — an elastic
+        restore pays the single-device materialize + relayout cost the
+        sharded path avoids; every trainer re-lays the state out on its
+        mesh after restore anyway (their `relayout`/`mesh_layout`)."""
+        from ..parallel.ring import pad_to_world
+        from ..parallel.zero import Zero1State
+
+        def is_z(n):
+            return isinstance(n, Zero1State)
+
+        tmpl = jax.tree_util.tree_map(
+            lambda n: (Zero1State(n.step,
+                                  jnp.zeros((saved_len,), jnp.float32))
+                       if is_z(n) else n),
+            state_template, is_leaf=is_z)
+        restored = self.restore(tmpl, step=step)
+        total = sum(int(np.size(l))
+                    for l in jax.tree_util.tree_leaves(restored.params))
+
+        def refl(saved, want):
+            if not is_z(saved):
+                return saved
+            mom = pad_to_world(jnp.asarray(saved.momentum)[:total], world)
+            want_len = int(np.shape(want.momentum)[0])
+            if int(mom.shape[0]) != want_len:
+                raise ValueError(
+                    f"elastic restore at world={world}: re-flattened "
+                    f"momentum has {int(mom.shape[0])} elements but the "
+                    f"template expects {want_len} — template world and "
+                    f"`world=` disagree (build the template with the "
+                    f"updater for the NEW world size)")
+            return Zero1State(saved.step, mom)
+
+        new_opt = jax.tree_util.tree_map(
+            refl, restored.opt_state, state_template.opt_state,
+            is_leaf=is_z)
+        return restored.replace(opt_state=new_opt)
+
     def restore_latest_valid(self, state_template: TrainState,
                              shardings: Optional[Any] = None,
-                             rank: int = 0) -> Optional[RestoreResult]:
+                             rank: int = 0,
+                             world: Optional[int] = None
+                             ) -> Optional[RestoreResult]:
         """Restore the newest step that (a) passes the integrity check
         and (b) actually restores.  Steps failing either are skipped
         with a rank-0 warning and reported in ``RestoreResult.skipped``
         (the resilience counters' `restores`/`skipped` feed).  Returns
-        None when no step survives."""
+        None when no step survives.
+
+        A step with NO recorded digest (pre-integrity checkpoint) still
+        restores — rejecting it would turn a config change into data
+        loss — but the gap is surfaced: rank-0 warning, and
+        ``RestoreResult.verified is None`` so callers count it
+        (`ckpts_unverified`) instead of silently treating it as
+        verified.  `world` enables the elastic ZeRO re-flatten (see
+        `restore`)."""
         skipped = []
         for step in sorted(self._mgr.all_steps(), reverse=True):
             verdict = self.verify_step(step)
@@ -346,7 +458,7 @@ class CheckpointManager:
                 continue
             try:
                 state = self.restore(state_template, step=step,
-                                     shardings=shardings)
+                                     shardings=shardings, world=world)
             except Exception as e:
                 # a checkpoint that fails integrity-unknown restore is
                 # exactly what this scan exists to survive: report and
@@ -357,7 +469,13 @@ class CheckpointManager:
                           file=sys.stderr)
                 skipped.append(step)
                 continue
-            return RestoreResult(state, step, tuple(skipped))
+            if verdict is None and rank == 0:
+                print(f"=> checkpoint {step}: restored WITHOUT an "
+                      f"integrity digest (pre-integrity save) — "
+                      f"corruption would be undetectable here",
+                      file=sys.stderr)
+            return RestoreResult(state, step, tuple(skipped),
+                                 verified=verdict)
         return None
 
     def close(self):

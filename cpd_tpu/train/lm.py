@@ -54,13 +54,21 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        grad_man: int = 23, use_kahan: bool = False,
                        mode: str = "faithful", donate: bool = True,
                        label_smoothing: float = 0.0, rng_seed: int = 0,
-                       grad_rounding: str = "nearest", grad_seed: int = 0):
+                       grad_rounding: str = "nearest", grad_seed: int = 0,
+                       verify_reduce: bool = False,
+                       wire_fault_plan=None):
     """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
 
     tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
     (dp, sp).  Loss is next-token CE averaged over all target positions;
     ``label_smoothing`` in [0, 1) mixes the one-hot targets with uniform
     mass (training loss only — eval stays plain CE).
+
+    verify_reduce / wire_fault_plan: the self-verifying dp reduction and
+    its deterministic wire-fault table, exactly as on
+    `train.step.make_train_step` (the reduce_ok/... metrics feed the
+    transport supervisor).  The sp/tp psums stay unverified — they are
+    XLA's own collectives with no custom wire.
     """
     if not 0.0 <= label_smoothing < 1.0:
         raise ValueError(f"label_smoothing must be in [0, 1), got "
@@ -159,11 +167,22 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 grad_sr_key(grad_seed, state.step, 0),
                 lax.axis_index(axis_dp).astype(jnp.int32)) if sr
             else None)
+        wf = None
+        if wire_fault_plan is not None and mode == "ring":
+            codes = jnp.asarray(wire_fault_plan[0], jnp.int32)
+            ranks = jnp.asarray(wire_fault_plan[1], jnp.int32)
+            idx = jnp.clip(state.step, 0, codes.shape[0] - 1)
+            wf = (jnp.where(state.step < codes.shape[0], codes[idx], 0),
+                  ranks[idx])
+        vreport = None
         reduced = sum_gradients(
             local, axis_dp, use_aps=use_aps,
             grad_exp=grad_exp, grad_man=grad_man,
             use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
-            key=grad_sr_key(grad_seed, state.step, 1) if sr else None)
+            key=grad_sr_key(grad_seed, state.step, 1) if sr else None,
+            verify=verify_reduce, wire_fault=wf)
+        if verify_reduce:
+            reduced, vreport = reduced
 
         updates, new_opt = tx.update(reduced, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -180,6 +199,13 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             "accuracy": lax.psum(hits.sum().astype(jnp.float32),
                                  (axis_dp, axis_sp)) / total_n,
         }
+        if vreport is not None:
+            f32 = jnp.float32
+            metrics.update(
+                reduce_ok=vreport["ok"].astype(f32),
+                reduce_hop_bad=vreport["hop_bad"].astype(f32),
+                reduce_gather_bad=vreport["gather_bad"].astype(f32),
+                reduce_agree=vreport["agree"].astype(f32))
         return new_state, metrics
 
     return make_sharded_stepper(

@@ -40,7 +40,14 @@ class ResilienceMeter:
                 "guard_disagreements": "disagreements",
                 "faults_injected": "faults_injected"}
     HOST = ("rollbacks", "restores", "watchdog_trips", "preemptions",
-            "batches_dropped", "batches_duplicated", "ckpts_invalid")
+            "batches_dropped", "batches_duplicated", "ckpts_invalid",
+            # verified-reduce / degraded-transport accounting (ISSUE 4):
+            # detections and ladder moves are host decisions (the loop
+            # reads the step's replicated reduce_ok scalar), so they are
+            # host counters, not device mirrors
+            "wire_faults_detected", "reduce_retries",
+            "transport_downgrades", "transport_upgrades", "resyncs",
+            "ckpts_unverified", "faults_unfired")
     FIELDS = tuple(MIRRORED.values()) + HOST
 
     def __init__(self):
@@ -73,7 +80,13 @@ class ResilienceMeter:
                  "faults_injected": "inj", "rollbacks": "rollback",
                  "restores": "restore", "watchdog_trips": "wdog",
                  "preemptions": "preempt", "batches_dropped": "drop",
-                 "batches_duplicated": "dup", "ckpts_invalid": "badckpt"}
+                 "batches_duplicated": "dup", "ckpts_invalid": "badckpt",
+                 "wire_faults_detected": "wire",
+                 "reduce_retries": "retry",
+                 "transport_downgrades": "down",
+                 "transport_upgrades": "up", "resyncs": "resync",
+                 "ckpts_unverified": "unvckpt",
+                 "faults_unfired": "unfired"}
         parts = [f"{short[f]} {v}" for f, v in self.counts.items() if v]
         return (" " + " ".join(parts)) if parts else ""
 

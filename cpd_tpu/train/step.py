@@ -105,7 +105,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     opt_state_spec: Optional[Any] = None,
                     reduce_in_update: bool = False,
                     params_spec: Optional[Any] = None,
-                    unpack_params: Optional[Callable] = None):
+                    unpack_params: Optional[Callable] = None,
+                    verify_reduce: bool = False,
+                    wire_fault_plan: Optional[tuple] = None):
     """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
 
     images: (global_batch * emulate_node, H, W, C) sharded over `axis_name`;
@@ -125,6 +127,16 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     stored layout to the model's param pytree inside shard_map (e.g. the
     flat-shard all_gather + unflatten of parallel/zero.py `_Zero3`);
     update_fn then returns params back in the STORED layout.
+
+    verify_reduce=True runs the self-verifying reduction
+    (`sum_gradients(..., verify=True)`, parallel/integrity.py) and adds
+    the replicated scalars ``reduce_ok`` / ``reduce_hop_bad`` /
+    ``reduce_gather_bad`` / ``reduce_agree`` to the metrics — the feed
+    for `resilience.transport.TransportSupervisor`.  wire_fault_plan is
+    a ``FaultPlan.wire_schedule(n_steps)`` (codes, ranks) table baked
+    into the program; entry ``state.step`` corrupts the ring wire on
+    that rank (ignored outside mode="ring" — the ring's wire IS the one
+    under attack, and downgrading transports is the escape).
     """
     if grad_rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
@@ -146,6 +158,11 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         raise ValueError("params_spec (sharded stored params) requires "
                          "unpack_params to rebuild the model pytree "
                          "inside the step")
+    if verify_reduce and reduce_in_update:
+        raise ValueError("verify_reduce=True needs the step's own "
+                         "sum_gradients call; reduce_in_update hands the "
+                         "collective to the updater (ZeRO-2/3), which "
+                         "does not thread a verification report")
     has_stats_cache: dict = {}
 
     def local_micro_grads(params, batch_stats, images, labels, world, step,
@@ -255,6 +272,16 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 lax.axis_index(axis_name).astype(jnp.int32)) if sr
             else None)
         sum_key = grad_sr_key(grad_seed, state.step, 1) if sr else None
+        # wire-fault table lookup, keyed by the optimizer-update index —
+        # the same clock as with_fault_injection's grad schedule
+        wf = None
+        if wire_fault_plan is not None and mode == "ring":
+            codes = jnp.asarray(wire_fault_plan[0], jnp.int32)
+            ranks = jnp.asarray(wire_fault_plan[1], jnp.int32)
+            idx = jnp.clip(state.step, 0, codes.shape[0] - 1)
+            in_range = state.step < codes.shape[0]
+            wf = (jnp.where(in_range, codes[idx], 0), ranks[idx])
+        vreport = None
         if reduce_in_update:
             reduced = local       # update_fn owns the collective
         else:
@@ -262,7 +289,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 local, axis_name, use_aps=use_aps,
                 grad_exp=grad_exp, grad_man=grad_man,
                 use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
-                key=sum_key)
+                key=sum_key, verify=verify_reduce, wire_fault=wf)
+            if verify_reduce:
+                reduced, vreport = reduced
 
         if update_fn is not None:
             # custom update (e.g. parallel/zero.py ZeRO: shard-local
@@ -311,6 +340,16 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                             lax.psum(counted.astype(jnp.float32), axis_name),
                             1.0),
         }
+        if vreport is not None:
+            # replicated scalars: the wire-integrity verdict of THIS
+            # step's reduce (parallel/integrity.py), consumed by the
+            # transport supervisor in the loop
+            f32 = jnp.float32
+            metrics.update(
+                reduce_ok=vreport["ok"].astype(f32),
+                reduce_hop_bad=vreport["hop_bad"].astype(f32),
+                reduce_gather_bad=vreport["gather_bad"].astype(f32),
+                reduce_agree=vreport["agree"].astype(f32))
         return new_state, metrics
 
     if opt_state_spec is None and params_spec is None:

@@ -84,6 +84,18 @@ def add_resilience_flags(parser: argparse.ArgumentParser) -> None:
                         "their async overlap with compute, at the cost "
                         "of restore falling back only on restore "
                         "FAILURES, not on silent corruption)")
+    g.add_argument("--verify-reduce", action="store_true",
+                   help="self-verifying quantized reduction "
+                        "(parallel/integrity.py): tagged checksums on "
+                        "every ring hop + all-gather row, cross-replica "
+                        "agreement digest, and the degraded-transport "
+                        "ladder (ring -> faithful -> fp32) on failure")
+    g.add_argument("--reduce-retries", default=1, type=int,
+                   help="verified reduce: same-step retries before the "
+                        "transport supervisor downgrades a level")
+    g.add_argument("--transport-probation", default=8, type=int,
+                   help="clean verified steps at a degraded transport "
+                        "before probation moves one level back up")
 
 
 def build_resilience(args: argparse.Namespace, *, n_steps: int,
@@ -122,8 +134,33 @@ def build_resilience(args: argparse.Namespace, *, n_steps: int,
 
     timeout = float(getattr(args, "watchdog_timeout", 0.0) or 0.0)
     window = int(getattr(args, "divergence_window", 0) or 0)
+    verify = bool(getattr(args, "verify_reduce", False))
+    wire = plan.wire_faults() if plan is not None else ()
+    if wire and not verify:
+        # the attack without the defense silently corrupts sums — legal
+        # (that IS the baseline the checksums are measured against) but
+        # never what a CLI user means; make the footgun explicit
+        import sys as _sys
+        print("=> WARNING: fault plan schedules wire_* faults but "
+              "--verify-reduce is off — the corrupted reduce will go "
+              "UNDETECTED (pass --verify-reduce to arm the checksums)",
+              file=_sys.stderr)
+    supervisor = None
+    if verify:
+        from cpd_tpu.resilience.transport import TransportSupervisor
+        start = getattr(args, "mode", "faithful")
+        if start in TransportSupervisor.LEVELS:
+            supervisor = TransportSupervisor(
+                start=start, max_retries=int(args.reduce_retries),
+                probation=int(args.transport_probation))
+        # modes outside the ladder (e.g. fast) keep THEIR reduction and
+        # verify by agreement digest only — detection without a ladder,
+        # never a silent swap onto a transport the user didn't configure
     return {
         "plan": plan,
+        "verify": verify,
+        "wire_plan": (plan.wire_schedule(n_steps) if wire else None),
+        "supervisor": supervisor,
         # True only when wrap_tx is not the identity — what actually
         # composes (or not) with custom-update paths like ZeRO
         "wraps_optimizer": bool(guard
@@ -141,5 +178,6 @@ def build_resilience(args: argparse.Namespace, *, n_steps: int,
                      if window > 0 else None),
         "meter": ResilienceMeter(),
         "wrap_tx": wrap_tx,
-        "active": bool(plan or guard or timeout > 0 or window > 0),
+        "active": bool(plan or guard or timeout > 0 or window > 0
+                       or verify),
     }

@@ -281,6 +281,10 @@ def main(argv=None) -> dict:
     # (dp/sp/tp, pp, moe); host faults/watchdog/sentinel wrap the loop.
     from cpd_tpu.utils.config import build_resilience
     res = build_resilience(args, n_steps=args.max_iter, rank=rank)
+    if res["verify"] and (args.pp > 1 or args.moe):
+        raise SystemExit("--verify-reduce is wired to the default "
+                         "dp/sp/tp path only (the pp/moe steppers do "
+                         "not thread a verification report)")
     if res["active"]:
         # the guard's verdict must be agreed over EVERY mesh axis the
         # update runs under — tp/pp/ep-sharded leaves legitimately hold
@@ -289,6 +293,7 @@ def main(argv=None) -> dict:
         tx = res["wrap_tx"](tx, axis_name=tuple(mesh.axis_names))
     injector, watchdog = res["injector"], res["watchdog"]
     sentinel, meter = res["sentinel"], res["meter"]
+    supervisor, step_table, resync_fn = res["supervisor"], None, None
 
     ds = SyntheticText(n=4096, seq_len=args.seq_len,
                        vocab_size=args.vocab_size)
@@ -353,10 +358,38 @@ def main(argv=None) -> dict:
                                     dropout_rate=args.dropout, **model_kw)
         state = create_train_state(init_model, tx, sample,
                                    jax.random.PRNGKey(0))
-        step = make_lm_train_step(model, tx, mesh,
-                                  emulate_node=args.emulate_node,
-                                  label_smoothing=args.label_smoothing,
-                                  **quant_kw)
+        if supervisor is not None:
+            # degraded-transport ladder (docs/RESILIENCE.md): one lazily
+            # compiled verified step per rung, swapped on downgrade /
+            # probation; donate=False so a failed verify can discard
+            from cpd_tpu.parallel.integrity import make_consensus_fns
+            from cpd_tpu.resilience import (StepTable,
+                                            level_reduce_kwargs)
+            _, resync_fn = make_consensus_fns(mesh, "dp")
+            lvl_kw = {k: v for k, v in quant_kw.items()
+                      if k not in ("mode", "grad_exp", "grad_man")}
+
+            def build_step(level):
+                return make_lm_train_step(
+                    model, tx, mesh, emulate_node=args.emulate_node,
+                    label_smoothing=args.label_smoothing, donate=False,
+                    verify_reduce=True,
+                    wire_fault_plan=(res["wire_plan"]
+                                     if level == "ring" else None),
+                    **level_reduce_kwargs(level, args.grad_exp,
+                                          args.grad_man), **lvl_kw)
+
+            step_table = StepTable(build_step)
+            step = step_table[supervisor.mode]
+        else:
+            # no ladder (verify off, or a non-ladder mode like fast):
+            # verification, when on, is detection-only agreement checking
+            step = make_lm_train_step(model, tx, mesh,
+                                      emulate_node=args.emulate_node,
+                                      label_smoothing=args.label_smoothing,
+                                      verify_reduce=res["verify"],
+                                      wire_fault_plan=res["wire_plan"],
+                                      **quant_kw)
         eval_step = make_lm_eval_step(model, mesh)
         specs_fn = lm_state_specs
         global_batch = args.batch_size * dp * args.emulate_node
@@ -480,6 +513,7 @@ def main(argv=None) -> dict:
                     watchdog.arm(it, loss=last.get("loss"))
                 if injector is not None:
                     injector.maybe_stall(upd)
+                prev_state = state    # verified-reduce discard target
                 state, m = step(state, jnp.asarray(toks), jnp.asarray(tgts))
                 last = {k: float(v) for k, v in m.items()}  # device sync
                 if watchdog is not None:
@@ -496,6 +530,63 @@ def main(argv=None) -> dict:
                 meter.bump("preemptions")
                 preempted = True
                 break
+            # --- verified-reduce supervision (ISSUE 4) ----------------
+            # reduce_ok == 0: the reduce failed its checksums/agreement.
+            # Discard the corrupted update (donate=False keeps the
+            # pre-step state alive) and walk the transport ladder; the
+            # `continue` leaves `it` unchanged, so the retry replays the
+            # SAME update index — a deterministic injected wire fault
+            # re-fires and forces the downgrade, exactly as in
+            # run_guarded.
+            if supervisor is None and res["verify"] and float(
+                    last.get("reduce_ok", 1.0)) == 0.0:
+                # non-ladder mode (fast): detection only — count + warn
+                meter.bump("wire_faults_detected")
+                if rank == 0:
+                    print(f"=> reduce verify FAILED at iter {it} (mode "
+                          f"{args.mode} has no transport ladder: "
+                          f"detection only)", file=sys.stderr)
+            if supervisor is not None and float(
+                    last.get("reduce_ok", 1.0)) == 0.0:
+                meter.bump("wire_faults_detected")
+                state = prev_state
+                action = supervisor.on_failure(upd)
+                if action == "give_up":
+                    if rank == 0:
+                        print(f"=> verified reduce failed at the fp32 "
+                              f"transport floor (iter {it}) — not a "
+                              f"wire problem; stopping", file=sys.stderr)
+                    diverged = True
+                    break
+                if action == "downgrade":
+                    meter.bump("transport_downgrades")
+                    state = resync_fn(state)
+                    meter.bump("resyncs")
+                    step = step_table[supervisor.mode]
+                    if rank == 0:
+                        print(f"=> wire fault detected at iter {it} "
+                              f"(hop_bad "
+                              f"{int(last.get('reduce_hop_bad', 0))}, "
+                              f"gather_bad "
+                              f"{int(last.get('reduce_gather_bad', 0))})"
+                              f" — transport downgraded to "
+                              f"{supervisor.mode}, replicas re-synced "
+                              f"from rank 0", file=sys.stderr)
+                else:
+                    meter.bump("reduce_retries")
+                    if rank == 0:
+                        print(f"=> wire fault detected at iter {it} — "
+                              f"update discarded, retrying on the "
+                              f"{supervisor.mode} transport",
+                              file=sys.stderr)
+                continue
+            if supervisor is not None and \
+                    supervisor.on_success(upd) == "upgrade":
+                meter.bump("transport_upgrades")
+                step = step_table[supervisor.mode]
+                if rank == 0:
+                    print(f"=> transport probation passed at iter {it}: "
+                          f"back to {supervisor.mode}", file=sys.stderr)
             step_no = it
             if meter is not None:
                 meter.observe_metrics(last)
@@ -565,9 +656,14 @@ def main(argv=None) -> dict:
         guard.uninstall()
         if watchdog is not None:
             watchdog.close()
-    if injector is not None and rank == 0 and injector.unfired():
-        print(f"=> fault plan: spec(s) never fired: "
-              f"{injector.unfired()}", file=sys.stderr)
+    from cpd_tpu.resilience import report_unfired
+    # wire faults only fire when the default path baked a ring-mode
+    # table in — a wire_* spec on any other run must read as UNFIRED
+    report_unfired(injector, n_steps=args.max_iter, meter=meter, rank=rank,
+                   wire_armed=(not (args.pp > 1 or args.moe)
+                               and (supervisor.home == "ring"
+                                    if supervisor is not None
+                                    else args.mode == "ring")))
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()

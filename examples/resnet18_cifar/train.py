@@ -198,6 +198,12 @@ def main(argv=None) -> dict:
         raise SystemExit("--guard-grads / grad_* faults do not compose "
                          "with the ZeRO updaters (custom update_fn owns "
                          "the optimizer math the guard would wrap)")
+    if res["verify"] and (args.zero1 or args.zero2):
+        raise SystemExit("--verify-reduce needs the step's own reduction "
+                         "and a donate-free state for discard-and-retry; "
+                         "the ZeRO updaters own the collective "
+                         "(reduce_in_update) — run without --zero1/"
+                         "--zero2")
     if res["active"]:
         tx = res["wrap_tx"](tx, axis_name="dp")
     injector, watchdog = res["injector"], res["watchdog"]
@@ -297,12 +303,38 @@ def main(argv=None) -> dict:
         state, extra = zero.mesh_layout(state, mesh)
         to_ckpt = zero.export_state
 
-    train_step = make_train_step(
-        model, tx, mesh, emulate_node=args.emulate_node,
-        use_aps=args.use_APS, grad_exp=args.grad_exp,
-        grad_man=args.grad_man, use_kahan=args.use_kahan, mode=args.mode,
-        grad_rounding=args.grad_rounding, grad_seed=args.grad_seed,
-        **extra)
+    step_kw = dict(emulate_node=args.emulate_node, use_aps=args.use_APS,
+                   use_kahan=args.use_kahan,
+                   grad_rounding=args.grad_rounding,
+                   grad_seed=args.grad_seed, **extra)
+    supervisor = res["supervisor"]
+    resync_fn = None
+    if supervisor is not None:
+        # the degraded-transport ladder (docs/RESILIENCE.md): one lazily
+        # compiled verified step per rung, swapped on downgrade/probation
+        from cpd_tpu.parallel.integrity import make_consensus_fns
+        from cpd_tpu.resilience import StepTable, level_reduce_kwargs
+        _, resync_fn = make_consensus_fns(mesh, "dp")
+
+        def build_step(level):
+            return make_train_step(
+                model, tx, mesh, donate=False, verify_reduce=True,
+                wire_fault_plan=(res["wire_plan"] if level == "ring"
+                                 else None),
+                **level_reduce_kwargs(level, args.grad_exp,
+                                      args.grad_man), **step_kw)
+
+        step_table = StepTable(build_step)
+        train_step = step_table[supervisor.mode]
+    else:
+        # no ladder (verify off, or a non-ladder mode like fast):
+        # verification, when on, is detection-only agreement checking
+        step_table = None
+        train_step = make_train_step(
+            model, tx, mesh, grad_exp=args.grad_exp,
+            grad_man=args.grad_man, mode=args.mode,
+            verify_reduce=res["verify"],
+            wire_fault_plan=res["wire_plan"], **step_kw)
     eval_step = make_eval_step(model, mesh)
 
     # Global per-step batch = per-chip batch x chips x emulated nodes
@@ -429,6 +461,7 @@ def main(argv=None) -> dict:
                     watchdog.arm(step_no, loss=last.get("loss"))
                 if injector is not None:
                     injector.maybe_stall(step_no)
+                prev_state = state    # verified-reduce discard target
                 state, metrics = train_step(state, gx, gy)
                 last = {k: float(v) for k, v in metrics.items()}  # sync
                 if watchdog is not None:
@@ -448,6 +481,68 @@ def main(argv=None) -> dict:
                              what="injected preemption at")
                 preempted = True
                 break
+            # --- verified-reduce supervision (ISSUE 4) ----------------
+            # reduce_ok == 0: this step's reduce failed its checksums /
+            # agreement — discard the corrupted update (state rewinds to
+            # the pre-step pytree; steps are built donate=False) and let
+            # the supervisor walk the ring -> faithful -> fp32 ladder.
+            # Unlike run_guarded, the prefetcher pipeline cannot rewind
+            # a batch, so a "retry" trains the NEXT batch at the same
+            # rung — the update index (state.step) did not advance, so a
+            # deterministic injected fault still re-fires and drives the
+            # downgrade exactly as in the harness loop.
+            if supervisor is None and res["verify"] and float(
+                    last.get("reduce_ok", 1.0)) == 0.0:
+                # non-ladder mode (fast): detection only — count + warn
+                meter.bump("wire_faults_detected")
+                if rank == 0:
+                    print(f"=> reduce verify FAILED at iter "
+                          f"{step_no + 1} (mode {args.mode} has no "
+                          f"transport ladder: detection only)",
+                          file=sys.stderr)
+            if supervisor is not None and float(
+                    last.get("reduce_ok", 1.0)) == 0.0:
+                meter.bump("wire_faults_detected")
+                state = prev_state
+                action = supervisor.on_failure(step_no)
+                if action == "give_up":
+                    if rank == 0:
+                        print(f"=> verified reduce failed at the fp32 "
+                              f"transport floor (iter {step_no + 1}) — "
+                              f"not a wire problem; stopping",
+                              file=sys.stderr)
+                    diverged = True
+                    break
+                if action == "downgrade":
+                    meter.bump("transport_downgrades")
+                    state = resync_fn(state)
+                    meter.bump("resyncs")
+                    train_step = step_table[supervisor.mode]
+                    if rank == 0:
+                        print(f"=> wire fault detected at iter "
+                              f"{step_no + 1} (hop_bad "
+                              f"{int(last.get('reduce_hop_bad', 0))}, "
+                              f"gather_bad "
+                              f"{int(last.get('reduce_gather_bad', 0))})"
+                              f" — transport downgraded to "
+                              f"{supervisor.mode}, replicas re-synced "
+                              f"from rank 0", file=sys.stderr)
+                else:
+                    meter.bump("reduce_retries")
+                    if rank == 0:
+                        print(f"=> wire fault detected at iter "
+                              f"{step_no + 1} — update discarded, "
+                              f"retrying on the {supervisor.mode} "
+                              f"transport", file=sys.stderr)
+                continue
+            if supervisor is not None and \
+                    supervisor.on_success(step_no) == "upgrade":
+                meter.bump("transport_upgrades")
+                train_step = step_table[supervisor.mode]
+                if rank == 0:
+                    print(f"=> transport probation passed at iter "
+                          f"{step_no + 1}: back to {supervisor.mode}",
+                          file=sys.stderr)
             step_no += 1
             meter.observe_metrics(last)
             if injector is not None:
@@ -498,9 +593,13 @@ def main(argv=None) -> dict:
         if watchdog is not None:
             watchdog.close()
         batches.close()   # stop the producer even on an exception path
-    if injector is not None and rank == 0 and injector.unfired():
-        print(f"=> fault plan: spec(s) never fired: "
-              f"{injector.unfired()}", file=sys.stderr)
+    from cpd_tpu.resilience import report_unfired
+    # wire faults only fire when a ring-mode step baked the table in —
+    # a wire_* spec on a gather/psum run must read as UNFIRED, not pass
+    report_unfired(injector, n_steps=total_iter, meter=meter, rank=rank,
+                   wire_armed=(supervisor.home == "ring"
+                               if supervisor is not None
+                               else args.mode == "ring"))
     profiler.close()
     manager.wait()
     writer.close()

@@ -1,0 +1,256 @@
+"""cpd_tpu.parallel.integrity — the wire/replica checksum layer (ISSUE 4).
+
+Layers under test:
+
+* digest mechanics: determinism, single-bit sensitivity, positional
+  (reorder) sensitivity, dtype coverage (packed uint8 wire / fp32 bit
+  patterns), the pytree fold;
+* the verified ring transport: clean wire -> bitwise-unchanged result +
+  all-green report; each injected wire fault (flip / stale / drop)
+  detected with EXACT counter values at both the scan hop and the
+  gather wire — and the same faults silently corrupting the sum when
+  verify is off (the attack is real, the defense is load-bearing);
+* replica consensus: divergent per-device copies of a "replicated"
+  array detected by the digest check and repaired BITWISE to rank 0's
+  bytes by the resync broadcast.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cpd_tpu.compat import shard_map
+from cpd_tpu.parallel.integrity import (digest_agree, hop_tag,
+                                        make_consensus_fns, tree_digest,
+                                        wire_digest)
+from cpd_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+from cpd_tpu.parallel.ring import ring_oracle_sum, ring_quantized_sum
+from cpd_tpu.quant.numerics import pack_exmy
+
+W = 8  # conftest forces 8 virtual devices
+
+
+def _bits(x):
+    return np.asarray(x).view(np.uint32)
+
+
+# ------------------------------------------------ digest mechanics
+
+def test_wire_digest_deterministic_and_jit_pure():
+    x = jnp.asarray(np.random.RandomState(0).randn(10001), jnp.float32)
+    a = int(wire_digest(x))
+    b = int(jax.jit(wire_digest)(x))
+    assert a == b != 0
+
+
+def test_wire_digest_catches_single_bit_flip():
+    x = jnp.asarray(np.random.RandomState(1).randn(4097), jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    for idx in (0, 1234, 4096):
+        y = jax.lax.bitcast_convert_type(
+            bits.at[idx].set(bits[idx] ^ 1), jnp.float32)
+        assert int(wire_digest(y)) != int(wire_digest(x)), idx
+
+
+def test_wire_digest_catches_word_swap():
+    """The position weight: swapping two words keeps the plain sum but
+    must change the digest (corruption that MOVES data, not just flips
+    it)."""
+    x = jnp.asarray(np.arange(100, dtype=np.float32))
+    y = x.at[3].set(x[97]).at[97].set(x[3])
+    assert int(wire_digest(y)) != int(wire_digest(x))
+
+
+def test_wire_digest_packed_uint8_words():
+    q = pack_exmy(jnp.asarray(np.random.RandomState(2).randn(300),
+                              jnp.float32) * 0 + 1.5, 5, 2)
+    d = int(wire_digest(q))
+    flipped = q.at[7, 0].set(q[7, 0] ^ 1)
+    assert int(wire_digest(flipped)) != d
+    assert int(wire_digest(jnp.zeros((0,), jnp.float32))) == 0
+
+
+def test_wire_digest_hashes_bit_patterns_not_values():
+    """Sub-fp32 float leaves must hash their BIT patterns: a value cast
+    would map every |x| < 1 bf16 element to word 0, making the replica-
+    consensus digest blind to exactly the drift it exists to catch."""
+    small = jnp.asarray([0.25, -0.125, 0.5, -0.75], jnp.bfloat16)
+    drifted = small + jnp.bfloat16(0.0625)
+    assert int(wire_digest(small)) != int(wire_digest(drifted))
+    h16 = jnp.asarray([0.1, -0.2], jnp.float16)
+    assert int(wire_digest(h16)) != int(wire_digest(-h16))
+    # signed ints: negative values hash deterministically (bitcast)
+    i8 = jnp.asarray([-1, 2, -3], jnp.int8)
+    assert int(wire_digest(i8)) != int(wire_digest(jnp.abs(i8)))
+    assert int(wire_digest(i8)) == int(jax.jit(wire_digest)(i8))
+
+
+def test_tree_digest_sensitive_to_any_leaf_and_order():
+    t = {"a": jnp.ones((5,), jnp.float32), "b": jnp.zeros((3,), jnp.int32)}
+    d = int(tree_digest(t))
+    assert int(tree_digest({**t, "a": t["a"].at[4].set(2.0)})) != d
+    assert int(tree_digest({**t, "b": t["b"].at[0].set(1)})) != d
+    assert int(tree_digest(t)) == d
+
+
+def test_hop_tag_binds_payload_hop_and_sender():
+    """The stale-wire defense: identical payloads tagged for different
+    (hop, sender) must not verify against each other."""
+    x = jnp.asarray(np.random.RandomState(3).randn(64), jnp.float32)
+    t = int(hop_tag(x, jnp.int32(2), jnp.int32(4)))
+    assert int(hop_tag(x, jnp.int32(3), jnp.int32(4))) != t
+    assert int(hop_tag(x, jnp.int32(2), jnp.int32(5))) != t
+    assert int(hop_tag(x, jnp.int32(2), jnp.int32(4))) == t
+
+
+# ------------------------------------------------ verified ring
+
+def _run_ring(world, stacked, exp, man, verify=False, fault=None, **kw):
+    mesh = make_mesh(dp=world, devices=jax.devices()[:world])
+
+    def body(st):
+        return ring_quantized_sum(st[0], "dp", exp, man, verify=verify,
+                                  fault=fault, **kw)
+
+    out_specs = (P(), P()) if verify else P()
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=out_specs, check_vma=False))
+    sharded = jax.device_put(jnp.asarray(stacked),
+                             NamedSharding(mesh, P("dp")))
+    return fn(sharded)
+
+
+def _stack(world, n, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(world, n) * 0.3).astype(np.float32)
+
+
+@pytest.mark.parametrize("exp,man,kahan", [(5, 2, False), (4, 3, True),
+                                           (8, 23, False)])
+def test_verified_ring_clean_is_bitwise_transparent(exp, man, kahan):
+    """verify=True must observe the wire, never touch it: result equals
+    the unverified run AND the oracle bit for bit, report all green —
+    across packed, Kahan-double-payload and fp32-unpacked wires."""
+    stacked = _stack(W, 193, seed=exp * 7 + man)
+    plain = np.asarray(_run_ring(W, stacked, exp, man, use_kahan=kahan))
+    vec, rep = _run_ring(W, stacked, exp, man, use_kahan=kahan,
+                         verify=True)
+    np.testing.assert_array_equal(_bits(vec), plain.view(np.uint32))
+    np.testing.assert_array_equal(
+        _bits(vec),
+        _bits(ring_oracle_sum(jnp.asarray(stacked), exp, man,
+                              use_kahan=kahan)))
+    assert {k: int(v) for k, v in rep.items()} == {
+        "hop_bad": 0, "gather_bad": 0, "agree": 1, "ok": 1}
+
+
+@pytest.mark.parametrize("code,name", [(1, "flip"), (2, "stale"),
+                                       (3, "drop")])
+def test_wire_fault_detected_with_exact_counters(code, name):
+    """Each wire-fault kind, injected at the first reduce-scatter hop
+    AND the gather wire on rank 2: exactly one hop mismatch + one
+    gather-row mismatch, replica agreement broken, ok=0 — and the same
+    ints on a second run (deterministic chaos)."""
+    stacked = _stack(W, 257, seed=7)
+    plain = np.asarray(_run_ring(W, stacked, 5, 2))
+    for _ in range(2):
+        vec, rep = _run_ring(W, stacked, 5, 2, verify=True,
+                             fault=(jnp.int32(code), jnp.int32(2)))
+        got = {k: int(v) for k, v in rep.items()}
+        assert got == {"hop_bad": 1, "gather_bad": 1, "agree": 0,
+                       "ok": 0}, (name, got)
+        # the corruption is real: the sum actually changed
+        assert (_bits(vec) != plain.view(np.uint32)).any(), name
+
+
+@pytest.mark.parametrize("code", [1, 2, 3])
+def test_wire_fault_without_verify_corrupts_silently(code):
+    """The EQuARX failure mode this PR exists for: with verify off the
+    same fault leaves the replicas holding DIFFERENT "replicated"
+    vectors and NOTHING raises — the checksum layer is load-bearing,
+    not decorative.  (A 1-ulp scan-site flip can even be re-absorbed by
+    later e5m2 requantization; the gather-site corruption always
+    diverges the faulted rank's copy, which is exactly what no single
+    replica can see locally.)"""
+    stacked = _stack(W, 101, seed=11)
+    bad = _run_ring(W, stacked, 5, 2,
+                    fault=(jnp.int32(code), jnp.int32(1)))
+    shards = [np.asarray(s.data) for s in bad.addressable_shards]
+    assert any((shards[0].view(np.uint32)
+                != s.view(np.uint32)).any() for s in shards[1:]), code
+
+
+def test_wire_fault_rank_gating():
+    """fault rank >= 0 corrupts that rank only; code 0 is a no-op (the
+    dense schedule's 'no fault this step' entry)."""
+    stacked = _stack(4, 65, seed=13)
+    plain = np.asarray(_run_ring(4, stacked, 5, 2))
+    noop, rep = _run_ring(4, stacked, 5, 2, verify=True,
+                          fault=(jnp.int32(0), jnp.int32(2)))
+    np.testing.assert_array_equal(_bits(noop), plain.view(np.uint32))
+    assert int(rep["ok"]) == 1
+
+
+def test_verified_ring_sr_and_w2():
+    """SR bits and the smallest ring compose with verification."""
+    key = jax.random.PRNGKey(5)
+    stacked = _stack(2, 50, seed=17)
+    vec, rep = _run_ring(2, stacked, 5, 2, verify=True, key=key)
+    want = ring_oracle_sum(jnp.asarray(stacked), 5, 2, key=key)
+    np.testing.assert_array_equal(_bits(vec), _bits(want))
+    assert int(rep["ok"]) == 1
+
+
+# ------------------------------------------------ replica consensus
+
+def test_consensus_detects_and_resyncs_divergent_replicas():
+    """Manufactured replica drift on a nominally-replicated array: the
+    digest check sees it, the resync broadcast restores rank-0's exact
+    bytes on every device."""
+    mesh = data_parallel_mesh()
+
+    def diverge(x):
+        return x + jax.lax.axis_index("dp").astype(jnp.float32) * 0.125
+
+    fn = jax.jit(shard_map(diverge, mesh=mesh, in_specs=(P(),),
+                           out_specs=P(), check_vma=False))
+    bad = fn(jnp.arange(16.0))
+    shards = [np.asarray(s.data) for s in bad.addressable_shards]
+    assert any((shards[0] != s).any() for s in shards[1:])
+
+    check_fn, resync_fn = make_consensus_fns(mesh, "dp")
+    assert int(check_fn(bad)) == 0
+    good = resync_fn(bad)
+    gshards = [np.asarray(s.data) for s in good.addressable_shards]
+    for s in gshards:
+        np.testing.assert_array_equal(s.view(np.uint32),
+                                      np.arange(16.0,
+                                                dtype=np.float32)
+                                      .view(np.uint32))
+    assert int(check_fn(good)) == 1
+
+
+def test_consensus_clean_tree_agrees():
+    mesh = data_parallel_mesh()
+    check_fn, _ = make_consensus_fns(mesh, "dp")
+    tree = {"w": jnp.ones((4, 4)), "step": jnp.zeros([], jnp.int32)}
+    from cpd_tpu.parallel.dist import replicate
+    assert int(check_fn(replicate(tree, mesh))) == 1
+
+
+def test_digest_agree_inside_shard_map():
+    mesh = data_parallel_mesh()
+
+    def body(x):
+        rank = jax.lax.axis_index("dp")
+        same = digest_agree(wire_digest(x), "dp")
+        diff = digest_agree(wire_digest(x + rank.astype(jnp.float32)),
+                            "dp")
+        return same, diff
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(),),
+                           out_specs=(P(), P()), check_vma=False))
+    same, diff = fn(jnp.arange(8.0))
+    assert int(same) == 1 and int(diff) == 0

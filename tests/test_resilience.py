@@ -672,6 +672,269 @@ def test_chaos_run_is_deterministic(tmp_path, chaos_step_and_state):
 
 
 # ---------------------------------------------------------------------------
+# wire faults + the degraded-transport state machine (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+def test_plan_wire_grammar_and_schedule():
+    plan = FaultPlan.parse("wire_flip@4:2;wire_stale@7;wire_drop@9:5")
+    assert plan.counts() == {"wire_flip": 1, "wire_stale": 1,
+                             "wire_drop": 1}
+    assert plan.wire_faults() == plan.faults
+    assert plan.grad_faults() == () and plan.host_faults() == {}
+    codes, ranks = plan.wire_schedule(10)
+    assert codes.tolist() == [0, 0, 0, 0, 1, 0, 0, 2, 0, 3]
+    # arg is the target rank; unspecified (-1) gates to rank 0
+    assert ranks.tolist() == [0, 0, 0, 0, 2, 0, 0, 0, 0, 5]
+    # specs past the table are dropped by the schedule (and surfaced by
+    # report_unfired, tested below)
+    codes5, _ = plan.wire_schedule(5)
+    assert codes5.tolist() == [0, 0, 0, 0, 1]
+
+
+def test_transport_supervisor_state_machine():
+    from cpd_tpu.resilience import TransportSupervisor
+    sup = TransportSupervisor(start="ring", max_retries=2, probation=3)
+    assert sup.mode == "ring" and not sup.degraded
+    assert sup.on_failure(4) == "retry"
+    assert sup.on_failure(4) == "retry"
+    assert sup.on_failure(4) == "downgrade"
+    assert sup.mode == "faithful" and sup.degraded
+    # a clean streak of `probation` earns the rung back
+    assert sup.on_success(5) is None
+    assert sup.on_success(6) is None
+    assert sup.on_success(7) == "upgrade"
+    assert sup.mode == "ring"
+    # a failure resets the streak
+    sup2 = TransportSupervisor(start="ring", max_retries=0, probation=2)
+    assert sup2.on_failure(1) == "downgrade"
+    assert sup2.on_success(2) is None
+    assert sup2.on_failure(3) == "downgrade"       # streak reset, fp32
+    assert sup2.mode == "fp32"
+    assert sup2.on_failure(4) == "give_up"         # bottom rung
+    assert sup2.transitions == [(1, "ring", "faithful"),
+                                (3, "faithful", "fp32")]
+    # probation never climbs ABOVE the configured home transport: a
+    # faithful-mode run must not be silently migrated onto the ring
+    sup3 = TransportSupervisor(start="faithful", max_retries=0,
+                               probation=1)
+    assert sup3.home == "faithful" and not sup3.degraded
+    assert sup3.on_success(1) is None            # no upgrade to ring
+    assert sup3.on_failure(2) == "downgrade"     # faithful -> fp32
+    assert sup3.degraded
+    assert sup3.on_success(3) == "upgrade"       # back to faithful...
+    assert sup3.mode == "faithful"
+    assert sup3.on_success(4) is None            # ...and no further
+    with pytest.raises(ValueError, match="unknown transport level"):
+        TransportSupervisor(start="torus")
+
+
+def test_level_reduce_kwargs_ladder():
+    from cpd_tpu.resilience import level_reduce_kwargs
+    assert level_reduce_kwargs("ring", 5, 2) == dict(
+        mode="ring", grad_exp=5, grad_man=2)
+    assert level_reduce_kwargs("faithful", 5, 2) == dict(
+        mode="faithful", grad_exp=5, grad_man=2)
+    assert level_reduce_kwargs("fp32", 5, 2) == dict(
+        mode="fast", grad_exp=8, grad_man=23)
+    with pytest.raises(ValueError, match="unknown transport level"):
+        level_reduce_kwargs("torus", 5, 2)
+
+
+WIRE_STEPS = 10
+WIRE_PLAN = "wire_flip@4:2"
+
+
+def _wire_chaos_run(mesh, model_state, steps, supervisor, resync_fn,
+                    check_fn):
+    def next_batch(i, reseed):
+        r = np.random.default_rng(1000 * reseed + i)
+        return (jnp.asarray(r.normal(size=(16, 8, 8, 3)), jnp.float32),
+                jnp.asarray(np.arange(16) % 4, jnp.int32))
+
+    injector = Injector(FaultPlan.parse(WIRE_PLAN))
+    return run_guarded(None, model_state, next_batch, WIRE_STEPS,
+                       injector=injector, supervisor=supervisor,
+                       step_for_level=steps, resync_fn=resync_fn,
+                       consensus_fn=check_fn, consensus_every=4)
+
+
+@pytest.fixture(scope="module")
+def wire_chaos_pieces(mesh):
+    from cpd_tpu.models.tiny import tiny_cnn
+    from cpd_tpu.parallel.dist import replicate
+    from cpd_tpu.parallel.integrity import make_consensus_fns
+    from cpd_tpu.resilience import StepTable, level_reduce_kwargs
+    from cpd_tpu.train.state import create_train_state
+    from cpd_tpu.train.step import make_train_step
+
+    model = tiny_cnn(num_classes=4, width=4)
+    tx = sgd(lambda _: 0.05, momentum=0.9)
+    state0 = replicate(create_train_state(model, tx,
+                                          jnp.zeros((2, 8, 8, 3)),
+                                          jax.random.PRNGKey(0)), mesh)
+    wire_tbl = FaultPlan.parse(WIRE_PLAN).wire_schedule(WIRE_STEPS)
+
+    def build(level):
+        # donate=False: a failed verify discards the update, so the
+        # pre-step buffers must stay alive
+        return make_train_step(
+            model, tx, mesh, use_aps=True, donate=False,
+            verify_reduce=True,
+            wire_fault_plan=(wire_tbl if level == "ring" else None),
+            **level_reduce_kwargs(level, 5, 2))
+
+    check_fn, resync_fn = make_consensus_fns(mesh, "dp")
+    return state0, StepTable(build), check_fn, resync_fn
+
+
+def test_wire_chaos_detect_downgrade_resync_probation(wire_chaos_pieces,
+                                                      mesh):
+    """The ISSUE-4 acceptance run: wire_flip@4 on rank 2 of the
+    8-device mesh -> detected AT STEP 4 by the checksum/agreement check
+    (never by loss divergence: zero rollbacks), corrupted update
+    discarded and retried, transport downgraded ring->faithful with a
+    rank-0 bitwise re-sync, probation back up to ring after 3 clean
+    steps, run completes within budget with exact counters."""
+    from cpd_tpu.resilience import TransportSupervisor
+    state0, steps, check_fn, resync_fn = wire_chaos_pieces
+    sup = TransportSupervisor(start="ring", max_retries=1, probation=3)
+    state, report = _wire_chaos_run(mesh, state0, steps, sup, resync_fn,
+                                    check_fn)
+
+    assert report.completed and report.aborted is None
+    c = report.counters
+    # detected twice at step 4 (the retry replays the deterministic
+    # fault), one retry, one downgrade, one re-sync, one probation
+    # upgrade — and NOT via divergence (no rollbacks, no skips)
+    assert c["wire_faults_detected"] == 2
+    assert c["reduce_retries"] == 1
+    assert c["transport_downgrades"] == 1
+    assert c["transport_upgrades"] == 1
+    assert c["resyncs"] == 1
+    assert c["rollbacks"] == 0 and c["steps_skipped"] == 0
+    assert ("wire_fault", 4, "ring", 1, 1) in report.events
+    assert ("reduce_retry", 4) in report.events
+    assert ("transport_down", 4, "faithful") in report.events
+    assert ("resync", 4) in report.events
+    assert ("transport_up", 6, "ring") in report.events
+    assert sup.transitions == [(4, "ring", "faithful"),
+                               (6, "faithful", "ring")]
+    # replicas end bitwise re-synced (per-device buffers identical)
+    for leaf in jax.tree.leaves(state.params):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(
+                shards[0].view(np.uint8), s.view(np.uint8))
+    assert int(check_fn(state)) == 1
+
+
+def test_wire_chaos_is_deterministic(wire_chaos_pieces, mesh):
+    """Same plan + seeds => identical event sequence, counters and
+    bitwise-identical final params across two runs."""
+    from cpd_tpu.resilience import TransportSupervisor
+    state0, steps, check_fn, resync_fn = wire_chaos_pieces
+    runs = []
+    for _ in range(2):
+        sup = TransportSupervisor(start="ring", max_retries=1,
+                                  probation=3)
+        runs.append(_wire_chaos_run(mesh, state0, steps, sup, resync_fn,
+                                    check_fn))
+    (s1, r1), (s2, r2) = runs
+    assert r1.events == r2.events
+    assert r1.counters == r2.counters
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_run_guarded_supervisor_requires_step_table():
+    from cpd_tpu.resilience import TransportSupervisor
+    with pytest.raises(ValueError, match="step_for_level"):
+        run_guarded(_fake_step, _FakeState(0), _fake_batch, 2,
+                    supervisor=TransportSupervisor())
+    with pytest.raises(ValueError, match="consensus_every"):
+        run_guarded(_fake_step, _FakeState(0), _fake_batch, 2,
+                    consensus_every=3)
+
+
+# ---------------------------------------------------------------------------
+# unfired-fault surfacing + unverified-restore accounting (satellites)
+# ---------------------------------------------------------------------------
+
+def test_report_unfired_counts_warns_and_covers_jit_kinds(capsys):
+    from cpd_tpu.resilience import report_unfired
+    from cpd_tpu.train.metrics import ResilienceMeter
+
+    # stall@50 (host one-shot) and grad_nan@60 / wire_flip@70 (jit
+    # schedule entries) all scheduled past a 10-step run: every one is
+    # a silent user error until surfaced
+    plan = FaultPlan.parse("stall@50;grad_nan@60;wire_flip@70:1;"
+                           "loss_spike@2:10")
+    inj = Injector(plan)
+    inj.fault_loss(2, 1.0)                  # the only spec that fires
+    meter = ResilienceMeter()
+    leftover = report_unfired(inj, n_steps=10, meter=meter, rank=0)
+    assert [f.kind for f in leftover] == ["stall", "grad_nan",
+                                          "wire_flip"]
+    assert meter["faults_unfired"] == 3
+    assert "never fired" in capsys.readouterr().err
+    assert "unfired 3" in meter.suffix()
+    # a fully-fired plan stays silent
+    assert report_unfired(Injector(FaultPlan()), n_steps=10,
+                          meter=ResilienceMeter(), rank=0) == []
+    assert capsys.readouterr().err == ""
+    assert report_unfired(None) == []
+    # wire specs on a run whose reduction never baked the wire table in
+    # (wire_armed=False — e.g. wire_flip planned for a faithful-mode
+    # run) read as UNFIRED even when in range, and are not double-
+    # counted when also past n_steps
+    inj2 = Injector(FaultPlan.parse("wire_flip@2:1;wire_drop@99"))
+    assert [f.kind for f in report_unfired(inj2, n_steps=10, rank=0)] \
+        == ["wire_drop"]                         # armed: in-range passes
+    left = report_unfired(Injector(FaultPlan.parse(
+        "wire_flip@2:1;wire_drop@99")), n_steps=10, rank=0,
+        wire_armed=False)
+    assert [f.kind for f in left] == ["wire_flip", "wire_drop"]
+
+
+def test_run_guarded_warns_on_unfired_specs(capsys):
+    inj = Injector(FaultPlan.parse("stall@99"))
+    _, report = run_guarded(_fake_step, _FakeState(0), _fake_batch, 4,
+                            injector=inj)
+    assert report.completed
+    assert report.counters["faults_unfired"] == 1
+    assert "never fired" in capsys.readouterr().err
+
+
+def test_restore_unverified_checkpoint_counted_separately(tmp_path,
+                                                          capsys):
+    """verify_step(...) is None (no recorded digest) must not masquerade
+    as a verified restore: RestoreResult.verified is None, a rank-0
+    warning names the gap, and integrity-on restores stay verified=True."""
+    from cpd_tpu.train.checkpoint import CheckpointManager
+
+    # integrity OFF: no digest is ever recorded
+    mgr = CheckpointManager(str(tmp_path / "plain"), track_best=False,
+                            integrity=False)
+    mgr.save(1, _ck_state(1.0), force=True)
+    mgr.wait()
+    res = mgr.restore_latest_valid(_ck_state(0.0))
+    assert res is not None and res.step == 1
+    assert res.verified is None
+    assert "WITHOUT an integrity digest" in capsys.readouterr().err
+    mgr.close()
+
+    # integrity ON: digest recorded and re-checked -> verified True
+    mgr2 = CheckpointManager(str(tmp_path / "digested"),
+                             track_best=False)
+    mgr2.save(1, _ck_state(2.0), force=True)
+    mgr2.wait()
+    res2 = mgr2.restore_latest_valid(_ck_state(0.0))
+    assert res2 is not None and res2.verified is True
+    assert "WITHOUT" not in capsys.readouterr().err
+    mgr2.close()
+
+
+# ---------------------------------------------------------------------------
 # trainer CLI under a fault plan (full stack; slow tier)
 # ---------------------------------------------------------------------------
 

@@ -199,6 +199,76 @@ def test_sum_gradients_rejects_unknown_mode():
             {"g": jnp.zeros((W, 4))})
 
 
+def test_sum_gradients_ring_multi_axis_actionable_error():
+    """mode="ring" over several mesh axes used to surface
+    ring_quantized_sum's bare ValueError from deep inside jit tracing;
+    the dispatch now fails fast, names the axes and points at the
+    multi-axis-capable faithful mode."""
+    from cpd_tpu.parallel.dist import sum_gradients
+    with pytest.raises(ValueError) as e:
+        sum_gradients({"g": jnp.zeros((4,))}, ("dp", "sp"), mode="ring")
+    msg = str(e.value)
+    assert "('dp', 'sp')" in msg
+    assert "mode='faithful'" in msg
+    assert "ONE mesh axis" in msg
+    # a single-axis tuple is still a tuple — same actionable message
+    with pytest.raises(ValueError, match="ONE mesh axis"):
+        sum_gradients({"g": jnp.zeros((4,))}, ("dp",), mode="ring")
+
+
+def test_sum_gradients_ring_verify_end_to_end():
+    """verify=True through the pytree API: clean tree reduces to the
+    same bits as the unverified path, report all green; an injected
+    gather-wire fault flips the verdict and (without the defense
+    discarding it) leaves replicas holding different sums — which the
+    re-sync broadcast then repairs BITWISE."""
+    from cpd_tpu.compat import shard_map
+    from cpd_tpu.parallel.dist import sum_gradients
+    from cpd_tpu.parallel.integrity import make_consensus_fns
+
+    mesh = data_parallel_mesh()
+    rng = np.random.RandomState(21)
+    tree = {"w": (rng.randn(W, 33) * 0.2).astype(np.float32),
+            "b": (rng.randn(W, 5) * 0.2).astype(np.float32)}
+    sharded = jax.tree.map(
+        lambda g: jax.device_put(jnp.asarray(g),
+                                 NamedSharding(mesh, P("dp"))), tree)
+
+    def body(st, fault=None):
+        local = jax.tree.map(lambda g: g[0], st)
+        return sum_gradients(local, "dp", grad_exp=5, grad_man=2,
+                             mode="ring", verify=True, wire_fault=fault)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=(P(), P()), check_vma=False))
+    got, rep = fn(sharded)
+    assert {k: int(v) for k, v in rep.items()} == {
+        "hop_bad": 0, "gather_bad": 0, "agree": 1, "ok": 1}
+    plain_fn = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=5,
+                                     grad_man=2, mode="ring")
+    plain = plain_fn(sharded)
+    for k in tree:
+        _bitwise(np.asarray(got[k]), np.asarray(plain[k]), k)
+
+    def fbody(st):
+        return body(st, fault=(jnp.int32(1), jnp.int32(3)))
+    ffn = jax.jit(shard_map(fbody, mesh=mesh, in_specs=(P("dp"),),
+                            out_specs=(P(), P()), check_vma=False))
+    bad, brep = ffn(sharded)
+    assert int(brep["ok"]) == 0 and int(brep["agree"]) == 0
+
+    # the replicas now disagree bitwise; rank-0 broadcast re-syncs them
+    check_fn, resync_fn = make_consensus_fns(mesh, "dp")
+    assert int(check_fn(bad)) == 0
+    fixed = resync_fn(bad)
+    assert int(check_fn(fixed)) == 1
+    for leaf in jax.tree.leaves(fixed):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0].view(np.uint32),
+                                          s.view(np.uint32))
+
+
 def test_train_step_mode_ring_end_to_end():
     """A whole jitted train step with mode="ring" (APS + e5m2, the
     flagship config): traces, runs, loss finite, params move."""

@@ -87,6 +87,36 @@ def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
             best = min(best, time.perf_counter() - t0)
         out["modes"][mode] = {"best_ms": round(best * 1e3, 3),
                               "elems_per_sec": round(n / best, 1)}
+
+    # verified ring (ISSUE 4): same transport + the integrity layer
+    # (per-hop tagged checksums, gather-row tags, replica-agreement
+    # digest) — the measured verify-overhead column of docs/PERF.md
+    from cpd_tpu.compat import shard_map
+    from cpd_tpu.parallel.ring import ring_quantized_sum
+
+    def vbody(st, k=key):
+        vec, rep = ring_quantized_sum(st["g"][0], "dp", exp, man,
+                                      use_kahan=use_kahan, key=k,
+                                      verify=True)
+        return vec, rep["ok"]
+    vfn = jax.jit(shard_map(vbody, mesh=mesh, in_specs=(P("dp"),),
+                            out_specs=(P(), P()), check_vma=False))
+    vec, ok = vfn(sharded)
+    np.asarray(vec)
+    best_v = float("inf")
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        vec, ok = vfn(sharded)
+        np.asarray(vec)
+        best_v = min(best_v, time.perf_counter() - t0)
+    ring_ms = out["modes"]["ring"]["best_ms"]
+    out["modes"]["ring_verified"] = {
+        "best_ms": round(best_v * 1e3, 3),
+        "elems_per_sec": round(n / best_v, 1),
+        "ok": int(ok),
+        "overhead_vs_ring_pct": (round(100.0 * (best_v * 1e3 - ring_ms)
+                                       / ring_ms, 1) if ring_ms else None),
+    }
     return out
 
 
@@ -136,6 +166,44 @@ def smoke() -> dict:
                             f"ring != oracle (bitwise) at {label}")
                     checks.append(label)
 
+    # verified-ring gate (ISSUE 4): the checksums must (a) pass and
+    # leave the result BITWISE unchanged on a clean wire, and (b) catch
+    # an injected single-bit wire flip — with exact counter values, so
+    # a silently weakened checksum fails CI here
+    stacked = (rng.randn(8, n) * 0.3).astype(np.float32)
+    mesh8 = make_mesh(dp=8, devices=jax.devices()[:8])
+    sharded = jax.device_put(jnp.asarray(stacked),
+                             NamedSharding(mesh8, P("dp")))
+
+    def vbody(st, fault=None):
+        return ring_quantized_sum(st[0], "dp", 5, 2, verify=True,
+                                  fault=fault)
+
+    clean_fn = jax.jit(shard_map(vbody, mesh=mesh8, in_specs=(P("dp"),),
+                                 out_specs=(P(), P()), check_vma=False))
+    vec, rep = clean_fn(sharded)
+    plain = np.asarray(ring_oracle_sum(jnp.asarray(stacked), 5, 2))
+    if (np.asarray(vec).view(np.uint32) != plain.view(np.uint32)).any():
+        raise AssertionError("verified ring != oracle on a clean wire")
+    if not (int(rep["ok"]) == 1 and int(rep["hop_bad"]) == 0
+            and int(rep["gather_bad"]) == 0 and int(rep["agree"]) == 1):
+        raise AssertionError(f"clean verified ring reported a fault: "
+                             f"{jax.tree.map(int, rep)}")
+
+    def fbody(st):
+        return vbody(st, fault=(jnp.int32(1), jnp.int32(3)))
+    flip_fn = jax.jit(shard_map(fbody, mesh=mesh8, in_specs=(P("dp"),),
+                                out_specs=(P(), P()), check_vma=False))
+    fvec, frep = flip_fn(sharded)
+    if not (int(frep["ok"]) == 0 and int(frep["hop_bad"]) == 1
+            and int(frep["gather_bad"]) == 1 and int(frep["agree"]) == 0):
+        raise AssertionError(f"injected wire flip not detected exactly: "
+                             f"{jax.tree.map(int, frep)}")
+    if (np.asarray(fvec).view(np.uint32) == plain.view(np.uint32)).all():
+        raise AssertionError("injected wire flip did not corrupt the "
+                             "sum — the attack is a no-op, so the "
+                             "detection above proves nothing")
+
     # byte-counter invariants — the acceptance gate: >= 2x fewer wire
     # bytes at W=8 for e5m2 vs the faithful gather path (both flavors)
     n_big = 1_000_000
@@ -147,7 +215,11 @@ def smoke() -> dict:
     # exact analytic forms: gather (W-1)*n*4 raw; ring 2*(W-1)*(n/W)*1
     assert gather_fp32 == 7 * n_big * 4
     assert ring_b == 2 * 7 * 125_000 * 1
-    return {"parity_checks": len(checks), "ring_bytes_w8_e5m2": ring_b,
+    return {"parity_checks": len(checks),
+            "verified_ring": {"clean_ok": True, "flip_detected": True,
+                              "flip_hop_bad": int(frep["hop_bad"]),
+                              "flip_gather_bad": int(frep["gather_bad"])},
+            "ring_bytes_w8_e5m2": ring_b,
             "gather_bytes_w8_e5m2_fp32": gather_fp32,
             "gather_bytes_w8_e5m2_packed": gather_packed,
             "ring_vs_gather_fp32_ratio": round(gather_fp32 / ring_b, 2),
