@@ -560,6 +560,60 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
             partial["resilience_note"] = (f"resilience extra skipped: "
                                           f"{type(e).__name__}: {e}")
 
+    # Cheap EXTRA (seconds, platform-agnostic): the precision-ladder
+    # drill (ISSUE 5) — (a) the numeric-health telemetry cast stays
+    # bitwise identical to the plain cast and its measured overhead is
+    # a tracked number (the docs/PERF.md telemetry-overhead column);
+    # (b) the PrecisionSupervisor still escalates on a hot feed and
+    # probations back on a quiet one, so a silently disarmed ladder
+    # shows up in the bench ledger, not just in tests.
+    if time.monotonic() < budget_end - 15:
+        try:
+            from cpd_tpu.quant.numerics import cast_to_format
+            from cpd_tpu.quant.quant_function import float_quantize_stats
+            from cpd_tpu.resilience import PrecisionSupervisor
+
+            n_tele = 1 << 20
+            xt = jnp.asarray(rng.randn(n_tele).astype(np.float32))
+            plain_fn = jax.jit(lambda v: cast_to_format(v, 5, 2))
+            stats_fn = jax.jit(lambda v: float_quantize_stats(v, 5, 2))
+            q0 = plain_fn(xt)
+            q1, _h = stats_fn(xt)
+            bit_ok = bool((np.asarray(q0).view(np.uint32)
+                           == np.asarray(q1).view(np.uint32)).all())
+
+            def _best(f):
+                best = float("inf")
+                for _ in range(10):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(f(xt))
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            t_plain, t_stats = _best(plain_fn), _best(stats_fn)
+            psd = PrecisionSupervisor("e5m2,e8m23", threshold=1e-3,
+                                      patience=2, probation=2)
+            hot = {"prec_wire_sat": 100.0, "prec_wire_total": 1000.0}
+            quiet = {"prec_wire_sat": 0.0, "prec_wire_total": 1000.0}
+            acts = [psd.on_metrics(i, m) for i, m in
+                    enumerate([quiet, hot, hot, quiet, quiet])]
+            partial["precision"] = {
+                "stats_cast_bitwise_identical": bit_ok,
+                "cast_ms": round(t_plain * 1e3, 3),
+                "stats_cast_ms": round(t_stats * 1e3, 3),
+                "telemetry_overhead_pct": (
+                    round(100.0 * (t_stats - t_plain) / t_plain, 1)
+                    if t_plain else None),
+                "ladder_drill": {
+                    "escalated": acts[2] == "escalate",
+                    "deescalated": acts[4] == "deescalate",
+                    "transitions": [list(t) for t in psd.transitions],
+                },
+            }
+        except Exception as e:  # noqa: BLE001 — extras must not kill the run
+            partial["precision_note"] = (f"precision extra skipped: "
+                                         f"{type(e).__name__}: {e}")
+
     if profile_dir and time.monotonic() < budget_end - 30:
         state = create_train_state(model, tx, x[0, :2],
                                    jax.random.PRNGKey(0))

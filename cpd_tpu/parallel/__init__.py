@@ -4,7 +4,7 @@ TPU-native replacement for reference CPDtorch/utils/dist_util.py (NCCL /
 torch.distributed) built on XLA collectives under shard_map/pjit."""
 
 from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
-                  aps_unscale)
+                  aps_shift_factors_checked, aps_unscale)
 from .dist import (all_reduce_mean, broadcast_from, dist_init,
                    make_sum_gradients_fn, replicate, sum_gradients)
 from .emulate import emulate_node_reduce
@@ -21,7 +21,8 @@ from .reduction import (kahan_quantized_sum, ordered_quantized_sum,
 
 __all__ = [
     "pipeline_spmd", "Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd",
-    "aps_max_exponents", "aps_scale", "aps_shift_factors", "aps_unscale",
+    "aps_max_exponents", "aps_scale", "aps_shift_factors",
+    "aps_shift_factors_checked", "aps_unscale",
     "all_reduce_mean", "broadcast_from", "dist_init", "make_sum_gradients_fn",
     "replicate", "sum_gradients", "emulate_node_reduce",
     "AXIS_DATA", "AXIS_EXPERT", "AXIS_PIPE", "AXIS_SEQ", "AXIS_TENSOR",

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["aps_max_exponents", "aps_shift_factors", "aps_scale", "aps_unscale"]
+__all__ = ["aps_max_exponents", "aps_shift_factors",
+           "aps_shift_factors_checked", "aps_scale", "aps_unscale"]
 
 
 def aps_max_exponents(grads: Any, world_size) -> jnp.ndarray:
@@ -48,11 +49,40 @@ def aps_max_exponents(grads: Any, world_size) -> jnp.ndarray:
          for g in leaves])
 
 
-def aps_shift_factors(max_exp: jnp.ndarray, grad_exp: int) -> jnp.ndarray:
-    """shift = (2^(exp-1)-1) - max_exp, with the all-zero guard (shift=0)."""
+def aps_shift_factors_checked(max_exp: jnp.ndarray,
+                              grad_exp: int) -> tuple:
+    """shift = (2^(exp-1)-1) - max_exp, distinguishing the two ways
+    `max_exp` can be non-finite.
+
+    * ``-inf`` — an all-zero leaf (log2(0)); shift 0 is CORRECT there
+      (nothing to scale; the reference's guarded emulate-node path,
+      mix.py:267-268).
+    * ``+inf`` or ``NaN`` — the leaf itself contains Inf/NaN gradients.
+      Shift 0 is merely *damage control*: the garbage value still rides
+      the quantized reduce (the cast passes Inf/NaN through), so the
+      condition must be SURFACED, not silently normalized away.
+
+    Returns ``(shifts, bad)`` where ``bad`` is the int32 count of
+    non-finite-gradient leaves (the ``+inf``/NaN case only — all-zero
+    leaves are healthy).  `sum_gradients(stats=True)` exposes it as the
+    ``aps_bad`` counter, which the grad guard's skip and the precision
+    supervisor (resilience/precision.py) both see.  Call on the
+    pmax-agreed vector: the verdict is then replicated by construction
+    (pmax propagates +inf, and jnp.maximum propagates NaN)."""
     upper_bound = jnp.float32(2 ** (grad_exp - 1) - 1)
     shift = upper_bound - max_exp
-    return jnp.where(jnp.isfinite(shift), shift, jnp.float32(0.0))
+    bad = jnp.sum((jnp.isnan(max_exp)
+                   | (max_exp == jnp.inf)).astype(jnp.int32))
+    return jnp.where(jnp.isfinite(shift), shift, jnp.float32(0.0)), bad
+
+
+def aps_shift_factors(max_exp: jnp.ndarray, grad_exp: int) -> jnp.ndarray:
+    """shift = (2^(exp-1)-1) - max_exp, with the all-zero guard (shift=0).
+
+    Maps BOTH non-finite cases to shift 0 (see the checked variant for
+    why they differ); callers that can report should prefer
+    `aps_shift_factors_checked`."""
+    return aps_shift_factors_checked(max_exp, grad_exp)[0]
 
 
 def aps_scale(grads: Any, shifts: jnp.ndarray) -> Any:

@@ -54,7 +54,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..quant.numerics import (cast_to_format, cast_to_format_sr_at,
                               pack_exmy, unpack_exmy, wire_bytes)
-from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
+from ..quant.quant_function import tree_quant_health
+from .aps import (aps_max_exponents, aps_scale, aps_shift_factors_checked,
                   aps_unscale, pmax_scalar_vector)
 from .reduction import quantized_sum
 from .ring import ring_quantized_sum
@@ -291,7 +292,8 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   bucket: Optional[bool] = None,
                   rounding: str = "nearest", key=None,
                   verify: bool = False,
-                  wire_fault: Optional[tuple] = None) -> Any:
+                  wire_fault: Optional[tuple] = None,
+                  stats: bool = False) -> Any:
     """Low-precision gradient all-reduce (SUM) over `axis_name`.
 
     Pure pytree-in/pytree-out version of reference `sum_gradients`
@@ -343,6 +345,22 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   mode, because the wire being attacked IS the ring's
                   (downgrading the transport is how a run escapes a
                   persistently faulty ring wire).
+    stats       → numeric-health telemetry of the reduce-wire cast site
+                  (quant.numerics.quant_health): returns ``(reduced,
+                  report)`` where report gains the psum-agreed
+                  float32 scalars {wire_sat, wire_underflow, wire_nan,
+                  wire_total} plus ``aps_bad`` (count of leaves whose
+                  APS max-exponent was +Inf/NaN — gradients already
+                  non-finite BEFORE the wire, satellite of
+                  aps_shift_factors_checked; 0 when use_aps is off).
+                  With APS the counters observe the pre-reduce quantize
+                  that already runs (zero extra casts); without APS the
+                  local grads are probe-cast to the wire format once,
+                  telemetry-only (RTNE regardless of `rounding` — the
+                  probe measures format fit, not round direction; its
+                  output is discarded).  The data path is bitwise
+                  unchanged either way.  Composes with `verify`: one
+                  merged report dict.
     """
     if mode not in ("faithful", "fast", "ring"):
         raise ValueError(f"unknown mode {mode!r}")
@@ -388,12 +406,38 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         return quantize_tree_sr(t, grad_exp, grad_man, k)
 
     shifts = None
+    prec = None
+    aps_bad = jnp.zeros([], jnp.int32)
     if use_aps:
         max_exp = aps_max_exponents(grads, world)
         max_exp = pmax_scalar_vector(max_exp, axis_name)
-        shifts = aps_shift_factors(max_exp, grad_exp)
-        grads = aps_scale(grads, shifts)
-        grads = q_tree(grads, k_pre)
+        # checked variant: a +Inf/NaN max-exponent means the leaf holds
+        # non-finite gradients — shift 0 is damage control, the count is
+        # the signal (computed on the pmax'd vector, so it is replicated)
+        shifts, aps_bad = aps_shift_factors_checked(max_exp, grad_exp)
+        scaled = aps_scale(grads, shifts)
+        grads = q_tree(scaled, k_pre)
+        if stats:
+            # the exact values that hit the reduce wire, observed for
+            # free: the APS pre-quantize above already ran, telemetry
+            # just compares its (input, output) pair
+            prec = tree_quant_health(scaled, grads)
+    elif stats:
+        # no pre-quantize on this path (faithful/ring cast inside the
+        # ordered accumulation) — probe: cast the local grads, scaled by
+        # the world size, to the wire format once; telemetry-only,
+        # result discarded.  The ·W scale is APS's own worst-case bound
+        # on the ordered accumulation (max|g·W|, dist_util.py:26-28): a
+        # per-rank value can fit the format while the running W-rank sum
+        # saturates mid-scan, and the supervisor must see THAT — the
+        # failure the reduce actually hits — not just the per-element
+        # cast.  This one extra elementwise cast is the measured
+        # telemetry overhead of docs/PERF.md.
+        scaled = jax.tree.map(lambda g: g.astype(jnp.float32) * world,
+                              grads)
+        probe = jax.tree.map(
+            lambda g: cast_to_format(g, grad_exp, grad_man), scaled)
+        prec = tree_quant_health(scaled, probe)
 
     if mode == "fast":
         if not use_aps and not (grad_exp == 8 and grad_man == 23):
@@ -463,15 +507,26 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
 
     if use_aps:
         reduced = aps_unscale(reduced, shifts)
-    if verify:
-        if mode != "ring":
-            # psum / all_gather have no custom wire to checksum; the
-            # cross-replica agreement digest is the whole verdict there
-            from .integrity import digest_agree, tree_digest
-            agree = digest_agree(tree_digest(reduced), axis_name)
-            report = _clean_verify_report()
-            report["agree"] = agree
-            report["ok"] = agree
+    if verify or stats:
+        if verify:
+            if mode != "ring":
+                # psum / all_gather have no custom wire to checksum; the
+                # cross-replica agreement digest is the whole verdict
+                from .integrity import digest_agree, tree_digest
+                agree = digest_agree(tree_digest(reduced), axis_name)
+                report = _clean_verify_report()
+                report["agree"] = agree
+                report["ok"] = agree
+        else:
+            report = {}
+        if stats:
+            # SUM the per-rank counts so every replica reports the same
+            # cluster-wide verdict (the supervisor's escalation decision
+            # must agree across hosts); aps_bad is replicated already
+            # (computed from the pmax'd vector)
+            report.update({"wire_" + k: lax.psum(v, axis_name)
+                           for k, v in prec.items()})
+            report["aps_bad"] = aps_bad
         return reduced, report
     return reduced
 

@@ -53,6 +53,7 @@ __all__ = ["cast_to_format", "cast_body", "cast_oracle", "max_finite",
            "cast_body_sr", "cast_to_format_sr", "cast_oracle_sr",
            "sr_bits_at", "cast_to_format_sr_at",
            "pack_exmy", "unpack_exmy", "wire_bytes",
+           "quant_health", "cast_to_format_stats", "HEALTH_FIELDS",
            "FP32_EXP_BITS", "FP32_MAN_BITS"]
 
 FP32_EXP_BITS = 8
@@ -273,6 +274,79 @@ def cast_to_format_sr_at(x: jnp.ndarray, exp_bits: int, man_bits: int,
     `offsets` must have x's shape (or broadcast to it)."""
     rbits = jnp.broadcast_to(sr_bits_at(key, offsets), jnp.shape(x))
     return cast_body_sr(x, exp_bits, man_bits, rbits)
+
+
+# --------------------------------------------------------------------------
+# Numeric-health telemetry (the precision supervisor's sensor layer,
+# resilience/precision.py).
+#
+# A launch-time format choice is a bet about runtime value ranges; these
+# counters are how a run notices the bet going bad WHILE it can still
+# react.  `quant_health` observes one cast's (input, output) pair and
+# counts the three failure signatures of the eXmY cast semantics above:
+#
+#   sat       — output is ±Inf: the pre-rounding exponent-overflow
+#               saturation (float_kernel.cu:24-30) fired, or an Inf that
+#               was already in the input passed through.  Either way the
+#               format is carrying Inf — the health problem is the same.
+#   underflow — a non-zero finite input came out exactly 0: the
+#               fp32-subnormal flush (float_kernel.cu:87-91) or the
+#               subnormal-target path rounding the whole significand
+#               away.  Gradient mass silently vanishing.
+#   nan       — NaN inputs (passthrough): poison already upstream of the
+#               cast, counted here because the cast site is where a
+#               format ladder can still re-trace before the optimizer
+#               eats it.
+#
+# Pure observation: the caller hands in whatever the cast produced, so
+# enabling telemetry CANNOT change the cast's bits (gated bitwise in
+# tools/bench_reduce.py --smoke).  Counters are float32 scalars —
+# exact for any count below 2^24, and immune to the int32 wrap that a
+# pod-scale psum (n_params x world) or a faithful-GEMM scan total
+# (5·K·M·N) would hit; at those magnitudes the ~1e-7 relative rounding
+# is noise against the supervisor's rate threshold.  Summable across
+# leaves, sites and replicas (lax.psum).
+# --------------------------------------------------------------------------
+
+HEALTH_FIELDS = ("sat", "underflow", "nan", "total")
+
+
+def quant_health(x: jnp.ndarray, q: jnp.ndarray) -> dict:
+    """{sat, underflow, nan, total} float32 scalars for one cast's input
+    `x` and output `q` (see the block comment above for the exact
+    definitions, including why float32 and not int32 — the pod-scale
+    overflow).  `total` is the element count, so callers can turn sums
+    into rates.
+
+    Zero-ness is decided on the BIT PATTERN, not by a float compare:
+    XLA's CPU backend compares under DAZ semantics, where an fp32
+    subnormal == 0.0 — a value compare would both miss the
+    subnormal-input flush (the reference's own flush case,
+    float_kernel.cu:87-91) and falsely flag e8 formats' legitimate
+    subnormal OUTPUTS as underflow."""
+    x = jnp.asarray(x, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    mag = jnp.uint32(0x7FFFFFFF)
+    x_nonzero = (jax.lax.bitcast_convert_type(x, jnp.uint32) & mag) != 0
+    q_zero = (jax.lax.bitcast_convert_type(q, jnp.uint32) & mag) == 0
+    f32 = jnp.float32
+    return {
+        "sat": jnp.sum(jnp.isinf(q).astype(f32)),
+        "underflow": jnp.sum((q_zero & x_nonzero
+                              & jnp.isfinite(x)).astype(f32)),
+        "nan": jnp.sum(jnp.isnan(x).astype(f32)),
+        "total": jnp.asarray(x.size, f32),
+    }
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def cast_to_format_stats(x: jnp.ndarray, exp_bits: int,
+                         man_bits: int) -> tuple:
+    """`cast_to_format` plus its health counters: ``(q, health)`` where
+    ``q`` is BITWISE identical to the plain cast (same `cast_body`) and
+    ``health`` is `quant_health(x, q)` (float32 scalars)."""
+    q = cast_body(x, exp_bits, man_bits)
+    return q, quant_health(x, q)
 
 
 # --------------------------------------------------------------------------

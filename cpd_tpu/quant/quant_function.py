@@ -27,9 +27,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .numerics import cast_to_format, cast_to_format_sr
+from .numerics import (HEALTH_FIELDS, cast_to_format, cast_to_format_sr,
+                       quant_health)
 
-__all__ = ["float_quantize", "quantizer", "quantizer_sr", "quant_gemm"]
+__all__ = ["float_quantize", "quantizer", "quantizer_sr", "quant_gemm",
+           "float_quantize_stats", "quant_gemm_stats", "quantizer_stats",
+           "tree_quant_health", "HEALTH_FIELDS"]
 
 
 def _site_key(key_data, site: int):
@@ -74,6 +77,39 @@ def float_quantize(x: jnp.ndarray, exp: int, man: int,
     if _validate_rounding(rounding, key):
         return cast_to_format_sr(x, exp, man, key)
     return cast_to_format(x, exp, man)
+
+
+def float_quantize_stats(x: jnp.ndarray, exp: int, man: int,
+                         rounding: str = "nearest", key=None) -> tuple:
+    """`float_quantize` plus its numeric-health counters.
+
+    Returns ``(q, health)`` where ``q`` is BITWISE identical to
+    ``float_quantize(x, exp, man, rounding, key)`` — telemetry observes
+    the cast's (input, output) pair, it never touches the cast itself
+    (gated in tools/bench_reduce.py --smoke across formats × rounding) —
+    and ``health`` is `numerics.quant_health`'s {sat, underflow, nan,
+    total} float32 scalars (the precision supervisor's sensor,
+    resilience/precision.py)."""
+    q = float_quantize(x, exp, man, rounding=rounding, key=key)
+    return q, quant_health(x, q)
+
+
+def tree_quant_health(before: jnp.ndarray, after) -> dict:
+    """Summed `quant_health` over two matching pytrees (cast inputs and
+    outputs, leaf for leaf).  Empty trees report all-zero counters."""
+    out = {f: jnp.zeros([], jnp.float32) for f in HEALTH_FIELDS}
+    for b, a in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        h = quant_health(b, a)
+        out = {f: out[f] + h[f] for f in HEALTH_FIELDS}
+    return out
+
+
+def _health_vec(x, q) -> jnp.ndarray:
+    """quant_health as a float32 (4,) vector in HEALTH_FIELDS order —
+    the form that can ride autodiff cotangents (quantizer_stats)."""
+    h = quant_health(x, q)
+    return jnp.stack([h[f].astype(jnp.float32) for f in HEALTH_FIELDS])
 
 
 def quantizer(forward_exp: int = 8, forward_man: int = 23,
@@ -128,6 +164,130 @@ def quantizer_sr(forward_exp: int = 8, forward_man: int = 23,
     return _round
 
 
+def quantizer_stats(forward_exp: int = 8, forward_man: int = 23,
+                    backward_exp: int = 8, backward_man: int = 23):
+    """Stats-counting `quantizer`: both cast sites observed, neither
+    changed.
+
+    Returns ``fn(x, tap)`` where ``tap`` is a float32 (4,) zeros array.
+    Forward: ``fn`` returns ``(y, fwd_health)`` with ``y`` bitwise
+    identical to `quantizer`'s output and ``fwd_health`` the float32
+    [sat, underflow, nan, total] vector (HEALTH_FIELDS order) of the
+    forward activation cast.  Backward: a VJP cannot emit primal
+    outputs, so the *backward* cast's health rides the one channel
+    autodiff provides — the cotangent returned for the otherwise-unused
+    ``tap`` input:
+
+        (y, fwd_h), vjp = jax.vjp(fn, x, jnp.zeros(4))
+        gx, bwd_h = vjp((g, jnp.zeros(4)))
+
+    ``gx`` is bitwise identical to `quantizer`'s backward cast of ``g``;
+    ``bwd_h`` is its health vector.  The (8, 23) shortcuts keep identity
+    semantics on either side and report a counted no-op (sat/underflow
+    only from values already Inf/0 in the data)."""
+
+    @jax.custom_vjp
+    def _round(x, tap):
+        if forward_exp == 8 and forward_man == 23:
+            q = x
+        else:
+            q = cast_to_format(x, forward_exp, forward_man)
+        return q, _health_vec(x, q)
+
+    def _round_fwd(x, tap):
+        return _round(x, tap), None
+
+    def _round_bwd(_, cot):
+        g, _unused_health_cot = cot
+        if backward_exp == 8 and backward_man == 23:
+            gq = g
+        else:
+            gq = cast_to_format(g, backward_exp, backward_man)
+        return gq, _health_vec(g, gq)
+
+    _round.defvjp(_round_fwd, _round_bwd)
+    return _round
+
+
+def _quant_gemm_impl(a: jnp.ndarray, b: jnp.ndarray, man: int, exp: int,
+                     mode: str, rounding: str, key, with_stats: bool):
+    """Shared gemm body of `quant_gemm` / `quant_gemm_stats`.  With
+    `with_stats` the five per-K-step accumulator casts (or the fast
+    mode's output cast) are additionally observed by `quant_health` —
+    same ops, same order, bitwise-identical product; the counters ride
+    the scan carry as one float32 (4,) vector."""
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+        raise ValueError(f"quant_gemm expects (M,K)x(K,N); got {a.shape} x {b.shape}")
+    sr = _validate_rounding(rounding, key)
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    def health_dict(vec):
+        return {f: vec[i] for i, f in enumerate(HEALTH_FIELDS)}
+
+    if mode == "fast":
+        # True fp32 MXU dot (HIGHEST forces fp32 multiply passes on TPU,
+        # where the default would be bf16) followed by one output cast.
+        out = jnp.dot(a, b, precision=lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+        if exp == 8 and man == 23:
+            if with_stats:        # no cast ran: a counted no-op
+                return out, {f: jnp.zeros([], jnp.float32)
+                             for f in HEALTH_FIELDS}
+            return out
+        if sr:
+            cast = cast_to_format_sr(out, exp, man, key)
+        else:
+            cast = cast_to_format(out, exp, man)
+        if with_stats:
+            return cast, quant_health(out, cast)
+        return cast
+    if mode != "faithful":
+        raise ValueError(f"unknown quant_gemm mode: {mode!r}")
+    # NOTE: no (8,23) shortcut here — the reference CUDA kernel runs the
+    # Kahan-compensated sequential loop for every format including fp32
+    # (quant_function.py:78-98 has no shortcut), and cast_to_format(8,23)
+    # still flushes fp32-subnormal intermediates, so bit-parity requires
+    # the full scan.  Use mode="fast" when emulation is not needed.
+
+    M, K = a.shape
+    N = b.shape[1]
+
+    def step(carry, ab_k):
+        s, c, cnt = carry
+        a_k, b_k, i = ab_k  # (M,), (N,), scalar k index
+        healths = []
+        if sr:
+            kk = jax.random.fold_in(key, i)  # one hash per K step
+
+            def q(t, site):
+                out = cast_to_format_sr(t, exp, man,
+                                        jax.random.fold_in(kk, site))
+                if with_stats:
+                    healths.append(_health_vec(t, out))
+                return out
+        else:
+            def q(t, site):
+                out = cast_to_format(t, exp, man)
+                if with_stats:
+                    healths.append(_health_vec(t, out))
+                return out
+        tmp = q(a_k[:, None] * b_k[None, :], 0)
+        y = q(tmp - c, 1)
+        t = q(s + y, 2)
+        c = q(q(t - s, 3) - y, 4)
+        if with_stats:
+            cnt = cnt + sum(healths)
+        return (t, c, cnt), None
+
+    init = (jnp.zeros((M, N), jnp.float32), jnp.zeros((M, N), jnp.float32),
+            jnp.zeros((len(HEALTH_FIELDS),), jnp.float32))
+    (s, _, cnt), _ = lax.scan(step, init, (a.T, b, jnp.arange(K)))
+    if with_stats:
+        return s, health_dict(cnt)
+    return s
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
 def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
                mode: str = "faithful", rounding: str = "nearest",
@@ -158,51 +318,22 @@ def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
     bitstream per (k, site)): the accumulator analog of the SR gradient
     pipeline, for emulating stochastic-rounding accumulators.
     """
-    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
-        raise ValueError(f"quant_gemm expects (M,K)x(K,N); got {a.shape} x {b.shape}")
-    sr = _validate_rounding(rounding, key)
-    a = jnp.asarray(a, jnp.float32)
-    b = jnp.asarray(b, jnp.float32)
+    return _quant_gemm_impl(a, b, man, exp, mode, rounding, key, False)
 
-    if mode == "fast":
-        # True fp32 MXU dot (HIGHEST forces fp32 multiply passes on TPU,
-        # where the default would be bf16) followed by one output cast.
-        out = jnp.dot(a, b, precision=lax.Precision.HIGHEST,
-                      preferred_element_type=jnp.float32)
-        if exp == 8 and man == 23:
-            return out
-        if sr:
-            return cast_to_format_sr(out, exp, man, key)
-        return cast_to_format(out, exp, man)
-    if mode != "faithful":
-        raise ValueError(f"unknown quant_gemm mode: {mode!r}")
-    # NOTE: no (8,23) shortcut here — the reference CUDA kernel runs the
-    # Kahan-compensated sequential loop for every format including fp32
-    # (quant_function.py:78-98 has no shortcut), and cast_to_format(8,23)
-    # still flushes fp32-subnormal intermediates, so bit-parity requires
-    # the full scan.  Use mode="fast" when emulation is not needed.
 
-    M, K = a.shape
-    N = b.shape[1]
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def quant_gemm_stats(a: jnp.ndarray, b: jnp.ndarray, man: int = 23,
+                     exp: int = 8, mode: str = "faithful",
+                     rounding: str = "nearest", key=None) -> tuple:
+    """`quant_gemm` plus accumulator health: ``(out, health)``.
 
-    def step(carry, ab_k):
-        s, c = carry
-        a_k, b_k, i = ab_k  # (M,), (N,), scalar k index
-        if sr:
-            kk = jax.random.fold_in(key, i)  # one hash per K step
-
-            def q(t, site):
-                return cast_to_format_sr(t, exp, man,
-                                         jax.random.fold_in(kk, site))
-        else:
-            def q(t, site):
-                return cast_to_format(t, exp, man)
-        tmp = q(a_k[:, None] * b_k[None, :], 0)
-        y = q(tmp - c, 1)
-        t = q(s + y, 2)
-        c = q(q(t - s, 3) - y, 4)
-        return (t, c), None
-
-    init = (jnp.zeros((M, N), jnp.float32), jnp.zeros((M, N), jnp.float32))
-    (s, _), _ = lax.scan(step, init, (a.T, b, jnp.arange(K)))
-    return s
+    ``out`` is BITWISE identical to ``quant_gemm(...)`` (the stats ride
+    the scan carry without touching the accumulation); ``health`` sums
+    `quant_health` over EVERY cast the mode performs — faithful: all
+    five per-K-step intermediates (total = 5·K·M·N), fast: the single
+    output cast (zero counters at the (8,23) no-cast shortcut;
+    float32 — a faithful GEMM's 5·K·M·N total would wrap int32).  A
+    rising ``sat`` here means the accumulator format can no longer hold
+    the running dot products — the GEMM-site feed of the precision
+    supervisor's escalation ladder (resilience/precision.py)."""
+    return _quant_gemm_impl(a, b, man, exp, mode, rounding, key, True)

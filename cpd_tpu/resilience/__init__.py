@@ -24,6 +24,13 @@ GradScaler-style skip); this package adds
   ladder (ring -> faithful -> fp32) driven by the self-verifying
   reduce's checksums (parallel/integrity.py), with bounded same-step
   retries and probation back up;
+* **precision** — :class:`PrecisionSupervisor`: the eXmY
+  format-escalation ladder driven by the in-jit numeric-health
+  counters (quant.numerics.quant_health via
+  ``sum_gradients(stats=True)``): sustained saturation/NaN at a quant
+  site escalates the format one configured rung (re-traced via the
+  same StepTable machinery), quiet steps probation back down, and the
+  ladder state persists in checkpoints so restarts resume escalated;
 * **loop** — :func:`run_guarded`: the defenses composed around any
   ``(state, x, y) -> (state, metrics)`` step, with integrity-checked
   checkpoint rollback, bounded re-seeded retries, verified-reduce
@@ -39,6 +46,8 @@ from .guard import (GradGuardState, describe_culprit, find_guard,
                     guard_metrics, with_grad_guard)
 from .sentinel import DivergenceSentinel
 from .transport import StepTable, TransportSupervisor, level_reduce_kwargs
+from .precision import (PrecisionSupervisor, format_name, ladder_step_key,
+                        parse_format, parse_ladder)
 from .watchdog import StepWatchdog
 from .loop import GuardedReport, run_guarded
 
@@ -49,5 +58,7 @@ __all__ = [
     "describe_culprit",
     "DivergenceSentinel", "StepWatchdog",
     "TransportSupervisor", "StepTable", "level_reduce_kwargs",
+    "PrecisionSupervisor", "parse_format", "parse_ladder", "format_name",
+    "ladder_step_key",
     "run_guarded", "GuardedReport",
 ]

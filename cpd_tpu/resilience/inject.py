@@ -27,7 +27,8 @@ by :meth:`FaultPlan.to_json`):
 
 e.g. ``grad_nan@3;stall@5:1.5;ckpt_truncate@6;loss_spike@8:1e6``.
 ``arg`` means: shard index for ``grad_*`` (-1 = every shard, the
-default), RANK for ``wire_*`` (-1 = rank 0), seconds for ``stall``,
+default), RANK for ``wire_*`` (-1 = rank 0), the log2 scale factor for
+``sat_pressure`` (-1 = 24, i.e. ×2^24), seconds for ``stall``,
 multiplier for ``loss_spike`` / ``batch_scale``; ignored elsewhere.
 
 A third executor consumes the ``wire_*`` kinds (``wire_flip@s:k``,
@@ -38,6 +39,17 @@ deterministic (same seed/plan ⇒ same corruption), detected by the
 integrity checksums (parallel/integrity.py) when the reduce runs with
 ``verify=True``.  :meth:`FaultPlan.wire_schedule` compiles them into
 the dense (codes, ranks) table the step builders bake in.
+
+A fourth executor consumes ``sat_pressure@s:k`` (the scale-blowup
+attack of the precision ladder, resilience/precision.py): the step
+builders bake :meth:`FaultPlan.sat_schedule`'s dense exponent table
+into the program and scale step ``s``'s LOCAL post-backward gradients
+by ``2^k`` (default k=24) BEFORE the emulate-node reduce and the
+quantized collective — an exact power-of-two, identical on every rank,
+that deterministically drives the reduce-wire cast into saturation.
+Schedule ``patience`` consecutive specs to force an escalation; the
+same plan without the ladder is the degradation baseline (the grad
+guard skips the saturated steps, or the loss blows up).
 
 ``step`` convention: the 0-based optimizer-UPDATE index — one clock for
 both executors, so ``grad_nan@3`` and ``stall@3`` hit the same physical
@@ -60,13 +72,19 @@ import numpy as np
 
 __all__ = ["FaultSpec", "FaultPlan", "Injector", "InjectedPreemption",
            "with_fault_injection", "report_unfired", "GRAD_KINDS",
-           "HOST_KINDS", "WIRE_KINDS"]
+           "HOST_KINDS", "WIRE_KINDS", "SAT_KINDS",
+           "SAT_PRESSURE_DEFAULT_EXP"]
 
 # jit-level kinds -> corruption opcode in the compiled fault table
 GRAD_KINDS = {"grad_nan": 1, "grad_inf": 2, "grad_blowup": 3}
 # wire-level kinds -> corruption opcode inside ring_quantized_sum
 # (parallel/ring.py _apply_hop_fault / the gather-wire fault)
 WIRE_KINDS = {"wire_flip": 1, "wire_stale": 2, "wire_drop": 3}
+# saturation-pressure kind, executed by the step builders' baked 2^k
+# gradient-scale table (train/step.py, train/lm.py sat_fault_plan) —
+# the attack the precision ladder is exercised against
+SAT_KINDS = frozenset({"sat_pressure"})
+SAT_PRESSURE_DEFAULT_EXP = 24          # arg -1 -> scale by 2^24
 # host-level kinds, executed by the Injector around the step call
 HOST_KINDS = frozenset({
     "batch_nan",       # poison one element of the first float batch leaf
@@ -79,7 +97,8 @@ HOST_KINDS = frozenset({
     "ckpt_bitflip",    # flip one byte in the newest checkpoint
     "loss_spike",      # multiply the observed loss metric by `arg`
 })
-_ALL_KINDS = frozenset(GRAD_KINDS) | HOST_KINDS | frozenset(WIRE_KINDS)
+_ALL_KINDS = (frozenset(GRAD_KINDS) | HOST_KINDS | frozenset(WIRE_KINDS)
+              | SAT_KINDS)
 
 
 class InjectedPreemption(BaseException):
@@ -194,6 +213,9 @@ class FaultPlan:
     def wire_faults(self) -> tuple:
         return tuple(f for f in self.faults if f.kind in WIRE_KINDS)
 
+    def sat_faults(self) -> tuple:
+        return tuple(f for f in self.faults if f.kind in SAT_KINDS)
+
     def host_faults(self) -> dict:
         """step -> [FaultSpec] for the host-level kinds."""
         out: dict = {}
@@ -227,6 +249,33 @@ class FaultPlan:
                 codes[f.step] = WIRE_KINDS[f.kind]
                 ranks[f.step] = max(int(f.arg), 0)
         return codes, ranks
+
+    def sat_schedule(self, n_steps: int):
+        """Dense int32 log2-scale table for the step builders' baked
+        saturation-pressure attack (``sat_fault_plan=``); entry ``i``
+        scales optimizer update ``i``'s local gradients by ``2^exps[i]``
+        (0 = off — an exact no-op).  ``arg`` is the exponent (-1 ->
+        `SAT_PRESSURE_DEFAULT_EXP`); at most one pressure per step (the
+        last spec wins)."""
+        exps = np.zeros((max(n_steps, 1),), np.int32)
+        for f in self.sat_faults():
+            if f.step < n_steps:
+                exps[f.step] = (SAT_PRESSURE_DEFAULT_EXP if f.arg < 0
+                                else int(f.arg))
+        return exps
+
+
+def sat_pressure_factor(table, step):
+    """The 2^k gradient scale for optimizer update ``step`` from a dense
+    `FaultPlan.sat_schedule` table — jit-safe, the ONE lookup shared by
+    the step builders (train/step.py, train/lm.py) so the clip/where
+    indexing cannot drift between them.  Entry 0 -> 2^0 == 1.0, an
+    exact fp32 no-op; steps past the table are unpressured."""
+    import jax.numpy as jnp
+    exps = jnp.asarray(table, jnp.int32)
+    idx = jnp.clip(step, 0, exps.shape[0] - 1)
+    e = jnp.where(step < exps.shape[0], exps[idx], 0)
+    return jnp.exp2(e.astype(jnp.float32))
 
 
 # ---------------------------------------------------------------------------
@@ -448,7 +497,8 @@ class Injector:
 
 def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
                    = None, meter=None, rank: int = 0,
-                   wire_armed: bool = True) -> list:
+                   wire_armed: bool = True,
+                   sat_armed: bool = True) -> list:
     """The ONE end-of-run check every loop calls: which planned faults
     never fired?  A chaos run that silently skipped a fault proves
     nothing — the usual causes are a plan step beyond the run's
@@ -456,21 +506,25 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     silent user errors until this surfaces them.
 
     Covers the host-level one-shots (``Injector.unfired()``), the
-    jit-level grad/wire specs scheduled past the end of the compiled
+    jit-level grad/wire/sat specs scheduled past the end of the compiled
     fault table (when ``n_steps`` is given — the schedule builders drop
     those without a sound), and — when the caller passes
-    ``wire_armed=False`` — EVERY wire spec, because the run's reduction
-    never baked in the wire table (e.g. ``wire_flip`` planned for a
-    faithful-mode run; the trainers compute this from their transport
-    config).  Bumps the meter's ``faults_unfired`` counter and warns on
-    rank 0; returns the sorted leftover list (empty = every planned
-    fault fired)."""
+    ``wire_armed=False`` / ``sat_armed=False`` — EVERY wire / sat spec,
+    because the run's step never baked the corresponding table in
+    (e.g. ``wire_flip`` planned for a faithful-mode run, or
+    ``sat_pressure`` planned for a pp/moe run whose stepper takes no
+    ``sat_fault_plan``; the trainers compute both from their config).
+    Bumps the meter's ``faults_unfired`` counter and warns on rank 0;
+    returns the sorted leftover list (empty = every planned fault
+    fired)."""
     if injector is None:
         return []
     leftover = list(injector.unfired())
-    for f in injector.plan.grad_faults() + injector.plan.wire_faults():
+    for f in (injector.plan.grad_faults() + injector.plan.wire_faults()
+              + injector.plan.sat_faults()):
         past = n_steps is not None and f.step >= n_steps
-        unwired = not wire_armed and f.kind in WIRE_KINDS
+        unwired = ((not wire_armed and f.kind in WIRE_KINDS)
+                   or (not sat_armed and f.kind in SAT_KINDS))
         if past or unwired:
             leftover.append(f)
     leftover = sorted(set(leftover))

@@ -37,6 +37,18 @@ Recovery policies, in the order they can fire:
 * **replica drift** — every ``consensus_every`` accepted steps the
   cheap parameter-consensus digest runs; a mismatch re-syncs the state
   from rank 0 (bitwise) and counts ``resyncs``.
+* **numeric-health escalation** — the step's ``prec_wire_*`` telemetry
+  (quantization saturation/underflow/NaN at the reduce wire,
+  `sum_gradients(stats=True)`) feeds the `PrecisionSupervisor`
+  (resilience/precision.py): a sustained hot sat+NaN rate escalates the
+  eXmY format one rung up the configured ladder (the next iteration
+  fetches the re-traced step from ``step_for_level``), quiet steps
+  probation back down — never below home.  Escalation is
+  forward-looking: the tripping step's update is KEPT (if its values
+  went non-finite the grad guard already skipped it) — the ladder
+  changes what the NEXT steps pay.  The supervisor's state rides every
+  checkpoint's metadata sidecar and is restored on rollback, so a
+  replay resumes at the escalated format.
 
 Anomalous gradient steps (non-finite / spike / replica disagreement)
 never reach this file: the GradGuard optax wrapper already skipped them
@@ -77,7 +89,8 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 supervisor=None, step_for_level=None,
                 resync_fn: Optional[Callable] = None,
                 consensus_fn: Optional[Callable] = None,
-                consensus_every: int = 0):
+                consensus_every: int = 0,
+                precision=None):
     """Drive ``step_fn`` to ``n_steps`` under the defense stack.
 
     step_fn: jitted ``(state, *batch) -> (state, metrics)`` with a
@@ -103,15 +116,27 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
     consensus_fn / consensus_every: the periodic parameter-consensus
         digest check (``state -> int32 agree``) and its cadence in
         accepted steps (0 = off; requires resync_fn).
+    precision: resilience.precision.PrecisionSupervisor — enables the
+        eXmY format-escalation ladder; requires ``step_for_level``,
+        whose keys follow `precision.ladder_step_key` (the (exp, man)
+        tuple alone, or ``(transport_level, (exp, man))`` when composed
+        with a TransportSupervisor).  Steps must be built with
+        ``quant_stats=True`` so the prec_wire_* metrics exist (a
+        telemetry-less step reads as permanently quiet).
 
     Returns ``(state, GuardedReport)``; the report's ``events`` list is
     the determinism witness.
     """
     from ..train.metrics import ResilienceMeter
+    from .precision import ladder_step_key
     meter = meter if meter is not None else ResilienceMeter()
     if supervisor is not None and step_for_level is None:
         raise ValueError("supervisor requires step_for_level (a level -> "
                          "step mapping, e.g. transport.StepTable)")
+    if precision is not None and step_for_level is None:
+        raise ValueError("precision requires step_for_level (a format -> "
+                         "step mapping, e.g. transport.StepTable keyed "
+                         "by precision.ladder_step_key)")
     if consensus_every and (consensus_fn is None or resync_fn is None):
         raise ValueError("consensus_every needs both consensus_fn and "
                          "resync_fn")
@@ -125,7 +150,12 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
     def save(step, tag):
         if manager is None:
             return
-        manager.save(step, state, force=True)
+        # supervisor state rides the metadata sidecar so a restore (the
+        # rollback below, or a later restart) resumes the ladder where
+        # it stood — e.g. mid-escalation — instead of re-diverging
+        meta = ({"precision": precision.state_dict()}
+                if precision is not None else None)
+        manager.save(step, state, force=True, metadata=meta)
         manager.wait()
         events.append((tag, step))
         if injector is not None and injector.corrupt_checkpoint(
@@ -178,8 +208,8 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 watchdog.arm(it, counters=meter.as_dict())
             if injector is not None:
                 injector.maybe_stall(it)
-            fn = (step_for_level[supervisor.mode]
-                  if supervisor is not None else step_fn)
+            lkey = ladder_step_key(supervisor, precision)
+            fn = step_for_level[lkey] if lkey is not None else step_fn
             new_state, metrics = fn(state, *batch)
             loss = float(metrics["loss"])      # device sync
             if watchdog is not None:
@@ -244,6 +274,21 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 events.append(("transport_up", it, supervisor.mode))
 
         meter.observe_metrics(metrics)
+        # --- precision-ladder supervision (ISSUE 5) -------------------
+        # runs only on ACCEPTED steps (a wire-fault discard above never
+        # reaches here — its telemetry came from a corrupted reduce).
+        # The update is kept either way; the ladder re-formats the NEXT
+        # step (precision.py: escalation is forward-looking).
+        if precision is not None:
+            pact = precision.on_metrics(it, metrics)
+            if precision.last_hot:
+                meter.bump("sat_hot_steps")
+            if pact == "escalate":
+                meter.bump("precision_escalations")
+                events.append(("precision_up", it, precision.name))
+            elif pact == "deescalate":
+                meter.bump("precision_deescalations")
+                events.append(("precision_down", it, precision.name))
         if injector is not None:
             loss = injector.fault_loss(it, loss)
         if on_step is not None:
@@ -270,6 +315,14 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 # the silent-integrity gap, made loud
                 meter.bump("ckpts_unverified")
                 events.append(("ckpt_unverified", res.step))
+            if precision is not None and (res.metadata or {}
+                                          ).get("precision"):
+                # resume the ladder where the checkpoint left it (e.g.
+                # mid-escalation) — replaying at home would re-diverge
+                # into the exact saturation the escalation escaped
+                precision.load_state_dict(res.metadata["precision"])
+                events.append(("precision_restored", res.step,
+                               precision.name))
             state = res.state
             it = int(res.step)
             rollbacks += 1

@@ -1,0 +1,276 @@
+"""Precision supervision — the eXmY format-escalation ladder.
+
+The paper's premise is that a *well-chosen* eXmY format trains
+accurately; every format in this framework is chosen once at launch.
+But a long run visits regimes the launch-time choice never saw: gradient
+magnitudes drift, the reduce wire starts saturating to ±Inf or flushing
+to zero, and by the time the loss (or the grad guard) notices, the
+damage is steps old.  PR 4 built the reflex for the *transport*
+(`transport.TransportSupervisor`'s ring → faithful → fp32 ladder); this
+module is the same reflex for *precision itself*:
+
+    e4m3 ──(sat/NaN rate hot for K steps)──> e5m7 ──(again)──> e8m23
+      ^                                        |                 |
+      └────── probation: N quiet steps ────────┴──── N quiet ────┘
+
+* **sense** — the in-jit numeric-health counters
+  (`quant.numerics.quant_health`, threaded through
+  `sum_gradients(stats=True)` into the step metrics as
+  ``prec_wire_sat`` / ``prec_wire_nan`` / ``prec_wire_underflow`` /
+  ``prec_wire_total`` / ``prec_aps_bad``).  They are psum-agreed across
+  replicas, so every host sees the same verdict and escalates in
+  lockstep.
+* **escalate** — when the agreed saturation+NaN rate exceeds
+  ``threshold`` for ``patience`` consecutive steps (or APS reports
+  non-finite gradient leaves), move one rung up the configured format
+  schedule.  The loop re-traces the train step at the new format via
+  the same `StepTable` machinery the transport ladder uses.
+* **probation** — after ``probation`` consecutive quiet steps at an
+  escalated rung, move one rung back down — never below the configured
+  home format (rung 0): the run earns its cheap format back, it is
+  never silently migrated to a format the user did not configure.
+* **persist** — `state_dict()` is JSON-able and rides the checkpoint
+  metadata sidecar (`CheckpointManager.save(metadata=...)`), so a
+  restart resumes AT the escalated format instead of re-diverging from
+  the home format (`load_state_dict`, fed from
+  `RestoreResult.metadata` / `CheckpointManager.metadata()`).
+
+The supervisor is pure host state — no RNG, no wall clock — so a run
+under a deterministic ``FaultPlan`` (the ``sat_pressure`` attack,
+resilience/inject.py) replays its exact transition sequence (asserted
+in tests/test_precision.py).  `run_guarded` (resilience/loop.py) drives
+it; the lm and resnet18 CLIs wire the same ladder via
+``--precision-ladder`` / ``--sat-threshold`` / ``--sat-patience`` /
+``--precision-probation``.
+
+Escalation is *forward-looking*: the step that tripped the detector
+already ran at the old format, and its update is kept (when the values
+actually went non-finite, the grad guard's skip — a separate, composing
+defense — already zeroed it).  The ladder changes what the NEXT steps
+pay, which is the honest contract: telemetry cannot un-round a cast
+that already happened.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence
+
+__all__ = ["PrecisionSupervisor", "parse_format", "parse_ladder",
+           "format_name", "ladder_step_key", "resolve_ladder_key"]
+
+_FMT_RE = re.compile(r"^e(\d{1,2})m(\d{1,2})$")
+
+
+def parse_format(text) -> tuple:
+    """'eXmY' (or an (exp, man) pair) -> validated (exp, man) tuple."""
+    if isinstance(text, (tuple, list)):
+        exp, man = int(text[0]), int(text[1])
+    else:
+        m = _FMT_RE.match(str(text).strip().lower())
+        if not m:
+            raise ValueError(f"bad eXmY format spec {text!r} (want e.g. "
+                             f"'e4m3', 'e8m23')")
+        exp, man = int(m.group(1)), int(m.group(2))
+    if not (1 <= exp <= 8):
+        raise ValueError(f"exp_bits must be in [1, 8], got {exp} "
+                         f"(in {text!r})")
+    if not (0 <= man <= 23):
+        raise ValueError(f"man_bits must be in [0, 23], got {man} "
+                         f"(in {text!r})")
+    return (exp, man)
+
+
+def format_name(fmt) -> str:
+    """(exp, man) -> 'eXmY'."""
+    return f"e{int(fmt[0])}m{int(fmt[1])}"
+
+
+def parse_ladder(text) -> tuple:
+    """'e4m3,e5m7,e8m23' (or a sequence of specs) -> tuple of (exp, man).
+
+    Rung 0 is the HOME format; each subsequent rung must strictly widen
+    the representable range (`numerics.max_finite`) — an escalation that
+    cannot hold larger values would be a lateral move the saturation
+    detector re-trips on forever."""
+    from ..quant.numerics import max_finite
+    parts = ([p for p in str(text).replace(";", ",").split(",")
+              if p.strip()] if isinstance(text, str) else list(text))
+    if len(parts) < 2:
+        raise ValueError(f"a precision ladder needs >= 2 rungs (home + at "
+                         f"least one escalation), got {text!r}")
+    fmts = tuple(parse_format(p) for p in parts)
+    for lo, hi in zip(fmts, fmts[1:]):
+        if max_finite(*hi) <= max_finite(*lo):
+            raise ValueError(
+                f"ladder rung {format_name(hi)} does not widen the "
+                f"range over {format_name(lo)} (max_finite "
+                f"{max_finite(*hi):.4g} <= {max_finite(*lo):.4g}); "
+                f"order rungs from home to widest")
+    return fmts
+
+
+def ladder_step_key(transport=None, precision=None):
+    """The ONE `StepTable` key derivation shared by `run_guarded` and
+    the trainer CLIs, covering every supervisor combination:
+
+      transport only          -> the level name (PR-4 compatible)
+      precision only          -> the (exp, man) format tuple
+      both                    -> (level, (exp, man))
+      neither                 -> None (caller uses its fixed step)
+    """
+    if transport is not None and precision is not None:
+        return (transport.mode, precision.fmt)
+    if precision is not None:
+        return precision.fmt
+    if transport is not None:
+        return transport.mode
+    return None
+
+
+def resolve_ladder_key(key, *, transport_on: bool, precision_on: bool,
+                       level: str, fmt: tuple) -> tuple:
+    """Inverse of `ladder_step_key` for StepTable build functions: map a
+    table key back to ``(transport_level, (exp, man))``, filling the
+    coordinate a missing supervisor pins from the run's static config
+    (``level`` = the configured --mode, ``fmt`` = the configured
+    gradient format).  The ONE unpacking shared by the trainer CLIs so
+    the three-way branch cannot drift between them."""
+    if transport_on and precision_on:
+        return key
+    if transport_on:
+        return key, fmt
+    if precision_on:
+        return level, key
+    return level, fmt
+
+
+class PrecisionSupervisor:
+    """The format-escalation state machine (module docstring).
+
+    ``on_metrics(step, metrics)`` -> None | "escalate" | "deescalate";
+    ``fmt`` is the (exp, man) the loop should build/fetch the next step
+    for (`ladder_step_key` + `StepTable`); ``transitions`` is the
+    deterministic (step, from, to) log the chaos tests assert on;
+    ``last_hot`` is the verdict of the most recent observation (the
+    loop's ``sat_hot_steps`` counter feed).
+    """
+
+    def __init__(self, ladder, *, threshold: float = 1e-3,
+                 patience: int = 2, probation: int = 16,
+                 site: str = "wire"):
+        self.ladder = parse_ladder(ladder)
+        if not 0.0 <= threshold < 1.0:
+            raise ValueError(f"threshold is a rate in [0, 1), got "
+                             f"{threshold}")
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        if probation < 1:
+            raise ValueError(f"probation must be >= 1, got {probation}")
+        self.threshold = float(threshold)
+        self.patience = int(patience)
+        self.probation = int(probation)
+        self.site = site
+        self._level = 0        # index into ladder; 0 == home
+        self.hot = 0           # consecutive hot observations
+        self.quiet = 0         # consecutive quiet observations
+        self.last_hot = False
+        self.transitions: list = []   # (step, from_name, to_name)
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def fmt(self) -> tuple:
+        """The (exp, man) the next step should run at."""
+        return self.ladder[self._level]
+
+    @property
+    def home(self) -> tuple:
+        """Rung 0 — the configured format; probation never goes below."""
+        return self.ladder[0]
+
+    @property
+    def name(self) -> str:
+        return format_name(self.fmt)
+
+    @property
+    def escalated(self) -> bool:
+        return self._level > 0
+
+    # -- the state machine ------------------------------------------------
+
+    def observe(self, sat: float, nan: float, total: float,
+                aps_bad: float = 0.0) -> bool:
+        """Raw-counter form of the hot/quiet verdict: True when the
+        agreed saturation+NaN rate exceeds the threshold, or APS saw
+        non-finite gradient leaves (`aps_shift_factors_checked`)."""
+        rate = (float(sat) + float(nan)) / max(float(total), 1.0)
+        return rate > self.threshold or float(aps_bad) > 0.0
+
+    def on_metrics(self, step: int, metrics: dict) -> Optional[str]:
+        """Feed one accepted step's metric dict (the ``prec_<site>_*``
+        replicated scalars the step builders emit); returns "escalate" /
+        "deescalate" when the ladder moves, else None.  Metrics without
+        the telemetry keys (telemetry off) read as quiet."""
+        p = f"prec_{self.site}_"
+        hot = self.observe(metrics.get(p + "sat", 0.0),
+                           metrics.get(p + "nan", 0.0),
+                           metrics.get(p + "total", 0.0),
+                           metrics.get("prec_aps_bad", 0.0))
+        self.last_hot = hot
+        if hot:
+            self.quiet = 0
+            self.hot += 1
+            if self.hot >= self.patience and \
+                    self._level + 1 < len(self.ladder):
+                old = self.name
+                self._level += 1
+                self.hot = 0
+                self.transitions.append((step, old, self.name))
+                return "escalate"
+            return None
+        self.hot = 0
+        self.quiet += 1
+        if self._level > 0 and self.quiet >= self.probation:
+            old = self.name
+            self._level -= 1
+            self.quiet = 0
+            self.transitions.append((step, old, self.name))
+            return "deescalate"
+        return None
+
+    # -- checkpoint persistence -------------------------------------------
+
+    def state_dict(self) -> dict:
+        """JSON-able snapshot for the checkpoint metadata sidecar: a
+        restart resumes AT the escalated format (acceptance criterion)
+        instead of re-diverging from home."""
+        return {
+            "ladder": [list(f) for f in self.ladder],
+            "site": self.site,
+            "level": self._level,
+            "hot": self.hot,
+            "quiet": self.quiet,
+            "transitions": [list(t) for t in self.transitions],
+        }
+
+    def load_state_dict(self, state: dict) -> "PrecisionSupervisor":
+        """Restore a `state_dict` snapshot (returns self).  The saved
+        ladder must match the configured one — resuming level 2 of a
+        DIFFERENT schedule would silently run an unintended format; a
+        reconfigured run should start the new ladder from home
+        (and gets told so explicitly here)."""
+        saved = tuple(tuple(f) for f in state["ladder"])
+        if saved != self.ladder:
+            raise ValueError(
+                f"checkpointed precision ladder "
+                f"{[format_name(f) for f in saved]} does not match the "
+                f"configured {[format_name(f) for f in self.ladder]}; "
+                f"restart with the same --precision-ladder, or drop the "
+                f"flag's saved state by starting a fresh run directory")
+        self._level = min(max(int(state["level"]), 0),
+                          len(self.ladder) - 1)
+        self.hot = int(state.get("hot", 0))
+        self.quiet = int(state.get("quiet", 0))
+        self.transitions = [tuple(t) for t in state.get("transitions", [])]
+        return self

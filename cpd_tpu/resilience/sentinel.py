@@ -9,6 +9,21 @@ sentinel keeps a window of recent finite losses and trips when the new
 loss exceeds ``factor`` x the window median (median, not mean: one
 earlier spike must not inflate the baseline and mask the next one).
 
+``mode="ema"`` (beyond the PR-2 default) is the *drift* detector: the
+median mode is blind to a SLOW upward creep — the signature of quiet
+saturation/underflow at a too-narrow eXmY format, where each step loses
+a little gradient mass and the loss ratchets up gently — because the
+creep drags the window median up with it and the factor-x-median bar is
+never cleared.  EMA mode keeps two exponential averages of the loss, a
+fast one (span ``min_history``) tracking "now" and a slow windowed one
+(span ``window``) tracking "recently", and trips when fast >
+``factor`` x slow: a drift opens a persistent gap between the two long
+before any single step looks like a spike.  Pick a smaller ``factor``
+for this mode (the gap between two EMAs of a drifting series is
+bounded by the drift rate, not by the blow-up size) — the trainers
+expose it as ``--divergence-mode ema``.  The default stays "median":
+existing runs keep the PR-2 behavior bit-for-bit.
+
 The verdict is host-side and replicated-input (the loss metric is
 all-reduced), so every host trips at the same step.  The loop owns the
 recovery: restore the newest *valid* checkpoint, re-seed the data order,
@@ -26,17 +41,27 @@ __all__ = ["DivergenceSentinel"]
 
 class DivergenceSentinel:
     def __init__(self, window: int = 20, factor: float = 10.0,
-                 min_history: int = 5):
+                 min_history: int = 5, mode: str = "median"):
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         if factor <= 1.0:
             raise ValueError(f"factor must be > 1, got {factor}")
+        if mode not in ("median", "ema"):
+            raise ValueError(f"unknown sentinel mode {mode!r}; know "
+                             f"('median', 'ema')")
         self.window = window
         self.factor = factor
+        self.mode = mode
         # a min_history the window can never reach would silently disarm
         # the sentinel (len(deque(maxlen=w)) <= w)
         self.min_history = min(min_history, window)
         self.losses: deque = deque(maxlen=window)
+        # ema state (mode="ema"): standard span -> alpha = 2/(span+1)
+        self._a_fast = 2.0 / (self.min_history + 1)
+        self._a_slow = 2.0 / (self.window + 1)
+        self._fast = 0.0
+        self._slow = 0.0
+        self._count = 0
 
     def update(self, loss: float) -> bool:
         """Record ``loss``; True when it signals divergence.  A diverged
@@ -45,13 +70,34 @@ class DivergenceSentinel:
         loss = float(loss)
         if not math.isfinite(loss):
             return True
+        if self.mode == "ema":
+            return self._update_ema(loss)
         if (len(self.losses) >= self.min_history
                 and loss > self.factor * statistics.median(self.losses)):
             return True
         self.losses.append(loss)
         return False
 
+    def _update_ema(self, loss: float) -> bool:
+        if self._count == 0:
+            self._fast = self._slow = loss
+            self._count = 1
+            return False
+        fast_next = self._fast + self._a_fast * (loss - self._fast)
+        # positive-loss contract (same as factor-x-median): a ratio
+        # test needs a positive baseline; until the slow EMA is, the
+        # drift check stays disarmed (non-finite still trips above)
+        if (self._count >= self.min_history and self._slow > 0.0
+                and fast_next > self.factor * self._slow):
+            return True
+        self._fast = fast_next
+        self._slow = self._slow + self._a_slow * (loss - self._slow)
+        self._count += 1
+        return False
+
     def reset(self) -> None:
         """Forget the history (after a rollback: the restored model's
         losses are the new baseline)."""
         self.losses.clear()
+        self._fast = self._slow = 0.0
+        self._count = 0

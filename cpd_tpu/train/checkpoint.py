@@ -73,6 +73,12 @@ class RestoreResult(NamedTuple):
     # masquerade as a verified one.  False never appears here: digest
     # mismatches are skipped, not restored.
     verified: Any = True
+    # the restored step's metadata sidecar (None when absent) — how
+    # supervisor state saved WITH a checkpoint comes back with it: the
+    # precision ladder resumes at its escalated format
+    # (resilience/precision.py state_dict under the "precision" key)
+    # instead of re-diverging from home after a rollback or restart.
+    metadata: Any = None
 
 
 def preempt_save(manager: "CheckpointManager", step_no, state, rank: int,
@@ -475,7 +481,8 @@ class CheckpointManager:
                       f"corruption would be undetectable here",
                       file=sys.stderr)
             return RestoreResult(state, step, tuple(skipped),
-                                 verified=verdict)
+                                 verified=verdict,
+                                 metadata=self.metadata(step))
         return None
 
     def close(self):

@@ -56,7 +56,9 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        label_smoothing: float = 0.0, rng_seed: int = 0,
                        grad_rounding: str = "nearest", grad_seed: int = 0,
                        verify_reduce: bool = False,
-                       wire_fault_plan=None):
+                       wire_fault_plan=None,
+                       quant_stats: bool = False,
+                       sat_fault_plan=None):
     """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
 
     tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
@@ -69,6 +71,13 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     `train.step.make_train_step` (the reduce_ok/... metrics feed the
     transport supervisor).  The sp/tp psums stay unverified — they are
     XLA's own collectives with no custom wire.
+
+    quant_stats / sat_fault_plan: reduce-wire numeric-health telemetry
+    (``prec_wire_*`` / ``prec_aps_bad`` metrics feeding the
+    `resilience.precision.PrecisionSupervisor`) and the deterministic
+    2^k saturation-pressure table, exactly as on `make_train_step` —
+    the pressure scales the post-sp/tp-psum local gradients, so every
+    dp rank's wire cast sees it identically.
     """
     if not 0.0 <= label_smoothing < 1.0:
         raise ValueError(f"label_smoothing must be in [0, 1), got "
@@ -153,6 +162,13 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             return g
 
         stacked = jax.tree.map(sp_tp_reduce, stacked, specs)
+        if sat_fault_plan is not None:
+            # saturation-pressure attack (resilience/inject.py
+            # `sat_pressure`): 2^k exact power-of-two scaling, shared
+            # lookup (see make_train_step)
+            from ..resilience.inject import sat_pressure_factor
+            sfac = sat_pressure_factor(sat_fault_plan, state.step)
+            stacked = jax.tree.map(lambda g: g * sfac, stacked)
         # SR keys (grad_rounding='stochastic'): the rank-local emulate key
         # folds ONLY the dp index — post-psum grads are identical across
         # sp (and across tp for replicated params), so sp/tp copies must
@@ -180,8 +196,8 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             grad_exp=grad_exp, grad_man=grad_man,
             use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
             key=grad_sr_key(grad_seed, state.step, 1) if sr else None,
-            verify=verify_reduce, wire_fault=wf)
-        if verify_reduce:
+            verify=verify_reduce, wire_fault=wf, stats=quant_stats)
+        if verify_reduce or quant_stats:
             reduced, vreport = reduced
 
         updates, new_opt = tx.update(reduced, state.opt_state, state.params)
@@ -201,11 +217,20 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         }
         if vreport is not None:
             f32 = jnp.float32
-            metrics.update(
-                reduce_ok=vreport["ok"].astype(f32),
-                reduce_hop_bad=vreport["hop_bad"].astype(f32),
-                reduce_gather_bad=vreport["gather_bad"].astype(f32),
-                reduce_agree=vreport["agree"].astype(f32))
+            if verify_reduce:
+                metrics.update(
+                    reduce_ok=vreport["ok"].astype(f32),
+                    reduce_hop_bad=vreport["hop_bad"].astype(f32),
+                    reduce_gather_bad=vreport["gather_bad"].astype(f32),
+                    reduce_agree=vreport["agree"].astype(f32))
+            if quant_stats:
+                metrics.update(
+                    prec_wire_sat=vreport["wire_sat"].astype(f32),
+                    prec_wire_underflow=vreport["wire_underflow"]
+                    .astype(f32),
+                    prec_wire_nan=vreport["wire_nan"].astype(f32),
+                    prec_wire_total=vreport["wire_total"].astype(f32),
+                    prec_aps_bad=vreport["aps_bad"].astype(f32))
         return new_state, metrics
 
     return make_sharded_stepper(

@@ -47,7 +47,12 @@ class ResilienceMeter:
             # host counters, not device mirrors
             "wire_faults_detected", "reduce_retries",
             "transport_downgrades", "transport_upgrades", "resyncs",
-            "ckpts_unverified", "faults_unfired")
+            "ckpts_unverified", "faults_unfired",
+            # precision-ladder accounting (ISSUE 5): hot steps (agreed
+            # sat+NaN rate over the supervisor's threshold) and ladder
+            # moves, decided host-side from the prec_wire_* metrics
+            "sat_hot_steps", "precision_escalations",
+            "precision_deescalations")
     FIELDS = tuple(MIRRORED.values()) + HOST
 
     def __init__(self):
@@ -86,7 +91,10 @@ class ResilienceMeter:
                  "transport_downgrades": "down",
                  "transport_upgrades": "up", "resyncs": "resync",
                  "ckpts_unverified": "unvckpt",
-                 "faults_unfired": "unfired"}
+                 "faults_unfired": "unfired",
+                 "sat_hot_steps": "hot",
+                 "precision_escalations": "esc",
+                 "precision_deescalations": "deesc"}
         parts = [f"{short[f]} {v}" for f, v in self.counts.items() if v]
         return (" " + " ".join(parts)) if parts else ""
 

@@ -107,7 +107,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     params_spec: Optional[Any] = None,
                     unpack_params: Optional[Callable] = None,
                     verify_reduce: bool = False,
-                    wire_fault_plan: Optional[tuple] = None):
+                    wire_fault_plan: Optional[tuple] = None,
+                    quant_stats: bool = False,
+                    sat_fault_plan: Optional[Any] = None):
     """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
 
     images: (global_batch * emulate_node, H, W, C) sharded over `axis_name`;
@@ -137,6 +139,19 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     into the program; entry ``state.step`` corrupts the ring wire on
     that rank (ignored outside mode="ring" — the ring's wire IS the one
     under attack, and downgrading transports is the escape).
+
+    quant_stats=True threads the reduce-wire numeric-health telemetry
+    (`sum_gradients(..., stats=True)`) into the metrics as the
+    replicated scalars ``prec_wire_sat`` / ``prec_wire_underflow`` /
+    ``prec_wire_nan`` / ``prec_wire_total`` / ``prec_aps_bad`` — the
+    feed for `resilience.precision.PrecisionSupervisor`'s escalation
+    ladder.  The gradient path stays bitwise unchanged.  sat_fault_plan
+    is a ``FaultPlan.sat_schedule(n_steps)`` int32 exponent table baked
+    into the program: entry ``state.step`` scales this step's LOCAL
+    post-backward gradients by 2^k before the emulate-node reduce and
+    the quantized collective, deterministically driving the wire cast
+    into saturation (the attack the ladder is exercised against; 0 =
+    off, and scaling by 2^0 == 1.0 is an exact fp32 no-op).
     """
     if grad_rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
@@ -163,6 +178,11 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                          "sum_gradients call; reduce_in_update hands the "
                          "collective to the updater (ZeRO-2/3), which "
                          "does not thread a verification report")
+    if quant_stats and reduce_in_update:
+        raise ValueError("quant_stats=True needs the step's own "
+                         "sum_gradients call; reduce_in_update hands the "
+                         "collective to the updater (ZeRO-2/3), which "
+                         "does not thread a telemetry report")
     has_stats_cache: dict = {}
 
     def local_micro_grads(params, batch_stats, images, labels, world, step,
@@ -254,6 +274,14 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         stacked, new_stats, loss, correct, counted = local_micro_grads(
             model_params, state.batch_stats, images, labels, world,
             state.step, scale)
+        if sat_fault_plan is not None:
+            # saturation-pressure attack (resilience/inject.py
+            # `sat_pressure`): scale this step's local grads by 2^k.  An
+            # exact power of two, rank-agnostic (every replica scales
+            # identically, so replication is preserved)
+            from ..resilience.inject import sat_pressure_factor
+            sfac = sat_pressure_factor(sat_fault_plan, state.step)
+            stacked = jax.tree.map(lambda g: g * sfac, stacked)
 
         # Local emulated-node reduction (mix.py:251-282), then the
         # cross-device low-precision all-reduce (mix.py:286-291).
@@ -289,8 +317,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 local, axis_name, use_aps=use_aps,
                 grad_exp=grad_exp, grad_man=grad_man,
                 use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
-                key=sum_key, verify=verify_reduce, wire_fault=wf)
-            if verify_reduce:
+                key=sum_key, verify=verify_reduce, wire_fault=wf,
+                stats=quant_stats)
+            if verify_reduce or quant_stats:
                 reduced, vreport = reduced
 
         if update_fn is not None:
@@ -341,15 +370,24 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                             1.0),
         }
         if vreport is not None:
-            # replicated scalars: the wire-integrity verdict of THIS
-            # step's reduce (parallel/integrity.py), consumed by the
-            # transport supervisor in the loop
+            # replicated scalars: the wire-integrity verdict / numeric-
+            # health telemetry of THIS step's reduce, consumed by the
+            # transport / precision supervisors in the loop
             f32 = jnp.float32
-            metrics.update(
-                reduce_ok=vreport["ok"].astype(f32),
-                reduce_hop_bad=vreport["hop_bad"].astype(f32),
-                reduce_gather_bad=vreport["gather_bad"].astype(f32),
-                reduce_agree=vreport["agree"].astype(f32))
+            if verify_reduce:
+                metrics.update(
+                    reduce_ok=vreport["ok"].astype(f32),
+                    reduce_hop_bad=vreport["hop_bad"].astype(f32),
+                    reduce_gather_bad=vreport["gather_bad"].astype(f32),
+                    reduce_agree=vreport["agree"].astype(f32))
+            if quant_stats:
+                metrics.update(
+                    prec_wire_sat=vreport["wire_sat"].astype(f32),
+                    prec_wire_underflow=vreport["wire_underflow"]
+                    .astype(f32),
+                    prec_wire_nan=vreport["wire_nan"].astype(f32),
+                    prec_wire_total=vreport["wire_total"].astype(f32),
+                    prec_aps_bad=vreport["aps_bad"].astype(f32))
         return new_state, metrics
 
     if opt_state_spec is None and params_spec is None:

@@ -72,6 +72,14 @@ def add_resilience_flags(parser: argparse.ArgumentParser) -> None:
                    help="divergence sentinel window of recent losses "
                         "(0 = off); trips when loss > factor x median")
     g.add_argument("--divergence-factor", default=10.0, type=float)
+    g.add_argument("--divergence-mode", default="median",
+                   choices=["median", "ema"],
+                   help="sentinel detector: 'median' = factor x window-"
+                        "median spike (default, PR-2 behavior); 'ema' = "
+                        "dual-EMA relative drift — catches the SLOW "
+                        "upward creep of quiet saturation that drags "
+                        "the median up with it (use a smaller factor, "
+                        "e.g. 2)")
     g.add_argument("--max-rollbacks", default=2, type=int,
                    help="bounded retries: rollbacks to the newest valid "
                         "checkpoint before declaring the run diverged")
@@ -96,6 +104,32 @@ def add_resilience_flags(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--transport-probation", default=8, type=int,
                    help="clean verified steps at a degraded transport "
                         "before probation moves one level back up")
+    g.add_argument("--precision-ladder", default=None, metavar="F1,F2,..",
+                   help="eXmY format-escalation ladder (resilience."
+                        "precision): comma list of rungs, home first "
+                        "and range-widening (e.g. 'e5m2,e5m7,e8m23'; "
+                        "the home rung must equal --grad_exp/"
+                        "--grad_man).  Turns on the reduce-wire "
+                        "numeric-health telemetry and escalates the "
+                        "gradient format when the agreed sat+NaN rate "
+                        "stays hot; quiet steps probation back down, "
+                        "never below home; ladder state persists in "
+                        "checkpoints")
+    g.add_argument("--sat-threshold", default=1e-3, type=float,
+                   help="precision ladder: agreed (sat+NaN)/total rate "
+                        "at the reduce wire above which a step is hot")
+    g.add_argument("--sat-patience", default=2, type=int,
+                   help="precision ladder: consecutive hot steps before "
+                        "escalating one rung")
+    g.add_argument("--precision-probation", default=16, type=int,
+                   help="precision ladder: consecutive quiet steps at "
+                        "an escalated rung before stepping one rung "
+                        "back down")
+    g.add_argument("--quant-telemetry", action="store_true",
+                   help="reduce-wire numeric-health counters "
+                        "(prec_wire_sat/underflow/nan + aps_bad "
+                        "metrics) WITHOUT the ladder — observability "
+                        "only (implied by --precision-ladder)")
 
 
 def build_resilience(args: argparse.Namespace, *, n_steps: int,
@@ -156,11 +190,57 @@ def build_resilience(args: argparse.Namespace, *, n_steps: int,
         # modes outside the ladder (e.g. fast) keep THEIR reduction and
         # verify by agreement digest only — detection without a ladder,
         # never a silent swap onto a transport the user didn't configure
+    precision = None
+    ladder_spec = getattr(args, "precision_ladder", None)
+    if ladder_spec:
+        from cpd_tpu.resilience.precision import (PrecisionSupervisor,
+                                                  format_name)
+        precision = PrecisionSupervisor(
+            ladder_spec, threshold=float(args.sat_threshold),
+            patience=int(args.sat_patience),
+            probation=int(args.precision_probation))
+        ge = getattr(args, "grad_exp", None)
+        gm = getattr(args, "grad_man", None)
+        if ge is not None and precision.home != (int(ge), int(gm)):
+            # the ladder's rung 0 IS the run's gradient format; a
+            # mismatch would silently train at a format the flags deny
+            raise ValueError(
+                f"--precision-ladder home rung "
+                f"{format_name(precision.home)} must equal the "
+                f"configured gradient format e{ge}m{gm} "
+                f"(--grad_exp/--grad_man); put e{ge}m{gm} first")
+        if getattr(args, "mode", None) == "ring":
+            # fail at argument time, not hours in: the ring transport's
+            # packed wire (quant.numerics.pack_exmy) needs man_bits >= 2
+            # for its Inf/carry/NaN special codes, and the lazily
+            # compiled escalated step would otherwise hit that
+            # ValueError inside jit tracing at the exact moment the
+            # ladder tries to save the run
+            unpackable = [f for f in precision.ladder
+                          if f[1] < 2 and f != (8, 23)]
+            if unpackable:
+                raise ValueError(
+                    f"--precision-ladder rung(s) "
+                    f"{[format_name(f) for f in unpackable]} cannot "
+                    f"ride the ring transport's packed wire (pack_exmy "
+                    f"needs man_bits >= 2 for the special codes); use "
+                    f"man >= 2 rungs or --mode faithful")
+    sat = plan.sat_faults() if plan is not None else ()
+    quant_stats = bool(precision is not None
+                       or getattr(args, "quant_telemetry", False))
     return {
         "plan": plan,
         "verify": verify,
         "wire_plan": (plan.wire_schedule(n_steps) if wire else None),
         "supervisor": supervisor,
+        # precision-ladder surface (ISSUE 5): the supervisor (None when
+        # --precision-ladder is off), whether step builders should
+        # thread the prec_wire_* telemetry, and the baked 2^k
+        # saturation-pressure table (None when the plan has no
+        # sat_pressure specs)
+        "precision": precision,
+        "quant_stats": quant_stats,
+        "sat_plan": (plan.sat_schedule(n_steps) if sat else None),
         # True only when wrap_tx is not the identity — what actually
         # composes (or not) with custom-update paths like ZeRO
         "wraps_optimizer": bool(guard
@@ -174,10 +254,13 @@ def build_resilience(args: argparse.Namespace, *, n_steps: int,
                                   hard_exit_after=timeout)
                      if timeout > 0 else None),
         "sentinel": (DivergenceSentinel(window,
-                                        factor=args.divergence_factor)
+                                        factor=args.divergence_factor,
+                                        mode=getattr(args,
+                                                     "divergence_mode",
+                                                     "median"))
                      if window > 0 else None),
         "meter": ResilienceMeter(),
         "wrap_tx": wrap_tx,
         "active": bool(plan or guard or timeout > 0 or window > 0
-                       or verify),
+                       or verify or quant_stats),
     }

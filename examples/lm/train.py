@@ -279,12 +279,19 @@ def main(argv=None) -> dict:
     # resilience stack (docs/RESILIENCE.md): gradient faults + guard are
     # optax wrappers, so they ride inside the jitted step on every path
     # (dp/sp/tp, pp, moe); host faults/watchdog/sentinel wrap the loop.
+    from cpd_tpu.resilience import ladder_step_key
     from cpd_tpu.utils.config import build_resilience
     res = build_resilience(args, n_steps=args.max_iter, rank=rank)
     if res["verify"] and (args.pp > 1 or args.moe):
         raise SystemExit("--verify-reduce is wired to the default "
                          "dp/sp/tp path only (the pp/moe steppers do "
                          "not thread a verification report)")
+    if (res["quant_stats"] or res["sat_plan"] is not None) \
+            and (args.pp > 1 or args.moe):
+        raise SystemExit("--precision-ladder/--quant-telemetry and "
+                         "sat_pressure faults are wired to the default "
+                         "dp/sp/tp path only (the pp/moe steppers do "
+                         "not thread the telemetry / pressure tables)")
     if res["active"]:
         # the guard's verdict must be agreed over EVERY mesh axis the
         # update runs under — tp/pp/ep-sharded leaves legitimately hold
@@ -294,6 +301,13 @@ def main(argv=None) -> dict:
     injector, watchdog = res["injector"], res["watchdog"]
     sentinel, meter = res["sentinel"], res["meter"]
     supervisor, step_table, resync_fn = res["supervisor"], None, None
+    psup = res["precision"]
+
+    def run_meta():
+        # ladder state rides every checkpoint's metadata sidecar so a
+        # restart/rollback resumes AT the escalated format
+        return ({"precision": psup.state_dict()}
+                if psup is not None else None)
 
     ds = SyntheticText(n=4096, seq_len=args.seq_len,
                        vocab_size=args.vocab_size)
@@ -358,29 +372,42 @@ def main(argv=None) -> dict:
                                     dropout_rate=args.dropout, **model_kw)
         state = create_train_state(init_model, tx, sample,
                                    jax.random.PRNGKey(0))
-        if supervisor is not None:
-            # degraded-transport ladder (docs/RESILIENCE.md): one lazily
-            # compiled verified step per rung, swapped on downgrade /
-            # probation; donate=False so a failed verify can discard
-            from cpd_tpu.parallel.integrity import make_consensus_fns
+        tele_kw = dict(quant_stats=res["quant_stats"],
+                       sat_fault_plan=res["sat_plan"])
+        if supervisor is not None or psup is not None:
+            # one or both ladders (docs/RESILIENCE.md): lazily compiled
+            # steps keyed by `ladder_step_key` — transport level, eXmY
+            # format, or the (level, format) pair; donate=False so a
+            # failed verify can discard
             from cpd_tpu.resilience import (StepTable,
                                             level_reduce_kwargs)
-            _, resync_fn = make_consensus_fns(mesh, "dp")
+            from cpd_tpu.resilience.precision import resolve_ladder_key
+            if supervisor is not None:
+                from cpd_tpu.parallel.integrity import make_consensus_fns
+                _, resync_fn = make_consensus_fns(mesh, "dp")
             lvl_kw = {k: v for k, v in quant_kw.items()
                       if k not in ("mode", "grad_exp", "grad_man")}
 
-            def build_step(level):
+            def build_step(key):
+                level, fmt = resolve_ladder_key(
+                    key, transport_on=supervisor is not None,
+                    precision_on=psup is not None, level=args.mode,
+                    fmt=(args.grad_exp, args.grad_man))
+                if supervisor is not None:
+                    rkw = level_reduce_kwargs(level, *fmt)
+                else:
+                    rkw = dict(mode=level, grad_exp=fmt[0],
+                               grad_man=fmt[1])
                 return make_lm_train_step(
                     model, tx, mesh, emulate_node=args.emulate_node,
                     label_smoothing=args.label_smoothing, donate=False,
-                    verify_reduce=True,
+                    verify_reduce=res["verify"],
                     wire_fault_plan=(res["wire_plan"]
                                      if level == "ring" else None),
-                    **level_reduce_kwargs(level, args.grad_exp,
-                                          args.grad_man), **lvl_kw)
+                    **rkw, **lvl_kw, **tele_kw)
 
             step_table = StepTable(build_step)
-            step = step_table[supervisor.mode]
+            step = step_table[ladder_step_key(supervisor, psup)]
         else:
             # no ladder (verify off, or a non-ladder mode like fast):
             # verification, when on, is detection-only agreement checking
@@ -389,7 +416,7 @@ def main(argv=None) -> dict:
                                       label_smoothing=args.label_smoothing,
                                       verify_reduce=res["verify"],
                                       wire_fault_plan=res["wire_plan"],
-                                      **quant_kw)
+                                      **quant_kw, **tele_kw)
         eval_step = make_lm_eval_step(model, mesh)
         specs_fn = lm_state_specs
         global_batch = args.batch_size * dp * args.emulate_node
@@ -408,6 +435,17 @@ def main(argv=None) -> dict:
         start_iter = int(restored.step)
         if rank == 0:
             print(f"=> resumed from iter {start_iter}")
+        if psup is not None:
+            # a restart mid-escalation resumes AT the escalated format
+            # (the acceptance contract) — the ladder state was saved in
+            # the checkpoint's metadata sidecar
+            meta = manager.metadata()
+            if meta and meta.get("precision"):
+                psup.load_state_dict(meta["precision"])
+                step = step_table[ladder_step_key(supervisor, psup)]
+                if rank == 0:
+                    print(f"=> resumed precision ladder at {psup.name}"
+                          + (" (escalated)" if psup.escalated else ""))
     def relayout(st):
         # orbax restores arrays committed to a single device; the step's
         # shard_map needs the path's PartitionSpec layout (also re-run
@@ -467,7 +505,8 @@ def main(argv=None) -> dict:
     def watchdog_stop():
         watchdog.disarm()     # acknowledge the trip: cancels hard-exit
         meter.bump("watchdog_trips")
-        preempt_save(manager, step_no, state, rank, what="watchdog stop at")
+        preempt_save(manager, step_no, state, rank,
+                     metadata=run_meta(), what="watchdog stop at")
 
     try:
         it = start_iter + 1
@@ -479,7 +518,8 @@ def main(argv=None) -> dict:
                 preempted = True
                 break
             if guard.should_stop():      # collective when multi-host
-                preempt_save(manager, step_no, state, rank)
+                preempt_save(manager, step_no, state, rank,
+                             metadata=run_meta())
                 preempted = True
                 break
             profiler.step(it)
@@ -526,6 +566,7 @@ def main(argv=None) -> dict:
                 raise
             except InjectedPreemption:
                 preempt_save(manager, step_no, state, rank,
+                             metadata=run_meta(),
                              what="injected preemption at")
                 meter.bump("preemptions")
                 preempted = True
@@ -562,7 +603,7 @@ def main(argv=None) -> dict:
                     meter.bump("transport_downgrades")
                     state = resync_fn(state)
                     meter.bump("resyncs")
-                    step = step_table[supervisor.mode]
+                    step = step_table[ladder_step_key(supervisor, psup)]
                     if rank == 0:
                         print(f"=> wire fault detected at iter {it} "
                               f"(hop_bad "
@@ -583,13 +624,36 @@ def main(argv=None) -> dict:
             if supervisor is not None and \
                     supervisor.on_success(upd) == "upgrade":
                 meter.bump("transport_upgrades")
-                step = step_table[supervisor.mode]
+                step = step_table[ladder_step_key(supervisor, psup)]
                 if rank == 0:
                     print(f"=> transport probation passed at iter {it}: "
                           f"back to {supervisor.mode}", file=sys.stderr)
             step_no = it
             if meter is not None:
                 meter.observe_metrics(last)
+            # --- precision-ladder supervision (ISSUE 5) ---------------
+            # host decision on the psum-agreed prec_wire_* telemetry;
+            # escalation re-formats the NEXT step (this update was
+            # already guarded in-step if its values went non-finite)
+            if psup is not None:
+                pact = psup.on_metrics(upd, last)
+                if psup.last_hot:
+                    meter.bump("sat_hot_steps")
+                if pact is not None:
+                    meter.bump("precision_escalations"
+                               if pact == "escalate"
+                               else "precision_deescalations")
+                    step = step_table[ladder_step_key(supervisor, psup)]
+                    if rank == 0:
+                        how = ("escalated" if pact == "escalate"
+                               else "probation passed: back")
+                        print(f"=> precision ladder {how} to "
+                              f"{psup.name} at iter {it} (sat "
+                              f"{int(last.get('prec_wire_sat', 0))}/"
+                              f"{int(last.get('prec_wire_total', 0))} "
+                              f"nan "
+                              f"{int(last.get('prec_wire_nan', 0))})",
+                              file=sys.stderr)
             if injector is not None:
                 last["loss"] = injector.fault_loss(upd, last["loss"])
             # a guard-skipped step's loss metric may be poisoned by the
@@ -610,6 +674,13 @@ def main(argv=None) -> dict:
                         break
                     for _bad in rolled.skipped:
                         meter.bump("ckpts_invalid")
+                    if psup is not None and (rolled.metadata or {}
+                                             ).get("precision"):
+                        # replaying at home would re-diverge into the
+                        # saturation the escalation escaped
+                        psup.load_state_dict(rolled.metadata["precision"])
+                        step = step_table[ladder_step_key(supervisor,
+                                                          psup)]
                     state = relayout(rolled.state)
                     step_no = int(rolled.step)
                     it = step_no + 1
@@ -641,7 +712,8 @@ def main(argv=None) -> dict:
             if it % args.ckpt_freq == 0 or it == args.max_iter:
                 # force under resilience: a rollback replay must be able
                 # to overwrite the stale/corrupt copy of this step
-                manager.save(it, state, force=res["active"])
+                manager.save(it, state, force=res["active"],
+                             metadata=run_meta())
                 if injector is not None:
                     # the fault must land on the FINAL bytes — without
                     # integrity the save is still async at this point
@@ -663,7 +735,11 @@ def main(argv=None) -> dict:
                    wire_armed=(not (args.pp > 1 or args.moe)
                                and (supervisor.home == "ring"
                                     if supervisor is not None
-                                    else args.mode == "ring")))
+                                    else args.mode == "ring")),
+                   # sat tables only ride the default-path steppers (a
+                   # pp/moe run with sat specs exits up front, but keep
+                   # the accounting honest regardless)
+                   sat_armed=not (args.pp > 1 or args.moe))
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()

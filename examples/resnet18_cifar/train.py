@@ -204,10 +204,24 @@ def main(argv=None) -> dict:
                          "the ZeRO updaters own the collective "
                          "(reduce_in_update) — run without --zero1/"
                          "--zero2")
+    if res["quant_stats"] and (args.zero1 or args.zero2):
+        raise SystemExit("--precision-ladder/--quant-telemetry need the "
+                         "step's own reduction for the wire telemetry; "
+                         "the ZeRO updaters own the collective "
+                         "(reduce_in_update) — run without --zero1/"
+                         "--zero2")
     if res["active"]:
         tx = res["wrap_tx"](tx, axis_name="dp")
     injector, watchdog = res["injector"], res["watchdog"]
     sentinel, meter = res["sentinel"], res["meter"]
+    psup = res["precision"]
+
+    def run_meta():
+        # ladder state rides every checkpoint's metadata sidecar so a
+        # restart resumes AT the escalated format (docs/RESILIENCE.md
+        # "Precision ladder")
+        return ({"precision": psup.state_dict()}
+                if psup is not None else None)
 
     state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),
                                jax.random.PRNGKey(seed))
@@ -292,6 +306,18 @@ def main(argv=None) -> dict:
             start_iter = int(restored.step)
             if rank == 0:
                 print(f"=> resumed from iter {start_iter}")
+            if psup is not None:
+                # resume the ladder where the checkpoint left it — the
+                # acceptance contract: a restart mid-escalation runs at
+                # the escalated format, not home
+                meta = manager.metadata()
+                if meta and meta.get("precision"):
+                    psup.load_state_dict(meta["precision"])
+                    if rank == 0:
+                        print(f"=> resumed precision ladder at "
+                              f"{psup.name}"
+                              + (" (escalated)" if psup.escalated
+                                 else ""))
     # orbax restores arrays committed to a single device; the train step's
     # shard_map needs the state laid out over the mesh (replicated, except
     # the ZeRO momentum which is dp-sharded)
@@ -306,26 +332,41 @@ def main(argv=None) -> dict:
     step_kw = dict(emulate_node=args.emulate_node, use_aps=args.use_APS,
                    use_kahan=args.use_kahan,
                    grad_rounding=args.grad_rounding,
-                   grad_seed=args.grad_seed, **extra)
+                   grad_seed=args.grad_seed,
+                   quant_stats=res["quant_stats"],
+                   sat_fault_plan=res["sat_plan"], **extra)
     supervisor = res["supervisor"]
     resync_fn = None
-    if supervisor is not None:
-        # the degraded-transport ladder (docs/RESILIENCE.md): one lazily
-        # compiled verified step per rung, swapped on downgrade/probation
-        from cpd_tpu.parallel.integrity import make_consensus_fns
-        from cpd_tpu.resilience import StepTable, level_reduce_kwargs
-        _, resync_fn = make_consensus_fns(mesh, "dp")
+    if supervisor is not None or psup is not None:
+        # one or both ladders (docs/RESILIENCE.md "Degraded transports" /
+        # "Precision ladder"): lazily compiled steps keyed by
+        # `ladder_step_key` — transport level, eXmY format, or the
+        # (level, format) pair when both supervisors run
+        from cpd_tpu.resilience import (StepTable, ladder_step_key,
+                                        level_reduce_kwargs)
+        from cpd_tpu.resilience.precision import resolve_ladder_key
+        if supervisor is not None:
+            from cpd_tpu.parallel.integrity import make_consensus_fns
+            _, resync_fn = make_consensus_fns(mesh, "dp")
 
-        def build_step(level):
+        def build_step(key):
+            level, fmt = resolve_ladder_key(
+                key, transport_on=supervisor is not None,
+                precision_on=psup is not None, level=args.mode,
+                fmt=(args.grad_exp, args.grad_man))
+            if supervisor is not None:
+                rkw = level_reduce_kwargs(level, *fmt)
+            else:
+                rkw = dict(mode=level, grad_exp=fmt[0], grad_man=fmt[1])
             return make_train_step(
-                model, tx, mesh, donate=False, verify_reduce=True,
+                model, tx, mesh, donate=False,
+                verify_reduce=res["verify"],
                 wire_fault_plan=(res["wire_plan"] if level == "ring"
                                  else None),
-                **level_reduce_kwargs(level, args.grad_exp,
-                                      args.grad_man), **step_kw)
+                **rkw, **step_kw)
 
         step_table = StepTable(build_step)
-        train_step = step_table[supervisor.mode]
+        train_step = step_table[ladder_step_key(supervisor, psup)]
     else:
         # no ladder (verify off, or a non-ladder mode like fast):
         # verification, when on, is detection-only agreement checking
@@ -432,11 +473,12 @@ def main(argv=None) -> dict:
                 watchdog.disarm()     # acknowledge: cancels hard-exit
                 meter.bump("watchdog_trips")
                 preempt_save(manager, step_no, to_ckpt(state), rank,
-                             what="watchdog stop at")
+                             metadata=run_meta(), what="watchdog stop at")
                 preempted = True
                 break
             if guard.should_stop():      # collective when multi-host
-                preempt_save(manager, step_no, to_ckpt(state), rank)
+                preempt_save(manager, step_no, to_ckpt(state), rank,
+                             metadata=run_meta())
                 preempted = True
                 break
             profiler.step(step_no)
@@ -471,6 +513,7 @@ def main(argv=None) -> dict:
                     watchdog.disarm()     # acknowledge: cancels hard-exit
                     meter.bump("watchdog_trips")
                     preempt_save(manager, step_no, to_ckpt(state), rank,
+                                 metadata=run_meta(),
                                  what="watchdog stop at")
                     preempted = True
                     break
@@ -478,7 +521,7 @@ def main(argv=None) -> dict:
             except InjectedPreemption:
                 meter.bump("preemptions")
                 preempt_save(manager, step_no, to_ckpt(state), rank,
-                             what="injected preemption at")
+                             metadata=run_meta(), what="injected preemption at")
                 preempted = True
                 break
             # --- verified-reduce supervision (ISSUE 4) ----------------
@@ -517,7 +560,8 @@ def main(argv=None) -> dict:
                     meter.bump("transport_downgrades")
                     state = resync_fn(state)
                     meter.bump("resyncs")
-                    train_step = step_table[supervisor.mode]
+                    train_step = step_table[ladder_step_key(supervisor,
+                                                            psup)]
                     if rank == 0:
                         print(f"=> wire fault detected at iter "
                               f"{step_no + 1} (hop_bad "
@@ -538,13 +582,39 @@ def main(argv=None) -> dict:
             if supervisor is not None and \
                     supervisor.on_success(step_no) == "upgrade":
                 meter.bump("transport_upgrades")
-                train_step = step_table[supervisor.mode]
+                train_step = step_table[ladder_step_key(supervisor,
+                                                            psup)]
                 if rank == 0:
                     print(f"=> transport probation passed at iter "
                           f"{step_no + 1}: back to {supervisor.mode}",
                           file=sys.stderr)
             step_no += 1
             meter.observe_metrics(last)
+            # --- precision-ladder supervision (ISSUE 5) ---------------
+            # host decision on the psum-agreed prec_wire_* telemetry;
+            # escalation re-formats the NEXT step (the update that
+            # tripped the detector was already guarded in-step)
+            if psup is not None:
+                from cpd_tpu.resilience import ladder_step_key
+                pact = psup.on_metrics(step_no - 1, last)
+                if psup.last_hot:
+                    meter.bump("sat_hot_steps")
+                if pact is not None:
+                    meter.bump("precision_escalations"
+                               if pact == "escalate"
+                               else "precision_deescalations")
+                    train_step = step_table[ladder_step_key(supervisor,
+                                                            psup)]
+                    if rank == 0:
+                        how = ("escalated" if pact == "escalate"
+                               else "probation passed: back")
+                        print(f"=> precision ladder {how} to "
+                              f"{psup.name} at iter {step_no} "
+                              f"(sat {int(last.get('prec_wire_sat', 0))}"
+                              f"/{int(last.get('prec_wire_total', 0))}"
+                              f" nan "
+                              f"{int(last.get('prec_wire_nan', 0))})",
+                              file=sys.stderr)
             if injector is not None:
                 # step_no - 1 == the 0-based update index this loss came
                 # from — the same clock the pre-step hooks above use
@@ -579,7 +649,8 @@ def main(argv=None) -> dict:
                 writer.add_scalar("val/top1", val["top1"], step_no)
                 prec1 = 100 * val["top1"]
                 best_prec1 = max(best_prec1, prec1)
-                manager.save(step_no, to_ckpt(state), best_metric=prec1)
+                manager.save(step_no, to_ckpt(state), best_metric=prec1,
+                             metadata=run_meta())
                 if injector is not None:
                     # the fault must land on the FINAL bytes — without
                     # integrity the save is still async at this point

@@ -1,0 +1,675 @@
+"""Precision supervisor (ISSUE 5): in-jit numeric-health telemetry + the
+eXmY format-escalation ladder.
+
+Layers:
+
+* sensors: `quant_health` / `float_quantize_stats` / `quant_gemm_stats`
+  / `quantizer_stats` count saturation/underflow/NaN exactly and leave
+  the cast's bits UNTOUCHED (the clean-path bitwise gate, also enforced
+  by tools/bench_reduce.py --smoke across formats × rounding);
+* APS satellite: `aps_shift_factors_checked` distinguishes the healthy
+  all-zero leaf (-inf max-exponent) from non-finite gradients (+inf /
+  NaN), surfacing the latter as the `aps_bad` counter;
+* wire telemetry: `sum_gradients(stats=True)` psum-agreed counters on a
+  real shard_map mesh, clean path bitwise unchanged in every mode;
+* sentinel satellite: the dual-EMA drift mode catches a slow upward
+  creep the factor-x-median spike check is structurally blind to;
+* the supervisor: escalate-after-patience / probation-back / home-floor
+  state machine, checkpoint persistence (state_dict round-trip and the
+  ladder-mismatch refusal), and the StepTable key derivation;
+* end-to-end: the ISSUE-5 acceptance chaos run — `sat_pressure`
+  injection drives the home format hot, the ladder escalates within
+  patience steps, probations back to home after the pressure ends, the
+  run finishes within the loss budget with exact deterministic
+  counters, a checkpoint saved mid-escalation records the escalated
+  format, and the SAME injection without the ladder shows the
+  degradation (guard skips every pressured step).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from cpd_tpu.quant.numerics import (cast_to_format, max_finite,
+                                    quant_health)
+from cpd_tpu.quant.quant_function import (float_quantize,
+                                          float_quantize_stats,
+                                          quant_gemm, quant_gemm_stats,
+                                          quantizer, quantizer_stats,
+                                          tree_quant_health)
+from cpd_tpu.resilience import (FaultPlan, Injector, PrecisionSupervisor,
+                                StepTable, format_name, ladder_step_key,
+                                parse_format, parse_ladder,
+                                report_unfired, run_guarded,
+                                with_grad_guard)
+from cpd_tpu.resilience.inject import SAT_PRESSURE_DEFAULT_EXP
+from cpd_tpu.train.metrics import ResilienceMeter
+from cpd_tpu.train.optim import sgd
+
+
+def _bitwise_equal(a, b):
+    return (np.asarray(a, np.float32).view(np.uint32)
+            == np.asarray(b, np.float32).view(np.uint32)).all()
+
+
+# ---------------------------------------------------------------------------
+# sensors: counting casts
+# ---------------------------------------------------------------------------
+
+# (4,3): max_finite = 240, min subnormal = 2^(1-7-3) = 2^-9
+_PROBE = np.array([0.1, 500.0, -600.0, np.inf, -np.inf, np.nan,
+                   1e-9, 0.0, -2.5e-7, 240.0], np.float32)
+
+
+def test_quant_health_counts_exact():
+    q = cast_to_format(jnp.asarray(_PROBE), 4, 3)
+    h = {k: int(v) for k, v in quant_health(jnp.asarray(_PROBE), q).items()}
+    # 500/-600 saturate, +/-inf pass through (still inf on the wire)
+    assert h == {"sat": 4, "underflow": 2, "nan": 1, "total": 10}
+
+
+def test_quant_health_counts_are_daz_proof():
+    """Regression (found driving the real backend): XLA:CPU compares
+    floats under DAZ semantics, so an fp32-SUBNORMAL input == 0.0 by
+    value — zero-ness must be decided on the bit pattern or the
+    subnormal-flush underflow (the reference's float_kernel.cu:87-91
+    case) is silently uncounted, and -0.0 inputs would need care too."""
+    x = jnp.asarray(np.array([-1e-45, 1e-42, -0.0, 0.0], np.float32))
+    q = cast_to_format(x, 5, 2)         # flushes both subnormals to +0
+    h = {k: int(v) for k, v in quant_health(x, q).items()}
+    assert h == {"sat": 0, "underflow": 2, "nan": 0, "total": 4}
+    # e8 formats legitimately OUTPUT fp32 subnormals ((8,23) keeps the
+    # value set minus the flushed inputs): a subnormal output must not
+    # read as underflow under the same DAZ compare
+    y = jnp.asarray(np.array([2.0e-39], np.float32))   # fp32 subnormal
+    qy = jnp.asarray(np.array([2.0e-39], np.float32))  # "cast" kept it
+    hy = {k: int(v) for k, v in quant_health(y, qy).items()}
+    assert hy["underflow"] == 0
+
+
+@pytest.mark.parametrize("fmt", [(4, 3), (5, 2), (5, 7), (8, 23)])
+@pytest.mark.parametrize("rounding", ["nearest", "stochastic"])
+def test_float_quantize_stats_bitwise_unchanged(fmt, rounding):
+    """Telemetry must observe, never touch: the stats cast's value
+    output is bitwise identical to the plain cast for every format and
+    rounding mode (the acceptance criterion's clean-path gate)."""
+    exp, man = fmt
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(np.concatenate([
+        rng.randn(64).astype(np.float32) * 10.0 ** rng.randint(-8, 8, 64),
+        _PROBE]))
+    key = jax.random.PRNGKey(7) if rounding == "stochastic" else None
+    plain = float_quantize(x, exp, man, rounding=rounding, key=key)
+    q, h = float_quantize_stats(x, exp, man, rounding=rounding, key=key)
+    assert _bitwise_equal(plain, q)
+    assert int(h["total"]) == x.size
+    assert int(h["nan"]) == int(np.isnan(np.asarray(x)).sum())
+
+
+def test_tree_quant_health_sums_leaves_and_empty():
+    x = {"a": jnp.asarray(_PROBE), "b": jnp.asarray(_PROBE)}
+    q = jax.tree.map(lambda t: cast_to_format(t, 4, 3), x)
+    h = {k: int(v) for k, v in tree_quant_health(x, q).items()}
+    assert h == {"sat": 8, "underflow": 4, "nan": 2, "total": 20}
+    h0 = {k: int(v) for k, v in tree_quant_health({}, {}).items()}
+    assert h0 == {"sat": 0, "underflow": 0, "nan": 0, "total": 0}
+
+
+@pytest.mark.parametrize("mode", ["faithful", "fast"])
+def test_quant_gemm_stats_bitwise_and_counts(mode):
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+    b = jnp.asarray(rng.randn(6, 5).astype(np.float32))
+    out = quant_gemm(a, b, man=3, exp=4, mode=mode)
+    out_s, h = quant_gemm_stats(a, b, man=3, exp=4, mode=mode)
+    assert _bitwise_equal(out, out_s)
+    assert int(h["sat"]) == 0 and int(h["nan"]) == 0
+    # faithful observes all 5 casts per K step; fast the one output cast
+    expect_total = 5 * 6 * 4 * 5 if mode == "faithful" else 4 * 5
+    assert int(h["total"]) == expect_total
+    # a row of huge values must saturate the (4,3) accumulator
+    a_hot = a.at[0].set(1e6)
+    out_hot, h_hot = quant_gemm_stats(a_hot, b, man=3, exp=4, mode=mode)
+    assert int(h_hot["sat"]) > 0
+    # SR path: same bits as the plain SR gemm
+    key = jax.random.PRNGKey(5)
+    sr = quant_gemm(a, b, man=3, exp=4, mode=mode,
+                    rounding="stochastic", key=key)
+    sr_s, _ = quant_gemm_stats(a, b, man=3, exp=4, mode=mode,
+                               rounding="stochastic", key=key)
+    assert _bitwise_equal(sr, sr_s)
+
+
+def test_quant_gemm_stats_fp32_fast_is_counted_noop():
+    rng = np.random.RandomState(1)
+    a = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+    b = jnp.asarray(rng.randn(4, 2).astype(np.float32))
+    out, h = quant_gemm_stats(a, b, man=23, exp=8, mode="fast")
+    assert all(int(v) == 0 for v in h.values())   # no cast ran
+    assert _bitwise_equal(out, quant_gemm(a, b, man=23, exp=8,
+                                          mode="fast"))
+
+
+def test_quantizer_stats_forward_and_backward_health():
+    """Forward health returns as a primal output; backward health rides
+    the cotangent of the unused tap input — the only channel a VJP has.
+    Both casts stay bitwise identical to the plain quantizer's."""
+    x = jnp.asarray(_PROBE)
+    fn = quantizer_stats(4, 3, 5, 2)
+    tap = jnp.zeros(4)
+    (y, fwd_h), vjp = jax.vjp(fn, x, tap)
+    assert _bitwise_equal(y, quantizer(4, 3, 5, 2)(x))
+    assert [int(v) for v in np.asarray(fwd_h)] == [4, 2, 1, 10]
+    # cotangents of 1e-9 underflow at e5m2 (min subnormal 2^-16)
+    g = jnp.full_like(x, 1e-9)
+    gx, bwd_h = vjp((g, jnp.zeros(4)))
+    plain_bwd = jax.vjp(quantizer(4, 3, 5, 2), x)[1](g)[0]
+    assert _bitwise_equal(gx, plain_bwd)
+    assert [int(v) for v in np.asarray(bwd_h)] == [0, 10, 0, 10]
+    # (8,23) identity shortcut: a counted no-op, not an uncounted one
+    fn_id = quantizer_stats(8, 23, 8, 23)
+    (y_id, h_id), _ = jax.vjp(fn_id, x, tap)
+    assert _bitwise_equal(y_id, x)
+    assert int(np.asarray(h_id)[3]) == x.size
+
+
+# ---------------------------------------------------------------------------
+# APS satellite: non-finite max-exponent != all-zero leaf
+# ---------------------------------------------------------------------------
+
+def test_aps_shift_factors_checked_distinguishes_cases():
+    from cpd_tpu.parallel.aps import (aps_max_exponents,
+                                      aps_shift_factors,
+                                      aps_shift_factors_checked)
+    leaves = [jnp.zeros((4,)),                          # all-zero: healthy
+              jnp.asarray([1.0, jnp.inf, 2.0]),         # inf gradient
+              jnp.asarray([jnp.nan, 1.0]),              # nan gradient
+              jnp.asarray([0.5, -2.0])]                 # normal
+    me = aps_max_exponents(leaves, 4)
+    shifts, bad = aps_shift_factors_checked(me, 5)
+    shifts = np.asarray(shifts)
+    # every non-finite max_exp maps to shift 0 (damage control) ...
+    assert shifts[0] == 0.0 and shifts[1] == 0.0 and shifts[2] == 0.0
+    assert shifts[3] != 0.0                # normal leaf actually shifts
+    # ... but only the Inf/NaN leaves count as bad — NOT the zero leaf
+    assert int(bad) == 2
+    # regression: the unchecked spelling still returns the same shifts
+    np.testing.assert_array_equal(np.asarray(aps_shift_factors(me, 5)),
+                                  shifts)
+    # all-clean tree: bad == 0
+    _, bad_clean = aps_shift_factors_checked(
+        aps_max_exponents([jnp.ones((3,))], 4), 5)
+    assert int(bad_clean) == 0
+
+
+# ---------------------------------------------------------------------------
+# wire telemetry on a real mesh
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh():
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    return data_parallel_mesh()
+
+
+@pytest.mark.parametrize("use_aps", [False, True])
+@pytest.mark.parametrize("mode", ["faithful", "ring", "fast"])
+def test_sum_gradients_stats_clean_path_bitwise(mesh, use_aps, mode):
+    from cpd_tpu.compat import shard_map
+    from cpd_tpu.parallel.dist import sum_gradients
+    from jax.sharding import NamedSharding
+
+    rng = np.random.RandomState(0)
+    g = rng.randn(8, 64).astype(np.float32) * 0.1
+    g[1, 3] = 5000.0
+    sharded = jax.device_put(jnp.asarray(g),
+                             NamedSharding(mesh, P("dp")))
+
+    def body(st):
+        plain = sum_gradients(st[0], "dp", use_aps=use_aps, grad_exp=4,
+                              grad_man=3, mode=mode)
+        with_stats, rep = sum_gradients(st[0], "dp", use_aps=use_aps,
+                                        grad_exp=4, grad_man=3,
+                                        mode=mode, stats=True)
+        return plain, with_stats, rep
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=(P(), P(), P()), check_vma=False))
+    plain, with_stats, rep = fn(sharded)
+    assert _bitwise_equal(plain, with_stats)
+    assert int(rep["wire_total"]) == 512        # psum'd: 8 ranks x 64
+    assert int(rep["aps_bad"]) == 0
+    if not use_aps:
+        # the 5000 outlier saturates the W-scaled (4,3) probe
+        assert int(rep["wire_sat"]) >= 1
+
+
+def test_sum_gradients_stats_aps_bad_on_inf_grad(mesh):
+    from cpd_tpu.compat import shard_map
+    from cpd_tpu.parallel.dist import sum_gradients
+    from jax.sharding import NamedSharding
+
+    g = (np.random.RandomState(0).randn(8, 16) * 0.1).astype(np.float32)
+    g[0, 0] = np.inf
+
+    def body(st):
+        _, rep = sum_gradients(st[0], "dp", use_aps=True, grad_exp=4,
+                               grad_man=3, stats=True)
+        return rep
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P(), check_vma=False))
+    rep = fn(jax.device_put(jnp.asarray(g),
+                            NamedSharding(mesh, P("dp"))))
+    assert int(rep["aps_bad"]) == 1          # the non-finite leaf, seen
+    assert int(rep["wire_sat"]) >= 1         # the Inf rides the wire
+
+
+# ---------------------------------------------------------------------------
+# sentinel satellite: EMA drift mode
+# ---------------------------------------------------------------------------
+
+def test_sentinel_ema_catches_drift_median_is_blind_to():
+    from cpd_tpu.resilience import DivergenceSentinel
+    median = DivergenceSentinel(window=20, factor=10.0, min_history=5)
+    # the steady-state fast/slow EMA ratio of a geometric drift is
+    # bounded by the drift rate (sentinel.py docstring): 10%/step gives
+    # ~1.58 with these spans, so the drift factor must sit BELOW that —
+    # 1.5 here — where the median mode's 10x spike bar never comes close
+    ema = DivergenceSentinel(window=20, factor=1.5, min_history=5,
+                             mode="ema")
+    # a slow 10%-per-step upward creep: each step is far from 10x the
+    # window median (the median drifts along), but the fast/slow EMA
+    # gap opens steadily
+    loss, med_trip, ema_trip = 1.0, None, None
+    for i in range(60):
+        if med_trip is None and median.update(loss):
+            med_trip = i
+        if ema_trip is None and ema.update(loss):
+            ema_trip = i
+        loss *= 1.10
+    assert med_trip is None          # structurally blind to the drift
+    assert ema_trip is not None      # caught before the absolute blow-up
+
+
+def test_sentinel_ema_quiet_on_stationary_noise_and_resets():
+    from cpd_tpu.resilience import DivergenceSentinel
+    s = DivergenceSentinel(window=16, factor=2.0, min_history=4,
+                           mode="ema")
+    r = np.random.RandomState(0)
+    for _ in range(50):
+        assert not s.update(1.0 + 0.05 * r.randn())
+    assert s.update(float("nan"))            # non-finite always trips
+    assert s.update(10.0)                    # 10x the settled baseline
+    s.reset()
+    assert not s.update(10.0)                # fresh baseline after reset
+    with pytest.raises(ValueError, match="unknown sentinel mode"):
+        DivergenceSentinel(mode="quantile")
+
+
+def test_sentinel_median_default_unchanged():
+    from cpd_tpu.resilience import DivergenceSentinel
+    s = DivergenceSentinel(window=8, factor=10.0, min_history=3)
+    assert s.mode == "median"
+    for i in range(6):
+        assert not s.update(1.0 + 0.1 * i)
+    assert s.update(50.0)
+
+
+# ---------------------------------------------------------------------------
+# the supervisor state machine + persistence
+# ---------------------------------------------------------------------------
+
+def test_parse_format_and_ladder_validation():
+    assert parse_format("e4m3") == (4, 3)
+    assert parse_format((5, 2)) == (5, 2)
+    assert format_name((8, 23)) == "e8m23"
+    assert parse_ladder("e4m3,e5m7,e8m23") == ((4, 3), (5, 7), (8, 23))
+    with pytest.raises(ValueError, match="bad eXmY format"):
+        parse_format("fp8")
+    with pytest.raises(ValueError, match="exp_bits"):
+        parse_format("e9m2")
+    with pytest.raises(ValueError, match=">= 2 rungs"):
+        parse_ladder("e4m3")
+    with pytest.raises(ValueError, match="does not widen"):
+        parse_ladder("e5m7,e4m3")           # shrinking range
+    with pytest.raises(ValueError, match="does not widen"):
+        parse_ladder("e4m3,e4m2")           # lateral/narrower
+    # a ladder that widens range while shortening mantissa is legal
+    assert max_finite(5, 2) > max_finite(4, 3)
+    assert parse_ladder("e4m3,e5m2") == ((4, 3), (5, 2))
+
+
+def _hot():
+    return {"prec_wire_sat": 100.0, "prec_wire_nan": 0.0,
+            "prec_wire_total": 1000.0}
+
+
+def _quiet():
+    return {"prec_wire_sat": 0.0, "prec_wire_nan": 0.0,
+            "prec_wire_total": 1000.0}
+
+
+def test_supervisor_escalates_after_patience_and_probations_home():
+    sup = PrecisionSupervisor("e4m3,e5m7,e8m23", threshold=1e-3,
+                              patience=2, probation=3)
+    assert sup.fmt == (4, 3) and sup.home == (4, 3) and not sup.escalated
+    assert sup.on_metrics(0, _quiet()) is None
+    assert sup.on_metrics(1, _hot()) is None          # hot streak 1
+    assert sup.last_hot
+    assert sup.on_metrics(2, _hot()) == "escalate"    # streak 2 == patience
+    assert sup.fmt == (5, 7) and sup.escalated
+    # a quiet step resets the hot streak: no double-escalate from one
+    # more hot observation
+    assert sup.on_metrics(3, _quiet()) is None
+    assert sup.on_metrics(4, _hot()) is None
+    assert sup.on_metrics(5, _hot()) == "escalate"
+    assert sup.fmt == (8, 23)
+    # at the top rung, sustained heat has nowhere to go
+    assert sup.on_metrics(6, _hot()) is None
+    assert sup.on_metrics(7, _hot()) is None
+    # probation: 3 consecutive quiet steps per rung, down to home
+    for i in range(8, 11):
+        out = sup.on_metrics(i, _quiet())
+    assert out == "deescalate" and sup.fmt == (5, 7)
+    for i in range(11, 14):
+        out = sup.on_metrics(i, _quiet())
+    assert out == "deescalate" and sup.fmt == (4, 3)
+    # at home, quiet steps never de-escalate below rung 0
+    for i in range(14, 20):
+        assert sup.on_metrics(i, _quiet()) is None
+    assert sup.fmt == (4, 3)
+    assert sup.transitions == [(2, "e4m3", "e5m7"), (5, "e5m7", "e8m23"),
+                               (10, "e8m23", "e5m7"),
+                               (13, "e5m7", "e4m3")]
+
+
+def test_supervisor_aps_bad_counts_as_hot_and_threshold_edge():
+    sup = PrecisionSupervisor("e4m3,e8m23", threshold=0.01, patience=1,
+                              probation=2)
+    # rate exactly at the threshold is NOT hot (strictly greater)
+    at_edge = {"prec_wire_sat": 10.0, "prec_wire_total": 1000.0}
+    assert sup.on_metrics(0, at_edge) is None and not sup.last_hot
+    # aps_bad > 0 is hot regardless of the rate
+    assert sup.on_metrics(1, {**_quiet(), "prec_aps_bad": 1.0}) \
+        == "escalate"
+    # metrics without telemetry keys read as quiet
+    assert not sup.observe(0, 0, 0)
+    with pytest.raises(ValueError, match="patience"):
+        PrecisionSupervisor("e4m3,e8m23", patience=0)
+    with pytest.raises(ValueError, match="threshold"):
+        PrecisionSupervisor("e4m3,e8m23", threshold=1.5)
+
+
+def test_supervisor_state_dict_roundtrip_and_ladder_mismatch():
+    sup = PrecisionSupervisor("e4m3,e5m7,e8m23", patience=1, probation=4)
+    sup.on_metrics(3, _hot())
+    assert sup.escalated
+    blob = sup.state_dict()
+    import json
+    blob = json.loads(json.dumps(blob))     # must survive JSON (sidecar)
+    fresh = PrecisionSupervisor("e4m3,e5m7,e8m23", patience=1,
+                                probation=4)
+    fresh.load_state_dict(blob)
+    assert fresh.fmt == (5, 7) and fresh.escalated
+    assert fresh.transitions == [(3, "e4m3", "e5m7")]
+    other = PrecisionSupervisor("e4m3,e8m23")
+    with pytest.raises(ValueError, match="does not match"):
+        other.load_state_dict(blob)
+
+
+def test_resolve_ladder_key_inverts_step_key():
+    from cpd_tpu.resilience import TransportSupervisor
+    from cpd_tpu.resilience.precision import resolve_ladder_key
+    t = TransportSupervisor(start="ring")
+    p = PrecisionSupervisor("e4m3,e8m23")
+    cases = [(t, p), (t, None), (None, p), (None, None)]
+    for tr, pr in cases:
+        key = ladder_step_key(tr, pr)
+        level, fmt = resolve_ladder_key(
+            key, transport_on=tr is not None, precision_on=pr is not None,
+            level="faithful", fmt=(5, 2))
+        assert level == (tr.mode if tr is not None else "faithful")
+        assert fmt == (pr.fmt if pr is not None else (5, 2))
+
+
+def test_build_resilience_rejects_ring_unpackable_ladder():
+    """Review finding (this PR): a man_bits < 2 rung passes the
+    range-widening check but cannot ride the ring transport's packed
+    wire — the lazily compiled escalated step would die inside jit
+    tracing hours in; build_resilience must reject it at argument
+    time (and accept the same ladder for the faithful transport)."""
+    import argparse
+    from cpd_tpu.utils.config import (add_resilience_flags,
+                                      build_resilience)
+
+    def parse(extra):
+        p = argparse.ArgumentParser()
+        p.add_argument("--mode", default="faithful")
+        p.add_argument("--grad_exp", default=4, type=int)
+        p.add_argument("--grad_man", default=3, type=int)
+        add_resilience_flags(p)
+        return p.parse_args(extra)
+
+    bad = ["--precision-ladder", "e4m3,e6m1,e8m23"]
+    with pytest.raises(ValueError, match="packed wire"):
+        build_resilience(parse(bad + ["--mode", "ring"]), n_steps=4)
+    # same ladder is legal on the faithful transport (raw fp32 wire)
+    res = build_resilience(parse(bad), n_steps=4)
+    assert res["precision"].ladder == ((4, 3), (6, 1), (8, 23))
+    # and a packable ladder is fine on the ring
+    res2 = build_resilience(parse(
+        ["--precision-ladder", "e4m3,e5m7,e8m23", "--mode", "ring"]),
+        n_steps=4)
+    assert res2["precision"] is not None and res2["quant_stats"]
+
+
+def test_ladder_step_key_combinations():
+    from cpd_tpu.resilience import TransportSupervisor
+    t = TransportSupervisor(start="ring")
+    p = PrecisionSupervisor("e4m3,e8m23")
+    assert ladder_step_key(None, None) is None
+    assert ladder_step_key(t, None) == "ring"
+    assert ladder_step_key(None, p) == (4, 3)
+    assert ladder_step_key(t, p) == ("ring", (4, 3))
+    t.on_failure(0)                          # ring -> faithful (retries 1)
+    t.on_failure(0)
+    p.on_metrics(0, _hot())
+    p.on_metrics(1, _hot())
+    assert ladder_step_key(t, p) == ("faithful", (8, 23))
+
+
+# ---------------------------------------------------------------------------
+# sat_pressure plan plumbing
+# ---------------------------------------------------------------------------
+
+def test_sat_schedule_and_grammar():
+    plan = FaultPlan.parse("sat_pressure@2:12;sat_pressure@4")
+    assert plan.counts() == {"sat_pressure": 2}
+    assert plan.sat_faults() == plan.faults
+    assert plan.grad_faults() == () and plan.wire_faults() == ()
+    exps = plan.sat_schedule(6)
+    assert exps.tolist() == [0, 0, 12, 0, SAT_PRESSURE_DEFAULT_EXP, 0]
+    # specs past the table are dropped (and surfaced by report_unfired)
+    assert plan.sat_schedule(3).tolist() == [0, 0, 12]
+
+
+def test_report_unfired_covers_sat_specs():
+    plan = FaultPlan.parse("sat_pressure@2:12;sat_pressure@50")
+    meter = ResilienceMeter()
+    left = report_unfired(Injector(plan), n_steps=10, meter=meter, rank=0)
+    assert [f.step for f in left] == [50]         # past the table
+    assert meter["faults_unfired"] == 1
+    # a run whose stepper never baked the sat table (sat_armed=False)
+    # must surface EVERY sat spec
+    left2 = report_unfired(Injector(plan), n_steps=10, rank=0,
+                           sat_armed=False)
+    assert [f.step for f in left2] == [2, 50]
+
+
+def test_run_guarded_precision_requires_step_table():
+    from typing import NamedTuple
+
+    class _S(NamedTuple):
+        step: int
+
+    with pytest.raises(ValueError, match="precision requires"):
+        run_guarded(lambda s, x: (s, {"loss": 1.0}), _S(0),
+                    lambda i, r: (np.zeros(2),), 2,
+                    precision=PrecisionSupervisor("e4m3,e8m23"))
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end acceptance chaos run
+# ---------------------------------------------------------------------------
+
+# pressure x2^12 saturates e4m3 (|g·W·4096| >> 240 for a third of the
+# tiny grads) but stays comfortably inside e5m7 (max 65280): the ladder
+# fixes it, fp32 is never needed.  Four consecutive pressured steps,
+# patience 2 -> escalate after the second; probation 3 -> back home
+# after three quiet steps at e5m7 (pressured-but-in-range steps ARE
+# quiet — the escalated format is doing its job).
+SAT_PLAN = ("sat_pressure@2:12;sat_pressure@3:12;"
+            "sat_pressure@4:12;sat_pressure@5:12")
+SAT_STEPS = 12
+
+
+def _chaos_batch(i, reseed):
+    r = np.random.default_rng(1000 * reseed + i)
+    return (jnp.asarray(r.normal(size=(16, 8, 8, 3)), jnp.float32),
+            jnp.asarray(np.arange(16) % 4, jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def precision_chaos_pieces(mesh):
+    from cpd_tpu.models.tiny import tiny_cnn
+    from cpd_tpu.parallel.dist import replicate
+    from cpd_tpu.train.state import create_train_state
+    from cpd_tpu.train.step import make_train_step
+
+    model = tiny_cnn(num_classes=4, width=4)
+    # the guard is the composing in-step defense: the steps BEFORE the
+    # escalation land still reduce to Inf and must be skipped, not
+    # applied.  spike check wide open — magnitude is the attack here,
+    # and the ladder (not the spike skip) is under test.  lr tiny so
+    # the pressured-but-finite steps at the escalated rung stay inside
+    # the loss budget.
+    tx = with_grad_guard(sgd(lambda _: 1e-5, momentum=0.9),
+                         axis_name="dp", spike_factor=1e9)
+    state0 = replicate(create_train_state(model, tx,
+                                          jnp.zeros((2, 8, 8, 3)),
+                                          jax.random.PRNGKey(0)), mesh)
+    sat_tbl = FaultPlan.parse(SAT_PLAN).sat_schedule(SAT_STEPS)
+
+    def build(fmt):
+        # donate=False: StepTable swaps steps mid-run
+        return make_train_step(model, tx, mesh, donate=False,
+                               quant_stats=True, sat_fault_plan=sat_tbl,
+                               grad_exp=fmt[0], grad_man=fmt[1])
+
+    return state0, StepTable(build)
+
+
+def _ladder_run(pieces, tmpdir=None, ckpt_every=0):
+    from cpd_tpu.train.checkpoint import CheckpointManager
+    state0, steps = pieces
+    psup = PrecisionSupervisor("e4m3,e5m7,e8m23", threshold=1e-3,
+                               patience=2, probation=3)
+    injector = Injector(FaultPlan.parse(SAT_PLAN))
+    manager = (CheckpointManager(tmpdir, track_best=False)
+               if tmpdir else None)
+    try:
+        state, report = run_guarded(
+            None, state0, _chaos_batch, SAT_STEPS, injector=injector,
+            precision=psup, step_for_level=steps, manager=manager,
+            ckpt_every=ckpt_every)
+    finally:
+        if manager is not None:
+            manager.close()
+    return state, report, psup
+
+
+def test_precision_chaos_end_to_end(tmp_path, precision_chaos_pieces):
+    """The ISSUE-5 acceptance run: sat_pressure@2..5 (x2^12) on the
+    e4m3 home format -> hot at 2,3 (guard skips the Inf reduces),
+    escalated to e5m7 AT step 3 (within patience=2 of the attack),
+    pressured steps 4,5 run IN RANGE at the escalated format (trained,
+    not skipped), probation back to e4m3 at step 6, run completes
+    within the loss budget with exact counters, and the checkpoint
+    saved mid-escalation (step 4) records the escalated format."""
+    state, report, psup = _ladder_run(precision_chaos_pieces,
+                                      str(tmp_path / "ladder"),
+                                      ckpt_every=4)
+    assert report.completed and report.aborted is None
+    c = report.counters
+    assert c["sat_hot_steps"] == 2                 # steps 2, 3
+    assert c["precision_escalations"] == 1
+    assert c["precision_deescalations"] == 1
+    # only the PRE-escalation steps were lost to the guard; the
+    # escalated format trained through the remaining pressure
+    assert c["steps_skipped"] == 2 and c["overflows"] == 2
+    assert c["rollbacks"] == 0
+    assert ("precision_up", 3, "e5m7") in report.events
+    assert ("precision_down", 6, "e4m3") in report.events
+    assert psup.transitions == [(3, "e4m3", "e5m7"),
+                                (6, "e5m7", "e4m3")]
+    assert psup.fmt == psup.home == (4, 3)         # ended back home
+    # loss budget: params finite, and the loop never aborted
+    for leaf in jax.tree.leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # the step-4 checkpoint was written DURING the escalation window:
+    # its sidecar must record rung 1, and a fresh supervisor restored
+    # from it resumes at e5m7 — the restart acceptance criterion
+    from cpd_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "ladder"), track_best=False)
+    try:
+        meta4 = mgr.metadata(4)
+        assert meta4["precision"]["level"] == 1
+        fresh = PrecisionSupervisor("e4m3,e5m7,e8m23", threshold=1e-3,
+                                    patience=2, probation=3)
+        fresh.load_state_dict(meta4["precision"])
+        assert fresh.fmt == (5, 7) and fresh.escalated
+        # restore_latest_valid carries the same metadata back with the
+        # state (the trainers' rollback path)
+        from cpd_tpu.train.state import TrainState
+        res = mgr.restore_latest_valid(jax.tree.map(np.asarray, state))
+        assert res is not None and res.metadata is not None
+        assert "precision" in res.metadata
+    finally:
+        mgr.close()
+
+
+def test_precision_chaos_without_ladder_shows_degradation(
+        precision_chaos_pieces):
+    """The SAME injection with the ladder disabled: every pressured
+    step saturates the fixed e4m3 wire to Inf and is guard-skipped —
+    twice the lost steps of the ladder run (the degradation baseline
+    of the acceptance criteria)."""
+    state0, steps = precision_chaos_pieces
+    injector = Injector(FaultPlan.parse(SAT_PLAN))
+    # the ladder table's home-format entry IS the fixed-format step
+    state, report = run_guarded(steps[(4, 3)], state0, _chaos_batch,
+                                SAT_STEPS, injector=injector)
+    assert report.completed
+    c = report.counters
+    assert c["steps_skipped"] == 4 and c["overflows"] == 4
+    assert c["precision_escalations"] == 0
+    for leaf in jax.tree.leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_precision_chaos_is_deterministic(precision_chaos_pieces):
+    """Same plan + seeds => identical event sequence, counters,
+    transitions, and bitwise-identical final parameters."""
+    runs = [_ladder_run(precision_chaos_pieces) for _ in range(2)]
+    (s1, r1, p1), (s2, r2, p2) = runs
+    assert r1.events == r2.events
+    assert r1.counters == r2.counters
+    assert p1.transitions == p2.transitions
+    for a, b in zip(jax.tree.leaves(s1.params),
+                    jax.tree.leaves(s2.params)):
+        np.testing.assert_array_equal(
+            np.asarray(a).view(np.uint32), np.asarray(b).view(np.uint32))

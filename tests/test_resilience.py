@@ -728,6 +728,40 @@ def test_transport_supervisor_state_machine():
         TransportSupervisor(start="torus")
 
 
+def test_transport_probation_ceiling_nondefault_home():
+    """Satellite (ISSUE 5): the probation ceiling for EVERY non-default
+    home, driven through full failure/recovery cycles — the ladder must
+    never climb above the configured start level no matter how long the
+    clean streak runs (a faithful-mode run must not be migrated onto
+    the ring, and an fp32 run must never leave fp32)."""
+    from cpd_tpu.resilience import TransportSupervisor
+
+    # home=faithful: repeated cycles of degrade-to-fp32 + recovery
+    sup = TransportSupervisor(start="faithful", max_retries=0,
+                              probation=2)
+    for cycle in range(3):
+        assert sup.on_failure(10 * cycle) == "downgrade"
+        assert sup.mode == "fp32" and sup.degraded
+        assert sup.on_success(10 * cycle + 1) is None
+        assert sup.on_success(10 * cycle + 2) == "upgrade"
+        assert sup.mode == "faithful" and not sup.degraded
+        # a LONG clean streak at home must never upgrade past it
+        for i in range(3, 9):
+            assert sup.on_success(10 * cycle + i) is None
+            assert sup.mode == "faithful"
+    assert [t[1:] for t in sup.transitions] == \
+        [("faithful", "fp32"), ("fp32", "faithful")] * 3
+    # home=fp32: the bottom rung is both floor and ceiling — recovery
+    # has nowhere to go, failure is terminal
+    bottom = TransportSupervisor(start="fp32", max_retries=0,
+                                 probation=1)
+    for i in range(5):
+        assert bottom.on_success(i) is None
+        assert bottom.mode == "fp32" and not bottom.degraded
+    assert bottom.on_failure(9) == "give_up"
+    assert bottom.transitions == []
+
+
 def test_level_reduce_kwargs_ladder():
     from cpd_tpu.resilience import level_reduce_kwargs
     assert level_reduce_kwargs("ring", 5, 2) == dict(

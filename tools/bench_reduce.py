@@ -204,6 +204,48 @@ def smoke() -> dict:
                              "sum — the attack is a no-op, so the "
                              "detection above proves nothing")
 
+    # stats-cast gate (ISSUE 5): the numeric-health telemetry cast must
+    # be BITWISE identical to the plain cast across formats × rounding —
+    # a telemetry layer that perturbs the values it observes corrupts
+    # the very training run it is supposed to protect — and its
+    # counters must be exact on a crafted probe
+    from cpd_tpu.quant.quant_function import (float_quantize,
+                                              float_quantize_stats)
+    probe = np.concatenate([
+        (rng.randn(509) * (10.0 ** rng.randint(-9, 9, 509)))
+        .astype(np.float32),
+        np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 1e-9, -2.5e-7,
+                  500.0, -600.0, 240.0], np.float32)])
+    key = jax.random.PRNGKey(23)
+    stats_checks = 0
+    for exp, man in ((4, 3), (5, 2), (5, 7), (8, 23)):
+        for k in (None, key):
+            rounding = "nearest" if k is None else "stochastic"
+            plain = np.asarray(float_quantize(jnp.asarray(probe), exp,
+                                              man, rounding=rounding,
+                                              key=k))
+            got, h = float_quantize_stats(jnp.asarray(probe), exp, man,
+                                          rounding=rounding, key=k)
+            if (np.asarray(got).view(np.uint32)
+                    != plain.view(np.uint32)).any():
+                raise AssertionError(
+                    f"stats cast != plain cast (bitwise) at "
+                    f"({exp},{man}) rounding={rounding}")
+            if int(h["total"]) != probe.size or \
+                    int(h["nan"]) != int(np.isnan(probe).sum()):
+                raise AssertionError(
+                    f"stats counters wrong at ({exp},{man}) "
+                    f"rounding={rounding}: {jax.tree.map(int, h)}")
+            stats_checks += 1
+    # exact counts on the crafted tail at (4,3): 500/-600 saturate,
+    # +/-inf pass through (4 sat), 1e-9/-2.5e-7 flush (but the random
+    # head flushes more) — pin the crafted-tail contribution precisely
+    _, h43 = float_quantize_stats(jnp.asarray(probe[-10:]), 4, 3)
+    if {kk: int(v) for kk, v in h43.items()} != \
+            {"sat": 4, "underflow": 2, "nan": 1, "total": 10}:
+        raise AssertionError(
+            f"(4,3) probe counters off: {jax.tree.map(int, h43)}")
+
     # byte-counter invariants — the acceptance gate: >= 2x fewer wire
     # bytes at W=8 for e5m2 vs the faithful gather path (both flavors)
     n_big = 1_000_000
@@ -219,6 +261,7 @@ def smoke() -> dict:
             "verified_ring": {"clean_ok": True, "flip_detected": True,
                               "flip_hop_bad": int(frep["hop_bad"]),
                               "flip_gather_bad": int(frep["gather_bad"])},
+            "stats_cast_bitwise_checks": stats_checks,
             "ring_bytes_w8_e5m2": ring_b,
             "gather_bytes_w8_e5m2_fp32": gather_fp32,
             "gather_bytes_w8_e5m2_packed": gather_packed,
