@@ -26,10 +26,18 @@ image before heavyweight deps install.
 """
 
 from .core import (Finding, Rule, all_rules, lint_file, lint_source,
-                   lint_tree, register, render_json, render_text)
+                   lint_tree, module_rules, project_rules, register,
+                   render_json, render_text)
+from .config import Config, load_config
+from .engine import AnalysisResult, run_analysis
+from .project import ProjectGraph, ProjectRule
+from .sarif import render_sarif
 
-__all__ = ["Finding", "Rule", "all_rules", "lint_file", "lint_source",
-           "lint_tree", "register", "render_json", "render_text"]
+__all__ = ["Finding", "Rule", "all_rules", "module_rules",
+           "project_rules", "lint_file", "lint_source", "lint_tree",
+           "register", "render_json", "render_text", "render_sarif",
+           "Config", "load_config", "AnalysisResult", "run_analysis",
+           "ProjectGraph", "ProjectRule"]
 
 # importing the rules package registers every built-in rule
 from . import rules as _rules  # noqa: E402,F401  (registration side effect)

@@ -1,37 +1,107 @@
-"""CLI entry: ``python -m cpd_tpu.analysis <paths> [--format=...]``.
+"""CLI entry: ``python -m cpd_tpu.analysis <paths> [options]``.
 
 Exit-code contract (stable for tooling; pinned by tests/test_analysis.py
-and [project.scripts] cpd-lint):
+and [project.scripts] cpd-lint — CI depends on the 1-vs-2 distinction to
+tell "findings" from "the analyzer itself broke"):
 
     0  clean — every checked file passed every selected rule
     1  findings — at least one unsuppressed finding was reported
-    2  internal error — bad arguments, unreadable/ unparsable input, or
-       a rule crash (details on stderr)
+    2  internal error — bad arguments, unreadable/ unparsable input, a
+       rule crash, a broken git environment under --changed-only, or an
+       unusable --config (details on stderr)
+
+Options beyond PR 1's:
+
+    --format=sarif       SARIF 2.1.0 (CI PR annotation; analysis/sarif.py)
+    --no-cache           bypass the .cpd-lint-cache/ fingerprint cache
+    --cache-dir DIR      cache location (default ./.cpd-lint-cache)
+    --changed-only       lint only git-changed .py files (working tree +
+                         index; --since REF diffs against a ref instead)
+    --config FILE        explicit [tool.cpd-lint] config (precedence:
+                         this > discovered pyproject > built-in)
+    --explain RULE       print a rule's catalog entry + the minimal
+                         bad/good example from its fixtures, then exit 0
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
-from .core import (LintError, all_rules, lint_tree, render_json,
-                   render_text)
+from .core import LintError, all_rules, render_json, render_text
+from .config import ConfigError
+from .engine import DEFAULT_CACHE_DIR, run_analysis
+from .sarif import render_sarif
 
 
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m cpd_tpu.analysis",
         description="JAX/precision-aware static lint for the cpd_tpu "
-                    "tree (stdlib-only; see docs/ANALYSIS.md)")
+                    "tree — per-file rules + a whole-program pass "
+                    "(stdlib-only; see docs/ANALYSIS.md)")
     p.add_argument("paths", nargs="*",
                    help="files or directories to lint")
-    p.add_argument("--format", choices=("text", "json"), default="text",
-                   help="output format (default: text)")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text", help="output format (default: text)")
     p.add_argument("--select", default=None, metavar="RULE[,RULE...]",
                    help="run only these rule ids")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit 0")
+    p.add_argument("--explain", default=None, metavar="RULE",
+                   help="print a rule's catalog entry + fixture example")
+    p.add_argument("--config", default=None, metavar="FILE",
+                   help="explicit cpd-lint config (overrides pyproject)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the per-file fingerprint cache")
+    p.add_argument("--cache-dir", default=DEFAULT_CACHE_DIR,
+                   metavar="DIR", help="fingerprint cache directory")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only git-changed files under the paths")
+    p.add_argument("--since", default=None, metavar="REF",
+                   help="with --changed-only: diff against REF instead "
+                        "of the working tree (CI passes the PR base)")
     return p
+
+
+def _fixtures_dir() -> str:
+    """tests/fixtures/analysis relative to the repo checkout (the
+    package's grandparent); '' when not running from a checkout."""
+    pkg = os.path.dirname(os.path.abspath(__file__))        # analysis/
+    repo = os.path.dirname(os.path.dirname(pkg))            # repo root
+    d = os.path.join(repo, "tests", "fixtures", "analysis")
+    return d if os.path.isdir(d) else ""
+
+
+def _explain(rule_id: str) -> int:
+    rules = all_rules()
+    rule = rules.get(rule_id)
+    if rule is None:
+        print(f"error: unknown rule id {rule_id!r}; known: "
+              f"{sorted(rules)}", file=sys.stderr)
+        return 2
+    print(f"{rule.id} [{rule.scope}]")
+    print(f"  {rule.summary}\n")
+    doc = (sys.modules[type(rule).__module__].__doc__ or "").strip()
+    if doc:
+        print(doc + "\n")
+    fdir = _fixtures_dir()
+    if not fdir:
+        print("(fixture examples unavailable outside a repo checkout)")
+        return 0
+    stem = rule_id.replace("-", "_")
+    for kind, label in (("bad", "FIRES on (minimal bad example)"),
+                        ("good", "stays SILENT on (clean twin)")):
+        path = os.path.join(fdir, f"{stem}_{kind}.py")
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            body = fh.read().rstrip()
+        print(f"--- {label}: tests/fixtures/analysis/{stem}_{kind}.py ---")
+        print(body)
+        print()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -45,11 +115,24 @@ def main(argv=None) -> int:
     rules = all_rules()
     if args.list_rules:
         for rule_id, rule in sorted(rules.items()):
-            print(f"{rule_id:16s} {rule.summary}")
+            print(f"{rule_id:20s} [{rule.scope:7s}] {rule.summary}")
         return 0
+    if args.explain is not None:
+        return _explain(args.explain)
 
     if not args.paths:
-        print("error: no paths given (try --help)", file=sys.stderr)
+        # [tool.cpd-lint].paths provides the default roots; bare
+        # invocation with neither is an error, not an empty pass
+        try:
+            from .config import load_config
+            cfg = load_config([], cli_path=args.config)
+        except ConfigError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        args.paths = list(cfg.paths)
+    if not args.paths:
+        print("error: no paths given and no [tool.cpd-lint].paths "
+              "configured (try --help)", file=sys.stderr)
         return 2
 
     select = None
@@ -61,24 +144,34 @@ def main(argv=None) -> int:
                   f"known: {sorted(rules)}", file=sys.stderr)
             return 2
 
-    files = []
     try:
-        findings = lint_tree(args.paths, select=select,
-                             on_file=files.append)
-    except LintError as e:
+        result = run_analysis(
+            args.paths, select=select, config_path=args.config,
+            use_cache=not args.no_cache, cache_dir=args.cache_dir,
+            changed_only=args.changed_only, since=args.since)
+    except (LintError, ConfigError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
-    if not files:
+    if result.files_checked == 0:
+        if args.changed_only:
+            # an empty diff is a legitimate clean PR, not an error
+            print("no changed Python files under the given paths",
+                  file=sys.stderr)
+            return 0
         print(f"error: no Python files under {args.paths}",
               file=sys.stderr)
         return 2
 
+    findings = result.findings
     if args.format == "json":
-        print(render_json(findings, files_checked=len(files)))
+        print(render_json(findings, files_checked=result.files_checked,
+                          files_parsed=result.files_parsed))
+    elif args.format == "sarif":
+        print(render_sarif(findings, base_dir=os.getcwd()))
     else:
         print(render_text(findings))
     return 1 if findings else 0

@@ -1,0 +1,105 @@
+"""Per-file fingerprint cache so the whole-program gate stays tier-1
+fast: a warm re-run over an unchanged tree re-parses ZERO files.
+
+One JSON entry per source file under ``.cpd-lint-cache/`` (CWD by
+default; ``--cache-dir`` overrides, ``--no-cache`` bypasses).  The entry
+key is the sha1 of the absolute path; the entry is valid only while its
+**fingerprint** matches:
+
+    (mtime_ns, size, rule-set hash)
+
+The rule-set hash covers the sorted rule ids AND ``SCHEMA_VERSION`` —
+bump the version whenever extraction or a rule's logic changes shape, so
+stale caches self-invalidate instead of silently serving old facts.
+Config exemptions are deliberately NOT in the fingerprint: they are
+applied AFTER the cache (engine.py), so editing pyproject's
+[tool.cpd-lint] table never requires a cold run.
+
+An entry stores the module-rule findings (already suppression-filtered —
+suppressions live in the file, so the fingerprint covers them) and the
+serialized module summary (analysis/project.py), which is everything the
+project rules need.  Corrupt or unreadable entries are treated as
+misses, never errors — the cache is an accelerator, not a source of
+truth.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+from .core import Finding
+
+__all__ = ["LintCache", "SCHEMA_VERSION", "ruleset_hash"]
+
+# bump on ANY change to summary extraction, Finding shape, or rule logic
+# that could alter cached results for an unchanged file
+SCHEMA_VERSION = 3
+
+
+def ruleset_hash(rule_ids) -> str:
+    blob = json.dumps([SCHEMA_VERSION, sorted(rule_ids)])
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+def _fingerprint(path: str, rules_hash: str) -> Optional[list]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return [st.st_mtime_ns, st.st_size, rules_hash]
+
+
+class LintCache:
+    """Directory-backed per-file cache (module docstring)."""
+
+    def __init__(self, directory: str, rule_ids):
+        self.directory = directory
+        self.rules_hash = ruleset_hash(rule_ids)
+        self.hits = 0
+        self.misses = 0
+
+    def _entry_path(self, path: str) -> str:
+        key = hashlib.sha1(os.path.abspath(path).encode()).hexdigest()
+        return os.path.join(self.directory, key + ".json")
+
+    def get(self, path: str) -> Optional[tuple]:
+        """(findings, summary) when fresh; None on miss/stale."""
+        fp = _fingerprint(path, self.rules_hash)
+        if fp is None:
+            return None
+        try:
+            with open(self._entry_path(path), encoding="utf-8") as fh:
+                entry = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if entry.get("fingerprint") != fp:
+            return None
+        try:
+            findings = [Finding(**f) for f in entry["findings"]]
+            summary = entry["summary"]
+        except (KeyError, TypeError):
+            return None
+        self.hits += 1
+        return findings, summary
+
+    def put(self, path: str, findings, summary) -> None:
+        self.misses += 1
+        fp = _fingerprint(path, self.rules_hash)
+        if fp is None:
+            return
+        entry = {"fingerprint": fp,
+                 "findings": [f.to_dict() for f in findings],
+                 "summary": summary}
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = self._entry_path(path) + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(entry, fh)
+            os.replace(tmp, self._entry_path(path))
+        except OSError:
+            # a read-only checkout must still lint; the cache silently
+            # degrades to a no-op there
+            pass

@@ -1,0 +1,287 @@
+"""Lint configuration: per-rule path exemptions + root paths.
+
+Three precedence layers, highest wins **per top-level key** (``exempt``,
+``paths``) — a higher layer that defines a key replaces the lower
+layer's value for that key wholesale, it does not merge into it
+(documented in docs/ANALYSIS.md, pinned by tests/test_analysis.py):
+
+    1. an explicit ``--config FILE`` on the CLI
+    2. the ``[tool.cpd-lint]`` table of the pyproject.toml discovered by
+       walking up from the first linted path
+    3. the built-in defaults below
+
+The built-in defaults exist so bare ``lint_source`` calls (unit tests,
+editor integrations with no project file) behave like the shipped
+pyproject: the ``swallow`` rule's resilience/ carve-out and
+``compat-drift``'s compat.py carve-out live in CONFIG, not in rule code.
+
+TOML support is a deliberate stdlib-only subset (``tomllib`` only
+appeared in Python 3.11 and this package must run on 3.10): sections,
+string/int/float/bool scalars, and (possibly multi-line) arrays of
+strings.  Quoted keys (``"compat-drift" = [...]``) are supported — rule
+ids contain hyphens.  That covers every [tool.cpd-lint] shape we
+document; anything fancier (inline tables, dotted keys in assignments)
+raises ``ConfigError`` rather than being silently misread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Iterable, Optional
+
+__all__ = ["Config", "ConfigError", "DEFAULT_CONFIG", "load_config",
+           "parse_toml_subset", "discover_pyproject"]
+
+
+class ConfigError(Exception):
+    """Unreadable/unsupported config input — maps to exit code 2."""
+
+
+# rule id -> path fragments (matched as substrings of the /-normalized
+# finding path).  These defaults mirror the shipped pyproject.toml.
+_DEFAULT_EXEMPT = {
+    "swallow": ("cpd_tpu/resilience/",),
+    "compat-drift": ("cpd_tpu/compat.py",),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Resolved lint configuration (see module docstring)."""
+    exempt: dict = dataclasses.field(
+        default_factory=lambda: dict(_DEFAULT_EXEMPT))
+    paths: tuple = ()              # default roots when CLI gives none
+    source: str = "builtin"        # where the winning table came from
+
+    def exempts(self, rule: str, path: str) -> bool:
+        """True when `rule` findings in `path` are configured away."""
+        fragments = self.exempt.get(rule)
+        if not fragments:
+            return False
+        norm = os.path.normpath(path).replace(os.sep, "/")
+        return any(frag in norm for frag in fragments)
+
+
+DEFAULT_CONFIG = Config()
+
+
+# ---------------------------------------------------------------------------
+# the TOML subset
+# ---------------------------------------------------------------------------
+
+_SECTION = re.compile(r'^\[([^\]]+)\]\s*(?:#.*)?$')
+_KEY = re.compile(r'^\s*(?:"([^"]+)"|\'([^\']+)\'|([A-Za-z0-9_-]+))\s*=\s*(.*)$')
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a # comment that is not inside a string literal."""
+    out, in_str, quote = [], False, ""
+    for ch in line:
+        if in_str:
+            out.append(ch)
+            if ch == quote:
+                in_str = False
+        elif ch in "\"'":
+            in_str, quote = True, ch
+            out.append(ch)
+        elif ch == "#":
+            break
+        else:
+            out.append(ch)
+    return "".join(out).rstrip()
+
+
+class _Unsupported:
+    """Sentinel for TOML values outside the supported subset.  They are
+    tolerated everywhere EXCEPT inside [tool.cpd-lint] itself — a
+    pyproject full of inline tables must still load, but a cpd-lint key
+    we cannot read must fail loudly (validated in _config_from_table)."""
+    def __repr__(self):
+        return "<unsupported toml value>"
+
+
+_UNSUPPORTED = _Unsupported()
+
+
+def _parse_scalar(text: str):
+    text = text.strip()
+    if len(text) >= 2 and text[0] in "\"'" and text[-1] == text[0]:
+        return text[1:-1]
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        return _UNSUPPORTED
+
+
+def _parse_array(text: str):
+    body = text.strip()[1:-1]
+    items, cur, in_str, quote = [], [], False, ""
+    for ch in body:
+        if in_str:
+            cur.append(ch)
+            if ch == quote:
+                in_str = False
+        elif ch in "\"'":
+            in_str, quote = True, ch
+            cur.append(ch)
+        elif ch == ",":
+            if "".join(cur).strip():
+                items.append(_parse_scalar("".join(cur)))
+            cur = []
+        else:
+            cur.append(ch)
+    if "".join(cur).strip():
+        items.append(_parse_scalar("".join(cur)))
+    return items
+
+
+def parse_toml_subset(text: str) -> dict:
+    """Parse the documented TOML subset into nested dicts (module
+    docstring).  Sections create nesting; unsupported syntax raises
+    ConfigError instead of misparsing."""
+    root: dict = {}
+    current = root
+    section: list = []
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = _strip_comment(lines[i])
+        i += 1
+        if not line.strip():
+            continue
+        m = _SECTION.match(line.strip())
+        if m:
+            current = root
+            section = [p.strip().strip('"\'')
+                       for p in m.group(1).split(".")]
+            for part in section:
+                current = current.setdefault(part, {})
+                if not isinstance(current, dict):
+                    raise ConfigError(
+                        f"section [{m.group(1)}] collides with a value")
+            continue
+        m = _KEY.match(line)
+        if not m:
+            # outside [tool.cpd-lint]: tolerate the rest of TOML (a
+            # pyproject full of dotted keys must still load).  INSIDE
+            # our table, a line we cannot read is a loud error — a
+            # silently-dropped exemption would un-gate the tree.
+            if section[:2] == ["tool", "cpd-lint"]:
+                raise ConfigError(
+                    f"unsupported TOML syntax inside [tool.cpd-lint]: "
+                    f"{line.strip()!r} (the supported subset is plain "
+                    f"`key = value` / quoted keys / string arrays — "
+                    f"see analysis/config.py)")
+            continue
+        key = m.group(1) or m.group(2) or m.group(3)
+        value = m.group(4).strip()
+        if value.startswith("["):
+            # arrays may span lines: accumulate until brackets balance
+            while value.count("[") > value.count("]"):
+                if i >= len(lines):
+                    raise ConfigError(f"unterminated array for key {key!r}")
+                value += " " + _strip_comment(lines[i]).strip()
+                i += 1
+            current[key] = _parse_array(value)
+        else:
+            current[key] = _parse_scalar(value)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# loading + precedence
+# ---------------------------------------------------------------------------
+
+def discover_pyproject(paths: Iterable[str]) -> Optional[str]:
+    """Walk up from each path in turn (or the CWD when none are given)
+    until some pyproject.toml is found."""
+    paths = list(paths) or [os.getcwd()]
+    for root in paths:
+        probe = os.path.abspath(root)
+        if os.path.isfile(probe):
+            probe = os.path.dirname(probe)
+        while True:
+            cand = os.path.join(probe, "pyproject.toml")
+            if os.path.isfile(cand):
+                return cand
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break              # this root exhausted; try the next
+            probe = parent
+    return None
+
+
+def _table_from_file(path: str) -> Optional[dict]:
+    """The [tool.cpd-lint] table of `path` (or the file's top level when
+    it IS a standalone cpd-lint config with no [tool] nesting)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = parse_toml_subset(fh.read())
+    except OSError as e:
+        raise ConfigError(f"cannot read config {path}: {e}") from e
+    table = data.get("tool", {}).get("cpd-lint")
+    if table is None and os.path.basename(path) != "pyproject.toml":
+        # standalone config file: top-level keys are the table
+        table = {k: v for k, v in data.items()
+                 if k in ("exempt", "paths")}
+    return table if table else None
+
+
+def _config_from_table(table: dict, source: str,
+                       base: Config) -> Config:
+    exempt = base.exempt
+    paths = base.paths
+    raw_exempt = table.get("exempt")
+    if raw_exempt is not None:
+        if not isinstance(raw_exempt, dict):
+            raise ConfigError("[tool.cpd-lint.exempt] must be a table of "
+                              "rule-id -> path-fragment arrays")
+        exempt = {}
+        for rule, frags in raw_exempt.items():
+            if isinstance(frags, str):
+                frags = [frags]
+            if not isinstance(frags, list) or not all(
+                    isinstance(f, str) for f in frags):
+                raise ConfigError(f"exempt.{rule!s} must be a "
+                                  f"path-fragment string array (got an "
+                                  f"unsupported TOML value — see the "
+                                  f"supported subset in "
+                                  f"analysis/config.py)")
+            exempt[rule] = tuple(frags)
+    raw_paths = table.get("paths")
+    if raw_paths is not None:
+        if not isinstance(raw_paths, list) or not all(
+                isinstance(p, str) for p in raw_paths):
+            raise ConfigError("[tool.cpd-lint].paths must be a string array")
+        paths = tuple(raw_paths)
+    return Config(exempt=exempt, paths=paths, source=source)
+
+
+def load_config(paths: Iterable[str] = (),
+                cli_path: Optional[str] = None) -> Config:
+    """Resolve the active Config through the precedence chain
+    (module docstring): --config file > discovered pyproject > builtin,
+    applied PER KEY — a --config that sets only ``paths`` still takes
+    its ``exempt`` table from the discovered pyproject."""
+    cfg = DEFAULT_CONFIG
+    pyproject = discover_pyproject(paths)
+    if pyproject is not None:
+        table = _table_from_file(pyproject)
+        if table:
+            cfg = _config_from_table(table, pyproject, cfg)
+    if cli_path is None:
+        return cfg
+    if not os.path.isfile(cli_path):
+        raise ConfigError(f"config file does not exist: {cli_path}")
+    table = _table_from_file(cli_path)
+    if table:
+        cfg = _config_from_table(table, cli_path, cfg)
+    return cfg

@@ -20,8 +20,9 @@ import tokenize
 from typing import Callable, Iterable, Iterator, Optional
 
 __all__ = ["Finding", "ModuleContext", "Rule", "register", "all_rules",
-           "lint_source", "lint_file", "lint_tree", "render_text",
-           "render_json"]
+           "module_rules", "project_rules", "lint_source", "lint_file",
+           "lint_tree", "lint_parsed", "run_project_rules",
+           "render_text", "render_json"]
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -100,6 +101,23 @@ class Suppressions:
                 return False
         return True
 
+    def to_dict(self) -> dict:
+        """JSON form (cached alongside the module summary so warm runs
+        filter project-rule findings without re-tokenizing)."""
+        return {"skip": self.skip_file,
+                "file_rules": sorted(self.file_rules),
+                "line_rules": {str(k): sorted(v)
+                               for k, v in self.line_rules.items()}}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Suppressions":
+        inst = cls.__new__(cls)
+        inst.skip_file = bool(data.get("skip", False))
+        inst.file_rules = set(data.get("file_rules", ()))
+        inst.line_rules = {int(k): set(v)
+                           for k, v in data.get("line_rules", {}).items()}
+        return inst
+
 
 class ModuleContext:
     """Everything a rule needs about one parsed file, computed once."""
@@ -127,10 +145,13 @@ class ModuleContext:
 
 class Rule:
     """Base class; subclasses set ``id``/``summary`` and implement
-    ``check``."""
+    ``check``.  ``scope`` is "module" (check(ctx) per parsed file) or
+    "project" (check(project) once per run, over the whole-program graph
+    — see analysis/project.py's ProjectRule)."""
 
     id: str = ""
     summary: str = ""
+    scope: str = "module"
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         raise NotImplementedError
@@ -152,6 +173,14 @@ def register(cls: type) -> type:
 
 def all_rules() -> dict[str, Rule]:
     return dict(_REGISTRY)
+
+
+def module_rules() -> dict[str, Rule]:
+    return {k: r for k, r in _REGISTRY.items() if r.scope == "module"}
+
+
+def project_rules() -> dict[str, Rule]:
+    return {k: r for k, r in _REGISTRY.items() if r.scope == "project"}
 
 
 # ---------------------------------------------------------------------------
@@ -299,31 +328,54 @@ class LintError(Exception):
     """Internal failure (unreadable file, rule crash) — exit code 2."""
 
 
-def lint_source(src: str, path: str = "<string>",
-                select: Optional[Iterable[str]] = None) -> list[Finding]:
-    """Lint one source blob; returns suppression-filtered findings."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        raise LintError(f"{path}: syntax error at line {e.lineno}: "
-                        f"{e.msg}") from e
-    supp = Suppressions(src)
-    if supp.skip_file:
-        return []
-    ctx = ModuleContext(path, src, tree)
-    # line -> first line of the innermost statement covering it, so a
-    # suppression on a multi-line call's first line covers findings
-    # anchored to argument nodes on its later lines (nested statements
-    # start later, so max() picks the innermost)
+def _stmt_start_map(tree: ast.Module) -> dict[int, int]:
+    """line -> first line of the innermost statement covering it, so a
+    suppression on a multi-line call's first line covers findings
+    anchored to argument nodes on its later lines (nested statements
+    start later, so max() picks the innermost)."""
     stmt_start: dict[int, int] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.stmt) and node.end_lineno is not None:
             for line in range(node.lineno, node.end_lineno + 1):
                 stmt_start[line] = max(stmt_start.get(line, 1),
                                        node.lineno)
+    return stmt_start
+
+
+def lint_parsed(path: str, src: str, tree: ast.Module,
+                select: Optional[Iterable[str]] = None
+                ) -> tuple[list[Finding], dict]:
+    """Module-rule pass over one parsed file.
+
+    Returns ``(suppression-filtered findings, module summary)`` — the
+    summary (analysis/project.py) carries the whole-program facts PLUS
+    the file's suppression/statement tables under ``"_lint"``, so the
+    fingerprint cache can serve project rules without re-parsing."""
+    from .project import summarize_module
+    supp = Suppressions(src)
+    stmt_start = _stmt_start_map(tree)
+    try:
+        summary = summarize_module(path, src, tree)
+    except LintError:
+        raise
+    except Exception as e:
+        # an extraction crash is an engine bug and must surface as
+        # exit 2 (analyzer broke), never as exit 1 (lint findings) —
+        # CI distinguishes them (docs/ANALYSIS.md contract)
+        raise LintError(
+            f"{path}: summary extraction crashed: "
+            f"{type(e).__name__}: {e}") from e
+    summary["_lint"] = {"supp": supp.to_dict(),
+                        "stmt_start": {str(k): v
+                                       for k, v in stmt_start.items()}}
+    if supp.skip_file:
+        return [], summary
+    ctx = ModuleContext(path, src, tree)
     wanted = set(select) if select is not None else None
     out: list[Finding] = []
     for rule_id, rule in sorted(_REGISTRY.items()):
+        if rule.scope != "module":
+            continue
         if wanted is not None and rule_id not in wanted:
             continue
         try:
@@ -336,17 +388,83 @@ def lint_source(src: str, path: str = "<string>",
             raise LintError(
                 f"{path}: rule {rule_id!r} crashed: {type(e).__name__}: "
                 f"{e}") from e
-    return sorted(out)
+    return sorted(out), summary
+
+
+def run_project_rules(summaries: list[dict],
+                      select: Optional[Iterable[str]] = None
+                      ) -> list[Finding]:
+    """Whole-program pass: build one ProjectGraph over `summaries`, run
+    every selected project-scoped rule, and filter each finding through
+    its file's cached suppression tables."""
+    from .project import ProjectGraph
+    wanted = set(select) if select is not None else None
+    active = [(rid, r) for rid, r in sorted(_REGISTRY.items())
+              if r.scope == "project"
+              and (wanted is None or rid in wanted)]
+    if not active:
+        return []
+    graph = ProjectGraph(summaries)
+    supp_by_path: dict[str, tuple] = {}
+    for s in summaries:
+        meta = s.get("_lint", {})
+        supp = Suppressions.from_dict(meta.get("supp", {}))
+        stmt_start = {int(k): v
+                      for k, v in meta.get("stmt_start", {}).items()}
+        supp_by_path[s["path"]] = (supp, stmt_start)
+    out: list[Finding] = []
+    for rule_id, rule in active:
+        try:
+            for f in rule.check(graph):
+                supp, stmt_start = supp_by_path.get(f.path, (None, {}))
+                if supp is None:
+                    out.append(f)
+                elif not supp.skip_file and supp.allows(
+                        f, stmt_start.get(f.line)):
+                    out.append(f)
+        except LintError:
+            raise
+        except Exception as e:
+            raise LintError(
+                f"project rule {rule_id!r} crashed: "
+                f"{type(e).__name__}: {e}") from e
+    return out
+
+
+def _apply_config(findings: list[Finding], config) -> list[Finding]:
+    if config is None:
+        return findings
+    return [f for f in findings if not config.exempts(f.rule, f.path)]
+
+
+def lint_source(src: str, path: str = "<string>",
+                select: Optional[Iterable[str]] = None,
+                config=None) -> list[Finding]:
+    """Lint one source blob (module rules + a single-file project pass);
+    returns suppression- and config-filtered findings.  With no explicit
+    `config` the built-in defaults apply (analysis/config.py)."""
+    from .config import DEFAULT_CONFIG
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        raise LintError(f"{path}: syntax error at line {e.lineno}: "
+                        f"{e.msg}") from e
+    findings, summary = lint_parsed(path, src, tree, select=select)
+    findings = findings + run_project_rules([summary], select=select)
+    return sorted(_apply_config(findings,
+                                config if config is not None
+                                else DEFAULT_CONFIG))
 
 
 def lint_file(path: str,
-              select: Optional[Iterable[str]] = None) -> list[Finding]:
+              select: Optional[Iterable[str]] = None,
+              config=None) -> list[Finding]:
     try:
         with open(path, encoding="utf-8") as fh:
             src = fh.read()
     except OSError as e:
         raise LintError(f"cannot read {path}: {e}") from e
-    return lint_source(src, path=path, select=select)
+    return lint_source(src, path=path, select=select, config=config)
 
 
 # Directories never worth descending into.  ``fixtures`` holds test DATA
@@ -376,15 +494,36 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
 
 def lint_tree(paths: Iterable[str],
               select: Optional[Iterable[str]] = None,
-              on_file: Optional[Callable[[str], None]] = None
-              ) -> list[Finding]:
-    """Lint every .py under ``paths`` (files or directories)."""
+              on_file: Optional[Callable[[str], None]] = None,
+              config=None) -> list[Finding]:
+    """Lint every .py under ``paths`` (files or directories): one
+    module-rule pass per file plus ONE whole-program pass over all of
+    them.  With no explicit `config` the pyproject.toml discovered above
+    the first path wins, then the built-in defaults (analysis/config.py
+    precedence).  For the cached engine see analysis/engine.py."""
+    from .config import load_config
+    if config is None:
+        config = load_config(paths)
     findings: list[Finding] = []
+    summaries: list[dict] = []
     for path in iter_python_files(paths):
         if on_file is not None:
             on_file(path)
-        findings.extend(lint_file(path, select=select))
-    return sorted(findings)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            raise LintError(f"cannot read {path}: {e}") from e
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            raise LintError(f"{path}: syntax error at line {e.lineno}: "
+                            f"{e.msg}") from e
+        local, summary = lint_parsed(path, src, tree, select=select)
+        findings.extend(local)
+        summaries.append(summary)
+    findings.extend(run_project_rules(summaries, select=select))
+    return sorted(_apply_config(findings, config))
 
 
 # ---------------------------------------------------------------------------
@@ -399,13 +538,19 @@ def render_text(findings: list[Finding]) -> str:
     return "\n".join(lines)
 
 
-def render_json(findings: list[Finding], files_checked: int) -> str:
+def render_json(findings: list[Finding], files_checked: int,
+                files_parsed: Optional[int] = None) -> str:
     by_rule: dict[str, int] = {}
     for f in findings:
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
-    return json.dumps({
+    payload = {
         "version": 1,
         "files_checked": files_checked,
         "findings": [f.to_dict() for f in findings],
         "counts": by_rule,
-    }, indent=2, sort_keys=True)
+    }
+    if files_parsed is not None:
+        # additive cache telemetry (v1-compatible): how many files the
+        # run actually re-parsed vs served from the fingerprint cache
+        payload["files_parsed"] = files_parsed
+    return json.dumps(payload, indent=2, sort_keys=True)

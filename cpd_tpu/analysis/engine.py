@@ -1,0 +1,151 @@
+"""The cached analysis driver behind the CLI: per-file fingerprint cache
++ whole-program pass + config filtering + git ``--changed-only`` mode.
+
+``lint_tree`` (core.py) is the simple always-parse API the tests lean
+on; ``run_analysis`` is the production entry — same rules, same
+findings, but files whose fingerprint matches the cache are served
+without re-parsing (their module-rule findings AND their project-layer
+summaries come from disk), and the result carries the counters the
+cache-correctness test pins (``files_parsed == 0`` on a warm unchanged
+tree).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+from typing import Iterable, Optional
+
+from . import core
+from .cache import LintCache
+from .config import Config, load_config
+from .core import Finding, LintError
+
+__all__ = ["AnalysisResult", "run_analysis", "changed_files"]
+
+DEFAULT_CACHE_DIR = ".cpd-lint-cache"
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list
+    files_checked: int
+    files_parsed: int        # cache misses; 0 on a warm unchanged tree
+    config: Config
+
+
+def changed_files(paths: Iterable[str],
+                  since: Optional[str] = None) -> list[str]:
+    """The .py files under `paths` that git reports as changed: working
+    tree + index vs HEAD (``git status --porcelain``), or the diff
+    against ``since`` (a ref; CI passes the PR base).  A broken git
+    environment is a loud LintError (exit 2) — silently linting nothing
+    would shrink the gate to zero coverage."""
+    roots = [os.path.abspath(p) for p in paths]
+    cwd = roots[0] if roots else os.getcwd()
+    if os.path.isfile(cwd):
+        cwd = os.path.dirname(cwd)
+    if since:
+        cmd = ["git", "diff", "--name-only", "--diff-filter=d", "-z",
+               since, "--"]
+    else:
+        # -uall lists FILES inside untracked directories (plain
+        # --porcelain emits only "?? newdir/", which would silently
+        # skip every new file in a new package)
+        cmd = ["git", "status", "--porcelain", "-uall", "-z"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              cwd=cwd, timeout=60)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise LintError(f"--changed-only: cannot run git: {e}") from e
+    if proc.returncode != 0:
+        raise LintError(f"--changed-only: git failed: "
+                        f"{proc.stderr.strip() or proc.stdout.strip()}")
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True, cwd=cwd)
+    repo = top.stdout.strip() if top.returncode == 0 else cwd
+    names: list[str] = []
+    chunks = [c for c in proc.stdout.split("\0") if c]
+    i = 0
+    while i < len(chunks):
+        chunk = chunks[i]
+        i += 1
+        if since:
+            name = chunk
+        else:
+            status, name = chunk[:2], chunk[3:]
+            if status and status[0] in "RC":
+                # -z rename/copy records emit the OLD path as the NEXT
+                # NUL field, with no status prefix — consume it so it
+                # is neither prefix-sliced nor linted (it no longer
+                # exists under that name)
+                i += 1
+        if name.endswith(".py"):
+            names.append(os.path.join(repo, name))
+    wanted = []
+    for name in names:
+        full = os.path.abspath(name)
+        if not os.path.isfile(full):
+            continue
+        if any(full == r or full.startswith(r + os.sep) for r in roots):
+            wanted.append(full)
+    return sorted(set(wanted))
+
+
+def run_analysis(paths: Iterable[str],
+                 select: Optional[Iterable[str]] = None,
+                 config_path: Optional[str] = None,
+                 use_cache: bool = True,
+                 cache_dir: Optional[str] = None,
+                 changed_only: bool = False,
+                 since: Optional[str] = None) -> AnalysisResult:
+    """The CLI's analysis pipeline (module docstring)."""
+    paths = list(paths)
+    config = load_config(paths, cli_path=config_path)
+    if changed_only:
+        files = changed_files(paths, since=since)
+    else:
+        files = list(core.iter_python_files(paths))
+    cache = None
+    if use_cache:
+        cache = LintCache(cache_dir or DEFAULT_CACHE_DIR,
+                          sorted(core.all_rules()))
+    findings: list[Finding] = []
+    summaries: list[dict] = []
+    parsed = 0
+    for path in files:
+        entry = cache.get(path) if cache is not None else None
+        if entry is not None:
+            local, summary = entry
+        else:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError as e:
+                raise LintError(f"cannot read {path}: {e}") from e
+            import ast as _ast
+            try:
+                tree = _ast.parse(src, filename=path)
+            except SyntaxError as e:
+                raise LintError(f"{path}: syntax error at line "
+                                f"{e.lineno}: {e.msg}") from e
+            parsed += 1
+            # cache entries always hold the FULL rule set's findings
+            # (select filtering happens below), so a --select run can
+            # never poison the cache for a later full run
+            local, summary = core.lint_parsed(path, src, tree,
+                                              select=None)
+            if cache is not None:
+                cache.put(path, local, summary)
+        findings.extend(local)
+        summaries.append(summary)
+    findings.extend(core.run_project_rules(summaries, select=select))
+    if select is not None:
+        wanted = set(select)
+        findings = [f for f in findings if f.rule in wanted]
+    findings = [f for f in findings
+                if not config.exempts(f.rule, f.path)]
+    return AnalysisResult(findings=sorted(findings),
+                          files_checked=len(files),
+                          files_parsed=parsed, config=config)

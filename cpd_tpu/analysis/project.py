@@ -1,0 +1,969 @@
+"""Whole-program analysis layer: module summaries, import resolution,
+call graph, and a small constant/format lattice — stdlib-``ast`` only.
+
+PR 1's linter is per-file; every cross-module incident since slipped
+exactly through the file boundary (the man<2 ladder rung that would die
+inside ``pack_exmy`` mid-jit, the ``ladder_step_key`` re-trace bug fixed
+in PR 5 review).  This layer gives rules the missing whole-program view:
+
+* **ModuleSummary** — a JSON-serializable fact extraction from one
+  parsed file: imports, per-scope function summaries (calls with
+  abstract argument values, collective axis literals, ppermute
+  permutation analyses, Kahan unpacks, wire-payload name closures,
+  jit-construction sites, step-table subscripts), module-level
+  constants, declared mesh axes, suppression tables.  Because rules
+  consume summaries — never raw ASTs — the fingerprint cache
+  (analysis/cache.py) can serve a warm run with ZERO re-parses.
+
+* **ProjectGraph** — summaries indexed and linked: dotted-import
+  resolution across the analyzed tree (absolute + relative, one level
+  of ``__init__`` re-export chasing), a call graph that also follows
+  bare-name function references (step functions passed to
+  ``shard_map``/``jax.jit`` are edges too), and an interprocedural
+  constant lattice.
+
+The lattice is deliberately small: abstract values are sets of concrete
+constants (strings, ints, floats, tuples — which covers eXmY ``(exp,
+man)`` pairs, ladder rung lists, axis names and wire-word widths) plus a
+``("packed", (exp, man))`` marker for ``pack_exmy`` results.  Joins are
+set unions; a set wider than ``_WIDEN_CAP`` widens to TOP (``None``).
+Parameter environments are propagated caller→callee over the call graph
+to a bounded fixpoint (``_PROPAGATE_ROUNDS``), so a format literal
+constructed in a trainer CLI is visible at the ``pack_exmy`` sink four
+calls away.  Everything undecidable stays TOP and rules only fire on
+KNOWN-bad values — the analysis is unsound-but-precise by design: it
+exists to catch the silently-wrong-number bug class, not to prove the
+tree correct.
+
+Project-scoped rules subclass ``ProjectRule`` (``scope = "project"``)
+and implement ``check(project)``; the engine builds one graph per run
+(a single-module graph for ``lint_source``/``lint_file``) and filters
+their findings through the same per-file suppression tables as module
+rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Optional
+
+from .core import (Finding, Rule, base_name, call_arg, dotted_name,
+                   literal_int)
+
+__all__ = ["ProjectGraph", "ProjectRule", "summarize_module",
+           "module_name_for", "TOP"]
+
+TOP = None                # lattice top: "any value"
+_WIDEN_CAP = 8            # value-set size that widens to TOP
+_PROPAGATE_ROUNDS = 6     # caller->callee binding fixpoint bound
+_AVAL_DEPTH = 3           # nested abstract-value extraction depth
+
+# collective -> axis-argument position/keyword (the axis-name rule's
+# vocabulary, restated here so extraction never imports the rule module)
+COLLECTIVES = {
+    "psum": (1, "axis_name"), "pmean": (1, "axis_name"),
+    "pmax": (1, "axis_name"), "pmin": (1, "axis_name"),
+    "ppermute": (1, "axis_name"), "pshuffle": (1, "axis_name"),
+    "psum_scatter": (1, "axis_name"), "all_gather": (1, "axis_name"),
+    "all_to_all": (1, "axis_name"), "axis_index": (0, "axis_name"),
+    "axis_size": (0, "axis_name"),
+    "broadcast_from": (1, "axis_name"), "all_reduce_mean": (1, "axis_name"),
+    "pmax_scalar_vector": (1, "axis_name"),
+}
+
+_MESH_CANONICAL = ("dp", "tp", "sp", "pp", "ep")
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for a file, walking up through __init__.py
+    packages ('cpd_tpu.parallel.ring'; bare stem for scripts)."""
+    path = os.path.abspath(path)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if stem == "__init__" else [stem]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    return ".".join(parts) if parts else stem
+
+
+# ---------------------------------------------------------------------------
+# abstract values
+# ---------------------------------------------------------------------------
+
+def _aval(node: ast.AST, params: set, depth: int = 0) -> dict:
+    """Extraction-time abstract value of an expression (module
+    docstring's lattice, JSON-encoded)."""
+    if depth > _AVAL_DEPTH:
+        return {"k": "top"}
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if isinstance(v, bool) or v is None:
+            return {"k": "const", "v": v}
+        if isinstance(v, (int, float)):
+            return {"k": "num", "v": v}
+        if isinstance(v, str):
+            return {"k": "str", "v": v}
+        return {"k": "top"}
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _aval(node.operand, params, depth + 1)
+        if inner.get("k") == "num":
+            return {"k": "num", "v": -inner["v"]}
+        return {"k": "top"}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        if len(node.elts) > 16:
+            return {"k": "top"}
+        return {"k": "tuple",
+                "v": [_aval(el, params, depth + 1) for el in node.elts]}
+    if isinstance(node, ast.Name):
+        kind = "param" if node.id in params else "name"
+        return {"k": kind, "v": node.id}
+    if isinstance(node, ast.Attribute):
+        chain = dotted_name(node)
+        if chain:
+            return {"k": "attr", "v": chain.split(".")}
+        return {"k": "top"}
+    if isinstance(node, ast.JoinedStr):
+        return {"k": "fstr"}
+    if isinstance(node, ast.Starred):
+        return {"k": "star"}
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func)
+        return {
+            "k": "call", "f": callee,
+            "args": [_aval(a, params, depth + 1) for a in node.args],
+            "kw": {k.arg: _aval(k.value, params, depth + 1)
+                   for k in node.keywords if k.arg is not None},
+        }
+    return {"k": "top"}
+
+
+# ---------------------------------------------------------------------------
+# permutation bijection analysis (collective-contract's local half,
+# computed at extraction so cached summaries carry the verdict)
+# ---------------------------------------------------------------------------
+
+def _linear_in(expr: ast.AST, var: str, consts: dict) -> Optional[tuple]:
+    """Classify `expr` as an injective-mod-w function of `var`: returns
+    (stride, ...) marker when provably injective over range(w), None when
+    unknown, and raises nothing.  Recognized: i, i+c, i-c, c-i, w-1-i,
+    (any of those) % w, with c an int literal/module constant."""
+    node = expr
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)):
+        node = node.left           # (f(i)) % w is injective iff f is
+    if isinstance(node, ast.Name) and node.id == var:
+        return (1,)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add,
+                                                            ast.Sub)):
+        left_has = any(isinstance(n, ast.Name) and n.id == var
+                       for n in ast.walk(node.left))
+        right_has = any(isinstance(n, ast.Name) and n.id == var
+                        for n in ast.walk(node.right))
+        if left_has and not right_has:
+            inner = _linear_in(node.left, var, consts)
+            return inner
+        if right_has and not left_has:
+            inner = _linear_in(node.right, var, consts)
+            if inner is None or inner[0] == "noninj":
+                return inner   # c - 2*i is as non-injective as 2*i
+            # c - i is injective; c + i too
+            return (-inner[0],) if isinstance(node.op, ast.Sub) else inner
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult)):
+        # stride * i: injective mod w only when gcd(stride, w) == 1 —
+        # unknowable without w, so treat literal strides != 1 as suspect
+        for side, other in ((node.left, node.right),
+                            (node.right, node.left)):
+            if isinstance(side, ast.Name) and side.id == var:
+                c = literal_int(other)
+                if c is None and isinstance(other, ast.Name):
+                    c = consts.get(other.id)
+                if c is not None and abs(c) != 1:
+                    return ("noninj", c)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.FloorDiv):
+        left_has = any(isinstance(n, ast.Name) and n.id == var
+                       for n in ast.walk(node.left))
+        if left_has:
+            return ("noninj", "//")
+    return None
+
+
+def _perm_violation(perm_node: ast.AST, consts: dict) -> Optional[str]:
+    """A message when the ppermute permutation expression is provably NOT
+    a bijection; None when it is one or is unresolvable."""
+    # literal [(s, d), ...]
+    if isinstance(perm_node, (ast.List, ast.Tuple)) and perm_node.elts:
+        srcs, dsts = [], []
+        for el in perm_node.elts:
+            if not (isinstance(el, ast.Tuple) and len(el.elts) == 2):
+                return None
+            s, d = (literal_int(el.elts[0]), literal_int(el.elts[1]))
+            if s is None or d is None:
+                return None
+            srcs.append(s)
+            dsts.append(d)
+        if len(set(srcs)) != len(srcs):
+            return (f"permutation repeats source rank(s) "
+                    f"{sorted(s for s in srcs if srcs.count(s) > 1)} — "
+                    f"ppermute silently drops duplicate senders")
+        if len(set(dsts)) != len(dsts):
+            return (f"permutation repeats destination rank(s) "
+                    f"{sorted(d for d in dsts if dsts.count(d) > 1)} — "
+                    f"colliding receivers make the result rank-dependent")
+        return None
+    # [(f(i), g(i)) for i in range(w)]
+    if isinstance(perm_node, ast.ListComp) and len(
+            perm_node.generators) == 1:
+        gen = perm_node.generators[0]
+        if not (isinstance(gen.target, ast.Name)
+                and isinstance(gen.iter, ast.Call)
+                and base_name(gen.iter.func) == "range"
+                and not gen.ifs):
+            return None
+        var = gen.target.id
+        elt = perm_node.elt
+        if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2):
+            return None
+        for label, half in (("source", elt.elts[0]),
+                            ("destination", elt.elts[1])):
+            uses_var = any(isinstance(n, ast.Name) and n.id == var
+                           for n in ast.walk(half))
+            if not uses_var:
+                return (f"permutation {label} is constant over the "
+                        f"comprehension — every rank maps to the same "
+                        f"{label}; not a bijection")
+            cls = _linear_in(half, var, consts)
+            if cls is not None and cls[0] == "noninj":
+                return (f"permutation {label} `{ast.unparse(half)}` is "
+                        f"not injective over the axis (stride/floordiv "
+                        f"collides ranks for even axis sizes) — "
+                        f"ppermute needs a bijection")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-scope extraction
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def _scope_statements(body) -> Iterator[ast.AST]:
+    """Walk a scope without entering nested function/class scopes (the
+    nested def node itself is yielded)."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, _SCOPE_NODES):
+                yield child
+                continue
+            stack.append(child)
+
+
+def _in_pytest_raises(parents: list) -> bool:
+    for p in parents:
+        if isinstance(p, ast.With):
+            for item in p.items:
+                ctx = item.context_expr
+                if (isinstance(ctx, ast.Call)
+                        and base_name(ctx.func) == "raises"):
+                    return True
+    return False
+
+
+class _ScopeExtractor:
+    """Extract one function scope's facts (module docstring)."""
+
+    def __init__(self, name: str, qual: str, node, body,
+                 int_consts: dict, lineno: int):
+        self.name = name
+        self.qual = qual
+        self.int_consts = int_consts
+        self._perm_sources = _extract_perm_sources(body)
+        params: list = []
+        kwonly: list = []
+        if node is not None and not isinstance(node, ast.Module):
+            a = node.args
+            params = [p.arg for p in (a.posonlyargs + a.args)]
+            kwonly = [p.arg for p in a.kwonlyargs]
+        self.params = params
+        self.kwonly = kwonly
+        self.pset = set(params) | set(kwonly)
+        self.out = {
+            "name": name, "qual": qual, "line": lineno,
+            "params": params, "kwonly": kwonly,
+            "calls": [], "refs": [], "assigns": {},
+            "axis_literals": [], "perm_findings": [],
+            "kahan_unpacks": [], "wire_payloads": [],
+            "jit_in_loop": [], "table_subscripts": [],
+            "supervisor_objs": {}, "jit_tables": [], "returns": [],
+        }
+        self._assign_deps: dict = {}       # name -> set of RHS names
+        self._refs: set = set()
+        self._walk(body, parents=[])
+        self._close_wire_payloads()
+        self.out["refs"] = sorted(self._refs)[:200]
+
+    # -- traversal ---------------------------------------------------------
+
+    def _walk(self, body, parents):
+        for stmt in body:
+            self._visit(stmt, parents)
+
+    def _visit(self, node, parents):
+        if isinstance(node, _SCOPE_NODES):
+            return                          # nested scope: its own summary
+        if isinstance(node, ast.Assign):
+            self._handle_assign(node, parents)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            self.out["returns"].append(_aval(node.value, self.pset))
+        self._scan_expressions(node, parents)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPE_NODES):
+                continue
+            self._visit(child, parents + [node])
+
+    def _scan_expressions(self, node, parents):
+        if isinstance(node, ast.Call):
+            self._handle_call(node, parents)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            self._refs.add(node.id)
+        elif isinstance(node, ast.Subscript):
+            self._handle_subscript(node, parents)
+
+    # -- statement handlers ------------------------------------------------
+
+    def _handle_assign(self, node: ast.Assign, parents):
+        value = node.value
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            av = _aval(value, self.pset)
+            prev = self.out["assigns"].get(tgt)
+            # joined local assignment view: two different AVals -> top
+            self.out["assigns"][tgt] = av if prev in (None, av) else \
+                {"k": "top"}
+            self._assign_deps.setdefault(tgt, set()).update(
+                n.id for n in ast.walk(value)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load))
+            if isinstance(value, ast.Call):
+                cname = base_name(value.func)
+                if cname.endswith("TransportSupervisor"):
+                    self.out["supervisor_objs"][tgt] = "transport"
+                elif cname.endswith("PrecisionSupervisor"):
+                    self.out["supervisor_objs"][tgt] = "precision"
+            if isinstance(value, (ast.Dict,)) or (
+                    isinstance(value, ast.Call)
+                    and base_name(value.func) == "dict"
+                    and not value.args):
+                self.out["jit_tables"].append(
+                    {"name": tgt, "jit": False, "line": node.lineno})
+        # res, comp = kahanish(...)
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Tuple)
+                and len(node.targets[0].elts) == 2
+                and all(isinstance(e, ast.Name)
+                        for e in node.targets[0].elts)
+                and isinstance(value, ast.Call)):
+            res, comp = (e.id for e in node.targets[0].elts)
+            self.out["kahan_unpacks"].append({
+                "res": res, "comp": comp,
+                "callee": dotted_name(value.func), "line": node.lineno})
+        # table[key] = jax.jit(...)  — mark jit tables
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)
+                and isinstance(node.targets[0].value, ast.Name)):
+            tname = node.targets[0].value.id
+            has_jit = any(isinstance(n, ast.Call)
+                          and dotted_name(n.func) in _JIT_NAMES
+                          for n in ast.walk(value))
+            if has_jit:
+                for t in self.out["jit_tables"]:
+                    if t["name"] == tname:
+                        t["jit"] = True
+                        break
+                else:
+                    self.out["jit_tables"].append(
+                        {"name": tname, "jit": True, "line": node.lineno})
+
+    def _handle_call(self, node: ast.Call, parents):
+        callee = dotted_name(node.func)
+        fact = {
+            "callee": callee, "line": node.lineno, "col": node.col_offset,
+            "args": [_aval(a, self.pset) for a in node.args],
+            "kw": {k.arg: _aval(k.value, self.pset)
+                   for k in node.keywords if k.arg is not None},
+            "star": any(isinstance(a, ast.Starred) for a in node.args)
+                    or any(k.arg is None for k in node.keywords),
+            "raises_ctx": _in_pytest_raises(parents),
+        }
+        self.out["calls"].append(fact)
+        short = base_name(node.func)
+        # collective axis literals + ppermute permutation analysis
+        spec = COLLECTIVES.get(short)
+        if spec is not None:
+            axis_arg = call_arg(node, spec[0], spec[1])
+            if axis_arg is not None:
+                lits = []
+                if (isinstance(axis_arg, ast.Constant)
+                        and isinstance(axis_arg.value, str)):
+                    lits = [axis_arg]
+                elif isinstance(axis_arg, (ast.Tuple, ast.List)):
+                    lits = [el for el in axis_arg.elts
+                            if isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)]
+                for lit in lits:
+                    self.out["axis_literals"].append({
+                        "collective": short, "axis": lit.value,
+                        "line": lit.lineno, "col": lit.col_offset})
+        if short == "ppermute":
+            perm_arg = call_arg(node, 2, "perm")
+            if perm_arg is not None:
+                msg = None
+                if isinstance(perm_arg, ast.Name):
+                    # a perm built earlier in the scope: analyze its RHS
+                    src = self._perm_sources.get(perm_arg.id)
+                    if src is not None:
+                        msg = _perm_violation(src, self.int_consts)
+                else:
+                    msg = _perm_violation(perm_arg, self.int_consts)
+                if msg:
+                    self.out["perm_findings"].append({
+                        "line": perm_arg.lineno, "col": perm_arg.col_offset,
+                        "msg": msg})
+        if short in ("ppermute", "all_gather") and node.args:
+            self.out["wire_payloads"].append({
+                "collective": short,
+                "names": sorted({n.id for n in ast.walk(node.args[0])
+                                 if isinstance(n, ast.Name)
+                                 and isinstance(n.ctx, ast.Load)}),
+                "line": node.lineno, "col": node.col_offset})
+        # jit construction inside a loop without a memoization guard.
+        # A `for cfg in (a, b)` sweep over a SMALL literal tuple is a
+        # bounded set of distinct once-traced configs, not a retrace
+        # hazard — only while-loops / unbounded iterables flag.
+        if callee in _JIT_NAMES:
+            loops = [p for p in parents
+                     if isinstance(p, (ast.For, ast.While))]
+            hazardous = any(
+                isinstance(p, ast.While)
+                or not (isinstance(p.iter, (ast.Tuple, ast.List))
+                        and len(p.iter.elts) <= 4)
+                for p in loops)
+            if loops and hazardous:
+                guarded = any(
+                    isinstance(p, ast.If)
+                    and isinstance(p.test, ast.Compare)
+                    and any(isinstance(op, ast.NotIn)
+                            for op in p.test.ops)
+                    for p in parents)
+                if not guarded:
+                    self.out["jit_in_loop"].append(
+                        {"line": node.lineno, "col": node.col_offset})
+
+    def _handle_subscript(self, node: ast.Subscript, parents):
+        if not (isinstance(node.value, ast.Name)
+                and isinstance(node.ctx, ast.Load)):
+            return
+        key = node.slice
+        entry = {"table": node.value.id, "line": node.lineno,
+                 "col": node.col_offset, "key_kind": "other",
+                 "key_obj": "", "key_attr": "", "key_callee": ""}
+        if isinstance(key, ast.Attribute):
+            chain = dotted_name(key)
+            parts = chain.split(".") if chain else []
+            if len(parts) == 2:
+                entry.update(key_kind="attr", key_obj=parts[0],
+                             key_attr=parts[1])
+        elif isinstance(key, ast.JoinedStr):
+            entry["key_kind"] = "fstr"
+        elif isinstance(key, ast.Call):
+            entry.update(key_kind="call",
+                         key_callee=dotted_name(key.func))
+        elif isinstance(key, ast.Name):
+            entry["key_kind"] = "name"
+            src = self.out["assigns"].get(key.id)
+            if src is not None:
+                if src.get("k") == "fstr":
+                    entry["key_kind"] = "fstr"
+                elif src.get("k") == "attr" and len(src["v"]) == 2:
+                    entry.update(key_kind="attr", key_obj=src["v"][0],
+                                 key_attr=src["v"][1])
+                elif src.get("k") == "call":
+                    entry.update(key_kind="call", key_callee=src["f"])
+        elif isinstance(key, ast.Constant):
+            entry["key_kind"] = "const"
+        self.out["table_subscripts"].append(entry)
+
+    # -- post-passes -------------------------------------------------------
+
+    def _close_wire_payloads(self):
+        """Transitive closure of payload names through scope-local
+        assignments, so `wire = to_wire(res, comp); ppermute(wire, ...)`
+        sees res/comp in the payload's name set."""
+        for wp in self.out["wire_payloads"]:
+            seen = set(wp["names"])
+            frontier = list(seen)
+            for _ in range(20):
+                nxt = set()
+                for nm in frontier:
+                    nxt |= self._assign_deps.get(nm, set()) - seen
+                if not nxt:
+                    break
+                seen |= nxt
+                frontier = list(nxt)
+            wp["names"] = sorted(seen)[:80]
+
+
+def _extract_perm_sources(body) -> dict:
+    """name -> the list-comp/list expression assigned to it in this
+    scope (for `perm = [...]; ppermute(x, a, perm)`)."""
+    out = {}
+    for n in _scope_statements(body):
+        if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, (ast.List, ast.Tuple,
+                                         ast.ListComp))):
+            out[n.targets[0].id] = n.value
+    return out
+
+
+def _declared_axes_of(tree: ast.Module) -> list:
+    """Mesh axes declared anywhere in the module (the axis-name rule's
+    binding logic, shared)."""
+    from .core import string_literals
+    declared: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = base_name(node.func)
+        if name in ("make_mesh", "data_parallel_mesh"):
+            declared |= set(_MESH_CANONICAL)
+        elif name == "Mesh":
+            axes = call_arg(node, 1, "axis_names")
+            if axes is not None:
+                declared |= {c.value for c in string_literals(axes)}
+        elif dotted_name(node.func) in ("jax.make_mesh", "make_mesh2"):
+            axes = call_arg(node, 1, "axis_names")
+            if axes is not None:
+                declared |= {c.value for c in string_literals(axes)}
+        elif name in ("PartitionSpec", "P"):
+            declared |= {c.value for c in string_literals(node)}
+        elif name in ("shard_map", "pjit"):
+            for kw in node.keywords:
+                if kw.arg in ("in_specs", "out_specs", "axis_names"):
+                    declared |= {c.value for c in string_literals(kw.value)}
+    return sorted(declared)
+
+
+def summarize_module(path: str, src: str, tree: ast.Module,
+                     modname: Optional[str] = None) -> dict:
+    """The serializable whole-program facts of one parsed file."""
+    modname = modname or module_name_for(path)
+    int_consts: dict = {}
+    consts: dict = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            av = _aval(node.value, set())
+            consts[node.targets[0].id] = av
+            iv = literal_int(node.value)
+            if iv is not None:
+                int_consts[node.targets[0].id] = iv
+
+    imports: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                imports[local] = {"kind": "mod", "mod": target}
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level:
+                pkg = modname.split(".")
+                if not path.endswith("__init__.py"):
+                    pkg = pkg[:-1]
+                pkg = pkg[:len(pkg) - (node.level - 1)] if node.level > 1 \
+                    else pkg
+                mod = ".".join(pkg + ([mod] if mod else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                imports[local] = {"kind": "obj", "mod": mod,
+                                  "attr": alias.name}
+
+    functions: dict = {}
+
+    def visit_scope(node, qual_prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = (qual_prefix + "." + child.name) if qual_prefix \
+                    else child.name
+                ex = _ScopeExtractor(child.name, qual, child, child.body,
+                                     int_consts, child.lineno)
+                functions[qual] = ex.out
+                visit_scope(child, qual)
+            elif isinstance(child, ast.ClassDef):
+                visit_scope(child, (qual_prefix + "." + child.name)
+                            if qual_prefix else child.name)
+            else:
+                visit_scope(child, qual_prefix)
+
+    # module-level pseudo-scope
+    mod_ex = _ScopeExtractor("<module>", "<module>", None, tree.body,
+                             int_consts, 1)
+    functions["<module>"] = mod_ex.out
+    visit_scope(tree, "")
+
+    return {
+        "path": path, "modname": modname,
+        "is_package": path.endswith("__init__.py"),
+        "imports": imports,
+        "declared_axes": _declared_axes_of(tree),
+        "int_consts": int_consts, "consts": consts,
+        "functions": functions,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the project graph
+# ---------------------------------------------------------------------------
+
+class ProjectRule(Rule):
+    """Base for whole-program rules: ``check(project)`` instead of
+    ``check(ctx)``."""
+    scope = "project"
+
+    def check(self, project: "ProjectGraph") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class ProjectGraph:
+    """Summaries indexed, linked and propagated (module docstring)."""
+
+    def __init__(self, summaries: list):
+        self.modules: dict = {}        # modname -> summary
+        for s in summaries:
+            key = s["modname"]
+            if key in self.modules:
+                # two top-level scripts with the same stem (e.g. every
+                # examples/*/train.py): uniquify so neither shadows the
+                # other — scripts are not import targets, so the
+                # decorated name never needs to resolve
+                key = s["modname"] + "@" + s["path"]
+                s = dict(s, modname=key)
+            self.modules[key] = s
+        self.funcs: dict = {}          # (modname, qual) -> func summary
+        # build from self.modules (the de-collided view), NOT from the
+        # raw summaries — otherwise same-stem scripts overwrite each
+        # other's functions and findings land in the wrong file
+        for s in self.modules.values():
+            for qual, f in s["functions"].items():
+                self.funcs[(s["modname"], qual)] = f
+        self._edges: dict = {}         # fkey -> set of callee fkeys
+        self._redges: dict = {}        # fkey -> set of caller fkeys
+        self._resolve_cache: dict = {}
+        self._build_edges()
+        self._env: dict = {fk: {} for fk in self.funcs}
+        self._propagate()
+
+    # -- import/function resolution ---------------------------------------
+
+    def _module_func(self, modname: str, name: str,
+                     depth: int = 0) -> Optional[tuple]:
+        """(modname, qual) for a top-level function `name` of `modname`,
+        chasing one level of __init__ re-exports."""
+        if depth > 3:
+            return None
+        s = self.modules.get(modname)
+        if s is None:
+            return None
+        if name in s["functions"]:
+            return (modname, name)
+        # method container classes: Class.name lookups happen elsewhere
+        imp = s["imports"].get(name)
+        if imp is not None:
+            if imp["kind"] == "obj":
+                return self._module_func(imp["mod"], imp["attr"],
+                                         depth + 1)
+            return None
+        return None
+
+    def resolve(self, modname: str, dotted: str) -> Optional[tuple]:
+        """Resolve a (possibly dotted) callee name seen in `modname` to a
+        project function key, or None (external/unresolvable)."""
+        if not dotted:
+            return None
+        ck = (modname, dotted)
+        if ck in self._resolve_cache:
+            return self._resolve_cache[ck]
+        out = self._resolve_uncached(modname, dotted)
+        self._resolve_cache[ck] = out
+        return out
+
+    def _resolve_uncached(self, modname, dotted):
+        s = self.modules.get(modname)
+        if s is None:
+            return None
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+        if not rest:
+            # local def, or imported object
+            local = self._module_func(modname, head)
+            if local is not None:
+                return local
+            return None
+        imp = s["imports"].get(head)
+        if imp is None:
+            return None
+        if imp["kind"] == "mod":
+            target = imp["mod"]
+        else:
+            target = imp["mod"] + "." + imp["attr"]
+        # walk remaining parts: all but the last extend the module path
+        for i, part in enumerate(rest):
+            is_last = i == len(rest) - 1
+            if is_last:
+                fn = self._module_func(target, part)
+                if fn is not None:
+                    return fn
+                return None
+            target = target + "." + part
+        return None
+
+    # -- call graph --------------------------------------------------------
+
+    def _build_edges(self):
+        for (mod, qual), f in self.funcs.items():
+            edges = set()
+            for call in f["calls"]:
+                tgt = self.resolve(mod, call["callee"])
+                if tgt is not None:
+                    edges.add(tgt)
+            for ref in f["refs"]:
+                tgt = self.resolve(mod, ref)
+                if tgt is not None:
+                    edges.add(tgt)
+            # an enclosing function "calls" its nested defs (they close
+            # over its scope and usually run under it)
+            for other_qual in self.modules[mod]["functions"]:
+                if other_qual.startswith(qual + ".") and \
+                        other_qual.count(".") == qual.count(".") + 1:
+                    edges.add((mod, other_qual))
+            edges.discard((mod, qual))
+            self._edges[(mod, qual)] = edges
+            for tgt in edges:
+                self._redges.setdefault(tgt, set()).add((mod, qual))
+
+    def callers(self, fkey) -> set:
+        return self._redges.get(fkey, set())
+
+    def callees(self, fkey) -> set:
+        return self._edges.get(fkey, set())
+
+    # -- lattice evaluation ------------------------------------------------
+
+    def _concrete(self, av: dict) -> Optional[frozenset]:
+        """Value set of an aval with no env needed; TOP otherwise."""
+        k = av.get("k")
+        if k in ("num", "str", "const"):
+            return frozenset([av["v"]])
+        if k == "tuple":
+            parts = [self._concrete(x) for x in av["v"]]
+            if any(p is None or len(p) != 1 for p in parts):
+                return TOP
+            return frozenset([tuple(next(iter(p)) for p in parts)])
+        return TOP
+
+    def eval_in(self, fkey, av: dict, depth: int = 0) -> Optional[frozenset]:
+        """Value set of an abstract value observed inside function
+        `fkey`, resolving params through the propagated environments and
+        names through module constants.  None == TOP."""
+        if av is None or depth > 6:
+            return TOP
+        k = av.get("k")
+        conc = self._concrete(av)
+        if conc is not None:
+            return conc
+        if k == "param":
+            return self._env.get(fkey, {}).get(av["v"], TOP)
+        if k == "name":
+            mod, qual = fkey
+            f = self.funcs.get(fkey)
+            if f is not None:
+                local = f["assigns"].get(av["v"])
+                if local is not None:
+                    return self.eval_in(fkey, local, depth + 1)
+            cav = self.modules[mod]["consts"].get(av["v"])
+            if cav is not None:
+                return self.eval_in(fkey, cav, depth + 1)
+            return TOP
+        if k == "tuple":
+            parts = [self.eval_in(fkey, x, depth + 1) for x in av["v"]]
+            if any(p is TOP or len(p) != 1 for p in parts):
+                return TOP
+            return frozenset([tuple(next(iter(p)) for p in parts)])
+        if k == "call":
+            base = av.get("f", "").rsplit(".", 1)[-1]
+            if base in ("pack_exmy",) and len(av.get("args", [])) >= 3:
+                e = self.eval_in(fkey, av["args"][1], depth + 1)
+                m = self.eval_in(fkey, av["args"][2], depth + 1)
+                if e is not TOP and m is not TOP and len(e) == 1 \
+                        and len(m) == 1:
+                    return frozenset(
+                        [("packed", (next(iter(e)), next(iter(m))))])
+                return TOP
+            tgt = self.resolve(fkey[0], av.get("f", ""))
+            if tgt is not None:
+                return self.returns_of(tgt, depth + 1)
+        return TOP
+
+    def returns_of(self, fkey, depth: int = 0) -> Optional[frozenset]:
+        """Joined return-value set of a function (TOP unless every
+        return is concrete under its env)."""
+        if depth > 6:
+            return TOP
+        f = self.funcs.get(fkey)
+        if f is None or not f["returns"]:
+            return TOP
+        out = set()
+        for rav in f["returns"]:
+            vs = self.eval_in(fkey, rav, depth + 1)
+            if vs is TOP:
+                return TOP
+            out |= vs
+            if len(out) > _WIDEN_CAP:
+                return TOP
+        return frozenset(out)
+
+    # -- interprocedural parameter propagation ----------------------------
+
+    def _propagate(self):
+        for _ in range(_PROPAGATE_ROUNDS):
+            changed = False
+            for (mod, qual), f in self.funcs.items():
+                for call in f["calls"]:
+                    tgt = self.resolve(mod, call["callee"])
+                    if tgt is None or call["star"]:
+                        continue
+                    tf = self.funcs[tgt]
+                    bindings = list(zip(tf["params"], call["args"]))
+                    for kname, kav in call["kw"].items():
+                        if kname in tf["params"] or kname in tf["kwonly"]:
+                            bindings.append((kname, kav))
+                    env = self._env[tgt]
+                    for pname, pav in bindings:
+                        vs = self.eval_in((mod, qual), pav)
+                        old = env.get(pname, frozenset())
+                        if old is TOP:
+                            continue
+                        new = TOP if vs is TOP else old | vs
+                        if new is not TOP and len(new) > _WIDEN_CAP:
+                            new = TOP
+                        if new != old:
+                            env[pname] = new
+                            changed = True
+            if not changed:
+                break
+
+    def param_values(self, fkey, pname) -> Optional[frozenset]:
+        vs = self._env.get(fkey, {}).get(pname)
+        return TOP if vs is None or vs is TOP else vs
+
+    # -- reachability helpers ---------------------------------------------
+
+    def reachable_axes(self, fkey) -> set:
+        """Axes declared in this function's own module or in any module
+        holding a transitive caller — 'a mesh constructor that actually
+        reaches it through the call graph'."""
+        axes: set = set()
+        seen = {fkey}
+        frontier = [fkey]
+        while frontier:
+            cur = frontier.pop()
+            axes.update(self.modules[cur[0]]["declared_axes"])
+            for caller in self.callers(cur):
+                if caller not in seen:
+                    seen.add(caller)
+                    frontier.append(caller)
+        return axes
+
+    def ring_reaching(self, fkey, max_depth: int = 8,
+                      root_bindings: Optional[dict] = None
+                      ) -> Optional[int]:
+        """Line of the first ring sink (a call with mode='ring', a
+        ring_quantized_sum call, or a pack_exmy call) reachable from
+        `fkey` through the call graph; None when no sink is reachable.
+
+        ``root_bindings`` (param -> value set) overrides the JOINED
+        parameter environment for `fkey` itself — one level of context
+        sensitivity, so a ladder handed to ``f(..., mode="faithful")``
+        is not condemned because a DIFFERENT call site passes
+        ``mode="ring"`` through the same function."""
+        seen = {fkey}
+        frontier = [(fkey, 0)]
+        while frontier:
+            cur, d = frontier.pop()
+            f = self.funcs.get(cur)
+            if f is not None:
+                for call in f["calls"]:
+                    base = call["callee"].rsplit(".", 1)[-1]
+                    if base in ("ring_quantized_sum", "pack_exmy"):
+                        return call["line"]
+                    mode = call["kw"].get("mode")
+                    if mode is not None:
+                        if (cur == fkey and root_bindings is not None
+                                and mode.get("k") == "param"
+                                and mode["v"] in root_bindings):
+                            vs = root_bindings[mode["v"]]
+                        else:
+                            vs = self.eval_in(cur, mode)
+                        if vs is not TOP and "ring" in vs:
+                            return call["line"]
+            if d >= max_depth:
+                continue
+            for nxt in self.callees(cur):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append((nxt, d + 1))
+        return None
+
+    def kahan_producing(self, modname: str, callee: str,
+                        depth: int = 2) -> bool:
+        """True when `callee` (as seen from `modname`) is a Kahan
+        accumulator by name, or transitively calls one within `depth`."""
+        if "kahan" in callee.lower():
+            return True
+        tgt = self.resolve(modname, callee)
+        seen = set()
+        frontier = [(tgt, 0)] if tgt is not None else []
+        while frontier:
+            cur, d = frontier.pop()
+            if cur is None or cur in seen or d > depth:
+                continue
+            seen.add(cur)
+            if "kahan" in cur[1].lower():
+                return True
+            f = self.funcs.get(cur)
+            if f is None:
+                continue
+            for call in f["calls"]:
+                if "kahan" in call["callee"].lower():
+                    return True
+                nxt = self.resolve(cur[0], call["callee"])
+                if nxt is not None and nxt not in seen:
+                    frontier.append((nxt, d + 1))
+        return False
+
+    # -- iteration ---------------------------------------------------------
+
+    def iter_functions(self):
+        """(fkey, func summary, module summary) for every scope."""
+        for fkey, f in self.funcs.items():
+            yield fkey, f, self.modules[fkey[0]]

@@ -1,7 +1,8 @@
 """Built-in rules.  Importing this package registers every rule with the
 core registry (each module applies ``@core.register`` at import time).
 
-Rule IDs (stable — they are the suppression-comment vocabulary):
+Rule IDs (stable — they are the suppression-comment vocabulary).
+Module-scoped (one file at a time):
 
   format-bounds    eXmY literals outside exp[1,8]/man[0,23]; constants
                    that overflow a literal-declared format
@@ -13,12 +14,29 @@ Rule IDs (stable — they are the suppression-comment vocabulary):
   kahan-ordering   unordered jnp.sum/lax.psum over quantized values
                    where the ordered primitives exist
   donation         reuse of a buffer after donating it to a jitted call
-  swallow          bare except / pass-only broad except outside
-                   resilience/ (failure handling must be explicit)
+  swallow          bare except / pass-only broad except (failure
+                   handling must be explicit; the resilience/ carve-out
+                   lives in [tool.cpd-lint] config, not here)
+  compat-drift     jax.experimental.* / removed-API use outside
+                   compat.py (ROADMAP item 5 precondition)
+
+Project-scoped (whole-program, over analysis/project.py's graph):
+
+  format-flow      man<2 ladder rungs reaching the ring wire; (exp,man)
+                   swaps across call boundaries; pack/unpack width drift
+  axis-flow        axis literals in no-mesh library modules unreachable
+                   from any mesh constructor through the call graph
+  collective-contract  non-bijective ppermute permutations; Kahan
+                   compensation missing from a wire the partial rides
+  retrace          jit built per-iteration; step tables keyed outside
+                   ladder_step_key/StepTable (the PR 5 stale-step bug)
 """
 
-from . import (axis_name, donation, format_bounds, jit_hazards,  # noqa: F401
-               kahan_ordering, pallas_hygiene, swallow)
+from . import (axis_flow, axis_name, collective_contract,  # noqa: F401
+               compat_drift, donation, format_bounds, format_flow,
+               jit_hazards, kahan_ordering, pallas_hygiene, retrace,
+               swallow)
 
 __all__ = ["format_bounds", "axis_name", "jit_hazards", "pallas_hygiene",
-           "kahan_ordering", "donation", "swallow"]
+           "kahan_ordering", "donation", "swallow", "compat_drift",
+           "format_flow", "axis_flow", "collective_contract", "retrace"]

@@ -1,0 +1,80 @@
+"""axis-flow: collective axis literals must be reachable from a mesh
+that binds them — whole-program.
+
+The module-local `axis-name` rule exempts every module that declares no
+mesh ("library code takes axis_name as a parameter") — a blanket hole:
+a library function that HARDCODES an axis string is exactly the case
+that rule exists for, and it hides in the exemption.  This rule kills
+the hole: for each collective call with a literal axis in a
+**no-mesh module**, the literal must be bound by at least one mesh
+constructor in SOME module that reaches this function through the call
+graph (transitive callers; bare-name references like
+``shard_map(step, ...)`` count as edges).  A literal no mesh anywhere
+can justify is a finding — it can only ever trace against somebody
+else's axis names.
+
+Modules that DO declare axes stay `axis-name`'s territory (module-local
+check, no double report).  Test modules additionally inherit the axes of
+any ``conftest.py`` above them — pytest wires those fixtures in without
+a visible call edge.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+from ..core import Finding, register
+from ..project import ProjectGraph, ProjectRule
+
+
+def _conftest_axes(project: ProjectGraph, path: str) -> set:
+    """Axes declared by conftest.py files in ancestor directories of
+    `path` (pytest's implicit reach)."""
+    axes: set = set()
+    d = os.path.dirname(os.path.abspath(path))
+    for s in project.modules.values():
+        if os.path.basename(s["path"]) == "conftest.py":
+            cdir = os.path.dirname(os.path.abspath(s["path"]))
+            if d == cdir or d.startswith(cdir + os.sep):
+                axes.update(s["declared_axes"])
+    return axes
+
+
+@register
+class AxisFlow(ProjectRule):
+    id = "axis-flow"
+    summary = ("collective axis literals in library (no-mesh) modules "
+               "must be bound by a mesh that reaches them through the "
+               "call graph")
+
+    def check(self, project: ProjectGraph) -> Iterator[Finding]:
+        for fkey, f, mod in project.iter_functions():
+            if mod["declared_axes"]:
+                continue          # axis-name's (module-local) territory
+            if not f["axis_literals"]:
+                continue
+            callers = len(project.callers(fkey))
+            if not callers:
+                # no caller in the ANALYZED SET: the binding driver may
+                # simply be outside it (--changed-only lints one file at
+                # a time) — degrade to silence, never to guesses.  The
+                # full-tree gate, where every live function has test/CLI
+                # callers, is where absence of a mesh becomes a verdict.
+                continue
+            reachable = project.reachable_axes(fkey)
+            reachable |= _conftest_axes(project, mod["path"])
+            for lit in f["axis_literals"]:
+                if lit["axis"] in reachable:
+                    continue
+                via = f"{callers} transitive caller(s) checked"
+                yield Finding(
+                    path=mod["path"], line=lit["line"], col=lit["col"],
+                    rule=self.id,
+                    message=(
+                        f"{lit['collective']}: axis {lit['axis']!r} is "
+                        f"not bound by any mesh constructor that reaches "
+                        f"this function through the call graph ({via}"
+                        f"{'; reachable axes: ' + str(sorted(reachable)) if reachable else ''}) "
+                        f"— the literal can only trace against someone "
+                        f"else's axis names"))

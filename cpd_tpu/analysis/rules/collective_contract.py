@@ -1,0 +1,73 @@
+"""collective-contract: ppermute permutations must be bijections; Kahan
+compensation must ride every wire the partial sum rides.
+
+Two contracts XLA never checks and trace time cannot:
+
+* **bijection** — ``lax.ppermute`` takes ``(source, dest)`` pairs.  A
+  repeated source silently DROPS one payload; a repeated destination
+  makes the received value backend-order dependent; a stride that
+  shares a factor with the axis size collides ranks for even worlds.
+  The ring transport's entire correctness story (parallel/ring.py's
+  documented per-chunk rotation) assumes the hop permutation is exactly
+  the cyclic bijection.  Literal perm lists and
+  ``[(f(i), g(i)) for i in range(w)]`` comprehensions are classified at
+  extraction (analysis/project.py `_perm_violation`); anything
+  unresolvable stays silent.
+
+* **Kahan-on-the-wire** — a Kahan-compensated partial is a PAIR
+  ``(res, comp)``: the next hop's casts need the compensation term, or
+  the scheme silently degrades to plain quantized accumulation (the
+  error the +2x wire cost exists to remove — ring.py ships both values
+  in the reduce-scatter phase for exactly this reason).  In any scope
+  that unpacks ``res, comp = <kahan-producing call>`` (callee named
+  *kahan*, or transitively calling one — resolved through the project
+  graph), a ``ppermute``/``all_gather`` payload whose name closure
+  (traced through local assignments) contains ``res`` but NOT ``comp``
+  is a finding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Finding, register
+from ..project import ProjectGraph, ProjectRule
+
+
+@register
+class CollectiveContract(ProjectRule):
+    id = "collective-contract"
+    summary = ("ppermute permutations must be bijections; Kahan "
+               "compensation must ride every wire the partial rides")
+
+    def check(self, project: ProjectGraph) -> Iterator[Finding]:
+        for fkey, f, mod in project.iter_functions():
+            for pf in f["perm_findings"]:
+                yield Finding(
+                    path=mod["path"], line=pf["line"], col=pf["col"],
+                    rule=self.id, message="ppermute: " + pf["msg"])
+            yield from self._kahan_wire(project, fkey, f, mod)
+
+    def _kahan_wire(self, project, fkey, f, mod) -> Iterator[Finding]:
+        if not f["kahan_unpacks"] or not f["wire_payloads"]:
+            return
+        pairs = [(u["res"], u["comp"]) for u in f["kahan_unpacks"]
+                 if project.kahan_producing(fkey[0], u["callee"])]
+        if not pairs:
+            return
+        for wp in f["wire_payloads"]:
+            names = set(wp["names"])
+            for res, comp in pairs:
+                if res in names and comp not in names:
+                    yield Finding(
+                        path=mod["path"], line=wp["line"], col=wp["col"],
+                        rule=self.id,
+                        message=(
+                            f"{wp['collective']}: payload carries the "
+                            f"Kahan partial {res!r} but not its "
+                            f"compensation {comp!r} — the next hop's "
+                            f"casts lose the compensated bits and the "
+                            f"scheme silently degrades to plain "
+                            f"quantized accumulation (ring.py ships "
+                            f"both: `jnp.stack([res, comp])`)"))
+                    break

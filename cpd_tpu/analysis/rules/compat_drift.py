@@ -1,0 +1,86 @@
+"""compat-drift: version-sensitive JAX API use outside compat.py.
+
+ROADMAP item 5 (un-pin from jax 0.4.x, kill the shim) needs a
+machine-checked inventory of every version-gated API use before the
+migration can start — and needs the inventory to STAY empty afterwards.
+This rule is that inventory: any ``jax.experimental.*`` import or
+attribute chain, and any known-removed/renamed jax API, is a finding
+unless it sits in ``cpd_tpu/compat.py`` (the one sanctioned shim site,
+carved out via the [tool.cpd-lint] exempt table — config, not rule
+code).
+
+``jax.experimental`` is exactly the surface jax upstream renames,
+promotes and deletes between minor releases (`shard_map` →
+``jax.shard_map``, ``maps``/``pjit`` internals gone, Pallas still
+migrating).  Routing every such use through compat.py means an upstream
+rename costs ONE file, and the dual-pin CI of ROADMAP item 5 has a
+single choke point to verify.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, ModuleContext, Rule, dotted_name, register
+
+# APIs removed or renamed across the 0.4.x -> current window: using one
+# is drift even outside jax.experimental
+_REMOVED = {
+    "jax.tree_multimap": "removed in jax 0.4 — use jax.tree.map",
+    "jax.tree_map": "deprecated alias — use jax.tree.map",
+    "jax.abstract_arrays": "module removed — use jax.core aval types",
+    "jax.linear_util": "moved to jax.extend.linear_util",
+    "jax.xla_computation": "removed — use jax.jit(f).lower(...)",
+    "jax.core.NamedShape": "removed in jax 0.5",
+}
+
+_MSG = ("version-gated API ({name}) outside compat.py — route it "
+        "through cpd_tpu/compat.py so the jax un-pin (ROADMAP item 5) "
+        "has one choke point; see docs/ANALYSIS.md")
+
+
+@register
+class CompatDrift(Rule):
+    id = "compat-drift"
+    summary = ("jax.experimental.* / removed-API use outside compat.py "
+               "— the machine-checked precondition for the jax un-pin")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        inner: set = set()   # ids of Attribute nodes inside a reported chain
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.startswith("jax.experimental"):
+                        yield ctx.finding(self.id, node,
+                                          _MSG.format(name=alias.name))
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level == 0 and mod.startswith("jax.experimental"):
+                    yield ctx.finding(self.id, node, _MSG.format(name=mod))
+                elif node.level == 0 and mod == "jax":
+                    # `from jax import experimental [as e]` is the same
+                    # surface through a side door
+                    for alias in node.names:
+                        if alias.name == "experimental":
+                            yield ctx.finding(
+                                self.id, node,
+                                _MSG.format(name="jax.experimental"))
+            elif isinstance(node, ast.Attribute) and id(node) not in inner:
+                chain = dotted_name(node)
+                if not chain:
+                    continue
+                hit = None
+                if chain.startswith("jax.experimental"):
+                    hit = _MSG.format(name=chain)
+                elif chain in _REMOVED:
+                    hit = (_MSG.format(name=chain)
+                           + f" ({_REMOVED[chain]})")
+                if hit:
+                    # report the OUTERMOST chain once, not every nested
+                    # Attribute node it contains (they share positions)
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Attribute) and sub is not node:
+                            inner.add(id(sub))
+                    yield ctx.finding(self.id, node, hit)

@@ -1,0 +1,267 @@
+"""format-flow: whole-program eXmY format consistency.
+
+`format-bounds` (PR 1) checks each call site against the legal eXmY
+ranges — per file.  Every real incident since crossed a file boundary:
+a ladder string built in a trainer CLI dies three calls later inside
+``pack_exmy`` (the man<2 rung PR 5 review caught at argument time), a
+helper forwards ``(exp, man)`` swapped into a ``(man, exp)`` API, a
+packer and its unpacker drift to different declared widths.  This rule
+runs those checks over the project graph (analysis/project.py):
+
+1. **ladder → ring**: a ladder rung list (a literal ``"e5m2,e4m1"``
+   string or tuple of ``(exp, man)`` pairs) that flows into a function
+   from which a ring sink is reachable through the call graph — a call
+   with ``mode="ring"``, a ``ring_quantized_sum`` call, or a
+   ``pack_exmy`` call — must have ``man >= 2`` on every rung: the wire
+   codec rejects man<2 formats, so the first escalation onto that rung
+   dies mid-jit, hours in.  Calls inside ``pytest.raises`` blocks are
+   skipped (tests that PROVE the rejection are not bugs).
+2. **component swap**: at any known format API, passing a man-named
+   variable into the exp slot (or vice versa) across a call boundary —
+   both-in-range swaps that format-bounds cannot see.
+3. **pack/unpack width drift**: an ``unpack_exmy`` whose payload traces
+   (locally or through a returning callee) to a ``pack_exmy`` with a
+   DIFFERENT resolved ``(exp, man)`` — the decoded words are garbage,
+   bitwise-silently.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterator, Optional
+
+from ..core import Finding, Rule, register
+from ..project import ProjectGraph, ProjectRule, TOP
+
+_FMT_TOKEN = re.compile(r"^e(\d+)m(\d+)$")
+
+# slots (positional index, keyword) per API with (exp, man) semantics —
+# mirrors format_bounds._APIS positions for the swap check
+_SWAP_APIS = {
+    "cast_to_format": ((1, "exp_bits"), (2, "man_bits")),
+    "cast_to_format_sr": ((1, "exp_bits"), (2, "man_bits")),
+    "cast_to_format_sr_at": ((1, "exp_bits"), (2, "man_bits")),
+    "cast_body": ((1, "exp_bits"), (2, "man_bits")),
+    "cast_oracle": ((1, "exp_bits"), (2, "man_bits")),
+    "quantize_pallas": ((1, "exp_bits"), (2, "man_bits")),
+    "float_quantize": ((1, "exp"), (2, "man")),
+    "ordered_quantized_sum": ((1, "exp"), (2, "man")),
+    "kahan_quantized_sum": ((1, "exp"), (2, "man")),
+    "quantized_sum": ((1, "exp"), (2, "man")),
+    "ring_quantized_sum": ((2, "exp"), (3, "man")),
+    "pack_exmy": ((1, "exp_bits"), (2, "man_bits")),
+    "unpack_exmy": ((1, "exp_bits"), (2, "man_bits")),
+    # NOTE quant_gemm's real signature is (x, w, man, exp) — the swap
+    # check must use ITS order, not assume (exp, man)
+    "quant_gemm": ((3, "exp"), (2, "man")),
+}
+
+_EXP_NAMES = re.compile(r"(^|_)exp(_bits)?$")
+_MAN_NAMES = re.compile(r"(^|_)man(_bits)?$")
+
+
+def _looks_exp(name: str) -> bool:
+    return bool(_EXP_NAMES.search(name))
+
+
+def _looks_man(name: str) -> bool:
+    return bool(_MAN_NAMES.search(name))
+
+
+def parse_ladder_value(value) -> Optional[list]:
+    """Rungs [(exp, man), ...] from a concrete lattice value: an eXmY
+    spec string ("e5m2,e4m1") or a tuple of 2-int tuples; None when the
+    value is not ladder-shaped."""
+    if isinstance(value, str):
+        rungs = []
+        for part in value.split(","):
+            m = _FMT_TOKEN.match(part.strip().lower())
+            if not m:
+                return None
+            rungs.append((int(m.group(1)), int(m.group(2))))
+        return rungs if rungs else None
+    if isinstance(value, tuple) and value and all(
+            isinstance(r, tuple) and len(r) == 2
+            and all(isinstance(c, int) for c in r) for r in value):
+        return list(value)
+    return None
+
+
+def _aval_name(av: dict) -> str:
+    """Variable name behind a param/name aval ('' otherwise)."""
+    if av.get("k") in ("param", "name"):
+        return av["v"]
+    if av.get("k") == "attr" and av["v"]:
+        return av["v"][-1]
+    return ""
+
+
+@register
+class FormatFlow(ProjectRule):
+    id = "format-flow"
+    summary = ("whole-program eXmY flow: man<2 ladder rungs reaching the "
+               "ring wire, (exp, man) swaps across calls, pack/unpack "
+               "width drift")
+
+    def check(self, project: ProjectGraph) -> Iterator[Finding]:
+        yield from self._ladders(project)
+        yield from self._swaps(project)
+        yield from self._pack_drift(project)
+
+    # -- 1. ladder rungs reaching a ring sink -----------------------------
+
+    def _ladders(self, project: ProjectGraph) -> Iterator[Finding]:
+        for fkey, f, mod in project.iter_functions():
+            for call in f["calls"]:
+                if call["raises_ctx"]:
+                    continue   # asserting the rejection, not hitting it
+                ladder_av = call["kw"].get("ladder")
+                base = call["callee"].rsplit(".", 1)[-1]
+                if ladder_av is None and base in ("parse_ladder",
+                                                  "PrecisionSupervisor"):
+                    if call["args"]:
+                        ladder_av = call["args"][0]
+                if ladder_av is None:
+                    continue
+                values = project.eval_in(fkey, ladder_av)
+                if values is TOP:
+                    continue
+                target = project.resolve(fkey[0], call["callee"])
+                ring_line = None
+                # the ladder's consumer (or, for unresolvable callees,
+                # this function itself) must reach a ring sink; THIS
+                # call's own argument bindings override the consumer's
+                # joined parameter env (one level of context
+                # sensitivity — see ring_reaching)
+                if target is not None:
+                    bindings = {}
+                    tf = project.funcs[target]
+                    if not call["star"]:
+                        for pname, pav in zip(tf["params"], call["args"]):
+                            vs = project.eval_in(fkey, pav)
+                            if vs is not TOP:
+                                bindings[pname] = vs
+                        for kname, kav in call["kw"].items():
+                            if kname in tf["params"] \
+                                    or kname in tf["kwonly"]:
+                                vs = project.eval_in(fkey, kav)
+                                if vs is not TOP:
+                                    bindings[kname] = vs
+                    ring_line = project.ring_reaching(
+                        target, root_bindings=bindings or None)
+                else:
+                    # unresolvable consumer (e.g. PrecisionSupervisor
+                    # from outside the analyzed set): a ring-mode kwarg
+                    # on the SAME call, or a ring sink reachable from
+                    # the constructing function, condemns the ladder
+                    mode = call["kw"].get("mode")
+                    if mode is not None:
+                        mv = project.eval_in(fkey, mode)
+                        if mv is not TOP and "ring" in mv:
+                            ring_line = call["line"]
+                    if ring_line is None:
+                        ring_line = project.ring_reaching(fkey)
+                if ring_line is None:
+                    continue
+                for value in values:
+                    rungs = parse_ladder_value(value)
+                    if not rungs:
+                        continue
+                    bad = [r for r in rungs if r[1] < 2]
+                    for exp, man in bad:
+                        yield Finding(
+                            path=mod["path"], line=call["line"],
+                            col=call["col"], rule=self.id,
+                            message=(
+                                f"ladder rung e{exp}m{man} (man < 2) can "
+                                f"reach the ring transport through this "
+                                f"call — pack_exmy rejects man<2 formats, "
+                                f"so the first escalation onto that rung "
+                                f"dies mid-jit (ring sink reachable via "
+                                f"the call graph)"))
+
+    # -- 2. (exp, man) component swaps ------------------------------------
+
+    def _swaps(self, project: ProjectGraph) -> Iterator[Finding]:
+        for fkey, f, mod in project.iter_functions():
+            for call in f["calls"]:
+                base = call["callee"].rsplit(".", 1)[-1]
+                spec = _SWAP_APIS.get(base)
+                if spec is None or call["star"]:
+                    continue
+                (epos, ekw), (mpos, mkw) = spec
+
+                def slot(pos, kw):
+                    if kw in call["kw"]:
+                        return call["kw"][kw]
+                    if pos is not None and pos < len(call["args"]):
+                        return call["args"][pos]
+                    return None
+
+                e_name = _aval_name(slot(epos, ekw) or {})
+                m_name = _aval_name(slot(mpos, mkw) or {})
+                e_crossed = bool(e_name) and _looks_man(e_name) \
+                    and not _looks_exp(e_name)
+                m_crossed = bool(m_name) and _looks_exp(m_name) \
+                    and not _looks_man(m_name)
+                if e_crossed or m_crossed:
+                    got = []
+                    if e_crossed:
+                        got.append(f"exp slot receives {e_name!r}")
+                    if m_crossed:
+                        got.append(f"man slot receives {m_name!r}")
+                    yield Finding(
+                        path=mod["path"], line=call["line"],
+                        col=call["col"], rule=self.id,
+                        message=(
+                            f"{base}: (exp, man) components look swapped "
+                            f"across the call boundary — {'; '.join(got)} "
+                            f"(both values can be in-range, so "
+                            f"format-bounds cannot catch this; the cast "
+                            f"silently runs at the wrong format)"))
+
+    # -- 3. pack/unpack width drift ---------------------------------------
+
+    def _fmt_of_call(self, project, fkey, av) -> Optional[tuple]:
+        """(exp, man) of a pack/unpack-style call aval when concrete."""
+        if av.get("k") != "call" or len(av.get("args", [])) < 3:
+            return None
+        e = project.eval_in(fkey, av["args"][1])
+        m = project.eval_in(fkey, av["args"][2])
+        if e is TOP or m is TOP or len(e) != 1 or len(m) != 1:
+            return None
+        ev, mv = next(iter(e)), next(iter(m))
+        if isinstance(ev, int) and isinstance(mv, int):
+            return (ev, mv)
+        return None
+
+    def _pack_drift(self, project: ProjectGraph) -> Iterator[Finding]:
+        for fkey, f, mod in project.iter_functions():
+            for call in f["calls"]:
+                base = call["callee"].rsplit(".", 1)[-1]
+                if base != "unpack_exmy" or call["star"]:
+                    continue
+                fake = {"k": "call", "f": call["callee"],
+                        "args": call["args"], "kw": call["kw"]}
+                unpack_fmt = self._fmt_of_call(project, fkey, fake)
+                if unpack_fmt is None or not call["args"]:
+                    continue
+                payload = call["args"][0]
+                sources = project.eval_in(fkey, payload)
+                if sources is TOP:
+                    continue
+                for src in sources:
+                    if (isinstance(src, tuple) and len(src) == 2
+                            and src[0] == "packed"
+                            and src[1] != unpack_fmt):
+                        pe, pm = src[1]
+                        ue, um = unpack_fmt
+                        yield Finding(
+                            path=mod["path"], line=call["line"],
+                            col=call["col"], rule=self.id,
+                            message=(
+                                f"unpack_exmy declares e{ue}m{um} but the "
+                                f"payload was packed as e{pe}m{pm} — the "
+                                f"decoded values are silently garbage "
+                                f"(wire words re-sliced at the wrong "
+                                f"width)"))

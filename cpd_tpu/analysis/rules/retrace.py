@@ -1,0 +1,105 @@
+"""retrace: jitted-step caches keyed outside StepTable/ladder_step_key.
+
+The PR 5 review bug, now a lint.  The repo's contract for "many jitted
+variants of one step" is a ``StepTable`` (or ``utils.cache.LRUCache``)
+keyed through ``resilience.precision.ladder_step_key`` — the ONE key
+derivation covering every supervisor combination.  The pre-fix CLI code
+keyed its table with the bare ``supervisor.mode`` while a
+``PrecisionSupervisor`` was also escalating the format: the key missed
+the format coordinate, so the table happily served the step traced at
+the OLD format after an escalation — a silently-wrong-precision run,
+the exact bug class this whole analyzer exists for.
+
+Three shapes flagged:
+
+1. **jit-in-loop** — ``jax.jit(...)`` constructed inside a ``for``/
+   ``while`` body with no ``key not in cache`` memoization guard: a
+   fresh jit object per iteration re-traces every step (the memoized
+   ``if key not in cache: cache[key] = jax.jit(...)`` idiom of
+   train/lm.py stays silent).
+2. **half-keyed ladder table** — in a scope holding BOTH a
+   ``TransportSupervisor`` and a ``PrecisionSupervisor``, subscripting a
+   step table with only one supervisor's ``.mode``/``.fmt`` attribute
+   instead of ``ladder_step_key(transport, precision)``.
+3. **f-string step keys** — subscripting a dict that holds jitted
+   callables with an f-string: stringified keys conflate distinct
+   configs ("8" == "8") and churn the table under formatting drift;
+   route structured tuples through StepTable/LRUCache.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Finding, register
+from ..project import ProjectGraph, ProjectRule
+
+
+@register
+class Retrace(ProjectRule):
+    id = "retrace"
+    summary = ("jit built per-iteration, or step tables keyed outside "
+               "ladder_step_key/StepTable — the PR 5 stale-step bug "
+               "class")
+
+    def check(self, project: ProjectGraph) -> Iterator[Finding]:
+        for fkey, f, mod in project.iter_functions():
+            for site in f["jit_in_loop"]:
+                yield Finding(
+                    path=mod["path"], line=site["line"], col=site["col"],
+                    rule=self.id,
+                    message=(
+                        "jax.jit constructed inside a loop with no "
+                        "`key not in cache` memoization guard — every "
+                        "iteration builds a fresh jitted callable and "
+                        "re-traces; hoist it, or route variants through "
+                        "transport.StepTable / utils.cache.LRUCache"))
+            yield from self._half_keyed(f, mod)
+            yield from self._fstr_keys(f, mod)
+
+    def _half_keyed(self, f, mod) -> Iterator[Finding]:
+        sups = f["supervisor_objs"]
+        kinds = set(sups.values())
+        if not {"transport", "precision"} <= kinds:
+            return
+        for sub in f["table_subscripts"]:
+            if sub["key_kind"] != "attr":
+                continue
+            if sups.get(sub["key_obj"]) is None:
+                continue
+            if sub["key_attr"] not in ("mode", "fmt"):
+                continue
+            other = ("PrecisionSupervisor"
+                     if sups[sub["key_obj"]] == "transport"
+                     else "TransportSupervisor")
+            yield Finding(
+                path=mod["path"], line=sub["line"], col=sub["col"],
+                rule=self.id,
+                message=(
+                    f"step table keyed by bare "
+                    f"{sub['key_obj']}.{sub['key_attr']} while a "
+                    f"{other} is live in the same scope — the key "
+                    f"misses that supervisor's coordinate, so the table "
+                    f"serves a step traced for the WRONG "
+                    f"{'format' if other == 'PrecisionSupervisor' else 'transport'} "
+                    f"after a transition (the PR 5 ladder_step_key "
+                    f"bug); derive keys with "
+                    f"precision.ladder_step_key(transport, precision)"))
+
+    def _fstr_keys(self, f, mod) -> Iterator[Finding]:
+        jit_tables = {t["name"] for t in f["jit_tables"] if t["jit"]}
+        for sub in f["table_subscripts"]:
+            if sub["key_kind"] != "fstr":
+                continue
+            if sub["table"] not in jit_tables:
+                continue
+            yield Finding(
+                path=mod["path"], line=sub["line"], col=sub["col"],
+                rule=self.id,
+                message=(
+                    f"jitted-step table {sub['table']!r} keyed by an "
+                    f"f-string — stringified cache keys conflate "
+                    f"distinct configs and churn under formatting "
+                    f"drift; use structured tuple keys via "
+                    f"transport.StepTable (ladder_step_key) or "
+                    f"utils.cache.LRUCache"))

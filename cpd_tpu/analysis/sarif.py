@@ -1,0 +1,75 @@
+"""SARIF 2.1.0 rendering (``--format=sarif``) so CI can annotate PRs.
+
+One run, one tool (``cpd-lint``), one result per finding.  The shape is
+the minimal valid static-analysis SARIF — ``version``, ``$schema``,
+``runs[].tool.driver`` with the rule catalog, ``runs[].results[]`` with
+physical locations — pinned by tests/test_analysis.py so downstream
+uploaders (GitHub code-scanning, reviewdog) keep parsing it.  Paths are
+emitted repo-relative (forward slashes) when a base is given, because
+SARIF consumers resolve ``artifactLocation.uri`` against the checkout
+root, not the runner's CWD.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional
+
+from .core import Finding, all_rules
+
+__all__ = ["render_sarif"]
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _uri(path: str, base: Optional[str]) -> str:
+    if base:
+        try:
+            rel = os.path.relpath(os.path.abspath(path),
+                                  os.path.abspath(base))
+            if not rel.startswith(".."):
+                path = rel
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+def render_sarif(findings: Iterable[Finding],
+                 base_dir: Optional[str] = None) -> str:
+    rules_meta = [
+        {"id": rid,
+         "shortDescription": {"text": rule.summary},
+         "helpUri": "docs/ANALYSIS.md"}
+        for rid, rule in sorted(all_rules().items())
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(f.path, base_dir)},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                }
+            }],
+        }
+        for f in findings
+    ]
+    doc = {
+        "version": "2.1.0",
+        "$schema": _SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "cpd-lint",
+                "informationUri":
+                    "https://github.com/cpd-tpu/cpd-tpu",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -7,6 +7,21 @@ spelled ``check_rep=``.  Everything in cpd_tpu (and its tests/tools)
 imports ``shard_map`` from here so the whole tree tracks one shim instead
 of sprinkling try/except at every call site.
 
+This file is the ONE sanctioned home of ``jax.experimental`` imports:
+the ``compat-drift`` lint rule (docs/ANALYSIS.md) flags every use
+outside it, which is the machine-checked precondition for the jax
+un-pin (ROADMAP item 5) — when upstream renames or promotes an API,
+exactly one file changes.  Besides ``shard_map`` that covers:
+
+* ``pallas`` / ``pallas_tpu`` — still under jax.experimental on every
+  supported jax; re-exported so the Pallas kernels (ops/) survive the
+  eventual promotion to a stable namespace with a one-line edit here.
+* ``multihost_utils`` — host-coordination helpers (checkpoint.py's
+  preemption-flag agreement); experimental on 0.4.x.
+* ``flash_attention_import()`` — the stock Pallas TPU flash kernel,
+  imported LAZILY because the module pulls in TPU-kernel machinery that
+  CPU-only processes (and old jaxlibs) may not have.
+
 Stdlib-cheap rule: this module DOES import jax, so it must never be
 imported from ``cpd_tpu/__init__.py`` eagerly (see the lazy-export note
 there) — only from the L1/L2 modules that already depend on jax.
@@ -14,12 +29,63 @@ there) — only from the L1/L2 modules that already depend on jax.
 
 from __future__ import annotations
 
-__all__ = ["shard_map"]
+__all__ = ["shard_map", "pallas", "pallas_tpu", "multihost_utils",
+           "flash_attention_import"]
 
 try:  # jax >= 0.6: public
     from jax import shard_map as _shard_map
 except ImportError:  # jax 0.4.x/0.5.x: experimental
     from jax.experimental.shard_map import shard_map as _shard_map
+
+class _MissingModule:
+    """Placeholder for an optional surface the installed jax lacks.
+    Import-time soft (every compat importer — trainers, checkpointing,
+    shard_map users — must not hard-fail because Pallas moved), use-time
+    loud: touching any attribute raises with the real story."""
+
+    def __init__(self, name: str, err: Exception):
+        self._name = name
+        self._err = err
+
+    def __getattr__(self, attr):
+        raise ImportError(
+            f"{self._name} is unavailable in the installed jax "
+            f"({self._err}); cpd_tpu.compat could not locate it under "
+            f"jax.experimental or a promoted spelling") from self._err
+
+
+# Pallas: experimental namespace on every jax this tree currently
+# supports; try the promoted spelling first so the eventual move is
+# absorbed here, and degrade to a use-time error (never an import-time
+# one) when neither exists — compat is imported by far more modules
+# than the three Pallas kernels.
+try:
+    try:
+        from jax import pallas  # promoted (future jax)
+        from jax.pallas import tpu as pallas_tpu
+    except ImportError:
+        from jax.experimental import pallas
+        from jax.experimental.pallas import tpu as pallas_tpu
+except ImportError as _e:
+    pallas = _MissingModule("pallas", _e)
+    pallas_tpu = _MissingModule("pallas.tpu", _e)
+
+try:
+    from jax.experimental import multihost_utils
+except ImportError as _e:
+    multihost_utils = _MissingModule("multihost_utils", _e)
+
+
+def flash_attention_import():
+    """The stock Pallas TPU flash-attention kernel, resolved lazily.
+
+    Returns the ``flash_attention`` callable.  Lazy because importing
+    the kernel module is heavyweight and TPU-flavored; callers
+    (ops/attention.py's ``impl="flash"`` path) only reach it when the
+    user explicitly asks for the stock kernel."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention)
+    return flash_attention
 
 
 def _check_kw() -> str:

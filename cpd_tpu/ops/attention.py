@@ -120,8 +120,8 @@ def _flash_attention(q, k, v, causal, q_offset, k_offset):
     if q_offset != 0 or k_offset != 0:
         raise ValueError("impl='flash' does not support q/k offsets; "
                          "use the default impl inside ring steps")
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        flash_attention)
+    from ..compat import flash_attention_import
+    flash_attention = flash_attention_import()
 
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)

@@ -51,8 +51,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from ..compat import pallas as pl, pallas_tpu as pltpu
 
 from .attention import _NEG_INF, _gqa_rep  # attention imports us lazily
 

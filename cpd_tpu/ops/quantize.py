@@ -21,8 +21,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from ..compat import pallas as pl, pallas_tpu as pltpu
 
 from ..quant.numerics import _validate, cast_body, cast_body_sr
 

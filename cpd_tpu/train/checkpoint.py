@@ -167,7 +167,7 @@ class PreemptionGuard:
         the next boundary."""
         if jax.process_count() == 1:
             return self._triggered
-        from jax.experimental import multihost_utils
+        from ..compat import multihost_utils
 
         flags = multihost_utils.process_allgather(
             np.asarray(self._triggered, np.int32))

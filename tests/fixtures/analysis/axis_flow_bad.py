@@ -1,0 +1,21 @@
+"""Lint fixture: axis-flow true positives — a library module (no mesh
+declared anywhere in it) that HARDCODES collective axis names no mesh
+constructor can reach through the call graph."""
+
+from jax import lax
+
+
+def library_reduce(x):
+    # BAD: literal axis in library code with no mesh on any call path
+    return lax.psum(x, "dq")
+
+
+def library_gather(x):
+    # BAD: same hole via all_gather; "data" is nobody's axis here
+    return lax.all_gather(x, "data", axis=0, tiled=True)
+
+
+def caller(x):
+    # a caller exists, but it binds no mesh either — the literals still
+    # trace against nothing
+    return library_reduce(x) + library_gather(x).sum()

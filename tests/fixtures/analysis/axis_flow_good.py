@@ -1,0 +1,26 @@
+"""Lint fixture: clean twin of axis_flow_bad — library code that takes
+its axis as a parameter (the sanctioned idiom), and a literal axis whose
+function IS reached by a mesh constructor binding it (the whole-program
+check axis-name's module-local exemption cannot do)."""
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+
+def library_reduce(x, axis_name):
+    # parameter axes are the library idiom: unresolvable statically,
+    # bound by whoever calls us from under their mesh
+    return lax.psum(x, axis_name)
+
+
+def helper_on_dp(x):
+    # literal axis — but the driver below declares a mesh binding "dp"
+    # and reaches this function through the call graph
+    return lax.pmean(x, "dp")
+
+
+def driver(x):
+    mesh = Mesh(jax.devices(), ("dp",))
+    with mesh:
+        return helper_on_dp(library_reduce(x, "dp"))

@@ -1,0 +1,38 @@
+"""Lint fixture: collective-contract true positives — non-bijective
+ppermute permutations (literal and comprehension) and a Kahan partial
+shipped over the wire without its compensation term."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def repeated_dest(x):
+    # BAD: two senders target rank 1 — the received value is
+    # backend-order dependent
+    return lax.ppermute(x, "dp", [(0, 1), (1, 1)])
+
+
+def strided(x, w):
+    # BAD: stride 2 collides ranks whenever w is even
+    perm = [(i, (2 * i) % w) for i in range(w)]
+    return lax.ppermute(x, "dp", perm)
+
+
+def constant_dest(x, w):
+    # BAD: every rank sends to rank 0 — ppermute needs a bijection
+    return lax.ppermute(x, "dp", [(i, 0) for i in range(w)])
+
+
+def kahan_hop(res, comp, g):
+    y = g - comp
+    tmp = res + y
+    comp = (tmp - res) - y
+    return tmp, comp
+
+
+def ring_step(x, g, w):
+    perm = [(i, (i + 1) % w) for i in range(w)]
+    res, comp = kahan_hop(jnp.zeros_like(g), jnp.zeros_like(g), g)
+    # BAD: the compensation stays home — the next hop's casts lose the
+    # compensated bits and Kahan silently degrades to plain accumulation
+    return lax.ppermute(res, "dp", perm)

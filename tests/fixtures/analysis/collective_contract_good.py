@@ -1,0 +1,47 @@
+"""Lint fixture: clean twin of collective_contract_bad — cyclic and
+reversal bijections, a literal transposition, and Kahan state whose
+compensation rides the wire with the partial (ring.py's contract)."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+mesh = Mesh(jax.devices(), ("dp",))   # binds "dp" for the literals below
+
+
+def rotate(x, w):
+    perm = [(i, (i + 1) % w) for i in range(w)]
+    return lax.ppermute(x, "dp", perm)
+
+
+def rotate_back(x, w):
+    return lax.ppermute(x, "dp", [(i, (i - 1) % w) for i in range(w)])
+
+
+def reverse(x, w):
+    return lax.ppermute(x, "dp", [(i, w - 1 - i) for i in range(w)])
+
+
+def swap_pair(x):
+    return lax.ppermute(x, "dp", [(0, 1), (1, 0)])
+
+
+def kahan_hop(res, comp, g):
+    y = g - comp
+    tmp = res + y
+    comp = (tmp - res) - y
+    return tmp, comp
+
+
+def ring_step(x, g, w):
+    perm = [(i, (i + 1) % w) for i in range(w)]
+    res, comp = kahan_hop(jnp.zeros_like(g), jnp.zeros_like(g), g)
+    wire = jnp.stack([res, comp])      # compensation rides the wire
+    return lax.ppermute(wire, "dp", perm)
+
+
+def plain_step(x, g, w):
+    # a non-Kahan two-value unpack shipping only its first half is fine
+    res, aux = jnp.split(g, 2)
+    return lax.ppermute(res, "dp", [(i, (i + 1) % w) for i in range(w)])

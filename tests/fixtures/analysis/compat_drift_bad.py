@@ -1,0 +1,23 @@
+"""Lint fixture: compat-drift true positives — jax.experimental imports
+and attribute chains, plus a removed-API use, all outside compat.py."""
+
+import jax
+
+# BAD: experimental import (the 0.4.x shard_map spelling)
+from jax.experimental.shard_map import shard_map
+
+# BAD: experimental module import
+import jax.experimental.pallas as pl
+
+# BAD: the same surface through the side door
+from jax import experimental
+
+
+def gather_hosts(x):
+    # BAD: experimental attribute chain in expression position
+    return jax.experimental.multihost_utils.process_allgather(x)
+
+
+def tree_add(a, b):
+    # BAD: removed API (jax.tree_multimap died in jax 0.4)
+    return jax.tree_multimap(lambda x, y: x + y, a, b)

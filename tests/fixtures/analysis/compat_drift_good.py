@@ -1,0 +1,21 @@
+"""Lint fixture: clean twin of compat_drift_bad — every version-gated
+surface arrives through cpd_tpu.compat (the one sanctioned shim site),
+and modern stable spellings replace the removed APIs."""
+
+import jax
+
+from cpd_tpu.compat import multihost_utils, pallas as pl, shard_map
+
+
+def gather_hosts(x):
+    return multihost_utils.process_allgather(x)
+
+
+def tree_add(a, b):
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+def kernel_spec():
+    # pallas reached through the shim: one edit site when it promotes
+    from cpd_tpu.compat import pallas_tpu as pltpu
+    return pl.BlockSpec(memory_space=pltpu.ANY)

@@ -1,0 +1,43 @@
+"""Lint fixture: format-flow true positives — a man<2 ladder rung that
+reaches the ring wire through a call, (exp, man) swapped across a call
+boundary, and pack/unpack width drift (local + through a callee)."""
+
+from cpd_tpu.parallel.dist import sum_gradients
+from cpd_tpu.quant.numerics import cast_to_format, pack_exmy, unpack_exmy
+
+
+def run_reduce(grads, ladder, mode):
+    # the ladder's consumer sits on the ring path
+    return sum_gradients(grads, "dp", mode=mode)
+
+
+def launch(grads, ladder):
+    return run_reduce(grads, ladder, mode="ring")
+
+
+def go(grads):
+    # BAD: e4m1 (man < 2) escalation rung, ring transport reachable —
+    # pack_exmy rejects man<2, so the first escalation dies mid-jit
+    return launch(grads, ladder="e5m2,e4m1")
+
+
+def helper(x, exp, man):
+    # BAD: components crossed across the call boundary — both in range,
+    # so format-bounds can never see it
+    return cast_to_format(x, man, exp)
+
+
+def local_drift(x):
+    wire = pack_exmy(x, 5, 2)
+    # BAD: unpacked at a different declared width than it was packed
+    return unpack_exmy(wire, 4, 3)
+
+
+def make_wire(x):
+    return pack_exmy(x, 5, 7)
+
+
+def cross_function_drift(x):
+    payload = make_wire(x)
+    # BAD: packer (through the callee) says e5m7, unpacker says e5m2
+    return unpack_exmy(payload, 5, 2)

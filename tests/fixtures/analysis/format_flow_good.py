@@ -1,0 +1,52 @@
+"""Lint fixture: clean twin of format_flow_bad — wide-enough rungs on
+the ring path, a man<2 ladder that only ever reaches the faithful
+gather, straight component order, matching pack/unpack widths, and a
+pytest.raises block asserting the rejection (not hitting it)."""
+
+import pytest
+
+from cpd_tpu.parallel.dist import sum_gradients
+from cpd_tpu.quant.numerics import cast_to_format, pack_exmy, unpack_exmy
+
+
+def run_reduce(grads, ladder, mode):
+    return sum_gradients(grads, "dp", mode=mode)
+
+
+def launch(grads, ladder):
+    return run_reduce(grads, ladder, mode="ring")
+
+
+def go(grads):
+    # every rung man >= 2: packable all the way up the ladder
+    return launch(grads, ladder="e5m2,e5m7,e8m23")
+
+
+def go_faithful(grads):
+    # man<2 rung is fine where no ring sink is reachable: the faithful
+    # gather never packs the wire
+    return run_reduce(grads, ladder="e5m2,e8m1", mode="faithful")
+
+
+def test_ring_rejects_narrow_rungs(grads):
+    with pytest.raises(ValueError):
+        # asserting the argument-time rejection IS the test's point
+        launch(grads, ladder="e5m2,e4m1")
+
+
+def helper(x, exp, man):
+    return cast_to_format(x, exp, man)
+
+
+def round_trip(x):
+    wire = pack_exmy(x, 5, 2)
+    return unpack_exmy(wire, 5, 2)
+
+
+def make_wire(x):
+    return pack_exmy(x, 5, 7)
+
+
+def cross_function_round_trip(x):
+    payload = make_wire(x)
+    return unpack_exmy(payload, 5, 7)

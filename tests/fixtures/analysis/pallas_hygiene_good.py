@@ -2,8 +2,10 @@
 multiples (including via module constants), explicit memory spaces."""
 
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+# the raw spellings keep this fixture self-contained (it never runs);
+# live code routes these through cpd_tpu.compat — see compat_drift_good
+from jax.experimental import pallas as pl      # cpd: disable=compat-drift — fixture, not live code
+from jax.experimental.pallas import tpu as pltpu  # cpd: disable=compat-drift — fixture, not live code
 
 _LANES = 128
 _ROWS = 512

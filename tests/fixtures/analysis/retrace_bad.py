@@ -1,0 +1,45 @@
+"""Lint fixture: retrace true positives — jit built per-iteration, the
+PR 5 half-keyed ladder table (distilled from the pre-fix CLI code), and
+an f-string-keyed jitted-step cache."""
+
+import jax
+
+from cpd_tpu.resilience import (PrecisionSupervisor, StepTable,
+                                TransportSupervisor)
+
+
+def train_forever(step_fn, state, batches):
+    step = 0
+    while step < 1000:
+        # BAD: a fresh jit object every iteration — re-traces each step
+        fn = jax.jit(step_fn)
+        state = fn(state, batches[step])
+        step += 1
+    return state
+
+
+def sweep(step_fn, state, batches):
+    for i in range(100):
+        # BAD: jit-and-call in an unbounded loop, same hazard
+        state = jax.jit(step_fn)(state, batches[i])
+    return state
+
+
+def guarded_loop(build_step, state, batch, grad_exp, grad_man):
+    # distilled from the PRE-FIX trainer CLI: both ladders live, but the
+    # step table is keyed by the transport coordinate alone
+    supervisor = TransportSupervisor(start="ring")
+    psup = PrecisionSupervisor("e5m2,e5m7")
+    steps = StepTable(build_step)
+    # BAD: after a precision escalation this serves the step traced at
+    # the OLD format — key through ladder_step_key(supervisor, psup)
+    step = steps[supervisor.mode]
+    return step(state, batch)
+
+
+def string_keys(make_step, state, batch, exp, man):
+    cache = {}
+    key = f"e{exp}m{man}"
+    cache[key] = jax.jit(make_step(exp, man))
+    # BAD: stringified cache key on a jitted-step table
+    return cache[f"e{exp}m{man}"](state, batch)

@@ -1,0 +1,44 @@
+"""Lint fixture: clean twin of retrace_bad — hoisted jit, the memoized
+dict idiom, a bounded literal config sweep, and the StepTable keyed
+through ladder_step_key (the PR 5 fix)."""
+
+import jax
+
+from cpd_tpu.resilience import (PrecisionSupervisor, StepTable,
+                                TransportSupervisor, ladder_step_key)
+
+
+def train(step_fn, state, batches):
+    fn = jax.jit(step_fn)              # hoisted: one trace
+    for batch in batches:
+        state = fn(state, batch)
+    return state
+
+
+def memoized(step_fn, state, batches):
+    cache = {}
+    for batch in batches:
+        key = jax.tree.structure(state)
+        if key not in cache:           # the train/lm.py idiom
+            cache[key] = jax.jit(step_fn)
+        state = cache[key](state, batch)
+    return state
+
+
+def config_sweep(step_fn, state, batch):
+    out = {}
+    for donate in (False, True):
+        # a bounded literal sweep: each iteration IS a distinct
+        # once-traced config, not a retrace hazard
+        fn = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+        out[donate] = fn(state, batch)
+    return out
+
+
+def guarded_loop(build_step, state, batch, grad_exp, grad_man):
+    supervisor = TransportSupervisor(start="ring")
+    psup = PrecisionSupervisor("e5m2,e5m7")
+    steps = StepTable(build_step)
+    # the PR 5 fix: both supervisors' coordinates in the key
+    step = steps[ladder_step_key(supervisor, psup)]
+    return step(state, batch)
