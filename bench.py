@@ -614,6 +614,66 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
             partial["precision_note"] = (f"precision extra skipped: "
                                          f"{type(e).__name__}: {e}")
 
+    # Budget-gated EXTRA (platform-agnostic): the serving drill (ISSUE 7)
+    # — a tiny mixed-arrival trace through the continuous-batching
+    # ServeEngine with the packed eXmY KV cache, so every BENCH_* capture
+    # tracks the serving metric set (tok/s, p50/p99 TTFT + per-token
+    # latency, goodput under the SLA) AND the two serving gates: the
+    # batch must beat serial generate() on the same trace, and an
+    # injected KV page flip must be detected + repaired with the request
+    # completing.  Sizes mirror tools/bench_serve.py --smoke.
+    if time.monotonic() < budget_end - 60:
+        try:
+            from cpd_tpu.models import transformer_lm
+            from cpd_tpu.resilience import FaultPlan
+            from cpd_tpu.serve import (ServeEngine, mixed_trace,
+                                       run_trace, serial_baseline)
+
+            sv_model = transformer_lm(vocab_size=512, d_model=256,
+                                      n_layers=3, n_heads=8,
+                                      n_kv_heads=2, d_ff=512)
+            sv_params = sv_model.init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8),
+                                                jnp.int32))["params"]
+            sv_kw = dict(n_slots=8, max_seq=48, page_size=8,
+                         prefill_chunk=8, kv_format=(5, 2))
+            trace = mixed_trace(16, 512, max_new=(16,), seed=0)
+            run_trace(ServeEngine(sv_model, sv_params, **sv_kw),
+                      list(trace))                     # warm compile
+            sv = run_trace(ServeEngine(sv_model, sv_params, **sv_kw),
+                           list(trace))
+            base = serial_baseline(sv_model, sv_params, trace)
+            drill = ServeEngine(sv_model, sv_params, **sv_kw,
+                                scrub_every=2,
+                                fault_plan=FaultPlan.parse("kv_flip@6:0"))
+            dr = run_trace(drill, list(trace))
+            partial["serving"] = {
+                "kv_format": [5, 2],
+                "requests": sv["requests"],
+                "dropped": sv["dropped"],
+                "tok_per_s": sv["tok_per_s"],
+                "ttft_ms_p50": sv["ttft_ms_p50"],
+                "ttft_ms_p99": sv["ttft_ms_p99"],
+                "tpot_ms_p50": sv["tpot_ms_p50"],
+                "tpot_ms_p99": sv["tpot_ms_p99"],
+                "goodput_tok_per_s": sv["goodput_tok_per_s"],
+                "serial_tok_per_s": base["tok_per_s"],
+                "speedup_vs_serial": (
+                    round(sv["tok_per_s"] / base["tok_per_s"], 2)
+                    if base["tok_per_s"] else None),
+                "kv_repair_drill": {
+                    "flips_injected":
+                        dr["counters"]["kv_flips_injected"],
+                    "pages_corrupt": dr["counters"]["kv_pages_corrupt"],
+                    "repairs": dr["counters"]["kv_repairs"],
+                    "completed": dr["completed"],
+                    "dropped": dr["dropped"],
+                },
+            }
+        except Exception as e:  # noqa: BLE001 — extras must not kill the run
+            partial["serving_note"] = (f"serving extra skipped: "
+                                       f"{type(e).__name__}: {e}")
+
     if profile_dir and time.monotonic() < budget_end - 30:
         state = create_train_state(model, tx, x[0, :2],
                                    jax.random.PRNGKey(0))

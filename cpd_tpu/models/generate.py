@@ -28,12 +28,13 @@ plain unsharded module).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..utils.cache import LRUCache
 
 __all__ = ["generate", "filter_logits"]
 
@@ -77,79 +78,107 @@ def filter_logits(logits: jnp.ndarray, top_k: Optional[int] = None,
     return logits
 
 
-@functools.lru_cache(maxsize=32)
+# Bounded host-side caches (utils/cache.LRUCache, the
+# make_sum_gradients_fn precedent): the old functools.lru_cache pair
+# held strong references to decoder modules AND their jitted closures
+# forever — a serving process cycling through model/sampling configs
+# leaked every one of them.  Eviction just drops a compiled program;
+# the next call with that config re-traces.
+_SHAPE_CACHE = LRUCache(maxsize=32)
+_RUN_CACHE = LRUCache(maxsize=32)
+
+
 def _cache_shapes(decoder, b: int, t_max: int):
     """Shapes/dtypes of the decoder's cache collection, via eval_shape —
     memoized so repeat generate() calls skip the host-side init retrace
     (the arrays themselves are rebuilt per call; their contents are the
     defined zero state)."""
-    return jax.eval_shape(
-        lambda t: decoder.init(jax.random.PRNGKey(0), t, train=False),
-        jax.ShapeDtypeStruct((b, t_max), jnp.int32))["cache"]
+    return _SHAPE_CACHE.get_or_create(
+        (decoder, b, t_max),
+        lambda: jax.eval_shape(
+            lambda t: decoder.init(jax.random.PRNGKey(0), t, train=False),
+            jax.ShapeDtypeStruct((b, t_max), jnp.int32))["cache"])
 
 
-@functools.lru_cache(maxsize=32)
 def _make_run(decoder, max_new_tokens: int, temperature: float,
               top_k: Optional[int], top_p: Optional[float],
               eos_id: Optional[int]):
     """Build the jitted prefill+scan program once per (module, length,
     sampling config) — flax modules hash by their field values, so repeat
-    generate() calls hit jit's trace cache instead of recompiling."""
+    generate() calls hit the bounded run cache instead of recompiling."""
 
-    def sample(logits_last, key):
-        if temperature == 0:
-            if top_k is not None or top_p is not None:
-                raise ValueError(
-                    "top_k/top_p require temperature > 0 (greedy argmax "
-                    "is unaffected by the filtered tail)")
-            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-        logits = filter_logits(logits_last / jnp.float32(temperature),
-                               top_k, top_p)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    def build():
+        def sample(logits_last, key):
+            if temperature == 0:
+                if top_k is not None or top_p is not None:
+                    raise ValueError(
+                        "top_k/top_p require temperature > 0 (greedy "
+                        "argmax is unaffected by the filtered tail)")
+                return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+            logits = filter_logits(logits_last / jnp.float32(temperature),
+                                   top_k, top_p)
+            return jax.random.categorical(key, logits,
+                                          axis=-1).astype(jnp.int32)
 
-    def freeze(tok, done):
-        """Once a sequence emitted eos, it keeps emitting eos."""
-        if eos_id is None:
-            return tok, jnp.zeros(tok.shape, bool) if done is None else done
-        done = (tok == eos_id) if done is None else done | (tok == eos_id)
-        return jnp.where(done, jnp.int32(eos_id), tok), done
+        def freeze(tok, done):
+            """Once a sequence emitted eos, it keeps emitting eos."""
+            if eos_id is None:
+                return tok, (jnp.zeros(tok.shape, bool)
+                             if done is None else done)
+            done = ((tok == eos_id) if done is None
+                    else done | (tok == eos_id))
+            return jnp.where(done, jnp.int32(eos_id), tok), done
 
-    @jax.jit
-    def run(params, cache, prompt, rng):
-        # one-pass prefill over the whole prompt
-        logits, mut = decoder.apply({"params": params, "cache": cache},
-                                    prompt, train=False, mutable=["cache"])
-        key0, rng = jax.random.split(rng)
-        first, done = freeze(sample(logits[:, -1], key0), None)
+        @jax.jit
+        def run(params, cache, prompt, rng):
+            # one-pass prefill over the whole prompt
+            logits, mut = decoder.apply({"params": params, "cache": cache},
+                                        prompt, train=False,
+                                        mutable=["cache"])
+            key0, rng = jax.random.split(rng)
+            first, done = freeze(sample(logits[:, -1], key0), None)
 
-        def step(carry, _):
-            cache, tok, done, rng = carry
-            key, rng = jax.random.split(rng)
-            logits, mut = decoder.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                train=False, mutable=["cache"])
-            nxt, done = freeze(sample(logits[:, -1], key), done)
-            return (mut["cache"], nxt, done, rng), tok
+            def step(carry, _):
+                cache, tok, done, rng = carry
+                key, rng = jax.random.split(rng)
+                logits, mut = decoder.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    train=False, mutable=["cache"])
+                nxt, done = freeze(sample(logits[:, -1], key), done)
+                return (mut["cache"], nxt, done, rng), tok
 
-        # each step emits its input token and computes the next; the final
-        # carry token is the max_new-th generated token
-        (_, last, _, _), toks = lax.scan(
-            step, (mut["cache"], first, done, rng), None,
-            length=max_new_tokens - 1)
-        new = jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
-        return jnp.concatenate([prompt, new], axis=1)
+            # each step emits its input token and computes the next; the
+            # final carry token is the max_new-th generated token
+            (_, last, _, _), toks = lax.scan(
+                step, (mut["cache"], first, done, rng), None,
+                length=max_new_tokens - 1)
+            new = jnp.concatenate([toks.transpose(1, 0), last[:, None]],
+                                  axis=1)
+            return jnp.concatenate([prompt, new], axis=1)
 
-    return run
+        return run
+
+    return _RUN_CACHE.get_or_create(
+        (decoder, max_new_tokens, temperature, top_k, top_p, eos_id),
+        build)
 
 
 def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None, eos_id: Optional[int] = None,
-             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+             rng: Optional[jax.Array] = None,
+             t_max: Optional[int] = None) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, T_p).
 
     Returns (B, T_p + max_new_tokens) int32 — prompt included.  With
     ``eos_id``, positions after a sequence's first eos all hold eos_id.
+
+    ``t_max`` is an optional deployment capacity (the longest sequence
+    the caller's model/memory budget allows): when given, a request
+    whose ``prompt + max_new_tokens`` exceeds it raises ValueError HERE
+    — fail-fast at the API boundary, not a silent mid-scan
+    clip/NaN-poison from the cache layer (the serving engine applies
+    the same rule at `submit`, scheduler.validate).
     """
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
@@ -166,7 +195,11 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
         raise ValueError("top_k/top_p require temperature > 0")
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t_p = prompt.shape
-    t_max = t_p + max_new_tokens
+    t_total = t_p + max_new_tokens
+    if t_max is not None and t_total > t_max:
+        raise ValueError(
+            f"prompt length ({t_p}) + max_new_tokens ({max_new_tokens}) "
+            f"= {t_total} exceeds t_max ({t_max})")
 
     decoder = model.clone(decode=True, sp_axis=None, tp_axis=None,
                           tp_size=1)
@@ -174,7 +207,7 @@ def generate(model, params, prompt: jnp.ndarray, max_new_tokens: int,
     # cache shape from the init call) WITHOUT running the forward:
     # eval_shape (memoized) gives the cache pytree's shapes/dtypes for
     # free, and the initial cache contents are defined zeros
-    shapes = _cache_shapes(decoder, b, t_max)
+    shapes = _cache_shapes(decoder, b, t_total)
     cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
     # carry needs an array either way; greedy sampling ignores it
     rng = jax.random.PRNGKey(0) if rng is None else rng

@@ -52,7 +52,7 @@ import numpy as np
 __all__ = ["cast_to_format", "cast_body", "cast_oracle", "max_finite",
            "cast_body_sr", "cast_to_format_sr", "cast_oracle_sr",
            "sr_bits_at", "cast_to_format_sr_at",
-           "pack_exmy", "unpack_exmy", "wire_bytes",
+           "pack_exmy", "unpack_exmy", "wire_bytes", "kv_page_bytes",
            "quant_health", "cast_to_format_stats", "HEALTH_FIELDS",
            "FP32_EXP_BITS", "FP32_MAN_BITS"]
 
@@ -389,6 +389,29 @@ def wire_bytes(exp_bits: int, man_bits: int) -> int:
     """Bytes per element of the packed eXmY wire format."""
     _validate(exp_bits, man_bits)
     return (1 + exp_bits + man_bits + 7) // 8
+
+
+def kv_page_bytes(exp_bits: int, man_bits: int, page_size: int,
+                  n_kv_heads: int, head_dim: int) -> int:
+    """Bytes of ONE layer's K+V KV-cache page in the packed eXmY codec.
+
+    The analytic sibling of `wire_bytes` for the serving stack's paged
+    KV cache (cpd_tpu/serve/kvcache.py): a page holds `page_size` token
+    positions × `n_kv_heads` × `head_dim` elements for BOTH the K and V
+    planes, each element one `wire_bytes(exp_bits, man_bits)` code word.
+    Multiply by the layer count for a request's whole-model page cost.
+    This is the one source of truth bench/docs quote for KV memory per
+    format; tests pin it against the actual packed page-pool slice.
+    Applies the full packed-wire validation (`_validate_wire`, incl.
+    the man >= 2 special-code rule): a page count for a format the
+    packed cache cannot store would be a lie."""
+    if page_size < 1 or n_kv_heads < 1 or head_dim < 1:
+        raise ValueError(
+            f"page_size/n_kv_heads/head_dim must be >= 1, got "
+            f"({page_size}, {n_kv_heads}, {head_dim})")
+    _validate_wire(exp_bits, man_bits)
+    return 2 * page_size * n_kv_heads * head_dim * wire_bytes(exp_bits,
+                                                              man_bits)
 
 
 def _validate_wire(exp_bits: int, man_bits: int) -> None:

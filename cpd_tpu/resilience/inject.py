@@ -51,12 +51,20 @@ Schedule ``patience`` consecutive specs to force an escalation; the
 same plan without the ladder is the degradation baseline (the grad
 guard skips the saturated steps, or the loss blows up).
 
+A fifth executor consumes ``kv_flip@s:k`` (the serving-side corruption
+attack): the serving engine (cpd_tpu/serve/engine.py) flips one byte in
+request slot ``k``'s first KV-cache page at ENGINE step ``s`` (held
+until the slot holds cached K/V) — detected by the per-page digests and
+repaired by recomputation without dropping the request
+(docs/SERVING.md).  The engine does its own unfired accounting.
+
 ``step`` convention: the 0-based optimizer-UPDATE index — one clock for
 both executors, so ``grad_nan@3`` and ``stall@3`` hit the same physical
 step in every entry point (run_guarded and both trainer CLIs).  The
 ``ckpt_*`` kinds are the exception: their step is the saved
 checkpoint's own step number (what ``restore_latest_valid`` sees),
-because that is the name the corruption must land on.
+because that is the name the corruption must land on; ``kv_flip``'s
+step is the serving engine's step clock.
 """
 
 from __future__ import annotations
@@ -72,7 +80,7 @@ import numpy as np
 
 __all__ = ["FaultSpec", "FaultPlan", "Injector", "InjectedPreemption",
            "with_fault_injection", "report_unfired", "GRAD_KINDS",
-           "HOST_KINDS", "WIRE_KINDS", "SAT_KINDS",
+           "HOST_KINDS", "WIRE_KINDS", "SAT_KINDS", "KV_KINDS",
            "SAT_PRESSURE_DEFAULT_EXP"]
 
 # jit-level kinds -> corruption opcode in the compiled fault table
@@ -85,6 +93,13 @@ WIRE_KINDS = {"wire_flip": 1, "wire_stale": 2, "wire_drop": 3}
 # the attack the precision ladder is exercised against
 SAT_KINDS = frozenset({"sat_pressure"})
 SAT_PRESSURE_DEFAULT_EXP = 24          # arg -1 -> scale by 2^24
+# KV-cache corruption kind, executed by the serving engine
+# (serve/engine.py): ``kv_flip@s:k`` flips one byte in request slot
+# ``k``'s first KV page at engine step ``s`` (held until that slot holds
+# cached K/V) — the corruption class the per-page digests detect and the
+# repair-by-recompute ladder absorbs without dropping the request.
+# ``step`` here is the ENGINE-step clock, not the optimizer-update clock.
+KV_KINDS = frozenset({"kv_flip"})
 # host-level kinds, executed by the Injector around the step call
 HOST_KINDS = frozenset({
     "batch_nan",       # poison one element of the first float batch leaf
@@ -98,7 +113,7 @@ HOST_KINDS = frozenset({
     "loss_spike",      # multiply the observed loss metric by `arg`
 })
 _ALL_KINDS = (frozenset(GRAD_KINDS) | HOST_KINDS | frozenset(WIRE_KINDS)
-              | SAT_KINDS)
+              | SAT_KINDS | KV_KINDS)
 
 
 class InjectedPreemption(BaseException):
@@ -215,6 +230,11 @@ class FaultPlan:
 
     def sat_faults(self) -> tuple:
         return tuple(f for f in self.faults if f.kind in SAT_KINDS)
+
+    def kv_faults(self) -> tuple:
+        """The serving engine's KV-page corruption specs (``arg`` is the
+        target slot index, -1 -> slot 0)."""
+        return tuple(f for f in self.faults if f.kind in KV_KINDS)
 
     def host_faults(self) -> dict:
         """step -> [FaultSpec] for the host-level kinds."""
@@ -498,7 +518,8 @@ class Injector:
 def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
                    = None, meter=None, rank: int = 0,
                    wire_armed: bool = True,
-                   sat_armed: bool = True) -> list:
+                   sat_armed: bool = True,
+                   kv_armed: bool = False) -> list:
     """The ONE end-of-run check every loop calls: which planned faults
     never fired?  A chaos run that silently skipped a fault proves
     nothing — the usual causes are a plan step beyond the run's
@@ -514,6 +535,10 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     (e.g. ``wire_flip`` planned for a faithful-mode run, or
     ``sat_pressure`` planned for a pp/moe run whose stepper takes no
     ``sat_fault_plan``; the trainers compute both from their config).
+    ``kv_armed`` defaults False: the ``kv_flip`` kind only exists on the
+    serving engine's clock (which does its OWN unfired accounting,
+    `ServeEngine.report_unfired`), so a kv spec in a TRAINING plan is
+    always a never-fires user error and is surfaced here.
     Bumps the meter's ``faults_unfired`` counter and warns on rank 0;
     returns the sorted leftover list (empty = every planned fault
     fired)."""
@@ -521,10 +546,11 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
         return []
     leftover = list(injector.unfired())
     for f in (injector.plan.grad_faults() + injector.plan.wire_faults()
-              + injector.plan.sat_faults()):
+              + injector.plan.sat_faults() + injector.plan.kv_faults()):
         past = n_steps is not None and f.step >= n_steps
         unwired = ((not wire_armed and f.kind in WIRE_KINDS)
-                   or (not sat_armed and f.kind in SAT_KINDS))
+                   or (not sat_armed and f.kind in SAT_KINDS)
+                   or (not kv_armed and f.kind in KV_KINDS))
         if past or unwired:
             leftover.append(f)
     leftover = sorted(set(leftover))

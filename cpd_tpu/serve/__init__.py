@@ -1,0 +1,22 @@
+"""cpd_tpu.serve — continuous-batching serving on the quantized substrate.
+
+The serving layer (L5) over the whole stack (ROADMAP item 1): a request
+scheduler with continuous batching and chunked prefill
+(`scheduler.Scheduler`, `engine.ServeEngine`), a paged KV cache whose
+pages are bit-packed eXmY code words via the PR 3 wire codec
+(`kvcache`), per-page Fletcher digests with repair-by-recomputation
+(`engine.ServeEngine.scrub`), and the load-generator harness
+(`loadgen`, `tools/bench_serve.py`).  See docs/SERVING.md.
+"""
+
+from .engine import ServeEngine
+from .kvcache import KVCacheConfig
+from .loadgen import (bursty_trace, mixed_trace, poisson_trace,
+                      run_trace, serial_baseline)
+from .model import ModelSpec, spec_from_model
+from .scheduler import Request, Scheduler
+
+__all__ = ["ServeEngine", "KVCacheConfig", "Request", "Scheduler",
+           "ModelSpec", "spec_from_model", "poisson_trace",
+           "bursty_trace", "mixed_trace", "run_trace",
+           "serial_baseline"]

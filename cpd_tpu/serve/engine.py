@@ -1,0 +1,346 @@
+"""ServeEngine — continuous batching over the paged eXmY KV cache.
+
+One engine step is at most three device dispatches, each jit-stable:
+
+1. (every ``scrub_every`` steps) the **scrub** — recompute every page
+   digest and compare to the maintained array; mismatches are corruption
+   (docs/SERVING.md repair ladder): a page owned by a live request
+   triggers **repair by recomputation** — the slot's cached K/V is
+   rebuilt from its token history (prompt + generated so far, which the
+   host always holds) through the same prefill program, synchronously,
+   without dropping the request; a free page's corruption is absorbed
+   (nothing will ever read it before it is rewritten).
+2. one **prefill chunk** for one PREFILL slot (round-robin), so long
+   prompts trickle in without ever stalling the decode batch.
+3. one **decode step** for the whole fixed-shape batch — every DECODE
+   slot feeds its pending token and samples the next; FREE/PREFILL
+   slots ride along masked to the trash page.
+
+Detection is **two-tier** because an append re-digests its page from
+the post-write bytes (which would re-bless pre-existing corruption):
+every jitted dispatch verifies the pages it is about to append to
+BEFORE writing (`kvcache.check_digests`, the ``bad`` verdict riding out
+of the step), and the periodic scrub covers pages no append touches.
+A nonzero verdict discards that dispatch's results (`_checked`), runs
+the scrub+repair on the intact pre-dispatch state, and re-dispatches —
+so corruption can never be served OR blessed, whatever its timing
+relative to the scrub period.
+
+Fault injection rides the existing `resilience.FaultPlan` grammar: the
+``kv_flip@s:k`` kind flips one byte in slot ``k``'s first page at step
+``s`` (held until that slot actually has cached K/V), exactly the
+corruption class the digests exist to catch.  Injection, detection,
+repair and completion are all deterministic: two runs of the same
+(model, trace, plan) produce identical counters — the serve-smoke gate.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from . import kvcache
+from .kvcache import KVCacheConfig, TRASH_PAGE
+from .model import make_decode_step, make_prefill_step, spec_from_model
+from .scheduler import DECODE, FREE, PREFILL, Request, Scheduler
+
+__all__ = ["ServeEngine"]
+
+_COUNTERS = ("admitted", "completed", "prompt_tokens", "tokens_generated",
+             "decode_steps", "prefill_chunks", "repair_chunks", "scrubs",
+             "kv_flips_injected", "kv_inline_detects", "kv_pages_corrupt",
+             "kv_corrupt_free_pages", "kv_repairs", "pages_reserved",
+             "pages_freed", "kv_faults_unfired")
+
+
+class ServeEngine:
+    """Continuous-batching serving loop for one `TransformerLM`.
+
+    Parameters
+    ----------
+    model, params : the trained module (single-device config) + pytree.
+    n_slots : fixed decode-batch width.
+    max_seq : per-request capacity (prompt + max_new); rounded up to
+        whole pages.  Requests exceeding it are rejected at `submit` —
+        fail-fast, the serving twin of `generate(t_max=...)`.
+    page_size : token positions per KV page.
+    n_pages : total pool pages (default: full capacity for every slot
+        plus the trash page — allocation can then never starve).
+    kv_format : (exp_bits, man_bits) eXmY cache codec; (8, 23) is the
+        lossless byte split, e5m2/e4m3 the 4x-compressed formats.
+    raw_cache : fp32 pool, no codec — the bitwise oracle for (8, 23).
+    prefill_chunk : prompt tokens per prefill dispatch.
+    scrub_every : digest-scrub period in engine steps (0 = only explicit
+        `scrub()` calls).
+    fault_plan : `resilience.FaultPlan`; only its ``kv_flip`` specs are
+        consumed here.
+    temperature / seed : 0 = greedy argmax; > 0 samples from
+        softmax(logits / T) with a deterministic host RNG.
+    """
+
+    def __init__(self, model, params, *, n_slots: int = 4,
+                 max_seq: int = 128, page_size: int = 16,
+                 n_pages: Optional[int] = None,
+                 kv_format: tuple = (8, 23), raw_cache: bool = False,
+                 prefill_chunk: int = 16, scrub_every: int = 0,
+                 fault_plan=None, temperature: float = 0.0,
+                 seed: int = 0, record_logits: bool = False):
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        spec = spec_from_model(model)
+        max_pages = -(-max_seq // page_size)
+        if n_pages is None:
+            n_pages = 1 + n_slots * max_pages
+        exp_bits, man_bits = kv_format
+        self.cfg = KVCacheConfig(
+            n_layers=spec.n_layers, n_kv_heads=spec.kv_heads,
+            head_dim=spec.head_dim, page_size=page_size, n_pages=n_pages,
+            exp_bits=exp_bits, man_bits=man_bits, raw=raw_cache)
+        self.spec = spec
+        self.params = params
+        self.sched = Scheduler(n_slots, n_pages, page_size, max_pages)
+        self._prefill_chunk = prefill_chunk
+        self._scrub_every = scrub_every
+        self._temperature = float(temperature)
+        self._rng = np.random.default_rng(seed)
+
+        self._decode_fn = make_decode_step(spec, self.cfg)
+        self._prefill_fn = make_prefill_step(spec, self.cfg, prefill_chunk)
+        self._scrub_fn = jax.jit(kvcache.all_digests)
+        self._pool = kvcache.alloc_pool(self.cfg)
+        # initial state: digest-of-zero-page everywhere, via the same
+        # compiled scrub program every later pass reuses
+        self._digests = self._scrub_fn(self._pool)
+
+        self._kv_pending = list(fault_plan.kv_faults()) if fault_plan \
+            else []
+        self.counters = {k: 0 for k in _COUNTERS}
+        self.events: list = []     # (kind, rid, step, wall-clock seconds)
+        self.finished: dict = {}   # rid -> list of generated token ids
+        self.step_index = 0
+        # (rid, position, np logits row) per sampled token — the bitwise
+        # oracle gate compares these across cache codecs (tests only;
+        # unbounded, so keep it off in long-running serving)
+        self.record_logits = record_logits
+        self.logits_log: list = []
+
+    # -- public API -------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        self.sched.submit(req)
+
+    def drained(self) -> bool:
+        return self.sched.drained()
+
+    def run_until_drained(self, max_steps: int = 100000) -> None:
+        while not self.drained():
+            if self.step_index >= max_steps:
+                raise RuntimeError(
+                    f"serve loop not drained after {max_steps} steps "
+                    f"({len(self.sched.queue)} queued, "
+                    f"{sum(s.state != FREE for s in self.sched.slots)} "
+                    "slots busy)")
+            self.step()
+
+    def report_unfired(self) -> list:
+        """kv_flip specs that never found a live target (e.g. scheduled
+        on a slot index the trace never filled) — the serving twin of
+        `resilience.report_unfired`; counted, never silent."""
+        self.counters["kv_faults_unfired"] = len(self._kv_pending)
+        return list(self._kv_pending)
+
+    # -- the step ---------------------------------------------------------
+
+    def step(self) -> None:
+        s = self.step_index
+        self._fire_kv_faults(s)
+        if self._scrub_every and s % self._scrub_every == 0:
+            self.scrub()
+        for slot in self.sched.admit(s):
+            self.counters["admitted"] += 1
+            self.counters["pages_reserved"] += len(slot.pages)
+            self._event("admit", slot.req.rid, s)
+        self._prefill_phase(s)
+        self._decode_phase(s)
+        self.step_index += 1
+
+    # -- phases -----------------------------------------------------------
+
+    def _checked(self, fn, *args):
+        """Dispatch a jitted step; its pre-append integrity verdict
+        (``bad`` > 0: a page this dispatch was about to append to — and
+        whose digest the append would have re-blessed — holds corrupted
+        bytes) DISCARDS the returned state, repairs through `scrub` on
+        the intact pre-dispatch pool, and re-dispatches.  Two strikes on
+        the same dispatch mean repair itself failed — loud, not silent."""
+        for _ in range(2):
+            pool, digests, out, bad = fn(self.params, self._pool,
+                                         self._digests, *args)
+            if int(bad) == 0:
+                self._pool, self._digests = pool, digests
+                return out
+            self.counters["kv_inline_detects"] += 1
+            self.scrub()
+        raise RuntimeError(
+            "KV page corruption persisted through scrub + repair "
+            f"(counters: {self.counters})")
+
+    def _prefill_phase(self, s: int) -> None:
+        slot = self.sched.next_prefill_slot()
+        if slot is None:
+            return
+        prompt = slot.req.prompt
+        n = min(self._prefill_chunk, len(prompt) - slot.fed)
+        buf = np.zeros((self._prefill_chunk,), np.int32)
+        buf[:n] = prompt[slot.fed:slot.fed + n]
+        last_logits = self._checked(
+            self._prefill_fn, buf, np.int32(slot.fed), np.int32(n),
+            self.sched.page_row(slot))
+        slot.fed += n
+        self.counters["prefill_chunks"] += 1
+        self.counters["prompt_tokens"] += n
+        if slot.fed == len(prompt):
+            row = np.asarray(last_logits)
+            if self.record_logits:
+                self.logits_log.append((slot.req.rid, slot.fed - 1, row))
+            tok = self._sample(row)
+            slot.generated.append(tok)
+            self.counters["tokens_generated"] += 1
+            self._event("first_token", slot.req.rid, s)
+            if not self._maybe_complete(slot, tok, s):
+                slot.state = DECODE
+                slot.next_token = tok
+
+    def _decode_phase(self, s: int) -> None:
+        dec = self.sched.decode_slots()
+        if not dec:
+            return
+        slots = self.sched.slots
+        tokens = np.asarray([max(sl.next_token, 0) for sl in slots],
+                            np.int32)
+        positions = np.asarray([sl.fed for sl in slots], np.int32)
+        active = np.asarray([sl.state == DECODE for sl in slots], bool)
+        logits = np.asarray(self._checked(
+            self._decode_fn, tokens, positions, self.sched.page_table(),
+            active))
+        self.counters["decode_steps"] += 1
+        for sl in dec:
+            sl.fed += 1
+            if self.record_logits:
+                self.logits_log.append(
+                    (sl.req.rid, sl.fed - 1, logits[sl.index]))
+            tok = self._sample(logits[sl.index])
+            sl.generated.append(tok)
+            self.counters["tokens_generated"] += 1
+            if not self._maybe_complete(sl, tok, s):
+                sl.next_token = tok
+
+    def _maybe_complete(self, slot, tok: int, s: int) -> bool:
+        req = slot.req
+        done = (len(slot.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id))
+        if done:
+            self.finished[req.rid] = list(slot.generated)
+            self._event("complete", req.rid, s)
+            self.counters["completed"] += 1
+            self.counters["pages_freed"] += self.sched.evict(slot)
+        return done
+
+    def _sample(self, logits_row: np.ndarray) -> int:
+        if self._temperature == 0.0:
+            return int(np.argmax(logits_row))
+        z = logits_row.astype(np.float64) / self._temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(logits_row.shape[0], p=p))
+
+    # -- integrity: scrub + repair ---------------------------------------
+
+    def scrub(self) -> list:
+        """Recompute every page digest, repair any live corruption.
+        Returns the corrupt (layer, page) pairs found."""
+        self.counters["scrubs"] += 1
+        cur = np.asarray(self._scrub_fn(self._pool))
+        stored = np.asarray(self._digests)
+        bad = np.argwhere(cur != stored)
+        bad_pages = sorted({int(p) for _, p in bad if p != TRASH_PAGE})
+        if not bad_pages:
+            return []
+        to_repair = []
+        for p in bad_pages:
+            self.counters["kv_pages_corrupt"] += 1
+            owner = self.sched.owner_of_page(p)
+            if owner is None:
+                self.counters["kv_corrupt_free_pages"] += 1
+            elif owner not in to_repair:
+                to_repair.append(owner)
+        for slot in to_repair:
+            self._repair(slot)
+        # repaired pages rewrote their digests; absorb the rest (free
+        # pages and any corrupted-but-unwritten tail) by re-syncing the
+        # stored digests to the pool's current bytes
+        self._digests = self._scrub_fn(self._pool)
+        return [(int(layer), int(p)) for layer, p in bad
+                if int(p) != TRASH_PAGE]
+
+    def _repair(self, slot) -> None:
+        """Rebuild a slot's cached K/V from its token history through the
+        prefill program — the request is never dropped; decode resumes
+        from the same pending token.  The pre-append verdict is ignored
+        HERE (a nonzero count is exactly the corruption being repaired);
+        the rewrite itself re-syncs the touched pages' digests."""
+        self.counters["kv_repairs"] += 1
+        feed = slot.history[:slot.fed]
+        row = self.sched.page_row(slot)
+        done = 0
+        while done < len(feed):
+            n = min(self._prefill_chunk, len(feed) - done)
+            buf = np.zeros((self._prefill_chunk,), np.int32)
+            buf[:n] = feed[done:done + n]
+            self._pool, self._digests, _, _bad = self._prefill_fn(
+                self.params, self._pool, self._digests, buf,
+                np.int32(done), np.int32(n), row)
+            done += n
+            self.counters["repair_chunks"] += 1
+
+    # -- fault injection --------------------------------------------------
+
+    def _fire_kv_faults(self, s: int) -> None:
+        still = []
+        for f in self._kv_pending:
+            if f.step > s or not self._flip_page(int(f.arg)):
+                still.append(f)
+        self._kv_pending = still
+
+    def _flip_page(self, slot_arg: int) -> bool:
+        """Flip one byte in the target slot's first page (layer 0, K
+        plane, position 0).  Returns False when the slot holds no cached
+        K/V yet — the spec stays pending until it can actually fire."""
+        slot = self.sched.slots[max(slot_arg, 0) % self.sched.n_slots]
+        if slot.state == FREE or slot.fed == 0 or not slot.pages:
+            return False
+        pid = slot.pages[0]
+        if self.cfg.raw:
+            # a REAL bit flip (low mantissa byte XOR 0xFF), not an
+            # arithmetic perturbation: `old + 1.0` would round back to
+            # `old` for |old| >= 2^24 or non-finite values — a fault
+            # counted as fired that attacked nothing
+            old = np.float32(self._pool[0, pid, 0, 0, 0, 0])
+            bits = old.view(np.uint32) ^ np.uint32(0xFF)
+            self._pool = self._pool.at[0, pid, 0, 0, 0, 0].set(
+                float(bits.view(np.float32)))
+        else:
+            old = self._pool[0, pid, 0, 0, 0, 0, 0]
+            self._pool = self._pool.at[0, pid, 0, 0, 0, 0, 0].set(
+                old ^ np.uint8(0xFF))
+        self.counters["kv_flips_injected"] += 1
+        return True
+
+    # -- misc -------------------------------------------------------------
+
+    def _event(self, kind: str, rid: int, step: int) -> None:
+        self.events.append((kind, rid, step, time.monotonic()))

@@ -1,0 +1,199 @@
+"""Paged KV cache with bit-packed eXmY pages — the serving stack's memory.
+
+The key insight of ROADMAP item 1: the `quant/numerics.pack_exmy` wire
+codec (PR 3) **is** a KV-cache codec.  A K/V element that went through
+``cast_to_format(·, e, m)`` carries only ``1+e+m`` bits of information,
+so the cache stores the ``wire_bytes(e, m)``-byte code words instead of
+fp32 — 4× less HBM at e5m2 — and `unpack_exmy` reconstructs the exact
+fp32 bit pattern at attention time.  (8,23) bypasses quantization (the
+code word IS the fp32 byte split), which is what makes the packed cache
+**bitwise identical** to an fp32-cache oracle there — the gate
+tests/test_serve.py pins.
+
+Layout (one pool array, allocated ONCE at capacity — no allocation ever
+happens on the serving hot path):
+
+    pool:    (L, n_pages, 2, page_size, H_kv, D, WB)  uint8
+    digests: (L, n_pages)                             uint32
+
+* ``L`` — decoder layers; axis FIRST so every per-layer read/write is a
+  static slice (`pool[l]`) inside the jitted step.
+* plane 2 — K then V.
+* ``WB = wire_bytes(e, m)`` trailing code-word bytes (`pack_exmy`'s own
+  trailing axis).
+* page id 0 is the **trash page**: masked lanes (free slots in the
+  fixed-shape decode batch, pad tokens in a prefill chunk) write there,
+  so every scatter in the step has jit-stable shapes and no `cond`.
+  The allocator never hands out page 0 and the scrubber skips it.
+* ``digests[l, p]`` — `parallel/integrity.wire_digest` (Fletcher mod
+  65521, position-weighted) over page p's bytes in layer l, updated in
+  the same jitted program as every append.  The scrubber recomputes all
+  of them and compares: any flipped byte in an allocated page surfaces
+  as a (layer, page) mismatch the engine can map back to its owning
+  request and repair by recomputation (docs/SERVING.md, repair ladder).
+
+The page *table* lives in host/int32 land (scheduler.py): each request
+slot owns an immutable tuple of page ids reserved at admission
+(worst-case ``ceil((prompt + max_new) / page_size)`` — reservation is
+what makes "zero dropped requests" a theorem instead of a hope), padded
+with the trash page to the static ``max_pages`` row the jitted gather
+uses.
+
+A ``raw=True`` config skips the codec entirely (fp32 pool, no cast, no
+pack): that IS the fp32-cache oracle the packed cache is gated against
+— bitwise at (8,23), where packing is a lossless byte split and the
+cast is the identity on every non-subnormal fp32; accuracy-bounded at
+narrow formats (docs/SERVING.md documents the bound).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.integrity import wire_digest
+from ..quant.numerics import (_validate_wire, cast_to_format,
+                              kv_page_bytes, pack_exmy, unpack_exmy,
+                              wire_bytes)
+
+__all__ = ["KVCacheConfig", "alloc_pool", "pack_kv", "unpack_kv",
+           "write_kv", "gather_kv", "refresh_digests", "check_digests",
+           "all_digests", "TRASH_PAGE"]
+
+TRASH_PAGE = 0   # reserved page id for masked writes; never allocated
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static shape/format description of one paged KV pool."""
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    page_size: int
+    n_pages: int          # INCLUDING the trash page
+    exp_bits: int = 8
+    man_bits: int = 23
+    raw: bool = False     # fp32 pool, no codec — the oracle cache
+
+    def __post_init__(self):
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.n_pages < 2:
+            raise ValueError("n_pages must be >= 2 (page 0 is the trash "
+                             f"page), got {self.n_pages}")
+        if self.raw:
+            return
+        # the ONE packed-wire validator (numerics._validate_wire — the
+        # man>=2 special-code rule included), eagerly at config build
+        # time rather than mid-trace; no copy of the rule to drift
+        _validate_wire(self.exp_bits, self.man_bits)
+
+    @property
+    def fmt(self) -> tuple:
+        return (self.exp_bits, self.man_bits)
+
+    @property
+    def word_bytes(self) -> int:
+        return 4 if self.raw else wire_bytes(self.exp_bits, self.man_bits)
+
+    @property
+    def page_bytes(self) -> int:
+        """One layer's K+V bytes per page — `quant.numerics.kv_page_bytes`
+        is the single source of truth; the pool slice must agree."""
+        if self.raw:
+            return 2 * self.page_size * self.n_kv_heads * self.head_dim * 4
+        return kv_page_bytes(self.exp_bits, self.man_bits, self.page_size,
+                             self.n_kv_heads, self.head_dim)
+
+    @property
+    def pool_shape(self) -> tuple:
+        base = (self.n_layers, self.n_pages, 2, self.page_size,
+                self.n_kv_heads, self.head_dim)
+        return base if self.raw else base + (self.word_bytes,)
+
+
+def alloc_pool(cfg: KVCacheConfig) -> jnp.ndarray:
+    """The once-at-capacity page pool (zeros — the defined empty state)."""
+    return jnp.zeros(cfg.pool_shape,
+                     jnp.float32 if cfg.raw else jnp.uint8)
+
+
+def pack_kv(x: jnp.ndarray, cfg: KVCacheConfig) -> jnp.ndarray:
+    """fp32 K or V block (..., H_kv, D) -> quantized packed code words
+    (..., H_kv, D, WB) (raw oracle: the fp32 values unchanged).
+    Quantize-on-append: the cast runs HERE, once per token, so attention
+    reads the same value set no matter how often it re-reads a page."""
+    x = jnp.asarray(x, jnp.float32)
+    if cfg.raw:
+        return x
+    q = cast_to_format(x, cfg.exp_bits, cfg.man_bits)
+    return pack_exmy(q, cfg.exp_bits, cfg.man_bits)
+
+
+def unpack_kv(packed: jnp.ndarray, cfg: KVCacheConfig) -> jnp.ndarray:
+    """Inverse of `pack_kv`'s packing: (..., WB) uint8 -> (...) fp32 with
+    the exact bit patterns the append-time cast produced."""
+    if cfg.raw:
+        return packed
+    return unpack_exmy(packed, cfg.exp_bits, cfg.man_bits)
+
+
+def write_kv(pool: jnp.ndarray, layer: int, k: jnp.ndarray, v: jnp.ndarray,
+             page_ids: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
+    """Scatter already-packed K/V rows into layer ``layer``'s pages.
+
+    k, v: (N, H_kv, D, WB) uint8 — one row per token position;
+    page_ids, offsets: (N,) int32 — target page and in-page slot per row
+    (masked rows point at TRASH_PAGE; duplicate trash targets are
+    harmless, every lane writes garbage nobody reads)."""
+    pool = pool.at[layer, page_ids, 0, offsets].set(k)
+    return pool.at[layer, page_ids, 1, offsets].set(v)
+
+
+def gather_kv(pool: jnp.ndarray, layer: int, page_rows: jnp.ndarray,
+              cfg: KVCacheConfig) -> tuple:
+    """Assemble per-slot contiguous K/V from the page table.
+
+    page_rows: (S, max_pages) int32 — each slot's page ids, trash-padded.
+    Returns fp32 ``(k, v)`` each (S, max_pages * page_size, H_kv, D):
+    the slot's whole capacity window, unwritten tail included (callers
+    mask by position, exactly like the dense cache path)."""
+    s, max_pages = page_rows.shape
+    kv = unpack_kv(pool[layer][page_rows], cfg)     # (S, P, 2, page, H, D)
+    t_cap = max_pages * cfg.page_size
+    k = kv[:, :, 0].reshape(s, t_cap, cfg.n_kv_heads, cfg.head_dim)
+    v = kv[:, :, 1].reshape(s, t_cap, cfg.n_kv_heads, cfg.head_dim)
+    return k, v
+
+
+def check_digests(pool: jnp.ndarray, digests: jnp.ndarray, layer: int,
+                  page_ids: jnp.ndarray) -> jnp.ndarray:
+    """int32 count of pages among ``page_ids`` whose CURRENT bytes do not
+    match their stored digest — the PRE-append integrity check.
+
+    Appending to a page recomputes its digest from the post-write bytes
+    (`refresh_digests`), which would silently re-bless any corruption
+    already sitting in the page; checking right before the write closes
+    that window: a corrupted page is either appended to (caught HERE,
+    this step) or left alone (caught by the next periodic scrub).
+    Duplicate ids re-count the same page — callers only branch on
+    count > 0."""
+    cur = jax.vmap(wire_digest)(pool[layer][page_ids])
+    return jnp.sum((cur != digests[layer, page_ids]).astype(jnp.int32))
+
+
+def refresh_digests(pool: jnp.ndarray, digests: jnp.ndarray, layer: int,
+                    page_ids: jnp.ndarray) -> jnp.ndarray:
+    """Recompute the integrity digest of layer ``layer``'s pages
+    ``page_ids`` (N, duplicates fine — they all see the same post-write
+    bytes) from the pool's CURRENT contents."""
+    fresh = jax.vmap(wire_digest)(pool[layer][page_ids])
+    return digests.at[layer, page_ids].set(fresh)
+
+
+def all_digests(pool: jnp.ndarray) -> jnp.ndarray:
+    """(L, n_pages) uint32 digest of every page — the scrub pass (and the
+    initial digest state: digest-of-zero-page for untouched pages)."""
+    return jax.vmap(jax.vmap(wire_digest))(pool)

@@ -1,0 +1,199 @@
+"""Load generation + serving metrics — the harness behind
+`tools/bench_serve.py` and bench.py's ``serving`` block.
+
+Traces are **step-indexed**, not wall-clock-indexed: a request's
+``arrival`` is the engine step at which the load generator makes it
+visible.  That keeps every run of a (seed, trace) pair bit-reproducible
+— the scheduler's admissions, the chunk interleave, the sampled tokens
+and all engine counters replay exactly (the serve-smoke determinism
+gate) — while latency METRICS are still measured in wall time (TTFT =
+first-token wall time minus the wall time at which the arrival step
+began).
+
+Reported metrics (the `bench.py` ``serving`` block schema):
+
+* ``tok_per_s`` — generated tokens / wall duration of the drained trace;
+* ``ttft_ms`` p50/p99 — time-to-first-token per request;
+* ``tpot_ms`` p50/p99 — per-token latency after the first token;
+* ``goodput_tok_per_s`` — generated tokens of only the requests meeting
+  the SLA (TTFT <= ``sla_ttft_ms`` AND per-token <= ``sla_tpot_ms``)
+  over the same duration — the number that actually answers "how much
+  traffic is being served *well*";
+* the engine counter dict, verbatim.
+
+`serial_baseline` replays the same trace through sequential
+`models.generate` calls (batch 1, the pre-serve inference surface) —
+the continuous-batching speedup gate compares aggregate tok/s.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .scheduler import Request
+
+__all__ = ["poisson_trace", "bursty_trace", "mixed_trace", "run_trace",
+           "serial_baseline"]
+
+
+def poisson_trace(n_requests: int, vocab_size: int, *,
+                  rate: float = 0.5, prompt_lens: Sequence[int] = (4, 8),
+                  max_new: Sequence[int] = (8,), seed: int = 0,
+                  eos_id: Optional[int] = None) -> list:
+    """Poisson arrivals: exponential inter-arrival gaps (mean ``1/rate``
+    engine steps), prompt/response sizes drawn from the given small sets
+    (small ON PURPOSE: the serial baseline compiles one program per
+    distinct (prompt_len, max_new) pair)."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    for rid in range(n_requests):
+        t += rng.exponential(1.0 / rate)
+        out.append(Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in rng.integers(
+                0, vocab_size, int(rng.choice(list(prompt_lens))))),
+            max_new_tokens=int(rng.choice(list(max_new))),
+            arrival=int(t), eos_id=eos_id))
+    return out
+
+
+def bursty_trace(n_requests: int, vocab_size: int, *,
+                 burst: int = 4, gap: int = 8,
+                 prompt_lens: Sequence[int] = (4, 8),
+                 max_new: Sequence[int] = (8,), seed: int = 0,
+                 eos_id: Optional[int] = None) -> list:
+    """Bursty arrivals: ``burst`` requests land simultaneously every
+    ``gap`` steps — the flash-crowd shape that stresses admission and
+    page reservation hardest."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for rid in range(n_requests):
+        out.append(Request(
+            rid=rid,
+            prompt=tuple(int(x) for x in rng.integers(
+                0, vocab_size, int(rng.choice(list(prompt_lens))))),
+            max_new_tokens=int(rng.choice(list(max_new))),
+            arrival=(rid // burst) * gap, eos_id=eos_id))
+    return out
+
+
+def mixed_trace(n_requests: int, vocab_size: int, *,
+                prompt_lens: Sequence[int] = (4, 8, 12),
+                max_new: Sequence[int] = (8,), seed: int = 0,
+                eos_id: Optional[int] = None) -> list:
+    """The acceptance-gate trace shape: a Poisson steady stream for the
+    first half, then a flash-crowd burst landing on top of it — request
+    ids stay globally unique and arrivals sorted."""
+    half = n_requests // 2
+    steady = poisson_trace(half, vocab_size, rate=2.0,
+                           prompt_lens=prompt_lens, max_new=max_new,
+                           seed=seed, eos_id=eos_id)
+    crowd = bursty_trace(n_requests - half, vocab_size, burst=4, gap=3,
+                         prompt_lens=prompt_lens, max_new=max_new,
+                         seed=seed + 1, eos_id=eos_id)
+    mid = steady[half // 2].arrival if steady else 0
+    out = list(steady)
+    for r in crowd:
+        out.append(Request(rid=half + r.rid, prompt=r.prompt,
+                           max_new_tokens=r.max_new_tokens,
+                           arrival=r.arrival + mid, eos_id=r.eos_id))
+    return sorted(out, key=lambda r: (r.arrival, r.rid))
+
+
+def _pct(values: list, q: float) -> Optional[float]:
+    return round(float(np.percentile(values, q)), 3) if values else None
+
+
+def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
+              sla_tpot_ms: float = 250.0,
+              max_steps: int = 100000) -> dict:
+    """Drive ``engine`` through ``requests`` until drained; -> metrics."""
+    for r in requests:
+        engine.submit(r)
+    step_wall = {}
+    t0 = time.monotonic()
+    while not engine.drained():
+        if engine.step_index >= max_steps:
+            raise RuntimeError(f"trace not drained in {max_steps} steps")
+        step_wall[engine.step_index] = time.monotonic()
+        engine.step()
+    duration = time.monotonic() - t0
+    engine.report_unfired()
+
+    first, done = {}, {}
+    for kind, rid, _step, wall in engine.events:
+        if kind == "first_token":
+            first[rid] = wall
+        elif kind == "complete":
+            done[rid] = wall
+    ttft, tpot, good_tokens = [], [], 0
+    for r in requests:
+        n_gen = len(engine.finished.get(r.rid, ()))
+        if r.rid not in first:
+            continue
+        t_first = (first[r.rid] - step_wall[r.arrival]) * 1e3
+        ttft.append(t_first)
+        t_tok = None
+        if r.rid in done and n_gen > 1:
+            t_tok = (done[r.rid] - first[r.rid]) * 1e3 / (n_gen - 1)
+            tpot.append(t_tok)
+        if t_first <= sla_ttft_ms and (t_tok is None
+                                       or t_tok <= sla_tpot_ms):
+            good_tokens += n_gen
+
+    gen = engine.counters["tokens_generated"]
+    return {
+        "requests": len(requests),
+        "completed": engine.counters["completed"],
+        "dropped": len(requests) - engine.counters["completed"],
+        "engine_steps": engine.step_index,
+        "duration_s": round(duration, 3),
+        "tok_per_s": round(gen / duration, 1) if duration else None,
+        "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p99": _pct(ttft, 99),
+        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
+        "goodput_tok_per_s": (round(good_tokens / duration, 1)
+                              if duration else None),
+        "sla": {"ttft_ms": sla_ttft_ms, "tpot_ms": sla_tpot_ms},
+        "counters": dict(engine.counters),
+    }
+
+
+def serial_baseline(model, params, requests: list, *,
+                    warm: bool = True) -> dict:
+    """The same trace through sequential batch-1 `generate` calls — the
+    repo's pre-serve inference surface.  ``warm=True`` runs the trace
+    once first so every (prompt_len, max_new) program is compiled before
+    the measured pass (the engine gets the same courtesy from its warmup
+    trace run)."""
+    import jax.numpy as jnp
+
+    from ..models.generate import generate
+
+    def one_pass() -> int:
+        toks = 0
+        for r in requests:
+            prompt = jnp.asarray([list(r.prompt)], jnp.int32)
+            out = generate(model, params, prompt, r.max_new_tokens,
+                           eos_id=r.eos_id)
+            out.block_until_ready()
+            # count like the engine does: tokens up to AND INCLUDING the
+            # first eos (generate freezes after it — the frozen repeats
+            # are not useful work and must not pad the baseline's tok/s)
+            new = np.asarray(out)[0, len(r.prompt):]
+            if r.eos_id is not None and (new == r.eos_id).any():
+                toks += int(np.argmax(new == r.eos_id)) + 1
+            else:
+                toks += r.max_new_tokens
+        return toks
+
+    if warm:
+        one_pass()
+    t0 = time.monotonic()
+    n = one_pass()
+    duration = time.monotonic() - t0
+    return {"tok_per_s": round(n / duration, 1) if duration else None,
+            "duration_s": round(duration, 3), "tokens": n}

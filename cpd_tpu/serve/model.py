@@ -1,0 +1,307 @@
+"""Serving forward pass for `TransformerLM` over the paged eXmY KV cache.
+
+The flax decode path (`TransformerLM(decode=True)`) owns a dense
+(B, T_max) cache collection with ONE scalar position shared by the whole
+batch — exactly what continuous batching cannot use: slots in the same
+decode batch sit at different positions, join and leave mid-flight, and
+their K/V lives in pages, not a contiguous buffer.  So serving runs the
+transformer math directly over the param pytree: same ops in the same
+order as `models/transformer.py` (fast-variance LayerNorm, head-major
+qkv split, RoPE, GQA grouped contraction, gelu MLP, tied embed head),
+with attention reading K/V through `kvcache.gather_kv` and per-slot
+positions instead of the module's cache variables.  Parity with
+``model.apply`` is pinned to fp32 round-off by tests/test_serve.py.
+
+Two jitted programs, both jit-stable in shape:
+
+* ``decode_step`` — ONE token for every slot of the fixed-shape batch
+  (S,), free slots masked to the trash page;
+* ``prefill_step`` — one CHUNK of one slot's prompt (C tokens, tail
+  padded + masked), so a long prompt never stalls the decode batch: the
+  engine interleaves one chunk per engine step against ongoing decode.
+
+Quantize-on-append ordering: each layer packs its K/V into the pages
+FIRST and attends through the pool AFTER, so every K/V read — including
+a token's own chunk — sees the dequantized page bytes.  That makes the
+numerics independent of *when* a position was computed (prefill, decode,
+or corruption-repair recompute), which is what makes repair-by-recompute
+deterministic, and makes the (8,23) path bitwise equal to the fp32
+oracle (the codec is lossless there; tests/test_serve.py gates it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import kvcache
+from .kvcache import KVCacheConfig
+from ..utils.cache import LRUCache
+
+__all__ = ["ModelSpec", "spec_from_model", "make_decode_step",
+           "make_prefill_step"]
+
+# jitted step programs keyed by their static configuration, shared across
+# engines: a fresh ServeEngine for a warm (spec, cfg) re-uses the compile
+# instead of re-tracing (the determinism smoke runs the same trace on two
+# fresh engines).  Bounded, matching the make_sum_gradients_fn precedent.
+_STEP_CACHE = LRUCache(maxsize=32)
+
+_NEG_INF = jnp.float32(-1e30)
+_LN_EPS = 1e-6   # flax nn.LayerNorm default, matching transformer.py
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """The static facts the serving forward needs about a TransformerLM."""
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: Optional[int]     # None = MHA (fused wqkv layout)
+    d_ff: int
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads else self.n_heads
+
+
+def spec_from_model(model) -> ModelSpec:
+    """Extract a ModelSpec from a `TransformerLM` module, failing fast on
+    configurations the serving forward does not mirror."""
+    if getattr(model, "scan_layers", False):
+        raise ValueError("serving needs the unrolled block{i} param "
+                         "layout; scan_layers=True is not supported")
+    if (model.ffn_exp, model.ffn_man) != (8, 23):
+        raise ValueError(
+            f"serving mirrors the plain Dense FFN only; quantized-"
+            f"accumulator MLP (ffn e{model.ffn_exp}m{model.ffn_man}) is "
+            "a training-path feature")
+    if model.tp_axis or model.sp_axis:
+        raise ValueError("serving is single-device (like decode=True); "
+                         "unset tp_axis/sp_axis")
+    return ModelSpec(vocab_size=model.vocab_size, d_model=model.d_model,
+                     n_layers=model.n_layers, n_heads=model.n_heads,
+                     n_kv_heads=model.n_kv_heads, d_ff=model.d_ff)
+
+
+def _layernorm(x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    """flax nn.LayerNorm parity: fast variance (E[x²] − E[x]²), eps 1e-6,
+    learned scale+bias."""
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    mean2 = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, mean2 - jnp.square(mean))
+    y = (x - mean) * jax.lax.rsqrt(var + _LN_EPS)
+    return y * p["scale"] + p["bias"]
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    """Rotary embedding on (B, T, H, D) with PER-SLOT (B, T) positions —
+    the batched sibling of transformer._rope (whose positions are one
+    (T,) vector shared by the batch; serving slots each sit elsewhere)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32)
+                    * (jnp.log(10000.0) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (B, T, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+def _qkv(blk: dict, h: jnp.ndarray, spec: ModelSpec) -> tuple:
+    """Mirror Block's head-major projection split: (q, k, v) with q
+    (B, T, H, D) and k/v (B, T, H_kv, D) — GQA kv stays UNEXPANDED."""
+    b, t, _ = h.shape
+    hd = spec.head_dim
+    if spec.n_kv_heads is None:
+        qkv = h @ blk["wqkv"]["kernel"]
+        qkv = qkv.reshape(b, t, spec.n_heads, 3, hd)
+        return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    q = (h @ blk["wq"]["kernel"]).reshape(b, t, spec.n_heads, hd)
+    kv = (h @ blk["wkv"]["kernel"]).reshape(b, t, spec.n_kv_heads, 2, hd)
+    return q, kv[..., 0, :], kv[..., 1, :]
+
+
+def _paged_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     q_pos: jnp.ndarray,
+                     last_pos: jnp.ndarray) -> jnp.ndarray:
+    """GQA softmax attention against a gathered capacity window.
+
+    q: (B, T, H, D); k/v: (B, T_cap, H_kv, D) — the slot's whole page
+    window; q_pos: (B, T) int32 global query positions; last_pos: (B,)
+    the newest LIVE position per slot.  The mask ``key_pos <=
+    query_pos`` is both causality and the unwritten-tail guard, the
+    same contract as Block._cached_attention.  fp32 softmax; grouped
+    contraction, nothing rep-sized materialized."""
+    b, t, h, d = q.shape
+    hkv = k.shape[2]
+    rep = h // hkv
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    ki = jnp.arange(k.shape[1], dtype=jnp.int32)
+    # zero the window tail past each slot's newest LIVE position BEFORE
+    # any contraction: a freshly reallocated page can still hold a
+    # previous tenant's bytes — possibly corrupt ones decoding to NaN —
+    # and while the logit mask below gives those positions zero
+    # PROBABILITY, 0 * NaN in the value einsum would still poison the
+    # output row.  Zeroed K/V make the dead tail inert in both
+    # contractions.
+    live = (ki[None, :] <= last_pos[:, None])[..., None, None]
+    k = jnp.where(live, k, 0.0)                      # (B, T_cap, 1, 1)
+    v = jnp.where(live, v, 0.0)
+    qg = q.reshape(b, t, hkv, rep, d)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = ki[None, None, :] <= q_pos[:, :, None]         # (B, T, T_cap)
+    logits = jnp.where(mask[:, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, t, h, d)
+
+
+def _block(blk: dict, x: jnp.ndarray, positions: jnp.ndarray,
+           last_pos: jnp.ndarray, pool: jnp.ndarray,
+           digests: jnp.ndarray, layer: int,
+           page_rows: jnp.ndarray, page_ids: jnp.ndarray,
+           offsets: jnp.ndarray, spec: ModelSpec,
+           cfg: KVCacheConfig) -> tuple:
+    """One decoder block over the paged cache: project, append-quantized,
+    attend-through-pool, MLP.  page_ids/offsets: (N,) flattened targets
+    of THIS call's (B·T) new positions (masked lanes -> trash page)."""
+    h = _layernorm(x, blk["ln1"])
+    q, k, v = _qkv(blk, h, spec)
+    q = _rope(q, positions)
+    k = _rope(k, positions)
+    # pre-append integrity check: the refresh below re-digests the page
+    # from its POST-write bytes, which would re-bless corruption already
+    # in it — so the stored digest is verified against the current bytes
+    # first, and the step's verdict rides out to the engine (which
+    # discards this dispatch's results and repairs on a nonzero count)
+    bad = kvcache.check_digests(pool, digests, layer, page_ids)
+    # quantize-on-append BEFORE attention (module docstring: every read
+    # sees page bytes, so prefill/decode/repair agree on the value set)
+    flat = (-1, cfg.n_kv_heads, cfg.head_dim)
+    pool = kvcache.write_kv(pool, layer,
+                            kvcache.pack_kv(k.reshape(flat), cfg),
+                            kvcache.pack_kv(v.reshape(flat), cfg),
+                            page_ids, offsets)
+    digests = kvcache.refresh_digests(pool, digests, layer, page_ids)
+    kc, vc = kvcache.gather_kv(pool, layer, page_rows, cfg)
+    attn = _paged_attention(q, kc, vc, positions, last_pos)
+    attn = attn.reshape(*attn.shape[:-2], spec.n_heads * spec.head_dim)
+    x = x + attn @ blk["wo"]["kernel"]
+
+    h = _layernorm(x, blk["ln2"])
+    h = jax.nn.gelu(h @ blk["wi"]["kernel"])
+    x = x + h @ blk["wo_mlp"]["kernel"]
+    return x, pool, digests, bad
+
+
+def _forward(params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
+             last_pos: jnp.ndarray, pool: jnp.ndarray,
+             digests: jnp.ndarray, page_rows: jnp.ndarray,
+             page_ids: jnp.ndarray, offsets: jnp.ndarray,
+             spec: ModelSpec, cfg: KVCacheConfig) -> tuple:
+    """Shared decode/prefill body: embed -> blocks -> ln_f -> tied head.
+    tokens/positions: (B, T); last_pos: (B,) newest live position per
+    slot; returns ((B, T, V) logits, pool, digests, bad) where ``bad``
+    is the summed pre-append digest-mismatch count over all layers (the
+    engine discards the dispatch and repairs when it is nonzero)."""
+    emb = params["embed"]["embedding"]
+    x = emb[tokens].astype(jnp.float32)
+    bad = jnp.zeros((), jnp.int32)
+    for layer in range(spec.n_layers):
+        x, pool, digests, layer_bad = _block(
+            params[f"block{layer}"], x, positions, last_pos, pool,
+            digests, layer, page_rows, page_ids, offsets, spec, cfg)
+        bad = bad + layer_bad
+    x = _layernorm(x, params["ln_f"])
+    logits = jnp.einsum("btd,vd->btv", x, emb.astype(jnp.float32))
+    return logits.astype(jnp.float32), pool, digests, bad
+
+
+def _page_targets(positions: jnp.ndarray, page_rows: jnp.ndarray,
+                  valid: jnp.ndarray, cfg: KVCacheConfig) -> tuple:
+    """(page_ids, offsets) for new positions: look the position's page up
+    in its slot's page-table row; invalid lanes -> the trash page.
+
+    positions/valid: (B, T); page_rows: (B, max_pages).  Returns flat
+    (B·T,) int32 pairs, matching _block's flattened K/V rows."""
+    slot_page = jnp.clip(positions // cfg.page_size, 0,
+                         page_rows.shape[1] - 1)
+    pids = jnp.take_along_axis(page_rows, slot_page, axis=1)
+    pids = jnp.where(valid, pids, kvcache.TRASH_PAGE)
+    offs = jnp.where(valid, positions % cfg.page_size, 0)
+    return pids.reshape(-1), offs.reshape(-1).astype(jnp.int32)
+
+
+def make_decode_step(spec: ModelSpec, cfg: KVCacheConfig):
+    """Jitted fixed-shape continuous-batching decode step.
+
+    fn(params, pool, digests, tokens (S,), positions (S,), page_rows
+    (S, max_pages), active (S,) bool) -> (pool, digests, logits (S, V),
+    bad).  Each active slot feeds ONE token sitting at ``positions[s]``
+    (appending its K/V there) and gets the next-token logits; inactive
+    slots ride along masked to the trash page."""
+
+    def build():
+        @jax.jit
+        def step(params, pool, digests, tokens, positions, page_rows,
+                 active):
+            pos2 = positions[:, None]                 # (S, 1)
+            pids, offs = _page_targets(pos2, page_rows, active[:, None],
+                                       cfg)
+            logits, pool2, digests2, bad = _forward(
+                params, tokens[:, None], pos2, positions, pool, digests,
+                page_rows, pids, offs, spec, cfg)
+            return pool2, digests2, logits[:, 0], bad
+
+        return step
+
+    return _STEP_CACHE.get_or_create(("decode", spec, cfg), build)
+
+
+def make_prefill_step(spec: ModelSpec, cfg: KVCacheConfig, chunk: int):
+    """Jitted chunked-prefill step for ONE slot.
+
+    fn(params, pool, digests, tokens (C,), start, n_valid, page_row
+    (max_pages,)) -> (pool, digests, last_logits (V,), bad): feeds
+    prompt positions [start, start + n_valid) (the (C,) buffer's tail
+    past n_valid is pad — masked to the trash page, its rows discarded)
+    and returns the logits at the chunk's LAST VALID position —
+    meaningful only for the prompt's final chunk, where it samples
+    token 0."""
+    if chunk < 1:
+        raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+
+    def build():
+        @jax.jit
+        def step(params, pool, digests, tokens, start, n_valid, page_row):
+            idx = jnp.arange(chunk, dtype=jnp.int32)
+            positions = (start + idx)[None]            # (1, C)
+            valid = (idx < n_valid)[None]
+            pids, offs = _page_targets(positions, page_row[None], valid,
+                                       cfg)
+            # newest LIVE position: the last VALID chunk lane (pad lanes
+            # have positions past it but write only to the trash page)
+            last_pos = (start + n_valid - 1)[None]
+            logits, pool2, digests2, bad = _forward(
+                params, tokens[None], positions, last_pos, pool, digests,
+                page_row[None], pids, offs, spec, cfg)
+            last = jnp.clip(n_valid - 1, 0, chunk - 1)
+            return pool2, digests2, logits[0, last], bad
+
+        return step
+
+    return _STEP_CACHE.get_or_create(("prefill", spec, cfg, chunk), build)

@@ -1,0 +1,220 @@
+"""Request scheduler for continuous batching — all host-side, all int32.
+
+The device side (serve/model.py) wants exactly two things per step: a
+fixed-shape decode batch (one token per slot, free slots masked) and at
+most one prefill chunk.  Everything stateful — admission, page
+reservation, chunk bookkeeping, completion, eviction — lives here in
+plain Python so the jitted programs stay pure and shape-stable.
+
+Slot lifecycle (docs/SERVING.md state diagram):
+
+    FREE ──admit──> PREFILL ──prompt done──> DECODE ──eos/max──> FREE
+                        │  (one chunk per engine step,             ▲
+                        │   round-robin across PREFILL slots)      │
+                        └──────────── repair re-prefill ───────────┘
+                              (a corrupt page rewinds fed K/V;
+                               state and tokens are kept)
+
+Admission reserves the request's WORST-CASE page count —
+``ceil((prompt + max_new) / page_size)`` — up front, so a request that
+enters the batch can always finish: no mid-decode allocation exists to
+fail, which is what makes "zero dropped requests" structural.  The
+queue is FIFO with head-of-line blocking (a big request waits for pages
+rather than being overtaken into starvation).
+
+The scheduler never touches the pool; it owns the free list and each
+slot's page-id tuple, and renders them into the trash-padded
+``(S, max_pages)`` int32 page-table rows the jitted gather consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+from .kvcache import TRASH_PAGE
+
+__all__ = ["Request", "Slot", "Scheduler", "FREE", "PREFILL", "DECODE"]
+
+FREE, PREFILL, DECODE = "free", "prefill", "decode"
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request.  ``prompt`` is a tuple of token ids;
+    ``arrival`` is the engine-step index at which the load generator
+    makes it visible (step-based so traces replay deterministically)."""
+    rid: int
+    prompt: tuple
+    max_new_tokens: int
+    arrival: int = 0
+    eos_id: Optional[int] = None
+
+    def __post_init__(self):
+        if len(self.prompt) < 1:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.rid}: max_new_tokens must "
+                             f"be >= 1, got {self.max_new_tokens}")
+
+    @property
+    def t_max(self) -> int:
+        """Cache positions the request can occupy: prompt + all generated
+        tokens except the last (which is sampled but never fed) — the
+        same sizing rule as `models.generate` (t_p + max_new)."""
+        return len(self.prompt) + self.max_new_tokens
+
+
+@dataclasses.dataclass
+class Slot:
+    """One decode-batch lane and its cache bookkeeping."""
+    index: int
+    state: str = FREE
+    req: Optional[Request] = None
+    pages: tuple = ()        # reserved page ids, admission-ordered
+    fed: int = 0             # positions whose K/V is in the cache
+    next_token: int = -1     # token to feed at position `fed` (DECODE)
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def history(self) -> tuple:
+        """Every token whose K/V the cache holds (or will hold next) —
+        the recompute source for corruption repair."""
+        if self.req is None:
+            return ()
+        return self.req.prompt + tuple(self.generated)
+
+    def reset(self) -> None:
+        self.state = FREE
+        self.req = None
+        self.pages = ()
+        self.fed = 0
+        self.next_token = -1
+        self.generated = []
+
+
+class Scheduler:
+    """Admission + slot/page bookkeeping for a `ServeEngine`.
+
+    ``n_slots`` is the decode batch's fixed shape; ``max_pages`` the
+    static per-slot page-table width (capacity ``max_pages * page_size``
+    positions per request); ``n_pages`` the pool's total page count
+    (page 0 reserved as trash)."""
+
+    def __init__(self, n_slots: int, n_pages: int, page_size: int,
+                 max_pages: int):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.n_slots = n_slots
+        self.page_size = page_size
+        self.max_pages = max_pages
+        self.slots = [Slot(i) for i in range(n_slots)]
+        # page 0 is the trash page; ascending ids keep runs reproducible
+        self.total_pages = n_pages - 1
+        self.free_pages = deque(range(1, n_pages))
+        self.queue: deque = deque()
+        self._prefill_rr = 0      # round-robin cursor over PREFILL slots
+
+    # -- capacity ---------------------------------------------------------
+
+    def pages_needed(self, req: Request) -> int:
+        return -(-req.t_max // self.page_size)
+
+    def capacity_positions(self) -> int:
+        return self.max_pages * self.page_size
+
+    def validate(self, req: Request) -> None:
+        """Fail fast at submit time when a request can NEVER be served —
+        the serving twin of `generate`'s t_max check.  Both limits are
+        checked: the per-request position window AND the pool's
+        allocatable page count (a custom small `n_pages` could otherwise
+        admit a request to the queue that no amount of draining frees
+        enough pages for — head-of-line deadlock, not a drop)."""
+        if req.t_max > self.capacity_positions():
+            raise ValueError(
+                f"request {req.rid}: prompt ({len(req.prompt)}) + "
+                f"max_new_tokens ({req.max_new_tokens}) = {req.t_max} "
+                f"exceeds the per-request capacity "
+                f"{self.capacity_positions()} (max_pages={self.max_pages}"
+                f" x page_size={self.page_size})")
+        if self.pages_needed(req) > self.total_pages:
+            raise ValueError(
+                f"request {req.rid}: needs {self.pages_needed(req)} "
+                f"pages but the pool only has {self.total_pages} "
+                "allocatable (n_pages minus the trash page) — it would "
+                "deadlock the admission queue")
+
+    # -- admission / eviction --------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        self.validate(req)
+        self.queue.append(req)
+
+    def admit(self, step: int) -> list:
+        """Move arrived queue heads into FREE slots while pages last.
+        Returns the newly admitted slots (FIFO; head-of-line blocking on
+        page pressure — never a drop)."""
+        admitted = []
+        for slot in self.slots:
+            if slot.state != FREE:
+                continue
+            if not self.queue or self.queue[0].arrival > step:
+                break
+            req = self.queue[0]
+            need = self.pages_needed(req)
+            if len(self.free_pages) < need:
+                break
+            self.queue.popleft()
+            slot.req = req
+            slot.pages = tuple(self.free_pages.popleft()
+                               for _ in range(need))
+            slot.state = PREFILL
+            slot.fed = 0
+            slot.generated = []
+            slot.next_token = -1
+            admitted.append(slot)
+        return admitted
+
+    def evict(self, slot: Slot) -> int:
+        """Return a finished slot's pages to the free list; -> page count."""
+        n = len(slot.pages)
+        self.free_pages.extend(slot.pages)
+        slot.reset()
+        return n
+
+    # -- step composition -------------------------------------------------
+
+    def decode_slots(self) -> list:
+        return [s for s in self.slots if s.state == DECODE]
+
+    def next_prefill_slot(self) -> Optional[Slot]:
+        """Round-robin over PREFILL slots: one chunk per engine step, so
+        several long prompts make progress fairly while decode runs."""
+        pre = [s for s in self.slots if s.state == PREFILL]
+        if not pre:
+            return None
+        slot = pre[self._prefill_rr % len(pre)]
+        self._prefill_rr += 1
+        return slot
+
+    def page_row(self, slot: Slot) -> np.ndarray:
+        """The slot's trash-padded (max_pages,) int32 page-table row."""
+        row = np.full((self.max_pages,), TRASH_PAGE, np.int32)
+        row[:len(slot.pages)] = slot.pages
+        return row
+
+    def page_table(self) -> np.ndarray:
+        """(S, max_pages) int32 rows for the whole decode batch."""
+        return np.stack([self.page_row(s) for s in self.slots])
+
+    def owner_of_page(self, page_id: int) -> Optional[Slot]:
+        for slot in self.slots:
+            if slot.state != FREE and page_id in slot.pages:
+                return slot
+        return None
+
+    def drained(self) -> bool:
+        return not self.queue and all(s.state == FREE for s in self.slots)

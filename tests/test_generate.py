@@ -196,6 +196,47 @@ def test_generate_eos_freezes_sequence():
         np.testing.assert_array_equal(out[1], free[1])
 
 
+def test_generate_t_max_fail_fast():
+    """A deployment capacity passed as t_max rejects oversize requests at
+    the API boundary instead of relying on the cache layer's NaN poison
+    (the serving scheduler applies the same rule at submit)."""
+    model, params = _model_and_params()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="exceeds t_max"):
+        generate(model, params, prompt, max_new_tokens=6, t_max=8)
+    # exactly at capacity is fine
+    out = generate(model, params, prompt, max_new_tokens=5, t_max=8)
+    assert out.shape == (1, 8)
+
+
+def test_generate_caches_are_bounded_lru():
+    """The module-level program caches are the bounded utils LRUCache
+    (the make_sum_gradients_fn precedent), not functools.lru_cache
+    holding decoder modules + jitted closures forever; repeat calls with
+    the same config hit the cache instead of growing it."""
+    import importlib
+
+    from cpd_tpu.utils.cache import LRUCache
+
+    # the package re-exports the generate FUNCTION under the same name,
+    # so reach the module through importlib
+    gen_mod = importlib.import_module("cpd_tpu.models.generate")
+
+    assert isinstance(gen_mod._RUN_CACHE, LRUCache)
+    assert isinstance(gen_mod._SHAPE_CACHE, LRUCache)
+    assert gen_mod._RUN_CACHE.maxsize == 32
+    assert gen_mod._SHAPE_CACHE.maxsize == 32
+
+    model, params = _model_and_params()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    generate(model, params, prompt, max_new_tokens=2)
+    n_run, n_shape = len(gen_mod._RUN_CACHE), len(gen_mod._SHAPE_CACHE)
+    assert n_run >= 1 and n_shape >= 1
+    generate(model, params, prompt, max_new_tokens=2)   # same config
+    assert len(gen_mod._RUN_CACHE) == n_run
+    assert len(gen_mod._SHAPE_CACHE) == n_shape
+
+
 def test_generate_sampling_validation():
     model, params = _model_and_params()
     prompt = jnp.zeros((1, 3), jnp.int32)

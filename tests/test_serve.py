@@ -1,0 +1,378 @@
+"""Serving stack tests (cpd_tpu/serve/): scheduler, paged eXmY KV cache,
+continuous-batching engine, corruption repair, load-gen determinism.
+
+Oracles:
+  * the raw fp32-cache engine (``raw_cache=True``) — the packed (8,23)
+    cache must be BITWISE identical to it (the codec is a lossless byte
+    split there), narrow formats within documented logit-error bounds;
+  * `models.generate` — greedy engine output must reproduce the
+    fused-scan decode path token for token;
+  * determinism — the same (model, trace, fault plan) must replay to
+    identical counters and outputs on fresh engines.
+
+Timing (tok/s vs serial) is deliberately NOT asserted here — that is
+the `serve-smoke` CI gate (tools/bench_serve.py --smoke), where the
+model is sized so the comparison has margin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpd_tpu.models import transformer_lm
+from cpd_tpu.quant.numerics import (cast_to_format, kv_page_bytes,
+                                    pack_exmy, unpack_exmy, wire_bytes)
+from cpd_tpu.resilience import FaultPlan
+from cpd_tpu.serve import (KVCacheConfig, Request, ServeEngine,
+                           mixed_trace, run_trace)
+from cpd_tpu.serve.kvcache import alloc_pool
+from cpd_tpu.serve.model import spec_from_model
+from cpd_tpu.serve.scheduler import DECODE, FREE, Scheduler
+
+VOCAB = 64
+ENGINE_KW = dict(n_slots=2, max_seq=32, page_size=8, prefill_chunk=4)
+
+
+@pytest.fixture(scope="module")
+def gqa_model():
+    model = transformer_lm(vocab_size=VOCAB, d_model=32, n_layers=2,
+                           n_heads=4, n_kv_heads=2, d_ff=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _requests(n=3, seed=3, max_new=5, lens=(5, 7, 9)):
+    rng = np.random.RandomState(seed)
+    return [Request(rid=i,
+                    prompt=tuple(int(x) for x in
+                                 rng.randint(0, VOCAB, lens[i % len(lens)])),
+                    max_new_tokens=max_new, arrival=i % 2)
+            for i in range(n)]
+
+
+def _run(model, params, reqs, **over):
+    kw = dict(ENGINE_KW)
+    kw.update(over)
+    eng = ServeEngine(model, params, **kw)
+    for r in reqs:
+        eng.submit(r)
+    eng.run_until_drained()
+    eng.report_unfired()
+    return eng
+
+
+# ------------------------------------------------ codec at KV-cache shapes
+
+@pytest.mark.parametrize("exp,man", [(8, 23), (5, 2), (4, 3), (5, 7)])
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_pack_roundtrip_at_kv_page_shapes(exp, man, hkv):
+    """pack/unpack round-trip at page-granular KV shapes — GQA head
+    counts against head_dim 64 (the flash_gqa world), INCLUDING the odd
+    tail page (T=19 over page_size 8 -> 3 pages, tail 3 live rows + a
+    zero remainder): the codec has only been exercised at flat gradient
+    shapes before."""
+    page, hd, t = 8, 64, 19
+    n_pages = -(-t // page)
+    rng = np.random.RandomState(exp * 100 + man + hkv)
+    vals = np.zeros((n_pages * page, hkv, hd), np.float32)
+    vals[:t] = rng.randn(t, hkv, hd).astype(np.float32) * 4.0
+    q = np.asarray(cast_to_format(jnp.asarray(vals), exp, man))
+    pages = jnp.asarray(q.reshape(n_pages, page, hkv, hd))
+    packed = pack_exmy(pages, exp, man)
+    assert packed.shape == (n_pages, page, hkv, hd, wire_bytes(exp, man))
+    rt = np.asarray(unpack_exmy(packed, exp, man))
+    np.testing.assert_array_equal(rt.view(np.uint32),
+                                  q.reshape(rt.shape).view(np.uint32))
+
+
+@pytest.mark.parametrize("exp,man", [(8, 23), (5, 2), (4, 3)])
+def test_kv_page_bytes_matches_actual_packed_page(exp, man):
+    """The analytic `kv_page_bytes` must equal the actual byte count of
+    one layer's page slice in a real pool — one source of truth."""
+    cfg = KVCacheConfig(n_layers=2, n_kv_heads=2, head_dim=16,
+                        page_size=8, n_pages=4, exp_bits=exp,
+                        man_bits=man)
+    pool = alloc_pool(cfg)
+    page_slice = pool[0, 1]          # one layer, one page (K+V planes)
+    assert page_slice.nbytes == kv_page_bytes(exp, man, 8, 2, 16)
+    assert cfg.page_bytes == page_slice.nbytes
+
+
+def test_kv_page_bytes_validates():
+    with pytest.raises(ValueError, match="page_size"):
+        kv_page_bytes(5, 2, 0, 2, 16)
+    with pytest.raises(ValueError, match="man_bits"):
+        kv_page_bytes(5, 99, 8, 2, 16)
+    # the packed-wire man>=2 special-code rule applies too: a byte count
+    # for a format the packed cache cannot store would be a lie
+    with pytest.raises(ValueError, match="man_bits >= 2"):
+        kv_page_bytes(6, 1, 8, 2, 16)
+
+
+# ------------------------------------------------------------- scheduler
+
+def test_scheduler_reserves_worst_case_and_blocks_fifo():
+    sched = Scheduler(n_slots=2, n_pages=6, page_size=8, max_pages=4)
+    # t_max 20 -> 3 pages; two such requests need 6 > 5 free pages
+    a = Request(rid=0, prompt=tuple(range(12)), max_new_tokens=8)
+    b = Request(rid=1, prompt=tuple(range(12)), max_new_tokens=8)
+    c = Request(rid=2, prompt=(1,), max_new_tokens=1)   # 1 page
+    sched.submit(a), sched.submit(b), sched.submit(c)
+    admitted = sched.admit(step=0)
+    # a fits (3 of 5 pages); b blocks on pages; c must NOT overtake b
+    # (FIFO head-of-line — starvation-freedom beats utilization)
+    assert [s.req.rid for s in admitted] == [0]
+    assert [r.rid for r in sched.queue] == [1, 2]
+    # freeing a's pages admits b
+    sched.evict(admitted[0])
+    assert [s.req.rid for s in sched.admit(step=0)] == [1, 2]
+
+
+def test_scheduler_rejects_over_capacity_request():
+    sched = Scheduler(n_slots=1, n_pages=8, page_size=8, max_pages=2)
+    with pytest.raises(ValueError, match="exceeds the per-request"):
+        sched.submit(Request(rid=0, prompt=tuple(range(10)),
+                             max_new_tokens=8))   # 18 > 16
+
+
+def test_scheduler_rejects_request_bigger_than_pool():
+    """A request within the per-request window but needing more pages
+    than the pool ALLOCATABLY has would deadlock admission forever —
+    must fail at submit, not spin."""
+    sched = Scheduler(n_slots=1, n_pages=3, page_size=8, max_pages=5)
+    with pytest.raises(ValueError, match="deadlock"):
+        sched.submit(Request(rid=0, prompt=tuple(range(20)),
+                             max_new_tokens=8))   # 4 pages > 2 in pool
+
+
+def test_scheduler_arrival_gating():
+    sched = Scheduler(n_slots=2, n_pages=8, page_size=8, max_pages=2)
+    sched.submit(Request(rid=0, prompt=(1, 2), max_new_tokens=2,
+                         arrival=5))
+    assert sched.admit(step=4) == []
+    assert len(sched.admit(step=5)) == 1
+
+
+# ------------------------------------------------------- engine vs oracle
+
+def test_engine_greedy_matches_generate(gqa_model):
+    """Continuous-batching greedy decode == the fused-scan generate()
+    path, request for request (different schedules, same tokens)."""
+    from cpd_tpu.models.generate import generate
+
+    model, params = gqa_model
+    reqs = _requests(n=3)
+    eng = _run(model, params, reqs)
+    assert eng.counters["completed"] == len(reqs)
+    for r in reqs:
+        out = generate(model, params,
+                       jnp.asarray([list(r.prompt)], jnp.int32),
+                       r.max_new_tokens)
+        want = list(np.asarray(out)[0, len(r.prompt):])
+        assert eng.finished[r.rid] == want, f"rid {r.rid}"
+
+
+def test_packed_e8m23_bitwise_equals_fp32_oracle(gqa_model):
+    """The tentpole numerics gate: at (8,23) the packed cache's sampled
+    logits are BIT-identical to the raw fp32-cache engine's."""
+    model, params = gqa_model
+    reqs = _requests(n=3)
+    ea = _run(model, params, reqs, kv_format=(8, 23), record_logits=True)
+    eb = _run(model, params, reqs, raw_cache=True, record_logits=True)
+    assert len(ea.logits_log) == len(eb.logits_log) > 0
+    for (ra, pa, la), (rb, pb, lb) in zip(ea.logits_log, eb.logits_log):
+        assert (ra, pa) == (rb, pb)
+        np.testing.assert_array_equal(la.view(np.uint32),
+                                      lb.view(np.uint32))
+    assert ea.finished == eb.finished
+
+
+@pytest.mark.parametrize("fmt,bound", [((5, 2), 8.0), ((4, 3), 6.0)])
+def test_narrow_format_logit_error_bounded(gqa_model, fmt, bound):
+    """e5m2/e4m3 KV caches trade accuracy for 4x memory: the max-abs
+    logit deviation vs the fp32-cache oracle stays under the documented
+    bound (docs/SERVING.md "Accuracy"), and is NON-zero — proving the
+    quantization actually engaged (a vacuously-lossless run would hide
+    a codec bypass bug)."""
+    model, params = gqa_model
+    reqs = _requests(n=3)
+    en = _run(model, params, reqs, kv_format=fmt, record_logits=True)
+    eo = _run(model, params, reqs, raw_cache=True, record_logits=True)
+    err = 0.0
+    for (rn, pn, ln), (ro, po, lo) in zip(en.logits_log, eo.logits_log):
+        if (rn, pn) != (ro, po):
+            break   # token divergence re-schedules; bound the common run
+        err = max(err, float(np.max(np.abs(ln - lo))))
+    assert 0.0 < err <= bound, err
+    assert en.counters["completed"] == len(reqs)
+
+
+# ------------------------------------------------- batching + prefill
+
+def test_mixed_trace_deterministic_zero_drops(gqa_model):
+    model, params = gqa_model
+    trace = mixed_trace(8, VOCAB, prompt_lens=(4, 6, 9), max_new=(4,),
+                        seed=11)
+
+    def fresh():
+        eng = ServeEngine(model, params, **ENGINE_KW, kv_format=(5, 2))
+        return run_trace(eng, list(trace)), eng
+
+    m1, e1 = fresh()
+    m2, e2 = fresh()
+    assert m1["counters"] == m2["counters"]
+    assert e1.finished == e2.finished
+    assert m1["dropped"] == 0
+    assert m1["completed"] == len(trace)
+    # latency metric set exists (values are wall-clock, not asserted)
+    for k in ("tok_per_s", "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+              "goodput_tok_per_s"):
+        assert m1[k] is not None
+
+
+def test_chunked_prefill_interleaves_with_decode(gqa_model):
+    """A long prompt (6 chunks) must NOT stall the decode batch: the
+    short request keeps generating between the long prompt's admission
+    and its first token."""
+    model, params = gqa_model
+    short = Request(rid=0, prompt=(1, 2, 3), max_new_tokens=12)
+    long_ = Request(rid=1, prompt=tuple(range(24)), max_new_tokens=2,
+                    arrival=2)
+    eng = _run(model, params, [short, long_])
+    steps = {(k, r): s for k, r, s, _ in eng.events}
+    t_admit, t_first = steps[("admit", 1)], steps[("first_token", 1)]
+    assert t_first - t_admit >= 5   # 24 tokens / chunk 4 -> >= 6 steps
+    # the short request was still mid-decode through that whole prefill
+    # window (it completes AFTER the long prompt's first token), and the
+    # engine ran a decode step alongside ~every prefill chunk — the
+    # batch never stalled
+    assert steps[("complete", 0)] > t_first
+    assert eng.counters["decode_steps"] >= t_first - t_admit
+
+
+def test_engine_rejects_oversize_request(gqa_model):
+    model, params = gqa_model
+    eng = ServeEngine(model, params, **ENGINE_KW)
+    with pytest.raises(ValueError, match="exceeds the per-request"):
+        eng.submit(Request(rid=0, prompt=tuple(range(30)),
+                           max_new_tokens=8))   # 38 > 32
+
+
+def test_spec_from_model_fails_fast():
+    with pytest.raises(ValueError, match="scan_layers"):
+        spec_from_model(transformer_lm(vocab_size=8, d_model=8,
+                                       n_layers=1, n_heads=2, d_ff=8,
+                                       scan_layers=True))
+    with pytest.raises(ValueError, match="ffn"):
+        spec_from_model(transformer_lm(vocab_size=8, d_model=8,
+                                       n_layers=1, n_heads=2, d_ff=8,
+                                       ffn_exp=5, ffn_man=2))
+    with pytest.raises(ValueError, match="single-device"):
+        spec_from_model(transformer_lm(vocab_size=8, d_model=8,
+                                       n_layers=1, n_heads=2, d_ff=8,
+                                       tp_axis="tp"))
+
+
+def test_kvcache_config_validates():
+    with pytest.raises(ValueError, match="man_bits >= 2"):
+        KVCacheConfig(n_layers=1, n_kv_heads=1, head_dim=8, page_size=8,
+                      n_pages=4, exp_bits=6, man_bits=1)
+    with pytest.raises(ValueError, match="trash"):
+        KVCacheConfig(n_layers=1, n_kv_heads=1, head_dim=8, page_size=8,
+                      n_pages=1)
+
+
+# ------------------------------------------------- corruption + repair
+
+def test_kv_flip_detected_and_repaired_deterministic(gqa_model):
+    """The resilience ride-along, end to end: an injected KV page flip
+    is caught by the page digest at the next scrub, the slot's cache is
+    rebuilt from its token history, the request COMPLETES — and the
+    whole faulted run replays bit-identically."""
+    model, params = gqa_model
+    reqs = _requests(n=3)
+    plan = FaultPlan.parse("kv_flip@4:0")
+
+    def faulted():
+        return _run(model, params, reqs, kv_format=(5, 2),
+                    scrub_every=2, fault_plan=plan)
+
+    e1, e2 = faulted(), faulted()
+    c = e1.counters
+    assert c["kv_flips_injected"] == 1
+    assert c["kv_pages_corrupt"] >= 1
+    assert c["kv_repairs"] == 1
+    assert c["repair_chunks"] >= 1
+    assert c["kv_faults_unfired"] == 0
+    assert c["completed"] == len(reqs)
+    assert e1.counters == e2.counters
+    assert e1.finished == e2.finished
+    # clean twin: no corruption counters move without the plan
+    e3 = _run(model, params, reqs, kv_format=(5, 2), scrub_every=2)
+    assert e3.counters["kv_pages_corrupt"] == 0
+    assert e3.counters["kv_repairs"] == 0
+    assert e3.counters["scrubs"] >= 1
+
+
+def test_kv_flip_off_scrub_schedule_caught_inline(gqa_model):
+    """Corruption landing on a NON-scrub step — or with no periodic
+    scrub at all — must still be caught: the pre-append digest check
+    inside the very next dispatch flags it BEFORE the append would
+    re-bless the page, the dispatch is discarded, and repair runs."""
+    model, params = gqa_model
+    reqs = _requests(n=2)
+    plan = FaultPlan.parse("kv_flip@3:0")
+
+    def faulted():
+        return _run(model, params, reqs, kv_format=(5, 2),
+                    scrub_every=0, fault_plan=plan)   # NO periodic scrub
+
+    e1, e2 = faulted(), faulted()
+    c = e1.counters
+    assert c["kv_flips_injected"] == 1
+    assert c["kv_inline_detects"] >= 1
+    assert c["kv_pages_corrupt"] >= 1
+    assert c["kv_repairs"] == 1
+    assert c["completed"] == len(reqs)
+    assert e1.counters == e2.counters
+    assert e1.finished == e2.finished
+
+
+def test_kv_flip_detected_on_raw_oracle_cache(gqa_model):
+    """The raw fp32 pool's flip is a true BIT flip (not an arithmetic
+    +1.0 that rounds away on large values) — the digest must catch it
+    there too."""
+    model, params = gqa_model
+    reqs = _requests(n=2)
+    eng = _run(model, params, reqs, raw_cache=True, scrub_every=2,
+               fault_plan=FaultPlan.parse("kv_flip@4:0"))
+    assert eng.counters["kv_flips_injected"] == 1
+    assert eng.counters["kv_pages_corrupt"] >= 1
+    assert eng.counters["kv_repairs"] == 1
+    assert eng.counters["completed"] == len(reqs)
+
+
+def test_kv_flip_on_never_filled_slot_reports_unfired(gqa_model):
+    model, params = gqa_model
+    # slot 1 never hosts a request (single tiny request in slot 0)
+    req = Request(rid=0, prompt=(1, 2, 3), max_new_tokens=2)
+    eng = _run(model, params, [req], fault_plan=FaultPlan.parse(
+        "kv_flip@0:1"))
+    assert eng.counters["kv_flips_injected"] == 0
+    assert eng.counters["kv_faults_unfired"] == 1
+
+
+def test_report_unfired_flags_kv_specs_in_training_plans():
+    """A kv_flip in a TRAINING plan can never fire (the trainers don't
+    run the serving engine) — `resilience.report_unfired` must surface
+    it instead of staying silent."""
+    from cpd_tpu.resilience import Injector
+    from cpd_tpu.resilience.inject import report_unfired
+
+    plan = FaultPlan.parse("kv_flip@3;stall@0:0.0")
+    inj = Injector(plan)
+    inj.maybe_stall(0)
+    left = report_unfired(inj, n_steps=10, rank=1)
+    assert [f.kind for f in left] == ["kv_flip"]

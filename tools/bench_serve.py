@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Serving load-generator harness — tok/s, TTFT/TPOT percentiles, goodput.
+
+Replays synthetic arrival traces (Poisson / bursty / mixed) through the
+continuous-batching `cpd_tpu.serve.ServeEngine` and reports the serving
+metric set into one JSON line (the same schema as bench.py's ``serving``
+block): aggregate tok/s, p50/p99 time-to-first-token, p50/p99 per-token
+latency, and goodput under an SLA — plus the serial `generate()`
+baseline the continuous batch must beat.
+
+``--smoke`` is the CI `serve-smoke` gate (PR 2-5 style: deterministic
+counters asserted TWICE, never a timing flake deciding pass/fail except
+the explicit speedup gate):
+
+  1. mixed trace on two FRESH engines -> identical counters, zero
+     dropped requests, every request completed;
+  2. kv_flip fault drill: injected page corruption is detected by the
+     page digests and repaired — request completes, counters exact,
+     deterministic across two runs;
+  3. bitwise gate: the packed (8,23) cache's sampled logits are
+     bit-identical to the raw-fp32-cache oracle's;
+  4. speedup gate: continuous batching sustains strictly higher
+     aggregate tok/s than serial batch-1 `generate()` on the same trace
+     (best of two engine passes, after a warmup pass for both sides).
+
+Run it by hand for the docs/PERF.md numbers:
+
+    JAX_PLATFORMS=cpu python tools/bench_serve.py --trace mixed \
+        --requests 16 --kv-format e5m2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the ONE eXmY spec parser (validated, good errors) — not a local copy
+from cpd_tpu.resilience.precision import parse_format  # noqa: E402
+
+
+# The smoke model: big enough that batched decode beats the serial
+# fused-scan generate() on a CPU host (measured ~2x at this shape —
+# docs/PERF.md "Serving smoke"), small enough to compile in seconds.
+_SMOKE_MODEL = dict(vocab_size=512, d_model=256, n_layers=3, n_heads=8,
+                    n_kv_heads=2, d_ff=512)
+_SMOKE_ENGINE = dict(n_slots=8, max_seq=48, page_size=8, prefill_chunk=8)
+
+
+def _build_model(args):
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.models import transformer_lm
+
+    model = transformer_lm(**_SMOKE_MODEL)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _build_trace(args):
+    from cpd_tpu.serve import bursty_trace, mixed_trace, poisson_trace
+
+    kw = dict(prompt_lens=(4, 8, 12), max_new=(16,), seed=args.seed)
+    vocab = _SMOKE_MODEL["vocab_size"]
+    if args.trace == "poisson":
+        return poisson_trace(args.requests, vocab, rate=args.rate, **kw)
+    if args.trace == "bursty":
+        return bursty_trace(args.requests, vocab, burst=4, gap=4, **kw)
+    return mixed_trace(args.requests, vocab, **kw)
+
+
+def _fresh_engine(model, params, args, **over):
+    from cpd_tpu.serve import ServeEngine
+
+    kw = dict(_SMOKE_ENGINE, kv_format=args.kv_format, seed=args.seed)
+    kw.update(over)
+    return ServeEngine(model, params, **kw)
+
+
+def run_load(args) -> dict:
+    from cpd_tpu.serve import run_trace, serial_baseline
+
+    model, params = _build_model(args)
+    trace = _build_trace(args)
+    run_trace(_fresh_engine(model, params, args), list(trace))  # warm
+    metrics = run_trace(_fresh_engine(model, params, args), list(trace),
+                        sla_ttft_ms=args.sla_ttft_ms,
+                        sla_tpot_ms=args.sla_tpot_ms)
+    base = serial_baseline(model, params, trace)
+    metrics["serial_baseline"] = base
+    if base["tok_per_s"]:
+        metrics["speedup_vs_serial"] = round(
+            metrics["tok_per_s"] / base["tok_per_s"], 2)
+    metrics["kv_format"] = list(args.kv_format)
+    metrics["trace"] = args.trace
+    return metrics
+
+
+def run_smoke(args) -> dict:
+    import numpy as np
+
+    from cpd_tpu.resilience import FaultPlan
+    from cpd_tpu.serve import run_trace, serial_baseline
+
+    model, params = _build_model(args)
+    trace = _build_trace(args)
+    out = {"smoke": True, "kv_format": list(args.kv_format),
+           "trace": args.trace, "requests": len(trace)}
+
+    # 1. determinism + zero drops: the same mixed trace on two fresh
+    # engines must replay to identical counters and finish everything
+    run_trace(_fresh_engine(model, params, args), list(trace))  # warm
+    m1 = run_trace(_fresh_engine(model, params, args), list(trace))
+    m2 = run_trace(_fresh_engine(model, params, args), list(trace))
+    assert m1["counters"] == m2["counters"], \
+        f"serving counters not deterministic:\n{m1['counters']}\n" \
+        f"{m2['counters']}"
+    assert m1["dropped"] == 0 and m1["completed"] == len(trace), \
+        f"dropped requests: {m1['dropped']}/{len(trace)}"
+    out["determinism"] = {"counters_equal": True,
+                          "completed": m1["completed"], "dropped": 0}
+
+    # 2. kv_flip drill: corruption detected by the page digest, repaired
+    # by recomputation, request still completes — twice, identically
+    plan = FaultPlan.parse("kv_flip@6:0")
+    e1 = _fresh_engine(model, params, args, scrub_every=2,
+                       fault_plan=plan)
+    f1 = run_trace(e1, list(trace))
+    e2 = _fresh_engine(model, params, args, scrub_every=2,
+                       fault_plan=plan)
+    f2 = run_trace(e2, list(trace))
+    c = f1["counters"]
+    assert c == f2["counters"], \
+        f"fault-drill counters not deterministic:\n{c}\n{f2['counters']}"
+    assert c["kv_flips_injected"] == 1, c
+    assert c["kv_pages_corrupt"] >= 1 and c["kv_repairs"] >= 1, c
+    assert c["kv_faults_unfired"] == 0, c
+    assert f1["dropped"] == 0 and f1["completed"] == len(trace), \
+        f"fault drill dropped requests: {f1['dropped']}"
+    out["fault_drill"] = {
+        "flips_injected": c["kv_flips_injected"],
+        "pages_corrupt": c["kv_pages_corrupt"],
+        "repairs": c["kv_repairs"], "completed": f1["completed"],
+        "deterministic": True}
+
+    # 3. bitwise gate: packed (8,23) logits == raw fp32-cache oracle
+    small = list(trace)[:6]
+    ea = _fresh_engine(model, params, args, kv_format=(8, 23),
+                       record_logits=True)
+    eb = _fresh_engine(model, params, args, raw_cache=True,
+                       record_logits=True)
+    run_trace(ea, list(small))
+    run_trace(eb, list(small))
+    assert len(ea.logits_log) == len(eb.logits_log) > 0
+    for (ra, pa, la), (rb, pb, lb) in zip(ea.logits_log, eb.logits_log):
+        assert (ra, pa) == (rb, pb)
+        assert (la.view(np.uint32) == lb.view(np.uint32)).all(), \
+            f"packed (8,23) logits differ from fp32 oracle at rid={ra} " \
+            f"pos={pa}"
+    out["bitwise_e8m23_vs_fp32_oracle"] = {"rows": len(ea.logits_log),
+                                           "identical": True}
+
+    # 4. speedup gate: aggregate tok/s strictly above serial generate()
+    base = serial_baseline(model, params, trace)
+    best = max(x for x in (m1["tok_per_s"], m2["tok_per_s"]) if x)
+    assert base["tok_per_s"] and best > base["tok_per_s"], \
+        f"continuous batching ({best} tok/s) did not beat serial " \
+        f"generate ({base['tok_per_s']} tok/s)"
+    out["speedup"] = {"engine_tok_per_s": best,
+                      "serial_tok_per_s": base["tok_per_s"],
+                      "ratio": round(best / base["tok_per_s"], 2)}
+    out["metrics"] = {k: m1[k] for k in
+                      ("tok_per_s", "ttft_ms_p50", "ttft_ms_p99",
+                       "tpot_ms_p50", "tpot_ms_p99",
+                       "goodput_tok_per_s")}
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="CI gate: determinism x2, fault drill, bitwise "
+                        "oracle, speedup-vs-serial")
+    p.add_argument("--trace", choices=("poisson", "bursty", "mixed"),
+                   default="mixed")
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--rate", type=float, default=2.0,
+                   help="poisson arrivals per engine step")
+    p.add_argument("--kv-format", type=parse_format, default=(5, 2),
+                   help="KV-cache eXmY format (default e5m2)")
+    p.add_argument("--sla-ttft-ms", type=float, default=1000.0)
+    p.add_argument("--sla-tpot-ms", type=float, default=250.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    out = run_smoke(args) if args.smoke else run_load(args)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
