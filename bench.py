@@ -378,6 +378,32 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
             partial["reduction_note"] = (f"reduction ledger skipped: "
                                          f"{type(e).__name__}: {e}")
 
+    # Budget-gated EXTRA (ISSUE 8): the overlapped backward-reduce
+    # measurement — full-step throughput of fp32 vs faithful vs
+    # faithful+overlap vs ring vs ring+overlap at the smoke shape, plus
+    # each arm's structural interleaving count (overlap_evidence).  The
+    # measurement function lives in tools/bench_reduce.py (one home —
+    # the standalone tool and every BENCH capture report the same
+    # arms); here it rides as `reduction.overlap` so the headline
+    # capture records whether overlap pays on this backend.  Disable
+    # with BENCH_OVERLAP=0.
+    if (os.environ.get("BENCH_OVERLAP", "1") != "0"
+            and "reduction" in partial
+            and time.monotonic() < budget_end - 120):
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "bench_reduce", os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "bench_reduce.py"))
+            br = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(br)
+            partial["reduction"]["overlap"] = br.overlap_step_bench(
+                iters=int(os.environ.get("BENCH_OVERLAP_ITERS", "4")))
+        except Exception as e:  # noqa: BLE001 — extras must not kill it
+            partial["reduction"]["overlap_note"] = (
+                f"overlap bench skipped: {type(e).__name__}: {e}")
+
     # Budget-gated EXTRA: a larger-batch scaling point.  bs 32 is the
     # reference-parity headline (main.py:32) but underfills a TPU's MXU
     # (VERDICT r2 weak #3); bs 128 shows what the chip does when fed.

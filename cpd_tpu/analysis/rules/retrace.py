@@ -25,6 +25,13 @@ Three shapes flagged:
    callables with an f-string: stringified keys conflate distinct
    configs ("8" == "8") and churn the table under formatting drift;
    route structured tuples through StepTable/LRUCache.
+4. **overlap-blind ladder keys** (ISSUE 8) — in a module that
+   configures the overlapped transport (a ``overlap_reduce=`` step
+   builder, or the CLI's ``overlap_key(args)`` derivation), every
+   ``ladder_step_key(...)`` call must pass the ``overlap=`` coordinate:
+   a key without it serves a step traced for the wrong schedule /
+   bucket layout after a ladder transition — the same bug class with a
+   transport coordinate.
 """
 
 from __future__ import annotations
@@ -43,7 +50,9 @@ class Retrace(ProjectRule):
                "class")
 
     def check(self, project: ProjectGraph) -> Iterator[Finding]:
+        by_mod: dict = {}
         for fkey, f, mod in project.iter_functions():
+            by_mod.setdefault(fkey[0], (mod, []))[1].append(f)
             for site in f["jit_in_loop"]:
                 yield Finding(
                     path=mod["path"], line=site["line"], col=site["col"],
@@ -56,6 +65,8 @@ class Retrace(ProjectRule):
                         "transport.StepTable / utils.cache.LRUCache"))
             yield from self._half_keyed(f, mod)
             yield from self._fstr_keys(f, mod)
+        for mod, funcs in by_mod.values():
+            yield from self._overlap_blind(mod, funcs)
 
     def _half_keyed(self, f, mod) -> Iterator[Finding]:
         sups = f["supervisor_objs"]
@@ -85,6 +96,37 @@ class Retrace(ProjectRule):
                     f"after a transition (the PR 5 ladder_step_key "
                     f"bug); derive keys with "
                     f"precision.ladder_step_key(transport, precision)"))
+
+    def _overlap_blind(self, mod, funcs) -> Iterator[Finding]:
+        """Module-scope check 4: overlap-configured modules must thread
+        the overlap coordinate through every ladder key.  The trigger is
+        deliberately module-wide — the CLIs derive ``ov_key`` in main()
+        and subscript the table from the same scope, but a step builder
+        configured in a helper still poisons every key site in the
+        file."""
+        configures_overlap = any(
+            "overlap_reduce" in call["kw"]
+            or call["callee"].split(".")[-1] == "overlap_key"
+            for f in funcs for call in f["calls"])
+        if not configures_overlap:
+            return
+        for f in funcs:
+            for call in f["calls"]:
+                if call["callee"].split(".")[-1] != "ladder_step_key":
+                    continue
+                if "overlap" in call["kw"] or call["star"]:
+                    continue
+                yield Finding(
+                    path=mod["path"], line=call["line"], col=call["col"],
+                    rule=self.id,
+                    message=(
+                        "ladder_step_key(...) without the overlap= "
+                        "coordinate in a module that configures the "
+                        "overlapped transport — after a ladder "
+                        "transition the table would serve a step traced "
+                        "for the wrong schedule / bucket layout; pass "
+                        "overlap=utils.config.overlap_key(args) (None "
+                        "when the run has no overlap surface)"))
 
     def _fstr_keys(self, f, mod) -> Iterator[Finding]:
         jit_tables = {t["name"] for t in f["jit_tables"] if t["jit"]}

@@ -12,8 +12,11 @@ from .integrity import (digest_agree, hop_tag, make_consensus_fns,
                         tree_digest, wire_digest)
 from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR, group_split,
                    data_parallel_mesh, make_mesh)
+from .overlap import (BucketPlan, bucket_layout, overlap_evidence,
+                      overlapped_grads)
 from .pipeline import pipeline_spmd
-from .ring import (gather_transport_bytes, ring_oracle_sum,
+from .ring import (gather_transport_bytes, hierarchical_ring_sum,
+                   ring_oracle_sum, ring_oracle_sum_multi,
                    ring_quantized_sum, ring_transport_bytes)
 from .zero import Zero1State, zero1_sgd, zero2_sgd, zero3_sgd
 from .reduction import (kahan_quantized_sum, ordered_quantized_sum,
@@ -29,7 +32,9 @@ __all__ = [
     "data_parallel_mesh", "make_mesh",
     "kahan_quantized_sum", "ordered_quantized_sum", "quantized_sum",
     "ring_quantized_sum", "ring_oracle_sum", "ring_transport_bytes",
-    "gather_transport_bytes",
+    "gather_transport_bytes", "hierarchical_ring_sum",
+    "ring_oracle_sum_multi",
+    "BucketPlan", "bucket_layout", "overlapped_grads", "overlap_evidence",
     "wire_digest", "tree_digest", "hop_tag", "digest_agree",
     "make_consensus_fns",
 ]

@@ -57,8 +57,9 @@ from ..quant.numerics import (cast_to_format, cast_to_format_sr_at,
 from ..quant.quant_function import tree_quant_health
 from .aps import (aps_max_exponents, aps_scale, aps_shift_factors_checked,
                   aps_unscale, pmax_scalar_vector)
+from .overlap import DEFAULT_BUCKET_ELEMS, bucket_layout
 from .reduction import quantized_sum
-from .ring import ring_quantized_sum
+from .ring import hierarchical_ring_sum
 
 __all__ = [
     "dist_init", "sum_gradients", "broadcast_from", "replicate",
@@ -162,16 +163,20 @@ def _leaf_offsets(start: int, leaf) -> jnp.ndarray:
             + jnp.arange(leaf.size, dtype=jnp.uint32)).reshape(leaf.shape)
 
 
-def quantize_tree_sr(tree, grad_exp: int, grad_man: int, key) -> Any:
+def quantize_tree_sr(tree, grad_exp: int, grad_man: int, key,
+                     starts: Optional[Sequence[int]] = None) -> Any:
     """Per-leaf eXmY cast of a pytree: RTNE when `key` is None, otherwise
     stochastic rounding with GLOBAL-offset-indexed bits (one bitstream over
     the concatenated flat layout, so the draw is identical however the
-    tree is later flattened, bucketed, or sharded)."""
+    tree is later flattened, bucketed, or sharded).  ``starts`` overrides
+    each leaf's global flat offset — for callers whose ``tree`` is a
+    SLICE of a larger layout (the overlap taps reduce one bucket at a
+    time, parallel/overlap.py) and must draw that layout's bits."""
     if key is None:
         return jax.tree.map(
             lambda g: cast_to_format(g, grad_exp, grad_man), tree)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    starts = _leaf_starts(tree)
+    starts = _leaf_starts(tree) if starts is None else list(starts)
     out = [cast_to_format_sr_at(g, grad_exp, grad_man, key,
                                 _leaf_offsets(st, g))
            for st, g in zip(starts, leaves)]
@@ -221,68 +226,69 @@ def _gather_leaf(g: jnp.ndarray, axis_name, wire=None) -> jnp.ndarray:
     return lax.all_gather(g, axis_name, axis=0, tiled=False)
 
 
-# Per-bucket element cap for the faithful path.  W x 4M x 4B = 128 MiB of
-# gathered fp32 at W=8 — large enough to amortize collective launch
-# overhead, small enough that the gathered stack never rivals model memory.
-_BUCKET_ELEMS = 4 * 1024 * 1024
+# Per-bucket element cap for the faithful path (one home for the number:
+# parallel/overlap.py, which the overlapped transport shares the layout
+# with).  W x 4M x 4B = 128 MiB of gathered fp32 at W=8 — large enough to
+# amortize collective launch overhead, small enough that the gathered
+# stack never rivals model memory.
+_BUCKET_ELEMS = DEFAULT_BUCKET_ELEMS
 
 
 def _bucketed_quantized_sum(grads: Any, axis_name, grad_exp: int,
                             grad_man: int, use_kahan: bool,
                             bucket_elems: int = _BUCKET_ELEMS,
-                            wire=None, key=None) -> Any:
+                            wire=None, key=None, starts=None) -> Any:
     """Faithful ordered reduction over few large buckets instead of one
     collective per parameter (SURVEY.md §7 hard-part 4).
 
     Leaves are flattened and concatenated per dtype into buckets of at most
-    `bucket_elems` elements; each bucket is all_gathered ONCE and reduced
-    with ONE rank-ordered requantizing scan, then split back.  The quantized
-    accumulation is elementwise, so concatenation changes nothing about any
-    element's value — results are bit-identical to the per-leaf path (the
+    `bucket_elems` elements (`overlap.bucket_layout` — the ONE capping
+    function, shared with the bucketed ring and the overlap taps); each
+    bucket is all_gathered ONCE and reduced with ONE rank-ordered
+    requantizing scan, then split back.  The quantized accumulation is
+    elementwise, so concatenation changes nothing about any element's
+    value — results are bit-identical to the per-leaf path (the
     reference's per-parameter loop, dist_util.py:60-89), with W x leaf_count
     collective launches collapsed to W x bucket_count.
 
     With stochastic rounding (`key` given) the per-element bits are indexed
     by GLOBAL flat offset (numerics.sr_bits_at), so bucketed and per-leaf
     reductions draw the SAME bits — bit-identical results, invariant to the
-    bucket layout (and to ZeRO sharding, parallel/zero.py).
+    bucket layout (and to ZeRO sharding, parallel/zero.py).  ``starts``
+    overrides the leaves' global offsets (overlap taps reducing a bucket
+    of a larger layout).
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    starts = _leaf_starts(grads)
+    starts = _leaf_starts(grads) if starts is None else list(starts)
     out = [None] * len(leaves)
-    # group by dtype, preserving leaf order within a group
+    # group by dtype GLOBALLY (order of first appearance), then cap each
+    # group with the shared layout function — an interleaved-dtype tree
+    # still packs into few large per-dtype buckets instead of breaking a
+    # bucket at every dtype change
     by_dtype: dict = {}
     for i, g in enumerate(leaves):
         by_dtype.setdefault(jnp.dtype(g.dtype), []).append(i)
+    buckets = []
     for idxs in by_dtype.values():
-        # split the group into buckets of <= bucket_elems (a leaf larger
-        # than the cap forms its own bucket)
-        buckets, cur, cur_n = [], [], 0
-        for i in idxs:
+        for local in bucket_layout([leaves[i].size for i in idxs],
+                                   bucket_elems):
+            buckets.append([idxs[j] for j in local])
+    for bucket in buckets:
+        flat = (leaves[bucket[0]].reshape(-1) if len(bucket) == 1 else
+                jnp.concatenate([leaves[i].reshape(-1)
+                                 for i in bucket]))
+        gathered = _gather_leaf(flat, axis_name, wire=wire)
+        offs = (None if key is None else jnp.concatenate(
+            [_leaf_offsets(starts[i], leaves[i]).ravel()
+             for i in bucket]))
+        red = quantized_sum(gathered, grad_exp, grad_man, use_kahan,
+                            key=key, offsets=offs)
+        off = 0
+        for i in bucket:
             n = leaves[i].size
-            if cur and cur_n + n > bucket_elems:
-                buckets.append(cur)
-                cur, cur_n = [], 0
-            cur.append(i)
-            cur_n += n
-        if cur:
-            buckets.append(cur)
-        for bucket in buckets:
-            flat = (leaves[bucket[0]].reshape(-1) if len(bucket) == 1 else
-                    jnp.concatenate([leaves[i].reshape(-1)
-                                     for i in bucket]))
-            gathered = _gather_leaf(flat, axis_name, wire=wire)
-            offs = (None if key is None else jnp.concatenate(
-                [_leaf_offsets(starts[i], leaves[i]).ravel()
-                 for i in bucket]))
-            red = quantized_sum(gathered, grad_exp, grad_man, use_kahan,
-                                key=key, offsets=offs)
-            off = 0
-            for i in bucket:
-                n = leaves[i].size
-                out[i] = lax.dynamic_slice_in_dim(red, off, n).reshape(
-                    leaves[i].shape)
-                off += n
+            out[i] = lax.dynamic_slice_in_dim(red, off, n).reshape(
+                leaves[i].shape)
+            off += n
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -293,7 +299,9 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   rounding: str = "nearest", key=None,
                   verify: bool = False,
                   wire_fault: Optional[tuple] = None,
-                  stats: bool = False) -> Any:
+                  stats: bool = False,
+                  bucket_elems: Optional[int] = None,
+                  offset_starts: Optional[Sequence[int]] = None) -> Any:
     """Low-precision gradient all-reduce (SUM) over `axis_name`.
 
     Pure pytree-in/pytree-out version of reference `sum_gradients`
@@ -309,13 +317,34 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   with bit-packed eXmY partials on the wire — the ordered
                   requantized reduction at ~2/W of the gather wire bytes
                   and O(n/W) peak memory, in parallel/ring.py's documented
-                  per-chunk rank-rotation order; single mesh axis only).
+                  per-chunk rank-rotation order).  On a MULTI-axis
+                  ``axis_name`` the ring composes hierarchically:
+                  sequential per-axis rings, innermost (last-named) axis
+                  first, bit-gated by `ring.ring_oracle_sum_multi`
+                  (parallel/ring.hierarchical_ring_sum) — the old
+                  multi-axis fail-fast is gone.
     bucket      → faithful mode only: fuse per-leaf gathers into few large
                   per-dtype buckets (bit-identical).  Default (None) =
                   auto: on for TPU — fewer collective launches riding ICI
                   — off elsewhere (on the CPU mesh the gather is a plain
                   memcpy and the bucket concat/split copies measured ~17%
                   slower on a ResNet-18-sized pytree).
+    bucket_elems→ per-bucket element cap (default `_BUCKET_ELEMS`, 4M).
+                  Setting it implies ``bucket=True`` for faithful mode.
+                  RING mode is always bucketed at this cap via the same
+                  greedy layout the overlapped backward-reduce emits
+                  (`overlap.bucket_layout` / `BucketPlan.for_tree`), so
+                  overlap on/off is bitwise identical at ANY value
+                  including the default — a tree that fits one bucket
+                  rings whole, exactly the pre-bucketing transport.
+                  NOTE: different ``bucket_elems`` values are DIFFERENT
+                  documented accumulation orders (chunk boundaries
+                  move), each gated by its own per-bucket oracle.
+                  Ignored by "fast" (psum is elementwise; layout-free).
+    offset_starts→ per-leaf GLOBAL flat offsets overriding the tree's own
+                  `_leaf_starts` — for callers reducing a SLICE of a
+                  larger layout (the overlap taps, parallel/overlap.py)
+                  whose SR bits must match the whole-layout draw.
     rounding    → "nearest" (reference semantics) | "stochastic": every
                   eXmY cast in the pipeline (the APS/fast pre-quantize,
                   each ordered-accumulation step, the fast post-quantize)
@@ -364,17 +393,10 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
     """
     if mode not in ("faithful", "fast", "ring"):
         raise ValueError(f"unknown mode {mode!r}")
-    if mode == "ring" and not isinstance(axis_name, str):
-        # ring_quantized_sum would raise the same complaint from deep
-        # inside jit tracing; catch it at dispatch with the fix spelled
-        # out (satellite: actionable multi-axis error)
-        raise ValueError(
-            f"mode='ring' reduces over exactly ONE mesh axis, but "
-            f"axis_name names {len(tuple(axis_name))}: "
-            f"{tuple(axis_name)!r}.  Reduce over a single axis (e.g. "
-            f"axis_name='{next(iter(axis_name), 'dp')}') or use "
-            f"mode='faithful', whose gather+scan path supports "
-            f"multi-axis reductions.")
+    if mode == "ring" and not isinstance(axis_name, str) \
+            and not tuple(axis_name):
+        raise ValueError("mode='ring' needs at least one mesh axis; got "
+                         f"{tuple(axis_name)!r}")
     if rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown rounding {rounding!r}")
     if rounding == "stochastic" and key is None:
@@ -385,8 +407,12 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         raise ValueError("a PRNG key was passed but rounding='nearest' "
                          "would ignore it; pass rounding='stochastic' "
                          "(matching float_quantize/quant_gemm's contract)")
+    if bucket is False and bucket_elems is not None and mode == "faithful":
+        raise ValueError("bucket=False contradicts an explicit "
+                         "bucket_elems — drop one of them")
     if bucket is None:
-        bucket = jax.default_backend() == "tpu"
+        bucket = (jax.default_backend() == "tpu"
+                  or bucket_elems is not None)
     world = lax.psum(jnp.float32(1.0), axis_name)
 
     # Independent SR bitstreams for the three cast stages.  The pre-
@@ -403,7 +429,8 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         k_pre = jax.random.fold_in(k_pre, _flat_axis_index(axis_name))
 
     def q_tree(t, k):
-        return quantize_tree_sr(t, grad_exp, grad_man, k)
+        return quantize_tree_sr(t, grad_exp, grad_man, k,
+                                starts=offset_starts)
 
     shifts = None
     prec = None
@@ -450,28 +477,62 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
         if not (grad_exp == 8 and grad_man == 23):
             reduced = q_tree(reduced, k_post)
     elif mode == "ring":
-        # One ring over the WHOLE flat gradient (leaves concatenated in
-        # tree_flatten order, so SR offsets live in the same global space
-        # as _leaf_starts).  Partial sums are post-quantize — always in
-        # the format value set — so the wire is bit-packed whether or not
-        # APS pre-quantized the inputs.
+        # Per-bucket rings over the flat gradient (ONE whole-tree ring
+        # when bucket_elems is None — leaves concatenated in tree_flatten
+        # order, SR offsets in the same global space as _leaf_starts).
+        # Partial sums are post-quantize — always in the format value set
+        # — so the wire is bit-packed whether or not APS pre-quantized
+        # the inputs.  Multi-axis axis_name composes hierarchically
+        # (ring.hierarchical_ring_sum); an injected wire fault hits
+        # bucket 0 only, so chaos-drill counter expectations survive any
+        # bucket count (resilience/inject.py wire_schedule).
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         if leaves:
-            flat = (leaves[0].astype(jnp.float32).reshape(-1)
-                    if len(leaves) == 1 else
-                    jnp.concatenate([l.astype(jnp.float32).reshape(-1)
-                                     for l in leaves]))
-            red = ring_quantized_sum(flat, axis_name, grad_exp, grad_man,
-                                     use_kahan=use_kahan, key=k_sum,
-                                     verify=verify, fault=wire_fault)
-            if verify:
-                red, report = red
-            out, off = [], 0
-            for l in leaves:
-                out.append(lax.dynamic_slice_in_dim(red, off, l.size)
-                           .reshape(l.shape).astype(l.dtype))
-                off += l.size
+            starts = (_leaf_starts(grads) if offset_starts is None
+                      else list(offset_starts))
+            sizes = [l.size for l in leaves]
+            # the ring is ALWAYS bucketed at the same default cap the
+            # overlap taps use (BucketPlan.for_tree): a tree that fits
+            # one bucket rings whole — the historical behavior — and a
+            # larger tree gets the same per-bucket layout whether the
+            # reduction runs post-backward or inside the taps, so
+            # overlap on/off is bitwise identical at bucket_elems=None
+            # too (not just at an explicit cap)
+            buckets = bucket_layout(
+                sizes, bucket_elems if bucket_elems is not None
+                else _BUCKET_ELEMS)
+            out = [None] * len(leaves)
+            reports = []
+            for b, idxs in enumerate(buckets):
+                flat = (leaves[idxs[0]].astype(jnp.float32).reshape(-1)
+                        if len(idxs) == 1 else
+                        jnp.concatenate([leaves[i].astype(jnp.float32)
+                                         .reshape(-1) for i in idxs]))
+                # contiguous bucket -> cheap scalar offset_start; a
+                # bucket spanning non-adjacent global offsets ships the
+                # full per-element offset array instead
+                contig = all(starts[i] + sizes[i] == starts[j]
+                             for i, j in zip(idxs, idxs[1:]))
+                off_kw = (dict(offset_start=int(starts[idxs[0]]))
+                          if contig else
+                          dict(offsets=jnp.concatenate(
+                              [_leaf_offsets(starts[i], leaves[i]).ravel()
+                               for i in idxs])))
+                red = hierarchical_ring_sum(
+                    flat, axis_name, grad_exp, grad_man,
+                    use_kahan=use_kahan, key=k_sum, verify=verify,
+                    fault=(wire_fault if b == 0 else None), **off_kw)
+                if verify:
+                    red, rep = red
+                    reports.append(rep)
+                off = 0
+                for i in idxs:
+                    out[i] = lax.dynamic_slice_in_dim(red, off, sizes[i]) \
+                        .reshape(leaves[i].shape).astype(leaves[i].dtype)
+                    off += sizes[i]
             reduced = jax.tree_util.tree_unflatten(treedef, out)
+            if verify:
+                report = _merge_verify_reports(reports)
         else:
             reduced = grads
             if verify:
@@ -491,12 +552,15 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
             reduced = jax.tree.map(  # cpd: disable=kahan-ordering
                 lambda g: lax.psum(g, axis_name), grads)
         elif bucket:
-            reduced = _bucketed_quantized_sum(grads, axis_name, grad_exp,
-                                              grad_man, use_kahan,
-                                              wire=wire, key=k_sum)
+            reduced = _bucketed_quantized_sum(
+                grads, axis_name, grad_exp, grad_man, use_kahan,
+                bucket_elems=(bucket_elems if bucket_elems is not None
+                              else _BUCKET_ELEMS),
+                wire=wire, key=k_sum, starts=offset_starts)
         else:
             leaves, treedef = jax.tree_util.tree_flatten(grads)
-            starts = _leaf_starts(grads)
+            starts = (_leaf_starts(grads) if offset_starts is None
+                      else list(offset_starts))
             out = [quantized_sum(
                        _gather_leaf(g, axis_name, wire=wire),
                        grad_exp, grad_man, use_kahan, key=k_sum,
@@ -536,6 +600,25 @@ def _clean_verify_report() -> dict:
     return {"hop_bad": i0, "gather_bad": i0, "agree": i1, "ok": i1}
 
 
+def _merge_verify_reports(reports: list) -> dict:
+    """Merge per-bucket ring verification reports into one verdict:
+    mismatch COUNTS add, agreement ANDs, and ``ok`` is recomputed from
+    the merged fields — one corrupt bucket fails the step exactly as a
+    corrupt whole-tree ring did."""
+    if not reports:
+        return _clean_verify_report()
+    hop = sum((r["hop_bad"] for r in reports[1:]),
+              reports[0]["hop_bad"])
+    gather = sum((r["gather_bad"] for r in reports[1:]),
+                 reports[0]["gather_bad"])
+    agree = reports[0]["agree"]
+    for r in reports[1:]:
+        agree = jnp.minimum(agree, r["agree"])
+    return {"hop_bad": hop, "gather_bad": gather, "agree": agree,
+            "ok": ((hop == 0) & (gather == 0)
+                   & (agree == 1)).astype(jnp.int32)}
+
+
 def make_sum_gradients_fn(mesh: Mesh, axis_name: str = "data", **kwargs):
     """Standalone jitted ``stacked_grads -> reduced`` over `mesh.axis_name`.
 
@@ -563,7 +646,14 @@ def make_sum_gradients_fn(mesh: Mesh, axis_name: str = "data", **kwargs):
     jitted = LRUCache(maxsize=16)
 
     def reduced(stacked_grads):
-        treedef = jax.tree.structure(stacked_grads)
+        # the key carries the layout-affecting coordinates alongside the
+        # structure: a cached callable traced for one (mode, bucket
+        # layout) must never serve another (the PR 5 half-keyed-table
+        # bug class, extended to the bucket coordinate) — today they are
+        # per-instance constants, but the key is what guards tomorrow
+        treedef = (jax.tree.structure(stacked_grads),
+                   kwargs.get("mode", "faithful"),
+                   kwargs.get("bucket_elems"))
 
         def build():
             in_spec = jax.tree.map(lambda _: P(axis_name), stacked_grads)

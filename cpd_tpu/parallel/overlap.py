@@ -1,0 +1,408 @@
+"""Overlapped backward-reduce: bucketed, dependency-scheduled gradient
+transport (ISSUE 8; MLPerf TPU-pod bucketed gradient summation,
+PAPERS.md #4).
+
+Every reduction mode used to fire only after the ENTIRE backward pass had
+produced every gradient: the micro-batch ``lax.scan`` in the step builders
+emits all grads together, and the ring path additionally concatenates the
+whole tree into ONE flat vector — both are hard barriers, so XLA could
+never start a single collective hop while backward compute was still
+running.  This module removes the barrier:
+
+* :func:`bucket_layout` — the ONE greedy bucket-capping function, shared
+  with ``dist._bucketed_quantized_sum`` so the overlapped and the
+  post-backward bucketed paths can never disagree about the layout;
+* :class:`BucketPlan` — the static layout (leaf sizes, global flat
+  offsets in parallel/dist.py's `_leaf_starts` space, bucket membership)
+  plus a hashable ``key()`` for step-table cache keys
+  (resilience/precision.ladder_step_key's ``overlap`` coordinate);
+* :func:`overlapped_grads` — ``value_and_grad`` with per-bucket
+  ``jax.custom_vjp`` taps on the parameters: each bucket's tap is an
+  identity on the forward pass, and its BACKWARD rule runs that bucket's
+  quantized all-reduce (`dist.sum_gradients` on the bucket's sub-tree,
+  with the bucket's GLOBAL flat offsets) the moment autodiff closes the
+  bucket's last cotangent.  Late-layer buckets therefore finish their
+  reduction work while early-layer backward compute is still pending —
+  the dependency structure XLA's scheduler needs to overlap ring hops
+  with backward compute.  Verification / telemetry reports ride OUT of
+  the backward through the tap-cotangent channel (the
+  quant_function.quantizer_stats idiom): a zeros ``(n_buckets, R)``
+  input whose "gradient" is defined by the tap's bwd rule to be the
+  bucket's report vector;
+* :func:`overlap_evidence` — the crude overlap-actually-happened
+  assertion for CI: walks the traced step's jaxpr and counts matmul/conv
+  equations scheduled AFTER the first reduction collective.  The
+  monolithic step has none (every collective postdates all compute); the
+  tapped step interleaves them — a structural property of the emitted
+  program, not a timing flake.
+
+Bitwise contract: the overlapped result equals the non-overlapped one
+bit for bit.  The ordered quantized accumulation is elementwise across
+ranks, SR bits are indexed by GLOBAL flat offset, and Kahan compensation
+is per-element — so faithful/fast results are invariant to ANY bucket
+layout, and ring results are invariant to overlap on/off at a FIXED
+layout (``sum_gradients(mode="ring", bucket_elems=...)`` runs the same
+per-bucket rings post-backward; tests/test_overlap.py gates all of it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["bucket_layout", "BucketPlan", "overlapped_grads",
+           "overlap_evidence", "REPORT_FIELDS", "DEFAULT_BUCKET_ELEMS"]
+
+# One home for the default per-bucket element cap (dist.py re-exports it
+# as the faithful path's historical `_BUCKET_ELEMS`): W x 4M x 4B =
+# 128 MiB of gathered fp32 at W=8 — large enough to amortize collective
+# launch overhead, small enough that a bucket never rivals model memory
+# AND late buckets close early enough in the backward to overlap.
+DEFAULT_BUCKET_ELEMS = 4 * 1024 * 1024
+
+# Fixed slot order of the per-bucket report vector that rides the
+# tap-cotangent channel (float32; ints ride exactly up to 2^24).  The
+# wire layout prepends one internal "ran" slot (always 1 when the tap's
+# bwd executed): a bucket whose parameters the loss never touches has
+# its tap dead-code-eliminated by autodiff — its gradients are zeros
+# either way (reducing zeros yields zeros bitwise, so the data path is
+# unaffected), but its report row stays all-zero, and without the
+# sentinel the merged `agree` verdict would read a never-run bucket as
+# a cross-replica DISAGREEMENT (a permanent false-positive that would
+# livelock the transport ladder).
+REPORT_FIELDS = ("hop_bad", "gather_bad", "agree", "wire_sat",
+                 "wire_underflow", "wire_nan", "wire_total", "aps_bad")
+
+
+def bucket_layout(sizes: Sequence[int], bucket_elems: int,
+                  group_ids: Optional[Sequence] = None) -> list:
+    """Greedy bucket capping: split leaf indices into buckets of at most
+    ``bucket_elems`` total elements (a single leaf larger than the cap
+    forms its own bucket), preserving leaf order.  ``group_ids`` (e.g.
+    dtypes) force a bucket break between unequal neighbors — the faithful
+    gather path buckets per dtype because the gathered stack must be one
+    array.  This is THE layout function: `dist._bucketed_quantized_sum`,
+    the bucketed ring and the overlap taps all call it, so their bucket
+    boundaries cannot drift."""
+    if bucket_elems < 1:
+        raise ValueError(f"bucket_elems must be >= 1, got {bucket_elems}")
+    buckets: list = []
+    cur: list = []
+    cur_n = 0
+    cur_gid = None
+    for i, n in enumerate(sizes):
+        gid = None if group_ids is None else group_ids[i]
+        if cur and (cur_n + n > bucket_elems or gid != cur_gid):
+            buckets.append(cur)
+            cur, cur_n = [], 0
+        cur.append(i)
+        cur_n += int(n)
+        cur_gid = gid
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """Static bucket layout over one gradient pytree.
+
+    ``starts`` are GLOBAL flat offsets in tree_flatten order — the same
+    index space `dist._leaf_starts` defines and the SR bitstream is
+    indexed by, so a bucket's reduction draws exactly the bits the
+    whole-tree reduction would."""
+    sizes: tuple
+    starts: tuple
+    buckets: tuple          # tuple of tuples of leaf indices
+    bucket_elems: int
+
+    @classmethod
+    def for_tree(cls, tree: Any, bucket_elems: Optional[int] = None,
+                 group_by_dtype: bool = False) -> "BucketPlan":
+        be = (DEFAULT_BUCKET_ELEMS if bucket_elems is None
+              else int(bucket_elems))
+        if be < 1:
+            # fail HERE, at plan construction, not from bucket_layout
+            # deep inside jit tracing of a per-bucket reduce
+            raise ValueError(f"bucket_elems must be >= 1, got {be}")
+        leaves = jax.tree_util.tree_leaves(tree)
+        sizes = tuple(int(l.size) for l in leaves)
+        starts = tuple(int(s) for s in
+                       np.concatenate([[0], np.cumsum(sizes[:-1])])
+                       ) if sizes else ()
+        gids = ([str(jnp.dtype(l.dtype)) for l in leaves]
+                if group_by_dtype else None)
+        buckets = tuple(tuple(b) for b in bucket_layout(sizes, be, gids))
+        return cls(sizes=sizes, starts=starts, buckets=buckets,
+                   bucket_elems=be)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    def key(self) -> tuple:
+        """Hashable layout fingerprint for step-table cache keys: a step
+        traced for one layout must never be served for another (the PR 5
+        half-keyed-table bug class, now with a bucket coordinate)."""
+        return (self.bucket_elems, self.buckets)
+
+
+def _f0(x):
+    """A float0 zero cotangent for a non-differentiable (integer) tap
+    input — the tangent type JAX requires for int-dtype primals."""
+    return np.zeros(np.shape(x), jax.dtypes.float0)
+
+
+def _make_bucket_tap(reduce_bucket: Callable):
+    """One identity tap per bucket: ``tap(z, key, aux, *leaves)`` returns
+    the leaves unchanged; its bwd rule reduces the leaf cotangents with
+    `reduce_bucket` and returns the bucket's report vector as ``z``'s
+    cotangent.  ``key`` (uint32 PRNG key data, possibly a dummy) and
+    ``aux`` (float32 [sat_scale, wf_code, wf_rank]) are traced per-step
+    values that must ride as ARGUMENTS — custom_vjp cannot close over
+    tracers."""
+
+    @jax.custom_vjp
+    def tap(z, key, aux, *leaves):
+        return tuple(leaves)
+
+    def fwd(z, key, aux, *leaves):
+        return tuple(leaves), (key, aux)
+
+    def bwd(res, cots):
+        key, aux = res
+        reduced, report = reduce_bucket(list(cots), key, aux)
+        # slot 0 is the "ran" sentinel (see REPORT_FIELDS comment): it
+        # distinguishes a clean all-zero report from a tap autodiff
+        # never executed (all-unused bucket)
+        report = jnp.concatenate([jnp.ones((1,), jnp.float32), report])
+        return (report, _f0(key), jnp.zeros_like(aux), *reduced)
+
+    tap.defvjp(fwd, bwd)
+    return tap
+
+
+def overlapped_grads(loss_fn: Callable, params: Any, *,
+                     axis_name, plan: BucketPlan,
+                     reduce_kw: dict, key=None,
+                     sat_factor=None, wire_fault=None,
+                     verify: bool = False, stats: bool = False,
+                     leaf_pre: Optional[Callable] = None):
+    """``value_and_grad`` with per-bucket reduce-in-backward taps.
+
+    loss_fn(params) -> (loss, aux) — the scalar loss and auxiliary
+    outputs, exactly what the step builders pass to value_and_grad.
+    Returns ``((loss, aux), reduced_grads, report)`` where
+    ``reduced_grads`` is the FULLY REDUCED gradient pytree (bitwise equal
+    to ``sum_gradients(local_grads, ...)`` of the non-overlapped step)
+    and ``report`` is the merged verification/telemetry dict (None when
+    both ``verify`` and ``stats`` are off).
+
+    reduce_kw   → the `sum_gradients` precision/mode kwargs
+                  (use_aps/grad_exp/grad_man/use_kahan/mode/rounding).
+    key         → the shared reduction SR key (grad_sr_key site 1); the
+                  same key reaches every bucket — bits are global-offset
+                  indexed, so per-bucket draws equal the whole-tree draw.
+    sat_factor  → traced 2^k saturation-pressure scale applied to each
+                  cotangent BEFORE its bucket reduce (None = off; the
+                  FaultPlan ``sat_pressure`` attack keeps firing under
+                  the overlapped schedule).
+    wire_fault  → traced ``(code, rank)`` ring wire fault.  Injected
+                  into bucket 0 ONLY, so the deterministic chaos drills
+                  keep their exact expected counter values (one flip →
+                  hop_bad == 1) whatever the bucket count.
+    leaf_pre    → optional ``fn(cotangent, leaf_index)`` run on each leaf
+                  cotangent before the bucket reduce — the LM step's
+                  sp/tp psums, which in the monolithic step run between
+                  backward and the dp reduce.
+    """
+    from .dist import sum_gradients
+
+    leaves_t, treedef = jax.tree_util.tree_flatten(params)
+    if len(leaves_t) != len(plan.sizes):
+        raise ValueError(f"BucketPlan built for {len(plan.sizes)} leaves, "
+                         f"params have {len(leaves_t)}")
+    n_rep = len(REPORT_FIELDS)
+    has_key = key is not None
+    want_report = verify or stats
+
+    def make_reduce(b: int, idxs: tuple):
+        fault_armed = wire_fault is not None and b == 0
+
+        def reduce_bucket(gs, key_arr, aux):
+            # order matters and mirrors the monolith exactly: the sp/tp
+            # psums FIRST, the 2^k sat-pressure scale on the post-psum
+            # gradients second (scaling before the psum could overflow
+            # a per-rank value whose psum'd sum the monolith keeps
+            # finite — a bitwise divergence at the fp32 range edge)
+            if leaf_pre is not None:
+                gs = [leaf_pre(g, i) for g, i in zip(gs, idxs)]
+            if sat_factor is not None:
+                gs = [g * aux[0] for g in gs]
+            wf = ((aux[1].astype(jnp.int32), aux[2].astype(jnp.int32))
+                  if fault_armed else None)
+            out = sum_gradients(
+                list(gs), axis_name,
+                key=(key_arr if has_key else None),
+                verify=verify, stats=stats, wire_fault=wf,
+                offset_starts=[plan.starts[i] for i in idxs],
+                **reduce_kw)
+            if want_report:
+                out, rep = out
+                report = jnp.stack([
+                    rep.get(f, jnp.zeros([], jnp.float32))
+                    .astype(jnp.float32) for f in REPORT_FIELDS])
+            else:
+                report = jnp.zeros((n_rep,), jnp.float32)
+            return out, report
+
+        return reduce_bucket
+
+    taps = [_make_bucket_tap(make_reduce(b, idxs))
+            for b, idxs in enumerate(plan.buckets)]
+    key_arr = (jnp.asarray(key) if has_key
+               else jnp.zeros((2,), jnp.uint32))
+    aux = jnp.stack([
+        (jnp.asarray(sat_factor, jnp.float32) if sat_factor is not None
+         else jnp.float32(1.0)),
+        (wire_fault[0].astype(jnp.float32) if wire_fault is not None
+         else jnp.float32(0.0)),
+        (wire_fault[1].astype(jnp.float32) if wire_fault is not None
+         else jnp.float32(0.0))])
+
+    def inner(p, z):
+        leaves = list(jax.tree_util.tree_flatten(p)[0])
+        for b, idxs in enumerate(plan.buckets):
+            outs = taps[b](z[b], key_arr, aux, *[leaves[i] for i in idxs])
+            for j, i in enumerate(idxs):
+                leaves[i] = outs[j]
+        return loss_fn(jax.tree_util.tree_unflatten(treedef, leaves))
+
+    z0 = jnp.zeros((plan.n_buckets, n_rep + 1), jnp.float32)
+    (loss, aux_out), (g_params, g_z) = jax.value_and_grad(
+        inner, argnums=(0, 1), has_aux=True)(params, z0)
+
+    report = None
+    if want_report and plan.n_buckets == 0:
+        report = {"hop_bad": jnp.zeros([], jnp.int32),
+                  "gather_bad": jnp.zeros([], jnp.int32),
+                  "agree": jnp.ones([], jnp.int32),
+                  "ok": jnp.ones([], jnp.int32)} if verify else {}
+        if stats:
+            report.update({f: jnp.zeros([], jnp.float32)
+                           for f in ("wire_sat", "wire_underflow",
+                                     "wire_nan", "wire_total")})
+            report["aps_bad"] = jnp.zeros([], jnp.int32)
+    elif want_report:
+        ran = g_z[:, 0]
+        cols = {f: g_z[:, i + 1] for i, f in enumerate(REPORT_FIELDS)}
+        report = {}
+        if verify:
+            hop_bad = jnp.sum(cols["hop_bad"]).astype(jnp.int32)
+            gather_bad = jnp.sum(cols["gather_bad"]).astype(jnp.int32)
+            # a never-run bucket (ran == 0) reduced nothing — its wire
+            # is vacuously clean, not a disagreement
+            agree = jnp.min(jnp.where(ran > 0, cols["agree"], 1.0)
+                            ).astype(jnp.int32)
+            report.update(
+                hop_bad=hop_bad, gather_bad=gather_bad, agree=agree,
+                ok=((hop_bad == 0) & (gather_bad == 0)
+                    & (agree == 1)).astype(jnp.int32))
+        if stats:
+            for f in ("wire_sat", "wire_underflow", "wire_nan",
+                      "wire_total"):
+                report[f] = jnp.sum(cols[f])
+            # a never-run bucket's gradients are exact zeros; the
+            # monolith's probe still CASTS and COUNTS them (zeros fit
+            # every format: 0 sat/underflow/nan, n*W total).  Credit the
+            # dead buckets' element counts so wire_total — the
+            # precision supervisor's rate denominator — is identical
+            # under either schedule.
+            from jax import lax
+            sizes_b = jnp.asarray(
+                [sum(plan.sizes[i] for i in idxs)
+                 for idxs in plan.buckets], jnp.float32)
+            world = lax.psum(jnp.float32(1.0), axis_name)
+            report["wire_total"] = report["wire_total"] + world * jnp.sum(
+                jnp.where(ran > 0, 0.0, sizes_b))
+            report["aps_bad"] = jnp.sum(cols["aps_bad"]).astype(jnp.int32)
+    return (loss, aux_out), g_params, report
+
+
+# ---------------------------------------------------------------------------
+# overlap evidence (CI's crude "overlap actually happened" assertion)
+# ---------------------------------------------------------------------------
+
+# the gradient-TRANSPORT collectives: ppermute (ring hops) and
+# all_gather (gather path / ring rebuild).  psum is deliberately absent —
+# scalar bookkeeping (world size, loss metrics) and the LM's FORWARD
+# tensor-parallel psums would otherwise read as transport.
+_COLLECTIVE_PRIMS = {"ppermute", "all_gather"}
+_COMPUTE_PRIMS = {"conv_general_dilated", "dot_general"}
+
+
+def _walk_eqns(jaxpr, out: list):
+    """Flatten a jaxpr's equations depth-first in emission order —
+    equations are topologically ordered as traced, so relative positions
+    reflect the dependency structure XLA schedules from.  Each entry is
+    ``(primitive_name, max_operand_elems)``."""
+    for eqn in jaxpr.eqns:
+        size = 0
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                size = max(size, int(np.prod(aval.shape))
+                           if aval.shape else 1)
+        out.append((eqn.primitive.name, size))
+        for v in eqn.params.values():
+            if isinstance(v, jax.core.ClosedJaxpr):
+                _walk_eqns(v.jaxpr, out)
+            elif isinstance(v, jax.core.Jaxpr):
+                _walk_eqns(v, out)
+            elif isinstance(v, (tuple, list)):
+                for w in v:
+                    if isinstance(w, jax.core.ClosedJaxpr):
+                        _walk_eqns(w.jaxpr, out)
+                    elif isinstance(w, jax.core.Jaxpr):
+                        _walk_eqns(w, out)
+    return out
+
+
+def overlap_evidence(fn: Callable, *args,
+                     min_collective_elems: int = 2) -> dict:
+    """Trace ``fn(*args)`` and report how much matmul/conv compute the
+    program is free to schedule AFTER its first payload-bearing
+    reduction collective.
+
+    ``compute_after_first_collective == 0`` means every gradient
+    collective postdates all compute — the post-backward monolith (no
+    overlap possible).  A positive count is the structural signature of
+    the bucketed schedule: bucket k's ring hops are emitted while bucket
+    k+1's backward matmuls are still pending, so the compiler MAY
+    overlap them.  Collectives moving fewer than
+    ``min_collective_elems`` elements are ignored — the world-size psum,
+    loss/metric psums and the APS per-leaf exponent pmax are scalar
+    bookkeeping, not gradient transport.  This checks the emitted
+    dependency order, not wall-clock — a loaded CI box cannot flake
+    it."""
+    prims = _walk_eqns(jax.make_jaxpr(fn)(*args).jaxpr, [])
+    first_coll = None
+    compute_positions = []
+    n_coll = 0
+    for i, (p, size) in enumerate(prims):
+        if p in _COLLECTIVE_PRIMS and size >= min_collective_elems:
+            n_coll += 1
+            if first_coll is None:
+                first_coll = i
+        elif p in _COMPUTE_PRIMS:
+            compute_positions.append(i)
+    after = (0 if first_coll is None else
+             sum(1 for i in compute_positions if i > first_coll))
+    return {"collectives": n_coll,
+            "compute_eqns": len(compute_positions),
+            "compute_after_first_collective": after,
+            "interleaved": after > 0}

@@ -84,6 +84,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..quant.numerics import (cast_to_format, cast_to_format_sr_at,
@@ -92,7 +93,8 @@ from ..quant.numerics import (cast_to_format, cast_to_format_sr_at,
 
 __all__ = ["ring_quantized_sum", "ring_oracle_sum", "ring_transport_bytes",
            "gather_transport_bytes", "transport_table", "pad_to_world",
-           "ring_chunk_size"]
+           "ring_chunk_size", "hierarchical_ring_sum",
+           "ring_oracle_sum_multi"]
 
 
 def ring_chunk_size(n: int, world: int) -> int:
@@ -192,7 +194,8 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
                        fused: Optional[bool] = None,
                        interpret: bool = False,
                        verify: bool = False,
-                       fault: Optional[tuple] = None):
+                       fault: Optional[tuple] = None,
+                       offsets: Optional[jnp.ndarray] = None):
     """Ordered quantized SUM of per-rank flat fp32 vectors over `axis_name`
     via a ppermute ring — call inside shard_map.
 
@@ -210,6 +213,12 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
                    (8, 23) (4-byte code words).
     offset_start → global flat offset of flat[0] in the SR bit-index space
                    (parallel/dist.py's `_leaf_starts` space).
+    offsets      → full per-element (n,) uint32 global offsets, for flats
+                   that are NOT contiguous in the global space (a bucket
+                   spanning non-adjacent leaves — parallel/dist.py's
+                   bucketed ring).  Overrides ``offset_start``.  Pad
+                   elements are exact zeros, whose cast is rounding-
+                   invariant, so their (arbitrary) offsets never matter.
     world        → static axis size; default reads it from the axis.
     fused        → use the fused Pallas quantize-accumulate hop kernel
                    (ops/quantize.quantize_add_pallas; plain path only —
@@ -245,6 +254,12 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
 
     padded = pad_to_world(flat, w)
     chunk = padded.shape[0] // w if w else 0
+    padded_offs = None
+    if offsets is not None:
+        if offsets.shape != (n,):
+            raise ValueError(f"offsets must be shape ({n},), got "
+                             f"{offsets.shape}")
+        padded_offs = pad_to_world(offsets.astype(jnp.uint32), w)
     if n == 0:
         if verify:
             i0, i1 = jnp.zeros([], jnp.int32), jnp.ones([], jnp.int32)
@@ -263,6 +278,9 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
         return lax.dynamic_slice_in_dim(padded, c * chunk, chunk)
 
     def offs_of(c):
+        if padded_offs is not None:
+            return lax.dynamic_slice_in_dim(
+                padded_offs, c.astype(jnp.int32) * chunk, chunk)
         return (jnp.uint32(offset_start)
                 + c.astype(jnp.uint32) * jnp.uint32(chunk)
                 + jnp.arange(chunk, dtype=jnp.uint32))
@@ -386,7 +404,8 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
 
 def ring_oracle_sum(stacked: jnp.ndarray, exp: int, man: int, *,
                     use_kahan: bool = False, key=None,
-                    offset_start: int = 0) -> jnp.ndarray:
+                    offset_start: int = 0,
+                    offsets: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Single-device oracle for the ring transport: given the stacked
     per-rank contributions (W, *shape), reproduce `ring_quantized_sum`'s
     result bit-for-bit — the per-chunk rank rotation, the per-hop casts
@@ -411,9 +430,13 @@ def ring_oracle_sum(stacked: jnp.ndarray, exp: int, man: int, *,
     c_idx = jnp.arange(w)[None, :]
     order = jnp.mod(c_idx + 1 + t_idx, w)          # [hop, chunk]
     hops = per_chunk[order, c_idx, :]              # [hop, chunk, elem]
-    offs = (jnp.uint32(offset_start)
-            + (c_idx.astype(jnp.uint32) * jnp.uint32(chunk))[..., None]
-            + jnp.arange(chunk, dtype=jnp.uint32)[None, None, :])[0]
+    if offsets is not None:
+        offs = jnp.pad(offsets.astype(jnp.uint32).reshape(-1),
+                       (0, w * chunk - n)).reshape(w, chunk)
+    else:
+        offs = (jnp.uint32(offset_start)
+                + (c_idx.astype(jnp.uint32) * jnp.uint32(chunk))[..., None]
+                + jnp.arange(chunk, dtype=jnp.uint32)[None, None, :])[0]
     q = _make_hop_q(exp, man, key)
     fp32_shortcut = exp == 8 and man == 23 and not use_kahan
 
@@ -430,6 +453,128 @@ def ring_oracle_sum(stacked: jnp.ndarray, exp: int, man: int, *,
     (res, _), _ = lax.scan(
         body, (zero, zero), (jnp.arange(w, dtype=jnp.int32), hops))
     return res.reshape(-1)[:n].reshape(shape)
+
+
+def hierarchical_ring_sum(flat: jnp.ndarray, axis_names, exp: int, man: int,
+                          *, use_kahan: bool = False, key=None,
+                          offset_start: int = 0,
+                          offsets: Optional[jnp.ndarray] = None,
+                          packed: bool = True,
+                          fused: Optional[bool] = None,
+                          interpret: bool = False,
+                          verify: bool = False,
+                          fault: Optional[tuple] = None):
+    """Ring all-reduce composed over one OR several mesh axes.
+
+    A single axis (plain string, or a 1-tuple) is exactly
+    `ring_quantized_sum` — same bits, same program.  For k > 1 axes the
+    reduction runs as k sequential per-axis rings, INNERMOST (last-named)
+    axis first: per the mesh convention (parallel/mesh.py) the last axis
+    is the fastest ICI ring, so the large fan-in happens on the cheap
+    wire and the outer axes ring over already-reduced partials — the
+    hierarchical intra-axis-then-inter-axis reduce of the MLPerf TPU-pod
+    recipe (PAPERS.md #4).  Stage ``s`` reduces over ``axes[-1-s]`` with
+    SR key ``fold_in(key, s)`` (stages must draw independent bits — the
+    same (hop, site, offset) indices recur at every stage), and the
+    result is the per-axis composition of the documented per-chunk rank
+    rotation — reproduced bit-for-bit by `ring_oracle_sum_multi`.
+
+    verify → every stage runs the self-verifying transport; the merged
+    report sums ``hop_bad`` / ``gather_bad`` across all rings of all
+    stages (psum over the non-stage axes makes the totals replicated),
+    ANDs the per-stage agreement verdicts, and adds a FINAL cross-mesh
+    agreement digest over every axis at once — a divergence introduced
+    between stages (or on the last gather wire) cannot hide in a
+    single-axis check.
+
+    fault → injected into stage 0 only, and only on the one stage-0 ring
+    whose other-axes indices are all zero: exactly ONE corruption fires,
+    so the chaos drills' exact counter expectations (one flip →
+    hop_bad == 1) hold on any mesh shape.
+    """
+    axes = ((axis_names,) if isinstance(axis_names, str)
+            else tuple(axis_names))
+    if not axes:
+        raise ValueError("hierarchical_ring_sum needs at least one axis")
+    kw = dict(use_kahan=use_kahan, offset_start=offset_start,
+              offsets=offsets, packed=packed, fused=fused,
+              interpret=interpret)
+    if len(axes) == 1:
+        return ring_quantized_sum(flat, axes[0], exp, man, key=key,
+                                  verify=verify, fault=fault, **kw)
+
+    vec = flat
+    stage_reports = []
+    for s in range(len(axes)):
+        ax = axes[-1 - s]
+        k_s = None if key is None else jax.random.fold_in(key, s)
+        f_s = None
+        if fault is not None and s == 0:
+            on_slice = jnp.int32(1)
+            for other in axes[:-1]:
+                on_slice = on_slice * (
+                    lax.axis_index(other) == 0).astype(jnp.int32)
+            f_s = (jnp.where(on_slice == 1,
+                             jnp.asarray(fault[0], jnp.int32),
+                             jnp.int32(0)),
+                   jnp.asarray(fault[1], jnp.int32))
+        out = ring_quantized_sum(vec, ax, exp, man, key=k_s,
+                                 verify=verify, fault=f_s, **kw)
+        if verify:
+            vec, rep = out
+            stage_reports.append((ax, rep))
+        else:
+            vec = out
+    if not verify:
+        return vec
+
+    from .integrity import digest_agree, wire_digest
+    hop_bad = jnp.zeros([], jnp.int32)
+    gather_bad = jnp.zeros([], jnp.int32)
+    agree = jnp.ones([], jnp.int32)
+    for ax, rep in stage_reports:
+        other = tuple(a for a in axes if a != ax)
+        hop_bad = hop_bad + lax.psum(rep["hop_bad"], other)
+        gather_bad = gather_bad + lax.psum(rep["gather_bad"], other)
+        agree = jnp.minimum(agree, lax.pmin(rep["agree"], other))
+    agree = jnp.minimum(agree, digest_agree(wire_digest(vec), axes))
+    report = {"hop_bad": hop_bad, "gather_bad": gather_bad,
+              "agree": agree}
+    report["ok"] = ((hop_bad == 0) & (gather_bad == 0)
+                    & (agree == 1)).astype(jnp.int32)
+    return vec, report
+
+
+def ring_oracle_sum_multi(stacked: jnp.ndarray, n_axes: int, exp: int,
+                          man: int, *, use_kahan: bool = False, key=None,
+                          offset_start: int = 0,
+                          offsets: Optional[jnp.ndarray] = None
+                          ) -> jnp.ndarray:
+    """Single-device oracle for `hierarchical_ring_sum`: ``stacked`` has
+    shape ``(W_0, ..., W_{k-1}, *leaf)`` with the leading dims in mesh
+    AXIS-NAME order; the reduction folds the LAST leading axis first
+    (the innermost mesh axis), stage ``s`` drawing SR bits from
+    ``fold_in(key, s)`` — exactly the distributed composition.  With
+    ``n_axes == 1`` this is `ring_oracle_sum` (unfolded key, the legacy
+    single-axis bitstream)."""
+    if n_axes < 1 or stacked.ndim < n_axes:
+        raise ValueError(f"n_axes={n_axes} does not fit stacked shape "
+                         f"{stacked.shape}")
+    kw = dict(use_kahan=use_kahan, offset_start=offset_start,
+              offsets=offsets)
+    if n_axes == 1:
+        return ring_oracle_sum(stacked, exp, man, key=key, **kw)
+    vec = stacked
+    for s in range(n_axes):
+        k_s = None if key is None else jax.random.fold_in(key, s)
+        lead = vec.shape[:n_axes - s]
+        tail = vec.shape[n_axes - s:]
+        rest = int(np.prod(lead[:-1])) if lead[:-1] else 1
+        flat = vec.reshape((rest, lead[-1]) + tail)
+        red = jax.vmap(lambda st, k=k_s: ring_oracle_sum(
+            st, exp, man, key=k, **kw))(flat)
+        vec = red.reshape(lead[:-1] + tail)
+    return vec
 
 
 def ring_transport_bytes(n: int, world: int, exp: int, man: int, *,

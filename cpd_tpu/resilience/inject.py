@@ -261,7 +261,19 @@ class FaultPlan:
         in-jit wire faults; entry ``i`` drives optimizer update ``i``
         (the same clock as `grad_schedule`).  ``arg`` is the target
         rank (-1 -> rank 0); at most one wire fault per step (the last
-        spec wins)."""
+        spec wins).
+
+        Bucketed / overlapped transports (``bucket_elems`` /
+        ``overlap_reduce``, ISSUE 8): the table is still indexed by the
+        optimizer-update clock — NOT by ring-call count — because the
+        step builders bake ONE lookup per step and `sum_gradients`
+        applies the fault to bucket 0 only (and, on a multi-axis mesh,
+        to the single stage-0 ring whose other-axes indices are zero).
+        A step's fault therefore fires exactly once however many
+        per-bucket rings the schedule launches, keeping the chaos
+        drills' exact counter expectations (one flip -> hop_bad == 1)
+        and `report_unfired`'s fired/unfired accounting layout-free
+        (covered in tests/test_overlap.py)."""
         codes = np.zeros((max(n_steps, 1),), np.int32)
         ranks = np.zeros((max(n_steps, 1),), np.int32)
         for f in self.wire_faults():

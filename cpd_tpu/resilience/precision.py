@@ -110,7 +110,7 @@ def parse_ladder(text) -> tuple:
     return fmts
 
 
-def ladder_step_key(transport=None, precision=None):
+def ladder_step_key(transport=None, precision=None, overlap=None):
     """The ONE `StepTable` key derivation shared by `run_guarded` and
     the trainer CLIs, covering every supervisor combination:
 
@@ -118,24 +118,41 @@ def ladder_step_key(transport=None, precision=None):
       precision only          -> the (exp, man) format tuple
       both                    -> (level, (exp, man))
       neither                 -> None (caller uses its fixed step)
-    """
+
+    ``overlap``, when given, is a ``(overlap_reduce, bucket_elems)``
+    pair appended as an explicit key coordinate (ISSUE 8): a step traced
+    with the overlapped transport / one bucket layout must never be
+    served to a configuration without it after a ladder transition — the
+    PR 5 half-keyed-table bug class, extended to the transport schedule.
+    Callers whose run has NO overlap surface pass None and keep the
+    PR 4/5-compatible key shapes."""
     if transport is not None and precision is not None:
-        return (transport.mode, precision.fmt)
-    if precision is not None:
-        return precision.fmt
-    if transport is not None:
-        return transport.mode
-    return None
+        base = (transport.mode, precision.fmt)
+    elif precision is not None:
+        base = precision.fmt
+    elif transport is not None:
+        base = transport.mode
+    else:
+        base = None
+    if overlap is None:
+        return base
+    return (base, ("overlap",) + tuple(overlap))
 
 
 def resolve_ladder_key(key, *, transport_on: bool, precision_on: bool,
-                       level: str, fmt: tuple) -> tuple:
+                       level: str, fmt: tuple,
+                       overlap_on: bool = False) -> tuple:
     """Inverse of `ladder_step_key` for StepTable build functions: map a
     table key back to ``(transport_level, (exp, man))``, filling the
     coordinate a missing supervisor pins from the run's static config
     (``level`` = the configured --mode, ``fmt`` = the configured
     gradient format).  The ONE unpacking shared by the trainer CLIs so
-    the three-way branch cannot drift between them."""
+    the three-way branch cannot drift between them.  ``overlap_on``
+    strips the key's ``("overlap", ...)`` coordinate first (the builder
+    reads the overlap config from its static flags — the coordinate
+    exists to split the CACHE, not to carry data)."""
+    if overlap_on:
+        key = key[0]
     if transport_on and precision_on:
         return key
     if transport_on:

@@ -58,7 +58,9 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        verify_reduce: bool = False,
                        wire_fault_plan=None,
                        quant_stats: bool = False,
-                       sat_fault_plan=None):
+                       sat_fault_plan=None,
+                       overlap_reduce: bool = False,
+                       bucket_elems=None):
     """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
 
     tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
@@ -78,12 +80,27 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     2^k saturation-pressure table, exactly as on `make_train_step` —
     the pressure scales the post-sp/tp-psum local gradients, so every
     dp rank's wire cast sees it identically.
+
+    overlap_reduce / bucket_elems: the bucketed, dependency-scheduled
+    transport, exactly as on `make_train_step` (parallel/overlap.py) —
+    per-bucket taps run the dp reduction inside the backward; each
+    leaf's sp psum (and tp psum for replicated params) moves INTO its
+    bucket's tap, so the whole per-leaf reduction chain starts when
+    that bucket closes.  Bitwise identical to the monolithic step;
+    requires emulate_node == 1.
     """
     if not 0.0 <= label_smoothing < 1.0:
         raise ValueError(f"label_smoothing must be in [0, 1), got "
                          f"{label_smoothing}")
     if grad_rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
+    if overlap_reduce and emulate_node != 1:
+        raise ValueError(
+            f"overlap_reduce=True requires emulate_node == 1 (got "
+            f"{emulate_node}): the micro-batch scan is a barrier that "
+            f"defeats the overlapped schedule, and in-backward taps "
+            f"would reduce once per micro-batch instead of once per "
+            f"step")
     # Guard: the optimizer update runs shard-local, which is only exact for
     # elementwise transforms (see reject_norm_based).  With tp=1 all params
     # are replicated and grads fully reduced before the update, so
@@ -140,18 +157,6 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
 
         n = emulate_node
         mb = tokens.shape[0] // n
-        toks = tokens.reshape(n, mb, tokens.shape[1])
-        tgts = targets.reshape(n, mb, targets.shape[1])
-
-        def micro(micro_idx, xy):
-            tk, tg = xy
-            (_, aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(state.params, tk, tg, micro_idx)
-            return micro_idx + 1, (grads, *aux)
-
-        _, (stacked, sums, ns, hits) = lax.scan(
-            micro, jnp.zeros([], jnp.int32), (toks, tgts))
-
         # --- cross-axis gradient reduction (see module docstring) ---
         specs = lm_param_specs(state.params, axis_tp)
 
@@ -161,14 +166,6 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 g = lax.psum(g, axis_tp)
             return g
 
-        stacked = jax.tree.map(sp_tp_reduce, stacked, specs)
-        if sat_fault_plan is not None:
-            # saturation-pressure attack (resilience/inject.py
-            # `sat_pressure`): 2^k exact power-of-two scaling, shared
-            # lookup (see make_train_step)
-            from ..resilience.inject import sat_pressure_factor
-            sfac = sat_pressure_factor(sat_fault_plan, state.step)
-            stacked = jax.tree.map(lambda g: g * sfac, stacked)
         # SR keys (grad_rounding='stochastic'): the rank-local emulate key
         # folds ONLY the dp index — post-psum grads are identical across
         # sp (and across tp for replicated params), so sp/tp copies must
@@ -176,13 +173,7 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         # dp ranks hold different grads and decorrelate (see
         # parallel/dist.py on coherent rounding error).
         sr = grad_rounding == "stochastic"
-        local = emulate_node_reduce(
-            stacked, n, use_aps, grad_exp, grad_man,
-            rounding=grad_rounding,
-            key=jax.random.fold_in(
-                grad_sr_key(grad_seed, state.step, 0),
-                lax.axis_index(axis_dp).astype(jnp.int32)) if sr
-            else None)
+        sum_key = grad_sr_key(grad_seed, state.step, 1) if sr else None
         wf = None
         if wire_fault_plan is not None and mode == "ring":
             codes = jnp.asarray(wire_fault_plan[0], jnp.int32)
@@ -190,15 +181,78 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             idx = jnp.clip(state.step, 0, codes.shape[0] - 1)
             wf = (jnp.where(state.step < codes.shape[0], codes[idx], 0),
                   ranks[idx])
+        sfac = None
+        if sat_fault_plan is not None:
+            # saturation-pressure attack (resilience/inject.py
+            # `sat_pressure`): 2^k exact power-of-two scaling, shared
+            # lookup (see make_train_step)
+            from ..resilience.inject import sat_pressure_factor
+            sfac = sat_pressure_factor(sat_fault_plan, state.step)
         vreport = None
-        reduced = sum_gradients(
-            local, axis_dp, use_aps=use_aps,
-            grad_exp=grad_exp, grad_man=grad_man,
-            use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
-            key=grad_sr_key(grad_seed, state.step, 1) if sr else None,
-            verify=verify_reduce, wire_fault=wf, stats=quant_stats)
-        if verify_reduce or quant_stats:
-            reduced, vreport = reduced
+        if overlap_reduce:
+            # Bucketed dependency-scheduled transport (parallel/
+            # overlap.py): per-bucket taps own the WHOLE per-leaf
+            # reduction chain — sp psum, tp psum for replicated params
+            # (leaf_pre), sat pressure, then the dp quantized collective
+            # — so a bucket's work starts the moment its last cotangent
+            # closes.  Bitwise identical to the monolithic path below.
+            from ..parallel.overlap import BucketPlan, overlapped_grads
+            plan = BucketPlan.for_tree(state.params, bucket_elems)
+            specs_flat = jax.tree_util.tree_flatten(
+                specs, is_leaf=lambda s: isinstance(s, P))[0]
+
+            def leaf_pre(g, i):
+                return sp_tp_reduce(g, specs_flat[i])
+
+            def loss_closure(p):
+                loss, aux = loss_of(p, tokens, targets,
+                                    jnp.zeros([], jnp.int32))
+                return loss, aux
+
+            ((_, (l_sum, l_n, l_hits)), reduced,
+             vreport) = overlapped_grads(
+                loss_closure, state.params, axis_name=axis_dp, plan=plan,
+                reduce_kw=dict(use_aps=use_aps, grad_exp=grad_exp,
+                               grad_man=grad_man, use_kahan=use_kahan,
+                               mode=mode, rounding=grad_rounding,
+                               bucket_elems=bucket_elems),
+                key=sum_key, sat_factor=sfac, wire_fault=wf,
+                verify=verify_reduce, stats=quant_stats,
+                leaf_pre=leaf_pre)
+            sums = l_sum[None]
+            ns = l_n[None]
+            hits = l_hits[None]
+        else:
+            toks = tokens.reshape(n, mb, tokens.shape[1])
+            tgts = targets.reshape(n, mb, targets.shape[1])
+
+            def micro(micro_idx, xy):
+                tk, tg = xy
+                (_, aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(state.params, tk, tg, micro_idx)
+                return micro_idx + 1, (grads, *aux)
+
+            _, (stacked, sums, ns, hits) = lax.scan(
+                micro, jnp.zeros([], jnp.int32), (toks, tgts))
+
+            stacked = jax.tree.map(sp_tp_reduce, stacked, specs)
+            if sfac is not None:
+                stacked = jax.tree.map(lambda g: g * sfac, stacked)
+            local = emulate_node_reduce(
+                stacked, n, use_aps, grad_exp, grad_man,
+                rounding=grad_rounding,
+                key=jax.random.fold_in(
+                    grad_sr_key(grad_seed, state.step, 0),
+                    lax.axis_index(axis_dp).astype(jnp.int32)) if sr
+                else None)
+            reduced = sum_gradients(
+                local, axis_dp, use_aps=use_aps,
+                grad_exp=grad_exp, grad_man=grad_man,
+                use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
+                key=sum_key, verify=verify_reduce, wire_fault=wf,
+                stats=quant_stats, bucket_elems=bucket_elems)
+            if verify_reduce or quant_stats:
+                reduced, vreport = reduced
 
         updates, new_opt = tx.update(reduced, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -109,7 +109,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     verify_reduce: bool = False,
                     wire_fault_plan: Optional[tuple] = None,
                     quant_stats: bool = False,
-                    sat_fault_plan: Optional[Any] = None):
+                    sat_fault_plan: Optional[Any] = None,
+                    overlap_reduce: bool = False,
+                    bucket_elems: Optional[int] = None):
     """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
 
     images: (global_batch * emulate_node, H, W, C) sharded over `axis_name`;
@@ -152,6 +154,25 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     the quantized collective, deterministically driving the wire cast
     into saturation (the attack the ladder is exercised against; 0 =
     off, and scaling by 2^0 == 1.0 is an exact fp32 no-op).
+
+    overlap_reduce=True replaces the post-backward reduction monolith
+    with the bucketed, dependency-scheduled transport
+    (parallel/overlap.py): per-bucket custom_vjp taps on the parameters
+    run each bucket's quantized all-reduce INSIDE the backward pass, the
+    moment that bucket's last gradient closes — late-layer buckets ring
+    while early-layer backward compute is still pending, which is the
+    dependency structure XLA needs to overlap collectives with compute
+    (MLPerf TPU-pod bucketed gradient summation, PAPERS.md #4).  The
+    reduced gradients — and therefore the updated parameters — are
+    BITWISE identical to the non-overlapped step (tests/test_overlap.py);
+    verify/stats reports ride out of the backward on the tap-cotangent
+    channel, and sat_pressure / wire faults keep firing (wire faults hit
+    bucket 0 only, preserving exact drill counters).  Requires
+    emulate_node == 1 (the micro-batch scan is itself a barrier — and
+    its taps would otherwise reduce once per micro-batch) and the step's
+    own collective (not reduce_in_update).  bucket_elems caps the bucket
+    size for BOTH the overlapped taps and the post-backward
+    bucketed/ring layouts (default: parallel/dist._BUCKET_ELEMS).
     """
     if grad_rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
@@ -183,7 +204,54 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                          "sum_gradients call; reduce_in_update hands the "
                          "collective to the updater (ZeRO-2/3), which "
                          "does not thread a telemetry report")
+    if overlap_reduce and emulate_node != 1:
+        raise ValueError(
+            f"overlap_reduce=True requires emulate_node == 1 (got "
+            f"{emulate_node}): the micro-batch scan is a barrier that "
+            f"defeats the overlapped schedule, and in-backward taps "
+            f"would reduce once per micro-batch instead of once per "
+            f"step")
+    if overlap_reduce and reduce_in_update:
+        raise ValueError("overlap_reduce=True runs the collective inside "
+                         "the backward taps; reduce_in_update hands it "
+                         "to the updater (ZeRO-2/3) — pick one owner")
     has_stats_cache: dict = {}
+
+    def make_loss_of(world, scale):
+        """The per-micro-batch loss closure — ONE definition feeding both
+        the scan path and the overlapped-taps path, so their numerics
+        cannot drift."""
+
+        def loss_of(p, stats, x, y, rngs):
+            variables = {"params": p}
+            kwargs = {"rngs": rngs} if rngs else {}
+            has_stats = bool(jax.tree.leaves(stats))
+            if has_stats:
+                variables["batch_stats"] = stats
+                logits, mut = model.apply(variables, x, train=True,
+                                          mutable=["batch_stats"], **kwargs)
+                new_stats = mut["batch_stats"]
+            else:
+                logits = model.apply(variables, x, train=True, **kwargs)
+                new_stats = stats
+            loss = loss_fn(logits, y) / (world * emulate_node)  # mix.py:239
+            return loss * scale, (logits, new_stats, loss)
+
+        return loss_of
+
+    def micro_rngs(step, micro_idx):
+        """Per-micro-step stream rngs (dropout etc.), deterministic in
+        (rng_seed, replica, global step, micro index) — the replica fold
+        keeps dropout masks decorrelated across data-parallel shards
+        (one rng stream per rank, as torch DDP gives)."""
+        if not rng_keys:
+            return {}
+        base = jax.random.fold_in(jax.random.PRNGKey(rng_seed),
+                                  step * emulate_node + micro_idx)
+        base = jax.random.fold_in(
+            base, lax.axis_index(axis_name).astype(jnp.int32))
+        return {k: jax.random.fold_in(base, i)
+                for i, k in enumerate(rng_keys)}
 
     def local_micro_grads(params, batch_stats, images, labels, world, step,
                           scale):
@@ -200,53 +268,28 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         mb = images.shape[0] // n
         images = images.reshape(n, mb, *images.shape[1:])
         labels = labels.reshape(n, mb, *labels.shape[1:])
-
-        def loss_of(p, stats, x, y, rngs):
-            variables = {"params": p}
-            kwargs = {"rngs": rngs} if rngs else {}
-            has_stats = bool(jax.tree.leaves(stats))
-            if has_stats:
-                variables["batch_stats"] = stats
-                logits, mut = model.apply(variables, x, train=True,
-                                          mutable=["batch_stats"], **kwargs)
-                new_stats = mut["batch_stats"]
-            else:
-                logits = model.apply(variables, x, train=True, **kwargs)
-                new_stats = stats
-            loss = loss_fn(logits, y) / (world * n)          # mix.py:239
-            return loss * scale, (logits, new_stats, loss)
+        loss_of = make_loss_of(world, scale)
 
         def micro(carry, xy):
             stats, micro_idx = carry
             x, y = xy
-            # Per-micro-step stream rngs (dropout etc.), deterministic in
-            # (rng_seed, replica, global step, micro index) — the replica
-            # fold keeps dropout masks decorrelated across data-parallel
-            # shards (one rng stream per rank, as torch DDP gives).
-            rngs = {}
-            if rng_keys:
-                base = jax.random.fold_in(jax.random.PRNGKey(rng_seed),
-                                          step * n + micro_idx)
-                base = jax.random.fold_in(
-                    base, lax.axis_index(axis_name).astype(jnp.int32))
-                rngs = {k: jax.random.fold_in(base, i)
-                        for i, k in enumerate(rng_keys)}
+            rngs = micro_rngs(step, micro_idx)
             (_, (logits, new_stats, loss)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params, stats, x, y, rngs)
-            hit = jnp.argmax(_main_logits(logits), -1) == y
-            if ignore_label is not None:
-                valid = y != ignore_label
-                correct = jnp.sum(hit & valid)
-                counted = jnp.sum(valid)
-            else:
-                correct = jnp.sum(hit)
-                counted = jnp.asarray(y.size)
+            correct, counted = _count_hits(logits, y)
             return (new_stats, micro_idx + 1), (grads, loss, correct, counted)
 
         (final_stats, _), (stacked_grads, losses, corrects, counts) = lax.scan(
             micro, (batch_stats, jnp.zeros([], jnp.int32)), (images, labels))
         return (stacked_grads, final_stats, losses.sum(), corrects.sum(),
                 counts.sum())
+
+    def _count_hits(logits, y):
+        hit = jnp.argmax(_main_logits(logits), -1) == y
+        if ignore_label is not None:
+            valid = y != ignore_label
+            return jnp.sum(hit & valid), jnp.sum(valid)
+        return jnp.sum(hit), jnp.asarray(y.size)
 
     def step_fn(state: TrainState, images, labels):
         world = lax.psum(jnp.float32(1.0), axis_name)
@@ -271,34 +314,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     "loss_scale is static; pass loss_scale='dynamic' to "
                     "make_train_step")
             scale = jnp.float32(loss_scale)
-        stacked, new_stats, loss, correct, counted = local_micro_grads(
-            model_params, state.batch_stats, images, labels, world,
-            state.step, scale)
-        if sat_fault_plan is not None:
-            # saturation-pressure attack (resilience/inject.py
-            # `sat_pressure`): scale this step's local grads by 2^k.  An
-            # exact power of two, rank-agnostic (every replica scales
-            # identically, so replication is preserved)
-            from ..resilience.inject import sat_pressure_factor
-            sfac = sat_pressure_factor(sat_fault_plan, state.step)
-            stacked = jax.tree.map(lambda g: g * sfac, stacked)
-
-        # Local emulated-node reduction (mix.py:251-282), then the
-        # cross-device low-precision all-reduce (mix.py:286-291).
-        # grad_rounding='stochastic': fresh unbiased SR bits per step via
-        # the shared derivation (parallel/dist.py grad_sr_key — rank-free
-        # by contract, so replicated reduction outputs stay consistent).
         sr = grad_rounding == "stochastic"
-        # the emulate-node reduce is rank-LOCAL, so its key also folds in
-        # the rank index (same decorrelation the dropout rngs get above;
-        # sum_gradients folds the rank into its own pre-quantize key)
-        local = emulate_node_reduce(
-            stacked, emulate_node, use_aps, grad_exp, grad_man,
-            rounding=grad_rounding,
-            key=jax.random.fold_in(
-                grad_sr_key(grad_seed, state.step, 0),
-                lax.axis_index(axis_name).astype(jnp.int32)) if sr
-            else None)
         sum_key = grad_sr_key(grad_seed, state.step, 1) if sr else None
         # wire-fault table lookup, keyed by the optimizer-update index —
         # the same clock as with_fault_injection's grad schedule
@@ -309,18 +325,77 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             idx = jnp.clip(state.step, 0, codes.shape[0] - 1)
             in_range = state.step < codes.shape[0]
             wf = (jnp.where(in_range, codes[idx], 0), ranks[idx])
+        sfac = None
+        if sat_fault_plan is not None:
+            # saturation-pressure attack (resilience/inject.py
+            # `sat_pressure`): scale this step's local grads by 2^k.  An
+            # exact power of two, rank-agnostic (every replica scales
+            # identically, so replication is preserved)
+            from ..resilience.inject import sat_pressure_factor
+            sfac = sat_pressure_factor(sat_fault_plan, state.step)
         vreport = None
-        if reduce_in_update:
-            reduced = local       # update_fn owns the collective
+        if overlap_reduce:
+            # Bucketed, dependency-scheduled transport: the reduction
+            # runs INSIDE the backward via per-bucket custom_vjp taps
+            # (parallel/overlap.py) — bitwise identical to the
+            # post-backward path below, but each bucket's collective is
+            # emitted the moment its last cotangent closes, so XLA may
+            # overlap ring hops with the remaining backward compute.
+            from ..parallel.overlap import BucketPlan, overlapped_grads
+            if images.shape[0] < 1:
+                raise ValueError("empty per-device batch")
+            plan = BucketPlan.for_tree(model_params, bucket_elems)
+            rngs = micro_rngs(state.step, jnp.zeros([], jnp.int32))
+            loss_of = make_loss_of(world, scale)
+
+            def loss_closure(p):
+                return loss_of(p, state.batch_stats, images, labels, rngs)
+
+            ((_, (logits, new_stats, loss)), reduced,
+             vreport) = overlapped_grads(
+                loss_closure, model_params, axis_name=axis_name,
+                plan=plan,
+                reduce_kw=dict(use_aps=use_aps, grad_exp=grad_exp,
+                               grad_man=grad_man, use_kahan=use_kahan,
+                               mode=mode, rounding=grad_rounding,
+                               bucket_elems=bucket_elems),
+                key=sum_key, sat_factor=sfac, wire_fault=wf,
+                verify=verify_reduce, stats=quant_stats)
+            correct, counted = _count_hits(logits, labels)
         else:
-            reduced = sum_gradients(
-                local, axis_name, use_aps=use_aps,
-                grad_exp=grad_exp, grad_man=grad_man,
-                use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
-                key=sum_key, verify=verify_reduce, wire_fault=wf,
-                stats=quant_stats)
-            if verify_reduce or quant_stats:
-                reduced, vreport = reduced
+            stacked, new_stats, loss, correct, counted = local_micro_grads(
+                model_params, state.batch_stats, images, labels, world,
+                state.step, scale)
+            if sfac is not None:
+                stacked = jax.tree.map(lambda g: g * sfac, stacked)
+
+            # Local emulated-node reduction (mix.py:251-282), then the
+            # cross-device low-precision all-reduce (mix.py:286-291).
+            # grad_rounding='stochastic': fresh unbiased SR bits per step
+            # via the shared derivation (parallel/dist.py grad_sr_key —
+            # rank-free by contract, so replicated reduction outputs stay
+            # consistent).  The emulate-node reduce is rank-LOCAL, so its
+            # key also folds in the rank index (same decorrelation the
+            # dropout rngs get; sum_gradients folds the rank into its own
+            # pre-quantize key).
+            local = emulate_node_reduce(
+                stacked, emulate_node, use_aps, grad_exp, grad_man,
+                rounding=grad_rounding,
+                key=jax.random.fold_in(
+                    grad_sr_key(grad_seed, state.step, 0),
+                    lax.axis_index(axis_name).astype(jnp.int32)) if sr
+                else None)
+            if reduce_in_update:
+                reduced = local       # update_fn owns the collective
+            else:
+                reduced = sum_gradients(
+                    local, axis_name, use_aps=use_aps,
+                    grad_exp=grad_exp, grad_man=grad_man,
+                    use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
+                    key=sum_key, verify=verify_reduce, wire_fault=wf,
+                    stats=quant_stats, bucket_elems=bucket_elems)
+                if verify_reduce or quant_stats:
+                    reduced, vreport = reduced
 
         if update_fn is not None:
             # custom update (e.g. parallel/zero.py ZeRO: shard-local

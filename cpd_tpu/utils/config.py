@@ -21,7 +21,8 @@ from typing import Any, Dict
 import yaml
 
 __all__ = ["load_yaml_config", "merge_config_into_args",
-           "add_resilience_flags", "build_resilience"]
+           "add_resilience_flags", "add_transport_flags",
+           "build_resilience", "overlap_key"]
 
 
 def load_yaml_config(path: str, section: str = "common") -> Dict[str, Any]:
@@ -45,6 +46,42 @@ def merge_config_into_args(args: argparse.Namespace, cfg: Dict[str, Any],
         if key not in explicit:
             setattr(args, key, value)
     return args
+
+
+def add_transport_flags(parser: argparse.ArgumentParser) -> None:
+    """The shared gradient-transport knobs (ISSUE 8: overlapped
+    backward-reduce + bucket sizing), one surface for every trainer."""
+    g = parser.add_argument_group(
+        "transport", "gradient-reduce transport (parallel/overlap.py)")
+    g.add_argument("--overlap-reduce", action="store_true",
+                   help="bucketed, dependency-scheduled reduction: run "
+                        "each gradient bucket's quantized all-reduce "
+                        "INSIDE the backward pass (custom_vjp taps) the "
+                        "moment the bucket's last gradient closes, so "
+                        "XLA can overlap ring hops with backward "
+                        "compute.  Bitwise identical to the "
+                        "post-backward reduction; requires "
+                        "--emulate_node 1")
+    g.add_argument("--bucket-elems", default=None, type=int,
+                   help="per-bucket element cap for the bucketed "
+                        "faithful gather, the bucketed ring and the "
+                        "overlapped schedule (default: parallel/dist."
+                        "_BUCKET_ELEMS = 4M).  Smaller buckets close "
+                        "earlier in the backward (more overlap) but "
+                        "launch more collectives — sweep with "
+                        "tools/bench_reduce.py --bucket-sweep")
+
+
+def overlap_key(args: argparse.Namespace):
+    """The `ladder_step_key(overlap=...)` coordinate for a parsed CLI:
+    ``(overlap_reduce, bucket_elems)`` when the run touches the overlap
+    surface, None otherwise (keeping the PR 4/5-compatible key shapes
+    for runs that never saw the flags)."""
+    ov = bool(getattr(args, "overlap_reduce", False))
+    be = getattr(args, "bucket_elems", None)
+    if not ov and be is None:
+        return None
+    return (ov, be)
 
 
 def add_resilience_flags(parser: argparse.ArgumentParser) -> None:

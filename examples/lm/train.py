@@ -157,8 +157,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="after training, write a torch state_dict .pth "
                         "of the LM (cpd_tpu.interop.torch_lm; default "
                         "dp/sp/tp path only — pp/moe layouts differ)")
-    from cpd_tpu.utils.config import add_resilience_flags
+    from cpd_tpu.utils.config import (add_resilience_flags,
+                                      add_transport_flags)
     add_resilience_flags(p)       # --fault-plan / guard / watchdog / rollback
+    add_transport_flags(p)        # --overlap-reduce / --bucket-elems
     return p
 
 
@@ -292,6 +294,15 @@ def main(argv=None) -> dict:
                          "sat_pressure faults are wired to the default "
                          "dp/sp/tp path only (the pp/moe steppers do "
                          "not thread the telemetry / pressure tables)")
+    if (args.overlap_reduce or args.bucket_elems is not None) \
+            and (args.pp > 1 or args.moe):
+        raise SystemExit("--overlap-reduce/--bucket-elems are wired to "
+                         "the default dp/sp/tp path only (the pp/moe "
+                         "steppers have their own schedules)")
+    if args.overlap_reduce and args.emulate_node != 1:
+        raise SystemExit("--overlap-reduce requires --emulate_node 1: "
+                         "the micro-batch scan is a barrier that "
+                         "defeats the overlapped schedule")
     if res["active"]:
         # the guard's verdict must be agreed over EVERY mesh axis the
         # update runs under — tp/pp/ep-sharded leaves legitimately hold
@@ -312,10 +323,16 @@ def main(argv=None) -> dict:
     ds = SyntheticText(n=4096, seq_len=args.seq_len,
                        vocab_size=args.vocab_size)
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
+    from cpd_tpu.utils.config import overlap_key
+    ov_key = overlap_key(args)
     quant_kw = dict(use_aps=args.use_APS, grad_exp=args.grad_exp,
                     grad_man=args.grad_man, use_kahan=args.use_kahan,
                     mode=args.mode, grad_rounding=args.grad_rounding,
                     grad_seed=args.grad_seed)
+    if not (args.pp > 1 or args.moe):
+        # the overlapped transport rides the default dp/sp/tp step only
+        quant_kw.update(overlap_reduce=args.overlap_reduce,
+                        bucket_elems=args.bucket_elems)
 
     if args.pp > 1:
         # GPipe pipeline path (parallel/pipeline.py, train/pp.py)
@@ -392,7 +409,8 @@ def main(argv=None) -> dict:
                 level, fmt = resolve_ladder_key(
                     key, transport_on=supervisor is not None,
                     precision_on=psup is not None, level=args.mode,
-                    fmt=(args.grad_exp, args.grad_man))
+                    fmt=(args.grad_exp, args.grad_man),
+                    overlap_on=ov_key is not None)
                 if supervisor is not None:
                     rkw = level_reduce_kwargs(level, *fmt)
                 else:
@@ -407,7 +425,7 @@ def main(argv=None) -> dict:
                     **rkw, **lvl_kw, **tele_kw)
 
             step_table = StepTable(build_step)
-            step = step_table[ladder_step_key(supervisor, psup)]
+            step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
         else:
             # no ladder (verify off, or a non-ladder mode like fast):
             # verification, when on, is detection-only agreement checking
@@ -442,7 +460,7 @@ def main(argv=None) -> dict:
             meta = manager.metadata()
             if meta and meta.get("precision"):
                 psup.load_state_dict(meta["precision"])
-                step = step_table[ladder_step_key(supervisor, psup)]
+                step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
                 if rank == 0:
                     print(f"=> resumed precision ladder at {psup.name}"
                           + (" (escalated)" if psup.escalated else ""))
@@ -603,7 +621,7 @@ def main(argv=None) -> dict:
                     meter.bump("transport_downgrades")
                     state = resync_fn(state)
                     meter.bump("resyncs")
-                    step = step_table[ladder_step_key(supervisor, psup)]
+                    step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
                     if rank == 0:
                         print(f"=> wire fault detected at iter {it} "
                               f"(hop_bad "
@@ -624,7 +642,7 @@ def main(argv=None) -> dict:
             if supervisor is not None and \
                     supervisor.on_success(upd) == "upgrade":
                 meter.bump("transport_upgrades")
-                step = step_table[ladder_step_key(supervisor, psup)]
+                step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
                 if rank == 0:
                     print(f"=> transport probation passed at iter {it}: "
                           f"back to {supervisor.mode}", file=sys.stderr)
@@ -643,7 +661,7 @@ def main(argv=None) -> dict:
                     meter.bump("precision_escalations"
                                if pact == "escalate"
                                else "precision_deescalations")
-                    step = step_table[ladder_step_key(supervisor, psup)]
+                    step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
                     if rank == 0:
                         how = ("escalated" if pact == "escalate"
                                else "probation passed: back")
@@ -680,7 +698,8 @@ def main(argv=None) -> dict:
                         # saturation the escalation escaped
                         psup.load_state_dict(rolled.metadata["precision"])
                         step = step_table[ladder_step_key(supervisor,
-                                                          psup)]
+                                                          psup,
+                                                          overlap=ov_key)]
                     state = relayout(rolled.state)
                     step_no = int(rolled.step)
                     it = step_no + 1

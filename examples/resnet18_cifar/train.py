@@ -116,8 +116,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "sharded (all_to_all reduce-scatter; composes "
                         "with --use_lars).  --zero3 lives on the "
                         "ResNet-50 CLI (portable checkpoint layout)")
-    from cpd_tpu.utils.config import add_resilience_flags
+    from cpd_tpu.utils.config import (add_resilience_flags,
+                                      add_transport_flags)
     add_resilience_flags(p)       # --fault-plan / guard / watchdog
+    add_transport_flags(p)        # --overlap-reduce / --bucket-elems
     return p
 
 
@@ -210,6 +212,22 @@ def main(argv=None) -> dict:
                          "the ZeRO updaters own the collective "
                          "(reduce_in_update) — run without --zero1/"
                          "--zero2")
+    if args.overlap_reduce and (args.zero1 or args.zero2):
+        raise SystemExit("--overlap-reduce runs the collective inside "
+                         "the backward taps; the ZeRO updaters own it "
+                         "(reduce_in_update) — pick one")
+    if args.bucket_elems is not None and (args.zero1 or args.zero2):
+        # same ownership conflict as --overlap-reduce: the ZeRO updaters
+        # never see bucket_elems, and a silently ignored tuning knob is
+        # worse than an error
+        raise SystemExit("--bucket-elems tunes the step's own reduction; "
+                         "the ZeRO updaters own the collective "
+                         "(reduce_in_update) — run without --zero1/"
+                         "--zero2")
+    if args.overlap_reduce and args.emulate_node != 1:
+        raise SystemExit("--overlap-reduce requires --emulate_node 1: "
+                         "the micro-batch scan is a barrier that "
+                         "defeats the overlapped schedule")
     if res["active"]:
         tx = res["wrap_tx"](tx, axis_name="dp")
     injector, watchdog = res["injector"], res["watchdog"]
@@ -329,12 +347,16 @@ def main(argv=None) -> dict:
         state, extra = zero.mesh_layout(state, mesh)
         to_ckpt = zero.export_state
 
+    from cpd_tpu.utils.config import overlap_key
+    ov_key = overlap_key(args)
     step_kw = dict(emulate_node=args.emulate_node, use_aps=args.use_APS,
                    use_kahan=args.use_kahan,
                    grad_rounding=args.grad_rounding,
                    grad_seed=args.grad_seed,
                    quant_stats=res["quant_stats"],
-                   sat_fault_plan=res["sat_plan"], **extra)
+                   sat_fault_plan=res["sat_plan"],
+                   overlap_reduce=args.overlap_reduce,
+                   bucket_elems=args.bucket_elems, **extra)
     supervisor = res["supervisor"]
     resync_fn = None
     if supervisor is not None or psup is not None:
@@ -353,7 +375,8 @@ def main(argv=None) -> dict:
             level, fmt = resolve_ladder_key(
                 key, transport_on=supervisor is not None,
                 precision_on=psup is not None, level=args.mode,
-                fmt=(args.grad_exp, args.grad_man))
+                fmt=(args.grad_exp, args.grad_man),
+                overlap_on=ov_key is not None)
             if supervisor is not None:
                 rkw = level_reduce_kwargs(level, *fmt)
             else:
@@ -366,7 +389,7 @@ def main(argv=None) -> dict:
                 **rkw, **step_kw)
 
         step_table = StepTable(build_step)
-        train_step = step_table[ladder_step_key(supervisor, psup)]
+        train_step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
     else:
         # no ladder (verify off, or a non-ladder mode like fast):
         # verification, when on, is detection-only agreement checking
@@ -561,7 +584,8 @@ def main(argv=None) -> dict:
                     state = resync_fn(state)
                     meter.bump("resyncs")
                     train_step = step_table[ladder_step_key(supervisor,
-                                                            psup)]
+                                                            psup,
+                                                            overlap=ov_key)]
                     if rank == 0:
                         print(f"=> wire fault detected at iter "
                               f"{step_no + 1} (hop_bad "
@@ -583,7 +607,8 @@ def main(argv=None) -> dict:
                     supervisor.on_success(step_no) == "upgrade":
                 meter.bump("transport_upgrades")
                 train_step = step_table[ladder_step_key(supervisor,
-                                                            psup)]
+                                                            psup,
+                                                            overlap=ov_key)]
                 if rank == 0:
                     print(f"=> transport probation passed at iter "
                           f"{step_no + 1}: back to {supervisor.mode}",
@@ -604,7 +629,8 @@ def main(argv=None) -> dict:
                                if pact == "escalate"
                                else "precision_deescalations")
                     train_step = step_table[ladder_step_key(supervisor,
-                                                            psup)]
+                                                            psup,
+                                                            overlap=ov_key)]
                     if rank == 0:
                         how = ("escalated" if pact == "escalate"
                                else "probation passed: back")

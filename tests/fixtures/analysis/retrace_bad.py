@@ -43,3 +43,18 @@ def string_keys(make_step, state, batch, exp, man):
     cache[key] = jax.jit(make_step(exp, man))
     # BAD: stringified cache key on a jitted-step table
     return cache[f"e{exp}m{man}"](state, batch)
+
+
+def overlap_blind(make_train_step, ladder_step_key, build, model, tx,
+                  mesh, state, batch):
+    # distilled from the ISSUE 8 hazard: the run configures the
+    # overlapped transport, but the ladder key has no overlap coordinate
+    supervisor = TransportSupervisor(start="ring")
+    psup = PrecisionSupervisor("e5m2,e5m7")
+    make_train_step(model, tx, mesh, overlap_reduce=True,
+                    bucket_elems=65536)
+    steps = StepTable(build)
+    # BAD: a ladder transition serves a step traced for the wrong
+    # schedule/bucket layout — pass overlap=(overlap_reduce, bucket_elems)
+    step = steps[ladder_step_key(supervisor, psup)]
+    return step(state, batch)

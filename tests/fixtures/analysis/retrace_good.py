@@ -39,6 +39,22 @@ def guarded_loop(build_step, state, batch, grad_exp, grad_man):
     supervisor = TransportSupervisor(start="ring")
     psup = PrecisionSupervisor("e5m2,e5m7")
     steps = StepTable(build_step)
-    # the PR 5 fix: both supervisors' coordinates in the key
-    step = steps[ladder_step_key(supervisor, psup)]
+    # the PR 5 fix: both supervisors' coordinates in the key (and an
+    # explicit overlap=None: this run has no overlap surface)
+    step = steps[ladder_step_key(supervisor, psup, overlap=None)]
+    return step(state, batch)
+
+
+def overlap_keyed(make_train_step, build, model, tx, mesh, state,
+                  batch, overlap_reduce, bucket_elems):
+    # the ISSUE 8 fix: the overlap/bucket coordinate rides the key, so a
+    # ladder transition can never serve a step traced for the wrong
+    # schedule
+    supervisor = TransportSupervisor(start="ring")
+    psup = PrecisionSupervisor("e5m2,e5m7")
+    make_train_step(model, tx, mesh, overlap_reduce=overlap_reduce,
+                    bucket_elems=bucket_elems)
+    steps = StepTable(build)
+    step = steps[ladder_step_key(supervisor, psup,
+                                 overlap=(overlap_reduce, bucket_elems))]
     return step(state, batch)

@@ -85,7 +85,7 @@ def test_bad_fixture_finding_counts():
                 "swallow": 4,
                 # v2 (whole-program + compat inventory) rules
                 "format-flow": 4, "axis-flow": 2,
-                "collective-contract": 4, "retrace": 4,
+                "collective-contract": 4, "retrace": 5,
                 "compat-drift": 5}
     assert set(expected) == set(RULE_IDS), "new rule missing a count pin"
     for rule_id, n in expected.items():

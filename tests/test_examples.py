@@ -57,6 +57,21 @@ def test_resnet18_trainer_aps_smoke(tiny_cifar, tmp_path, capsys, mode):
     mgr.close()
 
 
+def test_resnet18_trainer_overlap_smoke(tiny_cifar, tmp_path):
+    """--overlap-reduce end to end (ISSUE 8): the bucketed in-backward
+    ring transport trains through the full CLI harness."""
+    from resnet18_cifar.train import main
+
+    res = main(["--use_APS", "--grad_exp", "5", "--grad_man", "2",
+                "--emulate_node", "1", "--arch", "tiny",
+                "--data-root", tiny_cifar, "--max-iter", "3",
+                "--batch_size", "2", "--val_freq", "4",
+                "--save_path", str(tmp_path / "ckpt"), "--mode", "ring",
+                "--overlap-reduce", "--bucket-elems", "4096"])
+    assert res["step"] == 3
+    assert math.isfinite(res["loss"])
+
+
 def test_resnet18_halts_on_nonfinite_loss(tiny_cifar, tmp_path, capsys):
     """A diverged run (NaN/inf loss) must stop with a clear verdict — a
     controlled stop (diverged=True in the result, teardown runs), not an

@@ -199,21 +199,167 @@ def test_sum_gradients_rejects_unknown_mode():
             {"g": jnp.zeros((W, 4))})
 
 
-def test_sum_gradients_ring_multi_axis_actionable_error():
-    """mode="ring" over several mesh axes used to surface
-    ring_quantized_sum's bare ValueError from deep inside jit tracing;
-    the dispatch now fails fast, names the axes and points at the
-    multi-axis-capable faithful mode."""
+def test_sum_gradients_ring_empty_axis_tuple_rejected():
+    """Multi-axis ring now WORKS (hierarchical composition, PR 8) — the
+    only invalid axis spec left is an empty one."""
     from cpd_tpu.parallel.dist import sum_gradients
-    with pytest.raises(ValueError) as e:
-        sum_gradients({"g": jnp.zeros((4,))}, ("dp", "sp"), mode="ring")
-    msg = str(e.value)
-    assert "('dp', 'sp')" in msg
-    assert "mode='faithful'" in msg
-    assert "ONE mesh axis" in msg
-    # a single-axis tuple is still a tuple — same actionable message
-    with pytest.raises(ValueError, match="ONE mesh axis"):
-        sum_gradients({"g": jnp.zeros((4,))}, ("dp",), mode="ring")
+    with pytest.raises(ValueError, match="at least one"):
+        sum_gradients({"g": jnp.zeros((4,))}, (), mode="ring")
+
+
+# ------------------------------------------------ multi-axis hierarchical ring
+
+def _run_hier(mesh, axes, stacked, exp, man, spec, **kw):
+    from cpd_tpu.parallel.ring import hierarchical_ring_sum
+
+    def body(st):
+        local = st
+        for _ in range(len(spec)):
+            local = local[0]
+        return hierarchical_ring_sum(local, axes, exp, man, **kw)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(*spec),),
+                           out_specs=P(), check_vma=False))
+    sharded = jax.device_put(jnp.asarray(stacked),
+                             NamedSharding(mesh, P(*spec)))
+    return np.asarray(fn(sharded))
+
+
+@pytest.mark.parametrize("dp,tp", [(4, 2), (2, 4)])
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3), (8, 23)])
+@pytest.mark.parametrize("variant", ["nearest", "stochastic", "kahan"])
+def test_hierarchical_ring_2d_matches_oracle_bitwise(dp, tp, exp, man,
+                                                     variant):
+    """The PR 8 acceptance gate: mode="ring" on a 2D DP x TP mesh ==
+    the single-device hierarchical oracle, bit for bit, across formats,
+    mesh shapes and rounding modes — the old multi-axis fail-fast is
+    replaced by a working (and gated) transport."""
+    from cpd_tpu.parallel.ring import ring_oracle_sum_multi
+    kahan = variant == "kahan"
+    key = _KEY if variant == "stochastic" else None
+    mesh = make_mesh(dp=dp, tp=tp)
+    stacked = np.random.RandomState(dp * 10 + exp).randn(
+        dp, tp, 103).astype(np.float32) * 0.3
+    got = _run_hier(mesh, ("dp", "tp"), stacked, exp, man, ("dp", "tp"),
+                    use_kahan=kahan, key=key)
+    want = ring_oracle_sum_multi(jnp.asarray(stacked), 2, exp, man,
+                                 use_kahan=kahan, key=key)
+    _bitwise(got, want, f"{dp}x{tp} ({exp},{man}) {variant}")
+
+
+def test_hierarchical_ring_3d_matches_oracle_bitwise():
+    """Three axes compose by induction — one gate at the 2x2x2 mesh."""
+    from cpd_tpu.parallel.ring import ring_oracle_sum_multi
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    stacked = _stack(8, 67, seed=31).reshape(2, 2, 2, 67)
+    got = _run_hier(mesh, ("dp", "sp", "tp"), stacked, 5, 2,
+                    ("dp", "sp", "tp"))
+    want = ring_oracle_sum_multi(jnp.asarray(stacked), 3, 5, 2)
+    _bitwise(got, want, "2x2x2")
+
+
+def test_hierarchical_ring_single_axis_tuple_is_legacy_ring():
+    """A 1-tuple axis spec is EXACTLY the single-axis ring — same bits,
+    same (unfolded) SR bitstream."""
+    stacked = _stack(W, 129, seed=32)
+    got = _run_ring(W, stacked, 5, 2, key=_KEY)
+    mesh = make_mesh(dp=W, devices=jax.devices()[:W])
+    got_tup = _run_hier(mesh, ("dp",), stacked, 5, 2, ("dp",), key=_KEY)
+    _bitwise(got_tup, got)
+
+
+def test_sum_gradients_ring_2d_mesh_end_to_end():
+    """mode="ring" through the pytree API on a DP x TP mesh: bitwise
+    equal to the hierarchical oracle over the concatenated flat layout,
+    and verify=True reports all-green with the result unchanged."""
+    from cpd_tpu.compat import shard_map as smap
+    from cpd_tpu.parallel.dist import sum_gradients
+    from cpd_tpu.parallel.ring import ring_oracle_sum_multi
+    mesh = make_mesh(dp=4, tp=2)
+    rng = np.random.RandomState(33)
+    stacked = (rng.randn(4, 2, 61) * 0.2).astype(np.float32)
+
+    def body(st, verify=False):
+        tree = {"g": st[0, 0]}
+        return sum_gradients(tree, ("dp", "tp"), grad_exp=5, grad_man=2,
+                             mode="ring", verify=verify)
+
+    fn = jax.jit(smap(body, mesh=mesh, in_specs=(P("dp", "tp"),),
+                      out_specs=P(), check_vma=False))
+    sharded = jax.device_put(jnp.asarray(stacked),
+                             NamedSharding(mesh, P("dp", "tp")))
+    got = np.asarray(fn(sharded)["g"])
+    want = np.asarray(ring_oracle_sum_multi(jnp.asarray(stacked), 2, 5, 2))
+    _bitwise(got, want)
+
+    vfn = jax.jit(smap(lambda st: body(st, verify=True), mesh=mesh,
+                       in_specs=(P("dp", "tp"),), out_specs=(P(), P()),
+                       check_vma=False))
+    vgot, rep = vfn(sharded)
+    assert {k: int(v) for k, v in rep.items()} == {
+        "hop_bad": 0, "gather_bad": 0, "agree": 1, "ok": 1}
+    _bitwise(np.asarray(vgot["g"]), want)
+
+
+def test_hierarchical_ring_2d_verify_catches_injected_flip():
+    """A wire flip on the 2D mesh is injected into exactly ONE stage-0
+    ring (the slice whose other-axes index is 0), so the merged report
+    counts it exactly once — the chaos-drill counter contract survives
+    mesh composition."""
+    mesh = make_mesh(dp=4, tp=2)
+    stacked = _stack(8, 95, seed=34).reshape(4, 2, 95)
+    got, rep = _run_hier_verify(mesh, stacked,
+                                fault=(jnp.int32(1), jnp.int32(1)))
+    assert int(rep["ok"]) == 0
+    assert int(rep["hop_bad"]) == 1, jax.tree.map(int, rep)
+    clean, crep = _run_hier_verify(mesh, stacked, fault=None)
+    assert int(crep["ok"]) == 1
+    assert (np.asarray(got).view(np.uint32)
+            != np.asarray(clean).view(np.uint32)).any()
+
+
+def _run_hier_verify(mesh, stacked, fault):
+    from cpd_tpu.parallel.ring import hierarchical_ring_sum
+
+    def body(st):
+        return hierarchical_ring_sum(st[0, 0], ("dp", "tp"), 5, 2,
+                                     verify=True, fault=fault)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp", "tp"),),
+                           out_specs=(P(), P()), check_vma=False))
+    return fn(jax.device_put(jnp.asarray(stacked),
+                             NamedSharding(mesh, P("dp", "tp"))))
+
+
+# ------------------------------------------------ bucketed ring
+
+def test_sum_gradients_bucketed_ring_matches_per_bucket_oracle():
+    """bucket_elems splits the ring transport at the shared greedy
+    layout's boundaries; each bucket is its own documented rotation with
+    its GLOBAL offset_start, reproduced by per-bucket oracles (RTNE and
+    SR)."""
+    mesh = data_parallel_mesh()
+    rng = np.random.RandomState(35)
+    tree = {"a": (rng.randn(W, 37) * 0.2).astype(np.float32),
+            "b": (rng.randn(W, 53) * 0.2).astype(np.float32),
+            "c": (rng.randn(W, 11) * 0.2).astype(np.float32)}
+    sharded = jax.tree.map(
+        lambda g: jax.device_put(jnp.asarray(g),
+                                 NamedSharding(mesh, P("dp"))), tree)
+    for key in (None, _KEY):
+        kw = (dict(rounding="stochastic", key=key) if key is not None
+              else {})
+        fn = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=5,
+                                   grad_man=2, mode="ring",
+                                   bucket_elems=40, **kw)
+        got = jax.tree.map(np.asarray, fn(sharded))
+        k_sum = None if key is None else jax.random.split(key, 3)[1]
+        # cap 40 over sizes (37, 53, 11) in tree_flatten order -> one
+        # bucket per leaf, at global starts 0 / 37 / 90
+        for name, start in (("a", 0), ("b", 37), ("c", 90)):
+            want = ring_oracle_sum(jnp.asarray(tree[name]), 5, 2,
+                                   key=k_sum, offset_start=start)
+            _bitwise(got[name], want, f"{name} sr={key is not None}")
 
 
 def test_sum_gradients_ring_verify_end_to_end():

@@ -47,7 +47,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 
 def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
-            rounding: str) -> dict:
+            rounding: str, bucket_elems=None) -> dict:
     """Time sum_gradients in each transport mode on the current backend."""
     import jax
     import jax.numpy as jnp
@@ -69,6 +69,7 @@ def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
 
     out = {"world": world, "elements": n, "format": [exp, man],
            "use_kahan": use_kahan, "rounding": rounding,
+           "bucket_elems": bucket_elems,
            "platform": jax.devices()[0].platform,
            "bytes_on_wire_per_device": transport_table(
                n, world, exp, man, use_kahan=use_kahan),
@@ -76,7 +77,8 @@ def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
     for mode in ("faithful", "ring", "fast"):
         fn = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=exp,
                                    grad_man=man, use_kahan=use_kahan,
-                                   mode=mode, rounding=rounding, key=key)
+                                   mode=mode, rounding=rounding, key=key,
+                                   bucket_elems=bucket_elems)
         r = fn(sharded)
         np.asarray(r["g"])  # compile + sync
         best = float("inf")
@@ -117,6 +119,138 @@ def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
         "overhead_vs_ring_pct": (round(100.0 * (best_v * 1e3 - ring_ms)
                                        / ring_ms, 1) if ring_ms else None),
     }
+    return out
+
+
+def bucket_sweep(n: int, exp: int, man: int, iters: int,
+                 sizes: list) -> dict:
+    """Time the bucketed faithful gather and the bucketed ring at each
+    bucket size (None = one whole-tree bucket/ring) — the ISSUE 8
+    satellite: `bucket_elems` is a measured knob, not a guess.  The
+    pytree is split into 64 equal leaves so the layout actually varies
+    with the cap."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cpd_tpu.parallel import make_sum_gradients_fn
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+
+    mesh = data_parallel_mesh()
+    world = len(jax.devices())
+    rng = np.random.RandomState(0)
+    n_leaves = 64
+    per = max(n // n_leaves, 1)
+    stacked = {f"g{i:02d}": (rng.randn(world, per) * 0.1)
+               .astype(np.float32) for i in range(n_leaves)}
+    sharded = jax.tree.map(
+        lambda g: jax.device_put(jnp.asarray(g),
+                                 NamedSharding(mesh, P("dp"))), stacked)
+
+    def time_one(mode, be):
+        kw = dict(bucket_elems=be)
+        if mode == "faithful":
+            kw["bucket"] = True if be is None else None
+        fn = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=exp,
+                                   grad_man=man, mode=mode, **kw)
+        r = fn(sharded)
+        np.asarray(r["g00"])
+        best = float("inf")
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            r = fn(sharded)
+            np.asarray(r["g00"])
+            best = min(best, time.perf_counter() - t0)
+        return round(best * 1e3, 3)
+
+    rows = []
+    for be in sizes:
+        rows.append({"bucket_elems": be,
+                     "faithful_ms": time_one("faithful", be),
+                     "ring_ms": time_one("ring", be)})
+    return {"world": world, "elements": per * n_leaves,
+            "leaves": n_leaves, "format": [exp, man],
+            "platform": jax.devices()[0].platform, "rows": rows}
+
+
+def overlap_step_bench(iters: int = 8, batch_per_dev: int = 8,
+                       width: int = 128, image: int = 16,
+                       bucket_elems: int = 65536) -> dict:
+    """Full-train-step throughput of the overlapped transport vs the
+    monoliths on the current backend — the ISSUE 8 acceptance
+    measurement (docs/PERF.md "Overlapped reduce"; bench.py embeds this
+    as ``reduction.overlap``).
+
+    Arms: fp32 step (grad (8,23) — the plain-psum shortcut), faithful
+    e5m2 APS (monolith), faithful+overlap, ring, ring+overlap.  The
+    model is a widened TinyCNN (~320k grad elements) so the reduction is
+    a real fraction of the step, as it is for ResNet-50 at pod scale.
+    Alongside the timings it reports each arm's `overlap_evidence` —
+    the structural interleaving count — and asserts nothing: the CI
+    gate lives in smoke(); this is the measurement."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cpd_tpu.models.tiny import tiny_cnn
+    from cpd_tpu.parallel.dist import replicate
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    from cpd_tpu.parallel.overlap import overlap_evidence
+    from cpd_tpu.train import (create_train_state, make_optimizer,
+                               make_train_step, warmup_step_decay)
+
+    mesh = data_parallel_mesh()
+    n_dev = mesh.devices.size
+    model = tiny_cnn(num_classes=10, width=width)
+    tx = make_optimizer("sgd", warmup_step_decay(0.1, 10, [10 ** 6]),
+                        momentum=0.9)
+    state = replicate(create_train_state(
+        model, tx, jnp.zeros((2, image, image, 3)),
+        jax.random.PRNGKey(0)), mesh)
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
+    rng = np.random.RandomState(0)
+    gb = batch_per_dev * n_dev
+    x = jnp.asarray(rng.randn(gb, image, image, 3), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, (gb,)), jnp.int32)
+
+    arms = {
+        "fp32": dict(grad_exp=8, grad_man=23, mode="faithful"),
+        "faithful": dict(use_aps=True, grad_exp=5, grad_man=2,
+                         mode="faithful"),
+        "faithful_overlap": dict(use_aps=True, grad_exp=5, grad_man=2,
+                                 mode="faithful", overlap_reduce=True,
+                                 bucket_elems=bucket_elems),
+        "ring": dict(use_aps=True, grad_exp=5, grad_man=2, mode="ring",
+                     bucket_elems=bucket_elems),
+        "ring_overlap": dict(use_aps=True, grad_exp=5, grad_man=2,
+                             mode="ring", overlap_reduce=True,
+                             bucket_elems=bucket_elems),
+    }
+    out = {"world": n_dev, "platform": jax.devices()[0].platform,
+           "grad_elements": n_params, "global_batch": gb,
+           "bucket_elems": bucket_elems, "arms": {}}
+    for name, kw in arms.items():
+        step = make_train_step(model, tx, mesh, donate=False, **kw)
+        s, m = step(state, x, y)
+        float(m["loss"])          # compile + sync
+        best = float("inf")
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            s, m = step(s, x, y)
+            float(m["loss"])
+            best = min(best, time.perf_counter() - t0)
+        ev = overlap_evidence(step, state, x, y)
+        out["arms"][name] = {
+            "best_ms": round(best * 1e3, 3),
+            "img_per_sec": round(gb / best, 1),
+            "compute_after_first_collective":
+                ev["compute_after_first_collective"],
+        }
+    fp32 = out["arms"]["fp32"]["img_per_sec"]
+    for name in arms:
+        out["arms"][name]["vs_fp32"] = round(
+            out["arms"][name]["img_per_sec"] / fp32, 3)
     return out
 
 
@@ -246,6 +380,87 @@ def smoke() -> dict:
         raise AssertionError(
             f"(4,3) probe counters off: {jax.tree.map(int, h43)}")
 
+    # bucketed-ring gate (ISSUE 8): per-bucket rings at the shared
+    # greedy layout == per-bucket oracles at their GLOBAL offset starts
+    from cpd_tpu.parallel import make_sum_gradients_fn
+    from cpd_tpu.parallel.mesh import data_parallel_mesh
+    mesh_dp = data_parallel_mesh()
+    tree = {"a": (rng.randn(8, 37) * 0.2).astype(np.float32),
+            "b": (rng.randn(8, 53) * 0.2).astype(np.float32)}
+    sharded_t = jax.tree.map(
+        lambda g: jax.device_put(jnp.asarray(g),
+                                 NamedSharding(mesh_dp, P("dp"))), tree)
+    got = jax.tree.map(np.asarray, make_sum_gradients_fn(
+        mesh_dp, axis_name="dp", grad_exp=5, grad_man=2, mode="ring",
+        bucket_elems=40)(sharded_t))
+    for name, start in (("a", 0), ("b", 37)):
+        want = np.asarray(ring_oracle_sum(jnp.asarray(tree[name]), 5, 2,
+                                          offset_start=start))
+        if (got[name].view(np.uint32) != want.view(np.uint32)).any():
+            raise AssertionError(f"bucketed ring != oracle at leaf "
+                                 f"{name}")
+
+    # multi-axis gate (ISSUE 8): hierarchical ring on a 2D DP x TP mesh
+    # == the single-device multi-axis oracle, bitwise
+    from cpd_tpu.parallel.ring import (hierarchical_ring_sum,
+                                       ring_oracle_sum_multi)
+    mesh2d = make_mesh(dp=4, tp=2)
+    st2 = (rng.randn(4, 2, 97) * 0.3).astype(np.float32)
+
+    def h_body(st):
+        return hierarchical_ring_sum(st[0, 0], ("dp", "tp"), 5, 2,
+                                     key=key)
+
+    hfn = jax.jit(shard_map(h_body, mesh=mesh2d,
+                            in_specs=(P("dp", "tp"),), out_specs=P(),
+                            check_vma=False))
+    hgot = np.asarray(hfn(jax.device_put(
+        jnp.asarray(st2), NamedSharding(mesh2d, P("dp", "tp")))))
+    hwant = np.asarray(ring_oracle_sum_multi(jnp.asarray(st2), 2, 5, 2,
+                                             key=key))
+    if (hgot.view(np.uint32) != hwant.view(np.uint32)).any():
+        raise AssertionError("2D hierarchical ring != multi-axis oracle")
+
+    # overlap gate (ISSUE 8): the overlapped step's updated params are
+    # BITWISE the monolith's, and the overlap actually happened — the
+    # tapped program interleaves transport collectives with backward
+    # compute (a structural jaxpr property, not a timing flake), while
+    # the monolith's transport strictly postdates all compute
+    from cpd_tpu.models.tiny import tiny_cnn
+    from cpd_tpu.parallel.dist import replicate
+    from cpd_tpu.parallel.overlap import overlap_evidence
+    from cpd_tpu.train import (create_train_state, make_optimizer,
+                               make_train_step, warmup_step_decay)
+    model = tiny_cnn(num_classes=4, width=4)
+    tx = make_optimizer("sgd", warmup_step_decay(0.1, 10, [100]),
+                        momentum=0.9)
+    state0 = replicate(create_train_state(
+        model, tx, jnp.zeros((2, 8, 8, 3)), jax.random.PRNGKey(0)),
+        mesh_dp)
+    xs = jnp.asarray(rng.randn(16, 8, 8, 3), jnp.float32)
+    ys = jnp.asarray(np.arange(16) % 4, jnp.int32)
+    step_kw = dict(use_aps=True, grad_exp=5, grad_man=2, mode="ring",
+                   bucket_elems=100, donate=False)
+    mono = make_train_step(model, tx, mesh_dp, **step_kw)
+    over = make_train_step(model, tx, mesh_dp, overlap_reduce=True,
+                           **step_kw)
+    sa, ma = mono(state0, xs, ys)
+    sb, mb = over(state0, xs, ys)
+    for pa, pb in zip(jax.tree.leaves(sa.params),
+                      jax.tree.leaves(sb.params)):
+        if (np.asarray(pa).view(np.uint32)
+                != np.asarray(pb).view(np.uint32)).any():
+            raise AssertionError("overlapped step != monolith step "
+                                 "(bitwise params)")
+    ev_over = overlap_evidence(over, state0, xs, ys)
+    ev_mono = overlap_evidence(mono, state0, xs, ys)
+    if not ev_over["interleaved"]:
+        raise AssertionError(f"overlapped step NOT interleaved: "
+                             f"{ev_over}")
+    if ev_mono["interleaved"]:
+        raise AssertionError(f"monolith step unexpectedly interleaved: "
+                             f"{ev_mono}")
+
     # byte-counter invariants — the acceptance gate: >= 2x fewer wire
     # bytes at W=8 for e5m2 vs the faithful gather path (both flavors)
     n_big = 1_000_000
@@ -262,6 +477,13 @@ def smoke() -> dict:
                               "flip_hop_bad": int(frep["hop_bad"]),
                               "flip_gather_bad": int(frep["gather_bad"])},
             "stats_cast_bitwise_checks": stats_checks,
+            "bucketed_ring_oracle": True,
+            "hierarchical_ring_2d_oracle": True,
+            "overlap": {"bitwise_vs_monolith": True,
+                        "interleaved": ev_over[
+                            "compute_after_first_collective"],
+                        "monolith_interleaved": ev_mono[
+                            "compute_after_first_collective"]},
             "ring_bytes_w8_e5m2": ring_b,
             "gather_bytes_w8_e5m2_fp32": gather_fp32,
             "gather_bytes_w8_e5m2_packed": gather_packed,
@@ -285,13 +507,33 @@ def main():
     ap.add_argument("--kahan", action="store_true")
     ap.add_argument("--rounding", default="nearest",
                     choices=["nearest", "stochastic"])
+    ap.add_argument("--bucket-elems", type=int, default=None,
+                    help="per-bucket element cap for the bucketed "
+                         "faithful gather and the bucketed ring")
+    ap.add_argument("--bucket-sweep", default=None, metavar="N1,N2,..",
+                    help="time the bucketed faithful/ring transports at "
+                         "each comma-listed bucket size ('0' = one "
+                         "whole-tree bucket); ISSUE 8's tuning table")
+    ap.add_argument("--overlap-bench", action="store_true",
+                    help="full-train-step throughput: fp32 vs faithful "
+                         "vs faithful+overlap vs ring vs ring+overlap "
+                         "(the docs/PERF.md 'Overlapped reduce' table)")
     args = ap.parse_args()
 
     if args.smoke:
         out = {"reduce_smoke": smoke(), "status": "ok"}
+    elif args.bucket_sweep:
+        sizes = [None if s.strip() in ("0", "none") else int(s)
+                 for s in args.bucket_sweep.split(",") if s.strip()]
+        out = {"bucket_sweep": bucket_sweep(args.elements, args.exp,
+                                            args.man, args.iters, sizes)}
+    elif args.overlap_bench:
+        out = {"overlap_step_bench": overlap_step_bench(
+            iters=args.iters)}
     else:
         out = {"reduction": measure(args.elements, args.exp, args.man,
-                                    args.iters, args.kahan, args.rounding)}
+                                    args.iters, args.kahan, args.rounding,
+                                    bucket_elems=args.bucket_elems)}
     print(json.dumps(out), flush=True)
 
 
