@@ -113,6 +113,20 @@ def _alarm_handler(signum, frame):
     raise Deadline("bench deadline expired")
 
 
+def _bench_reduce_mod():
+    """Load tools/bench_reduce.py as a module (one loader for every
+    extra that borrows its measurement functions — overlap bench,
+    block-scaled frontier)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_reduce", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "bench_reduce.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _measure(jax, step, state, x, y, iters: int, windows: int = 4,
              imgs_per_call: int | None = None):
     """Compile (first call) then time `iters` calls in `windows` separate
@@ -374,6 +388,26 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 "verify_tag_bytes_per_device": 4 * (2 * (n_dev - 1)
                                                     + n_dev),
             }
+            # the block-scaled wire (ISSUE 9): sidecar-priced analytic
+            # bytes at the default block, plus (budget permitting — the
+            # probe runs a few single-device oracle reductions) the
+            # small-probe frontier pair, so every BENCH capture records
+            # whether the EQuARX point (e4m3 blocked beating per-tensor
+            # e5m7 at fewer bytes) holds on this build
+            from cpd_tpu.parallel.ring import ring_transport_bytes
+            blk = 128
+            partial["reduction"]["block_scaled"] = {
+                "block_size": blk,
+                "ring_bytes_per_device": ring_transport_bytes(
+                    n_params, n_dev, 5, 2, block_size=blk),
+                "ring_bytes_per_device_w8_e4m3": ring_transport_bytes(
+                    n_params, 8, 4, 3, block_size=blk),
+            }
+            if time.monotonic() < budget_end - 90:
+                fr = _bench_reduce_mod().block_frontier_sweep(
+                    8192, formats=((4, 3), (5, 7)), blocks=(16, 32, blk))
+                partial["reduction"]["block_scaled"][
+                    "frontier_e4m3_vs_e5m7"] = fr["frontier_e4m3_vs_e5m7"]
         except Exception as e:  # noqa: BLE001 — extras must not kill it
             partial["reduction_note"] = (f"reduction ledger skipped: "
                                          f"{type(e).__name__}: {e}")
@@ -391,13 +425,7 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
             and "reduction" in partial
             and time.monotonic() < budget_end - 120):
         try:
-            import importlib.util
-            spec = importlib.util.spec_from_file_location(
-                "bench_reduce", os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    "tools", "bench_reduce.py"))
-            br = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(br)
+            br = _bench_reduce_mod()
             partial["reduction"]["overlap"] = br.overlap_step_bench(
                 iters=int(os.environ.get("BENCH_OVERLAP_ITERS", "4")))
         except Exception as e:  # noqa: BLE001 — extras must not kill it

@@ -25,7 +25,9 @@ in PR 5 review).  This layer gives rules the missing whole-program view:
 The lattice is deliberately small: abstract values are sets of concrete
 constants (strings, ints, floats, tuples — which covers eXmY ``(exp,
 man)`` pairs, ladder rung lists, axis names and wire-word widths) plus a
-``("packed", (exp, man))`` marker for ``pack_exmy`` results.  Joins are
+``("packed", (exp, man))`` marker for ``pack_exmy`` results (and the
+``("packed", (exp, man), block)`` marker for ``pack_exmy_blocked``'s
+sidecar wire).  Joins are
 set unions; a set wider than ``_WIDEN_CAP`` widens to TOP (``None``).
 Parameter environments are propagated caller→callee over the call graph
 to a bounded fixpoint (``_PROPAGATE_ROUNDS``), so a format literal
@@ -811,13 +813,28 @@ class ProjectGraph:
             return frozenset([tuple(next(iter(p)) for p in parts)])
         if k == "call":
             base = av.get("f", "").rsplit(".", 1)[-1]
-            if base in ("pack_exmy",) and len(av.get("args", [])) >= 3:
+            if base in ("pack_exmy", "pack_exmy_blocked") \
+                    and len(av.get("args", [])) >= 3:
                 e = self.eval_in(fkey, av["args"][1], depth + 1)
                 m = self.eval_in(fkey, av["args"][2], depth + 1)
                 if e is not TOP and m is not TOP and len(e) == 1 \
                         and len(m) == 1:
-                    return frozenset(
-                        [("packed", (next(iter(e)), next(iter(m))))])
+                    fmt = (next(iter(e)), next(iter(m)))
+                    if base == "pack_exmy":
+                        return frozenset([("packed", fmt)])
+                    # blocked wire: the marker carries the block size
+                    # too — ("packed", fmt, block) — so format-flow can
+                    # lint pack/unpack BLOCK drift, not just format
+                    # drift (a mismatched block re-slices the sidecar
+                    # lane at the wrong offsets, bitwise-silently)
+                    bav = (av["args"][3] if len(av["args"]) >= 4
+                           else av.get("kw", {}).get("block_size"))
+                    b = (self.eval_in(fkey, bav, depth + 1)
+                         if bav is not None else TOP)
+                    if b is not TOP and len(b) == 1 \
+                            and isinstance(next(iter(b)), int):
+                        return frozenset([("packed", fmt,
+                                           next(iter(b)))])
                 return TOP
             tgt = self.resolve(fkey[0], av.get("f", ""))
             if tgt is not None:

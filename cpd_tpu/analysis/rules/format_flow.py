@@ -19,10 +19,16 @@ runs those checks over the project graph (analysis/project.py):
 2. **component swap**: at any known format API, passing a man-named
    variable into the exp slot (or vice versa) across a call boundary —
    both-in-range swaps that format-bounds cannot see.
-3. **pack/unpack width drift**: an ``unpack_exmy`` whose payload traces
-   (locally or through a returning callee) to a ``pack_exmy`` with a
-   DIFFERENT resolved ``(exp, man)`` — the decoded words are garbage,
-   bitwise-silently.
+3. **pack/unpack width drift**: an ``unpack_exmy`` /
+   ``unpack_exmy_blocked`` whose payload traces (locally or through a
+   returning callee) to a packer with a DIFFERENT resolved
+   ``(exp, man)`` — the decoded words are garbage, bitwise-silently.
+   Block-scaled payloads carry a third lattice coordinate
+   (``("packed", fmt, block)``, analysis/project.py): a blocked wire
+   into the per-tensor unpacker (or vice versa), and a matched-format
+   pack/unpack pair whose BLOCK sizes differ, are findings too — the
+   sidecar scale lane re-slices at wrong boundaries and every element
+   unscales by a wrong 2^k.
 """
 
 from __future__ import annotations
@@ -51,6 +57,10 @@ _SWAP_APIS = {
     "ring_quantized_sum": ((2, "exp"), (3, "man")),
     "pack_exmy": ((1, "exp_bits"), (2, "man_bits")),
     "unpack_exmy": ((1, "exp_bits"), (2, "man_bits")),
+    "pack_exmy_blocked": ((1, "exp_bits"), (2, "man_bits")),
+    "unpack_exmy_blocked": ((1, "exp_bits"), (2, "man_bits")),
+    "cast_to_format_blocked": ((1, "exp_bits"), (2, "man_bits")),
+    "cast_body_blocked": ((1, "exp_bits"), (2, "man_bits")),
     # NOTE quant_gemm's real signature is (x, w, man, exp) — the swap
     # check must use ITS order, not assume (exp, man)
     "quant_gemm": ((3, "exp"), (2, "man")),
@@ -235,33 +245,87 @@ class FormatFlow(ProjectRule):
             return (ev, mv)
         return None
 
+    def _block_of_call(self, project, fkey, call) -> Optional[int]:
+        """Concrete block_size of an unpack_exmy_blocked call site
+        (positional slot 4, after (packed, exp, man, n))."""
+        av = (call["args"][4] if len(call["args"]) >= 5
+              else call["kw"].get("block_size"))
+        if av is None:
+            return None
+        b = project.eval_in(fkey, av)
+        if b is TOP or len(b) != 1:
+            return None
+        bv = next(iter(b))
+        return bv if isinstance(bv, int) else None
+
     def _pack_drift(self, project: ProjectGraph) -> Iterator[Finding]:
         for fkey, f, mod in project.iter_functions():
             for call in f["calls"]:
                 base = call["callee"].rsplit(".", 1)[-1]
-                if base != "unpack_exmy" or call["star"]:
+                if base not in ("unpack_exmy", "unpack_exmy_blocked") \
+                        or call["star"]:
                     continue
                 fake = {"k": "call", "f": call["callee"],
                         "args": call["args"], "kw": call["kw"]}
                 unpack_fmt = self._fmt_of_call(project, fkey, fake)
                 if unpack_fmt is None or not call["args"]:
                     continue
+                blocked_call = base == "unpack_exmy_blocked"
+                unpack_blk = (self._block_of_call(project, fkey, call)
+                              if blocked_call else None)
                 payload = call["args"][0]
                 sources = project.eval_in(fkey, payload)
                 if sources is TOP:
                     continue
                 for src in sources:
-                    if (isinstance(src, tuple) and len(src) == 2
-                            and src[0] == "packed"
-                            and src[1] != unpack_fmt):
+                    if not (isinstance(src, tuple) and len(src) >= 2
+                            and src[0] == "packed"):
+                        continue
+                    src_blk = src[2] if len(src) == 3 else None
+                    ue, um = unpack_fmt
+                    if src[1] != unpack_fmt:
                         pe, pm = src[1]
-                        ue, um = unpack_fmt
                         yield Finding(
                             path=mod["path"], line=call["line"],
                             col=call["col"], rule=self.id,
                             message=(
-                                f"unpack_exmy declares e{ue}m{um} but the "
+                                f"{base} declares e{ue}m{um} but the "
                                 f"payload was packed as e{pe}m{pm} — the "
                                 f"decoded values are silently garbage "
                                 f"(wire words re-sliced at the wrong "
                                 f"width)"))
+                    elif blocked_call and src_blk is None:
+                        yield Finding(
+                            path=mod["path"], line=call["line"],
+                            col=call["col"], rule=self.id,
+                            message=(
+                                f"unpack_exmy_blocked on a PER-TENSOR "
+                                f"pack_exmy payload — the wire has no "
+                                f"sidecar lane, so the unpacker reads "
+                                f"the last code bytes as scale shifts "
+                                f"(use pack_exmy_blocked, or "
+                                f"unpack_exmy)"))
+                    elif not blocked_call and src_blk is not None:
+                        yield Finding(
+                            path=mod["path"], line=call["line"],
+                            col=call["col"], rule=self.id,
+                            message=(
+                                f"unpack_exmy on a BLOCK-SCALED "
+                                f"pack_exmy_blocked payload (block "
+                                f"{src_blk}) — the sidecar scale lane "
+                                f"is decoded as code words and every "
+                                f"block's 2^k scale is dropped (use "
+                                f"unpack_exmy_blocked)"))
+                    elif (blocked_call and unpack_blk is not None
+                          and src_blk != unpack_blk):
+                        yield Finding(
+                            path=mod["path"], line=call["line"],
+                            col=call["col"], rule=self.id,
+                            message=(
+                                f"unpack_exmy_blocked declares block "
+                                f"size {unpack_blk} but the payload was "
+                                f"packed with block {src_blk} — the "
+                                f"sidecar lane re-slices at the wrong "
+                                f"block boundaries and every element "
+                                f"unscales by a wrong 2^k, bitwise-"
+                                f"silently"))

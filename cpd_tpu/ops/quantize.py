@@ -21,12 +21,17 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from ..compat import pallas as pl, pallas_tpu as pltpu
 
-from ..quant.numerics import _validate, cast_body, cast_body_sr
+from ..quant.numerics import (_scale_pow2, _validate, _validate_wire,
+                              cast_body, cast_body_sr,
+                              format_max_exponent, max_finite, pack_code,
+                              sidecar_bytes, unpack_code, wire_bytes)
 
 __all__ = ["quantize_pallas", "quantize_pallas_sr", "quantize_add_pallas",
-           "quantize_add_pallas_bits"]
+           "quantize_add_pallas_bits", "hop_pack_pallas",
+           "quantize_pack_pallas", "fletcher_mod65521"]
 
 _LANES = 128
 _BLOCK_ROWS = 512  # (512, 128) fp32 block = 256 KiB of VMEM in + out
@@ -189,3 +194,359 @@ def quantize_pallas_sr(x: jnp.ndarray, exp_bits: int, man_bits: int,
         interpret=interpret,
     )(flat, rflat)
     return out.reshape(-1)[:n].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Fused wire kernels (ISSUE 9): the ENTIRE per-hop ring wire path —
+# unpack the received code words, accumulate the local contribution,
+# (block-)scale, quantize, re-pack, and Fletcher-digest BOTH wire
+# buffers — in ONE Pallas kernel.
+#
+# Why: the self-verifying transport used to run its digests as a
+# separate XLA pass over the packed words (docs/PERF.md measured it at
+# +449-566% of the clean reduce), and pack/unpack themselves were
+# separate HBM round-trips around the quantize-accumulate kernel.  Here
+# one kernel streams the received bytes and the local gradients through
+# VMEM once and emits the new partial (fp32), the new code words, and
+# the (s1, s2) Fletcher partial sums of both buffers — so `verify=True`
+# costs a few VPU ops per element instead of extra passes.
+#
+# Bitwise contract: every stage reuses the SAME un-jitted bodies as the
+# XLA path (`cast_body`/`cast_body_sr`, `pack_code`/`unpack_code`), and
+# the in-kernel mod-65521 arithmetic (`fletcher_mod65521` — shift/add
+# only, no integer division, Mosaic-safe) is exact, so the kernel's
+# digest word equals `integrity.wire_digest` on the same buffer and the
+# kernel's partial equals the XLA hop bit-for-bit (gated in
+# tests/test_ops_pallas.py and CI's reduce-smoke).
+#
+# Block-scaled hops (`block_size=`) are fused when the block is a
+# multiple of 128 lanes dividing the 64k-element kernel tile (the
+# default 128 qualifies): blocks are then whole kernel rows, so the
+# per-block max is a row reduction.  The 1-byte-per-block shift sidecar
+# is assembled (and its few bytes digested) in XLA and combined with
+# the kernel's code-lane digest via `integrity.digest_concat`.
+# ---------------------------------------------------------------------------
+
+_DIGEST_MOD = 65521  # == integrity.DIGEST_MOD (import-leaf; pinned in
+#                      tests/test_integrity.py)
+
+
+def fletcher_mod65521(x: jnp.ndarray) -> jnp.ndarray:
+    """x % 65521 for uint32 inputs using only shifts/masks/adds
+    (2^16 ≡ 15 mod 65521), exact for the full uint32 range — the
+    Mosaic-safe modulus of the in-kernel Fletcher digest.  Pinned
+    against `%` in tests."""
+    f = jnp.uint32(15)
+    x = (x & jnp.uint32(0xFFFF)) + (x >> 16) * f      # < 2^20
+    x = (x & jnp.uint32(0xFFFF)) + (x >> 16) * f      # < 65761
+    m = jnp.uint32(_DIGEST_MOD)
+    return jnp.where(x >= m, x - m, x)
+
+
+def _tile_fletcher(bytes_u32: jnp.ndarray, byte_pos: jnp.ndarray) -> tuple:
+    """Partial Fletcher sums (s1, s2) of one (R, 128) tile of byte
+    values at absolute byte positions `byte_pos` (uint32).  Zero pad
+    bytes contribute nothing, so no masking is needed.  Overflow-safe:
+    Σ bytes <= 65536·255 < 2^24; per-lane products < 2^8·2^16 = 2^24,
+    row sums of 128 < 2^31, mod'd row partials sum < 512·2^16."""
+    s1 = fletcher_mod65521(jnp.sum(bytes_u32))
+    posm = fletcher_mod65521(byte_pos) + jnp.uint32(1)
+    rows = fletcher_mod65521(jnp.sum(bytes_u32 * posm, axis=1))
+    s2 = fletcher_mod65521(jnp.sum(rows))
+    return s1, s2
+
+
+def _exp_field(x: jnp.ndarray) -> jnp.ndarray:
+    return ((jax.lax.bitcast_convert_type(x, jnp.uint32) >> 23)
+            & jnp.uint32(0xFF)).astype(jnp.int32)
+
+
+def _flush_low_kernel(x: jnp.ndarray) -> jnp.ndarray:
+    low = _exp_field(x) == 0
+    return jnp.where(low, jnp.float32(0.0), x)
+
+
+def _make_wire_kernel(exp_bits: int, man_bits: int, wb: int, *,
+                      first: bool, sr: bool, blocked, want_digest: bool):
+    """Build the fused hop kernel body.  Ref order: [wb in-planes +
+    k_in plane (mid-hop only)], g, [rbits], then outputs: res, wb
+    out-planes, [k_out plane (blocked)], [digest (1, 4) SMEM]."""
+    emax = format_max_exponent(exp_bits)
+    mf = float(max_finite(exp_bits, man_bits))
+
+    def kernel(*refs):
+        i = 0
+        in_planes = k_in_ref = None
+        if not first:
+            in_planes = refs[:wb]
+            i = wb
+            if blocked is not None:
+                k_in_ref = refs[i]
+                i += 1
+        g_ref = refs[i]
+        i += 1
+        r_ref = None
+        if sr:
+            r_ref = refs[i]
+            i += 1
+        res_ref = refs[i]
+        i += 1
+        out_planes = refs[i:i + wb]
+        i += wb
+        k_out_ref = None
+        if blocked is not None:
+            k_out_ref = refs[i]
+            i += 1
+        dig_ref = refs[i] if want_digest else None
+
+        # -- unpack + accumulate ----------------------------------------
+        code_in = None
+        if first:
+            s = g_ref[:]
+        else:
+            code_in = in_planes[0][:].astype(jnp.uint32)
+            for k in range(1, wb):
+                code_in = code_in | (in_planes[k][:].astype(jnp.uint32)
+                                     << (8 * k))
+            prev = unpack_code(code_in, exp_bits, man_bits)
+            if blocked is not None:
+                k_in = k_in_ref[:]
+                flush = (jnp.isfinite(prev) & (prev != 0)
+                         & (_exp_field(prev) - 127 + k_in <= -127))
+                prev = _flush_low_kernel(
+                    jnp.where(flush, jnp.float32(0.0),
+                              _scale_pow2(prev, k_in)))
+            s = prev + g_ref[:]
+
+        # -- (block-)scale + quantize -----------------------------------
+        if blocked is None:
+            q = (cast_body_sr(s, exp_bits, man_bits, r_ref[:]) if sr
+                 else cast_body(s, exp_bits, man_bits))
+            res_ref[:] = q
+        else:
+            rows, lanes = s.shape
+            c = blocked // lanes           # rows per block (>= 1)
+            s = _flush_low_kernel(s)
+            mag = jnp.where(jnp.isfinite(s), jnp.abs(s), 0.0)
+            rmax = jnp.max(mag, axis=1, keepdims=True)      # (rows, 1)
+            if c > 1:
+                gmax = jnp.max(rmax.reshape(rows // c, c), axis=1,
+                               keepdims=True)
+                rmax = jnp.broadcast_to(gmax, (rows // c, c)).reshape(
+                    rows, 1)
+            bmax = jnp.broadcast_to(rmax, (rows, lanes))
+            k_blk = jnp.where(bmax > 0, _exp_field(bmax) - 127 - emax, 0)
+            k_blk = jnp.clip(k_blk, -128, 127)
+            tiny = (jnp.isfinite(s) & (s != 0)
+                    & (_exp_field(s) - 127 - k_blk <= -127))
+            s = jnp.where(tiny, jnp.float32(0.0), s)
+            y = _scale_pow2(s, -k_blk)
+            q = (cast_body_sr(y, exp_bits, man_bits, r_ref[:]) if sr
+                 else cast_body(y, exp_bits, man_bits))
+            carry = jnp.isfinite(y) & (jnp.abs(q) > jnp.float32(mf))
+            q = jnp.where(carry,
+                          jnp.where(q > 0, jnp.float32(mf),
+                                    jnp.float32(-mf)), q)
+            out_flush = (jnp.isfinite(q) & (q != 0)
+                         & (_exp_field(q) - 127 + k_blk <= -127))
+            res_ref[:] = _flush_low_kernel(
+                jnp.where(out_flush, jnp.float32(0.0),
+                          _scale_pow2(q, k_blk)))
+            k_out_ref[:] = k_blk
+            # canonicalize the wire: values the unscale flushes (and
+            # ±0.0) encode as code 0, exactly what the XLA path's
+            # re-pack of the flushed partial emits — the two paths'
+            # wire BYTES, not just their decoded values, must agree
+            q = jnp.where(out_flush | (q == 0), jnp.float32(0.0), q)
+
+        # -- pack + digest ----------------------------------------------
+        code = pack_code(q, exp_bits, man_bits)
+        for k in range(wb):
+            out_planes[k][:] = ((code >> (8 * k))
+                                & jnp.uint32(0xFF)).astype(jnp.uint8)
+        if want_digest:
+            pid = pl.program_id(0)
+            rows, lanes = res_ref.shape
+            elem = (jnp.uint32(rows * lanes) * pid.astype(jnp.uint32)
+                    + lax.broadcasted_iota(jnp.uint32, (rows, lanes), 0)
+                    * jnp.uint32(lanes)
+                    + lax.broadcasted_iota(jnp.uint32, (rows, lanes), 1))
+
+            def plane_sums(code_words):
+                s1 = jnp.uint32(0)
+                s2 = jnp.uint32(0)
+                for k in range(wb):
+                    b = (code_words >> (8 * k)) & jnp.uint32(0xFF)
+                    p1, p2 = _tile_fletcher(
+                        b, elem * jnp.uint32(wb) + jnp.uint32(k))
+                    s1 = fletcher_mod65521(s1 + p1)
+                    s2 = fletcher_mod65521(s2 + p2)
+                return s1, s2
+
+            o1, o2 = plane_sums(code)
+            i1 = i2 = jnp.uint32(0)
+            if not first:
+                i1, i2 = plane_sums(code_in)
+
+            @pl.when(pid == 0)
+            def _():
+                for j in range(4):
+                    dig_ref[0, j] = jnp.uint32(0)
+
+            for j, v in enumerate((i1, i2, o1, o2)):
+                dig_ref[0, j] = fletcher_mod65521(dig_ref[0, j] + v)
+
+    return kernel
+
+
+def _assemble_wire(planes, n: int, wb: int) -> jnp.ndarray:
+    """Byte planes back to the (n, wb) uint8 wire layout of pack_exmy."""
+    return jnp.stack([p.reshape(-1)[:n] for p in planes], axis=-1)
+
+
+def _wire_call(codes_in, k_in, sidecar_in, g, exp_bits, man_bits, rbits,
+               block_size, want_digest, interpret):
+    """Shared pallas_call assembly for the first-hop and mid-hop fused
+    wire kernels.  Returns (res (n,), wire, [digest_in, digest_out]) —
+    the wire in EXACTLY the layout the XLA path ships (``(n, wb)`` code
+    words, or the flat blocked buffer with its sidecar lane), and the
+    digests bitwise equal to `integrity.wire_digest` of those buffers."""
+    _validate_wire(exp_bits, man_bits)
+    wb = wire_bytes(exp_bits, man_bits)
+    n = g.size
+    first = codes_in is None
+    sr = rbits is not None
+    blocked = block_size is not None
+    if blocked and (block_size % _LANES != 0
+                    or (_BLOCK_ROWS * _LANES) % block_size != 0):
+        raise ValueError(
+            f"fused blocked hop needs block_size a multiple of {_LANES} "
+            f"dividing {_BLOCK_ROWS * _LANES}, got {block_size} — the "
+            f"XLA path (parallel/ring.py) handles other sizes")
+    g = jnp.asarray(g, jnp.float32).reshape(-1)
+    gf, grid, padded_rows = _to_blocks(g)
+    operands = []
+    in_specs = []
+    if not first:
+        for k in range(wb):
+            pf, _, _ = _to_blocks(codes_in[:, k])
+            operands.append(pf)
+            in_specs.append(_block_spec())
+        if blocked:
+            kf, _, _ = _to_blocks(k_in.astype(jnp.int32))
+            operands.append(kf)
+            in_specs.append(_block_spec())
+    operands.append(gf)
+    in_specs.append(_block_spec())
+    if sr:
+        rf, _, _ = _to_blocks(jnp.asarray(rbits, jnp.uint32))
+        operands.append(rf)
+        in_specs.append(_block_spec())
+
+    out_shape = [jax.ShapeDtypeStruct((padded_rows, _LANES), jnp.float32)]
+    out_specs = [_block_spec()]
+    for _ in range(wb):
+        out_shape.append(jax.ShapeDtypeStruct((padded_rows, _LANES),
+                                              jnp.uint8))
+        out_specs.append(_block_spec())
+    if blocked:
+        out_shape.append(jax.ShapeDtypeStruct((padded_rows, _LANES),
+                                              jnp.int32))
+        out_specs.append(_block_spec())
+    if want_digest:
+        out_shape.append(jax.ShapeDtypeStruct((1, 4), jnp.uint32))
+        # 4 running digest scalars in SMEM — the lane-multiple tiling
+        # rule is about VMEM vector blocks; SMEM is word-addressed
+        out_specs.append(pl.BlockSpec(  # cpd: disable=pallas-hygiene
+            (1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM))
+
+    kernel = _make_wire_kernel(exp_bits, man_bits, wb, first=first,
+                               sr=sr, blocked=block_size if blocked
+                               else None, want_digest=want_digest)
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shape),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        interpret=interpret,
+    )(*operands)
+
+    res = outs[0].reshape(-1)[:n]
+    planes = outs[1:1 + wb]
+    idx = 1 + wb
+    if not blocked:
+        wire = _assemble_wire(planes, n, wb)
+        if not want_digest:
+            return res, wire
+        dig = outs[idx]
+        d_out = (dig[0, 3] << 16) | dig[0, 2]
+        d_in = (dig[0, 1] << 16) | dig[0, 0]
+        return res, wire, d_in, d_out
+
+    # blocked: append the sidecar lane, combine its digest contribution
+    from ..parallel.integrity import digest_concat, wire_digest
+    k_plane = outs[idx]
+    idx += 1
+    nb = sidecar_bytes(n, block_size)
+    rows_per_block = block_size // _LANES
+    # block b's shift sits in rows [b*rpb, (b+1)*rpb), any lane
+    k_rows = k_plane[:, 0]                      # (padded_rows,)
+    shifts = k_rows[::rows_per_block][:nb]
+    sidecar = (shifts + 128).astype(jnp.uint8)
+    codes_flat = _assemble_wire(planes, n, wb).reshape(-1)
+    wire = jnp.concatenate([codes_flat, sidecar])
+    if not want_digest:
+        return res, wire
+    dig = outs[idx]
+    d_out_codes = (dig[0, 3] << 16) | dig[0, 2]
+    d_out = digest_concat(d_out_codes, n * wb, wire_digest(sidecar))
+    d_in_codes = (dig[0, 1] << 16) | dig[0, 0]
+    d_in = (digest_concat(d_in_codes, n * wb, wire_digest(sidecar_in))
+            if not first else jnp.uint32(0))
+    return res, wire, d_in, d_out
+
+
+def hop_pack_pallas(wire_in: jnp.ndarray, g: jnp.ndarray, exp_bits: int,
+                    man_bits: int, *, rbits=None,
+                    block_size=None, want_digest: bool = False,
+                    interpret: bool = False):
+    """One fused ring hop over the packed wire: unpack `wire_in`, add
+    the local contribution `g`, (block-)quantize, re-pack, and (with
+    ``want_digest``) Fletcher-digest both wire buffers — a single
+    Pallas kernel pass (module block comment).
+
+    Returns ``(res, wire_out)`` or ``(res, wire_out, digest_in,
+    digest_out)``; `res` is the fp32 partial (bitwise the XLA hop's),
+    `wire_out` the exact byte layout `ring_quantized_sum`'s to_wire
+    ships, and the digests equal `integrity.wire_digest` of the full
+    received/emitted buffers (sidecar lane included)."""
+    n = g.size
+    if block_size is None:
+        codes_in = wire_in.reshape(n, wire_bytes(exp_bits, man_bits))
+        k_in = sidecar_in = None
+    else:
+        wb = wire_bytes(exp_bits, man_bits)
+        nb = sidecar_bytes(n, block_size)
+        codes_in = wire_in[:n * wb].reshape(n, wb)
+        sidecar_in = wire_in[n * wb:n * wb + nb]
+        k_in = jnp.repeat(sidecar_in.astype(jnp.int32) - 128,
+                          block_size)[:n]
+    return _wire_call(codes_in, k_in, sidecar_in, g, exp_bits, man_bits,
+                      rbits, block_size, want_digest, interpret)
+
+
+def quantize_pack_pallas(g: jnp.ndarray, exp_bits: int, man_bits: int, *,
+                         rbits=None, block_size=None,
+                         want_digest: bool = False,
+                         interpret: bool = False):
+    """The ring's hop-0 wire emit, fused: (block-)quantize the local
+    chunk and pack it (plus digest) in one kernel — `hop_pack_pallas`
+    without a received wire.  Returns ``(res, wire)`` or ``(res, wire,
+    digest)``."""
+    out = _wire_call(None, None, None, g, exp_bits, man_bits, rbits,
+                     block_size, want_digest, interpret)
+    if want_digest:
+        res, wire, _, d_out = out
+        return res, wire, d_out
+    return out

@@ -301,7 +301,9 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   wire_fault: Optional[tuple] = None,
                   stats: bool = False,
                   bucket_elems: Optional[int] = None,
-                  offset_starts: Optional[Sequence[int]] = None) -> Any:
+                  offset_starts: Optional[Sequence[int]] = None,
+                  block_scale: bool = False,
+                  block_size: int = 128) -> Any:
     """Low-precision gradient all-reduce (SUM) over `axis_name`.
 
     Pure pytree-in/pytree-out version of reference `sum_gradients`
@@ -345,6 +347,19 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                   `_leaf_starts` — for callers reducing a SLICE of a
                   larger layout (the overlap taps, parallel/overlap.py)
                   whose SR bits must match the whole-layout draw.
+    block_scale / block_size → ring mode only: the EQuARX-style
+                  block-scaled wire (quant/numerics.py "Block-scaled
+                  eXmY codec"): every hop cast shares one power-of-2
+                  scale per `block_size` consecutive elements, the
+                  1-byte-per-block shift sidecar riding the packed
+                  wire.  Different accumulation NUMERICS than the
+                  per-tensor cast — gated by its own extended oracle
+                  (`ring.ring_oracle_sum(block_scale=True)`), and an
+                  e4m3 blocked wire covers dynamic range a per-tensor
+                  e5m7 cannot (tools/bench_reduce.py --block-sweep).
+                  Needs a packable format (man >= 2, not (8, 23));
+                  rejected outside mode="ring" — faithful/fast have no
+                  sidecar wire to carry the scales.
     rounding    → "nearest" (reference semantics) | "stochastic": every
                   eXmY cast in the pipeline (the APS/fast pre-quantize,
                   each ordered-accumulation step, the fast post-quantize)
@@ -410,6 +425,11 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
     if bucket is False and bucket_elems is not None and mode == "faithful":
         raise ValueError("bucket=False contradicts an explicit "
                          "bucket_elems — drop one of them")
+    if block_scale and mode != "ring":
+        raise ValueError(
+            f"block_scale=True needs mode='ring' (got {mode!r}): the "
+            f"per-block shift sidecar rides the ring's packed wire — "
+            f"faithful's gather and fast's psum have no lane to carry it")
     if bucket is None:
         bucket = (jax.default_backend() == "tpu"
                   or bucket_elems is not None)
@@ -521,7 +541,9 @@ def sum_gradients(grads: Any, axis_name: str | Sequence[str],
                 red = hierarchical_ring_sum(
                     flat, axis_name, grad_exp, grad_man,
                     use_kahan=use_kahan, key=k_sum, verify=verify,
-                    fault=(wire_fault if b == 0 else None), **off_kw)
+                    fault=(wire_fault if b == 0 else None),
+                    block_scale=block_scale, block_size=block_size,
+                    **off_kw)
                 if verify:
                     red, rep = red
                     reports.append(rep)
@@ -653,7 +675,9 @@ def make_sum_gradients_fn(mesh: Mesh, axis_name: str = "data", **kwargs):
         # per-instance constants, but the key is what guards tomorrow
         treedef = (jax.tree.structure(stacked_grads),
                    kwargs.get("mode", "faithful"),
-                   kwargs.get("bucket_elems"))
+                   kwargs.get("bucket_elems"),
+                   kwargs.get("block_scale", False),
+                   kwargs.get("block_size", 128))
 
         def build():
             in_spec = jax.tree.map(lambda _: P(axis_name), stacked_grads)

@@ -11,12 +11,18 @@ both pure jnp (they run *inside* the jitted step):
 
 * **per-wire checksums** — :func:`wire_digest`, a Fletcher-style
   position-weighted double sum mod 65521 over the payload's words
-  (uint8 code words for packed eXmY, the raw fp32 bit patterns
-  otherwise).  The ring tags every hop payload with
-  :func:`hop_tag`(digest ^ hop-index ^ sender-rank), so a flipped bit,
-  a dropped payload, AND a stale self-echo (whose embedded digest still
-  matches its bytes!) all fail verification at the receiving hop —
-  catching exactly the corruption class cross-replica agreement cannot.
+  (uint8 code words for packed eXmY — sidecar scale bytes included on
+  the block-scaled wire — the raw fp32 bit patterns otherwise).  The
+  ring tags every hop payload with :func:`hop_tag`(digest ^ hop-index ^
+  sender-rank) on BOTH ends of the wire — the sender tags what it
+  actually sent, the receiver tags what actually arrived — and compares
+  the two vectors after the scan (one extra (W-1)-tag ppermute for the
+  whole reduce, parallel/ring.py), so a flipped bit, a dropped payload,
+  AND a stale self-echo all fail the end-to-end compare — catching
+  exactly the corruption class cross-replica agreement cannot.  On TPU
+  the payload digest comes out of the fused pack kernel as a second
+  output (ops/quantize.py), so verification is not a separate pass over
+  the wire words at all.
 * **cross-replica agreement** — :func:`digest_agree`: pmin == pmax of
   the per-replica :func:`tree_digest`/:func:`wire_digest` of the
   reduced result, so every replica learns whether *any* replica
@@ -43,8 +49,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["wire_digest", "tree_digest", "hop_tag", "digest_agree",
-           "make_consensus_fns", "DIGEST_MOD"]
+__all__ = ["wire_digest", "tree_digest", "hop_tag", "tag_from_digest",
+           "digest_agree", "digest_concat", "make_consensus_fns",
+           "DIGEST_MOD"]
 
 # Largest prime below 2^16 (Adler-32's modulus): keeps both running sums
 # in uint16 range so the pair packs into one uint32 digest, and keeps
@@ -60,16 +67,30 @@ _GOLD_HOP = 0x9E3779B9
 _GOLD_SRC = 0x85EBCA6B
 
 
+def _mod65521(x: jnp.ndarray) -> jnp.ndarray:
+    """x % 65521 for the full uint32 range using only shifts/masks/adds
+    (2^16 ≡ 15 mod 65521) — exact, and DIVISION-FREE: a per-word ``%``
+    lowers to integer divides, which measured as the dominant cost of
+    the verified ring on XLA:CPU (docs/PERF.md "Block-scaled wire").
+    Same arithmetic as the fused pack kernel's `fletcher_mod65521`
+    (ops/quantize.py — kept separate so this module stays import-leaf);
+    both are pinned against ``%`` in tests."""
+    f = jnp.uint32(15)
+    x = (x & jnp.uint32(0xFFFF)) + (x >> 16) * f      # < 2^20
+    x = (x & jnp.uint32(0xFFFF)) + (x >> 16) * f      # < 65761
+    m = jnp.uint32(DIGEST_MOD)
+    return jnp.where(x >= m, x - m, x)
+
+
 def _mod_sum(v: jnp.ndarray) -> jnp.ndarray:
     """Sum of uint32 values (< DIGEST_MOD each) mod DIGEST_MOD, chunked
     so no intermediate overflows: 4096 summands < 65521 stay under
     4096 * 65520 < 2^28 < 2^32.  Static shapes only — jit-safe."""
-    m = jnp.uint32(DIGEST_MOD)
     while v.size > 1:
         pad = (-v.size) % 4096
         if pad:
             v = jnp.concatenate([v, jnp.zeros((pad,), jnp.uint32)])
-        v = jnp.sum(v.reshape(-1, 4096), axis=1) % m
+        v = _mod65521(jnp.sum(v.reshape(-1, 4096), axis=1))
     return v[0] if v.size else jnp.uint32(0)
 
 
@@ -107,13 +128,33 @@ def wire_digest(x: jnp.ndarray) -> jnp.ndarray:
     sum1 = Σ wᵢ and sum2 = Σ (i+1)·wᵢ, both mod 65521 — sum1 catches
     any changed word, the position weight in sum2 catches reorderings
     and moved corruption that a plain sum cannot."""
-    words = _digest_words(jnp.ravel(x))
-    m = jnp.uint32(DIGEST_MOD)
-    w = words % m
+    flat = jnp.ravel(x)
+    if flat.dtype == jnp.uint8 and flat.size > 4096:
+        # fast path for the packed-wire case (bytes < 256): chunk 4096
+        # words and hoist the position weight's chunk offset out of the
+        # inner product — global position (c·4096 + l) splits as
+        # (l+1) + c·4096, so s2 = Σ_c [Σ_l w·(l+1)] + (c·4096)·[Σ_l w],
+        # with every inner sum overflow-free in uint32 (4096·255·4096 <
+        # 2^32).  ~1.5x fewer passes than the generic path on the hot
+        # verified-ring wires; bitwise the SAME digest (pinned in
+        # tests/test_integrity.py)
+        n = flat.size
+        pad = (-n) % 4096
+        w = jnp.pad(flat, (0, pad)).astype(jnp.uint32).reshape(-1, 4096)
+        pos_l = jnp.arange(4096, dtype=jnp.uint32) + jnp.uint32(1)
+        c1 = jnp.sum(w, axis=1)                        # < 2^20
+        c2 = _mod65521(jnp.sum(w * pos_l, axis=1))     # < 2^32
+        off = _mod65521(jnp.arange(w.shape[0], dtype=jnp.uint32)
+                        * jnp.uint32(4096 % DIGEST_MOD))
+        s1 = _mod_sum(_mod65521(c1))
+        s2 = _mod_sum(_mod65521(c2 + _mod65521(off * _mod65521(c1))))
+        return (s2 << 16) | s1
+    words = _digest_words(flat)
+    w = _mod65521(words)
     # weights cycle 1..DIGEST_MOD; each product < 65521^2 < 2^32
-    pos = (jnp.arange(w.size, dtype=jnp.uint32) % m) + jnp.uint32(1)
+    pos = _mod65521(jnp.arange(w.size, dtype=jnp.uint32)) + jnp.uint32(1)
     s1 = _mod_sum(w)
-    s2 = _mod_sum((w * pos) % m)
+    s2 = _mod_sum(_mod65521(w * pos))
     return (s2 << 16) | s1
 
 
@@ -127,18 +168,47 @@ def tree_digest(tree: Any) -> jnp.ndarray:
     return d
 
 
-def hop_tag(payload: jnp.ndarray, hop: jnp.ndarray,
-            src_rank: jnp.ndarray) -> jnp.ndarray:
-    """The tagged checksum a ring hop rides alongside its payload:
-    digest ^ mix(hop index) ^ mix(sender rank).  The hop/sender folds
-    are what catch a STALE wire — a replayed buffer carries a digest
-    that still matches its own bytes, but its (hop, sender) provenance
-    cannot match what the receiver expects."""
-    return (wire_digest(payload)
+def tag_from_digest(digest: jnp.ndarray, hop: jnp.ndarray,
+                    src_rank: jnp.ndarray) -> jnp.ndarray:
+    """Mix a precomputed payload digest with its (hop, sender)
+    provenance — the tag algebra of :func:`hop_tag`, split out so a
+    digest produced elsewhere (the fused Pallas pack kernel's second
+    output, ops/quantize.py) can be tagged without re-hashing."""
+    return (digest
             ^ (jnp.asarray(hop).astype(jnp.uint32)
                * jnp.uint32(_GOLD_HOP))
             ^ (jnp.asarray(src_rank).astype(jnp.uint32)
                * jnp.uint32(_GOLD_SRC)))
+
+
+def hop_tag(payload: jnp.ndarray, hop: jnp.ndarray,
+            src_rank: jnp.ndarray) -> jnp.ndarray:
+    """The per-hop wire checksum: digest ^ mix(hop index) ^ mix(sender
+    rank).  The ring compares the SENDER's tag of what it actually sent
+    against the RECEIVER's tag of what actually arrived (deferred to one
+    post-scan ppermute of the stacked tag vector, parallel/ring.py) —
+    content-complete detection: a flip, a drop, AND a stale replay all
+    change the received bytes, and a corruption that leaves the bytes
+    identical is by definition a no-op on the reduction."""
+    return tag_from_digest(wire_digest(payload), hop, src_rank)
+
+
+def digest_concat(d_a: jnp.ndarray, len_a, d_b: jnp.ndarray) -> jnp.ndarray:
+    """Fletcher digest of the CONCATENATION of two payloads from their
+    individual digests: with (s1, s2) packed as (s2 << 16) | s1,
+    ``s1 = s1a + s1b`` and ``s2 = s2a + s2b + len_a * s1b`` (mod 65521 —
+    the position weights of the second payload shift by len_a, and
+    (i mod m)+1 ≡ i+1 mod m makes the shift additive).  Lets the fused
+    pack kernel digest the code-word lane and XLA digest the tiny
+    sidecar lane, composing to EXACTLY `wire_digest(concat(a, b))`."""
+    m = jnp.uint32(DIGEST_MOD)
+    s1a, s2a = d_a & jnp.uint32(0xFFFF), d_a >> 16
+    s1b, s2b = d_b & jnp.uint32(0xFFFF), d_b >> 16
+    la = jnp.asarray(len_a).astype(jnp.uint32) % m
+    s1 = (s1a + s1b) % m
+    # each term < m, la*s1b < m^2 < 2^32: no intermediate overflow
+    s2 = (s2a + s2b + (la * s1b) % m) % m
+    return (s2 << 16) | s1
 
 
 def digest_agree(digest: jnp.ndarray, axis_name) -> jnp.ndarray:

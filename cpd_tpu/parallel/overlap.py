@@ -203,7 +203,12 @@ def overlapped_grads(loss_fn: Callable, params: Any, *,
     both ``verify`` and ``stats`` are off).
 
     reduce_kw   → the `sum_gradients` precision/mode kwargs
-                  (use_aps/grad_exp/grad_man/use_kahan/mode/rounding).
+                  (use_aps/grad_exp/grad_man/use_kahan/mode/rounding/
+                  block_scale/block_size — the block-scaled ring wire
+                  threads through unchanged, and because blocks are
+                  chunk-local the per-bucket taps reproduce the
+                  monolith's block boundaries exactly: overlap on/off
+                  stays bitwise identical with block scaling on).
     key         → the shared reduction SR key (grad_sr_key site 1); the
                   same key reaches every bucket — bits are global-offset
                   indexed, so per-bucket draws equal the whole-tree draw.

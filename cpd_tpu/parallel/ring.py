@@ -59,8 +59,14 @@ Wire integrity (ISSUE 4)
 payload rides a tagged Fletcher checksum (parallel/integrity.hop_tag —
 digest ^ hop-index ^ sender-rank, so flipped bits, dropped payloads AND
 coherent stale self-echoes all fail at the receiving hop), the final
-all-gather rows are tag-checked the same way, and the full reduced
-vector's digest is pmin/pmax-agreed across replicas.  The function then
+all-gather rows are tag-checked the same way, and each rank's WHOLE
+gathered wire digest — composed from the per-row digests it just
+computed, via `integrity.digest_concat` (the reconstructed vector is a
+deterministic function of those bytes, so wire agreement IS vector
+agreement, without a second full-vector hash pass) — is pmin/pmax-
+agreed across replicas.  On the fused wire path the per-hop digests
+come out of the pack kernel itself (ops/quantize.hop_pack_pallas) —
+verification is not a separate pass over the wire words.  The function
 returns ``(vec, report)`` with replicated int32 scalars ``hop_bad`` /
 ``gather_bad`` (psum'd mismatch counts), ``agree`` and ``ok``.  The
 scan-site checksums matter because a corrupted partial keeps hopping
@@ -87,9 +93,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..quant.numerics import (cast_to_format, cast_to_format_sr_at,
-                              pack_exmy, sr_bits_at, unpack_exmy,
-                              wire_bytes)
+from ..quant.numerics import (cast_body_blocked, cast_to_format,
+                              cast_to_format_sr_at, pack_exmy,
+                              pack_exmy_blocked, sr_bits_at,
+                              unpack_exmy, unpack_exmy_blocked, wire_bytes,
+                              wire_bytes_blocked)
 
 __all__ = ["ring_quantized_sum", "ring_oracle_sum", "ring_transport_bytes",
            "gather_transport_bytes", "transport_table", "pad_to_world",
@@ -111,18 +119,32 @@ def pad_to_world(flat: jnp.ndarray, world: int) -> jnp.ndarray:
     return jnp.pad(flat, (0, world * ring_chunk_size(n, world) - n))
 
 
-def _make_hop_q(exp: int, man: int, key):
+def _make_hop_q(exp: int, man: int, key, block: Optional[int] = None):
     """Per-hop quantizer ``q(x, step, site, offs)`` with reduction.py's
     exact bit-indexing contract: RTNE when key is None, else SR bits from
     (key, step, site, global offset).  Unlike reduction._make_q the
     offsets are a call argument — on the ring the chunk (hence its global
-    offsets) a device is casting changes every hop."""
+    offsets) a device is casting changes every hop.
+
+    ``block`` switches every cast site to the block-scaled cast
+    (`numerics.cast_body_blocked`, blocks of ``block`` elements along the
+    LAST axis): each block of the partial is power-of-2-shifted to the
+    format's top exponent before the cast and shifted back after — the
+    EQuARX-style wire.  The distributed ring and `ring_oracle_sum` share
+    this one factory, so the blocked transport is oracle-gated exactly
+    like the per-tensor one."""
     if key is None:
-        return lambda x, step, site, offs: cast_to_format(x, exp, man)
+        if block is None:
+            return lambda x, step, site, offs: cast_to_format(x, exp, man)
+        return lambda x, step, site, offs: cast_body_blocked(
+            x, exp, man, block)
 
     def q(x, step, site, offs):
         k = jax.random.fold_in(jax.random.fold_in(key, step), site)
-        return cast_to_format_sr_at(x, exp, man, k, offs)
+        if block is None:
+            return cast_to_format_sr_at(x, exp, man, k, offs)
+        rbits = jnp.broadcast_to(sr_bits_at(k, offs), jnp.shape(x))
+        return cast_body_blocked(x, exp, man, block, rbits=rbits)
 
     return q
 
@@ -160,18 +182,21 @@ def _flip_first_bit(x: jnp.ndarray) -> jnp.ndarray:
     return flat.reshape(x.shape)
 
 
-def _apply_hop_fault(recv, rtag, sent, stag, code, active):
-    """Corrupt a received (payload, tag) per the wire-fault code when
-    `active` (resilience/inject.WIRE_KINDS).  ``stale`` replays this
-    rank's own just-sent payload WITH its coherent tag — the corruption
-    a bare payload checksum cannot catch (the tag's sender-rank fold
-    does); ``flip``/``drop`` corrupt the payload under the ridden tag."""
+def _apply_hop_fault(recv, sent, code, active):
+    """Corrupt a received payload per the wire-fault code when `active`
+    (resilience/inject.WIRE_KINDS).  ``stale`` replays this rank's own
+    just-sent payload; ``flip`` flips one bit; ``drop`` zeroes.  The
+    deferred tag compare (sender-side tag of what was actually sent vs
+    receiver-side tag of what actually arrived) catches all three by
+    CONTENT: any replay/flip/drop whose bytes differ from the genuine
+    payload fails the end-to-end compare, and one whose bytes happen to
+    be identical is by definition a no-op on the sum — there is nothing
+    to detect."""
     stale = active & (code == 2)
     recv = jnp.where(stale, sent, recv)
-    rtag = jnp.where(stale, stag, rtag)
     recv = jnp.where(active & (code == 1), _flip_first_bit(recv), recv)
     recv = jnp.where(active & (code == 3), jnp.zeros_like(recv), recv)
-    return recv, rtag
+    return recv
 
 
 def _static_world(axis_name, world: Optional[int]) -> int:
@@ -195,7 +220,9 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
                        interpret: bool = False,
                        verify: bool = False,
                        fault: Optional[tuple] = None,
-                       offsets: Optional[jnp.ndarray] = None):
+                       offsets: Optional[jnp.ndarray] = None,
+                       block_scale: bool = False,
+                       block_size: int = 128):
     """Ordered quantized SUM of per-rank flat fp32 vectors over `axis_name`
     via a ppermute ring — call inside shard_map.
 
@@ -236,6 +263,18 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
                    all-gather wire on that rank.  Applied whether or
                    not `verify` is on — the attack does not need the
                    defense's permission.
+    block_scale  → block-scaled wire (EQuARX-style; quant/numerics.py
+                   "Block-scaled eXmY codec"): every hop cast shares one
+                   power-of-2 scale per ``block_size`` consecutive
+                   elements (chunk-local blocks, odd tail included), and
+                   the 1-byte-per-block shift sidecar rides the packed
+                   wire next to the code words.  Different accumulation
+                   NUMERICS than the per-tensor cast — gated by its own
+                   extended oracle (`ring_oracle_sum(block_size=...)`),
+                   NOT bitwise comparable to block_scale=False.
+                   Requires a packable format (man >= 2, not (8, 23)).
+    block_size   → elements per shared-scale block (static; default 128
+                   — one fp32 cache line's worth per scale byte).
     """
     if isinstance(axis_name, (tuple, list)):
         raise ValueError("ring transport runs over exactly one mesh axis; "
@@ -244,6 +283,20 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
     n = flat.shape[0]
     flat = jnp.asarray(flat, jnp.float32)
     fp32_shortcut = exp == 8 and man == 23 and not use_kahan
+    if block_scale:
+        if exp == 8 and man == 23:
+            raise ValueError("block_scale=True at (8, 23): the fp32 wire "
+                             "has nothing to scale — drop block_scale or "
+                             "pick a sub-fp32 format")
+        if man < 2:
+            raise ValueError(
+                f"block_scale=True needs a packable format (man_bits >= 2 "
+                f"for the codec's special codes), got ({exp}, {man})")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if not packed:
+            raise ValueError("block_scale=True IS the packed sidecar wire; "
+                             "packed=False contradicts it")
     if man < 2 or (exp == 8 and man == 23):
         packed = packed and not (man < 2)
         packed = packed and not fp32_shortcut  # 4-byte words: skip the work
@@ -251,6 +304,15 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
         fused = jax.default_backend() == "tpu"
     if fused and (use_kahan or fp32_shortcut):
         fused = False
+    # the single-kernel wire path (ops/quantize.hop_pack_pallas): packed
+    # plain hops, and blocked hops whose blocks are whole kernel rows
+    # (a multiple of the 128-lane width dividing the 64k-element tile —
+    # the default block_size=128 qualifies); other shapes ride the XLA
+    # composition of the same bodies
+    fused_wire = (fused and packed and not use_kahan
+                  and (not block_scale
+                       or (block_size % 128 == 0
+                           and 65536 % block_size == 0)))
 
     padded = pad_to_world(flat, w)
     chunk = padded.shape[0] // w if w else 0
@@ -268,7 +330,8 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
         return flat
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % w) for i in range(w)]
-    q = _make_hop_q(exp, man, key)
+    blk = block_size if block_scale else None
+    q = _make_hop_q(exp, man, key, block=blk)
 
     def chunk_at(t):
         """Chunk index this device's partial holds after hop t."""
@@ -285,117 +348,242 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
                 + c.astype(jnp.uint32) * jnp.uint32(chunk)
                 + jnp.arange(chunk, dtype=jnp.uint32))
 
+    def hop_rbits(t, c):
+        k = jax.random.fold_in(jax.random.fold_in(key, t), 0)
+        return sr_bits_at(k, offs_of(c))
+
     def accum(res, comp, t, c):
         g = local_chunk(c)
         offs = offs_of(c)
         if use_kahan:
             return _hop_kahan(q, res, comp, g, t, offs)
-        if fused and key is None:
-            from ..ops.quantize import quantize_add_pallas
-            return quantize_add_pallas(res, g, exp, man,
-                                       interpret=interpret), comp
-        if fused:
+        if fused and not fused_wire:
+            # legacy fused hop (unpacked wires): add+cast only
+            if key is None:
+                from ..ops.quantize import quantize_add_pallas
+                return quantize_add_pallas(res, g, exp, man,
+                                           interpret=interpret), comp
             from ..ops.quantize import quantize_add_pallas_bits
-            k = jax.random.fold_in(jax.random.fold_in(key, t), 0)
             return quantize_add_pallas_bits(res, g, exp, man,
-                                            sr_bits_at(k, offs),
+                                            hop_rbits(t, c),
                                             interpret=interpret), comp
         return _hop_plain(q, res, g, t, offs, fp32_shortcut), comp
 
     def to_wire(res, comp):
         payload = jnp.stack([res, comp]) if use_kahan else res
+        if block_scale:
+            return pack_exmy_blocked(payload, exp, man, block_size)
         return pack_exmy(payload, exp, man) if packed else payload
 
     def from_wire(p):
-        payload = unpack_exmy(p, exp, man) if packed else p
+        if block_scale:
+            payload = unpack_exmy_blocked(p, exp, man, chunk, block_size)
+        else:
+            payload = unpack_exmy(p, exp, man) if packed else p
         if use_kahan:
             return payload[0], payload[1]
         return payload, jnp.zeros_like(payload)
 
-    # hop 0: quantize the local chunk in place (res = q(0 + g)); no wire
-    zero = jnp.zeros((chunk,), jnp.float32)
-    res, comp = accum(zero, zero, jnp.int32(0), chunk_at(0))
+    def fused_hop(recv_wire, t, c, want_digest):
+        """The single-kernel wire path: unpack + add + (scale+)cast +
+        pack (+ Fletcher digest of both wire buffers) in ONE Pallas
+        kernel (ops/quantize.hop_pack_pallas).  Bitwise identical to the
+        XLA composition (same cast/pack bodies)."""
+        from ..ops.quantize import hop_pack_pallas
+        rb = None if key is None else hop_rbits(t, c)
+        return hop_pack_pallas(recv_wire, local_chunk(c), exp, man,
+                               rbits=rb, block_size=blk,
+                               want_digest=want_digest,
+                               interpret=interpret)
+
+    def fused_first(c, want_digest):
+        from ..ops.quantize import quantize_pack_pallas
+        rb = None if key is None else hop_rbits(jnp.int32(0), c)
+        return quantize_pack_pallas(local_chunk(c), exp, man, rbits=rb,
+                                    block_size=blk,
+                                    want_digest=want_digest,
+                                    interpret=interpret)
 
     if not verify and fault is None:
         # the plain transport, untouched: zero checksum work, and the
         # oracle-parity tests gate this exact path bitwise
-        def body(carry, t):
-            res, comp = from_wire(lax.ppermute(carry, axis_name, perm))
-            res, comp = accum(res, comp, t, chunk_at(t))
-            return to_wire(res, comp), None
+        if fused_wire:
+            _, wire0 = fused_first(chunk_at(0), False)
 
-        carry, _ = lax.scan(body, to_wire(res, comp),
-                            jnp.arange(1, w, dtype=jnp.int32))
-        res, _ = from_wire(carry)
+            def body(carry, t):
+                recv = lax.ppermute(carry, axis_name, perm)
+                _, new_wire = fused_hop(recv, t, chunk_at(t), False)
+                return new_wire, None
+
+            carry, _ = lax.scan(body, wire0,
+                                jnp.arange(1, w, dtype=jnp.int32))
+            res, _ = from_wire(carry)
+        else:
+            zero = jnp.zeros((chunk,), jnp.float32)
+            res, comp = accum(zero, zero, jnp.int32(0), chunk_at(0))
+
+            def body(carry, t):
+                res, comp = from_wire(lax.ppermute(carry, axis_name, perm))
+                res, comp = accum(res, comp, t, chunk_at(t))
+                return to_wire(res, comp), None
+
+            carry, _ = lax.scan(body, to_wire(res, comp),
+                                jnp.arange(1, w, dtype=jnp.int32))
+            res, _ = from_wire(carry)
         # res is now the reduced chunk `rank`; ring all-gather of the
         # packed chunks rebuilds the full vector (XLA lowers all_gather
         # as a ring on the TPU torus, so the wire cost is the (W-1)
         # chunk hops accounted in ring_transport_bytes — with the
         # payload still bit-packed).
-        wire = pack_exmy(res, exp, man) if packed else res
+        if block_scale:
+            wire = pack_exmy_blocked(res, exp, man, block_size)
+        else:
+            wire = pack_exmy(res, exp, man) if packed else res
         gathered = lax.all_gather(wire, axis_name, axis=0, tiled=False)
-        full = unpack_exmy(gathered, exp, man) if packed else gathered
+        if block_scale:
+            full = jax.vmap(lambda r: unpack_exmy_blocked(
+                r, exp, man, chunk, block_size))(gathered)
+        else:
+            full = (unpack_exmy(gathered, exp, man) if packed
+                    else gathered)
         return full.reshape(-1)[:n]
 
     # --- verified / fault-injected transport (module docstring) ------
-    from .integrity import digest_agree, hop_tag, wire_digest
+    #
+    # Deferred end-to-end tag compare: the scan carry stays EXACTLY the
+    # clean wire (no second per-hop collective — a tag ppermute inside
+    # the scan measured 3-4x the whole clean reduce on the CPU mesh);
+    # each hop instead RECORDS two uint32 tags as scan outputs — the
+    # sender-side tag of what it actually sent, and the receiver-side
+    # tag of what actually arrived — and ONE post-scan ppermute of the
+    # stacked (W-1,) sent-tag vector lines them up for the compare.
+    # Detection is content-complete: any corruption whose bytes differ
+    # from the genuine payload mismatches, and one whose bytes are
+    # identical is a no-op on the sum.
+    from .integrity import hop_tag, wire_digest
     rank_i = rank.astype(jnp.int32)
-    f_code = (jnp.asarray(fault[0], jnp.int32) if fault is not None
-              else jnp.zeros([], jnp.int32))
-    f_rank = (jnp.asarray(fault[1], jnp.int32) if fault is not None
-              else jnp.zeros([], jnp.int32))
-    on_me = (f_code > 0) & (rank_i == f_rank)
+    have_fault = fault is not None
+    if have_fault:
+        f_code = jnp.asarray(fault[0], jnp.int32)
+        f_rank = jnp.asarray(fault[1], jnp.int32)
+        on_me = (f_code > 0) & (rank_i == f_rank)
+    left = jnp.mod(rank_i - 1, w)
+
+    def tag_of(wire, t, src, digest=None):
+        d = wire_digest(wire) if digest is None else digest
+        from .integrity import tag_from_digest
+        return tag_from_digest(d, t, src)
 
     def vbody(carry, t):
-        wire, tag, bad = carry
+        wire = carry
         recv = lax.ppermute(wire, axis_name, perm)
-        rtag = lax.ppermute(tag, axis_name, perm)
-        recv, rtag = _apply_hop_fault(recv, rtag, wire, tag, f_code,
-                                      on_me & (t == jnp.int32(1)))
-        # the left neighbor built its tag for exactly this (hop, sender)
-        bad = bad + (hop_tag(recv, t, jnp.mod(rank_i - 1, w))
-                     != rtag).astype(jnp.int32)
-        res, comp = from_wire(recv)
-        res, comp = accum(res, comp, t, chunk_at(t))
-        new_wire = to_wire(res, comp)
-        return (new_wire, hop_tag(new_wire, t + 1, rank_i), bad), None
+        if have_fault:
+            recv = _apply_hop_fault(recv, wire, f_code,
+                                    on_me & (t == jnp.int32(1)))
+        ys = ()
+        if fused_wire:
+            if verify:
+                res, new_wire, d_in, d_out = fused_hop(
+                    recv, t, chunk_at(t), True)
+                ys = (tag_of(recv, t, left, digest=d_in),
+                      tag_of(new_wire, t + 1, rank_i, digest=d_out))
+            else:
+                _, new_wire = fused_hop(recv, t, chunk_at(t), False)
+        else:
+            if verify:
+                rtag = hop_tag(recv, t, left)
+            res, comp = from_wire(recv)
+            res, comp = accum(res, comp, t, chunk_at(t))
+            new_wire = to_wire(res, comp)
+            if verify:
+                ys = (rtag, hop_tag(new_wire, t + 1, rank_i))
+        return new_wire, ys
 
-    wire0 = to_wire(res, comp)
-    (wire_f, _, hop_bad), _ = lax.scan(
-        vbody, (wire0, hop_tag(wire0, jnp.int32(1), rank_i),
-                jnp.zeros([], jnp.int32)),
-        jnp.arange(1, w, dtype=jnp.int32))
+    if fused_wire:
+        if verify:
+            _, wire0, d0 = fused_first(chunk_at(0), True)
+            tag0 = tag_of(wire0, jnp.int32(1), rank_i, digest=d0)
+        else:
+            _, wire0 = fused_first(chunk_at(0), False)
+    else:
+        zero = jnp.zeros((chunk,), jnp.float32)
+        res, comp = accum(zero, zero, jnp.int32(0), chunk_at(0))
+        wire0 = to_wire(res, comp)
+        if verify:
+            tag0 = hop_tag(wire0, jnp.int32(1), rank_i)
+    wire_f, ys = lax.scan(vbody, wire0, jnp.arange(1, w, dtype=jnp.int32))
     res, _ = from_wire(wire_f)
+
+    hop_bad = jnp.zeros([], jnp.int32)
+    if verify and w > 1:
+        rtags, stags = ys
+        # sent[k] = the tag of the wire delivered at hop k+1: wire0's
+        # tag first, then each body-produced wire's (the last body
+        # iteration's wire is never sent — its tag is dropped)
+        sent = jnp.concatenate([tag0[None], stags[:-1]])
+        remote_sent = lax.ppermute(sent, axis_name, perm)
+        hop_bad = jnp.sum((remote_sent != rtags).astype(jnp.int32))
 
     # all-gather wire, row-tagged: row i's tag is built by rank i with
     # hop index 0 (scan hops use t >= 1, so no aliasing)
-    gwire = pack_exmy(res, exp, man) if packed else res
-    gtag = hop_tag(gwire, jnp.int32(0), rank_i)
+    if block_scale:
+        gwire = pack_exmy_blocked(res, exp, man, block_size)
+    else:
+        gwire = pack_exmy(res, exp, man) if packed else res
     gathered = lax.all_gather(gwire, axis_name, axis=0, tiled=False)
-    gtags = lax.all_gather(gtag, axis_name, axis=0, tiled=False)
-    # gather-site fault: rank k's RECEIVED copy of row (k+1) mod W is
-    # corrupted — only that replica's rebuilt vector diverges, which is
-    # the case the cross-replica agreement digest exists for
-    j = jnp.mod(rank_i + 1, w)
-    row = jnp.take(gathered, j, axis=0)
-    new_row = jnp.where(f_code == 2, gwire, row)       # stale: own row
-    new_row = jnp.where(f_code == 1, _flip_first_bit(row), new_row)
-    new_row = jnp.where(f_code == 3, jnp.zeros_like(row), new_row)
-    gathered = jnp.where(on_me, gathered.at[j].set(new_row), gathered)
-    gtags = jnp.where(on_me & (f_code == 2), gtags.at[j].set(gtag),
-                      gtags)
-    row_tags = jax.vmap(
-        lambda r, i: hop_tag(r, jnp.int32(0), i))(
-            gathered, jnp.arange(w, dtype=jnp.int32))
-    gather_bad = jnp.sum((row_tags != gtags).astype(jnp.int32))
-    full = (unpack_exmy(gathered, exp, man) if packed
-            else gathered).reshape(-1)[:n]
+    if have_fault:
+        # gather-site fault: rank k's RECEIVED copy of row (k+1) mod W
+        # is corrupted — only that replica's rebuilt vector diverges,
+        # which is the case the cross-replica agreement digest catches
+        j = jnp.mod(rank_i + 1, w)
+        row = jnp.take(gathered, j, axis=0)
+        new_row = jnp.where(f_code == 2, gwire, row)   # stale: own row
+        new_row = jnp.where(f_code == 1, _flip_first_bit(row), new_row)
+        new_row = jnp.where(f_code == 3, jnp.zeros_like(row), new_row)
+        gathered = jnp.where(on_me, gathered.at[j].set(new_row), gathered)
+    if block_scale:
+        full = jax.vmap(lambda r: unpack_exmy_blocked(
+            r, exp, man, chunk, block_size))(gathered)
+    else:
+        full = (unpack_exmy(gathered, exp, man) if packed else gathered)
+    full = full.reshape(-1)[:n]
     if not verify:
         return full
+
+    # one tiny all_gather carries the whole report exchange: each rank's
+    # gather-row tag, its gathered-wire digest, and its hop-bad count —
+    # totals and the agreement verdict derive locally; only the
+    # per-rank gather-row verdicts (which compare the LOCAL copies of
+    # the gathered rows) still need one scalar psum.
+    #
+    # The agreement value is the digest of this rank's WHOLE gathered
+    # wire, composed from the per-row digests via `digest_concat` — the
+    # rows were just digested for the tag compare, so agreement costs
+    # O(W) scalar folds instead of a second full-vector hash pass
+    # (digesting the reconstructed fp32 vector measured as a dominant
+    # verify cost, docs/PERF.md).  Coverage is unchanged: `full` is a
+    # deterministic pure function of the gathered wire (`from_wire` is
+    # shared code), so replicas agreeing on every gathered byte agree
+    # on the reconstructed vector bit-for-bit.
+    from .integrity import digest_concat, tag_from_digest
+    gtag = hop_tag(gwire, jnp.int32(0), rank_i)
+    row_digests = jax.vmap(wire_digest)(gathered)
+    row_tags = jax.vmap(
+        lambda d, i: tag_from_digest(d, jnp.int32(0), i))(
+            row_digests, jnp.arange(w, dtype=jnp.int32))
+    row_words = int(np.prod(gathered.shape[1:]))
+    full_digest = row_digests[0]
+    for i in range(1, w):
+        full_digest = digest_concat(full_digest, i * row_words,
+                                    row_digests[i])
+    rep = lax.all_gather(
+        jnp.stack([gtag, full_digest, hop_bad.astype(jnp.uint32)]),
+        axis_name, axis=0, tiled=False)
+    gather_bad = jnp.sum((row_tags != rep[:, 0]).astype(jnp.int32))
     report = {
-        "hop_bad": lax.psum(hop_bad, axis_name),
+        "hop_bad": jnp.sum(rep[:, 2].astype(jnp.int32)),
         "gather_bad": lax.psum(gather_bad, axis_name),
-        "agree": digest_agree(wire_digest(full), axis_name),
+        "agree": jnp.all(rep[:, 1] == rep[0, 1]).astype(jnp.int32),
     }
     report["ok"] = ((report["hop_bad"] == 0) & (report["gather_bad"] == 0)
                     & (report["agree"] == 1)).astype(jnp.int32)
@@ -405,17 +593,21 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
 def ring_oracle_sum(stacked: jnp.ndarray, exp: int, man: int, *,
                     use_kahan: bool = False, key=None,
                     offset_start: int = 0,
-                    offsets: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                    offsets: Optional[jnp.ndarray] = None,
+                    block_scale: bool = False,
+                    block_size: int = 128) -> jnp.ndarray:
     """Single-device oracle for the ring transport: given the stacked
     per-rank contributions (W, *shape), reproduce `ring_quantized_sum`'s
     result bit-for-bit — the per-chunk rank rotation, the per-hop casts
     with their (step, site, global-offset) SR bit indexing, the (8,23)
-    fp32 shortcut, everything except the wire.
+    fp32 shortcut, and (``block_scale=True``) the block-scaled hop
+    quantizer with its chunk-local block boundaries — everything except
+    the wire.
 
     The distributed path and this oracle share the hop-body functions
-    (`_hop_plain` / `_hop_kahan` / `_make_hop_q`), so a divergence can
-    only come from the transport itself — which is exactly what the
-    oracle-parity tests gate."""
+    (`_hop_plain` / `_hop_kahan` / `_make_hop_q`, the latter carrying
+    the blocked cast), so a divergence can only come from the transport
+    itself — which is exactly what the oracle-parity tests gate."""
     w = stacked.shape[0]
     n = int(stacked[0].size)
     shape = stacked.shape[1:]
@@ -437,7 +629,8 @@ def ring_oracle_sum(stacked: jnp.ndarray, exp: int, man: int, *,
         offs = (jnp.uint32(offset_start)
                 + (c_idx.astype(jnp.uint32) * jnp.uint32(chunk))[..., None]
                 + jnp.arange(chunk, dtype=jnp.uint32)[None, None, :])[0]
-    q = _make_hop_q(exp, man, key)
+    q = _make_hop_q(exp, man, key,
+                    block=block_size if block_scale else None)
     fp32_shortcut = exp == 8 and man == 23 and not use_kahan
 
     def body(carry, xs):
@@ -463,7 +656,9 @@ def hierarchical_ring_sum(flat: jnp.ndarray, axis_names, exp: int, man: int,
                           fused: Optional[bool] = None,
                           interpret: bool = False,
                           verify: bool = False,
-                          fault: Optional[tuple] = None):
+                          fault: Optional[tuple] = None,
+                          block_scale: bool = False,
+                          block_size: int = 128):
     """Ring all-reduce composed over one OR several mesh axes.
 
     A single axis (plain string, or a 1-tuple) is exactly
@@ -498,7 +693,8 @@ def hierarchical_ring_sum(flat: jnp.ndarray, axis_names, exp: int, man: int,
         raise ValueError("hierarchical_ring_sum needs at least one axis")
     kw = dict(use_kahan=use_kahan, offset_start=offset_start,
               offsets=offsets, packed=packed, fused=fused,
-              interpret=interpret)
+              interpret=interpret, block_scale=block_scale,
+              block_size=block_size)
     if len(axes) == 1:
         return ring_quantized_sum(flat, axes[0], exp, man, key=key,
                                   verify=verify, fault=fault, **kw)
@@ -548,8 +744,9 @@ def hierarchical_ring_sum(flat: jnp.ndarray, axis_names, exp: int, man: int,
 def ring_oracle_sum_multi(stacked: jnp.ndarray, n_axes: int, exp: int,
                           man: int, *, use_kahan: bool = False, key=None,
                           offset_start: int = 0,
-                          offsets: Optional[jnp.ndarray] = None
-                          ) -> jnp.ndarray:
+                          offsets: Optional[jnp.ndarray] = None,
+                          block_scale: bool = False,
+                          block_size: int = 128) -> jnp.ndarray:
     """Single-device oracle for `hierarchical_ring_sum`: ``stacked`` has
     shape ``(W_0, ..., W_{k-1}, *leaf)`` with the leading dims in mesh
     AXIS-NAME order; the reduction folds the LAST leading axis first
@@ -561,7 +758,8 @@ def ring_oracle_sum_multi(stacked: jnp.ndarray, n_axes: int, exp: int,
         raise ValueError(f"n_axes={n_axes} does not fit stacked shape "
                          f"{stacked.shape}")
     kw = dict(use_kahan=use_kahan, offset_start=offset_start,
-              offsets=offsets)
+              offsets=offsets, block_scale=block_scale,
+              block_size=block_size)
     if n_axes == 1:
         return ring_oracle_sum(stacked, exp, man, key=key, **kw)
     vec = stacked
@@ -579,36 +777,55 @@ def ring_oracle_sum_multi(stacked: jnp.ndarray, n_axes: int, exp: int,
 
 def ring_transport_bytes(n: int, world: int, exp: int, man: int, *,
                          use_kahan: bool = False,
-                         packed: bool = True) -> int:
+                         packed: bool = True,
+                         block_size: Optional[int] = None) -> int:
     """Analytic per-device wire bytes for one ring all-reduce of n
     elements: (W-1) reduce-scatter hops of one chunk (×2 with Kahan — the
-    compensation rides) plus (W-1) all-gather hops of one chunk."""
+    compensation rides) plus (W-1) all-gather hops of one chunk.
+
+    ``block_size`` prices the block-scaled wire: every chunk payload
+    carries its sidecar lane (one shift byte per block, odd tail block
+    included) next to the code words — the sidecar is EXPLICIT here, and
+    tests pin this formula against real `pack_exmy_blocked` buffer
+    sizes so the analytics can never silently under-report the wire."""
     if n == 0 or world <= 0:
         return 0
     chunk = ring_chunk_size(n, world)
-    per_elem = wire_bytes(exp, man) if packed else 4
-    reduce_phase = (world - 1) * chunk * per_elem * (2 if use_kahan else 1)
-    gather_phase = (world - 1) * chunk * per_elem
+    if block_size is not None:
+        per_chunk = wire_bytes_blocked(exp, man, chunk, block_size)
+    else:
+        per_chunk = chunk * (wire_bytes(exp, man) if packed else 4)
+    reduce_phase = (world - 1) * per_chunk * (2 if use_kahan else 1)
+    gather_phase = (world - 1) * per_chunk
     return reduce_phase + gather_phase
 
 
 def gather_transport_bytes(n: int, world: int, exp: int, man: int, *,
-                           compressed: bool = False) -> int:
+                           compressed: bool = False,
+                           block_size: Optional[int] = None) -> int:
     """Analytic per-device wire bytes for the faithful all_gather path:
     (W-1)·n elements, raw fp32 unless the APS-prequantized wire packing
-    applies (`compressed`)."""
+    applies (`compressed`).  ``block_size`` adds the sidecar bytes a
+    block-scaled row would carry ((W-1) rows × one shift byte per
+    block) — analytic only; the faithful gather ships per-tensor today,
+    but the ledger must price the alternative honestly."""
     if n == 0 or world <= 0:
         return 0
+    if block_size is not None:
+        return (world - 1) * wire_bytes_blocked(exp, man, n, block_size)
     per_elem = wire_bytes(exp, man) if compressed else 4
     return (world - 1) * n * per_elem
 
 
 def transport_table(n: int, world: int, exp: int, man: int,
-                    use_kahan: bool = False) -> dict:
+                    use_kahan: bool = False,
+                    block_size: Optional[int] = None) -> dict:
     """Analytic per-device bytes-on-wire for every transport of one
     all-reduce of n elements — the payload of bench.py's `reduction`
     block and tools/bench_reduce.py.  One home for the comparison so the
-    ledger, the tool and docs/PERF.md's table cannot drift."""
+    ledger, the tool and docs/PERF.md's table cannot drift.  With
+    ``block_size`` the table adds the block-scaled ring row (code words
+    + sidecar lane, both counted)."""
     compressible = man >= 2 and wire_bytes(exp, man) < 4
     gather = gather_transport_bytes(n, world, exp, man, compressed=False)
     table = {
@@ -619,6 +836,10 @@ def transport_table(n: int, world: int, exp: int, man: int,
         "ring_packed": ring_transport_bytes(n, world, exp, man,
                                             use_kahan=use_kahan,
                                             packed=compressible),
+        "ring_block_scaled": (
+            ring_transport_bytes(n, world, exp, man, use_kahan=use_kahan,
+                                 block_size=block_size)
+            if block_size is not None and compressible else None),
         # XLA lowers psum as a ring reduce-scatter + all-gather on the
         # TPU torus, but the payload stays fp32 (psum cannot know the
         # values fit a narrower format — EQuARX's whole point), so fast

@@ -52,7 +52,11 @@ import numpy as np
 __all__ = ["cast_to_format", "cast_body", "cast_oracle", "max_finite",
            "cast_body_sr", "cast_to_format_sr", "cast_oracle_sr",
            "sr_bits_at", "cast_to_format_sr_at",
-           "pack_exmy", "unpack_exmy", "wire_bytes", "kv_page_bytes",
+           "pack_exmy", "unpack_exmy", "pack_code", "unpack_code",
+           "wire_bytes", "kv_page_bytes",
+           "block_shifts", "cast_body_blocked", "cast_to_format_blocked",
+           "pack_exmy_blocked", "unpack_exmy_blocked", "sidecar_bytes",
+           "wire_bytes_blocked", "format_max_exponent",
            "quant_health", "cast_to_format_stats", "HEALTH_FIELDS",
            "FP32_EXP_BITS", "FP32_MAN_BITS"]
 
@@ -438,16 +442,17 @@ def _join_bytes(packed: jnp.ndarray) -> jnp.ndarray:
     return code
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def pack_exmy(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
-    """Pack fp32 values already in the (exp_bits, man_bits) value set into
-    little-endian uint8 code words of shape ``x.shape + (wire_bytes(),)``."""
+def pack_code(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Un-jitted pack body: fp32 values in the (exp_bits, man_bits) value
+    set -> uint32 code words.  Pure bit arithmetic on ops Mosaic
+    supports, so the SAME code is the XLA packer (`pack_exmy`) and the
+    fused Pallas wire kernel's pack stage (ops/quantize.py) — the
+    `cast_body` pattern applied to the codec."""
     _validate_wire(exp_bits, man_bits)
     x = jnp.asarray(x, jnp.float32)
-    n_bytes = wire_bytes(exp_bits, man_bits)
     bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
     if exp_bits == 8 and man_bits == 23:
-        return _split_bytes(bits, n_bytes)
+        return bits
 
     sign = (bits >> 31) & jnp.uint32(1)
     exp_f = ((bits >> 23) & jnp.uint32(0xFF)).astype(jnp.int32)
@@ -481,21 +486,24 @@ def pack_exmy(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
                      | jnp.uint32(1), code)
     code = jnp.where(is_inf, (sign << (exp_bits + man_bits)) | top, code)
     code = jnp.where(is_nan, top | jnp.uint32(2), code)
-    return _split_bytes(code, n_bytes)
+    return code
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
-def unpack_exmy(packed: jnp.ndarray, exp_bits: int,
+def pack_exmy(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Pack fp32 values already in the (exp_bits, man_bits) value set into
+    little-endian uint8 code words of shape ``x.shape + (wire_bytes(),)``."""
+    return _split_bytes(pack_code(x, exp_bits, man_bits),
+                        wire_bytes(exp_bits, man_bits))
+
+
+def unpack_code(code: jnp.ndarray, exp_bits: int,
                 man_bits: int) -> jnp.ndarray:
-    """Inverse of `pack_exmy`: uint8 ``(..., wire_bytes())`` -> fp32 ``(...)``
-    with the exact bit patterns the cast produced."""
+    """Un-jitted unpack body: uint32 code words -> the exact fp32 bit
+    patterns the cast produced.  Mosaic-safe twin of `pack_code` (see
+    its docstring); `unpack_exmy` and the fused hop kernel share it."""
     _validate_wire(exp_bits, man_bits)
-    n_bytes = wire_bytes(exp_bits, man_bits)
-    packed = jnp.asarray(packed, jnp.uint8)
-    if packed.shape[-1] != n_bytes:
-        raise ValueError(f"trailing axis {packed.shape[-1]} != "
-                         f"wire_bytes({exp_bits}, {man_bits}) = {n_bytes}")
-    code = _join_bytes(packed)
+    code = jnp.asarray(code, jnp.uint32)
     if exp_bits == 8 and man_bits == 23:
         return jax.lax.bitcast_convert_type(code, jnp.float32)
 
@@ -525,6 +533,267 @@ def unpack_exmy(packed: jnp.ndarray, exp_bits: int,
     val = jnp.where(sign, -mag, mag)
     return jnp.where(is_special & (man_field >= 2), jnp.float32(jnp.nan),
                      val)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def unpack_exmy(packed: jnp.ndarray, exp_bits: int,
+                man_bits: int) -> jnp.ndarray:
+    """Inverse of `pack_exmy`: uint8 ``(..., wire_bytes())`` -> fp32 ``(...)``
+    with the exact bit patterns the cast produced."""
+    n_bytes = wire_bytes(exp_bits, man_bits)
+    packed = jnp.asarray(packed, jnp.uint8)
+    if packed.shape[-1] != n_bytes:
+        raise ValueError(f"trailing axis {packed.shape[-1]} != "
+                         f"wire_bytes({exp_bits}, {man_bits}) = {n_bytes}")
+    return unpack_code(_join_bytes(packed), exp_bits, man_bits)
+
+
+# --------------------------------------------------------------------------
+# Block-scaled eXmY codec (EQuARX-style, PAPERS.md #2; the ring transport's
+# `block_scale=` wire, parallel/ring.py).
+#
+# APS (parallel/aps.py) shifts exponents per-TENSOR: one shared scale for
+# every element of a leaf, chosen from the global max.  A tensor whose
+# blocks span very different magnitudes then wastes the format's dynamic
+# range everywhere except near the max — small-magnitude regions flush.
+# Block scaling shares one power-of-2 scale per BLOCK of `block_size`
+# consecutive elements instead: each block's values are scaled so its own
+# max sits at the format's top normal exponent, cast to (exp, man), and
+# the 1-byte shift rides the wire as a sidecar lane next to the packed
+# code words.  An e4m3 code word + 1/block_size sidecar bytes then covers
+# the dynamic range a per-tensor e5m7 cannot — the new accuracy/bytes
+# frontier point tools/bench_reduce.py --block-sweep measures.
+#
+# Semantics (beyond-reference — the reference has no blocked cast):
+#
+#   per block b (blocks along the LAST axis; the tail block may be short):
+#     E_b  = floor(log2(max finite |x| in b))     (0 if no finite nonzero)
+#     k_b  = clip(E_b - emax, -128, 127)          emax = max_finite's exp
+#     y    = x * 2^-k_b                           (exact power-of-2 scale)
+#     q_s  = cast(y, exp, man)                    (RTNE or SR)
+#     q_s  = +/-max_finite where a FINITE y rounded past the format max
+#            (the reference cast's carry quirk, float_kernel.cu:71 —
+#            clamped HERE so the scale derivation is a fixed point: the
+#            quantized block max keeps exponent emax, so re-deriving k_b
+#            from the output reproduces k_b exactly, which is what makes
+#            `pack_exmy_blocked` idempotent/lossless on its output set)
+#     out  = q_s * 2^k_b
+#
+# Inf/NaN pass through the cast and ride the codec's special codes; the
+# shift derivation ignores them (a block of only specials gets k_b = 0).
+# Zeros are invariant under any scale, so the ring's zero padding stays
+# rounding-neutral.  EVERYTHING below the fp32 normal floor — subnormal
+# inputs, -0.0, inputs whose scaled form would be subnormal, and
+# unscaled results that would land there — canonicalizes to +0.0: the
+# reference cast's own subnormal-input flush (float_kernel.cu:87-91)
+# extended to the whole class, because XLA backends FTZ/DAZ subnormals
+# inconsistently across fusion boundaries (and frexp mis-reports them),
+# so any blocked semantics that DISTINGUISHED patterns inside that class
+# would diverge between the distributed ring and its single-device
+# oracle.  With the class flushed, every surviving multiply is an exact
+# normal-range product and the codec round-trip is idempotent.
+#
+# Sidecar lane: one uint8 per block, value k_b + 128.  Wire layout of
+# `pack_exmy_blocked` (last axis): [ n * wire_bytes code bytes | n_blocks
+# sidecar bytes ] — one flat uint8 lane per payload, so the ring's hop
+# digest covers codes AND scales in a single pass.
+# --------------------------------------------------------------------------
+
+
+def format_max_exponent(exp_bits: int) -> int:
+    """Exponent of `max_finite(exp_bits, ·)`: (2^e - 2) - bias."""
+    _validate(exp_bits, 0)
+    return ((1 << exp_bits) - 2) - ((1 << (exp_bits - 1)) - 1)
+
+
+def _scale_pow2(x: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
+    """x * 2^e for integer e in [-252, 253], applied as two sequential
+    exact power-of-two factors (NEVER as a precomputed 2^e scalar, which
+    for |e| > 127 would itself overflow/flush and poison the product).
+    Each factor multiply is exact unless the running result crosses the
+    fp32 subnormal floor or overflows — deterministic either way."""
+    a = jnp.clip(e, -126, 127)
+    return (x * _pow2(a)) * _pow2(jnp.clip(e - a, -126, 126))
+
+
+def sidecar_bytes(n: int, block_size: int) -> int:
+    """Sidecar-lane bytes for n elements at one shift byte per block."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    return -(-n // block_size) if n else 0
+
+
+def wire_bytes_blocked(exp_bits: int, man_bits: int, n: int,
+                       block_size: int) -> int:
+    """Total wire bytes of one block-scaled payload of n elements: the
+    packed code words plus the sidecar lane.  The analytic twin of
+    `pack_exmy_blocked`'s output size (pinned against the real buffer
+    in tests)."""
+    _validate_wire(exp_bits, man_bits)
+    return n * wire_bytes(exp_bits, man_bits) + sidecar_bytes(n, block_size)
+
+
+def _flush_low(x: jnp.ndarray) -> jnp.ndarray:
+    """Canonicalize the entire sub-normal-floor class — fp32 subnormals
+    AND ±0.0 — to +0.0.  XLA backends FTZ/DAZ subnormals inconsistently
+    across fusion boundaries (a subnormal intermediate may reach the
+    next op as ±tiny in one program and as ∓0.0 in another), and frexp
+    mis-reports them outright — so the blocked pipeline flushes the
+    whole CLASS up front: every pattern with a zero exponent field maps
+    to the same +0.0 no matter which form the backend delivered."""
+    bits = jax.lax.bitcast_convert_type(jnp.asarray(x, jnp.float32),
+                                        jnp.uint32)
+    low = ((bits >> 23) & jnp.uint32(0xFF)) == 0
+    return jnp.where(low, jnp.float32(0.0), x)
+
+
+def block_shifts(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                 block_size: int) -> jnp.ndarray:
+    """Per-block power-of-2 shift exponents k_b (int32), blocks of
+    `block_size` along the LAST axis (short tail block included).
+    Shape: x.shape[:-1] + (ceil(n / block_size),).  Sub-2^-126 inputs
+    count as zero (`_flush_low` — the blocked cast flushes them)."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    x = _flush_low(jnp.asarray(x, jnp.float32))
+    n = x.shape[-1]
+    nb = sidecar_bytes(n, block_size)
+    mag = jnp.where(jnp.isfinite(x), jnp.abs(x), 0.0)
+    pad = nb * block_size - n
+    if pad:
+        mag = jnp.pad(mag, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    m_b = jnp.max(mag.reshape(x.shape[:-1] + (nb, block_size)), axis=-1)
+    # floor(log2(m)) via frexp (exact on normals): m = f * 2^e, f in
+    # [0.5, 1)
+    _, e = jnp.frexp(m_b)
+    emax = format_max_exponent(exp_bits)
+    k = jnp.where(m_b > 0, e.astype(jnp.int32) - 1 - emax, 0)
+    return jnp.clip(k, -128, 127)
+
+
+def _per_element_shifts(shifts: jnp.ndarray, n: int,
+                        block_size: int) -> jnp.ndarray:
+    """Broadcast (..., nb) block shifts to (..., n) element shifts."""
+    rep = jnp.repeat(shifts, block_size, axis=-1)
+    return rep[..., :n]
+
+
+def _unscale_flush(q: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """q * 2^k with would-be-fp32-subnormal results flushed to +0.0 (the
+    blocked cast's output flush — see the block comment; shared by the
+    cast and the unpacker so both reconstruct identical bits).
+
+    The flush condition is decided from (q, k) EXPONENT arithmetic, not
+    from the product's bit pattern: XLA backends disagree about whether
+    a subnormal product survives a fusion boundary (CPU FTZ), so a
+    pattern test would flush on one path and miss on another — a ±0.0
+    divergence between the distributed ring and its oracle.  With
+    frexp(|q|) = f · 2^e (f in [0.5, 1)), |q · 2^k| < 2^-126 iff
+    e + k <= -126; everything kept is then a NORMAL product of exactly
+    representable factors — exact on every backend."""
+    _, e = jnp.frexp(q)
+    flush = (jnp.isfinite(q) & (q != 0)
+             & (e.astype(jnp.int32) + k <= -126))
+    out = jnp.where(flush, jnp.float32(0.0), _scale_pow2(q, k))
+    # the base cast's subnormal-target rounding can emit -0.0 (a wiped
+    # negative significand keeps its sign); fold it into the +0.0 class
+    return _flush_low(out)
+
+
+def _block_quantize(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                    block_size: int, rbits=None) -> tuple:
+    """Shared shift-scale-cast-clamp core of the blocked cast and the
+    blocked packer: returns ``(q_scaled, shifts, k_elem)`` — the
+    SCALED-domain quantized values (exactly what the wire's code words
+    encode), the per-block shifts, and the per-element shift broadcast.
+    Sub-floor inputs (and inputs whose scaled form would be fp32-
+    subnormal) flush to +0.0 FIRST, so no multiply or frexp ever sees a
+    pattern a backend's FTZ could have already rewritten."""
+    _validate(exp_bits, man_bits)
+    x = _flush_low(jnp.asarray(x, jnp.float32))
+    shifts = block_shifts(x, exp_bits, man_bits, block_size)
+    k = _per_element_shifts(shifts, x.shape[-1], block_size)
+    _, ex = jnp.frexp(x)
+    tiny = (jnp.isfinite(x) & (x != 0)
+            & (ex.astype(jnp.int32) - 1 - k <= -127))
+    x = jnp.where(tiny, jnp.float32(0.0), x)
+    y = _scale_pow2(x, -k)
+    if rbits is None:
+        q = cast_body(y, exp_bits, man_bits)
+    else:
+        q = cast_body_sr(y, exp_bits, man_bits, rbits)
+    mf = jnp.float32(max_finite(exp_bits, man_bits))
+    carry = jnp.isfinite(y) & (jnp.abs(q) > mf)
+    q = jnp.where(carry, jnp.where(q > 0, mf, -mf), q)
+    return q, shifts, k
+
+
+def cast_body_blocked(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                      block_size: int, rbits=None) -> jnp.ndarray:
+    """Block-scaled eXmY cast (see the block comment above): per-block
+    power-of-2 scale to the format's top exponent, cast (RTNE, or SR when
+    `rbits` is given — same contract as `cast_body_sr`), carry clamped to
+    +/-max_finite, unscale.  The ring's blocked hop quantizer AND
+    `ring_oracle_sum(block_size=...)` share this one body, so the
+    distributed transport and its oracle cannot drift."""
+    q, _, k = _block_quantize(x, exp_bits, man_bits, block_size, rbits)
+    return _unscale_flush(q, k)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def cast_to_format_blocked(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                           block_size: int) -> jnp.ndarray:
+    """Jitted RTNE `cast_body_blocked` (blocks along the last axis)."""
+    return cast_body_blocked(x, exp_bits, man_bits, block_size)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def pack_exmy_blocked(x: jnp.ndarray, exp_bits: int, man_bits: int,
+                      block_size: int) -> jnp.ndarray:
+    """Quantize-and-pack into the block-scaled wire: shift, RTNE-cast
+    (identity when x is already in the blocked value set — SR callers
+    pre-cast with `cast_body_blocked(..., rbits)` and pack losslessly),
+    pack the SCALED code words, and append the sidecar lane.
+
+    Output (last axis): ``n * wire_bytes(exp, man)`` little-endian code
+    bytes followed by ``ceil(n / block_size)`` sidecar bytes (k + 128).
+    Losslessness: ``unpack_exmy_blocked(pack_exmy_blocked(x)) ==
+    cast_body_blocked(x)`` bitwise, and is the identity on anything that
+    already went through the blocked cast at the same (format, block) —
+    the fixed-point shift derivation above is what guarantees the
+    re-derived k_b matches."""
+    _validate_wire(exp_bits, man_bits)
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    q, shifts, _ = _block_quantize(x, exp_bits, man_bits, block_size)
+    codes = pack_exmy(q, exp_bits, man_bits)
+    codes = codes.reshape(x.shape[:-1] + (n * codes.shape[-1],))
+    sidecar = (shifts + 128).astype(jnp.uint8)
+    return jnp.concatenate([codes, sidecar], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def unpack_exmy_blocked(packed: jnp.ndarray, exp_bits: int, man_bits: int,
+                        n: int, block_size: int) -> jnp.ndarray:
+    """Inverse of `pack_exmy_blocked`: split the sidecar lane off the
+    wire, decode the scaled code words, and unscale each block by its
+    ridden 2^k — reproducing the blocked cast's output bit-for-bit."""
+    _validate_wire(exp_bits, man_bits)
+    wb = wire_bytes(exp_bits, man_bits)
+    nb = sidecar_bytes(n, block_size)
+    packed = jnp.asarray(packed, jnp.uint8)
+    if packed.shape[-1] != n * wb + nb:
+        raise ValueError(
+            f"trailing axis {packed.shape[-1]} != wire_bytes_blocked("
+            f"{exp_bits}, {man_bits}, n={n}, block={block_size}) = "
+            f"{n * wb + nb}")
+    codes = packed[..., :n * wb].reshape(packed.shape[:-1] + (n, wb))
+    shifts = packed[..., n * wb:].astype(jnp.int32) - 128
+    q = unpack_exmy(codes, exp_bits, man_bits)
+    k = _per_element_shifts(shifts, n, block_size)
+    return _unscale_flush(q, k)
 
 
 def cast_oracle_sr(x: float, exp_bits: int, man_bits: int, r: int) -> float:

@@ -110,7 +110,8 @@ def parse_ladder(text) -> tuple:
     return fmts
 
 
-def ladder_step_key(transport=None, precision=None, overlap=None):
+def ladder_step_key(transport=None, precision=None, overlap=None,
+                    block=None):
     """The ONE `StepTable` key derivation shared by `run_guarded` and
     the trainer CLIs, covering every supervisor combination:
 
@@ -125,7 +126,17 @@ def ladder_step_key(transport=None, precision=None, overlap=None):
     served to a configuration without it after a ladder transition — the
     PR 5 half-keyed-table bug class, extended to the transport schedule.
     Callers whose run has NO overlap surface pass None and keep the
-    PR 4/5-compatible key shapes."""
+    PR 4/5-compatible key shapes.
+
+    ``block``, when given, is a ``(block_scale, block_size)`` pair
+    appended the same way (ISSUE 9): the block-scaled ring wire is a
+    DIFFERENT documented accumulation numerics (and a different wire
+    layout) than the per-tensor cast, so a step traced with one block
+    coordinate must never be served after a transport/precision ladder
+    transition to a run configured with another — the transport ladder
+    retraces through the blocked rung, the precision ladder re-derives
+    per-block shifts at the new format.  Runs that never touch the
+    block surface pass None and keep the PR 8-compatible key shapes."""
     if transport is not None and precision is not None:
         base = (transport.mode, precision.fmt)
     elif precision is not None:
@@ -134,23 +145,30 @@ def ladder_step_key(transport=None, precision=None, overlap=None):
         base = transport.mode
     else:
         base = None
-    if overlap is None:
-        return base
-    return (base, ("overlap",) + tuple(overlap))
+    if overlap is not None:
+        base = (base, ("overlap",) + tuple(overlap))
+    if block is not None:
+        base = (base, ("block",) + tuple(block))
+    return base
 
 
 def resolve_ladder_key(key, *, transport_on: bool, precision_on: bool,
                        level: str, fmt: tuple,
-                       overlap_on: bool = False) -> tuple:
+                       overlap_on: bool = False,
+                       block_on: bool = False) -> tuple:
     """Inverse of `ladder_step_key` for StepTable build functions: map a
     table key back to ``(transport_level, (exp, man))``, filling the
     coordinate a missing supervisor pins from the run's static config
     (``level`` = the configured --mode, ``fmt`` = the configured
     gradient format).  The ONE unpacking shared by the trainer CLIs so
-    the three-way branch cannot drift between them.  ``overlap_on``
-    strips the key's ``("overlap", ...)`` coordinate first (the builder
-    reads the overlap config from its static flags — the coordinate
-    exists to split the CACHE, not to carry data)."""
+    the three-way branch cannot drift between them.  ``overlap_on`` /
+    ``block_on`` strip the key's ``("overlap", ...)`` / ``("block",
+    ...)`` coordinates first — in reverse append order, block
+    outermost (the builder reads the overlap/block config from its
+    static flags — the coordinates exist to split the CACHE, not to
+    carry data)."""
+    if block_on:
+        key = key[0]
     if overlap_on:
         key = key[0]
     if transport_on and precision_on:

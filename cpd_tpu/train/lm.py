@@ -60,7 +60,9 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                        quant_stats: bool = False,
                        sat_fault_plan=None,
                        overlap_reduce: bool = False,
-                       bucket_elems=None):
+                       bucket_elems=None,
+                       block_scale: bool = False,
+                       block_size: int = 128):
     """Build jitted ``(state, tokens, targets) -> (state, metrics)``.
 
     tokens/targets: (global_batch * emulate_node, T_global) int32, sharded
@@ -88,6 +90,12 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     bucket's tap, so the whole per-leaf reduction chain starts when
     that bucket closes.  Bitwise identical to the monolithic step;
     requires emulate_node == 1.
+
+    block_scale / block_size: the EQuARX-style block-scaled ring wire
+    for the dp reduction, exactly as on `make_train_step` — ring mode
+    only; a distinct accumulation numerics (own StepTable key via
+    `ladder_step_key(block=...)`); composes with overlap_reduce
+    bitwise.  The sp/tp psums are untouched (fp32 XLA collectives).
     """
     if not 0.0 <= label_smoothing < 1.0:
         raise ValueError(f"label_smoothing must be in [0, 1), got "
@@ -101,6 +109,10 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             f"defeats the overlapped schedule, and in-backward taps "
             f"would reduce once per micro-batch instead of once per "
             f"step")
+    if block_scale and mode != "ring":
+        raise ValueError(
+            f"block_scale=True needs mode='ring' (got {mode!r}): the "
+            f"per-block scale sidecar rides the ring's packed wire")
     # Guard: the optimizer update runs shard-local, which is only exact for
     # elementwise transforms (see reject_norm_based).  With tp=1 all params
     # are replicated and grads fully reduced before the update, so
@@ -215,7 +227,9 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 reduce_kw=dict(use_aps=use_aps, grad_exp=grad_exp,
                                grad_man=grad_man, use_kahan=use_kahan,
                                mode=mode, rounding=grad_rounding,
-                               bucket_elems=bucket_elems),
+                               bucket_elems=bucket_elems,
+                               block_scale=block_scale,
+                               block_size=block_size),
                 key=sum_key, sat_factor=sfac, wire_fault=wf,
                 verify=verify_reduce, stats=quant_stats,
                 leaf_pre=leaf_pre)
@@ -250,7 +264,8 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 grad_exp=grad_exp, grad_man=grad_man,
                 use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
                 key=sum_key, verify=verify_reduce, wire_fault=wf,
-                stats=quant_stats, bucket_elems=bucket_elems)
+                stats=quant_stats, bucket_elems=bucket_elems,
+                block_scale=block_scale, block_size=block_size)
             if verify_reduce or quant_stats:
                 reduced, vreport = reduced
 

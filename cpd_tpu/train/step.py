@@ -111,7 +111,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     quant_stats: bool = False,
                     sat_fault_plan: Optional[Any] = None,
                     overlap_reduce: bool = False,
-                    bucket_elems: Optional[int] = None):
+                    bucket_elems: Optional[int] = None,
+                    block_scale: bool = False,
+                    block_size: int = 128):
     """Build the jitted ``(state, images, labels) -> (state, metrics)`` step.
 
     images: (global_batch * emulate_node, H, W, C) sharded over `axis_name`;
@@ -173,6 +175,17 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     own collective (not reduce_in_update).  bucket_elems caps the bucket
     size for BOTH the overlapped taps and the post-backward
     bucketed/ring layouts (default: parallel/dist._BUCKET_ELEMS).
+
+    block_scale / block_size thread the EQuARX-style block-scaled ring
+    wire (`sum_gradients(block_scale=...)`, quant/numerics.py
+    "Block-scaled eXmY codec"): every hop cast shares one power-of-2
+    scale per `block_size` consecutive elements and the 1-byte-per-block
+    shift sidecar rides the packed wire.  Ring mode only (validated at
+    build time — the other transports have no sidecar lane), and a
+    DIFFERENT documented accumulation numerics than per-tensor: steps
+    with and without it are distinct StepTable entries
+    (`ladder_step_key(block=...)`).  Composes with overlap_reduce —
+    overlap on/off stays bitwise identical with block scaling on.
     """
     if grad_rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
@@ -215,6 +228,15 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
         raise ValueError("overlap_reduce=True runs the collective inside "
                          "the backward taps; reduce_in_update hands it "
                          "to the updater (ZeRO-2/3) — pick one owner")
+    if block_scale and mode != "ring":
+        raise ValueError(
+            f"block_scale=True needs mode='ring' (got {mode!r}): the "
+            f"per-block scale sidecar rides the ring's packed wire")
+    if block_scale and reduce_in_update:
+        raise ValueError("block_scale=True needs the step's own "
+                         "sum_gradients call; reduce_in_update hands the "
+                         "collective to the updater (ZeRO-2/3), whose "
+                         "reduce-scatter has no block-scaled wire")
     has_stats_cache: dict = {}
 
     def make_loss_of(world, scale):
@@ -358,7 +380,9 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                 reduce_kw=dict(use_aps=use_aps, grad_exp=grad_exp,
                                grad_man=grad_man, use_kahan=use_kahan,
                                mode=mode, rounding=grad_rounding,
-                               bucket_elems=bucket_elems),
+                               bucket_elems=bucket_elems,
+                               block_scale=block_scale,
+                               block_size=block_size),
                 key=sum_key, sat_factor=sfac, wire_fault=wf,
                 verify=verify_reduce, stats=quant_stats)
             correct, counted = _count_hits(logits, labels)
@@ -393,7 +417,8 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     grad_exp=grad_exp, grad_man=grad_man,
                     use_kahan=use_kahan, mode=mode, rounding=grad_rounding,
                     key=sum_key, verify=verify_reduce, wire_fault=wf,
-                    stats=quant_stats, bucket_elems=bucket_elems)
+                    stats=quant_stats, bucket_elems=bucket_elems,
+                    block_scale=block_scale, block_size=block_size)
                 if verify_reduce or quant_stats:
                     reduced, vreport = reduced
 

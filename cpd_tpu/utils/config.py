@@ -70,6 +70,20 @@ def add_transport_flags(parser: argparse.ArgumentParser) -> None:
                         "earlier in the backward (more overlap) but "
                         "launch more collectives — sweep with "
                         "tools/bench_reduce.py --bucket-sweep")
+    g.add_argument("--block-scale", action="store_true",
+                   help="block-scaled ring wire (EQuARX-style, ISSUE 9): "
+                        "every hop cast shares one power-of-2 scale per "
+                        "--block-size consecutive elements; the 1-byte-"
+                        "per-block shift sidecar rides the packed wire. "
+                        "Recovers per-tensor-e5m7-class accuracy at e4m3 "
+                        "wire bytes (tools/bench_reduce.py --block-sweep)."
+                        "  Requires --mode ring and a packable gradient "
+                        "format (man >= 2)")
+    g.add_argument("--block-size", default=128, type=int,
+                   help="elements per shared-scale block for "
+                        "--block-scale (default 128; multiples of 128 "
+                        "keep the fused Pallas wire kernel eligible — "
+                        "other sizes fall back to the XLA hop bodies)")
 
 
 def overlap_key(args: argparse.Namespace):
@@ -82,6 +96,18 @@ def overlap_key(args: argparse.Namespace):
     if not ov and be is None:
         return None
     return (ov, be)
+
+
+def block_key(args: argparse.Namespace):
+    """The `ladder_step_key(block=...)` coordinate for a parsed CLI:
+    ``(block_scale, block_size)`` when the run turned block scaling on,
+    None otherwise (keeping the PR 8-compatible key shapes for runs
+    that never saw the flags).  Unlike `overlap_key`, a bare
+    ``--block-size`` without ``--block-scale`` stays None — the size is
+    inert until the sidecar wire exists."""
+    if not bool(getattr(args, "block_scale", False)):
+        return None
+    return (True, int(getattr(args, "block_size", 128)))
 
 
 def add_resilience_flags(parser: argparse.ArgumentParser) -> None:

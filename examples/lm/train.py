@@ -303,6 +303,17 @@ def main(argv=None) -> dict:
         raise SystemExit("--overlap-reduce requires --emulate_node 1: "
                          "the micro-batch scan is a barrier that "
                          "defeats the overlapped schedule")
+    if args.block_scale and args.mode != "ring":
+        raise SystemExit("--block-scale needs --mode ring: the per-block "
+                         "scale sidecar rides the ring's packed wire")
+    if args.block_scale and (args.pp > 1 or args.moe):
+        raise SystemExit("--block-scale is wired to the default dp/sp/tp "
+                         "path only (the pp/moe steppers do not thread "
+                         "the blocked wire)")
+    if args.block_scale and args.grad_man < 2:
+        raise SystemExit(f"--block-scale needs a packable gradient format "
+                         f"(man_bits >= 2 for the codec's special codes), "
+                         f"got e{args.grad_exp}m{args.grad_man}")
     if res["active"]:
         # the guard's verdict must be agreed over EVERY mesh axis the
         # update runs under — tp/pp/ep-sharded leaves legitimately hold
@@ -323,16 +334,20 @@ def main(argv=None) -> dict:
     ds = SyntheticText(n=4096, seq_len=args.seq_len,
                        vocab_size=args.vocab_size)
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
-    from cpd_tpu.utils.config import overlap_key
+    from cpd_tpu.utils.config import block_key, overlap_key
     ov_key = overlap_key(args)
+    bk_key = block_key(args)
     quant_kw = dict(use_aps=args.use_APS, grad_exp=args.grad_exp,
                     grad_man=args.grad_man, use_kahan=args.use_kahan,
                     mode=args.mode, grad_rounding=args.grad_rounding,
                     grad_seed=args.grad_seed)
     if not (args.pp > 1 or args.moe):
-        # the overlapped transport rides the default dp/sp/tp step only
+        # the overlapped transport (and the block-scaled ring wire)
+        # ride the default dp/sp/tp step only
         quant_kw.update(overlap_reduce=args.overlap_reduce,
-                        bucket_elems=args.bucket_elems)
+                        bucket_elems=args.bucket_elems,
+                        block_scale=args.block_scale,
+                        block_size=args.block_size)
 
     if args.pp > 1:
         # GPipe pipeline path (parallel/pipeline.py, train/pp.py)
@@ -403,29 +418,38 @@ def main(argv=None) -> dict:
                 from cpd_tpu.parallel.integrity import make_consensus_fns
                 _, resync_fn = make_consensus_fns(mesh, "dp")
             lvl_kw = {k: v for k, v in quant_kw.items()
-                      if k not in ("mode", "grad_exp", "grad_man")}
+                      if k not in ("mode", "grad_exp", "grad_man",
+                                   "block_scale", "block_size")}
 
             def build_step(key):
                 level, fmt = resolve_ladder_key(
                     key, transport_on=supervisor is not None,
                     precision_on=psup is not None, level=args.mode,
                     fmt=(args.grad_exp, args.grad_man),
-                    overlap_on=ov_key is not None)
+                    overlap_on=ov_key is not None,
+                    block_on=bk_key is not None)
                 if supervisor is not None:
                     rkw = level_reduce_kwargs(level, *fmt)
                 else:
                     rkw = dict(mode=level, grad_exp=fmt[0],
                                grad_man=fmt[1])
+                # block scaling only exists on the ring rung at a
+                # packable format (see the resnet18 CLI's gating)
+                blk = (args.block_scale and rkw.get("mode") == "ring"
+                       and fmt[1] >= 2 and fmt != (8, 23))
                 return make_lm_train_step(
                     model, tx, mesh, emulate_node=args.emulate_node,
                     label_smoothing=args.label_smoothing, donate=False,
                     verify_reduce=res["verify"],
                     wire_fault_plan=(res["wire_plan"]
                                      if level == "ring" else None),
+                    block_scale=blk, block_size=args.block_size,
                     **rkw, **lvl_kw, **tele_kw)
 
             step_table = StepTable(build_step)
-            step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
+            step = step_table[ladder_step_key(supervisor, psup,
+                                              overlap=ov_key,
+                                              block=bk_key)]
         else:
             # no ladder (verify off, or a non-ladder mode like fast):
             # verification, when on, is detection-only agreement checking
@@ -460,7 +484,7 @@ def main(argv=None) -> dict:
             meta = manager.metadata()
             if meta and meta.get("precision"):
                 psup.load_state_dict(meta["precision"])
-                step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
+                step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key, block=bk_key)]
                 if rank == 0:
                     print(f"=> resumed precision ladder at {psup.name}"
                           + (" (escalated)" if psup.escalated else ""))
@@ -621,7 +645,7 @@ def main(argv=None) -> dict:
                     meter.bump("transport_downgrades")
                     state = resync_fn(state)
                     meter.bump("resyncs")
-                    step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
+                    step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key, block=bk_key)]
                     if rank == 0:
                         print(f"=> wire fault detected at iter {it} "
                               f"(hop_bad "
@@ -642,7 +666,7 @@ def main(argv=None) -> dict:
             if supervisor is not None and \
                     supervisor.on_success(upd) == "upgrade":
                 meter.bump("transport_upgrades")
-                step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
+                step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key, block=bk_key)]
                 if rank == 0:
                     print(f"=> transport probation passed at iter {it}: "
                           f"back to {supervisor.mode}", file=sys.stderr)
@@ -661,7 +685,7 @@ def main(argv=None) -> dict:
                     meter.bump("precision_escalations"
                                if pact == "escalate"
                                else "precision_deescalations")
-                    step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
+                    step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key, block=bk_key)]
                     if rank == 0:
                         how = ("escalated" if pact == "escalate"
                                else "probation passed: back")
@@ -699,7 +723,8 @@ def main(argv=None) -> dict:
                         psup.load_state_dict(rolled.metadata["precision"])
                         step = step_table[ladder_step_key(supervisor,
                                                           psup,
-                                                          overlap=ov_key)]
+                                                          overlap=ov_key,
+                                                          block=bk_key)]
                     state = relayout(rolled.state)
                     step_no = int(rolled.step)
                     it = step_no + 1

@@ -228,6 +228,18 @@ def main(argv=None) -> dict:
         raise SystemExit("--overlap-reduce requires --emulate_node 1: "
                          "the micro-batch scan is a barrier that "
                          "defeats the overlapped schedule")
+    if args.block_scale and args.mode != "ring":
+        raise SystemExit("--block-scale needs --mode ring: the per-block "
+                         "scale sidecar rides the ring's packed wire")
+    if args.block_scale and (args.zero1 or args.zero2):
+        raise SystemExit("--block-scale tunes the step's own ring "
+                         "reduction; the ZeRO updaters own the collective "
+                         "(reduce_in_update) — run without --zero1/"
+                         "--zero2")
+    if args.block_scale and args.grad_man < 2:
+        raise SystemExit(f"--block-scale needs a packable gradient format "
+                         f"(man_bits >= 2 for the codec's special codes), "
+                         f"got e{args.grad_exp}m{args.grad_man}")
     if res["active"]:
         tx = res["wrap_tx"](tx, axis_name="dp")
     injector, watchdog = res["injector"], res["watchdog"]
@@ -347,8 +359,9 @@ def main(argv=None) -> dict:
         state, extra = zero.mesh_layout(state, mesh)
         to_ckpt = zero.export_state
 
-    from cpd_tpu.utils.config import overlap_key
+    from cpd_tpu.utils.config import block_key, overlap_key
     ov_key = overlap_key(args)
+    bk_key = block_key(args)
     step_kw = dict(emulate_node=args.emulate_node, use_aps=args.use_APS,
                    use_kahan=args.use_kahan,
                    grad_rounding=args.grad_rounding,
@@ -376,20 +389,30 @@ def main(argv=None) -> dict:
                 key, transport_on=supervisor is not None,
                 precision_on=psup is not None, level=args.mode,
                 fmt=(args.grad_exp, args.grad_man),
-                overlap_on=ov_key is not None)
+                overlap_on=ov_key is not None,
+                block_on=bk_key is not None)
             if supervisor is not None:
                 rkw = level_reduce_kwargs(level, *fmt)
             else:
                 rkw = dict(mode=level, grad_exp=fmt[0], grad_man=fmt[1])
+            # block scaling only exists on the ring rung at a packable
+            # format: a transport downgrade (faithful/fp32) or a
+            # precision escalation to (8, 23) retraces WITHOUT the
+            # sidecar wire — rung validity beats knob persistence
+            blk = (args.block_scale and rkw.get("mode") == "ring"
+                   and fmt[1] >= 2 and fmt != (8, 23))
             return make_train_step(
                 model, tx, mesh, donate=False,
                 verify_reduce=res["verify"],
                 wire_fault_plan=(res["wire_plan"] if level == "ring"
                                  else None),
+                block_scale=blk, block_size=args.block_size,
                 **rkw, **step_kw)
 
         step_table = StepTable(build_step)
-        train_step = step_table[ladder_step_key(supervisor, psup, overlap=ov_key)]
+        train_step = step_table[ladder_step_key(supervisor, psup,
+                                                overlap=ov_key,
+                                                block=bk_key)]
     else:
         # no ladder (verify off, or a non-ladder mode like fast):
         # verification, when on, is detection-only agreement checking
@@ -398,7 +421,9 @@ def main(argv=None) -> dict:
             model, tx, mesh, grad_exp=args.grad_exp,
             grad_man=args.grad_man, mode=args.mode,
             verify_reduce=res["verify"],
-            wire_fault_plan=res["wire_plan"], **step_kw)
+            wire_fault_plan=res["wire_plan"],
+            block_scale=args.block_scale, block_size=args.block_size,
+            **step_kw)
     eval_step = make_eval_step(model, mesh)
 
     # Global per-step batch = per-chip batch x chips x emulated nodes
@@ -585,7 +610,8 @@ def main(argv=None) -> dict:
                     meter.bump("resyncs")
                     train_step = step_table[ladder_step_key(supervisor,
                                                             psup,
-                                                            overlap=ov_key)]
+                                                            overlap=ov_key,
+                                                            block=bk_key)]
                     if rank == 0:
                         print(f"=> wire fault detected at iter "
                               f"{step_no + 1} (hop_bad "
@@ -608,7 +634,8 @@ def main(argv=None) -> dict:
                 meter.bump("transport_upgrades")
                 train_step = step_table[ladder_step_key(supervisor,
                                                             psup,
-                                                            overlap=ov_key)]
+                                                            overlap=ov_key,
+                                                            block=bk_key)]
                 if rank == 0:
                     print(f"=> transport probation passed at iter "
                           f"{step_no + 1}: back to {supervisor.mode}",
@@ -630,7 +657,8 @@ def main(argv=None) -> dict:
                                else "precision_deescalations")
                     train_step = step_table[ladder_step_key(supervisor,
                                                             psup,
-                                                            overlap=ov_key)]
+                                                            overlap=ov_key,
+                                                            block=bk_key)]
                     if rank == 0:
                         how = ("escalated" if pact == "escalate"
                                else "probation passed: back")

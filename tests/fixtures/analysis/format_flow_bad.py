@@ -3,7 +3,9 @@ reaches the ring wire through a call, (exp, man) swapped across a call
 boundary, and pack/unpack width drift (local + through a callee)."""
 
 from cpd_tpu.parallel.dist import sum_gradients
-from cpd_tpu.quant.numerics import cast_to_format, pack_exmy, unpack_exmy
+from cpd_tpu.quant.numerics import (cast_to_format, pack_exmy,
+                                    pack_exmy_blocked, unpack_exmy,
+                                    unpack_exmy_blocked)
 
 
 def run_reduce(grads, ladder, mode):
@@ -41,3 +43,25 @@ def cross_function_drift(x):
     payload = make_wire(x)
     # BAD: packer (through the callee) says e5m7, unpacker says e5m2
     return unpack_exmy(payload, 5, 2)
+
+
+def blocked_size_drift(x, n):
+    wire = pack_exmy_blocked(x, 4, 3, 128)
+    # BAD: same format, WRONG block size — the sidecar lane re-slices
+    # at the wrong block boundaries; every element unscales by a wrong
+    # 2^k, bitwise-silently
+    return unpack_exmy_blocked(wire, 4, 3, n, 64)
+
+
+def blocked_into_per_tensor(x):
+    wire = pack_exmy_blocked(x, 5, 2, 32)
+    # BAD: block-scaled wire into the per-tensor unpacker — the sidecar
+    # scale lane is decoded as code words and every 2^k is dropped
+    return unpack_exmy(wire, 5, 2)
+
+
+def per_tensor_into_blocked(x, n):
+    wire = pack_exmy(x, 5, 2)
+    # BAD: per-tensor wire into the blocked unpacker — there is no
+    # sidecar lane; the last code bytes are read as scale shifts
+    return unpack_exmy_blocked(wire, 5, 2, n, 32)

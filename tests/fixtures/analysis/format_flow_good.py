@@ -6,7 +6,9 @@ pytest.raises block asserting the rejection (not hitting it)."""
 import pytest
 
 from cpd_tpu.parallel.dist import sum_gradients
-from cpd_tpu.quant.numerics import cast_to_format, pack_exmy, unpack_exmy
+from cpd_tpu.quant.numerics import (cast_to_format, pack_exmy,
+                                    pack_exmy_blocked, unpack_exmy,
+                                    unpack_exmy_blocked)
 
 
 def run_reduce(grads, ladder, mode):
@@ -50,3 +52,10 @@ def make_wire(x):
 def cross_function_round_trip(x):
     payload = make_wire(x)
     return unpack_exmy(payload, 5, 7)
+
+
+def blocked_round_trip(x, n):
+    # matching (format, block) pair: the sidecar lane slices exactly
+    # where it was written
+    wire = pack_exmy_blocked(x, 4, 3, 128)
+    return unpack_exmy_blocked(wire, 4, 3, n, 128)

@@ -84,7 +84,7 @@ def test_bad_fixture_finding_counts():
                 "pallas-hygiene": 5, "kahan-ordering": 3, "donation": 2,
                 "swallow": 4,
                 # v2 (whole-program + compat inventory) rules
-                "format-flow": 4, "axis-flow": 2,
+                "format-flow": 7, "axis-flow": 2,
                 "collective-contract": 4, "retrace": 5,
                 "compat-drift": 5}
     assert set(expected) == set(RULE_IDS), "new rule missing a count pin"
@@ -255,6 +255,35 @@ def test_format_flow_ladder_crosses_files(tmp_path):
     root2 = _write_tree(tmp_path / "2", {
         "lib.py": lib,
         "cli.py": cli.replace("e5m2,e8m1", "e5m2,e8m10")})
+    assert lint_tree([root2], select=["format-flow"]) == []
+
+
+def test_format_flow_block_drift_crosses_files(tmp_path):
+    """A block-scaled wire packed in one file and unpacked at a
+    different block size in another is a finding (the ("packed", fmt,
+    block) lattice value survives the call boundary); the matching
+    pair is clean."""
+    lib = """
+        from cpd_tpu.quant.numerics import pack_exmy_blocked
+
+        def make_wire(x):
+            return pack_exmy_blocked(x, 4, 3, 128)
+    """
+    cli = """
+        from lib import make_wire
+        from cpd_tpu.quant.numerics import unpack_exmy_blocked
+
+        def restore(x, n):
+            return unpack_exmy_blocked(make_wire(x), 4, 3, n, 64)
+    """
+    root = _write_tree(tmp_path, {"lib.py": lib, "cli.py": cli})
+    findings = lint_tree([root], select=["format-flow"])
+    assert [f.rule for f in findings] == ["format-flow"]
+    assert findings[0].path.endswith("cli.py")
+    assert "block" in findings[0].message
+
+    root2 = _write_tree(tmp_path / "2", {
+        "lib.py": lib, "cli.py": cli.replace("n, 64", "n, 128")})
     assert lint_tree([root2], select=["format-flow"]) == []
 
 
@@ -502,7 +531,7 @@ def test_live_suppression_count_is_pinned():
                         f"{path}:{tok.start[0]}: suppression without a "
                         f"written justification: {payload!r}")
                     sites.append((path, tok.start[0], payload))
-    assert len(sites) == 5, (
+    assert len(sites) == 6, (
         "live-tree suppression count changed — review the new/removed "
         "site's justification and re-pin:\n" + "\n".join(
             f"{p}:{ln}: {pl}" for p, ln, pl in sites))

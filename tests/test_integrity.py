@@ -254,3 +254,37 @@ def test_digest_agree_inside_shard_map():
                            out_specs=(P(), P()), check_vma=False))
     same, diff = fn(jnp.arange(8.0))
     assert int(same) == 1 and int(diff) == 0
+
+
+def test_wire_digest_u8_fast_path_matches_reference():
+    """The chunked-u8 fast path (size > 4096) and the generic word path
+    are the SAME Fletcher function — pinned against a pure-numpy
+    reference at sizes straddling every chunk boundary."""
+    rng = np.random.RandomState(7)
+    for n in (1, 4095, 4096, 4097, 8192, 100_003):
+        b = rng.randint(0, 256, n).astype(np.uint8)
+        w = b.astype(np.uint64)
+        pos = (np.arange(n, dtype=np.uint64) % 65521) + 1
+        s1 = int(w.sum() % 65521)
+        s2 = int((w * pos).sum() % 65521)
+        want = (s2 << 16) | s1
+        assert int(wire_digest(jnp.asarray(b))) == want, n
+
+
+def test_mod65521_exact_over_uint32():
+    from cpd_tpu.parallel.integrity import _mod65521
+    edge = np.array([0, 1, 65520, 65521, 65522, 2**16 - 1, 2**16,
+                     2**32 - 1, 65521 * 65521, 2**31], dtype=np.uint64)
+    rng = np.random.RandomState(11)
+    x = np.concatenate([edge, rng.randint(0, 2**32, 4096, np.uint64)])
+    got = np.asarray(_mod65521(jnp.asarray(x.astype(np.uint32))))
+    np.testing.assert_array_equal(got, (x % 65521).astype(np.uint32))
+
+
+def test_kernel_digest_modulus_pinned_to_integrity():
+    """integrity.py is an import-leaf, so the fused kernels carry their
+    own copy of the Fletcher modulus — this is the one place the two
+    constants are tied together."""
+    from cpd_tpu.ops.quantize import _DIGEST_MOD
+    from cpd_tpu.parallel.integrity import DIGEST_MOD
+    assert _DIGEST_MOD == DIGEST_MOD == 65521

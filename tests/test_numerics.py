@@ -158,3 +158,91 @@ def test_grad_and_vmap_safe():
     assert g.shape == (4, 4)  # zero-grad (bit ops) but must not crash
     vm = jax.vmap(lambda t: cast_to_format(t, 5, 2))(jnp.ones((3, 8)))
     assert vm.shape == (3, 8)
+
+
+# ---------------------------------------------------------------------------
+# Block-scaled cast (ISSUE 9) — the codec wire tests live in
+# test_ring.py; here: the cast semantics and the crafted probe where
+# per-block scaling provably beats per-tensor APS.
+# ---------------------------------------------------------------------------
+
+import jax  # noqa: E402
+
+from cpd_tpu.quant.numerics import (block_shifts,  # noqa: E402
+                                    cast_body_blocked,
+                                    cast_to_format_blocked,
+                                    format_max_exponent, quant_health)
+
+
+def test_format_max_exponent_closed_form():
+    assert format_max_exponent(4) == 7
+    assert format_max_exponent(5) == 15
+    assert format_max_exponent(8) == 127
+    assert format_max_exponent(2) == 1
+
+
+def test_block_shifts_land_each_block_at_the_top():
+    """Every block's max lands at the format's top normal exponent, the
+    odd tail block gets its own shift, all-zero and all-special blocks
+    shift by 0."""
+    x = jnp.asarray(np.array(
+        [2.0 ** 20] * 4 + [2.0 ** -20] * 4 + [0.0] * 4
+        + [np.inf, np.nan, np.inf, -np.inf] + [3.0, 3.0], np.float32))
+    k = np.asarray(block_shifts(x, 4, 3, 4))
+    assert k.shape == (5,)
+    assert k[0] == 20 - 7          # floor(log2(2^20)) - emax
+    assert k[1] == -20 - 7
+    assert k[2] == 0               # all zeros
+    assert k[3] == 0               # specials ignored
+    assert k[4] == 1 - 7           # tail block of two 3.0s
+    # and the blocked cast is exact on each block's max power of two
+    q = np.asarray(cast_to_format_blocked(x, 4, 3, 4))
+    assert q[0] == np.float32(2.0 ** 20)
+    assert q[4] == np.float32(2.0 ** -20)
+
+
+def test_blocked_cast_low_class_canonicalizes():
+    """-0.0, fp32 subnormals, and results that would land below the
+    fp32 normal floor all come out as +0.0 exactly."""
+    x = jnp.asarray(np.array([-0.0, 1e-45, -1e-39, 0.0, 1.0, -1.0],
+                             np.float32))
+    q = np.asarray(cast_body_blocked(x, 5, 2, 2))
+    assert (q[:4].view(np.uint32) == 0).all()
+    assert q[4] == 1.0 and q[5] == -1.0
+
+
+def test_blocked_cast_specials_passthrough():
+    x = jnp.asarray(np.array([np.inf, -np.inf, np.nan, 2.0],
+                             np.float32))
+    q = np.asarray(cast_body_blocked(x, 4, 3, 4))
+    assert np.isinf(q[0]) and q[0] > 0
+    assert np.isinf(q[1]) and q[1] < 0
+    assert np.isnan(q[2])
+    assert q[3] == 2.0
+
+
+def test_blocked_beats_per_tensor_aps_sat_counter_to_zero():
+    """The ISSUE 9 probe: two regimes 2^50 apart.  Per-tensor APS at
+    e4m3 must either saturate the top or flush the bottom (here: the
+    shift protects the top, so the WHOLE bottom region underflows —
+    nonzero counter); the blocked cast's health counters are BOTH
+    exactly zero and every element stays finite and nonzero."""
+    rng = np.random.RandomState(42)
+    hi = (np.abs(rng.randn(64)) + 0.5) * 2.0 ** 25
+    lo = (np.abs(rng.randn(64)) + 0.5) * 2.0 ** -25
+    x = jnp.asarray(np.concatenate([hi, lo]).astype(np.float32))
+
+    # per-tensor APS: shift max|x| to e4's top exponent, cast, unscale
+    shift = 2.0 ** (7 - int(np.floor(np.log2(float(np.max(np.abs(x)))))))
+    q_pt = cast_to_format(x * np.float32(shift), 4, 3)
+    h_pt = jax.tree.map(int, quant_health(x * np.float32(shift), q_pt))
+    assert h_pt["underflow"] == 64       # the whole small regime gone
+
+    q_blk = cast_to_format_blocked(x, 4, 3, 64)
+    h_blk = jax.tree.map(int, quant_health(x, q_blk))
+    assert h_blk["sat"] == 0 and h_blk["underflow"] == 0
+    q = np.asarray(q_blk)
+    assert np.isfinite(q).all() and (q != 0).all()
+    # and the kept values are accurate to the format's relative step
+    rel = np.abs(q - np.asarray(x)) / np.asarray(x)
+    assert rel.max() < 2.0 ** -3

@@ -188,3 +188,117 @@ def test_flash_gqa_routing_and_validation():
     with pytest.raises(ValueError, match="multiple"):
         flash_gqa(q, k[:, :, :1].repeat(3, axis=2), v[:, :, :1].repeat(
             3, axis=2), True)
+
+
+# ---------------------------------------------------------------------------
+# Fused wire kernels (ISSUE 9): one Pallas pass = unpack + accumulate +
+# (block-)scale + quantize + pack + Fletcher digest.  Every stage shares
+# its un-jitted body with the XLA path, so parity is BITWISE — values,
+# wire bytes, AND digest words.
+# ---------------------------------------------------------------------------
+
+import jax  # noqa: E402
+
+from cpd_tpu.ops.quantize import (fletcher_mod65521,  # noqa: E402
+                                  hop_pack_pallas, quantize_pack_pallas)
+from cpd_tpu.parallel.integrity import (digest_concat,  # noqa: E402
+                                        wire_digest)
+from cpd_tpu.quant.numerics import (cast_body_blocked,  # noqa: E402
+                                    pack_exmy, pack_exmy_blocked,
+                                    sr_bits_at, unpack_exmy,
+                                    unpack_exmy_blocked)
+
+
+def test_fletcher_mod65521_matches_modulo():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(np.concatenate([
+        rng.randint(0, 2 ** 32, 4096, np.uint64),
+        [0, 1, 65520, 65521, 65522, 2 ** 32 - 1, 2 ** 16, 2 ** 16 - 1],
+    ]).astype(np.uint32))
+    got = np.asarray(fletcher_mod65521(x))
+    np.testing.assert_array_equal(got, np.asarray(x) % np.uint32(65521))
+
+
+def _wire_xla(g, prev_wire, exp, man, rbits=None, block=None):
+    """The XLA composition of one hop — the reference the kernel must
+    match byte-for-byte."""
+    n = g.size
+    if prev_wire is None:
+        s = g
+    else:
+        if block is None:
+            prev = unpack_exmy(prev_wire, exp, man)
+        else:
+            prev = unpack_exmy_blocked(prev_wire, exp, man, n, block)
+        s = prev + g
+    if block is None:
+        from cpd_tpu.quant.numerics import cast_body, cast_body_sr
+        q = (cast_body(s, exp, man) if rbits is None
+             else cast_body_sr(s, exp, man, rbits))
+        return q, pack_exmy(q, exp, man)
+    q = cast_body_blocked(s, exp, man, block,
+                          rbits=rbits)
+    return q, pack_exmy_blocked(q, exp, man, block)
+
+
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3), (5, 7)])
+@pytest.mark.parametrize("sr", [False, True])
+@pytest.mark.parametrize("block", [None, 128])
+def test_fused_wire_kernels_match_xla_hop(exp, man, sr, block):
+    """hop-0 emit and a mid-hop through the fused kernels == the XLA
+    composition: partials bitwise, wire bytes identical, digests equal
+    `wire_digest` of the full buffers (sidecar included)."""
+    n = 300
+    rng = np.random.RandomState(exp * 10 + man + (7 if sr else 0))
+    g0 = jnp.asarray((rng.randn(n) * 0.4).astype(np.float32))
+    g1 = jnp.asarray((rng.randn(n) * 0.4).astype(np.float32))
+    key = jax.random.PRNGKey(5)
+    offs = jnp.arange(n, dtype=jnp.uint32)
+    rb0 = sr_bits_at(jax.random.fold_in(key, 0), offs) if sr else None
+    rb1 = sr_bits_at(jax.random.fold_in(key, 1), offs) if sr else None
+
+    res0, wire0, d0 = quantize_pack_pallas(
+        g0, exp, man, rbits=rb0, block_size=block, want_digest=True,
+        interpret=True)
+    q0, w0_ref = _wire_xla(g0, None, exp, man, rbits=rb0, block=block)
+    np.testing.assert_array_equal(np.asarray(res0).view(np.uint32),
+                                  np.asarray(q0).view(np.uint32))
+    np.testing.assert_array_equal(np.asarray(wire0).reshape(-1),
+                                  np.asarray(w0_ref).reshape(-1))
+    assert int(d0) == int(wire_digest(w0_ref))
+
+    res1, wire1, d_in, d_out = hop_pack_pallas(
+        wire0, g1, exp, man, rbits=rb1, block_size=block,
+        want_digest=True, interpret=True)
+    q1, w1_ref = _wire_xla(g1, w0_ref, exp, man, rbits=rb1, block=block)
+    np.testing.assert_array_equal(np.asarray(res1).view(np.uint32),
+                                  np.asarray(q1).view(np.uint32))
+    np.testing.assert_array_equal(np.asarray(wire1).reshape(-1),
+                                  np.asarray(w1_ref).reshape(-1))
+    assert int(d_in) == int(wire_digest(w0_ref))
+    assert int(d_out) == int(wire_digest(w1_ref))
+
+    # digest-free variant returns the same wire
+    res1b, wire1b = hop_pack_pallas(wire0, g1, exp, man, rbits=rb1,
+                                    block_size=block, interpret=True)
+    np.testing.assert_array_equal(np.asarray(wire1b).reshape(-1),
+                                  np.asarray(wire1).reshape(-1))
+
+
+def test_fused_blocked_rejects_unaligned_block():
+    g = jnp.zeros(300, jnp.float32)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        quantize_pack_pallas(g, 5, 2, block_size=96, interpret=True)
+
+
+def test_digest_concat_is_concat_digest():
+    """digest_concat(d(a), len(a), d(b)) == wire_digest(a ++ b) — the
+    identity that lets the kernel digest the code lane and XLA digest
+    the sidecar, composing exactly."""
+    rng = np.random.RandomState(3)
+    for la, lb in ((0, 5), (1, 1), (300, 7), (4096, 129)):
+        a = jnp.asarray(rng.randint(0, 256, la, np.int64), jnp.uint8)
+        b = jnp.asarray(rng.randint(0, 256, lb, np.int64), jnp.uint8)
+        got = digest_concat(wire_digest(a), la, wire_digest(b))
+        want = wire_digest(jnp.concatenate([a, b]))
+        assert int(got) == int(want), (la, lb)

@@ -82,7 +82,8 @@ def test_bucket_plan_key_is_hashable_and_layout_sensitive():
 
 def _run_overlapped(mesh, tree, *, mode, bucket_elems, key=None,
                     use_kahan=False, use_aps=False, exp=5, man=2,
-                    verify=False, stats=False):
+                    verify=False, stats=False, block_scale=False,
+                    block_size=128):
     """Reduce `tree`'s per-rank rows through the overlap taps: params of
     ones, loss = sum(p * data), so each rank's cotangent IS its data
     row — the reduced grads equal sum_gradients(data rows)."""
@@ -104,7 +105,9 @@ def _run_overlapped(mesh, tree, *, mode, bucket_elems, key=None,
                            use_kahan=use_kahan, mode=mode,
                            rounding=("stochastic" if key is not None
                                      else "nearest"),
-                           bucket_elems=bucket_elems),
+                           bucket_elems=bucket_elems,
+                           block_scale=block_scale,
+                           block_size=block_size),
             key=key, verify=verify, stats=stats)
         if rep is not None:
             return grads, dict(rep)
@@ -165,6 +168,48 @@ def test_overlap_bitwise_invariance_ring(variant):
                                  use_kahan=kahan)
     for name in tree:
         _bitwise(post[name], overlapped[name], name)
+
+
+@pytest.mark.parametrize("variant", ["nearest", "stochastic", "kahan"])
+def test_overlap_bitwise_invariance_ring_block_scaled(variant):
+    """ISSUE 9 acceptance: overlap on/off stays bitwise identical with
+    block scaling enabled — blocks are chunk-local, so the per-bucket
+    taps reproduce the monolith's block boundaries exactly."""
+    mesh = data_parallel_mesh()
+    tree = _tree(W, seed=5)
+    kahan = variant == "kahan"
+    key = _KEY if variant == "stochastic" else None
+    kw = dict(grad_exp=4, grad_man=3, use_kahan=kahan, mode="ring",
+              bucket_elems=40, block_scale=True, block_size=16)
+    if key is not None:
+        kw.update(rounding="stochastic", key=key)
+    post = _reference(mesh, tree, **kw)
+    overlapped = _run_overlapped(mesh, tree, mode="ring",
+                                 bucket_elems=40, key=key, exp=4, man=3,
+                                 use_kahan=kahan, block_scale=True,
+                                 block_size=16)
+    for name in tree:
+        _bitwise(post[name], overlapped[name], name)
+
+
+def test_train_step_block_scale_bitwise_and_validated():
+    """make_train_step(block_scale=True): overlap on/off bitwise at the
+    step level, and the builder rejects non-ring / reduce_in_update."""
+    from cpd_tpu.train import make_train_step
+    mesh, model, tx, state0, xs, ys = _tiny_setup()
+    kw = dict(use_aps=True, grad_exp=4, grad_man=3, mode="ring",
+              bucket_elems=100, block_scale=True, block_size=32,
+              donate=False)
+    mono = make_train_step(model, tx, mesh, **kw)
+    over = make_train_step(model, tx, mesh, overlap_reduce=True, **kw)
+    sa, _ = mono(state0, xs, ys)
+    sb, _ = over(state0, xs, ys)
+    for pa, pb in zip(jax.tree.leaves(sa.params),
+                      jax.tree.leaves(sb.params)):
+        _bitwise(pa, pb, "block-scaled overlap step != monolith")
+    with pytest.raises(ValueError, match="mode='ring'"):
+        make_train_step(model, tx, mesh, mode="faithful",
+                        block_scale=True)
 
 
 def test_overlap_report_parity_with_monolith():
@@ -500,6 +545,63 @@ def test_ladder_step_key_overlap_coordinate():
     table = StepTable(lambda key: built.append(key) or (lambda *a: key))
     assert table[k1] is not table[k2]
     assert built == [k1, k2]
+
+
+def test_ladder_step_key_block_coordinate():
+    """ISSUE 9 satellite: the block-scaled wire is its own accumulation
+    numerics, so the (block_scale, block_size) coordinate must split
+    the step cache the same way the overlap coordinate does — and
+    compose with it (block appended outermost)."""
+    from cpd_tpu.resilience import (PrecisionSupervisor, StepTable,
+                                    TransportSupervisor, ladder_step_key)
+    from cpd_tpu.resilience.precision import resolve_ladder_key
+    t = TransportSupervisor(start="ring")
+    p = PrecisionSupervisor("e5m2,e5m7")
+    base = ladder_step_key(t, p, overlap=None)
+    assert base == ("ring", (5, 2))          # PR 8 shape preserved
+    kb = ladder_step_key(t, p, overlap=None, block=(True, 128))
+    assert kb == (("ring", (5, 2)), ("block", True, 128))
+    assert kb != ladder_step_key(t, p, overlap=None,
+                                 block=(True, 32)) != base
+    both = ladder_step_key(t, p, overlap=(True, 65536),
+                           block=(True, 128))
+    assert both == ((("ring", (5, 2)), ("overlap", True, 65536)),
+                    ("block", True, 128))
+    # resolve strips block (then overlap) and recovers (level, fmt)
+    assert resolve_ladder_key(
+        kb, transport_on=True, precision_on=True, level="ring",
+        fmt=(5, 2), block_on=True) == ("ring", (5, 2))
+    assert resolve_ladder_key(
+        both, transport_on=True, precision_on=True, level="ring",
+        fmt=(5, 2), overlap_on=True, block_on=True) == ("ring", (5, 2))
+    # distinct keys -> distinct StepTable entries
+    built = []
+    table = StepTable(lambda key: built.append(key) or (lambda *a: key))
+    assert table[kb] is not table[both]
+    assert built == [kb, both]
+
+
+def test_make_sum_gradients_fn_cache_keyed_by_block_coordinate():
+    """The standalone reducer's jit cache key carries the block
+    coordinates — a callable traced for the blocked wire must never
+    serve the per-tensor config (the PR 5 half-keyed-table bug class,
+    extended to the block coordinate)."""
+    from cpd_tpu.parallel import make_sum_gradients_fn
+    mesh = data_parallel_mesh()
+    tree = _tree(W, seed=11)
+    f1 = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=4,
+                               grad_man=3, mode="ring", block_scale=True,
+                               block_size=32)
+    f2 = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=4,
+                               grad_man=3, mode="ring")
+    sharded = _shard(mesh, tree)
+    f1(sharded)
+    f2(sharded)
+    (k1,) = list(f1._cache._d)
+    (k2,) = list(f2._cache._d)
+    assert k1 != k2
+    assert k1[3] is True and k1[4] == 32     # the block coordinates
+    assert k2[3] is False
 
 
 def test_make_sum_gradients_fn_cache_keyed_by_bucket_layout():

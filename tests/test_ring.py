@@ -549,3 +549,277 @@ def test_wire_bytes_table():
     assert wire_bytes(8, 23) == 4
     assert wire_bytes(8, 17) == 4
     assert wire_bytes(6, 9) == 2
+
+
+# ------------------------------------------------ block-scaled wire (ISSUE 9)
+
+from cpd_tpu.parallel.ring import (hierarchical_ring_sum,  # noqa: E402
+                                   ring_oracle_sum_multi, transport_table)
+from cpd_tpu.quant.numerics import (cast_body_blocked,  # noqa: E402
+                                    cast_to_format_blocked,
+                                    pack_exmy_blocked, sidecar_bytes,
+                                    unpack_exmy_blocked, wire_bytes_blocked)
+
+
+def _spread_stack(world, n, seed=0, region=16, spread=30):
+    """Block-structured magnitudes (shared across ranks) — the data
+    per-block scaling exists for."""
+    rng = np.random.RandomState(seed)
+    nr = -(-n // region)
+    scale = np.exp2(rng.uniform(-spread, spread, (1, nr))
+                    ).repeat(region, axis=1)[:, :n]
+    return (rng.randn(world, n) * scale).astype(np.float32)
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("exp,man", [(5, 2), (4, 3)])
+@pytest.mark.parametrize("variant", ["nearest", "stochastic", "kahan"])
+def test_blocked_ring_matches_oracle_bitwise(world, exp, man, variant):
+    """The ISSUE-9 acceptance gate: the block-scaled distributed ring ==
+    the extended single-device oracle, bit for bit, across formats x
+    W in {2,4,8} x RTNE/SR/Kahan — at an ODD block size so every chunk
+    carries a short tail block on the wire."""
+    kahan = variant == "kahan"
+    key = _KEY if variant == "stochastic" else None
+    stacked = _spread_stack(world, 103, seed=world * 10 + exp)
+    got = _run_ring(world, stacked, exp, man, use_kahan=kahan, key=key,
+                    block_scale=True, block_size=33)
+    want = ring_oracle_sum(jnp.asarray(stacked), exp, man,
+                           use_kahan=kahan, key=key, block_scale=True,
+                           block_size=33)
+    _bitwise(got, want, f"W={world} ({exp},{man}) {variant} blocked")
+
+
+@pytest.mark.parametrize("block", [1, 5, 16])
+def test_blocked_ring_block_size_is_a_numerics_knob(block):
+    """Each block size is its own documented accumulation order, gated
+    by its own oracle — and sub-chunk block sizes genuinely differ on
+    spread data (the knob does something).  Blocks are chunk-local
+    (chunk = 25 here), so the contrast arm uses the whole chunk as one
+    block."""
+    stacked = _spread_stack(W, 200, seed=9)
+    got = _run_ring(W, stacked, 4, 3, block_scale=True, block_size=block)
+    want = ring_oracle_sum(jnp.asarray(stacked), 4, 3, block_scale=True,
+                           block_size=block)
+    _bitwise(got, want, f"block={block}")
+    other = _run_ring(W, stacked, 4, 3, block_scale=True, block_size=25)
+    assert (got != other).any()
+
+
+def test_blocked_hierarchical_ring_2d_matches_oracle():
+    mesh_shape = (4, 2)
+    stacked = _spread_stack(1, 8 * 97, seed=11).reshape(4, 2, 97)
+
+    mesh = make_mesh(dp=4, tp=2)
+
+    def body(st):
+        return hierarchical_ring_sum(st[0, 0], ("dp", "tp"), 5, 2,
+                                     key=_KEY, block_scale=True,
+                                     block_size=17)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp", "tp"),),
+                           out_specs=P(), check_vma=False))
+    got = np.asarray(fn(jax.device_put(
+        jnp.asarray(stacked), NamedSharding(mesh, P("dp", "tp")))))
+    want = ring_oracle_sum_multi(jnp.asarray(stacked), 2, 5, 2, key=_KEY,
+                                 block_scale=True, block_size=17)
+    _bitwise(got, want, f"2D blocked {mesh_shape}")
+
+
+def test_blocked_ring_verified_clean_and_flip_detected():
+    """verify=True over the blocked wire: bitwise-clean result + exact
+    flip counters — the digest covers code words AND the sidecar."""
+    stacked = _spread_stack(W, 130, seed=13)
+    mesh = make_mesh(dp=W, devices=jax.devices()[:W])
+
+    def body(st, fault=None):
+        return ring_quantized_sum(st[0], "dp", 4, 3, verify=True,
+                                  fault=fault, block_scale=True,
+                                  block_size=32)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=(P(), P()), check_vma=False))
+    sharded = jax.device_put(jnp.asarray(stacked),
+                             NamedSharding(mesh, P("dp")))
+    vec, rep = fn(sharded)
+    want = ring_oracle_sum(jnp.asarray(stacked), 4, 3, block_scale=True,
+                           block_size=32)
+    _bitwise(np.asarray(vec), want, "verified blocked clean")
+    assert int(rep["ok"]) == 1 and int(rep["hop_bad"]) == 0
+
+    def fbody(st):
+        return body(st, fault=(jnp.int32(1), jnp.int32(2)))
+    ffn = jax.jit(shard_map(fbody, mesh=mesh, in_specs=(P("dp"),),
+                            out_specs=(P(), P()), check_vma=False))
+    _, frep = ffn(sharded)
+    assert int(frep["ok"]) == 0 and int(frep["hop_bad"]) == 1 \
+        and int(frep["gather_bad"]) == 1 and int(frep["agree"]) == 0
+
+
+def test_sum_gradients_block_scale_matches_oracle_and_gates():
+    """block_scale threads sum_gradients -> hierarchical_ring_sum with
+    the tree's global offsets; non-ring modes reject it."""
+    from cpd_tpu.parallel.dist import sum_gradients
+    mesh = data_parallel_mesh()
+    tree = {"a": _spread_stack(W, 37, seed=21),
+            "b": _spread_stack(W, 53, seed=22)}
+    sharded = jax.tree.map(
+        lambda g: jax.device_put(jnp.asarray(g),
+                                 NamedSharding(mesh, P("dp"))), tree)
+    fn = make_sum_gradients_fn(mesh, axis_name="dp", grad_exp=4,
+                               grad_man=3, mode="ring", block_scale=True,
+                               block_size=16)
+    got = jax.tree.map(np.asarray, fn(sharded))
+    # one whole-tree ring: leaves concatenate in tree_flatten order
+    flat = np.concatenate([tree["a"], tree["b"]], axis=1)
+    want = np.asarray(ring_oracle_sum(jnp.asarray(flat), 4, 3,
+                                      block_scale=True, block_size=16))
+    _bitwise(got["a"], want[:37], "leaf a")
+    _bitwise(got["b"], want[37:], "leaf b")
+
+    with pytest.raises(ValueError, match="mode='ring'"):
+        sum_gradients({"g": jnp.zeros(4)}, "dp", mode="faithful",
+                      block_scale=True)
+
+
+def test_blocked_ring_argument_validation():
+    z = jnp.zeros((4,), jnp.float32)
+    with pytest.raises(ValueError, match="nothing to scale"):
+        ring_quantized_sum(z, "dp", 8, 23, block_scale=True, world=2)
+    with pytest.raises(ValueError, match="packable"):
+        ring_quantized_sum(z, "dp", 6, 1, block_scale=True, world=2)
+    with pytest.raises(ValueError, match="block_size"):
+        ring_quantized_sum(z, "dp", 5, 2, block_scale=True,
+                           block_size=0, world=2)
+    with pytest.raises(ValueError, match="packed=False"):
+        ring_quantized_sum(z, "dp", 5, 2, block_scale=True,
+                           packed=False, world=2)
+
+
+# ------------------------------------------------ blocked codec
+
+@pytest.mark.parametrize("exp,man", [(4, 3), (5, 2), (5, 7)])
+@pytest.mark.parametrize("n,block", [(64, 16), (103, 16), (7, 8),
+                                     (130, 128), (33, 1)])
+def test_blocked_codec_roundtrip_and_idempotence(exp, man, n, block):
+    """pack -> unpack reproduces the blocked cast bitwise (odd tail
+    blocks included), and the codec is the identity on its own output
+    set — the fixed-point shift derivation at work."""
+    x = jnp.asarray(_spread_stack(1, n, seed=exp * 7 + n)[0])
+    wire = pack_exmy_blocked(x, exp, man, block)
+    assert wire.shape[-1] == wire_bytes_blocked(exp, man, n, block)
+    got = np.asarray(unpack_exmy_blocked(wire, exp, man, n, block))
+    want = np.asarray(cast_body_blocked(x, exp, man, block))
+    _bitwise(got, want, "unpack(pack(x)) != blocked cast")
+    # idempotence on the output set
+    wire2 = pack_exmy_blocked(jnp.asarray(got), exp, man, block)
+    got2 = np.asarray(unpack_exmy_blocked(wire2, exp, man, n, block))
+    _bitwise(got2, got, "codec not idempotent on its own output")
+    rt = np.asarray(cast_body_blocked(jnp.asarray(got), exp, man, block))
+    _bitwise(rt, got, "blocked cast not idempotent on its own output")
+
+
+def test_blocked_codec_specials_and_low_class():
+    """±Inf/NaN ride the special codes through any block scale; the
+    whole sub-normal-floor class (fp32 subnormals, -0.0) canonicalizes
+    to +0.0; zeros are scale-invariant."""
+    x = jnp.asarray(np.array(
+        [np.inf, -np.inf, np.nan, 0.0, -0.0, 1e-45, -1e-40, 3.0,
+         2.0 ** -40, -7.5, 2.0 ** 30, 0.0, 1.0, -1.0, 2.0 ** -20, 5.0],
+        np.float32))
+    got = np.asarray(unpack_exmy_blocked(
+        pack_exmy_blocked(x, 4, 3, 4), 4, 3, 16, 4))
+    assert np.isinf(got[0]) and got[0] > 0
+    assert np.isinf(got[1]) and got[1] < 0
+    assert np.isnan(got[2])
+    # the low class: +0.0 bit pattern exactly (never -0.0 / subnormal)
+    for i in (3, 4, 5, 6):
+        assert got[i].view(np.uint32) if False else \
+            np.asarray(got[i]).view(np.uint32) == 0, i
+    # zeros stay exact zeros wherever they sit
+    assert np.asarray(got[11]).view(np.uint32) == 0
+
+
+def test_blocked_beats_per_tensor_on_spread_blocks():
+    """The EQuARX claim at codec level: on block-structured magnitudes
+    an e4m3 per-BLOCK scale preserves every block (error bounded by the
+    format's relative step) while a per-tensor shift flushes the small
+    blocks entirely."""
+    x = np.zeros(128, np.float32)
+    x[:64] = np.random.RandomState(0).randn(64) * 2.0 ** 25
+    x[64:] = np.random.RandomState(1).randn(64) * 2.0 ** -25
+    blocked = np.asarray(cast_to_format_blocked(jnp.asarray(x), 4, 3, 64))
+    # per-tensor: APS shifts the max to the top of e4 and casts
+    shift = float(2.0 ** (7 - 26))
+    pt = np.asarray(cast_to_format(jnp.asarray(x * shift), 4, 3)) / shift
+    lo = slice(64, 128)
+    assert np.all(pt[lo] == 0.0)                      # flushed wholesale
+    rel = np.abs(blocked[lo] - x[lo]) / np.abs(x[lo])
+    assert np.all(rel < 2.0 ** -3)                    # kept, in-format
+
+
+# ------------------------------------------------ sidecar byte accounting
+
+def test_blocked_wire_bytes_pinned_against_real_buffers():
+    """The analytics and the actual packed buffers cannot drift: every
+    (n, block) combination's wire_bytes_blocked == the real trailing
+    axis, sidecar included — the byte-analytics satellite."""
+    for n, block in ((64, 16), (65, 16), (1, 128), (130, 33), (256, 256)):
+        x = jnp.asarray(np.random.RandomState(n).randn(n), np.float32)
+        for exp, man in ((5, 2), (5, 7)):
+            wire = pack_exmy_blocked(x, exp, man, block)
+            assert wire.shape[-1] == wire_bytes_blocked(exp, man, n,
+                                                        block)
+            assert wire.shape[-1] == n * wire_bytes(exp, man) \
+                + sidecar_bytes(n, block)
+    assert sidecar_bytes(0, 8) == 0
+    assert sidecar_bytes(1, 8) == 1
+    assert sidecar_bytes(129, 128) == 2
+
+
+def test_transport_analytics_price_the_sidecar():
+    """ring/gather/table analytics count sidecar bytes explicitly."""
+    n, world, chunk = 1_000_000, 8, 125_000
+    per_chunk = chunk * 1 + sidecar_bytes(chunk, 128)
+    assert ring_transport_bytes(n, world, 5, 2, block_size=128) \
+        == 2 * 7 * per_chunk
+    assert ring_transport_bytes(n, world, 5, 2, block_size=128,
+                                use_kahan=True) == 3 * 7 * per_chunk
+    assert gather_transport_bytes(n, world, 5, 2, block_size=128) \
+        == 7 * (n + sidecar_bytes(n, 128))
+    table = transport_table(n, world, 5, 2, block_size=128)
+    assert table["ring_block_scaled"] == 2 * 7 * per_chunk
+    assert table["ring_block_scaled"] > table["ring_packed"]
+    # no block_size -> no block row; unpackable format -> None
+    assert transport_table(n, world, 5, 2)["ring_block_scaled"] is None
+    assert transport_table(n, world, 8, 23,
+                           block_size=128)["ring_block_scaled"] is None
+
+
+def test_blocked_ring_fused_wire_matches_oracle():
+    """The single-kernel blocked wire path (kernel-aligned block 128,
+    interpret mode) == the XLA composition == the oracle, RTNE and SR —
+    and verify=True over it stays bitwise clean."""
+    stacked = _spread_stack(W, 2 * 128 * W, seed=17)   # 2 blocks/chunk
+    for key in (None, _KEY):
+        want = ring_oracle_sum(jnp.asarray(stacked), 5, 2, key=key,
+                               block_scale=True, block_size=128)
+        got = _run_ring(W, stacked, 5, 2, key=key, fused=True,
+                        interpret=True, block_scale=True,
+                        block_size=128)
+        _bitwise(got, want, f"fused blocked sr={key is not None}")
+
+    mesh = make_mesh(dp=W, devices=jax.devices()[:W])
+
+    def vbody(st):
+        return ring_quantized_sum(st[0], "dp", 5, 2, verify=True,
+                                  fused=True, interpret=True,
+                                  block_scale=True, block_size=128)
+    fn = jax.jit(shard_map(vbody, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=(P(), P()), check_vma=False))
+    vec, rep = fn(jax.device_put(jnp.asarray(stacked),
+                                 NamedSharding(mesh, P("dp"))))
+    want = ring_oracle_sum(jnp.asarray(stacked), 5, 2, block_scale=True,
+                           block_size=128)
+    _bitwise(np.asarray(vec), want, "fused blocked verified clean")
+    assert int(rep["ok"]) == 1

@@ -206,6 +206,62 @@ def main() -> int:
           "OK" if len(failures) == fg_before else failures[fg_before:],
           flush=True)
 
+    # 7. fused wire kernels (ISSUE 9) — the ring's per-hop pack path:
+    # unpack + accumulate + (block-)quantize + re-pack + in-kernel
+    # Fletcher digest, bitwise vs the XLA composition (values, wire
+    # bytes, and digest words; sidecar lane included when blocked)
+    from cpd_tpu.ops.quantize import hop_pack_pallas, quantize_pack_pallas
+    from cpd_tpu.parallel.integrity import wire_digest
+    from cpd_tpu.quant.numerics import (cast_body, cast_body_blocked,
+                                        pack_exmy, pack_exmy_blocked,
+                                        unpack_exmy, unpack_exmy_blocked)
+
+    fw_before = len(failures)
+    for exp_bits, man_bits in [(5, 2), (4, 3), (5, 7)]:
+        for block in (None, 128):
+            nw = 384
+            g0 = jnp.asarray(rng.randn(nw).astype(np.float32) * 0.4)
+            g1 = jnp.asarray(rng.randn(nw).astype(np.float32) * 0.4)
+            res0, wire0, d0 = quantize_pack_pallas(
+                g0, exp_bits, man_bits, block_size=block,
+                want_digest=True, interpret=interpret)
+            if block is None:
+                q0 = cast_body(g0, exp_bits, man_bits)
+                w0 = pack_exmy(q0, exp_bits, man_bits)
+                prev = unpack_exmy(w0, exp_bits, man_bits)
+            else:
+                q0 = cast_body_blocked(g0, exp_bits, man_bits, block)
+                w0 = pack_exmy_blocked(q0, exp_bits, man_bits, block)
+                prev = unpack_exmy_blocked(w0, exp_bits, man_bits, nw,
+                                           block)
+            res1, wire1, d_in, d_out = hop_pack_pallas(
+                wire0, g1, exp_bits, man_bits, block_size=block,
+                want_digest=True, interpret=interpret)
+            if block is None:
+                q1 = cast_body(prev + g1, exp_bits, man_bits)
+                w1 = pack_exmy(q1, exp_bits, man_bits)
+            else:
+                q1 = cast_body_blocked(prev + g1, exp_bits, man_bits,
+                                       block)
+                w1 = pack_exmy_blocked(q1, exp_bits, man_bits, block)
+            tag = f"e{exp_bits}m{man_bits} block={block}"
+            if not (np.array_equal(np.asarray(res0).view(np.uint32),
+                                   np.asarray(q0).view(np.uint32))
+                    and np.array_equal(np.asarray(wire0).reshape(-1),
+                                       np.asarray(w0).reshape(-1))
+                    and int(d0) == int(wire_digest(w0))):
+                failures.append(f"fused emit {tag}")
+            if not (np.array_equal(np.asarray(res1).view(np.uint32),
+                                   np.asarray(q1).view(np.uint32))
+                    and np.array_equal(np.asarray(wire1).reshape(-1),
+                                       np.asarray(w1).reshape(-1))
+                    and int(d_in) == int(wire_digest(w0))
+                    and int(d_out) == int(wire_digest(w1))):
+                failures.append(f"fused hop {tag}")
+    print("fused wire kernels:",
+          "OK" if len(failures) == fw_before else failures[fw_before:],
+          flush=True)
+
     if failures:
         print("FAIL:", failures)
         return 1
