@@ -724,6 +724,58 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                     "dropped": dr["dropped"],
                 },
             }
+            # ISSUE 10 ride-alongs, in their OWN guard so a drill
+            # failure surfaces as a note without discarding the core
+            # serving metrics already recorded above: the SLA overload
+            # drill (bounded queue + tight class-1 deadlines ->
+            # explicit sheds, zero silent drops) and the crash-recovery
+            # snapshot gate (mid-trace save -> restore -> bitwise
+            # decode tail at (8,23), decode_tail_matches raising on any
+            # divergence)
+            try:
+                from cpd_tpu.serve import (ServeEngine as _SE,
+                                           decode_tail_matches,
+                                           with_sla)
+                sla_trace = with_sla(
+                    mixed_trace(8, 512, max_new=(8,), seed=17),
+                    [dict(sla_class=0),
+                     dict(sla_class=1, deadline_steps=4)])
+                ov_eng = ServeEngine(sv_model, sv_params, **sv_kw,
+                                     max_queue=2)
+                ov = run_trace(ov_eng, list(sla_trace))
+                snap_eng = ServeEngine(sv_model, sv_params,
+                                       **dict(sv_kw, kv_format=(8, 23)),
+                                       record_logits=True)
+                for r in mixed_trace(8, 512, max_new=(8,), seed=23):
+                    snap_eng.submit(r)
+                for _ in range(8):
+                    snap_eng.step()
+                import tempfile as _tf
+                with _tf.TemporaryDirectory() as _td:
+                    _sp = os.path.join(_td, "snap")
+                    snap_eng.snapshot(_sp)
+                    _mark = len(snap_eng.logits_log)
+                    snap_eng.run_until_drained()
+                    re_eng = _SE.restore(sv_model, sv_params, _sp)
+                    re_eng.run_until_drained()
+                snap_rows = decode_tail_matches(snap_eng, _mark, re_eng)
+                partial["serving"]["overload_drill"] = {
+                    "submitted": ov["submitted"],
+                    "completed": ov["completed"],
+                    "shed": ov["shed"],
+                    "deadline_misses": ov["deadline_misses"],
+                    "shed_rate": ov["shed_rate"],
+                    "silent_drops": ov["dropped"],
+                    "unresolved": len(ov_eng.unresolved()),
+                }
+                partial["serving"]["snapshot_drill"] = {
+                    "rows": snap_rows,
+                    "bitwise": True,
+                }
+            except Exception as e:  # noqa: BLE001 — extras must not kill the run
+                partial["serving"]["sla_note"] = (
+                    f"SLA/snapshot drill skipped: "
+                    f"{type(e).__name__}: {e}")
         except Exception as e:  # noqa: BLE001 — extras must not kill the run
             partial["serving_note"] = (f"serving extra skipped: "
                                        f"{type(e).__name__}: {e}")

@@ -58,6 +58,25 @@ until the slot holds cached K/V) — detected by the per-page digests and
 repaired by recomputation without dropping the request
 (docs/SERVING.md).  The engine does its own unfired accounting.
 
+The same executor consumes the serving-chaos kinds (``SERVE_KINDS``,
+ISSUE 10 — all on the serving engine's step clock):
+
+* ``kv_storm@s:k`` — flip one byte in each of up to ``k`` (default 3)
+  DISTINCT live KV pages at engine step ``s`` (held until at least one
+  live page exists): multi-page corruption wide enough that the
+  `ServeSupervisor` degradation ladder, not just the scrubber, has to
+  react.
+* ``slot_stall@s:k`` — request slot ``k`` stops making token progress
+  from engine step ``s`` (held until the slot is decoding): a wedged
+  decode lane, caught by the engine's no-progress watchdog, which
+  evicts the slot's pages and re-prefills its cache from the host-held
+  token history without dropping the request.
+* ``req_burst@s:k`` — a flash crowd of ``k`` (default 4) extra requests
+  arrives at engine step ``s``; the LOAD GENERATOR is the consumer
+  (`serve.loadgen.run_trace(burst_factory=...)` pops the due specs via
+  `ServeEngine.take_due_bursts`), so the burst is keyed into the plan
+  and replays deterministically like every other fault.
+
 ``step`` convention: the 0-based optimizer-UPDATE index — one clock for
 both executors, so ``grad_nan@3`` and ``stall@3`` hit the same physical
 step in every entry point (run_guarded and both trainer CLIs).  The
@@ -81,7 +100,7 @@ import numpy as np
 __all__ = ["FaultSpec", "FaultPlan", "Injector", "InjectedPreemption",
            "with_fault_injection", "report_unfired", "GRAD_KINDS",
            "HOST_KINDS", "WIRE_KINDS", "SAT_KINDS", "KV_KINDS",
-           "SAT_PRESSURE_DEFAULT_EXP"]
+           "SERVE_KINDS", "SAT_PRESSURE_DEFAULT_EXP"]
 
 # jit-level kinds -> corruption opcode in the compiled fault table
 GRAD_KINDS = {"grad_nan": 1, "grad_inf": 2, "grad_blowup": 3}
@@ -100,6 +119,16 @@ SAT_PRESSURE_DEFAULT_EXP = 24          # arg -1 -> scale by 2^24
 # repair-by-recompute ladder absorbs without dropping the request.
 # ``step`` here is the ENGINE-step clock, not the optimizer-update clock.
 KV_KINDS = frozenset({"kv_flip"})
+# serving-chaos kinds (ISSUE 10), all on the serving engine's step
+# clock: ``kv_storm@s:k`` (byte flips in up to k DISTINCT live pages —
+# wide enough to exercise the ServeSupervisor degradation ladder, not
+# just the scrubber), ``slot_stall@s:k`` (slot k stops making token
+# progress until the engine's no-progress watchdog evicts and
+# re-prefills it from history), and ``req_burst@s:k`` (k extra requests
+# arrive at step s — consumed by the load generator through
+# `ServeEngine.take_due_bursts`, so the flash crowd is keyed into the
+# plan and replays deterministically).
+SERVE_KINDS = frozenset({"kv_storm", "slot_stall", "req_burst"})
 # host-level kinds, executed by the Injector around the step call
 HOST_KINDS = frozenset({
     "batch_nan",       # poison one element of the first float batch leaf
@@ -113,7 +142,7 @@ HOST_KINDS = frozenset({
     "loss_spike",      # multiply the observed loss metric by `arg`
 })
 _ALL_KINDS = (frozenset(GRAD_KINDS) | HOST_KINDS | frozenset(WIRE_KINDS)
-              | SAT_KINDS | KV_KINDS)
+              | SAT_KINDS | KV_KINDS | SERVE_KINDS)
 
 
 class InjectedPreemption(BaseException):
@@ -235,6 +264,12 @@ class FaultPlan:
         """The serving engine's KV-page corruption specs (``arg`` is the
         target slot index, -1 -> slot 0)."""
         return tuple(f for f in self.faults if f.kind in KV_KINDS)
+
+    def serve_faults(self) -> tuple:
+        """The serving-chaos specs (`SERVE_KINDS`): ``kv_storm`` /
+        ``slot_stall`` / ``req_burst`` — all on the serving engine's
+        step clock (module docstring)."""
+        return tuple(f for f in self.faults if f.kind in SERVE_KINDS)
 
     def host_faults(self) -> dict:
         """step -> [FaultSpec] for the host-level kinds."""
@@ -531,7 +566,8 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
                    = None, meter=None, rank: int = 0,
                    wire_armed: bool = True,
                    sat_armed: bool = True,
-                   kv_armed: bool = False) -> list:
+                   kv_armed: bool = False,
+                   serve_armed: bool = False) -> list:
     """The ONE end-of-run check every loop calls: which planned faults
     never fired?  A chaos run that silently skipped a fault proves
     nothing — the usual causes are a plan step beyond the run's
@@ -551,6 +587,11 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     serving engine's clock (which does its OWN unfired accounting,
     `ServeEngine.report_unfired`), so a kv spec in a TRAINING plan is
     always a never-fires user error and is surfaced here.
+    ``serve_armed`` defaults False for exactly the same reason: the
+    `SERVE_KINDS` (``kv_storm``/``slot_stall``/``req_burst``, ISSUE 10)
+    also live on the serving engine's clock and do their own unfired
+    accounting there — in a training plan they can never fire and are
+    flagged here.
     Bumps the meter's ``faults_unfired`` counter and warns on rank 0;
     returns the sorted leftover list (empty = every planned fault
     fired)."""
@@ -558,11 +599,19 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
         return []
     leftover = list(injector.unfired())
     for f in (injector.plan.grad_faults() + injector.plan.wire_faults()
-              + injector.plan.sat_faults() + injector.plan.kv_faults()):
+              + injector.plan.sat_faults() + injector.plan.kv_faults()
+              + injector.plan.serve_faults()):
+        if f.kind in KV_KINDS or f.kind in SERVE_KINDS:
+            # engine-clock kinds: the training ``n_steps`` budget says
+            # nothing about them.  Unarmed -> can never fire, flagged;
+            # armed -> the serving engine's own accounting owns them.
+            armed = kv_armed if f.kind in KV_KINDS else serve_armed
+            if not armed:
+                leftover.append(f)
+            continue
         past = n_steps is not None and f.step >= n_steps
         unwired = ((not wire_armed and f.kind in WIRE_KINDS)
-                   or (not sat_armed and f.kind in SAT_KINDS)
-                   or (not kv_armed and f.kind in KV_KINDS))
+                   or (not sat_armed and f.kind in SAT_KINDS))
         if past or unwired:
             leftover.append(f)
     leftover = sorted(set(leftover))

@@ -1,22 +1,30 @@
-"""cpd_tpu.serve — continuous-batching serving on the quantized substrate.
+"""cpd_tpu.serve — SLA-guarded continuous batching on the quantized
+substrate.
 
 The serving layer (L5) over the whole stack (ROADMAP item 1): a request
-scheduler with continuous batching and chunked prefill
-(`scheduler.Scheduler`, `engine.ServeEngine`), a paged KV cache whose
-pages are bit-packed eXmY code words via the PR 3 wire codec
-(`kvcache`), per-page Fletcher digests with repair-by-recomputation
-(`engine.ServeEngine.scrub`), and the load-generator harness
-(`loadgen`, `tools/bench_serve.py`).  See docs/SERVING.md.
+scheduler with continuous batching, chunked prefill and
+ACCEPT/QUEUE/SHED admission verdicts (`scheduler.Scheduler`,
+`engine.ServeEngine`), a paged KV cache whose pages are bit-packed eXmY
+code words via the PR 3 wire codec (`kvcache`), per-page Fletcher
+digests with repair-by-recomputation (`engine.ServeEngine.scrub`), the
+`supervisor.ServeSupervisor` degradation ladder + deadline cancellation
++ no-progress watchdog + crash-recovery snapshots (ISSUE 10), and the
+load-generator harness (`loadgen`, `tools/bench_serve.py`).  See
+docs/SERVING.md.
 """
 
-from .engine import ServeEngine
+from .engine import ResultStore, ServeEngine
 from .kvcache import KVCacheConfig
-from .loadgen import (bursty_trace, mixed_trace, poisson_trace,
-                      run_trace, serial_baseline)
+from .loadgen import (bursty_trace, decode_tail_matches, flash_crowd,
+                      mixed_trace, poisson_trace, run_trace,
+                      serial_baseline, with_sla)
 from .model import ModelSpec, spec_from_model
-from .scheduler import Request, Scheduler
+from .scheduler import ACCEPT, QUEUE, Request, Scheduler, SHED
+from .supervisor import Rung, ServeSupervisor, default_rungs
 
-__all__ = ["ServeEngine", "KVCacheConfig", "Request", "Scheduler",
-           "ModelSpec", "spec_from_model", "poisson_trace",
-           "bursty_trace", "mixed_trace", "run_trace",
-           "serial_baseline"]
+__all__ = ["ServeEngine", "ResultStore", "KVCacheConfig", "Request",
+           "Scheduler", "ACCEPT", "QUEUE", "SHED", "ModelSpec",
+           "spec_from_model", "Rung", "ServeSupervisor", "default_rungs",
+           "poisson_trace", "bursty_trace", "mixed_trace", "with_sla",
+           "flash_crowd", "run_trace", "serial_baseline",
+           "decode_tail_matches"]

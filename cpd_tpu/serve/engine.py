@@ -1,20 +1,24 @@
-"""ServeEngine — continuous batching over the paged eXmY KV cache.
+"""ServeEngine — SLA-guarded continuous batching over the paged eXmY KV
+cache.
 
 One engine step is at most three device dispatches, each jit-stable:
 
-1. (every ``scrub_every`` steps) the **scrub** — recompute every page
-   digest and compare to the maintained array; mismatches are corruption
-   (docs/SERVING.md repair ladder): a page owned by a live request
-   triggers **repair by recomputation** — the slot's cached K/V is
-   rebuilt from its token history (prompt + generated so far, which the
-   host always holds) through the same prefill program, synchronously,
-   without dropping the request; a free page's corruption is absorbed
-   (nothing will ever read it before it is rewritten).
-2. one **prefill chunk** for one PREFILL slot (round-robin), so long
-   prompts trickle in without ever stalling the decode batch.
+1. (every effective-scrub-period steps) the **scrub** — recompute every
+   page digest and compare to the maintained array; mismatches are
+   corruption (docs/SERVING.md repair ladder): a page owned by a live
+   request triggers **repair by recomputation** — the slot's cached K/V
+   is rebuilt from its token history (prompt + generated so far, which
+   the host always holds) through the same prefill program,
+   synchronously, without dropping the request; a free page's
+   corruption is absorbed (nothing will ever read it before it is
+   rewritten).
+2. one **prefill chunk** for the OLDEST admitted PREFILL slot, so long
+   prompts trickle in without ever stalling the decode batch (oldest-
+   first is what makes the admission-time TTFT bound provable —
+   scheduler.py module docstring).
 3. one **decode step** for the whole fixed-shape batch — every DECODE
-   slot feeds its pending token and samples the next; FREE/PREFILL
-   slots ride along masked to the trash page.
+   slot feeds its pending token and samples the next; FREE/PREFILL and
+   stalled slots ride along masked to the trash page.
 
 Detection is **two-tier** because an append re-digests its page from
 the post-write bytes (which would re-bless pre-existing corruption):
@@ -26,38 +30,176 @@ the scrub+repair on the intact pre-dispatch state, and re-dispatches —
 so corruption can never be served OR blessed, whatever its timing
 relative to the scrub period.
 
-Fault injection rides the existing `resilience.FaultPlan` grammar: the
-``kv_flip@s:k`` kind flips one byte in slot ``k``'s first page at step
-``s`` (held until that slot actually has cached K/V), exactly the
-corruption class the digests exist to catch.  Injection, detection,
-repair and completion are all deterministic: two runs of the same
-(model, trace, plan) produce identical counters — the serve-smoke gate.
+SLA guard rails (ISSUE 10), all step-clock-deterministic:
+
+* `submit` returns an ACCEPT/QUEUE/SHED **verdict** (scheduler.py): a
+  request whose TTFT deadline is provably unmeetable from the current
+  backlog, that the bounded queue has no room for, or whose SLA class
+  the active degradation rung sheds, is rejected at admission and
+  resolved SHED — never silently dropped.
+* expired work is **cancelled**: a queued request past its TTFT
+  deadline, a PREFILL slot that cannot have produced its first token in
+  time, or a DECODE slot blowing its per-token budget is resolved
+  DEADLINE_MISS with its partial output retained, its pages released to
+  the pool.
+* a **no-progress watchdog** catches a decode lane that stops advancing
+  (the ``slot_stall`` chaos kind): after ``stall_patience`` stuck
+  steps, the slot's pages are evicted and its cache re-prefilled from
+  the host-held token history — the request resumes, never dropped.
+* a `ServeSupervisor` (serve/supervisor.py) watches page pressure,
+  corruption and deadline misses, and steps the engine down a
+  degradation ladder (shrink the prefill chunk, cap admissions, tighten
+  the scrub, shed low-SLA traffic), probating back up on clean windows.
+
+Every submitted rid therefore resolves to exactly one of ``finished``,
+``shed`` or ``missed`` (the zero-silent-drops contract, `unresolved()`),
+all three stores are BOUNDED and drainable (`ResultStore`), and the
+event log is a bounded deque — so sustained traffic cannot grow host
+memory without limit (`logits_log` is the one exception, tests-only
+and off by default).
+
+Crash recovery: `snapshot(path)` serializes the FULL engine state —
+scheduler slots/queue/page table, host token histories, supervisor +
+counters, and the bit-packed u8 KV pool with its per-page digests —
+with a `train.checkpoint.checkpoint_digest` content digest in a
+``meta.json`` sidecar; `ServeEngine.restore(model, params, path)`
+verifies the digest and resumes decoding **bitwise-identically** (the
+pool is exact bytes; gated at (8,23) against the uninterrupted run).  A
+snapshot taken mid-corruption restores the corrupt bytes AND the stale
+digests, so the standard detect→repair path fires on the first
+post-restore dispatch.
+
+Fault injection rides the existing `resilience.FaultPlan` grammar:
+``kv_flip@s:k`` flips one byte in slot ``k``'s first page, and the
+serving-chaos kinds ``kv_storm@s:k`` (byte flips in up to ``k``
+distinct live pages), ``slot_stall@s:k`` (slot ``k`` stops making
+progress until the watchdog evicts it) and ``req_burst@s:k`` (a flash
+crowd the load generator pops via `take_due_bursts`) exercise the
+supervisor, the watchdog and the shed policy.  Injection, detection,
+repair, shedding and completion are all deterministic: two runs of the
+same (model, trace, plan) produce identical counters — the serve-smoke
+gate.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
+import os
+import shutil
 import time
+from collections import OrderedDict, deque
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from . import kvcache
 from .kvcache import KVCacheConfig, TRASH_PAGE
 from .model import make_decode_step, make_prefill_step, spec_from_model
-from .scheduler import DECODE, FREE, PREFILL, Request, Scheduler
+from .scheduler import DECODE, FREE, Request, SHED, Scheduler
+from .supervisor import ServeSupervisor
 
-__all__ = ["ServeEngine"]
+__all__ = ["ServeEngine", "ResultStore"]
 
-_COUNTERS = ("admitted", "completed", "prompt_tokens", "tokens_generated",
+_COUNTERS = ("submitted", "admitted", "completed", "shed",
+             "deadline_misses", "prompt_tokens", "tokens_generated",
              "decode_steps", "prefill_chunks", "repair_chunks", "scrubs",
-             "kv_flips_injected", "kv_inline_detects", "kv_pages_corrupt",
+             "kv_flips_injected", "kv_storms_injected", "kv_storm_pages",
+             "slot_stalls_injected", "req_bursts_injected",
+             "watchdog_evictions", "watchdog_chunks",
+             "kv_inline_detects", "kv_pages_corrupt",
              "kv_corrupt_free_pages", "kv_repairs", "pages_reserved",
-             "pages_freed", "kv_faults_unfired")
+             "pages_freed", "results_evicted", "sup_hot_steps",
+             "sup_degrades", "sup_probations", "kv_faults_unfired")
+
+_SNAP_STATE, _SNAP_META = "state.json", "meta.json"
+_SNAP_POOL, _SNAP_DIGESTS = "pool.npy", "digests.npy"
+
+
+def _json_default(o):
+    """Snapshot-JSON coercion for numpy scalars (a trace built from a
+    numpy RNG can legally carry np.int64 token ids)."""
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    raise TypeError(f"snapshot state is not JSON-serializable: "
+                    f"{type(o).__name__}")
+
+
+class ResultStore:
+    """Bounded, drainable rid -> record mapping (ISSUE 10 satellite:
+    the old ``Engine.finished`` dict grew forever under sustained
+    traffic).  Past ``cap`` entries the OLDEST resolution is evicted
+    (counted, never silent); `drain()` hands the current contents to
+    the caller and clears — the pull API for long-running serving where
+    nobody reads results out of the engine object."""
+
+    def __init__(self, cap: int):
+        if cap < 1:
+            raise ValueError(f"cap must be >= 1, got {cap}")
+        self.cap = int(cap)
+        self.evicted = 0
+        self._d: OrderedDict = OrderedDict()
+
+    def put(self, rid: int, value) -> None:
+        self._d[rid] = value
+        while len(self._d) > self.cap:
+            self._d.popitem(last=False)
+            self.evicted += 1
+
+    def drain(self) -> dict:
+        """Return every held resolution and clear the store."""
+        out = dict(self._d)
+        self._d.clear()
+        return out
+
+    def get(self, rid, default=None):
+        return self._d.get(rid, default)
+
+    def __getitem__(self, rid):
+        return self._d[rid]
+
+    def __contains__(self, rid) -> bool:
+        return rid in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def keys(self):
+        return self._d.keys()
+
+    def items(self):
+        return self._d.items()
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, ResultStore):
+            return dict(self._d) == dict(other._d)
+        return dict(self._d) == other
+
+    def __repr__(self) -> str:
+        return (f"ResultStore(cap={self.cap}, len={len(self._d)}, "
+                f"evicted={self.evicted})")
+
+    def state_dict(self) -> dict:
+        return {"cap": self.cap, "evicted": self.evicted,
+                "items": [[rid, v] for rid, v in self._d.items()]}
+
+    def load_state_dict(self, state: dict) -> "ResultStore":
+        self.cap = int(state["cap"])
+        self.evicted = int(state["evicted"])
+        self._d = OrderedDict((int(r), v) for r, v in state["items"])
+        return self
 
 
 class ServeEngine:
-    """Continuous-batching serving loop for one `TransformerLM`.
+    """SLA-guarded continuous-batching serving loop for one
+    `TransformerLM` (module docstring).
 
     Parameters
     ----------
@@ -72,11 +214,19 @@ class ServeEngine:
     kv_format : (exp_bits, man_bits) eXmY cache codec; (8, 23) is the
         lossless byte split, e5m2/e4m3 the 4x-compressed formats.
     raw_cache : fp32 pool, no codec — the bitwise oracle for (8, 23).
-    prefill_chunk : prompt tokens per prefill dispatch.
+    prefill_chunk : prompt tokens per prefill dispatch (a degradation
+        rung may cap the VALID tokens per dispatch below this; the
+        compiled chunk shape never changes).
     scrub_every : digest-scrub period in engine steps (0 = only explicit
-        `scrub()` calls).
-    fault_plan : `resilience.FaultPlan`; only its ``kv_flip`` specs are
-        consumed here.
+        `scrub()` calls; a degradation rung may tighten it).
+    fault_plan : `resilience.FaultPlan`; consumes the ``kv_flip`` and
+        `SERVE_KINDS` specs (``kv_storm``/``slot_stall``/``req_burst``).
+    supervisor : optional `ServeSupervisor` degradation ladder.
+    max_queue : bounded-queue backpressure — submissions beyond this
+        queue depth are SHED (None = unbounded, the pre-SLA behaviour).
+    stall_patience : no-progress steps before the watchdog evicts and
+        re-prefills a stuck decode slot.
+    finished_cap : bound on each resolution store (finished/shed/missed).
     temperature / seed : 0 = greedy argmax; > 0 samples from
         softmax(logits / T) with a deterministic host RNG.
     """
@@ -86,24 +236,44 @@ class ServeEngine:
                  n_pages: Optional[int] = None,
                  kv_format: tuple = (8, 23), raw_cache: bool = False,
                  prefill_chunk: int = 16, scrub_every: int = 0,
-                 fault_plan=None, temperature: float = 0.0,
-                 seed: int = 0, record_logits: bool = False):
+                 fault_plan=None, supervisor: Optional[ServeSupervisor]
+                 = None, max_queue: Optional[int] = None,
+                 stall_patience: int = 4, finished_cap: int = 4096,
+                 temperature: float = 0.0, seed: int = 0,
+                 record_logits: bool = False):
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if stall_patience < 1:
+            raise ValueError(f"stall_patience must be >= 1, got "
+                             f"{stall_patience}")
         spec = spec_from_model(model)
         max_pages = -(-max_seq // page_size)
         if n_pages is None:
             n_pages = 1 + n_slots * max_pages
         exp_bits, man_bits = kv_format
+        # the restore() recipe: everything an identical engine needs
+        # (fault_plan/supervisor ride the snapshot separately)
+        self._init_kw = dict(
+            n_slots=n_slots, max_seq=max_seq, page_size=page_size,
+            n_pages=n_pages, kv_format=[int(exp_bits), int(man_bits)],
+            raw_cache=bool(raw_cache), prefill_chunk=prefill_chunk,
+            scrub_every=scrub_every, max_queue=max_queue,
+            stall_patience=stall_patience, finished_cap=finished_cap,
+            temperature=float(temperature), seed=int(seed),
+            record_logits=bool(record_logits))
         self.cfg = KVCacheConfig(
             n_layers=spec.n_layers, n_kv_heads=spec.kv_heads,
             head_dim=spec.head_dim, page_size=page_size, n_pages=n_pages,
             exp_bits=exp_bits, man_bits=man_bits, raw=raw_cache)
         self.spec = spec
         self.params = params
-        self.sched = Scheduler(n_slots, n_pages, page_size, max_pages)
+        self.sched = Scheduler(n_slots, n_pages, page_size, max_pages,
+                               prefill_chunk=prefill_chunk,
+                               max_queue=max_queue)
         self._prefill_chunk = prefill_chunk
         self._scrub_every = scrub_every
+        self._stall_patience = stall_patience
+        self.supervisor = supervisor
         self._temperature = float(temperature)
         self._rng = np.random.default_rng(seed)
 
@@ -115,12 +285,29 @@ class ServeEngine:
         # compiled scrub program every later pass reuses
         self._digests = self._scrub_fn(self._pool)
 
+        serve = list(fault_plan.serve_faults()) if fault_plan else []
         self._kv_pending = list(fault_plan.kv_faults()) if fault_plan \
             else []
+        self._storm_pending = [f for f in serve if f.kind == "kv_storm"]
+        self._stall_pending = [f for f in serve if f.kind == "slot_stall"]
+        self._burst_pending = [f for f in serve if f.kind == "req_burst"]
+        self._stalled: set = set()    # slot indices not making progress
         self.counters = {k: 0 for k in _COUNTERS}
-        self.events: list = []     # (kind, rid, step, wall-clock seconds)
-        self.finished: dict = {}   # rid -> list of generated token ids
+        # (kind, rid, step, wall-clock seconds); bounded like the
+        # resolution stores (~6 events/request), oldest silently aged
+        # out — latency metrics cover the retained window
+        self.events: deque = deque(maxlen=8 * finished_cap)
+        # bounded resolution stores: every submitted rid lands in
+        # exactly one (the zero-silent-drops contract, `unresolved`)
+        self.finished = ResultStore(finished_cap)   # rid -> token list
+        self.shed = ResultStore(finished_cap)       # rid -> reason str
+        self.missed = ResultStore(finished_cap)     # rid -> partial toks
+        self._inflight: set = set()
         self.step_index = 0
+        # effective (rung-capped) knobs, recomputed every step
+        self._eff_chunk = prefill_chunk
+        self._eff_scrub = scrub_every
+        self._sig_prev = {"corrupt": 0, "misses": 0}
         # (rid, position, np logits row) per sampled token — the bitwise
         # oracle gate compares these across cache codecs (tests only;
         # unbounded, so keep it off in long-running serving)
@@ -129,11 +316,28 @@ class ServeEngine:
 
     # -- public API -------------------------------------------------------
 
-    def submit(self, req: Request) -> None:
-        self.sched.submit(req)
+    def submit(self, req: Request) -> str:
+        """Admission verdict (ACCEPT / QUEUE / SHED — scheduler.py).  A
+        SHED request is resolved immediately (`shed` store + event);
+        impossible requests still raise — BEFORE the submitted counter
+        moves, so a validation error cannot leave a phantom submission
+        that reads as a silent drop forever."""
+        verdict = self.sched.submit(req, step=self.step_index)
+        self.counters["submitted"] += 1
+        if verdict == SHED:
+            self._resolve_shed(req.rid, "admission", self.step_index)
+        else:
+            self._inflight.add(req.rid)
+        return verdict
 
     def drained(self) -> bool:
         return self.sched.drained()
+
+    def unresolved(self) -> list:
+        """Submitted rids not yet resolved to FINISHED/SHED/
+        DEADLINE_MISS — empty on a drained engine (the zero-silent-drops
+        acceptance check)."""
+        return sorted(self._inflight)
 
     def run_until_drained(self, max_steps: int = 100000) -> None:
         while not self.drained():
@@ -145,27 +349,167 @@ class ServeEngine:
                     "slots busy)")
             self.step()
 
+    def has_pending_bursts(self) -> bool:
+        """True while ``req_burst`` specs wait to fire — the load
+        generator keeps the step clock running toward them even after
+        the current work drains (a flash crowd scheduled for a quiet
+        moment must still arrive)."""
+        return bool(self._burst_pending)
+
+    def take_due_bursts(self, step: Optional[int] = None) -> list:
+        """Pop the ``req_burst`` specs due at ``step`` (default: the
+        current step) — the load generator's hook
+        (`loadgen.run_trace(burst_factory=...)`); each popped spec is
+        counted fired.  Uncalled (no load generator driving the plan),
+        the specs stay pending and surface through `report_unfired`."""
+        s = self.step_index if step is None else step
+        due = [f for f in self._burst_pending if f.step <= s]
+        if due:
+            self._burst_pending = [f for f in self._burst_pending
+                                   if f.step > s]
+            self.counters["req_bursts_injected"] += len(due)
+        return due
+
     def report_unfired(self) -> list:
-        """kv_flip specs that never found a live target (e.g. scheduled
-        on a slot index the trace never filled) — the serving twin of
+        """Fault specs that never found a live target (e.g. a kv_flip
+        on a slot the trace never filled, or a req_burst no load
+        generator consumed) — the serving twin of
         `resilience.report_unfired`; counted, never silent."""
-        self.counters["kv_faults_unfired"] = len(self._kv_pending)
-        return list(self._kv_pending)
+        left = (list(self._kv_pending) + list(self._storm_pending)
+                + list(self._stall_pending) + list(self._burst_pending))
+        self.counters["kv_faults_unfired"] = len(left)
+        return sorted(left)
 
     # -- the step ---------------------------------------------------------
 
     def step(self) -> None:
         s = self.step_index
+        self._apply_rung(s)
         self._fire_kv_faults(s)
-        if self._scrub_every and s % self._scrub_every == 0:
+        if self._eff_scrub and s % self._eff_scrub == 0:
             self.scrub()
+        self._expire_deadlines(s)
+        self._watchdog(s)
         for slot in self.sched.admit(s):
             self.counters["admitted"] += 1
             self.counters["pages_reserved"] += len(slot.pages)
             self._event("admit", slot.req.rid, s)
         self._prefill_phase(s)
         self._decode_phase(s)
+        self._observe_supervisor(s)
         self.step_index += 1
+
+    # -- SLA guard rails --------------------------------------------------
+
+    def _apply_rung(self, s: int) -> None:
+        """Point the step's effective knobs at the supervisor's current
+        rung (supervisor.py): prefill-chunk cap, admission cap, scrub
+        cadence, and the shed class (applied to NEW submissions via the
+        scheduler policy AND to already-queued low-class work)."""
+        rung = self.supervisor.rung if self.supervisor is not None else None
+        base = self._prefill_chunk
+        self._eff_chunk = (base if rung is None
+                           or rung.prefill_chunk_cap is None
+                           else min(base, rung.prefill_chunk_cap))
+        eff_scrub = self._scrub_every
+        if rung is not None and rung.scrub_every_cap is not None:
+            eff_scrub = (rung.scrub_every_cap if eff_scrub == 0
+                         else min(eff_scrub, rung.scrub_every_cap))
+        self._eff_scrub = eff_scrub
+        self.sched.admission_cap = (rung.admission_cap
+                                    if rung is not None else None)
+        shed_above = rung.shed_class_above if rung is not None else None
+        self.sched.shed_class_above = shed_above
+        if shed_above is not None:
+            for q in self.sched.shed_queued_class(shed_above):
+                self._resolve_shed(q.rid, "rung-purge", s)
+
+    def _expire_deadlines(self, s: int) -> None:
+        """Cancel provably-late work: queued requests past their TTFT
+        deadline, PREFILL slots that can no longer produce a first
+        token in time, and DECODE slots past their per-token budget —
+        pages released, partial output retained, DEADLINE_MISS
+        resolved.  Strict ``>`` everywhere: a token produced AT the
+        deadline step lands later in this same step, on time."""
+        for q in self.sched.expire_queued(s):
+            self._resolve_miss(q.rid, [], s)
+        for slot in self.sched.slots:
+            if slot.state == FREE:
+                continue
+            req = slot.req
+            if slot.first_token_step < 0:
+                late = (req.deadline_steps is not None
+                        and s > req.arrival + req.deadline_steps)
+            else:
+                pending = len(slot.generated)   # index of the NEXT token
+                late = (req.tpot_budget_steps is not None
+                        and s > slot.first_token_step
+                        + pending * req.tpot_budget_steps)
+            if late:
+                partial = list(slot.generated)
+                self._stalled.discard(slot.index)
+                self.counters["pages_freed"] += self.sched.evict(slot)
+                self._resolve_miss(req.rid, partial, s)
+
+    def _watchdog(self, s: int) -> None:
+        """No-progress watchdog: a DECODE slot whose ``fed`` has not
+        advanced for ``stall_patience`` steps (the ``slot_stall`` chaos
+        kind, or any real wedged lane) is evicted — pages returned and
+        fresh ones reserved — and its cache re-prefilled from the
+        host-held token history; decode resumes from the same pending
+        token.  The request is never dropped."""
+        for slot in self.sched.decode_slots():
+            if s - slot.last_progress < self._stall_patience:
+                continue
+            self.counters["watchdog_evictions"] += 1
+            self._stalled.discard(slot.index)   # recovery clears a stall
+            n = self.sched.reassign_pages(slot)
+            self.counters["pages_freed"] += n
+            self.counters["pages_reserved"] += n
+            self._reprefill(slot, "watchdog_chunks")
+            slot.last_progress = s
+            self._event("watchdog_evict", slot.req.rid, s)
+
+    def _observe_supervisor(self, s: int) -> None:
+        if self.supervisor is None:
+            return
+        cur = {"corrupt": (self.counters["kv_inline_detects"]
+                           + self.counters["kv_pages_corrupt"]),
+               "misses": self.counters["deadline_misses"]}
+        act = self.supervisor.on_step(
+            s, page_util=self.sched.page_utilization(),
+            corrupt=cur["corrupt"] - self._sig_prev["corrupt"],
+            misses=cur["misses"] - self._sig_prev["misses"])
+        self._sig_prev = cur
+        if self.supervisor.last_hot:
+            self.counters["sup_hot_steps"] += 1
+        if act == "degrade":
+            self.counters["sup_degrades"] += 1
+            self._event("degrade", -1, s)
+        elif act == "probate":
+            self.counters["sup_probations"] += 1
+            self._event("probate", -1, s)
+
+    # -- resolution bookkeeping -------------------------------------------
+
+    def _resolve_shed(self, rid: int, reason: str, s: int) -> None:
+        self.counters["shed"] += 1
+        self.shed.put(rid, reason)
+        self._inflight.discard(rid)
+        self._event("shed", rid, s)
+        self._refresh_evicted()
+
+    def _resolve_miss(self, rid: int, partial: list, s: int) -> None:
+        self.counters["deadline_misses"] += 1
+        self.missed.put(rid, partial)
+        self._inflight.discard(rid)
+        self._event("deadline_miss", rid, s)
+        self._refresh_evicted()
+
+    def _refresh_evicted(self) -> None:
+        self.counters["results_evicted"] = (self.finished.evicted
+                                            + self.shed.evicted
+                                            + self.missed.evicted)
 
     # -- phases -----------------------------------------------------------
 
@@ -193,13 +537,14 @@ class ServeEngine:
         if slot is None:
             return
         prompt = slot.req.prompt
-        n = min(self._prefill_chunk, len(prompt) - slot.fed)
+        n = min(self._eff_chunk, len(prompt) - slot.fed)
         buf = np.zeros((self._prefill_chunk,), np.int32)
         buf[:n] = prompt[slot.fed:slot.fed + n]
         last_logits = self._checked(
             self._prefill_fn, buf, np.int32(slot.fed), np.int32(n),
             self.sched.page_row(slot))
         slot.fed += n
+        slot.last_progress = s
         self.counters["prefill_chunks"] += 1
         self.counters["prompt_tokens"] += n
         if slot.fed == len(prompt):
@@ -208,6 +553,7 @@ class ServeEngine:
                 self.logits_log.append((slot.req.rid, slot.fed - 1, row))
             tok = self._sample(row)
             slot.generated.append(tok)
+            slot.first_token_step = s
             self.counters["tokens_generated"] += 1
             self._event("first_token", slot.req.rid, s)
             if not self._maybe_complete(slot, tok, s):
@@ -215,20 +561,24 @@ class ServeEngine:
                 slot.next_token = tok
 
     def _decode_phase(self, s: int) -> None:
-        dec = self.sched.decode_slots()
+        dec = [sl for sl in self.sched.decode_slots()
+               if sl.index not in self._stalled]
         if not dec:
             return
         slots = self.sched.slots
         tokens = np.asarray([max(sl.next_token, 0) for sl in slots],
                             np.int32)
         positions = np.asarray([sl.fed for sl in slots], np.int32)
-        active = np.asarray([sl.state == DECODE for sl in slots], bool)
+        active = np.asarray([sl.state == DECODE
+                             and sl.index not in self._stalled
+                             for sl in slots], bool)
         logits = np.asarray(self._checked(
             self._decode_fn, tokens, positions, self.sched.page_table(),
             active))
         self.counters["decode_steps"] += 1
         for sl in dec:
             sl.fed += 1
+            sl.last_progress = s
             if self.record_logits:
                 self.logits_log.append(
                     (sl.req.rid, sl.fed - 1, logits[sl.index]))
@@ -243,10 +593,12 @@ class ServeEngine:
         done = (len(slot.generated) >= req.max_new_tokens
                 or (req.eos_id is not None and tok == req.eos_id))
         if done:
-            self.finished[req.rid] = list(slot.generated)
+            self.finished.put(req.rid, list(slot.generated))
+            self._inflight.discard(req.rid)
             self._event("complete", req.rid, s)
             self.counters["completed"] += 1
             self.counters["pages_freed"] += self.sched.evict(slot)
+            self._refresh_evicted()
         return done
 
     def _sample(self, logits_row: np.ndarray) -> int:
@@ -279,7 +631,8 @@ class ServeEngine:
             elif owner not in to_repair:
                 to_repair.append(owner)
         for slot in to_repair:
-            self._repair(slot)
+            self.counters["kv_repairs"] += 1
+            self._reprefill(slot, "repair_chunks")
         # repaired pages rewrote their digests; absorb the rest (free
         # pages and any corrupted-but-unwritten tail) by re-syncing the
         # stored digests to the pool's current bytes
@@ -287,13 +640,14 @@ class ServeEngine:
         return [(int(layer), int(p)) for layer, p in bad
                 if int(p) != TRASH_PAGE]
 
-    def _repair(self, slot) -> None:
+    def _reprefill(self, slot, counter: str) -> None:
         """Rebuild a slot's cached K/V from its token history through the
         prefill program — the request is never dropped; decode resumes
-        from the same pending token.  The pre-append verdict is ignored
-        HERE (a nonzero count is exactly the corruption being repaired);
-        the rewrite itself re-syncs the touched pages' digests."""
-        self.counters["kv_repairs"] += 1
+        from the same pending token.  Shared by corruption repair and
+        the watchdog eviction (``counter`` keeps their chunk accounting
+        separate).  The pre-append verdict is ignored HERE (a nonzero
+        count is exactly the corruption being repaired); the rewrite
+        itself re-syncs the touched pages' digests."""
         feed = slot.history[:slot.fed]
         row = self.sched.page_row(slot)
         done = 0
@@ -305,30 +659,72 @@ class ServeEngine:
                 self.params, self._pool, self._digests, buf,
                 np.int32(done), np.int32(n), row)
             done += n
-            self.counters["repair_chunks"] += 1
+            self.counters[counter] += 1
 
     # -- fault injection --------------------------------------------------
 
     def _fire_kv_faults(self, s: int) -> None:
         still = []
         for f in self._kv_pending:
-            if f.step > s or not self._flip_page(int(f.arg)):
+            if f.step > s or not self._flip_slot_page(int(f.arg)):
                 still.append(f)
         self._kv_pending = still
+        still = []
+        for f in self._storm_pending:
+            if f.step > s or not self._fire_storm(f):
+                still.append(f)
+        self._storm_pending = still
+        still = []
+        for f in self._stall_pending:
+            if f.step > s or not self._fire_stall(int(f.arg)):
+                still.append(f)
+        self._stall_pending = still
 
-    def _flip_page(self, slot_arg: int) -> bool:
-        """Flip one byte in the target slot's first page (layer 0, K
-        plane, position 0).  Returns False when the slot holds no cached
-        K/V yet — the spec stays pending until it can actually fire."""
+    def _flip_slot_page(self, slot_arg: int) -> bool:
+        """``kv_flip``: flip one byte in the target slot's first page.
+        Returns False when the slot holds no cached K/V yet — the spec
+        stays pending until it can actually fire."""
         slot = self.sched.slots[max(slot_arg, 0) % self.sched.n_slots]
         if slot.state == FREE or slot.fed == 0 or not slot.pages:
             return False
-        pid = slot.pages[0]
+        self._flip_page_byte(slot.pages[0])
+        self.counters["kv_flips_injected"] += 1
+        return True
+
+    def _fire_storm(self, f) -> bool:
+        """``kv_storm@s:k``: flip one byte in each of up to ``k``
+        (default 3) DISTINCT live pages (`Scheduler.live_pages`,
+        slot-index order) — wide enough corruption that the supervisor,
+        not just the scrubber, reacts.  Held until at least one slot
+        holds cached K/V."""
+        targets = self.sched.live_pages()
+        if not targets:
+            return False
+        k = int(f.arg) if f.arg > 0 else 3
+        for pid in targets[:k]:
+            self._flip_page_byte(pid)
+            self.counters["kv_storm_pages"] += 1
+        self.counters["kv_storms_injected"] += 1
+        return True
+
+    def _fire_stall(self, slot_arg: int) -> bool:
+        """``slot_stall``: the target slot stops making token progress
+        (masked out of the decode batch) until the no-progress watchdog
+        evicts and re-prefills it.  Held until the slot is decoding."""
+        idx = max(slot_arg, 0) % self.sched.n_slots
+        if self.sched.slots[idx].state != DECODE:
+            return False
+        self._stalled.add(idx)
+        self.counters["slot_stalls_injected"] += 1
+        return True
+
+    def _flip_page_byte(self, pid: int) -> None:
+        """One REAL byte flip in page ``pid`` (layer 0, K plane,
+        position 0).  On the raw fp32 oracle pool this is a mantissa
+        byte XOR (not an arithmetic perturbation: `old + 1.0` would
+        round back to `old` for |old| >= 2^24 or non-finite values — a
+        fault counted as fired that attacked nothing)."""
         if self.cfg.raw:
-            # a REAL bit flip (low mantissa byte XOR 0xFF), not an
-            # arithmetic perturbation: `old + 1.0` would round back to
-            # `old` for |old| >= 2^24 or non-finite values — a fault
-            # counted as fired that attacked nothing
             old = np.float32(self._pool[0, pid, 0, 0, 0, 0])
             bits = old.view(np.uint32) ^ np.uint32(0xFF)
             self._pool = self._pool.at[0, pid, 0, 0, 0, 0].set(
@@ -337,8 +733,189 @@ class ServeEngine:
             old = self._pool[0, pid, 0, 0, 0, 0, 0]
             self._pool = self._pool.at[0, pid, 0, 0, 0, 0, 0].set(
                 old ^ np.uint8(0xFF))
-        self.counters["kv_flips_injected"] += 1
-        return True
+
+    # -- crash-recovery snapshots -----------------------------------------
+
+    def snapshot(self, path: str) -> dict:
+        """Serialize the FULL engine state into directory ``path``:
+        the bit-packed u8 KV pool + per-page digests (exact bytes), the
+        scheduler (slots / queue / page table / token histories), the
+        resolution stores, supervisor, counters, RNG and pending fault
+        specs — with a `train.checkpoint.checkpoint_digest` content
+        digest in a ``meta.json`` sidecar so `restore` can refuse a
+        truncated or bit-flipped snapshot.  Returns the digest record.
+
+        Whole-directory atomicity (the orbax write-tmp-then-rename
+        discipline, applied at directory granularity): the snapshot is
+        built in ``path + ".tmp"`` and only swapped in once complete —
+        a crash mid-save can never destroy the last good snapshot at
+        ``path`` (the periodic snapshot-to-one-path loop's whole point
+        is surviving exactly such a crash).  During the final swap the
+        previous snapshot briefly lives at ``path + ".old"``; a crash
+        in that window leaves it there, intact and restorable."""
+        from ..train.checkpoint import checkpoint_digest
+
+        tmp_dir = path.rstrip(os.sep) + ".tmp"
+        if os.path.isdir(tmp_dir):
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir)
+        np.save(os.path.join(tmp_dir, _SNAP_POOL),
+                np.asarray(self._pool))
+        np.save(os.path.join(tmp_dir, _SNAP_DIGESTS),
+                np.asarray(self._digests))
+        state = {
+            "version": 1,
+            "init": dict(self._init_kw),
+            "step_index": self.step_index,
+            "counters": dict(self.counters),
+            "events": [[k, r, st, w] for k, r, st, w in self.events],
+            "finished": self.finished.state_dict(),
+            "shed": self.shed.state_dict(),
+            "missed": self.missed.state_dict(),
+            "inflight": sorted(self._inflight),
+            "stalled": sorted(self._stalled),
+            "sig_prev": dict(self._sig_prev),
+            "rng": self._rng.bit_generator.state,
+            "pending": {
+                "kv": [dataclasses.asdict(f) for f in self._kv_pending],
+                "storm": [dataclasses.asdict(f)
+                          for f in self._storm_pending],
+                "stall": [dataclasses.asdict(f)
+                          for f in self._stall_pending],
+                "burst": [dataclasses.asdict(f)
+                          for f in self._burst_pending],
+            },
+            "supervisor": (self.supervisor.state_dict()
+                           if self.supervisor is not None else None),
+            "scheduler": self._sched_state(),
+        }
+        with open(os.path.join(tmp_dir, _SNAP_STATE), "w") as fh:
+            json.dump(state, fh, default=_json_default)
+        # the digest covers every data file; meta.json itself is
+        # excluded (it cannot contain its own hash)
+        record = checkpoint_digest(tmp_dir, exclude=(_SNAP_META,))
+        with open(os.path.join(tmp_dir, _SNAP_META), "w") as fh:
+            json.dump({"integrity": record}, fh)
+        # the swap: retire the previous snapshot to .old, promote the
+        # complete tmp dir, then drop .old — the only window without a
+        # snapshot at `path` leaves the previous one intact at .old
+        old_dir = path.rstrip(os.sep) + ".old"
+        if os.path.isdir(path):
+            shutil.rmtree(old_dir, ignore_errors=True)
+            os.rename(path, old_dir)
+        os.rename(tmp_dir, path)
+        shutil.rmtree(old_dir, ignore_errors=True)
+        return record
+
+    @classmethod
+    def restore(cls, model, params, path: str) -> "ServeEngine":
+        """Rebuild an engine from a `snapshot` directory and resume
+        decoding bitwise-identically (the pool is exact bytes — gated
+        at (8,23) in tests/test_serve.py and the serve-smoke).  The
+        content digest is verified FIRST; a tampered or truncated
+        snapshot raises instead of restoring garbage.  A snapshot taken
+        mid-corruption restores the corrupt page bytes AND the stale
+        page digests, so the standard detect -> repair path fires on
+        the first post-restore dispatch.
+
+        Swap-window recovery: if ``path`` itself holds no complete
+        snapshot (a crash landed between `snapshot`'s two directory
+        renames), the COMPLETE sibling is used instead — ``path.tmp``
+        first (the newer state, fully written before the swap begins),
+        then ``path.old`` (the retired previous snapshot) — so the
+        automated snapshot-to-one-path crash-recovery loop restores
+        without operator surgery whatever instant the save died."""
+        from ..resilience.inject import FaultSpec
+        from ..train.checkpoint import checkpoint_digest
+
+        base = path.rstrip(os.sep)
+        candidates = [path, base + ".tmp", base + ".old"]
+        complete = [p for p in candidates
+                    if os.path.exists(os.path.join(p, _SNAP_META))]
+        if not complete:
+            raise FileNotFoundError(
+                f"no complete snapshot at {path} (nor at the "
+                f"swap-window siblings {base}.tmp / {base}.old)")
+        path = complete[0]
+        with open(os.path.join(path, _SNAP_META)) as fh:
+            recorded = json.load(fh)["integrity"]
+        actual = checkpoint_digest(path, exclude=(_SNAP_META,))
+        if actual["digest"] != recorded["digest"]:
+            raise ValueError(
+                f"snapshot {path}: content digest mismatch "
+                f"({actual['digest'][:12]}… != "
+                f"{recorded['digest'][:12]}…) — refusing to restore a "
+                "corrupted snapshot")
+        with open(os.path.join(path, _SNAP_STATE)) as fh:
+            state = json.load(fh)
+        init = dict(state["init"])
+        init["kv_format"] = tuple(init["kv_format"])
+        eng = cls(model, params, **init)
+        eng._pool = jnp.asarray(np.load(os.path.join(path, _SNAP_POOL)))
+        eng._digests = jnp.asarray(np.load(os.path.join(path,
+                                                        _SNAP_DIGESTS)))
+        eng.step_index = int(state["step_index"])
+        eng.counters = {k: int(v) for k, v in state["counters"].items()}
+        eng.events = deque(((k, r, st, w) for k, r, st, w
+                            in state["events"]), maxlen=eng.events.maxlen)
+        eng.finished.load_state_dict(state["finished"])
+        eng.shed.load_state_dict(state["shed"])
+        eng.missed.load_state_dict(state["missed"])
+        eng._inflight = set(state["inflight"])
+        eng._stalled = set(state["stalled"])
+        eng._sig_prev = {k: int(v) for k, v in state["sig_prev"].items()}
+        eng._rng.bit_generator.state = state["rng"]
+        pend = state["pending"]
+        eng._kv_pending = [FaultSpec(**f) for f in pend["kv"]]
+        eng._storm_pending = [FaultSpec(**f) for f in pend["storm"]]
+        eng._stall_pending = [FaultSpec(**f) for f in pend["stall"]]
+        eng._burst_pending = [FaultSpec(**f) for f in pend["burst"]]
+        if state["supervisor"] is not None:
+            eng.supervisor = ServeSupervisor.from_state_dict(
+                state["supervisor"])
+        eng._load_sched_state(state["scheduler"])
+        return eng
+
+    def _sched_state(self) -> dict:
+        def req_dict(r):
+            return None if r is None else dataclasses.asdict(r)
+
+        return {
+            "slots": [{
+                "index": sl.index, "state": sl.state,
+                "req": req_dict(sl.req), "pages": list(sl.pages),
+                "fed": sl.fed, "next_token": sl.next_token,
+                "generated": list(sl.generated), "seq": sl.seq,
+                "first_token_step": sl.first_token_step,
+                "last_progress": sl.last_progress,
+            } for sl in self.sched.slots],
+            "queue": [dataclasses.asdict(q) for q in self.sched.queue],
+            "free_pages": list(self.sched.free_pages),
+            "admit_seq": self.sched._admit_seq,
+        }
+
+    def _load_sched_state(self, state: dict) -> None:
+        def req_from(d):
+            if d is None:
+                return None
+            d = dict(d)
+            d["prompt"] = tuple(d["prompt"])
+            return Request(**d)
+
+        for sl, d in zip(self.sched.slots, state["slots"]):
+            sl.state = d["state"]
+            sl.req = req_from(d["req"])
+            sl.pages = tuple(d["pages"])
+            sl.fed = int(d["fed"])
+            sl.next_token = int(d["next_token"])
+            sl.generated = [int(t) for t in d["generated"]]
+            sl.seq = int(d["seq"])
+            sl.first_token_step = int(d["first_token_step"])
+            sl.last_progress = int(d["last_progress"])
+        self.sched.queue = deque(req_from(q) for q in state["queue"])
+        self.sched.free_pages = deque(int(p)
+                                      for p in state["free_pages"])
+        self.sched._admit_seq = int(state["admit_seq"])
 
     # -- misc -------------------------------------------------------------
 

@@ -10,6 +10,18 @@ gate) — while latency METRICS are still measured in wall time (TTFT =
 first-token wall time minus the wall time at which the arrival step
 began).
 
+`run_trace` submits each request AT its arrival step (not all
+up-front): the ACCEPT/QUEUE/SHED admission verdicts (ISSUE 10) are
+computed against the LIVE backlog, which is what the structural TTFT
+bound prices — submitting the whole future trace at step 0 would make
+every later request look provably late.  For arrival-sorted traces
+with no SLA fields this is behaviourally identical to the old
+submit-everything-first driver (admission was already arrival-gated).
+``burst_factory`` wires the ``req_burst@s:k`` chaos kind: each step the
+driver pops the engine's due burst specs (`ServeEngine.take_due_bursts`)
+and submits the factory's flash crowd — the burst is keyed into the
+fault plan, so it replays deterministically.
+
 Reported metrics (the `bench.py` ``serving`` block schema):
 
 * ``tok_per_s`` — generated tokens / wall duration of the drained trace;
@@ -18,8 +30,23 @@ Reported metrics (the `bench.py` ``serving`` block schema):
 * ``goodput_tok_per_s`` — generated tokens of only the requests meeting
   the SLA (TTFT <= ``sla_ttft_ms`` AND per-token <= ``sla_tpot_ms``)
   over the same duration — the number that actually answers "how much
-  traffic is being served *well*";
+  traffic is being served *well*" — plus ``goodput_by_class`` (the same
+  split per ``sla_class``);
+* ``shed_rate`` / ``deadline_miss_rate`` — shed and cancelled fractions
+  of everything submitted (trace + bursts) — the overload-frontier
+  axes `tools/bench_serve.py --overload-sweep` tabulates;
+* ``dropped`` — SILENT drops: submissions resolved by none of
+  FINISHED/SHED/DEADLINE_MISS.  Zero is the structural contract.
 * the engine counter dict, verbatim.
+
+The per-request metrics (ttft/tpot percentiles, goodput splits) read
+the engine's BOUNDED stores: a trace longer than the engine's
+``finished_cap`` ages out its earliest resolutions mid-run, so those
+metrics then cover only the retained window.  That truncation is never
+silent — ``metrics_truncated`` is True whenever the engine evicted
+results (counter-derived numbers: tok/s, counts, shed/miss rates stay
+exact regardless).  Size ``finished_cap`` to the trace for full-window
+percentiles.
 
 `serial_baseline` replays the same trace through sequential
 `models.generate` calls (batch 1, the pre-serve inference surface) —
@@ -28,15 +55,47 @@ the continuous-batching speedup gate compares aggregate tok/s.
 
 from __future__ import annotations
 
+import dataclasses
 import time
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
 from .scheduler import Request
 
-__all__ = ["poisson_trace", "bursty_trace", "mixed_trace", "run_trace",
-           "serial_baseline"]
+__all__ = ["poisson_trace", "bursty_trace", "mixed_trace", "with_sla",
+           "flash_crowd", "run_trace", "serial_baseline",
+           "decode_tail_matches"]
+
+
+def decode_tail_matches(original, mark: int, restored) -> int:
+    """The ONE snapshot-restore comparison contract (shared by the
+    tests, the serve-smoke gate and bench.py's serving block): the
+    restored engine's full ``logits_log`` must reproduce the original's
+    entries from index ``mark`` on — same (rid, position) schedule and
+    BITWISE-identical logit rows — and the two engines must agree on
+    ``finished`` and ``counters``.  Returns the compared row count
+    (> 0; an empty tail would make the gate vacuous); raises ValueError
+    naming the first divergence otherwise.  Both engines need
+    ``record_logits=True`` and to be drained."""
+    tail = original.logits_log[mark:]
+    if len(tail) != len(restored.logits_log) or not tail:
+        raise ValueError(
+            f"restored decode stream length {len(restored.logits_log)} "
+            f"!= original tail {len(tail)} (empty tails are vacuous)")
+    for (ra, pa, la), (rb, pb, lb) in zip(tail, restored.logits_log):
+        if (ra, pa) != (rb, pb):
+            raise ValueError(f"restored decode schedule diverged: "
+                             f"(rid {ra}, pos {pa}) vs (rid {rb}, "
+                             f"pos {pb})")
+        if not (la.view(np.uint32) == lb.view(np.uint32)).all():
+            raise ValueError(f"restored logits not bitwise identical "
+                             f"at rid={ra} pos={pa}")
+    if original.finished != restored.finished:
+        raise ValueError("restored `finished` store differs")
+    if original.counters != restored.counters:
+        raise ValueError("restored counters differ")
+    return len(tail)
 
 
 def poisson_trace(n_requests: int, vocab_size: int, *,
@@ -104,21 +163,86 @@ def mixed_trace(n_requests: int, vocab_size: int, *,
     return sorted(out, key=lambda r: (r.arrival, r.rid))
 
 
+def with_sla(requests: Sequence[Request], classes: Sequence[dict]) -> list:
+    """Stamp SLA fields onto a trace: request ``i`` gets
+    ``classes[i % len(classes)]``, each a dict of any of ``sla_class``,
+    ``deadline_steps``, ``tpot_budget_steps`` — e.g.
+
+        with_sla(trace, [dict(sla_class=0, deadline_steps=8),
+                         dict(sla_class=1)])
+
+    alternates premium deadline-bound traffic with best-effort."""
+    if not classes:
+        raise ValueError("with_sla needs at least one class dict")
+    return [dataclasses.replace(r, **classes[i % len(classes)])
+            for i, r in enumerate(requests)]
+
+
+def flash_crowd(vocab_size: int, *, start_rid: int = 1_000_000,
+                prompt_lens: Sequence[int] = (4, 8),
+                max_new: Sequence[int] = (8,), seed: int = 0,
+                sla: Optional[dict] = None,
+                eos_id: Optional[int] = None) -> Callable:
+    """A ``burst_factory`` for `run_trace`: given a fired
+    ``req_burst@s:k`` spec it returns ``k`` (default 4) requests
+    arriving at step ``s`` — rids allocated from ``start_rid`` up (far
+    above trace rids), sizes drawn from a dedicated deterministic
+    stream so the crowd is identical every replay."""
+    rng = np.random.default_rng(seed)
+    next_rid = [start_rid]
+
+    def factory(spec) -> list:
+        k = int(spec.arg) if spec.arg > 0 else 4
+        out = []
+        for _ in range(k):
+            kw = dict(sla or {})
+            out.append(Request(
+                rid=next_rid[0],
+                prompt=tuple(int(x) for x in rng.integers(
+                    0, vocab_size,
+                    int(rng.choice(list(prompt_lens))))),
+                max_new_tokens=int(rng.choice(list(max_new))),
+                arrival=spec.step, eos_id=eos_id, **kw))
+            next_rid[0] += 1
+        return out
+
+    return factory
+
+
 def _pct(values: list, q: float) -> Optional[float]:
     return round(float(np.percentile(values, q)), 3) if values else None
 
 
 def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
               sla_tpot_ms: float = 250.0,
+              burst_factory: Optional[Callable] = None,
               max_steps: int = 100000) -> dict:
-    """Drive ``engine`` through ``requests`` until drained; -> metrics."""
-    for r in requests:
-        engine.submit(r)
+    """Drive ``engine`` through ``requests`` (submitted at their arrival
+    steps, module docstring) until drained; -> metrics."""
+    pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
+    submitted = []
     step_wall = {}
+
+    def more_work() -> bool:
+        # a req_burst scheduled past the current drain point must still
+        # arrive: the step clock runs until every consumed-here spec fired
+        if pending or not engine.drained():
+            return True
+        return burst_factory is not None and engine.has_pending_bursts()
+
     t0 = time.monotonic()
-    while not engine.drained():
+    while more_work():
         if engine.step_index >= max_steps:
             raise RuntimeError(f"trace not drained in {max_steps} steps")
+        while pending and pending[0].arrival <= engine.step_index:
+            r = pending.pop(0)
+            engine.submit(r)
+            submitted.append(r)
+        if burst_factory is not None:
+            for spec in engine.take_due_bursts():
+                for r in burst_factory(spec):
+                    engine.submit(r)
+                    submitted.append(r)
         step_wall[engine.step_index] = time.monotonic()
         engine.step()
     duration = time.monotonic() - t0
@@ -131,7 +255,8 @@ def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
         elif kind == "complete":
             done[rid] = wall
     ttft, tpot, good_tokens = [], [], 0
-    for r in requests:
+    class_tokens: dict = {}
+    for r in submitted:
         n_gen = len(engine.finished.get(r.rid, ()))
         if r.rid not in first:
             continue
@@ -144,12 +269,25 @@ def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
         if t_first <= sla_ttft_ms and (t_tok is None
                                        or t_tok <= sla_tpot_ms):
             good_tokens += n_gen
+            class_tokens[r.sla_class] = (class_tokens.get(r.sla_class, 0)
+                                         + n_gen)
 
-    gen = engine.counters["tokens_generated"]
+    c = engine.counters
+    gen = c["tokens_generated"]
+    n_sub = c["submitted"]
+    resolved = c["completed"] + c["shed"] + c["deadline_misses"]
     return {
         "requests": len(requests),
-        "completed": engine.counters["completed"],
-        "dropped": len(requests) - engine.counters["completed"],
+        "submitted": n_sub,
+        "completed": c["completed"],
+        "shed": c["shed"],
+        "deadline_misses": c["deadline_misses"],
+        # SILENT drops — anything submitted that resolved to none of
+        # FINISHED / SHED / DEADLINE_MISS; structurally zero
+        "dropped": n_sub - resolved,
+        "shed_rate": round(c["shed"] / n_sub, 4) if n_sub else 0.0,
+        "deadline_miss_rate": (round(c["deadline_misses"] / n_sub, 4)
+                               if n_sub else 0.0),
         "engine_steps": engine.step_index,
         "duration_s": round(duration, 3),
         "tok_per_s": round(gen / duration, 1) if duration else None,
@@ -157,6 +295,13 @@ def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
         "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
         "goodput_tok_per_s": (round(good_tokens / duration, 1)
                               if duration else None),
+        "goodput_by_class": {str(k): (round(v / duration, 1)
+                                      if duration else None)
+                             for k, v in sorted(class_tokens.items())},
+        # bounded-store honesty flag (module docstring): the
+        # per-request latency/goodput numbers cover only the retained
+        # resolution window when the engine evicted results mid-run
+        "metrics_truncated": c["results_evicted"] > 0,
         "sla": {"ttft_ms": sla_ttft_ms, "tpot_ms": sla_tpot_ms},
         "counters": dict(engine.counters),
     }

@@ -2,7 +2,7 @@
 
 The device side (serve/model.py) wants exactly two things per step: a
 fixed-shape decode batch (one token per slot, free slots masked) and at
-most one prefill chunk.  Everything stateful — admission, page
+most one prefill chunk.  Everything stateful — admission verdicts, page
 reservation, chunk bookkeeping, completion, eviction — lives here in
 plain Python so the jitted programs stay pure and shape-stable.
 
@@ -10,7 +10,7 @@ Slot lifecycle (docs/SERVING.md state diagram):
 
     FREE ──admit──> PREFILL ──prompt done──> DECODE ──eos/max──> FREE
                         │  (one chunk per engine step,             ▲
-                        │   round-robin across PREFILL slots)      │
+                        │   OLDEST admitted slot first)            │
                         └──────────── repair re-prefill ───────────┘
                               (a corrupt page rewinds fed K/V;
                                state and tokens are kept)
@@ -20,7 +20,39 @@ Admission reserves the request's WORST-CASE page count —
 enters the batch can always finish: no mid-decode allocation exists to
 fail, which is what makes "zero dropped requests" structural.  The
 queue is FIFO with head-of-line blocking (a big request waits for pages
-rather than being overtaken into starvation).
+rather than being overtaken into starvation — FIFO-within-class is an
+invariant, pinned by the starvation test).
+
+SLA verdicts (ISSUE 10): `submit` no longer unconditionally enqueues —
+it returns ``ACCEPT`` (a FREE slot + pages are available right now, the
+request enters the batch at the next step), ``QUEUE`` (it waits behind
+the backlog), or ``SHED`` (rejected at admission: the bounded queue is
+full, the active degradation rung sheds its SLA class, or its TTFT
+deadline is PROVABLY unmeetable — `ttft_bound_steps`).  A shed request
+is never silently dropped: the engine records the verdict and resolves
+the rid as SHED.
+
+The TTFT bound is structural, not a timer: prefill dispatches at most
+``prefill_chunk`` prompt tokens per engine step, admission is FIFO, and
+the prefill dispatcher serves the OLDEST admitted slot first — so every
+prompt token ahead of a new request must be fed before its own prompt
+finishes.  With ``n = ceil((backlog + own_prompt) / prefill_chunk)``
+required dispatches and the first one eligible to run in the current
+step, the first token cannot exist before ``n - 1`` steps from now (or
+``ceil(own_prompt / chunk) - 1`` steps after its arrival, whichever is
+later).  A deadline tighter than that bound is unmeetable by
+construction, whatever the decode load does.  (Oldest-first is load-
+bearing: the previous round-robin prefill could serve a later short
+prompt ahead of an earlier long one, which would make the aggregated
+bound unsound.)  The bound counts the backlog present AT SUBMIT TIME
+and is exact under the NO-CANCELLATION assumption: if everything
+queued ahead is actually served, the deadline is provably missed.  A
+later cancellation of counted backlog (a deadline expiry or rung
+purge ahead of the request) removes work and can make real TTFT beat
+the bound — so a shed can be PESSIMISTIC in that case, never the
+reverse: a request the bound admits is never doomed by backlog the
+bound failed to count.  Admission control sheds on the load actually
+offered, not on hypothetical future cancellations.
 
 The scheduler never touches the pool; it owns the free list and each
 slot's page-id tuple, and renders them into the trash-padded
@@ -37,21 +69,36 @@ import numpy as np
 
 from .kvcache import TRASH_PAGE
 
-__all__ = ["Request", "Slot", "Scheduler", "FREE", "PREFILL", "DECODE"]
+__all__ = ["Request", "Slot", "Scheduler", "FREE", "PREFILL", "DECODE",
+           "ACCEPT", "QUEUE", "SHED"]
 
 FREE, PREFILL, DECODE = "free", "prefill", "decode"
+# admission verdicts (`Scheduler.submit` / `ServeEngine.submit` return)
+ACCEPT, QUEUE, SHED = "accept", "queue", "shed"
 
 
 @dataclasses.dataclass(frozen=True)
 class Request:
     """One generation request.  ``prompt`` is a tuple of token ids;
     ``arrival`` is the engine-step index at which the load generator
-    makes it visible (step-based so traces replay deterministically)."""
+    makes it visible (step-based so traces replay deterministically).
+
+    SLA fields (ISSUE 10, all step-clock so drills replay exactly):
+    ``sla_class`` orders traffic priority (0 = highest; the degradation
+    ladder sheds the LARGEST classes first); ``deadline_steps`` is the
+    TTFT deadline — the first token must be sampled no later than
+    engine step ``arrival + deadline_steps``; ``tpot_budget_steps`` is
+    the per-token budget after the first — generated token ``k`` must
+    land by ``first_token_step + k * tpot_budget_steps``.  ``None``
+    disables the respective deadline (the pre-SLA behaviour)."""
     rid: int
     prompt: tuple
     max_new_tokens: int
     arrival: int = 0
     eos_id: Optional[int] = None
+    sla_class: int = 0
+    deadline_steps: Optional[int] = None
+    tpot_budget_steps: Optional[int] = None
 
     def __post_init__(self):
         if len(self.prompt) < 1:
@@ -59,6 +106,14 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(f"request {self.rid}: max_new_tokens must "
                              f"be >= 1, got {self.max_new_tokens}")
+        if self.sla_class < 0:
+            raise ValueError(f"request {self.rid}: sla_class must be "
+                             f">= 0, got {self.sla_class}")
+        for name in ("deadline_steps", "tpot_budget_steps"):
+            v = getattr(self, name)
+            if v is not None and v < 1:
+                raise ValueError(f"request {self.rid}: {name} must be "
+                                 f">= 1, got {v}")
 
     @property
     def t_max(self) -> int:
@@ -78,6 +133,9 @@ class Slot:
     fed: int = 0             # positions whose K/V is in the cache
     next_token: int = -1     # token to feed at position `fed` (DECODE)
     generated: List[int] = dataclasses.field(default_factory=list)
+    seq: int = -1            # admission sequence number (FIFO service)
+    first_token_step: int = -1   # engine step of the first sampled token
+    last_progress: int = -1      # engine step `fed` last advanced
 
     @property
     def history(self) -> tuple:
@@ -94,6 +152,9 @@ class Slot:
         self.fed = 0
         self.next_token = -1
         self.generated = []
+        self.seq = -1
+        self.first_token_step = -1
+        self.last_progress = -1
 
 
 class Scheduler:
@@ -102,21 +163,42 @@ class Scheduler:
     ``n_slots`` is the decode batch's fixed shape; ``max_pages`` the
     static per-slot page-table width (capacity ``max_pages * page_size``
     positions per request); ``n_pages`` the pool's total page count
-    (page 0 reserved as trash)."""
+    (page 0 reserved as trash); ``prefill_chunk`` the engine's prompt
+    tokens per prefill dispatch (the TTFT bound's throughput constant).
+
+    Admission POLICY knobs — all host state the engine (and through it
+    the `ServeSupervisor` degradation ladder) re-points every step:
+    ``max_queue`` bounds the wait queue (None = unbounded; beyond it
+    `submit` sheds — bounded-queue backpressure instead of head-of-line
+    starvation during burst storms); ``shed_class_above`` sheds every
+    request whose ``sla_class`` is >= it at admission time;
+    ``admission_cap`` caps admissions per engine step."""
 
     def __init__(self, n_slots: int, n_pages: int, page_size: int,
-                 max_pages: int):
+                 max_pages: int, prefill_chunk: int = 16,
+                 max_queue: Optional[int] = None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1 (or None), got "
+                             f"{max_queue}")
         self.n_slots = n_slots
         self.page_size = page_size
         self.max_pages = max_pages
+        self.prefill_chunk = prefill_chunk
         self.slots = [Slot(i) for i in range(n_slots)]
         # page 0 is the trash page; ascending ids keep runs reproducible
         self.total_pages = n_pages - 1
         self.free_pages = deque(range(1, n_pages))
         self.queue: deque = deque()
-        self._prefill_rr = 0      # round-robin cursor over PREFILL slots
+        self._admit_seq = 0       # admission sequence (oldest-first prefill)
+        # per-step policy (engine/supervisor-owned; see class docstring)
+        self.max_queue = max_queue
+        self.shed_class_above: Optional[int] = None
+        self.admission_cap: Optional[int] = None
 
     # -- capacity ---------------------------------------------------------
 
@@ -125,6 +207,13 @@ class Scheduler:
 
     def capacity_positions(self) -> int:
         return self.max_pages * self.page_size
+
+    def page_utilization(self) -> float:
+        """Fraction of allocatable pages currently reserved — the
+        supervisor's page-pressure signal."""
+        if self.total_pages <= 0:
+            return 1.0
+        return 1.0 - len(self.free_pages) / self.total_pages
 
     def validate(self, req: Request) -> None:
         """Fail fast at submit time when a request can NEVER be served —
@@ -147,18 +236,107 @@ class Scheduler:
                 "allocatable (n_pages minus the trash page) — it would "
                 "deadlock the admission queue")
 
+    # -- admission verdicts ----------------------------------------------
+
+    def prefill_backlog_tokens(self) -> int:
+        """Prompt tokens that MUST be prefill-dispatched before any new
+        request's own prompt under FIFO admission + oldest-first prefill:
+        the unfed remainder of every PREFILL slot plus every queued
+        prompt."""
+        backlog = sum(len(s.req.prompt) - s.fed for s in self.slots
+                      if s.state == PREFILL)
+        return backlog + sum(len(q.prompt) for q in self.queue)
+
+    def ttft_bound_steps(self, req: Request) -> int:
+        """Structural lower bound on the prefill-chunk DISPATCHES that
+        must run before ``req``'s first token exists (module docstring):
+        at most one chunk (<= ``prefill_chunk`` tokens) is dispatched
+        per engine step, and under oldest-admitted-first prefill every
+        token of the current backlog precedes every token of ``req``'s
+        prompt.  The first of those dispatches can run in the CURRENT
+        step (submission precedes the step's prefill phase), so the
+        earliest first-token step is ``now + ttft_bound_steps - 1``."""
+        need = self.prefill_backlog_tokens() + len(req.prompt)
+        return -(-need // self.prefill_chunk)
+
+    def deadline_unmeetable(self, req: Request, step: int) -> bool:
+        """True when ``req``'s TTFT deadline is provably missed GIVEN
+        the backlog ahead of it is served (module docstring — a later
+        cancellation ahead can make a shed pessimistic, never let an
+        admitted request be doomed by counted backlog): the backlog
+        bound from now, or the request's own prompt-feed time from its
+        arrival, lands past ``arrival + deadline_steps``.  Both bounds
+        count dispatches, and dispatch 1 of ``n`` can run in its
+        starting step — so ``n`` dispatches finish no earlier than
+        ``start + n - 1``, and a first token landing exactly AT the
+        deadline step is on time (the engine's expiry uses the same
+        strict-past convention)."""
+        if req.deadline_steps is None:
+            return False
+        latest = req.arrival + req.deadline_steps
+        own = -(-len(req.prompt) // self.prefill_chunk)
+        earliest = max(step + self.ttft_bound_steps(req) - 1,
+                       req.arrival + own - 1)
+        return earliest > latest
+
+    def submit(self, req: Request, step: int = 0) -> str:
+        """Admission verdict for ``req`` at engine step ``step``:
+        ``SHED`` (rejected — degradation rung sheds its class, bounded
+        queue full, or TTFT deadline provably unmeetable), ``ACCEPT``
+        (enqueued with a FREE slot + pages available right now), or
+        ``QUEUE`` (enqueued behind the backlog).  Impossible requests
+        (over capacity / bigger than the pool) still raise."""
+        self.validate(req)
+        if (self.shed_class_above is not None
+                and req.sla_class >= self.shed_class_above):
+            return SHED
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            return SHED
+        if self.deadline_unmeetable(req, step):
+            return SHED
+        immediate = (not self.queue and req.arrival <= step
+                     and any(s.state == FREE for s in self.slots)
+                     and len(self.free_pages) >= self.pages_needed(req))
+        self.queue.append(req)
+        return ACCEPT if immediate else QUEUE
+
+    def shed_queued_class(self, shed_class_above: int) -> list:
+        """Purge queued requests whose ``sla_class`` >= the rung's shed
+        class (the 'shed lowest-SLA-class traffic' rung acting on work
+        that was queued BEFORE the rung engaged).  Returns the shed
+        requests in queue order; FIFO order of the survivors is
+        untouched."""
+        keep, shed = deque(), []
+        for q in self.queue:
+            (shed if q.sla_class >= shed_class_above else keep).append(q)
+        self.queue = keep
+        return shed
+
+    def expire_queued(self, step: int) -> list:
+        """Remove queued requests whose TTFT deadline has already passed
+        (``step > arrival + deadline_steps`` — even an immediate
+        admission could no longer produce the first token in time).
+        Returns them in queue order for DEADLINE_MISS accounting."""
+        keep, expired = deque(), []
+        for q in self.queue:
+            dead = (q.deadline_steps is not None
+                    and step > q.arrival + q.deadline_steps)
+            (expired if dead else keep).append(q)
+        self.queue = keep
+        return expired
+
     # -- admission / eviction --------------------------------------------
 
-    def submit(self, req: Request) -> None:
-        self.validate(req)
-        self.queue.append(req)
-
     def admit(self, step: int) -> list:
-        """Move arrived queue heads into FREE slots while pages last.
-        Returns the newly admitted slots (FIFO; head-of-line blocking on
-        page pressure — never a drop)."""
+        """Move arrived queue heads into FREE slots while pages last
+        (and ``admission_cap`` allows).  Returns the newly admitted
+        slots (FIFO; head-of-line blocking on page pressure — never a
+        drop)."""
         admitted = []
         for slot in self.slots:
+            if (self.admission_cap is not None
+                    and len(admitted) >= self.admission_cap):
+                break
             if slot.state != FREE:
                 continue
             if not self.queue or self.queue[0].arrival > step:
@@ -175,6 +353,10 @@ class Scheduler:
             slot.fed = 0
             slot.generated = []
             slot.next_token = -1
+            slot.seq = self._admit_seq
+            slot.first_token_step = -1
+            slot.last_progress = step
+            self._admit_seq += 1
             admitted.append(slot)
         return admitted
 
@@ -185,20 +367,32 @@ class Scheduler:
         slot.reset()
         return n
 
+    def reassign_pages(self, slot: Slot) -> int:
+        """Watchdog eviction support: return the slot's pages and reserve
+        a FRESH set of the same size (guaranteed available — its own
+        pages just went back).  The request stays in its slot; the
+        engine rebuilds the cache from history into the new pages.
+        Returns the page count (rides both `pages_freed` and
+        `pages_reserved`)."""
+        n = len(slot.pages)
+        self.free_pages.extend(slot.pages)
+        slot.pages = tuple(self.free_pages.popleft() for _ in range(n))
+        return n
+
     # -- step composition -------------------------------------------------
 
     def decode_slots(self) -> list:
         return [s for s in self.slots if s.state == DECODE]
 
     def next_prefill_slot(self) -> Optional[Slot]:
-        """Round-robin over PREFILL slots: one chunk per engine step, so
-        several long prompts make progress fairly while decode runs."""
+        """OLDEST admitted PREFILL slot — strict FIFO service, one chunk
+        per engine step.  This discipline is what makes
+        `ttft_bound_steps` a true lower bound (module docstring): every
+        backlog token is dispatched before any newer prompt's."""
         pre = [s for s in self.slots if s.state == PREFILL]
         if not pre:
             return None
-        slot = pre[self._prefill_rr % len(pre)]
-        self._prefill_rr += 1
-        return slot
+        return min(pre, key=lambda s: s.seq)
 
     def page_row(self, slot: Slot) -> np.ndarray:
         """The slot's trash-padded (max_pages,) int32 page-table row."""
@@ -215,6 +409,19 @@ class Scheduler:
             if slot.state != FREE and page_id in slot.pages:
                 return slot
         return None
+
+    def live_pages(self) -> list:
+        """Every page reserved by a slot that already HOLDS cached K/V
+        (``fed > 0``), slot-index then reservation order — the ONE
+        deterministic target list for the ``kv_storm`` multi-page
+        corruption drill (`ServeEngine._fire_storm` consumes it;
+        admitted-but-unfed slots are excluded because their pages hold
+        nothing a flip could corrupt meaningfully)."""
+        out = []
+        for slot in self.slots:
+            if slot.state != FREE and slot.fed > 0:
+                out.extend(slot.pages)
+        return out
 
     def drained(self) -> bool:
         return not self.queue and all(s.state == FREE for s in self.slots)

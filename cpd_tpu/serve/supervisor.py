@@ -1,0 +1,218 @@
+"""Serving degradation supervision — the escalate/probation reflex for L5.
+
+The training side grew this reflex twice: `TransportSupervisor` (PR 4)
+degrades the reduce transport under wire corruption, and
+`PrecisionSupervisor` (PR 5) escalates the eXmY format under saturation
+pressure.  The serving engine had neither — under a flash crowd its
+only behaviours were head-of-line blocking and the scrub loop.  This
+module is the same state-machine shape pointed at serving overload
+(ISSUE 10):
+
+    normal ──(hot for `patience` steps)──> rung 1 ──(again)──> rung 2 …
+      ^                                       |                   |
+      └──── probation: N quiet steps ─────────┴──── N quiet ──────┘
+
+* **sense** — three deterministic step-clock signals the engine feeds
+  every step: page-pool pressure (reserved fraction of allocatable
+  pages above ``pressure``), KV corruption (inline pre-append detects
+  OR scrub-found corrupt pages this step), and deadline misses (a
+  cancellation this step).  Any one makes the step *hot*.
+* **degrade** — after ``patience`` consecutive hot steps, step one rung
+  DOWN the configured ladder.  Each `Rung` names a restriction set the
+  engine applies from the next step: cap the prefill chunk (smaller
+  dispatches, finer interleave — the SAME compiled program, only
+  ``n_valid`` shrinks, so no retrace), cap admissions per step, tighten
+  the scrub cadence, and finally shed the lowest-SLA-class traffic at
+  admission (including purging it from the queue).
+* **probation** — after ``probation`` consecutive quiet steps at a
+  degraded rung, move one rung back up; rung 0 (the configured
+  behaviour) is home, never exceeded.
+
+Pure host state — no RNG, no wall clock — so a run under a
+deterministic `FaultPlan` (``kv_storm``/``req_burst``/``slot_stall``)
+replays its exact transition sequence, and `state_dict()` is JSON-able
+so crash-recovery snapshots (`ServeEngine.snapshot`) resume the ladder
+mid-degradation exactly like the precision supervisor resumes
+mid-escalation from checkpoint metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+__all__ = ["Rung", "ServeSupervisor", "default_rungs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rung:
+    """One degradation rung: the restriction set the engine applies
+    while the supervisor sits at this level.  ``None`` leaves the
+    engine's configured behaviour untouched; rungs list their
+    restrictions EXPLICITLY (no implicit inheritance from earlier
+    rungs), so the active policy is always readable off one object."""
+    name: str
+    prefill_chunk_cap: Optional[int] = None  # max prompt tokens/dispatch
+    admission_cap: Optional[int] = None      # max admissions per step
+    scrub_every_cap: Optional[int] = None    # scrub at least this often
+    shed_class_above: Optional[int] = None   # shed sla_class >= this
+
+    def __post_init__(self):
+        for field in ("prefill_chunk_cap", "admission_cap",
+                      "scrub_every_cap", "shed_class_above"):
+            v = getattr(self, field)
+            if v is not None and v < 1:
+                raise ValueError(f"rung {self.name!r}: {field} must be "
+                                 f">= 1 (or None), got {v}")
+
+
+def default_rungs(prefill_chunk: int) -> tuple:
+    """The documented default ladder for an engine with the given base
+    prefill chunk (docs/SERVING.md "Degradation ladder"): shrink the
+    prefill chunk, then cap admissions, then tighten the scrub, then
+    shed everything below the premium class."""
+    half = max(1, prefill_chunk // 2)
+    return (
+        Rung("normal"),
+        Rung("small-prefill", prefill_chunk_cap=half),
+        Rung("cap-admissions", prefill_chunk_cap=half, admission_cap=1),
+        Rung("tight-scrub", prefill_chunk_cap=half, admission_cap=1,
+             scrub_every_cap=1),
+        Rung("shed-low", prefill_chunk_cap=half, admission_cap=1,
+             scrub_every_cap=1, shed_class_above=1),
+    )
+
+
+class ServeSupervisor:
+    """The serving degradation ladder (module docstring).
+
+    ``on_step(step, page_util=, corrupt=, misses=)`` -> None |
+    "degrade" | "probate"; ``rung`` is the restriction set the engine
+    should apply next step; ``transitions`` is the deterministic
+    (step, from_name, to_name) log the chaos tests assert on."""
+
+    def __init__(self, rungs: Optional[Sequence[Rung]] = None, *,
+                 patience: int = 2, probation: int = 8,
+                 pressure: float = 0.9, prefill_chunk: int = 16):
+        self.rungs = tuple(rungs) if rungs is not None \
+            else default_rungs(prefill_chunk)
+        if len(self.rungs) < 2:
+            raise ValueError(f"a degradation ladder needs >= 2 rungs "
+                             f"(normal + at least one restriction), got "
+                             f"{len(self.rungs)}")
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        if probation < 1:
+            raise ValueError(f"probation must be >= 1, got {probation}")
+        if not 0.0 < pressure <= 1.0:
+            raise ValueError(f"pressure is a fraction in (0, 1], got "
+                             f"{pressure}")
+        self.patience = int(patience)
+        self.probation = int(probation)
+        self.pressure = float(pressure)
+        self._level = 0
+        self.hot = 0              # consecutive hot steps
+        self.quiet = 0            # consecutive quiet steps
+        self.last_hot = False
+        self.transitions: list = []   # (step, from_name, to_name)
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def rung(self) -> Rung:
+        """The restriction set the engine should apply next step."""
+        return self.rungs[self._level]
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    @property
+    def degraded(self) -> bool:
+        return self._level > 0
+
+    # -- the state machine ------------------------------------------------
+
+    def observe(self, *, page_util: float, corrupt: int,
+                misses: int) -> bool:
+        """The hot/quiet verdict for one engine step: page pressure at or
+        above the threshold, any KV corruption seen this step (inline
+        detects or scrub-found pages), or any deadline miss."""
+        return (float(page_util) >= self.pressure or int(corrupt) > 0
+                or int(misses) > 0)
+
+    def on_step(self, step: int, *, page_util: float, corrupt: int = 0,
+                misses: int = 0) -> Optional[str]:
+        """Feed one engine step's signals; returns "degrade"/"probate"
+        when the ladder moves, else None."""
+        hot = self.observe(page_util=page_util, corrupt=corrupt,
+                           misses=misses)
+        self.last_hot = hot
+        if hot:
+            self.quiet = 0
+            self.hot += 1
+            if self.hot >= self.patience and \
+                    self._level + 1 < len(self.rungs):
+                old = self.rung.name
+                self._level += 1
+                self.hot = 0
+                self.transitions.append((step, old, self.rung.name))
+                return "degrade"
+            return None
+        self.hot = 0
+        self.quiet += 1
+        if self._level > 0 and self.quiet >= self.probation:
+            old = self.rung.name
+            self._level -= 1
+            self.quiet = 0
+            self.transitions.append((step, old, self.rung.name))
+            return "probate"
+        return None
+
+    # -- snapshot persistence ---------------------------------------------
+
+    def state_dict(self) -> dict:
+        """JSON-able snapshot (rung CONFIG included, so
+        `ServeEngine.restore` rebuilds the identical ladder): a restored
+        engine resumes mid-degradation instead of re-climbing from
+        normal — the serving twin of the precision supervisor's
+        checkpoint-metadata persistence."""
+        return {
+            "rungs": [dataclasses.asdict(r) for r in self.rungs],
+            "patience": self.patience,
+            "probation": self.probation,
+            "pressure": self.pressure,
+            "level": self._level,
+            "hot": self.hot,
+            "quiet": self.quiet,
+            "transitions": [list(t) for t in self.transitions],
+        }
+
+    @classmethod
+    def from_state_dict(cls, state: dict) -> "ServeSupervisor":
+        """Rebuild a supervisor — config AND position — from a
+        `state_dict` snapshot."""
+        sup = cls(tuple(Rung(**r) for r in state["rungs"]),
+                  patience=int(state["patience"]),
+                  probation=int(state["probation"]),
+                  pressure=float(state["pressure"]))
+        sup.load_state_dict(state)
+        return sup
+
+    def load_state_dict(self, state: dict) -> "ServeSupervisor":
+        """Restore ladder position onto a configured supervisor
+        (returns self).  The saved rung list must match the configured
+        one — resuming level 2 of a DIFFERENT ladder would silently
+        apply an unintended restriction set."""
+        saved = tuple(Rung(**r) for r in state["rungs"])
+        if saved != self.rungs:
+            raise ValueError(
+                f"snapshotted serve ladder "
+                f"{[r.name for r in saved]} does not match the "
+                f"configured {[r.name for r in self.rungs]}; restore "
+                f"with the same rung list")
+        self._level = min(max(int(state["level"]), 0), len(self.rungs) - 1)
+        self.hot = int(state.get("hot", 0))
+        self.quiet = int(state.get("quiet", 0))
+        self.transitions = [tuple(t) for t in state.get("transitions", [])]
+        return self

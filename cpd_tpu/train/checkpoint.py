@@ -35,13 +35,18 @@ __all__ = ["CheckpointManager", "PreemptionGuard", "preempt_save",
            "checkpoint_digest"]
 
 
-def checkpoint_digest(step_dir: str) -> dict:
+def checkpoint_digest(step_dir: str, exclude: tuple = ()) -> dict:
     """Content checksum of one step's checkpoint directory.
 
     sha256 over (relative path, size, bytes) of every file, in sorted
     order — any truncation, bit-flip, or missing file changes the
     digest.  Orbax finalizes a step atomically (write to a tmp dir, then
-    rename), so by the time a step is listed its files are stable."""
+    rename), so by the time a step is listed its files are stable.
+
+    ``exclude`` skips files by step-dir-relative path: a sidecar that
+    STORES the digest cannot be covered by it (the serving engine's
+    crash-recovery snapshots put ``meta.json`` inside the snapshot
+    directory — `serve.engine.ServeEngine.snapshot`)."""
     h = hashlib.sha256()
     n_files = 0
     n_bytes = 0
@@ -50,6 +55,8 @@ def checkpoint_digest(step_dir: str) -> dict:
         for name in sorted(files):
             path = os.path.join(root, name)
             rel = os.path.relpath(path, step_dir)
+            if rel in exclude:
+                continue
             size = os.path.getsize(path)
             h.update(rel.encode())
             h.update(str(size).encode())

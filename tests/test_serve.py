@@ -1,5 +1,9 @@
 """Serving stack tests (cpd_tpu/serve/): scheduler, paged eXmY KV cache,
-continuous-batching engine, corruption repair, load-gen determinism.
+continuous-batching engine, corruption repair, load-gen determinism —
+plus the ISSUE 10 SLA-guard layer: admission verdicts + the structural
+TTFT shed bound, deadline cancellation, the no-progress watchdog, the
+ServeSupervisor degradation ladder, crash-recovery snapshots, bounded
+result stores, and the e2e serving chaos drill.
 
 Oracles:
   * the raw fp32-cache engine (``raw_cache=True``) — the packed (8,23)
@@ -8,12 +12,17 @@ Oracles:
   * `models.generate` — greedy engine output must reproduce the
     fused-scan decode path token for token;
   * determinism — the same (model, trace, fault plan) must replay to
-    identical counters and outputs on fresh engines.
+    identical counters and outputs on fresh engines;
+  * the uninterrupted run — a restored snapshot's decode stream must be
+    bitwise identical to it at (8,23).
 
 Timing (tok/s vs serial) is deliberately NOT asserted here — that is
 the `serve-smoke` CI gate (tools/bench_serve.py --smoke), where the
 model is sized so the comparison has margin.
 """
+
+import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -24,8 +33,11 @@ from cpd_tpu.models import transformer_lm
 from cpd_tpu.quant.numerics import (cast_to_format, kv_page_bytes,
                                     pack_exmy, unpack_exmy, wire_bytes)
 from cpd_tpu.resilience import FaultPlan
-from cpd_tpu.serve import (KVCacheConfig, Request, ServeEngine,
-                           mixed_trace, run_trace)
+from cpd_tpu.serve import (ACCEPT, KVCacheConfig, QUEUE, Request,
+                           ResultStore, Rung, SHED, ServeEngine,
+                           ServeSupervisor, decode_tail_matches,
+                           default_rungs, flash_crowd, mixed_trace,
+                           run_trace, with_sla)
 from cpd_tpu.serve.kvcache import alloc_pool
 from cpd_tpu.serve.model import spec_from_model
 from cpd_tpu.serve.scheduler import DECODE, FREE, Scheduler
@@ -376,3 +388,553 @@ def test_report_unfired_flags_kv_specs_in_training_plans():
     inj.maybe_stall(0)
     left = report_unfired(inj, n_steps=10, rank=1)
     assert [f.kind for f in left] == ["kv_flip"]
+
+
+def test_report_unfired_serve_armed_both_directions():
+    """The serving-chaos kinds (`SERVE_KINDS`) in a TRAINING plan are
+    flagged by default (they only exist on the serving engine's clock);
+    ``serve_armed=True`` — a caller that IS driving a serving engine —
+    suppresses exactly those flags and nothing else."""
+    from cpd_tpu.resilience import Injector
+    from cpd_tpu.resilience.inject import report_unfired
+
+    plan = FaultPlan.parse(
+        "kv_storm@2:3;slot_stall@3:0;req_burst@4:4;grad_nan@1")
+    left = report_unfired(Injector(plan), n_steps=10, rank=1)
+    assert sorted(f.kind for f in left) == ["kv_storm", "req_burst",
+                                            "slot_stall"]
+    left = report_unfired(Injector(plan), n_steps=10, rank=1,
+                          serve_armed=True)
+    assert left == []
+    # arming serve kinds must not unflag a plain past-the-end spec
+    left = report_unfired(Injector(plan), n_steps=1, rank=1,
+                          serve_armed=True)
+    assert [f.kind for f in left] == ["grad_nan"]
+
+
+# =================================================================
+# ISSUE 10 — SLA verdicts, deadlines, shed policy
+# =================================================================
+
+def test_submit_verdicts_accept_queue_shed(gqa_model):
+    """`submit` returns an explicit verdict: ACCEPT with a free slot +
+    pages right now, QUEUE behind a backlog, SHED when the TTFT
+    deadline is provably unmeetable from the structural prefill bound."""
+    model, params = gqa_model
+    eng = ServeEngine(model, params, **ENGINE_KW)
+    assert eng.submit(Request(rid=0, prompt=(1, 2, 3),
+                              max_new_tokens=2)) == ACCEPT
+    # queue is now non-empty -> the next submission waits its turn
+    assert eng.submit(Request(rid=1, prompt=(1, 2, 3),
+                              max_new_tokens=2)) == QUEUE
+    # backlog: 6 queued prompt tokens + own 8 = 14 over chunk 4 -> the
+    # first token cannot come sooner than 4 steps; deadline 1 is
+    # provably unmeetable -> SHED, resolved, never enqueued
+    shed_req = Request(rid=2, prompt=tuple(range(8)), max_new_tokens=2,
+                       deadline_steps=1)
+    assert eng.sched.ttft_bound_steps(shed_req) == 4
+    assert eng.submit(shed_req) == SHED
+    assert eng.shed[2] == "admission"
+    assert eng.counters["shed"] == 1
+    eng.run_until_drained()
+    # zero silent drops: every submitted rid resolved
+    assert eng.unresolved() == []
+    assert eng.counters["completed"] == 2
+
+
+def test_shed_bound_is_structural_not_heuristic(gqa_model):
+    """The shed decision flips exactly at the structural bound: with
+    ``bound`` dispatches required (the first eligible in the current
+    step), the earliest first-token step is ``bound - 1`` — a deadline
+    of ``bound - 2`` sheds, ``bound - 1`` queues AND the request then
+    delivers its first token exactly at the deadline (the bound is
+    tight under oldest-first prefill — no slack, no false shed)."""
+    model, params = gqa_model
+    eng = ServeEngine(model, params, **ENGINE_KW)
+    eng.submit(Request(rid=0, prompt=tuple(range(8)), max_new_tokens=2))
+    probe = Request(rid=1, prompt=tuple(range(4)), max_new_tokens=2)
+    bound = eng.sched.ttft_bound_steps(probe)    # 12 tokens / chunk 4
+    assert bound == 3
+    assert eng.submit(dataclasses.replace(
+        probe, deadline_steps=bound - 2)) == SHED
+    ok = dataclasses.replace(probe, rid=2, deadline_steps=bound - 1)
+    assert eng.submit(ok) == QUEUE
+    eng.run_until_drained()
+    steps = {(k, r): s for k, r, s, _ in eng.events}
+    # tight: the first token lands exactly AT the deadline step
+    assert steps[("first_token", 2)] == ok.arrival + ok.deadline_steps
+    assert eng.counters["deadline_misses"] == 0
+    assert eng.unresolved() == []
+
+
+def test_bounded_queue_backpressure(gqa_model):
+    """`max_queue` turns burst storms into explicit shed verdicts
+    instead of an ever-growing wait queue."""
+    model, params = gqa_model
+    eng = ServeEngine(model, params, **ENGINE_KW, max_queue=2)
+    verdicts = [eng.submit(Request(rid=i, prompt=(1, 2, 3),
+                                   max_new_tokens=2, arrival=5))
+                for i in range(4)]
+    assert verdicts == [QUEUE, QUEUE, SHED, SHED]
+    assert len(eng.sched.queue) == 2
+    eng.run_until_drained()
+    assert eng.counters["completed"] == 2
+    assert eng.counters["shed"] == 2
+    assert eng.unresolved() == []
+
+
+def test_queued_request_past_deadline_cancelled(gqa_model):
+    """A request whose TTFT deadline expires WHILE QUEUED (admission
+    blocked by a busy batch — a delay the submit-time prefill bound
+    does not price) is cancelled as DEADLINE_MISS, not left to starve."""
+    model, params = gqa_model
+    eng = ServeEngine(model, params, n_slots=1, max_seq=32, page_size=8,
+                      prefill_chunk=4)
+    # the slot is busy decoding 12 tokens; B's own prefill bound is 1
+    # step so it is NOT shed at submit, but admission waits ~12 steps
+    assert eng.submit(Request(rid=0, prompt=(1, 2, 3),
+                              max_new_tokens=12)) == ACCEPT
+    assert eng.submit(Request(rid=1, prompt=(4, 5, 6), max_new_tokens=2,
+                              deadline_steps=4)) == QUEUE
+    eng.run_until_drained()
+    assert eng.counters["deadline_misses"] == 1
+    assert eng.missed[1] == []          # no first token -> empty partial
+    assert eng.counters["completed"] == 1
+    assert eng.unresolved() == []
+
+
+def test_tpot_deadline_cancels_stalled_slot_partial_retained(gqa_model):
+    """A decode slot blowing its per-token budget (here: wedged by
+    slot_stall, with the watchdog configured slower than the budget) is
+    cancelled mid-flight — pages released, DEADLINE_MISS emitted, the
+    partial output RETAINED."""
+    model, params = gqa_model
+    plan = FaultPlan.parse("slot_stall@3:0")
+
+    def run():
+        eng = ServeEngine(model, params, **ENGINE_KW, stall_patience=50,
+                          fault_plan=plan)
+        eng.submit(Request(rid=0, prompt=(1, 2, 3), max_new_tokens=10,
+                           tpot_budget_steps=2))
+        eng.submit(Request(rid=1, prompt=(4, 5, 6), max_new_tokens=4))
+        eng.run_until_drained()
+        eng.report_unfired()
+        return eng
+
+    e1, e2 = run(), run()
+    assert e1.counters["slot_stalls_injected"] == 1
+    assert e1.counters["deadline_misses"] == 1
+    assert len(e1.missed[0]) >= 1       # partial output retained
+    assert e1.counters["completed"] == 1
+    assert e1.unresolved() == []
+    assert e1.counters == e2.counters
+    assert e1.missed == e2.missed
+    # the cancelled slot's pages went back to the pool
+    assert len(e1.sched.free_pages) == e1.sched.total_pages
+
+
+def test_starvation_fifo_within_class_preserved():
+    """A large queued request blocked on page pressure cannot be
+    indefinitely bypassed by later small ones under the shed policy:
+    admission stays strict FIFO (head-of-line), so once pages free the
+    big request enters FIRST."""
+    sched = Scheduler(n_slots=2, n_pages=6, page_size=8, max_pages=4,
+                      prefill_chunk=4, max_queue=8)
+    running = Request(rid=0, prompt=tuple(range(12)), max_new_tokens=8)
+    big = Request(rid=1, prompt=tuple(range(12)), max_new_tokens=8)
+    assert sched.submit(running) == ACCEPT
+    (head,) = sched.admit(step=0)
+    assert sched.submit(big) == QUEUE
+    # a stream of later 1-page requests must not overtake the big one
+    for i in range(2, 6):
+        assert sched.submit(Request(rid=i, prompt=(1,),
+                                    max_new_tokens=1)) == QUEUE
+    assert sched.admit(step=1) == []       # blocked: FIFO holds them all
+    sched.evict(head)
+    admitted = sched.admit(step=2)
+    assert [s.req.rid for s in admitted] == [1, 2]   # big goes FIRST
+    # the surviving queue order is still submission order
+    assert [r.rid for r in sched.queue] == [3, 4, 5]
+
+
+# =================================================================
+# ISSUE 10 — no-progress watchdog (slot_stall)
+# =================================================================
+
+def test_slot_stall_watchdog_evicts_and_reprefills(gqa_model):
+    """The slot_stall chaos kind wedges a decode lane; the watchdog
+    catches the no-progress streak, evicts the slot's pages, rebuilds
+    its cache from the token history and resumes — the request is never
+    dropped, the OUTPUT matches the stall-free run, and the whole drill
+    replays to exact counters."""
+    model, params = gqa_model
+    reqs = _requests(n=3)
+    plan = FaultPlan.parse("slot_stall@4:0")
+
+    def run(p):
+        return _run(model, params, reqs, stall_patience=3, fault_plan=p)
+
+    e1, e2 = run(plan), run(plan)
+    c = e1.counters
+    assert c["slot_stalls_injected"] == 1
+    assert c["watchdog_evictions"] == 1
+    assert c["watchdog_chunks"] >= 1
+    assert c["completed"] == len(reqs)
+    assert c["kv_faults_unfired"] == 0
+    assert e1.unresolved() == []
+    assert e1.counters == e2.counters
+    assert e1.finished == e2.finished
+    # the stall only DELAYS: the recomputed cache decodes to the same
+    # tokens the clean engine produces
+    clean = _run(model, params, reqs, stall_patience=3)
+    assert clean.counters["watchdog_evictions"] == 0
+    assert e1.finished == clean.finished
+
+
+# =================================================================
+# ISSUE 10 — ServeSupervisor degradation ladder
+# =================================================================
+
+def test_supervisor_state_machine_and_roundtrip():
+    sup = ServeSupervisor(default_rungs(8), patience=2, probation=3)
+    assert sup.rung.name == "normal"
+    # one hot step is not enough (patience 2)
+    assert sup.on_step(0, page_util=0.0, corrupt=1) is None
+    assert sup.on_step(1, page_util=0.0, corrupt=1) == "degrade"
+    assert sup.rung.name == "small-prefill"
+    assert sup.on_step(2, page_util=1.0) is None     # pressure is hot
+    for s in (3, 4):
+        assert sup.on_step(s, page_util=0.0) is None
+    assert sup.on_step(5, page_util=0.0) == "probate"
+    assert sup.rung.name == "normal"
+    assert sup.transitions == [(1, "normal", "small-prefill"),
+                               (5, "small-prefill", "normal")]
+    # snapshot round-trip restores config AND position
+    sup2 = ServeSupervisor.from_state_dict(sup.state_dict())
+    assert sup2.state_dict() == sup.state_dict()
+    with pytest.raises(ValueError, match="does not match"):
+        ServeSupervisor(default_rungs(4)).load_state_dict(
+            sup.state_dict())
+
+
+def test_kv_storm_forces_supervisor_reaction(gqa_model):
+    """kv_storm flips multiple live pages at once: the scrubber repairs
+    them AND the supervisor sees the corruption signal, degrades a
+    rung, then probates back after the clean window — transitions and
+    counters exact and deterministic twice."""
+    model, params = gqa_model
+    reqs = _requests(n=3, max_new=8)
+    plan = FaultPlan.parse("kv_storm@4:2")
+
+    def run():
+        sup = ServeSupervisor(default_rungs(4), patience=1, probation=3)
+        eng = _run(model, params, reqs, kv_format=(5, 2), scrub_every=2,
+                   fault_plan=plan, supervisor=sup)
+        return eng, sup
+
+    (e1, s1), (e2, s2) = run(), run()
+    c = e1.counters
+    assert c["kv_storms_injected"] == 1
+    assert c["kv_storm_pages"] == 2
+    assert c["kv_pages_corrupt"] >= 2
+    assert c["kv_repairs"] >= 1
+    assert c["sup_degrades"] >= 1
+    assert c["sup_probations"] >= 1
+    assert c["completed"] == len(reqs)
+    assert e1.unresolved() == []
+    assert e1.counters == e2.counters
+    assert s1.transitions == s2.transitions
+    assert s1.transitions[0][1:] == ("normal", "small-prefill")
+    assert s1.rung.name == "normal"     # probated home by drain
+
+
+def test_rung_caps_apply_to_engine(gqa_model):
+    """Rung restrictions actually bite: a degraded rung's prefill-chunk
+    cap halves the tokens per dispatch (same compiled program), the
+    admission cap limits admissions per step, and the shed-low rung
+    purges queued low-SLA work and sheds new low-SLA submissions."""
+    model, params = gqa_model
+    # a supervisor pinned at the shed-low rung (patience 1, instant)
+    rungs = (Rung("normal"),
+             Rung("degraded", prefill_chunk_cap=2, admission_cap=1,
+                  shed_class_above=1))
+    sup = ServeSupervisor(rungs, patience=1, probation=1000)
+    sup.on_step(0, page_util=1.0)       # hot -> degraded before the run
+    assert sup.rung.name == "degraded"
+    eng = ServeEngine(model, params, **ENGINE_KW, supervisor=sup)
+    eng.submit(Request(rid=0, prompt=tuple(range(8)), max_new_tokens=2))
+    eng.submit(Request(rid=1, prompt=(1, 2, 3), max_new_tokens=2,
+                       sla_class=1))
+    eng.step()
+    # queued class-1 work purged by the rung at step start
+    assert eng.shed[1] == "rung-purge"
+    # admission cap 1: only rid 0 entered despite 2 free slots
+    assert eng.counters["admitted"] == 1
+    # chunk capped at 2: the 8-token prompt needs 4 dispatches
+    eng.run_until_drained()
+    assert eng.counters["prefill_chunks"] == 4
+    # NEW low-class submissions shed at the scheduler policy too
+    assert eng.submit(Request(rid=2, prompt=(1,), max_new_tokens=1,
+                              sla_class=1)) == SHED
+    assert eng.unresolved() == []
+
+
+# =================================================================
+# ISSUE 10 — crash-recovery snapshots
+# =================================================================
+
+def _drive(engine, reqs, n_steps):
+    for r in reqs:
+        engine.submit(r)
+    for _ in range(n_steps):
+        engine.step()
+
+
+def test_snapshot_restore_bitwise_decode(gqa_model, tmp_path):
+    """The acceptance gate: a mid-trace snapshot restores to an engine
+    whose remaining decode stream is BITWISE identical to the
+    uninterrupted one at (8,23) — the pool is exact bytes, so this is
+    the same oracle class as the packed-vs-raw gate."""
+    model, params = gqa_model
+    reqs = _requests(n=3)
+    ea = ServeEngine(model, params, **ENGINE_KW, record_logits=True)
+    _drive(ea, reqs, 6)
+    snap = os.path.join(tmp_path, "snap")
+    ea.snapshot(snap)
+    mark = len(ea.logits_log)
+    ea.run_until_drained()
+    eb = ServeEngine.restore(model, params, snap)
+    assert eb.record_logits and eb.step_index == 6
+    eb.run_until_drained()
+    assert decode_tail_matches(ea, mark, eb) > 0
+    # overwriting the same path is whole-directory atomic: the second
+    # save swaps in cleanly (no .tmp/.old debris) and still restores
+    ea.snapshot(snap)
+    assert sorted(os.listdir(tmp_path)) == ["snap"]
+    er = ServeEngine.restore(model, params, snap)
+    assert er.drained() and er.counters == ea.counters
+    # swap-window recovery: a crash between snapshot()'s two renames
+    # leaves the snapshot at a sibling — restore falls back to it
+    os.rename(snap, snap + ".old")
+    er = ServeEngine.restore(model, params, snap)
+    assert er.drained() and er.counters == ea.counters
+
+
+def test_snapshot_mid_corruption_restores_then_repairs(gqa_model,
+                                                       tmp_path):
+    """A snapshot taken WITH corruption in the pool serializes the
+    corrupt bytes and the stale digests verbatim; the restored engine's
+    first dispatch detects the mismatch and repairs through the
+    standard recompute path — no special snapshot-time scrub needed."""
+    model, params = gqa_model
+    reqs = _requests(n=3)
+    eng = ServeEngine(model, params, **ENGINE_KW, kv_format=(5, 2),
+                      scrub_every=2)
+    _drive(eng, reqs, 5)
+    eng._flip_page_byte(eng.sched.live_pages()[0])
+    snap = os.path.join(tmp_path, "snap")
+    eng.snapshot(snap)
+    er = ServeEngine.restore(model, params, snap)
+    er.run_until_drained()
+    assert er.counters["kv_inline_detects"] + \
+        er.counters["kv_pages_corrupt"] >= 1
+    assert er.counters["kv_repairs"] >= 1
+    assert er.counters["completed"] == len(reqs)
+    assert er.unresolved() == []
+
+
+def test_snapshot_tamper_rejected(gqa_model, tmp_path):
+    """`restore` goes through the checkpoint digest machinery: a
+    snapshot whose bytes changed after the save is refused, not
+    silently restored."""
+    model, params = gqa_model
+    eng = ServeEngine(model, params, **ENGINE_KW)
+    _drive(eng, _requests(n=2), 3)
+    snap = os.path.join(tmp_path, "snap")
+    eng.snapshot(snap)
+    pool_file = os.path.join(snap, "pool.npy")
+    blob = bytearray(open(pool_file, "rb").read())
+    blob[-1] ^= 0xFF
+    with open(pool_file, "wb") as fh:
+        fh.write(blob)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        ServeEngine.restore(model, params, snap)
+
+
+# =================================================================
+# ISSUE 10 — bounded result stores
+# =================================================================
+
+def test_result_store_semantics():
+    with pytest.raises(ValueError, match="cap"):
+        ResultStore(0)
+    rs = ResultStore(2)
+    for rid in range(4):
+        rs.put(rid, [rid])
+    assert len(rs) == 2 and rs.evicted == 2
+    assert 0 not in rs and rs[3] == [3]
+    assert rs == {2: [2], 3: [3]}
+    drained = rs.drain()
+    assert drained == {2: [2], 3: [3]} and len(rs) == 0
+
+
+def test_finished_store_bounded_under_sustained_load(gqa_model):
+    """The unbounded-memory regression gate: sustained traffic holds
+    the finished store at its cap, evictions are counted, completions
+    keep counting past the cap, and drain() hands results out."""
+    model, params = gqa_model
+    eng = ServeEngine(model, params, **ENGINE_KW, finished_cap=4)
+    reqs = [Request(rid=i, prompt=(1 + i % 5, 2, 3), max_new_tokens=2,
+                    arrival=i) for i in range(12)]
+    for r in reqs:
+        eng.submit(r)
+    eng.run_until_drained()
+    assert eng.counters["completed"] == 12
+    assert len(eng.finished) == 4                 # held at the cap
+    assert eng.finished.evicted == 8
+    assert eng.counters["results_evicted"] == 8
+    assert eng.unresolved() == []
+    out = eng.finished.drain()
+    assert len(out) == 4 and len(eng.finished) == 0
+
+
+# =================================================================
+# ISSUE 10 — req_burst + loadgen SLA metrics
+# =================================================================
+
+def test_req_burst_keyed_into_plan(gqa_model):
+    """The flash crowd rides the FaultPlan: run_trace pops the due
+    specs and submits the factory's requests — deterministic twice —
+    and with NO factory the spec is reported unfired, never silent."""
+    model, params = gqa_model
+    base = [Request(rid=0, prompt=(1, 2, 3), max_new_tokens=3)]
+    plan = FaultPlan.parse("req_burst@3:3")
+
+    def burst_run():
+        eng = ServeEngine(model, params, **ENGINE_KW, fault_plan=plan)
+        m = run_trace(eng, list(base),
+                      burst_factory=flash_crowd(
+                          VOCAB, prompt_lens=(3,), max_new=(3,)))
+        return eng, m
+
+    (e1, m1), (e2, m2) = burst_run(), burst_run()
+    assert m1["submitted"] == 4               # 1 trace + 3 crowd
+    assert e1.counters["req_bursts_injected"] == 1
+    assert m1["completed"] == 4 and m1["dropped"] == 0
+    assert m1["counters"] == m2["counters"]
+    assert e1.finished == e2.finished
+    # no factory -> the spec can never fire; surfaced, not swallowed
+    e3 = ServeEngine(model, params, **ENGINE_KW, fault_plan=plan)
+    run_trace(e3, list(base))
+    assert e3.counters["req_bursts_injected"] == 0
+    assert e3.counters["kv_faults_unfired"] == 1
+
+
+def test_run_trace_sla_metrics(gqa_model):
+    """The SLA metric satellite: shed_rate / deadline_miss_rate /
+    goodput_by_class ride the metric dict, with sheds actually
+    engaging under a bounded queue."""
+    model, params = gqa_model
+    trace = with_sla(
+        mixed_trace(8, VOCAB, prompt_lens=(4, 6), max_new=(4,), seed=7),
+        [dict(sla_class=0), dict(sla_class=1, deadline_steps=2)])
+    eng = ServeEngine(model, params, **ENGINE_KW, max_queue=2)
+    m = run_trace(eng, trace)
+    assert m["dropped"] == 0
+    assert m["submitted"] == 8
+    assert m["completed"] + m["shed"] + m["deadline_misses"] == 8
+    assert m["shed_rate"] == round(m["shed"] / 8, 4)
+    assert m["deadline_miss_rate"] == round(m["deadline_misses"] / 8, 4)
+    assert m["shed"] > 0        # the tight class-1 deadline engaged
+    assert set(m["goodput_by_class"]) <= {"0", "1"}
+    assert "0" in m["goodput_by_class"]
+
+
+# =================================================================
+# ISSUE 10 — the e2e serving chaos drill (acceptance gate)
+# =================================================================
+
+def test_e2e_serving_chaos_drill(gqa_model, tmp_path):
+    """burst + stall + storm -> shed / degrade / watchdog / repair ->
+    ZERO silent drops: every submitted rid resolves to FINISHED, SHED
+    or DEADLINE_MISS; supervisor degrade->probation transitions and
+    every counter exact and identical across two runs; and a mid-chaos
+    snapshot restores to a bitwise-identical decode stream at (8,23)."""
+    model, params = gqa_model
+    plan = FaultPlan.parse("req_burst@2:4;slot_stall@5:0;kv_storm@8:2")
+    base = with_sla(
+        mixed_trace(6, VOCAB, prompt_lens=(4, 6), max_new=(5,), seed=3),
+        [dict(sla_class=0), dict(sla_class=1, deadline_steps=6)])
+
+    def chaos_engine():
+        sup = ServeSupervisor(default_rungs(4), patience=1, probation=4)
+        return ServeEngine(model, params, **ENGINE_KW, kv_format=(8, 23),
+                           scrub_every=3, stall_patience=2, max_queue=3,
+                           fault_plan=plan, supervisor=sup,
+                           record_logits=True)
+
+    def factory():
+        return flash_crowd(VOCAB, prompt_lens=(4,), max_new=(4,),
+                           seed=9, sla=dict(sla_class=1))
+
+    def chaos_run():
+        eng = chaos_engine()
+        m = run_trace(eng, list(base), burst_factory=factory())
+        return eng, m
+
+    (e1, m1), (e2, m2) = chaos_run(), chaos_run()
+    c = e1.counters
+    # every chaos kind fired
+    assert c["req_bursts_injected"] == 1
+    assert c["slot_stalls_injected"] == 1
+    assert c["kv_storms_injected"] == 1
+    assert c["kv_faults_unfired"] == 0
+    # every defense engaged
+    assert c["shed"] >= 1                       # burst over max_queue
+    assert c["watchdog_evictions"] >= 1         # stall recovered
+    assert c["kv_repairs"] >= 1                 # storm repaired
+    assert c["sup_degrades"] >= 1 and c["sup_probations"] >= 1
+    assert e1.supervisor.transitions and \
+        e1.supervisor.transitions == e2.supervisor.transitions
+    # ZERO silent drops: every submitted rid resolved
+    assert m1["dropped"] == 0
+    assert e1.unresolved() == []
+    assert m1["submitted"] == (c["completed"] + c["shed"]
+                               + c["deadline_misses"])
+    # exact and deterministic twice
+    assert m1["counters"] == m2["counters"]
+    assert e1.finished == e2.finished
+    assert e1.shed == e2.shed and e1.missed == e2.missed
+
+    # mid-chaos snapshot: replay the drill manually, snapshot after the
+    # storm has fired (step 9 > all spec steps), and compare the
+    # remaining decode stream bitwise against the uninterrupted engine
+    def manual(eng, until):
+        pending = sorted(base, key=lambda r: (r.arrival, r.rid))
+        fac = factory()
+        while (pending or eng.has_pending_bursts()
+               or not eng.drained()):
+            if until is not None and eng.step_index >= until:
+                return pending
+            while pending and pending[0].arrival <= eng.step_index:
+                eng.submit(pending.pop(0))
+            for spec in eng.take_due_bursts():
+                for r in fac(spec):
+                    eng.submit(r)
+            eng.step()
+        return pending
+
+    ea = chaos_engine()
+    left = manual(ea, until=9)
+    assert not ea.has_pending_bursts()     # chaos fully fired pre-snap
+    snap = os.path.join(tmp_path, "chaos-snap")
+    ea.snapshot(snap)
+    mark = len(ea.logits_log)
+    for r in left:
+        ea.submit(r)
+    ea.run_until_drained()
+    eb = ServeEngine.restore(model, params, snap)
+    for r in left:
+        eb.submit(r)
+    eb.run_until_drained()
+    assert decode_tail_matches(ea, mark, eb) > 0

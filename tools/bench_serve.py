@@ -21,7 +21,24 @@ the explicit speedup gate):
      bit-identical to the raw-fp32-cache oracle's;
   4. speedup gate: continuous batching sustains strictly higher
      aggregate tok/s than serial batch-1 `generate()` on the same trace
-     (best of two engine passes, after a warmup pass for both sides).
+     (best of two engine passes, after a warmup pass for both sides);
+  5. overload drill (ISSUE 10): an SLA-classed flash crowd against a
+     bounded queue + tight deadlines -> shed and deadline-miss counters
+     nonzero, EXACT and identical across two runs, zero silent drops
+     (every submitted rid resolves to FINISHED/SHED/DEADLINE_MISS);
+  6. snapshot drill: save mid-trace -> restore -> the remaining decode
+     stream is BITWISE identical to the uninterrupted engine at (8,23);
+  7. slot-stall watchdog drill: a wedged decode lane is evicted and
+     re-prefilled from history by the no-progress watchdog — output
+     identical to the stall-free run, counters exact twice.
+
+Drill traces (5-7) are deliberately SHORT (8 requests, max_new 8) so
+the gate stays inside its CI time budget; they reuse the compiled step
+programs of gates 1-4.
+
+``--overload-sweep`` maps the overload frontier for docs/PERF.md: the
+same SLA-classed trace at increasing Poisson offered rates, reporting
+offered load vs goodput / shed_rate / deadline_miss_rate.
 
 Run it by hand for the docs/PERF.md numbers:
 
@@ -179,14 +196,154 @@ def run_smoke(args) -> dict:
                       ("tok_per_s", "ttft_ms_p50", "ttft_ms_p99",
                        "tpot_ms_p50", "tpot_ms_p99",
                        "goodput_tok_per_s")}
+
+    # 5. overload drill (ISSUE 10): SLA-classed burst against a bounded
+    # queue + tight class-1 deadlines -> sheds and misses engage, exact
+    # and deterministic twice, zero SILENT drops
+    from cpd_tpu.serve import with_sla
+    drill_trace = with_sla(
+        _drill_trace(args),
+        [dict(sla_class=0), dict(sla_class=1, deadline_steps=4)])
+
+    def overload_run():
+        eng = _fresh_engine(model, params, args, max_queue=2)
+        return run_trace(eng, list(drill_trace)), eng
+
+    o1, e1 = overload_run()
+    o2, _ = overload_run()
+    assert o1["counters"] == o2["counters"], \
+        f"overload counters not deterministic:\n{o1['counters']}\n" \
+        f"{o2['counters']}"
+    assert o1["shed"] + o1["deadline_misses"] > 0, \
+        f"overload drill never shed or missed: {o1['counters']}"
+    assert o1["dropped"] == 0 and e1.unresolved() == [], \
+        f"silent drops under overload: {o1['dropped']} " \
+        f"(unresolved {e1.unresolved()})"
+    out["overload_drill"] = {
+        "submitted": o1["submitted"], "completed": o1["completed"],
+        "shed": o1["shed"], "deadline_misses": o1["deadline_misses"],
+        "shed_rate": o1["shed_rate"],
+        "deadline_miss_rate": o1["deadline_miss_rate"],
+        "silent_drops": o1["dropped"], "deterministic": True}
+
+    # 6. snapshot drill: save mid-trace, restore, remaining decode
+    # stream bitwise identical at (8,23) (reuses gate 3's compiled cfg;
+    # the ONE comparison contract lives in loadgen.decode_tail_matches)
+    import tempfile
+
+    from cpd_tpu.serve import ServeEngine, decode_tail_matches
+
+    snap_trace = _drill_trace(args)
+    ea = _fresh_engine(model, params, args, kv_format=(8, 23),
+                       record_logits=True)
+    for r in snap_trace:
+        ea.submit(r)
+    for _ in range(8):
+        ea.step()
+    with tempfile.TemporaryDirectory() as td:
+        snap = os.path.join(td, "snap")
+        ea.snapshot(snap)
+        mark = len(ea.logits_log)
+        ea.run_until_drained()
+        eb = ServeEngine.restore(model, params, snap)
+        eb.run_until_drained()
+    rows = decode_tail_matches(ea, mark, eb)   # raises on any divergence
+    out["snapshot_drill"] = {"rows": rows, "bitwise": True,
+                             "restored_at_step": 8}
+
+    # 7. slot-stall watchdog drill: wedged lane evicted + re-prefilled,
+    # output identical to the stall-free run, counters exact twice
+    stall_plan = FaultPlan.parse("slot_stall@6:0")
+    stall_trace = _drill_trace(args)
+
+    def stall_run(plan):
+        eng = _fresh_engine(model, params, args, stall_patience=2,
+                            fault_plan=plan)
+        return run_trace(eng, list(stall_trace)), eng
+
+    s1, se1 = stall_run(stall_plan)
+    s2, _ = stall_run(stall_plan)
+    sc = s1["counters"]
+    assert sc == s2["counters"], \
+        f"stall counters not deterministic:\n{sc}\n{s2['counters']}"
+    assert sc["slot_stalls_injected"] == 1, sc
+    assert sc["watchdog_evictions"] >= 1 and sc["watchdog_chunks"] >= 1, sc
+    assert sc["kv_faults_unfired"] == 0, sc
+    assert s1["dropped"] == 0 and s1["completed"] == len(stall_trace), sc
+    clean, ce = stall_run(None)
+    assert ce.finished == se1.finished, \
+        "watchdog recovery changed the decoded tokens"
+    out["watchdog_drill"] = {
+        "stalls": sc["slot_stalls_injected"],
+        "evictions": sc["watchdog_evictions"],
+        "reprefill_chunks": sc["watchdog_chunks"],
+        "completed": s1["completed"],
+        "output_matches_stall_free": True, "deterministic": True}
     return out
+
+
+def _drill_trace(args) -> list:
+    """The SHORT trace the ISSUE 10 drills share (time budget: the
+    smoke's main trace keeps its 16x16 shape for the speedup margin;
+    the drills only need enough traffic to trip their mechanisms)."""
+    from cpd_tpu.serve import mixed_trace
+
+    return mixed_trace(8, _SMOKE_MODEL["vocab_size"],
+                       prompt_lens=(4, 8, 12), max_new=(8,),
+                       seed=args.seed + 17)
+
+
+def run_overload_sweep(args) -> dict:
+    """The overload frontier for docs/PERF.md: the same SLA-classed
+    request population at increasing Poisson offered rates through a
+    bounded-queue engine — offered load vs goodput, shed and
+    deadline-miss rates.  Class 0 is best-effort, class 1 carries a
+    TTFT deadline; past saturation the deadline bound sheds class-1
+    work at admission instead of letting everything miss."""
+    from cpd_tpu.serve import poisson_trace, run_trace, with_sla
+
+    model, params = _build_model(args)
+    rows = []
+    for rate in (0.5, 1.0, 2.0, 4.0, 8.0):
+        trace = with_sla(
+            poisson_trace(args.requests, _SMOKE_MODEL["vocab_size"],
+                          rate=rate, prompt_lens=(4, 8, 12),
+                          max_new=(16,), seed=args.seed),
+            [dict(sla_class=0),
+             dict(sla_class=1, deadline_steps=args.deadline_steps)])
+        span = max(r.arrival for r in trace) + 1
+        run_trace(_fresh_engine(model, params, args, max_queue=4),
+                  list(trace))        # warm
+        m = run_trace(_fresh_engine(model, params, args, max_queue=4),
+                      list(trace))
+        rows.append({
+            "rate": rate,
+            "offered_req_per_step": round(len(trace) / span, 3),
+            "tok_per_s": m["tok_per_s"],
+            "goodput_tok_per_s": m["goodput_tok_per_s"],
+            "goodput_by_class": m["goodput_by_class"],
+            "shed_rate": m["shed_rate"],
+            "deadline_miss_rate": m["deadline_miss_rate"],
+            "completed": m["completed"], "shed": m["shed"],
+            "deadline_misses": m["deadline_misses"],
+            "dropped": m["dropped"],
+        })
+    return {"overload_sweep": rows, "requests": args.requests,
+            "deadline_steps": args.deadline_steps,
+            "kv_format": list(args.kv_format)}
 
 
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     p.add_argument("--smoke", action="store_true",
                    help="CI gate: determinism x2, fault drill, bitwise "
-                        "oracle, speedup-vs-serial")
+                        "oracle, speedup-vs-serial, overload/snapshot/"
+                        "watchdog drills")
+    p.add_argument("--overload-sweep", action="store_true",
+                   help="map the overload frontier (offered load vs "
+                        "goodput/shed/miss) for docs/PERF.md")
+    p.add_argument("--deadline-steps", type=int, default=12,
+                   help="class-1 TTFT deadline for --overload-sweep")
     p.add_argument("--trace", choices=("poisson", "bursty", "mixed"),
                    default="mixed")
     p.add_argument("--requests", type=int, default=16)
@@ -199,7 +356,12 @@ def main() -> int:
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
-    out = run_smoke(args) if args.smoke else run_load(args)
+    if args.smoke:
+        out = run_smoke(args)
+    elif args.overload_sweep:
+        out = run_overload_sweep(args)
+    else:
+        out = run_load(args)
     print(json.dumps(out))
     return 0
 
