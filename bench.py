@@ -694,8 +694,29 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
             trace = mixed_trace(16, 512, max_new=(16,), seed=0)
             run_trace(ServeEngine(sv_model, sv_params, **sv_kw),
                       list(trace))                     # warm compile
-            sv = run_trace(ServeEngine(sv_model, sv_params, **sv_kw),
-                           list(trace))
+            # obs spine (ISSUE 11): the measured engine carries a
+            # tracer + flight ring so every capture ships the artifact
+            # bundle (per-request timelines, Prometheus metrics,
+            # Perfetto trace) alongside its numbers — the
+            # `observability` block below records the paths + the
+            # timeline-reconstruction parity verdict.  Built through
+            # the ONE shared materializer (utils.config.build_obs —
+            # same stack the CLIs and bench_serve wire).
+            import argparse as _ap
+
+            from cpd_tpu.utils.config import build_obs
+            obs = build_obs(
+                _ap.Namespace(
+                    obs_dir=os.environ.get(
+                        "BENCH_OBS_DIR",
+                        os.path.join("tools", "recapture_logs",
+                                     "obs_latest")),
+                    obs_flight=256),
+                run="bench", meta={"block": "serving"})
+            sv_eng = ServeEngine(sv_model, sv_params, **sv_kw,
+                                 tracer=obs["tracer"],
+                                 flight=obs["flight"])
+            sv = run_trace(sv_eng, list(trace))
             base = serial_baseline(sv_model, sv_params, trace)
             drill = ServeEngine(sv_model, sv_params, **sv_kw,
                                 scrub_every=2,
@@ -724,6 +745,20 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                     "dropped": dr["dropped"],
                 },
             }
+            try:
+                from cpd_tpu.serve import timeline_metrics
+                obs["registry"].absorb_serve_counters(sv_eng.counters)
+                recon = timeline_metrics(obs["tracer"])
+                bundle = obs["finish"](ttft_reconstruction_exact=all(
+                    recon[k] == sv[k]
+                    for k in ("ttft_ms_p50", "ttft_ms_p99",
+                              "tpot_ms_p50", "tpot_ms_p99",
+                              "goodput_tok_per_s")))
+                obs["flight"].dump("bench_capture")
+                partial["observability"] = bundle
+            except Exception as e:  # noqa: BLE001 — extras must not kill the run
+                partial["observability_note"] = (
+                    f"obs export skipped: {type(e).__name__}: {e}")
             # ISSUE 10 ride-alongs, in their OWN guard so a drill
             # failure surfaces as a note without discarding the core
             # serving metrics already recorded above: the SLA overload

@@ -19,6 +19,10 @@ Module-scoped (one file at a time):
                    lives in [tool.cpd-lint] config, not here)
   compat-drift     jax.experimental.* / removed-API use outside
                    compat.py (ROADMAP item 5 precondition)
+  obs-print        bare print() in non-script library code — ad-hoc
+                   telemetry bypassing the obs MetricsRegistry/event
+                   stream (utils/logging.py's reference-parity loggers
+                   carved out in [tool.cpd-lint] config)
 
 Project-scoped (whole-program, over analysis/project.py's graph):
 
@@ -34,9 +38,10 @@ Project-scoped (whole-program, over analysis/project.py's graph):
 
 from . import (axis_flow, axis_name, collective_contract,  # noqa: F401
                compat_drift, donation, format_bounds, format_flow,
-               jit_hazards, kahan_ordering, pallas_hygiene, retrace,
-               swallow)
+               jit_hazards, kahan_ordering, obs_print, pallas_hygiene,
+               retrace, swallow)
 
 __all__ = ["format_bounds", "axis_name", "jit_hazards", "pallas_hygiene",
            "kahan_ordering", "donation", "swallow", "compat_drift",
-           "format_flow", "axis_flow", "collective_contract", "retrace"]
+           "format_flow", "axis_flow", "collective_contract", "retrace",
+           "obs_print"]

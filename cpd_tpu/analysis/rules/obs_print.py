@@ -1,0 +1,89 @@
+"""obs-print: ad-hoc stdout telemetry bypassing the obs registry.
+
+The obs subsystem (ISSUE 11, cpd_tpu/obs/) gives every number one home
+— `MetricsRegistry` for counters/gauges, the tracer's event stream for
+occurrences, `ScalarWriter` for training curves.  A bare ``print(...)``
+in library code is the regression vector: an un-named, un-labelled,
+un-exported number on stdout that no dashboard, determinism gate or
+flight dump will ever see again.
+
+Flagged shape — a ``print`` call **without a ``file=`` keyword** in a
+module that is **not a script** (no top-level ``if __name__ ==
+"__main__"`` guard):
+
+    def scrub(self):
+        print(f"corrupt pages: {n}")        # <- ad-hoc counter
+
+Deliberately NOT flagged:
+
+* ``print(..., file=sys.stderr)`` — rank-gated operator diagnostics
+  (the ``=> ...`` protocol every defense uses) are stderr's job;
+* any print in a module with a ``__main__`` guard — a CLI/tool's
+  stdout IS its product (bench JSON lines, the linter's own output);
+* the legacy reference-parity loggers (``utils/logging.py``'s
+  TableLogger/ProgressPrinter stdout line protocol, which
+  draw_curve.py greps) — that carve-out lives in config
+  (``[tool.cpd-lint] exempt``), not here: path policy is the
+  project's to own.
+
+New counters should be `MetricsRegistry` series; new one-off prints
+that really are operator diagnostics should say so by writing to
+stderr.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, ModuleContext, Rule, register
+
+__all__ = ["ObsPrint"]
+
+
+def _has_main_guard(tree: ast.Module) -> bool:
+    """Top-level ``if __name__ == "__main__"`` (either comparison
+    order) — the marker that this module's stdout is its product."""
+    for node in tree.body:
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Compare)
+                and len(test.comparators) == 1):
+            continue
+        sides = (test.left, test.comparators[0])
+        names = [s.id for s in sides if isinstance(s, ast.Name)]
+        consts = [s.value for s in sides
+                  if isinstance(s, ast.Constant)]
+        if "__name__" in names and "__main__" in consts:
+            return True
+    return False
+
+
+@register
+class ObsPrint(Rule):
+    id = "obs-print"
+    summary = ("bare print() in library code bypasses the obs "
+               "MetricsRegistry/event stream — use stderr for operator "
+               "diagnostics or a registry metric for numbers "
+               "(script modules with a __main__ guard are exempt; the "
+               "utils/logging.py reference-parity loggers' carve-out "
+               "lives in [tool.cpd-lint] config)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if _has_main_guard(ctx.tree):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                continue
+            if any(kw.arg == "file" for kw in node.keywords):
+                continue   # routed diagnostics (stderr/stream) are fine
+            yield ctx.finding(
+                self.id, node,
+                "bare print() in library code — telemetry belongs in "
+                "the obs MetricsRegistry (a number), the tracer event "
+                "stream (an occurrence), or stderr via file=sys.stderr "
+                "(an operator diagnostic); stdout is reserved for "
+                "script products (docs/OBSERVABILITY.md)")

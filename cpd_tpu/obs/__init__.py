@@ -1,0 +1,40 @@
+"""cpd_tpu.obs — unified tracing, metrics and the crash flight recorder.
+
+The observability spine (L2.5: below train/serve, above utils;
+docs/OBSERVABILITY.md).  Four legs, all pure host-side observation —
+nothing here may touch a value that feeds a jitted program, which is
+what makes "obs on == obs off, bitwise" a structural property rather
+than a hope (pinned in tests/test_obs.py and the obs-smoke CI gate):
+
+* `trace.Tracer` — nested spans + instant events on the step clock AND
+  the wall clock; `NULL_TRACER` / ``tracer is None`` is the zero-cost
+  disabled path.
+* `registry.MetricsRegistry` — counters/gauges/histograms with labels,
+  plus adapters that absorb every legacy telemetry surface
+  (ResilienceMeter, ``prec_wire_*``/``reduce_*`` step metrics, the
+  three supervisors, the serve engine counters) so every number has
+  one home and one name.
+* `export` — deterministic JSONL, Prometheus text exposition (+ the
+  minimal `parse_prometheus` checker), and Chrome-trace-event JSON
+  (Perfetto/TensorBoard-loadable); `write_all` is the one-call bundle.
+* `flight.FlightRecorder` — a bounded ring of recent events dumped on
+  watchdog fire, rollback, preemption and serve snapshots.
+* `timing` — the ONE monotonic wall-clock helper every timer in the
+  repo now rides (`now`, `Stopwatch`, `Timer`).
+
+Stdlib-only on purpose: ``import cpd_tpu.obs`` must stay cheap enough
+for CLIs to wire before jax loads (the same discipline as
+cpd_tpu/utils).
+"""
+
+from .export import (export_chrome_trace, export_jsonl,
+                     export_prometheus, parse_prometheus, write_all)
+from .flight import FlightRecorder
+from .registry import MetricsRegistry
+from .timing import Stopwatch, Timer, now
+from .trace import NULL_TRACER, Span, Tracer
+
+__all__ = ["Tracer", "Span", "NULL_TRACER", "MetricsRegistry",
+           "FlightRecorder", "export_jsonl", "export_prometheus",
+           "export_chrome_trace", "parse_prometheus", "write_all",
+           "now", "Stopwatch", "Timer"]

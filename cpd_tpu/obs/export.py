@@ -1,0 +1,263 @@
+"""Exporters — one tracer + registry, three artifact formats (ISSUE 11
+tentpole, leg 3):
+
+* **JSONL event stream** (``events.jsonl``) — one JSON object per
+  span/event, in ``seq`` order: the machine-diffable ground truth the
+  determinism gate compares byte-for-byte.
+* **Prometheus text exposition** (``metrics.prom``) — the registry
+  rendered in the text format scrapers ingest; `parse_prometheus` is
+  the minimal in-repo checker the tests and the obs-smoke gate run
+  over it.
+* **Chrome trace-event JSON** (``trace.json``) — loadable in Perfetto /
+  chrome://tracing / TensorBoard's trace viewer: spans as complete
+  ("X") events, instants as "i", per-request serve timelines threaded
+  by rid so one request reads as one lane.
+
+Determinism contract (pinned in tests/test_obs.py): a deterministic
+run exported with ``strip_wall=True`` yields byte-identical JSONL and
+Chrome-trace files across runs — every wall-clock-derived field
+(``wall``, ``dur_s``, ``ts``, ``dur``) is either dropped or replaced by
+the deterministic ``seq``/step clock.  With ``strip_wall=False``
+(default) the real timings ride along for humans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+__all__ = ["export_jsonl", "export_prometheus", "export_chrome_trace",
+           "parse_prometheus", "write_all"]
+
+
+# ---------------------------------------------------------------------------
+# JSONL
+# ---------------------------------------------------------------------------
+
+def _jsonl_records(tracer, strip_wall: bool):
+    # the header carries no wall field (Tracer.summary is counts only),
+    # so it is identical with or without strip_wall
+    yield {"t": "meta", "run": tracer.run, "meta": tracer.meta,
+           **tracer.summary()}
+    rows = []
+    for seq, name, cat, step, t0, dur, depth, args in tracer.spans:
+        r = {"t": "span", "seq": seq, "name": name, "cat": cat,
+             "step": step, "depth": depth}
+        if not strip_wall:
+            r["wall"] = t0
+            r["dur_s"] = dur
+        if args:
+            r["args"] = args
+        rows.append((seq, r))
+    for seq, name, cat, step, wall, args in tracer.events:
+        r = {"t": "event", "seq": seq, "name": name, "cat": cat,
+             "step": step}
+        if not strip_wall:
+            r["wall"] = wall
+        if args:
+            r["args"] = args
+        rows.append((seq, r))
+    for _seq, r in sorted(rows, key=lambda x: x[0]):
+        yield r
+
+
+def export_jsonl(tracer, path: str, *, strip_wall: bool = False) -> str:
+    """Write the span+event stream as sorted JSONL; returns `path`."""
+    with open(path, "w", encoding="utf-8") as fh:
+        for rec in _jsonl_records(tracer, strip_wall):
+            fh.write(json.dumps(rec, sort_keys=True,
+                                separators=(",", ":")) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+def _esc(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"') \
+                .replace("\n", "\\n")
+
+
+def _fmt_labels(labels: tuple, extra: tuple = ()) -> str:
+    pairs = list(labels) + list(extra)
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in pairs) + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    # the exposition-format spellings for non-finite samples (a
+    # diverged run's NaN telemetry absorbed into a gauge must export,
+    # not crash the end-of-run artifact write): int(inf)/int(nan)
+    # raise, and repr() would emit 'inf'/'nan', which the format (and
+    # our own parse_prometheus) rejects
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return repr(int(f)) if f == int(f) and abs(f) < 2 ** 53 else repr(f)
+
+
+def export_prometheus(registry, path: Optional[str] = None) -> str:
+    """Render the registry in the text exposition format; write to
+    `path` when given, return the text either way."""
+    lines = []
+    for name, kind, help_text, buckets, rows in registry.collect():
+        if help_text:
+            lines.append(f"# HELP {name} {_esc(help_text)}")
+        lines.append(f"# TYPE {name} {kind}")
+        if kind == "histogram":
+            for labels, cell in rows:
+                acc = 0
+                for bound, n in zip(buckets, cell["buckets"]):
+                    acc += n
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels(labels, (('le', repr(float(bound))),))}"
+                        f" {acc}")
+                lines.append(f"{name}_bucket"
+                             f"{_fmt_labels(labels, (('le', '+Inf'),))}"
+                             f" {cell['count']}")
+                lines.append(f"{name}_sum{_fmt_labels(labels)} "
+                             f"{_fmt_value(cell['sum'])}")
+                lines.append(f"{name}_count{_fmt_labels(labels)} "
+                             f"{cell['count']}")
+        else:
+            for labels, value in rows:
+                lines.append(f"{name}{_fmt_labels(labels)} "
+                             f"{_fmt_value(value)}")
+    text = "\n".join(lines) + ("\n" if lines else "")
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+    return text
+
+
+_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'          # metric name
+    r'(\{[^{}]*\})?'                         # optional label set
+    r'\s+(-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|\+?Inf|NaN))\s*$')
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> dict:
+    """Minimal exposition-format checker (ISSUE 11 satellite): every
+    line must be a comment, a ``# TYPE``/``# HELP`` directive, blank,
+    or a well-formed sample; samples must belong to a declared TYPE.
+    Returns ``{name: {"type": kind, "samples": [(labels_dict, value)]}}``
+    and raises ValueError naming the first malformed line."""
+    out: dict = {}
+    types: dict = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in ("counter", "gauge",
+                                                   "histogram",
+                                                   "summary",
+                                                   "untyped"):
+                raise ValueError(f"line {lineno}: malformed TYPE "
+                                 f"directive: {line!r}")
+            types[parts[2]] = parts[3]
+            out.setdefault(parts[2], {"type": parts[3], "samples": []})
+            continue
+        if line.startswith("#"):
+            continue   # HELP and free comments
+        m = _SAMPLE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample: "
+                             f"{line!r}")
+        name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        family = name if name in types else base
+        if family not in types:
+            raise ValueError(f"line {lineno}: sample {name!r} has no "
+                             f"preceding # TYPE directive")
+        labels = {}
+        if labels_raw:
+            body = labels_raw[1:-1]
+            matched = _LABEL.findall(body)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in matched)
+            if body and body.rstrip(",") != rebuilt:
+                raise ValueError(f"line {lineno}: malformed labels: "
+                                 f"{labels_raw!r}")
+            labels = dict(matched)
+        out.setdefault(family, {"type": types[family], "samples": []})
+        out[family]["samples"].append(
+            (labels, float(value.replace("+Inf", "inf"))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event JSON
+# ---------------------------------------------------------------------------
+
+def export_chrome_trace(tracer, path: str, *,
+                        strip_wall: bool = False) -> str:
+    """Write the Perfetto/chrome://tracing-loadable trace.  Spans are
+    complete ("X") events on tid 0; per-request serve events
+    (cat="req") are instants on ``tid = rid + 1`` (offset past the
+    span lane at tid 0) so each request reads as its own lane.
+    ``strip_wall`` replaces every wall-derived ts/dur with the
+    deterministic seq clock (1 µs per seq tick)."""
+    events = [{"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+               "args": {"name": f"cpd_tpu:{tracer.run}"}}]
+    rows = []
+    for seq, name, cat, step, t0, dur, depth, args in tracer.spans:
+        ev = {"ph": "X", "name": name, "cat": cat, "pid": 1, "tid": 0,
+              "ts": seq if strip_wall else round(t0 * 1e6, 3),
+              "dur": 1 if strip_wall else round(dur * 1e6, 3),
+              "args": {**({"step": step} if step is not None else {}),
+                       **args}}
+        rows.append((seq, ev))
+    for seq, name, cat, step, wall, args in tracer.events:
+        a = dict(args)
+        tid = int(a.get("rid", 0)) + 1 if cat == "req" else 0
+        ev = {"ph": "i", "s": "t", "name": name, "cat": cat, "pid": 1,
+              "tid": tid,
+              "ts": seq if strip_wall else round(wall * 1e6, 3),
+              "args": {**({"step": step} if step is not None else {}),
+                       **a}}
+        rows.append((seq, ev))
+    events.extend(ev for _seq, ev in sorted(rows, key=lambda x: x[0]))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"run": tracer.run, **tracer.meta}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True, separators=(",", ":"))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# the one-call artifact bundle
+# ---------------------------------------------------------------------------
+
+def write_all(obs_dir: str, tracer, registry=None, *,
+              strip_wall: bool = False) -> dict:
+    """Write every artifact into ``obs_dir`` and return the paths +
+    summary block CLIs and bench.py embed in their output
+    (docs/OBSERVABILITY.md "Artifact bundle")."""
+    os.makedirs(obs_dir, exist_ok=True)
+    artifacts = {
+        "events_jsonl": export_jsonl(
+            tracer, os.path.join(obs_dir, "events.jsonl"),
+            strip_wall=strip_wall),
+        "chrome_trace": export_chrome_trace(
+            tracer, os.path.join(obs_dir, "trace.json"),
+            strip_wall=strip_wall),
+    }
+    summary = dict(tracer.summary())
+    if registry is not None:
+        artifacts["prometheus"] = os.path.join(obs_dir, "metrics.prom")
+        export_prometheus(registry, artifacts["prometheus"])
+        summary["metrics"] = len(registry)
+    return {"dir": os.path.abspath(obs_dir),
+            "artifacts": {k: os.path.abspath(v)
+                          for k, v in artifacts.items()},
+            "summary": summary}

@@ -1,0 +1,109 @@
+"""FlightRecorder — a bounded ring of recent events, dumped at the
+moment of death (ISSUE 11 tentpole, leg 4).
+
+The post-incident question is always "what were the last N steps
+doing?"; the answer must survive the four ways a run dies:
+
+* a `StepWatchdog` fire (the step wedged — `StepWatchdog(on_trip=...)`
+  dumps BEFORE the interrupt is sent, so even a hard-exit leaves the
+  ring on disk),
+* a `run_guarded` rollback or abort (loop.py dumps on every rollback
+  and on any non-None abort),
+* SIGINT / preemption (the trainer CLIs dump in their preempt paths),
+* a serve-engine snapshot (`ServeEngine.snapshot` dumps alongside, so
+  a crash-recovery restore has the pre-crash flight log next to it).
+
+The ring holds (seq, wall, kind, step, fields) tuples; `record` is a
+deque append + one clock read — cheap enough to call every step.  Each
+`dump` APPENDS one self-describing block to the dump file (a header
+line with the reason + the ring contents), so repeated incidents in
+one run stay individually greppable:
+
+    {"flight_dump": 3, "reason": "watchdog", ...}
+    {"seq": 140, "kind": "step", "step": 140, "loss": 2.1, ...}
+    ...
+
+Dumping does NOT clear the ring: a rollback dump followed by a
+watchdog dump both show the full recent window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import deque
+from typing import Optional
+
+from .timing import now
+
+__all__ = ["FlightRecorder"]
+
+
+class FlightRecorder:
+    """Bounded in-memory event ring with crash-time JSONL dumps."""
+
+    def __init__(self, path: Optional[str] = None, *,
+                 capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.path = path
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self.dumps = 0
+
+    def record(self, kind: str, *, step: Optional[int] = None,
+               **fields) -> None:
+        """Append one event; past `capacity` the oldest ages out.
+        ``fields`` must be JSON-serializable (they are written verbatim
+        at dump time — a dump must never raise)."""
+        self._seq += 1
+        self._ring.append((self._seq, now(), kind, step, fields))
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def dump(self, reason: str, path: Optional[str] = None) -> Optional[str]:
+        """Append the ring (header + one line per event) to ``path`` or
+        the constructor's path.  Best-effort by design: a recorder with
+        no path, or an unwritable one, reports to stderr instead of
+        raising — the crash being recorded must stay the headline."""
+        target = path or self.path
+        self.dumps += 1
+        header = {"flight_dump": self.dumps, "reason": reason,
+                  "wall": now(), "events": len(self._ring),
+                  "capacity": self.capacity, "seq_high": self._seq}
+        if target is None:
+            print(f"=> flight recorder ({reason}): no dump path "
+                  f"configured; {len(self._ring)} events lost",
+                  file=sys.stderr)
+            return None
+        try:
+            # snapshot FIRST: dump() runs on the watchdog timer thread
+            # while the main thread may still be record()ing (a slow
+            # step completing as the trip fires) — iterating the live
+            # deque would raise "mutated during iteration" and lose
+            # the dump at exactly the crash moment it exists for
+            ring = list(self._ring)
+            os.makedirs(os.path.dirname(os.path.abspath(target)),
+                        exist_ok=True)
+            with open(target, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(header, sort_keys=True) + "\n")
+                for seq, wall, kind, step, fields in ring:
+                    rec = {"seq": seq, "wall": wall, "kind": kind,
+                           "step": step, **fields}
+                    fh.write(json.dumps(rec, sort_keys=True,
+                                        default=str) + "\n")
+        except Exception as e:  # noqa: BLE001 — a dump must never
+            # out-crash the crash it is recording (unserializable
+            # field, concurrent mutation, OSError alike)
+            print(f"=> flight recorder ({reason}): dump to {target} "
+                  f"failed: {type(e).__name__}: {e}", file=sys.stderr)
+            return None
+        return os.path.abspath(target)
+
+    def state(self) -> dict:
+        return {"events": len(self._ring), "capacity": self.capacity,
+                "dumps": self.dumps, "seq_high": self._seq,
+                "path": self.path}

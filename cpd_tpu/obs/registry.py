@@ -1,0 +1,227 @@
+"""MetricsRegistry — every number gets one home and one name (ISSUE 11
+tentpole, leg 2).
+
+The repo accreted five generations of telemetry, each with its own
+container and spelling: `ResilienceMeter` counters, the in-jit
+``prec_wire_*``/``reduce_*`` step metrics, the three supervisors'
+``state_dict()``s, the serve engine's counter dict, and assorted
+one-off floats in bench tools.  The registry absorbs all of them into
+one labelled namespace so exporters (export.py) and dashboards see a
+single coherent surface.
+
+Naming scheme (docs/OBSERVABILITY.md):
+
+    cpd_<subsystem>_<name>    e.g. cpd_train_rollbacks,
+                                   cpd_step_prec_wire_sat,
+                                   cpd_serve_tokens_generated,
+                                   cpd_sup_transport_level
+
+* **counter** — monotone, absorbed cumulatively (`inc`) or mirrored
+  from a device-held cumulative total (`mirror` — the ResilienceMeter
+  discipline: the device holds the truth, the host overwrites).
+* **gauge** — last-write-wins scalar (`set_gauge`).
+* **histogram** — fixed bucket bounds chosen at declaration, plus
+  sum/count (`observe`); exposition follows the Prometheus cumulative-
+  bucket convention.
+
+Labels are sorted key=value tuples, so iteration order — and therefore
+every export — is deterministic for a deterministic run.  The registry
+is pure host-side bookkeeping: nothing here may touch a traced value.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["MetricsRegistry"]
+
+# step-metric keys the registry adopts from a train step's metric dict
+# (the in-jit telemetry families; anything else in the dict is a loss/
+# accuracy-style training metric that belongs to ScalarWriter, not here)
+_STEP_FAMILIES = ("prec_wire_", "reduce_", "guard_", "faults_",
+                  "aps_")
+
+_NAME_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def _valid_name(name: str) -> bool:
+    return bool(name) and not name[0].isdigit() and \
+        all(c in _NAME_OK for c in name)
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Series:
+    __slots__ = ("kind", "help", "buckets", "series")
+
+    def __init__(self, kind: str, help_text: str,
+                 buckets: Optional[Sequence[float]] = None):
+        self.kind = kind
+        self.help = help_text
+        self.buckets = tuple(buckets) if buckets is not None else None
+        self.series: Dict[tuple, object] = {}
+
+
+class MetricsRegistry:
+    """One labelled namespace for every counter/gauge/histogram."""
+
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+    def __init__(self):
+        self._metrics: Dict[str, _Series] = {}
+
+    # -- declaration (implicit on first touch, explicit for help text) ----
+
+    def declare(self, name: str, kind: str, help_text: str = "",
+                buckets: Optional[Sequence[float]] = None) -> None:
+        if not _valid_name(name):
+            raise ValueError(f"invalid metric name {name!r} (allowed: "
+                             f"[a-zA-Z_:][a-zA-Z0-9_:]*)")
+        if kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown metric kind {kind!r}")
+        existing = self._metrics.get(name)
+        if existing is not None:
+            if existing.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already declared as "
+                    f"{existing.kind}, not {kind} — one home, one name")
+            if help_text and not existing.help:
+                existing.help = help_text
+            return
+        if kind == "histogram" and buckets is None:
+            buckets = self.DEFAULT_BUCKETS
+        self._metrics[name] = _Series(kind, help_text, buckets)
+
+    # -- writes -----------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        if value < 0:
+            raise ValueError(f"counter {name!r} cannot decrease "
+                             f"(inc {value})")
+        m = self._touch(name, "counter")
+        key = _label_key(labels)
+        m.series[key] = float(m.series.get(key, 0.0)) + float(value)
+
+    def mirror(self, name: str, value: float, **labels) -> None:
+        """Overwrite a counter with a device-held cumulative total (the
+        ResilienceMeter MIRRORED discipline)."""
+        m = self._touch(name, "counter")
+        m.series[_label_key(labels)] = float(value)
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        m = self._touch(name, "gauge")
+        m.series[_label_key(labels)] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        m = self._touch(name, "histogram")
+        key = _label_key(labels)
+        cell = m.series.get(key)
+        if cell is None:
+            cell = {"buckets": [0] * len(m.buckets), "sum": 0.0,
+                    "count": 0}
+            m.series[key] = cell
+        i = bisect.bisect_left(m.buckets, float(value))
+        if i < len(m.buckets):
+            cell["buckets"][i] += 1
+        cell["sum"] += float(value)
+        cell["count"] += 1
+
+    def _touch(self, name: str, kind: str) -> _Series:
+        m = self._metrics.get(name)
+        if m is None:
+            self.declare(name, kind)
+            m = self._metrics[name]
+        elif m.kind != kind:
+            raise ValueError(f"metric {name!r} is a {m.kind}, not a "
+                             f"{kind} — one home, one name")
+        return m
+
+    # -- adapters: the five legacy telemetry surfaces ---------------------
+
+    def absorb_resilience_meter(self, meter) -> None:
+        """`train.metrics.ResilienceMeter` — every field becomes
+        ``cpd_train_<field>`` (cumulative; mirrored, the meter already
+        holds run totals)."""
+        for field, value in meter.as_dict().items():
+            self.mirror(f"cpd_train_{field}", value)
+
+    def absorb_step_metrics(self, metrics: dict,
+                            step: Optional[int] = None) -> None:
+        """The in-jit telemetry families riding a step's metric dict
+        (``prec_wire_*``, ``reduce_*``, ``guard_*``, ``aps_*``,
+        ``faults_*``) — gauges named ``cpd_step_<key>`` holding the
+        latest step's value (the cumulative ones are device-held run
+        totals already)."""
+        for key, value in metrics.items():
+            if any(key.startswith(f) for f in _STEP_FAMILIES):
+                try:
+                    v = float(value)
+                except (TypeError, ValueError):
+                    continue
+                self.set_gauge(f"cpd_step_{key}", v)
+        if step is not None:
+            self.set_gauge("cpd_step_index", float(step))
+
+    def absorb_supervisor(self, which: str, state: dict) -> None:
+        """A supervisor ``state_dict()`` (transport / precision /
+        serve): numeric scalars become ``cpd_sup_<which>_<key>``
+        gauges; string/tuple-valued fields (mode, format, rung name)
+        become one ``cpd_sup_<which>_info`` gauge carrying them as
+        labels — the Prometheus *info-metric* idiom."""
+        info = {}
+        for key, value in sorted(state.items()):
+            name = f"cpd_sup_{which}_{key}"
+            if isinstance(value, bool):
+                self.set_gauge(name, 1.0 if value else 0.0)
+            elif isinstance(value, (int, float)):
+                self.set_gauge(name, float(value))
+            elif isinstance(value, str):
+                info[key] = value
+            elif isinstance(value, (list, tuple)):
+                # structure (ladder rungs, transition logs): export the
+                # size; the full value belongs to the JSONL stream
+                self.set_gauge(f"{name}_len", float(len(value)))
+            # nested dicts are supervisor-internal; JSONL carries them
+        if info:
+            self.set_gauge(f"cpd_sup_{which}_info", 1.0, **info)
+
+    def absorb_serve_counters(self, counters: dict) -> None:
+        """The serve engine's counter dict — ``cpd_serve_<key>``,
+        mirrored (the engine holds cumulative truth)."""
+        for key, value in counters.items():
+            self.mirror(f"cpd_serve_{key}", float(value))
+
+    # -- reads ------------------------------------------------------------
+
+    def collect(self) -> list:
+        """Deterministic flat view: ``(name, kind, help, [(labels,
+        value), ...])`` sorted by name then labels — the exporters'
+        input."""
+        out = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            rows = sorted(m.series.items())
+            out.append((name, m.kind, m.help, m.buckets, rows))
+        return out
+
+    def as_dict(self) -> dict:
+        """JSON-ready snapshot (bench.py summaries, tests)."""
+        out: dict = {}
+        for name, kind, _help, _buckets, rows in self.collect():
+            if len(rows) == 1 and rows[0][0] == ():
+                val = rows[0][1]
+            else:
+                val = {";".join(f"{k}={v}" for k, v in key): value
+                       for key, value in rows}
+            out[name] = {"kind": kind, "value": val}
+        return out
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._metrics

@@ -1,0 +1,70 @@
+"""The ONE wall-clock timer home (ISSUE 11 satellite: the repo had
+three — `train/metrics.Timer`, ad-hoc ``time.monotonic()`` pairs in
+`serve/loadgen.py`, and ``time.perf_counter()`` pairs sprinkled through
+the bench tools).  Everything that measures host wall time routes
+through here so the clock choice, and any future virtualization of it
+(deterministic replay, frozen-clock tests), has one choke point.
+
+``now()`` is a monotonic clock: immune to NTP steps, comparable only
+within one process — exactly the contract latency metrics need.  The
+serve timelines (obs/trace.py) and loadgen's published TTFT/TPOT use
+the SAME ``now()``, which is what makes the timeline reconstruction
+bit-exact against the published metrics (loadgen.timeline_metrics).
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["now", "Stopwatch", "Timer"]
+
+
+def now() -> float:
+    """Monotonic wall-clock seconds (one clock for the whole repo).
+
+    `time.perf_counter`, not `time.monotonic`: both are monotonic, but
+    perf_counter is the highest-resolution clock the platform offers —
+    the bench tools time sub-millisecond kernels through this helper,
+    and `time.monotonic`'s ~15.6 ms tick on Windows (< 3.13) would
+    quantize those to garbage.  Every latency metric and the timeline-
+    reconstruction parity contract only need one shared monotonic
+    clock, which this remains."""
+    return time.perf_counter()
+
+
+class Stopwatch:
+    """The ``t0 = clock(); ...; dt = clock() - t0`` pair, named.
+
+    `lap()` returns seconds since construction or the previous lap;
+    `elapsed()` peeks without resetting the lap mark."""
+
+    def __init__(self):
+        self._t0 = now()
+        self._mark = self._t0
+
+    def lap(self) -> float:
+        t = now()
+        dt = t - self._mark
+        self._mark = t
+        return dt
+
+    def elapsed(self) -> float:
+        """Seconds since construction (independent of laps)."""
+        return now() - self._t0
+
+
+class Timer:
+    """Incremental wall-clock timer (reference DavidNet/utils.py:28-38
+    parity, moved here from train/metrics.py): each call returns the
+    time since the previous call and accumulates total time."""
+
+    def __init__(self):
+        self.times = [now()]
+        self.total_time = 0.0
+
+    def __call__(self, include_in_total: bool = True) -> float:
+        self.times.append(now())
+        delta = self.times[-1] - self.times[-2]
+        if include_in_total:
+            self.total_time += delta
+        return delta

@@ -90,7 +90,7 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 resync_fn: Optional[Callable] = None,
                 consensus_fn: Optional[Callable] = None,
                 consensus_every: int = 0,
-                precision=None):
+                precision=None, tracer=None, flight=None):
     """Drive ``step_fn`` to ``n_steps`` under the defense stack.
 
     step_fn: jitted ``(state, *batch) -> (state, metrics)`` with a
@@ -116,6 +116,11 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
     consensus_fn / consensus_every: the periodic parameter-consensus
         digest check (``state -> int32 agree``) and its cadence in
         accepted steps (0 = off; requires resync_fn).
+    tracer: optional obs.Tracer — per-iteration data/step spans on the
+        step clock (pure host-side observation; step outputs are
+        bitwise identical with or without it, pinned in tests).
+    flight: optional obs.FlightRecorder — one ring event per accepted
+        step, dumped on every rollback and on any abort.
     precision: resilience.precision.PrecisionSupervisor — enables the
         eXmY format-escalation ladder; requires ``step_for_level``,
         whose keys follow `precision.ladder_step_key` (the (exp, man)
@@ -127,9 +132,11 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
     Returns ``(state, GuardedReport)``; the report's ``events`` list is
     the determinism witness.
     """
+    from ..obs.trace import NULL_TRACER
     from ..train.metrics import ResilienceMeter
     from .precision import ladder_step_key
     meter = meter if meter is not None else ResilienceMeter()
+    tr = tracer if tracer is not None else NULL_TRACER
     if supervisor is not None and step_for_level is None:
         raise ValueError("supervisor requires step_for_level (a level -> "
                          "step mapping, e.g. transport.StepTable)")
@@ -169,6 +176,9 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
         # covered too (inject.report_unfired)
         from .inject import report_unfired
         report_unfired(injector, n_steps=n_steps, meter=meter, rank=rank)
+        if flight is not None and aborted is not None:
+            flight.record("abort", step=it, reason=aborted)
+            flight.dump(aborted)
         return state, GuardedReport(
             completed=aborted is None and it >= n_steps,
             final_step=it, aborted=aborted, counters=meter.as_dict(),
@@ -186,22 +196,23 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                     injector.maybe_preempt(it)
 
                 # --- data motion, with drop/dup faults ---------------
-                action = (injector.batch_action(it)
-                          if injector is not None else None)
-                if action == "dup" and prev_batch is not None:
-                    batch = prev_batch
-                    meter.bump("batches_duplicated")
-                    events.append(("dup", it))
-                elif action == "drop":
-                    # this batch never arrives; train on the next one
-                    meter.bump("batches_dropped")
-                    events.append(("drop", it))
-                    batch = next_batch(it + n_steps, reseed)
-                else:
-                    batch = next_batch(it, reseed)
-                if injector is not None:
-                    batch = injector.corrupt_batch(it, batch)
-                prev_batch = batch
+                with tr.span("data", step=it):
+                    action = (injector.batch_action(it)
+                              if injector is not None else None)
+                    if action == "dup" and prev_batch is not None:
+                        batch = prev_batch
+                        meter.bump("batches_duplicated")
+                        events.append(("dup", it))
+                    elif action == "drop":
+                        # this batch never arrives; train on the next
+                        meter.bump("batches_dropped")
+                        events.append(("drop", it))
+                        batch = next_batch(it + n_steps, reseed)
+                    else:
+                        batch = next_batch(it, reseed)
+                    if injector is not None:
+                        batch = injector.corrupt_batch(it, batch)
+                    prev_batch = batch
 
             # --- the blocking region, under the watchdog --------------
             if watchdog is not None:
@@ -210,8 +221,13 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 injector.maybe_stall(it)
             lkey = ladder_step_key(supervisor, precision)
             fn = step_for_level[lkey] if lkey is not None else step_fn
-            new_state, metrics = fn(state, *batch)
-            loss = float(metrics["loss"])      # device sync
+            with tr.span("step", step=it):
+                # forward+backward+optimizer (one jitted program) plus
+                # the metric device-sync — the host cannot see inside
+                # the compiled step; per-bucket reduce detail rides the
+                # reduce_* metrics into the registry instead
+                new_state, metrics = fn(state, *batch)
+                loss = float(metrics["loss"])      # device sync
             if watchdog is not None:
                 watchdog.disarm()
                 if watchdog.tripped:
@@ -274,6 +290,8 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 events.append(("transport_up", it, supervisor.mode))
 
         meter.observe_metrics(metrics)
+        if flight is not None:
+            flight.record("step", step=it, loss=loss)
         # --- precision-ladder supervision (ISSUE 5) -------------------
         # runs only on ACCEPTED steps (a wire-fault discard above never
         # reaches here — its telemetry came from a corrupted reduce).
@@ -331,6 +349,9 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
             meter.bump("restores")
             sentinel.reset()
             events.append(("rollback", it))
+            if flight is not None:
+                flight.record("rollback", step=it)
+                flight.dump("rollback")
             if backoff_secs > 0:
                 time.sleep(backoff_secs * (2 ** (rollbacks - 1)))
             continue

@@ -44,7 +44,8 @@ __all__ = ["StepWatchdog"]
 class StepWatchdog:
     def __init__(self, timeout: float, *, rank: int = 0,
                  interrupt: bool = True,
-                 hard_exit_after: Optional[float] = None):
+                 hard_exit_after: Optional[float] = None,
+                 on_trip=None):
         if timeout <= 0:
             raise ValueError(f"watchdog timeout must be > 0, got {timeout}")
         if hard_exit_after is not None and hard_exit_after <= 0:
@@ -54,6 +55,13 @@ class StepWatchdog:
         self.rank = rank
         self.interrupt = interrupt
         self.hard_exit_after = hard_exit_after
+        # ``on_trip(context_dict)`` runs on the timer thread at fire
+        # time, BEFORE the interrupt is sent — the obs flight recorder
+        # hooks its dump here so even a wedge that ends in the
+        # hard-exit path leaves the recent-event ring on disk
+        # (cpd_tpu/obs/flight.py).  Best-effort: a failing hook must
+        # not stop the interrupt.
+        self.on_trip = on_trip
         self.tripped = False
         self.trips = 0
         self._timer: Optional[threading.Timer] = None
@@ -101,11 +109,21 @@ class StepWatchdog:
             # still fire even when stderr is a closed pipe
             print(f"=> watchdog: stack dump failed: {e}", file=sys.stderr)
         if self.hard_exit_after is not None:
+            # armed BEFORE the on_trip hook: a hook that BLOCKS (its
+            # dump path living on the same hung filesystem that wedged
+            # the step) must not defeat the backstop — the try/except
+            # below only covers a raising hook, not a hanging one
             with self._lock:
                 self._exit_timer = threading.Timer(self.hard_exit_after,
                                                    self._hard_exit)
                 self._exit_timer.daemon = True
                 self._exit_timer.start()
+        if self.on_trip is not None:
+            try:
+                self.on_trip(dict(self._context))
+            except Exception as e:
+                print(f"=> watchdog: on_trip hook failed: {e}",
+                      file=sys.stderr)
         if self.interrupt:
             # a REAL SIGINT (not _thread.interrupt_main, which only sets
             # a flag the main thread notices at its next bytecode — i.e.

@@ -17,7 +17,7 @@ from .engine import ResultStore, ServeEngine
 from .kvcache import KVCacheConfig
 from .loadgen import (bursty_trace, decode_tail_matches, flash_crowd,
                       mixed_trace, poisson_trace, run_trace,
-                      serial_baseline, with_sla)
+                      serial_baseline, timeline_metrics, with_sla)
 from .model import ModelSpec, spec_from_model
 from .scheduler import ACCEPT, QUEUE, Request, Scheduler, SHED
 from .supervisor import Rung, ServeSupervisor, default_rungs
@@ -27,4 +27,4 @@ __all__ = ["ServeEngine", "ResultStore", "KVCacheConfig", "Request",
            "spec_from_model", "Rung", "ServeSupervisor", "default_rungs",
            "poisson_trace", "bursty_trace", "mixed_trace", "with_sla",
            "flash_crowd", "run_trace", "serial_baseline",
-           "decode_tail_matches"]
+           "decode_tail_matches", "timeline_metrics"]

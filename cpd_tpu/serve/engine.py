@@ -87,7 +87,6 @@ import dataclasses
 import json
 import os
 import shutil
-import time
 from collections import OrderedDict, deque
 from typing import Optional
 
@@ -95,6 +94,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.timing import now
+from ..obs.trace import NULL_SPAN
 from . import kvcache
 from .kvcache import KVCacheConfig, TRASH_PAGE
 from .model import make_decode_step, make_prefill_step, spec_from_model
@@ -229,6 +230,15 @@ class ServeEngine:
     finished_cap : bound on each resolution store (finished/shed/missed).
     temperature / seed : 0 = greedy argmax; > 0 samples from
         softmax(logits / T) with a deterministic host RNG.
+    tracer : optional `obs.Tracer` — per-request timeline events
+        (submit→verdict→admit→first_chunk→first_token→complete, with
+        deadline/shed/ladder annotations) plus per-phase spans on the
+        step clock.  Pure observation: counters, sampled tokens and
+        page bytes are bitwise identical with or without it (pinned in
+        tests/test_obs.py).  Not part of the snapshot recipe — attach
+        a fresh tracer after `restore`.
+    flight : optional `obs.FlightRecorder` — one ring event per engine
+        step; dumped automatically by `snapshot` (reason="snapshot").
     """
 
     def __init__(self, model, params, *, n_slots: int = 4,
@@ -240,7 +250,7 @@ class ServeEngine:
                  = None, max_queue: Optional[int] = None,
                  stall_patience: int = 4, finished_cap: int = 4096,
                  temperature: float = 0.0, seed: int = 0,
-                 record_logits: bool = False):
+                 record_logits: bool = False, tracer=None, flight=None):
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if stall_patience < 1:
@@ -313,6 +323,10 @@ class ServeEngine:
         # unbounded, so keep it off in long-running serving)
         self.record_logits = record_logits
         self.logits_log: list = []
+        # observability taps (ISSUE 11): host-side observation only —
+        # neither may influence scheduling, sampling or page bytes
+        self.tracer = tracer
+        self.flight = flight
 
     # -- public API -------------------------------------------------------
 
@@ -324,6 +338,16 @@ class ServeEngine:
         that reads as a silent drop forever."""
         verdict = self.sched.submit(req, step=self.step_index)
         self.counters["submitted"] += 1
+        if self.tracer is not None:
+            # the timeline's opening record: verdict + the SLA terms
+            # the later deadline/shed annotations are judged against
+            self.tracer.request_event(
+                req.rid, "submit", self.step_index, verdict=verdict,
+                arrival=req.arrival, sla_class=req.sla_class,
+                deadline_steps=req.deadline_steps,
+                tpot_budget_steps=req.tpot_budget_steps,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens)
         if verdict == SHED:
             self._resolve_shed(req.rid, "admission", self.step_index)
         else:
@@ -384,19 +408,30 @@ class ServeEngine:
 
     def step(self) -> None:
         s = self.step_index
-        self._apply_rung(s)
-        self._fire_kv_faults(s)
-        if self._eff_scrub and s % self._eff_scrub == 0:
-            self.scrub()
-        self._expire_deadlines(s)
-        self._watchdog(s)
-        for slot in self.sched.admit(s):
-            self.counters["admitted"] += 1
-            self.counters["pages_reserved"] += len(slot.pages)
-            self._event("admit", slot.req.rid, s)
-        self._prefill_phase(s)
-        self._decode_phase(s)
-        self._observe_supervisor(s)
+        with self._span("serve_step", s):
+            self._apply_rung(s)
+            self._fire_kv_faults(s)
+            if self._eff_scrub and s % self._eff_scrub == 0:
+                with self._span("scrub", s):
+                    self.scrub()
+            self._expire_deadlines(s)
+            self._watchdog(s)
+            with self._span("admit", s):
+                for slot in self.sched.admit(s):
+                    self.counters["admitted"] += 1
+                    self.counters["pages_reserved"] += len(slot.pages)
+                    self._event("admit", slot.req.rid, s,
+                                pages=len(slot.pages))
+            with self._span("prefill", s):
+                self._prefill_phase(s)
+            with self._span("decode", s):
+                self._decode_phase(s)
+            self._observe_supervisor(s)
+        if self.flight is not None:
+            self.flight.record(
+                "serve_step", step=s, queued=len(self.sched.queue),
+                busy=sum(sl.state != FREE for sl in self.sched.slots),
+                inflight=len(self._inflight))
         self.step_index += 1
 
     # -- SLA guard rails --------------------------------------------------
@@ -485,10 +520,14 @@ class ServeEngine:
             self.counters["sup_hot_steps"] += 1
         if act == "degrade":
             self.counters["sup_degrades"] += 1
-            self._event("degrade", -1, s)
+            self._event("degrade", -1, s,
+                        rung=self.supervisor.rung.name,
+                        level=self.supervisor.level)
         elif act == "probate":
             self.counters["sup_probations"] += 1
-            self._event("probate", -1, s)
+            self._event("probate", -1, s,
+                        rung=self.supervisor.rung.name,
+                        level=self.supervisor.level)
 
     # -- resolution bookkeeping -------------------------------------------
 
@@ -496,14 +535,15 @@ class ServeEngine:
         self.counters["shed"] += 1
         self.shed.put(rid, reason)
         self._inflight.discard(rid)
-        self._event("shed", rid, s)
+        self._event("shed", rid, s, reason=reason)
         self._refresh_evicted()
 
     def _resolve_miss(self, rid: int, partial: list, s: int) -> None:
         self.counters["deadline_misses"] += 1
         self.missed.put(rid, partial)
         self._inflight.discard(rid)
-        self._event("deadline_miss", rid, s)
+        self._event("deadline_miss", rid, s,
+                    partial_tokens=len(partial))
         self._refresh_evicted()
 
     def _refresh_evicted(self) -> None:
@@ -538,6 +578,11 @@ class ServeEngine:
             return
         prompt = slot.req.prompt
         n = min(self._eff_chunk, len(prompt) - slot.fed)
+        if slot.fed == 0 and self.tracer is not None:
+            # tracer-only (the bounded host event log keeps its
+            # pre-obs vocabulary): the timeline's prefill-start mark
+            self.tracer.request_event(slot.req.rid, "first_chunk", s,
+                                      chunk_tokens=n)
         buf = np.zeros((self._prefill_chunk,), np.int32)
         buf[:n] = prompt[slot.fed:slot.fed + n]
         last_logits = self._checked(
@@ -595,7 +640,8 @@ class ServeEngine:
         if done:
             self.finished.put(req.rid, list(slot.generated))
             self._inflight.discard(req.rid)
-            self._event("complete", req.rid, s)
+            self._event("complete", req.rid, s,
+                        n_generated=len(slot.generated))
             self.counters["completed"] += 1
             self.counters["pages_freed"] += self.sched.evict(slot)
             self._refresh_evicted()
@@ -805,6 +851,11 @@ class ServeEngine:
             os.rename(path, old_dir)
         os.rename(tmp_dir, path)
         shutil.rmtree(old_dir, ignore_errors=True)
+        if self.flight is not None:
+            # the pre-crash flight ring rides NEXT TO the snapshot (its
+            # own configured path — outside the digest-sealed dir, so
+            # restore verification is unaffected)
+            self.flight.dump("snapshot")
         return record
 
     @classmethod
@@ -919,5 +970,20 @@ class ServeEngine:
 
     # -- misc -------------------------------------------------------------
 
-    def _event(self, kind: str, rid: int, step: int) -> None:
-        self.events.append((kind, rid, step, time.monotonic()))
+    def _span(self, name: str, step: int):
+        """Phase span when tracing, THE shared no-op context otherwise
+        (obs.trace.NULL_SPAN — zero allocation per step)."""
+        if self.tracer is None:
+            return NULL_SPAN
+        return self.tracer.span(name, step=step, cat="serve")
+
+    def _event(self, kind: str, rid: int, step: int, **ann) -> None:
+        """One engine event: the bounded host log keeps its historical
+        4-tuple shape (tests/snapshots parse it); the tracer — when
+        attached — gets the SAME wall float plus the annotations, which
+        is what makes `loadgen.timeline_metrics`'s reconstruction
+        bit-exact against the published latency metrics."""
+        w = now()
+        self.events.append((kind, rid, step, w))
+        if self.tracer is not None:
+            self.tracer.request_event(rid, kind, step, wall=w, **ann)

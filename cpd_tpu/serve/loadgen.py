@@ -56,16 +56,16 @@ the continuous-batching speedup gate compares aggregate tok/s.
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from ..obs.timing import Stopwatch, now
 from .scheduler import Request
 
 __all__ = ["poisson_trace", "bursty_trace", "mixed_trace", "with_sla",
            "flash_crowd", "run_trace", "serial_baseline",
-           "decode_tail_matches"]
+           "decode_tail_matches", "timeline_metrics"]
 
 
 def decode_tail_matches(original, mark: int, restored) -> int:
@@ -213,6 +213,122 @@ def _pct(values: list, q: float) -> Optional[float]:
     return round(float(np.percentile(values, q)), 3) if values else None
 
 
+def timeline_metrics(tracer, *, sla_ttft_ms: float = 1000.0,
+                     sla_tpot_ms: float = 250.0) -> dict:
+    """Reconstruct the serving latency metrics from an `obs.Tracer`'s
+    per-request timeline ALONE — no engine, no stores (ISSUE 11
+    acceptance gate: on a drained, non-truncated `run_trace(engine
+    with tracer=...)` run the reconstructed TTFT/TPOT percentiles,
+    goodput and verdict/resolution counts equal the published metrics
+    EXACTLY, float for float).
+
+    The equality is structural, not approximate: the engine records
+    each event's wall time once (`ServeEngine._event`) and hands the
+    same float to both its host event log (which `run_trace` reads)
+    and the tracer; `run_trace` likewise records its per-step wall
+    into the tracer (``step_begin``).  Reconstruction then repeats the
+    identical arithmetic on the identical floats.
+
+    Caveat (same honesty flag as ``metrics_truncated``): a run whose
+    engine evicted finished-store entries mid-trace publishes n_gen=0
+    for the evicted rids while the timeline still knows their true
+    counts — reconstruction parity is guaranteed only for runs with
+    ``results_evicted == 0`` and an unsaturated tracer ring."""
+    step_begin: dict = {}
+    submits: list = []           # (seq, rid, args) in submission order
+    first: dict = {}
+    done: dict = {}              # rid -> (wall, n_generated)
+    counts = {"completed": 0, "shed": 0, "deadline_misses": 0}
+    verdicts: dict = {}
+    tokens = 0
+    t0 = t_end = None
+    for _seq, name, cat, step, wall, args in sorted(tracer.events):
+        if cat == "serve":
+            if name == "step_begin":
+                step_begin[step] = wall
+            elif name == "trace_begin":
+                t0 = wall
+            elif name == "trace_end":
+                t_end = wall
+            continue
+        if cat != "req":
+            continue
+        rid = args["rid"]
+        if name == "submit":
+            submits.append((rid, args))
+            v = args.get("verdict")
+            verdicts[v] = verdicts.get(v, 0) + 1
+        elif name == "first_token":
+            first[rid] = wall
+        elif name == "complete":
+            done[rid] = (wall, args["n_generated"])
+            counts["completed"] += 1
+            tokens += args["n_generated"]
+        elif name == "shed":
+            counts["shed"] += 1
+        elif name == "deadline_miss":
+            counts["deadline_misses"] += 1
+            tokens += args.get("partial_tokens", 0)
+    ttft, tpot, good_tokens = [], [], 0
+    class_tokens: dict = {}
+    for rid, args in submits:
+        n_gen = done[rid][1] if rid in done else 0
+        if rid not in first:
+            continue
+        if args["arrival"] not in step_begin:
+            # no step_begin for this arrival: the engine was stepped
+            # manually (only run_trace records the per-step walls), or
+            # the tracer ring aged the early steps out — either way a
+            # silent wrong TTFT would betray the exactness contract
+            raise ValueError(
+                f"timeline has no step_begin for arrival step "
+                f"{args['arrival']} (rid {rid}): drive the engine "
+                f"through run_trace with the tracer attached, and "
+                f"size Tracer(max_records=) to the trace "
+                f"(events_dropped={getattr(tracer, 'events_dropped', 0)})")
+        t_first = (first[rid] - step_begin[args["arrival"]]) * 1e3
+        ttft.append(t_first)
+        t_tok = None
+        if rid in done and n_gen > 1:
+            t_tok = (done[rid][0] - first[rid]) * 1e3 / (n_gen - 1)
+            tpot.append(t_tok)
+        if t_first <= sla_ttft_ms and (t_tok is None
+                                       or t_tok <= sla_tpot_ms):
+            good_tokens += n_gen
+            cls = args.get("sla_class", 0)
+            class_tokens[cls] = class_tokens.get(cls, 0) + n_gen
+    duration = (t_end - t0) if (t0 is not None
+                                and t_end is not None) else None
+    n_sub = len(submits)
+    return {
+        "submitted": n_sub,
+        "verdicts": dict(sorted(verdicts.items())),
+        **counts,
+        "dropped": n_sub - sum(counts.values()),
+        "shed_rate": (round(counts["shed"] / n_sub, 4)
+                      if n_sub else 0.0),
+        "deadline_miss_rate": (round(counts["deadline_misses"] / n_sub,
+                                     4) if n_sub else 0.0),
+        "tokens_generated": tokens,
+        "duration_s": (round(duration, 3) if duration is not None
+                       else None),
+        "tok_per_s": (round(tokens / duration, 1) if duration
+                      else None),
+        "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p99": _pct(ttft, 99),
+        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
+        "goodput_tok_per_s": (round(good_tokens / duration, 1)
+                              if duration else None),
+        "goodput_by_class": {str(k): (round(v / duration, 1)
+                                      if duration else None)
+                             for k, v in sorted(class_tokens.items())},
+        # honesty flag (run_trace's metrics_truncated twin): a
+        # saturated tracer ring aged out early events, so the
+        # reconstruction covers only the surviving window
+        "timeline_truncated": getattr(tracer, "events_dropped", 0) > 0,
+        "sla": {"ttft_ms": sla_ttft_ms, "tpot_ms": sla_tpot_ms},
+    }
+
+
 def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
               sla_tpot_ms: float = 250.0,
               burst_factory: Optional[Callable] = None,
@@ -230,7 +346,10 @@ def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
             return True
         return burst_factory is not None and engine.has_pending_bursts()
 
-    t0 = time.monotonic()
+    tracer = getattr(engine, "tracer", None)
+    t0 = now()
+    if tracer is not None:
+        tracer.event("trace_begin", cat="serve", wall=t0)
     while more_work():
         if engine.step_index >= max_steps:
             raise RuntimeError(f"trace not drained in {max_steps} steps")
@@ -243,9 +362,19 @@ def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
                 for r in burst_factory(spec):
                     engine.submit(r)
                     submitted.append(r)
-        step_wall[engine.step_index] = time.monotonic()
+        w = now()
+        step_wall[engine.step_index] = w
+        if tracer is not None:
+            # the SAME wall float the latency metrics below subtract —
+            # recording it (not a re-read of the clock) is what makes
+            # `timeline_metrics`' reconstruction bit-exact
+            tracer.event("step_begin", step=engine.step_index,
+                         cat="serve", wall=w)
         engine.step()
-    duration = time.monotonic() - t0
+    t_end = now()
+    duration = t_end - t0
+    if tracer is not None:
+        tracer.event("trace_end", cat="serve", wall=t_end)
     engine.report_unfired()
 
     first, done = {}, {}
@@ -337,8 +466,8 @@ def serial_baseline(model, params, requests: list, *,
 
     if warm:
         one_pass()
-    t0 = time.monotonic()
+    watch = Stopwatch()
     n = one_pass()
-    duration = time.monotonic() - t0
+    duration = watch.elapsed()
     return {"tok_per_s": round(n / duration, 1) if duration else None,
             "duration_s": round(duration, 3), "tokens": n}

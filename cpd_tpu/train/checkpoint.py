@@ -103,7 +103,9 @@ def preempt_save(manager: "CheckpointManager", step_no, state, rank: int,
         manager.save(int(step_no), state, force=True, metadata=metadata)
         manager.wait()
     if rank == 0:
-        print(f"=> preempted: saved {what} {int(step_no)}; exiting")
+        # stdout on purpose: tests/test_examples.py asserts this exact
+        # line in captured stdout (reference-parity operator protocol)
+        print(f"=> preempted: saved {what} {int(step_no)}; exiting")  # cpd: disable=obs-print
 
 
 class PreemptionGuard:

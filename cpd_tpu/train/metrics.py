@@ -12,11 +12,15 @@ from __future__ import annotations
 
 import math
 import sys
-import time
 from collections import deque
 from typing import Sequence
 
 import numpy as np
+
+# the ONE wall-clock timer implementation now lives in cpd_tpu.obs
+# (ISSUE 11 satellite: this module, loadgen and the bench tools each
+# carried their own); re-exported here for the established import path
+from ..obs.timing import Timer
 
 __all__ = ["AverageMeter", "accuracy", "Timer", "loss_diverged",
            "ResilienceMeter"]
@@ -160,20 +164,3 @@ def accuracy(output, target, topk: Sequence[int] = (1,)):
     correct = pred == target[:, None]
     batch = target.shape[0]
     return [100.0 * correct[:, :k].any(axis=1).sum() / batch for k in topk]
-
-
-class Timer:
-    """Incremental wall-clock timer (DavidNet/utils.py:28-38): each call
-
-    returns the time since the previous call and accumulates total time."""
-
-    def __init__(self):
-        self.times = [time.perf_counter()]
-        self.total_time = 0.0
-
-    def __call__(self, include_in_total: bool = True) -> float:
-        self.times.append(time.perf_counter())
-        delta = self.times[-1] - self.times[-2]
-        if include_in_total:
-            self.total_time += delta
-        return delta

@@ -22,6 +22,7 @@ import yaml
 
 __all__ = ["load_yaml_config", "merge_config_into_args",
            "add_resilience_flags", "add_transport_flags",
+           "add_obs_flags", "build_obs", "finish_obs",
            "build_resilience", "overlap_key"]
 
 
@@ -108,6 +109,91 @@ def block_key(args: argparse.Namespace):
     if not bool(getattr(args, "block_scale", False)):
         return None
     return (True, int(getattr(args, "block_size", 128)))
+
+
+def add_obs_flags(parser: argparse.ArgumentParser) -> None:
+    """The shared observability surface (docs/OBSERVABILITY.md): every
+    trainer/bench CLI speaks the same two flags."""
+    g = parser.add_argument_group(
+        "observability", "cpd_tpu.obs tracing / metrics / flight "
+                         "recorder")
+    g.add_argument("--obs-dir", default=None, metavar="DIR",
+                   help="enable the obs spine: step/request tracing + "
+                        "the metrics registry, exported into DIR on "
+                        "exit as events.jsonl (deterministic event "
+                        "stream), metrics.prom (Prometheus text) and "
+                        "trace.json (Perfetto/Chrome-trace).  Unset = "
+                        "zero instrumentation cost; either way step "
+                        "outputs are bitwise unchanged (obs only "
+                        "observes)")
+    g.add_argument("--obs-flight", default=256, type=int,
+                   metavar="N",
+                   help="flight-recorder ring capacity (with "
+                        "--obs-dir): the last N step events are "
+                        "dumped to DIR/flight.jsonl on watchdog fire, "
+                        "rollback, preemption or serve snapshot "
+                        "(0 disables the recorder)")
+
+
+def build_obs(args: argparse.Namespace, *, run: str,
+              meta: Dict[str, Any] | None = None) -> Dict[str, Any]:
+    """Materialize the obs stack from parsed flags: ``tracer`` /
+    ``registry`` / ``flight`` (each None when --obs-dir is unset — the
+    provably-free disabled path) plus ``finish(extra=...)``, which
+    writes the artifact bundle and returns its paths+summary dict (or
+    None when obs is off)."""
+    import os
+
+    obs_dir = getattr(args, "obs_dir", None)
+    if not obs_dir:
+        return {"tracer": None, "registry": None, "flight": None,
+                "dir": None, "active": False,
+                "finish": lambda **_kw: None}
+    from cpd_tpu.obs import FlightRecorder, MetricsRegistry, Tracer
+    cap = int(getattr(args, "obs_flight", 256) or 0)
+    tracer = Tracer(run, meta=meta)
+    registry = MetricsRegistry()
+    flight = (FlightRecorder(os.path.join(obs_dir, "flight.jsonl"),
+                             capacity=cap) if cap > 0 else None)
+
+    def finish(**extra):
+        from cpd_tpu.obs import write_all
+        out = write_all(obs_dir, tracer, registry)
+        if extra:
+            out["summary"].update(extra)
+        return out
+
+    return {"tracer": tracer, "registry": registry, "flight": flight,
+            "dir": obs_dir, "active": True, "finish": finish}
+
+
+def finish_obs(obs: Dict[str, Any], *, meter=None, last=None,
+               step_no=None, supervisor=None, precision=None,
+               rank: int = 0, **extra):
+    """The ONE trainer obs epilogue (shared by the lm and resnet18
+    CLIs): absorb the run counters, the final step's telemetry
+    families and the supervisors' ladder state into the registry, then
+    write the artifact bundle.  Returns the bundle dict, or None when
+    obs is off."""
+    if not obs["active"]:
+        return None
+    reg = obs["registry"]
+    if meter is not None:
+        reg.absorb_resilience_meter(meter)
+    if last:
+        reg.absorb_step_metrics(last, step_no)
+    if supervisor is not None:
+        reg.absorb_supervisor("transport", {
+            "mode": supervisor.mode, "home": supervisor.home,
+            "degraded": supervisor.degraded,
+            "transitions": supervisor.transitions})
+    if precision is not None:
+        reg.absorb_supervisor("precision", precision.state_dict())
+    out = obs["finish"](**extra)
+    if rank == 0:
+        import sys
+        print(f"=> obs artifacts in {out['dir']}", file=sys.stderr)
+    return out
 
 
 def add_resilience_flags(parser: argparse.ArgumentParser) -> None:

@@ -38,7 +38,12 @@ class StepProfiler:
             return
         import jax
 
-        if it == self.start:
+        if it == self.start and not self._started:
+            # `not self._started` guards the rollback replay: a loop
+            # that rewinds past the window start and marches through it
+            # again must not call start_trace on an already-running (or
+            # already-completed) trace — jax.profiler raises on the
+            # double start, killing the run the profiler was observing
             os.makedirs(self.trace_dir, exist_ok=True)
             jax.profiler.start_trace(self.trace_dir)
             self._running = True

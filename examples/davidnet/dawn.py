@@ -135,76 +135,82 @@ def main(argv=None) -> dict:
     global_step = 0
     result = {}
     diverged = False
-    for epoch in range(1, args.epoch + 1):
-        rng = np.random.RandomState(args.seed + epoch)
-        # same epoch permutation on every host; each takes its contiguous
-        # 1/world block of every global batch
-        order = rng.permutation(dataset_len)[:iters_per_epoch * global_batch]
-        train_loss = train_acc = 0.0
-        n = 0
-        def produced(order=order, epoch=epoch):
-            # batch prep (native threaded augmentation + device transfer)
-            # two steps ahead of the device (utils/prefetch.py) — matters
-            # most here: DAWNBench is a wall-clock speed run
-            for lo in range(0, len(order), global_batch):
-                sel = order[lo + rank * host_batch:
-                            lo + (rank + 1) * host_batch]
-                bx, by = pipeline.batch(sel, seed=epoch)
-                yield (host_batch_to_global(bx, mesh),
-                       host_batch_to_global(by, mesh))
+    try:
+        for epoch in range(1, args.epoch + 1):
+            rng = np.random.RandomState(args.seed + epoch)
+            # same epoch permutation on every host; each takes its contiguous
+            # 1/world block of every global batch
+            order = rng.permutation(dataset_len)[:iters_per_epoch * global_batch]
+            train_loss = train_acc = 0.0
+            n = 0
+            def produced(order=order, epoch=epoch):
+                # batch prep (native threaded augmentation + device transfer)
+                # two steps ahead of the device (utils/prefetch.py) — matters
+                # most here: DAWNBench is a wall-clock speed run
+                for lo in range(0, len(order), global_batch):
+                    sel = order[lo + rank * host_batch:
+                                lo + (rank + 1) * host_batch]
+                    bx, by = pipeline.batch(sel, seed=epoch)
+                    yield (host_batch_to_global(bx, mesh),
+                           host_batch_to_global(by, mesh))
 
-        from cpd_tpu.utils.prefetch import Prefetcher
-        batches = Prefetcher(produced(), depth=2)
-        try:
-            for gx, gy in batches:
-                global_step += 1
-                profiler.step(global_step)
-                state, m = train_step(state, gx, gy)
-                step_loss = float(m["loss"])
-                if loss_diverged(step_loss, f"step {global_step}", rank,
-                                 hint="lower --loss_scale / try "
-                                      "--use_APS"):
-                    diverged = True
-                    break
-                train_loss += step_loss
-                train_acc += float(m["accuracy"])
-                n += 1
-        finally:
-            batches.close()   # stop the producer on any exit path
-        if diverged:
-            break
-        jax.block_until_ready(state.params)
-        train_time = timer()                 # counts toward total
+            from cpd_tpu.utils.prefetch import Prefetcher
+            batches = Prefetcher(produced(), depth=2)
+            try:
+                for gx, gy in batches:
+                    global_step += 1
+                    profiler.step(global_step)
+                    state, m = train_step(state, gx, gy)
+                    step_loss = float(m["loss"])
+                    if loss_diverged(step_loss, f"step {global_step}", rank,
+                                     hint="lower --loss_scale / try "
+                                          "--use_APS"):
+                        diverged = True
+                        break
+                    train_loss += step_loss
+                    train_acc += float(m["accuracy"])
+                    n += 1
+            finally:
+                batches.close()   # stop the producer on any exit path
+            if diverged:
+                break
+            jax.block_until_ready(state.params)
+            train_time = timer()                 # counts toward total
 
-        test_loss = test_acc = 0.0
-        k = 0
-        limit = (len(test_y) // eval_bs) * eval_bs
-        for lo in range(0, limit, eval_bs):
-            sel = np.arange(lo + rank * eval_host,
-                            lo + (rank + 1) * eval_host)
-            x, y = eval_pipe.batch(sel)
-            m = eval_step(state, host_batch_to_global(x, mesh),
-                          host_batch_to_global(y, mesh))
-            test_loss += float(m["loss"])
-            test_acc += float(m["top1"])
-            k += 1
-        # test time excluded from DAWNBench total (dawn.py's
-        # test_time_in_total=False).
-        test_time = timer(include_in_total=False)
-        total = timer.total_time
+            test_loss = test_acc = 0.0
+            k = 0
+            limit = (len(test_y) // eval_bs) * eval_bs
+            for lo in range(0, limit, eval_bs):
+                sel = np.arange(lo + rank * eval_host,
+                                lo + (rank + 1) * eval_host)
+                x, y = eval_pipe.batch(sel)
+                m = eval_step(state, host_batch_to_global(x, mesh),
+                              host_batch_to_global(y, mesh))
+                test_loss += float(m["loss"])
+                test_acc += float(m["top1"])
+                k += 1
+            # test time excluded from DAWNBench total (dawn.py's
+            # test_time_in_total=False).
+            test_time = timer(include_in_total=False)
+            total = timer.total_time
 
-        result = {
-            "epoch": epoch,
-            "lr": float(schedule(epoch * iters_per_epoch)),
-            "train time": train_time, "train loss": train_loss / max(n, 1),
-            "train acc": train_acc / max(n, 1),
-            "test time": test_time, "test loss": test_loss / max(k, 1),
-            "test acc": test_acc / max(k, 1),
-            "total time": total,
-        }
-        table.append(result)
-        tsv.append(result)
-    profiler.close()
+            result = {
+                "epoch": epoch,
+                "lr": float(schedule(epoch * iters_per_epoch)),
+                "train time": train_time, "train loss": train_loss / max(n, 1),
+                "train acc": train_acc / max(n, 1),
+                "test time": test_time, "test loss": test_loss / max(k, 1),
+                "test acc": test_acc / max(k, 1),
+                "total time": total,
+            }
+            table.append(result)
+            tsv.append(result)
+    finally:
+        # stops an in-flight jax.profiler trace even when the
+        # loop died inside the window (ISSUE 11 satellite -- a
+        # leaked running trace poisons every later start_trace
+        # in the process)
+        profiler.close()
     if rank == 0:
         print(tsv)
     result["diverged"] = diverged

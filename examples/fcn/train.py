@@ -232,10 +232,13 @@ def main(argv=None) -> dict:
     finally:
         guard.uninstall()
         batches.close()   # stop the producer even on an exception path
+        # stops an in-flight jax.profiler trace even when the loop died
+        # inside the window (ISSUE 11 satellite — a leaked running
+        # trace poisons every later start_trace in the process)
+        profiler.close()
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()
-    profiler.close()
     if rank == 0 and not (preempted or diverged):
         print(f"done: {args.max_iter} iters in {time.time()-t0:.1f}s "
               f"final loss {last.get('loss', float('nan')):.4f}")

@@ -157,10 +157,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="after training, write a torch state_dict .pth "
                         "of the LM (cpd_tpu.interop.torch_lm; default "
                         "dp/sp/tp path only — pp/moe layouts differ)")
-    from cpd_tpu.utils.config import (add_resilience_flags,
+    from cpd_tpu.utils.config import (add_obs_flags,
+                                      add_resilience_flags,
                                       add_transport_flags)
     add_resilience_flags(p)       # --fault-plan / guard / watchdog / rollback
     add_transport_flags(p)        # --overlap-reduce / --bucket-elems
+    add_obs_flags(p)              # --obs-dir / --obs-flight
     return p
 
 
@@ -324,6 +326,22 @@ def main(argv=None) -> dict:
     sentinel, meter = res["sentinel"], res["meter"]
     supervisor, step_table, resync_fn = res["supervisor"], None, None
     psup = res["precision"]
+    # observability spine (docs/OBSERVABILITY.md): tracer spans on the
+    # step clock, the metrics registry, and the crash flight recorder —
+    # all pure host-side observation, so step outputs are bitwise
+    # identical with or without --obs-dir (the obs-smoke gate pins it)
+    from cpd_tpu.obs import NULL_TRACER
+    from cpd_tpu.utils.config import build_obs
+    obs = build_obs(args, run="lm",
+                    meta={"max_iter": args.max_iter, "mode": args.mode,
+                          "grad_format": [args.grad_exp,
+                                          args.grad_man]})
+    otr = obs["tracer"] if obs["tracer"] is not None else NULL_TRACER
+    oreg, oflight = obs["registry"], obs["flight"]
+    if watchdog is not None and oflight is not None:
+        # dump the ring at FIRE time, on the timer thread — even a
+        # wedge that ends in the hard-exit path leaves it on disk
+        watchdog.on_trip = lambda ctx: oflight.dump("watchdog")
 
     def run_meta():
         # ladder state rides every checkpoint's metadata sidecar so a
@@ -537,12 +555,14 @@ def main(argv=None) -> dict:
         # batch order); rollback path: per-(retry, iter) seeding so a
         # replay draws a DIFFERENT batch order (the re-seeded recovery
         # of docs/RESILIENCE.md), identically on every host
-        if sentinel is not None:
-            r = np.random.RandomState((reseed * 1000003 + i) % (2 ** 31))
-            idx = r.randint(0, train_n, size=global_batch)
-        else:
-            idx = rng.randint(0, train_n, size=global_batch)
-        return ds.batch(idx, seed=i)
+        with otr.span("data", step=i):
+            if sentinel is not None:
+                r = np.random.RandomState((reseed * 1000003 + i)
+                                          % (2 ** 31))
+                idx = r.randint(0, train_n, size=global_batch)
+            else:
+                idx = rng.randint(0, train_n, size=global_batch)
+            return ds.batch(idx, seed=i)
 
     def watchdog_stop():
         watchdog.disarm()     # acknowledge the trip: cancels hard-exit
@@ -560,6 +580,8 @@ def main(argv=None) -> dict:
                 preempted = True
                 break
             if guard.should_stop():      # collective when multi-host
+                if oflight is not None:
+                    oflight.dump("preempt")
                 preempt_save(manager, step_no, state, rank,
                              metadata=run_meta())
                 preempted = True
@@ -596,8 +618,13 @@ def main(argv=None) -> dict:
                 if injector is not None:
                     injector.maybe_stall(upd)
                 prev_state = state    # verified-reduce discard target
-                state, m = step(state, jnp.asarray(toks), jnp.asarray(tgts))
-                last = {k: float(v) for k, v in m.items()}  # device sync
+                with otr.span("step", step=it):
+                    # the whole jitted fwd+bwd+reduce+optimizer program
+                    # plus the metric device-sync; per-bucket reduce
+                    # detail rides the reduce_* metrics (registry)
+                    state, m = step(state, jnp.asarray(toks),
+                                    jnp.asarray(tgts))
+                    last = {k: float(v) for k, v in m.items()}  # sync
                 if watchdog is not None:
                     watchdog.disarm()
             except KeyboardInterrupt:
@@ -607,6 +634,8 @@ def main(argv=None) -> dict:
                     break
                 raise
             except InjectedPreemption:
+                if oflight is not None:
+                    oflight.dump("preempt")
                 preempt_save(manager, step_no, state, rank,
                              metadata=run_meta(),
                              what="injected preemption at")
@@ -673,6 +702,10 @@ def main(argv=None) -> dict:
             step_no = it
             if meter is not None:
                 meter.observe_metrics(last)
+            if oreg is not None:
+                oreg.absorb_step_metrics(last, it)
+            if oflight is not None:
+                oflight.record("step", step=it, loss=last["loss"])
             # --- precision-ladder supervision (ISSUE 5) ---------------
             # host decision on the psum-agreed prec_wire_* telemetry;
             # escalation re-formats the NEXT step (this update was
@@ -732,6 +765,9 @@ def main(argv=None) -> dict:
                     reseed = rollbacks
                     meter.bump("rollbacks")
                     meter.bump("restores")
+                    if oflight is not None:
+                        oflight.record("rollback", step=step_no)
+                        oflight.dump("rollback")
                     sentinel.reset()
                     if rank == 0:
                         print(f"=> rolled back to iter {step_no} "
@@ -752,12 +788,14 @@ def main(argv=None) -> dict:
                                  / max(time.time() - t0, 1e-9))
             writer.add_scalar("train/loss", last["loss"], it)
             if it % args.val_freq == 0 or it == args.max_iter:
-                validate(it)
+                with otr.span("validate", step=it):
+                    validate(it)
             if it % args.ckpt_freq == 0 or it == args.max_iter:
                 # force under resilience: a rollback replay must be able
                 # to overwrite the stale/corrupt copy of this step
-                manager.save(it, state, force=res["active"],
-                             metadata=run_meta())
+                with otr.span("checkpoint", step=it):
+                    manager.save(it, state, force=res["active"],
+                                 metadata=run_meta())
                 if injector is not None:
                     # the fault must land on the FINAL bytes — without
                     # integrity the save is still async at this point
@@ -772,6 +810,11 @@ def main(argv=None) -> dict:
         guard.uninstall()
         if watchdog is not None:
             watchdog.close()
+        # close() stops an in-flight jax.profiler trace even when the
+        # loop died inside the window (watchdog interrupt, injected
+        # fault) — leaking a running trace poisons every later
+        # start_trace in this process (ISSUE 11 satellite)
+        profiler.close()
     from cpd_tpu.resilience import report_unfired
     # wire faults only fire when the default path baked a ring-mode
     # table in — a wire_* spec on any other run must read as UNFIRED
@@ -787,7 +830,6 @@ def main(argv=None) -> dict:
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()
-    profiler.close()
     dt = time.time() - t0
     ran = step_no - start_iter
     if rank == 0 and not (preempted or diverged):
@@ -842,8 +884,14 @@ def main(argv=None) -> dict:
                                   wrapper="state_dict")
             print(f"=> exported torch state_dict {args.export_torch}")
     writer.close()
+    from cpd_tpu.utils.config import finish_obs
+    obs_out = finish_obs(obs, meter=meter, last=last, step_no=step_no,
+                         supervisor=supervisor, precision=psup,
+                         rank=rank, preempted=preempted,
+                         diverged=diverged)
     return {"step": step_no, "diverged": diverged,
             **({"resilience": meter.as_dict()} if res["active"] else {}),
+            **({"obs": obs_out} if obs_out is not None else {}),
             **({"sample": sampled} if sampled is not None else {}), **last}
 
 

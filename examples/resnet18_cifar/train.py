@@ -116,10 +116,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "sharded (all_to_all reduce-scatter; composes "
                         "with --use_lars).  --zero3 lives on the "
                         "ResNet-50 CLI (portable checkpoint layout)")
-    from cpd_tpu.utils.config import (add_resilience_flags,
+    from cpd_tpu.utils.config import (add_obs_flags,
+                                      add_resilience_flags,
                                       add_transport_flags)
     add_resilience_flags(p)       # --fault-plan / guard / watchdog
     add_transport_flags(p)        # --overlap-reduce / --bucket-elems
+    add_obs_flags(p)              # --obs-dir / --obs-flight
     return p
 
 
@@ -245,6 +247,21 @@ def main(argv=None) -> dict:
     injector, watchdog = res["injector"], res["watchdog"]
     sentinel, meter = res["sentinel"], res["meter"]
     psup = res["precision"]
+    # observability spine (docs/OBSERVABILITY.md): pure host-side
+    # observation — step outputs bitwise identical with or without
+    # --obs-dir (pinned by the obs-smoke gate).  The data span lives on
+    # the Prefetcher's producer thread, so this trainer traces only the
+    # step/validate/checkpoint phases it runs on the main thread.
+    from cpd_tpu.obs import NULL_TRACER
+    from cpd_tpu.utils.config import build_obs
+    obs = build_obs(args, run="resnet18",
+                    meta={"mode": args.mode,
+                          "grad_format": [args.grad_exp,
+                                          args.grad_man]})
+    otr = obs["tracer"] if obs["tracer"] is not None else NULL_TRACER
+    oreg, oflight = obs["registry"], obs["flight"]
+    if watchdog is not None and oflight is not None:
+        watchdog.on_trip = lambda ctx: oflight.dump("watchdog")
 
     def run_meta():
         # ladder state rides every checkpoint's metadata sidecar so a
@@ -525,6 +542,8 @@ def main(argv=None) -> dict:
                 preempted = True
                 break
             if guard.should_stop():      # collective when multi-host
+                if oflight is not None:
+                    oflight.dump("preempt")
                 preempt_save(manager, step_no, to_ckpt(state), rank,
                              metadata=run_meta())
                 preempted = True
@@ -552,8 +571,10 @@ def main(argv=None) -> dict:
                 if injector is not None:
                     injector.maybe_stall(step_no)
                 prev_state = state    # verified-reduce discard target
-                state, metrics = train_step(state, gx, gy)
-                last = {k: float(v) for k, v in metrics.items()}  # sync
+                with otr.span("step", step=step_no + 1):
+                    state, metrics = train_step(state, gx, gy)
+                    last = {k: float(v)
+                            for k, v in metrics.items()}  # sync
                 if watchdog is not None:
                     watchdog.disarm()
             except KeyboardInterrupt:
@@ -568,6 +589,8 @@ def main(argv=None) -> dict:
                 raise
             except InjectedPreemption:
                 meter.bump("preemptions")
+                if oflight is not None:
+                    oflight.dump("preempt")
                 preempt_save(manager, step_no, to_ckpt(state), rank,
                              metadata=run_meta(), what="injected preemption at")
                 preempted = True
@@ -642,6 +665,11 @@ def main(argv=None) -> dict:
                           file=sys.stderr)
             step_no += 1
             meter.observe_metrics(last)
+            if oreg is not None:
+                oreg.absorb_step_metrics(last, step_no)
+            if oflight is not None:
+                oflight.record("step", step=step_no,
+                               loss=last["loss"])
             # --- precision-ladder supervision (ISSUE 5) ---------------
             # host decision on the psum-agreed prec_wire_* telemetry;
             # escalation re-formats the NEXT step (the update that
@@ -699,12 +727,15 @@ def main(argv=None) -> dict:
             writer.add_scalar("train/loss", last["loss"], step_no)
             writer.add_scalar("train/acc", last["accuracy"], step_no)
             if step_no % args.val_freq == 0 or step_no == total_iter:
-                val = validate(step_no)
+                with otr.span("validate", step=step_no):
+                    val = validate(step_no)
                 writer.add_scalar("val/top1", val["top1"], step_no)
                 prec1 = 100 * val["top1"]
                 best_prec1 = max(best_prec1, prec1)
-                manager.save(step_no, to_ckpt(state), best_metric=prec1,
-                             metadata=run_meta())
+                with otr.span("checkpoint", step=step_no):
+                    manager.save(step_no, to_ckpt(state),
+                                 best_metric=prec1,
+                                 metadata=run_meta())
                 if injector is not None:
                     # the fault must land on the FINAL bytes — without
                     # integrity the save is still async at this point
@@ -718,6 +749,11 @@ def main(argv=None) -> dict:
         if watchdog is not None:
             watchdog.close()
         batches.close()   # stop the producer even on an exception path
+        # close() stops an in-flight jax.profiler trace even when the
+        # loop died inside the window (watchdog interrupt, injected
+        # fault) — leaking a running trace poisons every later
+        # start_trace in this process (ISSUE 11 satellite)
+        profiler.close()
     from cpd_tpu.resilience import report_unfired
     # wire faults only fire when a ring-mode step baked the table in —
     # a wire_* spec on a gather/psum run must read as UNFIRED, not pass
@@ -725,7 +761,6 @@ def main(argv=None) -> dict:
                    wire_armed=(supervisor.home == "ring"
                                if supervisor is not None
                                else args.mode == "ring"))
-    profiler.close()
     manager.wait()
     writer.close()
     if rank == 0 and not (preempted or diverged):  # interrupted != "done"
@@ -734,9 +769,15 @@ def main(argv=None) -> dict:
     manager.close()
     if not (preempted or diverged):
         export_torch(state)
+    from cpd_tpu.utils.config import finish_obs
+    obs_out = finish_obs(obs, meter=meter, last=last, step_no=step_no,
+                         supervisor=supervisor, precision=psup,
+                         rank=rank, preempted=preempted,
+                         diverged=diverged)
     return {"step": step_no, "best_prec1": best_prec1,
             "diverged": diverged,
             **({"resilience": meter.as_dict()} if res["active"] else {}),
+            **({"obs": obs_out} if obs_out is not None else {}),
             **last}
 
 

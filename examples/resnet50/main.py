@@ -418,7 +418,10 @@ def main(argv=None) -> dict:
         guard.uninstall()
         if "batches" in locals():
             batches.close()   # stop the producer on any exception path
-    profiler.close()
+        # stops an in-flight jax.profiler trace even when the loop died
+        # inside the window (ISSUE 11 satellite — a leaked running
+        # trace poisons every later start_trace in the process)
+        profiler.close()
     manager.wait()
     manager.close()
     writer.close()

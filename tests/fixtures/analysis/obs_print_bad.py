@@ -1,0 +1,22 @@
+"""Deliberately-bad fixture for the obs-print rule: library-module
+prints (no __main__ guard) that bypass the obs MetricsRegistry/event
+stream — 3 findings pinned in tests/test_analysis.py."""
+
+
+class Scrubber:
+    def __init__(self):
+        self.pages_corrupt = 0
+
+    def scrub(self, bad_pages):
+        # an ad-hoc counter narrated to stdout instead of a registry
+        # metric — finding 1
+        self.pages_corrupt += len(bad_pages)
+        print(f"corrupt pages this scrub: {len(bad_pages)}")
+
+
+def train_loop(steps):
+    for it in range(steps):
+        loss = 1.0 / (it + 1)
+        if it % 10 == 0:
+            print("iter", it, "loss", loss)          # finding 2
+    print("done", steps, "steps")                    # finding 3

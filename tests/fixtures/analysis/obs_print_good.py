@@ -1,0 +1,28 @@
+"""Clean twin for the obs-print rule: the three sanctioned shapes —
+stderr diagnostics, registry metrics, and script-product stdout behind
+a __main__ guard."""
+
+import json
+import sys
+
+
+class Scrubber:
+    def __init__(self, registry):
+        self.registry = registry
+
+    def scrub(self, bad_pages):
+        # numbers go to the registry (one home, one name)
+        self.registry.inc("cpd_serve_kv_pages_corrupt", len(bad_pages))
+        if bad_pages:
+            # occurrences the operator should see are stderr's job
+            print(f"=> scrub: {len(bad_pages)} corrupt pages repaired",
+                  file=sys.stderr)
+
+
+def main():
+    # a script's stdout IS its product (the bench JSON-line protocol)
+    print(json.dumps({"metric": "scrubs", "value": 1}))
+
+
+if __name__ == "__main__":
+    main()

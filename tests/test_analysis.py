@@ -52,7 +52,8 @@ def test_catalog_is_complete():
     assert RULE_IDS == ["axis-flow", "axis-name", "collective-contract",
                         "compat-drift", "donation", "format-bounds",
                         "format-flow", "jit-hazards", "kahan-ordering",
-                        "pallas-hygiene", "retrace", "swallow"]
+                        "obs-print", "pallas-hygiene", "retrace",
+                        "swallow"]
 
 
 def test_scope_split():
@@ -86,7 +87,10 @@ def test_bad_fixture_finding_counts():
                 # v2 (whole-program + compat inventory) rules
                 "format-flow": 7, "axis-flow": 2,
                 "collective-contract": 4, "retrace": 5,
-                "compat-drift": 5}
+                "compat-drift": 5,
+                # ISSUE 11: ad-hoc stdout telemetry bypassing the obs
+                # MetricsRegistry
+                "obs-print": 3}
     assert set(expected) == set(RULE_IDS), "new rule missing a count pin"
     for rule_id, n in expected.items():
         findings = lint_file(_fixture(rule_id, "bad"), select=[rule_id])
@@ -531,7 +535,7 @@ def test_live_suppression_count_is_pinned():
                         f"{path}:{tok.start[0]}: suppression without a "
                         f"written justification: {payload!r}")
                     sites.append((path, tok.start[0], payload))
-    assert len(sites) == 6, (
+    assert len(sites) == 7, (
         "live-tree suppression count changed — review the new/removed "
         "site's justification and re-pin:\n" + "\n".join(
             f"{p}:{ln}: {pl}" for p, ln, pl in sites))

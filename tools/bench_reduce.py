@@ -31,7 +31,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 
 def _ensure_multidevice():
@@ -50,6 +49,10 @@ def _ensure_multidevice():
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
+
+# the ONE wall-clock helper (ISSUE 11 satellite: this tool's ad-hoc
+# perf_counter pairs deduped onto cpd_tpu.obs.timing)
+from cpd_tpu.obs.timing import now  # noqa: E402
 
 
 def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
@@ -96,10 +99,10 @@ def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
         np.asarray(r["g"])  # compile + sync
         best = float("inf")
         for _ in range(max(1, iters)):
-            t0 = time.perf_counter()
+            t0 = now()
             r = fn(sharded)
             np.asarray(r["g"])
-            best = min(best, time.perf_counter() - t0)
+            best = min(best, now() - t0)
         out["modes"][mode] = {"best_ms": round(best * 1e3, 3),
                               "elems_per_sec": round(n / best, 1)}
 
@@ -131,10 +134,10 @@ def measure(n: int, exp: int, man: int, iters: int, use_kahan: bool,
         np.asarray(vec)
         best = float("inf")
         for _ in range(max(1, iters)):
-            t0 = time.perf_counter()
+            t0 = now()
             vec, ok = fn(sharded)
             np.asarray(vec)
-            best = min(best, time.perf_counter() - t0)
+            best = min(best, now() - t0)
         return best * 1e3, int(ok)
 
     ring_ms = out["modes"]["ring"]["best_ms"]
@@ -201,10 +204,10 @@ def bucket_sweep(n: int, exp: int, man: int, iters: int,
         np.asarray(r["g00"])
         best = float("inf")
         for _ in range(max(1, iters)):
-            t0 = time.perf_counter()
+            t0 = now()
             r = fn(sharded)
             np.asarray(r["g00"])
-            best = min(best, time.perf_counter() - t0)
+            best = min(best, now() - t0)
         return round(best * 1e3, 3)
 
     rows = []
@@ -421,10 +424,10 @@ def overlap_step_bench(iters: int = 8, batch_per_dev: int = 8,
         float(m["loss"])          # compile + sync
         best = float("inf")
         for _ in range(max(1, iters)):
-            t0 = time.perf_counter()
+            t0 = now()
             s, m = step(s, x, y)
             float(m["loss"])
-            best = min(best, time.perf_counter() - t0)
+            best = min(best, now() - t0)
         ev = overlap_evidence(step, state, x, y)
         out["arms"][name] = {
             "best_ms": round(best * 1e3, 3),
@@ -783,11 +786,11 @@ def smoke() -> dict:
         assert int(ok) == 1
         best = float("inf")
         for _ in range(10):
-            t0 = time.perf_counter()
+            t0 = now()
             vec, ok = fn(big_sh)
             np.asarray(vec)
             np.asarray(ok)
-            best = min(best, time.perf_counter() - t0)
+            best = min(best, now() - t0)
         return best
 
     t_clean = timed(False)

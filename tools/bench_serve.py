@@ -105,8 +105,18 @@ def run_load(args) -> dict:
 
     model, params = _build_model(args)
     trace = _build_trace(args)
+    # the SHARED obs surface (utils.config): per-request timelines +
+    # phase spans + the flight ring on the MEASURED engine, so the
+    # exported artifacts describe the run whose numbers this JSON
+    # publishes
+    from cpd_tpu.utils.config import build_obs
+    obs = build_obs(args, run="bench_serve",
+                    meta={"trace": args.trace,
+                          "kv_format": list(args.kv_format)})
     run_trace(_fresh_engine(model, params, args), list(trace))  # warm
-    metrics = run_trace(_fresh_engine(model, params, args), list(trace),
+    eng = _fresh_engine(model, params, args, tracer=obs["tracer"],
+                        flight=obs["flight"])
+    metrics = run_trace(eng, list(trace),
                         sla_ttft_ms=args.sla_ttft_ms,
                         sla_tpot_ms=args.sla_tpot_ms)
     base = serial_baseline(model, params, trace)
@@ -116,6 +126,16 @@ def run_load(args) -> dict:
             metrics["tok_per_s"] / base["tok_per_s"], 2)
     metrics["kv_format"] = list(args.kv_format)
     metrics["trace"] = args.trace
+    if obs["active"]:
+        from cpd_tpu.serve import timeline_metrics
+        obs["registry"].absorb_serve_counters(eng.counters)
+        recon = timeline_metrics(obs["tracer"],
+                                 sla_ttft_ms=args.sla_ttft_ms,
+                                 sla_tpot_ms=args.sla_tpot_ms)
+        metrics["obs"] = obs["finish"](ttft_reconstruction_exact=all(
+            recon[k] == metrics[k]
+            for k in ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                      "tpot_ms_p99", "goodput_tok_per_s")))
     return metrics
 
 
@@ -354,6 +374,10 @@ def main() -> int:
     p.add_argument("--sla-ttft-ms", type=float, default=1000.0)
     p.add_argument("--sla-tpot-ms", type=float, default=250.0)
     p.add_argument("--seed", type=int, default=0)
+    # the shared --obs-dir/--obs-flight surface (the measured-run
+    # artifact bundle; docs/OBSERVABILITY.md)
+    from cpd_tpu.utils.config import add_obs_flags
+    add_obs_flags(p)
     args = p.parse_args()
 
     if args.smoke:

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""obs-smoke — the CI gate for the observability spine (ISSUE 11).
+
+Four sub-gates, each loud on failure, one JSON line on success
+(PR 2-10 style: deterministic asserts, no timing flakes):
+
+  1. **obs is free (train)**: the LM trainer runs a short step window
+     twice — ``--obs-dir`` unset, then set — and the final
+     loss/accuracy floats must be IDENTICAL (obs only observes); the
+     obs-on run's artifact bundle must exist and parse (JSONL per
+     line, Chrome-trace under the JSON shape check, Prometheus under
+     the minimal exposition checker).
+  2. **obs is free (serve) + exact timelines**: a short serve trace
+     with a tracer attached replays to the same counters as without,
+     and `loadgen.timeline_metrics` reconstructs run_trace's published
+     TTFT/TPOT/goodput/counts EXACTLY from the per-request timeline.
+  3. **exporter determinism**: the same serve (trace, seed) run twice
+     exports byte-identical stripped JSONL + Chrome-trace files.
+  4. **flight recorder on a forced watchdog fire**: the LM trainer
+     under an injected ``stall`` fault with a short ``--watchdog-
+     timeout`` trips the watchdog; the gate greps the flight dump for
+     the ``"reason": "watchdog"`` header and the recorded steps.
+
+Run:  JAX_PLATFORMS=cpu python tools/obs_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# tiny-but-real LM shape: compiles in seconds on the CPU backend
+_LM_ARGS = ["--vocab-size", "64", "--d-model", "32", "--n-layers", "1",
+            "--n-heads", "4", "--seq-len", "32", "--batch-size", "2",
+            "--max-iter", "4", "--print-freq", "100",
+            "--val-freq", "100", "--ckpt-freq", "100"]
+
+
+def _lm(tmp, *extra):
+    from examples.lm.train import main
+    save = tempfile.mkdtemp(dir=tmp)
+    return main(_LM_ARGS + ["--save-path", save, *extra])
+
+
+def _check_bundle(obs_dir: str) -> dict:
+    """The three artifacts exist and parse (the formats-load gate)."""
+    from cpd_tpu.obs import parse_prometheus
+    ev = os.path.join(obs_dir, "events.jsonl")
+    ct = os.path.join(obs_dir, "trace.json")
+    pm = os.path.join(obs_dir, "metrics.prom")
+    n_lines = 0
+    for line in open(ev, encoding="utf-8"):
+        rec = json.loads(line)
+        assert rec["t"] in ("meta", "span", "event"), rec
+        n_lines += 1
+    doc = json.load(open(ct, encoding="utf-8"))
+    assert isinstance(doc.get("traceEvents"), list) and doc["traceEvents"]
+    for e in doc["traceEvents"]:
+        assert e["ph"] in ("M", "X", "i") and "name" in e \
+            and "pid" in e and "tid" in e, e
+        if e["ph"] in ("X", "i"):
+            assert "ts" in e, e
+    prom = parse_prometheus(open(pm, encoding="utf-8").read())
+    assert prom, "empty prometheus exposition"
+    return {"jsonl_records": n_lines,
+            "trace_events": len(doc["traceEvents"]),
+            "metric_families": len(prom)}
+
+
+def gate_train_free(tmp) -> dict:
+    r_off = _lm(tmp)
+    obs_dir = os.path.join(tmp, "obs_train")
+    r_on = _lm(tmp, "--obs-dir", obs_dir)
+    for key in ("loss", "accuracy", "step"):
+        assert r_off[key] == r_on[key], \
+            f"obs-on changed step outputs: {key} {r_off[key]} != " \
+            f"{r_on[key]}"
+    formats = _check_bundle(obs_dir)
+    assert r_on["obs"]["summary"]["spans"] > 0
+    return {"bitwise_loss_equal": True, **formats}
+
+
+def gate_serve_timelines() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.models import transformer_lm
+    from cpd_tpu.obs import Tracer
+    from cpd_tpu.serve import (ServeEngine, mixed_trace, run_trace,
+                               timeline_metrics, with_sla)
+
+    model = transformer_lm(vocab_size=64, d_model=32, n_layers=1,
+                           n_heads=4, n_kv_heads=2, d_ff=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    kw = dict(n_slots=2, max_seq=32, page_size=8, prefill_chunk=4)
+    trace = with_sla(
+        mixed_trace(6, 64, prompt_lens=(4, 6), max_new=(4,), seed=5),
+        [dict(sla_class=0), dict(sla_class=1, deadline_steps=64)])
+
+    off = run_trace(ServeEngine(model, params, **kw), list(trace))
+    tr = Tracer("obs-smoke")
+    eng = ServeEngine(model, params, **kw, tracer=tr)
+    pub = run_trace(eng, list(trace))
+    assert off["counters"] == pub["counters"], \
+        "tracer perturbed the serve counters"
+    rec = timeline_metrics(tr)
+    keys = ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50", "tpot_ms_p99",
+            "goodput_tok_per_s", "completed", "shed",
+            "deadline_misses", "shed_rate", "tok_per_s")
+    for k in keys:
+        assert rec[k] == pub[k], \
+            f"timeline reconstruction diverged on {k}: {rec[k]} != " \
+            f"{pub[k]}"
+    return {"counters_equal": True,
+            "reconstructed_exact": list(keys),
+            "ttft_ms_p50": pub["ttft_ms_p50"]}
+
+
+def gate_export_determinism(tmp) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.models import transformer_lm
+    from cpd_tpu.obs import (MetricsRegistry, Tracer,
+                             export_chrome_trace, export_jsonl,
+                             export_prometheus)
+    from cpd_tpu.serve import ServeEngine, mixed_trace, run_trace
+
+    model = transformer_lm(vocab_size=64, d_model=32, n_layers=1,
+                           n_heads=4, n_kv_heads=2, d_ff=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    kw = dict(n_slots=2, max_seq=32, page_size=8, prefill_chunk=4)
+    trace = mixed_trace(4, 64, prompt_lens=(4,), max_new=(4,), seed=9)
+    blobs = []
+    for run in ("a", "b"):
+        tr = Tracer("det")
+        reg = MetricsRegistry()
+        eng = ServeEngine(model, params, **kw, tracer=tr)
+        run_trace(eng, list(trace))
+        reg.absorb_serve_counters(eng.counters)
+        j = export_jsonl(tr, os.path.join(tmp, f"{run}.jsonl"),
+                         strip_wall=True)
+        c = export_chrome_trace(tr, os.path.join(tmp, f"{run}.json"),
+                                strip_wall=True)
+        p = export_prometheus(reg)
+        blobs.append((open(j, "rb").read(), open(c, "rb").read(), p))
+    assert blobs[0][0] == blobs[1][0], "JSONL stream not deterministic"
+    assert blobs[0][1] == blobs[1][1], "Chrome trace not deterministic"
+    assert blobs[0][2] == blobs[1][2], "Prometheus text not deterministic"
+    return {"byte_identical": True,
+            "jsonl_bytes": len(blobs[0][0]),
+            "trace_bytes": len(blobs[0][1])}
+
+
+def gate_flight_on_watchdog(tmp) -> dict:
+    obs_dir = os.path.join(tmp, "obs_wdog")
+    # constraint chain: the timeout must clear the step-1 XLA compile
+    # (the watchdog arms around it), the stall must overshoot the
+    # timeout (else no trip), AND the stall must end before the
+    # hard-exit backstop at 2x timeout — the trainer's PreemptionGuard
+    # traps the watchdog's SIGINT, so the trip is only acknowledged at
+    # the step boundary after the sleep returns (watchdog.py docstring
+    # limitation 1).  8s < 12s < 16s holds all three with margin.
+    r = _lm(tmp, "--obs-dir", obs_dir,
+            "--fault-plan", "stall@2:12",
+            "--watchdog-timeout", "8")
+    assert r["resilience"]["watchdog_trips"] >= 1, r
+    flight = os.path.join(obs_dir, "flight.jsonl")
+    assert os.path.isfile(flight), "no flight dump after watchdog fire"
+    lines = [json.loads(ln) for ln in open(flight, encoding="utf-8")]
+    headers = [ln for ln in lines if "flight_dump" in ln]
+    # THE grep: the dump must say why it exists
+    assert any(h["reason"] == "watchdog" for h in headers), headers
+    steps = [ln for ln in lines if ln.get("kind") == "step"]
+    assert steps, "flight dump holds no step events"
+    return {"watchdog_trips": r["resilience"]["watchdog_trips"],
+            "flight_headers": [h["reason"] for h in headers],
+            "flight_steps": len(steps)}
+
+
+def main() -> int:
+    out = {"smoke": True}
+    with tempfile.TemporaryDirectory() as tmp:
+        out["train_free"] = gate_train_free(tmp)
+        out["serve_timelines"] = gate_serve_timelines()
+        out["export_determinism"] = gate_export_determinism(tmp)
+        out["flight_on_watchdog"] = gate_flight_on_watchdog(tmp)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
