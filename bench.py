@@ -408,6 +408,14 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                     8192, formats=((4, 3), (5, 7)), blocks=(16, 32, blk))
                 partial["reduction"]["block_scaled"][
                     "frontier_e4m3_vs_e5m7"] = fr["frontier_e4m3_vs_e5m7"]
+                # the ZeRO-2 all_to_all arm (ISSUE 12): same probe,
+                # sharded-wire frontier — small n, pure single-device
+                # oracle math
+                z2 = _bench_reduce_mod().zero2_block_sweep(
+                    8192, formats=((4, 3), (5, 7)), blocks=(16, 32))
+                partial["reduction"]["block_scaled"][
+                    "zero2_frontier_e4m3_vs_e5m7"] = \
+                    z2["frontier_e4m3_vs_e5m7"]
         except Exception as e:  # noqa: BLE001 — extras must not kill it
             partial["reduction_note"] = (f"reduction ledger skipped: "
                                          f"{type(e).__name__}: {e}")
@@ -806,6 +814,29 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
                 partial["serving"]["snapshot_drill"] = {
                     "rows": snap_rows,
                     "bitwise": True,
+                }
+                # blocked KV pages (ISSUE 12): the capacity trade on
+                # this build — blocked e4m3 run vs the same per-tensor
+                # engine; page bytes come from the ENGINE's own config
+                # (cfg.page_bytes routes through the one analytic
+                # source), so retuning the smoke model cannot desync
+                # the published number from the pool it prices
+                from cpd_tpu.quant.numerics import kv_page_bytes
+                blk_kw = dict(sv_kw)
+                blk_kw["kv_format"] = (4, 3)
+                bk_eng = ServeEngine(sv_model, sv_params, **blk_kw,
+                                     kv_block_size=32)
+                bk = run_trace(bk_eng, list(trace))
+                bcfg = bk_eng.cfg
+                partial["serving"]["blocked_kv"] = {
+                    "kv_format": [4, 3], "block_size": 32,
+                    "tok_per_s": bk["tok_per_s"],
+                    "dropped": bk["dropped"],
+                    "completed": bk["completed"],
+                    "page_bytes": bcfg.page_bytes,
+                    "page_bytes_e5m7_per_tensor": kv_page_bytes(
+                        5, 7, bcfg.page_size, bcfg.n_kv_heads,
+                        bcfg.head_dim),
                 }
             except Exception as e:  # noqa: BLE001 — extras must not kill the run
                 partial["serving"]["sla_note"] = (

@@ -32,6 +32,14 @@ Three shapes flagged:
    a key without it serves a step traced for the wrong schedule /
    bucket layout after a ladder transition — the same bug class with a
    transport coordinate.
+5. **block-blind ladder keys** (ISSUE 9/12) — same shape for the
+   block-scaled wire: a module that configures block scaling (a
+   ``block_scale=`` step builder, or ``block_key(args)``) must pass
+   the ``block=`` coordinate through every ``ladder_step_key(...)``
+   call — the blocked wire is a DIFFERENT accumulation numerics and
+   wire layout (ring sidecar, ZeRO-2 all_to_all, the blocked scan),
+   so a ladder transition must never serve a step traced for the
+   other block coordinate.
 """
 
 from __future__ import annotations
@@ -67,6 +75,7 @@ class Retrace(ProjectRule):
             yield from self._fstr_keys(f, mod)
         for mod, funcs in by_mod.values():
             yield from self._overlap_blind(mod, funcs)
+            yield from self._block_blind(mod, funcs)
 
     def _half_keyed(self, f, mod) -> Iterator[Finding]:
         sups = f["supervisor_objs"]
@@ -127,6 +136,35 @@ class Retrace(ProjectRule):
                         "for the wrong schedule / bucket layout; pass "
                         "overlap=utils.config.overlap_key(args) (None "
                         "when the run has no overlap surface)"))
+
+    def _block_blind(self, mod, funcs) -> Iterator[Finding]:
+        """Module-scope check 5: block-scale-configured modules must
+        thread the block coordinate through every ladder key (the
+        ``_overlap_blind`` shape for the ISSUE 9/12 blocked wires —
+        module-wide trigger for the same reason)."""
+        configures_block = any(
+            "block_scale" in call["kw"]
+            or call["callee"].split(".")[-1] == "block_key"
+            for f in funcs for call in f["calls"])
+        if not configures_block:
+            return
+        for f in funcs:
+            for call in f["calls"]:
+                if call["callee"].split(".")[-1] != "ladder_step_key":
+                    continue
+                if "block" in call["kw"] or call["star"]:
+                    continue
+                yield Finding(
+                    path=mod["path"], line=call["line"], col=call["col"],
+                    rule=self.id,
+                    message=(
+                        "ladder_step_key(...) without the block= "
+                        "coordinate in a module that configures the "
+                        "block-scaled wire — after a ladder transition "
+                        "the table would serve a step traced for the "
+                        "wrong block layout/numerics; pass "
+                        "block=utils.config.block_key(args) (None when "
+                        "the run has no block surface)"))
 
     def _fstr_keys(self, f, mod) -> Iterator[Finding]:
         jit_tables = {t["name"] for t in f["jit_tables"] if t["jit"]}

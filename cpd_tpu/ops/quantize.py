@@ -31,10 +31,12 @@ from ..quant.numerics import (_scale_pow2, _validate, _validate_wire,
 
 __all__ = ["quantize_pallas", "quantize_pallas_sr", "quantize_add_pallas",
            "quantize_add_pallas_bits", "hop_pack_pallas",
-           "quantize_pack_pallas", "fletcher_mod65521"]
+           "quantize_pack_pallas", "digest_rows_pallas",
+           "fletcher_mod65521"]
 
 _LANES = 128
 _BLOCK_ROWS = 512  # (512, 128) fp32 block = 256 KiB of VMEM in + out
+_DIGEST_ROWS = 2048  # (2048, 128) u8 block = 256 KiB (digest kernel)
 
 
 def _quantize_kernel(x_ref, o_ref, *, exp_bits: int, man_bits: int):
@@ -534,6 +536,99 @@ def hop_pack_pallas(wire_in: jnp.ndarray, g: jnp.ndarray, exp_bits: int,
                           block_size)[:n]
     return _wire_call(codes_in, k_in, sidecar_in, g, exp_bits, man_bits,
                       rbits, block_size, want_digest, interpret)
+
+
+def _digest_rows_kernel(b_ref, o_ref, *, w: int, sub_per_row: int):
+    """One grid step digests tile ``j`` of EVERY row at once: the block
+    stacks, for each of the ``w`` rows, ``sub_per_row`` sublanes of its
+    j-th tile — per-row Fletcher partials come out of masked reductions
+    over the sublane axis, so a whole W-row gather wire costs T grid
+    steps (not W·T; one step for the common one-tile case, which is
+    what keeps the interpret-mode CPU emulation honest).
+
+    Overflow audit (uint32): per-sublane byte sums <= 128·255 < 2^15;
+    per-sublane weighted sums: byte·(pos mod 65521 + 1) < 2^24, 128
+    lanes -> < 2^31, mod'd immediately; masked per-row sums over
+    sub_per_row <= 2048 sublanes of values < 65521 -> < 2^27."""
+    j = pl.program_id(0)
+    bytes_u32 = b_ref[:].astype(jnp.uint32)
+    rows, lanes = b_ref.shape                  # rows = w * sub_per_row
+    idx0 = lax.broadcasted_iota(jnp.uint32, (rows, lanes), 0)
+    sub = idx0 % jnp.uint32(sub_per_row)       # sublane within the row
+    pos = (j.astype(jnp.uint32)
+           * jnp.uint32(sub_per_row * lanes)
+           + sub * jnp.uint32(lanes)
+           + lax.broadcasted_iota(jnp.uint32, (rows, lanes), 1))
+    posm = fletcher_mod65521(pos) + jnp.uint32(1)
+    c1 = jnp.sum(bytes_u32, axis=1)                        # (rows,)
+    c2 = fletcher_mod65521(jnp.sum(bytes_u32 * posm, axis=1))
+    row_id = idx0[:, 0] // jnp.uint32(sub_per_row)         # (rows,)
+
+    @pl.when(j == 0)
+    def _():
+        for r in range(w):
+            o_ref[r, 0] = jnp.uint32(0)
+            o_ref[r, 1] = jnp.uint32(0)
+
+    for r in range(w):
+        m = row_id == jnp.uint32(r)
+        p1 = fletcher_mod65521(jnp.sum(jnp.where(m, c1, 0)))
+        p2 = fletcher_mod65521(jnp.sum(jnp.where(m, c2, 0)))
+        o_ref[r, 0] = fletcher_mod65521(o_ref[r, 0] + p1)
+        o_ref[r, 1] = fletcher_mod65521(o_ref[r, 1] + p2)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def digest_rows_pallas(rows: jnp.ndarray,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Per-row Fletcher digest of a (W, n_bytes) uint8 buffer in ONE
+    Pallas pass — bitwise equal to ``jax.vmap(integrity.wire_digest)``
+    over the rows (pinned in tests/test_ops_pallas.py).
+
+    This is the LAST fused digest of ISSUE 12 leg 4: the verified ring's
+    all-gather row check used to hash the received rows XLA-side
+    (`wire_digest` per row) — the one wire digest left outside the pack
+    kernels.  With this kernel the fused verified arm emits every hop
+    digest from `hop_pack_pallas` and every gather-row digest from here,
+    so no XLA-side wire digest remains on that arm.  Zero pad bytes
+    contribute nothing to either Fletcher sum, so rows pad freely to
+    the tile grid."""
+    rows = jnp.asarray(rows, jnp.uint8)
+    if rows.ndim != 2:
+        raise ValueError(f"digest_rows_pallas wants (W, n_bytes) uint8, "
+                         f"got shape {rows.shape}")
+    w, nb = rows.shape
+    if nb == 0 or w == 0:
+        return jnp.zeros((w,), jnp.uint32)
+    # sublanes of one row per grid step: cap the whole block (all W
+    # rows' tiles) near 2 MiB of VMEM, and cap per-row sublanes at 2048
+    # (the masked-sum overflow bound above)
+    sub_per_row = max(1, min(2048, 16384 // max(w, 1)))
+    tile = sub_per_row * _LANES
+    t = -(-nb // tile)
+    padded = jnp.pad(rows, ((0, 0), (0, t * tile - nb)))
+    # (w, t, sub, 128) -> (t, w·sub, 128): tile j of every row is one
+    # contiguous block the grid walks in j order
+    stacked = (padded.reshape(w, t, sub_per_row, _LANES)
+               .transpose(1, 0, 2, 3)
+               .reshape(t * w * sub_per_row, _LANES))
+
+    # 2 running digest scalars per row in SMEM — the lane-multiple
+    # tiling rule is about VMEM vector blocks; SMEM is word-addressed
+    dig_spec = pl.BlockSpec(  # cpd: disable=pallas-hygiene
+        (w, 2), lambda j: (0, 0), memory_space=pltpu.SMEM)
+    out = pl.pallas_call(
+        functools.partial(_digest_rows_kernel, w=w,
+                          sub_per_row=sub_per_row),
+        out_shape=jax.ShapeDtypeStruct((w, 2), jnp.uint32),
+        grid=(t,),
+        in_specs=[pl.BlockSpec((w * sub_per_row, _LANES),
+                               lambda j: (j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=dig_spec,
+        interpret=interpret,
+    )(stacked)
+    return (out[:, 1] << 16) | out[:, 0]
 
 
 def quantize_pack_pallas(g: jnp.ndarray, exp_bits: int, man_bits: int, *,

@@ -31,10 +31,11 @@ import jax
 import jax.numpy as jnp
 
 from ..quant.numerics import cast_to_format, cast_to_format_sr
-from .aps import aps_max_exponents, aps_shift_factors
+from .aps import aps_max_exponents, aps_shift_factors, exp2_exact
 from .reduction import ordered_quantized_sum
 
-__all__ = ["emulate_node_reduce"]
+__all__ = ["emulate_node_reduce", "reduce_stacked_leaf",
+           "make_overlap_emulate_fn"]
 
 
 def _reduce_leaf(g: jnp.ndarray, n: int, use_aps: bool,
@@ -47,7 +48,7 @@ def _reduce_leaf(g: jnp.ndarray, n: int, use_aps: bool,
         shift = aps_shift_factors(max_exp, grad_exp)[0]
     else:
         shift = jnp.float32(0.0)  # quantize still runs (mix.py:267-271)
-    scale = jnp.exp2(shift)
+    scale = exp2_exact(shift)
     if key is None:
         g = cast_to_format(g * scale, grad_exp, grad_man)
         res = ordered_quantized_sum(g, grad_exp, grad_man)
@@ -55,7 +56,46 @@ def _reduce_leaf(g: jnp.ndarray, n: int, use_aps: bool,
         k_pre, k_sum = jax.random.split(key)
         g = cast_to_format_sr(g * scale, grad_exp, grad_man, k_pre)
         res = ordered_quantized_sum(g, grad_exp, grad_man, key=k_sum)
-    return res / jnp.exp2(shift)  # true divide, as mix.py:280 does
+    return res / exp2_exact(shift)  # true divide, as mix.py:280 does
+
+
+def reduce_stacked_leaf(g: jnp.ndarray, n: int, use_aps: bool = False,
+                        grad_exp: int = 5, grad_man: int = 2,
+                        key=None) -> jnp.ndarray:
+    """Public per-leaf emulate-node reduce: one stacked (N, *shape) leaf
+    -> its locally-accumulated (*shape,) gradient, with EXACTLY
+    `emulate_node_reduce`'s per-leaf semantics (N==1 shortcut, quantize
+    even without APS, local-max shift).
+
+    For callers that reduce one leaf at a time — the overlapped
+    backward-reduce taps (parallel/overlap.py `emulate_reduce` hook,
+    ISSUE 12), whose bwd rules see a single leaf's cotangent.  The SR
+    `key` must already be folded by the leaf's GLOBAL tree index
+    (`fold_in(emu_key, leaf_index)`) to reproduce
+    `emulate_node_reduce`'s per-leaf streams bit for bit."""
+    return _reduce_leaf(g, n, use_aps, grad_exp, grad_man, key=key)
+
+
+def make_overlap_emulate_fn(n: int, use_aps: bool, grad_exp: int,
+                            grad_man: int, sr: bool):
+    """The ONE `overlapped_grads(emulate_reduce=...)` hook body, shared
+    by both step builders (train/step.py, train/lm.py) so the SR-key
+    contract — `fold_in(emu_key, GLOBAL leaf index)` feeding
+    `reduce_stacked_leaf`, exactly `emulate_node_reduce`'s per-leaf
+    streams — cannot drift between them.
+
+    Returns ``fn(cotangent, extra, leaf_index, emu_key)``: stacks the
+    LAST micro-batch's cotangent under the prior micro-batches' stacked
+    gradients (`extra`, (N-1, *leaf)) and runs the rank-local
+    emulate-node ordered reduce on the (N, *leaf) result."""
+
+    def emulate_fn(g, extra, i, ekey):
+        stacked_leaf = jnp.concatenate([extra, g[None]], 0)
+        return reduce_stacked_leaf(
+            stacked_leaf, n, use_aps, grad_exp, grad_man,
+            key=(jax.random.fold_in(ekey, i) if sr else None))
+
+    return emulate_fn
 
 
 def emulate_node_reduce(stacked_grads: Any, emulate_node: int,

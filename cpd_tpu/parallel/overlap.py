@@ -55,7 +55,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["bucket_layout", "BucketPlan", "overlapped_grads",
-           "overlap_evidence", "REPORT_FIELDS", "DEFAULT_BUCKET_ELEMS"]
+           "overlap_evidence", "extract_bucket_shards", "REPORT_FIELDS",
+           "DEFAULT_BUCKET_ELEMS"]
 
 # One home for the default per-bucket element cap (dist.py re-exports it
 # as the faithful path's historical `_BUCKET_ELEMS`): W x 4M x 4B =
@@ -157,33 +158,58 @@ def _f0(x):
     return np.zeros(np.shape(x), jax.dtypes.float0)
 
 
-def _make_bucket_tap(reduce_bucket: Callable):
-    """One identity tap per bucket: ``tap(z, key, aux, *leaves)`` returns
-    the leaves unchanged; its bwd rule reduces the leaf cotangents with
-    `reduce_bucket` and returns the bucket's report vector as ``z``'s
-    cotangent.  ``key`` (uint32 PRNG key data, possibly a dummy) and
-    ``aux`` (float32 [sat_scale, wf_code, wf_rank]) are traced per-step
-    values that must ride as ARGUMENTS — custom_vjp cannot close over
-    tracers."""
+def _make_bucket_tap(reduce_bucket: Callable, n_leaves: int):
+    """One identity tap per bucket: ``tap(z, keys, aux, *leaves,
+    *extras)`` returns the leaves unchanged; its bwd rule reduces the
+    leaf cotangents with `reduce_bucket` and returns the bucket's report
+    vector as ``z``'s cotangent.  ``keys`` ((2, 2) uint32 — the [sum,
+    emulate] PRNG key pair, possibly dummies) and ``aux`` (float32
+    [sat_scale, wf_code, wf_rank]) are traced per-step values that must
+    ride as ARGUMENTS — custom_vjp cannot close over tracers.  The
+    optional per-leaf ``extras`` (the emulate-node path's stacked prior
+    micro-batch gradients, ISSUE 12 leg 3) ride the same way: pass-through
+    residuals consumed by the bwd rule's local reduce, zero cotangents
+    out (they are data, not params)."""
 
     @jax.custom_vjp
-    def tap(z, key, aux, *leaves):
-        return tuple(leaves)
+    def tap(z, keys, aux, *operands):
+        return tuple(operands[:n_leaves])
 
-    def fwd(z, key, aux, *leaves):
-        return tuple(leaves), (key, aux)
+    def fwd(z, keys, aux, *operands):
+        return tuple(operands[:n_leaves]), (keys, aux,
+                                            operands[n_leaves:])
 
     def bwd(res, cots):
-        key, aux = res
-        reduced, report = reduce_bucket(list(cots), key, aux)
+        keys, aux, extras = res
+        reduced, report = reduce_bucket(list(cots), list(extras), keys,
+                                        aux)
         # slot 0 is the "ran" sentinel (see REPORT_FIELDS comment): it
         # distinguishes a clean all-zero report from a tap autodiff
         # never executed (all-unused bucket)
         report = jnp.concatenate([jnp.ones((1,), jnp.float32), report])
-        return (report, _f0(key), jnp.zeros_like(aux), *reduced)
+        return (report, _f0(keys), jnp.zeros_like(aux), *reduced,
+                *[jnp.zeros_like(e) for e in extras])
 
     tap.defvjp(fwd, bwd)
     return tap
+
+
+def extract_bucket_shards(reduced: Any, plan: "BucketPlan",
+                          chunks: Sequence[int]) -> jnp.ndarray:
+    """Pull the per-bucket reduce-scattered shards back out of the
+    embedded leaf-cotangent encoding a ZeRO-2 tap collective emits
+    (`parallel.zero._Zero2.make_tap_reduce`: bucket b's (c_b,) shard
+    sits in the first c_b flat slots of its leaves, zeros after) and
+    concatenate them into the rank's (S,) shard vector the updater's
+    ``pre_sharded`` path consumes."""
+    leaves = jax.tree_util.tree_leaves(reduced)
+    segs = []
+    for idxs, c in zip(plan.buckets, chunks):
+        flat = (leaves[idxs[0]].reshape(-1) if len(idxs) == 1 else
+                jnp.concatenate([leaves[i].reshape(-1) for i in idxs]))
+        segs.append(flat[:c])
+    return (jnp.concatenate(segs) if segs
+            else jnp.zeros((0,), jnp.float32))
 
 
 def overlapped_grads(loss_fn: Callable, params: Any, *,
@@ -191,7 +217,11 @@ def overlapped_grads(loss_fn: Callable, params: Any, *,
                      reduce_kw: dict, key=None,
                      sat_factor=None, wire_fault=None,
                      verify: bool = False, stats: bool = False,
-                     leaf_pre: Optional[Callable] = None):
+                     leaf_pre: Optional[Callable] = None,
+                     collective: Optional[Callable] = None,
+                     extras: Optional[Sequence] = None,
+                     emulate_reduce: Optional[Callable] = None,
+                     emulate_key=None):
     """``value_and_grad`` with per-bucket reduce-in-backward taps.
 
     loss_fn(params) -> (loss, aux) — the scalar loss and auxiliary
@@ -224,6 +254,27 @@ def overlapped_grads(loss_fn: Callable, params: Any, *,
                   cotangent before the bucket reduce — the LM step's
                   sp/tp psums, which in the monolithic step run between
                   backward and the dp reduce.
+    collective  → optional per-bucket collective override replacing the
+                  `sum_gradients` call (ISSUE 12 leg 3: ZeRO-2's
+                  per-bucket reduce-scatter, `zero._Zero2.make_tap_reduce`):
+                  ``fn(bucket_index, leaf_indices, gs, key) -> outputs``
+                  with outputs shaped like the bucket's leaves (the
+                  shard-embedding contract).  Mutually exclusive with
+                  verify/stats — the ZeRO updaters thread no reports.
+    extras      → optional per-leaf operand list (aligned with the FULL
+                  flattened param leaves): the emulate-node path's
+                  stacked (N-1, *leaf) prior micro-batch gradients,
+                  threaded through each tap as pass-through residuals so
+                  the bwd-rule reduce can see them without closing over
+                  tracers.
+    emulate_reduce → optional ``fn(cotangent, extra, leaf_index,
+                  emu_key) -> local_grad`` run per leaf AFTER leaf_pre
+                  and the sat scale and BEFORE the bucket collective —
+                  the rank-local emulate-node ordered reduce (stacks the
+                  last micro-batch's cotangent under the prior ones).
+                  Requires ``extras``.
+    emulate_key → the rank-folded emulate-node SR key (site 0); rides
+                  the taps next to `key` (slot 1 of the key pair).
     """
     from .dist import sum_gradients
 
@@ -231,28 +282,50 @@ def overlapped_grads(loss_fn: Callable, params: Any, *,
     if len(leaves_t) != len(plan.sizes):
         raise ValueError(f"BucketPlan built for {len(plan.sizes)} leaves, "
                          f"params have {len(leaves_t)}")
+    if collective is not None and (verify or stats):
+        raise ValueError("a custom bucket collective threads no "
+                         "verify/stats report — the ZeRO paths reject "
+                         "them upstream (make_train_step)")
+    if emulate_reduce is not None and extras is None:
+        raise ValueError("emulate_reduce needs the prior micro-batches' "
+                         "stacked gradients via extras=")
+    if extras is not None and len(extras) != len(leaves_t):
+        raise ValueError(f"extras must align with the {len(leaves_t)} "
+                         f"param leaves, got {len(extras)}")
     n_rep = len(REPORT_FIELDS)
     has_key = key is not None
+    has_emu_key = emulate_key is not None
     want_report = verify or stats
 
     def make_reduce(b: int, idxs: tuple):
         fault_armed = wire_fault is not None and b == 0
 
-        def reduce_bucket(gs, key_arr, aux):
+        def reduce_bucket(gs, extras_b, keys, aux):
             # order matters and mirrors the monolith exactly: the sp/tp
             # psums FIRST, the 2^k sat-pressure scale on the post-psum
             # gradients second (scaling before the psum could overflow
             # a per-rank value whose psum'd sum the monolith keeps
-            # finite — a bitwise divergence at the fp32 range edge)
+            # finite — a bitwise divergence at the fp32 range edge),
+            # the rank-local emulate-node reduce third (its input is
+            # the scaled post-psum micro grads, mix.py:251-282), the
+            # cross-device collective last
             if leaf_pre is not None:
                 gs = [leaf_pre(g, i) for g, i in zip(gs, idxs)]
             if sat_factor is not None:
                 gs = [g * aux[0] for g in gs]
+            if emulate_reduce is not None:
+                gs = [emulate_reduce(g, e, i,
+                                     keys[1] if has_emu_key else None)
+                      for g, e, i in zip(gs, extras_b, idxs)]
+            sum_key = keys[0] if has_key else None
+            if collective is not None:
+                out = collective(b, idxs, gs, sum_key)
+                return list(out), jnp.zeros((n_rep,), jnp.float32)
             wf = ((aux[1].astype(jnp.int32), aux[2].astype(jnp.int32))
                   if fault_armed else None)
             out = sum_gradients(
                 list(gs), axis_name,
-                key=(key_arr if has_key else None),
+                key=sum_key,
                 verify=verify, stats=stats, wire_fault=wf,
                 offset_starts=[plan.starts[i] for i in idxs],
                 **reduce_kw)
@@ -267,10 +340,11 @@ def overlapped_grads(loss_fn: Callable, params: Any, *,
 
         return reduce_bucket
 
-    taps = [_make_bucket_tap(make_reduce(b, idxs))
+    taps = [_make_bucket_tap(make_reduce(b, idxs), len(idxs))
             for b, idxs in enumerate(plan.buckets)]
-    key_arr = (jnp.asarray(key) if has_key
-               else jnp.zeros((2,), jnp.uint32))
+    dummy = jnp.zeros((2,), jnp.uint32)
+    keys = jnp.stack([jnp.asarray(key) if has_key else dummy,
+                      jnp.asarray(emulate_key) if has_emu_key else dummy])
     aux = jnp.stack([
         (jnp.asarray(sat_factor, jnp.float32) if sat_factor is not None
          else jnp.float32(1.0)),
@@ -282,7 +356,10 @@ def overlapped_grads(loss_fn: Callable, params: Any, *,
     def inner(p, z):
         leaves = list(jax.tree_util.tree_flatten(p)[0])
         for b, idxs in enumerate(plan.buckets):
-            outs = taps[b](z[b], key_arr, aux, *[leaves[i] for i in idxs])
+            ext = ([extras[i] for i in idxs] if extras is not None
+                   else [])
+            outs = taps[b](z[b], keys, aux,
+                           *[leaves[i] for i in idxs], *ext)
             for j, i in enumerate(idxs):
                 leaves[i] = outs[j]
         return loss_fn(jax.tree_util.tree_unflatten(treedef, leaves))
@@ -342,11 +419,12 @@ def overlapped_grads(loss_fn: Callable, params: Any, *,
 # overlap evidence (CI's crude "overlap actually happened" assertion)
 # ---------------------------------------------------------------------------
 
-# the gradient-TRANSPORT collectives: ppermute (ring hops) and
-# all_gather (gather path / ring rebuild).  psum is deliberately absent —
-# scalar bookkeeping (world size, loss metrics) and the LM's FORWARD
+# the gradient-TRANSPORT collectives: ppermute (ring hops), all_gather
+# (gather path / ring rebuild) and all_to_all (ZeRO-2's per-bucket
+# reduce-scatter, ISSUE 12).  psum is deliberately absent — scalar
+# bookkeeping (world size, loss metrics) and the LM's FORWARD
 # tensor-parallel psums would otherwise read as transport.
-_COLLECTIVE_PRIMS = {"ppermute", "all_gather"}
+_COLLECTIVE_PRIMS = {"ppermute", "all_gather", "all_to_all"}
 _COMPUTE_PRIMS = {"conv_general_dilated", "dot_general"}
 
 

@@ -27,12 +27,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..quant.numerics import cast_to_format, cast_to_format_sr_at
+from ..quant.numerics import (cast_body_blocked, cast_to_format,
+                              cast_to_format_sr_at, sr_bits_at)
 
 __all__ = ["ordered_quantized_sum", "kahan_quantized_sum", "quantized_sum"]
 
 
-def _make_q(exp: int, man: int, key, offsets=None):
+def _make_q(exp: int, man: int, key, offsets=None, block=None):
     """Per-step quantizer factory.  key=None -> RTNE (reference semantics,
     ignores the step/site arguments).  With a PRNG key -> unbiased
     stochastic rounding with an independent bitstream per (step, site,
@@ -47,8 +48,18 @@ def _make_q(exp: int, man: int, key, offsets=None):
     ``arange(size)``).  Bits therefore depend only on (key, step, site,
     offset), never on the array layout — callers that pass GLOBAL offsets
     (parallel/dist.py buckets, parallel/zero.py shards) get bitwise
-    agreement with the per-leaf / replicated computation."""
+    agreement with the per-leaf / replicated computation.
+
+    ``block`` switches every cast site to the block-scaled cast
+    (`numerics.cast_body_blocked`, blocks of ``block`` elements along
+    the LAST axis) — the ordered-scan twin of the ring's
+    `_make_hop_q(block=...)`, used by ZeRO-2's blocked reduce-scatter
+    scan (parallel/zero.py) so the accumulation keeps the per-block
+    dynamic range the blocked wire bought."""
     if key is None:
+        if block is not None:
+            return lambda x, step, site: cast_body_blocked(
+                x, exp, man, block)
         rtne = functools.partial(cast_to_format, exp_bits=exp, man_bits=man)
         return lambda x, step, site: rtne(x)
 
@@ -56,22 +67,27 @@ def _make_q(exp: int, man: int, key, offsets=None):
         k = jax.random.fold_in(jax.random.fold_in(key, step), site)
         offs = (jnp.arange(x.size, dtype=jnp.uint32).reshape(x.shape)
                 if offsets is None else offsets)
+        if block is not None:
+            rbits = jnp.broadcast_to(sr_bits_at(k, offs), jnp.shape(x))
+            return cast_body_blocked(x, exp, man, block, rbits=rbits)
         return cast_to_format_sr_at(x, exp, man, k, offs)
 
     return q
 
 
 def ordered_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
-                          key=None, offsets=None) -> jnp.ndarray:
+                          key=None, offsets=None,
+                          block_size=None) -> jnp.ndarray:
     """res = 0; for g in stacked: res = quantize(res + g)   — in order.
 
     Mirrors reference normal_sum_gradients' gather path
     (dist_util.py:60-69): accumulation starts from zeros, and every partial
     sum is re-cast to eXmY.  `stacked` has shape (W, *leaf_shape).
     `key` switches the per-step cast to stochastic rounding; `offsets`
-    overrides the per-element bit indices (see _make_q).
+    overrides the per-element bit indices; `block_size` switches every
+    cast to the block-scaled cast (see _make_q).
     """
-    q = _make_q(exp, man, key, offsets)
+    q = _make_q(exp, man, key, offsets, block=block_size)
 
     def step(carry, xs):
         res, i = carry
@@ -84,7 +100,8 @@ def ordered_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
 
 
 def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
-                        key=None, offsets=None) -> jnp.ndarray:
+                        key=None, offsets=None,
+                        block_size=None) -> jnp.ndarray:
     """Rank-ordered Kahan-compensated sum with every intermediate quantized.
 
     Mirrors reference kahan_sum_gradients (dist_util.py:72-89):
@@ -92,9 +109,10 @@ def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
         y = q(g - c); t = q(res + y); c = q(q(t - res) - y); res = t
 
     With `key`, each of the four casts draws its own SR bitstream per rank
-    step (sites 0-3); `offsets` overrides the per-element bit indices.
+    step (sites 0-3); `offsets` overrides the per-element bit indices;
+    `block_size` switches every site to the block-scaled cast.
     """
-    q = _make_q(exp, man, key, offsets)
+    q = _make_q(exp, man, key, offsets, block=block_size)
 
     def step(carry, g):
         res, c, i = carry
@@ -111,16 +129,23 @@ def kahan_quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
 
 def quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
                   use_kahan: bool = False, key=None,
-                  offsets=None) -> jnp.ndarray:
+                  offsets=None, block_size=None) -> jnp.ndarray:
     """Dispatch between the plain and Kahan ordered quantized sums.
 
     The fp32 shortcut (exp==8, man==23 → plain sum) applies only to the
     non-Kahan path, exactly as the reference does (dist_util.py:55-59 has the
     shortcut; kahan_sum_gradients:72-89 does not).  The shortcut also makes
-    `key` irrelevant there (SR at (8,23) is the identity)."""
+    `key` irrelevant there (SR at (8,23) is the identity).  ``block_size``
+    (ZeRO-2's blocked reduce-scatter, parallel/zero.py) switches every
+    cast site to the block-scaled cast; it is a caller error at (8,23),
+    where the shortcut would silently ignore it."""
+    if block_size is not None and exp == 8 and man == 23 and not use_kahan:
+        raise ValueError("block_size at (8, 23): the fp32 shortcut has no "
+                         "cast to block-scale")
     if use_kahan:
         return kahan_quantized_sum(stacked, exp, man, key=key,
-                                   offsets=offsets)
+                                   offsets=offsets, block_size=block_size)
     if exp == 8 and man == 23:
         return jnp.sum(stacked, axis=0)
-    return ordered_quantized_sum(stacked, exp, man, key=key, offsets=offsets)
+    return ordered_quantized_sum(stacked, exp, man, key=key, offsets=offsets,
+                                 block_size=block_size)

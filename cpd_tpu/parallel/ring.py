@@ -434,8 +434,12 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
         # packed chunks rebuilds the full vector (XLA lowers all_gather
         # as a ring on the TPU torus, so the wire cost is the (W-1)
         # chunk hops accounted in ring_transport_bytes — with the
-        # payload still bit-packed).
-        if block_scale:
+        # payload still bit-packed).  On the fused arm the scan's final
+        # carry IS that packed wire (the kernel canonicalizes its code
+        # bytes to the XLA re-pack's exactly), so no re-pack runs.
+        if fused_wire:          # fused_wire already excludes Kahan
+            wire = carry
+        elif block_scale:
             wire = pack_exmy_blocked(res, exp, man, block_size)
         else:
             wire = pack_exmy(res, exp, man) if packed else res
@@ -485,8 +489,12 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
             if verify:
                 res, new_wire, d_in, d_out = fused_hop(
                     recv, t, chunk_at(t), True)
+                # d_out also rides out raw: the LAST hop's out-digest is
+                # the digest of this rank's gather wire (gwire == the
+                # final carry), so the gather tag needs no XLA re-hash
                 ys = (tag_of(recv, t, left, digest=d_in),
-                      tag_of(new_wire, t + 1, rank_i, digest=d_out))
+                      tag_of(new_wire, t + 1, rank_i, digest=d_out),
+                      d_out)
             else:
                 _, new_wire = fused_hop(recv, t, chunk_at(t), False)
         else:
@@ -515,8 +523,15 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
     res, _ = from_wire(wire_f)
 
     hop_bad = jnp.zeros([], jnp.int32)
+    d_gwire = None
+    if verify and fused_wire:
+        d_gwire = d0  # w == 1: wire0 is the gather wire
     if verify and w > 1:
-        rtags, stags = ys
+        if fused_wire:
+            rtags, stags, douts = ys
+            d_gwire = douts[-1]
+        else:
+            rtags, stags = ys
         # sent[k] = the tag of the wire delivered at hop k+1: wire0's
         # tag first, then each body-produced wire's (the last body
         # iteration's wire is never sent — its tag is dropped)
@@ -525,8 +540,12 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
         hop_bad = jnp.sum((remote_sent != rtags).astype(jnp.int32))
 
     # all-gather wire, row-tagged: row i's tag is built by rank i with
-    # hop index 0 (scan hops use t >= 1, so no aliasing)
-    if block_scale:
+    # hop index 0 (scan hops use t >= 1, so no aliasing).  The fused arm
+    # reuses the scan's final carry as the gather wire (kernel bytes ==
+    # the XLA re-pack's, PR 9 parity) and its kernel digest for the tag.
+    if fused_wire:
+        gwire = wire_f
+    elif block_scale:
         gwire = pack_exmy_blocked(res, exp, man, block_size)
     else:
         gwire = pack_exmy(res, exp, man) if packed else res
@@ -566,8 +585,18 @@ def ring_quantized_sum(flat: jnp.ndarray, axis_name: str, exp: int, man: int,
     # shared code), so replicas agreeing on every gathered byte agree
     # on the reconstructed vector bit-for-bit.
     from .integrity import digest_concat, tag_from_digest
-    gtag = hop_tag(gwire, jnp.int32(0), rank_i)
-    row_digests = jax.vmap(wire_digest)(gathered)
+    if fused_wire:
+        # no XLA-side wire digest on the fused arm (ISSUE 12 leg 4):
+        # the sent gather wire's digest came out of the LAST hop's pack
+        # kernel, and the RECEIVED rows are hashed by the one-pass
+        # per-row digest kernel (ops/quantize.digest_rows_pallas)
+        from ..ops.quantize import digest_rows_pallas
+        gtag = tag_from_digest(d_gwire, jnp.int32(0), rank_i)
+        row_digests = digest_rows_pallas(
+            gathered.reshape(w, -1), interpret)
+    else:
+        gtag = hop_tag(gwire, jnp.int32(0), rank_i)
+        row_digests = jax.vmap(wire_digest)(gathered)
     row_tags = jax.vmap(
         lambda d, i: tag_from_digest(d, jnp.int32(0), i))(
             row_digests, jnp.arange(w, dtype=jnp.int32))

@@ -48,6 +48,7 @@ precision has one source of truth):
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable, NamedTuple, Optional
 
 import jax
@@ -56,15 +57,69 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..quant.numerics import pack_exmy, unpack_exmy
+from ..quant.numerics import (cast_body_blocked, pack_exmy,
+                              pack_exmy_blocked, sr_bits_at, unpack_exmy,
+                              unpack_exmy_blocked)
 from .aps import (aps_max_exponents, aps_scale, aps_shift_factors,
-                  pmax_scalar_vector)
+                  exp2_exact, pmax_scalar_vector)
 from .dist import _flat_axis_index, _wire_format, quantize_tree_sr
 from .reduction import quantized_sum
 from .ring import pad_to_world, ring_chunk_size
 
 __all__ = ["Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd",
-           "zero1_lars", "zero2_lars", "zero3_lars"]
+           "zero1_lars", "zero2_lars", "zero3_lars",
+           "zero2_oracle_flat"]
+
+
+@dataclasses.dataclass(frozen=True)
+class _ZeroLayout:
+    """Static flat layout of one ZeRO updater over one param/grad tree.
+
+    The legacy whole-tree layout is the single-bucket special case
+    (``bucket_elems=None``): one span covering every leaf, padded to
+    ``world * ceil(total / world)`` — bit-identical to the pre-ISSUE-12
+    code.  With a bucket cap (`zero2_sgd(bucket_elems=...)`, shared with
+    the overlap taps' `overlap.bucket_layout`), each bucket's span is
+    padded to ``world * c_b`` independently and rank r's shard is the
+    CONCATENATION of its per-bucket slices — the layout that lets a
+    per-bucket `custom_vjp` tap reduce-scatter one bucket the moment its
+    backward closes (ISSUE 12 leg 3), with the update consuming bucket
+    shards.
+
+    ``meta[b] = (a_b, m_b, c_b)``: the bucket's GLOBAL flat start in the
+    unpadded tree layout (the SR bitstream's index space,
+    parallel/dist._leaf_starts), its element count, and its per-rank
+    chunk ``ceil(m_b / world)``.  ``shard_size = Σ c_b``."""
+    world: int
+    total: int
+    sizes: tuple          # per-leaf element counts, tree_flatten order
+    starts: tuple         # per-leaf global flat starts
+    buckets: tuple        # tuple of tuples of leaf indices
+    meta: tuple           # per bucket: (a_b, m_b, c_b)
+
+    @property
+    def shard_size(self) -> int:
+        return sum(c for _, _, c in self.meta)
+
+    @property
+    def padded_total(self) -> int:
+        return self.world * self.shard_size
+
+    def shard_offsets(self, rank) -> jnp.ndarray:
+        """(S,) uint32 GLOBAL flat offset of each element of rank
+        ``rank``'s shard; world-size-pad elements get the sentinel
+        ``total`` (one past the last real element — they hold exact
+        zeros, whose cast is rounding-invariant, and the sentinel maps
+        them to the pad bucket in every leaf lookup)."""
+        segs = []
+        r = jnp.asarray(rank).astype(jnp.uint32)
+        for a, m, c in self.meta:
+            local = r * jnp.uint32(c) + jnp.arange(c, dtype=jnp.uint32)
+            g = jnp.uint32(a) + local
+            segs.append(jnp.where(local < jnp.uint32(m), g,
+                                  jnp.uint32(self.total)))
+        return jnp.concatenate(segs) if segs else jnp.zeros((0,),
+                                                            jnp.uint32)
 
 
 class Zero1State(NamedTuple):
@@ -84,6 +139,16 @@ class Zero1State(NamedTuple):
 
 
 class _Zero1:
+    # per-bucket element cap for the BUCKETED flat layout (ZeRO-2 only;
+    # None = the legacy single whole-tree bucket, bit-identical to the
+    # pre-ISSUE-12 layout)
+    bucket_elems: Optional[int] = None
+    # whether this updater's update_fn consumes pre_sharded tap output
+    # (ZeRO-2 only; mesh_layout wires tap_reduce= iff this is True, so
+    # ZeRO-3 — which inherits make_tap_reduce but not the pre_sharded
+    # update path — cannot advertise an overlap hook it does not honor)
+    supports_tap_reduce = False
+
     def __init__(self, schedule: Callable, world: int, momentum: float,
                  weight_decay: float, nesterov: bool,
                  wd_mask: Optional[Callable], axis_name: str):
@@ -96,42 +161,67 @@ class _Zero1:
         self.axis_name = axis_name
 
     # ---- flat layout ----
+    def _layout(self, template) -> _ZeroLayout:
+        """The static flat layout over `template` — one whole-tree
+        bucket unless this updater was built with a bucket cap
+        (`_Zero2(bucket_elems=...)`), in which case the buckets come
+        from the ONE shared capping function (`overlap.bucket_layout`)
+        so the updater, the step's overlap taps, and the bucketed ring
+        can never disagree about boundaries.  Chunks use the ring
+        transport's quantum (`ring_chunk_size`) per bucket."""
+        from .overlap import bucket_layout
+        leaves = jax.tree.leaves(template)
+        sizes = tuple(int(l.size) for l in leaves)
+        starts = tuple(int(s) for s in
+                       np.concatenate([[0], np.cumsum(sizes[:-1])])
+                       ) if sizes else ()
+        total = int(sum(sizes))
+        if self.bucket_elems is None:
+            buckets = (tuple(range(len(sizes))),) if sizes else ()
+        else:
+            buckets = tuple(tuple(b) for b in
+                            bucket_layout(sizes, self.bucket_elems))
+        meta = []
+        for b in buckets:
+            a = starts[b[0]]
+            m = sum(sizes[i] for i in b)
+            meta.append((a, m, ring_chunk_size(m, self.world)))
+        return _ZeroLayout(world=self.world, total=total, sizes=sizes,
+                           starts=starts, buckets=buckets,
+                           meta=tuple(meta))
+
     def _shard_size(self, params) -> int:
         # the ring transport's chunk quantum (parallel/ring.py) — ZeRO
         # shards and ring chunks slice the same padded flat layout
-        total = sum(l.size for l in jax.tree.leaves(params))
-        return ring_chunk_size(total, self.world)
+        return self._layout(params).shard_size
 
-    def _shard_leaf_values(self, template, values, rank,
-                           s: int, pad: float = 0.0) -> jnp.ndarray:
+    def _shard_leaf_values(self, lay: _ZeroLayout, values, rank,
+                           pad: float = 0.0) -> jnp.ndarray:
         """Expand a per-LEAF value vector to this rank's (S,) per-element
         shard of the flat layout.
 
-        Built from the static leaf-offset table: each shard element index
-        maps to its leaf via searchsorted, then to that leaf's value.
-        O(S) per rank — never the full (W*S,) flat vector, which the
-        round-2 code materialized on every rank before slicing (ADVICE
-        r2).  Elements past the last leaf (flat padding) get `pad`."""
-        leaf_idx = self._shard_leaf_index(template, rank, s)
+        Built from the static leaf-offset table: each shard element's
+        GLOBAL offset maps to its leaf via searchsorted, then to that
+        leaf's value.  O(S) per rank — never the full (W*S,) flat
+        vector, which the round-2 code materialized on every rank before
+        slicing (ADVICE r2).  Elements in the world-size pad get `pad`."""
+        leaf_idx = self._shard_leaf_index(lay, rank)
         padded = jnp.concatenate([jnp.asarray(values, jnp.float32),
                                   jnp.full((1,), pad, jnp.float32)])
         return jnp.take(padded, leaf_idx)
 
-    def _shard_leaf_index(self, template, rank, s: int) -> jnp.ndarray:
+    def _shard_leaf_index(self, lay: _ZeroLayout, rank) -> jnp.ndarray:
         """(S,) map from shard element to its leaf index in tree-leaves
         order; elements in the world-size pad map to n_leaves (one past
-        the last real leaf)."""
-        leaves = jax.tree.leaves(template)
-        ends = np.cumsum([l.size for l in leaves])  # static end offsets
-        # uint32 index space, same rationale as the SR offsets below:
-        # int32 would wrap negative past 2^31 elements and searchsorted
-        # would map those shard elements to leaf 0 (ADVICE r4 follow-up)
-        idx = rank.astype(jnp.uint32) * jnp.uint32(s) + jnp.arange(
-            s, dtype=jnp.uint32)
-        return jnp.searchsorted(jnp.asarray(ends, np.uint32), idx,
-                                side="right")
+        the last real leaf — the `shard_offsets` sentinel lands there)."""
+        ends = np.cumsum(lay.sizes)  # static end offsets
+        # uint32 index space, same rationale as the SR offsets: int32
+        # would wrap negative past 2^31 elements and searchsorted would
+        # map those shard elements to leaf 0 (ADVICE r4 follow-up)
+        return jnp.searchsorted(jnp.asarray(ends, np.uint32),
+                                lay.shard_offsets(rank), side="right")
 
-    def _shard_mask(self, params, rank, s: int) -> jnp.ndarray:
+    def _shard_mask(self, params, lay: _ZeroLayout, rank) -> jnp.ndarray:
         """This rank's (S,) slice of the per-element weight-decay mask
         (per-leaf bools are static, so the value vector is a host-side
         constant of n_leaves floats, not a 100MB per-element literal)."""
@@ -139,7 +229,38 @@ class _Zero1:
                 else jax.tree.map(lambda _: True, params))
         vals = np.array([float(bool(m)) for m in jax.tree.leaves(mask)],
                         np.float32)
-        return self._shard_leaf_values(params, vals, rank, s)
+        return self._shard_leaf_values(lay, vals, rank)
+
+    def _shard_flat(self, flat: jnp.ndarray, lay: _ZeroLayout,
+                    rank) -> jnp.ndarray:
+        """Rank `rank`'s (S,) shard of the UNPADDED (total,) flat vector:
+        per bucket, pad the span to ``world * c_b`` and dynamic-slice
+        the rank's chunk.  Single bucket == the legacy pad+slice."""
+        segs = []
+        for a, m, c in lay.meta:
+            span = pad_to_world(lax.slice_in_dim(flat, a, a + m),
+                                self.world)
+            segs.append(lax.dynamic_slice(
+                span, (rank * c,), (c,)))
+        return (jnp.concatenate(segs) if segs
+                else jnp.zeros((0,), jnp.float32))
+
+    def _unflatten_gathered(self, full: jnp.ndarray, template,
+                            lay: _ZeroLayout):
+        """Inverse of `_shard_flat` ∘ all_gather: the tiled (W*S,)
+        gather of per-rank shards back to the param pytree.  Rank-major:
+        ``full.reshape(W, S)`` holds each rank's concatenated bucket
+        chunks, so bucket b's unpadded span is the flattened (W, c_b)
+        column block trimmed to m_b."""
+        stacked = full.reshape(self.world, lay.shard_size)
+        spans, off = [], 0
+        for a, m, c in lay.meta:
+            spans.append(lax.slice_in_dim(stacked, off, off + c,
+                                          axis=1).reshape(-1)[:m])
+            off += c
+        flat = (jnp.concatenate(spans) if spans
+                else jnp.zeros((0,), jnp.float32))
+        return self._unflatten(flat, template)
 
     @staticmethod
     def _flatten(tree) -> jnp.ndarray:
@@ -177,20 +298,24 @@ class _Zero1:
             raise ValueError(
                 "ZeRO-1 expects pre-reduced gradients; "
                 "reduce_in_update=True is a ZeRO-2 (zero2_sgd) contract")
-        s = self._shard_size(state.params)
+        lay = self._layout(state.params)
         rank = lax.axis_index(axis_name)
-        flat_g = pad_to_world(self._flatten(grads), self.world)
-        return lax.dynamic_slice(flat_g, (rank * s,), (s,))
+        return self._shard_flat(self._flatten(grads), lay, rank)
 
     requires_reduce_in_update = False
 
-    def update_fn(self, grads, state, axis_name: str, **quant_kw):
+    def update_fn(self, grads, state, axis_name: str,
+                  pre_sharded: bool = False, **quant_kw):
         """Inside shard_map: `grads` per the subclass's _grad_shard
         contract, LOCAL (S,) momentum shard.  Returns (new full params,
         new opt state).  `quant_kw` is forwarded by the train step when it
         delegates the reduction (reduce_in_update) so precision settings
-        have one source of truth."""
-        if self.requires_reduce_in_update and not quant_kw:
+        have one source of truth.  ``pre_sharded=True`` (ZeRO-2 overlap,
+        ISSUE 12): `grads` is ALREADY this rank's (S,) reduce-scattered
+        shard — the per-bucket custom_vjp taps ran the collective inside
+        the backward (`make_tap_reduce`) and the update just consumes the
+        bucket shards."""
+        if self.requires_reduce_in_update and not (quant_kw or pre_sharded):
             raise ValueError(
                 "this ZeRO stage folds the collective into the update: "
                 "build the step with make_train_step(..., "
@@ -198,25 +323,34 @@ class _Zero1:
                 "and the sharded reduce-scatter would double-count by W")
         params = state.params
         opt: Zero1State = state.opt_state
-        s = self._shard_size(params)
+        lay = self._layout(params)
         rank = lax.axis_index(axis_name)
         lr = self.schedule(opt.step)
 
-        g_sh = self._grad_shard(grads, state, axis_name, **quant_kw)
-        flat_p = pad_to_world(self._flatten(params), self.world)
-        p_sh = lax.dynamic_slice(flat_p, (rank * s,), (s,))
-        new_p_sh, new_buf = self._shard_update(g_sh, p_sh, params, rank, s,
-                                               opt.momentum, lr, axis_name)
+        if pre_sharded:
+            g_sh = jnp.asarray(grads, jnp.float32)
+            if g_sh.shape != (lay.shard_size,):
+                raise ValueError(
+                    f"pre_sharded gradients have shape {g_sh.shape}, "
+                    f"expected ({lay.shard_size},) — the tap plan's "
+                    f"bucket layout must come from this updater "
+                    f"(make_tap_reduce)")
+        else:
+            g_sh = self._grad_shard(grads, state, axis_name, **quant_kw)
+        p_sh = self._shard_flat(self._flatten(params), lay, rank)
+        new_p_sh, new_buf = self._shard_update(g_sh, p_sh, params, rank,
+                                               lay, opt.momentum, lr,
+                                               axis_name)
 
         full = lax.all_gather(new_p_sh, axis_name, axis=0, tiled=True)
-        new_params = self._unflatten(full, params)
+        new_params = self._unflatten_gathered(full, params, lay)
         return new_params, Zero1State(opt.step + 1, new_buf)
 
-    def _shard_update(self, g_sh, p_sh, template, rank, s, buf, lr,
+    def _shard_update(self, g_sh, p_sh, template, rank, lay, buf, lr,
                       axis_name):
         """Optimizer rule on the flat shard — overridden by the LARS
         variants (`_LarsRule`); the default is the torch-SGD rule."""
-        m_sh = self._shard_mask(template, rank, s)
+        m_sh = self._shard_mask(template, lay, rank)
         return self._shard_sgd(g_sh, p_sh, m_sh, buf, lr)
 
     # ---- portable checkpoints (round 5; the ZeRO-3 analogs are its
@@ -231,11 +365,23 @@ class _Zero1:
         conversion) is equally world-portable now: `CheckpointManager`
         records the padded length in the sidecar and
         `restore_latest_valid(world=W')` performs this same trim +
-        re-pad lazily at restore (the Zero1State elastic invariant)."""
+        re-pad lazily at restore (the Zero1State elastic invariant) —
+        for the LEGACY single-bucket layout; a bucketed updater
+        (`zero2_sgd(bucket_elems=...)`) must save through this method,
+        which interleaves the per-bucket pad trim."""
         opt: Zero1State = state.opt_state
-        total = sum(l.size for l in jax.tree.leaves(state.params))
+        lay = self._layout(state.params)
+        mom = jnp.asarray(opt.momentum)
+        if len(lay.meta) <= 1:
+            return state.replace(opt_state=Zero1State(
+                opt.step, mom[:lay.total]))
+        stacked = mom.reshape(self.world, lay.shard_size)
+        spans, off = [], 0
+        for a, m, c in lay.meta:
+            spans.append(stacked[:, off:off + c].reshape(-1)[:m])
+            off += c
         return state.replace(opt_state=Zero1State(
-            opt.step, jnp.asarray(opt.momentum)[:total]))
+            opt.step, jnp.concatenate(spans)))
 
     def portable_template(self, state):
         """Restore template in the portable layout (pass to
@@ -245,10 +391,21 @@ class _Zero1:
             jnp.zeros([], jnp.int32), jnp.zeros((total,), jnp.float32)))
 
     def import_state(self, state):
-        """Portable layout -> THIS updater's padded (W*S,) layout."""
+        """Portable layout -> THIS updater's padded (W*S,) layout (the
+        bucketed inverse of `export_state`'s interleaved trim)."""
         opt: Zero1State = state.opt_state
-        mom = pad_to_world(jnp.asarray(opt.momentum), self.world)
-        return state.replace(opt_state=Zero1State(opt.step, mom))
+        lay = self._layout(state.params)
+        mom = jnp.asarray(opt.momentum)
+        if len(lay.meta) <= 1:
+            return state.replace(opt_state=Zero1State(
+                opt.step, pad_to_world(mom, self.world)))
+        cols = []
+        for a, m, c in lay.meta:
+            cols.append(pad_to_world(mom[a:a + m],
+                                     self.world).reshape(self.world, c))
+        stacked = jnp.concatenate(cols, axis=1)     # (W, S)
+        return state.replace(opt_state=Zero1State(opt.step,
+                                                  stacked.reshape(-1)))
 
     def mesh_layout(self, state, mesh):
         """Lay a pytree-params TrainState (whose opt_state is this
@@ -271,6 +428,11 @@ class _Zero1:
               "opt_state_spec": self.state_spec()}
         if self.requires_reduce_in_update:
             kw["reduce_in_update"] = True
+        if self.supports_tap_reduce:
+            # the ZeRO-2 overlap hook (make_tap_reduce): lets
+            # make_train_step(overlap_reduce=True) run the per-bucket
+            # reduce-scatter inside the backward taps (ISSUE 12 leg 3)
+            kw["tap_reduce"] = self.make_tap_reduce
         return laid, kw
 
     def _shard_sgd(self, g_sh, p_sh, m_sh, buf, lr):
@@ -298,44 +460,56 @@ class _Zero2(_Zero1):
     `update_fn` receives the rank's LOCAL (unreduced, post-emulate-node)
     gradients — build the train step with ``reduce_in_update=True`` so it
     skips `sum_gradients`.  Precision settings (use_aps/grad_exp/grad_man/
-    use_kahan/mode) are NOT stored here: the step forwards its own, so the
-    emulate-node quantization and the cross-device reduction cannot drift
-    apart."""
+    use_kahan/mode, and the blocked wire's block_scale/block_size) are NOT
+    stored here: the step forwards its own, so the emulate-node
+    quantization and the cross-device reduction cannot drift apart.
+
+    ``bucket_elems`` switches the flat layout to per-bucket spans
+    (`overlap.bucket_layout` boundaries): each bucket reduce-scatters
+    independently, which is what lets `make_tap_reduce`'s per-bucket
+    custom_vjp taps run the collective INSIDE the backward (ISSUE 12 leg
+    3) — overlap on/off is bitwise identical at a fixed layout because
+    monolith and taps run the SAME `_bucket_reduce_scatter` per bucket."""
 
     # update_fn must see LOCAL grads; _Zero1.update_fn enforces this by
     # refusing to run when the step did not forward its precision settings
     # (i.e. reduce_in_update was off and grads are already reduced —
     # reduce-scattering those would double-count by W)
     requires_reduce_in_update = True
+    supports_tap_reduce = True
 
-    def _shard_shifts(self, grads, shifts, rank, s: int) -> jnp.ndarray:
-        """This rank's (S,) slice of the per-element APS shift factors
-        (pad elements get shift 0 → factor exp2(0)=1)."""
-        return jnp.exp2(self._shard_leaf_values(grads, shifts, rank, s))
+    def __init__(self, schedule, world, momentum, weight_decay, nesterov,
+                 wd_mask, axis_name, bucket_elems: Optional[int] = None):
+        super().__init__(schedule, world, momentum, weight_decay, nesterov,
+                         wd_mask, axis_name)
+        if bucket_elems is not None and bucket_elems < 1:
+            raise ValueError(f"bucket_elems must be >= 1, got "
+                             f"{bucket_elems}")
+        self.bucket_elems = bucket_elems
 
-    def _grad_shard(self, local_grads, state, axis_name: str,
-                    use_aps: bool = False, grad_exp: int = 8,
-                    grad_man: int = 23, use_kahan: bool = False,
-                    mode: str = "faithful", rounding: str = "nearest",
-                    key=None) -> jnp.ndarray:
-        """This rank's (S,) slice of the faithful quantized gradient sum.
+    def _bucket_shift_values(self, lay: _ZeroLayout, b: int, values,
+                             rank, pad: float = 0.0) -> jnp.ndarray:
+        """Per-element expansion of a per-LEAF value vector over bucket
+        b's (c_b,) shard — the bucket-local sibling of
+        `_shard_leaf_values` (values indexed by position WITHIN the
+        bucket's leaf list; world-size-pad elements get `pad`)."""
+        _, m, c = lay.meta[b]
+        idxs = lay.buckets[b]
+        ends = np.cumsum([lay.sizes[i] for i in idxs])
+        local = (jnp.asarray(rank).astype(jnp.uint32) * jnp.uint32(c)
+                 + jnp.arange(c, dtype=jnp.uint32))
+        leaf_idx = jnp.searchsorted(jnp.asarray(ends, np.uint32), local,
+                                    side="right")
+        padded = jnp.concatenate([jnp.asarray(values, jnp.float32),
+                                  jnp.full((1,), pad, jnp.float32)])
+        return jnp.take(padded, leaf_idx)
 
-        Replicates parallel/dist.py `sum_gradients` faithful-mode semantics
-        exactly (APS pre-scale+quantize, rank-ordered requantized scan,
-        divide-unscale), but on 1/W of the elements: the scan is
-        elementwise over ranks, so slicing before summing is bit-identical
-        to summing then slicing.  The precision arguments come from the
-        train step (reduce_in_update forwards them).
-
-        rounding='stochastic' composes bitwise too: the SR bitstream is
-        indexed by GLOBAL flat offset (numerics.sr_bits_at) and the key
-        schedule mirrors sum_gradients' split exactly (k_pre rank-folded
-        for the local pre-quantize, k_sum shared for the ordered scan), so
-        each rank's shard reproduces the very bits the replicated faithful
-        path would give that slice — the semantics target is the
-        reference's ordered requantized sum (dist_util.py:60-69) with SR
-        in place of RTNE.  Elements in the world-size pad hold exact
-        zeros, whose cast is rounding-independent."""
+    @staticmethod
+    def _validate_precision(mode, rounding, key, block_scale, block_size,
+                            grad_exp, grad_man):
+        """The ONE precision-contract validator of the sharded reduce —
+        shared by the post-backward `_grad_shard` and the overlap hook
+        `make_tap_reduce`, so the two entry points cannot drift."""
         if mode != "faithful":
             raise ValueError(
                 f"ZeRO-2 shards the faithful ordered reduction; mode="
@@ -349,61 +523,326 @@ class _Zero2(_Zero1):
         if rounding == "nearest" and key is not None:
             raise ValueError("a PRNG key was passed but rounding='nearest' "
                              "would ignore it (sum_gradients' contract)")
+        if block_scale:
+            if grad_exp == 8 and grad_man == 23:
+                raise ValueError(
+                    "block_scale=True at (8, 23): the fp32 wire has "
+                    "nothing to scale — drop block_scale or pick a "
+                    "sub-fp32 format")
+            if grad_man < 2:
+                raise ValueError(
+                    f"block_scale=True needs a packable format (man_bits "
+                    f">= 2 for the codec's special codes), got "
+                    f"({grad_exp}, {grad_man})")
+            if block_size < 1:
+                raise ValueError(f"block_size must be >= 1, got "
+                                 f"{block_size}")
+
+    def _bucket_reduce_scatter(self, lay: _ZeroLayout, b: int, leaves_b,
+                               axis_name: str, *, use_aps, grad_exp,
+                               grad_man, use_kahan, key,
+                               block_scale=False,
+                               block_size=128) -> jnp.ndarray:
+        """Bucket b's (c_b,) reduce-scattered shard — the ONE collective
+        body shared by the post-backward monolith (`_grad_shard`'s loop)
+        and the in-backward overlap taps (`make_tap_reduce`), so overlap
+        on/off cannot drift.
+
+        Per-tensor wire (block_scale=False): APS pre-scale+quantize, the
+        (W, c_b) all_to_all of bit-packed code words, the rank-ordered
+        requantized scan with GLOBAL-offset SR bits, divide-unscale —
+        exactly `sum_gradients` faithful-mode semantics on the bucket's
+        slice (the scan is elementwise over ranks, so slicing before
+        summing is bit-identical to summing then slicing).
+
+        Blocked wire (block_scale=True, ISSUE 12 leg 1): the all_to_all
+        payload rides `pack_exmy_blocked` — each (c_b,) payload row is
+        block-scaled-cast with CHUNK-LOCAL blocks (every sender derives
+        identical boundaries for slice j, so the shift sidecar is
+        sharded consistently with the slice layout; the odd tail block
+        of a non-divisible c_b is handled by the codec), the
+        1-byte-per-block sidecar rides after the code bytes, and the
+        ordered scan requantizes partials with the SAME blocked cast
+        (`reduction.quantized_sum(block_size=...)`) so accumulation
+        keeps the per-block dynamic range the wire bought.  A DIFFERENT
+        documented accumulation numerics than per-tensor — gated by its
+        own single-device oracle in tests/test_zero.py, with the
+        pack→all_to_all→unpack wire trip bitwise lossless on the
+        blocked-cast values (the codec's fixed-point idempotence, the
+        'existing lossless path' gate)."""
+        a, m, c = lay.meta[b]
+        idxs = lay.buckets[b]
         k_pre = k_sum = None
         if key is not None:
             # same derivation as sum_gradients: shared scan key, rank-
-            # decorrelated pre-quantize key (coherent-rounding argument in
-            # parallel/dist.py)
+            # decorrelated pre-quantize key (coherent-rounding argument
+            # in parallel/dist.py)
             k_pre, k_sum, _ = jax.random.split(key, 3)
             k_pre = jax.random.fold_in(k_pre, _flat_axis_index(axis_name))
-        s = self._shard_size(local_grads)
-        g = local_grads
+        rank = lax.axis_index(axis_name)
+        g = list(leaves_b)
         shifts = None
         if use_aps:
             max_exp = aps_max_exponents(g, float(self.world))
             max_exp = pmax_scalar_vector(max_exp, axis_name)
             shifts = aps_shift_factors(max_exp, grad_exp)
             g = aps_scale(g, shifts)
-            g = quantize_tree_sr(g, grad_exp, grad_man, k_pre)
-
-        flat = pad_to_world(self._flatten(g), self.world)
-        wire = _wire_format(grad_exp, grad_man) if use_aps else None
-        payload = flat.reshape(self.world, s)
-        if wire is not None:
-            # bit-packed eXmY wire (quant.numerics.pack_exmy): the APS
-            # pre-quantize above put the values in the format set, so the
-            # all_to_all ships wire_bytes(exp, man) bytes/element lossless
-            payload = pack_exmy(payload, *wire)
-        # (W, S): row j after all_to_all = rank j's slice of OUR shard,
+            if not block_scale:
+                g = quantize_tree_sr(g, grad_exp, grad_man, k_pre,
+                                     starts=[lay.starts[i] for i in idxs])
+        flat = jnp.concatenate([l.astype(jnp.float32).reshape(-1)
+                                for l in g])
+        payload = pad_to_world(flat, self.world).reshape(self.world, c)
+        # uint32 offset space throughout: int32 intermediates would rely
+        # on signed overflow wrapping to agree with _leaf_offsets for
+        # element counts in (2^31, 2^32] (ADVICE r4)
+        if block_scale:
+            if k_pre is not None:
+                # blocked SR pre-quantize: bits indexed by the SAME
+                # (k_pre, global offset) convention as quantize_tree_sr,
+                # then the lossless blocked pack (idempotent on the
+                # blocked-cast output — numerics.pack_exmy_blocked)
+                local_idx = (jnp.arange(self.world * c, dtype=jnp.uint32)
+                             .reshape(self.world, c))
+                row_offs = jnp.where(local_idx < jnp.uint32(m),
+                                     jnp.uint32(a) + local_idx,
+                                     jnp.uint32(lay.total))
+                rbits = sr_bits_at(k_pre, row_offs)
+                payload = cast_body_blocked(payload, grad_exp, grad_man,
+                                            block_size, rbits=rbits)
+            wire_rows = pack_exmy_blocked(payload, grad_exp, grad_man,
+                                          block_size)
+            stacked = lax.all_to_all(wire_rows, axis_name,
+                                     split_axis=0, concat_axis=0)
+            stacked = unpack_exmy_blocked(stacked, grad_exp, grad_man, c,
+                                          block_size)
+        else:
+            wire = _wire_format(grad_exp, grad_man) if use_aps else None
+            if wire is not None:
+                # bit-packed eXmY wire (quant.numerics.pack_exmy): the
+                # APS pre-quantize above put the values in the format
+                # set, so the all_to_all ships wire_bytes(exp, man)
+                # bytes/element lossless
+                payload = pack_exmy(payload, *wire)
+            stacked = lax.all_to_all(payload, axis_name,
+                                     split_axis=0, concat_axis=0)
+            if wire is not None:
+                stacked = unpack_exmy(stacked, *wire)
+        # (W, c): row j after all_to_all = rank j's slice of OUR shard,
         # rank-ordered — the gather side of a reduce_scatter
-        stacked = lax.all_to_all(payload, axis_name,
-                                 split_axis=0, concat_axis=0)
-        if wire is not None:
-            stacked = unpack_exmy(stacked, *wire)
-        rank = lax.axis_index(axis_name)
-        # uint32 throughout: int32 intermediates would rely on signed
-        # overflow wrapping to agree with _leaf_offsets for element
-        # counts in (2^31, 2^32] (ADVICE r4)
-        offs = (None if k_sum is None
-                else (rank.astype(jnp.uint32) * jnp.uint32(s)
-                      + jnp.arange(s, dtype=jnp.uint32)))
+        offs = None
+        if k_sum is not None:
+            local = (rank.astype(jnp.uint32) * jnp.uint32(c)
+                     + jnp.arange(c, dtype=jnp.uint32))
+            offs = jnp.where(local < jnp.uint32(m), jnp.uint32(a) + local,
+                             jnp.uint32(lay.total))
         red = quantized_sum(stacked, grad_exp, grad_man, use_kahan,
-                            key=k_sum, offsets=offs)
+                            key=k_sum, offsets=offs,
+                            block_size=(block_size if block_scale
+                                        else None))
         if use_aps:
-            shift_sh = self._shard_shifts(local_grads, shifts, rank, s)
-            red = red / shift_sh   # true divide, aps_unscale semantics
+            # true divide, aps_unscale semantics (pad shift 0 -> 2^0=1)
+            red = red / exp2_exact(
+                self._bucket_shift_values(lay, b, shifts, rank))
         return red
+
+    def _grad_shard(self, local_grads, state, axis_name: str,
+                    use_aps: bool = False, grad_exp: int = 8,
+                    grad_man: int = 23, use_kahan: bool = False,
+                    mode: str = "faithful", rounding: str = "nearest",
+                    key=None, block_scale: bool = False,
+                    block_size: int = 128) -> jnp.ndarray:
+        """This rank's (S,) slice of the faithful quantized gradient sum:
+        the per-bucket `_bucket_reduce_scatter` shards concatenated in
+        bucket order (ONE whole-tree bucket unless this updater was built
+        with ``bucket_elems`` — the legacy layout, bit-identical to the
+        pre-ISSUE-12 code).
+
+        rounding='stochastic' composes bitwise: the SR bitstream is
+        indexed by GLOBAL flat offset (numerics.sr_bits_at) and the key
+        schedule mirrors sum_gradients' split exactly (k_pre rank-folded
+        for the local pre-quantize, k_sum shared for the ordered scan),
+        so each rank's shard reproduces the very bits the replicated
+        faithful path would give that slice — the semantics target is
+        the reference's ordered requantized sum (dist_util.py:60-69)
+        with SR in place of RTNE.  Elements in the world-size pad hold
+        exact zeros, whose cast is rounding-independent."""
+        self._validate_precision(mode, rounding, key, block_scale,
+                                 block_size, grad_exp, grad_man)
+        lay = self._layout(local_grads)
+        leaves = jax.tree.leaves(local_grads)
+        shards = [self._bucket_reduce_scatter(
+                      lay, b, [leaves[i] for i in lay.buckets[b]],
+                      axis_name, use_aps=use_aps, grad_exp=grad_exp,
+                      grad_man=grad_man, use_kahan=use_kahan, key=key,
+                      block_scale=block_scale, block_size=block_size)
+                  for b in range(len(lay.buckets))]
+        return (jnp.concatenate(shards) if shards
+                else jnp.zeros((0,), jnp.float32))
+
+    def make_tap_reduce(self, params, axis_name: str, quant_kw: dict):
+        """The ZeRO-2 overlap hook (ISSUE 12 leg 3): build the
+        ``(plan, chunks, collective)`` triple `make_train_step` hands to
+        `overlap.overlapped_grads` when ``overlap_reduce`` composes with
+        ``reduce_in_update``.
+
+        ``plan`` is the BucketPlan over THIS updater's bucket layout
+        (boundaries from the shared `overlap.bucket_layout`, so the taps
+        close exactly the buckets the update consumes); ``chunks`` the
+        per-bucket shard sizes c_b; ``collective(b, idxs, gs, key)``
+        runs bucket b's `_bucket_reduce_scatter` inside the tap's bwd
+        rule and returns the (c_b,) shard EMBEDDED in the bucket's
+        leaf-cotangent shapes (shard in the first c_b flat slots, zeros
+        elsewhere — c_b <= m_b always, since c_b = ceil(m_b / W)).  The
+        step extracts the shards (`overlap.extract_bucket_shards`) and
+        calls ``update_fn(shard_vec, ..., pre_sharded=True)``."""
+        from .overlap import BucketPlan
+        lay = self._layout(params)
+        self._validate_precision(
+            quant_kw.get("mode", "faithful"),
+            quant_kw.get("rounding", "nearest"),
+            # the SR key is traced per-step; validate the static
+            # contract with a placeholder
+            (object() if quant_kw.get("rounding") == "stochastic"
+             else None),
+            quant_kw.get("block_scale", False),
+            quant_kw.get("block_size", 128),
+            quant_kw.get("grad_exp", 8), quant_kw.get("grad_man", 23))
+        prec = dict(use_aps=quant_kw.get("use_aps", False),
+                    grad_exp=quant_kw.get("grad_exp", 8),
+                    grad_man=quant_kw.get("grad_man", 23),
+                    use_kahan=quant_kw.get("use_kahan", False),
+                    block_scale=quant_kw.get("block_scale", False),
+                    block_size=quant_kw.get("block_size", 128))
+        plan = BucketPlan(sizes=lay.sizes, starts=lay.starts,
+                          buckets=lay.buckets,
+                          bucket_elems=(self.bucket_elems
+                                        if self.bucket_elems is not None
+                                        else max(lay.total, 1)))
+        chunks = tuple(c for _, _, c in lay.meta)
+
+        def collective(b, idxs, gs, key):
+            _, m, c = lay.meta[b]
+            shard = self._bucket_reduce_scatter(lay, b, list(gs),
+                                                axis_name, key=key,
+                                                **prec)
+            flat = jnp.zeros((m,), jnp.float32).at[:c].set(shard)
+            outs, off = [], 0
+            for j, i in enumerate(idxs):
+                n_i = lay.sizes[i]
+                outs.append(flat[off:off + n_i].reshape(np.shape(gs[j])))
+                off += n_i
+            return outs
+
+        return plan, chunks, collective
 
 
 def zero2_sgd(schedule: Callable, world: int, momentum: float = 0.9,
               weight_decay: float = 0.0, nesterov: bool = False,
               wd_mask: Optional[Callable] = None,
-              axis_name: str = "dp") -> _Zero2:
+              axis_name: str = "dp",
+              bucket_elems: Optional[int] = None) -> _Zero2:
     """ZeRO-2 torch-SGD: momentum AND the faithful quantized reduction
     sharded 1/`world`; pair with ``make_train_step(...,
-    reduce_in_update=True)``, which forwards its precision settings."""
+    reduce_in_update=True)``, which forwards its precision settings.
+    ``bucket_elems`` opts into the per-bucket flat layout (the overlap
+    taps' boundaries; None keeps the legacy whole-tree span)."""
     return _Zero2(schedule, world, momentum, weight_decay, nesterov,
-                  wd_mask, axis_name)
+                  wd_mask, axis_name, bucket_elems)
+
+
+def zero2_oracle_flat(stacked, world: int, *, use_aps: bool = False,
+                      grad_exp: int = 8, grad_man: int = 23,
+                      use_kahan: bool = False, key=None,
+                      block_scale: bool = False, block_size: int = 128,
+                      bucket_elems: Optional[int] = None) -> jnp.ndarray:
+    """Single-device oracle for ZeRO-2's sharded reduce: given the
+    STACKED per-rank local gradients (leaves shaped ``(W, *leaf)``),
+    reproduce every rank's `_Zero2._grad_shard` output bit for bit and
+    return them concatenated in rank order — shape ``(W * S,)``,
+    ``S = Σ_b ceil(m_b / W)`` over the (optionally bucketed) layout.
+
+    Everything except the `all_to_all` wire is shared code: the APS
+    scaling/shift derivation, the per-tensor (`quantize_tree_sr`) and
+    blocked (`cast_body_blocked` + the codec's lossless pack round-trip)
+    pre-quantizers, the ordered `quantized_sum` scan with GLOBAL-offset
+    SR bits and the blocked scan casts, and the layout/shift-shard
+    helpers — so a divergence can only come from the transport itself,
+    exactly the `ring_oracle_sum` philosophy.  This is the fp32-oracle
+    gate of ISSUE 12 leg 1 (tests/test_zero.py + the reduce-smoke CI
+    arm in tools/bench_reduce.py)."""
+    z = _Zero2(lambda s: 0.0, world, 0.9, 0.0, False, None, "dp",
+               bucket_elems)
+    z._validate_precision("faithful",
+                          "stochastic" if key is not None else "nearest",
+                          key, block_scale, block_size, grad_exp,
+                          grad_man)
+    leaves = [jnp.asarray(l, jnp.float32) for l in jax.tree.leaves(stacked)]
+    template = [l[0] for l in leaves]
+    lay = z._layout(template)
+    k_pre = k_sum = None
+    if key is not None:
+        k_pre, k_sum, _ = jax.random.split(key, 3)
+    per_rank = []
+    for r in range(world):
+        segs = []
+        for b, idxs in enumerate(lay.buckets):
+            a, m, c = lay.meta[b]
+            shifts_b = None
+            if use_aps:
+                # max over the stacked (W, *leaf) leaves == the pmax of
+                # per-rank maxes the distributed path agrees on
+                max_exp = aps_max_exponents([leaves[i] for i in idxs],
+                                            float(world))
+                shifts_b = aps_shift_factors(max_exp, grad_exp)
+            rows_r = []
+            for j in range(world):
+                g_j = [leaves[i][j] for i in idxs]
+                k_pre_j = (jax.random.fold_in(k_pre, j)
+                           if k_pre is not None else None)
+                if use_aps:
+                    g_j = aps_scale(g_j, shifts_b)
+                    if not block_scale:
+                        g_j = quantize_tree_sr(
+                            g_j, grad_exp, grad_man, k_pre_j,
+                            starts=[lay.starts[i] for i in idxs])
+                flat = jnp.concatenate([l.reshape(-1) for l in g_j])
+                rows = pad_to_world(flat, world).reshape(world, c)
+                if block_scale:
+                    if k_pre_j is not None:
+                        local_idx = (jnp.arange(world * c,
+                                                dtype=jnp.uint32)
+                                     .reshape(world, c))
+                        row_offs = jnp.where(local_idx < jnp.uint32(m),
+                                             jnp.uint32(a) + local_idx,
+                                             jnp.uint32(lay.total))
+                        rbits = sr_bits_at(k_pre_j, row_offs)
+                        rows = cast_body_blocked(rows, grad_exp,
+                                                 grad_man, block_size,
+                                                 rbits=rbits)
+                    else:
+                        rows = cast_body_blocked(rows, grad_exp,
+                                                 grad_man, block_size)
+                rows_r.append(rows[r])
+            stack_r = jnp.stack(rows_r)
+            offs = None
+            if k_sum is not None:
+                local = (jnp.uint32(r) * jnp.uint32(c)
+                         + jnp.arange(c, dtype=jnp.uint32))
+                offs = jnp.where(local < jnp.uint32(m),
+                                 jnp.uint32(a) + local,
+                                 jnp.uint32(lay.total))
+            red = quantized_sum(stack_r, grad_exp, grad_man, use_kahan,
+                                key=k_sum, offsets=offs,
+                                block_size=(block_size if block_scale
+                                            else None))
+            if use_aps:
+                red = red / exp2_exact(
+                    z._bucket_shift_values(lay, b, shifts_b, r))
+            segs.append(red)
+        per_rank.append(jnp.concatenate(segs) if segs
+                        else jnp.zeros((0,), jnp.float32))
+    return jnp.concatenate(per_rank)
 
 
 class _Zero3(_Zero2):
@@ -431,6 +870,11 @@ class _Zero3(_Zero2):
     ShapeDtypeStructs); `to_pytree` recovers the pytree from the global
     flat array for eval/checkpoint interop.
     """
+
+    # ZeRO-3's update_fn has no pre_sharded path (its params ARE the
+    # shard; the gather/unflatten contract differs) — the inherited
+    # ZeRO-2 overlap hook must not be advertised or callable
+    supports_tap_reduce = False
 
     def __init__(self, schedule, world, momentum, weight_decay, nesterov,
                  wd_mask, axis_name, template):
@@ -522,6 +966,12 @@ class _Zero3(_Zero2):
             opt_state=Zero1State(jnp.zeros([], jnp.int32),
                                  jnp.zeros((self._total(),), jnp.float32)))
 
+    def make_tap_reduce(self, params, axis_name, quant_kw):
+        raise NotImplementedError(
+            "ZeRO-3 has no overlap tap hook: its update consumes the "
+            "param SHARD, not pre_sharded bucket gradients — run "
+            "without overlap_reduce (the ZeRO-2 updaters support it)")
+
     def update_fn(self, local_grads, state, axis_name: str, **quant_kw):
         """`state.params` is the (S,) flat shard; `local_grads` the local
         post-emulate grad pytree.  Returns (new shard, new opt state)."""
@@ -530,14 +980,14 @@ class _Zero3(_Zero2):
                 "ZeRO-3 folds the collective into the update: build the "
                 "step with make_train_step(..., reduce_in_update=True)")
         opt: Zero1State = state.opt_state
-        s = self._shard_size(self.template)
+        lay = self._layout(self.template)
         rank = lax.axis_index(axis_name)
         lr = self.schedule(opt.step)
 
         g_sh = self._grad_shard(local_grads, state, axis_name, **quant_kw)
         p_sh = state.params
         new_p_sh, new_buf = self._shard_update(g_sh, p_sh, self.template,
-                                               rank, s, opt.momentum, lr,
+                                               rank, lay, opt.momentum, lr,
                                                axis_name)
         return new_p_sh, Zero1State(opt.step + 1, new_buf)
 
@@ -585,12 +1035,11 @@ class _LarsRule:
 
     coefficient = 0.001
 
-    def _shard_update(self, g_sh, p_sh, template, rank, s, buf, lr,
+    def _shard_update(self, g_sh, p_sh, template, rank, lay, buf, lr,
                       axis_name):
         leaves = jax.tree.leaves(template)
         n = len(leaves)
-        leaf_idx = self._shard_leaf_index(template, rank, s).astype(
-            jnp.int32)
+        leaf_idx = self._shard_leaf_index(lay, rank).astype(jnp.int32)
         w_sq = jax.ops.segment_sum(p_sh * p_sh, leaf_idx,
                                    num_segments=n + 1)
         g_sq = jax.ops.segment_sum(g_sh * g_sh, leaf_idx,
@@ -619,10 +1068,16 @@ class _Zero3Lars(_LarsRule, _Zero3):
 
 
 def _lars_factory(cls, schedule, world, momentum, weight_decay,
-                  coefficient, axis_name, template=None):
+                  coefficient, axis_name, template=None,
+                  bucket_elems=None):
     args = (schedule, world, momentum, weight_decay, False, None,
             axis_name)
-    z = cls(*args, template) if template is not None else cls(*args)
+    if template is not None:
+        z = cls(*args, template)
+    elif bucket_elems is not None:
+        z = cls(*args, bucket_elems)     # _Zero2Lars's layout cap
+    else:
+        z = cls(*args)
     z.coefficient = coefficient
     return z
 
@@ -638,11 +1093,15 @@ def zero1_lars(schedule: Callable, world: int, momentum: float = 0.9,
 
 def zero2_lars(schedule: Callable, world: int, momentum: float = 0.9,
                weight_decay: float = 0.0, coefficient: float = 0.001,
-               axis_name: str = "dp") -> _Zero2Lars:
+               axis_name: str = "dp",
+               bucket_elems: Optional[int] = None) -> _Zero2Lars:
     """ZeRO-2 LARS: momentum + faithful reduction sharded; pair with
-    ``make_train_step(..., reduce_in_update=True)``."""
+    ``make_train_step(..., reduce_in_update=True)``.  ``bucket_elems``
+    opts into the per-bucket flat layout (the overlap taps'
+    boundaries, as on `zero2_sgd`)."""
     return _lars_factory(_Zero2Lars, schedule, world, momentum,
-                         weight_decay, coefficient, axis_name)
+                         weight_decay, coefficient, axis_name,
+                         bucket_elems=bucket_elems)
 
 
 def zero3_lars(schedule: Callable, world: int, template,

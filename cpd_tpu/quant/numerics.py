@@ -396,7 +396,8 @@ def wire_bytes(exp_bits: int, man_bits: int) -> int:
 
 
 def kv_page_bytes(exp_bits: int, man_bits: int, page_size: int,
-                  n_kv_heads: int, head_dim: int) -> int:
+                  n_kv_heads: int, head_dim: int,
+                  block_size=None) -> int:
     """Bytes of ONE layer's K+V KV-cache page in the packed eXmY codec.
 
     The analytic sibling of `wire_bytes` for the serving stack's paged
@@ -408,14 +409,27 @@ def kv_page_bytes(exp_bits: int, man_bits: int, page_size: int,
     format; tests pin it against the actual packed page-pool slice.
     Applies the full packed-wire validation (`_validate_wire`, incl.
     the man >= 2 special-code rule): a page count for a format the
-    packed cache cannot store would be a lie."""
+    packed cache cannot store would be a lie.
+
+    ``block_size`` prices the BLOCK-SCALED page (ISSUE 12): each K/V
+    row (one token position's n_kv_heads·head_dim elements) carries its
+    `sidecar_bytes` shift lane next to the code words — the sidecar is
+    EXPLICIT here, and the test pins this against the real blocked pool
+    slice so the analytics can never silently under-report KV memory."""
     if page_size < 1 or n_kv_heads < 1 or head_dim < 1:
         raise ValueError(
             f"page_size/n_kv_heads/head_dim must be >= 1, got "
             f"({page_size}, {n_kv_heads}, {head_dim})")
     _validate_wire(exp_bits, man_bits)
-    return 2 * page_size * n_kv_heads * head_dim * wire_bytes(exp_bits,
-                                                              man_bits)
+    n = n_kv_heads * head_dim
+    row = n * wire_bytes(exp_bits, man_bits)
+    if block_size is not None:
+        if exp_bits == 8 and man_bits == 23:
+            raise ValueError("block_size at (8, 23): the fp32 byte split "
+                             "has nothing to scale — no blocked page "
+                             "exists to price")
+        row += sidecar_bytes(n, block_size)
+    return 2 * page_size * row
 
 
 def _validate_wire(exp_bits: int, man_bits: int) -> None:

@@ -339,10 +339,15 @@ def sat_pressure_factor(table, step):
     indexing cannot drift between them.  Entry 0 -> 2^0 == 1.0, an
     exact fp32 no-op; steps past the table are unpressured."""
     import jax.numpy as jnp
+
+    from ..parallel.aps import exp2_exact
     exps = jnp.asarray(table, jnp.int32)
     idx = jnp.clip(step, 0, exps.shape[0] - 1)
     e = jnp.where(step < exps.shape[0], exps[idx], 0)
-    return jnp.exp2(e.astype(jnp.float32))
+    # exp2_exact, not jnp.exp2: the factor must be the EXACT power of
+    # two the attack documents (XLA:CPU's exp2 is off by an ulp for
+    # most negative integers — parallel/aps.py)
+    return exp2_exact(e.astype(jnp.float32))
 
 
 # ---------------------------------------------------------------------------

@@ -215,6 +215,13 @@ class ServeEngine:
     kv_format : (exp_bits, man_bits) eXmY cache codec; (8, 23) is the
         lossless byte split, e5m2/e4m3 the 4x-compressed formats.
     raw_cache : fp32 pool, no codec — the bitwise oracle for (8, 23).
+    kv_block_size : block-scale the pages (ISSUE 12): each K/V row is
+        blocked-cast with one power-of-2 scale per this many elements
+        and stored as codes + shift sidecar inside the page (so digests,
+        scrubs, repair and snapshots cover the sidecar for free).
+        Extends an e4m3/e5m2 page's dynamic range at ~3% extra bytes
+        (`kv_page_bytes(block_size=...)` prices it); None = per-tensor
+        pages (the PR 7 layout).  Needs a sub-fp32 kv_format.
     prefill_chunk : prompt tokens per prefill dispatch (a degradation
         rung may cap the VALID tokens per dispatch below this; the
         compiled chunk shape never changes).
@@ -245,6 +252,7 @@ class ServeEngine:
                  max_seq: int = 128, page_size: int = 16,
                  n_pages: Optional[int] = None,
                  kv_format: tuple = (8, 23), raw_cache: bool = False,
+                 kv_block_size: Optional[int] = None,
                  prefill_chunk: int = 16, scrub_every: int = 0,
                  fault_plan=None, supervisor: Optional[ServeSupervisor]
                  = None, max_queue: Optional[int] = None,
@@ -266,7 +274,10 @@ class ServeEngine:
         self._init_kw = dict(
             n_slots=n_slots, max_seq=max_seq, page_size=page_size,
             n_pages=n_pages, kv_format=[int(exp_bits), int(man_bits)],
-            raw_cache=bool(raw_cache), prefill_chunk=prefill_chunk,
+            raw_cache=bool(raw_cache),
+            kv_block_size=(int(kv_block_size)
+                           if kv_block_size is not None else None),
+            prefill_chunk=prefill_chunk,
             scrub_every=scrub_every, max_queue=max_queue,
             stall_patience=stall_patience, finished_cap=finished_cap,
             temperature=float(temperature), seed=int(seed),
@@ -274,7 +285,10 @@ class ServeEngine:
         self.cfg = KVCacheConfig(
             n_layers=spec.n_layers, n_kv_heads=spec.kv_heads,
             head_dim=spec.head_dim, page_size=page_size, n_pages=n_pages,
-            exp_bits=exp_bits, man_bits=man_bits, raw=raw_cache)
+            exp_bits=exp_bits, man_bits=man_bits, raw=raw_cache,
+            block_scale=kv_block_size is not None,
+            block_size=(int(kv_block_size)
+                        if kv_block_size is not None else 32))
         self.spec = spec
         self.params = params
         self.sched = Scheduler(n_slots, n_pages, page_size, max_pages,
@@ -775,6 +789,12 @@ class ServeEngine:
             bits = old.view(np.uint32) ^ np.uint32(0xFF)
             self._pool = self._pool.at[0, pid, 0, 0, 0, 0].set(
                 float(bits.view(np.float32)))
+        elif self.cfg.block_scale:
+            # blocked pool rows are flat byte vectors (codes + sidecar):
+            # flip the row's first code byte
+            old = self._pool[0, pid, 0, 0, 0]
+            self._pool = self._pool.at[0, pid, 0, 0, 0].set(
+                old ^ np.uint8(0xFF))
         else:
             old = self._pool[0, pid, 0, 0, 0, 0, 0]
             self._pool = self._pool.at[0, pid, 0, 0, 0, 0, 0].set(

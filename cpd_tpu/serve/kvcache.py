@@ -55,7 +55,9 @@ import jax.numpy as jnp
 
 from ..parallel.integrity import wire_digest
 from ..quant.numerics import (_validate_wire, cast_to_format,
-                              kv_page_bytes, pack_exmy, unpack_exmy,
+                              kv_page_bytes, pack_exmy,
+                              pack_exmy_blocked, sidecar_bytes,
+                              unpack_exmy, unpack_exmy_blocked,
                               wire_bytes)
 
 __all__ = ["KVCacheConfig", "alloc_pool", "pack_kv", "unpack_kv",
@@ -67,7 +69,21 @@ TRASH_PAGE = 0   # reserved page id for masked writes; never allocated
 
 @dataclasses.dataclass(frozen=True)
 class KVCacheConfig:
-    """Static shape/format description of one paged KV pool."""
+    """Static shape/format description of one paged KV pool.
+
+    ``block_scale`` (ISSUE 12 leg 2) switches each K/V row (one token
+    position's ``n_kv_heads * head_dim`` elements) to the BLOCK-SCALED
+    codec: the row is `cast_body_blocked` at append (one power-of-2
+    scale per ``block_size`` consecutive elements of the flattened row,
+    odd tail block included) and stored as `pack_exmy_blocked`'s flat
+    wire — code bytes followed by the 1-byte-per-block shift sidecar —
+    so an e4m3 page covers dynamic range a per-tensor e5m2 page cannot
+    (the bench_reduce frontier trade applied to KV memory, the serving
+    capacity ceiling).  The sidecar lives INSIDE the row, hence inside
+    the page pool: every page digest, scrub, corruption check and
+    snapshot covers it with zero extra machinery, and `kv_page_bytes`
+    (block_size=...) prices it.  Requires a packable sub-fp32 format —
+    at (8, 23) there is nothing to scale and the config is rejected."""
     n_layers: int
     n_kv_heads: int
     head_dim: int
@@ -76,6 +92,8 @@ class KVCacheConfig:
     exp_bits: int = 8
     man_bits: int = 23
     raw: bool = False     # fp32 pool, no codec — the oracle cache
+    block_scale: bool = False
+    block_size: int = 32
 
     def __post_init__(self):
         if self.page_size < 1:
@@ -83,12 +101,24 @@ class KVCacheConfig:
         if self.n_pages < 2:
             raise ValueError("n_pages must be >= 2 (page 0 is the trash "
                              f"page), got {self.n_pages}")
+        if self.block_scale and self.raw:
+            raise ValueError("block_scale=True with raw=True: the fp32 "
+                             "oracle pool has no codec to scale")
         if self.raw:
             return
         # the ONE packed-wire validator (numerics._validate_wire — the
         # man>=2 special-code rule included), eagerly at config build
         # time rather than mid-trace; no copy of the rule to drift
         _validate_wire(self.exp_bits, self.man_bits)
+        if self.block_scale:
+            if (self.exp_bits, self.man_bits) == (8, 23):
+                raise ValueError(
+                    "block_scale=True at (8, 23): the lossless byte "
+                    "split has nothing to scale — drop block_scale or "
+                    "pick a sub-fp32 format")
+            if self.block_size < 1:
+                raise ValueError(f"block_size must be >= 1, got "
+                                 f"{self.block_size}")
 
     @property
     def fmt(self) -> tuple:
@@ -99,16 +129,37 @@ class KVCacheConfig:
         return 4 if self.raw else wire_bytes(self.exp_bits, self.man_bits)
 
     @property
+    def row_elems(self) -> int:
+        """K or V elements of one token position (the blocked codec's
+        row length)."""
+        return self.n_kv_heads * self.head_dim
+
+    @property
+    def row_bytes(self) -> int:
+        """Stored bytes of one token position's K (or V) row in the
+        BLOCKED layout: code bytes + the shift sidecar."""
+        return (self.row_elems * self.word_bytes
+                + sidecar_bytes(self.row_elems, self.block_size))
+
+    @property
     def page_bytes(self) -> int:
         """One layer's K+V bytes per page — `quant.numerics.kv_page_bytes`
         is the single source of truth; the pool slice must agree."""
         if self.raw:
             return 2 * self.page_size * self.n_kv_heads * self.head_dim * 4
         return kv_page_bytes(self.exp_bits, self.man_bits, self.page_size,
-                             self.n_kv_heads, self.head_dim)
+                             self.n_kv_heads, self.head_dim,
+                             block_size=(self.block_size if self.block_scale
+                                         else None))
 
     @property
     def pool_shape(self) -> tuple:
+        if self.block_scale:
+            # rows are flat blocked-wire byte vectors (codes + sidecar):
+            # the per-element (H, D, WB) structure dissolves into the
+            # codec's own layout, and the sidecar rides inside the page
+            return (self.n_layers, self.n_pages, 2, self.page_size,
+                    self.row_bytes)
         base = (self.n_layers, self.n_pages, 2, self.page_size,
                 self.n_kv_heads, self.head_dim)
         return base if self.raw else base + (self.word_bytes,)
@@ -122,21 +173,35 @@ def alloc_pool(cfg: KVCacheConfig) -> jnp.ndarray:
 
 def pack_kv(x: jnp.ndarray, cfg: KVCacheConfig) -> jnp.ndarray:
     """fp32 K or V block (..., H_kv, D) -> quantized packed code words
-    (..., H_kv, D, WB) (raw oracle: the fp32 values unchanged).
+    (..., H_kv, D, WB), or the flat blocked row (..., row_bytes) when
+    ``cfg.block_scale`` (raw oracle: the fp32 values unchanged).
     Quantize-on-append: the cast runs HERE, once per token, so attention
     reads the same value set no matter how often it re-reads a page."""
     x = jnp.asarray(x, jnp.float32)
     if cfg.raw:
         return x
+    if cfg.block_scale:
+        rows = x.reshape(x.shape[:-2] + (cfg.row_elems,))
+        # pack_exmy_blocked IS the blocked cast + pack in one: the shift
+        # derivation is a fixed point of the cast, so decode reproduces
+        # cast_body_blocked(row) bit for bit (numerics block comment)
+        return pack_exmy_blocked(rows, cfg.exp_bits, cfg.man_bits,
+                                 cfg.block_size)
     q = cast_to_format(x, cfg.exp_bits, cfg.man_bits)
     return pack_exmy(q, cfg.exp_bits, cfg.man_bits)
 
 
 def unpack_kv(packed: jnp.ndarray, cfg: KVCacheConfig) -> jnp.ndarray:
-    """Inverse of `pack_kv`'s packing: (..., WB) uint8 -> (...) fp32 with
-    the exact bit patterns the append-time cast produced."""
+    """Inverse of `pack_kv`'s packing: (..., WB) uint8 (or the flat
+    blocked (..., row_bytes) row) -> (..., H_kv, D) fp32 with the exact
+    bit patterns the append-time cast produced."""
     if cfg.raw:
         return packed
+    if cfg.block_scale:
+        rows = unpack_exmy_blocked(packed, cfg.exp_bits, cfg.man_bits,
+                                   cfg.row_elems, cfg.block_size)
+        return rows.reshape(rows.shape[:-1] + (cfg.n_kv_heads,
+                                               cfg.head_dim))
     return unpack_exmy(packed, cfg.exp_bits, cfg.man_bits)
 
 
@@ -144,7 +209,8 @@ def write_kv(pool: jnp.ndarray, layer: int, k: jnp.ndarray, v: jnp.ndarray,
              page_ids: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
     """Scatter already-packed K/V rows into layer ``layer``'s pages.
 
-    k, v: (N, H_kv, D, WB) uint8 — one row per token position;
+    k, v: (N, H_kv, D, WB) uint8 — or the flat blocked (N, row_bytes)
+    rows when the config block-scales — one row per token position;
     page_ids, offsets: (N,) int32 — target page and in-page slot per row
     (masked rows point at TRASH_PAGE; duplicate trash targets are
     harmless, every lane writes garbage nobody reads)."""

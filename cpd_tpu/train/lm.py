@@ -88,8 +88,11 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     per-bucket taps run the dp reduction inside the backward; each
     leaf's sp psum (and tp psum for replicated params) moves INTO its
     bucket's tap, so the whole per-leaf reduction chain starts when
-    that bucket closes.  Bitwise identical to the monolithic step;
-    requires emulate_node == 1.
+    that bucket closes.  Bitwise identical to the monolithic step.
+    Composes with emulate_node > 1 (ISSUE 12): the first N-1
+    micro-batches run unrolled and their sp/tp-reduced stacked grads
+    ride into the last micro-batch's taps, whose per-bucket
+    emulate-node reduce + dp collective fire as each bucket closes.
 
     block_scale / block_size: the EQuARX-style block-scaled ring wire
     for the dp reduction, exactly as on `make_train_step` — ring mode
@@ -102,13 +105,6 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                          f"{label_smoothing}")
     if grad_rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
-    if overlap_reduce and emulate_node != 1:
-        raise ValueError(
-            f"overlap_reduce=True requires emulate_node == 1 (got "
-            f"{emulate_node}): the micro-batch scan is a barrier that "
-            f"defeats the overlapped schedule, and in-backward taps "
-            f"would reduce once per micro-batch instead of once per "
-            f"step")
     if block_scale and mode != "ring":
         raise ValueError(
             f"block_scale=True needs mode='ring' (got {mode!r}): the "
@@ -205,7 +201,10 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # Bucketed dependency-scheduled transport (parallel/
             # overlap.py): per-bucket taps own the WHOLE per-leaf
             # reduction chain — sp psum, tp psum for replicated params
-            # (leaf_pre), sat pressure, then the dp quantized collective
+            # (leaf_pre), sat pressure, emulate-node reduce (n > 1:
+            # micro-batches 0..N-2 run unrolled and their sp/tp-reduced
+            # stacked grads ride into the LAST micro-batch's taps as
+            # extras, ISSUE 12 leg 3), then the dp quantized collective
             # — so a bucket's work starts the moment its last cotangent
             # closes.  Bitwise identical to the monolithic path below.
             from ..parallel.overlap import BucketPlan, overlapped_grads
@@ -216,9 +215,46 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             def leaf_pre(g, i):
                 return sp_tp_reduce(g, specs_flat[i])
 
+            extras = emulate_fn = emu_key = None
+            micro_sums, micro_ns, micro_hits = [], [], []
+            if n > 1:
+                toks_u = tokens.reshape(n, mb, tokens.shape[1])
+                tgts_u = targets.reshape(n, mb, targets.shape[1])
+                prev = []
+                for mi in range(n - 1):
+                    (_, (s_mi, n_mi, h_mi)), g_mi = jax.value_and_grad(
+                        loss_of, has_aux=True)(state.params, toks_u[mi],
+                                               tgts_u[mi], jnp.int32(mi))
+                    micro_sums.append(s_mi)
+                    micro_ns.append(n_mi)
+                    micro_hits.append(h_mi)
+                    prev.append(jax.tree_util.tree_leaves(g_mi))
+                # sp/tp-reduce + sat-scale the prior micros here (the
+                # taps apply leaf_pre/aux[0] to the LAST micro's
+                # cotangent only) — elementwise psums, so per-micro
+                # equals the monolith's stacked psum bit for bit
+                extras = []
+                for i in range(len(plan.sizes)):
+                    st = jnp.stack([prev[mi][i] for mi in range(n - 1)])
+                    st = sp_tp_reduce(st, specs_flat[i])
+                    if sfac is not None:
+                        st = st * sfac
+                    extras.append(st)
+                if sr:
+                    emu_key = jax.random.fold_in(
+                        grad_sr_key(grad_seed, state.step, 0),
+                        lax.axis_index(axis_dp).astype(jnp.int32))
+                from ..parallel.emulate import make_overlap_emulate_fn
+                emulate_fn = make_overlap_emulate_fn(
+                    n, use_aps, grad_exp, grad_man, sr)
+                tk_last, tg_last = toks_u[n - 1], tgts_u[n - 1]
+                last_idx = jnp.int32(n - 1)
+            else:
+                tk_last, tg_last = tokens, targets
+                last_idx = jnp.zeros([], jnp.int32)
+
             def loss_closure(p):
-                loss, aux = loss_of(p, tokens, targets,
-                                    jnp.zeros([], jnp.int32))
+                loss, aux = loss_of(p, tk_last, tg_last, last_idx)
                 return loss, aux
 
             ((_, (l_sum, l_n, l_hits)), reduced,
@@ -232,10 +268,11 @@ def make_lm_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                                block_size=block_size),
                 key=sum_key, sat_factor=sfac, wire_fault=wf,
                 verify=verify_reduce, stats=quant_stats,
-                leaf_pre=leaf_pre)
-            sums = l_sum[None]
-            ns = l_n[None]
-            hits = l_hits[None]
+                leaf_pre=leaf_pre, collective=None, extras=extras,
+                emulate_reduce=emulate_fn, emulate_key=emu_key)
+            sums = jnp.stack(micro_sums + [l_sum])
+            ns = jnp.stack(micro_ns + [l_n])
+            hits = jnp.stack(micro_hits + [l_hits])
         else:
             toks = tokens.reshape(n, mb, tokens.shape[1])
             tgts = targets.reshape(n, mb, targets.shape[1])

@@ -106,6 +106,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                     reduce_in_update: bool = False,
                     params_spec: Optional[Any] = None,
                     unpack_params: Optional[Callable] = None,
+                    tap_reduce: Optional[Callable] = None,
                     verify_reduce: bool = False,
                     wire_fault_plan: Optional[tuple] = None,
                     quant_stats: bool = False,
@@ -169,23 +170,43 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
     BITWISE identical to the non-overlapped step (tests/test_overlap.py);
     verify/stats reports ride out of the backward on the tap-cotangent
     channel, and sat_pressure / wire faults keep firing (wire faults hit
-    bucket 0 only, preserving exact drill counters).  Requires
-    emulate_node == 1 (the micro-batch scan is itself a barrier — and
-    its taps would otherwise reduce once per micro-batch) and the step's
-    own collective (not reduce_in_update).  bucket_elems caps the bucket
-    size for BOTH the overlapped taps and the post-backward
+    bucket 0 only, preserving exact drill counters).  bucket_elems caps
+    the bucket size for BOTH the overlapped taps and the post-backward
     bucketed/ring layouts (default: parallel/dist._BUCKET_ELEMS).
+
+    overlap_reduce composes with emulate_node > 1 (ISSUE 12): the first
+    N-1 micro-batches run as an unrolled value_and_grad chain (same
+    sequential BN-stat order as the scan) and their stacked gradients
+    ride into the LAST micro-batch's taps, where each bucket's
+    rank-local emulate-node reduce + cross-device collective fire as
+    that bucket's final cotangent closes.  Gradients and therefore
+    PARAMS are bitwise identical to the scan + post-backward monolith
+    (tests/test_overlap.py); BN running stats agree to the last ulp
+    only — XLA fuses the scanned vs unrolled forward differently, and a
+    batch-mean reduction can differ in its final bit (training-mode BN
+    normalizes by the batch stats, so gradients never see the drift).
+
+    overlap_reduce also composes with reduce_in_update when the updater
+    provides the ``tap_reduce`` hook (ZeRO-2's
+    `zero2_sgd(...).mesh_layout` wires it): the taps run the updater's
+    per-bucket all_to_all reduce-scatter inside the backward and
+    `update_fn` consumes the extracted bucket shards
+    (``pre_sharded=True``) — bitwise identical to the post-backward
+    reduce_in_update monolith at a fixed bucket layout.
 
     block_scale / block_size thread the EQuARX-style block-scaled ring
     wire (`sum_gradients(block_scale=...)`, quant/numerics.py
     "Block-scaled eXmY codec"): every hop cast shares one power-of-2
     scale per `block_size` consecutive elements and the 1-byte-per-block
     shift sidecar rides the packed wire.  Ring mode only (validated at
-    build time — the other transports have no sidecar lane), and a
-    DIFFERENT documented accumulation numerics than per-tensor: steps
-    with and without it are distinct StepTable entries
-    (`ladder_step_key(block=...)`).  Composes with overlap_reduce —
-    overlap on/off stays bitwise identical with block scaling on.
+    build time — the other transports have no sidecar lane), EXCEPT
+    with reduce_in_update, where the pair is forwarded to the updater
+    and ZeRO-2's faithful all_to_all carries the blocked wire instead
+    (parallel/zero.py, ISSUE 12 leg 1).  A DIFFERENT documented
+    accumulation numerics than per-tensor: steps with and without it
+    are distinct StepTable entries (`ladder_step_key(block=...)`).
+    Composes with overlap_reduce — overlap on/off stays bitwise
+    identical with block scaling on.
     """
     if grad_rounding not in ("nearest", "stochastic"):
         raise ValueError(f"unknown grad_rounding {grad_rounding!r}")
@@ -217,26 +238,22 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                          "sum_gradients call; reduce_in_update hands the "
                          "collective to the updater (ZeRO-2/3), which "
                          "does not thread a telemetry report")
-    if overlap_reduce and emulate_node != 1:
+    if tap_reduce is not None and not reduce_in_update:
+        raise ValueError("tap_reduce is the ZeRO-2 overlap hook — it "
+                         "only makes sense with reduce_in_update=True")
+    if overlap_reduce and reduce_in_update and tap_reduce is None:
         raise ValueError(
-            f"overlap_reduce=True requires emulate_node == 1 (got "
-            f"{emulate_node}): the micro-batch scan is a barrier that "
-            f"defeats the overlapped schedule, and in-backward taps "
-            f"would reduce once per micro-batch instead of once per "
-            f"step")
-    if overlap_reduce and reduce_in_update:
-        raise ValueError("overlap_reduce=True runs the collective inside "
-                         "the backward taps; reduce_in_update hands it "
-                         "to the updater (ZeRO-2/3) — pick one owner")
-    if block_scale and mode != "ring":
+            "overlap_reduce=True with reduce_in_update needs the "
+            "updater's tap_reduce hook (zero2_sgd's mesh_layout wires "
+            "it); ZeRO-3 and other custom updaters without one own the "
+            "whole post-backward collective — run without "
+            "overlap_reduce")
+    if block_scale and mode != "ring" and not reduce_in_update:
         raise ValueError(
             f"block_scale=True needs mode='ring' (got {mode!r}): the "
-            f"per-block scale sidecar rides the ring's packed wire")
-    if block_scale and reduce_in_update:
-        raise ValueError("block_scale=True needs the step's own "
-                         "sum_gradients call; reduce_in_update hands the "
-                         "collective to the updater (ZeRO-2/3), whose "
-                         "reduce-scatter has no block-scaled wire")
+            f"per-block scale sidecar rides the ring's packed wire "
+            f"(with reduce_in_update the ZeRO-2 updater's all_to_all "
+            f"carries it instead — parallel/zero.py)")
     has_stats_cache: dict = {}
 
     def make_loss_of(world, scale):
@@ -356,6 +373,7 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             from ..resilience.inject import sat_pressure_factor
             sfac = sat_pressure_factor(sat_fault_plan, state.step)
         vreport = None
+        pre_sharded_vec = None
         if overlap_reduce:
             # Bucketed, dependency-scheduled transport: the reduction
             # runs INSIDE the backward via per-bucket custom_vjp taps
@@ -363,17 +381,96 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # post-backward path below, but each bucket's collective is
             # emitted the moment its last cotangent closes, so XLA may
             # overlap ring hops with the remaining backward compute.
-            from ..parallel.overlap import BucketPlan, overlapped_grads
-            if images.shape[0] < 1:
-                raise ValueError("empty per-device batch")
-            plan = BucketPlan.for_tree(model_params, bucket_elems)
-            rngs = micro_rngs(state.step, jnp.zeros([], jnp.int32))
+            #
+            # emulate_node > 1 (ISSUE 12 leg 3): micro-batches 0..N-2
+            # run as a plain unrolled value_and_grad chain (same
+            # sequential BN-stat order as the monolith's scan); their
+            # stacked gradients ride into the LAST micro-batch's taps as
+            # extras, where each bucket's rank-local emulate-node reduce
+            # + cross-device collective fire the moment that bucket's
+            # final cotangent closes — the collectives overlap the last
+            # backward instead of waiting behind the whole scan.
+            #
+            # reduce_in_update + tap_reduce (ZeRO-2): the taps run the
+            # updater's per-bucket reduce-scatter (`make_tap_reduce`)
+            # and the update consumes the extracted bucket shards.
+            from ..parallel.overlap import (BucketPlan,
+                                            extract_bucket_shards,
+                                            overlapped_grads)
+            n = emulate_node
+            if images.shape[0] < n or images.shape[0] % n:
+                raise ValueError(
+                    f"per-device batch {images.shape[0]} must be a "
+                    f"positive multiple of emulate_node={n}")
+            if tap_reduce is not None:
+                plan, tap_chunks, tap_collective = tap_reduce(
+                    model_params,  axis_name,
+                    dict(use_aps=use_aps, grad_exp=grad_exp,
+                         grad_man=grad_man, use_kahan=use_kahan,
+                         mode=mode, rounding=grad_rounding,
+                         block_scale=block_scale, block_size=block_size))
+                if (bucket_elems is not None
+                        and plan.bucket_elems != bucket_elems):
+                    # the tap plan comes SOLELY from the updater's
+                    # layout (the update must consume the same shards
+                    # the taps produce) — a step-side cap that differs
+                    # would be a silently ignored tuning knob, the
+                    # exact hazard the old CLI fail-fast rejected
+                    raise ValueError(
+                        f"bucket_elems={bucket_elems} does not match "
+                        f"the ZeRO updater's bucket layout (cap "
+                        f"{plan.bucket_elems}): with reduce_in_update "
+                        f"the tap plan comes from the updater — pass "
+                        f"the same value to zero2_sgd(bucket_elems=)")
+            else:
+                plan = BucketPlan.for_tree(model_params, bucket_elems)
+                tap_chunks = tap_collective = None
             loss_of = make_loss_of(world, scale)
+            stats_c = state.batch_stats
+            extras = emulate_fn = emu_key = None
+            micro_losses, micro_correct, micro_counted = [], [], []
+            if n > 1:
+                mb = images.shape[0] // n
+                imgs = images.reshape(n, mb, *images.shape[1:])
+                lbls = labels.reshape(n, mb, *labels.shape[1:])
+                prev = []
+                for mi in range(n - 1):
+                    rngs_mi = micro_rngs(state.step, jnp.int32(mi))
+                    (_, (lg, stats_c, l_mi)), g_mi = jax.value_and_grad(
+                        loss_of, has_aux=True)(model_params, stats_c,
+                                               imgs[mi], lbls[mi],
+                                               rngs_mi)
+                    c_mi, n_mi = _count_hits(lg, lbls[mi])
+                    micro_losses.append(l_mi)
+                    micro_correct.append(c_mi)
+                    micro_counted.append(n_mi)
+                    prev.append(jax.tree_util.tree_leaves(g_mi))
+                extras = [jnp.stack([prev[mi][i] for mi in range(n - 1)])
+                          for i in range(len(plan.sizes))]
+                if sfac is not None:
+                    # the monolith scales the whole stacked-grad tensor;
+                    # the taps scale the last micro's cotangent (aux[0])
+                    # — scale the prior micros here so every micro sees
+                    # the same 2^k pressure
+                    extras = [e * sfac for e in extras]
+                if sr:
+                    emu_key = jax.random.fold_in(
+                        grad_sr_key(grad_seed, state.step, 0),
+                        lax.axis_index(axis_name).astype(jnp.int32))
+                from ..parallel.emulate import make_overlap_emulate_fn
+                emulate_fn = make_overlap_emulate_fn(
+                    n, use_aps, grad_exp, grad_man, sr)
+                x_last, y_last = imgs[n - 1], lbls[n - 1]
+                rngs = micro_rngs(state.step, jnp.int32(n - 1))
+            else:
+                x_last, y_last = images, labels
+                rngs = micro_rngs(state.step, jnp.zeros([], jnp.int32))
+            base_stats = stats_c
 
             def loss_closure(p):
-                return loss_of(p, state.batch_stats, images, labels, rngs)
+                return loss_of(p, base_stats, x_last, y_last, rngs)
 
-            ((_, (logits, new_stats, loss)), reduced,
+            ((_, (logits, new_stats, loss_last)), reduced,
              vreport) = overlapped_grads(
                 loss_closure, model_params, axis_name=axis_name,
                 plan=plan,
@@ -384,8 +481,17 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
                                block_scale=block_scale,
                                block_size=block_size),
                 key=sum_key, sat_factor=sfac, wire_fault=wf,
-                verify=verify_reduce, stats=quant_stats)
-            correct, counted = _count_hits(logits, labels)
+                verify=verify_reduce, stats=quant_stats,
+                collective=tap_collective, extras=extras,
+                emulate_reduce=emulate_fn, emulate_key=emu_key)
+            c_last, n_last = _count_hits(logits, y_last)
+            # same associativity as the monolith's stacked-sum metrics
+            loss = jnp.stack(micro_losses + [loss_last]).sum()
+            correct = jnp.stack(micro_correct + [c_last]).sum()
+            counted = jnp.stack(micro_counted + [n_last]).sum()
+            if tap_collective is not None:
+                pre_sharded_vec = extract_bucket_shards(reduced, plan,
+                                                        tap_chunks)
         else:
             stacked, new_stats, loss, correct, counted = local_micro_grads(
                 model_params, state.batch_stats, images, labels, world,
@@ -434,12 +540,21 @@ def make_train_step(model, tx: optax.GradientTransformation, mesh: Mesh,
             # fold the replicated path hands sum_gradients, so a ZeRO
             # reduce-scatter draws exactly the bits the replicated
             # faithful reduction would (parallel/zero.py).
-            quant_kw = dict(use_aps=use_aps, grad_exp=grad_exp,
-                            grad_man=grad_man, use_kahan=use_kahan,
-                            mode=mode, rounding=grad_rounding,
-                            key=sum_key) if reduce_in_update else {}
-            new_params, new_opt = update_fn(reduced, state, axis_name,
-                                            **quant_kw)
+            if pre_sharded_vec is not None:
+                # ZeRO-2 overlap: the taps already ran the per-bucket
+                # reduce-scatter — the update just consumes the shards
+                new_params, new_opt = update_fn(pre_sharded_vec, state,
+                                                axis_name,
+                                                pre_sharded=True)
+            else:
+                quant_kw = dict(use_aps=use_aps, grad_exp=grad_exp,
+                                grad_man=grad_man, use_kahan=use_kahan,
+                                mode=mode, rounding=grad_rounding,
+                                key=sum_key, block_scale=block_scale,
+                                block_size=block_size) \
+                    if reduce_in_update else {}
+                new_params, new_opt = update_fn(reduced, state, axis_name,
+                                                **quant_kw)
         else:
             updates, new_opt = tx.update(reduced, state.opt_state,
                                          state.params)

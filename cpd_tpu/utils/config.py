@@ -61,8 +61,11 @@ def add_transport_flags(parser: argparse.ArgumentParser) -> None:
                         "moment the bucket's last gradient closes, so "
                         "XLA can overlap ring hops with backward "
                         "compute.  Bitwise identical to the "
-                        "post-backward reduction; requires "
-                        "--emulate_node 1")
+                        "post-backward reduction.  Composes with "
+                        "--emulate_node > 1 (unrolled micro chain "
+                        "feeding the last micro-batch's taps) and with "
+                        "--zero1/--zero2 (ZeRO-2 runs its per-bucket "
+                        "all_to_all reduce-scatter inside the taps)")
     g.add_argument("--bucket-elems", default=None, type=int,
                    help="per-bucket element cap for the bucketed "
                         "faithful gather, the bucketed ring and the "

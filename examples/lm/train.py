@@ -301,10 +301,9 @@ def main(argv=None) -> dict:
         raise SystemExit("--overlap-reduce/--bucket-elems are wired to "
                          "the default dp/sp/tp path only (the pp/moe "
                          "steppers have their own schedules)")
-    if args.overlap_reduce and args.emulate_node != 1:
-        raise SystemExit("--overlap-reduce requires --emulate_node 1: "
-                         "the micro-batch scan is a barrier that "
-                         "defeats the overlapped schedule")
+    # ISSUE 12: --overlap-reduce composes with --emulate_node > 1 now
+    # (the unrolled micro chain feeds the last micro-batch's taps) —
+    # the old fail-fast is gone
     if args.block_scale and args.mode != "ring":
         raise SystemExit("--block-scale needs --mode ring: the per-block "
                          "scale sidecar rides the ring's packed wire")

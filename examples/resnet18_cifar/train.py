@@ -214,30 +214,19 @@ def main(argv=None) -> dict:
                          "the ZeRO updaters own the collective "
                          "(reduce_in_update) — run without --zero1/"
                          "--zero2")
-    if args.overlap_reduce and (args.zero1 or args.zero2):
-        raise SystemExit("--overlap-reduce runs the collective inside "
-                         "the backward taps; the ZeRO updaters own it "
-                         "(reduce_in_update) — pick one")
-    if args.bucket_elems is not None and (args.zero1 or args.zero2):
-        # same ownership conflict as --overlap-reduce: the ZeRO updaters
-        # never see bucket_elems, and a silently ignored tuning knob is
-        # worse than an error
-        raise SystemExit("--bucket-elems tunes the step's own reduction; "
-                         "the ZeRO updaters own the collective "
-                         "(reduce_in_update) — run without --zero1/"
-                         "--zero2")
-    if args.overlap_reduce and args.emulate_node != 1:
-        raise SystemExit("--overlap-reduce requires --emulate_node 1: "
-                         "the micro-batch scan is a barrier that "
-                         "defeats the overlapped schedule")
-    if args.block_scale and args.mode != "ring":
-        raise SystemExit("--block-scale needs --mode ring: the per-block "
-                         "scale sidecar rides the ring's packed wire")
-    if args.block_scale and (args.zero1 or args.zero2):
-        raise SystemExit("--block-scale tunes the step's own ring "
-                         "reduction; the ZeRO updaters own the collective "
-                         "(reduce_in_update) — run without --zero1/"
-                         "--zero2")
+    # ISSUE 12 lifted the PR 8 fail-fasts: --bucket-elems/--overlap-reduce
+    # compose with --zero1 (the update slices the step's fully-reduced
+    # grads) AND --zero2 (zero2_sgd(bucket_elems=...) adopts the bucketed
+    # flat layout and its make_tap_reduce hook runs the per-bucket
+    # reduce-scatter inside the backward taps); --overlap-reduce also
+    # composes with --emulate_node > 1 (the unrolled micro chain feeds
+    # the last micro-batch's taps); --block-scale composes with --zero2
+    # (the faithful all_to_all carries the blocked wire).
+    if args.block_scale and args.mode != "ring" and not args.zero2:
+        raise SystemExit("--block-scale needs --mode ring (or --zero2, "
+                         "whose all_to_all carries the blocked wire): "
+                         "the per-block scale sidecar rides a packed "
+                         "wire")
     if args.block_scale and args.grad_man < 2:
         raise SystemExit(f"--block-scale needs a packable gradient format "
                          f"(man_bits >= 2 for the codec's special codes), "
@@ -291,9 +280,15 @@ def main(argv=None) -> dict:
                         ("zero1" if args.zero1 else "zero2")
                         + ("_lars" if args.use_lars else "_sgd"))
         # world = the dp axis size (emulate_node replicas live INSIDE a
-        # rank's micro-batch scan, same as the resnet50 CLI's wiring)
-        zero = maker(schedule, world=n_dev, momentum=args.momentum,
-                     weight_decay=args.weight_decay)
+        # rank's micro-batch scan, same as the resnet50 CLI's wiring).
+        # ZeRO-2 adopts the bucketed flat layout when --bucket-elems is
+        # set, so the overlap taps and the update consume the SAME
+        # per-bucket shards (zero2_sgd's make_tap_reduce, ISSUE 12)
+        zkw = dict(momentum=args.momentum,
+                   weight_decay=args.weight_decay)
+        if args.zero2:
+            zkw["bucket_elems"] = args.bucket_elems
+        zero = maker(schedule, world=n_dev, **zkw)
         state = state.replace(opt_state=zero.init(state.params))
     ckpt_dir = os.path.abspath(args.save_path)
     manager = CheckpointManager(ckpt_dir, track_best=True,

@@ -58,3 +58,18 @@ def overlap_blind(make_train_step, ladder_step_key, build, model, tx,
     # schedule/bucket layout — pass overlap=(overlap_reduce, bucket_elems)
     step = steps[ladder_step_key(supervisor, psup)]
     return step(state, batch)
+
+
+def block_blind(make_train_step, ladder_step_key, build, model, tx,
+                mesh, state, batch, ov_key):
+    # distilled from the ISSUE 12 hazard: the run configures the
+    # block-scaled wire, but the ladder key has no block coordinate
+    supervisor = TransportSupervisor(start="ring")
+    psup = PrecisionSupervisor("e5m2,e5m7")
+    make_train_step(model, tx, mesh, mode="ring", block_scale=True,
+                    block_size=128)
+    steps = StepTable(build)
+    # BAD: a ladder transition serves a step traced for the wrong block
+    # layout/numerics — pass block=(block_scale, block_size)
+    step = steps[ladder_step_key(supervisor, psup, overlap=ov_key)]
+    return step(state, batch)

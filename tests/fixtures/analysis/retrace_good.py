@@ -39,9 +39,11 @@ def guarded_loop(build_step, state, batch, grad_exp, grad_man):
     supervisor = TransportSupervisor(start="ring")
     psup = PrecisionSupervisor("e5m2,e5m7")
     steps = StepTable(build_step)
-    # the PR 5 fix: both supervisors' coordinates in the key (and an
-    # explicit overlap=None: this run has no overlap surface)
-    step = steps[ladder_step_key(supervisor, psup, overlap=None)]
+    # the PR 5 fix: both supervisors' coordinates in the key (and
+    # explicit overlap=None/block=None: this run has no overlap or
+    # block surface)
+    step = steps[ladder_step_key(supervisor, psup, overlap=None,
+                                 block=None)]
     return step(state, batch)
 
 
@@ -56,5 +58,21 @@ def overlap_keyed(make_train_step, build, model, tx, mesh, state,
                     bucket_elems=bucket_elems)
     steps = StepTable(build)
     step = steps[ladder_step_key(supervisor, psup,
-                                 overlap=(overlap_reduce, bucket_elems))]
+                                 overlap=(overlap_reduce, bucket_elems),
+                                 block=None)]
+    return step(state, batch)
+
+
+def block_keyed(make_train_step, build, model, tx, mesh, state, batch,
+                block_scale, block_size):
+    # the ISSUE 12 fix: the block coordinate rides the key too, so a
+    # ladder transition can never serve a step traced for the wrong
+    # block layout/numerics
+    supervisor = TransportSupervisor(start="ring")
+    psup = PrecisionSupervisor("e5m2,e5m7")
+    make_train_step(model, tx, mesh, mode="ring",
+                    block_scale=block_scale, block_size=block_size)
+    steps = StepTable(build)
+    step = steps[ladder_step_key(supervisor, psup, overlap=None,
+                                 block=(block_scale, block_size))]
     return step(state, batch)

@@ -86,7 +86,7 @@ def test_bad_fixture_finding_counts():
                 "swallow": 4,
                 # v2 (whole-program + compat inventory) rules
                 "format-flow": 7, "axis-flow": 2,
-                "collective-contract": 4, "retrace": 5,
+                "collective-contract": 4, "retrace": 7,
                 "compat-drift": 5,
                 # ISSUE 11: ad-hoc stdout telemetry bypassing the obs
                 # MetricsRegistry
@@ -288,6 +288,48 @@ def test_format_flow_block_drift_crosses_files(tmp_path):
 
     root2 = _write_tree(tmp_path / "2", {
         "lib.py": lib, "cli.py": cli.replace("n, 64", "n, 128")})
+    assert lint_tree([root2], select=["format-flow"]) == []
+
+
+def test_format_flow_covers_zero_and_kvcache_style_sites(tmp_path):
+    """ISSUE 12 satellite: the ("packed", fmt, block) lattice covers the
+    NEW blocked-wire owners — a ZeRO-2-style all_to_all module whose
+    pack/unpack block sizes drift, and a kvcache-style module that
+    decodes a blocked page with the per-tensor unpacker (dropping every
+    block's 2^k scale).  Matching pairs are clean — which is exactly
+    what pins the live zero.py/kvcache.py sites."""
+    zero_like = """
+        from cpd_tpu.quant.numerics import (pack_exmy_blocked,
+                                            unpack_exmy_blocked)
+
+        def reduce_scatter(payload, c):
+            wire = pack_exmy_blocked(payload, 4, 3, 32)
+            # the all_to_all would ride here; receiver unpacks at a
+            # DIFFERENT block size — every element lands on the wrong
+            # block's scale
+            return unpack_exmy_blocked(wire, 4, 3, c, 16)
+    """
+    kv_like = """
+        from cpd_tpu.quant.numerics import (pack_exmy_blocked,
+                                            unpack_exmy)
+
+        def gather_page(rows):
+            packed = pack_exmy_blocked(rows, 4, 3, 32)
+            # per-tensor unpack of a blocked page: the shift sidecar is
+            # read as code bytes and every block's scale is dropped
+            return unpack_exmy(packed, 4, 3)
+    """
+    root = _write_tree(tmp_path, {"zero_like.py": zero_like,
+                                  "kv_like.py": kv_like})
+    findings = lint_tree([root], select=["format-flow"])
+    assert sorted(f.path.rsplit("/", 1)[-1] for f in findings) == \
+        ["kv_like.py", "zero_like.py"], findings
+    root2 = _write_tree(tmp_path / "2", {
+        "zero_like.py": zero_like.replace("c, 16", "c, 32"),
+        "kv_like.py": kv_like.replace(
+            "unpack_exmy)", "unpack_exmy_blocked)").replace(
+            "unpack_exmy(packed, 4, 3)",
+            "unpack_exmy_blocked(packed, 4, 3, rows.shape[-1], 32)")})
     assert lint_tree([root2], select=["format-flow"]) == []
 
 
@@ -535,7 +577,7 @@ def test_live_suppression_count_is_pinned():
                         f"{path}:{tok.start[0]}: suppression without a "
                         f"written justification: {payload!r}")
                     sites.append((path, tok.start[0], payload))
-    assert len(sites) == 7, (
+    assert len(sites) == 8, (
         "live-tree suppression count changed — review the new/removed "
         "site's justification and re-pin:\n" + "\n".join(
             f"{p}:{ln}: {pl}" for p, ln, pl in sites))

@@ -302,3 +302,25 @@ def test_digest_concat_is_concat_digest():
         got = digest_concat(wire_digest(a), la, wire_digest(b))
         want = wire_digest(jnp.concatenate([a, b]))
         assert int(got) == int(want), (la, lb)
+
+
+# ---------------------------------------------------------------- ISSUE 12
+def test_digest_rows_pallas_matches_wire_digest():
+    """The one-pass per-row digest kernel == vmap(integrity.wire_digest)
+    bitwise — tile-boundary shapes, tiny rows, multi-tile rows."""
+    from cpd_tpu.ops.quantize import digest_rows_pallas
+    from cpd_tpu.parallel.integrity import wire_digest
+    rng = np.random.RandomState(0)
+    for w, nb in [(8, 37), (4, 4096), (3, 65536 + 17), (1, 1),
+                  (2, 131072), (5, 65536)]:
+        rows = jnp.asarray(rng.randint(0, 256, size=(w, nb)), jnp.uint8)
+        got = digest_rows_pallas(rows, True)
+        want = jax.vmap(wire_digest)(rows)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=f"({w}, {nb})")
+
+
+def test_digest_rows_pallas_rejects_bad_shapes():
+    from cpd_tpu.ops.quantize import digest_rows_pallas
+    with pytest.raises(ValueError, match="uint8"):
+        digest_rows_pallas(jnp.zeros((4,), jnp.uint8), True)

@@ -458,12 +458,21 @@ def test_train_step_overlap_wire_fault_exact_counters():
 def test_train_step_overlap_rejects_bad_configs():
     from cpd_tpu.train import make_train_step
     mesh, model, tx, state, x, y = _tiny_setup()
-    with pytest.raises(ValueError, match="emulate_node == 1"):
-        make_train_step(model, tx, mesh, overlap_reduce=True,
-                        emulate_node=2)
-    with pytest.raises(ValueError, match="one owner"):
+    # ISSUE 12 lifted the emulate_node fail-fast: overlap + emulate > 1
+    # now BUILDS (the unrolled micro chain feeds the last micro's taps)
+    assert callable(make_train_step(model, tx, mesh, overlap_reduce=True,
+                                    emulate_node=2, donate=False))
+    # ...but reduce_in_update still needs the updater's tap hook
+    # (ZeRO-2 wires it via mesh_layout; ZeRO-3 and ad-hoc updaters
+    # don't own one)
+    with pytest.raises(ValueError, match="tap_reduce"):
         make_train_step(model, tx, mesh, overlap_reduce=True,
                         reduce_in_update=True,
+                        update_fn=lambda *a, **k: None)
+    # and the hook alone is meaningless without reduce_in_update
+    with pytest.raises(ValueError, match="reduce_in_update"):
+        make_train_step(model, tx, mesh,
+                        tap_reduce=lambda *a, **k: None,
                         update_fn=lambda *a, **k: None)
 
 
@@ -504,16 +513,18 @@ def test_lm_train_step_overlap_bitwise():
     assert float(ma["loss"]) == float(mb["loss"])
 
 
-def test_lm_train_step_overlap_rejects_emulate_node():
+def test_lm_train_step_overlap_accepts_emulate_node():
+    # ISSUE 12 lifted the LM fail-fast too: overlap + emulate_node > 1
+    # builds (the bitwise gate is test_train_step_overlap_emulate_node)
     from cpd_tpu.models.transformer import transformer_lm
     from cpd_tpu.train import make_optimizer, warmup_step_decay
     from cpd_tpu.train.lm import make_lm_train_step
     mesh = data_parallel_mesh()
     model = transformer_lm(vocab_size=8, d_model=8, n_layers=1, n_heads=2)
     tx = make_optimizer("sgd", warmup_step_decay(0.01, 10, [100]))
-    with pytest.raises(ValueError, match="emulate_node == 1"):
-        make_lm_train_step(model, tx, mesh, overlap_reduce=True,
-                           emulate_node=2)
+    assert callable(make_lm_train_step(model, tx, mesh,
+                                       overlap_reduce=True,
+                                       emulate_node=2, donate=False))
 
 
 # ------------------------------------------------ ladder-key composition
@@ -526,10 +537,10 @@ def test_ladder_step_key_overlap_coordinate():
     from cpd_tpu.resilience.precision import resolve_ladder_key
     t = TransportSupervisor(start="ring")
     p = PrecisionSupervisor("e5m2,e5m7")
-    base = ladder_step_key(t, p, overlap=None)
+    base = ladder_step_key(t, p, overlap=None, block=None)
     assert base == ("ring", (5, 2))          # PR 5 shape preserved
-    k1 = ladder_step_key(t, p, overlap=(True, 65536))
-    k2 = ladder_step_key(t, p, overlap=(False, None))
+    k1 = ladder_step_key(t, p, overlap=(True, 65536), block=None)
+    k2 = ladder_step_key(t, p, overlap=(False, None), block=None)
     assert k1 != k2 != base and k1 != base
     assert k1 == (("ring", (5, 2)), ("overlap", True, 65536))
     # resolve strips the coordinate and recovers (level, fmt)
@@ -537,7 +548,7 @@ def test_ladder_step_key_overlap_coordinate():
         k1, transport_on=True, precision_on=True, level="ring",
         fmt=(5, 2), overlap_on=True) == ("ring", (5, 2))
     assert resolve_ladder_key(
-        ladder_step_key(t, None, overlap=(True, None)),
+        ladder_step_key(t, None, overlap=(True, None), block=None),
         transport_on=True, precision_on=False, level="ring", fmt=(5, 2),
         overlap_on=True) == ("ring", (5, 2))
     # distinct keys -> distinct StepTable entries (no stale-step serve)
@@ -557,7 +568,7 @@ def test_ladder_step_key_block_coordinate():
     from cpd_tpu.resilience.precision import resolve_ladder_key
     t = TransportSupervisor(start="ring")
     p = PrecisionSupervisor("e5m2,e5m7")
-    base = ladder_step_key(t, p, overlap=None)
+    base = ladder_step_key(t, p, overlap=None, block=None)
     assert base == ("ring", (5, 2))          # PR 8 shape preserved
     kb = ladder_step_key(t, p, overlap=None, block=(True, 128))
     assert kb == (("ring", (5, 2)), ("block", True, 128))
@@ -621,3 +632,98 @@ def test_make_sum_gradients_fn_cache_keyed_by_bucket_layout():
     (k2,) = list(f2._cache._d)
     assert k1 != k2
     assert k1[2] == 40 and k2[2] is None   # the bucket coordinate
+
+
+# ------------------------------------------------ emulate-node overlap
+# (ISSUE 12 leg 3: the micro-batch scan's barrier is gone — the first
+# N-1 micros run unrolled and feed the LAST micro's taps as extras)
+
+@pytest.mark.slow
+def test_train_step_overlap_emulate_node_bitwise():
+    """overlap on/off at emulate_node=2 with the full pipeline on (APS +
+    SR + ring): PARAMS bitwise identical to the scan + post-backward
+    monolith (the transport claim — every gradient bit, emulate reduce
+    included, matches), metrics equal.  BN running stats are pinned at
+    ulp tolerance instead: XLA compiles the monolith's scanned forward
+    and the overlap path's unrolled micro chain with different fusions,
+    and a batch-mean reduction can differ in the last ulp — forward
+    compilation noise, orthogonal to the reduction semantics under
+    test (the params being bitwise proves the GRADS were)."""
+    from cpd_tpu.train import make_train_step
+    mesh, model, tx, state, x, y = _tiny_setup()
+    x2 = jnp.concatenate([x, x[::-1]])   # 32 = 16 * emulate_node
+    y2 = jnp.concatenate([y, y[::-1]])
+    kw = dict(use_aps=True, grad_exp=5, grad_man=2, mode="ring",
+              grad_rounding="stochastic", grad_seed=5, bucket_elems=100,
+              emulate_node=2, donate=False)
+    mono = make_train_step(model, tx, mesh, **kw)
+    over = make_train_step(model, tx, mesh, overlap_reduce=True, **kw)
+    sa, ma = mono(state, x2, y2)
+    sb, mb = over(state, x2, y2)
+    for pa, pb in zip(jax.tree.leaves(sa.params),
+                      jax.tree.leaves(sb.params)):
+        _bitwise(pa, pb, "emulate-node overlap step != monolith")
+    for pa, pb in zip(jax.tree.leaves(sa.batch_stats),
+                      jax.tree.leaves(sb.batch_stats)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-6, atol=1e-8)
+    assert float(ma["loss"]) == float(mb["loss"])
+    assert float(ma["accuracy"]) == float(mb["accuracy"])
+
+
+@pytest.mark.slow
+def test_train_step_overlap_emulate_node_interleaved():
+    """overlap_evidence on the emulate>1 tapped step: the dp transport
+    collectives interleave with the LAST micro-batch's backward compute
+    (the monolith's scan postdates every collective)."""
+    from cpd_tpu.train import make_train_step
+    mesh, model, tx, state, x, y = _tiny_setup()
+    x2 = jnp.concatenate([x, x[::-1]])
+    y2 = jnp.concatenate([y, y[::-1]])
+    kw = dict(use_aps=True, grad_exp=5, grad_man=2, mode="ring",
+              bucket_elems=100, emulate_node=2, donate=False)
+    mono = make_train_step(model, tx, mesh, **kw)
+    over = make_train_step(model, tx, mesh, overlap_reduce=True, **kw)
+    ev_mono = overlap_evidence(mono, state, x2, y2)
+    ev_over = overlap_evidence(over, state, x2, y2)
+    assert not ev_mono["interleaved"]
+    assert ev_over["interleaved"], ev_over
+
+
+@pytest.mark.slow
+def test_lm_train_step_overlap_emulate_node_bitwise():
+    """LM step on the dp x sp x tp mesh at emulate_node=2: the unrolled
+    micro chain + tap-side emulate reduce reproduce the scanned
+    monolith bit for bit (sp/tp psums, sat-free path, SR)."""
+    from cpd_tpu.models.transformer import transformer_lm
+    from cpd_tpu.train import (create_train_state, make_optimizer,
+                               warmup_step_decay)
+    from cpd_tpu.train.lm import lm_state_specs, make_lm_train_step
+    from jax.sharding import PartitionSpec
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    model = transformer_lm(vocab_size=64, d_model=32, n_layers=2,
+                           n_heads=4, tp_axis="tp", sp_axis="sp",
+                           tp_size=2)
+    init_model = transformer_lm(vocab_size=64, d_model=32, n_layers=2,
+                                n_heads=4)
+    tx = make_optimizer("sgd", warmup_step_decay(0.01, 10, [100]),
+                        momentum=0.9)
+    state = create_train_state(init_model, tx,
+                               jnp.zeros((1, 16), jnp.int32),
+                               jax.random.PRNGKey(0))
+    state = jax.device_put(state, jax.tree.map(
+        lambda s: NamedSharding(mesh, s), lm_state_specs(state),
+        is_leaf=lambda s: isinstance(s, PartitionSpec)))
+    rng = np.random.RandomState(2)
+    toks = jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+    tgts = jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+    kw = dict(use_aps=True, grad_exp=5, grad_man=2,
+              grad_rounding="stochastic", grad_seed=3, donate=False,
+              bucket_elems=2000, emulate_node=2)
+    sa, ma = make_lm_train_step(model, tx, mesh, **kw)(state, toks, tgts)
+    sb, mb = make_lm_train_step(model, tx, mesh, overlap_reduce=True,
+                                **kw)(state, toks, tgts)
+    for pa, pb in zip(jax.tree.leaves(sa.params),
+                      jax.tree.leaves(sb.params)):
+        _bitwise(pa, pb, "LM emulate-node overlap != monolith")
+    assert float(ma["loss"]) == float(mb["loss"])

@@ -823,3 +823,117 @@ def test_blocked_ring_fused_wire_matches_oracle():
                            block_size=128)
     _bitwise(np.asarray(vec), want, "fused blocked verified clean")
     assert int(rep["ok"]) == 1
+
+
+# ---------------------------------------------------------------- ISSUE 12
+# leg 4: the all-gather row digests moved into Pallas — the fused
+# verified arm must emit NO XLA-side wire digest at all (plain packed)
+# or only the few-byte sidecar composition (blocked).
+
+def _spy_wire_digest(monkeypatch):
+    import cpd_tpu.parallel.integrity as integ
+    calls = []
+    real = integ.wire_digest
+
+    def spy(x):
+        calls.append(int(np.prod(jnp.shape(x))) if jnp.shape(x) else 1)
+        return real(x)
+
+    monkeypatch.setattr(integ, "wire_digest", spy)
+    return calls
+
+
+def _run_verified_fused(block_scale, n=700, exp=4, man=3):
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    rng = np.random.RandomState(2)
+    data = jnp.asarray(rng.randn(w, n).astype(np.float32))
+
+    def body(rows):
+        vec, rep = ring_quantized_sum(
+            rows[0], "dp", exp, man, world=w, fused=True, interpret=True,
+            verify=True, block_scale=block_scale,
+            block_size=128)
+        return vec, rep["ok"], rep["hop_bad"]
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=(P(), P(), P()), check_vma=False))
+    vec, ok, hop_bad = fn(data)
+    return np.asarray(vec), int(ok), int(hop_bad), w, n
+
+
+def test_fused_verified_arm_has_no_xla_wire_digest(monkeypatch):
+    """Plain packed fused verified ring: zero `integrity.wire_digest`
+    calls during trace — every hop digest comes out of the pack kernel
+    and every gather-row digest out of `digest_rows_pallas`."""
+    calls = _spy_wire_digest(monkeypatch)
+    vec, ok, hop_bad, w, n = _run_verified_fused(False)
+    assert ok == 1 and hop_bad == 0
+    assert calls == [], f"XLA wire_digest ran on the fused arm: {calls}"
+
+
+def test_fused_verified_blocked_arm_digests_sidecar_only(monkeypatch):
+    """Blocked fused verified ring: the ONLY XLA-side digest work left
+    is the per-hop shift-sidecar composition — every call's operand is
+    the few-byte sidecar lane (1 byte per 128-element block), never a
+    code-word buffer or a gathered row."""
+    from cpd_tpu.quant.numerics import sidecar_bytes
+    calls = _spy_wire_digest(monkeypatch)
+    vec, ok, hop_bad, w, n = _run_verified_fused(True)
+    assert ok == 1 and hop_bad == 0
+    chunk = -(-n // w)
+    nb = sidecar_bytes(chunk, 128)
+    assert calls, "blocked arm should compose sidecar digests"
+    assert all(c <= nb for c in calls), (calls, nb)
+
+
+def test_fused_verified_gather_digest_matches_xla_arm():
+    """The fused arm's kernel-digested verdicts equal the XLA arm's on
+    the same data — clean run, both transports, result bitwise."""
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    rng = np.random.RandomState(5)
+    data = jnp.asarray(rng.randn(w, 333).astype(np.float32))
+
+    def run(fused):
+        def body(rows):
+            vec, rep = ring_quantized_sum(
+                rows[0], "dp", 4, 3, world=w, fused=fused,
+                interpret=True, verify=True)
+            return vec, rep["ok"], rep["agree"], rep["gather_bad"]
+
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                                 out_specs=(P(),) * 4,
+                                 check_vma=False))(data)
+
+    va, oka, aga, gba = run(True)
+    vb, okb, agb, gbb = run(False)
+    np.testing.assert_array_equal(np.asarray(va).view(np.uint32),
+                                  np.asarray(vb).view(np.uint32))
+    assert (int(oka), int(aga), int(gba)) == (1, 1, 0)
+    assert (int(okb), int(agb), int(gbb)) == (1, 1, 0)
+
+
+@pytest.mark.parametrize("code", [1, 2, 3])
+def test_fused_verified_gather_fault_still_caught(code):
+    """A gather-site wire fault on the fused arm is detected by the
+    kernel-digested row tags exactly as the XLA digests caught it."""
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    rng = np.random.RandomState(7)
+    data = jnp.asarray(rng.randn(w, 256).astype(np.float32))
+
+    def body(rows):
+        vec, rep = ring_quantized_sum(
+            rows[0], "dp", 4, 3, world=w, fused=True, interpret=True,
+            verify=True, fault=(jnp.int32(code), jnp.int32(2)))
+        return rep["ok"], rep["gather_bad"], rep["agree"]
+
+    ok, gbad, agree = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("dp"),), out_specs=(P(),) * 3,
+        check_vma=False))(data)
+    assert int(ok) == 0
+    # flip/drop corrupt the received row (gather_bad fires); a stale
+    # self-echo replaces it with the receiving rank's own row — caught
+    # by the row tag OR the cross-replica agreement digest
+    assert int(gbad) >= 1 or int(agree) == 0

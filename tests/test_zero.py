@@ -849,3 +849,308 @@ def test_checkpoint_restore_directly_sharded(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(restored.params["conv0"]["kernel"]),
         np.asarray(state.params["conv0"]["kernel"]))
+
+
+# ---------------------------------------------------------------- ISSUE 12
+# Block-scaled ZeRO-2 all_to_all wire, bucketed layout, and the overlap
+# taps feeding reduce_in_update.
+
+def _gather_shards(z, tree, mesh, **prec):
+    """Run z._grad_shard inside shard_map and all_gather the per-rank
+    shards into the oracle's (W*S,) rank-major layout."""
+    from jax import lax
+
+    def body(t):
+        local = jax.tree.map(lambda g: g[0], t)
+        sh = z._grad_shard(local, None, "dp", **prec)
+        return lax.all_gather(sh, "dp", axis=0, tiled=True)
+
+    in_spec = jax.tree.map(lambda _: P("dp"), tree)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(in_spec,),
+                             out_specs=P(), check_vma=False))(tree)
+
+
+def _odd_tree(w, seed=3):
+    # odd leaf sizes -> shard chunks NOT divisible by the block size and
+    # a non-empty world-size pad (33+85+19 = 137; ceil(137/8)=18 ->
+    # pad 7, tail block of 18 % 8 = 2)
+    rng = np.random.RandomState(seed)
+    scale = np.exp2(rng.randint(-18, 12, size=(w, 1))).astype(np.float32)
+    return {"a": jnp.asarray(rng.randn(w, 33).astype(np.float32) * scale),
+            "b": jnp.asarray(rng.randn(w, 5, 17).astype(np.float32)),
+            "c": jnp.asarray(rng.randn(w, 19).astype(np.float32) * scale)}
+
+
+@pytest.mark.parametrize("exp,man,kahan,use_aps,sr", [
+    (4, 3, False, True, False),
+    pytest.param(5, 2, False, False, False, marks=pytest.mark.slow),
+    pytest.param(4, 3, True, True, False, marks=pytest.mark.slow),
+    pytest.param(4, 3, False, True, True, marks=pytest.mark.slow),
+    pytest.param(5, 2, True, False, True, marks=pytest.mark.slow),
+])  # one RTNE+APS combo in the default tier; the full matrix (and the
+# reduce-smoke CI gate's 3 combos incl. SR/Kahan) ride the slow tier —
+# suite-budget re-tiering, tests/test_zz_suite_budget.py
+def test_zero2_blocked_matches_oracle(exp, man, kahan, use_aps, sr):
+    """Blocked ZeRO-2 all_to_all (pack_exmy_blocked code words + shift
+    sidecar on the wire, blocked scan casts) against the single-device
+    `zero2_oracle_flat` — bitwise, across formats x kahan x rounding,
+    with odd-tail shard chunks at a non-divisible block size."""
+    from cpd_tpu.parallel.zero import zero2_oracle_flat
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    tree = _odd_tree(w)
+    z = zero2_sgd(lambda s: 0.1, world=w)
+    key = jax.random.PRNGKey(5) if sr else None
+    prec = dict(use_aps=use_aps, grad_exp=exp, grad_man=man,
+                use_kahan=kahan, block_scale=True, block_size=8,
+                key=key, rounding="stochastic" if sr else "nearest")
+    got = _gather_shards(z, tree, mesh, **prec)
+    want = zero2_oracle_flat(tree, w, use_aps=use_aps, grad_exp=exp,
+                             grad_man=man, use_kahan=kahan, key=key,
+                             block_scale=True, block_size=8)
+    np.testing.assert_array_equal(np.asarray(got).view(np.uint32),
+                                  np.asarray(want).view(np.uint32))
+
+
+def test_zero2_blocked_wire_lossless_vs_unblocked():
+    """The 'existing lossless path' gate: the blocked wire's
+    pack -> all_to_all -> unpack trip reproduces the blocked-cast
+    payload bit for bit (codec idempotence at the exact odd-tail
+    (W, c) row shapes the ZeRO-2 wire ships), so riding the sidecar
+    wire vs shipping the same blocked-cast values raw is a no-op."""
+    from cpd_tpu.quant.numerics import (cast_body_blocked,
+                                        pack_exmy_blocked,
+                                        unpack_exmy_blocked)
+    rng = np.random.RandomState(11)
+    w, c = 8, 18                    # c % block != 0 -> odd tail block
+    scale = np.exp2(rng.randint(-30, 20, size=(w, 1))).astype(np.float32)
+    rows = jnp.asarray(rng.randn(w, c).astype(np.float32) * scale)
+    for exp, man, block in [(4, 3, 8), (5, 2, 4), (5, 7, 16)]:
+        cast = cast_body_blocked(rows, exp, man, block)
+        wire = pack_exmy_blocked(cast, exp, man, block)
+        back = unpack_exmy_blocked(wire, exp, man, c, block)
+        np.testing.assert_array_equal(
+            np.asarray(back).view(np.uint32),
+            np.asarray(cast).view(np.uint32),
+            err_msg=f"e{exp}m{man} block {block}")
+
+
+def test_zero2_blocked_rejects_bad_formats():
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    z = zero2_sgd(lambda s: 0.1, world=w)
+    tree = {"a": jnp.zeros((w, 8), jnp.float32)}
+    local = {"a": jnp.zeros((8,), jnp.float32)}
+    with pytest.raises(ValueError, match=r"\(8, 23\)"):
+        z._grad_shard(local, None, "dp", grad_exp=8, grad_man=23,
+                      block_scale=True)
+    with pytest.raises(ValueError, match="man_bits >= 2"):
+        z._grad_shard(local, None, "dp", grad_exp=5, grad_man=1,
+                      block_scale=True)
+    del tree
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("block_scale", [False, True])
+def test_zero2_bucketed_layout_matches_oracle(block_scale):
+    """The bucketed flat layout (bucket_elems) — per-bucket all_to_all
+    spans, interleaved pads — against the oracle at the same layout,
+    per-tensor AND blocked wires."""
+    from cpd_tpu.parallel.zero import zero2_oracle_flat
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    tree = _odd_tree(w, seed=7)
+    z = zero2_sgd(lambda s: 0.1, world=w, bucket_elems=64)
+    lay = z._layout(jax.tree.map(lambda g: g[0], tree))
+    assert len(lay.buckets) > 1   # the cap actually splits this tree
+    prec = dict(use_aps=True, grad_exp=4, grad_man=3,
+                block_scale=block_scale, block_size=8)
+    got = _gather_shards(z, tree, mesh, **prec)
+    want = zero2_oracle_flat(tree, w, use_aps=True, grad_exp=4,
+                             grad_man=3, block_scale=block_scale,
+                             block_size=8, bucket_elems=64)
+    np.testing.assert_array_equal(np.asarray(got).view(np.uint32),
+                                  np.asarray(want).view(np.uint32))
+
+
+def test_zero2_bucketed_matches_unbucketed_values():
+    """Bucketing is a WIRE layout, not a numerics change, on the
+    per-tensor wire: the faithful scan is elementwise over ranks, so
+    the bucketed shards reassemble to exactly the replicated faithful
+    reduction (the pre-ISSUE-12 oracle, any bucket cap)."""
+    from cpd_tpu.parallel.dist import sum_gradients
+    from jax import lax
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    tree = _odd_tree(w, seed=9)
+    z = zero2_sgd(lambda s: 0.1, world=w, bucket_elems=64)
+    template = jax.tree.map(lambda g: g[0], tree)
+    lay = z._layout(template)
+
+    got = _gather_shards(z, tree, mesh, use_aps=True, grad_exp=4,
+                         grad_man=3)
+
+    def body(t):
+        local = jax.tree.map(lambda g: g[0], t)
+        return sum_gradients(local, "dp", use_aps=True, grad_exp=4,
+                             grad_man=3, mode="faithful")
+    in_spec = jax.tree.map(lambda _: P("dp"), tree)
+    ref = jax.jit(shard_map(body, mesh=mesh, in_specs=(in_spec,),
+                            out_specs=jax.tree.map(lambda _: P(), tree),
+                            check_vma=False))(tree)
+    flat_ref = np.concatenate([np.asarray(l).ravel()
+                               for l in jax.tree.leaves(ref)])
+    # reassemble the bucketed rank-major gather into the flat layout
+    stacked = np.asarray(got).reshape(w, lay.shard_size)
+    off = 0
+    for (a, m, c), idxs in zip(lay.meta, lay.buckets):
+        span = stacked[:, off:off + c].reshape(-1)[:m]
+        np.testing.assert_array_equal(span, flat_ref[a:a + m])
+        off += c
+
+
+def test_zero2_bucketed_export_import_roundtrip():
+    from cpd_tpu.parallel.zero import Zero1State
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    rng = np.random.RandomState(4)
+    params = {"a": jnp.asarray(rng.randn(33).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(5, 17).astype(np.float32)),
+              "c": jnp.asarray(rng.randn(19).astype(np.float32))}
+    z = zero2_sgd(lambda s: 0.1, world=w, bucket_elems=64)
+    lay = z._layout(params)
+    assert len(lay.buckets) > 1
+    mom = jnp.asarray(rng.randn(w * lay.shard_size).astype(np.float32))
+    # zero the world-size pads (the Zero1State elastic invariant)
+    mom_np = np.asarray(mom).reshape(w, lay.shard_size).copy()
+    off = 0
+    for a, m, c in lay.meta:
+        span = mom_np[:, off:off + c].reshape(-1)
+        span[m:] = 0.0
+        mom_np[:, off:off + c] = span.reshape(w, c)
+        off += c
+    state = TrainState(step=jnp.zeros([], jnp.int32), params=params,
+                       batch_stats={}, opt_state=Zero1State(
+                           jnp.zeros([], jnp.int32),
+                           jnp.asarray(mom_np.reshape(-1))))
+    portable = z.export_state(state)
+    assert portable.opt_state.momentum.shape == (lay.total,)
+    back = z.import_state(portable)
+    np.testing.assert_array_equal(np.asarray(back.opt_state.momentum),
+                                  mom_np.reshape(-1))
+    # and the portable layout re-pads at a DIFFERENT world size
+    z4 = zero2_sgd(lambda s: 0.1, world=4, bucket_elems=64)
+    lay4 = z4._layout(params)
+    re4 = z4.import_state(portable)
+    assert re4.opt_state.momentum.shape == (4 * lay4.shard_size,)
+    p4 = z4.export_state(re4)
+    np.testing.assert_array_equal(np.asarray(p4.opt_state.momentum),
+                                  np.asarray(portable.opt_state.momentum))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket_elems,emulate", [(3000, 1), (None, 1)])
+# both layouts in the slow tier (suite-budget re-tiering): the default
+# tier keeps test_zero2_overlap_default_cap_regression — ZeRO overlap
+# on/off bitwise at the default layout — plus the reduce-smoke CI gates
+def test_zero2_overlap_bitwise_vs_monolith(bucket_elems, emulate):
+    """ISSUE 12 acceptance: ZeRO-2 overlap on/off bitwise identical to
+    the monolith at a fixed bucket layout — the taps run the updater's
+    per-bucket reduce-scatter inside the backward (make_tap_reduce) and
+    the update consumes the extracted shards.  bucket_elems=None is the
+    legacy single-bucket layout (the monkeypatched-default regression
+    lives in test_zero2_overlap_default_cap_regression)."""
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = tiny_cnn()
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    x, y = _data(16 * emulate, seed=23)
+    quant = dict(use_aps=True, grad_exp=4, grad_man=3,
+                 grad_rounding="stochastic", grad_seed=11,
+                 emulate_node=emulate, block_scale=True, block_size=128)
+
+    tx = make_optimizer("sgd", schedule, momentum=0.9)
+    state0 = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    z = zero2_sgd(schedule, world=w, momentum=0.9,
+                  bucket_elems=bucket_elems)
+    zs = TrainState(step=jnp.zeros([], jnp.int32), params=state0.params,
+                    batch_stats=state0.batch_stats,
+                    opt_state=z.init(state0.params))
+    common = dict(update_fn=z.update_fn, opt_state_spec=z.state_spec(),
+                  reduce_in_update=True, donate=False, **quant)
+    mono = make_train_step(model, None, mesh, **common)
+    tapped = make_train_step(model, None, mesh, overlap_reduce=True,
+                             tap_reduce=z.make_tap_reduce,
+                             bucket_elems=bucket_elems, **common)
+    sa, ma = mono(zs, x, y)
+    sb, mb = tapped(zs, x, y)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(sa.params)[0],
+            jax.tree_util.tree_flatten_with_path(sb.params)[0]):
+        np.testing.assert_array_equal(np.asarray(a).view(np.uint32),
+                                      np.asarray(b).view(np.uint32),
+                                      err_msg=str(pa))
+    np.testing.assert_array_equal(
+        np.asarray(sa.opt_state.momentum),
+        np.asarray(sb.opt_state.momentum))
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]),
+                               rtol=0, atol=0)
+
+
+def test_zero2_overlap_default_cap_regression(monkeypatch):
+    """The monkeypatched-default regression (ISSUE 12 satellite): with
+    overlap's DEFAULT_BUCKET_ELEMS shrunk so the generic tap plan WOULD
+    split this tree, ZeRO overlap on/off must STAY bitwise at
+    bucket_elems=None — the tap plan must come from the updater's own
+    layout (make_tap_reduce), never the generic default cap."""
+    import cpd_tpu.parallel.overlap as ov
+    monkeypatch.setattr(ov, "DEFAULT_BUCKET_ELEMS", 64)
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = tiny_cnn()
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    x, y = _data(16, seed=29)
+    quant = dict(use_aps=True, grad_exp=4, grad_man=3)
+    tx = make_optimizer("sgd", schedule, momentum=0.9)
+    state0 = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    z = zero2_sgd(schedule, world=w, momentum=0.9)   # bucket_elems=None
+    zs = TrainState(step=jnp.zeros([], jnp.int32), params=state0.params,
+                    batch_stats=state0.batch_stats,
+                    opt_state=z.init(state0.params))
+    common = dict(update_fn=z.update_fn, opt_state_spec=z.state_spec(),
+                  reduce_in_update=True, donate=False, **quant)
+    sa, _ = make_train_step(model, None, mesh, **common)(zs, x, y)
+    sb, _ = make_train_step(model, None, mesh, overlap_reduce=True,
+                            tap_reduce=z.make_tap_reduce,
+                            **common)(zs, x, y)
+    for a, b in zip(jax.tree.leaves(sa.params),
+                    jax.tree.leaves(sb.params)):
+        np.testing.assert_array_equal(np.asarray(a).view(np.uint32),
+                                      np.asarray(b).view(np.uint32))
+
+
+@pytest.mark.slow
+def test_zero1_composes_with_bucket_elems_and_overlap():
+    """ZeRO-1 slices the step's fully-reduced gradients, so it composes
+    with bucket_elems AND overlap_reduce with no updater hook — the
+    lifted fail-fast's other half."""
+    mesh = data_parallel_mesh()
+    w = mesh.devices.size
+    model = tiny_cnn()
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    x, y = _data(16, seed=31)
+    tx = make_optimizer("sgd", schedule, momentum=0.9)
+    state0 = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
+    z = zero1_sgd(schedule, world=w, momentum=0.9)
+    zs = TrainState(step=jnp.zeros([], jnp.int32), params=state0.params,
+                    batch_stats=state0.batch_stats,
+                    opt_state=z.init(state0.params))
+    common = dict(update_fn=z.update_fn, opt_state_spec=z.state_spec(),
+                  donate=False, use_aps=True, grad_exp=5, grad_man=2)
+    sa, _ = make_train_step(model, None, mesh, **common)(zs, x, y)
+    sb, _ = make_train_step(model, None, mesh, overlap_reduce=True,
+                            bucket_elems=3000, **common)(zs, x, y)
+    for a, b in zip(jax.tree.leaves(sa.params),
+                    jax.tree.leaves(sb.params)):
+        np.testing.assert_array_equal(np.asarray(a).view(np.uint32),
+                                      np.asarray(b).view(np.uint32))

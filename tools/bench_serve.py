@@ -299,6 +299,64 @@ def run_smoke(args) -> dict:
         "reprefill_chunks": sc["watchdog_chunks"],
         "completed": s1["completed"],
         "output_matches_stall_free": True, "deterministic": True}
+
+    # 8. blocked-KV gates (ISSUE 12 leg 2): (a) the blocked page codec
+    # decodes the blocked cast BITWISE at real page/GQA row shapes
+    # (including an odd tail block); (b) a blocked engine replays
+    # deterministically with zero drops; (c) the page-corruption-repair
+    # drill works under block scaling — the shift sidecar lives in the
+    # page, so the digest catches a flip exactly as before and repair
+    # recomputes
+    import jax.numpy as jnp
+    from cpd_tpu.quant.numerics import cast_body_blocked
+    from cpd_tpu.serve.kvcache import KVCacheConfig, pack_kv, unpack_kv
+    bcfg = KVCacheConfig(n_layers=1,
+                         n_kv_heads=_SMOKE_MODEL["n_kv_heads"],
+                         head_dim=(_SMOKE_MODEL["d_model"]
+                                   // _SMOKE_MODEL["n_heads"]),
+                         page_size=8, n_pages=4, exp_bits=4, man_bits=3,
+                         block_scale=True, block_size=24)
+    rng_b = np.random.RandomState(5)
+    kvals = jnp.asarray(
+        (rng_b.randn(16, bcfg.n_kv_heads, bcfg.head_dim)
+         * np.exp2(rng_b.randint(-18, 12, (16, 1, 1))))
+        .astype(np.float32))
+    decoded = unpack_kv(pack_kv(kvals, bcfg), bcfg)
+    want_b = cast_body_blocked(
+        kvals.reshape(16, bcfg.row_elems), 4, 3,
+        bcfg.block_size).reshape(16, bcfg.n_kv_heads, bcfg.head_dim)
+    assert (np.asarray(decoded).view(np.uint32)
+            == np.asarray(want_b).view(np.uint32)).all(), \
+        "blocked KV decode != blocked cast (bitwise)"
+
+    bk1 = run_trace(_fresh_engine(model, params, args, kv_format=(4, 3),
+                                  kv_block_size=24), list(trace))
+    bk2 = run_trace(_fresh_engine(model, params, args, kv_format=(4, 3),
+                                  kv_block_size=24), list(trace))
+    assert bk1["counters"] == bk2["counters"], \
+        f"blocked-KV counters not deterministic:\n{bk1['counters']}\n" \
+        f"{bk2['counters']}"
+    assert bk1["dropped"] == 0 and bk1["completed"] == len(trace), bk1
+
+    bplan = FaultPlan.parse("kv_flip@6:0")
+    bf1 = run_trace(_fresh_engine(model, params, args, kv_format=(4, 3),
+                                  kv_block_size=24, scrub_every=2,
+                                  fault_plan=bplan), list(trace))
+    bf2 = run_trace(_fresh_engine(model, params, args, kv_format=(4, 3),
+                                  kv_block_size=24, scrub_every=2,
+                                  fault_plan=bplan), list(trace))
+    bc = bf1["counters"]
+    assert bc == bf2["counters"], \
+        f"blocked fault-drill counters not deterministic:\n{bc}"
+    assert bc["kv_flips_injected"] == 1, bc
+    assert bc["kv_pages_corrupt"] >= 1 and bc["kv_repairs"] >= 1, bc
+    assert bf1["dropped"] == 0 and bf1["completed"] == len(trace), bc
+    out["blocked_kv"] = {
+        "codec_bitwise_vs_blocked_cast": True,
+        "deterministic": True, "completed": bk1["completed"],
+        "repair_drill": {"flips": bc["kv_flips_injected"],
+                         "pages_corrupt": bc["kv_pages_corrupt"],
+                         "repairs": bc["kv_repairs"]}}
     return out
 
 
@@ -353,12 +411,71 @@ def run_overload_sweep(args) -> dict:
             "kv_format": list(args.kv_format)}
 
 
+def run_kv_sweep(args) -> dict:
+    """The KV-page accuracy-vs-capacity frontier (ISSUE 12 satellite):
+    per-tensor vs block-scaled pages per format, scored as max/mean
+    absolute logit deviation from the raw fp32-cache oracle over the
+    common decode prefix, priced by `kv_page_bytes` (sidecar included).
+    The serving twin of bench_reduce's --block-sweep: KV memory is the
+    capacity ceiling, so fewer bytes/page at equal accuracy = more
+    resident requests per HBM byte."""
+    import numpy as np
+
+    from cpd_tpu.quant.numerics import kv_page_bytes
+    from cpd_tpu.serve import run_trace
+
+    model, params = _build_model(args)
+    trace = _build_trace(args)[:8]
+    eo = _fresh_engine(model, params, args, raw_cache=True,
+                       record_logits=True)
+    run_trace(eo, list(trace))
+    hkv = _SMOKE_MODEL["n_kv_heads"]
+    hd = _SMOKE_MODEL["d_model"] // _SMOKE_MODEL["n_heads"]
+    page = _SMOKE_ENGINE["page_size"]
+
+    rows = []
+    for fmt in ((5, 7), (5, 2), (4, 3)):
+        for block in (None, 32, 16):
+            if block is not None and fmt == (5, 7):
+                continue        # the per-tensor baseline format
+            eng = _fresh_engine(
+                model, params, args, kv_format=fmt,
+                kv_block_size=block, record_logits=True)
+            run_trace(eng, list(trace))
+            err_max = err_mean = 0.0
+            n_rows = 0
+            for (rn, pn, ln), (ro, po, lo) in zip(eng.logits_log,
+                                                  eo.logits_log):
+                if (rn, pn) != (ro, po):
+                    break       # token divergence re-schedules
+                d = np.abs(ln - lo)
+                err_max = max(err_max, float(d.max()))
+                err_mean += float(d.mean())
+                n_rows += 1
+            rows.append({
+                "format": list(fmt), "block": block,
+                "page_bytes": kv_page_bytes(*fmt, page, hkv, hd,
+                                            block_size=block),
+                "logit_err_max": round(err_max, 4),
+                "logit_err_mean": round(err_mean / max(n_rows, 1), 5),
+                "rows_compared": n_rows,
+                "completed": eng.counters["completed"]})
+    fp32_page = 2 * page * hkv * hd * 4
+    return {"kv_sweep": rows, "fp32_page_bytes": fp32_page,
+            "model": dict(_SMOKE_MODEL), "page_size": page,
+            "requests": len(trace)}
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     p.add_argument("--smoke", action="store_true",
                    help="CI gate: determinism x2, fault drill, bitwise "
                         "oracle, speedup-vs-serial, overload/snapshot/"
                         "watchdog drills")
+    p.add_argument("--kv-sweep", action="store_true",
+                   help="KV-page accuracy-vs-capacity frontier: "
+                        "per-tensor vs block-scaled pages per format "
+                        "(ISSUE 12) for docs/PERF.md")
     p.add_argument("--overload-sweep", action="store_true",
                    help="map the overload frontier (offered load vs "
                         "goodput/shed/miss) for docs/PERF.md")
@@ -382,6 +499,8 @@ def main() -> int:
 
     if args.smoke:
         out = run_smoke(args)
+    elif args.kv_sweep:
+        out = run_kv_sweep(args)
     elif args.overload_sweep:
         out = run_overload_sweep(args)
     else:
