@@ -1,0 +1,39 @@
+"""cpd_tpu.fleet — multi-engine serving fleet (L6, ISSUE 13).
+
+The layer above `cpd_tpu.serve` (ROADMAP item 1c): N `ServeEngine`s
+behind one front door, stepped in lockstep on one shared step clock so
+every per-engine determinism and zero-silent-drops guarantee lifts to
+fleet scope unchanged.
+
+* `router.Fleet` — SLA-class-aware routing over the PR 10 admission
+  signals (structural TTFT bound, page pressure, supervisor rung),
+  bounded retry-on-SHED, fleet-scope resolution accounting, periodic
+  snapshots + deterministic replay-log recovery from the
+  ``engine_kill`` chaos kind, and drain/scale-in.
+* `migrate.SessionCapsule` — one request's slot state (token history,
+  KV pages as exact packed bytes + shift sidecars, RNG, per-page
+  digests) digest-sealed for live migration; the restored session's
+  remaining decode is BITWISE identical to the unmigrated run at
+  (8, 23).
+* `prefix.PrefixCache` — content-addressed prefix caching: full
+  prompt-prefix pages indexed by token digest, shared copy-on-write
+  across requests (refcounted through the scheduler), every digest hit
+  byte-confirmed so a Fletcher collision can never leak KV bytes
+  across tenants; cache hits skip prefill chunks and leave sampled
+  logits bitwise identical to the cold path.
+
+Harness: `serve.loadgen.run_fleet_trace` / `shared_prefix_trace`,
+``tools/bench_serve.py --fleet / --fleet-smoke``, the ``cpd_fleet_*``
+metric family (`obs.MetricsRegistry.absorb_fleet_counters`) and the
+merged per-engine Chrome-trace lanes
+(`obs.export.merge_chrome_traces`).  See docs/SERVING.md "Fleet".
+"""
+
+from .migrate import (SessionCapsule, can_adopt, extract_capsule,
+                      migrate_session, restore_capsule)
+from .prefix import PrefixCache, token_digest
+from .router import Fleet
+
+__all__ = ["Fleet", "SessionCapsule", "extract_capsule",
+           "restore_capsule", "migrate_session", "can_adopt",
+           "PrefixCache", "token_digest"]

@@ -1,0 +1,293 @@
+"""Live session migration — digest-sealed capsules, bitwise resume.
+
+A `SessionCapsule` is ONE request's complete serving state, extracted
+from a `ServeEngine` slot and restorable into a FREE slot of another
+engine (or the same one), built on the PR 10 snapshot doctrine applied
+at request granularity:
+
+* the slot's KV pages ride as **exact packed bytes** — the bit-packed
+  eXmY code words (shift sidecars included, since the blocked layout
+  stores them inside the page) sliced straight out of the u8 pool —
+  plus their per-page digests;
+* the host-side session state rides as JSON: the `Request`, the token
+  history (prompt + generated so far), ``fed``/``next_token``, the
+  first-token/progress clocks, and the source engine's RNG state and
+  config fingerprint;
+* the whole capsule is **sealed** with a sha256 over a canonical byte
+  serialization; `restore_capsule` verifies the seal and the config
+  compatibility BEFORE touching the target engine — a tampered capsule
+  or a mismatched cache layout (different ``kv_block_size``, page
+  size, format...) raises with zero pages written.
+
+Because quantize-on-append makes page bytes a pure function of the
+token prefix, and per-slot attention reads only the slot's own pages,
+the restored session's remaining decode stream is **bitwise identical**
+to the unmigrated run at (8, 23) — whatever slot index or page ids the
+target assigns (the page table is indirection, not numerics).  Gated in
+tests/test_fleet.py and the fleet-smoke.
+
+Clock convention: capsules record the source engine's step index; on
+restore the deadline-bearing fields (``arrival``, ``first_token_step``)
+shift by the clock offset so SLA expiry keeps meaning on the target.
+In a lockstep fleet the offset is zero.  Migration is a control-plane
+operation — call it between engine steps, never mid-step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..serve.scheduler import DECODE, FREE, PREFILL, Request
+
+__all__ = ["SessionCapsule", "extract_capsule", "restore_capsule",
+           "migrate_session", "can_adopt"]
+
+# the KVCacheConfig fields a capsule's pages are only meaningful under —
+# restore fails fast on ANY mismatch (a (4,3) block-24 page scattered
+# into a block-32 pool would not corrupt loudly, it would decode garbage)
+_CFG_FIELDS = ("n_layers", "n_kv_heads", "head_dim", "page_size",
+               "exp_bits", "man_bits", "raw", "block_scale", "block_size")
+
+_CAP_STATE, _CAP_POOL, _CAP_DIGESTS = "state.json", "pages.npy", \
+    "digests.npy"
+
+
+@dataclasses.dataclass
+class SessionCapsule:
+    """One migrated session (module docstring).  ``state`` is the
+    JSON-able host record, ``pool_pages``/``page_digests`` the exact
+    device bytes, ``seal`` the sha256 over the canonical serialization
+    (`SessionCapsule.seal_bytes`)."""
+    state: dict
+    pool_pages: np.ndarray
+    page_digests: np.ndarray
+    seal: str = ""
+
+    @property
+    def rid(self) -> int:
+        return int(self.state["req"]["rid"])
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.pool_pages.shape[1])
+
+    def seal_bytes(self) -> str:
+        """sha256 over the canonical byte serialization: the sorted
+        state JSON, then each array's dtype/shape descriptor and raw
+        bytes — any flipped byte, resized array or edited field changes
+        the digest."""
+        h = hashlib.sha256()
+        h.update(json.dumps(self.state, sort_keys=True,
+                            separators=(",", ":")).encode())
+        for arr in (self.pool_pages, self.page_digests):
+            h.update(str(arr.dtype).encode())
+            h.update(repr(tuple(arr.shape)).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return h.hexdigest()
+
+    def sealed(self) -> "SessionCapsule":
+        self.seal = self.seal_bytes()
+        return self
+
+    def verify(self) -> None:
+        """Raise ValueError unless the seal matches the contents —
+        ALWAYS the first thing `restore_capsule` does."""
+        actual = self.seal_bytes()
+        if not self.seal or actual != self.seal:
+            raise ValueError(
+                f"session capsule (rid {self.state.get('req', {}).get('rid')}"
+                f"): seal mismatch ({actual[:12]}… != "
+                f"{(self.seal or '<unsealed>')[:12]}…) — refusing to "
+                "restore a tampered capsule")
+
+    # -- durable form (drain-to-disk, cross-process migration) ------------
+
+    def to_dir(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        np.save(os.path.join(path, _CAP_POOL), self.pool_pages)
+        np.save(os.path.join(path, _CAP_DIGESTS), self.page_digests)
+        with open(os.path.join(path, _CAP_STATE), "w") as fh:
+            json.dump({"state": self.state, "seal": self.seal}, fh)
+        return path
+
+    @classmethod
+    def from_dir(cls, path: str) -> "SessionCapsule":
+        with open(os.path.join(path, _CAP_STATE)) as fh:
+            doc = json.load(fh)
+        return cls(state=doc["state"],
+                   pool_pages=np.load(os.path.join(path, _CAP_POOL)),
+                   page_digests=np.load(os.path.join(path, _CAP_DIGESTS)),
+                   seal=doc["seal"])
+
+
+def _cfg_fingerprint(cfg) -> dict:
+    return {f: getattr(cfg, f) for f in _CFG_FIELDS}
+
+
+def extract_capsule(engine, rid: int) -> SessionCapsule:
+    """Extract ``rid``'s live slot into a sealed capsule and REMOVE it
+    from ``engine`` (pages released, rid leaves the engine's in-flight
+    set WITHOUT resolving — the capsule now carries the zero-silent-
+    drops obligation; the caller must restore it somewhere).  Queued
+    requests move with `ServeEngine.withdraw` instead; resolved rids
+    are already final and raise here."""
+    slot = engine.slot_of_rid(rid)
+    if slot is None:
+        raise ValueError(
+            f"rid {rid} has no live slot on this engine (queued "
+            "requests move via withdraw(); resolved ones are final)")
+    pages = list(slot.pages)
+    idx = np.asarray(pages, np.int32)
+    pool_pages = np.asarray(engine._pool)[:, idx]
+    page_digests = np.asarray(engine._digests)[:, idx]
+    state = {
+        "version": 1,
+        "req": dataclasses.asdict(slot.req),
+        "state": slot.state,
+        "fed": int(slot.fed),
+        "next_token": int(slot.next_token),
+        "generated": [int(t) for t in slot.generated],
+        "first_token_step": int(slot.first_token_step),
+        "src_step": int(engine.step_index),
+        "cfg": _cfg_fingerprint(engine.cfg),
+        "rng": engine._rng.bit_generator.state,
+        "temperature": float(engine._temperature),
+    }
+    capsule = SessionCapsule(state=state, pool_pages=pool_pages,
+                             page_digests=page_digests).sealed()
+    # removal — after the capsule is sealed, so a failure above leaves
+    # the engine untouched
+    engine._stalled.discard(slot.index)
+    engine.counters["pages_freed"] += engine.sched.evict(slot)
+    engine._inflight.discard(rid)
+    engine.counters["sessions_out"] += 1
+    engine._event("migrate_out", rid, engine.step_index,
+                  pages=len(pages))
+    return capsule
+
+
+def can_adopt(engine, n_pages: int) -> bool:
+    """True when ``engine`` can restore a capsule of ``n_pages`` right
+    now: a FREE slot, a page-table row wide enough, and enough free (or
+    cache-reclaimable) pages.  Reclaimable counts only cache-held pages
+    whose SOLE reference is the cache — evicting an entry whose page a
+    live slot also shares releases a reference but frees nothing, so
+    counting those would over-promise and crash the adopt."""
+    if not any(sl.state == FREE for sl in engine.sched.slots):
+        return False
+    if n_pages > engine.sched.max_pages:
+        return False
+    reclaimable = 0
+    if engine.prefix_cache is not None:
+        reclaimable = sum(
+            1 for p in engine.prefix_cache.held_pages
+            if engine.sched.page_refs.get(p, 0) == 1)
+    return len(engine.sched.free_pages) + reclaimable >= n_pages
+
+
+def restore_capsule(engine, capsule: SessionCapsule, *,
+                    adopt_rng: bool = False):
+    """Restore a capsule into a FREE slot of ``engine`` and resume —
+    decode bitwise-identical to the unmigrated run at (8, 23) (module
+    docstring).  Verification order is load-bearing: the seal, then the
+    config compatibility, then capacity — ALL before any page is
+    written, so a failed restore leaves the target untouched.
+
+    ``adopt_rng=True`` additionally overwrites the target engine's
+    sampling RNG with the capsule's (single-tenant engine handoff);
+    the default leaves the target's stream alone — the bitwise-resume
+    contract is for greedy decode, where no RNG is drawn."""
+    capsule.verify()
+    want = capsule.state["cfg"]
+    have = _cfg_fingerprint(engine.cfg)
+    if want != have:
+        diff = {k: (want[k], have[k]) for k in _CFG_FIELDS
+                if want[k] != have[k]}
+        raise ValueError(
+            f"capsule (rid {capsule.rid}) is incompatible with this "
+            f"engine's cache layout — capsule vs engine: {diff}; "
+            "restore onto a matching engine (pages are raw packed "
+            "bytes, they cannot be transcoded here)")
+    if capsule.state["state"] not in (PREFILL, DECODE):
+        # up here with the other checks: the seal is not a secret (a
+        # foreign tool can reseal an edited capsule), and a bad state
+        # discovered after the page scatter would leak reserved pages
+        # and wedge the target slot
+        raise ValueError(f"capsule (rid {capsule.rid}) carries slot "
+                         f"state {capsule.state['state']!r}")
+    if capsule.n_pages > engine.sched.max_pages:
+        # max_pages is per-ENGINE sizing, not part of the cache-layout
+        # fingerprint — an oversized capsule would pass every byte
+        # check, then blow up the first page_row render post-write
+        raise ValueError(
+            f"capsule (rid {capsule.rid}) holds {capsule.n_pages} "
+            f"pages but this engine's page-table rows are "
+            f"{engine.sched.max_pages} wide (max_seq too small)")
+    slot = next((sl for sl in engine.sched.slots if sl.state == FREE),
+                None)
+    if slot is None:
+        raise RuntimeError(f"no FREE slot to adopt rid {capsule.rid}")
+    need = capsule.n_pages
+    engine._make_room(need)
+    if len(engine.sched.free_pages) < need:
+        raise RuntimeError(
+            f"cannot adopt rid {capsule.rid}: needs {need} pages, "
+            f"{len(engine.sched.free_pages)} free")
+    new_pages = engine.sched.reserve_pages(need)
+    idx = jnp.asarray(np.asarray(new_pages, np.int32))
+    engine._pool = engine._pool.at[:, idx].set(
+        jnp.asarray(capsule.pool_pages))
+    engine._digests = engine._digests.at[:, idx].set(
+        jnp.asarray(capsule.page_digests))
+    st = capsule.state
+    offset = engine.step_index - int(st["src_step"])
+    req = dict(st["req"])
+    req["prompt"] = tuple(req["prompt"])
+    req["arrival"] = int(req["arrival"]) + offset
+    slot.req = Request(**req)
+    slot.pages = new_pages
+    slot.state = st["state"]
+    slot.fed = int(st["fed"])
+    slot.next_token = int(st["next_token"])
+    slot.generated = [int(t) for t in st["generated"]]
+    slot.seq = engine.sched._admit_seq
+    engine.sched._admit_seq += 1
+    ft = int(st["first_token_step"])
+    slot.first_token_step = ft + offset if ft >= 0 else -1
+    slot.last_progress = engine.step_index
+    if adopt_rng:
+        engine._rng.bit_generator.state = st["rng"]
+    engine._inflight.add(capsule.rid)
+    engine.counters["sessions_in"] += 1
+    engine.counters["pages_reserved"] += need
+    engine._event("migrate_in", capsule.rid, engine.step_index,
+                  pages=need)
+    return slot
+
+
+def migrate_session(src, dst, rid: int,
+                    adopt_rng: bool = False) -> SessionCapsule:
+    """Extract ``rid`` from ``src`` and restore it into ``dst`` — the
+    one-call live migration.  The destination is vetted (`can_adopt`)
+    BEFORE extraction; if the restore still fails, the capsule is put
+    back into the source so the session is never stranded."""
+    slot = src.slot_of_rid(rid)
+    if slot is None:
+        raise ValueError(f"rid {rid} has no live slot to migrate")
+    if not can_adopt(dst, len(slot.pages)):
+        raise RuntimeError(
+            f"destination cannot adopt rid {rid} "
+            f"({len(slot.pages)} pages): no free slot or pages")
+    capsule = extract_capsule(src, rid)
+    try:
+        restore_capsule(dst, capsule, adopt_rng=adopt_rng)
+    except Exception:
+        restore_capsule(src, capsule, adopt_rng=False)
+        raise
+    return capsule

@@ -1,0 +1,254 @@
+"""Content-addressed prefix cache — shared prompt prefixes, CoW pages.
+
+Identical prompt prefixes are everywhere in real serving traffic
+(system prompts, few-shot preambles, multi-turn history), and the paged
+KV cache already makes their K/V bytes *identical by construction*:
+quantize-on-append (serve/model.py) writes page bytes as a pure
+function of the token prefix and the params, independent of when or in
+which slot the positions were computed.  So a FULL prefill page — all
+``page_size`` positions fed, all of them prompt tokens — can be shared
+copy-on-write across every request whose prompt starts with the same
+tokens, and the shared read is **bitwise identical** to a cold prefill
+(gated in tests/test_fleet.py and the fleet-smoke).
+
+Index discipline (the collision-confirmation rule, ISSUE 13):
+
+* entries are keyed by a position-weighted Fletcher digest of the
+  TOKEN prefix (`token_digest` — the same mod-65521 family as the page
+  digests in `parallel.integrity`), so lookup is content-addressed;
+* a digest hit is only ever shared after a full **byte comparison** of
+  the stored token prefix against the query — a Fletcher collision
+  (16+16 bits cannot be injective) must NEVER leak one tenant's KV
+  bytes into another tenant's attention window.  The crafted-collision
+  test pins this: two different prefixes with equal digests do not
+  share.
+
+Copy-on-write mechanics (the engine side, serve/engine.py):
+
+* only FULL prompt pages are indexed — appends always land past them,
+  so a shared page is never written by a tenant (seal-on-share is
+  structural, not a flag);
+* sharing is refcounted through the ONE scheduler allocation
+  discipline (`Scheduler.retain`/`release`): the cache holds its own
+  reference, so shared K/V outlives the request that computed it, and
+  a page returns to the pool exactly when its last reference drops;
+* paths that must WRITE (watchdog re-prefill, capsule adoption) first
+  move the slot onto fresh private pages (copy-before-append);
+  corruption repair recomputes in place — identical prefixes write
+  identical bytes, so the rewrite restores the shared page for every
+  reader;
+* a corrupt cache-held page with no live reader is invalidated
+  (`invalidate_page`), never re-blessed and served to a future tenant.
+
+The cache is bounded (``capacity_pages``); past it the LRU entry is
+evicted and its page reference released.  All state is host-side and
+deterministic — `state_dict` rides the engine snapshot so a restored
+engine resumes with the identical index, held pages and LRU order.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+__all__ = ["PrefixCache", "token_digest"]
+
+_MOD = 65521   # largest prime < 2^16 — the repo's Fletcher modulus
+
+
+def token_digest(tokens: Sequence[int]) -> int:
+    """Position-weighted Fletcher digest of a token sequence (mod
+    65521, the `parallel.integrity` family): ``s1`` sums the tokens,
+    ``s2`` sums the running sums — so position matters — and the
+    +1 offset keeps leading zero tokens from vanishing.  32 bits of
+    digest cannot be injective over token sequences, which is exactly
+    why `PrefixCache.lookup` byte-confirms every hit."""
+    s1 = s2 = 0
+    for t in tokens:
+        s1 = (s1 + int(t) + 1) % _MOD
+        s2 = (s2 + s1) % _MOD
+    return (s2 << 16) | s1
+
+
+class PrefixCache:
+    """Bounded digest-indexed, byte-confirmed prefix-page index
+    (module docstring).  The cache owns NO pages itself — the engine
+    performs every `Scheduler.retain`/`release` on its behalf, driven
+    by the return values here, so allocation stays in one place.
+
+    Parameters
+    ----------
+    capacity_pages : bound on indexed pages; past it the LRU entry is
+        evicted (`register` returns the displaced page ids for the
+        engine to release).
+    """
+
+    def __init__(self, capacity_pages: int = 256):
+        if capacity_pages < 1:
+            raise ValueError(f"capacity_pages must be >= 1, got "
+                             f"{capacity_pages}")
+        self.capacity_pages = int(capacity_pages)
+        # token-prefix tuple -> page id, in LRU order (oldest first)
+        self._entries: OrderedDict = OrderedDict()
+        # digest -> [token-prefix tuple, ...] collision chains
+        self._index: dict = {}
+        self.lookups = 0
+        self.confirmed_hits = 0
+        self.collisions_rejected = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def held_pages(self) -> list:
+        """Page ids the cache currently references (index order)."""
+        return list(self._entries.values())
+
+    # -- the read path ----------------------------------------------------
+
+    def _find(self, prefix: tuple) -> Optional[tuple]:
+        """Digest lookup + the byte confirmation (module docstring).
+        Returns the stored key on a CONFIRMED hit, None otherwise —
+        and counts a digest hit whose bytes differ (the collision a
+        32-bit Fletcher cannot rule out)."""
+        chain = self._index.get(token_digest(prefix))
+        if not chain:
+            return None
+        for key in chain:
+            if key == prefix:            # full byte comparison
+                return key
+        self.collisions_rejected += 1
+        return None
+
+    def lookup(self, prompt: Sequence[int], page_size: int, *,
+               max_pages: Optional[int] = None,
+               peek: bool = False) -> list:
+        """Longest confirmed run of full prefix pages for ``prompt``:
+        page ids for pages 0..k-1 where every page's token prefix is
+        byte-confirmed in the index (pages may come from different
+        registrations — any page registered under the exact prefix
+        holds identical bytes).  ``max_pages`` caps the run (the engine
+        always leaves at least one prompt token to feed); ``peek=True``
+        skips the LRU touch AND the hit statistics (router affinity
+        probes — one per engine per submission — must perturb neither
+        the deterministic eviction order nor the hit-rate numbers).
+
+        The Fletcher sums are prefix-extendable, so the scan carries
+        (s1, s2) across pages instead of re-hashing each prefix from
+        scratch, and only materializes the prefix tuple (for the byte
+        confirmation) when the digest chain is non-empty — a miss
+        costs O(page_size) per page, not O(prefix)."""
+        if not peek:
+            self.lookups += 1
+        limit = len(prompt) // page_size
+        if max_pages is not None:
+            limit = min(limit, max_pages)
+        pages = []
+        s1 = s2 = 0
+        for j in range(limit):
+            for t in prompt[j * page_size:(j + 1) * page_size]:
+                s1 = (s1 + int(t) + 1) % _MOD
+                s2 = (s2 + s1) % _MOD
+            chain = self._index.get((s2 << 16) | s1)
+            if not chain:
+                break
+            prefix = tuple(int(t) for t in prompt[:(j + 1) * page_size])
+            key = next((k for k in chain if k == prefix), None)
+            if key is None:
+                self.collisions_rejected += 1
+                break
+            if not peek:
+                self._entries.move_to_end(key)
+                self.confirmed_hits += 1
+            pages.append(self._entries[key])
+        return pages
+
+    # -- the write path ---------------------------------------------------
+
+    def register(self, prefix: Sequence[int], page_id: int) -> tuple:
+        """Index ``page_id`` as holding the K/V of exactly ``prefix``.
+        Returns ``(fresh, evicted_page_ids)``: ``fresh`` is False when
+        an identical prefix is already indexed (the caller keeps its
+        reference count unchanged); ``evicted_page_ids`` are LRU
+        entries displaced past capacity — the caller releases each."""
+        prefix = tuple(int(t) for t in prefix)
+        if not prefix:
+            raise ValueError("cannot register an empty prefix")
+        if self._find(prefix) is not None:
+            return False, []
+        self._entries[prefix] = int(page_id)
+        self._index.setdefault(token_digest(prefix), []).append(prefix)
+        evicted = []
+        while len(self._entries) > self.capacity_pages:
+            pid = self.evict_lru()
+            if pid is not None:
+                evicted.append(pid)
+        return True, evicted
+
+    def evict_lru(self) -> Optional[int]:
+        """Drop the least-recently-used entry; returns its page id for
+        the caller to release (None when empty).  Capacity bounding —
+        refcounts are irrelevant there, the index must stay bounded."""
+        if not self._entries:
+            return None
+        key, pid = self._entries.popitem(last=False)
+        self._unindex(key)
+        return pid
+
+    def evict_where(self, pred) -> Optional[int]:
+        """Drop the OLDEST entry whose page id satisfies ``pred`` and
+        return it (None when no entry qualifies).  The make-room path
+        uses this with a sole-reference predicate: evicting an entry
+        whose page a live slot still shares releases a reference but
+        frees nothing, so those entries are skipped — they stay useful
+        and the caller's free-list target stays honest."""
+        for key, pid in self._entries.items():
+            if pred(pid):
+                del self._entries[key]
+                self._unindex(key)
+                return pid
+        return None
+
+    def invalidate_page(self, page_id: int) -> bool:
+        """Drop every entry referencing ``page_id`` (a corrupt page
+        must never be served to a future tenant).  Returns True when
+        something was dropped — the caller then releases the cache's
+        reference once."""
+        victims = [k for k, p in self._entries.items() if p == page_id]
+        for k in victims:
+            del self._entries[k]
+            self._unindex(k)
+        return bool(victims)
+
+    def _unindex(self, key: tuple) -> None:
+        d = token_digest(key)
+        chain = self._index.get(d, [])
+        if key in chain:
+            chain.remove(key)
+        if not chain:
+            self._index.pop(d, None)
+
+    # -- snapshot persistence ---------------------------------------------
+
+    def state_dict(self) -> dict:
+        """JSON-able snapshot — entries in LRU order so a restored
+        engine resumes with identical eviction behaviour."""
+        return {"capacity_pages": self.capacity_pages,
+                "entries": [{"tokens": list(k), "page_id": p}
+                            for k, p in self._entries.items()]}
+
+    def load_state_dict(self, state: dict) -> "PrefixCache":
+        self.capacity_pages = int(state["capacity_pages"])
+        self._entries = OrderedDict()
+        self._index = {}
+        for ent in state["entries"]:
+            key = tuple(int(t) for t in ent["tokens"])
+            self._entries[key] = int(ent["page_id"])
+            self._index.setdefault(token_digest(key), []).append(key)
+        return self
+
+    def __repr__(self) -> str:
+        return (f"PrefixCache(entries={len(self._entries)}, "
+                f"capacity={self.capacity_pages}, "
+                f"hits={self.confirmed_hits}, "
+                f"collisions_rejected={self.collisions_rejected})")

@@ -1,0 +1,489 @@
+"""Fleet — N `ServeEngine`s behind one front door (ISSUE 13 tentpole).
+
+The fleet steps its member engines in LOCKSTEP on one shared step
+clock, so everything the serving stack already guarantees per engine —
+deterministic replay, exact counters, the zero-silent-drops contract —
+lifts to fleet scope unchanged: two runs of the same (model, trace,
+plans) produce identical fleet AND per-engine counters.
+
+**Routing** (`Fleet.submit`): requests are scored against live
+per-engine signals — exactly the quantities the PR 10 admission
+machinery already computes:
+
+| signal            | source                                | meaning |
+|-------------------|---------------------------------------|---------|
+| ``rung_sheds``    | `ServeSupervisor.rung.shed_class_above` | the engine's degradation rung would SHED this class |
+| ``ttft_bound``    | `Scheduler.ttft_bound_steps(req)`     | structural lower bound on first-token dispatches |
+| ``prefix_hits``   | `PrefixCache.lookup(..., peek=True)`  | full prefix pages already resident (affinity) |
+| ``page_util``     | `Scheduler.page_utilization()`        | pool pressure |
+| ``queue_len``     | ``len(Scheduler.queue)``              | backlog depth |
+
+Per-SLA-class policy (docs/SERVING.md "Fleet" has the table):
+class 0 (premium) routes **least-TTFT-bound** — (rung_sheds,
+ttft_bound, -prefix_hits, page_util, queue_len, index); best-effort
+(class >= 1) routes **load-spread with prefix affinity** —
+(rung_sheds, -prefix_hits, page_util, queue_len, ttft_bound, index).
+Ties fall to the engine index, so routing is deterministic.
+
+A SHED verdict triggers **bounded retry** on the next-best engine
+(``retry_limit``, default: every engine once); only when every tried
+engine sheds is the rid resolved at FLEET scope (``Fleet.shed`` store,
+``fleet_shed`` counter) — `Fleet.unresolved()` is therefore empty on a
+drained fleet: every submitted rid resolved FINISHED/SHED/DEADLINE_MISS
+*somewhere*, across routing retries, migration and engine kills.
+
+**Recovery** (the ``engine_kill@s:e`` fleet fault kind): the fleet
+keeps, per engine, the last periodic digest-sealed snapshot
+(`ServeEngine.snapshot`, every ``snapshot_every`` steps plus one at
+construction) and a **replay log** of every control-plane operation
+since (submissions — shed attempts included — capsule adoptions,
+extractions, queue withdrawals, in order).  A killed engine is rebuilt
+by restoring the snapshot and re-applying the log while stepping back
+up to the fleet clock — deterministically identical state to the
+moment of death, because every engine step is a pure function of
+(state, submissions) — and is then **drained**: admissions close,
+queued work re-routes to the survivors, live sessions migrate out
+where capacity allows (`fleet.migrate`), and whatever cannot move
+finishes locally.  Zero silent drops, counters exact across runs (the
+fleet-smoke drill pins it, ×2).
+
+Scale-in and engine replacement reuse the same two primitives:
+`Fleet.drain_engine` (migrate + re-route + close admissions) and
+`Fleet.migrate` (one session, bitwise resume).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Optional
+
+from ..resilience.inject import FLEET_KINDS, FaultPlan
+from ..serve.engine import ResultStore, ServeEngine
+from ..serve.scheduler import FREE, SHED
+from .migrate import can_adopt, extract_capsule, migrate_session, \
+    restore_capsule
+from .prefix import PrefixCache
+
+__all__ = ["Fleet"]
+
+_FLEET_COUNTERS = ("submitted", "routed", "router_retries", "fleet_shed",
+                   "migrations", "requeued", "engine_kills",
+                   "sessions_recovered", "drains",
+                   "fleet_faults_unfired")
+
+
+class Fleet:
+    """N engines, one front door (module docstring).
+
+    Parameters
+    ----------
+    model, params : shared by every engine (the fleet serves ONE
+        model; jitted step programs are shared through the serve-side
+        step cache, so N engines compile once).
+    n_engines : fleet width.
+    engine_kw : `ServeEngine` keyword dict applied to every engine
+        (n_slots, max_seq, kv_format, ...).
+    prefix_cache_pages : when set, every engine gets its own
+        `PrefixCache(capacity_pages=...)` — per-engine, because page
+        ids are pool-local; the router's affinity signal steers
+        shared-prefix traffic back to the engine holding the pages.
+    fault_plan : fleet-clock chaos (`FLEET_KINDS`: ``engine_kill``).
+        Requires ``snapshot_every`` > 0 and ``snapshot_dir`` — a kill
+        without a snapshot to recover from would be a guaranteed drop,
+        so it fails fast here instead.
+    engine_plans : optional per-engine `FaultPlan` list (the serving
+        chaos kinds, aimed at individual engines).
+    tracers : optional per-engine `obs.Tracer` list — each engine's
+        timeline becomes its own process lane in the merged Chrome
+        trace (`obs.export.merge_chrome_traces`).
+    retry_limit : max engines tried per submission (default: all).
+    snapshot_every : periodic per-engine snapshot cadence in fleet
+        steps (0 = never; then engine kills cannot be recovered).
+    snapshot_dir : directory for ``engine<i>`` snapshot subdirs.
+    """
+
+    def __init__(self, model, params, n_engines: int = 2, *,
+                 engine_kw: Optional[dict] = None,
+                 prefix_cache_pages: Optional[int] = None,
+                 fault_plan: Optional[FaultPlan] = None,
+                 engine_plans: Optional[list] = None,
+                 tracers: Optional[list] = None,
+                 retry_limit: Optional[int] = None,
+                 snapshot_every: int = 0,
+                 snapshot_dir: Optional[str] = None,
+                 finished_cap: int = 4096):
+        if n_engines < 1:
+            raise ValueError(f"n_engines must be >= 1, got {n_engines}")
+        if engine_plans is not None and len(engine_plans) != n_engines:
+            raise ValueError(f"engine_plans must have one entry per "
+                             f"engine ({n_engines}), got "
+                             f"{len(engine_plans)}")
+        if tracers is not None and len(tracers) != n_engines:
+            raise ValueError(f"tracers must have one entry per engine "
+                             f"({n_engines}), got {len(tracers)}")
+        self._kills = list(fault_plan.fleet_faults()) if fault_plan \
+            else []
+        if fault_plan is not None:
+            other = [f for f in fault_plan.faults
+                     if f.kind not in FLEET_KINDS]
+            if other:
+                # "counted, never silent": the fleet consumes ONLY the
+                # fleet-clock kinds — engine-clock specs riding this
+                # plan would neither fire nor surface in any unfired
+                # report, which is exactly the hole report_unfired
+                # exists to close
+                raise ValueError(
+                    f"fleet fault_plan carries non-fleet kinds "
+                    f"{sorted({f.kind for f in other})} — aim engine-"
+                    f"clock chaos at individual engines via "
+                    f"engine_plans=[...]")
+        if self._kills and (snapshot_every < 1 or not snapshot_dir):
+            raise ValueError(
+                "engine_kill in the fault plan needs snapshot_every >= 1 "
+                "and a snapshot_dir — a kill with no snapshot to recover "
+                "from is a guaranteed silent drop, refused up front")
+        self.model = model
+        self.params = params
+        self.n_engines = int(n_engines)
+        self._engine_kw = dict(engine_kw or {})
+        self._cache_pages = prefix_cache_pages
+        self.retry_limit = retry_limit
+        self.snapshot_every = int(snapshot_every)
+        self.snapshot_dir = snapshot_dir
+        self.engines = []
+        for i in range(n_engines):
+            kw = dict(self._engine_kw)
+            if prefix_cache_pages is not None:
+                kw["prefix_cache"] = PrefixCache(prefix_cache_pages)
+            if engine_plans is not None:
+                kw["fault_plan"] = engine_plans[i]
+            if tracers is not None:
+                kw["tracer"] = tracers[i]
+            self.engines.append(ServeEngine(model, params, **kw))
+        self.accepting = [True] * n_engines
+        # rid -> engine index, pruned to LIVE rids every step (resolved
+        # placements age out — the fleet must not regrow the unbounded
+        # dict the PR 10 ResultStore killed)
+        self.placement: dict = {}
+        self.shed = ResultStore(finished_cap)   # fleet-scope sheds
+        self.counters = {k: 0 for k in _FLEET_COUNTERS}
+        # bounded like the engine event log (~few events per incident)
+        self.events = deque(maxlen=8 * finished_cap)
+        self.step_index = 0
+        # per-engine control-plane replay logs since the last snapshot:
+        # (step, op, payload) with op in submit/adopt/extract/withdraw.
+        # Recorded ONLY when snapshotting is on — replay exists solely
+        # for engine_kill recovery, and without snapshots the log would
+        # retain every Request forever
+        self._replay_enabled = bool(self.snapshot_every
+                                    and self.snapshot_dir)
+        self._logs: list = [[] for _ in range(n_engines)]
+        if self._replay_enabled:
+            for i in range(n_engines):
+                self._snapshot_engine(i)
+
+    # -- routing ----------------------------------------------------------
+
+    def _signals(self, i: int, req) -> tuple:
+        """One engine's routing score components for ``req``."""
+        e = self.engines[i]
+        sup = e.supervisor
+        rung_sheds = int(sup is not None
+                         and sup.rung.shed_class_above is not None
+                         and req.sla_class >= sup.rung.shed_class_above)
+        bound = e.sched.ttft_bound_steps(req)
+        hits = 0
+        if e.prefix_cache is not None:
+            max_share = (len(req.prompt) - 1) // e.sched.page_size
+            if max_share >= 1:
+                hits = len(e.prefix_cache.lookup(
+                    req.prompt, e.sched.page_size,
+                    max_pages=max_share, peek=True))
+        return (rung_sheds, bound, hits,
+                e.sched.page_utilization(), len(e.sched.queue))
+
+    def rank_engines(self, req, exclude: tuple = ()) -> list:
+        """Engine indices best-first for ``req`` under the per-SLA-class
+        policy (module docstring table).  Deterministic: every
+        tiebreak ends at the engine index."""
+        keyed = []
+        for i in range(self.n_engines):
+            if i in exclude or not self.accepting[i]:
+                continue
+            rung_sheds, bound, hits, util, qlen = self._signals(i, req)
+            if req.sla_class == 0:
+                key = (rung_sheds, bound, -hits, util, qlen, i)
+            else:
+                key = (rung_sheds, -hits, util, qlen, bound, i)
+            keyed.append((key, i))
+        return [i for _key, i in sorted(keyed)]
+
+    def _log(self, idx: int, op: str, payload) -> None:
+        if self._replay_enabled:
+            self._logs[idx].append((self.step_index, op, payload))
+
+    def _place(self, req, order: list, shed_reason: str) -> tuple:
+        """The ONE try-engines-best-first loop behind `submit` and the
+        drain requeue — same bounded retry budget on both paths."""
+        limit = len(order) if self.retry_limit is None \
+            else min(self.retry_limit, len(order))
+        for pos, idx in enumerate(order[:limit]):
+            verdict = self.engines[idx].submit(req)
+            self._log(idx, "submit", req)
+            if verdict != SHED:
+                self.placement[req.rid] = idx
+                return verdict, idx
+            if pos + 1 < limit:
+                self.counters["router_retries"] += 1
+        self.shed.put(req.rid, shed_reason)
+        self.counters["fleet_shed"] += 1
+        self.events.append(("fleet_shed", self.step_index, req.rid))
+        return SHED, -1
+
+    def submit(self, req) -> tuple:
+        """Route one request: try engines best-first, bounded
+        retry-on-SHED, fleet-scope SHED when every tried engine sheds.
+        Returns ``(verdict, engine_index)`` (index -1 on fleet shed).
+
+        Validation runs BEFORE the submitted counter moves
+        (`ServeEngine.submit`'s phantom rule, fleet edition): an
+        impossible request raising out of an engine after the count
+        would read as a permanent fleet-scope silent drop.  Engines
+        share one config, so any scheduler speaks for all."""
+        self.engines[0].sched.validate(req)
+        self.counters["submitted"] += 1
+        verdict, idx = self._place(req, self.rank_engines(req),
+                                   "fleet-admission")
+        if idx >= 0:
+            self.counters["routed"] += 1
+        return verdict, idx
+
+    # -- the fleet step ---------------------------------------------------
+
+    def _kill_fireable(self, f) -> bool:
+        """A kill spec can still fire iff its target engine is still
+        accepting — drained engines never re-open, so a spec aimed at
+        one is permanently unfireable WHATEVER its step (running the
+        clock toward it would step a drained fleet for nothing).  It
+        stays pending only for `report_unfired`."""
+        return self.accepting[max(int(f.arg), 0) % self.n_engines]
+
+    def has_pending_faults(self) -> bool:
+        """True while ``engine_kill`` specs can still fire — the fleet
+        load generator keeps the step clock running toward them (the
+        `req_burst` convention lifted to fleet scope).  Unfireable
+        specs (target already drained) are excluded, so a double-kill
+        plan cannot livelock `run_fleet_trace`; they surface through
+        `report_unfired` instead."""
+        return any(self._kill_fireable(f) for f in self._kills)
+
+    def step(self) -> None:
+        s = self.step_index
+        self._fire_fleet_faults(s)
+        for e in self.engines:
+            e.step()
+        if self._replay_enabled and (s + 1) % self.snapshot_every == 0:
+            for i in range(self.n_engines):
+                self._snapshot_engine(i)
+        # resolved placements age out (bounded control-plane state):
+        # only rids still in flight somewhere need their routing home
+        self.placement = {rid: i for rid, i in self.placement.items()
+                          if rid in self.engines[i]._inflight}
+        self.step_index += 1
+
+    def drained(self) -> bool:
+        return all(e.drained() for e in self.engines)
+
+    def run_until_drained(self, max_steps: int = 100000) -> None:
+        while not self.drained():
+            if self.step_index >= max_steps:
+                busy = [i for i, e in enumerate(self.engines)
+                        if not e.drained()]
+                raise RuntimeError(
+                    f"fleet not drained after {max_steps} steps "
+                    f"(busy engines: {busy})")
+            self.step()
+
+    def unresolved(self) -> list:
+        """Submitted rids not yet resolved anywhere in the fleet —
+        empty on a drained fleet (the fleet-scope zero-silent-drops
+        acceptance check; migrations move the obligation with the
+        session, fleet sheds resolve it here)."""
+        out: set = set()
+        for e in self.engines:
+            out.update(e.unresolved())
+        return sorted(out)
+
+    def report_unfired(self) -> list:
+        """Fleet fault specs that never fired (e.g. an ``engine_kill``
+        scheduled past the end of the trace) — counted, never silent;
+        the fleet twin of `ServeEngine.report_unfired` (which every
+        member engine still runs for its own kinds)."""
+        for e in self.engines:
+            e.report_unfired()
+        self.counters["fleet_faults_unfired"] = len(self._kills)
+        return sorted(self._kills)
+
+    def aggregate_counters(self) -> dict:
+        """Sum of every engine's counter dict (per-engine truth stays
+        on the engines; this is the fleet roll-up the metrics and the
+        ``cpd_fleet_*`` family report)."""
+        out: dict = {}
+        for e in self.engines:
+            for k, v in e.counters.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    # -- chaos: engine kill -> snapshot+replay recovery -> drain ----------
+
+    def _fire_fleet_faults(self, s: int) -> None:
+        still = []
+        for f in self._kills:
+            if f.step > s:
+                still.append(f)
+                continue
+            target = max(int(f.arg), 0) % self.n_engines
+            if not self.accepting[target]:
+                still.append(f)      # held: already dead/draining
+                continue
+            self._kill_engine(target, s)
+        self._kills = still
+
+    def _snapshot_engine(self, i: int) -> None:
+        path = os.path.join(self.snapshot_dir, f"engine{i}")
+        self.engines[i].snapshot(path)
+        self._logs[i] = []
+
+    def _kill_engine(self, idx: int, s: int) -> None:
+        """The ``engine_kill`` handler (module docstring): rebuild the
+        engine from its last snapshot + the deterministic replay log,
+        then drain it onto the survivors."""
+        self.counters["engine_kills"] += 1
+        self.events.append(("engine_kill", s, idx))
+        dead = self.engines[idx]
+        path = os.path.join(self.snapshot_dir, f"engine{idx}")
+        # capacity is adopted from the snapshot blob on load; the
+        # constructor arg is a placeholder
+        cache = (PrefixCache(self._cache_pages or 1)
+                 if dead.prefix_cache is not None else None)
+        restored = ServeEngine.restore(self.model, self.params, path,
+                                       prefix_cache=cache)
+        self.engines[idx] = restored
+        log = self._logs[idx]
+        for fs in range(restored.step_index, s):
+            self._replay_ops(idx, log, fs)
+            restored.step()
+        self._replay_ops(idx, log, s)
+        # the obs lane re-attaches AFTER the replay — the dead engine's
+        # tracer already holds the pre-kill timeline, and replaying
+        # into it would duplicate every event
+        restored.tracer = dead.tracer
+        restored.flight = dead.flight
+        self.counters["sessions_recovered"] += (
+            sum(sl.state != FREE for sl in restored.sched.slots)
+            + len(restored.sched.queue))
+        self.drain_engine(idx)
+
+    def _replay_ops(self, idx: int, log: list, fs: int) -> None:
+        eng = self.engines[idx]
+        for step, op, payload in log:
+            if step != fs:
+                continue
+            if op == "submit":
+                eng.submit(payload)
+            elif op == "adopt":
+                restore_capsule(eng, payload)
+            elif op == "extract":
+                extract_capsule(eng, payload)
+            elif op == "withdraw":
+                eng.withdraw(payload)
+
+    def drain_engine(self, idx: int) -> dict:
+        """Close engine ``idx`` to new work and move what can move:
+        queued requests re-route through the router (excluding the
+        drained engine), live sessions migrate out where a survivor
+        can adopt them; the remainder completes locally (the engine
+        keeps stepping with admissions closed).  Returns the drain
+        summary.  Also the scale-in primitive."""
+        self.counters["drains"] += 1
+        self.accepting[idx] = False
+        e = self.engines[idx]
+        moved_q = moved_s = stayed = 0
+        for q in list(e.sched.queue):
+            req = e.withdraw(q.rid)
+            self._log(idx, "withdraw", q.rid)
+            self.placement.pop(q.rid, None)
+            self._requeue(req, exclude=(idx,))
+            moved_q += 1
+        for sl in list(e.sched.slots):
+            if sl.state == FREE:
+                continue
+            rid = sl.req.rid
+            target = self._adopt_target(len(sl.pages), exclude=(idx,))
+            if target is None:
+                stayed += 1
+                continue
+            self.migrate(rid, target)
+            moved_s += 1
+        self.events.append(("drain", self.step_index, idx,
+                            moved_q, moved_s, stayed))
+        return {"requeued": moved_q, "migrated": moved_s,
+                "stayed": stayed}
+
+    def _requeue(self, req, exclude: tuple) -> tuple:
+        """Re-place a withdrawn request (already counted submitted) on
+        another engine — same `_place` loop and retry budget as the
+        front door; all-shed resolves at fleet scope like submit."""
+        verdict, idx = self._place(
+            req, self.rank_engines(req, exclude=exclude), "fleet-drain")
+        if idx >= 0:
+            self.counters["requeued"] += 1
+            self.events.append(("requeue", self.step_index, req.rid,
+                                idx))
+        return verdict, idx
+
+    # -- migration --------------------------------------------------------
+
+    def _adopt_target(self, n_pages: int,
+                      exclude: tuple = ()) -> Optional[int]:
+        """Least-loaded accepting engine that can adopt ``n_pages``
+        right now (None when nobody can) — deterministic tiebreak on
+        the index."""
+        best = None
+        for i, e in enumerate(self.engines):
+            if i in exclude or not self.accepting[i]:
+                continue
+            if not can_adopt(e, n_pages):
+                continue
+            key = (e.sched.page_utilization(), len(e.sched.queue), i)
+            if best is None or key < best[0]:
+                best = (key, i)
+        return None if best is None else best[1]
+
+    def migrate(self, rid: int, dst: Optional[int] = None) -> int:
+        """Live-migrate ``rid`` to engine ``dst`` (default: the best
+        adoptable target).  The session's remaining decode is bitwise
+        identical to the unmigrated run (fleet-smoke gate).  Returns
+        the destination index."""
+        src = self.placement.get(rid)
+        if src is None:
+            raise ValueError(f"rid {rid} is not placed on this fleet")
+        slot = self.engines[src].slot_of_rid(rid)
+        if slot is None:
+            raise ValueError(f"rid {rid} has no live slot on engine "
+                             f"{src} (queued or already resolved)")
+        if dst is None:
+            dst = self._adopt_target(len(slot.pages), exclude=(src,))
+            if dst is None:
+                raise RuntimeError(
+                    f"no engine can adopt rid {rid} "
+                    f"({len(slot.pages)} pages) right now")
+        capsule = migrate_session(self.engines[src], self.engines[dst],
+                                  rid)
+        self._log(src, "extract", rid)
+        self._log(dst, "adopt", capsule)
+        self.placement[rid] = dst
+        self.counters["migrations"] += 1
+        self.events.append(("migrate", self.step_index, rid, src, dst))
+        return dst

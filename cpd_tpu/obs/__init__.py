@@ -28,7 +28,8 @@ cpd_tpu/utils).
 """
 
 from .export import (export_chrome_trace, export_jsonl,
-                     export_prometheus, parse_prometheus, write_all)
+                     export_prometheus, merge_chrome_traces,
+                     parse_prometheus, write_all)
 from .flight import FlightRecorder
 from .registry import MetricsRegistry
 from .timing import Stopwatch, Timer, now
@@ -36,5 +37,6 @@ from .trace import NULL_TRACER, Span, Tracer
 
 __all__ = ["Tracer", "Span", "NULL_TRACER", "MetricsRegistry",
            "FlightRecorder", "export_jsonl", "export_prometheus",
-           "export_chrome_trace", "parse_prometheus", "write_all",
+           "export_chrome_trace", "merge_chrome_traces",
+           "parse_prometheus", "write_all",
            "now", "Stopwatch", "Timer"]

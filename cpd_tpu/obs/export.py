@@ -29,7 +29,7 @@ import re
 from typing import Optional
 
 __all__ = ["export_jsonl", "export_prometheus", "export_chrome_trace",
-           "parse_prometheus", "write_all"]
+           "merge_chrome_traces", "parse_prometheus", "write_all"]
 
 
 # ---------------------------------------------------------------------------
@@ -199,19 +199,20 @@ def parse_prometheus(text: str) -> dict:
 # Chrome trace-event JSON
 # ---------------------------------------------------------------------------
 
-def export_chrome_trace(tracer, path: str, *,
-                        strip_wall: bool = False) -> str:
-    """Write the Perfetto/chrome://tracing-loadable trace.  Spans are
+def _chrome_rows(tracer, pid: int, strip_wall: bool,
+                 process_name: str) -> list:
+    """One tracer's Chrome events on process lane ``pid`` — the shared
+    body of the single-run export and the fleet merge.  Spans are
     complete ("X") events on tid 0; per-request serve events
     (cat="req") are instants on ``tid = rid + 1`` (offset past the
     span lane at tid 0) so each request reads as its own lane.
     ``strip_wall`` replaces every wall-derived ts/dur with the
     deterministic seq clock (1 µs per seq tick)."""
-    events = [{"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
-               "args": {"name": f"cpd_tpu:{tracer.run}"}}]
+    events = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+               "args": {"name": process_name}}]
     rows = []
     for seq, name, cat, step, t0, dur, depth, args in tracer.spans:
-        ev = {"ph": "X", "name": name, "cat": cat, "pid": 1, "tid": 0,
+        ev = {"ph": "X", "name": name, "cat": cat, "pid": pid, "tid": 0,
               "ts": seq if strip_wall else round(t0 * 1e6, 3),
               "dur": 1 if strip_wall else round(dur * 1e6, 3),
               "args": {**({"step": step} if step is not None else {}),
@@ -220,15 +221,46 @@ def export_chrome_trace(tracer, path: str, *,
     for seq, name, cat, step, wall, args in tracer.events:
         a = dict(args)
         tid = int(a.get("rid", 0)) + 1 if cat == "req" else 0
-        ev = {"ph": "i", "s": "t", "name": name, "cat": cat, "pid": 1,
+        ev = {"ph": "i", "s": "t", "name": name, "cat": cat, "pid": pid,
               "tid": tid,
               "ts": seq if strip_wall else round(wall * 1e6, 3),
               "args": {**({"step": step} if step is not None else {}),
                        **a}}
         rows.append((seq, ev))
     events.extend(ev for _seq, ev in sorted(rows, key=lambda x: x[0]))
+    return events
+
+
+def export_chrome_trace(tracer, path: str, *,
+                        strip_wall: bool = False) -> str:
+    """Write the Perfetto/chrome://tracing-loadable trace
+    (`_chrome_rows` has the lane layout)."""
+    events = _chrome_rows(tracer, 1, strip_wall,
+                          f"cpd_tpu:{tracer.run}")
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "otherData": {"run": tracer.run, **tracer.meta}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True, separators=(",", ":"))
+    return path
+
+
+def merge_chrome_traces(tracers, path: str, *, strip_wall: bool = False,
+                        run: str = "fleet") -> str:
+    """ONE merged timeline for a multi-engine fleet run (ISSUE 13):
+    engine ``i``'s tracer becomes process lane ``pid = i + 1`` (named
+    ``cpd_tpu:<run>:engine<i>``), with its per-request rid lanes
+    nested inside — so a migrated session reads as an instant stream
+    hopping between process lanes at the migration step.  The same
+    ``strip_wall`` determinism contract as `export_chrome_trace`
+    applies per lane (``ts`` falls back to each tracer's own seq
+    clock)."""
+    tracers = list(tracers)
+    events = []
+    for i, tracer in enumerate(tracers):
+        events.extend(_chrome_rows(tracer, i + 1, strip_wall,
+                                   f"cpd_tpu:{run}:engine{i}"))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"run": run, "engines": len(tracers)}}
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, sort_keys=True, separators=(",", ":"))
     return path

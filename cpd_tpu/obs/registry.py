@@ -189,11 +189,28 @@ class MetricsRegistry:
         if info:
             self.set_gauge(f"cpd_sup_{which}_info", 1.0, **info)
 
-    def absorb_serve_counters(self, counters: dict) -> None:
+    def absorb_serve_counters(self, counters: dict,
+                              engine: Optional[int] = None) -> None:
         """The serve engine's counter dict — ``cpd_serve_<key>``,
-        mirrored (the engine holds cumulative truth)."""
+        mirrored (the engine holds cumulative truth).  ``engine``
+        labels the series with the fleet member index, so an N-engine
+        fleet exports N distinguishable series per counter."""
+        labels = {} if engine is None else {"engine": engine}
         for key, value in counters.items():
-            self.mirror(f"cpd_serve_{key}", float(value))
+            self.mirror(f"cpd_serve_{key}", float(value), **labels)
+
+    def absorb_fleet_counters(self, fleet) -> None:
+        """A `cpd_tpu.fleet.Fleet` — the ``cpd_fleet_*`` family
+        (ISSUE 13): the fleet's own counters (routing, retries,
+        migrations, kills, recoveries) mirrored unlabelled, plus every
+        member engine's counters as engine-labelled ``cpd_serve_*``
+        series."""
+        for key, value in fleet.counters.items():
+            self.mirror(f"cpd_fleet_{key}", float(value))
+        self.set_gauge("cpd_fleet_engines", float(fleet.n_engines))
+        self.set_gauge("cpd_fleet_step_index", float(fleet.step_index))
+        for i, eng in enumerate(fleet.engines):
+            self.absorb_serve_counters(eng.counters, engine=i)
 
     # -- reads ------------------------------------------------------------
 

@@ -432,6 +432,40 @@ def kv_page_bytes(exp_bits: int, man_bits: int, page_size: int,
     return 2 * page_size * row
 
 
+def kv_pool_bytes(exp_bits: int, man_bits: int, page_size: int,
+                  n_kv_heads: int, head_dim: int, *, n_layers: int,
+                  logical_pages: int, shared_pages: int = 0,
+                  block_size=None) -> dict:
+    """Whole-pool KV accounting with prefix-cache dedup (ISSUE 13
+    satellite): ``logical_pages`` page ids as the requests see them,
+    of which ``shared_pages`` are copy-on-write references to a page
+    another request (or the prefix cache) already holds — so they cost
+    ZERO resident bytes.  A page id spans every layer (the pool is
+    ``(L, n_pages, ...)``), hence the ``n_layers`` factor on
+    `kv_page_bytes` (which prices ONE layer's K+V page, sidecar
+    included under ``block_size``).
+
+    Returns ``{page_bytes, logical_bytes, resident_bytes,
+    saved_bytes}`` — the dedup-savings ledger the fleet bench
+    (`bench_serve --fleet`) prices its prefix-hit sweep with.  Pinned
+    against real pool slices in tests (like the PR 12 sidecar
+    pricing): the analytics can never silently under-report KV
+    memory."""
+    if n_layers < 1:
+        raise ValueError(f"n_layers must be >= 1, got {n_layers}")
+    if logical_pages < 0 or not 0 <= shared_pages <= logical_pages:
+        raise ValueError(
+            f"need 0 <= shared_pages <= logical_pages, got "
+            f"({shared_pages}, {logical_pages})")
+    page = n_layers * kv_page_bytes(exp_bits, man_bits, page_size,
+                                    n_kv_heads, head_dim,
+                                    block_size=block_size)
+    return {"page_bytes": page,
+            "logical_bytes": logical_pages * page,
+            "resident_bytes": (logical_pages - shared_pages) * page,
+            "saved_bytes": shared_pages * page}
+
+
 def _validate_wire(exp_bits: int, man_bits: int) -> None:
     _validate(exp_bits, man_bits)
     if man_bits < 2 and not (exp_bits == 8 and man_bits == 23):

@@ -100,7 +100,7 @@ import numpy as np
 __all__ = ["FaultSpec", "FaultPlan", "Injector", "InjectedPreemption",
            "with_fault_injection", "report_unfired", "GRAD_KINDS",
            "HOST_KINDS", "WIRE_KINDS", "SAT_KINDS", "KV_KINDS",
-           "SERVE_KINDS", "SAT_PRESSURE_DEFAULT_EXP"]
+           "SERVE_KINDS", "FLEET_KINDS", "SAT_PRESSURE_DEFAULT_EXP"]
 
 # jit-level kinds -> corruption opcode in the compiled fault table
 GRAD_KINDS = {"grad_nan": 1, "grad_inf": 2, "grad_blowup": 3}
@@ -129,6 +129,18 @@ KV_KINDS = frozenset({"kv_flip"})
 # `ServeEngine.take_due_bursts`, so the flash crowd is keyed into the
 # plan and replays deterministically).
 SERVE_KINDS = frozenset({"kv_storm", "slot_stall", "req_burst"})
+# fleet-chaos kind (ISSUE 13), on the FLEET step clock (which is also
+# every member engine's step clock — the fleet steps them in lockstep):
+# ``engine_kill@s:e`` kills engine ``e`` of a `cpd_tpu.fleet.Fleet` at
+# fleet step ``s`` — the fleet recovers the engine's state from its
+# last periodic snapshot plus the deterministic submission replay log,
+# then DRAINS it (queued work re-routed, live sessions migrated out
+# where capacity allows, the rest completing locally with admissions
+# closed) with zero silent drops.  The fleet does its own unfired
+# accounting (`Fleet.report_unfired`); in a plain training or
+# single-engine serving plan the kind can never fire and
+# `report_unfired` flags it unless ``fleet_armed=True``.
+FLEET_KINDS = frozenset({"engine_kill"})
 # host-level kinds, executed by the Injector around the step call
 HOST_KINDS = frozenset({
     "batch_nan",       # poison one element of the first float batch leaf
@@ -142,7 +154,7 @@ HOST_KINDS = frozenset({
     "loss_spike",      # multiply the observed loss metric by `arg`
 })
 _ALL_KINDS = (frozenset(GRAD_KINDS) | HOST_KINDS | frozenset(WIRE_KINDS)
-              | SAT_KINDS | KV_KINDS | SERVE_KINDS)
+              | SAT_KINDS | KV_KINDS | SERVE_KINDS | FLEET_KINDS)
 
 
 class InjectedPreemption(BaseException):
@@ -270,6 +282,12 @@ class FaultPlan:
         ``slot_stall`` / ``req_burst`` — all on the serving engine's
         step clock (module docstring)."""
         return tuple(f for f in self.faults if f.kind in SERVE_KINDS)
+
+    def fleet_faults(self) -> tuple:
+        """The fleet-chaos specs (`FLEET_KINDS`): ``engine_kill@s:e``
+        on the fleet step clock (``arg`` is the target engine index,
+        -1 -> engine 0) — consumed by `cpd_tpu.fleet.Fleet.step`."""
+        return tuple(f for f in self.faults if f.kind in FLEET_KINDS)
 
     def host_faults(self) -> dict:
         """step -> [FaultSpec] for the host-level kinds."""
@@ -572,7 +590,8 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
                    wire_armed: bool = True,
                    sat_armed: bool = True,
                    kv_armed: bool = False,
-                   serve_armed: bool = False) -> list:
+                   serve_armed: bool = False,
+                   fleet_armed: bool = False) -> list:
     """The ONE end-of-run check every loop calls: which planned faults
     never fired?  A chaos run that silently skipped a fault proves
     nothing — the usual causes are a plan step beyond the run's
@@ -596,7 +615,10 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     `SERVE_KINDS` (``kv_storm``/``slot_stall``/``req_burst``, ISSUE 10)
     also live on the serving engine's clock and do their own unfired
     accounting there — in a training plan they can never fire and are
-    flagged here.
+    flagged here.  ``fleet_armed`` likewise covers `FLEET_KINDS`
+    (``engine_kill``, ISSUE 13): only a `cpd_tpu.fleet.Fleet` consumes
+    them (its own `Fleet.report_unfired` owns armed accounting), so in
+    any other plan they are flagged.
     Bumps the meter's ``faults_unfired`` counter and warns on rank 0;
     returns the sorted leftover list (empty = every planned fault
     fired)."""
@@ -605,12 +627,16 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     leftover = list(injector.unfired())
     for f in (injector.plan.grad_faults() + injector.plan.wire_faults()
               + injector.plan.sat_faults() + injector.plan.kv_faults()
-              + injector.plan.serve_faults()):
-        if f.kind in KV_KINDS or f.kind in SERVE_KINDS:
-            # engine-clock kinds: the training ``n_steps`` budget says
-            # nothing about them.  Unarmed -> can never fire, flagged;
-            # armed -> the serving engine's own accounting owns them.
-            armed = kv_armed if f.kind in KV_KINDS else serve_armed
+              + injector.plan.serve_faults()
+              + injector.plan.fleet_faults()):
+        if f.kind in KV_KINDS or f.kind in SERVE_KINDS \
+                or f.kind in FLEET_KINDS:
+            # engine/fleet-clock kinds: the training ``n_steps`` budget
+            # says nothing about them.  Unarmed -> can never fire,
+            # flagged; armed -> the consumer's own accounting owns them.
+            armed = (kv_armed if f.kind in KV_KINDS
+                     else serve_armed if f.kind in SERVE_KINDS
+                     else fleet_armed)
             if not armed:
                 leftover.append(f)
             continue

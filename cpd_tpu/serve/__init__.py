@@ -16,8 +16,9 @@ docs/SERVING.md.
 from .engine import ResultStore, ServeEngine
 from .kvcache import KVCacheConfig
 from .loadgen import (bursty_trace, decode_tail_matches, flash_crowd,
-                      mixed_trace, poisson_trace, run_trace,
-                      serial_baseline, timeline_metrics, with_sla)
+                      mixed_trace, poisson_trace, run_fleet_trace,
+                      run_trace, serial_baseline, shared_prefix_trace,
+                      timeline_metrics, with_sla)
 from .model import ModelSpec, spec_from_model
 from .scheduler import ACCEPT, QUEUE, Request, Scheduler, SHED
 from .supervisor import Rung, ServeSupervisor, default_rungs
@@ -27,4 +28,5 @@ __all__ = ["ServeEngine", "ResultStore", "KVCacheConfig", "Request",
            "spec_from_model", "Rung", "ServeSupervisor", "default_rungs",
            "poisson_trace", "bursty_trace", "mixed_trace", "with_sla",
            "flash_crowd", "run_trace", "serial_baseline",
-           "decode_tail_matches", "timeline_metrics"]
+           "decode_tail_matches", "timeline_metrics",
+           "shared_prefix_trace", "run_fleet_trace"]

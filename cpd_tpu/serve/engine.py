@@ -113,7 +113,13 @@ _COUNTERS = ("submitted", "admitted", "completed", "shed",
              "kv_inline_detects", "kv_pages_corrupt",
              "kv_corrupt_free_pages", "kv_repairs", "pages_reserved",
              "pages_freed", "results_evicted", "sup_hot_steps",
-             "sup_degrades", "sup_probations", "kv_faults_unfired")
+             "sup_degrades", "sup_probations", "kv_faults_unfired",
+             # fleet hooks (ISSUE 13): live session migration +
+             # content-addressed prefix-cache sharing
+             "sessions_out", "sessions_in",
+             "prefix_hits", "prefix_pages_shared",
+             "prefix_tokens_skipped", "prefix_registered",
+             "prefix_evictions", "prefix_invalidations")
 
 _SNAP_STATE, _SNAP_META = "state.json", "meta.json"
 _SNAP_POOL, _SNAP_DIGESTS = "pool.npy", "digests.npy"
@@ -246,6 +252,19 @@ class ServeEngine:
         a fresh tracer after `restore`.
     flight : optional `obs.FlightRecorder` — one ring event per engine
         step; dumped automatically by `snapshot` (reason="snapshot").
+    prefix_cache : optional content-addressed prefix cache
+        (`cpd_tpu.fleet.prefix.PrefixCache`, ISSUE 13): full prompt-
+        prefix pages are indexed by token digest and SHARED copy-on-
+        write across requests — an admission whose prompt prefix is
+        byte-confirmed in the cache adopts the cached pages (refcounted
+        via the scheduler, `Scheduler.retain`/`release`) and skips
+        those prefill chunks; sampled logits stay BITWISE identical to
+        the cold path because quantize-on-append makes page bytes a
+        pure function of the token prefix (gated in tests/test_fleet.py
+        and the fleet-smoke).  A digest hit is only shared after a full
+        byte comparison of the token prefixes — a Fletcher collision
+        can never leak one tenant's KV bytes into another's attention
+        window (docs/SERVING.md "Prefix cache").
     """
 
     def __init__(self, model, params, *, n_slots: int = 4,
@@ -258,7 +277,8 @@ class ServeEngine:
                  = None, max_queue: Optional[int] = None,
                  stall_patience: int = 4, finished_cap: int = 4096,
                  temperature: float = 0.0, seed: int = 0,
-                 record_logits: bool = False, tracer=None, flight=None):
+                 record_logits: bool = False, tracer=None, flight=None,
+                 prefix_cache=None):
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if stall_patience < 1:
@@ -341,6 +361,9 @@ class ServeEngine:
         # neither may influence scheduling, sampling or page bytes
         self.tracer = tracer
         self.flight = flight
+        # content-addressed prefix cache (ISSUE 13; class docstring) —
+        # None leaves every path bit-identical to the cache-less engine
+        self.prefix_cache = prefix_cache
 
     # -- public API -------------------------------------------------------
 
@@ -431,9 +454,17 @@ class ServeEngine:
             self._expire_deadlines(s)
             self._watchdog(s)
             with self._span("admit", s):
+                if self.prefix_cache is not None and self.sched.queue:
+                    # cache-held pages are reclaimable capacity: make
+                    # room for the queue head so the cache can never
+                    # starve admission (head-of-line, FIFO preserved)
+                    head = self.sched.queue[0]
+                    if head.arrival <= s:
+                        self._make_room(self.sched.pages_needed(head))
                 for slot in self.sched.admit(s):
                     self.counters["admitted"] += 1
                     self.counters["pages_reserved"] += len(slot.pages)
+                    self._adopt_prefix(slot, s)
                     self._event("admit", slot.req.rid, s,
                                 pages=len(slot.pages))
             with self._span("prefill", s):
@@ -510,11 +541,25 @@ class ServeEngine:
         for slot in self.sched.decode_slots():
             if s - slot.last_progress < self._stall_patience:
                 continue
+            # a slot holding SHARED prefix pages returns fewer pages
+            # than it re-reserves — the free list must cover the shared
+            # count, so reclaim cache-held pages first; if room still
+            # cannot be made (shared with another live slot on a
+            # custom-small pool), LEAVE the stall for a later step
+            # instead of crashing the serve loop mid-allocation
+            shared = sum(1 for p in slot.pages
+                         if self.sched.page_refs.get(p, 0) > 1)
+            self._make_room(shared)
+            if len(self.sched.free_pages) < shared:
+                continue
             self.counters["watchdog_evictions"] += 1
             self._stalled.discard(slot.index)   # recovery clears a stall
-            n = self.sched.reassign_pages(slot)
-            self.counters["pages_freed"] += n
-            self.counters["pages_reserved"] += n
+            npages = len(slot.pages)
+            # freed counts actual pool returns (a shared page survives
+            # its release); the slot re-reserves its full width
+            self.counters["pages_freed"] += self.sched.reassign_pages(
+                slot)
+            self.counters["pages_reserved"] += npages
             self._reprefill(slot, "watchdog_chunks")
             slot.last_progress = s
             self._event("watchdog_evict", slot.req.rid, s)
@@ -542,6 +587,111 @@ class ServeEngine:
             self._event("probate", -1, s,
                         rung=self.supervisor.rung.name,
                         level=self.supervisor.level)
+
+    # -- prefix cache (ISSUE 13; fleet/prefix.py owns the index) ----------
+
+    def _make_room(self, need: int) -> None:
+        """Evict prefix-cache LRU entries until the free list holds
+        ``need`` pages (or nothing reclaimable remains).  Cache-held
+        pages are reclaimable capacity, never a reason to refuse
+        admission — but ONLY entries whose page the cache alone
+        references: evicting an entry a live slot still shares frees
+        nothing, and would flush the cache for zero room (the
+        `can_adopt` sole-reference rule, applied here too)."""
+        if self.prefix_cache is None:
+            return
+        while len(self.sched.free_pages) < need:
+            pid = self.prefix_cache.evict_where(
+                lambda p: self.sched.page_refs.get(p, 0) == 1)
+            if pid is None:
+                return
+            self.sched.release(pid)
+            self.counters["prefix_evictions"] += 1
+
+    def _adopt_prefix(self, slot, s: int) -> None:
+        """Swap the freshly admitted slot's leading pages for cached
+        ones when its prompt prefix is byte-confirmed in the cache:
+        the shared pages are retained (refcount++), the displaced fresh
+        pages return to the pool, and ``fed`` jumps past the shared
+        positions — those prefill chunks never dispatch.  At least one
+        prompt token is always left to feed (the final prompt position's
+        dispatch produces the logits that sample token 0)."""
+        if self.prefix_cache is None:
+            return
+        prompt = slot.req.prompt
+        ps = self.sched.page_size
+        max_share = (len(prompt) - 1) // ps
+        if max_share < 1:
+            return
+        hit = self.prefix_cache.lookup(prompt, ps, max_pages=max_share)
+        if not hit:
+            return
+        k = len(hit)
+        for p in slot.pages[:k]:
+            if self.sched.release(p):
+                self.counters["pages_freed"] += 1
+        for p in hit:
+            self.sched.retain(p)
+        slot.pages = tuple(hit) + slot.pages[k:]
+        slot.fed = k * ps
+        slot.prefix_registered = k     # adopted pages are already indexed
+        self.counters["prefix_hits"] += 1
+        self.counters["prefix_pages_shared"] += k
+        self.counters["prefix_tokens_skipped"] += k * ps
+        self._event("prefix_hit", slot.req.rid, s, pages=k,
+                    tokens=k * ps)
+
+    def _register_prefix_pages(self, slot) -> None:
+        """Index every NEWLY completed prompt-prefix page of the slot
+        (positions fully fed and all prompt tokens) under the token-
+        prefix digest — `Slot.prefix_registered` is the watermark, so
+        a chunked prefill registers each page exactly once.  The cache
+        takes its own reference on a newly registered page, so the K/V
+        bytes outlive the owning request; duplicates (re-registration
+        after a snapshot restore) are dropped by the cache's
+        byte-confirmed dedupe."""
+        if self.prefix_cache is None:
+            return
+        prompt = slot.req.prompt
+        ps = self.sched.page_size
+        full = min(slot.fed, len(prompt)) // ps
+        for j in range(slot.prefix_registered, full):
+            pid = slot.pages[j]
+            fresh, evicted = self.prefix_cache.register(
+                prompt[:(j + 1) * ps], pid)
+            if fresh:
+                self.sched.retain(pid)
+                self.counters["prefix_registered"] += 1
+            for old in evicted:
+                self.sched.release(old)
+                self.counters["prefix_evictions"] += 1
+        slot.prefix_registered = full
+
+    # -- fleet hooks: live session migration (fleet/migrate.py) ----------
+
+    def slot_of_rid(self, rid: int):
+        """The live slot serving ``rid`` (PREFILL or DECODE), or None."""
+        for sl in self.sched.slots:
+            if sl.state != FREE and sl.req is not None \
+                    and sl.req.rid == rid:
+                return sl
+        return None
+
+    def withdraw(self, rid: int):
+        """Remove a QUEUED request WITHOUT resolving it — the fleet
+        drain re-places it on another engine, where it will resolve
+        (zero-silent-drops accounting moves with it; the caller owns
+        re-placement).  Returns the Request, or None if ``rid`` is not
+        queued (live sessions move via `fleet.migrate.extract_capsule`,
+        resolved ones are already final)."""
+        for q in list(self.sched.queue):
+            if q.rid == rid:
+                self.sched.queue.remove(q)
+                self._inflight.discard(rid)
+                self.counters["sessions_out"] += 1
+                self._event("withdraw", rid, self.step_index)
+                return q
+        return None
 
     # -- resolution bookkeeping -------------------------------------------
 
@@ -606,6 +756,7 @@ class ServeEngine:
         slot.last_progress = s
         self.counters["prefill_chunks"] += 1
         self.counters["prompt_tokens"] += n
+        self._register_prefix_pages(slot)
         if slot.fed == len(prompt):
             row = np.asarray(last_logits)
             if self.record_logits:
@@ -685,11 +836,25 @@ class ServeEngine:
         to_repair = []
         for p in bad_pages:
             self.counters["kv_pages_corrupt"] += 1
-            owner = self.sched.owner_of_page(p)
-            if owner is None:
-                self.counters["kv_corrupt_free_pages"] += 1
-            elif owner not in to_repair:
-                to_repair.append(owner)
+            owners = self.sched.owners_of_page(p)
+            if not owners:
+                # no live reader — but a cache-held page would be
+                # SERVED to a future tenant after the digest re-sync
+                # below re-blessed it, so the entry is invalidated and
+                # the page released instead of absorbed
+                if self.prefix_cache is not None \
+                        and self.prefix_cache.invalidate_page(p):
+                    self.sched.release(p)
+                    self.counters["prefix_invalidations"] += 1
+                else:
+                    self.counters["kv_corrupt_free_pages"] += 1
+                continue
+            # a SHARED page has several owners; every one is recomputed
+            # (identical prefixes write identical bytes, so the repairs
+            # agree — the first rewrite already restores the page)
+            for owner in owners:
+                if owner not in to_repair:
+                    to_repair.append(owner)
         for slot in to_repair:
             self.counters["kv_repairs"] += 1
             self._reprefill(slot, "repair_chunks")
@@ -854,6 +1019,8 @@ class ServeEngine:
             "supervisor": (self.supervisor.state_dict()
                            if self.supervisor is not None else None),
             "scheduler": self._sched_state(),
+            "prefix_cache": (self.prefix_cache.state_dict()
+                             if self.prefix_cache is not None else None),
         }
         with open(os.path.join(tmp_dir, _SNAP_STATE), "w") as fh:
             json.dump(state, fh, default=_json_default)
@@ -879,7 +1046,8 @@ class ServeEngine:
         return record
 
     @classmethod
-    def restore(cls, model, params, path: str) -> "ServeEngine":
+    def restore(cls, model, params, path: str,
+                prefix_cache=None) -> "ServeEngine":
         """Rebuild an engine from a `snapshot` directory and resume
         decoding bitwise-identically (the pool is exact bytes — gated
         at (8,23) in tests/test_serve.py and the serve-smoke).  The
@@ -945,6 +1113,20 @@ class ServeEngine:
             eng.supervisor = ServeSupervisor.from_state_dict(
                 state["supervisor"])
         eng._load_sched_state(state["scheduler"])
+        blob = state.get("prefix_cache")
+        if blob is not None and prefix_cache is not None:
+            # exact resume: same index, same held pages, same LRU order
+            prefix_cache.load_state_dict(blob)
+            eng.prefix_cache = prefix_cache
+        elif blob is not None:
+            # cold-cache restore (no cache object supplied): drop the
+            # cache's page references so its held pages return to the
+            # pool instead of leaking — deterministic, documented in
+            # docs/SERVING.md "Prefix cache"
+            for ent in blob["entries"]:
+                eng.sched.release(int(ent["page_id"]))
+        elif prefix_cache is not None:
+            eng.prefix_cache = prefix_cache
         return eng
 
     def _sched_state(self) -> dict:
@@ -959,9 +1141,12 @@ class ServeEngine:
                 "generated": list(sl.generated), "seq": sl.seq,
                 "first_token_step": sl.first_token_step,
                 "last_progress": sl.last_progress,
+                "prefix_registered": sl.prefix_registered,
             } for sl in self.sched.slots],
             "queue": [dataclasses.asdict(q) for q in self.sched.queue],
             "free_pages": list(self.sched.free_pages),
+            "page_refs": {str(p): n
+                          for p, n in sorted(self.sched.page_refs.items())},
             "admit_seq": self.sched._admit_seq,
         }
 
@@ -983,9 +1168,17 @@ class ServeEngine:
             sl.seq = int(d["seq"])
             sl.first_token_step = int(d["first_token_step"])
             sl.last_progress = int(d["last_progress"])
+            sl.prefix_registered = int(d.get("prefix_registered", 0))
         self.sched.queue = deque(req_from(q) for q in state["queue"])
         self.sched.free_pages = deque(int(p)
                                       for p in state["free_pages"])
+        if "page_refs" in state:
+            self.sched.page_refs = {int(p): int(n)
+                                    for p, n in state["page_refs"].items()}
+        else:
+            # pre-refcount snapshot: every live slot page held once
+            self.sched.page_refs = {int(p): 1 for sl in self.sched.slots
+                                    for p in sl.pages}
         self.sched._admit_seq = int(state["admit_seq"])
 
     # -- misc -------------------------------------------------------------

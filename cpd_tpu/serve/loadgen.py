@@ -39,14 +39,16 @@ Reported metrics (the `bench.py` ``serving`` block schema):
   FINISHED/SHED/DEADLINE_MISS.  Zero is the structural contract.
 * the engine counter dict, verbatim.
 
-The per-request metrics (ttft/tpot percentiles, goodput splits) read
-the engine's BOUNDED stores: a trace longer than the engine's
-``finished_cap`` ages out its earliest resolutions mid-run, so those
-metrics then cover only the retained window.  That truncation is never
-silent — ``metrics_truncated`` is True whenever the engine evicted
-results (counter-derived numbers: tok/s, counts, shed/miss rates stay
-exact regardless).  Size ``finished_cap`` to the trace for full-window
-percentiles.
+The per-request metrics (ttft/tpot percentiles, goodput splits) derive
+from the engine's TIMELINE when a tracer is attached (ISSUE 13: a
+``finished`` entry the bounded `ResultStore` evicted mid-run still has
+its ``complete`` event in the timeline, so `timeline_metrics`'s
+reconstruction stays float-for-float even with the store held at cap);
+only a saturated tracer ring then truncates them.  Without a tracer
+they read the BOUNDED stores, so a trace longer than ``finished_cap``
+covers only the retained window.  Truncation is never silent either
+way — ``metrics_truncated`` flags it (counter-derived numbers: tok/s,
+counts, shed/miss rates stay exact regardless).
 
 `serial_baseline` replays the same trace through sequential
 `models.generate` calls (batch 1, the pre-serve inference surface) —
@@ -65,7 +67,8 @@ from .scheduler import Request
 
 __all__ = ["poisson_trace", "bursty_trace", "mixed_trace", "with_sla",
            "flash_crowd", "run_trace", "serial_baseline",
-           "decode_tail_matches", "timeline_metrics"]
+           "decode_tail_matches", "timeline_metrics",
+           "shared_prefix_trace", "run_fleet_trace"]
 
 
 def decode_tail_matches(original, mark: int, restored) -> int:
@@ -229,11 +232,12 @@ def timeline_metrics(tracer, *, sla_ttft_ms: float = 1000.0,
     into the tracer (``step_begin``).  Reconstruction then repeats the
     identical arithmetic on the identical floats.
 
-    Caveat (same honesty flag as ``metrics_truncated``): a run whose
-    engine evicted finished-store entries mid-trace publishes n_gen=0
-    for the evicted rids while the timeline still knows their true
-    counts — reconstruction parity is guaranteed only for runs with
-    ``results_evicted == 0`` and an unsaturated tracer ring."""
+    Parity holds even when the bounded `ResultStore` evicted finished
+    entries mid-trace (ISSUE 13 satellite — the PR 11 caveat, closed):
+    `run_trace` derives its published per-request numbers from the
+    SAME timeline whenever a tracer is attached, so both sides see the
+    evicted rids' true ``n_generated``.  The one remaining truncation
+    is a saturated tracer ring (``timeline_truncated`` flags it)."""
     step_begin: dict = {}
     submits: list = []           # (seq, rid, args) in submission order
     first: dict = {}
@@ -329,6 +333,42 @@ def timeline_metrics(tracer, *, sla_ttft_ms: float = 1000.0,
     }
 
 
+def _latency_block(submitted, first, done, n_gen_of, step_wall,
+                   duration, sla_ttft_ms, sla_tpot_ms) -> dict:
+    """The ONE published per-request SLA-latency computation shared by
+    `run_trace` and `run_fleet_trace` (so the goodput/TTFT/TPOT
+    definitions cannot drift between engine and fleet reports).
+    `timeline_metrics` deliberately does NOT use this helper: it is the
+    independent reconstruction the parity gate cross-checks — folding
+    it in would make that gate circular."""
+    ttft, tpot, good_tokens = [], [], 0
+    class_tokens: dict = {}
+    for r in submitted:
+        n_gen = n_gen_of.get(r.rid, 0)
+        if r.rid not in first or r.arrival not in step_wall:
+            continue
+        t_first = (first[r.rid] - step_wall[r.arrival]) * 1e3
+        ttft.append(t_first)
+        t_tok = None
+        if r.rid in done and n_gen > 1:
+            t_tok = (done[r.rid] - first[r.rid]) * 1e3 / (n_gen - 1)
+            tpot.append(t_tok)
+        if t_first <= sla_ttft_ms and (t_tok is None
+                                       or t_tok <= sla_tpot_ms):
+            good_tokens += n_gen
+            class_tokens[r.sla_class] = (class_tokens.get(r.sla_class, 0)
+                                         + n_gen)
+    return {
+        "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p99": _pct(ttft, 99),
+        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
+        "goodput_tok_per_s": (round(good_tokens / duration, 1)
+                              if duration else None),
+        "goodput_by_class": {str(k): (round(v / duration, 1)
+                                      if duration else None)
+                             for k, v in sorted(class_tokens.items())},
+    }
+
+
 def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
               sla_tpot_ms: float = 250.0,
               burst_factory: Optional[Callable] = None,
@@ -346,7 +386,10 @@ def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
             return True
         return burst_factory is not None and engine.has_pending_bursts()
 
-    tracer = getattr(engine, "tracer", None)
+    # NULL_TRACER is falsy by design (obs.trace) — normalize it to None
+    # here so the disabled path cannot select the timeline-derived
+    # metrics branch below and publish empty percentiles
+    tracer = getattr(engine, "tracer", None) or None
     t0 = now()
     if tracer is not None:
         tracer.event("trace_begin", cat="serve", wall=t0)
@@ -377,29 +420,35 @@ def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
         tracer.event("trace_end", cat="serve", wall=t_end)
     engine.report_unfired()
 
-    first, done = {}, {}
-    for kind, rid, _step, wall in engine.events:
-        if kind == "first_token":
-            first[rid] = wall
-        elif kind == "complete":
-            done[rid] = wall
-    ttft, tpot, good_tokens = [], [], 0
-    class_tokens: dict = {}
-    for r in submitted:
-        n_gen = len(engine.finished.get(r.rid, ()))
-        if r.rid not in first:
-            continue
-        t_first = (first[r.rid] - step_wall[r.arrival]) * 1e3
-        ttft.append(t_first)
-        t_tok = None
-        if r.rid in done and n_gen > 1:
-            t_tok = (done[r.rid] - first[r.rid]) * 1e3 / (n_gen - 1)
-            tpot.append(t_tok)
-        if t_first <= sla_ttft_ms and (t_tok is None
-                                       or t_tok <= sla_tpot_ms):
-            good_tokens += n_gen
-            class_tokens[r.sla_class] = (class_tokens.get(r.sla_class, 0)
-                                         + n_gen)
+    first, done, n_gen_of = {}, {}, {}
+    if tracer is not None:
+        # ISSUE 13 satellite (the PR 11 parity caveat, closed): with a
+        # tracer attached the published per-request metrics derive from
+        # the TIMELINE, not the bounded stores — a `finished` entry the
+        # `ResultStore` evicted mid-run still has its `complete` event
+        # (wall + n_generated) in the timeline, so
+        # `timeline_metrics`'s reconstruction stays float-for-float
+        # even with the store held at cap (regression-tested).  The
+        # walls are the SAME floats either way (`ServeEngine._event`
+        # hands one `now()` to both sinks).
+        for _seq, name, cat, _step, wall, args in tracer.events:
+            if cat != "req":
+                continue
+            if name == "first_token":
+                first[args["rid"]] = wall
+            elif name == "complete":
+                done[args["rid"]] = wall
+                n_gen_of[args["rid"]] = int(args["n_generated"])
+    else:
+        for kind, rid, _step, wall in engine.events:
+            if kind == "first_token":
+                first[rid] = wall
+            elif kind == "complete":
+                done[rid] = wall
+        n_gen_of = {r.rid: len(engine.finished.get(r.rid, ()))
+                    for r in submitted}
+    lat = _latency_block(submitted, first, done, n_gen_of, step_wall,
+                         duration, sla_ttft_ms, sla_tpot_ms)
 
     c = engine.counters
     gen = c["tokens_generated"]
@@ -420,19 +469,134 @@ def run_trace(engine, requests: list, *, sla_ttft_ms: float = 1000.0,
         "engine_steps": engine.step_index,
         "duration_s": round(duration, 3),
         "tok_per_s": round(gen / duration, 1) if duration else None,
-        "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p99": _pct(ttft, 99),
-        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
-        "goodput_tok_per_s": (round(good_tokens / duration, 1)
-                              if duration else None),
-        "goodput_by_class": {str(k): (round(v / duration, 1)
-                                      if duration else None)
-                             for k, v in sorted(class_tokens.items())},
-        # bounded-store honesty flag (module docstring): the
-        # per-request latency/goodput numbers cover only the retained
-        # resolution window when the engine evicted results mid-run
-        "metrics_truncated": c["results_evicted"] > 0,
+        **lat,
+        # bounded honesty flag (module docstring): with a tracer the
+        # per-request numbers derive from the timeline, so only a
+        # SATURATED tracer ring truncates them; without one they read
+        # the bounded stores, so a mid-run eviction truncates
+        "metrics_truncated": (
+            getattr(tracer, "events_dropped", 0) > 0
+            if tracer is not None else c["results_evicted"] > 0),
         "sla": {"ttft_ms": sla_ttft_ms, "tpot_ms": sla_tpot_ms},
         "counters": dict(engine.counters),
+    }
+
+
+def shared_prefix_trace(n_requests: int, vocab_size: int, *,
+                        n_prefixes: int = 2, prefix_len: int = 16,
+                        suffix_lens: Sequence[int] = (2, 4),
+                        max_new: Sequence[int] = (8,),
+                        rate: float = 2.0, seed: int = 0,
+                        eos_id: Optional[int] = None,
+                        sla: Optional[Sequence[dict]] = None) -> list:
+    """The prefix-cache workload shape (ISSUE 13): Poisson arrivals
+    whose prompts share one of ``n_prefixes`` common prefixes (system
+    prompts / few-shot preambles) followed by a short per-request
+    suffix — the trace `tools/bench_serve.py --fleet`'s prefix-hit-rate
+    sweep replays.  ``sla`` stamps classes round-robin like
+    `with_sla`."""
+    if n_prefixes < 1 or prefix_len < 1:
+        raise ValueError(f"n_prefixes/prefix_len must be >= 1, got "
+                         f"({n_prefixes}, {prefix_len})")
+    rng = np.random.default_rng(seed)
+    prefixes = [tuple(int(x) for x in rng.integers(0, vocab_size,
+                                                   prefix_len))
+                for _ in range(n_prefixes)]
+    t = 0.0
+    out = []
+    for rid in range(n_requests):
+        t += rng.exponential(1.0 / rate)
+        suffix = tuple(int(x) for x in rng.integers(
+            0, vocab_size, int(rng.choice(list(suffix_lens)))))
+        out.append(Request(
+            rid=rid, prompt=prefixes[rid % n_prefixes] + suffix,
+            max_new_tokens=int(rng.choice(list(max_new))),
+            arrival=int(t), eos_id=eos_id))
+    return with_sla(out, list(sla)) if sla else out
+
+
+def run_fleet_trace(fleet, requests: list, *,
+                    sla_ttft_ms: float = 1000.0,
+                    sla_tpot_ms: float = 250.0,
+                    max_steps: int = 100000) -> dict:
+    """`run_trace` lifted to fleet scope: submit each request at its
+    arrival step through the ROUTER (`Fleet.submit`), step the fleet
+    (all engines in lockstep) until drained and every pending
+    ``engine_kill`` fired, and report the fleet metric set.
+
+    Resolution counts are rid-level fleet-scope truth, not engine-
+    counter sums (a request shed by one engine and completed by the
+    next after a router retry counts COMPLETED; engine counters keep
+    the per-engine view in ``engine_counters``).  ``dropped`` is the
+    fleet-scope silent-drop count — structurally zero.  Latency walls
+    merge every engine's event log (a migrated session's first token
+    and completion legitimately live on different engines)."""
+    pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
+    submitted = []
+    step_wall = {}
+
+    def more_work() -> bool:
+        return bool(pending) or not fleet.drained() \
+            or fleet.has_pending_faults()
+
+    t0 = now()
+    while more_work():
+        if fleet.step_index >= max_steps:
+            raise RuntimeError(
+                f"fleet trace not drained in {max_steps} steps")
+        while pending and pending[0].arrival <= fleet.step_index:
+            r = pending.pop(0)
+            fleet.submit(r)
+            submitted.append(r)
+        step_wall[fleet.step_index] = now()
+        fleet.step()
+    duration = now() - t0
+    fleet.report_unfired()
+
+    first, done, n_gen_of = {}, {}, {}
+    for e in fleet.engines:
+        for kind, rid, _step, wall in e.events:
+            if kind == "first_token":
+                first[rid] = wall
+            elif kind == "complete":
+                done[rid] = wall
+        for rid, toks in e.finished.items():
+            n_gen_of[rid] = len(toks)
+
+    lat = _latency_block(submitted, first, done, n_gen_of, step_wall,
+                         duration, sla_ttft_ms, sla_tpot_ms)
+    agg = fleet.aggregate_counters()
+    n_sub = fleet.counters["submitted"]
+    # fleet-scope resolution from COUNTERS, not the bounded stores
+    # (eviction-immune, run_trace's discipline): a rid completes and
+    # deadline-misses at most once however it moves; every router
+    # retry leaves exactly one extra engine-level shed record for a
+    # rid that resolved elsewhere, so subtracting retries yields the
+    # rid-level shed count
+    completed = agg.get("completed", 0)
+    misses = agg.get("deadline_misses", 0)
+    shed = agg.get("shed", 0) - fleet.counters["router_retries"]
+    resolved = completed + shed + misses
+    gen = agg.get("tokens_generated", 0)
+    return {
+        "n_engines": fleet.n_engines,
+        "requests": len(requests),
+        "submitted": n_sub,
+        "completed": completed,
+        "shed": shed,
+        "deadline_misses": misses,
+        "dropped": n_sub - resolved,       # fleet-scope SILENT drops
+        "shed_rate": round(shed / n_sub, 4) if n_sub else 0.0,
+        "deadline_miss_rate": (round(misses / n_sub, 4)
+                               if n_sub else 0.0),
+        "fleet_steps": fleet.step_index,
+        "duration_s": round(duration, 3),
+        "tok_per_s": round(gen / duration, 1) if duration else None,
+        **lat,
+        "metrics_truncated": agg.get("results_evicted", 0) > 0,
+        "sla": {"ttft_ms": sla_ttft_ms, "tpot_ms": sla_tpot_ms},
+        "fleet_counters": dict(fleet.counters),
+        "engine_counters": [dict(e.counters) for e in fleet.engines],
     }
 
 

@@ -57,6 +57,16 @@ offered, not on hypothetical future cancellations.
 The scheduler never touches the pool; it owns the free list and each
 slot's page-id tuple, and renders them into the trash-padded
 ``(S, max_pages)`` int32 page-table rows the jitted gather consumes.
+
+Page REFCOUNTS (ISSUE 13): every allocated page carries a reference
+count (``page_refs``), because the fleet layer's content-addressed
+prefix cache (cpd_tpu/fleet/prefix.py) shares identical prompt-prefix
+pages copy-on-write across requests — a page may be held by several
+slots AND the cache at once.  `retain` / `release` are the ONE
+allocation discipline: a page returns to the free list exactly when its
+last reference drops.  Without sharing every count is 1 and the
+behaviour (including free-list order) is identical to the pre-refcount
+scheduler.
 """
 
 from __future__ import annotations
@@ -136,6 +146,11 @@ class Slot:
     seq: int = -1            # admission sequence number (FIFO service)
     first_token_step: int = -1   # engine step of the first sampled token
     last_progress: int = -1      # engine step `fed` last advanced
+    prefix_registered: int = 0   # full prompt pages already offered to
+    #                              the prefix cache (a watermark, so
+    #                              each prefill chunk registers only
+    #                              NEWLY completed pages — not an
+    #                              O(pages) re-walk per chunk)
 
     @property
     def history(self) -> tuple:
@@ -155,6 +170,7 @@ class Slot:
         self.seq = -1
         self.first_token_step = -1
         self.last_progress = -1
+        self.prefix_registered = 0
 
 
 class Scheduler:
@@ -193,6 +209,9 @@ class Scheduler:
         # page 0 is the trash page; ascending ids keep runs reproducible
         self.total_pages = n_pages - 1
         self.free_pages = deque(range(1, n_pages))
+        # page id -> reference count (absent = free); the prefix cache
+        # and CoW sharing push counts above 1 (module docstring)
+        self.page_refs: dict = {}
         self.queue: deque = deque()
         self._admit_seq = 0       # admission sequence (oldest-first prefill)
         # per-step policy (engine/supervisor-owned; see class docstring)
@@ -325,6 +344,50 @@ class Scheduler:
         self.queue = keep
         return expired
 
+    # -- page reference counting ------------------------------------------
+
+    def retain(self, page_id: int) -> int:
+        """Add one reference to an allocated (or just-popped) page;
+        returns the new count.  The trash page is never refcounted."""
+        if page_id == TRASH_PAGE:
+            raise ValueError("the trash page is never retained")
+        self.page_refs[page_id] = self.page_refs.get(page_id, 0) + 1
+        return self.page_refs[page_id]
+
+    def release(self, page_id: int) -> bool:
+        """Drop one reference; at zero the page returns to the free
+        list.  Returns True when the page was actually freed — the
+        ``pages_freed`` counter counts pool returns, not reference
+        drops (a shared page survives its first releases)."""
+        n = self.page_refs.get(page_id, 0)
+        if n <= 0:
+            raise ValueError(f"release of unallocated page {page_id}")
+        if n == 1:
+            del self.page_refs[page_id]
+            self.free_pages.append(page_id)
+            return True
+        self.page_refs[page_id] = n - 1
+        return False
+
+    def reserve_pages(self, n: int) -> tuple:
+        """Pop ``n`` fresh pages off the free list at refcount 1 — the
+        one allocation path (admission, watchdog reassignment, capsule
+        adoption).  Raises if the free list is short; callers check (or
+        make room) first."""
+        if len(self.free_pages) < n:
+            raise RuntimeError(
+                f"page pool exhausted: need {n}, have "
+                f"{len(self.free_pages)} free")
+        pages = tuple(self.free_pages.popleft() for _ in range(n))
+        for p in pages:
+            self.retain(p)
+        return pages
+
+    def shared_pages(self) -> list:
+        """Page ids whose refcount exceeds 1 — the dedup accounting the
+        fleet analytics (`quant.numerics.kv_pool_bytes`) price."""
+        return sorted(p for p, n in self.page_refs.items() if n > 1)
+
     # -- admission / eviction --------------------------------------------
 
     def admit(self, step: int) -> list:
@@ -347,8 +410,7 @@ class Scheduler:
                 break
             self.queue.popleft()
             slot.req = req
-            slot.pages = tuple(self.free_pages.popleft()
-                               for _ in range(need))
+            slot.pages = self.reserve_pages(need)
             slot.state = PREFILL
             slot.fed = 0
             slot.generated = []
@@ -361,23 +423,29 @@ class Scheduler:
         return admitted
 
     def evict(self, slot: Slot) -> int:
-        """Return a finished slot's pages to the free list; -> page count."""
-        n = len(slot.pages)
-        self.free_pages.extend(slot.pages)
+        """Release a finished slot's page references; -> pages actually
+        FREED (== the page count unless the prefix cache or another
+        slot still shares some)."""
+        freed = sum(self.release(p) for p in slot.pages)
         slot.reset()
-        return n
+        return freed
 
     def reassign_pages(self, slot: Slot) -> int:
-        """Watchdog eviction support: return the slot's pages and reserve
-        a FRESH set of the same size (guaranteed available — its own
-        pages just went back).  The request stays in its slot; the
+        """Watchdog eviction support: release the slot's page refs and
+        reserve a FRESH private set of the same size.  Without sharing
+        the slot's own pages just came back, so the reserve always
+        succeeds; a slot holding SHARED pages returns fewer than it
+        takes, and the engine makes room first — or skips the eviction
+        when it cannot (prefix-cache eviction, `ServeEngine._make_room`
+        / the watchdog's skip).  The request stays in its slot; the
         engine rebuilds the cache from history into the new pages.
-        Returns the page count (rides both `pages_freed` and
-        `pages_reserved`)."""
+        Returns the pages actually FREED (pool returns, like `evict` —
+        a shared page survives its release); the reserved count is the
+        slot's page width."""
         n = len(slot.pages)
-        self.free_pages.extend(slot.pages)
-        slot.pages = tuple(self.free_pages.popleft() for _ in range(n))
-        return n
+        freed = sum(self.release(p) for p in slot.pages)
+        slot.pages = self.reserve_pages(n)
+        return freed
 
     # -- step composition -------------------------------------------------
 
@@ -404,11 +472,13 @@ class Scheduler:
         """(S, max_pages) int32 rows for the whole decode batch."""
         return np.stack([self.page_row(s) for s in self.slots])
 
-    def owner_of_page(self, page_id: int) -> Optional[Slot]:
-        for slot in self.slots:
-            if slot.state != FREE and page_id in slot.pages:
-                return slot
-        return None
+    def owners_of_page(self, page_id: int) -> list:
+        """EVERY live slot referencing the page — under prefix-cache
+        CoW sharing a corrupt shared page has several owners, and the
+        repair ladder must recompute all of them (slot-index order, so
+        the repair sequence is deterministic)."""
+        return [slot for slot in self.slots
+                if slot.state != FREE and page_id in slot.pages]
 
     def live_pages(self) -> list:
         """Every page reserved by a slot that already HOLDS cached K/V

@@ -1,0 +1,703 @@
+"""Fleet-layer tests (cpd_tpu/fleet/, ISSUE 13): SLA-aware routing over
+N engines, live session migration via digest-sealed capsules, and the
+content-addressed prefix cache — plus the satellite analytics and obs
+adapters.
+
+Oracles, matching the serving-stack doctrine (tests/test_serve.py):
+
+  * the UNMIGRATED run — a migrated session's decode stream (and every
+    other request's) must be bitwise identical to the same trace served
+    without migration;
+  * the COLD-prefill run — prefix-cache hits must produce bitwise-
+    identical sampled logits, fewer prefill chunks;
+  * determinism — the same (model, trace, plans) replays to identical
+    fleet AND per-engine counters, including through an engine kill;
+  * fleet-scope zero silent drops — every submitted rid resolves
+    FINISHED/SHED/DEADLINE_MISS somewhere, `Fleet.unresolved()` empty.
+
+The heavyweight end-to-end drills (N=2 route -> migrate -> kill ->
+drain, counters x2) live in the `fleet-smoke` CI gate
+(tools/bench_serve.py --fleet-smoke); these tests pin the mechanisms.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpd_tpu.fleet import (Fleet, PrefixCache, SessionCapsule,
+                           can_adopt, extract_capsule, migrate_session,
+                           restore_capsule, token_digest)
+from cpd_tpu.models import transformer_lm
+from cpd_tpu.quant.numerics import kv_page_bytes, kv_pool_bytes
+from cpd_tpu.resilience import FaultPlan
+from cpd_tpu.resilience.inject import (FLEET_KINDS, Injector,
+                                       report_unfired)
+from cpd_tpu.serve import (KVCacheConfig, Request, SHED, ServeEngine,
+                           mixed_trace)
+from cpd_tpu.serve.kvcache import alloc_pool
+from cpd_tpu.serve.loadgen import run_fleet_trace, shared_prefix_trace
+from cpd_tpu.serve.scheduler import DECODE, FREE, PREFILL, Scheduler
+
+VOCAB = 64
+ENGINE_KW = dict(n_slots=2, max_seq=32, page_size=8, prefill_chunk=4)
+
+
+@pytest.fixture(scope="module")
+def gqa_model():
+    model = transformer_lm(vocab_size=VOCAB, d_model=32, n_layers=2,
+                           n_heads=4, n_kv_heads=2, d_ff=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _prompt(n, seed=7):
+    rng = np.random.RandomState(seed)
+    return tuple(int(x) for x in rng.randint(0, VOCAB, n))
+
+
+def _rows(*engines):
+    out = {}
+    for e in engines:
+        for rid, pos, row in e.logits_log:
+            out[(rid, pos)] = row
+    return out
+
+
+def _assert_rows_bitwise(a: dict, b: dict):
+    assert a.keys() == b.keys() and len(a) > 0
+    for key in a:
+        assert (a[key].view(np.uint32) == b[key].view(np.uint32)).all(), \
+            f"logits differ at (rid, pos) = {key}"
+
+
+# ------------------------------------------------------- prefix cache unit
+
+def test_token_digest_is_position_weighted():
+    assert token_digest((1, 2)) != token_digest((2, 1))
+    assert token_digest((0, 5)) != token_digest((5,))   # leading zeros count
+    assert token_digest(()) == 0
+
+
+def test_crafted_fletcher_collision_is_not_shared():
+    """THE collision-confirmation rule: (5,9,5) and (6,7,6) have equal
+    position-weighted Fletcher digests (delta (+1,-2,+1) zeroes both
+    sums), and the byte comparison must refuse the share."""
+    a, b = (5, 9, 5), (6, 7, 6)
+    assert token_digest(a) == token_digest(b)
+    cache = PrefixCache(4)
+    fresh, evicted = cache.register(a, page_id=3)
+    assert fresh and evicted == []
+    assert cache.lookup(b + (9,), 3) == []
+    assert cache.collisions_rejected == 1
+    assert cache.lookup(a + (9,), 3) == [3]
+    # the collision chain holds BOTH entries once b is registered too
+    cache.register(b, page_id=5)
+    assert cache.lookup(b + (9,), 3) == [5]
+    assert cache.lookup(a + (9,), 3) == [3]
+
+
+def test_prefix_cache_multi_page_runs_and_lru():
+    cache = PrefixCache(2)
+    p = tuple(range(12))
+    cache.register(p[:4], 10)
+    cache.register(p[:8], 11)
+    # a two-page confirmed run; a 3rd page is not indexed
+    assert cache.lookup(p, 4) == [10, 11]
+    assert cache.lookup(p, 4, max_pages=1) == [10]
+    # LRU order now [11, 10] (the max_pages=1 lookup touched 10 last);
+    # peek must NOT perturb it, so the next register evicts 11
+    cache.lookup(p, 4, peek=True)
+    _fresh, evicted = cache.register((9, 9, 9, 9), 12)
+    assert evicted == [11]
+    assert cache.lookup(p, 4) == [10]   # page 1 of the run is gone
+
+
+def test_prefix_cache_invalidate_and_state_roundtrip():
+    cache = PrefixCache(8)
+    cache.register((1, 2, 3), 4)
+    cache.register((1, 2, 3, 4, 5, 6), 5)
+    # invalidating the page-1 entry breaks the 2-page run at page 1
+    assert cache.invalidate_page(5) is True
+    assert cache.invalidate_page(5) is False
+    assert cache.lookup((1, 2, 3, 4, 5, 6, 9), 3) == [4]
+    blob = json.loads(json.dumps(cache.state_dict()))
+    other = PrefixCache(1).load_state_dict(blob)
+    assert other.capacity_pages == 8
+    assert other.held_pages == cache.held_pages
+    assert other.lookup((1, 2, 3, 9), 3) == [4]
+    # invalidating the page-0 entry kills every run through it
+    assert cache.invalidate_page(4) is True
+    assert cache.lookup((1, 2, 3, 9), 3) == []
+
+
+# ------------------------------------------------------- scheduler refcounts
+
+def test_scheduler_refcounts_share_and_release():
+    sched = Scheduler(n_slots=2, n_pages=6, page_size=4, max_pages=2)
+    pages = sched.reserve_pages(2)
+    assert all(sched.page_refs[p] == 1 for p in pages)
+    sched.retain(pages[0])
+    assert sched.shared_pages() == [pages[0]]
+    free_before = len(sched.free_pages)
+    assert sched.release(pages[0]) is False     # still shared
+    assert len(sched.free_pages) == free_before
+    assert sched.release(pages[0]) is True      # last ref frees
+    assert pages[0] in sched.free_pages
+    with pytest.raises(ValueError, match="unallocated"):
+        sched.release(pages[0])
+    with pytest.raises(ValueError, match="trash"):
+        sched.retain(0)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        sched.reserve_pages(99)
+
+
+# ------------------------------------------------------- engine + prefix
+
+def test_prefix_hit_bitwise_and_skips_chunks(gqa_model):
+    """Acceptance: a cache hit skips prefill chunks AND leaves every
+    sampled logit row bitwise identical to the cold path."""
+    model, params = gqa_model
+    prompt = _prompt(12)
+    reqs = [Request(rid=0, prompt=prompt, max_new_tokens=4, arrival=0),
+            Request(rid=1, prompt=prompt, max_new_tokens=4, arrival=6)]
+
+    def run(cache):
+        eng = ServeEngine(model, params, **ENGINE_KW,
+                          record_logits=True, prefix_cache=cache)
+        for r in reqs:
+            eng.submit(r)
+        eng.run_until_drained()
+        return eng
+
+    cold = run(None)
+    warm = run(PrefixCache(16))
+    assert warm.counters["prefix_hits"] == 1
+    assert warm.counters["prefix_pages_shared"] >= 1
+    assert warm.counters["prefix_registered"] >= 1
+    assert warm.counters["prefill_chunks"] < cold.counters["prefill_chunks"]
+    _assert_rows_bitwise(_rows(cold), _rows(warm))
+    assert cold.finished == warm.finished
+    assert warm.unresolved() == []
+
+
+def test_shared_page_corruption_repairs_every_owner(gqa_model):
+    """A corrupt SHARED page has several owners; the scrub repairs all
+    of them in place (identical prefixes rewrite identical bytes) and
+    the decoded outputs match the corruption-free run."""
+    model, params = gqa_model
+    prompt = _prompt(12, seed=9)
+    reqs = [Request(rid=0, prompt=prompt, max_new_tokens=6, arrival=0),
+            Request(rid=1, prompt=prompt, max_new_tokens=6, arrival=4)]
+
+    def run(flip):
+        eng = ServeEngine(model, params, **ENGINE_KW,
+                          prefix_cache=PrefixCache(16))
+        for r in reqs:
+            eng.submit(r)
+        flipped = False
+        while not eng.drained():
+            shared = eng.sched.shared_pages()
+            owners = [len(eng.sched.owners_of_page(p)) for p in shared]
+            if flip and not flipped and shared and max(owners) >= 2:
+                # two live slots both reading the page (+ the cache ref)
+                pid = shared[int(np.argmax(owners))]
+                eng._flip_page_byte(pid)
+                eng.scrub()
+                flipped = True
+            eng.step()
+        return eng, flipped
+
+    clean, _ = run(False)
+    hurt, flipped = run(True)
+    assert flipped, "the drill never saw a doubly-shared live page"
+    assert hurt.counters["kv_pages_corrupt"] >= 1
+    assert hurt.counters["kv_repairs"] >= 2       # BOTH owners recomputed
+    assert hurt.finished == clean.finished
+    assert hurt.unresolved() == []
+
+
+def test_corrupt_cache_held_page_invalidated_not_served(gqa_model):
+    """A corrupt page whose only reference is the prefix cache must be
+    invalidated (released, entry dropped) — never digest-re-blessed and
+    shared into a later tenant's attention window."""
+    model, params = gqa_model
+    prompt = _prompt(12, seed=11)
+    cache = PrefixCache(16)
+    eng = ServeEngine(model, params, **ENGINE_KW, record_logits=True,
+                      prefix_cache=cache)
+    eng.submit(Request(rid=0, prompt=prompt, max_new_tokens=4,
+                       arrival=0))
+    eng.run_until_drained()
+    held = list(cache.held_pages)
+    assert held, "prefill registered no pages"
+    eng._flip_page_byte(held[0])
+    eng.scrub()
+    assert eng.counters["prefix_invalidations"] == 1
+    assert held[0] not in cache.held_pages
+    # the same prompt now misses the cache and cold-prefills correctly
+    eng.submit(Request(rid=1, prompt=prompt, max_new_tokens=4,
+                       arrival=eng.step_index))
+    eng.run_until_drained()
+    assert eng.counters["prefix_hits"] == 0
+    assert eng.finished[1] == eng.finished[0]
+    assert eng.unresolved() == []
+
+
+def test_snapshot_roundtrips_prefix_cache_and_refs(gqa_model, tmp_path):
+    """Engine snapshots carry the refcounts and the cache index: a
+    restore WITH a cache object resumes sharing exactly; one WITHOUT
+    releases the cache-held pages instead of leaking them."""
+    model, params = gqa_model
+    prompt = _prompt(12, seed=13)
+    eng = ServeEngine(model, params, **ENGINE_KW,
+                      prefix_cache=PrefixCache(16))
+    eng.submit(Request(rid=0, prompt=prompt, max_new_tokens=4,
+                       arrival=0))
+    eng.run_until_drained()
+    assert eng.counters["prefix_registered"] >= 1
+    snap = os.path.join(tmp_path, "snap")
+    eng.snapshot(snap)
+
+    warm = ServeEngine.restore(model, params, snap,
+                               prefix_cache=PrefixCache(1))
+    assert warm.prefix_cache.held_pages == eng.prefix_cache.held_pages
+    assert warm.sched.page_refs == eng.sched.page_refs
+    warm.submit(Request(rid=1, prompt=prompt, max_new_tokens=4,
+                        arrival=warm.step_index))
+    warm.run_until_drained()
+    assert warm.counters["prefix_hits"] == 1
+
+    cold = ServeEngine.restore(model, params, snap)
+    assert cold.prefix_cache is None
+    assert cold.sched.page_refs == {}      # cache refs released
+    assert sorted(cold.sched.free_pages) == \
+        sorted(range(1, eng.cfg.n_pages))
+
+
+# ------------------------------------------------------- migration
+
+def test_migration_mid_decode_bitwise(gqa_model):
+    model, params = gqa_model
+    reqs = [Request(rid=i, prompt=_prompt(9 + 2 * i, seed=20 + i),
+                    max_new_tokens=6, arrival=0) for i in range(2)]
+    kw = dict(ENGINE_KW, kv_format=(8, 23), record_logits=True)
+
+    base = ServeEngine(model, params, **kw)
+    for r in reqs:
+        base.submit(r)
+    base.run_until_drained()
+
+    src = ServeEngine(model, params, **kw)
+    dst = ServeEngine(model, params, **kw)
+    for r in reqs:
+        src.submit(r)
+    while src.slot_of_rid(1) is None \
+            or src.slot_of_rid(1).state != DECODE:
+        src.step()
+    src.step()                      # at least one decoded token behind
+    cap = migrate_session(src, dst, 1)
+    assert cap.rid == 1 and cap.seal
+    assert src.slot_of_rid(1) is None and dst.slot_of_rid(1) is not None
+    assert src.counters["sessions_out"] == 1
+    assert dst.counters["sessions_in"] == 1
+    src.run_until_drained()
+    dst.run_until_drained()
+    _assert_rows_bitwise(_rows(base), _rows(src, dst))
+    assert dst.finished[1] == base.finished[1]
+    assert src.unresolved() == [] and dst.unresolved() == []
+
+
+def test_migration_mid_prefill_completes(gqa_model):
+    """Satellite: a capsule of a mid-PREFILL request restores and the
+    target finishes the prompt — output equal to the never-migrated
+    run."""
+    model, params = gqa_model
+    req = Request(rid=5, prompt=_prompt(14, seed=31), max_new_tokens=4,
+                  arrival=0)
+    kw = dict(ENGINE_KW, record_logits=True)
+    base = ServeEngine(model, params, **kw)
+    base.submit(req)
+    base.run_until_drained()
+
+    src = ServeEngine(model, params, **kw)
+    dst = ServeEngine(model, params, **kw)
+    src.submit(req)
+    src.step()
+    slot = src.slot_of_rid(5)
+    assert slot.state == PREFILL and 0 < slot.fed < len(req.prompt)
+    cap = extract_capsule(src, 5)
+    restore_capsule(dst, cap)
+    assert dst.slot_of_rid(5).state == PREFILL
+    dst.run_until_drained()
+    assert dst.finished[5] == base.finished[5]
+    _assert_rows_bitwise(_rows(base), _rows(src, dst))
+
+
+def test_capsule_rejects_mismatched_cache_layout(gqa_model):
+    """Satellite: restoring onto an engine with a different
+    kv_block_size (or any cache-layout field) must fail fast with the
+    target left untouched — never scatter undecodable bytes."""
+    model, params = gqa_model
+    kw = dict(ENGINE_KW, kv_format=(4, 3))
+    src = ServeEngine(model, params, **kw, kv_block_size=24)
+    dst = ServeEngine(model, params, **kw, kv_block_size=32)
+    src.submit(Request(rid=2, prompt=_prompt(9), max_new_tokens=4,
+                       arrival=0))
+    for _ in range(4):
+        src.step()
+    cap = extract_capsule(src, 2)
+    before = np.asarray(dst._pool).copy()
+    with pytest.raises(ValueError, match="incompatible"):
+        restore_capsule(dst, cap)
+    assert (np.asarray(dst._pool) == before).all()
+    assert all(sl.state == FREE for sl in dst.sched.slots)
+    assert dst.unresolved() == [] and dst.sched.page_refs == {}
+
+
+def test_capsule_rejects_narrower_page_table(gqa_model):
+    """max_pages is engine sizing, not cache layout: an oversized
+    capsule must be refused BEFORE any page write, not blow up the
+    first page_row render after occupying a slot."""
+    model, params = gqa_model
+    src = ServeEngine(model, params, **ENGINE_KW)          # max_seq 32
+    dst = ServeEngine(model, params, **dict(ENGINE_KW, max_seq=16))
+    src.submit(Request(rid=8, prompt=_prompt(20), max_new_tokens=8,
+                       arrival=0))
+    for _ in range(3):
+        src.step()
+    cap = extract_capsule(src, 8)
+    assert cap.n_pages > dst.sched.max_pages
+    before = np.asarray(dst._pool).copy()
+    with pytest.raises(ValueError, match="page-table rows"):
+        restore_capsule(dst, cap)
+    assert (np.asarray(dst._pool) == before).all()
+    assert all(sl.state == FREE for sl in dst.sched.slots)
+    assert dst.sched.page_refs == {}
+
+
+def test_fleet_plan_rejects_engine_clock_kinds(gqa_model, tmp_path):
+    """Engine-clock chaos in a FLEET plan would neither fire nor be
+    reported unfired — refused up front, pointed at engine_plans."""
+    model, params = gqa_model
+    with pytest.raises(ValueError, match="non-fleet kinds"):
+        Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+              fault_plan=FaultPlan.parse("engine_kill@6:1;kv_storm@3:0"),
+              snapshot_every=4, snapshot_dir=str(tmp_path))
+
+
+def test_migrate_session_rolls_back_on_failed_restore(gqa_model):
+    """`migrate_session` puts the capsule back into the source when the
+    restore fails — the session is never stranded in limbo."""
+    model, params = gqa_model
+    kw = dict(ENGINE_KW, kv_format=(4, 3))
+    src = ServeEngine(model, params, **kw, kv_block_size=24)
+    dst = ServeEngine(model, params, **kw)     # per-tensor: incompatible
+    src.submit(Request(rid=3, prompt=_prompt(9), max_new_tokens=8,
+                       arrival=0))
+    for _ in range(4):
+        src.step()
+    with pytest.raises(ValueError, match="incompatible"):
+        migrate_session(src, dst, 3)
+    assert src.slot_of_rid(3) is not None      # back home
+    src.run_until_drained()
+    assert 3 in src.finished and src.unresolved() == []
+
+
+def test_capsule_tamper_rejected_before_any_write(gqa_model):
+    """Satellite: one flipped capsule byte -> ValueError BEFORE any
+    page is written to the target."""
+    model, params = gqa_model
+    src = ServeEngine(model, params, **ENGINE_KW)
+    dst = ServeEngine(model, params, **ENGINE_KW)
+    src.submit(Request(rid=4, prompt=_prompt(9), max_new_tokens=8,
+                       arrival=0))
+    for _ in range(4):
+        src.step()
+    cap = extract_capsule(src, 4)
+    cap.pool_pages = cap.pool_pages.copy()
+    cap.pool_pages.reshape(-1)[0] ^= np.uint8(0xFF)
+    before = np.asarray(dst._pool).copy()
+    with pytest.raises(ValueError, match="seal mismatch"):
+        restore_capsule(dst, cap)
+    assert (np.asarray(dst._pool) == before).all()
+    assert all(sl.state == FREE for sl in dst.sched.slots)
+    # an edited STATE field is caught too
+    cap2 = extract_capsule(src, 4) if src.slot_of_rid(4) else None
+    assert cap2 is None        # rid 4 left with the first capsule
+    cap.pool_pages.reshape(-1)[0] ^= np.uint8(0xFF)   # un-flip bytes
+    cap.state["fed"] += 1                             # ...edit state
+    with pytest.raises(ValueError, match="seal mismatch"):
+        restore_capsule(dst, cap)
+
+
+def test_capsule_dir_roundtrip(gqa_model, tmp_path):
+    model, params = gqa_model
+    src = ServeEngine(model, params, **ENGINE_KW)
+    dst = ServeEngine(model, params, **ENGINE_KW)
+    src.submit(Request(rid=7, prompt=_prompt(9), max_new_tokens=8,
+                       arrival=0))
+    for _ in range(4):
+        src.step()
+    cap = extract_capsule(src, 7)
+    path = cap.to_dir(os.path.join(tmp_path, "cap"))
+    loaded = SessionCapsule.from_dir(path)
+    loaded.verify()
+    restore_capsule(dst, loaded)
+    dst.run_until_drained()
+    assert 7 in dst.finished
+
+
+# ------------------------------------------------------- routing
+
+def test_router_class0_routes_least_ttft_bound(gqa_model):
+    model, params = gqa_model
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW))
+    # load engine 0 with backlog directly (bypassing the router)
+    fleet.engines[0].submit(Request(rid=90, prompt=_prompt(16),
+                                    max_new_tokens=2, arrival=0))
+    premium = Request(rid=0, prompt=_prompt(5), max_new_tokens=2,
+                      arrival=0, sla_class=0)
+    _v, idx = fleet.submit(premium)
+    assert idx == 1        # least-TTFT-bound engine wins for class 0
+    # best-effort load-spread also avoids the loaded engine
+    _v, idx = fleet.submit(dataclasses.replace(premium, rid=1,
+                                               sla_class=1))
+    assert idx == 1
+
+
+def test_router_prefix_affinity_steers_best_effort(gqa_model):
+    model, params = gqa_model
+    prompt = _prompt(12, seed=40)
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+                  prefix_cache_pages=16)
+    fleet.submit(Request(rid=0, prompt=prompt, max_new_tokens=2,
+                         arrival=0))
+    fleet.run_until_drained()
+    assert fleet.engines[0].counters["prefix_registered"] >= 1
+    # the same prefix, best-effort: affinity beats the empty engine 1
+    _v, idx = fleet.submit(Request(rid=1, prompt=prompt,
+                                   max_new_tokens=2,
+                                   arrival=fleet.step_index,
+                                   sla_class=1))
+    assert idx == 0
+    fleet.run_until_drained()
+    assert fleet.engines[0].counters["prefix_hits"] == 1
+
+
+def test_router_retry_on_shed_then_fleet_shed(gqa_model):
+    """A request every engine sheds resolves at FLEET scope — counted,
+    stored, never silent."""
+    model, params = gqa_model
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW))
+    # prompt needs 3 chunk dispatches; deadline 1 step -> provably
+    # unmeetable on EVERY engine -> SHED everywhere -> fleet shed
+    doomed = Request(rid=0, prompt=_prompt(12), max_new_tokens=2,
+                     arrival=0, deadline_steps=1)
+    verdict, idx = fleet.submit(doomed)
+    assert (verdict, idx) == (SHED, -1)
+    assert fleet.counters["fleet_shed"] == 1
+    assert fleet.counters["router_retries"] == 1
+    assert 0 in fleet.shed
+    assert fleet.unresolved() == []
+    # both engines recorded their own shed resolution too
+    assert all(e.counters["shed"] == 1 for e in fleet.engines)
+
+
+def test_fleet_trace_deterministic_zero_drops(gqa_model):
+    model, params = gqa_model
+    trace = mixed_trace(10, VOCAB, prompt_lens=(5, 7, 9), max_new=(4,),
+                        seed=1)
+
+    def run():
+        fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW))
+        return run_fleet_trace(fleet, list(trace)), fleet
+
+    m1, f1 = run()
+    m2, _f2 = run()
+    assert m1["fleet_counters"] == m2["fleet_counters"]
+    assert m1["engine_counters"] == m2["engine_counters"]
+    assert m1["dropped"] == 0 and m1["completed"] == len(trace)
+    assert f1.unresolved() == []
+    assert m1["submitted"] == len(trace)
+
+
+def test_engine_kill_recovers_and_drains(gqa_model, tmp_path):
+    """The engine_kill fleet fault: snapshot+replay recovery rebuilds
+    the dead engine deterministically, the drain re-places its work,
+    zero silent drops, counters exact across two runs."""
+    model, params = gqa_model
+    trace = mixed_trace(10, VOCAB, prompt_lens=(5, 7, 9), max_new=(4,),
+                        seed=1)
+
+    def run(sub):
+        fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+                      fault_plan=FaultPlan.parse("engine_kill@6:1"),
+                      snapshot_every=4,
+                      snapshot_dir=os.path.join(tmp_path, sub))
+        return run_fleet_trace(fleet, list(trace)), fleet
+
+    m1, f1 = run("a")
+    m2, f2 = run("b")
+    assert m1["fleet_counters"] == m2["fleet_counters"]
+    assert m1["engine_counters"] == m2["engine_counters"]
+    assert f1.events == f2.events
+    assert m1["fleet_counters"]["engine_kills"] == 1
+    assert m1["fleet_counters"]["drains"] == 1
+    assert m1["fleet_counters"]["sessions_recovered"] >= 1
+    assert m1["dropped"] == 0 and f1.unresolved() == []
+    assert f1.report_unfired() == []
+    # the drained engine took no NEW work after the kill
+    assert f1.accepting == [True, False]
+
+
+def test_double_kill_on_drained_engine_does_not_livelock(gqa_model,
+                                                         tmp_path):
+    """A second engine_kill aimed at the already-drained engine is
+    permanently unfireable (drained engines never re-open): it must
+    not keep `run_fleet_trace`'s clock spinning toward it — the fleet
+    drains naturally and the spec surfaces through report_unfired."""
+    model, params = gqa_model
+    trace = mixed_trace(6, VOCAB, prompt_lens=(5, 7), max_new=(4,),
+                        seed=4)
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+                  fault_plan=FaultPlan.parse(
+                      "engine_kill@6:1;engine_kill@200:1"),
+                  snapshot_every=4, snapshot_dir=str(tmp_path))
+    m = run_fleet_trace(fleet, list(trace), max_steps=500)
+    assert m["dropped"] == 0
+    assert fleet.counters["engine_kills"] == 1
+    # the second spec went unfireable the moment engine 1 drained —
+    # the clock did NOT run out toward step 200
+    assert m["fleet_steps"] < 100
+    left = fleet.report_unfired()
+    assert len(left) == 1 and left[0].step == 200
+    assert fleet.counters["fleet_faults_unfired"] == 1
+
+
+def test_fleet_kill_requires_snapshots():
+    with pytest.raises(ValueError, match="snapshot"):
+        Fleet(None, None, 2,
+              fault_plan=FaultPlan.parse("engine_kill@3:0"))
+
+
+def test_fleet_report_unfired_and_training_plan_flagging(gqa_model,
+                                                         tmp_path):
+    """Both directions (satellite): an armed-but-unfired engine_kill is
+    counted by the fleet; an engine_kill in a TRAINING plan is flagged
+    by resilience.report_unfired unless fleet_armed=True."""
+    model, params = gqa_model
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+                  fault_plan=FaultPlan.parse("engine_kill@1000:0"),
+                  snapshot_every=64, snapshot_dir=str(tmp_path))
+    # drive the fleet directly: run_fleet_trace would (by the
+    # req_burst convention) keep the clock running TOWARD the kill
+    fleet.submit(Request(rid=0, prompt=_prompt(5), max_new_tokens=2,
+                         arrival=0))
+    fleet.run_until_drained()
+    left = fleet.report_unfired()
+    assert len(left) == 1 and left[0].kind == "engine_kill"
+    assert fleet.counters["fleet_faults_unfired"] == 1
+
+    plan = FaultPlan.parse("engine_kill@3:0")
+    assert {f.kind for f in plan.fleet_faults()} == FLEET_KINDS
+    inj = Injector(plan)
+    flagged = report_unfired(inj, n_steps=100, rank=1)
+    assert [f.kind for f in flagged] == ["engine_kill"]
+    armed = report_unfired(Injector(plan), n_steps=100, rank=1,
+                           fleet_armed=True)
+    assert armed == []
+
+
+# ------------------------------------------------------- analytics + obs
+
+@pytest.mark.parametrize("fmt,block", [((5, 2), None), ((4, 3), 24)])
+def test_kv_pool_bytes_pinned_against_pool_slice(fmt, block):
+    """Satellite: the shared_pages dedup ledger is pinned against REAL
+    pool slices — the analytics can never under-report KV memory."""
+    cfg = KVCacheConfig(n_layers=2, n_kv_heads=2, head_dim=8,
+                        page_size=8, n_pages=6, exp_bits=fmt[0],
+                        man_bits=fmt[1], block_scale=block is not None,
+                        block_size=block or 32)
+    pool = alloc_pool(cfg)
+    ids = np.asarray([1, 2, 3])
+    slice_bytes = np.asarray(pool)[:, ids].nbytes
+    ledger = kv_pool_bytes(*fmt, cfg.page_size, cfg.n_kv_heads,
+                           cfg.head_dim, n_layers=cfg.n_layers,
+                           logical_pages=3, shared_pages=1,
+                           block_size=block)
+    assert ledger["logical_bytes"] == slice_bytes
+    assert ledger["resident_bytes"] == \
+        np.asarray(pool)[:, ids[:2]].nbytes
+    assert ledger["saved_bytes"] == \
+        2 * kv_page_bytes(*fmt, cfg.page_size, cfg.n_kv_heads,
+                          cfg.head_dim, block_size=block)
+    assert ledger["logical_bytes"] == \
+        ledger["resident_bytes"] + ledger["saved_bytes"]
+
+
+def test_kv_pool_bytes_validates():
+    with pytest.raises(ValueError, match="shared_pages"):
+        kv_pool_bytes(5, 2, 8, 2, 8, n_layers=1, logical_pages=2,
+                      shared_pages=3)
+    with pytest.raises(ValueError, match="n_layers"):
+        kv_pool_bytes(5, 2, 8, 2, 8, n_layers=0, logical_pages=2)
+
+
+def test_registry_fleet_family_and_engine_labels(gqa_model):
+    from cpd_tpu.obs import MetricsRegistry
+    model, params = gqa_model
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW))
+    run_fleet_trace(fleet, mixed_trace(4, VOCAB, prompt_lens=(5,),
+                                       max_new=(3,), seed=2))
+    reg = MetricsRegistry()
+    reg.absorb_fleet_counters(fleet)
+    d = reg.as_dict()
+    assert d["cpd_fleet_submitted"]["value"] == 4.0
+    assert d["cpd_fleet_engines"]["value"] == 2.0
+    # per-engine cpd_serve series are engine-labelled
+    serve = d["cpd_serve_completed"]["value"]
+    assert set(serve) == {"engine=0", "engine=1"}
+    assert sum(serve.values()) == 4.0
+
+
+def test_merged_chrome_trace_has_per_engine_lanes(gqa_model, tmp_path):
+    from cpd_tpu.obs import Tracer, merge_chrome_traces
+    model, params = gqa_model
+    tracers = [Tracer("serve", meta={"engine": i}) for i in range(2)]
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+                  tracers=tracers)
+    run_fleet_trace(fleet, mixed_trace(4, VOCAB, prompt_lens=(5,),
+                                       max_new=(3,), seed=2))
+    path = merge_chrome_traces(tracers, os.path.join(tmp_path,
+                                                     "fleet.json"),
+                               strip_wall=True)
+    doc = json.load(open(path))
+    pids = {ev["pid"] for ev in doc["traceEvents"]}
+    assert pids == {1, 2}
+    names = {ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev["ph"] == "M"}
+    assert names == {"cpd_tpu:fleet:engine0", "cpd_tpu:fleet:engine1"}
+    assert doc["otherData"]["engines"] == 2
+    # both engines actually emitted request events into their lanes
+    req_pids = {ev["pid"] for ev in doc["traceEvents"]
+                if ev.get("cat") == "req"}
+    assert req_pids == {1, 2}
+
+
+def test_shared_prefix_trace_shape():
+    trace = shared_prefix_trace(8, VOCAB, n_prefixes=2, prefix_len=8,
+                                suffix_lens=(2,), max_new=(4,), seed=3,
+                                sla=[dict(sla_class=0),
+                                     dict(sla_class=1)])
+    assert len(trace) == 8
+    prefixes = {t.prompt[:8] for t in trace}
+    assert len(prefixes) == 2
+    assert trace[0].prompt[:8] == trace[2].prompt[:8]
+    assert [t.sla_class for t in trace[:4]] == [0, 1, 0, 1]
+    assert all(t.arrival <= u.arrival for t, u in zip(trace, trace[1:]))

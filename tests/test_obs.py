@@ -485,6 +485,59 @@ def test_serve_timeline_reconstruction_is_exact(serve_model):
     assert rec["timeline_truncated"] is False
 
 
+def test_timeline_parity_holds_with_result_store_at_cap(serve_model):
+    """ISSUE 13 satellite — the PR 11 parity caveat, closed: with the
+    bounded `ResultStore` held AT CAP (evictions mid-run), the
+    published metrics still equal the timeline reconstruction
+    float-for-float, because `run_trace` now derives its per-request
+    numbers from the timeline whenever a tracer is attached — a
+    completed rid the store evicted keeps its true n_generated."""
+    from cpd_tpu.serve import ServeEngine, run_trace, timeline_metrics
+    model, params = serve_model
+    trace = _serve_trace(12)
+    tr = Tracer("serve", max_records=4096)
+    eng = ServeEngine(model, params, **ENGINE_KW, finished_cap=2,
+                      tracer=tr)
+    pub = run_trace(eng, list(trace), sla_ttft_ms=500.0,
+                    sla_tpot_ms=100.0)
+    # the precondition the OLD caveat excluded: the store really
+    # evicted finished entries mid-run
+    assert pub["counters"]["results_evicted"] > 0
+    assert len(eng.finished) <= 2
+    # ... and the per-request metrics are NOT truncated by it anymore
+    assert pub["metrics_truncated"] is False
+    rec = timeline_metrics(tr, sla_ttft_ms=500.0, sla_tpot_ms=100.0)
+    for key in ("submitted", "completed", "shed", "deadline_misses",
+                "dropped", "shed_rate", "deadline_miss_rate",
+                "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                "tpot_ms_p99", "goodput_tok_per_s", "goodput_by_class",
+                "tok_per_s", "duration_s"):
+        assert rec[key] == pub[key], key
+    assert rec["tokens_generated"] == \
+        pub["counters"]["tokens_generated"]
+    assert rec["timeline_truncated"] is False
+
+
+def test_run_trace_null_tracer_matches_tracerless_metrics(serve_model):
+    """NULL_TRACER is the documented disabled path: `run_trace` must
+    treat it exactly like ``tracer=None`` — store/event-derived
+    published metrics, not an (empty) timeline derivation."""
+    from cpd_tpu.obs.trace import NULL_TRACER
+    from cpd_tpu.serve import ServeEngine, run_trace
+    model, params = serve_model
+    trace = _serve_trace()
+    off = run_trace(ServeEngine(model, params, **ENGINE_KW),
+                    list(trace))
+    null = run_trace(ServeEngine(model, params, **ENGINE_KW,
+                                 tracer=NULL_TRACER), list(trace))
+    assert null["completed"] == off["completed"] == len(trace)
+    # the real latency numbers are published (not None/0.0 from an
+    # empty timeline); counters identical
+    assert null["ttft_ms_p50"] is not None
+    assert null["goodput_tok_per_s"] and null["goodput_tok_per_s"] > 0
+    assert null["counters"] == off["counters"]
+
+
 def test_timeline_metrics_without_run_trace_is_loud(serve_model):
     """An engine stepped manually records no step_begin walls —
     reconstruction must refuse (a silent wrong TTFT would betray the

@@ -40,6 +40,28 @@ programs of gates 1-4.
 same SLA-classed trace at increasing Poisson offered rates, reporting
 offered load vs goodput / shed_rate / deadline_miss_rate.
 
+``--fleet`` maps the FLEET frontier (ISSUE 13) for docs/PERF.md: the
+same offered trace behind a `cpd_tpu.fleet.Fleet` at N = 1, 2, 4
+engines (tok/s, goodput, shed rate — how admission-pressure sheds melt
+as engines are added), plus a prefix-hit-rate sweep on shared-prompt
+traces (hit rate, prefill chunks skipped, resident KV bytes saved —
+`quant.numerics.kv_pool_bytes` prices the dedup).
+
+``--fleet-smoke`` is the CI `fleet-smoke` gate (N = 2, short traces,
+compiled cfgs shared across engines through the serve step cache):
+
+  1. routed mixed trace on two fresh fleets -> identical fleet AND
+     per-engine counters, zero fleet-scope silent drops;
+  2. live migration drill: one session migrated mid-decode between
+     engines -> its remaining decode stream (and every other
+     request's) BITWISE identical to the unmigrated fleet run;
+  3. engine-kill drill: ``engine_kill`` under chaos -> snapshot+replay
+     recovery, drain to the survivor, zero silent drops, counters
+     exact and identical across two runs;
+  4. prefix-cache drill: shared-prompt trace -> confirmed hits, chunks
+     skipped, sampled logits bitwise identical to the cache-less
+     fleet, and the crafted Fletcher-collision pair must NOT share.
+
 Run it by hand for the docs/PERF.md numbers:
 
     JAX_PLATFORMS=cpu python tools/bench_serve.py --trace mixed \
@@ -466,6 +488,251 @@ def run_kv_sweep(args) -> dict:
             "requests": len(trace)}
 
 
+def _fleet(model, params, args, n_engines, **over):
+    from cpd_tpu.fleet import Fleet
+
+    kw = dict(_SMOKE_ENGINE, kv_format=args.kv_format, seed=args.seed)
+    ekw = over.pop("engine_over", {})
+    kw.update(ekw)
+    return Fleet(model, params, n_engines, engine_kw=kw, **over)
+
+
+def run_fleet(args) -> dict:
+    """The fleet frontier + prefix-hit-rate sweep for docs/PERF.md
+    (module docstring)."""
+    from cpd_tpu.quant.numerics import kv_pool_bytes
+    from cpd_tpu.serve import shared_prefix_trace
+    from cpd_tpu.serve.loadgen import run_fleet_trace
+
+    model, params = _build_model(args)
+    # one offered load, growing fleet: the same SLA-classed trace that
+    # saturates one engine (bounded queues, class-1 deadlines) is
+    # re-offered to N engines — sheds melt, goodput scales
+    from cpd_tpu.serve import poisson_trace, with_sla
+    trace = with_sla(
+        poisson_trace(args.requests * 2, _SMOKE_MODEL["vocab_size"],
+                      rate=4.0, prompt_lens=(4, 8, 12), max_new=(16,),
+                      seed=args.seed),
+        [dict(sla_class=0),
+         dict(sla_class=1, deadline_steps=args.deadline_steps)])
+    frontier = []
+    for n in (1, 2, 4):
+        _m = run_fleet_trace(
+            _fleet(model, params, args, n,
+                   engine_over={"max_queue": 4}), list(trace))  # warm
+        m = run_fleet_trace(
+            _fleet(model, params, args, n,
+                   engine_over={"max_queue": 4}), list(trace))
+        frontier.append({
+            "n_engines": n,
+            "tok_per_s": m["tok_per_s"],
+            "goodput_tok_per_s": m["goodput_tok_per_s"],
+            "shed_rate": m["shed_rate"],
+            "deadline_miss_rate": m["deadline_miss_rate"],
+            "completed": m["completed"], "shed": m["shed"],
+            "dropped": m["dropped"],
+            "router_retries": m["fleet_counters"]["router_retries"],
+        })
+
+    # prefix-hit-rate sweep: fewer distinct prefixes = more sharing
+    hkv = _SMOKE_MODEL["n_kv_heads"]
+    hd = _SMOKE_MODEL["d_model"] // _SMOKE_MODEL["n_heads"]
+    page = _SMOKE_ENGINE["page_size"]
+    prefix_rows = []
+    for n_prefixes in (8, 4, 2, 1):
+        sp = shared_prefix_trace(
+            args.requests, _SMOKE_MODEL["vocab_size"],
+            n_prefixes=n_prefixes, prefix_len=2 * page,
+            suffix_lens=(2, 4), max_new=(8,), rate=2.0,
+            seed=args.seed)
+        fleet = _fleet(model, params, args, 2, prefix_cache_pages=64)
+        m = run_fleet_trace(fleet, list(sp))
+        agg = fleet.aggregate_counters()
+        shared = agg["prefix_pages_shared"]
+        pool = kv_pool_bytes(
+            *args.kv_format, page, hkv, hd,
+            n_layers=_SMOKE_MODEL["n_layers"],
+            logical_pages=agg["pages_reserved"], shared_pages=shared)
+        prefix_rows.append({
+            "n_prefixes": n_prefixes,
+            "hit_rate": round(agg["prefix_hits"] / m["submitted"], 3),
+            "pages_shared": shared,
+            "prefill_chunks": agg["prefill_chunks"],
+            "tokens_skipped": agg["prefix_tokens_skipped"],
+            "kv_bytes_saved": pool["saved_bytes"],
+            "kv_bytes_logical": pool["logical_bytes"],
+            "tok_per_s": m["tok_per_s"],
+            "dropped": m["dropped"],
+        })
+    return {"fleet_frontier": frontier, "prefix_sweep": prefix_rows,
+            "requests": args.requests, "kv_format": list(args.kv_format),
+            "deadline_steps": args.deadline_steps}
+
+
+def run_fleet_smoke(args) -> dict:
+    """The CI `fleet-smoke` gate (module docstring): N=2 drills, short
+    traces, deterministic counters asserted twice."""
+    import numpy as np
+
+    from cpd_tpu.fleet import PrefixCache, token_digest
+    from cpd_tpu.resilience import FaultPlan
+    from cpd_tpu.serve import mixed_trace, shared_prefix_trace
+    from cpd_tpu.serve.loadgen import run_fleet_trace
+    from cpd_tpu.serve.scheduler import DECODE
+
+    model, params = _build_model(args)
+    trace = _drill_trace(args)
+    out = {"fleet_smoke": True, "kv_format": list(args.kv_format)}
+
+    # 1. routing determinism + fleet-scope zero silent drops
+    def route_run():
+        fleet = _fleet(model, params, args, 2)
+        return run_fleet_trace(fleet, list(trace)), fleet
+
+    r1, f1 = route_run()
+    r2, _ = route_run()
+    assert r1["fleet_counters"] == r2["fleet_counters"], \
+        f"fleet counters not deterministic:\n{r1['fleet_counters']}\n" \
+        f"{r2['fleet_counters']}"
+    assert r1["engine_counters"] == r2["engine_counters"], \
+        "per-engine counters not deterministic"
+    assert r1["dropped"] == 0 and f1.unresolved() == [], \
+        f"fleet-scope silent drops: {r1['dropped']} " \
+        f"(unresolved {f1.unresolved()})"
+    assert r1["completed"] == len(trace), r1
+    # both engines actually served traffic (the router spread load)
+    served = [c["admitted"] for c in r1["engine_counters"]]
+    assert all(s > 0 for s in served), \
+        f"router left an engine idle: admitted per engine = {served}"
+    out["routing"] = {"completed": r1["completed"],
+                      "admitted_per_engine": served,
+                      "deterministic": True, "silent_drops": 0}
+
+    # 2. live migration mid-decode: bitwise vs the unmigrated fleet run
+    def decode_rows(fleet):
+        rows = {}
+        for e in fleet.engines:
+            for rid, pos, row in e.logits_log:
+                rows[(rid, pos)] = row
+        return rows
+
+    def mig_run(migrate: bool):
+        fleet = _fleet(model, params, args, 2,
+                       engine_over={"kv_format": (8, 23),
+                                    "record_logits": True})
+        pending = sorted(trace, key=lambda r: (r.arrival, r.rid))
+        moved = None
+        while pending or not fleet.drained():
+            while pending and pending[0].arrival <= fleet.step_index:
+                fleet.submit(pending.pop(0))
+            if migrate and moved is None and fleet.step_index >= 6:
+                # first DECODE session in rid order — deterministic
+                for rid in sorted(fleet.placement):
+                    src = fleet.placement[rid]
+                    sl = fleet.engines[src].slot_of_rid(rid)
+                    if sl is not None and sl.state == DECODE:
+                        fleet.migrate(rid)
+                        moved = rid
+                        break
+            fleet.step()
+        return fleet, moved
+
+    base, _ = mig_run(False)
+    mig, moved = mig_run(True)
+    assert moved is not None, "migration drill never found a live session"
+    assert mig.counters["migrations"] == 1
+    b_rows, m_rows = decode_rows(base), decode_rows(mig)
+    assert b_rows.keys() == m_rows.keys() and len(b_rows) > 0
+    for key in b_rows:
+        assert (b_rows[key].view(np.uint32)
+                == m_rows[key].view(np.uint32)).all(), \
+            f"migrated fleet logits differ from unmigrated at {key}"
+    assert mig.unresolved() == []
+    out["migration"] = {"migrated_rid": moved,
+                        "rows_compared": len(b_rows), "bitwise": True}
+
+    # 3. engine-kill drill: snapshot+replay recovery, drain, exact x2
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        def kill_run(sub):
+            plan = FaultPlan.parse("engine_kill@6:1")
+            fleet = _fleet(model, params, args, 2, fault_plan=plan,
+                           snapshot_every=4,
+                           snapshot_dir=os.path.join(td, sub))
+            m = run_fleet_trace(fleet, list(trace))
+            return m, fleet
+
+        k1, kf1 = kill_run("a")
+        k2, _ = kill_run("b")
+    assert k1["fleet_counters"] == k2["fleet_counters"], \
+        f"kill-drill counters not deterministic:\n{k1['fleet_counters']}" \
+        f"\n{k2['fleet_counters']}"
+    assert k1["engine_counters"] == k2["engine_counters"]
+    assert k1["fleet_counters"]["engine_kills"] == 1
+    assert k1["fleet_counters"]["drains"] == 1
+    assert k1["dropped"] == 0 and kf1.unresolved() == [], \
+        f"silent drops after engine kill: {k1['dropped']}"
+    assert kf1.report_unfired() == []
+    out["engine_kill"] = {
+        "kills": k1["fleet_counters"]["engine_kills"],
+        "sessions_recovered":
+            k1["fleet_counters"]["sessions_recovered"],
+        "requeued": k1["fleet_counters"]["requeued"],
+        "migrated_out": k1["fleet_counters"]["migrations"],
+        "completed": k1["completed"], "silent_drops": 0,
+        "deterministic": True}
+
+    # 4. prefix-cache drill: hits engage, chunks skipped, bitwise vs
+    # the cache-less fleet; crafted Fletcher collision must not share
+    sp = shared_prefix_trace(8, _SMOKE_MODEL["vocab_size"],
+                             n_prefixes=2,
+                             prefix_len=2 * _SMOKE_ENGINE["page_size"],
+                             suffix_lens=(2, 4), max_new=(8,),
+                             seed=args.seed + 29)
+
+    def prefix_run(cached):
+        fleet = _fleet(model, params, args, 2,
+                       engine_over={"record_logits": True},
+                       **({"prefix_cache_pages": 64} if cached else {}))
+        m = run_fleet_trace(fleet, list(sp))
+        return fleet, m
+
+    pc, mc = prefix_run(True)
+    pn, mn = prefix_run(False)
+    agg = pc.aggregate_counters()
+    aggn = pn.aggregate_counters()
+    assert agg["prefix_hits"] > 0, agg
+    assert agg["prefill_chunks"] < aggn["prefill_chunks"], \
+        f"prefix hits skipped no chunks: {agg['prefill_chunks']} vs " \
+        f"{aggn['prefill_chunks']}"
+    c_rows, n_rows = decode_rows(pc), decode_rows(pn)
+    assert c_rows.keys() == n_rows.keys() and len(c_rows) > 0
+    for key in c_rows:
+        assert (c_rows[key].view(np.uint32)
+                == n_rows[key].view(np.uint32)).all(), \
+            f"prefix-hit logits differ from cold prefill at {key}"
+    assert mc["dropped"] == mn["dropped"] == 0
+    # the collision-confirmation rule, on the crafted pair: the
+    # position-weighted Fletcher gives (5,9,5) and (6,7,6) the SAME
+    # digest, and the byte comparison must refuse the share
+    cache = PrefixCache(4)
+    a, b = (5, 9, 5), (6, 7, 6)
+    assert token_digest(a) == token_digest(b)
+    cache.register(a, page_id=3)
+    assert cache.lookup(b + (1,), 3) == [], \
+        "Fletcher collision shared a page across different prefixes"
+    assert cache.lookup(a + (1,), 3) == [3]
+    assert cache.collisions_rejected >= 1
+    out["prefix_cache"] = {
+        "hits": agg["prefix_hits"],
+        "pages_shared": agg["prefix_pages_shared"],
+        "chunks": [agg["prefill_chunks"], aggn["prefill_chunks"]],
+        "rows_compared": len(c_rows), "bitwise": True,
+        "collision_rejected": True}
+    return out
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     p.add_argument("--smoke", action="store_true",
@@ -479,6 +746,14 @@ def main() -> int:
     p.add_argument("--overload-sweep", action="store_true",
                    help="map the overload frontier (offered load vs "
                         "goodput/shed/miss) for docs/PERF.md")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet frontier (N=1,2,4 goodput/shed scaling)"
+                        " + prefix-hit-rate sweep (ISSUE 13) for "
+                        "docs/PERF.md")
+    p.add_argument("--fleet-smoke", action="store_true",
+                   help="CI gate: N=2 route/migrate/kill/prefix drills"
+                        " — bitwise resume, zero silent drops, "
+                        "counters exact x2")
     p.add_argument("--deadline-steps", type=int, default=12,
                    help="class-1 TTFT deadline for --overload-sweep")
     p.add_argument("--trace", choices=("poisson", "bursty", "mixed"),
@@ -499,6 +774,10 @@ def main() -> int:
 
     if args.smoke:
         out = run_smoke(args)
+    elif args.fleet_smoke:
+        out = run_fleet_smoke(args)
+    elif args.fleet:
+        out = run_fleet(args)
     elif args.kv_sweep:
         out = run_kv_sweep(args)
     elif args.overload_sweep:
