@@ -422,8 +422,9 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
 
     # Budget-gated EXTRA (ISSUE 8): the overlapped backward-reduce
     # measurement — full-step throughput of fp32 vs faithful vs
-    # faithful+overlap vs ring vs ring+overlap at the smoke shape, plus
-    # each arm's structural interleaving count (overlap_evidence).  The
+    # faithful+overlap vs ring vs ring+overlap at the smoke shape.
+    # (The structural interleaving verdicts moved to the analyzer's
+    # ir-overlap rule, ISSUE 14 — this block is pure timing now.)  The
     # measurement function lives in tools/bench_reduce.py (one home —
     # the standalone tool and every BENCH capture report the same
     # arms); here it rides as `reduction.overlap` so the headline

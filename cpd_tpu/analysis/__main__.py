@@ -21,6 +21,20 @@ Options beyond PR 1's:
                          this > discovered pyproject > built-in)
     --explain RULE       print a rule's catalog entry + the minimal
                          bad/good example from its fixtures, then exit 0
+
+v3 (ISSUE 14) — the program-contract scope:
+
+    --ir                 ALSO run the jaxpr-level program rules
+                         (analysis/ir/): trace the registered compiled
+                         programs abstractly on CPU and check their
+                         collective-schedule / wire-ledger / bitwise-
+                         stability / overlap / retrace contracts.  The
+                         only mode that imports jax.  With --ir and no
+                         paths, ONLY the program pass runs (the CI
+                         ``ir-contracts`` gate).  A program that fails
+                         to trace is a finding AND exit 2 — an
+                         unverifiable contract means the gate is down,
+                         not clean.
 """
 
 from __future__ import annotations
@@ -29,7 +43,8 @@ import argparse
 import os
 import sys
 
-from .core import LintError, all_rules, render_json, render_text
+from .core import (LintError, all_rules, program_rules, render_json,
+                   render_text)
 from .config import ConfigError
 from .engine import DEFAULT_CACHE_DIR, run_analysis
 from .sarif import render_sarif
@@ -62,6 +77,9 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--since", default=None, metavar="REF",
                    help="with --changed-only: diff against REF instead "
                         "of the working tree (CI passes the PR base)")
+    p.add_argument("--ir", action="store_true",
+                   help="also run the jaxpr-level program-contract "
+                        "rules (imports jax; see docs/ANALYSIS.md v3)")
     return p
 
 
@@ -83,7 +101,13 @@ def _explain(rule_id: str) -> int:
         return 2
     print(f"{rule.id} [{rule.scope}]")
     print(f"  {rule.summary}\n")
-    doc = (sys.modules[type(rule).__module__].__doc__ or "").strip()
+    # rules living one-per-module document themselves in the module
+    # docstring; modules holding several (analysis/ir/rules.py) put the
+    # catalog entry on the CLASS — prefer the specific one
+    import inspect
+    doc = inspect.cleandoc(
+        type(rule).__doc__
+        or sys.modules[type(rule).__module__].__doc__ or "")
     if doc:
         print(doc + "\n")
     fdir = _fixtures_dir()
@@ -120,9 +144,10 @@ def main(argv=None) -> int:
     if args.explain is not None:
         return _explain(args.explain)
 
-    if not args.paths:
+    if not args.paths and not args.ir:
         # [tool.cpd-lint].paths provides the default roots; bare
-        # invocation with neither is an error, not an empty pass
+        # invocation with neither is an error, not an empty pass.
+        # (--ir with no paths is the program-pass-only gate.)
         try:
             from .config import load_config
             cfg = load_config([], cli_path=args.config)
@@ -130,7 +155,7 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
         args.paths = list(cfg.paths)
-    if not args.paths:
+    if not args.paths and not args.ir:
         print("error: no paths given and no [tool.cpd-lint].paths "
               "configured (try --help)", file=sys.stderr)
         return 2
@@ -148,7 +173,8 @@ def main(argv=None) -> int:
         result = run_analysis(
             args.paths, select=select, config_path=args.config,
             use_cache=not args.no_cache, cache_dir=args.cache_dir,
-            changed_only=args.changed_only, since=args.since)
+            changed_only=args.changed_only, since=args.since,
+            ir=args.ir)
     except (LintError, ConfigError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -158,22 +184,48 @@ def main(argv=None) -> int:
 
     if result.files_checked == 0:
         if args.changed_only:
-            # an empty diff is a legitimate clean PR, not an error
-            print("no changed Python files under the given paths",
+            # an empty diff is a legitimate clean PR, not an error —
+            # but with --ir the program pass ran regardless, and its
+            # findings/trace-failures must reach the output and the
+            # exit code below, never be discarded by the empty diff
+            if not args.ir:
+                print("no changed Python files under the given paths",
+                      file=sys.stderr)
+                return 0
+            print("no changed Python files under the given paths; "
+                  "program-contract results follow", file=sys.stderr)
+        elif args.paths or not args.ir:
+            # explicit paths with nothing under them stay a loud
+            # error even under --ir (the file gate checked NOTHING);
+            # only the deliberate no-paths `--ir` program-pass-only
+            # mode is exempt
+            print(f"error: no Python files under {args.paths}",
                   file=sys.stderr)
-            return 0
-        print(f"error: no Python files under {args.paths}",
-              file=sys.stderr)
-        return 2
+            return 2
 
     findings = result.findings
     if args.format == "json":
         print(render_json(findings, files_checked=result.files_checked,
-                          files_parsed=result.files_parsed))
+                          files_parsed=result.files_parsed,
+                          programs_checked=(result.programs_checked
+                                            if args.ir else None),
+                          programs_traced=(result.programs_traced
+                                           if args.ir else None)))
     elif args.format == "sarif":
         print(render_sarif(findings, base_dir=os.getcwd()))
     else:
         print(render_text(findings))
+    if result.trace_failures and (
+            select is None or select & set(program_rules())):
+        # every program rule's verdict covers only the programs that
+        # TRACED — so any selection touching the program scope is
+        # unverified when a registered program failed to trace, not
+        # just an explicit ir-trace selection.  The exit code must say
+        # "the analyzer could not verify", never "clean"/"findings".
+        print(f"error: {result.trace_failures} registered program(s) "
+              f"failed to trace — program contracts unverified",
+              file=sys.stderr)
+        return 2
     return 1 if findings else 0
 
 

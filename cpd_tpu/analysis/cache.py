@@ -8,12 +8,15 @@ key is the sha1 of the absolute path; the entry is valid only while its
 
     (mtime_ns, size, rule-set hash)
 
-The rule-set hash covers the sorted rule ids AND ``SCHEMA_VERSION`` —
-bump the version whenever extraction or a rule's logic changes shape, so
-stale caches self-invalidate instead of silently serving old facts.
-Config exemptions are deliberately NOT in the fingerprint: they are
-applied AFTER the cache (engine.py), so editing pyproject's
-[tool.cpd-lint] table never requires a cold run.
+The rule-set hash covers the sorted rule ids, ``SCHEMA_VERSION`` — bump
+the version whenever extraction or a rule's logic changes shape, so
+stale caches self-invalidate instead of silently serving old facts —
+AND the resolved config's fingerprint (``Config.fingerprint``, ISSUE
+14): exemptions are applied after the cache, but the config also picks
+the roots and is the policy every cached verdict was produced under, so
+editing pyproject's [tool.cpd-lint] table invalidates warm runs
+wholesale (regression-pinned) rather than leaving any path where a
+policy edit is silently served stale.
 
 An entry stores the module-rule findings (already suppression-filtered —
 suppressions live in the file, so the fingerprint covers them) and the
@@ -32,15 +35,21 @@ from typing import Optional
 
 from .core import Finding
 
-__all__ = ["LintCache", "SCHEMA_VERSION", "ruleset_hash"]
+__all__ = ["LintCache", "SCHEMA_VERSION", "ruleset_hash",
+           "DEFAULT_CACHE_DIR"]
+
+# the ONE home of the default cache location (engine.py re-exports it;
+# the IR fact cache nests under it as <dir>/ir/)
+DEFAULT_CACHE_DIR = ".cpd-lint-cache"
 
 # bump on ANY change to summary extraction, Finding shape, or rule logic
 # that could alter cached results for an unchanged file
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 
-def ruleset_hash(rule_ids) -> str:
-    blob = json.dumps([SCHEMA_VERSION, sorted(rule_ids)])
+def ruleset_hash(rule_ids, config_fingerprint: str = "") -> str:
+    blob = json.dumps([SCHEMA_VERSION, sorted(rule_ids),
+                       config_fingerprint])
     return hashlib.sha1(blob.encode()).hexdigest()[:16]
 
 
@@ -55,9 +64,10 @@ def _fingerprint(path: str, rules_hash: str) -> Optional[list]:
 class LintCache:
     """Directory-backed per-file cache (module docstring)."""
 
-    def __init__(self, directory: str, rule_ids):
+    def __init__(self, directory: str, rule_ids,
+                 config_fingerprint: str = ""):
         self.directory = directory
-        self.rules_hash = ruleset_hash(rule_ids)
+        self.rules_hash = ruleset_hash(rule_ids, config_fingerprint)
         self.hits = 0
         self.misses = 0
 

@@ -67,6 +67,19 @@ class Config:
         norm = os.path.normpath(path).replace(os.sep, "/")
         return any(frag in norm for frag in fragments)
 
+    def fingerprint(self) -> str:
+        """Stable digest of the RESOLVED config (exemptions + paths),
+        folded into the lint caches' fingerprints (analysis/cache.py)
+        so editing pyproject's [tool.cpd-lint] invalidates warm runs —
+        a cache entry is only as fresh as the policy it was filtered
+        and keyed under."""
+        import hashlib
+        import json
+        blob = json.dumps(
+            {"exempt": {k: sorted(v) for k, v in self.exempt.items()},
+             "paths": list(self.paths)}, sort_keys=True)
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
 
 DEFAULT_CONFIG = Config()
 

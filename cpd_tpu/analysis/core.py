@@ -20,8 +20,9 @@ import tokenize
 from typing import Callable, Iterable, Iterator, Optional
 
 __all__ = ["Finding", "ModuleContext", "Rule", "register", "all_rules",
-           "module_rules", "project_rules", "lint_source", "lint_file",
-           "lint_tree", "lint_parsed", "run_project_rules",
+           "module_rules", "project_rules", "program_rules",
+           "lint_source", "lint_file", "lint_tree", "lint_parsed",
+           "run_project_rules", "run_program_rules_on",
            "render_text", "render_json"]
 
 
@@ -145,9 +146,11 @@ class ModuleContext:
 
 class Rule:
     """Base class; subclasses set ``id``/``summary`` and implement
-    ``check``.  ``scope`` is "module" (check(ctx) per parsed file) or
+    ``check``.  ``scope`` is "module" (check(ctx) per parsed file),
     "project" (check(project) once per run, over the whole-program graph
-    — see analysis/project.py's ProjectRule)."""
+    — see analysis/project.py's ProjectRule), or "program"
+    (check(programs) over the traced-jaxpr facts of the registered
+    compiled programs — analysis/ir/, run only under ``--ir``)."""
 
     id: str = ""
     summary: str = ""
@@ -181,6 +184,10 @@ def module_rules() -> dict[str, Rule]:
 
 def project_rules() -> dict[str, Rule]:
     return {k: r for k, r in _REGISTRY.items() if r.scope == "project"}
+
+
+def program_rules() -> dict[str, Rule]:
+    return {k: r for k, r in _REGISTRY.items() if r.scope == "program"}
 
 
 # ---------------------------------------------------------------------------
@@ -431,6 +438,31 @@ def run_project_rules(summaries: list[dict],
     return out
 
 
+def run_program_rules_on(progset,
+                         select: Optional[Iterable[str]] = None
+                         ) -> list[Finding]:
+    """Program-scope pass over one traced ProgramSet (analysis/ir/run.py
+    builds it).  Comment suppressions do not apply — findings anchor at
+    declaration sites whose files the IR pass never parses; config
+    exemptions still do (applied by the caller, engine.py)."""
+    wanted = set(select) if select is not None else None
+    out: list[Finding] = []
+    for rule_id, rule in sorted(_REGISTRY.items()):
+        if rule.scope != "program":
+            continue
+        if wanted is not None and rule_id not in wanted:
+            continue
+        try:
+            out.extend(rule.check(progset))
+        except LintError:
+            raise
+        except Exception as e:
+            raise LintError(
+                f"program rule {rule_id!r} crashed: "
+                f"{type(e).__name__}: {e}") from e
+    return out
+
+
 def _apply_config(findings: list[Finding], config) -> list[Finding]:
     if config is None:
         return findings
@@ -539,7 +571,9 @@ def render_text(findings: list[Finding]) -> str:
 
 
 def render_json(findings: list[Finding], files_checked: int,
-                files_parsed: Optional[int] = None) -> str:
+                files_parsed: Optional[int] = None,
+                programs_checked: Optional[int] = None,
+                programs_traced: Optional[int] = None) -> str:
     by_rule: dict[str, int] = {}
     for f in findings:
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
@@ -553,4 +587,9 @@ def render_json(findings: list[Finding], files_checked: int,
         # additive cache telemetry (v1-compatible): how many files the
         # run actually re-parsed vs served from the fingerprint cache
         payload["files_parsed"] = files_parsed
+    if programs_checked is not None:
+        # additive --ir telemetry: registered programs checked, and how
+        # many actually re-traced (0 on a warm unchanged tree)
+        payload["programs_checked"] = programs_checked
+        payload["programs_traced"] = programs_traced
     return json.dumps(payload, indent=2, sort_keys=True)

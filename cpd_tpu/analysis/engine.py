@@ -18,13 +18,12 @@ import subprocess
 from typing import Iterable, Optional
 
 from . import core
-from .cache import LintCache
+from .cache import DEFAULT_CACHE_DIR, LintCache
 from .config import Config, load_config
 from .core import Finding, LintError
 
-__all__ = ["AnalysisResult", "run_analysis", "changed_files"]
-
-DEFAULT_CACHE_DIR = ".cpd-lint-cache"
+__all__ = ["AnalysisResult", "run_analysis", "changed_files",
+           "DEFAULT_CACHE_DIR"]
 
 
 @dataclasses.dataclass
@@ -33,6 +32,10 @@ class AnalysisResult:
     files_checked: int
     files_parsed: int        # cache misses; 0 on a warm unchanged tree
     config: Config
+    # program (IR) scope — populated only when run with ir=True
+    programs_checked: int = 0
+    programs_traced: int = 0   # IR cache misses; 0 on a warm tree
+    trace_failures: int = 0    # nonzero -> the gate is DOWN (exit 2)
 
 
 def changed_files(paths: Iterable[str],
@@ -99,8 +102,17 @@ def run_analysis(paths: Iterable[str],
                  use_cache: bool = True,
                  cache_dir: Optional[str] = None,
                  changed_only: bool = False,
-                 since: Optional[str] = None) -> AnalysisResult:
-    """The CLI's analysis pipeline (module docstring)."""
+                 since: Optional[str] = None,
+                 ir: bool = False,
+                 ir_providers=None) -> AnalysisResult:
+    """The CLI's analysis pipeline (module docstring).
+
+    ``ir=True`` additionally runs the program-contract scope
+    (analysis/ir/): the registered compiled programs are traced to
+    jaxprs (fact-cached under the same cache dir) and the ir-* rules
+    check their declared contracts.  The ONLY mode that imports jax.
+    ``ir_providers`` overrides the registry source (fixture registries
+    in tests)."""
     paths = list(paths)
     config = load_config(paths, cli_path=config_path)
     if changed_only:
@@ -110,7 +122,8 @@ def run_analysis(paths: Iterable[str],
     cache = None
     if use_cache:
         cache = LintCache(cache_dir or DEFAULT_CACHE_DIR,
-                          sorted(core.all_rules()))
+                          sorted(core.all_rules()),
+                          config_fingerprint=config.fingerprint())
     findings: list[Finding] = []
     summaries: list[dict] = []
     parsed = 0
@@ -141,6 +154,21 @@ def run_analysis(paths: Iterable[str],
         findings.extend(local)
         summaries.append(summary)
     findings.extend(core.run_project_rules(summaries, select=select))
+    programs_checked = programs_traced = trace_failures = 0
+    if ir:
+        from .ir.run import run_ir
+        from .ir.registry import DEFAULT_PROVIDERS
+        ir_result = run_ir(
+            select=select,
+            providers=(ir_providers if ir_providers is not None
+                       else DEFAULT_PROVIDERS),
+            use_cache=use_cache,
+            cache_dir=cache_dir or DEFAULT_CACHE_DIR,
+            extra_fingerprint=config.fingerprint())
+        findings.extend(ir_result.findings)
+        programs_checked = ir_result.programs_checked
+        programs_traced = ir_result.programs_traced
+        trace_failures = ir_result.trace_failures
     if select is not None:
         wanted = set(select)
         findings = [f for f in findings if f.rule in wanted]
@@ -148,4 +176,7 @@ def run_analysis(paths: Iterable[str],
                 if not config.exempts(f.rule, f.path)]
     return AnalysisResult(findings=sorted(findings),
                           files_checked=len(files),
-                          files_parsed=parsed, config=config)
+                          files_parsed=parsed, config=config,
+                          programs_checked=programs_checked,
+                          programs_traced=programs_traced,
+                          trace_failures=trace_failures)

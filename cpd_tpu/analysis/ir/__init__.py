@@ -1,0 +1,42 @@
+"""analysis v3 — jaxpr-level program-contract verification.
+
+The per-file (module) and whole-program (project) rule scopes see Python
+AST; neither can see the invariants CPD actually sells — the compiled
+program's collective schedule, its packed wire bytes, its ulp-stability,
+its overlap interleaving.  The PR 12 ``exp2_exact`` fix is the canonical
+miss: XLA:CPU's ``exp2`` is off by an ulp for most integer inputs and
+*program-dependent*, so every cross-program bitwise APS contract held by
+luck — and no AST rule could have said so.  This package adds the third
+rule scope, ``program``: subsystems *declare* their contract-bearing
+programs in a registry (`registry.ProgramRegistry`; declarations live in
+``parallel/ring.py``, ``parallel/zero.py``, ``parallel/overlap.py``,
+``parallel/reduction.py``, ``train/step.py``, ``serve/model.py``), the
+tracer (`trace.py`) traces each one ABSTRACTLY on CPU to its jaxpr
+(``jax.make_jaxpr`` over ``ShapeDtypeStruct`` inputs — no compile, no
+execute, no weights) and extracts serializable **facts** (collective
+schedule with scan trip counts, transport bytes, primitive census,
+interleaving evidence, cond-branch collective sets, a jaxpr
+fingerprint), and the program rules (`rules.py`) machine-check the
+declared contracts against those facts.  Findings ride the existing
+engine/config/SARIF/CLI machinery and anchor at the declaration site.
+
+Unlike the rest of the analysis package this scope needs jax — it is
+therefore OFF by default (``python -m cpd_tpu.analysis`` stays
+stdlib-only and milliseconds) and runs only under the CLI's ``--ir``
+flag / ``run_analysis(ir=True)`` — the CI ``ir-contracts`` gate.  Traced
+facts are fingerprint-cached per program over the program's declared
+source deps (`run.py`), so a warm run re-traces zero unchanged programs.
+
+The rule classes themselves import no jax and register with the normal
+registry at package import, so ``--list-rules``/``--explain``/config
+exemptions cover them everywhere.
+"""
+
+from .registry import (ProgramRegistry, ProgramSpec, collect_programs,
+                       DEFAULT_PROVIDERS, ensure_cpu_devices)
+from .rules import ProgramRule, ProgramSet
+from .run import IRResult, run_ir
+
+__all__ = ["ProgramRegistry", "ProgramSpec", "collect_programs",
+           "DEFAULT_PROVIDERS", "ensure_cpu_devices", "ProgramRule",
+           "ProgramSet", "IRResult", "run_ir"]

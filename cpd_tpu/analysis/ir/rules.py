@@ -1,0 +1,298 @@
+"""The program-scope rules: machine-checked contracts over traced
+jaxpr facts (trace.py) of the registered programs (registry.py).
+
+Six rules, each with a fixture registry pinning its true positives
+(tests/fixtures/analysis/ir_*_bad.py / tests/test_analysis_ir.py):
+
+``ir-trace``        a registered program that fails to trace IS a
+                    finding (and the CLI exits 2) — never a silent
+                    skip; an unverifiable contract is a broken gate.
+``ir-schedule``     the collective-schedule race/desync detector.
+``ir-wire-ledger``  jaxpr-counted transport bytes == the analytic
+                    tables.
+``ir-bitwise``      no ulp-unstable primitive in a bitwise-gated
+                    program.
+``ir-overlap``      overlap-configured programs must actually
+                    interleave.
+``ir-retrace``      distinct programs in one StepTable family must
+                    carry distinct cache keys.
+
+This module imports no jax — rules consume plain extracted facts — so
+registration at ``cpd_tpu.analysis`` import keeps the base package
+stdlib-only.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import Finding, Rule, register
+from .trace import TracedProgram, schedule_counter
+
+__all__ = ["ProgramRule", "ProgramSet"]
+
+# the ulp-unstable transcendental class: XLA lowers these to polynomial
+# expansions whose final ulp DIFFERS BETWEEN COMPILED PROGRAMS (the
+# PR 12 exp2/log2 bug class; pow shares the lowering).  exp/log/erf/
+# tanh/rsqrt are deliberately absent from the default set: they are
+# used by every softmax/normalizer and their cross-program stability is
+# covered by the value-parity twin tests — a spec can still blacklist
+# them per program via a stricter contract if a future backend breaks
+# one.  Blessed exact replacements: aps.exp2_exact / _ceil_log2_exact /
+# numerics._pow2 (bit assembly — no such primitive ever appears).
+UNSTABLE_PRIMS = ("exp2", "log2", "pow")
+
+
+class ProgramSet:
+    """What a program rule checks: every TracedProgram of one run."""
+
+    def __init__(self, programs: list):
+        self.programs: list[TracedProgram] = list(programs)
+
+    def ok(self) -> list:
+        return [p for p in self.programs if p.ok]
+
+    def groups(self, attr: str) -> dict:
+        out: dict = {}
+        for p in self.ok():
+            key = getattr(p.spec, attr)
+            if key is not None:
+                out.setdefault(key, []).append(p)
+        return out
+
+
+class ProgramRule(Rule):
+    """Base for program-scope rules: ``check`` receives a ProgramSet."""
+
+    scope = "program"
+
+    def check(self, programs: ProgramSet) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def _finding(self, spec, message: str) -> Finding:
+        path, line = spec.origin
+        return Finding(path=path, line=line, col=0, rule=self.id,
+                       message=message)
+
+
+@register
+class TraceHonesty(ProgramRule):
+    """A registered program that cannot be traced reports a finding —
+    the analyzer refuses to pretend it verified a contract it never
+    saw.  The CLI maps any ir-trace finding to exit 2 (analyzer-broke),
+    not exit 1 (lint findings): the gate is DOWN, not clean."""
+
+    id = "ir-trace"
+    summary = ("registered program failed to trace — its contracts are "
+               "unverified (exit 2, never a silent skip)")
+
+    def check(self, programs: ProgramSet) -> Iterator[Finding]:
+        for p in programs.programs:
+            if not p.ok:
+                yield self._finding(
+                    p.spec, f"program {p.spec.name!r} failed to trace: "
+                            f"{p.error}")
+
+
+@register
+class CollectiveSchedule(ProgramRule):
+    """The distributed race/deadlock detector.  (1) Ladder-rung twins
+    that claim bitwise parity (same ``twin`` group) must move the
+    IDENTICAL multiset of transport collectives — kind, mesh axes,
+    payload dtype/shape, trip count; a twin that gathers an extra
+    tensor, rides a different axis or reshapes its wire has silently
+    changed the reduction it claims to reproduce, and at pod scale a
+    desynced schedule is a hang, not a wrong answer.  (2) No transport
+    collective may sit under a ``lax.cond`` whose branches carry
+    unequal collective sets: replicas disagreeing on the predicate
+    would leave some ranks waiting at a rendezvous the others never
+    enter — the MLPerf-pods divergent-program deadlock."""
+
+    id = "ir-schedule"
+    summary = ("collective schedule must be identical across bitwise "
+               "twins; no collective under a divergent cond branch")
+
+    def check(self, programs: ProgramSet) -> Iterator[Finding]:
+        for p in programs.ok():
+            for c in p.facts["cond_divergent"]:
+                yield self._finding(
+                    p.spec,
+                    f"program {p.spec.name!r}: transport collectives "
+                    f"differ across cond branches "
+                    f"({c['branches']}) — a divergent predicate "
+                    f"deadlocks the mesh")
+        for group, members in sorted(programs.groups("twin").items()):
+            if len(members) < 2:
+                yield self._finding(
+                    members[0].spec,
+                    f"twin group {group!r} has a single traced member "
+                    f"({members[0].spec.name!r}) — nothing to compare "
+                    f"its schedule against")
+                continue
+            base = members[0]
+            base_sched = schedule_counter(base.facts["collectives"])
+            for other in members[1:]:
+                sched = schedule_counter(other.facts["collectives"])
+                if sched == base_sched:
+                    continue
+                extra = {k: v for k, v in sched.items()
+                         if base_sched.get(k) != v}
+                missing = {k: v for k, v in base_sched.items()
+                           if sched.get(k) != v}
+                yield self._finding(
+                    other.spec,
+                    f"twin group {group!r}: {other.spec.name!r} and "
+                    f"{base.spec.name!r} claim bitwise parity but move "
+                    f"different collective schedules — "
+                    f"only in {other.spec.name!r}: "
+                    f"{sorted(map(str, extra))}; only in "
+                    f"{base.spec.name!r}: {sorted(map(str, missing))}")
+
+
+@register
+class WireLedger(ProgramRule):
+    """jaxpr-counted transport payload bytes per device must EQUAL the
+    analytic tables (`ring_transport_bytes` / `gather_transport_bytes`
+    / `zero2_transport_bytes`, blocked sidecars included).  The
+    analytics are what docs/PERF.md and the benches quote; a program
+    quietly shipping more — an fp32 debug gather, an unpacked hop, a
+    forgotten sidecar — fails lint instead of shipping a wire the
+    ledger never priced."""
+
+    id = "ir-wire-ledger"
+    summary = ("counted collective wire bytes must equal the analytic "
+               "transport tables (blocked sidecars included)")
+
+    def check(self, programs: ProgramSet) -> Iterator[Finding]:
+        for p in programs.ok():
+            if p.spec.wire is None:
+                continue
+            if p.facts["unpriceable"]:
+                yield self._finding(
+                    p.spec,
+                    f"program {p.spec.name!r}: wire contract declared "
+                    f"but a transport collective is unpriceable "
+                    f"(inside while/cond, or on an axis missing from "
+                    f"axis_sizes)")
+                continue
+            try:
+                expected = int(p.spec.wire())
+            except Exception as e:  # noqa: BLE001 — surfaced, not raised
+                yield self._finding(
+                    p.spec, f"program {p.spec.name!r}: wire contract "
+                            f"thunk crashed: {type(e).__name__}: {e}")
+                continue
+            got = int(p.facts["transport_bytes"])
+            if got != expected:
+                sched = sorted(map(str, schedule_counter(
+                    p.facts["collectives"])))
+                yield self._finding(
+                    p.spec,
+                    f"program {p.spec.name!r}: wire ledger mismatch — "
+                    f"jaxpr moves {got} bytes/device, analytic table "
+                    f"says {expected} (schedule: {sched})")
+
+
+@register
+class BitwiseStability(ProgramRule):
+    """Programs registered as bitwise-gated must not contain an
+    ulp-unstable transcendental primitive (exp2/log2/pow): XLA's
+    polynomial lowerings land on different final ulps in different
+    compiled programs, so any cross-program bitwise contract riding
+    one holds only by luck — the PR 12 ``aps.exp2_exact`` bug class,
+    found mechanically.  The blessed helpers (bit-assembly exp2_exact /
+    _ceil_log2_exact / _pow2) emit no such primitive, so a hit always
+    names real exposure.  A spec may bless a named primitive with a
+    justification via ``allow_unstable``."""
+
+    id = "ir-bitwise"
+    summary = ("no ulp-unstable primitive (exp2/log2/pow) inside a "
+               "bitwise-gated program outside the blessed exact helpers")
+
+    def check(self, programs: ProgramSet) -> Iterator[Finding]:
+        for p in programs.ok():
+            if not p.spec.bitwise:
+                continue
+            allowed = {a.split()[0] for a in p.spec.allow_unstable}
+            for prim in UNSTABLE_PRIMS:
+                n = p.facts["prims"].get(prim, 0)
+                if n and prim not in allowed:
+                    yield self._finding(
+                        p.spec,
+                        f"program {p.spec.name!r} is bitwise-gated but "
+                        f"contains {n} `{prim}` equation(s) — "
+                        f"program-dependent final ulp (use the exact "
+                        f"bit-assembly helpers: aps.exp2_exact / "
+                        f"_ceil_log2_exact / numerics._pow2, or bless "
+                        f"it via allow_unstable with a justification)")
+
+
+@register
+class OverlapInterleaving(ProgramRule):
+    """`overlap_evidence` generalized into the registry: a program
+    declared ``overlap=True`` must actually interleave — transport
+    collectives emitted while matmul/conv compute is still pending in
+    the jaxpr (the dependency freedom XLA needs to hide hops under
+    backward compute); ``overlap=False`` must strictly postdate all
+    compute (the monolith shape).  Structural, timing-free — a loaded
+    CI box cannot flake it — and now gated for EVERY overlap-configured
+    registered program, not just where a bench script happened to call
+    the probe."""
+
+    id = "ir-overlap"
+    summary = ("overlap-configured programs must interleave transport "
+               "with compute in the jaxpr (monoliths must not)")
+
+    def check(self, programs: ProgramSet) -> Iterator[Finding]:
+        for p in programs.ok():
+            if p.spec.overlap is None:
+                continue
+            ev = p.facts["evidence"]
+            if p.spec.overlap and not ev["interleaved"]:
+                yield self._finding(
+                    p.spec,
+                    f"program {p.spec.name!r} is overlap-configured "
+                    f"but its jaxpr is a monolith — every transport "
+                    f"collective postdates all compute ({ev})")
+            elif not p.spec.overlap and ev["interleaved"]:
+                yield self._finding(
+                    p.spec,
+                    f"program {p.spec.name!r} is declared monolithic "
+                    f"but its transport interleaves with compute "
+                    f"({ev}) — the twin claim is measuring the wrong "
+                    f"schedule")
+
+
+@register
+class RetraceCompleteness(ProgramRule):
+    """The retrace-completeness probe, the PR 5 half-keyed StepTable
+    bug verified DYNAMICALLY: members of one ``retrace_group`` are the
+    entries one jit/StepTable cache family would hold, traced at
+    perturbed config coordinates.  Two members whose traced programs
+    DIFFER (jaxpr fingerprints) while their declared cache keys are
+    EQUAL would be served each other's compiled step after a ladder
+    transition — a key coordinate is missing.  (Distinct keys for
+    identical programs are fine: over-keying only costs a retrace.)"""
+
+    id = "ir-retrace"
+    summary = ("distinct traced programs in one cache-key family must "
+               "carry distinct ladder_step_keys")
+
+    def check(self, programs: ProgramSet) -> Iterator[Finding]:
+        for group, members in sorted(
+                programs.groups("retrace_group").items()):
+            by_key: dict = {}
+            for p in members:
+                by_key.setdefault(repr(p.spec.retrace_key),
+                                  []).append(p)
+            for key, ps in sorted(by_key.items()):
+                fps = {p.facts["jaxpr_sha1"] for p in ps}
+                if len(fps) > 1:
+                    names = sorted(p.spec.name for p in ps)
+                    yield self._finding(
+                        ps[0].spec,
+                        f"cache-key family {group!r}: programs {names} "
+                        f"trace to {len(fps)} DISTINCT jaxprs but share "
+                        f"the cache key {key} — a config coordinate is "
+                        f"missing from ladder_step_key (the PR 5 "
+                        f"half-keyed StepTable bug)")

@@ -1,0 +1,160 @@
+"""The IR pass driver: collect → (cached) trace → rules → findings.
+
+Mirrors engine.run_analysis's shape for the program scope.  Facts —
+not findings — are what the cache holds: the schedule rule compares
+twins ACROSS programs, so a rule needs every member's facts even when
+only one re-traced; rules re-run every time (they are dict lookups),
+tracing is what the cache saves.  A program's fingerprint covers
+
+    (IR schema, jax version, spec dep files' (mtime_ns, size))
+
+where the dep set is the spec's declared modules PLUS the provider
+module that declared it — editing any of them re-traces exactly the
+affected programs; a warm run over an unchanged tree re-traces ZERO
+(pinned by tests/test_analysis_ir.py).  The resolved lint config is
+folded in by the caller through ``extra_fingerprint`` (engine.py), the
+same invalidate-on-config-edit contract the file cache carries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import importlib
+import json
+import os
+from typing import Iterable, Optional
+
+from ..cache import DEFAULT_CACHE_DIR
+from ..core import Finding
+from .registry import (DEFAULT_PROVIDERS, ProgramSpec, collect_programs,
+                       ensure_cpu_devices)
+from .rules import ProgramSet
+from .trace import TracedProgram, trace_program
+
+__all__ = ["IRResult", "run_ir", "IR_SCHEMA_VERSION"]
+
+# bump whenever trace.py's fact extraction changes shape
+IR_SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class IRResult:
+    findings: list
+    programs_checked: int
+    programs_traced: int     # cache misses; 0 on a warm unchanged tree
+    trace_failures: int      # nonzero maps to CLI exit 2
+
+
+def _dep_files(spec: ProgramSpec, provider_file: Optional[str]) -> list:
+    paths = []
+    if provider_file:
+        paths.append(provider_file)
+    for dep in spec.deps:
+        try:
+            mod = importlib.import_module(dep)
+            f = getattr(mod, "__file__", None)
+        except Exception:   # noqa: BLE001 — a missing dep is a stale key
+            f = None
+        if f:
+            paths.append(f)
+    return sorted(set(os.path.abspath(p) for p in paths))
+
+
+def _fingerprint(spec: ProgramSpec, provider_file: Optional[str],
+                 extra: str) -> Optional[str]:
+    import jax
+    parts = [IR_SCHEMA_VERSION, jax.__version__, extra, spec.name]
+    for path in _dep_files(spec, provider_file):
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        parts.append([path, st.st_mtime_ns, st.st_size])
+    return hashlib.sha1(json.dumps(parts).encode()).hexdigest()
+
+
+class _FactCache:
+    """One JSON file per program under ``<cache_dir>/ir/``.  Corrupt or
+    stale entries are misses, never errors (accelerator, not truth).
+    Trace FAILURES are never cached: a failure can be environmental
+    (device count, a flaky import) and must re-verify every run."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.join(directory, "ir")
+
+    def _path(self, name: str) -> str:
+        key = hashlib.sha1(name.encode()).hexdigest()
+        return os.path.join(self.directory, key + ".json")
+
+    def get(self, name: str, fingerprint: Optional[str]):
+        if fingerprint is None:
+            return None
+        try:
+            with open(self._path(name), encoding="utf-8") as fh:
+                entry = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if entry.get("fingerprint") != fingerprint:
+            return None
+        facts = entry.get("facts")
+        return facts if isinstance(facts, dict) else None
+
+    def put(self, name: str, fingerprint: Optional[str],
+            facts: dict) -> None:
+        if fingerprint is None:
+            return
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = self._path(name) + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"fingerprint": fingerprint, "facts": facts},
+                          fh)
+            os.replace(tmp, self._path(name))
+        except OSError:
+            pass    # read-only checkout still lints
+
+
+def run_ir(select: Optional[Iterable[str]] = None,
+           providers=DEFAULT_PROVIDERS,
+           use_cache: bool = True,
+           cache_dir: Optional[str] = None,
+           extra_fingerprint: str = "") -> IRResult:
+    """Run the program-contract pass (module docstring).
+
+    ``select`` filters RULES (not programs) exactly like the file pass;
+    ``providers`` overrides the registry source (fixture registries in
+    tests pass .py paths); ``extra_fingerprint`` folds caller context —
+    the resolved config — into every program's cache key."""
+    from ..core import LintError, run_program_rules_on
+    ensure_cpu_devices()
+    try:
+        registry = collect_programs(providers)
+    except Exception as e:  # noqa: BLE001 — surfaced as exit 2
+        raise LintError(f"IR program collection failed: "
+                        f"{type(e).__name__}: {e}") from e
+    cache = _FactCache(cache_dir or DEFAULT_CACHE_DIR) if use_cache \
+        else None
+    programs: list[TracedProgram] = []
+    traced = 0
+    for spec in registry.specs:
+        provider_file = spec.origin[0] if spec.origin else None
+        fp = None
+        if cache is not None:
+            fp = _fingerprint(spec, provider_file, extra_fingerprint)
+            facts = cache.get(spec.name, fp)
+            if facts is not None:
+                programs.append(TracedProgram(spec, facts=facts))
+                continue
+        tp = trace_program(spec)
+        traced += 1
+        if tp.ok and cache is not None:
+            cache.put(spec.name, fp, tp.facts)
+        programs.append(tp)
+    progset = ProgramSet(programs)
+    findings: list[Finding] = run_program_rules_on(progset, select=select)
+    failures = sum(1 for p in programs if not p.ok)
+    return IRResult(findings=sorted(findings),
+                    programs_checked=len(programs),
+                    programs_traced=traced,
+                    trace_failures=failures)

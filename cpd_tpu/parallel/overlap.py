@@ -55,7 +55,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["bucket_layout", "BucketPlan", "overlapped_grads",
-           "overlap_evidence", "extract_bucket_shards", "REPORT_FIELDS",
+           "overlap_evidence", "evidence_from_prims",
+           "extract_bucket_shards", "REPORT_FIELDS",
            "DEFAULT_BUCKET_ELEMS"]
 
 # One home for the default per-bucket element cap (dist.py re-exports it
@@ -455,24 +456,16 @@ def _walk_eqns(jaxpr, out: list):
     return out
 
 
-def overlap_evidence(fn: Callable, *args,
-                     min_collective_elems: int = 2) -> dict:
-    """Trace ``fn(*args)`` and report how much matmul/conv compute the
-    program is free to schedule AFTER its first payload-bearing
-    reduction collective.
-
-    ``compute_after_first_collective == 0`` means every gradient
-    collective postdates all compute — the post-backward monolith (no
-    overlap possible).  A positive count is the structural signature of
-    the bucketed schedule: bucket k's ring hops are emitted while bucket
-    k+1's backward matmuls are still pending, so the compiler MAY
-    overlap them.  Collectives moving fewer than
-    ``min_collective_elems`` elements are ignored — the world-size psum,
-    loss/metric psums and the APS per-leaf exponent pmax are scalar
-    bookkeeping, not gradient transport.  This checks the emitted
-    dependency order, not wall-clock — a loaded CI box cannot flake
-    it."""
-    prims = _walk_eqns(jax.make_jaxpr(fn)(*args).jaxpr, [])
+def evidence_from_prims(prims: Sequence,
+                        min_collective_elems: int = 2) -> dict:
+    """The ONE interleaving-count implementation, over an emission-order
+    ``(primitive_name, max_operand_elems)`` stream (`_walk_eqns`'s
+    output shape — the IR analyzer's program tracer feeds its own walk
+    through here, analysis/ir/trace.py, so the CI gate and the lint
+    rule cannot drift).  Collectives moving fewer than
+    ``min_collective_elems`` elements are ignored — the world-size
+    psum, loss/metric psums and the APS per-leaf exponent pmax are
+    scalar bookkeeping, not gradient transport."""
     first_coll = None
     compute_positions = []
     n_coll = 0
@@ -489,3 +482,104 @@ def overlap_evidence(fn: Callable, *args,
             "compute_eqns": len(compute_positions),
             "compute_after_first_collective": after,
             "interleaved": after > 0}
+
+
+def overlap_evidence(fn: Callable, *args,
+                     min_collective_elems: int = 2) -> dict:
+    """Trace ``fn(*args)`` and report how much matmul/conv compute the
+    program is free to schedule AFTER its first payload-bearing
+    reduction collective.
+
+    ``compute_after_first_collective == 0`` means every gradient
+    collective postdates all compute — the post-backward monolith (no
+    overlap possible).  A positive count is the structural signature of
+    the bucketed schedule: bucket k's ring hops are emitted while bucket
+    k+1's backward matmuls are still pending, so the compiler MAY
+    overlap them.  This checks the emitted dependency order, not
+    wall-clock — a loaded CI box cannot flake it.  Every
+    overlap-configured REGISTERED program is additionally gated on this
+    verdict in CI by the ``ir-overlap`` analyzer rule
+    (analysis/ir/rules.py), which shares `evidence_from_prims`."""
+    prims = _walk_eqns(jax.make_jaxpr(fn)(*args).jaxpr, [])
+    return evidence_from_prims(prims,
+                               min_collective_elems=min_collective_elems)
+
+
+def ir_programs(reg):
+    """Program-contract declarations (analysis/ir/registry.py): a toy
+    two-bucket overlapped_grads program and its post-backward monolith
+    — the minimal schedule twins.  They claim bitwise parity
+    (tests/test_overlap.py's whole matrix), so the `ir-schedule` rule
+    pins their collective multisets equal; the `ir-overlap` rule pins
+    the structural verdicts (taps interleave, monolith does not) — the
+    registry-generalized form of `overlap_evidence`, gated in CI for
+    every overlap-configured program rather than where a bench script
+    happened to call the probe."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
+    from .mesh import data_parallel_mesh
+    from .ring import ring_transport_bytes
+
+    W, d = 8, 64
+    n_leaf = d * d
+    deps = ("cpd_tpu.parallel.overlap", "cpd_tpu.parallel.dist",
+            "cpd_tpu.parallel.ring", "cpd_tpu.quant.numerics")
+    reduce_kw = dict(mode="ring", grad_exp=5, grad_man=2)
+
+    def _params():
+        return {"w1": jnp.zeros((d, d), jnp.float32),
+                "w2": jnp.zeros((d, d), jnp.float32)}
+
+    def _wire():
+        # two buckets (one per dxd leaf at cap n_leaf), each ringing
+        # its own n_leaf-element flat — identical for taps and monolith
+        return 2 * ring_transport_bytes(n_leaf, W, 5, 2)
+
+    def _overlapped():
+        def build():
+            mesh = data_parallel_mesh()
+            plan = BucketPlan.for_tree(_params(), n_leaf)
+
+            def body(x):
+                params = _params()
+
+                def loss(p):
+                    return jnp.sum((x[0] @ p["w1"]) @ p["w2"]), None
+
+                (_, _), reduced, _ = overlapped_grads(
+                    loss, params, axis_name="dp", plan=plan,
+                    reduce_kw=dict(reduce_kw))
+                return reduced
+
+            fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P(), check_vma=False)
+            return fn, (jax.ShapeDtypeStruct((W, 4, d), jnp.float32),)
+        return build
+
+    def _monolith():
+        def build():
+            from .dist import sum_gradients
+            mesh = data_parallel_mesh()
+
+            def body(x):
+                params = _params()
+
+                def loss(p):
+                    return jnp.sum((x[0] @ p["w1"]) @ p["w2"])
+
+                grads = jax.grad(loss)(params)
+                return sum_gradients(grads, "dp",
+                                     bucket_elems=n_leaf, **reduce_kw)
+
+            fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P(), check_vma=False)
+            return fn, (jax.ShapeDtypeStruct((W, 4, d), jnp.float32),)
+        return build
+
+    reg.declare("overlap.taps[ring,e5m2,w8]", _overlapped(),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                twin="overlap.toy", overlap=True, wire=_wire)
+    reg.declare("overlap.monolith[ring,e5m2,w8]", _monolith(),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                twin="overlap.toy", overlap=False, wire=_wire)

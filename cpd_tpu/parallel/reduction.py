@@ -149,3 +149,28 @@ def quantized_sum(stacked: jnp.ndarray, exp: int, man: int,
         return jnp.sum(stacked, axis=0)
     return ordered_quantized_sum(stacked, exp, man, key=key, offsets=offsets,
                                  block_size=block_size)
+
+
+def ir_programs(reg):
+    """Program-contract declarations (analysis/ir/registry.py): the
+    ordered-scan primitives are the emulation heart every oracle gate
+    leans on — register them bitwise-gated so an ulp-unstable
+    primitive (the PR 12 exp2 class) sneaking into a cast body fails
+    lint before it fails a bitwise test four layers up."""
+
+    def _scan(use_kahan, block=None):
+        def build():
+            arg = jax.ShapeDtypeStruct((8, 256), jnp.float32)
+            return (lambda st: quantized_sum(
+                st, 5 if block is None else 4,
+                2 if block is None else 3,
+                use_kahan=use_kahan, block_size=block), (arg,))
+        return build
+
+    deps = ("cpd_tpu.quant.numerics", "cpd_tpu.parallel.reduction")
+    reg.declare("reduce.ordered_scan[e5m2]", _scan(False),
+                deps=deps, bitwise=True)
+    reg.declare("reduce.kahan_scan[e5m2]", _scan(True),
+                deps=deps, bitwise=True)
+    reg.declare("reduce.ordered_scan[blocked-e4m3,b32]",
+                _scan(False, block=32), deps=deps, bitwise=True)

@@ -880,3 +880,76 @@ def transport_table(n: int, world: int, exp: int, man: int,
         round(gather / table["ring_packed"], 2) if table["ring_packed"]
         else None)
     return table
+
+
+def ir_programs(reg):
+    """Program-contract declarations (analysis/ir/registry.py).
+
+    The ring transport and the faithful gather are the wire the byte
+    analytics above price — each registered arm carries a ``wire``
+    contract equal to its analytic table entry, so a stray fp32 debug
+    gather, an unpacked hop, or a dropped block sidecar fails the
+    ``ir-wire-ledger`` rule instead of silently shipping unpriced
+    bytes.  All arms are bitwise-gated (`ring_oracle_sum` parity is a
+    cross-program bitwise claim)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
+    from .mesh import data_parallel_mesh
+
+    W, n = 8, 1000
+    deps = ("cpd_tpu.quant.numerics", "cpd_tpu.parallel.ring",
+            "cpd_tpu.parallel.reduction")
+
+    def _ring(use_kahan=False, block=None, exp=5, man=2):
+        def build():
+            mesh = data_parallel_mesh()
+
+            def body(x):
+                return ring_quantized_sum(
+                    x[0], "dp", exp, man, use_kahan=use_kahan,
+                    world=W, block_scale=block is not None,
+                    block_size=block if block is not None else 128)
+
+            fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P(), check_vma=False)
+            return fn, (jax.ShapeDtypeStruct((W, n), jnp.float32),)
+        return build
+
+    reg.declare("ring.packed[e5m2,w8]", _ring(),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: ring_transport_bytes(n, W, 5, 2))
+    reg.declare("ring.kahan[e5m2,w8]", _ring(use_kahan=True),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: ring_transport_bytes(n, W, 5, 2,
+                                                  use_kahan=True))
+    reg.declare("ring.blocked[e4m3,b32,w8]", _ring(block=32, exp=4,
+                                                   man=3),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: ring_transport_bytes(n, W, 4, 3,
+                                                  block_size=32))
+
+    def _gather(use_aps):
+        def build():
+            from .dist import sum_gradients
+            mesh = data_parallel_mesh()
+
+            def body(g):
+                return sum_gradients({"g": g[0]}, "dp", use_aps=use_aps,
+                                     grad_exp=5, grad_man=2,
+                                     mode="faithful")
+
+            fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P(), check_vma=False)
+            return fn, (jax.ShapeDtypeStruct((W, n), jnp.float32),)
+        return build
+
+    gdeps = deps + ("cpd_tpu.parallel.dist", "cpd_tpu.parallel.aps")
+    reg.declare("gather.fp32[e5m2,w8]", _gather(False),
+                deps=gdeps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: gather_transport_bytes(n, W, 5, 2,
+                                                    compressed=False))
+    reg.declare("gather.packed[aps,e5m2,w8]", _gather(True),
+                deps=gdeps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: gather_transport_bytes(n, W, 5, 2,
+                                                    compressed=True))

@@ -68,7 +68,7 @@ from .ring import pad_to_world, ring_chunk_size
 
 __all__ = ["Zero1State", "zero1_sgd", "zero2_sgd", "zero3_sgd",
            "zero1_lars", "zero2_lars", "zero3_lars",
-           "zero2_oracle_flat"]
+           "zero2_oracle_flat", "zero2_transport_bytes"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1112,3 +1112,77 @@ def zero3_lars(schedule: Callable, world: int, template,
     ratios from the sharded per-leaf norms."""
     return _lars_factory(_Zero3Lars, schedule, world, momentum,
                          weight_decay, coefficient, axis_name, template)
+
+
+def zero2_transport_bytes(n: int, world: int, exp: int, man: int, *,
+                          use_aps: bool = True,
+                          block_size: Optional[int] = None) -> int:
+    """Analytic per-device wire bytes of ZeRO-2's sharded faithful
+    reduction of ONE ``n``-element bucket: the ``all_to_all`` ships the
+    (W, c) payload (c = ``ring_chunk_size(n, world)``) and keeps 1/W
+    local — (W-1)·c rows' worth leave each device.  A multi-bucket
+    `_ZeroLayout` (``bucket_elems``) prices as the sum of this over its
+    per-bucket element counts ``m_b``.
+
+    The row cost mirrors `_bucket_reduce_scatter`'s wire exactly: the
+    bit-packed eXmY code words when the APS pre-quantize applies
+    (`dist._wire_format`), the blocked code-words-plus-sidecar wire
+    with ``block_size`` (`numerics.wire_bytes_blocked` — the sidecar is
+    EXPLICIT, as on `ring_transport_bytes`), raw fp32 otherwise.  The
+    sibling of `ring_transport_bytes`/`gather_transport_bytes` for the
+    third transport; the IR wire-ledger rule (analysis/ir) pins the
+    traced `all_to_all` payloads against this formula."""
+    from ..quant.numerics import wire_bytes, wire_bytes_blocked
+    if n == 0 or world <= 0:
+        return 0
+    c = ring_chunk_size(n, world)
+    if block_size is not None:
+        per_shard = wire_bytes_blocked(exp, man, c, block_size)
+    elif use_aps and man >= 2 and wire_bytes(exp, man) < 4:
+        per_shard = c * wire_bytes(exp, man)
+    else:
+        per_shard = c * 4
+    return (world - 1) * per_shard
+
+
+def ir_programs(reg):
+    """Program-contract declarations (analysis/ir/registry.py): the
+    ZeRO-2 sharded reduce is the third wire transport — its all_to_all
+    payloads are pinned against `zero2_transport_bytes` (blocked
+    sidecar included) and the scan body is bitwise-gated (it claims
+    slice-parity with the replicated faithful reduction)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
+    from .mesh import data_parallel_mesh
+
+    W, n = 8, 1000
+    deps = ("cpd_tpu.quant.numerics", "cpd_tpu.parallel.zero",
+            "cpd_tpu.parallel.dist", "cpd_tpu.parallel.reduction",
+            "cpd_tpu.parallel.aps")
+
+    def _rs(block=None, exp=5, man=2):
+        def build():
+            mesh = data_parallel_mesh()
+            z = zero2_sgd(lambda step: 0.1, W)
+
+            def body(g):
+                return z._grad_shard(
+                    {"w": g[0]}, None, "dp", use_aps=True,
+                    grad_exp=exp, grad_man=man,
+                    block_scale=block is not None,
+                    block_size=block if block is not None else 128)
+
+            fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P("dp"), check_vma=False)
+            return fn, (jax.ShapeDtypeStruct((W, n), jnp.float32),)
+        return build
+
+    reg.declare("zero2.reduce_scatter[aps,e5m2,w8]", _rs(),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: zero2_transport_bytes(n, W, 5, 2))
+    reg.declare("zero2.reduce_scatter[blocked-e4m3,b32,w8]",
+                _rs(block=32, exp=4, man=3),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: zero2_transport_bytes(n, W, 4, 3,
+                                                   block_size=32))

@@ -305,3 +305,85 @@ def make_prefill_step(spec: ModelSpec, cfg: KVCacheConfig, chunk: int):
         return step
 
     return _STEP_CACHE.get_or_create(("prefill", spec, cfg, chunk), build)
+
+
+def _ir_abstract_params(spec: ModelSpec):
+    """ShapeDtypeStruct param pytree matching `_forward`'s layout (GQA
+    form) — lets the IR analyzer trace the serving programs with no
+    weights materialized."""
+    d, ff, hd = spec.d_model, spec.d_ff, spec.head_dim
+
+    def f32(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    ln = lambda: {"scale": f32(d), "bias": f32(d)}  # noqa: E731
+    blk = {"ln1": ln(), "ln2": ln(),
+           "wq": {"kernel": f32(d, spec.n_heads * hd)},
+           "wkv": {"kernel": f32(d, spec.kv_heads * 2 * hd)},
+           "wo": {"kernel": f32(d, d)},
+           "wi": {"kernel": f32(d, ff)},
+           "wo_mlp": {"kernel": f32(ff, d)}}
+    params = {"embed": {"embedding": f32(spec.vocab_size, d)},
+              "ln_f": ln()}
+    for i in range(spec.n_layers):
+        params[f"block{i}"] = blk
+    return params
+
+
+def ir_programs(reg):
+    """Program-contract declarations (analysis/ir/registry.py): the
+    serving decode/prefill programs are bitwise-gated — prefill writes
+    pages one PROGRAM, decode and corruption-repair read them from
+    OTHERS, and the (8,23) decode additionally claims bitwise parity
+    with the fp32-cache oracle — exactly the cross-program contract an
+    ulp-unstable transcendental (the PR 12 exp2 class) breaks."""
+    S, MP, CHUNK = 4, 4, 4
+    spec = ModelSpec(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                     n_kv_heads=2, d_ff=64)
+    deps = ("cpd_tpu.serve.model", "cpd_tpu.serve.kvcache",
+            "cpd_tpu.quant.numerics")
+
+    def _cfg(block=None, fmt=(4, 3)):
+        return KVCacheConfig(n_layers=spec.n_layers, n_pages=8,
+                             page_size=4, n_kv_heads=spec.kv_heads,
+                             head_dim=spec.head_dim, exp_bits=fmt[0],
+                             man_bits=fmt[1],
+                             block_scale=block is not None,
+                             block_size=block if block is not None
+                             else 32)
+
+    def _decode(block=None, fmt=(4, 3)):
+        def build():
+            cfg = _cfg(block, fmt)
+            step = make_decode_step(spec, cfg)
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+            args = (_ir_abstract_params(spec),
+                    jax.ShapeDtypeStruct(cfg.pool_shape, jnp.uint8),
+                    jax.ShapeDtypeStruct((cfg.n_layers, cfg.n_pages),
+                                         jnp.uint32),
+                    i32(S), i32(S), i32(S, MP),
+                    jax.ShapeDtypeStruct((S,), jnp.bool_))
+            return step, args
+        return build
+
+    def _prefill():
+        def build():
+            cfg = _cfg()
+            step = make_prefill_step(spec, cfg, CHUNK)
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+            args = (_ir_abstract_params(spec),
+                    jax.ShapeDtypeStruct(cfg.pool_shape, jnp.uint8),
+                    jax.ShapeDtypeStruct((cfg.n_layers, cfg.n_pages),
+                                         jnp.uint32),
+                    i32(CHUNK), i32(), i32(), i32(MP))
+            return step, args
+        return build
+
+    reg.declare("serve.decode[e4m3]", _decode(), deps=deps,
+                bitwise=True)
+    reg.declare("serve.decode[blocked-e4m3,b32]", _decode(block=32),
+                deps=deps, bitwise=True)
+    reg.declare("serve.decode[e8m23]", _decode(fmt=(8, 23)),
+                deps=deps, bitwise=True)
+    reg.declare("serve.prefill[e4m3]", _prefill(), deps=deps,
+                bitwise=True)

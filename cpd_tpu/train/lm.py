@@ -373,3 +373,55 @@ def make_lm_eval_step(model, mesh: Mesh, *, axis_dp: str = "dp",
         return cache[key](state, tokens, targets)
 
     return runner
+
+
+def ir_programs(reg):
+    """Program-contract declarations (analysis/ir/registry.py): the LM
+    step builder on the dp x sp x tp mesh, overlap on/off — the twins
+    whose bitwise parity tests/test_overlap.py gates.  `ir-schedule`
+    pins their collective multisets identical (the dp ring wire AND the
+    forward sp ring-attention ppermutes), `ir-overlap` the interleaving
+    verdicts, `ir-bitwise` the absence of ulp-unstable transcendentals
+    under the whole traced step (constant LR for the same reason as the
+    vision declarations — `pow` is not the contract)."""
+    from ..models.transformer import transformer_lm
+    from .optim import make_optimizer
+    from .state import create_train_state
+
+    deps = ("cpd_tpu.train.lm", "cpd_tpu.parallel.dist",
+            "cpd_tpu.parallel.ring", "cpd_tpu.parallel.overlap",
+            "cpd_tpu.parallel.aps", "cpd_tpu.quant.numerics",
+            "cpd_tpu.models.transformer")
+
+    def _lm(overlap):
+        def build():
+            from ..parallel.mesh import make_mesh
+            mesh = make_mesh(dp=2, sp=2, tp=2)
+            model = transformer_lm(vocab_size=64, d_model=32,
+                                   n_layers=2, n_heads=4, tp_axis="tp",
+                                   sp_axis="sp", tp_size=2)
+            init_model = transformer_lm(vocab_size=64, d_model=32,
+                                        n_layers=2, n_heads=4)
+            tx = make_optimizer("sgd", lambda step: 0.01, momentum=0.9)
+            state = jax.eval_shape(lambda: create_train_state(
+                init_model, tx, jnp.zeros((1, 16), jnp.int32),
+                jax.random.PRNGKey(0)))
+            step = make_lm_train_step(
+                model, tx, mesh, mode="ring", use_aps=True, grad_exp=5,
+                grad_man=2, grad_rounding="stochastic", grad_seed=3,
+                donate=False, bucket_elems=2000,
+                overlap_reduce=overlap)
+            toks = jax.ShapeDtypeStruct((4, 16), jnp.int32)
+            return step, (state, toks, toks)
+        return build
+
+    # the monolith carries NO overlap expectation: the forward pass's
+    # sp ring-attention ppermutes legitimately precede all backward
+    # compute, so the structural probe reads "interleaved" on both
+    # twins — only the overlapped step's verdict is a contract here
+    reg.declare("lm.ring[e5m2,sr,aps]", _lm(False),
+                deps=deps, axis_sizes={"dp": 2, "sp": 2, "tp": 2},
+                bitwise=True, twin="lm.ring-overlap")
+    reg.declare("lm.ring[e5m2,sr,aps]+overlap", _lm(True),
+                deps=deps, axis_sizes={"dp": 2, "sp": 2, "tp": 2},
+                bitwise=True, twin="lm.ring-overlap", overlap=True)

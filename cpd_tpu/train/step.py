@@ -728,3 +728,131 @@ def make_seg_eval_step(model, mesh: Mesh, num_classes: int, *,
         out_specs=P(),
         check_vma=False)
     return jax.jit(shard_fn)
+
+
+def ir_programs(reg):
+    """Program-contract declarations (analysis/ir/registry.py): the
+    vision step builder traced at representative ladder coordinates.
+
+    * overlap twins — the ring step with and without ``overlap_reduce``
+      (and a ZeRO-2 tap-reduce pair) claim bitwise parity
+      (tests/test_overlap.py); `ir-schedule` pins their collective
+      multisets identical and `ir-overlap` their interleaving verdicts.
+    * the ``step.ladder`` retrace family — the SAME perturbed config
+      coordinates the CLIs' StepTable would hold, each declared with
+      its REAL `ladder_step_key`; `ir-retrace` asserts distinct traced
+      programs never share a key (the PR 5 half-keyed bug, verified
+      dynamically rather than by AST pattern).
+    * every member is bitwise-gated: the step wraps the whole
+      reduce/APS pipeline, so one stray `exp2` anywhere under it fails
+      `ir-bitwise` (the PR 12 class).
+
+    The LR schedule is a constant on purpose: `warmup_step_decay`'s
+    ``gamma ** k`` lowers to the unstable `pow` primitive, and the lr
+    is not the contract under test."""
+    from types import SimpleNamespace
+
+    from ..models.tiny import tiny_cnn
+    from ..resilience.precision import ladder_step_key
+    from .optim import make_optimizer
+    from .state import create_train_state
+
+    W, BUCKET = 8, 100
+    deps = ("cpd_tpu.train.step", "cpd_tpu.parallel.dist",
+            "cpd_tpu.parallel.ring", "cpd_tpu.parallel.overlap",
+            "cpd_tpu.parallel.aps", "cpd_tpu.parallel.emulate",
+            "cpd_tpu.parallel.zero", "cpd_tpu.quant.numerics",
+            "cpd_tpu.models.tiny")
+
+    def _key(mode, fmt, overlap=None, block=None):
+        return ladder_step_key(transport=SimpleNamespace(mode=mode),
+                               precision=SimpleNamespace(fmt=fmt),
+                               overlap=overlap, block=block)
+
+    def _vision(mode, fmt, overlap=False, block=None, zero2=False):
+        def build():
+            from ..parallel.mesh import data_parallel_mesh
+            mesh = data_parallel_mesh()
+            model = tiny_cnn(num_classes=4, width=4)
+            tx = make_optimizer("sgd", lambda step: 0.1, momentum=0.9)
+            def fresh_state():
+                return create_train_state(model, tx,
+                                          jnp.zeros((2, 8, 8, 3)),
+                                          jax.random.PRNGKey(0))
+
+            kw = dict(use_aps=True, grad_exp=fmt[0], grad_man=fmt[1],
+                      mode=mode, grad_rounding="stochastic",
+                      grad_seed=5, bucket_elems=BUCKET, donate=False,
+                      overlap_reduce=overlap,
+                      block_scale=block is not None,
+                      block_size=block if block is not None else 128)
+            if zero2:
+                from ..parallel.zero import zero2_sgd
+                z = zero2_sgd(lambda step: 0.1, W, bucket_elems=BUCKET)
+
+                def mk():
+                    st = fresh_state()
+                    return TrainState(step=st.step, params=st.params,
+                                      batch_stats=st.batch_stats,
+                                      opt_state=z.init(st.params))
+
+                state = jax.eval_shape(mk)
+                kw.update(mode="faithful", grad_rounding="nearest",
+                          bucket_elems=BUCKET if overlap else None,
+                          update_fn=z.update_fn,
+                          opt_state_spec=z.state_spec(),
+                          reduce_in_update=True,
+                          block_scale=False, block_size=128)
+                if overlap:
+                    kw["tap_reduce"] = z.make_tap_reduce
+            else:
+                state = jax.eval_shape(fresh_state)
+            step = make_train_step(model, tx, mesh, **kw)
+            abstract = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(jnp.shape(l),
+                                               jnp.result_type(l)),
+                state)
+            x = jax.ShapeDtypeStruct((16, 8, 8, 3), jnp.float32)
+            y = jax.ShapeDtypeStruct((16,), jnp.int32)
+            return step, (abstract, x, y)
+        return build
+
+    reg.declare(
+        "step.ring[e5m2,sr,aps]", _vision("ring", (5, 2)),
+        deps=deps, axis_sizes={"dp": W}, bitwise=True,
+        twin="step.ring-overlap", overlap=False,
+        retrace_group="step.ladder",
+        retrace_key=_key("ring", (5, 2), overlap=(False, BUCKET)))
+    reg.declare(
+        "step.ring[e5m2,sr,aps]+overlap", _vision("ring", (5, 2),
+                                                  overlap=True),
+        deps=deps, axis_sizes={"dp": W}, bitwise=True,
+        twin="step.ring-overlap", overlap=True,
+        retrace_group="step.ladder",
+        retrace_key=_key("ring", (5, 2), overlap=(True, BUCKET)))
+    reg.declare(
+        "step.faithful[e5m2,sr,aps]", _vision("faithful", (5, 2)),
+        deps=deps, axis_sizes={"dp": W}, bitwise=True,
+        retrace_group="step.ladder",
+        retrace_key=_key("faithful", (5, 2), overlap=(False, BUCKET)))
+    reg.declare(
+        "step.ring[e5m7,sr,aps]", _vision("ring", (5, 7)),
+        deps=deps, axis_sizes={"dp": W}, bitwise=True,
+        retrace_group="step.ladder",
+        retrace_key=_key("ring", (5, 7), overlap=(False, BUCKET)))
+    reg.declare(
+        "step.ring[blocked-e4m3,b32,sr,aps]",
+        _vision("ring", (4, 3), block=32),
+        deps=deps, axis_sizes={"dp": W}, bitwise=True,
+        retrace_group="step.ladder",
+        retrace_key=_key("ring", (4, 3), overlap=(False, BUCKET),
+                         block=(True, 32)))
+    reg.declare(
+        "step.zero2[aps,e5m2]", _vision("ring", (5, 2), zero2=True),
+        deps=deps, axis_sizes={"dp": W}, bitwise=True,
+        twin="step.zero2-overlap", overlap=False)
+    reg.declare(
+        "step.zero2[aps,e5m2]+overlap",
+        _vision("ring", (5, 2), overlap=True, zero2=True),
+        deps=deps, axis_sizes={"dp": W}, bitwise=True,
+        twin="step.zero2-overlap", overlap=True)

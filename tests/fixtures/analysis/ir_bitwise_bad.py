@@ -1,0 +1,26 @@
+"""ir-bitwise bad fixture: the BARE ``jnp.exp2`` IN A BITWISE PROGRAM —
+an APS-style shift scale computed with the transcendental whose final
+ulp is program-dependent on XLA:CPU (the PR 12 bug, pre-fix shape).
+Any cross-program bitwise contract riding this scale holds by luck.
+1 pinned finding."""
+
+import jax
+import jax.numpy as jnp
+
+from cpd_tpu.quant.numerics import cast_to_format
+
+
+def _aps_scaled_cast():
+    def build():
+        def fn(g):
+            # pre-fix APS: scale by 2^shift via the unstable primitive
+            shift = jnp.float32(24.0)
+            scaled = g * jnp.exp2(shift)
+            return cast_to_format(scaled, 5, 2) / jnp.exp2(shift)
+
+        return fn, (jax.ShapeDtypeStruct((256,), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.exp2_shift", _aps_scaled_cast(), bitwise=True)

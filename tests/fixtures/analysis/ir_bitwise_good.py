@@ -1,0 +1,25 @@
+"""ir-bitwise clean twin: the same shift scale built with
+`aps.exp2_exact` — bit assembly, exact and program-independent by
+construction; no unstable primitive appears in the traced jaxpr."""
+
+import jax
+import jax.numpy as jnp
+
+from cpd_tpu.parallel.aps import exp2_exact
+from cpd_tpu.quant.numerics import cast_to_format
+
+
+def _aps_scaled_cast():
+    def build():
+        def fn(g):
+            shift = jnp.float32(24.0)
+            scaled = g * exp2_exact(shift)
+            return cast_to_format(scaled, 5, 2) / exp2_exact(shift)
+
+        return fn, (jax.ShapeDtypeStruct((256,), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.exp2_exact_shift", _aps_scaled_cast(),
+                bitwise=True)

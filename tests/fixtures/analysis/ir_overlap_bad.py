@@ -1,0 +1,69 @@
+"""ir-overlap bad fixture: (1) a program DECLARED overlap-configured
+whose jaxpr is a post-backward monolith — every transport collective
+postdates all compute, so the overlap it claims cannot happen; (2) the
+inverse lie — a declared monolith whose transport interleaves.
+2 pinned findings."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from cpd_tpu.compat import shard_map
+from cpd_tpu.parallel.dist import sum_gradients
+from cpd_tpu.parallel.mesh import data_parallel_mesh
+from cpd_tpu.parallel.overlap import BucketPlan, overlapped_grads
+
+W, D = 8, 32
+
+
+def _monolith():
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            w = {"w1": jnp.ones((D, D), jnp.float32),
+                 "w2": jnp.ones((D, D), jnp.float32)}
+
+            def loss(p):
+                return jnp.sum((x[0] @ p["w1"]) @ p["w2"])
+
+            grads = jax.grad(loss)(w)
+            return sum_gradients(grads, "dp", grad_exp=5, grad_man=2,
+                                 mode="ring", bucket_elems=D * D)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, 4, D), jnp.float32),)
+    return build
+
+
+def _tapped():
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            w = {"w1": jnp.ones((D, D), jnp.float32),
+                 "w2": jnp.ones((D, D), jnp.float32)}
+            plan = BucketPlan.for_tree(w, D * D)
+
+            def loss(p):
+                return jnp.sum((x[0] @ p["w1"]) @ p["w2"]), None
+
+            _, reduced, _ = overlapped_grads(
+                loss, w, axis_name="dp", plan=plan,
+                reduce_kw=dict(mode="ring", grad_exp=5, grad_man=2))
+            return reduced
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, 4, D), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    # lie #1: monolith declared as overlapped
+    reg.declare("fixture.fake_overlap", _monolith(),
+                axis_sizes={"dp": W}, overlap=True)
+    # lie #2: tapped program declared as a monolith
+    reg.declare("fixture.fake_monolith", _tapped(),
+                axis_sizes={"dp": W}, overlap=False)

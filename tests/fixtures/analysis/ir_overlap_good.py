@@ -1,0 +1,65 @@
+"""ir-overlap clean twin: the declarations match the jaxprs — the
+tapped program is declared overlapped, the post-backward monolith is
+declared monolithic."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from cpd_tpu.compat import shard_map
+from cpd_tpu.parallel.dist import sum_gradients
+from cpd_tpu.parallel.mesh import data_parallel_mesh
+from cpd_tpu.parallel.overlap import BucketPlan, overlapped_grads
+
+W, D = 8, 32
+
+
+def _monolith():
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            w = {"w1": jnp.ones((D, D), jnp.float32),
+                 "w2": jnp.ones((D, D), jnp.float32)}
+
+            def loss(p):
+                return jnp.sum((x[0] @ p["w1"]) @ p["w2"])
+
+            grads = jax.grad(loss)(w)
+            return sum_gradients(grads, "dp", grad_exp=5, grad_man=2,
+                                 mode="ring", bucket_elems=D * D)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, 4, D), jnp.float32),)
+    return build
+
+
+def _tapped():
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            w = {"w1": jnp.ones((D, D), jnp.float32),
+                 "w2": jnp.ones((D, D), jnp.float32)}
+            plan = BucketPlan.for_tree(w, D * D)
+
+            def loss(p):
+                return jnp.sum((x[0] @ p["w1"]) @ p["w2"]), None
+
+            _, reduced, _ = overlapped_grads(
+                loss, w, axis_name="dp", plan=plan,
+                reduce_kw=dict(mode="ring", grad_exp=5, grad_man=2))
+            return reduced
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, 4, D), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.true_overlap", _tapped(),
+                axis_sizes={"dp": W}, overlap=True)
+    reg.declare("fixture.true_monolith", _monolith(),
+                axis_sizes={"dp": W}, overlap=False)

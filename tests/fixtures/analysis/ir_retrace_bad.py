@@ -1,0 +1,29 @@
+"""ir-retrace bad fixture: the HALF-KEYED RETRACE — two members of one
+StepTable family trace to DISTINCT programs (e5m2 vs e5m7 casts) but
+the key derivation dropped the format coordinate, so both carry the
+bare transport-mode key.  After a precision-ladder transition the table
+would serve the stale format's compiled step (the PR 5 bug, verified
+dynamically).  1 pinned finding."""
+
+import jax
+import jax.numpy as jnp
+
+from cpd_tpu.quant.numerics import cast_to_format
+
+
+def _cast(man):
+    def build():
+        def fn(g):
+            return cast_to_format(g, 5, man)
+
+        return fn, (jax.ShapeDtypeStruct((128,), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    # both keyed by the bare mode string — the format coordinate is
+    # missing, exactly the pre-PR-5 CLI shape
+    reg.declare("fixture.ladder[e5m2]", _cast(2),
+                retrace_group="fixture.ladder", retrace_key="ring")
+    reg.declare("fixture.ladder[e5m7]", _cast(7),
+                retrace_group="fixture.ladder", retrace_key="ring")

@@ -1,0 +1,27 @@
+"""ir-retrace clean twin: the same two programs keyed with the full
+(mode, format) coordinate — distinct programs, distinct keys.  (The
+reverse — distinct keys for IDENTICAL programs — is also fine:
+over-keying only costs a retrace, never a stale step.)"""
+
+import jax
+import jax.numpy as jnp
+
+from cpd_tpu.quant.numerics import cast_to_format
+
+
+def _cast(man):
+    def build():
+        def fn(g):
+            return cast_to_format(g, 5, man)
+
+        return fn, (jax.ShapeDtypeStruct((128,), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.ladder[e5m2]", _cast(2),
+                retrace_group="fixture.ladder",
+                retrace_key=("ring", (5, 2)))
+    reg.declare("fixture.ladder[e5m7]", _cast(7),
+                retrace_group="fixture.ladder",
+                retrace_key=("ring", (5, 7)))

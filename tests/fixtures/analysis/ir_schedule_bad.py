@@ -1,0 +1,67 @@
+"""ir-schedule bad fixture: (1) a DESYNCED TWIN — two programs claiming
+bitwise parity where one ships an extra fp32 debug all_gather the other
+never emits (their collective multisets differ, so at pod scale one
+rank's program waits at a rendezvous its twin never enters); (2) a
+transport collective under a DIVERGENT ``lax.cond`` branch — replicas
+disagreeing on the predicate deadlock the mesh.  2 pinned findings."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from cpd_tpu.compat import shard_map
+from cpd_tpu.parallel.mesh import data_parallel_mesh
+from cpd_tpu.parallel.ring import ring_quantized_sum
+
+W, N = 8, 64
+
+
+def _ring(leak):
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            out = ring_quantized_sum(x[0], "dp", 5, 2, world=W)
+            if leak:
+                # the desync: a debug gather only THIS twin performs
+                out = out + lax.all_gather(x[0], "dp", axis=0,
+                                           tiled=False).sum(0)
+            return out
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, N), jnp.float32),)
+    return build
+
+
+def _cond_collective():
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            flat = x[0]
+
+            def with_gather(v):
+                return lax.all_gather(v, "dp", axis=0,
+                                      tiled=False).sum(0)
+
+            def without(v):
+                return v
+
+            return lax.cond(jnp.sum(flat) > 0, with_gather, without,
+                            flat)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, N), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.twin_a", _ring(leak=False),
+                twin="fixture.desync", axis_sizes={"dp": W})
+    reg.declare("fixture.twin_b_leaky", _ring(leak=True),
+                twin="fixture.desync", axis_sizes={"dp": W})
+    reg.declare("fixture.cond_collective", _cond_collective(),
+                axis_sizes={"dp": W})

@@ -1,0 +1,63 @@
+"""ir-schedule clean twin: both members of the parity group move the
+identical collective multiset, and the only ``cond`` carries the SAME
+collectives in every branch (uniform across replicas — no rendezvous a
+rank can miss)."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from cpd_tpu.compat import shard_map
+from cpd_tpu.parallel.mesh import data_parallel_mesh
+from cpd_tpu.parallel.ring import ring_quantized_sum
+
+W, N = 8, 64
+
+
+def _ring(scale):
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            # twins may differ in elementwise work (scale) — only the
+            # collective schedule is the contract
+            return ring_quantized_sum(x[0] * scale, "dp", 5, 2,
+                                      world=W)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, N), jnp.float32),)
+    return build
+
+
+def _uniform_cond():
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            flat = x[0]
+
+            def pos(v):
+                return lax.all_gather(v, "dp", axis=0,
+                                      tiled=False).sum(0)
+
+            def neg(v):
+                return lax.all_gather(-v, "dp", axis=0,
+                                      tiled=False).sum(0)
+
+            return lax.cond(jnp.sum(flat) > 0, pos, neg, flat)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, N), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.twin_a", _ring(1.0),
+                twin="fixture.clean", axis_sizes={"dp": W})
+    reg.declare("fixture.twin_b", _ring(2.0),
+                twin="fixture.clean", axis_sizes={"dp": W})
+    reg.declare("fixture.uniform_cond", _uniform_cond(),
+                axis_sizes={"dp": W})

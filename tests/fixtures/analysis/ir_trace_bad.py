@@ -1,0 +1,25 @@
+"""ir-trace bad fixture: a registered program whose build crashes —
+the analyzer must report it as a finding AND exit 2 (contracts
+unverified), never skip it silently.  1 pinned finding."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _broken():
+    def build():
+        raise RuntimeError("model weights not found: /nonexistent.ckpt")
+    return build
+
+
+def _fine():
+    def build():
+        return (lambda g: g * 2.0,
+                (jax.ShapeDtypeStruct((8,), jnp.float32),))
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.broken_build", _broken(), bitwise=True)
+    # a healthy sibling proves the failure does not poison the run
+    reg.declare("fixture.healthy", _fine(), bitwise=True)

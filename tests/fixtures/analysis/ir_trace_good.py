@@ -1,0 +1,15 @@
+"""ir-trace clean twin: every registered program builds and traces."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _fine():
+    def build():
+        return (lambda g: g * 2.0,
+                (jax.ShapeDtypeStruct((8,), jnp.float32),))
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.healthy", _fine(), bitwise=True)

@@ -1,0 +1,37 @@
+"""ir-wire-ledger bad fixture: the FP32 LEAK ON THE WIRE — a ring
+program whose wire contract is the analytic `ring_transport_bytes`, but
+which also ships a raw fp32 debug all_gather the ledger never priced.
+The jaxpr-counted bytes exceed the table.  1 pinned finding."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from cpd_tpu.compat import shard_map
+from cpd_tpu.parallel.mesh import data_parallel_mesh
+from cpd_tpu.parallel.ring import ring_quantized_sum, ring_transport_bytes
+
+W, N = 8, 64
+
+
+def _leaky_ring():
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            out = ring_quantized_sum(x[0], "dp", 5, 2, world=W)
+            # the leak: (W-1)*N*4 unpriced fp32 bytes per device
+            return out + lax.all_gather(x[0], "dp", axis=0,
+                                        tiled=False).sum(0)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, N), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.leaky_ring", _leaky_ring(),
+                axis_sizes={"dp": W},
+                wire=lambda: ring_transport_bytes(N, W, 5, 2))

@@ -1,0 +1,32 @@
+"""ir-wire-ledger clean twin: the same ring program without the debug
+gather — the jaxpr-counted wire equals `ring_transport_bytes` exactly
+(packed code words, (W-1) reduce hops + (W-1) gather hops)."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from cpd_tpu.compat import shard_map
+from cpd_tpu.parallel.mesh import data_parallel_mesh
+from cpd_tpu.parallel.ring import ring_quantized_sum, ring_transport_bytes
+
+W, N = 8, 64
+
+
+def _clean_ring():
+    def build():
+        mesh = data_parallel_mesh()
+
+        def body(x):
+            return ring_quantized_sum(x[0], "dp", 5, 2, world=W)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=P(), check_vma=False)
+        return fn, (jax.ShapeDtypeStruct((W, N), jnp.float32),)
+    return build
+
+
+def ir_programs(reg):
+    reg.declare("fixture.clean_ring", _clean_ring(),
+                axis_sizes={"dp": W},
+                wire=lambda: ring_transport_bytes(N, W, 5, 2))

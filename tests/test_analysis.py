@@ -34,14 +34,18 @@ import textwrap
 import pytest
 
 from cpd_tpu.analysis import (all_rules, lint_file, lint_source,
-                              lint_tree, module_rules, project_rules,
-                              run_analysis)
+                              lint_tree, module_rules, program_rules,
+                              project_rules, run_analysis)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
 LINTED_PATHS = [os.path.join(REPO, d)
                 for d in ("cpd_tpu", "tests", "tools", "examples")]
 RULE_IDS = sorted(all_rules())
+# the AST-scope rules: their fixtures are lint_file-able source pairs.
+# Program-scope (ir-*) fixtures are REGISTRIES of traced jax programs,
+# exercised by tests/test_analysis_ir.py instead.
+AST_RULE_IDS = sorted(set(RULE_IDS) - set(program_rules()))
 
 
 def _fixture(rule_id: str, kind: str) -> str:
@@ -51,31 +55,48 @@ def _fixture(rule_id: str, kind: str) -> str:
 def test_catalog_is_complete():
     assert RULE_IDS == ["axis-flow", "axis-name", "collective-contract",
                         "compat-drift", "donation", "format-bounds",
-                        "format-flow", "jit-hazards", "kahan-ordering",
-                        "obs-print", "pallas-hygiene", "retrace",
-                        "swallow"]
+                        "format-flow", "ir-bitwise", "ir-overlap",
+                        "ir-retrace", "ir-schedule", "ir-trace",
+                        "ir-wire-ledger", "jit-hazards",
+                        "kahan-ordering", "obs-print", "pallas-hygiene",
+                        "retrace", "swallow"]
 
 
 def test_scope_split():
     assert sorted(project_rules()) == ["axis-flow", "collective-contract",
                                        "format-flow", "retrace"]
-    assert set(module_rules()) | set(project_rules()) == set(RULE_IDS)
+    assert sorted(program_rules()) == ["ir-bitwise", "ir-overlap",
+                                       "ir-retrace", "ir-schedule",
+                                       "ir-trace", "ir-wire-ledger"]
+    assert (set(module_rules()) | set(project_rules())
+            | set(program_rules())) == set(RULE_IDS)
 
 
-@pytest.mark.parametrize("rule_id", RULE_IDS)
+@pytest.mark.parametrize("rule_id", AST_RULE_IDS)
 def test_bad_fixture_is_a_true_positive(rule_id):
     findings = lint_file(_fixture(rule_id, "bad"), select=[rule_id])
     assert findings, f"{rule_id}: bad fixture produced no findings"
     assert all(f.rule == rule_id for f in findings)
 
 
-@pytest.mark.parametrize("rule_id", RULE_IDS)
+@pytest.mark.parametrize("rule_id", AST_RULE_IDS)
 def test_good_fixture_is_a_true_negative(rule_id):
     # clean under the WHOLE catalog, not just its own rule
     findings = lint_file(_fixture(rule_id, "good"))
     assert findings == [], (
         f"{rule_id}: good fixture tripped "
         f"{[(f.rule, f.line, f.message) for f in findings]}")
+
+
+def test_every_program_rule_has_fixture_registry_files():
+    """ir-* fixtures are registries of real traced programs; their
+    pinned true-positive counts live in tests/test_analysis_ir.py —
+    here we only pin that BOTH halves exist for every program rule so
+    a new rule cannot land exampleless (and --explain stays useful)."""
+    for rule_id in sorted(program_rules()):
+        for kind in ("bad", "good"):
+            assert os.path.isfile(_fixture(rule_id, kind)), (
+                f"{rule_id}: missing {kind} fixture registry")
 
 
 def test_bad_fixture_finding_counts():
@@ -91,7 +112,10 @@ def test_bad_fixture_finding_counts():
                 # ISSUE 11: ad-hoc stdout telemetry bypassing the obs
                 # MetricsRegistry
                 "obs-print": 3}
-    assert set(expected) == set(RULE_IDS), "new rule missing a count pin"
+    # program-scope (ir-*) counts are pinned in tests/test_analysis_ir.py
+    # against their fixture REGISTRIES, not lint_file-able sources
+    assert set(expected) == set(AST_RULE_IDS), \
+        "new AST rule missing a count pin"
     for rule_id, n in expected.items():
         findings = lint_file(_fixture(rule_id, "bad"), select=[rule_id])
         assert len(findings) == n, (
@@ -377,6 +401,38 @@ def test_cache_select_run_does_not_poison_full_run(tmp_path):
     full = run_analysis([src_dir], cache_dir=cache_dir)
     assert [f.rule for f in full.findings] == ["format-bounds"]
     assert full.files_parsed == 0      # served from cache, unpoisoned
+
+
+def test_cache_config_edit_invalidates_warm_run(tmp_path):
+    """ISSUE 14 satellite: the resolved [tool.cpd-lint] config is part
+    of the cache fingerprint — editing pyproject re-runs the affected
+    rules on a warm cache instead of silently serving verdicts keyed
+    under the old policy."""
+    src_dir = _write_tree(tmp_path, {"b.py": _BAD_LINE + "\n"})
+    pyproject = tmp_path / "pyproject.toml"
+    pyproject.write_text('[tool.cpd-lint.exempt]\n'
+                         '"format-bounds" = ["b.py"]\n')
+    cache_dir = str(tmp_path / "cache")
+
+    cold = run_analysis([src_dir], cache_dir=cache_dir)
+    assert cold.findings == []          # exempted by config
+    assert cold.files_parsed == 1
+    warm = run_analysis([src_dir], cache_dir=cache_dir)
+    assert warm.files_parsed == 0
+
+    # config edit: drop the exemption — the warm cache must invalidate
+    # and the finding must surface on the very next run
+    pyproject.write_text('[tool.cpd-lint.exempt]\n'
+                         '"format-bounds" = ["elsewhere/"]\n')
+    third = run_analysis([src_dir], cache_dir=cache_dir)
+    assert third.files_parsed == 1, \
+        "config edit must invalidate the warm cache"
+    assert [f.rule for f in third.findings] == ["format-bounds"]
+
+    # and the new policy's cache is itself warm afterwards
+    fourth = run_analysis([src_dir], cache_dir=cache_dir)
+    assert fourth.files_parsed == 0
+    assert [f.rule for f in fourth.findings] == ["format-bounds"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,312 @@
+"""analysis v3 — the jaxpr-level program-contract scope (ISSUE 14).
+
+Layers under test:
+
+1. every ir-* rule fires on its fixture REGISTRY (a provider module
+   declaring deliberately-broken traced programs) with a PINNED count,
+   and stays silent on the clean twin — mirroring the AST rules'
+   fixture-pair doctrine with programs instead of source files;
+2. the wire-ledger rule's analytics: the traced ring / faithful-gather
+   / ZeRO-2 arms byte-match `ring_transport_bytes` /
+   `gather_transport_bytes` / `zero2_transport_bytes` exactly,
+   blocked sidecars included (the fast live subset runs in tier-1; the
+   FULL registry incl. the train-step twins is the slow-tier /
+   ir-contracts gate);
+3. the program fact cache: a warm run re-traces ZERO unchanged
+   programs, an edited provider re-traces exactly its programs;
+4. trace-failure honesty: a registered program that fails to build is
+   a finding AND exit 2 through the CLI path — never a silent skip;
+5. the one-implementation contract: the IR tracer's transport-prim set
+   and interleave counting are `parallel.overlap`'s own.
+
+Runs on the conftest's 8-device virtual CPU mesh (tracing only — no
+program is ever compiled or executed).
+"""
+
+import os
+import shutil
+
+import pytest
+
+from cpd_tpu.analysis.ir import run_ir
+from cpd_tpu.analysis.ir.registry import collect_programs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def _fixture(rule_id: str, kind: str) -> str:
+    return os.path.join(FIXTURES,
+                        f"{rule_id.replace('-', '_')}_{kind}.py")
+
+
+# pinned true-positive counts per fixture registry: the desynced twin +
+# the cond-collective (ir-schedule), the fp32 wire leak
+# (ir-wire-ledger), the bare jnp.exp2 in a bitwise program
+# (ir-bitwise), both overlap lies (ir-overlap), the half-keyed retrace
+# (ir-retrace), and the crashing build (ir-trace)
+PINNED = {"ir-schedule": 2, "ir-wire-ledger": 1, "ir-bitwise": 1,
+          "ir-overlap": 2, "ir-retrace": 1, "ir-trace": 1}
+
+
+def test_pin_covers_every_program_rule():
+    from cpd_tpu.analysis import program_rules
+    assert set(PINNED) == set(program_rules()), \
+        "new program rule missing a fixture-count pin"
+
+
+@pytest.mark.parametrize("rule_id", sorted(PINNED))
+def test_bad_fixture_registry_is_a_true_positive(rule_id):
+    res = run_ir(providers=[_fixture(rule_id, "bad")], use_cache=False)
+    hits = [f for f in res.findings if f.rule == rule_id]
+    assert len(hits) == PINNED[rule_id], (
+        f"{rule_id}: expected {PINNED[rule_id]} findings, got "
+        f"{[(f.rule, f.message) for f in res.findings]}")
+    # findings anchor at the declaration site inside the fixture file
+    assert all(f.path.endswith(f"{rule_id.replace('-', '_')}_bad.py")
+               for f in hits), hits
+
+
+@pytest.mark.parametrize("rule_id", sorted(PINNED))
+def test_good_fixture_registry_is_a_true_negative(rule_id):
+    # clean under the WHOLE program-rule catalog, not just its own rule
+    res = run_ir(providers=[_fixture(rule_id, "good")], use_cache=False)
+    assert res.findings == [], (
+        f"{rule_id}: good registry tripped "
+        f"{[(f.rule, f.message) for f in res.findings]}")
+    assert res.trace_failures == 0
+
+
+# ---------------------------------------------------------------------------
+# the live registry
+# ---------------------------------------------------------------------------
+
+# the cheap live subset for tier-1 (~10 s of tracing): every
+# wire-ledger-bearing arm plus the serve programs.  The train-step
+# twins (8 heavier step traces) ride the slow tier + the CI
+# ir-contracts gate via test_live_registry_full.
+FAST_PROVIDERS = ("cpd_tpu.parallel.reduction", "cpd_tpu.parallel.ring",
+                  "cpd_tpu.parallel.overlap", "cpd_tpu.parallel.zero",
+                  "cpd_tpu.serve.model")
+
+
+def test_live_fast_subset_is_clean_and_ledger_matches():
+    res = run_ir(providers=FAST_PROVIDERS, use_cache=False)
+    assert res.trace_failures == 0
+    assert res.findings == [], [(f.rule, f.message)
+                                for f in res.findings]
+    # the ledger rule ran against real analytic contracts: every
+    # wire-bearing arm must be present (ring plain/kahan/blocked,
+    # gather fp32/packed, zero2 plain/blocked, overlap twins)
+    reg = collect_programs(FAST_PROVIDERS)
+    wired = [s.name for s in reg.specs if s.wire is not None]
+    assert len(wired) >= 9, wired
+
+
+@pytest.mark.slow
+def test_live_registry_full_is_clean():
+    """The acceptance gate: the FULL default registry — train-step and
+    LM twins included — traces and passes every program rule."""
+    res = run_ir(use_cache=False)
+    assert res.trace_failures == 0, [(f.rule, f.message)
+                                     for f in res.findings]
+    assert res.findings == [], [(f.rule, f.message)
+                                for f in res.findings]
+    assert res.programs_checked >= 20
+
+
+def test_zero2_transport_bytes_matches_real_packed_buffers():
+    """The new analytic is pinned against the REAL wire buffers, like
+    its ring/gather siblings: per-device all_to_all bytes = (W-1) rows
+    of exactly the packed (or blocked) row the collective ships."""
+    import numpy as np
+
+    from cpd_tpu.parallel.ring import ring_chunk_size
+    from cpd_tpu.parallel.zero import zero2_transport_bytes
+    from cpd_tpu.quant.numerics import (pack_exmy, pack_exmy_blocked,
+                                        wire_bytes)
+    W, n = 8, 1000
+    c = ring_chunk_size(n, W)
+    row = np.zeros((W, c), np.float32)
+    packed_row_bytes = pack_exmy(row, 5, 2).size // W
+    assert zero2_transport_bytes(n, W, 5, 2) == (W - 1) * packed_row_bytes
+    blocked_row_bytes = pack_exmy_blocked(row, 4, 3, 32).size // W
+    assert zero2_transport_bytes(n, W, 4, 3, block_size=32) == \
+        (W - 1) * blocked_row_bytes
+    # no APS pre-quantize -> raw fp32 rows
+    assert zero2_transport_bytes(n, W, 5, 2, use_aps=False) == \
+        (W - 1) * c * 4
+    assert zero2_transport_bytes(0, W, 5, 2) == 0
+
+
+# ---------------------------------------------------------------------------
+# the program fact cache
+# ---------------------------------------------------------------------------
+
+def test_ir_cache_warm_run_retraces_nothing_and_edits_invalidate(
+        tmp_path):
+    fixture = _fixture("ir-retrace", "good")
+    local = tmp_path / "provider.py"
+    shutil.copy(fixture, local)
+    cache_dir = str(tmp_path / "cache")
+
+    cold = run_ir(providers=[str(local)], cache_dir=cache_dir)
+    assert cold.programs_traced == cold.programs_checked == 2
+    warm = run_ir(providers=[str(local)], cache_dir=cache_dir)
+    assert warm.programs_checked == 2
+    assert warm.programs_traced == 0, \
+        "warm unchanged registry must re-trace 0 programs"
+    assert warm.findings == cold.findings
+
+    # provider edit -> its programs are stale
+    with open(local, "a") as fh:
+        fh.write("\n# touched\n")
+    os.utime(local, (os.path.getmtime(local) + 2,) * 2)
+    third = run_ir(providers=[str(local)], cache_dir=cache_dir)
+    assert third.programs_traced == 2
+
+    # config-context fold: a different extra_fingerprint (the resolved
+    # lint config) invalidates too — same contract as the file cache
+    fourth = run_ir(providers=[str(local)], cache_dir=cache_dir,
+                    extra_fingerprint="other-config")
+    assert fourth.programs_traced == 2
+
+
+def test_ir_cache_never_caches_failures(tmp_path):
+    fixture = _fixture("ir-trace", "bad")
+    cache_dir = str(tmp_path / "cache")
+    first = run_ir(providers=[fixture], cache_dir=cache_dir)
+    assert first.trace_failures == 1
+    # the healthy sibling cached; the failure re-verifies every run
+    second = run_ir(providers=[fixture], cache_dir=cache_dir)
+    assert second.trace_failures == 1
+    assert second.programs_traced == 1, \
+        "a trace failure must never be served from cache"
+
+
+# ---------------------------------------------------------------------------
+# trace-failure honesty: finding + exit 2, never a silent skip
+# ---------------------------------------------------------------------------
+
+def test_trace_failure_is_a_finding_and_cli_exit_2(monkeypatch, capsys):
+    from cpd_tpu.analysis.__main__ import main
+    from cpd_tpu.analysis.ir import registry as ir_registry
+    monkeypatch.setattr(ir_registry, "DEFAULT_PROVIDERS",
+                        (_fixture("ir-trace", "bad"),))
+    rc = main(["--ir", "--no-cache"])
+    out = capsys.readouterr()
+    assert rc == 2, out.out + out.err
+    assert "ir-trace" in out.out
+    assert "failed to trace" in out.out
+    assert "unverified" in out.err
+
+
+def test_ir_only_cli_clean_exit_0(monkeypatch, capsys):
+    from cpd_tpu.analysis.__main__ import main
+    from cpd_tpu.analysis.ir import registry as ir_registry
+    monkeypatch.setattr(ir_registry, "DEFAULT_PROVIDERS",
+                        (_fixture("ir-trace", "good"),))
+    rc = main(["--ir", "--no-cache"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_empty_changed_diff_does_not_discard_ir_results(
+        monkeypatch, capsys, tmp_path):
+    """Review regression: `--ir <paths> --changed-only` on an empty
+    diff must still report the program pass's results — a down gate
+    (trace failure) exits 2 even when no files changed, never 0."""
+    from cpd_tpu.analysis import engine
+    from cpd_tpu.analysis.__main__ import main
+    from cpd_tpu.analysis.ir import registry as ir_registry
+    monkeypatch.setattr(ir_registry, "DEFAULT_PROVIDERS",
+                        (_fixture("ir-trace", "bad"),))
+    # an empty-but-valid git diff under an arbitrary paths root
+    monkeypatch.setattr(engine, "changed_files", lambda *a, **k: [])
+    rc = main([str(tmp_path), "--changed-only", "--ir", "--no-cache"])
+    out = capsys.readouterr()
+    assert rc == 2, out.out + out.err
+    assert "ir-trace" in out.out
+
+
+def test_trace_failure_exits_2_under_any_program_rule_select(
+        monkeypatch, capsys):
+    """Review regression: every program rule's verdict covers only the
+    programs that TRACED, so selecting ir-overlap (not ir-trace) with
+    an untraceable program must still exit 2 — a 'verified' verdict
+    over a program the analyzer never saw is the silent skip the
+    honesty gate forbids."""
+    from cpd_tpu.analysis.__main__ import main
+    from cpd_tpu.analysis.ir import registry as ir_registry
+    monkeypatch.setattr(ir_registry, "DEFAULT_PROVIDERS",
+                        (_fixture("ir-trace", "bad"),))
+    rc = main(["--ir", "--no-cache", "--select", "ir-overlap"])
+    out = capsys.readouterr()
+    assert rc == 2, out.out + out.err
+    assert "unverified" in out.err
+    # ...but a selection with NO program rule claims no program verdict
+    rc = main(["--ir", "--no-cache", "--select", "format-bounds"])
+    assert rc == 0, capsys.readouterr()
+
+
+def test_ir_with_explicit_empty_paths_is_still_loud(
+        monkeypatch, capsys, tmp_path):
+    """Review regression: `--ir <dir-with-no-py>` (explicit paths, not
+    changed-only) keeps the old 'no Python files' exit 2 — the file
+    gate checked NOTHING and must say so; only the deliberate no-paths
+    --ir mode skips the file pass silently."""
+    from cpd_tpu.analysis.__main__ import main
+    from cpd_tpu.analysis.ir import registry as ir_registry
+    monkeypatch.setattr(ir_registry, "DEFAULT_PROVIDERS",
+                        (_fixture("ir-trace", "good"),))
+    (tmp_path / "notes.txt").write_text("no python here")
+    rc = main(["--ir", "--no-cache", str(tmp_path)])
+    out = capsys.readouterr()
+    assert rc == 2, out.out + out.err
+    assert "no Python files" in out.err
+
+
+def test_ir_findings_exit_1_not_2(monkeypatch, capsys):
+    # contract findings without trace failures are lint findings
+    from cpd_tpu.analysis.__main__ import main
+    from cpd_tpu.analysis.ir import registry as ir_registry
+    monkeypatch.setattr(ir_registry, "DEFAULT_PROVIDERS",
+                        (_fixture("ir-retrace", "bad"),))
+    rc = main(["--ir", "--no-cache"])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "ir-retrace" in out.out
+
+
+# ---------------------------------------------------------------------------
+# one-implementation contracts
+# ---------------------------------------------------------------------------
+
+def test_transport_prims_match_overlap_evidence():
+    """The tracer's notion of 'transport collective' IS overlap.py's —
+    one definition, asserted, so the CI probe and the lint rule cannot
+    drift apart."""
+    from cpd_tpu.analysis.ir.trace import TRANSPORT_PRIMS
+    from cpd_tpu.parallel.overlap import _COLLECTIVE_PRIMS
+    assert set(TRANSPORT_PRIMS) == set(_COLLECTIVE_PRIMS)
+
+
+def test_overlap_evidence_delegates_to_shared_counter():
+    """`overlap_evidence` and the IR rule consume the same
+    `evidence_from_prims`; spot-check the counting on a synthetic
+    stream."""
+    from cpd_tpu.parallel.overlap import evidence_from_prims
+    stream = [("add", 10), ("ppermute", 100), ("dot_general", 100),
+              ("psum", 1), ("dot_general", 100), ("all_gather", 100)]
+    ev = evidence_from_prims(stream)
+    assert ev == {"collectives": 2, "compute_eqns": 2,
+                  "compute_after_first_collective": 2,
+                  "interleaved": True}
+    mono = [("dot_general", 100), ("ppermute", 100)]
+    assert not evidence_from_prims(mono)["interleaved"]
+
+
+def test_unknown_provider_is_loud():
+    from cpd_tpu.analysis.core import LintError
+    with pytest.raises(LintError, match="collection failed"):
+        run_ir(providers=["cpd_tpu.quant.numerics"], use_cache=False)

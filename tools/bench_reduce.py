@@ -459,9 +459,10 @@ def overlap_step_bench(iters: int = 8, batch_per_dev: int = 8,
     e5m2 APS (monolith), faithful+overlap, ring, ring+overlap.  The
     model is a widened TinyCNN (~320k grad elements) so the reduction is
     a real fraction of the step, as it is for ResNet-50 at pod scale.
-    Alongside the timings it reports each arm's `overlap_evidence` —
-    the structural interleaving count — and asserts nothing: the CI
-    gate lives in smoke(); this is the measurement."""
+    Pure measurement: the structural interleaving gate lives in the
+    analyzer's `ir-overlap` rule now (ISSUE 14 — every
+    overlap-configured registered program is checked in CI), not in
+    per-arm `overlap_evidence` calls here."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -469,7 +470,6 @@ def overlap_step_bench(iters: int = 8, batch_per_dev: int = 8,
     from cpd_tpu.models.tiny import tiny_cnn
     from cpd_tpu.parallel.dist import replicate
     from cpd_tpu.parallel.mesh import data_parallel_mesh
-    from cpd_tpu.parallel.overlap import overlap_evidence
     from cpd_tpu.train import (create_train_state, make_optimizer,
                                make_train_step, warmup_step_decay)
 
@@ -554,12 +554,9 @@ def overlap_step_bench(iters: int = 8, batch_per_dev: int = 8,
             s, m = step(s, xb, yb)
             float(m["loss"])
             best = min(best, now() - t0)
-        ev = overlap_evidence(step, arm_state, xb, yb)
         out["arms"][name] = {
             "best_ms": round(best * 1e3, 3),
             "img_per_sec": round(gb * emulate / best, 1),
-            "compute_after_first_collective":
-                ev["compute_after_first_collective"],
         }
     fp32 = out["arms"]["fp32"]["img_per_sec"]
     for name in arms:
@@ -736,13 +733,14 @@ def smoke() -> dict:
         raise AssertionError("2D hierarchical ring != multi-axis oracle")
 
     # overlap gate (ISSUE 8): the overlapped step's updated params are
-    # BITWISE the monolith's, and the overlap actually happened — the
-    # tapped program interleaves transport collectives with backward
-    # compute (a structural jaxpr property, not a timing flake), while
-    # the monolith's transport strictly postdates all compute
+    # BITWISE the monolith's.  The interleaving half of the old gate —
+    # overlap_evidence's structural jaxpr probe — moved to the analyzer
+    # (ISSUE 14): the `ir-overlap` rule checks every overlap-configured
+    # REGISTERED program in the CI `ir-contracts` gate, one
+    # implementation (overlap.evidence_from_prims) instead of ad-hoc
+    # call sites here
     from cpd_tpu.models.tiny import tiny_cnn
     from cpd_tpu.parallel.dist import replicate
-    from cpd_tpu.parallel.overlap import overlap_evidence
     from cpd_tpu.train import (create_train_state, make_optimizer,
                                make_train_step, warmup_step_decay)
     model = tiny_cnn(num_classes=4, width=4)
@@ -766,15 +764,6 @@ def smoke() -> dict:
                 != np.asarray(pb).view(np.uint32)).any():
             raise AssertionError("overlapped step != monolith step "
                                  "(bitwise params)")
-    ev_over = overlap_evidence(over, state0, xs, ys)
-    ev_mono = overlap_evidence(mono, state0, xs, ys)
-    if not ev_over["interleaved"]:
-        raise AssertionError(f"overlapped step NOT interleaved: "
-                             f"{ev_over}")
-    if ev_mono["interleaved"]:
-        raise AssertionError(f"monolith step unexpectedly interleaved: "
-                             f"{ev_mono}")
-
     # ---- block-scaled oracle gate (ISSUE 9): the blocked distributed
     # ring == the extended single-device oracle, BITWISE, across
     # formats x W in {2,4,8} x {RTNE, SR, Kahan} — including an odd
@@ -1041,11 +1030,9 @@ def smoke() -> dict:
             "stats_cast_bitwise_checks": stats_checks,
             "bucketed_ring_oracle": True,
             "hierarchical_ring_2d_oracle": True,
-            "overlap": {"bitwise_vs_monolith": True,
-                        "interleaved": ev_over[
-                            "compute_after_first_collective"],
-                        "monolith_interleaved": ev_mono[
-                            "compute_after_first_collective"]},
+            # interleaving verdicts moved to the analyzer's ir-overlap
+            # rule (ISSUE 14) — value parity stays gated here
+            "overlap": {"bitwise_vs_monolith": True},
             "ring_bytes_w8_e5m2": ring_b,
             "gather_bytes_w8_e5m2_fp32": gather_fp32,
             "gather_bytes_w8_e5m2_packed": gather_packed,
