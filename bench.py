@@ -117,11 +117,18 @@ def _bench_reduce_mod():
     """Load tools/bench_reduce.py as a module (one loader for every
     extra that borrows its measurement functions — overlap bench,
     block-scaled frontier)."""
+    return _tool_mod("bench_reduce")
+
+
+def _tool_mod(stem: str):
+    """Load tools/<stem>.py as a module (shared by the bench_reduce and
+    bench_linalg extras — every BENCH capture reports the same
+    measurement functions the standalone tools run)."""
     import importlib.util
     spec = importlib.util.spec_from_file_location(
-        "bench_reduce", os.path.join(
+        stem, os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
-            "tools", "bench_reduce.py"))
+            "tools", f"{stem}.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -440,6 +447,20 @@ def run_bench(budget_end: float, profile_dir: str | None = None,
         except Exception as e:  # noqa: BLE001 — extras must not kill it
             partial["reduction"]["overlap_note"] = (
                 f"overlap bench skipped: {type(e).__name__}: {e}")
+
+    # Budget-gated EXTRA (ISSUE 15): the quantized-linalg workload class
+    # — per-format accuracy (sharded matmul / CholeskyQR2 / Lanczos vs
+    # fp64 oracles) + analytic wire bytes at the documented probe scale.
+    # One home for the measurement: tools/bench_linalg.py (whose --smoke
+    # is the linalg-smoke CI gate).  Disable with BENCH_LINALG=0.
+    if (os.environ.get("BENCH_LINALG", "1") != "0"
+            and time.monotonic() < budget_end - 120):
+        try:
+            partial["linalg"] = _tool_mod("bench_linalg").measure(
+                iters=int(os.environ.get("BENCH_LINALG_ITERS", "2")))
+        except Exception as e:  # noqa: BLE001 — extras must not kill it
+            partial["linalg_note"] = (
+                f"linalg bench skipped: {type(e).__name__}: {e}")
 
     # Budget-gated EXTRA: a larger-batch scaling point.  bs 32 is the
     # reference-parity headline (main.py:32) but underfills a TPU's MXU

@@ -24,6 +24,7 @@ _EXPORTS = {
     "float_quantize": "quant",
     "quantizer": "quant",
     "quant_gemm": "quant",
+    "qgemm": "quant",   # (exp, man)-consistent spelling (ISSUE 15)
     "Quantizer": "quant",
     "QuantLinear": "quant",
     "QuantConv": "quant",

@@ -58,6 +58,9 @@ DEFAULT_PROVIDERS = (
     "cpd_tpu.parallel.ring",
     "cpd_tpu.parallel.overlap",
     "cpd_tpu.parallel.zero",
+    "cpd_tpu.linalg.blockmm",
+    "cpd_tpu.linalg.qr",
+    "cpd_tpu.linalg.eigen",
     "cpd_tpu.train.step",
     "cpd_tpu.train.lm",
     "cpd_tpu.serve.model",

@@ -40,6 +40,8 @@ _APIS: dict[str, list[tuple[tuple[Optional[int], Optional[str]],
     "max_finite":          [((0, "exp_bits"), (1, "man_bits"), ())],
     "float_quantize":      [((1, "exp"), (2, "man"), (0,))],
     "quant_gemm":          [((3, "exp"), (2, "man"), (0, 1))],
+    "qgemm":               [((2, "exp"), (3, "man"), (0, 1))],
+    "qgemm_stats":         [((2, "exp"), (3, "man"), (0, 1))],
     "ordered_quantized_sum": [((1, "exp"), (2, "man"), (0,))],
     "kahan_quantized_sum": [((1, "exp"), (2, "man"), (0,))],
     "quantized_sum":       [((1, "exp"), (2, "man"), (0,))],

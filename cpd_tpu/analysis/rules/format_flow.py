@@ -62,8 +62,12 @@ _SWAP_APIS = {
     "cast_to_format_blocked": ((1, "exp_bits"), (2, "man_bits")),
     "cast_body_blocked": ((1, "exp_bits"), (2, "man_bits")),
     # NOTE quant_gemm's real signature is (x, w, man, exp) — the swap
-    # check must use ITS order, not assume (exp, man)
+    # check must use ITS order, not assume (exp, man).  The entry stays
+    # only for the back-compat shim; `qgemm` (ISSUE 15) is the
+    # (exp, man)-consistent spelling in-repo call sites migrated to.
     "quant_gemm": ((3, "exp"), (2, "man")),
+    "qgemm": ((2, "exp"), (3, "man")),
+    "qgemm_stats": ((2, "exp"), (3, "man")),
 }
 
 _EXP_NAMES = re.compile(r"(^|_)exp(_bits)?$")

@@ -1,0 +1,52 @@
+"""cpd_tpu.linalg — quantized distributed linear algebra (ISSUE 15).
+
+A new workload class beside `parallel/` and `train/`: dense linear
+algebra whose every GEMM runs through the quantized-Kahan eXmY
+accumulator (`quant.quant_function.qgemm`) and whose every cross-device
+reduction rides the ordered quantized transports of the gradient wire
+(`parallel.ring` / `parallel.reduction` — ring or gather, with the
+plain/Kahan/SR/block-scaled variants all plumbed through).  Each
+algorithm ships with a single-device oracle reproducing the
+distributed result BIT-FOR-BIT (shared numerics helpers; only the
+transport differs — the `ring_oracle_sum` doctrine), plus measured
+accuracy bounds vs fp64 oracles (docs/PERF.md "Quantized linalg").
+
+Modules:
+
+* `blockmm` — 2D block-cyclic sharded matmul (`block_matmul`).
+* `qr`      — distributed CholeskyQR2 (`cholesky_qr2`).
+* `eigen`   — power iteration / Lanczos top-k (`power_iteration`,
+  `lanczos_topk`) and the `inv_root_psd` preconditioner root that
+  Shampoo-lite (train/optim.py) applies to its quantized statistics.
+
+Ground: PAPERS.md #3 (TPU distributed linear algebra) × #2 (EQuARX
+quantized collectives).  Docs: docs/LINALG.md.
+"""
+
+from .blockmm import (BlockLayout, REL_ERROR_BOUNDS, block_matmul,
+                      block_matmul_oracle, make_block_matmul_fn,
+                      matmul_rel_error)
+from .eigen import (EIG_REL_BOUNDS, inv_root_psd, lanczos_topk,
+                    lanczos_topk_oracle, power_iteration,
+                    power_iteration_oracle)
+from .qr import (QR_ORTHO_BOUNDS, cholesky_qr2, cholesky_qr2_oracle,
+                 qr_error_metrics)
+
+__all__ = [
+    "BlockLayout",
+    "block_matmul",
+    "block_matmul_oracle",
+    "make_block_matmul_fn",
+    "matmul_rel_error",
+    "REL_ERROR_BOUNDS",
+    "cholesky_qr2",
+    "cholesky_qr2_oracle",
+    "qr_error_metrics",
+    "QR_ORTHO_BOUNDS",
+    "power_iteration",
+    "power_iteration_oracle",
+    "lanczos_topk",
+    "lanczos_topk_oracle",
+    "inv_root_psd",
+    "EIG_REL_BOUNDS",
+]

@@ -1,0 +1,429 @@
+"""Sharded block matmul with the quantized-Kahan accumulator (ISSUE 15).
+
+The first non-SGD workload riding the repo's two hard primitives: every
+tile product runs through `quant_gemm`'s eXmY Kahan accumulator
+(quant/quant_function.py — the reference CUDA kernel's numerics), and
+every cross-device partial-sum reduction rides the SAME ordered
+quantized transports the gradient all-reduce uses (`ring_quantized_sum`
+/ `reduction.quantized_sum` — ring or gather, plain/Kahan/SR/blocked all
+plumbed through).  Ground: the TPU linear-algebra paper (PAPERS.md #3)
+— pods doing matmul/QR/eigensolves at scale — crossed with EQuARX's
+quantized wire (PAPERS.md #2).
+
+Layout (2D block-cyclic)
+------------------------
+
+``C = A @ B`` over a 2D device grid ``(grid_r, grid_c)`` on two mesh
+axes (rows × K): A's row tiles are dealt CYCLICALLY over the grid rows
+(tile ``i`` lives on row ``i % grid_r``) and its K tiles cyclically
+over the grid columns (tile ``j`` on column ``j % grid_c``); B's K
+tiles follow A's K assignment and are replicated across grid rows.  N
+is not tiled — each tile product is one ``(tile_m, tile_k) @ (tile_k,
+n)`` `quant_gemm`, so the gemm's ordered K scan stays long enough to
+mean something.  Non-divisible edges are zero-padded to whole tiles
+(exact zeros are rounding-invariant on every cast path, and padded
+output rows are sliced off).
+
+Accumulation order (the semantics, documented like the ring's)
+--------------------------------------------------------------
+
+1. inside a tile: `quant_gemm`'s ordered K scan (the reference Kahan
+   recurrence, every intermediate re-cast);
+2. across a device's OWN K tiles: `reduction.quantized_sum` in
+   ascending local tile order (global tile ``j = c + grid_c*jj`` —
+   ascending ``jj``);
+3. across grid columns: the configured transport —
+   ``reduce="ring"``: `ring_quantized_sum` over the column axis (the
+   documented per-chunk rank rotation), ``reduce="gather"``:
+   `all_gather` + the rank-ordered `quantized_sum` scan.
+
+`block_matmul_oracle` reproduces all three levels bit-for-bit on one
+device (the distributed path and the oracle share `_local_partial` and
+the transport oracles — a divergence can only come from the wire,
+exactly like `ring_oracle_sum`'s contract).  Accuracy vs the exact
+fp64 product is a separate, measured claim: `matmul_rel_error` +
+`REL_ERROR_BOUNDS` (asserted in tools/bench_linalg.py --smoke,
+recorded in docs/PERF.md "Quantized linalg").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..quant.quant_function import qgemm
+from ..parallel.reduction import quantized_sum
+from ..parallel.ring import ring_oracle_sum, ring_quantized_sum
+
+__all__ = ["BlockLayout", "block_matmul", "block_matmul_oracle",
+           "make_block_matmul_fn", "matmul_rel_error",
+           "REL_ERROR_BOUNDS"]
+
+# Documented per-format relative-error bounds (Frobenius, vs the fp64
+# numpy oracle) at the benchmark probe scale — N(0,1) operands, K <=
+# 256, Kahan or plain RTNE.  Measured in tools/bench_linalg.py --smoke
+# (which asserts them) and recorded in docs/PERF.md; roughly 2x the
+# worst measured value so a genuine numerics regression trips the gate
+# but noise cannot.  Keyed (exp, man).
+REL_ERROR_BOUNDS = {
+    (8, 23): 1e-6,     # fp32 Kahan scan: ~ulp-level (measured ~7e-8)
+    (5, 7):  1.2e-2,   # e5m7: 7 mantissa bits     (measured ~6e-3)
+    (4, 3):  1.5e-1,   # e4m3                      (measured ~7e-2)
+    (5, 2):  3e-1,     # e5m2: 2 mantissa bits     (measured ~1.4e-1)
+}
+
+# fold_in salts separating the SR bitstreams of the three accumulation
+# levels (tile gemm / local K-tile scan / cross-device transport)
+_SALT_GEMM, _SALT_LOCAL, _SALT_REDUCE = 0, 1, 2
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockLayout:
+    """Static 2D block-cyclic layout for ``(m, k) @ (k, n)`` over a
+    ``(grid_r, grid_c)`` device grid with ``(tile_m, tile_k)`` tiles.
+
+    Derived fields give the padded extents and per-device tile counts;
+    `pack_a`/`pack_b`/`unpack_c` are pure reshape/transpose/pad maps
+    between the logical operands and the device-major layout shard_map
+    shards contiguously (the cyclic deal happens in the transpose)."""
+    m: int
+    k: int
+    n: int
+    grid_r: int
+    grid_c: int
+    tile_m: int
+    tile_k: int
+
+    def __post_init__(self):
+        if min(self.m, self.k, self.n) < 1:
+            raise ValueError(f"degenerate operand shape "
+                             f"({self.m}, {self.k}, {self.n})")
+        if min(self.tile_m, self.tile_k) < 1:
+            raise ValueError(f"tiles must be >= 1, got "
+                             f"({self.tile_m}, {self.tile_k})")
+        if min(self.grid_r, self.grid_c) < 1:
+            raise ValueError(f"grid must be >= 1x1, got "
+                             f"({self.grid_r}, {self.grid_c})")
+
+    # -- derived extents --------------------------------------------------
+
+    @property
+    def row_tiles(self) -> int:
+        return _ceil_div(self.m, self.tile_m)
+
+    @property
+    def k_tiles(self) -> int:
+        return _ceil_div(self.k, self.tile_k)
+
+    @property
+    def tiles_per_row_dev(self) -> int:
+        return _ceil_div(self.row_tiles, self.grid_r)
+
+    @property
+    def tiles_per_col_dev(self) -> int:
+        return _ceil_div(self.k_tiles, self.grid_c)
+
+    @property
+    def m_pad(self) -> int:
+        return self.grid_r * self.tiles_per_row_dev * self.tile_m
+
+    @property
+    def k_pad(self) -> int:
+        return self.grid_c * self.tiles_per_col_dev * self.tile_k
+
+    @property
+    def partial_elems(self) -> int:
+        """Flat element count of one device's C partial — the vector
+        the column-axis transport reduces (the wire-ledger quantum)."""
+        return self.tiles_per_row_dev * self.tile_m * self.n
+
+    # -- packing ----------------------------------------------------------
+
+    def pack_a(self, a: jnp.ndarray) -> jnp.ndarray:
+        """(m, k) -> (grid_r, grid_c, tpr, tpc, tile_m, tile_k), row
+        tile ``i`` at grid row ``i % grid_r`` slot ``i // grid_r`` (and
+        the K mirror) — the cyclic deal as a transpose."""
+        if a.shape != (self.m, self.k):
+            raise ValueError(f"A must be ({self.m}, {self.k}), "
+                             f"got {a.shape}")
+        tpr, tpc = self.tiles_per_row_dev, self.tiles_per_col_dev
+        pad = jnp.pad(jnp.asarray(a, jnp.float32),
+                      ((0, self.m_pad - self.m), (0, self.k_pad - self.k)))
+        t = pad.reshape(tpr, self.grid_r, self.tile_m,
+                        tpc, self.grid_c, self.tile_k)
+        return t.transpose(1, 4, 0, 3, 2, 5)
+
+    def pack_b(self, b: jnp.ndarray) -> jnp.ndarray:
+        """(k, n) -> (grid_c, tpc, tile_k, n): K tiles cyclic over grid
+        columns, replicated across grid rows."""
+        if b.shape != (self.k, self.n):
+            raise ValueError(f"B must be ({self.k}, {self.n}), "
+                             f"got {b.shape}")
+        tpc = self.tiles_per_col_dev
+        pad = jnp.pad(jnp.asarray(b, jnp.float32),
+                      ((0, self.k_pad - self.k), (0, 0)))
+        return pad.reshape(tpc, self.grid_c, self.tile_k,
+                           self.n).transpose(1, 0, 2, 3)
+
+    def unpack_c(self, c_dev: jnp.ndarray) -> jnp.ndarray:
+        """(grid_r, tpr, tile_m, n) device-major partials -> (m, n)."""
+        tpr = self.tiles_per_row_dev
+        out = c_dev.reshape(self.grid_r, tpr, self.tile_m, self.n)
+        out = out.transpose(1, 0, 2, 3).reshape(self.m_pad, self.n)
+        return out[:self.m]
+
+
+def _validate(exp: int, man: int, rounding: str, key, reduce: str,
+              block_scale: bool) -> None:
+    if reduce not in ("ring", "gather"):
+        raise ValueError(f"unknown reduce transport {reduce!r} "
+                         f"(ring | gather)")
+    if rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"unknown rounding mode: {rounding!r}")
+    if rounding == "stochastic" and key is None:
+        raise ValueError("rounding='stochastic' requires a PRNG key")
+    if rounding == "nearest" and key is not None:
+        raise ValueError("a PRNG key was passed but rounding='nearest' "
+                         "would ignore it; did you mean "
+                         "rounding='stochastic'?")
+    if block_scale and (exp, man) == (8, 23):
+        raise ValueError("block_scale=True at (8, 23): the fp32 partial "
+                         "has nothing to scale")
+
+
+def _local_partial(a_rc: jnp.ndarray, b_c: jnp.ndarray, exp: int,
+                   man: int, *, use_kahan: bool, key, rounding: str,
+                   gemm_mode: str) -> jnp.ndarray:
+    """One device's C partial: per-tile `quant_gemm` products, then the
+    ordered quantized scan across the device's own K tiles (ascending
+    local tile order).  Shared verbatim by the sharded path and the
+    oracle — level 1+2 of the documented accumulation order.
+
+    ``key`` is the device's rank-folded base key (None = RTNE)."""
+    tpr, tpc = a_rc.shape[0], a_rc.shape[1]
+    rows = []
+    for ii in range(tpr):
+        prods = []
+        for jj in range(tpc):
+            kk = None
+            if key is not None:
+                kk = jax.random.fold_in(jax.random.fold_in(
+                    jax.random.fold_in(key, _SALT_GEMM), ii), jj)
+            prods.append(qgemm(a_rc[ii, jj], b_c[jj], exp=exp, man=man,
+                               mode=gemm_mode, rounding=rounding, key=kk))
+        stacked = jnp.stack(prods)                 # (tpc, tile_m, n)
+        k_row = None
+        if key is not None:
+            k_row = jax.random.fold_in(
+                jax.random.fold_in(key, _SALT_LOCAL), ii)
+        rows.append(quantized_sum(stacked, exp, man, use_kahan=use_kahan,
+                                  key=k_row))
+    return jnp.stack(rows)                          # (tpr, tile_m, n)
+
+
+def make_block_matmul_fn(mesh, layout: BlockLayout, exp: int, man: int,
+                         *, row_axis: str = "dp", col_axis: str = "tp",
+                         use_kahan: bool = False,
+                         rounding: str = "nearest", key=None,
+                         reduce: str = "ring",
+                         block_scale: bool = False,
+                         block_size: int = 128,
+                         gemm_mode: str = "faithful"):
+    """Build the jitted sharded matmul ``(a_packed, b_packed) ->
+    c_device_major`` for one static configuration.
+
+    `block_matmul` is the pack/unpack convenience wrapper; use the
+    factory directly to amortize the compile across calls."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
+
+    _validate(exp, man, rounding, key, reduce, block_scale)
+    if (mesh.shape[row_axis] != layout.grid_r
+            or mesh.shape[col_axis] != layout.grid_c):
+        raise ValueError(
+            f"layout grid ({layout.grid_r}, {layout.grid_c}) != mesh "
+            f"axes ({row_axis}={mesh.shape[row_axis]}, "
+            f"{col_axis}={mesh.shape[col_axis]})")
+    grid_c = layout.grid_c
+
+    def body(a_loc, b_loc):
+        a_rc = a_loc[0, 0]                  # (tpr, tpc, tile_m, tile_k)
+        b_c = b_loc[0]                      # (tpc, tile_k, n)
+        dev_key = None
+        if key is not None:
+            dev_key = jax.random.fold_in(
+                jax.random.fold_in(key,
+                                   lax.axis_index(row_axis)),
+                lax.axis_index(col_axis))
+        part = _local_partial(a_rc, b_c, exp, man, use_kahan=use_kahan,
+                              key=dev_key, rounding=rounding,
+                              gemm_mode=gemm_mode)
+        flat = part.reshape(-1)
+        red_key = None
+        if key is not None:
+            # transport bits must be identical on every rank of the
+            # column ring (replicated output), so the reduce key folds
+            # only the ROW index — see dist.sum_gradients' key doctrine
+            red_key = jax.random.fold_in(
+                jax.random.fold_in(key, _SALT_REDUCE),
+                lax.axis_index(row_axis))
+        if reduce == "ring":
+            red = ring_quantized_sum(
+                flat, col_axis, exp, man, use_kahan=use_kahan,
+                key=red_key, world=grid_c, block_scale=block_scale,
+                block_size=block_size)
+        else:
+            stacked = lax.all_gather(flat, col_axis, axis=0, tiled=False)
+            red = quantized_sum(
+                stacked, exp, man, use_kahan=use_kahan, key=red_key,
+                block_size=block_size if block_scale else None)
+        return red.reshape(part.shape)[None]        # (1, tpr, tile_m, n)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(row_axis, col_axis), P(col_axis)),
+        out_specs=P(row_axis), check_vma=False))
+
+
+def block_matmul(a, b, mesh, exp: int, man: int, *,
+                 row_axis: str = "dp", col_axis: str = "tp",
+                 tile_m: int = 128, tile_k: int = 128,
+                 use_kahan: bool = False,
+                 rounding: str = "nearest", key=None,
+                 reduce: str = "ring", block_scale: bool = False,
+                 block_size: int = 128,
+                 gemm_mode: str = "faithful",
+                 layout: Optional[BlockLayout] = None) -> jnp.ndarray:
+    """Sharded quantized ``a @ b`` (module docstring) -> (m, n) fp32.
+
+    Bit-identical to ``block_matmul_oracle`` with the same layout and
+    knobs; `matmul_rel_error` vs the fp64 product stays within
+    `REL_ERROR_BOUNDS[(exp, man)]` at the documented probe scale."""
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+        raise ValueError(f"block_matmul expects (M,K)x(K,N); got "
+                         f"{a.shape} x {b.shape}")
+    if layout is None:
+        layout = BlockLayout(a.shape[0], a.shape[1], b.shape[1],
+                             int(mesh.shape[row_axis]),
+                             int(mesh.shape[col_axis]),
+                             tile_m, tile_k)
+    fn = make_block_matmul_fn(
+        mesh, layout, exp, man, row_axis=row_axis, col_axis=col_axis,
+        use_kahan=use_kahan, rounding=rounding, key=key, reduce=reduce,
+        block_scale=block_scale, block_size=block_size,
+        gemm_mode=gemm_mode)
+    c_dev = fn(layout.pack_a(a), layout.pack_b(b))
+    return layout.unpack_c(c_dev)
+
+
+def block_matmul_oracle(a, b, layout: BlockLayout, exp: int, man: int, *,
+                        use_kahan: bool = False,
+                        rounding: str = "nearest", key=None,
+                        reduce: str = "ring", block_scale: bool = False,
+                        block_size: int = 128,
+                        gemm_mode: str = "faithful") -> jnp.ndarray:
+    """Single-device oracle for `block_matmul`: same tile assignment,
+    same per-tile gemms, same local scans, and the transport replaced
+    by its own oracle (`ring_oracle_sum` / the ordered `quantized_sum`
+    scan) — everything except the wire, bit-for-bit."""
+    _validate(exp, man, rounding, key, reduce, block_scale)
+    ap = layout.pack_a(jnp.asarray(a, jnp.float32))
+    bp = layout.pack_b(jnp.asarray(b, jnp.float32))
+    rows = []
+    for r in range(layout.grid_r):
+        parts = []
+        for c in range(layout.grid_c):
+            dev_key = None
+            if key is not None:
+                dev_key = jax.random.fold_in(
+                    jax.random.fold_in(key, r), c)
+            parts.append(_local_partial(
+                ap[r, c], bp[c], exp, man, use_kahan=use_kahan,
+                key=dev_key, rounding=rounding,
+                gemm_mode=gemm_mode).reshape(-1))
+        stacked = jnp.stack(parts)              # (grid_c, partial_elems)
+        red_key = None
+        if key is not None:
+            red_key = jax.random.fold_in(
+                jax.random.fold_in(key, _SALT_REDUCE), r)
+        if reduce == "ring":
+            red = ring_oracle_sum(stacked, exp, man, use_kahan=use_kahan,
+                                  key=red_key, block_scale=block_scale,
+                                  block_size=block_size)
+        else:
+            red = quantized_sum(
+                stacked, exp, man, use_kahan=use_kahan, key=red_key,
+                block_size=block_size if block_scale else None)
+        rows.append(red.reshape(layout.tiles_per_row_dev, layout.tile_m,
+                                layout.n))
+    return layout.unpack_c(jnp.stack(rows))
+
+
+def matmul_rel_error(c, a, b) -> float:
+    """Relative Frobenius error of ``c`` vs the fp64 numpy product —
+    the accuracy axis of the linalg frontier (docs/PERF.md)."""
+    import numpy as np
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    ref = a64 @ b64
+    denom = float(np.linalg.norm(ref))
+    if denom == 0.0:
+        return float(np.linalg.norm(np.asarray(c, np.float64)))
+    return float(np.linalg.norm(np.asarray(c, np.float64) - ref) / denom)
+
+
+def ir_programs(reg):
+    """Program-contract declarations (analysis/ir/registry.py): the
+    sharded matmul's transports are priced by the SAME analytics as the
+    gradient wire — the ring arm must byte-match `ring_transport_bytes`
+    of one device's flat partial, the gather arm
+    `gather_transport_bytes` — and both arms are bitwise-gated (the
+    oracle-parity claim), so an ulp-unstable primitive or a stray fp32
+    debug gather fails lint before it fails a bitwise test."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
+    from ..parallel.mesh import make_mesh
+    from ..parallel.ring import gather_transport_bytes, ring_transport_bytes
+
+    R, C = 1, 8
+    lay = BlockLayout(m=32, k=64, n=16, grid_r=R, grid_c=C,
+                      tile_m=16, tile_k=8)
+    deps = ("cpd_tpu.quant.quant_function", "cpd_tpu.parallel.reduction",
+            "cpd_tpu.parallel.ring", "cpd_tpu.linalg.blockmm")
+
+    def _mm(reduce, exp, man, use_kahan=False):
+        def build():
+            mesh = make_mesh(dp=R, tp=C)
+            fn = make_block_matmul_fn(
+                mesh, lay, exp, man, reduce=reduce, use_kahan=use_kahan)
+            args = (jax.ShapeDtypeStruct(
+                        (R, C, lay.tiles_per_row_dev,
+                         lay.tiles_per_col_dev, lay.tile_m, lay.tile_k),
+                        jnp.float32),
+                    jax.ShapeDtypeStruct(
+                        (C, lay.tiles_per_col_dev, lay.tile_k, lay.n),
+                        jnp.float32))
+            return fn, args
+        return build
+
+    n_flat = lay.partial_elems
+    reg.declare("linalg.matmul[ring,e5m2,g1x8]", _mm("ring", 5, 2),
+                deps=deps, axis_sizes={"dp": R, "tp": C}, bitwise=True,
+                wire=lambda: ring_transport_bytes(n_flat, C, 5, 2))
+    reg.declare("linalg.matmul[gather,e4m3,kahan,g1x8]",
+                _mm("gather", 4, 3, use_kahan=True),
+                deps=deps, axis_sizes={"dp": R, "tp": C}, bitwise=True,
+                wire=lambda: gather_transport_bytes(n_flat, C, 4, 3,
+                                                    compressed=False))

@@ -1,0 +1,473 @@
+"""Power iteration and Lanczos top-k on the quantized matvec substrate,
+plus the PSD inverse-root preconditioner math Shampoo-lite rides.
+
+The iterative eigensolvers exercise the wire at a cadence training
+never does: ONE quantized reduction per matvec, dozens of iterations,
+with the iterate fed back through the quantized gemm every time — any
+transport non-determinism compounds immediately, which is why each
+solver is bit-gated against a single-device oracle exactly like the
+ring (shared iteration cores; only the transport differs).
+
+Substrate: symmetric ``S (nn, nn)`` COLUMN-sharded over one mesh axis.
+``y = S x`` is computed as ``sum_c S[:, cols_c] x[cols_c]`` — each
+device contributes a full-height partial from its column slab via
+`qgemm` (the quantized-Kahan accumulator), and the partials reduce
+over the configured quantized transport (`ring_quantized_sum` |
+all_gather + ordered scan; plain/Kahan/SR/blocked plumbed through).
+The scalar recurrences (Rayleigh quotients, norms, reorthogonalization)
+run replicated in fp32 on identical inputs — sqrt and divide are
+IEEE-exact, so they cannot diverge across ranks or programs (the
+ir-bitwise doctrine: no exp2/log2/pow anywhere on this path).
+
+`inv_root_psd` computes ``G^{-1/p}`` for p in {2, 4} via fp32 `eigh`
+and a SQRT CHAIN (x^{-1/4} = 1/sqrt(sqrt(x))) — deliberately not
+``pow``, which is the ulp-unstable primitive class the ir-bitwise rule
+bans from bitwise-gated programs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..quant.numerics import cast_to_format
+from ..quant.quant_function import qgemm
+from ..parallel.reduction import quantized_sum
+from ..parallel.ring import ring_oracle_sum, ring_quantized_sum
+
+__all__ = ["power_iteration", "power_iteration_oracle", "lanczos_topk",
+           "lanczos_topk_oracle", "inv_root_psd", "EIG_REL_BOUNDS",
+           "det_sum", "det_dot", "det_norm", "fence32"]
+
+# Documented per-format relative error of the LEADING Ritz/power
+# eigenvalue vs fp64 `numpy.linalg.eigvalsh`, at the benchmark probe
+# scale (well-separated spectrum, nn <= 64).  Measured + asserted in
+# tools/bench_linalg.py --smoke, recorded in docs/PERF.md.
+EIG_REL_BOUNDS = {
+    (8, 23): 1e-6,
+    (5, 7):  5e-3,
+    (4, 3):  8e-2,
+    (5, 2):  3e-1,
+}
+
+_SALT_GEMM, _SALT_REDUCE = 0, 1
+
+
+def _validate(exp, man, rounding, key, reduce, block_scale):
+    from .blockmm import _validate as v
+    v(exp, man, rounding, key, reduce, block_scale)
+
+
+def _pad_cols(s: jnp.ndarray, world: int):
+    """Pad symmetric (nn, nn) to (n_pad, n_pad), n_pad = world-multiple.
+    Padded rows/cols are exact zeros: they contribute zero partials and
+    keep the padded iterate entries exactly zero."""
+    nn = s.shape[0]
+    if s.ndim != 2 or s.shape[1] != nn:
+        raise ValueError(f"expected a square (nn, nn) operand, got "
+                         f"{s.shape}")
+    cols = -(-nn // world)
+    n_pad = world * cols
+    return jnp.pad(jnp.asarray(s, jnp.float32),
+                   ((0, n_pad - nn), (0, n_pad - nn))), cols, n_pad
+
+
+def _slab_product(s_loc, x_slab, exp, man, key, rounding, gemm_mode):
+    """One device's full-height matvec partial from its column slab —
+    the quantized-Kahan gemm, shared by the sharded path and oracle."""
+    return qgemm(s_loc, x_slab[:, None], exp=exp, man=man,
+                 mode=gemm_mode, rounding=rounding, key=key)[:, 0]
+
+
+def _it_key(key, it: int, salt: int):
+    if key is None:
+        return None
+    return jax.random.fold_in(jax.random.fold_in(key, salt),
+                              jnp.int32(it))
+
+
+# ---------------------------------------------------------------------------
+# Cross-program-deterministic fp32 scalar recurrences.
+#
+# The iteration cores' bitwise oracle gate compares values produced by
+# TWO DIFFERENT compiled programs (the shard_map solver and its
+# single-device oracle).  Two XLA:CPU behaviors are program-dependent
+# at the last ulp and broke that gate (found mechanically by the gate
+# itself): (a) `jnp.vdot`/`jnp.linalg.norm` pick their accumulation
+# order per fusion context, and (b) LLVM contracts a multiply feeding
+# an add/subtract into an FMA depending on how the surrounding program
+# fused — `lax.optimization_barrier` does NOT survive to codegen, so
+# it cannot stop (b).  The fixes are structural: every scalar
+# reduction runs through `det_sum` — an EXPLICIT fixed binary tree of
+# adds (XLA never reassociates written float adds) — and every product
+# that feeds an add/subtract is fenced through `_fence`, the repo's
+# own (8, 23) cast: a pile of integer-domain bit ops LLVM cannot
+# contract a multiply through (and whose only value effect, the
+# documented fp32-subnormal flush, is itself the canonicalization
+# quant/numerics.py applies everywhere else).  Same doctrine as
+# `aps.exp2_exact` (PR 12): cross-program bitwise contracts may not
+# lean on lowering luck.
+# ---------------------------------------------------------------------------
+
+
+def fence32(x: jnp.ndarray) -> jnp.ndarray:
+    """Contraction fence: the (8, 23) cast — value-preserving on every
+    normal fp32 (subnormals flush to +0.0, the numerics.py
+    canonicalization), routed through the integer domain so a fused
+    consumer cannot FMA-contract the producing multiply."""
+    return cast_to_format(x, 8, 23)
+
+
+def det_sum(x: jnp.ndarray) -> jnp.ndarray:
+    """Sum of ``x`` as an explicit zero-padded binary tree of adds —
+    identical rounding in every program that computes it."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if n == 0:
+        return jnp.zeros([], x.dtype)
+    p = 1 << (n - 1).bit_length() if n > 1 else 1
+    flat = jnp.pad(flat, (0, p - n))
+    while flat.shape[0] > 1:
+        flat = flat[0::2] + flat[1::2]
+    return flat[0]
+
+
+def det_dot(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """<x, y> with the elementwise product fenced from the reduction
+    (no FMA contraction) and the `det_sum` tree order."""
+    return det_sum(fence32(x * y))
+
+
+def det_norm(x: jnp.ndarray) -> jnp.ndarray:
+    """||x||_2 via `det_dot`; sqrt is IEEE-exact, so the whole norm is
+    cross-program deterministic."""
+    return jnp.sqrt(det_dot(x, x))
+
+
+def _axpy_sub(y: jnp.ndarray, s: jnp.ndarray, u: jnp.ndarray):
+    """``y - s * u`` with the product fenced (module comment above —
+    no program-dependent FMA contraction)."""
+    return y - fence32(s * u)
+
+
+def _power_core(matvec, x0, iters: int):
+    """The one power-iteration recurrence (fp32 normalization; the
+    matvec carries all quantization).  Shared by sharded + oracle."""
+    x = x0 / det_norm(x0)
+    lam = jnp.zeros([], jnp.float32)
+    for it in range(iters):
+        y = matvec(x, it)
+        lam = det_dot(x, y)
+        x = y / det_norm(y)
+    return lam, x
+
+
+def _lanczos_core(matvec, v0, steps: int, reorth: bool):
+    """The one Lanczos recurrence (full reorthogonalization in a fixed
+    ascending basis order when ``reorth``).  Returns the Ritz values
+    DESCENDING and the matching Ritz vectors."""
+    v = v0 / det_norm(v0)
+    vs = [v]
+    alphas, betas = [], []
+    v_prev = jnp.zeros_like(v)
+    beta_prev = jnp.zeros([], jnp.float32)
+    for j in range(steps):
+        w = matvec(vs[j], j)
+        alpha = det_dot(w, vs[j])
+        w = _axpy_sub(_axpy_sub(w, alpha, vs[j]), beta_prev, v_prev)
+        if reorth:
+            for u in vs:
+                w = _axpy_sub(w, det_dot(w, u), u)
+        beta = det_norm(w)
+        alphas.append(alpha)
+        betas.append(beta)
+        v_prev = vs[j]
+        beta_prev = beta
+        # breakdown guard: an exactly-invariant Krylov space (or a
+        # fully-flushed residual) gives beta == 0 — dividing would put
+        # NaN in every later Ritz value silently.  The guarded basis
+        # vector is zero, so later alphas/betas are zero rows of T and
+        # the already-converged Ritz values survive finite.  Normal
+        # path bitwise unchanged: beta > tiny selects w / beta exactly.
+        safe = jnp.maximum(beta, jnp.float32(1e-38))
+        vs.append(jnp.where(beta > 0.0, w / safe, jnp.zeros_like(w)))
+    t = jnp.diag(jnp.stack(alphas))
+    if steps > 1:               # steps == 1: T is the 1x1 [alpha_0]
+        off = jnp.stack(betas[:-1])
+        t = t + jnp.diag(off, 1) + jnp.diag(off, -1)
+    evals, evecs = jnp.linalg.eigh(t)
+    # Ritz vectors composed as explicit fenced axpy chains instead of a
+    # dot_general: a small matmul's codegen (and FMA use) is fusion-
+    # context-dependent on CPU — same cross-program concern as det_dot
+    cols = []
+    for i in range(steps):
+        col = jnp.zeros_like(vs[0])
+        for j in range(steps):
+            col = col + fence32(evecs[j, i] * vs[j])
+        cols.append(col)
+    return evals[::-1], jnp.stack(cols[::-1], axis=1)
+
+
+def _default_v0(n_pad: int) -> jnp.ndarray:
+    """Deterministic dense start vector (no PRNG: the SR keys belong to
+    the casts) — strictly positive, non-uniform, so it is never
+    orthogonal to a Perron-like leading eigenvector and never aliases a
+    coordinate axis.  Built from EXACT fp32 arithmetic only (mod,
+    scale by 2^-6, add-below-1): ``arange(n) / n`` for non-power-of-2
+    ``n`` rounds, and XLA constant-folds that division exactly while
+    runtime codegen reciprocal-multiplies it — a 1-ulp cross-program
+    divergence the W=2 oracle gate caught."""
+    i = jnp.arange(n_pad, dtype=jnp.float32)
+    return 1.0 + jnp.mod(i, 64.0) * jnp.float32(1.0 / 64.0)
+
+
+def _sharded_solver(s, mesh, axis, world, exp, man, use_kahan, rounding,
+                    key, reduce, block_scale, block_size, gemm_mode,
+                    core):
+    """Common scaffolding: pad/pack S, build the distributed matvec,
+    run ``core(matvec, n_pad)`` inside one shard_map program."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
+
+    _validate(exp, man, rounding, key, reduce, block_scale)
+    s_pad, cols, n_pad = _pad_cols(jnp.asarray(s, jnp.float32), world)
+    # (world, n_pad, cols): device c's column slab S[:, c*cols:(c+1)*cols]
+    packed = s_pad.reshape(n_pad, world, cols).transpose(1, 0, 2)
+
+    def body(s_blk):
+        s_loc = s_blk[0]                            # (n_pad, cols)
+        rank = lax.axis_index(axis)
+
+        def matvec(x, it):
+            x_slab = lax.dynamic_slice(x, (rank * cols,), (cols,))
+            gk = _it_key(key, it, _SALT_GEMM)
+            if gk is not None:
+                gk = jax.random.fold_in(gk, rank)
+            part = _slab_product(s_loc, x_slab, exp, man, gk, rounding,
+                                 gemm_mode)
+            rk = _it_key(key, it, _SALT_REDUCE)
+            if reduce == "ring":
+                return ring_quantized_sum(
+                    part, axis, exp, man, use_kahan=use_kahan, key=rk,
+                    world=world, block_scale=block_scale,
+                    block_size=block_size)
+            stacked = lax.all_gather(part, axis, axis=0, tiled=False)
+            return quantized_sum(
+                stacked, exp, man, use_kahan=use_kahan, key=rk,
+                block_size=block_size if block_scale else None)
+
+        return core(matvec, n_pad)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                           out_specs=P(), check_vma=False))
+    return fn(packed), n_pad
+
+
+def _oracle_solver(s, world, exp, man, use_kahan, rounding, key, reduce,
+                   block_scale, block_size, gemm_mode, core):
+    """Single-device twin of `_sharded_solver`: same slabs, same keys,
+    the transport replaced by its oracle."""
+    _validate(exp, man, rounding, key, reduce, block_scale)
+    s_pad, cols, n_pad = _pad_cols(jnp.asarray(s, jnp.float32), world)
+    slabs = [s_pad[:, c * cols:(c + 1) * cols] for c in range(world)]
+
+    def matvec(x, it):
+        parts = []
+        for c in range(world):
+            gk = _it_key(key, it, _SALT_GEMM)
+            if gk is not None:
+                gk = jax.random.fold_in(gk, c)
+            parts.append(_slab_product(
+                slabs[c], x[c * cols:(c + 1) * cols], exp, man, gk,
+                rounding, gemm_mode))
+        stacked = jnp.stack(parts)
+        rk = _it_key(key, it, _SALT_REDUCE)
+        if reduce == "ring":
+            return ring_oracle_sum(stacked, exp, man,
+                                   use_kahan=use_kahan, key=rk,
+                                   block_scale=block_scale,
+                                   block_size=block_size)
+        return quantized_sum(stacked, exp, man, use_kahan=use_kahan,
+                             key=rk,
+                             block_size=block_size if block_scale
+                             else None)
+
+    return core(matvec, n_pad), n_pad
+
+
+def power_iteration(s, mesh, exp: int, man: int, *, iters: int = 16,
+                    axis: str = "dp", v0=None, use_kahan: bool = False,
+                    rounding: str = "nearest", key=None,
+                    reduce: str = "ring", block_scale: bool = False,
+                    block_size: int = 128, gemm_mode: str = "faithful"):
+    """Distributed power iteration -> ``(eigval, eigvec)`` for the
+    leading eigenpair of symmetric ``s``, every matvec riding the
+    quantized wire.  Bit-identical to `power_iteration_oracle`."""
+    world = int(mesh.shape[axis])
+
+    def core(matvec, n_pad):
+        x0 = _default_v0(n_pad) if v0 is None else _pad_v0(v0, n_pad)
+        return _power_core(matvec, x0, iters)
+
+    (lam, x), n_pad = _sharded_solver(
+        s, mesh, axis, world, exp, man, use_kahan, rounding, key, reduce,
+        block_scale, block_size, gemm_mode, core)
+    return lam, x[:s.shape[0]]
+
+
+def power_iteration_oracle(s, world: int, exp: int, man: int, *,
+                           iters: int = 16, v0=None,
+                           use_kahan: bool = False,
+                           rounding: str = "nearest", key=None,
+                           reduce: str = "ring",
+                           block_scale: bool = False,
+                           block_size: int = 128,
+                           gemm_mode: str = "faithful"):
+    def core(matvec, n_pad):
+        x0 = _default_v0(n_pad) if v0 is None else _pad_v0(v0, n_pad)
+        return _power_core(matvec, x0, iters)
+
+    (lam, x), n_pad = _oracle_solver(
+        s, world, exp, man, use_kahan, rounding, key, reduce,
+        block_scale, block_size, gemm_mode, core)
+    return lam, x[:s.shape[0]]
+
+
+def _pad_v0(v0, n_pad: int) -> jnp.ndarray:
+    v0 = jnp.asarray(v0, jnp.float32)
+    return jnp.pad(v0, (0, n_pad - v0.shape[0]))
+
+
+def _lanczos_steps(k: int, steps, nn: int) -> int:
+    """Resolve + validate the Krylov depth: default 2k capped at the
+    matrix dimension (a Krylov space cannot exceed dim n, and running
+    past it guarantees a breakdown step); explicit over-asks rejected
+    loudly."""
+    if steps is None:
+        steps = min(2 * k, nn)
+    if steps < k:
+        raise ValueError(f"steps={steps} < k={k}: the Krylov basis "
+                         f"cannot hold k Ritz pairs")
+    if steps > nn:
+        raise ValueError(f"steps={steps} > matrix dim {nn}: the Krylov "
+                         f"space saturates at n — deeper iteration is "
+                         f"a guaranteed breakdown")
+    return steps
+
+
+def lanczos_topk(s, mesh, exp: int, man: int, *, k: int,
+                 steps: Optional[int] = None, axis: str = "dp", v0=None,
+                 reorth: bool = True, use_kahan: bool = False,
+                 rounding: str = "nearest", key=None,
+                 reduce: str = "ring", block_scale: bool = False,
+                 block_size: int = 128, gemm_mode: str = "faithful"):
+    """Distributed Lanczos -> ``(ritz_vals (k,), ritz_vecs (nn, k))``:
+    the top-k Ritz approximations of symmetric ``s`` after ``steps``
+    (default ``min(2k, n)``) three-term iterations, one quantized-wire
+    matvec each.  ``steps`` may exceed the per-device chunk edge ``n_pad /
+    world`` — the pad/shard paths training shapes never hit (tested).
+    Bit-identical to `lanczos_topk_oracle`."""
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    steps = _lanczos_steps(k, steps, s.shape[0] if hasattr(s, "shape")
+                           else len(s))
+    world = int(mesh.shape[axis])
+
+    def core(matvec, n_pad):
+        x0 = _default_v0(n_pad) if v0 is None else _pad_v0(v0, n_pad)
+        return _lanczos_core(matvec, x0, steps, reorth)
+
+    (vals, vecs), n_pad = _sharded_solver(
+        s, mesh, axis, world, exp, man, use_kahan, rounding, key, reduce,
+        block_scale, block_size, gemm_mode, core)
+    return vals[:k], vecs[:s.shape[0], :k]
+
+
+def lanczos_topk_oracle(s, world: int, exp: int, man: int, *, k: int,
+                        steps: Optional[int] = None, v0=None,
+                        reorth: bool = True, use_kahan: bool = False,
+                        rounding: str = "nearest", key=None,
+                        reduce: str = "ring", block_scale: bool = False,
+                        block_size: int = 128,
+                        gemm_mode: str = "faithful"):
+    steps = _lanczos_steps(k, steps, s.shape[0] if hasattr(s, "shape")
+                           else len(s))
+
+    def core(matvec, n_pad):
+        x0 = _default_v0(n_pad) if v0 is None else _pad_v0(v0, n_pad)
+        return _lanczos_core(matvec, x0, steps, reorth)
+
+    (vals, vecs), n_pad = _oracle_solver(
+        s, world, exp, man, use_kahan, rounding, key, reduce,
+        block_scale, block_size, gemm_mode, core)
+    return vals[:k], vecs[:s.shape[0], :k]
+
+
+def inv_root_psd(g, p: int = 4, eps: float = 1e-6) -> jnp.ndarray:
+    """``(G + ridge I)^{-1/p}`` for a symmetric PSD ``G``, p in {2, 4}.
+
+    fp32 `eigh`, eigenvalues floored at zero, a relative ridge
+    ``eps * max(lambda_max, 1e-16)``, and the inverse root taken as a
+    SQRT CHAIN (1/sqrt(x), 1/sqrt(sqrt(x))) — `pow` is the ulp-unstable
+    primitive class banned from bitwise-gated programs (ir-bitwise),
+    and Shampoo-lite's ×2-determinism gate runs straight through here.
+    Runs replicated on identical inputs; no collective."""
+    if p not in (2, 4):
+        raise ValueError(f"p must be 2 or 4 (sqrt-chain exactness; pow "
+                         f"is ulp-unstable), got {p}")
+    g = jnp.asarray(g, jnp.float32)
+    w, v = jnp.linalg.eigh(g)
+    wmax = jnp.maximum(w[-1], 0.0)
+    ridge = jnp.float32(eps) * jnp.maximum(wmax, jnp.float32(1e-16))
+    wc = jnp.maximum(w, 0.0) + ridge
+    root = jnp.sqrt(wc) if p == 2 else jnp.sqrt(jnp.sqrt(wc))
+    return (v / root) @ v.T
+
+
+def ir_programs(reg):
+    """Registry declarations: the iterative solvers put one quantized
+    reduction on the wire PER MATVEC — the ledger prices exactly
+    ``iters x`` the single-reduction analytics, so a solver that grows
+    a second hidden collective per iteration (or drops its packed wire)
+    fails `ir-wire-ledger` immediately."""
+    from ..parallel.mesh import data_parallel_mesh
+    from ..parallel.ring import ring_transport_bytes
+
+    W, nn = 8, 32
+    n_pad = W * (-(-nn // W))
+    deps = ("cpd_tpu.quant.quant_function", "cpd_tpu.parallel.reduction",
+            "cpd_tpu.parallel.ring", "cpd_tpu.linalg.eigen",
+            "cpd_tpu.linalg.blockmm")
+
+    def _power(iters):
+        def build():
+            mesh = data_parallel_mesh()
+
+            def run(s):
+                return power_iteration(s, mesh, 5, 2, iters=iters,
+                                       axis="dp", reduce="ring")
+
+            return run, (jax.ShapeDtypeStruct((nn, nn), jnp.float32),)
+        return build
+
+    def _lanczos(k, steps):
+        def build():
+            mesh = data_parallel_mesh()
+
+            def run(s):
+                return lanczos_topk(s, mesh, 5, 2, k=k, steps=steps,
+                                    axis="dp", reduce="ring")
+
+            return run, (jax.ShapeDtypeStruct((nn, nn), jnp.float32),)
+        return build
+
+    reg.declare("linalg.power[ring,e5m2,w8,it3]", _power(3),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: 3 * ring_transport_bytes(n_pad, W, 5, 2))
+    reg.declare("linalg.lanczos[ring,e5m2,w8,s4]", _lanczos(2, 4),
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: 4 * ring_transport_bytes(n_pad, W, 5, 2))

@@ -1,0 +1,261 @@
+"""Distributed one-sided QR on the quantized substrate: CholeskyQR2.
+
+The TPU linear-algebra paper (PAPERS.md #3) runs one-sided
+factorizations at pod scale because they need only GEMMs plus tiny
+replicated host-sized factors — exactly the substrate this repo owns.
+CholeskyQR2 (Yamamoto et al.) on tall-skinny ``A (m, nn)``, row-sharded
+over one mesh axis:
+
+    pass p = 1, 2:
+        G_local = qgemm(A_p^T, A_p)            # quantized Kahan Gram
+        G       = quantized reduce over axis   # ring | gather transport
+        L       = cholesky(G);  R_p = L^T      # fp32, replicated
+        A_{p+1} = qgemm(A_p, R_p^{-1})         # quantized apply
+    Q = A_3;  R = qgemm(R_2, R_1)
+
+One pass is classic CholeskyQR — orthogonality error ~ kappa(A)^2 * u;
+the second pass squares it away (u = the eXmY unit roundoff here, so
+the per-format orthogonality frontier is measured and documented
+rather than assumed — `qr_error_metrics`, docs/PERF.md "Quantized
+linalg").
+
+Every Gram partial is a `quant_gemm`-accumulated tile, and the ONLY
+cross-device numerics is the quantized reduction of the (nn, nn) Gram
+— the same ordered transports as the gradient wire, so
+`cholesky_qr2_oracle` reproduces the distributed factorization
+bit-for-bit on one device via `ring_oracle_sum` / the ordered scan
+(the shared-helper doctrine of parallel/ring.py).  The small factors
+(Cholesky, triangular inverse) are computed REPLICATED in fp32 on
+identical inputs, so they cannot diverge across ranks.
+
+Zero-padded tail rows contribute exact zeros to every Gram and stay
+exactly zero through ``A @ R^{-1}`` — sliced off at the end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..quant.quant_function import qgemm
+from ..parallel.reduction import quantized_sum
+from ..parallel.ring import ring_oracle_sum, ring_quantized_sum
+
+__all__ = ["cholesky_qr2", "cholesky_qr2_oracle", "qr_error_metrics",
+           "QR_ORTHO_BOUNDS"]
+
+# Documented per-format orthogonality bounds: ||Q^T Q - I||_F / sqrt(nn)
+# after TWO passes at the benchmark probe scale (tall-skinny N(0,1),
+# kappa ~ 1).  Measured in tools/bench_linalg.py --smoke (asserted),
+# recorded in docs/PERF.md; ~2x worst measured.  Keyed (exp, man).
+QR_ORTHO_BOUNDS = {
+    (8, 23): 1e-6,     # measured ~1.4e-7
+    (5, 7):  1e-2,     # measured ~4.4e-3
+    (4, 3):  1e-1,     # measured ~4.6e-2
+    (5, 2):  4e-1,     # measured ~2.2e-1
+}
+
+_SALT_GRAM, _SALT_APPLY, _SALT_REDUCE = 0, 1, 2
+
+
+def _pass_key(key, p: int, salt: int):
+    if key is None:
+        return None
+    return jax.random.fold_in(jax.random.fold_in(key, salt), p)
+
+
+def _gram_local(a_loc: jnp.ndarray, exp: int, man: int, key, rounding,
+                gemm_mode: str) -> jnp.ndarray:
+    """One device's Gram partial A_loc^T @ A_loc via the quantized-Kahan
+    gemm.  Symmetric by construction: entry (i, j) and (j, i) accumulate
+    the same products in the same K order, and every cast is
+    elementwise."""
+    return qgemm(a_loc.T, a_loc, exp=exp, man=man, mode=gemm_mode,
+                 rounding=rounding, key=key)
+
+
+def _chol_rinv(g: jnp.ndarray):
+    """(R, R^{-1}) from a replicated Gram: lower Cholesky in fp32, R =
+    L^T, R^{-1} = (L^{-1})^T via a triangular solve against I.  Runs on
+    inputs that are identical on every rank, so the factors are
+    replicated bit-for-bit without any collective."""
+    from jax.scipy.linalg import solve_triangular
+    l = jnp.linalg.cholesky(g.astype(jnp.float32))
+    eye = jnp.eye(g.shape[0], dtype=jnp.float32)
+    linv = solve_triangular(l, eye, lower=True)
+    return l.T, linv.T
+
+
+def _validate(exp, man, rounding, key, reduce, block_scale):
+    from .blockmm import _validate as v
+    v(exp, man, rounding, key, reduce, block_scale)
+
+
+def cholesky_qr2(a, mesh, exp: int, man: int, *, axis: str = "dp",
+                 use_kahan: bool = False, rounding: str = "nearest",
+                 key=None, reduce: str = "ring",
+                 block_scale: bool = False, block_size: int = 128,
+                 gemm_mode: str = "faithful", passes: int = 2):
+    """Distributed CholeskyQR2 -> ``(q, r)`` with ``q`` (m, nn) and
+    ``r`` (nn, nn) upper-triangular, ``q @ r ~= a``.
+
+    Row-sharded over ``axis``; every Gram reduction rides the
+    configured quantized transport (`ring_quantized_sum` or all_gather
+    + ordered scan), plain/Kahan/SR/blocked all plumbed through.
+    Bit-identical to `cholesky_qr2_oracle` with the same knobs."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..compat import shard_map
+
+    _validate(exp, man, rounding, key, reduce, block_scale)
+    if passes < 1:
+        raise ValueError(f"passes must be >= 1, got {passes}")
+    a = jnp.asarray(a, jnp.float32)
+    if a.ndim != 2:
+        raise ValueError(f"cholesky_qr2 expects a 2D (m, nn) operand, "
+                         f"got {a.shape}")
+    m, nn = a.shape
+    world = int(mesh.shape[axis])
+    rows_loc = -(-m // world)
+    a_pad = jnp.pad(a, ((0, world * rows_loc - m), (0, 0)))
+
+    def body(a_blk):
+        cur = a_blk[0]                              # (rows_loc, nn)
+        rank = lax.axis_index(axis)
+        r_total = None
+        for p in range(passes):
+            gk = _pass_key(key, p, _SALT_GRAM)
+            if gk is not None:
+                gk = jax.random.fold_in(gk, rank)
+            g_part = _gram_local(cur, exp, man, gk, rounding, gemm_mode)
+            rk = _pass_key(key, p, _SALT_REDUCE)
+            if reduce == "ring":
+                g = ring_quantized_sum(
+                    g_part.reshape(-1), axis, exp, man,
+                    use_kahan=use_kahan, key=rk, world=world,
+                    block_scale=block_scale, block_size=block_size)
+            else:
+                stacked = lax.all_gather(g_part.reshape(-1), axis,
+                                         axis=0, tiled=False)
+                g = quantized_sum(
+                    stacked, exp, man, use_kahan=use_kahan, key=rk,
+                    block_size=block_size if block_scale else None)
+            r_p, rinv = _chol_rinv(g.reshape(nn, nn))
+            ak = _pass_key(key, p, _SALT_APPLY)
+            if ak is not None:
+                ak = jax.random.fold_in(ak, rank)
+            cur = qgemm(cur, rinv, exp=exp, man=man, mode=gemm_mode,
+                        rounding=rounding, key=ak)
+            if r_total is None:
+                r_total = r_p
+            else:
+                fk = _pass_key(key, p, _SALT_APPLY)
+                if fk is not None:
+                    fk = jax.random.fold_in(fk, jnp.int32(world))
+                r_total = qgemm(r_p, r_total, exp=exp, man=man,
+                                mode=gemm_mode, rounding=rounding, key=fk)
+        return cur[None], r_total
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                           out_specs=(P(axis), P()), check_vma=False))
+    q_blk, r = fn(a_pad.reshape(world, rows_loc, nn))
+    return q_blk.reshape(world * rows_loc, nn)[:m], r
+
+
+def cholesky_qr2_oracle(a, world: int, exp: int, man: int, *,
+                        use_kahan: bool = False,
+                        rounding: str = "nearest", key=None,
+                        reduce: str = "ring", block_scale: bool = False,
+                        block_size: int = 128,
+                        gemm_mode: str = "faithful", passes: int = 2):
+    """Single-device oracle for `cholesky_qr2`: identical per-rank Gram
+    partials and factor math, the transport replaced by its oracle."""
+    _validate(exp, man, rounding, key, reduce, block_scale)
+    a = jnp.asarray(a, jnp.float32)
+    m, nn = a.shape
+    rows_loc = -(-m // world)
+    a_pad = jnp.pad(a, ((0, world * rows_loc - m), (0, 0)))
+    blocks = a_pad.reshape(world, rows_loc, nn)
+    cur = [blocks[r] for r in range(world)]
+    r_total = None
+    for p in range(passes):
+        parts = []
+        for r in range(world):
+            gk = _pass_key(key, p, _SALT_GRAM)
+            if gk is not None:
+                gk = jax.random.fold_in(gk, r)
+            parts.append(_gram_local(cur[r], exp, man, gk, rounding,
+                                     gemm_mode).reshape(-1))
+        stacked = jnp.stack(parts)
+        rk = _pass_key(key, p, _SALT_REDUCE)
+        if reduce == "ring":
+            g = ring_oracle_sum(stacked, exp, man, use_kahan=use_kahan,
+                                key=rk, block_scale=block_scale,
+                                block_size=block_size)
+        else:
+            g = quantized_sum(stacked, exp, man, use_kahan=use_kahan,
+                              key=rk,
+                              block_size=block_size if block_scale
+                              else None)
+        r_p, rinv = _chol_rinv(g.reshape(nn, nn))
+        nxt = []
+        for r in range(world):
+            ak = _pass_key(key, p, _SALT_APPLY)
+            if ak is not None:
+                ak = jax.random.fold_in(ak, r)
+            nxt.append(qgemm(cur[r], rinv, exp=exp, man=man,
+                             mode=gemm_mode, rounding=rounding, key=ak))
+        cur = nxt
+        if r_total is None:
+            r_total = r_p
+        else:
+            fk = _pass_key(key, p, _SALT_APPLY)
+            if fk is not None:
+                fk = jax.random.fold_in(fk, jnp.int32(world))
+            r_total = qgemm(r_p, r_total, exp=exp, man=man,
+                            mode=gemm_mode, rounding=rounding, key=fk)
+    q = jnp.concatenate(cur, axis=0)[:m]
+    return q, r_total
+
+
+def qr_error_metrics(q, r, a) -> dict:
+    """fp64 accuracy metrics of a computed factorization: normalized
+    orthogonality ``||Q^T Q - I||_F / sqrt(nn)`` and relative residual
+    ``||Q R - A||_F / ||A||_F`` — the two axes of the QR frontier."""
+    import numpy as np
+    q64 = np.asarray(q, np.float64)
+    r64 = np.asarray(r, np.float64)
+    a64 = np.asarray(a, np.float64)
+    nn = q64.shape[1]
+    ortho = np.linalg.norm(q64.T @ q64 - np.eye(nn)) / np.sqrt(nn)
+    resid = np.linalg.norm(q64 @ r64 - a64) / max(np.linalg.norm(a64),
+                                                  1e-30)
+    return {"orthogonality": float(ortho), "residual": float(resid)}
+
+
+def ir_programs(reg):
+    """Registry declarations: CholeskyQR2's wire is exactly two Gram
+    reductions of nn*nn elements per pass transport — priced by the
+    same `ring_transport_bytes` analytics as the gradient ring, and
+    bitwise-gated (the oracle-parity claim covers the whole
+    factorization)."""
+    from ..parallel.mesh import data_parallel_mesh
+    from ..parallel.ring import ring_transport_bytes
+
+    W, m, nn = 8, 64, 16
+    deps = ("cpd_tpu.quant.quant_function", "cpd_tpu.parallel.reduction",
+            "cpd_tpu.parallel.ring", "cpd_tpu.linalg.qr",
+            "cpd_tpu.linalg.blockmm")
+
+    def build():
+        mesh = data_parallel_mesh()
+
+        def run(a):
+            return cholesky_qr2(a, mesh, 5, 7, axis="dp", reduce="ring")
+
+        return run, (jax.ShapeDtypeStruct((m, nn), jnp.float32),)
+
+    reg.declare("linalg.qr[cholqr2,ring,e5m7,w8]", build,
+                deps=deps, axis_sizes={"dp": W}, bitwise=True,
+                wire=lambda: 2 * ring_transport_bytes(nn * nn, W, 5, 7))

@@ -199,6 +199,25 @@ class MetricsRegistry:
         for key, value in counters.items():
             self.mirror(f"cpd_serve_{key}", float(value), **labels)
 
+    def absorb_linalg_counters(self, counters: dict,
+                               algo: Optional[str] = None,
+                               fmt: Optional[str] = None) -> None:
+        """A linalg benchmark result block (tools/bench_linalg.py) —
+        ``cpd_linalg_<key>`` gauges labelled by algorithm and eXmY
+        format, so one capture exports the whole accuracy/bytes
+        frontier as distinguishable series (ISSUE 15)."""
+        labels = {}
+        if algo is not None:
+            labels["algo"] = algo
+        if fmt is not None:
+            labels["fmt"] = fmt
+        for key, value in counters.items():
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                continue
+            self.set_gauge(f"cpd_linalg_{key}", v, **labels)
+
     def absorb_fleet_counters(self, fleet) -> None:
         """A `cpd_tpu.fleet.Fleet` — the ``cpd_fleet_*`` family
         (ISSUE 13): the fleet's own counters (routing, retries,

@@ -31,6 +31,7 @@ from .numerics import (HEALTH_FIELDS, cast_to_format, cast_to_format_sr,
                        quant_health)
 
 __all__ = ["float_quantize", "quantizer", "quantizer_sr", "quant_gemm",
+           "qgemm", "qgemm_stats",
            "float_quantize_stats", "quant_gemm_stats", "quantizer_stats",
            "tree_quant_health", "HEALTH_FIELDS"]
 
@@ -289,10 +290,42 @@ def _quant_gemm_impl(a: jnp.ndarray, b: jnp.ndarray, man: int, exp: int,
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def qgemm(a: jnp.ndarray, b: jnp.ndarray, exp: int = 8, man: int = 23,
+          mode: str = "faithful", rounding: str = "nearest",
+          key=None) -> jnp.ndarray:
+    """`quant_gemm` with the repo-consistent ``(exp, man)`` argument
+    order — the canonical spelling (ISSUE 15 satellite).
+
+    `quant_gemm` keeps the reference's positional ``(man, exp)`` order
+    (quant_function.py:78-98) and stays as the back-compat shim; every
+    OTHER format API in the repo takes ``(exp, man)``, which made the
+    original order a positional-call footgun the format-bounds /
+    format-flow lint rules had to special-case.  New code calls
+    ``qgemm(a, b, exp=..., man=...)``; in-repo call sites are migrated.
+    Numerics, modes, rounding and the stats twin (`qgemm_stats`) are
+    identical — one `_quant_gemm_impl` body serves all four entries."""
+    return _quant_gemm_impl(a, b, man, exp, mode, rounding, key, False)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def qgemm_stats(a: jnp.ndarray, b: jnp.ndarray, exp: int = 8,
+                man: int = 23, mode: str = "faithful",
+                rounding: str = "nearest", key=None) -> tuple:
+    """`quant_gemm_stats` in the ``(exp, man)`` order — see `qgemm`."""
+    return _quant_gemm_impl(a, b, man, exp, mode, rounding, key, True)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
 def quant_gemm(a: jnp.ndarray, b: jnp.ndarray, man: int = 23, exp: int = 8,
                mode: str = "faithful", rounding: str = "nearest",
                key=None) -> jnp.ndarray:
     """GEMM ``a @ b`` with an eXmY accumulator.
+
+    BACK-COMPAT SHIM: the positional order here is the reference's
+    ``(man, exp)`` — every other format API takes ``(exp, man)``.
+    Prefer `qgemm` (same numerics, consistent order); this surface
+    stays for reference parity and external callers, and the analyzer
+    keeps its name-crossed table entry for exactly this signature.
 
     a: (M, K), b: (K, N) — reference quant_function.py:78-98.  The faithful
     mode reproduces the CUDA kernel's numerics exactly (float_kernel.cu:

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .quant_function import (_site_key, float_quantize,
-                             quant_gemm, quantizer, quantizer_sr)
+                             qgemm, quantizer, quantizer_sr)
 
 __all__ = ["Quantizer", "QuantLinear", "QuantConv", "QuantDense",
            "quant_linear_fn"]
@@ -35,9 +35,9 @@ __all__ = ["Quantizer", "QuantLinear", "QuantConv", "QuantDense",
 
 def _gemm(a, b, exp, man, mode, key_data, site):
     if key_data is None:
-        return quant_gemm(a, b, man=man, exp=exp, mode=mode)
-    return quant_gemm(a, b, man=man, exp=exp, mode=mode,
-                      rounding="stochastic", key=_site_key(key_data, site))
+        return qgemm(a, b, exp=exp, man=man, mode=mode)
+    return qgemm(a, b, exp=exp, man=man, mode=mode,
+                 rounding="stochastic", key=_site_key(key_data, site))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -4,7 +4,8 @@ from .state import TrainState, create_train_state
 from .step import (cross_entropy_loss, make_eval_step,
                    make_seg_eval_step, make_train_step,
                    seg_cross_entropy_loss)
-from .optim import lars, make_optimizer, quant_sgd, sgd
+from .optim import (ShampooLite, lars, make_optimizer, quant_sgd, sgd,
+                    shampoo_lite)
 from .schedules import (iter_table, piecewise_linear, warmup_cosine,
                         warmup_step_decay)
 from .metrics import (AverageMeter, ResilienceMeter, Timer, accuracy,
@@ -22,6 +23,7 @@ __all__ = [
     "cross_entropy_loss", "seg_cross_entropy_loss", "make_eval_step",
     "make_seg_eval_step", "make_train_step",
     "lars", "make_optimizer", "quant_sgd", "sgd",
+    "shampoo_lite", "ShampooLite",
     "iter_table", "piecewise_linear", "warmup_cosine", "warmup_step_decay",
     "AverageMeter", "ResilienceMeter", "Timer", "accuracy",
     "with_dynamic_loss_scale", "DynamicScaleState", "find_dynamic_scale",

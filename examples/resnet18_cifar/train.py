@@ -75,6 +75,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "stagnation; train/optim.py quant_sgd)")
     p.add_argument("--opt-seed", default=0, type=int,
                    help="PRNG seed for --opt-rounding stochastic")
+    p.add_argument("--optimizer", default="auto",
+                   choices=["auto", "sgd", "nesterov", "lars",
+                            "quant_sgd", "shampoo-lite"],
+                   help="optimizer family.  'auto' (default) keeps the "
+                        "legacy flag-driven choice (--use_lars / "
+                        "--opt_exp&co -> quant_sgd, else sgd).  "
+                        "'shampoo-lite' is the second-order optimizer "
+                        "riding the quantized ring (ISSUE 15, "
+                        "train/optim.py ShampooLite): per-leaf Gram "
+                        "statistics through the eXmY Kahan qgemm, "
+                        "cross-replica statistics reduced over the "
+                        "quantized ring, L^-1/4 G R^-1/4 "
+                        "preconditioning grafted to the SGD norm")
+    p.add_argument("--shampoo-stat-exp", default=8, type=int,
+                   help="eXmY exponent bits of the Shampoo-lite Gram "
+                        "statistics (8,23 = fp32 statistics)")
+    p.add_argument("--shampoo-stat-man", default=23, type=int,
+                   help="eXmY mantissa bits of the Shampoo-lite Gram "
+                        "statistics")
+    p.add_argument("--shampoo-stat-mode", default="ring",
+                   choices=["ring", "gather"],
+                   help="transport of the cross-replica statistics "
+                        "reduction: quantized ring (default) or "
+                        "all_gather + ordered scan")
     p.add_argument("-e", "--evaluate", action="store_true")
     p.add_argument("--emulate_node", default=1, type=int)
     # YAML-backed keys (mix.py:69-72 merges the YAML onto args); a CLI
@@ -180,7 +204,55 @@ def main(argv=None) -> dict:
         # would silently drop SR (quant_sgd's (8,23) identity shortcut)
         raise SystemExit("--opt-rounding stochastic needs a quantized "
                          "buffer (--opt_exp/--opt_man below fp32)")
-    opt_name = ("lars" if args.use_lars else
+    shampoo_on = args.optimizer == "shampoo-lite"
+    if shampoo_on:
+        # the ShampooLite updater owns the optimizer math AND the
+        # collective (reduce_in_update, like the ZeRO updaters) — the
+        # optax-chain knobs cannot ride along
+        if args.use_lars or quant_opt:
+            raise SystemExit("--optimizer shampoo-lite is exclusive "
+                             "with --use_lars and the quantized "
+                             "momentum flags (--opt_exp/--opt_man/"
+                             "--opt_kahan)")
+        if args.clip_grad is not None:
+            raise SystemExit("--clip-grad runs inside the optax chain, "
+                             "which the ShampooLite updater bypasses")
+        if args.overlap_reduce:
+            raise SystemExit("--overlap-reduce does not compose with "
+                             "--optimizer shampoo-lite (the updater "
+                             "owns the collective; only the ZeRO-2 "
+                             "updater has a tap hook)")
+        if args.bucket_elems is not None:
+            raise SystemExit("--bucket-elems does not compose with "
+                             "--optimizer shampoo-lite: the step hands "
+                             "the updater its quant kwargs without the "
+                             "bucket layout, so the requested bucketed "
+                             "transport would silently never run")
+    if not shampoo_on and (
+            (args.shampoo_stat_exp, args.shampoo_stat_man) != (8, 23)
+            or args.shampoo_stat_mode != "ring"):
+        # same loud-rejection rule as --opt_exp below: statistics-format
+        # flags without the optimizer that consumes them must not
+        # silently vanish
+        raise SystemExit("--shampoo-stat-exp/--shampoo-stat-man/"
+                         "--shampoo-stat-mode need --optimizer "
+                         "shampoo-lite; any other optimizer would "
+                         "silently ignore them")
+    if args.optimizer not in ("auto", "shampoo-lite"):
+        if args.use_lars and args.optimizer != "lars":
+            raise SystemExit("--use_lars contradicts --optimizer "
+                             f"{args.optimizer}")
+        if quant_opt and args.optimizer != "quant_sgd":
+            # under 'auto' these flags SELECT quant_sgd; an explicit
+            # other optimizer would silently drop them — the numerics
+            # the user asked for must not vanish without a word
+            raise SystemExit(f"--opt_exp/--opt_man/--opt_kahan need "
+                             f"the quantized momentum buffer; "
+                             f"--optimizer {args.optimizer} would "
+                             f"ignore them (use quant_sgd or auto)")
+    opt_name = (args.optimizer if args.optimizer not in ("auto",
+                                                         "shampoo-lite")
+                else "lars" if args.use_lars else
                 "quant_sgd" if quant_opt else "sgd")
     tx = make_optimizer(opt_name, schedule, momentum=args.momentum,
                         weight_decay=args.weight_decay,
@@ -196,24 +268,27 @@ def main(argv=None) -> dict:
     # can rewind (the Prefetcher pipeline here cannot).
     from cpd_tpu.utils.config import build_resilience
     res = build_resilience(args, n_steps=total_iter, rank=rank)
-    if res["wraps_optimizer"] and (args.zero1 or args.zero2):
-        # watchdog / sentinel / host-level faults compose fine with ZeRO;
-        # only the optimizer WRAPPERS (guard, grad-fault injection) don't
+    if res["wraps_optimizer"] and (args.zero1 or args.zero2
+                                   or shampoo_on):
+        # watchdog / sentinel / host-level faults compose fine with ZeRO
+        # and Shampoo-lite; only the optimizer WRAPPERS (guard,
+        # grad-fault injection) don't
         raise SystemExit("--guard-grads / grad_* faults do not compose "
-                         "with the ZeRO updaters (custom update_fn owns "
-                         "the optimizer math the guard would wrap)")
-    if res["verify"] and (args.zero1 or args.zero2):
+                         "with the ZeRO/ShampooLite updaters (custom "
+                         "update_fn owns the optimizer math the guard "
+                         "would wrap)")
+    if res["verify"] and (args.zero1 or args.zero2 or shampoo_on):
         raise SystemExit("--verify-reduce needs the step's own reduction "
                          "and a donate-free state for discard-and-retry; "
-                         "the ZeRO updaters own the collective "
-                         "(reduce_in_update) — run without --zero1/"
-                         "--zero2")
-    if res["quant_stats"] and (args.zero1 or args.zero2):
+                         "the ZeRO/ShampooLite updaters own the "
+                         "collective (reduce_in_update) — run without "
+                         "--zero1/--zero2/--optimizer shampoo-lite")
+    if res["quant_stats"] and (args.zero1 or args.zero2 or shampoo_on):
         raise SystemExit("--precision-ladder/--quant-telemetry need the "
                          "step's own reduction for the wire telemetry; "
-                         "the ZeRO updaters own the collective "
-                         "(reduce_in_update) — run without --zero1/"
-                         "--zero2")
+                         "the ZeRO/ShampooLite updaters own the "
+                         "collective (reduce_in_update) — run without "
+                         "--zero1/--zero2/--optimizer shampoo-lite")
     # ISSUE 12 lifted the PR 8 fail-fasts: --bucket-elems/--overlap-reduce
     # compose with --zero1 (the update slices the step's fully-reduced
     # grads) AND --zero2 (zero2_sgd(bucket_elems=...) adopts the bucketed
@@ -262,6 +337,20 @@ def main(argv=None) -> dict:
     state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),
                                jax.random.PRNGKey(seed))
     zero = None
+    shampoo = None
+    if shampoo_on:
+        if args.zero1 or args.zero2:
+            raise SystemExit("--optimizer shampoo-lite and --zero1/"
+                             "--zero2 are mutually exclusive (one "
+                             "custom updater per step)")
+        from cpd_tpu.train import shampoo_lite
+        shampoo = shampoo_lite(
+            schedule, world=n_dev, momentum=args.momentum,
+            weight_decay=args.weight_decay,
+            stat_exp=args.shampoo_stat_exp,
+            stat_man=args.shampoo_stat_man,
+            stat_mode=args.shampoo_stat_mode)
+        state = state.replace(opt_state=shampoo.init(state.params))
     if args.zero1 and args.zero2:
         raise SystemExit("--zero1/--zero2 are mutually exclusive")
     if args.zero1 or args.zero2:
@@ -363,7 +452,10 @@ def main(argv=None) -> dict:
     # orbax restores arrays committed to a single device; the train step's
     # shard_map needs the state laid out over the mesh (replicated, except
     # the ZeRO momentum which is dp-sharded)
-    if zero is None:
+    if shampoo is not None:
+        state, extra = shampoo.mesh_layout(state, mesh)
+        to_ckpt = shampoo.export_state
+    elif zero is None:
         state = replicate(state, mesh)
         extra = {}
         to_ckpt = lambda st: st                               # noqa: E731

@@ -81,13 +81,26 @@ def test_good_fixture_registry_is_a_true_negative(rule_id):
 # the live registry
 # ---------------------------------------------------------------------------
 
-# the cheap live subset for tier-1 (~10 s of tracing): every
-# wire-ledger-bearing arm plus the serve programs.  The train-step
-# twins (8 heavier step traces) ride the slow tier + the CI
-# ir-contracts gate via test_live_registry_full.
+# the cheap live subset for tier-1 (~15 s of tracing): every
+# wire-ledger-bearing arm — including the ISSUE 15 linalg programs —
+# plus the serve programs.  The train-step twins (8 heavier step
+# traces) ride the slow tier + the CI ir-contracts gate via
+# test_live_registry_full.
 FAST_PROVIDERS = ("cpd_tpu.parallel.reduction", "cpd_tpu.parallel.ring",
                   "cpd_tpu.parallel.overlap", "cpd_tpu.parallel.zero",
+                  "cpd_tpu.linalg.blockmm", "cpd_tpu.linalg.qr",
+                  "cpd_tpu.linalg.eigen",
                   "cpd_tpu.serve.model")
+
+# the linalg subsystem's declared programs (ISSUE 15 satellite: pinned
+# by name, so a silently dropped declaration shrinks no gate unnoticed)
+LINALG_PROGRAMS = {
+    "linalg.matmul[ring,e5m2,g1x8]",
+    "linalg.matmul[gather,e4m3,kahan,g1x8]",
+    "linalg.qr[cholqr2,ring,e5m7,w8]",
+    "linalg.power[ring,e5m2,w8,it3]",
+    "linalg.lanczos[ring,e5m2,w8,s4]",
+}
 
 
 def test_live_fast_subset_is_clean_and_ledger_matches():
@@ -97,22 +110,28 @@ def test_live_fast_subset_is_clean_and_ledger_matches():
                                 for f in res.findings]
     # the ledger rule ran against real analytic contracts: every
     # wire-bearing arm must be present (ring plain/kahan/blocked,
-    # gather fp32/packed, zero2 plain/blocked, overlap twins)
+    # gather fp32/packed, zero2 plain/blocked, overlap twins, and the
+    # 5 linalg arms — all wire-priced AND bitwise-contracted)
     reg = collect_programs(FAST_PROVIDERS)
-    wired = [s.name for s in reg.specs if s.wire is not None]
-    assert len(wired) >= 9, wired
+    wired = {s.name for s in reg.specs if s.wire is not None}
+    assert len(wired) >= 14, sorted(wired)
+    assert LINALG_PROGRAMS <= {s.name for s in reg.specs}, \
+        sorted(s.name for s in reg.specs)
+    assert all(s.bitwise and s.wire is not None
+               for s in reg.specs if s.name in LINALG_PROGRAMS)
 
 
 @pytest.mark.slow
 def test_live_registry_full_is_clean():
     """The acceptance gate: the FULL default registry — train-step and
-    LM twins included — traces and passes every program rule."""
+    LM twins included — traces and passes every program rule.  30 live
+    programs on this pin (25 from PR 14 + the 5 linalg declarations)."""
     res = run_ir(use_cache=False)
     assert res.trace_failures == 0, [(f.rule, f.message)
                                      for f in res.findings]
     assert res.findings == [], [(f.rule, f.message)
                                 for f in res.findings]
-    assert res.programs_checked >= 20
+    assert res.programs_checked >= 30
 
 
 def test_zero2_transport_bytes_matches_real_packed_buffers():

@@ -100,6 +100,51 @@ def test_resnet18_trainer_quant_optimizer_smoke(tiny_cifar, tmp_path):
     assert math.isfinite(res["loss"])
 
 
+def test_resnet18_trainer_shampoo_lite_smoke(tiny_cifar, tmp_path):
+    """--optimizer shampoo-lite at e5m7 ring statistics (ISSUE 15):
+    the second-order updater owns the collective (reduce_in_update,
+    like ZeRO) and the smoke must train inside the pinned loss
+    envelope — CE for 10 classes starts at ln(10) ~= 2.303; a broken
+    preconditioner (wrong grafting scale, bad inverse root) blows
+    straight past it in the first steps."""
+    from resnet18_cifar.train import main
+
+    res = main(["--optimizer", "shampoo-lite",
+                "--shampoo-stat-exp", "5", "--shampoo-stat-man", "7",
+                "--arch", "tiny", "--data-root", tiny_cifar,
+                "--max-iter", "3", "--batch_size", "2",
+                "--val_freq", "3", "--use_kahan",
+                "--save_path", str(tmp_path / "ck")])
+    assert res["step"] == 3
+    assert math.isfinite(res["loss"])
+    assert res["loss"] <= 2.6, \
+        f"shampoo-lite smoke loss {res['loss']:.3f} outside the " \
+        f"pinned envelope (measured ~2.30 on this fixture)"
+    assert not res["diverged"]
+
+
+def test_resnet18_shampoo_lite_flag_conflicts(tiny_cifar, tmp_path):
+    from resnet18_cifar.train import main
+
+    base = ["--optimizer", "shampoo-lite", "--arch", "tiny",
+            "--data-root", tiny_cifar, "--max-iter", "1",
+            "--batch_size", "2", "--val_freq", "1",
+            "--save_path", str(tmp_path / "ck")]
+    for bad in (["--use_lars"], ["--opt_exp", "5", "--opt_man", "2"],
+                ["--zero1"], ["--clip-grad", "1.0"],
+                ["--overlap-reduce"], ["--bucket-elems", "4096"]):
+        with pytest.raises(SystemExit):
+            main(base + bad)
+    # review regression: an explicit non-quant optimizer must not
+    # silently drop the quantized-momentum flags (auto would have
+    # selected quant_sgd for them)
+    with pytest.raises(SystemExit, match="ignore"):
+        main(["--optimizer", "sgd", "--opt_exp", "5", "--opt_man", "2",
+              "--arch", "tiny", "--data-root", tiny_cifar,
+              "--max-iter", "1", "--batch_size", "2", "--val_freq", "1",
+              "--save_path", str(tmp_path / "ck2")])
+
+
 def test_resnet18_trainer_evaluate_flag(tiny_cifar):
     from resnet18_cifar.train import main
 

@@ -1,0 +1,464 @@
+"""cpd_tpu.linalg — quantized distributed linear algebra (ISSUE 15).
+
+Layers under test, mirroring the ring's oracle doctrine:
+
+1. BITWISE oracle parity: the sharded block matmul / CholeskyQR2 /
+   power iteration / Lanczos must equal their single-device oracles
+   bit-for-bit across formats x transports x Kahan/SR/block-scaled —
+   the distributed path and the oracle share every numerics helper, so
+   a divergence can only be the wire (or a cross-program lowering
+   instability, the FMA/reduction-order class `linalg.eigen`'s fenced
+   recurrences exist to kill);
+2. the shard/pad paths training shapes never hit: non-divisible tile
+   tails, non-square (1xW / Wx1) grids, odd row counts, Lanczos with
+   more steps than a device's chunk edge;
+3. measured accuracy vs fp64 oracles inside the documented per-format
+   bounds (the frontier tools/bench_linalg.py records);
+4. Shampoo-lite: distributed update bitwise == the replicated
+   fp32-statistics monolith oracle, x2 deterministic, quantized-stats
+   arms included (train/optim.py);
+5. the `qgemm` (exp, man)-consistent surface == the `quant_gemm`
+   back-compat shim, and the `cpd_linalg_*` obs family.
+
+Runs on the conftest 8-device virtual CPU mesh.  The broad
+format x world matrices live in the slow tier; the fast tier keeps one
+representative arm per mechanism.
+"""
+
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpd_tpu.linalg import (BlockLayout, EIG_REL_BOUNDS, QR_ORTHO_BOUNDS,
+                            REL_ERROR_BOUNDS, block_matmul,
+                            block_matmul_oracle, cholesky_qr2,
+                            cholesky_qr2_oracle, inv_root_psd,
+                            lanczos_topk, lanczos_topk_oracle,
+                            matmul_rel_error, power_iteration,
+                            power_iteration_oracle, qr_error_metrics)
+from cpd_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+
+
+def _load_bench_linalg():
+    """tools/bench_linalg.py owns the probe operands, the documented
+    bound scale, and the distributed-Shampoo harness — ONE home, so
+    the CI gate and this tier can never validate different probes."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "bench_linalg.py")
+    spec = importlib.util.spec_from_file_location("bench_linalg", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+BL = _load_bench_linalg()
+M, K, N = BL.MM_SHAPE
+TILE_M, TILE_K = BL.MM_TILES   # tails on every tiled edge
+
+
+def _bits_eq(a, b) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a.view(np.uint32),
+                                                 b.view(np.uint32))
+
+
+def _tree_bits_eq(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(_bits_eq(x, y)
+                                      for x, y in zip(la, lb))
+
+
+@pytest.fixture(scope="module")
+def mm_ops():
+    return BL._mm_operands()
+
+
+@pytest.fixture(scope="module")
+def qr_op():
+    return BL._qr_operand()
+
+
+@pytest.fixture(scope="module")
+def sym_op():
+    return BL._eig_operand()
+
+
+# ---------------------------------------------------------------------------
+# block matmul
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fmt,red,kw", [
+    ((5, 2), "ring", {}),
+    ((8, 23), "ring", {}),
+    pytest.param((4, 3), "gather", dict(use_kahan=True),
+                 marks=pytest.mark.slow),
+    pytest.param((4, 3), "ring", dict(block_scale=True, block_size=8),
+                 marks=pytest.mark.slow),
+])
+def test_block_matmul_oracle_parity(mm_ops, fmt, red, kw):
+    a, b = mm_ops
+    mesh = make_mesh(dp=2, tp=4)
+    lay = BlockLayout(M, K, N, 2, 4, TILE_M, TILE_K)
+    got = block_matmul(a, b, mesh, *fmt, reduce=red, layout=lay, **kw)
+    want = block_matmul_oracle(a, b, lay, *fmt, reduce=red, **kw)
+    assert _bits_eq(got, want)
+    assert matmul_rel_error(got, a, b) <= REL_ERROR_BOUNDS[fmt]
+
+
+@pytest.mark.slow
+def test_block_matmul_sr_parity_and_key_determinism(mm_ops):
+    a, b = mm_ops
+    mesh = make_mesh(dp=2, tp=4)
+    lay = BlockLayout(M, K, N, 2, 4, TILE_M, TILE_K)
+    kw = dict(rounding="stochastic", key=jax.random.PRNGKey(7))
+    got = block_matmul(a, b, mesh, 5, 7, reduce="ring", layout=lay, **kw)
+    want = block_matmul_oracle(a, b, lay, 5, 7, reduce="ring", **kw)
+    assert _bits_eq(got, want)
+    # same key -> same bits; different key -> different rounding
+    again = block_matmul(a, b, mesh, 5, 7, reduce="ring", layout=lay,
+                         **kw)
+    assert _bits_eq(got, again)
+    other = block_matmul(a, b, mesh, 5, 7, reduce="ring", layout=lay,
+                         rounding="stochastic",
+                         key=jax.random.PRNGKey(8))
+    assert not _bits_eq(got, other)
+
+
+@pytest.mark.parametrize("grid", [
+    (1, 8), pytest.param((4, 1), marks=pytest.mark.slow)])
+def test_block_matmul_nonsquare_grids(mm_ops, grid):
+    """1xW (pure K-reduction) and Wx1 (pure row parallelism, a
+    world-1 column ring) — the degenerate grids the 2D scheme must
+    still reproduce bit-for-bit."""
+    a, b = mm_ops
+    gr, gc = grid
+    mesh = make_mesh(dp=gr, tp=gc, devices=jax.devices()[:gr * gc])
+    lay = BlockLayout(M, K, N, gr, gc, TILE_M, TILE_K)
+    got = block_matmul(a, b, mesh, 5, 2, reduce="ring", layout=lay)
+    want = block_matmul_oracle(a, b, lay, 5, 2, reduce="ring")
+    assert _bits_eq(got, want)
+
+
+def test_block_layout_packing_roundtrip():
+    """The cyclic deal: pack_a places global row tile i on grid row
+    i % grid_r, slot i // grid_r (and the K mirror); unpack_c inverts
+    it exactly."""
+    lay = BlockLayout(m=10, k=12, n=3, grid_r=2, grid_c=2,
+                      tile_m=3, tile_k=5)
+    a = np.arange(10 * 12, dtype=np.float32).reshape(10, 12)
+    packed = np.asarray(lay.pack_a(jnp.asarray(a)))
+    a_pad = np.zeros((lay.m_pad, lay.k_pad), np.float32)
+    a_pad[:10, :12] = a
+    for i in range(lay.row_tiles):
+        for j in range(lay.k_tiles):
+            r, ii = i % 2, i // 2
+            c, jj = j % 2, j // 2
+            np.testing.assert_array_equal(
+                packed[r, c, ii, jj],
+                a_pad[i * 3:(i + 1) * 3, j * 5:(j + 1) * 5])
+    # unpack round-trips a device-major identity layout
+    c_dev = jnp.asarray(np.arange(2 * lay.tiles_per_row_dev * 3 * 3,
+                                  dtype=np.float32).reshape(
+        2, lay.tiles_per_row_dev, 3, 3))
+    un = np.asarray(lay.unpack_c(c_dev))
+    assert un.shape == (10, 3)
+
+
+def test_block_matmul_validation(mm_ops):
+    a, b = mm_ops
+    mesh = make_mesh(dp=2, tp=4)
+    with pytest.raises(ValueError, match="unknown reduce"):
+        block_matmul(a, b, mesh, 5, 2, reduce="psum")
+    with pytest.raises(ValueError, match="requires a PRNG key"):
+        block_matmul(a, b, mesh, 5, 2, rounding="stochastic")
+    with pytest.raises(ValueError, match="nearest"):
+        block_matmul(a, b, mesh, 5, 2, key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="nothing to scale"):
+        block_matmul(a, b, mesh, 8, 23, block_scale=True)
+    with pytest.raises(ValueError, match="mesh"):
+        lay = BlockLayout(M, K, N, 4, 2, TILE_M, TILE_K)  # grid flipped
+        block_matmul(a, b, mesh, 5, 2, layout=lay)
+    with pytest.raises(ValueError, match="expects"):
+        block_matmul(a, b.T, mesh, 5, 2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("fmt,kw", [
+    ((5, 2), {}), ((5, 7), dict(use_kahan=True)),
+    ((4, 3), dict(rounding="stochastic", key=jax.random.PRNGKey(1))),
+])
+def test_block_matmul_parity_matrix(mm_ops, world, fmt, kw):
+    """The acceptance matrix: formats x W in {2,4,8} x RTNE/SR/Kahan,
+    ring transport, 1xW grids (the K-reduction is the wire)."""
+    a, b = mm_ops
+    mesh = make_mesh(dp=1, tp=world, devices=jax.devices()[:world])
+    lay = BlockLayout(M, K, N, 1, world, TILE_M, TILE_K)
+    got = block_matmul(a, b, mesh, *fmt, reduce="ring", layout=lay, **kw)
+    want = block_matmul_oracle(a, b, lay, *fmt, reduce="ring", **kw)
+    assert _bits_eq(got, want)
+
+
+# ---------------------------------------------------------------------------
+# CholeskyQR2
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fmt,red,kw", [
+    ((5, 7), "ring", {}),
+    pytest.param((4, 3), "gather", dict(use_kahan=True),
+                 marks=pytest.mark.slow),
+    ((8, 23), "ring", {}),
+])
+def test_cholesky_qr2_oracle_parity(qr_op, fmt, red, kw):
+    mesh = data_parallel_mesh()
+    q, r = cholesky_qr2(qr_op, mesh, *fmt, reduce=red, **kw)
+    qo, ro = cholesky_qr2_oracle(qr_op, 8, *fmt, reduce=red, **kw)
+    assert _bits_eq(q, qo) and _bits_eq(r, ro)
+    met = qr_error_metrics(q, r, qr_op)
+    assert met["orthogonality"] <= QR_ORTHO_BOUNDS[fmt]
+    assert met["residual"] <= QR_ORTHO_BOUNDS[fmt]
+    assert np.allclose(np.asarray(r), np.triu(np.asarray(r)))
+
+
+def test_cholesky_qr2_odd_rows_pad_path(qr_op):
+    """m=37 over W=8: 5 local rows with a zero-padded tail — the pad
+    rows must stay exactly zero through both passes."""
+    a = qr_op[:37]
+    mesh = data_parallel_mesh()
+    q, r = cholesky_qr2(a, mesh, 5, 7, reduce="ring")
+    qo, ro = cholesky_qr2_oracle(a, 8, 5, 7, reduce="ring")
+    assert _bits_eq(q, qo) and _bits_eq(r, ro)
+    assert q.shape == (37, 8)
+
+
+@pytest.mark.slow
+def test_cholesky_qr2_single_pass_is_classic_cholqr(qr_op):
+    """passes=1 = classic CholeskyQR: worse orthogonality than the
+    2-pass default at a sub-fp32 format, still oracle-exact."""
+    mesh = data_parallel_mesh()
+    q1, r1 = cholesky_qr2(qr_op, mesh, 4, 3, passes=1)
+    qo, ro = cholesky_qr2_oracle(qr_op, 8, 4, 3, passes=1)
+    assert _bits_eq(q1, qo) and _bits_eq(r1, ro)
+    q2, _ = cholesky_qr2(qr_op, mesh, 4, 3)
+    m1 = qr_error_metrics(q1, r1, qr_op)["orthogonality"]
+    m2 = qr_error_metrics(q2, _ , qr_op)["orthogonality"]
+    assert m2 <= m1 * 1.5  # second pass never substantially worse
+
+
+# ---------------------------------------------------------------------------
+# power iteration / Lanczos
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_power_iteration_oracle_parity_and_accuracy(sym_op):
+    """(Slow tier: the linalg-smoke CI gate runs the fast power arm.)"""
+    mesh = data_parallel_mesh()
+    ev = np.linalg.eigvalsh(sym_op.astype(np.float64))[::-1]
+    lam, x = power_iteration(sym_op, mesh, 5, 7, iters=14)
+    lo, xo = power_iteration_oracle(sym_op, 8, 5, 7, iters=14)
+    assert _bits_eq(lam, lo) and _bits_eq(x, xo)
+    assert abs(float(lam) - ev[0]) / abs(ev[0]) <= EIG_REL_BOUNDS[(5, 7)]
+    assert x.shape == (sym_op.shape[0],)
+
+
+@pytest.mark.slow
+def test_lanczos_topk_oracle_parity_and_accuracy(sym_op):
+    """(Slow tier with its power/steps siblings: the linalg-smoke CI
+    gate runs the fast lanczos arm every push.)"""
+    mesh = data_parallel_mesh()
+    ev = np.linalg.eigvalsh(sym_op.astype(np.float64))[::-1]
+    vals, vecs = lanczos_topk(sym_op, mesh, 5, 2, k=3, steps=8)
+    valso, vecso = lanczos_topk_oracle(sym_op, 8, 5, 2, k=3, steps=8)
+    assert _bits_eq(vals, valso) and _bits_eq(vecs, vecso)
+    assert vals.shape == (3,) and vecs.shape == (sym_op.shape[0], 3)
+    rel = abs(float(vals[0]) - ev[0]) / abs(ev[0])
+    assert rel <= EIG_REL_BOUNDS[(5, 2)]
+    # Ritz values come out DESCENDING
+    v = np.asarray(vals)
+    assert np.all(v[:-1] >= v[1:] - 1e-6)
+
+
+@pytest.mark.slow
+def test_lanczos_steps_beyond_chunk_edge(sym_op):
+    """steps=10 > n_pad/W = 3: the Krylov loop runs far past a
+    device's chunk edge.  (The fast-tier parity test already crosses
+    the edge at steps=8 > 3; this slow arm pushes deeper with a
+    different format.)"""
+    mesh = data_parallel_mesh()
+    vals, vecs = lanczos_topk(sym_op, mesh, 5, 7, k=4, steps=10)
+    valso, vecso = lanczos_topk_oracle(sym_op, 8, 5, 7, k=4, steps=10)
+    assert _bits_eq(vals, valso) and _bits_eq(vecs, vecso)
+
+
+def test_lanczos_validation(sym_op):
+    mesh = data_parallel_mesh()
+    with pytest.raises(ValueError, match="k must be"):
+        lanczos_topk(sym_op, mesh, 5, 7, k=0)
+    with pytest.raises(ValueError, match="Krylov basis"):
+        lanczos_topk(sym_op, mesh, 5, 7, k=4, steps=2)
+    with pytest.raises(ValueError, match="square"):
+        power_iteration(np.zeros((4, 6), np.float32), mesh, 5, 7)
+
+
+def test_lanczos_single_step_degenerate(sym_op):
+    """steps=1 (review regression): T is the 1x1 [alpha_0] — the
+    off-diagonal stack of an empty betas list used to crash."""
+    vals, vecs = lanczos_topk_oracle(sym_op, 2, 8, 23, k=1, steps=1)
+    assert vals.shape == (1,) and np.isfinite(float(vals[0]))
+    assert vecs.shape == (sym_op.shape[0], 1)
+
+
+def test_lanczos_breakdown_stays_finite():
+    """Review regression: an exactly-invariant Krylov space (scaled
+    identity — every start vector is an eigenvector) breaks down with
+    beta == 0 after one step; the guarded recurrence must return
+    FINITE Ritz values with the converged leading eigenvalue, never
+    silently NaN.  steps > n is rejected loudly."""
+    s = 3.0 * np.eye(8, dtype=np.float32)
+    vals, vecs = lanczos_topk_oracle(s, 2, 8, 23, k=2, steps=4)
+    assert np.all(np.isfinite(np.asarray(vals)))
+    assert abs(float(vals[0]) - 3.0) < 1e-5
+    assert np.all(np.isfinite(np.asarray(vecs)))
+    with pytest.raises(ValueError, match="saturates"):
+        lanczos_topk_oracle(s, 2, 8, 23, k=2, steps=9)
+
+
+def test_inv_root_psd_sqrt_chain():
+    """G^(-1/4) via eigh + sqrt chain: exact on a diagonal PSD matrix,
+    p outside {2, 4} rejected (pow is the banned primitive class)."""
+    g = jnp.diag(jnp.asarray([16.0, 81.0, 1.0], jnp.float32))
+    r4 = np.asarray(inv_root_psd(g, p=4, eps=0.0))
+    np.testing.assert_allclose(np.diag(r4), [0.5, 1.0 / 3.0, 1.0],
+                               rtol=1e-6)
+    r2 = np.asarray(inv_root_psd(g, p=2, eps=0.0))
+    np.testing.assert_allclose(np.diag(r2), [0.25, 1.0 / 9.0, 1.0],
+                               rtol=1e-6)
+    with pytest.raises(ValueError, match="p must be 2 or 4"):
+        inv_root_psd(g, p=3)
+
+
+# ---------------------------------------------------------------------------
+# Shampoo-lite
+# ---------------------------------------------------------------------------
+
+# the shampoo probe tree and the distributed shard_map harness are
+# bench_linalg's (_shampoo_operands / make_shampoo_step / _FakeState)
+# — shared verbatim with the linalg-smoke CI gate
+_St = BL._FakeState
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stat_fmt,stat_mode,gkw", [
+    ((8, 23), "ring", dict(grad_exp=8, grad_man=23, use_kahan=True)),
+    ((5, 7), "ring", dict(grad_exp=5, grad_man=7)),
+    ((4, 3), "gather", dict(grad_exp=4, grad_man=3)),
+])
+def test_shampoo_distributed_matches_monolith_oracle(stat_fmt, stat_mode,
+                                                     gkw):
+    """The acceptance gate: the distributed Shampoo-lite update — grads
+    through the step's ordered reduce, Gram statistics over the
+    quantized ring — bitwise == the single-device replicated monolith,
+    and x2 deterministic.  (The (8,23) arm rides the Kahan reduce: the
+    non-Kahan fp32 faithful path is the documented XLA-order psum
+    shortcut, unordered by reference parity.)"""
+    from cpd_tpu.train.optim import shampoo_lite
+    W, params, stacked = BL._shampoo_operands()
+    schedule = lambda step: jnp.float32(0.1)        # noqa: E731
+    sh = shampoo_lite(schedule, W, momentum=0.9, weight_decay=1e-4,
+                      stat_exp=stat_fmt[0], stat_man=stat_fmt[1],
+                      stat_mode=stat_mode, max_precond_dim=64)
+    fn, opt0 = BL.make_shampoo_step(sh, params, stacked, gkw)
+    p1, o1 = fn(stacked)
+    p2, o2 = fn(stacked)
+    po, oo = sh.oracle_update(stacked, _St(params, opt0), **gkw)
+    assert _tree_bits_eq(p1, p2) and _tree_bits_eq(o1, o2)
+    assert _tree_bits_eq(p1, po) and _tree_bits_eq(o1, oo)
+
+
+def test_shampoo_state_shapes_and_fallback_leaves():
+    """Precondable leaves get (p,p)/(q,q) Gram stats; 1D and oversized
+    leaves fall back to the plain direction (first step, zero momentum:
+    update = -lr * g exactly for fenced fp32 math)."""
+    from cpd_tpu.train.optim import shampoo_lite
+    params = {"w": jnp.ones((4, 3), jnp.float32),
+              "huge": jnp.ones((4, 300), jnp.float32),   # q > cap
+              "b": jnp.ones((5,), jnp.float32)}
+    sh = shampoo_lite(lambda s: jnp.float32(0.5), world=8,
+                      momentum=0.9, weight_decay=0.0,
+                      max_precond_dim=64)
+    opt = sh.init(params)
+    assert len(opt.stats_l) == 1 and opt.stats_l[0].shape == (4, 4)
+    assert opt.stats_r[0].shape == (3, 3)
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 0.25), params)
+    stats = sh._local_gram_flat(grads)
+    assert stats.shape == (4 * 4 + 3 * 3,)
+    newp, newopt = sh._apply(grads, _St(params, opt), stats)
+    np.testing.assert_allclose(np.asarray(newp["b"]),
+                               1.0 - 0.5 * 0.25, rtol=0)
+    np.testing.assert_allclose(np.asarray(newp["huge"]),
+                               1.0 - 0.5 * 0.25, rtol=0)
+    assert int(newopt.step) == 1
+
+
+def test_shampoo_validation():
+    from cpd_tpu.train.optim import shampoo_lite
+    with pytest.raises(ValueError, match="unknown stat_mode"):
+        shampoo_lite(lambda s: 0.1, world=8, stat_mode="psum")
+    with pytest.raises(ValueError, match="packable statistics"):
+        shampoo_lite(lambda s: 0.1, world=8, stat_exp=5, stat_man=1)
+    sh = shampoo_lite(lambda s: 0.1, world=8)
+    with pytest.raises(ValueError, match="reduce_in_update"):
+        sh.update_fn({}, None, "dp")
+    # review regression: the monolith oracle must REJECT quant kwargs
+    # it cannot replay (ring/SR/APS/blocked), never silently ignore
+    # them — a wrong oracle is worse than no oracle
+    with pytest.raises(ValueError, match="unsupported kwargs"):
+        sh.oracle_update({}, None, grad_exp=5, grad_man=7,
+                         rounding="stochastic", key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="faithful"):
+        sh.oracle_update({}, None, grad_exp=5, grad_man=7, mode="ring")
+
+
+# ---------------------------------------------------------------------------
+# qgemm surface + obs family
+# ---------------------------------------------------------------------------
+
+def test_qgemm_consistent_surface_matches_shim():
+    """`qgemm(a, b, exp=, man=)` == `quant_gemm(a, b, man=, exp=)`
+    bitwise for every mode — one `_quant_gemm_impl` body; positional
+    orders differ exactly as documented."""
+    from cpd_tpu.quant import (qgemm, qgemm_stats, quant_gemm,
+                               quant_gemm_stats)
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(6, 10).astype(np.float32))
+    b = jnp.asarray(rng.randn(10, 4).astype(np.float32))
+    for mode in ("faithful", "fast"):
+        got = qgemm(a, b, exp=5, man=2, mode=mode)
+        want = quant_gemm(a, b, man=2, exp=5, mode=mode)
+        assert _bits_eq(got, want)
+    # positional: qgemm is (exp, man); quant_gemm stays (man, exp)
+    assert _bits_eq(qgemm(a, b, 5, 2), quant_gemm(a, b, 2, 5))
+    gs, hs = qgemm_stats(a, b, exp=4, man=3)
+    gw, hw = quant_gemm_stats(a, b, man=3, exp=4)
+    assert _bits_eq(gs, gw)
+    assert all(_bits_eq(hs[k], hw[k]) for k in hs)
+
+
+def test_absorb_linalg_counters_naming():
+    from cpd_tpu.obs.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.absorb_linalg_counters({"rel_err_fp64": 0.01, "skip": "nan-str"},
+                               algo="matmul", fmt="e5m2")
+    reg.absorb_linalg_counters({"rel_err_fp64": 0.02},
+                               algo="qr", fmt="e4m3")
+    snap = reg.as_dict()
+    assert "cpd_linalg_rel_err_fp64" in snap
+    assert snap["cpd_linalg_rel_err_fp64"]["kind"] == "gauge"
+    series = snap["cpd_linalg_rel_err_fp64"]["value"]
+    assert len(series) == 2           # two (algo, fmt) label sets
+    with pytest.raises(ValueError, match="one home"):
+        reg.inc("cpd_linalg_rel_err_fp64")   # gauge, not counter
