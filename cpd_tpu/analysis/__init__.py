@@ -25,8 +25,8 @@ gate costs milliseconds and runs anywhere — including the minimal CI
 image before heavyweight deps install.
 """
 
-from .core import (Finding, Rule, all_rules, lint_file, lint_source,
-                   lint_tree, module_rules, program_rules,
+from .core import (Finding, Rule, all_rules, host_rules, lint_file,
+                   lint_source, lint_tree, module_rules, program_rules,
                    project_rules, register, render_json, render_text)
 from .config import Config, load_config
 from .engine import AnalysisResult, run_analysis
@@ -34,13 +34,15 @@ from .project import ProjectGraph, ProjectRule
 from .sarif import render_sarif
 
 __all__ = ["Finding", "Rule", "all_rules", "module_rules",
-           "project_rules", "program_rules", "lint_file", "lint_source",
-           "lint_tree", "register", "render_json", "render_text",
-           "render_sarif", "Config", "load_config", "AnalysisResult",
-           "run_analysis", "ProjectGraph", "ProjectRule"]
+           "project_rules", "program_rules", "host_rules", "lint_file",
+           "lint_source", "lint_tree", "register", "render_json",
+           "render_text", "render_sarif", "Config", "load_config",
+           "AnalysisResult", "run_analysis", "ProjectGraph",
+           "ProjectRule"]
 
 # importing the rules packages registers every built-in rule; the
-# program-scope (ir) rule classes are stdlib-only too — jax is touched
-# only when the --ir pass actually traces
+# program-scope (ir) and host-scope rule classes are stdlib-only too —
+# jax is touched only when the --ir pass actually traces
 from . import rules as _rules  # noqa: E402,F401  (registration side effect)
 from .ir import rules as _ir_rules  # noqa: E402,F401  (same)
+from .host import rules as _host_rules  # noqa: E402,F401  (same)

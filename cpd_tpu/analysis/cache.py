@@ -44,7 +44,8 @@ DEFAULT_CACHE_DIR = ".cpd-lint-cache"
 
 # bump on ANY change to summary extraction, Finding shape, or rule logic
 # that could alter cached results for an unchanged file
-SCHEMA_VERSION = 4
+# (5: the host scope — per-file cached findings now include host rules)
+SCHEMA_VERSION = 5
 
 
 def ruleset_hash(rule_ids, config_fingerprint: str = "") -> str:

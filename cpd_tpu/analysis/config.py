@@ -48,6 +48,13 @@ _DEFAULT_EXEMPT = {
     # ProgressPrinter / format_validation_line) that draw_curve.py
     # greps — legacy by design, exempt from the obs-print discipline
     "obs-print": ("cpd_tpu/utils/logging.py",),
+    # obs/timing.py IS the one clock — the only file allowed to read
+    # time.perf_counter/time.time directly (host scope, ISSUE 16)
+    "host-clock": ("cpd_tpu/obs/timing.py",),
+    # the analyzer is a batch CLI process: its graphs/caches are
+    # bounded by the size of the linted tree and freed at exit — not
+    # step/request-clock growth on a long-lived host object
+    "host-unbounded": ("cpd_tpu/analysis/",),
 }
 
 

@@ -20,7 +20,7 @@ import tokenize
 from typing import Callable, Iterable, Iterator, Optional
 
 __all__ = ["Finding", "ModuleContext", "Rule", "register", "all_rules",
-           "module_rules", "project_rules", "program_rules",
+           "module_rules", "project_rules", "program_rules", "host_rules",
            "lint_source", "lint_file", "lint_tree", "lint_parsed",
            "run_project_rules", "run_program_rules_on",
            "render_text", "render_json"]
@@ -147,10 +147,14 @@ class ModuleContext:
 class Rule:
     """Base class; subclasses set ``id``/``summary`` and implement
     ``check``.  ``scope`` is "module" (check(ctx) per parsed file),
-    "project" (check(project) once per run, over the whole-program graph
-    — see analysis/project.py's ProjectRule), or "program"
-    (check(programs) over the traced-jaxpr facts of the registered
-    compiled programs — analysis/ir/, run only under ``--ir``)."""
+    "host" (check(ctx) per parsed file too, but running the per-class
+    host-runtime dataflow of analysis/host/ — thread-safety, bounded
+    growth, resource lifecycle, one-clock), "project" (check(project)
+    once per run, over the whole-program graph — see
+    analysis/project.py's ProjectRule), or "program" (check(programs)
+    over the traced-jaxpr facts of the registered compiled programs —
+    analysis/ir/, run only under ``--ir``).  Module and host rules ride
+    the same per-file fingerprint cache entry."""
 
     id: str = ""
     summary: str = ""
@@ -188,6 +192,10 @@ def project_rules() -> dict[str, Rule]:
 
 def program_rules() -> dict[str, Rule]:
     return {k: r for k, r in _REGISTRY.items() if r.scope == "program"}
+
+
+def host_rules() -> dict[str, Rule]:
+    return {k: r for k, r in _REGISTRY.items() if r.scope == "host"}
 
 
 # ---------------------------------------------------------------------------
@@ -352,7 +360,7 @@ def _stmt_start_map(tree: ast.Module) -> dict[int, int]:
 def lint_parsed(path: str, src: str, tree: ast.Module,
                 select: Optional[Iterable[str]] = None
                 ) -> tuple[list[Finding], dict]:
-    """Module-rule pass over one parsed file.
+    """Module- and host-rule pass over one parsed file.
 
     Returns ``(suppression-filtered findings, module summary)`` — the
     summary (analysis/project.py) carries the whole-program facts PLUS
@@ -381,7 +389,7 @@ def lint_parsed(path: str, src: str, tree: ast.Module,
     wanted = set(select) if select is not None else None
     out: list[Finding] = []
     for rule_id, rule in sorted(_REGISTRY.items()):
-        if rule.scope != "module":
+        if rule.scope not in ("module", "host"):
             continue
         if wanted is not None and rule_id not in wanted:
             continue

@@ -1,0 +1,36 @@
+"""cpd_tpu.analysis.host — the host-runtime contract scope (v4).
+
+The fourth rule scope, beside module/project/program: a lightweight
+per-class dataflow over the repo's long-lived host-side runtime objects
+(engines, routers, supervisors, recorders, schedulers) checking the
+four contract families hand review kept re-finding across the
+serve/fleet/obs/resilience arcs (ISSUE 16):
+
+  host-race       attributes touched both from a thread/Timer callback
+                  and from main-loop methods with inconsistent locking,
+                  and unsynchronized container mutation across threads
+  host-unbounded  module-lifetime containers grown on the step/request
+                  clock with no cap, eviction or prune on any path
+                  (the ResultStore/fleet-control-plane defect class)
+  host-leak       acquire/start without a with/finally-scoped or
+                  class-managed release (open(), profiler windows,
+                  Timer/Thread lifecycles, bare lock acquires)
+  host-clock      wall-clock reads outside obs/timing.py — every timer
+                  rides obs.timing.now()/Stopwatch (durations) or
+                  obs.timing.epoch() (timestamps), the one-clock
+                  doctrine
+
+Host rules carry ``scope = "host"`` and run per *file* inside
+``core.lint_parsed`` right beside the module scope — the dataflow is
+per-class, so no cross-file graph is needed and every verdict rides
+the existing fingerprint cache, suppression grammar, ``[tool.cpd-lint]``
+exemptions, SARIF output and ``--explain`` machinery unchanged
+(SCHEMA_VERSION folds the scope into the cache fingerprint).
+
+Stdlib-only like every other AST scope: ``ast`` in, findings out, no
+jax anywhere — the canary-jax-current job runs this pass too.
+"""
+
+from . import rules  # noqa: F401  (registration side effect)
+
+__all__ = ["rules"]

@@ -1,0 +1,457 @@
+"""Per-class fact extraction for the host scope.
+
+One walk per file, memoized on the ModuleContext, shared by all four
+host rules.  For every ``class`` in the module we record:
+
+  * the method table and which methods are *thread entries* — targets
+    of ``threading.Thread(target=self.m)`` / ``threading.Timer(t,
+    self.m)`` plus their transitive ``self.m2()`` call closure;
+  * the lock inventory (attrs assigned ``threading.Lock/RLock/
+    Condition/Semaphore``) and the thread-safe allowlist (``queue.*``
+    queues, ``threading.Event`` — objects whose own methods
+    synchronize, so cross-thread use is fine without a lock);
+  * every ``self.X`` access with its method, access *kind* and the
+    *lockset* held at the access site (``with self._lock:`` blocks;
+    methods named ``*_locked`` are treated as holding every lock — the
+    repo's convention for lock-held helpers);
+  * container lifecycle: attrs initialized as unbounded containers in
+    ``__init__`` (list/dict/set literals or ctors, ``deque()`` without
+    ``maxlen=``), where they grow, and whether any shrink path exists
+    (``pop/popleft/popitem/remove/discard/clear``, ``del self.X[..]``,
+    or a rebind that resets to an empty literal / filters-truncates a
+    read of ``self.X`` itself — the comprehension-prune and
+    slice-truncate idioms);
+  * resource lifecycle: ``self.X = open(...)`` / ``threading.Timer`` /
+    ``threading.Thread`` attrs, whether they are started, and whether
+    the class provides the matching ``close/cancel/join`` (or marks
+    the thread daemon); ``start_trace``/``stop_trace`` and
+    ``acquire``/``release`` call tallies.
+
+Access kinds:  ``read`` — plain load or non-mutating method call;
+``write`` — attribute rebind; ``grow``/``shrink`` — container size
+change; ``mutate`` — in-place structure mutation that is neither
+(element store on a list, ``sort``, attribute-set on the referenced
+object).  The race rule treats everything but ``read`` as a write.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import ModuleContext, dotted_name
+
+__all__ = ["Access", "ClassFacts", "facts_for"]
+
+# -- vocabulary ---------------------------------------------------------------
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+# Objects whose methods synchronize internally: sharing them across
+# threads without an explicit lock is the *intended* use (Prefetcher's
+# queue.Queue + threading.Event handshake).
+_SAFE_TYPES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "Event"}
+
+_GROW_CALLS = {"append", "appendleft", "add", "extend", "insert",
+               "setdefault", "update"}
+_SHRINK_CALLS = {"pop", "popleft", "popitem", "remove", "discard", "clear"}
+# In-place mutations that are neither grow nor shrink for sure, but do
+# change structure — relevant to the race rule's cross-thread check.
+_MUTATE_CALLS = {"put", "put_nowait", "get", "get_nowait", "move_to_end",
+                 "sort", "reverse"}
+
+_WRITE_KINDS = {"write", "grow", "shrink", "mutate"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``"X"``; anything else -> None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_empty_container(node: ast.AST) -> bool:
+    """Empty literal or zero-arg container ctor — a reset-to-empty RHS."""
+    if isinstance(node, (ast.List, ast.Set)) and not node.elts:
+        return True
+    if isinstance(node, ast.Dict) and not node.keys:
+        return True
+    if isinstance(node, ast.Call) and not node.args and not node.keywords:
+        base = dotted_name(node.func).rsplit(".", 1)[-1]
+        return base in ("list", "dict", "set", "deque", "OrderedDict")
+    return False
+
+
+def _references_self_attr(node: ast.AST, attr: str) -> bool:
+    """Does the expression read ``self.<attr>`` anywhere?  A rebind whose
+    RHS re-reads the attr (``self.xs = [x for x in self.xs if ...]``,
+    ``self.xs = self.xs[-k:]``) is a prune, not fresh growth."""
+    for sub in ast.walk(node):
+        if _self_attr(sub) == attr:
+            return True
+    return False
+
+
+def _container_kind(value: ast.AST) -> Optional[Tuple[str, bool]]:
+    """Classify an ``__init__`` RHS as ``(kind, bounded)`` if it builds a
+    container; None otherwise.  Only ``deque(maxlen=...)`` is bounded by
+    construction."""
+    if isinstance(value, ast.List):
+        return ("list", False)
+    if isinstance(value, ast.Dict):
+        return ("dict", False)
+    if isinstance(value, ast.Set):
+        return ("set", False)
+    if isinstance(value, ast.Call):
+        base = dotted_name(value.func).rsplit(".", 1)[-1]
+        if base == "deque":
+            bounded = (any(kw.arg == "maxlen" for kw in value.keywords)
+                       or len(value.args) >= 2)
+            return ("deque", bounded)
+        if base in ("list", "set"):
+            return (base, False)
+        if base in ("dict", "OrderedDict", "defaultdict", "Counter"):
+            return ("dict", False)
+    return None
+
+
+# -- data ---------------------------------------------------------------------
+
+@dataclass
+class Access:
+    """One ``self.X`` touch: where, what kind, and under which locks."""
+
+    attr: str
+    method: str
+    kind: str                 # read | write | grow | shrink | mutate
+    locks: frozenset          # lock attrs held; "*" = all (``*_locked``)
+    node: ast.AST             # anchor for findings
+    call: Optional[str] = None  # method name for self.X.m() accesses
+
+
+@dataclass
+class ClassFacts:
+    """Everything the host rules need to know about one class."""
+
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    lock_attrs: Set[str] = field(default_factory=set)
+    safe_attrs: Set[str] = field(default_factory=set)
+    thread_entries: Set[str] = field(default_factory=set)
+    # attr -> (kind, init anchor) for unbounded-at-init containers
+    containers: Dict[str, Tuple[str, ast.AST]] = field(default_factory=dict)
+    accesses: List[Access] = field(default_factory=list)
+    # attr -> ("Timer"|"Thread", ctor anchor, daemon flag)
+    worker_attrs: Dict[str, Tuple[str, ast.AST, bool]] = field(
+        default_factory=dict)
+    open_attrs: Dict[str, ast.AST] = field(default_factory=dict)
+    start_trace_sites: List[ast.AST] = field(default_factory=list)
+    stop_trace_count: int = 0
+    # self.m() call edges: caller method -> callee method names
+    call_edges: Dict[str, Set[str]] = field(default_factory=dict)
+
+    def attr_accesses(self, attr: str) -> List[Access]:
+        return [a for a in self.accesses if a.attr == attr]
+
+    def calls_on(self, attr: str) -> Set[str]:
+        """All ``self.<attr>.m()`` method names seen class-wide."""
+        return {a.call for a in self.accesses
+                if a.attr == attr and a.call is not None}
+
+
+# -- extraction ---------------------------------------------------------------
+
+_WORKER_TYPES = {"Timer": "Timer", "Thread": "Thread"}
+
+
+def _worker_ctor(value: ast.AST, threading_names: Set[str]) -> Optional[str]:
+    """Is this RHS a ``threading.Timer(...)`` / ``threading.Thread(...)``
+    construction?  Bare ``Timer(...)`` only counts when the name was
+    imported from threading — the repo has unrelated Timer classes
+    (obs stopwatches, DavidNet parity)."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name in ("threading.Timer", "threading.Thread"):
+        return name.rsplit(".", 1)[-1]
+    if name in _WORKER_TYPES and name in threading_names:
+        return name
+    return None
+
+
+def _thread_target(call: ast.Call) -> Optional[str]:
+    """Method name of a ``self.m`` passed as a Thread target / Timer
+    function (kwarg or the Timer's second positional)."""
+    for kw in call.keywords:
+        if kw.arg in ("target", "function"):
+            return _self_attr(kw.value)
+    name = dotted_name(call.func).rsplit(".", 1)[-1]
+    if name == "Timer" and len(call.args) >= 2:
+        return _self_attr(call.args[1])
+    return None
+
+
+def _daemon_true(call: ast.Call) -> bool:
+    return any(kw.arg == "daemon"
+               and isinstance(kw.value, ast.Constant) and kw.value.value is True
+               for kw in call.keywords)
+
+
+class _MethodScanner:
+    """Walks one method body tracking the set of held locks, recording
+    every ``self.X`` access.  Does not descend into nested defs/lambdas/
+    classes (their execution time is unknowable statically)."""
+
+    def __init__(self, facts: ClassFacts, method: str,
+                 threading_names: Set[str]):
+        self.facts = facts
+        self.method = method
+        self.threading_names = threading_names
+        self.base_locks: frozenset = (
+            frozenset(["*"]) if method.endswith("_locked") else frozenset())
+
+    def add(self, attr: str, node: ast.AST, kind: str,
+            locks: frozenset, call: Optional[str] = None) -> None:
+        self.facts.accesses.append(Access(
+            attr=attr, method=self.method, kind=kind, locks=locks,
+            node=node, call=call))
+
+    def scan(self, node: ast.AST, locks: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.With):
+            held = set(locks)
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in self.facts.lock_attrs:
+                    held.add(attr)
+                else:
+                    self.scan(item.context_expr, locks)
+            for stmt in node.body:
+                self.scan(stmt, frozenset(held))
+            return
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                self._store(target, node.value, locks)
+            self.scan(node.value, locks)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._store(node.target, node.value, locks)
+                self.scan(node.value, locks)
+            return
+        if isinstance(node, ast.AugAssign):
+            attr = _self_attr(node.target)
+            if attr is not None:
+                kind = "grow" if attr in self.facts.containers else "write"
+                self.add(attr, node, kind, locks)
+            else:
+                self.scan(node.target, locks)
+            self.scan(node.value, locks)
+            return
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                attr = None
+                if isinstance(target, ast.Subscript):
+                    attr = _self_attr(target.value)
+                if attr is None:
+                    attr = _self_attr(target)
+                if attr is not None:
+                    self.add(attr, target, "shrink", locks)
+                else:
+                    self.scan(target, locks)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, locks)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                self.add(attr, node, "read", locks)
+                return
+        for child in ast.iter_child_nodes(node):
+            self.scan(child, locks)
+
+    def _store(self, target: ast.AST, value: ast.AST,
+               locks: frozenset) -> None:
+        attr = _self_attr(target)
+        if attr is not None:
+            kind = "write"
+            if self.method != "__init__" and attr in self.facts.containers:
+                if (_is_empty_container(value)
+                        or _references_self_attr(value, attr)):
+                    kind = "shrink"
+            worker = _worker_ctor(value, self.threading_names)
+            if worker is not None and attr not in self.facts.worker_attrs:
+                self.facts.worker_attrs[attr] = (
+                    worker, target, _daemon_true(value))
+            if (isinstance(value, ast.Call)
+                    and dotted_name(value.func) == "open"
+                    and attr not in self.facts.open_attrs):
+                self.facts.open_attrs[attr] = target
+            self.add(attr, target, kind, locks)
+            return
+        if isinstance(target, ast.Subscript):
+            attr = _self_attr(target.value)
+            if attr is not None:
+                # a[key] = v grows dicts/sets; on lists it replaces an
+                # element (no growth) — still a structure mutation.
+                kind_info = self.facts.containers.get(attr)
+                kind = ("grow" if kind_info and kind_info[0] == "dict"
+                        else "mutate")
+                if isinstance(target.slice, ast.Slice):
+                    kind = "mutate"  # slice-assign rewrites in place
+                self.add(attr, target, kind, locks)
+                return
+            self.scan(target.value, locks)
+            self.scan(target.slice, locks)
+            return
+        if isinstance(target, ast.Attribute):
+            # self.X.y = v — attribute-set on the referenced object
+            attr = _self_attr(target.value)
+            if attr is not None:
+                self.add(attr, target, "mutate", locks, call=None)
+                if (target.attr == "daemon"
+                        and attr in self.facts.worker_attrs):
+                    kind, anchor, _ = self.facts.worker_attrs[attr]
+                    self.facts.worker_attrs[attr] = (kind, anchor, True)
+                return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._store(elt, value, locks)
+            return
+        self.scan(target, locks)
+
+    def _call(self, node: ast.Call, locks: frozenset) -> None:
+        func = node.func
+        handled = False
+        if isinstance(func, ast.Attribute):
+            owner = _self_attr(func.value)
+            if owner is not None:
+                # self.X.m(...)
+                m = func.attr
+                if m in _GROW_CALLS:
+                    kind = "grow"
+                elif m in _SHRINK_CALLS:
+                    kind = "shrink"
+                elif m in _MUTATE_CALLS:
+                    kind = "mutate"
+                else:
+                    kind = "read"
+                self.add(owner, node, kind, locks, call=m)
+                handled = True
+            else:
+                callee = _self_attr(func)
+                if callee is not None:
+                    # self.m(...) — call edge (or callable-attr read)
+                    self.facts.call_edges.setdefault(
+                        self.method, set()).add(callee)
+                    self.add(callee, node, "read", locks, call=None)
+                    handled = True
+        name = dotted_name(func)
+        if name.endswith("start_trace"):
+            self.facts.start_trace_sites.append(node)
+        elif name.endswith("stop_trace"):
+            self.facts.stop_trace_count += 1
+        target = _thread_target(node) if _worker_ctor(
+            node, self.threading_names) else None
+        if target is not None:
+            self.facts.thread_entries.add(target)
+        if not handled:
+            self.scan(func, locks)
+        for arg in node.args:
+            self.scan(arg, locks)
+        for kw in node.keywords:
+            self.scan(kw.value, locks)
+
+
+def _scan_init_layout(facts: ClassFacts, threading_names: Set[str]) -> None:
+    """First pass over ``__init__`` (and class-level assigns): lock
+    inventory, thread-safe allowlist, container initializers."""
+    def classify(target: ast.AST, value: ast.AST) -> None:
+        attr = _self_attr(target)
+        if attr is None and isinstance(target, ast.Name):
+            attr = target.id  # class-level ``spans: deque = deque()``
+        if attr is None or value is None:
+            return
+        if isinstance(value, ast.Call):
+            base = dotted_name(value.func).rsplit(".", 1)[-1]
+            if base in _LOCK_TYPES:
+                facts.lock_attrs.add(attr)
+                facts.safe_attrs.add(attr)
+                return
+            if base in _SAFE_TYPES:
+                facts.safe_attrs.add(attr)
+                return
+        kind = _container_kind(value)
+        if kind is not None and not kind[1]:
+            facts.containers.setdefault(attr, (kind[0], target))
+
+    for stmt in facts.node.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                classify(t, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            classify(stmt.target, stmt.value)
+    init = facts.methods.get("__init__")
+    if init is not None:
+        for stmt in ast.walk(init):
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    classify(t, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                classify(stmt.target, stmt.value)
+
+
+def _close_thread_entries(facts: ClassFacts) -> None:
+    """Transitive closure of thread entries over self.m() call edges."""
+    work = list(facts.thread_entries)
+    while work:
+        m = work.pop()
+        for callee in facts.call_edges.get(m, ()):
+            if callee in facts.methods and callee not in facts.thread_entries:
+                facts.thread_entries.add(callee)
+                work.append(callee)
+
+
+def _threading_names(tree: ast.Module) -> Set[str]:
+    """Names bound by ``from threading import ...`` at module level."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def _extract(tree: ast.Module) -> List[ClassFacts]:
+    threading_names = _threading_names(tree)
+    out: List[ClassFacts] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        facts = ClassFacts(name=node.name, node=node)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                facts.methods[stmt.name] = stmt
+        _scan_init_layout(facts, threading_names)
+        for name, method in facts.methods.items():
+            scanner = _MethodScanner(facts, name, threading_names)
+            for stmt in method.body:
+                scanner.scan(stmt, scanner.base_locks)
+        _close_thread_entries(facts)
+        out.append(facts)
+    return out
+
+
+def facts_for(ctx: ModuleContext) -> List[ClassFacts]:
+    """Extract (memoized per ModuleContext — all host rules share one
+    walk per file)."""
+    cached = getattr(ctx, "_host_facts", None)
+    if cached is None:
+        cached = _extract(ctx.tree)
+        setattr(ctx, "_host_facts", cached)
+    return cached

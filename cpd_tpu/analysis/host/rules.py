@@ -1,0 +1,398 @@
+"""The four host-runtime contract rules (docs/ANALYSIS.md, "v4 — host
+contracts").  Each class docstring is its ``--explain`` catalog entry;
+fixture pairs live at tests/fixtures/analysis/host_*_{bad,good}.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ..core import (Finding, ModuleContext, Rule, dotted_name, register,
+                    walk_scope)
+from .facts import Access, ClassFacts, _WRITE_KINDS, facts_for
+
+__all__ = ["HostRaceRule", "HostUnboundedRule", "HostLeakRule",
+           "HostClockRule"]
+
+
+def _lockset_matches(a: Access, facts: ClassFacts) -> Set[str]:
+    """Concrete lock attrs an access holds; ``*_locked`` methods hold
+    every lock the class owns."""
+    if "*" in a.locks:
+        return set(facts.lock_attrs) or {"*"}
+    return set(a.locks)
+
+
+@register
+class HostRaceRule(Rule):
+    """Attributes shared between a thread/Timer callback and main-loop
+    methods must use one lock discipline.
+
+    Host objects that spawn workers — ``threading.Thread(target=
+    self.m)``, ``threading.Timer(t, self.m)`` — share ``self`` between
+    the worker and every main-loop method.  For each attribute touched
+    on *both* sides (``__init__`` excluded: it runs before the thread
+    exists) with at least one write, the rule checks the lock
+    discipline:
+
+    * **inconsistent locking** — some access holds a lock (``with
+      self._lock:`` block, or a ``*_locked``-suffixed helper, the
+      repo's held-lock naming convention) but the two sides share no
+      common lock: flagged.  This is the watchdog ``_context`` defect
+      shape — armed under the lock, read lock-free in the timer
+      callback.
+    * **no locking anywhere** — only *structure mutation* of a
+      container crosses the thread boundary unlocked (append/pop/del/
+      element store from one side while the other side touches the same
+      container): flagged.  Plain attribute rebinds of flags
+      (``self.tripped = True``) are CPython-atomic and deliberately NOT
+      flagged.
+
+    Deliberately NOT flagged: attrs that are themselves synchronized
+    objects — ``queue.Queue`` and friends, ``threading.Event``, the
+    locks themselves (utils/prefetch.py's queue+event handshake is the
+    sanctioned pattern); accesses in ``__init__``; classes that spawn
+    no workers.
+
+    Fix: take the same lock on both sides (snapshot under the lock,
+    then work on the snapshot — resilience/watchdog.py ``_fire``), move
+    the data onto a queue, or suppress with a written justification.
+    """
+
+    id = "host-race"
+    summary = ("thread/Timer-shared attribute accessed without a common "
+               "lock across the thread boundary")
+    scope = "host"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for facts in facts_for(ctx):
+            if not facts.thread_entries:
+                continue
+            attrs = {a.attr for a in facts.accesses
+                     if a.attr not in facts.safe_attrs
+                     and a.attr not in facts.methods}
+            for attr in sorted(attrs):
+                acc = [a for a in facts.attr_accesses(attr)
+                       if a.method != "__init__"]
+                thread_side = [a for a in acc
+                               if a.method in facts.thread_entries]
+                main_side = [a for a in acc
+                             if a.method not in facts.thread_entries]
+                if not thread_side or not main_side:
+                    continue
+                if not any(a.kind in _WRITE_KINDS for a in acc):
+                    continue
+                locksets = [_lockset_matches(a, facts) for a in acc]
+                if set.intersection(*locksets):
+                    continue  # common lock covers every access
+                if any(locksets):
+                    bare = next((a for a in thread_side
+                                 if not _lockset_matches(a, facts)),
+                                None) or next(
+                        a for a in acc if not _lockset_matches(a, facts))
+                    yield ctx.finding(
+                        self.id, bare.node,
+                        f"{facts.name}.{attr} uses inconsistent locking: "
+                        f"accessed lock-free in {bare.method}() but under "
+                        f"a lock elsewhere, and thread entry "
+                        f"{sorted(facts.thread_entries)} shares it with "
+                        f"the main loop — hold the same lock on every "
+                        f"side (snapshot under the lock, then use the "
+                        f"snapshot)")
+                    continue
+                mutation = next(
+                    (a for a in acc if a.kind in ("grow", "shrink",
+                                                  "mutate")), None)
+                if mutation is not None:
+                    yield ctx.finding(
+                        self.id, mutation.node,
+                        f"{facts.name}.{attr} container structure is "
+                        f"mutated across the thread boundary with no "
+                        f"lock at all ({mutation.method}() vs the other "
+                        f"side) — guard with a threading.Lock or hand "
+                        f"the data over a queue.Queue")
+
+
+@register
+class HostUnboundedRule(Rule):
+    """Module-lifetime containers grown on the step/request clock need a
+    cap, eviction, or prune path.
+
+    The generalized ResultStore defect (PR 10) and the fleet
+    control-plane logs (PR 13): an attribute initialized in
+    ``__init__`` as an unbounded container (list/dict/set literal or
+    ctor, ``deque()`` *without* ``maxlen=``) and grown inside non-init
+    methods (``append``/``add``/``extend``/``setdefault``/``update``,
+    dict element store, ``+=``) is flagged when the class has **no
+    shrink path anywhere**: on a long-lived host object every step or
+    request leaks a little memory forever.
+
+    Recognized shrink paths (any one silences the attr class-wide):
+    ``pop``/``popleft``/``popitem``/``remove``/``discard``/``clear``
+    calls, ``del self.X[...]``, and a rebind whose RHS is an empty
+    literal or *reads the attr itself* — the comprehension-filter prune
+    (``self.placement = {k: v for k, v in self.placement.items() if
+    ...}``) and slice-truncate (``self.log = self.log[-k:]``) idioms.
+    A ``load_state_dict``-style rebind from foreign data is NOT a
+    shrink — restoring a snapshot does not bound future growth.
+
+    Deliberately NOT flagged: ``deque(maxlen=...)`` (bounded by
+    construction); growth only inside ``__init__``; nested structures
+    (``self.logs[i].append(...)`` mutates an element, not the tracked
+    attr — flag the element's own class if it is long-lived).
+
+    Fix: bound it (``deque(maxlen=)``, explicit cap + eviction like
+    serve/engine.py's ResultStore, periodic prune), or suppress with a
+    justification stating the actual bound.
+    """
+
+    id = "host-unbounded"
+    summary = ("module-lifetime container grown on the step/request "
+               "clock with no cap or prune path")
+    scope = "host"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for facts in facts_for(ctx):
+            for attr, (kind, _anchor) in sorted(facts.containers.items()):
+                acc = facts.attr_accesses(attr)
+                grows = [a for a in acc
+                         if a.kind == "grow" and a.method != "__init__"]
+                if not grows:
+                    continue
+                if any(a.kind == "shrink" for a in acc):
+                    continue
+                first = min(grows, key=lambda a: getattr(
+                    a.node, "lineno", 1))
+                yield ctx.finding(
+                    self.id, first.node,
+                    f"{facts.name}.{attr} ({kind}, initialized in "
+                    f"__init__) grows in {first.method}() and the class "
+                    f"has no shrink path — bound it (deque(maxlen=), "
+                    f"cap+eviction, periodic prune) or suppress with "
+                    f"the actual bound")
+
+
+def _finally_bodies(fn: ast.AST) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Try):
+            out.extend(node.finalbody)
+    return out
+
+
+def _name_used(nodes: List[ast.AST], name: str,
+               method: Optional[str] = None) -> bool:
+    """Is ``name.method(...)`` (or any use of ``name``, when method is
+    None) present under ``nodes``?"""
+    for root in nodes:
+        for sub in ast.walk(root):
+            if method is None:
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+            elif (isinstance(sub, ast.Call)
+                  and isinstance(sub.func, ast.Attribute)
+                  and sub.func.attr == method
+                  and isinstance(sub.func.value, ast.Name)
+                  and sub.func.value.id == name):
+                return True
+    return False
+
+
+def _escapes(fn: ast.AST, name: str) -> bool:
+    """Conservative ownership-transfer check: the local is returned,
+    yielded, stored on self/another object, or passed to a call —
+    someone else may close it."""
+    for node in walk_scope(fn):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            if node.value is not None and _name_used([node.value], name):
+                return True
+        elif isinstance(node, ast.Call):
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if any(isinstance(a, ast.Name) and a.id == name for a in args):
+                return True
+        elif isinstance(node, ast.Assign):
+            if (_name_used([node.value], name)
+                    and any(not isinstance(t, ast.Name)
+                            for t in node.targets)):
+                return True
+    return False
+
+
+@register
+class HostLeakRule(Rule):
+    """Acquire/start without a with/finally-scoped or class-managed
+    release: file handles, profiler windows, Timer/Thread lifecycles,
+    bare lock acquires.
+
+    The PR 11 defect family (five profiler-close-in-finally fixes),
+    made mechanical.  Four shapes:
+
+    * ``f = open(...)`` into a **local**: must be ``with``-scoped or
+      ``f.close()``-d inside a ``finally:`` — a close on the straight
+      path still leaks on exceptions.  Ownership transfer (the handle
+      is returned, stored on ``self``/another object, or passed to a
+      call) silences the local check.
+    * ``self._fh = open(...)``: the class must contain
+      ``self._fh.close()`` somewhere (utils/logging.py's ScalarWriter
+      close/__exit__ pattern).
+    * ``start_trace`` without ``stop_trace`` anywhere in the same
+      class — an unclosed profiler window.
+    * ``threading.Timer``/``Thread`` stored on ``self`` and
+      ``.start()``-ed: Timers need a ``.cancel()`` path, Threads need
+      ``.join()`` or ``daemon=True`` (the watchdog cancel/daemon
+      discipline).  ``.acquire()`` on an attr with no ``.release()``
+      class-wide is flagged the same way (``with lock:`` never trips
+      this).
+
+    Deliberately NOT flagged: ``with open(...) as f`` and expression
+    opens (``open(p).read()`` — idiomatic for short reads, CPython
+    refcounting closes promptly); classes pairing start/stop
+    (utils/profiling.py's StepProfiler); daemon workers.
+
+    Fix: use ``with``; move the release into ``finally``; add the
+    ``close``/``cancel``/``join`` lifecycle method and call it from
+    ``close()``/``__exit__``.
+    """
+
+    id = "host-leak"
+    summary = ("resource acquired/started without a with/finally-scoped "
+               "or class-managed release")
+    scope = "host"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self._check_classes(ctx)
+        yield from self._check_functions(ctx)
+
+    def _check_classes(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for facts in facts_for(ctx):
+            for attr, anchor in sorted(facts.open_attrs.items()):
+                if "close" not in facts.calls_on(attr):
+                    yield ctx.finding(
+                        self.id, anchor,
+                        f"{facts.name}.{attr} = open(...) but the class "
+                        f"never calls self.{attr}.close() — add a "
+                        f"close()/__exit__ lifecycle method")
+            if facts.start_trace_sites and facts.stop_trace_count == 0:
+                yield ctx.finding(
+                    self.id, facts.start_trace_sites[0],
+                    f"{facts.name} opens a profiler window (start_trace) "
+                    f"but never calls stop_trace — close the window in "
+                    f"finally or a close() method")
+            for attr, (kind, anchor, daemon) in sorted(
+                    facts.worker_attrs.items()):
+                calls = facts.calls_on(attr)
+                if "start" not in calls:
+                    continue
+                if kind == "Timer" and "cancel" not in calls:
+                    yield ctx.finding(
+                        self.id, anchor,
+                        f"{facts.name}.{attr} is a started threading."
+                        f"Timer with no cancel() path — cancel it in "
+                        f"close()/stop() or the timer outlives the "
+                        f"object")
+                elif kind == "Thread" and not daemon and "join" not in calls:
+                    yield ctx.finding(
+                        self.id, anchor,
+                        f"{facts.name}.{attr} is a started non-daemon "
+                        f"Thread with no join() path — join it in "
+                        f"close() or mark it daemon")
+            for attr in sorted({a.attr for a in facts.accesses
+                                if a.call == "acquire"}):
+                if "release" not in facts.calls_on(attr):
+                    acq = next(a for a in facts.accesses
+                               if a.attr == attr and a.call == "acquire")
+                    yield ctx.finding(
+                        self.id, acq.node,
+                        f"{facts.name}.{attr}.acquire() with no "
+                        f"release() class-wide — use `with self.{attr}:` "
+                        f"or release in finally")
+
+    def _check_functions(self, ctx: ModuleContext) -> Iterator[Finding]:
+        """Function-local opens (free functions AND methods): open()
+        without with/finally-close."""
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in walk_scope(fn):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)
+                        and dotted_name(node.value.func) == "open"):
+                    continue
+                name = node.targets[0].id
+                closed_in_finally = _name_used(
+                    _finally_bodies(fn), name, "close")
+                if closed_in_finally or _escapes(fn, name):
+                    continue
+                yield ctx.finding(
+                    self.id, node,
+                    f"{name} = open(...) in {fn.name}() is closed on no "
+                    f"finally path — use `with open(...) as {name}:` or "
+                    f"close in finally (leaks the handle on exceptions)")
+
+
+_CLOCK_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "time.process_time_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.now", "datetime.utcnow",
+    "datetime.today",
+}
+_TIME_FUNCS = {"time", "time_ns", "monotonic", "monotonic_ns",
+               "perf_counter", "perf_counter_ns", "process_time",
+               "process_time_ns"}
+
+
+@register
+class HostClockRule(Rule):
+    """Wall-clock reads belong in obs/timing.py — everything else rides
+    the one shared clock.
+
+    The one-clock doctrine (docs/OBSERVABILITY.md): durations come from
+    ``obs.timing.now()``/``Stopwatch`` (monotonic ``perf_counter``
+    under the hood) and epoch timestamps from ``obs.timing.epoch()``
+    — so tests can virtualize time, traces from different subsystems
+    line up, and nobody diffs ``time.time()`` against ``perf_counter``.
+    Flags any call of ``time.time``/``monotonic``/``perf_counter``/
+    ``process_time`` (and ``_ns`` variants, including names imported
+    via ``from time import ...``) or ``datetime.now``/``utcnow``/
+    ``today`` outside the exempted ``cpd_tpu/obs/timing.py``.
+
+    Deliberately NOT flagged: ``time.sleep`` (a delay, not a clock
+    read); ``date.today`` on a bare ``date``; clock names inside string
+    literals (subprocess scripts in tests).
+
+    Fix: ``from cpd_tpu.obs.timing import now, epoch, Stopwatch`` —
+    ``now()`` for durations, ``epoch()`` for the sanctioned wall-clock
+    timestamp, or route through an existing Stopwatch.
+    """
+
+    id = "host-clock"
+    summary = ("wall-clock read outside obs/timing.py — use "
+               "obs.timing.now()/epoch()/Stopwatch")
+    scope = "host"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        from_time: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name in _TIME_FUNCS:
+                        from_time.add(alias.asname or alias.name)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            hit = (name in _CLOCK_CALLS
+                   or (isinstance(node.func, ast.Name)
+                       and node.func.id in from_time))
+            if hit:
+                yield ctx.finding(
+                    self.id, node,
+                    f"wall-clock read {name or node.func.id}() outside "
+                    f"obs/timing.py — use obs.timing.now() for "
+                    f"durations, obs.timing.epoch() for timestamps "
+                    f"(one-clock doctrine, docs/ANALYSIS.md v4)")

@@ -94,7 +94,7 @@ class MetricsRegistry:
             return
         if kind == "histogram" and buckets is None:
             buckets = self.DEFAULT_BUCKETS
-        self._metrics[name] = _Series(kind, help_text, buckets)
+        self._metrics[name] = _Series(kind, help_text, buckets)  # cpd: disable=host-unbounded -- keyed by declared metric names: static, low-cardinality by the registry's own naming contract
 
     # -- writes -----------------------------------------------------------
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import time
 
-__all__ = ["now", "Stopwatch", "Timer"]
+__all__ = ["now", "epoch", "Stopwatch", "Timer"]
 
 
 def now() -> float:
@@ -30,6 +30,18 @@ def now() -> float:
     reconstruction parity contract only need one shared monotonic
     clock, which this remains."""
     return time.perf_counter()
+
+
+def epoch() -> float:
+    """Unix-epoch seconds — the ONE sanctioned ``time.time()`` read.
+
+    For *timestamps* (log lines, scalar-stream ``ts`` fields, run
+    metadata) where an absolute, cross-process time is the point.
+    Never difference two ``epoch()`` reads to measure a duration — NTP
+    can step it; that is what ``now()``/``Stopwatch`` are for.  The
+    host-clock rule (docs/ANALYSIS.md v4) funnels every wall-clock
+    read in the tree through these two helpers."""
+    return time.time()
 
 
 class Stopwatch:
@@ -56,15 +68,21 @@ class Stopwatch:
 class Timer:
     """Incremental wall-clock timer (reference DavidNet/utils.py:28-38
     parity, moved here from train/metrics.py): each call returns the
-    time since the previous call and accumulates total time."""
+    time since the previous call and accumulates total time.
+
+    State is O(1) — only the previous mark is kept.  The reference
+    appends every timestamp to a list, which on a long-lived loop is
+    exactly the host-unbounded defect the analyzer flags; nothing ever
+    read more than the last two entries."""
 
     def __init__(self):
-        self.times = [now()]
+        self._last = now()
         self.total_time = 0.0
 
     def __call__(self, include_in_total: bool = True) -> float:
-        self.times.append(now())
-        delta = self.times[-1] - self.times[-2]
+        t = now()
+        delta = t - self._last
+        self._last = t
         if include_in_total:
             self.total_time += delta
         return delta

@@ -191,6 +191,9 @@ class PrecisionSupervisor:
     loop's ``sat_hot_steps`` counter feed).
     """
 
+    # transition-log cap: keep the newest entries, drop the oldest
+    TRANSITION_CAP = 4096
+
     def __init__(self, ladder, *, threshold: float = 1e-3,
                  patience: int = 2, probation: int = 16,
                  site: str = "wire"):
@@ -210,7 +213,9 @@ class PrecisionSupervisor:
         self.hot = 0           # consecutive hot observations
         self.quiet = 0         # consecutive quiet observations
         self.last_hot = False
-        self.transitions: list = []   # (step, from_name, to_name)
+        # (step, from_name, to_name); newest TRANSITION_CAP entries — a
+        # flapping ladder must not grow this forever (host-unbounded)
+        self.transitions: list = []
 
     # -- introspection ----------------------------------------------------
 
@@ -261,7 +266,7 @@ class PrecisionSupervisor:
                 old = self.name
                 self._level += 1
                 self.hot = 0
-                self.transitions.append((step, old, self.name))
+                self._record(step, old)
                 return "escalate"
             return None
         self.hot = 0
@@ -270,9 +275,14 @@ class PrecisionSupervisor:
             old = self.name
             self._level -= 1
             self.quiet = 0
-            self.transitions.append((step, old, self.name))
+            self._record(step, old)
             return "deescalate"
         return None
+
+    def _record(self, step: int, old: str) -> None:
+        self.transitions.append((step, old, self.name))
+        if len(self.transitions) > self.TRANSITION_CAP:
+            del self.transitions[0]
 
     # -- checkpoint persistence -------------------------------------------
 

@@ -49,6 +49,9 @@ class TransportSupervisor:
 
     LEVELS = ("ring", "faithful", "fp32")
 
+    # transition-log cap: keep the newest entries, drop the oldest
+    TRANSITION_CAP = 4096
+
     def __init__(self, start: str = "ring", max_retries: int = 1,
                  probation: int = 8):
         if start not in self.LEVELS:
@@ -64,7 +67,9 @@ class TransportSupervisor:
         self.probation = probation
         self.retries = 0          # consecutive failures at this step
         self.clean = 0            # consecutive clean steps at this level
-        self.transitions: list = []   # (step, from_level, to_level)
+        # (step, from_level, to_level); newest TRANSITION_CAP entries —
+        # a flapping transport must not grow this forever (host-unbounded)
+        self.transitions: list = []
 
     @property
     def mode(self) -> str:
@@ -92,7 +97,7 @@ class TransportSupervisor:
         if self._level + 1 < len(self.LEVELS):
             old = self.mode
             self._level += 1
-            self.transitions.append((step, old, self.mode))
+            self._record(step, old)
             return "downgrade"
         return "give_up"
 
@@ -105,9 +110,14 @@ class TransportSupervisor:
             old = self.mode
             self._level -= 1
             self.clean = 0
-            self.transitions.append((step, old, self.mode))
+            self._record(step, old)
             return "upgrade"
         return None
+
+    def _record(self, step: int, old: str) -> None:
+        self.transitions.append((step, old, self.mode))
+        if len(self.transitions) > self.TRANSITION_CAP:
+            del self.transitions[0]
 
 
 def level_reduce_kwargs(level: str, grad_exp: int, grad_man: int) -> dict:
@@ -139,7 +149,7 @@ class StepTable:
 
     def __getitem__(self, level: str) -> Callable:
         if level not in self._cache:
-            self._cache[level] = self._build(level)
+            self._cache[level] = self._build(level)  # cpd: disable=host-unbounded -- keyed by the static level/rung vocabulary (LEVELS / ladder rungs), not the step clock
         return self._cache[level]
 
     def __contains__(self, level: str) -> bool:

@@ -98,7 +98,13 @@ class StepWatchdog:
     def _fire(self) -> None:
         self.tripped = True
         self.trips += 1
-        ctx = dict(self._context)
+        with self._lock:
+            # snapshot under the same lock arm() holds while swapping
+            # _context in — this timer thread races the main loop
+            # re-arming for the next step (host-race, ISSUE 16); both
+            # uses below work on the snapshot
+            context = dict(self._context)
+        ctx = dict(context)
         print(f"=> watchdog: step {ctx.pop('step', '?')} exceeded "
               f"{self.timeout:.1f}s; last known: {ctx}", file=sys.stderr,
               flush=True)
@@ -120,7 +126,7 @@ class StepWatchdog:
                 self._exit_timer.start()
         if self.on_trip is not None:
             try:
-                self.on_trip(dict(self._context))
+                self.on_trip(dict(context))
             except Exception as e:
                 print(f"=> watchdog: on_trip hook failed: {e}",
                       file=sys.stderr)

@@ -760,7 +760,7 @@ class ServeEngine:
         if slot.fed == len(prompt):
             row = np.asarray(last_logits)
             if self.record_logits:
-                self.logits_log.append((slot.req.rid, slot.fed - 1, row))
+                self.logits_log.append((slot.req.rid, slot.fed - 1, row))  # cpd: disable=host-unbounded -- tests-only oracle tap behind record_logits (default off); bounded by the test's own request count
             tok = self._sample(row)
             slot.generated.append(tok)
             slot.first_token_step = s

@@ -89,7 +89,12 @@ class ServeSupervisor:
     ``on_step(step, page_util=, corrupt=, misses=)`` -> None |
     "degrade" | "probate"; ``rung`` is the restriction set the engine
     should apply next step; ``transitions`` is the deterministic
-    (step, from_name, to_name) log the chaos tests assert on."""
+    (step, from_name, to_name) log the chaos tests assert on, capped at
+    the newest ``TRANSITION_CAP`` entries."""
+
+    # plenty for any test/debug window; a process-lifetime supervisor
+    # keeps the newest entries and drops the oldest past this
+    TRANSITION_CAP = 4096
 
     def __init__(self, rungs: Optional[Sequence[Rung]] = None, *,
                  patience: int = 2, probation: int = 8,
@@ -114,7 +119,10 @@ class ServeSupervisor:
         self.hot = 0              # consecutive hot steps
         self.quiet = 0            # consecutive quiet steps
         self.last_hot = False
-        self.transitions: list = []   # (step, from_name, to_name)
+        # (step, from_name, to_name); capped — a supervisor lives for
+        # the whole serving process, and a flapping ladder would
+        # otherwise grow this on the step clock forever (host-unbounded)
+        self.transitions: list = []
 
     # -- introspection ----------------------------------------------------
 
@@ -156,7 +164,7 @@ class ServeSupervisor:
                 old = self.rung.name
                 self._level += 1
                 self.hot = 0
-                self.transitions.append((step, old, self.rung.name))
+                self._record(step, old)
                 return "degrade"
             return None
         self.hot = 0
@@ -165,9 +173,14 @@ class ServeSupervisor:
             old = self.rung.name
             self._level -= 1
             self.quiet = 0
-            self.transitions.append((step, old, self.rung.name))
+            self._record(step, old)
             return "probate"
         return None
+
+    def _record(self, step: int, old: str) -> None:
+        self.transitions.append((step, old, self.rung.name))
+        if len(self.transitions) > self.TRANSITION_CAP:
+            del self.transitions[0]
 
     # -- snapshot persistence ---------------------------------------------
 

@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from typing import Any, Dict, IO, Optional
+
+from ..obs.timing import epoch
 
 __all__ = ["TableLogger", "TSVLogger", "ScalarWriter", "ProgressPrinter",
            "format_validation_line"]
@@ -55,7 +56,7 @@ class TSVLogger:
         epoch = output["epoch"]
         hours = output["total time"] / 3600
         acc = 100.0 * float(output.get("test acc", 0.0))
-        self.log.append(f"{epoch}\t{hours:.8f}\t{acc:.2f}")
+        self.log.append(f"{epoch}\t{hours:.8f}\t{acc:.2f}")  # cpd: disable=host-unbounded -- one line per epoch; the list IS the DAWNBench submission artifact __str__ serializes
 
     def __str__(self):
         return "\n".join(self.log)
@@ -106,7 +107,7 @@ class ScalarWriter:
             return
         self._fh.write(json.dumps({"tag": tag, "step": int(step),
                                    "value": float(value),
-                                   "ts": time.time()}) + "\n")
+                                   "ts": epoch()}) + "\n")
         self._fh.flush()
         if self._tb is not None:
             self._tb.add_scalar(tag, float(value), int(step))

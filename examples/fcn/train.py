@@ -13,7 +13,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -23,6 +22,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
+
+from cpd_tpu.obs.timing import now  # noqa: E402  (the one clock; jax-free)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -193,7 +194,7 @@ def main(argv=None) -> dict:
     guard = PreemptionGuard()
     preempted = diverged = False
     step_no = start_iter
-    t0 = time.time()
+    t0 = now()
     def produced():
         # random-crop batch prep two steps ahead of the device
         # (utils/prefetch.py); the rng draws stay on this single
@@ -240,7 +241,7 @@ def main(argv=None) -> dict:
     manager.wait()
     manager.close()
     if rank == 0 and not (preempted or diverged):
-        print(f"done: {args.max_iter} iters in {time.time()-t0:.1f}s "
+        print(f"done: {args.max_iter} iters in {now()-t0:.1f}s "
               f"final loss {last.get('loss', float('nan')):.4f}")
     writer.close()
     return {"step": step_no, "diverged": diverged, **last}

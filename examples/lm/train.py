@@ -26,6 +26,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+from cpd_tpu.obs.timing import now  # noqa: E402  (the one clock; jax-free)
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="cpd_tpu transformer LM")
@@ -534,7 +536,7 @@ def main(argv=None) -> dict:
     progress = ProgressPrinter(args.max_iter, args.print_freq, rank=rank)
     rng = np.random.RandomState(0)
     last = {}
-    t0 = time.time()
+    t0 = now()
     # training indices exclude the held-out validation tail
     train_n = len(ds) - len(val_idx)
     profiler = StepProfiler(args.profile_dir, start=3)
@@ -784,7 +786,7 @@ def main(argv=None) -> dict:
                                  Loss=last["loss"],
                                  Acc=100 * last["accuracy"],
                                  TokPerSec=global_batch * args.seq_len * it
-                                 / max(time.time() - t0, 1e-9))
+                                 / max(now() - t0, 1e-9))
             writer.add_scalar("train/loss", last["loss"], it)
             if it % args.val_freq == 0 or it == args.max_iter:
                 with otr.span("validate", step=it):
@@ -829,7 +831,7 @@ def main(argv=None) -> dict:
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()
-    dt = time.time() - t0
+    dt = now() - t0
     ran = step_no - start_iter
     if rank == 0 and not (preempted or diverged):
         if last:

@@ -18,7 +18,6 @@ import argparse
 import math
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -28,6 +27,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
+
+from cpd_tpu.obs.timing import now  # noqa: E402  (the one clock; jax-free)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -594,7 +595,7 @@ def main(argv=None) -> dict:
     last = {"loss": float("nan"), "accuracy": 0.0}
     step_no = start_iter
     profiler = StepProfiler(args.profile_dir, start=start_iter + 2)
-    t0 = time.time()
+    t0 = now()
     def produced():
         # host-side batch prep (augmentation runs in the native threaded
         # executor) on a background thread, 2 steps ahead of the device
@@ -851,7 +852,7 @@ def main(argv=None) -> dict:
     manager.wait()
     writer.close()
     if rank == 0 and not (preempted or diverged):  # interrupted != "done"
-        print(f"done: {step_no - start_iter} iters in {time.time()-t0:.1f}s "
+        print(f"done: {step_no - start_iter} iters in {now()-t0:.1f}s "
               f"best Prec@1 {best_prec1:.2f}")
     manager.close()
     if not (preempted or diverged):

@@ -13,7 +13,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -23,6 +22,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
+
+from cpd_tpu.obs.timing import now  # noqa: E402  (the one clock; jax-free)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -319,7 +320,7 @@ def main(argv=None) -> dict:
         for epoch in range(start_epoch, args.epochs):
             sampler.set_epoch(epoch)
             order = np.fromiter(iter(sampler), np.int64)
-            t0 = time.time()
+            t0 = now()
             train_loss = train_acc = 0.0
             epoch_start = start_it if epoch == start_epoch else 0
             n_done = 0
@@ -368,7 +369,7 @@ def main(argv=None) -> dict:
             if preempted or diverged:
                 break
             jax.block_until_ready(state.params)
-            dt = time.time() - t0
+            dt = now() - t0
             n_done = max(n_done, 1)
             imgs_per_sec = n_done * global_batch / dt
 

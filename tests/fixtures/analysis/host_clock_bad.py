@@ -1,0 +1,21 @@
+"""Deliberately-bad fixture for the host-clock rule: ad-hoc wall-clock
+reads outside obs/timing.py — 4 findings pinned in
+tests/test_analysis.py."""
+
+import time
+from datetime import datetime
+from time import perf_counter
+
+
+def step_duration(step_fn):
+    t0 = time.time()                     # finding 1: epoch diffed for
+    step_fn()                            # a duration (NTP can step it)
+    return time.time() - t0              # finding 2
+
+
+def tick():
+    return perf_counter()                # finding 3: bare from-import
+
+
+def run_stamp():
+    return datetime.now().isoformat()    # finding 4

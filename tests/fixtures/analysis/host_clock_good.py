@@ -1,0 +1,36 @@
+"""Clean twin for the host-clock rule: everything rides the one shared
+clock — now()/Stopwatch for durations, epoch() for timestamps — and
+the deliberate non-reads (sleep, clock names inside string literals)
+stay silent."""
+
+import time
+
+from cpd_tpu.obs.timing import Stopwatch, epoch, now
+
+
+def step_duration(step_fn):
+    t0 = now()
+    step_fn()
+    return now() - t0
+
+
+def lap_times(step_fn, n):
+    watch = Stopwatch()
+    laps = []
+    for _ in range(n):
+        step_fn()
+        laps.append(watch.lap())
+    return laps
+
+
+def run_stamp():
+    return epoch()     # the ONE sanctioned epoch read, by name
+
+
+def backoff(attempt):
+    time.sleep(min(0.1 * attempt, 1.0))   # a delay, not a clock read
+
+
+# clock names inside string literals (subprocess scripts in tests) are
+# not calls and stay silent
+CHILD_SCRIPT = "import time; time.time()"

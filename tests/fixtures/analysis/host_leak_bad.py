@@ -1,0 +1,63 @@
+"""Deliberately-bad fixture for the host-leak rule: resources acquired
+or started with no with/finally-scoped or class-managed release — 5
+findings pinned in tests/test_analysis.py."""
+
+import threading
+
+
+def read_header(path):
+    fh = open(path)                      # finding 1: straight-path
+    data = fh.read(16)                   # close only — leaks on a
+    fh.close()                           # read() exception
+    return data
+
+
+class WindowProfiler:
+    """Opens a profiler window and never closes it."""
+
+    def __init__(self, profiler):
+        self.profiler = profiler
+
+    def step(self, s):
+        if s == 3:
+            self.profiler.start_trace("/tmp/trace")   # finding 2
+
+
+class ForgetfulWatchdog:
+    """A started Timer with no cancel path outlives its owner."""
+
+    def __init__(self, timeout):
+        self.timeout = timeout
+        self._timer = None
+
+    def arm(self):
+        self._timer = threading.Timer(self.timeout, self._fire)  # finding 3
+        self._timer.start()
+
+    def _fire(self):
+        return self.timeout
+
+
+class JoinlessWorker:
+    """A started non-daemon Thread with no join path."""
+
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run)        # finding 4
+
+    def start(self):
+        self._worker.start()
+
+    def _run(self):
+        return None
+
+
+class ManualLock:
+    """acquire() with no release() anywhere in the class."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        self._lock.acquire()             # finding 5
+        self.value += 1

@@ -1,0 +1,114 @@
+"""Clean twin for the host-leak rule: with-scoped handles, finally-
+scoped closes, ownership transfer, paired profiler windows, canceled
+timers, daemon/joined threads, with-scoped locks, class-managed
+files."""
+
+import threading
+
+
+def read_header(path):
+    with open(path) as fh:
+        return fh.read(16)
+
+
+def copy_text(src_path):
+    fh = open(src_path)
+    try:
+        return fh.read()
+    finally:
+        fh.close()
+
+
+def open_for_caller(path):
+    fh = open(path)
+    return fh          # ownership transfer: the caller closes
+
+
+class PairedProfiler:
+    """start_trace has a stop_trace in the same class."""
+
+    def __init__(self, profiler):
+        self.profiler = profiler
+        self.active = False
+
+    def step(self, s):
+        if s == 3:
+            self.profiler.start_trace("/tmp/trace")
+            self.active = True
+        elif s == 5 and self.active:
+            self.profiler.stop_trace()
+            self.active = False
+
+    def close(self):
+        if self.active:
+            self.profiler.stop_trace()
+            self.active = False
+
+
+class TidyWatchdog:
+    """Started Timer with a cancel path."""
+
+    def __init__(self, timeout):
+        self.timeout = timeout
+        self._timer = None
+
+    def arm(self):
+        self._timer = threading.Timer(self.timeout, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def close(self):
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _fire(self):
+        return self.timeout
+
+
+class JoinedWorker:
+    """Non-daemon Thread, joined in close()."""
+
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run)
+
+    def start(self):
+        self._worker.start()
+
+    def close(self):
+        self._worker.join()
+
+    def _run(self):
+        return None
+
+
+class ScopedLock:
+    """with-scoped lock use never trips the acquire/release pairing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+
+class ManagedFile:
+    """self-stored handle with a class-managed close (the ScalarWriter
+    shape)."""
+
+    def __init__(self, path):
+        self._fh = open(path, "a")
+
+    def write(self, line):
+        self._fh.write(line)
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

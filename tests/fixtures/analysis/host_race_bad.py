@@ -1,0 +1,73 @@
+"""Deliberately-bad fixture for the host-race rule: state shared
+between a thread/Timer callback and main-loop methods with a broken
+lock discipline — 3 findings pinned in tests/test_analysis.py."""
+
+import threading
+
+
+class InconsistentWatch:
+    """The watchdog defect shape: context armed UNDER the lock by the
+    main loop, read LOCK-FREE in the timer callback."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._context = {}
+        self._timer = None
+
+    def arm(self, step):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._context = {"step": step}
+            self._timer = threading.Timer(5.0, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def close(self):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    def _fire(self):
+        ctx = dict(self._context)        # finding 1: lock-free read
+        return ctx
+
+
+class UnlockedCollector:
+    """No lock anywhere, and the worker mutates a plain list the main
+    loop also drains — structure mutation across the thread boundary."""
+
+    def __init__(self, items):
+        self.results = []
+        self.done = False
+        self._items = list(items)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        for item in self._items:
+            self.results.append(item)    # finding 2: unlocked append
+        self.done = True                 # plain flag rebind: NOT flagged
+
+    def drain(self):
+        out = list(self.results)
+        self.results.clear()
+        return out
+
+
+class HalfLockedStats:
+    """Writes take the lock; the polling thread reads without it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stats = {}
+        self._poller = threading.Thread(target=self._poll, daemon=True)
+        self._poller.start()
+
+    def record(self, key, value):
+        with self._lock:
+            self.stats[key] = value
+
+    def _poll(self):
+        return sum(self.stats.values())  # finding 3: lock-free read

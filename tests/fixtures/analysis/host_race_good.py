@@ -1,0 +1,108 @@
+"""Clean twin for the host-race rule: thread-shared state with a
+consistent discipline — snapshot under the lock, synchronized handoff
+structures, the ``*_locked`` helper convention, and the deliberate
+plain-flag carve-out."""
+
+import queue
+import threading
+from collections import deque
+
+
+class SnapshotWatch:
+    """Both sides hold the same lock; the callback snapshots under it
+    and works on the snapshot (the watchdog fix shape)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._context = {}
+        self._timer = None
+
+    def arm(self, step):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._context = {"step": step}
+            self._timer = threading.Timer(5.0, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def close(self):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    def _fire(self):
+        with self._lock:
+            snapshot = dict(self._context)
+        self._handle(snapshot)
+
+    def _handle(self, snapshot):
+        return snapshot
+
+
+class QueueHandoff:
+    """Synchronized structures (queue.Queue, threading.Event) need no
+    extra lock — their methods synchronize internally."""
+
+    def __init__(self, items):
+        self._q = queue.Queue(maxsize=4)
+        self._stop = threading.Event()
+        self._items = list(items)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        for item in self._items:
+            if self._stop.is_set():
+                break
+            self._q.put(item)
+
+    def get(self):
+        return self._q.get()
+
+    def close(self):
+        self._stop.set()
+
+
+class LockedHelpers:
+    """The ``*_locked`` naming convention: helpers assumed to run with
+    the lock held, called from inside ``with self._lock:`` blocks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = deque(maxlen=64)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, item):
+        with self._lock:
+            self.pending.append(item)
+
+    def _run(self):
+        with self._lock:
+            self._drain_locked()
+
+    def _drain_locked(self):
+        while self.pending:
+            self.pending.popleft()
+
+
+class FlagOnly:
+    """A bare boolean rebind is CPython-atomic; crossing the thread
+    boundary unlocked is deliberately not flagged."""
+
+    def __init__(self):
+        self.tripped = False
+        self._timer = threading.Timer(1.0, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _fire(self):
+        self.tripped = True
+
+    def seen(self):
+        return self.tripped
+
+    def close(self):
+        self._timer.cancel()

@@ -1,0 +1,50 @@
+"""Deliberately-bad fixture for the host-unbounded rule: module-
+lifetime containers grown on the step/request clock with no cap,
+eviction, or prune anywhere — 4 findings pinned in
+tests/test_analysis.py."""
+
+from collections import deque
+
+
+class ReplayLog:
+    """The fleet replay-log defect: one entry per request, forever."""
+
+    def __init__(self):
+        self.events = []
+
+    def on_request(self, rid):
+        self.events.append(rid)          # finding 1
+
+
+class SessionIndex:
+    """Dict element stores on the admit clock; the snapshot-restore
+    rebind reads foreign state, which is NOT a prune."""
+
+    def __init__(self):
+        self.sessions = {}
+
+    def admit(self, sid, session):
+        self.sessions[sid] = session     # finding 2
+
+    def load_state_dict(self, state):
+        self.sessions = dict(state["sessions"])
+
+
+class SeenSet:
+    """Dedup sets keyed by an unbounded id space grow forever."""
+
+    def __init__(self):
+        self.seen = set()
+
+    def mark(self, key):
+        self.seen.add(key)               # finding 3
+
+
+class Timeline:
+    """A deque is only bounded when constructed with maxlen=."""
+
+    def __init__(self):
+        self.marks = deque()
+
+    def tick(self, t):
+        self.marks.append(t)             # finding 4

@@ -1,0 +1,80 @@
+"""Clean twin for the host-unbounded rule: every recognized bound —
+deque(maxlen=), cap+eviction, comprehension prune, slice truncate,
+keyed eviction — plus init-only growth."""
+
+from collections import deque
+
+
+class RingLog:
+    """Bounded by construction."""
+
+    def __init__(self, cap):
+        self.events = deque(maxlen=cap)
+
+    def on_request(self, rid):
+        self.events.append(rid)
+
+
+class CappedLog:
+    """Explicit cap + oldest-out eviction (the ResultStore shape)."""
+
+    CAP = 1024
+
+    def __init__(self):
+        self.entries = []
+
+    def push(self, item):
+        self.entries.append(item)
+        if len(self.entries) > self.CAP:
+            del self.entries[0]
+
+
+class PrunedPlacement:
+    """A rebind that re-reads the attr is a prune (the fleet router's
+    comprehension filter)."""
+
+    def __init__(self):
+        self.placement = {}
+
+    def assign(self, sid, engine):
+        self.placement[sid] = engine
+
+    def sweep(self, live):
+        self.placement = {sid: e for sid, e in self.placement.items()
+                          if sid in live}
+
+
+class TruncatedTrace:
+    """Slice-truncate rebind: keeps the newest window."""
+
+    def __init__(self):
+        self.trace = []
+
+    def record(self, event):
+        self.trace.append(event)
+        self.trace = self.trace[-256:]
+
+
+class EvictingCache:
+    """Keyed eviction via pop."""
+
+    def __init__(self):
+        self.cache = {}
+
+    def put(self, key, value):
+        self.cache[key] = value
+
+    def evict(self, key):
+        self.cache.pop(key, None)
+
+
+class StaticTable:
+    """Growth only inside __init__ is setup, not step-clock growth."""
+
+    def __init__(self, names):
+        self.rows = []
+        for name in names:
+            self.rows.append((name, 0))
+
+    def lookup(self, name):
+        return [r for r in self.rows if r[0] == name]

@@ -33,9 +33,10 @@ import textwrap
 
 import pytest
 
-from cpd_tpu.analysis import (all_rules, lint_file, lint_source,
-                              lint_tree, module_rules, program_rules,
-                              project_rules, run_analysis)
+from cpd_tpu.analysis import (all_rules, host_rules, lint_file,
+                              lint_source, lint_tree, module_rules,
+                              program_rules, project_rules,
+                              run_analysis)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
@@ -55,9 +56,10 @@ def _fixture(rule_id: str, kind: str) -> str:
 def test_catalog_is_complete():
     assert RULE_IDS == ["axis-flow", "axis-name", "collective-contract",
                         "compat-drift", "donation", "format-bounds",
-                        "format-flow", "ir-bitwise", "ir-overlap",
-                        "ir-retrace", "ir-schedule", "ir-trace",
-                        "ir-wire-ledger", "jit-hazards",
+                        "format-flow", "host-clock", "host-leak",
+                        "host-race", "host-unbounded", "ir-bitwise",
+                        "ir-overlap", "ir-retrace", "ir-schedule",
+                        "ir-trace", "ir-wire-ledger", "jit-hazards",
                         "kahan-ordering", "obs-print", "pallas-hygiene",
                         "retrace", "swallow"]
 
@@ -68,8 +70,10 @@ def test_scope_split():
     assert sorted(program_rules()) == ["ir-bitwise", "ir-overlap",
                                        "ir-retrace", "ir-schedule",
                                        "ir-trace", "ir-wire-ledger"]
+    assert sorted(host_rules()) == ["host-clock", "host-leak",
+                                    "host-race", "host-unbounded"]
     assert (set(module_rules()) | set(project_rules())
-            | set(program_rules())) == set(RULE_IDS)
+            | set(program_rules()) | set(host_rules())) == set(RULE_IDS)
 
 
 @pytest.mark.parametrize("rule_id", AST_RULE_IDS)
@@ -111,7 +115,11 @@ def test_bad_fixture_finding_counts():
                 "compat-drift": 5,
                 # ISSUE 11: ad-hoc stdout telemetry bypassing the obs
                 # MetricsRegistry
-                "obs-print": 3}
+                "obs-print": 3,
+                # v4 host-runtime contracts (per-class dataflow over
+                # long-lived serving/fleet/obs objects — ISSUE 16)
+                "host-race": 3, "host-unbounded": 4, "host-leak": 5,
+                "host-clock": 4}
     # program-scope (ir-*) counts are pinned in tests/test_analysis_ir.py
     # against their fixture REGISTRIES, not lint_file-able sources
     assert set(expected) == set(AST_RULE_IDS), \
@@ -633,7 +641,13 @@ def test_live_suppression_count_is_pinned():
                         f"{path}:{tok.start[0]}: suppression without a "
                         f"written justification: {payload!r}")
                     sites.append((path, tok.start[0], payload))
-    assert len(sites) == 8, (
+    # 8 pre-v4 + 6 host-unbounded claims added with the host scope
+    # (ISSUE 16): Injector.fired/log (bounded by the fault plan),
+    # StepTable._cache (static level vocabulary), MetricsRegistry
+    # ._metrics (declared-name cardinality), ServeEngine.logits_log
+    # (tests-only oracle tap), TSVLogger.log (one line per epoch — the
+    # DAWNBench artifact itself)
+    assert len(sites) == 14, (
         "live-tree suppression count changed — review the new/removed "
         "site's justification and re-pin:\n" + "\n".join(
             f"{p}:{ln}: {pl}" for p, ln, pl in sites))

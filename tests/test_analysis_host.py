@@ -1,0 +1,198 @@
+"""The v4 host scope (ISSUE 16) — host-rule behaviour that is NOT the
+per-fixture TP/TN coverage (that lives in tests/test_analysis.py, where
+host rules ride AST_RULE_IDS and the pinned finding counts):
+
+1. host findings ride the per-file fingerprint cache: a warm unchanged
+   run re-analyzes ZERO files yet reports identical host findings, and
+   an edit invalidates exactly the edited file;
+2. the cache fingerprint folds the host scope in — a SCHEMA_VERSION
+   bump (the required companion of any rule-logic edit) and a
+   [tool.cpd-lint] config edit each invalidate a warm cache;
+3. the CLI exit-code contract (0 clean / 1 findings / 2 internal
+   error) holds for the new scope, including crash-is-exit-2: a host
+   rule raising is an analyzer bug (LintError), never "findings";
+4. ``--explain <host-rule>`` prints the rule's catalog entry (class
+   docstring) plus both fixture halves.
+
+Stdlib-only like the analysis package itself — runs without jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from cpd_tpu.analysis import all_rules, host_rules, lint_source, run_analysis
+from cpd_tpu.analysis import cache as lint_cache
+from cpd_tpu.analysis.core import LintError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def _fixture(rule_id: str, kind: str) -> str:
+    return os.path.join(FIXTURES, f"{rule_id.replace('-', '_')}_{kind}.py")
+
+
+def _write_tree(tmp_path, files: dict) -> str:
+    root = tmp_path / "proj"
+    root.mkdir(parents=True, exist_ok=True)
+    for rel, body in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return str(root)
+
+
+# one minimal host-unbounded defect: a module-lifetime log grown on the
+# record clock with no shrink anywhere in the class
+_UNBOUNDED = """\
+    class StepLog:
+        def __init__(self):
+            self.entries = []
+
+        def record(self, item):
+            self.entries.append(item)
+"""
+
+# the fixed twin: an eviction path makes the growth bounded (kept
+# un-dedented — it is appended verbatim as a method of StepLog)
+_FIX = """
+    def _evict(self):
+        del self.entries[0]
+"""
+
+
+# ---------------------------------------------------------------------------
+# 1+2. host findings ride the fingerprint cache; the fingerprint folds
+# the scope in
+# ---------------------------------------------------------------------------
+
+def test_host_findings_ride_the_warm_cache(tmp_path):
+    src_dir = _write_tree(tmp_path, {"log.py": _UNBOUNDED,
+                                     "clean.py": "x = 1\n"})
+    cache_dir = str(tmp_path / "cache")
+
+    cold = run_analysis([src_dir], cache_dir=cache_dir)
+    assert [f.rule for f in cold.findings] == ["host-unbounded"]
+    assert cold.files_parsed == 2
+
+    # warm unchanged tree: ZERO files re-analyzed, identical findings —
+    # host findings are served from the per-file cache like any other
+    warm = run_analysis([src_dir], cache_dir=cache_dir)
+    assert warm.files_parsed == 0, "warm unchanged tree must re-parse 0"
+    assert warm.findings == cold.findings
+
+    # fixing the defect invalidates exactly the edited file
+    path = os.path.join(src_dir, "log.py")
+    with open(path, "a") as fh:
+        fh.write(_FIX)
+    os.utime(path, (os.path.getmtime(path) + 2,) * 2)
+    third = run_analysis([src_dir], cache_dir=cache_dir)
+    assert third.files_parsed == 1
+    assert third.findings == []
+
+
+def test_host_schema_bump_invalidates_warm_cache(tmp_path, monkeypatch):
+    """Any host-rule logic edit ships with a SCHEMA_VERSION bump (the
+    cache module's stated policy); pin that the bump actually flushes
+    warm verdicts instead of serving results from the old rule."""
+    src_dir = _write_tree(tmp_path, {"log.py": _UNBOUNDED})
+    cache_dir = str(tmp_path / "cache")
+
+    run_analysis([src_dir], cache_dir=cache_dir)
+    warm = run_analysis([src_dir], cache_dir=cache_dir)
+    assert warm.files_parsed == 0
+
+    monkeypatch.setattr(lint_cache, "SCHEMA_VERSION",
+                        lint_cache.SCHEMA_VERSION + 1)
+    bumped = run_analysis([src_dir], cache_dir=cache_dir)
+    assert bumped.files_parsed == 1, \
+        "a schema bump must invalidate every warm entry"
+    assert [f.rule for f in bumped.findings] == ["host-unbounded"]
+
+
+def test_host_config_edit_invalidates_warm_cache(tmp_path):
+    """Exempting a host rule in [tool.cpd-lint] must take effect on the
+    very next run even against a warm cache (the resolved config is
+    part of the fingerprint), and dropping the exemption must resurface
+    the finding."""
+    src_dir = _write_tree(tmp_path, {"log.py": _UNBOUNDED})
+    pyproject = tmp_path / "pyproject.toml"
+    pyproject.write_text('[tool.cpd-lint.exempt]\n'
+                         '"host-unbounded" = ["proj/"]\n')
+    cache_dir = str(tmp_path / "cache")
+
+    cold = run_analysis([src_dir], cache_dir=cache_dir)
+    assert cold.findings == []          # exempted by config
+    warm = run_analysis([src_dir], cache_dir=cache_dir)
+    assert warm.files_parsed == 0
+
+    pyproject.write_text('[tool.cpd-lint.exempt]\n'
+                         '"host-unbounded" = ["elsewhere/"]\n')
+    third = run_analysis([src_dir], cache_dir=cache_dir)
+    assert third.files_parsed == 1, \
+        "config edit must invalidate the warm cache"
+    assert [f.rule for f in third.findings] == ["host-unbounded"]
+
+
+# ---------------------------------------------------------------------------
+# 3. exit-code contract for the host scope
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "cpd_tpu.analysis", "--no-cache", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=180)
+
+
+def test_cli_host_exit_0_on_clean_and_1_on_findings():
+    for rule_id in sorted(host_rules()):
+        proc = _run_cli("--select", rule_id, _fixture(rule_id, "good"))
+        assert proc.returncode == 0, (rule_id, proc.stdout, proc.stderr)
+    proc = _run_cli("--format=json", "--select", "host-clock",
+                    _fixture("host-clock", "bad"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["counts"]["host-clock"] == 4
+
+
+def test_host_rule_crash_is_a_lint_error(monkeypatch):
+    """A host rule raising is an engine bug: it must surface as
+    LintError (CLI exit 2 — gate down), never as findings (exit 1) or
+    silence (exit 0)."""
+    def boom(ctx):
+        raise RuntimeError("synthetic host-rule crash")
+
+    monkeypatch.setattr(all_rules()["host-race"], "check", boom)
+    with pytest.raises(LintError, match="host-race.*crashed"):
+        lint_source("class A:\n    pass\n", path="x.py",
+                    select=["host-race"])
+
+
+# ---------------------------------------------------------------------------
+# 4. --explain covers the host catalog
+# ---------------------------------------------------------------------------
+
+_EXPLAIN_PHRASE = {
+    # a distinctive fragment of each rule's class docstring, so the
+    # catalog entry printed really is the rule's own contract text
+    "host-race": "thread/Timer callback",
+    "host-unbounded": "step/request clock",
+    "host-leak": "class-managed",
+    "host-clock": "obs/timing.py",
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(_EXPLAIN_PHRASE))
+def test_cli_explain_host_rules(rule_id):
+    proc = _run_cli("--explain", rule_id)
+    assert proc.returncode == 0, proc.stderr
+    assert rule_id in proc.stdout
+    assert _EXPLAIN_PHRASE[rule_id] in proc.stdout
+    # both fixture halves are printed
+    assert "FIRES on" in proc.stdout
+    assert "stays SILENT on" in proc.stdout

@@ -43,6 +43,17 @@ def test_timer_accumulates():
     assert t.total_time == pytest.approx(a + c, abs=1e-9)
 
 
+def test_timer_state_is_o1():
+    """Regression (host-unbounded, v4): Timer must keep only the last
+    mark — the reference appended every timestamp to a list, which on a
+    long-lived loop grows on the step clock forever."""
+    t = Timer()
+    deltas = [t() for _ in range(50)]
+    assert all(d >= 0 for d in deltas)
+    assert not any(isinstance(v, (list, dict, set))
+                   for v in vars(t).values())
+
+
 def test_stopwatch_laps_and_elapsed():
     w = Stopwatch()
     d1 = w.lap()

@@ -969,10 +969,61 @@ def test_restore_unverified_checkpoint_counted_separately(tmp_path,
 
 
 # ---------------------------------------------------------------------------
-# trainer CLI under a fault plan (full stack; slow tier)
+# v4 host-contract regressions (ISSUE 16): the live defects the host
+# scope surfaced, pinned so they cannot come back
 # ---------------------------------------------------------------------------
 
-@pytest.mark.slow
+def test_watchdog_on_trip_payload_is_fire_time_snapshot(monkeypatch):
+    """Regression (host-race): _fire must snapshot _context ONCE, under
+    the lock — a re-arm racing in between the diagnostic print and the
+    on_trip hook (here injected deterministically via the stack-dump
+    call that sits between them) must not leak the NEXT step's context
+    into the dump."""
+    import faulthandler
+
+    seen = []
+    wd = StepWatchdog(60.0, interrupt=False, on_trip=seen.append)
+    monkeypatch.setattr(faulthandler, "dump_traceback",
+                        lambda **kw: wd.arm(8, loss=9.9))
+    wd.arm(7, loss=1.25)
+    try:
+        wd._fire()                    # deterministic trip, no timer wait
+        assert seen == [{"step": 7, "loss": 1.25}]
+    finally:
+        wd.close()
+
+
+def test_transport_transitions_log_is_capped():
+    """Regression (host-unbounded): a flapping transport must not grow
+    the transition log forever; the newest entries are retained."""
+    from cpd_tpu.resilience import TransportSupervisor
+
+    sup = TransportSupervisor(start="ring", max_retries=0, probation=1)
+    sup.TRANSITION_CAP = 8            # instance override to keep it fast
+    for step in range(100):
+        if sup.degraded:
+            sup.on_success(step)
+        else:
+            sup.on_failure(step)
+    assert len(sup.transitions) == 8
+    assert sup.transitions[-1][0] == 99      # newest retained
+    assert sup.transitions[0][0] == 92       # oldest dropped
+
+
+def test_precision_transitions_log_is_capped():
+    """Regression (host-unbounded): same cap for the format ladder."""
+    from cpd_tpu.resilience import PrecisionSupervisor
+
+    sup = PrecisionSupervisor("e5m2,e5m7", patience=1, probation=1)
+    sup.TRANSITION_CAP = 6
+    hot = {"prec_wire_sat": 50.0, "prec_wire_nan": 0.0,
+           "prec_wire_total": 100.0}
+    quiet = {"prec_wire_sat": 0.0, "prec_wire_nan": 0.0,
+             "prec_wire_total": 100.0}
+    for step in range(100):
+        sup.on_metrics(step, hot if not sup.escalated else quiet)
+    assert len(sup.transitions) == 6
+    assert sup.transitions[-1][0] == 99
 def test_lm_trainer_chaos_cli(tmp_path):
     from lm.train import main
     res = main(["--max-iter", "12", "--d-model", "32", "--n-layers", "1",

@@ -617,6 +617,19 @@ def test_supervisor_state_machine_and_roundtrip():
             sup.state_dict())
 
 
+def test_supervisor_transitions_log_is_capped():
+    """Regression (host-unbounded, v4): a flapping ladder on a
+    long-lived serving host must not grow the transition log forever;
+    the newest entries are retained."""
+    sup = ServeSupervisor(default_rungs(8), patience=1, probation=1)
+    sup.TRANSITION_CAP = 8            # instance override to keep it fast
+    for step in range(100):
+        sup.on_step(step, page_util=0.0 if sup.degraded else 1.0)
+    assert len(sup.transitions) == 8
+    assert sup.transitions[-1][0] == 99      # newest retained
+    assert sup.transitions[0][0] == 92       # oldest dropped
+
+
 def test_kv_storm_forces_supervisor_reaction(gqa_model):
     """kv_storm flips multiple live pages at once: the scrubber repairs
     them AND the supervisor sees the corruption signal, degrades a

@@ -9,6 +9,8 @@ import time
 
 import pytest
 
+from cpd_tpu.obs.timing import now
+
 
 # ------------------------------------------------------------- config
 
@@ -156,10 +158,10 @@ def test_prefetcher_next_after_close_raises_stopiteration():
     it = iter(pf)
     assert next(it) == 0
     pf.close()
-    t0 = time.monotonic()
+    t0 = now()
     with pytest.raises(StopIteration):
         next(it)
-    assert time.monotonic() - t0 < 2.0   # prompt, not a hang/timeout pile
+    assert now() - t0 < 2.0   # prompt, not a hang/timeout pile
     with pytest.raises(StopIteration):   # and stays exhausted
         next(it)
 

@@ -30,7 +30,6 @@ import os
 import re
 import statistics
 import sys
-import time
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
@@ -42,6 +41,8 @@ import numpy as np  # noqa: E402
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
+
+from cpd_tpu.obs.timing import now  # noqa: E402  (the one clock; jax-free)
 
 
 def measure(dp: int, pp: int, m: int, remat: bool, *, d_model=192,
@@ -75,11 +76,11 @@ def measure(dp: int, pp: int, m: int, remat: bool, *, d_model=192,
                               donate=False)
     times = []
     for i in range(warmup + steps):
-        t0 = time.perf_counter()
+        t0 = now()
         state, metrics = step(state, toks, tgts)
         jax.block_until_ready(metrics["loss"])
         if i >= warmup:
-            times.append(time.perf_counter() - t0)
+            times.append(now() - t0)
     assert np.isfinite(float(metrics["loss"]))
     return statistics.median(times)
 

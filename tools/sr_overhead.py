@@ -19,11 +19,12 @@ import json
 import os
 import re
 import sys
-import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
+
+from cpd_tpu.obs.timing import now  # noqa: E402  (the one clock; jax-free)
 
 if os.environ.get("ON_TPU") != "1":
     # the 8-device virtual mesh, BEFORE jax import (same pattern as
@@ -80,11 +81,11 @@ def main() -> int:
         out = fn(grads)                      # compile + warm
         jax.block_until_ready(out)
         reps = 3
-        t0 = time.perf_counter()
+        t0 = now()
         for _ in range(reps):
             out = fn(grads)
         jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / reps * 1e3
+        return (now() - t0) / reps * 1e3
 
     t_near = run("nearest", None)
     key = grad_sr_key(0, jnp.zeros([], jnp.int32), 1)

@@ -24,11 +24,12 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
+
+from cpd_tpu.obs.timing import now  # noqa: E402  (the one clock; jax-free)
 
 
 def sync_scalar(x) -> float:
@@ -40,12 +41,12 @@ def sync_scalar(x) -> float:
 def windows(fn, sync, n_windows=4, per=5):
     rates = []
     for _ in range(n_windows):
-        t0 = time.perf_counter()
+        t0 = now()
         out = None
         for _ in range(per):
             out = fn()
         sync(out)
-        rates.append((time.perf_counter() - t0) / per)
+        rates.append((now() - t0) / per)
     rates.sort()
     return rates[0], rates[len(rates) // 2]   # best, median seconds/iter
 
@@ -107,17 +108,17 @@ def main() -> int:
     x = jnp.asarray(rng.randn(batch, 224, 224, 3).astype(np.float32),
                     jnp.bfloat16)
     y = jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
-    t0 = time.perf_counter()
+    t0 = now()
     state = create_train_state(model, tx, x[:2], jax.random.PRNGKey(0))
     sync_scalar(jax.tree.leaves(state.params)[0])
-    print(f"init: {time.perf_counter()-t0:.1f}s", flush=True)
+    print(f"init: {now()-t0:.1f}s", flush=True)
 
     # 2. forward only
     fwd = jax.jit(lambda p, s, xx: model.apply(
         {"params": p, "batch_stats": s}, xx, train=False))
-    t0 = time.perf_counter()
+    t0 = now()
     sync_scalar(fwd(state.params, state.batch_stats, x))
-    print(f"fwd compile+run: {time.perf_counter()-t0:.1f}s", flush=True)
+    print(f"fwd compile+run: {now()-t0:.1f}s", flush=True)
     best, med = win(lambda: fwd(state.params, state.batch_stats, x),
                     sync_scalar)
     print(f"fwd-only: best {batch/best:.1f} img/s ({best*1e3:.1f} ms), "
@@ -145,9 +146,9 @@ def main() -> int:
             holder["s"], m = step(holder["s"], x, y)
             return m["loss"]
 
-        t0 = time.perf_counter()
+        t0 = now()
         sync_scalar(one_step())
-        print(f"{name} compile+run: {time.perf_counter()-t0:.1f}s",
+        print(f"{name} compile+run: {now()-t0:.1f}s",
               flush=True)
         best, med = win(one_step, sync_scalar)
         print(f"{name}: best {batch/best:.1f} img/s ({best*1e3:.1f} ms), "
@@ -177,9 +178,9 @@ def main() -> int:
         def dec():
             return generate(lm, lm_params, prompt, max_new_tokens=t_new)
 
-        t0 = time.perf_counter()
+        t0 = now()
         sync_scalar(dec())
-        print(f"decode compile+run: {time.perf_counter()-t0:.1f}s",
+        print(f"decode compile+run: {now()-t0:.1f}s",
               flush=True)
         best, med = win(dec, sync_scalar)
         n_tok = b_dec * t_new
