@@ -15,6 +15,14 @@ fleet scope unchanged.
   digests) digest-sealed for live migration; the restored session's
   remaining decode is BITWISE identical to the unmigrated run at
   (8, 23).
+* `autoscale.Autoscaler` / `AutoscalePolicy` — step-clock-
+  deterministic elastic width (ISSUE 17): sustained page-pressure /
+  queue / shed signals spawn engines (`Fleet.spawn_engine`, joining
+  the fleet clock mid-run), sustained idleness drains the least-loaded
+  one down through the capsule-migration path (zero sessions lost,
+  survivors bitwise unchanged), with patience + cooldown hysteresis
+  and immediate floor repair after kill waves; `Fleet.shape_log`
+  records every decision and the soak gate pins the sequence ×2.
 * `prefix.PrefixCache` — content-addressed prefix caching: full
   prompt-prefix pages indexed by token digest, shared copy-on-write
   across requests (refcounted through the scheduler), every digest hit
@@ -29,11 +37,12 @@ merged per-engine Chrome-trace lanes
 (`obs.export.merge_chrome_traces`).  See docs/SERVING.md "Fleet".
 """
 
+from .autoscale import AutoscalePolicy, Autoscaler
 from .migrate import (SessionCapsule, can_adopt, extract_capsule,
                       migrate_session, restore_capsule)
 from .prefix import PrefixCache, token_digest
 from .router import Fleet
 
-__all__ = ["Fleet", "SessionCapsule", "extract_capsule",
-           "restore_capsule", "migrate_session", "can_adopt",
-           "PrefixCache", "token_digest"]
+__all__ = ["Fleet", "Autoscaler", "AutoscalePolicy", "SessionCapsule",
+           "extract_capsule", "restore_capsule", "migrate_session",
+           "can_adopt", "PrefixCache", "token_digest"]

@@ -1,0 +1,214 @@
+"""Autoscaler — step-clock-deterministic elastic fleet policy (ISSUE 17).
+
+The fleet's width becomes a POLICY OUTPUT instead of a constructor
+constant: every `Fleet.step` the autoscaler observes the same live
+signals the router already prices (page utilization, queue depth, the
+shed counters) and decides — on the shared step clock, from step-clock
+state only — whether to spawn an engine, drain one down, or hold.
+
+Determinism is the whole design: the observation is a pure function of
+(engine state, counters) and the hysteresis state is plain integers
+advanced once per fleet step, so two runs of the same (model, trace,
+plans, policy) produce the identical sequence of scaling decisions —
+`Fleet.shape_log` records it and the soak gate pins it ×2.  No wall
+clock anywhere (the PR 16 ``host-clock`` rule applies to this class).
+
+Policy shape (docs/SERVING.md "Elastic fleet" has the table):
+
+* **scale-up** — any accepting engine at/over ``up_page_util`` page
+  pressure or ``up_queue`` backlog, or fleet-scope shed counters
+  advancing, is a HOT step; ``up_patience`` consecutive hot steps spawn
+  one engine (`Fleet.spawn_engine` — joins the fleet clock mid-run).
+* **scale-down** — every accepting engine at/under ``down_page_util``
+  with empty queues and no shedding is a COLD step; ``down_patience``
+  consecutive cold steps drain the least-loaded accepting engine
+  (`Fleet.scale_down` — the PR 13 `drain_engine` + capsule-migration
+  path, so scale-down loses zero sessions and the survivors' decode
+  stays bitwise identical).
+* **hysteresis** — ``cooldown_steps`` after any action both streaks
+  restart from zero, so pressure oscillating around a threshold cannot
+  thrash spawn/drain cycles.
+* **floor repair** — whenever fewer than ``min_engines`` engines
+  accept work (a kill wave just went through), replacements spawn
+  IMMEDIATELY, bypassing patience and cooldown: restoring the
+  configured floor is recovery, not scaling.
+
+The hysteresis state round-trips through `state_dict` /
+`load_state_dict` (plain ints, JSON-ready) so a control-plane restart
+resumes the policy exactly where it left off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["AutoscalePolicy", "Autoscaler"]
+
+_SCALE_COUNTERS = ("ups", "downs", "floor_repairs", "hot_steps",
+                   "cold_steps")
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """The knobs (module docstring).  Frozen: a policy is part of the
+    run's identity — mutating it mid-run would silently fork the
+    deterministic decision sequence."""
+
+    min_engines: int = 1
+    max_engines: int = 4
+    up_page_util: float = 0.85
+    up_queue: int = 4
+    up_patience: int = 3
+    down_page_util: float = 0.30
+    down_patience: int = 8
+    cooldown_steps: int = 12
+
+    def __post_init__(self):
+        if self.min_engines < 1:
+            raise ValueError(f"min_engines must be >= 1, got "
+                             f"{self.min_engines}")
+        if self.max_engines < self.min_engines:
+            raise ValueError(
+                f"max_engines ({self.max_engines}) < min_engines "
+                f"({self.min_engines})")
+        if not (0.0 <= self.down_page_util <= self.up_page_util <= 1.0):
+            raise ValueError(
+                f"need 0 <= down_page_util <= up_page_util <= 1, got "
+                f"({self.down_page_util}, {self.up_page_util})")
+        if min(self.up_patience, self.down_patience) < 1:
+            raise ValueError("patience values must be >= 1")
+        if self.cooldown_steps < 0:
+            raise ValueError("cooldown_steps must be >= 0")
+
+
+class Autoscaler:
+    """Hysteresis state + decision procedure.  One instance per fleet,
+    handed to `Fleet(autoscaler=...)`; the fleet calls `observe` once
+    per step (after fleet faults fire, before the engines step, so a
+    kill wave's floor repair lands inside the same step)."""
+
+    def __init__(self, policy: Optional[AutoscalePolicy] = None):
+        self.policy = policy or AutoscalePolicy()
+        self.counters = {k: 0 for k in _SCALE_COUNTERS}
+        self.hot_streak = 0
+        self.cold_streak = 0
+        # first step at which a non-repair action is allowed again
+        self.cooldown_until = 0
+        self._prev_shed = 0
+
+    # -- signals ----------------------------------------------------------
+
+    def _shed_total(self, fleet) -> int:
+        """Monotone fleet-wide shed pressure: engine admission/purge
+        sheds plus fleet-scope sheds (counters, not stores — exact
+        regardless of eviction)."""
+        total = fleet.counters["fleet_shed"]
+        for i in fleet.live_engines():
+            total += fleet.engines[i].counters.get("shed", 0)
+        return int(total)
+
+    def classify(self, fleet) -> str:
+        """``"hot"`` / ``"cold"`` / ``"warm"`` for the current step —
+        a pure read of step-clock state (module docstring)."""
+        shed_now = self._shed_total(fleet)
+        shedding = shed_now > self._prev_shed
+        self._prev_shed = shed_now
+        utils, queues = [], []
+        for i, e in enumerate(fleet.engines):
+            if not fleet.accepting[i]:
+                continue
+            utils.append(e.sched.page_utilization())
+            queues.append(len(e.sched.queue))
+        if not utils:
+            return "hot"                  # nobody accepting: pressure
+        p = self.policy
+        if (shedding or max(utils) >= p.up_page_util
+                or max(queues) >= p.up_queue):
+            return "hot"
+        if max(utils) <= p.down_page_util and sum(queues) == 0 \
+                and not shedding:
+            return "cold"
+        return "warm"
+
+    # -- the per-step decision --------------------------------------------
+
+    def observe(self, fleet, step: int) -> Optional[str]:
+        """Advance the hysteresis one step and act through the fleet's
+        scaling hooks.  Returns the action taken (``"up"`` / ``"down"``
+        / ``"floor"``) or None."""
+        p = self.policy
+        accepting = sum(fleet.accepting)
+        if accepting < p.min_engines:
+            # recovery, not scaling: bypass patience and cooldown, and
+            # restart the streaks — post-repair pressure readings start
+            # from a fresh fleet shape
+            for _ in range(p.min_engines - accepting):
+                fleet.spawn_engine()
+            self.counters["floor_repairs"] += p.min_engines - accepting
+            self.hot_streak = 0
+            self.cold_streak = 0
+            self.cooldown_until = step + p.cooldown_steps
+            return "floor"
+        state = self.classify(fleet)
+        if state == "hot":
+            self.counters["hot_steps"] += 1
+            self.hot_streak += 1
+            self.cold_streak = 0
+        elif state == "cold":
+            self.counters["cold_steps"] += 1
+            self.cold_streak += 1
+            self.hot_streak = 0
+        else:
+            self.hot_streak = 0
+            self.cold_streak = 0
+        if step < self.cooldown_until:
+            return None
+        if self.hot_streak >= p.up_patience and accepting < p.max_engines:
+            fleet.spawn_engine()
+            self.counters["ups"] += 1
+            self.hot_streak = 0
+            self.cooldown_until = step + p.cooldown_steps
+            return "up"
+        if self.cold_streak >= p.down_patience \
+                and accepting > p.min_engines:
+            victim = self._victim(fleet)
+            if victim is not None:
+                fleet.scale_down(victim)
+                self.counters["downs"] += 1
+                self.cold_streak = 0
+                self.cooldown_until = step + p.cooldown_steps
+                return "down"
+        return None
+
+    def _victim(self, fleet) -> Optional[int]:
+        """Least-loaded accepting engine; exact ties retire the HIGHEST
+        index (the newest spare), so the fleet contracts in the reverse
+        order it grew.  Deterministic like every other routing choice."""
+        best = None
+        for i, e in enumerate(fleet.engines):
+            if not fleet.accepting[i]:
+                continue
+            key = (e.sched.page_utilization(), len(e.sched.queue), -i)
+            if best is None or key < best[0]:
+                best = (key, i)
+        return None if best is None else best[1]
+
+    # -- persistence ------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "counters": dict(self.counters),
+            "hot_streak": self.hot_streak,
+            "cold_streak": self.cold_streak,
+            "cooldown_until": self.cooldown_until,
+            "prev_shed": self._prev_shed,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.counters = {k: int(v) for k, v
+                         in state["counters"].items()}
+        self.hot_streak = int(state["hot_streak"])
+        self.cold_streak = int(state["cold_streak"])
+        self.cooldown_until = int(state["cooldown_until"])
+        self._prev_shed = int(state["prev_shed"])

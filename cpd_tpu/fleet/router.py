@@ -1,4 +1,5 @@
-"""Fleet — N `ServeEngine`s behind one front door (ISSUE 13 tentpole).
+"""Fleet — N `ServeEngine`s behind one front door (ISSUE 13 tentpole,
+elastic since ISSUE 17).
 
 The fleet steps its member engines in LOCKSTEP on one shared step
 clock, so everything the serving stack already guarantees per engine —
@@ -30,7 +31,8 @@ A SHED verdict triggers **bounded retry** on the next-best engine
 engine sheds is the rid resolved at FLEET scope (``Fleet.shed`` store,
 ``fleet_shed`` counter) — `Fleet.unresolved()` is therefore empty on a
 drained fleet: every submitted rid resolved FINISHED/SHED/DEADLINE_MISS
-*somewhere*, across routing retries, migration and engine kills.
+*somewhere*, across routing retries, migration, engine kills and
+scaling.
 
 **Recovery** (the ``engine_kill@s:e`` fleet fault kind): the fleet
 keeps, per engine, the last periodic digest-sealed snapshot
@@ -45,11 +47,28 @@ moment of death, because every engine step is a pure function of
 queued work re-routes to the survivors, live sessions migrate out
 where capacity allows (`fleet.migrate`), and whatever cannot move
 finishes locally.  Zero silent drops, counters exact across runs (the
-fleet-smoke drill pins it, ×2).
+fleet-smoke drill pins it, ×2).  ``kill_wave@s:{count}`` is the
+coordinated multi-engine version: the wave closes admissions on every
+victim FIRST (so drain migration lands only on true survivors), then
+runs the same recover-and-drain per victim — always leaving at least
+one accepting engine; any shortfall is counted
+(``kill_wave_shortfall``), never silent.
 
-Scale-in and engine replacement reuse the same two primitives:
-`Fleet.drain_engine` (migrate + re-route + close admissions) and
-`Fleet.migrate` (one session, bitwise resume).
+**Elasticity** (ISSUE 17): `spawn_engine` adds capacity mid-run (the
+new engine joins the shared step clock AT the current fleet step) and
+`scale_down` retires it through the SAME drain + capsule-migration
+path as recovery, so scale-down loses zero sessions and the migrated
+sessions' remaining decode stays bitwise identical.  Engine rows are
+slot-stable: a retired engine keeps its index (historical events and
+counters stay addressable) until `spawn_engine` RECYCLES the row —
+reuse-first keeps the per-engine control-plane arrays bounded at the
+fleet's peak concurrent width (``AutoscalePolicy.max_engines`` under
+the autoscaler) however long the scale churn runs.  A recycled row's
+final counters fold into an accumulator first, so
+`aggregate_counters` stays exact across arbitrary churn.  Scaling
+decisions, kills and retirements append to the bounded ``shape_log`` —
+two runs of the same inputs produce the identical shape history (the
+soak gate pins it ×2).
 """
 
 from __future__ import annotations
@@ -70,7 +89,9 @@ __all__ = ["Fleet"]
 _FLEET_COUNTERS = ("submitted", "routed", "router_retries", "fleet_shed",
                    "migrations", "requeued", "engine_kills",
                    "sessions_recovered", "drains",
-                   "fleet_faults_unfired")
+                   "fleet_faults_unfired", "kill_waves",
+                   "kill_wave_shortfall", "engines_spawned",
+                   "engines_retired")
 
 
 class Fleet:
@@ -81,26 +102,33 @@ class Fleet:
     model, params : shared by every engine (the fleet serves ONE
         model; jitted step programs are shared through the serve-side
         step cache, so N engines compile once).
-    n_engines : fleet width.
+    n_engines : initial fleet width (the live width changes under
+        `spawn_engine` / `scale_down` / the autoscaler).
     engine_kw : `ServeEngine` keyword dict applied to every engine
-        (n_slots, max_seq, kv_format, ...).
+        (n_slots, max_seq, kv_format, ...) — including engines spawned
+        later.
     prefix_cache_pages : when set, every engine gets its own
         `PrefixCache(capacity_pages=...)` — per-engine, because page
         ids are pool-local; the router's affinity signal steers
         shared-prefix traffic back to the engine holding the pages.
-    fault_plan : fleet-clock chaos (`FLEET_KINDS`: ``engine_kill``).
-        Requires ``snapshot_every`` > 0 and ``snapshot_dir`` — a kill
-        without a snapshot to recover from would be a guaranteed drop,
-        so it fails fast here instead.
+    fault_plan : fleet-clock chaos (`FLEET_KINDS`: ``engine_kill``,
+        ``kill_wave``).  Requires ``snapshot_every`` > 0 and
+        ``snapshot_dir`` — a kill without a snapshot to recover from
+        would be a guaranteed drop, so it fails fast here instead.
     engine_plans : optional per-engine `FaultPlan` list (the serving
-        chaos kinds, aimed at individual engines).
+        chaos kinds, aimed at individual engines).  Applies to the
+        INITIAL engines; spawned engines carry no plan.
     tracers : optional per-engine `obs.Tracer` list — each engine's
         timeline becomes its own process lane in the merged Chrome
-        trace (`obs.export.merge_chrome_traces`).
+        trace (`obs.export.merge_chrome_traces`).  Initial engines
+        only, like ``engine_plans``.
     retry_limit : max engines tried per submission (default: all).
     snapshot_every : periodic per-engine snapshot cadence in fleet
         steps (0 = never; then engine kills cannot be recovered).
     snapshot_dir : directory for ``engine<i>`` snapshot subdirs.
+    autoscaler : optional `cpd_tpu.fleet.autoscale.Autoscaler` —
+        observed once per step (after fleet faults fire), drives
+        `spawn_engine` / `scale_down` deterministically.
     """
 
     def __init__(self, model, params, n_engines: int = 2, *,
@@ -112,7 +140,8 @@ class Fleet:
                  retry_limit: Optional[int] = None,
                  snapshot_every: int = 0,
                  snapshot_dir: Optional[str] = None,
-                 finished_cap: int = 4096):
+                 finished_cap: int = 4096,
+                 autoscaler=None):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
         if engine_plans is not None and len(engine_plans) != n_engines:
@@ -122,6 +151,14 @@ class Fleet:
         if tracers is not None and len(tracers) != n_engines:
             raise ValueError(f"tracers must have one entry per engine "
                              f"({n_engines}), got {len(tracers)}")
+        if autoscaler is not None:
+            p = autoscaler.policy
+            if not (p.min_engines <= n_engines <= p.max_engines):
+                raise ValueError(
+                    f"n_engines={n_engines} outside the autoscaler's "
+                    f"[{p.min_engines}, {p.max_engines}] band — the "
+                    f"policy would fight the construction width on "
+                    f"step 0")
         self._kills = list(fault_plan.fleet_faults()) if fault_plan \
             else []
         if fault_plan is not None:
@@ -140,17 +177,18 @@ class Fleet:
                     f"engine_plans=[...]")
         if self._kills and (snapshot_every < 1 or not snapshot_dir):
             raise ValueError(
-                "engine_kill in the fault plan needs snapshot_every >= 1 "
-                "and a snapshot_dir — a kill with no snapshot to recover "
-                "from is a guaranteed silent drop, refused up front")
+                "engine_kill/kill_wave in the fault plan needs "
+                "snapshot_every >= 1 and a snapshot_dir — a kill with "
+                "no snapshot to recover from is a guaranteed silent "
+                "drop, refused up front")
         self.model = model
         self.params = params
-        self.n_engines = int(n_engines)
         self._engine_kw = dict(engine_kw or {})
         self._cache_pages = prefix_cache_pages
         self.retry_limit = retry_limit
         self.snapshot_every = int(snapshot_every)
         self.snapshot_dir = snapshot_dir
+        self.autoscaler = autoscaler
         self.engines = []
         for i in range(n_engines):
             kw = dict(self._engine_kw)
@@ -162,6 +200,8 @@ class Fleet:
                 kw["tracer"] = tracers[i]
             self.engines.append(ServeEngine(model, params, **kw))
         self.accepting = [True] * n_engines
+        self.draining = [False] * n_engines
+        self.retired = [False] * n_engines
         # rid -> engine index, pruned to LIVE rids every step (resolved
         # placements age out — the fleet must not regrow the unbounded
         # dict the PR 10 ResultStore killed)
@@ -170,6 +210,14 @@ class Fleet:
         self.counters = {k: 0 for k in _FLEET_COUNTERS}
         # bounded like the engine event log (~few events per incident)
         self.events = deque(maxlen=8 * finished_cap)
+        # the fleet-shape history the ×2 determinism drills compare:
+        # one entry per lifecycle change, bounded (shape changes are
+        # rare next to requests)
+        self.shape_log = deque(maxlen=256)
+        self.shape_log.append(("init", 0, n_engines))
+        # counters of engines whose row was RECYCLED (their objects are
+        # gone); folded via whole-dict rebind, fixed key set
+        self._retired_counters: dict = {}
         self.step_index = 0
         # per-engine control-plane replay logs since the last snapshot:
         # (step, op, payload) with op in submit/adopt/extract/withdraw.
@@ -182,6 +230,19 @@ class Fleet:
         if self._replay_enabled:
             for i in range(n_engines):
                 self._snapshot_engine(i)
+
+    @property
+    def n_engines(self) -> int:
+        """Engine ROWS (retired rows included until recycled) — the
+        historical addressing width.  ``sum(accepting)`` is the live
+        serving width."""
+        return len(self.engines)
+
+    def live_engines(self) -> list:
+        """Indices of non-retired engines (stepping, draining or
+        accepting)."""
+        return [i for i in range(len(self.engines))
+                if not self.retired[i]]
 
     # -- routing ----------------------------------------------------------
 
@@ -208,7 +269,7 @@ class Fleet:
         policy (module docstring table).  Deterministic: every
         tiebreak ends at the engine index."""
         keyed = []
-        for i in range(self.n_engines):
+        for i in range(len(self.engines)):
             if i in exclude or not self.accepting[i]:
                 continue
             rung_sheds, bound, hits, util, qlen = self._signals(i, req)
@@ -259,33 +320,136 @@ class Fleet:
             self.counters["routed"] += 1
         return verdict, idx
 
+    # -- elasticity: spawn / scale-down / retire --------------------------
+
+    def spawn_engine(self) -> int:
+        """Add one engine mid-run: fresh state, the shared model/params
+        (no new compilation — the serve-side step cache already holds
+        the programs) and the FLEET's step clock, so lockstep and the
+        replay-log recovery invariants hold for it like any founding
+        member.  Recycles the lowest retired row first (class
+        docstring: reuse-first is what bounds the per-engine arrays at
+        the fleet's peak width); only when no row is free does the
+        fleet widen.  Returns the engine index."""
+        kw = dict(self._engine_kw)
+        if self._cache_pages is not None:
+            kw["prefix_cache"] = PrefixCache(self._cache_pages)
+        eng = ServeEngine(self.model, self.params, **kw)
+        # join the shared clock AT the current step: deadlines, scrub
+        # cadence and the kill-replay window all assume engine step ==
+        # fleet step
+        eng.step_index = self.step_index
+        idx = next((i for i, r in enumerate(self.retired) if r), None)
+        if idx is not None:
+            self._fold_retired_row(idx)
+            self.engines[idx] = eng
+            self.accepting[idx] = True
+            self.draining[idx] = False
+            self.retired[idx] = False
+            self._logs[idx] = []
+        else:
+            idx = len(self.engines)
+            # rebind-extend, not append: with reuse-first above, these
+            # parallel rows only ever widen to the fleet's PEAK
+            # concurrent width (max_engines under the autoscaler) —
+            # scale churn recycles rows instead of growing them
+            self.engines = self.engines + [eng]
+            self.accepting = self.accepting + [True]
+            self.draining = self.draining + [False]
+            self.retired = self.retired + [False]
+            self._logs = self._logs + [[]]
+        self.counters["engines_spawned"] += 1
+        self.events.append(("spawn", self.step_index, idx))
+        self.shape_log.append(("spawn", self.step_index, idx))
+        if self._replay_enabled:
+            self._snapshot_engine(idx)
+        return idx
+
+    def scale_down(self, idx: int) -> dict:
+        """Retire engine ``idx`` through the drain path: admissions
+        close, queued work re-routes, live sessions migrate out via
+        capsules (bitwise resume — zero sessions lost), the remainder
+        completes locally; once drained the row retires (next `step`).
+        Refuses to drop the last accepting engine."""
+        if self.retired[idx]:
+            raise ValueError(f"engine {idx} is already retired")
+        if self.accepting[idx] and sum(self.accepting) <= 1:
+            raise ValueError(
+                "cannot scale down the last accepting engine — the "
+                "fleet would refuse all traffic (kill chaos holds the "
+                "same floor)")
+        summary = self.drain_engine(idx)
+        self.draining[idx] = True
+        self.events.append(("scale_down", self.step_index, idx))
+        self.shape_log.append(("scale_down", self.step_index, idx))
+        return summary
+
+    def _fold_retired_row(self, idx: int) -> None:
+        """Fold a retired engine's final counters into the accumulator
+        before its row is recycled — `aggregate_counters` must stay
+        exact across arbitrary churn."""
+        merged = dict(self._retired_counters)
+        for k, v in self.engines[idx].counters.items():
+            merged[k] = merged.get(k, 0) + int(v)
+        self._retired_counters = merged
+
+    def _finish_retirements(self) -> None:
+        """Draining engines that have fully drained retire: they stop
+        stepping and snapshotting, but keep their row (events, counters
+        and any unfired-fault accounting stay addressable) until
+        `spawn_engine` recycles it."""
+        for i in range(len(self.engines)):
+            if not self.draining[i] or self.retired[i]:
+                continue
+            if not self.engines[i].drained():
+                continue
+            self.retired[i] = True
+            self.draining[i] = False
+            self.counters["engines_retired"] += 1
+            self.events.append(("retire", self.step_index, i))
+            self.shape_log.append(("retire", self.step_index, i))
+
     # -- the fleet step ---------------------------------------------------
 
     def _kill_fireable(self, f) -> bool:
-        """A kill spec can still fire iff its target engine is still
-        accepting — drained engines never re-open, so a spec aimed at
-        one is permanently unfireable WHATEVER its step (running the
-        clock toward it would step a drained fleet for nothing).  It
-        stays pending only for `report_unfired`."""
-        return self.accepting[max(int(f.arg), 0) % self.n_engines]
+        """Can this spec still fire?  ``engine_kill``: its target row
+        must EXIST and still accept — an index the fleet shape never
+        grew to is exactly as unfireable as a drained engine (the
+        autoscaled-shape hole ISSUE 17 closes: the old ``% n_engines``
+        wrap silently re-aimed such specs at whatever engine the
+        modulo landed on).  ``kill_wave``: needs >= 2 accepting engines
+        (the wave must leave a survivor).  Unfireable specs stay
+        pending only for `report_unfired`."""
+        if f.kind == "kill_wave":
+            return sum(self.accepting) >= 2
+        target = max(int(f.arg), 0)
+        return target < len(self.engines) and self.accepting[target]
 
     def has_pending_faults(self) -> bool:
-        """True while ``engine_kill`` specs can still fire — the fleet
+        """True while fleet fault specs can still fire — the fleet
         load generator keeps the step clock running toward them (the
         `req_burst` convention lifted to fleet scope).  Unfireable
-        specs (target already drained) are excluded, so a double-kill
-        plan cannot livelock `run_fleet_trace`; they surface through
-        `report_unfired` instead."""
+        specs (target drained, never-existing index, no wave quorum)
+        are excluded, so a double-kill plan cannot livelock
+        `run_fleet_trace`; they surface through `report_unfired`
+        instead."""
         return any(self._kill_fireable(f) for f in self._kills)
 
     def step(self) -> None:
         s = self.step_index
         self._fire_fleet_faults(s)
-        for e in self.engines:
-            e.step()
+        if self.autoscaler is not None:
+            # after the faults: a kill wave's capacity hole is repaired
+            # inside the same step (floor repair bypasses hysteresis)
+            self.autoscaler.observe(self, s)
+        for i, e in enumerate(self.engines):
+            if not self.retired[i]:
+                e.step()
         if self._replay_enabled and (s + 1) % self.snapshot_every == 0:
-            for i in range(self.n_engines):
-                self._snapshot_engine(i)
+            for i in range(len(self.engines)):
+                if not self.retired[i]:
+                    self._snapshot_engine(i)
+        self._finish_retirements()
         # resolved placements age out (bounded control-plane state):
         # only rids still in flight somewhere need their routing home
         self.placement = {rid: i for rid, i in self.placement.items()
@@ -293,13 +457,14 @@ class Fleet:
         self.step_index += 1
 
     def drained(self) -> bool:
-        return all(e.drained() for e in self.engines)
+        return all(self.engines[i].drained()
+                   for i in self.live_engines())
 
     def run_until_drained(self, max_steps: int = 100000) -> None:
         while not self.drained():
             if self.step_index >= max_steps:
-                busy = [i for i, e in enumerate(self.engines)
-                        if not e.drained()]
+                busy = [i for i in self.live_engines()
+                        if not self.engines[i].drained()]
                 raise RuntimeError(
                     f"fleet not drained after {max_steps} steps "
                     f"(busy engines: {busy})")
@@ -316,20 +481,25 @@ class Fleet:
         return sorted(out)
 
     def report_unfired(self) -> list:
-        """Fleet fault specs that never fired (e.g. an ``engine_kill``
-        scheduled past the end of the trace) — counted, never silent;
-        the fleet twin of `ServeEngine.report_unfired` (which every
-        member engine still runs for its own kinds)."""
+        """Fleet fault specs that never fired — an ``engine_kill``
+        scheduled past the end of the trace, aimed at a drained engine,
+        or aimed at an index the (possibly autoscaled) fleet shape
+        never contained; a ``kill_wave`` that never found two accepting
+        engines.  Counted, never silent; the fleet twin of
+        `ServeEngine.report_unfired` (which every member engine still
+        runs for its own kinds)."""
         for e in self.engines:
             e.report_unfired()
         self.counters["fleet_faults_unfired"] = len(self._kills)
         return sorted(self._kills)
 
     def aggregate_counters(self) -> dict:
-        """Sum of every engine's counter dict (per-engine truth stays
-        on the engines; this is the fleet roll-up the metrics and the
-        ``cpd_fleet_*`` family report)."""
-        out: dict = {}
+        """Sum of every engine's counter dict — including engines whose
+        row was recycled by scale churn (their final counters live in
+        the fold accumulator), so the roll-up the metrics and the
+        ``cpd_fleet_*`` family report is exact across arbitrary
+        spawn/retire history."""
+        out = dict(self._retired_counters)
         for e in self.engines:
             for k, v in e.counters.items():
                 out[k] = out.get(k, 0) + v
@@ -343,12 +513,42 @@ class Fleet:
             if f.step > s:
                 still.append(f)
                 continue
-            target = max(int(f.arg), 0) % self.n_engines
-            if not self.accepting[target]:
-                still.append(f)      # held: already dead/draining
+            if f.kind == "kill_wave":
+                if sum(self.accepting) < 2:
+                    still.append(f)  # held until the fleet regrows
+                    continue
+                self._kill_wave(f, s)
+                continue
+            target = max(int(f.arg), 0)
+            if target >= len(self.engines) \
+                    or not self.accepting[target]:
+                # held: already dead/draining, or aimed at a row the
+                # fleet shape never grew to (no modulo wrap — a kill
+                # must hit the engine it names or surface as unfired)
+                still.append(f)
                 continue
             self._kill_engine(target, s)
         self._kills = still
+
+    def _kill_wave(self, f, s: int) -> None:
+        """``kill_wave@s:{count}``: kill up to ``count`` accepting
+        engines at once, lowest indices first, ALWAYS leaving at least
+        one accepting survivor.  Victim admissions close before any
+        drain runs, so wave-drain migration lands only on engines that
+        outlive the wave.  A shortfall (count > available victims) is
+        counted, never silent."""
+        count = int(f.arg) if f.arg > 0 else 2
+        acc = [i for i, a in enumerate(self.accepting) if a]
+        victims = acc[:min(count, len(acc) - 1)]
+        self.counters["kill_waves"] += 1
+        if count > len(victims):
+            self.counters["kill_wave_shortfall"] += count - len(victims)
+        self.events.append(("kill_wave", s, count, len(victims)))
+        self.shape_log.append(("kill_wave", s, tuple(victims)))
+        for v in victims:
+            self.accepting[v] = False
+        for v in victims:
+            self._kill_engine(v, s)
 
     def _snapshot_engine(self, i: int) -> None:
         path = os.path.join(self.snapshot_dir, f"engine{i}")
@@ -358,7 +558,10 @@ class Fleet:
     def _kill_engine(self, idx: int, s: int) -> None:
         """The ``engine_kill`` handler (module docstring): rebuild the
         engine from its last snapshot + the deterministic replay log,
-        then drain it onto the survivors."""
+        then drain it onto the survivors.  The drained engine finishes
+        its unmigratable local work and RETIRES (ISSUE 17) — replaced
+        capacity comes from the autoscaler's floor repair, not from
+        re-opening the dead row."""
         self.counters["engine_kills"] += 1
         self.events.append(("engine_kill", s, idx))
         dead = self.engines[idx]
@@ -384,6 +587,7 @@ class Fleet:
             sum(sl.state != FREE for sl in restored.sched.slots)
             + len(restored.sched.queue))
         self.drain_engine(idx)
+        self.draining[idx] = True
 
     def _replay_ops(self, idx: int, log: list, fs: int) -> None:
         eng = self.engines[idx]
@@ -405,7 +609,8 @@ class Fleet:
         drained engine), live sessions migrate out where a survivor
         can adopt them; the remainder completes locally (the engine
         keeps stepping with admissions closed).  Returns the drain
-        summary.  Also the scale-in primitive."""
+        summary.  Also the scale-in primitive (`scale_down` adds the
+        retirement bookkeeping)."""
         self.counters["drains"] += 1
         self.accepting[idx] = False
         e = self.engines[idx]

@@ -221,13 +221,22 @@ class MetricsRegistry:
     def absorb_fleet_counters(self, fleet) -> None:
         """A `cpd_tpu.fleet.Fleet` — the ``cpd_fleet_*`` family
         (ISSUE 13): the fleet's own counters (routing, retries,
-        migrations, kills, recoveries) mirrored unlabelled, plus every
-        member engine's counters as engine-labelled ``cpd_serve_*``
-        series."""
+        migrations, kills, recoveries, waves, spawns/retirements)
+        mirrored unlabelled, plus every member engine's counters as
+        engine-labelled ``cpd_serve_*`` series.  An attached
+        autoscaler adds the ``cpd_fleet_scale_*`` family (ISSUE 17):
+        its decision counters plus the live accepting-engine gauge —
+        docs/OBSERVABILITY.md lists the rows."""
         for key, value in fleet.counters.items():
             self.mirror(f"cpd_fleet_{key}", float(value))
         self.set_gauge("cpd_fleet_engines", float(fleet.n_engines))
         self.set_gauge("cpd_fleet_step_index", float(fleet.step_index))
+        scaler = getattr(fleet, "autoscaler", None)
+        if scaler is not None:
+            for key, value in scaler.counters.items():
+                self.mirror(f"cpd_fleet_scale_{key}", float(value))
+            self.set_gauge("cpd_fleet_scale_accepting",
+                           float(sum(fleet.accepting)))
         for i, eng in enumerate(fleet.engines):
             self.absorb_serve_counters(eng.counters, engine=i)
 

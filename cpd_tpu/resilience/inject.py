@@ -129,18 +129,26 @@ KV_KINDS = frozenset({"kv_flip"})
 # `ServeEngine.take_due_bursts`, so the flash crowd is keyed into the
 # plan and replays deterministically).
 SERVE_KINDS = frozenset({"kv_storm", "slot_stall", "req_burst"})
-# fleet-chaos kind (ISSUE 13), on the FLEET step clock (which is also
-# every member engine's step clock — the fleet steps them in lockstep):
+# fleet-chaos kinds (ISSUE 13, 17), on the FLEET step clock (which is
+# also every member engine's step clock — the fleet steps them in
+# lockstep):
 # ``engine_kill@s:e`` kills engine ``e`` of a `cpd_tpu.fleet.Fleet` at
 # fleet step ``s`` — the fleet recovers the engine's state from its
 # last periodic snapshot plus the deterministic submission replay log,
 # then DRAINS it (queued work re-routed, live sessions migrated out
 # where capacity allows, the rest completing locally with admissions
-# closed) with zero silent drops.  The fleet does its own unfired
-# accounting (`Fleet.report_unfired`); in a plain training or
-# single-engine serving plan the kind can never fire and
-# `report_unfired` flags it unless ``fleet_armed=True``.
-FLEET_KINDS = frozenset({"engine_kill"})
+# closed) with zero silent drops.  A kill aimed at an index the fleet
+# shape never contained (possible under autoscaling) is held, never
+# re-aimed, and surfaces through `Fleet.report_unfired`.
+# ``kill_wave@s:c`` (ISSUE 17) is the coordinated multi-engine kill: up
+# to ``c`` (default 2) accepting engines die at fleet step ``s`` —
+# admissions close on every victim before any drain migration runs, at
+# least one accepting survivor always remains, and any shortfall is
+# counted (``kill_wave_shortfall``), never silent.  The fleet does its
+# own unfired accounting (`Fleet.report_unfired`); in a plain training
+# or single-engine serving plan these kinds can never fire and
+# `report_unfired` flags them unless ``fleet_armed=True``.
+FLEET_KINDS = frozenset({"engine_kill", "kill_wave"})
 # host-level kinds, executed by the Injector around the step call
 HOST_KINDS = frozenset({
     "batch_nan",       # poison one element of the first float batch leaf
@@ -285,8 +293,10 @@ class FaultPlan:
 
     def fleet_faults(self) -> tuple:
         """The fleet-chaos specs (`FLEET_KINDS`): ``engine_kill@s:e``
-        on the fleet step clock (``arg`` is the target engine index,
-        -1 -> engine 0) — consumed by `cpd_tpu.fleet.Fleet.step`."""
+        (``arg`` is the target engine index, -1 -> engine 0) and
+        ``kill_wave@s:c`` (``arg`` is the victim count, -1 -> 2), both
+        on the fleet step clock — consumed by
+        `cpd_tpu.fleet.Fleet.step`."""
         return tuple(f for f in self.faults if f.kind in FLEET_KINDS)
 
     def host_faults(self) -> dict:
@@ -619,9 +629,11 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     also live on the serving engine's clock and do their own unfired
     accounting there — in a training plan they can never fire and are
     flagged here.  ``fleet_armed`` likewise covers `FLEET_KINDS`
-    (``engine_kill``, ISSUE 13): only a `cpd_tpu.fleet.Fleet` consumes
-    them (its own `Fleet.report_unfired` owns armed accounting), so in
-    any other plan they are flagged.
+    (``engine_kill``/``kill_wave``, ISSUE 13/17): only a
+    `cpd_tpu.fleet.Fleet` consumes them (its own `Fleet.report_unfired`
+    owns armed accounting — including kills aimed at engine indices the
+    autoscaled fleet shape never contained), so in any other plan they
+    are flagged.
     Bumps the meter's ``faults_unfired`` counter and warns on rank 0;
     returns the sorted leftover list (empty = every planned fault
     fired)."""

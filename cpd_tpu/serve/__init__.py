@@ -15,10 +15,11 @@ docs/SERVING.md.
 
 from .engine import ResultStore, ServeEngine
 from .kvcache import KVCacheConfig
-from .loadgen import (bursty_trace, decode_tail_matches, flash_crowd,
-                      mixed_trace, poisson_trace, run_fleet_trace,
-                      run_trace, serial_baseline, shared_prefix_trace,
-                      timeline_metrics, with_sla)
+from .loadgen import (bursty_trace, decode_tail_matches,
+                      fleet_timeline_metrics, flash_crowd, mixed_trace,
+                      poisson_trace, run_fleet_trace, run_trace,
+                      serial_baseline, shared_prefix_trace,
+                      steady_stream, timeline_metrics, with_sla)
 from .model import ModelSpec, spec_from_model
 from .scheduler import ACCEPT, QUEUE, Request, Scheduler, SHED
 from .supervisor import Rung, ServeSupervisor, default_rungs
@@ -29,4 +30,5 @@ __all__ = ["ServeEngine", "ResultStore", "KVCacheConfig", "Request",
            "poisson_trace", "bursty_trace", "mixed_trace", "with_sla",
            "flash_crowd", "run_trace", "serial_baseline",
            "decode_tail_matches", "timeline_metrics",
-           "shared_prefix_trace", "run_fleet_trace"]
+           "shared_prefix_trace", "run_fleet_trace",
+           "fleet_timeline_metrics", "steady_stream"]

@@ -339,8 +339,14 @@ class ServeEngine:
         self.counters = {k: 0 for k in _COUNTERS}
         # (kind, rid, step, wall-clock seconds); bounded like the
         # resolution stores (~6 events/request), oldest silently aged
-        # out — latency metrics cover the retained window
+        # out — latency metrics cover the retained window.
+        # ``events_total`` counts every event EVER appended (monotone),
+        # so incremental consumers — the streaming fleet load generator
+        # (ISSUE 17) — can tail the bounded ring without re-reading or
+        # double-counting: new events since a cursor are the last
+        # ``events_total - cursor`` entries
         self.events: deque = deque(maxlen=8 * finished_cap)
+        self.events_total = 0
         # bounded resolution stores: every submitted rid lands in
         # exactly one (the zero-silent-drops contract, `unresolved`)
         self.finished = ResultStore(finished_cap)   # rid -> token list
@@ -1097,6 +1103,10 @@ class ServeEngine:
         eng.counters = {k: int(v) for k, v in state["counters"].items()}
         eng.events = deque(((k, r, st, w) for k, r, st, w
                             in state["events"]), maxlen=eng.events.maxlen)
+        # the monotone tail cursor restarts at the retained window's
+        # length; consumers detect the restored object (new identity)
+        # and re-anchor — their per-rid guards make re-reads idempotent
+        eng.events_total = len(eng.events)
         eng.finished.load_state_dict(state["finished"])
         eng.shed.load_state_dict(state["shed"])
         eng.missed.load_state_dict(state["missed"])
@@ -1198,5 +1208,6 @@ class ServeEngine:
         bit-exact against the published latency metrics."""
         w = now()
         self.events.append((kind, rid, step, w))
+        self.events_total += 1
         if self.tracer is not None:
             self.tracer.request_event(rid, kind, step, wall=w, **ann)

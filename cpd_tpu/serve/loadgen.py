@@ -58,6 +58,7 @@ the continuous-batching speedup gate compares aggregate tok/s.
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -68,7 +69,8 @@ from .scheduler import Request
 __all__ = ["poisson_trace", "bursty_trace", "mixed_trace", "with_sla",
            "flash_crowd", "run_trace", "serial_baseline",
            "decode_tail_matches", "timeline_metrics",
-           "shared_prefix_trace", "run_fleet_trace"]
+           "shared_prefix_trace", "run_fleet_trace",
+           "fleet_timeline_metrics", "steady_stream"]
 
 
 def decode_tail_matches(original, mark: int, restored) -> int:
@@ -515,14 +517,80 @@ def shared_prefix_trace(n_requests: int, vocab_size: int, *,
     return with_sla(out, list(sla)) if sla else out
 
 
-def run_fleet_trace(fleet, requests: list, *,
+def _fleet_count_block(fleet, requests_seen: int, duration: float,
+                       sla_ttft_ms: float, sla_tpot_ms: float) -> dict:
+    """Fleet-scope resolution from COUNTERS, not the bounded stores
+    (eviction-immune, run_trace's discipline): a rid completes and
+    deadline-misses at most once however it moves; every router retry
+    leaves exactly one extra engine-level shed record for a rid that
+    resolved elsewhere, so subtracting retries yields the rid-level
+    shed count.  Shared by the in-memory and streaming fleet drivers —
+    the exact-count contract cannot drift between them."""
+    agg = fleet.aggregate_counters()
+    n_sub = fleet.counters["submitted"]
+    completed = agg.get("completed", 0)
+    misses = agg.get("deadline_misses", 0)
+    shed = agg.get("shed", 0) - fleet.counters["router_retries"]
+    resolved = completed + shed + misses
+    gen = agg.get("tokens_generated", 0)
+    return {
+        "n_engines": fleet.n_engines,
+        "requests": requests_seen,
+        "submitted": n_sub,
+        "completed": completed,
+        "shed": shed,
+        "deadline_misses": misses,
+        "dropped": n_sub - resolved,       # fleet-scope SILENT drops
+        "shed_rate": round(shed / n_sub, 4) if n_sub else 0.0,
+        "deadline_miss_rate": (round(misses / n_sub, 4)
+                               if n_sub else 0.0),
+        "fleet_steps": fleet.step_index,
+        "duration_s": round(duration, 3),
+        "tok_per_s": round(gen / duration, 1) if duration else None,
+        "sla": {"ttft_ms": sla_ttft_ms, "tpot_ms": sla_tpot_ms},
+        "fleet_counters": dict(fleet.counters),
+        "engine_counters": [dict(e.counters) for e in fleet.engines],
+        "_results_evicted": agg.get("results_evicted", 0),
+    }
+
+
+def run_fleet_trace(fleet, requests, *,
                     sla_ttft_ms: float = 1000.0,
                     sla_tpot_ms: float = 250.0,
-                    max_steps: int = 100000) -> dict:
+                    max_steps: int = 100000,
+                    burst_factory: Optional[Callable] = None,
+                    stream: Optional[bool] = None,
+                    window_steps: int = 64,
+                    tracer=None,
+                    min_steps: int = 0,
+                    lat_reservoir: int = 65536,
+                    max_windows: int = 4096) -> dict:
     """`run_trace` lifted to fleet scope: submit each request at its
     arrival step through the ROUTER (`Fleet.submit`), step the fleet
-    (all engines in lockstep) until drained and every pending
-    ``engine_kill`` fired, and report the fleet metric set.
+    (all engines in lockstep) until drained and every pending fleet
+    fault fired, and report the fleet metric set.
+
+    Two drivers behind one front door (ISSUE 17):
+
+    * **in-memory** (``requests`` is a list/tuple and ``stream`` unset)
+      — the PR 13 behavior, bit-unchanged: the whole trace is held,
+      per-request latency merges every engine's event log post-hoc.
+    * **streaming** (``requests`` is any other iterable, or
+      ``stream=True``) — arrivals are PULLED one at a time from a
+      generator and every per-request record is dropped the moment the
+      rid resolves, so RSS is bounded by the in-flight session count
+      however long the trace runs (~10⁶ sessions; the stays-at-cap
+      test pins it).  Latency lands in per-``window_steps`` windows
+      (``windows``) plus capped whole-run reservoirs; ``tracer``
+      (fleet-scope, records ``step_begin`` walls) + per-engine tracers
+      enable the independent `fleet_timeline_metrics` reconstruction
+      the parity gate cross-checks.
+
+    Both drivers consume ``burst_factory`` flash crowds
+    (``req_burst@s:k`` specs popped from EVERY engine's plan, submitted
+    through the router) and honor ``min_steps`` (keep the step clock
+    running through a drained quiet tail — what gives scale-down
+    hysteresis room to fire at end of trace).
 
     Resolution counts are rid-level fleet-scope truth, not engine-
     counter sums (a request shed by one engine and completed by the
@@ -531,13 +599,26 @@ def run_fleet_trace(fleet, requests: list, *,
     fleet-scope silent-drop count — structurally zero.  Latency walls
     merge every engine's event log (a migrated session's first token
     and completion legitimately live on different engines)."""
+    if stream is None:
+        stream = not isinstance(requests, (list, tuple))
+    if stream:
+        return _run_fleet_stream(
+            fleet, requests, sla_ttft_ms=sla_ttft_ms,
+            sla_tpot_ms=sla_tpot_ms, max_steps=max_steps,
+            burst_factory=burst_factory, window_steps=window_steps,
+            tracer=tracer, min_steps=min_steps,
+            lat_reservoir=lat_reservoir, max_windows=max_windows)
     pending = sorted(requests, key=lambda r: (r.arrival, r.rid))
     submitted = []
     step_wall = {}
 
     def more_work() -> bool:
-        return bool(pending) or not fleet.drained() \
-            or fleet.has_pending_faults()
+        if pending or not fleet.drained() or fleet.has_pending_faults():
+            return True
+        if burst_factory is not None and any(
+                e.has_pending_bursts() for e in fleet.engines):
+            return True
+        return fleet.step_index < min_steps
 
     t0 = now()
     while more_work():
@@ -548,6 +629,12 @@ def run_fleet_trace(fleet, requests: list, *,
             r = pending.pop(0)
             fleet.submit(r)
             submitted.append(r)
+        if burst_factory is not None:
+            for e in fleet.engines:
+                for spec in e.take_due_bursts(fleet.step_index):
+                    for r in burst_factory(spec):
+                        fleet.submit(r)
+                        submitted.append(r)
         step_wall[fleet.step_index] = now()
         fleet.step()
     duration = now() - t0
@@ -565,39 +652,435 @@ def run_fleet_trace(fleet, requests: list, *,
 
     lat = _latency_block(submitted, first, done, n_gen_of, step_wall,
                          duration, sla_ttft_ms, sla_tpot_ms)
-    agg = fleet.aggregate_counters()
-    n_sub = fleet.counters["submitted"]
-    # fleet-scope resolution from COUNTERS, not the bounded stores
-    # (eviction-immune, run_trace's discipline): a rid completes and
-    # deadline-misses at most once however it moves; every router
-    # retry leaves exactly one extra engine-level shed record for a
-    # rid that resolved elsewhere, so subtracting retries yields the
-    # rid-level shed count
-    completed = agg.get("completed", 0)
-    misses = agg.get("deadline_misses", 0)
-    shed = agg.get("shed", 0) - fleet.counters["router_retries"]
-    resolved = completed + shed + misses
-    gen = agg.get("tokens_generated", 0)
+    out = _fleet_count_block(fleet, len(submitted), duration,
+                             sla_ttft_ms, sla_tpot_ms)
+    evicted = out.pop("_results_evicted")
+    out.update(lat)
+    out["metrics_truncated"] = evicted > 0
+    return out
+
+
+def _run_fleet_stream(fleet, requests, *, sla_ttft_ms, sla_tpot_ms,
+                      max_steps, burst_factory, window_steps, tracer,
+                      min_steps, lat_reservoir, max_windows) -> dict:
+    """The streaming fleet driver (`run_fleet_trace` docstring).
+
+    Per-request state is ONE bounded dict: ``rid -> [arrival_wall,
+    sla_class, first_wall, ttft_ms]``, created when the router places
+    the rid and popped the moment it resolves — its size is exactly the
+    in-flight session count (the ResultStore doctrine at trace scope;
+    ``stream.peak_tracked_rids`` reports the high-water mark).  Engine
+    events are TAILED incrementally through the monotone
+    ``ServeEngine.events_total`` cursor (never re-read, never
+    double-counted; a kill-restored engine is re-anchored by object
+    identity and the per-rid guards make replayed duplicates no-ops).
+    Sheds are recognized by the placement sweep: a tracked rid no
+    longer placed anywhere after a step, with no complete/miss event,
+    resolved SHED that step — this covers admission sheds, supervisor
+    purges and drain-requeue sheds through one rule.  Aggregate counts
+    come from counters (`_fleet_count_block`, exact regardless of any
+    window/reservoir truncation); only the latency detail is windowed
+    and capped, and every cap is flagged, never silent."""
+    it = iter(requests)
+    nxt = next(it, None)
+    meta: dict = {}
+    peak_meta = 0
+    n_seen = 0
+    ttft_all: list = []
+    tpot_all: list = []
+    lat_dropped = 0
+    good_tokens = 0
+    class_tokens: dict = {}
+    windows: deque = deque(maxlen=max_windows)
+    windows_emitted = 0
+    events_missed = 0
+    tails: dict = {}       # engine row -> (object id, events cursor)
+    win = {"submitted": 0, "completed": 0, "shed": 0,
+           "deadline_misses": 0, "tokens": 0}
+    win_ttft: list = []
+    win_tpot: list = []
+    win_start = fleet.step_index
+    if window_steps < 1:
+        raise ValueError(f"window_steps must be >= 1, got {window_steps}")
+
+    def reservoir_add(store: list, value: float) -> bool:
+        nonlocal lat_dropped
+        if len(store) < lat_reservoir:
+            store.append(value)
+            return True
+        lat_dropped += 1
+        return False
+
+    def flush_window(end_step: int) -> None:
+        nonlocal win, win_ttft, win_tpot, win_start, windows_emitted
+        windows.append({
+            "start_step": win_start, "end_step": end_step,
+            **win,
+            "ttft_ms_p50": _pct(win_ttft, 50),
+            "ttft_ms_p99": _pct(win_ttft, 99),
+            "tpot_ms_p50": _pct(win_tpot, 50),
+            "tpot_ms_p99": _pct(win_tpot, 99),
+        })
+        windows_emitted += 1
+        win = {"submitted": 0, "completed": 0, "shed": 0,
+               "deadline_misses": 0, "tokens": 0}
+        win_ttft, win_tpot = [], []
+        win_start = end_step
+
+    def resolve_goodput(m: list, n_gen: int,
+                        t_tok: Optional[float]) -> None:
+        # the _latency_block SLA arithmetic, applied at resolution
+        # time on the SAME floats (arrival/first walls recorded once)
+        nonlocal good_tokens
+        if m[2] is None:
+            return
+        if m[3] <= sla_ttft_ms and (t_tok is None
+                                    or t_tok <= sla_tpot_ms):
+            good_tokens += n_gen
+            class_tokens[m[1]] = class_tokens.get(m[1], 0) + n_gen
+
+    def handle_event(kind: str, rid: int, wall: float, eng) -> None:
+        if kind == "first_token":
+            m = meta.get(rid)
+            if m is not None and m[2] is None and m[0] is not None:
+                m[2] = wall
+                m[3] = (wall - m[0]) * 1e3
+                win_ttft.append(m[3])
+                reservoir_add(ttft_all, m[3])
+        elif kind == "complete":
+            m = meta.pop(rid, None)
+            if m is not None:
+                n_gen = len(eng.finished.get(rid, ()))
+                win["completed"] += 1
+                win["tokens"] += n_gen
+                t_tok = None
+                if m[2] is not None and n_gen > 1:
+                    t_tok = (wall - m[2]) * 1e3 / (n_gen - 1)
+                    win_tpot.append(t_tok)
+                    reservoir_add(tpot_all, t_tok)
+                resolve_goodput(m, n_gen, t_tok)
+        elif kind == "deadline_miss":
+            m = meta.pop(rid, None)
+            if m is not None:
+                win["deadline_misses"] += 1
+                resolve_goodput(m, 0, None)
+
+    def consume_events() -> None:
+        nonlocal events_missed
+        for i, e in enumerate(fleet.engines):
+            key = id(e)
+            anchor = tails.get(i)
+            if anchor is None or anchor[0] != key:
+                # new or kill-restored engine: re-anchor at the start
+                # of its retained ring (replayed duplicates are no-ops
+                # through the per-rid guards above)
+                tails[i] = (key, max(0, e.events_total - len(e.events)))
+            seen = tails[i][1]
+            fresh = e.events_total - seen
+            if fresh <= 0:
+                continue
+            evs = list(e.events)
+            if fresh > len(evs):
+                events_missed += fresh - len(evs)
+                fresh = len(evs)
+            for kind, rid, _step, wall in evs[len(evs) - fresh:]:
+                handle_event(kind, rid, wall, e)
+            tails[i] = (key, e.events_total)
+
+    def sweep_resolved() -> None:
+        # any still-tracked rid no longer placed anywhere resolved
+        # WITHOUT a complete/miss event this step: a shed (admission,
+        # purge or drain-requeue) — one rule for every shed path
+        gone = [rid for rid in meta if rid not in fleet.placement]
+        for rid in gone:
+            meta.pop(rid)
+            win["shed"] += 1
+
+    def submit_one(r, stamped: list) -> None:
+        nonlocal n_seen
+        n_seen += 1
+        win["submitted"] += 1
+        _verdict, idx = fleet.submit(r)
+        if idx >= 0:
+            meta[r.rid] = [None, r.sla_class, None, None]
+            stamped.append(r.rid)
+        else:
+            win["shed"] += 1
+
+    def more_work() -> bool:
+        if nxt is not None or not fleet.drained() \
+                or fleet.has_pending_faults():
+            return True
+        if burst_factory is not None and any(
+                e.has_pending_bursts() for e in fleet.engines):
+            return True
+        return fleet.step_index < min_steps
+
+    t0 = now()
+    if tracer is not None:
+        tracer.event("trace_begin", cat="serve", wall=t0)
+    while more_work():
+        if fleet.step_index >= max_steps:
+            raise RuntimeError(
+                f"fleet stream not drained in {max_steps} steps")
+        stamped: list = []
+        while nxt is not None and nxt.arrival <= fleet.step_index:
+            if nxt.arrival < fleet.step_index:
+                raise ValueError(
+                    f"streaming arrivals must be sorted by arrival "
+                    f"step: rid {nxt.rid} arrives at {nxt.arrival} "
+                    f"but the fleet clock is at {fleet.step_index}")
+            submit_one(nxt, stamped)
+            nxt = next(it, None)
+        if burst_factory is not None:
+            for e in fleet.engines:
+                for spec in e.take_due_bursts(fleet.step_index):
+                    for r in burst_factory(spec):
+                        submit_one(r, stamped)
+        w = now()
+        if tracer is not None:
+            # the SAME wall float the TTFT subtraction below uses —
+            # what makes `fleet_timeline_metrics` bit-exact
+            tracer.event("step_begin", step=fleet.step_index,
+                         cat="serve", wall=w)
+        for rid in stamped:
+            meta[rid][0] = w
+        if len(meta) > peak_meta:
+            peak_meta = len(meta)
+        fleet.step()
+        consume_events()
+        sweep_resolved()
+        if fleet.step_index % window_steps == 0:
+            flush_window(fleet.step_index)
+    t_end = now()
+    if tracer is not None:
+        tracer.event("trace_end", cat="serve", wall=t_end)
+    duration = t_end - t0
+    if fleet.step_index > win_start:
+        flush_window(fleet.step_index)
+    fleet.report_unfired()
+
+    out = _fleet_count_block(fleet, n_seen, duration,
+                             sla_ttft_ms, sla_tpot_ms)
+    evicted = out.pop("_results_evicted")
+    out.update({
+        "ttft_ms_p50": _pct(ttft_all, 50),
+        "ttft_ms_p99": _pct(ttft_all, 99),
+        "tpot_ms_p50": _pct(tpot_all, 50),
+        "tpot_ms_p99": _pct(tpot_all, 99),
+        "goodput_tok_per_s": (round(good_tokens / duration, 1)
+                              if duration else None),
+        "goodput_by_class": {str(k): (round(v / duration, 1)
+                                      if duration else None)
+                             for k, v in sorted(class_tokens.items())},
+        "windows": list(windows),
+        "window_steps": window_steps,
+        "metrics_truncated": (evicted > 0 or lat_dropped > 0
+                              or events_missed > 0),
+        "fleet_shape": {
+            "rows": fleet.n_engines,
+            "accepting": sum(fleet.accepting),
+            "retired": sum(fleet.retired),
+            "shape_log": list(fleet.shape_log),
+        },
+        "stream": {
+            "peak_tracked_rids": peak_meta,
+            "final_tracked_rids": len(meta),
+            "lat_samples_dropped": lat_dropped,
+            "events_missed": events_missed,
+            "windows_emitted": windows_emitted,
+            "windows_truncated": windows_emitted > len(windows),
+        },
+    })
+    return out
+
+
+def fleet_timeline_metrics(tracer, engine_tracers, *,
+                           sla_ttft_ms: float = 1000.0,
+                           sla_tpot_ms: float = 250.0,
+                           window_steps: int = 64,
+                           lat_reservoir: int = 65536) -> dict:
+    """`timeline_metrics` lifted to fleet scope (ISSUE 17): rebuild the
+    STREAMING driver's windowed + aggregate latency metrics from the
+    fleet tracer (``step_begin``/``trace_begin``/``trace_end`` walls)
+    and the per-engine tracers' request timelines ALONE — no fleet, no
+    stores.  On a drained, non-truncated streaming run (reservoir under
+    cap, tracer rings unsaturated, no kill replay, an accepting engine
+    at every submit) the reconstruction equals the published
+    ``windows`` and latency aggregates EXACTLY, float for float: the
+    engines hand one wall per event to both sinks
+    (`ServeEngine._event`), the driver records its per-step wall into
+    the fleet tracer, and this function repeats the identical
+    arithmetic on the identical floats.  Deliberately independent of
+    the driver's accumulation code — it is the cross-check, and
+    sharing the arithmetic would make the parity gate circular.
+
+    Resolution rule per rid (mirrors the driver's event/sweep order):
+    a ``complete`` event wins; else ``deadline_miss``; else the rid
+    resolved SHED at its last ``shed`` event's step.  Window
+    attribution: submissions at the first ``submit`` step, TTFT at the
+    ``first_token`` step, completions/misses/sheds at their event
+    steps — the same steps the streaming sweep observes them."""
+    step_begin: dict = {}
+    t0 = t_end = None
+    for _seq, name, cat, step, wall, _args in sorted(tracer.events):
+        if cat != "serve":
+            continue
+        if name == "step_begin":
+            step_begin[step] = wall
+        elif name == "trace_begin":
+            t0 = wall
+        elif name == "trace_end":
+            t_end = wall
+    if not step_begin:
+        raise ValueError(
+            "fleet timeline has no step_begin records: drive the fleet "
+            "through run_fleet_trace(stream=True, tracer=...) — only "
+            "the streaming driver records the fleet-scope walls")
+    rids: dict = {}
+    for tr in engine_tracers:
+        for _seq, name, cat, step, wall, args in sorted(tr.events):
+            if cat != "req":
+                continue
+            rec = rids.setdefault(args["rid"], {})
+            if name == "submit":
+                if "submit_step" not in rec:
+                    rec["submit_step"] = step
+                    rec["arrival"] = args["arrival"]
+                    rec["sla_class"] = args.get("sla_class", 0)
+                if args.get("verdict") != "shed":
+                    rec["placed"] = True
+            elif name == "first_token" and "first" not in rec:
+                rec["first"] = (step, wall)
+            elif name == "complete" and "done" not in rec:
+                rec["done"] = (step, wall, int(args["n_generated"]))
+            elif name == "deadline_miss" and "miss" not in rec:
+                rec["miss"] = (step, wall)
+            elif name == "shed":
+                rec["last_shed_step"] = step
+    n_steps = max(step_begin) + 1
+    n_windows = -(-n_steps // window_steps)      # ceil
+    wins = [{"start_step": i * window_steps,
+             "end_step": min((i + 1) * window_steps, n_steps),
+             "submitted": 0, "completed": 0, "shed": 0,
+             "deadline_misses": 0, "tokens": 0,
+             "_ttft": [], "_tpot": []} for i in range(n_windows)]
+    ttft_all: list = []
+    tpot_all: list = []
+    good_tokens = 0
+    class_tokens: dict = {}
+    counts = {"completed": 0, "shed": 0, "deadline_misses": 0}
+    tokens = 0
+
+    def w_of(step: int) -> dict:
+        return wins[min(step // window_steps, n_windows - 1)]
+
+    for rid in sorted(rids):
+        rec = rids[rid]
+        w_of(rec["submit_step"])["submitted"] += 1
+        t_first = None
+        if "first" in rec and rec["arrival"] in step_begin:
+            fstep, fwall = rec["first"]
+            t_first = (fwall - step_begin[rec["arrival"]]) * 1e3
+            w_of(fstep)["_ttft"].append(t_first)
+            if len(ttft_all) < lat_reservoir:
+                ttft_all.append(t_first)
+        if not rec.get("placed"):
+            # every submit shed: resolved at fleet scope the same step
+            counts["shed"] += 1
+            w_of(rec.get("last_shed_step", rec["submit_step"]))["shed"] \
+                += 1
+            continue
+        if "done" in rec:
+            dstep, dwall, n_gen = rec["done"]
+            counts["completed"] += 1
+            tokens += n_gen
+            w = w_of(dstep)
+            w["completed"] += 1
+            w["tokens"] += n_gen
+            t_tok = None
+            if t_first is not None and n_gen > 1:
+                t_tok = (dwall - rec["first"][1]) * 1e3 / (n_gen - 1)
+                w["_tpot"].append(t_tok)
+                if len(tpot_all) < lat_reservoir:
+                    tpot_all.append(t_tok)
+            if t_first is not None and t_first <= sla_ttft_ms \
+                    and (t_tok is None or t_tok <= sla_tpot_ms):
+                good_tokens += n_gen
+                cls = rec["sla_class"]
+                class_tokens[cls] = class_tokens.get(cls, 0) + n_gen
+        elif "miss" in rec:
+            mstep, _mwall = rec["miss"]
+            counts["deadline_misses"] += 1
+            w_of(mstep)["deadline_misses"] += 1
+            if t_first is not None and t_first <= sla_ttft_ms:
+                good_tokens += 0
+                cls = rec["sla_class"]
+                class_tokens[cls] = class_tokens.get(cls, 0)
+        else:
+            counts["shed"] += 1
+            w_of(rec.get("last_shed_step", rec["submit_step"]))["shed"] \
+                += 1
+    windows = []
+    for w in wins:
+        t, p = w.pop("_ttft"), w.pop("_tpot")
+        windows.append({**w,
+                        "ttft_ms_p50": _pct(t, 50),
+                        "ttft_ms_p99": _pct(t, 99),
+                        "tpot_ms_p50": _pct(p, 50),
+                        "tpot_ms_p99": _pct(p, 99)})
+    duration = (t_end - t0) if (t0 is not None
+                                and t_end is not None) else None
     return {
-        "n_engines": fleet.n_engines,
-        "requests": len(requests),
-        "submitted": n_sub,
-        "completed": completed,
-        "shed": shed,
-        "deadline_misses": misses,
-        "dropped": n_sub - resolved,       # fleet-scope SILENT drops
-        "shed_rate": round(shed / n_sub, 4) if n_sub else 0.0,
-        "deadline_miss_rate": (round(misses / n_sub, 4)
-                               if n_sub else 0.0),
-        "fleet_steps": fleet.step_index,
-        "duration_s": round(duration, 3),
-        "tok_per_s": round(gen / duration, 1) if duration else None,
-        **lat,
-        "metrics_truncated": agg.get("results_evicted", 0) > 0,
+        "submitted": len(rids),
+        **counts,
+        "tokens_generated": tokens,
+        "fleet_steps": n_steps,
+        "windows": windows,
+        "window_steps": window_steps,
+        "duration_s": (round(duration, 3) if duration is not None
+                       else None),
+        "ttft_ms_p50": _pct(ttft_all, 50),
+        "ttft_ms_p99": _pct(ttft_all, 99),
+        "tpot_ms_p50": _pct(tpot_all, 50),
+        "tpot_ms_p99": _pct(tpot_all, 99),
+        "goodput_tok_per_s": (round(good_tokens / duration, 1)
+                              if duration else None),
+        "goodput_by_class": {str(k): (round(v / duration, 1)
+                                      if duration else None)
+                             for k, v in sorted(class_tokens.items())},
+        "timeline_truncated": (
+            getattr(tracer, "events_dropped", 0) > 0
+            or any(getattr(tr, "events_dropped", 0) > 0
+                   for tr in engine_tracers)),
         "sla": {"ttft_ms": sla_ttft_ms, "tpot_ms": sla_tpot_ms},
-        "fleet_counters": dict(fleet.counters),
-        "engine_counters": [dict(e.counters) for e in fleet.engines],
     }
+
+
+def steady_stream(n_requests: int, vocab_size: int, *,
+                  rate: float = 0.5,
+                  prompt_lens: Sequence[int] = (4, 8),
+                  max_new: Sequence[int] = (8,), seed: int = 0,
+                  start_rid: int = 0,
+                  sla: Optional[Sequence[dict]] = None,
+                  eos_id: Optional[int] = None):
+    """`poisson_trace` as a GENERATOR (ISSUE 17): yields requests one
+    at a time in arrival order, so the streaming fleet driver holds at
+    most one unsubmitted request — the arrival stream itself costs O(1)
+    RSS at any ``n_requests`` (10⁶ sessions is just a bigger count).
+    Same deterministic construction as `poisson_trace` seed-for-seed;
+    ``sla`` stamps class dicts round-robin like `with_sla`."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    for i in range(n_requests):
+        t += rng.exponential(1.0 / rate)
+        kw = dict(sla[i % len(sla)]) if sla else {}
+        yield Request(
+            rid=start_rid + i,
+            prompt=tuple(int(x) for x in rng.integers(
+                0, vocab_size, int(rng.choice(list(prompt_lens))))),
+            max_new_tokens=int(rng.choice(list(max_new))),
+            arrival=int(t), eos_id=eos_id, **kw)
 
 
 def serial_baseline(model, params, requests: list, *,

@@ -29,8 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from cpd_tpu.fleet import (Fleet, PrefixCache, SessionCapsule,
-                           can_adopt, extract_capsule, migrate_session,
+from cpd_tpu.fleet import (Autoscaler, AutoscalePolicy, Fleet,
+                           PrefixCache, SessionCapsule, can_adopt,
+                           extract_capsule, migrate_session,
                            restore_capsule, token_digest)
 from cpd_tpu.models import transformer_lm
 from cpd_tpu.quant.numerics import kv_page_bytes, kv_pool_bytes
@@ -40,7 +41,9 @@ from cpd_tpu.resilience.inject import (FLEET_KINDS, Injector,
 from cpd_tpu.serve import (KVCacheConfig, Request, SHED, ServeEngine,
                            mixed_trace)
 from cpd_tpu.serve.kvcache import alloc_pool
-from cpd_tpu.serve.loadgen import run_fleet_trace, shared_prefix_trace
+from cpd_tpu.serve.loadgen import (fleet_timeline_metrics,
+                                   run_fleet_trace,
+                                   shared_prefix_trace, steady_stream)
 from cpd_tpu.serve.scheduler import DECODE, FREE, PREFILL, Scheduler
 
 VOCAB = 64
@@ -604,11 +607,14 @@ def test_fleet_report_unfired_and_training_plan_flagging(gqa_model,
     assert len(left) == 1 and left[0].kind == "engine_kill"
     assert fleet.counters["fleet_faults_unfired"] == 1
 
-    plan = FaultPlan.parse("engine_kill@3:0")
+    # both fleet kinds: a kill_wave in a training plan is the same
+    # never-fires user error as an engine_kill (ISSUE 17)
+    plan = FaultPlan.parse("engine_kill@3:0;kill_wave@5:2")
     assert {f.kind for f in plan.fleet_faults()} == FLEET_KINDS
     inj = Injector(plan)
     flagged = report_unfired(inj, n_steps=100, rank=1)
-    assert [f.kind for f in flagged] == ["engine_kill"]
+    assert sorted(f.kind for f in flagged) == ["engine_kill",
+                                              "kill_wave"]
     armed = report_unfired(Injector(plan), n_steps=100, rank=1,
                            fleet_armed=True)
     assert armed == []
@@ -701,3 +707,369 @@ def test_shared_prefix_trace_shape():
     assert trace[0].prompt[:8] == trace[2].prompt[:8]
     assert [t.sla_class for t in trace[:4]] == [0, 1, 0, 1]
     assert all(t.arrival <= u.arrival for t, u in zip(trace, trace[1:]))
+
+
+# ------------------------------------------------------- elastic fleet
+# (ISSUE 17: autoscaling, kill waves, streaming loadgen, soak bounds)
+
+def _stream_kw(n, seed, **over):
+    kw = dict(rate=1.0, prompt_lens=(4, 8), max_new=(3, 4), seed=seed,
+              sla=[{"sla_class": 0}, {"sla_class": 1}])
+    kw.update(over)
+    return steady_stream(n, VOCAB, **kw)
+
+
+def test_autoscale_policy_validates():
+    with pytest.raises(ValueError, match="min_engines"):
+        AutoscalePolicy(min_engines=0)
+    with pytest.raises(ValueError, match="max_engines"):
+        AutoscalePolicy(min_engines=3, max_engines=2)
+    with pytest.raises(ValueError, match="down_page_util"):
+        AutoscalePolicy(down_page_util=0.9, up_page_util=0.5)
+    with pytest.raises(ValueError, match="patience"):
+        AutoscalePolicy(up_patience=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        AutoscalePolicy(cooldown_steps=-1)
+
+
+def test_fleet_width_must_sit_inside_autoscaler_band(gqa_model):
+    model, params = gqa_model
+    with pytest.raises(ValueError, match="band"):
+        Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+              autoscaler=Autoscaler(AutoscalePolicy(min_engines=1,
+                                                    max_engines=1)))
+
+
+def test_autoscaler_moves_both_directions_deterministically(gqa_model):
+    """The tentpole determinism contract: the same (model, stream,
+    policy) produces the IDENTICAL scaling-decision sequence twice —
+    shape_log, scaler counters and fleet counters all exact — while
+    actually exercising both directions and losing nothing."""
+    model, params = gqa_model
+
+    def run():
+        scaler = Autoscaler(AutoscalePolicy(
+            min_engines=1, max_engines=3, up_page_util=0.5, up_queue=1,
+            up_patience=2, down_page_util=0.2, down_patience=4,
+            cooldown_steps=3))
+        fleet = Fleet(model, params, 1, engine_kw=dict(ENGINE_KW),
+                      autoscaler=scaler)
+        res = run_fleet_trace(fleet, _stream_kw(14, seed=11, rate=1.5),
+                              window_steps=8, min_steps=40)
+        return res, fleet, scaler
+
+    r1, f1, s1 = run()
+    r2, f2, s2 = run()
+    assert s1.counters["ups"] >= 1 and s1.counters["downs"] >= 1, \
+        s1.counters
+    assert r1["dropped"] == 0 and f1.unresolved() == []
+    assert list(f1.shape_log) == list(f2.shape_log)
+    assert s1.counters == s2.counters
+    assert r1["fleet_counters"] == r2["fleet_counters"]
+    # spawned engines joined the shared clock: every live engine sits
+    # exactly ON the fleet step (the deadline/scrub/replay contract)
+    for i in f1.live_engines():
+        assert f1.engines[i].step_index == f1.step_index
+    # the idle tail contracted back to the floor
+    assert sum(f1.accepting) == 1
+
+
+def test_autoscaler_state_roundtrip():
+    scaler = Autoscaler(AutoscalePolicy())
+    scaler.counters["ups"] = 2
+    scaler.hot_streak = 1
+    scaler.cooldown_until = 9
+    scaler._prev_shed = 4
+    fresh = Autoscaler(AutoscalePolicy())
+    fresh.load_state_dict(json.loads(json.dumps(scaler.state_dict())))
+    assert fresh.state_dict() == scaler.state_dict()
+
+
+def test_kill_wave_fires_with_shortfall_and_survivor(gqa_model,
+                                                     tmp_path):
+    """kill_wave@s:count kills count accepting engines at fleet step s
+    but ALWAYS leaves a survivor: an over-wide wave is truncated and
+    the shortfall counted, never silently absorbed."""
+    model, params = gqa_model
+    trace = mixed_trace(8, VOCAB, prompt_lens=(5, 7), max_new=(4,),
+                        seed=3)
+
+    def run(sub):
+        fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+                      fault_plan=FaultPlan.parse("kill_wave@6:5"),
+                      snapshot_every=4,
+                      snapshot_dir=os.path.join(tmp_path, sub))
+        return run_fleet_trace(fleet, list(trace)), fleet
+
+    m1, f1 = run("a")
+    m2, _ = run("b")
+    assert m1["fleet_counters"] == m2["fleet_counters"]
+    fc = m1["fleet_counters"]
+    assert fc["kill_waves"] == 1
+    assert fc["engine_kills"] == 1          # truncated to survivors-1
+    assert fc["kill_wave_shortfall"] == 4
+    assert m1["dropped"] == 0 and f1.unresolved() == []
+    assert f1.report_unfired() == []
+    assert sum(f1.accepting) == 1           # the survivor
+    wave = [ev for ev in f1.events if ev[0] == "kill_wave"]
+    assert wave == [("kill_wave", 6, 5, 1)]
+
+
+def test_kill_wave_holds_without_two_accepting_engines(gqa_model,
+                                                       tmp_path):
+    """A wave can never take the LAST accepting engine: on a width-1
+    fleet it holds forever and surfaces through report_unfired — and
+    the streaming driver must not spin the clock toward it."""
+    model, params = gqa_model
+    fleet = Fleet(model, params, 1, engine_kw=dict(ENGINE_KW),
+                  fault_plan=FaultPlan.parse("kill_wave@4:2"),
+                  snapshot_every=4, snapshot_dir=str(tmp_path))
+    m = run_fleet_trace(fleet, list(mixed_trace(
+        4, VOCAB, prompt_lens=(5,), max_new=(3,), seed=5)),
+        max_steps=400)
+    assert m["fleet_steps"] < 100
+    assert m["dropped"] == 0
+    left = fleet.report_unfired()
+    assert len(left) == 1 and left[0].kind == "kill_wave"
+    assert fleet.counters["kill_waves"] == 0
+    assert fleet.counters["fleet_faults_unfired"] == 1
+
+
+def test_engine_kill_at_never_existing_index_is_unfired(gqa_model,
+                                                        tmp_path):
+    """Satellite fix: an engine_kill aimed at an index the (possibly
+    autoscaled) fleet shape NEVER contained must surface as unfired —
+    the old modulo wrap silently re-aimed it at a live engine, firing
+    chaos the plan never described."""
+    model, params = gqa_model
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+                  fault_plan=FaultPlan.parse("engine_kill@3:7"),
+                  snapshot_every=4, snapshot_dir=str(tmp_path))
+    m = run_fleet_trace(fleet, list(mixed_trace(
+        6, VOCAB, prompt_lens=(5, 7), max_new=(3,), seed=6)),
+        max_steps=400)
+    assert m["dropped"] == 0
+    assert fleet.counters["engine_kills"] == 0       # nothing wrapped
+    left = fleet.report_unfired()
+    assert len(left) == 1 and left[0].kind == "engine_kill" \
+        and left[0].arg == 7
+    assert fleet.counters["fleet_faults_unfired"] == 1
+
+
+def test_scale_down_mid_prefill_is_bitwise(gqa_model):
+    """Satellite: scale-down while a session is mid-PREFILL on the
+    victim — the drain migrates it (digest-sealed capsule), the row
+    retires once empty, and EVERY sampled logits row of the run is
+    bitwise identical to the never-scaled fleet."""
+    model, params = gqa_model
+    kw = dict(ENGINE_KW, kv_format=(8, 23), record_logits=True)
+    reqs = [Request(rid=0, prompt=_prompt(12, seed=21),
+                    max_new_tokens=6, arrival=0),
+            Request(rid=1, prompt=_prompt(5, seed=22),
+                    max_new_tokens=4, arrival=0)]
+
+    def run(scale):
+        fleet = Fleet(model, params, 2, engine_kw=dict(kw))
+        for r in reqs:
+            fleet.submit(r)
+        victim = fleet.placement[0]
+        fleet.step()
+        if scale:
+            # prompt 12 / chunk 4: one chunk in, provably mid-PREFILL
+            sl = fleet.engines[victim].slot_of_rid(0)
+            assert sl is not None and sl.state == PREFILL
+            fleet.scale_down(victim)
+        fleet.run_until_drained()
+        while not fleet.retired[victim] and scale:
+            fleet.step()
+        return fleet, victim
+
+    base, _ = run(False)
+    scaled, victim = run(True)
+    assert scaled.counters["migrations"] == 1
+    assert scaled.counters["engines_retired"] == 1
+    assert scaled.retired[victim] and not scaled.accepting[victim]
+    assert scaled.unresolved() == []
+    _assert_rows_bitwise(_rows(*[base.engines[i] for i in
+                                 base.live_engines()]),
+                         _rows(*[scaled.engines[i] for i in
+                                 scaled.live_engines()]))
+    # the shape history recorded the decision + the retirement
+    kinds = [ev[0] for ev in scaled.shape_log]
+    assert kinds == ["init", "scale_down", "retire"]
+
+
+def test_scale_down_refuses_last_accepting_engine(gqa_model):
+    model, params = gqa_model
+    fleet = Fleet(model, params, 1, engine_kw=dict(ENGINE_KW))
+    with pytest.raises(ValueError, match="last accepting"):
+        fleet.scale_down(0)
+
+
+def test_spawned_engine_recycles_retired_row_and_keeps_counts(
+        gqa_model):
+    """Slot-stable rows: a retired row is REUSED by the next spawn (the
+    parallel arrays stay bounded at peak width) and the recycled
+    engine's counters keep flowing through aggregate_counters — the
+    exact-resolution arithmetic never loses a completed request."""
+    model, params = gqa_model
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW))
+    fleet.submit(Request(rid=0, prompt=_prompt(5), max_new_tokens=3,
+                         arrival=0))
+    fleet.run_until_drained()
+    done_before = fleet.aggregate_counters()["completed"]
+    victim = fleet.placement.get(0, 0)
+    fleet.scale_down(victim)
+    fleet.run_until_drained()
+    fleet.step()                 # retirement lands on the step clock
+    assert fleet.retired[victim]
+    idx = fleet.spawn_engine()
+    assert idx == victim         # reuse-first, not append
+    assert fleet.n_engines == 2
+    assert not fleet.retired[idx] and fleet.accepting[idx]
+    assert fleet.engines[idx].step_index == fleet.step_index
+    assert fleet.aggregate_counters()["completed"] == done_before
+    assert fleet.counters["engines_spawned"] == 1
+    assert fleet.counters["engines_retired"] == 1
+
+
+def test_streaming_matches_in_memory_counts(gqa_model):
+    """Satellite parity (a): the streaming driver and the in-memory
+    driver resolve the SAME trace to identical counter-derived fields —
+    submitted/completed/shed/misses/dropped, rates, fleet and
+    per-engine counters."""
+    model, params = gqa_model
+    trace = list(_stream_kw(12, seed=9))
+    f_mem = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW))
+    r_mem = run_fleet_trace(f_mem, trace)
+    f_str = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW))
+    r_str = run_fleet_trace(f_str, iter(trace), window_steps=8)
+    for k in ("submitted", "completed", "shed", "deadline_misses",
+              "dropped", "shed_rate", "deadline_miss_rate",
+              "fleet_steps", "fleet_counters", "engine_counters"):
+        assert r_mem[k] == r_str[k], (k, r_mem[k], r_str[k])
+    assert r_str["stream"]["final_tracked_rids"] == 0
+    # window counts tile the whole run without loss
+    assert sum(w["completed"] for w in r_str["windows"]) \
+        == r_str["completed"]
+    assert sum(w["submitted"] for w in r_str["windows"]) \
+        == r_str["submitted"]
+
+
+def test_streaming_windows_match_timeline_reconstruction(gqa_model):
+    """Satellite parity (b): within ONE streaming run,
+    fleet_timeline_metrics rebuilds the published windows and latency
+    aggregates from the tracers alone, float for float (the PR 11
+    one-wall-per-event doctrine at fleet scope)."""
+    from cpd_tpu.obs import Tracer
+    model, params = gqa_model
+    tracers = [Tracer(), Tracer()]
+    fleet_tr = Tracer()
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW),
+                  tracers=tracers)
+    res = run_fleet_trace(fleet, _stream_kw(10, seed=13),
+                          window_steps=8, tracer=fleet_tr)
+    rec = fleet_timeline_metrics(fleet_tr, tracers, window_steps=8)
+    assert rec["windows"] == res["windows"]
+    for k in ("submitted", "completed", "shed", "deadline_misses",
+              "fleet_steps", "duration_s", "ttft_ms_p50", "ttft_ms_p99",
+              "tpot_ms_p50", "tpot_ms_p99", "goodput_tok_per_s",
+              "goodput_by_class"):
+        assert rec[k] == res[k], (k, rec[k], res[k])
+    assert rec["timeline_truncated"] is False
+
+
+def test_fleet_timeline_requires_streaming_walls():
+    from cpd_tpu.obs import Tracer
+    with pytest.raises(ValueError, match="step_begin"):
+        fleet_timeline_metrics(Tracer(), [])
+
+
+def test_streaming_state_stays_at_cap(gqa_model):
+    """The bounded-RSS pin: a long stream against tiny bounded stores
+    keeps per-request tracking at the in-flight width (NOT the session
+    count), evicts from the stores, and STILL resolves every rid
+    exactly — the ResultStore doctrine at trace scope."""
+    model, params = gqa_model
+    n = 40
+    fleet = Fleet(model, params, 2,
+                  engine_kw=dict(ENGINE_KW, finished_cap=4,
+                                 max_queue=4))
+    res = run_fleet_trace(fleet, _stream_kw(n, seed=17, rate=2.0),
+                          window_steps=16)
+    assert res["submitted"] == n
+    assert res["dropped"] == 0 and fleet.unresolved() == []
+    agg = fleet.aggregate_counters()
+    assert agg["results_evicted"] > 0          # stores really at cap
+    st = res["stream"]
+    assert st["final_tracked_rids"] == 0
+    # in-flight width: 2 engines x (n_slots + max_queue) = 12, far
+    # below the stream length — the structural RSS bound
+    assert st["peak_tracked_rids"] <= 12 < n
+    assert res["metrics_truncated"] is True    # flagged, never silent
+
+
+def test_streaming_rejects_unsorted_arrivals(gqa_model):
+    model, params = gqa_model
+    fleet = Fleet(model, params, 2, engine_kw=dict(ENGINE_KW))
+    bad = [Request(rid=0, prompt=_prompt(5), max_new_tokens=3,
+                   arrival=5),
+           Request(rid=1, prompt=_prompt(5), max_new_tokens=3,
+                   arrival=0)]
+    with pytest.raises(ValueError, match="sorted"):
+        run_fleet_trace(fleet, iter(bad))
+
+
+def test_steady_stream_is_deterministic_and_sorted():
+    a = list(steady_stream(20, VOCAB, seed=3))
+    b = list(steady_stream(20, VOCAB, seed=3))
+    assert [(r.rid, r.arrival, r.prompt, r.max_new_tokens)
+            for r in a] == \
+        [(r.rid, r.arrival, r.prompt, r.max_new_tokens) for r in b]
+    assert all(x.arrival <= y.arrival for x, y in zip(a, a[1:]))
+    assert [r.sla_class for r in
+            steady_stream(4, VOCAB, seed=1,
+                          sla=[{"sla_class": 0}, {"sla_class": 1}])] \
+        == [0, 1, 0, 1]
+
+
+def test_registry_fleet_scale_family(gqa_model):
+    """Satellite: an attached autoscaler exports the cpd_fleet_scale_*
+    rows (docs/OBSERVABILITY.md) next to the fleet family."""
+    from cpd_tpu.obs import MetricsRegistry
+    model, params = gqa_model
+    scaler = Autoscaler(AutoscalePolicy(
+        min_engines=1, max_engines=2, up_page_util=0.5, up_queue=1,
+        up_patience=2, down_page_util=0.2, down_patience=4,
+        cooldown_steps=2))
+    fleet = Fleet(model, params, 1, engine_kw=dict(ENGINE_KW),
+                  autoscaler=scaler)
+    run_fleet_trace(fleet, _stream_kw(10, seed=19, rate=2.0),
+                    window_steps=8, min_steps=30)
+    reg = MetricsRegistry()
+    reg.absorb_fleet_counters(fleet)
+    d = reg.as_dict()
+    assert d["cpd_fleet_scale_ups"]["value"] \
+        == float(scaler.counters["ups"]) >= 1.0
+    assert d["cpd_fleet_scale_downs"]["value"] \
+        == float(scaler.counters["downs"])
+    assert d["cpd_fleet_scale_floor_repairs"]["value"] == 0.0
+    assert d["cpd_fleet_scale_accepting"]["value"] \
+        == float(sum(fleet.accepting))
+    assert d["cpd_fleet_engines_spawned"]["value"] \
+        == float(fleet.counters["engines_spawned"])
+    assert d["cpd_fleet_kill_waves"]["value"] == 0.0
+
+
+def test_fleet_modules_pass_host_lint():
+    """Satellite: the elastic control plane's bookkeeping is clean
+    under the PR 16 host-runtime rules — focused here so a regression
+    names the fleet file, not just the whole-tree gate."""
+    from cpd_tpu.analysis import host_rules, lint_tree
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = lint_tree(
+        [os.path.join(repo, "cpd_tpu", "fleet"),
+         os.path.join(repo, "cpd_tpu", "serve", "loadgen.py")],
+        select=list(host_rules()))
+    assert findings == [], [(f.path, f.line, f.rule, f.message)
+                            for f in findings]

@@ -62,6 +62,14 @@ compiled cfgs shared across engines through the serve step cache):
      skipped, sampled logits bitwise identical to the cache-less
      fleet, and the crafted Fletcher-collision pair must NOT share.
 
+``--soak-smoke`` is the CI `soak-smoke` gate (ISSUE 17): one
+STREAMING soak crossing every elastic-fleet mechanism — generator-fed
+arrivals, a mid-run ``kill_wave``, a ``req_burst`` flash crowd,
+autoscaler scale-up under the resulting pressure and scale-down
+through the idle tail — zero silent drops, bounded per-request RSS
+(stores at cap, tracking peaks at in-flight width), fleet/scaler
+counters and the full ``shape_log`` exact across two fresh soaks.
+
 Run it by hand for the docs/PERF.md numbers:
 
     JAX_PLATFORMS=cpu python tools/bench_serve.py --trace mixed \
@@ -733,6 +741,119 @@ def run_fleet_smoke(args) -> dict:
     return out
 
 
+def run_soak_smoke(args) -> dict:
+    """The CI `soak-smoke` gate (ISSUE 17): ONE streaming soak that
+    crosses every elastic-fleet mechanism at once — generator-fed
+    arrivals (never materialized as a list), a mid-run ``kill_wave``, a
+    ``req_burst`` flash crowd, autoscaler scale-up under the resulting
+    pressure and scale-down through the idle tail — asserted exactly
+    TWICE:
+
+      1. zero fleet-scope silent drops and an empty unresolved()/
+         report_unfired() after the full soak;
+      2. the autoscaler actually moved BOTH directions (ups >= 1,
+         downs >= 1) and the wave actually fired (kill_waves == 1);
+      3. bounded RSS: the per-request streaming state peaks far below
+         the session count (stays-at-cap: the bounded stores evicted,
+         yet counter-derived resolution stays exact);
+      4. determinism x2: fleet counters, scaler counters, the
+         shape_log (every spawn/kill/retire decision) and every
+         window's COUNT fields identical across two fresh soaks —
+         wall-clock percentiles are reported, never gated.
+    """
+    from cpd_tpu.fleet import Autoscaler, AutoscalePolicy
+    from cpd_tpu.resilience import FaultPlan
+    from cpd_tpu.serve.loadgen import (flash_crowd, run_fleet_trace,
+                                       steady_stream)
+
+    model, params = _build_model(args)
+    vocab = _SMOKE_MODEL["vocab_size"]
+    n_req = 48
+
+    def soak(sub, td):
+        policy = AutoscalePolicy(min_engines=1, max_engines=3,
+                                 up_page_util=0.55, up_queue=2,
+                                 up_patience=2, down_page_util=0.25,
+                                 down_patience=6, cooldown_steps=8)
+        fleet = _fleet(
+            model, params, args, 1,
+            engine_over={"finished_cap": 16},
+            fault_plan=FaultPlan.parse("kill_wave@20:1"),
+            engine_plans=[FaultPlan.parse("req_burst@14:6")],
+            snapshot_every=4, snapshot_dir=os.path.join(td, sub),
+            autoscaler=Autoscaler(policy))
+        gen = steady_stream(n_req, vocab, rate=1.5, prompt_lens=(4, 8),
+                            max_new=(6, 8), seed=args.seed + 17,
+                            sla=[{"sla_class": 0}, {"sla_class": 1}])
+        res = run_fleet_trace(
+            fleet, gen, window_steps=16, min_steps=110,
+            burst_factory=flash_crowd(vocab, seed=args.seed + 31))
+        return res, fleet
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        r1, f1 = soak("a", td)
+        r2, f2 = soak("b", td)
+
+    # 1. nothing dropped, nothing unresolved, every fault consumed
+    assert r1["dropped"] == 0 and f1.unresolved() == [], \
+        f"soak silent drops: {r1['dropped']} " \
+        f"(unresolved {f1.unresolved()})"
+    assert f1.report_unfired() == [], \
+        f"soak left faults unfired: {f1.report_unfired()}"
+    assert r1["submitted"] == n_req + 6, r1["submitted"]  # trace+burst
+
+    # 2. the fleet actually breathed, and the wave actually hit
+    sc = f1.autoscaler.counters
+    assert sc["ups"] >= 1 and sc["downs"] >= 1, \
+        f"autoscaler never moved both directions: {sc}"
+    fc = r1["fleet_counters"]
+    assert fc["kill_waves"] == 1 and fc["engines_spawned"] >= 1 \
+        and fc["engines_retired"] >= 1, fc
+    assert sum(f1.accepting) == 1, \
+        f"idle tail should scale back to the floor: " \
+        f"{sum(f1.accepting)} accepting"
+
+    # 3. bounded streaming state: stores at cap, tracking at in-flight
+    # width — yet the counter-derived resolution above stayed exact
+    agg = f1.aggregate_counters()
+    assert agg["results_evicted"] > 0, \
+        "soak never put the bounded stores at cap — not a soak"
+    st = r1["stream"]
+    assert st["final_tracked_rids"] == 0
+    assert st["peak_tracked_rids"] < r1["submitted"] // 2, \
+        f"per-request state not bounded by in-flight width: peak " \
+        f"{st['peak_tracked_rids']} of {r1['submitted']} submitted"
+
+    # 4. determinism x2 — counters, decisions, window counts
+    assert r1["fleet_counters"] == r2["fleet_counters"], \
+        f"soak fleet counters not deterministic:\n{r1['fleet_counters']}" \
+        f"\n{r2['fleet_counters']}"
+    assert f1.autoscaler.counters == f2.autoscaler.counters, \
+        "autoscaler decisions not deterministic"
+    assert list(f1.shape_log) == list(f2.shape_log), \
+        f"fleet shape history not deterministic:\n{list(f1.shape_log)}" \
+        f"\n{list(f2.shape_log)}"
+    count_keys = ("start_step", "end_step", "submitted", "completed",
+                  "shed", "deadline_misses", "tokens")
+    w1 = [{k: w[k] for k in count_keys} for w in r1["windows"]]
+    w2 = [{k: w[k] for k in count_keys} for w in r2["windows"]]
+    assert w1 == w2, "window count fields not deterministic"
+
+    return {"soak_smoke": True, "kv_format": list(args.kv_format),
+            "submitted": r1["submitted"], "completed": r1["completed"],
+            "shed": r1["shed"],
+            "deadline_misses": r1["deadline_misses"],
+            "silent_drops": 0, "fleet_steps": r1["fleet_steps"],
+            "windows": len(r1["windows"]),
+            "peak_tracked_rids": st["peak_tracked_rids"],
+            "results_evicted": agg["results_evicted"],
+            "scaler": dict(sc), "shape_log": [list(x) for x
+                                              in f1.shape_log],
+            "deterministic": True}
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     p.add_argument("--smoke", action="store_true",
@@ -754,6 +875,11 @@ def main() -> int:
                    help="CI gate: N=2 route/migrate/kill/prefix drills"
                         " — bitwise resume, zero silent drops, "
                         "counters exact x2")
+    p.add_argument("--soak-smoke", action="store_true",
+                   help="CI gate (ISSUE 17): streaming arrivals x "
+                        "kill wave x flash crowd x autoscale up/down "
+                        "in one soak — zero drops, bounded RSS, "
+                        "counters and shape_log exact x2")
     p.add_argument("--deadline-steps", type=int, default=12,
                    help="class-1 TTFT deadline for --overload-sweep")
     p.add_argument("--trace", choices=("poisson", "bursty", "mixed"),
@@ -774,6 +900,8 @@ def main() -> int:
 
     if args.smoke:
         out = run_smoke(args)
+    elif args.soak_smoke:
+        out = run_soak_smoke(args)
     elif args.fleet_smoke:
         out = run_fleet_smoke(args)
     elif args.fleet:
