@@ -50,9 +50,13 @@ __all__ = ["SessionCapsule", "extract_capsule", "restore_capsule",
 
 # the KVCacheConfig fields a capsule's pages are only meaningful under —
 # restore fails fast on ANY mismatch (a (4,3) block-24 page scattered
-# into a block-32 pool would not corrupt loudly, it would decode garbage)
+# into a block-32 pool would not corrupt loudly, it would decode garbage).
+# ``tp`` is layout (ISSUE 18): a tp=2 capsule's pages carry a 2-shard
+# axis a tp=4 pool cannot scatter — the fingerprint refuses BEFORE any
+# page write, like every other mismatch
 _CFG_FIELDS = ("n_layers", "n_kv_heads", "head_dim", "page_size",
-               "exp_bits", "man_bits", "raw", "block_scale", "block_size")
+               "exp_bits", "man_bits", "raw", "block_scale", "block_size",
+               "tp")
 
 _CAP_STATE, _CAP_POOL, _CAP_DIGESTS = "state.json", "pages.npy", \
     "digests.npy"

@@ -199,6 +199,29 @@ class MetricsRegistry:
         for key, value in counters.items():
             self.mirror(f"cpd_serve_{key}", float(value), **labels)
 
+    def absorb_serve_shards(self, cfg, engine: Optional[int] = None) -> None:
+        """Per-shard KV pool pricing for a (possibly tp-sharded) engine
+        (ISSUE 18): the ``shard`` label joins ``engine`` on the
+        ``cpd_serve_*`` family — one gauge series per head-group shard,
+        so a tp=4 engine exports four distinguishable pool slices.
+
+        ``cfg`` is the engine's `KVCacheConfig`.  Gauges (rows in
+        docs/OBSERVABILITY.md): ``cpd_serve_kv_shard_page_bytes`` — one
+        layer's K+V bytes of one page on this shard (the blocked codec's
+        per-shard sidecar makes this NOT page_bytes / tp); and
+        ``cpd_serve_kv_shard_pool_bytes`` — the shard's whole resident
+        pool slice.  A tp=1 engine exports the single shard-0 series,
+        so dashboards sum over ``shard`` uniformly."""
+        labels = {} if engine is None else {"engine": engine}
+        page = float(cfg.shard_page_bytes if cfg.tp > 1 else
+                     cfg.page_bytes)
+        pool = float(cfg.n_layers) * float(cfg.n_pages) * page
+        for s in range(cfg.tp):
+            self.set_gauge("cpd_serve_kv_shard_page_bytes", page,
+                           shard=s, **labels)
+            self.set_gauge("cpd_serve_kv_shard_pool_bytes", pool,
+                           shard=s, **labels)
+
     def absorb_linalg_counters(self, counters: dict,
                                algo: Optional[str] = None,
                                fmt: Optional[str] = None) -> None:
@@ -237,8 +260,21 @@ class MetricsRegistry:
                 self.mirror(f"cpd_fleet_scale_{key}", float(value))
             self.set_gauge("cpd_fleet_scale_accepting",
                            float(sum(fleet.accepting)))
+        shard_totals: Dict[int, float] = {}
         for i, eng in enumerate(fleet.engines):
             self.absorb_serve_counters(eng.counters, engine=i)
+            cfg = getattr(eng, "cfg", None)
+            if cfg is not None:
+                self.absorb_serve_shards(cfg, engine=i)
+                page = float(cfg.shard_page_bytes if cfg.tp > 1
+                             else cfg.page_bytes)
+                pool = float(cfg.n_layers) * float(cfg.n_pages) * page
+                for s in range(cfg.tp):
+                    shard_totals[s] = shard_totals.get(s, 0.0) + pool
+        # fleet-level shard rows (ISSUE 18): resident KV bytes per head-
+        # group shard index, summed over member engines.
+        for s, total in sorted(shard_totals.items()):
+            self.set_gauge("cpd_fleet_kv_shard_bytes", total, shard=s)
 
     # -- reads ------------------------------------------------------------
 

@@ -5,6 +5,7 @@ implementations these are bit-identical to."""
 from .quantize import quantize_pallas, quantize_pallas_sr
 from .qgemm import qgemm_pallas
 from .flash_gqa import flash_gqa
+from .serve_attn import fused_gather_attention
 
 __all__ = ["quantize_pallas", "quantize_pallas_sr", "qgemm_pallas",
-           "flash_gqa"]
+           "flash_gqa", "fused_gather_attention"]

@@ -397,7 +397,7 @@ def wire_bytes(exp_bits: int, man_bits: int) -> int:
 
 def kv_page_bytes(exp_bits: int, man_bits: int, page_size: int,
                   n_kv_heads: int, head_dim: int,
-                  block_size=None) -> int:
+                  block_size=None, tp: int = 1) -> int:
     """Bytes of ONE layer's K+V KV-cache page in the packed eXmY codec.
 
     The analytic sibling of `wire_bytes` for the serving stack's paged
@@ -415,13 +415,25 @@ def kv_page_bytes(exp_bits: int, man_bits: int, page_size: int,
     row (one token position's n_kv_heads·head_dim elements) carries its
     `sidecar_bytes` shift lane next to the code words — the sidecar is
     EXPLICIT here, and the test pins this against the real blocked pool
-    slice so the analytics can never silently under-report KV memory."""
+    slice so the analytics can never silently under-report KV memory.
+
+    ``tp`` prices a head-group-sharded page (ISSUE 18): the row splits
+    into ``tp`` shard-local rows of ``n_kv_heads // tp`` heads, each
+    carrying its OWN blocked sidecar (scale blocks span the shard-local
+    row, so the sharded page is not simply the tp=1 page — the sidecar
+    count can differ).  The return is the whole-page engine-aggregate;
+    divide the per-shard call (``tp=1`` on ``n_kv_heads // tp`` heads)
+    out yourself for the shard slice."""
     if page_size < 1 or n_kv_heads < 1 or head_dim < 1:
         raise ValueError(
             f"page_size/n_kv_heads/head_dim must be >= 1, got "
             f"({page_size}, {n_kv_heads}, {head_dim})")
+    if tp < 1 or n_kv_heads % tp != 0:
+        raise ValueError(
+            f"tp={tp} must be >= 1 and divide n_kv_heads={n_kv_heads}: "
+            "pages shard by whole KV head groups")
     _validate_wire(exp_bits, man_bits)
-    n = n_kv_heads * head_dim
+    n = (n_kv_heads // tp) * head_dim      # shard-local row elements
     row = n * wire_bytes(exp_bits, man_bits)
     if block_size is not None:
         if exp_bits == 8 and man_bits == 23:
@@ -429,13 +441,13 @@ def kv_page_bytes(exp_bits: int, man_bits: int, page_size: int,
                              "has nothing to scale — no blocked page "
                              "exists to price")
         row += sidecar_bytes(n, block_size)
-    return 2 * page_size * row
+    return tp * 2 * page_size * row
 
 
 def kv_pool_bytes(exp_bits: int, man_bits: int, page_size: int,
                   n_kv_heads: int, head_dim: int, *, n_layers: int,
                   logical_pages: int, shared_pages: int = 0,
-                  block_size=None) -> dict:
+                  block_size=None, tp: int = 1) -> dict:
     """Whole-pool KV accounting with prefix-cache dedup (ISSUE 13
     satellite): ``logical_pages`` page ids as the requests see them,
     of which ``shared_pages`` are copy-on-write references to a page
@@ -450,7 +462,12 @@ def kv_pool_bytes(exp_bits: int, man_bits: int, page_size: int,
     (`bench_serve --fleet`) prices its prefix-hit sweep with.  Pinned
     against real pool slices in tests (like the PR 12 sidecar
     pricing): the analytics can never silently under-report KV
-    memory."""
+    memory.
+
+    ``tp`` prices a head-group-sharded pool (ISSUE 18): all byte
+    figures stay engine-aggregate (summed over shards), and the dict
+    gains ``tp`` plus ``shard_page_bytes`` — one shard's whole-model
+    page cost, what each shard device actually holds per page id."""
     if n_layers < 1:
         raise ValueError(f"n_layers must be >= 1, got {n_layers}")
     if logical_pages < 0 or not 0 <= shared_pages <= logical_pages:
@@ -459,11 +476,17 @@ def kv_pool_bytes(exp_bits: int, man_bits: int, page_size: int,
             f"({shared_pages}, {logical_pages})")
     page = n_layers * kv_page_bytes(exp_bits, man_bits, page_size,
                                     n_kv_heads, head_dim,
-                                    block_size=block_size)
-    return {"page_bytes": page,
-            "logical_bytes": logical_pages * page,
-            "resident_bytes": (logical_pages - shared_pages) * page,
-            "saved_bytes": shared_pages * page}
+                                    block_size=block_size, tp=tp)
+    out = {"page_bytes": page,
+           "logical_bytes": logical_pages * page,
+           "resident_bytes": (logical_pages - shared_pages) * page,
+           "saved_bytes": shared_pages * page}
+    if tp > 1:
+        out["tp"] = tp
+        out["shard_page_bytes"] = n_layers * kv_page_bytes(
+            exp_bits, man_bits, page_size, n_kv_heads // tp, head_dim,
+            block_size=block_size)
+    return out
 
 
 def _validate_wire(exp_bits: int, man_bits: int) -> None:

@@ -111,7 +111,7 @@ def parse_ladder(text) -> tuple:
 
 
 def ladder_step_key(transport=None, precision=None, overlap=None,
-                    block=None):
+                    block=None, fused=None):
     """The ONE `StepTable` key derivation shared by `run_guarded` and
     the trainer CLIs, covering every supervisor combination:
 
@@ -136,7 +136,15 @@ def ladder_step_key(transport=None, precision=None, overlap=None,
     transition to a run configured with another — the transport ladder
     retraces through the blocked rung, the precision ladder re-derives
     per-block shifts at the new format.  Runs that never touch the
-    block surface pass None and keep the PR 8-compatible key shapes."""
+    block surface pass None and keep the PR 8-compatible key shapes.
+
+    ``fused``, when given, is the serving engine's ``fused_attn`` flag
+    appended the same way (ISSUE 18): the fused gather→unpack→attention
+    kernel and the XLA composition are DIFFERENT compiled programs over
+    the same decode contract, so a ladder transition must never serve a
+    step traced with one read path to a configuration running the
+    other.  Runs without the serving surface pass None and keep the
+    prior key shapes."""
     if transport is not None and precision is not None:
         base = (transport.mode, precision.fmt)
     elif precision is not None:
@@ -149,24 +157,29 @@ def ladder_step_key(transport=None, precision=None, overlap=None,
         base = (base, ("overlap",) + tuple(overlap))
     if block is not None:
         base = (base, ("block",) + tuple(block))
+    if fused is not None:
+        base = (base, ("fused", bool(fused)))
     return base
 
 
 def resolve_ladder_key(key, *, transport_on: bool, precision_on: bool,
                        level: str, fmt: tuple,
                        overlap_on: bool = False,
-                       block_on: bool = False) -> tuple:
+                       block_on: bool = False,
+                       fused_on: bool = False) -> tuple:
     """Inverse of `ladder_step_key` for StepTable build functions: map a
     table key back to ``(transport_level, (exp, man))``, filling the
     coordinate a missing supervisor pins from the run's static config
     (``level`` = the configured --mode, ``fmt`` = the configured
     gradient format).  The ONE unpacking shared by the trainer CLIs so
     the three-way branch cannot drift between them.  ``overlap_on`` /
-    ``block_on`` strip the key's ``("overlap", ...)`` / ``("block",
-    ...)`` coordinates first — in reverse append order, block
-    outermost (the builder reads the overlap/block config from its
-    static flags — the coordinates exist to split the CACHE, not to
-    carry data)."""
+    ``block_on`` / ``fused_on`` strip the key's ``("overlap", ...)`` /
+    ``("block", ...)`` / ``("fused", ...)`` coordinates first — in
+    reverse append order, fused outermost (the builder reads the
+    overlap/block/fused config from its static flags — the coordinates
+    exist to split the CACHE, not to carry data)."""
+    if fused_on:
+        key = key[0]
     if block_on:
         key = key[0]
     if overlap_on:

@@ -84,6 +84,7 @@ gate.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import os
 import shutil
@@ -278,7 +279,8 @@ class ServeEngine:
                  stall_patience: int = 4, finished_cap: int = 4096,
                  temperature: float = 0.0, seed: int = 0,
                  record_logits: bool = False, tracer=None, flight=None,
-                 prefix_cache=None):
+                 prefix_cache=None, tp: int = 1,
+                 fused_attn: bool = False):
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if stall_patience < 1:
@@ -301,14 +303,18 @@ class ServeEngine:
             scrub_every=scrub_every, max_queue=max_queue,
             stall_patience=stall_patience, finished_cap=finished_cap,
             temperature=float(temperature), seed=int(seed),
-            record_logits=bool(record_logits))
+            record_logits=bool(record_logits), tp=int(tp),
+            fused_attn=bool(fused_attn))
         self.cfg = KVCacheConfig(
             n_layers=spec.n_layers, n_kv_heads=spec.kv_heads,
             head_dim=spec.head_dim, page_size=page_size, n_pages=n_pages,
             exp_bits=exp_bits, man_bits=man_bits, raw=raw_cache,
             block_scale=kv_block_size is not None,
             block_size=(int(kv_block_size)
-                        if kv_block_size is not None else 32))
+                        if kv_block_size is not None else 32),
+            tp=int(tp))
+        self.tp = int(tp)
+        self.fused_attn = bool(fused_attn)
         self.spec = spec
         self.params = params
         self.sched = Scheduler(n_slots, n_pages, page_size, max_pages,
@@ -321,9 +327,11 @@ class ServeEngine:
         self._temperature = float(temperature)
         self._rng = np.random.default_rng(seed)
 
-        self._decode_fn = make_decode_step(spec, self.cfg)
+        self._decode_fn = make_decode_step(spec, self.cfg,
+                                           fused=self.fused_attn)
         self._prefill_fn = make_prefill_step(spec, self.cfg, prefill_chunk)
-        self._scrub_fn = jax.jit(kvcache.all_digests)
+        self._scrub_fn = jax.jit(functools.partial(
+            kvcache.all_digests, sharded=self.cfg.tp > 1))
         self._pool = kvcache.alloc_pool(self.cfg)
         # initial state: digest-of-zero-page everywhere, via the same
         # compiled scrub program every later pass reuses
@@ -835,8 +843,10 @@ class ServeEngine:
         self.counters["scrubs"] += 1
         cur = np.asarray(self._scrub_fn(self._pool))
         stored = np.asarray(self._digests)
+        # rows are (layer, page) at tp=1, (layer, page, shard) sharded —
+        # index positionally, page is always column 1
         bad = np.argwhere(cur != stored)
-        bad_pages = sorted({int(p) for _, p in bad if p != TRASH_PAGE})
+        bad_pages = sorted({int(r[1]) for r in bad if r[1] != TRASH_PAGE})
         if not bad_pages:
             return []
         to_repair = []
@@ -868,8 +878,8 @@ class ServeEngine:
         # pages and any corrupted-but-unwritten tail) by re-syncing the
         # stored digests to the pool's current bytes
         self._digests = self._scrub_fn(self._pool)
-        return [(int(layer), int(p)) for layer, p in bad
-                if int(p) != TRASH_PAGE]
+        return sorted({(int(r[0]), int(r[1])) for r in bad
+                       if int(r[1]) != TRASH_PAGE})
 
     def _reprefill(self, slot, counter: str) -> None:
         """Rebuild a slot's cached K/V from its token history through the
@@ -951,25 +961,29 @@ class ServeEngine:
 
     def _flip_page_byte(self, pid: int) -> None:
         """One REAL byte flip in page ``pid`` (layer 0, K plane,
-        position 0).  On the raw fp32 oracle pool this is a mantissa
-        byte XOR (not an arithmetic perturbation: `old + 1.0` would
-        round back to `old` for |old| >= 2^24 or non-finite values — a
-        fault counted as fired that attacked nothing)."""
+        position 0; shard 0 on a tp-sharded pool — per-shard digests
+        must catch a single shard's corruption).  On the raw fp32
+        oracle pool this is a mantissa byte XOR (not an arithmetic
+        perturbation: `old + 1.0` would round back to `old` for
+        |old| >= 2^24 or non-finite values — a fault counted as fired
+        that attacked nothing)."""
+        shard = (0,) if self.cfg.tp > 1 else ()
         if self.cfg.raw:
-            old = np.float32(self._pool[0, pid, 0, 0, 0, 0])
+            idx = (0, pid) + shard + (0, 0, 0, 0)
+            old = np.float32(self._pool[idx])
             bits = old.view(np.uint32) ^ np.uint32(0xFF)
-            self._pool = self._pool.at[0, pid, 0, 0, 0, 0].set(
+            self._pool = self._pool.at[idx].set(
                 float(bits.view(np.float32)))
         elif self.cfg.block_scale:
             # blocked pool rows are flat byte vectors (codes + sidecar):
             # flip the row's first code byte
-            old = self._pool[0, pid, 0, 0, 0]
-            self._pool = self._pool.at[0, pid, 0, 0, 0].set(
-                old ^ np.uint8(0xFF))
+            idx = (0, pid) + shard + (0, 0, 0)
+            old = self._pool[idx]
+            self._pool = self._pool.at[idx].set(old ^ np.uint8(0xFF))
         else:
-            old = self._pool[0, pid, 0, 0, 0, 0, 0]
-            self._pool = self._pool.at[0, pid, 0, 0, 0, 0, 0].set(
-                old ^ np.uint8(0xFF))
+            idx = (0, pid) + shard + (0, 0, 0, 0, 0)
+            old = self._pool[idx]
+            self._pool = self._pool.at[idx].set(old ^ np.uint8(0xFF))
 
     # -- crash-recovery snapshots -----------------------------------------
 

@@ -16,6 +16,12 @@ happens on the serving hot path):
     pool:    (L, n_pages, 2, page_size, H_kv, D, WB)  uint8
     digests: (L, n_pages)                             uint32
 
+Tensor-parallel engines (ISSUE 18) insert a shard axis at position 2 —
+``(L, n_pages, tp, 2, page_size, H_kv/tp, D, WB)`` with digests
+``(L, n_pages, tp)`` — so ``pool[:, :, s]`` is EXACTLY a tp=1 pool of
+shard ``s``'s head group and every codec/digest function below runs
+per shard under ``KVCacheConfig.shard_view()``, unchanged.
+
 * ``L`` — decoder layers; axis FIRST so every per-layer read/write is a
   static slice (`pool[l]`) inside the jitted step.
 * plane 2 — K then V.
@@ -94,6 +100,8 @@ class KVCacheConfig:
     raw: bool = False     # fp32 pool, no codec — the oracle cache
     block_scale: bool = False
     block_size: int = 32
+    tp: int = 1           # head-group shards (ISSUE 18): pool gains a
+                          # shard axis at position 2, digests a trailing one
 
     def __post_init__(self):
         if self.page_size < 1:
@@ -101,6 +109,12 @@ class KVCacheConfig:
         if self.n_pages < 2:
             raise ValueError("n_pages must be >= 2 (page 0 is the trash "
                              f"page), got {self.n_pages}")
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.n_kv_heads % self.tp != 0:
+            raise ValueError(
+                f"tp={self.tp} must divide n_kv_heads={self.n_kv_heads}: "
+                "the pool shards by whole KV head groups")
         if self.block_scale and self.raw:
             raise ValueError("block_scale=True with raw=True: the fp32 "
                              "oracle pool has no codec to scale")
@@ -124,6 +138,19 @@ class KVCacheConfig:
     def fmt(self) -> tuple:
         return (self.exp_bits, self.man_bits)
 
+    def shard_view(self) -> "KVCacheConfig":
+        """The ONE-shard view of a tp-sharded pool: same config with
+        ``tp=1`` and ``n_kv_heads // tp`` heads.  Every existing kvcache
+        function (pack/unpack/write/gather/check/refresh) operates on a
+        single shard's legacy-shaped slice under this view — the sharded
+        engine never needs shard-aware codec code, which is what keeps
+        each shard's pages bitwise identical to a tp=1 pool holding the
+        same head group."""
+        if self.tp == 1:
+            return self
+        return dataclasses.replace(self, tp=1,
+                                   n_kv_heads=self.n_kv_heads // self.tp)
+
     @property
     def word_bytes(self) -> int:
         return 4 if self.raw else wire_bytes(self.exp_bits, self.man_bits)
@@ -143,17 +170,34 @@ class KVCacheConfig:
 
     @property
     def page_bytes(self) -> int:
-        """One layer's K+V bytes per page — `quant.numerics.kv_page_bytes`
-        is the single source of truth; the pool slice must agree."""
+        """One layer's K+V bytes per page, summed over all ``tp`` shards
+        — `quant.numerics.kv_page_bytes` is the single source of truth;
+        the pool slice must agree.  (``shard_page_bytes`` is the
+        per-shard slice.)"""
         if self.raw:
             return 2 * self.page_size * self.n_kv_heads * self.head_dim * 4
         return kv_page_bytes(self.exp_bits, self.man_bits, self.page_size,
                              self.n_kv_heads, self.head_dim,
                              block_size=(self.block_size if self.block_scale
-                                         else None))
+                                         else None), tp=self.tp)
+
+    @property
+    def shard_page_bytes(self) -> int:
+        """One SHARD's K+V bytes per layer-page (== ``page_bytes`` at
+        tp=1).  Under the blocked codec this is NOT page_bytes // tp:
+        scale blocks span the shard-local row, so each shard prices its
+        own sidecar."""
+        return self.shard_view().page_bytes
 
     @property
     def pool_shape(self) -> tuple:
+        if self.tp > 1:
+            # shard axis at position 2: page axis stays axis 1, so every
+            # page-indexed host operation (snapshot, capsule extraction's
+            # pool[:, idx]) works unchanged, and pool[:, :, s] is exactly
+            # a tp=1 pool of the shard's head group
+            sv = self.shard_view()
+            return sv.pool_shape[:2] + (self.tp,) + sv.pool_shape[2:]
         if self.block_scale:
             # rows are flat blocked-wire byte vectors (codes + sidecar):
             # the per-element (H, D, WB) structure dissolves into the
@@ -163,6 +207,13 @@ class KVCacheConfig:
         base = (self.n_layers, self.n_pages, 2, self.page_size,
                 self.n_kv_heads, self.head_dim)
         return base if self.raw else base + (self.word_bytes,)
+
+    @property
+    def digests_shape(self) -> tuple:
+        """(L, n_pages) at tp=1; (L, n_pages, tp) sharded — one Fletcher
+        digest per shard-local page, so integrity stays per-shard-bitwise."""
+        base = (self.n_layers, self.n_pages)
+        return base if self.tp == 1 else base + (self.tp,)
 
 
 def alloc_pool(cfg: KVCacheConfig) -> jnp.ndarray:
@@ -259,7 +310,12 @@ def refresh_digests(pool: jnp.ndarray, digests: jnp.ndarray, layer: int,
     return digests.at[layer, page_ids].set(fresh)
 
 
-def all_digests(pool: jnp.ndarray) -> jnp.ndarray:
+def all_digests(pool: jnp.ndarray, sharded: bool = False) -> jnp.ndarray:
     """(L, n_pages) uint32 digest of every page — the scrub pass (and the
-    initial digest state: digest-of-zero-page for untouched pages)."""
+    initial digest state: digest-of-zero-page for untouched pages).
+    ``sharded=True`` digests a tp-sharded pool (shard axis at position 2)
+    per shard-local page -> (L, n_pages, tp): each shard's digest is
+    bitwise what a tp=1 pool of that head group would store."""
+    if sharded:
+        return jax.vmap(jax.vmap(jax.vmap(wire_digest)))(pool)
     return jax.vmap(jax.vmap(wire_digest))(pool)

@@ -36,9 +36,16 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from . import kvcache
 from .kvcache import KVCacheConfig
+from ..compat import shard_map
+from ..ops.serve_attn import fused_gather_attention
+from ..parallel.mesh import AXIS_TENSOR, make_mesh
+from ..parallel.ring import gather_transport_bytes
+from ..quant.numerics import cast_body, pack_exmy, unpack_exmy
 from ..utils.cache import LRUCache
 
 __all__ = ["ModelSpec", "spec_from_model", "make_decode_step",
@@ -50,7 +57,11 @@ __all__ = ["ModelSpec", "spec_from_model", "make_decode_step",
 # fresh engines).  Bounded, matching the make_sum_gradients_fn precedent.
 _STEP_CACHE = LRUCache(maxsize=32)
 
-_NEG_INF = jnp.float32(-1e30)
+# a Python float, not a jnp scalar: promotes to the same float32(-1e30)
+# in `jnp.where`, and stays an inlined literal when `_paged_attention`
+# traces INSIDE the fused Pallas kernel (a module-level device array
+# would be a captured constant, which pallas_call rejects)
+_NEG_INF = -1e30
 _LN_EPS = 1e-6   # flax nn.LayerNorm default, matching transformer.py
 
 
@@ -133,6 +144,63 @@ def _qkv(blk: dict, h: jnp.ndarray, spec: ModelSpec) -> tuple:
     return q, kv[..., 0, :], kv[..., 1, :]
 
 
+def _shard_qkv(blk: dict, h: jnp.ndarray, spec: ModelSpec,
+               tp: int) -> tuple:
+    """This shard's head group of `_qkv`, inside `shard_map`: params
+    ride REPLICATED (one in_spec for the whole tree — robust to pytree
+    container drift), and each shard slices its own contiguous kernel
+    columns by ``axis_index``.  The projection layouts are head-major
+    (transformer.py), so a contiguous column window IS a whole head
+    group — shard s computes exactly heads [s·H/tp, (s+1)·H/tp), and
+    the GQA q-group→kv-head mapping (j -> j // rep) stays shard-local
+    because tp divides both H and H_kv."""
+    b, t, _ = h.shape
+    hd = spec.head_dim
+    s = lax.axis_index(AXIS_TENSOR)
+    if spec.n_kv_heads is None:
+        h_loc = spec.n_heads // tp
+        cols = h_loc * 3 * hd                 # 3·hd columns per head
+        kern = lax.dynamic_slice_in_dim(blk["wqkv"]["kernel"], s * cols,
+                                        cols, axis=1)
+        qkv = (h @ kern).reshape(b, t, h_loc, 3, hd)
+        return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    h_loc = spec.n_heads // tp
+    kv_loc = spec.n_kv_heads // tp
+    wq = lax.dynamic_slice_in_dim(blk["wq"]["kernel"], s * h_loc * hd,
+                                  h_loc * hd, axis=1)
+    wkv = lax.dynamic_slice_in_dim(blk["wkv"]["kernel"],
+                                   s * kv_loc * 2 * hd, kv_loc * 2 * hd,
+                                   axis=1)
+    q = (h @ wq).reshape(b, t, h_loc, hd)
+    kv = (h @ wkv).reshape(b, t, kv_loc, 2, hd)
+    return q, kv[..., 0, :], kv[..., 1, :]
+
+
+def _gather_heads(attn_local: jnp.ndarray, cfg: KVCacheConfig) -> jnp.ndarray:
+    """all_gather the per-shard attention outputs over the QUANTIZED
+    wire: pack to the cache's eXmY format, gather the code words, unpack
+    — the EQuARX move applied to the tp gather.  At (8, 23) the cast is
+    SKIPPED: `pack_exmy` there is a lossless byte split of ANY fp32
+    (subnormals included), so the gathered heads are bit-identical to
+    the tp=1 engine's — the sharded (8,23) bitwise contract rides on
+    this.  Sub-fp32 formats quantize the attention output on the wire
+    (the documented sharded error bound, docs/SERVING.md).  Shard-major
+    concatenation == the original contiguous head order, so the merged
+    (B, T, H, D) is layout-identical to `_qkv`'s."""
+    if cfg.raw:
+        full = lax.all_gather(attn_local, AXIS_TENSOR)
+    else:
+        x = attn_local
+        if (cfg.exp_bits, cfg.man_bits) != (8, 23):
+            x = cast_body(x, cfg.exp_bits, cfg.man_bits)
+        wire = pack_exmy(x, cfg.exp_bits, cfg.man_bits)
+        wire = lax.all_gather(wire, AXIS_TENSOR)
+        full = unpack_exmy(wire, cfg.exp_bits, cfg.man_bits)
+    full = jnp.moveaxis(full, 0, 2)           # (B, T, tp, h_loc, D)
+    b, t = full.shape[:2]
+    return full.reshape(b, t, -1, full.shape[-1])
+
+
 def _paged_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      q_pos: jnp.ndarray,
                      last_pos: jnp.ndarray) -> jnp.ndarray:
@@ -175,12 +243,23 @@ def _block(blk: dict, x: jnp.ndarray, positions: jnp.ndarray,
            digests: jnp.ndarray, layer: int,
            page_rows: jnp.ndarray, page_ids: jnp.ndarray,
            offsets: jnp.ndarray, spec: ModelSpec,
-           cfg: KVCacheConfig) -> tuple:
+           cfg: KVCacheConfig, qkv_fn, merge_fn,
+           fused: bool) -> tuple:
     """One decoder block over the paged cache: project, append-quantized,
     attend-through-pool, MLP.  page_ids/offsets: (N,) flattened targets
-    of THIS call's (B·T) new positions (masked lanes -> trash page)."""
+    of THIS call's (B·T) new positions (masked lanes -> trash page).
+
+    ``cfg`` is the SHARD VIEW (== the engine config at tp=1): every
+    kvcache call below is shard-oblivious.  ``qkv_fn``/``merge_fn`` are
+    the tp hooks — identity projection/merge at tp=1, per-shard column
+    slice + quantized-wire head gather under shard_map.  ``fused``
+    routes the pool read through the one-pass Pallas kernel
+    (ops/serve_attn.py) instead of gather_kv + attention, with the
+    kernel's as-read page digests verified against the stored ones as a
+    BONUS read-path check (the pre-append check stays: the kernel
+    gathers post-refresh bytes, which are blessed by construction)."""
     h = _layernorm(x, blk["ln1"])
-    q, k, v = _qkv(blk, h, spec)
+    q, k, v = qkv_fn(blk, h)
     q = _rope(q, positions)
     k = _rope(k, positions)
     # pre-append integrity check: the refresh below re-digests the page
@@ -197,8 +276,19 @@ def _block(blk: dict, x: jnp.ndarray, positions: jnp.ndarray,
                             kvcache.pack_kv(v.reshape(flat), cfg),
                             page_ids, offsets)
     digests = kvcache.refresh_digests(pool, digests, layer, page_ids)
-    kc, vc = kvcache.gather_kv(pool, layer, page_rows, cfg)
-    attn = _paged_attention(q, kc, vc, positions, last_pos)
+    if fused:
+        attn, read_dig = fused_gather_attention(
+            pool[layer], q, page_rows, positions, last_pos,
+            page_size=cfg.page_size,
+            unpack_fn=lambda kv_pages: kvcache.unpack_kv(kv_pages, cfg),
+            attend_fn=_paged_attention,
+            interpret=jax.default_backend() != "tpu")
+        bad = bad + jnp.sum(
+            (read_dig != digests[layer][page_rows]).astype(jnp.int32))
+    else:
+        kc, vc = kvcache.gather_kv(pool, layer, page_rows, cfg)
+        attn = _paged_attention(q, kc, vc, positions, last_pos)
+    attn = merge_fn(attn)
     attn = attn.reshape(*attn.shape[:-2], spec.n_heads * spec.head_dim)
     x = x + attn @ blk["wo"]["kernel"]
 
@@ -212,19 +302,27 @@ def _forward(params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
              last_pos: jnp.ndarray, pool: jnp.ndarray,
              digests: jnp.ndarray, page_rows: jnp.ndarray,
              page_ids: jnp.ndarray, offsets: jnp.ndarray,
-             spec: ModelSpec, cfg: KVCacheConfig) -> tuple:
+             spec: ModelSpec, cfg: KVCacheConfig, qkv_fn=None,
+             merge_fn=None, fused: bool = False) -> tuple:
     """Shared decode/prefill body: embed -> blocks -> ln_f -> tied head.
     tokens/positions: (B, T); last_pos: (B,) newest live position per
     slot; returns ((B, T, V) logits, pool, digests, bad) where ``bad``
     is the summed pre-append digest-mismatch count over all layers (the
-    engine discards the dispatch and repairs when it is nonzero)."""
+    engine discards the dispatch and repairs when it is nonzero).
+    ``cfg`` must be the shard view; ``qkv_fn``/``merge_fn``/``fused``
+    as in `_block` (defaults are the tp=1 XLA path)."""
+    if qkv_fn is None:
+        qkv_fn = lambda blk, h: _qkv(blk, h, spec)  # noqa: E731
+    if merge_fn is None:
+        merge_fn = lambda attn: attn                # noqa: E731
     emb = params["embed"]["embedding"]
     x = emb[tokens].astype(jnp.float32)
     bad = jnp.zeros((), jnp.int32)
     for layer in range(spec.n_layers):
         x, pool, digests, layer_bad = _block(
             params[f"block{layer}"], x, positions, last_pos, pool,
-            digests, layer, page_rows, page_ids, offsets, spec, cfg)
+            digests, layer, page_rows, page_ids, offsets, spec, cfg,
+            qkv_fn, merge_fn, fused)
         bad = bad + layer_bad
     x = _layernorm(x, params["ln_f"])
     logits = jnp.einsum("btd,vd->btv", x, emb.astype(jnp.float32))
@@ -246,30 +344,102 @@ def _page_targets(positions: jnp.ndarray, page_rows: jnp.ndarray,
     return pids.reshape(-1), offs.reshape(-1).astype(jnp.int32)
 
 
-def make_decode_step(spec: ModelSpec, cfg: KVCacheConfig):
+def _serve_mesh(tp: int):
+    """The serving tp mesh: the first ``tp`` local devices on the one
+    tensor axis.  Fails fast with the fix (the conftest/bench device-
+    count forcing) when the platform is too small."""
+    devices = jax.devices()
+    if len(devices) < tp:
+        raise RuntimeError(
+            f"tp={tp} needs {tp} devices, have {len(devices)} — force "
+            "more virtual CPU devices (XLA_FLAGS="
+            "--xla_force_host_platform_device_count=N) before jax "
+            "initializes, or lower tp")
+    return make_mesh(tp=tp, devices=devices[:tp])
+
+
+def _check_tp(spec: ModelSpec, cfg: KVCacheConfig) -> None:
+    if spec.n_heads % cfg.tp != 0:
+        raise ValueError(
+            f"tp={cfg.tp} must divide n_heads={spec.n_heads}: decode "
+            "shards by whole query-head groups")
+    if spec.kv_heads % cfg.tp != 0:
+        raise ValueError(
+            f"tp={cfg.tp} must divide n_kv_heads={spec.kv_heads}")
+
+
+def make_decode_step(spec: ModelSpec, cfg: KVCacheConfig,
+                     fused: bool = False):
     """Jitted fixed-shape continuous-batching decode step.
 
     fn(params, pool, digests, tokens (S,), positions (S,), page_rows
     (S, max_pages), active (S,) bool) -> (pool, digests, logits (S, V),
     bad).  Each active slot feeds ONE token sitting at ``positions[s]``
     (appending its K/V there) and gets the next-token logits; inactive
-    slots ride along masked to the trash page."""
+    slots ride along masked to the trash page.
+
+    ``cfg.tp > 1`` runs the step under `shard_map` on the serving tp
+    mesh: params replicated, pool/digests sharded on their shard axis,
+    per-shard projections + attention, and the head merge over the
+    quantized all_gather wire (`_gather_heads` — bitwise == tp=1 at
+    (8, 23)).  ``fused`` routes the pool read through the one-pass
+    Pallas kernel; it is a retrace coordinate (`ladder_step_key`
+    carries it) and composes with tp.  The fp32 oracle cache keeps the
+    XLA read path — ``fused`` with ``raw=True`` is rejected."""
+    if fused and cfg.raw:
+        raise ValueError(
+            "fused_attn with raw=True: the fp32 oracle cache is the "
+            "reference the fused kernel is gated against — it keeps "
+            "the XLA read path")
+    _check_tp(spec, cfg)
 
     def build():
-        @jax.jit
-        def step(params, pool, digests, tokens, positions, page_rows,
+        if cfg.tp == 1:
+            @jax.jit
+            def step(params, pool, digests, tokens, positions, page_rows,
+                     active):
+                pos2 = positions[:, None]             # (S, 1)
+                pids, offs = _page_targets(pos2, page_rows,
+                                           active[:, None], cfg)
+                logits, pool2, digests2, bad = _forward(
+                    params, tokens[:, None], pos2, positions, pool,
+                    digests, page_rows, pids, offs, spec, cfg,
+                    fused=fused)
+                return pool2, digests2, logits[:, 0], bad
+
+            return step
+
+        mesh = _serve_mesh(cfg.tp)
+        sv = cfg.shard_view()
+        qkv_fn = lambda blk, h: _shard_qkv(blk, h, spec, cfg.tp)  # noqa: E731
+        merge_fn = lambda attn: _gather_heads(attn, cfg)          # noqa: E731
+
+        def body(params, pool, digests, tokens, positions, page_rows,
                  active):
-            pos2 = positions[:, None]                 # (S, 1)
+            # squeeze this shard's slice to the legacy tp=1 layout —
+            # every kvcache call inside _forward is shard-oblivious
+            pool = pool[:, :, 0]
+            digests = digests[:, :, 0]
+            pos2 = positions[:, None]
             pids, offs = _page_targets(pos2, page_rows, active[:, None],
                                        cfg)
-            logits, pool2, digests2, bad = _forward(
+            logits, pool, digests, bad = _forward(
                 params, tokens[:, None], pos2, positions, pool, digests,
-                page_rows, pids, offs, spec, cfg)
-            return pool2, digests2, logits[:, 0], bad
+                page_rows, pids, offs, spec, sv, qkv_fn=qkv_fn,
+                merge_fn=merge_fn, fused=fused)
+            # one fleet-visible verdict: any shard's mismatch is the
+            # engine's mismatch (psum is NOT a priced transport)
+            bad = lax.psum(bad, AXIS_TENSOR)
+            return (pool[:, :, None], digests[:, :, None],
+                    logits[:, 0], bad)
 
-        return step
+        shard = P(None, None, AXIS_TENSOR)
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), shard, shard, P(), P(), P(), P()),
+            out_specs=(shard, shard, P(), P()), check_vma=False))
 
-    return _STEP_CACHE.get_or_create(("decode", spec, cfg), build)
+    return _STEP_CACHE.get_or_create(("decode", spec, cfg, fused), build)
 
 
 def make_prefill_step(spec: ModelSpec, cfg: KVCacheConfig, chunk: int):
@@ -281,28 +451,64 @@ def make_prefill_step(spec: ModelSpec, cfg: KVCacheConfig, chunk: int):
     past n_valid is pad — masked to the trash page, its rows discarded)
     and returns the logits at the chunk's LAST VALID position —
     meaningful only for the prompt's final chunk, where it samples
-    token 0."""
+    token 0.  ``cfg.tp > 1`` shards exactly like `make_decode_step`
+    (prefill always keeps the XLA read path — the fused kernel is a
+    decode-hot-path optimization)."""
     if chunk < 1:
         raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+    _check_tp(spec, cfg)
 
     def build():
-        @jax.jit
-        def step(params, pool, digests, tokens, start, n_valid, page_row):
+        if cfg.tp == 1:
+            @jax.jit
+            def step(params, pool, digests, tokens, start, n_valid,
+                     page_row):
+                idx = jnp.arange(chunk, dtype=jnp.int32)
+                positions = (start + idx)[None]        # (1, C)
+                valid = (idx < n_valid)[None]
+                pids, offs = _page_targets(positions, page_row[None],
+                                           valid, cfg)
+                # newest LIVE position: the last VALID chunk lane (pad
+                # lanes have positions past it but write only to the
+                # trash page)
+                last_pos = (start + n_valid - 1)[None]
+                logits, pool2, digests2, bad = _forward(
+                    params, tokens[None], positions, last_pos, pool,
+                    digests, page_row[None], pids, offs, spec, cfg)
+                last = jnp.clip(n_valid - 1, 0, chunk - 1)
+                return pool2, digests2, logits[0, last], bad
+
+            return step
+
+        mesh = _serve_mesh(cfg.tp)
+        sv = cfg.shard_view()
+        qkv_fn = lambda blk, h: _shard_qkv(blk, h, spec, cfg.tp)  # noqa: E731
+        merge_fn = lambda attn: _gather_heads(attn, cfg)          # noqa: E731
+
+        def body(params, pool, digests, tokens, start, n_valid,
+                 page_row):
+            pool = pool[:, :, 0]
+            digests = digests[:, :, 0]
             idx = jnp.arange(chunk, dtype=jnp.int32)
-            positions = (start + idx)[None]            # (1, C)
+            positions = (start + idx)[None]
             valid = (idx < n_valid)[None]
             pids, offs = _page_targets(positions, page_row[None], valid,
                                        cfg)
-            # newest LIVE position: the last VALID chunk lane (pad lanes
-            # have positions past it but write only to the trash page)
             last_pos = (start + n_valid - 1)[None]
-            logits, pool2, digests2, bad = _forward(
-                params, tokens[None], positions, last_pos, pool, digests,
-                page_row[None], pids, offs, spec, cfg)
+            logits, pool, digests, bad = _forward(
+                params, tokens[None], positions, last_pos, pool,
+                digests, page_row[None], pids, offs, spec, sv,
+                qkv_fn=qkv_fn, merge_fn=merge_fn)
+            bad = lax.psum(bad, AXIS_TENSOR)
             last = jnp.clip(n_valid - 1, 0, chunk - 1)
-            return pool2, digests2, logits[0, last], bad
+            return (pool[:, :, None], digests[:, :, None],
+                    logits[0, last], bad)
 
-        return step
+        shard = P(None, None, AXIS_TENSOR)
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), shard, shard, P(), P(), P(), P()),
+            out_specs=(shard, shard, P(), P()), check_vma=False))
 
     return _STEP_CACHE.get_or_create(("prefill", spec, cfg, chunk), build)
 
@@ -343,41 +549,51 @@ def ir_programs(reg):
     deps = ("cpd_tpu.serve.model", "cpd_tpu.serve.kvcache",
             "cpd_tpu.quant.numerics")
 
-    def _cfg(block=None, fmt=(4, 3)):
+    def _cfg(block=None, fmt=(4, 3), tp=1):
         return KVCacheConfig(n_layers=spec.n_layers, n_pages=8,
                              page_size=4, n_kv_heads=spec.kv_heads,
                              head_dim=spec.head_dim, exp_bits=fmt[0],
                              man_bits=fmt[1],
                              block_scale=block is not None,
                              block_size=block if block is not None
-                             else 32)
+                             else 32, tp=tp)
 
-    def _decode(block=None, fmt=(4, 3)):
+    def _decode(block=None, fmt=(4, 3), tp=1):
         def build():
-            cfg = _cfg(block, fmt)
+            cfg = _cfg(block, fmt, tp)
             step = make_decode_step(spec, cfg)
             i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
             args = (_ir_abstract_params(spec),
                     jax.ShapeDtypeStruct(cfg.pool_shape, jnp.uint8),
-                    jax.ShapeDtypeStruct((cfg.n_layers, cfg.n_pages),
+                    jax.ShapeDtypeStruct(cfg.digests_shape,
                                          jnp.uint32),
                     i32(S), i32(S), i32(S, MP),
                     jax.ShapeDtypeStruct((S,), jnp.bool_))
             return step, args
         return build
 
-    def _prefill():
+    def _prefill(fmt=(4, 3), tp=1):
         def build():
-            cfg = _cfg()
+            cfg = _cfg(fmt=fmt, tp=tp)
             step = make_prefill_step(spec, cfg, CHUNK)
             i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
             args = (_ir_abstract_params(spec),
                     jax.ShapeDtypeStruct(cfg.pool_shape, jnp.uint8),
-                    jax.ShapeDtypeStruct((cfg.n_layers, cfg.n_pages),
+                    jax.ShapeDtypeStruct(cfg.digests_shape,
                                          jnp.uint32),
                     i32(CHUNK), i32(), i32(), i32(MP))
             return step, args
         return build
+
+    def _tp_wire(n_tokens, fmt):
+        # analytic cross-shard bytes (per device): one quantized
+        # all_gather of the per-shard attention outputs per layer —
+        # `gather_transport_bytes` is the same price the training ring
+        # quotes, so serving and training share one wire ledger.
+        h_loc = spec.n_heads // 2
+        n = n_tokens * h_loc * spec.head_dim
+        return lambda: spec.n_layers * gather_transport_bytes(
+            n, 2, fmt[0], fmt[1], compressed=True)
 
     reg.declare("serve.decode[e4m3]", _decode(), deps=deps,
                 bitwise=True)
@@ -386,4 +602,20 @@ def ir_programs(reg):
     reg.declare("serve.decode[e8m23]", _decode(fmt=(8, 23)),
                 deps=deps, bitwise=True)
     reg.declare("serve.prefill[e4m3]", _prefill(), deps=deps,
+                bitwise=True)
+    # tp=2 sharded twins (ISSUE 18): same contracts lifted onto the
+    # head-group mesh — the cross-shard attention gather is the ONLY
+    # wire, priced analytically and bitwise-gated like the ring.
+    reg.declare("serve.decode[tp2,e4m3]", _decode(tp=2), deps=deps,
+                axis_sizes={"tp": 2}, wire=_tp_wire(S, (4, 3)),
+                bitwise=True)
+    reg.declare("serve.decode[tp2,blocked-e4m3,b32]",
+                _decode(block=32, tp=2), deps=deps,
+                axis_sizes={"tp": 2}, wire=_tp_wire(S, (4, 3)),
+                bitwise=True)
+    reg.declare("serve.decode[tp2,e8m23]", _decode(fmt=(8, 23), tp=2),
+                deps=deps, axis_sizes={"tp": 2},
+                wire=_tp_wire(S, (8, 23)), bitwise=True)
+    reg.declare("serve.prefill[tp2,e4m3]", _prefill(tp=2), deps=deps,
+                axis_sizes={"tp": 2}, wire=_tp_wire(CHUNK, (4, 3)),
                 bitwise=True)

@@ -102,6 +102,15 @@ LINALG_PROGRAMS = {
     "linalg.lanczos[ring,e5m2,w8,s4]",
 }
 
+# the sharded serving programs (ISSUE 18 satellite: the tp=2 twins are
+# wire-priced — the cross-shard attention gather — AND bitwise-gated)
+SERVE_TP_PROGRAMS = {
+    "serve.decode[tp2,e4m3]",
+    "serve.decode[tp2,blocked-e4m3,b32]",
+    "serve.decode[tp2,e8m23]",
+    "serve.prefill[tp2,e4m3]",
+}
+
 
 def test_live_fast_subset_is_clean_and_ledger_matches():
     res = run_ir(providers=FAST_PROVIDERS, use_cache=False)
@@ -114,24 +123,30 @@ def test_live_fast_subset_is_clean_and_ledger_matches():
     # 5 linalg arms — all wire-priced AND bitwise-contracted)
     reg = collect_programs(FAST_PROVIDERS)
     wired = {s.name for s in reg.specs if s.wire is not None}
-    assert len(wired) >= 14, sorted(wired)
+    assert len(wired) >= 18, sorted(wired)
     assert LINALG_PROGRAMS <= {s.name for s in reg.specs}, \
         sorted(s.name for s in reg.specs)
     assert all(s.bitwise and s.wire is not None
                for s in reg.specs if s.name in LINALG_PROGRAMS)
+    assert SERVE_TP_PROGRAMS <= {s.name for s in reg.specs}, \
+        sorted(s.name for s in reg.specs)
+    assert all(s.bitwise and s.wire is not None
+               and s.axis_sizes == {"tp": 2}
+               for s in reg.specs if s.name in SERVE_TP_PROGRAMS)
 
 
 @pytest.mark.slow
 def test_live_registry_full_is_clean():
     """The acceptance gate: the FULL default registry — train-step and
-    LM twins included — traces and passes every program rule.  30 live
-    programs on this pin (25 from PR 14 + the 5 linalg declarations)."""
+    LM twins included — traces and passes every program rule.  34 live
+    programs on this pin (25 from PR 14 + 5 linalg declarations + the
+    4 tp=2 sharded serving twins of ISSUE 18)."""
     res = run_ir(use_cache=False)
     assert res.trace_failures == 0, [(f.rule, f.message)
                                      for f in res.findings]
     assert res.findings == [], [(f.rule, f.message)
                                 for f in res.findings]
-    assert res.programs_checked >= 30
+    assert res.programs_checked >= 34
 
 
 def test_zero2_transport_bytes_matches_real_packed_buffers():

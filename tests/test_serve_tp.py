@@ -1,0 +1,419 @@
+"""Sharded serving engine (ISSUE 18): tensor-parallel decode/prefill
+over the quantized ring + the fused gather→unpack→attention kernel.
+
+The contracts under test, in the same determinism doctrine as
+test_serve.py:
+
+* tp-width invariance — the SAME trace through tp=1, tp=2 (and tp=4 on
+  a 4-head-group model) engines produces BITWISE identical sampled
+  logits at (8, 23): the cross-shard all_gather packs fp32 losslessly
+  there, so sharding the heads must not move one bit.  Counters and
+  events are exact and x2 deterministic at every width.
+* sub-fp32 sharded bounds — e4m3/e5m2 quantize the attention outputs
+  on the wire, so tp>1 adds a bounded logit deviation vs tp=1
+  (docs/SERVING.md "Sharded engine" documents the bounds asserted
+  here).
+* the fused kernel is bitwise vs the XLA composition (gather_kv +
+  _paged_attention) at GQA shapes including odd tail pages and odd
+  blocked rows, in-kernel as-read digests included — and an engine
+  with fused_attn=True replays bitwise against the XLA engine.
+* per-shard integrity/mobility: kv_flip on the sharded pool is caught
+  and repaired; snapshots restore bitwise at tp=2; a migration capsule
+  refuses a tp-mismatched target BEFORE any page write and resumes
+  bitwise mid-PREFILL into a tp-matched one.
+* pricing: `kv_page_bytes(tp=...)`/`shard_page_bytes` equal the REAL
+  byte counts of pool slices, and the ladder key carries the fused
+  flag as a retrace coordinate.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpd_tpu.fleet import extract_capsule, restore_capsule
+from cpd_tpu.models import transformer_lm
+from cpd_tpu.obs import MetricsRegistry
+from cpd_tpu.ops import fused_gather_attention
+from cpd_tpu.quant.numerics import kv_page_bytes, kv_pool_bytes
+from cpd_tpu.resilience import FaultPlan
+from cpd_tpu.resilience.precision import (ladder_step_key,
+                                          resolve_ladder_key)
+from cpd_tpu.serve import (KVCacheConfig, Request, ServeEngine,
+                           decode_tail_matches)
+from cpd_tpu.serve import kvcache
+from cpd_tpu.serve.model import _paged_attention
+from cpd_tpu.serve.scheduler import FREE, PREFILL
+
+VOCAB = 64
+ENGINE_KW = dict(n_slots=2, max_seq=32, page_size=8, prefill_chunk=4)
+
+
+@pytest.fixture(scope="module")
+def gqa_model():
+    """n_kv_heads=2: supports tp in {1, 2}."""
+    model = transformer_lm(vocab_size=VOCAB, d_model=32, n_layers=2,
+                           n_heads=4, n_kv_heads=2, d_ff=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def mha4_model():
+    """n_kv_heads=4: supports tp in {1, 2, 4}."""
+    model = transformer_lm(vocab_size=VOCAB, d_model=32, n_layers=2,
+                           n_heads=4, n_kv_heads=4, d_ff=64)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _requests(n=3, seed=3, max_new=5, lens=(5, 7, 9)):
+    rng = np.random.RandomState(seed)
+    return [Request(rid=i,
+                    prompt=tuple(int(x) for x in
+                                 rng.randint(0, VOCAB, lens[i % len(lens)])),
+                    max_new_tokens=max_new, arrival=i % 2)
+            for i in range(n)]
+
+
+def _run(model, params, reqs, **over):
+    kw = dict(ENGINE_KW, record_logits=True)
+    kw.update(over)
+    eng = ServeEngine(model, params, **kw)
+    for r in reqs:
+        eng.submit(r)
+    eng.run_until_drained()
+    eng.report_unfired()
+    return eng
+
+
+def _rows(eng):
+    return {(rid, pos): row for rid, pos, row in eng.logits_log}
+
+
+def _assert_rows_bitwise(a, b):
+    assert a.keys() == b.keys() and len(a) > 0
+    for key in a:
+        np.testing.assert_array_equal(a[key].view(np.uint32),
+                                      b[key].view(np.uint32),
+                                      err_msg=f"logits differ at {key}")
+
+
+# ------------------------------------------------- tp-width invariance
+
+def test_tp2_bitwise_equals_tp1_at_e8m23_and_deterministic(gqa_model):
+    """The tentpole gate: the same trace at tp=2 is BITWISE identical
+    to tp=1 at (8,23) — sampled logits, counters, finished tokens —
+    and the tp=2 replay is exact twice."""
+    model, params = gqa_model
+    reqs = _requests(n=3)
+    e1 = _run(model, params, reqs, kv_format=(8, 23))
+    e2a = _run(model, params, reqs, kv_format=(8, 23), tp=2)
+    e2b = _run(model, params, reqs, kv_format=(8, 23), tp=2)
+    _assert_rows_bitwise(_rows(e1), _rows(e2a))
+    assert e2a.counters == e2b.counters == e1.counters
+    assert e2a.finished == e1.finished
+    assert e2a.unresolved() == []
+
+
+@pytest.mark.slow
+def test_tp4_bitwise_equals_tp1_at_e8m23(mha4_model):
+    """Same invariance at tp=4 on a 4-head-group model — every shard
+    holds exactly one KV head."""
+    model, params = mha4_model
+    reqs = _requests(n=3, seed=11)
+    e1 = _run(model, params, reqs, kv_format=(8, 23))
+    e4 = _run(model, params, reqs, kv_format=(8, 23), tp=4)
+    _assert_rows_bitwise(_rows(e1), _rows(e4))
+    assert e4.counters == e1.counters
+    assert e4.finished == e1.finished
+
+
+@pytest.mark.parametrize("fmt,bound", [
+    ((4, 3), 0.5),
+    pytest.param((5, 2), 1.5, marks=pytest.mark.slow),
+])
+def test_sharded_subfp32_logit_deviation_bounded(gqa_model, fmt, bound):
+    """Sub-fp32 formats quantize the attention outputs on the tp wire:
+    tp=2 deviates from tp=1 by a bounded amount over the common decode
+    prefix (greedy sampling may diverge after that — compare stops at
+    the first token split, exactly like the kv-sweep scorer)."""
+    model, params = gqa_model
+    reqs = _requests(n=3, seed=7)
+    e1 = _run(model, params, reqs, kv_format=fmt)
+    e2 = _run(model, params, reqs, kv_format=fmt, tp=2)
+    err, rows = 0.0, 0
+    for (r1, p1, l1), (r2, p2, l2) in zip(e1.logits_log, e2.logits_log):
+        if (r1, p1) != (r2, p2):
+            break
+        err = max(err, float(np.abs(l1 - l2).max()))
+        rows += 1
+    assert rows > 0
+    assert err < bound, \
+        f"tp=2 {fmt} logit deviation {err} above documented bound {bound}"
+
+
+def test_tp_rejects_indivisible_heads(gqa_model):
+    model, params = gqa_model          # n_kv_heads=2
+    with pytest.raises(ValueError):
+        ServeEngine(model, params, **ENGINE_KW, tp=4)
+    with pytest.raises(ValueError):
+        KVCacheConfig(n_layers=2, n_kv_heads=2, head_dim=8, page_size=8,
+                      n_pages=4, tp=3)
+
+
+# ---------------------------------------------------- the fused kernel
+
+@pytest.mark.parametrize("h,hkv,d,page,mp,fmt,block", [
+    (4, 2, 8, 4, 3, (4, 3), None),     # GQA 2:1, odd tail page
+    (4, 4, 8, 4, 2, (8, 23), None),    # MHA, fp32-exact codec
+    (8, 2, 16, 2, 3, (5, 2), None),    # GQA 4:1, tiny pages
+    (4, 2, 8, 4, 3, (4, 3), 12),       # blocked sidecar, odd blocks
+])
+def test_fused_kernel_bitwise_vs_xla_composition(h, hkv, d, page, mp,
+                                                 fmt, block):
+    """One kernel pass == gather_kv + _paged_attention bit for bit, and
+    the in-kernel as-read Fletcher digests == the stored page digests."""
+    cfg = KVCacheConfig(n_layers=1, n_pages=8, page_size=page,
+                        n_kv_heads=hkv, head_dim=d, exp_bits=fmt[0],
+                        man_bits=fmt[1], block_scale=block is not None,
+                        block_size=block if block is not None else 32)
+    rng = np.random.RandomState(h * 10 + hkv + (block or 0))
+    kv_raw = jnp.asarray(rng.randn(cfg.n_pages, 2, page, hkv, d)
+                         .astype(np.float32))
+    pool = kvcache.pack_kv(kv_raw, cfg)[None]
+    rows = jnp.asarray(rng.choice(cfg.n_pages, size=(2, mp),
+                                  replace=False).astype(np.int32))
+    last = jnp.asarray([mp * page - 2, page + 1], dtype=jnp.int32)
+    q = jnp.asarray(rng.randn(2, 1, h, d).astype(np.float32))
+    pos = last[:, None] + 1
+    attn, dig = fused_gather_attention(
+        pool[0], q, rows, pos, last, page_size=page,
+        unpack_fn=lambda kvp: kvcache.unpack_kv(kvp, cfg),
+        attend_fn=_paged_attention, interpret=True)
+    k, v = kvcache.gather_kv(pool, 0, rows, cfg)
+    want = _paged_attention(q, k, v, pos, last)
+    np.testing.assert_array_equal(np.asarray(attn).view(np.uint32),
+                                  np.asarray(want).view(np.uint32))
+    want_dig = jax.vmap(jax.vmap(kvcache.wire_digest))(pool[0][rows])
+    np.testing.assert_array_equal(np.asarray(dig), np.asarray(want_dig))
+
+
+@pytest.mark.parametrize("over", [
+    dict(kv_format=(8, 23)),
+    dict(kv_format=(4, 3)),
+    pytest.param(dict(kv_format=(4, 3), kv_block_size=24),
+                 marks=pytest.mark.slow),
+])
+def test_fused_engine_bitwise_equals_xla_engine(gqa_model, over):
+    """fused_attn=True is a pure hot-path swap: same trace, same bits,
+    same counters as the XLA engine — per format, blocked included."""
+    model, params = gqa_model
+    reqs = _requests(n=3, seed=5)
+    ex = _run(model, params, reqs, **over)
+    ef = _run(model, params, reqs, fused_attn=True, **over)
+    _assert_rows_bitwise(_rows(ex), _rows(ef))
+    assert ef.counters == ex.counters
+    assert ef.finished == ex.finished
+
+
+def test_fused_tp2_engine_bitwise_equals_tp1_xla(gqa_model):
+    """Both tentpole legs at once: sharded decode WITH the fused kernel
+    still matches the unsharded XLA engine bitwise at (8,23)."""
+    model, params = gqa_model
+    reqs = _requests(n=3, seed=13)
+    e1 = _run(model, params, reqs, kv_format=(8, 23))
+    ef = _run(model, params, reqs, kv_format=(8, 23), tp=2,
+              fused_attn=True)
+    _assert_rows_bitwise(_rows(e1), _rows(ef))
+    assert ef.counters == e1.counters
+
+
+def test_fused_refuses_raw_cache(gqa_model):
+    """The fused kernel is an eXmY-unpack kernel; the raw fp32 oracle
+    has no packed bytes to unpack — refused at build, not mis-traced."""
+    model, params = gqa_model
+    with pytest.raises(ValueError, match="raw"):
+        ServeEngine(model, params, **ENGINE_KW, raw_cache=True,
+                    fused_attn=True)
+
+
+# ----------------------------------- per-shard integrity and mobility
+
+def test_sharded_kv_flip_detected_and_repaired_deterministic(gqa_model):
+    """kv_flip on the SHARDED pool: the per-shard page digests catch
+    the flip, repair recomputes, the trace completes — exact twice."""
+    model, params = gqa_model
+    reqs = _requests(n=3, seed=9)
+
+    def faulted():
+        return _run(model, params, reqs, kv_format=(8, 23), tp=2,
+                    scrub_every=2,
+                    fault_plan=FaultPlan.parse("kv_flip@6:0"))
+
+    f1, f2 = faulted(), faulted()
+    assert f1.counters == f2.counters
+    c = f1.counters
+    assert c["kv_flips_injected"] == 1, c
+    assert c["kv_pages_corrupt"] >= 1 and c["kv_repairs"] >= 1, c
+    assert c["kv_faults_unfired"] == 0, c
+    assert f1.unresolved() == []
+
+
+def test_snapshot_restore_bitwise_at_tp2(gqa_model, tmp_path):
+    """A mid-trace tp=2 snapshot restores (tp rides the _init_kw
+    recipe) and the remaining decode stream is bitwise identical."""
+    model, params = gqa_model
+    reqs = _requests(n=3, seed=21)
+    ea = ServeEngine(model, params, **ENGINE_KW, kv_format=(8, 23),
+                     tp=2, record_logits=True)
+    for r in reqs:
+        ea.submit(r)
+    for _ in range(6):
+        ea.step()
+    snap = os.path.join(tmp_path, "snap")
+    ea.snapshot(snap)
+    mark = len(ea.logits_log)
+    ea.run_until_drained()
+    eb = ServeEngine.restore(model, params, snap)
+    assert eb.tp == 2 and eb.cfg.tp == 2
+    eb.run_until_drained()
+    assert decode_tail_matches(ea, mark, eb) > 0
+
+
+def test_capsule_refuses_tp_mismatch_before_any_page_write(mha4_model):
+    """A tp=2 capsule into a tp=4 engine: the cache-layout fingerprint
+    now carries tp, so the restore refuses up front — target pool
+    untouched, no slot occupied."""
+    model, params = mha4_model
+    src = ServeEngine(model, params, **ENGINE_KW, tp=2)
+    dst = ServeEngine(model, params, **ENGINE_KW, tp=4)
+    src.submit(Request(rid=2,
+                       prompt=_requests(1, seed=17, lens=(20,))[0].prompt,
+                       max_new_tokens=8, arrival=0))
+    for _ in range(4):
+        src.step()
+    assert src.slot_of_rid(2) is not None
+    cap = extract_capsule(src, 2)
+    before = np.asarray(dst._pool).copy()
+    with pytest.raises(ValueError, match="incompatible"):
+        restore_capsule(dst, cap)
+    assert (np.asarray(dst._pool) == before).all()
+    assert all(sl.state == FREE for sl in dst.sched.slots)
+    assert dst.sched.page_refs == {}
+
+
+def test_capsule_tp_matched_restores_bitwise_mid_prefill(gqa_model):
+    """tp=2 -> tp=2 migration extracted mid-PREFILL resumes bitwise:
+    the sharded pages move as exact bytes, digests reseal per shard."""
+    model, params = gqa_model
+    req = Request(rid=5, prompt=_requests(1, seed=31, lens=(14,))[0]
+                  .prompt, max_new_tokens=4, arrival=0)
+    kw = dict(ENGINE_KW, kv_format=(8, 23), tp=2, record_logits=True)
+    base = ServeEngine(model, params, **kw)
+    base.submit(req)
+    base.run_until_drained()
+
+    src = ServeEngine(model, params, **kw)
+    dst = ServeEngine(model, params, **kw)
+    src.submit(req)
+    src.step()
+    slot = src.slot_of_rid(5)
+    assert slot.state == PREFILL and 0 < slot.fed < len(req.prompt)
+    cap = extract_capsule(src, 5)
+    restore_capsule(dst, cap)
+    assert dst.slot_of_rid(5).state == PREFILL
+    dst.run_until_drained()
+    assert dst.finished[5] == base.finished[5]
+    rows = {}
+    for eng in (src, dst):
+        rows.update(_rows(eng))
+    _assert_rows_bitwise(_rows(base), rows)
+
+
+# -------------------------------------------- pricing and observability
+
+@pytest.mark.parametrize("fmt,block", [((8, 23), None), ((4, 3), None),
+                                       ((4, 3), 16), ((5, 2), None)])
+def test_kv_page_bytes_matches_real_sharded_pool_slices(fmt, block):
+    """The analytic per-shard and aggregate prices equal the REAL byte
+    counts of pool slices — one source of truth, now per shard."""
+    tp = 2
+    cfg = KVCacheConfig(n_layers=2, n_kv_heads=2, head_dim=16,
+                        page_size=8, n_pages=4, exp_bits=fmt[0],
+                        man_bits=fmt[1], block_scale=block is not None,
+                        block_size=block if block is not None else 32,
+                        tp=tp)
+    pool = kvcache.alloc_pool(cfg)
+    assert pool.shape[:3] == (cfg.n_layers, cfg.n_pages, tp)
+    shard_slice = np.asarray(pool[0, 0, 0])
+    page_slice = np.asarray(pool[0, 0])
+    assert cfg.shard_page_bytes == shard_slice.nbytes
+    assert cfg.page_bytes == page_slice.nbytes
+    assert kv_page_bytes(*fmt, cfg.page_size, 2, 16, block_size=block,
+                         tp=tp) == page_slice.nbytes
+    out = kv_pool_bytes(*fmt, cfg.page_size, 2, 16,
+                        n_layers=cfg.n_layers,
+                        logical_pages=cfg.n_pages, block_size=block,
+                        tp=tp)
+    assert out["tp"] == tp
+    assert out["shard_page_bytes"] == cfg.n_layers * shard_slice.nbytes
+
+
+def test_tp1_pool_layout_and_pricing_unchanged():
+    """tp=1 keeps the exact legacy shapes and prices — the shard axis
+    only exists when tp > 1 (snapshot compatibility)."""
+    cfg = KVCacheConfig(n_layers=2, n_kv_heads=2, head_dim=16,
+                        page_size=8, n_pages=4, exp_bits=4, man_bits=3)
+    assert cfg.pool_shape[:2] == (2, 4) and len(cfg.pool_shape) == 7
+    assert cfg.digests_shape == (2, 4)
+    assert cfg.shard_page_bytes == cfg.page_bytes
+    assert kv_page_bytes(4, 3, 8, 2, 16) == \
+        kv_page_bytes(4, 3, 8, 2, 16, tp=1)
+    with pytest.raises(ValueError):
+        kv_page_bytes(4, 3, 8, 2, 16, tp=3)
+
+
+def test_shard_gauges_exported_with_shard_label(gqa_model):
+    """absorb_serve_shards + the fleet absorb path export the per-shard
+    pool gauges with a `shard` label (docs/OBSERVABILITY.md rows)."""
+    model, params = gqa_model
+    eng = ServeEngine(model, params, **ENGINE_KW, tp=2)
+    reg = MetricsRegistry()
+    reg.absorb_serve_shards(eng.cfg, engine=0)
+    rows = {name: series
+            for name, _k, _h, _b, series in reg.collect()}
+    pages = rows["cpd_serve_kv_shard_page_bytes"]
+    labels = [dict(lbl) for lbl, _v in pages]
+    assert sorted(l["shard"] for l in labels) == ["0", "1"]
+    assert all(l["engine"] == "0" for l in labels)
+    assert all(v == float(eng.cfg.shard_page_bytes)
+               for _l, v in pages)
+    pools = rows["cpd_serve_kv_shard_pool_bytes"]
+    want = float(eng.cfg.n_layers * eng.cfg.n_pages
+                 * eng.cfg.shard_page_bytes)
+    assert all(v == want for _l, v in pools)
+
+
+def test_ladder_key_carries_fused_coordinate():
+    """fused_attn is a retrace coordinate: the ladder key changes with
+    it and resolve strips it FIRST (reverse append order)."""
+    from cpd_tpu.resilience import TransportSupervisor
+    from cpd_tpu.resilience.precision import PrecisionSupervisor
+
+    t = TransportSupervisor(start="ring")
+    p = PrecisionSupervisor("e5m2,e8m23")
+    kw = dict(transport_on=True, precision_on=True, level="ring",
+              fmt=(5, 2))
+    base = ladder_step_key(t, p, block=None)
+    fused = ladder_step_key(t, p, block=None, fused=True)
+    assert base != fused and fused == (base, ("fused", True))
+    assert resolve_ladder_key(fused, fused_on=True, **kw) == \
+        resolve_ladder_key(base, **kw)
+    both = ladder_step_key(t, p, block=(True, 32), fused=True)
+    assert resolve_ladder_key(both, block_on=True, fused_on=True,
+                              **kw) == resolve_ladder_key(base, **kw)

@@ -86,6 +86,14 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# the sharded drills (--tp-sweep, fleet-smoke gate 5) need a multi-device
+# host: force virtual CPU devices BEFORE any jax backend initializes
+# (no-op on a real TPU slice, where the platform brings its own devices)
+_TP_FLAG = "--xla_force_host_platform_device_count=8"
+if _TP_FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = \
+        (os.environ.get("XLA_FLAGS", "") + " " + _TP_FLAG).strip()
+
 # the ONE eXmY spec parser (validated, good errors) — not a local copy
 from cpd_tpu.resilience.precision import parse_format  # noqa: E402
 
@@ -496,6 +504,64 @@ def run_kv_sweep(args) -> dict:
             "requests": len(trace)}
 
 
+def run_tp_sweep(args) -> dict:
+    """The sharded serving frontier (ISSUE 18) for docs/PERF.md: the
+    same offered trace through tensor-parallel engines at tp = 1, 2, 4
+    — aggregate tok/s plus the ANALYTIC per-token cross-shard wire
+    (the per-layer quantized all_gather of attention outputs, priced by
+    `gather_transport_bytes`, the same ledger the --ir gate pins) —
+    and the fused gather→unpack→attention kernel's decode hot-path
+    timing vs the XLA composition (fused_attn=True vs False on
+    otherwise identical engines).  The tp=4 rows need 4 KV head
+    groups, so the sweep model widens _SMOKE_MODEL to n_kv_heads=4."""
+    import jax
+    import jax.numpy as jnp
+
+    from cpd_tpu.models import transformer_lm
+    from cpd_tpu.parallel.ring import gather_transport_bytes
+    from cpd_tpu.serve import ServeEngine, run_trace
+
+    tp_model = dict(_SMOKE_MODEL, n_kv_heads=4)
+    model = transformer_lm(**tp_model)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    trace = _build_trace(args)
+    hd = tp_model["d_model"] // tp_model["n_heads"]
+
+    rows = []
+    for tp in (1, 2, 4):
+        kw = dict(_SMOKE_ENGINE, kv_format=args.kv_format,
+                  seed=args.seed, tp=tp)
+        run_trace(ServeEngine(model, params, **kw), list(trace))  # warm
+        m = run_trace(ServeEngine(model, params, **kw), list(trace))
+        h_loc = tp_model["n_heads"] // tp
+        wire = 0 if tp == 1 else tp_model["n_layers"] * \
+            gather_transport_bytes(h_loc * hd, tp, *args.kv_format,
+                                   compressed=True)
+        rows.append({"tp": tp, "tok_per_s": m["tok_per_s"],
+                     "wire_bytes_per_token": wire,
+                     "completed": m["completed"],
+                     "dropped": m["dropped"]})
+
+    # fused decode hot path vs the XLA composition: same engine, same
+    # trace, fused_attn flipped (CAVEAT printed with the number: off
+    # TPU the kernel runs in interpret mode, so only the TPU timing
+    # speaks for the Mosaic lowering)
+    fused_rows = []
+    for fused in (False, True):
+        kw = dict(_SMOKE_ENGINE, kv_format=args.kv_format,
+                  seed=args.seed, fused_attn=fused)
+        run_trace(ServeEngine(model, params, **kw), list(trace))  # warm
+        m = run_trace(ServeEngine(model, params, **kw), list(trace))
+        fused_rows.append({"fused_attn": fused,
+                           "tok_per_s": m["tok_per_s"],
+                           "completed": m["completed"]})
+    return {"tp_sweep": rows, "fused_hot_path": fused_rows,
+            "backend": jax.default_backend(),
+            "model": tp_model, "requests": len(trace),
+            "kv_format": list(args.kv_format)}
+
+
 def _fleet(model, params, args, n_engines, **over):
     from cpd_tpu.fleet import Fleet
 
@@ -624,10 +690,11 @@ def run_fleet_smoke(args) -> dict:
                 rows[(rid, pos)] = row
         return rows
 
-    def mig_run(migrate: bool):
+    def mig_run(migrate: bool, **extra_over):
         fleet = _fleet(model, params, args, 2,
                        engine_over={"kv_format": (8, 23),
-                                    "record_logits": True})
+                                    "record_logits": True,
+                                    **extra_over})
         pending = sorted(trace, key=lambda r: (r.arrival, r.rid))
         moved = None
         while pending or not fleet.drained():
@@ -738,6 +805,61 @@ def run_fleet_smoke(args) -> dict:
         "chunks": [agg["prefill_chunks"], aggn["prefill_chunks"]],
         "rows_compared": len(c_rows), "bitwise": True,
         "collision_rejected": True}
+
+    # 5. tp=2 sharded drill (ISSUE 18): the fleet's engines run
+    # tensor-parallel over 2 head groups — routing stays exact x2,
+    # a session migrated mid-decode between SHARDED engines resumes
+    # bitwise, and a kv_flip on the sharded pool is caught by the
+    # per-shard page digests and repaired, deterministically
+    from cpd_tpu.serve import run_trace
+
+    def tp_route_run():
+        fleet = _fleet(model, params, args, 2, engine_over={"tp": 2})
+        return run_fleet_trace(fleet, list(trace)), fleet
+
+    t1, tf1 = tp_route_run()
+    t2, _ = tp_route_run()
+    assert t1["fleet_counters"] == t2["fleet_counters"], \
+        f"tp=2 fleet counters not deterministic:\n{t1['fleet_counters']}" \
+        f"\n{t2['fleet_counters']}"
+    assert t1["engine_counters"] == t2["engine_counters"], \
+        "tp=2 per-engine counters not deterministic"
+    assert t1["dropped"] == 0 and tf1.unresolved() == [], \
+        f"tp=2 fleet silent drops: {t1['dropped']}"
+    assert t1["completed"] == len(trace), t1
+
+    tbase, _ = mig_run(False, tp=2)
+    tmig, tmoved = mig_run(True, tp=2)
+    assert tmoved is not None, "tp=2 migration drill found no session"
+    assert tmig.counters["migrations"] == 1
+    tb_rows, tm_rows = decode_rows(tbase), decode_rows(tmig)
+    assert tb_rows.keys() == tm_rows.keys() and len(tb_rows) > 0
+    for key in tb_rows:
+        assert (tb_rows[key].view(np.uint32)
+                == tm_rows[key].view(np.uint32)).all(), \
+            f"tp=2 migrated fleet logits differ at {key}"
+    assert tmig.unresolved() == []
+
+    tplan = FaultPlan.parse("kv_flip@6:0")
+    tf_a = run_trace(_fresh_engine(model, params, args, tp=2,
+                                   scrub_every=2, fault_plan=tplan),
+                     list(trace))
+    tf_b = run_trace(_fresh_engine(model, params, args, tp=2,
+                                   scrub_every=2, fault_plan=tplan),
+                     list(trace))
+    tc = tf_a["counters"]
+    assert tc == tf_b["counters"], \
+        f"tp=2 fault-drill counters not deterministic:\n{tc}"
+    assert tc["kv_flips_injected"] == 1, tc
+    assert tc["kv_pages_corrupt"] >= 1 and tc["kv_repairs"] >= 1, tc
+    assert tf_a["dropped"] == 0 and tf_a["completed"] == len(trace), tc
+    out["tp2_drill"] = {
+        "routing_deterministic": True, "completed": t1["completed"],
+        "migrated_rid": tmoved, "rows_compared": len(tb_rows),
+        "migration_bitwise": True,
+        "repair": {"flips": tc["kv_flips_injected"],
+                   "pages_corrupt": tc["kv_pages_corrupt"],
+                   "repairs": tc["kv_repairs"]}}
     return out
 
 
@@ -875,6 +997,11 @@ def main() -> int:
                    help="CI gate: N=2 route/migrate/kill/prefix drills"
                         " — bitwise resume, zero silent drops, "
                         "counters exact x2")
+    p.add_argument("--tp-sweep", action="store_true",
+                   help="sharded serving frontier (ISSUE 18): tok/s + "
+                        "per-token cross-shard wire bytes at tp=1,2,4 "
+                        "and fused-vs-XLA decode hot path, for "
+                        "docs/PERF.md")
     p.add_argument("--soak-smoke", action="store_true",
                    help="CI gate (ISSUE 17): streaming arrivals x "
                         "kill wave x flash crowd x autoscale up/down "
@@ -904,6 +1031,8 @@ def main() -> int:
         out = run_soak_smoke(args)
     elif args.fleet_smoke:
         out = run_fleet_smoke(args)
+    elif args.tp_sweep:
+        out = run_tp_sweep(args)
     elif args.fleet:
         out = run_fleet(args)
     elif args.kv_sweep:

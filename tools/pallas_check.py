@@ -262,6 +262,60 @@ def main() -> int:
           "OK" if len(failures) == fw_before else failures[fw_before:],
           flush=True)
 
+    # 8. fused gather→unpack→attention (ISSUE 18) — the sharded serving
+    # engine's decode hot path: page-row gather + eXmY unpack (blocked
+    # sidecar included) + masked GQA attention + as-read page digests in
+    # ONE kernel, bitwise vs the XLA composition (gather_kv +
+    # _paged_attention) and digest-exact vs the pool's stored digests.
+    # Shapes include GQA head ratios, an odd tail page, and a blocked
+    # row with an odd block count.
+    from cpd_tpu.serve import kvcache as _kvc
+    from cpd_tpu.serve.kvcache import KVCacheConfig
+    from cpd_tpu.serve.model import _paged_attention
+    from cpd_tpu.ops import fused_gather_attention
+
+    fa_before = len(failures)
+    for (h, hkv, d, page, mp, fmt, block) in [
+            (4, 2, 8, 4, 3, (4, 3), None),       # GQA 2:1, odd tail page
+            (4, 4, 8, 4, 2, (8, 23), None),      # MHA, fp32-exact codec
+            (8, 2, 16, 2, 3, (5, 2), None),      # GQA 4:1, tiny pages
+            (4, 2, 8, 4, 3, (4, 3), 12)]:        # blocked, odd blocks
+        cfg = KVCacheConfig(n_layers=1, n_pages=8, page_size=page,
+                            n_kv_heads=hkv, head_dim=d,
+                            exp_bits=fmt[0], man_bits=fmt[1],
+                            block_scale=block is not None,
+                            block_size=block if block is not None
+                            else 32)
+        s_count = 2
+        kv_raw = jnp.asarray(rng.randn(cfg.n_pages, 2, page, hkv, d)
+                             .astype(np.float32))
+        pool = _kvc.pack_kv(kv_raw, cfg)[None]    # (1, n_pages, ...)
+        rows = jnp.asarray(
+            rng.choice(cfg.n_pages, size=(s_count, mp), replace=False)
+            .astype(np.int32))
+        last = jnp.asarray([mp * page - 2, page + 1], dtype=jnp.int32)
+        q = jnp.asarray(rng.randn(s_count, 1, h, d).astype(np.float32))
+        pos = last[:, None] + 1
+        attn, dig = fused_gather_attention(
+            pool[0], q, rows, pos, last, page_size=page,
+            unpack_fn=lambda kv: _kvc.unpack_kv(kv, cfg),
+            attend_fn=_paged_attention, interpret=interpret)
+        k, v = _kvc.gather_kv(pool, 0, rows, cfg)
+        want = _paged_attention(q, k, v, pos, last)
+        want_dig = jax.vmap(jax.vmap(_kvc.wire_digest))(pool[0][rows])
+        tag = (f"h={h}/{hkv} d={d} page={page} "
+               f"e{fmt[0]}m{fmt[1]} block={block}")
+        if not np.array_equal(np.asarray(attn).view(np.uint32),
+                              np.asarray(want).view(np.uint32)):
+            failures.append(
+                f"fused attn {tag} maxdiff="
+                f"{np.max(np.abs(np.asarray(attn) - np.asarray(want)))}")
+        if not np.array_equal(np.asarray(dig), np.asarray(want_dig)):
+            failures.append(f"fused attn digests {tag}")
+    print("fused gather-attention:",
+          "OK" if len(failures) == fa_before else failures[fa_before:],
+          flush=True)
+
     if failures:
         print("FAIL:", failures)
         return 1
