@@ -276,6 +276,25 @@ class MetricsRegistry:
         for s, total in sorted(shard_totals.items()):
             self.set_gauge("cpd_fleet_kv_shard_bytes", total, shard=s)
 
+    def absorb_elastic(self, supervisor) -> None:
+        """A `cpd_tpu.resilience.ElasticSupervisor` — the
+        ``cpd_elastic_*`` family (ISSUE 19): the recovery-ladder
+        decision counters (drains, rejoins, shrinks, regrows, hot
+        steps, heartbeat misses, link retries/escalations) mirrored
+        unlabelled, plus the live fleet-shape gauges: the current
+        compute world, the home (full-fleet) world, the alive-host
+        count, and a degraded flag — docs/OBSERVABILITY.md lists the
+        rows."""
+        for key, value in supervisor.counters.items():
+            self.mirror(f"cpd_elastic_{key}", float(value))
+        self.set_gauge("cpd_elastic_world", float(supervisor.world))
+        self.set_gauge("cpd_elastic_home_world",
+                       float(supervisor.home_world))
+        self.set_gauge("cpd_elastic_alive",
+                       float(sum(supervisor.alive)))
+        self.set_gauge("cpd_elastic_degraded",
+                       1.0 if supervisor.degraded else 0.0)
+
     # -- reads ------------------------------------------------------------
 
     def collect(self) -> list:

@@ -101,7 +101,7 @@ from ..quant.numerics import (cast_body_blocked, cast_to_format,
 
 __all__ = ["ring_quantized_sum", "ring_oracle_sum", "ring_transport_bytes",
            "gather_transport_bytes", "transport_table", "pad_to_world",
-           "ring_chunk_size", "hierarchical_ring_sum",
+           "reflatten_to_world", "ring_chunk_size", "hierarchical_ring_sum",
            "ring_oracle_sum_multi"]
 
 
@@ -117,6 +117,27 @@ def pad_to_world(flat: jnp.ndarray, world: int) -> jnp.ndarray:
     quantized reduction (and are sliced off before returning)."""
     n = flat.shape[0]
     return jnp.pad(flat, (0, world * ring_chunk_size(n, world) - n))
+
+
+def reflatten_to_world(flat: jnp.ndarray, total: int,
+                       world: int) -> jnp.ndarray:
+    """Re-shard a world-padded flat layout for a DIFFERENT world size:
+    trim the old pad (the real data is the first ``total`` elements —
+    the invariant every padded flat layout here keeps, because exact-zero
+    grads leave exact-zero momentum in the pad) and re-pad through
+    `pad_to_world` at the new world.  Bitwise-faithful in both
+    directions, for ANY world pair — including non-divisible shrinks
+    (8 -> 3): only the pad length changes, never a data element.  The
+    runtime half of the elastic-restart contract (ISSUE 4/19): the
+    checkpoint layer re-flattens through this on a ``world=`` restore,
+    and the elastic shrink/regrow path re-flattens live ZeRO state the
+    same way."""
+    if total > flat.shape[0]:
+        raise ValueError(
+            f"reflatten_to_world: flat layout holds {flat.shape[0]} "
+            f"elements but total={total} are claimed as data — the "
+            f"caller's layout and parameter count disagree")
+    return pad_to_world(flat[:total], world)
 
 
 def _make_hop_q(exp: int, man: int, key, block: Optional[int] = None):

@@ -34,14 +34,19 @@ GradScaler-style skip); this package adds
 * **loop** — :func:`run_guarded`: the defenses composed around any
   ``(state, x, y) -> (state, metrics)`` step, with integrity-checked
   checkpoint rollback, bounded re-seeded retries, verified-reduce
-  supervision and periodic replica-consensus repair.
+  supervision and periodic replica-consensus repair;
+* **elastic** — :class:`ElasticSupervisor` + :func:`run_elastic`: the
+  whole-host recovery ladder (ISSUE 19) — heartbeat/straggler
+  detection, in-step link retries, deterministic mesh shrink W -> W'
+  through the digest-sealed checkpoints, probationary regrow.
 
 The defense matrix (fault -> detector -> recovery) is documented in
 docs/RESILIENCE.md.
 """
 
-from .inject import (FaultPlan, FaultSpec, InjectedPreemption, Injector,
-                     report_unfired, with_fault_injection)
+from .inject import (ELASTIC_KINDS, FaultPlan, FaultSpec,
+                     InjectedPreemption, Injector, report_unfired,
+                     with_fault_injection)
 from .guard import (GradGuardState, describe_culprit, find_guard,
                     guard_metrics, with_grad_guard)
 from .sentinel import DivergenceSentinel
@@ -50,10 +55,12 @@ from .precision import (PrecisionSupervisor, format_name, ladder_step_key,
                         parse_format, parse_ladder)
 from .watchdog import StepWatchdog
 from .loop import GuardedReport, run_guarded
+from .elastic import (ElasticReport, ElasticSupervisor, HeartbeatMonitor,
+                      heartbeat_table, run_elastic, shrink_world)
 
 __all__ = [
     "FaultPlan", "FaultSpec", "Injector", "InjectedPreemption",
-    "with_fault_injection", "report_unfired",
+    "with_fault_injection", "report_unfired", "ELASTIC_KINDS",
     "GradGuardState", "with_grad_guard", "guard_metrics", "find_guard",
     "describe_culprit",
     "DivergenceSentinel", "StepWatchdog",
@@ -61,4 +68,6 @@ __all__ = [
     "PrecisionSupervisor", "parse_format", "parse_ladder", "format_name",
     "ladder_step_key",
     "run_guarded", "GuardedReport",
+    "ElasticSupervisor", "HeartbeatMonitor", "run_elastic",
+    "ElasticReport", "heartbeat_table", "shrink_world",
 ]

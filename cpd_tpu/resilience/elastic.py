@@ -1,0 +1,705 @@
+"""Elastic training — survive whole-host faults mid-run (ISSUE 19).
+
+The resilience doctrine so far covers wire bits (transport ladder,
+ISSUE 4), numeric formats (precision ladder, ISSUE 5) and the serving
+fleet (ISSUES 10/13/17) — but the trainer still died with its slowest
+or unluckiest host.  This module ports the fleet-style supervision to
+training, on the substrate the repo already has: the ZeRO flat layouts
+re-flatten bitwise across world sizes (`parallel.ring.pad_to_world` /
+`reflatten_to_world`), the checkpoint layer restores at any world
+(`CheckpointManager.restore_latest_valid(world=W')`), and a new world
+is just a new mesh — the ring/hierarchical transports and the
+`make_sum_gradients_fn` caches are per-mesh closures, so rebuilding the
+step at W' rebuilds them all.
+
+Three pieces, same ladder shape as Transport/PrecisionSupervisor:
+
+* :class:`HeartbeatMonitor` — the per-host step-time detector.  Every
+  host's step time feeds an EMA; a beat slower than ``factor`` x its
+  own EMA is *slow* (and deliberately NOT folded into the EMA — a
+  detector must not learn the anomaly as the new normal); ``patience``
+  consecutive slow beats make the host *hot*.  A missing beat feeds a
+  miss streak; ``kill_patience`` consecutive misses make it *dead*.
+  The monitor never reads a clock — the caller passes measured
+  durations in (`cpd_tpu.obs.timing.now()` pairs in real runs, the
+  plan-derived synthetic table in drills), which is what keeps every
+  detection decision a pure function of its inputs (the v4 host-clock
+  rule) and the drills step-clock-deterministic.
+
+* :class:`ElasticSupervisor` — the recovery ladder coordinator:
+
+      in-step collective retry ──(retries exhausted)──> drain + shrink
+      W -> W'  ──(host healthy again for `probation` beats)──> regrow
+
+  ``on_heartbeats(step, dts)`` classifies every host and decides
+  ``("shrink", hosts)`` / ``("regrow", hosts)`` / None;
+  ``on_link_failure(step, host)`` is the per-attempt retry/escalate
+  decision for a flaky reduce wire into one host.  Pure host state: no
+  RNG, no wall clock, fixed-size per-host tables, capped transition
+  log — the same host-contract discipline the v4 analysis rules pin on
+  the other supervisors.
+
+* :func:`run_elastic` — the guarded loop that can CHANGE WORLD SIZE.
+  The caller provides world-parametrized builders (``build_world``)
+  and a world-aware batch function; on a shrink the loop drains the
+  dead host, rebuilds the step at the new world, and resumes from the
+  last digest-sealed checkpoint restored at W' (the ZeRO momentum
+  re-flattened through `pad_to_world`); on a regrow it seals a fresh
+  checkpoint and rebuilds back up.  Zero steps are lost beyond the
+  checkpoint cadence, and the post-shrink trajectory is BITWISE equal
+  to a fresh run started from the same checkpoint at W' — the same
+  gating contract as every other transport (tools/bench_elastic.py
+  asserts it x2 in the elastic-smoke CI gate).
+
+Shrink policy: the compute world is the largest power of two <= the
+number of alive hosts (``pow2=True``, the default) — power-of-two
+worlds keep every transport layout and batch divisibility assumption
+intact, so killing 1 host of 8 shrinks to W'=4 with 3 healthy hosts
+idling as warm spares.  ``pow2=False`` uses every alive host (the
+checkpoint layer handles non-divisible re-flattens like 8 -> 3
+bitwise; tests pin that edge directly).
+
+Fault kinds (grammar in resilience/inject.py): ``host_kill@s:h[:r]``,
+``straggler@s:h:f``, ``link_flaky@s:h:p``.  The elastic harness
+consumes them directly from the plan (like the ring consumes wire
+kinds and the fleet consumes fleet kinds) and owns their one-shot +
+unfired accounting; `report_unfired(host_armed=...)` covers the
+unarmed direction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Callable, Optional
+
+from .inject import ELASTIC_KINDS, InjectedPreemption
+
+__all__ = ["HeartbeatMonitor", "ElasticSupervisor", "ElasticReport",
+           "run_elastic", "shrink_world", "heartbeat_table",
+           "STRAGGLER_DEFAULT_FACTOR"]
+
+STRAGGLER_DEFAULT_FACTOR = 4.0     # straggler arg2 -1 -> x4 step time
+
+
+def shrink_world(alive: int, pow2: bool = True) -> int:
+    """The compute world for ``alive`` healthy hosts: largest power of
+    two <= alive (default), or alive itself (``pow2=False``)."""
+    if alive < 1:
+        return 0
+    if not pow2:
+        return alive
+    w = 1
+    while w * 2 <= alive:
+        w *= 2
+    return w
+
+
+class HeartbeatMonitor:
+    """Per-host step-time EMA + miss-streak detector (module docstring).
+
+    All per-host state lives in fixed-size lists allocated up front and
+    indexed by host — nothing grows on the step clock (host-unbounded),
+    no thread ever touches it but the caller's (host-race), and no
+    clock is read here (host-clock): durations are passed IN, measured
+    by the caller through `cpd_tpu.obs.timing.now()` or synthesized
+    from the fault plan in drills.
+    """
+
+    def __init__(self, world: int, *, patience: int = 3,
+                 factor: float = 2.0, smoothing: float = 0.25,
+                 warmup: int = 2, kill_patience: int = 1):
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        if patience < 1 or kill_patience < 1:
+            raise ValueError("patience/kill_patience must be >= 1")
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        if not 0.0 < smoothing <= 1.0:
+            raise ValueError(f"smoothing must be in (0, 1], got "
+                             f"{smoothing}")
+        self.world = world
+        self.patience = int(patience)
+        self.factor = float(factor)
+        self.smoothing = float(smoothing)
+        self.warmup = max(int(warmup), 1)
+        self.kill_patience = int(kill_patience)
+        # fixed-size per-host tables, indexed by host id < world
+        self.ema = [0.0] * world
+        self.beats = [0] * world      # healthy beats folded into the EMA
+        self.slow = [0] * world       # consecutive slow beats
+        self.miss = [0] * world       # consecutive missing beats
+
+    def beat(self, host: int, dt: float) -> str:
+        """Feed one host's measured step time; returns "ok" | "slow" |
+        "hot" (slow streak reached ``patience``)."""
+        self.miss[host] = 0
+        if self.beats[host] >= self.warmup and \
+                dt > self.factor * self.ema[host]:
+            # slow: count it, but do NOT fold it into the EMA — the
+            # detector must keep the healthy baseline, or a sustained
+            # straggler drags its own threshold up and escapes
+            self.slow[host] += 1
+            return "hot" if self.slow[host] >= self.patience else "slow"
+        self.slow[host] = 0
+        self.ema[host] = (dt if self.beats[host] == 0 else
+                          (1.0 - self.smoothing) * self.ema[host]
+                          + self.smoothing * dt)
+        self.beats[host] += 1
+        return "ok"
+
+    def absent(self, host: int) -> bool:
+        """Feed one missing heartbeat; True when the miss streak says
+        the host is dead (``kill_patience`` consecutive misses)."""
+        self.miss[host] += 1
+        return self.miss[host] >= self.kill_patience
+
+    def reset(self, host: int) -> None:
+        """Forget one host's history (it was drained, or it rejoined —
+        either way its old baseline is meaningless now)."""
+        self.ema[host] = 0.0
+        self.beats[host] = 0
+        self.slow[host] = 0
+        self.miss[host] = 0
+
+    def state_dict(self) -> dict:
+        return {"ema": list(self.ema), "beats": list(self.beats),
+                "slow": list(self.slow), "miss": list(self.miss)}
+
+    def load_state_dict(self, state: dict) -> "HeartbeatMonitor":
+        for key in ("ema", "beats", "slow", "miss"):
+            vals = state[key]
+            if len(vals) != self.world:
+                raise ValueError(
+                    f"heartbeat state for {len(vals)} hosts cannot load "
+                    f"into a world-{self.world} monitor")
+            getattr(self, key)[:] = vals
+        return self
+
+
+class ElasticSupervisor:
+    """The shrink/regrow coordinator (module docstring).
+
+    ``on_heartbeats(step, dts)`` -> ("shrink", hosts) | ("regrow",
+    hosts) | None; ``on_link_failure(step, host)`` -> "retry" |
+    "shrink"; ``on_step_ok(step)`` closes a healthy step (resets the
+    link-retry streak).  ``world`` is the compute world the loop should
+    run the next step at; ``active_hosts()`` names the hosts carrying
+    shards; ``transitions`` is the deterministic (step, from_world,
+    to_world) log the drills assert on.
+    """
+
+    # transition-log cap: keep the newest entries, drop the oldest
+    TRANSITION_CAP = 4096
+
+    def __init__(self, world: int, *, patience: int = 3,
+                 factor: float = 2.0, smoothing: float = 0.25,
+                 warmup: int = 2, kill_patience: int = 1,
+                 max_retries: int = 1, probation: int = 8,
+                 pow2: bool = True):
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got "
+                             f"{max_retries}")
+        if probation < 1:
+            raise ValueError(f"probation must be >= 1, got {probation}")
+        self.home_world = int(world)
+        self.monitor = HeartbeatMonitor(world, patience=patience,
+                                        factor=factor,
+                                        smoothing=smoothing,
+                                        warmup=warmup,
+                                        kill_patience=kill_patience)
+        self.max_retries = int(max_retries)
+        self.probation = int(probation)
+        self.pow2 = bool(pow2)
+        # fixed-size per-host state, indexed by host id < home_world
+        self.alive = [True] * self.home_world
+        self.rejoin = [0] * self.home_world   # healthy-beat streak of
+        #                                       drained hosts (probation)
+        self.link_retries = 0       # consecutive failed attempts, this step
+        # fixed counter vocabulary — the drills' exact-count assertions
+        self.counters = {
+            "drains": 0, "rejoins": 0, "shrinks": 0, "regrows": 0,
+            "hot_steps": 0, "heartbeat_misses": 0,
+            "link_retries": 0, "link_escalations": 0,
+        }
+        # (step, from_world, to_world); newest TRANSITION_CAP entries
+        self.transitions: list = []
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def world(self) -> int:
+        """The compute world for the CURRENT alive set."""
+        return shrink_world(sum(self.alive), self.pow2)
+
+    @property
+    def degraded(self) -> bool:
+        return self.world < self.home_world
+
+    def active_hosts(self) -> tuple:
+        """The hosts carrying real shards: the first ``world`` alive
+        ones, in host order (drained hosts and warm spares idle)."""
+        out = []
+        w = self.world
+        for h in range(self.home_world):
+            if self.alive[h]:
+                out.append(h)
+                if len(out) == w:
+                    break
+        return tuple(out)
+
+    # -- the state machine ------------------------------------------------
+
+    def _drain(self, host: int) -> None:
+        self.alive[host] = False
+        self.rejoin[host] = 0
+        self.monitor.reset(host)
+        self.counters["drains"] += 1
+
+    def on_heartbeats(self, step: int, dts) -> Optional[tuple]:
+        """Feed one step's per-host heartbeat row (``dts[h]`` = host
+        h's measured step seconds, None = no heartbeat arrived).  At
+        most one decision per call; a shrink takes priority over a
+        regrow (rejoin streaks keep and commit on a later step)."""
+        if len(dts) != self.home_world:
+            raise ValueError(f"heartbeat row has {len(dts)} hosts; the "
+                             f"supervisor watches {self.home_world}")
+        old_active = self.active_hosts()
+        drained, rejoined = [], []
+        for h in range(self.home_world):
+            dt = dts[h]
+            if self.alive[h]:
+                if dt is None:
+                    self.counters["heartbeat_misses"] += 1
+                    if self.monitor.absent(h):
+                        self._drain(h)
+                        drained.append(h)
+                else:
+                    verdict = self.monitor.beat(h, dt)
+                    if verdict in ("slow", "hot"):
+                        self.counters["hot_steps"] += 1
+                    if verdict == "hot":
+                        self._drain(h)
+                        drained.append(h)
+            else:
+                # a drained host earns its shards back with `probation`
+                # consecutive healthy beats; a miss or a slow beat
+                # resets the streak (monitor history was reset at the
+                # drain, so "slow" here is vs the post-drain baseline)
+                if dt is None or self.monitor.beat(h, dt) != "ok":
+                    self.rejoin[h] = 0
+                else:
+                    self.rejoin[h] += 1
+                    if self.rejoin[h] >= self.probation:
+                        rejoined.append(h)
+        if drained:
+            self._record(step, old_active)
+            self.counters["shrinks"] += 1
+            return ("shrink", tuple(drained))
+        if rejoined:
+            for h in rejoined:
+                self.alive[h] = True
+                self.rejoin[h] = 0
+                self.monitor.reset(h)
+                self.counters["rejoins"] += 1
+            self._record(step, old_active)
+            self.counters["regrows"] += 1
+            return ("regrow", tuple(rejoined))
+        return None
+
+    def on_link_failure(self, step: int, host: int) -> str:
+        """A collective attempt into ``host`` failed (a verify/retry
+        escalation from the PR 4 path): "retry" while the in-step
+        budget lasts, then drain the host and "shrink"."""
+        if self.link_retries < self.max_retries:
+            self.link_retries += 1
+            self.counters["link_retries"] += 1
+            return "retry"
+        self.link_retries = 0
+        old_active = self.active_hosts()
+        if self.alive[host]:
+            self._drain(host)
+        self.counters["link_escalations"] += 1
+        self._record(step, old_active)
+        self.counters["shrinks"] += 1
+        return "shrink"
+
+    def on_step_ok(self, step: int) -> None:
+        """A step completed cleanly: the link-retry streak resets (the
+        retry budget is per-step, like the transport ladder's)."""
+        self.link_retries = 0
+
+    def _record(self, step: int, old_active: tuple) -> None:
+        self.transitions.append(
+            (step, len(old_active), self.world))
+        if len(self.transitions) > self.TRANSITION_CAP:
+            del self.transitions[0]
+
+    # -- checkpoint persistence -------------------------------------------
+
+    def state_dict(self) -> dict:
+        """JSON-able snapshot for the checkpoint metadata sidecar.  A
+        PROCESS RESTART loads it to resume with the same alive set and
+        detector history; the in-run shrink path deliberately keeps the
+        live supervisor instead (loading the pre-shrink sidecar would
+        resurrect the host that just died)."""
+        return {
+            "home_world": self.home_world,
+            "alive": [bool(a) for a in self.alive],
+            "rejoin": list(self.rejoin),
+            "counters": dict(self.counters),
+            "monitor": self.monitor.state_dict(),
+            "transitions": [list(t) for t in self.transitions],
+        }
+
+    def load_state_dict(self, state: dict) -> "ElasticSupervisor":
+        if int(state["home_world"]) != self.home_world:
+            raise ValueError(
+                f"checkpointed elastic state is for home world "
+                f"{state['home_world']}, not {self.home_world}; restart "
+                f"with the same fleet shape or a fresh run directory")
+        self.alive[:] = [bool(a) for a in state["alive"]]
+        self.rejoin[:] = [int(r) for r in state["rejoin"]]
+        # rebuild over the FIXED counter vocabulary — unknown saved
+        # keys are dropped, missing ones keep their current value
+        saved = state.get("counters", {})
+        self.counters = {key: int(saved.get(key, val))
+                         for key, val in self.counters.items()}
+        self.monitor.load_state_dict(state["monitor"])
+        self.transitions = [tuple(t) for t in
+                            state.get("transitions", [])]
+        return self
+
+
+# ---------------------------------------------------------------------------
+# plan-derived synthetic signals (the drills' deterministic clock)
+# ---------------------------------------------------------------------------
+
+def heartbeat_table(plan, world: int, n_steps: int,
+                    base_dt: float = 1.0) -> list:
+    """The drills' synthetic heartbeat rows: ``table[step][host]`` is
+    host's step time at ``step`` (None = no heartbeat).  A pure
+    function of the plan — no wall clock anywhere — which is what makes
+    an elastic drill replay event-for-event:
+
+    * every host beats at ``base_dt``;
+    * ``straggler@s:h:f`` inflates host h's beat at step s by f
+      (arg2 < 0 -> `STRAGGLER_DEFAULT_FACTOR`);
+    * ``host_kill@s:h[:r]`` blanks host h's beats from step s on,
+      returning after r steps when r (arg2) >= 0.
+
+    Real runs skip this entirely and feed measured
+    `cpd_tpu.obs.timing.now()` durations to `run_elastic` instead.
+    """
+    table = [[base_dt] * world for _ in range(n_steps)]
+    for f in plan.elastic_faults():
+        host = int(f.arg) if f.arg >= 0 else 0
+        if host >= world:
+            continue      # aimed past the fleet: held, surfaced unfired
+        if f.kind == "straggler":
+            if f.step < n_steps:
+                factor = (f.arg2 if f.arg2 > 0
+                          else STRAGGLER_DEFAULT_FACTOR)
+                table[f.step][host] = base_dt * factor
+        elif f.kind == "host_kill":
+            until = (f.step + int(f.arg2) if f.arg2 >= 0 else n_steps)
+            for s in range(f.step, min(until, n_steps)):
+                table[s][host] = None
+    return table
+
+
+def _link_plan(plan) -> dict:
+    """step -> (host, attempts) for the link_flaky specs (last wins)."""
+    out = {}
+    for f in plan.elastic_faults():
+        if f.kind == "link_flaky":
+            out[f.step] = (int(f.arg) if f.arg >= 0 else 0,
+                           int(f.arg2) if f.arg2 >= 0 else 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the elastic guarded loop
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ElasticReport:
+    completed: bool
+    final_step: int
+    aborted: Optional[str]   # None | watchdog | preempted | elastic
+    world: int               # the compute world the run ended at
+    home_world: int
+    counters: dict           # ResilienceMeter.as_dict()
+    events: list             # deterministic (what, step, ...) log
+
+
+def run_elastic(build_world: Callable, state, next_batch: Callable,
+                n_steps: int, *, supervisor: ElasticSupervisor,
+                manager, plan=None, injector=None, watchdog=None,
+                meter=None, precision=None, ckpt_every: int = 2,
+                rank: int = 0, heartbeats: Optional[Callable] = None,
+                base_dt: float = 1.0,
+                on_step: Optional[Callable] = None,
+                max_recoveries: int = 8):
+    """Drive a world-parametrized step to ``n_steps`` under the elastic
+    recovery ladder (module docstring).
+
+    build_world: ``(world, hosts) -> dict`` with keys ``"step"`` (the
+        jitted ``(state, *batch) -> (state, metrics)`` for that world,
+        built ``donate=False``), ``"template"`` (the restore template —
+        for ZeRO states, built with the NEW world's updater so the
+        momentum re-flatten has its target length), and optionally
+        ``"relayout"`` (``state -> state`` onto the new mesh — elastic
+        restores materialize unsharded).  ``hosts`` is the active host
+        tuple; called once per distinct membership (cached here).
+    next_batch: ``(step, world) -> tuple`` — a PURE function of both,
+        so the post-shrink replay and a fresh run at W' see identical
+        data (the bitwise contract's data half).
+    heartbeats: ``step -> row`` of per-host step times (None = missing).
+        Defaults to the plan-derived `heartbeat_table` (the drills);
+        real runs pass measured `obs.timing` durations.
+    ckpt_every: the save cadence (> 0 — elastic recovery IS a restore
+        from the last sealed checkpoint, so a cadence of 0 would turn
+        the first fault into an abort).
+    max_recoveries: hard cap on shrink/regrow rebuilds — a plan that
+        faults faster than checkpoints seal would otherwise livelock.
+
+    Returns ``(state, ElasticReport)``.  The supervisor's state rides
+    every checkpoint's metadata sidecar (key ``"elastic"``) next to the
+    precision ladder's, so a PROCESS restart can resume the fleet view;
+    the in-run shrink keeps the live supervisor (see
+    `ElasticSupervisor.state_dict`).
+    """
+    from ..train.metrics import ResilienceMeter
+    from .inject import report_unfired
+    if ckpt_every < 1:
+        raise ValueError("run_elastic needs ckpt_every >= 1: elastic "
+                         "recovery resumes from the last sealed "
+                         "checkpoint")
+    if manager is None:
+        raise ValueError("run_elastic needs a CheckpointManager — the "
+                         "shrink path restores through it")
+    meter = meter if meter is not None else ResilienceMeter()
+    events: list = []
+    it = int(state.step)
+
+    # plan-driven signals (all pure functions of the plan)
+    the_plan = plan if plan is not None else getattr(injector, "plan",
+                                                     None)
+    if the_plan is not None:
+        pending = {}      # step -> [elastic specs]; popped on first visit
+        for f in the_plan.elastic_faults():
+            pending.setdefault(f.step, []).append(f)
+        links = _link_plan(the_plan)
+        if heartbeats is None:
+            table = heartbeat_table(the_plan, supervisor.home_world,
+                                    n_steps, base_dt)
+            heartbeats = lambda s: table[s]          # noqa: E731
+    else:
+        pending, links = {}, {}
+    if heartbeats is None:
+        raise ValueError("run_elastic needs heartbeats (measured "
+                         "per-host step times) or a plan to derive the "
+                         "drill table from")
+    fired: dict = {"host_kill": 0, "straggler": 0, "link_flaky": 0}
+
+    bundles: dict = {}       # active-host tuple -> build_world output
+
+    def bundle():
+        hosts = supervisor.active_hosts()
+        if hosts not in bundles:
+            if len(bundles) >= 8:
+                # a flapping fleet must not accumulate compiled steps
+                # forever; evict the oldest membership (re-entering it
+                # re-traces, which is the cheap direction of the trade)
+                del bundles[next(iter(bundles))]
+            bundles[hosts] = build_world(len(hosts), hosts)
+        return bundles[hosts]
+
+    def save(step, tag):
+        meta = {"elastic": supervisor.state_dict()}
+        if precision is not None:
+            meta["precision"] = precision.state_dict()
+        manager.save(step, state, force=True, metadata=meta)
+        manager.wait()
+        events.append((tag, step))
+        if injector is not None and injector.corrupt_checkpoint(
+                step, manager.directory):
+            events.append(("ckpt_corrupted", step))
+
+    recoveries = 0
+
+    def recover(step, tag):
+        """Rebuild at the supervisor's CURRENT world and resume from
+        the newest sealed checkpoint restored at it.  Returns the new
+        (state, it) or None when recovery is impossible."""
+        nonlocal recoveries
+        recoveries += 1
+        if recoveries > max_recoveries:
+            return None
+        b = bundle()
+        w = supervisor.world
+        res = manager.restore_latest_valid(b["template"], rank=rank,
+                                           world=w)
+        if res is None:
+            return None
+        for bad in res.skipped:
+            meter.bump("ckpts_invalid")
+            events.append(("ckpt_invalid", bad))
+        if res.verified is None:
+            meter.bump("ckpts_unverified")
+            events.append(("ckpt_unverified", res.step))
+        if precision is not None and (res.metadata or {}
+                                      ).get("precision"):
+            # the format ladder resumes where the checkpoint left it
+            # (mid-escalation included) — the elastic block is NOT
+            # loaded here: the live supervisor knows the host just
+            # died; the sidecar's view predates the death
+            precision.load_state_dict(res.metadata["precision"])
+            events.append(("precision_restored", res.step,
+                           precision.name))
+        new_state = res.state
+        if b.get("relayout") is not None:
+            new_state = b["relayout"](new_state)
+        meter.bump("restores")
+        events.append((tag, step, supervisor.world,
+                       supervisor.active_hosts()))
+        return new_state, int(res.step)
+
+    def finish(aborted):
+        # unfired accounting, both directions: the harness owns the
+        # elastic kinds (anything still pending never manifested); the
+        # injector covers every other family (host_armed=True keeps it
+        # from double-flagging ours)
+        leftover = sorted(f for specs in pending.values() for f in specs)
+        if leftover:
+            meter.bump("faults_unfired", len(leftover))
+            if rank == 0:
+                print(f"=> elastic plan: {len(leftover)} spec(s) never "
+                      f"fired (scheduled past the end of the run): "
+                      f"{leftover}", file=sys.stderr)
+        report_unfired(injector, n_steps=n_steps, meter=meter,
+                       rank=rank, host_armed=True)
+        return state, ElasticReport(
+            completed=aborted is None and it >= n_steps,
+            final_step=it, aborted=aborted, world=supervisor.world,
+            home_world=supervisor.home_world,
+            counters=meter.as_dict(), events=events)
+
+    while it < n_steps:
+        # --- elastic spec consumption (one-shot accounting; the
+        # heartbeat table carries the actual effect, so a post-shrink
+        # replay of this step sees a CONSISTENT fleet view without
+        # double-counting the fault) -----------------------------------
+        due = pending.pop(it, ())
+        for f in due:
+            fired[f.kind] += 1
+            events.append((f.kind, it, int(f.arg) if f.arg >= 0 else 0))
+
+        # --- detection: one heartbeat row per step --------------------
+        decision = supervisor.on_heartbeats(it, heartbeats(it))
+        if decision is not None:
+            what, hosts = decision
+            if what == "shrink":
+                for _ in hosts:
+                    meter.bump("elastic_drains")
+                meter.bump("elastic_shrinks")
+                events.append(("elastic_shrink", it, hosts,
+                               supervisor.world))
+                got = recover(it, "elastic_resume")
+                if got is None:
+                    return finish("elastic")
+                state, it = got
+                continue
+            # regrow: the current state is live and healthy — seal it,
+            # then rebuild UP and restore the very checkpoint we just
+            # wrote (the re-flatten in the growing direction); zero
+            # steps lost by construction
+            meter.bump("elastic_regrows")
+            events.append(("elastic_regrow", it, hosts,
+                           supervisor.world))
+            save(it, "ckpt_pre_regrow")
+            got = recover(it, "elastic_resume")
+            if got is None:
+                return finish("elastic")
+            state, it = got
+            continue
+
+        # --- link-flaky: the in-step collective retry ladder ----------
+        lf = None
+        for f in due:
+            if f.kind == "link_flaky":
+                lf = (int(f.arg) if f.arg >= 0 else 0,
+                      int(f.arg2) if f.arg2 >= 0 else 1)
+        if lf is not None:
+            host, attempts = lf
+            escalated = False
+            for _ in range(attempts):
+                act = supervisor.on_link_failure(it, host)
+                if act == "shrink":
+                    escalated = True
+                    break
+                meter.bump("elastic_link_retries")
+                events.append(("link_retry", it, host))
+            if escalated:
+                meter.bump("elastic_link_escalations")
+                meter.bump("elastic_drains")
+                meter.bump("elastic_shrinks")
+                events.append(("elastic_shrink", it, (host,),
+                               supervisor.world))
+                got = recover(it, "elastic_resume")
+                if got is None:
+                    return finish("elastic")
+                state, it = got
+                continue
+
+        try:
+            if injector is not None:
+                injector.maybe_preempt(it)
+            batch = next_batch(it, supervisor.world)
+            if watchdog is not None:
+                # arm() also clears any stale trip from a PREVIOUS
+                # step — a recovery above must not read as a hang here
+                watchdog.arm(it, world=supervisor.world,
+                             counters=meter.as_dict())
+            if injector is not None:
+                injector.maybe_stall(it)
+            new_state, metrics = bundle()["step"](state, *batch)
+            loss = float(metrics["loss"])          # device sync
+            if watchdog is not None:
+                watchdog.disarm()
+                if watchdog.tripped:
+                    raise KeyboardInterrupt
+        except KeyboardInterrupt:
+            if watchdog is not None and watchdog.tripped:
+                watchdog.disarm()
+                meter.bump("watchdog_trips")
+                events.append(("watchdog", it))
+                save(it, "ckpt_on_watchdog")
+                return finish("watchdog")
+            raise
+        except InjectedPreemption:
+            meter.bump("preemptions")
+            events.append(("preempted", it))
+            save(it, "ckpt_on_preempt")
+            return finish("preempted")
+
+        supervisor.on_step_ok(it)
+        meter.observe_metrics(metrics)
+        # mirror the supervisor's own tallies into the run meter (the
+        # supervisor holds per-decision truth; the meter is the report)
+        meter.counts["elastic_hot_steps"] = \
+            supervisor.counters["hot_steps"]
+        meter.counts["elastic_heartbeat_misses"] = \
+            supervisor.counters["heartbeat_misses"]
+        if injector is not None:
+            loss = injector.fault_loss(it, loss)
+        if on_step is not None:
+            on_step(it, {**metrics, "loss": loss})
+        state = new_state
+        it += 1
+        if it % ckpt_every == 0 and it < n_steps:
+            save(it, "ckpt")
+
+    save(it, "ckpt_final")
+    return finish(None)

@@ -23,13 +23,15 @@ A :class:`FaultPlan` is an immutable, seed-reproducible schedule of
 Grammar for ``--fault-plan`` (also accepts a path to a JSON file written
 by :meth:`FaultPlan.to_json`):
 
-    kind@step[:arg][;kind@step[:arg]...]
+    kind@step[:arg[:arg2]][;kind@step[:arg[:arg2]]...]
 
 e.g. ``grad_nan@3;stall@5:1.5;ckpt_truncate@6;loss_spike@8:1e6``.
 ``arg`` means: shard index for ``grad_*`` (-1 = every shard, the
 default), RANK for ``wire_*`` (-1 = rank 0), the log2 scale factor for
 ``sat_pressure`` (-1 = 24, i.e. ×2^24), seconds for ``stall``,
 multiplier for ``loss_spike`` / ``batch_scale``; ignored elsewhere.
+``arg2`` only exists for the two-argument elastic kinds below (-1 =
+kind-specific default).
 
 A third executor consumes the ``wire_*`` kinds (``wire_flip@s:k``,
 ``wire_stale@s:k``, ``wire_drop@s:k``): the ring transport itself
@@ -77,6 +79,25 @@ ISSUE 10 — all on the serving engine's step clock):
   `ServeEngine.take_due_bursts`), so the burst is keyed into the plan
   and replays deterministically like every other fault.
 
+A sixth executor consumes the elastic-training kinds (``ELASTIC_KINDS``,
+ISSUE 19 — whole-host faults on the optimizer-update clock, consumed by
+`cpd_tpu.resilience.elastic.run_elastic` / the trainers' ``--elastic``
+path, which do their own one-shot + unfired accounting):
+
+* ``host_kill@s:h[:r]`` — host ``h``'s heartbeat disappears at step
+  ``s``; with ``arg2`` = ``r`` >= 0 it reappears ``r`` steps later (the
+  regrow drill), -1 (default) = never.  The `ElasticSupervisor` drains
+  the dead host and shrinks the mesh W -> W' deterministically.
+* ``straggler@s:h:f`` — host ``h``'s step time at step ``s`` reads as
+  inflated by factor ``f`` (arg2, -1 -> 4.0).  One spec = one slow
+  heartbeat; schedule ``patience`` consecutive steps to force the
+  detector hot (the ``sat_pressure`` idiom).
+* ``link_flaky@s:h:p`` — the reduce wire into host ``h`` fails ``p``
+  (arg2, -1 -> 1) consecutive attempts at step ``s``, plan-keyed
+  deterministic; absorbed by the in-step collective retry when ``p``
+  <= the supervisor's ``max_retries``, escalated to a drain+shrink
+  otherwise.
+
 ``step`` convention: the 0-based optimizer-UPDATE index — one clock for
 both executors, so ``grad_nan@3`` and ``stall@3`` hit the same physical
 step in every entry point (run_guarded and both trainer CLIs).  The
@@ -100,7 +121,8 @@ import numpy as np
 __all__ = ["FaultSpec", "FaultPlan", "Injector", "InjectedPreemption",
            "with_fault_injection", "report_unfired", "GRAD_KINDS",
            "HOST_KINDS", "WIRE_KINDS", "SAT_KINDS", "KV_KINDS",
-           "SERVE_KINDS", "FLEET_KINDS", "SAT_PRESSURE_DEFAULT_EXP"]
+           "SERVE_KINDS", "FLEET_KINDS", "ELASTIC_KINDS",
+           "SAT_PRESSURE_DEFAULT_EXP"]
 
 # jit-level kinds -> corruption opcode in the compiled fault table
 GRAD_KINDS = {"grad_nan": 1, "grad_inf": 2, "grad_blowup": 3}
@@ -149,6 +171,19 @@ SERVE_KINDS = frozenset({"kv_storm", "slot_stall", "req_burst"})
 # or single-engine serving plan these kinds can never fire and
 # `report_unfired` flags them unless ``fleet_armed=True``.
 FLEET_KINDS = frozenset({"engine_kill", "kill_wave"})
+# elastic-training kinds (ISSUE 19), on the optimizer-update clock like
+# the grad/wire kinds — but consumed by the ELASTIC harness
+# (resilience/elastic.py run_elastic, or a trainer's ``--elastic``
+# path), never by the plain Injector hooks: ``host_kill@s:h[:r]``
+# (host h's heartbeat disappears at step s, reappearing r steps later
+# when arg2 >= 0), ``straggler@s:h:f`` (host h's step time at step s
+# inflated by f — one slow heartbeat per spec), ``link_flaky@s:h:p``
+# (the reduce wire into host h fails p consecutive attempts at step s,
+# absorbed by the in-step retry when p <= max_retries).  The harness
+# does its own one-shot + unfired accounting; `report_unfired` flags
+# these kinds in any run without an elastic consumer
+# (``host_armed=False``, the default).
+ELASTIC_KINDS = frozenset({"host_kill", "straggler", "link_flaky"})
 # host-level kinds, executed by the Injector around the step call
 HOST_KINDS = frozenset({
     "batch_nan",       # poison one element of the first float batch leaf
@@ -162,7 +197,8 @@ HOST_KINDS = frozenset({
     "loss_spike",      # multiply the observed loss metric by `arg`
 })
 _ALL_KINDS = (frozenset(GRAD_KINDS) | HOST_KINDS | frozenset(WIRE_KINDS)
-              | SAT_KINDS | KV_KINDS | SERVE_KINDS | FLEET_KINDS)
+              | SAT_KINDS | KV_KINDS | SERVE_KINDS | FLEET_KINDS
+              | ELASTIC_KINDS)
 
 
 class InjectedPreemption(BaseException):
@@ -173,10 +209,14 @@ class InjectedPreemption(BaseException):
 
 @dataclasses.dataclass(frozen=True, order=True)
 class FaultSpec:
-    """One scheduled fault.  ``arg`` is kind-dependent (module docstring)."""
+    """One scheduled fault.  ``arg`` is kind-dependent (module
+    docstring); ``arg2`` only carries the second argument of the
+    two-argument elastic kinds (straggler factor, link attempt count,
+    host-rejoin delay) and stays -1.0 everywhere else."""
     step: int
     kind: str
     arg: float = -1.0
+    arg2: float = -1.0
 
     def __post_init__(self):
         if self.kind not in _ALL_KINDS:
@@ -204,9 +244,9 @@ class FaultPlan:
 
     @classmethod
     def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
-        """Parse the compact ``kind@step[:arg]`` grammar, or load a JSON
-        file if ``text`` names one (the ``--fault-plan`` flag accepts
-        both)."""
+        """Parse the compact ``kind@step[:arg[:arg2]]`` grammar, or load
+        a JSON file if ``text`` names one (the ``--fault-plan`` flag
+        accepts both)."""
         text = text.strip()
         if not text:
             return cls((), seed)
@@ -220,16 +260,20 @@ class FaultPlan:
                 continue
             try:
                 kind, rest = part.split("@", 1)
-                if ":" in rest:
-                    step_s, arg_s = rest.split(":", 1)
-                    arg = float(arg_s)
-                else:
-                    step_s, arg = rest, -1.0
-                faults.append(FaultSpec(int(step_s), kind.strip(), arg))
+                fields = rest.split(":", 2)
+                if len(fields) > 2 and kind.strip() not in ELASTIC_KINDS:
+                    raise ValueError(
+                        f"arg2 only exists for the elastic kinds "
+                        f"{sorted(ELASTIC_KINDS)}")
+                step_s = fields[0]
+                arg = float(fields[1]) if len(fields) > 1 else -1.0
+                arg2 = float(fields[2]) if len(fields) > 2 else -1.0
+                faults.append(FaultSpec(int(step_s), kind.strip(), arg,
+                                        arg2))
             except ValueError as e:
                 raise ValueError(
-                    f"bad fault spec {part!r} (want kind@step[:arg]): {e}"
-                ) from e
+                    f"bad fault spec {part!r} (want "
+                    f"kind@step[:arg[:arg2]]): {e}") from e
         return cls(tuple(faults), seed)
 
     @classmethod
@@ -254,7 +298,8 @@ class FaultPlan:
     def from_json(cls, blob: str) -> "FaultPlan":
         doc = json.loads(blob)
         return cls(tuple(FaultSpec(f["step"], f["kind"],
-                                   float(f.get("arg", -1.0)))
+                                   float(f.get("arg", -1.0)),
+                                   float(f.get("arg2", -1.0)))
                          for f in doc["faults"]),
                    int(doc.get("seed", 0)))
 
@@ -298,6 +343,16 @@ class FaultPlan:
         on the fleet step clock — consumed by
         `cpd_tpu.fleet.Fleet.step`."""
         return tuple(f for f in self.faults if f.kind in FLEET_KINDS)
+
+    def elastic_faults(self) -> tuple:
+        """The elastic-training specs (`ELASTIC_KINDS`):
+        ``host_kill@s:h[:r]`` / ``straggler@s:h:f`` /
+        ``link_flaky@s:h:p``, all on the optimizer-update clock —
+        consumed by the elastic harness
+        (`cpd_tpu.resilience.elastic.run_elastic` or a trainer's
+        ``--elastic`` path), which owns their one-shot and unfired
+        accounting."""
+        return tuple(f for f in self.faults if f.kind in ELASTIC_KINDS)
 
     def host_faults(self) -> dict:
         """step -> [FaultSpec] for the host-level kinds."""
@@ -604,7 +659,8 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
                    sat_armed: bool = True,
                    kv_armed: bool = False,
                    serve_armed: bool = False,
-                   fleet_armed: bool = False) -> list:
+                   fleet_armed: bool = False,
+                   host_armed: bool = False) -> list:
     """The ONE end-of-run check every loop calls: which planned faults
     never fired?  A chaos run that silently skipped a fault proves
     nothing — the usual causes are a plan step beyond the run's
@@ -633,7 +689,12 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     `cpd_tpu.fleet.Fleet` consumes them (its own `Fleet.report_unfired`
     owns armed accounting — including kills aimed at engine indices the
     autoscaled fleet shape never contained), so in any other plan they
-    are flagged.
+    are flagged.  ``host_armed`` covers `ELASTIC_KINDS`
+    (``host_kill``/``straggler``/``link_flaky``, ISSUE 19): only an
+    elastic consumer (`resilience.elastic.run_elastic`, or a trainer
+    run with ``--elastic``) executes them and owns their one-shot +
+    unfired accounting, so in a non-elastic run — the default — they
+    can never fire and are flagged here.
     Bumps the meter's ``faults_unfired`` counter and warns on rank 0;
     returns the sorted leftover list (empty = every planned fault
     fired)."""
@@ -643,15 +704,18 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     for f in (injector.plan.grad_faults() + injector.plan.wire_faults()
               + injector.plan.sat_faults() + injector.plan.kv_faults()
               + injector.plan.serve_faults()
-              + injector.plan.fleet_faults()):
+              + injector.plan.fleet_faults()
+              + injector.plan.elastic_faults()):
         if f.kind in KV_KINDS or f.kind in SERVE_KINDS \
-                or f.kind in FLEET_KINDS:
-            # engine/fleet-clock kinds: the training ``n_steps`` budget
-            # says nothing about them.  Unarmed -> can never fire,
-            # flagged; armed -> the consumer's own accounting owns them.
+                or f.kind in FLEET_KINDS or f.kind in ELASTIC_KINDS:
+            # engine/fleet/elastic-consumer kinds: the training
+            # ``n_steps`` budget says nothing about them.  Unarmed ->
+            # can never fire, flagged; armed -> the consumer's own
+            # accounting owns them.
             armed = (kv_armed if f.kind in KV_KINDS
                      else serve_armed if f.kind in SERVE_KINDS
-                     else fleet_armed)
+                     else fleet_armed if f.kind in FLEET_KINDS
+                     else host_armed)
             if not armed:
                 leftover.append(f)
             continue

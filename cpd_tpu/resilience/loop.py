@@ -354,6 +354,14 @@ def run_guarded(step_fn: Callable, state, next_batch: Callable,
                 flight.dump("rollback")
             if backoff_secs > 0:
                 time.sleep(backoff_secs * (2 ** (rollbacks - 1)))
+            if watchdog is not None:
+                # re-arm on rollback completion (ISSUE 19 bugfix): the
+                # restore + backoff ran on the tripped-out step's old
+                # clock; the replay step gets a FRESH deadline and a
+                # fresh verdict (arm clears a stale `tripped`), so a
+                # fire that landed mid-rollback cannot abort the
+                # slow-but-healthy recovery step at its boundary check
+                watchdog.arm(it, counters=meter.as_dict())
             continue
 
         state = new_state

@@ -72,9 +72,18 @@ class StepWatchdog:
     def arm(self, step: int, **context) -> None:
         """Start (or restart) the countdown for ``step``.  ``context`` is
         whatever the loop knows (last metrics, phase) — it goes verbatim
-        into the diagnostic dump."""
+        into the diagnostic dump.
+
+        Arming CLEARS ``tripped``: a fresh deadline is a fresh verdict.
+        Without this a loop that recovers and continues (a guarded
+        rollback, an elastic shrink) would see the PREVIOUS step's stale
+        trip at its next boundary check and abort a perfectly healthy
+        recovery step (ISSUE 19 bugfix).  A trip fired DURING a step
+        stays visible at that step's boundary — arm precedes the step —
+        and the cumulative ``trips`` total is never reset."""
         with self._lock:
             self._cancel_locked()
+            self.tripped = False
             self._context = {"step": step, **context}
             self._timer = threading.Timer(self.timeout, self._fire)
             self._timer.daemon = True
@@ -96,13 +105,14 @@ class StepWatchdog:
             self._exit_timer = None
 
     def _fire(self) -> None:
-        self.tripped = True
-        self.trips += 1
         with self._lock:
-            # snapshot under the same lock arm() holds while swapping
-            # _context in — this timer thread races the main loop
-            # re-arming for the next step (host-race, ISSUE 16); both
-            # uses below work on the snapshot
+            # trip verdict AND context snapshot under the same lock
+            # arm() holds while clearing `tripped` / swapping _context
+            # in — this timer thread races the main loop re-arming for
+            # the next step (host-race, ISSUE 16); everything below
+            # works on the snapshot
+            self.tripped = True
+            self.trips += 1
             context = dict(self._context)
         ctx = dict(context)
         print(f"=> watchdog: step {ctx.pop('step', '?')} exceeded "

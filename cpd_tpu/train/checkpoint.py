@@ -410,7 +410,7 @@ class CheckpointManager:
         restore pays the single-device materialize + relayout cost the
         sharded path avoids; every trainer re-lays the state out on its
         mesh after restore anyway (their `relayout`/`mesh_layout`)."""
-        from ..parallel.ring import pad_to_world
+        from ..parallel.ring import reflatten_to_world
         from ..parallel.zero import Zero1State
 
         def is_z(n):
@@ -428,7 +428,8 @@ class CheckpointManager:
         def refl(saved, want):
             if not is_z(saved):
                 return saved
-            mom = pad_to_world(jnp.asarray(saved.momentum)[:total], world)
+            mom = reflatten_to_world(jnp.asarray(saved.momentum), total,
+                                     world)
             want_len = int(np.shape(want.momentum)[0])
             if int(mom.shape[0]) != want_len:
                 raise ValueError(

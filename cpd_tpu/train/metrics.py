@@ -56,7 +56,14 @@ class ResilienceMeter:
             # sat+NaN rate over the supervisor's threshold) and ladder
             # moves, decided host-side from the prec_wire_* metrics
             "sat_hot_steps", "precision_escalations",
-            "precision_deescalations")
+            "precision_deescalations",
+            # elastic-training accounting (ISSUE 19): detection and
+            # shrink/regrow moves are host decisions of the
+            # ElasticSupervisor (resilience/elastic.py); the loop bumps
+            # these as it executes them
+            "elastic_shrinks", "elastic_regrows", "elastic_drains",
+            "elastic_hot_steps", "elastic_heartbeat_misses",
+            "elastic_link_retries", "elastic_link_escalations")
     FIELDS = tuple(MIRRORED.values()) + HOST
 
     def __init__(self):
@@ -98,7 +105,14 @@ class ResilienceMeter:
                  "faults_unfired": "unfired",
                  "sat_hot_steps": "hot",
                  "precision_escalations": "esc",
-                 "precision_deescalations": "deesc"}
+                 "precision_deescalations": "deesc",
+                 "elastic_shrinks": "shrink",
+                 "elastic_regrows": "regrow",
+                 "elastic_drains": "drain",
+                 "elastic_hot_steps": "ehot",
+                 "elastic_heartbeat_misses": "miss",
+                 "elastic_link_retries": "lretry",
+                 "elastic_link_escalations": "lesc"}
         parts = [f"{short[f]} {v}" for f, v in self.counts.items() if v]
         return (" " + " ".join(parts)) if parts else ""
 

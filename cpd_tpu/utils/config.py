@@ -172,7 +172,7 @@ def build_obs(args: argparse.Namespace, *, run: str,
 
 def finish_obs(obs: Dict[str, Any], *, meter=None, last=None,
                step_no=None, supervisor=None, precision=None,
-               rank: int = 0, **extra):
+               elastic=None, rank: int = 0, **extra):
     """The ONE trainer obs epilogue (shared by the lm and resnet18
     CLIs): absorb the run counters, the final step's telemetry
     families and the supervisors' ladder state into the registry, then
@@ -192,6 +192,8 @@ def finish_obs(obs: Dict[str, Any], *, meter=None, last=None,
             "transitions": supervisor.transitions})
     if precision is not None:
         reg.absorb_supervisor("precision", precision.state_dict())
+    if elastic is not None:
+        reg.absorb_elastic(elastic)
     out = obs["finish"](**extra)
     if rank == 0:
         import sys
@@ -282,10 +284,25 @@ def add_resilience_flags(parser: argparse.ArgumentParser) -> None:
                         "(prec_wire_sat/underflow/nan + aps_bad "
                         "metrics) WITHOUT the ladder — observability "
                         "only (implied by --precision-ladder)")
+    g.add_argument("--elastic", action="store_true",
+                   help="elastic training (resilience.elastic): "
+                        "heartbeat/straggler detection per host, "
+                        "in-step link retries, deterministic mesh "
+                        "shrink to the largest power-of-two world of "
+                        "alive hosts through the digest-sealed "
+                        "checkpoints, probationary regrow on rejoin "
+                        "(arms host_kill/straggler/link_flaky plan "
+                        "kinds)")
+    g.add_argument("--heartbeat-patience", default=3, type=int,
+                   help="elastic: consecutive slow heartbeats before a "
+                        "host is hot and gets drained")
+    g.add_argument("--straggler-factor", default=2.0, type=float,
+                   help="elastic: a heartbeat slower than this multiple "
+                        "of the host's own step-time EMA is slow")
 
 
 def build_resilience(args: argparse.Namespace, *, n_steps: int,
-                     rank: int = 0) -> Dict[str, Any]:
+                     rank: int = 0, world: int = 0) -> Dict[str, Any]:
     """Materialize the resilience stack from parsed flags.
 
     Returns a dict with ``injector`` / ``watchdog`` / ``sentinel`` /
@@ -293,6 +310,11 @@ def build_resilience(args: argparse.Namespace, *, n_steps: int,
     layers ``with_fault_injection`` (when the plan has gradient faults)
     and ``with_grad_guard`` (when requested or implied) around an
     optimizer — outermost-first, the order guard.py documents.
+
+    ``world``: the data-parallel host count — needed only when
+    ``--elastic`` is on (the ElasticSupervisor watches that many
+    heartbeats); trainers that don't pass it get ``"elastic": None``
+    and a warning if the flag was set.
     """
     from cpd_tpu.resilience import (DivergenceSentinel, FaultPlan,
                                     Injector, StepWatchdog,
@@ -380,6 +402,27 @@ def build_resilience(args: argparse.Namespace, *, n_steps: int,
     sat = plan.sat_faults() if plan is not None else ()
     quant_stats = bool(precision is not None
                        or getattr(args, "quant_telemetry", False))
+    elastic = None
+    wants_elastic = bool(getattr(args, "elastic", False))
+    host_faults = plan.elastic_faults() if plan is not None else ()
+    if host_faults and not wants_elastic:
+        import sys as _sys
+        print("=> WARNING: fault plan schedules host-level faults "
+              "(host_kill/straggler/link_flaky) but --elastic is off — "
+              "they will be flagged unfired, not survived (pass "
+              "--elastic to arm the recovery ladder)", file=_sys.stderr)
+    if wants_elastic:
+        if world >= 1:
+            from cpd_tpu.resilience.elastic import ElasticSupervisor
+            elastic = ElasticSupervisor(
+                world,
+                patience=int(getattr(args, "heartbeat_patience", 3)),
+                factor=float(getattr(args, "straggler_factor", 2.0)))
+        elif rank == 0:
+            import sys as _sys
+            print("=> WARNING: --elastic needs the trainer to pass its "
+                  "host world to build_resilience(world=...); elastic "
+                  "supervision is OFF for this run", file=_sys.stderr)
     return {
         "plan": plan,
         "verify": verify,
@@ -413,6 +456,9 @@ def build_resilience(args: argparse.Namespace, *, n_steps: int,
                      if window > 0 else None),
         "meter": ResilienceMeter(),
         "wrap_tx": wrap_tx,
+        # elastic-training surface (ISSUE 19): the ElasticSupervisor
+        # (None unless --elastic AND the trainer passed world >= 1)
+        "elastic": elastic,
         "active": bool(plan or guard or timeout > 0 or window > 0
-                       or verify or quant_stats),
+                       or verify or quant_stats or elastic is not None),
     }

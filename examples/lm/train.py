@@ -287,7 +287,23 @@ def main(argv=None) -> dict:
     # (dp/sp/tp, pp, moe); host faults/watchdog/sentinel wrap the loop.
     from cpd_tpu.resilience import ladder_step_key
     from cpd_tpu.utils.config import build_resilience
-    res = build_resilience(args, n_steps=args.max_iter, rank=rank)
+    res = build_resilience(args, n_steps=args.max_iter, rank=rank,
+                           world=dp)
+    esup = res["elastic"]
+    if esup is not None:
+        # the elastic ladder re-layouts the DATA axis at runtime; the
+        # other axes' shardings (and the ladder step tables, which
+        # compile against the full-world mesh) don't re-shape that way
+        if args.pp > 1 or args.moe or args.sp > 1 or args.tp > 1:
+            raise SystemExit("--elastic is wired to the plain dp path "
+                             "only (shrinking a sp/tp/pp/moe mesh is "
+                             "not a data-axis re-layout)")
+        if res["verify"] or res["precision"] is not None:
+            raise SystemExit("--elastic does not compose with "
+                             "--verify-reduce/--precision-ladder here "
+                             "(their step tables compile against the "
+                             "full-world mesh; use tools/bench_elastic "
+                             "or run_elastic for the composed drills)")
     if res["verify"] and (args.pp > 1 or args.moe):
         raise SystemExit("--verify-reduce is wired to the default "
                          "dp/sp/tp path only (the pp/moe steppers do "
@@ -346,9 +362,15 @@ def main(argv=None) -> dict:
 
     def run_meta():
         # ladder state rides every checkpoint's metadata sidecar so a
-        # restart/rollback resumes AT the escalated format
-        return ({"precision": psup.state_dict()}
-                if psup is not None else None)
+        # restart/rollback resumes AT the escalated format; the elastic
+        # fleet view rides along so a process restart resumes with the
+        # same alive set (ISSUE 19)
+        meta = {}
+        if psup is not None:
+            meta["precision"] = psup.state_dict()
+        if esup is not None:
+            meta["elastic"] = esup.state_dict()
+        return meta or None
 
     ds = SyntheticText(n=4096, seq_len=args.seq_len,
                        vocab_size=args.vocab_size)
@@ -472,12 +494,16 @@ def main(argv=None) -> dict:
         else:
             # no ladder (verify off, or a non-ladder mode like fast):
             # verification, when on, is detection-only agreement checking
-            step = make_lm_train_step(model, tx, mesh,
-                                      emulate_node=args.emulate_node,
-                                      label_smoothing=args.label_smoothing,
-                                      verify_reduce=res["verify"],
-                                      wire_fault_plan=res["wire_plan"],
-                                      **quant_kw, **tele_kw)
+            def build_plain_step(m):
+                # mesh-parametrized so the elastic path can rebuild the
+                # SAME step at a shrunken/regrown world (ISSUE 19)
+                return make_lm_train_step(
+                    model, tx, m, emulate_node=args.emulate_node,
+                    label_smoothing=args.label_smoothing,
+                    verify_reduce=res["verify"],
+                    wire_fault_plan=res["wire_plan"],
+                    **quant_kw, **tele_kw)
+            step = build_plain_step(mesh)
         eval_step = make_lm_eval_step(model, mesh)
         specs_fn = lm_state_specs
         global_batch = args.batch_size * dp * args.emulate_node
@@ -549,6 +575,33 @@ def main(argv=None) -> dict:
     step_no = start_iter
     rollbacks = reseed = 0
     prev_batch = None
+    # --- elastic training setup (ISSUE 19, docs/RESILIENCE.md) --------
+    elastic_table, elastic_links, last_dt = None, {}, None
+    if esup is not None:
+        if res["plan"] is not None and res["plan"].elastic_faults():
+            # drill mode: heartbeat rows derive from the plan — a pure
+            # function of it, no wall clock — so a drill replays its
+            # shrink/regrow event sequence exactly
+            from cpd_tpu.resilience.elastic import heartbeat_table
+            elastic_table = heartbeat_table(res["plan"],
+                                            esup.home_world,
+                                            args.max_iter)
+            elastic_links = {f.step: (int(f.arg) if f.arg >= 0 else 0,
+                                      int(f.arg2) if f.arg2 >= 0 else 1)
+                             for f in res["plan"].elastic_faults()
+                             if f.kind == "link_flaky"}
+
+        def rebuild_elastic(w):
+            # re-layout the data axis at runtime: a new mesh over the
+            # first w alive hosts' devices rebuilds the compiled step
+            # and with it every per-mesh closure (ring/hierarchical
+            # transports, reduce caches) at the new world
+            nonlocal mesh, step, eval_step, global_batch
+            devs = [jax.devices()[h] for h in esup.active_hosts()]
+            mesh = make_mesh(dp=w, devices=devs)
+            step = build_plain_step(mesh)
+            eval_step = make_lm_eval_step(model, mesh)
+            global_batch = args.batch_size * w * args.emulate_node
 
     def batch_for(i):
         # default path: the run-sequential RNG stream (unchanged
@@ -594,6 +647,91 @@ def main(argv=None) -> dict:
             # run_guarded, whose `it` is that index already).  Checkpoint
             # faults are the exception: they key on the saved step's name.
             upd = it - 1
+            # --- elastic supervision (ISSUE 19): one heartbeat row per
+            # update, BEFORE the step — the evidence is the previous
+            # step's per-host timing (plan-derived in drills, the
+            # measured step time stood in for every dp host otherwise)
+            if esup is not None:
+                if elastic_table is not None:
+                    row = (elastic_table[upd] if upd < len(elastic_table)
+                           else [1.0] * esup.home_world)
+                elif last_dt is not None:
+                    row = [last_dt] * esup.home_world
+                else:
+                    row = None
+                decision = (esup.on_heartbeats(upd, row)
+                            if row is not None else None)
+                meter.counts["elastic_hot_steps"] = \
+                    esup.counters["hot_steps"]
+                meter.counts["elastic_heartbeat_misses"] = \
+                    esup.counters["heartbeat_misses"]
+                if decision is None and upd in elastic_links:
+                    # the in-step collective retry ladder for a flaky
+                    # wire into one host (popped: one-shot per spec)
+                    host, attempts = elastic_links.pop(upd)
+                    for _ in range(attempts):
+                        act = esup.on_link_failure(upd, host)
+                        if act == "shrink":
+                            decision = ("shrink", (host,))
+                            meter.bump("elastic_link_escalations")
+                            break
+                        meter.bump("elastic_link_retries")
+                    else:
+                        esup.on_step_ok(upd)
+                        if rank == 0 and attempts:
+                            print(f"=> elastic: flaky link into host "
+                                  f"{host} at iter {it} absorbed by "
+                                  f"{attempts} in-step retr"
+                                  f"{'y' if attempts == 1 else 'ies'}",
+                                  file=sys.stderr)
+                if decision is not None:
+                    what, hosts_ch = decision
+                    if what == "shrink":
+                        for _ in hosts_ch:
+                            meter.bump("elastic_drains")
+                        meter.bump("elastic_shrinks")
+                        new_w = esup.world
+                        rolled = (manager.restore_latest_valid(
+                                      state, rank=rank, world=new_w)
+                                  if new_w >= 1 else None)
+                        if rolled is None:
+                            if rank == 0:
+                                print(f"=> elastic: host(s) "
+                                      f"{list(hosts_ch)} lost at iter "
+                                      f"{it} and no world to shrink "
+                                      f"onto — stopping", file=sys.stderr)
+                            if oflight is not None:
+                                oflight.dump("elastic")
+                            preempted = True
+                            break
+                        rebuild_elastic(new_w)
+                        state = relayout(rolled.state)
+                        meter.bump("restores")
+                        step_no = int(rolled.step)
+                        it = step_no + 1
+                        if rank == 0:
+                            print(f"=> elastic: drained host(s) "
+                                  f"{list(hosts_ch)}, world -> {new_w} "
+                                  f"(hosts {list(esup.active_hosts())})"
+                                  f", resumed from iter {step_no}",
+                                  file=sys.stderr)
+                        if oflight is not None:
+                            oflight.record("elastic_shrink",
+                                           step=step_no)
+                        continue
+                    # regrow: the live state is healthy — seal it, then
+                    # rebuild UP onto the returning host (zero steps
+                    # lost by construction)
+                    meter.bump("elastic_regrows")
+                    manager.save(step_no, state, force=True,
+                                 metadata=run_meta())
+                    manager.wait()
+                    rebuild_elastic(esup.world)
+                    state = relayout(state)
+                    if rank == 0:
+                        print(f"=> elastic: host(s) {list(hosts_ch)} "
+                              f"rejoined after probation, world -> "
+                              f"{esup.world}", file=sys.stderr)
             try:
                 if injector is not None:
                     injector.maybe_preempt(upd)
@@ -619,6 +757,7 @@ def main(argv=None) -> dict:
                 if injector is not None:
                     injector.maybe_stall(upd)
                 prev_state = state    # verified-reduce discard target
+                t_step = now()
                 with otr.span("step", step=it):
                     # the whole jitted fwd+bwd+reduce+optimizer program
                     # plus the metric device-sync; per-bucket reduce
@@ -626,6 +765,9 @@ def main(argv=None) -> dict:
                     state, m = step(state, jnp.asarray(toks),
                                     jnp.asarray(tgts))
                     last = {k: float(v) for k, v in m.items()}  # sync
+                last_dt = now() - t_step
+                if esup is not None:
+                    esup.on_step_ok(upd)
                 if watchdog is not None:
                     watchdog.disarm()
             except KeyboardInterrupt:
@@ -817,6 +959,18 @@ def main(argv=None) -> dict:
         # start_trace in this process (ISSUE 11 satellite)
         profiler.close()
     from cpd_tpu.resilience import report_unfired
+    if esup is not None and res["plan"] is not None:
+        # the elastic harness owns its kinds' accounting (mirrors
+        # run_elastic): anything scheduled past the last processed
+        # update, or aimed at a host outside the fleet, never manifested
+        leftover = sorted(
+            f for f in res["plan"].elastic_faults()
+            if f.step >= step_no or int(max(f.arg, 0)) >= esup.home_world)
+        if leftover:
+            meter.bump("faults_unfired", len(leftover))
+            if rank == 0:
+                print(f"=> elastic plan: {len(leftover)} spec(s) never "
+                      f"fired: {leftover}", file=sys.stderr)
     # wire faults only fire when the default path baked a ring-mode
     # table in — a wire_* spec on any other run must read as UNFIRED
     report_unfired(injector, n_steps=args.max_iter, meter=meter, rank=rank,
@@ -827,7 +981,8 @@ def main(argv=None) -> dict:
                    # sat tables only ride the default-path steppers (a
                    # pp/moe run with sat specs exits up front, but keep
                    # the accounting honest regardless)
-                   sat_armed=not (args.pp > 1 or args.moe))
+                   sat_armed=not (args.pp > 1 or args.moe),
+                   host_armed=esup is not None)
     jax.block_until_ready(state.params)
     manager.wait()
     manager.close()
@@ -888,7 +1043,7 @@ def main(argv=None) -> dict:
     from cpd_tpu.utils.config import finish_obs
     obs_out = finish_obs(obs, meter=meter, last=last, step_no=step_no,
                          supervisor=supervisor, precision=psup,
-                         rank=rank, preempted=preempted,
+                         elastic=esup, rank=rank, preempted=preempted,
                          diverged=diverged)
     return {"step": step_no, "diverged": diverged,
             **({"resilience": meter.as_dict()} if res["active"] else {}),

@@ -268,7 +268,8 @@ def main(argv=None) -> dict:
     # recovery lives on the LM trainer, whose synchronous batch fetch
     # can rewind (the Prefetcher pipeline here cannot).
     from cpd_tpu.utils.config import build_resilience
-    res = build_resilience(args, n_steps=total_iter, rank=rank)
+    res = build_resilience(args, n_steps=total_iter, rank=rank,
+                           world=n_dev)
     if res["wraps_optimizer"] and (args.zero1 or args.zero2
                                    or shampoo_on):
         # watchdog / sentinel / host-level faults compose fine with ZeRO
@@ -312,6 +313,7 @@ def main(argv=None) -> dict:
     injector, watchdog = res["injector"], res["watchdog"]
     sentinel, meter = res["sentinel"], res["meter"]
     psup = res["precision"]
+    esup = res["elastic"]
     # observability spine (docs/OBSERVABILITY.md): pure host-side
     # observation — step outputs bitwise identical with or without
     # --obs-dir (pinned by the obs-smoke gate).  The data span lives on
@@ -331,9 +333,14 @@ def main(argv=None) -> dict:
     def run_meta():
         # ladder state rides every checkpoint's metadata sidecar so a
         # restart resumes AT the escalated format (docs/RESILIENCE.md
-        # "Precision ladder")
-        return ({"precision": psup.state_dict()}
-                if psup is not None else None)
+        # "Precision ladder"); the elastic fleet view rides along so a
+        # process restart resumes with the same alive set (ISSUE 19)
+        meta = {}
+        if psup is not None:
+            meta["precision"] = psup.state_dict()
+        if esup is not None:
+            meta["elastic"] = esup.state_dict()
+        return meta or None
 
     state = create_train_state(model, tx, jnp.zeros((2, 32, 32, 3)),
                                jax.random.PRNGKey(seed))
@@ -615,6 +622,20 @@ def main(argv=None) -> dict:
     preempted = False
     diverged = False
     prev_batch = None
+    # --- elastic training setup (ISSUE 19): detection + drain only —
+    # the prefetcher pipeline cannot rewind a batch, so this trainer's
+    # recovery doctrine is a clean drain-save and a controlled exit
+    # (the in-run shrink lives on the LM trainer and run_elastic)
+    elastic_table, elastic_links, last_dt = None, {}, None
+    if esup is not None:
+        if res["plan"] is not None and res["plan"].elastic_faults():
+            from cpd_tpu.resilience.elastic import heartbeat_table
+            elastic_table = heartbeat_table(res["plan"],
+                                            esup.home_world, total_iter)
+            elastic_links = {f.step: (int(f.arg) if f.arg >= 0 else 0,
+                                      int(f.arg2) if f.arg2 >= 0 else 1)
+                             for f in res["plan"].elastic_faults()
+                             if f.kind == "link_flaky"}
     from cpd_tpu.utils.prefetch import Prefetcher
     batches = Prefetcher(produced(), depth=2)
     batch_iter = iter(batches)
@@ -637,6 +658,61 @@ def main(argv=None) -> dict:
                 preempted = True
                 break
             profiler.step(step_no)
+            # --- elastic supervision (ISSUE 19): one heartbeat row per
+            # update (plan-derived in drills, the measured step time
+            # standing in for every dp host otherwise); any drain
+            # decision -> sealed checkpoint + controlled exit
+            if esup is not None:
+                if elastic_table is not None:
+                    row = (elastic_table[step_no]
+                           if step_no < len(elastic_table)
+                           else [1.0] * esup.home_world)
+                elif last_dt is not None:
+                    row = [last_dt] * esup.home_world
+                else:
+                    row = None
+                decision = (esup.on_heartbeats(step_no, row)
+                            if row is not None else None)
+                meter.counts["elastic_hot_steps"] = \
+                    esup.counters["hot_steps"]
+                meter.counts["elastic_heartbeat_misses"] = \
+                    esup.counters["heartbeat_misses"]
+                if decision is None and step_no in elastic_links:
+                    host, attempts = elastic_links.pop(step_no)
+                    for _ in range(attempts):
+                        act = esup.on_link_failure(step_no, host)
+                        if act == "shrink":
+                            decision = ("shrink", (host,))
+                            meter.bump("elastic_link_escalations")
+                            break
+                        meter.bump("elastic_link_retries")
+                    else:
+                        esup.on_step_ok(step_no)
+                        if rank == 0 and attempts:
+                            print(f"=> elastic: flaky link into host "
+                                  f"{host} at iter {step_no + 1} "
+                                  f"absorbed by {attempts} in-step "
+                                  f"retr"
+                                  f"{'y' if attempts == 1 else 'ies'}",
+                                  file=sys.stderr)
+                if decision is not None and decision[0] == "shrink":
+                    for _ in decision[1]:
+                        meter.bump("elastic_drains")
+                    meter.bump("elastic_shrinks")
+                    if rank == 0:
+                        print(f"=> elastic: host(s) "
+                              f"{list(decision[1])} unhealthy at iter "
+                              f"{step_no + 1} — draining to a sealed "
+                              f"checkpoint and stopping (in-run "
+                              f"shrink: LM trainer / run_elastic)",
+                              file=sys.stderr)
+                    if oflight is not None:
+                        oflight.dump("elastic")
+                    preempt_save(manager, step_no, to_ckpt(state), rank,
+                                 metadata=run_meta(),
+                                 what="elastic drain at")
+                    preempted = True
+                    break
             try:
                 if injector is not None:
                     injector.maybe_preempt(step_no)
@@ -659,10 +735,14 @@ def main(argv=None) -> dict:
                 if injector is not None:
                     injector.maybe_stall(step_no)
                 prev_state = state    # verified-reduce discard target
+                t_step = now()
                 with otr.span("step", step=step_no + 1):
                     state, metrics = train_step(state, gx, gy)
                     last = {k: float(v)
                             for k, v in metrics.items()}  # sync
+                last_dt = now() - t_step
+                if esup is not None:
+                    esup.on_step_ok(step_no)
                 if watchdog is not None:
                     watchdog.disarm()
             except KeyboardInterrupt:
@@ -843,12 +923,25 @@ def main(argv=None) -> dict:
         # start_trace in this process (ISSUE 11 satellite)
         profiler.close()
     from cpd_tpu.resilience import report_unfired
+    if esup is not None and res["plan"] is not None:
+        # the elastic harness owns its kinds' accounting: anything
+        # scheduled past the last processed update, or aimed outside
+        # the fleet, never manifested (mirrors run_elastic / lm)
+        leftover = sorted(
+            f for f in res["plan"].elastic_faults()
+            if f.step >= step_no or int(max(f.arg, 0)) >= esup.home_world)
+        if leftover:
+            meter.bump("faults_unfired", len(leftover))
+            if rank == 0:
+                print(f"=> elastic plan: {len(leftover)} spec(s) never "
+                      f"fired: {leftover}", file=sys.stderr)
     # wire faults only fire when a ring-mode step baked the table in —
     # a wire_* spec on a gather/psum run must read as UNFIRED, not pass
     report_unfired(injector, n_steps=total_iter, meter=meter, rank=rank,
                    wire_armed=(supervisor.home == "ring"
                                if supervisor is not None
-                               else args.mode == "ring"))
+                               else args.mode == "ring"),
+                   host_armed=esup is not None)
     manager.wait()
     writer.close()
     if rank == 0 and not (preempted or diverged):  # interrupted != "done"
@@ -860,7 +953,7 @@ def main(argv=None) -> dict:
     from cpd_tpu.utils.config import finish_obs
     obs_out = finish_obs(obs, meter=meter, last=last, step_no=step_no,
                          supervisor=supervisor, precision=psup,
-                         rank=rank, preempted=preempted,
+                         elastic=esup, rank=rank, preempted=preempted,
                          diverged=diverged)
     return {"step": step_no, "best_prec1": best_prec1,
             "diverged": diverged,

@@ -196,3 +196,77 @@ def test_cli_explain_host_rules(rule_id):
     # both fixture halves are printed
     assert "FIRES on" in proc.stdout
     assert "stays SILENT on" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: the elastic subsystem is IN the v4 host scope
+# ---------------------------------------------------------------------------
+
+def test_elastic_module_passes_host_lint():
+    """The ElasticSupervisor/HeartbeatMonitor/run_elastic bookkeeping
+    is clean under every host rule WITHOUT a single suppression pragma
+    — fixed-size per-host lists (host-unbounded), durations passed in
+    rather than measured (host-clock), no thread but the caller's
+    (host-race).  Focused here so a regression names the elastic file,
+    not just the whole-tree gate."""
+    from cpd_tpu.analysis import lint_tree
+    target = os.path.join(REPO, "cpd_tpu", "resilience", "elastic.py")
+    findings = lint_tree([target], select=list(host_rules()))
+    assert findings == [], [(f.line, f.rule, f.message)
+                            for f in findings]
+    with open(target) as fh:
+        assert "cpd-lint:" not in fh.read(), \
+            "elastic.py must stay pragma-free (the pinned suppression " \
+            "budget in test_analysis.py does not include it)"
+
+
+def test_host_rules_catch_elastic_shaped_defects():
+    """The rules genuinely guard the elastic design decisions: each
+    tempting shortcut — an uncapped transition log, a timer-thread
+    heartbeat feed, self-measured step times — is an elastic-shaped
+    variant a host rule fires on."""
+    unbounded = """\
+        class Supervisor:
+            def __init__(self):
+                self.transitions = []
+
+            def on_heartbeats(self, step, row):
+                self.transitions.append((step, len(row)))
+        """
+    found = lint_source(textwrap.dedent(unbounded), path="sup.py",
+                        select=list(host_rules()))
+    assert [f.rule for f in found] == ["host-unbounded"]
+
+    race = """\
+        import threading
+
+        class HeartbeatFeed:
+            def __init__(self):
+                self.rows = []
+                self._t = threading.Thread(target=self._pump,
+                                           daemon=True)
+                self._t.start()
+
+            def _pump(self):
+                self.rows.append(1.0)
+
+            def drain(self):
+                out = list(self.rows)
+                self.rows.clear()
+                return out
+        """
+    found = lint_source(textwrap.dedent(race), path="feed.py",
+                        select=list(host_rules()))
+    assert "host-race" in {f.rule for f in found}
+
+    clock = """\
+        import time
+
+        class Monitor:
+            def beat(self, host):
+                t0 = time.time()
+                return time.time() - t0
+        """
+    found = lint_source(textwrap.dedent(clock), path="mon.py",
+                        select=list(host_rules()))
+    assert [f.rule for f in found] == ["host-clock", "host-clock"]

@@ -1,0 +1,475 @@
+"""ISSUE 19 — the elastic-training state machine, unit level.
+
+Everything here runs on a FAKE step (a closed-form params update, no
+model, no compiles): the supervisor, the monitor, the plan grammar, the
+synthetic heartbeat tables and the `run_elastic` ladder are all pure
+host code, so the units stay milliseconds.  The real-stack drills
+(ZeRO-1 re-flatten, bitwise shrink-vs-fresh-run, x2 determinism on an
+8-device mesh) live in tools/bench_elastic.py — the `elastic-smoke` CI
+gate — and the pad_to_world edge cases in tests/test_zero.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpd_tpu.resilience import (ELASTIC_KINDS, FaultPlan, Injector,
+                                StepWatchdog, report_unfired)
+from cpd_tpu.resilience.elastic import (ElasticSupervisor,
+                                        HeartbeatMonitor,
+                                        heartbeat_table, run_elastic,
+                                        shrink_world)
+from cpd_tpu.train.checkpoint import CheckpointManager
+from cpd_tpu.train.metrics import ResilienceMeter
+from cpd_tpu.train.state import TrainState
+
+
+# ---------------------------------------------------------------------------
+# the grammar: elastic kinds in the FaultPlan
+# ---------------------------------------------------------------------------
+
+def test_plan_parses_elastic_kinds_with_arg2():
+    plan = FaultPlan.parse("host_kill@5:3,straggler@4:2:4,"
+                           "link_flaky@3:1:2")
+    fs = plan.elastic_faults()
+    # plans are step-ordered
+    assert [f.kind for f in fs] == ["link_flaky", "straggler",
+                                    "host_kill"]
+    lf, st, hk = fs
+    assert (hk.step, hk.arg, hk.arg2) == (5, 3.0, -1.0)   # no rejoin
+    assert (st.step, st.arg, st.arg2) == (4, 2.0, 4.0)    # factor 4
+    assert (lf.step, lf.arg, lf.arg2) == (3, 1.0, 2.0)    # 2 attempts
+    assert all(f.kind in ELASTIC_KINDS for f in fs)
+
+
+def test_plan_rejects_arg2_on_non_elastic_kinds():
+    with pytest.raises(ValueError, match="arg2"):
+        FaultPlan.parse("grad_nan@3:1:2")
+    with pytest.raises(ValueError, match="arg2"):
+        FaultPlan.parse("wire_flip@3:0.5:9")
+
+
+def test_elastic_faults_excludes_other_families():
+    plan = FaultPlan.parse("grad_nan@1;host_kill@2:0;stall@3:0.1")
+    assert [f.kind for f in plan.elastic_faults()] == ["host_kill"]
+
+
+# ---------------------------------------------------------------------------
+# shrink_world
+# ---------------------------------------------------------------------------
+
+def test_shrink_world_power_of_two_and_exact():
+    assert [shrink_world(a) for a in (0, 1, 2, 3, 5, 7, 8, 9)] \
+        == [0, 1, 2, 2, 4, 4, 8, 8]
+    assert [shrink_world(a, pow2=False) for a in (3, 5, 7)] == [3, 5, 7]
+
+
+# ---------------------------------------------------------------------------
+# HeartbeatMonitor
+# ---------------------------------------------------------------------------
+
+def test_monitor_validates_ctor():
+    for bad in (dict(world=0), dict(world=4, patience=0),
+                dict(world=4, kill_patience=0),
+                dict(world=4, factor=1.0),
+                dict(world=4, smoothing=0.0),
+                dict(world=4, smoothing=1.5)):
+        with pytest.raises(ValueError):
+            HeartbeatMonitor(**bad)
+
+
+def test_monitor_slow_streak_goes_hot_at_patience():
+    m = HeartbeatMonitor(2, patience=3, factor=2.0, warmup=2)
+    for _ in range(4):
+        assert m.beat(0, 1.0) == "ok"
+    assert m.beat(0, 4.0) == "slow"
+    assert m.beat(0, 4.0) == "slow"
+    assert m.beat(0, 4.0) == "hot"          # third consecutive slow
+    # a healthy beat resets the streak
+    m2 = HeartbeatMonitor(2, patience=3, factor=2.0, warmup=2)
+    for _ in range(4):
+        m2.beat(0, 1.0)
+    m2.beat(0, 4.0)
+    m2.beat(0, 1.0)
+    assert m2.slow[0] == 0
+
+
+def test_monitor_slow_beats_do_not_poison_the_ema():
+    """The detection-evasion regression: a sustained straggler must not
+    drag its own threshold up.  Slow beats are counted but NOT folded
+    into the EMA, so the healthy baseline survives the attack."""
+    m = HeartbeatMonitor(1, patience=100, factor=2.0, warmup=2)
+    for _ in range(5):
+        m.beat(0, 1.0)
+    baseline = m.ema[0]
+    for _ in range(50):                    # a long 3x slowdown
+        assert m.beat(0, 3.0) == "slow"    # NEVER becomes "ok"
+    assert m.ema[0] == baseline            # the baseline never moved
+
+
+def test_monitor_warmup_beats_never_read_slow():
+    m = HeartbeatMonitor(1, warmup=2)
+    assert m.beat(0, 100.0) == "ok"        # first beats seed the EMA
+    assert m.beat(0, 0.1) == "ok"
+
+
+def test_monitor_absent_and_reset():
+    m = HeartbeatMonitor(2, kill_patience=2)
+    assert not m.absent(1)
+    assert m.absent(1)                     # second consecutive miss
+    m.beat(1, 1.0)                         # a beat clears the streak
+    assert not m.absent(1)
+    m.reset(1)
+    assert m.ema[1] == 0.0 and m.miss[1] == 0
+
+
+def test_monitor_state_roundtrip_and_world_mismatch():
+    m = HeartbeatMonitor(3)
+    m.beat(0, 1.0)
+    m.beat(1, 2.0)
+    m.absent(2)
+    m2 = HeartbeatMonitor(3).load_state_dict(m.state_dict())
+    assert m2.state_dict() == m.state_dict()
+    with pytest.raises(ValueError, match="world-4"):
+        HeartbeatMonitor(4).load_state_dict(m.state_dict())
+
+
+# ---------------------------------------------------------------------------
+# ElasticSupervisor
+# ---------------------------------------------------------------------------
+
+def _row(world, **over):
+    row = [1.0] * world
+    for h, dt in over.items():
+        row[int(h)] = dt
+    return row
+
+
+def test_supervisor_validates_ctor():
+    with pytest.raises(ValueError, match="max_retries"):
+        ElasticSupervisor(8, max_retries=-1)
+    with pytest.raises(ValueError, match="probation"):
+        ElasticSupervisor(8, probation=0)
+
+
+def test_supervisor_miss_drains_and_shrinks_pow2():
+    sup = ElasticSupervisor(8, kill_patience=1)
+    assert sup.world == 8 and not sup.degraded
+    decision = sup.on_heartbeats(5, _row(8, **{"3": None}))
+    assert decision == ("shrink", (3,))
+    assert sup.world == 4                   # 7 alive -> pow2 floor 4
+    assert sup.active_hosts() == (0, 1, 2, 4)
+    assert sup.degraded
+    assert sup.counters["drains"] == 1 and sup.counters["shrinks"] == 1
+    assert sup.counters["heartbeat_misses"] == 1
+    assert sup.transitions == [(5, 8, 4)]
+
+
+def test_supervisor_non_pow2_uses_all_alive():
+    sup = ElasticSupervisor(8, pow2=False)
+    sup.on_heartbeats(2, _row(8, **{"6": None}))
+    assert sup.world == 7
+    assert sup.active_hosts() == (0, 1, 2, 3, 4, 5, 7)
+
+
+def test_supervisor_straggler_hot_then_probation_regrow():
+    sup = ElasticSupervisor(4, patience=2, factor=2.0, probation=3)
+    for s in range(4):                      # warm the baselines
+        assert sup.on_heartbeats(s, _row(4)) is None
+    assert sup.on_heartbeats(4, _row(4, **{"1": 5.0})) is None   # slow
+    decision = sup.on_heartbeats(5, _row(4, **{"1": 5.0}))       # hot
+    assert decision == ("shrink", (1,))
+    assert sup.counters["hot_steps"] == 2
+    assert sup.world == 2 and sup.active_hosts() == (0, 2)
+    # three healthy beats clear probation; the monitor history was
+    # reset at the drain so the first two SEED the new baseline
+    assert sup.on_heartbeats(6, _row(4)) is None
+    assert sup.on_heartbeats(7, _row(4)) is None
+    decision = sup.on_heartbeats(8, _row(4))
+    assert decision == ("regrow", (1,))
+    assert sup.world == 4 and not sup.degraded
+    assert sup.counters["rejoins"] == 1 and sup.counters["regrows"] == 1
+    assert sup.transitions == [(5, 4, 2), (8, 2, 4)]
+
+
+def test_supervisor_probation_streak_resets_on_miss():
+    sup = ElasticSupervisor(4, probation=3, kill_patience=1)
+    sup.on_heartbeats(0, _row(4, **{"2": None}))
+    sup.on_heartbeats(1, _row(4))
+    sup.on_heartbeats(2, _row(4))
+    assert sup.rejoin[2] == 2
+    sup.on_heartbeats(3, _row(4, **{"2": None}))     # flaps again
+    assert sup.rejoin[2] == 0
+    assert sup.world == 2                   # still shrunk
+
+
+def test_supervisor_shrink_takes_priority_over_regrow():
+    """One decision per call: a row where a drained host clears
+    probation AND a live host goes missing must shrink first — the
+    rejoin streak keeps and commits on a later, healthy step."""
+    sup = ElasticSupervisor(4, probation=1, kill_patience=1)
+    sup.on_heartbeats(0, _row(4, **{"3": None}))
+    decision = sup.on_heartbeats(1, _row(4, **{"1": None}))
+    assert decision == ("shrink", (1,))     # host 3's rejoin waits
+    # both drained hosts clear probation on the next healthy row
+    assert sup.on_heartbeats(2, _row(4)) == ("regrow", (1, 3))
+
+
+def test_supervisor_link_ladder_retry_then_escalate():
+    sup = ElasticSupervisor(4, max_retries=2)
+    assert sup.on_link_failure(3, 1) == "retry"
+    assert sup.on_link_failure(3, 1) == "retry"
+    assert sup.on_link_failure(3, 1) == "shrink"     # budget exhausted
+    assert not sup.alive[1]
+    assert sup.counters["link_retries"] == 2
+    assert sup.counters["link_escalations"] == 1
+    # on_step_ok resets the per-step streak
+    sup2 = ElasticSupervisor(4, max_retries=1)
+    assert sup2.on_link_failure(3, 1) == "retry"
+    sup2.on_step_ok(3)
+    assert sup2.on_link_failure(4, 1) == "retry"     # fresh budget
+    assert sup2.world == 4
+
+
+def test_supervisor_row_width_validated():
+    sup = ElasticSupervisor(4)
+    with pytest.raises(ValueError, match="watches 4"):
+        sup.on_heartbeats(0, [1.0] * 8)
+
+
+def test_supervisor_state_roundtrip_and_home_mismatch():
+    sup = ElasticSupervisor(4, kill_patience=1)
+    sup.on_heartbeats(1, _row(4, **{"2": None}))
+    sup.on_link_failure(2, 0)
+    sd = sup.state_dict()
+    sup2 = ElasticSupervisor(4).load_state_dict(sd)
+    assert sup2.world == sup.world
+    assert sup2.active_hosts() == sup.active_hosts()
+    assert sup2.counters == sup.counters
+    assert sup2.transitions == sup.transitions
+    with pytest.raises(ValueError, match="home world"):
+        ElasticSupervisor(8).load_state_dict(sd)
+
+
+def test_supervisor_transition_log_capped():
+    sup = ElasticSupervisor(2, kill_patience=1, probation=1)
+    cap = ElasticSupervisor.TRANSITION_CAP
+    for s in range(cap + 20):               # flap forever
+        row = _row(2, **{"1": None}) if s % 2 == 0 else _row(2)
+        sup.on_heartbeats(s, row)
+    assert len(sup.transitions) <= cap
+
+
+# ---------------------------------------------------------------------------
+# the synthetic heartbeat tables
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_table_straggler_and_kill_with_rejoin():
+    plan = FaultPlan.parse("straggler@2:1:3,host_kill@4:0:2")
+    t = heartbeat_table(plan, 2, 8)
+    assert t[2][1] == 3.0                   # inflated by the factor
+    assert t[2][0] == 1.0
+    assert t[4][0] is None and t[5][0] is None
+    assert t[6][0] == 1.0                   # back after r=2 steps
+    assert all(t[s][0] == 1.0 for s in (0, 1, 2, 3))
+
+
+def test_heartbeat_table_default_factor_and_open_kill():
+    t = heartbeat_table(FaultPlan.parse("straggler@1:0"), 1, 3)
+    assert t[1][0] == 4.0                   # STRAGGLER_DEFAULT_FACTOR
+    t2 = heartbeat_table(FaultPlan.parse("host_kill@1:0"), 1, 4)
+    assert t2[1][0] is None and t2[3][0] is None     # never returns
+
+
+def test_heartbeat_table_holds_specs_aimed_past_the_fleet():
+    t = heartbeat_table(FaultPlan.parse("host_kill@1:7"), 4, 3)
+    assert all(all(dt == 1.0 for dt in row) for row in t)
+
+
+# ---------------------------------------------------------------------------
+# run_elastic on a fake step (closed-form update, no compiles)
+# ---------------------------------------------------------------------------
+
+def _fake_state(w=0.0):
+    return TrainState(step=jnp.zeros([], jnp.int32),
+                      params={"w": jnp.float32(w)}, batch_stats={},
+                      opt_state=jnp.zeros([], jnp.float32))
+
+
+def _fake_build(world, hosts):
+    def stepf(state, b):
+        new = state.replace(step=state.step + 1,
+                            params={"w": state.params["w"] + b})
+        return new, {"loss": new.params["w"] * 0.5}
+    return {"step": stepf, "template": _fake_state()}
+
+
+def _fake_batch(step, world):
+    # pure in (step, world): the replay-equals-fresh-run contract's
+    # data half, same as the real trainers' requirement
+    return (jnp.float32(0.001 * step + world),)
+
+
+def _drill(tmp_path, spec, n_steps, max_recoveries=8, **sup_kw):
+    plan = FaultPlan.parse(spec)
+    sup = ElasticSupervisor(8, **sup_kw)
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        state, report = run_elastic(_fake_build, _fake_state(),
+                                    _fake_batch, n_steps,
+                                    supervisor=sup, manager=mgr,
+                                    plan=plan, injector=Injector(plan),
+                                    ckpt_every=2,
+                                    max_recoveries=max_recoveries)
+    finally:
+        mgr.close()
+    return state, report, sup
+
+
+def test_run_elastic_validates_args(tmp_path):
+    sup = ElasticSupervisor(8)
+    with pytest.raises(ValueError, match="ckpt_every"):
+        run_elastic(_fake_build, _fake_state(), _fake_batch, 4,
+                    supervisor=sup, manager=object(), ckpt_every=0)
+    with pytest.raises(ValueError, match="CheckpointManager"):
+        run_elastic(_fake_build, _fake_state(), _fake_batch, 4,
+                    supervisor=sup, manager=None)
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        with pytest.raises(ValueError, match="heartbeats"):
+            run_elastic(_fake_build, _fake_state(), _fake_batch, 4,
+                        supervisor=sup, manager=mgr)
+    finally:
+        mgr.close()
+
+
+def test_run_elastic_host_kill_shrinks_and_replays(tmp_path):
+    state, report, sup = _drill(tmp_path, "host_kill@5:3", 10)
+    assert report.completed and report.final_step == 10
+    assert report.world == 4 and report.home_world == 8
+    assert sup.active_hosts() == (0, 1, 2, 4)
+    assert ("host_kill", 5, 3) in report.events
+    assert ("elastic_shrink", 5, (3,), 4) in report.events
+    # the resume event names the new world and membership
+    assert ("elastic_resume", 5, 4, (0, 1, 2, 4)) in report.events
+    assert report.counters["elastic_shrinks"] == 1
+    assert report.counters["elastic_drains"] == 1
+    assert report.counters["restores"] == 1
+    # the final params are the pure replay from the step-4 seal: steps
+    # 0..3 at world 8, steps 4..9 at world 4
+    want = 0.0
+    for s in range(4):
+        want += 0.001 * s + 8
+    for s in range(4, 10):
+        want += 0.001 * s + 4
+    np.testing.assert_allclose(float(state.params["w"]), want,
+                               rtol=1e-6)
+
+
+def test_run_elastic_straggler_regrows_to_home(tmp_path):
+    state, report, sup = _drill(
+        tmp_path, "straggler@4:2:4,straggler@5:2:4,straggler@6:2:4",
+        14, patience=3, probation=4)
+    assert report.completed and report.world == 8
+    assert sup.counters["hot_steps"] == 3
+    assert report.counters["elastic_regrows"] == 1
+    assert report.counters["elastic_shrinks"] == 1
+    kinds = [e[0] for e in report.events]
+    assert kinds.index("elastic_shrink") < kinds.index("ckpt_pre_regrow")
+    assert "elastic_regrow" in kinds
+
+
+def test_run_elastic_link_flaky_absorbed(tmp_path):
+    state, report, sup = _drill(tmp_path, "link_flaky@3:2:1", 6)
+    assert report.completed and report.world == 8
+    assert report.counters["elastic_link_retries"] == 1
+    assert report.counters["elastic_link_escalations"] == 0
+    assert ("link_retry", 3, 2) in report.events
+    # absorbed: params equal an undisturbed pure run
+    want = sum(0.001 * s + 8 for s in range(6))
+    np.testing.assert_allclose(float(state.params["w"]), want,
+                               rtol=1e-6)
+
+
+def test_run_elastic_link_flaky_escalates_past_budget(tmp_path):
+    state, report, sup = _drill(tmp_path, "link_flaky@3:2:5", 8,
+                                max_retries=1)
+    assert report.completed
+    assert report.counters["elastic_link_retries"] == 1
+    assert report.counters["elastic_link_escalations"] == 1
+    assert report.counters["elastic_shrinks"] == 1
+    assert not sup.alive[2] and report.world == 4
+
+
+def test_run_elastic_recovery_budget_aborts(tmp_path):
+    state, report, sup = _drill(tmp_path, "host_kill@3:1", 8,
+                                max_recoveries=0)
+    assert report.aborted == "elastic" and not report.completed
+
+
+def test_run_elastic_unfired_spec_counted(tmp_path):
+    state, report, sup = _drill(tmp_path, "host_kill@50:3", 4)
+    assert report.completed
+    assert report.counters["faults_unfired"] >= 1
+    assert report.counters["elastic_shrinks"] == 0
+
+
+def test_run_elastic_watchdog_stale_trip_not_fatal(tmp_path):
+    """The satellite-3 fix end to end: a trip that fired on an EARLIER
+    step is cleared by the next arm(); only a trip during the armed
+    window aborts."""
+    plan = FaultPlan.parse("")
+    sup = ElasticSupervisor(8)
+    wd = StepWatchdog(60.0, interrupt=False)
+    wd.arm(0)
+    wd._fire()                              # stale trip from 'before'
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        state, report = run_elastic(
+            _fake_build, _fake_state(), _fake_batch, 4,
+            supervisor=sup, manager=mgr, plan=plan, watchdog=wd,
+            heartbeats=lambda s: [1.0] * 8, ckpt_every=2)
+    finally:
+        wd.close()
+        mgr.close()
+    assert report.completed and report.aborted is None
+    assert report.counters["watchdog_trips"] == 0
+
+
+def test_run_elastic_deterministic_x2(tmp_path):
+    runs = []
+    for rnd in range(2):
+        state, report, sup = _drill(
+            tmp_path / str(rnd), "host_kill@5:3,link_flaky@2:1:1", 10)
+        runs.append((float(state.params["w"]), report.events,
+                     dict(sup.counters)))
+    assert runs[0] == runs[1]
+
+
+def test_run_elastic_sidecar_carries_supervisor_state(tmp_path):
+    """Every seal rides the supervisor snapshot: a PROCESS restart can
+    rebuild the fleet view from the newest sidecar."""
+    state, report, sup = _drill(tmp_path, "host_kill@5:3", 10)
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        meta = mgr.metadata()
+    finally:
+        mgr.close()
+    assert meta is not None and "elastic" in meta
+    rebuilt = ElasticSupervisor(8).load_state_dict(meta["elastic"])
+    assert rebuilt.world == 4
+    assert rebuilt.active_hosts() == (0, 1, 2, 4)
+
+
+def test_report_unfired_host_armed_both_directions():
+    plan = FaultPlan.parse("host_kill@2:1;straggler@3:1:4;"
+                           "link_flaky@4:1:2")
+    unarmed = ResilienceMeter()
+    left = report_unfired(Injector(plan), n_steps=10, meter=unarmed,
+                          rank=1)
+    assert unarmed["faults_unfired"] == 3
+    assert {f.kind for f in left} == set(ELASTIC_KINDS)
+    armed = ResilienceMeter()
+    left = report_unfired(Injector(plan), n_steps=10, meter=armed,
+                          rank=1, host_armed=True)
+    assert armed["faults_unfired"] == 0 and left == []

@@ -1073,3 +1073,26 @@ def test_fleet_modules_pass_host_lint():
         select=list(host_rules()))
     assert findings == [], [(f.path, f.line, f.rule, f.message)
                             for f in findings]
+
+
+@pytest.mark.slow
+def test_soak_rounds_holds_rss_flat():
+    """ISSUE 19 satellite (the hours-equivalent soak, slow tier): three
+    full x2 soak rounds through `bench_serve --soak-smoke --rounds 3` —
+    every per-round gate (zero drops, both scale directions, bounded
+    stores, x2 determinism) plus the cross-round one: process RSS
+    plateaus after the round-1 jit warmup.  Run as a subprocess so the
+    RSS gate measures a clean interpreter, not the test session's
+    accumulated caches.  Recorded in docs/PERF.md."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_serve.py"),
+         "--soak-smoke", "--rounds", "3"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["soak_smoke"] is True and out["rounds"] == 3
+    assert len(out["rss_mb"]) == 3 and out["deterministic"] is True

@@ -424,6 +424,27 @@ def test_watchdog_disarm_prevents_trip():
     assert not wd.tripped
 
 
+def test_watchdog_rearm_clears_stale_trip():
+    """ISSUE 19 bugfix regression: a trip must not outlive the step it
+    fired on.  Before the fix, `tripped` was sticky — a guarded
+    rollback (or an elastic shrink) that recovered and re-armed for the
+    next step would read the PREVIOUS step's trip at its own boundary
+    check and abort a perfectly healthy recovery step.  Fired directly
+    (no timers, no sleeps) so the sequence is deterministic."""
+    wd = StepWatchdog(60.0, interrupt=False)
+    try:
+        wd.arm(5)
+        wd._fire()                      # step 5 wedges; the trip fires
+        assert wd.tripped and wd.trips == 1
+        wd.arm(6)                       # recovery re-arms for step 6
+        # fresh deadline = fresh verdict; the cumulative total stays
+        assert not wd.tripped and wd.trips == 1
+        wd._fire()                      # a REAL second hang still trips
+        assert wd.tripped and wd.trips == 2
+    finally:
+        wd.close()
+
+
 def test_sentinel_min_history_clamped_to_window():
     """window < min_history must not silently disarm the spike check
     (regression: found driving the resnet18 CLI with --divergence-window

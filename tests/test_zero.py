@@ -5,6 +5,8 @@ Oracle: ZeRO is a memory layout, not a numerics change — N steps with the
 sharded flat momentum (and, for ZeRO-2, the sharded faithful reduction)
 must match N steps of the replicated implementation exactly."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -616,6 +618,196 @@ def test_zero_elastic_template_world_mismatch_raises(tmp_path):
     with pytest.raises(ValueError, match="template world"):
         mgr.restore(tmpl2, step=1, world=4)   # template says world=2
     mgr.close()
+
+
+def test_zero_elastic_restore_non_divisible_world(tmp_path):
+    """ISSUE 19 satellite: the shrink target need not divide the home
+    world OR the parameter count — a padded world=8 snapshot restores at
+    world=3 (a pow2=False fleet losing hosts 3..7), the momentum
+    re-padded through `pad_to_world` at the new world, and reassembles
+    bitwise back at world=8."""
+    from cpd_tpu.parallel.ring import pad_to_world
+    from cpd_tpu.parallel.zero import Zero1State, zero2_sgd
+    from cpd_tpu.train import CheckpointManager
+    from cpd_tpu.train.state import TrainState
+
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    # total=41: 41 % 8 == 1 and 41 % 3 == 2 — both pads non-trivial —
+    # and 3 divides neither 8 nor 41 (the non-divisible shrink)
+    params = {"w": jnp.asarray(np.random.RandomState(2)
+                               .randn(37).astype(np.float32)),
+              "b": jnp.asarray(np.linspace(-1, 1, 4), jnp.float32)}
+    total = 41
+    vals = jnp.asarray(np.random.RandomState(3)
+                       .randn(total).astype(np.float32))
+    s8 = TrainState(step=jnp.asarray(7, jnp.int32), params=params,
+                    batch_stats={},
+                    opt_state=Zero1State(jnp.asarray(7, jnp.int32),
+                                         pad_to_world(vals, 8)))
+    mgr = CheckpointManager(str(tmp_path / "w8"), track_best=False)
+    mgr.save(1, s8, force=True)
+    mgr.wait()
+    assert mgr.metadata(1)["zero_layout"]["momentum_padded"] == 48
+
+    z3 = zero2_sgd(schedule, world=3)
+    tmpl3 = TrainState(step=jnp.zeros([], jnp.int32), params=params,
+                       batch_stats={}, opt_state=z3.init(params))
+    res = mgr.restore_latest_valid(tmpl3, world=3)
+    mgr.close()
+    assert res is not None and res.verified is True
+    m3 = np.asarray(res.state.opt_state.momentum)
+    assert m3.shape == np.asarray(z3.init(params).momentum).shape
+    assert m3.shape[0] % 3 == 0 and m3.shape[0] >= total
+    np.testing.assert_array_equal(m3[:total].view(np.uint32),
+                                  np.asarray(vals).view(np.uint32))
+    assert (m3[total:] == 0).all()
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(res.state.params[k]).view(np.uint32),
+            np.asarray(params[k]).view(np.uint32))
+
+    # regrow: the W=3 snapshot reassembles bitwise at W=8
+    z8 = zero2_sgd(schedule, world=8)
+    mgr2 = CheckpointManager(str(tmp_path / "w3"), track_best=False)
+    mgr2.save(1, res.state, force=True)
+    mgr2.wait()
+    tmpl8 = TrainState(step=jnp.zeros([], jnp.int32), params=params,
+                       batch_stats={}, opt_state=z8.init(params))
+    res8 = mgr2.restore_latest_valid(tmpl8, world=8)
+    mgr2.close()
+    assert res8 is not None
+    np.testing.assert_array_equal(
+        np.asarray(res8.state.opt_state.momentum).view(np.uint32),
+        np.asarray(s8.opt_state.momentum).view(np.uint32))
+
+
+def test_zero_elastic_shrink_regrow_keeps_escalated_precision(tmp_path):
+    """ISSUE 19 satellite: a shrink that lands mid-escalation must
+    resume INSIDE the precision ladder.  The supervisor's rung rides the
+    checkpoint metadata sidecar through shrink AND regrow — the resumed
+    run re-enters at the escalated format (and can still earn probation
+    back to home), never re-diverges from rung 0."""
+    from cpd_tpu.parallel.ring import pad_to_world
+    from cpd_tpu.parallel.zero import Zero1State, zero2_sgd
+    from cpd_tpu.resilience.precision import PrecisionSupervisor
+    from cpd_tpu.train import CheckpointManager
+    from cpd_tpu.train.state import TrainState
+
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    ladder = "e4m3,e5m7,e8m23"
+    sup = PrecisionSupervisor(ladder, threshold=1e-3, patience=2,
+                              probation=3)
+    hot = {"prec_wire_sat": 50.0, "prec_wire_total": 100.0}
+    sup.on_metrics(3, hot)
+    assert sup.on_metrics(4, hot) == "escalate" and sup.name == "e5m7"
+
+    params = {"w": jnp.asarray(np.random.RandomState(4)
+                               .randn(42).astype(np.float32))}
+    vals = jnp.asarray(np.random.RandomState(5)
+                       .randn(42).astype(np.float32))
+    s8 = TrainState(step=jnp.asarray(4, jnp.int32), params=params,
+                    batch_stats={},
+                    opt_state=Zero1State(jnp.asarray(4, jnp.int32),
+                                         pad_to_world(vals, 8)))
+    mgr = CheckpointManager(str(tmp_path / "w8"), track_best=False)
+    mgr.save(4, s8, force=True, metadata={"precision": sup.state_dict()})
+    mgr.wait()
+
+    # shrink to W'=4: the sidecar hands the escalated rung to the run
+    # that resumes at the smaller world
+    z4 = zero2_sgd(schedule, world=4)
+    tmpl4 = TrainState(step=jnp.zeros([], jnp.int32), params=params,
+                       batch_stats={}, opt_state=z4.init(params))
+    res = mgr.restore_latest_valid(tmpl4, world=4)
+    mgr.close()
+    assert res is not None and res.metadata["precision"]["level"] == 1
+    sup4 = PrecisionSupervisor(ladder, threshold=1e-3, patience=2,
+                               probation=3)
+    sup4.load_state_dict(res.metadata["precision"])
+    assert sup4.escalated and sup4.fmt == (5, 7) and sup4.home == (4, 3)
+
+    # regrow to W=8: the rung survives the second re-flatten too
+    mgr2 = CheckpointManager(str(tmp_path / "w4"), track_best=False)
+    mgr2.save(5, res.state, force=True,
+              metadata={"precision": sup4.state_dict()})
+    mgr2.wait()
+    z8 = zero2_sgd(schedule, world=8)
+    tmpl8 = TrainState(step=jnp.zeros([], jnp.int32), params=params,
+                       batch_stats={}, opt_state=z8.init(params))
+    res8 = mgr2.restore_latest_valid(tmpl8, world=8)
+    mgr2.close()
+    assert res8 is not None
+    sup8 = PrecisionSupervisor(ladder, threshold=1e-3, patience=2,
+                               probation=3)
+    sup8.load_state_dict(res8.metadata["precision"])
+    assert sup8.escalated and sup8.fmt == (5, 7)
+    # still INSIDE the ladder, not pinned: probation quiet steps earn
+    # the home format back on the regrown fleet
+    quiet = {"prec_wire_sat": 0.0, "prec_wire_total": 100.0}
+    sup8.on_metrics(6, quiet)
+    sup8.on_metrics(7, quiet)
+    assert sup8.on_metrics(8, quiet) == "deescalate"
+    assert sup8.fmt == sup8.home
+
+
+def test_zero_elastic_tampered_sidecar_refused_before_restore(tmp_path):
+    """ISSUE 19 satellite: a tampered checkpoint is refused BEFORE any
+    param bytes are read back — `restore_latest_valid(world=W')` runs
+    the digest check first, so the orbax restore is never even invoked
+    for the bad step, and the scan falls back to the older valid one."""
+    from cpd_tpu.parallel.ring import pad_to_world
+    from cpd_tpu.parallel.zero import Zero1State, zero2_sgd
+    from cpd_tpu.train import CheckpointManager
+    from cpd_tpu.train.state import TrainState
+
+    schedule = lambda s: jnp.float32(0.05)                     # noqa: E731
+    params = {"w": jnp.arange(42, dtype=jnp.float32)}
+
+    def snap(tag):
+        return TrainState(
+            step=jnp.asarray(tag, jnp.int32), params=params,
+            batch_stats={},
+            opt_state=Zero1State(jnp.asarray(tag, jnp.int32),
+                                 pad_to_world(
+                                     jnp.full((42,), float(tag)), 8)))
+
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        mgr.save(2, snap(2), force=True)
+        mgr.save(5, snap(5), force=True)
+        mgr.wait()
+        # flip one byte in the newest step's largest file
+        victim, size = max(
+            ((os.path.join(r, f), os.path.getsize(os.path.join(r, f)))
+             for r, _, fs in os.walk(str(tmp_path / "5")) for f in fs),
+            key=lambda t: t[1])
+        with open(victim, "r+b") as f:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+
+        restored_steps = []
+        inner = mgr._mgr.restore
+
+        def spy(step, *a, **kw):
+            restored_steps.append(step)
+            return inner(step, *a, **kw)
+
+        mgr._mgr.restore = spy
+        z4 = zero2_sgd(schedule, world=4)
+        tmpl4 = TrainState(step=jnp.zeros([], jnp.int32), params=params,
+                           batch_stats={}, opt_state=z4.init(params))
+        res = mgr.restore_latest_valid(tmpl4, world=4)
+        assert res is not None
+        assert res.step == 2 and res.skipped == (5,)
+        # the refusal happened at the digest, before any param read:
+        # orbax only ever touched the surviving step
+        assert restored_steps == [2]
+        np.testing.assert_array_equal(
+            np.asarray(res.state.opt_state.momentum)[:42], 2.0)
+    finally:
+        mgr.close()
 
 
 @pytest.mark.slow

@@ -130,6 +130,20 @@ def _build_trace(args):
     return mixed_trace(args.requests, vocab, **kw)
 
 
+def _rss_mb() -> float:
+    """Current resident set in MB — /proc on Linux, ru_maxrss (a
+    high-water mark, still monotone-comparable across rounds) elsewhere."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    import resource
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
 def _fresh_engine(model, params, args, **over):
     from cpd_tpu.serve import ServeEngine
 
@@ -882,6 +896,14 @@ def run_soak_smoke(args) -> dict:
          shape_log (every spawn/kill/retire decision) and every
          window's COUNT fields identical across two fresh soaks —
          wall-clock percentiles are reported, never gated.
+
+    ``--rounds N`` (ISSUE 19 satellite, the hours-equivalent soak —
+    slow tier, recorded in docs/PERF.md) repeats the full x2 soak N
+    times with shifted arrival seeds, a fresh fleet each round, and
+    additionally gates PROCESS RSS: round 1 pays the jit/compile-cache
+    warmup, after which later rounds must hold resident memory flat —
+    the leak class a short soak cannot see (accumulating per-round
+    state: result stores, shape logs, trace buffers, orbax handles).
     """
     from cpd_tpu.fleet import Autoscaler, AutoscalePolicy
     from cpd_tpu.resilience import FaultPlan
@@ -892,7 +914,7 @@ def run_soak_smoke(args) -> dict:
     vocab = _SMOKE_MODEL["vocab_size"]
     n_req = 48
 
-    def soak(sub, td):
+    def soak(sub, td, seed):
         policy = AutoscalePolicy(min_engines=1, max_engines=3,
                                  up_page_util=0.55, up_queue=2,
                                  up_patience=2, down_page_util=0.25,
@@ -905,65 +927,85 @@ def run_soak_smoke(args) -> dict:
             snapshot_every=4, snapshot_dir=os.path.join(td, sub),
             autoscaler=Autoscaler(policy))
         gen = steady_stream(n_req, vocab, rate=1.5, prompt_lens=(4, 8),
-                            max_new=(6, 8), seed=args.seed + 17,
+                            max_new=(6, 8), seed=seed + 17,
                             sla=[{"sla_class": 0}, {"sla_class": 1}])
         res = run_fleet_trace(
             fleet, gen, window_steps=16, min_steps=110,
-            burst_factory=flash_crowd(vocab, seed=args.seed + 31))
+            burst_factory=flash_crowd(vocab, seed=seed + 31))
         return res, fleet
 
     import tempfile
 
-    with tempfile.TemporaryDirectory() as td:
-        r1, f1 = soak("a", td)
-        r2, f2 = soak("b", td)
+    rounds = max(int(getattr(args, "rounds", 1) or 1), 1)
+    rss_mb = []
+    for rnd in range(rounds):
+        seed = args.seed + 1000 * rnd
+        with tempfile.TemporaryDirectory() as td:
+            r1, f1 = soak("a", td, seed)
+            r2, f2 = soak("b", td, seed)
 
-    # 1. nothing dropped, nothing unresolved, every fault consumed
-    assert r1["dropped"] == 0 and f1.unresolved() == [], \
-        f"soak silent drops: {r1['dropped']} " \
-        f"(unresolved {f1.unresolved()})"
-    assert f1.report_unfired() == [], \
-        f"soak left faults unfired: {f1.report_unfired()}"
-    assert r1["submitted"] == n_req + 6, r1["submitted"]  # trace+burst
+        # 1. nothing dropped, nothing unresolved, every fault consumed
+        assert r1["dropped"] == 0 and f1.unresolved() == [], \
+            f"soak silent drops: {r1['dropped']} " \
+            f"(unresolved {f1.unresolved()})"
+        assert f1.report_unfired() == [], \
+            f"soak left faults unfired: {f1.report_unfired()}"
+        assert r1["submitted"] == n_req + 6, r1["submitted"]  # +burst
 
-    # 2. the fleet actually breathed, and the wave actually hit
-    sc = f1.autoscaler.counters
-    assert sc["ups"] >= 1 and sc["downs"] >= 1, \
-        f"autoscaler never moved both directions: {sc}"
-    fc = r1["fleet_counters"]
-    assert fc["kill_waves"] == 1 and fc["engines_spawned"] >= 1 \
-        and fc["engines_retired"] >= 1, fc
-    assert sum(f1.accepting) == 1, \
-        f"idle tail should scale back to the floor: " \
-        f"{sum(f1.accepting)} accepting"
+        # 2. the fleet actually breathed, and the wave actually hit
+        sc = f1.autoscaler.counters
+        assert sc["ups"] >= 1 and sc["downs"] >= 1, \
+            f"autoscaler never moved both directions: {sc}"
+        fc = r1["fleet_counters"]
+        assert fc["kill_waves"] == 1 and fc["engines_spawned"] >= 1 \
+            and fc["engines_retired"] >= 1, fc
+        assert sum(f1.accepting) == 1, \
+            f"idle tail should scale back to the floor: " \
+            f"{sum(f1.accepting)} accepting"
 
-    # 3. bounded streaming state: stores at cap, tracking at in-flight
-    # width — yet the counter-derived resolution above stayed exact
-    agg = f1.aggregate_counters()
-    assert agg["results_evicted"] > 0, \
-        "soak never put the bounded stores at cap — not a soak"
-    st = r1["stream"]
-    assert st["final_tracked_rids"] == 0
-    assert st["peak_tracked_rids"] < r1["submitted"] // 2, \
-        f"per-request state not bounded by in-flight width: peak " \
-        f"{st['peak_tracked_rids']} of {r1['submitted']} submitted"
+        # 3. bounded streaming state: stores at cap, tracking at
+        # in-flight width — yet counter-derived resolution stays exact
+        agg = f1.aggregate_counters()
+        assert agg["results_evicted"] > 0, \
+            "soak never put the bounded stores at cap — not a soak"
+        st = r1["stream"]
+        assert st["final_tracked_rids"] == 0
+        assert st["peak_tracked_rids"] < r1["submitted"] // 2, \
+            f"per-request state not bounded by in-flight width: peak " \
+            f"{st['peak_tracked_rids']} of {r1['submitted']} submitted"
 
-    # 4. determinism x2 — counters, decisions, window counts
-    assert r1["fleet_counters"] == r2["fleet_counters"], \
-        f"soak fleet counters not deterministic:\n{r1['fleet_counters']}" \
-        f"\n{r2['fleet_counters']}"
-    assert f1.autoscaler.counters == f2.autoscaler.counters, \
-        "autoscaler decisions not deterministic"
-    assert list(f1.shape_log) == list(f2.shape_log), \
-        f"fleet shape history not deterministic:\n{list(f1.shape_log)}" \
-        f"\n{list(f2.shape_log)}"
-    count_keys = ("start_step", "end_step", "submitted", "completed",
-                  "shed", "deadline_misses", "tokens")
-    w1 = [{k: w[k] for k in count_keys} for w in r1["windows"]]
-    w2 = [{k: w[k] for k in count_keys} for w in r2["windows"]]
-    assert w1 == w2, "window count fields not deterministic"
+        # 4. determinism x2 — counters, decisions, window counts
+        assert r1["fleet_counters"] == r2["fleet_counters"], \
+            f"soak fleet counters not deterministic:\n" \
+            f"{r1['fleet_counters']}\n{r2['fleet_counters']}"
+        assert f1.autoscaler.counters == f2.autoscaler.counters, \
+            "autoscaler decisions not deterministic"
+        assert list(f1.shape_log) == list(f2.shape_log), \
+            f"fleet shape history not deterministic:\n" \
+            f"{list(f1.shape_log)}\n{list(f2.shape_log)}"
+        count_keys = ("start_step", "end_step", "submitted", "completed",
+                      "shed", "deadline_misses", "tokens")
+        w1 = [{k: w[k] for k in count_keys} for w in r1["windows"]]
+        w2 = [{k: w[k] for k in count_keys} for w in r2["windows"]]
+        assert w1 == w2, "window count fields not deterministic"
 
-    return {"soak_smoke": True, "kv_format": list(args.kv_format),
+        rss_mb.append(round(_rss_mb(), 1))
+        if rounds > 1:
+            print(f"[soak] round {rnd + 1}/{rounds} ok, "
+                  f"rss {rss_mb[-1]:.0f} MB", flush=True)
+
+    # 5. (--rounds only) hours-equivalent leak gate: once round 1 has
+    # paid the jit warmup, resident memory must plateau — per-round
+    # growth means some store survives its fleet (ISSUE 19 satellite)
+    if rounds > 1:
+        grown = rss_mb[-1] - rss_mb[0]
+        allowed = max(0.3 * rss_mb[0], 200.0)
+        assert grown <= allowed, \
+            f"soak RSS grew {grown:.0f} MB over {rounds} rounds " \
+            f"({rss_mb} MB) — per-round state is leaking"
+
+    return {"soak_smoke": True, "rounds": rounds, "rss_mb": rss_mb,
+            "kv_format": list(args.kv_format),
             "submitted": r1["submitted"], "completed": r1["completed"],
             "shed": r1["shed"],
             "deadline_misses": r1["deadline_misses"],
@@ -1018,6 +1060,12 @@ def main() -> int:
                    help="KV-cache eXmY format (default e5m2)")
     p.add_argument("--sla-ttft-ms", type=float, default=1000.0)
     p.add_argument("--sla-tpot-ms", type=float, default=250.0)
+    p.add_argument("--rounds", type=int, default=1,
+                   help="repeat the --soak-smoke x2 soak N times "
+                        "(fresh fleet, shifted seeds) and gate process "
+                        "RSS flat after the round-1 warmup — the "
+                        "hours-equivalent leak check (slow tier; "
+                        "docs/PERF.md)")
     p.add_argument("--seed", type=int, default=0)
     # the shared --obs-dir/--obs-flight surface (the measured-run
     # artifact bundle; docs/OBSERVABILITY.md)
