@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import io
 import json
 import os
 
@@ -112,22 +113,74 @@ class SessionCapsule:
 
     # -- durable form (drain-to-disk, cross-process migration) ------------
 
+    def _blobs(self) -> dict:
+        """The ONE capsule serialization body (three byte blobs),
+        shared by `to_dir` and the durable-store `to_store` — the bytes
+        on disk are identical either way."""
+        buf = io.BytesIO()
+        np.save(buf, self.pool_pages)
+        pool_blob = buf.getvalue()
+        buf = io.BytesIO()
+        np.save(buf, self.page_digests)
+        return {
+            _CAP_POOL: pool_blob,
+            _CAP_DIGESTS: buf.getvalue(),
+            _CAP_STATE: json.dumps({"state": self.state,
+                                    "seal": self.seal}).encode(),
+        }
+
+    @classmethod
+    def _from_blobs(cls, blobs: dict) -> "SessionCapsule":
+        doc = json.loads(blobs[_CAP_STATE].decode())
+        return cls(state=doc["state"],
+                   pool_pages=np.load(io.BytesIO(blobs[_CAP_POOL])),
+                   page_digests=np.load(io.BytesIO(blobs[_CAP_DIGESTS])),
+                   seal=doc["seal"])
+
     def to_dir(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
-        np.save(os.path.join(path, _CAP_POOL), self.pool_pages)
-        np.save(os.path.join(path, _CAP_DIGESTS), self.page_digests)
-        with open(os.path.join(path, _CAP_STATE), "w") as fh:
-            json.dump({"state": self.state, "seal": self.seal}, fh)
+        for name, blob in self._blobs().items():
+            with open(os.path.join(path, name), "wb") as fh:
+                fh.write(blob)
         return path
 
     @classmethod
     def from_dir(cls, path: str) -> "SessionCapsule":
-        with open(os.path.join(path, _CAP_STATE)) as fh:
-            doc = json.load(fh)
-        return cls(state=doc["state"],
-                   pool_pages=np.load(os.path.join(path, _CAP_POOL)),
-                   page_digests=np.load(os.path.join(path, _CAP_DIGESTS)),
-                   seal=doc["seal"])
+        blobs = {}
+        for name in (_CAP_STATE, _CAP_POOL, _CAP_DIGESTS):
+            with open(os.path.join(path, name), "rb") as fh:
+                blobs[name] = fh.read()
+        return cls._from_blobs(blobs)
+
+    def to_store(self, store, *, step=None, meta=None,
+                 writer=None):
+        """Publish this capsule as ONE sealed generation of a
+        `cpd_tpu.store.DurableStore` (ISSUE 20) — the capsule log's
+        append operation.  Before the store plane, `to_dir` wrote plain
+        files with NO atomicity story at all: a crash mid-write left a
+        torn capsule that `from_dir` would crash on.  A generation is
+        fsynced, sealed, digest-covered and atomic; a torn one lands in
+        quarantine instead of being adopted.  ``meta`` rides the sealed
+        manifest (the fleet records src/dst/step and the parked flag
+        there).  Returns the published `GenerationInfo`."""
+        m = dict(meta or {})
+        m.setdefault("surface", "capsule")
+        m["rid"] = self.rid
+        return store.publish(self._blobs(), step=step, meta=m,
+                             writer=writer)
+
+    @classmethod
+    def from_store(cls, store, token=None) -> "SessionCapsule":
+        """Load a capsule from the newest valid generation (or exact
+        ``token``) of a capsule store.  The store quarantines torn
+        generations during the scan; the capsule seal is verified again
+        by `restore_capsule` — two independent integrity fences."""
+        info = (store.newest_valid() if token is None
+                else store.lookup(token))
+        if info is None:
+            raise FileNotFoundError(
+                f"no valid capsule generation in {store.root}")
+        return cls._from_blobs(store.load(info))
 
 
 def _cfg_fingerprint(cfg) -> dict:

@@ -54,6 +54,28 @@ runs the same recover-and-drain per victim — always leaving at least
 one accepting engine; any shortfall is counted
 (``kill_wave_shortfall``), never silent.
 
+**Durability** (ISSUE 20): pass ``store=`` (a
+`cpd_tpu.store.DurableStore`) and the whole persistence story moves
+onto the crash-consistent store plane.  Engine snapshots publish as
+sealed generations of per-engine sub-stores (``engine<i>``), the
+fleet's own control state (flags, counters, shape log, and the engine
+snapshot tokens of the round) publishes to the ``fleet`` sub-store
+AFTER every engine of the round — so the newest valid ``fleet``
+generation always names a **consistent cut**: a complete snapshot
+round, never a half-written one.  Migrations write through a durable
+**capsule log** (``capsules`` sub-store): the capsule is parked as a
+sealed generation before the destination restore, and a claim record
+is appended once the session lands — park without claim is exactly
+the crash window where an in-memory fleet loses the session.
+`Fleet.cold_restore` rebuilds the whole fleet after total process
+death from that cut: every engine restores bitwise from its named
+generation, placement is rebuilt from the restored in-flight sets,
+and unclaimed parked capsules re-adopt **exactly once** (a parked rid
+already live in a restored snapshot is superseded — claimed, never
+duplicated).  Resumed sessions decode bitwise at (8, 23); the
+store-smoke drill pins restore-vs-uninterrupted byte equality and
+exact counters ×2.
+
 **Elasticity** (ISSUE 17): `spawn_engine` adds capacity mid-run (the
 new engine joins the shared step clock AT the current fleet step) and
 `scale_down` retires it through the SAME drain + capsule-migration
@@ -73,6 +95,7 @@ soak gate pins it ×2).
 
 from __future__ import annotations
 
+import json
 import os
 from collections import deque
 from typing import Optional
@@ -80,8 +103,8 @@ from typing import Optional
 from ..resilience.inject import FLEET_KINDS, FaultPlan
 from ..serve.engine import ResultStore, ServeEngine
 from ..serve.scheduler import FREE, SHED
-from .migrate import can_adopt, extract_capsule, migrate_session, \
-    restore_capsule
+from .migrate import SessionCapsule, can_adopt, extract_capsule, \
+    migrate_session, restore_capsule
 from .prefix import PrefixCache
 
 __all__ = ["Fleet"]
@@ -91,7 +114,17 @@ _FLEET_COUNTERS = ("submitted", "routed", "router_retries", "fleet_shed",
                    "sessions_recovered", "drains",
                    "fleet_faults_unfired", "kill_waves",
                    "kill_wave_shortfall", "engines_spawned",
-                   "engines_retired")
+                   "engines_retired", "capsules_parked",
+                   "capsules_claimed", "cold_restores")
+
+_FLEET_STATE = "fleet.json"
+
+
+def _detuple(x):
+    """JSON round-trips tuples as lists; shape-log entries are tuples
+    (nested, for kill_wave victims) and the ×2 determinism drills
+    compare them structurally — re-tuple on the way back in."""
+    return tuple(_detuple(v) for v in x) if isinstance(x, list) else x
 
 
 class Fleet:
@@ -125,7 +158,14 @@ class Fleet:
     retry_limit : max engines tried per submission (default: all).
     snapshot_every : periodic per-engine snapshot cadence in fleet
         steps (0 = never; then engine kills cannot be recovered).
-    snapshot_dir : directory for ``engine<i>`` snapshot subdirs.
+    snapshot_dir : directory for ``engine<i>`` snapshot subdirs
+        (legacy path — superseded by ``store`` when both are given).
+    store : optional `cpd_tpu.store.DurableStore` — the durable state
+        plane (module docstring "Durability").  Engine snapshots,
+        fleet control state and the migration capsule log all publish
+        through it as sealed, fenced, crash-consistent generations;
+        `Fleet.cold_restore` rebuilds the fleet from it after total
+        process death.  With a store, ``snapshot_dir`` is unnecessary.
     autoscaler : optional `cpd_tpu.fleet.autoscale.Autoscaler` —
         observed once per step (after fleet faults fire), drives
         `spawn_engine` / `scale_down` deterministically.
@@ -140,6 +180,7 @@ class Fleet:
                  retry_limit: Optional[int] = None,
                  snapshot_every: int = 0,
                  snapshot_dir: Optional[str] = None,
+                 store=None,
                  finished_cap: int = 4096,
                  autoscaler=None):
         if n_engines < 1:
@@ -175,12 +216,13 @@ class Fleet:
                     f"{sorted({f.kind for f in other})} — aim engine-"
                     f"clock chaos at individual engines via "
                     f"engine_plans=[...]")
-        if self._kills and (snapshot_every < 1 or not snapshot_dir):
+        if self._kills and (snapshot_every < 1
+                            or not (snapshot_dir or store)):
             raise ValueError(
                 "engine_kill/kill_wave in the fault plan needs "
-                "snapshot_every >= 1 and a snapshot_dir — a kill with "
-                "no snapshot to recover from is a guaranteed silent "
-                "drop, refused up front")
+                "snapshot_every >= 1 and a snapshot_dir or store — a "
+                "kill with no snapshot to recover from is a guaranteed "
+                "silent drop, refused up front")
         self.model = model
         self.params = params
         self._engine_kw = dict(engine_kw or {})
@@ -188,6 +230,13 @@ class Fleet:
         self.retry_limit = retry_limit
         self.snapshot_every = int(snapshot_every)
         self.snapshot_dir = snapshot_dir
+        self.store = store
+        # the fencing epoch comes from the `fleet` sub-store (it gets a
+        # publish every snapshot round, so its epochs see every writer
+        # this fleet tree ever had); a predecessor's stale epoch is
+        # refused at every sub-store from here on
+        self._store_writer = (store.sub("fleet").acquire_writer()
+                              if store is not None else None)
         self.autoscaler = autoscaler
         self.engines = []
         for i in range(n_engines):
@@ -225,11 +274,18 @@ class Fleet:
         # for engine_kill recovery, and without snapshots the log would
         # retain every Request forever
         self._replay_enabled = bool(self.snapshot_every
-                                    and self.snapshot_dir)
+                                    and (self.snapshot_dir
+                                         or self.store is not None))
         self._logs: list = [[] for _ in range(n_engines)]
+        # per-row token of the last snapshot generation published to
+        # the store (rides fleet.json so cold_restore reads a
+        # consistent cut instead of racing newest_valid per engine)
+        self._snap_tokens: list = [None] * n_engines
         if self._replay_enabled:
             for i in range(n_engines):
                 self._snapshot_engine(i)
+            if self.store is not None:
+                self._publish_fleet_state()
 
     @property
     def n_engines(self) -> int:
@@ -347,6 +403,7 @@ class Fleet:
             self.draining[idx] = False
             self.retired[idx] = False
             self._logs[idx] = []
+            self._snap_tokens[idx] = None
         else:
             idx = len(self.engines)
             # rebind-extend, not append: with reuse-first above, these
@@ -358,11 +415,17 @@ class Fleet:
             self.draining = self.draining + [False]
             self.retired = self.retired + [False]
             self._logs = self._logs + [[]]
+            self._snap_tokens = self._snap_tokens + [None]
         self.counters["engines_spawned"] += 1
         self.events.append(("spawn", self.step_index, idx))
         self.shape_log.append(("spawn", self.step_index, idx))
         if self._replay_enabled:
             self._snapshot_engine(idx)
+            if self.store is not None:
+                # the new row must be durably visible NOW: a cold
+                # restore from the previous round's cut would silently
+                # forget the spawn
+                self._publish_fleet_state()
         return idx
 
     def scale_down(self, idx: int) -> dict:
@@ -445,7 +508,9 @@ class Fleet:
         for i, e in enumerate(self.engines):
             if not self.retired[i]:
                 e.step()
-        if self._replay_enabled and (s + 1) % self.snapshot_every == 0:
+        snap_round = (self._replay_enabled
+                      and (s + 1) % self.snapshot_every == 0)
+        if snap_round:
             for i in range(len(self.engines)):
                 if not self.retired[i]:
                     self._snapshot_engine(i)
@@ -455,6 +520,12 @@ class Fleet:
         self.placement = {rid: i for rid, i in self.placement.items()
                           if rid in self.engines[i]._inflight}
         self.step_index += 1
+        if snap_round and self.store is not None:
+            # the control-state generation lands AFTER every engine of
+            # the round: the newest valid fleet.json therefore always
+            # names a COMPLETE snapshot round (the consistent cut
+            # cold_restore rebuilds from)
+            self._publish_fleet_state()
 
     def drained(self) -> bool:
         return all(self.engines[i].drained()
@@ -551,9 +622,44 @@ class Fleet:
             self._kill_engine(v, s)
 
     def _snapshot_engine(self, i: int) -> None:
-        path = os.path.join(self.snapshot_dir, f"engine{i}")
-        self.engines[i].snapshot(path)
+        if self.store is not None:
+            sub = self.store.sub(f"engine{i}")
+            info = self.engines[i].snapshot_store(
+                sub, writer=self._store_writer)
+            self._snap_tokens[i] = list(info.token)
+            # keep=2: the newest fleet.json names tokens at most one
+            # round old (it publishes right after this round), so two
+            # retained generations per engine always cover the cut
+            sub.gc(keep=2)
+        else:
+            path = os.path.join(self.snapshot_dir, f"engine{i}")
+            self.engines[i].snapshot(path)
         self._logs[i] = []
+
+    def _publish_fleet_state(self) -> None:
+        """Publish the fleet's control state as one sealed generation
+        of the ``fleet`` sub-store — everything `cold_restore` needs
+        that is not inside an engine snapshot, including the engine
+        snapshot tokens of the round (the consistent cut)."""
+        doc = {
+            "version": 1,
+            "step_index": self.step_index,
+            "accepting": list(self.accepting),
+            "draining": list(self.draining),
+            "retired": list(self.retired),
+            "counters": dict(self.counters),
+            "retired_counters": dict(self._retired_counters),
+            "shape_log": [list(x) for x in self.shape_log],
+            "snapshot_every": self.snapshot_every,
+            "retry_limit": self.retry_limit,
+            "engine_tokens": list(self._snap_tokens),
+        }
+        sub = self.store.sub("fleet")
+        sub.publish(
+            {_FLEET_STATE: json.dumps(doc, sort_keys=True).encode()},
+            step=self.step_index, meta={"surface": "fleet"},
+            writer=self._store_writer)
+        sub.gc(keep=4)
 
     def _kill_engine(self, idx: int, s: int) -> None:
         """The ``engine_kill`` handler (module docstring): rebuild the
@@ -565,13 +671,18 @@ class Fleet:
         self.counters["engine_kills"] += 1
         self.events.append(("engine_kill", s, idx))
         dead = self.engines[idx]
-        path = os.path.join(self.snapshot_dir, f"engine{idx}")
         # capacity is adopted from the snapshot blob on load; the
         # constructor arg is a placeholder
         cache = (PrefixCache(self._cache_pages or 1)
                  if dead.prefix_cache is not None else None)
-        restored = ServeEngine.restore(self.model, self.params, path,
-                                       prefix_cache=cache)
+        if self.store is not None:
+            restored = ServeEngine.restore_store(
+                self.model, self.params, self.store.sub(f"engine{idx}"),
+                prefix_cache=cache)
+        else:
+            path = os.path.join(self.snapshot_dir, f"engine{idx}")
+            restored = ServeEngine.restore(self.model, self.params,
+                                           path, prefix_cache=cache)
         self.engines[idx] = restored
         log = self._logs[idx]
         for fs in range(restored.step_index, s):
@@ -684,11 +795,213 @@ class Fleet:
                 raise RuntimeError(
                     f"no engine can adopt rid {rid} "
                     f"({len(slot.pages)} pages) right now")
-        capsule = migrate_session(self.engines[src], self.engines[dst],
-                                  rid)
+        if self.store is None:
+            capsule = migrate_session(self.engines[src],
+                                      self.engines[dst], rid)
+        else:
+            capsule = self._migrate_logged(src, dst, rid)
         self._log(src, "extract", rid)
         self._log(dst, "adopt", capsule)
         self.placement[rid] = dst
         self.counters["migrations"] += 1
         self.events.append(("migrate", self.step_index, rid, src, dst))
         return dst
+
+    # -- the durable capsule log (store mode) -----------------------------
+
+    def _cap_store(self):
+        return self.store.sub("capsules")
+
+    def _claim(self, token, engine: int, reason: str) -> None:
+        """Append a claim record for a parked capsule generation —
+        the exactly-once fence: an unclaimed park is precisely the
+        crash window `cold_restore` must repair, a claimed one must
+        never be adopted again."""
+        rec = {"claim": list(token), "engine": int(engine),
+               "reason": reason}
+        self._cap_store().publish(
+            {"claim.json": json.dumps(rec, sort_keys=True).encode()},
+            step=self.step_index,
+            meta={"surface": "claim", "claim": list(token)},
+            writer=self._store_writer)
+        self.counters["capsules_claimed"] += 1
+
+    def _migrate_logged(self, src: int, dst: int, rid: int):
+        """`migrate_session` written through the durable capsule log:
+        park (sealed generation) BEFORE the destination restore, claim
+        AFTER the session lands — a crash anywhere in between leaves a
+        parked-unclaimed generation that `cold_restore` re-adopts
+        instead of a lost session.  The failed-restore path also
+        claims (back onto the source), so the log never double-counts
+        a session that was put back."""
+        s_eng, d_eng = self.engines[src], self.engines[dst]
+        slot = s_eng.slot_of_rid(rid)
+        if slot is None:
+            raise ValueError(f"rid {rid} has no live slot to migrate")
+        if not can_adopt(d_eng, len(slot.pages)):
+            raise RuntimeError(
+                f"destination cannot adopt rid {rid} "
+                f"({len(slot.pages)} pages): no free slot or pages")
+        capsule = extract_capsule(s_eng, rid)
+        info = capsule.to_store(
+            self._cap_store(), step=self.step_index,
+            meta={"parked": True, "src": src, "dst": dst},
+            writer=self._store_writer)
+        self.counters["capsules_parked"] += 1
+        try:
+            restore_capsule(d_eng, capsule)
+        except Exception:
+            restore_capsule(s_eng, capsule)
+            self._claim(info.token, src, "restore-failed")
+            raise
+        self._claim(info.token, dst, "migrated")
+        return capsule
+
+    def park_session(self, rid: int):
+        """Extract ``rid`` into the durable capsule log WITHOUT
+        restoring it anywhere — the deliberate park (drain with no
+        adoptive capacity, operator handoff, pre-shutdown stash).  The
+        session's zero-silent-drops obligation now rides the sealed
+        generation; `adopt_parked` (or the next `cold_restore`)
+        re-adopts it exactly once.  Returns the parked
+        `GenerationInfo`."""
+        if self.store is None:
+            raise RuntimeError("park_session needs a fleet store "
+                               "(construct the Fleet with store=)")
+        src = self.placement.get(rid)
+        if src is None:
+            raise ValueError(f"rid {rid} is not placed on this fleet")
+        capsule = extract_capsule(self.engines[src], rid)
+        self._log(src, "extract", rid)
+        info = capsule.to_store(
+            self._cap_store(), step=self.step_index,
+            meta={"parked": True, "src": src},
+            writer=self._store_writer)
+        self.counters["capsules_parked"] += 1
+        self.placement.pop(rid, None)
+        self.events.append(("park", self.step_index, rid, src))
+        return info
+
+    def parked_unclaimed(self) -> list:
+        """Parked capsule generations with no claim record, oldest
+        first (adoption order is deterministic).  Torn log entries are
+        quarantined by the scan, never misread."""
+        claimed, parked = set(), []
+        for info in self._cap_store().valid_generations():
+            meta = info.meta
+            if meta.get("claim"):
+                claimed.add(tuple(meta["claim"]))
+            elif meta.get("parked"):
+                parked.append(info)
+        return [i for i in sorted(parked, key=lambda g: g.token)
+                if i.token not in claimed]
+
+    def adopt_parked(self) -> list:
+        """Re-adopt every unclaimed parked capsule an engine can take
+        right now, exactly once each (a claim record lands per
+        adoption).  A parked rid already live somewhere — the park's
+        extraction happened AFTER the snapshot cut a cold restore
+        rewound to — is superseded: claimed without adoption, because
+        the in-engine copy IS the consistent one.  Capsules nobody can
+        hold yet stay parked for the next call.  Returns adopted
+        rids."""
+        adopted = []
+        for info in self.parked_unclaimed():
+            capsule = SessionCapsule.from_store(self._cap_store(),
+                                                token=info.token)
+            rid = capsule.rid
+            if any(rid in self.engines[i]._inflight
+                   for i in self.live_engines()):
+                self._claim(info.token, -1, "superseded")
+                self.events.append(("park_superseded", self.step_index,
+                                    rid))
+                continue
+            dst = self._adopt_target(capsule.n_pages)
+            if dst is None:
+                self.events.append(("park_stayed", self.step_index,
+                                    rid))
+                continue
+            restore_capsule(self.engines[dst], capsule)
+            self._log(dst, "adopt", capsule)
+            self.placement[rid] = dst
+            self._claim(info.token, dst, "adopted")
+            self.events.append(("adopt_parked", self.step_index, rid,
+                                dst))
+            adopted.append(rid)
+        return adopted
+
+    # -- whole-fleet cold restore (store mode) ----------------------------
+
+    @classmethod
+    def cold_restore(cls, model, params, store, *,
+                     engine_kw: Optional[dict] = None,
+                     prefix_cache_pages: Optional[int] = None,
+                     retry_limit: Optional[int] = None,
+                     finished_cap: int = 4096,
+                     autoscaler=None) -> "Fleet":
+        """Rebuild a whole fleet after TOTAL process death from its
+        durable store (module docstring "Durability").  The newest
+        valid ``fleet`` generation names the engine snapshot tokens of
+        the last COMPLETE round (the consistent cut — it publishes
+        only after every engine of the round); each engine restores
+        bitwise from its named generation, placement rebuilds from the
+        restored in-flight sets, and unclaimed parked capsules
+        re-adopt exactly once.  Resumed sessions decode bitwise at
+        (8, 23).  A fresh writer epoch is acquired, so the dead
+        fleet's writer is fenced from here on."""
+        fleet_store = store.sub("fleet")
+        info = fleet_store.newest_valid()
+        if info is None:
+            raise FileNotFoundError(
+                f"no valid fleet state generation under "
+                f"{fleet_store.root} — nothing to cold-restore")
+        doc = json.loads(fleet_store.read(info, _FLEET_STATE).decode())
+        n = len(doc["accepting"])
+        self = cls.__new__(cls)
+        self.model = model
+        self.params = params
+        self._engine_kw = dict(engine_kw or {})
+        self._cache_pages = prefix_cache_pages
+        self.retry_limit = (retry_limit if retry_limit is not None
+                            else doc.get("retry_limit"))
+        self.snapshot_every = int(doc["snapshot_every"])
+        self.snapshot_dir = None
+        self.store = store
+        self._store_writer = fleet_store.acquire_writer()
+        self.autoscaler = autoscaler
+        self._kills = []
+        self.engines = []
+        for i in range(n):
+            cache = (PrefixCache(prefix_cache_pages)
+                     if prefix_cache_pages is not None else None)
+            tok = doc["engine_tokens"][i]
+            self.engines.append(ServeEngine.restore_store(
+                model, params, store.sub(f"engine{i}"),
+                prefix_cache=cache,
+                token=tuple(tok) if tok else None))
+        self.accepting = [bool(a) for a in doc["accepting"]]
+        self.draining = [bool(d) for d in doc["draining"]]
+        self.retired = [bool(r) for r in doc["retired"]]
+        self.shed = ResultStore(finished_cap)
+        self.counters = {k: 0 for k in _FLEET_COUNTERS}
+        self.counters.update(
+            {k: int(v) for k, v in doc["counters"].items()})
+        self.events = deque(maxlen=8 * finished_cap)
+        self.shape_log = deque((_detuple(e) for e in doc["shape_log"]),
+                               maxlen=256)
+        self._retired_counters = {
+            k: int(v) for k, v in doc["retired_counters"].items()}
+        self.step_index = int(doc["step_index"])
+        self._replay_enabled = bool(self.snapshot_every)
+        self._logs = [[] for _ in range(n)]
+        self._snap_tokens = [list(t) if t else None
+                             for t in doc["engine_tokens"]]
+        self.placement = {}
+        for i in self.live_engines():
+            for rid in sorted(self.engines[i]._inflight):
+                self.placement[rid] = i
+        self.counters["cold_restores"] += 1
+        self.events.append(("cold_restore", self.step_index, n))
+        self.shape_log.append(("cold_restore", self.step_index, n))
+        self.adopt_parked()
+        return self

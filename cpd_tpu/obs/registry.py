@@ -276,6 +276,23 @@ class MetricsRegistry:
         for s, total in sorted(shard_totals.items()):
             self.set_gauge("cpd_fleet_kv_shard_bytes", total, shard=s)
 
+    def absorb_store_counters(self, store) -> None:
+        """A `cpd_tpu.store.DurableStore` — the ``cpd_store_*`` family
+        (ISSUE 20): the store tree's shared counters (publishes,
+        retries, transient I/O errors, backoff steps, quarantines,
+        tmp sweeps, GC collections, restores, fence refusals, fired
+        storage chaos, read-time digest rejects) mirrored unlabelled,
+        plus live gauges for the quarantine depth and the number of
+        published generations under the root.  Sub-stores share one
+        counter plane, so absorbing the root covers every surface
+        riding it — docs/OBSERVABILITY.md lists the rows."""
+        for key, value in store.counters.items():
+            self.mirror(f"cpd_store_{key}", float(value))
+        self.set_gauge("cpd_store_quarantine_depth",
+                       float(len(store.quarantined())))
+        self.set_gauge("cpd_store_generations",
+                       float(len(store.generations())))
+
     def absorb_elastic(self, supervisor) -> None:
         """A `cpd_tpu.resilience.ElasticSupervisor` — the
         ``cpd_elastic_*`` family (ISSUE 19): the recovery-ladder

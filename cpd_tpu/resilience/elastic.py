@@ -561,6 +561,12 @@ def run_elastic(build_world: Callable, state, next_batch: Callable,
         new_state = res.state
         if b.get("relayout") is not None:
             new_state = b["relayout"](new_state)
+        if getattr(manager, "store", None) is not None:
+            # store-backed: the recovered run is a NEW writer — take a
+            # fresh fencing epoch so the pre-death writer (possibly
+            # still mid-publish somewhere) can never out-name or
+            # clobber the post-recovery checkpoints
+            manager.refence()
         meter.bump("restores")
         events.append((tag, step, supervisor.world,
                        supervisor.active_hosts()))

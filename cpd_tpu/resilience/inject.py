@@ -98,6 +98,21 @@ path, which do their own one-shot + unfired accounting):
   <= the supervisor's ``max_retries``, escalated to a drain+shrink
   otherwise.
 
+A seventh executor consumes the storage-chaos kinds (``STORE_KINDS``,
+ISSUE 20 — on the `cpd_tpu.store.DurableStore` PUBLISH clock, consumed
+by any store built with ``fault_plan=``, which owns their one-shot +
+unfired accounting):
+
+* ``store_eio@s:n`` / ``store_enospc@s:n`` — transient EIO / ENOSPC
+  instead of the nth write-class I/O op of publish number ``s``,
+  absorbed by the store's deterministic retry-with-backoff.
+* ``store_torn@s:k`` / ``store_flip@s:k`` — the generation publish
+  ``s`` sealed is truncated at byte ``k`` / byte-flipped at offset
+  ``k`` (-1 -> the legacy half-size / midpoint defaults), through the
+  same `store.faultfs.corrupt_file` body as ``ckpt_truncate`` /
+  ``ckpt_bitflip``; detected by the manifest digests, quarantined,
+  never adopted.
+
 ``step`` convention: the 0-based optimizer-UPDATE index — one clock for
 both executors, so ``grad_nan@3`` and ``stall@3`` hit the same physical
 step in every entry point (run_guarded and both trainer CLIs).  The
@@ -121,7 +136,7 @@ import numpy as np
 __all__ = ["FaultSpec", "FaultPlan", "Injector", "InjectedPreemption",
            "with_fault_injection", "report_unfired", "GRAD_KINDS",
            "HOST_KINDS", "WIRE_KINDS", "SAT_KINDS", "KV_KINDS",
-           "SERVE_KINDS", "FLEET_KINDS", "ELASTIC_KINDS",
+           "SERVE_KINDS", "FLEET_KINDS", "ELASTIC_KINDS", "STORE_KINDS",
            "SAT_PRESSURE_DEFAULT_EXP"]
 
 # jit-level kinds -> corruption opcode in the compiled fault table
@@ -184,6 +199,24 @@ FLEET_KINDS = frozenset({"engine_kill", "kill_wave"})
 # these kinds in any run without an elastic consumer
 # (``host_armed=False``, the default).
 ELASTIC_KINDS = frozenset({"host_kill", "straggler", "link_flaky"})
+# storage-chaos kinds (ISSUE 20), on the DurableStore's own PUBLISH
+# clock (`cpd_tpu.store` counts publish calls across the whole store
+# tree): ``store_eio@s:n`` / ``store_enospc@s:n`` raise a transient
+# EIO / ENOSPC instead of executing the nth write-class I/O op of
+# publish number ``s`` (one-shot — the store's deterministic
+# retry-with-backoff must absorb it), ``store_torn@s:k`` truncates the
+# largest artifact of the generation publish ``s`` sealed at byte ``k``
+# (-1 -> the legacy half-size cut) and ``store_flip@s:k`` XOR-flips its
+# byte ``k`` (-1 -> midpoint) — both through the SAME `corrupt_file`
+# body as the legacy ``ckpt_truncate`` / ``ckpt_bitflip`` one-shots
+# below, so the old checkpoint drills and the new storage drills share
+# one injection body.  Only a `DurableStore` built with
+# ``fault_plan=`` consumes these (it owns their one-shot + unfired
+# accounting, `DurableStore.report_unfired`); in any run without a
+# store attached they can never fire and `report_unfired` flags them
+# unless ``store_armed=True``.
+STORE_KINDS = frozenset({"store_torn", "store_flip", "store_eio",
+                         "store_enospc"})
 # host-level kinds, executed by the Injector around the step call
 HOST_KINDS = frozenset({
     "batch_nan",       # poison one element of the first float batch leaf
@@ -198,7 +231,7 @@ HOST_KINDS = frozenset({
 })
 _ALL_KINDS = (frozenset(GRAD_KINDS) | HOST_KINDS | frozenset(WIRE_KINDS)
               | SAT_KINDS | KV_KINDS | SERVE_KINDS | FLEET_KINDS
-              | ELASTIC_KINDS)
+              | ELASTIC_KINDS | STORE_KINDS)
 
 
 class InjectedPreemption(BaseException):
@@ -353,6 +386,15 @@ class FaultPlan:
         ``--elastic`` path), which owns their one-shot and unfired
         accounting."""
         return tuple(f for f in self.faults if f.kind in ELASTIC_KINDS)
+
+    def store_faults(self) -> tuple:
+        """The storage-chaos specs (`STORE_KINDS`):
+        ``store_eio@s:n`` / ``store_enospc@s:n`` /
+        ``store_torn@s:k`` / ``store_flip@s:k``, all on the
+        `cpd_tpu.store.DurableStore` publish clock — consumed by a
+        store built with ``fault_plan=``, which owns their one-shot and
+        unfired accounting (`DurableStore.report_unfired`)."""
+        return tuple(f for f in self.faults if f.kind in STORE_KINDS)
 
     def host_faults(self) -> dict:
         """step -> [FaultSpec] for the host-level kinds."""
@@ -629,7 +671,16 @@ class Injector:
         f = self._take(step, ("ckpt_truncate", "ckpt_bitflip"))
         if f is None:
             return False
+        # ONE injection body for old and new storage drills (ISSUE 20):
+        # the byte-level damage is `cpd_tpu.store.faultfs.corrupt_file`,
+        # exactly what the `store_torn` / `store_flip` kinds use.
+        from ..store.faultfs import corrupt_file
         step_dir = os.path.join(directory, str(step))
+        if not os.path.isdir(step_dir):
+            # a store-backed CheckpointManager keeps no per-step dir:
+            # its checkpoints are DurableStore generations.  Aim at the
+            # generation whose sealed manifest records this step.
+            step_dir = self._store_generation_dir(directory, step)
         victim, size = None, -1
         for root, _, files in os.walk(step_dir):
             for name in sorted(files):
@@ -642,15 +693,27 @@ class Injector:
                 f"{f.kind} fault at step {step}: no checkpoint files "
                 f"under {step_dir}")
         if f.kind == "ckpt_truncate":
-            with open(victim, "r+b") as fh:
-                fh.truncate(max(size // 2, 1))
+            corrupt_file(victim, torn_at=-1)
         else:
-            with open(victim, "r+b") as fh:
-                fh.seek(size // 2)
-                byte = fh.read(1)
-                fh.seek(size // 2)
-                fh.write(bytes([byte[0] ^ 0xFF]))
+            corrupt_file(victim, flip_at=-1)
         return True
+
+    @staticmethod
+    def _store_generation_dir(directory: str, step: int) -> str:
+        """The ``gen-*`` directory of a `DurableStore`-backed checkpoint
+        root whose manifest records ``step`` (newest first)."""
+        best = os.path.join(directory, str(step))   # reported on miss
+        for name in sorted(os.listdir(directory), reverse=True):
+            if not name.startswith("gen-"):
+                continue
+            mpath = os.path.join(directory, name, "MANIFEST.json")
+            try:
+                with open(mpath) as fh:
+                    if json.load(fh).get("step") == step:
+                        return os.path.join(directory, name)
+            except (OSError, ValueError):
+                continue
+        return best
 
 
 def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
@@ -660,7 +723,8 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
                    kv_armed: bool = False,
                    serve_armed: bool = False,
                    fleet_armed: bool = False,
-                   host_armed: bool = False) -> list:
+                   host_armed: bool = False,
+                   store_armed: bool = False) -> list:
     """The ONE end-of-run check every loop calls: which planned faults
     never fired?  A chaos run that silently skipped a fault proves
     nothing — the usual causes are a plan step beyond the run's
@@ -694,7 +758,13 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
     elastic consumer (`resilience.elastic.run_elastic`, or a trainer
     run with ``--elastic``) executes them and owns their one-shot +
     unfired accounting, so in a non-elastic run — the default — they
-    can never fire and are flagged here.
+    can never fire and are flagged here.  ``store_armed`` covers
+    `STORE_KINDS` (``store_torn``/``store_flip``/``store_eio``/
+    ``store_enospc``, ISSUE 20): only a `cpd_tpu.store.DurableStore`
+    built with ``fault_plan=`` consumes them (its own
+    `DurableStore.report_unfired` owns the armed direction — a spec
+    aimed at a publish number the run never reached stays pending
+    there), so in any run without a store attached they are flagged.
     Bumps the meter's ``faults_unfired`` counter and warns on rank 0;
     returns the sorted leftover list (empty = every planned fault
     fired)."""
@@ -705,9 +775,11 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
               + injector.plan.sat_faults() + injector.plan.kv_faults()
               + injector.plan.serve_faults()
               + injector.plan.fleet_faults()
-              + injector.plan.elastic_faults()):
+              + injector.plan.elastic_faults()
+              + injector.plan.store_faults()):
         if f.kind in KV_KINDS or f.kind in SERVE_KINDS \
-                or f.kind in FLEET_KINDS or f.kind in ELASTIC_KINDS:
+                or f.kind in FLEET_KINDS or f.kind in ELASTIC_KINDS \
+                or f.kind in STORE_KINDS:
             # engine/fleet/elastic-consumer kinds: the training
             # ``n_steps`` budget says nothing about them.  Unarmed ->
             # can never fire, flagged; armed -> the consumer's own
@@ -715,6 +787,7 @@ def report_unfired(injector: Optional["Injector"], *, n_steps: Optional[int]
             armed = (kv_armed if f.kind in KV_KINDS
                      else serve_armed if f.kind in SERVE_KINDS
                      else fleet_armed if f.kind in FLEET_KINDS
+                     else store_armed if f.kind in STORE_KINDS
                      else host_armed)
             if not armed:
                 leftover.append(f)

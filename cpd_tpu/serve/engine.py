@@ -85,6 +85,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import io
 import json
 import os
 import shutil
@@ -1010,10 +1011,37 @@ class ServeEngine:
         if os.path.isdir(tmp_dir):
             shutil.rmtree(tmp_dir)
         os.makedirs(tmp_dir)
-        np.save(os.path.join(tmp_dir, _SNAP_POOL),
-                np.asarray(self._pool))
-        np.save(os.path.join(tmp_dir, _SNAP_DIGESTS),
-                np.asarray(self._digests))
+        blobs = self._snapshot_blobs()
+        for name in (_SNAP_POOL, _SNAP_DIGESTS, _SNAP_STATE):
+            with open(os.path.join(tmp_dir, name), "wb") as fh:
+                fh.write(blobs[name])
+        # the digest covers every data file; meta.json itself is
+        # excluded (it cannot contain its own hash)
+        record = checkpoint_digest(tmp_dir, exclude=(_SNAP_META,))
+        with open(os.path.join(tmp_dir, _SNAP_META), "w") as fh:
+            json.dump({"integrity": record}, fh)
+        # the swap: retire the previous snapshot to .old, promote the
+        # complete tmp dir, then drop .old — the only window without a
+        # snapshot at `path` leaves the previous one intact at .old
+        old_dir = path.rstrip(os.sep) + ".old"
+        if os.path.isdir(path):
+            shutil.rmtree(old_dir, ignore_errors=True)
+            os.rename(path, old_dir)
+        os.rename(tmp_dir, path)
+        shutil.rmtree(old_dir, ignore_errors=True)
+        if self.flight is not None:
+            # the pre-crash flight ring rides NEXT TO the snapshot (its
+            # own configured path — outside the digest-sealed dir, so
+            # restore verification is unaffected)
+            self.flight.dump("snapshot")
+        return record
+
+    def _snapshot_blobs(self) -> dict:
+        """The ONE snapshot serialization body: the full engine state as
+        three byte blobs (``pool.npy`` / ``digests.npy`` /
+        ``state.json``), shared verbatim by the legacy directory
+        `snapshot` and the durable-store `snapshot_store` — store-on
+        and store-off snapshots are byte-identical by construction."""
         state = {
             "version": 1,
             "init": dict(self._init_kw),
@@ -1042,28 +1070,49 @@ class ServeEngine:
             "prefix_cache": (self.prefix_cache.state_dict()
                              if self.prefix_cache is not None else None),
         }
-        with open(os.path.join(tmp_dir, _SNAP_STATE), "w") as fh:
-            json.dump(state, fh, default=_json_default)
-        # the digest covers every data file; meta.json itself is
-        # excluded (it cannot contain its own hash)
-        record = checkpoint_digest(tmp_dir, exclude=(_SNAP_META,))
-        with open(os.path.join(tmp_dir, _SNAP_META), "w") as fh:
-            json.dump({"integrity": record}, fh)
-        # the swap: retire the previous snapshot to .old, promote the
-        # complete tmp dir, then drop .old — the only window without a
-        # snapshot at `path` leaves the previous one intact at .old
-        old_dir = path.rstrip(os.sep) + ".old"
-        if os.path.isdir(path):
-            shutil.rmtree(old_dir, ignore_errors=True)
-            os.rename(path, old_dir)
-        os.rename(tmp_dir, path)
-        shutil.rmtree(old_dir, ignore_errors=True)
+        json_blob = json.dumps(state, default=_json_default).encode()
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(self._pool))
+        pool_blob = buf.getvalue()
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(self._digests))
+        return {_SNAP_POOL: pool_blob, _SNAP_DIGESTS: buf.getvalue(),
+                _SNAP_STATE: json_blob}
+
+    def snapshot_store(self, store, *, writer=None):
+        """Publish the snapshot as ONE sealed generation of a
+        `cpd_tpu.store.DurableStore` (ISSUE 20): same three blobs as
+        `snapshot`, but the atomicity story is the store's — fsynced
+        artifacts, sealed manifest with per-artifact digests, atomic
+        rename, writer fencing, quarantine on corruption — instead of
+        the hand-rolled ``.tmp``/``.old`` dance.  Returns the published
+        `GenerationInfo`."""
+        info = store.publish(self._snapshot_blobs(),
+                             step=int(self.step_index),
+                             meta={"surface": "engine"}, writer=writer)
         if self.flight is not None:
-            # the pre-crash flight ring rides NEXT TO the snapshot (its
-            # own configured path — outside the digest-sealed dir, so
-            # restore verification is unaffected)
             self.flight.dump("snapshot")
-        return record
+        return info
+
+    @classmethod
+    def restore_store(cls, model, params, store, prefix_cache=None,
+                      token=None) -> "ServeEngine":
+        """Rebuild an engine from the newest VALID generation of a
+        durable store (or the exact ``token``).  Corrupt generations
+        are quarantined by the store's scan and the next-newest valid
+        one restores instead — the store-plane version of `restore`'s
+        swap-window recovery, with the same bitwise (8,23) resume."""
+        info = (store.newest_valid() if token is None
+                else store.lookup(token))
+        if info is None:
+            raise FileNotFoundError(
+                f"no valid engine snapshot generation in {store.root}")
+        blobs = store.load(info)
+        state = json.loads(blobs[_SNAP_STATE].decode())
+        pool = np.load(io.BytesIO(blobs[_SNAP_POOL]))
+        digests = np.load(io.BytesIO(blobs[_SNAP_DIGESTS]))
+        return cls._rebuild(model, params, state, pool, digests,
+                            prefix_cache)
 
     @classmethod
     def restore(cls, model, params, path: str,
@@ -1084,7 +1133,6 @@ class ServeEngine:
         then ``path.old`` (the retired previous snapshot) — so the
         automated snapshot-to-one-path crash-recovery loop restores
         without operator surgery whatever instant the save died."""
-        from ..resilience.inject import FaultSpec
         from ..train.checkpoint import checkpoint_digest
 
         base = path.rstrip(os.sep)
@@ -1107,12 +1155,24 @@ class ServeEngine:
                 "corrupted snapshot")
         with open(os.path.join(path, _SNAP_STATE)) as fh:
             state = json.load(fh)
+        pool = np.load(os.path.join(path, _SNAP_POOL))
+        digests = np.load(os.path.join(path, _SNAP_DIGESTS))
+        return cls._rebuild(model, params, state, pool, digests,
+                            prefix_cache)
+
+    @classmethod
+    def _rebuild(cls, model, params, state: dict, pool, digests,
+                 prefix_cache) -> "ServeEngine":
+        """The ONE snapshot-rebuild body (state dict + pool/digest
+        arrays -> live engine), shared by the directory `restore` and
+        the durable-store `restore_store`."""
+        from ..resilience.inject import FaultSpec
+
         init = dict(state["init"])
         init["kv_format"] = tuple(init["kv_format"])
         eng = cls(model, params, **init)
-        eng._pool = jnp.asarray(np.load(os.path.join(path, _SNAP_POOL)))
-        eng._digests = jnp.asarray(np.load(os.path.join(path,
-                                                        _SNAP_DIGESTS)))
+        eng._pool = jnp.asarray(pool)
+        eng._digests = jnp.asarray(digests)
         eng.step_index = int(state["step_index"])
         eng.counters = {k: int(v) for k, v in state["counters"].items()}
         eng.events = deque(((k, r, st, w) for k, r, st, w

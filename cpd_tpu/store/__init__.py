@@ -1,0 +1,24 @@
+"""cpd_tpu.store — the durable state plane (ISSUE 20).
+
+One crash-consistent `DurableStore` that the three persistence
+surfaces (trainer checkpoints, `ServeEngine` snapshots, migration
+capsules) publish through, one `FaultFS` boundary that storage chaos
+(`store_torn` / `store_flip` / `store_eio` / `store_enospc`) enters
+through, and one shared `corrupt_file` body behind both the legacy
+checkpoint drills and the new storage kinds.
+
+Pure stdlib on purpose: the crash matrix (tools/bench_store.py
+``--crash-matrix``) forks a subprocess per write-boundary stratum and
+must not pay a jax import for each.
+"""
+
+from .durable import (DurableStore, FencedWriterError, GenerationInfo,
+                      MANIFEST, QUARANTINE, STORE_COUNTERS)
+from .faultfs import (CRASH_EXIT, FaultFS, TRANSIENT_ERRNOS, WRITE_OPS,
+                      corrupt_file)
+
+__all__ = [
+    "DurableStore", "FencedWriterError", "GenerationInfo", "MANIFEST",
+    "QUARANTINE", "STORE_COUNTERS", "CRASH_EXIT", "FaultFS",
+    "TRANSIENT_ERRNOS", "WRITE_OPS", "corrupt_file",
+]

@@ -1,0 +1,519 @@
+"""DurableStore — the one crash-consistent persistence plane.
+
+Before this layer, the repo had THREE hand-rolled persistence paths
+with three different atomicity stories: orbax's tmp-then-rename for
+trainer checkpoints (plus a non-fsynced metadata sidecar), the
+``.tmp``/``.old`` directory dance of `ServeEngine.snapshot`, and
+`SessionCapsule.to_dir`'s plain writes (no atomicity at all).  Every
+drilled defense — rollback, engine restore, session migration —
+bottomed out in a filesystem write nothing ever attacked.  This module
+is the single answer all three surfaces migrate onto.
+
+A store is a directory of immutable **generations**.  One publish is::
+
+    .tmp-gen-E-S/           mkdir
+      <artifact>            write + fsync, one pair per artifact
+      MANIFEST.json         write + fsync  (sealed; per-artifact sha256)
+    fsync(.tmp-gen-E-S)     pin the directory entries
+    rename -> gen-E-S       the commit point (atomic on POSIX)
+    fsync(root)             pin the rename
+
+Every one of those steps goes through `cpd_tpu.store.faultfs.FaultFS`,
+so a crash (or injected EIO/ENOSPC) at ANY boundary leaves either the
+fully sealed new generation or no trace of it — the crash matrix in
+tools/bench_store.py kills a subprocess at every op and proves restore
+always lands on a sealed, digest-valid generation.
+
+Contracts:
+
+* **Writer fencing** — generations are named by a monotonic
+  ``(epoch, seq)`` token.  `acquire_writer` hands out ``max epoch + 1``;
+  a publish from epoch *e* is refused (`FencedWriterError`) once any
+  generation — valid, quarantined, or half-written — carries an epoch
+  ``> e``.  A stale elastic-restart writer therefore cannot clobber or
+  out-name the successor that replaced it.
+* **Deterministic retry** — transient ``EIO`` / ``ENOSPC`` during a
+  publish is retried up to ``retries`` times with an exponential
+  *step-clock* backoff (counted in ``backoff_steps``, never slept:
+  wall-clock sleeps are banned host-side, and the drills must be
+  bitwise reproducible).  Non-transient ``OSError`` propagates at once.
+* **Quarantine** — a generation that fails validation (torn artifact,
+  flipped byte, unparsable or unsealed manifest, missing/extra file)
+  is renamed into ``_quarantine/`` and counted.  Never silently
+  deleted (it is evidence), never adopted (nothing reads quarantine).
+* **Retention GC** — `gc(keep)` deletes only VALID generations beyond
+  the ``keep`` newest and by construction can never touch the newest
+  valid one (``keep >= 1`` is enforced; invalid generations met along
+  the way are quarantined, not collected).
+
+Chaos enters through the `FaultPlan` grammar (STORE_KINDS in
+resilience/inject.py): ``store_eio@s:n`` / ``store_enospc@s:n`` fire on
+the nth write op of publish number *s* (the store's own publish clock),
+``store_torn@s:k`` / ``store_flip@s:k`` corrupt the generation publish
+*s* sealed, at byte *k* — through the same `corrupt_file` body the
+legacy checkpoint drills use.  `report_unfired` keeps the run honest in
+both directions, exactly like every other fault family.
+
+This module is deliberately pure stdlib (no numpy/jax) so the crash
+matrix can fork subprocesses in ~0.1 s.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .faultfs import FaultFS, TRANSIENT_ERRNOS, corrupt_file
+
+MANIFEST = "MANIFEST.json"
+QUARANTINE = "_quarantine"
+
+_GEN_RE = re.compile(r"^gen-(\d{8})-(\d{8})$")
+_TMP_PREFIX = ".tmp-gen-"
+
+# counter names, one spelling (mirrored by MetricsRegistry as
+# ``cpd_store_*`` — see obs/registry.py `absorb_store_counters`)
+STORE_COUNTERS = (
+    "publishes", "publish_retries", "io_errors", "backoff_steps",
+    "quarantined", "tmp_swept", "gc_collected", "restores",
+    "fence_refusals", "torn_fired", "flip_fired", "eio_fired",
+    "enospc_fired", "read_rejects",
+)
+
+
+class FencedWriterError(RuntimeError):
+    """A stale writer (older epoch) tried to publish after a newer
+    writer's generation appeared — refused, never clobbered."""
+
+
+@dataclass
+class GenerationInfo:
+    """One generation directory, parsed from its name.  ``manifest`` is
+    populated once the generation has been validated."""
+    epoch: int
+    seq: int
+    path: str
+    manifest: Optional[dict] = field(default=None, repr=False)
+
+    @property
+    def token(self):
+        return (self.epoch, self.seq)
+
+    @property
+    def name(self) -> str:
+        return os.path.basename(self.path)
+
+    @property
+    def step(self):
+        return None if self.manifest is None else self.manifest.get("step")
+
+    @property
+    def meta(self) -> dict:
+        return {} if self.manifest is None else dict(
+            self.manifest.get("meta") or {})
+
+
+def _seal(body: dict) -> str:
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _check_artifact_name(name: str) -> str:
+    if (not name or name == MANIFEST or name.startswith(".")
+            or os.sep in name or "/" in name):
+        raise ValueError(f"DurableStore: bad artifact name {name!r}")
+    return name
+
+
+class DurableStore:
+    """Crash-consistent generation store rooted at ``root``.
+
+    Args:
+        root: directory holding ``gen-*`` generations (created if
+            absent).  Sub-stores (`sub`) nest their roots inside it.
+        fs: the `FaultFS` boundary; one is created if not given.  All
+            sub-stores share it (one op clock per store tree).
+        retries: max transient-error retries per publish.
+        backoff_base: first retry's step-clock backoff; doubles per
+            attempt (pure accounting — nothing sleeps).
+        fault_plan: optional `resilience.inject.FaultPlan` (duck-typed:
+            anything with ``store_faults()``); its STORE_KINDS specs
+            arm this store tree's chaos.
+    """
+
+    def __init__(self, root: str, *, fs: Optional[FaultFS] = None,
+                 retries: int = 3, backoff_base: int = 1,
+                 fault_plan=None, _shared=None):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        if _shared is not None:
+            # a sub-store: one fs / counters / clock / pending-fault
+            # pool for the whole tree, so chaos and accounting span
+            # every surface that hangs off the parent
+            self.fs, self.counters, self._clock, self._pending = _shared
+            self.retries = retries
+            self.backoff_base = backoff_base
+            return
+        self.fs = fs if fs is not None else FaultFS()
+        self.retries = int(retries)
+        self.backoff_base = int(backoff_base)
+        self.counters: Dict[str, int] = {k: 0 for k in STORE_COUNTERS}
+        self._clock = {"publish_calls": 0}
+        self._pending: list = []
+        if fault_plan is not None:
+            self._pending.extend(fault_plan.store_faults())
+
+    # -- tree --------------------------------------------------------------
+
+    def sub(self, name: str) -> "DurableStore":
+        """A nested store at ``root/name`` sharing this tree's FaultFS,
+        counters, publish clock and pending chaos — one accounting
+        plane however many surfaces ride it."""
+        if _GEN_RE.match(name) or name in (QUARANTINE,) or "/" in name \
+                or os.sep in name or name.startswith("."):
+            raise ValueError(f"DurableStore.sub: bad surface name {name!r}")
+        return DurableStore(
+            os.path.join(self.root, name), retries=self.retries,
+            backoff_base=self.backoff_base,
+            _shared=(self.fs, self.counters, self._clock, self._pending))
+
+    # -- listing -----------------------------------------------------------
+
+    def _entries(self, sub: str = "") -> list:
+        path = os.path.join(self.root, sub) if sub else self.root
+        if not os.path.isdir(path):
+            return []
+        return self.fs.listdir(path)
+
+    def generations(self) -> List[GenerationInfo]:
+        """All published generations, newest token first (validity
+        unknown until `validate`)."""
+        out = []
+        for name in self._entries():
+            m = _GEN_RE.match(name)
+            if m:
+                out.append(GenerationInfo(int(m.group(1)), int(m.group(2)),
+                                          os.path.join(self.root, name)))
+        return sorted(out, key=lambda g: g.token, reverse=True)
+
+    def _max_token(self):
+        """Highest (epoch, seq) visible anywhere — published,
+        quarantined, or a crash-leftover tmp dir.  Fencing and epoch
+        allocation must see them all: a quarantined epoch-9 generation
+        still proves an epoch-9 writer existed."""
+        toks = [g.token for g in self.generations()]
+        for name in self._entries(QUARANTINE):
+            stem = name.split(".quarantined")[0]
+            if stem.startswith(_TMP_PREFIX):
+                stem = "gen-" + stem[len(_TMP_PREFIX):]
+            m = _GEN_RE.match(stem)
+            if m:
+                toks.append((int(m.group(1)), int(m.group(2))))
+        for name in self._entries():
+            if name.startswith(_TMP_PREFIX):
+                m = _GEN_RE.match("gen-" + name[len(_TMP_PREFIX):])
+                if m:
+                    toks.append((int(m.group(1)), int(m.group(2))))
+        return max(toks) if toks else None
+
+    # -- fencing -----------------------------------------------------------
+
+    def acquire_writer(self) -> int:
+        """Claim the next writer epoch (monotonic over everything this
+        store has ever seen).  Hold it for the process lifetime; pass
+        it to every `publish`."""
+        top = self._max_token()
+        return (top[0] if top else 0) + 1
+
+    # -- publish -----------------------------------------------------------
+
+    def publish(self, artifacts: Dict[str, bytes], *, step=None,
+                meta: Optional[dict] = None,
+                writer: Optional[int] = None) -> GenerationInfo:
+        """Atomically publish one generation of ``artifacts`` (flat
+        name → bytes).  Returns its `GenerationInfo` (manifest loaded).
+
+        ``writer`` is a fencing epoch from `acquire_writer`; omitted,
+        the publish runs as a one-shot writer (fresh epoch, cannot be
+        fenced).  ``step`` and ``meta`` ride the sealed manifest.
+        """
+        for name in artifacts:
+            _check_artifact_name(name)
+        clock = self._clock["publish_calls"]
+        self._clock["publish_calls"] += 1
+
+        if writer is None:
+            top = self._max_token()
+            epoch, seq = ((top[0] if top else 0) + 1, 0)
+        else:
+            epoch = int(writer)
+            top = self._max_token()
+            if top is not None and top[0] > epoch:
+                self.counters["fence_refusals"] += 1
+                raise FencedWriterError(
+                    f"stale writer epoch {epoch}: generation "
+                    f"{top} already published by a newer writer")
+            seq = top[1] + 1 if (top is not None and top[0] == epoch) else 0
+
+        transient = [f for f in self._pending
+                     if f.kind in ("store_eio", "store_enospc")
+                     and f.step == clock]
+        info = None
+        for attempt in range(self.retries + 1):
+            for spec in transient:
+                if spec in self._pending:
+                    code = (TRANSIENT_ERRNOS[0] if spec.kind == "store_eio"
+                            else TRANSIENT_ERRNOS[1])
+                    self.fs.arm(self.fs.ops + max(int(spec.arg), 0),
+                                code, spec)
+            try:
+                info = self._publish_once(epoch, seq, step, meta, artifacts)
+                self.fs.disarm_all()
+                break
+            except OSError as e:
+                for tag in self.fs.drain_fired():
+                    if tag in self._pending:
+                        self._pending.remove(tag)
+                        self.counters["eio_fired" if tag.kind == "store_eio"
+                                      else "enospc_fired"] += 1
+                self.fs.disarm_all()
+                self._scrub_tmp(epoch, seq)
+                if e.errno not in TRANSIENT_ERRNOS or attempt == self.retries:
+                    raise
+                self.counters["io_errors"] += 1
+                self.counters["publish_retries"] += 1
+                # step-clock exponential backoff: pure accounting, no
+                # sleeping — determinism over realism
+                self.counters["backoff_steps"] += self.backoff_base << attempt
+        self.counters["publishes"] += 1
+        self._fire_corruption(clock, info)
+        return info
+
+    def _publish_once(self, epoch, seq, step, meta, artifacts):
+        name = f"gen-{epoch:08d}-{seq:08d}"
+        tmp = os.path.join(self.root, _TMP_PREFIX + name[len("gen-"):])
+        if os.path.isdir(tmp):
+            # leftover from a failed attempt of THIS token — raw
+            # cleanup, not an op (the op clock counts forward progress)
+            shutil.rmtree(tmp)
+        body = {"version": 1, "epoch": epoch, "seq": seq, "step": step,
+                "meta": dict(meta or {}), "artifacts": {}}
+        self.fs.mkdir(tmp)
+        for aname in sorted(artifacts):
+            blob = artifacts[aname]
+            if not isinstance(blob, (bytes, bytearray)):
+                raise TypeError(f"artifact {aname!r}: bytes required, "
+                                f"got {type(blob).__name__}")
+            apath = os.path.join(tmp, aname)
+            self.fs.write(apath, bytes(blob))
+            self.fs.fsync(apath)
+            body["artifacts"][aname] = {
+                "bytes": len(blob),
+                "sha256": hashlib.sha256(bytes(blob)).hexdigest()}
+        sealed = dict(body, seal=_seal(body))
+        mpath = os.path.join(tmp, MANIFEST)
+        self.fs.write(mpath, json.dumps(sealed, sort_keys=True).encode())
+        self.fs.fsync(mpath)
+        self.fs.fsync_dir(tmp)
+        final = os.path.join(self.root, name)
+        self.fs.rename(tmp, final)       # the commit point
+        self.fs.fsync_dir(self.root)
+        return GenerationInfo(epoch, seq, final, manifest=sealed)
+
+    def _scrub_tmp(self, epoch, seq) -> None:
+        tmp = os.path.join(self.root,
+                           f"{_TMP_PREFIX}{epoch:08d}-{seq:08d}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _fire_corruption(self, clock: int, info: GenerationInfo) -> None:
+        for spec in [f for f in self._pending
+                     if f.kind in ("store_torn", "store_flip")
+                     and f.step == clock]:
+            self._pending.remove(spec)
+            names = [n for n in info.manifest["artifacts"]]
+            victim = max(names, key=lambda n:
+                         (info.manifest["artifacts"][n]["bytes"], n))
+            arg = int(spec.arg)
+            if spec.kind == "store_torn":
+                corrupt_file(os.path.join(info.path, victim), torn_at=arg)
+                self.counters["torn_fired"] += 1
+            else:
+                corrupt_file(os.path.join(info.path, victim), flip_at=arg)
+                self.counters["flip_fired"] += 1
+
+    # -- validation / quarantine / recovery --------------------------------
+
+    def validate(self, info: GenerationInfo) -> Optional[dict]:
+        """Full integrity check of one generation: manifest parses, its
+        seal matches, its token matches the directory name, every
+        artifact is present with exact size and sha256, and no foreign
+        file hides in the directory.  Returns the manifest, or None."""
+        try:
+            raw = self.fs.read(os.path.join(info.path, MANIFEST))
+            man = json.loads(raw.decode())
+            body = {k: v for k, v in man.items() if k != "seal"}
+            if man.get("seal") != _seal(body):
+                return None
+            if (int(man["epoch"]), int(man["seq"])) != info.token:
+                return None
+            files = [n for n in self.fs.listdir(info.path) if n != MANIFEST]
+            if sorted(files) != sorted(man["artifacts"]):
+                return None
+            for aname, rec in man["artifacts"].items():
+                apath = os.path.join(info.path, aname)
+                blob = self.fs.read(apath)
+                if len(blob) != int(rec["bytes"]):
+                    return None
+                if hashlib.sha256(blob).hexdigest() != rec["sha256"]:
+                    return None
+            return man
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _quarantine(self, info: GenerationInfo) -> None:
+        qdir = os.path.join(self.root, QUARANTINE)
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, info.name)
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(qdir, f"{info.name}.quarantined{n}")
+        os.rename(info.path, dst)
+        self.counters["quarantined"] += 1
+
+    def quarantined(self) -> list:
+        """Names under ``_quarantine/`` (evidence, never adopted)."""
+        return list(self._entries(QUARANTINE))
+
+    def sweep_tmp(self) -> int:
+        """Move crash-leftover ``.tmp-gen-*`` dirs into quarantine (an
+        unsealed half-publish is evidence too, never adopted, never
+        silently deleted).  Returns how many were swept."""
+        n = 0
+        for name in self._entries():
+            if name.startswith(_TMP_PREFIX):
+                qdir = os.path.join(self.root, QUARANTINE)
+                os.makedirs(qdir, exist_ok=True)
+                dst = os.path.join(qdir, name)
+                k = 0
+                while os.path.exists(dst):
+                    k += 1
+                    dst = os.path.join(qdir, f"{name}.quarantined{k}")
+                os.rename(os.path.join(self.root, name), dst)
+                self.counters["tmp_swept"] += 1
+                n += 1
+        return n
+
+    def newest_valid(self) -> Optional[GenerationInfo]:
+        """Recovery scan: newest generation that passes `validate`.
+        Invalid generations met on the way down are quarantined (and
+        counted) — the next scan never re-trips on them.  Leftover tmp
+        dirs are swept first.  Returns None when nothing valid exists."""
+        self.sweep_tmp()
+        for info in self.generations():
+            man = self.validate(info)
+            if man is not None:
+                info.manifest = man
+                self.counters["restores"] += 1
+                return info
+            self._quarantine(info)
+        return None
+
+    def valid_generations(self) -> List[GenerationInfo]:
+        """Every generation that validates, newest token first —
+        invalid ones met during the scan are quarantined exactly like
+        `newest_valid` (this is its whole-log twin; the fleet capsule
+        log reads its park/claim history through it)."""
+        self.sweep_tmp()
+        out = []
+        for info in self.generations():
+            man = self.validate(info)
+            if man is None:
+                self._quarantine(info)
+            else:
+                info.manifest = man
+                out.append(info)
+        return out
+
+    def lookup(self, token) -> Optional[GenerationInfo]:
+        """The generation with exactly this (epoch, seq) token, if it
+        exists AND validates (quarantined on failure)."""
+        for info in self.generations():
+            if info.token == tuple(token):
+                man = self.validate(info)
+                if man is None:
+                    self._quarantine(info)
+                    return None
+                info.manifest = man
+                return info
+        return None
+
+    # -- reading -----------------------------------------------------------
+
+    def read(self, info: GenerationInfo, name: str) -> bytes:
+        """One artifact's bytes, digest-checked at read time (a
+        generation torn AFTER its validating scan is still refused)."""
+        if info.manifest is None:
+            man = self.validate(info)
+            if man is None:
+                self.counters["read_rejects"] += 1
+                raise ValueError(f"generation {info.name} fails validation")
+            info.manifest = man
+        rec = info.manifest["artifacts"].get(name)
+        if rec is None:
+            raise KeyError(f"generation {info.name}: no artifact {name!r}")
+        blob = self.fs.read(os.path.join(info.path, name))
+        if (len(blob) != int(rec["bytes"])
+                or hashlib.sha256(blob).hexdigest() != rec["sha256"]):
+            self.counters["read_rejects"] += 1
+            raise ValueError(
+                f"artifact {name!r} of {info.name}: digest mismatch at "
+                "read time — refusing torn bytes")
+        return blob
+
+    def load(self, info: GenerationInfo) -> Dict[str, bytes]:
+        """Every artifact of a generation, digest-checked."""
+        if info.manifest is None and self.validate(info) is None:
+            self.counters["read_rejects"] += 1
+            raise ValueError(f"generation {info.name} fails validation")
+        return {name: self.read(info, name)
+                for name in info.manifest["artifacts"]}
+
+    # -- retention ---------------------------------------------------------
+
+    def gc(self, keep: int) -> int:
+        """Collect valid generations beyond the ``keep`` newest.  The
+        newest valid generation is structurally uncollectable: the
+        survivor set is filled newest-first BEFORE anything is deleted,
+        and ``keep >= 1`` is enforced.  Invalid generations met during
+        the scan are quarantined, never counted against ``keep`` and
+        never deleted.  Returns the number collected."""
+        if keep < 1:
+            raise ValueError("DurableStore.gc: keep must be >= 1 — the "
+                             "newest valid generation is not collectable")
+        survivors, victims = [], []
+        for info in self.generations():
+            if self.validate(info) is None:
+                self._quarantine(info)
+            elif len(survivors) < keep:
+                survivors.append(info)
+            else:
+                victims.append(info)
+        for info in victims:
+            self.fs.remove_tree(info.path)
+            self.counters["gc_collected"] += 1
+        return len(victims)
+
+    # -- chaos accounting --------------------------------------------------
+
+    def report_unfired(self) -> list:
+        """STORE_KINDS specs still pending — the storage half of the
+        end-of-run honesty check (`resilience.inject.report_unfired`
+        flags the same specs when NO store consumed them)."""
+        return list(self._pending)

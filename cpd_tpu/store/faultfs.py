@@ -1,0 +1,179 @@
+"""FaultFS — the store's I/O boundary, and the door storage chaos enters.
+
+Every byte `cpd_tpu.store.DurableStore` moves crosses ONE wrapper around
+the handful of POSIX primitives a crash-consistent publish needs
+(mkdir / write / per-file fsync / rename / directory fsync / subtree
+remove).  Funnelling them through a single object buys two things:
+
+* **Determinism** — a monotonically counted *op clock* over the
+  write-class primitives.  The nth write op is the same op on every
+  run, so `store_eio@s:n` / `store_enospc@s:n` specs and the crash
+  matrix's kill-at-op-n strata (tools/bench_store.py) aim at exact
+  write boundaries instead of wall-clock races.
+* **Chaos** — one-shot transient ``EIO`` / ``ENOSPC`` injection
+  (consumed when fired, so the store's deterministic retry provably
+  absorbs it) and simulated power loss (``crash_at_op`` →
+  ``os._exit``: nothing buffered after the boundary survives, exactly
+  like the plug being pulled).
+
+Read-class helpers (`read` / `listdir` / `exists`) are NOT on the op
+clock: a crash "during a read" is not a write boundary, and the store's
+recovery scan must be free to probe a wounded tree without advancing
+the clock the faults aim at.
+
+Post-publish corruption (`store_torn@s:k` / `store_flip@s:k`) also
+bypasses the clock — a torn or flipped generation is an adversary
+editing sealed bytes behind the store's back.  It shares ONE injection
+body, `corrupt_file`, with PR 2's legacy host one-shots
+(`ckpt_truncate` / `ckpt_bitflip` in resilience/inject.py), so the old
+checkpoint drills and the new storage drills corrupt bytes the exact
+same way.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import shutil
+from typing import List, Optional, Tuple
+
+# transient errnos the store retries; anything else propagates
+TRANSIENT_ERRNOS = (errno.EIO, errno.ENOSPC)
+
+# the crash matrix recognises this exit code as "simulated power loss"
+CRASH_EXIT = 73
+
+# write-class primitive names, in no particular order (docs/tests)
+WRITE_OPS = ("mkdir", "write", "fsync", "rename", "fsync_dir", "remove")
+
+
+class FaultFS:
+    """Counted, injectable wrapper over the store's POSIX write path.
+
+    Args:
+        crash_at_op: when set, the process exits with ``CRASH_EXIT``
+            *before executing* write op number ``crash_at_op`` (0-based
+            absolute op clock) — ops ``0 .. crash_at_op-1`` hit disk,
+            nothing after.  The crash matrix sweeps this over every
+            boundary of a publish.
+    """
+
+    def __init__(self, *, crash_at_op: Optional[int] = None):
+        self.ops = 0                      # absolute write-op clock
+        self.crash_at_op = crash_at_op
+        self._armed: List[Tuple[int, int, object]] = []  # (op, errno, tag)
+        self.fired: List[object] = []     # tags of faults that fired
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, at_op: int, errno_code: int, tag=None) -> None:
+        """One-shot: raise ``OSError(errno_code)`` instead of executing
+        absolute op ``at_op``.  ``tag`` (e.g. the FaultSpec) is recorded
+        in ``fired`` when it goes off, for exact-counter accounting."""
+        if errno_code not in TRANSIENT_ERRNOS:
+            raise ValueError(f"FaultFS.arm: unsupported errno {errno_code}")
+        self._armed.append((int(at_op), int(errno_code), tag))
+
+    def disarm_all(self) -> list:
+        """Drop every still-armed fault, returning their tags (the
+        store re-pends them so `report_unfired` stays honest)."""
+        tags = [tag for _, _, tag in self._armed]
+        self._armed = []
+        return tags
+
+    def drain_fired(self) -> list:
+        """Return and clear the tags of faults that fired."""
+        out, self.fired = self.fired, []
+        return out
+
+    # -- the gate ----------------------------------------------------------
+
+    def _gate(self, path: str) -> None:
+        idx = self.ops
+        self.ops += 1
+        if self.crash_at_op is not None and idx == self.crash_at_op:
+            # simulated power loss: no flush, no atexit, no cleanup —
+            # whatever fsync already pinned is all that survives
+            os._exit(CRASH_EXIT)
+        for entry in self._armed:
+            if entry[0] == idx:
+                self._armed.remove(entry)
+                self.fired.append(entry[2])
+                raise OSError(entry[1], os.strerror(entry[1]), path)
+
+    # -- write-class primitives (on the op clock) --------------------------
+
+    def mkdir(self, path: str) -> None:
+        self._gate(path)
+        os.makedirs(path)
+
+    def write(self, path: str, data: bytes) -> None:
+        self._gate(path)
+        with open(path, "wb") as fh:
+            fh.write(data)
+
+    def fsync(self, path: str) -> None:
+        self._gate(path)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def fsync_dir(self, path: str) -> None:
+        self._gate(path)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def rename(self, src: str, dst: str) -> None:
+        self._gate(dst)
+        os.rename(src, dst)
+
+    def remove_tree(self, path: str) -> None:
+        self._gate(path)
+        shutil.rmtree(path)
+
+    # -- read-class helpers (NOT on the op clock) --------------------------
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    def listdir(self, path: str) -> list:
+        return sorted(os.listdir(path))
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+
+def corrupt_file(path: str, *, torn_at: Optional[int] = None,
+                 flip_at: Optional[int] = None) -> str:
+    """The ONE corruption body shared by the legacy checkpoint one-shots
+    (`Injector.corrupt_checkpoint`: ``ckpt_truncate`` / ``ckpt_bitflip``)
+    and the new store kinds (``store_torn@s:k`` / ``store_flip@s:k``).
+
+    ``torn_at=k`` truncates the file at byte ``k`` (``k < 0`` → the
+    legacy half-size cut, ``max(size // 2, 1)``); ``flip_at=k`` XORs
+    the byte at offset ``k`` with 0xFF (``k < 0`` → the legacy midpoint
+    ``size // 2``).  Returns a short description for event logs."""
+    if (torn_at is None) == (flip_at is None):
+        raise ValueError("corrupt_file: exactly one of torn_at / flip_at")
+    size = os.path.getsize(path)
+    if torn_at is not None:
+        cut = max(size // 2, 1) if torn_at < 0 else min(int(torn_at), size)
+        with open(path, "r+b") as fh:
+            fh.truncate(cut)
+        return f"torn@{cut}"
+    off = size // 2 if flip_at < 0 else int(flip_at)
+    if size == 0:
+        raise ValueError(f"corrupt_file: {path} is empty, nothing to flip")
+    off = min(off, size - 1)
+    with open(path, "r+b") as fh:
+        fh.seek(off)
+        byte = fh.read(1)
+        fh.seek(off)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    return f"flip@{off}"

@@ -17,6 +17,7 @@ pytree.
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
 import signal as _signal
@@ -215,24 +216,71 @@ class CheckpointManager:
     sidecar, and ``restore_latest_valid`` walks steps newest-first,
     skipping any whose bytes no longer match — a truncated or bit-flipped
     checkpoint degrades the run by one save interval instead of killing
-    the resume (or worse, silently restoring garbage arrays)."""
+    the resume (or worse, silently restoring garbage arrays).
+
+    **Durable-store mode (ISSUE 20).**  Pass ``store=`` a
+    `cpd_tpu.store.DurableStore` and the checkpoint surface migrates
+    off orbax onto the crash-consistent generation store: each save
+    publishes ONE sealed generation (``state.npz`` of the flattened
+    pytree + ``tree.json`` layout record, per-artifact digests in the
+    manifest), fenced by a writer epoch the manager acquires at
+    construction — a stale elastic-restart writer gets
+    `store.FencedWriterError` instead of clobbering its successor's
+    checkpoints.  Retention is ``store.gc(max_to_keep)`` (provably
+    never the newest valid generation), corruption lands in quarantine
+    instead of being restored, and transient EIO/ENOSPC mid-save is
+    absorbed by the store's deterministic retry — the previous
+    generation stays restorable throughout.  The public API (save /
+    restore / restore_latest_valid / metadata / verify_step /
+    latest_step, including the elastic ``world=`` re-flatten) is
+    unchanged; store-on vs store-off runs are bitwise identical because
+    checkpointing is passive.
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 5,
-                 track_best: bool = True, integrity: bool = True):
+                 track_best: bool = True, integrity: bool = True,
+                 store=None):
         directory = os.path.abspath(directory)
+        self._dir = directory
+        self._integrity = integrity
+        self._keep = int(max_to_keep)
+        self._store = store
+        if store is not None:
+            # the store IS the checkpoint directory; orbax never starts.
+            # The writer epoch is the fence: acquired once per manager
+            # (per process incarnation), refreshed via `refence()` after
+            # an elastic recovery.  `directory` follows the store root
+            # so every path consumer (the legacy corruption drills
+            # included) aims at the generations that actually exist.
+            self._dir = store.root
+            self._mgr = None
+            self._writer = store.acquire_writer()
+            return
         kwargs = {}
         if track_best:   # orbax requires best_mode in {'min','max'} if set
             kwargs = {"best_fn": lambda m: m.get("best_metric", 0.0),
                       "best_mode": "max"}
         options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                **kwargs)
-        self._dir = directory
-        self._integrity = integrity
         self._mgr = ocp.CheckpointManager(directory, options=options)
 
     @property
     def directory(self) -> str:
         return self._dir
+
+    @property
+    def store(self):
+        """The backing `DurableStore` (None on the orbax path)."""
+        return self._store
+
+    def refence(self) -> int:
+        """Store mode: acquire a FRESH writer epoch (after an elastic
+        recovery — the rebuilt incarnation must fence out any save the
+        pre-failure incarnation still has in flight)."""
+        if self._store is None:
+            raise ValueError("refence() only exists in store mode")
+        self._writer = self._store.acquire_writer()
+        return self._writer
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self._dir, str(step))
@@ -254,6 +302,9 @@ class CheckpointManager:
         itself is written atomically (tmp + rename), so a crash mid-write
         leaves either the old sidecar or the new one, never a torn file.
         """
+        if self._store is not None:
+            self._store_save(step, state, best_metric, metadata)
+            return
         metrics = ({"best_metric": float(best_metric)}
                    if best_metric is not None else None)
         if force and step in self._mgr.all_steps():
@@ -292,11 +343,97 @@ class CheckpointManager:
             os.replace(tmp, os.path.join(self._dir, f"meta-{step}.json"))
             self._gc_metadata(keep=step)
 
+    # -- durable-store backend (ISSUE 20) ---------------------------------
+
+    def _store_save(self, step: int, state: TrainState,
+                    best_metric, metadata) -> None:
+        """One checkpoint = one sealed generation: the flattened pytree
+        as ``state.npz`` (leaf order = tree order, dtype-exact), the
+        layout as ``tree.json``, the sidecar dict in the manifest's
+        ``meta``.  Rank gating: only process 0 publishes, matching the
+        orbax path's sole-sidecar-writer rule."""
+        if jax.process_index() != 0:
+            return
+        leaves = jax.tree_util.tree_leaves(jax.device_get(state))
+        buf = io.BytesIO()
+        np.savez(buf, **{f"leaf{i:06d}": np.asarray(l)
+                         for i, l in enumerate(leaves)})
+        tree = {"n_leaves": len(leaves),
+                "shapes": [list(np.shape(l)) for l in leaves],
+                "dtypes": [str(jnp_dtype(l)) for l in leaves]}
+        meta = dict(metadata or {})
+        if best_metric is not None:
+            meta["best_metric"] = float(best_metric)
+        z = _find_zero_state(getattr(state, "opt_state", None))
+        if z is not None:
+            meta["zero_layout"] = {
+                "momentum_padded": int(np.shape(z.momentum)[0])}
+        self._store.publish(
+            {"state.npz": buf.getvalue(),
+             "tree.json": json.dumps(tree, sort_keys=True).encode()},
+            step=int(step), meta=meta, writer=self._writer)
+        self._store.gc(keep=self._keep)
+
+    def _store_gens(self) -> list:
+        """Valid generations newest-token-first, manifests loaded;
+        invalid ones are quarantined on the way (the store's contract).
+        Newest generation wins for a step saved twice (rollback replay
+        re-saves)."""
+        out = []
+        for info in self._store.generations():
+            man = self._store.validate(info)
+            if man is None:
+                self._store._quarantine(info)
+                continue
+            info.manifest = man
+            out.append(info)
+        return out
+
+    def _store_lookup(self, step: int):
+        for info in self._store_gens():
+            if info.step == int(step):
+                return info
+        return None
+
+    def _store_restore(self, info, state_template: TrainState):
+        blobs = self._store.load(info)
+        tree = json.loads(blobs["tree.json"].decode())
+        with np.load(io.BytesIO(blobs["state.npz"])) as z:
+            saved = [z[f"leaf{i:06d}"] for i in range(tree["n_leaves"])]
+        tleaves, treedef = jax.tree_util.tree_flatten(state_template)
+        if len(saved) != len(tleaves):
+            raise ValueError(
+                f"store checkpoint step {info.step}: {len(saved)} saved "
+                f"leaves vs {len(tleaves)} in the template")
+        out = []
+        for i, (s, t) in enumerate(zip(saved, tleaves)):
+            want = np.dtype(tree["dtypes"][i])
+            if s.dtype != want and s.dtype.itemsize == want.itemsize:
+                # npz round-trips extension dtypes (bfloat16, fp8) as
+                # raw void bytes; the recorded dtype restores the view
+                # bit-exactly
+                s = s.view(want)
+            if tuple(s.shape) != tuple(np.shape(t)) or \
+                    s.dtype != np.dtype(jnp_dtype(t)):
+                raise ValueError(
+                    f"store checkpoint step {info.step}, leaf {i}: saved "
+                    f"{s.shape}/{s.dtype} vs template "
+                    f"{np.shape(t)}/{jnp_dtype(t)}")
+            out.append(jnp.asarray(s))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
     def verify_step(self, step: int) -> Optional[bool]:
         """Re-hash `step`'s files against the recorded digest.  True =
-        match, False = mismatch (or unreadable), None = no digest was
-        recorded (pre-integrity checkpoint: unknown, not invalid)."""
-        meta = self.metadata(step)
+        match, False = mismatch (or unreadable — a sidecar that EXISTS
+        but does not parse is a torn write, invalid, not unknown), None
+        = no digest was recorded (pre-integrity checkpoint: unknown,
+        not invalid)."""
+        if self._store is not None:
+            info = self._store_lookup(step)
+            return False if info is None else True
+        status, meta = self._read_sidecar(step)
+        if status == "torn":
+            return False
         recorded = (meta or {}).get("integrity")
         if not recorded:
             return None
@@ -325,22 +462,46 @@ class CheckpointManager:
                     except OSError:
                         pass
 
+    def _read_sidecar(self, step: int) -> tuple:
+        """Tri-state sidecar read: ``("ok", dict)``, ``("absent",
+        None)``, or ``("torn", None)`` for a sidecar that exists but
+        does not parse — a truncated/garbled write that must read as
+        *invalid-and-skip*, never as "no digest recorded" (which would
+        let a corrupt checkpoint restore unverified) and never as a
+        crash (which would kill the whole resume scan)."""
+        path = os.path.join(self._dir, f"meta-{step}.json")
+        if not os.path.exists(path):
+            return "absent", None
+        try:
+            with open(path) as f:
+                return "ok", json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError, OSError):
+            return "torn", None
+
     def metadata(self, step: Optional[int] = None) -> Optional[dict]:
-        """Sidecar metadata saved with `step` (default: latest), or None."""
+        """Sidecar metadata saved with `step` (default: latest), or None
+        (absent OR torn — `verify_step` tells the two apart)."""
+        if self._store is not None:
+            if step is None:
+                step = self.latest_step()
+            if step is None:
+                return None
+            info = self._store_lookup(step)
+            return None if info is None else (info.meta or None)
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
             return None
-        path = os.path.join(self._dir, f"meta-{step}.json")
-        if not os.path.exists(path):
-            return None
-        with open(path) as f:
-            return json.load(f)
+        return self._read_sidecar(step)[1]
 
     def wait(self):
-        self._mgr.wait_until_finished()
+        if self._mgr is not None:     # store publishes are synchronous
+            self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
+        if self._store is not None:
+            info = self._store.newest_valid()
+            return None if info is None else int(info.step)
         return self._mgr.latest_step()
 
     def restore(self, state_template: TrainState,
@@ -364,9 +525,14 @@ class CheckpointManager:
         momentum is restored at its saved length, trimmed of the old
         world-size pad, and re-flattened through `pad_to_world` at the
         new world — bitwise-faithful, because the pad region holds exact
-        zeros by construction (zero gradients keep zero momentum)."""
+        zeros by construction (zero gradients keep zero momentum).
+
+        Store mode restores unsharded and ignores ``shardings`` (the
+        elastic path's documented trade: every trainer re-lays the
+        state out on its mesh after restore anyway)."""
         if step is None:
-            step = self._mgr.latest_step()
+            step = self.latest_step() if self._store is not None \
+                else self._mgr.latest_step()
         if step is None:
             return None
         if world is not None:
@@ -378,6 +544,11 @@ class CheckpointManager:
                 if saved_len != int(np.shape(ztmpl.momentum)[0]):
                     return self._restore_elastic(state_template, step,
                                                  world, saved_len)
+        if self._store is not None:
+            info = self._store_lookup(step)
+            if info is None:
+                return None
+            return self._store_restore(info, state_template)
         if shardings is None:
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
                                     state_template)
@@ -463,6 +634,9 @@ class CheckpointManager:
         (`ckpts_unverified`) instead of silently treating it as
         verified.  `world` enables the elastic ZeRO re-flatten (see
         `restore`)."""
+        if self._store is not None:
+            return self._store_restore_latest_valid(state_template, rank,
+                                                    world)
         skipped = []
         for step in sorted(self._mgr.all_steps(), reverse=True):
             verdict = self.verify_step(step)
@@ -495,8 +669,60 @@ class CheckpointManager:
                                  metadata=self.metadata(step))
         return None
 
+    def _store_restore_latest_valid(self, state_template: TrainState,
+                                    rank: int, world: Optional[int]
+                                    ) -> Optional[RestoreResult]:
+        """The store-mode resume scan: newest generation down, corrupt
+        ones quarantined + reported in ``skipped`` (they feed
+        ``ckpts_invalid`` exactly like an orbax digest mismatch).
+        ``verified`` is always True here — a sealed manifest with
+        per-artifact digests exists for every generation by
+        construction, so the unverified-restore gap cannot occur."""
+        skipped: list = []
+        seen: set = set()
+        for info in self._store.generations():
+            man = self._store.validate(info)
+            if man is None:
+                # report the STEP like the orbax scan does (callers
+                # match on ints); the generation name is only the
+                # fallback label when the manifest itself is the
+                # casualty and the step is unrecoverable
+                try:
+                    with open(os.path.join(info.path,
+                                           "MANIFEST.json")) as fh:
+                        label: Any = int(json.load(fh)["step"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    label = info.name
+                self._store._quarantine(info)
+                if rank == 0:
+                    print(f"=> store checkpoint {info.name}: failed "
+                          f"validation — quarantined, skipping",
+                          file=sys.stderr)
+                skipped.append(label)
+                continue
+            info.manifest = man
+            step = int(info.step)
+            if step in seen:
+                continue        # older duplicate of a re-saved step
+            seen.add(step)
+            try:
+                state = self.restore(state_template, step=step,
+                                     world=world)
+            except Exception as e:
+                if rank == 0:
+                    print(f"=> store checkpoint {step}: restore failed "
+                          f"({type(e).__name__}: {e}) — skipping",
+                          file=sys.stderr)
+                skipped.append(step)
+                continue
+            return RestoreResult(state, step, tuple(skipped),
+                                 verified=True,
+                                 metadata=info.meta or None)
+        return None
+
     def close(self):
-        self._mgr.close()
+        if self._mgr is not None:
+            self._mgr.close()
 
 
 def save_checkpoint(directory: str, step: int, state: TrainState,

@@ -1,0 +1,596 @@
+"""Durable state plane tests (cpd_tpu/store/, ISSUE 20): the
+crash-consistent `DurableStore` all three persistence surfaces ride,
+the `FaultFS` storage-chaos boundary, and the surface migrations
+(trainer checkpoints, engine snapshots, session capsules).
+
+Oracles:
+
+  * bitwise restore — whatever was published is what restores, or
+    nothing is (a torn generation quarantines; it never half-loads);
+  * store-on == store-off — each surface's serialized bytes are
+    IDENTICAL through the store and through its legacy path (shared
+    serialization bodies make this true by construction; these tests
+    pin it);
+  * previous-generation survival — a failed publish (EIO / ENOSPC /
+    simulated crash leftovers) never damages the last good generation,
+    on every surface;
+  * counted, never silent — quarantines, sweeps, retries, fence
+    refusals and unfired store chaos all land in exact counters.
+
+The kill-at-every-write-boundary matrix and the whole-fleet
+cold-restore drill live in the `store-smoke` CI gate
+(tools/bench_store.py); these tests pin the mechanisms in-process.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cpd_tpu.resilience.inject import (STORE_KINDS, FaultPlan, Injector,
+                                       report_unfired)
+from cpd_tpu.store import (MANIFEST, QUARANTINE, DurableStore, FaultFS,
+                           FencedWriterError, corrupt_file)
+from cpd_tpu.train.checkpoint import CheckpointManager
+from cpd_tpu.train.state import TrainState
+
+# ---------------------------------------------------------------------------
+# store core
+# ---------------------------------------------------------------------------
+
+
+def _arts(tag: str) -> dict:
+    return {"state.json": json.dumps({"tag": tag}).encode(),
+            "pages.npy": (tag * 37).encode()}
+
+
+def test_publish_restore_bitwise(tmp_path):
+    s = DurableStore(str(tmp_path))
+    info = s.publish(_arts("a"), step=3, meta={"k": "v"})
+    assert info.step == 3 and info.meta == {"k": "v"}
+    got = s.newest_valid()
+    assert got is not None and got.token == info.token
+    assert s.load(got) == _arts("a")
+
+
+def test_tokens_monotonic_and_fencing(tmp_path):
+    s = DurableStore(str(tmp_path))
+    w1 = s.acquire_writer()
+    g0 = s.publish(_arts("a"), step=0, writer=w1)
+    g1 = s.publish(_arts("b"), step=1, writer=w1)
+    assert g1.token > g0.token and g1.epoch == g0.epoch
+    # a successor writer takes a higher epoch; the stale writer is
+    # refused, never clobbered
+    w2 = DurableStore(str(tmp_path)).acquire_writer()
+    assert w2 > w1
+    s2 = DurableStore(str(tmp_path))
+    s2.publish(_arts("c"), step=2, writer=w2)
+    with pytest.raises(FencedWriterError):
+        s.publish(_arts("d"), step=3, writer=w1)
+    assert s.counters["fence_refusals"] == 1
+    # the refused publish left no trace; the successor's is newest
+    top = s2.newest_valid()
+    assert s2.load(top) == _arts("c")
+
+
+def test_fencing_sees_quarantined_epochs(tmp_path):
+    """A quarantined epoch still proves that writer existed — the next
+    epoch must be allocated above it."""
+    s = DurableStore(str(tmp_path))
+    w1 = s.acquire_writer()
+    info = s.publish(_arts("a"), step=0, writer=w1)
+    corrupt_file(os.path.join(info.path, "pages.npy"), flip_at=0)
+    assert s.newest_valid() is None          # quarantined
+    assert DurableStore(str(tmp_path)).acquire_writer() > w1
+
+
+def test_validate_rejects_each_corruption(tmp_path):
+    cases = {
+        "flip": lambda p: corrupt_file(os.path.join(p, "pages.npy"),
+                                       flip_at=4),
+        "torn": lambda p: corrupt_file(os.path.join(p, "pages.npy"),
+                                       torn_at=3),
+        "manifest": lambda p: corrupt_file(os.path.join(p, MANIFEST),
+                                           torn_at=10),
+        "extra": lambda p: open(os.path.join(p, "foreign.bin"),
+                                "wb").close(),
+        "missing": lambda p: os.unlink(os.path.join(p, "state.json")),
+    }
+    for name, wound in cases.items():
+        root = str(tmp_path / name)
+        s = DurableStore(root)
+        info = s.publish(_arts("x"), step=0)
+        assert s.validate(info) is not None
+        wound(info.path)
+        assert s.validate(info) is None, name
+        assert s.newest_valid() is None
+        assert s.counters["quarantined"] == 1
+        assert len(s.quarantined()) == 1     # evidence kept, not deleted
+
+
+def test_quarantine_never_reduces_valid_count(tmp_path):
+    s = DurableStore(str(tmp_path))
+    w = s.acquire_writer()
+    infos = [s.publish(_arts(f"g{i}"), step=i, writer=w)
+             for i in range(4)]
+    for info in infos[2:]:                   # corrupt the newest two
+        corrupt_file(os.path.join(info.path, "pages.npy"), flip_at=1)
+    assert len(s.valid_generations()) == 2
+    assert s.counters["quarantined"] == 2
+    top = s.newest_valid()
+    assert s.load(top) == _arts("g1")        # falls back bitwise
+    # the scan moved the wounded pair aside; the valid pair is intact
+    assert len(s.valid_generations()) == 2
+
+
+def test_tmp_leftovers_swept_never_adopted(tmp_path):
+    s = DurableStore(str(tmp_path))
+    s.publish(_arts("good"), step=0)
+    # fabricate a crash leftover: a half-written publish that never
+    # reached its commit rename
+    leftover = tmp_path / ".tmp-gen-00000009-00000000"
+    leftover.mkdir()
+    (leftover / "pages.npy").write_bytes(b"half")
+    top = s.newest_valid()
+    assert s.load(top) == _arts("good")
+    assert s.counters["tmp_swept"] == 1
+    assert any(n.startswith(".tmp-gen-") for n in s.quarantined())
+    # the leftover's epoch still fences
+    assert DurableStore(str(tmp_path)).acquire_writer() == 10
+
+
+def test_gc_never_collects_newest_valid(tmp_path):
+    s = DurableStore(str(tmp_path))
+    w = s.acquire_writer()
+    infos = [s.publish(_arts(f"g{i}"), step=i, writer=w)
+             for i in range(5)]
+    # wound the newest two: gc must quarantine them, keep the newest
+    # VALID one, and only collect beyond `keep`
+    for info in infos[3:]:
+        corrupt_file(os.path.join(info.path, "pages.npy"), torn_at=2)
+    assert s.gc(keep=1) == 2                 # g0, g1 collected
+    assert s.counters["quarantined"] == 2
+    assert s.load(s.newest_valid()) == _arts("g2")
+    with pytest.raises(ValueError, match="keep"):
+        s.gc(keep=0)
+
+
+def test_read_rejects_bytes_torn_after_validation(tmp_path):
+    s = DurableStore(str(tmp_path))
+    info = s.publish(_arts("a"), step=0)
+    assert s.validate(info) is not None      # manifest cached as valid
+    corrupt_file(os.path.join(info.path, "pages.npy"), flip_at=2)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        s.read(info, "pages.npy")
+    assert s.counters["read_rejects"] == 1
+
+
+def test_transient_retry_absorbs_and_counts(tmp_path):
+    plan = FaultPlan.parse("store_eio@0:3,store_enospc@1:2")
+    s = DurableStore(str(tmp_path), fault_plan=plan)
+    w = s.acquire_writer()
+    s.publish(_arts("a"), step=0, writer=w)
+    s.publish(_arts("b"), step=1, writer=w)
+    assert s.load(s.newest_valid()) == _arts("b")
+    assert s.counters["eio_fired"] == 1
+    assert s.counters["enospc_fired"] == 1
+    assert s.counters["publish_retries"] == 2
+    assert s.counters["backoff_steps"] == 2
+    assert s.report_unfired() == []
+
+
+def test_exhausted_retries_leave_previous_restorable(tmp_path):
+    plan = FaultPlan.parse("store_enospc@1:2")
+    s = DurableStore(str(tmp_path), retries=0, fault_plan=plan)
+    w = s.acquire_writer()
+    s.publish(_arts("good"), step=0, writer=w)
+    with pytest.raises(OSError):
+        s.publish(_arts("doomed"), step=1, writer=w)
+    assert s.load(s.newest_valid()) == _arts("good")
+    # no half-written residue is left published
+    assert len(s.valid_generations()) == 1
+
+
+def test_nontransient_oserror_propagates_immediately(tmp_path):
+    s = DurableStore(str(tmp_path))
+    # an artifact that cannot be created raises at once — the retry
+    # budget is reserved for the TRANSIENT_ERRNOS pair
+    with pytest.raises((OSError, ValueError)):
+        s.publish({"no/such/dir.bin": b"x"}, step=0)
+    assert s.counters["publish_retries"] == 0
+    assert s.generations() == []
+
+
+def test_store_chaos_fires_through_plan_grammar(tmp_path):
+    plan = FaultPlan.parse("store_flip@0:4,store_torn@1:8")
+    s = DurableStore(str(tmp_path), fault_plan=plan)
+    s.publish(_arts("a"), step=0)            # flipped after sealing
+    assert s.counters["flip_fired"] == 1
+    assert s.newest_valid() is None          # quarantined on scan
+    s.publish(_arts("b"), step=1)            # torn after sealing
+    assert s.counters["torn_fired"] == 1
+    assert s.newest_valid() is None
+    assert s.counters["quarantined"] == 2
+    assert s.report_unfired() == []
+
+
+def test_sub_stores_share_one_accounting_plane(tmp_path):
+    plan = FaultPlan.parse("store_eio@1:2")
+    root = DurableStore(str(tmp_path), fault_plan=plan)
+    a, b = root.sub("engine0"), root.sub("capsules")
+    a.publish(_arts("a"), step=0)            # publish clock 0
+    b.publish(_arts("b"), step=0)            # clock 1 -> the EIO fires
+    assert root.counters["eio_fired"] == 1
+    assert root.counters["publishes"] == 2
+    assert root.report_unfired() == []
+    with pytest.raises(ValueError):
+        root.sub("gen-00000001-00000000")    # reserved names refused
+
+
+def test_report_unfired_store_armed_both_directions(tmp_path):
+    # armed: the store itself flags specs its clock never reached
+    plan = FaultPlan.parse("store_eio@9:1")
+    s = DurableStore(str(tmp_path), fault_plan=plan)
+    s.publish(_arts("a"), step=0)
+    assert len(s.report_unfired()) == 1
+    # unarmed: a plain Injector run with no store consumer flags the
+    # same kinds via report_unfired's default store_armed=False
+    inj = Injector(FaultPlan.parse("store_torn@0:1"))
+    assert len(report_unfired(inj, store_armed=False)) == 1
+    assert report_unfired(inj, store_armed=True) == []
+    assert STORE_KINDS <= {"store_torn", "store_flip", "store_eio",
+                           "store_enospc"}
+
+
+def test_faultfs_crash_semantics_are_prefix_durable(tmp_path):
+    """In-process twin of the crash matrix: a publish attempted with
+    every-op EIO leaves nothing adoptable, and the op clock is
+    deterministic across runs."""
+    ops = []
+    for _ in range(2):
+        fs = FaultFS()
+        s = DurableStore(str(tmp_path / f"r{len(ops)}"), fs=fs)
+        before = fs.ops
+        s.publish(_arts("a"), step=0)
+        ops.append(fs.ops - before)
+    assert ops[0] == ops[1]                  # the clock replays exactly
+
+
+# ---------------------------------------------------------------------------
+# surface 1: trainer checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _ck_state(v: float) -> TrainState:
+    return TrainState(step=jnp.asarray(0, jnp.int32),
+                      params={"w": jnp.full((16,), v, jnp.bfloat16)},
+                      batch_stats={},
+                      opt_state={"m": jnp.zeros((16,), jnp.float32)})
+
+
+def _assert_states_bitwise(a: TrainState, b: TrainState):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert xa.dtype == ya.dtype and xa.shape == ya.shape
+        assert xa.tobytes() == ya.tobytes()
+
+
+def test_checkpoint_store_save_restore_bitwise(tmp_path):
+    store = DurableStore(str(tmp_path))
+    mgr = CheckpointManager(str(tmp_path), store=store, max_to_keep=3)
+    state = _ck_state(1.5)
+    mgr.save(2, state, metadata={"epoch": 1})
+    mgr.wait()
+    assert mgr.latest_step() == 2
+    assert mgr.verify_step(2)
+    got = mgr.restore(_ck_state(0.0), step=2)
+    _assert_states_bitwise(got, state)       # bfloat16 survives exactly
+    assert mgr.metadata(2)["epoch"] == 1
+
+
+def test_checkpoint_store_corrupt_falls_back_and_counts(tmp_path):
+    store = DurableStore(str(tmp_path))
+    mgr = CheckpointManager(str(tmp_path), store=store)
+    mgr.save(2, _ck_state(1.0))
+    mgr.save(4, _ck_state(2.0))
+    top = store.generations()[0]
+    corrupt_file(os.path.join(top.path, "state.npz"), flip_at=64)
+    res = mgr.restore_latest_valid(_ck_state(0.0))
+    assert res is not None and res.step == 2
+    assert res.skipped == (4,)               # step ints, like orbax
+    _assert_states_bitwise(res.state, _ck_state(1.0))
+    assert store.counters["quarantined"] == 1
+
+
+def test_checkpoint_store_fencing_and_refence(tmp_path):
+    store = DurableStore(str(tmp_path))
+    m1 = CheckpointManager(str(tmp_path), store=store)
+    m1.save(2, _ck_state(1.0))
+    # a successor incarnation on the same root takes a newer epoch
+    m2 = CheckpointManager(str(tmp_path),
+                           store=DurableStore(str(tmp_path)))
+    m2.save(4, _ck_state(2.0))
+    with pytest.raises(FencedWriterError):
+        m1.save(6, _ck_state(3.0))
+    m1.refence()                             # the elastic-recovery path
+    m1.save(6, _ck_state(3.0))
+    assert m1.latest_step() == 6
+
+
+def test_checkpoint_store_enospc_mid_save_previous_restorable(tmp_path):
+    plan = FaultPlan.parse("store_enospc@1:3")
+    store = DurableStore(str(tmp_path), retries=0, fault_plan=plan)
+    mgr = CheckpointManager(str(tmp_path), store=store)
+    mgr.save(2, _ck_state(1.0))
+    with pytest.raises(OSError):
+        mgr.save(4, _ck_state(2.0))
+    res = mgr.restore_latest_valid(_ck_state(0.0))
+    assert res is not None and res.step == 2
+    _assert_states_bitwise(res.state, _ck_state(1.0))
+
+
+def test_checkpoint_store_force_resave_newest_wins(tmp_path):
+    store = DurableStore(str(tmp_path))
+    mgr = CheckpointManager(str(tmp_path), store=store)
+    mgr.save(2, _ck_state(1.0))
+    mgr.save(2, _ck_state(9.0), force=True)  # rollback replay re-saves
+    got = mgr.restore(_ck_state(0.0), step=2)
+    _assert_states_bitwise(got, _ck_state(9.0))
+
+
+# satellite 1: the orbax path's torn-sidecar regression
+
+
+def test_torn_sidecar_is_invalid_and_skipped_not_a_crash(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), track_best=False)
+    try:
+        mgr.save(2, _ck_state(1.0))
+        mgr.save(4, _ck_state(2.0))
+        mgr.wait()
+        side = os.path.join(str(tmp_path), "meta-4.json")
+        assert os.path.exists(side)
+        corrupt_file(side, torn_at=max(os.path.getsize(side) // 2, 1))
+        assert mgr.verify_step(4) is False   # torn != crash
+        assert mgr.metadata(4) is None
+        res = mgr.restore_latest_valid(_ck_state(0.0))
+        assert res is not None and res.step == 2
+        assert 4 in res.skipped              # counted ckpts_invalid
+    finally:
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# surfaces 2 + 3: engine snapshots and session capsules
+# ---------------------------------------------------------------------------
+
+VOCAB = 64
+ENGINE_KW = dict(n_slots=2, max_seq=32, page_size=8, prefill_chunk=4,
+                 kv_format=(8, 23))
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    from cpd_tpu.models import transformer_lm
+    model = transformer_lm(vocab_size=VOCAB, d_model=32, n_layers=2,
+                           n_heads=4, n_kv_heads=2, d_ff=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _busy_engine(small_model):
+    from cpd_tpu.serve import Request, ServeEngine
+    model, params = small_model
+    eng = ServeEngine(model, params, **ENGINE_KW)
+    rng = np.random.RandomState(3)
+    for i in range(2):
+        eng.submit(Request(
+            rid=i, prompt=tuple(int(x) for x in rng.randint(0, VOCAB, 6)),
+            max_new_tokens=6, arrival=0))
+    for _ in range(3):
+        eng.step()
+    return eng
+
+
+def test_engine_snapshot_store_on_equals_store_off(small_model,
+                                                   tmp_path):
+    from cpd_tpu.serve import ServeEngine
+    eng = _busy_engine(small_model)
+    store = DurableStore(str(tmp_path / "gen"))
+    info = eng.snapshot_store(store)
+    eng.snapshot(str(tmp_path / "dir"))
+    blobs = store.load(info)
+    for name, blob in blobs.items():         # identical bytes both ways
+        with open(os.path.join(str(tmp_path / "dir"), name), "rb") as fh:
+            assert fh.read() == blob, name
+    restored = ServeEngine.restore_store(*small_model, store)
+    assert restored.step_index == eng.step_index
+    assert sorted(restored._inflight) == sorted(eng._inflight)
+    # the restored engine's next snapshot is bitwise the same state
+    assert restored._snapshot_blobs() == eng._snapshot_blobs()
+
+
+def test_engine_snapshot_eio_previous_generation_restorable(
+        small_model, tmp_path):
+    from cpd_tpu.serve import ServeEngine
+    eng = _busy_engine(small_model)
+    plan = FaultPlan.parse("store_eio@1:3")
+    store = DurableStore(str(tmp_path), retries=0, fault_plan=plan)
+    first = eng.snapshot_store(store)
+    eng.step()
+    with pytest.raises(OSError):
+        eng.snapshot_store(store)
+    restored = ServeEngine.restore_store(*small_model, store)
+    assert restored.step_index == first.manifest["step"]
+
+
+def test_capsule_store_roundtrip_and_enospc(small_model, tmp_path):
+    from cpd_tpu.fleet import SessionCapsule, extract_capsule
+    eng = _busy_engine(small_model)
+    rid = sorted(eng._inflight)[0]
+    cap = extract_capsule(eng, rid)
+    store = DurableStore(str(tmp_path / "log"))
+    info = cap.to_store(store, step=int(eng.step_index))
+    assert info.meta["surface"] == "capsule" and info.meta["rid"] == rid
+    back = SessionCapsule.from_store(store)
+    back.verify()
+    assert back.seal == cap.seal
+    assert (back.pool_pages == cap.pool_pages).all()
+    # bytes identical to the legacy directory form
+    cap.to_dir(str(tmp_path / "dir"))
+    for name, blob in store.load(info).items():
+        with open(os.path.join(str(tmp_path / "dir"), name), "rb") as fh:
+            assert fh.read() == blob, name
+    # a failed re-publish leaves the parked capsule restorable
+    plan = FaultPlan.parse("store_enospc@1:2")
+    s2 = DurableStore(str(tmp_path / "log2"), retries=0,
+                      fault_plan=plan)
+    cap.to_store(s2, step=0)
+    with pytest.raises(OSError):
+        cap.to_store(s2, step=1)
+    assert SessionCapsule.from_store(s2).seal == cap.seal
+
+
+def test_legacy_ckpt_kinds_share_corruption_body(tmp_path):
+    """`Injector.corrupt_checkpoint` routes through the same
+    `corrupt_file` as STORE_KINDS — including against a store-backed
+    checkpoint directory (it finds the step's generation dir)."""
+    store = DurableStore(str(tmp_path))
+    mgr = CheckpointManager(str(tmp_path), store=store)
+    mgr.save(4, _ck_state(1.0))
+    inj = Injector(FaultPlan.parse("ckpt_bitflip@4"))
+    assert inj.corrupt_checkpoint(4, mgr.directory)
+    assert mgr.restore_latest_valid(_ck_state(0.0)) is None
+    assert store.counters["quarantined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the fleet on the store plane
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_cold_restore_bitwise_and_park_claim(small_model,
+                                                   tmp_path):
+    from cpd_tpu.fleet import Fleet
+    from cpd_tpu.serve import Request
+    model, params = small_model
+    kw = dict(ENGINE_KW, record_logits=True)
+
+    def reqs():
+        out = []
+        for i in range(4):
+            rng = np.random.RandomState(i + 1)
+            out.append(Request(
+                rid=i,
+                prompt=tuple(int(x) for x in rng.randint(0, VOCAB, 6)),
+                max_new_tokens=6, sla_class=i % 2, arrival=0,
+                deadline_steps=500))
+        return out
+
+    def rows(fleet):
+        out = {}
+        for e in fleet.engines:
+            for rid, pos, row in e.logits_log:
+                out[(rid, pos)] = row
+        return out
+
+    ref = Fleet(model, params, 2, engine_kw=kw)
+    for r in reqs():
+        ref.submit(r)
+    ref.run_until_drained()
+    ref_rows = rows(ref)
+
+    store = DurableStore(str(tmp_path))
+    fl = Fleet(model, params, 2, engine_kw=kw, store=store,
+               snapshot_every=4)
+    for r in reqs():
+        fl.submit(r)
+    for _ in range(4):
+        fl.step()                            # the cut seals at step 4
+    del fl                                   # total process death
+
+    cold = Fleet.cold_restore(model, params, store, engine_kw=kw)
+    assert cold.step_index == 4
+    assert cold.counters["cold_restores"] == 1
+    cold.run_until_drained()
+    assert cold.unresolved() == []
+    got = rows(cold)
+    assert len(got) > 0 and set(got) <= set(ref_rows)
+    for k in got:                            # bitwise at (8, 23)
+        assert (got[k].view(np.uint32)
+                == ref_rows[k].view(np.uint32)).all(), k
+
+
+def test_fleet_park_claim_exactly_once(small_model, tmp_path):
+    from cpd_tpu.fleet import Fleet
+    from cpd_tpu.serve import Request
+    model, params = small_model
+    store = DurableStore(str(tmp_path))
+    fl = Fleet(model, params, 2, engine_kw=ENGINE_KW, store=store,
+               snapshot_every=4)
+    rng = np.random.RandomState(5)
+    for i in range(2):
+        fl.submit(Request(
+            rid=i, prompt=tuple(int(x) for x in rng.randint(0, VOCAB, 6)),
+            max_new_tokens=8, arrival=0))
+    for _ in range(2):
+        fl.step()
+    fl.park_session(0)
+    assert len(fl.parked_unclaimed()) == 1 and 0 not in fl.placement
+    assert fl.adopt_parked() == [0]          # exactly once...
+    assert fl.adopt_parked() == []           # ...claims fence the rerun
+    src = fl.placement[1]
+    fl.migrate(1)                            # migration writes the log
+    assert fl.placement[1] != src
+    assert fl.parked_unclaimed() == []
+    assert fl.counters["capsules_parked"] == 2
+    assert fl.counters["capsules_claimed"] == 2
+    fl.run_until_drained()
+    assert fl.unresolved() == []
+
+
+def test_fleet_superseded_park_never_duplicates(small_model, tmp_path):
+    """A park whose extraction happened AFTER the snapshot cut is
+    superseded on cold restore — the in-engine copy resumes; the
+    parked record is claimed, never adopted into a duplicate."""
+    from cpd_tpu.fleet import Fleet
+    from cpd_tpu.serve import Request
+    model, params = small_model
+    store = DurableStore(str(tmp_path))
+    fl = Fleet(model, params, 2, engine_kw=ENGINE_KW, store=store,
+               snapshot_every=2)
+    rng = np.random.RandomState(9)
+    for i in range(2):
+        fl.submit(Request(
+            rid=i, prompt=tuple(int(x) for x in rng.randint(0, VOCAB, 6)),
+            max_new_tokens=8, arrival=0))
+    for _ in range(2):
+        fl.step()                            # cut at step 2: rids live
+    fl.park_session(0)                       # post-cut extraction
+    del fl                                   # crash before any claim
+
+    cold = Fleet.cold_restore(model, params, store,
+                              engine_kw=ENGINE_KW)
+    assert any(ev[0] == "park_superseded" for ev in cold.events)
+    assert cold.parked_unclaimed() == []
+    assert sorted(cold.unresolved()) == [0, 1]
+    cold.run_until_drained()
+    assert cold.unresolved() == []
+
+
+def test_registry_absorbs_store_counters(tmp_path):
+    from cpd_tpu.obs.registry import MetricsRegistry
+    s = DurableStore(str(tmp_path))
+    s.publish(_arts("a"), step=0)
+    reg = MetricsRegistry()
+    reg.absorb_store_counters(s)
+    d = reg.as_dict()
+    assert d["cpd_store_publishes"]["value"] == 1.0
+    assert d["cpd_store_generations"]["value"] == 1.0
+    assert d["cpd_store_quarantine_depth"]["value"] == 0.0
